@@ -1,0 +1,14 @@
+"""spark_rapids_tpu: a TPU-native Spark SQL acceleration framework.
+
+Brand-new design with the capabilities of the RAPIDS Accelerator for Apache
+Spark (reference surveyed in SURVEY.md), executing columnar SQL operators as
+fused XLA computations on TPU via JAX/Pallas instead of cuDF/JNI kernels.
+"""
+
+import jax
+
+# SQL semantics require int64/float64 end to end; bf16/f32 remain available
+# where ops opt in (e.g. MXU paths).
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

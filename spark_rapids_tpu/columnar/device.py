@@ -1,0 +1,366 @@
+"""Device-resident columnar data (the TPU analog of GpuColumnVector).
+
+Re-design of the reference's L1 columnar layer
+(ref: sql-plugin/src/main/java/com/nvidia/spark/rapids/GpuColumnVector.java)
+for XLA's compilation model:
+
+* A `DeviceColumn` is a pytree of JAX arrays padded to a static *capacity*
+  bucket; the batch's true row count travels as a traced int32 scalar.
+  XLA therefore compiles each operator once per (schema, capacity bucket),
+  never per row count — the TPU answer to cuDF's dynamic-size kernels.
+* Null handling: a bool `validity` lane per column; data under a null is
+  canonical zero.  Rows at index >= num_rows are padding: validity False.
+* Strings/binary are (offsets:int32[cap+1], data:uint8[char_cap]) tensors.
+* DECIMAL(p<=18) is int64 unscaled values; (p<=38) adds a `data_hi` lane.
+* ARRAY adds an offsets lane over a child column; STRUCT holds children.
+
+Everything registers with jax.tree_util so batches flow through jit/shard_map
+transparently.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import types as t
+from .interop import from_arrow_type, to_arrow_type
+
+DEFAULT_ROW_BUCKETS = (1024, 8192, 65536, 262144, 1048576, 4194304)
+DEFAULT_CHAR_BUCKETS = (16384, 131072, 1048576, 8388608, 67108864, 268435456)
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n; beyond the largest, round up to a power of two."""
+    n = max(int(n), 1)
+    for b in buckets:
+        if n <= b:
+            return b
+    return 1 << math.ceil(math.log2(n))
+
+
+class DeviceColumn:
+    """One column of device data.  A pytree; static aux is the SQL dtype."""
+
+    __slots__ = ("dtype", "data", "validity", "offsets", "data_hi", "children")
+
+    def __init__(self, dtype: t.DataType, data=None, validity=None,
+                 offsets=None, data_hi=None,
+                 children: Tuple["DeviceColumn", ...] = ()):
+        self.dtype = dtype
+        self.data = data
+        self.validity = validity
+        self.offsets = offsets
+        self.data_hi = data_hi
+        self.children = tuple(children)
+
+    # -- pytree -------------------------------------------------------------
+    def tree_flatten(self):
+        leaves = (self.data, self.validity, self.offsets, self.data_hi,
+                  self.children)
+        return leaves, self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, dtype, leaves):
+        data, validity, offsets, data_hi, children = leaves
+        return cls(dtype, data, validity, offsets, data_hi, children)
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        if self.data is not None and not isinstance(self.dtype, (t.StringType, t.BinaryType)):
+            return int(self.data.shape[0])
+        if self.offsets is not None:
+            return int(self.offsets.shape[0]) - 1
+        if self.validity is not None:
+            return int(self.validity.shape[0])
+        raise ValueError("empty column")
+
+    def row_mask(self, num_rows) -> jnp.ndarray:
+        return jnp.arange(self.capacity, dtype=jnp.int32) < num_rows
+
+    def __repr__(self):
+        return f"DeviceColumn({self.dtype.name}, cap={self.capacity})"
+
+
+jax.tree_util.register_pytree_node(
+    DeviceColumn, DeviceColumn.tree_flatten, DeviceColumn.tree_unflatten)
+
+
+class DeviceBatch:
+    """A batch of device columns + traced row count (analog of ColumnarBatch
+    over GpuColumnVector, ref GpuColumnVector.java / ColumnarBatch)."""
+
+    __slots__ = ("columns", "num_rows", "names")
+
+    def __init__(self, columns: Sequence[DeviceColumn], num_rows,
+                 names: Optional[Sequence[str]] = None):
+        self.columns = tuple(columns)
+        if isinstance(num_rows, (int, np.integer)):
+            num_rows = np.int32(num_rows)
+        self.num_rows = num_rows
+        self.names = tuple(names) if names is not None else tuple(
+            f"c{i}" for i in range(len(self.columns)))
+
+    def tree_flatten(self):
+        return (self.columns, self.num_rows), self.names
+
+    @classmethod
+    def tree_unflatten(cls, names, leaves):
+        columns, num_rows = leaves
+        return cls(columns, num_rows, names)
+
+    @property
+    def num_cols(self) -> int:
+        return len(self.columns)
+
+    @property
+    def capacity(self) -> int:
+        if not self.columns:
+            return 0
+        return self.columns[0].capacity
+
+    @property
+    def dtypes(self) -> List[t.DataType]:
+        return [c.dtype for c in self.columns]
+
+    def row_mask(self) -> jnp.ndarray:
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.num_rows
+
+    def column(self, i: int) -> DeviceColumn:
+        return self.columns[i]
+
+    def with_columns(self, columns, names=None) -> "DeviceBatch":
+        return DeviceBatch(columns, self.num_rows,
+                           names if names is not None else None)
+
+    def __repr__(self):
+        return (f"DeviceBatch(cap={self.capacity}, cols="
+                f"{[c.dtype.name for c in self.columns]})")
+
+
+jax.tree_util.register_pytree_node(
+    DeviceBatch, DeviceBatch.tree_flatten, DeviceBatch.tree_unflatten)
+
+
+# ---------------------------------------------------------------------------
+# Host (Arrow) -> device
+# ---------------------------------------------------------------------------
+
+def _np_pad(arr: np.ndarray, cap: int, fill=0) -> np.ndarray:
+    n = arr.shape[0]
+    if n == cap:
+        return arr
+    out = np.full((cap,), fill, dtype=arr.dtype)
+    out[:n] = arr
+    return out
+
+
+def _valid_np(arr: pa.Array) -> np.ndarray:
+    if arr.null_count == 0:
+        return np.ones(len(arr), dtype=np.bool_)
+    return np.asarray(arr.is_valid())
+
+
+def _decimal_unscaled(arr: pa.Array) -> Tuple[np.ndarray, np.ndarray]:
+    """Extract (lo:int64, hi:int64) unscaled little-endian halves of a
+    decimal128 array directly from its buffer."""
+    arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    buf = arr.buffers()[1]
+    raw = np.frombuffer(buf, dtype=np.int64,
+                        count=2 * (len(arr) + arr.offset))
+    raw = raw.reshape(-1, 2)[arr.offset:arr.offset + len(arr)]
+    lo = raw[:, 0].copy()
+    hi = raw[:, 1].copy()
+    return lo, hi
+
+
+def column_to_device(arr: pa.Array, dtype: t.DataType, cap: int,
+                     char_buckets: Sequence[int] = DEFAULT_CHAR_BUCKETS,
+                     xp=jnp) -> DeviceColumn:
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    n = len(arr)
+    validity = xp.asarray(_np_pad(_valid_np(arr), cap, False))
+
+    if isinstance(dtype, (t.StringType, t.BinaryType)):
+        target = pa.large_binary() if isinstance(dtype, t.BinaryType) else pa.large_string()
+        sarr = arr.cast(target)
+        if sarr.null_count:
+            sarr = sarr.fill_null(b"" if isinstance(dtype, t.BinaryType) else "")
+        bufs = sarr.buffers()
+        offs64 = np.frombuffer(bufs[1], dtype=np.int64,
+                               count=n + 1 + sarr.offset)[sarr.offset:]
+        base = offs64[0]
+        offs = (offs64 - base).astype(np.int32)
+        nbytes = int(offs[-1])
+        if bufs[2] is not None:
+            chars = np.frombuffer(bufs[2], dtype=np.uint8,
+                                  count=base + nbytes)[base:]
+        else:
+            chars = np.zeros(0, dtype=np.uint8)
+        char_cap = bucket_for(max(nbytes, 1), char_buckets)
+        offs_p = np.full((cap + 1,), offs[-1] if n else 0, dtype=np.int32)
+        offs_p[:n + 1] = offs
+        return DeviceColumn(dtype,
+                            data=xp.asarray(_np_pad(chars, char_cap)),
+                            validity=validity,
+                            offsets=xp.asarray(offs_p))
+
+    if isinstance(dtype, t.DecimalType):
+        lo, hi = _decimal_unscaled(arr)
+        lo = np.where(np.asarray(_valid_np(arr)), lo, 0)
+        col = DeviceColumn(dtype, data=xp.asarray(_np_pad(lo, cap)),
+                           validity=validity)
+        if not dtype.is64:
+            hi = np.where(np.asarray(_valid_np(arr)), hi, 0)
+            col.data_hi = xp.asarray(_np_pad(hi, cap))
+        return col
+
+    if isinstance(dtype, t.ArrayType):
+        larr = arr.cast(pa.large_list(to_arrow_type(dtype.element_type)))
+        if larr.null_count:
+            larr = larr.fill_null([])
+        offs64 = np.asarray(larr.offsets)
+        base = offs64[0]
+        offs = (offs64 - base).astype(np.int32)
+        child = larr.values[base: base + int(offs[-1])]
+        child_cap = bucket_for(len(child), DEFAULT_ROW_BUCKETS)
+        child_col = column_to_device(child, dtype.element_type, child_cap,
+                                     char_buckets, xp)
+        offs_p = np.full((cap + 1,), offs[-1] if n else 0, dtype=np.int32)
+        offs_p[:n + 1] = offs
+        return DeviceColumn(dtype, validity=validity,
+                            offsets=xp.asarray(offs_p),
+                            children=(child_col,))
+
+    if isinstance(dtype, t.StructType):
+        children = []
+        for i, f in enumerate(dtype.fields):
+            children.append(column_to_device(arr.field(i), f.data_type, cap,
+                                             char_buckets, xp))
+        return DeviceColumn(dtype, validity=validity, children=tuple(children))
+
+    if isinstance(dtype, t.NullType):
+        return DeviceColumn(dtype, data=xp.zeros((cap,), xp.int8),
+                            validity=xp.zeros((cap,), bool))
+
+    # flat types
+    np_dt = t.to_np_dtype(dtype)
+    if arr.null_count:
+        arr = arr.fill_null(False if isinstance(dtype, t.BooleanType) else 0)
+    if isinstance(dtype, t.DateType):
+        npdata = np.asarray(arr.cast(pa.int32()))
+    elif isinstance(dtype, t.TimestampType):
+        npdata = np.asarray(arr.cast(pa.timestamp("us", tz="UTC")).cast(pa.int64()))
+    else:
+        npdata = arr.to_numpy(zero_copy_only=False).astype(np_dt, copy=False)
+    return DeviceColumn(dtype, data=xp.asarray(_np_pad(npdata, cap)),
+                        validity=validity)
+
+
+def batch_to_device(rb: pa.RecordBatch,
+                    row_buckets: Sequence[int] = DEFAULT_ROW_BUCKETS,
+                    char_buckets: Sequence[int] = DEFAULT_CHAR_BUCKETS,
+                    capacity: Optional[int] = None, xp=jnp) -> DeviceBatch:
+    """Upload an Arrow RecordBatch, padding to a capacity bucket."""
+    n = rb.num_rows
+    cap = capacity if capacity is not None else bucket_for(n, row_buckets)
+    cols = []
+    for i, f in enumerate(rb.schema):
+        dtype = from_arrow_type(f.type)
+        cols.append(column_to_device(rb.column(i), dtype, cap, char_buckets, xp))
+    return DeviceBatch(cols, n, names=rb.schema.names)
+
+
+# ---------------------------------------------------------------------------
+# Device -> host (Arrow)
+# ---------------------------------------------------------------------------
+
+def column_to_arrow(col: DeviceColumn, n: int) -> pa.Array:
+    validity = np.asarray(col.validity)[:n] if col.validity is not None else None
+    mask = None if validity is None else ~validity
+    dtype = col.dtype
+
+    if isinstance(dtype, (t.StringType, t.BinaryType)):
+        offs = np.asarray(col.offsets)[:n + 1].astype(np.int64)
+        chars = np.asarray(col.data)
+        nbytes = int(offs[-1]) if n else 0
+        pa_type = pa.large_binary() if isinstance(dtype, t.BinaryType) else pa.large_string()
+        arr = pa.Array.from_buffers(
+            pa_type, n,
+            [None, pa.py_buffer(offs.tobytes()),
+             pa.py_buffer(chars[:max(nbytes, 1)].tobytes())])
+        if mask is not None and mask.any():
+            arr = pa.array(
+                [None if m else v for v, m in zip(arr.to_pylist(), mask)],
+                type=pa_type)
+        return arr
+
+    if isinstance(dtype, t.DecimalType):
+        lo = np.asarray(col.data)[:n]
+        if dtype.is64:
+            vals = [None if (mask is not None and m) else int(v)
+                    for v, m in zip(lo, mask if mask is not None else np.zeros(n, bool))]
+        else:
+            hi = np.asarray(col.data_hi)[:n]
+            vals = []
+            msk = mask if mask is not None else np.zeros(n, bool)
+            for v_lo, v_hi, m in zip(lo, hi, msk):
+                if m:
+                    vals.append(None)
+                else:
+                    vals.append((int(v_hi) << 64) | (int(v_lo) & ((1 << 64) - 1)))
+        import decimal as pydec
+        scale = dtype.scale
+        py = [None if v is None else
+              pydec.Decimal(v).scaleb(-scale) for v in vals]
+        return pa.array(py, type=pa.decimal128(dtype.precision, dtype.scale))
+
+    if isinstance(dtype, t.ArrayType):
+        offs = np.asarray(col.offsets)[:n + 1].astype(np.int64)
+        child_n = int(offs[-1]) if n else 0
+        child = column_to_arrow(col.children[0], child_n)
+        arr = pa.LargeListArray.from_arrays(pa.array(offs, type=pa.int64()),
+                                            child)
+        if mask is not None and mask.any():
+            arr = pa.array([None if m else v
+                            for v, m in zip(arr.to_pylist(), mask)],
+                           type=pa.large_list(to_arrow_type(dtype.element_type)))
+        return arr
+
+    if isinstance(dtype, t.StructType):
+        children = [column_to_arrow(c, n) for c in col.children]
+        names = [f.name for f in dtype.fields]
+        arr = pa.StructArray.from_arrays(children, names=names)
+        if mask is not None and mask.any():
+            arr = pa.array([None if m else v
+                            for v, m in zip(arr.to_pylist(), mask)],
+                           type=to_arrow_type(dtype))
+        return arr
+
+    if isinstance(dtype, t.NullType):
+        return pa.nulls(n)
+
+    data = np.asarray(col.data)[:n]
+    if isinstance(dtype, t.DateType):
+        return pa.array(data.astype(np.int32), type=pa.date32(),
+                        mask=mask)
+    if isinstance(dtype, t.TimestampType):
+        return pa.array(data.astype(np.int64),
+                        type=pa.timestamp("us", tz="UTC"), mask=mask)
+    if isinstance(dtype, t.BooleanType):
+        data = data.astype(np.bool_)
+    return pa.array(data, type=to_arrow_type(dtype), mask=mask)
+
+
+def batch_to_arrow(batch: DeviceBatch) -> pa.RecordBatch:
+    n = int(batch.num_rows)
+    arrays = [column_to_arrow(c, n) for c in batch.columns]
+    names = list(batch.names)
+    return pa.RecordBatch.from_arrays(arrays, names=names)

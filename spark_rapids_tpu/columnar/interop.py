@@ -1,0 +1,107 @@
+"""DataType <-> pyarrow schema interop.
+
+The host-side canonical columnar representation is Arrow (the reference's
+host columns are also Arrow-compatible, ref HostColumnarToGpu.scala:436
+zero-copy Arrow path).  This module converts between our SQL type lattice
+(`spark_rapids_tpu.types`) and pyarrow types.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import pyarrow as pa
+
+from .. import types as t
+
+
+def to_arrow_type(dt: t.DataType) -> pa.DataType:
+    if isinstance(dt, t.BooleanType):
+        return pa.bool_()
+    if isinstance(dt, t.ByteType):
+        return pa.int8()
+    if isinstance(dt, t.ShortType):
+        return pa.int16()
+    if isinstance(dt, t.IntegerType):
+        return pa.int32()
+    if isinstance(dt, t.LongType):
+        return pa.int64()
+    if isinstance(dt, t.FloatType):
+        return pa.float32()
+    if isinstance(dt, t.DoubleType):
+        return pa.float64()
+    if isinstance(dt, t.StringType):
+        return pa.large_string()
+    if isinstance(dt, t.BinaryType):
+        return pa.large_binary()
+    if isinstance(dt, t.DateType):
+        return pa.date32()
+    if isinstance(dt, t.TimestampType):
+        return pa.timestamp("us", tz="UTC")
+    if isinstance(dt, t.DecimalType):
+        return pa.decimal128(dt.precision, dt.scale)
+    if isinstance(dt, t.NullType):
+        return pa.null()
+    if isinstance(dt, t.ArrayType):
+        return pa.large_list(to_arrow_type(dt.element_type))
+    if isinstance(dt, t.StructType):
+        return pa.struct([pa.field(f.name, to_arrow_type(f.data_type),
+                                   nullable=f.nullable) for f in dt.fields])
+    if isinstance(dt, t.MapType):
+        return pa.map_(to_arrow_type(dt.key_type), to_arrow_type(dt.value_type))
+    raise TypeError(f"no arrow mapping for {dt}")
+
+
+def from_arrow_type(at: pa.DataType) -> t.DataType:
+    if pa.types.is_boolean(at):
+        return t.BOOLEAN
+    if pa.types.is_int8(at):
+        return t.BYTE
+    if pa.types.is_int16(at):
+        return t.SHORT
+    if pa.types.is_int32(at):
+        return t.INT
+    if pa.types.is_int64(at):
+        return t.LONG
+    if pa.types.is_uint8(at):
+        return t.SHORT
+    if pa.types.is_uint16(at):
+        return t.INT
+    if pa.types.is_uint32(at) or pa.types.is_uint64(at):
+        return t.LONG
+    if pa.types.is_float32(at):
+        return t.FLOAT
+    if pa.types.is_float64(at):
+        return t.DOUBLE
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return t.STRING
+    if pa.types.is_binary(at) or pa.types.is_large_binary(at):
+        return t.BINARY
+    if pa.types.is_date32(at):
+        return t.DATE
+    if pa.types.is_timestamp(at):
+        return t.TIMESTAMP
+    if pa.types.is_decimal(at):
+        return t.DecimalType(at.precision, at.scale)
+    if pa.types.is_null(at):
+        return t.NULL
+    if pa.types.is_list(at) or pa.types.is_large_list(at):
+        return t.ArrayType(from_arrow_type(at.value_type))
+    if pa.types.is_struct(at):
+        return t.StructType([t.StructField(f.name, from_arrow_type(f.type),
+                                           f.nullable) for f in at])
+    if pa.types.is_map(at):
+        return t.MapType(from_arrow_type(at.key_type),
+                         from_arrow_type(at.item_type))
+    raise TypeError(f"no mapping for arrow type {at}")
+
+
+def to_arrow_schema(names: List[str], dtypes: List[t.DataType]) -> pa.Schema:
+    return pa.schema([pa.field(n, to_arrow_type(d))
+                      for n, d in zip(names, dtypes)])
+
+
+def schema_of(batch: pa.RecordBatch) -> Tuple[List[str], List[t.DataType]]:
+    names = list(batch.schema.names)
+    dtypes = [from_arrow_type(f.type) for f in batch.schema]
+    return names, dtypes

@@ -1,0 +1,405 @@
+"""Typed configuration system.
+
+TPU-native analog of the reference's config machinery
+(ref: sql-plugin/.../RapidsConf.scala:116-296 builder machinery,
+:301-1275 key definitions).  Every entry is typed, documented, validated,
+and defaulted; `generate_docs()` renders docs/configs.md from the registry,
+exactly as the reference generates its docs from code.
+
+Keys keep the `spark.rapids.` prefix so existing reference configuration
+carries over; TPU-specific keys live under `spark.rapids.tpu.` / `.memory.tpu.`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Generic, List, Optional, Sequence, TypeVar
+
+V = TypeVar("V")
+
+_REGISTERED: Dict[str, "ConfEntry"] = {}
+
+
+def _to_bool(s: Any) -> bool:
+    if isinstance(s, bool):
+        return s
+    s = str(s).strip().lower()
+    if s in ("true", "1", "yes"):
+        return True
+    if s in ("false", "0", "no"):
+        return False
+    raise ValueError(f"cannot convert {s!r} to bool")
+
+
+def _to_bytes(s: Any) -> int:
+    """Parse a byte size like '512m', '1g', '16384'."""
+    if isinstance(s, int):
+        return s
+    s = str(s).strip().lower()
+    units = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40, "b": 1}
+    if s and s[-1] in units:
+        return int(float(s[:-1]) * units[s[-1]])
+    return int(s)
+
+
+class ConfEntry(Generic[V]):
+    """One typed config key (ref RapidsConf.scala:116 `ConfEntry`)."""
+
+    def __init__(self, key: str, converter: Callable[[Any], V], doc: str,
+                 default: Optional[V], is_internal: bool = False,
+                 validator: Optional[Callable[[V], Optional[str]]] = None):
+        self.key = key
+        self.converter = converter
+        self.doc = doc
+        self.default = default
+        self.is_internal = is_internal
+        self.validator = validator
+        if key in _REGISTERED:
+            raise ValueError(f"duplicate conf key {key}")
+        _REGISTERED[key] = self
+
+    def get(self, conf: Dict[str, Any]) -> V:
+        raw = conf.get(self.key, None)
+        if raw is None:
+            return self.default  # type: ignore[return-value]
+        v = self.converter(raw)
+        if self.validator is not None:
+            err = self.validator(v)
+            if err:
+                raise ValueError(f"{self.key}: {err}")
+        return v
+
+    def help(self) -> str:
+        return f"{self.key} (default={self.default}): {self.doc}"
+
+
+class ConfBuilder(Generic[V]):
+    """Fluent builder (ref RapidsConf.scala:153 `TypedConfBuilder`)."""
+
+    def __init__(self, key: str, converter: Callable[[Any], V]):
+        self._key = key
+        self._converter = converter
+        self._doc = ""
+        self._internal = False
+        self._validator: Optional[Callable[[V], Optional[str]]] = None
+
+    def doc(self, text: str) -> "ConfBuilder[V]":
+        self._doc = " ".join(text.split())
+        return self
+
+    def internal(self) -> "ConfBuilder[V]":
+        self._internal = True
+        return self
+
+    def check_values(self, allowed: Sequence[V]) -> "ConfBuilder[V]":
+        allowed = list(allowed)
+
+        def v(x):
+            return None if x in allowed else f"must be one of {allowed}, got {x}"
+        self._validator = v
+        return self
+
+    def check(self, fn: Callable[[V], bool], msg: str) -> "ConfBuilder[V]":
+        def v(x):
+            return None if fn(x) else msg
+        self._validator = v
+        return self
+
+    def create_with_default(self, default: V) -> ConfEntry[V]:
+        return ConfEntry(self._key, self._converter, self._doc, default,
+                         self._internal, self._validator)
+
+    def create_optional(self) -> ConfEntry[Optional[V]]:
+        return ConfEntry(self._key, self._converter, self._doc, None,
+                         self._internal, self._validator)
+
+
+def conf(key: str) -> "_Typed":
+    return _Typed(key)
+
+
+class _Typed:
+    def __init__(self, key: str):
+        self.key = key
+
+    def boolean(self) -> ConfBuilder[bool]:
+        return ConfBuilder(self.key, _to_bool)
+
+    def integer(self) -> ConfBuilder[int]:
+        return ConfBuilder(self.key, int)
+
+    def double(self) -> ConfBuilder[float]:
+        return ConfBuilder(self.key, float)
+
+    def string(self) -> ConfBuilder[str]:
+        return ConfBuilder(self.key, str)
+
+    def bytes(self) -> ConfBuilder[int]:
+        return ConfBuilder(self.key, _to_bytes)
+
+
+# ---------------------------------------------------------------------------
+# Key definitions (subset mirrors RapidsConf.scala:301-1275; grows with features)
+# ---------------------------------------------------------------------------
+
+SQL_ENABLED = conf("spark.rapids.sql.enabled").boolean() \
+    .doc("Enable or disable TPU acceleration of SQL plans entirely.") \
+    .create_with_default(True)
+
+BACKEND = conf("spark.rapids.backend").string() \
+    .doc("Accelerator backend. This framework provides 'tpu'.") \
+    .check_values(["tpu", "cpu"]) \
+    .create_with_default("tpu")
+
+EXPLAIN = conf("spark.rapids.sql.explain").string() \
+    .doc("Explain why parts of a query were or were not placed on the TPU: "
+         "NONE, ALL, or NOT_ON_GPU (only report operators that stayed on CPU).") \
+    .check_values(["NONE", "ALL", "NOT_ON_GPU"]) \
+    .create_with_default("NOT_ON_GPU")
+
+INCOMPATIBLE_OPS = conf("spark.rapids.sql.incompatibleOps.enabled").boolean() \
+    .doc("Enable operators that produce results that differ from Spark in "
+         "corner cases (e.g. float ordering of NaN, string upper/lower beyond "
+         "ASCII).") \
+    .create_with_default(False)
+
+ANSI_ENABLED = conf("spark.rapids.sql.ansi.enabled").boolean() \
+    .doc("ANSI-mode overflow/invalid-cast error semantics.") \
+    .create_with_default(False)
+
+BATCH_SIZE_BYTES = conf("spark.rapids.sql.batchSizeBytes").bytes() \
+    .doc("Target size in bytes of output batches for TPU operators "
+         "(ref RapidsConf.scala:437 GPU_BATCH_SIZE_BYTES).") \
+    .check(lambda v: v > 0, "must be positive") \
+    .create_with_default(512 * 1024 * 1024)
+
+MAX_READER_BATCH_SIZE_ROWS = conf("spark.rapids.sql.reader.batchSizeRows").integer() \
+    .doc("Soft cap on rows per batch produced by file readers.") \
+    .create_with_default(2147483647)
+
+MAX_READER_BATCH_SIZE_BYTES = conf("spark.rapids.sql.reader.batchSizeBytes").bytes() \
+    .doc("Soft cap on bytes per batch produced by file readers.") \
+    .create_with_default(2147483647)
+
+DECIMAL_TYPE_ENABLED = conf("spark.rapids.sql.decimalType.enabled").boolean() \
+    .doc("Enable decimal type acceleration (int64-backed fixed point; "
+         "ref RapidsConf.scala:565).") \
+    .create_with_default(True)
+
+REPLACE_SORT_MERGE_JOIN = conf("spark.rapids.sql.replaceSortMergeJoin.enabled").boolean() \
+    .doc("Replace sort-merge joins with TPU hash joins "
+         "(ref RapidsConf.scala:572).") \
+    .create_with_default(True)
+
+STABLE_SORT = conf("spark.rapids.sql.stableSort.enabled").boolean() \
+    .doc("Force stable sort (ref RapidsConf.scala:478).") \
+    .create_with_default(False)
+
+HAS_NANS = conf("spark.rapids.sql.hasNans").boolean() \
+    .doc("Assume floating point data may contain NaN (affects agg/join on floats).") \
+    .create_with_default(True)
+
+VARIABLE_FLOAT_AGG = conf("spark.rapids.sql.variableFloatAgg.enabled").boolean() \
+    .doc("Allow float/double aggregations whose result can vary with "
+         "evaluation order (TPU parallel reductions reorder).") \
+    .create_with_default(True)
+
+CONCURRENT_TPU_TASKS = conf("spark.rapids.sql.concurrentGpuTasks").integer() \
+    .doc("Number of concurrent tasks admitted to the TPU per executor "
+         "(ref RapidsConf.scala:424; name kept for compatibility).") \
+    .check(lambda v: v >= 1, "must be >= 1") \
+    .create_with_default(2)
+
+# --- memory ---------------------------------------------------------------
+
+HBM_POOL_FRACTION = conf("spark.rapids.memory.tpu.allocFraction").double() \
+    .doc("Fraction of HBM to reserve for the framework's arena at startup.") \
+    .check(lambda v: 0.0 < v <= 1.0, "must be in (0,1]") \
+    .create_with_default(0.9)
+
+HBM_RESERVE = conf("spark.rapids.memory.tpu.reserve").bytes() \
+    .doc("Bytes of HBM left un-pooled for XLA scratch space.") \
+    .create_with_default(1 << 30)
+
+HOST_SPILL_STORAGE_SIZE = conf("spark.rapids.memory.host.spillStorageSize").bytes() \
+    .doc("Host-memory spill tier capacity before overflow to disk.") \
+    .create_with_default(1 << 30)
+
+PINNED_POOL_SIZE = conf("spark.rapids.memory.pinnedPool.size").bytes() \
+    .doc("Size of the native host staging arena used for device transfers.") \
+    .create_with_default(0)
+
+SPILL_DIRS = conf("spark.rapids.memory.spill.dirs").string() \
+    .doc("Comma-separated local dirs for the DISK spill tier.") \
+    .create_with_default("/tmp/spark_rapids_tpu_spill")
+
+MEMORY_DEBUG = conf("spark.rapids.memory.tpu.debug").boolean() \
+    .doc("Track allocations for leak diagnostics (ref RapidsConf.scala:307).") \
+    .create_with_default(False)
+
+UNSPILL_ENABLED = conf("spark.rapids.memory.tpu.unspill.enabled").boolean() \
+    .doc("Move spilled buffers back to device memory when touched again.") \
+    .create_with_default(False)
+
+# --- shuffle --------------------------------------------------------------
+
+SHUFFLE_MANAGER_ENABLED = conf("spark.rapids.shuffle.enabled").boolean() \
+    .doc("Use the accelerated shuffle that caches batches in device/host "
+         "memory and exchanges over ICI/DCN instead of row serialization.") \
+    .create_with_default(True)
+
+SHUFFLE_TRANSPORT = conf("spark.rapids.shuffle.transport").string() \
+    .doc("Accelerated shuffle transport: 'ici' (mesh collectives inside a "
+         "pod slice), 'tcp' (host sockets across pods), 'none' (fall back to "
+         "serialized base shuffle).") \
+    .check_values(["ici", "tcp", "none"]) \
+    .create_with_default("ici")
+
+SHUFFLE_COMPRESSION_CODEC = conf("spark.rapids.shuffle.compression.codec").string() \
+    .doc("Codec for shuffle payloads: none, lz4, zstd (native codec library).") \
+    .check_values(["none", "lz4", "zstd"]) \
+    .create_with_default("none")
+
+SHUFFLE_PARTITIONING_MAX_PARTS = conf(
+    "spark.rapids.shuffle.partitioning.maxCpuBatchedParts").integer() \
+    .doc("Above this partition count, slicing happens on host not device.") \
+    .create_with_default(32768)
+
+SHUFFLE_HEARTBEAT_INTERVAL_MS = conf("spark.rapids.shuffle.heartbeat.intervalMs").integer() \
+    .doc("Executor->driver shuffle heartbeat interval "
+         "(ref RapidsShuffleHeartbeatManager).") \
+    .create_with_default(5000)
+
+SHUFFLE_HEARTBEAT_TIMEOUT_MS = conf("spark.rapids.shuffle.heartbeat.timeoutMs").integer() \
+    .doc("Peer considered dead after missing heartbeats for this long.") \
+    .create_with_default(30000)
+
+# --- io -------------------------------------------------------------------
+
+PARQUET_ENABLED = conf("spark.rapids.sql.format.parquet.enabled").boolean() \
+    .doc("Enable TPU parquet scan/write.").create_with_default(True)
+
+PARQUET_READER_TYPE = conf("spark.rapids.sql.format.parquet.reader.type").string() \
+    .doc("PERFILE, COALESCING, or MULTITHREADED (ref RapidsConf.scala:706).") \
+    .check_values(["PERFILE", "COALESCING", "MULTITHREADED", "AUTO"]) \
+    .create_with_default("AUTO")
+
+PARQUET_MULTITHREAD_READ_NUM_THREADS = conf(
+    "spark.rapids.sql.format.parquet.multiThreadedRead.numThreads").integer() \
+    .doc("Thread pool size for the MULTITHREADED cloud reader.") \
+    .create_with_default(20)
+
+ORC_ENABLED = conf("spark.rapids.sql.format.orc.enabled").boolean() \
+    .doc("Enable TPU ORC scan/write.").create_with_default(True)
+
+CSV_ENABLED = conf("spark.rapids.sql.format.csv.enabled").boolean() \
+    .doc("Enable TPU CSV scan.").create_with_default(True)
+
+# --- udf ------------------------------------------------------------------
+
+UDF_COMPILER_ENABLED = conf("spark.rapids.sql.udfCompiler.enabled").boolean() \
+    .doc("Compile Python lambda UDFs to the expression IR via bytecode "
+         "analysis (ref RapidsConf.scala:520).") \
+    .create_with_default(False)
+
+# --- optimizer ------------------------------------------------------------
+
+OPTIMIZER_ENABLED = conf("spark.rapids.sql.optimizer.enabled").boolean() \
+    .doc("Enable the cost-based second pass that can move subtrees back to "
+         "CPU (ref CostBasedOptimizer.scala).") \
+    .create_with_default(False)
+
+OPTIMIZER_EXPLAIN = conf("spark.rapids.sql.optimizer.explain").string() \
+    .doc("NONE or ALL: log CBO decisions.") \
+    .check_values(["NONE", "ALL"]).create_with_default("NONE")
+
+# --- metrics / test hooks -------------------------------------------------
+
+METRICS_LEVEL = conf("spark.rapids.sql.metrics.level").string() \
+    .doc("ESSENTIAL, MODERATE, or DEBUG (ref GpuExec.scala:32-45).") \
+    .check_values(["ESSENTIAL", "MODERATE", "DEBUG"]) \
+    .create_with_default("MODERATE")
+
+TEST_ENABLED = conf("spark.rapids.sql.test.enabled").boolean() \
+    .doc("Test mode: fail if an op unexpectedly stays on CPU "
+         "(ref RapidsConf.scala:937).").internal() \
+    .create_with_default(False)
+
+TEST_ALLOWED_NON_TPU = conf("spark.rapids.sql.test.allowedNonGpu").string() \
+    .doc("Comma-separated exec names allowed on CPU in test mode.").internal() \
+    .create_with_default("")
+
+# --- tpu platform ---------------------------------------------------------
+
+TPU_BATCH_CAPACITY_BUCKETS = conf("spark.rapids.tpu.batchCapacityBuckets").string() \
+    .doc("Comma-separated row-capacity buckets batches are padded to so XLA "
+         "compiles once per (schema, bucket) instead of once per row count.") \
+    .create_with_default("1024,8192,65536,262144,1048576,4194304")
+
+TPU_STRING_DATA_BUCKETS = conf("spark.rapids.tpu.stringDataBuckets").string() \
+    .doc("Byte-capacity buckets for the string data buffer.") \
+    .create_with_default("16384,131072,1048576,8388608,67108864,268435456")
+
+
+class RapidsConf:
+    """Snapshot of a config map with typed accessors
+    (ref RapidsConf.scala class)."""
+
+    def __init__(self, conf_map: Optional[Dict[str, Any]] = None):
+        self._map = dict(conf_map or {})
+
+    def get(self, entry: ConfEntry[V]) -> V:
+        return entry.get(self._map)
+
+    def raw(self, key: str, default: Any = None) -> Any:
+        return self._map.get(key, default)
+
+    def set(self, key: str, value: Any) -> "RapidsConf":
+        m = dict(self._map)
+        m[key] = value
+        return RapidsConf(m)
+
+    def is_op_enabled(self, kind: str, name: str, default: bool = True) -> bool:
+        """Auto-derived per-op enable keys, e.g.
+        spark.rapids.sql.exec.TpuSortExec (ref GpuOverrides.scala:145-150)."""
+        raw = self._map.get(f"spark.rapids.sql.{kind}.{name}")
+        return default if raw is None else _to_bool(raw)
+
+    # convenient named properties used widely
+    @property
+    def sql_enabled(self) -> bool:
+        return self.get(SQL_ENABLED)
+
+    @property
+    def batch_size_bytes(self) -> int:
+        return self.get(BATCH_SIZE_BYTES)
+
+    @property
+    def explain(self) -> str:
+        return self.get(EXPLAIN)
+
+    @property
+    def capacity_buckets(self) -> List[int]:
+        return sorted(int(x) for x in
+                      self.get(TPU_BATCH_CAPACITY_BUCKETS).split(","))
+
+    @property
+    def string_data_buckets(self) -> List[int]:
+        return sorted(int(x) for x in
+                      self.get(TPU_STRING_DATA_BUCKETS).split(","))
+
+
+def all_entries() -> List[ConfEntry]:
+    return [e for _, e in sorted(_REGISTERED.items())]
+
+
+def generate_docs() -> str:
+    """Render docs/configs.md from the registry
+    (ref RapidsConf.scala doc printer)."""
+    lines = ["# Configuration", "",
+             "Generated from `spark_rapids_tpu/config.py` — do not edit.", "",
+             "| Name | Default | Description |", "|---|---|---|"]
+    for e in all_entries():
+        if e.is_internal:
+            continue
+        lines.append(f"| `{e.key}` | {e.default} | {e.doc} |")
+    return "\n".join(lines) + "\n"

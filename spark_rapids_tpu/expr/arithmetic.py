@@ -1,0 +1,467 @@
+"""Arithmetic expressions with Spark SQL semantics.
+
+Ref: org/apache/spark/sql/rapids/arithmetic.scala and the rules registered
+in GpuOverrides.scala (Add, Subtract, Multiply, Divide, IntegralDivide,
+Remainder, Pmod, UnaryMinus, Abs, ...).
+
+Semantics notes (match Spark, not numpy defaults):
+  * integral overflow wraps in non-ANSI mode, errors in ANSI mode;
+  * x / 0, x % 0 -> NULL in non-ANSI mode (never inf/nan for integrals);
+  * Divide always produces double (analyzer casts) or decimal;
+  * decimal add/sub rescale to max scale; multiply adds scales.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .. import types as t
+from .core import (ColumnValue, EvalContext, Expression, ScalarValue, Value,
+                   and_validity, data_of, evaluator, make_column, validity_of)
+
+
+# ---------------------------------------------------------------------------
+# numeric type promotion (Spark's findTightestCommonType subset)
+# ---------------------------------------------------------------------------
+
+_INT_ORDER = [t.ByteType, t.ShortType, t.IntegerType, t.LongType]
+
+
+def promote(a: t.DataType, b: t.DataType) -> t.DataType:
+    if a == b:
+        return a
+    if isinstance(a, t.NullType):
+        return b
+    if isinstance(b, t.NullType):
+        return a
+    if isinstance(a, t.DoubleType) or isinstance(b, t.DoubleType):
+        return t.DOUBLE
+    if isinstance(a, t.FloatType) or isinstance(b, t.FloatType):
+        return t.FLOAT
+    if isinstance(a, t.DecimalType) and isinstance(b, t.DecimalType):
+        scale = max(a.scale, b.scale)
+        intd = max(a.precision - a.scale, b.precision - b.scale)
+        return t.DecimalType(min(intd + scale, t.MAX_DECIMAL128_PRECISION), scale)
+    if isinstance(a, t.DecimalType) and t.is_integral(b):
+        return promote(a, _decimal_of_integral(b))
+    if isinstance(b, t.DecimalType) and t.is_integral(a):
+        return promote(_decimal_of_integral(a), b)
+    if t.is_integral(a) and t.is_integral(b):
+        ia = _INT_ORDER.index(type(a))
+        ib = _INT_ORDER.index(type(b))
+        return a if ia >= ib else b
+    raise TypeError(f"cannot promote {a} and {b}")
+
+
+def _decimal_of_integral(dt: t.DataType) -> t.DecimalType:
+    p = {t.ByteType: 3, t.ShortType: 5, t.IntegerType: 10, t.LongType: 20}[type(dt)]
+    return t.DecimalType(min(p, 38), 0)
+
+
+def cast_data(ctx: EvalContext, data, src: t.DataType, dst: t.DataType):
+    """Numeric representation change (no bounds checking — plain widen)."""
+    if src == dst:
+        return data
+    xp = ctx.xp
+    if isinstance(dst, t.DecimalType):
+        if isinstance(src, t.DecimalType):
+            if dst.scale == src.scale:
+                return data
+            if dst.scale > src.scale:
+                return data * np.int64(10 ** (dst.scale - src.scale))
+            return _div_round_half_up(xp, data, np.int64(10 ** (src.scale - dst.scale)))
+        # integral -> decimal
+        return data.astype(np.int64) * np.int64(10 ** dst.scale)
+    if isinstance(src, t.DecimalType):
+        # decimal -> floating
+        return data.astype(t.to_np_dtype(dst)) / (10.0 ** src.scale)
+    if hasattr(data, "astype"):
+        return data.astype(t.to_np_dtype(dst))
+    return np.array(data, dtype=t.to_np_dtype(dst))[()]
+
+
+def _div_round_half_up(xp, num, den):
+    """Integer divide rounding half away from zero (Spark decimal rounding)."""
+    q = num // den
+    r = num - q * den
+    adj = (2 * xp.abs(r) >= den).astype(num.dtype) * xp.where(
+        (num < 0), np.int64(-1), np.int64(1))
+    # careful: python floor div on negatives; implement HALF_UP on magnitude
+    trunc = xp.where(num < 0, -((-num) // den), num // den)
+    r2 = xp.abs(num) - xp.abs(trunc) * den
+    round_up = (2 * r2 >= den)
+    mag = xp.abs(trunc) + round_up.astype(num.dtype)
+    return xp.where(num < 0, -mag, mag)
+
+
+class BinaryArithmetic(Expression):
+    symbol = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    def data_type(self):
+        return promote(self.left.data_type(), self.right.data_type())
+
+    def sql(self):
+        return f"({self.children[0].sql()} {self.symbol} {self.children[1].sql()})"
+
+    def result_decimal_type(self) -> Optional[t.DecimalType]:
+        return None
+
+
+def _binary_inputs(e: BinaryArithmetic, ctx: EvalContext,
+                   out_type: t.DataType) -> Tuple:
+    lv = e.left.eval(ctx)
+    rv = e.right.eval(ctx)
+    ld = cast_data(ctx, data_of(lv, ctx), lv.dtype if isinstance(lv, ColumnValue)
+                   else e.left.data_type(), out_type)
+    rd = cast_data(ctx, data_of(rv, ctx), rv.dtype if isinstance(rv, ColumnValue)
+                   else e.right.data_type(), out_type)
+    validity = and_validity(ctx, validity_of(lv, ctx), validity_of(rv, ctx))
+    return ld, rd, validity
+
+
+def _decimal_binary_type(op: str, lt: t.DecimalType, rt: t.DecimalType) -> t.DecimalType:
+    """Spark DecimalPrecision result types."""
+    p1, s1, p2, s2 = lt.precision, lt.scale, rt.precision, rt.scale
+    if op in ("add", "sub"):
+        scale = max(s1, s2)
+        prec = max(p1 - s1, p2 - s2) + scale + 1
+    elif op == "mul":
+        scale = s1 + s2
+        prec = p1 + p2 + 1
+    elif op == "div":
+        scale = max(6, s1 + p2 + 1)
+        prec = p1 - s1 + s2 + scale
+    elif op in ("mod",):
+        scale = max(s1, s2)
+        prec = min(p1 - s1, p2 - s2) + scale
+    else:
+        raise ValueError(op)
+    return t.DecimalType(min(prec, t.MAX_DECIMAL128_PRECISION), min(scale, 38))
+
+
+def _as_decimal(dt: t.DataType) -> t.DecimalType:
+    return dt if isinstance(dt, t.DecimalType) else _decimal_of_integral(dt)
+
+
+class Add(BinaryArithmetic):
+    symbol = "+"
+
+    def data_type(self):
+        lt, rt = self.left.data_type(), self.right.data_type()
+        if isinstance(lt, t.DecimalType) or isinstance(rt, t.DecimalType):
+            return _decimal_binary_type("add", _as_decimal(lt), _as_decimal(rt))
+        return promote(lt, rt)
+
+
+class Subtract(BinaryArithmetic):
+    symbol = "-"
+
+    def data_type(self):
+        lt, rt = self.left.data_type(), self.right.data_type()
+        if isinstance(lt, t.DecimalType) or isinstance(rt, t.DecimalType):
+            return _decimal_binary_type("sub", _as_decimal(lt), _as_decimal(rt))
+        return promote(lt, rt)
+
+
+class Multiply(BinaryArithmetic):
+    symbol = "*"
+
+    def data_type(self):
+        lt, rt = self.left.data_type(), self.right.data_type()
+        if isinstance(lt, t.DecimalType) or isinstance(rt, t.DecimalType):
+            return _decimal_binary_type("mul", _as_decimal(lt), _as_decimal(rt))
+        return promote(lt, rt)
+
+
+@evaluator(Add)
+def _eval_add(e: Add, ctx: EvalContext):
+    out = e.data_type()
+    if isinstance(out, t.DecimalType):
+        return _decimal_addsub(e, ctx, out, +1)
+    ld, rd, v = _binary_inputs(e, ctx, out)
+    return make_column(ctx, out, ld + rd, v)
+
+
+@evaluator(Subtract)
+def _eval_sub(e: Subtract, ctx: EvalContext):
+    out = e.data_type()
+    if isinstance(out, t.DecimalType):
+        return _decimal_addsub(e, ctx, out, -1)
+    ld, rd, v = _binary_inputs(e, ctx, out)
+    return make_column(ctx, out, ld - rd, v)
+
+
+def _decimal_addsub(e: BinaryArithmetic, ctx: EvalContext,
+                    out: t.DecimalType, sign: int):
+    lv, rv = e.left.eval(ctx), e.right.eval(ctx)
+    lt = _as_decimal(e.left.data_type())
+    rt = _as_decimal(e.right.data_type())
+    scale = out.scale
+    ld = cast_data(ctx, data_of(lv, ctx), lt, t.DecimalType(38, scale))
+    rd = cast_data(ctx, data_of(rv, ctx), rt, t.DecimalType(38, scale))
+    v = and_validity(ctx, validity_of(lv, ctx), validity_of(rv, ctx))
+    data = ld + rd if sign > 0 else ld - rd
+    return make_column(ctx, out, data, v)
+
+
+@evaluator(Multiply)
+def _eval_mul(e: Multiply, ctx: EvalContext):
+    out = e.data_type()
+    if isinstance(out, t.DecimalType):
+        lv, rv = e.left.eval(ctx), e.right.eval(ctx)
+        v = and_validity(ctx, validity_of(lv, ctx), validity_of(rv, ctx))
+        ld = data_of(lv, ctx)
+        rd = data_of(rv, ctx)
+        if not hasattr(ld, "astype"):
+            ld = np.int64(ld)
+        if not hasattr(rd, "astype"):
+            rd = np.int64(rd)
+        return make_column(ctx, out, ld * rd, v)
+    ld, rd, v = _binary_inputs(e, ctx, out)
+    return make_column(ctx, out, ld * rd, v)
+
+
+class Divide(BinaryArithmetic):
+    symbol = "/"
+
+    def data_type(self):
+        lt, rt = self.left.data_type(), self.right.data_type()
+        if isinstance(lt, t.DecimalType) or isinstance(rt, t.DecimalType):
+            return _decimal_binary_type("div", _as_decimal(lt), _as_decimal(rt))
+        return t.DOUBLE
+
+
+@evaluator(Divide)
+def _eval_div(e: Divide, ctx: EvalContext):
+    xp = ctx.xp
+    out = e.data_type()
+    if isinstance(out, t.DecimalType):
+        lv, rv = e.left.eval(ctx), e.right.eval(ctx)
+        lt, rt = _as_decimal(e.left.data_type()), _as_decimal(e.right.data_type())
+        ld, rd = data_of(lv, ctx), data_of(rv, ctx)
+        if not hasattr(ld, "astype"):
+            ld = np.int64(ld)
+        if not hasattr(rd, "astype"):
+            rd = np.int64(rd)
+        # value = l*10^-s1 / (r*10^-s2) scaled to out.scale:
+        #   unscaled = l * 10^(out.scale - s1 + s2) / r   (HALF_UP)
+        shift = out.scale - lt.scale + rt.scale
+        num = ld * np.int64(10 ** max(shift, 0))
+        den = rd * np.int64(10 ** max(-shift, 0))
+        zero = den == 0
+        den_safe = xp.where(zero, xp.ones_like(den), den)
+        sign = xp.where((num < 0) != (den_safe < 0), -1, 1).astype(np.int64)
+        q = _div_round_half_up(xp, xp.abs(num), xp.abs(den_safe)) * sign
+        v = and_validity(ctx, validity_of(lv, ctx), validity_of(rv, ctx),
+                         None if not hasattr(zero, "shape") or zero.shape == ()
+                         else ~zero)
+        if (not hasattr(zero, "shape")) or zero.shape == ():
+            if bool(zero):
+                v = False
+        return make_column(ctx, out, q, v)
+    ld, rd, v = _binary_inputs(e, ctx, t.DOUBLE)
+    rzero = rd == 0
+    rd_safe = xp.where(rzero, xp.ones_like(rd), rd) if hasattr(rd, "shape") and rd.shape else (1.0 if rd == 0 else rd)
+    data = ld / rd_safe
+    if hasattr(rzero, "shape") and rzero.shape:
+        v = and_validity(ctx, v, ~rzero)
+    elif bool(rzero):
+        v = False
+    return make_column(ctx, out, data, v)
+
+
+class IntegralDivide(BinaryArithmetic):
+    symbol = "div"
+
+    def data_type(self):
+        return t.LONG
+
+
+@evaluator(IntegralDivide)
+def _eval_idiv(e: IntegralDivide, ctx: EvalContext):
+    xp = ctx.xp
+    ld, rd, v = _binary_inputs(e, ctx, t.LONG)
+    rzero = rd == 0
+    scalar_zero = not (hasattr(rzero, "shape") and rzero.shape)
+    rd_safe = (1 if scalar_zero and bool(rzero) else rd) if scalar_zero \
+        else xp.where(rzero, xp.ones_like(rd), rd)
+    # Spark truncates toward zero; numpy // floors
+    q = xp.where(xp.asarray((ld < 0) != (rd_safe < 0)),
+                 -(xp.abs(ld) // xp.abs(rd_safe)),
+                 xp.abs(ld) // xp.abs(rd_safe)).astype(np.int64)
+    if scalar_zero:
+        if bool(rzero):
+            v = False
+    else:
+        v = and_validity(ctx, v, ~rzero)
+    return make_column(ctx, t.LONG, q, v)
+
+
+class Remainder(BinaryArithmetic):
+    symbol = "%"
+
+
+@evaluator(Remainder)
+def _eval_rem(e: Remainder, ctx: EvalContext):
+    xp = ctx.xp
+    out = e.data_type()
+    ld, rd, v = _binary_inputs(e, ctx, out)
+    rzero = rd == 0
+    scalar_zero = not (hasattr(rzero, "shape") and rzero.shape)
+    rd_safe = (1 if scalar_zero and bool(rzero) else rd) if scalar_zero \
+        else xp.where(rzero, xp.ones_like(rd), rd)
+    # Spark remainder takes the sign of the dividend (C semantics), numpy mod
+    # takes the divisor's.  fmod has C semantics.
+    if isinstance(out, (t.FloatType, t.DoubleType)):
+        data = xp.fmod(ld, rd_safe)
+    else:
+        data = ld - (xp.where((ld < 0) != (rd_safe < 0),
+                              -(xp.abs(ld) // xp.abs(rd_safe)),
+                              xp.abs(ld) // xp.abs(rd_safe))) * rd_safe
+    if scalar_zero:
+        if bool(rzero):
+            v = False
+    else:
+        v = and_validity(ctx, v, ~rzero)
+    return make_column(ctx, out, data, v)
+
+
+class Pmod(BinaryArithmetic):
+    symbol = "pmod"
+
+
+@evaluator(Pmod)
+def _eval_pmod(e: Pmod, ctx: EvalContext):
+    xp = ctx.xp
+    out = e.data_type()
+    ld, rd, v = _binary_inputs(e, ctx, out)
+    rzero = rd == 0
+    scalar_zero = not (hasattr(rzero, "shape") and rzero.shape)
+    rd_safe = (1 if scalar_zero and bool(rzero) else rd) if scalar_zero \
+        else xp.where(rzero, xp.ones_like(rd), rd)
+    # Spark pmod: r = C-style remainder(a, n); if r < 0 then r + n else r
+    if isinstance(out, (t.FloatType, t.DoubleType)):
+        r = xp.fmod(ld, rd_safe)
+    else:
+        trunc = xp.where(xp.asarray((ld < 0) != (rd_safe < 0)),
+                         -(xp.abs(ld) // xp.abs(rd_safe)),
+                         xp.abs(ld) // xp.abs(rd_safe))
+        r = ld - trunc * rd_safe
+    data = xp.where(r < 0, r + rd_safe, r)
+    if scalar_zero:
+        if bool(rzero):
+            v = False
+    else:
+        v = and_validity(ctx, v, ~rzero)
+    return make_column(ctx, out, data, v)
+
+
+class UnaryMinus(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def data_type(self):
+        return self.children[0].data_type()
+
+    def sql(self):
+        return f"(- {self.children[0].sql()})"
+
+
+@evaluator(UnaryMinus)
+def _eval_neg(e: UnaryMinus, ctx: EvalContext):
+    v = e.children[0].eval(ctx)
+    return make_column(ctx, e.data_type(), -data_of(v, ctx),
+                       validity_of(v, ctx))
+
+
+class UnaryPositive(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def data_type(self):
+        return self.children[0].data_type()
+
+
+@evaluator(UnaryPositive)
+def _eval_pos(e: UnaryPositive, ctx: EvalContext):
+    return e.children[0].eval(ctx)
+
+
+class Abs(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def data_type(self):
+        return self.children[0].data_type()
+
+
+@evaluator(Abs)
+def _eval_abs(e: Abs, ctx: EvalContext):
+    v = e.children[0].eval(ctx)
+    return make_column(ctx, e.data_type(), ctx.xp.abs(data_of(v, ctx)),
+                       validity_of(v, ctx))
+
+
+class Greatest(Expression):
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    def data_type(self):
+        out = self.children[0].data_type()
+        for c in self.children[1:]:
+            out = promote(out, c.data_type())
+        return out
+
+
+class Least(Greatest):
+    pass
+
+
+def _eval_extreme(e, ctx: EvalContext, is_max: bool):
+    # Spark: skips nulls; null only if all null
+    xp = ctx.xp
+    out = e.data_type()
+    best = None
+    best_valid = None
+    for c in e.children:
+        v = c.eval(ctx)
+        src = v.dtype if isinstance(v, ColumnValue) else c.data_type()
+        d = cast_data(ctx, data_of(v, ctx), src, out)
+        val = validity_of(v, ctx)
+        if val is None:
+            val = xp.ones((ctx.capacity,), dtype=bool)
+        elif val is False:
+            val = xp.zeros((ctx.capacity,), dtype=bool)
+        if not hasattr(d, "shape") or d.shape == ():
+            d = xp.full((ctx.capacity,), d, dtype=t.to_np_dtype(out))
+        if best is None:
+            best, best_valid = d, val
+        else:
+            take_new = val & (~best_valid |
+                              ((d > best) if is_max else (d < best)))
+            best = xp.where(take_new, d, best)
+            best_valid = best_valid | val
+    return make_column(ctx, out, best, best_valid)
+
+
+@evaluator(Greatest)
+def _eval_greatest(e, ctx):
+    return _eval_extreme(e, ctx, True)
+
+
+@evaluator(Least)
+def _eval_least(e, ctx):
+    return _eval_extreme(e, ctx, False)

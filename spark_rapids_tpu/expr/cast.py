@@ -1,0 +1,518 @@
+"""Cast expression + the cast capability matrix.
+
+Ref: sql-plugin/.../GpuCast.scala (1.4k LoC) and CastChecks
+(TypeChecks.scala:1255).  `CAST_MATRIX` mirrors the reference's per
+(from,to) support table: pairs not present fall back to CPU via tagging,
+exactly how the reference keeps unsupported casts off the GPU.
+
+Device-side string formatting/parsing is done with fixed-width byte-matrix
+kernels (ops/strings.pack_rows / window_bytes): int64 has at most 20 digits,
+dates are exactly 10 bytes — static shapes, fully vectorized.
+
+Semantics (match Spark, not C/numpy):
+  * float -> integral saturates (Java d.toInt), NaN -> 0;
+  * integral -> narrower integral wraps bits (Java i.toByte);
+  * string -> numeric yields NULL on malformed input (non-ANSI);
+  * date<->timestamp via UTC days/micros.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as t
+from ..ops import strings as sops
+from .arithmetic import cast_data
+from .core import (ColumnValue, EvalContext, Expression, ScalarValue,
+                   and_validity, data_of, evaluator, make_column,
+                   validity_of)
+
+_INT_INFO = {
+    t.ByteType: (np.int8, -128, 127),
+    t.ShortType: (np.int16, -32768, 32767),
+    t.IntegerType: (np.int32, -(2**31), 2**31 - 1),
+    t.LongType: (np.int64, -(2**63), 2**63 - 1),
+}
+
+
+class Cast(Expression):
+    def __init__(self, child: Expression, to: t.DataType, ansi: bool = False):
+        self.children = (child,)
+        self.to = to
+        self.ansi = ansi
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def data_type(self):
+        return self.to
+
+    def sql(self):
+        return f"CAST({self.child.sql()} AS {self.to.name})"
+
+
+# which (from, to) pairs run on TPU; others are tagged off (CPU fallback)
+def cast_supported_on_tpu(src: t.DataType, dst: t.DataType) -> bool:
+    if src == dst:
+        return True
+    flat = (t.BooleanType, t.ByteType, t.ShortType, t.IntegerType, t.LongType,
+            t.FloatType, t.DoubleType, t.DecimalType)
+    if isinstance(src, flat) and isinstance(dst, flat):
+        return True
+    if isinstance(src, t.NullType):
+        return True
+    if isinstance(src, flat) and isinstance(dst, t.StringType):
+        # float/double -> string needs shortest-repr formatting: CPU
+        return not isinstance(src, (t.FloatType, t.DoubleType))
+    if isinstance(src, t.StringType) and isinstance(dst, flat):
+        return not isinstance(dst, t.DecimalType)
+    if isinstance(src, (t.DateType, t.TimestampType)) and \
+            isinstance(dst, (t.DateType, t.TimestampType)):
+        return True
+    if isinstance(src, t.TimestampType) and isinstance(dst, flat):
+        return True
+    if isinstance(src, flat) and isinstance(dst, t.TimestampType):
+        return True
+    if isinstance(src, t.DateType) and isinstance(dst, t.StringType):
+        return True
+    if isinstance(src, t.StringType) and isinstance(dst, t.DateType):
+        return True
+    return False
+
+
+@evaluator(Cast)
+def _eval_cast(e: Cast, ctx: EvalContext):
+    src = e.child.data_type()
+    dst = e.to
+    v = e.child.eval(ctx)
+    if src == dst:
+        return v
+    val = validity_of(v, ctx)
+
+    if isinstance(src, t.NullType):
+        from .core import all_null_column
+        return all_null_column(ctx, dst)
+
+    if isinstance(src, t.StringType):
+        return _cast_from_string(e, ctx, v, dst)
+    if isinstance(dst, t.StringType):
+        return _cast_to_string(e, ctx, v, src)
+
+    xp = ctx.xp
+    d = data_of(v, ctx)
+
+    # ---- temporal ----------------------------------------------------------
+    if isinstance(src, t.DateType) and isinstance(dst, t.TimestampType):
+        return make_column(ctx, dst, d.astype(np.int64) * np.int64(86400000000), val)
+    if isinstance(src, t.TimestampType) and isinstance(dst, t.DateType):
+        days = xp.floor_divide(d, np.int64(86400000000)).astype(np.int32)
+        return make_column(ctx, dst, days, val)
+    if isinstance(src, t.TimestampType):
+        # micros -> seconds for integral/floating (Spark)
+        if t.is_integral(dst):
+            secs = xp.floor_divide(d, np.int64(1000000))
+            return _int_to_int(ctx, secs, t.LONG, dst, val)
+        if t.is_floating(dst):
+            return make_column(ctx, dst,
+                               (d / 1e6).astype(t.to_np_dtype(dst)), val)
+    if isinstance(dst, t.TimestampType):
+        if t.is_integral(src):
+            return make_column(ctx, dst, d.astype(np.int64) * np.int64(1000000), val)
+        if t.is_floating(src):
+            return make_column(ctx, dst, (d * 1e6).astype(np.int64), val)
+        if isinstance(src, t.BooleanType):
+            return make_column(ctx, dst, d.astype(np.int64) * np.int64(1000000), val)
+
+    # ---- boolean -----------------------------------------------------------
+    if isinstance(dst, t.BooleanType):
+        if isinstance(src, t.DecimalType):
+            return make_column(ctx, dst, d != 0, val)
+        return make_column(ctx, dst, d != 0, val)
+    if isinstance(src, t.BooleanType):
+        if isinstance(dst, t.DecimalType):
+            one = np.int64(10 ** dst.scale)
+            return make_column(ctx, dst, d.astype(np.int64) * one, val)
+        return make_column(ctx, dst, d.astype(t.to_np_dtype(dst)), val)
+
+    # ---- decimal -----------------------------------------------------------
+    if isinstance(dst, t.DecimalType):
+        if isinstance(src, t.DecimalType):
+            data = cast_data(ctx, d, src, dst)
+            # overflow of target precision -> null (non-ANSI)
+            limit = np.int64(10 ** min(dst.precision, 18))
+            ok = (data < limit) & (data > -limit)
+            return make_column(ctx, dst, data, and_validity(ctx, val, ok))
+        if t.is_integral(src):
+            data = d.astype(np.int64) * np.int64(10 ** dst.scale)
+            limit = np.int64(10 ** min(dst.precision, 18))
+            ok = (data < limit) & (data > -limit)
+            return make_column(ctx, dst, data, and_validity(ctx, val, ok))
+        if t.is_floating(src):
+            scaled = d * (10.0 ** dst.scale)
+            data = _round_half_up_float(xp, scaled).astype(np.int64)
+            limit = np.int64(10 ** min(dst.precision, 18))
+            ok = (~xp.isnan(d)) & (data < limit) & (data > -limit)
+            return make_column(ctx, dst, xp.where(ok, data, 0),
+                               and_validity(ctx, val, ok))
+    if isinstance(src, t.DecimalType):
+        if t.is_integral(dst):
+            whole = _trunc_div(xp, d, np.int64(10 ** src.scale))
+            return _int_to_int(ctx, whole, t.LONG, dst, val)
+        if t.is_floating(dst):
+            return make_column(ctx, dst,
+                               (d / (10.0 ** src.scale)).astype(
+                                   t.to_np_dtype(dst)), val)
+
+    # ---- numeric -----------------------------------------------------------
+    if t.is_floating(src) and t.is_integral(dst):
+        npdt, lo, hi = _INT_INFO[type(dst)]
+        nan = xp.isnan(d)
+        clipped = xp.clip(xp.where(nan, 0.0, d), float(lo), float(hi))
+        return make_column(ctx, dst, clipped.astype(npdt), val)
+    if t.is_integral(src) and t.is_integral(dst):
+        return _int_to_int(ctx, d, src, dst, val)
+    return make_column(ctx, dst, d.astype(t.to_np_dtype(dst)), val)
+
+
+def _trunc_div(xp, a, b):
+    return xp.where(a < 0, -((-a) // b), a // b)
+
+
+def _round_half_up_float(xp, d):
+    return xp.where(d >= 0, xp.floor(d + 0.5), xp.ceil(d - 0.5))
+
+
+def _int_to_int(ctx, d, src, dst, val):
+    npdt, _, _ = _INT_INFO[type(dst)]
+    return make_column(ctx, dst, d.astype(npdt), val)  # Java-style bit wrap
+
+
+# ---------------------------------------------------------------------------
+# to-string kernels
+# ---------------------------------------------------------------------------
+
+_ZERO = np.uint8(ord("0"))
+
+
+def _int_digits(xp, d):
+    """(bytes[cap,20] left-aligned, lens) decimal text of int64 values."""
+    cap = d.shape[0]
+    neg = d < 0
+    # magnitude as uint64 handles int64 min
+    mag = xp.where(neg, (-(d.astype(xp.int64))).astype(xp.uint64),
+                   d.astype(xp.uint64))
+    k = xp.arange(20, dtype=xp.uint64)
+    pow10 = xp.asarray(np.power(np.uint64(10), (19 - np.arange(20)).astype(np.uint64)))
+    digits = ((mag[:, None] // pow10[None, :]) % xp.uint64(10)).astype(xp.uint8)
+    nonzero = digits != 0
+    any_nz = nonzero.any(axis=1)
+    first_nz = xp.argmax(nonzero, axis=1).astype(xp.int32)
+    first_nz = xp.where(any_nz, first_nz, 19)  # "0" for value 0
+    ndig = 20 - first_nz
+    lens = ndig + neg.astype(xp.int32)
+    # left-align: out[r, j] = '-'? at j=0 if neg; digit at j - neg + first_nz
+    j = xp.arange(21, dtype=xp.int32)
+    srcj = j[None, :] - neg.astype(xp.int32)[:, None] + first_nz[:, None]
+    srcj_c = xp.clip(srcj, 0, 19)
+    dig_bytes = digits[xp.arange(cap, dtype=xp.int32)[:, None], srcj_c] + _ZERO
+    out = xp.where((j[None, :] == 0) & neg[:, None], xp.uint8(ord("-")),
+                   dig_bytes)
+    return out, lens
+
+
+def _cast_to_string(e: Cast, ctx: EvalContext, v, src):
+    from ..columnar.device import DeviceColumn
+    xp = ctx.xp
+    d = data_of(v, ctx)
+    val = validity_of(v, ctx)
+    cap = ctx.capacity
+    if val is None:
+        val = xp.ones((cap,), dtype=bool)
+    elif val is False:
+        val = xp.zeros((cap,), dtype=bool)
+
+    if isinstance(src, t.BooleanType):
+        # "true" / "false"
+        mat = xp.zeros((cap, 5), dtype=xp.uint8)
+        tb = xp.asarray(np.frombuffer(b"true\0", dtype=np.uint8))
+        fb = xp.asarray(np.frombuffer(b"false", dtype=np.uint8))
+        mat = xp.where(d.astype(bool)[:, None], tb[None, :], fb[None, :])
+        lens = xp.where(d.astype(bool), 4, 5).astype(xp.int32)
+        char_cap = _str_char_cap(cap, 5)
+        offs, chars = sops.pack_rows(xp, mat, lens, val, char_cap)
+        return ColumnValue(DeviceColumn(t.STRING, data=chars, offsets=offs,
+                                        validity=val))
+
+    if isinstance(src, t.DateType):
+        y, m, day = _civil_from_days(xp, d.astype(xp.int64))
+        mat = xp.stack([
+            (y // 1000) % 10, (y // 100) % 10, (y // 10) % 10, y % 10,
+            xp.full((cap,), -3, xp.int64),
+            (m // 10) % 10, m % 10,
+            xp.full((cap,), -3, xp.int64),
+            (day // 10) % 10, day % 10], axis=1)
+        mat = (mat + np.int64(ord("0"))).astype(xp.uint8)  # -3+48 = 45 '-'
+        lens = xp.full((cap,), 10, dtype=xp.int32)
+        char_cap = _str_char_cap(cap, 10)
+        offs, chars = sops.pack_rows(xp, mat, lens, val, char_cap)
+        return ColumnValue(DeviceColumn(t.STRING, data=chars, offsets=offs,
+                                        validity=val))
+
+    if isinstance(src, t.DecimalType):
+        unscaled = d
+        s = src.scale
+        mat, lens = _int_digits(xp, unscaled)
+        if s == 0:
+            char_cap = _str_char_cap(cap, 21)
+            offs, chars = sops.pack_rows(xp, mat, lens, val, char_cap)
+            return ColumnValue(DeviceColumn(t.STRING, data=chars,
+                                            offsets=offs, validity=val))
+        # insert '.' s digits from the right; ensure leading 0 before point
+        return _decimal_to_string(ctx, unscaled, s, val)
+
+    if t.is_integral(src) or isinstance(src, t.TimestampType):
+        mat, lens = _int_digits(xp, d.astype(xp.int64))
+        char_cap = _str_char_cap(cap, 21)
+        offs, chars = sops.pack_rows(xp, mat, lens, val, char_cap)
+        return ColumnValue(DeviceColumn(t.STRING, data=chars, offsets=offs,
+                                        validity=val))
+    raise NotImplementedError(f"cast {src} -> string on TPU")
+
+
+def _decimal_to_string(ctx, unscaled, scale, val):
+    from ..columnar.device import DeviceColumn
+    xp = ctx.xp
+    cap = ctx.capacity
+    neg = unscaled < 0
+    mag = xp.abs(unscaled).astype(xp.uint64)
+    ipart = (mag // xp.uint64(10 ** scale)).astype(xp.int64)
+    fpart = (mag % xp.uint64(10 ** scale)).astype(xp.int64)
+    imat, ilens = _int_digits(xp, ipart)
+    # width = sign + ilen + 1 + scale
+    W = 21 + 1 + scale
+    j = xp.arange(W, dtype=xp.int32)
+    signw = neg.astype(xp.int32)
+    total = signw + ilens + 1 + scale
+    out = xp.zeros((cap, W), dtype=xp.uint8)
+    is_sign = (j[None, :] == 0) & neg[:, None]
+    in_int = (j[None, :] >= signw[:, None]) & \
+        (j[None, :] < (signw + ilens)[:, None])
+    int_src = xp.clip(j[None, :] - signw[:, None], 0, 20)
+    is_dot = j[None, :] == (signw + ilens)[:, None]
+    in_frac = (j[None, :] > (signw + ilens)[:, None]) & \
+        (j[None, :] < total[:, None])
+    fk = xp.clip(j[None, :] - (signw + ilens)[:, None] - 1, 0, max(scale - 1, 0))
+    fpow = xp.asarray((10 ** (scale - 1 - np.arange(max(scale, 1))))
+                      .astype(np.int64))
+    fdig = ((fpart[:, None] // fpow[None, :]) % 10).astype(xp.uint8) + _ZERO
+    rowidx = xp.arange(cap, dtype=xp.int32)[:, None]
+    out = xp.where(is_sign, xp.uint8(ord("-")), out)
+    out = xp.where(in_int, imat[rowidx, int_src], out)
+    out = xp.where(is_dot, xp.uint8(ord(".")), out)
+    out = xp.where(in_frac, fdig[rowidx, fk], out)
+    char_cap = _str_char_cap(cap, W)
+    offs, chars = sops.pack_rows(xp, out, total, val, char_cap)
+    return ColumnValue(DeviceColumn(t.STRING, data=chars, offsets=offs,
+                                    validity=val))
+
+
+def _str_char_cap(cap, width):
+    from ..columnar.device import DEFAULT_CHAR_BUCKETS, bucket_for
+    return bucket_for(cap * width, DEFAULT_CHAR_BUCKETS)
+
+
+# ---------------------------------------------------------------------------
+# from-string kernels
+# ---------------------------------------------------------------------------
+
+def _cast_from_string(e: Cast, ctx: EvalContext, v, dst):
+    xp = ctx.xp
+    col = v.col if isinstance(v, ColumnValue) else None
+    if col is None:
+        raise NotImplementedError("scalar string cast")
+    val = validity_of(v, ctx)
+    W = 24
+    b, lens = sops.window_bytes(xp, col.offsets, col.data, W)
+    # trim ASCII whitespace on both ends (Spark trims before parsing)
+    is_ws = (b == 32) | ((b >= 9) & (b <= 13))
+    pos = xp.arange(W, dtype=xp.int32)
+    inlen = pos[None, :] < lens[:, None]
+    nonws = (~is_ws) & inlen
+    any_c = nonws.any(axis=1)
+    start = xp.argmax(nonws, axis=1).astype(xp.int32)
+    end = (W - xp.argmax(nonws[:, ::-1], axis=1)).astype(xp.int32)
+    start = xp.where(any_c, start, 0)
+    end = xp.where(any_c, end, 0)
+    tl = end - start
+    rowidx = xp.arange(b.shape[0], dtype=xp.int32)[:, None]
+    tb = b[rowidx, xp.clip(start[:, None] + pos[None, :], 0, W - 1)]
+    tb = xp.where(pos[None, :] < tl[:, None], tb, xp.zeros((), xp.uint8))
+
+    if isinstance(dst, t.BooleanType):
+        return _parse_bool(ctx, tb, tl, val)
+    if isinstance(dst, t.DateType):
+        return _parse_date(ctx, tb, tl, val)
+    if t.is_integral(dst) or isinstance(dst, t.TimestampType):
+        longs, ok = _parse_long(xp, tb, tl)
+        okv = and_validity(ctx, val, ok)
+        if isinstance(dst, t.TimestampType):
+            return make_column(ctx, dst, longs * np.int64(1000000), okv)
+        return _int_to_int(ctx, longs, t.LONG, dst, okv)
+    if t.is_floating(dst):
+        d, ok = _parse_float(xp, tb, tl)
+        return make_column(ctx, dst, d.astype(t.to_np_dtype(dst)),
+                           and_validity(ctx, val, ok))
+    raise NotImplementedError(f"cast string -> {dst} on TPU")
+
+
+def _parse_bool(ctx, tb, tl, val):
+    xp = ctx.xp
+
+    def is_word(word: bytes):
+        wb = np.frombuffer(word.ljust(tb.shape[1], b"\0"), dtype=np.uint8)
+        lower = xp.where((tb >= 65) & (tb <= 90), tb + 32, tb)
+        return (tl == len(word)) & (lower == xp.asarray(wb)).all(axis=1) | \
+            ((tl == len(word)) &
+             (xp.where(xp.arange(tb.shape[1]) < tl[:, None], lower, 0)
+              == xp.asarray(wb)).all(axis=1))
+
+    lower = xp.where((tb >= 65) & (tb <= 90), tb + 32, tb)
+
+    def word_eq(word: bytes):
+        wb = np.frombuffer(word.ljust(tb.shape[1], b"\0"), dtype=np.uint8)
+        return (tl == len(word)) & (lower == xp.asarray(wb)).all(axis=1)
+
+    true_m = word_eq(b"true") | word_eq(b"t") | word_eq(b"yes") | \
+        word_eq(b"y") | word_eq(b"1")
+    false_m = word_eq(b"false") | word_eq(b"f") | word_eq(b"no") | \
+        word_eq(b"n") | word_eq(b"0")
+    ok = true_m | false_m
+    return make_column(ctx, t.BOOLEAN, true_m, and_validity(ctx, val, ok))
+
+
+def _parse_long(xp, tb, tl):
+    W = tb.shape[1]
+    pos = xp.arange(W, dtype=xp.int32)
+    neg = tb[:, 0] == ord("-")
+    plus = tb[:, 0] == ord("+")
+    shift = (neg | plus).astype(xp.int32)
+    ndig = tl - shift
+    digpos = pos[None, :] + shift[:, None]
+    rowidx = xp.arange(tb.shape[0], dtype=xp.int32)[:, None]
+    db = tb[rowidx, xp.clip(digpos, 0, W - 1)]
+    in_d = pos[None, :] < ndig[:, None]
+    is_digit = (db >= ord("0")) & (db <= ord("9"))
+    ok = (ndig >= 1) & (ndig <= 19) & (is_digit | ~in_d).all(axis=1)
+    dvals = xp.where(in_d, (db - ord("0")).astype(xp.int64),
+                     xp.zeros((), xp.int64))
+    # value = sum d_j * 10^(ndig-1-j)
+    p10 = xp.asarray(np.concatenate([
+        np.power(np.int64(10), np.arange(18, -1, -1)), np.zeros(max(W - 19, 0),
+                                                                np.int64)]))
+    expo = xp.clip(ndig[:, None] - 1 - pos[None, :], 0, 18)
+    mult = xp.asarray(np.power(np.int64(10), np.arange(19)))[expo]
+    value = xp.sum(xp.where(in_d, dvals * mult, 0), axis=1)
+    value = xp.where(neg, -value, value)
+    return value, ok
+
+
+def _parse_float(xp, tb, tl):
+    """Parse [sign] digits [. digits] [e sign digits] — no inf/nan words."""
+    W = tb.shape[1]
+    pos = xp.arange(W, dtype=xp.int32)
+    rowidx = xp.arange(tb.shape[0], dtype=xp.int32)[:, None]
+    neg = tb[:, 0] == ord("-")
+    plus = tb[:, 0] == ord("+")
+    shift = (neg | plus).astype(xp.int32)
+    in_s = pos[None, :] < tl[:, None]
+    is_digit = (tb >= ord("0")) & (tb <= ord("9"))
+    is_dot = tb == ord(".")
+    is_e = (tb == ord("e")) | (tb == ord("E"))
+    # locate dot and e
+    dot_any = (is_dot & in_s).any(axis=1)
+    dot_pos = xp.where(dot_any, xp.argmax(is_dot & in_s, axis=1),
+                       tl).astype(xp.int32)
+    e_any = (is_e & in_s).any(axis=1)
+    e_pos = xp.where(e_any, xp.argmax(is_e & in_s, axis=1), tl).astype(xp.int32)
+    mant_end = xp.minimum(e_pos, tl)
+    # integer part digits: [shift, min(dot,mant_end)); frac: (dot, mant_end)
+    int_end = xp.minimum(dot_pos, mant_end)
+    ip = pos[None, :]
+    in_int = (ip >= shift[:, None]) & (ip < int_end[:, None])
+    in_frac = (ip > dot_pos[:, None]) & (ip < mant_end[:, None])
+    dval = xp.where(is_digit, (tb - ord("0")).astype(xp.float64), 0.0)
+    int_w = xp.where(in_int, dval, 0.0)
+    # value of int part: digits weighted by 10^(int_end-1-j)
+    ie = xp.clip(int_end[:, None] - 1 - ip, -1, W)
+    int_val = xp.sum(xp.where(in_int, int_w * xp.power(10.0, ie.astype(xp.float64)), 0.0), axis=1)
+    fe = xp.clip(ip - dot_pos[:, None], 1, W).astype(xp.float64)
+    frac_val = xp.sum(xp.where(in_frac, dval * xp.power(10.0, -fe), 0.0), axis=1)
+    mant = int_val + frac_val
+    # exponent
+    e_start = e_pos + 1
+    eneg = tb[rowidx[:, 0], xp.clip(e_start, 0, W - 1)] == ord("-")
+    epl = tb[rowidx[:, 0], xp.clip(e_start, 0, W - 1)] == ord("+")
+    es = e_start + (eneg | epl).astype(xp.int32)
+    in_exp = (ip >= es[:, None]) & (ip < tl[:, None])
+    ee = xp.clip(tl[:, None] - 1 - ip, 0, 8)
+    exp_val = xp.sum(xp.where(in_exp, dval * xp.power(10.0, ee.astype(xp.float64)), 0.0),
+                     axis=1).astype(xp.float64)
+    exp_val = xp.where(e_any, xp.where(eneg, -exp_val, exp_val), 0.0)
+    value = xp.where(neg, -mant, mant) * xp.power(10.0, exp_val)
+    # validity: digits present; all chars are legal; single dot/e
+    legal = is_digit | is_dot | is_e | (tb == ord("-")) | (tb == ord("+"))
+    has_digit = (is_digit & in_s).any(axis=1)
+    ok = has_digit & (xp.where(in_s, legal, True)).all(axis=1) & \
+        (xp.sum((is_dot & in_s), axis=1) <= 1) & \
+        (xp.sum((is_e & in_s), axis=1) <= 1) & (tl >= 1)
+    ok = ok & (~e_any | (is_digit[rowidx[:, 0], xp.clip(tl - 1, 0, W - 1)]))
+    return value, ok
+
+
+def _parse_date(ctx, tb, tl, val):
+    """yyyy-MM-dd (also accepts yyyy-M-d like Spark's loose parser subset)."""
+    xp = ctx.xp
+    W = tb.shape[1]
+    is_digit = (tb >= ord("0")) & (tb <= ord("9"))
+    dash = tb == ord("-")
+    # strict: positions 0-3 digits, 4 dash, 5-6 digits, 7 dash, 8-9 digits
+    strict = (tl == 10) & is_digit[:, 0] & is_digit[:, 1] & is_digit[:, 2] & \
+        is_digit[:, 3] & dash[:, 4] & is_digit[:, 5] & is_digit[:, 6] & \
+        dash[:, 7] & is_digit[:, 8] & is_digit[:, 9]
+    dv = (tb - ord("0")).astype(xp.int64)
+    y = dv[:, 0] * 1000 + dv[:, 1] * 100 + dv[:, 2] * 10 + dv[:, 3]
+    m = dv[:, 5] * 10 + dv[:, 6]
+    d = dv[:, 8] * 10 + dv[:, 9]
+    ok = strict & (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31)
+    days = _days_from_civil(xp, y, m, d)
+    return make_column(ctx, t.DATE, days.astype(np.int32),
+                       and_validity(ctx, val, ok))
+
+
+# ---------------------------------------------------------------------------
+# civil-calendar math (Howard Hinnant's algorithms; pure int vector math)
+# ---------------------------------------------------------------------------
+
+def _days_from_civil(xp, y, m, d):
+    y = y - (m <= 2)
+    era = xp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = (m + 9) % 12
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _civil_from_days(xp, z):
+    z = z + 719468
+    era = xp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + xp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d

@@ -1,0 +1,261 @@
+"""Conditional expressions: If, CaseWhen, Coalesce, NullIf, Nvl.
+
+Ref: sql-plugin/.../conditionalExpressions.scala, nullExpressions.scala.
+On TPU every branch evaluates eagerly and blends with `where` — branches are
+cheap vector ops and XLA fuses the blend; this matches how cuDF evaluates
+both sides too (no short-circuit on columnar data).
+"""
+
+from __future__ import annotations
+
+from .. import types as t
+from .arithmetic import cast_data, promote
+from .core import (ColumnValue, EvalContext, Expression, ScalarValue,
+                   and_validity, data_of, evaluator, make_column,
+                   validity_of)
+from .predicates import _bool_parts
+
+
+def _common_type(exprs):
+    out = None
+    for e in exprs:
+        dt = e.data_type()
+        if isinstance(dt, t.NullType):
+            continue
+        out = dt if out is None else promote(out, dt)
+    return out if out is not None else t.NULL
+
+
+def _value_parts(ctx: EvalContext, v, src: t.DataType, out: t.DataType):
+    """(data[cap], validity[cap]) of a value cast to `out`."""
+    xp = ctx.xp
+    if isinstance(out, (t.StringType, t.BinaryType)):
+        raise NotImplementedError("string conditional handled separately")
+    d = data_of(v, ctx)
+    if not isinstance(src, t.NullType):
+        d = cast_data(ctx, d, src, out)
+    else:
+        d = xp.zeros((ctx.capacity,), dtype=t.to_np_dtype(out))
+    if not hasattr(d, "shape") or getattr(d, "shape", ()) == ():
+        d = xp.full((ctx.capacity,), d, dtype=t.to_np_dtype(out))
+    val = validity_of(v, ctx)
+    if val is None:
+        val = xp.ones((ctx.capacity,), dtype=bool)
+    elif val is False:
+        val = xp.zeros((ctx.capacity,), dtype=bool)
+    return d, val
+
+
+class If(Expression):
+    def __init__(self, pred, if_true, if_false):
+        self.children = (pred, if_true, if_false)
+
+    def data_type(self):
+        return _common_type(self.children[1:])
+
+    def sql(self):
+        p, a, b = self.children
+        return f"if({p.sql()}, {a.sql()}, {b.sql()})"
+
+
+@evaluator(If)
+def _eval_if(e: If, ctx: EvalContext):
+    xp = ctx.xp
+    out = e.data_type()
+    pd, pv = _bool_parts(ctx, e.children[0].eval(ctx))
+    cond = pd & pv  # null predicate -> false branch (Spark)
+    if isinstance(out, (t.StringType, t.BinaryType)):
+        return _string_select(ctx, [cond], [e.children[1]], e.children[2], out)
+    ad, av = _value_parts(ctx, e.children[1].eval(ctx),
+                          e.children[1].data_type(), out)
+    bd, bv = _value_parts(ctx, e.children[2].eval(ctx),
+                          e.children[2].data_type(), out)
+    return make_column(ctx, out, xp.where(cond, ad, bd),
+                       xp.where(cond, av, bv))
+
+
+class CaseWhen(Expression):
+    """CASE WHEN c1 THEN v1 ... ELSE d END.
+    children = [c1, v1, c2, v2, ..., (else)]"""
+
+    def __init__(self, branches, else_value=None):
+        from .core import Literal
+        kids = []
+        for c, v in branches:
+            kids += [c, v]
+        if else_value is None:
+            else_value = Literal(None, t.NULL)
+        kids.append(else_value)
+        self.children = tuple(kids)
+        self.n_branches = len(branches)
+
+    def branches(self):
+        return [(self.children[2 * i], self.children[2 * i + 1])
+                for i in range(self.n_branches)]
+
+    def else_value(self):
+        return self.children[-1]
+
+    def data_type(self):
+        vals = [v for _, v in self.branches()] + [self.else_value()]
+        return _common_type(vals)
+
+
+@evaluator(CaseWhen)
+def _eval_case(e: CaseWhen, ctx: EvalContext):
+    xp = ctx.xp
+    out = e.data_type()
+    conds = []
+    taken = xp.zeros((ctx.capacity,), dtype=bool)
+    for c, _ in e.branches():
+        pd, pv = _bool_parts(ctx, c.eval(ctx))
+        fire = pd & pv & ~taken
+        conds.append(fire)
+        taken = taken | fire
+    if isinstance(out, (t.StringType, t.BinaryType)):
+        return _string_select(ctx, conds, [v for _, v in e.branches()],
+                              e.else_value(), out)
+    dd, dv = _value_parts(ctx, e.else_value().eval(ctx),
+                          e.else_value().data_type(), out)
+    data, validity = dd, dv
+    for fire, (_, v) in zip(conds, e.branches()):
+        vd, vv = _value_parts(ctx, v.eval(ctx), v.data_type(), out)
+        data = xp.where(fire, vd, data)
+        validity = xp.where(fire, vv, validity)
+    return make_column(ctx, out, data, validity)
+
+
+class Coalesce(Expression):
+    def __init__(self, *children):
+        self.children = tuple(children)
+
+    def data_type(self):
+        return _common_type(self.children)
+
+
+@evaluator(Coalesce)
+def _eval_coalesce(e: Coalesce, ctx: EvalContext):
+    xp = ctx.xp
+    out = e.data_type()
+    if isinstance(out, (t.StringType, t.BinaryType)):
+        # select first non-null: express as cascade of If on IsNotNull
+        from .predicates import IsNotNull
+        expr = e.children[-1]
+        for c in reversed(e.children[:-1]):
+            expr = If(IsNotNull(c), c, expr)
+        return expr.eval(ctx)
+    data = xp.zeros((ctx.capacity,), dtype=t.to_np_dtype(out))
+    validity = xp.zeros((ctx.capacity,), dtype=bool)
+    for c in e.children:
+        vd, vv = _value_parts(ctx, c.eval(ctx), c.data_type(), out)
+        take = ~validity & vv
+        data = xp.where(take, vd, data)
+        validity = validity | vv
+    return make_column(ctx, out, data, validity)
+
+
+class NullIf(Expression):
+    def __init__(self, left, right):
+        self.children = (left, right)
+
+    def data_type(self):
+        return self.children[0].data_type()
+
+
+@evaluator(NullIf)
+def _eval_nullif(e: NullIf, ctx: EvalContext):
+    from .predicates import EqualTo
+    eq = EqualTo(e.children[0], e.children[1])
+    pd, pv = _bool_parts(ctx, eq.eval(ctx))
+    v = e.children[0].eval(ctx)
+    out = e.data_type()
+    if isinstance(out, (t.StringType, t.BinaryType)):
+        col = _as_string_column(ctx, v, out)
+        validity = col.col.validity & ~(pd & pv)
+        from ..columnar.device import DeviceColumn
+        return ColumnValue(DeviceColumn(out, data=col.col.data,
+                                        offsets=col.col.offsets,
+                                        validity=validity))
+    d, val = _value_parts(ctx, v, out, out)
+    return make_column(ctx, out, d, val & ~(pd & pv))
+
+
+class Nvl(Coalesce):
+    def __init__(self, left, right):
+        super().__init__(left, right)
+
+
+# ---------------------------------------------------------------------------
+# string select support
+# ---------------------------------------------------------------------------
+
+def _as_string_column(ctx: EvalContext, v, dtype) -> ColumnValue:
+    from ..columnar.device import DeviceColumn
+    xp = ctx.xp
+    if isinstance(v, ColumnValue):
+        return v
+    s = v.value if isinstance(v.value, bytes) else (
+        v.value.encode() if isinstance(v.value, str) else None)
+    cap = ctx.capacity
+    if s is None:
+        return ColumnValue(DeviceColumn(
+            dtype, data=xp.zeros((1,), dtype=xp.uint8),
+            offsets=xp.zeros((cap + 1,), dtype=xp.int32),
+            validity=xp.zeros((cap,), dtype=bool)))
+    import numpy as np
+    sarr = np.frombuffer(s, dtype=np.uint8)
+    ln = len(s)
+    offsets = xp.arange(cap + 1, dtype=xp.int32) * xp.int32(ln)
+    chars = xp.asarray(np.tile(sarr, cap)) if ln else xp.zeros((1,), xp.uint8)
+    return ColumnValue(DeviceColumn(dtype, data=chars, offsets=offsets,
+                                    validity=xp.ones((cap,), dtype=bool)))
+
+
+def _string_select(ctx: EvalContext, conds, values, else_value, out):
+    """Blend string columns: pick per-row source then gather spans."""
+    from ..columnar.device import DeviceColumn, bucket_for
+    from ..ops.strings import gather_strings
+    xp = ctx.xp
+    cols = [_as_string_column(ctx, v.eval(ctx), out) for v in values]
+    ecol = _as_string_column(ctx, else_value.eval(ctx), out)
+    cap = ctx.capacity
+    # choose source index per row: 0..n-1 branches, n = else
+    n = len(cols)
+    src = xp.full((cap,), n, dtype=xp.int32)
+    for i in reversed(range(n)):
+        src = xp.where(conds[i], xp.int32(i), src)
+    all_cols = cols + [ecol]
+    # concatenate char buffers, then per-row gather the right span
+    offs_list = [c.col.offsets for c in all_cols]
+    chars_list = [c.col.data for c in all_cols]
+    char_caps = [c.col.data.shape[0] for c in all_cols]
+    total_cap = int(sum(char_caps))
+    from ..ops.strings import concat_char_buffers
+    base = 0
+    # build per-row source offsets into the concatenated buffer
+    big_chars = xp.concatenate(chars_list)
+    row = xp.arange(cap, dtype=xp.int32)
+    starts = xp.zeros((cap,), dtype=xp.int32)
+    lens = xp.zeros((cap,), dtype=xp.int32)
+    validity = xp.zeros((cap,), dtype=bool)
+    for i, c in enumerate(all_cols):
+        sel = src == i
+        o = c.col.offsets
+        starts = xp.where(sel, o[:-1] + xp.int32(base), starts)
+        lens = xp.where(sel, o[1:] - o[:-1], lens)
+        validity = xp.where(sel, c.col.validity, validity)
+        base += int(c.col.data.shape[0])
+    # gather: emulate gather_strings with explicit starts/lens
+    out_char_cap = max(int(c.col.data.shape[0]) for c in all_cols)
+    new_offs = xp.concatenate([
+        xp.zeros((1,), xp.int32),
+        xp.cumsum(xp.where(validity, lens, 0), dtype=xp.int32)])
+    p = xp.arange(out_char_cap, dtype=xp.int32)
+    prow = xp.clip(xp.searchsorted(new_offs[1:], p, side="right"),
+                   0, cap - 1).astype(xp.int32)
+    src_pos = xp.clip(starts[prow] + (p - new_offs[prow]), 0,
+                      big_chars.shape[0] - 1)
+    new_chars = xp.where(p < new_offs[-1], big_chars[src_pos],
+                         xp.zeros((), dtype=xp.uint8))
+    return ColumnValue(DeviceColumn(out, data=new_chars, offsets=new_offs,
+                                    validity=validity))

@@ -1,0 +1,368 @@
+"""Expression IR core.
+
+TPU-native re-design of the reference's expression layer (ref:
+GpuExpression in sql-plugin/.../GpuExpressions.scala and the ~180
+expression rules registered at GpuOverrides.scala:727-3048).
+
+Design: one evaluator, two backends.  Every expression evaluates over an
+`EvalContext` whose array module `xp` is either `numpy` (the CPU fallback
+engine) or `jax.numpy` (the TPU path).  On TPU the whole operator's
+expression tree traces into a single XLA computation, so elementwise ops
+fuse — the structural advantage over the reference's one-JNI-kernel-per-
+expression model (its AST fusion, GpuOverrides ENABLE_PROJECT_AST, is the
+special case; here fusion is the default).
+
+Null semantics follow Spark: values under a null are undefined (canonically
+zero); each op combines child validity.  ANSI mode raises on overflow /
+invalid input where Spark would.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+from .. import types as t
+from ..columnar.device import DeviceColumn
+
+
+class EvalError(Exception):
+    """Runtime expression failure (ANSI errors, unsupported eval)."""
+
+
+# ---------------------------------------------------------------------------
+# Values
+# ---------------------------------------------------------------------------
+
+class ColumnValue:
+    """A columnar evaluation result: wraps a DeviceColumn whose buffers are
+    xp arrays (numpy on CPU, jax on TPU)."""
+
+    __slots__ = ("col",)
+
+    def __init__(self, col: DeviceColumn):
+        self.col = col
+
+    @property
+    def dtype(self) -> t.DataType:
+        return self.col.dtype
+
+
+class ScalarValue:
+    """A literal/scalar evaluation result."""
+
+    __slots__ = ("value", "dtype")
+
+    def __init__(self, value: Any, dtype: t.DataType):
+        self.value = value  # python scalar / bytes / None
+        self.dtype = dtype
+
+    @property
+    def is_null(self) -> bool:
+        return self.value is None
+
+
+Value = Any  # ColumnValue | ScalarValue
+
+
+class EvalContext:
+    """Evaluation context: the input batch + array backend.
+
+    `xp` is numpy or jax.numpy; all evaluator code must go through it so the
+    same semantics run on both engines.
+    """
+
+    __slots__ = ("xp", "batch", "ansi", "capacity")
+
+    def __init__(self, xp, batch, ansi: bool = False):
+        self.xp = xp
+        self.batch = batch  # DeviceBatch (buffers in xp-land)
+        self.ansi = ansi
+        self.capacity = batch.capacity if batch is not None else 0
+
+    def row_mask(self):
+        return self.xp.arange(self.capacity, dtype=np.int32) < self.batch.num_rows
+
+
+# ---------------------------------------------------------------------------
+# Expression tree
+# ---------------------------------------------------------------------------
+
+class Expression:
+    """Base expression node."""
+
+    children: Tuple["Expression", ...] = ()
+
+    def data_type(self) -> t.DataType:
+        raise NotImplementedError(type(self).__name__)
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    @property
+    def pretty_name(self) -> str:
+        return type(self).__name__.lower()
+
+    def with_children(self, children: Sequence["Expression"]) -> "Expression":
+        import copy
+        c = copy.copy(self)
+        c.children = tuple(children)
+        return c
+
+    def transform_up(self, fn: Callable[["Expression"], "Expression"]) -> "Expression":
+        new_children = [c.transform_up(fn) for c in self.children]
+        node = self if all(a is b for a, b in zip(new_children, self.children)) \
+            and len(new_children) == len(self.children) \
+            else self.with_children(new_children)
+        return fn(node)
+
+    def collect(self, pred: Callable[["Expression"], bool]) -> List["Expression"]:
+        out = [self] if pred(self) else []
+        for c in self.children:
+            out.extend(c.collect(pred))
+        return out
+
+    def sql(self) -> str:
+        args = ", ".join(c.sql() for c in self.children)
+        return f"{self.pretty_name}({args})"
+
+    def __repr__(self):
+        return self.sql()
+
+    # evaluation ------------------------------------------------------------
+    def eval(self, ctx: EvalContext) -> Value:
+        fn = _EVALUATORS.get(type(self))
+        if fn is None:
+            raise EvalError(f"no evaluator for {type(self).__name__}")
+        return fn(self, ctx)
+
+
+_EVALUATORS: Dict[Type[Expression], Callable[[Expression, EvalContext], Value]] = {}
+
+
+def evaluator(cls: Type[Expression]):
+    """Register an evaluation function for an expression class."""
+    def deco(fn):
+        _EVALUATORS[cls] = fn
+        return fn
+    return deco
+
+
+class LeafExpression(Expression):
+    children = ()
+
+
+class Literal(LeafExpression):
+    def __init__(self, value: Any, dtype: Optional[t.DataType] = None):
+        if dtype is None:
+            dtype = infer_literal_type(value)
+        if isinstance(value, str):
+            value = value.encode("utf-8") if not isinstance(value, bytes) else value
+        self.value = value
+        self.dtype = dtype
+
+    def data_type(self):
+        return self.dtype
+
+    @property
+    def nullable(self):
+        return self.value is None
+
+    def sql(self):
+        if self.value is None:
+            return "NULL"
+        if isinstance(self.dtype, t.StringType):
+            return repr(self.value.decode("utf-8", "replace"))
+        return str(self.value)
+
+
+def infer_literal_type(value: Any) -> t.DataType:
+    import datetime
+    import decimal as pydec
+    if value is None:
+        return t.NULL
+    if isinstance(value, bool):
+        return t.BOOLEAN
+    if isinstance(value, (int, np.integer)):
+        return t.LONG if not (-(2**31) <= value < 2**31) else t.INT
+    if isinstance(value, (float, np.floating)):
+        return t.DOUBLE
+    if isinstance(value, (str, bytes)):
+        return t.STRING
+    if isinstance(value, pydec.Decimal):
+        sign, digits, exp = value.as_tuple()
+        scale = max(-exp, 0)
+        precision = max(len(digits), scale)
+        return t.DecimalType(precision, scale)
+    if isinstance(value, datetime.datetime):
+        return t.TIMESTAMP
+    if isinstance(value, datetime.date):
+        return t.DATE
+    raise TypeError(f"cannot infer literal type of {value!r}")
+
+
+@evaluator(Literal)
+def _eval_literal(e: Literal, ctx: EvalContext):
+    return ScalarValue(e.value, e.dtype)
+
+
+class AttributeReference(LeafExpression):
+    """Unresolved column reference by name."""
+
+    def __init__(self, name: str, dtype: Optional[t.DataType] = None):
+        self.name = name
+        self.dtype = dtype
+
+    def data_type(self):
+        if self.dtype is None:
+            raise EvalError(f"unresolved attribute {self.name}")
+        return self.dtype
+
+    def sql(self):
+        return self.name
+
+
+class BoundReference(LeafExpression):
+    """Column reference bound to an input ordinal (ref BoundReference)."""
+
+    def __init__(self, ordinal: int, dtype: t.DataType, name: str = ""):
+        self.ordinal = ordinal
+        self.dtype = dtype
+        self.name = name or f"input[{ordinal}]"
+
+    def data_type(self):
+        return self.dtype
+
+    def sql(self):
+        return self.name
+
+
+@evaluator(BoundReference)
+def _eval_bound(e: BoundReference, ctx: EvalContext):
+    return ColumnValue(ctx.batch.columns[e.ordinal])
+
+
+class Alias(Expression):
+    def __init__(self, child: Expression, name: str):
+        self.children = (child,)
+        self.name = name
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def data_type(self):
+        return self.child.data_type()
+
+    @property
+    def nullable(self):
+        return self.child.nullable
+
+    def sql(self):
+        return f"{self.child.sql()} AS {self.name}"
+
+
+@evaluator(Alias)
+def _eval_alias(e: Alias, ctx: EvalContext):
+    return e.child.eval(ctx)
+
+
+def output_name(e: Expression) -> str:
+    if isinstance(e, Alias):
+        return e.name
+    if isinstance(e, (AttributeReference, BoundReference)):
+        return e.name
+    return e.sql()
+
+
+def bind_expression(expr: Expression, names: Sequence[str],
+                    dtypes: Sequence[t.DataType]) -> Expression:
+    """Replace AttributeReference by BoundReference against a schema."""
+    index = {n: i for i, n in enumerate(names)}
+
+    def fn(e: Expression) -> Expression:
+        if isinstance(e, AttributeReference):
+            if e.name not in index:
+                raise EvalError(f"column {e.name!r} not in {list(names)}")
+            i = index[e.name]
+            return BoundReference(i, dtypes[i], e.name)
+        return e
+    return expr.transform_up(fn)
+
+
+# ---------------------------------------------------------------------------
+# Shared evaluation helpers (used by all expression modules)
+# ---------------------------------------------------------------------------
+
+def data_of(v: Value, ctx: EvalContext):
+    """The raw data (xp array or python scalar) of a value."""
+    if isinstance(v, ColumnValue):
+        return v.col.data
+    if v.value is None:
+        return _zero_of(v.dtype)
+    if isinstance(v.dtype, t.BooleanType):
+        return bool(v.value)
+    return v.value
+
+
+def _zero_of(dtype: t.DataType):
+    if isinstance(dtype, t.BooleanType):
+        return False
+    if isinstance(dtype, (t.FloatType, t.DoubleType)):
+        return 0.0
+    if isinstance(dtype, (t.StringType, t.BinaryType)):
+        return b""
+    return 0
+
+
+def validity_of(v: Value, ctx: EvalContext):
+    """Validity mask (xp bool array), or None meaning all-valid, or False
+    meaning all-null scalar."""
+    if isinstance(v, ColumnValue):
+        return v.col.validity
+    return None if v.value is not None else False
+
+
+def and_validity(ctx: EvalContext, *vals):
+    """Combine child validities (Spark null propagation)."""
+    out = None
+    for v in vals:
+        if v is None:
+            continue
+        if v is False:
+            return ctx.xp.zeros((ctx.capacity,), dtype=bool)
+        out = v if out is None else (out & v)
+    return out
+
+
+def make_column(ctx: EvalContext, dtype: t.DataType, data, validity) -> ColumnValue:
+    xp = ctx.xp
+    if validity is None:
+        validity = xp.ones((ctx.capacity,), dtype=bool)
+    elif validity is False:
+        validity = xp.zeros((ctx.capacity,), dtype=bool)
+    if not hasattr(data, "shape") or getattr(data, "shape", ()) == ():
+        npdt = t.to_np_dtype(dtype) if not isinstance(
+            dtype, (t.StringType, t.BinaryType)) else None
+        if npdt is not None:
+            data = xp.full((ctx.capacity,), data, dtype=npdt)
+    # canonicalize: zero under nulls so downstream reductions are safe
+    if not isinstance(dtype, (t.StringType, t.BinaryType, t.StructType,
+                              t.ArrayType, t.MapType)):
+        data = ctx.xp.where(validity, data, ctx.xp.zeros_like(data))
+    return ColumnValue(DeviceColumn(dtype, data=data, validity=validity))
+
+
+def all_null_column(ctx: EvalContext, dtype: t.DataType) -> ColumnValue:
+    xp = ctx.xp
+    if isinstance(dtype, (t.StringType, t.BinaryType)):
+        return ColumnValue(DeviceColumn(
+            dtype, data=xp.zeros((1,), dtype=np.uint8),
+            offsets=xp.zeros((ctx.capacity + 1,), dtype=np.int32),
+            validity=xp.zeros((ctx.capacity,), dtype=bool)))
+    npdt = t.to_np_dtype(dtype) if not isinstance(dtype, t.NullType) else np.int8
+    return ColumnValue(DeviceColumn(
+        dtype, data=xp.zeros((ctx.capacity,), dtype=npdt),
+        validity=xp.zeros((ctx.capacity,), dtype=bool)))

@@ -1,0 +1,269 @@
+"""Math expressions.
+
+Ref: org/apache/spark/sql/rapids/mathExpressions.scala and GpuOverrides
+rules (Sqrt, Exp, Log*, trig family, Pow, Floor, Ceil, Round, Signum, ...).
+
+Spark corner semantics: log of a non-positive number is NULL (not NaN);
+floor/ceil of double return LONG; round is HALF_UP for decimals/integrals
+and HALF_EVEN-free (Spark uses HALF_UP for Round, BRound is HALF_EVEN).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as t
+from .arithmetic import cast_data
+from .core import (EvalContext, Expression, and_validity, data_of, evaluator,
+                   make_column, validity_of)
+
+
+class UnaryMath(Expression):
+    out_type: t.DataType = t.DOUBLE
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def data_type(self):
+        return self.out_type
+
+
+def _unary_double(e, ctx: EvalContext):
+    v = e.children[0].eval(ctx)
+    d = cast_data(ctx, data_of(v, ctx), e.children[0].data_type(), t.DOUBLE)
+    return d, validity_of(v, ctx)
+
+
+def _simple(cls_name: str, fn_name: str):
+    cls = type(cls_name, (UnaryMath,), {})
+
+    @evaluator(cls)
+    def _e(e, ctx: EvalContext, _fn=fn_name):
+        d, val = _unary_double(e, ctx)
+        return make_column(ctx, t.DOUBLE, getattr(ctx.xp, _fn)(d), val)
+    return cls
+
+
+Sqrt = _simple("Sqrt", "sqrt")
+Exp = _simple("Exp", "exp")
+Expm1 = _simple("Expm1", "expm1")
+Sin = _simple("Sin", "sin")
+Cos = _simple("Cos", "cos")
+Tan = _simple("Tan", "tan")
+Asin = _simple("Asin", "arcsin")
+Acos = _simple("Acos", "arccos")
+Atan = _simple("Atan", "arctan")
+Sinh = _simple("Sinh", "sinh")
+Cosh = _simple("Cosh", "cosh")
+Tanh = _simple("Tanh", "tanh")
+Cbrt = _simple("Cbrt", "cbrt")
+Rint = _simple("Rint", "rint")
+ToDegrees = _simple("ToDegrees", "degrees")
+ToRadians = _simple("ToRadians", "radians")
+
+
+class Log(UnaryMath):
+    """Natural log; Spark returns NULL for input <= 0."""
+
+
+@evaluator(Log)
+def _eval_log(e: Log, ctx: EvalContext):
+    xp = ctx.xp
+    d, val = _unary_double(e, ctx)
+    ok = d > 0
+    safe = xp.where(ok, d, xp.ones_like(d))
+    return make_column(ctx, t.DOUBLE, xp.log(safe),
+                       and_validity(ctx, val, ok))
+
+
+class Log2(Log):
+    pass
+
+
+class Log10(Log):
+    pass
+
+
+class Log1p(Log):
+    pass
+
+
+@evaluator(Log2)
+def _eval_log2(e, ctx):
+    xp = ctx.xp
+    d, val = _unary_double(e, ctx)
+    ok = d > 0
+    safe = xp.where(ok, d, xp.ones_like(d))
+    return make_column(ctx, t.DOUBLE, xp.log2(safe), and_validity(ctx, val, ok))
+
+
+@evaluator(Log10)
+def _eval_log10(e, ctx):
+    xp = ctx.xp
+    d, val = _unary_double(e, ctx)
+    ok = d > 0
+    safe = xp.where(ok, d, xp.ones_like(d))
+    return make_column(ctx, t.DOUBLE, xp.log10(safe), and_validity(ctx, val, ok))
+
+
+@evaluator(Log1p)
+def _eval_log1p(e, ctx):
+    xp = ctx.xp
+    d, val = _unary_double(e, ctx)
+    ok = d > -1
+    safe = xp.where(ok, d, xp.zeros_like(d))
+    return make_column(ctx, t.DOUBLE, xp.log1p(safe), and_validity(ctx, val, ok))
+
+
+class Pow(Expression):
+    def __init__(self, left, right):
+        self.children = (left, right)
+
+    def data_type(self):
+        return t.DOUBLE
+
+
+@evaluator(Pow)
+def _eval_pow(e: Pow, ctx: EvalContext):
+    lv, rv = e.children[0].eval(ctx), e.children[1].eval(ctx)
+    ld = cast_data(ctx, data_of(lv, ctx), e.children[0].data_type(), t.DOUBLE)
+    rd = cast_data(ctx, data_of(rv, ctx), e.children[1].data_type(), t.DOUBLE)
+    v = and_validity(ctx, validity_of(lv, ctx), validity_of(rv, ctx))
+    return make_column(ctx, t.DOUBLE, ctx.xp.power(ld, rd), v)
+
+
+class Atan2(Expression):
+    def __init__(self, left, right):
+        self.children = (left, right)
+
+    def data_type(self):
+        return t.DOUBLE
+
+
+@evaluator(Atan2)
+def _eval_atan2(e: Atan2, ctx: EvalContext):
+    lv, rv = e.children[0].eval(ctx), e.children[1].eval(ctx)
+    ld = cast_data(ctx, data_of(lv, ctx), e.children[0].data_type(), t.DOUBLE)
+    rd = cast_data(ctx, data_of(rv, ctx), e.children[1].data_type(), t.DOUBLE)
+    v = and_validity(ctx, validity_of(lv, ctx), validity_of(rv, ctx))
+    return make_column(ctx, t.DOUBLE, ctx.xp.arctan2(ld, rd), v)
+
+
+class Floor(Expression):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def data_type(self):
+        dt = self.children[0].data_type()
+        if isinstance(dt, t.DecimalType):
+            return t.DecimalType(dt.precision - dt.scale + 1, 0)
+        if t.is_integral(dt):
+            return dt
+        return t.LONG
+
+
+class Ceil(Floor):
+    pass
+
+
+@evaluator(Floor)
+def _eval_floor(e: Floor, ctx: EvalContext):
+    xp = ctx.xp
+    v = e.children[0].eval(ctx)
+    src = e.children[0].data_type()
+    out = e.data_type()
+    d = data_of(v, ctx)
+    val = validity_of(v, ctx)
+    is_ceil = type(e) is Ceil
+    if isinstance(src, t.DecimalType):
+        scale_f = np.int64(10 ** src.scale)
+        q = d // scale_f if not is_ceil else -((-d) // scale_f)
+        return make_column(ctx, out, q, val)
+    if t.is_integral(src):
+        return make_column(ctx, out, d, val)
+    data = (xp.ceil(d) if is_ceil else xp.floor(d)).astype(np.int64)
+    return make_column(ctx, out, data, val)
+
+
+_EVAL_CEIL = _eval_floor
+from .core import _EVALUATORS  # noqa: E402
+_EVALUATORS[Ceil] = _eval_floor
+
+
+class Signum(UnaryMath):
+    pass
+
+
+@evaluator(Signum)
+def _eval_signum(e, ctx):
+    d, val = _unary_double(e, ctx)
+    return make_column(ctx, t.DOUBLE, ctx.xp.sign(d), val)
+
+
+class Round(Expression):
+    """HALF_UP rounding to `scale` digits (Spark Round)."""
+
+    half_even = False
+
+    def __init__(self, child, scale: int = 0):
+        self.children = (child,)
+        self.scale = scale
+
+    def data_type(self):
+        dt = self.children[0].data_type()
+        if isinstance(dt, t.DecimalType):
+            new_scale = min(max(self.scale, 0), dt.scale)
+            p = dt.precision - dt.scale + new_scale + (1 if new_scale < dt.scale else 0)
+            return t.DecimalType(min(p, 38), new_scale)
+        return dt
+
+
+class BRound(Round):
+    half_even = True
+
+
+def _round_impl(e: Round, ctx: EvalContext):
+    xp = ctx.xp
+    v = e.children[0].eval(ctx)
+    src = e.children[0].data_type()
+    d = data_of(v, ctx)
+    val = validity_of(v, ctx)
+    s = e.scale
+    if isinstance(src, t.DecimalType):
+        out = e.data_type()
+        if out.scale >= src.scale:
+            return make_column(ctx, out, d, val)
+        f = np.int64(10 ** (src.scale - out.scale))
+        if e.half_even:
+            # floor-division puts r in [0, f); tie picks the even quotient
+            q = d // f
+            r = d - q * f
+            up = (2 * r > f) | ((2 * r == f) & (q % 2 != 0))
+            return make_column(ctx, out, (q + up.astype(np.int64)), val)
+        from .arithmetic import _div_round_half_up
+        q = _div_round_half_up(xp, d, f)
+        return make_column(ctx, out, q, val)
+    if t.is_integral(src):
+        if s >= 0:
+            return make_column(ctx, src, d, val)
+        f = np.int64(10 ** (-s))
+        from .arithmetic import _div_round_half_up
+        q = _div_round_half_up(xp, d, f)
+        return make_column(ctx, src, q * f, val)
+    # floating: Spark rounds via BigDecimal HALF_UP; approximate with
+    # scaled rounding (documented float corner)
+    f = 10.0 ** s
+    if e.half_even:
+        data = xp.round(d * f) / f
+    else:
+        data = xp.where(d >= 0, xp.floor(d * f + 0.5),
+                        xp.ceil(d * f - 0.5)) / f
+    return make_column(ctx, src, data.astype(t.to_np_dtype(src)), val)
+
+
+@evaluator(Round)
+def _eval_round(e, ctx):
+    return _round_impl(e, ctx)
+
+
+_EVALUATORS[BRound] = _round_impl

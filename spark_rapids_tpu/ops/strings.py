@@ -1,0 +1,175 @@
+"""TPU string kernels over (offsets:int32, chars:uint8) byte tensors.
+
+The reference gets string kernels from libcudf (substr, concat, compare,
+hash — ref GpuOverrides string rules, stringFunctions.scala).  TPUs have no
+native string support, so every primitive here is expressed as static-shape
+vector ops over the character buffer:
+
+* equality    — string length + two independent 64-bit polynomial rolling
+                hashes (computed in O(char_cap) with a single cumsum); the
+                double hash makes false-positive probability ~2^-120 per
+                pair.  Exact for strings <= PREFIX_BYTES via prefix compare.
+* ordering    — big-endian packed uint64 prefix words (PREFIX_BYTES bytes);
+                lexicographic byte order == numeric order of the words.
+                Strings equal in the first PREFIX_BYTES bytes tie-break by
+                length (documented corner: >32-byte shared-prefix ordering
+                is approximate; gate via incompatibleOps like the reference
+                gates corner-case ops).
+* gather      — build a new (offsets, chars) pair for a row selection using
+                cumsum offsets + a scatter of source spans (O(char_cap)).
+
+All functions take `xp` (numpy or jax.numpy) so the CPU fallback engine runs
+the identical semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+PREFIX_BYTES = 32  # 4 uint64 words
+_HASH_BASE_1 = np.uint64(0x100000001B3)          # FNV-ish odd base
+_HASH_BASE_2 = np.uint64(0x9E3779B97F4A7C15)     # golden-ratio odd base
+_HASH_INV_1 = np.uint64(pow(int(_HASH_BASE_1), -1, 1 << 64))
+_HASH_INV_2 = np.uint64(pow(int(_HASH_BASE_2), -1, 1 << 64))
+
+
+def lengths(xp, offsets):
+    return offsets[1:] - offsets[:-1]
+
+
+def _rolling_hash(xp, offsets, chars, base, inv_base):
+    """hash_i = sum_{j in span_i} (chars[j]+1) * base^(j-start_i)  (mod 2^64).
+
+    Computed globally: prefix[k] = sum_{j<k} (c_j+1) * base^j, then
+    hash_i = (prefix[end] - prefix[start]) * base^{-start}.
+    """
+    n = chars.shape[0]
+    powers = xp.cumprod(xp.full((n,), base, dtype=xp.uint64)) * inv_base
+    inv_powers = xp.cumprod(xp.full((n,), inv_base, dtype=xp.uint64)) * base
+    contrib = (chars.astype(xp.uint64) + xp.uint64(1)) * powers
+    prefix = xp.concatenate([xp.zeros((1,), xp.uint64), xp.cumsum(contrib)])
+    starts = offsets[:-1].astype(xp.int32)
+    ends = offsets[1:].astype(xp.int32)
+    span = prefix[ends] - prefix[starts]
+    # base^{-start}; start == n only for empty spans (span == 0), clip is safe
+    start_inv = inv_powers[xp.clip(starts, 0, n - 1)]
+    return span * start_inv
+
+
+def string_hashes(xp, offsets, chars):
+    """Two independent 64-bit content hashes per string."""
+    h1 = _rolling_hash(xp, offsets, chars, _HASH_BASE_1, _HASH_INV_1)
+    h2 = _rolling_hash(xp, offsets, chars, _HASH_BASE_2, _HASH_INV_2)
+    return h1, h2
+
+
+def string_eq(xp, offs_a, chars_a, offs_b, chars_b):
+    """Elementwise string equality (bool[cap])."""
+    la = lengths(xp, offs_a)
+    lb = lengths(xp, offs_b)
+    a1, a2 = string_hashes(xp, offs_a, chars_a)
+    b1, b2 = string_hashes(xp, offs_b, chars_b)
+    return (la == lb) & (a1 == b1) & (a2 == b2)
+
+
+def prefix_words(xp, offsets, chars, n_words: int = PREFIX_BYTES // 8):
+    """[cap, n_words] uint64 big-endian packed prefixes for ordering."""
+    cap = offsets.shape[0] - 1
+    lens = lengths(xp, offsets)
+    k = xp.arange(n_words * 8, dtype=xp.int32)
+    idx = offsets[:-1][:, None] + k[None, :]
+    in_range = k[None, :] < lens[:, None]
+    idx = xp.clip(idx, 0, chars.shape[0] - 1)
+    b = xp.where(in_range, chars[idx], xp.zeros((), dtype=chars.dtype))
+    b = b.astype(xp.uint64).reshape(cap, n_words, 8)
+    shifts = xp.uint64(8) * (xp.uint64(7) - xp.arange(8, dtype=xp.uint64))
+    words = xp.sum(b << shifts[None, None, :], axis=-1, dtype=xp.uint64)
+    return words
+
+
+def order_keys(xp, offsets, chars):
+    """Columns (most-significant first) for lexicographic string ordering:
+    prefix words then length as tie-break."""
+    words = prefix_words(xp, offsets, chars)
+    lens = lengths(xp, offsets).astype(xp.uint64)
+    cols = [words[:, i] for i in range(words.shape[1])]
+    cols.append(lens)
+    return cols
+
+
+def gather_strings(xp, offsets, chars, indices, valid, out_char_cap: int):
+    """Build (offsets', chars') for rows chars[span(indices[i])].
+
+    `indices` int32[out_cap] source row per output slot; `valid` bool[out_cap]
+    marks live slots (invalid slots become empty strings).  O(out_cap +
+    out_char_cap) using a scatter of span starts + cummax trick:
+
+      For output position p in [0, out_char_cap): find which output row it
+      belongs to via searchsorted over the new offsets, then read
+      chars[src_start[row] + (p - new_start[row])].
+    """
+    src_start = offsets[indices]
+    src_len = xp.where(valid, offsets[indices + 1] - src_start,
+                       xp.zeros((), dtype=offsets.dtype))
+    new_offs = xp.concatenate([
+        xp.zeros((1,), offsets.dtype),
+        xp.cumsum(src_len, dtype=offsets.dtype)])
+    p = xp.arange(out_char_cap, dtype=offsets.dtype)
+    row = xp.searchsorted(new_offs[1:], p, side="right").astype(xp.int32)
+    row = xp.clip(row, 0, indices.shape[0] - 1)
+    src_pos = src_start[row] + (p - new_offs[row])
+    src_pos = xp.clip(src_pos, 0, chars.shape[0] - 1)
+    total = new_offs[-1]
+    new_chars = xp.where(p < total, chars[src_pos],
+                         xp.zeros((), dtype=chars.dtype))
+    return new_offs, new_chars
+
+
+def pack_rows(xp, bytes_mat, lens, valid, out_char_cap: int):
+    """Build (offsets, chars) from left-aligned per-row byte matrices.
+
+    bytes_mat: uint8[cap, W] with row content in columns [0, lens[i]);
+    invalid rows become empty strings.  O(cap*W + out_char_cap).
+    """
+    cap = bytes_mat.shape[0]
+    lens = xp.where(valid, lens, xp.zeros((), dtype=lens.dtype)).astype(xp.int32)
+    offs = xp.concatenate([xp.zeros((1,), xp.int32),
+                           xp.cumsum(lens, dtype=xp.int32)])
+    p = xp.arange(out_char_cap, dtype=xp.int32)
+    row = xp.clip(xp.searchsorted(offs[1:], p, side="right"),
+                  0, cap - 1).astype(xp.int32)
+    col = xp.clip(p - offs[row], 0, bytes_mat.shape[1] - 1)
+    chars = xp.where(p < offs[-1], bytes_mat[row, col],
+                     xp.zeros((), dtype=xp.uint8))
+    return offs, chars
+
+
+def window_bytes(xp, offsets, chars, width: int):
+    """[cap, width] uint8 window of each string's first `width` bytes
+    (zero beyond the string's length), plus lengths."""
+    lens = lengths(xp, offsets)
+    k = xp.arange(width, dtype=xp.int32)
+    idx = xp.clip(offsets[:-1][:, None] + k[None, :], 0, chars.shape[0] - 1)
+    b = xp.where(k[None, :] < lens[:, None], chars[idx],
+                 xp.zeros((), dtype=chars.dtype))
+    return b, lens
+
+
+def concat_char_buffers(xp, offs_list, chars_list, out_char_cap: int):
+    """Concatenate several (offsets, chars) columns into one buffer."""
+    total = 0
+    new_chars = xp.zeros((out_char_cap,), dtype=xp.uint8)
+    new_offs_parts = []
+    base = xp.zeros((), dtype=offs_list[0].dtype)
+    pos = xp.arange(out_char_cap, dtype=xp.int32)
+    for offs, chars in zip(offs_list, chars_list):
+        n = chars.shape[0]
+        nbytes = offs[-1]
+        in_span = (pos >= base) & (pos < base + nbytes)
+        src = xp.clip(pos - base, 0, n - 1)
+        new_chars = xp.where(in_span, chars[src], new_chars)
+        new_offs_parts.append(offs[:-1] + base)
+        base = base + nbytes
+    new_offs = xp.concatenate(new_offs_parts +
+                              [base[None].astype(offs_list[0].dtype)])
+    return new_offs, new_chars

@@ -1,0 +1,557 @@
+"""Data types and the TypeSig capability algebra.
+
+TPU-native re-design of the reference's type-compatibility system
+(ref: sql-plugin/.../TypeChecks.scala:169 `TypeSig`, :711 `TypeChecks`).
+A `TypeSig` describes the set of types an operator / expression parameter
+supports in a given context; tagging produces human-readable reasons used
+by the plan-rewrite engine to decide TPU vs CPU placement, and it also
+drives the generated `docs/supported_ops.md`.
+
+Physical mapping notes (TPU-first):
+  - integral/floating types map 1:1 onto jnp dtypes,
+  - DECIMAL(p<=18) is an int64-backed fixed-point tensor (DECIMAL_64),
+  - DECIMAL(p<=38) is a (hi:int64, lo:uint64) pair of tensors (DECIMAL_128),
+  - STRING/BINARY are (offsets:int32[n+1], data:uint8[cap]) tensor pairs,
+  - DATE is int32 days since epoch, TIMESTAMP int64 micros since epoch (UTC),
+  - ARRAY adds an offsets tensor over its child; STRUCT is a named tuple of
+    child columns; MAP is ARRAY<STRUCT<key,value>>.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# DataType hierarchy (mirrors Spark SQL's type lattice; independent impl)
+# ---------------------------------------------------------------------------
+
+class DataType:
+    """Base class for SQL data types."""
+
+    #: simple name used in signatures / docs
+    name: str = "data"
+
+    def __eq__(self, other):
+        return type(self) is type(other)
+
+    def __hash__(self):
+        return hash(type(self))
+
+    def __repr__(self):
+        return self.name
+
+    @property
+    def default_size(self) -> int:
+        return 8
+
+    def simple_string(self) -> str:
+        return self.name
+
+
+class NullType(DataType):
+    name = "null"
+
+    @property
+    def default_size(self):
+        return 1
+
+
+class BooleanType(DataType):
+    name = "boolean"
+
+    @property
+    def default_size(self):
+        return 1
+
+
+class NumericType(DataType):
+    pass
+
+
+class IntegralType(NumericType):
+    pass
+
+
+class ByteType(IntegralType):
+    name = "tinyint"
+
+    @property
+    def default_size(self):
+        return 1
+
+
+class ShortType(IntegralType):
+    name = "smallint"
+
+    @property
+    def default_size(self):
+        return 2
+
+
+class IntegerType(IntegralType):
+    name = "int"
+
+    @property
+    def default_size(self):
+        return 4
+
+
+class LongType(IntegralType):
+    name = "bigint"
+
+    @property
+    def default_size(self):
+        return 8
+
+
+class FractionalType(NumericType):
+    pass
+
+
+class FloatType(FractionalType):
+    name = "float"
+
+    @property
+    def default_size(self):
+        return 4
+
+
+class DoubleType(FractionalType):
+    name = "double"
+
+    @property
+    def default_size(self):
+        return 8
+
+
+class StringType(DataType):
+    name = "string"
+
+    @property
+    def default_size(self):
+        return 20
+
+
+class BinaryType(DataType):
+    name = "binary"
+
+    @property
+    def default_size(self):
+        return 100
+
+
+class DateType(DataType):
+    name = "date"
+
+    @property
+    def default_size(self):
+        return 4
+
+
+class TimestampType(DataType):
+    name = "timestamp"
+
+    @property
+    def default_size(self):
+        return 8
+
+
+class CalendarIntervalType(DataType):
+    name = "interval"
+
+
+MAX_DECIMAL64_PRECISION = 18
+MAX_DECIMAL128_PRECISION = 38
+
+
+class DecimalType(FractionalType):
+    """Fixed-point decimal.  p <= 18 backed by int64 on device (DECIMAL_64),
+    p <= 38 by an int64-pair encoding (DECIMAL_128)."""
+
+    def __init__(self, precision: int = 10, scale: int = 0):
+        if precision < 1 or precision > MAX_DECIMAL128_PRECISION:
+            raise ValueError(f"decimal precision {precision} out of range")
+        if scale > precision:
+            raise ValueError(f"decimal scale {scale} > precision {precision}")
+        self.precision = precision
+        self.scale = scale
+        self.name = f"decimal({precision},{scale})"
+
+    def __eq__(self, other):
+        return (isinstance(other, DecimalType)
+                and other.precision == self.precision
+                and other.scale == self.scale)
+
+    def __hash__(self):
+        return hash(("decimal", self.precision, self.scale))
+
+    @property
+    def is64(self) -> bool:
+        return self.precision <= MAX_DECIMAL64_PRECISION
+
+    @property
+    def default_size(self):
+        return 8 if self.is64 else 16
+
+
+class ArrayType(DataType):
+    def __init__(self, element_type: DataType, contains_null: bool = True):
+        self.element_type = element_type
+        self.contains_null = contains_null
+        self.name = f"array<{element_type.name}>"
+
+    def __eq__(self, other):
+        return (isinstance(other, ArrayType)
+                and other.element_type == self.element_type)
+
+    def __hash__(self):
+        return hash(("array", self.element_type))
+
+
+@dataclass(frozen=True)
+class StructField:
+    name: str
+    data_type: DataType
+    nullable: bool = True
+
+
+class StructType(DataType):
+    def __init__(self, fields: Iterable[StructField]):
+        self.fields: Tuple[StructField, ...] = tuple(fields)
+        self.name = "struct<" + ",".join(
+            f"{f.name}:{f.data_type.name}" for f in self.fields) + ">"
+
+    def __eq__(self, other):
+        return isinstance(other, StructType) and other.fields == self.fields
+
+    def __hash__(self):
+        return hash(("struct", self.fields))
+
+    def field_index(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    @property
+    def names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+
+class MapType(DataType):
+    def __init__(self, key_type: DataType, value_type: DataType,
+                 value_contains_null: bool = True):
+        self.key_type = key_type
+        self.value_type = value_type
+        self.value_contains_null = value_contains_null
+        self.name = f"map<{key_type.name},{value_type.name}>"
+
+    def __eq__(self, other):
+        return (isinstance(other, MapType) and other.key_type == self.key_type
+                and other.value_type == self.value_type)
+
+    def __hash__(self):
+        return hash(("map", self.key_type, self.value_type))
+
+
+# singletons
+NULL = NullType()
+BOOLEAN = BooleanType()
+BYTE = ByteType()
+SHORT = ShortType()
+INT = IntegerType()
+LONG = LongType()
+FLOAT = FloatType()
+DOUBLE = DoubleType()
+STRING = StringType()
+BINARY = BinaryType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+CALENDAR = CalendarIntervalType()
+
+_INTEGRAL = (ByteType, ShortType, IntegerType, LongType)
+
+
+def is_integral(dt: DataType) -> bool:
+    return isinstance(dt, _INTEGRAL)
+
+
+def is_numeric(dt: DataType) -> bool:
+    return isinstance(dt, NumericType)
+
+
+def is_floating(dt: DataType) -> bool:
+    return isinstance(dt, (FloatType, DoubleType))
+
+
+# numpy dtype mapping for the host representation
+_NP_DTYPES = {
+    BooleanType: np.bool_,
+    ByteType: np.int8,
+    ShortType: np.int16,
+    IntegerType: np.int32,
+    LongType: np.int64,
+    FloatType: np.float32,
+    DoubleType: np.float64,
+    DateType: np.int32,
+    TimestampType: np.int64,
+}
+
+
+def to_np_dtype(dt: DataType):
+    """Physical numpy dtype of the primary buffer for a flat type."""
+    if isinstance(dt, DecimalType):
+        return np.int64
+    t = _NP_DTYPES.get(type(dt))
+    if t is None:
+        raise TypeError(f"no flat numpy dtype for {dt}")
+    return t
+
+
+def from_np_dtype(npdt) -> DataType:
+    npdt = np.dtype(npdt)
+    table = {
+        np.dtype(np.bool_): BOOLEAN,
+        np.dtype(np.int8): BYTE,
+        np.dtype(np.int16): SHORT,
+        np.dtype(np.int32): INT,
+        np.dtype(np.int64): LONG,
+        np.dtype(np.float32): FLOAT,
+        np.dtype(np.float64): DOUBLE,
+    }
+    if npdt in table:
+        return table[npdt]
+    if npdt.kind in ("U", "S", "O"):
+        return STRING
+    if npdt.kind == "M":
+        return TIMESTAMP
+    raise TypeError(f"unsupported numpy dtype {npdt}")
+
+
+# ---------------------------------------------------------------------------
+# TypeEnum + TypeSig algebra  (ref TypeChecks.scala:169)
+# ---------------------------------------------------------------------------
+
+class TypeEnum(enum.Flag):
+    NONE = 0
+    BOOLEAN = enum.auto()
+    BYTE = enum.auto()
+    SHORT = enum.auto()
+    INT = enum.auto()
+    LONG = enum.auto()
+    FLOAT = enum.auto()
+    DOUBLE = enum.auto()
+    DATE = enum.auto()
+    TIMESTAMP = enum.auto()
+    STRING = enum.auto()
+    DECIMAL_64 = enum.auto()
+    DECIMAL_128 = enum.auto()
+    NULL = enum.auto()
+    BINARY = enum.auto()
+    CALENDAR = enum.auto()
+    ARRAY = enum.auto()
+    MAP = enum.auto()
+    STRUCT = enum.auto()
+    UDT = enum.auto()
+
+
+def _type_enum_of(dt: DataType) -> TypeEnum:
+    if isinstance(dt, BooleanType):
+        return TypeEnum.BOOLEAN
+    if isinstance(dt, ByteType):
+        return TypeEnum.BYTE
+    if isinstance(dt, ShortType):
+        return TypeEnum.SHORT
+    if isinstance(dt, IntegerType):
+        return TypeEnum.INT
+    if isinstance(dt, LongType):
+        return TypeEnum.LONG
+    if isinstance(dt, FloatType):
+        return TypeEnum.FLOAT
+    if isinstance(dt, DoubleType):
+        return TypeEnum.DOUBLE
+    if isinstance(dt, DateType):
+        return TypeEnum.DATE
+    if isinstance(dt, TimestampType):
+        return TypeEnum.TIMESTAMP
+    if isinstance(dt, StringType):
+        return TypeEnum.STRING
+    if isinstance(dt, DecimalType):
+        return TypeEnum.DECIMAL_64 if dt.is64 else TypeEnum.DECIMAL_128
+    if isinstance(dt, NullType):
+        return TypeEnum.NULL
+    if isinstance(dt, BinaryType):
+        return TypeEnum.BINARY
+    if isinstance(dt, CalendarIntervalType):
+        return TypeEnum.CALENDAR
+    if isinstance(dt, ArrayType):
+        return TypeEnum.ARRAY
+    if isinstance(dt, MapType):
+        return TypeEnum.MAP
+    if isinstance(dt, StructType):
+        return TypeEnum.STRUCT
+    return TypeEnum.UDT
+
+
+class TypeSig:
+    """A set of types an op supports, with separate nested-child capability
+    and per-type doc notes.  Immutable; combine with ``+``/``-``.
+
+    Ref: TypeChecks.scala:169.
+    """
+
+    __slots__ = ("initial", "nested_sig", "lit_only", "notes", "max_decimal_precision")
+
+    def __init__(self, initial: TypeEnum = TypeEnum.NONE,
+                 nested_sig: TypeEnum = TypeEnum.NONE,
+                 lit_only: TypeEnum = TypeEnum.NONE,
+                 notes: Optional[Dict[TypeEnum, str]] = None,
+                 max_decimal_precision: int = MAX_DECIMAL64_PRECISION):
+        self.initial = initial
+        self.nested_sig = nested_sig
+        self.lit_only = lit_only
+        self.notes = dict(notes or {})
+        self.max_decimal_precision = max_decimal_precision
+
+    # -- building -----------------------------------------------------------
+    def __add__(self, other: "TypeSig") -> "TypeSig":
+        return TypeSig(self.initial | other.initial,
+                       self.nested_sig | other.nested_sig,
+                       self.lit_only | other.lit_only,
+                       {**self.notes, **other.notes},
+                       max(self.max_decimal_precision, other.max_decimal_precision))
+
+    def __sub__(self, other: "TypeSig") -> "TypeSig":
+        return TypeSig(self.initial & ~other.initial,
+                       self.nested_sig & ~other.nested_sig,
+                       self.lit_only,
+                       self.notes,
+                       self.max_decimal_precision)
+
+    def nested(self, sub: Optional["TypeSig"] = None) -> "TypeSig":
+        """Allow nested children of the given sig (default: same as top)."""
+        sub_enum = (sub.initial if sub is not None else self.initial)
+        return TypeSig(self.initial, self.nested_sig | sub_enum,
+                       self.lit_only, self.notes, self.max_decimal_precision)
+
+    def with_ps_note(self, te: TypeEnum, note: str) -> "TypeSig":
+        notes = dict(self.notes)
+        notes[te] = note
+        return TypeSig(self.initial, self.nested_sig, self.lit_only, notes,
+                       self.max_decimal_precision)
+
+    def with_lit_only(self, te: TypeEnum) -> "TypeSig":
+        return TypeSig(self.initial, self.nested_sig, self.lit_only | te,
+                       self.notes, self.max_decimal_precision)
+
+    # -- checking -----------------------------------------------------------
+    def _is_supported(self, dt: DataType, allowed: TypeEnum, depth: int) -> bool:
+        te = _type_enum_of(dt)
+        if te == TypeEnum.DECIMAL_64 or te == TypeEnum.DECIMAL_128:
+            dec_ok = (TypeEnum.DECIMAL_64 | TypeEnum.DECIMAL_128) & allowed
+            if not (te & allowed):
+                return False
+            assert isinstance(dt, DecimalType)
+            if dt.precision > self.max_decimal_precision:
+                return False
+            return bool(dec_ok)
+        if not (te & allowed):
+            return False
+        child_allowed = self.nested_sig
+        if isinstance(dt, ArrayType):
+            return self._is_supported(dt.element_type, child_allowed, depth + 1)
+        if isinstance(dt, MapType):
+            return (self._is_supported(dt.key_type, child_allowed, depth + 1)
+                    and self._is_supported(dt.value_type, child_allowed, depth + 1))
+        if isinstance(dt, StructType):
+            return all(self._is_supported(f.data_type, child_allowed, depth + 1)
+                       for f in dt.fields)
+        return True
+
+    def is_supported(self, dt: DataType) -> bool:
+        return self._is_supported(dt, self.initial, 0)
+
+    def reasons_not_supported(self, dt: DataType) -> List[str]:
+        """Human-readable reasons why ``dt`` is not supported (empty == ok)."""
+        if self.is_supported(dt):
+            return []
+        te = _type_enum_of(dt)
+        if not (te & self.initial):
+            return [f"{dt.name} is not supported"]
+        if isinstance(dt, DecimalType) and dt.precision > self.max_decimal_precision:
+            return [f"{dt.name} precision exceeds max supported "
+                    f"({self.max_decimal_precision})"]
+        if isinstance(dt, ArrayType):
+            return [f"array child: {r}"
+                    for r in TypeSig(self.nested_sig, self.nested_sig,
+                                     max_decimal_precision=self.max_decimal_precision)
+                    .reasons_not_supported(dt.element_type)]
+        if isinstance(dt, MapType):
+            child = TypeSig(self.nested_sig, self.nested_sig,
+                            max_decimal_precision=self.max_decimal_precision)
+            out = [f"map key: {r}" for r in child.reasons_not_supported(dt.key_type)]
+            out += [f"map value: {r}" for r in child.reasons_not_supported(dt.value_type)]
+            return out
+        if isinstance(dt, StructType):
+            child = TypeSig(self.nested_sig, self.nested_sig,
+                            max_decimal_precision=self.max_decimal_precision)
+            out = []
+            for f in dt.fields:
+                out += [f"struct field {f.name}: {r}"
+                        for r in child.reasons_not_supported(f.data_type)]
+            return out
+        return [f"{dt.name} is not supported"]
+
+    def described(self) -> str:
+        if self.initial == TypeEnum.NONE:
+            return "none"
+        return ", ".join(t.name for t in TypeEnum if t != TypeEnum.NONE
+                         and (t & self.initial))
+
+
+def _sig(*types: TypeEnum) -> TypeSig:
+    v = TypeEnum.NONE
+    for t in types:
+        v |= t
+    return TypeSig(v)
+
+
+class TpuTypeSigs:
+    """Standard signatures (ref TypeChecks.scala companion object constants)."""
+    none = TypeSig()
+    BOOLEAN = _sig(TypeEnum.BOOLEAN)
+    BYTE = _sig(TypeEnum.BYTE)
+    SHORT = _sig(TypeEnum.SHORT)
+    INT = _sig(TypeEnum.INT)
+    LONG = _sig(TypeEnum.LONG)
+    FLOAT = _sig(TypeEnum.FLOAT)
+    DOUBLE = _sig(TypeEnum.DOUBLE)
+    DATE = _sig(TypeEnum.DATE)
+    TIMESTAMP = _sig(TypeEnum.TIMESTAMP)
+    STRING = _sig(TypeEnum.STRING)
+    NULL = _sig(TypeEnum.NULL)
+    BINARY = _sig(TypeEnum.BINARY)
+    CALENDAR = _sig(TypeEnum.CALENDAR)
+    DECIMAL_64 = TypeSig(TypeEnum.DECIMAL_64)
+    DECIMAL_128 = TypeSig(TypeEnum.DECIMAL_64 | TypeEnum.DECIMAL_128,
+                          max_decimal_precision=MAX_DECIMAL128_PRECISION)
+    ARRAY = _sig(TypeEnum.ARRAY)
+    MAP = _sig(TypeEnum.MAP)
+    STRUCT = _sig(TypeEnum.STRUCT)
+
+    integral = BYTE + SHORT + INT + LONG
+    gpu_numeric = integral + FLOAT + DOUBLE + DECIMAL_128
+    numeric = gpu_numeric
+    comparable = numeric + BOOLEAN + DATE + TIMESTAMP + STRING + NULL
+    common_scalar = (numeric + BOOLEAN + DATE + TIMESTAMP + STRING + NULL)
+    orderable = common_scalar
+    all_types = (common_scalar + BINARY + CALENDAR + ARRAY + MAP + STRUCT)
+
+
+# convenience alias used across the codebase
+T = TpuTypeSigs
