@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Benchmark: hash-aggregate pipeline throughput, TPU engine vs CPU engine.
+
+Workload mirrors the reference's first-line benchmark shape
+(integration_tests hash_aggregate / BASELINE.json config 1): scan ->
+filter -> GROUP BY k SUM/AVG/COUNT over int/long/double columns.
+
+Prints ONE JSON line: metric, value (rows/s through the TPU engine),
+vs_baseline (speedup over the CPU fallback engine on the same host —
+the stand-in for Spark-CPU until a cluster baseline exists).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+
+
+def make_table(n_rows: int, n_groups: int) -> pa.Table:
+    rng = np.random.default_rng(42)
+    return pa.table({
+        "k": pa.array(rng.integers(0, n_groups, n_rows).astype(np.int64)),
+        "v": pa.array(rng.integers(-(10**6), 10**6, n_rows).astype(np.int64)),
+        "f": pa.array(rng.random(n_rows)),
+    })
+
+
+def run_query(session, table):
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    df = session.create_dataframe(table)
+    return (df.filter(col("v") > -(10**6) // 2)
+              .group_by(col("k"))
+              .agg(F.sum(col("v")).alias("sv"),
+                   F.avg(col("f")).alias("af"),
+                   F.count("*").alias("c"))
+              .collect())
+
+
+def time_engine(enabled: bool, table, repeats: int = 3) -> float:
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession.builder().config("spark.rapids.sql.enabled",
+                                    enabled).get_or_create()
+    run_query(s, table)  # warmup (compile)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = run_query(s, table)
+        best = min(best, time.perf_counter() - t0)
+    assert out.num_rows > 0
+    return best
+
+
+def main():
+    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
+    table = make_table(n_rows, n_groups=100_000)
+    tpu_t = time_engine(True, table)
+    cpu_t = time_engine(False, table)
+    value = n_rows / tpu_t
+    print(json.dumps({
+        "metric": "hash_agg_pipeline_rows_per_sec",
+        "value": round(value, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(cpu_t / tpu_t, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

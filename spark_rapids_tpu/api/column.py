@@ -1,0 +1,181 @@
+"""Column wrapper: operator overloading over the expression IR
+(mirrors pyspark.sql.Column)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..expr import arithmetic as ar
+from ..expr import predicates as pred
+from ..expr.cast import Cast
+from ..expr.core import (Alias, AttributeReference, Expression, Literal,
+                         output_name)
+from .. import types as t
+
+
+def _expr(v) -> Expression:
+    if isinstance(v, Column):
+        return v.expr
+    if isinstance(v, Expression):
+        return v
+    return Literal(v)
+
+
+class Column:
+    def __init__(self, expr: Expression, alias: Optional[str] = None,
+                 sort_order: Optional[Tuple[bool, bool]] = None):
+        self.expr = expr
+        self._alias = alias
+        self._sort_order = sort_order
+
+    # arithmetic
+    def __add__(self, o):
+        return Column(ar.Add(self.expr, _expr(o)))
+
+    def __radd__(self, o):
+        return Column(ar.Add(_expr(o), self.expr))
+
+    def __sub__(self, o):
+        return Column(ar.Subtract(self.expr, _expr(o)))
+
+    def __rsub__(self, o):
+        return Column(ar.Subtract(_expr(o), self.expr))
+
+    def __mul__(self, o):
+        return Column(ar.Multiply(self.expr, _expr(o)))
+
+    def __rmul__(self, o):
+        return Column(ar.Multiply(_expr(o), self.expr))
+
+    def __truediv__(self, o):
+        return Column(ar.Divide(self.expr, _expr(o)))
+
+    def __rtruediv__(self, o):
+        return Column(ar.Divide(_expr(o), self.expr))
+
+    def __mod__(self, o):
+        return Column(ar.Remainder(self.expr, _expr(o)))
+
+    def __neg__(self):
+        return Column(ar.UnaryMinus(self.expr))
+
+    # comparisons
+    def __eq__(self, o):  # type: ignore[override]
+        return Column(pred.EqualTo(self.expr, _expr(o)))
+
+    def __ne__(self, o):  # type: ignore[override]
+        return Column(pred.Not(pred.EqualTo(self.expr, _expr(o))))
+
+    def __lt__(self, o):
+        return Column(pred.LessThan(self.expr, _expr(o)))
+
+    def __le__(self, o):
+        return Column(pred.LessThanOrEqual(self.expr, _expr(o)))
+
+    def __gt__(self, o):
+        return Column(pred.GreaterThan(self.expr, _expr(o)))
+
+    def __ge__(self, o):
+        return Column(pred.GreaterThanOrEqual(self.expr, _expr(o)))
+
+    # boolean
+    def __and__(self, o):
+        return Column(pred.And(self.expr, _expr(o)))
+
+    def __or__(self, o):
+        return Column(pred.Or(self.expr, _expr(o)))
+
+    def __invert__(self):
+        return Column(pred.Not(self.expr))
+
+    # null / membership
+    def is_null(self):
+        return Column(pred.IsNull(self.expr))
+
+    isNull = is_null
+
+    def is_not_null(self):
+        return Column(pred.IsNotNull(self.expr))
+
+    isNotNull = is_not_null
+
+    def isin(self, *vals):
+        if len(vals) == 1 and isinstance(vals[0], (list, tuple)):
+            vals = tuple(vals[0])
+        return Column(pred.In(self.expr, [Literal(v) for v in vals]))
+
+    def eq_null_safe(self, o):
+        return Column(pred.EqualNullSafe(self.expr, _expr(o)))
+
+    eqNullSafe = eq_null_safe
+
+    # misc
+    def alias(self, name: str):
+        return Column(Alias(self.expr, name), alias=name)
+
+    def cast(self, to):
+        if isinstance(to, str):
+            to = _parse_type(to)
+        return Column(Cast(self.expr, to))
+
+    def asc(self):
+        return Column(self.expr, self._alias, sort_order=(True, True))
+
+    def desc(self):
+        return Column(self.expr, self._alias, sort_order=(False, False))
+
+    def asc_nulls_last(self):
+        return Column(self.expr, self._alias, sort_order=(True, False))
+
+    def desc_nulls_first(self):
+        return Column(self.expr, self._alias, sort_order=(False, True))
+
+    def substr(self, start, length):
+        from ..expr.strings import Substring
+        return Column(Substring(self.expr, Literal(start), Literal(length)))
+
+    def contains(self, s):
+        from ..expr.strings import Contains
+        return Column(Contains(self.expr, _expr(s)))
+
+    def startswith(self, s):
+        from ..expr.strings import StartsWith
+        return Column(StartsWith(self.expr, _expr(s)))
+
+    def endswith(self, s):
+        from ..expr.strings import EndsWith
+        return Column(EndsWith(self.expr, _expr(s)))
+
+    def __repr__(self):
+        return f"Column<{self.expr.sql()}>"
+
+
+def _parse_type(s: str) -> t.DataType:
+    s = s.strip().lower()
+    simple = {
+        "boolean": t.BOOLEAN, "bool": t.BOOLEAN,
+        "byte": t.BYTE, "tinyint": t.BYTE,
+        "short": t.SHORT, "smallint": t.SHORT,
+        "int": t.INT, "integer": t.INT,
+        "long": t.LONG, "bigint": t.LONG,
+        "float": t.FLOAT, "double": t.DOUBLE,
+        "string": t.STRING, "binary": t.BINARY,
+        "date": t.DATE, "timestamp": t.TIMESTAMP,
+    }
+    if s in simple:
+        return simple[s]
+    if s.startswith("decimal"):
+        import re
+        m = re.match(r"decimal\((\d+),\s*(\d+)\)", s)
+        if m:
+            return t.DecimalType(int(m.group(1)), int(m.group(2)))
+        return t.DecimalType(10, 0)
+    raise ValueError(f"cannot parse type {s!r}")
+
+
+def col(name: str) -> Column:
+    return Column(AttributeReference(name))
+
+
+def lit(v) -> Column:
+    return Column(Literal(v))

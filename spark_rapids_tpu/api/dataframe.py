@@ -1,0 +1,235 @@
+"""DataFrame API mirroring Spark's (the surface the reference accelerates).
+
+Builds logical plans; execution happens in TpuSession.execute via the
+planner + overrides engine.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import pyarrow as pa
+
+from ..expr.core import (Alias, AttributeReference, Expression, Literal,
+                         output_name)
+from ..plan import logical as L
+from .column import Column, col, lit
+
+
+def _to_expr(c) -> Expression:
+    if isinstance(c, Column):
+        return c.expr
+    if isinstance(c, Expression):
+        return c
+    if isinstance(c, str):
+        return AttributeReference(c)
+    return Literal(c)
+
+
+class DataFrame:
+    def __init__(self, lp: L.LogicalPlan, session):
+        self._lp = lp
+        self.session = session
+
+    # -- schema -------------------------------------------------------------
+    @property
+    def columns(self) -> List[str]:
+        return self._lp.schema()[0]
+
+    @property
+    def dtypes(self):
+        names, types = self._lp.schema()
+        return list(zip(names, [t.name for t in types]))
+
+    def __getitem__(self, name: str) -> Column:
+        return col(name)
+
+    # -- transformations ----------------------------------------------------
+    def select(self, *cols) -> "DataFrame":
+        exprs = []
+        for c in cols:
+            if isinstance(c, str) and c == "*":
+                exprs += [AttributeReference(n) for n in self.columns]
+            else:
+                exprs.append(_to_expr(c))
+        return DataFrame(L.Project(exprs, self._lp), self.session)
+
+    def with_column(self, name: str, c) -> "DataFrame":
+        exprs = [AttributeReference(n) for n in self.columns
+                 if n != name]
+        exprs.append(Alias(_to_expr(c), name))
+        return DataFrame(L.Project(exprs, self._lp), self.session)
+
+    withColumn = with_column
+
+    def filter(self, condition) -> "DataFrame":
+        return DataFrame(L.Filter(_to_expr(condition), self._lp),
+                         self.session)
+
+    where = filter
+
+    def group_by(self, *cols) -> "GroupedData":
+        return GroupedData([_to_expr(c) for c in cols], self)
+
+    groupBy = group_by
+
+    def agg(self, *aggs) -> "DataFrame":
+        return self.group_by().agg(*aggs)
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner"
+             ) -> "DataFrame":
+        how = {"leftsemi": "left_semi", "semi": "left_semi",
+               "leftanti": "left_anti", "anti": "left_anti",
+               "outer": "full", "fullouter": "full",
+               "left_outer": "left", "right_outer": "right"}.get(
+                   how.lower().replace("_", ""), how.lower())
+        cond = None
+        using = None
+        if on is not None:
+            if isinstance(on, str):
+                using = [on]
+            elif isinstance(on, (list, tuple)) and on and \
+                    isinstance(on[0], str):
+                using = list(on)
+            else:
+                cond = _to_expr(on)
+        return DataFrame(L.Join(self._lp, other._lp, how, cond, using),
+                         self.session)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(L.Union([self._lp, other._lp]), self.session)
+
+    unionAll = union
+
+    def order_by(self, *cols, ascending=True) -> "DataFrame":
+        orders = []
+        for i, c in enumerate(cols):
+            if isinstance(c, Column) and c._sort_order is not None:
+                asc, nf = c._sort_order
+                orders.append((c.expr, asc, nf))
+            else:
+                asc = ascending if isinstance(ascending, bool) \
+                    else ascending[i]
+                orders.append((_to_expr(c), asc, asc))
+        return DataFrame(L.Sort(orders, True, self._lp), self.session)
+
+    orderBy = order_by
+    sort = order_by
+
+    def sort_within_partitions(self, *cols, ascending=True) -> "DataFrame":
+        orders = [(_to_expr(c), ascending, ascending) for c in cols]
+        return DataFrame(L.Sort(orders, False, self._lp), self.session)
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(L.Limit(n, self._lp), self.session)
+
+    def distinct(self) -> "DataFrame":
+        return DataFrame(L.Distinct(self._lp), self.session)
+
+    def drop(self, *names) -> "DataFrame":
+        keep = [AttributeReference(n) for n in self.columns
+                if n not in names]
+        return DataFrame(L.Project(keep, self._lp), self.session)
+
+    def with_column_renamed(self, old: str, new: str) -> "DataFrame":
+        exprs = [Alias(AttributeReference(n), new) if n == old
+                 else AttributeReference(n) for n in self.columns]
+        return DataFrame(L.Project(exprs, self._lp), self.session)
+
+    withColumnRenamed = with_column_renamed
+
+    def repartition(self, num_partitions: int, *cols) -> "DataFrame":
+        keys = [_to_expr(c) for c in cols] or None
+        return DataFrame(L.Repartition(num_partitions, keys, self._lp),
+                         self.session)
+
+    def select_expr_window(self, *window_exprs) -> "DataFrame":
+        return DataFrame(L.Window(list(window_exprs), self._lp), self.session)
+
+    # -- actions ------------------------------------------------------------
+    def collect(self) -> pa.Table:
+        return self.session.execute(self._lp)
+
+    def to_pandas(self):
+        return self.collect().to_pandas()
+
+    toPandas = to_pandas
+
+    def count(self) -> int:
+        from .functions import count
+        res = self.agg(count(lit(1)).alias("count")).collect()
+        return res.column("count").to_pylist()[0]
+
+    def show(self, n: int = 20):
+        print(self.limit(n).collect().to_pandas().to_string())
+
+    def explain(self) -> str:
+        s = self.session.explain(self._lp)
+        print(s)
+        return s
+
+    # -- writers ------------------------------------------------------------
+    @property
+    def write(self):
+        from ..io.writer import DataFrameWriter
+        return DataFrameWriter(self)
+
+
+class GroupedData:
+    def __init__(self, grouping: List[Expression], df: DataFrame):
+        self.grouping = grouping
+        self.df = df
+
+    def agg(self, *aggs) -> DataFrame:
+        from ..expr.aggregates import AggregateExpression
+        out = []
+        for a in aggs:
+            if isinstance(a, Column):
+                e = a.expr
+                name = a._alias
+            else:
+                e = a
+                name = None
+            from ..expr.core import Alias as _Alias
+            if isinstance(e, _Alias) and isinstance(e.child,
+                                                    AggregateExpression):
+                name = e.name
+                e = e.child
+            if isinstance(e, AggregateExpression):
+                ae = e
+                if name:
+                    ae.name = name
+            else:
+                from ..expr.aggregates import AggregateFunction
+                if isinstance(e, AggregateFunction):
+                    ae = AggregateExpression(e, name)
+                else:
+                    raise TypeError(f"not an aggregate: {e}")
+            out.append(ae)
+        return DataFrame(L.Aggregate(self.grouping, out, self.df._lp),
+                         self.df.session)
+
+    def count(self) -> DataFrame:
+        from .functions import count
+        return self.agg(count(lit(1)).alias("count"))
+
+    def _simple(self, fn, cols):
+        from . import functions as F
+        names = cols or [n for n, tn in self.df.dtypes
+                         if tn in ("tinyint", "smallint", "int", "bigint",
+                                   "float", "double") or
+                         tn.startswith("decimal")]
+        return self.agg(*[getattr(F, fn)(col(n)).alias(f"{fn}({n})")
+                          for n in names])
+
+    def sum(self, *cols) -> DataFrame:
+        return self._simple("sum", list(cols))
+
+    def avg(self, *cols) -> DataFrame:
+        return self._simple("avg", list(cols))
+
+    def min(self, *cols) -> DataFrame:
+        return self._simple("min", list(cols))
+
+    def max(self, *cols) -> DataFrame:
+        return self._simple("max", list(cols))

@@ -1,0 +1,213 @@
+"""pyspark.sql.functions-style function surface."""
+
+from __future__ import annotations
+
+from ..expr import aggregates as agg
+from ..expr import arithmetic as ar
+from ..expr import conditional as cond
+from ..expr import mathexpr as mx
+from ..expr import predicates as pred
+from ..expr.cast import Cast
+from ..expr.core import Alias, AttributeReference, Expression, Literal
+from .column import Column, col, lit, _expr
+
+
+def _c(e: Expression) -> Column:
+    return Column(e)
+
+
+# -- aggregates --------------------------------------------------------------
+
+def sum(c) -> Column:  # noqa: A001
+    return _c(agg.AggregateExpression(agg.Sum(_expr(c))))
+
+
+def count(c="*") -> Column:
+    child = None if c == "*" else _expr(c)
+    return _c(agg.AggregateExpression(agg.Count(child)))
+
+
+def avg(c) -> Column:
+    return _c(agg.AggregateExpression(agg.Average(_expr(c))))
+
+
+mean = avg
+
+
+def min(c) -> Column:  # noqa: A001
+    return _c(agg.AggregateExpression(agg.Min(_expr(c))))
+
+
+def max(c) -> Column:  # noqa: A001
+    return _c(agg.AggregateExpression(agg.Max(_expr(c))))
+
+
+def first(c, ignorenulls: bool = False) -> Column:
+    return _c(agg.AggregateExpression(agg.First(_expr(c), ignorenulls)))
+
+
+def last(c, ignorenulls: bool = False) -> Column:
+    return _c(agg.AggregateExpression(agg.Last(_expr(c), ignorenulls)))
+
+
+def stddev(c) -> Column:
+    return _c(agg.AggregateExpression(agg.StddevSamp(_expr(c))))
+
+
+stddev_samp = stddev
+
+
+def stddev_pop(c) -> Column:
+    return _c(agg.AggregateExpression(agg.StddevPop(_expr(c))))
+
+
+def variance(c) -> Column:
+    return _c(agg.AggregateExpression(agg.VarianceSamp(_expr(c))))
+
+
+var_samp = variance
+
+
+def var_pop(c) -> Column:
+    return _c(agg.AggregateExpression(agg.VariancePop(_expr(c))))
+
+
+def count_distinct(*cols) -> Column:
+    from ..expr.aggregates import CountDistinct
+    return _c(agg.AggregateExpression(CountDistinct([_expr(c) for c in cols])))
+
+
+# -- scalar ------------------------------------------------------------------
+
+def abs(c) -> Column:  # noqa: A001
+    return _c(ar.Abs(_expr(c)))
+
+
+def sqrt(c) -> Column:
+    return _c(mx.Sqrt(_expr(c)))
+
+
+def exp(c) -> Column:
+    return _c(mx.Exp(_expr(c)))
+
+
+def log(c) -> Column:
+    return _c(mx.Log(_expr(c)))
+
+
+def log2(c) -> Column:
+    return _c(mx.Log2(_expr(c)))
+
+
+def log10(c) -> Column:
+    return _c(mx.Log10(_expr(c)))
+
+
+def pow(l, r) -> Column:  # noqa: A001
+    return _c(mx.Pow(_expr(l), _expr(r)))
+
+
+def floor(c) -> Column:
+    return _c(mx.Floor(_expr(c)))
+
+
+def ceil(c) -> Column:
+    return _c(mx.Ceil(_expr(c)))
+
+
+def round(c, scale: int = 0) -> Column:  # noqa: A001
+    return _c(mx.Round(_expr(c), scale))
+
+
+def bround(c, scale: int = 0) -> Column:
+    return _c(mx.BRound(_expr(c), scale))
+
+
+def signum(c) -> Column:
+    return _c(mx.Signum(_expr(c)))
+
+
+def greatest(*cols) -> Column:
+    return _c(ar.Greatest(*[_expr(c) for c in cols]))
+
+
+def least(*cols) -> Column:
+    return _c(ar.Least(*[_expr(c) for c in cols]))
+
+
+def when(condition, value) -> "CaseBuilder":
+    return CaseBuilder([(_expr(condition), _expr(value))])
+
+
+class CaseBuilder(Column):
+    def __init__(self, branches):
+        self._branches = branches
+        super().__init__(cond.CaseWhen(branches))
+
+    def when(self, condition, value) -> "CaseBuilder":
+        return CaseBuilder(self._branches + [(_expr(condition), _expr(value))])
+
+    def otherwise(self, value) -> Column:
+        return Column(cond.CaseWhen(self._branches, _expr(value)))
+
+
+def coalesce(*cols) -> Column:
+    return _c(cond.Coalesce(*[_expr(c) for c in cols]))
+
+
+def isnull(c) -> Column:
+    return _c(pred.IsNull(_expr(c)))
+
+
+def isnan(c) -> Column:
+    return _c(pred.IsNaN(_expr(c)))
+
+
+def expr_if(c, a, b) -> Column:
+    return _c(cond.If(_expr(c), _expr(a), _expr(b)))
+
+
+# strings / datetime / hash re-exported once those modules land
+def upper(c) -> Column:
+    from ..expr.strings import Upper
+    return _c(Upper(_expr(c)))
+
+
+def lower(c) -> Column:
+    from ..expr.strings import Lower
+    return _c(Lower(_expr(c)))
+
+
+def length(c) -> Column:
+    from ..expr.strings import Length
+    return _c(Length(_expr(c)))
+
+
+def substring(c, pos, length) -> Column:
+    from ..expr.strings import Substring
+    return _c(Substring(_expr(c), Literal(pos), Literal(length)))
+
+
+def concat(*cols) -> Column:
+    from ..expr.strings import Concat
+    return _c(Concat(*[_expr(c) for c in cols]))
+
+
+def year(c) -> Column:
+    from ..expr.datetime_expr import Year
+    return _c(Year(_expr(c)))
+
+
+def month(c) -> Column:
+    from ..expr.datetime_expr import Month
+    return _c(Month(_expr(c)))
+
+
+def dayofmonth(c) -> Column:
+    from ..expr.datetime_expr import DayOfMonth
+    return _c(DayOfMonth(_expr(c)))
+
+
+def hash(*cols) -> Column:  # noqa: A001
+    from ..expr.hashfns import Murmur3Hash
+    return _c(Murmur3Hash([_expr(c) for c in cols]))

@@ -1,0 +1,117 @@
+"""TpuSession: the user entry point.
+
+Plays the combined role of SparkSession + the reference's plugin bootstrap
+(ref Plugin.scala RapidsDriverPlugin/RapidsExecutorPlugin): holds config,
+initializes the device manager/semaphore/spill catalog, and drives
+logical -> physical -> overrides -> execution for DataFrame queries.
+
+With `spark.rapids.sql.enabled=false` queries run entirely on the CPU
+engine — the differential-test harness toggles exactly this key, the same
+way the reference's integration tests do.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+
+from .. import config as cfg
+from ..config import RapidsConf
+from ..exec.base import ExecContext
+from ..plan import logical as L
+from ..plan.overrides import TpuOverrides
+from ..plan.planner import plan as plan_physical
+from .dataframe import DataFrame
+
+
+class TpuSession:
+    _active: Optional["TpuSession"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, conf: Optional[Dict] = None):
+        self._conf_map = dict(conf or {})
+        self._init_runtime()
+        TpuSession._active = self
+
+    def _init_runtime(self):
+        conf = self.conf
+        if conf.get(cfg.BACKEND) == "tpu" and conf.sql_enabled:
+            from ..memory.device import DeviceManager
+            self.device_manager = DeviceManager.initialize(conf)
+        else:
+            self.device_manager = None
+
+    # -- conf ---------------------------------------------------------------
+    @property
+    def conf(self) -> RapidsConf:
+        return RapidsConf(self._conf_map)
+
+    def set_conf(self, key: str, value) -> "TpuSession":
+        self._conf_map[key] = value
+        return self
+
+    @classmethod
+    def builder(cls):
+        return _Builder()
+
+    @classmethod
+    def active(cls) -> "TpuSession":
+        if cls._active is None:
+            cls._active = TpuSession()
+        return cls._active
+
+    # -- data sources -------------------------------------------------------
+    def create_dataframe(self, data, num_partitions: int = 1) -> DataFrame:
+        if isinstance(data, pa.Table):
+            table = data
+        elif isinstance(data, pa.RecordBatch):
+            table = pa.Table.from_batches([data])
+        elif isinstance(data, dict):
+            table = pa.table(data)
+        else:
+            import pandas as pd
+            if isinstance(data, pd.DataFrame):
+                table = pa.Table.from_pandas(data, preserve_index=False)
+            else:
+                raise TypeError(f"cannot create DataFrame from {type(data)}")
+        return DataFrame(L.LocalRelation(table, num_partitions), self)
+
+    def range(self, start, end=None, step=1, num_partitions=1) -> DataFrame:
+        if end is None:
+            start, end = 0, start
+        return DataFrame(L.Range(start, end, step, num_partitions), self)
+
+    @property
+    def read(self):
+        from ..io.reader import DataFrameReader
+        return DataFrameReader(self)
+
+    # -- execution ----------------------------------------------------------
+    def execute(self, lp: L.LogicalPlan) -> pa.Table:
+        physical = plan_physical(lp, self.conf)
+        overrides = TpuOverrides(self.conf)
+        final_plan = overrides.apply(physical)
+        self.last_plan = final_plan
+        self.last_explain = overrides.last_explain
+        ctx = ExecContext(self.conf)
+        return final_plan.execute_collect(ctx)
+
+    def explain(self, lp: L.LogicalPlan) -> str:
+        physical = plan_physical(lp, self.conf)
+        overrides = TpuOverrides(self.conf)
+        final_plan = overrides.apply(physical)
+        return final_plan.tree_string() + "\n--\n" + overrides.last_explain
+
+
+class _Builder:
+    def __init__(self):
+        self._conf: Dict = {}
+
+    def config(self, key, value):
+        self._conf[key] = value
+        return self
+
+    def get_or_create(self) -> TpuSession:
+        return TpuSession(self._conf)

@@ -1,0 +1,434 @@
+"""Hash aggregate operators.
+
+Ref: sql-plugin/.../aggregate.scala (GpuHashAggregateExec / iterator mode
+pipeline at :258-275) — re-designed for TPU as sort+segment-reduce:
+
+  1. per batch: evaluate grouping keys + update inputs, encode keys as
+     order-preserving uint64 words, lax.sort (stable, multi-operand),
+     boundary-detect, segment-reduce every buffer, compact groups to the
+     front — one jitted XLA computation per (schema, capacity);
+  2. across batches: concatenate the per-batch partials and run the same
+     kernel with merge ops (the analog of tryMergeAggregatedBatches);
+  3. Final/Complete mode then evaluates result expressions over buffers.
+
+The CPU-placed aggregate (`CpuHashAggregateExec`) is an independent
+pyarrow `Table.group_by` implementation — it both serves as the fallback
+for TPU-unsupported types and gives differential tests a second engine.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import types as t
+from ..columnar.device import (DEFAULT_ROW_BUCKETS, DeviceBatch, DeviceColumn,
+                               batch_to_arrow, batch_to_device, bucket_for)
+from ..expr.aggregates import (COMPLETE, FINAL, PARTIAL, AggregateExpression,
+                               AggregateFunction, Average, Count, First, Last,
+                               Max, Min, StddevPop, StddevSamp, Sum,
+                               VariancePop, VarianceSamp)
+from ..expr.core import (ColumnValue, EvalContext, Expression,
+                         bind_expression, output_name)
+from ..ops import segmented as seg
+from ..ops.gather import gather_column
+from .base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU, Batch,
+                   Exec, ExecContext, MetricTimer)
+from .concat import concat_batches
+
+
+def _group_reduce(xp, key_cols: List[DeviceColumn],
+                  value_cols: List[DeviceColumn], ops: List[str],
+                  cap: int, live, global_agg: bool):
+    """Core sort+segment kernel.  Returns (out_key_cols, out_value_cols,
+    num_groups)."""
+    # --- sort keys ----------------------------------------------------------
+    words: List = [(~live).astype(xp.uint64)]  # padding rows sort last
+    for kc in key_cols:
+        words += seg.key_words_for_column(xp, kc, live, for_grouping=True)
+    order = seg.lexsort(xp, words, cap)
+    sorted_words = [w[order] for w in words[1:]]  # drop padding word
+    live_sorted = live[order]
+    if global_agg:
+        new_group = xp.arange(cap, dtype=np.int32) == 0
+    else:
+        new_group = seg.segment_boundaries(xp, sorted_words, live_sorted)
+    seg_ids = seg.segment_ids(xp, new_group)
+    seg_ids = xp.clip(seg_ids, 0, cap - 1)
+    num_groups = xp.sum(new_group.astype(np.int32)) if not global_agg \
+        else xp.int32(1) if xp is not np else np.int32(1)
+    slot_valid = xp.arange(cap, dtype=np.int32) < num_groups
+
+    # --- reduce buffers -----------------------------------------------------
+    out_values: List[DeviceColumn] = []
+    for vc, op in zip(value_cols, ops):
+        validity = vc.validity if vc.validity is not None else \
+            xp.ones((cap,), dtype=bool)
+        validity_sorted = validity[order] & live_sorted
+        if op == "countvalid":
+            _, cnt = seg.segment_reduce(
+                xp, "sum", xp.zeros((cap,), np.int64), seg_ids, cap,
+                validity_sorted)
+            out_values.append(DeviceColumn(
+                t.LONG, data=cnt.astype(np.int64), validity=slot_valid))
+            continue
+        if op.endswith("_any"):
+            base_op = op[:-4]
+            idx_vals = xp.arange(cap, dtype=np.int64)
+            contrib = live_sorted
+        else:
+            base_op = op
+            contrib = validity_sorted
+        if op in ("first", "last", "first_any", "last_any") or \
+                _needs_index_gather(vc.dtype):
+            pos = xp.arange(cap, dtype=np.int64)
+            which = "first" if base_op in ("first", "min") else \
+                ("last" if base_op in ("last",) else "first")
+            idx, cnt = seg.segment_reduce(xp, which, pos, seg_ids, cap,
+                                          contrib)
+            idx = idx.astype(xp.int32)
+            gathered = gather_column(
+                xp, _permuted(xp, vc, order), idx,
+                (cnt > 0) & slot_valid)
+            if op.endswith("_any"):
+                gathered = DeviceColumn(vc.dtype, data=gathered.data,
+                                        offsets=gathered.offsets,
+                                        data_hi=gathered.data_hi,
+                                        children=gathered.children,
+                                        validity=gathered.validity)
+            out_values.append(gathered)
+            continue
+        data_sorted = vc.data[order]
+        out, cnt = seg.segment_reduce(xp, base_op, data_sorted, seg_ids,
+                                      cap, contrib)
+        validity_out = (cnt > 0) & slot_valid
+        out = xp.where(validity_out, out, xp.zeros_like(out))
+        col = DeviceColumn(vc.dtype, data=out, validity=validity_out)
+        out_values.append(col)
+
+    # --- gather group key values -------------------------------------------
+    first_idx = seg.first_index_per_segment(xp, seg_ids, cap, new_group)
+    out_keys = [gather_column(xp, _permuted(xp, kc, order), first_idx,
+                              slot_valid)
+                for kc in key_cols]
+    return out_keys, out_values, num_groups
+
+
+def _permuted(xp, col: DeviceColumn, order) -> DeviceColumn:
+    all_valid = xp.ones((order.shape[0],), dtype=bool)
+    return gather_column(xp, col, order, all_valid)
+
+
+def _needs_index_gather(dtype: t.DataType) -> bool:
+    return isinstance(dtype, (t.StringType, t.BinaryType, t.StructType,
+                              t.ArrayType, t.MapType))
+
+
+class TpuHashAggregateExec(Exec):
+    """TPU hash aggregate (ref GpuHashAggregateExec, aggregate.scala:1450)."""
+
+    placement = TPU
+
+    def __init__(self, grouping: Sequence[Expression],
+                 aggregates: Sequence[AggregateExpression],
+                 mode: str, child: Exec):
+        super().__init__([child])
+        self.grouping = list(grouping)
+        from ..expr.aggregates import bind_aggregate
+        if mode in (PARTIAL, COMPLETE):
+            self.aggregates = [bind_aggregate(a, child.output_names,
+                                              child.output_types)
+                               for a in aggregates]
+        else:
+            self.aggregates = list(aggregates)  # FINAL: pre-bound by caller
+        self.mode = mode
+        self._setup()
+
+    def _setup(self):
+        child = self.children[0]
+        cn, ct = child.output_names, child.output_types
+        self._group_names = [output_name(g) for g in self.grouping]
+        if self.mode in (PARTIAL, COMPLETE):
+            self._bound_grouping = [bind_expression(g, cn, ct)
+                                    for g in self.grouping]
+            self._update_inputs = []
+            self._update_ops = []
+            for ae in self.aggregates:
+                for expr, op in ae.func.update():
+                    self._update_inputs.append(bind_expression(expr, cn, ct))
+                    self._update_ops.append(op)
+        if self.mode == FINAL:
+            # child layout: group cols then buffers in declaration order
+            k = len(self.grouping)
+            self._buffer_ordinals = list(range(k, len(cn)))
+        self._buffer_names = []
+        self._buffer_types = []
+        for i, ae in enumerate(self.aggregates):
+            for j, bt in enumerate(ae.func.buffer_types()):
+                self._buffer_names.append(f"buf{i}_{j}")
+                self._buffer_types.append(bt)
+        self._merge_ops = []
+        for ae in self.aggregates:
+            self._merge_ops += ae.func.merge_ops()
+
+    @property
+    def output_names(self):
+        if self.mode == PARTIAL:
+            return self._group_names + self._buffer_names
+        return self._group_names + [ae.name for ae in self.aggregates]
+
+    @property
+    def output_types(self):
+        if self.mode == PARTIAL:
+            gt = [g.data_type() for g in
+                  (self._bound_grouping if self.mode in (PARTIAL, COMPLETE)
+                   else [])]
+            return gt + self._buffer_types
+        if self.mode == COMPLETE:
+            gt = [g.data_type() for g in self._bound_grouping]
+        else:
+            gt = self.children[0].output_types[:len(self.grouping)]
+        return gt + [ae.data_type() for ae in self.aggregates]
+
+    def describe(self):
+        return (f"HashAggregate(mode={self.mode}, keys="
+                f"[{', '.join(self._group_names)}], fns="
+                f"[{', '.join(a.name for a in self.aggregates)}])")
+
+    # --- device kernels -----------------------------------------------------
+    def _update_batch(self, xp, batch: Batch) -> Batch:
+        ctx = EvalContext(xp, batch)
+        live = ctx.row_mask()
+        key_cols = [g.eval(ctx).col for g in self._bound_grouping]
+        val_cols = []
+        for b, op in zip(self._update_inputs, self._update_ops):
+            v = b.eval(ctx)
+            if not isinstance(v, ColumnValue):
+                from ..expr.core import make_column
+                v = make_column(ctx, b.data_type(), v.value if v.value
+                                is not None else 0,
+                                None if v.value is not None else False)
+            val_cols.append(v.col)
+        ok, ov, n = _group_reduce(xp, key_cols, val_cols, self._update_ops,
+                                  batch.capacity, live,
+                                  global_agg=not self.grouping)
+        return DeviceBatch(ok + ov, n, self._group_names + self._buffer_names)
+
+    def _merge_batch(self, xp, batch: Batch) -> Batch:
+        k = len(self.grouping)
+        live = xp.arange(batch.capacity, dtype=np.int32) < batch.num_rows
+        key_cols = list(batch.columns[:k])
+        val_cols = list(batch.columns[k:])
+        ok, ov, n = _group_reduce(xp, key_cols, val_cols, self._merge_ops,
+                                  batch.capacity, live,
+                                  global_agg=not self.grouping)
+        return DeviceBatch(ok + ov, n, self._group_names + self._buffer_names)
+
+    def _evaluate_batch(self, xp, batch: Batch) -> Batch:
+        """buffers -> final results (Final/Complete modes)."""
+        k = len(self.grouping)
+        ctx = EvalContext(xp, batch)
+        out_cols = list(batch.columns[:k])
+        pos = k
+        for ae in self.aggregates:
+            nb = len(ae.func.buffer_types())
+            bufs = [ColumnValue(batch.columns[pos + j]) for j in range(nb)]
+            res = ae.func.evaluate(ctx, bufs)
+            out_cols.append(res.col)
+            pos += nb
+        return DeviceBatch(out_cols, batch.num_rows, self.output_names)
+
+    @functools.cached_property
+    def _jit_update(self):
+        return jax.jit(lambda b: self._update_batch(jnp, b))
+
+    @functools.cached_property
+    def _jit_merge(self):
+        return jax.jit(lambda b: self._merge_batch(jnp, b))
+
+    @functools.cached_property
+    def _jit_merge_eval(self):
+        return jax.jit(lambda b: self._evaluate_batch(jnp, self._merge_batch(jnp, b)))
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        xp = self.xp
+        on_tpu = self.placement == TPU
+        partials: List[Batch] = []
+        schema_names = self._group_names + self._buffer_names
+        kt = ([g.data_type() for g in self._bound_grouping]
+              if self.mode in (PARTIAL, COMPLETE)
+              else self.children[0].output_types[:len(self.grouping)])
+        schema_types = kt + self._buffer_types
+        for b in self.children[0].execute_partition(pid, ctx):
+            with MetricTimer(self.metrics[OP_TIME]):
+                if self.mode in (PARTIAL, COMPLETE):
+                    out = self._jit_update(b) if on_tpu else \
+                        self._update_batch(np, b)
+                else:
+                    out = b  # FINAL: merge happens below
+            partials.append(out)
+        if not partials:
+            if self.grouping:
+                return
+            # global aggregate over empty input still yields one row
+            from ..columnar.interop import to_arrow_schema
+            empty = to_arrow_schema(
+                self.children[0].output_names,
+                self.children[0].output_types).empty_table()
+            rb = (empty.to_batches() or
+                  [pa.RecordBatch.from_pydict(
+                      {n: pa.array([], type=f.type)
+                       for n, f in zip(empty.schema.names, empty.schema)})])
+            eb = batch_to_device(rb[0], xp=xp)
+            partials = [self._jit_update(eb) if on_tpu
+                        else self._update_batch(np, eb)]
+        with MetricTimer(self.metrics[OP_TIME]):
+            if len(partials) == 1:
+                merged_in = partials[0]
+            else:
+                merged_in = concat_batches(xp, partials, schema_names,
+                                           schema_types)
+            if self.mode == PARTIAL:
+                out = self._jit_merge(merged_in) if on_tpu else \
+                    self._merge_batch(np, merged_in)
+            else:
+                out = self._jit_merge_eval(merged_in) if on_tpu else \
+                    self._evaluate_batch(np, self._merge_batch(np, merged_in))
+        self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+        self.metrics[NUM_OUTPUT_BATCHES] += 1
+        yield out
+
+
+# ---------------------------------------------------------------------------
+# CPU fallback aggregate: independent pyarrow implementation
+# ---------------------------------------------------------------------------
+
+_PA_AGG = {
+    Sum: "sum", Count: "count", Average: "mean", Min: "min", Max: "max",
+    First: "first", Last: "last", StddevSamp: "stddev", StddevPop: "stddev",
+    VarianceSamp: "variance", VariancePop: "variance",
+}
+
+
+class CpuHashAggregateExec(Exec):
+    """Complete-mode aggregate on pyarrow (the 'Spark CPU' role)."""
+
+    def __init__(self, grouping: Sequence[Expression],
+                 aggregates: Sequence[AggregateExpression], child: Exec):
+        super().__init__([child])
+        self.grouping = list(grouping)
+        cn, ct = child.output_names, child.output_types
+        from ..expr.aggregates import bind_aggregate
+        self.aggregates = [bind_aggregate(a, cn, ct) for a in aggregates]
+        self._bound_grouping = [bind_expression(g, cn, ct) for g in grouping]
+        self._group_names = [output_name(g) for g in grouping]
+
+    @property
+    def output_names(self):
+        return self._group_names + [a.name for a in self.aggregates]
+
+    @property
+    def output_types(self):
+        return [g.data_type() for g in self._bound_grouping] + \
+            [a.data_type() for a in self.aggregates]
+
+    def describe(self):
+        return (f"CpuHashAggregate(keys=[{', '.join(self._group_names)}], "
+                f"fns=[{', '.join(a.name for a in self.aggregates)}])")
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        from ..expr.core import EvalContext as EC
+        from ..columnar.interop import to_arrow_type
+        child = self.children[0]
+        tables = []
+        for b in child.execute_partition(pid, ctx):
+            # evaluate grouping + agg input expressions on host, then arrow
+            ec = EC(np, b)
+            cols = {}
+            for g, nm in zip(self._bound_grouping, self._group_names):
+                from ..columnar.device import column_to_arrow
+                v = g.eval(ec)
+                cols[nm] = column_to_arrow(v.col, int(b.num_rows))
+            for i, ae in enumerate(self.aggregates):
+                fn = ae.func
+                if fn.children:
+                    bexpr = bind_expression(fn.child, child.output_names,
+                                            child.output_types)
+                    v = bexpr.eval(ec)
+                    from ..expr.core import ScalarValue, make_column
+                    if isinstance(v, ScalarValue):
+                        v = make_column(ec, bexpr.data_type(),
+                                        v.value if v.value is not None else 0,
+                                        None if v.value is not None else False)
+                    from ..columnar.device import column_to_arrow
+                    cols[f"__in{i}"] = column_to_arrow(v.col, int(b.num_rows))
+                else:
+                    cols[f"__in{i}"] = pa.array([1] * int(b.num_rows),
+                                                type=pa.int64())
+            tables.append(pa.table(cols))
+        if not tables:
+            if self.grouping:
+                return
+            tables = [pa.table({nm: pa.array([], to_arrow_type(dt))
+                                for nm, dt in
+                                zip(self._group_names +
+                                    [f"__in{i}" for i in
+                                     range(len(self.aggregates))],
+                                    [g.data_type() for g in
+                                     self._bound_grouping] +
+                                    [a.func.child.data_type() if
+                                     a.func.children else t.INT
+                                     for a in self.aggregates])})]
+        table = pa.concat_tables(tables)
+        aggs = []
+        for i, ae in enumerate(self.aggregates):
+            kind = _PA_AGG[type(ae.func)]
+            opts = None
+            if kind in ("stddev", "variance"):
+                ddof = 0 if isinstance(ae.func, (StddevPop, VariancePop)) else 1
+                opts = pc.VarianceOptions(ddof=ddof)
+            if kind in ("first", "last"):
+                opts = pc.ScalarAggregateOptions(
+                    skip_nulls=ae.func.ignore_nulls)
+            aggs.append((f"__in{i}", kind, opts))
+        if self.grouping:
+            res = pa.TableGroupBy(table, self._group_names,
+                                  use_threads=False).aggregate(aggs)
+        elif table.num_rows == 0:
+            # Spark: a global aggregate over empty input yields one row
+            cols = {}
+            for (cname, kind, opts) in aggs:
+                fn = {"sum": pc.sum, "count": pc.count, "mean": pc.mean,
+                      "min": pc.min, "max": pc.max,
+                      "stddev": pc.stddev, "variance": pc.variance,
+                      "first": pc.first, "last": pc.last}[kind]
+                scalar = fn(table.column(cname))
+                cols[f"{cname}_{kind}"] = pa.array([scalar.as_py()],
+                                                   type=scalar.type)
+            res = pa.table(cols)
+        else:
+            res = pa.TableGroupBy(
+                table.append_column("__g", pa.array([1] * table.num_rows)),
+                ["__g"], use_threads=False).aggregate(aggs)
+            res = res.drop_columns(["__g"])
+        # rename/cast to declared output schema
+        out_cols = []
+        for nm in self._group_names:
+            out_cols.append(res.column(nm))
+        for i, ae in enumerate(self.aggregates):
+            kind = _PA_AGG[type(ae.func)]
+            cname = f"__in{i}_{kind}"
+            col = res.column(cname)
+            col = col.cast(to_arrow_type(ae.data_type()))
+            out_cols.append(col)
+        out = pa.table(dict(zip(self.output_names, out_cols)))
+        for rb in out.combine_chunks().to_batches():
+            yield batch_to_device(rb, xp=np)
+        if out.num_rows == 0 and not self.grouping:
+            pass

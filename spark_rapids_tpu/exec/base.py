@@ -1,0 +1,232 @@
+"""Physical execution layer: operator base classes, metrics, transitions.
+
+TPU-native analog of the reference's GpuExec contract
+(ref: sql-plugin/.../GpuExec.scala:196 `doExecuteColumnar(): RDD[ColumnarBatch]`).
+
+Execution model: a physical plan is a tree of `Exec` nodes.  Each node
+declares a placement (TPU or CPU) decided by the overrides engine
+(plan/overrides.py).  Data flows as iterators of batches per partition:
+
+  * TPU-placed nodes stream `DeviceBatch` (JAX arrays, bucketed capacity);
+    their compute is jit-compiled once per (schema, capacity) signature.
+  * CPU-placed nodes stream the same batch structure backed by numpy —
+    the CPU fallback engine runs identical operator semantics through the
+    shared xp-parameterized kernels (playing the role Spark's own row/
+    columnar operators play for the reference).
+  * `HostToDeviceExec` / `DeviceToHostExec` transitions are inserted by the
+    rewrite engine exactly like GpuRowToColumnarExec/GpuColumnarToRowExec
+    (ref GpuTransitionOverrides.scala:48).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import types as t
+from ..columnar.device import DeviceBatch, DeviceColumn, batch_to_arrow, batch_to_device
+from ..config import RapidsConf
+
+Batch = DeviceBatch  # alias: same structure on both engines
+
+
+class Metric:
+    """Operator metric (ref GpuMetric / GpuExec.scala:45-104)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def add(self, v):
+        self.value += v
+
+    def __iadd__(self, v):
+        self.value += v
+        return self
+
+
+class MetricTimer:
+    def __init__(self, metric: Metric):
+        self.metric = metric
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self.metric.add(time.perf_counter_ns() - self._t0)
+
+
+class ExecContext:
+    """Per-query execution context: conf + memory/semaphore hooks."""
+
+    def __init__(self, conf: Optional[RapidsConf] = None):
+        self.conf = conf or RapidsConf()
+        self.task_context: Dict = {}
+
+    @property
+    def capacity_buckets(self):
+        return self.conf.capacity_buckets
+
+
+CPU = "cpu"
+TPU = "tpu"
+
+# standard metric names (ref GpuExec.scala:45-104)
+NUM_OUTPUT_ROWS = "numOutputRows"
+NUM_OUTPUT_BATCHES = "numOutputBatches"
+OP_TIME = "opTime"
+
+
+class Exec:
+    """Base physical operator."""
+
+    placement = CPU
+
+    def __init__(self, children: Sequence["Exec"]):
+        self.children: List[Exec] = list(children)
+        self.metrics: Dict[str, Metric] = {}
+        for m in (NUM_OUTPUT_ROWS, NUM_OUTPUT_BATCHES, OP_TIME):
+            self.metrics[m] = Metric(m)
+
+    # -- schema -------------------------------------------------------------
+    @property
+    def output_names(self) -> List[str]:
+        raise NotImplementedError
+
+    @property
+    def output_types(self) -> List[t.DataType]:
+        raise NotImplementedError
+
+    # -- partitioning --------------------------------------------------------
+    @property
+    def num_partitions(self) -> int:
+        if self.children:
+            return self.children[0].num_partitions
+        return 1
+
+    # -- execution -----------------------------------------------------------
+    def execute_partition(self, pid: int, ctx: ExecContext) -> Iterator[Batch]:
+        """Produce batches for one partition.  Buffers are jnp arrays when
+        self.placement == TPU, numpy arrays when CPU."""
+        raise NotImplementedError
+
+    def execute_collect(self, ctx: ExecContext) -> pa.Table:
+        """Run all partitions and collect to an Arrow table (driver side)."""
+        out: List[pa.RecordBatch] = []
+        for pid in range(self.num_partitions):
+            for b in self.execute_partition(pid, ctx):
+                rb = to_host_batch(b, self.output_names)
+                if rb.num_rows:
+                    out.append(rb)
+        from ..columnar.interop import to_arrow_schema
+        schema = to_arrow_schema(self.output_names, self.output_types)
+        if not out:
+            return schema.empty_table()
+        return pa.Table.from_batches([b.cast(schema) for b in out])
+
+    # -- display ------------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, level: int = 0) -> str:
+        pad = "  " * level
+        mark = "*" if self.placement == TPU else " "
+        lines = [f"{pad}{mark}{self.describe()}"]
+        for c in self.children:
+            lines.append(c.tree_string(level + 1))
+        return "\n".join(lines)
+
+    def describe(self) -> str:
+        return self.name
+
+    def with_new_children(self, children: Sequence["Exec"]) -> "Exec":
+        import copy
+        c = copy.copy(self)
+        c.children = list(children)
+        c.metrics = {k: Metric(k) for k in self.metrics}
+        return c
+
+    def transform_up(self, fn):
+        node = self
+        new_children = [c.transform_up(fn) for c in self.children]
+        if any(a is not b for a, b in zip(new_children, node.children)):
+            node = node.with_new_children(new_children)
+        return fn(node)
+
+    def foreach(self, fn):
+        fn(self)
+        for c in self.children:
+            c.foreach(fn)
+
+    @property
+    def xp(self):
+        return jnp if self.placement == TPU else np
+
+
+def to_host_batch(b: Batch, names: Sequence[str]) -> pa.RecordBatch:
+    """Device/host batch -> Arrow."""
+    nb = DeviceBatch(b.columns, b.num_rows, names)
+    return batch_to_arrow(nb)
+
+
+# ---------------------------------------------------------------------------
+# Transitions (ref GpuRowToColumnarExec / GpuColumnarToRowExec)
+# ---------------------------------------------------------------------------
+
+def _to_numpy_leaf(x):
+    return np.asarray(x)
+
+
+class HostToDeviceExec(Exec):
+    """Move a CPU child's batches onto the TPU (analog of
+    GpuRowToColumnarExec + HostColumnarToGpu, ref GpuRowToColumnarExec.scala:830)."""
+
+    placement = TPU
+
+    def __init__(self, child: Exec):
+        super().__init__([child])
+
+    @property
+    def output_names(self):
+        return self.children[0].output_names
+
+    @property
+    def output_types(self):
+        return self.children[0].output_types
+
+    def execute_partition(self, pid, ctx):
+        for b in self.children[0].execute_partition(pid, ctx):
+            with MetricTimer(self.metrics[OP_TIME]):
+                yield jax.tree_util.tree_map(jnp.asarray, b)
+
+
+class DeviceToHostExec(Exec):
+    """Bring TPU batches back to host numpy (analog of GpuColumnarToRowExec,
+    ref GpuColumnarToRowExec.scala:358)."""
+
+    placement = CPU
+
+    def __init__(self, child: Exec):
+        super().__init__([child])
+
+    @property
+    def output_names(self):
+        return self.children[0].output_names
+
+    @property
+    def output_types(self):
+        return self.children[0].output_types
+
+    def execute_partition(self, pid, ctx):
+        for b in self.children[0].execute_partition(pid, ctx):
+            with MetricTimer(self.metrics[OP_TIME]):
+                yield jax.tree_util.tree_map(_to_numpy_leaf, b)

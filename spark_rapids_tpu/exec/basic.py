@@ -1,0 +1,334 @@
+"""Basic physical operators: scan, project, filter, range, union, limits,
+sample, coalesce-batches.
+
+Ref: sql-plugin/.../basicPhysicalOperators.scala:140-592 (GpuProjectExec,
+GpuFilterExec, GpuRangeExec, GpuUnionExec), limit.scala, GpuCoalesceBatches.
+
+TPU realization: Project/Filter trace their whole expression tree into one
+jitted function per (schema, capacity) signature — XLA fuses every
+elementwise op into a handful of kernels, where the reference pays one JNI
+kernel launch per expression node.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import types as t
+from ..columnar.device import (DEFAULT_ROW_BUCKETS, DeviceBatch, DeviceColumn,
+                               batch_to_device, bucket_for)
+from ..expr.core import (EvalContext, Expression, bind_expression,
+                         output_name)
+from ..ops.gather import gather_batch
+from .base import (CPU, NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU,
+                   Batch, Exec, ExecContext, MetricTimer)
+
+
+class LocalScanExec(Exec):
+    """Scan over in-memory Arrow data split into partitions
+    (analog of Spark's LocalTableScanExec feeding the plugin)."""
+
+    def __init__(self, table: pa.Table, num_partitions: int = 1,
+                 batch_rows: Optional[int] = None):
+        super().__init__([])
+        self.table = table
+        self._names = list(table.schema.names)
+        from ..columnar.interop import from_arrow_type
+        self._types = [from_arrow_type(f.type) for f in table.schema]
+        self._num_partitions = max(1, num_partitions)
+        self.batch_rows = batch_rows
+
+    @property
+    def output_names(self):
+        return self._names
+
+    @property
+    def output_types(self):
+        return self._types
+
+    @property
+    def num_partitions(self):
+        return self._num_partitions
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        n = self.table.num_rows
+        per = -(-n // self._num_partitions)
+        start = min(pid * per, n)
+        length = min(per, n - start)
+        chunk = self.table.slice(start, length)
+        rows = self.batch_rows or max(length, 1)
+        xp = self.xp
+        offset = 0
+        combined = chunk.combine_chunks()
+        while offset < max(length, 1):
+            piece = combined.slice(offset, min(rows, length - offset))
+            rb = piece.to_batches()
+            if rb:
+                b = batch_to_device(pa.Table.from_batches(rb).combine_chunks()
+                                    .to_batches()[0], xp=xp)
+            else:
+                b = batch_to_device(
+                    pa.RecordBatch.from_pydict(
+                        {n_: pa.array([], type=f.type)
+                         for n_, f in zip(self._names, self.table.schema)}),
+                    xp=xp)
+            self.metrics[NUM_OUTPUT_ROWS] += int(b.num_rows)
+            self.metrics[NUM_OUTPUT_BATCHES] += 1
+            yield b
+            offset += rows
+            if length == 0:
+                break
+
+
+class ProjectExec(Exec):
+    """Columnar projection (ref GpuProjectExec, basicPhysicalOperators.scala:140)."""
+
+    def __init__(self, exprs: Sequence[Expression], child: Exec):
+        super().__init__([child])
+        self.exprs = list(exprs)
+        self._bound = [bind_expression(e, child.output_names,
+                                       child.output_types)
+                       for e in self.exprs]
+
+    @property
+    def output_names(self):
+        return [output_name(e) for e in self.exprs]
+
+    @property
+    def output_types(self):
+        return [b.data_type() for b in self._bound]
+
+    def describe(self):
+        return f"Project [{', '.join(e.sql() for e in self.exprs)}]"
+
+    def _compute(self, xp, batch: Batch) -> Batch:
+        ctx = EvalContext(xp, batch)
+        cols = []
+        for b in self._bound:
+            v = b.eval(ctx)
+            from ..expr.core import ColumnValue, ScalarValue
+            if isinstance(v, ScalarValue):
+                from ..expr.core import make_column
+                v = make_column(ctx, b.data_type() if not isinstance(
+                    b.data_type(), t.NullType) else t.NULL,
+                    v.value if v.value is not None else 0,
+                    None if v.value is not None else False)
+            cols.append(v.col)
+        return DeviceBatch(cols, batch.num_rows, self.output_names)
+
+    @functools.cached_property
+    def _jitted(self):
+        return jax.jit(lambda b: self._compute(jnp, b))
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        xp = self.xp
+        for b in self.children[0].execute_partition(pid, ctx):
+            with MetricTimer(self.metrics[OP_TIME]):
+                out = self._jitted(b) if self.placement == TPU \
+                    else self._compute(np, b)
+            self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+            self.metrics[NUM_OUTPUT_BATCHES] += 1
+            yield out
+
+
+class FilterExec(Exec):
+    """Columnar filter with device-side compaction
+    (ref GpuFilterExec, basicPhysicalOperators.scala:220).
+
+    Compaction keeps static shapes: a stable argsort on the keep flag moves
+    surviving rows to the front; num_rows shrinks to the survivor count."""
+
+    def __init__(self, condition: Expression, child: Exec):
+        super().__init__([child])
+        self.condition = condition
+        self._bound = bind_expression(condition, child.output_names,
+                                      child.output_types)
+
+    @property
+    def output_names(self):
+        return self.children[0].output_names
+
+    @property
+    def output_types(self):
+        return self.children[0].output_types
+
+    def describe(self):
+        return f"Filter [{self.condition.sql()}]"
+
+    def _compute(self, xp, batch: Batch) -> Batch:
+        ctx = EvalContext(xp, batch)
+        pred = self._bound.eval(ctx)
+        from .filter_common import apply_filter
+        return apply_filter(xp, batch, pred, self.output_names)
+
+    @functools.cached_property
+    def _jitted(self):
+        return jax.jit(lambda b: self._compute(jnp, b))
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        for b in self.children[0].execute_partition(pid, ctx):
+            with MetricTimer(self.metrics[OP_TIME]):
+                out = self._jitted(b) if self.placement == TPU \
+                    else self._compute(np, b)
+            self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+            self.metrics[NUM_OUTPUT_BATCHES] += 1
+            yield out
+
+
+class RangeExec(Exec):
+    """range(start, end, step) table generator (ref GpuRangeExec)."""
+
+    def __init__(self, start: int, end: int, step: int = 1,
+                 num_partitions: int = 1, name: str = "id",
+                 max_batch_rows: int = 1 << 20):
+        super().__init__([])
+        assert step != 0
+        self.start, self.end, self.step = start, end, step
+        self._name = name
+        self._num_partitions = num_partitions
+        self.max_batch_rows = max_batch_rows
+
+    @property
+    def output_names(self):
+        return [self._name]
+
+    @property
+    def output_types(self):
+        return [t.LONG]
+
+    @property
+    def num_partitions(self):
+        return self._num_partitions
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        xp = self.xp
+        total = max(0, -(-(self.end - self.start) // self.step))
+        per = -(-total // self._num_partitions)
+        lo = min(pid * per, total)
+        hi = min(lo + per, total)
+        i = lo
+        while i < hi:
+            n = min(self.max_batch_rows, hi - i)
+            cap = bucket_for(n, DEFAULT_ROW_BUCKETS)
+            vals = (xp.arange(cap, dtype=xp.int64) + np.int64(i)) * \
+                np.int64(self.step) + np.int64(self.start)
+            col = DeviceColumn(t.LONG, data=vals,
+                               validity=xp.arange(cap) < n)
+            b = DeviceBatch([col], n, [self._name])
+            self.metrics[NUM_OUTPUT_ROWS] += n
+            self.metrics[NUM_OUTPUT_BATCHES] += 1
+            yield b
+            i += n
+        if lo >= hi:
+            return
+
+
+class UnionExec(Exec):
+    """Concatenation of children's partitions (ref GpuUnionExec)."""
+
+    def __init__(self, children: Sequence[Exec]):
+        super().__init__(children)
+
+    @property
+    def output_names(self):
+        return self.children[0].output_names
+
+    @property
+    def output_types(self):
+        return self.children[0].output_types
+
+    @property
+    def num_partitions(self):
+        return sum(c.num_partitions for c in self.children)
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        for c in self.children:
+            if pid < c.num_partitions:
+                yield from c.execute_partition(pid, ctx)
+                return
+            pid -= c.num_partitions
+
+
+class LocalLimitExec(Exec):
+    """Per-partition limit (ref limit.scala GpuLocalLimitExec)."""
+
+    def __init__(self, limit: int, child: Exec):
+        super().__init__([child])
+        self.limit = limit
+
+    @property
+    def output_names(self):
+        return self.children[0].output_names
+
+    @property
+    def output_types(self):
+        return self.children[0].output_types
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        remaining = self.limit
+        xp = self.xp
+        for b in self.children[0].execute_partition(pid, ctx):
+            n = int(b.num_rows)
+            take = min(n, remaining)
+            if take < n:
+                mask = xp.arange(b.capacity) < take
+                cols = [DeviceColumn(c.dtype, data=c.data,
+                                     validity=(c.validity & mask)
+                                     if c.validity is not None else mask,
+                                     offsets=c.offsets, data_hi=c.data_hi,
+                                     children=c.children)
+                        for c in b.columns]
+                b = DeviceBatch(cols, take, b.names)
+            remaining -= take
+            yield b
+            if remaining <= 0:
+                return
+
+
+class GlobalLimitExec(LocalLimitExec):
+    """Whole-result limit; planner ensures single partition upstream."""
+
+
+class CoalesceBatchesExec(Exec):
+    """Concatenate small batches up to a target size goal
+    (ref GpuCoalesceBatches.scala:519, CoalesceGoal)."""
+
+    def __init__(self, child: Exec, target_rows: Optional[int] = None,
+                 require_single_batch: bool = False):
+        super().__init__([child])
+        self.target_rows = target_rows
+        self.require_single_batch = require_single_batch
+
+    @property
+    def output_names(self):
+        return self.children[0].output_names
+
+    @property
+    def output_types(self):
+        return self.children[0].output_types
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        from .concat import concat_batches
+        xp = self.xp
+        pending: List[Batch] = []
+        pending_rows = 0
+        target = self.target_rows or (1 << 22)
+        for b in self.children[0].execute_partition(pid, ctx):
+            n = int(b.num_rows)
+            if n == 0:
+                continue
+            pending.append(b)
+            pending_rows += n
+            if not self.require_single_batch and pending_rows >= target:
+                yield concat_batches(xp, pending, self.output_names,
+                                     self.output_types)
+                pending, pending_rows = [], 0
+        if pending:
+            yield concat_batches(xp, pending, self.output_names,
+                                 self.output_types)

@@ -1,0 +1,119 @@
+"""Device batch concatenation (ref GpuCoalesceBatches concat path and
+cudf Table.concatenate usage).
+
+Concatenates batches by gathering from a stacked buffer: the output
+capacity is the bucket covering the total row count.  Variable-length
+columns re-pack char/child buffers.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from .. import types as t
+from ..columnar.device import (DEFAULT_CHAR_BUCKETS, DEFAULT_ROW_BUCKETS,
+                               DeviceBatch, DeviceColumn, bucket_for)
+
+
+def _concat_flat(xp, arrays, cap, fill_dtype):
+    total = sum(int(a.shape[0]) for a in arrays)
+    joined = xp.concatenate(arrays)
+    if total == cap:
+        return joined
+    if total > cap:
+        return joined[:cap]
+    pad = xp.zeros((cap - total,), dtype=joined.dtype)
+    return xp.concatenate([joined, pad])
+
+
+def concat_columns(xp, cols: Sequence[DeviceColumn], counts, cap: int,
+                   dtype: t.DataType) -> DeviceColumn:
+    """Concatenate column segments where cols[i] contributes its first
+    counts[i] rows.  `counts` are python ints (host-known batch sizes)."""
+    validity_parts = []
+    for c, n in zip(cols, counts):
+        v = c.validity if c.validity is not None else \
+            xp.ones((c.capacity,), dtype=bool)
+        validity_parts.append(v[:n] if xp is np else
+                              _take_prefix(xp, v, n, c.capacity))
+    validity = _concat_flat(xp, validity_parts, cap, bool)
+
+    if isinstance(dtype, (t.StringType, t.BinaryType)):
+        offs_parts = []
+        chars_parts = []
+        base = 0
+        for c, n in zip(cols, counts):
+            o = c.offsets
+            nb = int(o[n]) if xp is np else int(np.asarray(o)[n])
+            offs_parts.append((o[:n] if xp is np else o[:n]) + np.int32(base))
+            chars_parts.append(c.data[:nb])
+            base += nb
+        last = np.int32(base)
+        total_rows = sum(counts)
+        offs = xp.concatenate(
+            offs_parts + [xp.full((cap + 1 - total_rows,), last, xp.int32)])
+        char_cap = bucket_for(max(base, 1), DEFAULT_CHAR_BUCKETS)
+        chars = _concat_flat(xp, chars_parts, char_cap, np.uint8)
+        return DeviceColumn(dtype, data=chars, offsets=offs,
+                            validity=validity)
+
+    if isinstance(dtype, t.StructType):
+        children = tuple(
+            concat_columns(xp, [c.children[i] for c in cols], counts, cap,
+                           f.data_type)
+            for i, f in enumerate(dtype.fields))
+        return DeviceColumn(dtype, validity=validity, children=children)
+
+    if isinstance(dtype, t.ArrayType):
+        offs_parts = []
+        base = 0
+        child_cols = []
+        child_counts = []
+        for c, n in zip(cols, counts):
+            o = c.offsets
+            nb = int(np.asarray(o)[n])
+            offs_parts.append(o[:n] + np.int32(base))
+            child_cols.append(c.children[0])
+            child_counts.append(nb)
+            base += nb
+        last = np.int32(base)
+        total_rows = sum(counts)
+        offs = xp.concatenate(
+            offs_parts + [xp.full((cap + 1 - total_rows,), last, xp.int32)])
+        child_cap = bucket_for(max(base, 1), DEFAULT_ROW_BUCKETS)
+        child = concat_columns(xp, child_cols, child_counts, child_cap,
+                               dtype.element_type)
+        return DeviceColumn(dtype, offsets=offs, validity=validity,
+                            children=(child,))
+
+    data_parts = [c.data[:n] for c, n in zip(cols, counts)]
+    data = _concat_flat(xp, data_parts, cap, None)
+    out = DeviceColumn(dtype, data=data, validity=validity)
+    if cols[0].data_hi is not None:
+        hi_parts = [c.data_hi[:n] for c, n in zip(cols, counts)]
+        out.data_hi = _concat_flat(xp, hi_parts, cap, None)
+    return out
+
+
+def _take_prefix(xp, arr, n, cap):
+    return arr[:n]
+
+
+def concat_batches(xp, batches: List[DeviceBatch], names, dtypes
+                   ) -> DeviceBatch:
+    """Concatenate host-length-known batches into one bucketed batch.
+
+    Note: this runs outside jit (batch row counts must be host ints), which
+    is fine — coalescing happens at iterator boundaries, like the
+    reference's host-side concatenation decisions.
+    """
+    counts = [int(b.num_rows) for b in batches]
+    total = sum(counts)
+    cap = bucket_for(max(total, 1), DEFAULT_ROW_BUCKETS)
+    cols = []
+    for i, dt in enumerate(dtypes):
+        cols.append(concat_columns(xp, [b.columns[i] for b in batches],
+                                   counts, cap, dt))
+    return DeviceBatch(cols, total, names)

@@ -1,0 +1,48 @@
+"""Filter compaction shared by FilterExec / conditional joins / having.
+
+Static-shape compaction: stable-sort rows on the (negated) keep flag so
+survivors move to the front in original order, then gather every column.
+One lax.sort + gathers — no dynamic shapes, no host sync.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..columnar.device import DeviceBatch
+from ..ops.gather import gather_batch
+
+
+def keep_flags(xp, batch: DeviceBatch, pred_value):
+    """bool[cap] from a predicate value (null -> drop, Spark)."""
+    live = xp.arange(batch.capacity, dtype=np.int32) < batch.num_rows
+    from ..expr.core import ScalarValue
+    if isinstance(pred_value, ScalarValue):
+        if pred_value.value is None or not bool(pred_value.value):
+            return xp.zeros((batch.capacity,), dtype=bool)
+        return live
+    col = pred_value.col
+    keep = col.data.astype(bool)
+    if col.validity is not None:
+        keep = keep & col.validity
+    return keep & live
+
+
+def compact(xp, batch: DeviceBatch, keep, names):
+    """Move kept rows to the front (stable), shrink num_rows."""
+    cap = batch.capacity
+    if xp is np:
+        order = np.argsort(~keep, kind="stable").astype(np.int32)
+    else:
+        from jax import lax
+        iota = xp.arange(cap, dtype=xp.int32)
+        order = lax.sort(((~keep).astype(xp.int32), iota), num_keys=1,
+                         is_stable=True)[1]
+    new_n = xp.sum(keep.astype(np.int32))
+    valid_slot = xp.arange(cap, dtype=np.int32) < new_n
+    out = gather_batch(xp, batch, order, valid_slot, new_n)
+    return DeviceBatch(out.columns, new_n, names)
+
+
+def apply_filter(xp, batch: DeviceBatch, pred_value, names):
+    return compact(xp, batch, keep_flags(xp, batch, pred_value), names)

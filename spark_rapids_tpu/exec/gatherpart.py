@@ -1,0 +1,36 @@
+"""GatherPartitionsExec: funnel all child partitions into one.
+
+Stand-in exchange used where an operator needs co-located data and the
+planner has not inserted a real shuffle (analog of Spark's coalesce(1) /
+single-partition exchange).  The accelerated shuffle (shuffle/) replaces
+this in distributed plans.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from .base import Batch, Exec
+
+
+class GatherPartitionsExec(Exec):
+    def __init__(self, child: Exec):
+        super().__init__([child])
+
+    @property
+    def output_names(self):
+        return self.children[0].output_names
+
+    @property
+    def output_types(self):
+        return self.children[0].output_types
+
+    @property
+    def num_partitions(self):
+        return 1
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        assert pid == 0
+        child = self.children[0]
+        for cpid in range(child.num_partitions):
+            yield from child.execute_partition(cpid, ctx)

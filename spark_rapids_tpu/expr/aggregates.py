@@ -1,0 +1,297 @@
+"""Aggregate functions.
+
+Ref: sql-plugin/.../AggregateFunctions.scala (Sum/Count/Average/Min/Max/
+First/Last/M2-based stddev-variance/Pivot, collect_*).
+
+Model (mirrors Spark's declarative aggregates, realized as segmented
+reductions): each function declares
+  * update stage:  per-buffer (input expression, segmented op)
+  * merge stage:   per-buffer segmented op over the partial buffers
+  * evaluate:      final result expression over the merged buffers.
+
+Segmented ops: sum / min / max / first / last / countvalid (count of
+non-null rows).  Group validity comes back as a per-buffer non-null count.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .. import types as t
+from .arithmetic import _as_decimal, _decimal_binary_type, cast_data
+from .cast import Cast
+from .core import (ColumnValue, EvalContext, Expression, Literal,
+                   make_column)
+
+PARTIAL = "Partial"
+FINAL = "Final"
+COMPLETE = "Complete"
+
+
+class AggregateFunction(Expression):
+    """Base declarative aggregate."""
+
+    def __init__(self, child: Optional[Expression] = None):
+        self.children = (child,) if child is not None else ()
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    # update stage: list of (input expression over child schema, op)
+    def update(self) -> List[Tuple[Expression, str]]:
+        raise NotImplementedError
+
+    # buffer SQL types, same arity as update()
+    def buffer_types(self) -> List[t.DataType]:
+        raise NotImplementedError
+
+    # merge ops over buffers, same arity
+    def merge_ops(self) -> List[str]:
+        raise NotImplementedError
+
+    # evaluate final value from merged buffer columns
+    def evaluate(self, ctx: EvalContext, buffers: List[ColumnValue]
+                 ) -> ColumnValue:
+        raise NotImplementedError
+
+
+class Sum(AggregateFunction):
+    def data_type(self):
+        ct = self.child.data_type()
+        if isinstance(ct, t.DecimalType):
+            return t.DecimalType(min(ct.precision + 10, 38), ct.scale)
+        if t.is_integral(ct):
+            return t.LONG
+        return t.DOUBLE
+
+    def update(self):
+        return [(Cast(self.child, self.data_type()), "sum")]
+
+    def buffer_types(self):
+        return [self.data_type()]
+
+    def merge_ops(self):
+        return ["sum"]
+
+    def evaluate(self, ctx, buffers):
+        return buffers[0]
+
+
+class Count(AggregateFunction):
+    """count(expr) or count(*) (child=None)."""
+
+    def data_type(self):
+        return t.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def update(self):
+        target = self.children[0] if self.children else Literal(1, t.INT)
+        return [(target, "countvalid")]
+
+    def buffer_types(self):
+        return [t.LONG]
+
+    def merge_ops(self):
+        return ["sum"]
+
+    def evaluate(self, ctx, buffers):
+        b = buffers[0]
+        # count is never null; empty merge slots count 0
+        xp = ctx.xp
+        data = b.col.data
+        return make_column(ctx, t.LONG, data, None)
+
+
+class Average(AggregateFunction):
+    def data_type(self):
+        ct = self.child.data_type()
+        if isinstance(ct, t.DecimalType):
+            return t.DecimalType(min(ct.precision + 4, 38),
+                                 min(ct.scale + 4, 38))
+        return t.DOUBLE
+
+    def _sum_type(self):
+        ct = self.child.data_type()
+        if isinstance(ct, t.DecimalType):
+            return t.DecimalType(min(ct.precision + 10, 38), ct.scale)
+        return t.DOUBLE
+
+    def update(self):
+        return [(Cast(self.child, self._sum_type()), "sum"),
+                (self.child, "countvalid")]
+
+    def buffer_types(self):
+        return [self._sum_type(), t.LONG]
+
+    def merge_ops(self):
+        return ["sum", "sum"]
+
+    def evaluate(self, ctx, buffers):
+        xp = ctx.xp
+        s, c = buffers[0], buffers[1]
+        cnt = c.col.data
+        nonzero = cnt > 0
+        safe = xp.where(nonzero, cnt, xp.ones_like(cnt))
+        out = self.data_type()
+        if isinstance(out, t.DecimalType):
+            st = self._sum_type()
+            shift = out.scale - st.scale
+            num = s.col.data * np.int64(10 ** max(shift, 0))
+            from .arithmetic import _div_round_half_up
+            sign = xp.where((num < 0), -1, 1).astype(np.int64)
+            q = _div_round_half_up(xp, xp.abs(num), safe) * sign
+            return make_column(ctx, out, q, nonzero & (s.col.validity
+                               if s.col.validity is not None else nonzero))
+        data = s.col.data / safe
+        return make_column(ctx, out, data, nonzero)
+
+
+class Min(AggregateFunction):
+    op = "min"
+
+    def data_type(self):
+        return self.child.data_type()
+
+    def update(self):
+        return [(self.child, self.op)]
+
+    def buffer_types(self):
+        return [self.data_type()]
+
+    def merge_ops(self):
+        return [self.op]
+
+    def evaluate(self, ctx, buffers):
+        return buffers[0]
+
+
+class Max(Min):
+    op = "max"
+
+
+class First(AggregateFunction):
+    op = "first"
+
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    def data_type(self):
+        return self.child.data_type()
+
+    def update(self):
+        return [(self.child, self.op if self.ignore_nulls
+                 else self.op + "_any")]
+
+    def buffer_types(self):
+        return [self.data_type()]
+
+    def merge_ops(self):
+        return [self.op if self.ignore_nulls else self.op + "_any"]
+
+    def evaluate(self, ctx, buffers):
+        return buffers[0]
+
+
+class Last(First):
+    op = "last"
+
+
+class _MomentAgg(AggregateFunction):
+    """Shared buffers for variance/stddev: (n, sum, sumsq) — merge-friendly
+    linear statistics (the reference keeps Welford M2; we trade a little
+    precision for pure-sum merges and document it)."""
+
+    ddof = 1  # sample
+
+    def data_type(self):
+        return t.DOUBLE
+
+    def update(self):
+        from .arithmetic import Multiply
+        x = Cast(self.child, t.DOUBLE)
+        return [(self.child, "countvalid"), (x, "sum"),
+                (Multiply(x, x), "sum")]
+
+    def buffer_types(self):
+        return [t.LONG, t.DOUBLE, t.DOUBLE]
+
+    def merge_ops(self):
+        return ["sum", "sum", "sum"]
+
+    def _moments(self, ctx, buffers):
+        xp = ctx.xp
+        n = buffers[0].col.data.astype(xp.float64)
+        s = buffers[1].col.data
+        ss = buffers[2].col.data
+        m2 = ss - xp.where(n > 0, s * s / xp.maximum(n, 1.0), 0.0)
+        m2 = xp.maximum(m2, 0.0)
+        return n, s, m2
+
+    def _var(self, ctx, buffers, ddof):
+        xp = ctx.xp
+        n, _, m2 = self._moments(ctx, buffers)
+        denom = n - ddof
+        ok = denom > 0
+        var = xp.where(ok, m2 / xp.maximum(denom, 1.0), 0.0)
+        return var, ok
+
+
+class VarianceSamp(_MomentAgg):
+    def evaluate(self, ctx, buffers):
+        var, ok = self._var(ctx, buffers, 1)
+        return make_column(ctx, t.DOUBLE, var, ok)
+
+
+class VariancePop(_MomentAgg):
+    def evaluate(self, ctx, buffers):
+        var, ok = self._var(ctx, buffers, 0)
+        return make_column(ctx, t.DOUBLE, var, ok)
+
+
+class StddevSamp(_MomentAgg):
+    def evaluate(self, ctx, buffers):
+        var, ok = self._var(ctx, buffers, 1)
+        return make_column(ctx, t.DOUBLE, ctx.xp.sqrt(var), ok)
+
+
+class StddevPop(_MomentAgg):
+    def evaluate(self, ctx, buffers):
+        var, ok = self._var(ctx, buffers, 0)
+        return make_column(ctx, t.DOUBLE, ctx.xp.sqrt(var), ok)
+
+
+def bind_aggregate(ae: "AggregateExpression", names, dtypes
+                   ) -> "AggregateExpression":
+    """Bind the function's child expressions against an input schema."""
+    import copy
+    from .core import bind_expression
+    fn = ae.func
+    if fn.children:
+        f2 = copy.copy(fn)
+        f2.children = tuple(bind_expression(c, names, dtypes)
+                            for c in fn.children)
+    else:
+        f2 = fn
+    return AggregateExpression(f2, ae.name)
+
+
+class AggregateExpression(Expression):
+    """An aggregate function + mode + output name binding."""
+
+    def __init__(self, func: AggregateFunction, name: Optional[str] = None):
+        self.children = (func,)
+        self.func = func
+        self.name = name or func.sql()
+
+    def data_type(self):
+        return self.func.data_type()
+
+    def sql(self):
+        return self.name

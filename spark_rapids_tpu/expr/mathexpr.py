@@ -181,7 +181,14 @@ def _eval_floor(e: Floor, ctx: EvalContext):
         return make_column(ctx, out, q, val)
     if t.is_integral(src):
         return make_column(ctx, out, d, val)
-    data = (xp.ceil(d) if is_ceil else xp.floor(d)).astype(np.int64)
+    r = xp.ceil(d) if is_ceil else xp.floor(d)
+    # Java d.toLong semantics: NaN -> 0, out-of-range saturates exactly
+    r = xp.where(xp.isnan(r), 0.0, r)
+    too_hi = r >= 9.223372036854776e18
+    too_lo = r <= -9.223372036854776e18
+    safe = xp.clip(r, -9.2e18, 9.2e18).astype(np.int64)
+    data = xp.where(too_hi, np.int64(2**63 - 1),
+                    xp.where(too_lo, np.int64(-(2**63)), safe))
     return make_column(ctx, out, data, val)
 
 

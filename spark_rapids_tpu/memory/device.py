@@ -1,0 +1,57 @@
+"""Device manager: TPU acquisition + memory bookkeeping + semaphore init.
+
+Ref: GpuDeviceManager.scala:125 initializeGpuAndMemory / :216 initializeRmm.
+The RMM pool's TPU analog is an HBM budget tracked against the PJRT
+device's memory stats; allocation visibility for spill decisions comes
+from the batch registry (memory/spill.py) rather than allocator callbacks
+(XLA owns the real allocator — SURVEY hard-part #5).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+from .. import config as cfg
+
+
+class DeviceManager:
+    _instance: Optional["DeviceManager"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, conf: cfg.RapidsConf):
+        self.conf = conf
+        self.device = None
+        self.hbm_limit = 0
+        self.hbm_reserve = conf.get(cfg.HBM_RESERVE)
+        devs = jax.devices()
+        if devs:
+            self.device = devs[0]
+            stats = {}
+            try:
+                stats = self.device.memory_stats() or {}
+            except Exception:
+                stats = {}
+            total = stats.get("bytes_limit", 16 * (1 << 30))
+            frac = conf.get(cfg.HBM_POOL_FRACTION)
+            self.hbm_limit = int(total * frac) - self.hbm_reserve
+
+    @classmethod
+    def initialize(cls, conf: cfg.RapidsConf) -> "DeviceManager":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = DeviceManager(conf)
+            return cls._instance
+
+    @classmethod
+    def get(cls) -> Optional["DeviceManager"]:
+        return cls._instance
+
+    def memory_in_use(self) -> int:
+        try:
+            stats = self.device.memory_stats() or {}
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0

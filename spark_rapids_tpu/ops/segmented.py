@@ -1,0 +1,197 @@
+"""Segmented reductions + order-preserving key encodings.
+
+The TPU replacement for cuDF's hash-based groupby (ref aggregate.scala's
+cudf groupBy calls): sort rows by an order-preserving uint64 encoding of
+the keys, detect segment boundaries, then segment-reduce.  Sort+segment
+maps perfectly onto XLA (lax.sort is a native TPU op; segment_sum lowers
+to scatter-add) and needs no dynamic shapes.
+
+All entry points take `xp` so the numpy CPU engine shares the semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as t
+from ..columnar.device import DeviceColumn
+from . import strings as sops
+
+
+# ---------------------------------------------------------------------------
+# order-preserving uint64 encodings
+# ---------------------------------------------------------------------------
+
+def encode_int_ordered(xp, data):
+    """int -> uint64 preserving order (flip sign bit)."""
+    return (data.astype(xp.int64).astype(xp.uint64)
+            ^ xp.uint64(0x8000000000000000))
+
+
+def encode_float_ordered(xp, data):
+    """float64 -> uint64 with Spark's total order (NaN last, -0==... well
+    -0 sorts before +0 which matches IEEE; Spark treats -0.0 == 0.0 in
+    comparisons — normalize first)."""
+    d = data.astype(xp.float64)
+    d = xp.where(d == 0.0, xp.zeros_like(d), d)          # -0.0 -> +0.0
+    d = xp.where(xp.isnan(d), xp.full_like(d, xp.nan), d)  # canonical NaN
+    bits = d.view(xp.int64) if hasattr(d, "view") else d.view(np.int64)
+    neg = bits < 0
+    enc = xp.where(neg, ~bits, bits | np.int64(-(2**63)))
+    return enc.astype(xp.uint64)
+
+
+def key_words_for_column(xp, col: DeviceColumn, live_mask,
+                         for_grouping: bool = True, nulls_first: bool = True,
+                         ascending: bool = True):
+    """uint64 sort-key words (most-significant first) for one column.
+
+    Word 0 is the null indicator (nulls group/sort together); remaining
+    words encode the value.  Strings use content hashes when only grouping
+    (equality) is needed, or prefix words for true ordering.
+    """
+    dtype = col.dtype
+    validity = col.validity
+    if validity is None:
+        validity = xp.ones((col.capacity,), dtype=bool)
+    null_word = xp.where(validity, xp.uint64(1 if nulls_first else 0),
+                         xp.uint64(0 if nulls_first else 1))
+    words = [null_word]
+    if isinstance(dtype, (t.StringType, t.BinaryType)):
+        if for_grouping:
+            h1, h2 = sops.string_hashes(xp, col.offsets, col.data)
+            words += [h1, h2]
+        else:
+            words += sops.order_keys(xp, col.offsets, col.data)
+    elif isinstance(dtype, (t.FloatType, t.DoubleType)):
+        words.append(encode_float_ordered(xp, col.data))
+    elif isinstance(dtype, t.BooleanType):
+        words.append(col.data.astype(xp.uint64))
+    elif isinstance(dtype, t.NullType):
+        pass
+    elif isinstance(dtype, t.StructType):
+        for ch in col.children:
+            words += key_words_for_column(xp, ch, live_mask, for_grouping,
+                                          nulls_first, True)
+    else:
+        words.append(encode_int_ordered(xp, col.data))
+    if not ascending:
+        # descending: invert value words; the null word already encodes the
+        # requested nulls_first/last placement independently
+        words = [words[0]] + [~w for w in words[1:]]
+    return words
+
+
+def lexsort(xp, key_words, capacity: int):
+    """Stable ascending lexicographic argsort over uint64 key word lists
+    (most-significant first).  Uses lax.sort's multi-operand lexicographic
+    mode on TPU, np.lexsort on CPU."""
+    if xp is np:
+        # np.lexsort: last key is primary
+        return np.lexsort(tuple(reversed(key_words))).astype(np.int32)
+    import jax
+    from jax import lax
+    iota = xp.arange(capacity, dtype=xp.int32)
+    out = lax.sort(tuple(key_words) + (iota,), num_keys=len(key_words),
+                   is_stable=True)
+    return out[-1]
+
+
+# ---------------------------------------------------------------------------
+# segmented reduce
+# ---------------------------------------------------------------------------
+
+def segment_boundaries(xp, sorted_words, live_sorted):
+    """new_group flags over sorted rows: first live row or any key word
+    differs from the previous row's."""
+    n = sorted_words[0].shape[0]
+    diff = xp.zeros((n,), dtype=bool)
+    for w in sorted_words:
+        prev = xp.concatenate([w[:1], w[:-1]])
+        d = w != prev
+        diff = diff | d
+    first = xp.zeros((n,), dtype=bool)
+    if n > 0:
+        first = xp.arange(n) == 0
+    new_group = (diff | first) & live_sorted
+    return new_group
+
+
+def segment_ids(xp, new_group):
+    return (xp.cumsum(new_group.astype(xp.int32)) - 1).astype(xp.int32)
+
+
+def segment_reduce(xp, op: str, values, seg_ids, num_segments: int, valid):
+    """Reduce `values` per segment.  Returns (out[num_segments],
+    count_valid[num_segments]).  op in {sum, min, max, first, last}.
+    Invalid rows don't contribute."""
+    seg = xp.where(valid, seg_ids, num_segments - 1)  # park invalids anywhere
+    ones = valid.astype(xp.int64)
+    if xp is np:
+        cnt = np.zeros((num_segments,), np.int64)
+        np.add.at(cnt, seg_ids[valid], 1)
+        if op == "sum":
+            out = np.zeros((num_segments,), values.dtype)
+            np.add.at(out, seg_ids[valid], values[valid])
+        elif op == "min" or op == "max":
+            init = _extreme_init(np, values.dtype, op == "min")
+            out = np.full((num_segments,), init, values.dtype)
+            fn = np.minimum if op == "min" else np.maximum
+            fn.at(out, seg_ids[valid], values[valid])
+        elif op in ("first", "last"):
+            idx = np.full((num_segments,),
+                          2**31 - 1 if op == "first" else -1, np.int64)
+            pos = np.arange(values.shape[0], dtype=np.int64)
+            (np.minimum if op == "first" else np.maximum).at(
+                idx, seg_ids[valid], pos[valid])
+            safe = np.clip(idx, 0, values.shape[0] - 1).astype(np.int64)
+            out = values[safe]
+        else:
+            raise ValueError(op)
+        return out, cnt
+    # jax path
+    import jax
+    cnt = jax.ops.segment_sum(ones, seg, num_segments=num_segments)
+    if op == "sum":
+        vals = xp.where(valid, values, xp.zeros_like(values))
+        out = jax.ops.segment_sum(vals, seg, num_segments=num_segments)
+    elif op in ("min", "max"):
+        init = _extreme_init(xp, values.dtype, op == "min")
+        vals = xp.where(valid, values, xp.full_like(values, init))
+        fn = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+        out = fn(vals, seg, num_segments=num_segments)
+    elif op in ("first", "last"):
+        pos = xp.arange(values.shape[0], dtype=xp.int64)
+        sentinel = np.int64(2**62) if op == "first" else np.int64(-1)
+        p = xp.where(valid, pos, xp.full_like(pos, sentinel))
+        fn = jax.ops.segment_min if op == "first" else jax.ops.segment_max
+        idx = fn(p, seg, num_segments=num_segments)
+        safe = xp.clip(idx, 0, values.shape[0] - 1).astype(xp.int32)
+        out = values[safe]
+    else:
+        raise ValueError(op)
+    return out, cnt
+
+
+def _extreme_init(xp, dtype, is_min: bool):
+    dt = np.dtype(dtype)
+    if dt.kind == "f":
+        return np.array(np.inf if is_min else -np.inf, dt)
+    if dt.kind == "b":
+        return np.array(True if is_min else False, dt)
+    info = np.iinfo(dt)
+    return np.array(info.max if is_min else info.min, dt)
+
+
+def first_index_per_segment(xp, seg_ids, num_segments: int, live):
+    """Index of the first row of each segment (for gathering group keys)."""
+    pos = xp.arange(seg_ids.shape[0], dtype=xp.int64)
+    if xp is np:
+        idx = np.full((num_segments,), 2**31 - 1, np.int64)
+        np.minimum.at(idx, seg_ids[live], pos[live])
+        return np.clip(idx, 0, seg_ids.shape[0] - 1).astype(np.int32)
+    import jax
+    seg = xp.where(live, seg_ids, num_segments - 1)
+    p = xp.where(live, pos, xp.full_like(pos, 2**62))
+    idx = jax.ops.segment_min(p, seg, num_segments=num_segments)
+    return xp.clip(idx, 0, seg_ids.shape[0] - 1).astype(xp.int32)

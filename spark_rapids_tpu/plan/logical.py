@@ -1,0 +1,243 @@
+"""Logical plan nodes + analyzer.
+
+The reference receives analyzed physical plans from Spark's Catalyst; as a
+standalone framework we carry a small logical layer (built by the DataFrame
+API) whose only jobs are name resolution, type propagation, and implicit
+casts.  Shapes mirror Catalyst so the rewrite engine downstream sees
+familiar structure.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+from .. import types as t
+from ..expr.core import (Alias, AttributeReference, BoundReference,
+                         Expression, Literal, output_name)
+
+
+class LogicalPlan:
+    children: Tuple["LogicalPlan", ...] = ()
+
+    def schema(self) -> Tuple[List[str], List[t.DataType]]:
+        raise NotImplementedError
+
+    @property
+    def names(self):
+        return self.schema()[0]
+
+    @property
+    def dtypes(self):
+        return self.schema()[1]
+
+
+class LocalRelation(LogicalPlan):
+    def __init__(self, table: pa.Table, num_partitions: int = 1):
+        self.table = table
+        self.num_partitions = num_partitions
+
+    def schema(self):
+        from ..columnar.interop import from_arrow_type
+        return (list(self.table.schema.names),
+                [from_arrow_type(f.type) for f in self.table.schema])
+
+
+class Range(LogicalPlan):
+    def __init__(self, start, end, step=1, num_partitions=1):
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = num_partitions
+
+    def schema(self):
+        return ["id"], [t.LONG]
+
+
+class FileRelation(LogicalPlan):
+    """Scan of parquet/orc/csv files (resolved by io layer)."""
+
+    def __init__(self, fmt: str, paths: List[str], schema_names,
+                 schema_types, options=None):
+        self.fmt = fmt
+        self.paths = paths
+        self._names = schema_names
+        self._types = schema_types
+        self.options = options or {}
+        self.pushed_filters: List[Expression] = []
+
+    def schema(self):
+        return list(self._names), list(self._types)
+
+
+class Project(LogicalPlan):
+    def __init__(self, exprs: Sequence[Expression], child: LogicalPlan):
+        self.exprs = list(exprs)
+        self.children = (child,)
+
+    def schema(self):
+        names, dtypes = [], []
+        cn, ct = self.children[0].schema()
+        from ..expr.core import bind_expression
+        for e in self.exprs:
+            b = bind_expression(e, cn, ct)
+            names.append(output_name(e))
+            dtypes.append(b.data_type())
+        return names, dtypes
+
+
+class Filter(LogicalPlan):
+    def __init__(self, condition: Expression, child: LogicalPlan):
+        self.condition = condition
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+
+class Aggregate(LogicalPlan):
+    def __init__(self, grouping: Sequence[Expression],
+                 aggregates, child: LogicalPlan):
+        from ..expr.aggregates import AggregateExpression
+        self.grouping = list(grouping)
+        self.aggregates: List[AggregateExpression] = list(aggregates)
+        self.children = (child,)
+
+    def schema(self):
+        cn, ct = self.children[0].schema()
+        from ..expr.core import bind_expression
+        names, dtypes = [], []
+        for g in self.grouping:
+            b = bind_expression(g, cn, ct)
+            names.append(output_name(g))
+            dtypes.append(b.data_type())
+        for a in self.aggregates:
+            names.append(a.name)
+            fn = a.func
+            if fn.children:
+                bound_child = bind_expression(fn.child, cn, ct)
+                fb = type(fn).__new__(type(fn))
+                fb.__dict__.update(fn.__dict__)
+                fb.children = (bound_child,)
+                dtypes.append(fb.data_type())
+            else:
+                dtypes.append(fn.data_type())
+        return names, dtypes
+
+
+class Join(LogicalPlan):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 how: str, condition: Optional[Expression] = None,
+                 using: Optional[List[str]] = None):
+        self.children = (left, right)
+        self.how = how  # inner, left, right, full, left_semi, left_anti, cross
+        self.condition = condition
+        self.using = using
+
+    def schema(self):
+        ln, lt = self.children[0].schema()
+        rn, rt = self.children[1].schema()
+        if self.how in ("left_semi", "left_anti"):
+            return ln, lt
+        return ln + rn, lt + rt
+
+
+class Sort(LogicalPlan):
+    def __init__(self, orders, is_global: bool, child: LogicalPlan):
+        # orders: list of (expr, ascending, nulls_first)
+        self.orders = orders
+        self.is_global = is_global
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+
+class Limit(LogicalPlan):
+    def __init__(self, n: int, child: LogicalPlan):
+        self.n = n
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+
+class Union(LogicalPlan):
+    def __init__(self, children: Sequence[LogicalPlan]):
+        self.children = tuple(children)
+
+    def schema(self):
+        return self.children[0].schema()
+
+
+class Window(LogicalPlan):
+    """Window function application; window_exprs are WindowExpression."""
+
+    def __init__(self, window_exprs, child: LogicalPlan):
+        self.window_exprs = list(window_exprs)
+        self.children = (child,)
+
+    def schema(self):
+        cn, ct = self.children[0].schema()
+        from ..expr.core import bind_expression
+        names = list(cn)
+        dtypes = list(ct)
+        for we in self.window_exprs:
+            names.append(we.name)
+            dtypes.append(we.resolved_type(cn, ct))
+        return names, dtypes
+
+
+class Expand(LogicalPlan):
+    """Multiple projections per input row (ref GpuExpandExec)."""
+
+    def __init__(self, projections: List[List[Expression]],
+                 names: List[str], child: LogicalPlan):
+        self.projections = projections
+        self._names = names
+        self.children = (child,)
+
+    def schema(self):
+        cn, ct = self.children[0].schema()
+        from ..expr.core import bind_expression
+        dtypes = [bind_expression(e, cn, ct).data_type()
+                  for e in self.projections[0]]
+        return list(self._names), dtypes
+
+
+class Distinct(LogicalPlan):
+    def __init__(self, child: LogicalPlan):
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+
+class Repartition(LogicalPlan):
+    def __init__(self, num_partitions: int, keys: Optional[List[Expression]],
+                 child: LogicalPlan):
+        self.num_partitions = num_partitions
+        self.keys = keys
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+
+class Generate(LogicalPlan):
+    """explode/posexplode over an array column (ref GpuGenerateExec)."""
+
+    def __init__(self, generator: Expression, outer: bool,
+                 output_names: List[str], child: LogicalPlan):
+        self.generator = generator
+        self.outer = outer
+        self._out_names = output_names
+        self.children = (child,)
+
+    def schema(self):
+        cn, ct = self.children[0].schema()
+        from ..expr.core import bind_expression
+        g = bind_expression(self.generator, cn, ct)
+        elem = g.data_type()
+        if isinstance(elem, t.ArrayType):
+            elem = elem.element_type
+        return cn + self._out_names, ct + [elem]

@@ -1,0 +1,393 @@
+"""Plan-rewrite engine: meta wrapping, tagging, TPU conversion, transitions.
+
+TPU-native analog of the reference's core
+(ref: GpuOverrides.scala:3476 apply / :3495 applyOverrides,
+RapidsMeta.scala:70/543/911 meta hierarchy,
+GpuTransitionOverrides.scala:44 transition insertion).
+
+Flow:
+  1. wrap the CPU physical plan into a Meta tree,
+  2. tag every node: per-op enable confs, TypeSig checks on output schema,
+     expression-level checks (each expression class has a rule + TypeSig,
+     ref GpuOverrides.scala:727-3048 registry),
+  3. convert untagged subtrees to TPU placement (aggregates become a
+     Partial/Final TPU pair, ref aggregate.scala modes),
+  4. insert HostToDevice/DeviceToHost transitions at placement boundaries,
+  5. produce reference-style explain output (spark.rapids.sql.explain).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Type
+
+from .. import config as cfg
+from .. import types as t
+from ..exec import base as eb
+from ..exec.aggregate import (CpuHashAggregateExec, TpuHashAggregateExec)
+from ..exec.basic import (CoalesceBatchesExec, FilterExec, GlobalLimitExec,
+                          LocalLimitExec, LocalScanExec, ProjectExec,
+                          RangeExec, UnionExec)
+from ..exec.gatherpart import GatherPartitionsExec
+from ..expr import aggregates as agg
+from ..expr import arithmetic as ar
+from ..expr import conditional as cond
+from ..expr import mathexpr as mx
+from ..expr import predicates as pred
+from ..expr.cast import Cast, cast_supported_on_tpu
+from ..expr.core import (Alias, AttributeReference, BoundReference,
+                         Expression, Literal, bind_expression)
+from ..types import T, TypeSig
+
+
+# ---------------------------------------------------------------------------
+# Expression rules (ref ExprRule, GpuOverrides.scala:206)
+# ---------------------------------------------------------------------------
+
+class ExprRule:
+    def __init__(self, sig: TypeSig, desc: str = "",
+                 tag_fn: Optional[Callable] = None):
+        self.sig = sig
+        self.desc = desc
+        self.tag_fn = tag_fn
+
+
+EXPR_RULES: Dict[Type[Expression], ExprRule] = {}
+
+
+def expr_rule(cls, sig: TypeSig, desc: str = "", tag_fn=None):
+    EXPR_RULES[cls] = ExprRule(sig, desc, tag_fn)
+
+
+_num = T.numeric
+_common = T.common_scalar
+_cmp = _common
+
+expr_rule(Literal, T.all_types, "literal values")
+expr_rule(Alias, T.all_types.nested(), "named expression")
+expr_rule(AttributeReference, _common + T.ARRAY + T.STRUCT + T.MAP + T.BINARY,
+          "column reference")
+expr_rule(BoundReference, _common + T.ARRAY + T.STRUCT + T.MAP + T.BINARY,
+          "bound column reference")
+for c in (ar.Add, ar.Subtract, ar.Multiply, ar.Divide, ar.IntegralDivide,
+          ar.Remainder, ar.Pmod, ar.UnaryMinus, ar.UnaryPositive, ar.Abs,
+          ar.Greatest, ar.Least):
+    expr_rule(c, _num)
+for c in (pred.EqualTo, pred.EqualNullSafe, pred.LessThan,
+          pred.LessThanOrEqual, pred.GreaterThan, pred.GreaterThanOrEqual,
+          pred.In):
+    expr_rule(c, _cmp)
+for c in (pred.And, pred.Or, pred.Not):
+    expr_rule(c, T.BOOLEAN)
+for c in (pred.IsNull, pred.IsNotNull, pred.IsNaN):
+    expr_rule(c, _common)
+for c in (cond.If, cond.CaseWhen, cond.Coalesce, cond.NullIf, cond.Nvl):
+    expr_rule(c, _common)
+for c in (mx.Sqrt, mx.Exp, mx.Expm1, mx.Sin, mx.Cos, mx.Tan, mx.Asin,
+          mx.Acos, mx.Atan, mx.Sinh, mx.Cosh, mx.Tanh, mx.Cbrt, mx.Rint,
+          mx.ToDegrees, mx.ToRadians, mx.Log, mx.Log2, mx.Log10, mx.Log1p,
+          mx.Pow, mx.Atan2, mx.Signum, mx.Round, mx.BRound, mx.Floor,
+          mx.Ceil):
+    expr_rule(c, _num)
+
+
+def _tag_cast(meta: "ExprMeta"):
+    e = meta.expr
+    src = e.child.data_type()
+    if not cast_supported_on_tpu(src, e.to):
+        meta.will_not_work(
+            f"cast from {src.name} to {e.to.name} is not supported on TPU")
+
+
+expr_rule(Cast, T.all_types, "type cast", _tag_cast)
+
+# aggregate functions
+expr_rule(agg.Sum, _num)
+expr_rule(agg.Average, _num)
+expr_rule(agg.Count, T.all_types)
+expr_rule(agg.Min, _num + T.DATE + T.TIMESTAMP + T.BOOLEAN)
+expr_rule(agg.Max, _num + T.DATE + T.TIMESTAMP + T.BOOLEAN)
+expr_rule(agg.First, _common)
+expr_rule(agg.Last, _common)
+for c in (agg.StddevPop, agg.StddevSamp, agg.VariancePop, agg.VarianceSamp):
+    expr_rule(c, _num - T.DECIMAL_128)
+expr_rule(agg.AggregateExpression, T.all_types)
+
+
+# ---------------------------------------------------------------------------
+# Meta hierarchy (ref RapidsMeta.scala)
+# ---------------------------------------------------------------------------
+
+class BaseMeta:
+    def __init__(self, conf: cfg.RapidsConf):
+        self.conf = conf
+        self.reasons: List[str] = []
+
+    def will_not_work(self, reason: str):
+        if reason not in self.reasons:
+            self.reasons.append(reason)
+
+    @property
+    def can_replace(self) -> bool:
+        return not self.reasons
+
+
+class ExprMeta(BaseMeta):
+    """Wraps one expression node (ref BaseExprMeta, RapidsMeta.scala:911)."""
+
+    def __init__(self, expr: Expression, conf, input_names, input_types):
+        super().__init__(conf)
+        self.expr = expr
+        self.input_names = input_names
+        self.input_types = input_types
+        self.children = [ExprMeta(c, conf, input_names, input_types)
+                         for c in expr.children]
+        if isinstance(expr, agg.AggregateExpression):
+            self.children = [ExprMeta(expr.func, conf, input_names,
+                                      input_types)]
+
+    def tag(self):
+        rule = EXPR_RULES.get(type(self.expr))
+        if rule is None:
+            self.will_not_work(
+                f"expression {type(self.expr).__name__} is not supported on TPU")
+        else:
+            if not self.conf.is_op_enabled("expression",
+                                           type(self.expr).__name__):
+                self.will_not_work(
+                    f"expression {type(self.expr).__name__} has been disabled")
+            try:
+                bound = bind_expression(self.expr, self.input_names,
+                                        self.input_types)
+                dt = bound.data_type()
+                if not isinstance(dt, t.NullType) and \
+                        not rule.sig.is_supported(dt):
+                    for r in rule.sig.reasons_not_supported(dt):
+                        self.will_not_work(
+                            f"{type(self.expr).__name__} produces "
+                            f"unsupported type: {r}")
+            except Exception as ex:  # unresolvable -> cannot place
+                self.will_not_work(
+                    f"{type(self.expr).__name__}: {ex}")
+            if rule.tag_fn is not None and not self.reasons:
+                try:
+                    bound = bind_expression(self.expr, self.input_names,
+                                            self.input_types)
+                    m2 = ExprMeta.__new__(ExprMeta)
+                    m2.__dict__.update(self.__dict__)
+                    m2.expr = bound
+                    m2.reasons = self.reasons
+                    rule.tag_fn(m2)
+                except Exception as ex:
+                    self.will_not_work(str(ex))
+        for c in self.children:
+            c.tag()
+
+    @property
+    def can_replace_tree(self) -> bool:
+        return self.can_replace and all(c.can_replace_tree
+                                        for c in self.children)
+
+    def all_reasons(self) -> List[str]:
+        out = list(self.reasons)
+        for c in self.children:
+            out += c.all_reasons()
+        return out
+
+
+class ExecMeta(BaseMeta):
+    """Wraps one physical operator (ref SparkPlanMeta, RapidsMeta.scala:543)."""
+
+    def __init__(self, exec_node: eb.Exec, conf):
+        super().__init__(conf)
+        self.exec = exec_node
+        self.children = [ExecMeta(c, conf) for c in exec_node.children]
+
+    # schema feeding this node's expressions
+    def _input_schema(self):
+        if self.exec.children:
+            c = self.exec.children[0]
+            return c.output_names, c.output_types
+        return [], []
+
+    def expressions(self) -> List[Expression]:
+        e = self.exec
+        if isinstance(e, ProjectExec):
+            return list(e.exprs)
+        if isinstance(e, FilterExec):
+            return [e.condition]
+        if isinstance(e, (CpuHashAggregateExec,)):
+            return list(e.grouping) + list(e.aggregates)
+        return []
+
+    def tag(self):
+        e = self.exec
+        name = type(e).__name__
+        if not self.conf.is_op_enabled("exec", name):
+            self.will_not_work(f"{name} has been disabled by config")
+        rule_sig = EXEC_SIGS.get(type(e))
+        if rule_sig is None:
+            self.will_not_work(f"{name} has no TPU implementation")
+        else:
+            for n, dt in zip(e.output_names, e.output_types):
+                if isinstance(dt, t.NullType):
+                    continue
+                if not rule_sig.is_supported(dt):
+                    for r in rule_sig.reasons_not_supported(dt):
+                        self.will_not_work(f"output column {n}: {r}")
+        names, dtypes = self._input_schema()
+        self.expr_metas = [ExprMeta(x, self.conf, names, dtypes)
+                           for x in self.expressions()]
+        for em in self.expr_metas:
+            em.tag()
+            if not em.can_replace_tree:
+                for r in em.all_reasons():
+                    self.will_not_work(r)
+        custom = EXEC_TAGS.get(type(e))
+        if custom:
+            custom(self)
+        for c in self.children:
+            c.tag()
+
+    # ---- conversion -------------------------------------------------------
+    def convert(self) -> eb.Exec:
+        new_children = [c.convert() for c in self.children]
+        e = self.exec.with_new_children(new_children)
+        if not self.can_replace or not self.conf.sql_enabled:
+            return e
+        conv = EXEC_CONVERTS.get(type(e))
+        if conv is not None:
+            return conv(e, self.conf)
+        import copy
+        e.placement = eb.TPU
+        return e
+
+    # ---- explain ----------------------------------------------------------
+    def explain_lines(self, level=0) -> List[str]:
+        pad = "  " * level
+        name = type(self.exec).__name__
+        if self.can_replace:
+            lines = [f"{pad}*Exec <{name}> will run on TPU"]
+        else:
+            lines = [f"{pad}!Exec <{name}> cannot run on TPU because "
+                     + "; ".join(self.reasons[:4])]
+        for c in self.children:
+            lines += c.explain_lines(level + 1)
+        return lines
+
+
+# exec output-type signatures (ref ExecChecks, TypeChecks.scala:886)
+_exec_common = (T.common_scalar + T.ARRAY + T.STRUCT + T.MAP + T.BINARY).nested()
+EXEC_SIGS: Dict[Type[eb.Exec], TypeSig] = {
+    LocalScanExec: _exec_common,
+    RangeExec: T.LONG,
+    ProjectExec: _exec_common,
+    FilterExec: _exec_common,
+    UnionExec: _exec_common,
+    LocalLimitExec: _exec_common,
+    GlobalLimitExec: _exec_common,
+    CoalesceBatchesExec: _exec_common,
+    GatherPartitionsExec: _exec_common,
+    CpuHashAggregateExec: (T.common_scalar).nested(),
+}
+
+EXEC_TAGS: Dict[Type[eb.Exec], Callable] = {}
+EXEC_CONVERTS: Dict[Type[eb.Exec], Callable] = {}
+
+
+def _convert_aggregate(e: CpuHashAggregateExec, conf) -> eb.Exec:
+    """Replace the complete-mode CPU aggregate with a TPU Partial/Final
+    pair (ref aggregate.scala partial/final mode pipeline)."""
+    child = e.children[0]
+    partial = TpuHashAggregateExec(e.grouping, e.aggregates, agg.PARTIAL,
+                                   child)
+    final = TpuHashAggregateExec(e.grouping, partial.aggregates, agg.FINAL,
+                                 partial)
+    return final
+
+
+EXEC_CONVERTS[CpuHashAggregateExec] = _convert_aggregate
+
+
+def _tag_aggregate(meta: ExecMeta):
+    e: CpuHashAggregateExec = meta.exec
+    cn, ct = e.children[0].output_names, e.children[0].output_types
+    for ae in e.aggregates:
+        fn = ae.func
+        rule = EXPR_RULES.get(type(fn))
+        if rule is None:
+            meta.will_not_work(
+                f"aggregate {type(fn).__name__} is not supported on TPU")
+            continue
+        if fn.children:
+            try:
+                b = bind_expression(fn.child, cn, ct)
+                dt = b.data_type()
+                if not rule.sig.is_supported(dt):
+                    for r in rule.sig.reasons_not_supported(dt):
+                        meta.will_not_work(
+                            f"{type(fn).__name__} over unsupported input: {r}")
+            except Exception as ex:
+                meta.will_not_work(str(ex))
+
+
+EXEC_TAGS[CpuHashAggregateExec] = _tag_aggregate
+
+
+# ---------------------------------------------------------------------------
+# Transitions (ref GpuTransitionOverrides)
+# ---------------------------------------------------------------------------
+
+def insert_transitions(root: eb.Exec) -> eb.Exec:
+    def fix(node: eb.Exec) -> eb.Exec:
+        new_children = []
+        for c in node.children:
+            c = fix(c)
+            if node.placement == eb.TPU and c.placement == eb.CPU and \
+                    not isinstance(c, eb.DeviceToHostExec):
+                c = eb.HostToDeviceExec(c)
+            elif node.placement == eb.CPU and c.placement == eb.TPU:
+                c = eb.DeviceToHostExec(c)
+            new_children.append(c)
+        if new_children or node.children:
+            node = node.with_new_children(new_children)
+        return node
+
+    root = fix(root)
+    if root.placement == eb.TPU:
+        root = eb.DeviceToHostExec(root)
+    # fuse DeviceToHost(HostToDevice(x)) -> x
+    def fuse(node: eb.Exec) -> eb.Exec:
+        if isinstance(node, eb.HostToDeviceExec) and \
+                isinstance(node.children[0], eb.DeviceToHostExec):
+            return node.children[0].children[0]
+        if isinstance(node, eb.DeviceToHostExec) and \
+                isinstance(node.children[0], eb.HostToDeviceExec):
+            return node.children[0].children[0]
+        return node
+    return root.transform_up(fuse)
+
+
+class TpuOverrides:
+    """Entry point (ref GpuOverrides.apply, ColumnarOverrideRules)."""
+
+    def __init__(self, conf: cfg.RapidsConf):
+        self.conf = conf
+        self.last_explain = ""
+
+    def apply(self, plan: eb.Exec) -> eb.Exec:
+        if not self.conf.sql_enabled:
+            self.last_explain = "(TPU acceleration disabled)"
+            return plan
+        meta = ExecMeta(plan, self.conf)
+        meta.tag()
+        explain_mode = self.conf.explain
+        lines = meta.explain_lines()
+        self.last_explain = "\n".join(lines)
+        if explain_mode == "ALL":
+            print(self.last_explain)
+        elif explain_mode == "NOT_ON_GPU":
+            bad = [l for l in lines if l.lstrip().startswith("!")]
+            if bad:
+                print("\n".join(bad))
+        converted = meta.convert()
+        return insert_transitions(converted)

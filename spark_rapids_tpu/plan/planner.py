@@ -1,0 +1,84 @@
+"""Logical -> physical planning.
+
+Produces a CPU-placed physical plan — the same starting point the
+reference gets from Spark's query planner — which plan/overrides.py then
+rewrites onto the TPU (tagging unsupported pieces to stay on CPU).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .. import types as t
+from ..exec import base as eb
+from ..exec.aggregate import CpuHashAggregateExec
+from ..exec.basic import (CoalesceBatchesExec, FilterExec, GlobalLimitExec,
+                          LocalLimitExec, LocalScanExec, ProjectExec,
+                          RangeExec, UnionExec)
+from ..expr.aggregates import AggregateExpression, First
+from ..expr.core import AttributeReference, Expression
+from . import logical as L
+
+
+def plan(lp: L.LogicalPlan, conf) -> eb.Exec:
+    if isinstance(lp, L.LocalRelation):
+        return LocalScanExec(lp.table, lp.num_partitions)
+    if isinstance(lp, L.Range):
+        return RangeExec(lp.start, lp.end, lp.step, lp.num_partitions)
+    if isinstance(lp, L.FileRelation):
+        from ..io.scan import make_scan_exec
+        return make_scan_exec(lp, conf)
+    if isinstance(lp, L.Project):
+        return ProjectExec(lp.exprs, plan(lp.children[0], conf))
+    if isinstance(lp, L.Filter):
+        return FilterExec(lp.condition, plan(lp.children[0], conf))
+    if isinstance(lp, L.Aggregate):
+        child = plan(lp.children[0], conf)
+        if child.num_partitions > 1:
+            # complete-mode aggregation needs co-located groups; until the
+            # conversion pass swaps in partial/final around an exchange,
+            # gather to one partition (the overrides engine re-plans this)
+            from ..exec.gatherpart import GatherPartitionsExec
+            child = GatherPartitionsExec(child)
+        return CpuHashAggregateExec(lp.grouping, lp.aggregates, child)
+    if isinstance(lp, L.Join):
+        from ..exec.join import plan_join
+        return plan_join(lp, plan(lp.children[0], conf),
+                         plan(lp.children[1], conf), conf)
+    if isinstance(lp, L.Sort):
+        from ..exec.sort import SortExec
+        return SortExec(lp.orders, plan(lp.children[0], conf),
+                        is_global=lp.is_global)
+    if isinstance(lp, L.Limit):
+        child = plan(lp.children[0], conf)
+        if child.num_partitions > 1:
+            from ..exec.gatherpart import GatherPartitionsExec
+            child = GatherPartitionsExec(LocalLimitExec(lp.n, child))
+        return GlobalLimitExec(lp.n, child)
+    if isinstance(lp, L.Union):
+        return UnionExec([plan(c, conf) for c in lp.children])
+    if isinstance(lp, L.Distinct):
+        names, dtypes = lp.schema()
+        grouping = [AttributeReference(n) for n in names]
+        return CpuHashAggregateExec(grouping, [],
+                                    plan(lp.children[0], conf))
+    if isinstance(lp, L.Window):
+        from ..exec.window import WindowExec
+        return WindowExec(lp.window_exprs, plan(lp.children[0], conf))
+    if isinstance(lp, L.Expand):
+        from ..exec.expand import ExpandExec
+        return ExpandExec(lp.projections, lp._names,
+                          plan(lp.children[0], conf))
+    if isinstance(lp, L.Generate):
+        from ..exec.expand import GenerateExec
+        return GenerateExec(lp.generator, lp.outer, lp._out_names,
+                            plan(lp.children[0], conf))
+    if isinstance(lp, L.Repartition):
+        from ..shuffle.exchange import ShuffleExchangeExec
+        from ..shuffle.partitioning import (HashPartitioning,
+                                            RoundRobinPartitioning)
+        child = plan(lp.children[0], conf)
+        part = HashPartitioning(lp.keys, lp.num_partitions) if lp.keys \
+            else RoundRobinPartitioning(lp.num_partitions)
+        return ShuffleExchangeExec(part, child)
+    raise NotImplementedError(f"no physical plan for {type(lp).__name__}")

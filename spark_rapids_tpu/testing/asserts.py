@@ -1,0 +1,155 @@
+"""Differential-test assertions.
+
+Re-design of the reference's primary correctness net
+(ref: integration_tests/src/main/python/asserts.py:434
+assert_gpu_and_cpu_are_equal_collect, :14-60 recursive value compare,
+:357 assert_gpu_fallback_collect): run the same query on the CPU engine
+(spark.rapids.sql.enabled=false) and the TPU engine, deep-compare results
+with float tolerance; fallback assertions capture the executed plan and
+check an operator actually stayed on CPU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional
+
+import pyarrow as pa
+
+from ..api.session import TpuSession
+
+_TPU_CONF = {"spark.rapids.sql.enabled": True}
+_CPU_CONF = {"spark.rapids.sql.enabled": False}
+
+
+def _mk(conf: Dict) -> TpuSession:
+    b = TpuSession.builder()
+    for k, v in conf.items():
+        b.config(k, v)
+    return b.get_or_create()
+
+
+def with_cpu_session(fn: Callable[[TpuSession], object],
+                     conf: Optional[Dict] = None):
+    c = dict(conf or {})
+    c.update(_CPU_CONF)
+    return fn(_mk(c))
+
+
+def with_tpu_session(fn: Callable[[TpuSession], object],
+                     conf: Optional[Dict] = None):
+    c = dict(conf or {})
+    c.update(_TPU_CONF)
+    return fn(_mk(c))
+
+
+def _val_equal(a, b, approx: float) -> bool:
+    if a is None and b is None:
+        return True
+    if a is None or b is None:
+        return False
+    if isinstance(a, float) or isinstance(b, float):
+        fa, fb = float(a), float(b)
+        if math.isnan(fa) and math.isnan(fb):
+            return True
+        if math.isinf(fa) or math.isinf(fb):
+            return fa == fb
+        if approx > 0:
+            denom = max(abs(fa), abs(fb), 1e-12)
+            return abs(fa - fb) <= approx * denom or abs(fa - fb) < 1e-11
+        return fa == fb
+    if isinstance(a, dict) and isinstance(b, dict):
+        return (set(a) == set(b)
+                and all(_val_equal(a[k], b[k], approx) for k in a))
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return (len(a) == len(b)
+                and all(_val_equal(x, y, approx) for x, y in zip(a, b)))
+    return a == b
+
+
+def _sort_key(row):
+    def k(v):
+        if v is None:
+            return (0, "")
+        if isinstance(v, bool):
+            return (1, str(int(v)))
+        if isinstance(v, (int, float)):
+            if isinstance(v, float) and math.isnan(v):
+                return (3, "nan")
+            return (2, f"{float(v):+040.12e}")
+        if isinstance(v, (list, tuple, dict)):
+            return (4, str(v))
+        return (4, str(v))
+    return tuple(k(v) for v in row)
+
+
+def assert_tables_equal(cpu: pa.Table, tpu: pa.Table,
+                        ignore_order: bool = True,
+                        approximate_float: float = 0.0):
+    assert cpu.schema.names == tpu.schema.names, \
+        f"schema mismatch: {cpu.schema.names} vs {tpu.schema.names}"
+    crows = [tuple(r.values()) for r in cpu.to_pylist()]
+    trows = [tuple(r.values()) for r in tpu.to_pylist()]
+    assert len(crows) == len(trows), \
+        f"row count: cpu={len(crows)} tpu={len(trows)}"
+    if ignore_order:
+        crows = sorted(crows, key=_sort_key)
+        trows = sorted(trows, key=_sort_key)
+    for i, (cr, tr) in enumerate(zip(crows, trows)):
+        if not _val_equal(list(cr), list(tr), approximate_float):
+            raise AssertionError(
+                f"row {i} differs:\n  cpu: {cr}\n  tpu: {tr}")
+
+
+def assert_tpu_and_cpu_are_equal_collect(
+        df_fn: Callable[[TpuSession], "object"],
+        conf: Optional[Dict] = None,
+        ignore_order: bool = True,
+        approximate_float: float = 0.0):
+    """Run the query builder against both engines and compare results
+    (ref asserts.py:434)."""
+    cpu = with_cpu_session(lambda s: df_fn(s).collect(), conf)
+    tpu = with_tpu_session(lambda s: df_fn(s).collect(), conf)
+    assert_tables_equal(cpu, tpu, ignore_order, approximate_float)
+    return cpu, tpu
+
+
+def assert_tpu_fallback_collect(
+        df_fn: Callable[[TpuSession], "object"],
+        cpu_exec_name: str,
+        conf: Optional[Dict] = None,
+        ignore_order: bool = True,
+        approximate_float: float = 0.0):
+    """Verify the op stayed on CPU *and* results match
+    (ref asserts.py:357 + ExecutionPlanCaptureCallback)."""
+    cpu = with_cpu_session(lambda s: df_fn(s).collect(), conf)
+
+    c = dict(conf or {})
+    c.update(_TPU_CONF)
+    session = _mk(c)
+    tpu = df_fn(session).collect()
+    plan = session.last_plan
+    found = []
+    plan.foreach(lambda e: found.append(type(e).__name__))
+    from ..exec.base import CPU as _CPU
+    cpu_placed = []
+    plan.foreach(lambda e: cpu_placed.append(type(e).__name__)
+                 if e.placement == _CPU else None)
+    assert any(cpu_exec_name in n for n in cpu_placed), \
+        (f"expected {cpu_exec_name} to fall back to CPU; CPU-placed: "
+         f"{cpu_placed}; all: {found}")
+    assert_tables_equal(cpu, tpu, ignore_order, approximate_float)
+
+
+def assert_tpu_and_cpu_error(df_fn, conf, error_message: str):
+    """Both engines must raise with the message (ref asserts.py:495)."""
+    for runner in (with_cpu_session, with_tpu_session):
+        try:
+            runner(lambda s: df_fn(s).collect(), conf)
+            raise AssertionError(
+                f"expected error '{error_message}' but query succeeded")
+        except AssertionError:
+            raise
+        except Exception as ex:
+            assert error_message in str(ex), \
+                f"expected '{error_message}' in '{ex}'"

@@ -1,0 +1,212 @@
+"""Typed fuzz data generators.
+
+Re-design of the reference's generator library
+(ref: integration_tests/src/main/python/data_gen.py:30-987): typed
+generators with weighted special cases (nulls, NaN, +/-Inf, min/max,
+empty strings), nested array/struct generation, deterministic seeding.
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal as pydec
+import random
+import string
+from typing import List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+from .. import types as t
+from ..columnar.interop import to_arrow_type
+
+
+class DataGen:
+    def __init__(self, dtype: t.DataType, nullable: bool = True,
+                 null_prob: float = 0.1):
+        self.dtype = dtype
+        self.nullable = nullable
+        self.null_prob = null_prob
+        self._specials: List = []
+        self._special_prob = 0.05
+
+    def with_special_case(self, value, weight: float = 1.0):
+        self._specials.append(value)
+        return self
+
+    def _gen_value(self, rng: random.Random):
+        raise NotImplementedError
+
+    def gen(self, rng: random.Random):
+        if self.nullable and rng.random() < self.null_prob:
+            return None
+        if self._specials and rng.random() < self._special_prob * \
+                len(self._specials):
+            return rng.choice(self._specials)
+        return self._gen_value(rng)
+
+
+class BooleanGen(DataGen):
+    def __init__(self, **kw):
+        super().__init__(t.BOOLEAN, **kw)
+
+    def _gen_value(self, rng):
+        return rng.random() < 0.5
+
+
+class _IntGen(DataGen):
+    LO, HI = 0, 0
+
+    def __init__(self, dtype, lo=None, hi=None, **kw):
+        super().__init__(dtype, **kw)
+        self.lo = self.LO if lo is None else lo
+        self.hi = self.HI if hi is None else hi
+        self.with_special_case(self.LO).with_special_case(self.HI)
+        self.with_special_case(0)
+
+    def _gen_value(self, rng):
+        return rng.randint(self.lo, self.hi)
+
+
+class ByteGen(_IntGen):
+    LO, HI = -128, 127
+
+    def __init__(self, **kw):
+        super().__init__(t.BYTE, **kw)
+
+
+class ShortGen(_IntGen):
+    LO, HI = -32768, 32767
+
+    def __init__(self, **kw):
+        super().__init__(t.SHORT, **kw)
+
+
+class IntegerGen(_IntGen):
+    LO, HI = -(2**31), 2**31 - 1
+
+    def __init__(self, **kw):
+        super().__init__(t.INT, **kw)
+
+
+class LongGen(_IntGen):
+    LO, HI = -(2**63), 2**63 - 1
+
+    def __init__(self, **kw):
+        super().__init__(t.LONG, **kw)
+
+
+class FloatGen(DataGen):
+    def __init__(self, dtype=t.FLOAT, no_nans: bool = False, **kw):
+        super().__init__(dtype, **kw)
+        if not no_nans:
+            self.with_special_case(float("nan"))
+        self.with_special_case(float("inf"))
+        self.with_special_case(float("-inf"))
+        self.with_special_case(0.0).with_special_case(-0.0)
+
+    def _gen_value(self, rng):
+        choice = rng.random()
+        if choice < 0.3:
+            return rng.uniform(-1000, 1000)
+        if choice < 0.6:
+            return rng.uniform(-1, 1)
+        return rng.uniform(-1e30, 1e30)
+
+
+class DoubleGen(FloatGen):
+    def __init__(self, **kw):
+        super().__init__(t.DOUBLE, **kw)
+
+
+class StringGen(DataGen):
+    def __init__(self, alphabet: str = string.ascii_letters + string.digits +
+                 " _-", max_len: int = 20, **kw):
+        super().__init__(t.STRING, **kw)
+        self.alphabet = alphabet
+        self.max_len = max_len
+        self.with_special_case("")
+
+    def _gen_value(self, rng):
+        n = rng.randint(0, self.max_len)
+        return "".join(rng.choice(self.alphabet) for _ in range(n))
+
+
+class DecimalGen(DataGen):
+    def __init__(self, precision: int = 10, scale: int = 2, **kw):
+        super().__init__(t.DecimalType(precision, scale), **kw)
+        self.precision, self.scale = precision, scale
+
+    def _gen_value(self, rng):
+        unscaled = rng.randint(-(10**self.precision) + 1,
+                               10**self.precision - 1)
+        return pydec.Decimal(unscaled).scaleb(-self.scale)
+
+
+class DateGen(DataGen):
+    def __init__(self, **kw):
+        super().__init__(t.DATE, **kw)
+        self.with_special_case(datetime.date(1970, 1, 1))
+        self.with_special_case(datetime.date(1582, 10, 15))
+
+    def _gen_value(self, rng):
+        return datetime.date(1970, 1, 1) + \
+            datetime.timedelta(days=rng.randint(-30000, 30000))
+
+
+class TimestampGen(DataGen):
+    def __init__(self, **kw):
+        super().__init__(t.TIMESTAMP, **kw)
+
+    def _gen_value(self, rng):
+        base = datetime.datetime(1970, 1, 1,
+                                 tzinfo=datetime.timezone.utc)
+        return base + datetime.timedelta(
+            seconds=rng.randint(-(2**40) // 1000, (2**40) // 1000),
+            microseconds=rng.randint(0, 999999))
+
+
+class ArrayGen(DataGen):
+    def __init__(self, child: DataGen, max_len: int = 5, **kw):
+        super().__init__(t.ArrayType(child.dtype), **kw)
+        self.child = child
+        self.max_len = max_len
+
+    def _gen_value(self, rng):
+        return [self.child.gen(rng)
+                for _ in range(rng.randint(0, self.max_len))]
+
+
+class StructGen(DataGen):
+    def __init__(self, fields: Sequence[Tuple[str, DataGen]], **kw):
+        super().__init__(
+            t.StructType([t.StructField(n, g.dtype) for n, g in fields]), **kw)
+        self.fields = list(fields)
+
+    def _gen_value(self, rng):
+        return {n: g.gen(rng) for n, g in self.fields}
+
+
+# standard generator sets (mirrors data_gen.py's canonical lists)
+int_gens = [ByteGen(), ShortGen(), IntegerGen(), LongGen()]
+numeric_gens = int_gens + [FloatGen(), DoubleGen()]
+all_basic_gens = numeric_gens + [BooleanGen(), StringGen()]
+
+
+def gen_table(columns: Sequence[Tuple[str, DataGen]], length: int = 2048,
+              seed: int = 0) -> pa.Table:
+    rng = random.Random(seed)
+    arrays = {}
+    for name, g in columns:
+        vals = [g.gen(rng) for _ in range(length)]
+        arrays[name] = pa.array(vals, type=to_arrow_type(g.dtype))
+    return pa.table(arrays)
+
+
+def gen_df(session, columns, length: int = 2048, seed: int = 0,
+           num_partitions: int = 1):
+    return session.create_dataframe(gen_table(columns, length, seed),
+                                    num_partitions=num_partitions)
+
+
+def two_col_df(session, a: DataGen, b: DataGen, length=2048, seed=0):
+    return gen_df(session, [("a", a), ("b", b)], length, seed)

@@ -1,0 +1,29 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh BEFORE any
+jax import, so TPU-path kernels and multi-chip sharding run hermetically
+(the driver separately dry-runs multichip via __graft_entry__)."""
+
+import os
+
+os.environ["PALLAS_AXON_POOL_IPS"] = ""   # disable the axon TPU tunnel
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    xla_flags += " --xla_force_host_platform_device_count=8"
+if "xla_cpu_enable_fast_math" not in xla_flags:
+    # fast-math breaks IEEE inf/nan semantics (floor(inf) -> nan)
+    xla_flags += " --xla_cpu_enable_fast_math=false"
+os.environ["XLA_FLAGS"] = xla_flags.strip()
+
+# the axon sitecustomize imports jax at interpreter start, so env vars are
+# too late — steer the (not-yet-initialized) backend via config directly
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tpu_session():
+    from spark_rapids_tpu.api.session import TpuSession
+    return TpuSession.builder().get_or_create()

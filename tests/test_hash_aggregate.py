@@ -1,0 +1,127 @@
+"""Hash aggregate differential tests (model: integration_tests/
+hash_aggregate_test.py — the reference's first-line aggregate coverage)."""
+
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col, lit
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect)
+from spark_rapids_tpu.testing.data_gen import (
+    ByteGen, DoubleGen, FloatGen, IntegerGen, LongGen, ShortGen, StringGen,
+    gen_df)
+
+_int_key_gens = [ByteGen(), ShortGen(), IntegerGen(), LongGen()]
+
+
+@pytest.mark.parametrize("key_gen", _int_key_gens,
+                         ids=lambda g: type(g).__name__)
+def test_group_by_sum_int_keys(key_gen):
+    def q(spark):
+        df = gen_df(spark, [("k", key_gen), ("v", LongGen())], length=512)
+        return df.group_by(col("k")).agg(F.sum(col("v")).alias("s"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_group_by_sum_avg_count():
+    def q(spark):
+        df = gen_df(spark, [("k", IntegerGen()), ("v", LongGen()),
+                            ("f", DoubleGen(no_nans=True))], length=1024)
+        return df.group_by(col("k")).agg(
+            F.sum(col("v")).alias("sv"),
+            F.avg(col("f")).alias("af"),
+            F.count(col("v")).alias("cv"),
+            F.count("*").alias("c"))
+    assert_tpu_and_cpu_are_equal_collect(q, approximate_float=1e-9)
+
+
+def test_group_by_min_max():
+    def q(spark):
+        df = gen_df(spark, [("k", IntegerGen()), ("v", LongGen()),
+                            ("f", DoubleGen(no_nans=True))], length=1024)
+        return df.group_by(col("k")).agg(
+            F.min(col("v")).alias("mn"), F.max(col("v")).alias("mx"),
+            F.min(col("f")).alias("fmn"), F.max(col("f")).alias("fmx"))
+    assert_tpu_and_cpu_are_equal_collect(q, approximate_float=1e-9)
+
+
+def test_group_by_string_keys():
+    def q(spark):
+        df = gen_df(spark, [("k", StringGen(max_len=8)), ("v", LongGen())],
+                    length=1024)
+        return df.group_by(col("k")).agg(F.sum(col("v")).alias("s"),
+                                         F.count("*").alias("c"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_global_aggregate():
+    def q(spark):
+        df = gen_df(spark, [("v", LongGen()), ("f", DoubleGen(no_nans=True))],
+                    length=777)
+        return df.agg(F.sum(col("v")).alias("s"),
+                      F.count("*").alias("c"),
+                      F.avg(col("f")).alias("a"))
+    assert_tpu_and_cpu_are_equal_collect(q, approximate_float=1e-9)
+
+
+def test_global_aggregate_empty_input():
+    def q(spark):
+        df = gen_df(spark, [("v", LongGen())], length=64)
+        return df.filter(lit(False)).agg(F.count("*").alias("c"),
+                                         F.sum(col("v")).alias("s"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_group_by_with_nulls_in_keys():
+    def q(spark):
+        df = gen_df(spark, [("k", IntegerGen(null_prob=0.5)),
+                            ("v", LongGen())], length=512)
+        return df.group_by(col("k")).agg(F.sum(col("v")).alias("s"),
+                                         F.count("*").alias("c"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_group_by_multiple_keys():
+    def q(spark):
+        df = gen_df(spark, [("k1", IntegerGen()), ("k2", StringGen(max_len=4)),
+                            ("k3", ByteGen()), ("v", LongGen())], length=2048)
+        return df.group_by(col("k1"), col("k2"), col("k3")).agg(
+            F.sum(col("v")).alias("s"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_first_last():
+    # first/last are order-dependent: use a sorted single-partition input
+    def q(spark):
+        df = spark.create_dataframe({
+            "k": [1, 1, 1, 2, 2, 3],
+            "v": [10, None, 30, 40, 50, None]})
+        return df.group_by(col("k")).agg(
+            F.first(col("v")).alias("f"),
+            F.last(col("v")).alias("l"),
+            F.first(col("v"), ignorenulls=True).alias("fn"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_stddev_variance():
+    def q(spark):
+        df = gen_df(spark, [("k", IntegerGen(lo=0, hi=20)),
+                            ("v", DoubleGen(no_nans=True))], length=1024)
+        df = df.filter(col("v").is_not_null() &
+                       (F.abs(col("v")) < lit(1e6)))
+        return df.group_by(col("k")).agg(
+            F.stddev(col("v")).alias("sd"),
+            F.var_pop(col("v")).alias("vp"),
+            F.count("*").alias("c"))
+    assert_tpu_and_cpu_are_equal_collect(q, approximate_float=1e-6)
+
+
+def test_avg_overflow_like_reference_config():
+    """BASELINE.json config 1: single-partition GROUP BY SUM/AVG int/long."""
+    def q(spark):
+        df = gen_df(spark, [("k", LongGen()), ("i", IntegerGen()),
+                            ("l", LongGen())], length=4096)
+        return df.group_by(col("k")).agg(
+            F.sum(col("i")).alias("si"), F.avg(col("i")).alias("ai"),
+            F.sum(col("l")).alias("sl"), F.avg(col("l")).alias("al"))
+    assert_tpu_and_cpu_are_equal_collect(q, approximate_float=1e-9)
