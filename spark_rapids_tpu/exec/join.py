@@ -1,0 +1,626 @@
+"""Join operators.
+
+Ref: sql-plugin/.../GpuHashJoin.scala:96-377 (HashJoinIterator),
+JoinGatherer.scala (gather-map chunked output),
+GpuShuffledHashJoinBase.scala, GpuBroadcastNestedLoopJoinExec.scala,
+GpuCartesianProductExec.scala.  Sort-merge joins are replaced by hash
+joins exactly like the reference (RapidsConf replaceSortMergeJoin).
+
+TPU realization (ops/join_kernels.py): build side concatenates and its
+combined 64-bit key hash sorts once; each probe batch runs a jitted
+count phase (binary-search match ranges + exact output sizing incl.
+string bytes), one host sync picks the bucketed output capacity, and a
+jitted expand phase materializes gather maps for both sides — the
+static-shape answer to cuDF's dynamic gather maps.
+
+CpuJoinExec is an independent pyarrow Table.join implementation (CPU
+fallback engine + differential oracle).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import types as t
+from ..columnar.device import (DEFAULT_CHAR_BUCKETS, DEFAULT_ROW_BUCKETS,
+                               DeviceBatch, DeviceColumn, batch_to_arrow,
+                               batch_to_device, bucket_for)
+from ..expr.core import (BoundReference, EvalContext, Expression,
+                         bind_expression)
+from ..expr.predicates import And, EqualTo
+from ..ops import join_kernels as jk
+from ..ops.gather import gather_batch, gather_column
+from .base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU, Batch,
+                   Exec, MetricTimer)
+from .concat import concat_batches
+from .filter_common import apply_filter, compact
+
+JOIN_TYPES = ("inner", "left", "right", "full", "left_semi", "left_anti",
+              "cross")
+
+
+def split_equi_condition(cond: Optional[Expression], left_names, right_names
+                         ) -> Tuple[List[Expression], List[Expression],
+                                    Optional[Expression]]:
+    """Split a join condition into equi key pairs + residual
+    (ref GpuHashJoin extractTopLevelAttributes / Spark's ExtractEquiJoinKeys)."""
+    from ..expr.core import AttributeReference
+    lset, rset = set(left_names), set(right_names)
+
+    def refs(e: Expression):
+        return {x.name for x in e.collect(
+            lambda n: isinstance(n, AttributeReference))}
+
+    conjuncts: List[Expression] = []
+
+    def flatten(e):
+        if isinstance(e, And):
+            flatten(e.children[0])
+            flatten(e.children[1])
+        else:
+            conjuncts.append(e)
+    if cond is not None:
+        flatten(cond)
+    lkeys, rkeys, residual = [], [], []
+    for c in conjuncts:
+        if isinstance(c, EqualTo):
+            a, b = c.children
+            ra, rb = refs(a), refs(b)
+            if ra <= lset and rb <= rset and ra and rb:
+                lkeys.append(a)
+                rkeys.append(b)
+                continue
+            if ra <= rset and rb <= lset and ra and rb:
+                lkeys.append(b)
+                rkeys.append(a)
+                continue
+        residual.append(c)
+    res = None
+    for c in residual:
+        res = c if res is None else And(res, c)
+    return lkeys, rkeys, res
+
+
+class HashJoinExec(Exec):
+    """TPU equi-join; build side is always the right child
+    (right joins are planned flipped, like the reference's build-side
+    selection in GpuShuffledHashJoinBase)."""
+
+    def __init__(self, left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression], how: str,
+                 condition: Optional[Expression],
+                 left: Exec, right: Exec):
+        super().__init__([left, right])
+        assert how in JOIN_TYPES
+        self.how = how
+        self.left_keys = [bind_expression(k, left.output_names,
+                                          left.output_types)
+                          for k in left_keys]
+        self.right_keys = [bind_expression(k, right.output_names,
+                                           right.output_types)
+                           for k in right_keys]
+        self.condition = condition
+        self._bound_condition = (
+            bind_expression(condition, self.output_names, self.output_types)
+            if condition is not None else None)
+
+    @property
+    def output_names(self):
+        l, r = self.children
+        if self.how in ("left_semi", "left_anti"):
+            return l.output_names
+        return l.output_names + r.output_names
+
+    @property
+    def output_types(self):
+        l, r = self.children
+        lt = list(l.output_types)
+        rt = list(r.output_types)
+        if self.how in ("left_semi", "left_anti"):
+            return lt
+        return lt + rt
+
+    @property
+    def num_partitions(self):
+        return self.children[0].num_partitions
+
+    def describe(self):
+        ks = ", ".join(f"{a.sql()}={b.sql()}"
+                       for a, b in zip(self.left_keys, self.right_keys))
+        return f"HashJoin {self.how} on [{ks}]"
+
+    # --- phase 1: count + sizing -------------------------------------------
+    def _count(self, xp, build: Batch, probe: Batch):
+        bctx = EvalContext(xp, build)
+        pctx = EvalContext(xp, probe)
+        bkeys = [k.eval(bctx).col for k in self.right_keys]
+        pkeys = [k.eval(pctx).col for k in self.left_keys]
+        blive = bctx.row_mask()
+        plive = pctx.row_mask()
+        bh = jk.combined_key_hash(xp, bkeys, build.capacity, side="build")
+        ph = jk.combined_key_hash(xp, pkeys, probe.capacity, side="probe")
+        order, lo, counts = jk.count_matches(xp, bh, blive, ph, plive)
+        outer = self.how in ("left", "full")
+        eff = xp.maximum(counts, 1) if outer else counts
+        eff = xp.where(plive, eff, 0)
+        total = xp.sum(eff)
+        # string sizing
+        pbytes = []
+        for c in probe.columns:
+            if isinstance(c.dtype, (t.StringType, t.BinaryType)):
+                lens = (c.offsets[1:] - c.offsets[:-1]).astype(xp.int64)
+                pbytes.append(xp.sum(eff * lens))
+            else:
+                pbytes.append(xp.int64(0) if xp is not np else np.int64(0))
+        bbytes = []
+        for c in build.columns:
+            if isinstance(c.dtype, (t.StringType, t.BinaryType)):
+                lens = (c.offsets[1:] - c.offsets[:-1]).astype(xp.int64)
+                sl = lens[order]
+                pre = xp.concatenate([xp.zeros((1,), xp.int64),
+                                      xp.cumsum(sl)])
+                per = pre[lo + counts.astype(xp.int32)] - pre[lo]
+                bbytes.append(xp.sum(xp.where(plive, per, 0)))
+            else:
+                bbytes.append(xp.int64(0) if xp is not np else np.int64(0))
+        matched = jk.build_matched_flags(xp, order, lo, counts, plive,
+                                         build.capacity)
+        return (order, lo, counts, total,
+                tuple(pbytes), tuple(bbytes), matched)
+
+    @functools.cached_property
+    def _jit_count(self):
+        return jax.jit(lambda b, p: self._count(jnp, b, p))
+
+    # --- phase 2: expansion -------------------------------------------------
+    def _expand(self, xp, build: Batch, probe: Batch, order, lo, counts,
+                out_cap: int, pchar_caps, bchar_caps) -> Batch:
+        plive = xp.arange(probe.capacity, dtype=np.int32) < probe.num_rows
+        (pidx, bidx, pair_valid, pvalid, bvalid, total) = jk.expand_pairs(
+            xp, order, lo, counts, plive, out_cap, self.how)
+        lcols = [gather_column(xp, c, pidx, pvalid, cc)
+                 for c, cc in zip(probe.columns, pchar_caps)]
+        rcols = [gather_column(xp, c, bidx, bvalid, cc)
+                 for c, cc in zip(build.columns, bchar_caps)]
+        return DeviceBatch(lcols + rcols, total, self.output_names)
+
+    def _expand_call(self, xp, build, probe, order, lo, counts, out_cap,
+                     pchar_caps, bchar_caps):
+        if xp is np:
+            return self._expand(np, build, probe, order, lo, counts,
+                                out_cap, pchar_caps, bchar_caps)
+        key = (out_cap, tuple(pchar_caps), tuple(bchar_caps))
+        cache = getattr(self, "_expand_cache", None)
+        if cache is None:
+            cache = self._expand_cache = {}
+        fn = cache.get(key)
+        if fn is None:
+            fn = jax.jit(lambda b, p, o, l, c: self._expand(
+                jnp, b, p, o, l, c, out_cap, pchar_caps, bchar_caps))
+            cache[key] = fn
+        return fn(build, probe, order, lo, counts)
+
+    # --- unmatched build rows for right/full --------------------------------
+    def _unmatched_build(self, xp, build: Batch, matched_any) -> Batch:
+        keep = (xp.arange(build.capacity, dtype=np.int32) < build.num_rows) \
+            & ~matched_any
+        compacted = compact(xp, build, keep, self.children[1].output_names)
+        n = compacted.num_rows
+        from ..expr.core import EvalContext as EC, all_null_column
+        ctx = EC(xp, compacted)
+        lcols = [all_null_column(ctx, dt).col
+                 for dt in self.children[0].output_types]
+        return DeviceBatch(lcols + list(compacted.columns), n,
+                           self.output_names)
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        xp = self.xp
+        on_tpu = self.placement == TPU
+        right = self.children[1]
+        build_batches = []
+        for bpid in range(right.num_partitions) if right.num_partitions > 1 \
+                else [pid]:
+            build_batches += list(right.execute_partition(
+                bpid if right.num_partitions > 1 else 0, ctx))
+        if not build_batches:
+            from ..columnar.interop import to_arrow_schema
+            schema = to_arrow_schema(right.output_names, right.output_types)
+            rb = pa.RecordBatch.from_pydict(
+                {n: pa.array([], type=f.type)
+                 for n, f in zip(schema.names, schema)})
+            build_batches = [batch_to_device(rb, xp=xp)]
+        build = concat_batches(xp, build_batches, right.output_names,
+                               right.output_types) \
+            if len(build_batches) > 1 else build_batches[0]
+        matched_acc = None
+        for probe in self.children[0].execute_partition(pid, ctx):
+            with MetricTimer(self.metrics[OP_TIME]):
+                if on_tpu:
+                    (order, lo, counts, total, pbytes, bbytes,
+                     matched) = self._jit_count(build, probe)
+                else:
+                    (order, lo, counts, total, pbytes, bbytes,
+                     matched) = self._count(np, build, probe)
+                if self.how in ("right", "full"):
+                    matched_acc = matched if matched_acc is None else \
+                        (matched_acc | matched)
+                if self.how == "left_semi":
+                    keep = counts > 0
+                    live = xp.arange(probe.capacity, dtype=np.int32) < \
+                        probe.num_rows
+                    yield compact(xp, probe, keep & live, self.output_names)
+                    continue
+                if self.how == "left_anti":
+                    live = xp.arange(probe.capacity, dtype=np.int32) < \
+                        probe.num_rows
+                    yield compact(xp, probe, (counts == 0) & live,
+                                  self.output_names)
+                    continue
+                if self.how == "right":
+                    # planned flipped; only unmatched emission remains here
+                    pass
+                ntotal = int(total)
+                out_cap = bucket_for(max(ntotal, 1), DEFAULT_ROW_BUCKETS)
+                pchar_caps = [bucket_for(max(int(x), 1),
+                                         DEFAULT_CHAR_BUCKETS)
+                              if isinstance(c.dtype, (t.StringType,
+                                                      t.BinaryType)) else 0
+                              for x, c in zip(pbytes, probe.columns)]
+                bchar_caps = [bucket_for(max(int(x), 1),
+                                         DEFAULT_CHAR_BUCKETS)
+                              if isinstance(c.dtype, (t.StringType,
+                                                      t.BinaryType)) else 0
+                              for x, c in zip(bbytes, build.columns)]
+                out = self._expand_call(xp, build, probe, order, lo, counts,
+                                        out_cap, pchar_caps, bchar_caps)
+                if self._bound_condition is not None and \
+                        self.how == "inner":
+                    pctx = EvalContext(xp, out)
+                    pred = self._bound_condition.eval(pctx)
+                    out = apply_filter(xp, out, pred, self.output_names)
+            self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+            self.metrics[NUM_OUTPUT_BATCHES] += 1
+            yield out
+        if self.how in ("right", "full") and matched_acc is not None:
+            out = self._unmatched_build(xp, build, matched_acc)
+            if int(out.num_rows):
+                yield out
+
+
+class NestedLoopJoinExec(Exec):
+    """Cross product + optional condition (ref
+    GpuBroadcastNestedLoopJoinExec / GpuCartesianProductExec)."""
+
+    def __init__(self, how: str, condition: Optional[Expression],
+                 left: Exec, right: Exec):
+        super().__init__([left, right])
+        self.how = how
+        self.condition = condition
+        self._bound_condition = (
+            bind_expression(condition, self.output_names, self.output_types)
+            if condition is not None else None)
+
+    @property
+    def output_names(self):
+        return self.children[0].output_names + self.children[1].output_names
+
+    @property
+    def output_types(self):
+        return self.children[0].output_types + self.children[1].output_types
+
+    @property
+    def num_partitions(self):
+        return self.children[0].num_partitions
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        xp = self.xp
+        right = self.children[1]
+        rbatches = []
+        for rp in range(right.num_partitions):
+            rbatches += list(right.execute_partition(rp, ctx))
+        if not rbatches:
+            return
+        build = concat_batches(xp, rbatches, right.output_names,
+                               right.output_types) if len(rbatches) > 1 \
+            else rbatches[0]
+        nb = int(build.num_rows)
+        for probe in self.children[0].execute_partition(pid, ctx):
+            np_rows = int(probe.num_rows)
+            total = np_rows * nb
+            out_cap = bucket_for(max(total, 1), DEFAULT_ROW_BUCKETS)
+            pidx = xp.arange(out_cap, dtype=np.int32) // max(nb, 1)
+            bidx = xp.arange(out_cap, dtype=np.int32) % max(nb, 1)
+            valid = xp.arange(out_cap, dtype=np.int32) < total
+            pchar = [int(c.data.shape[0]) * max(nb, 1)
+                     if isinstance(c.dtype, (t.StringType, t.BinaryType))
+                     else 0 for c in probe.columns]
+            bchar = [int(c.data.shape[0]) * max(np_rows, 1)
+                     if isinstance(c.dtype, (t.StringType, t.BinaryType))
+                     else 0 for c in build.columns]
+            lcols = [gather_column(xp, c, pidx, valid,
+                                   bucket_for(max(cc, 1),
+                                              DEFAULT_CHAR_BUCKETS)
+                                   if cc else 0)
+                     for c, cc in zip(probe.columns, pchar)]
+            rcols = [gather_column(xp, c, bidx, valid,
+                                   bucket_for(max(cc, 1),
+                                              DEFAULT_CHAR_BUCKETS)
+                                   if cc else 0)
+                     for c, cc in zip(build.columns, bchar)]
+            out = DeviceBatch(lcols + rcols, total, self.output_names)
+            if self._bound_condition is not None:
+                ectx = EvalContext(xp, out)
+                out = apply_filter(xp, out, self._bound_condition.eval(ectx),
+                                   self.output_names)
+            yield out
+
+
+# ---------------------------------------------------------------------------
+# CPU fallback: pyarrow Table.join
+# ---------------------------------------------------------------------------
+
+_PA_JOIN = {"inner": "inner", "left": "left outer", "right": "right outer",
+            "full": "full outer", "left_semi": "left semi",
+            "left_anti": "left anti"}
+
+
+class CpuJoinExec(Exec):
+    def __init__(self, left_keys, right_keys, how, condition,
+                 left: Exec, right: Exec, coalesce_keys: bool = False):
+        super().__init__([left, right])
+        self.how = how
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.condition = condition
+        self.coalesce_keys = coalesce_keys
+
+    @property
+    def output_names(self):
+        l, r = self.children
+        if self.how in ("left_semi", "left_anti"):
+            return l.output_names
+        return l.output_names + r.output_names
+
+    @property
+    def output_types(self):
+        l, r = self.children
+        if self.how in ("left_semi", "left_anti"):
+            return list(l.output_types)
+        return list(l.output_types) + list(r.output_types)
+
+    @property
+    def num_partitions(self):
+        return self.children[0].num_partitions
+
+    def describe(self):
+        return f"CpuJoin {self.how}"
+
+    def _collect_side(self, side: int, ctx, pid=None) -> pa.Table:
+        child = self.children[side]
+        rbs = []
+        pids = range(child.num_partitions) if pid is None else [pid]
+        for p in pids:
+            for b in child.execute_partition(p, ctx):
+                rb = batch_to_arrow(DeviceBatch(b.columns, b.num_rows,
+                                                child.output_names))
+                if rb.num_rows:
+                    rbs.append(rb)
+        from ..columnar.interop import to_arrow_schema
+        schema = to_arrow_schema(child.output_names, child.output_types)
+        if not rbs:
+            return schema.empty_table()
+        return pa.Table.from_batches([r.cast(schema) for r in rbs])
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        import pyarrow.compute as pc
+        left = self._collect_side(0, ctx, pid)
+        right = self._collect_side(1, ctx)
+        # materialize key columns (they may be expressions)
+        lkn, rkn = [], []
+        lt, rt = left, right
+        for i, (lk, rk) in enumerate(zip(self.left_keys, self.right_keys)):
+            ln_, rn_ = f"__lk{i}", f"__rk{i}"
+            lt = lt.append_column(ln_, _eval_arrow(lk, left,
+                                                   self.children[0]))
+            rt = rt.append_column(rn_, _eval_arrow(rk, right,
+                                                   self.children[1]))
+            lkn.append(ln_)
+            rkn.append(rn_)
+        # avoid output name collisions: temporarily rename
+        lnames = [f"l_{i}" for i in range(len(left.schema.names))]
+        rnames = [f"r_{i}" for i in range(len(right.schema.names))]
+        lt = lt.rename_columns(lnames + lkn)
+        rt = rt.rename_columns(rnames + rkn)
+        # Spark equi-joins never match null keys; split them out so Acero's
+        # null handling can't differ
+        def null_key_mask(tbl, keys):
+            m = None
+            for k in keys:
+                kn = pc.is_null(tbl.column(k))
+                m = kn if m is None else pc.or_(m, kn)
+            return m
+        l_null = null_key_mask(lt, lkn)
+        r_null = null_key_mask(rt, rkn)
+        lt_nn = lt.filter(pc.invert(l_null)) if l_null is not None else lt
+        rt_nn = rt.filter(pc.invert(r_null)) if r_null is not None else rt
+        joined = lt_nn.join(rt_nn, keys=lkn, right_keys=rkn,
+                            join_type=_PA_JOIN[self.how],
+                            coalesce_keys=False, use_threads=False)
+        if self.how in ("left_semi", "left_anti"):
+            out = joined.select(lnames).rename_columns(
+                self.children[0].output_names)
+            if self.how == "left_anti" and l_null is not None:
+                extra = lt.filter(l_null).select(lnames).rename_columns(
+                    self.children[0].output_names)
+                out = pa.concat_tables([out, extra]) if extra.num_rows else out
+        else:
+            out = joined.select(lnames + rnames).rename_columns(
+                self.output_names)
+            if self.how in ("left", "full") and l_null is not None:
+                nulls_l = lt.filter(l_null).select(lnames)
+                if nulls_l.num_rows:
+                    pad = {n: pa.nulls(nulls_l.num_rows, f.type)
+                           for n, f in zip(rnames,
+                                           [rt.schema.field(x)
+                                            for x in rnames])}
+                    extra = nulls_l.rename_columns(
+                        self.children[0].output_names)
+                    for (n, arr), on in zip(pad.items(),
+                                            self.children[1].output_names):
+                        extra = extra.append_column(on, arr)
+                    out = pa.concat_tables(
+                        [out, extra.rename_columns(self.output_names)])
+            if self.how in ("right", "full") and r_null is not None:
+                nulls_r = rt.filter(r_null).select(rnames)
+                if nulls_r.num_rows:
+                    extra = pa.table(
+                        {n: pa.nulls(nulls_r.num_rows,
+                                     lt.schema.field(ln).type)
+                         for n, ln in zip(self.children[0].output_names,
+                                          lnames)})
+                    for arr, on in zip(nulls_r.columns,
+                                       self.children[1].output_names):
+                        extra = extra.append_column(on, arr)
+                    out = pa.concat_tables(
+                        [out, extra.rename_columns(self.output_names)])
+        if self.condition is not None:
+            mask = _eval_arrow(self.condition, out, self)
+            if self.how == "inner":
+                out = out.filter(mask)
+            elif self.how in ("left", "full", "right"):
+                # outer conditional joins: keep unmatched semantics by
+                # filtering matched pairs only — fall back to pandas
+                raise NotImplementedError(
+                    "conditional outer join on CPU engine")
+        from ..columnar.interop import to_arrow_schema
+        schema = to_arrow_schema(self.output_names, self.output_types)
+        out = out.cast(schema)
+        for rb in out.combine_chunks().to_batches():
+            yield batch_to_device(rb, xp=np)
+
+
+def _eval_arrow(expr: Expression, table: pa.Table, child_like) -> pa.Array:
+    """Evaluate an expression over an arrow table via the numpy engine."""
+    from ..columnar.device import batch_to_device, column_to_arrow
+    from ..expr.core import ColumnValue, EvalContext, make_column
+    names = child_like.output_names
+    dtypes = child_like.output_types
+    tbl = table.rename_columns(names) if list(table.schema.names) != names \
+        else table
+    tbl = tbl.combine_chunks()
+    rbs = tbl.to_batches() or [pa.RecordBatch.from_pydict(
+        {n: pa.array([], type=f.type) for n, f in
+         zip(tbl.schema.names, tbl.schema)})]
+    outs = []
+    bound = bind_expression(expr, names, dtypes)
+    for rb in rbs:
+        b = batch_to_device(rb, xp=np)
+        ec = EvalContext(np, b)
+        v = bound.eval(ec)
+        if not isinstance(v, ColumnValue):
+            v = make_column(ec, bound.data_type(),
+                            v.value if v.value is not None else 0,
+                            None if v.value is not None else False)
+        outs.append(column_to_arrow(v.col, rb.num_rows))
+    return pa.chunked_array(outs) if len(outs) > 1 else outs[0]
+
+
+# ---------------------------------------------------------------------------
+# planning
+# ---------------------------------------------------------------------------
+
+def plan_join(lp, left: Exec, right: Exec, conf) -> Exec:
+    """Logical Join -> physical (ref GpuOverrides join rules +
+    ExtractEquiJoinKeys)."""
+    from ..expr.core import AttributeReference, Alias
+    from ..plan import logical as L
+    how = lp.how
+    cond = lp.condition
+    using = lp.using
+    if using:
+        c = None
+        for k in using:
+            eq = EqualTo(AttributeReference(k), AttributeReference(k))
+            # disambiguate: bind left occurrence to left, right to right
+            c = eq if c is None else And(c, eq)
+        lkeys = [AttributeReference(k) for k in using]
+        rkeys = [AttributeReference(k) for k in using]
+        residual = None
+    else:
+        lkeys, rkeys, residual = split_equi_condition(
+            cond, left.output_names, right.output_names)
+    if left.num_partitions > 1:
+        from .gatherpart import GatherPartitionsExec
+        left = GatherPartitionsExec(left)
+    if right.num_partitions > 1:
+        from .gatherpart import GatherPartitionsExec
+        right = GatherPartitionsExec(right)
+
+    if how == "cross" or (not lkeys and how == "inner" and cond is not None) \
+            or (not lkeys and cond is None and how == "cross"):
+        return NestedLoopJoinExec("cross" if how == "cross" else how,
+                                  cond, left, right)
+    if not lkeys and how == "inner" and cond is None:
+        return NestedLoopJoinExec("cross", None, left, right)
+    if not lkeys:
+        raise NotImplementedError(
+            f"non-equi {how} join is not supported yet")
+
+    flipped = False
+    if how == "right":
+        left, right = right, left
+        lkeys, rkeys = rkeys, lkeys
+        how = "left"
+        flipped = True
+
+    join: Exec = CpuJoinExec(lkeys, rkeys, how, residual, left, right)
+    out_exec = join
+    if flipped or using:
+        from .basic import ProjectExec
+        names = join.output_names
+        types = join.output_types
+        nl = len(left.output_names)
+        if flipped:
+            # output order: original-left (= current right side) first
+            exprs = [BoundReference(nl + i, types[nl + i], names[nl + i])
+                     for i in range(len(right.output_names))] + \
+                    [BoundReference(i, types[i], names[i])
+                     for i in range(nl)]
+            out_exec = ProjectExec(
+                [Alias(e, e.name) for e in exprs], join)
+            names = out_exec.output_names
+            types = out_exec.output_types
+        if using and how not in ("left_semi", "left_anti"):
+            from ..expr.conditional import Coalesce
+            lnames = lp.children[0].schema()[0]
+            rnames = lp.children[1].schema()[0]
+            n_l = len(lnames)
+            exprs = []
+            for k in using:
+                li = lnames.index(k)
+                ri = n_l + rnames.index(k)
+                if lp.how == "full":
+                    exprs.append(Alias(Coalesce(
+                        BoundReference(li, types[li], k),
+                        BoundReference(ri, types[ri], k)), k))
+                elif lp.how == "right":
+                    exprs.append(Alias(
+                        BoundReference(ri, types[ri], k), k))
+                else:
+                    exprs.append(Alias(
+                        BoundReference(li, types[li], k), k))
+            for i, n in enumerate(lnames):
+                if n not in using:
+                    exprs.append(Alias(BoundReference(i, types[i], n), n))
+            for j, n in enumerate(rnames):
+                if n not in using:
+                    exprs.append(Alias(
+                        BoundReference(n_l + j, types[n_l + j], n), n))
+            out_exec = ProjectExec(exprs, out_exec)
+    return out_exec

@@ -1,0 +1,95 @@
+"""Sort operator.
+
+Ref: sql-plugin/.../GpuSortExec.scala:39-534 (single-batch, per-batch and
+out-of-core modes) + SortUtils.scala.
+
+TPU realization: order-preserving uint64 key-word encoding per sort column
+(ops/segmented.key_words_for_column with true string ordering) feeding one
+stable multi-operand lax.sort; rows then move via gather.  Multi-batch
+partitions concatenate before sorting (spillable out-of-core merge arrives
+with the memory framework; the concat path is the reference's
+single-batch-goal mode).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as t
+from ..columnar.device import DeviceBatch
+from ..expr.core import EvalContext, Expression, bind_expression
+from ..ops import segmented as seg
+from ..ops.gather import gather_batch
+from .base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU, Batch,
+                   Exec, MetricTimer)
+from .concat import concat_batches
+
+
+class SortExec(Exec):
+    """orders: [(expr, ascending, nulls_first)]."""
+
+    def __init__(self, orders, child: Exec, is_global: bool = True):
+        super().__init__([child])
+        self.orders = list(orders)
+        self.is_global = is_global
+        cn, ct = child.output_names, child.output_types
+        self._bound = [(bind_expression(e, cn, ct), asc, nf)
+                       for e, asc, nf in self.orders]
+
+    @property
+    def output_names(self):
+        return self.children[0].output_names
+
+    @property
+    def output_types(self):
+        return self.children[0].output_types
+
+    def describe(self):
+        os = ", ".join(f"{e.sql()} {'ASC' if a else 'DESC'}"
+                       for e, a, _ in self._bound)
+        return f"Sort [{os}] global={self.is_global}"
+
+    def _sort_batch(self, xp, batch: Batch) -> Batch:
+        ctx = EvalContext(xp, batch)
+        live = ctx.row_mask()
+        words: List = [(~live).astype(xp.uint64)]  # padding last
+        for e, asc, nulls_first in self._bound:
+            v = e.eval(ctx)
+            from ..expr.core import ColumnValue, make_column
+            if not isinstance(v, ColumnValue):
+                v = make_column(ctx, e.data_type(),
+                                v.value if v.value is not None else 0,
+                                None if v.value is not None else False)
+            words += seg.key_words_for_column(
+                xp, v.col, live, for_grouping=False,
+                nulls_first=nulls_first, ascending=asc)
+        order = seg.lexsort(xp, words, batch.capacity)
+        out = gather_batch(xp, batch, order, live[order], batch.num_rows)
+        return DeviceBatch(out.columns, batch.num_rows, batch.names)
+
+    @functools.cached_property
+    def _jitted(self):
+        return jax.jit(lambda b: self._sort_batch(jnp, b))
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        xp = self.xp
+        batches = [b for b in self.children[0].execute_partition(pid, ctx)
+                   if int(b.num_rows) or True]
+        if not batches:
+            return
+        with MetricTimer(self.metrics[OP_TIME]):
+            if len(batches) > 1:
+                merged = concat_batches(xp, batches, self.output_names,
+                                        self.output_types)
+            else:
+                merged = batches[0]
+            out = self._jitted(merged) if self.placement == TPU \
+                else self._sort_batch(np, merged)
+        self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+        self.metrics[NUM_OUTPUT_BATCHES] += 1
+        yield out

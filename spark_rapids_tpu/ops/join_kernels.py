@@ -1,0 +1,144 @@
+"""Equi-join kernels: sorted-key binary-search probe + pair expansion.
+
+TPU replacement for cuDF's hash join (ref GpuHashJoin.scala /
+JoinGatherer.scala): instead of a device hash table, the build side's keys
+collapse to a single 64-bit combined hash, get sorted once, and each probe
+row finds its match range with two vectorized binary searches
+(searchsorted).  Pair expansion uses the same searchsorted-span technique
+as the string gather — all static shapes.
+
+Two-phase protocol (one host sync, like cuDF sizing its gather maps):
+  phase 1 (jitted `count_matches`): per-probe match ranges + totals;
+  host picks a bucketed output capacity;
+  phase 2 (jitted `expand_pairs`): materialize (probe_idx, build_idx,
+  probe_valid, build_valid) gather maps at that static capacity.
+
+Key hashing: per-column 64-bit words (value hash or content hash for
+strings) mixed with a splitmix-style combiner.  Equal keys always collide
+onto equal hashes; unequal keys collide with probability ~2^-64 —
+documented, same tradeoff as the string-equality design.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as t
+from ..columnar.device import DeviceColumn
+from . import strings as sops
+
+_MIX = np.uint64(0xBF58476D1CE4E5B9)
+_MIX2 = np.uint64(0x94D049BB133111EB)
+_NULL_BUILD = np.uint64(0x9E3779B97F4A7C15)   # sentinel: build-side null key
+_NULL_PROBE = np.uint64(0xC2B2AE3D27D4EB4F)   # distinct: probe-side null key
+
+
+def _mix64(xp, h):
+    h = (h ^ (h >> np.uint64(30))) * _MIX
+    h = (h ^ (h >> np.uint64(27))) * _MIX2
+    return h ^ (h >> np.uint64(31))
+
+
+def combined_key_hash(xp, key_cols, cap, null_matches: bool = False,
+                      side: str = "build"):
+    """uint64[cap] combined hash over the key columns; rows with any null
+    key get a side-specific sentinel so nulls never match (unless
+    null_matches, for null-safe equality)."""
+    from .segmented import encode_float_ordered, encode_int_ordered
+    h = xp.full((cap,), np.uint64(0x12345678DEADBEEF), dtype=xp.uint64)
+    any_null = xp.zeros((cap,), dtype=bool)
+    for col in key_cols:
+        dtype = col.dtype
+        if isinstance(dtype, (t.StringType, t.BinaryType)):
+            h1, h2 = sops.string_hashes(xp, col.offsets, col.data)
+            w = _mix64(xp, h1 ^ (h2 * _MIX))
+        elif isinstance(dtype, (t.FloatType, t.DoubleType)):
+            w = _mix64(xp, encode_float_ordered(xp, col.data))
+        elif isinstance(dtype, t.NullType):
+            w = xp.zeros((cap,), dtype=xp.uint64)
+        else:
+            w = _mix64(xp, encode_int_ordered(xp, col.data))
+        h = _mix64(xp, h ^ (w + np.uint64(0x9E3779B97F4A7C15) +
+                            (h << np.uint64(6)) + (h >> np.uint64(2))))
+        if col.validity is not None:
+            any_null = any_null | ~col.validity
+    if not null_matches:
+        sentinel = _NULL_BUILD if side == "build" else _NULL_PROBE
+        h = xp.where(any_null, sentinel + xp.arange(cap, dtype=xp.uint64)
+                     * xp.uint64(2654435761), h)
+    return h
+
+
+def count_matches(xp, build_hash, build_live, probe_hash, probe_live):
+    """Per-probe-row match ranges against the sorted build side.
+
+    Returns (sorted_build_order, lo, hi, counts) where build rows
+    sorted_build_order[lo[i]:hi[i]] match probe row i."""
+    cap_b = build_hash.shape[0]
+    # park dead build rows at +inf end
+    bh = xp.where(build_live, build_hash, xp.uint64(0xFFFFFFFFFFFFFFFF))
+    if xp is np:
+        order = np.argsort(bh, kind="stable").astype(np.int32)
+        sorted_h = bh[order]
+    else:
+        from jax import lax
+        iota = xp.arange(cap_b, dtype=xp.int32)
+        sorted_h, order = lax.sort((bh, iota), num_keys=1, is_stable=True)
+    lo = xp.searchsorted(sorted_h, probe_hash, side="left").astype(xp.int32)
+    hi = xp.searchsorted(sorted_h, probe_hash, side="right").astype(xp.int32)
+    counts = xp.where(probe_live, hi - lo, 0).astype(xp.int64)
+    return order, lo, counts
+
+
+def expand_pairs(xp, order, lo, counts, probe_live, out_cap: int,
+                 join_type: str = "inner"):
+    """Materialize the pair lists at static capacity `out_cap`.
+
+    Returns (probe_idx, build_idx, pair_valid, probe_side_valid,
+    build_side_valid, total).  For outer-left, probe rows with zero
+    matches emit one pair with build side invalid."""
+    outer_left = join_type in ("left", "full")
+    eff_counts = xp.maximum(counts, 1) if outer_left else counts
+    eff_counts = xp.where(probe_live, eff_counts, 0)
+    offs = xp.concatenate([xp.zeros((1,), xp.int64),
+                           xp.cumsum(eff_counts, dtype=xp.int64)])
+    total = offs[-1]
+    p = xp.arange(out_cap, dtype=xp.int64)
+    row = xp.clip(xp.searchsorted(offs[1:], p, side="right"),
+                  0, counts.shape[0] - 1).astype(xp.int32)
+    k = (p - offs[row]).astype(xp.int32)
+    pair_valid = p < total
+    matched = counts[row] > 0
+    build_pos = xp.clip(lo[row] + xp.minimum(k, xp.maximum(
+        counts[row].astype(xp.int32) - 1, 0)), 0, order.shape[0] - 1)
+    build_idx = order[build_pos]
+    build_valid = pair_valid & matched
+    probe_idx = row
+    probe_valid = pair_valid
+    return probe_idx, build_idx, pair_valid, probe_valid, build_valid, total
+
+
+def build_matched_flags(xp, order, lo, counts, probe_live, build_cap: int):
+    """bool[build_cap]: build rows matched by at least one probe row
+    (for right/full outer unmatched emission).  Scatter +1 at range starts
+    and -1 after range ends over sorted positions, prefix-sum."""
+    n = counts.shape[0]
+    delta = xp.zeros((build_cap + 1,), dtype=xp.int32)
+    starts = xp.clip(lo, 0, build_cap)
+    ends = xp.clip(lo + counts.astype(xp.int32), 0, build_cap)
+    live = probe_live & (counts > 0)
+    if xp is np:
+        np.add.at(delta, starts[live], 1)
+        np.add.at(delta, ends[live], -1)
+    else:
+        ones = live.astype(xp.int32)
+        delta = delta.at[starts].add(ones)
+        delta = delta.at[ends].add(-ones)
+    covered = xp.cumsum(delta[:-1]) > 0
+    # covered is in sorted-order positions; map back to original rows
+    matched = xp.zeros((build_cap,), dtype=bool)
+    if xp is np:
+        matched[order] = covered
+    else:
+        matched = matched.at[order].set(covered)
+    return matched

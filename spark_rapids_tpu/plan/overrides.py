@@ -217,6 +217,9 @@ class ExecMeta(BaseMeta):
             return [e.condition]
         if isinstance(e, (CpuHashAggregateExec,)):
             return list(e.grouping) + list(e.aggregates)
+        from ..exec.sort import SortExec as _SE
+        if isinstance(e, _SE):
+            return [o[0] for o in e.orders]
         return []
 
     def tag(self):
@@ -290,8 +293,46 @@ EXEC_SIGS: Dict[Type[eb.Exec], TypeSig] = {
     CpuHashAggregateExec: (T.common_scalar).nested(),
 }
 
+from ..exec.join import CpuJoinExec, HashJoinExec, NestedLoopJoinExec
+from ..exec.sort import SortExec
+
+EXEC_SIGS[SortExec] = T.common_scalar.nested()
+EXEC_SIGS[CpuJoinExec] = _exec_common
+EXEC_SIGS[NestedLoopJoinExec] = _exec_common
+EXEC_SIGS[HashJoinExec] = _exec_common
+
 EXEC_TAGS: Dict[Type[eb.Exec], Callable] = {}
 EXEC_CONVERTS: Dict[Type[eb.Exec], Callable] = {}
+
+
+def _convert_join(e: "CpuJoinExec", conf) -> eb.Exec:
+    j = HashJoinExec(e.left_keys, e.right_keys, e.how, e.condition,
+                     e.children[0], e.children[1])
+    j.placement = eb.TPU
+    return j
+
+
+def _tag_join(meta: "ExecMeta"):
+    e: CpuJoinExec = meta.exec
+    if e.condition is not None and e.how != "inner":
+        meta.will_not_work(
+            f"conditional {e.how} join is not supported on TPU")
+    # key types must be hash/equality-capable
+    l, r = e.children
+    for k in e.left_keys + e.right_keys:
+        names = l.output_names + r.output_names
+        dtypes = l.output_types + r.output_types
+        try:
+            b = bind_expression(k, l.output_names, l.output_types)
+        except Exception:
+            try:
+                b = bind_expression(k, r.output_names, r.output_types)
+            except Exception as ex:
+                meta.will_not_work(str(ex))
+                continue
+        dt = b.data_type()
+        if not (T.comparable + T.STRUCT).is_supported(dt):
+            meta.will_not_work(f"join key type {dt.name} not supported")
 
 
 def _convert_aggregate(e: CpuHashAggregateExec, conf) -> eb.Exec:
@@ -306,6 +347,8 @@ def _convert_aggregate(e: CpuHashAggregateExec, conf) -> eb.Exec:
 
 
 EXEC_CONVERTS[CpuHashAggregateExec] = _convert_aggregate
+EXEC_CONVERTS[CpuJoinExec] = _convert_join
+EXEC_TAGS[CpuJoinExec] = _tag_join
 
 
 def _tag_aggregate(meta: ExecMeta):

@@ -47,8 +47,11 @@ def plan(lp: L.LogicalPlan, conf) -> eb.Exec:
                          plan(lp.children[1], conf), conf)
     if isinstance(lp, L.Sort):
         from ..exec.sort import SortExec
-        return SortExec(lp.orders, plan(lp.children[0], conf),
-                        is_global=lp.is_global)
+        child = plan(lp.children[0], conf)
+        if lp.is_global and child.num_partitions > 1:
+            from ..exec.gatherpart import GatherPartitionsExec
+            child = GatherPartitionsExec(child)
+        return SortExec(lp.orders, child, is_global=lp.is_global)
     if isinstance(lp, L.Limit):
         child = plan(lp.children[0], conf)
         if child.num_partitions > 1:

@@ -1,0 +1,97 @@
+"""Join differential tests (model: integration_tests/join_test.py)."""
+
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col, lit
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect)
+from spark_rapids_tpu.testing.data_gen import (
+    IntegerGen, LongGen, StringGen, gen_df)
+
+ALL_JOINS = ["inner", "left", "right", "full", "left_semi", "left_anti"]
+
+
+def _sides(spark, key_gen, length=256):
+    a = gen_df(spark, [("k", key_gen), ("va", LongGen())],
+               length=length, seed=10)
+    b = gen_df(spark, [("k2", key_gen), ("vb", LongGen())],
+               length=length // 2, seed=20)
+    return a, b
+
+
+@pytest.mark.parametrize("how", ALL_JOINS)
+def test_equi_join_int_keys(how):
+    def q(spark):
+        a, b = _sides(spark, IntegerGen(lo=0, hi=50))
+        return a.join(b, on=(col("k") == col("k2")), how=how)
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "left_semi", "left_anti"])
+def test_equi_join_string_keys(how):
+    def q(spark):
+        a = gen_df(spark, [("k", StringGen(max_len=4)), ("va", LongGen())],
+                   length=256, seed=1)
+        b = gen_df(spark, [("k2", StringGen(max_len=4)), ("vb", LongGen())],
+                   length=128, seed=2)
+        return a.join(b, on=(col("k") == col("k2")), how=how)
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+@pytest.mark.parametrize("how", ALL_JOINS)
+def test_join_null_keys(how):
+    def q(spark):
+        a, b = _sides(spark, IntegerGen(lo=0, hi=5, null_prob=0.4), 64)
+        return a.join(b, on=(col("k") == col("k2")), how=how)
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_using_join():
+    def q(spark):
+        a = gen_df(spark, [("k", IntegerGen(lo=0, hi=20)),
+                           ("va", LongGen())], length=128, seed=3)
+        b = gen_df(spark, [("k", IntegerGen(lo=0, hi=20)),
+                           ("vb", LongGen())], length=64, seed=4)
+        return a.join(b, on="k", how="inner")
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_multi_key_join():
+    def q(spark):
+        a = gen_df(spark, [("k1", IntegerGen(lo=0, hi=8)),
+                           ("k2", IntegerGen(lo=0, hi=8)),
+                           ("va", LongGen())], length=256, seed=5)
+        b = gen_df(spark, [("j1", IntegerGen(lo=0, hi=8)),
+                           ("j2", IntegerGen(lo=0, hi=8)),
+                           ("vb", LongGen())], length=128, seed=6)
+        return a.join(b, on=(col("k1") == col("j1")) &
+                      (col("k2") == col("j2")), how="inner")
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_conditional_inner_join():
+    def q(spark):
+        a, b = _sides(spark, IntegerGen(lo=0, hi=20), 128)
+        return a.join(b, on=(col("k") == col("k2")) &
+                      (col("va") > col("vb")), how="inner")
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_cross_join():
+    def q(spark):
+        a = gen_df(spark, [("x", IntegerGen())], length=30, seed=7)
+        b = gen_df(spark, [("y", IntegerGen())], length=20, seed=8)
+        return a.join(b, how="cross")
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_join_then_aggregate():
+    """Join feeding aggregation (the TPC-DS bread-and-butter shape)."""
+    def q(spark):
+        a, b = _sides(spark, IntegerGen(lo=0, hi=30), 512)
+        return (a.join(b, on=(col("k") == col("k2")), how="inner")
+                 .group_by(col("k"))
+                 .agg(F.sum(col("va")).alias("sa"),
+                      F.count("*").alias("c")))
+    assert_tpu_and_cpu_are_equal_collect(q)

@@ -1,0 +1,49 @@
+"""Sort differential tests (model: integration_tests/sort_test.py)."""
+
+import pytest
+
+from spark_rapids_tpu.api.column import col
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect)
+from spark_rapids_tpu.testing.data_gen import (
+    DoubleGen, IntegerGen, LongGen, StringGen, gen_df)
+
+
+def test_sort_int_asc():
+    def q(spark):
+        df = gen_df(spark, [("a", IntegerGen()), ("b", LongGen())],
+                    length=512)
+        return df.order_by(col("a"), col("b"))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+
+
+def test_sort_desc_and_nulls():
+    def q(spark):
+        df = gen_df(spark, [("a", IntegerGen(null_prob=0.3)),
+                            ("b", LongGen())], length=512)
+        return df.order_by(col("a").desc(), col("b").asc())
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+
+
+def test_sort_doubles_with_nan():
+    def q(spark):
+        df = gen_df(spark, [("d", DoubleGen()), ("x", IntegerGen())],
+                    length=512)
+        return df.order_by(col("d"), col("x"))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+
+
+def test_sort_strings():
+    def q(spark):
+        df = gen_df(spark, [("s", StringGen(max_len=10)),
+                            ("x", IntegerGen())], length=512)
+        return df.order_by(col("s"), col("x"))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+
+
+def test_sort_multi_partition_global():
+    def q(spark):
+        df = gen_df(spark, [("a", IntegerGen()), ("b", LongGen())],
+                    length=1024, num_partitions=4)
+        return df.order_by(col("a"), col("b"))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
