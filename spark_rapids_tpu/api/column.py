@@ -146,6 +146,20 @@ class Column:
         from ..expr.strings import EndsWith
         return Column(EndsWith(self.expr, _expr(s)))
 
+    def over(self, window) -> "Column":
+        from ..expr.aggregates import AggregateExpression
+        from ..expr.window import WindowBuilder, WindowExpression
+        spec = window.spec if isinstance(window, WindowBuilder) else window
+        e = self.expr
+        if isinstance(e, Alias):
+            name = e.name
+            e = e.child
+        else:
+            name = self._alias
+        if isinstance(e, AggregateExpression):
+            e = e.func
+        return Column(WindowExpression(e, spec, name))
+
     def __repr__(self):
         return f"Column<{self.expr.sql()}>"
 

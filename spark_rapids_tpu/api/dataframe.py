@@ -46,19 +46,38 @@ class DataFrame:
 
     # -- transformations ----------------------------------------------------
     def select(self, *cols) -> "DataFrame":
+        from ..expr.window import WindowExpression
         exprs = []
         for c in cols:
             if isinstance(c, str) and c == "*":
                 exprs += [AttributeReference(n) for n in self.columns]
             else:
-                exprs.append(_to_expr(c))
+                e = _to_expr(c)
+                if isinstance(e, Alias) and isinstance(e.child,
+                                                       WindowExpression):
+                    e.child.name = e.name
+                    e = e.child
+                if isinstance(c, Column) and c._alias and \
+                        isinstance(e, WindowExpression):
+                    e.name = c._alias
+                exprs.append(e)
+        # route window expressions through a Window node, then project
+        windows = [e for e in exprs if isinstance(e, WindowExpression)]
+        if windows:
+            base = L.Window(windows, self._lp)
+            proj = []
+            for e in exprs:
+                if isinstance(e, WindowExpression):
+                    proj.append(AttributeReference(e.name))
+                else:
+                    proj.append(e)
+            return DataFrame(L.Project(proj, base), self.session)
         return DataFrame(L.Project(exprs, self._lp), self.session)
 
     def with_column(self, name: str, c) -> "DataFrame":
-        exprs = [AttributeReference(n) for n in self.columns
-                 if n != name]
-        exprs.append(Alias(_to_expr(c), name))
-        return DataFrame(L.Project(exprs, self._lp), self.session)
+        cols = [col(n) for n in self.columns if n != name]
+        cc = c if isinstance(c, Column) else Column(_to_expr(c))
+        return self.select(*cols, cc.alias(name))
 
     withColumn = with_column
 

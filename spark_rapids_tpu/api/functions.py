@@ -23,7 +23,7 @@ def sum(c) -> Column:  # noqa: A001
 
 
 def count(c="*") -> Column:
-    child = None if c == "*" else _expr(c)
+    child = None if (isinstance(c, str) and c == "*") else _expr(c)
     return _c(agg.AggregateExpression(agg.Count(child)))
 
 
@@ -165,6 +165,38 @@ def isnan(c) -> Column:
 
 def expr_if(c, a, b) -> Column:
     return _c(cond.If(_expr(c), _expr(a), _expr(b)))
+
+
+# -- window ------------------------------------------------------------------
+
+def row_number() -> Column:
+    from ..expr.window import RowNumber
+    return _c(RowNumber())
+
+
+def rank() -> Column:
+    from ..expr.window import Rank
+    return _c(Rank())
+
+
+def dense_rank() -> Column:
+    from ..expr.window import DenseRank
+    return _c(DenseRank())
+
+
+def lead(c, offset: int = 1) -> Column:
+    from ..expr.window import Lead
+    return _c(Lead(_expr(c), offset))
+
+
+def lag(c, offset: int = 1) -> Column:
+    from ..expr.window import Lag
+    return _c(Lag(_expr(c), offset))
+
+
+def ntile(n: int) -> Column:
+    from ..expr.window import NTile
+    return _c(NTile(n))
 
 
 # strings / datetime / hash re-exported once those modules land

@@ -1,0 +1,344 @@
+"""Window operator.
+
+Ref: sql-plugin/.../GpuWindowExec.scala (running + partitioned paths,
+pre/post projection splicing at :143-161) and GpuWindowExpression.scala.
+
+TPU realization: one sort by (partition keys, order keys) per window spec,
+then every function is a segmented vector computation over the sorted
+view — prefix sums for running/bounded-rows aggregates, run-boundary
+cummax for rank/dense_rank, shifted gathers for lead/lag, segment-reduce +
+broadcast for whole-partition aggregates — and an inverse permutation
+restores input order.  RANGE UNBOUNDED..CURRENT (Spark's default with
+ORDER BY) evaluates at peer-run ends, matching Spark's peer semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as t
+from ..columnar.device import DeviceBatch, DeviceColumn
+from ..expr.aggregates import (AggregateExpression, AggregateFunction,
+                               Average, Count, Max, Min, Sum, bind_aggregate)
+from ..expr.core import (ColumnValue, EvalContext, Expression,
+                         bind_expression, make_column)
+from ..expr.window import (CURRENT_ROW, UNBOUNDED_FOLLOWING,
+                           UNBOUNDED_PRECEDING, DenseRank, Lag, Lead, NTile,
+                           Rank, RowNumber, WindowExpression)
+from ..ops import segmented as seg
+from ..ops.gather import gather_column
+from .base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU, Batch,
+                   Exec, MetricTimer)
+from .concat import concat_batches
+
+
+def _seg_start_positions(xp, new_seg):
+    """pos of the segment start for every sorted row (cummax trick)."""
+    n = new_seg.shape[0]
+    pos = xp.arange(n, dtype=xp.int64)
+    starts = xp.where(new_seg, pos, xp.int64(-1))
+    if xp is np:
+        return np.maximum.accumulate(starts)
+    return jax.lax.associative_scan(jnp.maximum, starts)
+
+
+def _run_end_positions(xp, new_run):
+    """pos of the last row of each peer run: run id per row, then the max
+    position within each run, broadcast back."""
+    n = new_run.shape[0]
+    pos = xp.arange(n, dtype=xp.int64)
+    run_id = (xp.cumsum(new_run.astype(xp.int64)) - 1).astype(xp.int32)
+    run_id = xp.clip(run_id, 0, n - 1)
+    last, _ = seg.segment_reduce(xp, "max", pos, run_id, n,
+                                 xp.ones((n,), dtype=bool))
+    return xp.clip(last[run_id], 0, n - 1)
+
+
+def _segmented_running_minmax(xp, v, new_seg, is_min: bool):
+    if xp is np:
+        out = v.copy()
+        for i in range(1, len(v)):
+            if not new_seg[i]:
+                out[i] = min(out[i - 1], out[i]) if is_min else \
+                    max(out[i - 1], out[i])
+        return out
+    neutral = seg._extreme_init(jnp, v.dtype, is_min)
+    op = jnp.minimum if is_min else jnp.maximum
+
+    def combine(a, b):
+        av, aseg = a
+        bv, bseg = b
+        # if b starts a new segment, ignore a's value
+        nv = jnp.where(bseg, bv, op(av, bv))
+        return nv, aseg | bseg
+    out, _ = jax.lax.associative_scan(combine, (v, new_seg))
+    return out
+
+
+class WindowExec(Exec):
+    def __init__(self, window_exprs: List[WindowExpression], child: Exec):
+        super().__init__([child])
+        self.window_exprs = list(window_exprs)
+        cn, ct = child.output_names, child.output_types
+
+    @property
+    def output_names(self):
+        return self.children[0].output_names + \
+            [w.name for w in self.window_exprs]
+
+    @property
+    def output_types(self):
+        cn, ct = (self.children[0].output_names,
+                  self.children[0].output_types)
+        return list(ct) + [w.resolved_type(cn, ct)
+                           for w in self.window_exprs]
+
+    def describe(self):
+        return f"Window [{', '.join(w.name for w in self.window_exprs)}]"
+
+    # ------------------------------------------------------------------
+    def _compute_one(self, xp, batch: Batch, wexpr: WindowExpression
+                     ) -> DeviceColumn:
+        cn = self.children[0].output_names
+        ct = self.children[0].output_types
+        ctx = EvalContext(xp, batch)
+        live = ctx.row_mask()
+        cap = batch.capacity
+        spec = wexpr.spec
+        pkeys = [bind_expression(p, cn, ct).eval(ctx).col
+                 for p in spec.partition_by]
+        okeys = [(bind_expression(o, cn, ct).eval(ctx).col, asc, nf)
+                 for o, asc, nf in spec.order_by]
+        words = [(~live).astype(xp.uint64)]
+        pwords: List = []
+        for pk in pkeys:
+            pwords += seg.key_words_for_column(xp, pk, live,
+                                               for_grouping=True)
+        owords: List = []
+        for ok, asc, nf in okeys:
+            owords += seg.key_words_for_column(xp, ok, live,
+                                               for_grouping=False,
+                                               nulls_first=nf, ascending=asc)
+        order = seg.lexsort(xp, words + pwords + owords, cap)
+        inv = xp.zeros((cap,), dtype=xp.int32)
+        if xp is np:
+            inv[order] = np.arange(cap, dtype=np.int32)
+        else:
+            inv = inv.at[order].set(xp.arange(cap, dtype=xp.int32))
+        live_s = live[order]
+        psorted = [w[order] for w in pwords]
+        osorted = [w[order] for w in owords]
+        new_seg = seg.segment_boundaries(xp, psorted if psorted else
+                                         [live_s.astype(xp.uint64) * 0],
+                                         live_s)
+        if not pkeys:
+            new_seg = (xp.arange(cap) == 0)
+        new_run = seg.segment_boundaries(xp, psorted + osorted, live_s) \
+            if okeys else new_seg
+        seg_ids = xp.clip(seg.segment_ids(xp, new_seg), 0, cap - 1)
+        pos = xp.arange(cap, dtype=xp.int64)
+        seg_start = _seg_start_positions(xp, new_seg)
+        idx_in_seg = pos - seg_start
+
+        func = wexpr.func
+        out_dtype = wexpr.resolved_type(cn, ct)
+
+        def finish(sorted_data, sorted_valid):
+            data = sorted_data[inv]
+            valid = sorted_valid[inv] & live
+            if not isinstance(out_dtype, (t.StringType, t.BinaryType)):
+                data = xp.where(valid, data, xp.zeros_like(data))
+            return DeviceColumn(out_dtype, data=data, validity=valid)
+
+        if isinstance(func, (RowNumber, Rank, DenseRank)) and \
+                type(func) is RowNumber:
+            return finish((idx_in_seg + 1).astype(np.int32), live_s)
+        if type(func) is Rank:
+            run_start = _seg_start_positions(xp, new_run)
+            return finish((run_start - seg_start + 1).astype(np.int32),
+                          live_s)
+        if type(func) is DenseRank:
+            runs_cum = xp.cumsum(new_run.astype(xp.int64))
+            base = runs_cum[xp.clip(seg_start, 0, cap - 1)] - \
+                new_run[xp.clip(seg_start, 0, cap - 1)].astype(xp.int64)
+            return finish((runs_cum - base).astype(np.int32), live_s)
+        if isinstance(func, NTile):
+            seg_len, _ = seg.segment_reduce(
+                xp, "max", idx_in_seg + 1, seg_ids, cap,
+                xp.ones((cap,), dtype=bool))
+            n_rows = seg_len[seg_ids]
+            nt = np.int64(func.n)
+            base = n_rows // nt
+            rem = n_rows % nt
+            # first `rem` buckets get base+1 rows
+            big = rem * (base + 1)
+            bucket = xp.where(idx_in_seg < big,
+                              idx_in_seg // xp.maximum(base + 1, 1),
+                              rem + (idx_in_seg - big) //
+                              xp.maximum(base, 1))
+            return finish((bucket + 1).astype(np.int32), live_s)
+
+        if isinstance(func, (Lead, Lag)):
+            child = bind_expression(func.children[0], cn, ct)
+            v = child.eval(ctx)
+            if not isinstance(v, ColumnValue):
+                v = make_column(ctx, child.data_type(),
+                                v.value if v.value is not None else 0,
+                                None if v.value is not None else False)
+            col_s = gather_column(xp, v.col, order,
+                                  xp.ones((cap,), dtype=bool))
+            k = -func.offset if isinstance(func, Lag) else func.offset
+            src = xp.clip(pos + k, 0, cap - 1).astype(xp.int32)
+            same_seg = (seg_ids[src] == seg_ids) & \
+                (pos + k >= 0) & (pos + k < cap) & live_s[src]
+            shifted = gather_column(xp, col_s, src, same_seg)
+            return finish(shifted.data,
+                          shifted.validity if shifted.validity is not None
+                          else same_seg)
+
+        if isinstance(func, AggregateFunction):
+            ae = bind_aggregate(AggregateExpression(func), cn, ct)
+            f = ae.func
+            kind, lo_b, hi_b = spec.effective_frame(False)
+            # evaluate update inputs in sorted order
+            upd = f.update()
+            bufs_sorted = []
+            for expr, op in upd:
+                v = expr.eval(ctx)
+                if not isinstance(v, ColumnValue):
+                    v = make_column(ctx, expr.data_type(),
+                                    v.value if v.value is not None else 0,
+                                    None if v.value is not None else False)
+                vs = v.col.data[order] if v.col.data is not None else None
+                val = (v.col.validity[order]
+                       if v.col.validity is not None else
+                       xp.ones((cap,), dtype=bool)) & live_s
+                bufs_sorted.append((vs, val, op))
+            whole = (lo_b == UNBOUNDED_PRECEDING and
+                     hi_b == UNBOUNDED_FOLLOWING)
+            results = []
+            for vs, val, op in bufs_sorted:
+                if op == "countvalid":
+                    contrib = val.astype(xp.int64)
+                    red_op = "sum"
+                    vv = contrib
+                elif op in ("sum",):
+                    red_op = "sum"
+                    vv = xp.where(val, vs, xp.zeros_like(vs))
+                elif op in ("min", "max"):
+                    red_op = op
+                    init = seg._extreme_init(xp, vs.dtype, op == "min")
+                    vv = xp.where(val, vs, xp.full_like(vs, init))
+                else:  # first/last etc -> whole-partition only
+                    red_op = op
+                    vv = vs
+                if whole:
+                    out, cnt = seg.segment_reduce(xp, red_op if red_op in
+                                                  ("sum", "min", "max",
+                                                   "first", "last")
+                                                  else "sum",
+                                                  vv, seg_ids, cap, val)
+                    results.append((out[seg_ids], cnt[seg_ids]))
+                elif kind == "rows" and lo_b == UNBOUNDED_PRECEDING and \
+                        hi_b == CURRENT_ROW:
+                    results.append(self._running(xp, red_op, vv, val,
+                                                 new_seg, seg_start))
+                elif kind == "range" and lo_b == UNBOUNDED_PRECEDING and \
+                        hi_b == CURRENT_ROW:
+                    r, c = self._running(xp, red_op, vv, val, new_seg,
+                                         seg_start)
+                    run_end = _run_end_positions(xp, new_run)
+                    results.append((r[run_end], c[run_end]))
+                elif kind == "rows":
+                    if red_op != "sum":
+                        raise NotImplementedError(
+                            "bounded rows frame supports sum/count/avg")
+                    pre = xp.concatenate([xp.zeros((1,), vv.dtype),
+                                          xp.cumsum(vv)])
+                    cpre = xp.concatenate([xp.zeros((1,), xp.int64),
+                                           xp.cumsum(val.astype(xp.int64))])
+                    seg_end = _run_end_positions(xp, new_seg)
+                    lo_i = xp.clip(pos + lo_b, seg_start, pos + cap)
+                    lo_i = xp.maximum(pos + max(lo_b, -cap), seg_start) \
+                        if lo_b != UNBOUNDED_PRECEDING else seg_start
+                    hi_i = xp.minimum(pos + min(hi_b, cap), seg_end) \
+                        if hi_b != UNBOUNDED_FOLLOWING else seg_end
+                    lo_i = xp.clip(lo_i, 0, cap - 1)
+                    hi_i = xp.clip(hi_i, -1, cap - 1)
+                    empty = hi_i < lo_i
+                    s = pre[hi_i + 1] - pre[lo_i]
+                    c = cpre[hi_i + 1] - cpre[lo_i]
+                    s = xp.where(empty, xp.zeros_like(s), s)
+                    c = xp.where(empty, xp.zeros_like(c), c)
+                    results.append((s, c))
+                else:
+                    raise NotImplementedError(f"frame {kind} {lo_b} {hi_b}")
+            # evaluate the aggregate from its (broadcast) buffers
+            buf_cols = []
+            for (data, cnt), (expr, op) in zip(results, upd):
+                if op == "countvalid":
+                    buf_cols.append(ColumnValue(DeviceColumn(
+                        t.LONG, data=data.astype(np.int64),
+                        validity=xp.ones((cap,), dtype=bool))))
+                else:
+                    buf_cols.append(ColumnValue(DeviceColumn(
+                        expr.data_type(), data=data, validity=cnt > 0)))
+            fctx = EvalContext(xp, DeviceBatch(
+                [c.col for c in buf_cols], batch.num_rows, None))
+            res = f.evaluate(fctx, buf_cols)
+            valid = res.col.validity if res.col.validity is not None else \
+                xp.ones((cap,), dtype=bool)
+            return finish(res.col.data, valid)
+        raise NotImplementedError(f"window function {type(func).__name__}")
+
+    def _running(self, xp, red_op, vv, val, new_seg, seg_start):
+        if red_op == "sum":
+            cs = xp.cumsum(vv)
+            base = xp.where(seg_start > 0,
+                            cs[xp.clip(seg_start - 1, 0, None)],
+                            xp.zeros((), dtype=cs.dtype))
+            ccs = xp.cumsum(val.astype(xp.int64))
+            cbase = xp.where(seg_start > 0,
+                             ccs[xp.clip(seg_start - 1, 0, None)],
+                             xp.zeros((), dtype=xp.int64))
+            return cs - base, ccs - cbase
+        if red_op in ("min", "max"):
+            out = _segmented_running_minmax(xp, vv, new_seg,
+                                            red_op == "min")
+            ccs = xp.cumsum(val.astype(xp.int64))
+            cbase = xp.where(seg_start > 0,
+                             ccs[xp.clip(seg_start - 1, 0, None)],
+                             xp.zeros((), dtype=xp.int64))
+            return out, ccs - cbase
+        raise NotImplementedError(f"running {red_op}")
+
+    def _compute(self, xp, batch: Batch) -> Batch:
+        cols = list(batch.columns)
+        for w in self.window_exprs:
+            cols.append(self._compute_one(xp, batch, w))
+        return DeviceBatch(cols, batch.num_rows, self.output_names)
+
+    @functools.cached_property
+    def _jitted(self):
+        return jax.jit(lambda b: self._compute(jnp, b))
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        xp = self.xp
+        child = self.children[0]
+        batches = list(child.execute_partition(pid, ctx))
+        if not batches:
+            return
+        with MetricTimer(self.metrics[OP_TIME]):
+            merged = concat_batches(xp, batches, child.output_names,
+                                    child.output_types) \
+                if len(batches) > 1 else batches[0]
+            out = self._jitted(merged) if self.placement == TPU \
+                else self._compute(np, merged)
+        self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+        self.metrics[NUM_OUTPUT_BATCHES] += 1
+        yield out

@@ -1,0 +1,173 @@
+"""Window expressions.
+
+Ref: sql-plugin/.../GpuWindowExpression.scala (1.4k) + GpuWindowExec.scala
+(running vs partitioned paths, frame specs).
+
+A WindowExpression pairs a window function (ranking / lead-lag / aggregate)
+with a WindowSpec (partition keys, ordering, frame).  Frames supported on
+TPU round 1: ROWS UNBOUNDED PRECEDING..CURRENT ROW (running), UNBOUNDED..
+UNBOUNDED (whole partition), and bounded ROWS frames for sum/count/avg/
+min/max via prefix/scan kernels.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .. import types as t
+from .aggregates import AggregateFunction
+from .core import Expression
+
+UNBOUNDED_PRECEDING = -(2**31)
+UNBOUNDED_FOLLOWING = 2**31
+CURRENT_ROW = 0
+
+
+class WindowSpec:
+    def __init__(self, partition_by: List[Expression] = None,
+                 order_by: List[Tuple[Expression, bool, bool]] = None,
+                 frame: Optional[Tuple[str, int, int]] = None):
+        self.partition_by = partition_by or []
+        self.order_by = order_by or []
+        # frame: (kind, start, end) — kind 'rows' | 'range'
+        self.frame = frame
+
+    def effective_frame(self, is_ranking: bool) -> Tuple[str, int, int]:
+        if self.frame is not None:
+            return self.frame
+        if self.order_by and not is_ranking:
+            # Spark default with ORDER BY: range unbounded preceding..current
+            return ("range", UNBOUNDED_PRECEDING, CURRENT_ROW)
+        return ("rows", UNBOUNDED_PRECEDING, UNBOUNDED_FOLLOWING)
+
+
+class Window:
+    """pyspark-style builder: Window.partition_by(...).order_by(...)."""
+
+    unboundedPreceding = UNBOUNDED_PRECEDING
+    unboundedFollowing = UNBOUNDED_FOLLOWING
+    currentRow = CURRENT_ROW
+
+    @staticmethod
+    def partition_by(*cols) -> "WindowBuilder":
+        return WindowBuilder().partition_by(*cols)
+
+    partitionBy = partition_by
+
+    @staticmethod
+    def order_by(*cols) -> "WindowBuilder":
+        return WindowBuilder().order_by(*cols)
+
+    orderBy = order_by
+
+
+class WindowBuilder:
+    def __init__(self):
+        self.spec = WindowSpec()
+
+    def partition_by(self, *cols):
+        from ..api.dataframe import _to_expr
+        self.spec.partition_by = [_to_expr(c) for c in cols]
+        return self
+
+    partitionBy = partition_by
+
+    def order_by(self, *cols):
+        from ..api.column import Column
+        from ..api.dataframe import _to_expr
+        orders = []
+        for c in cols:
+            if isinstance(c, Column) and c._sort_order is not None:
+                orders.append((c.expr, *c._sort_order))
+            else:
+                orders.append((_to_expr(c), True, True))
+        self.spec.order_by = orders
+        return self
+
+    orderBy = order_by
+
+    def rows_between(self, start: int, end: int):
+        self.spec.frame = ("rows", start, end)
+        return self
+
+    rowsBetween = rows_between
+
+    def range_between(self, start: int, end: int):
+        self.spec.frame = ("range", start, end)
+        return self
+
+    rangeBetween = range_between
+
+
+class WindowFunction(Expression):
+    is_ranking = False
+
+
+class RowNumber(WindowFunction):
+    is_ranking = True
+
+    def data_type(self):
+        return t.INT
+
+    @property
+    def nullable(self):
+        return False
+
+
+class Rank(RowNumber):
+    pass
+
+
+class DenseRank(RowNumber):
+    pass
+
+
+class Lead(WindowFunction):
+    def __init__(self, child: Expression, offset: int = 1,
+                 default=None):
+        self.children = (child,)
+        self.offset = offset
+        self.default = default
+
+    def data_type(self):
+        return self.children[0].data_type()
+
+
+class Lag(Lead):
+    pass
+
+
+class NTile(WindowFunction):
+    is_ranking = True
+
+    def __init__(self, n: int):
+        self.children = ()
+        self.n = n
+
+    def data_type(self):
+        return t.INT
+
+
+class WindowExpression(Expression):
+    def __init__(self, func, spec: WindowSpec, name: str = None):
+        self.children = (func,)
+        self.func = func
+        self.spec = spec
+        self.name = name or f"{type(func).__name__.lower()}_w"
+
+    def data_type(self):
+        return self.func.data_type()
+
+    def resolved_type(self, names, dtypes):
+        from .aggregates import bind_aggregate, AggregateExpression
+        from .core import bind_expression
+        f = self.func
+        if isinstance(f, AggregateFunction):
+            ae = bind_aggregate(AggregateExpression(f), names, dtypes)
+            return ae.func.data_type()
+        if isinstance(f, (Lead, Lag)):
+            return bind_expression(f.children[0], names, dtypes).data_type()
+        return f.data_type()
+
+    def sql(self):
+        return self.name

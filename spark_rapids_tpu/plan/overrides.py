@@ -350,6 +350,40 @@ EXEC_CONVERTS[CpuHashAggregateExec] = _convert_aggregate
 EXEC_CONVERTS[CpuJoinExec] = _convert_join
 EXEC_TAGS[CpuJoinExec] = _tag_join
 
+from ..exec.window import WindowExec  # noqa: E402
+
+EXEC_SIGS[WindowExec] = T.common_scalar.nested()
+
+
+def _tag_window(meta: ExecMeta):
+    from ..expr import window as W
+    from ..expr.aggregates import (AggregateFunction, Average, Count, First,
+                                   Last, Max, Min, Sum)
+    e: WindowExec = meta.exec
+    for w in e.window_exprs:
+        f = w.func
+        if isinstance(f, AggregateFunction):
+            if not isinstance(f, (Sum, Count, Average, Min, Max, First,
+                                  Last)):
+                meta.will_not_work(
+                    f"window aggregate {type(f).__name__} not supported")
+            kind, lo, hi = w.spec.effective_frame(False)
+            bounded = not (lo == W.UNBOUNDED_PRECEDING and
+                           hi in (W.CURRENT_ROW, W.UNBOUNDED_FOLLOWING))
+            if kind == "range" and bounded:
+                meta.will_not_work("bounded range frames not supported")
+            if bounded and isinstance(f, (Min, Max, First, Last)):
+                meta.will_not_work(
+                    f"bounded rows frame with {type(f).__name__} "
+                    "not supported")
+        elif not isinstance(f, (W.RowNumber, W.Rank, W.DenseRank, W.Lead,
+                                W.Lag, W.NTile)):
+            meta.will_not_work(
+                f"window function {type(f).__name__} not supported")
+
+
+EXEC_TAGS[WindowExec] = _tag_window
+
 
 def _tag_aggregate(meta: ExecMeta):
     e: CpuHashAggregateExec = meta.exec

@@ -1,0 +1,139 @@
+"""Window function tests (model: integration_tests/window_function_test.py).
+
+The window kernels are shared between engines, so correctness here is
+checked against independent pandas oracles, not just CPU-vs-TPU.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col
+from spark_rapids_tpu.expr.window import Window
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect, with_tpu_session)
+from spark_rapids_tpu.testing.data_gen import (
+    IntegerGen, LongGen, gen_df, gen_table)
+
+
+def _data(spark, length=256, seed=0):
+    return gen_df(spark, [("k", IntegerGen(lo=0, hi=10, null_prob=0.1)),
+                          ("o", IntegerGen(lo=0, hi=1000)),
+                          ("v", IntegerGen(lo=-1000, hi=1000))],
+                  length=length, seed=seed)
+
+
+def test_row_number_vs_pandas():
+    w = Window.partition_by(col("k")).order_by(col("o"), col("v"))
+
+    def q(spark):
+        return _data(spark).select("k", "o", "v",
+                                   F.row_number().over(w).alias("rn"))
+    out = with_tpu_session(lambda s: q(s).collect()).to_pandas()
+    pdf = out[["k", "o", "v"]].copy()
+    # stable sort so ties break by original order, same as the engine
+    exp = (pdf.sort_values(["o", "v"], kind="stable", na_position="first")
+           .groupby("k", dropna=False).cumcount() + 1)
+    assert (out["rn"] == exp.reindex(out.index)).all()
+
+
+def test_rank_dense_rank():
+    w = Window.partition_by(col("k")).order_by(col("o"))
+
+    def q(spark):
+        return _data(spark).select(
+            "k", "o", F.rank().over(w).alias("r"),
+            F.dense_rank().over(w).alias("dr"),
+            F.row_number().over(w).alias("rn"))
+    out = with_tpu_session(lambda s: q(s).collect()).to_pandas()
+    g = out.sort_values(["k", "o"]).reset_index(drop=True)
+    exp_r = (g.groupby("k", dropna=False)["o"]
+             .rank(method="min", na_option="top").astype(int))
+    exp_dr = (g.groupby("k", dropna=False)["o"]
+              .rank(method="dense", na_option="top").astype(int))
+    assert (g["r"].values == exp_r.values).all()
+    assert (g["dr"].values == exp_dr.values).all()
+
+
+def test_running_sum_and_count():
+    w = (Window.partition_by(col("k")).order_by(col("o"), col("v"))
+         .rows_between(Window.unboundedPreceding, Window.currentRow))
+
+    def q(spark):
+        return _data(spark).select(
+            "k", "o", "v",
+            F.sum(col("v")).over(w).alias("rs"),
+            F.count(col("v")).over(w).alias("rc"))
+    out = with_tpu_session(lambda s: q(s).collect()).to_pandas()
+    srt = out.sort_values(["o", "v"], kind="stable", na_position="first")
+    g = srt.groupby("k", dropna=False)["v"]
+    exp_sum = g.transform(lambda s: s.fillna(0).cumsum()).reindex(out.index)
+    exp_cnt = g.transform(lambda s: s.notna().cumsum()).reindex(out.index)
+    # Spark: sum skips nulls; null only while no non-null values seen yet
+    ok = ((exp_cnt > 0) & (out["rs"] == exp_sum)) | \
+        ((exp_cnt == 0) & out["rs"].isna())
+    assert ok.all()
+    assert (out["rc"] == exp_cnt).all()
+
+
+def test_whole_partition_agg():
+    w = Window.partition_by(col("k"))
+
+    def q(spark):
+        return _data(spark).select(
+            "k", "v",
+            F.sum(col("v")).over(w).alias("ts"),
+            F.max(col("v")).over(w).alias("tm"),
+            F.avg(col("v")).over(w).alias("ta"))
+    out = with_tpu_session(lambda s: q(s).collect()).to_pandas()
+    g = out.groupby("k", dropna=False)
+    assert np.allclose(out["ts"], g["v"].transform("sum"))
+    assert (out["tm"] == g["v"].transform("max")).all()
+    assert np.allclose(out["ta"], g["v"].transform("mean"))
+
+
+def test_lead_lag():
+    w = Window.partition_by(col("k")).order_by(col("o"), col("v"))
+
+    def q(spark):
+        return _data(spark).select(
+            "k", "o", "v",
+            F.lead(col("v")).over(w).alias("ld"),
+            F.lag(col("v")).over(w).alias("lg"))
+    out = with_tpu_session(lambda s: q(s).collect()).to_pandas()
+    srt = out.sort_values(["o", "v"], kind="stable", na_position="first")
+    exp_ld = srt.groupby("k", dropna=False)["v"].shift(-1)
+    exp_lg = srt.groupby("k", dropna=False)["v"].shift(1)
+    assert np.array_equal(out["ld"].fillna(-999999).values,
+                          exp_ld.reindex(out.index).fillna(-999999).values)
+    assert np.array_equal(out["lg"].fillna(-999999).values,
+                          exp_lg.reindex(out.index).fillna(-999999).values)
+
+
+def test_sliding_sum():
+    w = (Window.partition_by(col("k")).order_by(col("o"), col("v"))
+         .rows_between(-2, 2))
+
+    def q(spark):
+        return _data(spark, length=128).select(
+            "k", "o", "v", F.sum(col("v")).over(w).alias("ss"))
+    out = with_tpu_session(lambda s: q(s).collect()).to_pandas()
+    srt = out.sort_values(["o", "v"], kind="stable", na_position="first")
+    exp = (srt.groupby("k", dropna=False)["v"]
+           .rolling(window=5, min_periods=1, center=True).sum()
+           .reset_index(level=0, drop=True))
+    assert np.allclose(out["ss"].values.astype(float),
+                       exp.reindex(out.index).values)
+
+
+def test_window_differential():
+    w = Window.partition_by(col("k")).order_by(col("o"), col("v"))
+
+    def q(spark):
+        return _data(spark, length=512, seed=3).select(
+            "k", "o", "v",
+            F.row_number().over(w).alias("rn"),
+            F.sum(col("v")).over(
+                Window.partition_by(col("k"))).alias("ts"))
+    assert_tpu_and_cpu_are_equal_collect(q)
