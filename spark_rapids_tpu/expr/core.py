@@ -156,10 +156,23 @@ class LeafExpression(Expression):
 
 class Literal(LeafExpression):
     def __init__(self, value: Any, dtype: Optional[t.DataType] = None):
+        import datetime
+        import decimal as pydec
         if dtype is None:
             dtype = infer_literal_type(value)
         if isinstance(value, str):
-            value = value.encode("utf-8") if not isinstance(value, bytes) else value
+            value = value.encode("utf-8")
+        elif isinstance(value, datetime.datetime):
+            epoch = datetime.datetime(1970, 1, 1,
+                                      tzinfo=datetime.timezone.utc)
+            if value.tzinfo is None:
+                value = value.replace(tzinfo=datetime.timezone.utc)
+            value = int((value - epoch).total_seconds() * 1e6)
+        elif isinstance(value, datetime.date):
+            value = (value - datetime.date(1970, 1, 1)).days
+        elif isinstance(value, pydec.Decimal) and \
+                isinstance(dtype, t.DecimalType):
+            value = int(value.scaleb(dtype.scale))
         self.value = value
         self.dtype = dtype
 

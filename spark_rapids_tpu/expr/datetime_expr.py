@@ -1,0 +1,362 @@
+"""Date/time expressions (UTC only, like the reference's timezone gate —
+GpuOverrides tags non-UTC sessions off the GPU).
+
+Ref: org/apache/spark/sql/rapids/datetimeExpressions.scala.
+DATE is int32 days since epoch; TIMESTAMP is int64 micros since epoch.
+Field extraction uses branch-free civil-calendar arithmetic
+(expr/cast.py's Hinnant algorithms), fully vectorized.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as t
+from .cast import _civil_from_days, _days_from_civil
+from .core import (EvalContext, Expression, and_validity, data_of,
+                   evaluator, make_column, validity_of)
+
+MICROS_PER_DAY = np.int64(86400000000)
+
+
+class DateTimeUnary(Expression):
+    out_type = t.INT
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def data_type(self):
+        return self.out_type
+
+
+def _days_of(e, ctx):
+    """child -> (days int64, validity)."""
+    xp = ctx.xp
+    v = e.children[0].eval(ctx)
+    d = data_of(v, ctx)
+    dt = e.children[0].data_type()
+    if isinstance(dt, t.TimestampType):
+        days = xp.floor_divide(d, MICROS_PER_DAY)
+    else:
+        days = d.astype(xp.int64) if hasattr(d, "astype") else np.int64(d)
+    return days, validity_of(v, ctx)
+
+
+def _micros_of(e, ctx):
+    xp = ctx.xp
+    v = e.children[0].eval(ctx)
+    d = data_of(v, ctx)
+    return d, validity_of(v, ctx)
+
+
+class Year(DateTimeUnary):
+    pass
+
+
+class Month(DateTimeUnary):
+    pass
+
+
+class DayOfMonth(DateTimeUnary):
+    pass
+
+
+class Quarter(DateTimeUnary):
+    pass
+
+
+class DayOfWeek(DateTimeUnary):
+    """1 = Sunday ... 7 = Saturday (Spark)."""
+
+
+class WeekDay(DateTimeUnary):
+    """0 = Monday ... 6 = Sunday (Spark)."""
+
+
+class DayOfYear(DateTimeUnary):
+    pass
+
+
+class LastDay(DateTimeUnary):
+    out_type = t.DATE
+
+
+def _ymd(xp, days):
+    return _civil_from_days(xp, days.astype(xp.int64))
+
+
+@evaluator(Year)
+def _eval_year(e, ctx):
+    days, val = _days_of(e, ctx)
+    y, m, d = _ymd(ctx.xp, days)
+    return make_column(ctx, t.INT, y.astype(np.int32), val)
+
+
+@evaluator(Month)
+def _eval_month(e, ctx):
+    days, val = _days_of(e, ctx)
+    y, m, d = _ymd(ctx.xp, days)
+    return make_column(ctx, t.INT, m.astype(np.int32), val)
+
+
+@evaluator(DayOfMonth)
+def _eval_dom(e, ctx):
+    days, val = _days_of(e, ctx)
+    y, m, d = _ymd(ctx.xp, days)
+    return make_column(ctx, t.INT, d.astype(np.int32), val)
+
+
+@evaluator(Quarter)
+def _eval_quarter(e, ctx):
+    days, val = _days_of(e, ctx)
+    y, m, d = _ymd(ctx.xp, days)
+    return make_column(ctx, t.INT, ((m - 1) // 3 + 1).astype(np.int32), val)
+
+
+@evaluator(DayOfWeek)
+def _eval_dow(e, ctx):
+    xp = ctx.xp
+    days, val = _days_of(e, ctx)
+    # 1970-01-01 was a Thursday; Sunday=1
+    dow = xp.mod(days + 4, 7) + 1
+    return make_column(ctx, t.INT, dow.astype(np.int32), val)
+
+
+@evaluator(WeekDay)
+def _eval_weekday(e, ctx):
+    xp = ctx.xp
+    days, val = _days_of(e, ctx)
+    wd = xp.mod(days + 3, 7)  # Monday=0
+    return make_column(ctx, t.INT, wd.astype(np.int32), val)
+
+
+@evaluator(DayOfYear)
+def _eval_doy(e, ctx):
+    xp = ctx.xp
+    days, val = _days_of(e, ctx)
+    y, m, d = _ymd(xp, days)
+    jan1 = _days_from_civil(xp, y, xp.ones_like(m), xp.ones_like(d))
+    return make_column(ctx, t.INT, (days - jan1 + 1).astype(np.int32), val)
+
+
+@evaluator(LastDay)
+def _eval_lastday(e, ctx):
+    xp = ctx.xp
+    days, val = _days_of(e, ctx)
+    y, m, d = _ymd(xp, days)
+    ny = xp.where(m == 12, y + 1, y)
+    nm = xp.where(m == 12, xp.ones_like(m), m + 1)
+    first_next = _days_from_civil(xp, ny, nm, xp.ones_like(d))
+    return make_column(ctx, t.DATE, (first_next - 1).astype(np.int32), val)
+
+
+class TimePartUnary(DateTimeUnary):
+    pass
+
+
+class Hour(TimePartUnary):
+    pass
+
+
+class Minute(TimePartUnary):
+    pass
+
+
+class Second(TimePartUnary):
+    pass
+
+
+def _time_part(e, ctx, div, mod):
+    xp = ctx.xp
+    micros, val = _micros_of(e, ctx)
+    tod = xp.mod(micros, MICROS_PER_DAY)
+    part = xp.mod(tod // np.int64(div), np.int64(mod))
+    return make_column(ctx, t.INT, part.astype(np.int32), val)
+
+
+@evaluator(Hour)
+def _eval_hour(e, ctx):
+    return _time_part(e, ctx, 3600000000, 24)
+
+
+@evaluator(Minute)
+def _eval_minute(e, ctx):
+    return _time_part(e, ctx, 60000000, 60)
+
+
+@evaluator(Second)
+def _eval_second(e, ctx):
+    return _time_part(e, ctx, 1000000, 60)
+
+
+class DateBinary(Expression):
+    def __init__(self, left, right):
+        self.children = (left, right)
+
+
+class DateAdd(DateBinary):
+    def data_type(self):
+        return t.DATE
+
+
+class DateSub(DateBinary):
+    def data_type(self):
+        return t.DATE
+
+
+class DateDiff(DateBinary):
+    def data_type(self):
+        return t.INT
+
+
+@evaluator(DateAdd)
+def _eval_dateadd(e, ctx):
+    xp = ctx.xp
+    lv, rv = e.children[0].eval(ctx), e.children[1].eval(ctx)
+    days = data_of(lv, ctx)
+    delta = data_of(rv, ctx)
+    sign = -1 if isinstance(e, DateSub) else 1
+    out = (days.astype(xp.int64) if hasattr(days, "astype") else days) + \
+        sign * (delta.astype(xp.int64) if hasattr(delta, "astype")
+                else np.int64(delta))
+    v = and_validity(ctx, validity_of(lv, ctx), validity_of(rv, ctx))
+    return make_column(ctx, t.DATE, out.astype(np.int32), v)
+
+
+from .core import _EVALUATORS  # noqa: E402
+_EVALUATORS[DateSub] = _eval_dateadd
+
+
+@evaluator(DateDiff)
+def _eval_datediff(e, ctx):
+    xp = ctx.xp
+    lv, rv = e.children[0].eval(ctx), e.children[1].eval(ctx)
+    a = data_of(lv, ctx)
+    b = data_of(rv, ctx)
+    v = and_validity(ctx, validity_of(lv, ctx), validity_of(rv, ctx))
+    out = (a.astype(xp.int64) if hasattr(a, "astype") else np.int64(a)) - \
+        (b.astype(xp.int64) if hasattr(b, "astype") else np.int64(b))
+    return make_column(ctx, t.INT, out.astype(np.int32), v)
+
+
+class AddMonths(DateBinary):
+    def data_type(self):
+        return t.DATE
+
+
+@evaluator(AddMonths)
+def _eval_addmonths(e, ctx):
+    xp = ctx.xp
+    lv, rv = e.children[0].eval(ctx), e.children[1].eval(ctx)
+    days = data_of(lv, ctx)
+    months = data_of(rv, ctx)
+    if not hasattr(months, "astype"):
+        months = np.int64(months)
+    y, m, d = _civil_from_days(xp, days.astype(xp.int64))
+    tot = y * 12 + (m - 1) + months.astype(xp.int64)
+    ny = tot // 12
+    nm = xp.mod(tot, 12) + 1
+    # clamp day to the target month's last day
+    ny2 = xp.where(nm == 12, ny + 1, ny)
+    nm2 = xp.where(nm == 12, xp.ones_like(nm), nm + 1)
+    last = _days_from_civil(xp, ny2, nm2, xp.ones_like(d)) - 1
+    _, _, last_d = _civil_from_days(xp, last)
+    nd = xp.minimum(d, last_d)
+    out = _days_from_civil(xp, ny, nm, nd)
+    v = and_validity(ctx, validity_of(lv, ctx), validity_of(rv, ctx))
+    return make_column(ctx, t.DATE, out.astype(np.int32), v)
+
+
+class TruncDate(Expression):
+    """trunc(date, fmt) — fmt literal: year/yyyy/yy/month/mon/mm/week/quarter."""
+
+    def __init__(self, child, fmt: str):
+        self.children = (child,)
+        self.fmt = fmt.lower()
+
+    def data_type(self):
+        return t.DATE
+
+
+@evaluator(TruncDate)
+def _eval_trunc(e: TruncDate, ctx):
+    xp = ctx.xp
+    days, val = _days_of(e, ctx)
+    y, m, d = _civil_from_days(xp, days.astype(xp.int64))
+    f = e.fmt
+    if f in ("year", "yyyy", "yy"):
+        out = _days_from_civil(xp, y, xp.ones_like(m), xp.ones_like(d))
+    elif f in ("month", "mon", "mm"):
+        out = _days_from_civil(xp, y, m, xp.ones_like(d))
+    elif f == "quarter":
+        qm = ((m - 1) // 3) * 3 + 1
+        out = _days_from_civil(xp, y, qm, xp.ones_like(d))
+    elif f == "week":
+        wd = xp.mod(days + 3, 7)  # Monday=0
+        out = days - wd
+    else:
+        raise NotImplementedError(f"trunc format {f}")
+    return make_column(ctx, t.DATE, out.astype(np.int32), val)
+
+
+class UnixTimestampBase(Expression):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def data_type(self):
+        return t.LONG
+
+
+class ToUnixTimestamp(UnixTimestampBase):
+    pass
+
+
+@evaluator(ToUnixTimestamp)
+def _eval_tounix(e, ctx):
+    xp = ctx.xp
+    v = e.children[0].eval(ctx)
+    d = data_of(v, ctx)
+    dt = e.children[0].data_type()
+    if isinstance(dt, t.DateType):
+        secs = d.astype(xp.int64) * np.int64(86400)
+    else:
+        secs = xp.floor_divide(d, np.int64(1000000))
+    return make_column(ctx, t.LONG, secs, validity_of(v, ctx))
+
+
+class FromUnixTime(Expression):
+    """from_unixtime(sec) -> timestamp (format handling via cast)."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def data_type(self):
+        return t.TIMESTAMP
+
+
+@evaluator(FromUnixTime)
+def _eval_fromunix(e, ctx):
+    v = e.children[0].eval(ctx)
+    d = data_of(v, ctx)
+    return make_column(ctx, t.TIMESTAMP,
+                       d.astype(ctx.xp.int64) * np.int64(1000000),
+                       validity_of(v, ctx))
+
+
+class TimeAdd(Expression):
+    """timestamp + interval (interval as literal micros)."""
+
+    def __init__(self, child, interval_micros: int):
+        self.children = (child,)
+        self.interval = int(interval_micros)
+
+    def data_type(self):
+        return t.TIMESTAMP
+
+
+@evaluator(TimeAdd)
+def _eval_timeadd(e: TimeAdd, ctx):
+    v = e.children[0].eval(ctx)
+    d = data_of(v, ctx)
+    return make_column(ctx, t.TIMESTAMP, d + np.int64(e.interval),
+                       validity_of(v, ctx))

@@ -1,0 +1,214 @@
+"""Hash functions: Spark-compatible Murmur3 (hash()) and partition hashing.
+
+Ref: org/apache/spark/sql/rapids/HashFunctions.scala, GpuMurmur3Hash;
+the reference gets these from cudf and keeps bit-parity with Spark so that
+hash partitioning matches between CPU and GPU — the same property this
+implementation preserves between our CPU and TPU engines.
+
+Spark's hash() is Murmur3_x86_32 with seed 42 over the value's Spark
+representation: int-family widened to 4-byte int, long/timestamp as two
+4-byte halves, double via Double.doubleToLongBits, strings over UTF-8
+bytes.  Fixed-width inputs vectorize directly; strings process 4-byte
+little-endian blocks with a bounded fori_loop, all rows in parallel.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .. import types as t
+from .core import (ColumnValue, EvalContext, Expression, data_of, evaluator,
+                   make_column, validity_of)
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+SEED = np.uint32(42)
+
+
+def _rotl(xp, x, r):
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def _mix_k1(xp, k1):
+    k1 = (k1 * _C1).astype(xp.uint32)
+    k1 = _rotl(xp, k1, 15)
+    return (k1 * _C2).astype(xp.uint32)
+
+
+def _mix_h1(xp, h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl(xp, h1, 13)
+    return (h1 * np.uint32(5) + np.uint32(0xE6546B64)).astype(xp.uint32)
+
+
+def _fmix(xp, h1, length):
+    h1 = h1 ^ length.astype(xp.uint32)
+    h1 = h1 ^ (h1 >> np.uint32(16))
+    h1 = (h1 * np.uint32(0x85EBCA6B)).astype(xp.uint32)
+    h1 = h1 ^ (h1 >> np.uint32(13))
+    h1 = (h1 * np.uint32(0xC2B2AE35)).astype(xp.uint32)
+    return h1 ^ (h1 >> np.uint32(16))
+
+
+def hash_int32(xp, values, seed):
+    """Murmur3 of a 4-byte int block (Spark hashInt)."""
+    k1 = _mix_k1(xp, values.astype(xp.uint32))
+    h1 = _mix_h1(xp, seed, k1)
+    return _fmix(xp, h1, xp.full_like(h1, np.uint32(4)))
+
+
+def hash_int64(xp, values, seed):
+    """Spark hashLong: low word then high word."""
+    v = values.astype(xp.uint64)
+    lo = (v & xp.uint64(0xFFFFFFFF)).astype(xp.uint32)
+    hi = (v >> xp.uint64(32)).astype(xp.uint32)
+    h1 = _mix_h1(xp, seed, _mix_k1(xp, lo))
+    h1 = _mix_h1(xp, h1, _mix_k1(xp, hi))
+    return _fmix(xp, h1, xp.full_like(h1, np.uint32(8)))
+
+
+def hash_bytes(xp, offsets, chars, seed_arr):
+    """Per-row Murmur3 over byte spans (Spark hashUnsafeBytes).
+
+    Processes 4-byte little-endian blocks; all rows advance together in a
+    bounded loop over the max block count (traced while_loop on TPU)."""
+    cap = offsets.shape[0] - 1
+    lens = (offsets[1:] - offsets[:-1]).astype(xp.int64)
+    nblocks = (lens // 4).astype(xp.int32)
+    max_blocks = int(chars.shape[0] // 4) if xp is np else None
+
+    def read_u32(block_i):
+        base = offsets[:-1].astype(xp.int64) + block_i * 4
+        b = [chars[xp.clip(base + j, 0, chars.shape[0] - 1)].astype(
+            xp.uint32) for j in range(4)]
+        return (b[0] | (b[1] << np.uint32(8)) | (b[2] << np.uint32(16))
+                | (b[3] << np.uint32(24)))
+
+    h1 = seed_arr
+    if xp is np:
+        mb = int(nblocks.max()) if cap else 0
+        for i in range(mb):
+            active = i < nblocks
+            k1 = _mix_k1(np, read_u32(np.int64(i)))
+            h1 = np.where(active, _mix_h1(np, h1, k1), h1)
+    else:
+        import jax
+
+        def body(i, h):
+            active = i < nblocks
+            k1 = _mix_k1(xp, read_u32(i.astype(xp.int64)))
+            return xp.where(active, _mix_h1(xp, h, k1), h)
+        # traced upper bound lowers to while_loop; all rows step together
+        h1 = jax.lax.fori_loop(0, jnp_max_int(xp, nblocks), body, h1)
+    # tail bytes (Spark processes them one at a time as signed ints)
+    tail_len = (lens % 4).astype(xp.int32)
+    base = offsets[:-1].astype(xp.int64) + nblocks.astype(xp.int64) * 4
+    for j in range(3):
+        tb = chars[xp.clip(base + j, 0, chars.shape[0] - 1)]
+        signed = tb.astype(xp.int8).astype(xp.int32).astype(xp.uint32)
+        k1 = _mix_k1(xp, signed)
+        h1 = xp.where(j < tail_len, _mix_h1(xp, h1, k1), h1)
+    return _fmix(xp, h1, lens.astype(xp.uint32))
+
+
+def jnp_max_int(xp, arr):
+    # dynamic loop bound: fori_loop accepts traced upper bounds
+    return xp.max(arr).astype(xp.int32) if arr.shape[0] else 0
+
+
+def hash_column(xp, col, seed_arr, cap):
+    """Spark-compatible hash of one column, folding into per-row seeds.
+    Null rows leave the seed unchanged (Spark semantics)."""
+    dtype = col.dtype
+    validity = col.validity
+    if isinstance(dtype, (t.StringType, t.BinaryType)):
+        h = hash_bytes(xp, col.offsets, col.data, seed_arr)
+    elif isinstance(dtype, (t.LongType, t.TimestampType)):
+        h = hash_int64(xp, col.data, seed_arr)
+    elif isinstance(dtype, t.DoubleType):
+        d = col.data
+        d = xp.where(d == 0.0, xp.zeros_like(d), d)  # -0.0 -> 0.0
+        bits = d.view(xp.int64) if hasattr(d, "view") else d.view(np.int64)
+        h = hash_int64(xp, bits, seed_arr)
+    elif isinstance(dtype, t.FloatType):
+        d = col.data
+        d = xp.where(d == 0.0, xp.zeros_like(d), d)
+        bits = d.view(xp.int32) if hasattr(d, "view") else d.view(np.int32)
+        h = hash_int32(xp, bits, seed_arr)
+    elif isinstance(dtype, t.BooleanType):
+        h = hash_int32(xp, col.data.astype(xp.int32), seed_arr)
+    elif isinstance(dtype, t.DecimalType):
+        # decimal64: Spark hashes the unscaled long when precision <= 18
+        h = hash_int64(xp, col.data, seed_arr)
+    elif isinstance(dtype, t.StructType):
+        h = seed_arr
+        for ch in col.children:
+            h = hash_column(xp, ch, h, cap)
+    else:
+        h = hash_int32(xp, col.data.astype(xp.int32), seed_arr)
+    if validity is not None:
+        h = xp.where(validity, h, seed_arr)
+    return h
+
+
+class Murmur3Hash(Expression):
+    def __init__(self, children: List[Expression], seed: int = 42):
+        self.children = tuple(children)
+        self.seed = seed
+
+    def data_type(self):
+        return t.INT
+
+    @property
+    def nullable(self):
+        return False
+
+
+@evaluator(Murmur3Hash)
+def _eval_murmur3(e: Murmur3Hash, ctx: EvalContext):
+    xp = ctx.xp
+    cap = ctx.capacity
+    h = xp.full((cap,), np.uint32(e.seed), dtype=xp.uint32)
+    for c in e.children:
+        v = c.eval(ctx)
+        if not isinstance(v, ColumnValue):
+            from .core import make_column as mk
+            v = mk(ctx, c.data_type(), v.value if v.value is not None else 0,
+                   None if v.value is not None else False)
+        h = hash_column(xp, v.col, h, cap)
+    return make_column(ctx, t.INT, h.astype(np.int32), None)
+
+
+class SparkPartitionID(Expression):
+    children = ()
+
+    def data_type(self):
+        return t.INT
+
+    @property
+    def nullable(self):
+        return False
+
+
+class MonotonicallyIncreasingID(Expression):
+    children = ()
+
+    def data_type(self):
+        return t.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+
+class Md5(Expression):
+    """MD5 digest hex string — host-only (CPU engine), tagged off TPU like
+    the reference tags unsupported exprs."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def data_type(self):
+        return t.STRING

@@ -90,6 +90,47 @@ for c in (mx.Sqrt, mx.Exp, mx.Expm1, mx.Sin, mx.Cos, mx.Tan, mx.Asin,
     expr_rule(c, _num)
 
 
+from ..expr import datetime_expr as dte
+from ..expr import hashfns as hf
+from ..expr import strings as se
+
+for c in (se.Upper, se.Lower, se.Substring, se.Concat, se.Trim, se.TrimLeft,
+          se.TrimRight, se.StringReplace, se.StringRepeat, se.Reverse,
+          se.StringLPad, se.StringRPad, se.InitCap):
+    expr_rule(c, T.STRING)
+for c in (se.Length, se.BitLength, se.StringLocate):
+    expr_rule(c, T.INT)
+for c in (se.Contains, se.StartsWith, se.EndsWith, se.Like):
+    expr_rule(c, T.BOOLEAN)
+for c in (dte.Year, dte.Month, dte.DayOfMonth, dte.Quarter, dte.DayOfWeek,
+          dte.WeekDay, dte.DayOfYear, dte.Hour, dte.Minute, dte.Second,
+          dte.DateDiff):
+    expr_rule(c, T.INT)
+for c in (dte.LastDay, dte.DateAdd, dte.DateSub, dte.AddMonths,
+          dte.TruncDate):
+    expr_rule(c, T.DATE)
+expr_rule(dte.ToUnixTimestamp, T.LONG)
+expr_rule(dte.FromUnixTime, T.TIMESTAMP)
+expr_rule(dte.TimeAdd, T.TIMESTAMP)
+expr_rule(hf.Murmur3Hash, T.INT)
+
+
+def _tag_string_literal_needle(meta: "ExprMeta"):
+    from ..expr.strings import _literal_bytes
+    e = meta.expr
+    needle_child = e.children[1] if len(e.children) > 1 else None
+    if needle_child is not None and \
+            _literal_bytes(needle_child) is None and \
+            not isinstance(needle_child, Literal):
+        meta.will_not_work(
+            f"{type(e).__name__} requires a literal search argument on TPU")
+
+
+for c in (se.Contains, se.StartsWith, se.EndsWith, se.Like,
+          se.StringReplace):
+    EXPR_RULES[c].tag_fn = _tag_string_literal_needle
+
+
 def _tag_cast(meta: "ExprMeta"):
     e = meta.expr
     src = e.child.data_type()

@@ -1,0 +1,146 @@
+"""Date/time expression tests vs python datetime oracles
+(model: integration_tests/date_time_test.py)."""
+
+import datetime
+
+import pyarrow as pa
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import Column, col, lit
+from spark_rapids_tpu.expr import datetime_expr as D
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect)
+from spark_rapids_tpu.testing.data_gen import DateGen, TimestampGen, gen_df
+
+_DATES = [datetime.date(2024, 2, 29), datetime.date(1970, 1, 1),
+          datetime.date(1969, 12, 31), datetime.date(2000, 12, 31),
+          None, datetime.date(1582, 10, 15), datetime.date(2038, 1, 19)]
+
+
+def _df(spark):
+    return spark.create_dataframe(pa.table({
+        "d": pa.array(_DATES, type=pa.date32()),
+        "n": pa.array(list(range(len(_DATES))), type=pa.int32())}))
+
+
+def test_extract_fields():
+    def q(spark):
+        return _df(spark).select(
+            F.year(col("d")).alias("y"),
+            F.month(col("d")).alias("m"),
+            F.dayofmonth(col("d")).alias("dm"),
+            Column(D.DayOfWeek(col("d").expr)).alias("dw"),
+            Column(D.DayOfYear(col("d").expr)).alias("dy"),
+            Column(D.Quarter(col("d").expr)).alias("q"))
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+    assert tpu.column("y").to_pylist() == \
+        [None if d is None else d.year for d in _DATES]
+    assert tpu.column("m").to_pylist() == \
+        [None if d is None else d.month for d in _DATES]
+    assert tpu.column("dm").to_pylist() == \
+        [None if d is None else d.day for d in _DATES]
+    # Spark: Sunday=1..Saturday=7; python weekday(): Monday=0
+    assert tpu.column("dw").to_pylist() == \
+        [None if d is None else ((d.weekday() + 1) % 7) + 1 for d in _DATES]
+    assert tpu.column("dy").to_pylist() == \
+        [None if d is None else d.timetuple().tm_yday for d in _DATES]
+
+
+def test_date_arithmetic():
+    def q(spark):
+        return _df(spark).select(
+            Column(D.DateAdd(col("d").expr, lit(10).expr)).alias("pa"),
+            Column(D.DateSub(col("d").expr, lit(10).expr)).alias("mi"),
+            Column(D.AddMonths(col("d").expr, lit(1).expr)).alias("am"),
+            Column(D.LastDay(col("d").expr)).alias("ld"))
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+    assert tpu.column("pa").to_pylist() == \
+        [None if d is None else d + datetime.timedelta(days=10)
+         for d in _DATES]
+    # add_months clamps to month end (e.g. Jan 31 + 1 = Feb 29)
+    assert tpu.column("am").to_pylist()[0] == datetime.date(2024, 3, 29)
+    assert tpu.column("ld").to_pylist()[0] == datetime.date(2024, 2, 29)
+
+
+def test_timestamp_fields():
+    ts = [datetime.datetime(2024, 6, 15, 13, 45, 59, 123456,
+                            tzinfo=datetime.timezone.utc),
+          datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc),
+          None]
+
+    def q(spark):
+        df = spark.create_dataframe(pa.table(
+            {"t": pa.array(ts, type=pa.timestamp("us", tz="UTC"))}))
+        return df.select(
+            Column(D.Hour(col("t").expr)).alias("h"),
+            Column(D.Minute(col("t").expr)).alias("mi"),
+            Column(D.Second(col("t").expr)).alias("s"),
+            F.year(col("t")).alias("y"))
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+    assert tpu.column("h").to_pylist() == [13, 0, None]
+    assert tpu.column("mi").to_pylist() == [45, 0, None]
+    assert tpu.column("s").to_pylist() == [59, 0, None]
+    assert tpu.column("y").to_pylist() == [2024, 1970, None]
+
+
+def test_datetime_fuzz_differential():
+    def q(spark):
+        df = gen_df(spark, [("d", DateGen()), ("t", TimestampGen())],
+                    length=512)
+        return df.select(
+            F.year(col("d")).alias("yd"), F.month(col("d")).alias("md"),
+            F.dayofmonth(col("d")).alias("dd"),
+            F.year(col("t")).alias("yt"),
+            Column(D.DateDiff(col("d").expr, lit(
+                datetime.date(2000, 1, 1)).expr)).alias("dd2"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_murmur3_consistency():
+    """hash() must agree between engines (partitioning correctness)."""
+    from spark_rapids_tpu.testing.data_gen import (IntegerGen, LongGen,
+                                                   StringGen, DoubleGen)
+
+    def q(spark):
+        df = gen_df(spark, [("i", IntegerGen()), ("l", LongGen()),
+                            ("s", StringGen(max_len=12)),
+                            ("f", DoubleGen())], length=512)
+        return df.select(F.hash(col("i")).alias("hi"),
+                         F.hash(col("l")).alias("hl"),
+                         F.hash(col("s")).alias("hs"),
+                         F.hash(col("f")).alias("hf"),
+                         F.hash(col("i"), col("s"), col("l")).alias("hm"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_murmur3_known_values():
+    """Spark-published murmur3 results: hash of int 0 with seed 42 etc.
+    (values computed from the Murmur3_x86_32 spec)."""
+    def q(spark):
+        df = spark.create_dataframe(pa.table(
+            {"i": pa.array([0, 1, 42], type=pa.int32())}))
+        return df.select(F.hash(col("i")).alias("h"))
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+    # reference Murmur3_x86_32(le32(v), seed=42) values
+    import struct
+
+    def mmh3_32(data: bytes, seed: int) -> int:
+        c1, c2 = 0xCC9E2D51, 0x1B873593
+        h = seed & 0xFFFFFFFF
+        for i in range(0, len(data) - len(data) % 4, 4):
+            k = struct.unpack_from("<I", data, i)[0]
+            k = (k * c1) & 0xFFFFFFFF
+            k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+            k = (k * c2) & 0xFFFFFFFF
+            h ^= k
+            h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+            h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+        h ^= len(data)
+        h ^= h >> 16
+        h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+        h ^= h >> 13
+        h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+        h ^= h >> 16
+        return h - (1 << 32) if h >= (1 << 31) else h
+    exp = [mmh3_32(struct.pack("<i", v), 42) for v in [0, 1, 42]]
+    assert tpu.column("h").to_pylist() == exp

@@ -1,0 +1,166 @@
+"""String expression tests — differential + independent pyarrow oracles
+(model: integration_tests/string_test.py)."""
+
+import pyarrow as pa
+import pyarrow.compute as pc
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col, lit
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect, with_tpu_session)
+from spark_rapids_tpu.testing.data_gen import StringGen, IntegerGen, gen_df
+
+_SAMPLE = ["hello world", "", None, "  padded  ", "UPPER lower",
+           "a", "abcabcabc", "xyz", "foo bar baz", "  ", "ab_cd%ef"]
+
+
+def _df(spark):
+    return spark.create_dataframe(pa.table({
+        "s": pa.array(_SAMPLE, type=pa.string()),
+        "n": pa.array(list(range(len(_SAMPLE))), type=pa.int32())}))
+
+
+def test_upper_lower_length_vs_arrow():
+    def q(spark):
+        return _df(spark).select(
+            F.upper(col("s")).alias("u"),
+            F.lower(col("s")).alias("l"),
+            F.length(col("s")).alias("n"))
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+    arr = pa.array(_SAMPLE, type=pa.string())
+    assert tpu.column("u").to_pylist() == pc.utf8_upper(arr).to_pylist()
+    assert tpu.column("l").to_pylist() == pc.utf8_lower(arr).to_pylist()
+    assert tpu.column("n").to_pylist() == pc.utf8_length(arr).to_pylist()
+
+
+def test_substring():
+    def q(spark):
+        return _df(spark).select(
+            F.substring(col("s"), 1, 3).alias("a"),
+            F.substring(col("s"), 3, 100).alias("b"),
+            F.substring(col("s"), -3, 2).alias("c"))
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+    exp = [None if s is None else s[0:3] for s in _SAMPLE]
+    assert tpu.column("a").to_pylist() == exp
+    def sub_sql(s, pos, n):
+        start = len(s) + pos if pos < 0 else (pos - 1 if pos > 0 else 0)
+        end = start + n
+        return s[max(start, 0):max(min(end, len(s)), 0)]
+    exp_c = [None if s is None else sub_sql(s, -3, 2) for s in _SAMPLE]
+    assert tpu.column("c").to_pylist() == exp_c
+
+
+def test_concat_trim():
+    def q(spark):
+        return _df(spark).select(
+            F.concat(col("s"), lit("!"), col("s")).alias("cc"),
+            Fx_trim(col("s")).alias("tr"))
+
+    def Fx_trim(c):
+        from spark_rapids_tpu.expr.strings import Trim
+        from spark_rapids_tpu.api.column import Column
+        return Column(Trim(c.expr))
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+    exp = [None if s is None else s + "!" + s for s in _SAMPLE]
+    assert tpu.column("cc").to_pylist() == exp
+    assert tpu.column("tr").to_pylist() == \
+        [None if s is None else s.strip(" ") for s in _SAMPLE]
+
+
+def test_contains_startswith_endswith():
+    def q(spark):
+        return _df(spark).select(
+            col("s").contains("ab").alias("c"),
+            col("s").startswith("he").alias("st"),
+            col("s").endswith("z").alias("en"))
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+    assert tpu.column("c").to_pylist() == \
+        [None if s is None else "ab" in s for s in _SAMPLE]
+    assert tpu.column("st").to_pylist() == \
+        [None if s is None else s.startswith("he") for s in _SAMPLE]
+    assert tpu.column("en").to_pylist() == \
+        [None if s is None else s.endswith("z") for s in _SAMPLE]
+
+
+def test_replace():
+    def q(spark):
+        from spark_rapids_tpu.expr.strings import StringReplace
+        from spark_rapids_tpu.api.column import Column
+        from spark_rapids_tpu.expr.core import Literal
+        return _df(spark).select(Column(StringReplace(
+            col("s").expr, Literal("ab"), Literal("XYZ"))).alias("r"))
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+    assert tpu.column("r").to_pylist() == \
+        [None if s is None else s.replace("ab", "XYZ") for s in _SAMPLE]
+
+
+def test_like():
+    import fnmatch
+
+    def q(spark):
+        from spark_rapids_tpu.expr.strings import Like
+        from spark_rapids_tpu.api.column import Column
+        from spark_rapids_tpu.expr.core import Literal
+        return _df(spark).select(
+            Column(Like(col("s").expr, Literal("h%"))).alias("p"),
+            Column(Like(col("s").expr, Literal("%z"))).alias("sfx"),
+            Column(Like(col("s").expr, Literal("%bar%"))).alias("mid"),
+            Column(Like(col("s").expr, Literal("a_c%"))).alias("w"))
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+    assert tpu.column("p").to_pylist() == \
+        [None if s is None else s.startswith("h") for s in _SAMPLE]
+    assert tpu.column("sfx").to_pylist() == \
+        [None if s is None else s.endswith("z") for s in _SAMPLE]
+    assert tpu.column("mid").to_pylist() == \
+        [None if s is None else "bar" in s for s in _SAMPLE]
+
+
+def test_pad_repeat_reverse_initcap():
+    def q(spark):
+        from spark_rapids_tpu.expr.strings import (InitCap, Reverse,
+                                                   StringLPad, StringRepeat,
+                                                   StringRPad)
+        from spark_rapids_tpu.api.column import Column
+        from spark_rapids_tpu.expr.core import Literal
+        return _df(spark).select(
+            Column(StringLPad(col("s").expr, Literal(8),
+                              Literal("*"))).alias("lp"),
+            Column(StringRPad(col("s").expr, Literal(8),
+                              Literal("*"))).alias("rp"),
+            Column(StringRepeat(col("s").expr, Literal(2))).alias("rep"),
+            Column(Reverse(col("s").expr)).alias("rev"),
+            Column(InitCap(col("s").expr)).alias("ic"))
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+    assert tpu.column("lp").to_pylist() == \
+        [None if s is None else s.rjust(8, "*")[:8] if len(s) <= 8
+         else s[:8] for s in _SAMPLE]
+    assert tpu.column("rep").to_pylist() == \
+        [None if s is None else s * 2 for s in _SAMPLE]
+    assert tpu.column("rev").to_pylist() == \
+        [None if s is None else s[::-1] for s in _SAMPLE]
+
+
+def test_string_fuzz_differential():
+    def q(spark):
+        df = gen_df(spark, [("s", StringGen(max_len=12)),
+                            ("p", IntegerGen(lo=-5, hi=8))], length=512)
+        return df.select(
+            F.upper(col("s")).alias("u"),
+            F.length(col("s")).alias("n"),
+            F.substring(col("s"), 2, 4).alias("sub"),
+            F.concat(col("s"), lit("-"), col("s")).alias("cc"),
+            col("s").contains("a").alias("ca"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_locate():
+    def q(spark):
+        from spark_rapids_tpu.expr.strings import StringLocate
+        from spark_rapids_tpu.api.column import Column
+        from spark_rapids_tpu.expr.core import Literal
+        return _df(spark).select(
+            Column(StringLocate(Literal("b"), col("s").expr)).alias("l1"))
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+    assert tpu.column("l1").to_pylist() == \
+        [None if s is None else (s.find("b") + 1) for s in _SAMPLE]
