@@ -95,10 +95,11 @@ class HashJoinExec(Exec):
     def __init__(self, left_keys: Sequence[Expression],
                  right_keys: Sequence[Expression], how: str,
                  condition: Optional[Expression],
-                 left: Exec, right: Exec):
+                 left: Exec, right: Exec, colocated: bool = False):
         super().__init__([left, right])
         assert how in JOIN_TYPES
         self.how = how
+        self.colocated = colocated
         self.left_keys = [bind_expression(k, left.output_names,
                                           left.output_types)
                           for k in left_keys]
@@ -224,10 +225,12 @@ class HashJoinExec(Exec):
         on_tpu = self.placement == TPU
         right = self.children[1]
         build_batches = []
-        for bpid in range(right.num_partitions) if right.num_partitions > 1 \
-                else [pid]:
-            build_batches += list(right.execute_partition(
-                bpid if right.num_partitions > 1 else 0, ctx))
+        if self.colocated:
+            build_pids = [pid]
+        else:
+            build_pids = list(range(right.num_partitions))
+        for bpid in build_pids:
+            build_batches += list(right.execute_partition(bpid, ctx))
         if not build_batches:
             from ..columnar.interop import to_arrow_schema
             schema = to_arrow_schema(right.output_names, right.output_types)
@@ -372,13 +375,13 @@ _PA_JOIN = {"inner": "inner", "left": "left outer", "right": "right outer",
 
 class CpuJoinExec(Exec):
     def __init__(self, left_keys, right_keys, how, condition,
-                 left: Exec, right: Exec, coalesce_keys: bool = False):
+                 left: Exec, right: Exec, colocated: bool = False):
         super().__init__([left, right])
         self.how = how
         self.left_keys = left_keys
         self.right_keys = right_keys
         self.condition = condition
-        self.coalesce_keys = coalesce_keys
+        self.colocated = colocated
 
     @property
     def output_names(self):
@@ -420,7 +423,7 @@ class CpuJoinExec(Exec):
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         import pyarrow.compute as pc
         left = self._collect_side(0, ctx, pid)
-        right = self._collect_side(1, ctx)
+        right = self._collect_side(1, ctx, pid if self.colocated else None)
         # materialize key columns (they may be expressions)
         lkn, rkn = [], []
         lt, rt = left, right
@@ -555,12 +558,22 @@ def plan_join(lp, left: Exec, right: Exec, conf) -> Exec:
     else:
         lkeys, rkeys, residual = split_equi_condition(
             cond, left.output_names, right.output_names)
-    if left.num_partitions > 1:
+    multi = left.num_partitions > 1 or right.num_partitions > 1
+    colocated = False
+    if multi and lkeys:
+        # shuffled hash join: co-partition both sides on the join keys
+        from ..shuffle.exchange import ShuffleExchangeExec
+        from ..shuffle.partitioning import HashPartitioning
+        n = max(left.num_partitions, right.num_partitions)
+        left = ShuffleExchangeExec(HashPartitioning(lkeys, n), left)
+        right = ShuffleExchangeExec(HashPartitioning(rkeys, n), right)
+        colocated = True
+    elif multi:
         from .gatherpart import GatherPartitionsExec
-        left = GatherPartitionsExec(left)
-    if right.num_partitions > 1:
-        from .gatherpart import GatherPartitionsExec
-        right = GatherPartitionsExec(right)
+        if left.num_partitions > 1:
+            left = GatherPartitionsExec(left)
+        if right.num_partitions > 1:
+            right = GatherPartitionsExec(right)
 
     if how == "cross" or (not lkeys and how == "inner" and cond is not None) \
             or (not lkeys and cond is None and how == "cross"):
@@ -579,7 +592,8 @@ def plan_join(lp, left: Exec, right: Exec, conf) -> Exec:
         how = "left"
         flipped = True
 
-    join: Exec = CpuJoinExec(lkeys, rkeys, how, residual, left, right)
+    join: Exec = CpuJoinExec(lkeys, rkeys, how, residual, left, right,
+                             colocated=colocated)
     out_exec = join
     if flipped or using:
         from .basic import ProjectExec

@@ -1,0 +1,96 @@
+"""Table metadata + batch serialization for spill and shuffle transport.
+
+Ref: MetaUtils.scala (FlatBuffers TableMeta describing packed tables) and
+GpuColumnarBatchSerializer.scala (the serialized fallback path).
+
+The wire format here is Arrow IPC for column payloads plus a fixed little-
+endian header (magic, version, lengths) — language-neutral like the
+reference's FlatBuffers schemas, with pyarrow doing the zero-copy body
+encoding.  Compression plugs in via the native codec layer
+(spark_rapids_tpu/native, ref TableCompressionCodec.scala)."""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from ..columnar.device import DeviceBatch, batch_to_arrow, batch_to_device
+
+MAGIC = b"TPUB"
+VERSION = 1
+
+_HEADER = struct.Struct("<4sHHqq")  # magic, version, codec, n_rows, body_len
+
+CODEC_NONE = 0
+CODEC_LZ4 = 1
+CODEC_ZSTD = 2
+
+
+def serialize_batch(batch: DeviceBatch, codec: int = CODEC_NONE) -> bytes:
+    """Device/host batch -> self-describing bytes."""
+    rb = batch_to_arrow(batch)
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, rb.schema) as w:
+        w.write_batch(rb)
+    body = sink.getvalue()
+    if codec == CODEC_LZ4:
+        from ..native import codec as ncodec
+        body = ncodec.lz4_compress(body)
+    elif codec == CODEC_ZSTD:
+        from ..native import codec as ncodec
+        body = ncodec.zstd_compress(body)
+    head = _HEADER.pack(MAGIC, VERSION, codec, int(batch.num_rows),
+                        len(body))
+    return head + body
+
+
+def deserialize_batch(data: bytes, xp=np) -> DeviceBatch:
+    magic, version, codec, n_rows, body_len = _HEADER.unpack_from(data, 0)
+    assert magic == MAGIC and version == VERSION, "bad batch header"
+    body = data[_HEADER.size:_HEADER.size + body_len]
+    if codec == CODEC_LZ4:
+        from ..native import codec as ncodec
+        body = ncodec.lz4_decompress(body)
+    elif codec == CODEC_ZSTD:
+        from ..native import codec as ncodec
+        body = ncodec.zstd_decompress(body)
+    with pa.ipc.open_stream(io.BytesIO(body)) as r:
+        rbs = list(r)
+    if not rbs:
+        raise ValueError("empty batch stream")
+    return batch_to_device(rbs[0], xp=xp)
+
+
+class TableMeta:
+    """Lightweight descriptor advertised before transfer (ref
+    MetaUtils.buildTableMeta): row count + serialized size + schema id."""
+
+    __slots__ = ("num_rows", "num_bytes", "schema_fingerprint")
+
+    def __init__(self, num_rows: int, num_bytes: int,
+                 schema_fingerprint: int):
+        self.num_rows = num_rows
+        self.num_bytes = num_bytes
+        self.schema_fingerprint = schema_fingerprint
+
+    _S = struct.Struct("<qqQ")
+
+    def pack(self) -> bytes:
+        return self._S.pack(self.num_rows, self.num_bytes,
+                            self.schema_fingerprint)
+
+    @classmethod
+    def unpack(cls, data: bytes) -> "TableMeta":
+        return cls(*cls._S.unpack_from(data, 0))
+
+    @classmethod
+    def of(cls, batch: DeviceBatch, payload: bytes) -> "TableMeta":
+        import zlib
+        names = ",".join(batch.names).encode()
+        types = ",".join(d.name for d in batch.dtypes).encode()
+        fp = zlib.crc32(names + b"|" + types)
+        return cls(int(batch.num_rows), len(payload), fp)

@@ -348,7 +348,8 @@ EXEC_CONVERTS: Dict[Type[eb.Exec], Callable] = {}
 
 def _convert_join(e: "CpuJoinExec", conf) -> eb.Exec:
     j = HashJoinExec(e.left_keys, e.right_keys, e.how, e.condition,
-                     e.children[0], e.children[1])
+                     e.children[0], e.children[1],
+                     colocated=getattr(e, "colocated", False))
     j.placement = eb.TPU
     return j
 
@@ -378,8 +379,25 @@ def _tag_join(meta: "ExecMeta"):
 
 def _convert_aggregate(e: CpuHashAggregateExec, conf) -> eb.Exec:
     """Replace the complete-mode CPU aggregate with a TPU Partial/Final
-    pair (ref aggregate.scala partial/final mode pipeline)."""
+    pair (ref aggregate.scala partial/final mode pipeline).  When the
+    planner put an exchange below the aggregate, the partial half moves
+    BELOW the exchange (Spark's partial-aggregation pushdown) so only
+    pre-aggregated groups cross the wire."""
     child = e.children[0]
+    if isinstance(child, ShuffleExchangeExec):
+        from ..shuffle.partitioning import HashPartitioning
+        source = child.children[0]
+        partial = TpuHashAggregateExec(e.grouping, e.aggregates,
+                                       agg.PARTIAL, source)
+        part = HashPartitioning(
+            [AttributeReference(n) for n in partial.output_names[
+                :len(e.grouping)]],
+            child.partitioning.num_partitions)
+        exchange = ShuffleExchangeExec(part, partial)
+        exchange.placement = eb.TPU
+        final = TpuHashAggregateExec(e.grouping, partial.aggregates,
+                                     agg.FINAL, exchange)
+        return final
     partial = TpuHashAggregateExec(e.grouping, e.aggregates, agg.PARTIAL,
                                    child)
     final = TpuHashAggregateExec(e.grouping, partial.aggregates, agg.FINAL,
@@ -392,8 +410,10 @@ EXEC_CONVERTS[CpuJoinExec] = _convert_join
 EXEC_TAGS[CpuJoinExec] = _tag_join
 
 from ..exec.window import WindowExec  # noqa: E402
+from ..shuffle.exchange import ShuffleExchangeExec  # noqa: E402
 
 EXEC_SIGS[WindowExec] = T.common_scalar.nested()
+EXEC_SIGS[ShuffleExchangeExec] = _exec_common
 
 
 def _tag_window(meta: ExecMeta):

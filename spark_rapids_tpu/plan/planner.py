@@ -35,11 +35,17 @@ def plan(lp: L.LogicalPlan, conf) -> eb.Exec:
     if isinstance(lp, L.Aggregate):
         child = plan(lp.children[0], conf)
         if child.num_partitions > 1:
-            # complete-mode aggregation needs co-located groups; until the
-            # conversion pass swaps in partial/final around an exchange,
-            # gather to one partition (the overrides engine re-plans this)
-            from ..exec.gatherpart import GatherPartitionsExec
-            child = GatherPartitionsExec(child)
+            # co-locate groups: hash exchange on the grouping keys (the
+            # conversion pass rewrites this into partial->exchange->final)
+            if lp.grouping:
+                from ..shuffle.exchange import ShuffleExchangeExec
+                from ..shuffle.partitioning import HashPartitioning
+                child = ShuffleExchangeExec(
+                    HashPartitioning(lp.grouping, child.num_partitions),
+                    child)
+            else:
+                from ..exec.gatherpart import GatherPartitionsExec
+                child = GatherPartitionsExec(child)
         return CpuHashAggregateExec(lp.grouping, lp.aggregates, child)
     if isinstance(lp, L.Join):
         from ..exec.join import plan_join
@@ -49,8 +55,11 @@ def plan(lp: L.LogicalPlan, conf) -> eb.Exec:
         from ..exec.sort import SortExec
         child = plan(lp.children[0], conf)
         if lp.is_global and child.num_partitions > 1:
-            from ..exec.gatherpart import GatherPartitionsExec
-            child = GatherPartitionsExec(child)
+            # total-order sort: range-partition then sort within partitions
+            from ..shuffle.exchange import ShuffleExchangeExec
+            from ..shuffle.partitioning import RangePartitioning
+            child = ShuffleExchangeExec(
+                RangePartitioning(lp.orders, child.num_partitions), child)
         return SortExec(lp.orders, child, is_global=lp.is_global)
     if isinstance(lp, L.Limit):
         child = plan(lp.children[0], conf)
@@ -67,7 +76,23 @@ def plan(lp: L.LogicalPlan, conf) -> eb.Exec:
                                     plan(lp.children[0], conf))
     if isinstance(lp, L.Window):
         from ..exec.window import WindowExec
-        return WindowExec(lp.window_exprs, plan(lp.children[0], conf))
+        child = plan(lp.children[0], conf)
+        if child.num_partitions > 1:
+            specs = [w.spec for w in lp.window_exprs]
+            pkeys = specs[0].partition_by if specs else []
+            same_keys = all(
+                [k.sql() for k in s.partition_by] ==
+                [k.sql() for k in pkeys] for s in specs)
+            if pkeys and same_keys:
+                from ..shuffle.exchange import ShuffleExchangeExec
+                from ..shuffle.partitioning import HashPartitioning
+                child = ShuffleExchangeExec(
+                    HashPartitioning(list(pkeys), child.num_partitions),
+                    child)
+            else:
+                from ..exec.gatherpart import GatherPartitionsExec
+                child = GatherPartitionsExec(child)
+        return WindowExec(lp.window_exprs, child)
     if isinstance(lp, L.Expand):
         from ..exec.expand import ExpandExec
         return ExpandExec(lp.projections, lp._names,

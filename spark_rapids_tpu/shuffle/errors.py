@@ -1,0 +1,16 @@
+"""Shuffle failure types (ref org/apache/spark/shuffle/rapids/
+RapidsShuffleExceptions.scala): fetch failures surface as retryable errors
+so the scheduler's stage-retry machinery provides recovery."""
+
+
+class TpuShuffleError(Exception):
+    pass
+
+
+class TpuShuffleFetchFailedError(TpuShuffleError):
+    """A remote block could not be fetched; the caller should retry the
+    map stage (lineage recompute model, same as the reference)."""
+
+
+class TpuShuffleTimeoutError(TpuShuffleFetchFailedError):
+    pass

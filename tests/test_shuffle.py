@@ -1,0 +1,154 @@
+"""Shuffle tests: partitioning kernels, exchange-based multi-partition
+queries, the TCP transport client/server, and heartbeats
+(model: tests/.../shuffle suites — in-process, no real cluster)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col
+from spark_rapids_tpu.shuffle.heartbeat import (HeartbeatEndpoint,
+                                                HeartbeatManager)
+from spark_rapids_tpu.shuffle.manager import (ShuffleBlockId,
+                                              TpuShuffleManager)
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect)
+from spark_rapids_tpu.testing.data_gen import (IntegerGen, LongGen,
+                                               StringGen, gen_df)
+
+
+def test_hash_partition_ids_consistent_engines():
+    """Murmur3 partition routing must agree across engines."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.device import batch_to_device
+    from spark_rapids_tpu.expr.core import EvalContext, AttributeReference
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+    rb = pa.record_batch({"k": pa.array([1, 2, 3, None, 5, 6, 7, 8],
+                                        type=pa.int64())})
+    part = HashPartitioning([AttributeReference("k")], 4).bind(
+        ["k"], [__import__("spark_rapids_tpu.types",
+                           fromlist=["LONG"]).LONG])
+    out = {}
+    for xp in (np, jnp):
+        b = batch_to_device(rb, xp=xp)
+        ctx = EvalContext(xp, b)
+        pids = part.partition_ids(xp, ctx, b)
+        out[xp.__name__] = np.asarray(pids)[:8].tolist()
+    assert out["numpy"] == out["jax.numpy"]
+    assert all(0 <= p < 4 for p in out["numpy"])
+
+
+@pytest.mark.parametrize("n_parts", [2, 4])
+def test_multi_partition_aggregate(n_parts):
+    def q(spark):
+        df = gen_df(spark, [("k", IntegerGen(lo=0, hi=40)),
+                            ("v", LongGen())], length=2048,
+                    num_partitions=n_parts)
+        return df.group_by(col("k")).agg(F.sum(col("v")).alias("s"),
+                                         F.count("*").alias("c"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_multi_partition_string_group():
+    def q(spark):
+        df = gen_df(spark, [("k", StringGen(max_len=5)),
+                            ("v", LongGen())], length=1024,
+                    num_partitions=3)
+        return df.group_by(col("k")).agg(F.count("*").alias("c"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_multi_partition_join():
+    def q(spark):
+        a = gen_df(spark, [("k", IntegerGen(lo=0, hi=30)),
+                           ("va", LongGen())], length=512, seed=1,
+                   num_partitions=3)
+        b = gen_df(spark, [("k2", IntegerGen(lo=0, hi=30)),
+                           ("vb", LongGen())], length=256, seed=2,
+                   num_partitions=2)
+        return a.join(b, on=(col("k") == col("k2")), how="inner")
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_multi_partition_global_sort():
+    def q(spark):
+        df = gen_df(spark, [("a", IntegerGen()), ("b", LongGen())],
+                    length=1024, num_partitions=4)
+        return df.order_by(col("a"), col("b"))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+
+
+def test_repartition_roundtrip():
+    def q(spark):
+        df = gen_df(spark, [("k", IntegerGen()), ("v", LongGen())],
+                    length=512, num_partitions=2)
+        return df.repartition(5, col("k")).group_by(col("k")).agg(
+            F.count("*").alias("c"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_shuffle_serialization_roundtrip():
+    from spark_rapids_tpu.columnar.device import (batch_to_arrow,
+                                                  batch_to_device)
+    from spark_rapids_tpu.memory.meta import (deserialize_batch,
+                                              serialize_batch)
+    rb = pa.record_batch({
+        "a": pa.array([1, None, 3], type=pa.int64()),
+        "s": pa.array(["x", "yy", None])})
+    b = batch_to_device(rb, xp=np)
+    data = serialize_batch(b)
+    back = deserialize_batch(data, xp=np)
+    assert batch_to_arrow(back).to_pylist() == rb.to_pylist()
+
+
+def test_transport_fetch():
+    """Client/server over real sockets, serving catalog blocks."""
+    from spark_rapids_tpu.columnar.device import (batch_to_arrow,
+                                                  batch_to_device)
+    from spark_rapids_tpu.shuffle.transport import (ShuffleClient,
+                                                    ShuffleServer)
+    TpuShuffleManager.reset()
+    mgr = TpuShuffleManager.get()
+    rb = pa.record_batch({"a": pa.array(list(range(100)), type=pa.int64())})
+    b = batch_to_device(rb, xp=np)
+    mgr.write_map_output(7, 0, {3: b})
+    server = ShuffleServer(mgr).start()
+    try:
+        cli = ShuffleClient("127.0.0.1", server.port)
+        metas = cli.fetch_metadata(7, 3).wait(10)
+        assert len(metas) == 1
+        (sid, mid, rid, idx), meta = metas[0]
+        assert (sid, mid, rid) == (7, 0, 3)
+        assert meta.num_rows == 100
+        got = cli.fetch_block(sid, mid, rid, idx).wait(10)
+        assert batch_to_arrow(got).to_pylist() == rb.to_pylist()
+        # error path: missing block -> fetch-failed
+        from spark_rapids_tpu.shuffle.errors import (
+            TpuShuffleFetchFailedError)
+        with pytest.raises(TpuShuffleFetchFailedError):
+            cli.fetch_block(7, 0, 3, 99).wait(10)
+        cli.close()
+    finally:
+        server.stop()
+        TpuShuffleManager.reset()
+
+
+def test_heartbeats():
+    mgr = HeartbeatManager(timeout_s=0.5)
+    seen = {}
+    e1 = HeartbeatEndpoint(mgr, "exec-1", "h1", 1111, interval_s=0.1,
+                           on_peers=lambda ps: seen.__setitem__(
+                               "e1", [p.executor_id for p in ps]))
+    peers2 = mgr.register_executor("exec-2", "h2", 2222)
+    assert [p.executor_id for p in peers2] == ["exec-1"]
+    e1.start()
+    import time
+    time.sleep(0.3)
+    assert seen.get("e1") == ["exec-2"]
+    e1.stop()
+    # exec-1 stops heartbeating; after timeout it expires
+    time.sleep(0.7)
+    mgr.executor_heartbeat("exec-2")
+    assert [p.executor_id for p in mgr.live_peers()] == ["exec-2"]
+    assert mgr.expire_dead() == ["exec-1"]
