@@ -39,9 +39,16 @@ class TpuSession:
         conf = self.conf
         if conf.get(cfg.BACKEND) == "tpu" and conf.sql_enabled:
             from ..memory.device import DeviceManager
+            from ..memory.semaphore import TpuSemaphore
+            from ..memory.spill import SpillCatalog
             self.device_manager = DeviceManager.initialize(conf)
+            self.semaphore = TpuSemaphore.initialize(
+                conf.get(cfg.CONCURRENT_TPU_TASKS))
+            self.spill_catalog = SpillCatalog.init_from_conf(conf)
         else:
             self.device_manager = None
+            self.semaphore = None
+            self.spill_catalog = None
 
     # -- conf ---------------------------------------------------------------
     @property

@@ -232,6 +232,11 @@ SPILL_DIRS = conf("spark.rapids.memory.spill.dirs").string() \
     .doc("Comma-separated local dirs for the DISK spill tier.") \
     .create_with_default("/tmp/spark_rapids_tpu_spill")
 
+SPILL_DEVICE_BUDGET = conf("spark.rapids.memory.tpu.spillBudgetBytes").bytes() \
+    .doc("Override the registered-batch device budget that triggers "
+         "proactive spill (default: the HBM arena size).").internal() \
+    .create_optional()
+
 MEMORY_DEBUG = conf("spark.rapids.memory.tpu.debug").boolean() \
     .doc("Track allocations for leak diagnostics (ref RapidsConf.scala:307).") \
     .create_with_default(False)

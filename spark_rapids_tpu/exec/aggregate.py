@@ -265,6 +265,8 @@ class TpuHashAggregateExec(Exec):
               if self.mode in (PARTIAL, COMPLETE)
               else self.children[0].output_types[:len(self.grouping)])
         schema_types = kt + self._buffer_types
+        from ..memory.spill import SpillCatalog, SpillPriority
+        spill = SpillCatalog.get()
         for b in self.children[0].execute_partition(pid, ctx):
             with MetricTimer(self.metrics[OP_TIME]):
                 if self.mode in (PARTIAL, COMPLETE):
@@ -272,7 +274,9 @@ class TpuHashAggregateExec(Exec):
                         self._update_batch(np, b)
                 else:
                     out = b  # FINAL: merge happens below
-            partials.append(out)
+            # accumulated partials are spillable (ref aggregate.scala's
+            # spillable batch accumulation before merge)
+            partials.append(spill.register(out, SpillPriority.INPUT))
         if not partials:
             if self.grouping:
                 return
@@ -286,14 +290,18 @@ class TpuHashAggregateExec(Exec):
                       {n: pa.array([], type=f.type)
                        for n, f in zip(empty.schema.names, empty.schema)})])
             eb = batch_to_device(rb[0], xp=xp)
-            partials = [self._jit_update(eb) if on_tpu
-                        else self._update_batch(np, eb)]
+            partials = [spill.register(
+                self._jit_update(eb) if on_tpu
+                else self._update_batch(np, eb), SpillPriority.INPUT)]
         with MetricTimer(self.metrics[OP_TIME]):
-            if len(partials) == 1:
-                merged_in = partials[0]
+            mats = [p.get_batch(xp) for p in partials]
+            if len(mats) == 1:
+                merged_in = mats[0]
             else:
-                merged_in = concat_batches(xp, partials, schema_names,
+                merged_in = concat_batches(xp, mats, schema_names,
                                            schema_types)
+            for p in partials:
+                p.close()
             if self.mode == PARTIAL:
                 out = self._jit_merge(merged_in) if on_tpu else \
                     self._merge_batch(np, merged_in)

@@ -119,13 +119,21 @@ class Exec:
         raise NotImplementedError
 
     def execute_collect(self, ctx: ExecContext) -> pa.Table:
-        """Run all partitions and collect to an Arrow table (driver side)."""
+        """Run all partitions and collect to an Arrow table (driver side).
+        Each partition is a 'task': it holds the TPU semaphore while it
+        runs (ref GpuSemaphore acquire/release around task device work)."""
+        from ..memory.semaphore import TpuSemaphore
+        sem = TpuSemaphore.get()
         out: List[pa.RecordBatch] = []
         for pid in range(self.num_partitions):
-            for b in self.execute_partition(pid, ctx):
-                rb = to_host_batch(b, self.output_names)
-                if rb.num_rows:
-                    out.append(rb)
+            sem.acquire_if_necessary(pid)
+            try:
+                for b in self.execute_partition(pid, ctx):
+                    rb = to_host_batch(b, self.output_names)
+                    if rb.num_rows:
+                        out.append(rb)
+            finally:
+                sem.release_if_necessary(pid)
         from ..columnar.interop import to_arrow_schema
         schema = to_arrow_schema(self.output_names, self.output_types)
         if not out:

@@ -78,16 +78,21 @@ class SortExec(Exec):
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         xp = self.xp
-        batches = [b for b in self.children[0].execute_partition(pid, ctx)
-                   if int(b.num_rows) or True]
-        if not batches:
+        from ..memory.spill import SpillCatalog, SpillPriority
+        spill = SpillCatalog.get()
+        pending = [spill.register(b, SpillPriority.INPUT)
+                   for b in self.children[0].execute_partition(pid, ctx)]
+        if not pending:
             return
         with MetricTimer(self.metrics[OP_TIME]):
+            batches = [p.get_batch(xp) for p in pending]
             if len(batches) > 1:
                 merged = concat_batches(xp, batches, self.output_names,
                                         self.output_types)
             else:
                 merged = batches[0]
+            for p in pending:
+                p.close()
             out = self._jitted(merged) if self.placement == TPU \
                 else self._sort_batch(np, merged)
         self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)

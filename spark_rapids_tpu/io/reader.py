@@ -1,0 +1,95 @@
+"""DataFrameReader: spark.read.parquet/orc/csv entry points.
+
+Ref: the reader side of GpuReadParquetFileFormat / GpuReadOrcFileFormat /
+GpuReadCSVFileFormat — schema discovery from footers, options handling.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.orc as paorc
+import pyarrow.parquet as papq
+
+from ..columnar.interop import from_arrow_type
+from ..plan.logical import FileRelation
+
+
+def _expand(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for fmt_glob in ("*.parquet", "*.orc", "*.csv", "*"):
+                hits = sorted(glob.glob(os.path.join(p, fmt_glob)))
+                hits = [h for h in hits if os.path.isfile(h)
+                        and not os.path.basename(h).startswith(("_", "."))]
+                if hits:
+                    out.extend(hits)
+                    break
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(glob.glob(p)))
+        else:
+            out.append(p)
+    return out
+
+
+class DataFrameReader:
+    def __init__(self, session):
+        self.session = session
+        self._options: Dict = {}
+        self._schema = None
+
+    def option(self, key, value) -> "DataFrameReader":
+        self._options[key] = value
+        return self
+
+    def schema(self, schema) -> "DataFrameReader":
+        self._schema = schema
+        return self
+
+    def parquet(self, *paths):
+        files = _expand(list(paths))
+        if not files:
+            raise FileNotFoundError(f"no parquet files under {paths}")
+        schema = papq.read_schema(files[0])
+        names = list(schema.names)
+        dtypes = [from_arrow_type(f.type) for f in schema]
+        from ..api.dataframe import DataFrame
+        return DataFrame(FileRelation("parquet", files, names, dtypes,
+                                      dict(self._options)), self.session)
+
+    def orc(self, *paths):
+        files = _expand(list(paths))
+        if not files:
+            raise FileNotFoundError(f"no orc files under {paths}")
+        schema = paorc.ORCFile(files[0]).schema
+        names = list(schema.names)
+        dtypes = [from_arrow_type(f.type) for f in schema]
+        from ..api.dataframe import DataFrame
+        return DataFrame(FileRelation("orc", files, names, dtypes,
+                                      dict(self._options)), self.session)
+
+    def csv(self, *paths, header: bool = True):
+        files = _expand(list(paths))
+        if not files:
+            raise FileNotFoundError(f"no csv files under {paths}")
+        opts = dict(self._options)
+        opts.setdefault("header", header)
+        if self._schema is not None:
+            names = [n for n, _ in self._schema]
+            dtypes = [d for _, d in self._schema]
+        else:
+            ropts = pacsv.ReadOptions(
+                autogenerate_column_names=not opts.get("header", True))
+            sample = pacsv.read_csv(files[0], read_options=ropts)
+            names = list(sample.schema.names)
+            dtypes = [from_arrow_type(f.type) for f in sample.schema]
+        from ..api.dataframe import DataFrame
+        return DataFrame(FileRelation("csv", files, names, dtypes, opts),
+                         self.session)

@@ -1,0 +1,68 @@
+"""TPU task admission semaphore.
+
+Ref: GpuSemaphore.scala:27-170 — bounds how many concurrent tasks may hold
+device memory at once (spark.rapids.sql.concurrentGpuTasks); a task
+acquires before its first device operation and releases at completion.
+Re-entrant per task, like the reference's per-task bookkeeping.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class TpuSemaphore:
+    _instance: Optional["TpuSemaphore"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, max_concurrent: int):
+        self.max_concurrent = max_concurrent
+        self._sem = threading.Semaphore(max_concurrent)
+        self._holders: Dict[int, int] = {}
+        self._holders_lock = threading.Lock()
+
+    @classmethod
+    def initialize(cls, max_concurrent: int) -> "TpuSemaphore":
+        with cls._lock:
+            if cls._instance is None or \
+                    cls._instance.max_concurrent != max_concurrent:
+                cls._instance = TpuSemaphore(max_concurrent)
+            return cls._instance
+
+    @classmethod
+    def get(cls) -> "TpuSemaphore":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = TpuSemaphore(1)
+            return cls._instance
+
+    def acquire_if_necessary(self, task_id: int,
+                             timeout: Optional[float] = None) -> bool:
+        """Blocks until the task holds the semaphore (re-entrant)."""
+        with self._holders_lock:
+            if task_id in self._holders:
+                self._holders[task_id] += 1
+                return True
+        ok = self._sem.acquire(timeout=timeout) if timeout is not None \
+            else self._sem.acquire()
+        if ok:
+            with self._holders_lock:
+                self._holders[task_id] = 1
+        return ok
+
+    def release_if_necessary(self, task_id: int) -> None:
+        with self._holders_lock:
+            n = self._holders.get(task_id)
+            if n is None:
+                return
+            if n > 1:
+                self._holders[task_id] = n - 1
+                return
+            del self._holders[task_id]
+        self._sem.release()
+
+    @property
+    def holders(self) -> int:
+        with self._holders_lock:
+            return len(self._holders)

@@ -1,0 +1,273 @@
+"""Tiered spill framework: DEVICE -> HOST -> DISK.
+
+Ref: RapidsBuffer.scala:53 (StorageTier), RapidsBufferCatalog.scala:156
+(registry + tier wiring), RapidsBufferStore.synchronousSpill:146,
+DeviceMemoryEventHandler.scala (Rmm OOM callback), SpillPriorities.scala,
+SpillableColumnarBatch.scala.
+
+TPU redesign (SURVEY hard-part #5): XLA owns the allocator, so there is no
+RMM-style OOM callback.  Instead the framework tracks every *registered*
+batch's device footprint in this catalog and reacts two ways:
+  * proactively — `maybe_spill()` demotes lowest-priority buffers when the
+    registered device bytes exceed the HBM budget;
+  * reactively — `with_retry_spill(fn)` catches XLA RESOURCE_EXHAUSTED,
+    spills synchronously, and retries, the analog of the reference's
+    retry-on-OOM allocation loop.
+Host tier holds serialized batches in RAM up to its own budget, then
+overflows to local disk (RapidsDiskStore analog).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import uuid
+from enum import Enum
+from typing import Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..columnar.device import DeviceBatch
+
+
+class StorageTier(Enum):
+    DEVICE = 0
+    HOST = 1
+    DISK = 2
+
+
+class SpillPriority:
+    """Lower value spills first (ref SpillPriorities.scala)."""
+    INPUT = -10
+    SHUFFLE = 0
+    ACTIVE = 100
+
+
+def batch_device_bytes(batch: DeviceBatch) -> int:
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(batch):
+        nb = getattr(leaf, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+    return total
+
+
+class SpillableBatch:
+    """A batch that can move down the storage tiers and come back
+    (ref SpillableColumnarBatch.scala:29-230)."""
+
+    def __init__(self, batch: DeviceBatch, catalog: "SpillCatalog",
+                 priority: int = SpillPriority.ACTIVE):
+        self.id = uuid.uuid4().hex
+        self.catalog = catalog
+        self.priority = priority
+        self.tier = StorageTier.DEVICE
+        self._batch: Optional[DeviceBatch] = batch
+        self._host_bytes: Optional[bytes] = None
+        self._disk_path: Optional[str] = None
+        self.device_bytes = batch_device_bytes(batch)
+        self.num_rows = int(batch.num_rows)
+
+    # -- tier moves ---------------------------------------------------------
+    def spill_to_host(self):
+        if self.tier != StorageTier.DEVICE:
+            return 0
+        from .meta import serialize_batch
+        self._host_bytes = serialize_batch(self._batch)
+        self._batch = None
+        self.tier = StorageTier.HOST
+        return self.device_bytes
+
+    def spill_to_disk(self):
+        if self.tier == StorageTier.DEVICE:
+            self.spill_to_host()
+        if self.tier != StorageTier.HOST:
+            return 0
+        path = os.path.join(self.catalog.spill_dir, f"spill-{self.id}.bin")
+        with open(path, "wb") as f:
+            f.write(self._host_bytes)
+        freed = len(self._host_bytes)
+        self._disk_path = path
+        self._host_bytes = None
+        self.tier = StorageTier.DISK
+        return freed
+
+    def get_batch(self, xp) -> DeviceBatch:
+        """Materialize (unspilling if needed)."""
+        if self.tier == StorageTier.DEVICE:
+            b = self._batch
+            if xp is not np:
+                return b
+            return b
+        from .meta import deserialize_batch
+        if self.tier == StorageTier.HOST:
+            data = self._host_bytes
+        else:
+            with open(self._disk_path, "rb") as f:
+                data = f.read()
+        batch = deserialize_batch(data, xp=xp)
+        if self.catalog.unspill_enabled and xp is not np:
+            self._batch = batch
+            self._host_bytes = None
+            if self._disk_path:
+                try:
+                    os.unlink(self._disk_path)
+                except OSError:
+                    pass
+                self._disk_path = None
+            self.tier = StorageTier.DEVICE
+            self.catalog.note_unspill(self)
+        return batch
+
+    def host_size(self) -> int:
+        return len(self._host_bytes) if self._host_bytes else 0
+
+    def close(self):
+        self.catalog.unregister(self)
+        self._batch = None
+        self._host_bytes = None
+        if self._disk_path:
+            try:
+                os.unlink(self._disk_path)
+            except OSError:
+                pass
+
+
+class SpillCatalog:
+    """Registry + tier orchestration (ref RapidsBufferCatalog)."""
+
+    _instance: Optional["SpillCatalog"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, device_budget: int = 8 << 30,
+                 host_budget: int = 1 << 30,
+                 spill_dir: Optional[str] = None,
+                 unspill_enabled: bool = False):
+        self.device_budget = device_budget
+        self.host_budget = host_budget
+        self.spill_dir = spill_dir or tempfile.mkdtemp(
+            prefix="spark_rapids_tpu_spill_")
+        os.makedirs(self.spill_dir, exist_ok=True)
+        self.unspill_enabled = unspill_enabled
+        self._buffers: Dict[str, SpillableBatch] = {}
+        self._reg_lock = threading.RLock()
+        self.spilled_to_host_bytes = 0
+        self.spilled_to_disk_bytes = 0
+
+    @classmethod
+    def get(cls) -> "SpillCatalog":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = SpillCatalog()
+            return cls._instance
+
+    @classmethod
+    def init_from_conf(cls, conf) -> "SpillCatalog":
+        from .. import config as cfg
+        from .device import DeviceManager
+        dm = DeviceManager.get()
+        device_budget = conf.get(cfg.SPILL_DEVICE_BUDGET)
+        if device_budget is None:
+            device_budget = dm.hbm_limit if dm and dm.hbm_limit > 0 \
+                else 8 << 30
+        with cls._lock:
+            cls._instance = SpillCatalog(
+                device_budget=device_budget,
+                host_budget=conf.get(cfg.HOST_SPILL_STORAGE_SIZE),
+                spill_dir=conf.get(cfg.SPILL_DIRS).split(",")[0],
+                unspill_enabled=conf.get(cfg.UNSPILL_ENABLED))
+            return cls._instance
+
+    # -- registration -------------------------------------------------------
+    def register(self, batch: DeviceBatch,
+                 priority: int = SpillPriority.ACTIVE) -> SpillableBatch:
+        sb = SpillableBatch(batch, self, priority)
+        with self._reg_lock:
+            self._buffers[sb.id] = sb
+        self.maybe_spill()
+        return sb
+
+    def unregister(self, sb: SpillableBatch):
+        with self._reg_lock:
+            self._buffers.pop(sb.id, None)
+
+    def note_unspill(self, sb: SpillableBatch):
+        self.maybe_spill()
+
+    # -- accounting ---------------------------------------------------------
+    def device_bytes_registered(self) -> int:
+        with self._reg_lock:
+            return sum(b.device_bytes for b in self._buffers.values()
+                       if b.tier == StorageTier.DEVICE)
+
+    def host_bytes_registered(self) -> int:
+        with self._reg_lock:
+            return sum(b.host_size() for b in self._buffers.values()
+                       if b.tier == StorageTier.HOST)
+
+    # -- spilling -----------------------------------------------------------
+    def synchronous_spill(self, target_free: int) -> int:
+        """Demote device buffers (lowest priority first) until
+        `target_free` bytes are released (ref synchronousSpill)."""
+        freed = 0
+        with self._reg_lock:
+            candidates = sorted(
+                (b for b in self._buffers.values()
+                 if b.tier == StorageTier.DEVICE),
+                key=lambda b: b.priority)
+            for b in candidates:
+                if freed >= target_free:
+                    break
+                freed += b.spill_to_host()
+                self.spilled_to_host_bytes += b.host_size()
+            self._enforce_host_budget()
+        return freed
+
+    def _enforce_host_budget(self):
+        used = sum(b.host_size() for b in self._buffers.values()
+                   if b.tier == StorageTier.HOST)
+        if used <= self.host_budget:
+            return
+        candidates = sorted(
+            (b for b in self._buffers.values()
+             if b.tier == StorageTier.HOST),
+            key=lambda b: b.priority)
+        for b in candidates:
+            if used <= self.host_budget:
+                break
+            sz = b.host_size()
+            self.spilled_to_disk_bytes += sz
+            b.spill_to_disk()
+            used -= sz
+
+    def maybe_spill(self):
+        over = self.device_bytes_registered() - self.device_budget
+        if over > 0:
+            self.synchronous_spill(over)
+
+
+def is_oom_error(ex: Exception) -> bool:
+    s = str(ex)
+    return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s or \
+        "OOM" in s
+
+
+def with_retry_spill(fn: Callable, catalog: Optional[SpillCatalog] = None,
+                     attempts: int = 3):
+    """Run a device computation; on XLA OOM, spill registered buffers and
+    retry (the DeviceMemoryEventHandler analog)."""
+    catalog = catalog or SpillCatalog.get()
+    last = None
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as ex:  # XlaRuntimeError etc.
+            if not is_oom_error(ex):
+                raise
+            last = ex
+            freed = catalog.synchronous_spill(catalog.device_budget)
+            if freed == 0 and i > 0:
+                break
+    raise last

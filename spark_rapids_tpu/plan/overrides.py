@@ -415,6 +415,22 @@ from ..shuffle.exchange import ShuffleExchangeExec  # noqa: E402
 EXEC_SIGS[WindowExec] = T.common_scalar.nested()
 EXEC_SIGS[ShuffleExchangeExec] = _exec_common
 
+from ..io.scan import FileScanExec  # noqa: E402
+
+EXEC_SIGS[FileScanExec] = _exec_common
+
+
+def _tag_file_scan(meta: "ExecMeta"):
+    from .. import config as cfg
+    e: FileScanExec = meta.exec
+    key = {"parquet": cfg.PARQUET_ENABLED, "orc": cfg.ORC_ENABLED,
+           "csv": cfg.CSV_ENABLED}.get(e.fmt)
+    if key is not None and not meta.conf.get(key):
+        meta.will_not_work(f"{e.fmt} scan disabled by config")
+
+
+EXEC_TAGS[FileScanExec] = _tag_file_scan
+
 
 def _tag_window(meta: ExecMeta):
     from ..expr import window as W

@@ -29,9 +29,23 @@ def plan(lp: L.LogicalPlan, conf) -> eb.Exec:
         from ..io.scan import make_scan_exec
         return make_scan_exec(lp, conf)
     if isinstance(lp, L.Project):
-        return ProjectExec(lp.exprs, plan(lp.children[0], conf))
+        child_lp = lp.children[0]
+        if isinstance(child_lp, L.FileRelation) and all(
+                isinstance(e, AttributeReference) for e in lp.exprs):
+            # column pruning pushdown (ref GpuFileSourceScanExec pruning)
+            from ..io.scan import make_scan_exec
+            scan = make_scan_exec(child_lp, conf)
+            scan.required_columns = [e.name for e in lp.exprs]
+            return scan
+        return ProjectExec(lp.exprs, plan(child_lp, conf))
     if isinstance(lp, L.Filter):
-        return FilterExec(lp.condition, plan(lp.children[0], conf))
+        child_lp = lp.children[0]
+        if isinstance(child_lp, L.FileRelation):
+            # predicate pushdown for row-group pruning; the exact Filter
+            # stays above (ref parquet footer filters + GpuFilterExec)
+            child_lp.pushed_filters = child_lp.pushed_filters + \
+                [lp.condition]
+        return FilterExec(lp.condition, plan(child_lp, conf))
     if isinstance(lp, L.Aggregate):
         child = plan(lp.children[0], conf)
         if child.num_partitions > 1:

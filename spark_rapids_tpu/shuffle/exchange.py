@@ -99,11 +99,13 @@ class ShuffleExchangeExec(Exec):
             self._shuffle_id = shuffle_id
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        from ..memory.spill import SpillableBatch
         self._ensure_written(ctx)
         mgr = TpuShuffleManager.get()
-        got = 0
+        xp = self.xp
         for b in mgr.read_partition(self._shuffle_id, pid):
-            got += 1
+            if isinstance(b, SpillableBatch):
+                b = b.get_batch(xp)
             self.metrics[NUM_OUTPUT_ROWS] += int(b.num_rows)
             self.metrics[NUM_OUTPUT_BATCHES] += 1
             yield b

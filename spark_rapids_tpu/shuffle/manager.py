@@ -89,10 +89,16 @@ class TpuShuffleManager:
     def write_map_output(self, shuffle_id: int, map_id: int,
                          slices: Dict[int, DeviceBatch]) -> None:
         """Register one map task's partition slices (ref
-        RapidsCachingWriter.write)."""
+        RapidsCachingWriter.write).  Batches stay live in device memory but
+        are registered spillable, so memory pressure demotes them
+        HOST->DISK exactly like the reference's shuffle-buffer spill."""
+        from ..memory.spill import SpillCatalog, SpillPriority
+        spill = SpillCatalog.get()
         for reduce_id, batch in slices.items():
+            sb = spill.register(batch, SpillPriority.SHUFFLE) \
+                if isinstance(batch, DeviceBatch) else batch
             self.catalog.add(ShuffleBlockId(shuffle_id, map_id, reduce_id),
-                             batch)
+                             sb)
         self._written[(shuffle_id, map_id)] = True
 
     def map_done(self, shuffle_id: int, map_id: int) -> bool:

@@ -122,6 +122,7 @@ class ShuffleServer:
         metas = []
         for blk in blocks:
             for i, b in enumerate(self.manager.catalog.get(blk)):
+                b = _materialize(b)
                 payload = serialize_batch(b)
                 metas.append((blk, i, TableMeta.of(b, payload)))
         out = struct.pack("<i", len(metas))
@@ -135,7 +136,7 @@ class ShuffleServer:
         if idx >= len(batches):
             _send_frame(sock, MSG_ERROR, req_id, b"no such block")
             return
-        payload = serialize_batch(batches[idx])
+        payload = serialize_batch(_materialize(batches[idx]))
         # windowed chunked send (bounce-buffer flow, BufferSendState analog)
         total = len(payload)
         _send_frame(sock, MSG_BUFFER, req_id,
@@ -208,6 +209,13 @@ class ShuffleClient:
         except OSError as ex:
             tx.fail(str(ex))
         return tx
+
+
+def _materialize(b):
+    from ..memory.spill import SpillableBatch
+    if isinstance(b, SpillableBatch):
+        return b.get_batch(np)
+    return b
 
 
 def _send_frame(sock, mtype: int, req_id: int, body: bytes):

@@ -1,0 +1,120 @@
+"""Parquet/ORC/CSV read+write tests (model: integration_tests/
+parquet_test.py, parquet_write_test.py, orc_test.py, csv_test.py)."""
+
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect, with_cpu_session,
+    with_tpu_session)
+from spark_rapids_tpu.testing.data_gen import (DoubleGen, IntegerGen,
+                                               LongGen, StringGen,
+                                               gen_table)
+
+
+@pytest.fixture
+def sample_table():
+    return gen_table([("k", IntegerGen(lo=0, hi=50)), ("v", LongGen()),
+                      ("s", StringGen(max_len=10)),
+                      ("f", DoubleGen(no_nans=True))], length=1000, seed=7)
+
+
+def _write_parquet_files(tmp_path, table, n_files=3):
+    paths = []
+    bounds = [round(i * table.num_rows / n_files)
+              for i in range(n_files + 1)]
+    for i in range(n_files):
+        p = str(tmp_path / f"f{i}.parquet")
+        papq.write_table(table.slice(bounds[i], bounds[i + 1] - bounds[i]),
+                         p)
+        paths.append(p)
+    return paths
+
+
+@pytest.mark.parametrize("reader_type",
+                         ["PERFILE", "COALESCING", "MULTITHREADED"])
+def test_parquet_read_strategies(tmp_path, sample_table, reader_type):
+    paths = _write_parquet_files(tmp_path, sample_table)
+    conf = {"spark.rapids.sql.format.parquet.reader.type": reader_type}
+
+    def q(spark):
+        return spark.read.parquet(*paths).group_by(col("k")).agg(
+            F.sum(col("v")).alias("sv"), F.count("*").alias("c"))
+    assert_tpu_and_cpu_are_equal_collect(q, conf)
+
+
+def test_parquet_pushdown_and_pruning(tmp_path, sample_table):
+    paths = _write_parquet_files(tmp_path, sample_table)
+
+    def q(spark):
+        df = spark.read.parquet(*paths)
+        return df.filter(col("k") > 25).select("k", "v")
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q)
+    assert cpu.schema.names == ["k", "v"]
+    exp = sample_table.to_pandas()
+    exp = exp[exp.k > 25]
+    assert cpu.num_rows == len(exp)
+
+
+def test_parquet_roundtrip_write(tmp_path, sample_table):
+    src = str(tmp_path / "src.parquet")
+    papq.write_table(sample_table, src)
+    out_dir = str(tmp_path / "out")
+
+    def write(spark):
+        df = spark.read.parquet(src)
+        df.write.mode("overwrite").parquet(out_dir)
+        return spark.read.parquet(out_dir)
+    back = with_tpu_session(lambda s: write(s).collect())
+    assert back.num_rows == sample_table.num_rows
+    assert sorted(back.column("v").to_pylist(),
+                  key=lambda x: (x is None, x)) == \
+        sorted(sample_table.column("v").to_pylist(),
+               key=lambda x: (x is None, x))
+
+
+def test_partitioned_write(tmp_path, sample_table):
+    src = str(tmp_path / "src.parquet")
+    small = sample_table.slice(0, 100)
+    papq.write_table(small, src)
+    out_dir = str(tmp_path / "pout")
+
+    def write(spark):
+        df = spark.read.parquet(src)
+        df.write.mode("overwrite").partition_by("k").parquet(out_dir)
+    with_tpu_session(write)
+    parts = [d for d in os.listdir(out_dir) if d.startswith("k=")]
+    assert len(parts) >= 2
+
+
+def test_orc_roundtrip(tmp_path, sample_table):
+    import pyarrow.orc as paorc
+    src = str(tmp_path / "a.orc")
+    # ORC writer doesn't take large_string: cast
+    cast = sample_table.cast(pa.schema([
+        pa.field("k", pa.int32()), pa.field("v", pa.int64()),
+        pa.field("s", pa.string()), pa.field("f", pa.float64())]))
+    paorc.write_table(cast, src)
+
+    def q(spark):
+        return spark.read.orc(src).group_by(col("k")).agg(
+            F.count("*").alias("c"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_csv_read(tmp_path):
+    p = str(tmp_path / "data.csv")
+    with open(p, "w") as f:
+        f.write("a,b,c\n1,2.5,hello\n2,3.5,world\n3,,x\n")
+
+    def q(spark):
+        return spark.read.csv(p).select(
+            (col("a") * 2).alias("a2"), col("b"), col("c"))
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+    assert tpu.column("a2").to_pylist() == [2, 4, 6]
+    assert tpu.column("b").to_pylist() == [2.5, 3.5, None]

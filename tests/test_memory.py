@@ -1,0 +1,138 @@
+"""Memory framework tests: spill tiers, catalog budgets, semaphore
+(model: RapidsDeviceMemoryStoreSuite / RapidsHostMemoryStoreSuite /
+RapidsDiskStoreSuite / GpuSemaphoreSuite)."""
+
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar.device import batch_to_arrow, batch_to_device
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.memory.spill import (SpillCatalog, SpillPriority,
+                                           SpillableBatch, StorageTier,
+                                           with_retry_spill)
+
+
+def _batch(n=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    rb = pa.record_batch({
+        "a": pa.array(rng.integers(0, 100, n)),
+        "s": pa.array([f"row{i}" for i in range(n)])})
+    return rb, batch_to_device(rb, xp=np)
+
+
+def test_spill_tiers_roundtrip(tmp_path):
+    cat = SpillCatalog(device_budget=1 << 30, host_budget=1 << 30,
+                       spill_dir=str(tmp_path))
+    rb, b = _batch()
+    sb = cat.register(b)
+    assert sb.tier == StorageTier.DEVICE
+    sb.spill_to_host()
+    assert sb.tier == StorageTier.HOST
+    back = sb.get_batch(np)
+    assert batch_to_arrow(back).to_pylist() == rb.to_pylist()
+    sb.spill_to_disk()
+    assert sb.tier == StorageTier.DISK
+    back = sb.get_batch(np)
+    assert batch_to_arrow(back).to_pylist() == rb.to_pylist()
+    sb.close()
+
+
+def test_device_budget_triggers_spill(tmp_path):
+    rb, b = _batch()
+    one = sum(leaf.nbytes for leaf in
+              __import__("jax").tree_util.tree_leaves(b))
+    cat = SpillCatalog(device_budget=int(one * 2.5),
+                       host_budget=1 << 30, spill_dir=str(tmp_path))
+    sbs = [cat.register(_batch(seed=i)[1], SpillPriority.INPUT)
+           for i in range(4)]
+    # budget fits ~2.5 batches: at least one must have left the device
+    tiers = [s.tier for s in sbs]
+    assert any(t != StorageTier.DEVICE for t in tiers)
+    assert cat.device_bytes_registered() <= int(one * 2.5)
+    for s in sbs:
+        s.close()
+
+
+def test_host_budget_overflows_to_disk(tmp_path):
+    rb, b = _batch()
+    cat = SpillCatalog(device_budget=0, host_budget=1,
+                       spill_dir=str(tmp_path))
+    sb = cat.register(b)
+    # device budget 0 -> immediate spill; host budget 1 byte -> disk
+    assert sb.tier == StorageTier.DISK
+    assert batch_to_arrow(sb.get_batch(np)).to_pylist() == rb.to_pylist()
+    sb.close()
+
+
+def test_retry_spill_on_oom(tmp_path):
+    cat = SpillCatalog(device_budget=1 << 30, host_budget=1 << 30,
+                       spill_dir=str(tmp_path))
+    rb, b = _batch()
+    sb = cat.register(b)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory on HBM")
+        return 42
+
+    assert with_retry_spill(flaky, cat) == 42
+    assert sb.tier != StorageTier.DEVICE  # the retry spilled it
+    sb.close()
+
+
+def test_semaphore_limits_concurrency():
+    sem = TpuSemaphore(2)
+    order = []
+    barrier = threading.Barrier(2)
+
+    def task(tid):
+        sem.acquire_if_necessary(tid)
+        order.append(("in", tid))
+        barrier.wait(timeout=5)
+        sem.release_if_necessary(tid)
+
+    t1 = threading.Thread(target=task, args=(1,))
+    t2 = threading.Thread(target=task, args=(2,))
+    t1.start()
+    t2.start()
+    t1.join(5)
+    t2.join(5)
+    assert len([o for o in order if o[0] == "in"]) == 2
+    # third acquire with none released would block: use timeout path
+    sem2 = TpuSemaphore(1)
+    assert sem2.acquire_if_necessary(10)
+    assert sem2.acquire_if_necessary(10)  # re-entrant
+    assert not sem2.acquire_if_necessary(11, timeout=0.1)
+    sem2.release_if_necessary(10)
+    sem2.release_if_necessary(10)
+    assert sem2.acquire_if_necessary(11, timeout=1.0)
+
+
+def test_query_runs_with_tiny_device_budget(tmp_path):
+    """End-to-end aggregation under heavy spill pressure: every partial
+    demotes to disk and comes back for the merge."""
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.testing.asserts import with_tpu_session
+    from spark_rapids_tpu.testing.data_gen import IntegerGen, LongGen, gen_df
+
+    conf = {"spark.rapids.memory.tpu.spillBudgetBytes": 1,
+            "spark.rapids.memory.host.spillStorageSize": 1,
+            "spark.rapids.memory.spill.dirs": str(tmp_path)}
+    old = SpillCatalog._instance
+    try:
+        def q(spark):
+            df = gen_df(spark, [("k", IntegerGen(lo=0, hi=10)),
+                                ("v", LongGen())], length=512,
+                        num_partitions=3)
+            return df.group_by(col("k")).agg(F.sum(col("v")).alias("s"))
+        out = with_tpu_session(lambda s: q(s).collect(), conf)
+        assert out.num_rows > 0
+        assert SpillCatalog._instance.spilled_to_disk_bytes > 0
+    finally:
+        SpillCatalog._instance = old
