@@ -61,6 +61,36 @@ class DataFrame:
                         isinstance(e, WindowExpression):
                     e.name = c._alias
                 exprs.append(e)
+        # route generators (explode/posexplode) through a Generate node
+        from ..expr.collection import Generator
+        gens = [e for e in exprs
+                if isinstance(e, Generator) or
+                (isinstance(e, Alias) and isinstance(e.child, Generator))]
+        if gens:
+            if len(gens) > 1:
+                raise ValueError("only one generator per select")
+            g = gens[0]
+            out_names = []
+            if isinstance(g, Alias):
+                out_names = [g.name]
+                g = g.child
+            gen_names = list(g._out_names)
+            if not out_names:
+                out_names = gen_names
+            elif len(gen_names) == 2:  # posexplode with single alias
+                out_names = ["pos", out_names[0]]
+            base = L.Generate(g, getattr(g, "outer", False), out_names,
+                              self._lp)
+            child_names = self.columns
+            proj = []
+            for e in exprs:
+                if isinstance(e, Generator) or \
+                        (isinstance(e, Alias) and
+                         isinstance(e.child, Generator)):
+                    proj += [AttributeReference(n) for n in out_names]
+                else:
+                    proj.append(e)
+            return DataFrame(L.Project(proj, base), self.session)
         # route window expressions through a Window node, then project
         windows = [e for e in exprs if isinstance(e, WindowExpression)]
         if windows:
@@ -91,6 +121,21 @@ class DataFrame:
         return GroupedData([_to_expr(c) for c in cols], self)
 
     groupBy = group_by
+
+    def rollup(self, *cols) -> "GroupedData":
+        """GROUP BY ROLLUP — grouping sets [(k1..kn), (k1..kn-1), ..., ()]
+        via an Expand below the aggregate (ref GpuExpandExec)."""
+        return GroupedData([_to_expr(c) for c in cols], self, mode="rollup")
+
+    def cube(self, *cols) -> "GroupedData":
+        """GROUP BY CUBE — all subsets of the grouping keys."""
+        return GroupedData([_to_expr(c) for c in cols], self, mode="cube")
+
+    def sample(self, fraction: float, seed: Optional[int] = None
+               ) -> "DataFrame":
+        return DataFrame(L.Sample(fraction,
+                                  seed if seed is not None else 42,
+                                  self._lp), self.session)
 
     def agg(self, *aggs) -> "DataFrame":
         return self.group_by().agg(*aggs)
@@ -195,13 +240,65 @@ class DataFrame:
 
 
 class GroupedData:
-    def __init__(self, grouping: List[Expression], df: DataFrame):
+    def __init__(self, grouping: List[Expression], df: DataFrame,
+                 mode: str = "groupby"):
         self.grouping = grouping
         self.df = df
+        self.mode = mode
+
+    def _grouping_sets_plan(self) -> "tuple":
+        """Build the Expand feeding a rollup/cube aggregate.  Returns
+        (expand_lp, grouping_exprs, rewrite) — grouping is the nulled key
+        copies plus the synthetic spark_grouping_id (distinguishing
+        natural-null keys from keys absent in a grouping set, Spark's
+        grouping__id); `rewrite` maps aggregate inputs onto untouched
+        copies of every input column, so aggregating a grouping key sees
+        the original values (Spark keeps both copies in its Expand too)."""
+        import itertools
+        keys = self.grouping
+        if not all(isinstance(k, AttributeReference) for k in keys):
+            raise TypeError("rollup/cube keys must be plain columns")
+        names, dtypes = self.df._lp.schema()
+        idx = {n: i for i, n in enumerate(names)}
+        key_names = [k.name for k in keys]
+        nk = len(keys)
+        if self.mode == "rollup":
+            sets = [tuple(range(nk - i)) for i in range(nk + 1)]
+        else:  # cube
+            sets = []
+            for r in range(nk, -1, -1):
+                sets += list(itertools.combinations(range(nk), r))
+        orig = {n: f"__orig_{n}" for n in names}
+        projections = []
+        for s in sets:
+            gid = 0
+            proj = [AttributeReference(n) for n in names]  # agg inputs
+            for i, k in enumerate(keys):
+                if i in s:
+                    proj.append(AttributeReference(k.name))
+                else:
+                    gid |= 1 << (nk - 1 - i)
+                    proj.append(Literal(None, dtypes[idx[k.name]]))
+            proj.append(Literal(gid))
+            projections.append(proj)
+        out_names = [orig[n] for n in names] + key_names + \
+            ["spark_grouping_id"]
+        expand = L.Expand(projections, out_names, self.df._lp)
+        grouping = [AttributeReference(n) for n in key_names] + \
+            [AttributeReference("spark_grouping_id")]
+
+        def rewrite(e: Expression) -> Expression:
+            def fn(x):
+                if isinstance(x, AttributeReference) and x.name in orig:
+                    return AttributeReference(orig[x.name], x.dtype)
+                return x
+            return e.transform_up(fn)
+        return expand, grouping, rewrite
 
     def agg(self, *aggs) -> DataFrame:
         from ..expr.aggregates import AggregateExpression
         out = []
+        gid_aliases = []  # grouping_id() projections (rollup/cube only)
         for a in aggs:
             if isinstance(a, Column):
                 e = a.expr
@@ -214,6 +311,18 @@ class GroupedData:
                                                     AggregateExpression):
                 name = e.name
                 e = e.child
+            if isinstance(e, _Alias) and \
+                    isinstance(e.child, AttributeReference) and \
+                    e.child.name == "spark_grouping_id":
+                name = e.name
+                e = e.child
+            if isinstance(e, AttributeReference) and \
+                    e.name == "spark_grouping_id":
+                if self.mode not in ("rollup", "cube"):
+                    raise TypeError(
+                        "grouping_id() only valid with rollup/cube")
+                gid_aliases.append(name or "grouping_id()")
+                continue
             if isinstance(e, AggregateExpression):
                 ae = e
                 if name:
@@ -225,6 +334,19 @@ class GroupedData:
                 else:
                     raise TypeError(f"not an aggregate: {e}")
             out.append(ae)
+        if self.mode in ("rollup", "cube"):
+            from ..expr.aggregates import AggregateExpression as _AE
+            expand, grouping, rewrite = self._grouping_sets_plan()
+            out = [_AE(rewrite(ae.func), ae.name) for ae in out]
+            agg_lp = L.Aggregate(grouping, out, expand)
+            agg_names = agg_lp.schema()[0]
+            keep = [AttributeReference(n) for n in agg_names
+                    if n != "spark_grouping_id"]
+            keep += [Alias(AttributeReference("spark_grouping_id"), n)
+                     for n in gid_aliases]
+            return DataFrame(L.Project(keep, agg_lp), self.df.session)
+        if gid_aliases:
+            raise TypeError("grouping_id() only valid with rollup/cube")
         return DataFrame(L.Aggregate(self.grouping, out, self.df._lp),
                          self.df.session)
 

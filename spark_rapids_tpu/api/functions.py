@@ -243,3 +243,43 @@ def dayofmonth(c) -> Column:
 def hash(*cols) -> Column:  # noqa: A001
     from ..expr.hashfns import Murmur3Hash
     return _c(Murmur3Hash([_expr(c) for c in cols]))
+
+
+def explode(c) -> Column:
+    from ..expr.collection import Explode
+    return _c(Explode(_expr(c)))
+
+
+def explode_outer(c) -> Column:
+    from ..expr.collection import Explode
+    return _c(Explode(_expr(c), outer=True))
+
+
+def posexplode(c) -> Column:
+    from ..expr.collection import PosExplode
+    return _c(PosExplode(_expr(c)))
+
+
+def posexplode_outer(c) -> Column:
+    from ..expr.collection import PosExplode
+    return _c(PosExplode(_expr(c), outer=True))
+
+
+def size(c) -> Column:
+    from ..expr.collection import Size
+    return _c(Size(_expr(c)))
+
+
+def array_contains(c, value) -> Column:
+    from ..expr.collection import ArrayContains
+    v = value if isinstance(value, (Column, Expression)) else Literal(value)
+    return _c(ArrayContains(_expr(c), _expr(v)))
+
+
+def sort_array(c, asc: bool = True) -> Column:
+    from ..expr.collection import SortArray
+    return _c(SortArray(_expr(c), asc))
+
+
+def grouping_id() -> Column:
+    return _c(AttributeReference("spark_grouping_id"))

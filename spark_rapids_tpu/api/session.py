@@ -103,7 +103,21 @@ class TpuSession:
         self.last_plan = final_plan
         self.last_explain = overrides.last_explain
         ctx = ExecContext(self.conf)
-        return final_plan.execute_collect(ctx)
+        try:
+            return final_plan.execute_collect(ctx)
+        finally:
+            # release shuffle blocks this query registered in the global
+            # spill catalog (ref remove-shuffle on stage cleanup) — each
+            # collect re-plans, so dropping them here cannot be observed
+            from ..shuffle.manager import TpuShuffleManager
+            ids = []
+            final_plan.foreach(
+                lambda e: ids.append(e._shuffle_id)
+                if getattr(e, "_shuffle_id", None) is not None else None)
+            if ids:
+                mgr = TpuShuffleManager.get()
+                for sid in ids:
+                    mgr.unregister(sid)
 
     def explain(self, lp: L.LogicalPlan) -> str:
         physical = plan_physical(lp, self.conf)

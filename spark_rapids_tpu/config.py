@@ -190,6 +190,13 @@ REPLACE_SORT_MERGE_JOIN = conf("spark.rapids.sql.replaceSortMergeJoin.enabled").
          "(ref RapidsConf.scala:572).") \
     .create_with_default(True)
 
+AUTO_BROADCAST_JOIN_THRESHOLD = conf(
+    "spark.rapids.sql.autoBroadcastJoinThreshold").bytes() \
+    .doc("Broadcast the build side of a join when its estimated size is at "
+         "most this many bytes (mirrors spark.sql.autoBroadcastJoinThreshold; "
+         "-1 disables broadcast joins).") \
+    .create_with_default(10 * 1024 * 1024)
+
 STABLE_SORT = conf("spark.rapids.sql.stableSort.enabled").boolean() \
     .doc("Force stable sort (ref RapidsConf.scala:478).") \
     .create_with_default(False)

@@ -112,6 +112,16 @@ class Exec:
             return self.children[0].num_partitions
         return 1
 
+    # -- statistics ----------------------------------------------------------
+    def estimated_size_bytes(self) -> Optional[int]:
+        """Rough output-size estimate for planning (broadcast decisions, CBO
+        — the analog of Spark's logical-plan statistics the reference's
+        broadcast threshold consults).  None = unknown."""
+        sizes = [c.estimated_size_bytes() for c in self.children]
+        if not sizes or any(s is None for s in sizes):
+            return None
+        return sum(sizes)
+
     # -- execution -----------------------------------------------------------
     def execute_partition(self, pid: int, ctx: ExecContext) -> Iterator[Batch]:
         """Produce batches for one partition.  Buffers are jnp arrays when

@@ -56,6 +56,9 @@ class LocalScanExec(Exec):
     def num_partitions(self):
         return self._num_partitions
 
+    def estimated_size_bytes(self):
+        return self.table.nbytes
+
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         n = self.table.num_rows
         per = -(-n // self._num_partitions)
@@ -293,6 +296,56 @@ class LocalLimitExec(Exec):
 
 class GlobalLimitExec(LocalLimitExec):
     """Whole-result limit; planner ensures single partition upstream."""
+
+
+class SampleExec(Exec):
+    """Bernoulli sampling (ref GpuSampleExec in basicPhysicalOperators).
+
+    Deterministic: the keep decision hashes (seed, partition, global row
+    index) with a splitmix-style mixer, so CPU and TPU engines sample the
+    same rows — the property the differential harness relies on, the way
+    Spark ties sampling to (seed, partitionId)."""
+
+    def __init__(self, fraction: float, seed: int, child: Exec):
+        super().__init__([child])
+        assert 0.0 <= fraction <= 1.0
+        self.fraction = float(fraction)
+        self.seed = int(seed) & 0xFFFFFFFF
+
+    @property
+    def output_names(self):
+        return self.children[0].output_names
+
+    @property
+    def output_types(self):
+        return self.children[0].output_types
+
+    def describe(self):
+        return f"Sample fraction={self.fraction} seed={self.seed}"
+
+    def _keep_mask(self, xp, cap: int, row_offset: int, pid: int):
+        idx = (xp.arange(cap, dtype=np.uint32) + np.uint32(row_offset))
+        h = idx ^ np.uint32(self.seed * 0x9E3779B9 + pid * 0x85EBCA6B
+                            & 0xFFFFFFFF)
+        h = (h ^ (h >> 16)) * np.uint32(0x85EBCA6B)
+        h = (h ^ (h >> 13)) * np.uint32(0xC2B2AE35)
+        h = h ^ (h >> 16)
+        return (h & np.uint32(0xFFFFFF)).astype(np.float64) / float(1 << 24) \
+            < self.fraction
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        from .filter_common import compact
+        xp = self.xp
+        row_offset = 0
+        for b in self.children[0].execute_partition(pid, ctx):
+            with MetricTimer(self.metrics[OP_TIME]):
+                keep = self._keep_mask(xp, b.capacity, row_offset, pid)
+                live = b.row_mask()
+                out = compact(xp, b, keep & live, self.output_names)
+            row_offset += int(b.num_rows)
+            self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+            self.metrics[NUM_OUTPUT_BATCHES] += 1
+            yield out
 
 
 class CoalesceBatchesExec(Exec):

@@ -1,0 +1,112 @@
+"""Broadcast exchange + broadcast joins.
+
+Ref: execution/GpuBroadcastExchangeExec.scala (serialized host batch
+broadcast, built once and reused by every task),
+GpuBroadcastHashJoinExec (per-shim), GpuBroadcastNestedLoopJoinExec.scala.
+
+TPU realization: the build side is collected and concatenated ONCE per
+query (thread-safe, cached on the exec instance — the analog of a Spark
+broadcast variable materialized on the driver and shipped to executors),
+then every probe partition joins against the same cached device batch.
+Avoids a full shuffle of the big side: the core win of broadcast joins.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, Optional
+
+import pyarrow as pa
+
+from ..columnar.device import batch_to_device
+from .base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, Batch, Exec,
+                   ExecContext, MetricTimer)
+from .concat import concat_batches
+from .join import HashJoinExec, NestedLoopJoinExec
+
+BUILD_TIME = "buildTime"
+BROADCAST_BYTES = "dataSize"
+
+
+class BroadcastExchangeExec(Exec):
+    """Collects every child partition into one concatenated batch, computed
+    once and served to all consumers (num_partitions == 1)."""
+
+    def __init__(self, child: Exec):
+        super().__init__([child])
+        self.metrics[BUILD_TIME] = self._new_metric(BUILD_TIME)
+        self.metrics[BROADCAST_BYTES] = self._new_metric(BROADCAST_BYTES)
+        self._lock = threading.Lock()
+        self._cached: Optional[Batch] = None
+
+    @staticmethod
+    def _new_metric(name):
+        from .base import Metric
+        return Metric(name)
+
+    @property
+    def output_names(self):
+        return self.children[0].output_names
+
+    @property
+    def output_types(self):
+        return self.children[0].output_types
+
+    @property
+    def num_partitions(self):
+        return 1
+
+    def describe(self):
+        return "BroadcastExchange"
+
+    def _materialize(self, ctx: ExecContext) -> Batch:
+        with self._lock:
+            if self._cached is not None:
+                return self._cached
+            child = self.children[0]
+            xp = self.xp
+            batches = []
+            with MetricTimer(self.metrics[BUILD_TIME]):
+                for pid in range(child.num_partitions):
+                    batches += list(child.execute_partition(pid, ctx))
+                if not batches:
+                    from ..columnar.interop import to_arrow_schema
+                    schema = to_arrow_schema(child.output_names,
+                                             child.output_types)
+                    rb = pa.RecordBatch.from_pydict(
+                        {n: pa.array([], type=f.type)
+                         for n, f in zip(schema.names, schema)})
+                    batches = [batch_to_device(rb, xp=xp)]
+                out = concat_batches(xp, batches, child.output_names,
+                                     child.output_types) \
+                    if len(batches) > 1 else batches[0]
+            from ..memory.spill import batch_device_bytes
+            self.metrics[BROADCAST_BYTES] += batch_device_bytes(out)
+            self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+            self.metrics[NUM_OUTPUT_BATCHES] += 1
+            self._cached = out
+            return out
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        yield self._materialize(ctx)
+
+
+class BroadcastHashJoinExec(HashJoinExec):
+    """Equi-join whose build (right) child is a BroadcastExchangeExec
+    (ref GpuBroadcastHashJoinExec): no shuffle of the probe side; the
+    cached broadcast batch is the hash-build input for every partition."""
+
+    def describe(self):
+        ks = ", ".join(f"{a.sql()}={b.sql()}"
+                       for a, b in zip(self.left_keys, self.right_keys))
+        return f"BroadcastHashJoin {self.how} on [{ks}]"
+
+
+class BroadcastNestedLoopJoinExec(NestedLoopJoinExec):
+    """Cross/conditional join whose build side is broadcast
+    (ref GpuBroadcastNestedLoopJoinExec.scala)."""
+
+    def describe(self):
+        c = f" on {self.condition.sql()}" if self.condition is not None \
+            else ""
+        return f"BroadcastNestedLoopJoin {self.how}{c}"

@@ -1,0 +1,157 @@
+"""Expand (grouping sets) and Generate (explode) operators.
+
+Ref: GpuExpandExec.scala (multiple projections per input row, feeding
+rollup/cube aggregations) and GpuGenerateExec.scala:560 (explode /
+posexplode over array columns).
+
+Generate uses the span-gather technique (ops/gather.py): a count pass
+sizes the output (one host sync for the capacity bucket), then every
+output slot locates its source row by searchsorted over the cumulative
+per-row output counts — static shapes, both engines.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import numpy as np
+
+from .. import types as t
+from ..columnar.device import (DEFAULT_ROW_BUCKETS, DeviceBatch, DeviceColumn,
+                               bucket_for)
+from ..expr.collection import Explode, Generator, PosExplode
+from ..expr.core import (ColumnValue, EvalContext, Expression, ScalarValue,
+                         bind_expression, make_column)
+from ..ops.gather import gather_column
+from .base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, Batch, Exec,
+                   MetricTimer)
+
+
+class ExpandExec(Exec):
+    """Emit one projected batch per projection list per input batch
+    (ref GpuExpandExec)."""
+
+    def __init__(self, projections: List[List[Expression]],
+                 names: List[str], child: Exec):
+        super().__init__([child])
+        self._names = list(names)
+        self.projections = [
+            [bind_expression(e, child.output_names, child.output_types)
+             for e in proj] for proj in projections]
+        self._types = [e.data_type() for e in self.projections[0]]
+
+    @property
+    def output_names(self):
+        return self._names
+
+    @property
+    def output_types(self):
+        return self._types
+
+    def describe(self):
+        return f"Expand [{len(self.projections)} projections]"
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        xp = self.xp
+        for b in self.children[0].execute_partition(pid, ctx):
+            for proj in self.projections:
+                with MetricTimer(self.metrics[OP_TIME]):
+                    ectx = EvalContext(xp, b)
+                    cols = []
+                    for e, dt in zip(proj, self._types):
+                        v = e.eval(ectx)
+                        if isinstance(v, ScalarValue):
+                            v = make_column(
+                                ectx, dt if v.value is not None else dt,
+                                v.value if v.value is not None else 0,
+                                None if v.value is not None else False)
+                        cols.append(v.col)
+                    out = DeviceBatch(cols, b.num_rows, self._names)
+                self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+                self.metrics[NUM_OUTPUT_BATCHES] += 1
+                yield out
+
+
+class GenerateExec(Exec):
+    """explode/posexplode: child columns are repeated per array element,
+    generated columns appended (ref GpuGenerateExec)."""
+
+    def __init__(self, generator: Generator, outer: bool,
+                 out_names: List[str], child: Exec):
+        super().__init__([child])
+        self.generator = bind_expression(
+            generator, child.output_names, child.output_types)
+        self.outer = outer or getattr(generator, "outer", False)
+        gnames, gtypes = self.generator.generator_output()
+        if out_names:
+            gnames = list(out_names)
+        self._out_names = list(child.output_names) + gnames
+        self._out_types = list(child.output_types) + gtypes
+
+    @property
+    def output_names(self):
+        return self._out_names
+
+    @property
+    def output_types(self):
+        return self._out_types
+
+    def describe(self):
+        return f"Generate {self.generator.sql()} outer={self.outer}"
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        xp = self.xp
+        pos_wanted = isinstance(self.generator, PosExplode)
+        for b in self.children[0].execute_partition(pid, ctx):
+            with MetricTimer(self.metrics[OP_TIME]):
+                ectx = EvalContext(xp, b)
+                arr = self.generator.children[0].eval(ectx)
+                col = arr.col
+                child_col = col.children[0]
+                cap = b.capacity
+                live = ectx.row_mask()
+                valid = col.validity if col.validity is not None else \
+                    xp.ones((cap,), bool)
+                lens = (col.offsets[1:] - col.offsets[:-1]).astype(np.int32)
+                lens = xp.where(valid, lens, 0)
+                if self.outer:
+                    eff = xp.where(live, xp.maximum(lens, 1), 0)
+                else:
+                    eff = xp.where(live, lens, 0)
+                cum = xp.concatenate([xp.zeros((1,), np.int32),
+                                      xp.cumsum(eff, dtype=np.int32)])
+                total = int(cum[-1])
+                out_cap = bucket_for(max(total, 1), DEFAULT_ROW_BUCKETS)
+                p = xp.arange(out_cap, dtype=np.int32)
+                row = xp.clip(xp.searchsorted(cum[1:], p, side="right"),
+                              0, cap - 1).astype(np.int32)
+                in_range = p < total
+                pos = p - cum[row]
+                is_elem = in_range & (pos < lens[row])
+                elem_idx = xp.clip(col.offsets[row] + pos, 0,
+                                   max(int(child_col.capacity) - 1, 0))
+                # repeated input columns (string bytes scale with repetition)
+                from ..columnar.device import DEFAULT_CHAR_BUCKETS
+                out_cols = []
+                for c in b.columns:
+                    ccap = 0
+                    if isinstance(c.dtype, (t.StringType, t.BinaryType)):
+                        slens = (c.offsets[1:] - c.offsets[:-1]) \
+                            .astype(np.int64)
+                        need = int(xp.sum(eff.astype(np.int64) * slens))
+                        ccap = bucket_for(max(need, 1), DEFAULT_CHAR_BUCKETS)
+                    out_cols.append(
+                        gather_column(xp, c, row, in_range, ccap))
+                if pos_wanted:
+                    pos_col = DeviceColumn(
+                        t.INT,
+                        data=xp.where(is_elem, pos, 0).astype(np.int32),
+                        validity=is_elem)
+                    out_cols.append(pos_col)
+                # the element column: gather from the array's child values
+                elem = gather_column(xp, child_col, elem_idx, is_elem)
+                out_cols.append(elem)
+                out = DeviceBatch(out_cols, total, self._out_names)
+            self.metrics[NUM_OUTPUT_ROWS] += total
+            self.metrics[NUM_OUTPUT_BATCHES] += 1
+            yield out

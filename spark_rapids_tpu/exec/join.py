@@ -558,9 +558,57 @@ def plan_join(lp, left: Exec, right: Exec, conf) -> Exec:
     else:
         lkeys, rkeys, residual = split_equi_condition(
             cond, left.output_names, right.output_names)
+    from ..config import AUTO_BROADCAST_JOIN_THRESHOLD
+    threshold = conf.get(AUTO_BROADCAST_JOIN_THRESHOLD)
+    lsz = left.estimated_size_bytes()
+    rsz = right.estimated_size_bytes()
+
+    # ---- build-side selection (ref GpuShuffledHashJoinBase build side +
+    # Spark's broadcast side selection): the build side is always planned
+    # as the RIGHT child; flip when the join type forces it (right outer)
+    # or when an inner join's smaller side is on the left.
+    flipped = False
+
+    def flip():
+        nonlocal left, right, lkeys, rkeys, lsz, rsz, flipped, how
+        left, right = right, left
+        lkeys, rkeys = rkeys, lkeys
+        lsz, rsz = rsz, lsz
+        flipped = not flipped
+
+    if how == "right" and lkeys:
+        flip()
+        how = "left"
+    elif how == "inner" and lkeys and lsz is not None and rsz is not None \
+            and lsz < rsz:
+        flip()
+
     multi = left.num_partitions > 1 or right.num_partitions > 1
+
+    # ---- non-equi paths (nested loop); broadcast the build side so it is
+    # collected once, not once per probe partition
+    # (ref GpuBroadcastNestedLoopJoinExec / GpuCartesianProductExec)
+    if not lkeys:
+        from .broadcast import BroadcastExchangeExec, \
+            BroadcastNestedLoopJoinExec
+        if how == "cross" or (how == "inner" and cond is not None):
+            r = BroadcastExchangeExec(right) if multi else right
+            cls = BroadcastNestedLoopJoinExec if multi else NestedLoopJoinExec
+            return cls("cross" if how == "cross" else how, cond, left, r)
+        if how == "inner" and cond is None:
+            r = BroadcastExchangeExec(right) if multi else right
+            cls = BroadcastNestedLoopJoinExec if multi else NestedLoopJoinExec
+            return cls("cross", None, left, r)
+        raise NotImplementedError(
+            f"non-equi {how} join is not supported yet")
+
+    # ---- equi joins: broadcast-hash vs shuffled-hash
     colocated = False
-    if multi and lkeys:
+    if multi and threshold >= 0 and rsz is not None and rsz <= threshold \
+            and how in ("inner", "left", "left_semi", "left_anti", "cross"):
+        from .broadcast import BroadcastExchangeExec
+        right = BroadcastExchangeExec(right)
+    elif multi:
         # shuffled hash join: co-partition both sides on the join keys
         from ..shuffle.exchange import ShuffleExchangeExec
         from ..shuffle.partitioning import HashPartitioning
@@ -568,29 +616,6 @@ def plan_join(lp, left: Exec, right: Exec, conf) -> Exec:
         left = ShuffleExchangeExec(HashPartitioning(lkeys, n), left)
         right = ShuffleExchangeExec(HashPartitioning(rkeys, n), right)
         colocated = True
-    elif multi:
-        from .gatherpart import GatherPartitionsExec
-        if left.num_partitions > 1:
-            left = GatherPartitionsExec(left)
-        if right.num_partitions > 1:
-            right = GatherPartitionsExec(right)
-
-    if how == "cross" or (not lkeys and how == "inner" and cond is not None) \
-            or (not lkeys and cond is None and how == "cross"):
-        return NestedLoopJoinExec("cross" if how == "cross" else how,
-                                  cond, left, right)
-    if not lkeys and how == "inner" and cond is None:
-        return NestedLoopJoinExec("cross", None, left, right)
-    if not lkeys:
-        raise NotImplementedError(
-            f"non-equi {how} join is not supported yet")
-
-    flipped = False
-    if how == "right":
-        left, right = right, left
-        lkeys, rkeys = rkeys, lkeys
-        how = "left"
-        flipped = True
 
     join: Exec = CpuJoinExec(lkeys, rkeys, how, residual, left, right,
                              colocated=colocated)

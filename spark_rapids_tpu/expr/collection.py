@@ -1,0 +1,244 @@
+"""Collection / generator expressions.
+
+Ref: org/apache/spark/sql/rapids/collectionOperations.scala (Size,
+ArrayContains, SortArray, ...), GpuGenerateExec generators (GpuExplode,
+GpuPosExplode in GpuGenerateExec.scala:560).
+
+Generators (Explode/PosExplode) are evaluated by GenerateExec, not by
+`eval` — they declare their per-row output schema via `generator_output`.
+Scalar collection functions evaluate over the (offsets, child) span
+encoding of device array columns.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from .. import types as t
+from .core import (ColumnValue, EvalContext, Expression, ScalarValue,
+                   and_validity, data_of, evaluator, make_column,
+                   validity_of)
+
+
+class Generator(Expression):
+    """Base for expressions that produce multiple output rows per input row
+    (ref Spark's Generator / GpuGenerateExec)."""
+
+    def generator_output(self) -> Tuple[List[str], List[t.DataType]]:
+        raise NotImplementedError
+
+
+class Explode(Generator):
+    """explode(array) -> one row per element (ref GpuExplode)."""
+
+    def __init__(self, child: Expression, outer: bool = False):
+        self.children = (child,)
+        self.outer = outer
+        self._out_names = ["col"]
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def data_type(self):
+        dt = self.child.data_type()
+        if isinstance(dt, t.ArrayType):
+            return dt.element_type
+        raise TypeError(f"explode input must be array, got {dt.name}")
+
+    def generator_output(self):
+        return list(self._out_names), [self.data_type()]
+
+    def sql(self):
+        return f"explode({self.child.sql()})"
+
+
+class PosExplode(Generator):
+    """posexplode(array) -> (pos, col) rows (ref GpuPosExplode)."""
+
+    def __init__(self, child: Expression, outer: bool = False):
+        self.children = (child,)
+        self.outer = outer
+        self._out_names = ["pos", "col"]
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def data_type(self):
+        dt = self.child.data_type()
+        if isinstance(dt, t.ArrayType):
+            return dt.element_type
+        raise TypeError(f"posexplode input must be array, got {dt.name}")
+
+    def generator_output(self):
+        return list(self._out_names), [t.INT, self.data_type()]
+
+    def sql(self):
+        return f"posexplode({self.child.sql()})"
+
+
+# ---------------------------------------------------------------------------
+# Scalar collection functions
+# ---------------------------------------------------------------------------
+
+class Size(Expression):
+    """size(array) — Spark returns -1 for null input in legacy mode."""
+
+    def __init__(self, child: Expression, legacy_null: bool = True):
+        self.children = (child,)
+        self.legacy_null = legacy_null
+
+    def data_type(self):
+        return t.INT
+
+    @property
+    def nullable(self):
+        return not self.legacy_null
+
+    def sql(self):
+        return f"size({self.children[0].sql()})"
+
+
+@evaluator(Size)
+def _eval_size(e: Size, ctx: EvalContext):
+    v = e.children[0].eval(ctx)
+    xp = ctx.xp
+    col = v.col
+    lens = (col.offsets[1:] - col.offsets[:-1]).astype(np.int32)
+    valid = col.validity
+    if e.legacy_null:
+        data = xp.where(valid, lens, xp.full((), -1, dtype=np.int32))
+        return make_column(ctx, t.INT, data, None)
+    return make_column(ctx, t.INT, lens, valid)
+
+
+class ArrayContains(Expression):
+    def __init__(self, arr: Expression, value: Expression):
+        self.children = (arr, value)
+
+    def data_type(self):
+        return t.BOOLEAN
+
+    def sql(self):
+        return (f"array_contains({self.children[0].sql()}, "
+                f"{self.children[1].sql()})")
+
+
+@evaluator(ArrayContains)
+def _eval_array_contains(e: ArrayContains, ctx: EvalContext):
+    xp = ctx.xp
+    arr = e.children[0].eval(ctx)
+    needle = e.children[1].eval(ctx)
+    col = arr.col
+    child = col.children[0]
+    if isinstance(child.dtype, (t.StringType, t.BinaryType, t.ArrayType,
+                                t.StructType)):
+        from .core import EvalError
+        raise EvalError("array_contains over nested/string elements "
+                        "not supported")
+    cap = col.offsets.shape[0] - 1
+    child_cap = child.data.shape[0]
+    # element -> owning row
+    p = xp.arange(child_cap, dtype=np.int32)
+    row = xp.clip(xp.searchsorted(col.offsets[1:], p, side="right"),
+                  0, cap - 1).astype(np.int32)
+    in_span = p < col.offsets[-1]
+    nv = data_of(needle, ctx)
+    if isinstance(needle, ColumnValue):
+        needle_per_elem = nv[row]
+        needle_valid = needle.col.validity[row] \
+            if needle.col.validity is not None else None
+    else:
+        needle_per_elem = nv
+        needle_valid = None
+    elem_valid = child.validity if child.validity is not None else \
+        xp.ones((child_cap,), bool)
+    hit = in_span & elem_valid & \
+        (child.data.astype(np.float64) == needle_per_elem) \
+        if child.dtype in (t.FLOAT, t.DOUBLE) else \
+        in_span & elem_valid & (child.data == needle_per_elem)
+    if needle_valid is not None:
+        hit = hit & needle_valid
+    # any hit per row via segment max
+    found = xp.zeros((cap,), bool)
+    if xp is np:
+        np.maximum.at(found, row, hit)
+    else:
+        found = found.at[row].max(hit)
+    # null semantics: null array -> null; null needle -> null;
+    # no hit but array has null element -> null
+    has_null_elem = xp.zeros((cap,), bool)
+    null_elem = in_span & ~elem_valid
+    if xp is np:
+        np.maximum.at(has_null_elem, row, null_elem)
+    else:
+        has_null_elem = has_null_elem.at[row].max(null_elem)
+    valid = and_validity(ctx, validity_of(arr, ctx),
+                         validity_of(needle, ctx))
+    if valid is None:
+        valid = xp.ones((cap,), bool)
+    valid = valid & ~(~found & has_null_elem)
+    return make_column(ctx, t.BOOLEAN, found, valid)
+
+
+class SortArray(Expression):
+    def __init__(self, child: Expression, ascending: bool = True):
+        self.children = (child,)
+        self.ascending = ascending
+
+    def data_type(self):
+        return self.children[0].data_type()
+
+    def sql(self):
+        return f"sort_array({self.children[0].sql()}, {self.ascending})"
+
+
+@evaluator(SortArray)
+def _eval_sort_array(e: SortArray, ctx: EvalContext):
+    from ..columnar.device import DeviceColumn
+    xp = ctx.xp
+    v = e.children[0].eval(ctx)
+    col = v.col
+    child = col.children[0]
+    if isinstance(child.dtype, (t.StringType, t.BinaryType, t.ArrayType,
+                                t.StructType)):
+        from .core import EvalError
+        raise EvalError("sort_array over nested/string elements "
+                        "not supported")
+    cap = col.offsets.shape[0] - 1
+    child_cap = child.data.shape[0]
+    p = xp.arange(child_cap, dtype=np.int32)
+    row = xp.clip(xp.searchsorted(col.offsets[1:], p, side="right"),
+                  0, cap - 1).astype(np.int64)
+    in_span = p < col.offsets[-1]
+    elem_valid = child.validity if child.validity is not None else \
+        xp.ones((child_cap,), bool)
+    # segmented sort: key = (row, null flag (nulls first asc), value).
+    # Integer elements keep integer keys (float64 would collapse values
+    # above 2^53); descending integers flip via bitwise-not (~x = -x-1,
+    # exactly order-reversing with no int64-min overflow).
+    data = child.data
+    if xp.issubdtype(data.dtype, xp.integer) or data.dtype == bool:
+        vals = data.astype(np.int64)
+        if not e.ascending:
+            vals = ~vals
+    else:
+        vals = data.astype(np.float64) if data.dtype != np.float64 else data
+        # Spark orders NaN greater than any value
+        if not e.ascending:
+            vals = xp.where(xp.isnan(vals), -np.inf, -vals)
+        else:
+            vals = xp.where(xp.isnan(vals), np.inf, vals)
+    null_key = xp.where(elem_valid, 1, 0) if e.ascending else \
+        xp.where(elem_valid, 0, 1)
+    order = xp.lexsort((vals, null_key, xp.where(in_span, row, cap)))
+    new_data = data[order]
+    new_valid_elems = elem_valid[order]
+    new_child = DeviceColumn(child.dtype, data=new_data,
+                             validity=new_valid_elems)
+    out = DeviceColumn(col.dtype, validity=col.validity,
+                       offsets=col.offsets, children=(new_child,))
+    return ColumnValue(out)

@@ -25,8 +25,10 @@ def _expand(paths) -> List[str]:
     out: List[str] = []
     for p in paths:
         if os.path.isdir(p):
+            # recursive: partitioned writes lay out k=<v>/part-*.parquet
             for fmt_glob in ("*.parquet", "*.orc", "*.csv", "*"):
-                hits = sorted(glob.glob(os.path.join(p, fmt_glob)))
+                hits = sorted(glob.glob(os.path.join(p, "**", fmt_glob),
+                                        recursive=True))
                 hits = [h for h in hits if os.path.isfile(h)
                         and not os.path.basename(h).startswith(("_", "."))]
                 if hits:

@@ -120,6 +120,18 @@ class FileScanExec(Exec):
         return (f"FileScan {self.fmt} [{len(self.paths)} files, "
                 f"{self.reader_type}] cols={self.output_names}")
 
+    def estimated_size_bytes(self):
+        import os
+        total = 0
+        for p in self.paths:
+            try:
+                total += os.path.getsize(p)
+            except OSError:
+                return None
+        # columnar files are compressed on disk; in-memory blowup factor
+        # mirrors Spark's fileCompressionFactor default
+        return int(total * 3) if self.fmt in ("parquet", "orc") else total
+
     # -- host decode ---------------------------------------------------------
     def _read_file(self, path: str) -> pa.Table:
         cols = self.output_names
@@ -195,8 +207,9 @@ class FileScanExec(Exec):
         yield from self._emit(self._read_file(self.paths[pid]))
 
 
-def make_scan_exec(relation, conf) -> Exec:
+def make_scan_exec(relation, conf, extra_filters=None) -> Exec:
     from ..plan.logical import FileRelation
     rel: FileRelation = relation
+    filters = list(rel.pushed_filters) + list(extra_filters or [])
     return FileScanExec(rel.fmt, rel.paths, rel._names, rel._types,
-                        rel.options, conf, rel.pushed_filters)
+                        rel.options, conf, filters)

@@ -212,6 +212,16 @@ class Distinct(LogicalPlan):
         return self.children[0].schema()
 
 
+class Sample(LogicalPlan):
+    def __init__(self, fraction: float, seed: int, child: LogicalPlan):
+        self.fraction = fraction
+        self.seed = seed
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+
 class Repartition(LogicalPlan):
     def __init__(self, num_partitions: int, keys: Optional[List[Expression]],
                  child: LogicalPlan):
@@ -237,7 +247,6 @@ class Generate(LogicalPlan):
         cn, ct = self.children[0].schema()
         from ..expr.core import bind_expression
         g = bind_expression(self.generator, cn, ct)
-        elem = g.data_type()
-        if isinstance(elem, t.ArrayType):
-            elem = elem.element_type
-        return cn + self._out_names, ct + [elem]
+        gnames, gtypes = g.generator_output()
+        names = self._out_names if self._out_names else gnames
+        return cn + list(names), ct + list(gtypes)

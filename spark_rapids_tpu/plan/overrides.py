@@ -64,9 +64,11 @@ _cmp = _common
 
 expr_rule(Literal, T.all_types, "literal values")
 expr_rule(Alias, T.all_types.nested(), "named expression")
-expr_rule(AttributeReference, _common + T.ARRAY + T.STRUCT + T.MAP + T.BINARY,
+expr_rule(AttributeReference,
+          (_common + T.ARRAY + T.STRUCT + T.MAP + T.BINARY).nested(),
           "column reference")
-expr_rule(BoundReference, _common + T.ARRAY + T.STRUCT + T.MAP + T.BINARY,
+expr_rule(BoundReference,
+          (_common + T.ARRAY + T.STRUCT + T.MAP + T.BINARY).nested(),
           "bound column reference")
 for c in (ar.Add, ar.Subtract, ar.Multiply, ar.Divide, ar.IntegralDivide,
           ar.Remainder, ar.Pmod, ar.UnaryMinus, ar.UnaryPositive, ar.Abs,
@@ -113,6 +115,26 @@ expr_rule(dte.ToUnixTimestamp, T.LONG)
 expr_rule(dte.FromUnixTime, T.TIMESTAMP)
 expr_rule(dte.TimeAdd, T.TIMESTAMP)
 expr_rule(hf.Murmur3Hash, T.INT)
+
+from ..expr import collection as coll
+
+expr_rule(coll.Size, T.INT)
+expr_rule(coll.ArrayContains, T.BOOLEAN,
+          tag_fn=lambda m: m.will_not_work(
+              "array_contains over nested/string elements not supported")
+          if isinstance(m.expr.children[0].data_type().element_type,
+                        (t.StringType, t.BinaryType, t.ArrayType,
+                         t.StructType, t.MapType)) else None)
+expr_rule(coll.SortArray, T.ARRAY.nested(T.common_scalar),
+          tag_fn=lambda m: m.will_not_work(
+              "sort_array over nested/string elements not supported")
+          if isinstance(m.expr.children[0].data_type().element_type,
+                        (t.StringType, t.BinaryType, t.ArrayType,
+                         t.StructType, t.MapType)) else None)
+expr_rule(coll.Explode, (T.common_scalar + T.ARRAY + T.STRUCT).nested(),
+          "explode generator")
+expr_rule(coll.PosExplode, (T.common_scalar + T.ARRAY + T.STRUCT).nested(),
+          "posexplode generator")
 
 
 def _tag_string_literal_needle(meta: "ExprMeta"):
@@ -261,6 +283,12 @@ class ExecMeta(BaseMeta):
         from ..exec.sort import SortExec as _SE
         if isinstance(e, _SE):
             return [o[0] for o in e.orders]
+        from ..exec.expand import ExpandExec as _XE
+        from ..exec.expand import GenerateExec as _GE
+        if isinstance(e, _XE):
+            return [x for proj in e.projections for x in proj]
+        if isinstance(e, _GE):
+            return [e.generator]
         return []
 
     def tag(self):
@@ -334,6 +362,8 @@ EXEC_SIGS: Dict[Type[eb.Exec], TypeSig] = {
     CpuHashAggregateExec: (T.common_scalar).nested(),
 }
 
+from ..exec.broadcast import (BroadcastExchangeExec, BroadcastHashJoinExec,
+                              BroadcastNestedLoopJoinExec)
 from ..exec.join import CpuJoinExec, HashJoinExec, NestedLoopJoinExec
 from ..exec.sort import SortExec
 
@@ -341,15 +371,20 @@ EXEC_SIGS[SortExec] = T.common_scalar.nested()
 EXEC_SIGS[CpuJoinExec] = _exec_common
 EXEC_SIGS[NestedLoopJoinExec] = _exec_common
 EXEC_SIGS[HashJoinExec] = _exec_common
+EXEC_SIGS[BroadcastExchangeExec] = _exec_common
+EXEC_SIGS[BroadcastHashJoinExec] = _exec_common
+EXEC_SIGS[BroadcastNestedLoopJoinExec] = _exec_common
 
 EXEC_TAGS: Dict[Type[eb.Exec], Callable] = {}
 EXEC_CONVERTS: Dict[Type[eb.Exec], Callable] = {}
 
 
 def _convert_join(e: "CpuJoinExec", conf) -> eb.Exec:
-    j = HashJoinExec(e.left_keys, e.right_keys, e.how, e.condition,
-                     e.children[0], e.children[1],
-                     colocated=getattr(e, "colocated", False))
+    cls = BroadcastHashJoinExec \
+        if isinstance(e.children[1], BroadcastExchangeExec) else HashJoinExec
+    j = cls(e.left_keys, e.right_keys, e.how, e.condition,
+            e.children[0], e.children[1],
+            colocated=getattr(e, "colocated", False))
     j.placement = eb.TPU
     return j
 
@@ -418,6 +453,13 @@ EXEC_SIGS[ShuffleExchangeExec] = _exec_common
 from ..io.scan import FileScanExec  # noqa: E402
 
 EXEC_SIGS[FileScanExec] = _exec_common
+
+from ..exec.basic import SampleExec  # noqa: E402
+from ..exec.expand import ExpandExec, GenerateExec  # noqa: E402
+
+EXEC_SIGS[SampleExec] = _exec_common
+EXEC_SIGS[ExpandExec] = _exec_common
+EXEC_SIGS[GenerateExec] = _exec_common
 
 
 def _tag_file_scan(meta: "ExecMeta"):

@@ -42,9 +42,13 @@ def plan(lp: L.LogicalPlan, conf) -> eb.Exec:
         child_lp = lp.children[0]
         if isinstance(child_lp, L.FileRelation):
             # predicate pushdown for row-group pruning; the exact Filter
-            # stays above (ref parquet footer filters + GpuFilterExec)
-            child_lp.pushed_filters = child_lp.pushed_filters + \
-                [lp.condition]
+            # stays above (ref parquet footer filters + GpuFilterExec).
+            # The pushed filter lives only in this query's scan exec — the
+            # shared FileRelation node is never mutated.
+            from ..io.scan import make_scan_exec
+            scan = make_scan_exec(child_lp, conf,
+                                  extra_filters=[lp.condition])
+            return FilterExec(lp.condition, scan)
         return FilterExec(lp.condition, plan(child_lp, conf))
     if isinstance(lp, L.Aggregate):
         child = plan(lp.children[0], conf)
@@ -76,7 +80,21 @@ def plan(lp: L.LogicalPlan, conf) -> eb.Exec:
                 RangePartitioning(lp.orders, child.num_partitions), child)
         return SortExec(lp.orders, child, is_global=lp.is_global)
     if isinstance(lp, L.Limit):
-        child = plan(lp.children[0], conf)
+        child_lp = lp.children[0]
+        if isinstance(child_lp, L.Sort) and child_lp.is_global:
+            # TopN: per-partition sort+limit, then one final merge sort+limit
+            # (ref limit.scala GpuTopN / TakeOrderedAndProjectExec) — avoids
+            # the range-partition exchange a full global sort would need
+            from ..exec.gatherpart import GatherPartitionsExec
+            from ..exec.sort import SortExec
+            inner = plan(child_lp.children[0], conf)
+            local = LocalLimitExec(
+                lp.n, SortExec(child_lp.orders, inner, is_global=False))
+            merged = GatherPartitionsExec(local) \
+                if inner.num_partitions > 1 else local
+            return GlobalLimitExec(
+                lp.n, SortExec(child_lp.orders, merged, is_global=False))
+        child = plan(child_lp, conf)
         if child.num_partitions > 1:
             from ..exec.gatherpart import GatherPartitionsExec
             child = GatherPartitionsExec(LocalLimitExec(lp.n, child))
@@ -115,6 +133,9 @@ def plan(lp: L.LogicalPlan, conf) -> eb.Exec:
         from ..exec.expand import GenerateExec
         return GenerateExec(lp.generator, lp.outer, lp._out_names,
                             plan(lp.children[0], conf))
+    if isinstance(lp, L.Sample):
+        from ..exec.basic import SampleExec
+        return SampleExec(lp.fraction, lp.seed, plan(lp.children[0], conf))
     if isinstance(lp, L.Repartition):
         from ..shuffle.exchange import ShuffleExchangeExec
         from ..shuffle.partitioning import (HashPartitioning,

@@ -49,8 +49,18 @@ class ShuffleBufferCatalog:
                           if b[0] == shuffle_id and b[2] == reduce_id)
 
     def remove_shuffle(self, shuffle_id: int) -> None:
+        """Drop a shuffle's blocks AND release their spill registrations —
+        otherwise the process-global SpillCatalog grows without bound and
+        its device-budget accounting spills live buffers forever."""
         with self._lock:
             for k in [b for b in self._buffers if b[0] == shuffle_id]:
+                for sb in self._buffers[k]:
+                    close = getattr(sb, "close", None)
+                    if close is not None:
+                        try:
+                            close()
+                        except Exception:
+                            pass
                 del self._buffers[k]
 
     def num_blocks(self) -> int:

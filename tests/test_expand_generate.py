@@ -1,0 +1,184 @@
+"""Expand (rollup/cube), Generate (explode), Sample, TopN differential
+tests (model: integration_tests generate_expr_test.py /
+hash_aggregate_test.py rollup cases / limit tests)."""
+
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect)
+from spark_rapids_tpu.testing.data_gen import (
+    ArrayGen, IntegerGen, LongGen, StringGen, gen_df)
+
+
+def _arr_df(spark, elem_gen, length=128, parts=1):
+    return gen_df(spark, [("i", IntegerGen()),
+                          ("arr", ArrayGen(elem_gen, max_len=5))],
+                  length=length, seed=40, num_partitions=parts)
+
+
+@pytest.mark.parametrize("outer", [False, True])
+def test_explode_ints(outer):
+    def q(spark):
+        df = _arr_df(spark, IntegerGen(null_prob=0.1))
+        f = F.explode_outer if outer else F.explode
+        return df.select(col("i"), f(col("arr")).alias("e"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_explode_runs_on_tpu():
+    """Generate over array<int> must be TPU-placed, not a silent fallback."""
+    from spark_rapids_tpu.testing.asserts import _TPU_CONF, _mk
+    session = _mk(dict(_TPU_CONF))
+    df = _arr_df(session, IntegerGen())
+    df.select(col("i"), F.explode(col("arr")).alias("e")).collect()
+    placements = []
+    session.last_plan.foreach(
+        lambda e: placements.append(e.placement)
+        if type(e).__name__ == "GenerateExec" else None)
+    assert placements == ["tpu"], placements
+
+
+@pytest.mark.parametrize("outer", [False, True])
+def test_posexplode(outer):
+    def q(spark):
+        df = _arr_df(spark, LongGen())
+        f = F.posexplode_outer if outer else F.posexplode
+        return df.select(col("i"), f(col("arr")))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_explode_strings():
+    def q(spark):
+        df = _arr_df(spark, StringGen(max_len=6))
+        return df.select(col("i"), F.explode(col("arr")).alias("s"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_explode_then_aggregate():
+    def q(spark):
+        df = _arr_df(spark, IntegerGen(lo=0, hi=10), length=256)
+        return (df.select(F.explode(col("arr")).alias("e"))
+                  .group_by(col("e")).agg(F.count("*").alias("c")))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_size_and_contains():
+    def q(spark):
+        df = _arr_df(spark, IntegerGen(lo=0, hi=5, null_prob=0.2))
+        return df.select(col("i"), F.size(col("arr")).alias("n"),
+                         F.array_contains(col("arr"), 3).alias("has3"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+@pytest.mark.parametrize("asc", [True, False])
+def test_sort_array(asc):
+    def q(spark):
+        df = _arr_df(spark, IntegerGen(null_prob=0.15))
+        return df.select(col("i"), F.sort_array(col("arr"), asc).alias("s"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+# ---------------------------------------------------------------------------
+# rollup / cube via Expand
+# ---------------------------------------------------------------------------
+
+def _kv_df(spark, parts=1):
+    return gen_df(spark, [("a", IntegerGen(lo=0, hi=4, null_prob=0.1)),
+                          ("b", IntegerGen(lo=0, hi=3)),
+                          ("v", LongGen())],
+                  length=256, seed=41, num_partitions=parts)
+
+
+def test_rollup():
+    def q(spark):
+        return (_kv_df(spark).rollup("a", "b")
+                .agg(F.sum(col("v")).alias("sv"),
+                     F.count("*").alias("c")))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_cube():
+    def q(spark):
+        return (_kv_df(spark).cube("a", "b")
+                .agg(F.sum(col("v")).alias("sv")))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_rollup_distributed():
+    def q(spark):
+        return (_kv_df(spark, parts=3).rollup("a", "b")
+                .agg(F.count("*").alias("c"),
+                     F.min(col("v")).alias("mv")))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+# ---------------------------------------------------------------------------
+# sample / TopN
+# ---------------------------------------------------------------------------
+
+def test_sample_deterministic():
+    def q(spark):
+        df = gen_df(spark, [("x", LongGen())], length=1024, seed=42)
+        return df.sample(0.3, seed=7)
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q)
+    assert 0 < cpu.num_rows < 1024
+
+
+def test_sample_fraction_bounds():
+    def q(spark):
+        df = gen_df(spark, [("x", LongGen())], length=512, seed=43,
+                    num_partitions=2)
+        return df.sample(1.0, seed=1)
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q)
+    assert cpu.num_rows == 512
+
+
+def test_topn():
+    def q(spark):
+        df = gen_df(spark, [("k", IntegerGen()), ("v", LongGen())],
+                    length=512, seed=44, num_partitions=4)
+        return df.order_by(col("v"), ascending=False).limit(10)
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+    assert cpu.num_rows == 10
+
+
+def test_topn_no_exchange():
+    """TopN must not plan a range-partition exchange."""
+    from spark_rapids_tpu.testing.asserts import _TPU_CONF, _mk
+    session = _mk(dict(_TPU_CONF))
+    df = gen_df(session, [("v", LongGen())], length=256, seed=45,
+                num_partitions=4)
+    df.order_by(col("v")).limit(5).collect()
+    names = []
+    session.last_plan.foreach(lambda e: names.append(type(e).__name__))
+    assert "ShuffleExchangeExec" not in names, names
+
+
+def test_rollup_aggregate_over_grouping_key():
+    """Aggregating a grouping key must see original values in subtotal rows
+    (Spark keeps separate agg-input and grouping-output copies in Expand)."""
+    def q(spark):
+        import pyarrow as pa
+        df = spark.create_dataframe(pa.table(
+            {"k": pa.array([1, 2, 2]), "v": pa.array([10, 20, 30])}))
+        return df.rollup("k").agg(F.sum(col("k")).alias("sk"),
+                                  F.count("*").alias("c"))
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q)
+    rows = sorted(cpu.to_pylist(), key=str)
+    total = [r for r in rows if r["k"] is None]
+    assert total[0]["sk"] == 5, rows  # 1+2+2, not null
+
+
+def test_grouping_id():
+    def q(spark):
+        import pyarrow as pa
+        df = spark.create_dataframe(pa.table(
+            {"a": pa.array([1, 1]), "b": pa.array([2, 3]),
+             "v": pa.array([5, 6])}))
+        return df.rollup("a", "b").agg(F.sum(col("v")).alias("sv"),
+                                       F.grouping_id().alias("gid"))
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q)
+    gids = sorted({r["gid"] for r in cpu.to_pylist()})
+    assert gids == [0, 1, 3], gids

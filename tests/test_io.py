@@ -118,3 +118,37 @@ def test_csv_read(tmp_path):
     cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
     assert tpu.column("a2").to_pylist() == [2, 4, 6]
     assert tpu.column("b").to_pylist() == [2.5, 3.5, None]
+
+
+def test_partitioned_write_read_roundtrip(tmp_path):
+    """Reader must find files under k=<v>/ subdirectories (recursive)."""
+    import pyarrow as pa
+    from spark_rapids_tpu.testing.asserts import with_tpu_session
+    out = str(tmp_path / "part_out")
+
+    def w(spark):
+        df = spark.create_dataframe(pa.table(
+            {"k": pa.array([1, 1, 2]), "v": pa.array([10, 20, 30])}))
+        df.write.partition_by("k").parquet(out)
+        return spark.read.parquet(out).collect()
+    tbl = with_tpu_session(w)
+    assert tbl.num_rows == 3
+
+
+def test_filter_pushdown_does_not_leak_across_queries(tmp_path):
+    """Planning a filtered query must not mutate the shared relation."""
+    import pyarrow as pa
+    from spark_rapids_tpu.testing.asserts import with_tpu_session
+    p = str(tmp_path / "t.parquet")
+
+    def w(spark):
+        spark.create_dataframe(pa.table(
+            {"k": pa.array(range(100)), "v": pa.array(range(100))})) \
+            .write.parquet(p)
+        base = spark.read.parquet(p)
+        filtered = base.filter(col("k") > 90).collect()
+        full = base.select("k", "v").collect()
+        return filtered.num_rows, full.num_rows
+    nf, nfull = with_tpu_session(w)
+    assert nf == 9
+    assert nfull == 100

@@ -95,3 +95,91 @@ def test_join_then_aggregate():
                  .agg(F.sum(col("va")).alias("sa"),
                       F.count("*").alias("c")))
     assert_tpu_and_cpu_are_equal_collect(q)
+
+
+# ---------------------------------------------------------------------------
+# Broadcast joins (ref GpuBroadcastHashJoinExec / GpuBroadcastNestedLoopJoin)
+# ---------------------------------------------------------------------------
+
+def _plan_exec_names(df_fn, conf=None):
+    from spark_rapids_tpu.testing.asserts import _TPU_CONF, _mk
+    c = dict(conf or {})
+    c.update(_TPU_CONF)
+    session = _mk(c)
+    df_fn(session).collect()
+    names = []
+    session.last_plan.foreach(lambda e: names.append(type(e).__name__))
+    return names
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "left_semi",
+                                 "left_anti"])
+def test_broadcast_hash_join(how):
+    """Small build side over a partitioned probe side must broadcast."""
+    def q(spark):
+        a = gen_df(spark, [("k", IntegerGen(lo=0, hi=40)),
+                           ("va", LongGen())],
+                   length=512, seed=30, num_partitions=4)
+        b = gen_df(spark, [("k2", IntegerGen(lo=0, hi=40)),
+                           ("vb", LongGen())], length=64, seed=31)
+        return a.join(b, on=(col("k") == col("k2")), how=how)
+    assert_tpu_and_cpu_are_equal_collect(q)
+    names = _plan_exec_names(q)
+    assert "BroadcastHashJoinExec" in names, names
+    assert "BroadcastExchangeExec" in names, names
+    assert "ShuffleExchangeExec" not in names, names
+
+
+def test_broadcast_disabled_by_threshold():
+    """threshold=-1 must fall back to shuffled hash join."""
+    conf = {"spark.rapids.sql.autoBroadcastJoinThreshold": "-1"}
+    def q(spark):
+        a = gen_df(spark, [("k", IntegerGen(lo=0, hi=40)),
+                           ("va", LongGen())],
+                   length=512, seed=32, num_partitions=4)
+        b = gen_df(spark, [("k2", IntegerGen(lo=0, hi=40)),
+                           ("vb", LongGen())], length=64, seed=33)
+        return a.join(b, on=(col("k") == col("k2")), how="inner")
+    assert_tpu_and_cpu_are_equal_collect(q, conf=conf)
+    names = _plan_exec_names(q, conf)
+    assert "BroadcastHashJoinExec" not in names, names
+    assert "ShuffleExchangeExec" in names, names
+
+
+def test_broadcast_nested_loop_join():
+    def q(spark):
+        a = gen_df(spark, [("x", IntegerGen(lo=0, hi=100))],
+                   length=64, seed=34, num_partitions=3)
+        b = gen_df(spark, [("y", IntegerGen(lo=0, hi=100))],
+                   length=16, seed=35)
+        return a.join(b, on=(col("x") > col("y")), how="inner")
+    assert_tpu_and_cpu_are_equal_collect(q)
+    names = _plan_exec_names(q)
+    assert "BroadcastNestedLoopJoinExec" in names, names
+
+
+def test_inner_join_build_side_flip():
+    """Inner join with the smaller side on the left should flip it to the
+    build side and still produce left-first column order."""
+    def q(spark):
+        small = gen_df(spark, [("k", IntegerGen(lo=0, hi=10)),
+                               ("vs", LongGen())], length=32, seed=36)
+        big = gen_df(spark, [("k2", IntegerGen(lo=0, hi=10)),
+                             ("vb", LongGen())],
+                     length=512, seed=37, num_partitions=2)
+        return small.join(big, on=(col("k") == col("k2")), how="inner")
+    cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q)
+    assert cpu.schema.names == ["k", "vs", "k2", "vb"]
+
+
+def test_full_join_never_broadcast():
+    def q(spark):
+        a = gen_df(spark, [("k", IntegerGen(lo=0, hi=20)),
+                           ("va", LongGen())],
+                   length=256, seed=38, num_partitions=3)
+        b = gen_df(spark, [("k2", IntegerGen(lo=0, hi=20)),
+                           ("vb", LongGen())], length=32, seed=39)
+        return a.join(b, on=(col("k") == col("k2")), how="full")
+    assert_tpu_and_cpu_are_equal_collect(q)
+    names = _plan_exec_names(q)
+    assert "BroadcastHashJoinExec" not in names, names
