@@ -283,3 +283,60 @@ def sort_array(c, asc: bool = True) -> Column:
 
 def grouping_id() -> Column:
     return _c(AttributeReference("spark_grouping_id"))
+
+
+# -- UDFs --------------------------------------------------------------------
+
+def udf(f=None, returnType=None):
+    """Create a scalar Python UDF (pyspark.sql.functions.udf parity).
+
+    When `spark.rapids.sql.udfCompiler.enabled` is on, the planner tries to
+    compile the function's bytecode into the expression IR so it fuses into
+    the TPU computation (ref udf-compiler); otherwise it runs as opaque
+    Python through ArrowEvalPythonExec.
+    """
+    from .. import types as t
+    from ..udf.python_udf import PythonUDF
+
+    if isinstance(f, t.DataType):  # @udf(IntegerType()) form (pyspark parity)
+        f, returnType = None, f
+    rt = returnType or t.STRING
+
+    def wrap(fn):
+        def call(*cols) -> Column:
+            return _c(PythonUDF(fn, rt, [_expr(c) for c in cols],
+                                vectorized=False))
+        call.__name__ = getattr(fn, "__name__", "udf")
+        call.func = fn
+        call.returnType = rt
+        return call
+
+    return wrap if f is None else wrap(f)
+
+
+def pandas_udf(f=None, returnType=None):
+    """Vectorized (pandas Series -> Series) UDF
+    (ref GpuArrowEvalPythonExec pandas path)."""
+    from .. import types as t
+    from ..udf.python_udf import PythonUDF
+
+    if isinstance(f, t.DataType):  # @pandas_udf(DoubleType()) form
+        f, returnType = None, f
+    rt = returnType or t.DOUBLE
+
+    def wrap(fn):
+        def call(*cols) -> Column:
+            return _c(PythonUDF(fn, rt, [_expr(c) for c in cols],
+                                vectorized=True))
+        call.__name__ = getattr(fn, "__name__", "pandas_udf")
+        call.func = fn
+        call.returnType = rt
+        return call
+
+    return wrap if f is None else wrap(f)
+
+
+def native_udf(impl, *cols) -> Column:
+    """Apply a TpuUDF (columnar native UDF, ref RapidsUDF.java) to columns."""
+    from ..udf.native import NativeUDFExpression
+    return _c(NativeUDFExpression(impl, [_expr(c) for c in cols]))

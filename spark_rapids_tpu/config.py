@@ -313,6 +313,14 @@ UDF_COMPILER_ENABLED = conf("spark.rapids.sql.udfCompiler.enabled").boolean() \
          "analysis (ref RapidsConf.scala:520).") \
     .create_with_default(False)
 
+ARROW_MAX_RECORDS_PER_BATCH = \
+    conf("spark.rapids.sql.python.arrowMaxRecordsPerBatch").integer() \
+    .doc("Max rows handed to a Python/pandas UDF at once (ref "
+         "GpuArrowEvalPythonExec rebatching / Spark "
+         "spark.sql.execution.arrow.maxRecordsPerBatch).") \
+    .check(lambda v: v > 0, "must be positive") \
+    .create_with_default(10000)
+
 # --- optimizer ------------------------------------------------------------
 
 OPTIMIZER_ENABLED = conf("spark.rapids.sql.optimizer.enabled").boolean() \
@@ -388,6 +396,18 @@ class RapidsConf:
     @property
     def explain(self) -> str:
         return self.get(EXPLAIN)
+
+    @property
+    def ansi_enabled(self) -> bool:
+        return self.get(ANSI_ENABLED)
+
+    @property
+    def arrow_max_records_per_batch(self) -> int:
+        return self.get(ARROW_MAX_RECORDS_PER_BATCH)
+
+    @property
+    def udf_compiler_enabled(self) -> bool:
+        return self.get(UDF_COMPILER_ENABLED)
 
     @property
     def capacity_buckets(self) -> List[int]:

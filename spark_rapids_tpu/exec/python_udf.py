@@ -1,0 +1,88 @@
+"""ArrowEvalPythonExec: evaluate opaque Python UDFs over columnar batches.
+
+Analog of the reference's GpuArrowEvalPythonExec
+(ref: sql-plugin/.../execution/python/GpuArrowEvalPythonExec.scala:58-260),
+which streams Arrow batches to out-of-process Python workers and pairs the
+results back with the inputs (BatchQueue, RebatchingRoundoffIterator).
+
+Our executor processes are already Python, so the exchange is in-process:
+the child's batches are brought to the host (the rewrite engine places
+this exec on CPU and inserts a DeviceToHost transition), each UDF is
+evaluated through its host evaluator, and the UDF outputs are appended as
+new columns after the child's output — the downstream Project refers to
+them by name.  Rebatching to the UDF target size is preserved: oversize
+batches are split so Python never sees more than `arrow_max_records_per_batch`
+rows at once (ref RebatchingRoundoffIterator's size goal).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+from .. import types as t
+from ..columnar.device import DeviceBatch
+from ..expr.core import (ColumnValue, EvalContext, Expression, ScalarValue,
+                         bind_expression, scalar_to_column)
+from ..udf.python_udf import PythonUDF
+from .base import (CPU, NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, Batch,
+                   Exec, ExecContext, MetricTimer)
+
+
+class ArrowEvalPythonExec(Exec):
+    """Appends one output column per UDF to the child's columns."""
+
+    placement = CPU
+
+    def __init__(self, udfs: Sequence[Tuple[str, PythonUDF]], child: Exec):
+        super().__init__([child])
+        self.udf_names = [n for n, _ in udfs]
+        self.udfs = [u for _, u in udfs]
+        self._bound = [bind_expression(u, child.output_names,
+                                       child.output_types)
+                       for u in self.udfs]
+
+    @property
+    def output_names(self):
+        return list(self.children[0].output_names) + self.udf_names
+
+    @property
+    def output_types(self):
+        return list(self.children[0].output_types) + \
+            [u.data_type() for u in self._bound]
+
+    def describe(self):
+        return f"ArrowEvalPython [{', '.join(self.udf_names)}]"
+
+    def _split(self, b: Batch, limit: int) -> Iterator[Batch]:
+        n = int(b.num_rows)
+        if n <= limit:
+            yield b
+            return
+        # slice the host batch into UDF-sized windows
+        from ..columnar.device import batch_to_arrow, batch_to_device
+        import pyarrow as pa
+        rb = batch_to_arrow(DeviceBatch(b.columns, n,
+                                        self.children[0].output_names))
+        tbl = pa.Table.from_batches([rb])
+        for off in range(0, n, limit):
+            piece = tbl.slice(off, min(limit, n - off)).combine_chunks()
+            yield batch_to_device(piece.to_batches()[0], xp=np)
+
+    def execute_partition(self, pid, ctx: ExecContext) -> Iterator[Batch]:
+        limit = ctx.conf.arrow_max_records_per_batch
+        for big in self.children[0].execute_partition(pid, ctx):
+            for b in self._split(big, limit):
+                with MetricTimer(self.metrics[OP_TIME]):
+                    ectx = EvalContext(np, b, ansi=ctx.conf.ansi_enabled)
+                    cols = list(b.columns)
+                    for u in self._bound:
+                        v = u.eval(ectx)
+                        if isinstance(v, ScalarValue):
+                            v = scalar_to_column(ectx, v)
+                        cols.append(v.col)
+                    out = DeviceBatch(cols, b.num_rows, self.output_names)
+                self.metrics[NUM_OUTPUT_ROWS] += int(b.num_rows)
+                self.metrics[NUM_OUTPUT_BATCHES] += 1
+                yield out

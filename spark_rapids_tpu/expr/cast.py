@@ -101,6 +101,10 @@ def _eval_cast(e: Cast, ctx: EvalContext):
 
     xp = ctx.xp
     d = data_of(v, ctx)
+    if not hasattr(d, "astype"):
+        # scalar input (e.g. a cast wrapped around a literal): promote to a
+        # 0-d array so the array cast paths below apply uniformly
+        d = xp.asarray(d, dtype=t.to_np_dtype(src))
 
     # ---- temporal ----------------------------------------------------------
     if isinstance(src, t.DateType) and isinstance(dst, t.TimestampType):

@@ -368,6 +368,30 @@ def make_column(ctx: EvalContext, dtype: t.DataType, data, validity) -> ColumnVa
     return ColumnValue(DeviceColumn(dtype, data=data, validity=validity))
 
 
+def scalar_to_column(ctx: EvalContext, sv: "ScalarValue") -> ColumnValue:
+    """Materialize a scalar as a full column (incl. string/null scalars,
+    which make_column cannot broadcast)."""
+    dtype = sv.dtype
+    if sv.value is None or isinstance(dtype, t.NullType):
+        return all_null_column(ctx, dtype)
+    if isinstance(dtype, (t.StringType, t.BinaryType)):
+        xp = ctx.xp
+        b = sv.value if isinstance(sv.value, bytes) else \
+            str(sv.value).encode("utf-8")
+        cap = ctx.capacity
+        if b:
+            unit = np.frombuffer(b, dtype=np.uint8)
+            data = xp.asarray(np.tile(unit, max(cap, 1)))
+        else:
+            data = xp.zeros((1,), dtype=np.uint8)
+        offsets = (xp.arange(cap + 1, dtype=np.int32) *
+                   np.int32(len(b)))
+        validity = xp.ones((cap,), dtype=bool)
+        return ColumnValue(DeviceColumn(dtype, data=data, offsets=offsets,
+                                        validity=validity))
+    return make_column(ctx, dtype, sv.value, None)
+
+
 def all_null_column(ctx: EvalContext, dtype: t.DataType) -> ColumnValue:
     xp = ctx.xp
     if isinstance(dtype, (t.StringType, t.BinaryType)):

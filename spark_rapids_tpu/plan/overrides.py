@@ -175,6 +175,15 @@ for c in (agg.StddevPop, agg.StddevSamp, agg.VariancePop, agg.VarianceSamp):
     expr_rule(c, _num - T.DECIMAL_128)
 expr_rule(agg.AggregateExpression, T.all_types)
 
+# columnar native UDFs trace straight into the operator's XLA computation
+# (ref GpuUserDefinedFunction + RapidsUDF.evaluateColumnar)
+from ..udf.native import NativeUDFExpression
+
+expr_rule(NativeUDFExpression, T.common_scalar + T.BINARY,
+          "user-supplied columnar UDF")
+# opaque PythonUDF has no rule: it keeps its operator on the CPU unless the
+# planner extracted it into ArrowEvalPythonExec (ref GpuOverrides fallback)
+
 
 # ---------------------------------------------------------------------------
 # Meta hierarchy (ref RapidsMeta.scala)

@@ -20,6 +20,69 @@ from ..expr.core import AttributeReference, Expression
 from . import logical as L
 
 
+def _rewrite_python_udfs(exprs: List[Expression], conf,
+                         schema=None):
+    """Compile-or-extract PythonUDF calls (ref udf-compiler
+    LogicalPlanRules.scala:29 for the compile attempt; GpuArrowEvalPythonExec
+    extraction for the opaque remainder)."""
+    from ..udf.python_udf import PythonUDF
+    udfs: List = []
+    types_by_name = dict(zip(*schema)) if schema else {}
+
+    def typed(e: Expression) -> Expression:
+        """Resolve attr dtypes so the compiled tree type-checks."""
+        def fn(x):
+            if isinstance(x, AttributeReference) and x.dtype is None and \
+                    x.name in types_by_name:
+                return AttributeReference(x.name, types_by_name[x.name])
+            return x
+        return e.transform_up(fn)
+
+    def walk(e: Expression) -> Expression:
+        if isinstance(e, PythonUDF):
+            if conf.udf_compiler_enabled and not e.vectorized:
+                from ..udf.compiler import try_compile_udf
+                compiled = try_compile_udf(e.fn, [typed(c)
+                                                  for c in e.children])
+                if compiled is not None:
+                    # keep the declared return type stable across the
+                    # compiled/opaque paths (schema must not depend on the
+                    # compiler flag)
+                    if compiled.data_type() != e.return_type:
+                        from ..expr.cast import Cast
+                        compiled = Cast(compiled, e.return_type)
+                    # the compiled tree may still hold nested opaque UDFs
+                    # in its leaves — extract those normally
+                    return walk_children(compiled)
+            # extract the whole subtree; nested UDFs inside evaluate
+            # recursively during host evaluation, so children stay intact
+            for n, u in udfs:
+                if u is e:
+                    return AttributeReference(n)
+            name = f"pythonUDF{len(udfs)}"
+            udfs.append((name, e))
+            return AttributeReference(name)
+        return walk_children(e)
+
+    def walk_children(e: Expression) -> Expression:
+        if not e.children:
+            return e
+        return e.with_children([walk(c) for c in e.children])
+
+    return [walk(e) for e in exprs], udfs
+
+
+def _plan_with_udfs(exprs: List[Expression], child_lp: L.LogicalPlan, conf):
+    """Plan `child_lp` and, if any expr holds an opaque PythonUDF, interpose
+    ArrowEvalPythonExec producing the UDF outputs as extra columns."""
+    new_exprs, udfs = _rewrite_python_udfs(exprs, conf, child_lp.schema())
+    child = plan(child_lp, conf)
+    if udfs:
+        from ..exec.python_udf import ArrowEvalPythonExec
+        child = ArrowEvalPythonExec(udfs, child)
+    return new_exprs, udfs, child
+
+
 def plan(lp: L.LogicalPlan, conf) -> eb.Exec:
     if isinstance(lp, L.LocalRelation):
         return LocalScanExec(lp.table, lp.num_partitions)
@@ -37,7 +100,8 @@ def plan(lp: L.LogicalPlan, conf) -> eb.Exec:
             scan = make_scan_exec(child_lp, conf)
             scan.required_columns = [e.name for e in lp.exprs]
             return scan
-        return ProjectExec(lp.exprs, plan(child_lp, conf))
+        exprs, _udfs, child = _plan_with_udfs(lp.exprs, child_lp, conf)
+        return ProjectExec(exprs, child)
     if isinstance(lp, L.Filter):
         child_lp = lp.children[0]
         if isinstance(child_lp, L.FileRelation):
@@ -49,7 +113,14 @@ def plan(lp: L.LogicalPlan, conf) -> eb.Exec:
             scan = make_scan_exec(child_lp, conf,
                                   extra_filters=[lp.condition])
             return FilterExec(lp.condition, scan)
-        return FilterExec(lp.condition, plan(child_lp, conf))
+        conds, udfs, child = _plan_with_udfs([lp.condition], child_lp, conf)
+        if udfs:
+            # UDF outputs were appended below; filter on them, then project
+            # the original columns back out
+            names, _ = lp.children[0].schema()
+            keep = [AttributeReference(n) for n in names]
+            return ProjectExec(keep, FilterExec(conds[0], child))
+        return FilterExec(conds[0], child)
     if isinstance(lp, L.Aggregate):
         child = plan(lp.children[0], conf)
         if child.num_partitions > 1:

@@ -1,0 +1,14 @@
+"""UDF layer (= reference L6).
+
+- `compiler`: Python-bytecode -> expression IR translation
+  (ref udf-compiler/).
+- `native`: columnar TpuUDF interface (ref RapidsUDF.java).
+- `python_udf`: opaque Python/pandas UDF expression + host evaluation
+  (ref sql-plugin execution/python/).
+- `examples`: cosine_similarity / string_word_count parity examples
+  (ref udf-examples/).
+"""
+
+from .compiler import UdfCompileError, compile_udf, try_compile_udf
+from .native import NativeUDFExpression, TpuUDF
+from .python_udf import PythonUDF

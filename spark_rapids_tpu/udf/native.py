@@ -1,0 +1,77 @@
+"""Native (columnar) UDF interface — the RapidsUDF analog.
+
+The reference lets users supply a *columnar* UDF implementation
+(`sql-plugin/src/main/java/com/nvidia/spark/RapidsUDF.java`:
+`evaluateColumnar(ColumnVector... args)`) that runs native CUDA code and
+skips row-by-row evaluation entirely.  The TPU-native equivalent: the user
+implements `evaluate_columnar(xp, n_rows, *cols)` over our DeviceColumn
+layout using `xp` (jax.numpy on TPU, numpy on the CPU fallback engine) or
+a Pallas kernel — the function traces into the enclosing operator's XLA
+computation, so it fuses with the surrounding expressions (better than the
+reference, where a native UDF is still a separate kernel launch).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .. import types as t
+from ..columnar.device import DeviceColumn
+from ..expr.core import (ColumnValue, EvalContext, Expression, ScalarValue,
+                         evaluator, make_column, scalar_to_column)
+
+
+class TpuUDF:
+    """User-facing columnar UDF base (ref RapidsUDF.java).
+
+    Subclass and implement `evaluate_columnar`.  Inputs arrive as
+    DeviceColumns (fixed capacity, validity masks); return a DeviceColumn
+    of the same capacity, or an (data, validity) tuple.
+    """
+
+    #: result type; override or pass to constructor
+    return_type: t.DataType = t.DOUBLE
+
+    def __init__(self, return_type: t.DataType = None):
+        if return_type is not None:
+            self.return_type = return_type
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def evaluate_columnar(self, xp, n_rows, *cols: DeviceColumn):
+        raise NotImplementedError
+
+
+class NativeUDFExpression(Expression):
+    """Expression node wrapping a TpuUDF (ref GpuUserDefinedFunction.scala
+    branch that dispatches to RapidsUDF.evaluateColumnar)."""
+
+    def __init__(self, udf: TpuUDF, children: Sequence[Expression]):
+        self.udf = udf
+        self.children = tuple(children)
+
+    def data_type(self):
+        return self.udf.return_type
+
+    @property
+    def pretty_name(self):
+        return self.udf.name
+
+
+@evaluator(NativeUDFExpression)
+def _eval_native_udf(e: NativeUDFExpression, ctx: EvalContext):
+    cols = []
+    for c in e.children:
+        v = c.eval(ctx)
+        if isinstance(v, ScalarValue):
+            v = scalar_to_column(ctx, v)
+        cols.append(v.col)
+    out = e.udf.evaluate_columnar(ctx.xp, ctx.batch.num_rows, *cols)
+    if isinstance(out, tuple):
+        data, validity = out
+        return make_column(ctx, e.udf.return_type, data, validity)
+    if isinstance(out, DeviceColumn):
+        return ColumnValue(out)
+    return make_column(ctx, e.udf.return_type, out, None)

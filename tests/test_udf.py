@@ -1,0 +1,273 @@
+"""UDF layer tests: bytecode compiler, opaque Python/pandas UDFs through
+ArrowEvalPythonExec, native columnar UDFs.
+
+Mirrors the reference's udf-compiler OpcodeSuite (bytecode translation
+cases) and integration_tests udf_test.py (pandas UDF round trips).
+"""
+
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col
+from spark_rapids_tpu.expr.core import AttributeReference as A
+from spark_rapids_tpu.udf.compiler import (UdfCompileError, compile_udf,
+                                           try_compile_udf)
+
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect as assert_tpu_and_cpu_are_equal)
+
+
+# ---------------------------------------------------------------------------
+# bytecode compiler unit tests (ref OpcodeSuite)
+# ---------------------------------------------------------------------------
+
+def _args(*dtypes):
+    return [A(f"c{i}", dt) for i, dt in enumerate(dtypes)]
+
+
+def _run_compiled(fn, dtypes, rows):
+    """Compile fn, evaluate the expression on a batch, compare to Python."""
+    from spark_rapids_tpu.columnar.device import batch_to_device
+    from spark_rapids_tpu.expr.core import (ColumnValue, EvalContext,
+                                            bind_expression)
+    args = _args(*dtypes)
+    expr = compile_udf(fn, args)
+    names = [a.name for a in args]
+    table = pa.table({n: pa.array(col_vals)
+                      for n, col_vals in zip(names, zip(*rows))})
+    from spark_rapids_tpu.columnar.interop import from_arrow_type
+    dts = [from_arrow_type(f.type) for f in table.schema]
+    bound = bind_expression(expr, names, dts)
+    rb = table.combine_chunks().to_batches()[0]
+    batch = batch_to_device(rb, xp=np)
+    ctx = EvalContext(np, batch)
+    v = bound.eval(ctx)
+    assert isinstance(v, ColumnValue)
+    from spark_rapids_tpu.columnar.device import column_to_arrow
+    got = column_to_arrow(v.col, len(rows)).to_pylist()
+    want = [fn(*r) for r in rows]
+    for g, w in zip(got, want):
+        if isinstance(w, float):
+            assert g == pytest.approx(w, rel=1e-12)
+        else:
+            assert g == w
+    return expr
+
+
+def test_compile_arithmetic():
+    _run_compiled(lambda x: x + 1, [t.LONG], [(1,), (-5,), (100,)])
+    _run_compiled(lambda x, y: (x - y) * 2, [t.LONG, t.LONG],
+                  [(3, 1), (10, 20)])
+    _run_compiled(lambda x: x / 4, [t.LONG], [(8,), (10,)])
+    _run_compiled(lambda x: x % 3, [t.LONG], [(7,), (9,)])
+    _run_compiled(lambda x: x ** 2, [t.LONG], [(3,), (5,)])
+
+
+def test_compile_conditional():
+    _run_compiled(lambda x: x if x > 0 else -x, [t.LONG],
+                  [(5,), (-7,), (0,)])
+
+    def grade(v):
+        if v >= 90:
+            return "A"
+        if v >= 80:
+            return "B"
+        return "C"
+    _run_compiled(grade, [t.LONG], [(95,), (85,), (40,)])
+
+
+def test_compile_boolean_ops():
+    _run_compiled(lambda x: x > 3 and x < 10, [t.LONG],
+                  [(5,), (2,), (15,)])
+    _run_compiled(lambda x: x < 0 or x > 100, [t.LONG],
+                  [(-1,), (50,), (200,)])
+    _run_compiled(lambda x: not (x == 3), [t.LONG], [(3,), (4,)])
+
+
+def test_compile_math_calls():
+    _run_compiled(lambda x: math.sqrt(x) + math.log(x), [t.DOUBLE],
+                  [(1.0,), (4.0,), (10.0,)])
+    _run_compiled(lambda x: abs(x) + max(x, 3), [t.LONG],
+                  [(-5,), (7,)])
+    _run_compiled(lambda x: math.floor(x) + math.ceil(x), [t.DOUBLE],
+                  [(1.5,), (-2.5,)])
+
+
+def test_compile_string_methods():
+    _run_compiled(lambda s: s.upper(), [t.STRING], [("abc",), ("X",)])
+    _run_compiled(lambda s: s.strip() + "!", [t.STRING],
+                  [("  hi  ",), ("a",)])
+    _run_compiled(lambda s: s.startswith("ab"), [t.STRING],
+                  [("abc",), ("xyz",)])
+    _run_compiled(lambda s: len(s), [t.STRING], [("abc",), ("",)])
+    _run_compiled(lambda s: s.replace("a", "b"), [t.STRING],
+                  [("banana",), ("ccc",)])
+
+
+def test_compile_closure_constant():
+    k = 10
+
+    def f(x):
+        return x + k
+    _run_compiled(f, [t.LONG], [(1,), (2,)])
+
+
+def test_compile_rejects_loops():
+    def f(x):
+        s = 0
+        for i in range(3):
+            s = s + x
+        return s
+    with pytest.raises(UdfCompileError):
+        compile_udf(f, _args(t.LONG))
+    assert try_compile_udf(f, _args(t.LONG)) is None
+
+
+def test_compile_rejects_unknown_calls():
+    import os
+
+    def f(x):
+        return os.getpid() + x
+    with pytest.raises(UdfCompileError):
+        compile_udf(f, _args(t.LONG))
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the engine (ref integration_tests/udf_test.py)
+# ---------------------------------------------------------------------------
+
+def _table():
+    rng = np.random.default_rng(7)
+    n = 500
+    return pa.table({
+        "a": pa.array(rng.integers(-100, 100, n), type=pa.int64()),
+        "b": pa.array(rng.random(n)),
+        "s": pa.array([f"w{i % 17} x{i % 5}" for i in range(n)]),
+    })
+
+
+def test_scalar_udf_fallback_collect():
+    plus_one = F.udf(lambda x: x + 1, returnType=t.LONG)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(_table())
+                   .select(plus_one(col("a")).alias("r")))
+
+
+def test_scalar_udf_in_filter():
+    is_pos = F.udf(lambda x: x > 0, returnType=t.BOOLEAN)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(_table())
+                   .filter(is_pos(col("a")))
+                   .select(col("a")))
+
+
+def test_pandas_udf():
+    doubled = F.pandas_udf(lambda x: x * 2.0, returnType=t.DOUBLE)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(_table())
+                   .select(doubled(col("b")).alias("r")))
+
+
+def test_udf_compiler_fuses_on_tpu():
+    """With the compiler on, a compilable UDF must become IR (TPU plan),
+    not an ArrowEvalPythonExec (ref assert_gpu_fallback_collect inverse)."""
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession.builder() \
+        .config("spark.rapids.sql.udfCompiler.enabled", True).get_or_create()
+    f = F.udf(lambda x: x * 3 + 1, returnType=t.LONG)
+    df = s.create_dataframe(_table()).select(f(col("a")).alias("r"))
+    plan_str = df.explain()
+    assert "ArrowEvalPython" not in plan_str
+    got = df.collect()
+    want = [int(x) * 3 + 1 for x in _table()["a"].to_pylist()]
+    assert got["r"].to_pylist() == want
+
+
+def test_udf_compiled_matches_uncompiled():
+    fn = lambda x: x * 2 if x > 0 else -x  # noqa: E731
+    from spark_rapids_tpu.api.session import TpuSession
+    out = []
+    for enabled in (True, False):
+        s = TpuSession.builder() \
+            .config("spark.rapids.sql.udfCompiler.enabled", enabled) \
+            .get_or_create()
+        f = F.udf(fn, returnType=t.LONG)
+        df = s.create_dataframe(_table()).select(f(col("a")).alias("r"))
+        out.append(df.collect())
+    assert out[0].equals(out[1])
+
+
+# ---------------------------------------------------------------------------
+# native columnar UDFs (ref udf-examples)
+# ---------------------------------------------------------------------------
+
+def test_native_udf_word_count():
+    from spark_rapids_tpu.udf.examples import StringWordCount
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(_table())
+                   .select(F.native_udf(StringWordCount(), col("s"))
+                           .alias("wc")))
+
+
+def test_native_udf_word_count_values():
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.udf.examples import StringWordCount
+    s = TpuSession.builder().get_or_create()
+    tbl = pa.table({"s": pa.array(["one two three", "", "  padded  ",
+                                   None, "single"])})
+    df = s.create_dataframe(tbl).select(
+        F.native_udf(StringWordCount(), col("s")).alias("wc"))
+    assert df.collect()["wc"].to_pylist() == [3, 0, 1, None, 1]
+
+
+def test_native_udf_cosine_similarity():
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.udf.examples import CosineSimilarity
+    s = TpuSession.builder().get_or_create()
+    tbl = pa.table({"x": pa.array([1.0, 0.5, -2.0]),
+                    "y": pa.array([2.0, 0.5, 4.0])})
+    df = s.create_dataframe(tbl).select(
+        F.native_udf(CosineSimilarity(), col("x"), col("y")).alias("sim"))
+    got = df.collect()["sim"].to_pylist()
+    # 1-wide vectors: sim is sign(x*y)
+    assert got == pytest.approx([1.0, 1.0, -1.0])
+
+
+# ---------------------------------------------------------------------------
+# review regressions
+# ---------------------------------------------------------------------------
+
+def test_udf_return_type_stable_across_compiler_flag():
+    """Declared returnType must hold whether or not the compiler fires."""
+    from spark_rapids_tpu.api.session import TpuSession
+    schemas = []
+    for enabled in (True, False):
+        s = TpuSession.builder() \
+            .config("spark.rapids.sql.udfCompiler.enabled", enabled) \
+            .get_or_create()
+        f = F.udf(lambda x: x + 1, returnType=t.INT)
+        out = s.create_dataframe(_table()).select(
+            f(col("a")).alias("r")).collect()
+        schemas.append(out.schema.field("r").type)
+    assert schemas[0] == schemas[1] == pa.int32()
+
+
+def test_udf_string_literal_arg():
+    join = F.udf(lambda a, sep: sep + a, returnType=t.STRING)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(_table())
+                   .select(join(col("s"), F.lit("-")).alias("r")))
+
+
+def test_udf_decorator_with_positional_return_type():
+    @F.udf(t.LONG)
+    def plus2(x):
+        return x + 2
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(_table())
+                   .select(plus2(col("a")).alias("r")))
