@@ -1,0 +1,225 @@
+"""SPMD distributed query steps over a device mesh.
+
+The multi-chip execution mode: instead of the host-orchestrated
+partition-iterator shuffle (shuffle/manager.py — the analog of the
+reference's always-available Spark-shuffle path), a whole query stage
+compiles into ONE `shard_map`-ped XLA program per schema: every device
+runs the identical operator pipeline on its shard and rows move over ICI
+with `all_to_all` (parallel/alltoall.py).  This is the structural
+equivalent of the reference's accelerated UCX shuffle stage
+(ref: RapidsShuffleInternalManagerBase.scala:74 caching writer keeping
+batches on-device; shuffle-plugin/.../UCXShuffleTransport.scala), with
+the XLA compiler playing the role of the transport state machines.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import pyarrow as pa
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .. import types as t
+from ..columnar.device import DeviceBatch, batch_to_arrow, batch_to_device, bucket_for
+from ..expr.core import EvalContext
+from ..shuffle.partitioning import HashPartitioning
+from .alltoall import allgather_batch, exchange_by_pid, exchange_supported
+from .mesh import DATA_AXIS, build_mesh
+
+
+class _SchemaSource:
+    """Placeholder child carrying only an output schema, so exec nodes can
+    be built against shard inputs that exist only inside shard_map."""
+
+    num_partitions = 1
+
+    def __init__(self, names: Sequence[str], dtypes: Sequence[t.DataType]):
+        self.output_names = list(names)
+        self.output_types = list(dtypes)
+        self.children = []
+
+    def execute_partition(self, pid, ctx):  # pragma: no cover
+        raise RuntimeError("schema-only node is never executed")
+
+
+def stack_shards(tables: Sequence[pa.Table], capacity: Optional[int] = None):
+    """Upload one Arrow table per device and stack them on a leading
+    device axis (the host->mesh transfer; each shard then lives on its
+    device under `jax.device_put` with a row sharding)."""
+    n_rows = max(max((tb.num_rows for tb in tables), default=1), 1)
+    cap = capacity or bucket_for(n_rows, (1024, 8192, 65536, 262144, 1048576))
+    batches = []
+    for tb in tables:
+        rbs = tb.combine_chunks().to_batches()
+        rb = rbs[0] if rbs else pa.RecordBatch.from_pydict(
+            {f.name: pa.array([], type=f.type) for f in tb.schema},
+            schema=tb.schema)
+        batches.append(batch_to_device(rb, capacity=cap))
+    # equalize char capacities across shards so stacking is legal
+    batches = _equalize_char_caps(batches)
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0),
+                                     *batches)
+    return stacked
+
+
+def _equalize_char_caps(batches: List[DeviceBatch]) -> List[DeviceBatch]:
+    from ..columnar.device import DeviceColumn
+    if not batches:
+        return batches
+    ncol = batches[0].num_cols
+    out = [list(b.columns) for b in batches]
+    for ci in range(ncol):
+        cols = [b.columns[ci] for b in batches]
+        if not isinstance(cols[0].dtype, (t.StringType, t.BinaryType)):
+            continue
+        char_cap = max(int(c.data.shape[0]) for c in cols)
+        for bi, c in enumerate(cols):
+            cur = int(c.data.shape[0])
+            if cur < char_cap:
+                data = jnp.concatenate(
+                    [c.data, jnp.zeros((char_cap - cur,), jnp.uint8)])
+                out[bi][ci] = DeviceColumn(c.dtype, data=data,
+                                           validity=c.validity,
+                                           offsets=c.offsets)
+    return [DeviceBatch(cols, b.num_rows, b.names)
+            for cols, b in zip(out, batches)]
+
+
+def unstack_shards(stacked: DeviceBatch) -> List[DeviceBatch]:
+    n_dev = int(jax.tree_util.tree_leaves(stacked)[0].shape[0])
+    return [jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
+            for i in range(n_dev)]
+
+
+def shards_to_table(stacked: DeviceBatch) -> pa.Table:
+    tables = [pa.Table.from_batches([batch_to_arrow(b)])
+              for b in unstack_shards(stacked)]
+    return pa.concat_tables(tables)
+
+
+class DistributedAggregate:
+    """Distributed GROUP BY: local partial agg -> ICI all_to_all on key
+    hash -> local final agg.  Compiles to one XLA program; every stage
+    stays on device (the reference's partial/exchange/final pipeline,
+    aggregate.scala:258-275 + GpuShuffleExchangeExec, fused end-to-end)."""
+
+    def __init__(self, grouping, aggregates, in_names, in_types,
+                 mesh: Optional[Mesh] = None, axis: str = DATA_AXIS):
+        from ..exec.aggregate import TpuHashAggregateExec
+        from ..expr.aggregates import FINAL, PARTIAL
+        self.mesh = mesh or build_mesh()
+        self.axis = axis
+        self.n_dev = self.mesh.shape[axis]
+        src = _SchemaSource(in_names, in_types)
+        self.partial = TpuHashAggregateExec(list(grouping), list(aggregates),
+                                            PARTIAL, src)
+        self.final = TpuHashAggregateExec(list(grouping),
+                                          self.partial.aggregates, FINAL,
+                                          self.partial)
+        reason = exchange_supported(self.partial.output_types)
+        if reason:
+            raise NotImplementedError(reason)
+        k = len(list(grouping))
+        # route on the SAME Spark-compatible murmur3+pmod rule the host
+        # shuffle uses (shuffle/partitioning.py), so both paths agree on
+        # key placement
+        self._routing = HashPartitioning(
+            [_attr(n, dt) for n, dt in zip(self.partial.output_names[:k],
+                                           self.partial.output_types[:k])],
+            self.n_dev).bind(self.partial.output_names,
+                             self.partial.output_types)
+
+    @property
+    def output_names(self):
+        return self.final.output_names
+
+    @property
+    def output_types(self):
+        return self.final.output_types
+
+    def _step(self, shard: DeviceBatch) -> DeviceBatch:
+        # leading device axis arrives stripped of sharding but kept as a
+        # size-1 axis; drop it
+        b = jax.tree_util.tree_map(lambda x: x[0], shard)
+        part = self.partial._update_batch(jnp, b)
+        if self.partial.grouping:
+            ctx = EvalContext(jnp, part)
+            pids = self._routing.partition_ids(jnp, ctx, part)
+            routed = exchange_by_pid(part, pids, self.n_dev, self.axis)
+        else:
+            # global aggregate: replicate partials, every device computes
+            # the same final row (cheap; buffers are one row each)
+            routed = allgather_batch(part, self.axis, self.n_dev)
+        merged = self.final._merge_batch(jnp, routed)
+        out = self.final._evaluate_batch(jnp, merged)
+        return jax.tree_util.tree_map(lambda x: x[None], out)
+
+    @functools.cached_property
+    def _compiled(self):
+        fn = shard_map(self._step, mesh=self.mesh,
+                       in_specs=P(self.axis), out_specs=P(self.axis),
+                       check_vma=False)
+        return jax.jit(fn)
+
+    def run(self, tables: Sequence[pa.Table]) -> pa.Table:
+        """tables: one scan shard per device."""
+        assert len(tables) == self.n_dev, \
+            f"need {self.n_dev} shards, got {len(tables)}"
+        stacked = stack_shards(tables)
+        out = self._compiled(stacked)
+        result = shards_to_table(out)
+        if not self.partial.grouping and result.num_rows:
+            # every device produced the same global row; keep one
+            result = result.slice(0, 1)
+        return result
+
+
+class DistributedExchange:
+    """A bare distributed repartition: rows move to `hash(keys) % n_dev`
+    (the building block joins/sorts stage on; analog of
+    GpuShuffleExchangeExec.doExecuteColumnar, execution/
+    GpuShuffleExchangeExec.scala:223)."""
+
+    def __init__(self, keys, in_names, in_types,
+                 mesh: Optional[Mesh] = None, axis: str = DATA_AXIS):
+        self.mesh = mesh or build_mesh()
+        self.axis = axis
+        self.n_dev = self.mesh.shape[axis]
+        reason = exchange_supported(in_types)
+        if reason:
+            raise NotImplementedError(reason)
+        self.in_names, self.in_types = list(in_names), list(in_types)
+        self._routing = HashPartitioning(list(keys), self.n_dev).bind(
+            self.in_names, self.in_types)
+
+    def _step(self, shard):
+        b = jax.tree_util.tree_map(lambda x: x[0], shard)
+        ctx = EvalContext(jnp, b)
+        pids = self._routing.partition_ids(jnp, ctx, b)
+        out = exchange_by_pid(b, pids, self.n_dev, self.axis)
+        return jax.tree_util.tree_map(lambda x: x[None], out)
+
+    @functools.cached_property
+    def _compiled(self):
+        fn = shard_map(self._step, mesh=self.mesh,
+                       in_specs=P(self.axis), out_specs=P(self.axis),
+                       check_vma=False)
+        return jax.jit(fn)
+
+    def run_stacked(self, stacked: DeviceBatch) -> DeviceBatch:
+        return self._compiled(stacked)
+
+    def run(self, tables: Sequence[pa.Table]) -> List[pa.Table]:
+        assert len(tables) == self.n_dev
+        out = self.run_stacked(stack_shards(tables))
+        return [pa.Table.from_batches([batch_to_arrow(b)])
+                for b in unstack_shards(out)]
+
+
+def _attr(name: str, dtype: t.DataType):
+    from ..expr.core import AttributeReference
+    return AttributeReference(name, dtype)

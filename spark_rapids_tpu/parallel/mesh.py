@@ -1,0 +1,38 @@
+"""Device-mesh management.
+
+The TPU analog of the reference's device acquisition + peer topology
+bootstrap (ref: GpuDeviceManager.scala:125 initializeGpuAndMemory picks
+one GPU per executor; RapidsShuffleHeartbeatManager.scala:50 teaches
+executors about each other so UCX endpoints can form).  On TPU the
+topology is declarative: a `jax.sharding.Mesh` over the slice's chips,
+with the `"data"` axis carrying SQL data parallelism.  XLA lays the
+collectives onto ICI; multi-pod meshes extend the same axis over DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+
+
+def build_mesh(n_devices: Optional[int] = None,
+               axis_name: str = DATA_AXIS,
+               devices: Optional[Sequence] = None) -> Mesh:
+    """A 1-D data-parallel mesh over the first ``n_devices`` chips."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), axis_names=(axis_name,))
+
+
+def mesh_sharding(mesh: Mesh, axis_name: str = DATA_AXIS) -> NamedSharding:
+    """Row-sharded placement: leading axis split across the data axis."""
+    return NamedSharding(mesh, PartitionSpec(axis_name))
