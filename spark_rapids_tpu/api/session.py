@@ -37,6 +37,8 @@ class TpuSession:
 
     def _init_runtime(self):
         conf = self.conf
+        from ..memory.meta import set_default_codec
+        set_default_codec(conf.get(cfg.SHUFFLE_COMPRESSION_CODEC))
         if conf.get(cfg.BACKEND) == "tpu" and conf.sql_enabled:
             from ..memory.device import DeviceManager
             from ..memory.semaphore import TpuSemaphore

@@ -87,14 +87,34 @@ def _group_reduce(xp, key_cols: List[DeviceColumn],
             contrib = validity_sorted
         if op in ("first", "last", "first_any", "last_any") or \
                 _needs_index_gather(vc.dtype):
-            pos = xp.arange(cap, dtype=np.int64)
-            which = "first" if base_op in ("first", "min") else \
-                ("last" if base_op in ("last",) else "first")
-            idx, cnt = seg.segment_reduce(xp, which, pos, seg_ids, cap,
-                                          contrib)
-            idx = idx.astype(xp.int32)
+            perm_col = _permuted(xp, vc, order)
+            if base_op in ("min", "max") and \
+                    isinstance(vc.dtype, (t.StringType, t.BinaryType)):
+                # ordered reduce for variable-width values: secondary sort
+                # by (segment, validity, value words), first row per
+                # segment wins.  Value words are the same prefix+length
+                # encoding the sort exec orders by; max inverts them.
+                vwords = seg.key_words_for_column(
+                    xp, perm_col, contrib, for_grouping=False,
+                    ascending=(base_op == "min"))
+                words2 = [seg_ids.astype(xp.uint64),
+                          (~contrib).astype(xp.uint64)] + vwords[1:]
+                order2 = seg.lexsort(xp, words2, cap)
+                first2 = seg.first_index_per_segment(
+                    xp, seg_ids[order2], cap, contrib[order2])
+                idx = order2[first2].astype(xp.int32)
+                _, cnt = seg.segment_reduce(
+                    xp, "sum", xp.zeros((cap,), np.int64), seg_ids, cap,
+                    contrib)
+            else:
+                pos = xp.arange(cap, dtype=np.int64)
+                which = "first" if base_op in ("first", "min") else \
+                    ("last" if base_op in ("last",) else "first")
+                idx, cnt = seg.segment_reduce(xp, which, pos, seg_ids, cap,
+                                              contrib)
+                idx = idx.astype(xp.int32)
             gathered = gather_column(
-                xp, _permuted(xp, vc, order), idx,
+                xp, perm_col, idx,
                 (cnt > 0) & slot_valid)
             if op.endswith("_any"):
                 gathered = DeviceColumn(vc.dtype, data=gathered.data,

@@ -29,9 +29,28 @@ CODEC_NONE = 0
 CODEC_LZ4 = 1
 CODEC_ZSTD = 2
 
+CODEC_BY_NAME = {"none": CODEC_NONE, "lz4": CODEC_LZ4, "zstd": CODEC_ZSTD}
 
-def serialize_batch(batch: DeviceBatch, codec: int = CODEC_NONE) -> bytes:
+_default_codec = CODEC_NONE
+
+
+def set_default_codec(name: str) -> None:
+    """Process-wide payload codec, set from
+    spark.rapids.shuffle.compression.codec at session init (ref
+    TableCompressionCodec.getCodec)."""
+    global _default_codec
+    _default_codec = CODEC_BY_NAME[name]
+
+
+def default_codec() -> int:
+    return _default_codec
+
+
+def serialize_batch(batch: DeviceBatch,
+                    codec: Optional[int] = None) -> bytes:
     """Device/host batch -> self-describing bytes."""
+    if codec is None:
+        codec = _default_codec
     rb = batch_to_arrow(batch)
     sink = io.BytesIO()
     with pa.ipc.new_stream(sink, rb.schema) as w:

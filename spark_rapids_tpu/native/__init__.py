@@ -1,0 +1,77 @@
+"""Native runtime library: build-on-first-import + ctypes binding.
+
+The reference consumes its native muscle (cuDF/RMM/nvcomp/UCX) as
+prebuilt JNI libraries; here the native layer is small enough to compile
+from source at first import (g++ -O3 -shared), cached next to the source.
+If no compiler is available the codec layer falls back to Python zlib —
+slower, still correct — mirroring the reference's ability to run with
+compression disabled."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_SRC = os.path.join(os.path.dirname(__file__), "src", "tpu_native.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "build", "libtpu_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_build_error: str = ""
+
+
+def _build() -> str:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    if os.path.exists(_SO) and \
+            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return ""
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", _SO, _SRC]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as ex:
+        return f"native build failed: {ex}"
+    if r.returncode != 0:
+        return f"native build failed: {r.stderr[-2000:]}"
+    return ""
+
+
+def get_lib():
+    """The loaded native library, or None (with a recorded reason)."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error:
+            return _lib
+        _build_error = _build()
+        if _build_error:
+            return None
+        lib = ctypes.CDLL(_SO)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.tpu_lz4_bound.restype = ctypes.c_int64
+        lib.tpu_lz4_bound.argtypes = [ctypes.c_int64]
+        for fn in (lib.tpu_lz4_compress, lib.tpu_lz4_decompress):
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
+        lib.tpu_arena_create.restype = ctypes.c_void_p
+        lib.tpu_arena_create.argtypes = [ctypes.c_int64]
+        lib.tpu_arena_alloc.restype = ctypes.c_int64
+        lib.tpu_arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                        ctypes.c_int64]
+        lib.tpu_arena_base.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.tpu_arena_base.argtypes = [ctypes.c_void_p]
+        for fn in (lib.tpu_arena_used, lib.tpu_arena_high_water,
+                   lib.tpu_arena_allocs):
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [ctypes.c_void_p]
+        lib.tpu_arena_reset.restype = None
+        lib.tpu_arena_reset.argtypes = [ctypes.c_void_p]
+        lib.tpu_arena_destroy.restype = None
+        lib.tpu_arena_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def build_error() -> str:
+    return _build_error

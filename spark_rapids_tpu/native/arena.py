@@ -1,0 +1,87 @@
+"""Host staging arena (RMM's pooled-allocator role on the host side,
+ref GpuDeviceManager.scala:216 initializeRmm / pinned pool at :302).
+
+A bump arena over one page-aligned native allocation: spill/shuffle
+staging buffers allocate in O(1) and free all-at-once per task, so hot
+paths never touch malloc.  Falls back to plain bytearray blocks when the
+native library is unavailable."""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Optional
+
+from . import get_lib
+
+
+class HostArena:
+    def __init__(self, capacity: int = 64 << 20):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._lib = get_lib()
+        if self._lib is not None:
+            self._arena = self._lib.tpu_arena_create(capacity)
+            if not self._arena:
+                raise MemoryError(f"cannot reserve {capacity} arena bytes")
+        else:
+            self._arena = None
+            self._buf = bytearray(capacity)
+            self._used = 0
+            self._high = 0
+            self._n = 0
+
+    def alloc(self, size: int, align: int = 64) -> Optional[memoryview]:
+        """A writable view of `size` bytes, or None when exhausted."""
+        with self._lock:
+            if self._arena is not None:
+                off = self._lib.tpu_arena_alloc(self._arena, size, align)
+                if off < 0:
+                    return None
+                base = self._lib.tpu_arena_base(self._arena)
+                return memoryview(
+                    (ctypes.c_uint8 * size).from_address(
+                        ctypes.addressof(base.contents) + off)).cast("B")
+            off = (self._used + align - 1) & ~(align - 1)
+            if off + size > self.capacity:
+                return None
+            self._used = off + size
+            self._high = max(self._high, self._used)
+            self._n += 1
+            return memoryview(self._buf)[off:off + size]
+
+    def reset(self):
+        with self._lock:
+            if self._arena is not None:
+                self._lib.tpu_arena_reset(self._arena)
+            else:
+                self._used = 0
+
+    @property
+    def used(self) -> int:
+        if self._arena is not None:
+            return self._lib.tpu_arena_used(self._arena)
+        return self._used
+
+    @property
+    def high_water(self) -> int:
+        if self._arena is not None:
+            return self._lib.tpu_arena_high_water(self._arena)
+        return self._high
+
+    @property
+    def n_allocs(self) -> int:
+        if self._arena is not None:
+            return self._lib.tpu_arena_allocs(self._arena)
+        return self._n
+
+    def close(self):
+        if self._arena is not None:
+            self._lib.tpu_arena_destroy(self._arena)
+            self._arena = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,140 @@
+"""Compression codecs for shuffle payloads and spill buffers.
+
+Ref: TableCompressionCodec.scala + NvcompLZ4CompressionCodec.scala — the
+reference compresses shuffle slices / spilled tables with nvcomp on the
+GPU.  On the TPU build compression runs on the host around the Arrow IPC
+body (the data is staged through the host for transport anyway):
+
+  * lz4  — our own C++ LZ4-block codec (native/src/tpu_native.cpp).
+  * zstd — the system libzstd, bound via ctypes (an external native
+           library, exactly how the reference consumes nvcomp).
+  * fallback — zlib from the Python stdlib when neither is available.
+
+Frames carry a tiny header with the uncompressed size (the LZ4 block
+format does not record it)."""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import struct
+import zlib
+
+from . import get_lib
+
+_FRAME = struct.Struct("<qB")  # uncompressed size, backend id
+_B_NATIVE_LZ4 = 1
+_B_ZLIB = 2
+_B_ZSTD = 3
+
+
+# --- lz4 -------------------------------------------------------------------
+
+def lz4_compress(data: bytes) -> bytes:
+    lib = get_lib()
+    if lib is None:
+        return _FRAME.pack(len(data), _B_ZLIB) + zlib.compress(data, 1)
+    n = len(data)
+    bound = lib.tpu_lz4_bound(n)
+    dst = (ctypes.c_uint8 * bound)()
+    src = (ctypes.c_uint8 * max(n, 1)).from_buffer_copy(data or b"\0")
+    m = lib.tpu_lz4_compress(src, n, dst, bound)
+    if m < 0:
+        raise RuntimeError("lz4 compress overflow")
+    return _FRAME.pack(n, _B_NATIVE_LZ4) + bytes(dst[:m])
+
+
+def lz4_decompress(data: bytes) -> bytes:
+    n, backend = _FRAME.unpack_from(data, 0)
+    body = data[_FRAME.size:]
+    if backend == _B_ZLIB:
+        return zlib.decompress(body)
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError(
+            "payload was lz4-compressed but the native codec is "
+            "unavailable: " + __import__(
+                "spark_rapids_tpu.native", fromlist=["build_error"]
+            ).build_error())
+    dst = (ctypes.c_uint8 * max(n, 1))()
+    src = (ctypes.c_uint8 * max(len(body), 1)).from_buffer_copy(body or b"\0")
+    m = lib.tpu_lz4_decompress(src, len(body), dst, n)
+    if m != n:
+        raise RuntimeError(f"lz4 decompress: expected {n} bytes, got {m}")
+    return bytes(dst[:n])
+
+
+# --- zstd ------------------------------------------------------------------
+
+_zstd_lib = None
+_zstd_checked = False
+
+
+def _zstd():
+    global _zstd_lib, _zstd_checked
+    if _zstd_checked:
+        return _zstd_lib
+    _zstd_checked = True
+    name = ctypes.util.find_library("zstd") or "libzstd.so.1"
+    try:
+        lib = ctypes.CDLL(name)
+    except OSError:
+        return None
+    lib.ZSTD_compressBound.restype = ctypes.c_size_t
+    lib.ZSTD_compressBound.argtypes = [ctypes.c_size_t]
+    lib.ZSTD_compress.restype = ctypes.c_size_t
+    lib.ZSTD_compress.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                  ctypes.c_void_p, ctypes.c_size_t,
+                                  ctypes.c_int]
+    lib.ZSTD_decompress.restype = ctypes.c_size_t
+    lib.ZSTD_decompress.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                    ctypes.c_void_p, ctypes.c_size_t]
+    lib.ZSTD_isError.restype = ctypes.c_uint
+    lib.ZSTD_isError.argtypes = [ctypes.c_size_t]
+    _zstd_lib = lib
+    return _zstd_lib
+
+
+def zstd_compress(data: bytes, level: int = 1) -> bytes:
+    lib = _zstd()
+    if lib is None:
+        return _FRAME.pack(len(data), _B_ZLIB) + zlib.compress(data, 6)
+    n = len(data)
+    bound = lib.ZSTD_compressBound(n)
+    dst = ctypes.create_string_buffer(bound)
+    m = lib.ZSTD_compress(dst, bound, data, n, level)
+    if lib.ZSTD_isError(m):
+        raise RuntimeError("zstd compress error")
+    return _FRAME.pack(n, _B_ZSTD) + dst.raw[:m]
+
+
+def zstd_decompress(data: bytes) -> bytes:
+    n, backend = _FRAME.unpack_from(data, 0)
+    body = data[_FRAME.size:]
+    if backend == _B_ZLIB:
+        return zlib.decompress(body)
+    lib = _zstd()
+    if lib is None:
+        raise RuntimeError("payload was zstd-compressed but libzstd "
+                           "is unavailable")
+    dst = ctypes.create_string_buffer(max(n, 1))
+    m = lib.ZSTD_decompress(dst, n, body, len(body))
+    if lib.ZSTD_isError(m) or m != n:
+        raise RuntimeError(f"zstd decompress: expected {n} bytes, got {m}")
+    return dst.raw[:n]
+
+
+def compress(codec: str, data: bytes) -> bytes:
+    if codec == "lz4":
+        return lz4_compress(data)
+    if codec == "zstd":
+        return zstd_compress(data)
+    raise ValueError(f"unknown codec {codec!r}")
+
+
+def decompress(codec: str, data: bytes) -> bytes:
+    if codec == "lz4":
+        return lz4_decompress(data)
+    if codec == "zstd":
+        return zstd_decompress(data)
+    raise ValueError(f"unknown codec {codec!r}")

@@ -1,0 +1,217 @@
+// Native runtime support library.
+//
+// The TPU-build analog of the reference's native dependencies:
+//   * LZ4 block codec  (role of nvcomp, ref NvcompLZ4CompressionCodec.scala)
+//     — our own implementation of the public LZ4 block format, used to
+//     compress shuffle payloads and spill buffers on the host.
+//   * Host arena allocator (role of RMM's pooled allocator,
+//     ref GpuDeviceManager.scala:216 initializeRmm) — a bump arena with
+//     aligned allocation and O(1) reset, used for host staging buffers so
+//     spill/shuffle hot paths do not churn malloc.
+//
+// Exposed as a C ABI consumed from Python via ctypes
+// (spark_rapids_tpu/native/__init__.py builds and binds it).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <new>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// LZ4 block format codec
+//
+// Format (public spec): a block is a sequence of
+//   [token][lit-len ext...][literals][offset LE16][match-len ext...]
+// token high nibble = literal count (15 => extension bytes, each 255 adds),
+// token low nibble = match length - 4 (15 => extension bytes).
+// The final sequence carries literals only.  Matches must not start within
+// the last 12 bytes, and must end at least 5 bytes before block end.
+// ---------------------------------------------------------------------------
+
+static inline uint32_t read32(const uint8_t* p) {
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    return v;
+}
+
+static inline uint32_t hash32(uint32_t v) {
+    return (v * 2654435761u) >> 16;  // 16-bit table index
+}
+
+// Worst-case compressed size for n input bytes.
+int64_t tpu_lz4_bound(int64_t n) {
+    return n + n / 255 + 16;
+}
+
+// Returns compressed size, or -1 if dst is too small.
+int64_t tpu_lz4_compress(const uint8_t* src, int64_t n,
+                         uint8_t* dst, int64_t dst_cap) {
+    if (n < 0 || dst_cap < 0) return -1;
+    const int64_t MFLIMIT = 12;   // no match may start in the last 12 bytes
+    const int64_t LASTLIT = 5;    // matches end >= 5 bytes before the end
+    uint32_t table[1 << 16];
+    std::memset(table, 0xff, sizeof(table));  // 0xffffffff = empty
+
+    const uint8_t* ip = src;
+    const uint8_t* anchor = src;
+    const uint8_t* iend = src + n;
+    const uint8_t* mflimit = n > MFLIMIT ? iend - MFLIMIT : src;
+    uint8_t* op = dst;
+    uint8_t* oend = dst + dst_cap;
+
+    auto emit = [&](const uint8_t* lit_start, int64_t lit_len,
+                    int64_t offset, int64_t match_len) -> bool {
+        // token + worst-case extensions + literals + offset
+        int64_t need = 1 + lit_len / 255 + 1 + lit_len + 2 +
+                       (match_len >= 0 ? match_len / 255 + 1 : 0);
+        if (op + need > oend) return false;
+        int64_t ml = match_len >= 0 ? match_len - 4 : 0;
+        uint8_t token =
+            (uint8_t)((lit_len >= 15 ? 15 : lit_len) << 4 |
+                      (match_len >= 0 ? (ml >= 15 ? 15 : ml) : 0));
+        *op++ = token;
+        if (lit_len >= 15) {
+            int64_t rest = lit_len - 15;
+            while (rest >= 255) { *op++ = 255; rest -= 255; }
+            *op++ = (uint8_t)rest;
+        }
+        std::memcpy(op, lit_start, lit_len);
+        op += lit_len;
+        if (match_len < 0) return true;  // final literals-only sequence
+        *op++ = (uint8_t)(offset & 0xff);
+        *op++ = (uint8_t)(offset >> 8);
+        if (ml >= 15) {
+            int64_t rest = ml - 15;
+            while (rest >= 255) { *op++ = 255; rest -= 255; }
+            *op++ = (uint8_t)rest;
+        }
+        return true;
+    };
+
+    if (n >= MFLIMIT) {
+        while (ip < mflimit) {
+            uint32_t h = hash32(read32(ip));
+            uint32_t cand = table[h];
+            table[h] = (uint32_t)(ip - src);
+            const uint8_t* ref = src + cand;
+            if (cand != 0xffffffffu && ip - ref <= 65535 &&
+                read32(ref) == read32(ip)) {
+                // extend match (end at least LASTLIT before iend)
+                const uint8_t* match_limit = iend - LASTLIT;
+                int64_t len = 4;
+                while (ip + len < match_limit && ref[len] == ip[len]) len++;
+                if (!emit(anchor, ip - anchor, ip - ref, len)) return -1;
+                ip += len;
+                anchor = ip;
+            } else {
+                ip++;
+            }
+        }
+    }
+    // final literals
+    if (!emit(anchor, iend - anchor, 0, -1)) return -1;
+    return op - dst;
+}
+
+// Returns decompressed size, or -1 on malformed/overflow input.
+int64_t tpu_lz4_decompress(const uint8_t* src, int64_t n,
+                           uint8_t* dst, int64_t dst_cap) {
+    const uint8_t* ip = src;
+    const uint8_t* iend = src + n;
+    uint8_t* op = dst;
+    uint8_t* oend = dst + dst_cap;
+
+    while (ip < iend) {
+        uint8_t token = *ip++;
+        int64_t lit = token >> 4;
+        if (lit == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return -1;
+                b = *ip++;
+                lit += b;
+            } while (b == 255);
+        }
+        if (ip + lit > iend || op + lit > oend) return -1;
+        std::memcpy(op, ip, lit);
+        ip += lit;
+        op += lit;
+        if (ip >= iend) break;  // final sequence has no match part
+        if (ip + 2 > iend) return -1;
+        int64_t offset = ip[0] | ((int64_t)ip[1] << 8);
+        ip += 2;
+        if (offset == 0 || op - dst < offset) return -1;
+        int64_t ml = (token & 15);
+        if (ml == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return -1;
+                b = *ip++;
+                ml += b;
+            } while (b == 255);
+        }
+        ml += 4;
+        if (op + ml > oend) return -1;
+        const uint8_t* match = op - offset;
+        // overlapping copy must be byte-wise
+        for (int64_t i = 0; i < ml; i++) op[i] = match[i];
+        op += ml;
+    }
+    return op - dst;
+}
+
+// ---------------------------------------------------------------------------
+// Host bump arena
+// ---------------------------------------------------------------------------
+
+struct Arena {
+    uint8_t* base;
+    int64_t capacity;
+    int64_t used;
+    int64_t high_water;
+    int64_t n_allocs;
+};
+
+void* tpu_arena_create(int64_t capacity) {
+    void* mem = nullptr;
+    if (posix_memalign(&mem, 4096, (size_t)capacity) != 0) return nullptr;
+    Arena* a = new (std::nothrow) Arena();
+    if (!a) { free(mem); return nullptr; }
+    a->base = (uint8_t*)mem;
+    a->capacity = capacity;
+    a->used = 0;
+    a->high_water = 0;
+    a->n_allocs = 0;
+    return a;
+}
+
+// Returns an offset into the arena base, or -1 when exhausted.
+int64_t tpu_arena_alloc(void* arena, int64_t size, int64_t align) {
+    Arena* a = (Arena*)arena;
+    if (align <= 0) align = 64;
+    int64_t off = (a->used + align - 1) & ~(align - 1);
+    if (off + size > a->capacity) return -1;
+    a->used = off + size;
+    if (a->used > a->high_water) a->high_water = a->used;
+    a->n_allocs++;
+    return off;
+}
+
+uint8_t* tpu_arena_base(void* arena) { return ((Arena*)arena)->base; }
+int64_t tpu_arena_used(void* arena) { return ((Arena*)arena)->used; }
+int64_t tpu_arena_high_water(void* arena) {
+    return ((Arena*)arena)->high_water;
+}
+int64_t tpu_arena_allocs(void* arena) { return ((Arena*)arena)->n_allocs; }
+
+void tpu_arena_reset(void* arena) { ((Arena*)arena)->used = 0; }
+
+void tpu_arena_destroy(void* arena) {
+    Arena* a = (Arena*)arena;
+    free(a->base);
+    delete a;
+}
+
+}  // extern "C"

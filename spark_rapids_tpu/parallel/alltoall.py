@@ -83,8 +83,7 @@ def _string_send(col: DeviceColumn, src_row, send_valid, n_parts: int,
     return chars_send, row_len
 
 
-def _string_receive(recv_chars, recv_len, ord2, out_total, n_parts: int,
-                    slot: int):
+def _string_receive(recv_chars, recv_len, ord2, n_parts: int, slot: int):
     """Re-assemble a received string column into (offsets, chars)."""
     char_slot = int(recv_chars.shape[1])
     flat_rows = n_parts * slot
@@ -161,7 +160,7 @@ def exchange_by_pid(batch: DeviceBatch, pids, n_parts: int, axis_name: str,
             recv_chars = a2a(chars_send)
             recv_len = a2a(len_send)
             out_chars, out_offs = _string_receive(
-                recv_chars, recv_len, ord2, out_total, n_parts, slot)
+                recv_chars, recv_len, ord2, n_parts, slot)
             out_cols.append(DeviceColumn(col.dtype, data=out_chars,
                                          validity=recv_v, offsets=out_offs))
             continue
@@ -208,7 +207,7 @@ def allgather_batch(batch: DeviceBatch, axis_name: str,
             recv_len = ag(lengths).reshape(n_parts, cap)
             # source char starts inside each gathered shard = its own offsets
             out_chars, out_offs = _string_receive(
-                recv_chars, recv_len, ord2, total, n_parts, cap)
+                recv_chars, recv_len, ord2, n_parts, cap)
             out_cols.append(DeviceColumn(col.dtype, data=out_chars,
                                          validity=recv_v, offsets=out_offs))
             continue

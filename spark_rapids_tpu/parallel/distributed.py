@@ -24,7 +24,8 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import types as t
-from ..columnar.device import DeviceBatch, batch_to_arrow, batch_to_device, bucket_for
+from ..columnar.device import (DEFAULT_ROW_BUCKETS, DeviceBatch,
+                               batch_to_arrow, batch_to_device, bucket_for)
 from ..expr.core import EvalContext
 from ..shuffle.partitioning import HashPartitioning
 from .alltoall import allgather_batch, exchange_by_pid, exchange_supported
@@ -51,7 +52,7 @@ def stack_shards(tables: Sequence[pa.Table], capacity: Optional[int] = None):
     device axis (the host->mesh transfer; each shard then lives on its
     device under `jax.device_put` with a row sharding)."""
     n_rows = max(max((tb.num_rows for tb in tables), default=1), 1)
-    cap = capacity or bucket_for(n_rows, (1024, 8192, 65536, 262144, 1048576))
+    cap = capacity or bucket_for(n_rows, DEFAULT_ROW_BUCKETS)
     batches = []
     for tb in tables:
         rbs = tb.combine_chunks().to_batches()

@@ -167,8 +167,8 @@ expr_rule(Cast, T.all_types, "type cast", _tag_cast)
 expr_rule(agg.Sum, _num)
 expr_rule(agg.Average, _num)
 expr_rule(agg.Count, T.all_types)
-expr_rule(agg.Min, _num + T.DATE + T.TIMESTAMP + T.BOOLEAN)
-expr_rule(agg.Max, _num + T.DATE + T.TIMESTAMP + T.BOOLEAN)
+expr_rule(agg.Min, _num + T.DATE + T.TIMESTAMP + T.BOOLEAN + T.STRING)
+expr_rule(agg.Max, _num + T.DATE + T.TIMESTAMP + T.BOOLEAN + T.STRING)
 expr_rule(agg.First, _common)
 expr_rule(agg.Last, _common)
 for c in (agg.StddevPop, agg.StddevSamp, agg.VariancePop, agg.VarianceSamp):

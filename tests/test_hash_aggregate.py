@@ -125,3 +125,23 @@ def test_avg_overflow_like_reference_config():
             F.sum(col("i")).alias("si"), F.avg(col("i")).alias("ai"),
             F.sum(col("l")).alias("sl"), F.avg(col("l")).alias("al"))
     assert_tpu_and_cpu_are_equal_collect(q, approximate_float=1e-9)
+
+
+def test_group_by_min_max_strings():
+    """Ordered reduce over variable-width values (regression: min/max on
+    strings used to return the first value per group)."""
+    def q(spark):
+        df = gen_df(spark, [("k", IntegerGen(nullable=False)),
+                            ("s", StringGen())], length=512)
+        return df.group_by(col("k")).agg(
+            F.min(col("s")).alias("mn"), F.max(col("s")).alias("mx"),
+            F.count(col("s")).alias("c"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_global_min_max_strings():
+    def q(spark):
+        df = gen_df(spark, [("s", StringGen())], length=256)
+        return df.agg(F.min(col("s")).alias("mn"),
+                      F.max(col("s")).alias("mx"))
+    assert_tpu_and_cpu_are_equal_collect(q)

@@ -127,7 +127,7 @@ def test_distributed_aggregate_matches_single_host():
                     AggregateExpression(Average(A("f")), "af"),
                     AggregateExpression(Count(None), "c")],
         in_names=["k", "v", "f"],
-        in_types=None or _types(table),
+        in_types=_types(table),
         mesh=mesh8())
     got = dagg.run(shard_tables(table)).sort_by("k")
 
