@@ -116,6 +116,11 @@ class Column:
     def cast(self, to):
         if isinstance(to, str):
             to = _parse_type(to)
+        elif not isinstance(to, t.DataType):
+            import pyarrow as pa
+            if isinstance(to, pa.DataType):
+                from ..columnar.interop import from_arrow_type
+                to = from_arrow_type(to)
         return Column(Cast(self.expr, to))
 
     def asc(self):

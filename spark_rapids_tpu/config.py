@@ -321,6 +321,35 @@ ARROW_MAX_RECORDS_PER_BATCH = \
     .check(lambda v: v > 0, "must be positive") \
     .create_with_default(10000)
 
+# --- adaptive execution ---------------------------------------------------
+
+ADAPTIVE_ENABLED = conf("spark.sql.adaptive.enabled").boolean() \
+    .doc("Adaptive query execution: re-shape shuffle reads from "
+         "materialized map-output statistics (coalesce small partitions, "
+         "split skewed ones; ref GpuCustomShuffleReaderExec).") \
+    .create_with_default(True)
+
+ADVISORY_PARTITION_SIZE = conf(
+    "spark.sql.adaptive.advisoryPartitionSizeInBytes").bytes() \
+    .doc("Target size for coalesced shuffle partitions.") \
+    .create_with_default(64 << 20)
+
+SKEW_JOIN_ENABLED = conf("spark.sql.adaptive.skewJoin.enabled").boolean() \
+    .doc("Split skewed probe-side join partitions and replicate the build "
+         "side (ref OptimizeSkewedJoin).") \
+    .create_with_default(True)
+
+SKEW_JOIN_FACTOR = conf(
+    "spark.sql.adaptive.skewJoin.skewedPartitionFactor").double() \
+    .doc("A partition is skewed when larger than this factor times the "
+         "median partition size (and the threshold below).") \
+    .create_with_default(5.0)
+
+SKEW_JOIN_THRESHOLD = conf(
+    "spark.sql.adaptive.skewJoin.skewedPartitionThresholdInBytes").bytes() \
+    .doc("Minimum size for a partition to be considered skewed.") \
+    .create_with_default(256 << 20)
+
 # --- optimizer ------------------------------------------------------------
 
 OPTIMIZER_ENABLED = conf("spark.rapids.sql.optimizer.enabled").boolean() \

@@ -85,11 +85,25 @@ def _group_reduce(xp, key_cols: List[DeviceColumn],
         else:
             base_op = op
             contrib = validity_sorted
+        is_dec128 = vc.data_hi is not None
+        if is_dec128 and base_op == "sum":
+            lo_s = vc.data[order]
+            hi_s = vc.data_hi[order]
+            lo_o, hi_o, cnt = seg.segment_sum128(xp, lo_s, hi_s, seg_ids,
+                                                 cap, contrib)
+            validity_out = (cnt > 0) & slot_valid
+            out_values.append(DeviceColumn(
+                vc.dtype,
+                data=xp.where(validity_out, lo_o, xp.zeros_like(lo_o)),
+                data_hi=xp.where(validity_out, hi_o, xp.zeros_like(hi_o)),
+                validity=validity_out))
+            continue
         if op in ("first", "last", "first_any", "last_any") or \
-                _needs_index_gather(vc.dtype):
+                _needs_index_gather(vc.dtype) or is_dec128:
             perm_col = _permuted(xp, vc, order)
             if base_op in ("min", "max") and \
-                    isinstance(vc.dtype, (t.StringType, t.BinaryType)):
+                    (is_dec128 or
+                     isinstance(vc.dtype, (t.StringType, t.BinaryType))):
                 # ordered reduce for variable-width values: secondary sort
                 # by (segment, validity, value words), first row per
                 # segment wins.  Value words are the same prefix+length

@@ -70,16 +70,35 @@ def cast_data(ctx: EvalContext, data, src: t.DataType, dst: t.DataType):
             if dst.scale == src.scale:
                 return data
             if dst.scale > src.scale:
-                return data * np.int64(10 ** (dst.scale - src.scale))
-            return _div_round_half_up(xp, data, np.int64(10 ** (src.scale - dst.scale)))
+                k = dst.scale - src.scale
+            return _widen_for(data, k, dst.precision > 18) * _pow10(k)
+            return _div_round_half_up(xp, data, _pow10(src.scale - dst.scale))
         # integral -> decimal
-        return data.astype(np.int64) * np.int64(10 ** dst.scale)
+        d64 = data.astype(np.int64)
+        return _widen_for(d64, dst.scale,
+                          dst.precision > 18) * _pow10(dst.scale)
     if isinstance(src, t.DecimalType):
         # decimal -> floating
         return data.astype(t.to_np_dtype(dst)) / (10.0 ** src.scale)
     if hasattr(data, "astype"):
         return data.astype(t.to_np_dtype(dst))
     return np.array(data, dtype=t.to_np_dtype(dst))[()]
+
+
+def _pow10(k: int):
+    """10**k as a multiplier: np.int64 while it fits (fast path), plain
+    Python int beyond (object-array exact path on the CPU engine)."""
+    return np.int64(10 ** k) if k <= 18 else 10 ** k
+
+
+def _widen_for(data, k: int, force: bool = False):
+    """Promote an int64 numpy array to an exact object array before a
+    10**k multiply that could exceed 64 bits (CPU-oracle path; the TPU
+    path is gated away from these shapes by TypeSig/cast tagging).
+    `force` widens regardless of k — for results wider than 18 digits."""
+    if (k > 18 or force) and isinstance(data, np.ndarray)             and data.dtype != object:
+        return data.astype(object)
+    return data
 
 
 def _div_round_half_up(xp, num, den):
@@ -229,6 +248,8 @@ def _eval_mul(e: Multiply, ctx: EvalContext):
             ld = np.int64(ld)
         if not hasattr(rd, "astype"):
             rd = np.int64(rd)
+        ld = _widen_for(ld, 0, out.precision > 18)
+        rd = _widen_for(rd, 0, out.precision > 18)
         return make_column(ctx, out, ld * rd, v)
     ld, rd, v = _binary_inputs(e, ctx, out)
     return make_column(ctx, out, ld * rd, v)
@@ -259,8 +280,9 @@ def _eval_div(e: Divide, ctx: EvalContext):
         # value = l*10^-s1 / (r*10^-s2) scaled to out.scale:
         #   unscaled = l * 10^(out.scale - s1 + s2) / r   (HALF_UP)
         shift = out.scale - lt.scale + rt.scale
-        num = ld * np.int64(10 ** max(shift, 0))
-        den = rd * np.int64(10 ** max(-shift, 0))
+        num = _widen_for(ld, max(shift, 0),
+                         out.precision > 18) * _pow10(max(shift, 0))
+        den = _widen_for(rd, max(-shift, 0)) * _pow10(max(-shift, 0))
         zero = den == 0
         den_safe = xp.where(zero, xp.ones_like(den), den)
         sign = xp.where((num < 0) != (den_safe < 0), -1, 1).astype(np.int64)

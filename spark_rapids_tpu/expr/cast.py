@@ -53,9 +53,32 @@ class Cast(Expression):
 
 
 # which (from, to) pairs run on TPU; others are tagged off (CPU fallback)
+def _dec_overflow_ok(xp, data, precision: int):
+    """Validity mask for decimal target-precision overflow, or None when
+    no value can overflow.  Exact beyond 18 digits via Python ints on the
+    object-array (CPU-oracle) path; an int64 lane can never exceed 19
+    digits so wider targets need no check."""
+    if precision > 18 and getattr(data, "dtype", None) != object:
+        return None
+    limit = 10 ** precision if precision > 18 else \
+        np.int64(10 ** precision)
+    return (data < limit) & (data > -limit)
+
+
 def cast_supported_on_tpu(src: t.DataType, dst: t.DataType) -> bool:
     if src == dst:
         return True
+    if isinstance(src, t.DecimalType) and not src.is64:
+        # cast kernels read the low word only; >18-digit inputs keep their
+        # operator on the CPU (the reference is decimal64-only)
+        return False
+    if isinstance(dst, t.DecimalType) and not dst.is64:
+        # a >18-digit destination can exceed int64 during the scale-up
+        # multiply; only same/down-scale decimal sources are overflow-free
+        # on the low-word kernels (the internal aggregation-buffer casts
+        # are exactly this shape and bypass tagging anyway)
+        if not (isinstance(src, t.DecimalType) and dst.scale <= src.scale):
+            return False
     flat = (t.BooleanType, t.ByteType, t.ShortType, t.IntegerType, t.LongType,
             t.FloatType, t.DoubleType, t.DecimalType)
     if isinstance(src, flat) and isinstance(dst, flat):
@@ -144,14 +167,17 @@ def _eval_cast(e: Cast, ctx: EvalContext):
         if isinstance(src, t.DecimalType):
             data = cast_data(ctx, d, src, dst)
             # overflow of target precision -> null (non-ANSI)
-            limit = np.int64(10 ** min(dst.precision, 18))
-            ok = (data < limit) & (data > -limit)
-            return make_column(ctx, dst, data, and_validity(ctx, val, ok))
+            ok = _dec_overflow_ok(xp, data, dst.precision)
+            return make_column(ctx, dst, data,
+                               val if ok is None else
+                               and_validity(ctx, val, ok))
         if t.is_integral(src):
-            data = d.astype(np.int64) * np.int64(10 ** dst.scale)
-            limit = np.int64(10 ** min(dst.precision, 18))
-            ok = (data < limit) & (data > -limit)
-            return make_column(ctx, dst, data, and_validity(ctx, val, ok))
+            from .arithmetic import cast_data as _cd
+            data = _cd(ctx, d, src, dst)
+            ok = _dec_overflow_ok(xp, data, dst.precision)
+            return make_column(ctx, dst, data,
+                               val if ok is None else
+                               and_validity(ctx, val, ok))
         if t.is_floating(src):
             scaled = d * (10.0 ** dst.scale)
             data = _round_half_up_float(xp, scaled).astype(np.int64)

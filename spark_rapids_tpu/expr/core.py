@@ -310,8 +310,21 @@ def bind_expression(expr: Expression, names: Sequence[str],
 # ---------------------------------------------------------------------------
 
 def data_of(v: Value, ctx: EvalContext):
-    """The raw data (xp array or python scalar) of a value."""
+    """The raw data (xp array or python scalar) of a value.
+
+    On the numpy (CPU-oracle) path, decimal128 columns materialize as
+    exact Python-int object arrays combining both 64-bit lanes, so every
+    downstream numpy op is arbitrary-precision — the CPU engine must be
+    bit-correct where it plays Spark's role.  The TPU path never sees
+    >64-bit decimals (TypeSig gating)."""
     if isinstance(v, ColumnValue):
+        col = v.col
+        if isinstance(col.dtype, t.DecimalType) and not col.dtype.is64 \
+                and col.data_hi is not None \
+                and isinstance(col.data, np.ndarray):
+            lo_u = col.data.astype(np.uint64).astype(object)
+            hi = col.data_hi.astype(object)
+            return (hi << 64) + lo_u
         return v.col.data
     if v.value is None:
         return _zero_of(v.dtype)
@@ -356,16 +369,38 @@ def make_column(ctx: EvalContext, dtype: t.DataType, data, validity) -> ColumnVa
         validity = xp.ones((ctx.capacity,), dtype=bool)
     elif validity is False:
         validity = xp.zeros((ctx.capacity,), dtype=bool)
+    is_dec128 = isinstance(dtype, t.DecimalType) and not dtype.is64
     if not hasattr(data, "shape") or getattr(data, "shape", ()) == ():
-        npdt = t.to_np_dtype(dtype) if not isinstance(
-            dtype, (t.StringType, t.BinaryType)) else None
-        if npdt is not None:
-            data = xp.full((ctx.capacity,), data, dtype=npdt)
+        if is_dec128 and xp is np and not (-(2**63) <= int(data) < 2**63):
+            data = np.full((ctx.capacity,), int(data), dtype=object)
+        else:
+            npdt = t.to_np_dtype(dtype) if not isinstance(
+                dtype, (t.StringType, t.BinaryType)) else None
+            if npdt is not None:
+                data = xp.full((ctx.capacity,), data, dtype=npdt)
     # canonicalize: zero under nulls so downstream reductions are safe
     if not isinstance(dtype, (t.StringType, t.BinaryType, t.StructType,
                               t.ArrayType, t.MapType)):
         data = ctx.xp.where(validity, data, ctx.xp.zeros_like(data))
-    return ColumnValue(DeviceColumn(dtype, data=data, validity=validity))
+    if isinstance(dtype, t.DecimalType) and \
+            getattr(data, "dtype", None) == object:
+        # exact Python-int array (numpy CPU path) -> 64-bit lane pair
+        mask = (1 << 64) - 1
+        lo = np.array([int(x) & mask for x in data],
+                      dtype=np.uint64).astype(np.int64)
+        hi = np.array([int(x) >> 64 for x in data], dtype=np.int64)
+        col = DeviceColumn(dtype, data=lo, validity=validity)
+        if not dtype.is64:
+            col.data_hi = hi
+        return ColumnValue(col)
+    col = DeviceColumn(dtype, data=data, validity=validity)
+    if is_dec128:
+        # expression kernels compute the low word; values are bounded to
+        # 64 bits by TypeSig gating (the reference is decimal64-only,
+        # RapidsConf.scala:565) — sign-extend so the 128-bit lanes agree
+        # and exact 128-bit aggregation buffers can build on top
+        col.data_hi = data.astype(xp.int64) >> np.int64(63)
+    return ColumnValue(col)
 
 
 def scalar_to_column(ctx: EvalContext, sv: "ScalarValue") -> ColumnValue:

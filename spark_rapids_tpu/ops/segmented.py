@@ -69,6 +69,10 @@ def key_words_for_column(xp, col: DeviceColumn, live_mask,
         words.append(col.data.astype(xp.uint64))
     elif isinstance(dtype, t.NullType):
         pass
+    elif isinstance(dtype, t.DecimalType) and col.data_hi is not None:
+        # decimal128: order by (hi signed, lo unsigned) word pair
+        words.append(encode_int_ordered(xp, col.data_hi))
+        words.append(col.data.astype(xp.uint64))
     elif isinstance(dtype, t.StructType):
         for ch in col.children:
             words += key_words_for_column(xp, ch, live_mask, for_grouping,
@@ -171,6 +175,46 @@ def segment_reduce(xp, op: str, values, seg_ids, num_segments: int, valid):
     else:
         raise ValueError(op)
     return out, cnt
+
+
+def segment_sum128(xp, lo, hi, seg_ids, num_segments: int, valid):
+    """128-bit segmented sum over (lo: int64 bit-pattern of the unsigned
+    low word, hi: int64 high word) columns.  Carries propagate through
+    32-bit partial sums, so per-segment row counts up to 2^31 are exact.
+    Returns (lo_out, hi_out, count_valid)."""
+    mask32 = xp.uint64(0xFFFFFFFF)
+    lo_u = lo.astype(xp.uint64)
+    lo32 = lo_u & mask32
+    hi32 = (lo_u >> xp.uint64(32)) & mask32
+    seg = xp.where(valid, seg_ids, num_segments - 1)
+    zero_u = xp.zeros((), xp.uint64)
+    lo32 = xp.where(valid, lo32, zero_u)
+    hi32 = xp.where(valid, hi32, zero_u)
+    hi_v = xp.where(valid, hi, xp.zeros_like(hi))
+    if xp is np:
+        s0 = np.zeros((num_segments,), np.uint64)
+        s1 = np.zeros((num_segments,), np.uint64)
+        sh = np.zeros((num_segments,), np.int64)
+        cnt = np.zeros((num_segments,), np.int64)
+        np.add.at(s0, seg, lo32)
+        np.add.at(s1, seg, hi32)
+        np.add.at(sh, seg, hi_v)
+        np.add.at(cnt, seg, valid.astype(np.int64))
+    else:
+        import jax
+        s0 = jax.ops.segment_sum(lo32, seg, num_segments=num_segments)
+        s1 = jax.ops.segment_sum(hi32, seg, num_segments=num_segments)
+        sh = jax.ops.segment_sum(hi_v, seg, num_segments=num_segments)
+        cnt = jax.ops.segment_sum(valid.astype(xp.int64), seg,
+                                  num_segments=num_segments)
+    low32 = s0 & mask32
+    c0 = s0 >> xp.uint64(32)
+    tmid = s1 + c0
+    high32 = tmid & mask32
+    c1 = (tmid >> xp.uint64(32)).astype(xp.int64)
+    lo_out = (low32 | (high32 << xp.uint64(32))).astype(xp.int64)
+    hi_out = sh + c1
+    return lo_out, hi_out, cnt
 
 
 def _extreme_init(xp, dtype, is_min: bool):

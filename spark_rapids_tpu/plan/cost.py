@@ -1,0 +1,152 @@
+"""Cost-based optimizer: the optional second pass that can move subtrees
+back to the CPU when acceleration would not pay for its transitions.
+
+Ref: CostBasedOptimizer.scala:1-528 (invoked from GpuOverrides.scala:
+3512-3524).  The reference walks plan "sections" comparing per-row
+GPU/CPU operator costs plus row<->columnar transition costs.  Here the
+same inputs feed an exact two-state dynamic program over the meta tree:
+
+  best_tpu(n) = tpu_cost(n) + sum_c min(best_tpu(c), best_cpu(c) + h2d(c))
+  best_cpu(n) = cpu_cost(n) + sum_c min(best_cpu(c), best_tpu(c) + d2h(c))
+
+(best_tpu = inf where tagging already rejected the node).  Backtracking
+marks every CPU-chosen node with "removed by cost-based optimizer",
+exactly the reason string consumers of the reference see.
+
+Per-operator costs are tunable the same way as the reference's
+(`spark.rapids.sql.optimizer.{cpu,tpu}.exec.<ExecName>` keys override the
+defaults), and row counts flow from scan statistics through per-operator
+cardinality factors.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Tuple
+
+from .. import config as cfg
+from ..exec import base as eb
+
+# default per-row operator costs (arbitrary units; only ratios matter).
+# TPU ops are cheaper per row but transitions cost extra — the same shape
+# as the reference's defaults (CostBasedOptimizer.scala DEFAULT_*).
+DEFAULT_CPU_OP_COST = 1.0
+DEFAULT_TPU_OP_COST = 0.25
+# host<->device transition per-row costs (ref
+# spark.rapids.sql.optimizer.cpu.exec.ColumnarToRowExec analog)
+DEFAULT_H2D_COST = 0.4
+DEFAULT_D2H_COST = 0.4
+# rows assumed when no statistics are available
+DEFAULT_ROW_COUNT = 1_000_000
+
+
+_CARDINALITY = {
+    # output rows as a factor of input rows (first child)
+    "FilterExec": 0.5,
+    "CpuHashAggregateExec": 0.2,
+    "TpuHashAggregateExec": 0.2,
+    "ExpandExec": 2.0,
+    "GenerateExec": 4.0,
+    "SampleExec": 0.1,
+}
+
+
+class CostBasedOptimizer:
+    def __init__(self, conf: cfg.RapidsConf):
+        self.conf = conf
+        self.explain_lines: List[str] = []
+
+    # -- inputs -------------------------------------------------------------
+    def _op_cost(self, side: str, name: str, default: float) -> float:
+        raw = self.conf.raw(f"spark.rapids.sql.optimizer.{side}.exec.{name}")
+        return float(raw) if raw is not None else default
+
+    def _rows(self, node: eb.Exec, child_rows: List[float]) -> float:
+        name = type(node).__name__
+        from ..exec.basic import GlobalLimitExec, LocalLimitExec, LocalScanExec, RangeExec
+        if isinstance(node, LocalScanExec):
+            return float(node.table.num_rows)
+        if isinstance(node, RangeExec):
+            return max(1.0, abs(node.end - node.start) / abs(node.step))
+        from ..io.scan import FileScanExec
+        if isinstance(node, FileScanExec):
+            try:
+                import os
+                size = sum(os.path.getsize(p) for p in node.paths)
+                return max(size / 100.0, 1.0)  # ~100 compressed bytes/row
+            except OSError:
+                return float(DEFAULT_ROW_COUNT)
+        if isinstance(node, (LocalLimitExec, GlobalLimitExec)):
+            n = float(node.limit)
+            return min(n, child_rows[0]) if child_rows else n
+        if not child_rows:
+            return float(DEFAULT_ROW_COUNT)
+        if name in ("UnionExec",):
+            return sum(child_rows)
+        if name in ("HashJoinExec", "CpuJoinExec", "BroadcastHashJoinExec",
+                    "NestedLoopJoinExec", "BroadcastNestedLoopJoinExec"):
+            return max(child_rows)
+        return child_rows[0] * _CARDINALITY.get(name, 1.0)
+
+    # -- the DP -------------------------------------------------------------
+    def optimize(self, meta) -> int:
+        """Tags CPU-cheaper nodes on the meta tree; returns #nodes moved."""
+        plans: Dict[int, Tuple] = {}
+
+        def walk(m) -> Tuple[float, float, float]:
+            """returns (rows, best_cpu, best_tpu) for the subtree."""
+            child_states = [walk(c) for c in m.children]
+            rows = self._rows(m.exec, [s[0] for s in child_states])
+            name = type(m.exec).__name__
+            cpu_op = self._op_cost("cpu", name, DEFAULT_CPU_OP_COST) * rows
+            tpu_op = self._op_cost("tpu", name, DEFAULT_TPU_OP_COST) * rows
+
+            cpu_total, tpu_total = cpu_op, tpu_op
+            child_choice_cpu, child_choice_tpu = [], []
+            for (crows, ccpu, ctpu) in child_states:
+                h2d = DEFAULT_H2D_COST * crows
+                d2h = DEFAULT_D2H_COST * crows
+                # parent on CPU
+                if ccpu <= ctpu + d2h:
+                    cpu_total += ccpu
+                    child_choice_cpu.append("cpu")
+                else:
+                    cpu_total += ctpu + d2h
+                    child_choice_cpu.append("tpu")
+                # parent on TPU
+                if ctpu <= ccpu + h2d:
+                    tpu_total += ctpu
+                    child_choice_tpu.append("tpu")
+                else:
+                    tpu_total += ccpu + h2d
+                    child_choice_tpu.append("cpu")
+            if not m.can_replace:
+                tpu_total = math.inf
+            plans[id(m)] = (child_choice_cpu, child_choice_tpu)
+            return rows, cpu_total, tpu_total
+
+        def mark(m, placement: str):
+            if placement == "cpu" and m.can_replace:
+                m.will_not_work("removed by cost-based optimizer")
+                self.explain_lines.append(
+                    f"CBO: {type(m.exec).__name__} -> CPU")
+            choices = plans[id(m)][0 if placement == "cpu" else 1]
+            for c, choice in zip(m.children, choices):
+                mark(c, choice)
+
+        rows, best_cpu, best_tpu = walk(meta)
+        # the plan root hands rows back to the host either way
+        root_tpu = best_tpu + DEFAULT_D2H_COST * rows
+        root = "cpu" if best_cpu <= root_tpu else "tpu"
+        before = _count_replaceable(meta)
+        mark(meta, root)
+        moved = before - _count_replaceable(meta)
+        if self.conf.get(cfg.OPTIMIZER_EXPLAIN) == "ALL" and \
+                self.explain_lines:
+            print("\n".join(self.explain_lines))
+        return moved
+
+
+def _count_replaceable(meta) -> int:
+    n = 1 if meta.can_replace else 0
+    return n + sum(_count_replaceable(c) for c in meta.children)

@@ -58,11 +58,19 @@ def expr_rule(cls, sig: TypeSig, desc: str = "", tag_fn=None):
     EXPR_RULES[cls] = ExprRule(sig, desc, tag_fn)
 
 
-_num = T.numeric
+_num = T.numeric64
 _common = T.common_scalar
-_cmp = _common
+_cmp = (T.numeric64 + T.BOOLEAN + T.DATE + T.TIMESTAMP + T.STRING + T.NULL)
 
-expr_rule(Literal, T.all_types, "literal values")
+def _tag_literal(meta: "ExprMeta"):
+    e = meta.expr
+    if isinstance(e.data_type(), t.DecimalType) and e.value is not None \
+            and not (-(2**63) <= int(e.value) < 2**63):
+        meta.will_not_work(
+            "decimal literal beyond 64-bit unscaled range stays on CPU")
+
+
+expr_rule(Literal, T.all_types, "literal values", _tag_literal)
 expr_rule(Alias, T.all_types.nested(), "named expression")
 expr_rule(AttributeReference,
           (_common + T.ARRAY + T.STRUCT + T.MAP + T.BINARY).nested(),
@@ -83,7 +91,7 @@ for c in (pred.And, pred.Or, pred.Not):
 for c in (pred.IsNull, pred.IsNotNull, pred.IsNaN):
     expr_rule(c, _common)
 for c in (cond.If, cond.CaseWhen, cond.Coalesce, cond.NullIf, cond.Nvl):
-    expr_rule(c, _common)
+    expr_rule(c, _cmp)  # branch-select kernels move the low word only
 for c in (mx.Sqrt, mx.Exp, mx.Expm1, mx.Sin, mx.Cos, mx.Tan, mx.Asin,
           mx.Acos, mx.Atan, mx.Sinh, mx.Cosh, mx.Tanh, mx.Cbrt, mx.Rint,
           mx.ToDegrees, mx.ToRadians, mx.Log, mx.Log2, mx.Log10, mx.Log1p,
@@ -163,16 +171,19 @@ def _tag_cast(meta: "ExprMeta"):
 
 expr_rule(Cast, T.all_types, "type cast", _tag_cast)
 
-# aggregate functions
-expr_rule(agg.Sum, _num)
-expr_rule(agg.Average, _num)
+# aggregate functions.  Sum accepts decimal64 inputs and produces exact
+# 128-bit buffers (segment_sum128); Average's final divide is 64-bit so
+# decimal averages stay on CPU; Min/Max carry both decimal words through
+# the ordered gather so full decimal128 is fine.
+expr_rule(agg.Sum, T.numeric)
+expr_rule(agg.Average, T.integral + T.FLOAT + T.DOUBLE)
 expr_rule(agg.Count, T.all_types)
-expr_rule(agg.Min, _num + T.DATE + T.TIMESTAMP + T.BOOLEAN + T.STRING)
-expr_rule(agg.Max, _num + T.DATE + T.TIMESTAMP + T.BOOLEAN + T.STRING)
+expr_rule(agg.Min, T.numeric + T.DATE + T.TIMESTAMP + T.BOOLEAN + T.STRING)
+expr_rule(agg.Max, T.numeric + T.DATE + T.TIMESTAMP + T.BOOLEAN + T.STRING)
 expr_rule(agg.First, _common)
 expr_rule(agg.Last, _common)
 for c in (agg.StddevPop, agg.StddevSamp, agg.VariancePop, agg.VarianceSamp):
-    expr_rule(c, _num - T.DECIMAL_128)
+    expr_rule(c, _num)
 expr_rule(agg.AggregateExpression, T.all_types)
 
 # columnar native UDFs trace straight into the operator's XLA computation
@@ -531,6 +542,13 @@ def _tag_aggregate(meta: ExecMeta):
                     for r in rule.sig.reasons_not_supported(dt):
                         meta.will_not_work(
                             f"{type(fn).__name__} over unsupported input: {r}")
+                if isinstance(fn, agg.Sum) and \
+                        isinstance(dt, t.DecimalType) and not dt.is64:
+                    # the update-stage cast reads the decimal low word; a
+                    # >18-digit input would lose its high word before the
+                    # exact 128-bit buffer accumulation starts
+                    meta.will_not_work(
+                        "sum over decimal(>18) inputs runs on CPU")
             except Exception as ex:
                 meta.will_not_work(str(ex))
 
@@ -585,6 +603,9 @@ class TpuOverrides:
             return plan
         meta = ExecMeta(plan, self.conf)
         meta.tag()
+        if self.conf.get(cfg.OPTIMIZER_ENABLED):
+            from .cost import CostBasedOptimizer
+            CostBasedOptimizer(self.conf).optimize(meta)
         explain_mode = self.conf.explain
         lines = meta.explain_lines()
         self.last_explain = "\n".join(lines)
@@ -595,4 +616,6 @@ class TpuOverrides:
             if bad:
                 print("\n".join(bad))
         converted = meta.convert()
+        from ..shuffle.aqe import install_aqe_readers
+        converted = install_aqe_readers(converted, self.conf)
         return insert_transitions(converted)

@@ -1,0 +1,8 @@
+"""Offline tooling over Spark event logs (ref tools/): qualification
+(which apps benefit from acceleration) and profiling (metrics
+aggregation, health check, timeline, plan graphs).  Hardware-neutral —
+ported behavior, not code."""
+
+from .eventlog import AppInfo, parse_event_log  # noqa: F401
+from .qualification import qualify  # noqa: F401
+from .profiling import profile  # noqa: F401

@@ -1,0 +1,37 @@
+"""CLI for the offline tools (ref QualificationMain / ProfileMain):
+
+    python -m spark_rapids_tpu.tools qualification <eventlogs...> [-o DIR]
+    python -m spark_rapids_tpu.tools profiling     <eventlogs...> [-o DIR] [-c]
+"""
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="spark_rapids_tpu.tools")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    q = sub.add_parser("qualification",
+                       help="score apps for TPU acceleration benefit")
+    q.add_argument("logs", nargs="+")
+    q.add_argument("-o", "--output", default="qual_output")
+    pr = sub.add_parser("profiling", help="profile apps from event logs")
+    pr.add_argument("logs", nargs="+")
+    pr.add_argument("-o", "--output", default="profile_output")
+    pr.add_argument("-c", "--compare", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.cmd == "qualification":
+        from .qualification import format_summary, qualify
+        results = qualify(args.logs, args.output)
+        sys.stdout.write(format_summary(results))
+    else:
+        from .profiling import profile
+        reports = profile(args.logs, args.output, compare=args.compare)
+        sys.stdout.write(f"profiled {len(reports)} application(s) -> "
+                         f"{args.output}\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

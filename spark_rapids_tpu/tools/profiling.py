@@ -1,0 +1,214 @@
+"""Profiling tool: metrics aggregation, health check, comparison,
+timeline and plan-graph generation from Spark event logs.
+
+Ref: tools/.../profiling/{ProfileMain,Profiler,Analysis,
+CollectInformation,HealthCheck,CompareApplications,GenerateTimeline,
+GenerateDot}.scala.
+"""
+
+from __future__ import annotations
+
+import os
+from statistics import median
+from typing import Dict, List, Optional
+
+from .eventlog import AppInfo, PlanNode, find_event_logs, parse_event_log
+
+
+# ---------------------------------------------------------------------------
+# Analysis (ref Analysis.scala jobAndStageMetricsAggregation /
+# sqlMetricsAggregation)
+# ---------------------------------------------------------------------------
+
+def app_information(app: AppInfo) -> Dict:
+    return {
+        "appName": app.app_name, "appId": app.app_id,
+        "sparkVersion": app.spark_version,
+        "startTime": app.start_time, "endTime": app.end_time,
+        "duration": app.app_duration,
+        "durationEstimated": app.duration_estimated,
+        "numExecutors": len(app.executors),
+        "totalCores": sum(e.get("cores", 0) for e in
+                          app.executors.values()),
+    }
+
+
+def stage_aggregates(app: AppInfo) -> List[Dict]:
+    out = []
+    for (sid, attempt), st in sorted(app.stages.items()):
+        ts = [t for t in app.tasks if t.stage_id == sid]
+        durs = [t.duration for t in ts] or [0]
+        out.append({
+            "stageId": sid, "attempt": attempt, "name": st.name[:60],
+            "numTasks": st.num_tasks, "duration": st.duration,
+            "taskDurMin": min(durs), "taskDurMed": int(median(durs)),
+            "taskDurMax": max(durs),
+            "inputBytes": sum(t.input_bytes for t in ts),
+            "outputBytes": sum(t.output_bytes for t in ts),
+            "shuffleRead": sum(t.shuffle_read_bytes for t in ts),
+            "shuffleWrite": sum(t.shuffle_write_bytes for t in ts),
+            "memSpilled": sum(t.memory_spilled for t in ts),
+            "diskSpilled": sum(t.disk_spilled for t in ts),
+            "gcTime": sum(t.gc_time for t in ts),
+        })
+    return out
+
+
+def sql_aggregates(app: AppInfo) -> List[Dict]:
+    out = []
+    for sql_id, sx in sorted(app.sql_executions.items()):
+        out.append({
+            "sqlId": sql_id,
+            "description": sx.description[:80],
+            "duration": sx.duration,
+            "taskDuration": app.sql_task_duration(sql_id),
+            "failed": sx.failed,
+        })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Health check (ref HealthCheck.scala)
+# ---------------------------------------------------------------------------
+
+def health_check(app: AppInfo) -> Dict[str, List]:
+    failed_tasks = [
+        {"taskId": t.task_id, "stageId": t.stage_id,
+         "attempt": t.attempt} for t in app.tasks if t.failed]
+    failed_stages = [
+        {"stageId": sid, "attempt": at, "reason": (st.failure_reason
+                                                   or "")[:120]}
+        for (sid, at), st in sorted(app.stages.items())
+        if st.failure_reason]
+    failed_jobs = [
+        {"jobId": jid, "result": j.get("result")}
+        for jid, j in sorted(app.jobs.items())
+        if j.get("result") not in (None, "JobSucceeded")]
+    return {"failedTasks": failed_tasks, "failedStages": failed_stages,
+            "failedJobs": failed_jobs}
+
+
+# ---------------------------------------------------------------------------
+# Comparison (ref CompareApplications.scala)
+# ---------------------------------------------------------------------------
+
+def compare_apps(apps: List[AppInfo]) -> List[Dict]:
+    rows = []
+    for i, app in enumerate(apps):
+        info = app_information(app)
+        info["runIndex"] = i
+        info["sqlDuration"] = sum(s.duration
+                                  for s in app.sql_executions.values())
+        info["taskDuration"] = sum(t.run_time for t in app.tasks)
+        rows.append(info)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# Timeline (ref GenerateTimeline.scala — emits an SVG lane chart)
+# ---------------------------------------------------------------------------
+
+def generate_timeline(app: AppInfo, path: str) -> None:
+    t0 = app.start_time or min((t.launch for t in app.tasks), default=0)
+    t1 = app.end_time or max((t.finish for t in app.tasks), default=t0 + 1)
+    span = max(t1 - t0, 1)
+    width, row_h = 1000, 14
+    lanes = sorted({t.executor_id for t in app.tasks})
+    height = row_h * (len(lanes) + 2)
+    parts = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+             f'height="{height}">']
+    for li, ex in enumerate(lanes):
+        y = row_h * (li + 1)
+        parts.append(f'<text x="2" y="{y + 10}" font-size="9">exec '
+                     f'{ex}</text>')
+        for t in app.tasks:
+            if t.executor_id != ex:
+                continue
+            x = 60 + (t.launch - t0) / span * (width - 70)
+            w = max(1.0, (t.finish - t.launch) / span * (width - 70))
+            color = "#d62728" if t.failed else "#1f77b4"
+            parts.append(f'<rect x="{x:.1f}" y="{y}" width="{w:.1f}" '
+                         f'height="{row_h - 3}" fill="{color}"/>')
+    parts.append("</svg>")
+    with open(path, "w") as f:
+        f.write("\n".join(parts))
+
+
+# ---------------------------------------------------------------------------
+# Plan graph (ref GenerateDot.scala)
+# ---------------------------------------------------------------------------
+
+def generate_dot(app: AppInfo, sql_id: int, path: str) -> None:
+    sx = app.sql_executions[sql_id]
+    lines = ["digraph plan {", '  node [shape=box, fontsize=10];']
+    counter = [0]
+
+    def emit(node: PlanNode) -> int:
+        nid = counter[0]
+        counter[0] += 1
+        label = node.node_name.replace('"', "'")[:60]
+        lines.append(f'  n{nid} [label="{label}"];')
+        for c in node.children:
+            cid = emit(c)
+            lines.append(f"  n{cid} -> n{nid};")
+        return nid
+
+    emit(sx.plan)
+    lines.append("}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+
+
+# ---------------------------------------------------------------------------
+# Driver (ref Profiler.scala)
+# ---------------------------------------------------------------------------
+
+def profile(paths: List[str], output_dir: Optional[str] = None,
+            compare: bool = False) -> List[Dict]:
+    apps = []
+    for log in find_event_logs(paths):
+        try:
+            apps.append(parse_event_log(log))
+        except OSError:
+            continue
+    reports = []
+    for app in apps:
+        reports.append({
+            "application": app_information(app),
+            "stages": stage_aggregates(app),
+            "sql": sql_aggregates(app),
+            "health": health_check(app),
+        })
+    if output_dir:
+        os.makedirs(output_dir, exist_ok=True)
+        for app, rep in zip(apps, reports):
+            base = os.path.join(output_dir, app.app_id or app.app_name
+                                or "app")
+            with open(base + "_profile.txt", "w") as f:
+                f.write(format_profile(rep))
+            generate_timeline(app, base + "_timeline.svg")
+            for sql_id in app.sql_executions:
+                generate_dot(app, sql_id, f"{base}_sql{sql_id}.dot")
+        if compare and len(apps) > 1:
+            with open(os.path.join(output_dir, "compare.txt"), "w") as f:
+                for row in compare_apps(apps):
+                    f.write(f"{row}\n")
+    return reports
+
+
+def format_profile(rep: Dict) -> str:
+    lines = ["### Application Information ###"]
+    for k, v in rep["application"].items():
+        lines.append(f"{k:20s} {v}")
+    lines.append("\n### Stage Aggregates ###")
+    for srow in rep["stages"]:
+        lines.append(str(srow))
+    lines.append("\n### SQL Executions ###")
+    for srow in rep["sql"]:
+        lines.append(str(srow))
+    h = rep["health"]
+    lines.append("\n### Health Check ###")
+    lines.append(f"failed tasks:  {len(h['failedTasks'])}")
+    lines.append(f"failed stages: {len(h['failedStages'])}")
+    lines.append(f"failed jobs:   {len(h['failedJobs'])}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,196 @@
+"""Qualification tool: which CPU Spark apps would benefit from the TPU
+plugin.
+
+Ref: tools/.../qualification/{QualificationMain,Qualification,
+QualAppInfo,PluginTypeChecker}.scala — scores each app from its event
+log: how much SQL-dataframe task time runs in operators the plugin can
+accelerate, penalizing potential problems (UDFs, unsupported formats,
+nested types).  Output matches the reference's CSV shape
+(QualOutputWriter.scala headers) so downstream consumers carry over.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .eventlog import AppInfo, PlanNode, find_event_logs, parse_event_log
+
+# Spark exec nodeName fragments the TPU build accelerates (kept in sync
+# with plan/overrides.py EXEC_SIGS; the reference derives the same list
+# from supportedExecs in PluginTypeChecker)
+SUPPORTED_EXECS = {
+    "Project", "Filter", "HashAggregate", "SortAggregate",
+    "ObjectHashAggregate", "Sort", "SortMergeJoin", "ShuffledHashJoin",
+    "BroadcastHashJoin", "BroadcastNestedLoopJoin", "CartesianProduct",
+    "Exchange", "ShuffleExchange", "BroadcastExchange", "Union", "Range",
+    "Window", "Expand", "Generate", "Sample", "GlobalLimit", "LocalLimit",
+    "TakeOrderedAndProject", "CollectLimit", "Coalesce",
+    "WholeStageCodegen", "ColumnarToRow", "RowToColumnar", "Subquery",
+    "ReusedExchange", "CustomShuffleReader", "AQEShuffleRead",
+    "AdaptiveSparkPlan", "InputAdapter",
+}
+
+SUPPORTED_READ_FORMATS = {"parquet", "orc", "csv"}
+SUPPORTED_WRITE_FORMATS = {"parquet", "orc"}
+
+PROBLEM_MARKERS = {
+    "UDF": ("udf",),
+    "DECIMAL": ("decimaltype", "decimal("),
+}
+
+
+class QualAppResult:
+    def __init__(self, app: AppInfo):
+        self.app = app
+        self.sql_df_duration = 0
+        self.sql_task_duration = 0
+        self.supported_task_duration = 0
+        self.problems: Set[str] = set()
+        self.failed_sql_ids: List[int] = []
+        self.problem_duration = 0
+        self.unsupported_read_formats: Set[str] = set()
+        self.unsupported_write_formats: Set[str] = set()
+        self.complex_types: Set[str] = set()
+        self._analyze()
+
+    # ------------------------------------------------------------------
+    def _analyze(self):
+        app = self.app
+        for sx in app.sql_executions.values():
+            dur = sx.duration
+            task_dur = app.sql_task_duration(sx.sql_id)
+            self.sql_df_duration += dur
+            self.sql_task_duration += task_dur
+            if sx.failed:
+                self.failed_sql_ids.append(sx.sql_id)
+                continue
+            problems = self._plan_problems(sx.plan)
+            frac = self._supported_fraction(sx.plan)
+            self.supported_task_duration += int(task_dur * frac)
+            if problems:
+                self.problems |= problems
+                self.problem_duration += dur
+
+    def _plan_problems(self, plan: PlanNode) -> Set[str]:
+        out: Set[str] = set()
+        for node in plan.walk():
+            text = (node.node_name + " " + node.simple_string).lower()
+            for problem, markers in PROBLEM_MARKERS.items():
+                if any(m in text for m in markers):
+                    out.add(problem)
+            if "scan" in node.node_name.lower():
+                fmt = _scan_format(node)
+                if fmt and fmt not in SUPPORTED_READ_FORMATS:
+                    self.unsupported_read_formats.add(fmt.upper())
+            if "insertintohadoopfs" in text or "datawritingcommand" in text:
+                fmt = _write_format(node)
+                if fmt and fmt not in SUPPORTED_WRITE_FORMATS:
+                    self.unsupported_write_formats.add(fmt.upper())
+            for marker in ("arraytype", "maptype", "structtype"):
+                if marker in text:
+                    self.complex_types.add(marker[:-4])
+        return out
+
+    def _supported_fraction(self, plan: PlanNode) -> float:
+        total = 0
+        good = 0
+        for node in plan.walk():
+            total += 1
+            base = node.node_name.split("(")[0].strip()
+            if any(base.startswith(s) or s in base
+                   for s in SUPPORTED_EXECS):
+                good += 1
+            elif "scan" in base.lower():
+                fmt = _scan_format(node)
+                if fmt in SUPPORTED_READ_FORMATS:
+                    good += 1
+        return good / total if total else 0.0
+
+    # ------------------------------------------------------------------
+    @property
+    def score(self) -> float:
+        """The reference's qualification score: supported SQL task time,
+        discounted when reads are unsupported (QualAppInfo score calc)."""
+        score = float(self.supported_task_duration)
+        if self.unsupported_read_formats:
+            score *= 0.8
+        if "UDF" in self.problems:
+            score *= 0.8
+        return round(score, 2)
+
+    def row(self) -> List:
+        app = self.app
+        return [
+            app.app_name, app.app_id, f"{self.score:.2f}",
+            ";".join(sorted(self.problems)),
+            self.sql_df_duration, self.sql_task_duration,
+            app.app_duration, app.executor_cpu_percent(),
+            str(app.duration_estimated).lower(),
+            self.problem_duration,
+            ";".join(str(i) for i in sorted(self.failed_sql_ids)),
+            ";".join(sorted(self.unsupported_read_formats)),
+            ";".join(sorted(self.unsupported_write_formats)),
+            ";".join(sorted(self.complex_types)),
+        ]
+
+
+HEADERS = ["App Name", "App ID", "Score", "Potential Problems",
+           "SQL Dataframe Duration", "SQL Dataframe Task Duration",
+           "App Duration", "Executor CPU Time Percent",
+           "App Duration Estimated", "SQL Duration with Potential Problems",
+           "SQL Ids with Failures", "Unsupported Read File Formats and Types",
+           "Unsupported Write Data Format", "Complex Types"]
+
+
+def _scan_format(node: PlanNode) -> Optional[str]:
+    text = node.simple_string.lower() + " " + node.node_name.lower()
+    for fmt in ("parquet", "orc", "csv", "json", "avro", "text", "jdbc"):
+        if fmt in text:
+            return fmt
+    return None
+
+
+def _write_format(node: PlanNode) -> Optional[str]:
+    return _scan_format(node)
+
+
+def qualify(paths: List[str], output_dir: Optional[str] = None
+            ) -> List[QualAppResult]:
+    """Run qualification over event logs; returns results sorted by score
+    descending and optionally writes the CSV + summary."""
+    results = []
+    for log in find_event_logs(paths):
+        try:
+            app = parse_event_log(log)
+        except OSError:
+            continue
+        if app.app_name or app.sql_executions:
+            results.append(QualAppResult(app))
+    results.sort(key=lambda r: r.score, reverse=True)
+    if output_dir:
+        os.makedirs(output_dir, exist_ok=True)
+        out_csv = os.path.join(output_dir,
+                               "spark_rapids_tpu_qualification_output.csv")
+        with open(out_csv, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(HEADERS)
+            for r in results:
+                w.writerow(r.row())
+        with open(os.path.join(
+                output_dir,
+                "spark_rapids_tpu_qualification_output.log"), "w") as f:
+            f.write(format_summary(results))
+    return results
+
+
+def format_summary(results: List[QualAppResult]) -> str:
+    lines = ["=" * 72,
+             f"Qualified {len(results)} application(s), best first:",
+             "=" * 72]
+    for r in results:
+        lines.append(f"{r.app.app_name:40s} {r.app.app_id:24s} "
+                     f"score={r.score:>12.2f} "
+                     f"sqlDur={r.sql_df_duration}ms")
+    return "\n".join(lines) + "\n"

@@ -547,6 +547,11 @@ class TpuTypeSigs:
     integral = BYTE + SHORT + INT + LONG
     gpu_numeric = integral + FLOAT + DOUBLE + DECIMAL_128
     numeric = gpu_numeric
+    # expression kernels operate on the decimal low word only, so general
+    # expressions are gated to 64-bit decimals (the reference is
+    # decimal64-only, RapidsConf.scala:565); aggregation buffers may be
+    # 128-bit (exact segment_sum128)
+    numeric64 = integral + FLOAT + DOUBLE + DECIMAL_64
     comparable = numeric + BOOLEAN + DATE + TIMESTAMP + STRING + NULL
     common_scalar = (numeric + BOOLEAN + DATE + TIMESTAMP + STRING + NULL)
     orderable = common_scalar

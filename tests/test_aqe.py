@@ -1,0 +1,118 @@
+"""Adaptive query execution tests: partition coalescing + skew split
+(model: the reference's AdaptiveQueryExecSuite)."""
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.shuffle.aqe import (AQEShuffleReadExec, coalesce_specs,
+                                          skew_split_specs)
+
+
+def _session(**extra):
+    b = TpuSession.builder().config("spark.rapids.sql.enabled", True)
+    for k, v in extra.items():
+        b = b.config(k, v)
+    return b.get_or_create()
+
+
+def test_coalesce_specs_groups_adjacent():
+    specs = coalesce_specs([10, 10, 10, 100, 5, 5], target=30)
+    groups = [s.reduce_ids for s in specs]
+    assert groups == [[0, 1, 2], [3], [4, 5]]
+
+
+def test_coalesce_specs_huge_partition_alone():
+    specs = coalesce_specs([500, 1, 1], target=100)
+    assert [s.reduce_ids for s in specs] == [[0], [1, 2]]
+
+
+def test_skew_split_detects_and_chunks():
+    sizes = [1000_000, 10, 10, 10]
+    n_blocks = [8, 2, 2, 2]
+    specs = skew_split_specs(sizes, n_blocks, factor=5.0, threshold=100,
+                             target=250_000)
+    assert specs is not None
+    skewed = [s for s in specs if s.block_slice is not None]
+    assert len(skewed) >= 2  # partition 0 split into chunks
+    covered = []
+    for s in skewed:
+        assert s.reduce_ids == [0]
+        covered += list(range(*s.block_slice))
+    assert covered == list(range(8))  # all blocks exactly once
+    assert [s for s in specs if s.reduce_ids != [0]] and \
+        all(s.block_slice is None for s in specs if s.reduce_ids != [0])
+
+
+def test_skew_split_none_when_uniform():
+    assert skew_split_specs([10, 11, 12], [2, 2, 2], 5.0, 100, 50) is None
+
+
+def _placements(session):
+    out = []
+    session.last_plan.foreach(
+        lambda e: out.append(type(e).__name__))
+    return out
+
+
+def test_aqe_coalesces_small_agg_partitions():
+    s = _session(**{"spark.sql.adaptive.advisoryPartitionSizeInBytes":
+                    "1g"})
+    rng = np.random.default_rng(0)
+    n = 5000
+    tb = pa.table({"k": pa.array(rng.integers(0, 64, n).astype(np.int64)),
+                   "v": pa.array(rng.random(n))})
+    df = s.create_dataframe(tb, num_partitions=6)
+    got = (df.group_by(col("k")).agg(F.sum(col("v")).alias("sv"))
+           .collect().sort_by("k"))
+    assert "AQEShuffleReadExec" in _placements(s)
+    want = pa.TableGroupBy(tb, ["k"], use_threads=False).aggregate(
+        [("v", "sum")]).sort_by("k")
+    assert got.column("k").to_pylist() == want.column("k").to_pylist()
+    np.testing.assert_allclose(np.array(got.column("sv")),
+                               np.array(want.column("v_sum")), rtol=1e-9)
+    # with a 1g target everything coalesces into one read partition
+    reads = []
+    s.last_plan.foreach(lambda e: reads.append(e)
+                        if isinstance(e, AQEShuffleReadExec) else None)
+    assert reads and all(r.num_partitions == 1 for r in reads)
+
+
+def test_aqe_join_correct_with_skew():
+    s = _session(**{
+        "spark.sql.adaptive.skewJoin.skewedPartitionThresholdInBytes": "1k",
+        "spark.sql.adaptive.advisoryPartitionSizeInBytes": "4k",
+    })
+    rng = np.random.default_rng(1)
+    # heavily skewed: 80% of left rows share one key
+    n = 8000
+    keys = np.where(rng.random(n) < 0.8, 7,
+                    rng.integers(0, 50, n)).astype(np.int64)
+    left = pa.table({"k": pa.array(keys),
+                     "v": pa.array(rng.integers(0, 100, n).astype(np.int64))})
+    right = pa.table({"k": pa.array(np.arange(50, dtype=np.int64)),
+                      "w": pa.array(np.arange(50, dtype=np.int64) * 10)})
+    ldf = s.create_dataframe(left, num_partitions=8)
+    rdf = s.create_dataframe(right, num_partitions=8)
+    got = ldf.join(rdf, on="k", how="inner").collect()
+    assert got.num_rows == n  # every left row matches exactly one right row
+    sums = pa.TableGroupBy(got, ["k"], use_threads=False).aggregate(
+        [("w", "count")]).sort_by("k")
+    # key 7 kept all its rows through the split
+    idx = sums.column("k").to_pylist().index(7)
+    assert sums.column("w_count").to_pylist()[idx] == int((keys == 7).sum())
+
+
+def test_aqe_disabled_still_correct():
+    s = _session(**{"spark.sql.adaptive.enabled": False})
+    rng = np.random.default_rng(2)
+    n = 2000
+    tb = pa.table({"k": pa.array(rng.integers(0, 16, n).astype(np.int64)),
+                   "v": pa.array(rng.random(n))})
+    df = s.create_dataframe(tb, num_partitions=4)
+    got = (df.group_by(col("k")).agg(F.count("*").alias("c"))
+           .collect())
+    assert "AQEShuffleReadExec" not in _placements(s)
+    assert sum(got.column("c").to_pylist()) == n

@@ -1,0 +1,173 @@
+"""Decimal tests (ref decimalExpressions.scala + DECIMAL_TYPE_ENABLED
+RapidsConf.scala:565 — the reference is decimal64-backed; this build adds
+exact 128-bit aggregation buffers on top)."""
+
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col, lit
+from spark_rapids_tpu.api.session import TpuSession
+
+D = decimal.Decimal
+
+
+def _session(enabled=True):
+    return TpuSession.builder().config("spark.rapids.sql.enabled",
+                                       enabled).get_or_create()
+
+
+def _dec_table(n=400, precision=12, scale=2, seed=0, null_every=7):
+    rng = np.random.default_rng(seed)
+    lim = 10 ** (precision - scale) - 1
+    vals = [None if i % null_every == 0 else
+            D(int(rng.integers(-lim, lim))).scaleb(-scale) +
+            D(int(rng.integers(0, 10 ** scale))).scaleb(-scale)
+            for i in range(n)]
+    return pa.table({
+        "k": pa.array((rng.integers(0, 20, n)).astype(np.int64)),
+        "d": pa.array(vals, type=pa.decimal128(precision, scale)),
+    })
+
+
+def _placements(s):
+    out = []
+    s.last_plan.foreach(lambda e: out.append((type(e).__name__, e.placement)))
+    return out
+
+
+def test_decimal_project_filter_roundtrip():
+    s = _session()
+    tb = _dec_table()
+    df = s.create_dataframe(tb)
+    out = df.select(col("k"), (col("d") + col("d")).alias("dd"),
+                    (col("d") * lit(2)).alias("d2")) \
+        .filter(col("k") >= 0).collect()
+    want = [None if v is None else v * 2 for v in
+            tb.column("d").to_pylist()]
+    assert out.column("dd").to_pylist() == want
+    assert any(p == "tpu" for _, p in _placements(s))
+
+
+def test_decimal_sum_exact_beyond_64_bits():
+    s = _session()
+    n = 3000
+    tb = pa.table({"k": pa.array([1] * n),
+                   "d": pa.array([D("9999999999999999.99")] * n,
+                                 type=pa.decimal128(18, 2))})
+    out = s.create_dataframe(tb).group_by(col("k")).agg(
+        F.sum(col("d")).alias("sd")).collect()
+    assert out.column("sd").to_pylist() == [D("9999999999999999.99") * n]
+    assert ("TpuHashAggregateExec", "tpu") in _placements(s)
+
+
+def test_decimal_group_agg_differential():
+    s = _session()
+    tb = _dec_table(600)
+    out = (s.create_dataframe(tb).group_by(col("k"))
+           .agg(F.sum(col("d")).alias("sd"),
+                F.min(col("d")).alias("mn"),
+                F.max(col("d")).alias("mx"),
+                F.count(col("d")).alias("c"))
+           .collect().sort_by("k"))
+    want = pa.TableGroupBy(tb, ["k"], use_threads=False).aggregate(
+        [("d", "sum"), ("d", "min"), ("d", "max"), ("d", "count")]
+    ).sort_by("k")
+    assert out.column("k").to_pylist() == want.column("k").to_pylist()
+    assert out.column("sd").to_pylist() == want.column("d_sum").to_pylist()
+    assert out.column("mn").to_pylist() == want.column("d_min").to_pylist()
+    assert out.column("mx").to_pylist() == want.column("d_max").to_pylist()
+    assert out.column("c").to_pylist() == want.column("d_count").to_pylist()
+
+
+def test_decimal_sort():
+    s = _session()
+    tb = _dec_table(300, null_every=11)
+    out = s.create_dataframe(tb).sort(col("d")).collect()
+    vals = [v for v in out.column("d").to_pylist() if v is not None]
+    assert vals == sorted(vals)
+
+
+def test_decimal_group_keys_and_shuffle():
+    s = _session()
+    vals = [D("1.50"), D("-2.25"), D("1.50"), None, D("-2.25"), D("1.50")]
+    tb = pa.table({"d": pa.array(vals * 50, type=pa.decimal128(10, 2)),
+                   "v": pa.array(list(range(300)), type=pa.int64())})
+    out = (s.create_dataframe(tb, num_partitions=4)
+           .group_by(col("d")).agg(F.count("*").alias("c"))
+           .collect())
+    got = dict(zip(out.column("d").to_pylist(), out.column("c").to_pylist()))
+    assert got == {D("1.50"): 150, D("-2.25"): 100, None: 50}
+
+
+def test_decimal128_expressions_fall_back_to_cpu():
+    s = _session()
+    tb = pa.table({"d": pa.array([D("123456789012345678901.23")],
+                                 type=pa.decimal128(30, 2))})
+    df = s.create_dataframe(tb)
+    out = df.select((col("d") + col("d")).alias("dd")).collect()
+    assert out.column("dd").to_pylist() == [D("246913578024691357802.46")]
+    # the projection must NOT have claimed the TPU
+    assert not any(n == "ProjectExec" and p == "tpu"
+                   for n, p in _placements(s))
+
+
+def test_decimal128_min_max_on_tpu():
+    s = _session()
+    big = [D("123456789012345678901.23"), D("-99999999999999999999.99"),
+           None, D("5.00")]
+    tb = pa.table({"k": pa.array([1, 1, 1, 1]),
+                   "d": pa.array(big, type=pa.decimal128(30, 2))})
+    out = s.create_dataframe(tb).group_by(col("k")).agg(
+        F.min(col("d")).alias("mn"), F.max(col("d")).alias("mx")).collect()
+    assert out.column("mn").to_pylist() == [D("-99999999999999999999.99")]
+    assert out.column("mx").to_pylist() == [D("123456789012345678901.23")]
+    assert ("TpuHashAggregateExec", "tpu") in _placements(s)
+
+
+def test_decimal_cast_to_double_and_string():
+    s = _session()
+    tb = pa.table({"d": pa.array([D("12.34"), None, D("-0.05")],
+                                 type=pa.decimal128(10, 2))})
+    df = s.create_dataframe(tb)
+    out = df.select(col("d").cast("double").alias("f"),
+                    col("d").cast("string").alias("s")).collect()
+    assert out.column("f").to_pylist() == [12.34, None, -0.05]
+    assert out.column("s").to_pylist() == ["12.34", None, "-0.05"]
+
+
+def test_decimal_cast_scale_up_to_128_exact():
+    """Regression: scale-up into a >18-digit target used to wrap in int64
+    on both engines."""
+    s = _session()
+    tb = pa.table({"d": pa.array([D("999999999999999999"), None],
+                                 type=pa.decimal128(18, 0))})
+    df = s.create_dataframe(tb)
+    out = df.select(col("d").cast(pa.decimal128(38, 5)).alias("x"),
+                    col("d").cast(pa.decimal128(38, 20)).alias("y")).collect()
+    assert out.column("x").to_pylist() == [D("999999999999999999.00000"),
+                                           None]
+    assert out.column("y").to_pylist() == [D("999999999999999999"), None]
+
+
+def test_decimal128_literal_exact_on_cpu_fallback():
+    s = _session()
+    df = s.create_dataframe(pa.table({"x": pa.array([1])}))
+    big = D("12345678901234567890123.45")
+    out = df.select(lit(big).alias("L")).collect()
+    assert out.column("L").to_pylist() == [big]
+
+
+def test_decimal_mul_into_128_exact():
+    s = _session()
+    tb = pa.table({"a": pa.array([D("123456789012.34")],
+                                 type=pa.decimal128(14, 2)),
+                   "b": pa.array([D("987654321098.76")],
+                                 type=pa.decimal128(14, 2))})
+    out = s.create_dataframe(tb).select(
+        (col("a") * col("b")).alias("p")).collect()
+    assert out.column("p").to_pylist() == [
+        D("123456789012.34") * D("987654321098.76")]

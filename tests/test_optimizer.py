@@ -1,0 +1,83 @@
+"""Cost-based optimizer tests (ref CostBasedOptimizerSuite)."""
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col
+from spark_rapids_tpu.api.session import TpuSession
+
+
+def _session(**extra):
+    b = TpuSession.builder().config("spark.rapids.sql.enabled", True)
+    for k, v in extra.items():
+        b = b.config(k.replace("_", "."), v)
+    return b.get_or_create()
+
+
+def _table(n=1000):
+    rng = np.random.default_rng(0)
+    return pa.table({
+        "k": pa.array(rng.integers(0, 10, n).astype(np.int64)),
+        "v": pa.array(rng.random(n)),
+    })
+
+
+def _placements(session):
+    out = []
+    session.last_plan.foreach(
+        lambda e: out.append((type(e).__name__, e.placement)))
+    return out
+
+
+def test_cbo_disabled_by_default_keeps_tpu_plan():
+    s = _session()
+    df = s.create_dataframe(_table())
+    got = df.filter(col("v") > 0.5).group_by(col("k")).agg(
+        F.count("*").alias("c")).collect()
+    assert got.num_rows == 10
+    assert any(p == "tpu" for _, p in _placements(s))
+
+
+def test_cbo_forces_cpu_when_tpu_cost_inflated():
+    s = _session(**{
+        "spark.rapids.sql.optimizer.enabled": True,
+        # make every TPU op absurdly expensive: the DP must keep the
+        # whole plan on CPU
+        "spark.rapids.sql.optimizer.tpu.exec.LocalScanExec": 1e9,
+        "spark.rapids.sql.optimizer.tpu.exec.FilterExec": 1e9,
+        "spark.rapids.sql.optimizer.tpu.exec.ProjectExec": 1e9,
+        "spark.rapids.sql.optimizer.tpu.exec.CpuHashAggregateExec": 1e9,
+    })
+    df = s.create_dataframe(_table())
+    got = df.filter(col("v") > 0.5).group_by(col("k")).agg(
+        F.count("*").alias("c")).collect()
+    assert got.num_rows == 10
+    assert all(p == "cpu" for _, p in _placements(s))
+
+
+def test_cbo_enabled_default_costs_keeps_tpu():
+    s = _session(**{"spark.rapids.sql.optimizer.enabled": True})
+    df = s.create_dataframe(_table())
+    got = df.filter(col("v") > 0.5).group_by(col("k")).agg(
+        F.count("*").alias("c")).collect()
+    assert got.num_rows == 10
+    # with default costs (TPU 4x cheaper/row) acceleration stays on
+    assert any(p == "tpu" for _, p in _placements(s))
+
+
+def test_cbo_results_identical_either_way():
+    base = None
+    for enabled in (False, True):
+        s = _session(**{"spark.rapids.sql.optimizer.enabled": enabled})
+        df = s.create_dataframe(_table(500))
+        got = (df.filter(col("v") > 0.25)
+               .group_by(col("k"))
+               .agg(F.sum(col("v")).alias("sv"))
+               .collect().sort_by("k"))
+        if base is None:
+            base = got
+        else:
+            assert got.column("k").to_pylist() == base.column("k").to_pylist()
+            np.testing.assert_allclose(np.array(got.column("sv")),
+                                       np.array(base.column("sv")))

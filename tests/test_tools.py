@@ -1,0 +1,184 @@
+"""Qualification & profiling tool tests over synthetic Spark event logs
+(model: the reference's QualificationSuite/ApplicationInfoSuite with
+golden CSV expectations)."""
+
+import csv
+import gzip
+import json
+import os
+
+import pytest
+
+from spark_rapids_tpu.tools.eventlog import parse_event_log
+from spark_rapids_tpu.tools.profiling import (app_information, compare_apps,
+                                              generate_dot,
+                                              generate_timeline,
+                                              health_check, profile,
+                                              stage_aggregates)
+from spark_rapids_tpu.tools.qualification import qualify
+
+
+def _mk_log(path, app_id="app-001", app_name="TestApp", with_udf=False,
+            fail_stage=False, fmt="parquet", gz=False):
+    plan = {
+        "nodeName": "WholeStageCodegen",
+        "simpleString": "WholeStageCodegen",
+        "children": [
+            {"nodeName": "HashAggregate",
+             "simpleString": "HashAggregate(keys=[k], functions=[sum(v)])",
+             "children": [
+                 {"nodeName": "Project",
+                  "simpleString": ("Project [myudf(v) AS u]" if with_udf
+                                   else "Project [v]"),
+                  "children": [
+                      {"nodeName": f"Scan {fmt}",
+                       "simpleString": f"FileScan {fmt} [k,v]",
+                       "children": [], "metrics": []}],
+                  "metrics": []}],
+             "metrics": []}],
+        "metrics": [],
+    }
+    events = [
+        {"Event": "SparkListenerLogStart", "Spark Version": "3.1.1"},
+        {"Event": "SparkListenerApplicationStart", "App Name": app_name,
+         "App ID": app_id, "Timestamp": 1000},
+        {"Event": "SparkListenerExecutorAdded", "Executor ID": "1",
+         "Timestamp": 1100,
+         "Executor Info": {"Host": "h1", "Total Cores": 8}},
+        {"Event":
+         "org.apache.spark.sql.execution.ui."
+         "SparkListenerSQLExecutionStart",
+         "executionId": 0, "description": "select sum(v) group by k",
+         "time": 1500, "sparkPlanInfo": plan},
+        {"Event": "SparkListenerJobStart", "Job ID": 0,
+         "Submission Time": 1600,
+         "Stage Infos": [{"Stage ID": 0, "Stage Attempt ID": 0,
+                          "Stage Name": "stage0", "Number of Tasks": 2}],
+         "Properties": {"spark.sql.execution.id": "0"}},
+        {"Event": "SparkListenerStageSubmitted",
+         "Stage Info": {"Stage ID": 0, "Stage Attempt ID": 0,
+                        "Stage Name": "stage0", "Number of Tasks": 2,
+                        "Submission Time": 1700}},
+    ]
+    for tid in (0, 1):
+        events.append({
+            "Event": "SparkListenerTaskEnd", "Stage ID": 0,
+            "Task Info": {"Task ID": tid, "Attempt": 0, "Launch Time": 1800,
+                          "Finish Time": 2800, "Failed": False,
+                          "Executor ID": "1"},
+            "Task Metrics": {"Executor Run Time": 900,
+                             "Executor CPU Time": 600_000_000,
+                             "JVM GC Time": 10,
+                             "Input Metrics": {"Bytes Read": 1 << 20},
+                             "Memory Bytes Spilled": 0,
+                             "Disk Bytes Spilled": 0}})
+    stage_done = {"Event": "SparkListenerStageCompleted",
+                  "Stage Info": {"Stage ID": 0, "Stage Attempt ID": 0,
+                                 "Stage Name": "stage0",
+                                 "Number of Tasks": 2,
+                                 "Submission Time": 1700,
+                                 "Completion Time": 2900}}
+    if fail_stage:
+        stage_done["Stage Info"]["Failure Reason"] = "boom"
+    events += [
+        stage_done,
+        {"Event": "SparkListenerJobEnd", "Job ID": 0,
+         "Completion Time": 3000,
+         "Job Result": {"Result": "JobSucceeded"}},
+        {"Event":
+         "org.apache.spark.sql.execution.ui.SparkListenerSQLExecutionEnd",
+         "executionId": 0, "time": 3100},
+        {"Event": "SparkListenerApplicationEnd", "Timestamp": 4000},
+    ]
+    opener = gzip.open if gz else open
+    with opener(path, "wt") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+    return path
+
+
+def test_parse_event_log(tmp_path):
+    log = _mk_log(str(tmp_path / "app1"))
+    app = parse_event_log(log)
+    assert app.app_id == "app-001"
+    assert app.app_duration == 3000
+    assert len(app.tasks) == 2
+    assert app.sql_executions[0].duration == 1600
+    assert app.sql_task_duration(0) == 1800
+    assert app.executors["1"]["cores"] == 8
+
+
+def test_parse_gzip_log(tmp_path):
+    log = _mk_log(str(tmp_path / "app1.gz"), gz=True)
+    app = parse_event_log(log)
+    assert app.app_id == "app-001"
+
+
+def test_qualification_scores_and_csv(tmp_path):
+    good = _mk_log(str(tmp_path / "good"), app_id="app-good")
+    udf = _mk_log(str(tmp_path / "udf"), app_id="app-udf", with_udf=True)
+    json_scan = _mk_log(str(tmp_path / "jsonscan"), app_id="app-json",
+                        fmt="json")
+    outdir = str(tmp_path / "out")
+    results = qualify([good, udf, json_scan], outdir)
+    by_id = {r.app.app_id: r for r in results}
+    assert "UDF" in by_id["app-udf"].problems
+    assert by_id["app-good"].problems == set()
+    assert by_id["app-json"].unsupported_read_formats == {"JSON"}
+    # UDF and bad-read apps score below the clean app
+    assert by_id["app-good"].score > by_id["app-udf"].score
+    assert by_id["app-good"].score > by_id["app-json"].score
+    csv_path = os.path.join(outdir,
+                            "spark_rapids_tpu_qualification_output.csv")
+    with open(csv_path) as f:
+        rows = list(csv.reader(f))
+    assert rows[0][0] == "App Name"
+    assert len(rows) == 4
+    # sorted by score: first data row is the clean app
+    assert rows[1][1] == "app-good"
+
+
+def test_profiling_report_and_health(tmp_path):
+    ok = _mk_log(str(tmp_path / "ok"), app_id="app-ok")
+    bad = _mk_log(str(tmp_path / "bad"), app_id="app-bad", fail_stage=True)
+    outdir = str(tmp_path / "prof")
+    reports = profile([ok, bad], outdir, compare=True)
+    assert len(reports) == 2
+    rep_ok = [r for r in reports
+              if r["application"]["appId"] == "app-ok"][0]
+    rep_bad = [r for r in reports
+               if r["application"]["appId"] == "app-bad"][0]
+    assert rep_ok["health"]["failedStages"] == []
+    assert rep_bad["health"]["failedStages"][0]["reason"] == "boom"
+    assert rep_ok["stages"][0]["numTasks"] == 2
+    assert rep_ok["sql"][0]["taskDuration"] == 1800
+    assert os.path.exists(os.path.join(outdir, "app-ok_profile.txt"))
+    assert os.path.exists(os.path.join(outdir, "app-ok_timeline.svg"))
+    assert os.path.exists(os.path.join(outdir, "app-ok_sql0.dot"))
+    assert os.path.exists(os.path.join(outdir, "compare.txt"))
+
+
+def test_generate_dot_structure(tmp_path):
+    log = _mk_log(str(tmp_path / "app"))
+    app = parse_event_log(log)
+    out = str(tmp_path / "plan.dot")
+    generate_dot(app, 0, out)
+    text = open(out).read()
+    assert "digraph plan" in text
+    assert "HashAggregate" in text and "->" in text
+
+
+def test_cli_qualification(tmp_path, capsys):
+    from spark_rapids_tpu.tools.__main__ import main
+    log = _mk_log(str(tmp_path / "app"))
+    rc = main(["qualification", log, "-o", str(tmp_path / "o")])
+    assert rc == 0
+    assert "Qualified 1 application" in capsys.readouterr().out
+
+
+def test_compare_apps(tmp_path):
+    a = parse_event_log(_mk_log(str(tmp_path / "a"), app_id="a1"))
+    b = parse_event_log(_mk_log(str(tmp_path / "b"), app_id="b1"))
+    rows = compare_apps([a, b])
+    assert [r["appId"] for r in rows] == ["a1", "b1"]
+    assert all(r["taskDuration"] == 1800 for r in rows)
