@@ -143,6 +143,23 @@ class Column:
         from ..expr.strings import Contains
         return Column(Contains(self.expr, _expr(s)))
 
+    def rlike(self, pattern: str):
+        from ..expr.regex import RLike
+        return Column(RLike(self.expr, Literal(pattern)))
+
+    def getItem(self, key):
+        from ..expr.complextype import GetArrayItem, GetStructField
+        if isinstance(key, str):
+            return Column(GetStructField(self.expr, key))
+        return Column(GetArrayItem(self.expr, _expr(key)))
+
+    def getField(self, name: str):
+        from ..expr.complextype import GetStructField
+        return Column(GetStructField(self.expr, name))
+
+    def __getitem__(self, key):
+        return self.getItem(key)
+
     def startswith(self, s):
         from ..expr.strings import StartsWith
         return Column(StartsWith(self.expr, _expr(s)))

@@ -210,6 +210,30 @@ class DataFrame:
     def select_expr_window(self, *window_exprs) -> "DataFrame":
         return DataFrame(L.Window(list(window_exprs), self._lp), self.session)
 
+    # -- caching ------------------------------------------------------------
+    def cache(self) -> "DataFrame":
+        """Mark for parquet-cached-batch materialization on the next
+        action (ref ParquetCachedBatchSerializer; gated by shim like the
+        reference's 3.1.1+ support)."""
+        shim = getattr(self.session, "shim", None)
+        if shim is not None and not shim.cached_batch_serializer_supported():
+            return self  # dialect too old: cache() is a no-op recompute
+        from ..io.cached_batch import CacheManager
+        CacheManager.cache(self._lp)
+        return self
+
+    persist = cache
+
+    def unpersist(self) -> "DataFrame":
+        from ..io.cached_batch import CacheManager
+        CacheManager.uncache(self._lp)
+        return self
+
+    @property
+    def is_cached(self) -> bool:
+        from ..io.cached_batch import CacheManager
+        return CacheManager.lookup(self._lp) is not None
+
     # -- actions ------------------------------------------------------------
     def collect(self) -> pa.Table:
         return self.session.execute(self._lp)

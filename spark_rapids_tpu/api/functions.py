@@ -340,3 +340,80 @@ def native_udf(impl, *cols) -> Column:
     """Apply a TpuUDF (columnar native UDF, ref RapidsUDF.java) to columns."""
     from ..udf.native import NativeUDFExpression
     return _c(NativeUDFExpression(impl, [_expr(c) for c in cols]))
+
+
+# -- complex types / higher-order functions ---------------------------------
+
+_LAMBDA_COUNTER = [0]
+
+
+def _make_lambda(fn) -> "Expression":
+    """Python callable -> LambdaFunction (pyspark-style F.transform API)."""
+    import inspect
+    from ..expr.higher_order import LambdaFunction, NamedLambdaVariable
+    n_args = len(inspect.signature(fn).parameters)
+    _LAMBDA_COUNTER[0] += 1
+    names = [f"x_{_LAMBDA_COUNTER[0]}", f"i_{_LAMBDA_COUNTER[0]}"][:n_args]
+    vars_ = [NamedLambdaVariable(n) for n in names]
+    body = fn(*[Column(v) for v in vars_])
+    return LambdaFunction(body.expr, vars_)
+
+
+def transform(c, fn) -> Column:
+    from ..expr.higher_order import ArrayTransform
+    return _c(ArrayTransform(_expr(c), _make_lambda(fn)))
+
+
+def filter(c, fn) -> Column:  # noqa: A001
+    from ..expr.higher_order import ArrayFilter
+    return _c(ArrayFilter(_expr(c), _make_lambda(fn)))
+
+
+def exists(c, fn) -> Column:
+    from ..expr.higher_order import ArrayExists
+    return _c(ArrayExists(_expr(c), _make_lambda(fn)))
+
+
+def forall(c, fn) -> Column:
+    from ..expr.higher_order import ArrayForAll
+    return _c(ArrayForAll(_expr(c), _make_lambda(fn)))
+
+
+def element_at(c, index) -> Column:
+    from ..expr.complextype import ElementAt
+    from ..expr.core import Literal
+    return _c(ElementAt(_expr(c), _expr(index)))
+
+
+def array(*cols) -> Column:
+    from ..expr.complextype import CreateArray
+    return _c(CreateArray([_expr(c) for c in cols]))
+
+
+def struct(*cols) -> Column:
+    from ..expr.complextype import CreateNamedStruct
+    from ..expr.core import output_name
+    exprs = [_expr(c) for c in cols]
+    names = [output_name(e) for e in exprs]
+    return _c(CreateNamedStruct(names, exprs))
+
+
+# -- regex ------------------------------------------------------------------
+
+def regexp_extract(c, pattern: str, idx: int = 1) -> Column:
+    from ..expr.core import Literal
+    from ..expr.regex import RegExpExtract
+    return _c(RegExpExtract(_expr(c), Literal(pattern), Literal(idx)))
+
+
+def regexp_replace(c, pattern: str, replacement: str) -> Column:
+    from ..expr.core import Literal
+    from ..expr.regex import RegExpReplace
+    return _c(RegExpReplace(_expr(c), Literal(pattern),
+                            Literal(replacement)))
+
+
+def split(c, pattern: str, limit: int = -1) -> Column:
+    from ..expr.core import Literal
+    from ..expr.regex import StringSplit
+    return _c(StringSplit(_expr(c), Literal(pattern), Literal(limit)))

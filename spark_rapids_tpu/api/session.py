@@ -32,6 +32,8 @@ class TpuSession:
 
     def __init__(self, conf: Optional[Dict] = None):
         self._conf_map = dict(conf or {})
+        self.last_plan = None
+        self.last_explain = ""
         self._init_runtime()
         TpuSession._active = self
 
@@ -39,15 +41,27 @@ class TpuSession:
         conf = self.conf
         from ..memory.meta import set_default_codec
         set_default_codec(conf.get(cfg.SHUFFLE_COMPRESSION_CODEC))
+        from ..shims import ShimLoader
+        self.shim = ShimLoader.get_shim(
+            conf.raw("spark.rapids.tpu.sparkVersion", "3.2.0"))
+        from ..exec.base import set_trace_annotations
+        set_trace_annotations(conf.get(cfg.PROFILE_TRACE_ANNOTATIONS))
         if conf.get(cfg.BACKEND) == "tpu" and conf.sql_enabled:
-            from ..memory.device import DeviceManager
-            from ..memory.semaphore import TpuSemaphore
-            from ..memory.spill import SpillCatalog
-            self.device_manager = DeviceManager.initialize(conf)
-            self.semaphore = TpuSemaphore.initialize(
-                conf.get(cfg.CONCURRENT_TPU_TASKS))
-            self.spill_catalog = SpillCatalog.init_from_conf(conf)
+            # in-process both-sides bootstrap (ref Plugin.scala: driver +
+            # executor plugins; one process hosts both roles here)
+            from ..plugin import TpuDriverPlugin, TpuExecutorPlugin
+            self.driver_plugin = TpuDriverPlugin(self._conf_map)
+            self.driver_plugin.init()
+            self.executor_plugin = TpuExecutorPlugin(
+                self._conf_map, driver=self.driver_plugin)
+            self.executor_plugin.init()
+            self.shim = self.executor_plugin.shim  # one source of truth
+            self.device_manager = self.executor_plugin.device_manager
+            self.semaphore = self.executor_plugin.semaphore
+            self.spill_catalog = self.executor_plugin.spill_catalog
         else:
+            self.driver_plugin = None
+            self.executor_plugin = None
             self.device_manager = None
             self.semaphore = None
             self.spill_catalog = None
@@ -104,6 +118,8 @@ class TpuSession:
         final_plan = overrides.apply(physical)
         self.last_plan = final_plan
         self.last_explain = overrides.last_explain
+        from ..plugin import ExecutionPlanCaptureCallback
+        ExecutionPlanCaptureCallback.on_plan(final_plan)
         ctx = ExecContext(self.conf)
         try:
             return final_plan.execute_collect(ctx)
@@ -138,3 +154,13 @@ class _Builder:
 
     def get_or_create(self) -> TpuSession:
         return TpuSession(self._conf)
+
+
+def last_query_metrics(session: TpuSession, level: str = None):
+    """(operator, metric, value) rows from the last executed plan at the
+    configured verbosity (ref GpuMetric levels feeding the SQL UI)."""
+    from ..exec.base import metrics_report
+    lvl = level or session.conf.get(cfg.METRICS_LEVEL)
+    if session.last_plan is None:
+        return []
+    return metrics_report(session.last_plan, lvl)

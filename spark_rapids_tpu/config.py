@@ -363,6 +363,13 @@ OPTIMIZER_EXPLAIN = conf("spark.rapids.sql.optimizer.explain").string() \
 
 # --- metrics / test hooks -------------------------------------------------
 
+PROFILE_TRACE_ANNOTATIONS = conf(
+    "spark.rapids.sql.profile.traceAnnotations").boolean() \
+    .doc("Wrap timed operator work in jax.profiler TraceAnnotation ranges "
+         "so device kernels correlate with operators in the TensorBoard "
+         "trace viewer (the NVTX-range analog, ref NvtxWithMetrics).") \
+    .create_with_default(False)
+
 METRICS_LEVEL = conf("spark.rapids.sql.metrics.level").string() \
     .doc("ESSENTIAL, MODERATE, or DEBUG (ref GpuExec.scala:32-45).") \
     .check_values(["ESSENTIAL", "MODERATE", "DEBUG"]) \

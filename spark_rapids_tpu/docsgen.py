@@ -1,0 +1,78 @@
+"""Generated-documentation renderers.
+
+Ref: TypeChecks.scala:1633 SupportedOpsDocs (docs/supported_ops.md) and
+the RapidsConf doc printer (docs/configs.md) — both references generate
+their docs from the live registries so they can never drift.  Same here:
+
+    python -m spark_rapids_tpu.docsgen [outdir]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List
+
+from . import config as cfg
+from . import types as t
+
+
+_DOC_TYPES = [
+    ("BOOLEAN", t.BOOLEAN), ("BYTE", t.BYTE), ("SHORT", t.SHORT),
+    ("INT", t.INT), ("LONG", t.LONG), ("FLOAT", t.FLOAT),
+    ("DOUBLE", t.DOUBLE), ("DATE", t.DATE), ("TIMESTAMP", t.TIMESTAMP),
+    ("STRING", t.STRING), ("DECIMAL64", t.DecimalType(18, 2)),
+    ("DECIMAL128", t.DecimalType(38, 2)), ("BINARY", t.BINARY),
+    ("ARRAY<INT>", t.ArrayType(t.INT)),
+    ("STRUCT", t.StructType([t.StructField("f", t.INT)])),
+]
+
+
+def generate_supported_ops() -> str:
+    """docs/supported_ops.md from the expression/exec registries."""
+    from .plan.overrides import EXEC_SIGS, EXPR_RULES
+    lines = [
+        "# Supported Operators and Expressions",
+        "",
+        "Generated from the live TypeSig registries "
+        "(`spark_rapids_tpu/plan/overrides.py`) — do not edit.",
+        "`S` = supported on TPU, blank = falls back to CPU.",
+        "",
+        "## Execs", "",
+        "| Exec | " + " | ".join(n for n, _ in _DOC_TYPES) + " |",
+        "|" + "---|" * (len(_DOC_TYPES) + 1),
+    ]
+    for cls in sorted(EXEC_SIGS, key=lambda c: c.__name__):
+        sig = EXEC_SIGS[cls]
+        cells = ["S" if sig.is_supported(dt) else "" for _, dt in _DOC_TYPES]
+        lines.append(f"| {cls.__name__} | " + " | ".join(cells) + " |")
+    lines += [
+        "", "## Expressions", "",
+        "| Expression | " + " | ".join(n for n, _ in _DOC_TYPES) + " |",
+        "|" + "---|" * (len(_DOC_TYPES) + 1),
+    ]
+    for cls in sorted(EXPR_RULES, key=lambda c: c.__name__):
+        sig = EXPR_RULES[cls].sig
+        cells = ["S" if sig.is_supported(dt) else "" for _, dt in _DOC_TYPES]
+        lines.append(f"| {cls.__name__} | " + " | ".join(cells) + " |")
+    return "\n".join(lines) + "\n"
+
+
+def write_docs(outdir: str = "docs") -> List[str]:
+    os.makedirs(outdir, exist_ok=True)
+    paths = []
+    p = os.path.join(outdir, "configs.md")
+    with open(p, "w") as f:
+        f.write(cfg.generate_docs())
+    paths.append(p)
+    p = os.path.join(outdir, "supported_ops.md")
+    with open(p, "w") as f:
+        f.write(generate_supported_ops())
+    paths.append(p)
+    return paths
+
+
+if __name__ == "__main__":
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "docs"
+    for p in write_docs(outdir):
+        print(p)

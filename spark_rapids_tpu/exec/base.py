@@ -35,14 +35,23 @@ from ..config import RapidsConf
 Batch = DeviceBatch  # alias: same structure on both engines
 
 
+# metric verbosity levels (ref GpuExec.scala:32-45, conf
+# spark.rapids.sql.metrics.level)
+ESSENTIAL = "ESSENTIAL"
+MODERATE = "MODERATE"
+DEBUG = "DEBUG"
+_LEVEL_ORDER = {ESSENTIAL: 0, MODERATE: 1, DEBUG: 2}
+
+
 class Metric:
     """Operator metric (ref GpuMetric / GpuExec.scala:45-104)."""
 
-    __slots__ = ("name", "value")
+    __slots__ = ("name", "value", "level")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, level: str = MODERATE):
         self.name = name
         self.value = 0
+        self.level = level
 
     def add(self, v):
         self.value += v
@@ -52,16 +61,39 @@ class Metric:
         return self
 
 
+_trace_annotations_enabled = False
+
+
+def set_trace_annotations(enabled: bool) -> None:
+    """Toggle jax.profiler trace annotations around timed operator work —
+    the NVTX-range analog (ref NvtxWithMetrics.scala:22-49; ranges show
+    up in the TensorBoard/XPlane trace viewer instead of Nsight)."""
+    global _trace_annotations_enabled
+    _trace_annotations_enabled = enabled
+
+
 class MetricTimer:
-    def __init__(self, metric: Metric):
+    """Times a block into a metric; optionally also opens a profiler
+    trace annotation of the same name (NvtxWithMetrics)."""
+
+    def __init__(self, metric: Metric, name: Optional[str] = None):
         self.metric = metric
+        self.name = name
+        self._ann = None
 
     def __enter__(self):
+        if _trace_annotations_enabled:
+            from jax.profiler import TraceAnnotation
+            self._ann = TraceAnnotation(self.name or self.metric.name)
+            self._ann.__enter__()
         self._t0 = time.perf_counter_ns()
         return self
 
     def __exit__(self, *exc):
         self.metric.add(time.perf_counter_ns() - self._t0)
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+            self._ann = None
 
 
 class ExecContext:
@@ -92,9 +124,11 @@ class Exec:
 
     def __init__(self, children: Sequence["Exec"]):
         self.children: List[Exec] = list(children)
-        self.metrics: Dict[str, Metric] = {}
-        for m in (NUM_OUTPUT_ROWS, NUM_OUTPUT_BATCHES, OP_TIME):
-            self.metrics[m] = Metric(m)
+        self.metrics: Dict[str, Metric] = {
+            NUM_OUTPUT_ROWS: Metric(NUM_OUTPUT_ROWS, ESSENTIAL),
+            NUM_OUTPUT_BATCHES: Metric(NUM_OUTPUT_BATCHES, MODERATE),
+            OP_TIME: Metric(OP_TIME, MODERATE),
+        }
 
     # -- schema -------------------------------------------------------------
     @property
@@ -170,7 +204,7 @@ class Exec:
         import copy
         c = copy.copy(self)
         c.children = list(children)
-        c.metrics = {k: Metric(k) for k in self.metrics}
+        c.metrics = {k: Metric(k, m.level) for k, m in self.metrics.items()}
         return c
 
     def transform_up(self, fn):
@@ -247,4 +281,21 @@ class DeviceToHostExec(Exec):
     def execute_partition(self, pid, ctx):
         for b in self.children[0].execute_partition(pid, ctx):
             with MetricTimer(self.metrics[OP_TIME]):
+                self.metrics[NUM_OUTPUT_ROWS] += int(b.num_rows)
+                self.metrics[NUM_OUTPUT_BATCHES] += 1
                 yield jax.tree_util.tree_map(_to_numpy_leaf, b)
+
+
+def metrics_report(root: "Exec", level: str = MODERATE) -> List[Tuple[str, str, int]]:
+    """Collect (operator, metric, value) at or below the verbosity level
+    (ref GpuExec metrics levels feeding the Spark SQL UI)."""
+    out: List[Tuple[str, str, int]] = []
+    cutoff = _LEVEL_ORDER[level]
+
+    def visit(node: "Exec"):
+        for m in node.metrics.values():
+            if _LEVEL_ORDER[m.level] <= cutoff:
+                out.append((type(node).__name__, m.name, m.value))
+
+    root.foreach(visit)
+    return out

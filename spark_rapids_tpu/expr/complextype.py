@@ -1,0 +1,223 @@
+"""Complex-type create/extract expressions.
+
+Ref: org/apache/spark/sql/rapids/{complexTypeCreator,
+complexTypeExtractors}.scala — CreateArray/CreateNamedStruct,
+GetStructField/GetArrayItem/ElementAt registered in GpuOverrides.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .. import types as t
+from ..columnar.device import DeviceColumn
+from .core import (ColumnValue, EvalContext, Expression, ScalarValue,
+                   and_validity, evaluator, make_column, scalar_to_column)
+
+
+class GetStructField(Expression):
+    def __init__(self, child: Expression, name: str,
+                 ordinal: Optional[int] = None):
+        self.children = (child,)
+        self.name = name
+        self.ordinal = ordinal
+
+    def _resolve(self):
+        st = self.children[0].data_type()
+        if not isinstance(st, t.StructType):
+            raise TypeError(
+                f"field access `.{self.name}` requires a struct column, "
+                f"got {st.name} (map key lookup is not supported)")
+        if self.ordinal is not None:
+            return self.ordinal, st.fields[self.ordinal].data_type
+        for i, f in enumerate(st.fields):
+            if f.name == self.name:
+                return i, f.data_type
+        raise KeyError(f"no field {self.name!r} in {st.name}")
+
+    def data_type(self):
+        return self._resolve()[1]
+
+    def sql(self):
+        return f"{self.children[0].sql()}.{self.name}"
+
+
+@evaluator(GetStructField)
+def _eval_get_struct_field(e: GetStructField, ctx: EvalContext):
+    v = e.children[0].eval(ctx)
+    i, _ = e._resolve()
+    col = v.col.children[i]
+    # struct-level nulls mask the extracted child
+    if v.col.validity is not None:
+        validity = (col.validity & v.col.validity
+                    if col.validity is not None else v.col.validity)
+        col = DeviceColumn(col.dtype, data=col.data, validity=validity,
+                           offsets=col.offsets, data_hi=col.data_hi,
+                           children=col.children)
+    return ColumnValue(col)
+
+
+class GetArrayItem(Expression):
+    """arr[index] — null when out of range (non-ANSI)."""
+
+    def __init__(self, child: Expression, index: Expression):
+        self.children = (child, index)
+
+    def data_type(self):
+        at = self.children[0].data_type()
+        assert isinstance(at, t.ArrayType), at
+        return at.element_type
+
+    def sql(self):
+        return f"{self.children[0].sql()}[{self.children[1].sql()}]"
+
+
+class ElementAt(Expression):
+    """element_at(arr, i): 1-based, negative counts from the end."""
+
+    def __init__(self, child: Expression, index: Expression):
+        self.children = (child, index)
+
+    def data_type(self):
+        at = self.children[0].data_type()
+        assert isinstance(at, t.ArrayType), at
+        return at.element_type
+
+    def sql(self):
+        return (f"element_at({self.children[0].sql()}, "
+                f"{self.children[1].sql()})")
+
+
+def _gather_element(ctx, arr_col: DeviceColumn, pos, in_range):
+    """Gather element `pos` (absolute child index) per row."""
+    from ..ops.gather import gather_column
+    xp = ctx.xp
+    child = arr_col.children[0]
+    valid = in_range
+    if arr_col.validity is not None:
+        valid = valid & arr_col.validity
+    idx = xp.clip(pos, 0, child.capacity - 1).astype(np.int32)
+    return ColumnValue(gather_column(xp, child, idx, valid))
+
+
+@evaluator(GetArrayItem)
+def _eval_get_array_item(e: GetArrayItem, ctx: EvalContext):
+    xp = ctx.xp
+    v = e.children[0].eval(ctx)
+    iv = e.children[1].eval(ctx)
+    from .core import data_of
+    i = data_of(iv, ctx)
+    col = v.col
+    lens = col.offsets[1:] - col.offsets[:-1]
+    in_range = (i >= 0) & (i < lens)
+    pos = col.offsets[:-1] + i
+    from .core import validity_of
+    iv_valid = validity_of(iv, ctx)
+    if iv_valid is not None:
+        in_range = in_range & iv_valid
+    return _gather_element(ctx, col, pos, in_range)
+
+
+@evaluator(ElementAt)
+def _eval_element_at(e: ElementAt, ctx: EvalContext):
+    xp = ctx.xp
+    v = e.children[0].eval(ctx)
+    iv = e.children[1].eval(ctx)
+    from .core import data_of, validity_of
+    i = data_of(iv, ctx)
+    col = v.col
+    lens = col.offsets[1:] - col.offsets[:-1]
+    pos_from_start = col.offsets[:-1] + (i - 1)
+    pos_from_end = col.offsets[1:] + i
+    pos = xp.where(i > 0, pos_from_start, pos_from_end)
+    in_range = ((i > 0) & (i <= lens)) | ((i < 0) & (-i <= lens))
+    iv_valid = validity_of(iv, ctx)
+    if iv_valid is not None:
+        in_range = in_range & iv_valid
+    return _gather_element(ctx, col, pos, in_range)
+
+
+class CreateArray(Expression):
+    def __init__(self, children: List[Expression]):
+        self.children = tuple(children)
+
+    def data_type(self):
+        et = self.children[0].data_type() if self.children else t.NULL
+        return t.ArrayType(et)
+
+    def sql(self):
+        return f"array({', '.join(c.sql() for c in self.children)})"
+
+
+@evaluator(CreateArray)
+def _eval_create_array(e: CreateArray, ctx: EvalContext):
+    xp = ctx.xp
+    n = len(e.children)
+    cap = ctx.capacity
+    if n == 0:
+        # F.array() -> empty array<null> per row
+        child = DeviceColumn(t.NULL, data=xp.zeros((1,), np.int8),
+                             validity=xp.zeros((1,), dtype=bool))
+        return ColumnValue(DeviceColumn(
+            t.ArrayType(t.NULL), validity=xp.ones((cap,), dtype=bool),
+            offsets=xp.zeros((cap + 1,), np.int32), children=(child,)))
+    vals = []
+    for c in e.children:
+        v = c.eval(ctx)
+        if isinstance(v, ScalarValue):
+            v = scalar_to_column(ctx, v)
+        vals.append(v.col)
+    et = e.children[0].data_type()
+    # interleave: element j of row r sits at child index r*n + j
+    from ..ops.gather import gather_column
+    child_cap = cap * n
+    src = xp.arange(child_cap, dtype=np.int32) // n       # source row
+    which = xp.arange(child_cap, dtype=np.int32) % n      # source column
+    parts = []
+    for j, col in enumerate(vals):
+        g = gather_column(xp, col, src,
+                          xp.ones((child_cap,), dtype=bool))
+        parts.append(g)
+    # select lane j where which == j
+    data = parts[0].data
+    validity = parts[0].validity
+    for j in range(1, n):
+        pick = which == j
+        data = xp.where(pick, parts[j].data, data)
+        validity = xp.where(pick, parts[j].validity, validity)
+    child = DeviceColumn(et, data=data, validity=validity)
+    offsets = (xp.arange(cap + 1, dtype=np.int32) * n).astype(np.int32)
+    return ColumnValue(DeviceColumn(
+        t.ArrayType(et), validity=xp.ones((cap,), dtype=bool),
+        offsets=offsets, children=(child,)))
+
+
+class CreateNamedStruct(Expression):
+    def __init__(self, names: List[str], values: List[Expression]):
+        self.names = list(names)
+        self.children = tuple(values)
+
+    def data_type(self):
+        return t.StructType([t.StructField(n, c.data_type())
+                             for n, c in zip(self.names, self.children)])
+
+    def sql(self):
+        inner = ", ".join(f"{n}, {c.sql()}"
+                          for n, c in zip(self.names, self.children))
+        return f"named_struct({inner})"
+
+
+@evaluator(CreateNamedStruct)
+def _eval_create_named_struct(e: CreateNamedStruct, ctx: EvalContext):
+    xp = ctx.xp
+    cols = []
+    for c in e.children:
+        v = c.eval(ctx)
+        if isinstance(v, ScalarValue):
+            v = scalar_to_column(ctx, v)
+        cols.append(v.col)
+    return ColumnValue(DeviceColumn(
+        e.data_type(), validity=xp.ones((ctx.capacity,), dtype=bool),
+        children=tuple(cols)))

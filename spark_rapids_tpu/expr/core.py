@@ -73,13 +73,16 @@ class EvalContext:
     same semantics run on both engines.
     """
 
-    __slots__ = ("xp", "batch", "ansi", "capacity")
+    __slots__ = ("xp", "batch", "ansi", "capacity", "lambda_bindings")
 
     def __init__(self, xp, batch, ansi: bool = False):
         self.xp = xp
         self.batch = batch  # DeviceBatch (buffers in xp-land)
         self.ansi = ansi
         self.capacity = batch.capacity if batch is not None else 0
+        # name -> ColumnValue for in-scope lambda variables (higher-order
+        # function bodies evaluate in array-element space)
+        self.lambda_bindings = {}
 
     def row_mask(self):
         return self.xp.arange(self.capacity, dtype=np.int32) < self.batch.num_rows

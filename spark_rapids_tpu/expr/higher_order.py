@@ -1,0 +1,252 @@
+"""Higher-order functions over arrays: transform / filter / exists /
+forall.
+
+Ref: sql-plugin/.../higherOrderFunctions.scala — the reference evaluates
+lambda bodies columnar over the array's flattened child column; the same
+shape maps perfectly to this build's element-space evaluation: a lambda
+body is an ordinary expression evaluated in a context whose capacity is
+the child column's, with the lambda variable bound to the child column
+itself.  Offsets are then reused (transform), recomputed by segmented
+counts (filter), or reduced per row (exists/forall).
+
+Lambda bodies may reference the lambda variable(s) and literals; outer
+column references inside a body are not supported (tagged off, both
+engines) — the reference has the same restriction for its AST-style
+lambda evaluation.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .. import types as t
+from ..columnar.device import DeviceColumn
+from .core import (ColumnValue, EvalContext, Expression, evaluator,
+                   make_column)
+
+
+class NamedLambdaVariable(Expression):
+    def __init__(self, name: str, dtype: t.DataType = None):
+        self.children = ()
+        self.name = name
+        self.dtype = dtype
+
+    def data_type(self):
+        if self.dtype is None:
+            raise TypeError(f"unbound lambda variable {self.name}")
+        return self.dtype
+
+    def sql(self):
+        return self.name
+
+
+@evaluator(NamedLambdaVariable)
+def _eval_lambda_var(e: NamedLambdaVariable, ctx: EvalContext):
+    v = ctx.lambda_bindings.get(e.name)
+    if v is None:
+        from .core import EvalError
+        raise EvalError(f"lambda variable {e.name} not in scope")
+    return v
+
+
+class LambdaFunction(Expression):
+    def __init__(self, body: Expression, args: List[NamedLambdaVariable]):
+        self.children = (body,)
+        self.args = list(args)
+
+    @property
+    def body(self):
+        return self.children[0]
+
+    def data_type(self):
+        return self.body.data_type()
+
+    def sql(self):
+        names = ", ".join(a.name for a in self.args)
+        return f"lambdafunction({self.body.sql()}, {names})"
+
+
+def references_outer_columns(body: Expression, arg_names) -> bool:
+    from .core import AttributeReference, BoundReference
+    found = []
+
+    def visit(e):
+        if isinstance(e, (AttributeReference, BoundReference)):
+            found.append(e)
+        return e
+    body.transform_up(visit)
+    return bool(found)
+
+
+class ArrayHigherOrder(Expression):
+    def __init__(self, arr: Expression, fn: LambdaFunction):
+        self.children = (arr, fn)
+
+    @property
+    def arr(self):
+        return self.children[0]
+
+    @property
+    def fn(self) -> LambdaFunction:
+        return self.children[1]
+
+    def _bind_lambda(self) -> LambdaFunction:
+        """Type the lambda variable(s) with the array's element type."""
+        at = self.arr.data_type()
+        assert isinstance(at, t.ArrayType), at
+        fn = self.fn
+        typed = {fn.args[0].name: at.element_type}
+        if len(fn.args) > 1:
+            typed[fn.args[1].name] = t.INT  # element index
+
+        def retype(e):
+            if isinstance(e, NamedLambdaVariable) and e.name in typed:
+                return NamedLambdaVariable(e.name, typed[e.name])
+            return e
+        body = fn.body.transform_up(retype)
+        return LambdaFunction(body, [retype(a) for a in fn.args])
+
+    def _element_eval(self, ctx: EvalContext, arr_col: DeviceColumn):
+        """Evaluate the lambda body in element space; returns the body's
+        ColumnValue over the child capacity."""
+        from ..columnar.device import DeviceBatch
+        xp = ctx.xp
+        child = arr_col.children[0]
+        fn = self._bind_lambda()
+        n_elem = arr_col.offsets[-1]
+        ectx = EvalContext(xp, DeviceBatch([child], n_elem))
+        ectx.ansi = ctx.ansi
+        ectx.lambda_bindings[fn.args[0].name] = ColumnValue(child)
+        if len(fn.args) > 1:
+            # element index within its row
+            pos = xp.arange(child.capacity, dtype=np.int32)
+            row = xp.clip(
+                xp.searchsorted(arr_col.offsets, pos, side="right") - 1,
+                0, arr_col.capacity - 1).astype(np.int32)
+            idx = (pos - arr_col.offsets[row]).astype(np.int32)
+            ectx.lambda_bindings[fn.args[1].name] = make_column(
+                ectx, t.INT, idx, None)
+        v = fn.body.eval(ectx)
+        if not isinstance(v, ColumnValue):
+            from .core import scalar_to_column
+            v = scalar_to_column(ectx, v)
+        return v
+
+
+class ArrayTransform(ArrayHigherOrder):
+    def data_type(self):
+        return t.ArrayType(self._bind_lambda().body.data_type())
+
+    def sql(self):
+        return f"transform({self.arr.sql()}, {self.fn.sql()})"
+
+
+@evaluator(ArrayTransform)
+def _eval_array_transform(e: ArrayTransform, ctx: EvalContext):
+    v = e.arr.eval(ctx)
+    col = v.col
+    out_elem = e._element_eval(ctx, col)
+    return ColumnValue(DeviceColumn(
+        e.data_type(), validity=col.validity, offsets=col.offsets,
+        children=(out_elem.col,)))
+
+
+class ArrayFilter(ArrayHigherOrder):
+    def data_type(self):
+        return self.arr.data_type()
+
+    def sql(self):
+        return f"filter({self.arr.sql()}, {self.fn.sql()})"
+
+
+@evaluator(ArrayFilter)
+def _eval_array_filter(e: ArrayFilter, ctx: EvalContext):
+    from ..ops.gather import gather_column
+    xp = ctx.xp
+    v = e.arr.eval(ctx)
+    col = v.col
+    child = col.children[0]
+    pred = e._element_eval(ctx, col)
+    keep = pred.col.data.astype(bool)
+    if pred.col.validity is not None:
+        keep = keep & pred.col.validity  # null predicate drops the element
+    n_elem = col.offsets[-1]
+    in_bounds = xp.arange(child.capacity, dtype=np.int32) < n_elem
+    keep = keep & in_bounds
+    # new offsets: per-row kept counts
+    kept_cum = xp.concatenate([
+        xp.zeros((1,), np.int64),
+        xp.cumsum(keep.astype(np.int64))])
+    new_offsets = kept_cum[col.offsets.astype(np.int64)].astype(np.int32)
+    # stable-compact kept elements to the front
+    order = xp.argsort(~keep, stable=True).astype(np.int32)
+    total_kept = new_offsets[-1]
+    live = xp.arange(child.capacity, dtype=np.int32) < total_kept
+    new_child = gather_column(xp, child, order, live)
+    return ColumnValue(DeviceColumn(
+        col.dtype, validity=col.validity, offsets=new_offsets,
+        children=(new_child,)))
+
+
+class ArrayExists(ArrayHigherOrder):
+    def data_type(self):
+        return t.BOOLEAN
+
+    def sql(self):
+        return f"exists({self.arr.sql()}, {self.fn.sql()})"
+
+
+class ArrayForAll(ArrayHigherOrder):
+    def data_type(self):
+        return t.BOOLEAN
+
+    def sql(self):
+        return f"forall({self.arr.sql()}, {self.fn.sql()})"
+
+
+def _segmented_bool(e: ArrayHigherOrder, ctx: EvalContext, want_all: bool):
+    """Spark three-valued logic: exists = true if any true, else NULL if
+    any null predicate, else false; forall dually."""
+    xp = ctx.xp
+    v = e.arr.eval(ctx)
+    col = v.col
+    child = col.children[0]
+    pred = e._element_eval(ctx, col)
+    p = pred.col.data.astype(bool)
+    pvalid = pred.col.validity if pred.col.validity is not None else \
+        xp.ones((child.capacity,), dtype=bool)
+    n_elem = col.offsets[-1]
+    in_bounds = xp.arange(child.capacity, dtype=np.int32) < n_elem
+
+    def per_row_count(mask):
+        cum = xp.concatenate([
+            xp.zeros((1,), np.int64), xp.cumsum(mask.astype(np.int64))])
+        return (cum[col.offsets[1:].astype(np.int64)] -
+                cum[col.offsets[:-1].astype(np.int64)])
+
+    n_true = per_row_count(p & pvalid & in_bounds)
+    n_false = per_row_count(~p & pvalid & in_bounds)
+    n_null = per_row_count(~pvalid & in_bounds)
+    if want_all:
+        data = n_false == 0
+        known = (n_false > 0) | (n_null == 0)
+    else:
+        data = n_true > 0
+        known = (n_true > 0) | (n_null == 0)
+    validity = known
+    if col.validity is not None:
+        validity = validity & col.validity
+    data = xp.where(validity, data, xp.zeros_like(data))
+    return make_column(ctx, t.BOOLEAN, data, validity)
+
+
+@evaluator(ArrayExists)
+def _eval_array_exists(e: ArrayExists, ctx: EvalContext):
+    return _segmented_bool(e, ctx, want_all=False)
+
+
+@evaluator(ArrayForAll)
+def _eval_array_forall(e: ArrayForAll, ctx: EvalContext):
+    return _segmented_bool(e, ctx, want_all=True)

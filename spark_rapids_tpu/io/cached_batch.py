@@ -1,0 +1,179 @@
+"""Cached-batch serializer: df.cache() materialized as parquet bytes.
+
+Ref: the ParquetCachedBatchSerializer the reference installs for Spark
+3.1.1+ (shims/spark311/.../SparkBaseShims.scala, docs/
+additional-functionality/cache-serializer.md, tests-spark310+/): cached
+DataFrames are stored as parquet-encoded byte blobs instead of Spark's
+row-based CachedBatch, so re-reads decode straight to columnar batches.
+
+Design here: a process-wide `CacheManager` keyed by logical-plan node.
+Planning a query that contains a cached-and-materialized subtree swaps
+in a `CachedScanExec` over the parquet blobs; the first execution after
+`cache()` materializes them (one parquet blob per partition).  The shim
+layer gates availability exactly like the reference (not supported on
+the 3.0.x dialect)."""
+
+from __future__ import annotations
+
+import io
+import threading
+from typing import Dict, Iterator, List, Optional
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ..exec.base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, Batch, Exec,
+                         TPU)
+
+
+class CachedPartition:
+    __slots__ = ("blobs", "complete")
+
+    def __init__(self):
+        self.blobs: List[bytes] = []  # one parquet blob per batch
+        self.complete = False  # generator ran to exhaustion
+
+
+class CacheEntry:
+    def __init__(self, lp):
+        # retain the logical plan: the registry is keyed by id(lp), so a
+        # strong reference both defines the cache lifetime (until
+        # unpersist) and prevents CPython id reuse from aliasing a freed
+        # plan's entry onto a new node
+        self.lp = lp
+        self.materialized = False
+        self.partitions: List[CachedPartition] = []
+        self.schema: Optional[pa.Schema] = None
+
+    @property
+    def size_bytes(self) -> int:
+        return sum(len(b) for p in self.partitions for b in p.blobs)
+
+
+class CacheManager:
+    """Process-wide registry of cached logical plans (the CachedRDD/
+    InMemoryRelation role)."""
+
+    _lock = threading.Lock()
+    _entries: Dict[int, CacheEntry] = {}
+
+    @classmethod
+    def cache(cls, lp) -> CacheEntry:
+        with cls._lock:
+            return cls._entries.setdefault(id(lp), CacheEntry(lp))
+
+    @classmethod
+    def lookup(cls, lp) -> Optional[CacheEntry]:
+        with cls._lock:
+            return cls._entries.get(id(lp))
+
+    @classmethod
+    def uncache(cls, lp) -> None:
+        with cls._lock:
+            cls._entries.pop(id(lp), None)
+
+    @classmethod
+    def clear(cls) -> None:
+        with cls._lock:
+            cls._entries.clear()
+
+
+def encode_batch(rb: pa.RecordBatch) -> bytes:
+    """RecordBatch -> parquet blob (the serializer's convertForCache)."""
+    sink = io.BytesIO()
+    tbl = pa.Table.from_batches([rb])
+    pq.write_table(tbl, sink, compression="snappy")
+    return sink.getvalue()
+
+
+def decode_blob(blob: bytes) -> List[pa.RecordBatch]:
+    tbl = pq.read_table(io.BytesIO(blob))
+    return tbl.combine_chunks().to_batches()
+
+
+class CacheWriteExec(Exec):
+    """Tees child batches into the cache while streaming them through
+    (the materialization pass on the first action after cache())."""
+
+    def __init__(self, entry: CacheEntry, child: Exec):
+        super().__init__([child])
+        self.entry = entry
+        self.placement = child.placement
+        self._lock = threading.Lock()
+
+    @property
+    def output_names(self):
+        return self.children[0].output_names
+
+    @property
+    def output_types(self):
+        return self.children[0].output_types
+
+    def describe(self):
+        return "CacheWrite(parquet)"
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        from ..exec.base import to_host_batch
+        with self._lock:
+            while len(self.entry.partitions) <= pid:
+                self.entry.partitions.append(CachedPartition())
+            part = self.entry.partitions[pid]
+            part.blobs = []
+            part.complete = False
+        for b in self.children[0].execute_partition(pid, ctx):
+            rb = to_host_batch(b, self.output_names)
+            blob = encode_batch(rb)
+            with self._lock:
+                part.blobs.append(blob)
+                if self.entry.schema is None:
+                    self.entry.schema = rb.schema
+            yield b
+        with self._lock:
+            part.complete = True
+            if len(self.entry.partitions) == self.num_partitions and \
+                    all(p.complete for p in self.entry.partitions):
+                # a short-circuited run (e.g. under a limit) never
+                # completes every partition and must not be served as a
+                # full cache
+                self.entry.materialized = True
+
+
+class CachedScanExec(Exec):
+    """Scan over parquet-cached partitions (the InMemoryTableScanExec
+    replacement; decodes blobs straight to columnar batches)."""
+
+    placement = TPU
+
+    def __init__(self, entry: CacheEntry, names, dtypes):
+        super().__init__([])
+        self.entry = entry
+        self._names = list(names)
+        self._types = list(dtypes)
+
+    @property
+    def output_names(self):
+        return self._names
+
+    @property
+    def output_types(self):
+        return self._types
+
+    @property
+    def num_partitions(self):
+        return max(1, len(self.entry.partitions))
+
+    def describe(self):
+        return (f"CachedScan(parquet, {self.num_partitions} partitions, "
+                f"{self.entry.size_bytes}B)")
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        from ..columnar.device import batch_to_device
+        xp = self.xp
+        if pid >= len(self.entry.partitions):
+            return
+        for blob in self.entry.partitions[pid].blobs:
+            for rb in decode_blob(blob):
+                b = batch_to_device(rb, xp=xp)
+                self.metrics[NUM_OUTPUT_ROWS] += int(b.num_rows)
+                self.metrics[NUM_OUTPUT_BATCHES] += 1
+                yield b

@@ -139,6 +139,51 @@ expr_rule(coll.SortArray, T.ARRAY.nested(T.common_scalar),
           if isinstance(m.expr.children[0].data_type().element_type,
                         (t.StringType, t.BinaryType, t.ArrayType,
                          t.StructType, t.MapType)) else None)
+from ..expr import complextype as cx
+from ..expr import higher_order as ho
+
+_nested_common = (T.common_scalar + T.ARRAY + T.STRUCT + T.MAP +
+                  T.BINARY).nested()
+expr_rule(cx.GetStructField, _nested_common, "struct field extract")
+expr_rule(cx.GetArrayItem, _nested_common, "array index extract")
+expr_rule(cx.ElementAt, _nested_common, "element_at")
+expr_rule(cx.CreateNamedStruct, T.STRUCT.nested(T.common_scalar),
+          "named_struct")
+
+
+def _tag_create_array(meta: "ExprMeta"):
+    et = meta.expr.children[0].data_type() if meta.expr.children else None
+    if isinstance(et, (t.StringType, t.BinaryType, t.ArrayType,
+                       t.StructType, t.MapType)):
+        meta.will_not_work(
+            "array() over string/nested elements is not supported on TPU")
+
+
+expr_rule(cx.CreateArray, T.ARRAY.nested(T.common_scalar), "array()",
+          _tag_create_array)
+
+
+def _tag_higher_order(meta: "ExprMeta"):
+    e = meta.expr
+    fn = e.fn
+    if ho.references_outer_columns(fn.body,
+                                   {a.name for a in fn.args}):
+        meta.will_not_work(
+            "lambda bodies may only reference lambda variables")
+
+
+expr_rule(ho.LambdaFunction, T.all_types.nested(), "lambda function")
+expr_rule(ho.NamedLambdaVariable, T.all_types.nested(), "lambda variable")
+expr_rule(ho.ArrayTransform, T.ARRAY.nested(T.common_scalar), "transform",
+          _tag_higher_order)
+expr_rule(ho.ArrayFilter, T.ARRAY.nested(T.common_scalar), "filter",
+          _tag_higher_order)
+expr_rule(ho.ArrayExists, T.BOOLEAN, "exists", _tag_higher_order)
+expr_rule(ho.ArrayForAll, T.BOOLEAN, "forall", _tag_higher_order)
+# regex expressions intentionally have NO rule: no TPU regex engine, the
+# operator stays on the CPU engine whose numpy path evaluates them via
+# `re` (ref marks regex-dependent ops incompat the same way)
+
 expr_rule(coll.Explode, (T.common_scalar + T.ARRAY + T.STRUCT).nested(),
           "explode generator")
 expr_rule(coll.PosExplode, (T.common_scalar + T.ARRAY + T.STRUCT).nested(),
@@ -227,6 +272,17 @@ class ExprMeta(BaseMeta):
         if isinstance(expr, agg.AggregateExpression):
             self.children = [ExprMeta(expr.func, conf, input_names,
                                       input_types)]
+        if isinstance(expr, ho.ArrayHigherOrder):
+            # retype the lambda variables from the (bound) array element
+            # type so the body's meta tree type-checks
+            try:
+                bound = bind_expression(expr, input_names, input_types)
+                self.children = [
+                    ExprMeta(bound.arr, conf, input_names, input_types),
+                    ExprMeta(bound._bind_lambda(), conf, input_names,
+                             input_types)]
+            except Exception:
+                pass  # tagging of the unbound tree will report the issue
 
     def tag(self):
         rule = EXPR_RULES.get(type(self.expr))
@@ -480,6 +536,11 @@ from ..exec.expand import ExpandExec, GenerateExec  # noqa: E402
 EXEC_SIGS[SampleExec] = _exec_common
 EXEC_SIGS[ExpandExec] = _exec_common
 EXEC_SIGS[GenerateExec] = _exec_common
+
+from ..io.cached_batch import CachedScanExec, CacheWriteExec  # noqa: E402
+
+EXEC_SIGS[CachedScanExec] = _exec_common
+EXEC_SIGS[CacheWriteExec] = _exec_common
 
 
 def _tag_file_scan(meta: "ExecMeta"):

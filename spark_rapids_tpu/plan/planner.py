@@ -84,6 +84,19 @@ def _plan_with_udfs(exprs: List[Expression], child_lp: L.LogicalPlan, conf):
 
 
 def plan(lp: L.LogicalPlan, conf) -> eb.Exec:
+    from ..io.cached_batch import (CacheManager, CacheWriteExec,
+                                   CachedScanExec)
+    entry = CacheManager.lookup(lp)
+    if entry is not None:
+        names, dtypes = lp.schema()
+        if entry.materialized:
+            return CachedScanExec(entry, names, dtypes)
+        inner = _plan_uncached(lp, conf)
+        return CacheWriteExec(entry, inner)
+    return _plan_uncached(lp, conf)
+
+
+def _plan_uncached(lp: L.LogicalPlan, conf) -> eb.Exec:
     if isinstance(lp, L.LocalRelation):
         return LocalScanExec(lp.table, lp.num_partitions)
     if isinstance(lp, L.Range):

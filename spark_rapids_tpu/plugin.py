@@ -1,0 +1,192 @@
+"""Plugin bootstrap: driver/executor lifecycle.
+
+Ref: sql-plugin/.../Plugin.scala — `RapidsDriverPlugin` (config fixup,
+shuffle heartbeat registry, plan-capture test callback RPC at :264-386)
+and `RapidsExecutorPlugin` (:166-238: cudf version handshake, GPU+RMM
+init, semaphore init, heartbeat registration, hard `System.exit(1)` on
+init failure so the cluster manager reschedules the executor).
+
+The TPU build keeps the same two-phase shape: a driver-side plugin that
+owns cluster-wide state (heartbeat registry, config fixup, capture
+callback) and an executor-side plugin that initializes this process's
+device runtime (device manager, HBM budget/spill catalog, task
+semaphore, shuffle endpoint, shim selection) and applies the same
+fail-fast contract.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, List, Optional
+
+from . import config as cfg
+
+log = logging.getLogger("spark_rapids_tpu.plugin")
+
+
+class PluginInitError(RuntimeError):
+    """Executor init failure.  The reference calls System.exit(1)
+    (Plugin.scala:196-203); embedded in-process we raise instead and let
+    the host decide, unless spark.rapids.tpu.hardExitOnInitFailure."""
+
+
+def fixup_configs(conf_map: dict) -> dict:
+    """Force settings the plugin needs, like the reference forcing
+    `spark.sql.extensions` + serializer checks
+    (RapidsPluginUtils.fixupConfigs, Plugin.scala:77-112)."""
+    out = dict(conf_map)
+    exts = out.get("spark.sql.extensions", "")
+    ours = "com.nvidia.spark.rapids.tpu.SQLExecPlugin"
+    if ours not in exts:
+        out["spark.sql.extensions"] = f"{exts},{ours}".strip(",")
+    # columnar serializer must stay compatible with device batches
+    out.setdefault("spark.rapids.shuffle.transport",
+                   cfg.RapidsConf(out).get(cfg.SHUFFLE_TRANSPORT))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Plan-capture callback (ref ExecutionPlanCaptureCallback Plugin.scala:264)
+# ---------------------------------------------------------------------------
+
+class ExecutionPlanCaptureCallback:
+    """Captures executed plans for fallback assertions in tests."""
+
+    _capture = False
+    _plans: List = []
+    _lock = threading.Lock()
+
+    @classmethod
+    def start_capture(cls):
+        with cls._lock:
+            cls._capture = True
+            cls._plans = []
+
+    @classmethod
+    def on_plan(cls, plan) -> None:
+        with cls._lock:
+            if cls._capture:
+                cls._plans.append(plan)
+
+    @classmethod
+    def get_resulting_plans(cls) -> List:
+        with cls._lock:
+            cls._capture = False
+            return list(cls._plans)
+
+    @classmethod
+    def assert_contains(cls, plan, exec_name: str) -> bool:
+        found = []
+        plan.foreach(lambda e: found.append(e)
+                     if type(e).__name__ == exec_name else None)
+        return bool(found)
+
+
+class TpuDriverPlugin:
+    """Driver-side lifecycle (ref RapidsDriverPlugin, Plugin.scala:129)."""
+
+    def __init__(self, conf_map: Optional[dict] = None):
+        self.conf_map = fixup_configs(conf_map or {})
+        self.conf = cfg.RapidsConf(self.conf_map)
+        self.heartbeat_manager = None
+
+    def init(self) -> dict:
+        from .shuffle.heartbeat import HeartbeatManager
+        if self.conf.get(cfg.SHUFFLE_MANAGER_ENABLED):
+            timeout = self.conf.get(cfg.SHUFFLE_HEARTBEAT_TIMEOUT_MS) / 1000
+            self.heartbeat_manager = HeartbeatManager(timeout_s=timeout)
+        log.info("TPU driver plugin initialized")
+        return self.conf_map  # the fixed-up configs Spark distributes
+
+    def receive(self, message):
+        """Driver RPC dispatch (ref Plugin.scala:132-144): executors
+        register / heartbeat through the plugin channel."""
+        kind = message.get("kind")
+        if self.heartbeat_manager is None:
+            return {"ok": False, "error": "accelerated shuffle disabled"}
+        if kind == "register":
+            peers = self.heartbeat_manager.register_executor(
+                message["executor_id"], message.get("host", ""),
+                message.get("port", 0))
+            return {"ok": True, "peers": [p.__dict__ for p in peers]}
+        if kind == "heartbeat":
+            peers = self.heartbeat_manager.executor_heartbeat(
+                message["executor_id"])
+            return {"ok": True, "peers": [p.__dict__ for p in peers]}
+        return {"ok": False, "error": f"unknown message {kind!r}"}
+
+    def shutdown(self):
+        self.heartbeat_manager = None
+
+
+class TpuExecutorPlugin:
+    """Executor-side lifecycle (ref RapidsExecutorPlugin,
+    Plugin.scala:166-238)."""
+
+    def __init__(self, conf_map: Optional[dict] = None,
+                 driver: Optional[TpuDriverPlugin] = None,
+                 executor_id: str = "0"):
+        self.conf = cfg.RapidsConf(conf_map or {})
+        self.driver = driver
+        self.executor_id = executor_id
+        self.device_manager = None
+        self.semaphore = None
+        self.spill_catalog = None
+        self.shuffle_server = None
+
+    # -- version handshake (ref checkCudfVersion Plugin.scala:206) ----------
+    @staticmethod
+    def check_runtime_versions() -> List[str]:
+        problems = []
+        import jax
+        import pyarrow
+        jv = tuple(int(x) for x in jax.__version__.split(".")[:2])
+        if jv < (0, 4):
+            problems.append(f"jax {jax.__version__} is too old (need 0.4+)")
+        pv = tuple(int(x) for x in pyarrow.__version__.split(".")[:1])
+        if pv < (8,):
+            problems.append(
+                f"pyarrow {pyarrow.__version__} is too old (need 8+)")
+        return problems
+
+    def init(self):
+        try:
+            problems = self.check_runtime_versions()
+            if problems:
+                raise PluginInitError("; ".join(problems))
+            from .memory.device import DeviceManager
+            from .memory.meta import set_default_codec
+            from .memory.semaphore import TpuSemaphore
+            from .memory.spill import SpillCatalog
+            from .shims import ShimLoader
+            self.shim = ShimLoader.get_shim(
+                self.conf.raw("spark.rapids.tpu.sparkVersion", "3.2.0"))
+            set_default_codec(self.conf.get(cfg.SHUFFLE_COMPRESSION_CODEC))
+            self.device_manager = DeviceManager.initialize(self.conf)
+            self.semaphore = TpuSemaphore.initialize(
+                self.conf.get(cfg.CONCURRENT_TPU_TASKS))
+            self.spill_catalog = SpillCatalog.init_from_conf(self.conf)
+            if self.conf.get(cfg.SHUFFLE_MANAGER_ENABLED) and \
+                    self.conf.get(cfg.SHUFFLE_TRANSPORT) == "tcp":
+                from .shuffle.transport import ShuffleServer
+                self.shuffle_server = ShuffleServer().start()
+            if self.driver is not None:
+                self.driver.receive({
+                    "kind": "register", "executor_id": self.executor_id,
+                    "host": "localhost",
+                    "port": getattr(self.shuffle_server, "port", 0)})
+            log.info("TPU executor plugin initialized (executor %s)",
+                     self.executor_id)
+        except Exception as ex:
+            log.error("executor plugin init failed: %s", ex)
+            raw = self.conf.raw("spark.rapids.tpu.hardExitOnInitFailure")
+            if raw is not None and cfg._to_bool(raw):
+                import os
+                os._exit(1)  # the reference's System.exit(1) contract
+            raise
+
+    def shutdown(self):
+        if self.shuffle_server is not None:
+            self.shuffle_server.stop()
+            self.shuffle_server = None

@@ -1,0 +1,144 @@
+"""Version-compat shim layer.
+
+Ref: shims/ + ShimLoader.scala:20-60 + SparkShims.scala:84 — one plugin
+artifact serves many Spark versions by routing every version-sensitive
+behavior through a `SparkShims` trait, with per-version providers
+discovered at runtime.  The TPU build targets pyspark-dialect semantics
+the same way: each provider declares the version range it serves and
+overrides only the behaviors that changed in that range.  `ShimLoader`
+picks the matching provider for `spark.rapids.tpu.sparkVersion`.
+
+The behaviors routed here are the ones the reference's shims actually
+guard (SparkBaseShims deltas between 3.0.x / 3.1.x / 3.2.x).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Type
+
+
+def _parse_version(v: str) -> Tuple[int, int, int]:
+    parts = (v.split("-")[0].split(".") + ["0", "0"])[:3]
+    return tuple(int(x) for x in parts)  # type: ignore[return-value]
+
+
+class SparkShims:
+    """Version-sensitive behavior switchboard (ref SparkShims.scala:84).
+
+    Defaults describe Spark 3.2 semantics; older providers override."""
+
+    version = "3.2.0"
+
+    # Spark 3.1 moved stddev/var to new evaluator semantics where empty
+    # input yields null; 3.0 returned NaN (ref shims stddev handling)
+    def legacy_statistical_aggregate(self) -> bool:
+        return False
+
+    # 3.0 parsed yyyy-M-d style dates leniently when casting string->date;
+    # 3.1+ requires fully padded ISO forms unless legacy parser policy
+    def lenient_string_to_date(self) -> bool:
+        return False
+
+    # parquet datetime rebase default mode (3.0: LEGACY, 3.1+: EXCEPTION
+    # for ancient dates; ref GpuParquetScan rebase handling)
+    def parquet_rebase_mode_default(self) -> str:
+        return "CORRECTED"
+
+    # 3.2 turned ANSI-mode interval arithmetic + error messages on paths
+    # the plugin must mirror (ref shims' AnsiCast variations)
+    def ansi_interval_support(self) -> bool:
+        return True
+
+    # whether df.cache() uses the parquet cached-batch serializer
+    # (supported 3.1.1+; ref tests-spark310+)
+    def cached_batch_serializer_supported(self) -> bool:
+        return True
+
+    # AQE custom shuffle reader class name changed 3.1 -> 3.2
+    # (CustomShuffleReaderExec -> AQEShuffleReadExec)
+    def aqe_shuffle_read_name(self) -> str:
+        return "AQEShuffleRead"
+
+    def describe(self) -> str:
+        return f"{type(self).__name__}({self.version})"
+
+
+class Spark320Shims(SparkShims):
+    version = "3.2.0"
+
+
+class Spark311Shims(SparkShims):
+    version = "3.1.1"
+
+    def ansi_interval_support(self) -> bool:
+        return False
+
+    def aqe_shuffle_read_name(self) -> str:
+        return "CustomShuffleReader"
+
+
+class Spark301Shims(SparkShims):
+    version = "3.0.1"
+
+    def legacy_statistical_aggregate(self) -> bool:
+        return True
+
+    def lenient_string_to_date(self) -> bool:
+        return True
+
+    def parquet_rebase_mode_default(self) -> str:
+        return "LEGACY"
+
+    def ansi_interval_support(self) -> bool:
+        return False
+
+    def cached_batch_serializer_supported(self) -> bool:
+        return False
+
+    def aqe_shuffle_read_name(self) -> str:
+        return "CustomShuffleReader"
+
+
+class ShimServiceProvider:
+    """Registration record (ref SparkShimServiceProvider)."""
+
+    def __init__(self, shim_cls: Type[SparkShims],
+                 min_version: str, max_version_exclusive: str):
+        self.shim_cls = shim_cls
+        self.lo = _parse_version(min_version)
+        self.hi = _parse_version(max_version_exclusive)
+
+    def matches(self, version: Tuple[int, int, int]) -> bool:
+        return self.lo <= version < self.hi
+
+
+_PROVIDERS: List[ShimServiceProvider] = [
+    ShimServiceProvider(Spark301Shims, "3.0.0", "3.1.0"),
+    ShimServiceProvider(Spark311Shims, "3.1.0", "3.2.0"),
+    ShimServiceProvider(Spark320Shims, "3.2.0", "4.0.0"),
+]
+
+
+class ShimLoader:
+    """Provider discovery + selection (ref ShimLoader.scala)."""
+
+    _cached: Optional[SparkShims] = None
+    _cached_version: Optional[str] = None
+
+    @classmethod
+    def register(cls, provider: ShimServiceProvider) -> None:
+        _PROVIDERS.append(provider)
+
+    @classmethod
+    def get_shim(cls, version: str = "3.2.0") -> SparkShims:
+        if cls._cached is not None and cls._cached_version == version:
+            return cls._cached
+        v = _parse_version(version)
+        for p in _PROVIDERS:
+            if p.matches(v):
+                cls._cached = p.shim_cls()
+                cls._cached_version = version
+                return cls._cached
+        raise ValueError(
+            f"no shim provider for Spark version {version!r}; supported: "
+            + ", ".join(f"[{p.lo}, {p.hi})" for p in _PROVIDERS))

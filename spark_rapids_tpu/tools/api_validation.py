@@ -1,0 +1,112 @@
+"""Registry/implementation consistency audit.
+
+Ref: api_validation/ (ApiValidation.scala audits constructor-signature
+parity between Spark execs and their Gpu replacements across versions).
+The TPU-build analog audits the live registries for the drift that
+actually bites this codebase:
+
+  * every expression class with an ExprRule must have an evaluator
+    registered (a rule without an evaluator converts to TPU and then
+    crashes at runtime);
+  * every exec class in EXEC_SIGS must implement the operator contract
+    (output_names/output_types/execute_partition);
+  * every aggregate function must declare matching update/buffer/merge
+    arity.
+
+Run: python -m spark_rapids_tpu.tools.api_validation
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import List
+
+
+def validate() -> List[str]:
+    problems: List[str] = []
+    from ..expr import aggregates as agg
+    from ..expr.core import (AttributeReference, BoundReference, Expression,
+                             Literal, _EVALUATORS)
+    from ..plan.overrides import EXEC_SIGS, EXPR_RULES
+
+    no_evaluator_ok = {
+        # evaluated structurally, not via the evaluator registry
+        "Alias", "AttributeReference", "BoundReference", "Literal",
+        "AggregateExpression", "LambdaFunction", "Cast",
+    }
+    from ..expr.collection import Generator
+    for cls in EXPR_RULES:
+        if issubclass(cls, agg.AggregateFunction):
+            continue  # aggregates evaluate through update/merge/evaluate
+        if issubclass(cls, Generator):
+            continue  # generators evaluate inside GenerateExec
+        if cls.__name__ in no_evaluator_ok:
+            continue
+        if cls not in _EVALUATORS and not any(
+                base in _EVALUATORS for base in cls.__mro__[1:]):
+            has_eval = any(
+                getattr(m, "__self__", None) is None and n == "eval"
+                and m.__qualname__.startswith(cls.__name__)
+                for n, m in inspect.getmembers(cls, inspect.isfunction))
+            if not has_eval:
+                problems.append(
+                    f"expression {cls.__name__} has a rule but no "
+                    f"registered evaluator")
+
+    for cls in EXEC_SIGS:
+        for attr in ("output_names", "output_types"):
+            if not hasattr(cls, attr):
+                problems.append(f"exec {cls.__name__} missing {attr}")
+        fn = getattr(cls, "execute_partition", None)
+        if fn is None:
+            problems.append(
+                f"exec {cls.__name__} missing execute_partition")
+
+    for cls in EXPR_RULES:
+        if not issubclass(cls, agg.AggregateFunction) or \
+                cls is agg.AggregateFunction:
+            continue
+        if inspect.isabstract(cls):
+            continue
+        try:
+            inst = cls.__new__(cls)
+            bt = cls.buffer_types
+            mo = cls.merge_ops
+        except Exception:
+            continue
+        # arity parity is checked structurally on a best-effort instance
+        try:
+            from ..expr.core import AttributeReference as A
+            probe = cls(A("x", __import__(
+                "spark_rapids_tpu.types", fromlist=["LONG"]).LONG)) \
+                if _arity(cls) == 1 else cls()
+            if len(probe.buffer_types()) != len(probe.merge_ops()):
+                problems.append(
+                    f"aggregate {cls.__name__}: buffer_types/merge_ops "
+                    f"arity mismatch")
+            if len(probe.update()) != len(probe.buffer_types()):
+                problems.append(
+                    f"aggregate {cls.__name__}: update/buffer arity "
+                    f"mismatch")
+        except Exception:
+            pass  # constructors needing special args are exercised in tests
+    return problems
+
+
+def _arity(cls) -> int:
+    try:
+        sig = inspect.signature(cls.__init__)
+        return len([p for p in sig.parameters.values()
+                    if p.name != "self" and
+                    p.default is inspect.Parameter.empty])
+    except (TypeError, ValueError):
+        return 0
+
+
+if __name__ == "__main__":
+    import sys
+    issues = validate()
+    for i in issues:
+        print("PROBLEM:", i)
+    print(f"{len(issues)} problem(s) found")
+    sys.exit(1 if issues else 0)

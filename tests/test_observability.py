@@ -1,0 +1,70 @@
+"""Docs generation, metrics levels, trace annotations, api_validation
+(ref SupportedOpsDocs, GpuMetric levels, NvtxWithMetrics,
+api_validation/)."""
+
+import os
+
+import pyarrow as pa
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col
+from spark_rapids_tpu.api.session import TpuSession, last_query_metrics
+from spark_rapids_tpu.docsgen import generate_supported_ops, write_docs
+from spark_rapids_tpu.tools.api_validation import validate
+
+
+def test_api_validation_clean():
+    assert validate() == []
+
+
+def test_generate_configs_docs_contains_keys():
+    text = cfg.generate_docs()
+    assert "spark.rapids.sql.enabled" in text
+    assert "spark.rapids.shuffle.compression.codec" in text
+    assert "spark.sql.adaptive.enabled" in text
+
+
+def test_generate_supported_ops_matrix():
+    text = generate_supported_ops()
+    assert "| TpuHashAggregateExec |" in text or \
+        "| CpuHashAggregateExec |" in text
+    assert "## Expressions" in text
+    # regex exprs deliberately absent (no TPU rule)
+    assert "RLike" not in text
+    # decimal128 min/max supported, average not over decimals
+    assert "| Min | S | S" in text
+
+
+def test_write_docs(tmp_path):
+    paths = write_docs(str(tmp_path))
+    assert all(os.path.exists(p) for p in paths)
+
+
+def test_metrics_levels_and_report():
+    s = TpuSession.builder().config("spark.rapids.sql.enabled",
+                                    True).get_or_create()
+    df = s.create_dataframe(pa.table({"x": pa.array(range(100))}))
+    df.group_by(col("x")).agg(F.count("*").alias("c")).collect()
+    essential = last_query_metrics(s, "ESSENTIAL")
+    moderate = last_query_metrics(s, "MODERATE")
+    assert essential and moderate
+    assert len(moderate) > len(essential)
+    assert all(m == "numOutputRows" for _, m, _ in essential)
+    rows_out = [v for op, m, v in essential
+                if op == "DeviceToHostExec" and m == "numOutputRows"]
+    assert rows_out and rows_out[0] == 100
+
+
+def test_trace_annotations_run():
+    s = (TpuSession.builder()
+         .config("spark.rapids.sql.enabled", True)
+         .config("spark.rapids.sql.profile.traceAnnotations", True)
+         .get_or_create())
+    try:
+        df = s.create_dataframe(pa.table({"x": pa.array(range(10))}))
+        out = df.filter(col("x") > 3).collect()
+        assert out.num_rows == 6
+    finally:
+        from spark_rapids_tpu.exec.base import set_trace_annotations
+        set_trace_annotations(False)
