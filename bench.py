@@ -1,13 +1,18 @@
 #!/usr/bin/env python
-"""Benchmark: hash-aggregate pipeline throughput, TPU engine vs CPU engine.
+"""Headline benchmark: mixed SQL operator suite, TPU engine vs CPU engine.
 
-Workload mirrors the reference's first-line benchmark shape
-(integration_tests hash_aggregate / BASELINE.json config 1): scan ->
-filter -> GROUP BY k SUM/AVG/COUNT over int/long/double columns.
+Workloads mirror the reference's best-suited shapes (docs/FAQ.md:107-116:
+high-cardinality group-by / join / sort, windows):
 
-Prints ONE JSON line: metric, value (rows/s through the TPU engine),
-vs_baseline (speedup over the CPU fallback engine on the same host —
-the stand-in for Spark-CPU until a cluster baseline exists).
+  q1 aggregate: scan -> filter -> GROUP BY k SUM/AVG/COUNT   (100k groups)
+  q2 join:      shuffled hash join on a 100k-key dimension, then agg
+  q3 sort:      global sort by two keys
+  q4 window:    row_number + running sum over partitions
+
+Prints ONE JSON line: value = total rows processed per second through
+the TPU engine across the suite; vs_baseline = CPU-engine time / TPU
+time on the same host (the stand-in for Spark-CPU until a cluster
+baseline exists).
 """
 
 import json
@@ -18,52 +23,89 @@ import numpy as np
 import pyarrow as pa
 
 
-def make_table(n_rows: int, n_groups: int) -> pa.Table:
+def make_tables(n_rows: int):
     rng = np.random.default_rng(42)
-    return pa.table({
-        "k": pa.array(rng.integers(0, n_groups, n_rows).astype(np.int64)),
+    fact = pa.table({
+        "k": pa.array(rng.integers(0, 100_000, n_rows).astype(np.int64)),
         "v": pa.array(rng.integers(-(10**6), 10**6, n_rows).astype(np.int64)),
         "f": pa.array(rng.random(n_rows)),
     })
+    dim = pa.table({
+        "k": pa.array(np.arange(100_000, dtype=np.int64)),
+        "w": pa.array(rng.random(100_000)),
+    })
+    return fact, dim
 
 
-def run_query(session, table):
+def queries(session, fact, dim):
     from spark_rapids_tpu.api import functions as F
     from spark_rapids_tpu.api.column import col
-    df = session.create_dataframe(table)
-    return (df.filter(col("v") > -(10**6) // 2)
-              .group_by(col("k"))
-              .agg(F.sum(col("v")).alias("sv"),
-                   F.avg(col("f")).alias("af"),
-                   F.count("*").alias("c"))
-              .collect())
+    from spark_rapids_tpu.expr.window import WindowBuilder
+
+    fdf = session.create_dataframe(fact)
+    ddf = session.create_dataframe(dim)
+
+    def q1():
+        return (fdf.filter(col("v") > -(10**6) // 2)
+                .group_by(col("k"))
+                .agg(F.sum(col("v")).alias("sv"),
+                     F.avg(col("f")).alias("af"),
+                     F.count("*").alias("c"))
+                .collect())
+
+    def q2():
+        return (fdf.join(ddf, on="k", how="inner")
+                .group_by(col("k"))
+                .agg(F.sum(col("w")).alias("sw"))
+                .collect())
+
+    def q3():
+        return fdf.sort(col("k"), col("v")).collect()
+
+    def q4():
+        w = WindowBuilder().partition_by(col("k")).order_by(col("v"))
+        return (fdf.select(col("k"), col("v"),
+                           F.row_number().over(w).alias("rn"),
+                           F.sum(col("v")).over(w).alias("rs"))
+                .collect())
+
+    return [("agg", q1), ("join", q2), ("sort", q3), ("window", q4)]
 
 
-def time_engine(enabled: bool, table, repeats: int = 3) -> float:
+def time_engine(enabled: bool, fact, dim, repeats: int = 2):
     from spark_rapids_tpu.api.session import TpuSession
     s = TpuSession.builder().config("spark.rapids.sql.enabled",
                                     enabled).get_or_create()
-    run_query(s, table)  # warmup (compile)
-    best = float("inf")
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        out = run_query(s, table)
-        best = min(best, time.perf_counter() - t0)
-    assert out.num_rows > 0
-    return best
+    qs = queries(s, fact, dim)
+    per_query = {}
+    for name, q in qs:
+        q()  # warmup (compile)
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = q()
+            best = min(best, time.perf_counter() - t0)
+        assert out.num_rows > 0
+        per_query[name] = best
+    return per_query
 
 
 def main():
-    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
-    table = make_table(n_rows, n_groups=100_000)
-    tpu_t = time_engine(True, table)
-    cpu_t = time_engine(False, table)
-    value = n_rows / tpu_t
+    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    fact, dim = make_tables(n_rows)
+    tpu = time_engine(True, fact, dim)
+    cpu = time_engine(False, fact, dim)
+    tpu_total = sum(tpu.values())
+    cpu_total = sum(cpu.values())
+    # rows processed: each of the 4 queries consumes the fact table once
+    value = (4 * n_rows) / tpu_total
     print(json.dumps({
-        "metric": "hash_agg_pipeline_rows_per_sec",
+        "metric": "sql_suite_rows_per_sec",
         "value": round(value, 1),
         "unit": "rows/s",
-        "vs_baseline": round(cpu_t / tpu_t, 3),
+        "vs_baseline": round(cpu_total / tpu_total, 3),
+        "detail": {k: {"tpu_s": round(tpu[k], 3),
+                       "cpu_s": round(cpu[k], 3)} for k in tpu},
     }))
 
 

@@ -210,6 +210,15 @@ class DataFrame:
     def select_expr_window(self, *window_exprs) -> "DataFrame":
         return DataFrame(L.Window(list(window_exprs), self._lp), self.session)
 
+    def mapInPandas(self, fn, schema) -> "DataFrame":
+        """Map partitions through fn(iterator[pd.DataFrame]) ->
+        iterator[pd.DataFrame] (ref GpuMapInPandasExec)."""
+        names, dtypes = _parse_schema(schema)
+        return DataFrame(L.MapInPandas(fn, names, dtypes, self._lp),
+                         self.session)
+
+    map_in_pandas = mapInPandas
+
     # -- caching ------------------------------------------------------------
     def cache(self) -> "DataFrame":
         """Mark for parquet-cached-batch materialization on the next
@@ -319,8 +328,52 @@ class GroupedData:
             return e.transform_up(fn)
         return expand, grouping, rewrite
 
+    def applyInPandas(self, fn, schema) -> DataFrame:
+        """Grouped-map pandas UDF (ref GpuFlatMapGroupsInPandasExec)."""
+        names, dtypes = _parse_schema(schema)
+        return DataFrame(L.FlatMapGroupsInPandas(
+            self.grouping, fn, names, dtypes, self.df._lp),
+            self.df.session)
+
+    apply_in_pandas = applyInPandas
+
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        return CoGroupedData(self, other)
+
     def agg(self, *aggs) -> DataFrame:
         from ..expr.aggregates import AggregateExpression
+        from .functions import PandasAggUDF
+        # grouped-aggregate pandas UDFs route the whole aggregate through
+        # AggregateInPandasExec (ref GpuAggregateInPandasExec); mixing
+        # with regular aggregates is unsupported, like pyspark
+        pandas_specs = []
+        plain = []
+        for a in aggs:
+            e = a.expr if isinstance(a, Column) else a
+            name = a._alias if isinstance(a, Column) else None
+            if isinstance(e, Alias) and isinstance(e.child, PandasAggUDF):
+                name, e = e.name, e.child
+            if isinstance(e, PandasAggUDF):
+                in_cols = [c.name if isinstance(c, AttributeReference)
+                           else None for c in e.children]
+                if any(c is None for c in in_cols):
+                    raise TypeError(
+                        "grouped-agg pandas UDF arguments must be plain "
+                        "columns")
+                pandas_specs.append(
+                    (name or e.sql(), e.fn, e.rt, in_cols))
+            else:
+                plain.append(a)
+        if pandas_specs:
+            if plain:
+                raise TypeError("cannot mix pandas grouped-agg UDFs with "
+                                "built-in aggregates")
+            if not all(isinstance(k, AttributeReference)
+                       for k in self.grouping):
+                raise TypeError("pandas grouped-agg needs plain column "
+                                "grouping keys")
+            return DataFrame(L.AggregateInPandas(
+                self.grouping, pandas_specs, self.df._lp), self.df.session)
         out = []
         gid_aliases = []  # grouping_id() projections (rollup/cube only)
         for a in aggs:
@@ -398,3 +451,56 @@ class GroupedData:
 
     def max(self, *cols) -> DataFrame:
         return self._simple("max", list(cols))
+
+
+class CoGroupedData:
+    """Pair of grouped frames for cogrouped-map pandas UDFs
+    (ref GpuFlatMapCoGroupsInPandasExec)."""
+
+    def __init__(self, left: GroupedData, right: GroupedData):
+        self.left = left
+        self.right = right
+
+    def applyInPandas(self, fn, schema) -> DataFrame:
+        names, dtypes = _parse_schema(schema)
+        return DataFrame(L.CoGroupMapInPandas(
+            self.left.grouping, self.right.grouping, fn, names, dtypes,
+            self.left.df._lp, self.right.df._lp), self.left.df.session)
+
+    apply_in_pandas = applyInPandas
+
+
+def _parse_schema(schema):
+    """'a int, b double' | pa.Schema | [(name, DataType)] -> names, types."""
+    from ..columnar.interop import from_arrow_type
+    if isinstance(schema, pa.Schema):
+        return list(schema.names), [from_arrow_type(f.type) for f in schema]
+    if isinstance(schema, str):
+        from .column import _parse_type
+        names, dtypes = [], []
+        # split on commas at paren depth 0 so decimal(p,s) survives
+        parts, depth, cur = [], 0, []
+        for ch in schema:
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            if ch == "," and depth == 0:
+                parts.append("".join(cur))
+                cur = []
+            else:
+                cur.append(ch)
+        if cur:
+            parts.append("".join(cur))
+        for part in parts:
+            toks = part.strip().split(None, 1)
+            if len(toks) != 2:
+                raise ValueError(f"cannot parse schema field {part!r}")
+            names.append(toks[0])
+            dtypes.append(_parse_type(toks[1].strip()))
+        return names, dtypes
+    names, dtypes = [], []
+    for name, dt in schema:
+        names.append(name)
+        dtypes.append(dt)
+    return names, dtypes

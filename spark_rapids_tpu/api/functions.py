@@ -314,9 +314,12 @@ def udf(f=None, returnType=None):
     return wrap if f is None else wrap(f)
 
 
-def pandas_udf(f=None, returnType=None):
+def pandas_udf(f=None, returnType=None, functionType=None):
     """Vectorized (pandas Series -> Series) UDF
-    (ref GpuArrowEvalPythonExec pandas path)."""
+    (ref GpuArrowEvalPythonExec pandas path).
+
+    functionType="grouped_agg" creates a Series -> scalar aggregate for
+    use in groupBy().agg() (ref GpuAggregateInPandasExec)."""
     from .. import types as t
     from ..udf.python_udf import PythonUDF
 
@@ -324,16 +327,44 @@ def pandas_udf(f=None, returnType=None):
         f, returnType = None, f
     rt = returnType or t.DOUBLE
 
+    if functionType not in (None, "scalar", "grouped_agg"):
+        raise ValueError(
+            f"unsupported pandas_udf functionType {functionType!r}; use "
+            f"'scalar', 'grouped_agg', or the dedicated APIs "
+            f"(mapInPandas / applyInPandas) for map-style UDFs")
+
     def wrap(fn):
-        def call(*cols) -> Column:
-            return _c(PythonUDF(fn, rt, [_expr(c) for c in cols],
-                                vectorized=True))
+        if functionType == "grouped_agg":
+            def call(*cols) -> Column:
+                return _c(PandasAggUDF(fn, rt, [_expr(c) for c in cols]))
+        else:
+            def call(*cols) -> Column:
+                return _c(PythonUDF(fn, rt, [_expr(c) for c in cols],
+                                    vectorized=True))
         call.__name__ = getattr(fn, "__name__", "pandas_udf")
         call.func = fn
         call.returnType = rt
         return call
 
     return wrap if f is None else wrap(f)
+
+
+class PandasAggUDF(Expression):
+    """Marker expression: a grouped-aggregate pandas UDF call.  Consumed
+    by GroupedData.agg, which routes the whole aggregate through
+    AggregateInPandasExec (never evaluated directly)."""
+
+    def __init__(self, fn, rt, args):
+        self.fn = fn
+        self.rt = rt
+        self.children = tuple(args)
+
+    def data_type(self):
+        return self.rt
+
+    def sql(self):
+        name = getattr(self.fn, "__name__", "pandas_agg")
+        return f"{name}({', '.join(c.sql() for c in self.children)})"
 
 
 def native_udf(impl, *cols) -> Column:

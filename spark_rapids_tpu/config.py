@@ -261,10 +261,11 @@ SHUFFLE_MANAGER_ENABLED = conf("spark.rapids.shuffle.enabled").boolean() \
 
 SHUFFLE_TRANSPORT = conf("spark.rapids.shuffle.transport").string() \
     .doc("Accelerated shuffle transport: 'ici' (mesh collectives inside a "
-         "pod slice), 'tcp' (host sockets across pods), 'none' (fall back to "
-         "serialized base shuffle).") \
+         "pod slice), 'tcp' (host sockets across pods), 'none' (serialized "
+         "base shuffle).  Opt-in like the reference's RapidsShuffleManager "
+         "(rapids-shuffle.md setup).") \
     .check_values(["ici", "tcp", "none"]) \
-    .create_with_default("ici")
+    .create_with_default("none")
 
 SHUFFLE_COMPRESSION_CODEC = conf("spark.rapids.shuffle.compression.codec").string() \
     .doc("Codec for shuffle payloads: none, lz4, zstd (native codec library).") \
@@ -362,6 +363,18 @@ OPTIMIZER_EXPLAIN = conf("spark.rapids.sql.optimizer.explain").string() \
     .check_values(["NONE", "ALL"]).create_with_default("NONE")
 
 # --- metrics / test hooks -------------------------------------------------
+
+COMPILATION_CACHE_ENABLED = conf(
+    "spark.rapids.tpu.compilationCache.enabled").boolean() \
+    .doc("Persist XLA executables across queries and sessions so "
+         "re-planned queries skip compilation (keyed by platform + XLA "
+         "flags fingerprint).") \
+    .create_with_default(True)
+
+COMPILATION_CACHE_DIR = conf("spark.rapids.tpu.compilationCache.dir") \
+    .string() \
+    .doc("Directory for the persistent XLA compilation cache.") \
+    .create_with_default("~/.cache/spark_rapids_tpu_xla")
 
 PROFILE_TRACE_ANNOTATIONS = conf(
     "spark.rapids.sql.profile.traceAnnotations").boolean() \

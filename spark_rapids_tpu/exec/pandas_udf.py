@@ -1,0 +1,287 @@
+"""Pandas UDF operator family: map / grouped-map / grouped-aggregate /
+cogrouped-map.
+
+Ref: sql-plugin/.../execution/python/{GpuMapInPandasExec,
+GpuFlatMapGroupsInPandasExec, GpuAggregateInPandasExec,
+GpuFlatMapCoGroupsInPandasExec}.scala — the reference streams Arrow
+batches to out-of-process pandas workers and reassembles columnar
+output.  Our executors are Python, so the exchange is in-process pandas
+(the worker-protocol plumbing drops away; grouping/rebatching semantics
+are preserved).  All placements are CPU — the data leaves the device for
+Python either way, and the rewrite engine inserts the DeviceToHost
+transition exactly as the reference schedules its device->Arrow copy.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from .. import types as t
+from ..columnar.device import DeviceBatch, batch_to_device
+from ..columnar.interop import to_arrow_schema
+from .base import (CPU, NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, Batch,
+                   Exec, ExecContext, MetricTimer, to_host_batch)
+
+
+def _from_pandas(pdf, schema: pa.Schema) -> pa.Table:
+    """pandas -> Arrow cast to the declared schema IMMEDIATELY, so
+    per-group dtype drift (e.g. int->float promotion under nulls) cannot
+    poison the concat."""
+    tbl = pa.Table.from_pandas(pdf, preserve_index=False)
+    return tbl.select(schema.names).cast(schema)
+
+
+def _batches_to_table(exec_node: Exec, pid: int, ctx) -> pa.Table:
+    rbs = []
+    for b in exec_node.execute_partition(pid, ctx):
+        rb = to_host_batch(b, exec_node.output_names)
+        if rb.num_rows:
+            rbs.append(rb)
+    schema = to_arrow_schema(exec_node.output_names, exec_node.output_types)
+    if not rbs:
+        return schema.empty_table()
+    return pa.Table.from_batches([rb.cast(schema) for rb in rbs])
+
+
+def _emit_table(self_node: Exec, tbl: pa.Table,
+                max_rows: int) -> Iterator[Batch]:
+    schema = to_arrow_schema(self_node.output_names, self_node.output_types)
+    tbl = tbl.cast(schema)
+    for rb in tbl.combine_chunks().to_batches(max_chunksize=max_rows):
+        if rb.num_rows == 0:
+            continue
+        b = batch_to_device(rb, xp=np)
+        self_node.metrics[NUM_OUTPUT_ROWS] += rb.num_rows
+        self_node.metrics[NUM_OUTPUT_BATCHES] += 1
+        yield b
+
+
+class MapInPandasExec(Exec):
+    """df.mapInPandas(fn, schema): fn(iterator[pd.DataFrame]) ->
+    iterator[pd.DataFrame] (ref GpuMapInPandasExec)."""
+
+    deliberate_cpu = True
+
+    placement = CPU
+
+    def __init__(self, fn: Callable, names, dtypes, child: Exec):
+        super().__init__([child])
+        self.fn = fn
+        self._names = list(names)
+        self._types = list(dtypes)
+
+    @property
+    def output_names(self):
+        return self._names
+
+    @property
+    def output_types(self):
+        return self._types
+
+    def describe(self):
+        return f"MapInPandas({getattr(self.fn, '__name__', 'fn')})"
+
+    def execute_partition(self, pid, ctx: ExecContext) -> Iterator[Batch]:
+        limit = ctx.conf.arrow_max_records_per_batch
+        child = self.children[0]
+
+        def pdf_iter():
+            for b in child.execute_partition(pid, ctx):
+                rb = to_host_batch(b, child.output_names)
+                if rb.num_rows:
+                    yield rb.to_pandas()
+
+        schema = to_arrow_schema(self.output_names, self.output_types)
+        with MetricTimer(self.metrics[OP_TIME]):
+            outs = [_from_pandas(pdf, schema)
+                    for pdf in self.fn(pdf_iter()) if len(pdf)]
+        if not outs:
+            return
+        yield from _emit_table(self, pa.concat_tables(outs), limit)
+
+
+def _group_tables(tbl: pa.Table, key_names: List[str]):
+    """Split a table into (key_tuple -> sub-table), null-safe grouping."""
+    import pandas as pd
+    if tbl.num_rows == 0:
+        return {}
+    pdf = tbl.to_pandas()
+    groups = {}
+    grouped = pdf.groupby(key_names, dropna=False, sort=True)
+    for key, sub in grouped:
+        if not isinstance(key, tuple):
+            key = (key,)
+        # normalize NaN keys to None for dict identity
+        key = tuple(None if (isinstance(k, float) and k != k) or
+                    k is pd.NaT else k for k in key)
+        groups[key] = sub.reset_index(drop=True)
+    return groups
+
+
+class FlatMapGroupsInPandasExec(Exec):
+    """groupBy(k).applyInPandas(fn, schema)
+    (ref GpuFlatMapGroupsInPandasExec).  The planner co-locates groups
+    with a hash exchange first, like the aggregate path."""
+
+    deliberate_cpu = True
+
+    placement = CPU
+
+    def __init__(self, key_names: List[str], fn: Callable, names, dtypes,
+                 child: Exec):
+        super().__init__([child])
+        self.key_names = list(key_names)
+        self.fn = fn
+        self._names = list(names)
+        self._types = list(dtypes)
+
+    @property
+    def output_names(self):
+        return self._names
+
+    @property
+    def output_types(self):
+        return self._types
+
+    def describe(self):
+        return (f"FlatMapGroupsInPandas(keys=[{', '.join(self.key_names)}],"
+                f" {getattr(self.fn, '__name__', 'fn')})")
+
+    def execute_partition(self, pid, ctx: ExecContext) -> Iterator[Batch]:
+        limit = ctx.conf.arrow_max_records_per_batch
+        tbl = _batches_to_table(self.children[0], pid, ctx)
+        schema = to_arrow_schema(self.output_names, self.output_types)
+        with MetricTimer(self.metrics[OP_TIME]):
+            outs = []
+            for _, pdf in sorted(_group_tables(tbl, self.key_names).items(),
+                                 key=lambda kv: tuple(
+                                     (k is None, k) for k in kv[0])):
+                res = self.fn(pdf)
+                if len(res):
+                    outs.append(_from_pandas(res, schema))
+        if not outs:
+            return
+        yield from _emit_table(self, pa.concat_tables(outs), limit)
+
+
+class AggregateInPandasExec(Exec):
+    """groupBy(k).agg(pandas_udf_series_to_scalar(col))
+    (ref GpuAggregateInPandasExec): one output row per group, keys then
+    one column per UDF."""
+
+    deliberate_cpu = True
+
+    placement = CPU
+
+    def __init__(self, key_names: List[str],
+                 udfs: Sequence[Tuple[str, Callable, t.DataType,
+                                      List[str]]],
+                 child: Exec):
+        super().__init__([child])
+        self.key_names = list(key_names)
+        self.udfs = list(udfs)  # (out_name, fn, ret_type, input_col_names)
+
+    @property
+    def output_names(self):
+        return self.key_names + [n for n, *_ in self.udfs]
+
+    @property
+    def output_types(self):
+        child = self.children[0]
+        by_name = dict(zip(child.output_names, child.output_types))
+        return [by_name[k] for k in self.key_names] + \
+            [rt for _, _, rt, _ in self.udfs]
+
+    def describe(self):
+        return (f"AggregateInPandas(keys=[{', '.join(self.key_names)}], "
+                f"fns=[{', '.join(n for n, *_ in self.udfs)}])")
+
+    def execute_partition(self, pid, ctx: ExecContext) -> Iterator[Batch]:
+        limit = ctx.conf.arrow_max_records_per_batch
+        tbl = _batches_to_table(self.children[0], pid, ctx)
+        with MetricTimer(self.metrics[OP_TIME]):
+            rows = {n: [] for n in self.output_names}
+            if self.key_names:
+                groups = sorted(_group_tables(tbl, self.key_names).items(),
+                                key=lambda kv: tuple(
+                                    (k is None, k) for k in kv[0]))
+            else:
+                groups = [((), tbl.to_pandas())]  # global aggregate
+            for key, pdf in groups:
+                for k_name, k_val in zip(self.key_names, key):
+                    rows[k_name].append(k_val)
+                for out_name, fn, _, in_cols in self.udfs:
+                    args = [pdf[c] for c in in_cols]
+                    rows[out_name].append(fn(*args))
+        first = self.output_names[0]
+        if not rows[first]:
+            return
+        arrays = []
+        schema = to_arrow_schema(self.output_names, self.output_types)
+        for f in schema:
+            arrays.append(pa.array(rows[f.name], type=f.type))
+        tbl_out = pa.Table.from_arrays(arrays, schema=schema)
+        yield from _emit_table(self, tbl_out, limit)
+
+
+class FlatMapCoGroupsInPandasExec(Exec):
+    """a.groupBy(k).cogroup(b.groupBy(k)).applyInPandas(fn, schema)
+    (ref GpuFlatMapCoGroupsInPandasExec): fn(left_pdf, right_pdf) per key
+    present on either side."""
+
+    deliberate_cpu = True
+
+    placement = CPU
+
+    def __init__(self, left_keys: List[str], right_keys: List[str],
+                 fn: Callable, names, dtypes, left: Exec, right: Exec):
+        super().__init__([left, right])
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.fn = fn
+        self._names = list(names)
+        self._types = list(dtypes)
+
+    @property
+    def output_names(self):
+        return self._names
+
+    @property
+    def output_types(self):
+        return self._types
+
+    @property
+    def num_partitions(self):
+        return self.children[0].num_partitions
+
+    def describe(self):
+        return (f"FlatMapCoGroupsInPandas(keys="
+                f"[{', '.join(self.left_keys)}])")
+
+    def execute_partition(self, pid, ctx: ExecContext) -> Iterator[Batch]:
+        limit = ctx.conf.arrow_max_records_per_batch
+        ltbl = _batches_to_table(self.children[0], pid, ctx)
+        rtbl = _batches_to_table(self.children[1], pid, ctx)
+        lgroups = _group_tables(ltbl, self.left_keys)
+        rgroups = _group_tables(rtbl, self.right_keys)
+        keys = sorted(set(lgroups) | set(rgroups),
+                      key=lambda kv: tuple((k is None, k) for k in kv))
+        schema = to_arrow_schema(self.output_names, self.output_types)
+        with MetricTimer(self.metrics[OP_TIME]):
+            outs = []
+            for key in keys:
+                lpdf = lgroups.get(key)
+                rpdf = rgroups.get(key)
+                if lpdf is None:
+                    lpdf = ltbl.schema.empty_table().to_pandas()
+                if rpdf is None:
+                    rpdf = rtbl.schema.empty_table().to_pandas()
+                res = self.fn(lpdf, rpdf)
+                if len(res):
+                    outs.append(_from_pandas(res, schema))
+        if not outs:
+            return
+        yield from _emit_table(self, pa.concat_tables(outs), limit)

@@ -1,0 +1,128 @@
+"""ICI-routed physical operators: plug the SPMD mesh stages into the
+regular query path.
+
+Ref: the reference substitutes its accelerated UCX shuffle under
+`spark.rapids.shuffle.transport` (GpuShuffleEnv.isRapidsShuffleEnabled →
+RapidsShuffleInternalManagerBase); here
+`spark.rapids.shuffle.transport=ici` + a multi-chip mesh substitutes the
+fused partial→all_to_all→final aggregate stage
+(parallel/distributed.py) for the host-orchestrated
+partial→exchange→final triple.  A post-conversion pass rewrites the plan
+exactly where the reference's shuffle manager would take over the
+exchange.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from .. import config as cfg
+from ..exec.base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU,
+                         Batch, Exec, MetricTimer, to_host_batch)
+from ..columnar.interop import to_arrow_schema
+
+
+class IciAggregateExec(Exec):
+    """Fused distributed GROUP BY over the device mesh (replaces
+    final ← exchange ← partial; one XLA program, rows ride ICI)."""
+
+    placement = TPU
+
+    def __init__(self, final_agg, mesh=None):
+        from .mesh import build_mesh
+        exchange = final_agg.children[0]
+        partial = exchange.children[0]
+        source = partial.children[0]
+        super().__init__([source])
+        self.final_agg = final_agg
+        self.partial = partial
+        self.mesh = mesh or build_mesh()
+        from .distributed import DistributedAggregate
+        self._dagg = DistributedAggregate(
+            partial.grouping, partial.aggregates,
+            source.output_names, source.output_types, mesh=self.mesh)
+
+    @property
+    def output_names(self):
+        return self.final_agg.output_names
+
+    @property
+    def output_types(self):
+        return self.final_agg.output_types
+
+    @property
+    def num_partitions(self):
+        return 1
+
+    def describe(self):
+        n = self.mesh.shape[self._dagg.axis]
+        return f"IciAggregate({n} chips, all_to_all)"
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        from ..columnar.device import batch_to_device
+        source = self.children[0]
+        n_dev = self._dagg.n_dev
+        rbs = []
+        for spid in range(source.num_partitions):
+            for b in source.execute_partition(spid, ctx):
+                rb = to_host_batch(b, source.output_names)
+                if rb.num_rows:
+                    rbs.append(rb)
+        schema = to_arrow_schema(source.output_names, source.output_types)
+        tbl = pa.Table.from_batches([rb.cast(schema) for rb in rbs],
+                                    schema=schema) if rbs else \
+            schema.empty_table()
+        per = max(1, -(-tbl.num_rows // n_dev))
+        shards = [tbl.slice(i * per, per) for i in range(n_dev)]
+        with MetricTimer(self.metrics[OP_TIME]):
+            out = self._dagg.run(shards)
+        for rb in out.combine_chunks().to_batches():
+            if rb.num_rows == 0:
+                continue
+            batch = batch_to_device(rb, xp=self.xp)
+            self.metrics[NUM_OUTPUT_ROWS] += rb.num_rows
+            self.metrics[NUM_OUTPUT_BATCHES] += 1
+            yield batch
+
+
+def install_ici_stages(root: Exec, conf: cfg.RapidsConf) -> Exec:
+    """Post-conversion rewrite: final←exchange←partial aggregate triples
+    become one IciAggregateExec when the ICI transport is selected and a
+    multi-chip mesh exists."""
+    if conf.get(cfg.SHUFFLE_TRANSPORT) != "ici":
+        return root
+    import jax
+    if len(jax.devices()) < 2:
+        return root
+    from ..exec.aggregate import TpuHashAggregateExec
+    from ..expr.aggregates import FINAL, PARTIAL
+    from ..shuffle.exchange import ShuffleExchangeExec
+    from ..shuffle.partitioning import HashPartitioning
+    from .alltoall import exchange_supported
+
+    def rewrite(node: Exec) -> Exec:
+        node = node.with_new_children([rewrite(c) for c in node.children])
+        if not (isinstance(node, TpuHashAggregateExec) and
+                node.mode == FINAL and node.grouping):
+            return node
+        ex = node.children[0]
+        if not (isinstance(ex, ShuffleExchangeExec) and
+                isinstance(ex.partitioning, HashPartitioning)):
+            return node
+        part = ex.children[0]
+        if not (isinstance(part, TpuHashAggregateExec) and
+                part.mode == PARTIAL and part.placement == TPU):
+            return node
+        source = part.children[0]
+        if exchange_supported(part.output_types) or \
+                exchange_supported(source.output_types):
+            return node  # nested types ride the host shuffle
+        try:
+            return IciAggregateExec(node)
+        except NotImplementedError:
+            return node
+
+    return rewrite(root)

@@ -250,3 +250,69 @@ class Generate(LogicalPlan):
         gnames, gtypes = g.generator_output()
         names = self._out_names if self._out_names else gnames
         return cn + list(names), ct + list(gtypes)
+
+
+class MapInPandas(LogicalPlan):
+    """df.mapInPandas(fn, schema) (ref GpuMapInPandasExec)."""
+
+    def __init__(self, fn, out_names, out_types, child: LogicalPlan):
+        self.fn = fn
+        self.out_names = list(out_names)
+        self.out_types = list(out_types)
+        self.children = (child,)
+
+    def schema(self):
+        return list(self.out_names), list(self.out_types)
+
+
+class FlatMapGroupsInPandas(LogicalPlan):
+    """groupBy(k).applyInPandas(fn, schema)
+    (ref GpuFlatMapGroupsInPandasExec)."""
+
+    def __init__(self, grouping, fn, out_names, out_types,
+                 child: LogicalPlan):
+        self.grouping = list(grouping)
+        self.fn = fn
+        self.out_names = list(out_names)
+        self.out_types = list(out_types)
+        self.children = (child,)
+
+    def schema(self):
+        return list(self.out_names), list(self.out_types)
+
+
+class AggregateInPandas(LogicalPlan):
+    """groupBy(k).agg(<grouped-agg pandas UDF>)
+    (ref GpuAggregateInPandasExec)."""
+
+    def __init__(self, grouping, udfs, child: LogicalPlan):
+        # udfs: list of (out_name, fn, ret_type, input_col_names)
+        self.grouping = list(grouping)
+        self.udfs = list(udfs)
+        self.children = (child,)
+
+    def schema(self):
+        cn, ct = self.children[0].schema()
+        by_name = dict(zip(cn, ct))
+        names = [k.name for k in self.grouping] + \
+            [n for n, *_ in self.udfs]
+        dtypes = [by_name[k.name] for k in self.grouping] + \
+            [rt for _, _, rt, _ in self.udfs]
+        return names, dtypes
+
+
+class CoGroupMapInPandas(LogicalPlan):
+    """cogroup(...).applyInPandas(fn, schema)
+    (ref GpuFlatMapCoGroupsInPandasExec)."""
+
+    def __init__(self, left_grouping, right_grouping, fn, out_names,
+                 out_types, left: LogicalPlan, right: LogicalPlan):
+        self.left_grouping = list(left_grouping)
+        self.right_grouping = list(right_grouping)
+        self.fn = fn
+        self.out_names = list(out_names)
+        self.out_types = list(out_types)
+        self.children = (left, right)
+
+    def schema(self):
+        return list(self.out_names), list(self.out_types)

@@ -370,6 +370,15 @@ class ExecMeta(BaseMeta):
     def tag(self):
         e = self.exec
         name = type(e).__name__
+        if getattr(e, "deliberate_cpu", False):
+            # python-exchange operators run on CPU by design (the data
+            # crosses into Python either way) — not an acceleration gap
+            self.will_not_work(
+                f"{name} runs on CPU by design (python data exchange)")
+            for c in self.children:
+                c.tag()
+            self.expr_metas = []
+            return
         if not self.conf.is_op_enabled("exec", name):
             self.will_not_work(f"{name} has been disabled by config")
         rule_sig = EXEC_SIGS.get(type(e))
@@ -677,6 +686,8 @@ class TpuOverrides:
             if bad:
                 print("\n".join(bad))
         converted = meta.convert()
+        from ..parallel.ici_exec import install_ici_stages
+        converted = install_ici_stages(converted, self.conf)
         from ..shuffle.aqe import install_aqe_readers
         converted = install_aqe_readers(converted, self.conf)
         return insert_transitions(converted)

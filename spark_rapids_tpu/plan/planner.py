@@ -228,4 +228,48 @@ def _plan_uncached(lp: L.LogicalPlan, conf) -> eb.Exec:
         part = HashPartitioning(lp.keys, lp.num_partitions) if lp.keys \
             else RoundRobinPartitioning(lp.num_partitions)
         return ShuffleExchangeExec(part, child)
+    if isinstance(lp, L.MapInPandas):
+        from ..exec.pandas_udf import MapInPandasExec
+        return MapInPandasExec(lp.fn, lp.out_names, lp.out_types,
+                               plan(lp.children[0], conf))
+    if isinstance(lp, L.FlatMapGroupsInPandas):
+        from ..exec.pandas_udf import FlatMapGroupsInPandasExec
+        child = _colocate_groups(lp.grouping, plan(lp.children[0], conf))
+        return FlatMapGroupsInPandasExec(
+            [k.name for k in lp.grouping], lp.fn, lp.out_names,
+            lp.out_types, child)
+    if isinstance(lp, L.AggregateInPandas):
+        from ..exec.pandas_udf import AggregateInPandasExec
+        child = _colocate_groups(lp.grouping, plan(lp.children[0], conf))
+        return AggregateInPandasExec([k.name for k in lp.grouping],
+                                     lp.udfs, child)
+    if isinstance(lp, L.CoGroupMapInPandas):
+        from ..exec.pandas_udf import FlatMapCoGroupsInPandasExec
+        lplan = plan(lp.children[0], conf)
+        rplan = plan(lp.children[1], conf)
+        # both sides must route equal keys to the same partition id:
+        # murmur3 routing is value-based, so hashing each side on its own
+        # keys with a COMMON partition count co-locates matching groups
+        n = max(lplan.num_partitions, rplan.num_partitions)
+        left = _colocate_groups(lp.left_grouping, lplan, n_parts=n)
+        right = _colocate_groups(lp.right_grouping, rplan, n_parts=n)
+        return FlatMapCoGroupsInPandasExec(
+            [k.name for k in lp.left_grouping],
+            [k.name for k in lp.right_grouping],
+            lp.fn, lp.out_names, lp.out_types, left, right)
     raise NotImplementedError(f"no physical plan for {type(lp).__name__}")
+
+
+def _colocate_groups(grouping, child, n_parts=None):
+    """Hash-exchange so every group lands in one partition (the pandas
+    grouped execs need whole groups, like the aggregate path)."""
+    target = n_parts if n_parts is not None else child.num_partitions
+    if child.num_partitions <= 1 and (n_parts is None or n_parts <= 1):
+        return child
+    if not grouping:
+        from ..exec.gatherpart import GatherPartitionsExec
+        return GatherPartitionsExec(child)
+    from ..shuffle.exchange import ShuffleExchangeExec
+    from ..shuffle.partitioning import HashPartitioning
+    return ShuffleExchangeExec(
+        HashPartitioning(list(grouping), target), child)

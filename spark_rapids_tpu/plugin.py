@@ -155,6 +155,7 @@ class TpuExecutorPlugin:
             problems = self.check_runtime_versions()
             if problems:
                 raise PluginInitError("; ".join(problems))
+            self._init_compilation_cache()
             from .memory.device import DeviceManager
             from .memory.meta import set_default_codec
             from .memory.semaphore import TpuSemaphore
@@ -185,6 +186,34 @@ class TpuExecutorPlugin:
                 import os
                 os._exit(1)  # the reference's System.exit(1) contract
             raise
+
+    def _init_compilation_cache(self):
+        """Persistent XLA compilation cache: re-planned queries re-trace
+        but skip compilation (each collect builds fresh exec instances, so
+        without this every repeated query pays a full XLA compile — the
+        analog of the reference's one-time CUDA kernel load)."""
+        import os
+        if not self.conf.get(cfg.COMPILATION_CACHE_ENABLED):
+            return
+        cache_dir = os.path.expanduser(
+            self.conf.get(cfg.COMPILATION_CACHE_DIR))
+        try:
+            import hashlib
+            import jax
+            # scope by platform + XLA flags: AOT executables compiled
+            # under one CPU-feature set must not load under another
+            # (XLA warns about possible SIGILL on mismatch)
+            fp = hashlib.sha1(
+                f"{jax.__version__}|{jax.default_backend()}|"
+                f"{os.environ.get('XLA_FLAGS', '')}".encode()).hexdigest()[:12]
+            cache_dir = os.path.join(cache_dir, fp)
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+        except Exception as ex:  # cache is an optimization, never fatal
+            log.warning("compilation cache unavailable: %s", ex)
 
     def shutdown(self):
         if self.shuffle_server is not None:

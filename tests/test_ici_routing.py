@@ -1,0 +1,76 @@
+"""ICI transport routing: session queries run the fused mesh aggregate
+when spark.rapids.shuffle.transport=ici and multiple chips exist."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col
+from spark_rapids_tpu.api.session import TpuSession
+
+
+def _session(transport="ici"):
+    return (TpuSession.builder()
+            .config("spark.rapids.sql.enabled", True)
+            .config("spark.rapids.shuffle.transport", transport)
+            .get_or_create())
+
+
+def _names(s):
+    out = []
+    s.last_plan.foreach(lambda e: out.append(type(e).__name__))
+    return out
+
+
+def test_ici_aggregate_routed_and_correct():
+    s = _session()
+    rng = np.random.default_rng(0)
+    n = 5000
+    tb = pa.table({
+        "k": pa.array(rng.integers(0, 64, n).astype(np.int64)),
+        "v": pa.array(rng.integers(-500, 500, n).astype(np.int64)),
+        "f": pa.array(rng.random(n)),
+    })
+    df = s.create_dataframe(tb, num_partitions=4)
+    got = (df.filter(col("v") > -250).group_by(col("k"))
+           .agg(F.sum(col("v")).alias("sv"), F.count("*").alias("c"))
+           .collect().sort_by("k"))
+    assert "IciAggregateExec" in _names(s), _names(s)
+    assert "ShuffleExchangeExec" not in _names(s)
+    import pyarrow.compute as pc
+    flt = tb.filter(pc.greater(tb.column("v"), -250))
+    want = pa.TableGroupBy(flt, ["k"], use_threads=False).aggregate(
+        [("v", "sum"), ("k", "count")]).sort_by("k")
+    assert got.column("k").to_pylist() == want.column("k").to_pylist()
+    assert got.column("sv").to_pylist() == want.column("v_sum").to_pylist()
+    assert got.column("c").to_pylist() == want.column("k_count").to_pylist()
+
+
+def test_ici_aggregate_with_string_keys():
+    s = _session()
+    rng = np.random.default_rng(1)
+    n = 1200
+    keys = [f"key_{int(i)}" for i in rng.integers(0, 40, n)]
+    tb = pa.table({"k": pa.array(keys),
+                   "v": pa.array(rng.integers(0, 100, n).astype(np.int64))})
+    got = (s.create_dataframe(tb, num_partitions=3)
+           .group_by(col("k")).agg(F.sum(col("v")).alias("sv"))
+           .collect().sort_by("k"))
+    assert "IciAggregateExec" in _names(s)
+    want = pa.TableGroupBy(tb, ["k"], use_threads=False).aggregate(
+        [("v", "sum")]).sort_by("k")
+    assert got.column("k").to_pylist() == want.column("k").to_pylist()
+    assert got.column("sv").to_pylist() == want.column("v_sum").to_pylist()
+
+
+def test_tcp_transport_keeps_host_exchange():
+    s = _session(transport="tcp")
+    rng = np.random.default_rng(2)
+    n = 1000
+    tb = pa.table({"k": pa.array(rng.integers(0, 8, n).astype(np.int64)),
+                   "v": pa.array(rng.random(n))})
+    got = (s.create_dataframe(tb, num_partitions=3)
+           .group_by(col("k")).agg(F.count("*").alias("c")).collect())
+    assert "IciAggregateExec" not in _names(s)
+    assert sum(got.column("c").to_pylist()) == n

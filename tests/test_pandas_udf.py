@@ -1,0 +1,123 @@
+"""Pandas UDF operator family tests (ref udf_test.py + the
+GpuMapInPandas/FlatMapGroupsInPandas/AggregateInPandas/
+FlatMapCoGroupsInPandas execs)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col
+from spark_rapids_tpu.api.session import TpuSession
+
+
+def _session():
+    return TpuSession.builder().config("spark.rapids.sql.enabled",
+                                       True).get_or_create()
+
+
+def _table(n=300):
+    rng = np.random.default_rng(0)
+    return pa.table({"k": pa.array(rng.integers(0, 8, n).astype(np.int64)),
+                     "v": pa.array(rng.integers(0, 100, n).astype(np.int64)),
+                     "f": pa.array(rng.random(n))})
+
+
+def test_map_in_pandas():
+    s = _session()
+    tb = _table()
+
+    def double_v(it):
+        for pdf in it:
+            pdf = pdf.copy()
+            pdf["v"] = pdf["v"] * 2
+            yield pdf[["k", "v"]]
+
+    out = (s.create_dataframe(tb, num_partitions=3)
+           .mapInPandas(double_v, "k long, v long").collect())
+    assert out.num_rows == 300
+    assert sorted(out.column("v").to_pylist()) == \
+        sorted((tb.column("v").to_numpy() * 2).tolist())
+
+
+def test_apply_in_pandas_grouped_map():
+    s = _session()
+    tb = _table()
+
+    def center(pdf: pd.DataFrame) -> pd.DataFrame:
+        pdf = pdf.copy()
+        pdf["v"] = pdf["v"] - pdf["v"].mean()
+        return pdf[["k", "v"]]
+
+    out = (s.create_dataframe(tb, num_partitions=4)
+           .group_by(col("k")).applyInPandas(center, "k long, v double")
+           .collect())
+    assert out.num_rows == 300
+    # per-group means of the centered values are ~0
+    got = pa.TableGroupBy(out, ["k"], use_threads=False).aggregate(
+        [("v", "mean")])
+    assert all(abs(m) < 1e-9 for m in got.column("v_mean").to_pylist())
+
+
+def test_grouped_agg_pandas_udf():
+    s = _session()
+    tb = _table()
+    mean_udf = F.pandas_udf(lambda v: float(v.mean()), t.DOUBLE,
+                            functionType="grouped_agg")
+    out = (s.create_dataframe(tb, num_partitions=3)
+           .group_by(col("k"))
+           .agg(mean_udf(col("f")).alias("mf"))
+           .collect().sort_by("k"))
+    want = pa.TableGroupBy(tb, ["k"], use_threads=False).aggregate(
+        [("f", "mean")]).sort_by("k")
+    assert out.column("k").to_pylist() == want.column("k").to_pylist()
+    np.testing.assert_allclose(np.array(out.column("mf")),
+                               np.array(want.column("f_mean")), rtol=1e-12)
+
+
+def test_grouped_agg_global():
+    s = _session()
+    tb = _table()
+    sum_udf = F.pandas_udf(lambda v: int(v.sum()), t.LONG,
+                           functionType="grouped_agg")
+    out = s.create_dataframe(tb).group_by().agg(
+        sum_udf(col("v")).alias("sv")).collect()
+    assert out.column("sv").to_pylist() == [int(tb.column("v").to_numpy()
+                                               .sum())]
+
+
+def test_cogroup_apply_in_pandas():
+    s = _session()
+    left = pa.table({"k": pa.array([1, 1, 2, 3], type=pa.int64()),
+                     "v": pa.array([10, 11, 20, 30], type=pa.int64())})
+    right = pa.table({"k": pa.array([1, 2, 2, 4], type=pa.int64()),
+                      "w": pa.array([100, 200, 201, 400], type=pa.int64())})
+
+    def summarize(lpdf: pd.DataFrame, rpdf: pd.DataFrame) -> pd.DataFrame:
+        k = lpdf["k"].iloc[0] if len(lpdf) else rpdf["k"].iloc[0]
+        return pd.DataFrame({"k": [k],
+                             "lsum": [int(lpdf["v"].sum()) if len(lpdf)
+                                      else 0],
+                             "rsum": [int(rpdf["w"].sum()) if len(rpdf)
+                                      else 0]})
+
+    ldf = s.create_dataframe(left, num_partitions=2)
+    rdf = s.create_dataframe(right, num_partitions=3)
+    out = (ldf.group_by(col("k")).cogroup(rdf.group_by(col("k")))
+           .applyInPandas(summarize, "k long, lsum long, rsum long")
+           .collect().sort_by("k"))
+    assert out.column("k").to_pylist() == [1, 2, 3, 4]
+    assert out.column("lsum").to_pylist() == [21, 20, 30, 0]
+    assert out.column("rsum").to_pylist() == [100, 401, 0, 400]
+
+
+def test_mixing_pandas_agg_with_builtin_raises():
+    s = _session()
+    mean_udf = F.pandas_udf(lambda v: float(v.mean()), t.DOUBLE,
+                            functionType="grouped_agg")
+    df = s.create_dataframe(_table())
+    with pytest.raises(TypeError):
+        df.group_by(col("k")).agg(mean_udf(col("f")),
+                                  F.count("*").alias("c"))
