@@ -221,6 +221,15 @@ class WindowExec(Exec):
                 bufs_sorted.append((vs, val, op))
             whole = (lo_b == UNBOUNDED_PRECEDING and
                      hi_b == UNBOUNDED_FOLLOWING)
+            running = (lo_b == UNBOUNDED_PRECEDING and hi_b == CURRENT_ROW)
+            bounds = None
+            if not whole:
+                seg_end_pos = _run_end_positions(xp, new_seg)
+                run_start_pos = _seg_start_positions(xp, new_run)
+                run_end_pos = _run_end_positions(xp, new_run)
+                bounds = self._frame_bounds(
+                    xp, kind, lo_b, hi_b, pos, seg_start, seg_end_pos,
+                    run_start_pos, run_end_pos, okeys, order, cap)
             results = []
             for vs, val, op in bufs_sorted:
                 if op == "countvalid":
@@ -244,40 +253,57 @@ class WindowExec(Exec):
                                                   else "sum",
                                                   vv, seg_ids, cap, val)
                     results.append((out[seg_ids], cnt[seg_ids]))
-                elif kind == "rows" and lo_b == UNBOUNDED_PRECEDING and \
-                        hi_b == CURRENT_ROW:
+                elif running and kind == "rows" and \
+                        red_op in ("sum", "min", "max"):
                     results.append(self._running(xp, red_op, vv, val,
                                                  new_seg, seg_start))
-                elif kind == "range" and lo_b == UNBOUNDED_PRECEDING and \
-                        hi_b == CURRENT_ROW:
+                elif running and kind == "range" and \
+                        red_op in ("sum", "min", "max"):
                     r, c = self._running(xp, red_op, vv, val, new_seg,
                                          seg_start)
                     run_end = _run_end_positions(xp, new_run)
                     results.append((r[run_end], c[run_end]))
-                elif kind == "rows":
-                    if red_op != "sum":
-                        raise NotImplementedError(
-                            "bounded rows frame supports sum/count/avg")
-                    pre = xp.concatenate([xp.zeros((1,), vv.dtype),
-                                          xp.cumsum(vv)])
-                    cpre = xp.concatenate([xp.zeros((1,), xp.int64),
-                                           xp.cumsum(val.astype(xp.int64))])
-                    seg_end = _run_end_positions(xp, new_seg)
-                    lo_i = xp.clip(pos + lo_b, seg_start, pos + cap)
-                    lo_i = xp.maximum(pos + max(lo_b, -cap), seg_start) \
-                        if lo_b != UNBOUNDED_PRECEDING else seg_start
-                    hi_i = xp.minimum(pos + min(hi_b, cap), seg_end) \
-                        if hi_b != UNBOUNDED_FOLLOWING else seg_end
-                    lo_i = xp.clip(lo_i, 0, cap - 1)
-                    hi_i = xp.clip(hi_i, -1, cap - 1)
-                    empty = hi_i < lo_i
-                    s = pre[hi_i + 1] - pre[lo_i]
-                    c = cpre[hi_i + 1] - cpre[lo_i]
-                    s = xp.where(empty, xp.zeros_like(s), s)
-                    c = xp.where(empty, xp.zeros_like(c), c)
-                    results.append((s, c))
                 else:
-                    raise NotImplementedError(f"frame {kind} {lo_b} {hi_b}")
+                    lo_i, hi_i = bounds
+                    lo_c = xp.clip(lo_i, 0, cap - 1)
+                    hi_c = xp.clip(hi_i, -1, cap - 1)
+                    empty = hi_c < lo_c
+                    cpre = xp.concatenate([
+                        xp.zeros((1,), xp.int64),
+                        xp.cumsum(val.astype(xp.int64))])
+                    c = cpre[hi_c + 1] - cpre[lo_c]
+                    c = xp.where(empty, xp.zeros_like(c), c)
+                    if red_op == "sum":
+                        pre = xp.concatenate([xp.zeros((1,), vv.dtype),
+                                              xp.cumsum(vv)])
+                        s = pre[hi_c + 1] - pre[lo_c]
+                        s = xp.where(empty, xp.zeros_like(s), s)
+                        results.append((s, c))
+                    elif red_op in ("min", "max"):
+                        # vv is already init-masked under invalid rows
+                        s = _rmq_query(xp, vv, lo_c, hi_c, cap, red_op)
+                        results.append((s, c))
+                    elif red_op in ("first", "last"):
+                        if red_op == "first":
+                            # first VALID index >= lo_i (ignore-nulls uses
+                            # the valid-count prefix; include-nulls is the
+                            # frame head itself)
+                            idx = xp.searchsorted(
+                                cpre, cpre[lo_c] + 1, side="left") - 1 \
+                                if op == "first" else lo_c
+                        else:
+                            idx = xp.searchsorted(
+                                cpre, cpre[hi_c + 1], side="left") - 1 \
+                                if op == "last" else hi_c
+                        idx = xp.clip(idx, 0, cap - 1)
+                        in_frame = (idx >= lo_c) & (idx <= hi_c) & ~empty
+                        s = vs[idx]
+                        c = xp.where(in_frame & val[idx],
+                                     xp.ones_like(c), xp.zeros_like(c))
+                        results.append((s, c))
+                    else:
+                        raise NotImplementedError(
+                            f"bounded frame op {red_op}")
             # evaluate the aggregate from its (broadcast) buffers
             buf_cols = []
             for (data, cnt), (expr, op) in zip(results, upd):
@@ -295,6 +321,46 @@ class WindowExec(Exec):
                 xp.ones((cap,), dtype=bool)
             return finish(res.col.data, valid)
         raise NotImplementedError(f"window function {type(func).__name__}")
+
+    def _frame_bounds(self, xp, kind, lo_b, hi_b, pos, seg_start, seg_end,
+                      run_start, run_end, okeys, order, cap):
+        """Per-row inclusive [lo_i, hi_i] frame index bounds over the
+        sorted row space, for bounded ROWS and RANGE frames."""
+        if kind == "rows":
+            lo_i = seg_start.astype(xp.int64) \
+                if lo_b == UNBOUNDED_PRECEDING else \
+                xp.clip(pos + lo_b, seg_start, seg_end + 1)
+            hi_i = seg_end.astype(xp.int64) \
+                if hi_b == UNBOUNDED_FOLLOWING else \
+                xp.clip(pos + hi_b, seg_start - 1, seg_end)
+            return lo_i.astype(xp.int64), hi_i.astype(xp.int64)
+        # range: exactly one ascending flat-numeric order key (tagging
+        # enforces this); null order rows frame over their peer run
+        oc, _, nf = okeys[0]
+        vals_s = oc.data[order]
+        ovalid_s = oc.validity[order] if oc.validity is not None else \
+            xp.ones((cap,), dtype=bool)
+        # park nulls outside every finite search window
+        park = seg._extreme_init(xp, vals_s.dtype, is_min=not nf)
+        masked = xp.where(ovalid_s, vals_s, xp.full_like(vals_s, park))
+        if lo_b == UNBOUNDED_PRECEDING:
+            lo_i = seg_start.astype(xp.int64)
+        elif lo_b == CURRENT_ROW:
+            lo_i = run_start.astype(xp.int64)
+        else:
+            lo_i = _vec_bound(xp, masked, vals_s + lo_b, seg_start,
+                              seg_end + 1, cap, left=True)
+        if hi_b == UNBOUNDED_FOLLOWING:
+            hi_i = seg_end.astype(xp.int64)
+        elif hi_b == CURRENT_ROW:
+            hi_i = run_end.astype(xp.int64)
+        else:
+            hi_i = _vec_bound(xp, masked, vals_s + hi_b, seg_start,
+                              seg_end + 1, cap, left=False) - 1
+        null_row = ~ovalid_s
+        lo_i = xp.where(null_row, run_start.astype(xp.int64), lo_i)
+        hi_i = xp.where(null_row, run_end.astype(xp.int64), hi_i)
+        return lo_i, hi_i
 
     def _running(self, xp, red_op, vv, val, new_seg, seg_start):
         if red_op == "sum":
@@ -342,3 +408,51 @@ class WindowExec(Exec):
         self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
         self.metrics[NUM_OUTPUT_BATCHES] += 1
         yield out
+
+
+def _vec_bound(xp, values, target, lo0, hi0, cap, left: bool):
+    """Vectorized per-row binary search: first index in [lo0, hi0) where
+    values[i] >= target (left) / > target (right).  `values` must be
+    ascending within each row's [lo0, hi0) window."""
+    import math
+    lo = lo0.astype(xp.int64)
+    hi = hi0.astype(xp.int64)
+    iters = max(1, int(math.ceil(math.log2(max(cap, 2)))) + 1)
+    for _ in range(iters):
+        active = lo < hi
+        mid = (lo + hi) // 2
+        v = values[xp.clip(mid, 0, cap - 1)]
+        pred = (v < target) if left else (v <= target)
+        lo = xp.where(active & pred, mid + 1, lo)
+        hi = xp.where(active & ~pred, mid, hi)
+    return lo
+
+
+def _rmq_query(xp, vv, lo_i, hi_i, cap, op: str):
+    """min/max over inclusive [lo_i, hi_i] per row via doubling (sparse
+    table) — O(cap log cap), idempotent ops only."""
+    import math
+    from ..ops import segmented as seg
+    is_min = op == "min"
+    init = seg._extreme_init(xp, vv.dtype, is_min)
+    fn = xp.minimum if is_min else xp.maximum
+    levels = max(1, int(math.ceil(math.log2(max(cap, 2)))))
+    st = [vv]
+    for k in range(levels):
+        sh = 1 << k
+        cur = st[-1]
+        shifted = xp.concatenate(
+            [cur[sh:], xp.full((sh,), init, cur.dtype)])
+        st.append(fn(cur, shifted))
+    length = hi_i - lo_i + 1
+    k_row = xp.zeros((cap,), xp.int32)
+    for j in range(1, levels + 1):
+        k_row = xp.where(length >= (1 << j), j, k_row)
+    lo_c = xp.clip(lo_i, 0, cap - 1).astype(xp.int32)
+    res = xp.full((cap,), init, vv.dtype)
+    for j in range(levels + 1):
+        span = 1 << j
+        b = xp.clip(hi_i - span + 1, 0, cap - 1).astype(xp.int32)
+        val = fn(st[j][lo_c], st[j][b])
+        res = xp.where((k_row == j) & (length >= 1), val, res)
+    return res

@@ -569,6 +569,8 @@ def _tag_window(meta: ExecMeta):
     from ..expr.aggregates import (AggregateFunction, Average, Count, First,
                                    Last, Max, Min, Sum)
     e: WindowExec = meta.exec
+    cn = e.children[0].output_names
+    ct = e.children[0].output_types
     for w in e.window_exprs:
         f = w.func
         if isinstance(f, AggregateFunction):
@@ -580,11 +582,23 @@ def _tag_window(meta: ExecMeta):
             bounded = not (lo == W.UNBOUNDED_PRECEDING and
                            hi in (W.CURRENT_ROW, W.UNBOUNDED_FOLLOWING))
             if kind == "range" and bounded:
-                meta.will_not_work("bounded range frames not supported")
-            if bounded and isinstance(f, (Min, Max, First, Last)):
-                meta.will_not_work(
-                    f"bounded rows frame with {type(f).__name__} "
-                    "not supported")
+                # bounded range frames need exactly one ascending flat
+                # numeric/date/timestamp order key (binary-search bounds)
+                orders = w.spec.order_by
+                ok = len(orders) == 1 and orders[0][1]
+                if ok:
+                    try:
+                        dt = bind_expression(orders[0][0], cn,
+                                             ct).data_type()
+                        ok = (t.is_numeric(dt) and not
+                              isinstance(dt, t.DecimalType)) or \
+                            isinstance(dt, (t.DateType, t.TimestampType))
+                    except Exception:
+                        ok = False
+                if not ok:
+                    meta.will_not_work(
+                        "bounded range frames need a single ascending "
+                        "numeric/date/timestamp order key")
         elif not isinstance(f, (W.RowNumber, W.Rank, W.DenseRank, W.Lead,
                                 W.Lag, W.NTile)):
             meta.will_not_work(

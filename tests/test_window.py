@@ -6,6 +6,7 @@ checked against independent pandas oracles, not just CPU-vs-TPU.
 
 import numpy as np
 import pandas as pd
+import pyarrow as pa
 import pytest
 
 from spark_rapids_tpu.api import functions as F
@@ -137,3 +138,129 @@ def test_window_differential():
             F.sum(col("v")).over(
                 Window.partition_by(col("k"))).alias("ts"))
     assert_tpu_and_cpu_are_equal_collect(q)
+
+
+# ---------------------------------------------------------------------------
+# widened frames: bounded rows min/max/first/last + bounded range frames
+# (brute-force python oracle for independence from the engine kernels)
+# ---------------------------------------------------------------------------
+
+def _brute_frame(rows, kind, lo_b, hi_b, key_of, val_of, ord_of):
+    """Per-row frame aggregate oracle over (partition, order)-sorted rows."""
+    import math
+    out = []
+    by_part = {}
+    srt = sorted(range(len(rows)),
+                 key=lambda i: (key_of(i), (ord_of(i) is None, ord_of(i) or 0)))
+    for i in srt:
+        by_part.setdefault(key_of(i), []).append(i)
+    frames = {}
+    for part, idxs in by_part.items():
+        for j, i in enumerate(idxs):
+            if kind == "rows":
+                lo = 0 if lo_b is None else max(0, j + lo_b)
+                hi = len(idxs) - 1 if hi_b is None else min(len(idxs) - 1,
+                                                            j + hi_b)
+                frames[i] = [idxs[k] for k in range(lo, hi + 1)] \
+                    if hi >= lo else []
+            else:  # range
+                v = ord_of(i)
+                if v is None:
+                    frames[i] = [k for k in idxs if ord_of(k) is None]
+                    continue
+                lo_t = -math.inf if lo_b is None else v + lo_b
+                hi_t = math.inf if hi_b is None else v + hi_b
+                frames[i] = [k for k in idxs if ord_of(k) is not None and
+                             lo_t <= ord_of(k) <= hi_t]
+    return frames
+
+
+def _window_df(n=200, seed=7):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array(rng.integers(0, 6, n).astype(np.int64)),
+        "o": pa.array(rng.integers(0, 50, n).astype(np.int64)),
+        "v": pa.array([None if i % 11 == 0 else int(x) for i, x in
+                       enumerate(rng.integers(-100, 100, n))],
+                      type=pa.int64()),
+        "rid": pa.array(np.arange(n, dtype=np.int64)),
+    })
+
+
+def test_bounded_rows_min_max(tpu_session):
+    tb = _window_df()
+    s = tpu_session
+    from spark_rapids_tpu.expr.window import WindowBuilder
+    w = (WindowBuilder().partition_by(col("k"))
+         .order_by(col("o"), col("rid")).rows_between(-2, 2))
+    out = (s.create_dataframe(tb)
+           .select(col("rid"), F.min(col("v")).over(w).alias("mn"),
+                   F.max(col("v")).over(w).alias("mx"))
+           .collect().sort_by("rid"))
+    rows = list(range(tb.num_rows))
+    k = tb.column("k").to_pylist()
+    o = tb.column("o").to_pylist()
+    v = tb.column("v").to_pylist()
+    rid = tb.column("rid").to_pylist()
+    frames = _brute_frame(rows, "rows", -2, 2,
+                          key_of=lambda i: k[i],
+                          val_of=lambda i: v[i],
+                          ord_of=lambda i: (o[i], rid[i]))
+    got_mn = out.column("mn").to_pylist()
+    got_mx = out.column("mx").to_pylist()
+    for i in rows:
+        vals = [v[j] for j in frames[i] if v[j] is not None]
+        assert got_mn[i] == (min(vals) if vals else None), i
+        assert got_mx[i] == (max(vals) if vals else None), i
+
+
+def test_bounded_range_sum_count(tpu_session):
+    tb = _window_df()
+    s = tpu_session
+    from spark_rapids_tpu.expr.window import WindowBuilder
+    w = (WindowBuilder().partition_by(col("k"))
+         .order_by(col("o")).range_between(-5, 5))
+    out = (s.create_dataframe(tb)
+           .select(col("rid"), F.sum(col("v")).over(w).alias("sv"),
+                   F.count(col("v")).over(w).alias("cv"))
+           .collect().sort_by("rid"))
+    rows = list(range(tb.num_rows))
+    k = tb.column("k").to_pylist()
+    o = tb.column("o").to_pylist()
+    v = tb.column("v").to_pylist()
+    frames = _brute_frame(rows, "range", -5, 5,
+                          key_of=lambda i: k[i],
+                          val_of=lambda i: v[i],
+                          ord_of=lambda i: o[i])
+    got_sv = out.column("sv").to_pylist()
+    got_cv = out.column("cv").to_pylist()
+    for i in rows:
+        vals = [v[j] for j in frames[i] if v[j] is not None]
+        assert got_cv[i] == len(vals), i
+        assert got_sv[i] == (sum(vals) if vals else None), i
+
+
+def test_bounded_range_min_max(tpu_session):
+    tb = _window_df(seed=13)
+    s = tpu_session
+    from spark_rapids_tpu.expr.window import WindowBuilder
+    w = (WindowBuilder().partition_by(col("k"))
+         .order_by(col("o")).range_between(-3, 0))
+    out = (s.create_dataframe(tb)
+           .select(col("rid"), F.min(col("v")).over(w).alias("mn"),
+                   F.max(col("v")).over(w).alias("mx"))
+           .collect().sort_by("rid"))
+    rows = list(range(tb.num_rows))
+    k = tb.column("k").to_pylist()
+    o = tb.column("o").to_pylist()
+    v = tb.column("v").to_pylist()
+    frames = _brute_frame(rows, "range", -3, 0,
+                          key_of=lambda i: k[i],
+                          val_of=lambda i: v[i],
+                          ord_of=lambda i: o[i])
+    got_mn = out.column("mn").to_pylist()
+    got_mx = out.column("mx").to_pylist()
+    for i in rows:
+        vals = [v[j] for j in frames[i] if v[j] is not None]
+        assert got_mn[i] == (min(vals) if vals else None), i
+        assert got_mx[i] == (max(vals) if vals else None), i
