@@ -229,7 +229,7 @@ class WindowExec(Exec):
                 run_end_pos = _run_end_positions(xp, new_run)
                 bounds = self._frame_bounds(
                     xp, kind, lo_b, hi_b, pos, seg_start, seg_end_pos,
-                    run_start_pos, run_end_pos, okeys, order, cap)
+                    run_start_pos, run_end_pos, okeys, order, cap, live_s)
             results = []
             for vs, val, op in bufs_sorted:
                 if op == "countvalid":
@@ -323,7 +323,7 @@ class WindowExec(Exec):
         raise NotImplementedError(f"window function {type(func).__name__}")
 
     def _frame_bounds(self, xp, kind, lo_b, hi_b, pos, seg_start, seg_end,
-                      run_start, run_end, okeys, order, cap):
+                      run_start, run_end, okeys, order, cap, live_s):
         """Per-row inclusive [lo_i, hi_i] frame index bounds over the
         sorted row space, for bounded ROWS and RANGE frames."""
         if kind == "rows":
@@ -343,6 +343,12 @@ class WindowExec(Exec):
         # park nulls outside every finite search window
         park = seg._extreme_init(xp, vals_s.dtype, is_min=not nf)
         masked = xp.where(ovalid_s, vals_s, xp.full_like(vals_s, park))
+        # dead padding rows sort after every live row (the lexsort's first
+        # word is ~live), so they must carry the +extreme — otherwise the
+        # last partition's search window [seg_start, seg_end+1) is not
+        # ascending and _vec_bound lands at capacity (empty frames)
+        dead_park = seg._extreme_init(xp, vals_s.dtype, is_min=True)
+        masked = xp.where(live_s, masked, xp.full_like(vals_s, dead_park))
         if lo_b == UNBOUNDED_PRECEDING:
             lo_i = seg_start.astype(xp.int64)
         elif lo_b == CURRENT_ROW:

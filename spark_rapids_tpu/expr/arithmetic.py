@@ -71,7 +71,7 @@ def cast_data(ctx: EvalContext, data, src: t.DataType, dst: t.DataType):
                 return data
             if dst.scale > src.scale:
                 k = dst.scale - src.scale
-            return _widen_for(data, k, dst.precision > 18) * _pow10(k)
+                return _widen_for(data, k, dst.precision > 18) * _pow10(k)
             return _div_round_half_up(xp, data, _pow10(src.scale - dst.scale))
         # integral -> decimal
         d64 = data.astype(np.int64)

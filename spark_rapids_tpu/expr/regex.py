@@ -154,6 +154,57 @@ class RegExpReplace(Expression):
                 f"{self.children[1].sql()}, {self.children[2].sql()})")
 
 
+def _java_replacement_to_python(rep: str, n_groups: int = 99) -> str:
+    """Translate a Java Matcher.appendReplacement replacement string to
+    Python re.sub semantics: in Java, backslash makes the next char
+    literal, $N is a group reference ($0 = whole match; digits are taken
+    only while they still form a group number <= the pattern's group
+    count, so '$12' with one group is group 1 then literal '2'), and
+    ${name} references a named group.  Python wants \\g<N>/\\g<name> and
+    a doubled backslash for a literal one.  Must scan left-to-right — a
+    single regex pass mis-pairs backslashes.
+
+    Deliberate dialect difference: where Java throws
+    IllegalArgumentException (bare '$', unterminated '${', trailing
+    backslash), this translator emits the characters literally instead of
+    failing the whole query — lenient like the reference's incompat ops
+    (ref GpuOverrides.scala:97-100 marks such corners incompat rather
+    than matching exception-for-exception)."""
+    out = []
+    i, n = 0, len(rep)
+    while i < n:
+        c = rep[i]
+        if c == "\\":
+            nxt = rep[i + 1] if i + 1 < n else "\\"
+            out.append("\\\\" if nxt == "\\" else nxt)
+            i += 2
+        elif c == "$":
+            if i + 1 < n and rep[i + 1] == "{":
+                end = rep.find("}", i + 2)
+                if end > i + 2:
+                    out.append(rf"\g<{rep[i + 2:end]}>")
+                    i = end + 1
+                    continue
+                out.append("$")     # unterminated ${: Java throws; literal
+                i += 1
+            elif i + 1 < n and rep[i + 1].isdigit():
+                num = int(rep[i + 1])
+                j = i + 2
+                while j < n and rep[j].isdigit() and \
+                        num * 10 + int(rep[j]) <= n_groups:
+                    num = num * 10 + int(rep[j])
+                    j += 1
+                out.append(rf"\g<{num}>")
+                i = j
+            else:                   # bare $: Java throws; keep literal
+                out.append("$")
+                i += 1
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
 @evaluator(RegExpReplace)
 def _eval_regexp_replace(e: RegExpReplace, ctx: EvalContext):
     _host_only(ctx, "regexp_replace")
@@ -162,9 +213,8 @@ def _eval_regexp_replace(e: RegExpReplace, ctx: EvalContext):
     if pat is None or rep is None:
         from .core import EvalError
         raise EvalError("regexp_replace requires literal pattern/replacement")
-    # Java uses $1 group references; Python uses \1
-    py_rep = re.sub(r"\$(\d+)", r"\\\1", rep)
     rx = re.compile(pat)
+    py_rep = _java_replacement_to_python(rep, rx.groups)
     v = e.children[0].eval(ctx)
     rows = np_string_rows(v.col, ctx.capacity)
     out = [rx.sub(py_rep, r) if r is not None else None for r in rows]

@@ -25,6 +25,29 @@ from . import config as cfg
 log = logging.getLogger("spark_rapids_tpu.plugin")
 
 
+def _host_cpu_fingerprint() -> str:
+    """Identify the host CPU feature set for the compilation-cache key.
+
+    Prefers the kernel's cpuinfo flags (the exact feature list XLA:CPU
+    targets); falls back to the machine arch + CPU model name."""
+    import hashlib
+    import platform
+
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+                if line.startswith("model name") and not flags:
+                    flags = line.split(":", 1)[1].strip()
+    except OSError:
+        flags = platform.processor()
+    return platform.machine() + "|" + \
+        hashlib.sha1(flags.encode()).hexdigest()[:12]
+
+
 class PluginInitError(RuntimeError):
     """Executor init failure.  The reference calls System.exit(1)
     (Plugin.scala:196-203); embedded in-process we raise instead and let
@@ -200,12 +223,15 @@ class TpuExecutorPlugin:
         try:
             import hashlib
             import jax
-            # scope by platform + XLA flags: AOT executables compiled
-            # under one CPU-feature set must not load under another
-            # (XLA warns about possible SIGILL on mismatch)
+            # scope by platform + XLA flags + host CPU features: AOT
+            # executables compiled under one CPU-feature set must not
+            # load under another (XLA warns about possible SIGILL on
+            # mismatch), so a cache dir shared across heterogeneous
+            # hosts or a migrated home dir must miss, not crash
             fp = hashlib.sha1(
                 f"{jax.__version__}|{jax.default_backend()}|"
-                f"{os.environ.get('XLA_FLAGS', '')}".encode()).hexdigest()[:12]
+                f"{os.environ.get('XLA_FLAGS', '')}|"
+                f"{_host_cpu_fingerprint()}".encode()).hexdigest()[:12]
             cache_dir = os.path.join(cache_dir, fp)
             os.makedirs(cache_dir, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -171,3 +171,34 @@ def test_decimal_mul_into_128_exact():
         (col("a") * col("b")).alias("p")).collect()
     assert out.column("p").to_pylist() == [
         D("123456789012.34") * D("987654321098.76")]
+
+
+def test_decimal_cast_scale_down_half_up():
+    """Regression: the scale-down branch of decimal->decimal cast was
+    unreachable (mis-indented up-scale return) — cast(decimal(10,4) ->
+    decimal(10,2)) raised UnboundLocalError on both engines."""
+    from spark_rapids_tpu import types as t
+    s = _session()
+    vals = [D("1.2345"), D("-1.2345"), D("0.0050"), D("-0.0050"),
+            D("99.9949"), D("99.9951"), None, D("0.0000")]
+    tb = pa.table({"d": pa.array(vals, type=pa.decimal128(10, 4))})
+    out = s.create_dataframe(tb).select(
+        col("d").cast(t.DecimalType(10, 2)).alias("c")).collect()
+    half_up = decimal.Decimal("0.01")
+    want = [None if v is None else
+            v.quantize(half_up, rounding=decimal.ROUND_HALF_UP)
+            for v in vals]
+    assert out.column("c").to_pylist() == want
+
+
+def test_decimal_cast_scale_down_differential():
+    from spark_rapids_tpu import types as t
+    tb = _dec_table(300, precision=12, scale=4, seed=5)
+    outs = {}
+    for enabled in (True, False):
+        s = _session(enabled)
+        outs[enabled] = s.create_dataframe(tb).select(
+            col("k"),
+            col("d").cast(t.DecimalType(12, 1)).alias("c")).collect()
+    assert outs[True].column("c").to_pylist() == \
+        outs[False].column("c").to_pylist()

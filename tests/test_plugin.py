@@ -81,3 +81,20 @@ def test_session_uses_plugins_and_capture_callback(tpu_session):
     assert plans
     assert ExecutionPlanCaptureCallback.assert_contains(
         plans[-1], "LocalScanExec")
+
+
+def test_generated_docs_are_fresh():
+    """The committed docs must match the live registries (the reference
+    regenerates docs/configs.md + supported_ops.md from code the same
+    way; ref TypeChecks.scala:1633)."""
+    import os
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.docsgen import generate_supported_ops
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "docs", "configs.md")) as f:
+        assert f.read() == cfg.generate_docs(), \
+            "docs/configs.md is stale — run python -m spark_rapids_tpu.docsgen"
+    with open(os.path.join(root, "docs", "supported_ops.md")) as f:
+        assert f.read() == generate_supported_ops(), \
+            "docs/supported_ops.md is stale — run python -m " \
+            "spark_rapids_tpu.docsgen"

@@ -7,6 +7,7 @@ import pytest
 
 from spark_rapids_tpu.api import functions as F
 from spark_rapids_tpu.api.column import col, lit
+from spark_rapids_tpu.api.session import TpuSession
 from spark_rapids_tpu.testing.asserts import (
     assert_tpu_and_cpu_are_equal_collect, with_tpu_session)
 from spark_rapids_tpu.testing.data_gen import StringGen, IntegerGen, gen_df
@@ -164,3 +165,50 @@ def test_locate():
     cpu, tpu = assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
     assert tpu.column("l1").to_pylist() == \
         [None if s is None else (s.find("b") + 1) for s in _SAMPLE]
+
+
+def test_regexp_replace_group_refs_and_escaped_dollar():
+    """Java replacement semantics: $N is a group ref, \\$ a literal
+    dollar, $0 the whole match (Python spells that \\g<0>, not \\0)."""
+    s = TpuSession.builder().get_or_create()
+    tb = pa.table({"s": pa.array(["ab12cd", "xy7", "noop", None])})
+    df = s.create_dataframe(tb)
+    out = df.select(
+        F.regexp_replace(col("s"), r"(\d+)", r"[$1]").alias("grp"),
+        F.regexp_replace(col("s"), r"(\d+)", r"\$1").alias("lit"),
+        F.regexp_replace(col("s"), r"\d+", r"<$0>").alias("whole"),
+    ).collect()
+    assert out.column("grp").to_pylist() == \
+        ["ab[12]cd", "xy[7]", "noop", None]
+    assert out.column("lit").to_pylist() == ["ab$1cd", "xy$1", "noop", None]
+    assert out.column("whole").to_pylist() == \
+        ["ab<12>cd", "xy<7>", "noop", None]
+
+
+def test_regexp_replace_backslash_escapes():
+    """Java appendReplacement: backslash makes the next char literal
+    (\\d is a literal d, \\\\$1 is a literal backslash then a group
+    ref) — needs a left-to-right scan, not a single regex pass."""
+    s = TpuSession.builder().get_or_create()
+    tb = pa.table({"s": pa.array(["a12b"])})
+    df = s.create_dataframe(tb)
+    out = df.select(
+        F.regexp_replace(col("s"), r"(\d+)", "\\d").alias("litd"),
+        F.regexp_replace(col("s"), r"(\d+)", "\\\\$1").alias("bsref"),
+    ).collect()
+    assert out.column("litd").to_pylist() == ["adb"]
+    assert out.column("bsref").to_pylist() == ["a\\12b"]
+
+
+def test_regexp_replace_group_ref_edge_cases():
+    """Java takes $-digits only while they form a valid group number
+    ('$12' with one group = group 1 + literal '2'); ${name} references
+    a named group."""
+    s = TpuSession.builder().get_or_create()
+    tb = pa.table({"s": pa.array(["a1b"])})
+    out = s.create_dataframe(tb).select(
+        F.regexp_replace(col("s"), r"(\d)", "$12").alias("over"),
+        F.regexp_replace(col("s"), r"(?P<d>\d)", "${d}!").alias("named"),
+    ).collect()
+    assert out.column("over").to_pylist() == ["a12b"]
+    assert out.column("named").to_pylist() == ["a1!b"]
