@@ -424,8 +424,53 @@ class GroupedData:
             return DataFrame(L.Project(keep, agg_lp), self.df.session)
         if gid_aliases:
             raise TypeError("grouping_id() only valid with rollup/cube")
+        if getattr(self, "_pivot", None) is not None:
+            out = self._expand_pivot_aggs(out)
         return DataFrame(L.Aggregate(self.grouping, out, self.df._lp),
                          self.df.session)
+
+    def pivot(self, pivot_col, values=None) -> "GroupedData":
+        """df.groupBy(k).pivot(p, [v1, v2]).agg(...) — one output column
+        per (pivot value, aggregate).
+
+        TPU-first realization of the reference's pivot support
+        (ref AggregateFunctions.scala GpuPivotFirst): each pivot value
+        becomes a conditionally-masked aggregate
+        `agg(IF(p == v, x, NULL))`, so the whole pivot is ONE pass
+        through the existing sort+segment kernel and XLA fuses the N
+        masks — no imperative per-value buffers.  When `values` is
+        omitted they are collected from the data first, like Spark."""
+        p = _to_expr(pivot_col)
+        if values is None:
+            vt = self.df.select(Column(p)).distinct().collect()
+            values = sorted(vt.column(0).to_pylist(),
+                            key=lambda v: (v is None, str(v)))
+        g = GroupedData(self.grouping, self.df, self.mode)
+        g._pivot = (p, list(values))
+        return g
+
+    def _expand_pivot_aggs(self, aggs):
+        from ..expr.aggregates import AggregateExpression
+        from ..expr.conditional import If
+        from ..expr.core import Literal
+        from ..expr.predicates import EqualNullSafe
+        p, values = self._pivot
+        out = []
+        for v in values:
+            for ae in aggs:
+                fn = ae.func
+                if not fn.children:
+                    raise TypeError(
+                        "pivot aggregates need an input column "
+                        "(count(*) unsupported, use count(col))")
+                from .. import types as _t
+                masked = fn.with_children(
+                    [If(EqualNullSafe(p, Literal(v)), fn.child,
+                        Literal(None, _t.NULL))] +
+                    list(fn.children[1:]))
+                name = str(v) if len(aggs) == 1 else f"{v}_{ae.name}"
+                out.append(AggregateExpression(masked, name))
+        return out
 
     def count(self) -> DataFrame:
         from .functions import count

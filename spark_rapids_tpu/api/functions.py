@@ -448,3 +448,47 @@ def split(c, pattern: str, limit: int = -1) -> Column:
     from ..expr.core import Literal
     from ..expr.regex import StringSplit
     return _c(StringSplit(_expr(c), Literal(pattern), Literal(limit)))
+
+
+def concat_ws(sep: str, *cols) -> Column:
+    """concat_ws(sep, c1, c2, ...): join non-null args with sep."""
+    from ..expr.strings import ConcatWs
+    return _c(ConcatWs(Literal(sep), *[_expr(c) for c in cols]))
+
+
+def md5(c) -> Column:
+    from ..expr.hashfns import Md5
+    return _c(Md5(_expr(c)))
+
+
+def get_json_object(c, path: str) -> Column:
+    from ..expr.json_expr import GetJsonObject
+    return _c(GetJsonObject(_expr(c), Literal(path)))
+
+
+def monotonically_increasing_id() -> Column:
+    from ..expr.hashfns import MonotonicallyIncreasingID
+    return _c(MonotonicallyIncreasingID())
+
+
+def spark_partition_id() -> Column:
+    from ..expr.hashfns import SparkPartitionID
+    return _c(SparkPartitionID())
+
+
+def input_file_name() -> Column:
+    from ..expr.hashfns import InputFileName
+    return _c(InputFileName())
+
+
+def rand(seed: int = 0) -> Column:
+    from ..expr.hashfns import Rand
+    return _c(Rand(seed))
+
+
+def collect_list(c) -> Column:
+    return _c(agg.AggregateExpression(agg.CollectList(_expr(c))))
+
+
+def collect_set(c) -> Column:
+    return _c(agg.AggregateExpression(agg.CollectSet(_expr(c))))

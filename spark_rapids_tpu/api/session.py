@@ -114,6 +114,8 @@ class TpuSession:
     # -- execution ----------------------------------------------------------
     def execute(self, lp: L.LogicalPlan) -> pa.Table:
         physical = plan_physical(lp, self.conf)
+        from ..plan.planner import force_perfile_if_input_file
+        force_perfile_if_input_file(physical)
         overrides = TpuOverrides(self.conf)
         final_plan = overrides.apply(physical)
         self.last_plan = final_plan
@@ -139,6 +141,8 @@ class TpuSession:
 
     def explain(self, lp: L.LogicalPlan) -> str:
         physical = plan_physical(lp, self.conf)
+        from ..plan.planner import force_perfile_if_input_file
+        force_perfile_if_input_file(physical)
         overrides = TpuOverrides(self.conf)
         final_plan = overrides.apply(physical)
         return final_plan.tree_string() + "\n--\n" + overrides.last_explain

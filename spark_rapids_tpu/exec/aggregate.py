@@ -31,15 +31,17 @@ from .. import types as t
 from ..columnar.device import (DEFAULT_ROW_BUCKETS, DeviceBatch, DeviceColumn,
                                batch_to_arrow, batch_to_device, bucket_for)
 from ..expr.aggregates import (COMPLETE, FINAL, PARTIAL, AggregateExpression,
-                               AggregateFunction, Average, Count, First, Last,
-                               Max, Min, StddevPop, StddevSamp, Sum,
-                               VariancePop, VarianceSamp)
+                               AggregateFunction, Average, CollectList,
+                               CollectSet, Count, First, Last, Max, Min,
+                               StddevPop, StddevSamp, Sum, VariancePop,
+                               VarianceSamp)
 from ..expr.core import (ColumnValue, EvalContext, Expression,
                          bind_expression, output_name)
 from ..ops import segmented as seg
 from ..ops.gather import gather_column
 from .base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU, Batch,
-                   Exec, ExecContext, MetricTimer)
+                   Exec, ExecContext, MetricTimer, process_jit, schema_sig,
+                   semantic_sig)
 from .concat import concat_batches
 
 
@@ -71,6 +73,16 @@ def _group_reduce(xp, key_cols: List[DeviceColumn],
         validity = vc.validity if vc.validity is not None else \
             xp.ones((cap,), dtype=bool)
         validity_sorted = validity[order] & live_sorted
+        if op in ("collect_list", "collect_set"):
+            out_values.append(_collect_update(
+                xp, vc, order, seg_ids, validity_sorted, cap, slot_valid,
+                dedupe=(op == "collect_set")))
+            continue
+        if op in ("collect_concat", "collect_concat_set"):
+            out_values.append(_collect_merge(
+                xp, vc, order, seg_ids, validity_sorted, cap, slot_valid,
+                dedupe=(op == "collect_concat_set")))
+            continue
         if op == "countvalid":
             _, cnt = seg.segment_reduce(
                 xp, "sum", xp.zeros((cap,), np.int64), seg_ids, cap,
@@ -157,6 +169,99 @@ def _group_reduce(xp, key_cols: List[DeviceColumn],
 def _permuted(xp, col: DeviceColumn, order) -> DeviceColumn:
     all_valid = xp.ones((order.shape[0],), dtype=bool)
     return gather_column(xp, col, order, all_valid)
+
+
+def _collect_update(xp, vc: DeviceColumn, order, seg_ids, contrib, cap: int,
+                    slot_valid, dedupe: bool) -> DeviceColumn:
+    """collect_list / collect_set over key-sorted rows (ref
+    AggregateFunctions.scala GpuCollectList/GpuCollectSet).
+
+    The sort by grouping key makes each group's rows contiguous, so the
+    collected child buffer is a stable compaction of contributing values;
+    null values are dropped (Spark semantics) and sets dedupe within the
+    segment by value words."""
+    perm = _permuted(xp, vc, order)
+    keep = contrib
+    sids = seg_ids
+    if dedupe:
+        # order by (segment, value), first occurrence survives
+        vwords = seg.key_words_for_column(xp, perm, keep, for_grouping=True)
+        words2 = [(~keep).astype(xp.uint64),
+                  sids.astype(xp.uint64)] + vwords
+        order2 = seg.lexsort(xp, words2, cap)
+        keep_s = keep[order2]
+        sw = [sids[order2].astype(xp.uint64)] + [w[order2] for w in vwords]
+        first = seg.segment_boundaries(xp, sw, keep_s)
+        perm = gather_column(xp, perm, order2,
+                             xp.ones((cap,), dtype=bool))
+        sids = sids[order2]
+        keep = keep_s & first
+    # stable compaction keeps segment-major order
+    if xp is np:
+        order3 = np.argsort(~keep, kind="stable").astype(np.int32)
+    else:
+        from jax import lax
+        iota = xp.arange(cap, dtype=xp.int32)
+        order3 = lax.sort(((~keep).astype(xp.int32), iota), num_keys=1,
+                          is_stable=True)[1]
+    child = gather_column(xp, perm, order3, keep[order3])
+    cnt, _ = seg.segment_reduce(xp, "sum", keep.astype(np.int64), sids,
+                                cap, keep)
+    offs = xp.concatenate([xp.zeros((1,), np.int32),
+                           xp.cumsum(cnt).astype(xp.int32)])
+    return DeviceColumn(t.ArrayType(vc.dtype), offsets=offs,
+                        validity=slot_valid, children=(child,))
+
+
+def _collect_merge(xp, vc: DeviceColumn, order, seg_ids, contrib, cap: int,
+                   slot_valid, dedupe: bool) -> DeviceColumn:
+    """Merge collected array buffers per key: gather rows in key-sorted
+    order (which repacks every row's span contiguously, i.e. the
+    segment-major concatenation), then optionally dedupe elements within
+    each segment (collect_set)."""
+    perm = gather_column(xp, vc, order, contrib)
+    child = perm.children[0]
+    child_cap = child.capacity
+    lens = (perm.offsets[1:] - perm.offsets[:-1]).astype(xp.int64)
+    if not dedupe:
+        cnt, _ = seg.segment_reduce(xp, "sum", lens, seg_ids, cap,
+                                    xp.ones((cap,), dtype=bool))
+        offs = xp.concatenate([xp.zeros((1,), np.int32),
+                               xp.cumsum(cnt).astype(xp.int32)])
+        return DeviceColumn(t.ArrayType(child.dtype), offsets=offs,
+                            validity=slot_valid, children=(child,))
+    # element -> segment mapping via the row each child position came from
+    pos = xp.arange(child_cap, dtype=xp.int32)
+    crow = xp.clip(xp.searchsorted(perm.offsets[1:], pos, side="right"),
+                   0, cap - 1).astype(xp.int32)
+    in_range = pos < perm.offsets[-1]
+    cseg = seg_ids[crow]
+    vwords = seg.key_words_for_column(xp, child, in_range,
+                                      for_grouping=True)
+    words = [(~in_range).astype(xp.uint64),
+             cseg.astype(xp.uint64)] + vwords
+    order2 = seg.lexsort(xp, words, child_cap)
+    keep_s = in_range[order2]
+    sw = [cseg[order2].astype(xp.uint64)] + [w[order2] for w in vwords]
+    first = seg.segment_boundaries(xp, sw, keep_s)
+    keep = keep_s & first
+    child_s = gather_column(xp, child, order2,
+                            xp.ones((child_cap,), dtype=bool))
+    if xp is np:
+        order3 = np.argsort(~keep, kind="stable").astype(np.int32)
+    else:
+        from jax import lax
+        iota = xp.arange(child_cap, dtype=xp.int32)
+        order3 = lax.sort(((~keep).astype(xp.int32), iota), num_keys=1,
+                          is_stable=True)[1]
+    final_child = gather_column(xp, child_s, order3, keep[order3])
+    cseg_s = cseg[order2]
+    cnt, _ = seg.segment_reduce(xp, "sum", keep.astype(np.int64), cseg_s,
+                                cap, keep)
+    offs = xp.concatenate([xp.zeros((1,), np.int32),
+                           xp.cumsum(cnt).astype(xp.int32)])
+    return DeviceColumn(t.ArrayType(child.dtype), offsets=offs,
+                        validity=slot_valid, children=(final_child,))
 
 
 def _needs_index_gather(dtype: t.DataType) -> bool:
@@ -279,16 +384,57 @@ class TpuHashAggregateExec(Exec):
         return DeviceBatch(out_cols, batch.num_rows, self.output_names)
 
     @functools.cached_property
+    def _jit_key(self):
+        return ("TpuHashAggregateExec", self.mode,
+                schema_sig(self.children[0]),
+                tuple(self._group_names), tuple(self._buffer_names),
+                tuple(self.output_names),
+                semantic_sig(getattr(self, "_bound_grouping",
+                                     self.grouping)),
+                semantic_sig(self.aggregates))
+
+    @property
     def _jit_update(self):
-        return jax.jit(lambda b: self._update_batch(jnp, b))
+        return process_jit(self._jit_key + ("update",),
+                           lambda: lambda b: self._update_batch(jnp, b))
 
-    @functools.cached_property
+    @property
     def _jit_merge(self):
-        return jax.jit(lambda b: self._merge_batch(jnp, b))
+        return process_jit(self._jit_key + ("merge",),
+                           lambda: lambda b: self._merge_batch(jnp, b))
 
-    @functools.cached_property
+    @property
     def _jit_merge_eval(self):
-        return jax.jit(lambda b: self._evaluate_batch(jnp, self._merge_batch(jnp, b)))
+        return process_jit(
+            self._jit_key + ("merge_eval",),
+            lambda: lambda b: self._evaluate_batch(jnp,
+                                                   self._merge_batch(jnp, b)))
+
+    @property
+    def _jit_eval(self):
+        return process_jit(self._jit_key + ("eval",),
+                           lambda: lambda b: self._evaluate_batch(jnp, b))
+
+    @property
+    def _jit_sortkeys(self):
+        return process_jit(self._jit_key + ("sortkeys",),
+                           lambda: lambda b: self._sort_by_keys(jnp, b))
+
+    def _sort_by_keys(self, xp, batch: Batch) -> Batch:
+        """Order partial-schema rows by grouping key words — the SAME
+        for_grouping encoding _group_reduce segments by, so chunked
+        re-aggregation's carry logic sees one consistent global order
+        (out-of-core sort fallback, ref aggregate.scala:311-314)."""
+        cap = batch.capacity
+        live = xp.arange(cap, dtype=np.int32) < batch.num_rows
+        words: List = [(~live).astype(xp.uint64)]
+        for kc in batch.columns[:len(self.grouping)]:
+            words += seg.key_words_for_column(xp, kc, live,
+                                              for_grouping=True)
+        order = seg.lexsort(xp, words, cap)
+        from ..ops.gather import gather_batch
+        out = gather_batch(xp, batch, order, live[order], batch.num_rows)
+        return DeviceBatch(out.columns, batch.num_rows, batch.names)
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         xp = self.xp
@@ -327,24 +473,51 @@ class TpuHashAggregateExec(Exec):
             partials = [spill.register(
                 self._jit_update(eb) if on_tpu
                 else self._update_batch(np, eb), SpillPriority.INPUT)]
+        total = sum(p.device_bytes for p in partials)
+        if total <= SpillCatalog.get().device_budget:
+            # in-core: one concat + merge
+            with MetricTimer(self.metrics[OP_TIME]):
+                mats = [p.get_batch(xp) for p in partials]
+                if len(mats) == 1:
+                    merged_in = mats[0]
+                else:
+                    merged_in = concat_batches(xp, mats, schema_names,
+                                               schema_types)
+                for p in partials:
+                    p.close()
+                if self.mode == PARTIAL:
+                    out = self._jit_merge(merged_in) if on_tpu else \
+                        self._merge_batch(np, merged_in)
+                else:
+                    out = self._jit_merge_eval(merged_in) if on_tpu else \
+                        self._evaluate_batch(np,
+                                             self._merge_batch(np,
+                                                               merged_in))
+            self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+            self.metrics[NUM_OUTPUT_BATCHES] += 1
+            yield out
+            return
+        # out-of-core: budget-bounded iterative merge with sort-based
+        # fallback (ref aggregate.scala:309-314)
+        from .outofcore import merge_partials_bounded
+        spill = SpillCatalog.get()
+        merge_fn = self._jit_merge if on_tpu else \
+            (lambda b: self._merge_batch(np, b))
+        sortkeys_fn = self._jit_sortkeys if on_tpu else \
+            (lambda b: self._sort_by_keys(np, b))
+        chunk_rows = max(int(p.num_rows) for p in partials)
         with MetricTimer(self.metrics[OP_TIME]):
-            mats = [p.get_batch(xp) for p in partials]
-            if len(mats) == 1:
-                merged_in = mats[0]
-            else:
-                merged_in = concat_batches(xp, mats, schema_names,
-                                           schema_types)
-            for p in partials:
-                p.close()
-            if self.mode == PARTIAL:
-                out = self._jit_merge(merged_in) if on_tpu else \
-                    self._merge_batch(np, merged_in)
-            else:
-                out = self._jit_merge_eval(merged_in) if on_tpu else \
-                    self._evaluate_batch(np, self._merge_batch(np, merged_in))
-        self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
-        self.metrics[NUM_OUTPUT_BATCHES] += 1
-        yield out
+            for m in merge_partials_bounded(
+                    xp, partials, merge_fn, sortkeys_fn, schema_names,
+                    schema_types, spill, spill.device_budget, chunk_rows):
+                if self.mode == PARTIAL:
+                    out = m
+                else:
+                    out = self._jit_eval(m) if on_tpu else \
+                        self._evaluate_batch(np, m)
+                self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+                self.metrics[NUM_OUTPUT_BATCHES] += 1
+                yield out
 
 
 # ---------------------------------------------------------------------------
@@ -355,6 +528,7 @@ _PA_AGG = {
     Sum: "sum", Count: "count", Average: "mean", Min: "min", Max: "max",
     First: "first", Last: "last", StddevSamp: "stddev", StddevPop: "stddev",
     VarianceSamp: "variance", VariancePop: "variance",
+    CollectSet: "distinct", CollectList: "list",
 }
 
 
@@ -467,6 +641,13 @@ class CpuHashAggregateExec(Exec):
             kind = _PA_AGG[type(ae.func)]
             cname = f"__in{i}_{kind}"
             col = res.column(cname)
+            if isinstance(ae.func, CollectList) and \
+                    not isinstance(ae.func, CollectSet):
+                # Spark's collect_list drops nulls; pyarrow's keeps them
+                col = pa.chunked_array([pa.array(
+                    [[v for v in row if v is not None]
+                     for row in chunk.to_pylist()],
+                    type=chunk.type) for chunk in col.chunks])
             col = col.cast(to_arrow_type(ae.data_type()))
             out_cols.append(col)
         out = pa.table(dict(zip(self.output_names, out_cols)))

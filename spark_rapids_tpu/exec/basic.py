@@ -27,7 +27,8 @@ from ..expr.core import (EvalContext, Expression, bind_expression,
                          output_name)
 from ..ops.gather import gather_batch
 from .base import (CPU, NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU,
-                   Batch, Exec, ExecContext, MetricTimer)
+                   Batch, Exec, ExecContext, MetricTimer, process_jit,
+                   schema_sig, semantic_sig)
 
 
 class LocalScanExec(Exec):
@@ -110,8 +111,8 @@ class ProjectExec(Exec):
     def describe(self):
         return f"Project [{', '.join(e.sql() for e in self.exprs)}]"
 
-    def _compute(self, xp, batch: Batch) -> Batch:
-        ctx = EvalContext(xp, batch)
+    def _compute(self, xp, batch: Batch, row_base=0) -> Batch:
+        ctx = EvalContext(xp, batch, row_base=row_base)
         cols = []
         for b in self._bound:
             v = b.eval(ctx)
@@ -126,18 +127,54 @@ class ProjectExec(Exec):
         return DeviceBatch(cols, batch.num_rows, self.output_names)
 
     @functools.cached_property
+    def _jit_key(self):
+        return ("ProjectExec", schema_sig(self.children[0]),
+                tuple(self.output_names), semantic_sig(self._bound))
+
+    @property
     def _jitted(self):
-        return jax.jit(lambda b: self._compute(jnp, b))
+        return process_jit(self._jit_key,
+                           lambda: lambda b: self._compute(jnp, b))
+
+    @property
+    def _jitted_rowpos(self):
+        return process_jit(self._jit_key + ("rowpos",),
+                           lambda: lambda b, base: self._compute(jnp, b,
+                                                                 base))
+
+    @functools.cached_property
+    def _needs_rowpos(self):
+        return _exprs_need_rowpos(self._bound)
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         xp = self.xp
+        offset = 0
         for b in self.children[0].execute_partition(pid, ctx):
             with MetricTimer(self.metrics[OP_TIME]):
-                out = self._jitted(b) if self.placement == TPU \
-                    else self._compute(np, b)
+                if self._needs_rowpos:
+                    base = (pid << 33) + offset
+                    out = self._jitted_rowpos(b, jnp.int64(base)) \
+                        if self.placement == TPU \
+                        else self._compute(np, b, base)
+                else:
+                    out = self._jitted(b) if self.placement == TPU \
+                        else self._compute(np, b)
+            offset += int(b.num_rows)
             self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
             self.metrics[NUM_OUTPUT_BATCHES] += 1
             yield out
+
+
+def _exprs_need_rowpos(bound_exprs) -> bool:
+    """True when any expression depends on (partition, row-position)
+    context — monotonically_increasing_id / spark_partition_id / rand."""
+    from ..expr.hashfns import (MonotonicallyIncreasingID, Rand,
+                                SparkPartitionID)
+    kinds = (MonotonicallyIncreasingID, Rand, SparkPartitionID)
+    for b in bound_exprs:
+        if b.collect(lambda e: isinstance(e, kinds)):
+            return True
+    return False
 
 
 class FilterExec(Exec):
@@ -164,21 +201,45 @@ class FilterExec(Exec):
     def describe(self):
         return f"Filter [{self.condition.sql()}]"
 
-    def _compute(self, xp, batch: Batch) -> Batch:
-        ctx = EvalContext(xp, batch)
+    def _compute(self, xp, batch: Batch, row_base=0) -> Batch:
+        ctx = EvalContext(xp, batch, row_base=row_base)
         pred = self._bound.eval(ctx)
         from .filter_common import apply_filter
         return apply_filter(xp, batch, pred, self.output_names)
 
     @functools.cached_property
+    def _jit_key(self):
+        return ("FilterExec", schema_sig(self.children[0]),
+                semantic_sig(self._bound))
+
+    @property
     def _jitted(self):
-        return jax.jit(lambda b: self._compute(jnp, b))
+        return process_jit(self._jit_key,
+                           lambda: lambda b: self._compute(jnp, b))
+
+    @property
+    def _jitted_rowpos(self):
+        return process_jit(self._jit_key + ("rowpos",),
+                           lambda: lambda b, base: self._compute(jnp, b,
+                                                                 base))
+
+    @functools.cached_property
+    def _needs_rowpos(self):
+        return _exprs_need_rowpos([self._bound])
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        offset = 0
         for b in self.children[0].execute_partition(pid, ctx):
             with MetricTimer(self.metrics[OP_TIME]):
-                out = self._jitted(b) if self.placement == TPU \
-                    else self._compute(np, b)
+                if self._needs_rowpos:
+                    base = (pid << 33) + offset
+                    out = self._jitted_rowpos(b, jnp.int64(base)) \
+                        if self.placement == TPU \
+                        else self._compute(np, b, base)
+                else:
+                    out = self._jitted(b) if self.placement == TPU \
+                        else self._compute(np, b)
+            offset += int(b.num_rows)
             self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
             self.metrics[NUM_OUTPUT_BATCHES] += 1
             yield out

@@ -37,7 +37,7 @@ from ..expr.predicates import And, EqualTo
 from ..ops import join_kernels as jk
 from ..ops.gather import gather_batch, gather_column
 from .base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU, Batch,
-                   Exec, MetricTimer)
+                   Exec, MetricTimer, process_jit, schema_sig, semantic_sig)
 from .concat import concat_batches
 from .filter_common import apply_filter, compact
 
@@ -176,8 +176,17 @@ class HashJoinExec(Exec):
                 tuple(pbytes), tuple(bbytes), matched)
 
     @functools.cached_property
+    def _jit_key(self):
+        return ("HashJoinExec", self.how,
+                schema_sig(self.children[0]), schema_sig(self.children[1]),
+                semantic_sig(self.left_keys),
+                semantic_sig(self.right_keys),
+                semantic_sig(self._bound_condition))
+
+    @property
     def _jit_count(self):
-        return jax.jit(lambda b, p: self._count(jnp, b, p))
+        return process_jit(self._jit_key + ("count",),
+                           lambda: lambda b, p: self._count(jnp, b, p))
 
     # --- phase 2: expansion -------------------------------------------------
     def _expand(self, xp, build: Batch, probe: Batch, order, lo, counts,
@@ -196,15 +205,10 @@ class HashJoinExec(Exec):
         if xp is np:
             return self._expand(np, build, probe, order, lo, counts,
                                 out_cap, pchar_caps, bchar_caps)
-        key = (out_cap, tuple(pchar_caps), tuple(bchar_caps))
-        cache = getattr(self, "_expand_cache", None)
-        if cache is None:
-            cache = self._expand_cache = {}
-        fn = cache.get(key)
-        if fn is None:
-            fn = jax.jit(lambda b, p, o, l, c: self._expand(
-                jnp, b, p, o, l, c, out_cap, pchar_caps, bchar_caps))
-            cache[key] = fn
+        key = self._jit_key + ("expand", out_cap, tuple(pchar_caps),
+                               tuple(bchar_caps))
+        fn = process_jit(key, lambda: lambda b, p, o, l, c: self._expand(
+            jnp, b, p, o, l, c, out_cap, pchar_caps, bchar_caps))
         return fn(build, probe, order, lo, counts)
 
     # --- unmatched build rows for right/full --------------------------------

@@ -1,0 +1,202 @@
+"""Out-of-core execution: bounded-memory sort and aggregate merge.
+
+Ref: GpuSortExec.scala:231 (GpuOutOfCoreSortIterator — spillable pending/
+sorted queues, boundary-key splitting) and aggregate.scala:309-314
+(tryMergeAggregatedBatches + sort-based re-aggregation fallback when the
+merged output exceeds one batch).
+
+TPU redesign: XLA has no streaming merge primitive, but its sort is fast
+and jit-cached per capacity bucket — so the external merge step IS a
+re-sort of a budget-bounded group of runs (memory is the scarce resource
+out-of-core, not FLOPs).  All host-driven control flow here runs outside
+jit; the per-chunk kernels (sort, merge, gather) are the process-cached
+jitted ones.
+
+  * external_merge_sort: sort each input batch -> spillable single-chunk
+    runs -> repeatedly merge groups of runs whose total device footprint
+    fits the budget (concat + re-sort + re-chunk, chunks spilled as they
+    are produced) until one globally sorted run remains.
+  * merge_partials_bounded: iteratively merge aggregate partials in
+    budget-bounded groups (each merge compacts to the group's distinct
+    keys); if a pass cannot pair any two batches under the budget, fall
+    back to sort-by-key + carry re-aggregation, emitting completed key
+    ranges incrementally exactly like the reference's sort fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Sequence
+
+import numpy as np
+
+from .. import types as t
+from ..columnar.device import (DEFAULT_CHAR_BUCKETS, DEFAULT_ROW_BUCKETS,
+                               DeviceBatch, bucket_for)
+from ..memory.spill import SpillableBatch, SpillCatalog, SpillPriority
+from ..ops.gather import gather_batch
+from .concat import concat_batches
+
+
+def slice_batch(xp, batch: DeviceBatch, names, types, start: int,
+                length: int) -> DeviceBatch:
+    """Host-driven row slice [start, start+length) re-bucketed to the
+    smallest covering capacity (variable-length columns re-pack)."""
+    cap = bucket_for(max(length, 1), DEFAULT_ROW_BUCKETS)
+    idx = xp.arange(cap, dtype=xp.int32) + np.int32(start)
+    valid = xp.arange(cap, dtype=xp.int32) < length
+    char_caps = []
+    for c, dt in zip(batch.columns, types):
+        if isinstance(dt, (t.StringType, t.BinaryType)):
+            o = np.asarray(c.offsets)
+            lo = int(o[min(start, len(o) - 1)])
+            hi = int(o[min(start + length, len(o) - 1)])
+            char_caps.append(bucket_for(max(hi - lo, 1),
+                                        DEFAULT_CHAR_BUCKETS))
+        elif isinstance(dt, t.ArrayType):
+            o = np.asarray(c.offsets)
+            lo = int(o[min(start, len(o) - 1)])
+            hi = int(o[min(start + length, len(o) - 1)])
+            char_caps.append(bucket_for(max(hi - lo, 1),
+                                        DEFAULT_ROW_BUCKETS))
+        else:
+            char_caps.append(0)
+    out = gather_batch(xp, batch, idx, valid, length, char_caps)
+    return DeviceBatch(out.columns, length, names)
+
+
+def rechunk(xp, batch: DeviceBatch, names, types,
+            chunk_rows: int) -> List[DeviceBatch]:
+    """Split a batch into row-bounded chunks (order preserved)."""
+    n = int(batch.num_rows)
+    if n <= chunk_rows:
+        return [batch]
+    out = []
+    for start in range(0, n, chunk_rows):
+        out.append(slice_batch(xp, batch, names, types, start,
+                               min(chunk_rows, n - start)))
+    return out
+
+
+Run = List[SpillableBatch]
+
+
+def _run_bytes(run: Run) -> int:
+    return sum(c.device_bytes for c in run)
+
+
+def external_merge_sort(xp, inputs: Sequence[SpillableBatch],
+                        sort_fn: Callable[[DeviceBatch], DeviceBatch],
+                        names, types, spill: SpillCatalog, budget: int,
+                        chunk_rows: int) -> Iterator[DeviceBatch]:
+    """Globally sort arbitrarily many spilled batches within `budget`
+    device bytes (ref GpuOutOfCoreSortIterator, GpuSortExec.scala:231)."""
+    runs: List[Run] = []
+    for p in inputs:
+        b = p.get_batch(xp)
+        p.close()
+        sb = sort_fn(b)
+        run = [spill.register(c, SpillPriority.INPUT)
+               for c in rechunk(xp, sb, names, types, chunk_rows)]
+        runs.append(run)
+        spill.maybe_spill()
+    while len(runs) > 1:
+        # greedy budget-bounded fan-in (always >= 2: correctness over a
+        # transient overshoot when two single runs already exceed budget)
+        group = [runs.pop(0)]
+        total = _run_bytes(group[0])
+        while runs and (len(group) < 2 or
+                        total + _run_bytes(runs[0]) <= budget):
+            total += _run_bytes(runs[0])
+            group.append(runs.pop(0))
+        chunks = [c.get_batch(xp) for r in group for c in r]
+        for r in group:
+            for c in r:
+                c.close()
+        merged = concat_batches(xp, chunks, names, types) \
+            if len(chunks) > 1 else chunks[0]
+        del chunks
+        sb = sort_fn(merged)
+        del merged
+        new_run = [spill.register(c, SpillPriority.INPUT)
+                   for c in rechunk(xp, sb, names, types, chunk_rows)]
+        runs.append(new_run)
+        spill.maybe_spill()
+    for c in runs[0]:
+        out = c.get_batch(xp)
+        c.close()
+        yield out
+
+
+def merge_partials_bounded(xp, partials: List[SpillableBatch],
+                           merge_fn: Callable[[DeviceBatch], DeviceBatch],
+                           sort_by_keys_fn: Callable[[DeviceBatch],
+                                                     DeviceBatch],
+                           names, types, spill: SpillCatalog, budget: int,
+                           chunk_rows: int) -> Iterator[DeviceBatch]:
+    """Merge aggregate partial batches without ever concatenating more
+    than `budget` device bytes (ref aggregate.scala:309-314).
+
+    merge_fn must combine duplicate keys of ONE batch and leave output
+    groups in sorted key order, live rows first (the segment-reduce
+    kernel's contract)."""
+    def _merge_compact(group: List[SpillableBatch]) -> SpillableBatch:
+        mats = [p.get_batch(xp) for p in group]
+        for p in group:
+            p.close()
+        merged_in = concat_batches(xp, mats, names, types) \
+            if len(mats) > 1 else mats[0]
+        del mats
+        out = merge_fn(merged_in)
+        # re-bucket to the surviving group count so batches genuinely
+        # shrink (the merge kernel keeps its input capacity)
+        compacted = slice_batch(xp, out, names, types, 0,
+                                int(out.num_rows))
+        return spill.register(compacted, SpillPriority.INPUT)
+
+    while len(partials) > 1:
+        nxt: List[SpillableBatch] = []
+        progress = False
+        i = 0
+        while i < len(partials):
+            group = [partials[i]]
+            total = partials[i].device_bytes
+            i += 1
+            while i < len(partials) and \
+                    total + partials[i].device_bytes <= budget:
+                total += partials[i].device_bytes
+                group.append(partials[i])
+                i += 1
+            if len(group) == 1:
+                nxt.append(group[0])
+                continue
+            nxt.append(_merge_compact(group))
+            progress = True
+            spill.maybe_spill()
+        partials = nxt
+        if not progress:
+            break
+    if len(partials) == 1:
+        out = partials[0].get_batch(xp)
+        partials[0].close()
+        yield out
+        return
+    # Sort-based fallback: no two batches fit the budget together.  Sort
+    # everything by grouping key, then stream chunks; merge_fn leaves
+    # groups key-sorted, so only the LAST group of each merged chunk can
+    # continue into the next chunk — carry it forward (the reference's
+    # sort-fallback re-aggregation emits completed keys the same way).
+    sorted_chunks = external_merge_sort(xp, partials, sort_by_keys_fn,
+                                        names, types, spill, budget,
+                                        chunk_rows)
+    carry: DeviceBatch | None = None
+    for chunk in sorted_chunks:
+        merged_in = concat_batches(xp, [carry, chunk], names, types) \
+            if carry is not None else chunk
+        merged = merge_fn(merged_in)
+        n = int(merged.num_rows)
+        if n > 1:
+            yield slice_batch(xp, merged, names, types, 0, n - 1)
+        carry = slice_batch(xp, merged, names, types, max(n - 1, 0),
+                            min(n, 1))
+    if carry is not None and int(carry.num_rows) > 0:
+        yield carry

@@ -26,7 +26,7 @@ from ..expr.core import EvalContext, Expression, bind_expression
 from ..ops import segmented as seg
 from ..ops.gather import gather_batch
 from .base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU, Batch,
-                   Exec, MetricTimer)
+                   Exec, MetricTimer, process_jit, schema_sig, semantic_sig)
 from .concat import concat_batches
 
 
@@ -73,8 +73,14 @@ class SortExec(Exec):
         return DeviceBatch(out.columns, batch.num_rows, batch.names)
 
     @functools.cached_property
+    def _jit_key(self):
+        return ("SortExec", schema_sig(self.children[0]),
+                semantic_sig(self._bound))
+
+    @property
     def _jitted(self):
-        return jax.jit(lambda b: self._sort_batch(jnp, b))
+        return process_jit(self._jit_key,
+                           lambda: lambda b: self._sort_batch(jnp, b))
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         xp = self.xp
@@ -84,17 +90,31 @@ class SortExec(Exec):
                    for b in self.children[0].execute_partition(pid, ctx)]
         if not pending:
             return
-        with MetricTimer(self.metrics[OP_TIME]):
-            batches = [p.get_batch(xp) for p in pending]
-            if len(batches) > 1:
+        sort_fn = self._jitted if self.placement == TPU \
+            else lambda b: self._sort_batch(np, b)
+        total = sum(p.device_bytes for p in pending)
+        if total <= spill.device_budget:
+            # in-core: concat everything and sort once
+            with MetricTimer(self.metrics[OP_TIME]):
+                batches = [p.get_batch(xp) for p in pending]
                 merged = concat_batches(xp, batches, self.output_names,
-                                        self.output_types)
-            else:
-                merged = batches[0]
-            for p in pending:
-                p.close()
-            out = self._jitted(merged) if self.placement == TPU \
-                else self._sort_batch(np, merged)
-        self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
-        self.metrics[NUM_OUTPUT_BATCHES] += 1
-        yield out
+                                        self.output_types) \
+                    if len(batches) > 1 else batches[0]
+                for p in pending:
+                    p.close()
+                out = sort_fn(merged)
+            self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+            self.metrics[NUM_OUTPUT_BATCHES] += 1
+            yield out
+            return
+        # out-of-core external merge sort (ref GpuSortExec.scala:231)
+        from .outofcore import external_merge_sort
+        chunk_rows = max(int(p.num_rows) for p in pending)
+        with MetricTimer(self.metrics[OP_TIME]):
+            for out in external_merge_sort(
+                    xp, pending, sort_fn, self.output_names,
+                    self.output_types, spill, spill.device_budget,
+                    chunk_rows):
+                self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+                self.metrics[NUM_OUTPUT_BATCHES] += 1
+                yield out

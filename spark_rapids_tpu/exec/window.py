@@ -33,7 +33,7 @@ from ..expr.window import (CURRENT_ROW, UNBOUNDED_FOLLOWING,
 from ..ops import segmented as seg
 from ..ops.gather import gather_column
 from .base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU, Batch,
-                   Exec, MetricTimer)
+                   Exec, MetricTimer, process_jit, schema_sig, semantic_sig)
 from .concat import concat_batches
 
 
@@ -396,8 +396,14 @@ class WindowExec(Exec):
         return DeviceBatch(cols, batch.num_rows, self.output_names)
 
     @functools.cached_property
+    def _jit_key(self):
+        return ("WindowExec", schema_sig(self.children[0]),
+                semantic_sig(self.window_exprs))
+
+    @property
     def _jitted(self):
-        return jax.jit(lambda b: self._compute(jnp, b))
+        return process_jit(self._jit_key,
+                           lambda: lambda b: self._compute(jnp, b))
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         xp = self.xp

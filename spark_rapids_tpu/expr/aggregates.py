@@ -203,6 +203,44 @@ class Last(First):
     op = "last"
 
 
+class CollectList(AggregateFunction):
+    """collect_list(x): gather non-null values per group into an array
+    (ref AggregateFunctions.scala GpuCollectList).  TPU realization: the
+    sort+segment kernel makes each group's rows contiguous, so collection
+    is a stable compaction + per-segment offset build — no host loop."""
+
+    update_op = "collect_list"
+    merge_op = "collect_concat"
+
+    def data_type(self):
+        return t.ArrayType(self.child.data_type())
+
+    @property
+    def nullable(self):
+        return False  # empty group yields [], not null
+
+    def update(self):
+        return [(self.child, self.update_op)]
+
+    def buffer_types(self):
+        return [self.data_type()]
+
+    def merge_ops(self):
+        return [self.merge_op]
+
+    def evaluate(self, ctx, buffers):
+        return buffers[0]
+
+
+class CollectSet(CollectList):
+    """collect_set(x): like collect_list but deduped per group by value
+    words (ref GpuCollectSet; element order is unspecified, same as
+    Spark)."""
+
+    update_op = "collect_set"
+    merge_op = "collect_concat_set"
+
+
 class _MomentAgg(AggregateFunction):
     """Shared buffers for variance/stddev: (n, sum, sumsq) — merge-friendly
     linear statistics (the reference keeps Welford M2; we trade a little

@@ -73,9 +73,10 @@ class EvalContext:
     same semantics run on both engines.
     """
 
-    __slots__ = ("xp", "batch", "ansi", "capacity", "lambda_bindings")
+    __slots__ = ("xp", "batch", "ansi", "capacity", "lambda_bindings",
+                 "row_base")
 
-    def __init__(self, xp, batch, ansi: bool = False):
+    def __init__(self, xp, batch, ansi: bool = False, row_base=0):
         self.xp = xp
         self.batch = batch  # DeviceBatch (buffers in xp-land)
         self.ansi = ansi
@@ -83,6 +84,12 @@ class EvalContext:
         # name -> ColumnValue for in-scope lambda variables (higher-order
         # function bodies evaluate in array-element space)
         self.lambda_bindings = {}
+        # (partition_id << 33) + running row offset — the positional seed
+        # for monotonically_increasing_id / spark_partition_id / rand
+        # (ref GpuMonotonicallyIncreasingID.scala's partition-packed
+        # layout).  A traced scalar on the TPU path so per-batch offsets
+        # never retrace.
+        self.row_base = row_base
 
     def row_mask(self):
         return self.xp.arange(self.capacity, dtype=np.int32) < self.batch.num_rows

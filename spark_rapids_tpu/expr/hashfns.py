@@ -192,17 +192,6 @@ class SparkPartitionID(Expression):
         return False
 
 
-class MonotonicallyIncreasingID(Expression):
-    children = ()
-
-    def data_type(self):
-        return t.LONG
-
-    @property
-    def nullable(self):
-        return False
-
-
 class Md5(Expression):
     """MD5 digest hex string — host-only (CPU engine), tagged off TPU like
     the reference tags unsupported exprs."""
@@ -212,3 +201,149 @@ class Md5(Expression):
 
     def data_type(self):
         return t.STRING
+
+
+class Rand(Expression):
+    """rand([seed]): uniform [0,1) per row, deterministic in
+    (seed, partition, row position).
+
+    Ref: GpuOverrides registers rand (GpuRand); Spark's XORShiftRandom
+    stream is NOT reproduced bit-for-bit (marked incompat, the
+    reference's own pattern for sequence-sensitive ops) — but the CPU and
+    TPU engines here produce IDENTICAL values, so differential tests and
+    retried tasks agree."""
+
+    children = ()
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+
+    def data_type(self):
+        return t.DOUBLE
+
+    @property
+    def nullable(self):
+        return False
+
+    def sql(self):
+        return f"rand({self.seed})"
+
+
+class MonotonicallyIncreasingID(Expression):
+    """(partition_id << 33) + row position within the partition
+    (ref GpuMonotonicallyIncreasingID.scala)."""
+
+    children = ()
+
+    def data_type(self):
+        return t.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def sql(self):
+        return "monotonically_increasing_id()"
+
+
+class InputFileName(Expression):
+    """input_file_name(): path of the file feeding the current batch.
+
+    Host-only: the value is per-file host metadata, not device data (the
+    reference routes plans containing it through InputFileBlockRule.scala
+    to keep the scan+project together on one side; here the CPU fallback
+    Project plays that role, and exchange boundaries reset the file to
+    the empty string exactly like Spark reports no file)."""
+
+    children = ()
+
+    def data_type(self):
+        return t.STRING
+
+    @property
+    def nullable(self):
+        return False
+
+    def sql(self):
+        return "input_file_name()"
+
+
+def _splitmix64(xp, z):
+    """SplitMix64 finalizer — one uint64 in, one well-mixed uint64 out."""
+    z = (z + np.uint64(0x9E3779B97F4A7C15)).astype(xp.uint64)
+    z = ((z ^ (z >> np.uint64(30))) *
+         np.uint64(0xBF58476D1CE4E5B9)).astype(xp.uint64)
+    z = ((z ^ (z >> np.uint64(27))) *
+         np.uint64(0x94D049BB133111EB)).astype(xp.uint64)
+    return z ^ (z >> np.uint64(31))
+
+
+@evaluator(MonotonicallyIncreasingID)
+def _eval_mid(e: MonotonicallyIncreasingID, ctx: EvalContext):
+    xp = ctx.xp
+    base = ctx.row_base
+    if not isinstance(base, (int, np.integer)):
+        base = base.astype(np.int64)
+    data = (xp.arange(ctx.capacity, dtype=np.int64) + base)
+    return make_column(ctx, t.LONG, data, None)
+
+
+@evaluator(SparkPartitionID)
+def _eval_spark_partition_id(e: SparkPartitionID, ctx: EvalContext):
+    xp = ctx.xp
+    base = ctx.row_base
+    if isinstance(base, (int, np.integer)):
+        pid = np.int32(int(base) >> 33)
+        data = xp.full((ctx.capacity,), pid, dtype=np.int32)
+    else:
+        data = xp.broadcast_to((base >> np.int64(33)).astype(np.int32),
+                               (ctx.capacity,))
+    return make_column(ctx, t.INT, data, None)
+
+
+@evaluator(Rand)
+def _eval_rand(e: Rand, ctx: EvalContext):
+    xp = ctx.xp
+    base = ctx.row_base
+    if not isinstance(base, (int, np.integer)):
+        base = base.astype(np.int64)
+    pos = (xp.arange(ctx.capacity, dtype=np.int64) + base)\
+        .astype(np.uint64)
+    mixed = _splitmix64(xp, pos ^ np.uint64(e.seed & 0xFFFFFFFFFFFFFFFF))
+    # top 53 bits -> [0, 1)
+    data = (mixed >> np.uint64(11)).astype(np.float64) * (2.0 ** -53)
+    return make_column(ctx, t.DOUBLE, data, None)
+
+
+@evaluator(Md5)
+def _eval_md5(e: Md5, ctx: EvalContext):
+    import hashlib
+
+    from .regex import _host_only, build_string_column
+    _host_only(ctx, "md5")
+    v = e.children[0].eval(ctx)
+    if not isinstance(v, ColumnValue):
+        v = make_column(ctx, e.children[0].data_type(),
+                        v.value if v.value is not None else 0,
+                        None if v.value is not None else False)
+    offs = np.asarray(v.col.offsets)
+    chars = np.asarray(v.col.data)
+    valid = np.asarray(v.col.validity) if v.col.validity is not None \
+        else np.ones(ctx.capacity, dtype=bool)
+    out = []
+    for i in range(ctx.capacity):
+        if not valid[i]:
+            out.append(None)
+        else:
+            raw = bytes(chars[offs[i]:offs[i + 1]])
+            out.append(hashlib.md5(raw).hexdigest())
+    return build_string_column(ctx, out)
+
+
+@evaluator(InputFileName)
+def _eval_input_file_name(e: InputFileName, ctx: EvalContext):
+    from ..io.scan import current_input_file
+    from .regex import _host_only, build_string_column
+    _host_only(ctx, "input_file_name")
+    return build_string_column(
+        ctx, [current_input_file()] * ctx.capacity)

@@ -1,0 +1,102 @@
+"""JSON expressions: get_json_object.
+
+Ref: GpuGetJsonObject.scala (the reference binds cudf's JSONPath kernel).
+TPU realization: JSON parsing is irregular byte work with no fixed-shape
+device form, so this evaluates on host like the regex family — the
+overrides engine keeps the projection on CPU (unregistered expressions
+fall back with a tag reason, the reference's incompat pattern).
+
+Supported JSONPath subset (same surface cudf documents): `$`, `.field`,
+`['field']`, `[index]`.  Invalid JSON or an unmatched path yields NULL;
+string results are unquoted, nested results are re-serialized compactly —
+matching Spark's GetJsonObject behavior.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, List, Optional
+
+from .. import types as t
+from .core import (ColumnValue, EvalContext, Expression, evaluator,
+                   make_column)
+
+_PATH_TOKEN = re.compile(
+    r"\.(?P<field>[^.\[\]]+)|\[(?P<index>\d+)\]|\['(?P<qfield>[^']*)'\]")
+
+
+def parse_json_path(path: str) -> Optional[List[Any]]:
+    """'$.a[0].b' -> ['a', 0, 'b']; None when the path is malformed."""
+    if not path.startswith("$"):
+        return None
+    rest = path[1:]
+    toks: List[Any] = []
+    pos = 0
+    while pos < len(rest):
+        m = _PATH_TOKEN.match(rest, pos)
+        if m is None:
+            return None
+        if m.group("field") is not None:
+            toks.append(m.group("field"))
+        elif m.group("qfield") is not None:
+            toks.append(m.group("qfield"))
+        else:
+            toks.append(int(m.group("index")))
+        pos = m.end()
+    return toks
+
+
+def extract_json_path(doc: str, toks: List[Any]) -> Optional[str]:
+    try:
+        cur = json.loads(doc)
+    except (ValueError, TypeError):
+        return None
+    for tk in toks:
+        if isinstance(tk, int):
+            if not isinstance(cur, list) or tk >= len(cur):
+                return None
+            cur = cur[tk]
+        else:
+            if not isinstance(cur, dict) or tk not in cur:
+                return None
+            cur = cur[tk]
+    if cur is None:
+        return None
+    if isinstance(cur, str):
+        return cur
+    if isinstance(cur, bool):
+        return "true" if cur else "false"
+    if isinstance(cur, (dict, list)):
+        return json.dumps(cur, separators=(",", ":"))
+    return json.dumps(cur)
+
+
+class GetJsonObject(Expression):
+    def __init__(self, child: Expression, path: Expression):
+        self.children = (child, path)
+
+    def data_type(self):
+        return t.STRING
+
+    def sql(self):
+        return (f"get_json_object({self.children[0].sql()}, "
+                f"{self.children[1].sql()})")
+
+
+@evaluator(GetJsonObject)
+def _eval_get_json_object(e: GetJsonObject, ctx: EvalContext):
+    from .regex import (_host_only, _pattern_of, build_string_column,
+                        np_string_rows)
+    from .strings import _string_input
+    _host_only(ctx, "get_json_object")
+    path = _pattern_of(e.children[1])
+    toks = parse_json_path(path) if path is not None else None
+    rows = np_string_rows(_string_input(ctx, e.children[0].eval(ctx)),
+                          ctx.capacity)
+    if toks is None:
+        out: List[Optional[str]] = [None] * ctx.capacity
+    else:
+        out = [extract_json_path(r, toks) if r is not None else None
+               for r in rows]
+    return build_string_column(ctx, out)

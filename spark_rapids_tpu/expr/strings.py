@@ -732,3 +732,26 @@ def _eval_initcap(e, ctx: EvalContext):
     out = xp.where(word_start, up, lo)
     return ColumnValue(DeviceColumn(t.STRING, data=out, offsets=col.offsets,
                                     validity=col.validity))
+
+
+@evaluator(ConcatWs)
+def _eval_concat_ws(e: ConcatWs, ctx: EvalContext):
+    """concat_ws(sep, s1, s2, ...): null args are SKIPPED (unlike concat,
+    which nulls the whole row); null separator -> null result
+    (ref stringFunctions.scala GpuConcatWs semantics).  Host evaluation —
+    the variable piece-skipping layout has no fixed-shape device form yet,
+    so tagging keeps the projection on CPU like the regex family."""
+    from .regex import _host_only, build_string_column, np_string_rows
+    _host_only(ctx, "concat_ws")
+    cap = ctx.capacity
+    cols = [np_string_rows(_string_input(ctx, c.eval(ctx)), cap)
+            for c in e.children]
+    sep_rows, arg_rows = cols[0], cols[1:]
+    out = []
+    for i in range(cap):
+        sep = sep_rows[i]
+        if sep is None:
+            out.append(None)
+            continue
+        out.append(sep.join(r[i] for r in arg_rows if r[i] is not None))
+    return build_string_column(ctx, out)

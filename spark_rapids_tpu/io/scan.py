@@ -75,6 +75,23 @@ def _pushdown_to_arrow(filters: List[Expression], names) -> Optional[object]:
     return out
 
 
+# thread-local "current input file" — the source of input_file_name()
+# (ref InputFileBlockRule.scala: the reference pins scan+project together
+# so the value is well-defined; here the pull-based iterator chain gives
+# the same guarantee in-process, and exchange readers reset it to "")
+import threading as _threading
+
+_input_file_ctx = _threading.local()
+
+
+def current_input_file() -> str:
+    return getattr(_input_file_ctx, "path", "")
+
+
+def set_current_input_file(path: str) -> None:
+    _input_file_ctx.path = path
+
+
 class FileScanExec(Exec):
     """Columnar file scan (ref GpuFileSourceScanExec + partition readers)."""
 
@@ -157,8 +174,9 @@ class FileScanExec(Exec):
             return tbl.select(self.output_names).cast(want)
         raise ValueError(self.fmt)
 
-    def _emit(self, table: pa.Table) -> Iterator[Batch]:
+    def _emit(self, table: pa.Table, path: str = "") -> Iterator[Batch]:
         xp = self.xp
+        set_current_input_file(path)
         from ..columnar.interop import to_arrow_schema
         want = to_arrow_schema(self.output_names, self.output_types)
         table = table.cast(want)
@@ -188,7 +206,8 @@ class FileScanExec(Exec):
             return
         if self.reader_type == "COALESCING":
             tables = [self._read_file(p) for p in self.paths]
-            yield from self._emit(pa.concat_tables(tables))
+            yield from self._emit(pa.concat_tables(tables),
+                              ",".join(self.paths))
             return
         if self.reader_type == "MULTITHREADED":
             # pool shared per exec; partition pid consumes its own file but
@@ -202,9 +221,11 @@ class FileScanExec(Exec):
                 self._futures = {
                     i: pool.submit(self._read_file, p)
                     for i, p in enumerate(self.paths)}
-            yield from self._emit(self._futures[pid].result())
+            yield from self._emit(self._futures[pid].result(),
+                              self.paths[pid])
             return
-        yield from self._emit(self._read_file(self.paths[pid]))
+        yield from self._emit(self._read_file(self.paths[pid]),
+                              self.paths[pid])
 
 
 def make_scan_exec(relation, conf, extra_filters=None) -> Exec:

@@ -160,11 +160,22 @@ class DistributedAggregate:
         return jax.tree_util.tree_map(lambda x: x[None], out)
 
     @functools.cached_property
+    def _jit_key(self):
+        from ..exec.base import semantic_sig
+        return ("DistributedAggregate", self.axis,
+                tuple(d.id for d in self.mesh.devices.flat),
+                self.partial._jit_key, self.final._jit_key,
+                semantic_sig(self._routing))
+
+    @property
     def _compiled(self):
-        fn = shard_map(self._step, mesh=self.mesh,
-                       in_specs=P(self.axis), out_specs=P(self.axis),
-                       check_vma=False)
-        return jax.jit(fn)
+        from ..exec.base import process_jit
+
+        def make():
+            return shard_map(self._step, mesh=self.mesh,
+                             in_specs=P(self.axis), out_specs=P(self.axis),
+                             check_vma=False)
+        return process_jit(self._jit_key, make)
 
     def run(self, tables: Sequence[pa.Table]) -> pa.Table:
         """tables: one scan shard per device."""
@@ -205,11 +216,22 @@ class DistributedExchange:
         return jax.tree_util.tree_map(lambda x: x[None], out)
 
     @functools.cached_property
+    def _jit_key(self):
+        from ..exec.base import semantic_sig
+        return ("DistributedExchange", self.axis,
+                tuple(d.id for d in self.mesh.devices.flat),
+                tuple(zip(self.in_names, map(repr, self.in_types))),
+                semantic_sig(self._routing))
+
+    @property
     def _compiled(self):
-        fn = shard_map(self._step, mesh=self.mesh,
-                       in_specs=P(self.axis), out_specs=P(self.axis),
-                       check_vma=False)
-        return jax.jit(fn)
+        from ..exec.base import process_jit
+
+        def make():
+            return shard_map(self._step, mesh=self.mesh,
+                             in_specs=P(self.axis), out_specs=P(self.axis),
+                             check_vma=False)
+        return process_jit(self._jit_key, make)
 
     def run_stacked(self, stacked: DeviceBatch) -> DeviceBatch:
         return self._compiled(stacked)

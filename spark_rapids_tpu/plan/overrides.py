@@ -123,6 +123,13 @@ expr_rule(dte.ToUnixTimestamp, T.LONG)
 expr_rule(dte.FromUnixTime, T.TIMESTAMP)
 expr_rule(dte.TimeAdd, T.TIMESTAMP)
 expr_rule(hf.Murmur3Hash, T.INT)
+expr_rule(hf.MonotonicallyIncreasingID, T.LONG,
+          "(partition << 33) + row position, ref "
+          "GpuMonotonicallyIncreasingID.scala")
+expr_rule(hf.SparkPartitionID, T.INT, "ref GpuSparkPartitionID.scala")
+expr_rule(hf.Rand, T.DOUBLE,
+          "uniform [0,1); engine-deterministic but not bit-compatible "
+          "with Spark's XORShift sequence (incompat, like the reference)")
 
 from ..expr import collection as coll
 
@@ -227,9 +234,14 @@ expr_rule(agg.Min, T.numeric + T.DATE + T.TIMESTAMP + T.BOOLEAN + T.STRING)
 expr_rule(agg.Max, T.numeric + T.DATE + T.TIMESTAMP + T.BOOLEAN + T.STRING)
 expr_rule(agg.First, _common)
 expr_rule(agg.Last, _common)
+# collect over flat types: element ordering inside the collected array is
+# sorted-row order (list) / value order (set), ref GpuCollectList/Set
+_collect_elem = T.numeric + T.BOOLEAN + T.DATE + T.TIMESTAMP + T.STRING
+expr_rule(agg.CollectList, (_collect_elem + T.ARRAY).nested(_collect_elem))
+expr_rule(agg.CollectSet, (_collect_elem + T.ARRAY).nested(_collect_elem))
 for c in (agg.StddevPop, agg.StddevSamp, agg.VariancePop, agg.VarianceSamp):
     expr_rule(c, _num)
-expr_rule(agg.AggregateExpression, T.all_types)
+expr_rule(agg.AggregateExpression, T.all_types.nested())
 
 # columnar native UDFs trace straight into the operator's XLA computation
 # (ref GpuUserDefinedFunction + RapidsUDF.evaluateColumnar)
@@ -444,7 +456,8 @@ EXEC_SIGS: Dict[Type[eb.Exec], TypeSig] = {
     GlobalLimitExec: _exec_common,
     CoalesceBatchesExec: _exec_common,
     GatherPartitionsExec: _exec_common,
-    CpuHashAggregateExec: (T.common_scalar).nested(),
+    CpuHashAggregateExec: (T.common_scalar + T.ARRAY).nested(
+        T.common_scalar),
 }
 
 from ..exec.broadcast import (BroadcastExchangeExec, BroadcastHashJoinExec,

@@ -273,3 +273,30 @@ def _colocate_groups(grouping, child, n_parts=None):
     from ..shuffle.partitioning import HashPartitioning
     return ShuffleExchangeExec(
         HashPartitioning(list(grouping), target), child)
+
+
+def force_perfile_if_input_file(root: eb.Exec) -> None:
+    """When the plan evaluates input_file_name(), multi-file coalescing /
+    multithreaded readers would make the value ambiguous — force the
+    PERFILE reader (the reference's InputFileBlockRule.scala +
+    queryUsesInputFile checks in GpuMultiFileReader.scala do the same)."""
+    from ..expr.hashfns import InputFileName
+    from ..io.scan import FileScanExec
+
+    found = []
+
+    def check(node):
+        for attr in ("_bound", "exprs"):
+            v = getattr(node, attr, None)
+            if v is None:
+                continue
+            for e in (v if isinstance(v, (list, tuple)) else [v]):
+                if hasattr(e, "collect") and \
+                        e.collect(lambda x: isinstance(x, InputFileName)):
+                    found.append(node)
+                    return
+
+    root.foreach(check)
+    if found:
+        root.foreach(lambda n: isinstance(n, FileScanExec) and
+                     setattr(n, "reader_type", "PERFILE"))

@@ -173,8 +173,11 @@ class AQEShuffleReadExec(Exec):
     # -- read ---------------------------------------------------------------
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         from ..memory.spill import SpillableBatch
+        from ..io.scan import set_current_input_file
         spec = self.specs()[pid]
         self.exchange._ensure_written(ctx)
+        # no "current file" past an exchange (ref InputFileBlockRule.scala)
+        set_current_input_file("")
         mgr = TpuShuffleManager.get()
         sid = self.exchange._shuffle_id
         xp = self.xp

@@ -21,7 +21,8 @@ import numpy as np
 from ..columnar.device import DeviceBatch
 from ..expr.core import EvalContext
 from ..exec.base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU,
-                         Batch, Exec, ExecContext, MetricTimer)
+                         Batch, Exec, ExecContext, MetricTimer, process_jit,
+                         schema_sig, semantic_sig)
 from ..exec.concat import concat_batches
 from .manager import TpuShuffleManager
 from .partitioning import Partitioning, slice_batch_by_partition
@@ -57,8 +58,15 @@ class ShuffleExchangeExec(Exec):
                                         self.num_partitions)
 
     @functools.cached_property
+    def _jit_key(self):
+        return ("ShuffleExchangeExec", schema_sig(self.children[0]),
+                semantic_sig(self.partitioning))
+
+    @property
     def _jit_map(self):
-        return jax.jit(lambda b, off: self._map_batch(jnp, b, off))
+        return process_jit(self._jit_key,
+                           lambda: lambda b, off: self._map_batch(jnp, b,
+                                                                  off))
 
     def _ensure_written(self, ctx: ExecContext):
         with self._write_lock:
@@ -100,7 +108,11 @@ class ShuffleExchangeExec(Exec):
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         from ..memory.spill import SpillableBatch
+        from ..io.scan import set_current_input_file
         self._ensure_written(ctx)
+        # past an exchange there is no "current file" (Spark's
+        # input_file_name() returns "" there; ref InputFileBlockRule.scala)
+        set_current_input_file("")
         mgr = TpuShuffleManager.get()
         xp = self.xp
         for b in mgr.read_partition(self._shuffle_id, pid):

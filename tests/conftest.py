@@ -6,6 +6,10 @@ import os
 
 os.environ["PALLAS_AXON_POOL_IPS"] = ""   # disable the axon TPU tunnel
 os.environ["JAX_PLATFORMS"] = "cpu"
+# silence the cpu_aot_loader machine-feature ERROR spam: XLA bakes
+# +prefer-no-scatter/-gather pseudo-features into its own AOT cache
+# entries, so even same-host loads log a scary (but benign) mismatch
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     xla_flags += " --xla_force_host_platform_device_count=8"

@@ -145,3 +145,145 @@ def test_global_min_max_strings():
         return df.agg(F.min(col("s")).alias("mn"),
                       F.max(col("s")).alias("mx"))
     assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_collect_list_and_set():
+    """collect_list/collect_set (ref AggregateFunctions.scala
+    GpuCollectList/GpuCollectSet): list keeps duplicates in row order
+    within the engine's key-sorted layout, set dedupes; nulls dropped."""
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession.builder().config("spark.rapids.sql.enabled",
+                                    True).get_or_create()
+    rng = np.random.default_rng(5)
+    n = 3000
+    tb = pa.table({
+        "k": pa.array(rng.integers(0, 40, n).astype(np.int64)),
+        "v": pa.array([None if i % 7 == 0 else int(x) for i, x in
+                       enumerate(rng.integers(0, 15, n))],
+                      type=pa.int64()),
+    })
+    out = (s.create_dataframe(tb, num_partitions=3)
+           .group_by(col("k"))
+           .agg(F.collect_list(col("v")).alias("cl"),
+                F.collect_set(col("v")).alias("cs"))
+           .collect().sort_by("k"))
+    placements = []
+    s.last_plan.foreach(lambda e: placements.append(
+        (type(e).__name__, e.placement)))
+    assert any(n_ == "TpuHashAggregateExec" and p == "tpu"
+               for n_, p in placements), placements
+    # oracle
+    want = {}
+    for k, v in zip(tb.column("k").to_pylist(), tb.column("v").to_pylist()):
+        want.setdefault(k, []).append(v)
+    got_k = out.column("k").to_pylist()
+    got_cl = out.column("cl").to_pylist()
+    got_cs = out.column("cs").to_pylist()
+    assert got_k == sorted(want)
+    for k, cl, cs in zip(got_k, got_cl, got_cs):
+        ref = [v for v in want[k] if v is not None]
+        assert sorted(cl) == sorted(ref), (k, "list contents")
+        assert sorted(cs) == sorted(set(ref)), (k, "set contents")
+
+
+def test_collect_differential_cpu_vs_tpu():
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.api.session import TpuSession
+    rng = np.random.default_rng(9)
+    n = 1200
+    tb = pa.table({
+        "k": pa.array(rng.integers(0, 25, n).astype(np.int64)),
+        "v": pa.array([None if i % 5 == 0 else int(x) for i, x in
+                       enumerate(rng.integers(-8, 8, n))],
+                      type=pa.int64()),
+    })
+    res = {}
+    for enabled in (True, False):
+        s = TpuSession.builder().config("spark.rapids.sql.enabled",
+                                        enabled).get_or_create()
+        out = (s.create_dataframe(tb, num_partitions=2)
+               .group_by(col("k"))
+               .agg(F.collect_list(col("v")).alias("cl"),
+                    F.collect_set(col("v")).alias("cs"))
+               .collect().sort_by("k"))
+        res[enabled] = (out.column("k").to_pylist(),
+                        [sorted(x) for x in out.column("cl").to_pylist()],
+                        [sorted(x) for x in out.column("cs").to_pylist()])
+    assert res[True] == res[False]
+
+
+def test_collect_list_strings():
+    import pyarrow as pa
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession.builder().config("spark.rapids.sql.enabled",
+                                    True).get_or_create()
+    tb = pa.table({
+        "k": pa.array([1, 1, 2, 2, 1, 2]),
+        "s": pa.array(["a", "bb", "x", None, "a", "x"]),
+    })
+    out = (s.create_dataframe(tb).group_by(col("k"))
+           .agg(F.collect_list(col("s")).alias("cl"),
+                F.collect_set(col("s")).alias("cs"))
+           .collect().sort_by("k"))
+    cl = [sorted(x) for x in out.column("cl").to_pylist()]
+    cs = [sorted(x) for x in out.column("cs").to_pylist()]
+    assert cl == [["a", "a", "bb"], ["x", "x"]]
+    assert cs == [["a", "bb"], ["x"]]
+
+
+def test_pivot():
+    """groupBy().pivot(col, values).agg(...) — each pivot value becomes a
+    masked aggregate fused into one kernel pass (ref GpuPivotFirst in
+    AggregateFunctions.scala)."""
+    import numpy as np
+    import pyarrow as pa
+    import pandas as pd
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession.builder().config("spark.rapids.sql.enabled",
+                                    True).get_or_create()
+    rng = np.random.default_rng(3)
+    n = 2000
+    cats = ["red", "green", "blue"]
+    tb = pa.table({
+        "k": pa.array(rng.integers(0, 30, n).astype(np.int64)),
+        "p": pa.array([cats[i] for i in rng.integers(0, 3, n)]),
+        "v": pa.array(rng.integers(-100, 100, n).astype(np.int64)),
+    })
+    out = (s.create_dataframe(tb, num_partitions=2)
+           .group_by(col("k")).pivot(col("p"), cats)
+           .agg(F.sum(col("v")).alias("sv"))
+           .collect().sort_by("k"))
+    pdf = tb.to_pandas()
+    want = pdf.pivot_table(index="k", columns="p", values="v",
+                           aggfunc="sum")
+    got_k = out.column("k").to_pylist()
+    assert got_k == sorted(set(pdf.k))
+    for c in cats:
+        got = out.column(c).to_pylist()
+        exp = [None if pd.isna(x) else int(x)
+               for x in want[c].reindex(got_k)]
+        assert got == exp, c
+
+
+def test_pivot_inferred_values_multiple_aggs():
+    import pyarrow as pa
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession.builder().config("spark.rapids.sql.enabled",
+                                    True).get_or_create()
+    tb = pa.table({
+        "k": pa.array([1, 1, 2, 2, 2]),
+        "p": pa.array(["a", "b", "a", "a", "b"]),
+        "v": pa.array([10, 20, 30, 40, 50]),
+    })
+    out = (s.create_dataframe(tb).group_by(col("k"))
+           .pivot(col("p"))
+           .agg(F.sum(col("v")).alias("sv"),
+                F.count(col("v")).alias("cv"))
+           .collect().sort_by("k"))
+    assert out.column("a_sv").to_pylist() == [10, 70]
+    assert out.column("b_sv").to_pylist() == [20, 50]
+    assert out.column("a_cv").to_pylist() == [1, 2]
+    assert out.column("b_cv").to_pylist() == [1, 1]

@@ -1,0 +1,162 @@
+"""Round-2 expression catalog additions: monotonically_increasing_id,
+spark_partition_id, rand, input_file_name, md5, concat_ws,
+get_json_object (ref GpuMonotonicallyIncreasingID.scala,
+GpuGetJsonObject.scala, stringFunctions.scala, InputFileBlockRule.scala)."""
+
+import hashlib
+import json
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col
+from spark_rapids_tpu.api.session import TpuSession
+
+
+def _session(enabled=True):
+    return TpuSession.builder().config("spark.rapids.sql.enabled",
+                                       enabled).get_or_create()
+
+
+def _placements(s):
+    out = []
+    s.last_plan.foreach(lambda e: out.append((type(e).__name__,
+                                              e.placement)))
+    return out
+
+
+def test_monotonically_increasing_id_layout():
+    s = _session()
+    n = 1000
+    tb = pa.table({"v": pa.array(np.arange(n, dtype=np.int64))})
+    out = s.create_dataframe(tb, num_partitions=4).select(
+        col("v"), F.monotonically_increasing_id().alias("mid"),
+        F.spark_partition_id().alias("pid")).collect()
+    # runs on TPU
+    assert any(n_ == "ProjectExec" and p == "tpu"
+               for n_, p in _placements(s))
+    mids = out.column("mid").to_pylist()
+    pids = out.column("pid").to_pylist()
+    assert len(set(mids)) == n, "ids must be unique"
+    for m, p in zip(mids, pids):
+        assert (m >> 33) == p, "high bits carry the partition id"
+    # within each partition ids increase by 1 from (pid << 33)
+    by_pid = {}
+    for m, p in zip(mids, pids):
+        by_pid.setdefault(p, []).append(m)
+    for p, ms in by_pid.items():
+        base = p << 33
+        assert sorted(ms) == list(range(base, base + len(ms)))
+
+
+def test_rand_deterministic_and_engine_agreeing():
+    tb = pa.table({"v": pa.array(np.arange(500, dtype=np.int64))})
+    outs = {}
+    for enabled in (True, False):
+        s = _session(enabled)
+        outs[enabled] = s.create_dataframe(tb, num_partitions=2).select(
+            F.rand(42).alias("r")).collect().column("r").to_pylist()
+    assert outs[True] == outs[False], "engines must agree"
+    rs = outs[True]
+    assert all(0.0 <= r < 1.0 for r in rs)
+    assert len(set(rs)) > 450, "values must look uniform, not repeated"
+    # different seed -> different stream
+    s = _session(True)
+    other = s.create_dataframe(tb, num_partitions=2).select(
+        F.rand(7).alias("r")).collect().column("r").to_pylist()
+    assert other != rs
+
+
+def test_md5():
+    s = _session()
+    vals = ["hello", "", None, "spark-rapids-tpu"]
+    tb = pa.table({"s": pa.array(vals)})
+    out = s.create_dataframe(tb).select(F.md5(col("s")).alias("h")) \
+        .collect()
+    want = [hashlib.md5(v.encode()).hexdigest() if v is not None else None
+            for v in vals]
+    assert out.column("h").to_pylist() == want
+
+
+def test_concat_ws_skips_nulls():
+    s = _session()
+    tb = pa.table({
+        "a": pa.array(["x", None, "p", None]),
+        "b": pa.array(["y", "q", None, None]),
+    })
+    out = s.create_dataframe(tb).select(
+        F.concat_ws("-", col("a"), col("b")).alias("j")).collect()
+    # Spark: null args skipped entirely; all-null -> empty string
+    assert out.column("j").to_pylist() == ["x-y", "q", "p", ""]
+
+
+def test_get_json_object():
+    s = _session()
+    docs = [
+        json.dumps({"a": {"b": [1, 2, {"c": "deep"}]}, "s": "str",
+                    "n": 2.5, "t": True, "z": None}),
+        "not json",
+        None,
+        json.dumps([10, 20]),
+    ]
+    tb = pa.table({"j": pa.array(docs)})
+    out = s.create_dataframe(tb).select(
+        F.get_json_object(col("j"), "$.a.b[2].c").alias("deep"),
+        F.get_json_object(col("j"), "$.s").alias("s"),
+        F.get_json_object(col("j"), "$.n").alias("n"),
+        F.get_json_object(col("j"), "$.t").alias("t"),
+        F.get_json_object(col("j"), "$.z").alias("z"),
+        F.get_json_object(col("j"), "$[1]").alias("idx"),
+        F.get_json_object(col("j"), "$.a").alias("nested"),
+        F.get_json_object(col("j"), "$.missing").alias("miss"),
+    ).collect()
+    assert out.column("deep").to_pylist() == ["deep", None, None, None]
+    assert out.column("s").to_pylist() == ["str", None, None, None]
+    assert out.column("n").to_pylist() == ["2.5", None, None, None]
+    assert out.column("t").to_pylist() == ["true", None, None, None]
+    assert out.column("z").to_pylist() == [None, None, None, None]
+    assert out.column("idx").to_pylist() == [None, None, None, "20"]
+    assert out.column("nested").to_pylist() == \
+        ['{"b":[1,2,{"c":"deep"}]}', None, None, None]
+    assert out.column("miss").to_pylist() == [None, None, None, None]
+
+
+def test_input_file_name(tmp_path):
+    import pyarrow.parquet as pq
+    s = _session()
+    paths = []
+    for i in range(2):
+        p = str(tmp_path / f"part-{i}.parquet")
+        pq.write_table(pa.table({
+            "v": pa.array(np.arange(5, dtype=np.int64) + 10 * i)}), p)
+        paths.append(p)
+    df = s.read.parquet(*paths)
+    out = df.select(col("v"),
+                    F.input_file_name().alias("f")).collect()
+    got = dict(zip(out.column("v").to_pylist(),
+                   out.column("f").to_pylist()))
+    for i, p in enumerate(paths):
+        for v in range(10 * i, 10 * i + 5):
+            assert got[v] == p, (v, got[v])
+
+
+def test_input_file_name_empty_after_exchange():
+    s = _session()
+    tb = pa.table({"k": pa.array([1, 2, 1, 2]),
+                   "v": pa.array([1, 2, 3, 4])})
+    # local (non-file) scan: no input file at all
+    out = s.create_dataframe(tb, num_partitions=2) \
+        .group_by(col("k")).agg(F.sum(col("v")).alias("sv")) \
+        .select(F.input_file_name().alias("f")).collect()
+    assert set(out.column("f").to_pylist()) == {""}
+
+
+def test_split_and_registered_docs_refresh():
+    s = _session()
+    tb = pa.table({"s": pa.array(["a,b,c", "x", None])})
+    out = s.create_dataframe(tb).select(
+        F.split(col("s"), ",").alias("parts")).collect()
+    assert out.column("parts").to_pylist() == \
+        [["a", "b", "c"], ["x"], None]

@@ -1,0 +1,126 @@
+"""Out-of-core sort + aggregate merge (ref GpuSortExec.scala:231,
+aggregate.scala:309-314): partitions several times larger than the spill
+device budget must still produce exact results, with the SpillCatalog
+recording nonzero spilled bytes."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pytest
+
+from spark_rapids_tpu.exec.base import TPU, ExecContext
+from spark_rapids_tpu.exec.basic import LocalScanExec
+from spark_rapids_tpu.exec.sort import SortExec
+from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+from spark_rapids_tpu.expr.aggregates import (COMPLETE, AggregateExpression,
+                                              Count, Min, Sum)
+from spark_rapids_tpu.expr.core import AttributeReference as A
+from spark_rapids_tpu.memory.spill import SpillCatalog
+
+
+@pytest.fixture
+def tiny_budget_catalog():
+    """Install a catalog whose device budget forces out-of-core paths."""
+    old = SpillCatalog._instance
+    cat = SpillCatalog(device_budget=1 << 20, host_budget=4 << 20)
+    SpillCatalog._instance = cat
+    yield cat
+    SpillCatalog._instance = old
+
+
+def _fact(n=60_000, keys=None, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array(rng.integers(0, keys if keys else n, n)
+                      .astype(np.int64)),
+        "v": pa.array(rng.integers(-1000, 1000, n).astype(np.int64)),
+        "f": pa.array(rng.random(n)),
+    })
+
+
+def _batch_bytes_estimate(rows):
+    return rows * (8 + 8 + 8 + 3)  # 3 int64-ish cols + validity
+
+
+@pytest.mark.parametrize("placement", ["tpu", "cpu"])
+def test_out_of_core_sort(tiny_budget_catalog, placement):
+    tb = _fact(60_000)
+    # ~4096-row batches of 3x8B cols ≈ 100KB each; 15 batches ≈ 1.5MB
+    # against a 1MB budget -> external merge required
+    scan = LocalScanExec(tb, num_partitions=1, batch_rows=4096)
+    sort = SortExec([(A("k"), True, True), (A("v"), True, True)], scan)
+    if placement == "tpu":
+        scan.placement = TPU
+        sort.placement = TPU
+    out = sort.execute_collect(ExecContext())
+    want = tb.sort_by([("k", "ascending"), ("v", "ascending")])
+    assert out.column("k").to_pylist() == want.column("k").to_pylist()
+    assert out.column("v").to_pylist() == want.column("v").to_pylist()
+    assert np.allclose(out.column("f").to_numpy(),
+                       want.column("f").to_numpy())
+    assert tiny_budget_catalog.spilled_to_host_bytes > 0, \
+        "out-of-core sort must have spilled"
+
+
+@pytest.mark.parametrize("placement", ["tpu", "cpu"])
+def test_out_of_core_aggregate_merge(tiny_budget_catalog, placement):
+    # high-cardinality keys: partial outputs stay large, forcing the
+    # bounded iterative merge
+    tb = _fact(60_000, keys=50_000, seed=3)
+    scan = LocalScanExec(tb, num_partitions=1, batch_rows=4096)
+    aggs = [AggregateExpression(Sum(A("v")), "sv"),
+            AggregateExpression(Count(None), "c"),
+            AggregateExpression(Min(A("v")), "mn")]
+    agg = TpuHashAggregateExec([A("k")], aggs, COMPLETE, scan)
+    if placement == "tpu":
+        scan.placement = TPU
+        agg.placement = TPU
+    out = agg.execute_collect(ExecContext()).sort_by("k")
+    grouped = tb.group_by("k").aggregate(
+        [("v", "sum"), ("v", "count"), ("v", "min")]).sort_by("k")
+    assert out.column("k").to_pylist() == grouped.column("k").to_pylist()
+    assert out.column("sv").to_pylist() == \
+        grouped.column("v_sum").to_pylist()
+    assert out.column("c").to_pylist() == \
+        grouped.column("v_count").to_pylist()
+    assert out.column("mn").to_pylist() == \
+        grouped.column("v_min").to_pylist()
+
+
+def test_aggregate_sort_fallback(tiny_budget_catalog):
+    """Budget below two compacted partials -> the iterative merge cannot
+    pair anything and must take the sort-based re-aggregation path."""
+    cat = SpillCatalog(device_budget=220_000, host_budget=4 << 20)
+    SpillCatalog._instance = cat
+    tb = _fact(40_000, keys=39_000, seed=7)
+    scan = LocalScanExec(tb, num_partitions=1, batch_rows=8192)
+    aggs = [AggregateExpression(Sum(A("v")), "sv"),
+            AggregateExpression(Count(None), "c")]
+    agg = TpuHashAggregateExec([A("k")], aggs, COMPLETE, scan)
+    scan.placement = TPU
+    agg.placement = TPU
+    out = agg.execute_collect(ExecContext()).sort_by("k")
+    grouped = tb.group_by("k").aggregate(
+        [("v", "sum"), ("v", "count")]).sort_by("k")
+    assert out.column("k").to_pylist() == grouped.column("k").to_pylist()
+    assert out.column("sv").to_pylist() == \
+        grouped.column("v_sum").to_pylist()
+    assert out.column("c").to_pylist() == \
+        grouped.column("v_count").to_pylist()
+
+
+def test_out_of_core_sort_with_strings(tiny_budget_catalog):
+    rng = np.random.default_rng(11)
+    n = 30_000
+    tb = pa.table({
+        "s": pa.array([f"key-{x:06d}" for x in
+                       rng.integers(0, 100_000, n)]),
+        "v": pa.array(rng.integers(-50, 50, n).astype(np.int64)),
+    })
+    scan = LocalScanExec(tb, num_partitions=1, batch_rows=4096)
+    sort = SortExec([(A("s"), True, True)], scan)
+    scan.placement = TPU
+    sort.placement = TPU
+    out = sort.execute_collect(ExecContext())
+    want = tb.sort_by([("s", "ascending")])
+    assert out.column("s").to_pylist() == want.column("s").to_pylist()
