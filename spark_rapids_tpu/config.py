@@ -227,6 +227,14 @@ HBM_RESERVE = conf("spark.rapids.memory.tpu.reserve").bytes() \
     .doc("Bytes of HBM left un-pooled for XLA scratch space.") \
     .create_with_default(1 << 30)
 
+HBM_LIMIT_OVERRIDE = conf("spark.rapids.memory.tpu.limitBytes").bytes() \
+    .doc("Explicit HBM capacity override for hosts whose PJRT runtime "
+         "does not report memory_stats().  When unset, capacity comes "
+         "from memory_stats, then a device-kind table, then (CPU backend "
+         "only) host RAM; an unrecognized accelerator with no stats "
+         "fails startup rather than guessing.") \
+    .create_optional()
+
 HOST_SPILL_STORAGE_SIZE = conf("spark.rapids.memory.host.spillStorageSize").bytes() \
     .doc("Host-memory spill tier capacity before overflow to disk.") \
     .create_with_default(1 << 30)
@@ -266,6 +274,13 @@ SHUFFLE_TRANSPORT = conf("spark.rapids.shuffle.transport").string() \
          "(rapids-shuffle.md setup).") \
     .check_values(["ici", "tcp", "none"]) \
     .create_with_default("none")
+
+SCAN_PIN_DEVICE = conf("spark.rapids.sql.localScan.pinDeviceBatches").boolean() \
+    .doc("Keep uploaded device batches of in-memory scans pinned in HBM "
+         "across collects, so repeated queries over the same DataFrame "
+         "never re-upload (the analog of the reference's caching shuffle "
+         "writer keeping batches device-resident).") \
+    .create_with_default(True)
 
 SHUFFLE_COMPRESSION_CODEC = conf("spark.rapids.shuffle.compression.codec").string() \
     .doc("Codec for shuffle payloads: none, lz4, zstd (native codec library).") \

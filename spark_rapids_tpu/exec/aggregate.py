@@ -40,8 +40,8 @@ from ..expr.core import (ColumnValue, EvalContext, Expression,
 from ..ops import segmented as seg
 from ..ops.gather import gather_column
 from .base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU, Batch,
-                   Exec, ExecContext, MetricTimer, process_jit, schema_sig,
-                   semantic_sig)
+                   Exec, ExecContext, MetricTimer, maybe_sync, process_jit,
+                   schema_sig, semantic_sig)
 from .concat import concat_batches
 
 
@@ -454,6 +454,7 @@ class TpuHashAggregateExec(Exec):
                         self._update_batch(np, b)
                 else:
                     out = b  # FINAL: merge happens below
+                maybe_sync(out)
             # accumulated partials are spillable (ref aggregate.scala's
             # spillable batch accumulation before merge)
             partials.append(spill.register(out, SpillPriority.INPUT))
@@ -493,7 +494,8 @@ class TpuHashAggregateExec(Exec):
                         self._evaluate_batch(np,
                                              self._merge_batch(np,
                                                                merged_in))
-            self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+                maybe_sync(out)
+            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
             self.metrics[NUM_OUTPUT_BATCHES] += 1
             yield out
             return
@@ -515,7 +517,7 @@ class TpuHashAggregateExec(Exec):
                 else:
                     out = self._jit_eval(m) if on_tpu else \
                         self._evaluate_batch(np, m)
-                self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+                self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
                 self.metrics[NUM_OUTPUT_BATCHES] += 1
                 yield out
 

@@ -124,21 +124,72 @@ _LEVEL_ORDER = {ESSENTIAL: 0, MODERATE: 1, DEBUG: 2}
 
 
 class Metric:
-    """Operator metric (ref GpuMetric / GpuExec.scala:45-104)."""
+    """Operator metric (ref GpuMetric / GpuExec.scala:45-104).
 
-    __slots__ = ("name", "value", "level")
+    Accepts device scalars without forcing a sync: `add` stashes traced
+    values and `value` resolves them only when the metric is read — the
+    execution hot path must never block on the device for bookkeeping
+    (each host<->device round trip costs ~tens of ms on a tunneled TPU)."""
+
+    __slots__ = ("name", "_value", "level", "_pending")
 
     def __init__(self, name: str, level: str = MODERATE):
         self.name = name
-        self.value = 0
+        self._value = 0
         self.level = level
+        self._pending: list = []
+
+    @property
+    def value(self):
+        if self._pending:
+            # resolve all deferred device scalars in ONE transfer (a
+            # per-scalar fetch would pay one tunnel round trip each)
+            stacked = jnp.stack([jnp.asarray(p, dtype=jnp.int64)
+                                 for p in self._pending])
+            self._value += int(np.asarray(stacked).sum())
+            self._pending.clear()
+        return self._value
+
+    @value.setter
+    def value(self, v):
+        self._value = v
+        self._pending.clear()
 
     def add(self, v):
-        self.value += v
+        if isinstance(v, (int, float, np.integer, np.floating)):
+            self._value += v
+        else:
+            self._pending.append(v)
 
     def __iadd__(self, v):
-        self.value += v
+        self.add(v)
         return self
+
+
+_device_timing_enabled = False
+
+
+def set_device_timing(enabled: bool) -> None:
+    """DEBUG metrics mode: each operator blocks on its own outputs so
+    opTime records real device time per op instead of async dispatch time
+    (the role NvtxWithMetrics plays for the reference,
+    ref NvtxWithMetrics.scala:22-49).  Costs one device sync per operator
+    per batch — diagnostics only, off for production runs."""
+    global _device_timing_enabled
+    _device_timing_enabled = enabled
+
+
+def device_timing_enabled() -> bool:
+    return _device_timing_enabled
+
+
+def maybe_sync(out) -> None:
+    """Under device-timing mode, block until `out`'s arrays are resolved.
+    Call as the last statement inside a MetricTimer block."""
+    if _device_timing_enabled:
+        jax.block_until_ready(
+            [l for l in jax.tree_util.tree_leaves(out)
+             if isinstance(l, jax.Array)])
 
 
 _trace_annotations_enabled = False
@@ -314,10 +365,6 @@ def to_host_batch(b: Batch, names: Sequence[str]) -> pa.RecordBatch:
 # Transitions (ref GpuRowToColumnarExec / GpuColumnarToRowExec)
 # ---------------------------------------------------------------------------
 
-def _to_numpy_leaf(x):
-    return np.asarray(x)
-
-
 class HostToDeviceExec(Exec):
     """Move a CPU child's batches onto the TPU (analog of
     GpuRowToColumnarExec + HostColumnarToGpu, ref GpuRowToColumnarExec.scala:830)."""
@@ -359,11 +406,13 @@ class DeviceToHostExec(Exec):
         return self.children[0].output_types
 
     def execute_partition(self, pid, ctx):
+        from ..columnar.fetch import fetch_batch
         for b in self.children[0].execute_partition(pid, ctx):
             with MetricTimer(self.metrics[OP_TIME]):
-                self.metrics[NUM_OUTPUT_ROWS] += int(b.num_rows)
+                out = fetch_batch(b)
+                self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
                 self.metrics[NUM_OUTPUT_BATCHES] += 1
-                yield jax.tree_util.tree_map(_to_numpy_leaf, b)
+                yield out
 
 
 def metrics_report(root: "Exec", level: str = MODERATE) -> List[Tuple[str, str, int]]:

@@ -27,8 +27,8 @@ from ..expr.core import (EvalContext, Expression, bind_expression,
                          output_name)
 from ..ops.gather import gather_batch
 from .base import (CPU, NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU,
-                   Batch, Exec, ExecContext, MetricTimer, process_jit,
-                   schema_sig, semantic_sig)
+                   Batch, Exec, ExecContext, MetricTimer, maybe_sync,
+                   process_jit, schema_sig, semantic_sig)
 
 
 class LocalScanExec(Exec):
@@ -36,7 +36,8 @@ class LocalScanExec(Exec):
     (analog of Spark's LocalTableScanExec feeding the plugin)."""
 
     def __init__(self, table: pa.Table, num_partitions: int = 1,
-                 batch_rows: Optional[int] = None):
+                 batch_rows: Optional[int] = None,
+                 pin_cache: Optional[dict] = None):
         super().__init__([])
         self.table = table
         self._names = list(table.schema.names)
@@ -44,6 +45,11 @@ class LocalScanExec(Exec):
         self._types = [from_arrow_type(f.type) for f in table.schema]
         self._num_partitions = max(1, num_partitions)
         self.batch_rows = batch_rows
+        # upload pin cache owned by the logical LocalRelation node: keeps
+        # device batches resident across collects so a cached DataFrame
+        # never re-uploads (round-2 probe: re-upload was ~9% of q1's time
+        # and forced an extra pipeline stall per query)
+        self.pin_cache = pin_cache
 
     @property
     def output_names(self):
@@ -61,6 +67,34 @@ class LocalScanExec(Exec):
         return self.table.nbytes
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        from .. import config as cfg
+        key = (pid, self._num_partitions, self.batch_rows,
+               self.placement)
+        pin = self.pin_cache if (self.pin_cache is not None and
+                                 ctx.conf.get(cfg.SCAN_PIN_DEVICE)) else None
+        if pin is not None and key in pin:
+            for b in pin[key]:
+                # scan batches always carry a concrete row count
+                self.metrics[NUM_OUTPUT_ROWS] += int(b.num_rows)
+                self.metrics[NUM_OUTPUT_BATCHES] += 1
+                yield b
+            return
+        produced: List[Batch] = []
+        for b in self._produce_partition(pid, ctx):
+            if pin is not None:
+                produced.append(b)
+            yield b
+        if pin is not None:
+            pin[key] = produced
+            if self.placement == TPU:
+                # account pinned HBM against the spill budget; under
+                # pressure the catalog evicts this entry (re-upload on
+                # next miss).  CPU-engine pins are host numpy — cached
+                # for conversion cost only, no HBM accounting.
+                from ..memory.spill import SpillCatalog
+                SpillCatalog.get().register_pinned(pin, key, produced)
+
+    def _produce_partition(self, pid, ctx) -> Iterator[Batch]:
         n = self.table.num_rows
         per = -(-n // self._num_partitions)
         start = min(pid * per, n)
@@ -82,7 +116,7 @@ class LocalScanExec(Exec):
                         {n_: pa.array([], type=f.type)
                          for n_, f in zip(self._names, self.table.schema)}),
                     xp=xp)
-            self.metrics[NUM_OUTPUT_ROWS] += int(b.num_rows)
+            self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
             self.metrics[NUM_OUTPUT_BATCHES] += 1
             yield b
             offset += rows
@@ -159,8 +193,10 @@ class ProjectExec(Exec):
                 else:
                     out = self._jitted(b) if self.placement == TPU \
                         else self._compute(np, b)
-            offset += int(b.num_rows)
-            self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+                maybe_sync(out)
+            if self._needs_rowpos:
+                offset += int(b.num_rows)
+            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
             self.metrics[NUM_OUTPUT_BATCHES] += 1
             yield out
 
@@ -239,8 +275,10 @@ class FilterExec(Exec):
                 else:
                     out = self._jitted(b) if self.placement == TPU \
                         else self._compute(np, b)
-            offset += int(b.num_rows)
-            self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+                maybe_sync(out)
+            if self._needs_rowpos:
+                offset += int(b.num_rows)
+            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
             self.metrics[NUM_OUTPUT_BATCHES] += 1
             yield out
 
@@ -403,8 +441,9 @@ class SampleExec(Exec):
                 keep = self._keep_mask(xp, b.capacity, row_offset, pid)
                 live = b.row_mask()
                 out = compact(xp, b, keep & live, self.output_names)
+                maybe_sync(out)
             row_offset += int(b.num_rows)
-            self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
             self.metrics[NUM_OUTPUT_BATCHES] += 1
             yield out
 

@@ -19,7 +19,8 @@ from typing import Iterator, Optional
 import pyarrow as pa
 
 from ..columnar.device import batch_to_device
-from .base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, Batch, Exec,
+from .base import (maybe_sync,
+                   NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, Batch, Exec,
                    ExecContext, MetricTimer)
 from .concat import concat_batches
 from .join import HashJoinExec, NestedLoopJoinExec
@@ -80,9 +81,10 @@ class BroadcastExchangeExec(Exec):
                 out = concat_batches(xp, batches, child.output_names,
                                      child.output_types) \
                     if len(batches) > 1 else batches[0]
+                maybe_sync(out)
             from ..memory.spill import batch_device_bytes
             self.metrics[BROADCAST_BYTES] += batch_device_bytes(out)
-            self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
             self.metrics[NUM_OUTPUT_BATCHES] += 1
             self._cached = out
             return out

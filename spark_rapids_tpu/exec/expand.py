@@ -23,7 +23,8 @@ from ..expr.collection import Explode, Generator, PosExplode
 from ..expr.core import (ColumnValue, EvalContext, Expression, ScalarValue,
                          bind_expression, make_column)
 from ..ops.gather import gather_column
-from .base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, Batch, Exec,
+from .base import (maybe_sync,  # noqa: F401
+                   NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, Batch, Exec,
                    MetricTimer)
 
 
@@ -67,7 +68,8 @@ class ExpandExec(Exec):
                                 None if v.value is not None else False)
                         cols.append(v.col)
                     out = DeviceBatch(cols, b.num_rows, self._names)
-                self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+                    maybe_sync(out)
+                self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
                 self.metrics[NUM_OUTPUT_BATCHES] += 1
                 yield out
 

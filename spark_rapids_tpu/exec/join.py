@@ -36,7 +36,8 @@ from ..expr.core import (BoundReference, EvalContext, Expression,
 from ..expr.predicates import And, EqualTo
 from ..ops import join_kernels as jk
 from ..ops.gather import gather_batch, gather_column
-from .base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU, Batch,
+from .base import (maybe_sync,  # noqa: F401
+                   NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU, Batch,
                    Exec, MetricTimer, process_jit, schema_sig, semantic_sig)
 from .concat import concat_batches
 from .filter_common import apply_filter, compact
@@ -172,8 +173,12 @@ class HashJoinExec(Exec):
                 bbytes.append(xp.int64(0) if xp is not np else np.int64(0))
         matched = jk.build_matched_flags(xp, order, lo, counts, plive,
                                          build.capacity)
-        return (order, lo, counts, total,
-                tuple(pbytes), tuple(bbytes), matched)
+        # all host-needed sizes ride ONE array so the caller pays a single
+        # device round trip, not one per column (tunnel latency)
+        sizes = xp.stack([xp.asarray(total, dtype=xp.int64)]
+                         + [xp.asarray(x, dtype=xp.int64) for x in pbytes]
+                         + [xp.asarray(x, dtype=xp.int64) for x in bbytes])
+        return (order, lo, counts, sizes, matched)
 
     @functools.cached_property
     def _jit_key(self):
@@ -249,10 +254,10 @@ class HashJoinExec(Exec):
         for probe in self.children[0].execute_partition(pid, ctx):
             with MetricTimer(self.metrics[OP_TIME]):
                 if on_tpu:
-                    (order, lo, counts, total, pbytes, bbytes,
+                    (order, lo, counts, sizes,
                      matched) = self._jit_count(build, probe)
                 else:
-                    (order, lo, counts, total, pbytes, bbytes,
+                    (order, lo, counts, sizes,
                      matched) = self._count(np, build, probe)
                 if self.how in ("right", "full"):
                     matched_acc = matched if matched_acc is None else \
@@ -272,7 +277,10 @@ class HashJoinExec(Exec):
                 if self.how == "right":
                     # planned flipped; only unmatched emission remains here
                     pass
-                ntotal = int(total)
+                sizes = np.asarray(sizes)          # one round trip
+                ntotal = int(sizes[0])
+                pbytes = sizes[1:1 + len(probe.columns)]
+                bbytes = sizes[1 + len(probe.columns):]
                 out_cap = bucket_for(max(ntotal, 1), DEFAULT_ROW_BUCKETS)
                 pchar_caps = [bucket_for(max(int(x), 1),
                                          DEFAULT_CHAR_BUCKETS)
@@ -291,7 +299,8 @@ class HashJoinExec(Exec):
                     pctx = EvalContext(xp, out)
                     pred = self._bound_condition.eval(pctx)
                     out = apply_filter(xp, out, pred, self.output_names)
-            self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+                maybe_sync(out)
+            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
             self.metrics[NUM_OUTPUT_BATCHES] += 1
             yield out
         if self.how in ("right", "full") and matched_acc is not None:

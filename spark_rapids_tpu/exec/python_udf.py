@@ -83,6 +83,6 @@ class ArrowEvalPythonExec(Exec):
                             v = scalar_to_column(ectx, v)
                         cols.append(v.col)
                     out = DeviceBatch(cols, b.num_rows, self.output_names)
-                self.metrics[NUM_OUTPUT_ROWS] += int(b.num_rows)
+                self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
                 self.metrics[NUM_OUTPUT_BATCHES] += 1
                 yield out

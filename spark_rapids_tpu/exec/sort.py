@@ -25,7 +25,8 @@ from ..columnar.device import DeviceBatch
 from ..expr.core import EvalContext, Expression, bind_expression
 from ..ops import segmented as seg
 from ..ops.gather import gather_batch
-from .base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU, Batch,
+from .base import (maybe_sync,  # noqa: F401
+                   NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU, Batch,
                    Exec, MetricTimer, process_jit, schema_sig, semantic_sig)
 from .concat import concat_batches
 
@@ -103,7 +104,8 @@ class SortExec(Exec):
                 for p in pending:
                     p.close()
                 out = sort_fn(merged)
-            self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+                maybe_sync(out)
+            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
             self.metrics[NUM_OUTPUT_BATCHES] += 1
             yield out
             return
@@ -115,6 +117,6 @@ class SortExec(Exec):
                     xp, pending, sort_fn, self.output_names,
                     self.output_types, spill, spill.device_budget,
                     chunk_rows):
-                self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+                self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
                 self.metrics[NUM_OUTPUT_BATCHES] += 1
                 yield out

@@ -32,7 +32,8 @@ from ..expr.window import (CURRENT_ROW, UNBOUNDED_FOLLOWING,
                            Rank, RowNumber, WindowExpression)
 from ..ops import segmented as seg
 from ..ops.gather import gather_column
-from .base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU, Batch,
+from .base import (maybe_sync,  # noqa: F401
+                   NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU, Batch,
                    Exec, MetricTimer, process_jit, schema_sig, semantic_sig)
 from .concat import concat_batches
 
@@ -417,7 +418,8 @@ class WindowExec(Exec):
                 if len(batches) > 1 else batches[0]
             out = self._jitted(merged) if self.placement == TPU \
                 else self._compute(np, merged)
-        self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
+            maybe_sync(out)
+        self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
         self.metrics[NUM_OUTPUT_BATCHES] += 1
         yield out
 

@@ -174,6 +174,6 @@ class CachedScanExec(Exec):
         for blob in self.entry.partitions[pid].blobs:
             for rb in decode_blob(blob):
                 b = batch_to_device(rb, xp=xp)
-                self.metrics[NUM_OUTPUT_ROWS] += int(b.num_rows)
+                self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
                 self.metrics[NUM_OUTPUT_BATCHES] += 1
                 yield b

@@ -29,14 +29,46 @@ class DeviceManager:
         devs = jax.devices()
         if devs:
             self.device = devs[0]
-            stats = {}
-            try:
-                stats = self.device.memory_stats() or {}
-            except Exception:
-                stats = {}
-            total = stats.get("bytes_limit", 16 * (1 << 30))
+            total = self._device_capacity(conf)
             frac = conf.get(cfg.HBM_POOL_FRACTION)
             self.hbm_limit = int(total * frac) - self.hbm_reserve
+
+    # per-generation HBM capacities (public TPU specs); used only when the
+    # PJRT runtime reports no memory_stats for the device
+    _KNOWN_HBM = (
+        ("v5 lite", 16 * (1 << 30)), ("v5e", 16 * (1 << 30)),
+        ("v5p", 95 * (1 << 30)), ("v6", 32 * (1 << 30)),
+        ("v4", 32 * (1 << 30)), ("v3", 16 * (1 << 30)),
+        ("v2", 8 * (1 << 30)),
+    )
+
+    def _device_capacity(self, conf: cfg.RapidsConf) -> int:
+        """Resolve real device memory: explicit conf > PJRT memory_stats >
+        device-kind table > host RAM (CPU backend).  An unrecognized
+        accelerator with no stats raises instead of silently assuming a
+        capacity the spill budget would then be fiction against."""
+        override = conf.get(cfg.HBM_LIMIT_OVERRIDE)
+        if override:
+            return int(override)
+        try:
+            stats = self.device.memory_stats() or {}
+        except Exception:
+            stats = {}
+        if stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+        kind = (getattr(self.device, "device_kind", "") or "").lower()
+        platform = getattr(self.device, "platform", "")
+        for marker, cap in self._KNOWN_HBM:
+            if marker in kind:
+                return cap
+        if platform == "cpu" or kind == "cpu":
+            import os
+            return os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
+        from ..plugin import PluginInitError
+        raise PluginInitError(
+            f"cannot determine memory capacity of device {kind!r} "
+            f"(platform {platform!r}): PJRT reports no memory_stats; set "
+            f"{cfg.HBM_LIMIT_OVERRIDE.key} explicitly")
 
     @classmethod
     def initialize(cls, conf: cfg.RapidsConf) -> "DeviceManager":

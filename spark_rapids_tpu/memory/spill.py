@@ -68,7 +68,16 @@ class SpillableBatch:
         self._host_bytes: Optional[bytes] = None
         self._disk_path: Optional[str] = None
         self.device_bytes = batch_device_bytes(batch)
-        self.num_rows = int(batch.num_rows)
+        # num_rows may be a traced device scalar; resolving it here would
+        # force a sync per registered batch — defer to first read
+        self._num_rows = batch.num_rows
+
+    @property
+    def num_rows(self) -> int:
+        import numpy as _np
+        if not isinstance(self._num_rows, int):
+            self._num_rows = int(_np.asarray(self._num_rows))
+        return self._num_rows
 
     # -- tier moves ---------------------------------------------------------
     def spill_to_host(self):
@@ -152,9 +161,18 @@ class SpillCatalog:
         os.makedirs(self.spill_dir, exist_ok=True)
         self.unspill_enabled = unspill_enabled
         self._buffers: Dict[str, SpillableBatch] = {}
+        # pinned device residents (scan pin caches): (owner_dict, key) ->
+        # nbytes.  Counted against the budget and evicted FIRST under
+        # pressure by dropping the owner's entry — they re-materialize
+        # from host Arrow, so eviction is the cheapest possible "spill"
+        # (the reference treats cached shuffle batches the same way:
+        # device-resident but reclaimable, RapidsDeviceMemoryStore)
+        self._pinned: Dict[tuple, int] = {}
+        self._pin_owners: Dict[tuple, Dict] = {}
         self._reg_lock = threading.RLock()
         self.spilled_to_host_bytes = 0
         self.spilled_to_disk_bytes = 0
+        self.pinned_evicted_bytes = 0
 
     @classmethod
     def get(cls) -> "SpillCatalog":
@@ -193,6 +211,35 @@ class SpillCatalog:
         with self._reg_lock:
             self._buffers.pop(sb.id, None)
 
+    # -- pinned scan batches -------------------------------------------------
+    def register_pinned(self, owner: Dict, key, batch_list) -> None:
+        """Account a pin-cache entry (owner[key] = batches) against the
+        device budget and make it evictable."""
+        nbytes = sum(batch_device_bytes(b) for b in batch_list)
+        with self._reg_lock:
+            self._pinned[(id(owner), key)] = nbytes
+            self._pin_owners[(id(owner), key)] = owner
+        self.maybe_spill()
+
+    def pinned_bytes(self) -> int:
+        with self._reg_lock:
+            return sum(self._pinned.values())
+
+    def _evict_pinned(self, target_free: int) -> int:
+        freed = 0
+        with self._reg_lock:
+            for (oid, key), nbytes in list(self._pinned.items()):
+                if freed >= target_free:
+                    break
+                owner = self._pin_owners.get((oid, key))
+                if owner is not None:
+                    owner.pop(key, None)
+                self._pinned.pop((oid, key), None)
+                self._pin_owners.pop((oid, key), None)
+                freed += nbytes
+                self.pinned_evicted_bytes += nbytes
+        return freed
+
     def note_unspill(self, sb: SpillableBatch):
         self.maybe_spill()
 
@@ -200,7 +247,8 @@ class SpillCatalog:
     def device_bytes_registered(self) -> int:
         with self._reg_lock:
             return sum(b.device_bytes for b in self._buffers.values()
-                       if b.tier == StorageTier.DEVICE)
+                       if b.tier == StorageTier.DEVICE) + \
+                sum(self._pinned.values())
 
     def host_bytes_registered(self) -> int:
         with self._reg_lock:
@@ -211,7 +259,9 @@ class SpillCatalog:
     def synchronous_spill(self, target_free: int) -> int:
         """Demote device buffers (lowest priority first) until
         `target_free` bytes are released (ref synchronousSpill)."""
-        freed = 0
+        # pinned scan batches go first: dropping them frees real HBM at
+        # zero serialization cost (they rebuild from host Arrow on miss)
+        freed = self._evict_pinned(target_free)
         with self._reg_lock:
             candidates = sorted(
                 (b for b in self._buffers.values()

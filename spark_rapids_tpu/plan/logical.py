@@ -37,6 +37,9 @@ class LocalRelation(LogicalPlan):
     def __init__(self, table: pa.Table, num_partitions: int = 1):
         self.table = table
         self.num_partitions = num_partitions
+        # device-batch pin cache shared by every scan planned from this
+        # node; lifetime == the user's DataFrame (see LocalScanExec)
+        self.device_cache: dict = {}
 
     def schema(self):
         from ..columnar.interop import from_arrow_type

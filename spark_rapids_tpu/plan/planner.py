@@ -98,7 +98,8 @@ def plan(lp: L.LogicalPlan, conf) -> eb.Exec:
 
 def _plan_uncached(lp: L.LogicalPlan, conf) -> eb.Exec:
     if isinstance(lp, L.LocalRelation):
-        return LocalScanExec(lp.table, lp.num_partitions)
+        return LocalScanExec(lp.table, lp.num_partitions,
+                             pin_cache=lp.device_cache)
     if isinstance(lp, L.Range):
         return RangeExec(lp.start, lp.end, lp.step, lp.num_partitions)
     if isinstance(lp, L.FileRelation):

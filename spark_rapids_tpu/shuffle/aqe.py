@@ -190,7 +190,7 @@ class AQEShuffleReadExec(Exec):
                 for b in mgr.catalog.get(blk):
                     if isinstance(b, SpillableBatch):
                         b = b.get_batch(xp)
-                    self.metrics[NUM_OUTPUT_ROWS] += int(b.num_rows)
+                    self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
                     self.metrics[NUM_OUTPUT_BATCHES] += 1
                     yield b
 

