@@ -243,6 +243,253 @@ class DistributedExchange:
                 for b in unstack_shards(out)]
 
 
+class DistributedSort:
+    """Distributed total-order sort in ONE SPMD program: per-shard splitter
+    sampling -> all_gather of candidates -> route rows to their key range
+    with all_to_all -> local multi-key sort.  Device ``i`` ends up holding
+    globally-ordered range ``i`` (read shards in mesh order for the total
+    order) — the ICI realization of the reference's range-partition +
+    per-partition sort pipeline (ref GpuRangePartitioner.scala +
+    GpuSortExec.scala), with the sample/boundary handshake that Spark does
+    on the driver folded into the compiled program as collectives."""
+
+    def __init__(self, orders, in_names, in_types,
+                 mesh: Optional[Mesh] = None, axis: str = DATA_AXIS):
+        from ..exec.sort import SortExec
+        self.mesh = mesh or build_mesh()
+        self.axis = axis
+        self.n_dev = self.mesh.shape[axis]
+        reason = exchange_supported(in_types)
+        if reason:
+            raise NotImplementedError(reason)
+        self.in_names, self.in_types = list(in_names), list(in_types)
+        src = _SchemaSource(in_names, in_types)
+        self._sorter = SortExec(list(orders), src)
+
+    output_names = property(lambda self: self.in_names)
+    output_types = property(lambda self: self.in_types)
+
+    def _first_key_word(self, b: DeviceBatch):
+        """Order-consistent uint64 routing word of the FIRST sort key:
+        the first VALUE word with null rows forced to the extreme their
+        nulls_first placement demands.  Ties may span further key words,
+        but equal routing words land on the same shard, so the local
+        multi-key sort finishes the order."""
+        from ..ops import segmented as seg
+        ctx = EvalContext(jnp, b)
+        live = ctx.row_mask()
+        e, asc, nf = self._sorter._bound[0]
+        v = e.eval(ctx)
+        from ..expr.core import ColumnValue, make_column
+        if not isinstance(v, ColumnValue):
+            v = make_column(ctx, e.data_type(),
+                            v.value if v.value is not None else 0,
+                            None if v.value is not None else False)
+        words = seg.key_words_for_column(jnp, v.col, live,
+                                         for_grouping=False,
+                                         nulls_first=nf, ascending=asc)
+        # words[0] is the null indicator — routing on it would ship every
+        # non-null row to one device.  Route on the value word instead,
+        # with nulls pinned to the boundary shard their placement wants.
+        valid = v.col.validity if v.col.validity is not None else \
+            jnp.ones((b.capacity,), bool)
+        null_route = jnp.uint64(0) if nf else \
+            jnp.uint64(0xFFFFFFFFFFFFFFFF)
+        value_w = words[1] if len(words) > 1 else \
+            jnp.zeros((b.capacity,), jnp.uint64)
+        return jnp.where(valid, value_w, null_route), live
+
+    def _step(self, shard):
+        n_dev = self.n_dev
+        b = jax.tree_util.tree_map(lambda x: x[0], shard)
+        w0, live = self._first_key_word(b)
+        cap = b.capacity
+        maxw = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+        sorted_w0 = jnp.sort(jnp.where(live, w0, maxw))
+        n_live = jnp.sum(live.astype(jnp.int32))
+        # local splitter candidates at the n_dev-quantiles
+        q = (jnp.arange(1, n_dev, dtype=jnp.int32) * n_live) // n_dev
+        cand = sorted_w0[jnp.clip(q, 0, cap - 1)]
+        # every shard contributes candidates; global splitters are the
+        # n_dev-quantiles of the gathered candidate set
+        all_cand = jax.lax.all_gather(cand, self.axis, axis=0,
+                                      tiled=True)          # [(n_dev-1)*n_dev]
+        all_sorted = jnp.sort(all_cand)
+        m = all_cand.shape[0]
+        pick = (jnp.arange(1, n_dev, dtype=jnp.int32) * m) // n_dev
+        splitters = all_sorted[jnp.clip(pick, 0, m - 1)]   # [n_dev-1]
+        pid = jnp.searchsorted(splitters, w0, side="right").astype(jnp.int32)
+        routed = exchange_by_pid(b, pid, n_dev, self.axis)
+        out = self._sorter._sort_batch(jnp, routed)
+        return jax.tree_util.tree_map(lambda x: x[None], out)
+
+    @functools.cached_property
+    def _jit_key(self):
+        from ..exec.base import semantic_sig
+        return ("DistributedSort", self.axis,
+                tuple(d.id for d in self.mesh.devices.flat),
+                tuple(zip(self.in_names, map(repr, self.in_types))),
+                semantic_sig(self._sorter._bound))
+
+    @property
+    def _compiled(self):
+        from ..exec.base import process_jit
+
+        def make():
+            return shard_map(self._step, mesh=self.mesh,
+                             in_specs=P(self.axis), out_specs=P(self.axis),
+                             check_vma=False)
+        return process_jit(self._jit_key, make)
+
+    def run(self, tables: Sequence[pa.Table]) -> pa.Table:
+        """tables: one shard per device; returns the totally-ordered
+        concatenation (shard 0's range first)."""
+        assert len(tables) == self.n_dev
+        out = self._compiled(stack_shards(tables))
+        return shards_to_table(out)
+
+
+class DistributedHashJoin:
+    """Shuffled hash join over the mesh: both sides are exchanged to
+    ``hash(keys) % n_dev`` inside one SPMD count program (so matching keys
+    co-locate, ref GpuShuffledHashJoinBase.scala), ONE host round trip
+    reads the per-shard output sizes, then a second SPMD program gathers
+    the join output at the bucketed static capacity — the multi-chip
+    mirror of HashJoinExec's count/sync/expand pipeline."""
+
+    SUPPORTED = ("inner", "left", "left_semi", "left_anti")
+
+    def __init__(self, left_keys, right_keys, how: str, condition,
+                 lnames, ltypes, rnames, rtypes,
+                 mesh: Optional[Mesh] = None, axis: str = DATA_AXIS):
+        from ..exec.join import HashJoinExec
+        if how not in self.SUPPORTED:
+            raise NotImplementedError(f"ici join how={how}")
+        if condition is not None and how != "inner":
+            raise NotImplementedError("ici join residual condition only "
+                                      "for inner joins")
+        self.mesh = mesh or build_mesh()
+        self.axis = axis
+        self.n_dev = self.mesh.shape[axis]
+        for tys in (ltypes, rtypes):
+            reason = exchange_supported(tys)
+            if reason:
+                raise NotImplementedError(reason)
+        self.how = how
+        lsrc = _SchemaSource(lnames, ltypes)
+        rsrc = _SchemaSource(rnames, rtypes)
+        self._join = HashJoinExec(list(left_keys), list(right_keys), how,
+                                  condition, lsrc, rsrc, colocated=True)
+        self._l_routing = HashPartitioning(
+            list(left_keys), self.n_dev).bind(lnames, ltypes)
+        self._r_routing = HashPartitioning(
+            list(right_keys), self.n_dev).bind(rnames, rtypes)
+
+    output_names = property(lambda self: self._join.output_names)
+    output_types = property(lambda self: self._join.output_types)
+
+    def _exchange_side(self, b: DeviceBatch, routing) -> DeviceBatch:
+        ctx = EvalContext(jnp, b)
+        pids = routing.partition_ids(jnp, ctx, b)
+        return exchange_by_pid(b, pids, self.n_dev, self.axis)
+
+    def _count_step(self, lshard, rshard):
+        lb = jax.tree_util.tree_map(lambda x: x[0], lshard)
+        rb = jax.tree_util.tree_map(lambda x: x[0], rshard)
+        lx = self._exchange_side(lb, self._l_routing)
+        rx = self._exchange_side(rb, self._r_routing)
+        if self.how in ("left_semi", "left_anti"):
+            # no expansion: compact the probe side in-program, no sizing
+            from ..exec.filter_common import compact
+            order, lo, counts, sizes, matched = self._join._count(
+                jnp, rx, lx)
+            live = lx.row_mask()
+            keep = (counts > 0) if self.how == "left_semi" else \
+                (counts == 0)
+            out = compact(jnp, lx, keep & live, self._join.output_names)
+            return jax.tree_util.tree_map(lambda x: x[None], out)
+        order, lo, counts, sizes, matched = self._join._count(jnp, rx, lx)
+        add1 = lambda x: jax.tree_util.tree_map(  # noqa: E731
+            lambda y: y[None], x)
+        return (add1(lx), add1(rx), add1(order), add1(lo), add1(counts),
+                sizes[None])
+
+    def _expand_step(self, lx, rx, order, lo, counts, out_cap: int,
+                     pchar, bchar):
+        strip = lambda x: jax.tree_util.tree_map(  # noqa: E731
+            lambda y: y[0], x)
+        out = self._join._expand(jnp, strip(rx), strip(lx), strip(order),
+                                 strip(lo), strip(counts), out_cap,
+                                 pchar, bchar)
+        if self._join._bound_condition is not None and self.how == "inner":
+            from ..exec.filter_common import apply_filter
+            ctx = EvalContext(jnp, out)
+            pred = self._join._bound_condition.eval(ctx)
+            out = apply_filter(jnp, out, pred, self._join.output_names)
+        return jax.tree_util.tree_map(lambda x: x[None], out)
+
+    @functools.cached_property
+    def _jit_key(self):
+        from ..exec.base import semantic_sig
+        return ("DistributedHashJoin", self.axis, self.how,
+                tuple(d.id for d in self.mesh.devices.flat),
+                self._join._jit_key, semantic_sig(self._l_routing),
+                semantic_sig(self._r_routing))
+
+    def _compiled_count(self):
+        from ..exec.base import process_jit
+
+        def make():
+            return shard_map(self._count_step, mesh=self.mesh,
+                             in_specs=(P(self.axis), P(self.axis)),
+                             out_specs=P(self.axis), check_vma=False)
+        return process_jit(self._jit_key + ("count",), make)
+
+    def _compiled_expand(self, out_cap: int, pchar, bchar):
+        from ..exec.base import process_jit
+
+        def make():
+            def step(lx, rx, order, lo, counts):
+                return self._expand_step(lx, rx, order, lo, counts,
+                                         out_cap, pchar, bchar)
+            return shard_map(step, mesh=self.mesh,
+                             in_specs=(P(self.axis),) * 5,
+                             out_specs=P(self.axis), check_vma=False)
+        return process_jit(self._jit_key + ("expand", out_cap,
+                                            tuple(pchar), tuple(bchar)),
+                           make)
+
+    def run(self, left_tables: Sequence[pa.Table],
+            right_tables: Sequence[pa.Table]) -> pa.Table:
+        import numpy as np
+        from ..columnar.device import (DEFAULT_CHAR_BUCKETS,
+                                       DEFAULT_ROW_BUCKETS, bucket_for)
+        assert len(left_tables) == self.n_dev
+        assert len(right_tables) == self.n_dev
+        ls = stack_shards(left_tables)
+        rs = stack_shards(right_tables)
+        if self.how in ("left_semi", "left_anti"):
+            return shards_to_table(self._compiled_count()(ls, rs))
+        lx, rx, order, lo, counts, sizes = self._compiled_count()(ls, rs)
+        sz = np.asarray(sizes)                       # one round trip
+        ncols_l = len(self._join.children[0].output_names)
+        out_cap = bucket_for(max(int(sz[:, 0].max()), 1),
+                             DEFAULT_ROW_BUCKETS)
+        pb = sz[:, 1:1 + ncols_l].max(axis=0)
+        bb = sz[:, 1 + ncols_l:].max(axis=0)
+        l_types = self._join.children[0].output_types
+        r_types = self._join.children[1].output_types
+        pchar = [bucket_for(max(int(x), 1), DEFAULT_CHAR_BUCKETS)
+                 if isinstance(dt, (t.StringType, t.BinaryType)) else 0
+                 for x, dt in zip(pb, l_types)]
+        bchar = [bucket_for(max(int(x), 1), DEFAULT_CHAR_BUCKETS)
+                 if isinstance(dt, (t.StringType, t.BinaryType)) else 0
+                 for x, dt in zip(bb, r_types)]
+        out = self._compiled_expand(out_cap, pchar, bchar)(
+            lx, rx, order, lo, counts)
+        return shards_to_table(out)
+
+
 def _attr(name: str, dtype: t.DataType):
     from ..expr.core import AttributeReference
     return AttributeReference(name, dtype)

@@ -62,67 +62,193 @@ class IciAggregateExec(Exec):
         return f"IciAggregate({n} chips, all_to_all)"
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
-        from ..columnar.device import batch_to_device
         source = self.children[0]
-        n_dev = self._dagg.n_dev
-        rbs = []
-        for spid in range(source.num_partitions):
-            for b in source.execute_partition(spid, ctx):
-                rb = to_host_batch(b, source.output_names)
-                if rb.num_rows:
-                    rbs.append(rb)
-        schema = to_arrow_schema(source.output_names, source.output_types)
-        tbl = pa.Table.from_batches([rb.cast(schema) for rb in rbs],
-                                    schema=schema) if rbs else \
-            schema.empty_table()
-        per = max(1, -(-tbl.num_rows // n_dev))
-        shards = [tbl.slice(i * per, per) for i in range(n_dev)]
+        tbl = _gather_source_table(source, ctx, source.output_names,
+                                   source.output_types)
+        shards = _shard_table(tbl, self._dagg.n_dev)
         with MetricTimer(self.metrics[OP_TIME]):
             out = self._dagg.run(shards)
-        for rb in out.combine_chunks().to_batches():
-            if rb.num_rows == 0:
-                continue
-            batch = batch_to_device(rb, xp=self.xp)
-            self.metrics[NUM_OUTPUT_ROWS] += rb.num_rows
-            self.metrics[NUM_OUTPUT_BATCHES] += 1
-            yield batch
+        yield from _emit_table(self, out)
+
+
+def _gather_source_table(source: Exec, ctx, names, dtypes) -> pa.Table:
+    rbs = []
+    for spid in range(source.num_partitions):
+        for b in source.execute_partition(spid, ctx):
+            rb = to_host_batch(b, names)
+            if rb.num_rows:
+                rbs.append(rb)
+    schema = to_arrow_schema(names, dtypes)
+    if not rbs:
+        return schema.empty_table()
+    return pa.Table.from_batches([rb.cast(schema) for rb in rbs],
+                                 schema=schema)
+
+
+def _shard_table(tbl: pa.Table, n_dev: int):
+    per = max(1, -(-tbl.num_rows // n_dev))
+    return [tbl.slice(i * per, per) for i in range(n_dev)]
+
+
+def _emit_table(self, tbl: pa.Table) -> Iterator[Batch]:
+    from ..columnar.device import batch_to_device
+    for rb in tbl.combine_chunks().to_batches():
+        if rb.num_rows == 0:
+            continue
+        batch = batch_to_device(rb, xp=self.xp)
+        self.metrics[NUM_OUTPUT_ROWS] += rb.num_rows
+        self.metrics[NUM_OUTPUT_BATCHES] += 1
+        yield batch
+
+
+class IciSortExec(Exec):
+    """Distributed total-order sort over the mesh (replaces
+    sort ← range-exchange; splitter sampling + all_to_all routing +
+    local sort compile into ONE SPMD program, ref GpuRangePartitioner +
+    GpuSortExec)."""
+
+    placement = TPU
+
+    def __init__(self, sort_exec, mesh=None):
+        from .mesh import build_mesh
+        exchange = sort_exec.children[0]
+        source = exchange.children[0]
+        super().__init__([source])
+        self.sort_exec = sort_exec
+        self.mesh = mesh or build_mesh()
+        from .distributed import DistributedSort
+        self._dsort = DistributedSort(sort_exec.orders,
+                                      source.output_names,
+                                      source.output_types, mesh=self.mesh)
+
+    output_names = property(lambda self: self.sort_exec.output_names)
+    output_types = property(lambda self: self.sort_exec.output_types)
+    num_partitions = property(lambda self: 1)
+
+    def describe(self):
+        n = self.mesh.shape[self._dsort.axis]
+        return f"IciSort({n} chips, sample+all_to_all)"
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        source = self.children[0]
+        tbl = _gather_source_table(source, ctx, source.output_names,
+                                   source.output_types)
+        shards = _shard_table(tbl, self._dsort.n_dev)
+        with MetricTimer(self.metrics[OP_TIME]):
+            out = self._dsort.run(shards)
+        yield from _emit_table(self, out)
+
+
+class IciJoinExec(Exec):
+    """Shuffled hash join over the mesh (replaces
+    join ← {hash-exchange, hash-exchange}; both sides ride all_to_all
+    inside the compiled stage, ref GpuShuffledHashJoinBase +
+    UCXShuffleTransport)."""
+
+    placement = TPU
+
+    def __init__(self, join_exec, mesh=None):
+        from .mesh import build_mesh
+        lex, rex = join_exec.children
+        lsrc, rsrc = lex.children[0], rex.children[0]
+        super().__init__([lsrc, rsrc])
+        self.join_exec = join_exec
+        self.mesh = mesh or build_mesh()
+        from .distributed import DistributedHashJoin
+        self._djoin = DistributedHashJoin(
+            [k for k in join_exec.left_keys],
+            [k for k in join_exec.right_keys],
+            join_exec.how, join_exec.condition,
+            lsrc.output_names, lsrc.output_types,
+            rsrc.output_names, rsrc.output_types, mesh=self.mesh)
+
+    output_names = property(lambda self: self.join_exec.output_names)
+    output_types = property(lambda self: self.join_exec.output_types)
+    num_partitions = property(lambda self: 1)
+
+    def describe(self):
+        n = self.mesh.shape[self._djoin.axis]
+        return f"IciJoin({self.join_exec.how}, {n} chips, all_to_all)"
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        lsrc, rsrc = self.children
+        lt = _gather_source_table(lsrc, ctx, lsrc.output_names,
+                                  lsrc.output_types)
+        rt = _gather_source_table(rsrc, ctx, rsrc.output_names,
+                                  rsrc.output_types)
+        n_dev = self._djoin.n_dev
+        with MetricTimer(self.metrics[OP_TIME]):
+            out = self._djoin.run(_shard_table(lt, n_dev),
+                                  _shard_table(rt, n_dev))
+        yield from _emit_table(self, out)
 
 
 def install_ici_stages(root: Exec, conf: cfg.RapidsConf) -> Exec:
-    """Post-conversion rewrite: final←exchange←partial aggregate triples
-    become one IciAggregateExec when the ICI transport is selected and a
-    multi-chip mesh exists."""
+    """Post-conversion rewrite: shuffle-bracketed stages become fused SPMD
+    mesh stages when the ICI transport is selected and a multi-chip mesh
+    exists — aggregate triples (IciAggregateExec), range-partitioned
+    global sorts (IciSortExec), and co-partitioned hash joins
+    (IciJoinExec).  The reference swaps its transport underneath every
+    shuffle (UCXShuffleTransport serves aggregates, joins and sorts
+    alike); this pass is the plan-level equivalent."""
     if conf.get(cfg.SHUFFLE_TRANSPORT) != "ici":
         return root
     import jax
     if len(jax.devices()) < 2:
         return root
     from ..exec.aggregate import TpuHashAggregateExec
+    from ..exec.join import HashJoinExec
+    from ..exec.sort import SortExec
     from ..expr.aggregates import FINAL, PARTIAL
     from ..shuffle.exchange import ShuffleExchangeExec
-    from ..shuffle.partitioning import HashPartitioning
+    from ..shuffle.partitioning import HashPartitioning, RangePartitioning
     from .alltoall import exchange_supported
 
     def rewrite(node: Exec) -> Exec:
         node = node.with_new_children([rewrite(c) for c in node.children])
-        if not (isinstance(node, TpuHashAggregateExec) and
-                node.mode == FINAL and node.grouping):
+        # --- final <- hash-exchange <- partial aggregate ----------------
+        if isinstance(node, TpuHashAggregateExec) and \
+                node.mode == FINAL and node.grouping:
+            ex = node.children[0]
+            if isinstance(ex, ShuffleExchangeExec) and \
+                    isinstance(ex.partitioning, HashPartitioning):
+                part = ex.children[0]
+                if isinstance(part, TpuHashAggregateExec) and \
+                        part.mode == PARTIAL and part.placement == TPU:
+                    source = part.children[0]
+                    if not (exchange_supported(part.output_types) or
+                            exchange_supported(source.output_types)):
+                        try:
+                            return IciAggregateExec(node)
+                        except NotImplementedError:
+                            pass
             return node
-        ex = node.children[0]
-        if not (isinstance(ex, ShuffleExchangeExec) and
-                isinstance(ex.partitioning, HashPartitioning)):
+        # --- global sort <- range exchange ------------------------------
+        if isinstance(node, SortExec) and node.is_global and \
+                node.placement == TPU:
+            ex = node.children[0]
+            if isinstance(ex, ShuffleExchangeExec) and \
+                    isinstance(ex.partitioning, RangePartitioning) and \
+                    not exchange_supported(ex.output_types):
+                try:
+                    return IciSortExec(node)
+                except NotImplementedError:
+                    pass
             return node
-        part = ex.children[0]
-        if not (isinstance(part, TpuHashAggregateExec) and
-                part.mode == PARTIAL and part.placement == TPU):
+        # --- colocated hash join <- two hash exchanges ------------------
+        if isinstance(node, HashJoinExec) and node.colocated and \
+                node.placement == TPU:
+            lex, rex = node.children
+            if all(isinstance(e, ShuffleExchangeExec) and
+                   isinstance(e.partitioning, HashPartitioning)
+                   for e in (lex, rex)) and \
+                    not (exchange_supported(lex.output_types) or
+                         exchange_supported(rex.output_types)):
+                try:
+                    return IciJoinExec(node)
+                except NotImplementedError:
+                    pass
             return node
-        source = part.children[0]
-        if exchange_supported(part.output_types) or \
-                exchange_supported(source.output_types):
-            return node  # nested types ride the host shuffle
-        try:
-            return IciAggregateExec(node)
-        except NotImplementedError:
-            return node
+        return node
 
     return rewrite(root)

@@ -74,3 +74,106 @@ def test_tcp_transport_keeps_host_exchange():
            .group_by(col("k")).agg(F.count("*").alias("c")).collect())
     assert "IciAggregateExec" not in _names(s)
     assert sum(got.column("c").to_pylist()) == n
+
+
+def test_ici_join_routed_and_correct():
+    """A shuffled hash join with transport=ici fuses into IciJoinExec:
+    both sides exchanged over all_to_all inside one SPMD stage and the
+    result equals the host path (ref GpuShuffledHashJoinBase)."""
+    rng = np.random.default_rng(3)
+    n = 4000
+    fact = pa.table({
+        "k": pa.array(rng.integers(0, 300, n).astype(np.int64)),
+        "v": pa.array(rng.integers(-100, 100, n).astype(np.int64)),
+    })
+    dim = pa.table({
+        "k": pa.array(np.arange(250, dtype=np.int64)),
+        "w": pa.array(rng.integers(0, 10, 250).astype(np.int64)),
+    })
+    # disable broadcast so the shuffled-hash path is chosen
+    s2 = (TpuSession.builder()
+          .config("spark.rapids.sql.enabled", True)
+          .config("spark.rapids.shuffle.transport", "ici")
+          .config("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+          .get_or_create())
+    fdf = s2.create_dataframe(fact, num_partitions=4)
+    ddf = s2.create_dataframe(dim, num_partitions=2)
+    got = fdf.join(ddf, on="k", how="inner").collect()
+    names = _names(s2)
+    assert "IciJoinExec" in names, names
+    assert "ShuffleExchangeExec" not in names
+
+    # oracle: host path with ici off
+    s3 = (TpuSession.builder()
+          .config("spark.rapids.sql.enabled", False)
+          .config("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+          .get_or_create())
+    want = (s3.create_dataframe(fact, num_partitions=4)
+            .join(s3.create_dataframe(dim, num_partitions=2),
+                  on="k", how="inner").collect())
+    key = lambda tb: sorted(zip(tb.column("k").to_pylist(),
+                                tb.column("v").to_pylist(),
+                                tb.column("w").to_pylist()))
+    assert key(got) == key(want)
+
+
+def test_ici_join_semi_anti():
+    rng = np.random.default_rng(4)
+    n = 2000
+    left = pa.table({
+        "k": pa.array(rng.integers(0, 100, n).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 50, n).astype(np.int64)),
+    })
+    right = pa.table({"k": pa.array(np.arange(0, 60, dtype=np.int64))})
+    s2 = (TpuSession.builder()
+          .config("spark.rapids.sql.enabled", True)
+          .config("spark.rapids.shuffle.transport", "ici")
+          .config("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+          .get_or_create())
+    for how, pred in [("left_semi", lambda k: k < 60),
+                      ("left_anti", lambda k: k >= 60)]:
+        got = (s2.create_dataframe(left, num_partitions=3)
+               .join(s2.create_dataframe(right, num_partitions=2),
+                     on="k", how=how).collect())
+        assert "IciJoinExec" in _names(s2), (how, _names(s2))
+        want = sorted((k, v) for k, v in
+                      zip(left.column("k").to_pylist(),
+                          left.column("v").to_pylist()) if pred(k))
+        assert sorted(zip(got.column("k").to_pylist(),
+                          got.column("v").to_pylist())) == want, how
+
+
+def test_ici_sort_routed_and_total_order():
+    """A global sort with transport=ici fuses into IciSortExec (splitter
+    sample + all_to_all + local sort in one SPMD program) and yields the
+    exact total order of the host path (ref GpuRangePartitioner)."""
+    s = _session()
+    rng = np.random.default_rng(5)
+    n = 3000
+    tb = pa.table({
+        "a": pa.array(rng.integers(-1000, 1000, n).astype(np.int64)),
+        "b": pa.array(rng.random(n)),
+    })
+    df = s.create_dataframe(tb, num_partitions=4)
+    got = df.sort(col("a"), col("b")).collect()
+    names = _names(s)
+    assert "IciSortExec" in names, names
+    assert "ShuffleExchangeExec" not in names
+    want = tb.sort_by([("a", "ascending"), ("b", "ascending")])
+    assert got.column("a").to_pylist() == want.column("a").to_pylist()
+    assert got.column("b").to_pylist() == want.column("b").to_pylist()
+
+
+def test_ici_sort_desc_with_strings():
+    s = _session()
+    rng = np.random.default_rng(6)
+    n = 800
+    words = [f"w{int(i):03d}" for i in rng.integers(0, 200, n)]
+    tb = pa.table({"s": pa.array(words),
+                   "v": pa.array(rng.integers(0, 99, n).astype(np.int64))})
+    df = s.create_dataframe(tb, num_partitions=3)
+    got = df.sort(col("s").desc(), col("v")).collect()
+    assert "IciSortExec" in _names(s), _names(s)
+    want = tb.sort_by([("s", "descending"), ("v", "ascending")])
+    assert got.column("s").to_pylist() == want.column("s").to_pylist()
+    assert got.column("v").to_pylist() == want.column("v").to_pylist()
