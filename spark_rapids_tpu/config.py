@@ -275,6 +275,19 @@ SHUFFLE_TRANSPORT = conf("spark.rapids.shuffle.transport").string() \
     .check_values(["ici", "tcp", "none"]) \
     .create_with_default("none")
 
+PYTHON_WORKER_ENABLED = conf("spark.rapids.sql.python.worker.enabled").boolean() \
+    .doc("Run Python/pandas UDFs in out-of-process Arrow-IPC workers "
+         "(crash containment + no GIL/heap contention with the engine, "
+         "ref GpuArrowEvalPythonExec + python/rapids/worker.py).  UDFs "
+         "that cannot be pickled fall back to in-process evaluation.") \
+    .create_with_default(True)
+
+CONCURRENT_PYTHON_WORKERS = conf(
+    "spark.rapids.python.concurrentPythonWorkers").integer() \
+    .doc("Maximum live Python UDF worker processes "
+         "(ref PythonWorkerSemaphore).") \
+    .create_with_default(2)
+
 SCAN_PIN_DEVICE = conf("spark.rapids.sql.localScan.pinDeviceBatches").boolean() \
     .doc("Keep uploaded device batches of in-memory scans pinned in HBM "
          "across collects, so repeated queries over the same DataFrame "

@@ -26,12 +26,11 @@ from .base import (CPU, NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, Batch,
                    Exec, ExecContext, MetricTimer, to_host_batch)
 
 
-def _from_pandas(pdf, schema: pa.Schema) -> pa.Table:
-    """pandas -> Arrow cast to the declared schema IMMEDIATELY, so
-    per-group dtype drift (e.g. int->float promotion under nulls) cannot
-    poison the concat."""
-    tbl = pa.Table.from_pandas(pdf, preserve_index=False)
-    return tbl.select(schema.names).cast(schema)
+# canonical pandas<->arrow helpers live in udf/worker.py so the worker
+# path and the in-process fallback share ONE implementation of the
+# schema-cast and null-safe grouping semantics
+from ..udf.worker import _cast_result as _from_pandas  # noqa: E402
+from ..udf.worker import _group_pandas  # noqa: E402
 
 
 def _batches_to_table(exec_node: Exec, pid: int, ctx) -> pa.Table:
@@ -85,8 +84,31 @@ class MapInPandasExec(Exec):
         return f"MapInPandas({getattr(self.fn, '__name__', 'fn')})"
 
     def execute_partition(self, pid, ctx: ExecContext) -> Iterator[Batch]:
+        from ..udf import worker as w
         limit = ctx.conf.arrow_max_records_per_batch
         child = self.children[0]
+        schema = to_arrow_schema(self.output_names, self.output_types)
+        if w.worker_path_usable(ctx.conf, self.fn):
+            # streaming exchange: one batch in flight per direction, so a
+            # partition larger than RAM flows through the worker the same
+            # way the in-process iterator path streams it
+            def table_iter():
+                for b in child.execute_partition(pid, ctx):
+                    rb = to_host_batch(b, child.output_names)
+                    if rb.num_rows:
+                        yield pa.Table.from_batches([rb])
+
+            out_iter = w.pool_from_conf(ctx.conf).run_stream(
+                w.task_stream_map_in_pandas, (self.fn, schema),
+                table_iter())
+            while True:
+                with MetricTimer(self.metrics[OP_TIME]):
+                    try:
+                        tbl = next(out_iter)
+                    except StopIteration:
+                        break
+                yield from _emit_table(self, tbl, limit)
+            return
 
         def pdf_iter():
             for b in child.execute_partition(pid, ctx):
@@ -94,31 +116,12 @@ class MapInPandasExec(Exec):
                 if rb.num_rows:
                     yield rb.to_pandas()
 
-        schema = to_arrow_schema(self.output_names, self.output_types)
         with MetricTimer(self.metrics[OP_TIME]):
             outs = [_from_pandas(pdf, schema)
                     for pdf in self.fn(pdf_iter()) if len(pdf)]
         if not outs:
             return
         yield from _emit_table(self, pa.concat_tables(outs), limit)
-
-
-def _group_tables(tbl: pa.Table, key_names: List[str]):
-    """Split a table into (key_tuple -> sub-table), null-safe grouping."""
-    import pandas as pd
-    if tbl.num_rows == 0:
-        return {}
-    pdf = tbl.to_pandas()
-    groups = {}
-    grouped = pdf.groupby(key_names, dropna=False, sort=True)
-    for key, sub in grouped:
-        if not isinstance(key, tuple):
-            key = (key,)
-        # normalize NaN keys to None for dict identity
-        key = tuple(None if (isinstance(k, float) and k != k) or
-                    k is pd.NaT else k for k in key)
-        groups[key] = sub.reset_index(drop=True)
-    return groups
 
 
 class FlatMapGroupsInPandasExec(Exec):
@@ -151,14 +154,22 @@ class FlatMapGroupsInPandasExec(Exec):
                 f" {getattr(self.fn, '__name__', 'fn')})")
 
     def execute_partition(self, pid, ctx: ExecContext) -> Iterator[Batch]:
+        from ..udf import worker as w
         limit = ctx.conf.arrow_max_records_per_batch
         tbl = _batches_to_table(self.children[0], pid, ctx)
         schema = to_arrow_schema(self.output_names, self.output_types)
+        if w.worker_path_usable(ctx.conf, self.fn):
+            with MetricTimer(self.metrics[OP_TIME]):
+                tables, _ = w.pool_from_conf(ctx.conf).run(
+                    w.task_grouped_map,
+                    (self.fn, schema, self.key_names), [tbl])
+            if not tables:
+                return
+            yield from _emit_table(self, tables[0], limit)
+            return
         with MetricTimer(self.metrics[OP_TIME]):
             outs = []
-            for _, pdf in sorted(_group_tables(tbl, self.key_names).items(),
-                                 key=lambda kv: tuple(
-                                     (k is None, k) for k in kv[0])):
+            for _, pdf in _group_pandas(tbl, self.key_names):
                 res = self.fn(pdf)
                 if len(res):
                     outs.append(_from_pandas(res, schema))
@@ -200,22 +211,28 @@ class AggregateInPandasExec(Exec):
                 f"fns=[{', '.join(n for n, *_ in self.udfs)}])")
 
     def execute_partition(self, pid, ctx: ExecContext) -> Iterator[Batch]:
+        from ..udf import worker as w
         limit = ctx.conf.arrow_max_records_per_batch
         tbl = _batches_to_table(self.children[0], pid, ctx)
-        with MetricTimer(self.metrics[OP_TIME]):
-            rows = {n: [] for n in self.output_names}
-            if self.key_names:
-                groups = sorted(_group_tables(tbl, self.key_names).items(),
-                                key=lambda kv: tuple(
-                                    (k is None, k) for k in kv[0]))
-            else:
-                groups = [((), tbl.to_pandas())]  # global aggregate
-            for key, pdf in groups:
-                for k_name, k_val in zip(self.key_names, key):
-                    rows[k_name].append(k_val)
-                for out_name, fn, _, in_cols in self.udfs:
-                    args = [pdf[c] for c in in_cols]
-                    rows[out_name].append(fn(*args))
+        if w.worker_path_usable(ctx.conf,
+                                *[fn for _, fn, _, _ in self.udfs]):
+            specs = [(n, fn, in_cols) for n, fn, _, in_cols in self.udfs]
+            with MetricTimer(self.metrics[OP_TIME]):
+                _, rows = w.pool_from_conf(ctx.conf).run(
+                    w.task_grouped_agg, (specs, self.key_names), [tbl])
+        else:
+            with MetricTimer(self.metrics[OP_TIME]):
+                rows = {n: [] for n in self.output_names}
+                if self.key_names:
+                    groups = _group_pandas(tbl, self.key_names)
+                else:
+                    groups = [((), tbl.to_pandas())]  # global aggregate
+                for key, pdf in groups:
+                    for k_name, k_val in zip(self.key_names, key):
+                        rows[k_name].append(k_val)
+                    for out_name, fn, _, in_cols in self.udfs:
+                        args = [pdf[c] for c in in_cols]
+                        rows[out_name].append(fn(*args))
         first = self.output_names[0]
         if not rows[first]:
             return
@@ -262,11 +279,23 @@ class FlatMapCoGroupsInPandasExec(Exec):
                 f"[{', '.join(self.left_keys)}])")
 
     def execute_partition(self, pid, ctx: ExecContext) -> Iterator[Batch]:
+        from ..udf import worker as w
         limit = ctx.conf.arrow_max_records_per_batch
         ltbl = _batches_to_table(self.children[0], pid, ctx)
         rtbl = _batches_to_table(self.children[1], pid, ctx)
-        lgroups = _group_tables(ltbl, self.left_keys)
-        rgroups = _group_tables(rtbl, self.right_keys)
+        schema0 = to_arrow_schema(self.output_names, self.output_types)
+        if w.worker_path_usable(ctx.conf, self.fn):
+            with MetricTimer(self.metrics[OP_TIME]):
+                tables, _ = w.pool_from_conf(ctx.conf).run(
+                    w.task_cogrouped_map,
+                    (self.fn, schema0, self.left_keys, self.right_keys),
+                    [ltbl, rtbl])
+            if not tables:
+                return
+            yield from _emit_table(self, tables[0], limit)
+            return
+        lgroups = dict(_group_pandas(ltbl, self.left_keys))
+        rgroups = dict(_group_pandas(rtbl, self.right_keys))
         keys = sorted(set(lgroups) | set(rgroups),
                       key=lambda kv: tuple((k is None, k) for k in kv))
         schema = to_arrow_schema(self.output_names, self.output_types)

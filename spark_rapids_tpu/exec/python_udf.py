@@ -71,18 +71,64 @@ class ArrowEvalPythonExec(Exec):
             yield batch_to_device(piece.to_batches()[0], xp=np)
 
     def execute_partition(self, pid, ctx: ExecContext) -> Iterator[Batch]:
+        from ..udf import worker as w
         limit = ctx.conf.arrow_max_records_per_batch
-        for big in self.children[0].execute_partition(pid, ctx):
+        use_worker = w.worker_path_usable(ctx.conf, *self._bound)
+        child = self.children[0]
+        for big in child.execute_partition(pid, ctx):
             for b in self._split(big, limit):
                 with MetricTimer(self.metrics[OP_TIME]):
-                    ectx = EvalContext(np, b, ansi=ctx.conf.ansi_enabled)
-                    cols = list(b.columns)
-                    for u in self._bound:
-                        v = u.eval(ectx)
-                        if isinstance(v, ScalarValue):
-                            v = scalar_to_column(ectx, v)
-                        cols.append(v.col)
-                    out = DeviceBatch(cols, b.num_rows, self.output_names)
+                    if use_worker:
+                        out = self._eval_in_worker(b, ctx)
+                    else:
+                        ectx = EvalContext(np, b,
+                                           ansi=ctx.conf.ansi_enabled)
+                        cols = list(b.columns)
+                        for u in self._bound:
+                            v = u.eval(ectx)
+                            if isinstance(v, ScalarValue):
+                                v = scalar_to_column(ectx, v)
+                            cols.append(v.col)
+                        out = DeviceBatch(cols, b.num_rows,
+                                          self.output_names)
                 self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
                 self.metrics[NUM_OUTPUT_BATCHES] += 1
                 yield out
+
+    def _eval_in_worker(self, b: Batch, ctx: ExecContext) -> Batch:
+        """Ship the batch over Arrow IPC; the worker runs the SAME bound
+        expression evaluator, then the UDF columns come back columnar
+        (ref GpuArrowEvalPythonExec's worker exchange + BatchQueue input
+        pairing — here the child columns never leave this process)."""
+        import pyarrow as pa
+        from ..columnar.device import batch_to_arrow, batch_to_device
+        from ..udf import worker as w
+        child = self.children[0]
+        rb = batch_to_arrow(DeviceBatch(b.columns, int(b.num_rows),
+                                        child.output_names))
+        aux = (self._bound, child.output_names, child.output_types,
+               self.udf_names, ctx.conf.ansi_enabled)
+        tables, _ = w.pool_from_conf(ctx.conf).run(
+            w.task_eval_bound, aux, [pa.Table.from_batches([rb])])
+        # pair the child columns with the worker's UDF columns through one
+        # Arrow table so every lane shares a single capacity bucket
+        udf_tbl = tables[0].combine_chunks()
+        paired = pa.Table.from_arrays(
+            list(pa.Table.from_batches([rb]).columns) +
+            [udf_tbl.column(i) for i in range(udf_tbl.num_columns)],
+            names=self.output_names)
+        rbs = paired.combine_chunks().to_batches()
+        if not rbs:
+            # a 0-row table flattens to no batches; keep the DECLARED
+            # schema (from_pydict would infer null type for every column)
+            from ..columnar.interop import to_arrow_schema
+            rbs = to_arrow_schema(self.output_names,
+                                  self.output_types).empty_table() \
+                .to_batches(max_chunksize=1)
+            if not rbs:
+                rbs = [pa.RecordBatch.from_arrays(
+                    [pa.array([], type=f.type)
+                     for f in to_arrow_schema(self.output_names,
+                                              self.output_types)],
+                    names=list(self.output_names))]
+        return batch_to_device(rbs[0], xp=np)
