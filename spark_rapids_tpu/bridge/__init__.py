@@ -1,0 +1,34 @@
+"""Spark-facing bridge: ship physical-plan stages from a Spark executor
+into this engine over Arrow IPC.
+
+The reference integrates with Spark from INSIDE the JVM: Plugin.scala
+forces itself into spark.sql.extensions (Plugin.scala:77-112) and its
+ColumnarRule (Plugin.scala:44-51) swaps physical subtrees for Gpu execs
+that call cuDF through JNI.  A JAX/XLA engine cannot live inside the JVM,
+so the bridge is a per-executor SIDECAR process (SURVEY hard-part #2's
+recommended shape): the JVM side replaces a supported subtree
+(scan -> filter -> project -> aggregate) with a stage that
+
+  1. serializes the subtree as a JSON plan spec (bridge/spec.py — the
+     language-neutral contract a Scala ColumnarRule emits),
+  2. streams its input ColumnarBatches as Arrow IPC to the sidecar
+     (bridge/sidecar.py) over a localhost socket, the same transport the
+     reference already uses between the JVM and pandas workers
+     (GpuArrowEvalPythonExec), and
+  3. reads the stage's result back as Arrow.
+
+The sidecar advertises its port on stdout at startup (the analog of the
+UCX port riding MapStatus's BlockManagerId topology field,
+RapidsShuffleInternalManagerBase.scala:175-185).
+
+No JVM exists in this build environment, so tests/test_bridge.py plays
+the JVM's role faithfully: a separate OS process builds plan specs +
+Arrow streams exactly as the Scala rule would and validates results
+against an independent oracle.
+"""
+
+from .client import BridgeClient
+from .sidecar import SidecarServer
+from .spec import plan_spec_to_logical
+
+__all__ = ["BridgeClient", "SidecarServer", "plan_spec_to_logical"]

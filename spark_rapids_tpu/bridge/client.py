@@ -1,0 +1,62 @@
+"""Bridge client: the executor-side driver of the sidecar protocol.
+
+The Scala ColumnarRule's replacement exec holds one of these per task
+(connection pooling is the JVM side's concern, like the reference's
+transport client cache, RapidsShuffleTransport.makeClient).  This Python
+implementation is both the reference client for the protocol and what
+the fake-JVM test harness uses."""
+
+from __future__ import annotations
+
+import io
+import json
+import socket
+import struct
+
+import pyarrow as pa
+
+from .sidecar import MAGIC, _read_exact
+
+
+class BridgeError(RuntimeError):
+    """The sidecar rejected or failed the stage (sidecar stays alive)."""
+
+
+class BridgeClient:
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 timeout: float = 600.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+
+    def ping(self) -> bool:
+        self._sock.sendall(MAGIC + b"P")
+        tag = _read_exact(self._sock, 1)
+        _read_exact(self._sock, 8)
+        return tag == b"O"
+
+    def execute_stage(self, spec: dict, table: pa.Table) -> pa.Table:
+        sink = io.BytesIO()
+        with pa.ipc.new_stream(sink, table.schema) as w:
+            w.write_table(table)
+        ipc = sink.getvalue()
+        blob = json.dumps(spec).encode()
+        self._sock.sendall(MAGIC + b"E" + struct.pack("<I", len(blob)) +
+                           blob + struct.pack("<Q", len(ipc)) + ipc)
+        tag = _read_exact(self._sock, 1)
+        if tag == b"E":
+            (n,) = struct.unpack("<I", _read_exact(self._sock, 4))
+            raise BridgeError(_read_exact(self._sock, n).decode())
+        (n,) = struct.unpack("<Q", _read_exact(self._sock, 8))
+        with pa.ipc.open_stream(io.BytesIO(_read_exact(self._sock, n))) as r:
+            return r.read_all()
+
+    def shutdown_sidecar(self):
+        try:
+            self._sock.sendall(MAGIC + b"Q")
+        except OSError:
+            pass
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

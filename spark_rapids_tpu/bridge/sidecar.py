@@ -1,0 +1,140 @@
+"""Sidecar server: hosts the engine next to a Spark executor.
+
+One sidecar per executor (ref the reference's one-GPU-per-executor
+assumption, Plugin.scala:180-181).  The JVM connects over localhost TCP
+and drives the framed protocol:
+
+    request : MAGIC 'E' | u32 spec_len | spec JSON | u64 ipc_len | Arrow IPC
+    response: 'O' | u64 ipc_len | Arrow IPC        (stage result)
+              'E' | u32 msg_len | utf-8 error      (stage failed; sidecar
+                                                    stays up)
+    request : MAGIC 'P'  -> response 'O' u64=0     (ping)
+    request : MAGIC 'Q'  -> sidecar exits          (shutdown)
+
+Startup prints `TPU_SIDECAR_PORT=<port>` on stdout — the discovery
+handshake (the reference advertises its fast-path port through
+MapStatus's BlockManagerId topology field,
+RapidsShuffleInternalManagerBase.scala:175-185)."""
+
+from __future__ import annotations
+
+import io
+import json
+import socket
+import struct
+import sys
+import threading
+from typing import Optional
+
+import pyarrow as pa
+
+MAGIC = b"TPUB"
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise EOFError("bridge peer closed")
+        buf += chunk
+    return buf
+
+
+class SidecarServer:
+    def __init__(self, conf: Optional[dict] = None, port: int = 0):
+        self.conf = dict(conf or {})
+        self.conf.setdefault("spark.rapids.sql.enabled", True)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", port))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._session = None
+        self._stop = threading.Event()
+
+    def _get_session(self):
+        if self._session is None:
+            from ..api.session import TpuSession
+            b = TpuSession.builder()
+            for k, v in self.conf.items():
+                b = b.config(k, v)
+            self._session = b.get_or_create()
+        return self._session
+
+    def execute_stage(self, spec: dict, table: pa.Table) -> pa.Table:
+        from .spec import plan_spec_to_logical
+        session = self._get_session()
+        lp = plan_spec_to_logical(spec, table)
+        return session.execute(lp)
+
+    # -- server loop --------------------------------------------------------
+    def serve_forever(self, announce=True):
+        if announce:
+            print(f"TPU_SIDECAR_PORT={self.port}", flush=True)
+        # accept with a timeout so shutdown() (called from a connection
+        # thread) reliably wakes this loop — closing a socket does not
+        # interrupt a blocked accept on all platforms
+        self._sock.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            while True:
+                head = _read_exact(conn, 5)
+                if head[:4] != MAGIC:
+                    return
+                op = head[4:5]
+                if op == b"P":
+                    conn.sendall(b"O" + struct.pack("<Q", 0))
+                    continue
+                if op == b"Q":
+                    self.shutdown()
+                    return
+                if op != b"E":
+                    return
+                (spec_len,) = struct.unpack("<I", _read_exact(conn, 4))
+                spec = json.loads(_read_exact(conn, spec_len))
+                (ipc_len,) = struct.unpack("<Q", _read_exact(conn, 8))
+                ipc = _read_exact(conn, ipc_len)
+                try:
+                    with pa.ipc.open_stream(io.BytesIO(ipc)) as r:
+                        table = r.read_all()
+                    out = self.execute_stage(spec, table)
+                    sink = io.BytesIO()
+                    with pa.ipc.new_stream(sink, out.schema) as w:
+                        w.write_table(out)
+                    body = sink.getvalue()
+                    conn.sendall(b"O" + struct.pack("<Q", len(body)) + body)
+                except Exception as ex:  # noqa: BLE001 — survive bad stages
+                    msg = f"{type(ex).__name__}: {ex}".encode()
+                    conn.sendall(b"E" + struct.pack("<I", len(msg)) + msg)
+        except (EOFError, OSError):
+            return
+        finally:
+            conn.close()
+
+    def shutdown(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def main():
+    conf = json.loads(sys.argv[1]) if len(sys.argv) > 1 else {}
+    SidecarServer(conf).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""JSON plan-spec: the language-neutral stage contract the JVM side emits.
+
+A spec describes one pushed-down stage over a single Arrow input stream
+(the subtree a ColumnarRule replaced, ref GpuOverrides' convert of
+scan/filter/project/aggregate subtrees).  Shape:
+
+    {"input": {"schema": [["k", "bigint"], ["v", "bigint"]]},
+     "ops": [
+       {"op": "filter", "condition": <expr>},
+       {"op": "project", "exprs": [{"expr": <expr>, "name": "x"}]},
+       {"op": "aggregate",
+        "groupBy": [<expr>...],
+        "aggs": [{"fn": "sum", "expr": <expr>, "name": "s"}]},
+       {"op": "sort", "orders": [{"expr": <expr>, "ascending": true,
+                                  "nullsFirst": true}]},
+       {"op": "limit", "n": 10}
+     ]}
+
+Expressions are JSON trees:
+
+    {"col": "v"} | {"lit": 5, "type": "bigint"} |
+    {"op": "gt", "children": [<expr>, <expr>]}
+
+Types use Spark SQL DDL names (the same strings the DataFrame API's
+schema parser accepts), so the Scala side can emit
+`DataType.catalogString` verbatim.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..api.column import _parse_type
+from ..plan import logical as L
+
+
+_AGG_FNS = ("sum", "count", "avg", "min", "max", "first", "last")
+
+
+def expr_from_spec(spec: Dict):
+    """JSON expression tree -> engine expression."""
+    from ..expr import arithmetic as ar
+    from ..expr import predicates as pr
+    from ..expr.core import AttributeReference, Literal
+    if "col" in spec:
+        return AttributeReference(spec["col"])
+    if "lit" in spec:
+        dt = _parse_type(spec["type"]) if "type" in spec else None
+        return Literal(spec["lit"], dt) if dt is not None \
+            else Literal(spec["lit"])
+    op = spec["op"]
+    kids = [expr_from_spec(c) for c in spec.get("children", [])]
+    table = {
+        "eq": pr.EqualTo, "lt": pr.LessThan, "le": pr.LessThanOrEqual,
+        "gt": pr.GreaterThan, "ge": pr.GreaterThanOrEqual,
+        "and": pr.And, "or": pr.Or,
+        "add": ar.Add, "sub": ar.Subtract, "mul": ar.Multiply,
+        "div": ar.Divide,
+    }
+    if op in table:
+        return table[op](*kids)
+    if op == "ne":
+        return pr.Not(pr.EqualTo(*kids))
+    if op == "not":
+        return pr.Not(kids[0])
+    if op == "isnull":
+        return pr.IsNull(kids[0])
+    if op == "isnotnull":
+        return pr.IsNotNull(kids[0])
+    raise ValueError(f"unsupported bridge expression op {op!r}")
+
+
+def _agg_from_spec(a: Dict):
+    from ..expr.aggregates import (AggregateExpression, Average, Count,
+                                   First, Last, Max, Min, Sum)
+    fn = a["fn"]
+    if fn not in _AGG_FNS:
+        raise ValueError(f"unsupported bridge aggregate {fn!r}")
+    child = expr_from_spec(a["expr"]) if a.get("expr") is not None else None
+    cls = {"sum": Sum, "avg": Average, "min": Min, "max": Max,
+           "first": First, "last": Last}.get(fn)
+    if fn == "count":
+        agg = Count(child)
+    else:
+        agg = cls(child)
+    return AggregateExpression(agg, a.get("name") or fn)
+
+
+def plan_spec_to_logical(spec: Dict, table) -> L.LogicalPlan:
+    """Spec + the stage's Arrow input -> engine logical plan."""
+    from ..expr.core import Alias
+    lp: L.LogicalPlan = L.LocalRelation(table,
+                                        spec.get("numPartitions", 1))
+    for op in spec.get("ops", []):
+        kind = op["op"]
+        if kind == "filter":
+            lp = L.Filter(expr_from_spec(op["condition"]), lp)
+        elif kind == "project":
+            exprs = []
+            for e in op["exprs"]:
+                ex = expr_from_spec(e["expr"])
+                exprs.append(Alias(ex, e["name"]) if e.get("name") else ex)
+            lp = L.Project(exprs, lp)
+        elif kind == "aggregate":
+            grouping = [expr_from_spec(g) for g in op.get("groupBy", [])]
+            aggs = [_agg_from_spec(a) for a in op.get("aggs", [])]
+            lp = L.Aggregate(grouping, aggs, lp)
+        elif kind == "sort":
+            orders = [(expr_from_spec(o["expr"]),
+                       bool(o.get("ascending", True)),
+                       bool(o.get("nullsFirst", o.get("ascending", True))))
+                      for o in op["orders"]]
+            lp = L.Sort(orders, True, lp)
+        elif kind == "limit":
+            lp = L.Limit(int(op["n"]), lp)
+        else:
+            raise ValueError(f"unsupported bridge operator {kind!r}")
+    return lp

@@ -5,11 +5,13 @@ Ref: sql-plugin/.../execution/python/{GpuMapInPandasExec,
 GpuFlatMapGroupsInPandasExec, GpuAggregateInPandasExec,
 GpuFlatMapCoGroupsInPandasExec}.scala — the reference streams Arrow
 batches to out-of-process pandas workers and reassembles columnar
-output.  Our executors are Python, so the exchange is in-process pandas
-(the worker-protocol plumbing drops away; grouping/rebatching semantics
-are preserved).  All placements are CPU — the data leaves the device for
-Python either way, and the rewrite engine inserts the DeviceToHost
-transition exactly as the reference schedules its device->Arrow copy.
+output.  This engine does the same by default: udf/worker.py hosts the
+pandas exchange in pooled subprocesses (mapInPandas streams; the grouped
+family ships its co-located partition table per request), with an
+in-process fallback for unpicklable functions.  All placements are CPU —
+the data leaves the device for Python either way, and the rewrite engine
+inserts the DeviceToHost transition exactly as the reference schedules
+its device->Arrow copy.
 """
 
 from __future__ import annotations

@@ -5,14 +5,15 @@ Analog of the reference's GpuArrowEvalPythonExec
 which streams Arrow batches to out-of-process Python workers and pairs the
 results back with the inputs (BatchQueue, RebatchingRoundoffIterator).
 
-Our executor processes are already Python, so the exchange is in-process:
-the child's batches are brought to the host (the rewrite engine places
-this exec on CPU and inserts a DeviceToHost transition), each UDF is
-evaluated through its host evaluator, and the UDF outputs are appended as
-new columns after the child's output — the downstream Project refers to
-them by name.  Rebatching to the UDF target size is preserved: oversize
-batches are split so Python never sees more than `arrow_max_records_per_batch`
-rows at once (ref RebatchingRoundoffIterator's size goal).
+Default path (spark.rapids.sql.python.worker.enabled): the UDF input
+columns stream over Arrow IPC to an out-of-process worker
+(udf/worker.py) which runs the SAME bound-expression evaluator, and the
+UDF output columns are paired back with the locally-retained child
+batches — the BatchQueue design.  Unpicklable UDFs (or worker disabled)
+evaluate in-process with identical semantics.  Rebatching to the UDF
+target size is preserved either way: oversize batches split so Python
+never sees more than `arrow_max_records_per_batch` rows at once
+(ref RebatchingRoundoffIterator's size goal).
 """
 
 from __future__ import annotations
@@ -23,8 +24,9 @@ import numpy as np
 
 from .. import types as t
 from ..columnar.device import DeviceBatch
-from ..expr.core import (ColumnValue, EvalContext, Expression, ScalarValue,
-                         bind_expression, scalar_to_column)
+from ..expr.core import (BoundReference, ColumnValue, EvalContext,
+                         Expression, ScalarValue, bind_expression,
+                         scalar_to_column)
 from ..udf.python_udf import PythonUDF
 from .base import (CPU, NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, Batch,
                    Exec, ExecContext, MetricTimer)
@@ -75,60 +77,102 @@ class ArrowEvalPythonExec(Exec):
         limit = ctx.conf.arrow_max_records_per_batch
         use_worker = w.worker_path_usable(ctx.conf, *self._bound)
         child = self.children[0]
+        if use_worker:
+            yield from self._execute_via_worker(pid, ctx, limit)
+            return
         for big in child.execute_partition(pid, ctx):
             for b in self._split(big, limit):
                 with MetricTimer(self.metrics[OP_TIME]):
-                    if use_worker:
-                        out = self._eval_in_worker(b, ctx)
-                    else:
-                        ectx = EvalContext(np, b,
-                                           ansi=ctx.conf.ansi_enabled)
-                        cols = list(b.columns)
-                        for u in self._bound:
-                            v = u.eval(ectx)
-                            if isinstance(v, ScalarValue):
-                                v = scalar_to_column(ectx, v)
-                            cols.append(v.col)
-                        out = DeviceBatch(cols, b.num_rows,
-                                          self.output_names)
+                    ectx = EvalContext(np, b,
+                                       ansi=ctx.conf.ansi_enabled)
+                    cols = list(b.columns)
+                    for u in self._bound:
+                        v = u.eval(ectx)
+                        if isinstance(v, ScalarValue):
+                            v = scalar_to_column(ectx, v)
+                        cols.append(v.col)
+                    out = DeviceBatch(cols, b.num_rows,
+                                      self.output_names)
                 self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
                 self.metrics[NUM_OUTPUT_BATCHES] += 1
                 yield out
 
-    def _eval_in_worker(self, b: Batch, ctx: ExecContext) -> Batch:
-        """Ship the batch over Arrow IPC; the worker runs the SAME bound
-        expression evaluator, then the UDF columns come back columnar
-        (ref GpuArrowEvalPythonExec's worker exchange + BatchQueue input
-        pairing — here the child columns never leave this process)."""
+    def _shipped_exprs(self):
+        """(remapped bound exprs, used ordinals): only the columns the
+        UDFs reference cross the process boundary; expressions are
+        re-bound to the pruned ordinal space."""
+        child = self.children[0]
+        used = sorted({br.ordinal for u in self._bound
+                       for br in u.collect(
+                           lambda e: isinstance(e, BoundReference))})
+        if not used and child.output_names:
+            used = [0]  # constant UDFs still need a row-count carrier
+        remap = {old: new for new, old in enumerate(used)}
+
+        def rebind(e):
+            if isinstance(e, BoundReference):
+                return BoundReference(remap[e.ordinal], e.dtype, e.name)
+            return e
+
+        shipped = [u.transform_up(rebind) for u in self._bound]
+        names = [child.output_names[i] for i in used]
+        types = [child.output_types[i] for i in used]
+        return shipped, used, names, types
+
+    def _execute_via_worker(self, pid, ctx: ExecContext,
+                            limit: int) -> Iterator[Batch]:
+        """Streaming exchange (ref GpuArrowEvalPythonExec's BatchQueue:
+        inputs are retained locally and paired 1:1 with the worker's UDF
+        output batches; the closure ships once per partition)."""
+        import collections
+
         import pyarrow as pa
+
         from ..columnar.device import batch_to_arrow, batch_to_device
         from ..udf import worker as w
         child = self.children[0]
-        rb = batch_to_arrow(DeviceBatch(b.columns, int(b.num_rows),
-                                        child.output_names))
-        aux = (self._bound, child.output_names, child.output_types,
-               self.udf_names, ctx.conf.ansi_enabled)
-        tables, _ = w.pool_from_conf(ctx.conf).run(
-            w.task_eval_bound, aux, [pa.Table.from_batches([rb])])
-        # pair the child columns with the worker's UDF columns through one
-        # Arrow table so every lane shares a single capacity bucket
-        udf_tbl = tables[0].combine_chunks()
-        paired = pa.Table.from_arrays(
-            list(pa.Table.from_batches([rb]).columns) +
-            [udf_tbl.column(i) for i in range(udf_tbl.num_columns)],
-            names=self.output_names)
-        rbs = paired.combine_chunks().to_batches()
-        if not rbs:
-            # a 0-row table flattens to no batches; keep the DECLARED
-            # schema (from_pydict would infer null type for every column)
-            from ..columnar.interop import to_arrow_schema
-            rbs = to_arrow_schema(self.output_names,
-                                  self.output_types).empty_table() \
-                .to_batches(max_chunksize=1)
-            if not rbs:
-                rbs = [pa.RecordBatch.from_arrays(
-                    [pa.array([], type=f.type)
-                     for f in to_arrow_schema(self.output_names,
-                                              self.output_types)],
-                    names=list(self.output_names))]
-        return batch_to_device(rbs[0], xp=np)
+        shipped, used, in_names, in_types = self._shipped_exprs()
+        aux = (shipped, in_names, in_types, self.udf_names,
+               ctx.conf.ansi_enabled)
+        pending = collections.deque()  # (batch, full arrow RecordBatch)
+
+        def in_iter():
+            for big in child.execute_partition(pid, ctx):
+                for b in self._split(big, limit):
+                    rb = batch_to_arrow(
+                        DeviceBatch(b.columns, int(b.num_rows),
+                                    child.output_names))
+                    pending.append(rb)
+                    yield pa.Table.from_batches(
+                        [rb]).select(in_names)
+
+        out_iter = w.pool_from_conf(ctx.conf).run_stream(
+            w.task_stream_eval_bound, aux, in_iter())
+        while True:
+            with MetricTimer(self.metrics[OP_TIME]):
+                try:
+                    udf_tbl = next(out_iter).combine_chunks()
+                except StopIteration:
+                    break
+                rb = pending.popleft()
+                # pair through one Arrow table so every lane shares a
+                # single capacity bucket
+                paired = pa.Table.from_arrays(
+                    list(pa.Table.from_batches([rb]).columns) +
+                    [udf_tbl.column(i)
+                     for i in range(udf_tbl.num_columns)],
+                    names=self.output_names)
+                rbs = paired.combine_chunks().to_batches()
+                if not rbs:
+                    # 0-row: keep the DECLARED schema (from_pydict would
+                    # infer null type for every column)
+                    from ..columnar.interop import to_arrow_schema
+                    rbs = [pa.RecordBatch.from_arrays(
+                        [pa.array([], type=f.type)
+                         for f in to_arrow_schema(self.output_names,
+                                                  self.output_types)],
+                        names=list(self.output_names))]
+                out = batch_to_device(rbs[0], xp=np)
+            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+            self.metrics[NUM_OUTPUT_BATCHES] += 1
+            yield out

@@ -23,18 +23,48 @@ _build_error: str = ""
 
 
 def _build() -> str:
-    os.makedirs(os.path.dirname(_SO), exist_ok=True)
-    if os.path.exists(_SO) and \
-            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+    """Ensure the .so exists: wheel installs ship it prebuilt (setup.py);
+    source checkouts compile on first import; read-only installs without
+    a shipped binary compile into a per-user cache dir."""
+    global _SO
+    if os.path.exists(_SO) and (not os.path.exists(_SRC) or
+                                os.path.getmtime(_SO) >=
+                                os.path.getmtime(_SRC)):
         return ""
+    if not os.path.exists(_SRC):
+        return f"native build failed: neither {_SO} nor {_SRC} exists"
+    target = _SO
+    try:
+        os.makedirs(os.path.dirname(target), exist_ok=True)
+        probe = os.path.join(os.path.dirname(target), ".writable")
+        with open(probe, "w"):
+            pass
+        os.unlink(probe)
+    except OSError:
+        try:
+            cache = os.path.join(
+                os.environ.get("XDG_CACHE_HOME",
+                               os.path.expanduser("~/.cache")),
+                "spark_rapids_tpu")
+            os.makedirs(cache, exist_ok=True)
+            target = os.path.join(cache, "libtpu_native.so")
+            if os.path.exists(target) and \
+                    os.path.getmtime(target) >= os.path.getmtime(_SRC):
+                _SO = target
+                return ""
+        except OSError as ex:
+            # nowhere writable: record the reason; codec falls back to
+            # pure python (get_lib()'s graceful-degradation contract)
+            return f"native build failed: no writable dir ({ex})"
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-           "-o", _SO, _SRC]
+           "-o", target, _SRC]
     try:
         r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired) as ex:
         return f"native build failed: {ex}"
     if r.returncode != 0:
         return f"native build failed: {r.stderr[-2000:]}"
+    _SO = target
     return ""
 
 

@@ -266,6 +266,16 @@ def task_grouped_agg(tables, aux):
     return [], rows
 
 
+def task_stream_eval_bound(tables_iter, aux):
+    """Streaming row-UDF evaluation: one output table per input table,
+    in order.  The closure/expression payload ships ONCE per partition
+    (not per batch) and the input carries only the columns the UDFs
+    actually reference."""
+    for tbl in tables_iter:
+        out, _ = task_eval_bound([tbl], aux)
+        yield out[0]
+
+
 def task_eval_bound(tables, aux):
     """Evaluate bound engine expressions (python row UDFs) against the
     batch — the worker runs the same host evaluator the in-process path
@@ -367,16 +377,28 @@ class PythonWorker:
                     w.write(bytes([TAG_BLOB]))
                     _write_blob(w, _table_to_ipc(tb))
                     w.flush()
-                w.write(bytes([TAG_END]))
-                w.flush()
             except BaseException as ex:  # noqa: BLE001
                 write_err.append(ex)
+            # ALWAYS terminate the input stream — even when the upstream
+            # iterator raised — or both sides would block forever waiting
+            # for the next frame; the recorded error re-raises below
+            try:
+                w.write(bytes([TAG_END]))
+                w.flush()
+            except OSError as ex:
+                if not write_err:
+                    write_err.append(ex)
 
         try:
             w.write(MAGIC + bytes([OP_STREAM]))
             _write_blob(w, cloudpickle.dumps((task_gen, aux)))
             w.flush()
             feeder = threading.Thread(target=feed, daemon=True)
+            # the feeder drives upstream execs on behalf of a borrow that
+            # already holds a pool permit; mark it so nested borrows (a
+            # stacked mapInPandas chain) skip the semaphore instead of
+            # deadlocking against their own ancestor
+            feeder._tpu_pool_nested = True
             feeder.start()
             while True:
                 tag = _read_exact(r, 1)[0]
@@ -389,6 +411,8 @@ class PythonWorker:
                 tb_str = cloudpickle.loads(_read_blob(r))
                 raise PythonWorkerError(
                     f"python UDF raised in worker:\n{tb_str}")
+            if write_err:
+                raise write_err[0]
             self.requests_served += 1
         except (EOFError, BrokenPipeError, OSError) as ex:
             rc = self.proc.poll()
@@ -447,13 +471,24 @@ class PythonWorkerPool:
                 return
         worker.kill()
 
+    def _acquire(self) -> bool:
+        """Take a permit unless the current thread is a stream feeder
+        already working on behalf of a held permit — a nested borrow
+        blocking on its own ancestor would deadlock a single stacked
+        query (permits bound CONCURRENT independent borrows; nesting
+        depth is bounded by the plan height)."""
+        if getattr(threading.current_thread(), "_tpu_pool_nested", False):
+            return False
+        self._sem.acquire()
+        return True
+
     def run(self, task: Callable, aux, tables: Sequence[pa.Table]
             ) -> Tuple[List[pa.Table], object]:
         """Borrow a worker (blocking on the semaphore), run one request,
         return the worker to the pool if it survived.  A UDF exception
         (PythonWorkerError) leaves the worker in a clean protocol state —
         it is returned, not killed; only crashes cost a respawn."""
-        self._sem.acquire()
+        held = self._acquire()
         worker = None
         try:
             worker = self._checkout()
@@ -468,13 +503,14 @@ class PythonWorkerPool:
                 worker.kill()
             raise
         finally:
-            self._sem.release()
+            if held:
+                self._sem.release()
 
     def run_stream(self, task_gen: Callable, aux, tables_iter):
         """Streaming variant of run(); yields output tables lazily.  An
         abandoned generator (consumer stops early) kills the worker — the
         protocol is mid-stream and cannot be resynced."""
-        self._sem.acquire()
+        held = self._acquire()
         worker = None
         try:
             worker = self._checkout()
@@ -488,7 +524,8 @@ class PythonWorkerPool:
                 worker.kill()
             raise
         finally:
-            self._sem.release()
+            if held:
+                self._sem.release()
 
     def shutdown(self):
         with self._list_lock:

@@ -220,3 +220,55 @@ def test_map_in_pandas_streams_once_per_partition():
     out = (s.create_dataframe(tb, num_partitions=1, )
            .mapInPandas(_stateful_sum, "k long, v long").collect())
     assert out.column("v").to_pylist() == [sum(tb.column("v").to_pylist())]
+
+
+def _inc(it):
+    for pdf in it:
+        pdf = pdf.copy()
+        pdf["v"] = pdf["v"] + 1
+        yield pdf
+
+
+def test_stacked_map_in_pandas_does_not_deadlock():
+    """Three chained streaming UDF stages with a 2-permit pool: nested
+    borrows (a feeder driving upstream execs) bypass the semaphore, so a
+    single stacked query can never deadlock against itself."""
+    s = _session()
+    tb = _table(60)
+    df = s.create_dataframe(tb, num_partitions=1)
+    out = (df.mapInPandas(_inc, "k long, v long")
+           .mapInPandas(_inc, "k long, v long")
+           .mapInPandas(_inc, "k long, v long").collect())
+    assert sorted(out.column("v").to_pylist()) == \
+        sorted(v + 3 for v in tb.column("v").to_pylist())
+
+
+def _boom_iter():
+    raise RuntimeError("upstream source exploded")
+
+
+def test_upstream_iterator_error_propagates_not_hangs():
+    """An error in the INPUT iterator of a streaming request surfaces as
+    an exception (with the stream cleanly terminated) instead of hanging
+    both processes."""
+    from spark_rapids_tpu.udf.worker import (PythonWorkerPool,
+                                             task_stream_map_in_pandas)
+    import pyarrow as _pa
+    pool = PythonWorkerPool(1)
+    schema = _pa.schema([("x", _pa.int64())])
+
+    def bad_iter():
+        yield _pa.table({"x": _pa.array([1], type=_pa.int64())})
+        raise RuntimeError("upstream source exploded")
+
+    def ident(it):
+        yield from it
+
+    with pytest.raises(RuntimeError, match="upstream source exploded"):
+        list(pool.run_stream(task_stream_map_in_pandas,
+                             (ident, schema), bad_iter()))
+    # pool permit was released; next request succeeds
+    tables, _ = pool.run(task_map_in_pandas, (ident, schema),
+                         [_pa.table({"x": _pa.array([2], type=_pa.int64())})])
+    assert tables[0].column("x").to_pylist() == [2]
+    pool.shutdown()
