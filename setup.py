@@ -30,7 +30,7 @@ class BuildPyWithNative(build_py):
             if r.returncode != 0:
                 self.announce(
                     f"native build skipped: {r.stderr[-500:]}", level=3)
-        except OSError as ex:
+        except (OSError, subprocess.TimeoutExpired) as ex:
             self.announce(f"native build skipped: {ex}", level=3)
 
 

@@ -450,7 +450,8 @@ class GroupedData:
         return g
 
     def _expand_pivot_aggs(self, aggs):
-        from ..expr.aggregates import AggregateExpression
+        from ..expr.aggregates import (AggregateExpression, First,
+                                       PivotFirst)
         from ..expr.conditional import If
         from ..expr.core import Literal
         from ..expr.predicates import EqualNullSafe
@@ -463,12 +464,18 @@ class GroupedData:
                     raise TypeError(
                         "pivot aggregates need an input column "
                         "(count(*) unsupported, use count(col))")
+                name = str(v) if len(aggs) == 1 else f"{v}_{ae.name}"
+                if type(fn) is First:
+                    # the canonical pivot lowering unit
+                    # (ref GpuPivotFirst, GpuOverrides.scala:2034-2060)
+                    out.append(AggregateExpression(
+                        PivotFirst(p, fn.child, v), name))
+                    continue
                 from .. import types as _t
                 masked = fn.with_children(
                     [If(EqualNullSafe(p, Literal(v)), fn.child,
                         Literal(None, _t.NULL))] +
                     list(fn.children[1:]))
-                name = str(v) if len(aggs) == 1 else f"{v}_{ae.name}"
                 out.append(AggregateExpression(masked, name))
         return out
 

@@ -492,3 +492,43 @@ def collect_list(c) -> Column:
 
 def collect_set(c) -> Column:
     return _c(agg.AggregateExpression(agg.CollectSet(_expr(c))))
+
+
+def approx_percentile(c, percentage: float, accuracy: int = 10000
+                      ) -> Column:
+    """Exact inverted-CDF percentile per group (ref percentile_approx /
+    GPU ApproximatePercentile; accuracy accepted for API parity — the
+    sort-based kernel is always exact)."""
+    return _c(agg.AggregateExpression(
+        agg.ApproximatePercentile(_expr(c), percentage, accuracy)))
+
+
+percentile_approx = approx_percentile
+
+
+def pivot_first(pivot_col, value_col, pivot_value) -> Column:
+    """The first value where pivot_col equals pivot_value — the unit a
+    pivot aggregate lowers to (ref GpuPivotFirst)."""
+    return _c(agg.AggregateExpression(
+        agg.PivotFirst(_expr(pivot_col), _expr(value_col), pivot_value)))
+
+
+def window(time_col, window_duration: str, slide_duration: str = None,
+           start_time: str = "0 seconds") -> Column:
+    """Tumbling time-window bucketing: window(ts, '10 minutes') yields a
+    struct<start,end> grouping key (ref
+    org/apache/spark/sql/rapids/TimeWindow.scala)."""
+    from ..expr.datetime_expr import TimeWindow, parse_duration_micros
+    w = parse_duration_micros(window_duration)
+    s = parse_duration_micros(slide_duration) if slide_duration else None
+    st = parse_duration_micros(start_time, allow_nonpositive=True) \
+        if start_time else 0
+    return _c(TimeWindow(_expr(time_col), w, s, st))
+
+
+def scalar_subquery(df) -> Column:
+    """A one-row one-column DataFrame used as a scalar in expressions
+    (ref GpuScalarSubquery.scala; the subquery executes first and its
+    value substitutes as a typed literal)."""
+    from ..expr.subquery import ScalarSubquery
+    return _c(ScalarSubquery(df._lp))

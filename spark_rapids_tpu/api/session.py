@@ -117,6 +117,12 @@ class TpuSession:
 
     # -- execution ----------------------------------------------------------
     def execute(self, lp: L.LogicalPlan) -> pa.Table:
+        from ..expr.subquery import (has_scalar_subquery,
+                                     resolve_scalar_subqueries)
+        if has_scalar_subquery(lp):
+            # subqueries run first, driver-side, and substitute as typed
+            # literals (ref GpuScalarSubquery / ExecSubqueryExpression)
+            lp = resolve_scalar_subqueries(lp, self)
         physical = plan_physical(lp, self.conf)
         from ..plan.planner import force_perfile_if_input_file
         force_perfile_if_input_file(physical)

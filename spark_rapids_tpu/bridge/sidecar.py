@@ -103,10 +103,11 @@ class SidecarServer:
                 if op != b"E":
                     return
                 (spec_len,) = struct.unpack("<I", _read_exact(conn, 4))
-                spec = json.loads(_read_exact(conn, spec_len))
+                spec_bytes = _read_exact(conn, spec_len)
                 (ipc_len,) = struct.unpack("<Q", _read_exact(conn, 8))
                 ipc = _read_exact(conn, ipc_len)
                 try:
+                    spec = json.loads(spec_bytes)
                     with pa.ipc.open_stream(io.BytesIO(ipc)) as r:
                         table = r.read_all()
                     out = self.execute_stage(spec, table)

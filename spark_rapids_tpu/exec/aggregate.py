@@ -31,8 +31,9 @@ from .. import types as t
 from ..columnar.device import (DEFAULT_ROW_BUCKETS, DeviceBatch, DeviceColumn,
                                batch_to_arrow, batch_to_device, bucket_for)
 from ..expr.aggregates import (COMPLETE, FINAL, PARTIAL, AggregateExpression,
-                               AggregateFunction, Average, CollectList,
-                               CollectSet, Count, First, Last, Max, Min,
+                               AggregateFunction, ApproximatePercentile,
+                               Average, CollectList, CollectSet, Count,
+                               First, Last, Max, Min, PivotFirst,
                                StddevPop, StddevSamp, Sum, VariancePop,
                                VarianceSamp)
 from ..expr.core import (ColumnValue, EvalContext, Expression,
@@ -531,6 +532,10 @@ _PA_AGG = {
     First: "first", Last: "last", StddevSamp: "stddev", StddevPop: "stddev",
     VarianceSamp: "variance", VariancePop: "variance",
     CollectSet: "distinct", CollectList: "list",
+    # PivotFirst: the masked input column + first-non-null
+    PivotFirst: "first",
+    # ApproximatePercentile: collect the group then rank on host
+    ApproximatePercentile: "list",
 }
 
 
@@ -572,11 +577,22 @@ class CpuHashAggregateExec(Exec):
             for g, nm in zip(self._bound_grouping, self._group_names):
                 from ..columnar.device import column_to_arrow
                 v = g.eval(ec)
-                cols[nm] = column_to_arrow(v.col, int(b.num_rows))
+                arr = column_to_arrow(v.col, int(b.num_rows))
+                if pa.types.is_struct(arr.type):
+                    # pyarrow cannot group struct keys: flatten to field
+                    # columns and rebuild after the aggregate (field
+                    # nullness carries the key identity)
+                    for j in range(arr.type.num_fields):
+                        import pyarrow.compute as _pc
+                        cols[f"__{nm}__f{j}"] = _pc.struct_field(arr, j)
+                else:
+                    cols[nm] = arr
             for i, ae in enumerate(self.aggregates):
                 fn = ae.func
                 if fn.children:
-                    bexpr = bind_expression(fn.child, child.output_names,
+                    in_expr = fn._masked() if isinstance(fn, PivotFirst) \
+                        else fn.child
+                    bexpr = bind_expression(in_expr, child.output_names,
                                             child.output_types)
                     v = bexpr.eval(ec)
                     from ..expr.core import ScalarValue, make_column
@@ -604,6 +620,18 @@ class CpuHashAggregateExec(Exec):
                                      a.func.children else t.INT
                                      for a in self.aggregates])})]
         table = pa.concat_tables(tables)
+        struct_types = {nm: to_arrow_type(g.data_type())
+                        for g, nm in zip(self._bound_grouping,
+                                         self._group_names)
+                        if pa.types.is_struct(
+                            to_arrow_type(g.data_type()))}
+        group_cols = []
+        for nm, g in zip(self._group_names, self._bound_grouping):
+            if nm in struct_types:
+                group_cols += [f"__{nm}__f{j}" for j in
+                               range(struct_types[nm].num_fields)]
+            else:
+                group_cols.append(nm)
         aggs = []
         for i, ae in enumerate(self.aggregates):
             kind = _PA_AGG[type(ae.func)]
@@ -612,16 +640,23 @@ class CpuHashAggregateExec(Exec):
                 ddof = 0 if isinstance(ae.func, (StddevPop, VariancePop)) else 1
                 opts = pc.VarianceOptions(ddof=ddof)
             if kind in ("first", "last"):
-                opts = pc.ScalarAggregateOptions(
-                    skip_nulls=ae.func.ignore_nulls)
+                skip = True if isinstance(ae.func, PivotFirst) \
+                    else ae.func.ignore_nulls
+                opts = pc.ScalarAggregateOptions(skip_nulls=skip)
             aggs.append((f"__in{i}", kind, opts))
         if self.grouping:
-            res = pa.TableGroupBy(table, self._group_names,
+            res = pa.TableGroupBy(table, group_cols,
                                   use_threads=False).aggregate(aggs)
         elif table.num_rows == 0:
             # Spark: a global aggregate over empty input yields one row
             cols = {}
             for (cname, kind, opts) in aggs:
+                if kind in ("list", "distinct"):
+                    # empty input collects to the empty list (Spark's
+                    # collect_*), which percentile evaluates to null
+                    cols[f"{cname}_{kind}"] = pa.array(
+                        [[]], type=pa.list_(table.column(cname).type))
+                    continue
                 fn = {"sum": pc.sum, "count": pc.count, "mean": pc.mean,
                       "min": pc.min, "max": pc.max,
                       "stddev": pc.stddev, "variance": pc.variance,
@@ -638,11 +673,33 @@ class CpuHashAggregateExec(Exec):
         # rename/cast to declared output schema
         out_cols = []
         for nm in self._group_names:
-            out_cols.append(res.column(nm))
+            if nm in struct_types:
+                st = struct_types[nm]
+                fields = [res.column(f"__{nm}__f{j}").combine_chunks()
+                          for j in range(st.num_fields)]
+                arrs = [f.chunk(0) if isinstance(f, pa.ChunkedArray)
+                        else f for f in fields]
+                out_cols.append(pa.StructArray.from_arrays(
+                    arrs, fields=list(st)))
+            else:
+                out_cols.append(res.column(nm))
         for i, ae in enumerate(self.aggregates):
             kind = _PA_AGG[type(ae.func)]
             cname = f"__in{i}_{kind}"
             col = res.column(cname)
+            if isinstance(ae.func, ApproximatePercentile):
+                p = ae.func.percentage
+                vals = []
+                for row in col.to_pylist():
+                    grp = sorted(v for v in row if v is not None)
+                    if not grp:
+                        vals.append(None)
+                        continue
+                    import math
+                    k = max(math.ceil(p * len(grp)) - 1, 0)
+                    vals.append(grp[min(k, len(grp) - 1)])
+                col = pa.chunked_array([pa.array(
+                    vals, type=to_arrow_type(ae.data_type()))])
             if isinstance(ae.func, CollectList) and \
                     not isinstance(ae.func, CollectSet):
                 # Spark's collect_list drops nulls; pyarrow's keeps them

@@ -143,8 +143,9 @@ class ArrowEvalPythonExec(Exec):
                         DeviceBatch(b.columns, int(b.num_rows),
                                     child.output_names))
                     pending.append(rb)
-                    yield pa.Table.from_batches(
-                        [rb]).select(in_names)
+                    # select by ORDINAL: child schemas may carry
+                    # duplicate names (join outputs concatenate sides)
+                    yield pa.Table.from_batches([rb]).select(used)
 
         out_iter = w.pool_from_conf(ctx.conf).run_stream(
             w.task_stream_eval_bound, aux, in_iter())

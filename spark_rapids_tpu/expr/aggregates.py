@@ -305,6 +305,119 @@ class StddevPop(_MomentAgg):
         return make_column(ctx, t.DOUBLE, ctx.xp.sqrt(var), ok)
 
 
+class PivotFirst(AggregateFunction):
+    """pivot_first(pivotColumn, valueColumn, pivotValue): the first valid
+    valueColumn row whose pivotColumn equals pivotValue — one instance
+    per pivot value is how a pivot aggregate lowers
+    (ref AggregateFunctions.scala GpuPivotFirst, registered at
+    GpuOverrides.scala:2034-2060).
+
+    TPU realization: the conditional mask fuses into the update
+    expression (IF(p <=> v, x, NULL)) and the existing "first" segmented
+    reduce picks the surviving row — no per-value imperative buffers,
+    XLA fuses all N masks of a pivot into the one kernel pass."""
+
+    def __init__(self, pivot: Expression, value: Expression, pivot_value):
+        self.children = (pivot, value)
+        self.pivot_value = pivot_value
+
+    @property
+    def value_expr(self):
+        return self.children[1]
+
+    def data_type(self):
+        return self.children[1].data_type()
+
+    def sql(self):
+        return (f"pivot_first({self.children[0].sql()}, "
+                f"{self.children[1].sql()}, {self.pivot_value!r})")
+
+    def _masked(self):
+        from .conditional import If
+        from .predicates import EqualNullSafe
+        return If(EqualNullSafe(self.children[0],
+                                Literal(self.pivot_value)),
+                  self.children[1], Literal(None, t.NULL))
+
+    def update(self):
+        return [(self._masked(), "first")]
+
+    def buffer_types(self):
+        return [self.data_type()]
+
+    def merge_ops(self):
+        return ["first"]
+
+    def evaluate(self, ctx, buffers):
+        return buffers[0]
+
+
+class ApproximatePercentile(AggregateFunction):
+    """approx_percentile(col, percentage[, accuracy])
+    (ref ApproximatePercentile via GpuOverrides; the reference runs a
+    t-digest on the GPU).
+
+    TPU realization: the collect_list kernel already materializes each
+    group's values contiguously, so the percentile is EXACT — one
+    lexsort by (group, value) and a gather at rank ceil(p*n)-1 (the
+    inverted-CDF element Spark's sketch approximates).  Trading the
+    sketch for a sort is the right call on this hardware: the sort is
+    the same fused kernel the aggregate already paid for."""
+
+    def __init__(self, child: Expression, percentage: float,
+                 accuracy: int = 10000):
+        super().__init__(child)
+        self.percentage = float(percentage)
+        self.accuracy = int(accuracy)
+
+    def data_type(self):
+        ct = self.child.data_type()
+        return ct if t.is_numeric(ct) else t.DOUBLE
+
+    def sql(self):
+        return (f"approx_percentile({self.child.sql()}, "
+                f"{self.percentage})")
+
+    def update(self):
+        return [(self.child, "collect_list")]
+
+    def buffer_types(self):
+        return [t.ArrayType(self.child.data_type())]
+
+    def merge_ops(self):
+        return ["collect_concat"]
+
+    def evaluate(self, ctx, buffers):
+        from ..ops import segmented as seg
+        xp = ctx.xp
+        arr = buffers[0].col
+        offs = arr.offsets.astype(np.int64)
+        child = arr.children[0]
+        ccap = child.capacity
+        pos = xp.arange(ccap, dtype=np.int64)
+        total = offs[-1]
+        in_range = pos < total
+        seg_of = (xp.searchsorted(offs[1:], pos, side="right")
+                  .astype(np.int64))
+        seg_word = xp.where(in_range, seg_of,
+                            np.int64(ccap)).astype(xp.uint64)
+        vwords = seg.key_words_for_column(xp, child, in_range,
+                                          for_grouping=False)
+        order = seg.lexsort(xp, [seg_word] + vwords[1:], ccap)
+        sorted_data = child.data[order]
+        n = offs[1:] - offs[:-1]
+        # inverted-CDF rank: ceil(p*n) - 1, clamped into the group
+        k = xp.ceil(self.percentage * n.astype(np.float64)) \
+            .astype(np.int64) - 1
+        k = xp.clip(k, 0, xp.maximum(n - 1, 0))
+        idx = xp.clip(offs[:-1] + k, 0, max(ccap - 1, 0)).astype(np.int32)
+        data = sorted_data[idx]
+        valid = n > 0
+        return make_column(ctx, self.data_type(),
+                           xp.where(valid, data, xp.zeros_like(data)),
+                           valid)
+
+
 def bind_aggregate(ae: "AggregateExpression", names, dtypes
                    ) -> "AggregateExpression":
     """Bind the function's child expressions against an input schema."""

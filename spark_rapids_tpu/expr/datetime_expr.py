@@ -360,3 +360,82 @@ def _eval_timeadd(e: TimeAdd, ctx):
     d = data_of(v, ctx)
     return make_column(ctx, t.TIMESTAMP, d + np.int64(e.interval),
                        validity_of(v, ctx))
+
+
+def parse_duration_micros(s: str, allow_nonpositive: bool = False
+                          ) -> int:
+    """'10 minutes' / '1 hour' / '30 seconds' -> microseconds (the subset
+    of CalendarInterval strings time windows accept; month/year units are
+    rejected exactly like Spark's TimeWindow analysis rule).  Start-time
+    offsets may be zero or negative (allow_nonpositive)."""
+    units = {
+        "microsecond": 1, "millisecond": 1000, "second": 1_000_000,
+        "minute": 60_000_000, "hour": 3_600_000_000,
+        "day": 86_400_000_000, "week": 7 * 86_400_000_000,
+    }
+    total = 0
+    toks = s.strip().lower().replace("interval", "").split()
+    if len(toks) % 2 != 0 or not toks:
+        raise ValueError(f"cannot parse window duration {s!r}")
+    for i in range(0, len(toks), 2):
+        n, unit = toks[i], toks[i + 1].rstrip("s")
+        if unit not in units:
+            raise ValueError(
+                f"window duration unit {unit!r} not supported "
+                f"(month/year windows are not fixed-length)")
+        total += int(n) * units[unit]
+    if total <= 0 and not allow_nonpositive:
+        raise ValueError(f"window duration must be positive: {s!r}")
+    return total
+
+
+class TimeWindow(Expression):
+    """window(ts, windowDuration[, slideDuration[, startTime]]) -> struct
+    with start/end timestamps (ref
+    org/apache/spark/sql/rapids/TimeWindow.scala; Spark lowers sliding
+    windows to an Expand of per-slide copies — this expression covers the
+    tumbling case, and the overrides rule tags sliding windows onto the
+    CPU path exactly like unsupported shapes elsewhere)."""
+
+    def __init__(self, child: Expression, window_micros: int,
+                 slide_micros=None, start_micros: int = 0):
+        self.children = (child,)
+        self.window = int(window_micros)
+        self.slide = int(slide_micros if slide_micros is not None
+                         else window_micros)
+        self.start = int(start_micros)
+
+    def data_type(self):
+        return t.StructType([t.StructField("start", t.TIMESTAMP),
+                             t.StructField("end", t.TIMESTAMP)])
+
+    def sql(self):
+        return f"window({self.children[0].sql()}, {self.window}us)"
+
+    @property
+    def is_tumbling(self):
+        return self.slide == self.window
+
+
+@evaluator(TimeWindow)
+def _eval_time_window(e: TimeWindow, ctx):
+    from ..columnar.device import DeviceColumn
+    from .core import ColumnValue
+    if not e.is_tumbling:
+        raise NotImplementedError(
+            "sliding time windows (slide != window) need the Expand "
+            "lowering; only tumbling windows are supported")
+    xp = ctx.xp
+    v = e.children[0].eval(ctx)
+    ts = data_of(v, ctx)
+    valid = validity_of(v, ctx)
+    if valid is None:
+        valid = xp.ones((ctx.capacity,), dtype=bool)
+    w = np.int64(e.window)
+    # numpy/jnp mod follows the divisor's sign, so this floors correctly
+    # for pre-epoch timestamps too
+    ws = ts - (ts - np.int64(e.start)) % w
+    start = DeviceColumn(t.TIMESTAMP, data=ws, validity=valid)
+    end = DeviceColumn(t.TIMESTAMP, data=ws + w, validity=valid)
+    return ColumnValue(DeviceColumn(e.data_type(), validity=valid,
+                                    children=(start, end)))

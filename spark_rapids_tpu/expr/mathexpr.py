@@ -274,3 +274,30 @@ def _eval_round(e, ctx):
 
 
 _EVALUATORS[BRound] = _round_impl
+
+
+class NormalizeNaNAndZero(Expression):
+    """Canonicalize floats for grouping/join keys: every NaN becomes THE
+    NaN and -0.0 becomes +0.0 (ref NormalizeFloatingNumbers.scala /
+    GpuNormalizeNaNAndZero).  The engine's key-word encoding already
+    normalizes inside group/sort kernels; this expression is the
+    user-facing/plan-inserted form."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def data_type(self):
+        return self.children[0].data_type()
+
+    def sql(self):
+        return f"normalize_nan_and_zero({self.children[0].sql()})"
+
+
+@evaluator(NormalizeNaNAndZero)
+def _eval_normalize_nan_zero(e: NormalizeNaNAndZero, ctx):
+    xp = ctx.xp
+    v = e.children[0].eval(ctx)
+    d = data_of(v, ctx)
+    d = xp.where(xp.isnan(d), xp.full_like(d, np.nan), d)
+    d = xp.where(d == 0, xp.zeros_like(d), d)   # -0.0 -> +0.0
+    return make_column(ctx, e.data_type(), d, validity_of(v, ctx))

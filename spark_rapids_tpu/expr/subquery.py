@@ -1,0 +1,107 @@
+"""Scalar subqueries (ref GpuScalarSubquery.scala: the reference wraps
+Spark's ExecSubqueryExpression — the subquery runs first on the driver
+and its single value is substituted into the outer plan's expressions).
+
+Engine realization: `ScalarSubquery` holds the subquery's LOGICAL plan;
+`resolve_scalar_subqueries` runs each subquery through the session
+ahead of outer-plan planning (driver-side, exactly Spark's sequencing)
+and replaces the node with a typed Literal, so the outer query compiles
+with a constant — the most XLA-friendly form a runtime scalar can take.
+"""
+
+from __future__ import annotations
+
+from .. import types as t
+from .core import Expression, Literal
+
+
+class ScalarSubquery(Expression):
+    """A subquery that must yield exactly one row and one column."""
+
+    def __init__(self, lp):
+        self.children = ()
+        self.lp = lp
+
+    def data_type(self):
+        return self.lp.schema()[1][0]
+
+    def sql(self):
+        return "scalar_subquery(...)"
+
+
+def resolve_scalar_subqueries(lp, session):
+    """Replace every ScalarSubquery in the plan's expression trees with
+    the executed literal value.  Raises if a subquery yields != 1 row
+    (Spark's runtime error for scalar subqueries)."""
+
+    def resolve_expr(e: Expression) -> Expression:
+        def fn(x):
+            if isinstance(x, ScalarSubquery):
+                out = session.execute(x.lp)
+                if out.num_columns < 1 or out.num_rows != 1:
+                    raise ValueError(
+                        f"scalar subquery must return one row, got "
+                        f"{out.num_rows}")
+                val = out.column(0).to_pylist()[0]
+                return Literal(val, x.data_type())
+            return x
+        return e.transform_up(fn)
+
+    def walk(node):
+        node.children = tuple(walk(c) for c in node.children)
+        for attr in ("condition", "exprs", "grouping", "aggregates",
+                     "projections", "orders", "keys"):
+            v = getattr(node, attr, None)
+            if v is None:
+                continue
+            if isinstance(v, Expression):
+                setattr(node, attr, resolve_expr(v))
+            elif isinstance(v, (list, tuple)):
+                out = []
+                changed = False
+                for item in v:
+                    if isinstance(item, Expression):
+                        r = resolve_expr(item)
+                        changed |= r is not item
+                        out.append(r)
+                    elif (isinstance(item, tuple) and item and
+                          isinstance(item[0], Expression)):
+                        r = (resolve_expr(item[0]),) + item[1:]
+                        changed = True
+                        out.append(r)
+                    else:
+                        out.append(item)
+                if changed:
+                    setattr(node, attr, type(v)(out) if
+                            isinstance(v, list) else tuple(out))
+        return node
+
+    return walk(lp)
+
+
+def has_scalar_subquery(lp) -> bool:
+    found = []
+
+    def check_expr(e):
+        if isinstance(e, Expression):
+            if e.collect(lambda x: isinstance(x, ScalarSubquery)):
+                found.append(True)
+
+    def walk(node):
+        for attr in ("condition", "exprs", "grouping", "aggregates",
+                     "projections", "orders", "keys"):
+            v = getattr(node, attr, None)
+            if isinstance(v, Expression):
+                check_expr(v)
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    if isinstance(item, Expression):
+                        check_expr(item)
+                    elif (isinstance(item, tuple) and item and
+                          isinstance(item[0], Expression)):
+                        check_expr(item[0])
+        for c in node.children:
+            walk(c)
+
+    walk(lp)
+    return bool(found)

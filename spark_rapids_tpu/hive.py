@@ -1,0 +1,74 @@
+"""Hive dialect support via the extension hook (ref
+org/apache/spark/sql/hive/rapids/ + GpuHiveOverrides at
+GpuOverrides.scala:53).
+
+The reference accelerates two Hive surfaces: Hive UDF wrappers
+(GpuHiveSimpleUDF/GpuHiveGenericUDF — JVM classes that cannot exist
+here; our native/Python UDF paths are the equivalent capability) and
+Hive-specific expressions.  This module provides the Hive hash — the
+expression Hive bucketing and Hive-style DISTRIBUTE BY rely on — and
+registers it through plan.extensions the way GpuHiveOverrides
+self-registers when Hive is on the classpath.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import types as t
+from .expr.core import (EvalContext, Expression, data_of, evaluator,
+                        make_column, validity_of)
+
+
+class HiveHash(Expression):
+    """Hive's bucketing hash (int): for ints the value itself, for
+    booleans 1/0, combined per-column as 31*h + col_hash — the ObjectsHashAggregate-compatible rule
+    (ref HiveHash in the reference's hive overrides)."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    def data_type(self):
+        return t.INT
+
+    def sql(self):
+        return f"hive_hash({', '.join(c.sql() for c in self.children)})"
+
+
+@evaluator(HiveHash)
+def _eval_hive_hash(e: HiveHash, ctx: EvalContext):
+    xp = ctx.xp
+    h = xp.zeros((ctx.capacity,), dtype=np.int32)
+    for c in e.children:
+        v = c.eval(ctx)
+        d = data_of(v, ctx)
+        dt = c.data_type()
+        if isinstance(dt, t.BooleanType):
+            ch = d.astype(np.int32)
+        elif isinstance(dt, (t.LongType, t.TimestampType)):
+            x = d.astype(np.int64)
+            ch = (x ^ ((x >> 32) & 0xFFFFFFFF)).astype(np.int32)
+        elif isinstance(dt, t.DoubleType):
+            x = d.astype(np.float64).view(np.int64) if xp is np else \
+                xp.asarray(d, dtype=xp.float64).view(xp.int64)
+            ch = (x ^ ((x >> 32) & 0xFFFFFFFF)).astype(np.int32)
+        else:
+            ch = d.astype(np.int32)
+        valid = validity_of(v, ctx)
+        if valid is not None:
+            ch = xp.where(valid, ch, xp.zeros_like(ch))
+        h = (h * np.int32(31) + ch).astype(np.int32)
+    return make_column(ctx, t.INT, h, None)
+
+
+def _register() -> None:
+    from .plan.overrides import expr_rule
+    from .types import T
+    expr_rule(HiveHash, T.INT, "Hive bucketing hash")
+
+
+def enable_hive_support() -> None:
+    """Opt in to the Hive dialect rules (the analog of the reference
+    finding Hive on the classpath)."""
+    from .plan.extensions import register_override_provider
+    register_override_provider(_register)

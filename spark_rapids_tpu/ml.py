@@ -1,0 +1,63 @@
+"""Zero-copy ML export: query results as device-resident JAX arrays.
+
+The reference's ML integration story (ref ColumnarRdd.scala,
+InternalColumnarRddConverter.scala, docs/ml-integration.md) hands GPU
+columnar batches straight to XGBoost without a host round trip.  The
+TPU-native equivalent hands the final device batches of a query to JAX
+ML code with NO device->host transfer at all: the training step consumes
+the same HBM buffers the SQL pipeline produced — a tighter integration
+than the reference's, since consumer and producer share one runtime.
+
+    from spark_rapids_tpu import ml
+    arrays = ml.columnar_arrays(df)       # [{col: (data, validity)}, ...]
+    X = jnp.stack([arrays[0]["f1"][0], arrays[0]["f2"][0]], axis=1)
+    ... jax training loop ...
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+def device_batches(df) -> List:
+    """Run the DataFrame's plan and return the raw device batches per
+    partition WITHOUT the DeviceToHost transition — the ColumnarRdd
+    analog.  Falls back to numpy-backed batches for CPU-placed plans
+    (the reference likewise degrades to host rows when the plan ended on
+    CPU, InternalColumnarRddConverter's row path)."""
+    from .exec.base import DeviceToHostExec, ExecContext
+    from .plan.overrides import TpuOverrides
+    from .plan.planner import plan as plan_physical
+
+    session = df.session
+    physical = plan_physical(df._lp, session.conf)
+    final_plan = TpuOverrides(session.conf).apply(physical)
+    # strip the terminal transition: consumers want device residency
+    if isinstance(final_plan, DeviceToHostExec):
+        final_plan = final_plan.children[0]
+    session.last_plan = final_plan
+    ctx = ExecContext(session.conf)
+    out = []
+    for pid in range(final_plan.num_partitions):
+        out.append(list(final_plan.execute_partition(pid, ctx)))
+    return out
+
+
+def columnar_arrays(df) -> List[Dict[str, Tuple]]:
+    """Per-partition dicts of column name -> (data, validity) JAX
+    arrays, still on device.  Variable-width columns additionally carry
+    their offsets: (data, validity, offsets)."""
+    parts = device_batches(df)
+    names = df.columns
+    result = []
+    for batches in parts:
+        for b in batches:
+            d: Dict[str, Tuple] = {}
+            for name, col in zip(names, b.columns):
+                if col.offsets is not None:
+                    d[name] = (col.data, col.validity, col.offsets)
+                else:
+                    d[name] = (col.data, col.validity)
+            d["__num_rows__"] = b.num_rows
+            result.append(d)
+    return result

@@ -1,0 +1,39 @@
+"""External override providers — the GpuHiveOverrides pattern.
+
+The reference wires Hive-specific rules through a provider hook so the
+core engine never hard-depends on Hive classes (ref GpuOverrides.scala:53
+`GpuHiveOverrides`, ExternalSource): if the provider's prerequisites are
+present it contributes extra ExprRules/ExecRules, otherwise the engine
+runs without them.
+
+This module is that hook for the TPU engine: libraries register a
+provider; each provider's `register()` runs once, lazily, the first time
+the overrides engine is entered, and may add expression rules
+(plan.overrides.expr_rule) or exec handling.  `spark_rapids_tpu.hive`
+registers itself through this hook exactly the way GpuHiveOverrides
+self-registers.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+_PROVIDERS: List[Callable[[], None]] = []
+_loaded = False
+
+
+def register_override_provider(fn: Callable[[], None]) -> None:
+    """Add a provider; it runs once before the next plan rewrite."""
+    global _loaded
+    _PROVIDERS.append(fn)
+    _loaded = False
+
+
+def load_extension_rules() -> None:
+    """Run all pending providers (idempotent)."""
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    for fn in list(_PROVIDERS):
+        fn()

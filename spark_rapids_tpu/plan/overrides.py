@@ -241,7 +241,30 @@ expr_rule(agg.CollectList, (_collect_elem + T.ARRAY).nested(_collect_elem))
 expr_rule(agg.CollectSet, (_collect_elem + T.ARRAY).nested(_collect_elem))
 for c in (agg.StddevPop, agg.StddevSamp, agg.VariancePop, agg.VarianceSamp):
     expr_rule(c, _num)
+# pivot_first: first value where the pivot column matches; the mask fuses
+# into the update expression (ref GpuPivotFirst, GpuOverrides.scala:2034)
+expr_rule(agg.PivotFirst, _common,
+          "pivot aggregate (one instance per pivot value)")
+expr_rule(agg.ApproximatePercentile, T.numeric64,
+          "exact inverted-CDF percentile over collected groups "
+          "(decimal128 would drop the high word in the rank gather)")
 expr_rule(agg.AggregateExpression, T.all_types.nested())
+
+
+def _tag_time_window(meta: "ExprMeta"):
+    if not meta.expr.is_tumbling:
+        meta.will_not_work(
+            "sliding time windows (slide != window) lower through an "
+            "Expand on the CPU path")
+
+
+from ..expr.datetime_expr import TimeWindow as _TimeWindow
+from ..expr.mathexpr import NormalizeNaNAndZero as _NormNaN
+
+expr_rule(_TimeWindow, T.STRUCT.nested(T.TIMESTAMP),
+          "tumbling time window bucketing", _tag_time_window)
+expr_rule(_NormNaN, T.FLOAT + T.DOUBLE,
+          "canonicalize NaN/-0.0 for grouping and join keys")
 
 # columnar native UDFs trace straight into the operator's XLA computation
 # (ref GpuUserDefinedFunction + RapidsUDF.evaluateColumnar)
@@ -456,7 +479,9 @@ EXEC_SIGS: Dict[Type[eb.Exec], TypeSig] = {
     GlobalLimitExec: _exec_common,
     CoalesceBatchesExec: _exec_common,
     GatherPartitionsExec: _exec_common,
-    CpuHashAggregateExec: (T.common_scalar + T.ARRAY).nested(
+    # struct keys group fine: key_words_for_column recurses children
+    # (time-window bucketing groups by struct<start,end>)
+    CpuHashAggregateExec: (T.common_scalar + T.ARRAY + T.STRUCT).nested(
         T.common_scalar),
 }
 
@@ -695,6 +720,10 @@ class TpuOverrides:
         self.last_explain = ""
 
     def apply(self, plan: eb.Exec) -> eb.Exec:
+        # external override providers contribute rules lazily (the
+        # GpuHiveOverrides hook, ref GpuOverrides.scala:53)
+        from .extensions import load_extension_rules
+        load_extension_rules()
         if not self.conf.sql_enabled:
             self.last_explain = "(TPU acceleration disabled)"
             return plan

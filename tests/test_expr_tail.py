@@ -1,0 +1,209 @@
+"""Differential tests for the round-3 expression tail: PivotFirst,
+approx_percentile, tumbling time windows, NormalizeNaNAndZero
+(ref GpuPivotFirst / ApproximatePercentile / TimeWindow.scala /
+NormalizeFloatingNumbers.scala)."""
+
+import datetime
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col
+from spark_rapids_tpu.api.session import TpuSession
+
+
+def _session(enabled=True):
+    return (TpuSession.builder()
+            .config("spark.rapids.sql.enabled", enabled).get_or_create())
+
+
+def _tpu_ops(s):
+    names = []
+    s.last_plan.foreach(lambda e: names.append((type(e).__name__,
+                                                e.placement)))
+    return names
+
+
+def test_pivot_first_matches_manual_pivot():
+    s = _session()
+    tb = pa.table({
+        "k": pa.array([1, 1, 1, 2, 2, 3], type=pa.int64()),
+        "p": pa.array(["a", "b", "a", "a", "c", None]),
+        "v": pa.array([10, 20, 30, 40, 50, 60], type=pa.int64()),
+    })
+    df = s.create_dataframe(tb)
+    out = (df.group_by(col("k"))
+           .agg(F.pivot_first(col("p"), col("v"), "a").alias("pa"),
+                F.pivot_first(col("p"), col("v"), "c").alias("pc"))
+           .collect().sort_by("k"))
+    assert out.column("pa").to_pylist() == [10, 40, None]
+    assert out.column("pc").to_pylist() == [None, 50, None]
+    # the aggregate ran on the TPU engine
+    assert any(n == "TpuHashAggregateExec" and p == "tpu"
+               for n, p in _tpu_ops(s))
+
+
+def test_pivot_api_uses_pivot_first_and_matches_oracle():
+    s = _session()
+    rng = np.random.default_rng(4)
+    n = 400
+    tb = pa.table({
+        "k": pa.array(rng.integers(0, 10, n).astype(np.int64)),
+        "p": pa.array([["x", "y", "z"][i] for i in
+                       rng.integers(0, 3, n)]),
+        "v": pa.array(rng.integers(0, 100, n).astype(np.int64)),
+    })
+    df = s.create_dataframe(tb)
+    got = (df.group_by(col("k")).pivot(col("p"), ["x", "y", "z"])
+           .agg(F.sum(col("v")).alias("s")).collect().sort_by("k"))
+    import collections
+    want = collections.defaultdict(lambda: {"x": None, "y": None,
+                                            "z": None})
+    for k, p, v in zip(tb.column("k").to_pylist(),
+                       tb.column("p").to_pylist(),
+                       tb.column("v").to_pylist()):
+        cur = want[k][p]
+        want[k][p] = v if cur is None else cur + v
+    for i, k in enumerate(got.column("k").to_pylist()):
+        for p in ("x", "y", "z"):
+            assert got.column(p).to_pylist()[i] == want[k][p], (k, p)
+
+
+def test_approx_percentile_differential_and_sane():
+    rng = np.random.default_rng(5)
+    n = 3000
+    tb = pa.table({
+        "k": pa.array(rng.integers(0, 7, n).astype(np.int64)),
+        "v": pa.array(rng.normal(0, 100, n)),
+    })
+    for p in (0.0, 0.25, 0.5, 0.9, 1.0):
+        s1 = _session(True)
+        got = (s1.create_dataframe(tb).group_by(col("k"))
+               .agg(F.approx_percentile(col("v"), p).alias("q"))
+               .collect().sort_by("k"))
+        s2 = _session(False)
+        want = (s2.create_dataframe(tb).group_by(col("k"))
+                .agg(F.approx_percentile(col("v"), p).alias("q"))
+                .collect().sort_by("k"))
+        np.testing.assert_allclose(np.array(got.column("q")),
+                                   np.array(want.column("q")),
+                                   rtol=1e-12)
+        # sanity vs numpy's inverted-CDF quantile per group
+        ks = np.array(tb.column("k"))
+        vs = np.array(tb.column("v"))
+        for i, k in enumerate(got.column("k").to_pylist()):
+            grp = np.sort(vs[ks == k])
+            idx = max(int(np.ceil(p * len(grp))) - 1, 0)
+            assert abs(got.column("q").to_pylist()[i] - grp[idx]) < 1e-9
+
+
+def test_approx_percentile_int_type_preserved():
+    s = _session()
+    tb = pa.table({"v": pa.array([5, 1, 9, 3, 7], type=pa.int64())})
+    out = s.create_dataframe(tb).agg(
+        F.approx_percentile(col("v"), 0.5).alias("m")).collect()
+    assert out.schema.field("m").type == pa.int64()
+    assert out.column("m").to_pylist() == [5]
+
+
+def test_tumbling_time_window_groups():
+    s = _session()
+    base = datetime.datetime(2024, 3, 1, 10, 0, 0,
+                             tzinfo=datetime.timezone.utc)
+    ts = [base + datetime.timedelta(minutes=m) for m in
+          (0, 3, 7, 12, 14, 21)]
+    tb = pa.table({
+        "ts": pa.array(ts, type=pa.timestamp("us", tz="UTC")),
+        "v": pa.array([1, 2, 3, 4, 5, 6], type=pa.int64()),
+    })
+    df = s.create_dataframe(tb)
+    out = (df.group_by(F.window(col("ts"), "10 minutes").alias("w"))
+           .agg(F.sum(col("v")).alias("s")).collect())
+    rows = sorted((w["start"], s_) for w, s_ in
+                  zip(out.column("w").to_pylist(),
+                      out.column("s").to_pylist()))
+    # minutes 0-9 -> 1+2+3; 10-19 -> 4+5; 20-29 -> 6
+    assert [r[1] for r in rows] == [6, 9, 6]
+    starts = [r[0].replace(tzinfo=datetime.timezone.utc) for r in rows]
+    assert starts[0] == base
+    assert starts[1] == base + datetime.timedelta(minutes=10)
+
+
+def test_sliding_window_raises_until_expand_lowering():
+    """slide != window needs the per-slide Expand; evaluating it as
+    tumbling would be silently wrong, so it raises (code-review round-3
+    finding)."""
+    s = _session()
+    base = datetime.datetime(2024, 3, 1, tzinfo=datetime.timezone.utc)
+    tb = pa.table({"ts": pa.array([base], type=pa.timestamp("us",
+                                                            tz="UTC")),
+                   "v": pa.array([1], type=pa.int64())})
+    df = s.create_dataframe(tb)
+    q = df.select(F.window(col("ts"), "10 minutes", "5 minutes")
+                  .alias("w"))
+    with pytest.raises(NotImplementedError, match="sliding"):
+        q.collect()
+
+
+def test_window_start_time_offsets():
+    s = _session()
+    base = datetime.datetime(2024, 3, 1, 10, 0, 0,
+                             tzinfo=datetime.timezone.utc)
+    ts = [base + datetime.timedelta(minutes=m) for m in (0, 4, 6)]
+    tb = pa.table({"ts": pa.array(ts, type=pa.timestamp("us", tz="UTC")),
+                   "v": pa.array([1, 2, 4], type=pa.int64())})
+    df = s.create_dataframe(tb)
+    # zero and negative offsets are accepted (Spark parity)
+    for st in ("0 minutes", "-5 minutes"):
+        out = (df.group_by(F.window(col("ts"), "10 minutes",
+                                    start_time=st).alias("w"))
+               .agg(F.sum(col("v")).alias("s")).collect())
+        assert sum(out.column("s").to_pylist()) == 7
+
+
+def test_struct_key_grouping_on_cpu_engine():
+    """The CPU oracle flattens struct keys for pyarrow grouping and
+    rebuilds them (code-review round-3 finding)."""
+    s = _session(False)
+    base = datetime.datetime(2024, 3, 1, tzinfo=datetime.timezone.utc)
+    ts = [base + datetime.timedelta(minutes=m) for m in (0, 3, 12)]
+    tb = pa.table({"ts": pa.array(ts, type=pa.timestamp("us", tz="UTC")),
+                   "v": pa.array([1, 2, 4], type=pa.int64())})
+    out = (s.create_dataframe(tb)
+           .group_by(F.window(col("ts"), "10 minutes").alias("w"))
+           .agg(F.sum(col("v")).alias("s")).collect())
+    assert sorted(out.column("s").to_pylist()) == [3, 4]
+
+
+def test_approx_percentile_empty_input():
+    for enabled in (True, False):
+        s = _session(enabled)
+        tb = pa.table({"v": pa.array([], type=pa.int64())})
+        out = s.create_dataframe(tb).agg(
+            F.approx_percentile(col("v"), 0.5).alias("m")).collect()
+        assert out.num_rows == 1
+        assert out.column("m").to_pylist() == [None], enabled
+
+
+def test_normalize_nan_and_zero():
+    from spark_rapids_tpu.api.column import Column
+    from spark_rapids_tpu.expr.mathexpr import NormalizeNaNAndZero
+    s = _session()
+    tb = pa.table({"x": pa.array([0.0, -0.0, float("nan"), 1.5, None])})
+    df = s.create_dataframe(tb)
+    out = df.select(Column(NormalizeNaNAndZero(col("x").expr))
+                    .alias("n")).collect()
+    vals = out.column("n").to_pylist()
+    assert str(vals[1]) == "0.0"          # -0.0 canonicalized
+    assert np.isnan(vals[2])
+    assert vals[3] == 1.5 and vals[4] is None
+    # grouping floats already canonicalizes: -0.0 and 0.0 share a group
+    g = (df.group_by(col("x")).agg(F.count("*").alias("c"))
+         .collect())
+    zero_rows = [c for x, c in zip(g.column("x").to_pylist(),
+                                   g.column("c").to_pylist())
+                 if x == 0.0]
+    assert zero_rows == [2]

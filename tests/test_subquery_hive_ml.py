@@ -1,0 +1,89 @@
+"""Scalar subqueries (GpuScalarSubquery analog), the Hive override hook
+(GpuHiveOverrides analog), and zero-copy ML export (ColumnarRdd analog)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col
+from spark_rapids_tpu.api.session import TpuSession
+
+
+def _session(enabled=True):
+    return (TpuSession.builder()
+            .config("spark.rapids.sql.enabled", enabled).get_or_create())
+
+
+def test_scalar_subquery_in_filter_and_project():
+    s = _session()
+    tb = pa.table({"k": pa.array([1, 2, 3, 4], type=pa.int64()),
+                   "v": pa.array([10, 20, 30, 40], type=pa.int64())})
+    df = s.create_dataframe(tb)
+    avg_df = df.agg(F.avg(col("v")).alias("a"))
+    out = df.filter(col("v") > F.scalar_subquery(avg_df)).collect()
+    assert sorted(out.column("v").to_pylist()) == [30, 40]
+    out2 = df.select(
+        col("k"),
+        (col("v") - F.scalar_subquery(avg_df)).alias("d")).collect()
+    assert out2.column("d").to_pylist() == [-15.0, -5.0, 5.0, 15.0]
+
+
+def test_scalar_subquery_must_be_single_row():
+    s = _session()
+    tb = pa.table({"v": pa.array([1, 2], type=pa.int64())})
+    df = s.create_dataframe(tb)
+    with pytest.raises(ValueError, match="one row"):
+        df.filter(col("v") > F.scalar_subquery(df)).collect()
+
+
+def test_hive_override_hook_registers_rules():
+    from spark_rapids_tpu.api.column import Column
+    from spark_rapids_tpu.hive import HiveHash, enable_hive_support
+    from spark_rapids_tpu.plan.overrides import EXPR_RULES
+
+    s = _session()
+    tb = pa.table({"a": pa.array([1, 2, None], type=pa.int32()),
+                   "b": pa.array([True, False, True])})
+    df = s.create_dataframe(tb)
+    # before opting in the expression has no rule -> CPU fallback works
+    q = df.select(Column(HiveHash(col("a").expr, col("b").expr))
+                  .alias("h"))
+    out_cpu = q.collect()
+    enable_hive_support()
+    out_tpu = q.collect()
+    assert HiveHash in EXPR_RULES
+    assert out_cpu.column("h").to_pylist() == \
+        out_tpu.column("h").to_pylist()
+    # hive hash semantics: 31*h + int(col) per column, nulls contribute 0
+    assert out_cpu.column("h").to_pylist() == [31 * 1 + 1, 31 * 2 + 0,
+                                               31 * 0 + 1]
+
+
+def test_ml_columnar_arrays_zero_copy():
+    import jax
+
+    from spark_rapids_tpu import ml
+    s = _session()
+    rng = np.random.default_rng(6)
+    n = 1000
+    tb = pa.table({"k": pa.array(rng.integers(0, 5, n).astype(np.int64)),
+                   "x": pa.array(rng.random(n))})
+    df = (s.create_dataframe(tb)
+          .group_by(col("k")).agg(F.avg(col("x")).alias("mx")))
+    parts = ml.columnar_arrays(df)
+    assert len(parts) == 1
+    d = parts[0]
+    # arrays are device-resident jax arrays, not numpy (zero copy out of
+    # the SQL pipeline, ColumnarRdd analog)
+    assert isinstance(d["mx"][0], jax.Array)
+    # and consumable by jax compute directly
+    n_groups = int(np.asarray(d["__num_rows__"]))
+    live = np.asarray(d["mx"][1])[:n_groups]
+    vals = np.asarray(d["mx"][0])[:n_groups]
+    want = {k: float(np.mean(np.array(tb.column("x"))[
+        np.array(tb.column("k")) == k])) for k in range(5)}
+    got = {int(k): float(v) for k, v, ok in zip(
+        np.asarray(d["k"][0])[:n_groups], vals, live) if ok}
+    for k in want:
+        assert abs(got[k] - want[k]) < 1e-12
