@@ -2,12 +2,16 @@
 """Headline benchmark: mixed SQL operator suite, TPU engine vs CPU engine.
 
 Workloads mirror the reference's best-suited shapes (docs/FAQ.md:107-116:
-high-cardinality group-by / join / sort, windows):
+high-cardinality group-by / join / sort, windows, parquet IO):
 
-  q1 aggregate: scan -> filter -> GROUP BY k SUM/AVG/COUNT   (100k groups)
-  q2 join:      shuffled hash join on a 100k-key dimension, then agg
-  q3 sort:      global sort by two keys
-  q4 window:    row_number + running sum over partitions
+  q1 agg:     scan -> filter -> GROUP BY k SUM/AVG/COUNT   (100k groups)
+  q2 join:    shuffled hash join on a 100k-key dimension, then agg
+  q3 sort:    global sort by two keys
+  q4 window:  row_number + running sum over partitions
+  q5 parquet: multi-file parquet scan -> filter -> aggregate
+  q6 shjoin:  multi-partition shuffle join (broadcast disabled), the
+              multi-batch host-exchange path
+  q7 write:   scan -> parquet write (columnar write path)
 
 Prints ONE JSON line: value = total rows processed per second through
 the TPU engine across the suite; vs_baseline = CPU-engine time / TPU
@@ -16,11 +20,15 @@ baseline exists).
 """
 
 import json
+import os
+import shutil
 import sys
+import tempfile
 import time
 
 import numpy as np
 import pyarrow as pa
+import pyarrow.parquet as pq
 
 
 def make_tables(n_rows: int):
@@ -37,15 +45,29 @@ def make_tables(n_rows: int):
     return fact, dim
 
 
-def queries(session, fact, dim):
+def write_parquet_input(fact: pa.Table, root: str, n_files: int = 4) -> str:
+    """Multi-file parquet dataset for the scan benchmarks."""
+    path = os.path.join(root, "fact_pq")
+    os.makedirs(path, exist_ok=True)
+    per = -(-fact.num_rows // n_files)
+    for i in range(n_files):
+        pq.write_table(fact.slice(i * per, per),
+                       os.path.join(path, f"part-{i:02d}.parquet"))
+    return path
+
+
+def queries(session, fact, dim, pq_path, out_root):
     from spark_rapids_tpu.api import functions as F
     from spark_rapids_tpu.api.column import col
     from spark_rapids_tpu.expr.window import WindowBuilder
 
     fdf = session.create_dataframe(fact)
     ddf = session.create_dataframe(dim)
+    # multi-partition variants exercise the shuffle paths
+    fdf4 = session.create_dataframe(fact, num_partitions=4)
+    ddf2 = session.create_dataframe(dim, num_partitions=2)
 
-    def q1():
+    def q1_agg():
         return (fdf.filter(col("v") > -(10**6) // 2)
                 .group_by(col("k"))
                 .agg(F.sum(col("v")).alias("sv"),
@@ -53,52 +75,95 @@ def queries(session, fact, dim):
                      F.count("*").alias("c"))
                 .collect())
 
-    def q2():
+    def q2_join():
         return (fdf.join(ddf, on="k", how="inner")
                 .group_by(col("k"))
                 .agg(F.sum(col("w")).alias("sw"))
                 .collect())
 
-    def q3():
+    def q3_sort():
         return fdf.sort(col("k"), col("v")).collect()
 
-    def q4():
+    def q4_window():
         w = WindowBuilder().partition_by(col("k")).order_by(col("v"))
         return (fdf.select(col("k"), col("v"),
                            F.row_number().over(w).alias("rn"),
                            F.sum(col("v")).over(w).alias("rs"))
                 .collect())
 
-    return [("agg", q1), ("join", q2), ("sort", q3), ("window", q4)]
+    def q5_parquet():
+        return (session.read.parquet(pq_path)
+                .filter(col("f") < 0.5)
+                .group_by(col("k"))
+                .agg(F.sum(col("v")).alias("sv"),
+                     F.count("*").alias("c"))
+                .collect())
+
+    def q6_shuffle_join():
+        return (fdf4.join(ddf2, on="k", how="inner")
+                .group_by(col("k"))
+                .agg(F.sum(col("w")).alias("sw"))
+                .collect())
+
+    def q7_write():
+        out = os.path.join(out_root, f"bench_out_{time.time_ns()}")
+        fdf.filter(col("v") > 0).write.mode("overwrite").parquet(out)
+        # row verification reads only footers; a full read-back would
+        # charge scan cost to the write benchmark
+        n = sum(pq.ParquetFile(os.path.join(out, f)).metadata.num_rows
+                for f in os.listdir(out) if f.endswith(".parquet"))
+        shutil.rmtree(out, ignore_errors=True)
+
+        class R:  # uniform "has rows" result contract
+            num_rows = n
+        return R
+
+    return [("agg", q1_agg), ("join", q2_join), ("sort", q3_sort),
+            ("window", q4_window), ("parquet", q5_parquet),
+            ("shuffle_join", q6_shuffle_join), ("write", q7_write)]
 
 
-def time_engine(enabled: bool, fact, dim, repeats: int = 2):
+def time_engine(enabled: bool, fact, dim, pq_path, out_root,
+                repeats: int = 3):
     from spark_rapids_tpu.api.session import TpuSession
-    s = TpuSession.builder().config("spark.rapids.sql.enabled",
-                                    enabled).get_or_create()
-    qs = queries(s, fact, dim)
+    extra = {}
+    if enabled and os.environ.get("BENCH_TRANSPORT"):
+        extra["spark.rapids.shuffle.transport"] = \
+            os.environ["BENCH_TRANSPORT"]
+    b = TpuSession.builder().config("spark.rapids.sql.enabled", enabled)
+    for k, v in extra.items():
+        b = b.config(k, v)
+    s = b.get_or_create()
+    qs = queries(s, fact, dim, pq_path, out_root)
     per_query = {}
     for name, q in qs:
         q()  # warmup (compile)
-        best = float("inf")
+        times = []
         for _ in range(repeats):
             t0 = time.perf_counter()
             out = q()
-            best = min(best, time.perf_counter() - t0)
+            times.append(time.perf_counter() - t0)
         assert out.num_rows > 0
-        per_query[name] = best
+        # median: best-of flattered the number, mean punishes one-off
+        # host hiccups; median is the honest middle
+        per_query[name] = sorted(times)[len(times) // 2]
     return per_query
 
 
 def main():
     n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
     fact, dim = make_tables(n_rows)
-    tpu = time_engine(True, fact, dim)
-    cpu = time_engine(False, fact, dim)
+    root = tempfile.mkdtemp(prefix="spark_rapids_tpu_bench_")
+    try:
+        pq_path = write_parquet_input(fact, root)
+        tpu = time_engine(True, fact, dim, pq_path, root)
+        cpu = time_engine(False, fact, dim, pq_path, root)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
     tpu_total = sum(tpu.values())
     cpu_total = sum(cpu.values())
-    # rows processed: each of the 4 queries consumes the fact table once
-    value = (4 * n_rows) / tpu_total
+    # rows processed: each query consumes the fact table once
+    value = (len(tpu) * n_rows) / tpu_total
     print(json.dumps({
         "metric": "sql_suite_rows_per_sec",
         "value": round(value, 1),

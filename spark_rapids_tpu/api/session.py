@@ -41,9 +41,10 @@ class TpuSession:
         conf = self.conf
         from ..memory.meta import set_default_codec
         set_default_codec(conf.get(cfg.SHUFFLE_COMPRESSION_CODEC))
-        from ..shims import ShimLoader
+        from ..shims import ShimLoader, set_active_shim
         self.shim = ShimLoader.get_shim(
             conf.raw("spark.rapids.tpu.sparkVersion", "3.2.0"))
+        set_active_shim(self.shim)
         from ..exec.base import set_device_timing, set_trace_annotations
         set_trace_annotations(conf.get(cfg.PROFILE_TRACE_ANNOTATIONS))
         # DEBUG metrics level: block per-op so opTime is real device time
@@ -116,9 +117,18 @@ class TpuSession:
         return DataFrameReader(self)
 
     # -- execution ----------------------------------------------------------
-    def execute(self, lp: L.LogicalPlan) -> pa.Table:
+    def prepare_plan(self, lp: L.LogicalPlan):
+        """Logical plan -> final physical plan: dialect install, scalar
+        subqueries, planning, overrides — the shared front half of
+        execute()/explain()/ml.device_batches."""
         from ..expr.subquery import (has_scalar_subquery,
                                      resolve_scalar_subqueries)
+        from ..shims import set_active_shim
+        # queries are evaluated sequentially per process; installing the
+        # dialect per execution keeps interleaved sessions with different
+        # sparkVersions correct (concurrent multi-dialect sessions are
+        # out of scope, like one ShimLoader per JVM in the reference)
+        set_active_shim(self.shim)
         if has_scalar_subquery(lp):
             # subqueries run first, driver-side, and substitute as typed
             # literals (ref GpuScalarSubquery / ExecSubqueryExpression)
@@ -130,32 +140,35 @@ class TpuSession:
         final_plan = overrides.apply(physical)
         self.last_plan = final_plan
         self.last_explain = overrides.last_explain
+        return final_plan
+
+    def release_plan_shuffles(self, final_plan) -> None:
+        """Release shuffle blocks a plan registered in the global spill
+        catalog (ref remove-shuffle on stage cleanup) — each collect
+        re-plans, so dropping them cannot be observed."""
+        from ..shuffle.manager import TpuShuffleManager
+        ids = []
+        final_plan.foreach(
+            lambda e: ids.append(e._shuffle_id)
+            if getattr(e, "_shuffle_id", None) is not None else None)
+        if ids:
+            mgr = TpuShuffleManager.get()
+            for sid in ids:
+                mgr.unregister(sid)
+
+    def execute(self, lp: L.LogicalPlan) -> pa.Table:
+        final_plan = self.prepare_plan(lp)
         from ..plugin import ExecutionPlanCaptureCallback
         ExecutionPlanCaptureCallback.on_plan(final_plan)
         ctx = ExecContext(self.conf)
         try:
             return final_plan.execute_collect(ctx)
         finally:
-            # release shuffle blocks this query registered in the global
-            # spill catalog (ref remove-shuffle on stage cleanup) — each
-            # collect re-plans, so dropping them here cannot be observed
-            from ..shuffle.manager import TpuShuffleManager
-            ids = []
-            final_plan.foreach(
-                lambda e: ids.append(e._shuffle_id)
-                if getattr(e, "_shuffle_id", None) is not None else None)
-            if ids:
-                mgr = TpuShuffleManager.get()
-                for sid in ids:
-                    mgr.unregister(sid)
+            self.release_plan_shuffles(final_plan)
 
     def explain(self, lp: L.LogicalPlan) -> str:
-        physical = plan_physical(lp, self.conf)
-        from ..plan.planner import force_perfile_if_input_file
-        force_perfile_if_input_file(physical)
-        overrides = TpuOverrides(self.conf)
-        final_plan = overrides.apply(physical)
-        return final_plan.tree_string() + "\n--\n" + overrides.last_explain
+        final_plan = self.prepare_plan(lp)
+        return final_plan.tree_string() + "\n--\n" + self.last_explain
 
 
 class _Builder:

@@ -580,11 +580,13 @@ class CpuHashAggregateExec(Exec):
                 arr = column_to_arrow(v.col, int(b.num_rows))
                 if pa.types.is_struct(arr.type):
                     # pyarrow cannot group struct keys: flatten to field
-                    # columns and rebuild after the aggregate (field
-                    # nullness carries the key identity)
+                    # columns (+ an explicit top-level null flag — field
+                    # nulls alone cannot distinguish a null struct from a
+                    # struct of nulls) and rebuild after the aggregate
+                    import pyarrow.compute as _pc
                     for j in range(arr.type.num_fields):
-                        import pyarrow.compute as _pc
                         cols[f"__{nm}__f{j}"] = _pc.struct_field(arr, j)
+                    cols[f"__{nm}__null"] = _pc.is_null(arr)
                 else:
                     cols[nm] = arr
             for i, ae in enumerate(self.aggregates):
@@ -630,6 +632,7 @@ class CpuHashAggregateExec(Exec):
             if nm in struct_types:
                 group_cols += [f"__{nm}__f{j}" for j in
                                range(struct_types[nm].num_fields)]
+                group_cols.append(f"__{nm}__null")
             else:
                 group_cols.append(nm)
         aggs = []
@@ -679,8 +682,13 @@ class CpuHashAggregateExec(Exec):
                           for j in range(st.num_fields)]
                 arrs = [f.chunk(0) if isinstance(f, pa.ChunkedArray)
                         else f for f in fields]
+                nulls = res.column(f"__{nm}__null").combine_chunks()
+                nulls = nulls.chunk(0) if isinstance(
+                    nulls, pa.ChunkedArray) else nulls
+                mask = pa.array([bool(x) if x is not None else True
+                                 for x in nulls.to_pylist()])
                 out_cols.append(pa.StructArray.from_arrays(
-                    arrs, fields=list(st)))
+                    arrs, fields=list(st), mask=mask))
             else:
                 out_cols.append(res.column(nm))
         for i, ae in enumerate(self.aggregates):

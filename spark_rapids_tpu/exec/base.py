@@ -53,7 +53,14 @@ def process_jit(key: tuple, make_fn):
     """Return the process-cached jitted function for `key`, building it
     with make_fn() (a 0-arg factory returning the python callable) on
     first use.  jax.jit itself then caches per input-shape signature, so
-    capacity buckets share one entry here."""
+    capacity buckets share one entry here.
+
+    The active shim version joins the key: dialect-sensitive expressions
+    (legacy stddev, lenient date cast) trace DIFFERENT computations per
+    Spark version, and a cached kernel from one dialect must never serve
+    another."""
+    from ..shims import active_shim
+    key = (active_shim().version,) + key
     f = _JIT_CACHE.get(key)
     if f is None:
         f = jax.jit(make_fn())

@@ -273,11 +273,20 @@ class _MomentAgg(AggregateFunction):
         return n, s, m2
 
     def _var(self, ctx, buffers, ddof):
+        from ..shims import active_shim
         xp = ctx.xp
         n, _, m2 = self._moments(ctx, buffers)
         denom = n - ddof
         ok = denom > 0
         var = xp.where(ok, m2 / xp.maximum(denom, 1.0), 0.0)
+        if active_shim().legacy_statistical_aggregate():
+            # Spark 3.0 dialect: divide-by-zero yields NaN, not null
+            # (ref shims legacy statistical aggregate handling)
+            has_rows = n > 0
+            var = xp.where(ok, var,
+                           xp.where(has_rows, xp.full_like(var, np.nan),
+                                    var))
+            ok = has_rows
         return var, ok
 
 
@@ -440,6 +449,14 @@ class AggregateExpression(Expression):
         self.children = (func,)
         self.func = func
         self.name = name or func.sql()
+
+    def with_children(self, children):
+        # func mirrors children[0]; a transform_up rebuild must not leave
+        # the two diverged (scalar-subquery substitution walks through
+        # aggregate arguments)
+        c = super().with_children(children)
+        c.func = c.children[0]
+        return c
 
     def data_type(self):
         return self.func.data_type()

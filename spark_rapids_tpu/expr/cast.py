@@ -501,20 +501,41 @@ def _parse_float(xp, tb, tl):
 
 
 def _parse_date(ctx, tb, tl, val):
-    """yyyy-MM-dd (also accepts yyyy-M-d like Spark's loose parser subset)."""
+    """yyyy-MM-dd; the 3.0 dialect (shims lenient_string_to_date) also
+    accepts unpadded yyyy-M-d forms, matching Spark 3.0's loose parser
+    vs the 3.1+ strict ISO requirement (ref per-shim date parsing)."""
+    from ..shims import active_shim
     xp = ctx.xp
     W = tb.shape[1]
     is_digit = (tb >= ord("0")) & (tb <= ord("9"))
     dash = tb == ord("-")
+    dv = (tb - ord("0")).astype(xp.int64)
+    y = dv[:, 0] * 1000 + dv[:, 1] * 100 + dv[:, 2] * 10 + dv[:, 3]
     # strict: positions 0-3 digits, 4 dash, 5-6 digits, 7 dash, 8-9 digits
     strict = (tl == 10) & is_digit[:, 0] & is_digit[:, 1] & is_digit[:, 2] & \
         is_digit[:, 3] & dash[:, 4] & is_digit[:, 5] & is_digit[:, 6] & \
         dash[:, 7] & is_digit[:, 8] & is_digit[:, 9]
-    dv = (tb - ord("0")).astype(xp.int64)
-    y = dv[:, 0] * 1000 + dv[:, 1] * 100 + dv[:, 2] * 10 + dv[:, 3]
     m = dv[:, 5] * 10 + dv[:, 6]
     d = dv[:, 8] * 10 + dv[:, 9]
-    ok = strict & (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31)
+    ok = strict
+    if active_shim().lenient_string_to_date() and W >= 10:
+        # enumerate the three unpadded shapes: y-M-d, y-MM-d, y-M-dd
+        prefix_ok = is_digit[:, 0] & is_digit[:, 1] & is_digit[:, 2] & \
+            is_digit[:, 3] & dash[:, 4]
+        for mlen, dlen in ((1, 1), (2, 1), (1, 2)):
+            L = 4 + 1 + mlen + 1 + dlen
+            shape = prefix_ok & (tl == L) & dash[:, 5 + mlen]
+            for i in range(mlen):
+                shape = shape & is_digit[:, 5 + i]
+            for i in range(dlen):
+                shape = shape & is_digit[:, 6 + mlen + i]
+            lm = dv[:, 5] if mlen == 1 else dv[:, 5] * 10 + dv[:, 6]
+            ld = dv[:, 6 + mlen] if dlen == 1 else \
+                dv[:, 6 + mlen] * 10 + dv[:, 7 + mlen]
+            m = xp.where(shape, lm, m)
+            d = xp.where(shape, ld, d)
+            ok = ok | shape
+    ok = ok & (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31)
     days = _days_from_civil(xp, y, m, d)
     return make_column(ctx, t.DATE, days.astype(np.int32),
                        and_validity(ctx, val, ok))

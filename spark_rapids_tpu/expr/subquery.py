@@ -14,6 +14,24 @@ from __future__ import annotations
 from .. import types as t
 from .core import Expression, Literal
 
+# every logical-plan attribute that can carry expressions (Window keeps
+# them under window_exprs; Expand.projections is a list of lists)
+_EXPR_ATTRS = ("condition", "exprs", "grouping", "aggregates",
+               "projections", "orders", "keys", "window_exprs")
+
+
+def _map_expr_container(v, fn):
+    """Apply fn to every Expression inside a (possibly nested) container,
+    preserving its shape."""
+    if isinstance(v, Expression):
+        return fn(v)
+    if isinstance(v, (list, tuple)):
+        out = [_map_expr_container(item, fn) if
+               isinstance(item, (Expression, list, tuple)) else item
+               for item in v]
+        return type(v)(out) if isinstance(v, list) else tuple(out)
+    return v
+
 
 class ScalarSubquery(Expression):
     """A subquery that must yield exactly one row and one column."""
@@ -44,36 +62,29 @@ def resolve_scalar_subqueries(lp, session):
                         f"{out.num_rows}")
                 val = out.column(0).to_pylist()[0]
                 return Literal(val, x.data_type())
+            from .window import WindowExpression
+            if isinstance(x, WindowExpression):
+                # the window spec's keys live outside the children tuple
+                import copy
+                spec = copy.copy(x.spec)
+                spec.partition_by = [resolve_expr(p)
+                                     for p in spec.partition_by]
+                spec.order_by = [
+                    (resolve_expr(o[0]),) + tuple(o[1:])
+                    if isinstance(o, tuple) else resolve_expr(o)
+                    for o in spec.order_by]
+                x = copy.copy(x)
+                x.spec = spec
             return x
         return e.transform_up(fn)
 
     def walk(node):
         node.children = tuple(walk(c) for c in node.children)
-        for attr in ("condition", "exprs", "grouping", "aggregates",
-                     "projections", "orders", "keys"):
+        for attr in _EXPR_ATTRS:
             v = getattr(node, attr, None)
             if v is None:
                 continue
-            if isinstance(v, Expression):
-                setattr(node, attr, resolve_expr(v))
-            elif isinstance(v, (list, tuple)):
-                out = []
-                changed = False
-                for item in v:
-                    if isinstance(item, Expression):
-                        r = resolve_expr(item)
-                        changed |= r is not item
-                        out.append(r)
-                    elif (isinstance(item, tuple) and item and
-                          isinstance(item[0], Expression)):
-                        r = (resolve_expr(item[0]),) + item[1:]
-                        changed = True
-                        out.append(r)
-                    else:
-                        out.append(item)
-                if changed:
-                    setattr(node, attr, type(v)(out) if
-                            isinstance(v, list) else tuple(out))
+            setattr(node, attr, _map_expr_container(v, resolve_expr))
         return node
 
     return walk(lp)
@@ -87,19 +98,16 @@ def has_scalar_subquery(lp) -> bool:
             if e.collect(lambda x: isinstance(x, ScalarSubquery)):
                 found.append(True)
 
+    def scan(v):
+        if isinstance(v, Expression):
+            check_expr(v)
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                scan(item)
+
     def walk(node):
-        for attr in ("condition", "exprs", "grouping", "aggregates",
-                     "projections", "orders", "keys"):
-            v = getattr(node, attr, None)
-            if isinstance(v, Expression):
-                check_expr(v)
-            elif isinstance(v, (list, tuple)):
-                for item in v:
-                    if isinstance(item, Expression):
-                        check_expr(item)
-                    elif (isinstance(item, tuple) and item and
-                          isinstance(item[0], Expression)):
-                        check_expr(item[0])
+        for attr in _EXPR_ATTRS:
+            scan(getattr(node, attr, None))
         for c in node.children:
             walk(c)
 

@@ -155,6 +155,13 @@ class WindowExpression(Expression):
         self.spec = spec
         self.name = name or f"{type(func).__name__.lower()}_w"
 
+    def with_children(self, children):
+        # func mirrors children[0] (same discipline as
+        # AggregateExpression): rebuilds must not diverge the two
+        c = super().with_children(children)
+        c.func = c.children[0]
+        return c
+
     def data_type(self):
         return self.func.data_type()
 

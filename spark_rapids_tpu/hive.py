@@ -52,8 +52,15 @@ def _eval_hive_hash(e: HiveHash, ctx: EvalContext):
             x = d.astype(np.float64).view(np.int64) if xp is np else \
                 xp.asarray(d, dtype=xp.float64).view(xp.int64)
             ch = (x ^ ((x >> 32) & 0xFFFFFFFF)).astype(np.int32)
-        else:
+        elif isinstance(dt, t.FloatType):
+            # floatToIntBits, not value truncation
+            ch = (d.astype(np.float32).view(np.int32) if xp is np else
+                  xp.asarray(d, dtype=xp.float32).view(xp.int32))
+        elif t.is_integral(dt) or isinstance(dt, t.DateType):
             ch = d.astype(np.int32)
+        else:
+            raise NotImplementedError(
+                f"hive_hash over {dt.name} is not supported")
         valid = validity_of(v, ctx)
         if valid is not None:
             ch = xp.where(valid, ch, xp.zeros_like(ch))

@@ -26,20 +26,20 @@ def device_batches(df) -> List:
     (the reference likewise degrades to host rows when the plan ended on
     CPU, InternalColumnarRddConverter's row path)."""
     from .exec.base import DeviceToHostExec, ExecContext
-    from .plan.overrides import TpuOverrides
-    from .plan.planner import plan as plan_physical
 
     session = df.session
-    physical = plan_physical(df._lp, session.conf)
-    final_plan = TpuOverrides(session.conf).apply(physical)
+    final_plan = session.prepare_plan(df._lp)
     # strip the terminal transition: consumers want device residency
     if isinstance(final_plan, DeviceToHostExec):
         final_plan = final_plan.children[0]
     session.last_plan = final_plan
     ctx = ExecContext(session.conf)
     out = []
-    for pid in range(final_plan.num_partitions):
-        out.append(list(final_plan.execute_partition(pid, ctx)))
+    try:
+        for pid in range(final_plan.num_partitions):
+            out.append(list(final_plan.execute_partition(pid, ctx)))
+    finally:
+        session.release_plan_shuffles(final_plan)
     return out
 
 

@@ -119,6 +119,23 @@ _PROVIDERS: List[ShimServiceProvider] = [
 ]
 
 
+_ACTIVE: Optional[SparkShims] = None
+
+
+def set_active_shim(shim: SparkShims) -> None:
+    """Install the session's dialect (ref ShimLoader.getSparkShims —
+    one dialect per plugin lifecycle)."""
+    global _ACTIVE
+    _ACTIVE = shim
+
+
+def active_shim() -> SparkShims:
+    global _ACTIVE
+    if _ACTIVE is None:
+        _ACTIVE = Spark320Shims()
+    return _ACTIVE
+
+
 class ShimLoader:
     """Provider discovery + selection (ref ShimLoader.scala)."""
 

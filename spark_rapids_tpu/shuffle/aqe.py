@@ -137,8 +137,12 @@ class AQEShuffleReadExec(Exec):
         return self.exchange.output_types
 
     def describe(self):
+        # the display name changed across Spark versions
+        # (CustomShuffleReader in 3.0/3.1, AQEShuffleRead in 3.2 — ref
+        # per-shim AQE exec naming); mirror the session's dialect
+        from ..shims import active_shim
         n = len(self._specs) if self._specs is not None else "?"
-        return f"AQEShuffleRead({n} specs)"
+        return f"{active_shim().aqe_shuffle_read_name()}({n} specs)"
 
     # -- spec computation ---------------------------------------------------
     def _materialize(self):

@@ -87,3 +87,45 @@ def test_ml_columnar_arrays_zero_copy():
         np.asarray(d["k"][0])[:n_groups], vals, live) if ok}
     for k in want:
         assert abs(got[k] - want[k]) < 1e-12
+
+
+def test_scalar_subquery_inside_aggregate_and_window():
+    """Subqueries nested in aggregate arguments and window expressions
+    resolve too (code-review round-3 findings: stale
+    AggregateExpression.func and the window_exprs walker gap)."""
+    s = _session()
+    tb = pa.table({"k": pa.array([1, 1, 2], type=pa.int64()),
+                   "v": pa.array([10, 20, 40], type=pa.int64())})
+    df = s.create_dataframe(tb)
+    one = df.agg(F.min(col("v")).alias("m"))  # = 10
+    out = df.agg(F.sum(col("v") - F.scalar_subquery(one)).alias("d")) \
+        .collect()
+    assert out.column("d").to_pylist() == [(10 - 10) + (20 - 10) +
+                                           (40 - 10)]
+    from spark_rapids_tpu.expr.window import WindowBuilder
+    w = WindowBuilder().partition_by(col("k")).order_by(col("v"))
+    out2 = df.select(
+        col("k"),
+        F.sum(col("v") - F.scalar_subquery(one)).over(w).alias("rs")) \
+        .collect()
+    assert sorted(out2.column("rs").to_pylist()) == [0, 10, 30]
+
+
+def test_struct_key_null_distinct_from_null_fields_cpu():
+    """A null struct key and a struct of null fields group separately on
+    the CPU oracle (code-review round-3 finding: lost top-level
+    validity)."""
+    import datetime
+    s = _session(False)
+    base = datetime.datetime(2024, 3, 1, tzinfo=datetime.timezone.utc)
+    tb = pa.table({
+        "ts": pa.array([base, None, base], type=pa.timestamp("us",
+                                                             tz="UTC")),
+        "v": pa.array([1, 2, 4], type=pa.int64())})
+    out = (s.create_dataframe(tb)
+           .group_by(F.window(col("ts"), "10 minutes").alias("w"))
+           .agg(F.sum(col("v")).alias("s")).collect())
+    got = {(w is None): sv for w, sv in
+           zip(out.column("w").to_pylist(), out.column("s").to_pylist())}
+    assert got[True] == 2     # the null-ts row groups under the null key
+    assert got[False] == 5
