@@ -117,10 +117,14 @@ class TpuSession:
         return DataFrameReader(self)
 
     # -- execution ----------------------------------------------------------
-    def prepare_plan(self, lp: L.LogicalPlan):
+    def prepare_plan(self, lp: L.LogicalPlan, run_subqueries: bool = True):
         """Logical plan -> final physical plan: dialect install, scalar
         subqueries, planning, overrides — the shared front half of
-        execute()/explain()/ml.device_batches."""
+        execute()/explain()/ml.device_batches.
+
+        run_subqueries=False (explain) substitutes subqueries with typed
+        null placeholders instead of EXECUTING them: printing a plan must
+        never run device work (ref explain stays driver-side)."""
         from ..expr.subquery import (has_scalar_subquery,
                                      resolve_scalar_subqueries)
         from ..shims import set_active_shim
@@ -132,7 +136,8 @@ class TpuSession:
         if has_scalar_subquery(lp):
             # subqueries run first, driver-side, and substitute as typed
             # literals (ref GpuScalarSubquery / ExecSubqueryExpression)
-            lp = resolve_scalar_subqueries(lp, self)
+            lp = resolve_scalar_subqueries(lp, self,
+                                           execute=run_subqueries)
         physical = plan_physical(lp, self.conf)
         from ..plan.planner import force_perfile_if_input_file
         force_perfile_if_input_file(physical)
@@ -161,13 +166,36 @@ class TpuSession:
         from ..plugin import ExecutionPlanCaptureCallback
         ExecutionPlanCaptureCallback.on_plan(final_plan)
         ctx = ExecContext(self.conf)
+        from ..memory.spill import SpillCatalog
+        debug = self.conf.get(cfg.MEMORY_DEBUG)
+        cat = SpillCatalog.get()
+        if debug:
+            cat.debug = True
+            before = {b_id for b_id, *_ in cat.leak_report()}
         try:
-            return final_plan.execute_collect(ctx)
-        finally:
+            result = final_plan.execute_collect(ctx)
+        except BaseException:
+            # an aborted query routinely strands buffers; the original
+            # error must surface, not a misleading leak report
             self.release_plan_shuffles(final_plan)
+            if debug:
+                cat.debug = False
+            raise
+        self.release_plan_shuffles(final_plan)
+        if debug:
+            leaks = [l for l in cat.leak_report() if l[0] not in before]
+            cat.debug = False
+            if leaks:
+                detail = "\n---\n".join(
+                    f"{i} tier={t_} bytes={b}\n{st}"
+                    for i, t_, b, st in leaks)
+                raise RuntimeError(
+                    f"query leaked {len(leaks)} spillable "
+                    f"buffer(s) (memory.tpu.debug):\n{detail}")
+        return result
 
     def explain(self, lp: L.LogicalPlan) -> str:
-        final_plan = self.prepare_plan(lp)
+        final_plan = self.prepare_plan(lp, run_subqueries=False)
         return final_plan.tree_string() + "\n--\n" + self.last_explain
 
 

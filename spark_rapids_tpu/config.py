@@ -253,7 +253,10 @@ SPILL_DEVICE_BUDGET = conf("spark.rapids.memory.tpu.spillBudgetBytes").bytes() \
     .create_optional()
 
 MEMORY_DEBUG = conf("spark.rapids.memory.tpu.debug").boolean() \
-    .doc("Track allocations for leak diagnostics (ref RapidsConf.scala:307).") \
+    .doc("Track the creation stack of every registered spillable buffer "
+         "and fail queries that leak unclosed buffers (ref "
+         "spark.rapids.memory.gpu.debug RapidsConf.scala:307 + the "
+         "Arm.scala RAII discipline).  Diagnostics only.") \
     .create_with_default(False)
 
 UNSPILL_ENABLED = conf("spark.rapids.memory.tpu.unspill.enabled").boolean() \

@@ -635,6 +635,8 @@ class CpuHashAggregateExec(Exec):
                 group_cols.append(f"__{nm}__null")
             else:
                 group_cols.append(nm)
+        from ..shims import active_shim
+        legacy_stat = active_shim().legacy_statistical_aggregate()
         aggs = []
         for i, ae in enumerate(self.aggregates):
             kind = _PA_AGG[type(ae.func)]
@@ -642,6 +644,11 @@ class CpuHashAggregateExec(Exec):
             if kind in ("stddev", "variance"):
                 ddof = 0 if isinstance(ae.func, (StddevPop, VariancePop)) else 1
                 opts = pc.VarianceOptions(ddof=ddof)
+                if legacy_stat:
+                    # 3.0 dialect needs the group's row count to turn
+                    # divide-by-zero nulls into NaN (same rule as the
+                    # TPU path's _MomentAgg._var)
+                    aggs.append((f"__in{i}", "count", None))
             if kind in ("first", "last"):
                 skip = True if isinstance(ae.func, PivotFirst) \
                     else ae.func.ignore_nulls
@@ -695,6 +702,14 @@ class CpuHashAggregateExec(Exec):
             kind = _PA_AGG[type(ae.func)]
             cname = f"__in{i}_{kind}"
             col = res.column(cname)
+            if legacy_stat and kind in ("stddev", "variance"):
+                import math
+                counts = res.column(f"__in{i}_count").to_pylist()
+                vals = [v if v is not None else
+                        (float("nan") if (n or 0) > 0 else None)
+                        for v, n in zip(col.to_pylist(), counts)]
+                col = pa.chunked_array([pa.array(vals,
+                                                 type=pa.float64())])
             if isinstance(ae.func, ApproximatePercentile):
                 p = ae.func.percentage
                 vals = []

@@ -47,14 +47,17 @@ class ScalarSubquery(Expression):
         return "scalar_subquery(...)"
 
 
-def resolve_scalar_subqueries(lp, session):
+def resolve_scalar_subqueries(lp, session, execute: bool = True):
     """Replace every ScalarSubquery in the plan's expression trees with
-    the executed literal value.  Raises if a subquery yields != 1 row
-    (Spark's runtime error for scalar subqueries)."""
+    the executed literal value (execute=False substitutes typed null
+    placeholders — the explain path must not run device work).  Raises
+    if a subquery yields != 1 row (Spark's runtime error)."""
 
     def resolve_expr(e: Expression) -> Expression:
         def fn(x):
             if isinstance(x, ScalarSubquery):
+                if not execute:
+                    return Literal(None, x.data_type())
                 out = session.execute(x.lp)
                 if out.num_columns < 1 or out.num_rows != 1:
                     raise ValueError(
@@ -66,37 +69,79 @@ def resolve_scalar_subqueries(lp, session):
             if isinstance(x, WindowExpression):
                 # the window spec's keys live outside the children tuple
                 import copy
-                spec = copy.copy(x.spec)
-                spec.partition_by = [resolve_expr(p)
-                                     for p in spec.partition_by]
-                spec.order_by = [
-                    (resolve_expr(o[0]),) + tuple(o[1:])
-                    if isinstance(o, tuple) else resolve_expr(o)
-                    for o in spec.order_by]
-                x = copy.copy(x)
-                x.spec = spec
+                new_pb = [resolve_expr(p) for p in x.spec.partition_by]
+                new_ob = [(resolve_expr(o[0]),) + tuple(o[1:])
+                          if isinstance(o, tuple) else resolve_expr(o)
+                          for o in x.spec.order_by]
+                changed = any(a is not b for a, b in
+                              zip(new_pb, x.spec.partition_by)) or \
+                    any((a[0] if isinstance(a, tuple) else a) is not
+                        (b[0] if isinstance(b, tuple) else b)
+                        for a, b in zip(new_ob, x.spec.order_by))
+                if changed:
+                    spec = copy.copy(x.spec)
+                    spec.partition_by = new_pb
+                    spec.order_by = new_ob
+                    x = copy.copy(x)
+                    x.spec = spec
             return x
         return e.transform_up(fn)
 
     def walk(node):
-        node.children = tuple(walk(c) for c in node.children)
+        """Copy-on-write: the caller's logical plan must stay intact —
+        explain substitutes placeholders, and a re-collect must re-run
+        subqueries against current data, not a frozen literal."""
+        import copy
+        new_children = tuple(walk(c) for c in node.children)
+        new_attrs = {}
         for attr in _EXPR_ATTRS:
             v = getattr(node, attr, None)
             if v is None:
                 continue
-            setattr(node, attr, _map_expr_container(v, resolve_expr))
+            nv = _map_expr_container(v, resolve_expr)
+            if not _same_exprs(v, nv):
+                new_attrs[attr] = nv
+        changed = new_attrs or any(
+            a is not b for a, b in zip(new_children, node.children))
+        if not changed:
+            return node
+        node = copy.copy(node)
+        node.children = new_children
+        for attr, nv in new_attrs.items():
+            setattr(node, attr, nv)
         return node
 
     return walk(lp)
+
+
+def _same_exprs(a, b) -> bool:
+    """Identity comparison through nested containers (resolve rebuilds
+    containers even when nothing changed inside)."""
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return len(a) == len(b) and all(_same_exprs(x, y)
+                                        for x, y in zip(a, b))
+    return a is b
 
 
 def has_scalar_subquery(lp) -> bool:
     found = []
 
     def check_expr(e):
-        if isinstance(e, Expression):
-            if e.collect(lambda x: isinstance(x, ScalarSubquery)):
-                found.append(True)
+        if not isinstance(e, Expression):
+            return
+        if e.collect(lambda x: isinstance(x, ScalarSubquery)):
+            found.append(True)
+        # window specs keep their keys outside the children tuple
+        from .window import WindowExpression
+        for w in [e] + e.collect(
+                lambda x: isinstance(x, WindowExpression)):
+            spec = getattr(w, "spec", None)
+            if spec is None:
+                continue
+            for p in spec.partition_by:
+                check_expr(p)
+            for o in spec.order_by:
+                check_expr(o[0] if isinstance(o, tuple) else o)
 
     def scan(v):
         if isinstance(v, Expression):

@@ -56,7 +56,10 @@ def batch_device_bytes(batch: DeviceBatch) -> int:
 
 class SpillableBatch:
     """A batch that can move down the storage tiers and come back
-    (ref SpillableColumnarBatch.scala:29-230)."""
+    (ref SpillableColumnarBatch.scala:29-230).  Supports `with` blocks —
+    the Arm.scala withResource discipline: the reference leans on RAII +
+    refcount asserts to catch leaks; here the context manager plus the
+    catalog's debug leak tracker play that role."""
 
     def __init__(self, batch: DeviceBatch, catalog: "SpillCatalog",
                  priority: int = SpillPriority.ACTIVE):
@@ -67,6 +70,7 @@ class SpillableBatch:
         self._batch: Optional[DeviceBatch] = batch
         self._host_bytes: Optional[bytes] = None
         self._disk_path: Optional[str] = None
+        self.closed = False
         self.device_bytes = batch_device_bytes(batch)
         # num_rows may be a traced device scalar; resolving it here would
         # force a sync per registered batch — defer to first read
@@ -134,6 +138,7 @@ class SpillableBatch:
         return len(self._host_bytes) if self._host_bytes else 0
 
     def close(self):
+        self.closed = True
         self.catalog.unregister(self)
         self._batch = None
         self._host_bytes = None
@@ -142,6 +147,12 @@ class SpillableBatch:
                 os.unlink(self._disk_path)
             except OSError:
                 pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 class SpillCatalog:
@@ -173,6 +184,11 @@ class SpillCatalog:
         self.spilled_to_host_bytes = 0
         self.spilled_to_disk_bytes = 0
         self.pinned_evicted_bytes = 0
+        # debug leak tracking (ref spark.rapids.memory.gpu.debug,
+        # RapidsConf.scala:307 + Arm.scala's leak discipline): record
+        # where every live buffer was registered
+        self.debug = False
+        self._created_at: Dict[str, str] = {}
 
     @classmethod
     def get(cls) -> "SpillCatalog":
@@ -204,12 +220,26 @@ class SpillCatalog:
         sb = SpillableBatch(batch, self, priority)
         with self._reg_lock:
             self._buffers[sb.id] = sb
+            if self.debug:
+                import traceback
+                self._created_at[sb.id] = "".join(
+                    traceback.format_stack(limit=8)[:-1])
         self.maybe_spill()
         return sb
 
     def unregister(self, sb: SpillableBatch):
         with self._reg_lock:
             self._buffers.pop(sb.id, None)
+            self._created_at.pop(sb.id, None)
+
+    def leak_report(self) -> List[tuple]:
+        """(id, tier, bytes, creation_stack) for every still-open
+        buffer — the debug-mode leak check (Arm.scala analog)."""
+        with self._reg_lock:
+            return [(b.id, b.tier.name, b.device_bytes,
+                     self._created_at.get(b.id, "(enable debug for "
+                     "stacks)"))
+                    for b in self._buffers.values()]
 
     # -- pinned scan batches -------------------------------------------------
     def register_pinned(self, owner: Dict, key, batch_list) -> None:

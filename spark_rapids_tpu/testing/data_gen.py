@@ -119,16 +119,109 @@ class DoubleGen(FloatGen):
 
 
 class StringGen(DataGen):
-    def __init__(self, alphabet: str = string.ascii_letters + string.digits +
+    """Strings from an alphabet OR sampled from a regex pattern — the
+    reference generates pattern strings with sre_yield
+    (ref data_gen.py:153 `StringGen(pattern)`); here a sampler walks
+    Python's own sre parse tree, so any stdlib-`re` pattern works.
+    Special cases cover empty and UTF-8 multibyte edges by default."""
+
+    def __init__(self, pattern: Optional[str] = None,
+                 alphabet: str = string.ascii_letters + string.digits +
                  " _-", max_len: int = 20, **kw):
         super().__init__(t.STRING, **kw)
         self.alphabet = alphabet
         self.max_len = max_len
+        self._parsed = None
+        if pattern is not None:
+            import re
+            parser = getattr(re, "_parser", None)
+            if parser is None:  # pragma: no cover - pre-3.11 stdlib
+                import sre_parse as parser
+            self._parsed = parser.parse(pattern)
         self.with_special_case("")
+        self.with_special_case("\u00e9\u4e2d\U0001F600")  # 2/3/4-byte UTF-8
 
     def _gen_value(self, rng):
+        if self._parsed is not None:
+            return _sample_sre(self._parsed, rng)
         n = rng.randint(0, self.max_len)
         return "".join(rng.choice(self.alphabet) for _ in range(n))
+
+
+_SRE_CATEGORIES = {
+    "category_digit": string.digits,
+    "category_not_digit": string.ascii_letters + "_ ",
+    "category_word": string.ascii_letters + string.digits + "_",
+    "category_not_word": " .,;-",
+    "category_space": " \t",
+    "category_not_space": string.ascii_letters + string.digits,
+}
+_MAX_REPEAT_SAMPLE = 8
+
+
+def _sample_sre(parsed, rng: random.Random) -> str:
+    """Generate one string matching a parsed stdlib-re pattern (the
+    constructs the reference's test patterns use: literals, sets,
+    ranges, categories, branches, groups, repeats, dot, anchors)."""
+    out = []
+    for op, arg in parsed:
+        name = str(op).lower().split(".")[-1]
+        if name == "literal":
+            out.append(chr(arg))
+        elif name == "not_literal":
+            c = rng.choice(string.ascii_letters + string.digits)
+            out.append(c if ord(c) != arg else "x")
+        elif name == "any":
+            out.append(rng.choice(string.ascii_letters + string.digits +
+                                  " _-"))
+        elif name == "in":
+            out.append(_sample_in(arg, rng))
+        elif name == "branch":
+            _, branches = arg
+            out.append(_sample_sre(rng.choice(branches), rng))
+        elif name == "subpattern":
+            out.append(_sample_sre(arg[3], rng))
+        elif name in ("max_repeat", "min_repeat"):
+            lo, hi, sub = arg
+            hi = min(hi, lo + _MAX_REPEAT_SAMPLE)
+            for _ in range(rng.randint(lo, hi)):
+                out.append(_sample_sre(sub, rng))
+        elif name == "at":
+            pass  # anchors generate nothing
+        elif name == "category":
+            out.append(rng.choice(_SRE_CATEGORIES[
+                str(arg).lower().split(".")[-1]]))
+        else:
+            raise ValueError(f"regex construct {name!r} not supported "
+                             f"by the pattern sampler")
+    return "".join(out)
+
+
+def _sample_in(items, rng: random.Random) -> str:
+    negated = any(str(op).lower().endswith("negate") for op, _ in items)
+    if negated:
+        member = set()
+        for op, arg in items:
+            name = str(op).lower().split(".")[-1]
+            if name == "literal":
+                member.add(chr(arg))
+            elif name == "range":
+                member |= {chr(c) for c in range(arg[0], arg[1] + 1)}
+        pool = [c for c in (string.ascii_letters + string.digits + " _-")
+                if c not in member]
+        return rng.choice(pool or ["x"])
+    choices = []
+    for op, arg in items:
+        name = str(op).lower().split(".")[-1]
+        if name == "literal":
+            choices.append(chr(arg))
+        elif name == "range":
+            lo, hi = arg
+            choices.append(chr(rng.randint(lo, hi)))
+        elif name == "category":
+            choices.append(rng.choice(_SRE_CATEGORIES[
+                str(arg).lower().split(".")[-1]]))
+    return rng.choice(choices) if choices else "x"
 
 
 class DecimalGen(DataGen):
@@ -184,6 +277,31 @@ class StructGen(DataGen):
 
     def _gen_value(self, rng):
         return {n: g.gen(rng) for n, g in self.fields}
+
+
+def nested_gen(rng_or_seed=0, max_depth: int = 3,
+               leaf_gens: Optional[List[DataGen]] = None,
+               depth_weight: float = 0.5) -> DataGen:
+    """Randomly composed nested generator with weighted depth: at each
+    level the chance of nesting deeper decays by `depth_weight` — the
+    reference's weighted-choice nested map/struct depth control
+    (ref data_gen.py nested gen construction)."""
+    rng = rng_or_seed if isinstance(rng_or_seed, random.Random) \
+        else random.Random(rng_or_seed)
+    leaves = leaf_gens or [IntegerGen(), LongGen(), DoubleGen(),
+                           StringGen(), BooleanGen()]
+
+    def build(depth: int) -> DataGen:
+        if depth >= max_depth or rng.random() > depth_weight ** depth:
+            return rng.choice(leaves)
+        kind = rng.choice(["array", "struct"])
+        if kind == "array":
+            return ArrayGen(build(depth + 1))
+        n = rng.randint(1, 3)
+        return StructGen([(f"f{i}", build(depth + 1))
+                          for i in range(n)])
+
+    return build(0)
 
 
 # standard generator sets (mirrors data_gen.py's canonical lists)

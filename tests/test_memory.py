@@ -214,3 +214,40 @@ def test_pinned_scan_cache_counts_and_evicts():
     assert ("k", 0) not in owner          # entry dropped from the cache
     assert cat.pinned_bytes() == 0
     assert cat.pinned_evicted_bytes > 0
+
+
+def test_leak_tracker_clean_query_and_detects_leak():
+    """Arm.scala-style leak discipline: debug mode records creation
+    stacks and a clean query leaks nothing; an unclosed buffer is
+    reported with its origin."""
+    import numpy as _np
+    import pyarrow as _pa
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.columnar.device import DeviceBatch, DeviceColumn
+    from spark_rapids_tpu import types as _t
+
+    s = (TpuSession.builder()
+         .config("spark.rapids.sql.enabled", True)
+         .config("spark.rapids.memory.tpu.debug", True).get_or_create())
+    tb = _pa.table({"k": _pa.array([1, 2, 1], type=_pa.int64()),
+                    "v": _pa.array([1.0, 2.0, 3.0])})
+    out = (s.create_dataframe(tb).group_by(col("k"))
+           .agg(F.sum(col("v")).alias("sv"))
+           .collect())          # must not raise: all buffers closed
+    assert out.num_rows == 2
+
+    cat = SpillCatalog.get()
+    cat.debug = True
+    col0 = DeviceColumn(_t.LONG, data=_np.zeros(8, _np.int64),
+                        validity=_np.ones(8, bool))
+    sb = cat.register(DeviceBatch([col0], 8, ["x"]))
+    report = [l for l in cat.leak_report() if l[0] == sb.id]
+    assert report and "register" in report[0][3]
+    with sb:            # withResource-style close
+        pass
+    assert sb.closed
+    assert not [l for l in cat.leak_report() if l[0] == sb.id]
+    cat.debug = False

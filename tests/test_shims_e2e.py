@@ -32,12 +32,14 @@ def _stddev_single_rows(version: str, enabled: bool):
 
 
 def test_legacy_statistical_aggregate_dialect():
-    """3.0: stddev of a single-row group is NaN; 3.1+: null."""
-    for enabled in (True,):
+    """3.0: stddev of a single-row group is NaN; 3.1+: null — on BOTH
+    engines (the CPU oracle consults the shim too)."""
+    for enabled in (True, False):
         legacy = _stddev_single_rows("3.0.1", enabled)
         modern = _stddev_single_rows("3.2.0", enabled)
-        assert all(v is not None and math.isnan(v) for v in legacy), legacy
-        assert modern == [None, None], modern
+        assert all(v is not None and math.isnan(v) for v in legacy), \
+            (enabled, legacy)
+        assert modern == [None, None], (enabled, modern)
 
 
 def _cast_unpadded_date(version: str):

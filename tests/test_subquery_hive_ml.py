@@ -129,3 +129,52 @@ def test_struct_key_null_distinct_from_null_fields_cpu():
            zip(out.column("w").to_pylist(), out.column("s").to_pylist())}
     assert got[True] == 2     # the null-ts row groups under the null key
     assert got[False] == 5
+
+
+def test_explain_does_not_execute_subqueries_or_mutate_plan():
+    """explain() substitutes placeholders without running the subquery,
+    and a later collect() still resolves the REAL value (code-review
+    round-3 findings: explain side effects + in-place plan mutation)."""
+    s = _session()
+    tb = pa.table({"v": pa.array([1, 2, 3], type=pa.int64())})
+    df = s.create_dataframe(tb)
+    calls = []
+    orig = type(s).execute
+
+    sub = df.agg(F.max(col("v")).alias("m"))
+    q = df.filter(col("v") >= F.scalar_subquery(sub))
+
+    import spark_rapids_tpu.api.session as sess_mod
+    real_execute = sess_mod.TpuSession.execute
+
+    def counting(self, lp):
+        calls.append(lp)
+        return real_execute(self, lp)
+
+    sess_mod.TpuSession.execute = counting
+    try:
+        s.explain(q._lp)
+        assert calls == []          # explain ran NO subquery
+        out = q.collect()
+    finally:
+        sess_mod.TpuSession.execute = real_execute
+    assert out.column("v").to_pylist() == [3]   # real value resolved
+    # and the plan object still carries the subquery for future runs
+    from spark_rapids_tpu.expr.subquery import has_scalar_subquery
+    assert has_scalar_subquery(q._lp)
+
+
+def test_subquery_in_window_partition_keys():
+    s = _session()
+    tb = pa.table({"k": pa.array([1, 1, 2], type=pa.int64()),
+                   "v": pa.array([5, 7, 9], type=pa.int64())})
+    df = s.create_dataframe(tb)
+    one = df.agg(F.min(col("v")).alias("m"))   # 5
+    from spark_rapids_tpu.expr.window import WindowBuilder
+    w = (WindowBuilder()
+         .partition_by((col("k") * 0 + F.scalar_subquery(one)))
+         .order_by(col("v")))
+    out = df.select(col("v"), F.row_number().over(w).alias("rn")) \
+        .collect()
+    # one partition (constant key) -> row numbers 1..3
+    assert sorted(out.column("rn").to_pylist()) == [1, 2, 3]

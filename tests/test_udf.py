@@ -271,3 +271,77 @@ def test_udf_decorator_with_positional_return_type():
     assert_tpu_and_cpu_are_equal(
         lambda s: s.create_dataframe(_table())
                    .select(plus2(col("a")).alias("r")))
+
+
+def test_identical_lambdas_share_jit_cache_entry():
+    """A re-created but bytecode-identical UDF must HIT the process jit
+    cache — a fresh trace costs minutes on a remote-compile TPU
+    (round-2 verdict weak #7)."""
+    import pyarrow as pa
+
+    from spark_rapids_tpu import types as t
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.exec.base import jit_cache_size
+
+    s = TpuSession.builder().config("spark.rapids.sql.enabled",
+                                    True).get_or_create()
+    tb = pa.table({"v": pa.array([1, 2, 3], type=pa.int64())})
+    df = s.create_dataframe(tb)
+
+    def make_query():
+        # a FRESH lambda object each call, same bytecode
+        u = F.udf(lambda x: x * 2 + 1, t.LONG)
+        return df.select(u(col("v")).alias("y"))
+
+    out1 = make_query().collect()
+    n_after_first = jit_cache_size()
+    out2 = make_query().collect()
+    assert jit_cache_size() == n_after_first   # no re-trace
+    assert out1.column("y").to_pylist() == out2.column("y").to_pylist() \
+        == [3, 5, 7]
+
+    # different bytecode still misses (correctness over reuse)
+    u3 = F.udf(lambda x: x * 3, t.LONG)
+    out3 = df.select(u3(col("v")).alias("y")).collect()
+    assert out3.column("y").to_pylist() == [3, 6, 9]
+
+    # different CLOSURE VALUES miss too
+    def make_closure(k):
+        u = F.udf(lambda x: x + k, t.LONG)
+        return df.select(u(col("v")).alias("y")).collect()
+
+    assert make_closure(10).column("y").to_pylist() == [11, 12, 13]
+    assert make_closure(20).column("y").to_pylist() == [21, 22, 23]
+
+
+_GLOBAL_K = 10
+
+
+def test_udf_global_value_change_misses_cache():
+    """A UDF reading a module global must NOT hit a kernel traced under
+    a different global value (code-review round-3 finding: wrong hits
+    are never acceptable)."""
+    import pyarrow as pa
+
+    from spark_rapids_tpu import types as t
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.session import TpuSession
+
+    global _GLOBAL_K
+    s = TpuSession.builder().config("spark.rapids.sql.enabled",
+                                    True).get_or_create()
+    tb = pa.table({"v": pa.array([1, 2], type=pa.int64())})
+    df = s.create_dataframe(tb)
+
+    def make():
+        u = F.udf(lambda x: x + _GLOBAL_K, t.LONG)
+        return df.select(u(col("v")).alias("y")).collect()
+
+    _GLOBAL_K = 10
+    assert make().column("y").to_pylist() == [11, 12]
+    _GLOBAL_K = 20
+    assert make().column("y").to_pylist() == [21, 22]
+    _GLOBAL_K = 10
