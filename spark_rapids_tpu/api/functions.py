@@ -532,3 +532,69 @@ def scalar_subquery(df) -> Column:
     value substitutes as a typed literal)."""
     from ..expr.subquery import ScalarSubquery
     return _c(ScalarSubquery(df._lp))
+
+
+def bitwise_not(c) -> Column:
+    from ..expr.bitwise import BitwiseNot
+    return _c(BitwiseNot(_expr(c)))
+
+
+def shiftleft(c, n) -> Column:
+    from ..expr.bitwise import ShiftLeft
+    return _c(ShiftLeft(_expr(c), _expr(n)))
+
+
+def shiftright(c, n) -> Column:
+    from ..expr.bitwise import ShiftRight
+    return _c(ShiftRight(_expr(c), _expr(n)))
+
+
+def shiftrightunsigned(c, n) -> Column:
+    from ..expr.bitwise import ShiftRightUnsigned
+    return _c(ShiftRightUnsigned(_expr(c), _expr(n)))
+
+
+def cot(c) -> Column:
+    from ..expr.mathexpr import Cot
+    return _c(Cot(_expr(c)))
+
+
+def asinh(c) -> Column:
+    from ..expr.mathexpr import Asinh
+    return _c(Asinh(_expr(c)))
+
+
+def acosh(c) -> Column:
+    from ..expr.mathexpr import Acosh
+    return _c(Acosh(_expr(c)))
+
+
+def atanh(c) -> Column:
+    from ..expr.mathexpr import Atanh
+    return _c(Atanh(_expr(c)))
+
+
+def log_base(base, c) -> Column:
+    """log(base, x) (Spark's two-argument log)."""
+    from ..expr.mathexpr import Logarithm
+    return _c(Logarithm(_expr(base), _expr(c)))
+
+
+def ascii(c) -> Column:
+    from ..expr.strings import Ascii
+    return _c(Ascii(_expr(c)))
+
+
+def bitwise_and(a, b) -> Column:
+    from ..expr.bitwise import BitwiseAnd
+    return _c(BitwiseAnd(_expr(a), _expr(b)))
+
+
+def bitwise_or(a, b) -> Column:
+    from ..expr.bitwise import BitwiseOr
+    return _c(BitwiseOr(_expr(a), _expr(b)))
+
+
+def bitwise_xor(a, b) -> Column:
+    from ..expr.bitwise import BitwiseXor
+    return _c(BitwiseXor(_expr(a), _expr(b)))

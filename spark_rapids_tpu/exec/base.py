@@ -130,28 +130,45 @@ def semantic_sig(v) -> object:
 
 _SIG_SIMPLE = (str, bytes, int, float, bool, type(None), complex)
 
+# distinct sentinel: None is a perfectly common captured VALUE
+# (def f(x, y=None)) and must not read as "unsignable"
+_UNSIGNABLE = object()
 
-def _value_sig_or_none(x):
-    """Content signature for a captured value, or None when no stable
-    one exists (unknown objects / huge arrays would otherwise alias)."""
+
+def _value_sig(x):
+    """Content signature for a captured value, or _UNSIGNABLE when no
+    stable one exists (unknown objects / huge arrays would alias)."""
     import types as _pytypes
     if isinstance(x, _SIG_SIMPLE):
-        return x
+        return ("v", x)
     if isinstance(x, (np.integer, np.floating, np.bool_)):
-        return x.item()
+        return ("v", x.item())
     if isinstance(x, _pytypes.ModuleType):
         # module bindings are stable per process; key by name
         return ("module", x.__name__)
+    if isinstance(x, _pytypes.CodeType):
+        return _code_sig(x)
     if isinstance(x, (np.ndarray, jnp.ndarray)):
         a = np.asarray(x)
         if a.nbytes <= (1 << 16):
             return ("arr", a.dtype.str, a.shape, a.tobytes())
-        return None
+        return _UNSIGNABLE
     if isinstance(x, (tuple, list)):
-        parts = tuple(_value_sig_or_none(i) for i in x)
-        return None if any(p is None for p in parts) \
+        parts = tuple(_value_sig(i) for i in x)
+        return _UNSIGNABLE if any(p is _UNSIGNABLE for p in parts) \
             else (type(x).__name__,) + parts
-    return None
+    return _UNSIGNABLE
+
+
+def _code_sig(code):
+    """Recursive code-object signature: co_consts may hold NESTED code
+    objects (inner lambdas/genexps) whose repr would embed memory
+    addresses — recurse instead."""
+    consts = tuple(_value_sig(c) for c in code.co_consts)
+    if any(c is _UNSIGNABLE for c in consts):
+        return _UNSIGNABLE
+    return ("code", code.co_code, consts, code.co_names,
+            code.co_varnames, code.co_freevars)
 
 
 def _function_sig(fn):
@@ -161,39 +178,43 @@ def _function_sig(fn):
     target = fn
     bound_self = getattr(fn, "__self__", None)
     if bound_self is not None:
-        s = _value_sig_or_none(bound_self)
-        if s is None:
+        s = _value_sig(bound_self)
+        if s is _UNSIGNABLE:
             return None
         self_sig = ("self", s)
         target = fn.__func__
     code = getattr(target, "__code__", None)
     if code is None:
         return None
+    csig = _code_sig(code)
+    if csig is _UNSIGNABLE:
+        return None
     captures = []
     cells = getattr(target, "__closure__", None)
     if cells:
         for c in cells:
             try:
-                s = _value_sig_or_none(c.cell_contents)
+                s = _value_sig(c.cell_contents)
             except ValueError:   # empty cell
                 s = ("emptycell",)
-            if s is None:
+            if s is _UNSIGNABLE:
                 return None
             captures.append(s)
     gl = getattr(target, "__globals__", {})
     for name in code.co_names:
         if name in gl:
-            s = _value_sig_or_none(gl[name])
-            if s is None:
+            s = _value_sig(gl[name])
+            if s is _UNSIGNABLE:
                 return None
             captures.append((name, s))
         else:
             captures.append((name, "builtin"))
-    defaults = _value_sig_or_none(getattr(target, "__defaults__", None))
-    if defaults is None and getattr(target, "__defaults__", None) is not None:
+    defaults = _value_sig(getattr(target, "__defaults__", None))
+    kwdefaults = _value_sig(getattr(target, "__kwdefaults__", None))
+    if defaults is _UNSIGNABLE or kwdefaults is _UNSIGNABLE:
         return None
-    return ("pyfn", code.co_code, repr(code.co_consts),
-            tuple(captures), defaults, self_sig)
+    return ("pyfn", csig, tuple(captures), defaults, kwdefaults,
+            self_sig)
 def schema_sig(node: "Exec") -> tuple:
     return tuple(zip(node.output_names, map(repr, node.output_types)))
 

@@ -1,0 +1,144 @@
+"""Bitwise expressions (ref org/apache/spark/sql/rapids/bitwise.scala:
+GpuBitwiseAnd/Or/Xor/Not, GpuShiftLeft/Right/RightUnsigned, registered
+at GpuOverrides.scala bitwise rules).
+
+TPU realization: straight elementwise integer ops — XLA fuses them into
+surrounding kernels.  Shift distances follow Java semantics (masked by
+the value width), matching Spark."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as t
+from .core import (EvalContext, Expression, and_validity, data_of,
+                   evaluator, make_column, validity_of)
+
+
+_INT_WIDTH = {t.ByteType: 1, t.ShortType: 2, t.IntegerType: 4,
+              t.LongType: 8}
+
+
+def _width(dt) -> int:
+    return _INT_WIDTH.get(type(dt), 8)
+
+
+class _BitwiseBinary(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def data_type(self):
+        lt = self.children[0].data_type()
+        rt = self.children[1].data_type()
+        return lt if _width(lt) >= _width(rt) else rt
+
+
+def _binary_ints(e, ctx):
+    lv = e.children[0].eval(ctx)
+    rv = e.children[1].eval(ctx)
+    out_t = e.data_type()
+    np_t = t.to_np_dtype(out_t)
+    l = data_of(lv, ctx).astype(np_t)
+    r = data_of(rv, ctx).astype(np_t)
+    val = and_validity(ctx, validity_of(lv, ctx), validity_of(rv, ctx))
+    return l, r, out_t, val
+
+
+class BitwiseAnd(_BitwiseBinary):
+    pass
+
+
+class BitwiseOr(_BitwiseBinary):
+    pass
+
+
+class BitwiseXor(_BitwiseBinary):
+    pass
+
+
+@evaluator(BitwiseAnd)
+def _eval_band(e, ctx: EvalContext):
+    l, r, out_t, val = _binary_ints(e, ctx)
+    return make_column(ctx, out_t, l & r, val)
+
+
+@evaluator(BitwiseOr)
+def _eval_bor(e, ctx: EvalContext):
+    l, r, out_t, val = _binary_ints(e, ctx)
+    return make_column(ctx, out_t, l | r, val)
+
+
+@evaluator(BitwiseXor)
+def _eval_bxor(e, ctx: EvalContext):
+    l, r, out_t, val = _binary_ints(e, ctx)
+    return make_column(ctx, out_t, l ^ r, val)
+
+
+class BitwiseNot(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def data_type(self):
+        return self.children[0].data_type()
+
+
+@evaluator(BitwiseNot)
+def _eval_bnot(e, ctx: EvalContext):
+    v = e.children[0].eval(ctx)
+    return make_column(ctx, e.data_type(), ~data_of(v, ctx),
+                       validity_of(v, ctx))
+
+
+class _Shift(Expression):
+    """value SHIFT amount; Java masks the shift distance by width-1."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def data_type(self):
+        dt = self.children[0].data_type()
+        return dt if isinstance(dt, t.LongType) else t.INT
+
+
+def _shift_operands(e, ctx):
+    lv = e.children[0].eval(ctx)
+    rv = e.children[1].eval(ctx)
+    out_t = e.data_type()
+    np_t = t.to_np_dtype(out_t)
+    width = 64 if isinstance(out_t, t.LongType) else 32
+    l = data_of(lv, ctx).astype(np_t)
+    sh = (data_of(rv, ctx).astype(np.int64) & (width - 1)).astype(np_t)
+    val = and_validity(ctx, validity_of(lv, ctx), validity_of(rv, ctx))
+    return l, sh, out_t, np_t, val
+
+
+class ShiftLeft(_Shift):
+    pass
+
+
+class ShiftRight(_Shift):
+    pass
+
+
+class ShiftRightUnsigned(_Shift):
+    pass
+
+
+@evaluator(ShiftLeft)
+def _eval_shl(e, ctx: EvalContext):
+    l, sh, out_t, np_t, val = _shift_operands(e, ctx)
+    return make_column(ctx, out_t, l << sh, val)
+
+
+@evaluator(ShiftRight)
+def _eval_shr(e, ctx: EvalContext):
+    l, sh, out_t, np_t, val = _shift_operands(e, ctx)
+    return make_column(ctx, out_t, l >> sh, val)   # arithmetic (signed)
+
+
+@evaluator(ShiftRightUnsigned)
+def _eval_shru(e, ctx: EvalContext):
+    l, sh, out_t, np_t, val = _shift_operands(e, ctx)
+    u_t = np.uint64 if np_t == np.int64 else np.uint32
+    out = (l.view(u_t) >> sh.view(u_t)).view(np_t)   # logical shift
+    return make_column(ctx, out_t, out, val)

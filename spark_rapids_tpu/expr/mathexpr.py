@@ -60,6 +60,53 @@ Cbrt = _simple("Cbrt", "cbrt")
 Rint = _simple("Rint", "rint")
 ToDegrees = _simple("ToDegrees", "degrees")
 ToRadians = _simple("ToRadians", "radians")
+Asinh = _simple("Asinh", "arcsinh")
+Acosh = _simple("Acosh", "arccosh")
+Atanh = _simple("Atanh", "arctanh")
+
+
+class Cot(UnaryMath):
+    """cot(x) = 1/tan(x) (Spark returns inf at 0 like 1/tan)."""
+
+
+@evaluator(Cot)
+def _eval_cot(e: Cot, ctx: EvalContext):
+    d, val = _unary_double(e, ctx)
+    with np.errstate(divide="ignore"):   # cot(0) = inf, like Spark
+        out = 1.0 / ctx.xp.tan(d)
+    return make_column(ctx, t.DOUBLE, out, val)
+
+
+class Logarithm(Expression):
+    """log(base, x); NULL for x <= 0 or base <= 0 (Spark)."""
+
+    def __init__(self, base: Expression, child: Expression):
+        self.children = (base, child)
+
+    def data_type(self):
+        return t.DOUBLE
+
+    def sql(self):
+        return (f"log({self.children[0].sql()}, "
+                f"{self.children[1].sql()})")
+
+
+@evaluator(Logarithm)
+def _eval_logarithm(e: Logarithm, ctx: EvalContext):
+    xp = ctx.xp
+    bv = e.children[0].eval(ctx)
+    xv = e.children[1].eval(ctx)
+    b = cast_data(ctx, data_of(bv, ctx), e.children[0].data_type(),
+                  t.DOUBLE)
+    x = cast_data(ctx, data_of(xv, ctx), e.children[1].data_type(),
+                  t.DOUBLE)
+    ok = (x > 0) & (b > 0)
+    sb = xp.where(ok, b, xp.full_like(b, 2.0))
+    sx = xp.where(ok, x, xp.ones_like(x))
+    out = xp.log(sx) / xp.log(sb)
+    val = and_validity(ctx, and_validity(ctx, validity_of(bv, ctx),
+                                         validity_of(xv, ctx)), ok)
+    return make_column(ctx, t.DOUBLE, out, val)
 
 
 class Log(UnaryMath):

@@ -110,6 +110,42 @@ def _eval_length(e, ctx: EvalContext):
     return make_column(ctx, t.INT, nchars.astype(np.int32), col.validity)
 
 
+class Ascii(StringUnary):
+    """ascii(s): code point of the FIRST character; 0 for empty strings
+    (ref stringFunctions.scala GpuAscii).  Full UTF-8 decode of the lead
+    sequence (1-4 bytes), matching Spark's behavior on non-ASCII."""
+
+    def data_type(self):
+        return t.INT
+
+
+@evaluator(Ascii)
+def _eval_ascii(e, ctx: EvalContext):
+    v = e.children[0].eval(ctx)
+    col = _string_input(ctx, v)
+    xp = ctx.xp
+    cap = max(int(col.data.shape[0]) - 1, 0)
+    starts = col.offsets[:-1]
+    lens = col.offsets[1:] - col.offsets[:-1]
+
+    def byte_at(k):
+        ok = lens > k
+        idx = xp.clip(starts + k, 0, cap)
+        return xp.where(ok, col.data[idx],
+                        xp.zeros((), col.data.dtype)).astype(np.int32)
+
+    b0, b1, b2, b3 = byte_at(0), byte_at(1), byte_at(2), byte_at(3)
+    c1 = b0                                              # 0xxxxxxx
+    c2 = ((b0 & 0x1F) << 6) | (b1 & 0x3F)                # 110xxxxx
+    c3 = ((b0 & 0x0F) << 12) | ((b1 & 0x3F) << 6) | (b2 & 0x3F)
+    c4 = ((b0 & 0x07) << 18) | ((b1 & 0x3F) << 12) |         ((b2 & 0x3F) << 6) | (b3 & 0x3F)
+    out = xp.where(b0 < 0x80, c1,
+                   xp.where(b0 < 0xE0, c2,
+                            xp.where(b0 < 0xF0, c3, c4)))
+    out = xp.where(lens == 0, xp.zeros_like(out), out)
+    return make_column(ctx, t.INT, out.astype(np.int32), col.validity)
+
+
 class BitLength(StringUnary):
     def data_type(self):
         return t.INT

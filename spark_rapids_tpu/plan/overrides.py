@@ -96,8 +96,14 @@ for c in (mx.Sqrt, mx.Exp, mx.Expm1, mx.Sin, mx.Cos, mx.Tan, mx.Asin,
           mx.Acos, mx.Atan, mx.Sinh, mx.Cosh, mx.Tanh, mx.Cbrt, mx.Rint,
           mx.ToDegrees, mx.ToRadians, mx.Log, mx.Log2, mx.Log10, mx.Log1p,
           mx.Pow, mx.Atan2, mx.Signum, mx.Round, mx.BRound, mx.Floor,
-          mx.Ceil):
+          mx.Ceil, mx.Asinh, mx.Acosh, mx.Atanh, mx.Cot, mx.Logarithm):
     expr_rule(c, _num)
+
+from ..expr import bitwise as bw
+
+for c in (bw.BitwiseAnd, bw.BitwiseOr, bw.BitwiseXor, bw.BitwiseNot,
+          bw.ShiftLeft, bw.ShiftRight, bw.ShiftRightUnsigned):
+    expr_rule(c, T.integral)
 
 
 from ..expr import datetime_expr as dte
@@ -112,6 +118,37 @@ for c in (se.Length, se.BitLength, se.StringLocate):
     expr_rule(c, T.INT)
 for c in (se.Contains, se.StartsWith, se.EndsWith, se.Like):
     expr_rule(c, T.BOOLEAN)
+expr_rule(se.Ascii, T.INT)
+
+
+# host-evaluated string families run inside a CPU-placed operator
+# (SURVEY hard-part #3: no regex engine on TPU) — registered with
+# per-family reasons so generated docs and explain output state WHY,
+# the way the reference documents its incompat/disabled ops
+# (ref GpuOverrides.scala:97-100)
+def _tag_host_only(reason: str):
+    def tag(meta: "ExprMeta", _r=reason):
+        meta.will_not_work(_r)
+    return tag
+
+
+from ..expr import json_expr as je
+from ..expr import regex as rx
+
+_regex_reason = ("regex evaluation runs on the host engine "
+                 "(no TPU regex kernel; ref SURVEY hard-part #3)")
+for c in (rx.RLike, rx.RegExpExtract, rx.RegExpReplace, rx.StringSplit):
+    expr_rule(c, T.STRING, "host-evaluated regex",
+              _tag_host_only(_regex_reason))
+expr_rule(se.ConcatWs, T.STRING, "host-evaluated concat_ws",
+          _tag_host_only("concat_ws's variadic null/separator semantics "
+                         "evaluate on the host engine"))
+expr_rule(je.GetJsonObject, T.STRING, "host-evaluated JSON path",
+          _tag_host_only("JSON-path evaluation runs on the host engine "
+                         "(no TPU JSON parser)"))
+expr_rule(hf.Md5, T.STRING, "md5 hex digest (host digest loop)",
+          _tag_host_only("md5 digests run on the host engine "
+                         "(byte-serial digest)"))
 for c in (dte.Year, dte.Month, dte.DayOfMonth, dte.Quarter, dte.DayOfWeek,
           dte.WeekDay, dte.DayOfYear, dte.Hour, dte.Minute, dte.Second,
           dte.DateDiff):

@@ -207,6 +207,9 @@ def _sample_in(items, rng: random.Random) -> str:
                 member.add(chr(arg))
             elif name == "range":
                 member |= {chr(c) for c in range(arg[0], arg[1] + 1)}
+            elif name == "category":
+                member |= set(_SRE_CATEGORIES[
+                    str(arg).lower().split(".")[-1]])
         pool = [c for c in (string.ascii_letters + string.digits + " _-")
                 if c not in member]
         return rng.choice(pool or ["x"])

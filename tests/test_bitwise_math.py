@@ -1,0 +1,163 @@
+"""Bitwise + extended math expressions: TPU-vs-CPU differential and
+Java/Spark shift semantics (ref bitwise.scala GpuBitwise*/GpuShift*)."""
+
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col
+from spark_rapids_tpu.api.session import TpuSession
+
+
+def _both(q):
+    outs = []
+    for enabled in (True, False):
+        s = (TpuSession.builder()
+             .config("spark.rapids.sql.enabled", enabled).get_or_create())
+        outs.append((s, q(s)))
+    return outs
+
+
+def test_bitwise_and_or_xor_not_differential():
+    rng = np.random.default_rng(11)
+    tb = pa.table({
+        "a": pa.array(rng.integers(-2**31, 2**31, 500).astype(np.int64)),
+        "b": pa.array(rng.integers(-2**31, 2**31, 500).astype(np.int64)),
+    })
+
+    def q(s):
+        df = s.create_dataframe(tb)
+        return df.select(
+            F.bitwise_and(col("a"), col("b")).alias("and_"),
+            F.bitwise_or(col("a"), col("b")).alias("or_"),
+            F.bitwise_xor(col("a"), col("b")).alias("xor_"),
+            F.bitwise_not(col("a")).alias("not_")).collect()
+
+    (s1, t1), (s2, t2) = _both(q)
+    for name in ("and_", "or_", "xor_", "not_"):
+        assert t1.column(name).to_pylist() == t2.column(name).to_pylist()
+    # placement check: the project ran on TPU
+    ops = []
+    s1.last_plan.foreach(lambda e: ops.append((type(e).__name__,
+                                               e.placement)))
+    assert ("ProjectExec", "tpu") in ops, ops
+    # oracle spot check
+    a = tb.column("a").to_pylist()
+    b = tb.column("b").to_pylist()
+    assert t1.column("and_").to_pylist()[:5] == \
+        [x & y for x, y in zip(a[:5], b[:5])]
+
+
+def test_shifts_follow_java_masking():
+    tb = pa.table({
+        "v": pa.array([1, -8, 2**40, -1], type=pa.int64()),
+        "s": pa.array([1, 2, 65, 63], type=pa.int32()),
+    })
+
+    def q(s):
+        df = s.create_dataframe(tb)
+        return df.select(
+            F.shiftleft(col("v"), col("s")).alias("shl"),
+            F.shiftright(col("v"), col("s")).alias("shr"),
+            F.shiftrightunsigned(col("v"), col("s")).alias("shru"),
+        ).collect()
+
+    (_, t1), (_, t2) = _both(q)
+    for n in ("shl", "shr", "shru"):
+        assert t1.column(n).to_pylist() == t2.column(n).to_pylist(), n
+    # Java masks long shifts by 63: shift of 65 acts as shift of 1
+    assert t1.column("shl").to_pylist()[2] == (2**40) << 1
+    # arithmetic vs logical right shift of a negative number
+    assert t1.column("shr").to_pylist()[3] == -1       # sign-extends
+    assert t1.column("shru").to_pylist()[3] == 1       # zero-fills
+
+
+def test_extended_math_differential():
+    rng = np.random.default_rng(12)
+    tb = pa.table({"x": pa.array(rng.uniform(1.1, 5.0, 200))})
+
+    def q(s):
+        df = s.create_dataframe(tb)
+        return df.select(F.cot(col("x")).alias("cot"),
+                         F.asinh(col("x")).alias("asinh"),
+                         F.acosh(col("x")).alias("acosh"),
+                         F.log_base(F.lit(2.0), col("x")).alias("lg2"),
+                         ).collect()
+
+    (_, t1), (_, t2) = _both(q)
+    for n in ("cot", "asinh", "acosh", "lg2"):
+        np.testing.assert_allclose(np.array(t1.column(n)),
+                                   np.array(t2.column(n)), rtol=1e-12)
+    xs = tb.column("x").to_pylist()
+    np.testing.assert_allclose(t1.column("lg2").to_pylist()[:3],
+                               [math.log2(v) for v in xs[:3]], rtol=1e-12)
+
+
+def test_ascii_and_host_fallback_families_documented():
+    tb = pa.table({"s": pa.array(["Abc", "", "zoo", None])})
+
+    def q(s):
+        df = s.create_dataframe(tb)
+        return df.select(F.ascii(col("s")).alias("a")).collect()
+
+    (_, t1), (_, t2) = _both(q)
+    assert t1.column("a").to_pylist() == [65, 0, 122, None]
+    assert t1.column("a").to_pylist() == t2.column("a").to_pylist()
+
+    # regex/json/md5 rules exist with a documented host-fallback reason
+    from spark_rapids_tpu.expr.regex import RLike, StringSplit
+    from spark_rapids_tpu.expr.json_expr import GetJsonObject
+    from spark_rapids_tpu.expr.hashfns import Md5
+    from spark_rapids_tpu.plan.overrides import EXPR_RULES
+    for c in (RLike, StringSplit, GetJsonObject, Md5):
+        assert c in EXPR_RULES, c
+        assert EXPR_RULES[c].tag_fn is not None
+
+
+def test_ascii_decodes_multibyte_first_char():
+    tb = pa.table({"s": pa.array(["A", "é", "中", "😀", ""])})
+
+    def q(s):
+        df = s.create_dataframe(tb)
+        return df.select(F.ascii(col("s")).alias("a")).collect()
+
+    (_, t1), (_, t2) = _both(q)
+    want = [ord("A"), ord("é"), ord("中"), ord("😀"), 0]
+    assert t1.column("a").to_pylist() == want
+    assert t2.column("a").to_pylist() == want
+
+
+def test_udf_kwonly_defaults_and_inner_lambda_keying():
+    """kw-only default changes must MISS; re-created UDFs containing an
+    inner lambda must still HIT (code-review round-3 findings)."""
+    from spark_rapids_tpu import types as t
+    from spark_rapids_tpu.api.functions import udf
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.exec.base import jit_cache_size
+
+    s = TpuSession.builder().config("spark.rapids.sql.enabled",
+                                    True).get_or_create()
+    tb = pa.table({"v": pa.array([1, 2], type=pa.int64())})
+    df = s.create_dataframe(tb)
+
+    def make(m):
+        def f(x, *, mult=m):
+            return x * mult
+        return udf(f, t.LONG)
+
+    assert df.select(make(2)(col("v")).alias("y")).collect() \
+        .column("y").to_pylist() == [2, 4]
+    assert df.select(make(3)(col("v")).alias("y")).collect() \
+        .column("y").to_pylist() == [3, 6]
+
+    def make_inner():
+        return udf(lambda x: (lambda y: y + 1)(x) * 2, t.LONG)
+
+    df.select(make_inner()(col("v")).alias("y")).collect()
+    n = jit_cache_size()
+    out = df.select(make_inner()(col("v")).alias("y")).collect()
+    assert jit_cache_size() == n        # inner-lambda UDF reused
+    assert out.column("y").to_pylist() == [4, 6]
