@@ -26,6 +26,83 @@ def _to_expr(c) -> Expression:
     return Literal(c)
 
 
+def _lower_sliding_windows(lp, exprs):
+    """Spark's TimeWindowing rule: a sliding window(ts, w, s) expands
+    each row into ceil(w/s) per-slide copies, filtered to the windows
+    that actually contain ts, and downstream expressions reference the
+    materialized window column (ref
+    org/apache/spark/sql/rapids/TimeWindow.scala + Spark's analysis
+    lowering through Expand).  Returns (new_lp, new_exprs)."""
+    import math
+
+    from ..expr.complextype import GetStructField
+    from ..expr.core import Alias as _Alias
+    from ..expr.core import AttributeReference as _Attr
+    from ..expr.datetime_expr import TimeWindow
+    from ..expr.predicates import And, GreaterThan, LessThanOrEqual
+
+    all_sliding = []
+    for e in exprs:
+        all_sliding += e.collect(
+            lambda x: isinstance(x, TimeWindow) and
+            not x.is_tumbling and x.copy_index is None)
+    if not all_sliding:
+        return lp, exprs
+    keys = {(w.window, w.slide, w.start, w.children[0].sql())
+            for w in all_sliding}
+    if len(keys) > 1:
+        # Spark raises AnalysisException for multiple time windows in
+        # one projection; substituting one Expand for both would
+        # silently return the wrong windows
+        raise ValueError(
+            "only one sliding time window is allowed per "
+            "select/groupBy (Spark's TimeWindowing restriction)")
+    sliding = all_sliding[0]
+    wname = None
+    for e in exprs:
+        if isinstance(e, _Alias) and e.child in all_sliding:
+            wname = e.name
+            break
+    names, _ = lp.schema()
+    if wname is None:
+        wname = "window"
+        while wname in names:
+            wname = "_" + wname
+    elif wname in names:
+        raise ValueError(
+            f"window alias {wname!r} collides with an input column")
+    n_copies = math.ceil(sliding.window / sliding.slide)
+    projections = []
+    for i in range(n_copies):
+        proj = [_Attr(n) for n in names]
+        proj.append(TimeWindow(sliding.children[0], sliding.window,
+                               sliding.slide, sliding.start,
+                               copy_index=i))
+        projections.append(proj)
+    out_names = list(names) + [wname]
+    expanded = L.Expand(projections, out_names, lp)
+    wref = _Attr(wname)
+    ts = sliding.children[0]
+    keep = And(GreaterThan(GetStructField(wref, "end"), ts),
+               LessThanOrEqual(GetStructField(wref, "start"), ts))
+    filtered = L.Filter(keep, expanded)
+
+    def substitute(e):
+        def fn(x):
+            # only the single lowered window shape substitutes (the
+            # multi-window case raised above)
+            if (isinstance(x, TimeWindow) and not x.is_tumbling and
+                    x.copy_index is None):
+                return _Attr(wname)
+            return x
+        if isinstance(e, _Alias) and e.child in all_sliding:
+            return _Attr(wname) if e.name == wname else \
+                _Alias(_Attr(wname), e.name)
+        return e.transform_up(fn)
+
+    return filtered, [substitute(e) for e in exprs]
+
+
 class DataFrame:
     def __init__(self, lp: L.LogicalPlan, session):
         self._lp = lp
@@ -91,6 +168,10 @@ class DataFrame:
                 else:
                     proj.append(e)
             return DataFrame(L.Project(proj, base), self.session)
+        # sliding time windows lower through Expand + Filter first
+        base_lp, exprs = _lower_sliding_windows(self._lp, exprs)
+        if base_lp is not self._lp:
+            return DataFrame(L.Project(exprs, base_lp), self.session)
         # route window expressions through a Window node, then project
         windows = [e for e in exprs if isinstance(e, WindowExpression)]
         if windows:
@@ -118,7 +199,11 @@ class DataFrame:
     where = filter
 
     def group_by(self, *cols) -> "GroupedData":
-        return GroupedData([_to_expr(c) for c in cols], self)
+        exprs = [_to_expr(c) for c in cols]
+        base_lp, exprs = _lower_sliding_windows(self._lp, exprs)
+        df = self if base_lp is self._lp else \
+            DataFrame(base_lp, self.session)
+        return GroupedData(exprs, df)
 
     groupBy = group_by
 

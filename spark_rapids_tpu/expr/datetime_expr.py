@@ -392,18 +392,21 @@ def parse_duration_micros(s: str, allow_nonpositive: bool = False
 class TimeWindow(Expression):
     """window(ts, windowDuration[, slideDuration[, startTime]]) -> struct
     with start/end timestamps (ref
-    org/apache/spark/sql/rapids/TimeWindow.scala; Spark lowers sliding
-    windows to an Expand of per-slide copies — this expression covers the
-    tumbling case, and the overrides rule tags sliding windows onto the
-    CPU path exactly like unsupported shapes elsewhere)."""
+    org/apache/spark/sql/rapids/TimeWindow.scala).  Tumbling windows
+    evaluate directly; sliding windows lower through an Expand of
+    per-slide copies (`copy_index` selects which overlapping window a
+    copy computes — Spark's TimeWindowing analysis rule does exactly
+    this), built by dataframe._lower_sliding_windows."""
 
     def __init__(self, child: Expression, window_micros: int,
-                 slide_micros=None, start_micros: int = 0):
+                 slide_micros=None, start_micros: int = 0,
+                 copy_index=None):
         self.children = (child,)
         self.window = int(window_micros)
         self.slide = int(slide_micros if slide_micros is not None
                          else window_micros)
         self.start = int(start_micros)
+        self.copy_index = copy_index
 
     def data_type(self):
         return t.StructType([t.StructField("start", t.TIMESTAMP),
@@ -421,21 +424,25 @@ class TimeWindow(Expression):
 def _eval_time_window(e: TimeWindow, ctx):
     from ..columnar.device import DeviceColumn
     from .core import ColumnValue
-    if not e.is_tumbling:
+    if not e.is_tumbling and e.copy_index is None:
         raise NotImplementedError(
-            "sliding time windows (slide != window) need the Expand "
-            "lowering; only tumbling windows are supported")
+            "sliding time windows evaluate through the Expand lowering "
+            "(dataframe._lower_sliding_windows); a bare sliding window "
+            "expression has no single value per row")
     xp = ctx.xp
     v = e.children[0].eval(ctx)
     ts = data_of(v, ctx)
     valid = validity_of(v, ctx)
     if valid is None:
         valid = xp.ones((ctx.capacity,), dtype=bool)
-    w = np.int64(e.window)
+    sl = np.int64(e.slide)
+    copy = int(e.copy_index or 0)
     # numpy/jnp mod follows the divisor's sign, so this floors correctly
-    # for pre-epoch timestamps too
-    ws = ts - (ts - np.int64(e.start)) % w
+    # for pre-epoch timestamps too; copy i selects the i-th overlapping
+    # window walking backwards from the last slide boundary <= ts
+    ws = ts - (ts - np.int64(e.start)) % sl - np.int64(copy) * sl
     start = DeviceColumn(t.TIMESTAMP, data=ws, validity=valid)
-    end = DeviceColumn(t.TIMESTAMP, data=ws + w, validity=valid)
+    end = DeviceColumn(t.TIMESTAMP, data=ws + np.int64(e.window),
+                       validity=valid)
     return ColumnValue(DeviceColumn(e.data_type(), validity=valid,
                                     children=(start, end)))

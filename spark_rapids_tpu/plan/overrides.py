@@ -289,10 +289,13 @@ expr_rule(agg.AggregateExpression, T.all_types.nested())
 
 
 def _tag_time_window(meta: "ExprMeta"):
-    if not meta.expr.is_tumbling:
+    if not meta.expr.is_tumbling and meta.expr.copy_index is None:
+        # lowered per-slide copies (copy_index set) are plain elementwise
+        # math and run on TPU; only a bare un-lowered sliding window is
+        # unsupported
         meta.will_not_work(
-            "sliding time windows (slide != window) lower through an "
-            "Expand on the CPU path")
+            "bare sliding time windows need the Expand lowering "
+            "(window() through select/groupBy lowers automatically)")
 
 
 from ..expr.datetime_expr import TimeWindow as _TimeWindow

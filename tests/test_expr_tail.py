@@ -132,20 +132,29 @@ def test_tumbling_time_window_groups():
     assert starts[1] == base + datetime.timedelta(minutes=10)
 
 
-def test_sliding_window_raises_until_expand_lowering():
-    """slide != window needs the per-slide Expand; evaluating it as
-    tumbling would be silently wrong, so it raises (code-review round-3
-    finding)."""
+def test_sliding_window_lowers_through_expand():
+    """slide != window lowers through an Expand in the plan (Spark's
+    TimeWindowing rule); a bare un-lowered sliding expression still
+    raises rather than silently evaluating as tumbling."""
     s = _session()
     base = datetime.datetime(2024, 3, 1, tzinfo=datetime.timezone.utc)
     tb = pa.table({"ts": pa.array([base], type=pa.timestamp("us",
                                                             tz="UTC")),
                    "v": pa.array([1], type=pa.int64())})
     df = s.create_dataframe(tb)
-    q = df.select(F.window(col("ts"), "10 minutes", "5 minutes")
-                  .alias("w"))
+    df.select(F.window(col("ts"), "10 minutes", "5 minutes")
+              .alias("w")).collect()
+    names = [n for n, _ in _tpu_ops(s)]
+    assert "ExpandExec" in names, names
+
+    # un-lowered bare expression (e.g. smuggled into a filter) raises
+    from spark_rapids_tpu.api.column import Column
+    from spark_rapids_tpu.expr.datetime_expr import TimeWindow
+    from spark_rapids_tpu.expr.complextype import GetStructField
+    bare = TimeWindow(col("ts").expr, 600_000_000, 300_000_000)
     with pytest.raises(NotImplementedError, match="sliding"):
-        q.collect()
+        df.filter(Column(GetStructField(bare, "end")) > col("ts")) \
+            .collect()
 
 
 def test_window_start_time_offsets():
@@ -207,3 +216,88 @@ def test_normalize_nan_and_zero():
                                    g.column("c").to_pylist())
                  if x == 0.0]
     assert zero_rows == [2]
+
+
+def test_sliding_window_expand_lowering():
+    """Sliding windows lower through Expand + Filter (Spark's
+    TimeWindowing rule): each row lands in every overlapping window and
+    the aggregate matches a hand-computed oracle."""
+    s = _session()
+    base = datetime.datetime(2024, 3, 1, 10, 0, 0,
+                             tzinfo=datetime.timezone.utc)
+    minutes = (0, 3, 7, 12, 14, 21)
+    ts = [base + datetime.timedelta(minutes=m) for m in minutes]
+    vals = [1, 2, 3, 4, 5, 6]
+    tb = pa.table({
+        "ts": pa.array(ts, type=pa.timestamp("us", tz="UTC")),
+        "v": pa.array(vals, type=pa.int64()),
+    })
+    df = s.create_dataframe(tb)
+    out = (df.group_by(F.window(col("ts"), "10 minutes", "5 minutes")
+                       .alias("w"))
+           .agg(F.sum(col("v")).alias("s")).collect())
+    got = {w["start"].replace(tzinfo=datetime.timezone.utc): sv
+           for w, sv in zip(out.column("w").to_pylist(),
+                            out.column("s").to_pylist())}
+    # oracle: every window [start, start+10) stepping by 5 that contains
+    # at least one row
+    want = {}
+    for m, v in zip(minutes, vals):
+        for wstart in range(m - m % 5, m - 10, -5):
+            if wstart <= m < wstart + 10:
+                key = base + datetime.timedelta(minutes=wstart)
+                want[key] = want.get(key, 0) + v
+    assert got == want, (got, want)
+    # each row appears in exactly 2 windows -> total doubles
+    assert sum(out.column("s").to_pylist()) == 2 * sum(vals)
+
+
+def test_sliding_window_in_select():
+    s = _session()
+    base = datetime.datetime(2024, 3, 1, tzinfo=datetime.timezone.utc)
+    tb = pa.table({
+        "ts": pa.array([base + datetime.timedelta(minutes=7)],
+                       type=pa.timestamp("us", tz="UTC")),
+        "v": pa.array([10], type=pa.int64()),
+    })
+    out = (s.create_dataframe(tb)
+           .select(F.window(col("ts"), "10 minutes", "5 minutes")
+                   .alias("w"), col("v")).collect())
+    # minute 7 falls in windows starting at 0 and 5
+    starts = sorted(w["start"].replace(tzinfo=datetime.timezone.utc)
+                    for w in out.column("w").to_pylist())
+    assert starts == [base, base + datetime.timedelta(minutes=5)]
+    assert out.column("v").to_pylist() == [10, 10]
+
+
+def test_multiple_sliding_windows_rejected():
+    s = _session()
+    base = datetime.datetime(2024, 3, 1, tzinfo=datetime.timezone.utc)
+    tb = pa.table({"ts": pa.array([base], type=pa.timestamp("us",
+                                                            tz="UTC"))})
+    df = s.create_dataframe(tb)
+    with pytest.raises(ValueError, match="one sliding time window"):
+        df.select(F.window(col("ts"), "10 minutes", "5 minutes")
+                  .alias("a"),
+                  F.window(col("ts"), "30 minutes", "15 minutes")
+                  .alias("b"))
+
+
+def test_window_name_collision_handling():
+    s = _session()
+    base = datetime.datetime(2024, 3, 1, tzinfo=datetime.timezone.utc)
+    tb = pa.table({
+        "ts": pa.array([base + datetime.timedelta(minutes=3)],
+                       type=pa.timestamp("us", tz="UTC")),
+        "window": pa.array([42], type=pa.int64()),
+    })
+    df = s.create_dataframe(tb)
+    # explicit alias colliding with a data column is an error
+    with pytest.raises(ValueError, match="collides"):
+        df.group_by(F.window(col("ts"), "10 minutes", "5 minutes")
+                    .alias("window"))
+    # the default internal name dodges the user's column
+    out = (df.group_by(F.window(col("ts"), "10 minutes", "5 minutes")
+                       .alias("w"))
+           .agg(F.first(col("window")).alias("orig")).collect())
+    assert out.column("orig").to_pylist() == [42, 42]
