@@ -168,10 +168,14 @@ class DataFrame:
                 else:
                     proj.append(e)
             return DataFrame(L.Project(proj, base), self.session)
-        # sliding time windows lower through Expand + Filter first
+        # sliding time windows lower through Expand + Filter first;
+        # re-entering select lets the remaining routing (generators,
+        # window expressions) see the substituted expressions
         base_lp, exprs = _lower_sliding_windows(self._lp, exprs)
         if base_lp is not self._lp:
-            return DataFrame(L.Project(exprs, base_lp), self.session)
+            from .column import Column as _Col
+            return DataFrame(base_lp, self.session).select(
+                *[_Col(e) for e in exprs])
         # route window expressions through a Window node, then project
         windows = [e for e in exprs if isinstance(e, WindowExpression)]
         if windows:

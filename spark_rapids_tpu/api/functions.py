@@ -598,3 +598,13 @@ def bitwise_or(a, b) -> Column:
 def bitwise_xor(a, b) -> Column:
     from ..expr.bitwise import BitwiseXor
     return _c(BitwiseXor(_expr(a), _expr(b)))
+
+
+def percent_rank() -> Column:
+    from ..expr.window import PercentRank
+    return _c(PercentRank())
+
+
+def cume_dist() -> Column:
+    from ..expr.window import CumeDist
+    return _c(CumeDist())

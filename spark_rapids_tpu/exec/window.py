@@ -28,8 +28,9 @@ from ..expr.aggregates import (AggregateExpression, AggregateFunction,
 from ..expr.core import (ColumnValue, EvalContext, Expression,
                          bind_expression, make_column)
 from ..expr.window import (CURRENT_ROW, UNBOUNDED_FOLLOWING,
-                           UNBOUNDED_PRECEDING, DenseRank, Lag, Lead, NTile,
-                           Rank, RowNumber, WindowExpression)
+                           UNBOUNDED_PRECEDING, CumeDist, DenseRank, Lag,
+                           Lead, NTile, PercentRank, Rank, RowNumber,
+                           WindowExpression)
 from ..ops import segmented as seg
 from ..ops.gather import gather_column
 from .base import (maybe_sync,  # noqa: F401
@@ -168,11 +169,35 @@ class WindowExec(Exec):
             base = runs_cum[xp.clip(seg_start, 0, cap - 1)] - \
                 new_run[xp.clip(seg_start, 0, cap - 1)].astype(xp.int64)
             return finish((runs_cum - base).astype(np.int32), live_s)
+        # partition row counts must exclude batch PADDING rows: dead
+        # tail rows inherit the last live segment id in the sorted
+        # layout, so an unmasked reduce inflates the final partition
+        def live_seg_len():
+            out, _ = seg.segment_reduce(xp, "max", idx_in_seg + 1,
+                                        seg_ids, cap, live_s)
+            return out[seg_ids]
+
+        if type(func) is PercentRank:
+            run_start = _seg_start_positions(xp, new_run)
+            rank = (run_start - seg_start + 1).astype(np.float64)
+            n_rows = live_seg_len().astype(np.float64)
+            pr = xp.where(n_rows > 1, (rank - 1.0) /
+                          xp.maximum(n_rows - 1.0, 1.0), 0.0)
+            return finish(pr, live_s)
+        if type(func) is CumeDist:
+            # last LIVE row of the current peer run (padding excluded)
+            run_id = xp.clip(
+                (xp.cumsum(new_run.astype(xp.int64)) - 1).astype(
+                    xp.int32), 0, cap - 1)
+            run_max, _ = seg.segment_reduce(xp, "max", pos, run_id, cap,
+                                            live_s)
+            run_end = run_max[run_id]
+            n_rows = live_seg_len().astype(np.float64)
+            cd = (run_end - seg_start + 1).astype(np.float64) / \
+                xp.maximum(n_rows, 1.0)
+            return finish(cd, live_s)
         if isinstance(func, NTile):
-            seg_len, _ = seg.segment_reduce(
-                xp, "max", idx_in_seg + 1, seg_ids, cap,
-                xp.ones((cap,), dtype=bool))
-            n_rows = seg_len[seg_ids]
+            n_rows = live_seg_len()
             nt = np.int64(func.n)
             base = n_rows // nt
             rem = n_rows % nt

@@ -137,6 +137,24 @@ class Lag(Lead):
     pass
 
 
+class PercentRank(RowNumber):
+    """(rank - 1) / (partition rows - 1); 0.0 for single-row partitions
+    (ref GpuWindowExpression percent_rank support)."""
+
+    def data_type(self):
+        from .. import types as t
+        return t.DOUBLE
+
+
+class CumeDist(RowNumber):
+    """rows with order key <= current / partition rows
+    (ref cume_dist window support)."""
+
+    def data_type(self):
+        from .. import types as t
+        return t.DOUBLE
+
+
 class NTile(WindowFunction):
     is_ranking = True
 

@@ -301,3 +301,29 @@ def test_window_name_collision_handling():
                        .alias("w"))
            .agg(F.first(col("window")).alias("orig")).collect())
     assert out.column("orig").to_pylist() == [42, 42]
+
+
+def test_sliding_window_mixed_with_window_function():
+    """select() mixing a sliding window with a window FUNCTION routes
+    both: the lowered select re-enters the normal routing (code-review
+    round-3 finding: the early return skipped WindowExpression
+    handling)."""
+    from spark_rapids_tpu.expr.window import WindowBuilder
+    s = _session()
+    base = datetime.datetime(2024, 3, 1, tzinfo=datetime.timezone.utc)
+    tb = pa.table({
+        "ts": pa.array([base + datetime.timedelta(minutes=m)
+                        for m in (1, 2, 8)],
+                       type=pa.timestamp("us", tz="UTC")),
+        "v": pa.array([10, 20, 30], type=pa.int64()),
+    })
+    df = s.create_dataframe(tb)
+    w = WindowBuilder().order_by(col("v"))
+    out = (df.select(F.window(col("ts"), "10 minutes", "5 minutes")
+                     .alias("w"),
+                     col("v"),
+                     F.row_number().over(w).alias("rn"))
+           .collect())
+    # 3 rows x 2 overlapping windows each
+    assert out.num_rows == 6
+    assert sorted(set(out.column("rn").to_pylist())) == [1, 2, 3, 4, 5, 6]

@@ -311,3 +311,59 @@ def test_bounded_range_fuzz(tpu_session, seed, lo_b, hi_b):
         assert got_cv[i] == len(vals), (i, "count")
         assert got_sv[i] == (sum(vals) if vals else None), (i, "sum")
         assert got_mn[i] == (min(vals) if vals else None), (i, "min")
+
+
+def test_percent_rank_and_cume_dist():
+    """percent_rank / cume_dist vs a pandas oracle, with ties (peer
+    runs) and a single-row partition (percent_rank -> 0.0)."""
+    import pandas as pd
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.expr.window import WindowBuilder
+
+    # the max-sorted partition has MULTIPLE rows: batch padding rows
+    # sort after it, so an unmasked partition count inflates exactly
+    # here (code-review round-3 finding)
+    tb = pa.table({
+        "k": pa.array([1, 1, 1, 1, 2, 2, 3, 3], type=pa.int64()),
+        "v": pa.array([10, 20, 20, 30, 5, 5, 7, 9], type=pa.int64()),
+    })
+    w = WindowBuilder().partition_by(col("k")).order_by(col("v"))
+
+    for enabled in (True, False):
+        s = (TpuSession.builder()
+             .config("spark.rapids.sql.enabled", enabled).get_or_create())
+        out = (s.create_dataframe(tb)
+               .select(col("k"), col("v"),
+                       F.percent_rank().over(w).alias("pr"),
+                       F.cume_dist().over(w).alias("cd"))
+               .collect().sort_by([("k", "ascending"),
+                                   ("v", "ascending")]))
+        pdf = tb.to_pandas()
+        g = pdf.groupby("k")["v"]
+        want_pr = pdf.assign(
+            pr=g.rank(method="min").sub(1) /
+            g.transform("count").sub(1).clip(lower=1) *
+            (g.transform("count") > 1)) \
+            .sort_values(["k", "v"])["pr"].tolist()
+        want_cd = pdf.assign(cd=g.rank(method="max") /
+                             g.transform("count")) \
+            .sort_values(["k", "v"])["cd"].tolist()
+        np.testing.assert_allclose(out.column("pr").to_pylist(), want_pr,
+                                   rtol=1e-12, err_msg=str(enabled))
+        np.testing.assert_allclose(out.column("cd").to_pylist(), want_cd,
+                                   rtol=1e-12, err_msg=str(enabled))
+
+        # no partition_by: ONE global frame over all live rows
+        wg = WindowBuilder().order_by(col("v"))
+        og = (s.create_dataframe(tb)
+              .select(col("v"), F.cume_dist().over(wg).alias("cd"))
+              .collect().sort_by("v"))
+        n = tb.num_rows
+        ranks = pd.Series(tb.column("v").to_pylist()).rank(method="max")
+        want_g = (ranks / n).sort_values().tolist()
+        np.testing.assert_allclose(sorted(og.column("cd").to_pylist()),
+                                   want_g, rtol=1e-12,
+                                   err_msg=str(enabled))
