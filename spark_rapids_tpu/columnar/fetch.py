@@ -13,11 +13,14 @@ TWO round trips, transferring only the rows that exist:
      tiny array — one sync that also acts as the pipeline barrier.
   2. `shrink_pack`: a jitted function (cached per schema/capacity shape)
      slices every lane down to the smallest capacity bucket that holds
-     num_rows, bitcasts each lane to bytes, and concatenates them into ONE
-     uint8 buffer — one transfer for the entire batch.
+     num_rows and concatenates the lanes into one buffer PER DTYPE
+     (bools fold into uint8).  No bitcasting — the TPU X64-rewrite pass
+     cannot compile 64-bit bitcast-convert — so instead of one uint8
+     buffer the fetch is a handful of per-dtype buffers brought over in
+     a single device_get (one sync).
 
-The host then rebuilds numpy-backed DeviceColumns from views of that
-buffer; Arrow conversion proceeds on host exactly as before.
+The host then rebuilds numpy-backed DeviceColumns from views of those
+buffers; Arrow conversion proceeds on host exactly as before.
 """
 
 from __future__ import annotations
@@ -115,26 +118,29 @@ def _shrink_column(col: DeviceColumn, out_cap: int, var_caps) -> DeviceColumn:
     return out
 
 
-def _to_bytes(a):
-    """1-D uint8 view of an array (device-side bitcast)."""
-    if a.dtype == jnp.bool_:
-        a = a.astype(jnp.uint8)
-    if a.dtype == jnp.uint8:
-        return a.reshape(-1)
-    return jax.lax.bitcast_convert_type(a, jnp.uint8).reshape(-1)
+def _canon_key(x) -> str:
+    """Buffer-group key for a lane: its dtype name, with bool folded into
+    uint8 (bools travel as bytes).  The ONLY place the grouping rule
+    lives — device pack and host unpack both call it, so they cannot
+    drift."""
+    d = np.dtype(x.dtype.name if hasattr(x.dtype, "name") else x.dtype)
+    return "uint8" if d == np.bool_ else d.name
 
 
 def _make_shrink_pack_fn(out_cap: int, var_caps: Tuple[int, ...]):
     def shrink_pack(batch: DeviceBatch):
         it = iter(var_caps)
         cols = [_shrink_column(c, out_cap, it) for c in batch.columns]
-        parts = []
+        groups: dict = {}  # insertion-ordered: key -> list of 1-D lanes
         for c in cols:
             for leaf in jax.tree_util.tree_leaves(c):
-                parts.append(_to_bytes(leaf))
-        if not parts:
-            return jnp.zeros((0,), jnp.uint8)
-        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+                k = _canon_key(leaf)
+                if leaf.dtype == jnp.bool_:
+                    leaf = leaf.astype(jnp.uint8)
+                groups.setdefault(k, []).append(leaf.reshape(-1))
+        return tuple(
+            jnp.concatenate(ls) if len(ls) > 1 else ls[0]
+            for ls in groups.values())
     return shrink_pack
 
 
@@ -143,19 +149,29 @@ def _np_dtype_of(x) -> np.dtype:
     return np.dtype(x.dtype.name if hasattr(x.dtype, "name") else x.dtype)
 
 
-def _unpack_column(col: DeviceColumn, buf: np.ndarray, pos: int,
-                   out_cap: int, var_caps) -> Tuple[DeviceColumn, int]:
-    """Rebuild a numpy-backed shrunk column from the packed buffer."""
-    dt = col.dtype
+class _BufReader:
+    """Per-dtype cursors over the fetched buffer group (walk order on host
+    mirrors the device pack exactly, so sequential slices line up)."""
 
-    def take(cap: int, dtype: np.dtype):
-        nonlocal pos
-        nbytes = cap * dtype.itemsize
-        view = buf[pos:pos + nbytes]
-        pos += nbytes
+    def __init__(self, bufs_by_key: dict):
+        self._bufs = bufs_by_key
+        self._pos = {k: 0 for k in bufs_by_key}
+
+    def take(self, cap: int, dtype: np.dtype) -> np.ndarray:
+        k = _canon_key(np.empty(0, dtype))
+        buf, pos = self._bufs[k], self._pos[k]
+        view = buf[pos:pos + cap]
+        self._pos[k] = pos + cap
         if dtype == np.bool_:
-            return view.view(np.uint8).astype(np.bool_)
-        return view.view(dtype)
+            return view.astype(np.bool_)
+        return view
+
+
+def _unpack_column(col: DeviceColumn, rd: _BufReader,
+                   out_cap: int, var_caps) -> DeviceColumn:
+    """Rebuild a numpy-backed shrunk column from the packed buffers."""
+    dt = col.dtype
+    take = rd.take
 
     if isinstance(dt, (t.StringType, t.BinaryType)):
         char_cap = next(var_caps)
@@ -164,25 +180,21 @@ def _unpack_column(col: DeviceColumn, buf: np.ndarray, pos: int,
             if col.validity is not None else None
         offsets = take(out_cap + 1, _np_dtype_of(col.offsets))
         return DeviceColumn(dt, data=data, validity=validity,
-                            offsets=offsets), pos
+                            offsets=offsets)
     if isinstance(dt, t.ArrayType):
         child_cap = next(var_caps)
         validity = take(out_cap, np.dtype(np.bool_)) \
             if col.validity is not None else None
         offsets = take(out_cap + 1, _np_dtype_of(col.offsets))
-        child, pos = _unpack_column(col.children[0], buf, pos, child_cap,
-                                    var_caps)
+        child = _unpack_column(col.children[0], rd, child_cap, var_caps)
         return DeviceColumn(dt, validity=validity, offsets=offsets,
-                            children=(child,)), pos
+                            children=(child,))
     if isinstance(dt, t.StructType):
         validity = take(out_cap, np.dtype(np.bool_)) \
             if col.validity is not None else None
-        children = []
-        for c in col.children:
-            ch, pos = _unpack_column(c, buf, pos, out_cap, var_caps)
-            children.append(ch)
-        return DeviceColumn(dt, validity=validity,
-                            children=tuple(children)), pos
+        children = tuple(_unpack_column(c, rd, out_cap, var_caps)
+                         for c in col.children)
+        return DeviceColumn(dt, validity=validity, children=children)
     data = take(out_cap, _np_dtype_of(col.data)) \
         if col.data is not None else None
     validity = take(out_cap, np.dtype(np.bool_)) \
@@ -190,7 +202,7 @@ def _unpack_column(col: DeviceColumn, buf: np.ndarray, pos: int,
     out = DeviceColumn(dt, data=data, validity=validity)
     if col.data_hi is not None:
         out.data_hi = take(out_cap, _np_dtype_of(col.data_hi))
-    return out, pos
+    return out
 
 
 def _schema_key(batch: DeviceBatch) -> tuple:
@@ -242,11 +254,13 @@ def fetch_batch(batch: DeviceBatch,
     vc = tuple(var_caps)
     pack_fn = process_jit(("fetch_pack", skey, out_cap, vc),
                           lambda: _make_shrink_pack_fn(out_cap, vc))
-    buf = np.asarray(pack_fn(batch))             # round trip 2
-    pos = 0
-    cols: List[DeviceColumn] = []
+    bufs = jax.device_get(pack_fn(batch))        # round trip 2 (one sync)
+    # reconstruct the device-side dtype-group order from the template
+    order = list(dict.fromkeys(
+        _canon_key(leaf) for c in batch.columns
+        for leaf in jax.tree_util.tree_leaves(c)))
+    assert len(order) == len(bufs), (order, [b.dtype for b in bufs])
+    rd = _BufReader(dict(zip(order, bufs)))
     caps_it = iter(vc)
-    for c in batch.columns:
-        nc, pos = _unpack_column(c, buf, pos, out_cap, caps_it)
-        cols.append(nc)
+    cols = [_unpack_column(c, rd, out_cap, caps_it) for c in batch.columns]
     return DeviceBatch(cols, n, batch.names)
