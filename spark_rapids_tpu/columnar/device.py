@@ -239,6 +239,25 @@ def column_to_device(arr: pa.Array, dtype: t.DataType, cap: int,
                             offsets=xp.asarray(offs_p),
                             children=(child_col,))
 
+    if isinstance(dtype, t.MapType):
+        # map<K,V> lowers as ARRAY<STRUCT<key,value>> minus the struct
+        # wrapper: offsets + (keys child, values child).  pyarrow's
+        # MapArray gives slice-adjusted offsets and full children.
+        offs64 = np.asarray(arr.offsets).astype(np.int64)
+        base = int(offs64[0])
+        offs = (offs64 - base).astype(np.int32)
+        nkv = int(offs[-1]) if n else 0
+        child_cap = bucket_for(max(nkv, 1), DEFAULT_ROW_BUCKETS)
+        kcol = column_to_device(arr.keys.slice(base, nkv), dtype.key_type,
+                                child_cap, char_buckets, xp)
+        vcol = column_to_device(arr.items.slice(base, nkv), dtype.value_type,
+                                child_cap, char_buckets, xp)
+        offs_p = np.full((cap + 1,), offs[-1] if n else 0, dtype=np.int32)
+        offs_p[:n + 1] = offs
+        return DeviceColumn(dtype, validity=validity,
+                            offsets=xp.asarray(offs_p),
+                            children=(kcol, vcol))
+
     if isinstance(dtype, t.StructType):
         children = []
         for i, f in enumerate(dtype.fields):
@@ -332,6 +351,19 @@ def column_to_arrow(col: DeviceColumn, n: int) -> pa.Array:
             arr = pa.array([None if m else v
                             for v, m in zip(arr.to_pylist(), mask)],
                            type=pa.large_list(to_arrow_type(dtype.element_type)))
+        return arr
+
+    if isinstance(dtype, t.MapType):
+        offs = np.asarray(col.offsets)[:n + 1].astype(np.int32)
+        child_n = int(offs[-1]) if n else 0
+        keys = column_to_arrow(col.children[0], child_n)
+        items = column_to_arrow(col.children[1], child_n)
+        arr = pa.MapArray.from_arrays(pa.array(offs, type=pa.int32()),
+                                      keys, items)
+        if mask is not None and mask.any():
+            arr = pa.array([None if m else v
+                            for v, m in zip(arr.to_pylist(), mask)],
+                           type=to_arrow_type(dtype))
         return arr
 
     if isinstance(dtype, t.StructType):

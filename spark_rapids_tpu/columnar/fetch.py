@@ -59,6 +59,11 @@ def _var_sizes(col: DeviceColumn, n) -> List:
         m = col.offsets[n]
         out.append(m.astype(jnp.int64))
         out += _var_sizes(col.children[0], m)
+    elif isinstance(dt, t.MapType):
+        m = col.offsets[n]
+        out.append(m.astype(jnp.int64))
+        out += _var_sizes(col.children[0], m)
+        out += _var_sizes(col.children[1], m)
     elif isinstance(dt, t.StructType):
         for c in col.children:
             out += _var_sizes(c, n)
@@ -105,6 +110,13 @@ def _shrink_column(col: DeviceColumn, out_cap: int, var_caps) -> DeviceColumn:
         return DeviceColumn(dt, validity=validity,
                             offsets=_slice_or_pad(col.offsets, out_cap + 1),
                             children=(child,))
+    if isinstance(dt, t.MapType):
+        child_cap = next(var_caps)
+        kcol = _shrink_column(col.children[0], child_cap, var_caps)
+        vcol = _shrink_column(col.children[1], child_cap, var_caps)
+        return DeviceColumn(dt, validity=validity,
+                            offsets=_slice_or_pad(col.offsets, out_cap + 1),
+                            children=(kcol, vcol))
     if isinstance(dt, t.StructType):
         children = tuple(_shrink_column(c, out_cap, var_caps)
                          for c in col.children)
@@ -189,6 +201,15 @@ def _unpack_column(col: DeviceColumn, rd: _BufReader,
         child = _unpack_column(col.children[0], rd, child_cap, var_caps)
         return DeviceColumn(dt, validity=validity, offsets=offsets,
                             children=(child,))
+    if isinstance(dt, t.MapType):
+        child_cap = next(var_caps)
+        validity = take(out_cap, np.dtype(np.bool_)) \
+            if col.validity is not None else None
+        offsets = take(out_cap + 1, _np_dtype_of(col.offsets))
+        kcol = _unpack_column(col.children[0], rd, child_cap, var_caps)
+        vcol = _unpack_column(col.children[1], rd, child_cap, var_caps)
+        return DeviceColumn(dt, validity=validity, offsets=offsets,
+                            children=(kcol, vcol))
     if isinstance(dt, t.StructType):
         validity = take(out_cap, np.dtype(np.bool_)) \
             if col.validity is not None else None
@@ -244,6 +265,11 @@ def fetch_batch(batch: DeviceBatch,
             m = int(next(it))
             var_caps.append(bucket_for(m, row_buckets))
             walk(col.children[0], it)
+        elif isinstance(dt, t.MapType):
+            m = int(next(it))
+            var_caps.append(bucket_for(m, row_buckets))
+            walk(col.children[0], it)
+            walk(col.children[1], it)
         elif isinstance(dt, t.StructType):
             for c in col.children:
                 walk(c, it)

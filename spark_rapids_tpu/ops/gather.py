@@ -63,6 +63,18 @@ def gather_column(xp, col: DeviceColumn, indices, valid,
         return DeviceColumn(dtype, offsets=new_offs, validity=new_valid,
                             children=(new_child,))
 
+    if isinstance(dtype, t.MapType):
+        kcol, vcol = col.children
+        cap = out_char_cap or kcol.capacity
+        new_offs, src_pos, in_range = gather_spans(
+            xp, col.offsets, idx, new_valid, cap)
+        src_pos = xp.clip(src_pos, 0, kcol.capacity - 1).astype(xp.int32)
+        return DeviceColumn(dtype, offsets=new_offs, validity=new_valid,
+                            children=(gather_column(xp, kcol, src_pos,
+                                                    in_range),
+                                      gather_column(xp, vcol, src_pos,
+                                                    in_range)))
+
     if isinstance(dtype, t.StructType):
         children = tuple(gather_column(xp, c, idx, new_valid)
                          for c in col.children)
