@@ -44,20 +44,43 @@ from .base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU, Batch,
                    Exec, ExecContext, MetricTimer, maybe_sync, process_jit,
                    schema_sig, semantic_sig)
 from .concat import concat_batches
+from ..ops.scan import cumsum_fast
 
 
 def _group_reduce(xp, key_cols: List[DeviceColumn],
                   value_cols: List[DeviceColumn], ops: List[str],
                   cap: int, live, global_agg: bool):
     """Core sort+segment kernel.  Returns (out_key_cols, out_value_cols,
-    num_groups)."""
-    # --- sort keys ----------------------------------------------------------
-    words: List = [(~live).astype(xp.uint64)]  # padding rows sort last
+    num_groups).
+
+    Round-4 kernel structure (see ops/carry.py docstring for the chip
+    measurements behind it):
+
+      1. ONE stable carry-sort by the key words — every flat lane of the
+         key and value columns rides the sort as a payload operand, so no
+         post-sort row gathers.
+      2. Per sum/count: a Hillis-Steele prefix scan + elementwise
+         exclusive value — the per-segment total is the difference of the
+         exclusive scan at consecutive segment starts.  No 64-bit
+         scatters anywhere; float sums scan finite values only and
+         rebuild IEEE inf/nan from per-segment special-value counts.
+      3. ONE carry-compaction-sort moves the boundary rows (and all
+         per-op scan lanes + flat key lanes) to the slot positions.
+      4. min/max/first/last use int32 scatter tournaments + one row
+         gather; variable-width columns keep the gather-based paths.
+    """
+    from ..ops import carry
+    # --- sort keys, carrying all row data -----------------------------------
+    words: List = [(~live).astype(xp.uint8)]  # padding rows sort last
     for kc in key_cols:
         words += seg.key_words_for_column(xp, kc, live, for_grouping=True)
-    order = seg.lexsort(xp, words, cap)
-    sorted_words = [w[order] for w in words[1:]]  # drop padding word
-    live_sorted = live[order]
+    all_cols = list(key_cols) + list(value_cols)
+    order, sorted_cols, ex = carry.sort_rows(
+        xp, words, all_cols, cap, extras=[live] + words[1:])
+    key_sorted = sorted_cols[:len(key_cols)]
+    val_sorted = sorted_cols[len(key_cols):]
+    live_sorted = ex[0]
+    sorted_words = ex[1:]
     if global_agg:
         new_group = xp.arange(cap, dtype=np.int32) == 0
     else:
@@ -66,104 +89,209 @@ def _group_reduce(xp, key_cols: List[DeviceColumn],
     seg_ids = xp.clip(seg_ids, 0, cap - 1)
     num_groups = xp.sum(new_group.astype(np.int32)) if not global_agg \
         else xp.int32(1) if xp is not np else np.int32(1)
-    slot_valid = xp.arange(cap, dtype=np.int32) < num_groups
+    iota_slots = xp.arange(cap, dtype=np.int32)
+    slot_valid = iota_slots < num_groups
 
-    # --- reduce buffers -----------------------------------------------------
-    out_values: List[DeviceColumn] = []
-    for vc, op in zip(value_cols, ops):
-        validity = vc.validity if vc.validity is not None else \
-            xp.ones((cap,), dtype=bool)
-        validity_sorted = validity[order] & live_sorted
+    # --- deferred scan lanes (compacted once, below) ------------------------
+    lanes: List = [iota_slots]        # lane 0 -> first row index per slot
+    lane_pos: dict = {}
+
+    def enlane(a) -> int:
+        k = id(a)
+        if k not in lane_pos:
+            lane_pos[k] = len(lanes)
+            lanes.append(a)
+        return lane_pos[k]
+
+    count_cache: dict = {}
+
+    def count_lane(mask) -> tuple:
+        """(lane index, total) of the exclusive scan of an int32 mask.
+        The cache RETAINS each mask: a bare id() key could alias a new
+        mask after a temporary is garbage-collected (np engine path)."""
+        k = id(mask)
+        hit = count_cache.get(k)
+        if hit is not None and hit[0] is mask:
+            return hit[1]
+        m32 = mask.astype(np.int32)
+        cs = seg.cumsum_fast(xp, m32)
+        val = (enlane(cs - m32), cs[-1])
+        count_cache[k] = (mask, val)
+        return val
+
+    sum_jobs: List[dict] = []
+    out_values: List[Optional[DeviceColumn]] = [None] * len(ops)
+
+    for oi, (vs, op) in enumerate(zip(val_sorted, ops)):
+        validity_sorted = live_sorted if vs.validity is None else \
+            (vs.validity & live_sorted)
         if op in ("collect_list", "collect_set"):
-            out_values.append(_collect_update(
-                xp, vc, order, seg_ids, validity_sorted, cap, slot_valid,
-                dedupe=(op == "collect_set")))
+            out_values[oi] = _collect_update(
+                xp, vs, seg_ids, validity_sorted, cap, slot_valid,
+                dedupe=(op == "collect_set"))
             continue
         if op in ("collect_concat", "collect_concat_set"):
-            out_values.append(_collect_merge(
-                xp, vc, order, seg_ids, validity_sorted, cap, slot_valid,
-                dedupe=(op == "collect_concat_set")))
+            out_values[oi] = _collect_merge(
+                xp, value_cols[oi], order, seg_ids, validity_sorted, cap,
+                slot_valid, dedupe=(op == "collect_concat_set"))
             continue
         if op == "countvalid":
-            _, cnt = seg.segment_reduce(
-                xp, "sum", xp.zeros((cap,), np.int64), seg_ids, cap,
-                validity_sorted)
-            out_values.append(DeviceColumn(
-                t.LONG, data=cnt.astype(np.int64), validity=slot_valid))
+            li, total = count_lane(validity_sorted)
+            sum_jobs.append(dict(kind="count", out=oi, lane=li,
+                                 total=total))
             continue
         if op.endswith("_any"):
             base_op = op[:-4]
-            idx_vals = xp.arange(cap, dtype=np.int64)
             contrib = live_sorted
         else:
             base_op = op
             contrib = validity_sorted
-        is_dec128 = vc.data_hi is not None
+        is_dec128 = vs.data_hi is not None
         if is_dec128 and base_op == "sum":
-            lo_s = vc.data[order]
-            hi_s = vc.data_hi[order]
-            lo_o, hi_o, cnt = seg.segment_sum128(xp, lo_s, hi_s, seg_ids,
-                                                 cap, contrib)
+            lo_o, hi_o, cnt = seg.segment_sum128(xp, vs.data, vs.data_hi,
+                                                 seg_ids, cap, contrib,
+                                                 sorted_ids=True)
             validity_out = (cnt > 0) & slot_valid
-            out_values.append(DeviceColumn(
-                vc.dtype,
+            out_values[oi] = DeviceColumn(
+                vs.dtype,
                 data=xp.where(validity_out, lo_o, xp.zeros_like(lo_o)),
                 data_hi=xp.where(validity_out, hi_o, xp.zeros_like(hi_o)),
-                validity=validity_out))
+                validity=validity_out)
             continue
         if op in ("first", "last", "first_any", "last_any") or \
-                _needs_index_gather(vc.dtype) or is_dec128:
-            perm_col = _permuted(xp, vc, order)
+                _needs_index_gather(vs.dtype) or is_dec128:
             if base_op in ("min", "max") and \
                     (is_dec128 or
-                     isinstance(vc.dtype, (t.StringType, t.BinaryType))):
+                     isinstance(vs.dtype, (t.StringType, t.BinaryType))):
                 # ordered reduce for variable-width values: secondary sort
                 # by (segment, validity, value words), first row per
                 # segment wins.  Value words are the same prefix+length
                 # encoding the sort exec orders by; max inverts them.
                 vwords = seg.key_words_for_column(
-                    xp, perm_col, contrib, for_grouping=False,
+                    xp, vs, contrib, for_grouping=False,
                     ascending=(base_op == "min"))
-                words2 = [seg_ids.astype(xp.uint64),
-                          (~contrib).astype(xp.uint64)] + vwords[1:]
+                words2 = [seg_ids.astype(xp.uint32),
+                          (~contrib).astype(xp.uint8)] + vwords[1:]
                 order2 = seg.lexsort(xp, words2, cap)
                 first2 = seg.first_index_per_segment(
                     xp, seg_ids[order2], cap, contrib[order2])
                 idx = order2[first2].astype(xp.int32)
                 _, cnt = seg.segment_reduce(
-                    xp, "sum", xp.zeros((cap,), np.int64), seg_ids, cap,
-                    contrib)
+                    xp, "sum", xp.zeros((cap,), np.int32), seg_ids, cap,
+                    contrib, sorted_ids=True)
             else:
-                pos = xp.arange(cap, dtype=np.int64)
+                pos = xp.arange(cap, dtype=np.int32)
                 which = "first" if base_op in ("first", "min") else \
                     ("last" if base_op in ("last",) else "first")
                 idx, cnt = seg.segment_reduce(xp, which, pos, seg_ids, cap,
-                                              contrib)
+                                              contrib, sorted_ids=True)
                 idx = idx.astype(xp.int32)
-            gathered = gather_column(
-                xp, perm_col, idx,
-                (cnt > 0) & slot_valid)
-            if op.endswith("_any"):
-                gathered = DeviceColumn(vc.dtype, data=gathered.data,
-                                        offsets=gathered.offsets,
-                                        data_hi=gathered.data_hi,
-                                        children=gathered.children,
-                                        validity=gathered.validity)
-            out_values.append(gathered)
+            gathered = gather_column(xp, vs, idx, (cnt > 0) & slot_valid)
+            out_values[oi] = gathered
             continue
-        data_sorted = vc.data[order]
-        out, cnt = seg.segment_reduce(xp, base_op, data_sorted, seg_ids,
-                                      cap, contrib)
+        if base_op in ("min", "max"):
+            out, cnt = seg.segment_reduce(xp, base_op, vs.data, seg_ids,
+                                          cap, contrib, sorted_ids=True)
+            validity_out = (cnt > 0) & slot_valid
+            out = xp.where(validity_out, out, xp.zeros_like(out))
+            out_values[oi] = DeviceColumn(vs.dtype, data=out,
+                                          validity=validity_out)
+            continue
+        # sum via prefix scans: integers use global-scan differencing
+        # (exact modulo 2^width); floats use a segmented scan — a global
+        # float prefix lets one segment's magnitude catastrophically
+        # cancel another's, and inf/nan would poison later segments
+        data = vs.data
+        vals0 = xp.where(contrib, data, xp.zeros_like(data))
+        job = dict(kind="sum", out=oi, dtype=vs.dtype)
+        if np.dtype(data.dtype).kind == "f":
+            finite = xp.isfinite(vals0)
+            scan_vals = xp.where(finite, vals0, xp.zeros_like(vals0))
+            job["pi"] = count_lane(contrib & (data == xp.inf))
+            job["ni"] = count_lane(contrib & (data == -xp.inf))
+            job["nan"] = count_lane(contrib & xp.isnan(data))
+            from ..ops.scan import segmented_cumsum_fast
+            sseg = segmented_cumsum_fast(xp, scan_vals, new_group)
+            # at a segment's first row, the PREVIOUS row closes the
+            # previous segment — compacting the shifted lane puts each
+            # segment's total at slot+1
+            shifted = xp.concatenate([xp.zeros((1,), sseg.dtype),
+                                      sseg[:-1]])
+            job["kind"] = "sum_seg"
+            job["lane"] = enlane(shifted)
+            job["total"] = sseg[-1]
+        else:
+            cs = seg.cumsum_fast(xp, vals0)
+            job["lane"] = enlane(cs - vals0)
+            job["total"] = cs[-1]
+        job["cnt"] = count_lane(contrib)
+        sum_jobs.append(job)
+
+    # --- flat key lanes join the compaction ---------------------------------
+    import jax
+    key_plans = []
+    for ks in key_sorted:
+        if carry.carriable(ks):
+            leaves, treedef = jax.tree_util.tree_flatten(ks)
+            key_plans.append((treedef, [enlane(l) for l in leaves]))
+        else:
+            key_plans.append((None, None))
+
+    # --- ONE compaction: boundary rows -> slot positions --------------------
+    ckey = (~new_group).astype(xp.uint8)
+    _, comp = carry.sort_lanes(xp, [ckey], lanes, cap)
+    first_idx = xp.clip(comp[0], 0, cap - 1).astype(xp.int32)
+
+    def span_next(lane_idx, total):
+        """Per-slot value from the NEXT slot's compacted lane entry; the
+        last live slot reads the whole-array closing value."""
+        E = comp[lane_idx]
+        nxt = xp.concatenate([E[1:], xp.zeros((1,), E.dtype)])
+        last = iota_slots == (num_groups - 1)
+        return xp.where(last, xp.asarray(total, dtype=E.dtype), nxt)
+
+    def span_diff(lane_idx, total):
+        """Per-slot total from a compacted exclusive scan: the difference
+        of consecutive segment starts; the last live slot closes on the
+        whole-array total."""
+        return span_next(lane_idx, total) - comp[lane_idx]
+
+    for job in sum_jobs:
+        cnt_lane, cnt_total = job["cnt"] if job["kind"] != "count" \
+            else (job["lane"], job["total"])
+        cnt = span_diff(cnt_lane, cnt_total)
+        if job["kind"] == "count":
+            out_values[job["out"]] = DeviceColumn(
+                t.LONG, data=cnt.astype(np.int64), validity=slot_valid)
+            continue
+        if job["kind"] == "sum_seg":
+            out = span_next(job["lane"], job["total"])
+        else:
+            out = span_diff(job["lane"], job["total"])
+        if "pi" in job:
+            n_pi = span_diff(*job["pi"])
+            n_ni = span_diff(*job["ni"])
+            n_nan = span_diff(*job["nan"])
+            out = xp.where((n_nan > 0) | ((n_pi > 0) & (n_ni > 0)),
+                           xp.full_like(out, xp.nan), out)
+            out = xp.where((n_pi > 0) & (n_ni == 0) & (n_nan == 0),
+                           xp.full_like(out, xp.inf), out)
+            out = xp.where((n_ni > 0) & (n_pi == 0) & (n_nan == 0),
+                           xp.full_like(out, -xp.inf), out)
         validity_out = (cnt > 0) & slot_valid
         out = xp.where(validity_out, out, xp.zeros_like(out))
-        col = DeviceColumn(vc.dtype, data=out, validity=validity_out)
-        out_values.append(col)
+        out_values[job["out"]] = DeviceColumn(job["dtype"], data=out,
+                                              validity=validity_out)
 
-    # --- gather group key values -------------------------------------------
-    first_idx = seg.first_index_per_segment(xp, seg_ids, cap, new_group)
-    out_keys = [gather_column(xp, _permuted(xp, kc, order), first_idx,
-                              slot_valid)
-                for kc in key_cols]
+    # --- group key values at slot positions ---------------------------------
+    out_keys = []
+    for ks, (treedef, lidx) in zip(key_sorted, key_plans):
+        if treedef is None:
+            out_keys.append(gather_column(xp, ks, first_idx, slot_valid))
+        else:
+            col = jax.tree_util.tree_unflatten(
+                treedef, [comp[i] for i in lidx])
+            out_keys.append(carry.mask_validity(xp, col, slot_valid))
     return out_keys, out_values, num_groups
 
 
@@ -172,26 +300,27 @@ def _permuted(xp, col: DeviceColumn, order) -> DeviceColumn:
     return gather_column(xp, col, order, all_valid)
 
 
-def _collect_update(xp, vc: DeviceColumn, order, seg_ids, contrib, cap: int,
+def _collect_update(xp, vc: DeviceColumn, seg_ids, contrib, cap: int,
                     slot_valid, dedupe: bool) -> DeviceColumn:
     """collect_list / collect_set over key-sorted rows (ref
     AggregateFunctions.scala GpuCollectList/GpuCollectSet).
 
-    The sort by grouping key makes each group's rows contiguous, so the
+    `vc` arrives already key-sorted (carried through the main sort).  The
+    sort by grouping key makes each group's rows contiguous, so the
     collected child buffer is a stable compaction of contributing values;
     null values are dropped (Spark semantics) and sets dedupe within the
     segment by value words."""
-    perm = _permuted(xp, vc, order)
+    perm = vc
     keep = contrib
     sids = seg_ids
     if dedupe:
         # order by (segment, value), first occurrence survives
         vwords = seg.key_words_for_column(xp, perm, keep, for_grouping=True)
-        words2 = [(~keep).astype(xp.uint64),
-                  sids.astype(xp.uint64)] + vwords
+        words2 = [(~keep).astype(xp.uint8),
+                  sids.astype(xp.uint32)] + vwords
         order2 = seg.lexsort(xp, words2, cap)
         keep_s = keep[order2]
-        sw = [sids[order2].astype(xp.uint64)] + [w[order2] for w in vwords]
+        sw = [sids[order2].astype(xp.uint32)] + [w[order2] for w in vwords]
         first = seg.segment_boundaries(xp, sw, keep_s)
         perm = gather_column(xp, perm, order2,
                              xp.ones((cap,), dtype=bool))
@@ -206,10 +335,10 @@ def _collect_update(xp, vc: DeviceColumn, order, seg_ids, contrib, cap: int,
         order3 = lax.sort(((~keep).astype(xp.int32), iota), num_keys=1,
                           is_stable=True)[1]
     child = gather_column(xp, perm, order3, keep[order3])
-    cnt, _ = seg.segment_reduce(xp, "sum", keep.astype(np.int64), sids,
-                                cap, keep)
+    cnt, _ = seg.segment_reduce(xp, "sum", keep.astype(np.int32), sids,
+                                cap, keep, sorted_ids=True)
     offs = xp.concatenate([xp.zeros((1,), np.int32),
-                           xp.cumsum(cnt).astype(xp.int32)])
+                           cumsum_fast(xp, cnt).astype(xp.int32)])
     return DeviceColumn(t.ArrayType(vc.dtype), offsets=offs,
                         validity=slot_valid, children=(child,))
 
@@ -228,7 +357,7 @@ def _collect_merge(xp, vc: DeviceColumn, order, seg_ids, contrib, cap: int,
         cnt, _ = seg.segment_reduce(xp, "sum", lens, seg_ids, cap,
                                     xp.ones((cap,), dtype=bool))
         offs = xp.concatenate([xp.zeros((1,), np.int32),
-                               xp.cumsum(cnt).astype(xp.int32)])
+                               cumsum_fast(xp, cnt).astype(xp.int32)])
         return DeviceColumn(t.ArrayType(child.dtype), offsets=offs,
                             validity=slot_valid, children=(child,))
     # element -> segment mapping via the row each child position came from
@@ -260,7 +389,7 @@ def _collect_merge(xp, vc: DeviceColumn, order, seg_ids, contrib, cap: int,
     cnt, _ = seg.segment_reduce(xp, "sum", keep.astype(np.int64), cseg_s,
                                 cap, keep)
     offs = xp.concatenate([xp.zeros((1,), np.int32),
-                           xp.cumsum(cnt).astype(xp.int32)])
+                           cumsum_fast(xp, cnt).astype(xp.int32)])
     return DeviceColumn(t.ArrayType(child.dtype), offsets=offs,
                         validity=slot_valid, children=(final_child,))
 
@@ -417,6 +546,16 @@ class TpuHashAggregateExec(Exec):
                            lambda: lambda b: self._evaluate_batch(jnp, b))
 
     @property
+    def _jit_complete(self):
+        """Single-batch Complete mode: update + evaluate fused into ONE
+        compiled program — a lone input batch leaves _group_reduce with
+        unique keys, so the merge pass would be an expensive no-op."""
+        return process_jit(
+            self._jit_key + ("complete",),
+            lambda: lambda b: self._evaluate_batch(jnp,
+                                                   self._update_batch(jnp, b)))
+
+    @property
     def _jit_sortkeys(self):
         return process_jit(self._jit_key + ("sortkeys",),
                            lambda: lambda b: self._sort_by_keys(jnp, b))
@@ -448,7 +587,33 @@ class TpuHashAggregateExec(Exec):
         schema_types = kt + self._buffer_types
         from ..memory.spill import SpillCatalog, SpillPriority
         spill = SpillCatalog.get()
-        for b in self.children[0].execute_partition(pid, ctx):
+        it = iter(self.children[0].execute_partition(pid, ctx))
+        first = next(it, None)
+        second = next(it, None) if first is not None else None
+        if first is not None and second is None and \
+                self.mode in (PARTIAL, COMPLETE):
+            # single input batch: _group_reduce leaves unique keys, so
+            # the cross-batch merge would be a no-op re-sort.  PARTIAL
+            # emits the update output directly; COMPLETE fuses
+            # update+evaluate into one compiled program.
+            with MetricTimer(self.metrics[OP_TIME]):
+                if not on_tpu:
+                    out = self._update_batch(np, first)
+                    if self.mode == COMPLETE:
+                        out = self._evaluate_batch(np, out)
+                elif self.mode == COMPLETE:
+                    out = self._jit_complete(first)
+                else:
+                    out = self._jit_update(first)
+                maybe_sync(out)
+            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+            self.metrics[NUM_OUTPUT_BATCHES] += 1
+            yield out
+            return
+        import itertools
+        stream = (b for b in itertools.chain(
+            [x for x in (first, second) if x is not None], it))
+        for b in stream:
             with MetricTimer(self.metrics[OP_TIME]):
                 if self.mode in (PARTIAL, COMPLETE):
                     out = self._jit_update(b) if on_tpu else \

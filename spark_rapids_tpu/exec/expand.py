@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Iterator, List
 
 import numpy as np
+from ..ops.scan import cumsum_fast
 
 from .. import types as t
 from ..columnar.device import (DEFAULT_ROW_BUCKETS, DeviceBatch, DeviceColumn,
@@ -121,7 +122,7 @@ class GenerateExec(Exec):
                 else:
                     eff = xp.where(live, lens, 0)
                 cum = xp.concatenate([xp.zeros((1,), np.int32),
-                                      xp.cumsum(eff, dtype=np.int32)])
+                                      cumsum_fast(xp, eff, dtype=np.int32)])
                 total = int(cum[-1])
                 out_cap = bucket_for(max(total, 1), DEFAULT_ROW_BUCKETS)
                 p = xp.arange(out_cap, dtype=np.int32)

@@ -29,19 +29,16 @@ def keep_flags(xp, batch: DeviceBatch, pred_value):
 
 
 def compact(xp, batch: DeviceBatch, keep, names):
-    """Move kept rows to the front (stable), shrink num_rows."""
+    """Move kept rows to the front (stable), shrink num_rows.  One
+    carry-sort on the keep flag; dropped rows become padding (validity
+    masked off per the batch contract)."""
+    from ..ops.carry import compact_rows, mask_validity
     cap = batch.capacity
-    if xp is np:
-        order = np.argsort(~keep, kind="stable").astype(np.int32)
-    else:
-        from jax import lax
-        iota = xp.arange(cap, dtype=xp.int32)
-        order = lax.sort(((~keep).astype(xp.int32), iota), num_keys=1,
-                         is_stable=True)[1]
     new_n = xp.sum(keep.astype(np.int32))
     valid_slot = xp.arange(cap, dtype=np.int32) < new_n
-    out = gather_batch(xp, batch, order, valid_slot, new_n)
-    return DeviceBatch(out.columns, new_n, names)
+    _, cols, _ = compact_rows(xp, keep, batch.columns, cap)
+    cols = [mask_validity(xp, c, valid_slot) for c in cols]
+    return DeviceBatch(cols, new_n, names)
 
 
 def apply_filter(xp, batch: DeviceBatch, pred_value, names):

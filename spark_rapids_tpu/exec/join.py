@@ -41,6 +41,7 @@ from .base import (maybe_sync,  # noqa: F401
                    Exec, MetricTimer, process_jit, schema_sig, semantic_sig)
 from .concat import concat_batches
 from .filter_common import apply_filter, compact
+from ..ops.scan import cumsum_fast
 
 JOIN_TYPES = ("inner", "left", "right", "full", "left_semi", "left_anti",
               "cross")
@@ -166,7 +167,7 @@ class HashJoinExec(Exec):
                 lens = (c.offsets[1:] - c.offsets[:-1]).astype(xp.int64)
                 sl = lens[order]
                 pre = xp.concatenate([xp.zeros((1,), xp.int64),
-                                      xp.cumsum(sl)])
+                                      cumsum_fast(xp, sl)])
                 per = pre[lo + counts.astype(xp.int32)] - pre[lo]
                 bbytes.append(xp.sum(xp.where(plive, per, 0)))
             else:

@@ -58,7 +58,7 @@ class SortExec(Exec):
     def _sort_batch(self, xp, batch: Batch) -> Batch:
         ctx = EvalContext(xp, batch)
         live = ctx.row_mask()
-        words: List = [(~live).astype(xp.uint64)]  # padding last
+        words: List = [(~live).astype(xp.uint8)]  # padding last
         for e, asc, nulls_first in self._bound:
             v = e.eval(ctx)
             from ..expr.core import ColumnValue, make_column
@@ -69,9 +69,10 @@ class SortExec(Exec):
             words += seg.key_words_for_column(
                 xp, v.col, live, for_grouping=False,
                 nulls_first=nulls_first, ascending=asc)
-        order = seg.lexsort(xp, words, batch.capacity)
-        out = gather_batch(xp, batch, order, live[order], batch.num_rows)
-        return DeviceBatch(out.columns, batch.num_rows, batch.names)
+        from ..ops import carry
+        _, cols, _ = carry.sort_rows(xp, words, batch.columns,
+                                     batch.capacity)
+        return DeviceBatch(cols, batch.num_rows, batch.names)
 
     @functools.cached_property
     def _jit_key(self):

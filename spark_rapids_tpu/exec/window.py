@@ -37,6 +37,7 @@ from .base import (maybe_sync,  # noqa: F401
                    NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU, Batch,
                    Exec, MetricTimer, process_jit, schema_sig, semantic_sig)
 from .concat import concat_batches
+from ..ops.scan import cumsum_fast
 
 
 def _seg_start_positions(xp, new_seg):
@@ -54,7 +55,7 @@ def _run_end_positions(xp, new_run):
     position within each run, broadcast back."""
     n = new_run.shape[0]
     pos = xp.arange(n, dtype=xp.int64)
-    run_id = (xp.cumsum(new_run.astype(xp.int64)) - 1).astype(xp.int32)
+    run_id = (cumsum_fast(xp, new_run.astype(xp.int64)) - 1).astype(xp.int32)
     run_id = xp.clip(run_id, 0, n - 1)
     last, _ = seg.segment_reduce(xp, "max", pos, run_id, n,
                                  xp.ones((n,), dtype=bool))
@@ -165,7 +166,7 @@ class WindowExec(Exec):
             return finish((run_start - seg_start + 1).astype(np.int32),
                           live_s)
         if type(func) is DenseRank:
-            runs_cum = xp.cumsum(new_run.astype(xp.int64))
+            runs_cum = cumsum_fast(xp, new_run.astype(xp.int64))
             base = runs_cum[xp.clip(seg_start, 0, cap - 1)] - \
                 new_run[xp.clip(seg_start, 0, cap - 1)].astype(xp.int64)
             return finish((runs_cum - base).astype(np.int32), live_s)
@@ -187,7 +188,7 @@ class WindowExec(Exec):
         if type(func) is CumeDist:
             # last LIVE row of the current peer run (padding excluded)
             run_id = xp.clip(
-                (xp.cumsum(new_run.astype(xp.int64)) - 1).astype(
+                (cumsum_fast(xp, new_run.astype(xp.int64)) - 1).astype(
                     xp.int32), 0, cap - 1)
             run_max, _ = seg.segment_reduce(xp, "max", pos, run_id, cap,
                                             live_s)
@@ -296,12 +297,12 @@ class WindowExec(Exec):
                     empty = hi_c < lo_c
                     cpre = xp.concatenate([
                         xp.zeros((1,), xp.int64),
-                        xp.cumsum(val.astype(xp.int64))])
+                        cumsum_fast(xp, val.astype(xp.int64))])
                     c = cpre[hi_c + 1] - cpre[lo_c]
                     c = xp.where(empty, xp.zeros_like(c), c)
                     if red_op == "sum":
                         pre = xp.concatenate([xp.zeros((1,), vv.dtype),
-                                              xp.cumsum(vv)])
+                                              cumsum_fast(xp, vv)])
                         s = pre[hi_c + 1] - pre[lo_c]
                         s = xp.where(empty, xp.zeros_like(s), s)
                         results.append((s, c))
@@ -396,11 +397,11 @@ class WindowExec(Exec):
 
     def _running(self, xp, red_op, vv, val, new_seg, seg_start):
         if red_op == "sum":
-            cs = xp.cumsum(vv)
+            cs = cumsum_fast(xp, vv)
             base = xp.where(seg_start > 0,
                             cs[xp.clip(seg_start - 1, 0, None)],
                             xp.zeros((), dtype=cs.dtype))
-            ccs = xp.cumsum(val.astype(xp.int64))
+            ccs = cumsum_fast(xp, val.astype(xp.int64))
             cbase = xp.where(seg_start > 0,
                              ccs[xp.clip(seg_start - 1, 0, None)],
                              xp.zeros((), dtype=xp.int64))
@@ -408,7 +409,7 @@ class WindowExec(Exec):
         if red_op in ("min", "max"):
             out = _segmented_running_minmax(xp, vv, new_seg,
                                             red_op == "min")
-            ccs = xp.cumsum(val.astype(xp.int64))
+            ccs = cumsum_fast(xp, val.astype(xp.int64))
             cbase = xp.where(seg_start > 0,
                              ccs[xp.clip(seg_start - 1, 0, None)],
                              xp.zeros((), dtype=xp.int64))

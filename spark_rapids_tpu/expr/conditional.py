@@ -14,6 +14,7 @@ from .core import (ColumnValue, EvalContext, Expression, ScalarValue,
                    and_validity, data_of, evaluator, make_column,
                    validity_of)
 from .predicates import _bool_parts
+from ..ops.scan import cumsum_fast
 
 
 def _common_type(exprs):
@@ -249,7 +250,7 @@ def _string_select(ctx: EvalContext, conds, values, else_value, out):
     out_char_cap = max(int(c.col.data.shape[0]) for c in all_cols)
     new_offs = xp.concatenate([
         xp.zeros((1,), xp.int32),
-        xp.cumsum(xp.where(validity, lens, 0), dtype=xp.int32)])
+        cumsum_fast(xp, xp.where(validity, lens, 0), dtype=xp.int32)])
     p = xp.arange(out_char_cap, dtype=xp.int32)
     prow = xp.clip(xp.searchsorted(new_offs[1:], p, side="right"),
                    0, cap - 1).astype(xp.int32)

@@ -20,6 +20,7 @@ from __future__ import annotations
 from typing import List
 
 import numpy as np
+from ..ops.scan import cumsum_fast
 
 from .. import types as t
 from ..columnar.device import DeviceColumn
@@ -178,7 +179,7 @@ def _eval_array_filter(e: ArrayFilter, ctx: EvalContext):
     # new offsets: per-row kept counts
     kept_cum = xp.concatenate([
         xp.zeros((1,), np.int64),
-        xp.cumsum(keep.astype(np.int64))])
+        cumsum_fast(xp, keep.astype(np.int64))])
     new_offsets = kept_cum[col.offsets.astype(np.int64)].astype(np.int32)
     # stable-compact kept elements to the front
     order = xp.argsort(~keep, stable=True).astype(np.int32)
@@ -222,7 +223,7 @@ def _segmented_bool(e: ArrayHigherOrder, ctx: EvalContext, want_all: bool):
 
     def per_row_count(mask):
         cum = xp.concatenate([
-            xp.zeros((1,), np.int64), xp.cumsum(mask.astype(np.int64))])
+            xp.zeros((1,), np.int64), cumsum_fast(xp, mask.astype(np.int64))])
         return (cum[col.offsets[1:].astype(np.int64)] -
                 cum[col.offsets[:-1].astype(np.int64)])
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 from typing import Optional
 
 import numpy as np
+from ..ops.scan import cumsum_fast
 
 from .. import types as t
 from ..columnar.device import DEFAULT_CHAR_BUCKETS, DeviceColumn, bucket_for
@@ -104,7 +105,7 @@ def _eval_length(e, ctx: EvalContext):
     xp = ctx.xp
     # Spark length() counts characters, not bytes
     starts = _char_starts(xp, col.data).astype(xp.int32)
-    pre = xp.concatenate([xp.zeros((1,), xp.int32), xp.cumsum(starts,
+    pre = xp.concatenate([xp.zeros((1,), xp.int32), cumsum_fast(xp, starts,
                                                               dtype=xp.int32)])
     nchars = pre[col.offsets[1:]] - pre[col.offsets[:-1]]
     return make_column(ctx, t.INT, nchars.astype(np.int32), col.validity)
@@ -188,7 +189,7 @@ def _eval_substring(e: Substring, ctx: EvalContext):
         if hasattr(ln, "astype"):
             ln = ln.astype(xp.int64)
     starts = _char_starts(xp, col.data).astype(xp.int64)
-    pre = xp.concatenate([xp.zeros((1,), xp.int64), xp.cumsum(starts)])
+    pre = xp.concatenate([xp.zeros((1,), xp.int64), cumsum_fast(xp, starts)])
     row_char0 = pre[col.offsets[:-1]]
     nchars = pre[col.offsets[1:]] - row_char0
     # resolve 1-based/negative pos to 0-based char index
@@ -223,7 +224,7 @@ def _eval_substring(e: Substring, ctx: EvalContext):
         xp.ones((ctx.capacity,), dtype=bool)
     new_offs = xp.concatenate([
         xp.zeros((1,), xp.int32),
-        xp.cumsum(xp.where(valid, new_lens, 0), dtype=xp.int32)])
+        cumsum_fast(xp, xp.where(valid, new_lens, 0), dtype=xp.int32)])
     out_cap = int(col.data.shape[0])
     q = xp.arange(out_cap, dtype=xp.int32)
     row = xp.clip(xp.searchsorted(new_offs[1:], q, side="right"),
@@ -273,7 +274,7 @@ def _eval_concat(e: Concat, ctx: EvalContext):
         total_len = total_len + l
     total_len = xp.where(validity, total_len, 0)
     new_offs = xp.concatenate([xp.zeros((1,), xp.int32),
-                               xp.cumsum(total_len, dtype=xp.int32)])
+                               cumsum_fast(xp, total_len, dtype=xp.int32)])
     out_cap = int(sum(int(c.data.shape[0]) for c in cols))
     out_cap = bucket_for(out_cap, DEFAULT_CHAR_BUCKETS)
     q = xp.arange(out_cap, dtype=xp.int32)
@@ -312,7 +313,7 @@ def _trim_impl(e: Trim, ctx: EvalContext):
     cap = ctx.capacity
     is_sp = col.data == np.uint8(32)
     nsp = xp.concatenate([xp.zeros((1,), xp.int64),
-                          xp.cumsum((~is_sp).astype(xp.int64))])
+                          cumsum_fast(xp, (~is_sp).astype(xp.int64))])
     o0 = col.offsets[:-1].astype(xp.int64)
     o1 = col.offsets[1:].astype(xp.int64)
     if e.mode in ("both", "left"):
@@ -335,7 +336,7 @@ def _trim_impl(e: Trim, ctx: EvalContext):
     new_lens = b1 - b0
     new_offs = xp.concatenate([
         xp.zeros((1,), xp.int32),
-        xp.cumsum(xp.where(valid, new_lens, 0), dtype=xp.int32)])
+        cumsum_fast(xp, xp.where(valid, new_lens, 0), dtype=xp.int32)])
     out_cap = int(col.data.shape[0])
     q = xp.arange(out_cap, dtype=xp.int32)
     row = xp.clip(xp.searchsorted(new_offs[1:], q, side="right"),
@@ -411,7 +412,7 @@ def _contains_impl(e, ctx: EvalContext, kind: str):
         data = (o1 - o0 >= L) & m[p]
     else:
         pre = xp.concatenate([xp.zeros((1,), xp.int64),
-                              xp.cumsum(m.astype(xp.int64))])
+                              cumsum_fast(xp, m.astype(xp.int64))])
         hi = xp.clip(o1 - L + 1, o0, col.data.shape[0])
         data = (pre[hi] - pre[o0]) > 0
     return make_column(ctx, t.BOOLEAN, data, val)
@@ -482,7 +483,7 @@ def _eval_like(e: Like, ctx: EvalContext):
     for tok in middles:
         m = _match_positions(xp, col.data, tok, wc)
         pre = xp.concatenate([xp.zeros((1,), xp.int64),
-                              xp.cumsum(m.astype(xp.int64))])
+                              cumsum_fast(xp, m.astype(xp.int64))])
         limit = o1 - len(last) - len(tok) + 1
         limit = xp.clip(limit, cur, col.data.shape[0])
         found = (pre[limit] - pre[xp.clip(cur, 0, col.data.shape[0])]) > 0
@@ -549,7 +550,7 @@ def _eval_replace(e: StringReplace, ctx: EvalContext):
     cl = xp.where(m, np.int32(R), xp.where(in_match_tail, np.int32(0),
                                            np.int32(1)))
     cpre = xp.concatenate([xp.zeros((1,), xp.int32),
-                           xp.cumsum(cl, dtype=xp.int32)])
+                           cumsum_fast(xp, cl, dtype=xp.int32)])
     new_offs = cpre[col.offsets]
     out_cap = bucket_for(max(int(n * max(1, (R + L - 1) // L)), 1),
                          DEFAULT_CHAR_BUCKETS) if R > L else \
@@ -596,7 +597,7 @@ def _eval_repeat(e: StringRepeat, ctx: EvalContext):
         valid = xp.zeros((cap,), dtype=bool)
     new_lens = xp.where(valid, lens * times, 0)
     new_offs = xp.concatenate([xp.zeros((1,), xp.int32),
-                               xp.cumsum(new_lens, dtype=xp.int64)
+                               cumsum_fast(xp, new_lens, dtype=xp.int64)
                                .astype(xp.int32)])
     out_cap = bucket_for(max(int(col.data.shape[0]) * 4, 1),
                          DEFAULT_CHAR_BUCKETS)
@@ -663,7 +664,7 @@ def _eval_locate(e: StringLocate, ctx: EvalContext):
                            xp.ones((ctx.capacity,), np.int32), val)
     m = _match_positions(xp, col.data, needle)
     pre = xp.concatenate([xp.zeros((1,), xp.int64),
-                          xp.cumsum(m.astype(xp.int64))])
+                          cumsum_fast(xp, m.astype(xp.int64))])
     start_off = o0
     if len(e.children) > 2:
         from .core import data_of
@@ -713,7 +714,7 @@ def _pad_impl(e: StringLPad, ctx: EvalContext):
         xp.ones((cap,), dtype=bool)
     new_lens = xp.where(valid, target, 0)
     new_offs = xp.concatenate([xp.zeros((1,), xp.int32),
-                               xp.cumsum(new_lens).astype(xp.int32)])
+                               cumsum_fast(xp, new_lens).astype(xp.int32)])
     out_cap = bucket_for(max(int(col.data.shape[0]) * 2, 1024),
                          DEFAULT_CHAR_BUCKETS)
     q = xp.arange(out_cap, dtype=xp.int64)

@@ -14,6 +14,7 @@ import numpy as np
 from .. import types as t
 from ..columnar.device import DeviceBatch, DeviceColumn
 from . import strings as sops
+from .scan import cumsum_fast
 
 
 def gather_spans(xp, offsets, indices, valid, out_child_cap: int):
@@ -24,7 +25,7 @@ def gather_spans(xp, offsets, indices, valid, out_child_cap: int):
                        xp.zeros((), dtype=offsets.dtype))
     new_offs = xp.concatenate([
         xp.zeros((1,), offsets.dtype),
-        xp.cumsum(src_len, dtype=offsets.dtype)])
+        cumsum_fast(xp, src_len, dtype=offsets.dtype)])
     p = xp.arange(out_child_cap, dtype=xp.int32)
     row = xp.clip(xp.searchsorted(new_offs[1:], p, side="right"),
                   0, indices.shape[0] - 1).astype(xp.int32)

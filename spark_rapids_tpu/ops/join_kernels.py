@@ -26,6 +26,7 @@ import numpy as np
 from .. import types as t
 from ..columnar.device import DeviceColumn
 from . import strings as sops
+from .scan import cumsum_fast
 
 _MIX = np.uint64(0xBF58476D1CE4E5B9)
 _MIX2 = np.uint64(0x94D049BB133111EB)
@@ -101,7 +102,7 @@ def expand_pairs(xp, order, lo, counts, probe_live, out_cap: int,
     eff_counts = xp.maximum(counts, 1) if outer_left else counts
     eff_counts = xp.where(probe_live, eff_counts, 0)
     offs = xp.concatenate([xp.zeros((1,), xp.int64),
-                           xp.cumsum(eff_counts, dtype=xp.int64)])
+                           cumsum_fast(xp, eff_counts, dtype=xp.int64)])
     total = offs[-1]
     p = xp.arange(out_cap, dtype=xp.int64)
     row = xp.clip(xp.searchsorted(offs[1:], p, side="right"),
@@ -134,7 +135,7 @@ def build_matched_flags(xp, order, lo, counts, probe_live, build_cap: int):
         ones = live.astype(xp.int32)
         delta = delta.at[starts].add(ones)
         delta = delta.at[ends].add(-ones)
-    covered = xp.cumsum(delta[:-1]) > 0
+    covered = cumsum_fast(xp, delta[:-1]) > 0
     # covered is in sorted-order positions; map back to original rows
     matched = xp.zeros((build_cap,), dtype=bool)
     if xp is np:

@@ -1,0 +1,74 @@
+"""Pad-shift (Hillis-Steele) prefix scans.
+
+TPU kernel-structure note: the stock jnp.cumsum/cumprod lowering compiles
+in minutes for 64-bit dtypes on this platform and the emulated scan HLO
+runs far off memory speed.  log2(n) elementwise pad+combine steps compile
+in ~2s and run at bandwidth for every dtype, so all engine prefix sums
+route through here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def cumsum_fast(xp, v, dtype=None, axis=None):
+    """Inclusive prefix sum via pad-shift doubling.  On TPU this lowers
+    to log2(n) elementwise adds (no reduce-window / scan HLO), which both
+    compiles ~100x faster than jnp.cumsum for 64-bit dtypes and avoids
+    the emulated-scan slow path."""
+    if axis is None:
+        axis = 0
+    if xp is np:
+        return np.cumsum(v, axis=axis, dtype=dtype)
+    if dtype is not None:
+        v = v.astype(dtype)
+    n = v.shape[axis]
+    d = 1
+    index = [slice(None)] * v.ndim
+    index[axis] = slice(0, n)
+    index = tuple(index)
+    while d < n:
+        pad = [(0, 0)] * v.ndim
+        pad[axis] = (d, 0)
+        v = v + xp.pad(v, pad)[index]
+        d *= 2
+    return v
+
+
+def cumprod_fast(xp, v, dtype=None):
+    """Inclusive prefix product, same pad-shift structure (pads with 1)."""
+    if xp is np:
+        return np.cumprod(v, dtype=dtype)
+    if dtype is not None:
+        v = v.astype(dtype)
+    n = v.shape[0]
+    d = 1
+    while d < n:
+        v = v * xp.pad(v, (d, 0), constant_values=1)[:n]
+        d *= 2
+    return v
+
+def segmented_cumsum_fast(xp, v, seg_start):
+    """Inclusive PER-SEGMENT prefix sum (segments restart where seg_start
+    is True) via the segmented Hillis-Steele recurrence:
+
+        v[i] += F[i] ? 0 : v[i-d];   F[i] |= F[i-d]
+
+    Floats need this instead of global-scan differencing: a global prefix
+    sum lets one segment's magnitude cancel catastrophically against
+    another's (and inf/nan poison everything downstream)."""
+    n = v.shape[0]
+    f = seg_start.astype(bool)
+    d = 1
+    while d < n:
+        if xp is np:
+            pv = np.concatenate([np.zeros((d,), v.dtype), v[:-d]])
+            pf = np.concatenate([np.ones((d,), bool), f[:-d]])
+        else:
+            pv = xp.pad(v, (d, 0))[:n]
+            pf = xp.pad(f, (d, 0), constant_values=True)[:n]
+        v = xp.where(f, v, v + pv)
+        f = f | pf
+        d *= 2
+    return v

@@ -25,6 +25,7 @@ the identical semantics.
 from __future__ import annotations
 
 import numpy as np
+from .scan import cumsum_fast, cumprod_fast
 
 PREFIX_BYTES = 32  # 4 uint64 words
 _HASH_BASE_1 = np.uint64(0x100000001B3)          # FNV-ish odd base
@@ -44,10 +45,10 @@ def _rolling_hash(xp, offsets, chars, base, inv_base):
     hash_i = (prefix[end] - prefix[start]) * base^{-start}.
     """
     n = chars.shape[0]
-    powers = xp.cumprod(xp.full((n,), base, dtype=xp.uint64)) * inv_base
-    inv_powers = xp.cumprod(xp.full((n,), inv_base, dtype=xp.uint64)) * base
+    powers = cumprod_fast(xp, xp.full((n,), base, dtype=xp.uint64)) * inv_base
+    inv_powers = cumprod_fast(xp, xp.full((n,), inv_base, dtype=xp.uint64)) * base
     contrib = (chars.astype(xp.uint64) + xp.uint64(1)) * powers
-    prefix = xp.concatenate([xp.zeros((1,), xp.uint64), xp.cumsum(contrib)])
+    prefix = xp.concatenate([xp.zeros((1,), xp.uint64), cumsum_fast(xp, contrib)])
     starts = offsets[:-1].astype(xp.int32)
     ends = offsets[1:].astype(xp.int32)
     span = prefix[ends] - prefix[starts]
@@ -113,7 +114,7 @@ def gather_strings(xp, offsets, chars, indices, valid, out_char_cap: int):
                        xp.zeros((), dtype=offsets.dtype))
     new_offs = xp.concatenate([
         xp.zeros((1,), offsets.dtype),
-        xp.cumsum(src_len, dtype=offsets.dtype)])
+        cumsum_fast(xp, src_len, dtype=offsets.dtype)])
     p = xp.arange(out_char_cap, dtype=offsets.dtype)
     row = xp.searchsorted(new_offs[1:], p, side="right").astype(xp.int32)
     row = xp.clip(row, 0, indices.shape[0] - 1)
@@ -134,7 +135,7 @@ def pack_rows(xp, bytes_mat, lens, valid, out_char_cap: int):
     cap = bytes_mat.shape[0]
     lens = xp.where(valid, lens, xp.zeros((), dtype=lens.dtype)).astype(xp.int32)
     offs = xp.concatenate([xp.zeros((1,), xp.int32),
-                           xp.cumsum(lens, dtype=xp.int32)])
+                           cumsum_fast(xp, lens, dtype=xp.int32)])
     p = xp.arange(out_char_cap, dtype=xp.int32)
     row = xp.clip(xp.searchsorted(offs[1:], p, side="right"),
                   0, cap - 1).astype(xp.int32)

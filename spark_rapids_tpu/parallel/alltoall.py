@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from .. import types as t
 from ..columnar.device import DeviceBatch, DeviceColumn
+from ..ops.scan import cumsum_fast
 
 
 def exchange_supported(dtypes) -> Optional[str]:
@@ -49,7 +50,7 @@ def _counts_starts(pid_key, n_parts: int):
     """Per-destination row counts and exclusive starts after a stable sort."""
     one_hot = pid_key[None, :] == jnp.arange(n_parts, dtype=pid_key.dtype)[:, None]
     counts = jnp.sum(one_hot.astype(jnp.int32), axis=1)
-    starts = jnp.cumsum(counts) - counts
+    starts = cumsum_fast(jnp, counts) - counts
     return counts, starts
 
 
@@ -65,7 +66,7 @@ def _string_send(col: DeviceColumn, src_row, send_valid, n_parts: int,
     row_len = jnp.where(send_valid, lengths[src_row], 0).astype(jnp.int32)
     # per-peer exclusive char starts [P, slot+1]
     char_start = jnp.concatenate(
-        [jnp.zeros((n_parts, 1), jnp.int32), jnp.cumsum(row_len, axis=1)],
+        [jnp.zeros((n_parts, 1), jnp.int32), cumsum_fast(jnp, row_len, axis=1)],
         axis=1)
     total_chars = char_start[:, -1]
     c = jnp.arange(char_slot, dtype=jnp.int32)
@@ -90,10 +91,10 @@ def _string_receive(recv_chars, recv_len, ord2, n_parts: int, slot: int):
     len_flat = recv_len.reshape(flat_rows)
     out_len = len_flat[ord2]
     out_offs = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(out_len)]).astype(jnp.int32)
+        [jnp.zeros((1,), jnp.int32), cumsum_fast(jnp, out_len)]).astype(jnp.int32)
     # per-source-peer exclusive char starts in the receive buffer
     recv_start = jnp.concatenate(
-        [jnp.zeros((n_parts, 1), jnp.int32), jnp.cumsum(recv_len, axis=1)],
+        [jnp.zeros((n_parts, 1), jnp.int32), cumsum_fast(jnp, recv_len, axis=1)],
         axis=1)
     out_char_cap = n_parts * char_slot
     c = jnp.arange(out_char_cap, dtype=jnp.int32)

@@ -596,11 +596,13 @@ def _convert_aggregate(e: CpuHashAggregateExec, conf) -> eb.Exec:
         final = TpuHashAggregateExec(e.grouping, partial.aggregates,
                                      agg.FINAL, exchange)
         return final
-    partial = TpuHashAggregateExec(e.grouping, e.aggregates, agg.PARTIAL,
-                                   child)
-    final = TpuHashAggregateExec(e.grouping, partial.aggregates, agg.FINAL,
-                                 partial)
-    return final
+    # no exchange below: groups are already co-located, so a single
+    # Complete-mode aggregate (update+evaluate, merge only for multi-batch
+    # inputs) replaces the Partial/Final pair — one compiled program and
+    # one device pass instead of two (Spark collapses the same way when
+    # partial aggregation cannot help)
+    return TpuHashAggregateExec(e.grouping, e.aggregates, agg.COMPLETE,
+                                child)
 
 
 EXEC_CONVERTS[CpuHashAggregateExec] = _convert_aggregate

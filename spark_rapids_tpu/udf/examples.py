@@ -16,6 +16,7 @@ import numpy as np
 from .. import types as t
 from ..columnar.device import DeviceColumn
 from .native import TpuUDF
+from ..ops.scan import cumsum_fast
 
 
 class CosineSimilarity(TpuUDF):
@@ -56,7 +57,7 @@ class StringWordCount(TpuUDF):
         prev = xp.concatenate([xp.ones((1,), dtype=bool), is_space[:-1]])
         starts = (nonspace & prev).astype(xp.int32)
         csum = xp.concatenate([xp.zeros((1,), dtype=xp.int32),
-                               xp.cumsum(starts, dtype=xp.int32)])
+                               cumsum_fast(xp, starts, dtype=xp.int32)])
         # word starts strictly inside each row's span; a row beginning
         # mid-buffer needs its own boundary treated as a word start
         lo = offs[:-1]
