@@ -246,11 +246,30 @@ def column_to_device(arr: pa.Array, dtype: t.DataType, cap: int,
         offs64 = np.asarray(arr.offsets).astype(np.int64)
         base = int(offs64[0])
         offs = (offs64 - base).astype(np.int32)
+        keys_src = arr.keys
+        items_src = arr.items
+        if arr.null_count:
+            # Arrow only RECOMMENDS zero-length spans under null slots;
+            # a producer emitting kv pairs under null rows would inflate
+            # nkv and break the engine invariant that null rows span
+            # zero entries — drop those entries and rebuild offsets
+            valid_np = _valid_np(arr)
+            spans = offs[1:] - offs[:-1]
+            spans0 = np.where(valid_np, spans, 0)
+            if not np.array_equal(spans0, spans):
+                keep = np.repeat(valid_np, spans)
+                keep_idx = np.flatnonzero(keep) + base
+                keys_src = keys_src.take(pa.array(keep_idx))
+                items_src = items_src.take(pa.array(keep_idx))
+                base = 0
+                offs = np.concatenate(
+                    [np.zeros(1, np.int32),
+                     np.cumsum(spans0, dtype=np.int32)])
         nkv = int(offs[-1]) if n else 0
         child_cap = bucket_for(max(nkv, 1), DEFAULT_ROW_BUCKETS)
-        kcol = column_to_device(arr.keys.slice(base, nkv), dtype.key_type,
+        kcol = column_to_device(keys_src.slice(base, nkv), dtype.key_type,
                                 child_cap, char_buckets, xp)
-        vcol = column_to_device(arr.items.slice(base, nkv), dtype.value_type,
+        vcol = column_to_device(items_src.slice(base, nkv), dtype.value_type,
                                 child_cap, char_buckets, xp)
         offs_p = np.full((cap + 1,), offs[-1] if n else 0, dtype=np.int32)
         offs_p[:n + 1] = offs

@@ -3,21 +3,28 @@
 The reference copies result batches over PCIe where per-transfer latency is
 microseconds (GpuColumnarToRowExec.scala:358 pulls each column's buffers).
 A tunneled TPU is a different animal: every host<->device round trip costs
-tens of milliseconds of fixed latency and host bandwidth is limited, so the
-naive per-buffer fetch (one transfer per data/validity/offsets lane) is the
-dominant query cost.  This module fetches a whole DeviceBatch in exactly
-TWO round trips, transferring only the rows that exist:
+tens of milliseconds of fixed latency and host bandwidth is ~tens of MB/s,
+so the naive per-buffer fetch (one transfer per data/validity/offsets lane)
+is the dominant query cost.  This module fetches a whole DeviceBatch in
+exactly TWO round trips, transferring only the rows that exist AND only the
+bytes that carry information:
 
-  1. `sizes`: one jitted call returns [num_rows, var_len_0, var_len_1, ...]
-     (char counts for strings, child row counts for arrays) as a single
-     tiny array — one sync that also acts as the pipeline barrier.
-  2. `shrink_pack`: a jitted function (cached per schema/capacity shape)
-     slices every lane down to the smallest capacity bucket that holds
-     num_rows and concatenates the lanes into one buffer PER DTYPE
-     (bools fold into uint8).  No bitcasting — the TPU X64-rewrite pass
-     cannot compile 64-bit bitcast-convert — so instead of one uint8
-     buffer the fetch is a handful of per-dtype buffers brought over in
-     a single device_get (one sync).
+  1. `sizes`: one jitted call returns [num_rows, var_len_0, ...] (char
+     counts for strings, child row counts for arrays) plus per-lane stats
+     (all-valid flags for bool lanes; min/max for integer lanes) as a
+     single tiny array — one sync that also acts as the pipeline barrier.
+  2. `shrink_pack`: a jitted function (cached per schema/capacity/plan)
+     slices every lane to the smallest capacity bucket holding num_rows,
+     then applies the transfer plan the host derived from the stats:
+       * bool lanes that are all-true up to num_rows are SKIPPED (the
+         host resynthesizes them from num_rows);
+       * remaining bool lanes bit-pack 8 rows per byte;
+       * integer lanes whose value range fits a narrower width travel as
+         (lane - min) in uint8/16/32 — the device re-derives min so the
+         plan key stays value-independent; the host adds back the min it
+         already fetched with the sizes;
+     and concatenates the lanes into one buffer PER TRANSFERRED DTYPE.
+     No 64-bit bitcasting — the TPU X64-rewrite pass cannot compile it.
 
 The host then rebuilds numpy-backed DeviceColumns from views of those
 buffers; Arrow conversion proceeds on host exactly as before.
@@ -44,8 +51,35 @@ def batch_is_device(batch: DeviceBatch) -> bool:
     return any(_is_device(l) for l in jax.tree_util.tree_leaves(batch))
 
 
+class FetchLayoutError(RuntimeError):
+    """Device pack and host unpack disagreed about the buffer layout."""
+
+
 # ---------------------------------------------------------------------------
-# sizes: [num_rows, varlen...] in column walk order
+# canonical lane walk (matches DeviceColumn.tree_flatten leaf order)
+# ---------------------------------------------------------------------------
+
+def _walk_lanes(col: DeviceColumn):
+    """Yield (kind, lane) for every present lane: data, validity, offsets,
+    data_hi, then children recursively — the tree_flatten leaf order."""
+    if col.data is not None:
+        yield ("data", col.data)
+    if col.validity is not None:
+        yield ("validity", col.validity)
+    if col.offsets is not None:
+        yield ("offsets", col.offsets)
+    if col.data_hi is not None:
+        yield ("hi", col.data_hi)
+    for ch in col.children:
+        yield from _walk_lanes(ch)
+
+
+def _np_dtype_of(x) -> np.dtype:
+    return np.dtype(x.dtype.name if hasattr(x.dtype, "name") else x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# sizes + stats: [num_rows, varlen..., lane stats...] in walk order
 # ---------------------------------------------------------------------------
 
 def _var_sizes(col: DeviceColumn, n) -> List:
@@ -70,18 +104,132 @@ def _var_sizes(col: DeviceColumn, n) -> List:
     return out
 
 
+def _lane_stats(col: DeviceColumn, n) -> List:
+    """Two device scalars per lane in walk order: bool lanes report
+    (all_true_up_to_n, 0); integer data lanes report (min, max) over the
+    LIVE rows only — padding rows are never read back (hosts slice to
+    num_rows), so zero padding must not drag the range and defeat the
+    narrowing; null rows within num_rows hold canonical zeros and are
+    included, keeping null-zero reconstruction exact.  Offsets lanes use
+    the full lane (their padding repeats the last live value).  Others
+    report (0, 0).
+
+    The device-side pack subtracts _narrow_min on the SAME masked lane,
+    so host and device agree on the offset exactly.
+
+    `n` is the live-row count at this column's level; children of span
+    columns use their own child counts."""
+    stats: List = []
+
+    def visit(c: DeviceColumn, live_n):
+        for kind, lane in [("data", c.data), ("validity", c.validity),
+                           ("offsets", c.offsets), ("hi", c.data_hi)]:
+            if lane is None:
+                continue
+            dt = _np_dtype_of(lane)
+            if dt == np.bool_:
+                io = jnp.arange(lane.shape[0], dtype=jnp.int32)
+                allv = jnp.all(lane | (io >= live_n))
+                stats.append(allv.astype(jnp.int64))
+                stats.append(jnp.int64(0))
+            elif dt.kind in "iu" and dt.itemsize >= 2:
+                if kind == "offsets":
+                    stats.append(jnp.min(lane).astype(jnp.int64))
+                    stats.append(jnp.max(lane).astype(jnp.int64))
+                else:
+                    stats.append(_narrow_min(lane, live_n).astype(
+                        jnp.int64))
+                    io = jnp.arange(lane.shape[0], dtype=jnp.int32)
+                    lo = np.iinfo(dt).min
+                    mx = jnp.max(jnp.where(io < live_n, lane,
+                                           lane.dtype.type(lo)))
+                    stats.append(mx.astype(jnp.int64))
+            else:
+                stats.append(jnp.int64(0))
+                stats.append(jnp.int64(0))
+        cdt = c.dtype
+        if isinstance(cdt, (t.ArrayType, t.MapType)):
+            m = c.offsets[jnp.clip(live_n, 0, c.capacity)]
+            for ch in c.children:
+                visit(ch, m)
+        else:
+            for ch in c.children:
+                visit(ch, live_n)
+
+    visit(col, n)
+    return stats
+
+
+def _narrow_min(lane, live_n):
+    """Min over live rows — the shared offset for integer narrowing.
+    Empty batches degrade to dtype-max, making span negative so the plan
+    never narrows."""
+    dt = _np_dtype_of(lane)
+    io = jnp.arange(lane.shape[0], dtype=jnp.int32)
+    hi = np.iinfo(dt).max
+    return jnp.min(jnp.where(io < live_n, lane, lane.dtype.type(hi)))
+
+
 def _make_sizes_fn():
     def sizes(batch: DeviceBatch):
         n = jnp.asarray(batch.num_rows).astype(jnp.int64)
         parts = [n]
         for col in batch.columns:
             parts += _var_sizes(col, jnp.asarray(batch.num_rows))
+        for col in batch.columns:
+            parts += _lane_stats(col, jnp.asarray(batch.num_rows))
         return jnp.stack(parts)
     return sizes
 
 
 # ---------------------------------------------------------------------------
-# shrink to bucket + pack to one uint8 buffer
+# transfer plan: one entry per lane in walk order
+# ---------------------------------------------------------------------------
+# entry: ("none",) | ("skip",) | ("bit",) | ("narrow", out_itemsize)
+# host-side companions (not in the jit key): min values for narrowed lanes
+
+_NARROW_NP = {1: np.uint8, 2: np.uint16, 4: np.uint32}
+_NARROW_JNP = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32}
+
+
+def _build_plan(batch: DeviceBatch, stats: np.ndarray):
+    """Per-lane transfer plan + per-lane host minima, in walk order."""
+    plan: List[tuple] = []
+    mins: List[int] = []
+    i = 0
+    for col in batch.columns:
+        for kind, lane in _walk_lanes(col):
+            s1, s2 = int(stats[2 * i]), int(stats[2 * i + 1])
+            i += 1
+            dt = _np_dtype_of(lane)
+            if dt == np.bool_:
+                if s1:
+                    plan.append(("skip",))
+                elif lane.shape[0] % 8 == 0:
+                    plan.append(("bit",))
+                else:
+                    plan.append(("none",))
+                mins.append(0)
+                continue
+            if dt.kind in "iu" and dt.itemsize >= 2:
+                span = s2 - s1
+                if 0 <= span < (1 << 8) and dt.itemsize > 1:
+                    plan.append(("narrow", 1))
+                elif 0 <= span < (1 << 16) and dt.itemsize > 2:
+                    plan.append(("narrow", 2))
+                elif 0 <= span < (1 << 32) and dt.itemsize > 4:
+                    plan.append(("narrow", 4))
+                else:
+                    plan.append(("none",))
+                mins.append(s1)
+                continue
+            plan.append(("none",))
+            mins.append(0)
+    return tuple(plan), mins
+
+
+# ---------------------------------------------------------------------------
+# shrink to bucket + pack per transferred dtype
 # ---------------------------------------------------------------------------
 
 def _slice_or_pad(a, cap: int):
@@ -130,35 +278,67 @@ def _shrink_column(col: DeviceColumn, out_cap: int, var_caps) -> DeviceColumn:
     return out
 
 
-def _canon_key(x) -> str:
-    """Buffer-group key for a lane: its dtype name, with bool folded into
-    uint8 (bools travel as bytes).  The ONLY place the grouping rule
-    lives — device pack and host unpack both call it, so they cannot
-    drift."""
-    d = np.dtype(x.dtype.name if hasattr(x.dtype, "name") else x.dtype)
-    return "uint8" if d == np.bool_ else d.name
+def _transferred_dtype(lane_dtype: np.dtype, step: tuple) -> Optional[str]:
+    """Wire dtype name for a lane under its plan step; None = skipped."""
+    if step[0] == "skip":
+        return None
+    if step[0] == "bit":
+        return "uint8"
+    if step[0] == "narrow":
+        return np.dtype(_NARROW_NP[step[1]]).name
+    return "uint8" if lane_dtype == np.bool_ else lane_dtype.name
 
 
-def _make_shrink_pack_fn(out_cap: int, var_caps: Tuple[int, ...]):
+def _make_shrink_pack_fn(out_cap: int, var_caps: Tuple[int, ...],
+                         plan: Tuple[tuple, ...]):
     def shrink_pack(batch: DeviceBatch):
         it = iter(var_caps)
         cols = [_shrink_column(c, out_cap, it) for c in batch.columns]
-        groups: dict = {}  # insertion-ordered: key -> list of 1-D lanes
-        for c in cols:
-            for leaf in jax.tree_util.tree_leaves(c):
-                k = _canon_key(leaf)
-                if leaf.dtype == jnp.bool_:
+        groups: dict = {}  # insertion-ordered: wire dtype -> 1-D pieces
+        pi = iter(plan)
+
+        def visit(c: DeviceColumn, orig: DeviceColumn, live_n):
+            for kind in ("data", "validity", "offsets", "hi"):
+                attr = "data_hi" if kind == "hi" else kind
+                leaf = getattr(c, attr)
+                if leaf is None:
+                    continue
+                oleaf = getattr(orig, attr)
+                step = next(pi)
+                if step[0] == "skip":
+                    continue
+                if step[0] == "bit":
+                    w = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))
+                    leaf = jnp.sum(
+                        leaf.reshape(-1, 8).astype(jnp.uint8) * w,
+                        axis=1, dtype=jnp.uint8)
+                elif step[0] == "narrow":
+                    # subtract exactly the offset the host fetched in the
+                    # sizes stats: live-masked min for data/hi lanes,
+                    # full-lane min for offsets
+                    minv = jnp.min(oleaf) if kind == "offsets" else \
+                        _narrow_min(oleaf, live_n)
+                    leaf = (leaf - minv).astype(_NARROW_JNP[step[1]])
+                elif leaf.dtype == jnp.bool_:
                     leaf = leaf.astype(jnp.uint8)
-                groups.setdefault(k, []).append(leaf.reshape(-1))
+                key = _np_dtype_of(leaf).name
+                groups.setdefault(key, []).append(leaf.reshape(-1))
+            cdt = orig.dtype
+            if isinstance(cdt, (t.ArrayType, t.MapType)):
+                m = orig.offsets[jnp.clip(live_n, 0, orig.capacity)]
+                for ch, och in zip(c.children, orig.children):
+                    visit(ch, och, m)
+            else:
+                for ch, och in zip(c.children, orig.children):
+                    visit(ch, och, live_n)
+
+        n0 = jnp.asarray(batch.num_rows)
+        for c, orig in zip(cols, batch.columns):
+            visit(c, orig, n0)
         return tuple(
             jnp.concatenate(ls) if len(ls) > 1 else ls[0]
             for ls in groups.values())
     return shrink_pack
-
-
-# host-side mirror of the shrunk column layout: (shape, np dtype, is_bool)
-def _np_dtype_of(x) -> np.dtype:
-    return np.dtype(x.dtype.name if hasattr(x.dtype, "name") else x.dtype)
 
 
 class _BufReader:
@@ -169,60 +349,73 @@ class _BufReader:
         self._bufs = bufs_by_key
         self._pos = {k: 0 for k in bufs_by_key}
 
-    def take(self, cap: int, dtype: np.dtype) -> np.ndarray:
-        k = _canon_key(np.empty(0, dtype))
-        buf, pos = self._bufs[k], self._pos[k]
-        view = buf[pos:pos + cap]
-        self._pos[k] = pos + cap
-        if dtype == np.bool_:
-            return view.astype(np.bool_)
+    def take(self, count: int, wire_dtype: str) -> np.ndarray:
+        buf, pos = self._bufs[wire_dtype], self._pos[wire_dtype]
+        view = buf[pos:pos + count]
+        if len(view) != count:
+            raise FetchLayoutError(
+                f"fetch underrun: wanted {count} x {wire_dtype}, "
+                f"buffer has {len(buf) - pos} left")
+        self._pos[wire_dtype] = pos + count
         return view
 
 
-def _unpack_column(col: DeviceColumn, rd: _BufReader,
-                   out_cap: int, var_caps) -> DeviceColumn:
-    """Rebuild a numpy-backed shrunk column from the packed buffers."""
+def _unpack_column(col: DeviceColumn, rd: _BufReader, out_cap: int,
+                   var_caps, plan_it, mins_it, live_n: int) -> DeviceColumn:
+    """Rebuild a numpy-backed shrunk column from the packed buffers,
+    reversing each lane's transfer transform.  `live_n` is this level's
+    live row count (for resynthesizing skipped validity lanes)."""
     dt = col.dtype
-    take = rd.take
+
+    def lane(template, cap: int) -> Optional[np.ndarray]:
+        if template is None:
+            return None
+        step = next(plan_it)
+        minv = next(mins_it)
+        ldt = _np_dtype_of(template)
+        if step[0] == "skip":
+            return np.arange(cap, dtype=np.int32) < live_n
+        if step[0] == "bit":
+            raw = rd.take(cap // 8, "uint8")
+            return np.unpackbits(raw, bitorder="little")[:cap].astype(
+                np.bool_)
+        if step[0] == "narrow":
+            raw = rd.take(cap, np.dtype(_NARROW_NP[step[1]]).name)
+            return raw.astype(ldt) + ldt.type(minv)
+        wire = "uint8" if ldt == np.bool_ else ldt.name
+        raw = rd.take(cap, wire)
+        return raw.astype(np.bool_) if ldt == np.bool_ else raw
 
     if isinstance(dt, (t.StringType, t.BinaryType)):
         char_cap = next(var_caps)
-        data = take(char_cap, np.dtype(np.uint8))
-        validity = take(out_cap, np.dtype(np.bool_)) \
-            if col.validity is not None else None
-        offsets = take(out_cap + 1, _np_dtype_of(col.offsets))
+        data = lane(col.data, char_cap)
+        validity = lane(col.validity, out_cap)
+        offsets = lane(col.offsets, out_cap + 1)
         return DeviceColumn(dt, data=data, validity=validity,
                             offsets=offsets)
-    if isinstance(dt, t.ArrayType):
+    if isinstance(dt, (t.ArrayType, t.MapType)):
         child_cap = next(var_caps)
-        validity = take(out_cap, np.dtype(np.bool_)) \
-            if col.validity is not None else None
-        offsets = take(out_cap + 1, _np_dtype_of(col.offsets))
-        child = _unpack_column(col.children[0], rd, child_cap, var_caps)
+        validity = lane(col.validity, out_cap)
+        offsets = lane(col.offsets, out_cap + 1)
+        child_n = int(offsets[min(live_n, len(offsets) - 1)])
+        children = tuple(
+            _unpack_column(ch, rd, child_cap, var_caps, plan_it, mins_it,
+                           child_n)
+            for ch in col.children)
         return DeviceColumn(dt, validity=validity, offsets=offsets,
-                            children=(child,))
-    if isinstance(dt, t.MapType):
-        child_cap = next(var_caps)
-        validity = take(out_cap, np.dtype(np.bool_)) \
-            if col.validity is not None else None
-        offsets = take(out_cap + 1, _np_dtype_of(col.offsets))
-        kcol = _unpack_column(col.children[0], rd, child_cap, var_caps)
-        vcol = _unpack_column(col.children[1], rd, child_cap, var_caps)
-        return DeviceColumn(dt, validity=validity, offsets=offsets,
-                            children=(kcol, vcol))
+                            children=children)
     if isinstance(dt, t.StructType):
-        validity = take(out_cap, np.dtype(np.bool_)) \
-            if col.validity is not None else None
-        children = tuple(_unpack_column(c, rd, out_cap, var_caps)
-                         for c in col.children)
+        validity = lane(col.validity, out_cap)
+        children = tuple(
+            _unpack_column(ch, rd, out_cap, var_caps, plan_it, mins_it,
+                           live_n)
+            for ch in col.children)
         return DeviceColumn(dt, validity=validity, children=children)
-    data = take(out_cap, _np_dtype_of(col.data)) \
-        if col.data is not None else None
-    validity = take(out_cap, np.dtype(np.bool_)) \
-        if col.validity is not None else None
+    data = lane(col.data, out_cap)
+    validity = lane(col.validity, out_cap)
     out = DeviceColumn(dt, data=data, validity=validity)
     if col.data_hi is not None:
-        out.data_hi = take(out_cap, _np_dtype_of(col.data_hi))
+        out.data_hi = lane(col.data_hi, out_cap)
     return out
 
 
@@ -243,7 +436,8 @@ def fetch_batch(batch: DeviceBatch,
                 char_buckets: Sequence[int] = DEFAULT_CHAR_BUCKETS,
                 ) -> DeviceBatch:
     """Bring a device batch to host as numpy-backed DeviceBatch in two
-    round trips, transferring only bucket_for(num_rows) rows per lane."""
+    round trips, transferring only bucket_for(num_rows) rows per lane
+    and only information-carrying bytes per lane (see module doc)."""
     if not batch_is_device(batch):
         # already host-side: just normalize num_rows to a python int
         return DeviceBatch(batch.columns, int(batch.num_rows), batch.names)
@@ -278,15 +472,27 @@ def fetch_batch(batch: DeviceBatch,
     for c in batch.columns:
         walk(c, it)
     vc = tuple(var_caps)
-    pack_fn = process_jit(("fetch_pack", skey, out_cap, vc),
-                          lambda: _make_shrink_pack_fn(out_cap, vc))
+    stats = sizes[1 + len(var_caps):]
+    plan, mins = _build_plan(batch, stats)
+    pack_fn = process_jit(("fetch_pack", skey, out_cap, vc, plan),
+                          lambda: _make_shrink_pack_fn(out_cap, vc, plan))
     bufs = jax.device_get(pack_fn(batch))        # round trip 2 (one sync)
-    # reconstruct the device-side dtype-group order from the template
-    order = list(dict.fromkeys(
-        _canon_key(leaf) for c in batch.columns
-        for leaf in jax.tree_util.tree_leaves(c)))
-    assert len(order) == len(bufs), (order, [b.dtype for b in bufs])
+    # reconstruct the device-side wire-dtype-group order from the template
+    order: List[str] = []
+    pi = iter(plan)
+    for c in batch.columns:
+        for kind, leaf in _walk_lanes(c):
+            wd = _transferred_dtype(_np_dtype_of(leaf), next(pi))
+            if wd is not None and wd not in order:
+                order.append(wd)
+    if len(order) != len(bufs):
+        raise FetchLayoutError(
+            f"fetch layout drift: host expects {order}, device sent "
+            f"{[str(b.dtype) for b in bufs]}")
     rd = _BufReader(dict(zip(order, bufs)))
     caps_it = iter(vc)
-    cols = [_unpack_column(c, rd, out_cap, caps_it) for c in batch.columns]
+    plan_it = iter(plan)
+    mins_it = iter(mins)
+    cols = [_unpack_column(c, rd, out_cap, caps_it, plan_it, mins_it, n)
+            for c in batch.columns]
     return DeviceBatch(cols, n, batch.names)

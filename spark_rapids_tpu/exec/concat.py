@@ -66,16 +66,14 @@ def concat_columns(xp, cols: Sequence[DeviceColumn], counts, cap: int,
             for i, f in enumerate(dtype.fields))
         return DeviceColumn(dtype, validity=validity, children=children)
 
-    if isinstance(dtype, t.ArrayType):
+    if isinstance(dtype, (t.ArrayType, t.MapType)):
         offs_parts = []
         base = 0
-        child_cols = []
         child_counts = []
         for c, n in zip(cols, counts):
             o = c.offsets
             nb = int(np.asarray(o)[n])
             offs_parts.append(o[:n] + np.int32(base))
-            child_cols.append(c.children[0])
             child_counts.append(nb)
             base += nb
         last = np.int32(base)
@@ -83,8 +81,16 @@ def concat_columns(xp, cols: Sequence[DeviceColumn], counts, cap: int,
         offs = xp.concatenate(
             offs_parts + [xp.full((cap + 1 - total_rows,), last, xp.int32)])
         child_cap = bucket_for(max(base, 1), DEFAULT_ROW_BUCKETS)
-        child = concat_columns(xp, child_cols, child_counts, child_cap,
-                               dtype.element_type)
+        if isinstance(dtype, t.MapType):
+            kchild = concat_columns(xp, [c.children[0] for c in cols],
+                                    child_counts, child_cap, dtype.key_type)
+            vchild = concat_columns(xp, [c.children[1] for c in cols],
+                                    child_counts, child_cap,
+                                    dtype.value_type)
+            return DeviceColumn(dtype, offsets=offs, validity=validity,
+                                children=(kchild, vchild))
+        child = concat_columns(xp, [c.children[0] for c in cols],
+                               child_counts, child_cap, dtype.element_type)
         return DeviceColumn(dtype, offsets=offs, validity=validity,
                             children=(child,))
 

@@ -153,19 +153,23 @@ class HashJoinExec(Exec):
         eff = xp.maximum(counts, 1) if outer else counts
         eff = xp.where(plive, eff, 0)
         total = xp.sum(eff)
-        # string sizing
+        # span sizing: strings count output BYTES, arrays/maps count
+        # output CHILD ROWS — a row-duplicating gather must size the
+        # child buffer to the duplicated total, not the source capacity
+        # (a source-cap default silently truncates join expansions)
+        def span_lens(c):
+            return (c.offsets[1:] - c.offsets[:-1]).astype(xp.int64)
+
         pbytes = []
         for c in probe.columns:
-            if isinstance(c.dtype, (t.StringType, t.BinaryType)):
-                lens = (c.offsets[1:] - c.offsets[:-1]).astype(xp.int64)
-                pbytes.append(xp.sum(eff * lens))
+            if c.offsets is not None:
+                pbytes.append(xp.sum(eff * span_lens(c)))
             else:
                 pbytes.append(xp.int64(0) if xp is not np else np.int64(0))
         bbytes = []
         for c in build.columns:
-            if isinstance(c.dtype, (t.StringType, t.BinaryType)):
-                lens = (c.offsets[1:] - c.offsets[:-1]).astype(xp.int64)
-                sl = lens[order]
+            if c.offsets is not None:
+                sl = span_lens(c)[order]
                 pre = xp.concatenate([xp.zeros((1,), xp.int64),
                                       cumsum_fast(xp, sl)])
                 per = pre[lo + counts.astype(xp.int32)] - pre[lo]
@@ -283,15 +287,22 @@ class HashJoinExec(Exec):
                 pbytes = sizes[1:1 + len(probe.columns)]
                 bbytes = sizes[1 + len(probe.columns):]
                 out_cap = bucket_for(max(ntotal, 1), DEFAULT_ROW_BUCKETS)
-                pchar_caps = [bucket_for(max(int(x), 1),
-                                         DEFAULT_CHAR_BUCKETS)
-                              if isinstance(c.dtype, (t.StringType,
-                                                      t.BinaryType)) else 0
+
+                def span_cap(x, c):
+                    """Output child capacity for a span column: char
+                    bucket for strings, row bucket for array/map child
+                    rows; 0 = not a span column."""
+                    if isinstance(c.dtype, (t.StringType, t.BinaryType)):
+                        return bucket_for(max(int(x), 1),
+                                          DEFAULT_CHAR_BUCKETS)
+                    if isinstance(c.dtype, (t.ArrayType, t.MapType)):
+                        return bucket_for(max(int(x), 1),
+                                          DEFAULT_ROW_BUCKETS)
+                    return 0
+
+                pchar_caps = [span_cap(x, c)
                               for x, c in zip(pbytes, probe.columns)]
-                bchar_caps = [bucket_for(max(int(x), 1),
-                                         DEFAULT_CHAR_BUCKETS)
-                              if isinstance(c.dtype, (t.StringType,
-                                                      t.BinaryType)) else 0
+                bchar_caps = [span_cap(x, c)
                               for x, c in zip(bbytes, build.columns)]
                 out = self._expand_call(xp, build, probe, order, lo, counts,
                                         out_cap, pchar_caps, bchar_caps)

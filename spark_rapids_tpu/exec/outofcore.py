@@ -52,7 +52,7 @@ def slice_batch(xp, batch: DeviceBatch, names, types, start: int,
             hi = int(o[min(start + length, len(o) - 1)])
             char_caps.append(bucket_for(max(hi - lo, 1),
                                         DEFAULT_CHAR_BUCKETS))
-        elif isinstance(dt, t.ArrayType):
+        elif isinstance(dt, (t.ArrayType, t.MapType)):
             o = np.asarray(c.offsets)
             lo = int(o[min(start, len(o) - 1)])
             hi = int(o[min(start + length, len(o) - 1)])

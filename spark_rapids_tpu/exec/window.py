@@ -40,47 +40,64 @@ from .concat import concat_batches
 from ..ops.scan import cumsum_fast
 
 
+def _cummax_i32(xp, v):
+    """Running max of an int32 array via pad-shift doubling (the
+    associative_scan lowering pays a huge compile bill on this
+    platform; log2(n) elementwise maxes compile in seconds)."""
+    n = v.shape[0]
+    d = 1
+    while d < n:
+        if xp is np:
+            prev = np.concatenate([np.full((d,), np.iinfo(v.dtype).min,
+                                           v.dtype), v[:-d]])
+        else:
+            prev = xp.pad(v, (d, 0),
+                          constant_values=np.iinfo(np.int32).min)[:n]
+        v = xp.maximum(v, prev)
+        d *= 2
+    return v
+
+
 def _seg_start_positions(xp, new_seg):
     """pos of the segment start for every sorted row (cummax trick)."""
     n = new_seg.shape[0]
-    pos = xp.arange(n, dtype=xp.int64)
-    starts = xp.where(new_seg, pos, xp.int64(-1))
-    if xp is np:
-        return np.maximum.accumulate(starts)
-    return jax.lax.associative_scan(jnp.maximum, starts)
+    pos = xp.arange(n, dtype=xp.int32)
+    starts = xp.where(new_seg, pos, xp.int32(-1))
+    return _cummax_i32(xp, starts)
 
 
 def _run_end_positions(xp, new_run):
-    """pos of the last row of each peer run: run id per row, then the max
-    position within each run, broadcast back."""
+    """pos of the last row of each peer run: the NEXT run's start minus
+    one (runs are contiguous; the final run closes at the array end)."""
     n = new_run.shape[0]
-    pos = xp.arange(n, dtype=xp.int64)
-    run_id = (cumsum_fast(xp, new_run.astype(xp.int64)) - 1).astype(xp.int32)
-    run_id = xp.clip(run_id, 0, n - 1)
-    last, _ = seg.segment_reduce(xp, "max", pos, run_id, n,
-                                 xp.ones((n,), dtype=bool))
-    return xp.clip(last[run_id], 0, n - 1)
+    pos = xp.arange(n, dtype=xp.int32)
+    # reversed cummin of next-run starts == next run-start after each row
+    nxt = xp.concatenate([new_run[1:], xp.ones((1,), dtype=bool)])
+    ends = xp.where(nxt, pos, xp.int32(n - 1))
+    # running min from the right: reverse, cummin (== -cummax of negation)
+    rev = -ends[::-1]
+    return xp.clip(-(_cummax_i32(xp, rev)[::-1]), 0, n - 1)
 
 
 def _segmented_running_minmax(xp, v, new_seg, is_min: bool):
-    if xp is np:
-        out = v.copy()
-        for i in range(1, len(v)):
-            if not new_seg[i]:
-                out[i] = min(out[i - 1], out[i]) if is_min else \
-                    max(out[i - 1], out[i])
-        return out
-    neutral = seg._extreme_init(jnp, v.dtype, is_min)
-    op = jnp.minimum if is_min else jnp.maximum
-
-    def combine(a, b):
-        av, aseg = a
-        bv, bseg = b
-        # if b starts a new segment, ignore a's value
-        nv = jnp.where(bseg, bv, op(av, bv))
-        return nv, aseg | bseg
-    out, _ = jax.lax.associative_scan(combine, (v, new_seg))
-    return out
+    """Per-segment running min/max via the segmented pad-shift
+    recurrence (v[i] = op(v[i], v[i-d]) unless a boundary intervenes)."""
+    n = v.shape[0]
+    op = xp.minimum if is_min else xp.maximum
+    init = seg._extreme_init(xp, v.dtype, is_min)
+    f = new_seg.astype(bool)
+    d = 1
+    while d < n:
+        if xp is np:
+            pv = np.concatenate([np.full((d,), init, v.dtype), v[:-d]])
+            pf = np.concatenate([np.ones((d,), bool), f[:-d]])
+        else:
+            pv = xp.pad(v, (d, 0), constant_values=init)[:n]
+            pf = xp.pad(f, (d, 0), constant_values=True)[:n]
+        v = xp.where(f, v, op(v, pv))
+        f = f | pf
+        d *= 2
+    return v
 
 
 class WindowExec(Exec):
@@ -105,19 +122,21 @@ class WindowExec(Exec):
         return f"Window [{', '.join(w.name for w in self.window_exprs)}]"
 
     # ------------------------------------------------------------------
-    def _compute_one(self, xp, batch: Batch, wexpr: WindowExpression
-                     ) -> DeviceColumn:
-        cn = self.children[0].output_names
-        ct = self.children[0].output_types
-        ctx = EvalContext(xp, batch)
-        live = ctx.row_mask()
-        cap = batch.capacity
-        spec = wexpr.spec
+    class _Layout:
+        """Sorted-space layout shared by every window expr on one spec:
+        the sort happens ONCE per spec, inputs ride it as carry lanes,
+        and results ride ONE carry-sort back to input order."""
+        __slots__ = ("order", "live_s", "new_seg", "new_run", "seg_ids",
+                     "pos", "seg_start", "idx_in_seg", "okeys_sorted",
+                     "input_sorted")
+
+    def _build_layout(self, xp, batch, live, cap, spec, ctx, input_cols):
+        cn, ct = self.children[0].output_names, self.children[0].output_types
         pkeys = [bind_expression(p, cn, ct).eval(ctx).col
                  for p in spec.partition_by]
         okeys = [(bind_expression(o, cn, ct).eval(ctx).col, asc, nf)
                  for o, asc, nf in spec.order_by]
-        words = [(~live).astype(xp.uint64)]
+        words = [(~live).astype(xp.uint8)]
         pwords: List = []
         for pk in pkeys:
             pwords += seg.key_words_for_column(xp, pk, live,
@@ -127,36 +146,62 @@ class WindowExec(Exec):
             owords += seg.key_words_for_column(xp, ok, live,
                                                for_grouping=False,
                                                nulls_first=nf, ascending=asc)
-        order = seg.lexsort(xp, words + pwords + owords, cap)
-        inv = xp.zeros((cap,), dtype=xp.int32)
-        if xp is np:
-            inv[order] = np.arange(cap, dtype=np.int32)
-        else:
-            inv = inv.at[order].set(xp.arange(cap, dtype=xp.int32))
-        live_s = live[order]
-        psorted = [w[order] for w in pwords]
-        osorted = [w[order] for w in owords]
+        from ..ops import carry
+        okey_cols = [ok for ok, _, _ in okeys]
+        order, sorted_cols, ex = carry.sort_rows(
+            xp, words + pwords + owords, list(input_cols) + okey_cols,
+            cap, extras=[live] + pwords + owords)
+        lay = WindowExec._Layout()
+        lay.order = order
+        lay.input_sorted = sorted_cols[:len(input_cols)]
+        osorted_cols = sorted_cols[len(input_cols):]
+        lay.okeys_sorted = [(c, asc, nf) for c, (_, asc, nf) in
+                            zip(osorted_cols, okeys)]
+        lay.live_s = ex[0]
+        psorted = ex[1:1 + len(pwords)]
+        osorted = ex[1 + len(pwords):]
+        live_s = lay.live_s
         new_seg = seg.segment_boundaries(xp, psorted if psorted else
-                                         [live_s.astype(xp.uint64) * 0],
+                                         [live_s.astype(xp.uint8) * 0],
                                          live_s)
         if not pkeys:
             new_seg = (xp.arange(cap) == 0)
-        new_run = seg.segment_boundaries(xp, psorted + osorted, live_s) \
+        lay.new_seg = new_seg
+        lay.new_run = seg.segment_boundaries(xp, psorted + osorted, live_s) \
             if okeys else new_seg
-        seg_ids = xp.clip(seg.segment_ids(xp, new_seg), 0, cap - 1)
-        pos = xp.arange(cap, dtype=xp.int64)
-        seg_start = _seg_start_positions(xp, new_seg)
-        idx_in_seg = pos - seg_start
+        lay.seg_ids = xp.clip(seg.segment_ids(xp, new_seg), 0, cap - 1)
+        lay.pos = xp.arange(cap, dtype=xp.int32)
+        lay.seg_start = _seg_start_positions(xp, new_seg)
+        lay.idx_in_seg = lay.pos - lay.seg_start
+        return lay
+
+    def _compute_one(self, xp, batch: Batch, wexpr: WindowExpression,
+                     lay, sorted_inputs) -> tuple:
+        """Returns ("lanes", sorted_data, sorted_valid) for flat results
+        (the caller carries them back to input order in one sort) or
+        ("col", device_column) for span results like strings (a char
+        buffer cannot ride a row carry-sort; the caller gathers it back
+        by the inverse permutation instead)."""
+        cn = self.children[0].output_names
+        ct = self.children[0].output_types
+        cap = batch.capacity
+        spec = wexpr.spec
+        okeys = lay.okeys_sorted
+        live_s = lay.live_s
+        new_seg, new_run = lay.new_seg, lay.new_run
+        seg_ids = lay.seg_ids
+        pos = lay.pos
+        seg_start = lay.seg_start
+        idx_in_seg = lay.idx_in_seg
 
         func = wexpr.func
         out_dtype = wexpr.resolved_type(cn, ct)
+        span_result = isinstance(out_dtype, (t.StringType, t.BinaryType,
+                                             t.ArrayType, t.StructType,
+                                             t.MapType))
 
         def finish(sorted_data, sorted_valid):
-            data = sorted_data[inv]
-            valid = sorted_valid[inv] & live
-            if not isinstance(out_dtype, (t.StringType, t.BinaryType)):
-                data = xp.where(valid, data, xp.zeros_like(data))
-            return DeviceColumn(out_dtype, data=data, validity=valid)
+            return ("lanes", sorted_data, sorted_valid)
 
         if isinstance(func, (RowNumber, Rank, DenseRank)) and \
                 type(func) is RowNumber:
@@ -166,9 +211,9 @@ class WindowExec(Exec):
             return finish((run_start - seg_start + 1).astype(np.int32),
                           live_s)
         if type(func) is DenseRank:
-            runs_cum = cumsum_fast(xp, new_run.astype(xp.int64))
+            runs_cum = cumsum_fast(xp, new_run.astype(xp.int32))
             base = runs_cum[xp.clip(seg_start, 0, cap - 1)] - \
-                new_run[xp.clip(seg_start, 0, cap - 1)].astype(xp.int64)
+                new_run[xp.clip(seg_start, 0, cap - 1)].astype(xp.int32)
             return finish((runs_cum - base).astype(np.int32), live_s)
         # partition row counts must exclude batch PADDING rows: dead
         # tail rows inherit the last live segment id in the sorted
@@ -188,8 +233,7 @@ class WindowExec(Exec):
         if type(func) is CumeDist:
             # last LIVE row of the current peer run (padding excluded)
             run_id = xp.clip(
-                (cumsum_fast(xp, new_run.astype(xp.int64)) - 1).astype(
-                    xp.int32), 0, cap - 1)
+                cumsum_fast(xp, new_run.astype(xp.int32)) - 1, 0, cap - 1)
             run_max, _ = seg.segment_reduce(xp, "max", pos, run_id, cap,
                                             live_s)
             run_end = run_max[run_id]
@@ -211,19 +255,14 @@ class WindowExec(Exec):
             return finish((bucket + 1).astype(np.int32), live_s)
 
         if isinstance(func, (Lead, Lag)):
-            child = bind_expression(func.children[0], cn, ct)
-            v = child.eval(ctx)
-            if not isinstance(v, ColumnValue):
-                v = make_column(ctx, child.data_type(),
-                                v.value if v.value is not None else 0,
-                                None if v.value is not None else False)
-            col_s = gather_column(xp, v.col, order,
-                                  xp.ones((cap,), dtype=bool))
+            col_s = sorted_inputs[0]
             k = -func.offset if isinstance(func, Lag) else func.offset
             src = xp.clip(pos + k, 0, cap - 1).astype(xp.int32)
             same_seg = (seg_ids[src] == seg_ids) & \
                 (pos + k >= 0) & (pos + k < cap) & live_s[src]
             shifted = gather_column(xp, col_s, src, same_seg)
+            if span_result:
+                return ("col", shifted)
             return finish(shifted.data,
                           shifted.validity if shifted.validity is not None
                           else same_seg)
@@ -232,18 +271,12 @@ class WindowExec(Exec):
             ae = bind_aggregate(AggregateExpression(func), cn, ct)
             f = ae.func
             kind, lo_b, hi_b = spec.effective_frame(False)
-            # evaluate update inputs in sorted order
+            # update inputs arrived in sorted order via the carry-sort
             upd = f.update()
             bufs_sorted = []
-            for expr, op in upd:
-                v = expr.eval(ctx)
-                if not isinstance(v, ColumnValue):
-                    v = make_column(ctx, expr.data_type(),
-                                    v.value if v.value is not None else 0,
-                                    None if v.value is not None else False)
-                vs = v.col.data[order] if v.col.data is not None else None
-                val = (v.col.validity[order]
-                       if v.col.validity is not None else
+            for scol, (expr, op) in zip(sorted_inputs, upd):
+                vs = scol.data
+                val = (scol.validity if scol.validity is not None else
                        xp.ones((cap,), dtype=bool)) & live_s
                 bufs_sorted.append((vs, val, op))
             whole = (lo_b == UNBOUNDED_PRECEDING and
@@ -256,11 +289,11 @@ class WindowExec(Exec):
                 run_end_pos = _run_end_positions(xp, new_run)
                 bounds = self._frame_bounds(
                     xp, kind, lo_b, hi_b, pos, seg_start, seg_end_pos,
-                    run_start_pos, run_end_pos, okeys, order, cap, live_s)
+                    run_start_pos, run_end_pos, okeys, cap, live_s)
             results = []
             for vs, val, op in bufs_sorted:
                 if op == "countvalid":
-                    contrib = val.astype(xp.int64)
+                    contrib = val.astype(xp.int32)
                     red_op = "sum"
                     vv = contrib
                 elif op in ("sum",):
@@ -296,8 +329,8 @@ class WindowExec(Exec):
                     hi_c = xp.clip(hi_i, -1, cap - 1)
                     empty = hi_c < lo_c
                     cpre = xp.concatenate([
-                        xp.zeros((1,), xp.int64),
-                        cumsum_fast(xp, val.astype(xp.int64))])
+                        xp.zeros((1,), xp.int32),
+                        cumsum_fast(xp, val.astype(xp.int32))])
                     c = cpre[hi_c + 1] - cpre[lo_c]
                     c = xp.where(empty, xp.zeros_like(c), c)
                     if red_op == "sum":
@@ -344,28 +377,32 @@ class WindowExec(Exec):
             fctx = EvalContext(xp, DeviceBatch(
                 [c.col for c in buf_cols], batch.num_rows, None))
             res = f.evaluate(fctx, buf_cols)
+            if span_result:
+                return ("col", res.col)
             valid = res.col.validity if res.col.validity is not None else \
                 xp.ones((cap,), dtype=bool)
             return finish(res.col.data, valid)
         raise NotImplementedError(f"window function {type(func).__name__}")
 
     def _frame_bounds(self, xp, kind, lo_b, hi_b, pos, seg_start, seg_end,
-                      run_start, run_end, okeys, order, cap, live_s):
+                      run_start, run_end, okeys_sorted, cap, live_s):
         """Per-row inclusive [lo_i, hi_i] frame index bounds over the
         sorted row space, for bounded ROWS and RANGE frames."""
         if kind == "rows":
-            lo_i = seg_start.astype(xp.int64) \
+            lo_i = seg_start.astype(xp.int32) \
                 if lo_b == UNBOUNDED_PRECEDING else \
                 xp.clip(pos + lo_b, seg_start, seg_end + 1)
-            hi_i = seg_end.astype(xp.int64) \
+            hi_i = seg_end.astype(xp.int32) \
                 if hi_b == UNBOUNDED_FOLLOWING else \
                 xp.clip(pos + hi_b, seg_start - 1, seg_end)
-            return lo_i.astype(xp.int64), hi_i.astype(xp.int64)
+            return lo_i.astype(xp.int32), hi_i.astype(xp.int32)
         # range: exactly one ascending flat-numeric order key (tagging
-        # enforces this); null order rows frame over their peer run
-        oc, _, nf = okeys[0]
-        vals_s = oc.data[order]
-        ovalid_s = oc.validity[order] if oc.validity is not None else \
+        # enforces this); null order rows frame over their peer run.
+        # Order keys arrive already sorted (carried through the layout
+        # sort).
+        oc, _, nf = okeys_sorted[0]
+        vals_s = oc.data
+        ovalid_s = oc.validity if oc.validity is not None else \
             xp.ones((cap,), dtype=bool)
         # park nulls outside every finite search window
         park = seg._extreme_init(xp, vals_s.dtype, is_min=not nf)
@@ -377,22 +414,24 @@ class WindowExec(Exec):
         dead_park = seg._extreme_init(xp, vals_s.dtype, is_min=True)
         masked = xp.where(live_s, masked, xp.full_like(vals_s, dead_park))
         if lo_b == UNBOUNDED_PRECEDING:
-            lo_i = seg_start.astype(xp.int64)
+            lo_i = seg_start.astype(xp.int32)
         elif lo_b == CURRENT_ROW:
-            lo_i = run_start.astype(xp.int64)
+            lo_i = run_start.astype(xp.int32)
         else:
             lo_i = _vec_bound(xp, masked, vals_s + lo_b, seg_start,
                               seg_end + 1, cap, left=True)
         if hi_b == UNBOUNDED_FOLLOWING:
-            hi_i = seg_end.astype(xp.int64)
+            hi_i = seg_end.astype(xp.int32)
         elif hi_b == CURRENT_ROW:
-            hi_i = run_end.astype(xp.int64)
+            hi_i = run_end.astype(xp.int32)
         else:
             hi_i = _vec_bound(xp, masked, vals_s + hi_b, seg_start,
                               seg_end + 1, cap, left=False) - 1
         null_row = ~ovalid_s
-        lo_i = xp.where(null_row, run_start.astype(xp.int64), lo_i)
-        hi_i = xp.where(null_row, run_end.astype(xp.int64), hi_i)
+        lo_i = xp.where(null_row, run_start.astype(xp.int32),
+                        lo_i.astype(xp.int32))
+        hi_i = xp.where(null_row, run_end.astype(xp.int32),
+                        hi_i.astype(xp.int32))
         return lo_i, hi_i
 
     def _running(self, xp, red_op, vv, val, new_seg, seg_start):
@@ -401,25 +440,101 @@ class WindowExec(Exec):
             base = xp.where(seg_start > 0,
                             cs[xp.clip(seg_start - 1, 0, None)],
                             xp.zeros((), dtype=cs.dtype))
-            ccs = cumsum_fast(xp, val.astype(xp.int64))
+            ccs = cumsum_fast(xp, val.astype(xp.int32))
             cbase = xp.where(seg_start > 0,
                              ccs[xp.clip(seg_start - 1, 0, None)],
-                             xp.zeros((), dtype=xp.int64))
+                             xp.zeros((), dtype=xp.int32))
             return cs - base, ccs - cbase
         if red_op in ("min", "max"):
             out = _segmented_running_minmax(xp, vv, new_seg,
                                             red_op == "min")
-            ccs = cumsum_fast(xp, val.astype(xp.int64))
+            ccs = cumsum_fast(xp, val.astype(xp.int32))
             cbase = xp.where(seg_start > 0,
                              ccs[xp.clip(seg_start - 1, 0, None)],
-                             xp.zeros((), dtype=xp.int64))
+                             xp.zeros((), dtype=xp.int32))
             return out, ccs - cbase
         raise NotImplementedError(f"running {red_op}")
 
+    def _input_exprs(self, wexpr):
+        """Bound input expressions whose columns must ride the layout
+        sort (order matches _compute_one's consumption)."""
+        cn, ct = self.children[0].output_names, self.children[0].output_types
+        func = wexpr.func
+        if isinstance(func, (Lead, Lag)):
+            return [bind_expression(func.children[0], cn, ct)]
+        if isinstance(func, AggregateFunction):
+            ae = bind_aggregate(AggregateExpression(func), cn, ct)
+            return [expr for expr, _op in ae.func.update()]
+        return []
+
     def _compute(self, xp, batch: Batch) -> Batch:
-        cols = list(batch.columns)
+        from ..ops import carry
+        cn, ct = self.children[0].output_names, self.children[0].output_types
+        ctx = EvalContext(xp, batch)
+        live = ctx.row_mask()
+        cap = batch.capacity
+
+        def eval_col(e):
+            v = e.eval(ctx)
+            if not isinstance(v, ColumnValue):
+                v = make_column(ctx, e.data_type(),
+                                v.value if v.value is not None else 0,
+                                None if v.value is not None else False)
+            return v.col
+
+        # group exprs by window spec; each group shares one sorted layout
+        specs: dict = {}
+        group_inputs: dict = {}
+        group_slices: dict = {}
         for w in self.window_exprs:
-            cols.append(self._compute_one(xp, batch, w))
+            sig = semantic_sig(w.spec)
+            specs.setdefault(sig, w.spec)
+            gi = group_inputs.setdefault(sig, [])
+            cols = [eval_col(e) for e in self._input_exprs(w)]
+            group_slices.setdefault(sig, []).append((w, len(gi), len(cols)))
+            gi.extend(cols)
+
+        out_by_expr: dict = {}
+        for sig, spec in specs.items():
+            lay = self._build_layout(xp, batch, live, cap, spec, ctx,
+                                     group_inputs[sig])
+            per = []
+            inv = None
+            for (w, start, ncols) in group_slices[sig]:
+                res = self._compute_one(
+                    xp, batch, w, lay, lay.input_sorted[start:start + ncols])
+                if res[0] == "col":
+                    # span results (strings etc.) cannot ride the row
+                    # carry-sort; gather back by the inverse permutation
+                    if inv is None:
+                        iota = xp.arange(cap, dtype=xp.int32)
+                        if xp is np:
+                            inv = np.zeros((cap,), np.int32)
+                            inv[np.asarray(lay.order)] = iota
+                        else:
+                            inv = xp.zeros((cap,), xp.int32).at[
+                                lay.order].set(iota, unique_indices=True)
+                    out_by_expr[id(w)] = gather_column(xp, res[1], inv,
+                                                       live)
+                    continue
+                per.append((w, res[1], res[2]))
+            if not per:
+                continue
+            # ONE carry-sort back to input order for the whole group
+            back_key = lay.order.astype(xp.uint32)
+            flat: List = []
+            for _, d, v in per:
+                flat += [d, v]
+            _, back = carry.sort_lanes(xp, [back_key], flat, cap)
+            for i, (w, _, _) in enumerate(per):
+                d, v = back[2 * i], back[2 * i + 1]
+                out_dtype = w.resolved_type(cn, ct)
+                valid = v & live
+                d = xp.where(valid, d, xp.zeros_like(d))
+                out_by_expr[id(w)] = DeviceColumn(out_dtype, data=d,
+                                                  validity=valid)
+        cols = list(batch.columns) + [out_by_expr[id(w)]
+                                      for w in self.window_exprs]
         return DeviceBatch(cols, batch.num_rows, self.output_names)
 
     @functools.cached_property
@@ -455,8 +570,8 @@ def _vec_bound(xp, values, target, lo0, hi0, cap, left: bool):
     values[i] >= target (left) / > target (right).  `values` must be
     ascending within each row's [lo0, hi0) window."""
     import math
-    lo = lo0.astype(xp.int64)
-    hi = hi0.astype(xp.int64)
+    lo = lo0.astype(xp.int32)
+    hi = hi0.astype(xp.int32)
     iters = max(1, int(math.ceil(math.log2(max(cap, 2)))) + 1)
     for _ in range(iters):
         active = lo < hi

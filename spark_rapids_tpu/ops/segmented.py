@@ -423,14 +423,16 @@ def segment_sum128(xp, lo, hi, seg_ids, num_segments: int, valid,
         np.add.at(sh, seg_ids[valid], hi_v[valid])
         np.add.at(cnt, seg_ids[valid], 1)
     else:
-        # one shared (startpos, endpos) pair serves all three word sums
-        ctx = build_segment_ctx(xp, seg_ids, num_segments, valid)
+        # one shared (startpos, endpos) pair serves all three word sums;
+        # the span-based fast path is only valid for contiguous segments
+        ctx = build_segment_ctx(xp, seg_ids, num_segments, valid) \
+            if sorted_ids else None
         s0, cnt = segment_reduce(xp, "sum", lo32, seg_ids, num_segments,
-                                 valid, sorted_ids=True, ctx=ctx)
+                                 valid, sorted_ids=sorted_ids, ctx=ctx)
         s1, _ = segment_reduce(xp, "sum", hi32, seg_ids, num_segments,
-                               valid, sorted_ids=True, ctx=ctx)
+                               valid, sorted_ids=sorted_ids, ctx=ctx)
         sh, _ = segment_reduce(xp, "sum", hi_v, seg_ids, num_segments,
-                               valid, sorted_ids=True, ctx=ctx)
+                               valid, sorted_ids=sorted_ids, ctx=ctx)
     low32 = s0 & mask32
     c0 = s0 >> xp.uint64(32)
     tmid = s1 + c0

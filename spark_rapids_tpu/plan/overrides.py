@@ -573,6 +573,36 @@ def _tag_join(meta: "ExecMeta"):
         dt = b.data_type()
         if not (T.comparable + T.STRUCT).is_supported(dt):
             meta.will_not_work(f"join key type {dt.name} not supported")
+    # payload sizing: the join size pass computes top-level child-row /
+    # char totals for span columns, but a varlen type nested INSIDE
+    # another type (array<string>, map<_, string>, struct<string> — the
+    # struct gather branch forwards no char cap either) still defaults
+    # its inner buffer to the source capacity — a duplicating gather
+    # would silently truncate it, so those payloads stay on CPU until
+    # the size pass learns to walk nested spans
+    def nested_varlen(dt: t.DataType) -> bool:
+        if isinstance(dt, t.ArrayType):
+            return _has_varlen(dt.element_type)
+        if isinstance(dt, t.MapType):
+            return _has_varlen(dt.key_type) or _has_varlen(dt.value_type)
+        if isinstance(dt, t.StructType):
+            return any(_has_varlen(f.data_type) for f in dt.fields)
+        return False
+
+    def _has_varlen(dt: t.DataType) -> bool:
+        if isinstance(dt, (t.StringType, t.BinaryType,
+                           t.ArrayType, t.MapType)):
+            return True
+        if isinstance(dt, t.StructType):
+            return any(_has_varlen(f.data_type) for f in dt.fields)
+        return False
+
+    for side in e.children:
+        for dt in side.output_types:
+            if nested_varlen(dt):
+                meta.will_not_work(
+                    f"join payload type {dt.name} (varlen nested in "
+                    f"varlen) not sized for duplicating gathers")
 
 
 def _convert_aggregate(e: CpuHashAggregateExec, conf) -> eb.Exec:
