@@ -1,0 +1,14 @@
+name := "tpu-bridge"
+
+version := "0.1"
+
+scalaVersion := "2.12.18"
+
+crossScalaVersions := Seq("2.12.18", "2.13.12")
+
+val sparkVersion = sys.props.getOrElse("spark.version", "3.5.1")
+
+libraryDependencies ++= Seq(
+  "org.apache.spark" %% "spark-sql" % sparkVersion % "provided",
+  "org.apache.spark" %% "spark-core" % sparkVersion % "provided"
+)

@@ -1,0 +1,347 @@
+/*
+ * TPU bridge — Spark side.
+ *
+ * The role Plugin.scala + GpuOverrides.scala play for the reference
+ * plugin: inject a physical-plan rule that replaces the largest
+ * supported subtree with an exec that runs it inside the TPU engine's
+ * sidecar process, splicing Arrow results back as InternalRows.
+ *
+ * Built by CI against Spark 3.3-3.5 (see bridge-jvm/README.md); the
+ * engine's hermetic environment carries no Spark distribution, so this
+ * source is validated by the fake-JVM protocol harness on that side
+ * (tests/test_bridge.py) and by the pyspark-marked integration test
+ * where pyspark exists (tests/test_bridge_pyspark.py).
+ */
+package org.sparkrapids.tpu
+
+import java.io.{BufferedInputStream, BufferedOutputStream, DataInputStream, DataOutputStream}
+import java.net.Socket
+import java.nio.charset.StandardCharsets
+
+import scala.collection.mutable.ArrayBuffer
+
+import org.apache.spark.api.plugin.{DriverPlugin, ExecutorPlugin, SparkPlugin}
+import org.apache.spark.rdd.RDD
+import org.apache.spark.sql.SparkSessionExtensions
+import org.apache.spark.sql.catalyst.InternalRow
+import org.apache.spark.sql.catalyst.expressions._
+import org.apache.spark.sql.catalyst.expressions.aggregate._
+import org.apache.spark.sql.catalyst.rules.Rule
+import org.apache.spark.sql.execution._
+import org.apache.spark.sql.execution.aggregate.HashAggregateExec
+import org.apache.spark.sql.execution.arrow.ArrowConverters
+import org.apache.spark.sql.execution.joins.BroadcastHashJoinExec
+import org.apache.spark.sql.execution.window.WindowExec
+import org.apache.spark.sql.types.StructType
+import org.apache.spark.sql.util.ArrowUtils
+
+/** Entry point for --conf spark.sql.extensions=... */
+class TpuBridgeExtensions extends (SparkSessionExtensions => Unit) {
+  override def apply(ext: SparkSessionExtensions): Unit = {
+    ext.injectColumnarRule(_ => TpuBridgeColumnarRule)
+  }
+}
+
+object TpuBridgeColumnarRule extends org.apache.spark.sql.execution.ColumnarRule {
+  override def preColumnarTransitions: Rule[SparkPlan] = TpuBridgeRule
+}
+
+/**
+ * Replace the largest supported plan prefix with a TpuBridgeExec.  The
+ * match walks top-down: at each node, collect the chain of spec-capable
+ * operators (project/filter/aggregate/sort/limit/window/broadcast join)
+ * whose expressions all translate; the first untranslatable node becomes
+ * the bridge exec's child and executes on the CPU as usual.
+ */
+object TpuBridgeRule extends Rule[SparkPlan] {
+  override def apply(plan: SparkPlan): SparkPlan = {
+    if (!plan.conf.getConfString("spark.tpu.bridge.enabled", "false").toBoolean) {
+      return plan
+    }
+    plan.transformDown {
+      case p if SpecBuilder.supportedChain(p) =>
+        val (ops, child, extraInputs) = SpecBuilder.build(p)
+        TpuBridgeExec(p.output, ops, child, extraInputs)
+    }
+  }
+}
+
+/** Catalyst -> JSON spec translation (mirrors bridge/spec.py). */
+object SpecBuilder {
+  private def json(s: String): String =
+    "\"" + s.replace("\\", "\\\\").replace("\"", "\\\"") + "\""
+
+  def expr(e: Expression): Option[String] = e match {
+    case a: AttributeReference => Some(s"""{"col": ${json(a.name)}}""")
+    case Alias(c, _) => expr(c)
+    case l: Literal if l.value == null =>
+      Some(s"""{"lit": null, "type": ${json(l.dataType.catalogString)}}""")
+    case l: Literal =>
+      val v = l.dataType.catalogString match {
+        case "string" => json(l.value.toString)
+        case _        => l.value.toString
+      }
+      Some(s"""{"lit": $v, "type": ${json(l.dataType.catalogString)}}""")
+    case b: BinaryOperator =>
+      val op = b match {
+        case _: EqualTo            => "eq"
+        case _: LessThan           => "lt"
+        case _: LessThanOrEqual    => "le"
+        case _: GreaterThan        => "gt"
+        case _: GreaterThanOrEqual => "ge"
+        case _: And                => "and"
+        case _: Or                 => "or"
+        case _: Add                => "add"
+        case _: Subtract           => "sub"
+        case _: Multiply           => "mul"
+        case _: Divide             => "div"
+        case _                     => return None
+      }
+      for (l <- expr(b.left); r <- expr(b.right))
+        yield s"""{"op": ${json(op)}, "children": [$l, $r]}"""
+    case Not(EqualTo(l, r)) =>
+      for (ls <- expr(l); rs <- expr(r))
+        yield s"""{"op": "ne", "children": [$ls, $rs]}"""
+    case Not(c) => expr(c).map(cs => s"""{"op": "not", "children": [$cs]}""")
+    case IsNull(c) =>
+      expr(c).map(cs => s"""{"op": "isnull", "children": [$cs]}""")
+    case IsNotNull(c) =>
+      expr(c).map(cs => s"""{"op": "isnotnull", "children": [$cs]}""")
+    case _ => None
+  }
+
+  private def aggFn(a: AggregateFunction): Option[(String, Option[Expression])] =
+    a match {
+      case Sum(c, _)           => Some(("sum", Some(c)))
+      case Average(c, _)       => Some(("avg", Some(c)))
+      case Min(c)              => Some(("min", Some(c)))
+      case Max(c)              => Some(("max", Some(c)))
+      case Count(Seq(Literal(1, _))) => Some(("count", None))
+      case Count(Seq(c))       => Some(("count", Some(c)))
+      case _                   => None
+    }
+
+  /** Is this node (and its supported chain) fully translatable? */
+  def supportedChain(p: SparkPlan): Boolean = build0(p).isDefined
+
+  def build(p: SparkPlan): (String, SparkPlan, Seq[SparkPlan]) =
+    build0(p).get
+
+  private def build0(p: SparkPlan): Option[(String, SparkPlan, Seq[SparkPlan])] = {
+    val extra = ArrayBuffer[SparkPlan]()
+
+    def walk(node: SparkPlan): Option[(List[String], SparkPlan)] = node match {
+      case ProjectExec(exprs, child) =>
+        val parts = exprs.map { ne =>
+          expr(ne).map(e => s"""{"expr": $e, "name": ${json(ne.name)}}""")
+        }
+        if (parts.exists(_.isEmpty)) None
+        else walk(child).map { case (ops, leaf) =>
+          (s"""{"op": "project", "exprs": [${parts.flatten.mkString(", ")}]}""" :: ops, leaf)
+        }
+      case FilterExec(cond, child) =>
+        expr(cond).flatMap { c =>
+          walk(child).map { case (ops, leaf) =>
+            (s"""{"op": "filter", "condition": $c}""" :: ops, leaf)
+          }
+        }
+      case agg: HashAggregateExec if agg.aggregateExpressions.forall(
+          ae => ae.mode == Complete || ae.mode == Partial) =>
+        val groups = agg.groupingExpressions.map(expr)
+        val aggs = agg.aggregateExpressions.map { ae =>
+          aggFn(ae.aggregateFunction).flatMap { case (fn, childE) =>
+            val ce = childE.map(expr)
+            if (ce.exists(_.isEmpty)) None
+            else Some(s"""{"fn": ${json(fn)}, "expr": ${ce.flatten.getOrElse("null")}, "name": ${json(ae.resultAttribute.name)}}""")
+          }
+        }
+        if (groups.exists(_.isEmpty) || aggs.exists(_.isEmpty)) None
+        else walk(agg.child).map { case (ops, leaf) =>
+          (s"""{"op": "aggregate", "groupBy": [${groups.flatten.mkString(", ")}], "aggs": [${aggs.flatten.mkString(", ")}]}""" :: ops, leaf)
+        }
+      case SortExec(orders, true, child, _) =>
+        val os = orders.map { so =>
+          expr(so.child).map { e =>
+            val asc = so.direction == Ascending
+            val nf = so.nullOrdering == NullsFirst
+            s"""{"expr": $e, "ascending": $asc, "nullsFirst": $nf}"""
+          }
+        }
+        if (os.exists(_.isEmpty)) None
+        else walk(child).map { case (ops, leaf) =>
+          (s"""{"op": "sort", "orders": [${os.flatten.mkString(", ")}]}""" :: ops, leaf)
+        }
+      case j: BroadcastHashJoinExec if j.condition.isEmpty =>
+        val keys = j.leftKeys.zip(j.rightKeys).map {
+          case (l: AttributeReference, r: AttributeReference)
+              if l.name == r.name => Some(json(l.name))
+          case _ => None
+        }
+        if (keys.exists(_.isEmpty)) None
+        else {
+          extra += j.right
+          val idx = extra.size
+          walk(j.left).map { case (ops, leaf) =>
+            (s"""{"op": "join", "right": $idx, "how": "${j.joinType.sql.toLowerCase}", "on": [${keys.flatten.mkString(", ")}]}""" :: ops, leaf)
+          }
+        }
+      case w: WindowExec => None // window translation: follow-up; spec carries it
+      case leaf => Some((Nil, leaf))
+    }
+
+    walk(p).flatMap { case (opsTopFirst, leaf) =>
+      if (opsTopFirst.isEmpty) None  // nothing to push down
+      else {
+        val schema = leaf.output.map(a =>
+          s"""[${json(a.name)}, ${json(a.dataType.catalogString)}]""")
+        val extraSchemas = extra.map(e =>
+          s"""{"schema": [${e.output.map(a => s"""[${json(a.name)}, ${json(a.dataType.catalogString)}]""").mkString(", ")}]}""")
+        // ops execute bottom-up
+        val ops = opsTopFirst.reverse.mkString(", ")
+        val spec =
+          s"""{"input": {"schema": [${schema.mkString(", ")}]}, """ +
+            s""""inputs": [${extraSchemas.mkString(", ")}], "ops": [$ops]}"""
+        Some((spec, leaf, extra.toSeq))
+      }
+    }
+  }
+}
+
+/**
+ * Executes `child` normally, ships each partition (plus the collected
+ * extra-input plans, broadcast to every task) through the sidecar
+ * protocol, and returns the sidecar's Arrow result rows.
+ */
+case class TpuBridgeExec(
+    output: Seq[Attribute],
+    spec: String,
+    child: SparkPlan,
+    extraInputs: Seq[SparkPlan]) extends UnaryExecNode {
+
+  override protected def doExecute(): RDD[InternalRow] = {
+    val childSchema = child.schema
+    val outSchema = StructType.fromAttributes(output)
+    val timeZone = conf.sessionLocalTimeZone
+    val port = conf.getConfString("spark.tpu.bridge.port",
+      TpuBridgeSidecar.port.toString).toInt
+    val specStr = spec
+    // extra inputs (join builds) are small broadcast-side plans:
+    // collect them once on the driver as Arrow payloads
+    val extras: Seq[Array[Byte]] = extraInputs.map { p =>
+      ArrowWire.planToIpc(p, timeZone)
+    }
+    val extrasBc = sparkContext.broadcast(extras)
+    child.execute().mapPartitionsInternal { rows =>
+      val ipc = ArrowWire.rowsToIpc(rows, childSchema, timeZone)
+      val result = SidecarClient.executeStage(
+        port, specStr, ipc +: extrasBc.value)
+      ArrowWire.ipcToRows(result, outSchema, timeZone)
+    }
+  }
+
+  override protected def withNewChildInternal(newChild: SparkPlan): SparkPlan =
+    copy(child = newChild)
+}
+
+/** Arrow IPC helpers over Spark's ArrowConverters. */
+object ArrowWire {
+  def rowsToIpc(rows: Iterator[InternalRow], schema: StructType,
+                timeZone: String): Array[Byte] = {
+    val batches = ArrowConverters.toBatchIterator(
+      rows, schema, Int.MaxValue, timeZone, org.apache.spark.TaskContext.get())
+    // toBatchIterator yields record-batch payloads; frame them as one
+    // IPC stream with the schema header
+    ArrowConverters.toArrowStream(schema, batches, timeZone)
+  }
+
+  def planToIpc(p: SparkPlan, timeZone: String): Array[Byte] = {
+    val rows = p.executeCollect().iterator
+    rowsToIpc(rows, p.schema, timeZone)
+  }
+
+  def ipcToRows(ipc: Array[Byte], schema: StructType,
+                timeZone: String): Iterator[InternalRow] = {
+    ArrowConverters.fromArrowStream(ipc, schema, timeZone)
+  }
+}
+
+/** Framed localhost protocol client (bridge/sidecar.py docstring). */
+object SidecarClient {
+  private val MAGIC = "TPUB".getBytes(StandardCharsets.US_ASCII)
+
+  def executeStage(port: Int, spec: String,
+                   inputs: Seq[Array[Byte]]): Array[Byte] = {
+    val sock = new Socket("127.0.0.1", port)
+    try {
+      val out = new DataOutputStream(
+        new BufferedOutputStream(sock.getOutputStream))
+      val in = new DataInputStream(
+        new BufferedInputStream(sock.getInputStream))
+      val specBytes = spec.getBytes(StandardCharsets.UTF_8)
+      out.write(MAGIC)
+      out.writeByte('M')
+      out.writeInt(Integer.reverseBytes(specBytes.length))
+      out.write(specBytes)
+      out.writeInt(Integer.reverseBytes(inputs.size))
+      inputs.foreach { ipc =>
+        out.writeLong(java.lang.Long.reverseBytes(ipc.length.toLong))
+        out.write(ipc)
+      }
+      out.flush()
+      val tag = in.readByte().toChar
+      if (tag == 'E') {
+        val n = Integer.reverseBytes(in.readInt())
+        val msg = new Array[Byte](n)
+        in.readFully(msg)
+        throw new RuntimeException(
+          "TPU sidecar stage failed: " + new String(msg, StandardCharsets.UTF_8))
+      }
+      val n = java.lang.Long.reverseBytes(in.readLong()).toInt
+      val body = new Array[Byte](n)
+      in.readFully(body)
+      body
+    } finally {
+      sock.close()
+    }
+  }
+}
+
+/** Executor lifecycle: launch one sidecar per executor, handshake port. */
+class TpuBridgeSparkPlugin extends SparkPlugin {
+  override def driverPlugin(): DriverPlugin = null
+  override def executorPlugin(): ExecutorPlugin = new TpuBridgeExecutorPlugin
+}
+
+object TpuBridgeSidecar {
+  @volatile var port: Int = -1
+  @volatile private var proc: Process = _
+
+  def ensureStarted(): Unit = synchronized {
+    if (port > 0) return
+    val pb = new ProcessBuilder(
+      "python", "-m", "spark_rapids_tpu.bridge.sidecar")
+    pb.redirectErrorStream(false)
+    proc = pb.start()
+    val reader = new java.io.BufferedReader(
+      new java.io.InputStreamReader(proc.getInputStream))
+    var line = reader.readLine()
+    while (line != null && !line.startsWith("TPU_SIDECAR_PORT=")) {
+      line = reader.readLine()
+    }
+    require(line != null, "sidecar never announced its port")
+    port = line.stripPrefix("TPU_SIDECAR_PORT=").trim.toInt
+  }
+
+  def stop(): Unit = synchronized {
+    if (proc != null) proc.destroy()
+    port = -1
+  }
+}
+
+class TpuBridgeExecutorPlugin extends ExecutorPlugin {
+  override def init(ctx: org.apache.spark.api.plugin.PluginContext,
+                    extraConf: java.util.Map[String, String]): Unit = {
+    TpuBridgeSidecar.ensureStarted()
+  }
+  override def shutdown(): Unit = TpuBridgeSidecar.stop()
+}

@@ -160,6 +160,11 @@ class TpuSession:
             mgr = TpuShuffleManager.get()
             for sid in ids:
                 mgr.unregister(sid)
+        # device-resident exchange memos (IciExchangeExec) hold whole
+        # shuffled datasets in HBM — same cleanup point as shuffle blocks
+        final_plan.foreach(
+            lambda e: e.release_shuffle()
+            if hasattr(e, "release_shuffle") else None)
 
     def execute(self, lp: L.LogicalPlan) -> pa.Table:
         final_plan = self.prepare_plan(lp)

@@ -33,14 +33,28 @@ class BridgeClient:
         _read_exact(self._sock, 8)
         return tag == b"O"
 
-    def execute_stage(self, spec: dict, table: pa.Table) -> pa.Table:
+    @staticmethod
+    def _ipc(table: pa.Table) -> bytes:
         sink = io.BytesIO()
         with pa.ipc.new_stream(sink, table.schema) as w:
             w.write_table(table)
-        ipc = sink.getvalue()
+        return sink.getvalue()
+
+    def execute_stage(self, spec: dict, table: pa.Table,
+                      extra_tables=()) -> pa.Table:
         blob = json.dumps(spec).encode()
-        self._sock.sendall(MAGIC + b"E" + struct.pack("<I", len(blob)) +
-                           blob + struct.pack("<Q", len(ipc)) + ipc)
+        if extra_tables:
+            parts = [MAGIC, b"M", struct.pack("<I", len(blob)), blob,
+                     struct.pack("<I", 1 + len(extra_tables))]
+            for tb in (table, *extra_tables):
+                ipc = self._ipc(tb)
+                parts += [struct.pack("<Q", len(ipc)), ipc]
+            self._sock.sendall(b"".join(parts))
+        else:
+            ipc = self._ipc(table)
+            self._sock.sendall(
+                MAGIC + b"E" + struct.pack("<I", len(blob)) + blob +
+                struct.pack("<Q", len(ipc)) + ipc)
         tag = _read_exact(self._sock, 1)
         if tag == b"E":
             (n,) = struct.unpack("<I", _read_exact(self._sock, 4))

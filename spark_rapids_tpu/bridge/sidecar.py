@@ -5,6 +5,11 @@ assumption, Plugin.scala:180-181).  The JVM connects over localhost TCP
 and drives the framed protocol:
 
     request : MAGIC 'E' | u32 spec_len | spec JSON | u64 ipc_len | Arrow IPC
+    request : MAGIC 'M' | u32 spec_len | spec JSON | u32 n_inputs |
+              (u64 ipc_len | Arrow IPC) * n_inputs   (multi-input stages:
+                                                      input 0 is the main
+                                                      stream, later ones
+                                                      back join ops)
     response: 'O' | u64 ipc_len | Arrow IPC        (stage result)
               'E' | u32 msg_len | utf-8 error      (stage failed; sidecar
                                                     stays up)
@@ -62,10 +67,11 @@ class SidecarServer:
             self._session = b.get_or_create()
         return self._session
 
-    def execute_stage(self, spec: dict, table: pa.Table) -> pa.Table:
+    def execute_stage(self, spec: dict, table: pa.Table,
+                      extra_tables=()) -> pa.Table:
         from .spec import plan_spec_to_logical
         session = self._get_session()
-        lp = plan_spec_to_logical(spec, table)
+        lp = plan_spec_to_logical(spec, table, extra_tables)
         return session.execute(lp)
 
     # -- server loop --------------------------------------------------------
@@ -100,17 +106,25 @@ class SidecarServer:
                 if op == b"Q":
                     self.shutdown()
                     return
-                if op != b"E":
+                if op not in (b"E", b"M"):
                     return
                 (spec_len,) = struct.unpack("<I", _read_exact(conn, 4))
                 spec_bytes = _read_exact(conn, spec_len)
-                (ipc_len,) = struct.unpack("<Q", _read_exact(conn, 8))
-                ipc = _read_exact(conn, ipc_len)
+                if op == b"M":
+                    (n_in,) = struct.unpack("<I", _read_exact(conn, 4))
+                else:
+                    n_in = 1
+                ipcs = []
+                for _ in range(max(n_in, 1)):
+                    (ipc_len,) = struct.unpack("<Q", _read_exact(conn, 8))
+                    ipcs.append(_read_exact(conn, ipc_len))
                 try:
                     spec = json.loads(spec_bytes)
-                    with pa.ipc.open_stream(io.BytesIO(ipc)) as r:
-                        table = r.read_all()
-                    out = self.execute_stage(spec, table)
+                    tables = []
+                    for ipc in ipcs:
+                        with pa.ipc.open_stream(io.BytesIO(ipc)) as r:
+                            tables.append(r.read_all())
+                    out = self.execute_stage(spec, tables[0], tables[1:])
                     sink = io.BytesIO()
                     with pa.ipc.new_stream(sink, out.schema) as w:
                         w.write_table(out)

@@ -1,21 +1,32 @@
 """JSON plan-spec: the language-neutral stage contract the JVM side emits.
 
-A spec describes one pushed-down stage over a single Arrow input stream
-(the subtree a ColumnarRule replaced, ref GpuOverrides' convert of
-scan/filter/project/aggregate subtrees).  Shape:
+A spec describes one pushed-down stage over one or more Arrow input
+streams (the subtree a ColumnarRule replaced, ref GpuOverrides' convert
+of scan/filter/project/aggregate/join/window subtrees).  Shape:
 
     {"input": {"schema": [["k", "bigint"], ["v", "bigint"]]},
+     "inputs": [{"schema": [...]}, ...],   # optional extra streams (joins)
      "ops": [
        {"op": "filter", "condition": <expr>},
        {"op": "project", "exprs": [{"expr": <expr>, "name": "x"}]},
        {"op": "aggregate",
         "groupBy": [<expr>...],
         "aggs": [{"fn": "sum", "expr": <expr>, "name": "s"}]},
+       {"op": "join", "right": 1,          # index into the input streams
+        "how": "inner", "on": ["k"],       # or "condition": <expr>
+       },
+       {"op": "window",
+        "partitionBy": [<expr>...],
+        "orderBy": [{"expr": <expr>, "ascending": true,
+                     "nullsFirst": true}],
+        "funcs": [{"fn": "row_number", "name": "rn"},
+                  {"fn": "sum", "expr": <expr>, "name": "rs"}]},
        {"op": "sort", "orders": [{"expr": <expr>, "ascending": true,
                                   "nullsFirst": true}]},
        {"op": "limit", "n": 10}
      ]}
 
+The main stream is input 0; `join` ops reference later streams by index.
 Expressions are JSON trees:
 
     {"col": "v"} | {"lit": 5, "type": "bigint"} |
@@ -86,8 +97,49 @@ def _agg_from_spec(a: Dict):
     return AggregateExpression(agg, a.get("name") or fn)
 
 
-def plan_spec_to_logical(spec: Dict, table) -> L.LogicalPlan:
-    """Spec + the stage's Arrow input -> engine logical plan."""
+_WINDOW_FNS = ("row_number", "rank", "dense_rank", "sum", "count", "avg",
+               "min", "max", "lead", "lag")
+
+
+def _window_from_spec(op: Dict) -> List:
+    """Window op spec -> WindowExpression list."""
+    from ..expr.aggregates import Average, Count, Max, Min, Sum
+    from ..expr.window import (DenseRank, Lag, Lead, Rank, RowNumber,
+                               WindowExpression, WindowSpec)
+    spec = WindowSpec(
+        partition_by=[expr_from_spec(p) for p in op.get("partitionBy", [])],
+        order_by=[(expr_from_spec(o["expr"]),
+                   bool(o.get("ascending", True)),
+                   bool(o.get("nullsFirst", o.get("ascending", True))))
+                  for o in op.get("orderBy", [])])
+    out = []
+    for f in op["funcs"]:
+        fn = f["fn"]
+        if fn not in _WINDOW_FNS:
+            raise ValueError(f"unsupported bridge window fn {fn!r}")
+        child = expr_from_spec(f["expr"]) if f.get("expr") is not None \
+            else None
+        if fn == "row_number":
+            func = RowNumber()
+        elif fn == "rank":
+            func = Rank()
+        elif fn == "dense_rank":
+            func = DenseRank()
+        elif fn == "lead":
+            func = Lead(child, int(f.get("offset", 1)))
+        elif fn == "lag":
+            func = Lag(child, int(f.get("offset", 1)))
+        else:
+            cls = {"sum": Sum, "count": Count, "avg": Average,
+                   "min": Min, "max": Max}[fn]
+            func = cls(child)
+        out.append(WindowExpression(func, spec, f.get("name") or fn))
+    return out
+
+
+def plan_spec_to_logical(spec: Dict, table, extra_tables=()) -> L.LogicalPlan:
+    """Spec + the stage's Arrow input stream(s) -> engine logical plan.
+    `table` is input 0; `extra_tables[i-1]` backs input i (joins)."""
     from ..expr.core import Alias
     lp: L.LogicalPlan = L.LocalRelation(table,
                                         spec.get("numPartitions", 1))
@@ -105,6 +157,21 @@ def plan_spec_to_logical(spec: Dict, table) -> L.LogicalPlan:
             grouping = [expr_from_spec(g) for g in op.get("groupBy", [])]
             aggs = [_agg_from_spec(a) for a in op.get("aggs", [])]
             lp = L.Aggregate(grouping, aggs, lp)
+        elif kind == "join":
+            ridx = int(op["right"])
+            if not (1 <= ridx <= len(extra_tables)):
+                raise ValueError(
+                    f"join input index {ridx} out of range "
+                    f"({len(extra_tables)} extra streams)")
+            right = L.LocalRelation(extra_tables[ridx - 1],
+                                    spec.get("numPartitions", 1))
+            how = op.get("how", "inner")
+            cond = expr_from_spec(op["condition"]) \
+                if op.get("condition") is not None else None
+            lp = L.Join(lp, right, how, cond,
+                        using=list(op.get("on") or []) or None)
+        elif kind == "window":
+            lp = L.Window(_window_from_spec(op), lp)
         elif kind == "sort":
             orders = [(expr_from_spec(o["expr"]),
                        bool(o.get("ascending", True)),

@@ -40,22 +40,7 @@ from .concat import concat_batches
 from ..ops.scan import cumsum_fast
 
 
-def _cummax_i32(xp, v):
-    """Running max of an int32 array via pad-shift doubling (the
-    associative_scan lowering pays a huge compile bill on this
-    platform; log2(n) elementwise maxes compile in seconds)."""
-    n = v.shape[0]
-    d = 1
-    while d < n:
-        if xp is np:
-            prev = np.concatenate([np.full((d,), np.iinfo(v.dtype).min,
-                                           v.dtype), v[:-d]])
-        else:
-            prev = xp.pad(v, (d, 0),
-                          constant_values=np.iinfo(np.int32).min)[:n]
-        v = xp.maximum(v, prev)
-        d *= 2
-    return v
+from ..ops.scan import cummax_i32 as _cummax_i32
 
 
 def _seg_start_positions(xp, new_seg):

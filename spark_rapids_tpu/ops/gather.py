@@ -27,8 +27,15 @@ def gather_spans(xp, offsets, indices, valid, out_child_cap: int):
         xp.zeros((1,), offsets.dtype),
         cumsum_fast(xp, src_len, dtype=offsets.dtype)])
     p = xp.arange(out_child_cap, dtype=xp.int32)
-    row = xp.clip(xp.searchsorted(new_offs[1:], p, side="right"),
-                  0, indices.shape[0] - 1).astype(xp.int32)
+    if xp is np:
+        row = np.clip(np.searchsorted(new_offs[1:], p, side="right"),
+                      0, indices.shape[0] - 1).astype(np.int32)
+    else:
+        from .scan import fill_rows_from_starts
+        row = xp.clip(
+            fill_rows_from_starts(xp, new_offs[:-1].astype(xp.int32),
+                                  src_len > 0, out_child_cap),
+            0, indices.shape[0] - 1)
     src_pos = src_start[row] + (p - new_offs[row])
     in_range = p < new_offs[-1]
     return new_offs, src_pos, in_range

@@ -73,21 +73,57 @@ def combined_key_hash(xp, key_cols, cap, null_matches: bool = False,
 def count_matches(xp, build_hash, build_live, probe_hash, probe_live):
     """Per-probe-row match ranges against the sorted build side.
 
-    Returns (sorted_build_order, lo, hi, counts) where build rows
-    sorted_build_order[lo[i]:hi[i]] match probe row i."""
+    Returns (sorted_build_order, lo, counts) where build rows
+    sorted_build_order[lo[i]:lo[i]+counts[i]] match probe row i.
+
+    TPU path: ONE combined stable sort over (hash, side, index) finds
+    every probe row's build run — within a hash segment build rows sort
+    first, so a probe row's running build count minus the count at the
+    segment start is exactly its match count, and the count at the
+    segment start is its `lo` into the hash-sorted build order.  A
+    per-position binary search (searchsorted) would cost ~log(n) gather
+    rounds; this is one sort + two scans + two int32 scatters."""
     cap_b = build_hash.shape[0]
     # park dead build rows at +inf end
     bh = xp.where(build_live, build_hash, xp.uint64(0xFFFFFFFFFFFFFFFF))
     if xp is np:
         order = np.argsort(bh, kind="stable").astype(np.int32)
         sorted_h = bh[order]
-    else:
-        from jax import lax
-        iota = xp.arange(cap_b, dtype=xp.int32)
-        sorted_h, order = lax.sort((bh, iota), num_keys=1, is_stable=True)
-    lo = xp.searchsorted(sorted_h, probe_hash, side="left").astype(xp.int32)
-    hi = xp.searchsorted(sorted_h, probe_hash, side="right").astype(xp.int32)
-    counts = xp.where(probe_live, hi - lo, 0).astype(xp.int64)
+        lo = np.searchsorted(sorted_h, probe_hash, side="left").astype(
+            np.int32)
+        hi = np.searchsorted(sorted_h, probe_hash, side="right").astype(
+            np.int32)
+        counts = np.where(probe_live, hi - lo, 0).astype(np.int64)
+        return order, lo, counts
+    from jax import lax
+    from .scan import cummax_i32, cumsum_fast
+    cap_p = probe_hash.shape[0]
+    iota_b = xp.arange(cap_b, dtype=xp.int32)
+    _, order = lax.sort((bh, iota_b), num_keys=1, is_stable=True)
+    allh = xp.concatenate([bh, probe_hash])
+    side = xp.concatenate([xp.zeros((cap_b,), xp.uint8),
+                           xp.ones((cap_p,), xp.uint8)])
+    idx = xp.concatenate([iota_b, xp.arange(cap_p, dtype=xp.int32)])
+    sh, ss, si = lax.sort((allh, side, idx), num_keys=2, is_stable=True)
+    is_b = (ss == 0).astype(xp.int32)
+    prev = xp.concatenate([sh[:1], sh[:-1]])
+    nb = (sh != prev)
+    n_all = cap_b + cap_p
+    if n_all > 0:
+        nb = nb | (xp.arange(n_all) == 0)
+    # running build count, exclusive of the current row
+    bexcl = cumsum_fast(xp, is_b) - is_b
+    # broadcast the segment-start value (bexcl is non-decreasing)
+    seg_start_excl = cummax_i32(xp, xp.where(nb, bexcl, xp.int32(-1)))
+    cnt_row = bexcl - seg_start_excl        # builds before row in its seg
+    # probe rows sort after every build row of their segment, so cnt_row
+    # IS the match count; scatter (lo, cnt) to original probe positions
+    probe_tgt = xp.where(ss == 1, si, xp.int32(cap_p))
+    lo = xp.zeros((cap_p,), xp.int32).at[probe_tgt].set(
+        seg_start_excl, mode="drop", unique_indices=True)
+    cnt = xp.zeros((cap_p,), xp.int32).at[probe_tgt].set(
+        cnt_row, mode="drop", unique_indices=True)
+    counts = xp.where(probe_live, cnt, 0).astype(xp.int64)
     return order, lo, counts
 
 
@@ -101,12 +137,21 @@ def expand_pairs(xp, order, lo, counts, probe_live, out_cap: int,
     outer_left = join_type in ("left", "full")
     eff_counts = xp.maximum(counts, 1) if outer_left else counts
     eff_counts = xp.where(probe_live, eff_counts, 0)
-    offs = xp.concatenate([xp.zeros((1,), xp.int64),
-                           cumsum_fast(xp, eff_counts, dtype=xp.int64)])
-    total = offs[-1]
-    p = xp.arange(out_cap, dtype=xp.int64)
-    row = xp.clip(xp.searchsorted(offs[1:], p, side="right"),
-                  0, counts.shape[0] - 1).astype(xp.int32)
+    eff32 = eff_counts.astype(xp.int32)
+    offs = xp.concatenate([xp.zeros((1,), xp.int32),
+                           cumsum_fast(xp, eff32)])
+    total = offs[-1].astype(xp.int64)
+    p = xp.arange(out_cap, dtype=xp.int32)
+    if xp is np:
+        row = np.clip(np.searchsorted(offs[1:], p, side="right"),
+                      0, counts.shape[0] - 1).astype(np.int32)
+    else:
+        # scatter each row's index at its span start, running-max fills
+        # the span (replaces a per-position binary search)
+        from .scan import fill_rows_from_starts
+        row = xp.clip(fill_rows_from_starts(xp, offs[:-1], eff32 > 0,
+                                            out_cap),
+                      0, counts.shape[0] - 1)
     k = (p - offs[row]).astype(xp.int32)
     pair_valid = p < total
     matched = counts[row] > 0

@@ -72,3 +72,37 @@ def segmented_cumsum_fast(xp, v, seg_start):
         f = f | pf
         d *= 2
     return v
+
+def cummax_i32(xp, v):
+    """Running max of an int32 array via pad-shift doubling."""
+    n = v.shape[0]
+    d = 1
+    lo = np.iinfo(np.int32).min
+    while d < n:
+        if xp is np:
+            prev = np.concatenate([np.full((d,), lo, v.dtype), v[:-d]])
+        else:
+            prev = xp.pad(v, (d, 0), constant_values=lo)[:n]
+        v = xp.maximum(v, prev)
+        d *= 2
+    return v
+
+
+def fill_rows_from_starts(xp, starts_i32, active, out_cap: int):
+    """For output positions p, the index of the input row whose span
+    contains p: rows scatter their index at their span start (skipped
+    when inactive/empty), then a running max fills the span — the
+    scatter+scan replacement for the per-position binary search
+    (searchsorted costs ~log(n) gather rounds on TPU; this is one int32
+    scatter plus log2(n) elementwise maxes)."""
+    n = starts_i32.shape[0]
+    iota = xp.arange(n, dtype=xp.int32)
+    if xp is np:
+        seed = np.zeros((out_cap,), np.int32)
+        tgt = np.where(active, np.clip(starts_i32, 0, out_cap), out_cap)
+        keep = tgt < out_cap
+        np.maximum.at(seed, tgt[keep], iota[keep])
+        return np.maximum.accumulate(seed)
+    tgt = xp.where(active, xp.clip(starts_i32, 0, out_cap), out_cap)
+    seed = xp.zeros((out_cap,), xp.int32).at[tgt].max(iota, mode="drop")
+    return cummax_i32(xp, seed)

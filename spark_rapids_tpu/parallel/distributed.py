@@ -357,13 +357,14 @@ class DistributedHashJoin:
     the join output at the bucketed static capacity — the multi-chip
     mirror of HashJoinExec's count/sync/expand pipeline."""
 
-    SUPPORTED = ("inner", "left", "left_semi", "left_anti")
+    SUPPORTED = ("inner", "left", "full", "left_semi", "left_anti")
 
     def __init__(self, left_keys, right_keys, how: str, condition,
                  lnames, ltypes, rnames, rtypes,
                  mesh: Optional[Mesh] = None, axis: str = DATA_AXIS):
         from ..exec.join import HashJoinExec
         if how not in self.SUPPORTED:
+            # right joins arrive pre-flipped to left (plan_join)
             raise NotImplementedError(f"ici join how={how}")
         if condition is not None and how != "inner":
             raise NotImplementedError("ici join residual condition only "
@@ -412,7 +413,7 @@ class DistributedHashJoin:
         add1 = lambda x: jax.tree_util.tree_map(  # noqa: E731
             lambda y: y[None], x)
         return (add1(lx), add1(rx), add1(order), add1(lo), add1(counts),
-                sizes[None])
+                sizes[None], matched[None])
 
     def _expand_step(self, lx, rx, order, lo, counts, out_cap: int,
                      pchar, bchar):
@@ -470,7 +471,8 @@ class DistributedHashJoin:
         rs = stack_shards(right_tables)
         if self.how in ("left_semi", "left_anti"):
             return shards_to_table(self._compiled_count()(ls, rs))
-        lx, rx, order, lo, counts, sizes = self._compiled_count()(ls, rs)
+        (lx, rx, order, lo, counts, sizes,
+         matched) = self._compiled_count()(ls, rs)
         sz = np.asarray(sizes)                       # one round trip
         ncols_l = len(self._join.children[0].output_names)
         out_cap = bucket_for(max(int(sz[:, 0].max()), 1),
@@ -487,7 +489,30 @@ class DistributedHashJoin:
                  for x, dt in zip(bb, r_types)]
         out = self._compiled_expand(out_cap, pchar, bchar)(
             lx, rx, order, lo, counts)
-        return shards_to_table(out)
+        result = shards_to_table(out)
+        if self.how == "full":
+            # keys are co-located per shard, so every build row's matches
+            # are local — per-shard unmatched emission is globally exact
+            unmatched = self._compiled_unmatched()(rx, matched)
+            um = shards_to_table(unmatched)
+            if um.num_rows:
+                result = pa.concat_tables(
+                    [result, um.cast(result.schema)])
+        return result
+
+    def _compiled_unmatched(self):
+        from ..exec.base import process_jit
+
+        def make():
+            def step(rx, matched):
+                rb = jax.tree_util.tree_map(lambda y: y[0], rx)
+                m = matched[0]
+                out = self._join._unmatched_build(jnp, rb, m)
+                return jax.tree_util.tree_map(lambda y: y[None], out)
+            return shard_map(step, mesh=self.mesh,
+                             in_specs=(P(self.axis), P(self.axis)),
+                             out_specs=P(self.axis), check_vma=False)
+        return process_jit(self._jit_key + ("unmatched",), make)
 
 
 def _attr(name: str, dtype: t.DataType):

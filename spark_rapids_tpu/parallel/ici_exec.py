@@ -63,6 +63,14 @@ class IciAggregateExec(Exec):
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         source = self.children[0]
+        stacked = _gather_source_stacked(
+            source, ctx, source.output_names, source.output_types,
+            self._dagg.n_dev)
+        if stacked is not None:
+            with MetricTimer(self.metrics[OP_TIME]):
+                out = self._dagg._compiled(stacked)
+            yield from _emit_stacked(self, out)
+            return
         tbl = _gather_source_table(source, ctx, source.output_names,
                                    source.output_types)
         shards = _shard_table(tbl, self._dagg.n_dev)
@@ -83,6 +91,93 @@ def _gather_source_table(source: Exec, ctx, names, dtypes) -> pa.Table:
         return schema.empty_table()
     return pa.Table.from_batches([rb.cast(schema) for rb in rbs],
                                  schema=schema)
+
+
+def _flat_schema(dtypes) -> bool:
+    from .. import types as t
+
+    def flat(dt):
+        if isinstance(dt, (t.StringType, t.BinaryType, t.ArrayType,
+                           t.MapType)):
+            return False
+        if isinstance(dt, t.StructType):
+            return all(flat(f.data_type) for f in dt.fields)
+        return True
+    return all(flat(dt) for dt in dtypes)
+
+
+def _gather_source_stacked(source: Exec, ctx, names, dtypes, n_dev: int):
+    """Device-resident scan->mesh edge: collect the source's DEVICE
+    batches, concatenate on device, and reshape every lane to
+    (n_dev, shard_cap) with ONE jitted program — rows never stage
+    through host Arrow (ref RapidsShuffleInternalManagerBase.scala:74:
+    shuffle input stays device-resident end-to-end).  Returns the
+    stacked DeviceBatch, or None when the schema has span columns
+    (offset rebasing across shards still goes through the host path)."""
+    if not _flat_schema(dtypes):
+        return None
+    import jax
+    import jax.numpy as jnp
+    from ..columnar.device import (DEFAULT_ROW_BUCKETS, DeviceBatch,
+                                   batch_to_device, bucket_for)
+    from ..exec.concat import concat_batches
+    from ..exec.base import process_jit, schema_sig
+
+    batches = []
+    for spid in range(source.num_partitions):
+        for b in source.execute_partition(spid, ctx):
+            batches.append(b)
+    batches = [b for b in batches if int(b.num_rows)]
+    if not batches:
+        schema = to_arrow_schema(names, dtypes)
+        rb = pa.RecordBatch.from_pydict(
+            {f.name: pa.array([], type=f.type) for f in schema},
+            schema=schema)
+        batches = [batch_to_device(rb)]
+    merged = concat_batches(jnp, batches, names, dtypes) \
+        if len(batches) > 1 else batches[0]
+    total = int(merged.num_rows)
+    # per-shard row budget rounds up to a power of two so distinct totals
+    # share compiled reshard programs (static-shape discipline) while
+    # shard imbalance stays bounded by 2x (the sparse row-bucket ladder
+    # could idle most of the mesh)
+    import math
+    need = max(1024, -(-total // n_dev))
+    per = 1 << math.ceil(math.log2(need))
+    in_cap = merged.capacity
+
+    def make():
+        def reshard(b: DeviceBatch):
+            def lane(x):
+                need = n_dev * per
+                if x.shape[0] < need:
+                    x = jnp.pad(x, (0, need - x.shape[0]))
+                return x[:need].reshape(n_dev, per)
+            cols = jax.tree_util.tree_map(lane, b.columns)
+            rows = jnp.clip(
+                jnp.asarray(b.num_rows, jnp.int32)
+                - jnp.arange(n_dev, dtype=jnp.int32) * np.int32(per),
+                0, np.int32(per))
+            return DeviceBatch(cols, rows, b.names)
+        return reshard
+    fn = process_jit(("ici_reshard", tuple(names),
+                      tuple(repr(d) for d in dtypes), in_cap, n_dev, per),
+                     make)
+    return fn(merged)
+
+
+def _emit_stacked(self, stacked) -> Iterator[Batch]:
+    """Yield per-shard device batches (mesh order) without host staging."""
+    import jax
+    from .distributed import unstack_shards
+    for b in unstack_shards(stacked):
+        n = int(np.asarray(b.num_rows))
+        if n == 0:
+            continue
+        out = Batch(b.columns, n, b.names)
+        self.metrics[NUM_OUTPUT_ROWS] += n
+        self.metrics[NUM_OUTPUT_BATCHES] += 1
+        yield out
 
 
 def _shard_table(tbl: pa.Table, n_dev: int):
@@ -131,6 +226,15 @@ class IciSortExec(Exec):
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         source = self.children[0]
+        stacked = _gather_source_stacked(
+            source, ctx, source.output_names, source.output_types,
+            self._dsort.n_dev)
+        if stacked is not None:
+            # shard i holds globally-ordered range i: emit in mesh order
+            with MetricTimer(self.metrics[OP_TIME]):
+                out = self._dsort._compiled(stacked)
+            yield from _emit_stacked(self, out)
+            return
         tbl = _gather_source_table(source, ctx, source.output_names,
                                    source.output_types)
         shards = _shard_table(tbl, self._dsort.n_dev)
@@ -181,6 +285,87 @@ class IciJoinExec(Exec):
             out = self._djoin.run(_shard_table(lt, n_dev),
                                   _shard_table(rt, n_dev))
         yield from _emit_table(self, out)
+
+
+class IciExchangeExec(Exec):
+    """A bare hash repartition routed over the mesh (replaces a
+    ShuffleExchangeExec that no fused stage absorbed; the all_to_all
+    analog of the reference transport serving EVERY shuffle,
+    UCXShuffleTransport.scala).  Downstream operators read one shard per
+    partition id."""
+
+    placement = TPU
+
+    def __init__(self, exchange, mesh=None):
+        import threading
+        from .mesh import build_mesh
+        source = exchange.children[0]
+        super().__init__([source])
+        self.exchange = exchange
+        self.mesh = mesh or build_mesh()
+        from .distributed import DistributedExchange
+        self._dex = DistributedExchange(
+            list(exchange.partitioning.keys), source.output_names,
+            source.output_types, mesh=self.mesh)
+        self._memo = {}
+        self._memo_lock = threading.Lock()
+
+    def release_shuffle(self):
+        """Drop the memoized shuffled dataset (the HBM analog of
+        unregistering shuffle blocks; called by release_plan_shuffles)."""
+        with self._memo_lock:
+            self._memo.clear()
+
+    output_names = property(lambda self: self.exchange.output_names)
+    output_types = property(lambda self: self.exchange.output_types)
+    num_partitions = property(
+        lambda self: self.mesh.shape[self._dex.axis])
+
+    def describe(self):
+        return f"IciExchange({self.num_partitions} chips, all_to_all)"
+
+    def _shards(self, ctx):
+        key = id(ctx)
+        with self._memo_lock:
+            hit = self._memo.get(key)
+            if hit is not None:
+                return hit
+            source = self.children[0]
+            stacked = _gather_source_stacked(
+                source, ctx, source.output_names, source.output_types,
+                self._dex.n_dev)
+            with MetricTimer(self.metrics[OP_TIME]):
+                if stacked is not None:
+                    out = self._dex.run_stacked(stacked)
+                    from .distributed import unstack_shards
+                    shards = unstack_shards(out)
+                else:
+                    tbl = _gather_source_table(source, ctx,
+                                               source.output_names,
+                                               source.output_types)
+                    tables = self._dex.run(
+                        _shard_table(tbl, self._dex.n_dev))
+                    from ..columnar.device import batch_to_device
+                    shards = []
+                    for tb in tables:
+                        rbs = tb.combine_chunks().to_batches()
+                        shards.append(
+                            batch_to_device(rbs[0], xp=self.xp) if rbs
+                            else None)
+            self._memo[key] = shards
+            return shards
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        shard = self._shards(ctx)[pid]
+        if shard is None:
+            return
+        n = int(np.asarray(shard.num_rows))
+        if n == 0:
+            return
+        out = Batch(shard.columns, n, shard.names)
+        self.metrics[NUM_OUTPUT_ROWS] += n
+        self.metrics[NUM_OUTPUT_BATCHES] += 1
+        yield out
 
 
 def install_ici_stages(root: Exec, conf: cfg.RapidsConf) -> Exec:
@@ -251,4 +436,21 @@ def install_ici_stages(root: Exec, conf: cfg.RapidsConf) -> Exec:
             return node
         return node
 
-    return rewrite(root)
+    def wrap_exchanges(node: Exec) -> Exec:
+        # second pass: any hash exchange the fused stages did not absorb
+        # still rides ICI as a bare all_to_all repartition — the
+        # transport serves EVERY shuffle, like the reference's
+        # UCXShuffleTransport regardless of the operator above it
+        node = node.with_new_children(
+            [wrap_exchanges(c) for c in node.children])
+        if isinstance(node, ShuffleExchangeExec) and \
+                isinstance(node.partitioning, HashPartitioning) and \
+                getattr(node.partitioning, "keys", None) and \
+                not exchange_supported(node.output_types):
+            try:
+                return IciExchangeExec(node)
+            except NotImplementedError:
+                pass
+        return node
+
+    return wrap_exchanges(rewrite(root))

@@ -76,9 +76,12 @@ class ShuffleExchangeExec(Exec):
             shuffle_id = mgr.new_shuffle_id()
             xp = self.xp
             child = self.children[0]
+            # phase 1: dispatch every map batch's partition-sort (async);
+            # phase 2: ONE host sync brings back ALL count vectors (a
+            # per-batch sync costs a full tunnel round trip each)
+            staged: List[tuple] = []  # (map_id, sorted_batch, counts)
             for map_id in range(child.num_partitions):
                 row_offset = 0
-                slices: Dict[int, List[Batch]] = {}
                 for b in child.execute_partition(map_id, ctx):
                     with MetricTimer(self.metrics[OP_TIME]):
                         if self.placement == TPU:
@@ -87,17 +90,30 @@ class ShuffleExchangeExec(Exec):
                         else:
                             sorted_b, counts = self._map_batch(
                                 np, b, row_offset)
-                        counts_host = np.asarray(counts)
-                        start = 0
-                        for pid_out in range(self.num_partitions):
-                            n = int(counts_host[pid_out])
-                            if n == 0:
-                                start += n
-                                continue
-                            piece = _slice_rows(xp, sorted_b, start, n)
-                            slices.setdefault(pid_out, []).append(piece)
-                            start += n
+                    staged.append((map_id, sorted_b, counts))
                     row_offset += int(b.num_rows)
+            if staged and self.placement == TPU:
+                all_counts = np.asarray(
+                    jnp.stack([c for _, _, c in staged]))   # one sync
+            else:
+                all_counts = np.stack([np.asarray(c)
+                                       for _, _, c in staged]) \
+                    if staged else np.zeros((0, self.num_partitions))
+            per_map: Dict[int, Dict[int, List[Batch]]] = {}
+            with MetricTimer(self.metrics[OP_TIME]):
+                for (map_id, sorted_b, _), counts_host in zip(staged,
+                                                              all_counts):
+                    slices = per_map.setdefault(map_id, {})
+                    start = 0
+                    for pid_out in range(self.num_partitions):
+                        n = int(counts_host[pid_out])
+                        if n == 0:
+                            continue
+                        piece = _slice_rows(xp, sorted_b, start, n)
+                        slices.setdefault(pid_out, []).append(piece)
+                        start += n
+            for map_id in range(child.num_partitions):
+                slices = per_map.get(map_id, {})
                 merged = {}
                 for pid_out, parts in slices.items():
                     merged[pid_out] = parts[0] if len(parts) == 1 else \

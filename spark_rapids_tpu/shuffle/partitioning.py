@@ -142,23 +142,24 @@ def slice_batch_by_partition(xp, batch: DeviceBatch, pids,
     """Sort rows by partition id (stable) and return (sorted_batch,
     partition_row_counts[int64 np array]).  The caller slices host-side by
     counts — the analog of GpuPartitioning's contiguous split."""
-    from ..ops import segmented as seg
+    from ..ops import carry
     live = xp.arange(batch.capacity, dtype=np.int32) < batch.num_rows
     key = xp.where(live, pids, np.int32(num_partitions))  # padding last
-    order = seg.lexsort(xp, [key.astype(xp.uint64)], batch.capacity)
-    sorted_batch = gather_batch(xp, batch, order, live[order],
-                                batch.num_rows)
-    sorted_pids = key[order]
+    # rows ride the sort as payload lanes (no post-sort gathers)
+    _, cols, ex = carry.sort_rows(xp, [key.astype(xp.uint32)],
+                                  batch.columns, batch.capacity,
+                                  extras=[key])
+    sorted_pids = ex[0]
     counts = xp.zeros((num_partitions,), dtype=np.int64)
     if xp is np:
-        u, c = np.unique(sorted_pids[np.asarray(live[order])],
+        u, c = np.unique(sorted_pids[sorted_pids < num_partitions],
                          return_counts=True)
-        counts[u[u < num_partitions]] = c[u < num_partitions]
+        counts[u] = c
     else:
         import jax
-        ones = live[order].astype(xp.int64)
         counts = jax.ops.segment_sum(
-            ones, xp.clip(sorted_pids, 0, num_partitions).astype(xp.int32),
-            num_segments=num_partitions + 1)[:num_partitions]
-    return DeviceBatch(sorted_batch.columns, batch.num_rows, batch.names), \
-        counts
+            (sorted_pids < num_partitions).astype(xp.int32),
+            xp.clip(sorted_pids, 0, num_partitions).astype(xp.int32),
+            num_segments=num_partitions + 1)[:num_partitions].astype(
+                xp.int64)
+    return DeviceBatch(cols, batch.num_rows, batch.names), counts

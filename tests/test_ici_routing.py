@@ -177,3 +177,96 @@ def test_ici_sort_desc_with_strings():
     want = tb.sort_by([("s", "descending"), ("v", "ascending")])
     assert got.column("s").to_pylist() == want.column("s").to_pylist()
     assert got.column("v").to_pylist() == want.column("v").to_pylist()
+
+
+def test_ici_flat_stage_is_device_resident(monkeypatch):
+    """Flat-schema ICI stages must never stage rows through host Arrow:
+    the scan->mesh edge is one jitted reshard over device batches (ref
+    RapidsShuffleInternalManagerBase.scala:74 — shuffle input stays
+    device-resident end-to-end)."""
+    from spark_rapids_tpu.parallel import ici_exec
+
+    def boom(*a, **k):  # host staging would be a regression
+        raise AssertionError("host Arrow staging used for flat schema")
+
+    monkeypatch.setattr(ici_exec, "_gather_source_table", boom)
+    monkeypatch.setattr(ici_exec, "_emit_table", boom)
+
+    s = _session()
+    rng = np.random.default_rng(7)
+    n = 4096
+    tb = pa.table({
+        "k": pa.array(rng.integers(0, 32, n).astype(np.int64)),
+        "v": pa.array(rng.integers(-50, 50, n).astype(np.int64)),
+    })
+    got = (s.create_dataframe(tb, num_partitions=4)
+           .group_by(col("k")).agg(F.sum(col("v")).alias("sv"))
+           .collect().sort_by("k"))
+    assert "IciAggregateExec" in _names(s)
+    want = pa.TableGroupBy(tb, ["k"], use_threads=False).aggregate(
+        [("v", "sum")]).sort_by("k")
+    assert got.column("k").to_pylist() == want.column("k").to_pylist()
+    assert got.column("sv").to_pylist() == want.column("v_sum").to_pylist()
+
+    # sorts ride the same device-resident edge
+    got2 = (s.create_dataframe(tb, num_partitions=4)
+            .sort(col("v"), col("k")).collect())
+    assert "IciSortExec" in _names(s)
+    want2 = tb.sort_by([("v", "ascending"), ("k", "ascending")])
+    assert got2.column("v").to_pylist() == want2.column("v").to_pylist()
+
+
+def test_ici_full_outer_join():
+    """Full-outer over ICI: co-located keys make per-shard unmatched
+    emission globally exact (ref GpuHashJoin full outer)."""
+    rng = np.random.default_rng(8)
+    left = pa.table({
+        "k": pa.array(rng.integers(0, 40, 500).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 9, 500).astype(np.int64)),
+    })
+    right = pa.table({
+        "k": pa.array(np.arange(20, 60, dtype=np.int64)),
+        "w": pa.array(np.arange(40, dtype=np.int64)),
+    })
+
+    def run(enabled_ici):
+        s2 = (TpuSession.builder()
+              .config("spark.rapids.sql.enabled", True)
+              .config("spark.rapids.shuffle.transport",
+                      "ici" if enabled_ici else "tcp")
+              .config("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+              .get_or_create())
+        out = (s2.create_dataframe(left, num_partitions=3)
+               .join(s2.create_dataframe(right, num_partitions=2),
+                     on="k", how="full").collect())
+        return out, _names(s2)
+
+    got, names = run(True)
+    assert "IciJoinExec" in names, names
+    want, _ = run(False)
+    key = lambda tb: sorted(
+        zip(tb.column("k").to_pylist(), tb.column("v").to_pylist(),
+            tb.column("w").to_pylist()), key=str)
+    assert key(got) == key(want)
+
+
+def test_ici_bare_repartition_routed():
+    """A hash repartition with no fused stage above it still rides ICI
+    (IciExchangeExec; the transport is operator-agnostic like
+    UCXShuffleTransport)."""
+    s = _session()
+    rng = np.random.default_rng(9)
+    n = 3000
+    tb = pa.table({
+        "k": pa.array(rng.integers(0, 50, n).astype(np.int64)),
+        "v": pa.array(rng.integers(-99, 99, n).astype(np.int64)),
+    })
+    got = (s.create_dataframe(tb, num_partitions=4)
+           .repartition(8, col("k")).collect())
+    names = _names(s)
+    assert "IciExchangeExec" in names, names
+    assert "ShuffleExchangeExec" not in names
+    assert sorted(zip(got.column("k").to_pylist(),
+                      got.column("v").to_pylist())) == \
+        sorted(zip(tb.column("k").to_pylist(),
+                   tb.column("v").to_pylist()))
