@@ -146,7 +146,9 @@ object SpecBuilder {
           }
         }
       case agg: HashAggregateExec if agg.aggregateExpressions.forall(
-          ae => ae.mode == Complete || ae.mode == Partial) =>
+          // Complete only: a Partial node must emit Spark's buffer
+          // schema (e.g. avg -> (sum, count)), not final values
+          ae => ae.mode == Complete) =>
         val groups = agg.groupingExpressions.map(expr)
         val aggs = agg.aggregateExpressions.map { ae =>
           aggFn(ae.aggregateFunction).flatMap { case (fn, childE) =>
@@ -171,18 +173,36 @@ object SpecBuilder {
         else walk(child).map { case (ops, leaf) =>
           (s"""{"op": "sort", "orders": [${os.flatten.mkString(", ")}]}""" :: ops, leaf)
         }
-      case j: BroadcastHashJoinExec if j.condition.isEmpty =>
+      case j: BroadcastHashJoinExec
+          if j.condition.isEmpty &&
+            j.buildSide == org.apache.spark.sql.catalyst.optimizer.BuildRight =>
+        // engine join-type names differ from JoinType.sql
+        val how = j.joinType match {
+          case org.apache.spark.sql.catalyst.plans.Inner     => Some("inner")
+          case org.apache.spark.sql.catalyst.plans.LeftOuter => Some("left")
+          case org.apache.spark.sql.catalyst.plans.FullOuter => Some("full")
+          case org.apache.spark.sql.catalyst.plans.LeftSemi  => Some("left_semi")
+          case org.apache.spark.sql.catalyst.plans.LeftAnti  => Some("left_anti")
+          case _                                             => None
+        }
         val keys = j.leftKeys.zip(j.rightKeys).map {
           case (l: AttributeReference, r: AttributeReference)
               if l.name == r.name => Some(json(l.name))
           case _ => None
         }
-        if (keys.exists(_.isEmpty)) None
+        if (keys.exists(_.isEmpty) || how.isEmpty) None
         else {
-          extra += j.right
+          // collect the build side BELOW the broadcast exchange —
+          // BroadcastExchangeExec throws on the execute() code path
+          val buildPlan = j.right match {
+            case b: org.apache.spark.sql.execution.exchange.BroadcastExchangeExec =>
+              b.child
+            case other => other
+          }
+          extra += buildPlan
           val idx = extra.size
           walk(j.left).map { case (ops, leaf) =>
-            (s"""{"op": "join", "right": $idx, "how": "${j.joinType.sql.toLowerCase}", "on": [${keys.flatten.mkString(", ")}]}""" :: ops, leaf)
+            (s"""{"op": "join", "right": $idx, "how": "${how.get}", "on": [${keys.flatten.mkString(", ")}]}""" :: ops, leaf)
           }
         }
       case w: WindowExec => None // window translation: follow-up; spec carries it

@@ -1,0 +1,33 @@
+"""Shared probe harness: persistent compile cache + fetch-forced timing."""
+import time
+
+import numpy as np
+
+import spark_rapids_tpu  # noqa: F401
+import jax
+
+
+def enable_cache():
+    import os
+    cache_dir = os.path.expanduser("~/.cache/spark_rapids_tpu_probe_xla")
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def timeit(name, fn, *args, reps=3):
+    jf = jax.jit(fn)
+    t0 = time.perf_counter()
+    o = jf(*args)
+    leaf = jax.tree_util.tree_leaves(o)[0]
+    np.asarray(leaf.ravel()[-1:])
+    c = time.perf_counter() - t0
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        o = jf(*args)
+        leaf = jax.tree_util.tree_leaves(o)[0]
+        np.asarray(leaf.ravel()[-1:])
+        ts.append(time.perf_counter() - t0)
+    print(f"{name:44s} {min(ts)*1e3:9.2f} ms  (first {c:6.1f}s)", flush=True)
+    return jf

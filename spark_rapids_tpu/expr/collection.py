@@ -17,6 +17,7 @@ from typing import List, Tuple
 import numpy as np
 
 from .. import types as t
+from ..columnar.device import DeviceColumn
 from .core import (ColumnValue, EvalContext, Expression, ScalarValue,
                    and_validity, data_of, evaluator, make_column,
                    validity_of)
@@ -242,3 +243,254 @@ def _eval_sort_array(e: SortArray, ctx: EvalContext):
     out = DeviceColumn(col.dtype, validity=col.validity,
                        offsets=col.offsets, children=(new_child,))
     return ColumnValue(out)
+
+
+class MapKeys(Expression):
+    """map_keys(m) -> array<K> (ref GpuMapKeys, collectionOperations.scala)."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def data_type(self):
+        return t.ArrayType(self.children[0].data_type().key_type)
+
+    def sql(self):
+        return f"map_keys({self.children[0].sql()})"
+
+
+class MapValues(Expression):
+    """map_values(m) -> array<V> (ref GpuMapValues)."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def data_type(self):
+        return t.ArrayType(self.children[0].data_type().value_type)
+
+    def sql(self):
+        return f"map_values({self.children[0].sql()})"
+
+
+class MapEntries(Expression):
+    """map_entries(m) -> array<struct<key,value>> (ref GpuMapEntries)."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def data_type(self):
+        mt = self.children[0].data_type()
+        return t.ArrayType(t.StructType([
+            t.StructField("key", mt.key_type),
+            t.StructField("value", mt.value_type)]))
+
+    def sql(self):
+        return f"map_entries({self.children[0].sql()})"
+
+
+@evaluator(MapKeys)
+def _eval_map_keys(e: MapKeys, ctx: EvalContext):
+    m = e.children[0].eval(ctx).col
+    return ColumnValue(DeviceColumn(e.data_type(), offsets=m.offsets,
+                                    validity=m.validity,
+                                    children=(m.children[0],)))
+
+
+@evaluator(MapValues)
+def _eval_map_values(e: MapValues, ctx: EvalContext):
+    m = e.children[0].eval(ctx).col
+    return ColumnValue(DeviceColumn(e.data_type(), offsets=m.offsets,
+                                    validity=m.validity,
+                                    children=(m.children[1],)))
+
+
+@evaluator(MapEntries)
+def _eval_map_entries(e: MapEntries, ctx: EvalContext):
+    m = e.children[0].eval(ctx).col
+    kcol, vcol = m.children
+    entry_type = e.data_type().element_type
+    struct_child = DeviceColumn(entry_type, children=(kcol, vcol))
+    return ColumnValue(DeviceColumn(e.data_type(), offsets=m.offsets,
+                                    validity=m.validity,
+                                    children=(struct_child,)))
+
+
+class GetMapValue(Expression):
+    """m[key] for a scalar key (ref GpuGetMapValue, complexTypeExtractors)."""
+
+    def __init__(self, child: Expression, key: Expression):
+        self.children = (child, key)
+
+    def data_type(self):
+        return self.children[0].data_type().value_type
+
+    def sql(self):
+        return f"{self.children[0].sql()}[{self.children[1].sql()}]"
+
+
+@evaluator(GetMapValue)
+def _eval_get_map_value(e: GetMapValue, ctx: EvalContext):
+    from ..ops.scan import fill_rows_from_starts
+    from ..ops.gather import gather_column
+    from ..ops import segmented as seg2
+    xp = ctx.xp
+    m = e.children[0].eval(ctx).col
+    keyv = e.children[1].eval(ctx)
+    kcol = m.children[0]
+    vcol = m.children[1]
+    child_cap = kcol.capacity
+    cap = m.capacity
+    pos = xp.arange(child_cap, dtype=xp.int32)
+    spans = m.offsets[1:] - m.offsets[:-1]
+    if xp is np:
+        crow = np.clip(np.searchsorted(m.offsets[1:], pos, side="right"),
+                       0, cap - 1).astype(np.int32)
+    else:
+        crow = xp.clip(
+            fill_rows_from_starts(xp, m.offsets[:-1].astype(xp.int32),
+                                  spans > 0, child_cap), 0, cap - 1)
+    in_range = pos < m.offsets[-1]
+    from .core import ScalarValue
+    if isinstance(keyv, ScalarValue):
+        if keyv.value is None:
+            return make_column(ctx, e.data_type(), 0, False)
+        if isinstance(kcol.dtype, (t.StringType, t.BinaryType)):
+            # compare every kv key against the literal's bytes
+            lit = keyv.value.encode() if isinstance(keyv.value, str) \
+                else bytes(keyv.value)
+            lens = kcol.offsets[1:] - kcol.offsets[:-1]
+            match = lens == len(lit)
+            for j, b in enumerate(lit):
+                at = xp.clip(kcol.offsets[:-1] + j, 0,
+                             kcol.data.shape[0] - 1)
+                match = match & (kcol.data[at] == np.uint8(b))
+        else:
+            kd = kcol.data
+            match = kd == xp.asarray(keyv.value, dtype=kd.dtype)
+    else:
+        kd = kcol.data
+        match = kd == keyv.col.data[crow]
+        kv_valid = keyv.col.validity
+        if kv_valid is not None:
+            match = match & kv_valid[crow]
+    kvalid = kcol.validity
+    if kvalid is not None:
+        match = match & kvalid
+    match = match & in_range
+    # last occurrence wins (Spark's GetMapValue semantics)
+    idx, cnt = seg2.segment_reduce(xp, "last", pos, crow, cap, match,
+                                   sorted_ids=True)
+    found = cnt > 0
+    out = gather_column(xp, vcol, xp.clip(idx, 0, child_cap - 1).astype(
+        xp.int32), found & (m.validity if m.validity is not None else
+                            xp.ones((cap,), bool)))
+    return ColumnValue(out)
+
+
+class ArrayMax(Expression):
+    """array_max(a) (ref GpuArrayMax, collectionOperations.scala)."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def data_type(self):
+        return self.children[0].data_type().element_type
+
+    def sql(self):
+        return f"array_max({self.children[0].sql()})"
+
+
+class ArrayMin(ArrayMax):
+    def sql(self):
+        return f"array_min({self.children[0].sql()})"
+
+
+def _eval_array_extreme(e, ctx: EvalContext, op: str):
+    from ..ops.scan import fill_rows_from_starts
+    from ..ops import segmented as seg2
+    xp = ctx.xp
+    a = e.children[0].eval(ctx).col
+    child = a.children[0]
+    child_cap = child.capacity
+    cap = a.capacity
+    pos = xp.arange(child_cap, dtype=xp.int32)
+    spans = a.offsets[1:] - a.offsets[:-1]
+    if xp is np:
+        crow = np.clip(np.searchsorted(a.offsets[1:], pos, side="right"),
+                       0, cap - 1).astype(np.int32)
+    else:
+        crow = xp.clip(
+            fill_rows_from_starts(xp, a.offsets[:-1].astype(xp.int32),
+                                  spans > 0, child_cap), 0, cap - 1)
+    in_range = pos < a.offsets[-1]
+    contrib = in_range
+    if child.validity is not None:
+        contrib = contrib & child.validity
+    out, cnt = seg2.segment_reduce(xp, op, child.data, crow, cap, contrib,
+                                   sorted_ids=True)
+    valid = (cnt > 0)
+    if a.validity is not None:
+        valid = valid & a.validity
+    return make_column(ctx, e.data_type(),
+                       xp.where(valid, out, xp.zeros_like(out)), valid)
+
+
+@evaluator(ArrayMax)
+def _eval_array_max(e: ArrayMax, ctx: EvalContext):
+    if type(e) is ArrayMin:
+        return _eval_array_extreme(e, ctx, "min")
+    return _eval_array_extreme(e, ctx, "max")
+
+
+@evaluator(ArrayMin)
+def _eval_array_min(e: ArrayMin, ctx: EvalContext):
+    return _eval_array_extreme(e, ctx, "min")
+
+
+class CreateMap(Expression):
+    """map(k1, v1, k2, v2, ...) from flat key/value expressions
+    (ref GpuCreateMap, complexTypeCreator.scala)."""
+
+    def __init__(self, children):
+        assert len(children) >= 2 and len(children) % 2 == 0
+        self.children = tuple(children)
+
+    def data_type(self):
+        return t.MapType(self.children[0].data_type(),
+                         self.children[1].data_type())
+
+    def sql(self):
+        return f"map({', '.join(c.sql() for c in self.children)})"
+
+
+@evaluator(CreateMap)
+def _eval_create_map(e: CreateMap, ctx: EvalContext):
+    xp = ctx.xp
+    cap = ctx.batch.capacity
+    npairs = len(e.children) // 2
+    kvals, vvals = [], []
+    from .core import make_column as mk
+    for i in range(npairs):
+        kv = e.children[2 * i].eval(ctx)
+        vv = e.children[2 * i + 1].eval(ctx)
+        if not isinstance(kv, ColumnValue):
+            kv = mk(ctx, e.children[2 * i].data_type(),
+                    kv.value if kv.value is not None else 0,
+                    None if kv.value is not None else False)
+        if not isinstance(vv, ColumnValue):
+            vv = mk(ctx, e.children[2 * i + 1].data_type(),
+                    vv.value if vv.value is not None else 0,
+                    None if vv.value is not None else False)
+        kvals.append(kv.col)
+        vvals.append(vv.col)
+    # interleave per row: entry j of row i = (kj[i], vj[i])
+    kdata = xp.stack([c.data for c in kvals], axis=1).reshape(-1)
+    vdata = xp.stack([c.data for c in vvals], axis=1).reshape(-1)
+    vval = xp.stack(
+        [c.validity if c.validity is not None else
+         xp.ones((cap,), bool) for c in vvals], axis=1).reshape(-1)
+    offs = (xp.arange(cap + 1, dtype=xp.int32) * np.int32(npairs))
+    dt = e.data_type()
+    kcol = DeviceColumn(dt.key_type, data=kdata)
+    vcol = DeviceColumn(dt.value_type, data=vdata, validity=vval)
+    return ColumnValue(DeviceColumn(dt, offsets=offs,
+                                    children=(kcol, vcol)))

@@ -446,3 +446,46 @@ def _eval_time_window(e: TimeWindow, ctx):
                        validity=valid)
     return ColumnValue(DeviceColumn(e.data_type(), validity=valid,
                                     children=(start, end)))
+
+
+class UnixTimestamp(ToUnixTimestamp):
+    """unix_timestamp(ts) — same kernel as to_unix_timestamp
+    (ref GpuUnixTimestamp; the two Spark classes share GpuToTimestamp)."""
+
+
+@evaluator(UnixTimestamp)
+def _eval_unixts(e, ctx):
+    return _eval_tounix(e, ctx)
+
+
+class DateFormatClass(Expression):
+    """date_format(ts, fmt) — host-evaluated (strftime rendering);
+    registered with a host-fallback reason like the regex family
+    (ref GpuDateFormatClass)."""
+
+    def __init__(self, child, fmt):
+        self.children = (child,)
+        self.fmt = fmt
+
+    def data_type(self):
+        return t.STRING
+
+    def sql(self):
+        return f"date_format({self.children[0].sql()}, '{self.fmt}')"
+
+
+class DateAddInterval(Expression):
+    """date + calendar interval — the interval type is not modeled on
+    device; host-fallback (ref GpuDateAddInterval)."""
+
+    def __init__(self, child, months: int = 0, days: int = 0):
+        self.children = (child,)
+        self.months = months
+        self.days = days
+
+    def data_type(self):
+        return t.DATE
+
+    def sql(self):
+        return (f"date_add_interval({self.children[0].sql()}, "
+                f"{self.months} months {self.days} days)")

@@ -251,3 +251,87 @@ def _eval_array_exists(e: ArrayExists, ctx: EvalContext):
 @evaluator(ArrayForAll)
 def _eval_array_forall(e: ArrayForAll, ctx: EvalContext):
     return _segmented_bool(e, ctx, want_all=True)
+
+
+class MapHigherOrder(Expression):
+    """transform_keys / transform_values: lambda (k, v) over each map
+    entry, rebuilding one side (ref GpuTransformKeys/GpuTransformValues,
+    higherOrderFunctions.scala)."""
+
+    def __init__(self, m: Expression, fn: LambdaFunction):
+        self.children = (m, fn)
+
+    @property
+    def fn(self) -> LambdaFunction:
+        return self.children[1]
+
+    def _bind_lambda(self) -> LambdaFunction:
+        mt = self.children[0].data_type()
+        assert isinstance(mt, t.MapType), mt
+        fn = self.fn
+        typed = {fn.args[0].name: mt.key_type}
+        if len(fn.args) > 1:
+            typed[fn.args[1].name] = mt.value_type
+
+        def retype(e):
+            if isinstance(e, NamedLambdaVariable) and e.name in typed:
+                return NamedLambdaVariable(e.name, typed[e.name])
+            return e
+        body = fn.body.transform_up(retype)
+        return LambdaFunction(body, [retype(a) for a in fn.args])
+
+    def _entry_eval(self, ctx: EvalContext, mcol: DeviceColumn):
+        from ..columnar.device import DeviceBatch
+        xp = ctx.xp
+        kcol, vcol = mcol.children
+        fn = self._bind_lambda()
+        n_elem = mcol.offsets[-1]
+        ectx = EvalContext(xp, DeviceBatch([kcol, vcol], n_elem))
+        ectx.ansi = ctx.ansi
+        ectx.lambda_bindings[fn.args[0].name] = ColumnValue(kcol)
+        if len(fn.args) > 1:
+            ectx.lambda_bindings[fn.args[1].name] = ColumnValue(vcol)
+        v = fn.body.eval(ectx)
+        if not isinstance(v, ColumnValue):
+            from .core import scalar_to_column
+            v = scalar_to_column(ectx, v)
+        return v
+
+
+class TransformValues(MapHigherOrder):
+    def data_type(self):
+        mt = self.children[0].data_type()
+        return t.MapType(mt.key_type, self._bind_lambda().body.data_type())
+
+    def sql(self):
+        return f"transform_values({self.children[0].sql()}, {self.fn.sql()})"
+
+
+class TransformKeys(MapHigherOrder):
+    def data_type(self):
+        mt = self.children[0].data_type()
+        return t.MapType(self._bind_lambda().body.data_type(),
+                         mt.value_type)
+
+    def sql(self):
+        return f"transform_keys({self.children[0].sql()}, {self.fn.sql()})"
+
+
+@evaluator(TransformValues)
+def _eval_transform_values(e: TransformValues, ctx: EvalContext):
+    m = e.children[0].eval(ctx).col
+    out = e._entry_eval(ctx, m)
+    return ColumnValue(DeviceColumn(
+        e.data_type(), validity=m.validity, offsets=m.offsets,
+        children=(m.children[0], out.col)))
+
+
+@evaluator(TransformKeys)
+def _eval_transform_keys(e: TransformKeys, ctx: EvalContext):
+    # Spark raises on null or duplicate transformed keys in ANSI mode;
+    # like the reference we keep the entry layout (keys map 1:1)
+    m = e.children[0].eval(ctx).col
+    out = e._entry_eval(ctx, m)
+    return ColumnValue(DeviceColumn(
+        e.data_type(), validity=m.validity, offsets=m.offsets,
+        children=(out.col, m.children[1])))

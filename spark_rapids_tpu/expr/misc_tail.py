@@ -1,0 +1,336 @@
+"""Expression-registry tail: the remaining reference rules
+(ref GpuOverrides.scala:727-3048) that are thin wrappers, plan-internal
+markers, or small kernels — NaN handling, null guards, decimal plumbing,
+timestamp conversions, input-file block metadata.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as t
+from .core import (ColumnValue, EvalContext, Expression,
+                   ScalarValue, evaluator, make_column)
+
+
+def _as_col(ctx: EvalContext, v, dt):
+    """Materialize a scalar value as a column (the idiom every evaluator
+    in this package uses for mixed scalar/column children)."""
+    if isinstance(v, ColumnValue):
+        return v
+    return make_column(ctx, dt, v.value if v.value is not None else 0,
+                       None if v.value is not None else False)
+
+
+def _col_validity(ctx: EvalContext, col):
+    return col.validity if col.validity is not None else \
+        ctx.xp.ones((col.capacity,), dtype=bool)
+
+
+
+class NaNvl(Expression):
+    """nanvl(a, b): b where a is NaN (ref GpuNaNvl, arithmetic.scala)."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def data_type(self):
+        return t.DOUBLE
+
+    def sql(self):
+        return f"nanvl({self.children[0].sql()}, {self.children[1].sql()})"
+
+
+@evaluator(NaNvl)
+def _eval_nanvl(e: NaNvl, ctx: EvalContext):
+    xp = ctx.xp
+    a = e.children[0].eval(ctx)
+    b = e.children[1].eval(ctx)
+    ac = _as_col(ctx, a, e.children[0].data_type())
+    bc = _as_col(ctx, b, e.children[1].data_type())
+    use_b = xp.isnan(ac.col.data)
+    av = _col_validity(ctx, ac.col)
+    bv = _col_validity(ctx, bc.col)
+    data = xp.where(use_b, bc.col.data.astype(np.float64),
+                    ac.col.data.astype(np.float64))
+    valid = xp.where(use_b, bv, av)
+    return make_column(ctx, t.DOUBLE, data, valid)
+
+
+class InSet(Expression):
+    """IN over a literal value set — the optimizer's large-list variant of
+    In (ref GpuInSet, GpuOverrides.scala)."""
+
+    def __init__(self, child: Expression, values):
+        self.children = (child,)
+        self.values = tuple(values)
+
+    def data_type(self):
+        return t.BOOLEAN
+
+    def sql(self):
+        return f"{self.children[0].sql()} IN ({len(self.values)} values)"
+
+
+@evaluator(InSet)
+def _eval_inset(e: InSet, ctx: EvalContext):
+    xp = ctx.xp
+    v = e.children[0].eval(ctx)
+    c = _as_col(ctx, v, e.children[0].data_type())
+    data = c.col.data
+    hit = xp.zeros(data.shape, dtype=bool)
+    has_null = False
+    for val in e.values:
+        if val is None:
+            has_null = True
+            continue
+        hit = hit | (data == xp.asarray(val, dtype=data.dtype))
+    valid = _col_validity(ctx, c.col)
+    if has_null:
+        # Spark: x IN (..., null) is null unless a match exists
+        valid = valid & hit
+    return make_column(ctx, t.BOOLEAN, hit, valid)
+
+
+class AtLeastNNonNulls(Expression):
+    """Used by df.dropna (ref GpuAtLeastNNonNulls)."""
+
+    def __init__(self, n: int, children):
+        self.n = int(n)
+        self.children = tuple(children)
+
+    def data_type(self):
+        return t.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def sql(self):
+        cs = ", ".join(c.sql() for c in self.children)
+        return f"atleastnnonnulls({self.n}, {cs})"
+
+
+@evaluator(AtLeastNNonNulls)
+def _eval_at_least_n(e: AtLeastNNonNulls, ctx: EvalContext):
+    xp = ctx.xp
+    cap = ctx.batch.capacity
+    count = xp.zeros((cap,), dtype=np.int32)
+    for ch in e.children:
+        v = ch.eval(ctx)
+        c = _as_col(ctx, v, ch.data_type())
+        ok = _col_validity(ctx, c.col)
+        if isinstance(ch.data_type(), (t.DoubleType, t.FloatType)):
+            ok = ok & ~xp.isnan(c.col.data)
+        count = count + ok.astype(np.int32)
+    return make_column(ctx, t.BOOLEAN, count >= e.n, None)
+
+
+class _PassThrough(Expression):
+    """Plan-internal marker wrappers: evaluate to their child unchanged."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def data_type(self):
+        return self.children[0].data_type()
+
+    def sql(self):
+        return self.children[0].sql()
+
+
+class KnownNotNull(_PassThrough):
+    """Optimizer non-null assertion (ref GpuKnownNotNull)."""
+
+    @property
+    def nullable(self):
+        return False
+
+
+class KnownFloatingPointNormalized(_PassThrough):
+    """Marker above NormalizeNaNAndZero (ref GpuKnownFloatingPointNormalized)."""
+
+
+class PromotePrecision(_PassThrough):
+    """Decimal precision promotion marker — the cast below it already
+    produced the target type (ref GpuPromotePrecision)."""
+
+
+@evaluator(KnownNotNull)
+@evaluator(KnownFloatingPointNormalized)
+@evaluator(PromotePrecision)
+def _eval_passthrough(e: _PassThrough, ctx: EvalContext):
+    return e.children[0].eval(ctx)
+
+
+class UnscaledValue(Expression):
+    """decimal -> raw unscaled long (ref GpuUnscaledValue) — the decimal64
+    lane IS the unscaled value, so this is a relabel."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def data_type(self):
+        return t.LONG
+
+    def sql(self):
+        return f"unscaledvalue({self.children[0].sql()})"
+
+
+@evaluator(UnscaledValue)
+def _eval_unscaled(e: UnscaledValue, ctx: EvalContext):
+    v = e.children[0].eval(ctx)
+    c = _as_col(ctx, v, e.children[0].data_type())
+    return make_column(ctx, t.LONG, c.col.data.astype(np.int64),
+                       _col_validity(ctx, c.col))
+
+
+class MakeDecimal(Expression):
+    """long unscaled -> decimal (ref GpuMakeDecimal)."""
+
+    def __init__(self, child: Expression, precision: int, scale: int):
+        self.children = (child,)
+        self.precision = int(precision)
+        self.scale = int(scale)
+
+    def data_type(self):
+        return t.DecimalType(self.precision, self.scale)
+
+    def sql(self):
+        return (f"makedecimal({self.children[0].sql()}, "
+                f"{self.precision}, {self.scale})")
+
+
+@evaluator(MakeDecimal)
+def _eval_make_decimal(e: MakeDecimal, ctx: EvalContext):
+    from ..columnar.device import DeviceColumn
+    xp = ctx.xp
+    v = e.children[0].eval(ctx)
+    c = _as_col(ctx, v, e.children[0].data_type())
+    valid = _col_validity(ctx, c.col)
+    data = c.col.data.astype(np.int64)
+    if e.precision >= 19:
+        ok = valid  # every int64 unscaled value fits precision >= 19
+    else:
+        bound = np.int64(10 ** e.precision)
+        ok = valid & (data > -bound) & (data < bound)
+    col = DeviceColumn(e.data_type(),
+                       data=xp.where(ok, data, xp.zeros_like(data)),
+                       validity=ok)
+    if not e.data_type().is64:
+        col.data_hi = xp.where(data < 0, xp.full_like(data, -1),
+                               xp.zeros_like(data))
+    return ColumnValue(col)
+
+
+class CheckOverflow(Expression):
+    """Null out decimal values beyond the target precision
+    (ref GpuCheckOverflow, nullOnOverflow mode)."""
+
+    def __init__(self, child: Expression, precision: int, scale: int,
+                 null_on_overflow: bool = True):
+        self.children = (child,)
+        self.precision = int(precision)
+        self.scale = int(scale)
+        self.null_on_overflow = null_on_overflow
+
+    def data_type(self):
+        return t.DecimalType(self.precision, self.scale)
+
+    def sql(self):
+        return f"checkoverflow({self.children[0].sql()})"
+
+
+@evaluator(CheckOverflow)
+def _eval_check_overflow(e: CheckOverflow, ctx: EvalContext):
+    from ..columnar.device import DeviceColumn
+    xp = ctx.xp
+    v = e.children[0].eval(ctx)
+    c = _as_col(ctx, v, e.children[0].data_type())
+    valid = _col_validity(ctx, c.col)
+    if e.precision > 18:
+        # 128-bit bound checks live in the cast kernels; pass through
+        return ColumnValue(DeviceColumn(e.data_type(), data=c.col.data,
+                                        data_hi=c.col.data_hi,
+                                        validity=valid))
+    bound = np.int64(10 ** e.precision)
+    data = c.col.data.astype(np.int64)
+    ok = valid & (data > -bound) & (data < bound)
+    return ColumnValue(DeviceColumn(
+        e.data_type(), data=xp.where(ok, data, xp.zeros_like(data)),
+        validity=ok))
+
+
+class PreciseTimestampConversion(Expression):
+    """Exact timestamp <-> long conversion the window TimeAdd rewrite
+    uses (ref GpuPreciseTimestampConversion)."""
+
+    def __init__(self, child: Expression, from_type, to_type):
+        self.children = (child,)
+        self._from = from_type
+        self._to = to_type
+
+    def data_type(self):
+        return self._to
+
+    def sql(self):
+        return f"precisetimestampconversion({self.children[0].sql()})"
+
+
+@evaluator(PreciseTimestampConversion)
+def _eval_precise_ts(e: PreciseTimestampConversion, ctx: EvalContext):
+    v = e.children[0].eval(ctx)
+    c = _as_col(ctx, v, e.children[0].data_type())
+    # both directions are identity on the micros lane
+    return make_column(ctx, e.data_type(), c.col.data.astype(np.int64),
+                       _col_validity(ctx, c.col))
+
+
+class InputFileBlockStart(Expression):
+    """Byte offset of the current input block; whole-file reads start at
+    0 (ref GpuInputFileBlockStart; the PERFILE reader reads whole files)."""
+
+    children = ()
+
+    def data_type(self):
+        return t.LONG
+
+    def sql(self):
+        return "input_file_block_start()"
+
+
+class InputFileBlockLength(Expression):
+    """Length of the current block = the whole file under PERFILE reads
+    (ref GpuInputFileBlockLength)."""
+
+    children = ()
+
+    def data_type(self):
+        return t.LONG
+
+    def sql(self):
+        return "input_file_block_length()"
+
+
+def _file_block(ctx, want_length: bool):
+    import os
+    from ..io.scan import current_input_file
+    path = current_input_file()
+    if want_length:
+        try:
+            val = os.path.getsize(path) if path else -1
+        except OSError:
+            val = -1
+    else:
+        val = 0 if path else -1
+    return val
+
+
+@evaluator(InputFileBlockStart)
+def _eval_block_start(e, ctx: EvalContext):
+    return make_column(ctx, t.LONG, np.int64(_file_block(ctx, False)), None)
+
+
+@evaluator(InputFileBlockLength)
+def _eval_block_length(e, ctx: EvalContext):
+    return make_column(ctx, t.LONG, np.int64(_file_block(ctx, True)), None)

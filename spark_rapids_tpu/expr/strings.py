@@ -792,3 +792,21 @@ def _eval_concat_ws(e: ConcatWs, ctx: EvalContext):
             continue
         out.append(sep.join(r[i] for r in arg_rows if r[i] is not None))
     return build_string_column(ctx, out)
+
+
+class SubstringIndex(Expression):
+    """substring_index(str, delim, count) — host-evaluated occurrence
+    scan (ref GpuSubstringIndex); registered with a host-fallback
+    reason like the regex family."""
+
+    def __init__(self, child, delim, count):
+        self.children = (child,)
+        self.delim = delim
+        self.count = count
+
+    def data_type(self):
+        return t.STRING
+
+    def sql(self):
+        return (f"substring_index({self.children[0].sql()}, "
+                f"'{self.delim}', {self.count})")

@@ -303,6 +303,14 @@ class IciExchangeExec(Exec):
         super().__init__([source])
         self.exchange = exchange
         self.mesh = mesh or build_mesh()
+        from .distributed import DATA_AXIS as _axis
+        if exchange.partitioning.num_partitions != \
+                self.mesh.shape[_axis]:
+            # pmod(mesh) would change the key->partition mapping the
+            # user asked for (e.g. partitioned writes rely on it)
+            raise NotImplementedError(
+                f"repartition({exchange.partitioning.num_partitions}) "
+                f"!= mesh size {self.mesh.shape[_axis]}: host exchange")
         from .distributed import DistributedExchange
         self._dex = DistributedExchange(
             list(exchange.partitioning.keys), source.output_names,

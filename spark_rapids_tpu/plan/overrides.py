@@ -170,6 +170,114 @@ expr_rule(hf.Rand, T.DOUBLE,
 
 from ..expr import collection as coll
 
+# --- registry tail: the remaining reference rules -------------------------
+# (ref GpuOverrides.scala:727-3048; each entry either lowers on TPU or is
+# registered with an explicit host-fallback reason so explain/docs tell
+# the truth about where it runs)
+from ..expr import misc_tail as mt
+from ..expr import higher_order as ho
+from ..expr import window as win
+from ..expr.subquery import ScalarSubquery
+from ..udf.python_udf import PythonUDF
+
+expr_rule(mt.NaNvl, T.DOUBLE + T.FLOAT)
+expr_rule(mt.InSet, T.BOOLEAN)
+expr_rule(mt.AtLeastNNonNulls, T.BOOLEAN)
+expr_rule(mt.KnownNotNull, T.all_types.nested(), "optimizer marker")
+expr_rule(mt.KnownFloatingPointNormalized, T.all_types.nested(),
+          "optimizer marker")
+expr_rule(mt.PromotePrecision, T.DECIMAL_64 + T.DECIMAL_128,
+          "decimal precision marker")
+expr_rule(mt.UnscaledValue, T.LONG,
+          tag_fn=lambda m: m.will_not_work(
+              "unscaledvalue of decimal128 needs both lanes")
+          if isinstance(m.expr.children[0].data_type(), t.DecimalType)
+          and not m.expr.children[0].data_type().is64 else None)
+expr_rule(mt.MakeDecimal, T.DECIMAL_64 + T.DECIMAL_128)
+expr_rule(mt.CheckOverflow, T.DECIMAL_64 + T.DECIMAL_128)
+expr_rule(mt.PreciseTimestampConversion, T.TIMESTAMP + T.LONG)
+expr_rule(hf.InputFileName, T.STRING,
+          "current scan file path (forces the PERFILE reader, ref "
+          "InputFileBlockRule.scala)",
+          _tag_host_only("file-path strings materialize on the host "
+                         "engine (task-context metadata, not device "
+                         "data)"))
+expr_rule(mt.InputFileBlockStart, T.LONG,
+          "0 for whole-file PERFILE reads, ref GpuInputFileBlockStart")
+expr_rule(mt.InputFileBlockLength, T.LONG,
+          "file size for whole-file PERFILE reads")
+
+# window machinery registered as expressions, like the reference
+# (GpuOverrides windowing rules); evaluation lives in WindowExec
+for c in (win.WindowExpression, win.RowNumber, win.Rank, win.DenseRank,
+          win.PercentRank, win.CumeDist, win.NTile):
+    expr_rule(c, T.common_scalar.nested())
+for c in (win.Lead, win.Lag):
+    expr_rule(c, (T.common_scalar + T.STRING).nested())
+expr_rule(win.WindowSpec, T.common_scalar.nested(),
+          "window spec definition (partition/order/frame; the analog of "
+          "WindowSpecDefinition + SpecifiedWindowFrame + SortOrder)")
+
+expr_rule(ScalarSubquery, T.common_scalar,
+          "resolved driver-side to a literal before execution")
+expr_rule(PythonUDF, T.all_types.nested(),
+          "compiled to engine expressions when possible; otherwise "
+          "evaluated out-of-process (ArrowEvalPython worker pool)")
+
+expr_rule(coll.MapKeys, T.ARRAY.nested(T.common_scalar))
+expr_rule(coll.MapValues, T.ARRAY.nested(T.common_scalar))
+expr_rule(coll.MapEntries, T.ARRAY.nested(T.common_scalar + T.STRUCT))
+expr_rule(coll.GetMapValue, T.common_scalar,
+          tag_fn=lambda m: m.will_not_work(
+              "string-keyed map element access needs a literal key "
+              "(column-key byte comparison not lowered)")
+          if isinstance(m.expr.children[0].data_type().key_type,
+                        (t.StringType, t.BinaryType))
+          and not isinstance(m.expr.children[1], Literal) else None)
+def _tag_create_map(m):
+    if any(isinstance(c.data_type(),
+                      (t.StringType, t.BinaryType, t.ArrayType,
+                       t.StructType, t.MapType))
+           for c in m.expr.children):
+        m.will_not_work("map() over variable-width children not supported")
+        return
+    # Spark RAISES on null map keys (and on duplicates under the default
+    # EXCEPTION dedup policy); a jitted kernel cannot raise data-dependent
+    # errors, so nullable keys stay on the host engine
+    for kc in m.expr.children[0::2]:
+        if getattr(kc, "nullable", True):
+            m.will_not_work(
+                "map() with nullable keys stays on CPU (Spark raises on "
+                "null keys; device kernels cannot raise data-dependently)")
+            return
+
+
+expr_rule(coll.CreateMap, T.MAP.nested(T.common_scalar),
+          "duplicate-key detection follows the host engine",
+          _tag_create_map)
+expr_rule(coll.ArrayMax, T.common_scalar,
+          tag_fn=lambda m: m.will_not_work(
+              "array_max/min over nested/string elements not supported")
+          if isinstance(m.expr.children[0].data_type().element_type,
+                        (t.StringType, t.BinaryType, t.ArrayType,
+                         t.StructType, t.MapType)) else None)
+expr_rule(coll.ArrayMin, T.common_scalar,
+          tag_fn=EXPR_RULES[coll.ArrayMax].tag_fn)
+expr_rule(ho.TransformKeys, T.MAP.nested(T.common_scalar))
+expr_rule(ho.TransformValues, T.MAP.nested(T.common_scalar))
+
+expr_rule(dte.UnixTimestamp, T.LONG)
+expr_rule(dte.DateFormatClass, T.STRING, "host-evaluated date_format",
+          _tag_host_only("strftime-style formatting runs on the host "
+                         "engine (byte-serial pattern rendering)"))
+expr_rule(dte.DateAddInterval, T.DATE, "host-evaluated interval add",
+          _tag_host_only("the calendar-interval type is not modeled on "
+                         "device; interval arithmetic runs on the host "
+                         "engine"))
+expr_rule(se.SubstringIndex, T.STRING, "host-evaluated substring_index",
+          _tag_host_only("delimiter-occurrence scanning runs on the "
+                         "host engine (byte-serial search)"))
+
 expr_rule(coll.Size, T.INT)
 expr_rule(coll.ArrayContains, T.BOOLEAN,
           tag_fn=lambda m: m.will_not_work(

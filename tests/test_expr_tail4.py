@@ -1,0 +1,146 @@
+"""Registry-tail expressions (ref GpuOverrides.scala:727-3048 delta):
+NaNvl, InSet, AtLeastNNonNulls, decimal plumbing (UnscaledValue /
+MakeDecimal / CheckOverflow), map family (map_keys/values/entries,
+element access, map() construction, transform_keys/values), array
+min/max, unix_timestamp — differential against the CPU engine or a
+hand oracle."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api.column import Column, col
+from spark_rapids_tpu.api.session import TpuSession
+
+
+def _session(enabled=True):
+    return TpuSession.builder().config("spark.rapids.sql.enabled",
+                                       enabled).get_or_create()
+
+
+def _c(expr):
+    return Column(expr)
+
+
+def _both(tbl, build):
+    outs = []
+    for enabled in (True, False):
+        s = _session(enabled)
+        df = s.create_dataframe(tbl)
+        outs.append(build(df).collect())
+    return outs
+
+
+def test_nanvl_inset_atleastn():
+    from spark_rapids_tpu.expr.misc_tail import (AtLeastNNonNulls, InSet,
+                                                 NaNvl)
+    tbl = pa.table({
+        "a": pa.array([1.0, float("nan"), None, 4.0]),
+        "b": pa.array([10.0, 20.0, 30.0, None]),
+        "k": pa.array([1, 2, 3, 4], type=pa.int64()),
+    })
+    tpu, cpu = _both(tbl, lambda df: df.select(
+        _c(NaNvl(col("a").expr, col("b").expr)).alias("nv"),
+        _c(InSet(col("k").expr, (2, 4, None))).alias("ins"),
+        _c(AtLeastNNonNulls(2, [col("a").expr, col("b").expr])).alias(
+            "aln")))
+    assert tpu.equals(cpu)
+    assert tpu.column("nv").to_pylist() == [1.0, 20.0, None, 4.0]
+    # IN with a null in the list: null unless matched
+    assert tpu.column("ins").to_pylist() == [None, True, None, True]
+    # NaN does not count as non-null for dropna semantics
+    assert tpu.column("aln").to_pylist() == [True, False, False, False]
+
+
+def test_decimal_plumbing():
+    from spark_rapids_tpu.expr.misc_tail import (CheckOverflow,
+                                                 MakeDecimal,
+                                                 UnscaledValue)
+    tbl = pa.table({
+        "d": pa.array([None, 1, 12345, -99999], type=pa.decimal128(9, 2)),
+        "u": pa.array([5, 123, 10**7, -(10**7)], type=pa.int64()),
+    })
+    tpu, cpu = _both(tbl, lambda df: df.select(
+        _c(UnscaledValue(col("d").expr)).alias("uv"),
+        _c(MakeDecimal(col("u").expr, 5, 2)).alias("md"),
+        _c(CheckOverflow(col("d").expr, 4, 2)).alias("co")))
+    assert tpu.equals(cpu)
+    # pyarrow reads the ints as decimal VALUES: 1.00, 123.45, -999.99
+    assert tpu.column("uv").to_pylist() == [None, 100, 1234500, -9999900]
+    md = tpu.column("md").to_pylist()
+    assert [str(x) if x is not None else None for x in md] == \
+        ["0.05", "1.23", None, None]       # 10^7 overflows precision 5
+    co = tpu.column("co").to_pylist()
+    assert [str(x) if x is not None else None for x in co] == \
+        [None, "1.00", None, None]         # |unscaled| >= 10^4 nulls out
+
+
+def test_map_family():
+    from spark_rapids_tpu.expr.collection import (ArrayMax, ArrayMin,
+                                                  GetMapValue, MapEntries,
+                                                  MapKeys, MapValues)
+    tbl = pa.table({
+        "m": pa.array([[("a", 1), ("b", 2)], None, [("b", 7)], []],
+                      type=pa.map_(pa.string(), pa.int64())),
+        "arr": pa.array([[3, 1, 2], None, [9], []],
+                        type=pa.list_(pa.int64())),
+    })
+    tpu, cpu = _both(tbl, lambda df: df.select(
+        _c(MapKeys(col("m").expr)).alias("mk"),
+        _c(MapValues(col("m").expr)).alias("mv"),
+        _c(MapEntries(col("m").expr)).alias("me"),
+        _c(ArrayMax(col("arr").expr)).alias("amax"),
+        _c(ArrayMin(col("arr").expr)).alias("amin")))
+    assert tpu.equals(cpu), (tpu.to_pydict(), cpu.to_pydict())
+    assert tpu.column("mk").to_pylist() == [["a", "b"], None, ["b"], []]
+    assert tpu.column("mv").to_pylist() == [[1, 2], None, [7], []]
+    assert tpu.column("amax").to_pylist() == [3, None, 9, None]
+    assert tpu.column("amin").to_pylist() == [1, None, 9, None]
+
+    from spark_rapids_tpu.expr.core import Literal
+    tpu2, cpu2 = _both(tbl, lambda df: df.select(
+        _c(GetMapValue(col("m").expr, Literal("b"))).alias("gb")))
+    assert tpu2.equals(cpu2)
+    assert tpu2.column("gb").to_pylist() == [2, None, 7, None]
+
+
+def test_create_map_and_transform():
+    from spark_rapids_tpu.expr.collection import CreateMap, MapValues
+    from spark_rapids_tpu.expr.higher_order import (LambdaFunction,
+                                                    NamedLambdaVariable,
+                                                    TransformValues)
+    from spark_rapids_tpu.expr.arithmetic import Multiply
+    from spark_rapids_tpu.expr.core import Literal
+    tbl = pa.table({
+        "k1": pa.array([1, 2, 3], type=pa.int64()),
+        "v1": pa.array([10, None, 30], type=pa.int64()),
+        "m": pa.array([[("a", 1), ("b", 2)], [("c", 3)], []],
+                      type=pa.map_(pa.string(), pa.int64())),
+    })
+
+    def build(df):
+        cm = CreateMap([col("k1").expr, col("v1").expr])
+        kvar = NamedLambdaVariable("k")
+        vvar = NamedLambdaVariable("v")
+        tv = TransformValues(
+            col("m").expr,
+            LambdaFunction(Multiply(vvar, Literal(2)), [kvar, vvar]))
+        return df.select(_c(cm).alias("cm"),
+                         _c(MapValues(tv)).alias("tv2"))
+
+    tpu, cpu = _both(tbl, build)
+    assert tpu.equals(cpu), (tpu.to_pydict(), cpu.to_pydict())
+    assert tpu.column("cm").to_pylist() == \
+        [[(1, 10)], [(2, None)], [(3, 30)]]
+    assert tpu.column("tv2").to_pylist() == [[2, 4], [6], []]
+
+
+def test_unix_timestamp_alias():
+    from spark_rapids_tpu.expr.datetime_expr import UnixTimestamp
+    tbl = pa.table({"ts": pa.array(
+        np.array([0, 86_400_000_000, 1_600_000_000_123_456],
+                 dtype="int64").view("M8[us]"))})
+    tpu, cpu = _both(tbl, lambda df: df.select(
+        _c(UnixTimestamp(col("ts").expr)).alias("u")))
+    assert tpu.equals(cpu)
+    assert tpu.column("u").to_pylist() == [0, 86_400, 1_600_000_000]
