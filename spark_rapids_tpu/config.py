@@ -298,6 +298,13 @@ SCAN_PIN_DEVICE = conf("spark.rapids.sql.localScan.pinDeviceBatches").boolean() 
          "writer keeping batches device-resident).") \
     .create_with_default(True)
 
+FILESCAN_PIN_DEVICE = conf("spark.rapids.sql.fileScan.pinDeviceBatches") \
+    .boolean() \
+    .doc("Keep decoded+uploaded file-scan batches pinned in HBM keyed by "
+         "(path, size, mtime, schema, filters); a changed file changes "
+         "the key.  Evicted first under memory pressure.") \
+    .create_with_default(True)
+
 SHUFFLE_COMPRESSION_CODEC = conf("spark.rapids.shuffle.compression.codec").string() \
     .doc("Codec for shuffle payloads: none, lz4, zstd (native codec library).") \
     .check_values(["none", "lz4", "zstd"]) \

@@ -479,9 +479,11 @@ class CoalesceBatchesExec(Exec):
             pending.append(b)
             pending_rows += n
             if not self.require_single_batch and pending_rows >= target:
-                yield concat_batches(xp, pending, self.output_names,
-                                     self.output_types)
+                yield pending[0] if len(pending) == 1 else \
+                    concat_batches(xp, pending, self.output_names,
+                                   self.output_types)
                 pending, pending_rows = [], 0
         if pending:
-            yield concat_batches(xp, pending, self.output_names,
-                                 self.output_types)
+            yield pending[0] if len(pending) == 1 else \
+                concat_batches(xp, pending, self.output_names,
+                               self.output_types)

@@ -329,7 +329,7 @@ class GetMapValue(Expression):
 
 @evaluator(GetMapValue)
 def _eval_get_map_value(e: GetMapValue, ctx: EvalContext):
-    from ..ops.scan import fill_rows_from_starts
+    from ..ops.scan import child_row_ids
     from ..ops.gather import gather_column
     from ..ops import segmented as seg2
     xp = ctx.xp
@@ -340,15 +340,7 @@ def _eval_get_map_value(e: GetMapValue, ctx: EvalContext):
     child_cap = kcol.capacity
     cap = m.capacity
     pos = xp.arange(child_cap, dtype=xp.int32)
-    spans = m.offsets[1:] - m.offsets[:-1]
-    if xp is np:
-        crow = np.clip(np.searchsorted(m.offsets[1:], pos, side="right"),
-                       0, cap - 1).astype(np.int32)
-    else:
-        crow = xp.clip(
-            fill_rows_from_starts(xp, m.offsets[:-1].astype(xp.int32),
-                                  spans > 0, child_cap), 0, cap - 1)
-    in_range = pos < m.offsets[-1]
+    crow, in_range = child_row_ids(xp, m.offsets, cap, child_cap)
     from .core import ScalarValue
     if isinstance(keyv, ScalarValue):
         if keyv.value is None:
@@ -405,23 +397,14 @@ class ArrayMin(ArrayMax):
 
 
 def _eval_array_extreme(e, ctx: EvalContext, op: str):
-    from ..ops.scan import fill_rows_from_starts
+    from ..ops.scan import child_row_ids
     from ..ops import segmented as seg2
     xp = ctx.xp
     a = e.children[0].eval(ctx).col
     child = a.children[0]
     child_cap = child.capacity
     cap = a.capacity
-    pos = xp.arange(child_cap, dtype=xp.int32)
-    spans = a.offsets[1:] - a.offsets[:-1]
-    if xp is np:
-        crow = np.clip(np.searchsorted(a.offsets[1:], pos, side="right"),
-                       0, cap - 1).astype(np.int32)
-    else:
-        crow = xp.clip(
-            fill_rows_from_starts(xp, a.offsets[:-1].astype(xp.int32),
-                                  spans > 0, child_cap), 0, cap - 1)
-    in_range = pos < a.offsets[-1]
+    crow, in_range = child_row_ids(xp, a.offsets, cap, child_cap)
     contrib = in_range
     if child.validity is not None:
         contrib = contrib & child.validity
@@ -436,8 +419,6 @@ def _eval_array_extreme(e, ctx: EvalContext, op: str):
 
 @evaluator(ArrayMax)
 def _eval_array_max(e: ArrayMax, ctx: EvalContext):
-    if type(e) is ArrayMin:
-        return _eval_array_extreme(e, ctx, "min")
     return _eval_array_extreme(e, ctx, "max")
 
 

@@ -74,22 +74,14 @@ class InSet(Expression):
 
 @evaluator(InSet)
 def _eval_inset(e: InSet, ctx: EvalContext):
-    xp = ctx.xp
-    v = e.children[0].eval(ctx)
-    c = _as_col(ctx, v, e.children[0].data_type())
-    data = c.col.data
-    hit = xp.zeros(data.shape, dtype=bool)
-    has_null = False
-    for val in e.values:
-        if val is None:
-            has_null = True
-            continue
-        hit = hit | (data == xp.asarray(val, dtype=data.dtype))
-    valid = _col_validity(ctx, c.col)
-    if has_null:
-        # Spark: x IN (..., null) is null unless a match exists
-        valid = valid & hit
-    return make_column(ctx, t.BOOLEAN, hit, valid)
+    # delegate to In's comparison machinery — it already handles string
+    # children, literal widening, and the null-in-list semantics
+    from .core import Literal
+    from .predicates import In
+    dt = e.children[0].data_type()
+    lits = [Literal(v, dt) if v is not None else Literal(None, dt)
+            for v in e.values]
+    return In(e.children[0], lits).eval(ctx)
 
 
 class AtLeastNNonNulls(Expression):

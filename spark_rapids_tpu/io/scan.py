@@ -92,6 +92,13 @@ def set_current_input_file(path: str) -> None:
     _input_file_ctx.path = path
 
 
+# process-level device pin for file scans: repeated queries over the
+# same unchanged files skip host decode AND re-upload (the HBM entries
+# register with the spill catalog and evict first under pressure, like
+# the local-scan pin)
+_FILESCAN_PIN: dict = {}
+
+
 class FileScanExec(Exec):
     """Columnar file scan (ref GpuFileSourceScanExec + partition readers)."""
 
@@ -198,7 +205,55 @@ class FileScanExec(Exec):
             if n == 0:
                 break
 
+    def _pin_key(self, pid):
+        """Process-level device pin key: file identity (path, size,
+        mtime) + everything that shapes the produced batches.  A changed
+        file changes the key, so stale reads are impossible."""
+        import os
+        ident = []
+        for p in self.paths:
+            try:
+                st = os.stat(p)
+                ident.append((p, st.st_size, st.st_mtime_ns))
+            except OSError:
+                return None
+        return (self.fmt, tuple(ident), tuple(self.output_names),
+                tuple(repr(d) for d in self.output_types),
+                tuple(repr(f) for f in self.pushed_filters),
+                self.reader_type, self.batch_rows, self.placement, pid)
+
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        from .. import config as cfg2
+        pin = _FILESCAN_PIN if ctx.conf.get(cfg2.FILESCAN_PIN_DEVICE) \
+            and self.placement == TPU else None
+        key = self._pin_key(pid) if pin is not None else None
+        if key is not None and key in pin:
+            for path, b in pin[key]:
+                set_current_input_file(path)
+                self.metrics[NUM_OUTPUT_ROWS] += int(b.num_rows)
+                self.metrics[NUM_OUTPUT_BATCHES] += 1
+                yield b
+            return
+        if key is not None:
+            produced = []
+            inner = self._execute_partition_uncached(pid, ctx)
+            for path, b in self._trace_paths(inner):
+                produced.append((path, b))
+                yield b
+            pin[key] = produced
+            from ..memory.spill import SpillCatalog
+            SpillCatalog.get().register_pinned(
+                pin, key, [b for _, b in produced])
+            return
+        yield from self._execute_partition_uncached(pid, ctx)
+
+    def _trace_paths(self, gen):
+        """Pair each emitted batch with the input file current at yield
+        time (input_file_name must replay correctly from the pin)."""
+        for b in gen:
+            yield current_input_file(), b
+
+    def _execute_partition_uncached(self, pid, ctx) -> Iterator[Batch]:
         if not self.paths:
             from ..columnar.interop import to_arrow_schema
             yield from self._emit(to_arrow_schema(

@@ -106,3 +106,18 @@ def fill_rows_from_starts(xp, starts_i32, active, out_cap: int):
     tgt = xp.where(active, xp.clip(starts_i32, 0, out_cap), out_cap)
     seed = xp.zeros((out_cap,), xp.int32).at[tgt].max(iota, mode="drop")
     return cummax_i32(xp, seed)
+
+
+def child_row_ids(xp, offsets, cap: int, child_cap: int):
+    """(row_ids[child_cap], in_range[child_cap]): the owning row of each
+    child/element position under a span-offsets column."""
+    pos = xp.arange(child_cap, dtype=xp.int32)
+    if xp is np:
+        row = np.clip(np.searchsorted(offsets[1:], pos, side="right"),
+                      0, cap - 1).astype(np.int32)
+    else:
+        spans = offsets[1:] - offsets[:-1]
+        row = xp.clip(
+            fill_rows_from_starts(xp, offsets[:-1].astype(xp.int32),
+                                  spans > 0, child_cap), 0, cap - 1)
+    return row, pos < offsets[-1]

@@ -879,7 +879,20 @@ def insert_transitions(root: eb.Exec) -> eb.Exec:
 
     root = fix(root)
     if root.placement == eb.TPU:
-        root = eb.DeviceToHostExec(root)
+        # collect boundary: funnel every partition's device batches into
+        # ONE device-side concat before crossing to host — each fetch
+        # costs two tunnel round trips, so a 4-partition result fetched
+        # per-batch pays 8 syncs where one coalesced batch pays 2 (the
+        # coalesce-before-transition role of GpuCoalesceBatches)
+        if root.num_partitions > 1:
+            root = GatherPartitionsExec(root)
+            root.placement = eb.TPU
+        # NOT require_single_batch: a result bigger than the coalesce
+        # target streams in bounded chunks instead of materializing one
+        # giant device batch (device-OOM guard for huge collects)
+        coal = CoalesceBatchesExec(root)
+        coal.placement = eb.TPU
+        root = eb.DeviceToHostExec(coal)
     # fuse DeviceToHost(HostToDevice(x)) -> x
     def fuse(node: eb.Exec) -> eb.Exec:
         if isinstance(node, eb.HostToDeviceExec) and \

@@ -1,0 +1,48 @@
+
+
+def test_filescan_device_pin_reuses_and_invalidates(tmp_path):
+    """Repeated parquet queries reuse pinned device batches; touching the
+    file (mtime/size change) invalidates the pin key."""
+    import pyarrow.parquet as pq
+    import numpy as np
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.io import scan as scan_mod
+
+    import pyarrow as pa
+    p = tmp_path / "pin.parquet"
+    tb = pa.table({"k": pa.array(np.arange(100, dtype=np.int64) % 7),
+                   "v": pa.array(np.arange(100, dtype=np.int64))})
+    pq.write_table(tb, p)
+    s = (TpuSession.builder().config("spark.rapids.sql.enabled", True)
+         .get_or_create())
+
+    def q():
+        return (s.read.parquet(str(p)).group_by(col("k"))
+                .agg(F.sum(col("v")).alias("sv")).collect().sort_by("k"))
+
+    scan_mod._FILESCAN_PIN.clear()
+    out1 = q()
+    assert len(scan_mod._FILESCAN_PIN) >= 1
+    calls = {"n": 0}
+    orig = scan_mod.FileScanExec._read_file
+
+    def spy(self, path):
+        calls["n"] += 1
+        return orig(self, path)
+
+    scan_mod.FileScanExec._read_file = spy
+    try:
+        out2 = q()
+        assert calls["n"] == 0, "pinned scan must not re-read the file"
+        assert out1.equals(out2)
+        # rewrite the file -> new key -> re-read
+        tb2 = pa.table({"k": pa.array(np.arange(50, dtype=np.int64) % 7),
+                        "v": pa.array(np.arange(50, dtype=np.int64))})
+        pq.write_table(tb2, p)
+        out3 = q()
+        assert calls["n"] >= 1, "changed file must invalidate the pin"
+        assert sum(out3.column("sv").to_pylist()) == sum(range(50))
+    finally:
+        scan_mod.FileScanExec._read_file = orig
