@@ -301,7 +301,8 @@ SCAN_PIN_DEVICE = conf("spark.rapids.sql.localScan.pinDeviceBatches").boolean() 
 FILESCAN_PIN_DEVICE = conf("spark.rapids.sql.fileScan.pinDeviceBatches") \
     .boolean() \
     .doc("Keep decoded+uploaded file-scan batches pinned in HBM keyed by "
-         "(path, size, mtime, schema, filters); a changed file changes "
+         "(path, size, mtime, schema, filters, decode options); a "
+         "changed file changes "
          "the key.  Evicted first under memory pressure.") \
     .create_with_default(True)
 

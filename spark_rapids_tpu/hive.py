@@ -76,6 +76,86 @@ def _register() -> None:
 
 def enable_hive_support() -> None:
     """Opt in to the Hive dialect rules (the analog of the reference
-    finding Hive on the classpath)."""
+    finding Hive on the classpath): the HiveHash expression rule plus
+    the Hive text-table read helper on the session class."""
     from .plan.extensions import register_override_provider
     register_override_provider(_register)
+    from .api.session import TpuSession
+    HiveTextRelation.attach(TpuSession)
+
+
+# ---------------------------------------------------------------------------
+# Hive text tables (LazySimpleSerDe): the file-format surface the
+# reference accelerates in org/apache/spark/sql/hive/rapids
+# (GpuHiveTableScanExec for reads, GpuHiveFileFormat for writes).
+# Hive's default text layout: fields separated by \x01, rows by \n,
+# NULL spelled \N, no header.
+# ---------------------------------------------------------------------------
+
+HIVE_FIELD_DELIM = "\x01"
+HIVE_NULL = r"\N"
+
+
+def read_hive_text(path: str, names, dtypes):
+    """Read a Hive text file/directory into an Arrow table with the given
+    schema (ref GpuHiveTableScanExec's LazySimpleSerDe subset: default
+    delimiters, no escaping/quoting — the same restrictions the
+    reference's isSupportedType checks enforce)."""
+    import os
+    import pyarrow as pa
+    import pyarrow.csv as pacsv
+    from .columnar.interop import to_arrow_schema
+    want = to_arrow_schema(list(names), list(dtypes))
+    paths = [path]
+    if os.path.isdir(path):
+        paths = sorted(
+            os.path.join(path, f) for f in os.listdir(path)
+            if not f.startswith((".", "_")))
+    if not paths:
+        # empty Hive table/partition (e.g. only _SUCCESS markers)
+        return want.empty_table()
+    ropts = pacsv.ReadOptions(column_names=list(names))
+    popts = pacsv.ParseOptions(delimiter=HIVE_FIELD_DELIM,
+                               quote_char=False, escape_char=False)
+    copts = pacsv.ConvertOptions(null_values=[HIVE_NULL],
+                                 strings_can_be_null=True,
+                                 quoted_strings_can_be_null=False,
+                                 column_types={f.name: f.type
+                                               for f in want})
+    tables = [pacsv.read_csv(p, read_options=ropts, parse_options=popts,
+                             convert_options=copts) for p in paths]
+    out = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+    return out.cast(want)
+
+
+def write_hive_text(table, path: str) -> None:
+    """Write an Arrow table in Hive text layout (ref GpuHiveFileFormat:
+    delimited write with \\N nulls, no header)."""
+    import pyarrow.csv as pacsv
+    wopts = pacsv.WriteOptions(include_header=False,
+                               delimiter=HIVE_FIELD_DELIM,
+                               quoting_style="none")
+    # pyarrow has no null-spelling option on write: substitute via fill
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    cols = []
+    for name in table.column_names:
+        c = table.column(name)
+        if c.null_count:
+            c = pc.fill_null(c.cast(pa.string()), HIVE_NULL)
+        cols.append(c)
+    pacsv.write_csv(pa.table(dict(zip(table.column_names, cols))), path,
+                    write_options=wopts)
+
+
+class HiveTextRelation:
+    """Session-level helpers registered by enable_hive_support():
+    session.read_hive_text(path, names, dtypes) -> DataFrame and
+    DataFrame-side write via write_hive_text."""
+
+    @staticmethod
+    def attach(session_cls) -> None:
+        def read_hive_text_m(self, path, names, dtypes):
+            tbl = read_hive_text(path, names, dtypes)
+            return self.create_dataframe(tbl)
+        session_cls.read_hive_text = read_hive_text_m

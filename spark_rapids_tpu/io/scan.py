@@ -207,19 +207,30 @@ class FileScanExec(Exec):
 
     def _pin_key(self, pid):
         """Process-level device pin key: file identity (path, size,
-        mtime) + everything that shapes the produced batches.  A changed
-        file changes the key, so stale reads are impossible."""
+        mtime) + everything that shapes the produced batches (schema,
+        filters, reader shape, decode options).  A changed file changes
+        the key, so stale reads are impossible.  File idents stat once
+        per exec (= per query), not once per partition."""
         import os
-        ident = []
-        for p in self.paths:
-            try:
-                st = os.stat(p)
-                ident.append((p, st.st_size, st.st_mtime_ns))
-            except OSError:
-                return None
-        return (self.fmt, tuple(ident), tuple(self.output_names),
+        ident = getattr(self, "_file_ident", None)
+        if ident is None:
+            ident = []
+            for p in self.paths:
+                try:
+                    st = os.stat(p)
+                    ident.append((p, st.st_size, st.st_mtime_ns))
+                except OSError:
+                    ident = None
+                    break
+            self._file_ident = ident if ident is None else tuple(ident)
+            ident = self._file_ident
+        if ident is None:
+            return None
+        return (self.fmt, ident, tuple(self.output_names),
                 tuple(repr(d) for d in self.output_types),
                 tuple(repr(f) for f in self.pushed_filters),
+                tuple(sorted((k, repr(v))
+                             for k, v in self.options.items())),
                 self.reader_type, self.batch_rows, self.placement, pid)
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:

@@ -26,12 +26,20 @@ def device_batches(df) -> List:
     (the reference likewise degrades to host rows when the plan ended on
     CPU, InternalColumnarRddConverter's row path)."""
     from .exec.base import DeviceToHostExec, ExecContext
+    from .exec.basic import CoalesceBatchesExec
+    from .exec.gatherpart import GatherPartitionsExec
 
     session = df.session
     final_plan = session.prepare_plan(df._lp)
-    # strip the terminal transition: consumers want device residency
+    # strip the whole collect boundary (DeviceToHost plus the gather/
+    # coalesce inserted for fetch efficiency): ML consumers want the
+    # plan's own partitioning and zero-copy device batches, not a
+    # concatenated fetch-shaped result
     if isinstance(final_plan, DeviceToHostExec):
         final_plan = final_plan.children[0]
+        while isinstance(final_plan, (CoalesceBatchesExec,
+                                      GatherPartitionsExec)):
+            final_plan = final_plan.children[0]
     session.last_plan = final_plan
     ctx = ExecContext(session.conf)
     out = []

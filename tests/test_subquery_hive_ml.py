@@ -178,3 +178,66 @@ def test_subquery_in_window_partition_keys():
         .collect()
     # one partition (constant key) -> row numbers 1..3
     assert sorted(out.column("rn").to_pylist()) == [1, 2, 3]
+
+
+def test_hive_text_round_trip(tmp_path):
+    """Hive text tables (LazySimpleSerDe layout: \\x01 delimiters, \\N
+    nulls) read into the engine and write back byte-compatibly
+    (ref GpuHiveTableScanExec / GpuHiveFileFormat)."""
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu import types as t
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.hive import (enable_hive_support,
+                                       read_hive_text, write_hive_text)
+
+    enable_hive_support()
+    src = tmp_path / "hive_table.txt"
+    rows = [("a", 1, 1.5), (None, 2, None), ("cé", None, -0.25)]
+    with open(src, "w", encoding="utf-8") as f:
+        for s_, i_, d_ in rows:
+            f.write("\x01".join([
+                s_ if s_ is not None else r"\N",
+                str(i_) if i_ is not None else r"\N",
+                repr(d_) if d_ is not None else r"\N"]) + "\n")
+
+    names = ["s", "i", "d"]
+    dtypes = [t.STRING, t.LONG, t.DOUBLE]
+    tbl = read_hive_text(str(src), names, dtypes)
+    assert tbl.column("s").to_pylist() == ["a", None, "cé"]
+    assert tbl.column("i").to_pylist() == [1, 2, None]
+    assert tbl.column("d").to_pylist() == [1.5, None, -0.25]
+
+    # engine query over the hive table via the session helper
+    sess = (TpuSession.builder()
+            .config("spark.rapids.sql.enabled", True).get_or_create())
+    df = sess.read_hive_text(str(src), names, dtypes)
+    out = df.select(col("i"), (col("d") * 2).alias("d2")).collect()
+    assert out.column("d2").to_pylist() == [3.0, None, -0.5]
+
+    # write back and re-read: identical values
+    dst = tmp_path / "out.txt"
+    write_hive_text(tbl, str(dst))
+    back = read_hive_text(str(dst), names, dtypes)
+    assert back.equals(tbl), (back.to_pydict(), tbl.to_pydict())
+
+
+def test_ml_export_preserves_partitions():
+    """ml.device_batches must NOT inherit the collect boundary's
+    gather/coalesce: partition structure and device residency are the
+    export's contract (ref ColumnarRdd.scala)."""
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu import ml
+    from spark_rapids_tpu.api.session import TpuSession
+
+    s = (TpuSession.builder()
+         .config("spark.rapids.sql.enabled", True).get_or_create())
+    tb = pa.table({"v": pa.array(np.arange(4000, dtype=np.int64))})
+    df = s.create_dataframe(tb, num_partitions=4)
+    parts = ml.device_batches(df)
+    assert len(parts) == 4, f"expected 4 partitions, got {len(parts)}"
+    total = sum(int(b.num_rows) for bs in parts for b in bs)
+    assert total == 4000
