@@ -289,11 +289,19 @@ def device_timing_enabled() -> bool:
 
 def maybe_sync(out) -> None:
     """Under device-timing mode, block until `out`'s arrays are resolved.
-    Call as the last statement inside a MetricTimer block."""
+    Call as the last statement inside a MetricTimer block.
+
+    On tunneled platforms (axon) `block_until_ready` returns before the
+    program executes, which would attribute every op's time to whichever
+    later op fetches — so this also forces a one-element fetch, the only
+    reliable execution barrier there.  Costs one tunnel round trip per
+    op per batch; diagnostics mode only."""
     if _device_timing_enabled:
-        jax.block_until_ready(
-            [l for l in jax.tree_util.tree_leaves(out)
-             if isinstance(l, jax.Array)])
+        leaves = [l for l in jax.tree_util.tree_leaves(out)
+                  if isinstance(l, jax.Array)]
+        jax.block_until_ready(leaves)
+        if leaves:
+            np.asarray(leaves[-1].ravel()[-1:])
 
 
 _trace_annotations_enabled = False

@@ -100,17 +100,14 @@ def read_hive_text(path: str, names, dtypes):
     """Read a Hive text file/directory into an Arrow table with the given
     schema (ref GpuHiveTableScanExec's LazySimpleSerDe subset: default
     delimiters, no escaping/quoting — the same restrictions the
-    reference's isSupportedType checks enforce)."""
-    import os
+    reference's isSupportedType checks enforce).  Directories expand
+    recursively (partitioned table layout); marker files skip."""
     import pyarrow as pa
     import pyarrow.csv as pacsv
     from .columnar.interop import to_arrow_schema
+    from .io.reader import _expand
     want = to_arrow_schema(list(names), list(dtypes))
-    paths = [path]
-    if os.path.isdir(path):
-        paths = sorted(
-            os.path.join(path, f) for f in os.listdir(path)
-            if not f.startswith((".", "_")))
+    paths = _expand([path])
     if not paths:
         # empty Hive table/partition (e.g. only _SUCCESS markers)
         return want.empty_table()
@@ -139,23 +136,31 @@ def write_hive_text(table, path: str) -> None:
     import pyarrow as pa
     import pyarrow.compute as pc
     cols = []
-    for name in table.column_names:
-        c = table.column(name)
+    for i in range(table.num_columns):
+        c = table.column(i)
         if c.null_count:
             c = pc.fill_null(c.cast(pa.string()), HIVE_NULL)
         cols.append(c)
-    pacsv.write_csv(pa.table(dict(zip(table.column_names, cols))), path,
+    # positional table rebuild: duplicate column names must survive
+    pacsv.write_csv(pa.table(cols, names=list(table.column_names)), path,
                     write_options=wopts)
 
 
 class HiveTextRelation:
-    """Session-level helpers registered by enable_hive_support():
-    session.read_hive_text(path, names, dtypes) -> DataFrame and
-    DataFrame-side write via write_hive_text."""
+    """Session-level helper registered by enable_hive_support():
+    session.read_hive_text(path, names, dtypes) -> DataFrame backed by
+    the regular scan exec (fmt="hivetext"), so Hive tables get the same
+    reader strategies, HBM pin cache, and batch chunking as parquet/csv
+    scans (the scan-exec modeling of GpuHiveTableScanExec)."""
 
     @staticmethod
     def attach(session_cls) -> None:
         def read_hive_text_m(self, path, names, dtypes):
-            tbl = read_hive_text(path, names, dtypes)
-            return self.create_dataframe(tbl)
+            from .api.dataframe import DataFrame
+            from .io.reader import _expand
+            from .plan.logical import FileRelation
+            files = _expand([path])
+            return DataFrame(
+                FileRelation("hivetext", files, list(names), list(dtypes)),
+                self)
         session_cls.read_hive_text = read_hive_text_m

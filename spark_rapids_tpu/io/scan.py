@@ -179,6 +179,24 @@ class FileScanExec(Exec):
             from ..columnar.interop import to_arrow_schema
             want = to_arrow_schema(self.output_names, self.output_types)
             return tbl.select(self.output_names).cast(want)
+        if self.fmt == "hivetext":
+            # Hive's LazySimpleSerDe text layout: \x01 field delimiter,
+            # \N nulls, no header, positional columns (so the FULL
+            # schema parses; pruning selects after)
+            from ..columnar.interop import to_arrow_schema
+            full = to_arrow_schema(self._all_names, self._all_types)
+            ropts = pacsv.ReadOptions(column_names=self._all_names)
+            popts = pacsv.ParseOptions(delimiter="\x01", quote_char=False,
+                                       escape_char=False)
+            copts = pacsv.ConvertOptions(
+                null_values=[r"\N"], strings_can_be_null=True,
+                quoted_strings_can_be_null=False,
+                column_types={f.name: f.type for f in full})
+            tbl = pacsv.read_csv(path, read_options=ropts,
+                                 parse_options=popts,
+                                 convert_options=copts)
+            want = to_arrow_schema(self.output_names, self.output_types)
+            return tbl.select(self.output_names).cast(want)
         raise ValueError(self.fmt)
 
     def _emit(self, table: pa.Table, path: str = "") -> Iterator[Batch]:

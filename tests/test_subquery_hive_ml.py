@@ -223,6 +223,21 @@ def test_hive_text_round_trip(tmp_path):
     back = read_hive_text(str(dst), names, dtypes)
     assert back.equals(tbl), (back.to_pydict(), tbl.to_pydict())
 
+    # partitioned-table layout: files under subdirectories read
+    # recursively; marker files skip; empty dirs read empty
+    pdir = tmp_path / "ptable"
+    (pdir / "part=1").mkdir(parents=True)
+    (pdir / "_SUCCESS").write_text("")
+    import shutil
+    shutil.copy(src, pdir / "part=1" / "f.txt")
+    part = read_hive_text(str(pdir), names, dtypes)
+    assert part.equals(tbl)
+    empty = tmp_path / "etable"
+    empty.mkdir()
+    (empty / "_SUCCESS").write_text("")
+    et = read_hive_text(str(empty), names, dtypes)
+    assert et.num_rows == 0 and et.schema.names == names
+
 
 def test_ml_export_preserves_partitions():
     """ml.device_batches must NOT inherit the collect boundary's
