@@ -96,6 +96,40 @@ HIVE_FIELD_DELIM = "\x01"
 HIVE_NULL = r"\N"
 
 
+def expand_hive_paths(path: str):
+    """Hive-layout file expansion: a literal file path reads as-is (no
+    glob interpretation); a directory walks recursively, skipping any
+    path COMPONENT that starts with '_' or '.' (_temporary/, _SUCCESS,
+    hidden files) and taking every remaining file regardless of
+    extension — Hive data files are extension-less (000000_0,
+    part-00000)."""
+    import os
+    if not os.path.isdir(path):
+        return [path]
+    out = []
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if not d.startswith(("_", ".")))
+        for f in sorted(files):
+            if not f.startswith(("_", ".")):
+                out.append(os.path.join(root, f))
+    return out
+
+
+def hive_text_read_options(names, want_schema):
+    """The LazySimpleSerDe read-option triple shared by the standalone
+    reader and the hivetext scan exec (one definition, no drift)."""
+    import pyarrow.csv as pacsv
+    ropts = pacsv.ReadOptions(column_names=list(names))
+    popts = pacsv.ParseOptions(delimiter=HIVE_FIELD_DELIM,
+                               quote_char=False, escape_char=False)
+    copts = pacsv.ConvertOptions(null_values=[HIVE_NULL],
+                                 strings_can_be_null=True,
+                                 quoted_strings_can_be_null=False,
+                                 column_types={f.name: f.type
+                                               for f in want_schema})
+    return ropts, popts, copts
+
+
 def read_hive_text(path: str, names, dtypes):
     """Read a Hive text file/directory into an Arrow table with the given
     schema (ref GpuHiveTableScanExec's LazySimpleSerDe subset: default
@@ -105,20 +139,12 @@ def read_hive_text(path: str, names, dtypes):
     import pyarrow as pa
     import pyarrow.csv as pacsv
     from .columnar.interop import to_arrow_schema
-    from .io.reader import _expand
     want = to_arrow_schema(list(names), list(dtypes))
-    paths = _expand([path])
+    paths = expand_hive_paths(path)
     if not paths:
         # empty Hive table/partition (e.g. only _SUCCESS markers)
         return want.empty_table()
-    ropts = pacsv.ReadOptions(column_names=list(names))
-    popts = pacsv.ParseOptions(delimiter=HIVE_FIELD_DELIM,
-                               quote_char=False, escape_char=False)
-    copts = pacsv.ConvertOptions(null_values=[HIVE_NULL],
-                                 strings_can_be_null=True,
-                                 quoted_strings_can_be_null=False,
-                                 column_types={f.name: f.type
-                                               for f in want})
+    ropts, popts, copts = hive_text_read_options(names, want)
     tables = [pacsv.read_csv(p, read_options=ropts, parse_options=popts,
                              convert_options=copts) for p in paths]
     out = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
@@ -157,9 +183,8 @@ class HiveTextRelation:
     def attach(session_cls) -> None:
         def read_hive_text_m(self, path, names, dtypes):
             from .api.dataframe import DataFrame
-            from .io.reader import _expand
             from .plan.logical import FileRelation
-            files = _expand([path])
+            files = expand_hive_paths(path)
             return DataFrame(
                 FileRelation("hivetext", files, list(names), list(dtypes)),
                 self)

@@ -180,18 +180,14 @@ class FileScanExec(Exec):
             want = to_arrow_schema(self.output_names, self.output_types)
             return tbl.select(self.output_names).cast(want)
         if self.fmt == "hivetext":
-            # Hive's LazySimpleSerDe text layout: \x01 field delimiter,
-            # \N nulls, no header, positional columns (so the FULL
-            # schema parses; pruning selects after)
+            # Hive's LazySimpleSerDe text layout; positional columns, so
+            # the FULL schema parses and pruning selects after.  Options
+            # come from ONE definition shared with hive.read_hive_text.
             from ..columnar.interop import to_arrow_schema
+            from ..hive import hive_text_read_options
             full = to_arrow_schema(self._all_names, self._all_types)
-            ropts = pacsv.ReadOptions(column_names=self._all_names)
-            popts = pacsv.ParseOptions(delimiter="\x01", quote_char=False,
-                                       escape_char=False)
-            copts = pacsv.ConvertOptions(
-                null_values=[r"\N"], strings_can_be_null=True,
-                quoted_strings_can_be_null=False,
-                column_types={f.name: f.type for f in full})
+            ropts, popts, copts = hive_text_read_options(self._all_names,
+                                                         full)
             tbl = pacsv.read_csv(path, read_options=ropts,
                                  parse_options=popts,
                                  convert_options=copts)

@@ -228,8 +228,11 @@ def test_hive_text_round_trip(tmp_path):
     pdir = tmp_path / "ptable"
     (pdir / "part=1").mkdir(parents=True)
     (pdir / "_SUCCESS").write_text("")
+    # leftovers from an interrupted write must NOT be ingested
+    (pdir / "_temporary" / "0").mkdir(parents=True)
     import shutil
-    shutil.copy(src, pdir / "part=1" / "f.txt")
+    shutil.copy(src, pdir / "_temporary" / "0" / "part-00000")
+    shutil.copy(src, pdir / "part=1" / "000000_0")  # extension-less
     part = read_hive_text(str(pdir), names, dtypes)
     assert part.equals(tbl)
     empty = tmp_path / "etable"
