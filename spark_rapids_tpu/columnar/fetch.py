@@ -431,20 +431,48 @@ def _schema_key(batch: DeviceBatch) -> tuple:
     return tuple(col_key(c) for c in batch.columns)
 
 
+# last successful (out_cap, var_caps, plan) per schema key: lets a warm
+# repeat dispatch the pack SPECULATIVELY alongside the sizes probe and
+# pay ONE sync instead of two serial tunnel round trips.  The sizes
+# still arrive and must re-derive the identical plan, or the
+# speculative buffers are discarded (a narrowed lane under a stale
+# narrower width would wrap silently — never trusted without the check).
+_LAST_PLAN: dict = {}
+
+
 def fetch_batch(batch: DeviceBatch,
                 row_buckets: Sequence[int] = DEFAULT_ROW_BUCKETS,
                 char_buckets: Sequence[int] = DEFAULT_CHAR_BUCKETS,
                 ) -> DeviceBatch:
     """Bring a device batch to host as numpy-backed DeviceBatch in two
-    round trips, transferring only bucket_for(num_rows) rows per lane
-    and only information-carrying bytes per lane (see module doc)."""
+    round trips (ONE when the speculative plan validates), transferring
+    only bucket_for(num_rows) rows per lane and only
+    information-carrying bytes per lane (see module doc)."""
     if not batch_is_device(batch):
         # already host-side: just normalize num_rows to a python int
         return DeviceBatch(batch.columns, int(batch.num_rows), batch.names)
     from ..exec.base import process_jit
     skey = _schema_key(batch)
     sizes_fn = process_jit(("fetch_sizes", skey), _make_sizes_fn)
-    sizes = np.asarray(sizes_fn(batch))          # round trip 1 (+ barrier)
+    entry = _LAST_PLAN.get(skey)
+    spec = None
+    spec_bufs = None
+    if entry is not None and entry[1] >= 1:
+        # speculate only after the plan repeated — a misprediction moves
+        # a full wasted payload over the bandwidth-bound tunnel, so
+        # alternating shapes must not thrash
+        spec = entry[0]
+        s_cap, s_vc, s_plan = spec
+        spec_fn = process_jit(("fetch_pack", skey, s_cap, s_vc, s_plan),
+                              lambda: _make_shrink_pack_fn(s_cap, s_vc,
+                                                           s_plan))
+        sizes_dev = sizes_fn(batch)
+        spec_out = spec_fn(batch)
+        fetched = jax.device_get((sizes_dev,) + tuple(spec_out))  # 1 sync
+        sizes = np.asarray(fetched[0])
+        spec_bufs = fetched[1:]
+    else:
+        sizes = np.asarray(sizes_fn(batch))      # round trip 1 (+ barrier)
     n = int(sizes[0])
     out_cap = bucket_for(n, row_buckets)
     # decode var sizes in walk order -> buckets (char lanes use char
@@ -474,9 +502,18 @@ def fetch_batch(batch: DeviceBatch,
     vc = tuple(var_caps)
     stats = sizes[1 + len(var_caps):]
     plan, mins = _build_plan(batch, stats)
-    pack_fn = process_jit(("fetch_pack", skey, out_cap, vc, plan),
-                          lambda: _make_shrink_pack_fn(out_cap, vc, plan))
-    bufs = jax.device_get(pack_fn(batch))        # round trip 2 (one sync)
+    if spec_bufs is not None and spec == (out_cap, vc, plan):
+        bufs = spec_bufs                         # speculation validated
+    else:
+        pack_fn = process_jit(("fetch_pack", skey, out_cap, vc, plan),
+                              lambda: _make_shrink_pack_fn(out_cap, vc,
+                                                           plan))
+        bufs = jax.device_get(pack_fn(batch))    # round trip 2 (one sync)
+    this_plan = (out_cap, vc, plan)
+    prev = _LAST_PLAN.get(skey)
+    _LAST_PLAN[skey] = (this_plan,
+                        (prev[1] + 1) if prev and prev[0] == this_plan
+                        else 0)
     # reconstruct the device-side wire-dtype-group order from the template
     order: List[str] = []
     pi = iter(plan)

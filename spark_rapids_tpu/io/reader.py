@@ -19,6 +19,15 @@ from ..columnar.interop import from_arrow_type
 from ..plan.logical import FileRelation
 
 
+def _hidden_component(root: str, path: str) -> bool:
+    """Any path component below `root` starting with '_' or '.' marks
+    metadata/leftovers (_SUCCESS, _temporary/ from interrupted writes,
+    hidden files) — Spark's readers skip these at every depth, not just
+    the basename."""
+    rel = os.path.relpath(path, root)
+    return any(part.startswith(("_", ".")) for part in rel.split(os.sep))
+
+
 def _expand(paths) -> List[str]:
     if isinstance(paths, str):
         paths = [paths]
@@ -30,7 +39,7 @@ def _expand(paths) -> List[str]:
                 hits = sorted(glob.glob(os.path.join(p, "**", fmt_glob),
                                         recursive=True))
                 hits = [h for h in hits if os.path.isfile(h)
-                        and not os.path.basename(h).startswith(("_", "."))]
+                        and not _hidden_component(p, h)]
                 if hits:
                     out.extend(hits)
                     break
