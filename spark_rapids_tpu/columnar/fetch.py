@@ -454,7 +454,10 @@ def fetch_batch(batch: DeviceBatch,
     from ..exec.base import process_jit
     skey = _schema_key(batch)
     sizes_fn = process_jit(("fetch_sizes", skey), _make_sizes_fn)
-    entry = _LAST_PLAN.get(skey)
+    # plan memo key includes the bucket ladders: a caller alternating
+    # bucket configs for one schema must not arm doomed speculation
+    pkey = (skey, tuple(row_buckets), tuple(char_buckets))
+    entry = _LAST_PLAN.get(pkey)
     spec = None
     spec_bufs = None
     if entry is not None and entry[1] >= 1:
@@ -510,8 +513,11 @@ def fetch_batch(batch: DeviceBatch,
                                                            plan))
         bufs = jax.device_get(pack_fn(batch))    # round trip 2 (one sync)
     this_plan = (out_cap, vc, plan)
-    prev = _LAST_PLAN.get(skey)
-    _LAST_PLAN[skey] = (this_plan,
+    prev = _LAST_PLAN.get(pkey)
+    if len(_LAST_PLAN) > 256 and pkey not in _LAST_PLAN:
+        # bounded memo: drop the oldest entry (insertion order)
+        _LAST_PLAN.pop(next(iter(_LAST_PLAN)))
+    _LAST_PLAN[pkey] = (this_plan,
                         (prev[1] + 1) if prev and prev[0] == this_plan
                         else 0)
     # reconstruct the device-side wire-dtype-group order from the template

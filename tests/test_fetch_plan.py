@@ -102,9 +102,10 @@ def test_fetch_nested_round_trip():
 
 
 def test_fetch_speculation_validates_and_falls_back():
-    """Second fetch of a schema rides the speculative single-sync path;
-    a batch with different row counts / value ranges must NOT be served
-    by the stale plan (narrowing widths could silently wrap)."""
+    """Speculation arms only after the plan repeats: the THIRD fetch of
+    a stable shape rides the single-sync path, and a same-shape batch
+    whose value range changes the narrowing plan must discard the
+    speculative buffers (a stale narrower width would silently wrap)."""
     from spark_rapids_tpu.columnar import fetch as fetch_mod
 
     fetch_mod._LAST_PLAN.clear()
@@ -115,17 +116,24 @@ def test_fetch_speculation_validates_and_falls_back():
     rb = a.combine_chunks().to_batches()[0]
     dev = batch_to_device(rb, xp=jnp)
     one = batch_to_arrow(fetch_batch(dev))
-    two = batch_to_arrow(fetch_batch(dev))   # speculative path
-    assert one.equals(two)
+    two = batch_to_arrow(fetch_batch(dev))   # arms the plan (count 1)
+    (pkey, (plan0, cnt)), = fetch_mod._LAST_PLAN.items()
+    assert cnt == 1
+    three = batch_to_arrow(fetch_batch(dev))  # speculative single-sync
+    assert one.equals(two) and one.equals(three)
+    assert fetch_mod._LAST_PLAN[pkey][1] == 2
 
-    # same schema, wildly different range AND row count -> plan changes
-    b = pa.table({"k": pa.array(rng.integers(-(2**60), 2**60, 700)
-                                .astype(np.int64)),
-                  "s": pa.array(["x" * int(x) for x in
-                                 rng.integers(0, 40, 700)])})
-    rb2 = b.combine_chunks().to_batches()[0]
+    # SAME padded shapes (same schema key), different value range ->
+    # the narrowing plan widens; speculation must mispredict safely
+    wide = pa.table({
+        "k": pa.array(rng.integers(-(2**60), 2**60, 2000)
+                      .astype(np.int64)),
+        "s": pa.array([f"v{i%9}" for i in range(2000)])})
+    rb2 = wide.combine_chunks().to_batches()[0]
     dev2 = batch_to_device(rb2, xp=jnp)
-    got = batch_to_arrow(fetch_batch(dev2))
+    assert fetch_mod._schema_key(dev2) == pkey[0]
+    got = batch_to_arrow(fetch_batch(dev2))   # speculates, must discard
     want = batch_to_arrow(batch_to_device(rb2, xp=np))
     assert got.column("k").to_pylist() == want.column("k").to_pylist()
     assert got.column("s").to_pylist() == want.column("s").to_pylist()
+    assert fetch_mod._LAST_PLAN[pkey][1] == 0  # repeat count reset
