@@ -795,18 +795,128 @@ def _eval_concat_ws(e: ConcatWs, ctx: EvalContext):
 
 
 class SubstringIndex(Expression):
-    """substring_index(str, delim, count) — host-evaluated occurrence
-    scan (ref GpuSubstringIndex); registered with a host-fallback
-    reason like the regex family."""
+    """substring_index(str, delim, count) (ref GpuSubstringIndex).
+
+    Single-byte delimiters lower to a device occurrence scan; multi-byte
+    delimiters need non-overlapping forward search (a sequential
+    dependency) and stay on the host engine via tagging."""
 
     def __init__(self, child, delim, count):
         self.children = (child,)
         self.delim = delim
-        self.count = count
+        self.count = int(count)
 
     def data_type(self):
         return t.STRING
 
+    def delim_bytes(self) -> bytes:
+        """The ONE definition of the delimiter's byte form — the tag rule
+        and the evaluator both gate on its length, and divergence would
+        turn a graceful host fallback into a runtime error."""
+        return self.delim.encode() if isinstance(self.delim, str) \
+            else bytes(self.delim)
+
     def sql(self):
         return (f"substring_index({self.children[0].sql()}, "
                 f"'{self.delim}', {self.count})")
+
+
+@evaluator(SubstringIndex)
+def _eval_substring_index(e: SubstringIndex, ctx: EvalContext):
+    xp = ctx.xp
+    v = e.children[0].eval(ctx)
+    col = _string_input(ctx, v)
+    valid = col.validity if col.validity is not None else \
+        xp.ones((ctx.capacity,), dtype=bool)
+    delim = e.delim_bytes()
+    cnt = e.count
+    if xp is np:
+        # host engine: python string semantics match Spark's indexOf scan
+        out = []
+        offs = np.asarray(col.offsets)
+        chars = np.asarray(col.data)
+        vm = np.asarray(valid)
+        d = delim.decode("utf-8", "surrogateescape")
+        for i in range(ctx.capacity):
+            if not vm[i]:
+                out.append("")
+                continue
+            sv = bytes(chars[offs[i]:offs[i + 1]]).decode(
+                "utf-8", "surrogateescape")
+            if cnt == 0 or not d:
+                out.append("")
+            elif cnt > 0:
+                out.append(d.join(sv.split(d)[:cnt]))
+            else:
+                out.append(d.join(sv.split(d)[cnt:]))
+        lens = np.array([len(o.encode("utf-8", "surrogateescape"))
+                         for o in out], np.int32)
+        new_offs = np.concatenate([np.zeros(1, np.int32),
+                                   np.cumsum(lens, dtype=np.int32)])
+        buf = b"".join(o.encode("utf-8", "surrogateescape") for o in out)
+        cap_b = max(int(col.data.shape[0]), 1)
+        data = np.zeros((cap_b,), np.uint8)
+        data[:len(buf)] = np.frombuffer(buf, np.uint8)
+        return ColumnValue(DeviceColumn(t.STRING, data=data,
+                                        offsets=new_offs,
+                                        validity=valid))
+    if len(delim) != 1:
+        from .core import EvalError
+        raise EvalError("substring_index with multi-byte delimiter runs "
+                        "on the host engine (tagging keeps it off the "
+                        "device)")
+    from ..ops.scan import cumsum_fast as _cs
+    from ..ops.scan import fill_rows_from_starts
+    char_cap = int(col.data.shape[0])
+    cap = ctx.capacity
+    b_row0 = col.offsets[:-1]
+    b_row1 = col.offsets[1:]
+    pos = xp.arange(char_cap, dtype=xp.int32)
+    match = (col.data == np.uint8(delim[0])).astype(xp.int32)
+    cm = _cs(xp, match)                  # inclusive global match count
+    cmp_ = xp.concatenate([xp.zeros((1,), cm.dtype), cm])
+    base = cmp_[xp.clip(b_row0, 0, char_cap)]
+    total = cmp_[xp.clip(b_row1, 0, char_cap)] - base
+    if cnt == 0:
+        b0 = b_row0
+        b1 = b_row0
+    else:
+        q = xp.full((cap,), np.int32(cnt)) if cnt > 0 else \
+            (total + np.int32(cnt + 1)).astype(xp.int32)
+        # char -> row, then per-char occurrence ordinal within its row
+        spans = b_row1 - b_row0
+        crow = xp.clip(
+            fill_rows_from_starts(xp, b_row0.astype(xp.int32), spans > 0,
+                                  char_cap), 0, cap - 1)
+        occ = cm - base[crow]            # inclusive ordinal at match chars
+        want = q[crow]
+        hit = (match > 0) & (occ == want) & (pos < b_row1[crow]) & \
+            (pos >= b_row0[crow])
+        cand = xp.where(hit, pos, np.int32(2**31 - 1))
+        import jax
+        hitpos = jax.ops.segment_min(
+            cand, crow, num_segments=cap)    # int32 scatter (~free)
+        found = hitpos < np.int32(2**31 - 1)
+        if cnt > 0:
+            b0 = b_row0
+            b1 = xp.where(found, xp.clip(hitpos, 0, char_cap), b_row1)
+            b1 = xp.clip(b1, b_row0, b_row1)
+        else:
+            # q <= 0 means fewer occurrences than |cnt|: whole string
+            b0 = xp.where((q > 0) & found,
+                          xp.clip(hitpos + 1, 0, char_cap), b_row0)
+            b0 = xp.clip(b0, b_row0, b_row1)
+            b1 = b_row1
+    new_lens = (b1 - b0).astype(xp.int32)
+    new_offs = xp.concatenate([
+        xp.zeros((1,), xp.int32),
+        _cs(xp, xp.where(valid, new_lens, 0), dtype=xp.int32)])
+    q2 = xp.arange(char_cap, dtype=xp.int32)
+    row = xp.clip(fill_rows_from_starts(xp, new_offs[:-1].astype(xp.int32),
+                                        new_lens > 0, char_cap),
+                  0, cap - 1)
+    src = xp.clip(b0[row] + (q2 - new_offs[row]), 0, char_cap - 1)
+    chars = xp.where(q2 < new_offs[-1], col.data[src],
+                     xp.zeros((), xp.uint8))
+    return ColumnValue(DeviceColumn(t.STRING, data=chars,
+                                    offsets=new_offs, validity=valid))

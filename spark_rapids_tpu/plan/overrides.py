@@ -274,9 +274,12 @@ expr_rule(dte.DateAddInterval, T.DATE, "host-evaluated interval add",
           _tag_host_only("the calendar-interval type is not modeled on "
                          "device; interval arithmetic runs on the host "
                          "engine"))
-expr_rule(se.SubstringIndex, T.STRING, "host-evaluated substring_index",
-          _tag_host_only("delimiter-occurrence scanning runs on the "
-                         "host engine (byte-serial search)"))
+expr_rule(se.SubstringIndex, T.STRING,
+          "single-byte delimiters lower on device",
+          tag_fn=lambda m: m.will_not_work(
+              "substring_index with a multi-byte or empty delimiter "
+              "needs sequential non-overlapping search; host engine")
+          if len(m.expr.delim_bytes()) != 1 else None)
 
 expr_rule(coll.Size, T.INT)
 expr_rule(coll.ArrayContains, T.BOOLEAN,

@@ -41,7 +41,6 @@ def validate() -> List[str]:
         # host-only families are tagged off the device; their rules exist
         # so explain and docs state the reason
         "InputFileName", "DateFormatClass", "DateAddInterval",
-        "SubstringIndex",
     }
     from ..expr.collection import Generator
     for cls in EXPR_RULES:

@@ -144,3 +144,35 @@ def test_unix_timestamp_alias():
         _c(UnixTimestamp(col("ts").expr)).alias("u")))
     assert tpu.equals(cpu)
     assert tpu.column("u").to_pylist() == [0, 86_400, 1_600_000_000]
+
+
+def test_substring_index_device_and_host():
+    from spark_rapids_tpu.expr.strings import SubstringIndex
+    vals = ["a.b.c.d", "no-delim", "", ".lead", "trail.", "..",
+            None, "x.y"]
+    tbl = pa.table({"s": pa.array(vals)})
+
+    def build(df, cnt, delim="."):
+        return df.select(
+            _c(SubstringIndex(col("s").expr, delim, cnt)).alias("o"))
+
+    for cnt in (2, 1, 0, -1, -2, 5, -9):
+        tpu, cpu = _both(tbl, lambda df, c=cnt: build(df, c))
+        want = []
+        for sv in vals:
+            if sv is None:
+                want.append(None)
+            elif cnt == 0:
+                want.append("")
+            elif cnt > 0:
+                want.append(".".join(sv.split(".")[:cnt]))
+            else:
+                want.append(".".join(sv.split(".")[cnt:]))
+        assert tpu.column("o").to_pylist() == want, (cnt, tpu.to_pydict())
+        assert cpu.column("o").to_pylist() == want, (cnt, "cpu")
+
+    # multi-byte delimiter: tagged to the host engine, still correct
+    tbl2 = pa.table({"s": pa.array(["a::b::c", "q"])})
+    tpu2, cpu2 = _both(tbl2, lambda df: build(df, 1, delim="::"))
+    assert tpu2.column("o").to_pylist() == ["a", "q"]
+    assert tpu2.equals(cpu2)
