@@ -6,11 +6,17 @@
  * supported subtree with an exec that runs it inside the TPU engine's
  * sidecar process, splicing Arrow results back as InternalRows.
  *
- * Built by CI against Spark 3.3-3.5 (see bridge-jvm/README.md); the
- * engine's hermetic environment carries no Spark distribution, so this
- * source is validated by the fake-JVM protocol harness on that side
- * (tests/test_bridge.py) and by the pyspark-marked integration test
- * where pyspark exists (tests/test_bridge_pyspark.py).
+ * Built by CI against Spark 3.3-3.5 (see bridge-jvm/README.md and
+ * .github/workflows/bridge-jvm.yml); the engine's hermetic environment
+ * carries no Spark distribution, so this source is validated by the
+ * fake-JVM protocol harness on that side (tests/test_bridge.py), the
+ * golden-spec fixtures (tests/test_bridge_goldens.py ↔
+ * src/test/scala/.../SpecBuilderSuite.scala), and the pyspark-marked
+ * integration test where pyspark exists (tests/test_bridge_pyspark.py).
+ *
+ * Classes that need Spark's private[sql] Arrow machinery live in
+ * org.apache.spark.sql.tpubridge (TpuBridgeExec.scala), the same move
+ * the reference makes with its org.apache.spark.sql.rapids package.
  */
 package org.sparkrapids.tpu
 
@@ -21,19 +27,17 @@ import java.nio.charset.StandardCharsets
 import scala.collection.mutable.ArrayBuffer
 
 import org.apache.spark.api.plugin.{DriverPlugin, ExecutorPlugin, SparkPlugin}
-import org.apache.spark.rdd.RDD
 import org.apache.spark.sql.SparkSessionExtensions
-import org.apache.spark.sql.catalyst.InternalRow
 import org.apache.spark.sql.catalyst.expressions._
 import org.apache.spark.sql.catalyst.expressions.aggregate._
+import org.apache.spark.sql.catalyst.plans._
 import org.apache.spark.sql.catalyst.rules.Rule
 import org.apache.spark.sql.execution._
 import org.apache.spark.sql.execution.aggregate.HashAggregateExec
-import org.apache.spark.sql.execution.arrow.ArrowConverters
-import org.apache.spark.sql.execution.joins.BroadcastHashJoinExec
+import org.apache.spark.sql.execution.exchange.{BroadcastExchangeExec, ShuffleExchangeExec}
+import org.apache.spark.sql.execution.joins.{BroadcastHashJoinExec, ShuffledHashJoinExec, SortMergeJoinExec}
 import org.apache.spark.sql.execution.window.WindowExec
-import org.apache.spark.sql.types.StructType
-import org.apache.spark.sql.util.ArrowUtils
+import org.apache.spark.sql.tpubridge.TpuBridgeExec
 
 /** Entry point for --conf spark.sql.extensions=... */
 class TpuBridgeExtensions extends (SparkSessionExtensions => Unit) {
@@ -49,9 +53,9 @@ object TpuBridgeColumnarRule extends org.apache.spark.sql.execution.ColumnarRule
 /**
  * Replace the largest supported plan prefix with a TpuBridgeExec.  The
  * match walks top-down: at each node, collect the chain of spec-capable
- * operators (project/filter/aggregate/sort/limit/window/broadcast join)
- * whose expressions all translate; the first untranslatable node becomes
- * the bridge exec's child and executes on the CPU as usual.
+ * operators (project/filter/aggregate/sort/limit/window/join) whose
+ * expressions all translate; the first untranslatable node becomes the
+ * bridge exec's child and executes on the CPU as usual.
  */
 object TpuBridgeRule extends Rule[SparkPlan] {
   override def apply(plan: SparkPlan): SparkPlan = {
@@ -68,8 +72,29 @@ object TpuBridgeRule extends Rule[SparkPlan] {
 
 /** Catalyst -> JSON spec translation (mirrors bridge/spec.py). */
 object SpecBuilder {
-  private def json(s: String): String =
-    "\"" + s.replace("\\", "\\\\").replace("\"", "\\\"") + "\""
+  private[tpu] def json(s: String): String = {
+    val sb = new StringBuilder("\"")
+    s.foreach {
+      case '\\' => sb.append("\\\\")
+      case '"'  => sb.append("\\\"")
+      case c if c < 0x20 =>
+        // bare control chars are invalid JSON; \n, \t etc. included
+        sb.append(f"\\u${c.toInt}%04x")
+      case c => sb.append(c)
+    }
+    sb.append('"').toString
+  }
+
+  private def nary(op: String, children: Seq[Expression]): Option[String] = {
+    val cs = children.map(expr)
+    if (cs.exists(_.isEmpty)) None
+    else Some(s"""{"op": ${json(op)}, "children": [${cs.flatten.mkString(", ")}]}""")
+  }
+
+  // engine-supported cast targets (mirrors spec.py _parse_type coverage)
+  private val castable = Set(
+    "tinyint", "smallint", "int", "bigint", "float", "double", "string",
+    "boolean", "date", "timestamp")
 
   def expr(e: Expression): Option[String] = e match {
     case a: AttributeReference => Some(s"""{"col": ${json(a.name)}}""")
@@ -79,15 +104,21 @@ object SpecBuilder {
     case l: Literal =>
       val v = l.dataType.catalogString match {
         case "string" => json(l.value.toString)
-        case _        => l.value.toString
+        case "boolean" => l.value.toString
+        case "tinyint" | "smallint" | "int" | "bigint" | "float" |
+            "double" => l.value.toString
+        case _ => return None
       }
       Some(s"""{"lit": $v, "type": ${json(l.dataType.catalogString)}}""")
+    case c: Cast if castable(c.dataType.catalogString) =>
+      expr(c.child).map(cs =>
+        s"""{"op": "cast", "type": ${json(c.dataType.catalogString)}, "children": [$cs]}""")
     case b: BinaryOperator =>
       val op = b match {
         case _: EqualTo            => "eq"
         case _: LessThan           => "lt"
         case _: LessThanOrEqual    => "le"
-        case _: GreaterThan        => "gt"
+        case _: GreaterThan       => "gt"
         case _: GreaterThanOrEqual => "ge"
         case _: And                => "and"
         case _: Or                 => "or"
@@ -95,37 +126,274 @@ object SpecBuilder {
         case _: Subtract           => "sub"
         case _: Multiply           => "mul"
         case _: Divide             => "div"
+        case _: Remainder          => "mod"
         case _                     => return None
       }
-      for (l <- expr(b.left); r <- expr(b.right))
-        yield s"""{"op": ${json(op)}, "children": [$l, $r]}"""
-    case Not(EqualTo(l, r)) =>
-      for (ls <- expr(l); rs <- expr(r))
-        yield s"""{"op": "ne", "children": [$ls, $rs]}"""
-    case Not(c) => expr(c).map(cs => s"""{"op": "not", "children": [$cs]}""")
-    case IsNull(c) =>
-      expr(c).map(cs => s"""{"op": "isnull", "children": [$cs]}""")
-    case IsNotNull(c) =>
-      expr(c).map(cs => s"""{"op": "isnotnull", "children": [$cs]}""")
+      nary(op, Seq(b.left, b.right))
+    case Not(EqualTo(l, r)) => nary("ne", Seq(l, r))
+    case Not(c)             => nary("not", Seq(c))
+    case IsNull(c)          => nary("isnull", Seq(c))
+    case IsNotNull(c)       => nary("isnotnull", Seq(c))
+    case IsNaN(c)           => nary("isnan", Seq(c))
+    case a: Abs             => nary("abs", Seq(a.child))
+    case Coalesce(cs)       => nary("coalesce", cs)
+    case If(p, t, f)        => nary("if", Seq(p, t, f))
+    // --- string tier ------------------------------------------------------
+    case Upper(c)           => nary("upper", Seq(c))
+    case Lower(c)           => nary("lower", Seq(c))
+    case Length(c)          => nary("length", Seq(c))
+    case Substring(s, p, l) => nary("substr", Seq(s, p, l))
+    case Contains(l, r)     => nary("contains", Seq(l, r))
+    case StartsWith(l, r)   => nary("startswith", Seq(l, r))
+    case EndsWith(l, r)     => nary("endswith", Seq(l, r))
+    case Concat(cs)         => nary("concat", cs)
+    case t: StringTrim      => nary("trim", Seq(t.srcStr))
+    case t: StringTrimLeft  => nary("ltrim", Seq(t.srcStr))
+    case t: StringTrimRight => nary("rtrim", Seq(t.srcStr))
+    // --- datetime tier ----------------------------------------------------
+    case Year(c)       => nary("year", Seq(c))
+    case Month(c)      => nary("month", Seq(c))
+    case DayOfMonth(c) => nary("dayofmonth", Seq(c))
+    case Hour(c, _)    => nary("hour", Seq(c))
+    case Minute(c, _)  => nary("minute", Seq(c))
+    case Second(c, _)  => nary("second", Seq(c))
+    case DateDiff(l, r) => nary("datediff", Seq(l, r))
+    case DateAdd(l, r)  => nary("date_add", Seq(l, r))
+    case DateSub(l, r)  => nary("date_sub", Seq(l, r))
     case _ => None
   }
 
+  /** Complete-mode aggregate translation (final values). */
   private def aggFn(a: AggregateFunction): Option[(String, Option[Expression])] =
     a match {
-      case Sum(c, _)           => Some(("sum", Some(c)))
-      case Average(c, _)       => Some(("avg", Some(c)))
-      case Min(c)              => Some(("min", Some(c)))
-      case Max(c)              => Some(("max", Some(c)))
+      case s: Sum     => Some(("sum", Some(s.child)))
+      case a: Average => Some(("avg", Some(a.child)))
+      case m: Min     => Some(("min", Some(m.child)))
+      case m: Max     => Some(("max", Some(m.child)))
       case Count(Seq(Literal(1, _))) => Some(("count", None))
-      case Count(Seq(c))       => Some(("count", Some(c)))
-      case _                   => None
+      case Count(Seq(c))             => Some(("count", Some(c)))
+      case _                         => None
     }
+
+  /**
+   * Partial-mode aggregate translation: emit Spark's BUFFER schema (the
+   * columns a Final HashAggregateExec above the exchange expects), e.g.
+   * avg -> (sum: double, count: long).  One spec agg per buffer column,
+   * named after the buffer attribute.
+   */
+  private def partialAggs(ae: AggregateExpression): Option[Seq[String]] = {
+    if (ae.isDistinct || ae.filter.isDefined) return None
+    val bufs = ae.aggregateFunction.aggBufferAttributes
+    ae.aggregateFunction match {
+      case s: Sum if !s.dataType.catalogString.startsWith("decimal") =>
+        // buffer layout differs across versions (3.x non-ANSI: [sum]);
+        // translate the single-buffer layout only
+        if (bufs.length != 1) return None
+        val cast = s"""{"op": "cast", "type": ${json(s.dataType.catalogString)}, "children": [%s]}"""
+        expr(s.child).map(c => Seq(
+          s"""{"fn": "sum", "expr": ${cast.format(c)}, "name": ${json(bufs(0).name)}}"""))
+      case a: Average if !a.dataType.catalogString.startsWith("decimal") =>
+        if (bufs.length != 2) return None
+        expr(a.child).map { c =>
+          val sumT = bufs(0).dataType.catalogString
+          Seq(
+            s"""{"fn": "sum", "expr": {"op": "cast", "type": ${json(sumT)}, "children": [$c]}, "name": ${json(bufs(0).name)}}""",
+            s"""{"fn": "count", "expr": $c, "name": ${json(bufs(1).name)}}""")
+        }
+      case m: Min =>
+        expr(m.child).map(c => Seq(
+          s"""{"fn": "min", "expr": $c, "name": ${json(bufs(0).name)}}"""))
+      case m: Max =>
+        expr(m.child).map(c => Seq(
+          s"""{"fn": "max", "expr": $c, "name": ${json(bufs(0).name)}}"""))
+      case Count(Seq(Literal(1, _))) =>
+        Some(Seq(s"""{"fn": "count", "expr": null, "name": ${json(bufs(0).name)}}"""))
+      case Count(Seq(c)) =>
+        expr(c).map(cs => Seq(
+          s"""{"fn": "count", "expr": $cs, "name": ${json(bufs(0).name)}}"""))
+      case _ => None
+    }
+  }
+
+  private def joinHow(t: JoinType): Option[String] = t match {
+    case Inner     => Some("inner")
+    case LeftOuter => Some("left")
+    case FullOuter => Some("full")
+    case LeftSemi  => Some("left_semi")
+    case LeftAnti  => Some("left_anti")
+    case _         => None
+  }
+
+  /**
+   * Join keys -> spec fields.  Identically-named attribute pairs emit
+   * `"on": [names]` (USING semantics).  Differing names emit an equi
+   * `"condition"` — valid only when every key name resolves to exactly
+   * one side, so the engine's name-based key extraction cannot misbind.
+   */
+  private def joinKeys(leftKeys: Seq[Expression], rightKeys: Seq[Expression],
+                       left: SparkPlan, right: SparkPlan): Option[String] = {
+    val pairs = leftKeys.zip(rightKeys).map {
+      case (l: AttributeReference, r: AttributeReference) => Some((l, r))
+      case _ => None
+    }
+    if (pairs.exists(_.isEmpty)) return None
+    val ps = pairs.flatten
+    if (ps.forall { case (l, r) => l.name == r.name }) {
+      return Some(s""""on": [${ps.map(p => json(p._1.name)).mkString(", ")}]""")
+    }
+    val lNames = left.output.map(_.name).toSet
+    val rNames = right.output.map(_.name).toSet
+    val unambiguous = ps.forall { case (l, r) =>
+      !rNames.contains(l.name) && !lNames.contains(r.name)
+    }
+    if (!unambiguous) return None
+    val conds = ps.map { case (l, r) =>
+      s"""{"op": "eq", "children": [{"col": ${json(l.name)}}, {"col": ${json(r.name)}}]}"""
+    }
+    val cond = conds.reduceLeft((a, b) =>
+      s"""{"op": "and", "children": [$a, $b]}""")
+    Some(s""""condition": $cond""")
+  }
+
+  /** Default-frame check: the spec language carries no frame clause, so
+   *  only Spark's default frames translate (ranking functions force
+   *  ROWS UNBOUNDED..CURRENT; ordered aggregates default to RANGE
+   *  UNBOUNDED..CURRENT; unordered to the whole partition). */
+  private def defaultFrame(frame: Expression, hasOrder: Boolean): Boolean =
+    frame match {
+      case SpecifiedWindowFrame(RowFrame, UnboundedPreceding, CurrentRow) =>
+        true
+      case SpecifiedWindowFrame(RangeFrame, UnboundedPreceding, CurrentRow) =>
+        hasOrder
+      case SpecifiedWindowFrame(_, UnboundedPreceding, UnboundedFollowing) =>
+        !hasOrder
+      case UnspecifiedFrame => true
+      case _ => false
+    }
+
+  private def windowFn(e: Expression): Option[(String, Option[Expression], Option[Int])] =
+    e match {
+      case _: RowNumber => Some(("row_number", None, None))
+      case _: Rank      => Some(("rank", None, None))
+      case _: DenseRank => Some(("dense_rank", None, None))
+      case l: Lead => (l.offset, l.default) match {
+        case (Literal(o: Int, _), Literal(null, _)) =>
+          Some(("lead", Some(l.input), Some(o)))
+        case _ => None
+      }
+      case l: Lag => (l.offset, l.default) match {
+        case (Literal(o: Int, _), Literal(null, _)) =>
+          Some(("lag", Some(l.input), Some(-o)))
+        case _ => None
+      }
+      case ae: AggregateExpression =>
+        aggFn(ae.aggregateFunction)
+          .map { case (fn, c) => (fn, c, None) }
+      case _ => None
+    }
+
+  /** Window translation: one spec window op per distinct
+   *  (partitionBy, orderBy) group, in output order. */
+  private def windowOps(w: WindowExec): Option[List[String]] = {
+    case class Grp(part: Seq[Expression], order: Seq[SortOrder])
+    val grouped = scala.collection.mutable.LinkedHashMap
+      .empty[(Seq[String], Seq[String]), (Grp, ArrayBuffer[String])]
+    for (ne <- w.windowExpression) {
+      val (name, we) = ne match {
+        case Alias(we: WindowExpression, n) => (n, we)
+        case _ => return None
+      }
+      val spec = we.windowSpec
+      if (!defaultFrame(spec.frameSpecification, spec.orderSpec.nonEmpty)) {
+        return None
+      }
+      val fn = windowFn(we.windowFunction).getOrElse(return None)
+      val (fname, child, offset) = fn
+      val childJs = child match {
+        case Some(c) => expr(c).getOrElse(return None)
+        case None    => "null"
+      }
+      val off = offset.map(o => s""", "offset": $o""").getOrElse("")
+      val fjson =
+        s"""{"fn": ${json(fname)}, "expr": $childJs, "name": ${json(name)}$off}"""
+      val key = (spec.partitionSpec.map(_.sql), spec.orderSpec.map(_.sql))
+      grouped.getOrElseUpdate(
+        key, (Grp(spec.partitionSpec, spec.orderSpec), ArrayBuffer()))
+        ._2 += fjson
+    }
+    val ops = grouped.values.map { case (g, fns) =>
+      val parts = g.part.map(expr)
+      if (parts.exists(_.isEmpty)) return None
+      val orders = g.order.map { so =>
+        expr(so.child).map { e =>
+          val asc = so.direction == Ascending
+          val nf = so.nullOrdering == NullsFirst
+          s"""{"expr": $e, "ascending": $asc, "nullsFirst": $nf}"""
+        }
+      }
+      if (orders.exists(_.isEmpty)) return None
+      s"""{"op": "window", "partitionBy": [${parts.flatten.mkString(", ")}], """ +
+        s""""orderBy": [${orders.flatten.mkString(", ")}], """ +
+        s""""funcs": [${fns.mkString(", ")}]}"""
+    }
+    Some(ops.toList)
+  }
 
   /** Is this node (and its supported chain) fully translatable? */
   def supportedChain(p: SparkPlan): Boolean = build0(p).isDefined
 
   def build(p: SparkPlan): (String, SparkPlan, Seq[SparkPlan]) =
     build0(p).get
+
+  /** Strip the exchange under a shuffled join input: the sidecar joins
+   *  each stream partition against the WHOLE collected build side, so
+   *  co-partitioning is unnecessary (and the exchange would re-shuffle
+   *  rows the bridge ships anyway). */
+  private def stripExchange(p: SparkPlan): SparkPlan = p match {
+    case e: ShuffleExchangeExec => e.child
+    case e: BroadcastExchangeExec => e.child
+    case other => other
+  }
+
+  private def translateJoin(
+      joinType: JoinType, leftKeys: Seq[Expression],
+      rightKeys: Seq[Expression], condition: Option[Expression],
+      left: SparkPlan, right: SparkPlan,
+      extra: ArrayBuffer[SparkPlan],
+      walk: SparkPlan => Option[(List[String], SparkPlan)])
+      : Option[(List[String], SparkPlan)] = {
+    val how = joinHow(joinType).getOrElse(return None)
+    // residual conditions only on inner joins (engine post-filters)
+    if (condition.isDefined && how != "inner") return None
+    val outNames = left.output.map(_.name) ++ (how match {
+      case "left_semi" | "left_anti" => Nil
+      case _ => right.output.map(_.name)
+    })
+    if (outNames.distinct.length != outNames.length) return None
+    val keys = joinKeys(leftKeys, rightKeys, left, right)
+      .getOrElse(return None)
+    val onStyle = keys.startsWith("\"on\"")
+    if (onStyle && condition.isDefined) {
+      // USING-style keys share names on both sides, so a residual
+      // cannot reference them unambiguously — fall back
+      return None
+    }
+    val keyField = condition match {
+      case Some(c) =>
+        // merge the equi condition with the residual
+        val res = expr(c).getOrElse(return None)
+        val eq = keys.stripPrefix("\"condition\": ")
+        s""""condition": {"op": "and", "children": [$eq, $res]}"""
+      case None => keys
+    }
+    val buildPlan = stripExchange(right)
+    extra += buildPlan
+    val idx = extra.size
+    walk(stripExchange(left)).map { case (ops, leaf) =>
+      (s"""{"op": "join", "right": $idx, "how": ${json(how)}, $keyField}""" :: ops,
+        leaf)
+    }
+  }
 
   private def build0(p: SparkPlan): Option[(String, SparkPlan, Seq[SparkPlan])] = {
     val extra = ArrayBuffer[SparkPlan]()
@@ -145,10 +413,8 @@ object SpecBuilder {
             (s"""{"op": "filter", "condition": $c}""" :: ops, leaf)
           }
         }
-      case agg: HashAggregateExec if agg.aggregateExpressions.forall(
-          // Complete only: a Partial node must emit Spark's buffer
-          // schema (e.g. avg -> (sum, count)), not final values
-          ae => ae.mode == Complete) =>
+      case agg: HashAggregateExec
+          if agg.aggregateExpressions.forall(_.mode == Complete) =>
         val groups = agg.groupingExpressions.map(expr)
         val aggs = agg.aggregateExpressions.map { ae =>
           aggFn(ae.aggregateFunction).flatMap { case (fn, childE) =>
@@ -160,6 +426,16 @@ object SpecBuilder {
         if (groups.exists(_.isEmpty) || aggs.exists(_.isEmpty)) None
         else walk(agg.child).map { case (ops, leaf) =>
           (s"""{"op": "aggregate", "groupBy": [${groups.flatten.mkString(", ")}], "aggs": [${aggs.flatten.mkString(", ")}]}""" :: ops, leaf)
+        }
+      case agg: HashAggregateExec if agg.aggregateExpressions.nonEmpty &&
+          agg.aggregateExpressions.forall(_.mode == Partial) =>
+        // partial pushdown: emit the buffer schema the Final agg above
+        // the exchange expects (ref aggregate.scala partial mode)
+        val groups = agg.groupingExpressions.map(expr)
+        val aggs = agg.aggregateExpressions.map(partialAggs)
+        if (groups.exists(_.isEmpty) || aggs.exists(_.isEmpty)) None
+        else walk(agg.child).map { case (ops, leaf) =>
+          (s"""{"op": "aggregate", "groupBy": [${groups.flatten.mkString(", ")}], "aggs": [${aggs.flatten.flatten.mkString(", ")}]}""" :: ops, leaf)
         }
       case SortExec(orders, true, child, _) =>
         val os = orders.map { so =>
@@ -173,39 +449,24 @@ object SpecBuilder {
         else walk(child).map { case (ops, leaf) =>
           (s"""{"op": "sort", "orders": [${os.flatten.mkString(", ")}]}""" :: ops, leaf)
         }
+      case w: WindowExec =>
+        windowOps(w).flatMap { wops =>
+          walk(w.child).map { case (ops, leaf) => (wops ::: ops, leaf) }
+        }
       case j: BroadcastHashJoinExec
-          if j.condition.isEmpty &&
-            j.buildSide == org.apache.spark.sql.catalyst.optimizer.BuildRight =>
-        // engine join-type names differ from JoinType.sql
-        val how = j.joinType match {
-          case org.apache.spark.sql.catalyst.plans.Inner     => Some("inner")
-          case org.apache.spark.sql.catalyst.plans.LeftOuter => Some("left")
-          case org.apache.spark.sql.catalyst.plans.FullOuter => Some("full")
-          case org.apache.spark.sql.catalyst.plans.LeftSemi  => Some("left_semi")
-          case org.apache.spark.sql.catalyst.plans.LeftAnti  => Some("left_anti")
-          case _                                             => None
-        }
-        val keys = j.leftKeys.zip(j.rightKeys).map {
-          case (l: AttributeReference, r: AttributeReference)
-              if l.name == r.name => Some(json(l.name))
-          case _ => None
-        }
-        if (keys.exists(_.isEmpty) || how.isEmpty) None
-        else {
-          // collect the build side BELOW the broadcast exchange —
-          // BroadcastExchangeExec throws on the execute() code path
-          val buildPlan = j.right match {
-            case b: org.apache.spark.sql.execution.exchange.BroadcastExchangeExec =>
-              b.child
-            case other => other
-          }
-          extra += buildPlan
-          val idx = extra.size
-          walk(j.left).map { case (ops, leaf) =>
-            (s"""{"op": "join", "right": $idx, "how": "${how.get}", "on": [${keys.flatten.mkString(", ")}]}""" :: ops, leaf)
-          }
-        }
-      case w: WindowExec => None // window translation: follow-up; spec carries it
+          if j.buildSide == org.apache.spark.sql.catalyst.optimizer.BuildRight =>
+        translateJoin(j.joinType, j.leftKeys, j.rightKeys, j.condition,
+          j.left, j.right, extra, walk)
+      case j: ShuffledHashJoinExec
+          if j.buildSide == org.apache.spark.sql.catalyst.optimizer.BuildRight =>
+        translateJoin(j.joinType, j.leftKeys, j.rightKeys, j.condition,
+          j.left, j.right, extra, walk)
+      case j: SortMergeJoinExec =>
+        // the engine replaces sort-merge with hash joins (like the
+        // reference's replaceSortMergeJoin); input sort order is not
+        // required by the sidecar stage
+        translateJoin(j.joinType, j.leftKeys, j.rightKeys, j.condition,
+          j.left, j.right, extra, walk)
       case leaf => Some((Nil, leaf))
     }
 
@@ -224,64 +485,6 @@ object SpecBuilder {
         Some((spec, leaf, extra.toSeq))
       }
     }
-  }
-}
-
-/**
- * Executes `child` normally, ships each partition (plus the collected
- * extra-input plans, broadcast to every task) through the sidecar
- * protocol, and returns the sidecar's Arrow result rows.
- */
-case class TpuBridgeExec(
-    output: Seq[Attribute],
-    spec: String,
-    child: SparkPlan,
-    extraInputs: Seq[SparkPlan]) extends UnaryExecNode {
-
-  override protected def doExecute(): RDD[InternalRow] = {
-    val childSchema = child.schema
-    val outSchema = StructType.fromAttributes(output)
-    val timeZone = conf.sessionLocalTimeZone
-    val port = conf.getConfString("spark.tpu.bridge.port",
-      TpuBridgeSidecar.port.toString).toInt
-    val specStr = spec
-    // extra inputs (join builds) are small broadcast-side plans:
-    // collect them once on the driver as Arrow payloads
-    val extras: Seq[Array[Byte]] = extraInputs.map { p =>
-      ArrowWire.planToIpc(p, timeZone)
-    }
-    val extrasBc = sparkContext.broadcast(extras)
-    child.execute().mapPartitionsInternal { rows =>
-      val ipc = ArrowWire.rowsToIpc(rows, childSchema, timeZone)
-      val result = SidecarClient.executeStage(
-        port, specStr, ipc +: extrasBc.value)
-      ArrowWire.ipcToRows(result, outSchema, timeZone)
-    }
-  }
-
-  override protected def withNewChildInternal(newChild: SparkPlan): SparkPlan =
-    copy(child = newChild)
-}
-
-/** Arrow IPC helpers over Spark's ArrowConverters. */
-object ArrowWire {
-  def rowsToIpc(rows: Iterator[InternalRow], schema: StructType,
-                timeZone: String): Array[Byte] = {
-    val batches = ArrowConverters.toBatchIterator(
-      rows, schema, Int.MaxValue, timeZone, org.apache.spark.TaskContext.get())
-    // toBatchIterator yields record-batch payloads; frame them as one
-    // IPC stream with the schema header
-    ArrowConverters.toArrowStream(schema, batches, timeZone)
-  }
-
-  def planToIpc(p: SparkPlan, timeZone: String): Array[Byte] = {
-    val rows = p.executeCollect().iterator
-    rowsToIpc(rows, p.schema, timeZone)
-  }
-
-  def ipcToRows(ipc: Array[Byte], schema: StructType,
-                timeZone: String): Iterator[InternalRow] = {
-    ArrowConverters.fromArrowStream(ipc, schema, timeZone)
   }
 }
 

@@ -19,7 +19,7 @@ import pyarrow as pa
 
 from .. import config as cfg
 from ..config import RapidsConf
-from ..exec.base import ExecContext
+from ..exec.base import ExecContext, SpeculativeSizingMiss
 from ..plan import logical as L
 from ..plan.overrides import TpuOverrides
 from ..plan.planner import plan as plan_physical
@@ -178,7 +178,16 @@ class TpuSession:
             cat.debug = True
             before = {b_id for b_id, *_ in cat.leak_report()}
         try:
-            result = final_plan.execute_collect(ctx)
+            try:
+                result = final_plan.execute_collect(ctx)
+            except SpeculativeSizingMiss:
+                # a capacity guess undershot (guard came back false):
+                # nothing was surfaced — re-execute with exact sizing
+                self.release_plan_shuffles(final_plan)
+                final_plan = self.prepare_plan(lp)
+                ctx = ExecContext(self.conf)
+                ctx.task_context["no_speculation"] = True
+                result = final_plan.execute_collect(ctx)
         except BaseException:
             # an aborted query routinely strands buffers; the original
             # error must surface, not a misleading leak report

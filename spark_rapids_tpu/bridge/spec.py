@@ -51,7 +51,11 @@ _AGG_FNS = ("sum", "count", "avg", "min", "max", "first", "last")
 def expr_from_spec(spec: Dict):
     """JSON expression tree -> engine expression."""
     from ..expr import arithmetic as ar
+    from ..expr import conditional as cond
+    from ..expr import datetime_expr as dte
     from ..expr import predicates as pr
+    from ..expr import strings as se
+    from ..expr.cast import Cast
     from ..expr.core import AttributeReference, Literal
     if "col" in spec:
         return AttributeReference(spec["col"])
@@ -66,10 +70,27 @@ def expr_from_spec(spec: Dict):
         "gt": pr.GreaterThan, "ge": pr.GreaterThanOrEqual,
         "and": pr.And, "or": pr.Or,
         "add": ar.Add, "sub": ar.Subtract, "mul": ar.Multiply,
-        "div": ar.Divide,
+        "div": ar.Divide, "mod": ar.Remainder,
+        # string tier (Scala SpecBuilder's string cases)
+        "upper": se.Upper, "lower": se.Lower, "length": se.Length,
+        "substr": se.Substring, "concat": se.Concat, "trim": se.Trim,
+        "ltrim": se.TrimLeft, "rtrim": se.TrimRight,
+        "contains": se.Contains, "startswith": se.StartsWith,
+        "endswith": se.EndsWith,
+        # datetime tier
+        "year": dte.Year, "month": dte.Month,
+        "dayofmonth": dte.DayOfMonth, "hour": dte.Hour,
+        "minute": dte.Minute, "second": dte.Second,
+        "datediff": dte.DateDiff, "date_add": dte.DateAdd,
+        "date_sub": dte.DateSub,
+        # misc
+        "abs": ar.Abs, "coalesce": cond.Coalesce, "if": cond.If,
+        "isnan": pr.IsNaN,
     }
     if op in table:
         return table[op](*kids)
+    if op == "cast":
+        return Cast(kids[0], _parse_type(spec["type"]))
     if op == "ne":
         return pr.Not(pr.EqualTo(*kids))
     if op == "not":

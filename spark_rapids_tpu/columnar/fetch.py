@@ -171,13 +171,16 @@ def _narrow_min(lane, live_n):
 
 
 def _make_sizes_fn():
-    def sizes(batch: DeviceBatch):
+    def sizes(batch: DeviceBatch, extras=()):
         n = jnp.asarray(batch.num_rows).astype(jnp.int64)
         parts = [n]
         for col in batch.columns:
             parts += _var_sizes(col, jnp.asarray(batch.num_rows))
         for col in batch.columns:
             parts += _lane_stats(col, jnp.asarray(batch.num_rows))
+        # ride-along scalars (speculation guards): verified by the caller
+        # from the same transfer, so deferred checks cost no extra trip
+        parts += [jnp.asarray(e).astype(jnp.int64) for e in extras]
         return jnp.stack(parts)
     return sizes
 
@@ -443,17 +446,26 @@ _LAST_PLAN: dict = {}
 def fetch_batch(batch: DeviceBatch,
                 row_buckets: Sequence[int] = DEFAULT_ROW_BUCKETS,
                 char_buckets: Sequence[int] = DEFAULT_CHAR_BUCKETS,
-                ) -> DeviceBatch:
+                extra_scalars: Sequence = ()):
     """Bring a device batch to host as numpy-backed DeviceBatch in two
     round trips (ONE when the speculative plan validates), transferring
     only bucket_for(num_rows) rows per lane and only
-    information-carrying bytes per lane (see module doc)."""
+    information-carrying bytes per lane (see module doc).
+
+    `extra_scalars` (device scalars, e.g. deferred speculation guards)
+    ride the sizes transfer; when given, returns (batch, extras_array)."""
+    n_extra = len(extra_scalars)
     if not batch_is_device(batch):
         # already host-side: just normalize num_rows to a python int
-        return DeviceBatch(batch.columns, int(batch.num_rows), batch.names)
+        out = DeviceBatch(batch.columns, int(batch.num_rows), batch.names)
+        if n_extra:
+            vals = np.asarray([int(np.asarray(e)) for e in extra_scalars])
+            return out, vals
+        return out
     from ..exec.base import process_jit
     skey = _schema_key(batch)
-    sizes_fn = process_jit(("fetch_sizes", skey), _make_sizes_fn)
+    sizes_fn = process_jit(("fetch_sizes", skey, n_extra), _make_sizes_fn)
+    extras_t = tuple(extra_scalars)
     # plan memo key includes the bucket ladders: a caller alternating
     # bucket configs for one schema must not arm doomed speculation
     pkey = (skey, tuple(row_buckets), tuple(char_buckets))
@@ -469,13 +481,16 @@ def fetch_batch(batch: DeviceBatch,
         spec_fn = process_jit(("fetch_pack", skey, s_cap, s_vc, s_plan),
                               lambda: _make_shrink_pack_fn(s_cap, s_vc,
                                                            s_plan))
-        sizes_dev = sizes_fn(batch)
+        sizes_dev = sizes_fn(batch, extras_t)
         spec_out = spec_fn(batch)
         fetched = jax.device_get((sizes_dev,) + tuple(spec_out))  # 1 sync
         sizes = np.asarray(fetched[0])
         spec_bufs = fetched[1:]
     else:
-        sizes = np.asarray(sizes_fn(batch))      # round trip 1 (+ barrier)
+        sizes = np.asarray(sizes_fn(batch, extras_t))  # round trip 1
+    extra_vals = sizes[len(sizes) - n_extra:] if n_extra else None
+    if n_extra:
+        sizes = sizes[:len(sizes) - n_extra]
     n = int(sizes[0])
     out_cap = bucket_for(n, row_buckets)
     # decode var sizes in walk order -> buckets (char lanes use char
@@ -538,4 +553,5 @@ def fetch_batch(batch: DeviceBatch,
     mins_it = iter(mins)
     cols = [_unpack_column(c, rd, out_cap, caps_it, plan_it, mins_it, n)
             for c in batch.columns]
-    return DeviceBatch(cols, n, batch.names)
+    out = DeviceBatch(cols, n, batch.names)
+    return (out, extra_vals) if n_extra else out

@@ -278,6 +278,39 @@ SHUFFLE_TRANSPORT = conf("spark.rapids.shuffle.transport").string() \
     .check_values(["ici", "tcp", "none"]) \
     .create_with_default("none")
 
+SINGLE_CHIP_FUSE = conf("spark.rapids.tpu.singleChipFuse").string() \
+    .doc("Collapse multi-partition exchange stages into one fused program "
+         "when the process drives a single chip: partial->exchange->final "
+         "aggregates, co-partitioned shuffled joins, range-partitioned "
+         "global sorts and hash-partitioned windows all absorb their "
+         "exchanges (an N-partition exchange otherwise runs N per-"
+         "partition programs SERIALLY on one chip, paying N program "
+         "floors for parallelism that does not exist).  'auto' = when "
+         "exactly one device is visible; 'on' / 'off' force it.  The "
+         "multi-chip analog is the ICI transport "
+         "(spark.rapids.shuffle.transport=ici).") \
+    .check_values(["auto", "on", "off"]) \
+    .create_with_default("auto")
+
+JOIN_SPECULATIVE_SIZING = conf(
+    "spark.rapids.tpu.join.speculativeSizing").boolean() \
+    .doc("Fuse a hash join's count and expand phases into ONE program by "
+         "guessing the output capacity (the probe side's capacity — exact "
+         "whenever no probe row matches more than one build row).  The "
+         "guess is validated by a deferred device guard that rides the "
+         "result fetch, so the common case pays ZERO sizing round trips; "
+         "a miss re-executes the query with exact sizing.  Flat (non-"
+         "string) schemas and inner/left joins only.") \
+    .create_with_default(True)
+
+HOST_ASSISTED_WRITE = conf("spark.rapids.sql.write.hostAssisted").boolean() \
+    .doc("When a write's plan is only row filtering/column pruning over a "
+         "source whose bytes already live on the host (in-memory tables, "
+         "file scans), fetch just the boolean keep-mask from the device "
+         "(bit-packed) and apply it to the host copy, instead of pulling "
+         "the full filtered payload back across the interconnect.") \
+    .create_with_default(True)
+
 PYTHON_WORKER_ENABLED = conf("spark.rapids.sql.python.worker.enabled").boolean() \
     .doc("Run Python/pandas UDFs in out-of-process Arrow-IPC workers "
          "(crash containment + no GIL/heap contention with the engine, "

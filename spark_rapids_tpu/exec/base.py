@@ -339,12 +339,57 @@ class MetricTimer:
             self._ann = None
 
 
+class SpeculativeSizingMiss(RuntimeError):
+    """A deferred speculation guard came back false: some operator's
+    capacity guess undershot and its output was truncated.  The session
+    re-executes the query with speculation disabled (results built on a
+    missed guess are never surfaced)."""
+
+
+import itertools as _itertools
+
+_CTX_IDS = _itertools.count()
+
+
 class ExecContext:
     """Per-query execution context: conf + memory/semaphore hooks."""
 
     def __init__(self, conf: Optional[RapidsConf] = None):
         self.conf = conf or RapidsConf()
         self.task_context: Dict = {}
+        # process-unique id: memo keys must never alias a recycled id()
+        # of a dead context (e.g. IciExchangeExec's shard memo)
+        self.uid = next(_CTX_IDS)
+        # deferred speculation guards: device bool scalars that must ALL
+        # be true for surfaced results to be valid.  They ride along with
+        # the next batch fetch (zero extra round trips) and are verified
+        # before data leaves the engine.
+        self.spec_guards: List = []
+
+    @property
+    def speculation_enabled(self) -> bool:
+        return not self.task_context.get("no_speculation", False)
+
+    def add_spec_guard(self, guard) -> None:
+        self.spec_guards.append(guard)
+
+    def drain_spec_guards(self) -> List:
+        g, self.spec_guards = self.spec_guards, []
+        return g
+
+    def verify_spec_guards(self) -> None:
+        """Force any still-pending guards to host (one tiny transfer) and
+        raise if any failed — the backstop for plans whose last fetch
+        happened before the final guard was registered (e.g. early-exit
+        limits)."""
+        g = self.drain_spec_guards()
+        if not g:
+            return
+        vals = np.asarray(jnp.stack([jnp.asarray(x) for x in g]))
+        if not vals.all():
+            raise SpeculativeSizingMiss(
+                f"{int((~vals.astype(bool)).sum())} speculation guard(s) "
+                "failed")
 
     @property
     def capacity_buckets(self):
@@ -421,6 +466,7 @@ class Exec:
                         out.append(rb)
             finally:
                 sem.release_if_necessary(pid)
+        ctx.verify_spec_guards()
         from ..columnar.interop import to_arrow_schema
         schema = to_arrow_schema(self.output_names, self.output_types)
         if not out:
@@ -521,7 +567,16 @@ class DeviceToHostExec(Exec):
         from ..columnar.fetch import fetch_batch
         for b in self.children[0].execute_partition(pid, ctx):
             with MetricTimer(self.metrics[OP_TIME]):
-                out = fetch_batch(b)
+                guards = ctx.drain_spec_guards()
+                if guards:
+                    # speculation guards ride the batch's own sizes fetch
+                    # — verification costs zero extra round trips
+                    out, gvals = fetch_batch(b, extra_scalars=guards)
+                    if not all(int(v) for v in gvals):
+                        raise SpeculativeSizingMiss(
+                            "join capacity guess undershot")
+                else:
+                    out = fetch_batch(b)
                 self.metrics[NUM_OUTPUT_ROWS] += int(out.num_rows)
                 self.metrics[NUM_OUTPUT_BATCHES] += 1
                 yield out

@@ -473,9 +473,15 @@ class CoalesceBatchesExec(Exec):
         pending_rows = 0
         target = self.target_rows or (1 << 22)
         for b in self.children[0].execute_partition(pid, ctx):
-            n = int(b.num_rows)
-            if n == 0:
-                continue
+            if isinstance(b.num_rows, (int, np.integer)):
+                n = int(b.num_rows)
+                if n == 0:
+                    continue
+            else:
+                # device-resident row count (jitted producer / speculative
+                # join): forcing it to host costs a tunnel round trip per
+                # batch — account by capacity and keep the pipeline async
+                n = b.capacity
             pending.append(b)
             pending_rows += n
             if not self.require_single_batch and pending_rows >= target:

@@ -234,9 +234,41 @@ class HashJoinExec(Exec):
         return DeviceBatch(lcols + list(compacted.columns), n,
                            self.output_names)
 
+    # --- speculative sizing: count+expand fused, zero sizing syncs ----------
+    def _spec_supported(self, build: Batch, probe: Batch) -> bool:
+        """Speculation needs a capacity guess that is usually right and a
+        truncation that a single guard detects: flat fixed-width lanes
+        (span columns would need char-cap guesses too) and join types
+        whose output rides the (probe, build) gather maps only."""
+        if self.how not in ("inner", "left"):
+            return False
+        def flat(c):
+            return c.offsets is None and c.data_hi is None and \
+                not c.children
+        return all(flat(c) for c in probe.columns) and \
+            all(flat(c) for c in build.columns)
+
+    def _spec_join(self, build: Batch, probe: Batch, out_cap: int):
+        """One fused program: count, expand at the guessed capacity, and
+        the guard `total <= out_cap` (validated later from the result
+        fetch — a miss means truncated output, never surfaced)."""
+        order, lo, counts, sizes, _ = self._count(jnp, build, probe)
+        zeros_p = [0] * len(probe.columns)
+        zeros_b = [0] * len(build.columns)
+        out = self._expand(jnp, build, probe, order, lo, counts, out_cap,
+                           zeros_p, zeros_b)
+        if self._bound_condition is not None and self.how == "inner":
+            pctx = EvalContext(jnp, out)
+            out = apply_filter(jnp, out, self._bound_condition.eval(pctx),
+                               self.output_names)
+        return out, sizes[0] <= np.int64(out_cap)
+
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        from .. import config as cfg
         xp = self.xp
         on_tpu = self.placement == TPU
+        speculate = (on_tpu and ctx.speculation_enabled and
+                     ctx.conf.get(cfg.JOIN_SPECULATIVE_SIZING))
         right = self.children[1]
         build_batches = []
         if self.colocated:
@@ -257,6 +289,23 @@ class HashJoinExec(Exec):
             if len(build_batches) > 1 else build_batches[0]
         matched_acc = None
         for probe in self.children[0].execute_partition(pid, ctx):
+            if speculate and self._spec_supported(build, probe):
+                # guess: output rows <= probe capacity (exact when build
+                # keys are unique — the FK->PK case); the deferred guard
+                # rides the result fetch, so the sizing round trip that
+                # serializes every other join disappears entirely
+                out_cap = int(probe.capacity)
+                with MetricTimer(self.metrics[OP_TIME]):
+                    fn = process_jit(
+                        self._jit_key + ("spec", out_cap),
+                        lambda: lambda b, p: self._spec_join(b, p, out_cap))
+                    out, guard = fn(build, probe)
+                    ctx.add_spec_guard(guard)
+                    maybe_sync(out)
+                self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+                self.metrics[NUM_OUTPUT_BATCHES] += 1
+                yield out
+                continue
             with MetricTimer(self.metrics[OP_TIME]):
                 if on_tpu:
                     (order, lo, counts, sizes,
@@ -284,6 +333,12 @@ class HashJoinExec(Exec):
                     pass
                 sizes = np.asarray(sizes)          # one round trip
                 ntotal = int(sizes[0])
+                if ntotal >= (1 << 31):
+                    # expand_pairs builds pair offsets in int32; a wrap
+                    # would silently corrupt gather indices
+                    raise RuntimeError(
+                        f"join expansion of {ntotal} rows exceeds the "
+                        f"2^31-1 per-batch capacity; split the inputs")
                 pbytes = sizes[1:1 + len(probe.columns)]
                 bbytes = sizes[1 + len(probe.columns):]
                 out_cap = bucket_for(max(ntotal, 1), DEFAULT_ROW_BUCKETS)

@@ -35,6 +35,11 @@ class NaNvl(Expression):
         self.children = (left, right)
 
     def data_type(self):
+        # Spark's nanvl(float, float) is float; anything else widens to
+        # double (ref GpuNaNvl type signature)
+        if all(isinstance(c.data_type(), t.FloatType)
+               for c in self.children):
+            return t.FLOAT
         return t.DOUBLE
 
     def sql(self):
@@ -51,10 +56,12 @@ def _eval_nanvl(e: NaNvl, ctx: EvalContext):
     use_b = xp.isnan(ac.col.data)
     av = _col_validity(ctx, ac.col)
     bv = _col_validity(ctx, bc.col)
-    data = xp.where(use_b, bc.col.data.astype(np.float64),
-                    ac.col.data.astype(np.float64))
+    out_t = e.data_type()
+    np_t = np.float32 if isinstance(out_t, t.FloatType) else np.float64
+    data = xp.where(use_b, bc.col.data.astype(np_t),
+                    ac.col.data.astype(np_t))
     valid = xp.where(use_b, bv, av)
-    return make_column(ctx, t.DOUBLE, data, valid)
+    return make_column(ctx, out_t, data, valid)
 
 
 class InSet(Expression):

@@ -19,6 +19,64 @@ import pyarrow.orc as paorc
 import pyarrow.parquet as papq
 
 
+def _host_assisted_table(df) -> Optional[pa.Table]:
+    """Write-side transfer elision: when the plan is only row filtering /
+    column pruning over a source whose bytes already exist on the host
+    (in-memory table, file scan), fetch just the boolean keep-mask from
+    the device (bit-packed by the fetch plan) and apply it to the host
+    copy — instead of round-tripping the full filtered payload over the
+    interconnect (the role GDS plays for the reference's write path:
+    never moving bytes that don't have to move, ref
+    GpuParquetFileFormat.scala).  Returns None when the plan computes
+    anything beyond selection, so the caller falls back to collect()."""
+    from ..expr.core import Alias, AttributeReference
+    from ..expr.predicates import And
+    from ..plan import logical as L
+
+    lp = df._lp
+    conditions = []
+    node = lp
+    while True:
+        if isinstance(node, L.Project):
+            if not all(isinstance(e, AttributeReference)
+                       for e in node.exprs):
+                return None
+            node = node.children[0]
+        elif isinstance(node, L.Filter):
+            conditions.append(node.condition)
+            node = node.children[0]
+        elif isinstance(node, (L.LocalRelation, L.FileRelation)):
+            break
+        else:
+            return None
+
+    if isinstance(node, L.LocalRelation):
+        host = node.table
+    else:
+        # decode on host through the CPU scan path (no pushed filters,
+        # so the row set matches the unfiltered mask plan below)
+        from ..exec.base import ExecContext
+        from .scan import make_scan_exec
+        rel = L.FileRelation(node.fmt, node.paths, node._names,
+                             node._types, node.options)
+        host = make_scan_exec(rel, df.session.conf).execute_collect(
+            ExecContext(df.session.conf))
+
+    if conditions:
+        combined = conditions[0]
+        for c in conditions[1:]:
+            combined = And(combined, c)
+        mask_lp = L.Project([Alias(combined, "__keep__")], node)
+        mask = df.session.execute(mask_lp).column("__keep__")
+        # Spark's filter keeps only TRUE rows; arrow's default
+        # null_selection_behavior='drop' matches
+        host = host.filter(mask)
+    names = lp.schema()[0]
+    if list(host.schema.names) != names:
+        host = host.select(names)
+    return host
+
+
 class WriteStatsTracker:
     """Per-job write statistics (ref BasicColumnarWriteStatsTracker)."""
 
@@ -96,10 +154,19 @@ class DataFrameWriter:
             pacsv.write_csv(table, out)
         self.stats.file_written(out, table.num_rows)
 
+    def _collect(self) -> pa.Table:
+        from .. import config as cfg
+        conf = self.df.session.conf
+        if conf.sql_enabled and conf.get(cfg.HOST_ASSISTED_WRITE):
+            table = _host_assisted_table(self.df)
+            if table is not None:
+                return table
+        return self.df.collect()
+
     def _write(self, path: str, fmt: str):
         if not self._prepare_dir(path):
             return
-        table = self.df.collect()
+        table = self._collect()
         if not self._partition_by:
             self._write_one(table, path, fmt)
             return

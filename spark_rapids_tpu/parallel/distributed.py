@@ -475,6 +475,10 @@ class DistributedHashJoin:
          matched) = self._compiled_count()(ls, rs)
         sz = np.asarray(sizes)                       # one round trip
         ncols_l = len(self._join.children[0].output_names)
+        if int(sz[:, 0].max()) >= (1 << 31):
+            raise RuntimeError(
+                f"join expansion of {int(sz[:, 0].max())} rows per shard "
+                f"exceeds the 2^31-1 per-batch capacity")
         out_cap = bucket_for(max(int(sz[:, 0].max()), 1),
                              DEFAULT_ROW_BUCKETS)
         pb = sz[:, 1:1 + ncols_l].max(axis=0)

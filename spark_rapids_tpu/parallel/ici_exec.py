@@ -333,7 +333,7 @@ class IciExchangeExec(Exec):
         return f"IciExchange({self.num_partitions} chips, all_to_all)"
 
     def _shards(self, ctx):
-        key = id(ctx)
+        key = ctx.uid
         with self._memo_lock:
             hit = self._memo.get(key)
             if hit is not None:

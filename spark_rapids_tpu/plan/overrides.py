@@ -653,12 +653,64 @@ EXEC_TAGS: Dict[Type[eb.Exec], Callable] = {}
 EXEC_CONVERTS: Dict[Type[eb.Exec], Callable] = {}
 
 
+def _fuse_single_chip(conf: cfg.RapidsConf) -> bool:
+    """Collapse exchanges when this process drives exactly one chip.
+
+    An N-partition exchange on a single device runs N per-partition
+    programs SERIALLY — N dispatch/sync floors buying parallelism that
+    does not exist (the multi-chip mesh path, parallel/ici_exec.py, is
+    where partitions buy real concurrency).  Absorbing the exchange into
+    its consumer turns the stage into ONE fused program, the single-chip
+    mirror of the ICI stage fusion."""
+    mode = conf.get(cfg.SINGLE_CHIP_FUSE)
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    import jax
+    return len(jax.devices()) == 1
+
+
+def _strip_exchange(exchange: eb.Exec, coalesce: bool = False) -> eb.Exec:
+    """Replace an exchange with a partition gather (+ optional device-side
+    batch coalesce so streaming consumers see ONE batch instead of one
+    per source partition — each probe batch costs its own sync)."""
+    src = exchange.children[0]
+    node = src
+    if src.num_partitions > 1:
+        node = GatherPartitionsExec(src)
+        node.placement = src.placement
+    if coalesce:
+        node = CoalesceBatchesExec(node)
+        node.placement = src.placement
+    return node
+
+
 def _convert_join(e: "CpuJoinExec", conf) -> eb.Exec:
+    left, right = e.children
+    colocated = getattr(e, "colocated", False)
+    if _fuse_single_chip(conf):
+        if colocated and \
+                all(isinstance(c, ShuffleExchangeExec) for c in e.children):
+            # shuffled hash join on one chip: the exchanges exist only to
+            # co-locate keys, which a single chip already is — drop both
+            # and run ONE count/sync/expand instead of one per partition
+            left = _strip_exchange(left, coalesce=True)   # probe streams
+            right = _strip_exchange(right)                # build concats
+            colocated = False
+        elif left.num_partitions > 1 and not colocated:
+            # broadcast/plain join with a multi-partition probe: each
+            # probe batch pays its own count->sync->expand round; one
+            # chip gains nothing from the split, so funnel the probe
+            # into a single device batch first
+            g = GatherPartitionsExec(left)
+            g.placement = left.placement
+            left = CoalesceBatchesExec(g)
+            left.placement = g.placement
     cls = BroadcastHashJoinExec \
-        if isinstance(e.children[1], BroadcastExchangeExec) else HashJoinExec
+        if isinstance(right, BroadcastExchangeExec) else HashJoinExec
     j = cls(e.left_keys, e.right_keys, e.how, e.condition,
-            e.children[0], e.children[1],
-            colocated=getattr(e, "colocated", False))
+            left, right, colocated=colocated)
     j.placement = eb.TPU
     return j
 
@@ -724,6 +776,13 @@ def _convert_aggregate(e: CpuHashAggregateExec, conf) -> eb.Exec:
     pre-aggregated groups cross the wire."""
     child = e.children[0]
     if isinstance(child, ShuffleExchangeExec):
+        if _fuse_single_chip(conf):
+            # one chip: partial-agg pushdown shrinks a wire that does not
+            # exist; a single fused Complete program over the gathered
+            # input replaces partial x N -> exchange -> final x N
+            return TpuHashAggregateExec(
+                e.grouping, e.aggregates, agg.COMPLETE,
+                _strip_exchange(child, coalesce=True))
         from ..shuffle.partitioning import HashPartitioning
         source = child.children[0]
         partial = TpuHashAggregateExec(e.grouping, e.aggregates,
@@ -755,6 +814,31 @@ from ..shuffle.exchange import ShuffleExchangeExec  # noqa: E402
 
 EXEC_SIGS[WindowExec] = T.common_scalar.nested()
 EXEC_SIGS[ShuffleExchangeExec] = _exec_common
+
+
+def _convert_window(e: WindowExec, conf) -> eb.Exec:
+    child = e.children[0]
+    if _fuse_single_chip(conf) and isinstance(child, ShuffleExchangeExec):
+        # window partitions need co-location only; one chip has it —
+        # WindowExec concats its input and carry-sorts by (pkeys, okeys)
+        e = WindowExec(e.window_exprs, _strip_exchange(child))
+    e.placement = eb.TPU
+    return e
+
+
+def _convert_sort(e: SortExec, conf) -> eb.Exec:
+    child = e.children[0]
+    if e.is_global and _fuse_single_chip(conf) and \
+            isinstance(child, ShuffleExchangeExec):
+        # range exchange orders ranges ACROSS partitions; a single chip
+        # sorts the gathered whole in one program instead
+        e = SortExec(e.orders, _strip_exchange(child), is_global=True)
+    e.placement = eb.TPU
+    return e
+
+
+EXEC_CONVERTS[WindowExec] = _convert_window
+EXEC_CONVERTS[SortExec] = _convert_sort
 
 from ..io.scan import FileScanExec  # noqa: E402
 

@@ -1,0 +1,154 @@
+"""Single-chip exchange collapse (spark.rapids.tpu.singleChipFuse).
+
+On one chip an N-partition exchange buys no parallelism — it costs N
+serial per-partition programs.  With fuse forced 'on', partial->exchange->
+final aggregates, co-partitioned shuffled joins, range-partitioned global
+sorts and hash-partitioned windows must all absorb their exchanges into
+ONE fused stage, with results identical to the CPU engine (the analog of
+the reference owning the shuffle underneath these stages,
+ref RapidsShuffleInternalManagerBase.scala:205).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.expr.window import WindowBuilder
+
+
+def _tables(n=20_000, nkeys=500):
+    rng = np.random.default_rng(11)
+    fact = pa.table({
+        "k": pa.array(rng.integers(0, nkeys, n).astype(np.int64)),
+        "v": pa.array(rng.integers(-1000, 1000, n).astype(np.int64)),
+        "f": pa.array(rng.random(n)),
+    })
+    dim = pa.table({
+        "k": pa.array(np.arange(nkeys, dtype=np.int64)),
+        "w": pa.array(rng.integers(0, 10**6, nkeys).astype(np.int64)),
+    })
+    return fact, dim
+
+
+def _session(fuse: str, enabled=True) -> TpuSession:
+    return (TpuSession.builder()
+            .config("spark.rapids.sql.enabled", enabled)
+            .config("spark.rapids.tpu.singleChipFuse", fuse)
+            .get_or_create())
+
+
+def _no_exchange(session, df):
+    plan = session.prepare_plan(df._lp)
+    names = []
+    plan.foreach(lambda e: names.append(type(e).__name__))
+    return "ShuffleExchangeExec" not in names, names
+
+
+@pytest.fixture(scope="module")
+def data():
+    return _tables()
+
+
+def test_fused_shuffled_join_plan_and_result(data):
+    fact, dim = data
+    s = _session("on")
+    fdf = s.create_dataframe(fact, num_partitions=4)
+    ddf = s.create_dataframe(dim, num_partitions=2)
+    # integer weights make the grouped sums exactly comparable
+    q = (fdf.join(ddf, on="k", how="inner")
+         .group_by(col("k")).agg(F.sum(col("w")).alias("sw")))
+    ok, names = _no_exchange(s, q)
+    assert ok, f"exchange survived the fuse: {names}"
+    got = q.collect().sort_by("k")
+
+    c = _session("off", enabled=False)
+    cf = c.create_dataframe(fact, num_partitions=4)
+    cd = c.create_dataframe(dim, num_partitions=2)
+    want = (cf.join(cd, on="k", how="inner")
+            .group_by(col("k")).agg(F.sum(col("w")).alias("sw"))
+            .collect().sort_by("k"))
+    assert got.equals(want)
+
+
+def test_fused_aggregate_plan_and_result(data):
+    fact, _ = data
+    s = _session("on")
+    fdf = s.create_dataframe(fact, num_partitions=4)
+    q = (fdf.filter(col("v") > 0).group_by(col("k"))
+         .agg(F.sum(col("v")).alias("sv"), F.count("*").alias("c")))
+    ok, names = _no_exchange(s, q)
+    assert ok, f"exchange survived the fuse: {names}"
+    got = q.collect().sort_by("k")
+
+    c = _session("off", enabled=False)
+    cf = c.create_dataframe(fact, num_partitions=4)
+    want = (cf.filter(col("v") > 0).group_by(col("k"))
+            .agg(F.sum(col("v")).alias("sv"), F.count("*").alias("c"))
+            .collect().sort_by("k"))
+    assert got.equals(want)
+
+
+def test_fused_global_sort(data):
+    fact, _ = data
+    s = _session("on")
+    fdf = s.create_dataframe(fact, num_partitions=4)
+    q = fdf.sort(col("k"), col("v"))
+    ok, names = _no_exchange(s, q)
+    assert ok, f"exchange survived the fuse: {names}"
+    got = q.collect()
+
+    c = _session("off", enabled=False)
+    want = (c.create_dataframe(fact, num_partitions=4)
+            .sort(col("k"), col("v")).collect())
+    assert got.equals(want)
+
+
+def test_fused_window(data):
+    fact, _ = data
+    s = _session("on")
+    fdf = s.create_dataframe(fact, num_partitions=4)
+    w = WindowBuilder().partition_by(col("k")).order_by(col("v"))
+    q = fdf.select(col("k"), col("v"),
+                   F.row_number().over(w).alias("rn"),
+                   F.sum(col("v")).over(w).alias("rs"))
+    ok, names = _no_exchange(s, q)
+    assert ok, f"exchange survived the fuse: {names}"
+    order = [("k", "ascending"), ("v", "ascending"), ("rn", "ascending")]
+    got = q.collect().sort_by(order)
+
+    c = _session("off", enabled=False)
+    cf = c.create_dataframe(fact, num_partitions=4)
+    want = cf.select(col("k"), col("v"),
+                     F.row_number().over(w).alias("rn"),
+                     F.sum(col("v")).over(w).alias("rs")
+                     ).collect().sort_by(order)
+    assert got.equals(want)
+
+
+def test_bare_repartition_not_fused(data):
+    """A user-visible repartition keeps its exchange (partition count and
+    key->partition mapping are observable, e.g. through partitioned
+    writes and spark_partition_id)."""
+    fact, _ = data
+    s = _session("on")
+    fdf = s.create_dataframe(fact, num_partitions=2)
+    q = fdf.repartition(4, col("k"))
+    ok, names = _no_exchange(s, q)
+    assert not ok, f"repartition exchange must survive: {names}"
+
+
+def test_auto_mode_multichip_keeps_exchanges(data):
+    """conftest forces an 8-device CPU mesh, so 'auto' must keep the
+    multi-partition exchange plan (fusion is a 1-device rewrite)."""
+    import jax
+    assert len(jax.devices()) > 1
+    fact, _ = data
+    s = _session("auto")
+    fdf = s.create_dataframe(fact, num_partitions=4)
+    q = fdf.filter(col("v") > 0).group_by(col("k")).agg(
+        F.sum(col("v")).alias("sv"))
+    ok, names = _no_exchange(s, q)
+    assert not ok, f"auto fused on a multi-device mesh: {names}"
