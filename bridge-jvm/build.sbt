@@ -10,5 +10,9 @@ val sparkVersion = sys.props.getOrElse("spark.version", "3.5.1")
 
 libraryDependencies ++= Seq(
   "org.apache.spark" %% "spark-sql" % sparkVersion % "provided",
-  "org.apache.spark" %% "spark-core" % sparkVersion % "provided"
+  "org.apache.spark" %% "spark-core" % sparkVersion % "provided",
+  "org.scalatest" %% "scalatest-funsuite" % "3.2.17" % Test
 )
+
+Test / fork := true
+Test / parallelExecution := false

@@ -1,0 +1,159 @@
+/*
+ * TPU bridge exec + Arrow wire (Spark side).
+ *
+ * Lives inside the org.apache.spark.sql namespace to reach Spark's
+ * private[sql] ArrowWriter — the same move the reference plugin makes
+ * with its org.apache.spark.sql.rapids package (ref
+ * sql-plugin/src/main/scala/org/apache/spark/sql/rapids/).
+ *
+ * The Arrow schema construction is our own (a fixed mapping for the
+ * bridge's supported type subset) instead of ArrowUtils.toArrowSchema:
+ * that private helper changed arity in every minor release (3.3 -> 3.5),
+ * while arrow-java's own Schema/Field API and Spark's
+ * ArrowWriter.create(root) are stable across all of them.
+ */
+package org.apache.spark.sql.tpubridge
+
+import java.io.{ByteArrayInputStream, ByteArrayOutputStream}
+import java.nio.channels.Channels
+
+import scala.collection.JavaConverters._
+import scala.collection.mutable.ArrayBuffer
+
+import org.apache.arrow.memory.RootAllocator
+import org.apache.arrow.vector.VectorSchemaRoot
+import org.apache.arrow.vector.ipc.{ArrowStreamReader, ArrowStreamWriter}
+import org.apache.arrow.vector.types.{DateUnit, FloatingPointPrecision, TimeUnit}
+import org.apache.arrow.vector.types.pojo.{ArrowType, Field, FieldType, Schema}
+
+import org.apache.spark.rdd.RDD
+import org.apache.spark.sql.catalyst.InternalRow
+import org.apache.spark.sql.catalyst.expressions.{Attribute, UnsafeProjection}
+import org.apache.spark.sql.execution.{SparkPlan, UnaryExecNode}
+import org.apache.spark.sql.execution.arrow.ArrowWriter
+import org.apache.spark.sql.types._
+import org.apache.spark.sql.vectorized.{ArrowColumnVector, ColumnVector, ColumnarBatch}
+
+/**
+ * Executes `child` normally, ships each partition (plus the collected
+ * extra-input plans, broadcast to every task) through the sidecar
+ * protocol, and returns the sidecar's Arrow result rows.
+ */
+case class TpuBridgeExec(
+    output: Seq[Attribute],
+    spec: String,
+    child: SparkPlan,
+    extraInputs: Seq[SparkPlan]) extends UnaryExecNode {
+
+  override protected def doExecute(): RDD[InternalRow] = {
+    val childSchema = child.schema
+    val outSchema = StructType(output.map(a =>
+      StructField(a.name, a.dataType, a.nullable)))
+    val port = conf.getConfString("spark.tpu.bridge.port",
+      org.sparkrapids.tpu.TpuBridgeSidecar.port.toString).toInt
+    val specStr = spec
+    // extra inputs (join builds) are small build-side plans: collect
+    // them once on the driver as Arrow payloads
+    val extras: Seq[Array[Byte]] = extraInputs.map(ArrowWire.planToIpc)
+    val extrasBc = sparkContext.broadcast(extras)
+    child.execute().mapPartitions { rows =>
+      val ipc = ArrowWire.rowsToIpc(rows, childSchema)
+      val result = org.sparkrapids.tpu.SidecarClient.executeStage(
+        port, specStr, ipc +: extrasBc.value)
+      ArrowWire.ipcToRows(result, outSchema)
+    }
+  }
+
+  override protected def withNewChildInternal(newChild: SparkPlan): SparkPlan =
+    copy(child = newChild)
+}
+
+/** Arrow IPC helpers: InternalRow <-> one-stream IPC payloads. */
+object ArrowWire {
+  private val BATCH_ROWS = 1 << 16
+
+  private def toArrowType(dt: DataType): ArrowType = dt match {
+    case BooleanType => ArrowType.Bool.INSTANCE
+    case ByteType => new ArrowType.Int(8, true)
+    case ShortType => new ArrowType.Int(16, true)
+    case IntegerType => new ArrowType.Int(32, true)
+    case LongType => new ArrowType.Int(64, true)
+    case FloatType =>
+      new ArrowType.FloatingPoint(FloatingPointPrecision.SINGLE)
+    case DoubleType =>
+      new ArrowType.FloatingPoint(FloatingPointPrecision.DOUBLE)
+    case StringType => ArrowType.Utf8.INSTANCE
+    case BinaryType => ArrowType.Binary.INSTANCE
+    case DateType => new ArrowType.Date(DateUnit.DAY)
+    case TimestampType => new ArrowType.Timestamp(TimeUnit.MICROSECOND, "UTC")
+    case d: DecimalType => ArrowType.Decimal.createDecimal(
+      d.precision, d.scale, null)
+    case other => throw new UnsupportedOperationException(
+      s"bridge wire does not carry ${other.catalogString}")
+  }
+
+  def toArrowSchema(schema: StructType): Schema =
+    new Schema(schema.map { f =>
+      new Field(f.name,
+        new FieldType(f.nullable, toArrowType(f.dataType), null),
+        java.util.Collections.emptyList[Field]())
+    }.asJava)
+
+  def rowsToIpc(rows: Iterator[InternalRow],
+                schema: StructType): Array[Byte] = {
+    val allocator = new RootAllocator(Long.MaxValue)
+    val root = VectorSchemaRoot.create(toArrowSchema(schema), allocator)
+    try {
+      val writer = ArrowWriter.create(root)
+      val bos = new ByteArrayOutputStream()
+      val sw = new ArrowStreamWriter(root, null, Channels.newChannel(bos))
+      sw.start()
+      var pending = 0
+      while (rows.hasNext) {
+        writer.write(rows.next())
+        pending += 1
+        if (pending == BATCH_ROWS) {
+          writer.finish(); sw.writeBatch(); writer.reset(); pending = 0
+        }
+      }
+      // final (possibly empty) batch carries the schema for empty
+      // partitions
+      writer.finish(); sw.writeBatch()
+      sw.end()
+      bos.toByteArray
+    } finally {
+      root.close()
+      allocator.close()
+    }
+  }
+
+  def planToIpc(p: SparkPlan): Array[Byte] =
+    rowsToIpc(p.executeCollect().iterator, p.schema)
+
+  def ipcToRows(ipc: Array[Byte],
+                schema: StructType): Iterator[InternalRow] = {
+    val allocator = new RootAllocator(Long.MaxValue)
+    val reader = new ArrowStreamReader(
+      new ByteArrayInputStream(ipc), allocator)
+    val proj = UnsafeProjection.create(schema)
+    val out = ArrayBuffer[InternalRow]()
+    try {
+      while (reader.loadNextBatch()) {
+        val root = reader.getVectorSchemaRoot
+        if (root.getRowCount > 0) {
+          val cols: Array[ColumnVector] = root.getFieldVectors.asScala
+            .map(v => new ArrowColumnVector(v): ColumnVector).toArray
+          val batch = new ColumnarBatch(cols, root.getRowCount)
+          val it = batch.rowIterator()
+          while (it.hasNext) {
+            out += proj(it.next()).copy()
+          }
+        }
+      }
+    } finally {
+      reader.close()
+      allocator.close()
+    }
+    out.iterator
+  }
+}

@@ -350,8 +350,11 @@ object SpecBuilder {
    *  co-partitioning is unnecessary (and the exchange would re-shuffle
    *  rows the bridge ships anyway). */
   private def stripExchange(p: SparkPlan): SparkPlan = p match {
-    case e: ShuffleExchangeExec => e.child
-    case e: BroadcastExchangeExec => e.child
+    case e: ShuffleExchangeExec => stripExchange(e.child)
+    case e: BroadcastExchangeExec => stripExchange(e.child)
+    // a sort-merge join's per-partition sort: the sidecar hash join
+    // needs neither the co-partitioning nor the order
+    case SortExec(_, false, child, _) => stripExchange(child)
     case other => other
   }
 

@@ -167,6 +167,10 @@ class TpuSession:
             if hasattr(e, "release_shuffle") else None)
 
     def execute(self, lp: L.LogicalPlan) -> pa.Table:
+        from ..plan.host_assist import try_host_assisted_collect
+        assisted = try_host_assisted_collect(self, lp)
+        if assisted is not None:
+            return assisted
         final_plan = self.prepare_plan(lp)
         from ..plugin import ExecutionPlanCaptureCallback
         ExecutionPlanCaptureCallback.on_plan(final_plan)

@@ -303,6 +303,16 @@ JOIN_SPECULATIVE_SIZING = conf(
          "string) schemas and inner/left joins only.") \
     .create_with_default(True)
 
+HOST_ASSISTED_COLLECT = conf(
+    "spark.rapids.sql.collect.hostAssisted").boolean() \
+    .doc("When a collect's plan is a global sort (over optional filters/"
+         "column pruning) of a host-resident in-memory table, fetch only "
+         "the device-computed row-index lane and apply `take` on the "
+         "host copy — a permutation's bytes already sit on the host, so "
+         "only ~4 bytes/row cross the interconnect instead of the whole "
+         "row.  Results below 64Ki rows keep the direct fetch path.") \
+    .create_with_default(True)
+
 HOST_ASSISTED_WRITE = conf("spark.rapids.sql.write.hostAssisted").boolean() \
     .doc("When a write's plan is only row filtering/column pruning over a "
          "source whose bytes already live on the host (in-memory tables, "

@@ -1,0 +1,122 @@
+"""Execute the SpecBuilder golden fixtures end-to-end.
+
+The JSON files in bridge-jvm/src/test/resources/goldens/ are the exact
+specs the Scala SpecBuilder emits for real Spark plans (asserted by
+bridge-jvm's SpecBuilderSuite in CI).  Here the SAME fixtures execute
+through the engine's spec interpreter against generated inputs, with
+pyarrow/numpy oracles — together the two suites prove the wire contract
+from Catalyst translation down to engine results."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pytest
+
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.bridge.spec import plan_spec_to_logical
+
+GOLDEN_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "bridge-jvm", "src", "test", "resources", "goldens")
+
+
+def _load(name):
+    with open(os.path.join(GOLDEN_DIR, name + ".json")) as f:
+        return json.load(f)["spec"]
+
+
+def _run(spec, table, extras=()):
+    s = TpuSession.builder().config("spark.rapids.sql.enabled",
+                                    True).get_or_create()
+    lp = plan_spec_to_logical(spec, table, extras)
+    return s.execute(lp)
+
+
+def test_goldens_exist_and_parse():
+    files = sorted(glob.glob(os.path.join(GOLDEN_DIR, "*.json")))
+    assert len(files) >= 5
+    for f in files:
+        with open(f) as fh:
+            spec = json.load(fh)["spec"]
+        assert "ops" in spec and "input" in spec
+
+
+def test_filter_project_golden():
+    spec = _load("filter_project")
+    rng = np.random.default_rng(1)
+    tb = pa.table({"k": pa.array(rng.integers(0, 9, 500).astype(np.int64)),
+                   "v": pa.array(rng.integers(-5, 5, 500).astype(np.int64))})
+    got = _run(spec, tb)
+    mask = pc.greater(tb.column("v"), 0)
+    want_k = tb.column("k").filter(mask)
+    want_v2 = pc.multiply(tb.column("v").filter(mask), 2)
+    assert got.column("k").to_pylist() == want_k.to_pylist()
+    assert got.column("v2").to_pylist() == want_v2.to_pylist()
+
+
+def test_partial_aggregate_golden():
+    spec = _load("partial_aggregate")
+    rng = np.random.default_rng(2)
+    tb = pa.table({"k": pa.array(rng.integers(0, 7, 300).astype(np.int64)),
+                   "v": pa.array(rng.integers(-9, 9, 300).astype(np.int64))})
+    got = _run(spec, tb).sort_by("k")
+    # buffer schema: k, sum (bigint), sum (double), count
+    assert got.schema.names == ["k", "sum", "sum", "count"]
+    gb = pa.TableGroupBy(tb, ["k"], use_threads=False).aggregate(
+        [("v", "sum"), ("v", "count")]).sort_by("k")
+    assert got.column("k").to_pylist() == gb.column("k").to_pylist()
+    assert got.column(1).to_pylist() == gb.column("v_sum").to_pylist()
+    assert got.column(2).to_pylist() == [
+        float(x) for x in gb.column("v_sum").to_pylist()]
+    assert got.column(3).to_pylist() == gb.column("v_count").to_pylist()
+
+
+def test_window_golden():
+    spec = _load("window_rownum_runsum")
+    rng = np.random.default_rng(3)
+    tb = pa.table({"k": pa.array(rng.integers(0, 5, 200).astype(np.int64)),
+                   "v": pa.array(rng.permutation(200).astype(np.int64))})
+    got = _run(spec, tb).sort_by([("k", "ascending"), ("v", "ascending")])
+    df = tb.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    df["rn"] = df.groupby("k").cumcount() + 1
+    df["rs"] = df.groupby("k")["v"].cumsum()
+    assert got.column("rn").to_pylist() == df["rn"].tolist()
+    assert got.column("rs").to_pylist() == df["rs"].tolist()
+
+
+def test_shuffled_join_diff_keys_golden():
+    spec = _load("shuffled_join_diff_keys")
+    fact = pa.table({
+        "id": pa.array(np.arange(100, dtype=np.int64) % 20),
+        "x": pa.array(np.arange(100, dtype=np.int64))})
+    dim = pa.table({
+        "user_id": pa.array(np.arange(20, dtype=np.int64)),
+        "w": pa.array((np.arange(20, dtype=np.int64) * 10))})
+    got = _run(spec, fact, (dim,)).sort_by(
+        [("x", "ascending"), ("w", "ascending")])
+    want_w = [int(i % 20) * 10 for i in range(100)]
+    assert got.schema.names == ["x", "w"]
+    assert got.column("x").to_pylist() == list(range(100))
+    assert got.column("w").to_pylist() == want_w
+
+
+def test_string_datetime_cast_golden():
+    import datetime
+    spec = _load("string_datetime_cast")
+    tb = pa.table({
+        "s": pa.array(["ax", "bb", "xc", None, "dx"]),
+        "d": pa.array([datetime.date(2021, 1, 2),
+                       datetime.date(2022, 3, 4),
+                       datetime.date(2023, 5, 6),
+                       datetime.date(2024, 7, 8),
+                       datetime.date(2025, 9, 10)]),
+        "v": pa.array(np.array([1, 2, 3, 4, 5], dtype=np.int64))})
+    got = _run(spec, tb)
+    assert got.column("u").to_pylist() == ["AX", "XC", "DX"]
+    assert got.column("y").to_pylist() == [2021, 2023, 2025]
+    assert got.column("vi").to_pylist() == [1, 3, 5]
+    assert got.schema.field("vi").type == pa.int32()
