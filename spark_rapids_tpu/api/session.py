@@ -70,6 +70,28 @@ class TpuSession:
             self.device_manager = None
             self.semaphore = None
             self.spill_catalog = None
+        # after plugin init: the cold-cache probe reads the persistent
+        # compile cache dir the plugin just configured
+        self._init_sort_mode(conf)
+
+    def _init_sort_mode(self, conf: RapidsConf) -> None:
+        """Pick the sort kernel structure (ops/carry.py module doc):
+        'auto' = compile-lean exactly while the persistent XLA compile
+        cache is cold, throughput carry-sorts once it is warm."""
+        import os
+        from ..ops.carry import set_compile_lean
+        mode = conf.get(cfg.SORT_COMPILE_LEAN)
+        if mode in ("on", "off"):
+            set_compile_lean(mode == "on")
+            return
+        try:
+            import jax
+            d = jax.config.jax_compilation_cache_dir
+            cold = not d or not os.path.isdir(d) or \
+                not any(os.scandir(d))
+        except Exception:
+            cold = False
+        set_compile_lean(cold)
 
     # -- conf ---------------------------------------------------------------
     @property

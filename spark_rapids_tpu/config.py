@@ -292,6 +292,19 @@ SINGLE_CHIP_FUSE = conf("spark.rapids.tpu.singleChipFuse").string() \
     .check_values(["auto", "on", "off"]) \
     .create_with_default("auto")
 
+SORT_COMPILE_LEAN = conf("spark.rapids.tpu.sort.compileLean").string() \
+    .doc("Sort-kernel structure tradeoff.  'off' (throughput): payload "
+         "lanes ride the sort as extra lax.sort operands — fastest warm, "
+         "but a cache-cold novel shape pays minutes of XLA compile at "
+         "1M rows.  'on' (compile-lean): every sort lowers as iterated "
+         "2-operand (uint64, iota) passes plus payload gathers — an "
+         "order of magnitude cheaper to compile, ~20ms/lane slower "
+         "warm.  'auto' picks lean exactly when the persistent compile "
+         "cache is cold (fresh deployments' first queries) and "
+         "throughput kernels once it is warm.") \
+    .check_values(["auto", "on", "off"]) \
+    .create_with_default("auto")
+
 JOIN_SPECULATIVE_SIZING = conf(
     "spark.rapids.tpu.join.speculativeSizing").boolean() \
     .doc("Fuse a hash join's count and expand phases into ONE program by "

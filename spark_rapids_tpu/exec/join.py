@@ -221,6 +221,71 @@ class HashJoinExec(Exec):
             jnp, b, p, o, l, c, out_cap, pchar_caps, bchar_caps))
         return fn(build, probe, order, lo, counts)
 
+    # --- conditional left join ---------------------------------------------
+    def _expand_left_cond(self, xp, build: Batch, probe: Batch, order, lo,
+                          counts, out_cap: int, pchar_caps, bchar_caps
+                          ) -> Batch:
+        """LEFT join with a residual condition, one traced function:
+        expand all candidate pairs, evaluate the condition, keep passing
+        pairs, and REPAIR probe rows whose candidates all failed — their
+        first pair survives with the build side nulled (Spark's outer
+        conditional-join semantics; ref GpuHashJoin's post-filter with
+        unmatched-row emission, GpuOverrides.scala:3352-3355)."""
+        from ..ops.carry import mask_validity
+        plive = xp.arange(probe.capacity, dtype=np.int32) < probe.num_rows
+        (pidx, bidx, pair_valid, pvalid, bvalid, total) = jk.expand_pairs(
+            xp, order, lo, counts, plive, out_cap, "left")
+        lcols = [gather_column(xp, c, pidx, pvalid, cc)
+                 for c, cc in zip(probe.columns, pchar_caps)]
+        rcols = [gather_column(xp, c, bidx, bvalid, cc)
+                 for c, cc in zip(build.columns, bchar_caps)]
+        out = DeviceBatch(lcols + rcols, total, self.output_names)
+        ctx = EvalContext(xp, out)
+        v = self._bound_condition.eval(ctx)
+        from ..expr.core import ColumnValue, make_column
+        if not isinstance(v, ColumnValue):
+            v = make_column(ctx, self._bound_condition.data_type(),
+                            v.value if v.value is not None else False,
+                            None if v.value is not None else False)
+        passes = v.col.data.astype(bool)
+        if v.col.validity is not None:
+            passes = passes & v.col.validity
+        real = counts.astype(xp.int32)[pidx] > 0     # vs synthesized null
+        pred_true = passes & real & pair_valid
+        if xp is np:
+            pass_cnt = np.zeros((probe.capacity,), np.int32)
+            np.add.at(pass_cnt, np.clip(pidx, 0, probe.capacity - 1),
+                      pred_true.astype(np.int32))
+        else:
+            pass_cnt = xp.zeros((probe.capacity,), xp.int32).at[pidx].add(
+                pred_true.astype(xp.int32), mode="drop")
+        # pairs are emitted grouped per probe row, so a boundary marks
+        # each row's first candidate
+        first = xp.concatenate(
+            [xp.ones((1,), bool), pidx[1:] != pidx[:-1]]) & pair_valid
+        convert = first & real & (pass_cnt[pidx] == 0)
+        keep = pair_valid & (~real | pred_true | convert)
+        null_build = ~real | convert
+        nb = len(probe.columns)
+        fixed = list(out.columns[:nb]) + [
+            mask_validity(xp, c, ~null_build) for c in out.columns[nb:]]
+        out = DeviceBatch(fixed, total, self.output_names)
+        return compact(xp, out, keep, self.output_names)
+
+    def _expand_left_cond_call(self, xp, build, probe, order, lo, counts,
+                               out_cap, pchar_caps, bchar_caps):
+        if xp is np:
+            return self._expand_left_cond(np, build, probe, order, lo,
+                                          counts, out_cap, pchar_caps,
+                                          bchar_caps)
+        key = self._jit_key + ("expand_leftcond", out_cap,
+                               tuple(pchar_caps), tuple(bchar_caps))
+        fn = process_jit(key, lambda: lambda b, p, o, l, c:
+                         self._expand_left_cond(jnp, b, p, o, l, c,
+                                                out_cap, pchar_caps,
+                                                bchar_caps))
+        return fn(build, probe, order, lo, counts)
+
     # --- unmatched build rows for right/full --------------------------------
     def _unmatched_build(self, xp, build: Batch, matched_any) -> Batch:
         keep = (xp.arange(build.capacity, dtype=np.int32) < build.num_rows) \
@@ -241,6 +306,10 @@ class HashJoinExec(Exec):
         (span columns would need char-cap guesses too) and join types
         whose output rides the (probe, build) gather maps only."""
         if self.how not in ("inner", "left"):
+            return False
+        if self._bound_condition is not None and self.how != "inner":
+            # conditional left runs the expand+repair kernel, which the
+            # speculative fused program does not carry
             return False
         def flat(c):
             return c.offsets is None and c.data_hi is None and \
@@ -359,13 +428,21 @@ class HashJoinExec(Exec):
                               for x, c in zip(pbytes, probe.columns)]
                 bchar_caps = [span_cap(x, c)
                               for x, c in zip(bbytes, build.columns)]
-                out = self._expand_call(xp, build, probe, order, lo, counts,
-                                        out_cap, pchar_caps, bchar_caps)
                 if self._bound_condition is not None and \
-                        self.how == "inner":
-                    pctx = EvalContext(xp, out)
-                    pred = self._bound_condition.eval(pctx)
-                    out = apply_filter(xp, out, pred, self.output_names)
+                        self.how == "left":
+                    out = self._expand_left_cond_call(
+                        xp, build, probe, order, lo, counts, out_cap,
+                        pchar_caps, bchar_caps)
+                else:
+                    out = self._expand_call(xp, build, probe, order, lo,
+                                            counts, out_cap, pchar_caps,
+                                            bchar_caps)
+                    if self._bound_condition is not None and \
+                            self.how == "inner":
+                        pctx = EvalContext(xp, out)
+                        pred = self._bound_condition.eval(pctx)
+                        out = apply_filter(xp, out, pred,
+                                           self.output_names)
                 maybe_sync(out)
             self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
             self.metrics[NUM_OUTPUT_BATCHES] += 1
@@ -573,19 +650,64 @@ class CpuJoinExec(Exec):
                     out = pa.concat_tables(
                         [out, extra.rename_columns(self.output_names)])
         if self.condition is not None:
-            mask = _eval_arrow(self.condition, out, self)
             if self.how == "inner":
+                mask = _eval_arrow(self.condition, out, self)
                 out = out.filter(mask)
-            elif self.how in ("left", "full", "right"):
-                # outer conditional joins: keep unmatched semantics by
-                # filtering matched pairs only — fall back to pandas
+            elif self.how == "left":
+                # conditional LEFT: keep matched pairs passing the
+                # condition; probe rows with no passing pair emit once,
+                # build side nulled (Spark's outer-join semantics)
+                out = _left_conditional_impl(self, lt, rt, lkn, rkn,
+                                             lnames, rnames, l_null,
+                                             r_null)
+            else:
                 raise NotImplementedError(
-                    "conditional outer join on CPU engine")
+                    f"conditional {self.how} join on CPU engine")
         from ..columnar.interop import to_arrow_schema
         schema = to_arrow_schema(self.output_names, self.output_types)
         out = out.cast(schema)
         for rb in out.combine_chunks().to_batches():
             yield batch_to_device(rb, xp=np)
+
+
+def _left_conditional_impl(join_exec: "CpuJoinExec", lt, rt, lkn, rkn,
+                           lnames, rnames, l_null, r_null) -> pa.Table:
+    """Conditional LEFT join on the CPU oracle: re-join with a probe row
+    id and a build marker, filter pairs by the condition, and null-extend
+    every probe row without a passing pair."""
+    import pyarrow.compute as pc
+    lt2 = lt.append_column(
+        "__pid__", pa.array(np.arange(lt.num_rows, dtype=np.int64)))
+    rt2 = rt.append_column(
+        "__bmark__", pa.array(np.ones(rt.num_rows, dtype=np.int8)))
+    l_nn = lt2.filter(pc.invert(l_null)) if l_null is not None else lt2
+    r_nn = rt2.filter(pc.invert(r_null)) if r_null is not None else rt2
+    joined = l_nn.join(r_nn, keys=lkn, right_keys=rkn,
+                       join_type="left outer", coalesce_keys=False,
+                       use_threads=False)
+    mask = _eval_arrow(
+        join_exec.condition,
+        joined.select(lnames + rnames).rename_columns(
+            join_exec.output_names),
+        join_exec)
+    if isinstance(mask, pa.ChunkedArray):
+        mask = mask.combine_chunks()
+    mask = pc.fill_null(mask, False)
+    real = pc.is_valid(joined.column("__bmark__"))
+    passing = pc.and_(mask, real)
+    pass_rows = joined.filter(passing)
+    passed = np.unique(np.asarray(pass_rows.column("__pid__")))
+    all_pids = np.asarray(lt2.column("__pid__"))
+    missing = lt2.take(np.flatnonzero(~np.isin(all_pids, passed)))
+    out = pass_rows.select(lnames + rnames)
+    if missing.num_rows:
+        pad = missing.select(lnames)
+        for rn_ in rnames:
+            pad = pad.append_column(
+                rn_, pa.nulls(missing.num_rows,
+                              rt.schema.field(rn_).type))
+        out = pa.concat_tables([out, pad])
+    return out.rename_columns(join_exec.output_names)
 
 
 def _eval_arrow(expr: Expression, table: pa.Table, child_like) -> pa.Array:

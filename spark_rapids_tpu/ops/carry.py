@@ -21,6 +21,48 @@ import numpy as np
 from ..columnar.device import DeviceColumn
 from .gather import gather_column
 
+# ---------------------------------------------------------------------------
+# Compile-lean mode
+# ---------------------------------------------------------------------------
+# XLA's lowering of a many-operand 64-bit lax.sort costs MINUTES of
+# compile at 1M rows (docs/performance.md:44-52) — the dominant cost of
+# a cache-cold novel query.  In lean mode every sort call site traces
+# the SAME tiny shape instead: an iterated 2-operand (uint64 key, int32
+# iota) stable sort per key word, then gathers move the payload.  Warm
+# cost rises (one ~20ms gather per payload lane at 1M rows); compile
+# drops by an order of magnitude.  The session picks the mode from
+# spark.rapids.tpu.sort.compileLean: 'auto' = lean exactly when the
+# persistent XLA compile cache is cold (a fresh deployment's first
+# queries), throughput kernels once the cache is warm.
+
+_LEAN = False
+
+
+def set_compile_lean(enabled: bool) -> None:
+    global _LEAN
+    _LEAN = bool(enabled)
+
+
+def compile_lean_enabled() -> bool:
+    return _LEAN
+
+
+def _sort_rows_lean(xp, key_words, cols, cap, extras):
+    """Iterated-pass lexicographic sort: one (uint64, iota) stable sort
+    per key word, least-significant first, then gather everything by the
+    final order.  Same results as the carry path, radically cheaper to
+    compile (every pass lowers the same 2-operand sort)."""
+    import jax
+    from jax import lax
+    order = xp.arange(cap, dtype=xp.int32)
+    for w in reversed(list(key_words)):
+        kw = w.astype(xp.uint64)[order]
+        _, order = lax.sort((kw, order), num_keys=1, is_stable=True)
+    ones = xp.ones((cap,), dtype=bool)
+    out_cols = [gather_column(xp, c, order, ones) for c in cols]
+    out_extras = [e[order] for e in extras]
+    return order, out_cols, out_extras
+
 
 def carriable(col: DeviceColumn) -> bool:
     """True when every lane of the column is row-aligned (no offsets
@@ -55,6 +97,9 @@ def sort_rows(xp, key_words: Sequence, cols: Sequence[DeviceColumn],
                 ones = np.ones((cap,), dtype=bool)
                 out_cols.append(gather_column(np, c, order, ones))
         return order, out_cols, out_extras
+
+    if _LEAN:
+        return _sort_rows_lean(xp, key_words, cols, cap, extras)
 
     from jax import lax
     iota = xp.arange(cap, dtype=xp.int32)

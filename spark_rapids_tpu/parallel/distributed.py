@@ -366,9 +366,12 @@ class DistributedHashJoin:
         if how not in self.SUPPORTED:
             # right joins arrive pre-flipped to left (plan_join)
             raise NotImplementedError(f"ici join how={how}")
-        if condition is not None and how != "inner":
+        if condition is not None and how not in ("inner", "left"):
+            # inner post-filters in-shard; left runs the conditional
+            # expand+repair kernel — co-located keys make both locally
+            # exact (ref GpuOverrides.scala:3352-3355)
             raise NotImplementedError("ici join residual condition only "
-                                      "for inner joins")
+                                      "for inner/left joins")
         self.mesh = mesh or build_mesh()
         self.axis = axis
         self.n_dev = self.mesh.shape[axis]
@@ -419,6 +422,13 @@ class DistributedHashJoin:
                      pchar, bchar):
         strip = lambda x: jax.tree_util.tree_map(  # noqa: E731
             lambda y: y[0], x)
+        if self._join._bound_condition is not None and self.how == "left":
+            # conditional LEFT: co-located shards make the expand+repair
+            # kernel (HashJoinExec._expand_left_cond) locally exact
+            out = self._join._expand_left_cond(
+                jnp, strip(rx), strip(lx), strip(order), strip(lo),
+                strip(counts), out_cap, pchar, bchar)
+            return jax.tree_util.tree_map(lambda x: x[None], out)
         out = self._join._expand(jnp, strip(rx), strip(lx), strip(order),
                                  strip(lo), strip(counts), out_cap,
                                  pchar, bchar)
@@ -462,13 +472,18 @@ class DistributedHashJoin:
 
     def run(self, left_tables: Sequence[pa.Table],
             right_tables: Sequence[pa.Table]) -> pa.Table:
+        assert len(left_tables) == self.n_dev
+        assert len(right_tables) == self.n_dev
+        return self.run_stacked(stack_shards(left_tables),
+                                stack_shards(right_tables))
+
+    def run_stacked(self, ls: DeviceBatch, rs: DeviceBatch) -> pa.Table:
+        """Join pre-stacked per-device shards (the device-resident
+        scan->mesh edge: rows arrive without host Arrow staging, ref
+        RapidsShuffleInternalManagerBase.scala:74)."""
         import numpy as np
         from ..columnar.device import (DEFAULT_CHAR_BUCKETS,
                                        DEFAULT_ROW_BUCKETS, bucket_for)
-        assert len(left_tables) == self.n_dev
-        assert len(right_tables) == self.n_dev
-        ls = stack_shards(left_tables)
-        rs = stack_shards(right_tables)
         if self.how in ("left_semi", "left_anti"):
             return shards_to_table(self._compiled_count()(ls, rs))
         (lx, rx, order, lo, counts, sizes,

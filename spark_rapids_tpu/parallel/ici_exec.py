@@ -93,7 +93,11 @@ def _gather_source_table(source: Exec, ctx, names, dtypes) -> pa.Table:
                                  schema=schema)
 
 
-def _flat_schema(dtypes) -> bool:
+def _stackable_schema(dtypes) -> bool:
+    """Schemas the device-resident reshard can carry: fixed-width lanes,
+    structs of them, and TOP-LEVEL strings/binaries (their offsets
+    rebase per shard; arrays/maps and span-inside-struct still stage
+    through host Arrow, matching exchange_supported's fallback)."""
     from .. import types as t
 
     def flat(dt):
@@ -103,7 +107,9 @@ def _flat_schema(dtypes) -> bool:
         if isinstance(dt, t.StructType):
             return all(flat(f.data_type) for f in dt.fields)
         return True
-    return all(flat(dt) for dt in dtypes)
+    return all(
+        flat(dt) or isinstance(dt, (t.StringType, t.BinaryType))
+        for dt in dtypes)
 
 
 def _gather_source_stacked(source: Exec, ctx, names, dtypes, n_dev: int):
@@ -111,15 +117,20 @@ def _gather_source_stacked(source: Exec, ctx, names, dtypes, n_dev: int):
     batches, concatenate on device, and reshape every lane to
     (n_dev, shard_cap) with ONE jitted program — rows never stage
     through host Arrow (ref RapidsShuffleInternalManagerBase.scala:74:
-    shuffle input stays device-resident end-to-end).  Returns the
-    stacked DeviceBatch, or None when the schema has span columns
-    (offset rebasing across shards still goes through the host path)."""
-    if not _flat_schema(dtypes):
+    shuffle input stays device-resident end-to-end).  String/binary
+    lanes rebase: each shard slices its char range at the source's char
+    capacity (conservative static shape; a balanced shard holds ~1/n of
+    the bytes) and rewrites offsets relative to its slice.  Returns the
+    stacked DeviceBatch, or None for schemas the reshard cannot carry
+    (arrays/maps — the host path remains)."""
+    if not _stackable_schema(dtypes):
         return None
     import jax
     import jax.numpy as jnp
+    from jax import lax
     from ..columnar.device import (DEFAULT_ROW_BUCKETS, DeviceBatch,
-                                   batch_to_device, bucket_for)
+                                   DeviceColumn, batch_to_device,
+                                   bucket_for)
     from ..exec.concat import concat_batches
     from ..exec.base import process_jit, schema_sig
 
@@ -142,18 +153,49 @@ def _gather_source_stacked(source: Exec, ctx, names, dtypes, n_dev: int):
     # shard imbalance stays bounded by 2x (the sparse row-bucket ladder
     # could idle most of the mesh)
     import math
-    need = max(1024, -(-total // n_dev))
-    per = 1 << math.ceil(math.log2(need))
+    need_rows = max(1024, -(-total // n_dev))
+    per = 1 << math.ceil(math.log2(need_rows))
     in_cap = merged.capacity
+    char_caps = tuple(int(c.data.shape[0]) if c.offsets is not None else 0
+                      for c in merged.columns)
 
     def make():
         def reshard(b: DeviceBatch):
-            def lane(x):
-                need = n_dev * per
-                if x.shape[0] < need:
-                    x = jnp.pad(x, (0, need - x.shape[0]))
-                return x[:need].reshape(n_dev, per)
-            cols = jax.tree_util.tree_map(lane, b.columns)
+            need = n_dev * per
+
+            def pad_to(x, size):
+                if x.shape[0] >= size:
+                    return x[:size]
+                return jnp.pad(x, (0, size - x.shape[0]))
+
+            cols = []
+            for c, ccap in zip(b.columns, char_caps):
+                if c.offsets is not None:
+                    # offsets edge-extend so padding rows are empty spans
+                    offs = c.offsets
+                    if offs.shape[0] < need + 1:
+                        offs = jnp.concatenate(
+                            [offs, jnp.full((need + 1 - offs.shape[0],),
+                                            offs[-1], offs.dtype)])
+                    else:
+                        offs = offs[:need + 1]
+                    # pad chars so a shard's dynamic slice never clamps
+                    data_p = jnp.concatenate(
+                        [c.data, jnp.zeros((ccap,), c.data.dtype)])
+                    sh_off, sh_chars = [], []
+                    for i in range(n_dev):
+                        o = offs[i * per:i * per + per + 1]
+                        sh_off.append(o - o[0])
+                        sh_chars.append(lax.dynamic_slice(
+                            data_p, (o[0],), (ccap,)))
+                    validity = None if c.validity is None else \
+                        pad_to(c.validity, need).reshape(n_dev, per)
+                    cols.append(DeviceColumn(
+                        c.dtype, data=jnp.stack(sh_chars),
+                        validity=validity, offsets=jnp.stack(sh_off)))
+                else:
+                    cols.append(jax.tree_util.tree_map(
+                        lambda x: pad_to(x, need).reshape(n_dev, per), c))
             rows = jnp.clip(
                 jnp.asarray(b.num_rows, jnp.int32)
                 - jnp.arange(n_dev, dtype=jnp.int32) * np.int32(per),
@@ -161,7 +203,8 @@ def _gather_source_stacked(source: Exec, ctx, names, dtypes, n_dev: int):
             return DeviceBatch(cols, rows, b.names)
         return reshard
     fn = process_jit(("ici_reshard", tuple(names),
-                      tuple(repr(d) for d in dtypes), in_cap, n_dev, per),
+                      tuple(repr(d) for d in dtypes), in_cap, n_dev, per,
+                      char_caps),
                      make)
     return fn(merged)
 
@@ -276,11 +319,23 @@ class IciJoinExec(Exec):
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         lsrc, rsrc = self.children
+        n_dev = self._djoin.n_dev
+        # device-resident edge first: both sides reshard on device and
+        # the join consumes the stacked shards without host staging
+        ls = _gather_source_stacked(lsrc, ctx, lsrc.output_names,
+                                    lsrc.output_types, n_dev)
+        rs = _gather_source_stacked(rsrc, ctx, rsrc.output_names,
+                                    rsrc.output_types, n_dev) \
+            if ls is not None else None
+        if ls is not None and rs is not None:
+            with MetricTimer(self.metrics[OP_TIME]):
+                out = self._djoin.run_stacked(ls, rs)
+            yield from _emit_table(self, out)
+            return
         lt = _gather_source_table(lsrc, ctx, lsrc.output_names,
                                   lsrc.output_types)
         rt = _gather_source_table(rsrc, ctx, rsrc.output_names,
                                   rsrc.output_types)
-        n_dev = self._djoin.n_dev
         with MetricTimer(self.metrics[OP_TIME]):
             out = self._djoin.run(_shard_table(lt, n_dev),
                                   _shard_table(rt, n_dev))

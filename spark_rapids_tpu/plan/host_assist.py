@@ -63,10 +63,18 @@ def try_host_assisted_collect(session, lp) -> Optional[pa.Table]:
         return None
 
     # device plan: carry a row id through the filters and the sort, and
-    # fetch ONLY it (the fetch plan narrows its value range)
+    # fetch ONLY it (the fetch plan narrows its value range).  Only the
+    # columns the filters/sort keys read ride along — payload columns
+    # would bloat the sort's carry lanes (and its compile) for nothing.
     from ..expr.hashfns import MonotonicallyIncreasingID
+    needed = []
+    for e in [c for c in filters] + [o[0] for o in lp.orders]:
+        for a in e.collect(lambda x: isinstance(x, AttributeReference)):
+            if a.name not in needed:
+                needed.append(a.name)
     rid_plan: L.LogicalPlan = L.Project(
-        [AttributeReference(n) for n in host.schema.names]
+        [AttributeReference(n) for n in host.schema.names
+         if n in needed]
         + [Alias(MonotonicallyIncreasingID(), _RID)], node)
     for cond in reversed(filters):
         rid_plan = L.Filter(cond, rid_plan)

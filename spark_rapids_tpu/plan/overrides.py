@@ -717,7 +717,9 @@ def _convert_join(e: "CpuJoinExec", conf) -> eb.Exec:
 
 def _tag_join(meta: "ExecMeta"):
     e: CpuJoinExec = meta.exec
-    if e.condition is not None and e.how != "inner":
+    if e.condition is not None and e.how not in ("inner", "left"):
+        # inner post-filters; left repairs unmatched probe rows in the
+        # expand kernel (right arrives pre-flipped to left)
         meta.will_not_work(
             f"conditional {e.how} join is not supported on TPU")
     # key types must be hash/equality-capable

@@ -183,3 +183,22 @@ def test_full_join_never_broadcast():
     assert_tpu_and_cpu_are_equal_collect(q)
     names = _plan_exec_names(q)
     assert "BroadcastHashJoinExec" not in names, names
+
+
+def test_conditional_left_join():
+    """LEFT join with a residual condition: pairs failing the condition
+    drop, probe rows with no passing pair emit once with the build side
+    nulled (expand+repair kernel; ref GpuOverrides.scala:3352-3355)."""
+    def q(spark):
+        a, b = _sides(spark, IntegerGen(lo=0, hi=20), 128)
+        return a.join(b, on=(col("k") == col("k2")) &
+                      (col("va") > col("vb")), how="left")
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_conditional_right_join_flips_to_left():
+    def q(spark):
+        a, b = _sides(spark, IntegerGen(lo=0, hi=12), 96)
+        return a.join(b, on=(col("k") == col("k2")) &
+                      (col("va") < col("vb")), how="right")
+    assert_tpu_and_cpu_are_equal_collect(q)
