@@ -16,21 +16,8 @@ import os
 from typing import Dict, List, Optional, Set, Tuple
 
 from .eventlog import AppInfo, PlanNode, find_event_logs, parse_event_log
-
-# Spark exec nodeName fragments the TPU build accelerates (kept in sync
-# with plan/overrides.py EXEC_SIGS; the reference derives the same list
-# from supportedExecs in PluginTypeChecker)
-SUPPORTED_EXECS = {
-    "Project", "Filter", "HashAggregate", "SortAggregate",
-    "ObjectHashAggregate", "Sort", "SortMergeJoin", "ShuffledHashJoin",
-    "BroadcastHashJoin", "BroadcastNestedLoopJoin", "CartesianProduct",
-    "Exchange", "ShuffleExchange", "BroadcastExchange", "Union", "Range",
-    "Window", "Expand", "Generate", "Sample", "GlobalLimit", "LocalLimit",
-    "TakeOrderedAndProject", "CollectLimit", "Coalesce",
-    "WholeStageCodegen", "ColumnarToRow", "RowToColumnar", "Subquery",
-    "ReusedExchange", "CustomShuffleReader", "AQEShuffleRead",
-    "AdaptiveSparkPlan", "InputAdapter",
-}
+from .supported_ops import (TRANSPARENT_EXECS, supported_exec_factors,
+                            unsupported_expr_tokens)
 
 SUPPORTED_READ_FORMATS = {"parquet", "orc", "csv"}
 SUPPORTED_WRITE_FORMATS = {"parquet", "orc"}
@@ -53,6 +40,9 @@ class QualAppResult:
         self.unsupported_read_formats: Set[str] = set()
         self.unsupported_write_formats: Set[str] = set()
         self.complex_types: Set[str] = set()
+        self.unsupported_exprs: Set[str] = set()
+        self._speedup_num = 0.0
+        self._speedup_den = 0.0
         self._analyze()
 
     # ------------------------------------------------------------------
@@ -67,8 +57,10 @@ class QualAppResult:
                 self.failed_sql_ids.append(sx.sql_id)
                 continue
             problems = self._plan_problems(sx.plan)
-            frac = self._supported_fraction(sx.plan)
+            frac, speedup = self._plan_scores(sx.plan)
             self.supported_task_duration += int(task_dur * frac)
+            self._speedup_num += task_dur * frac * speedup
+            self._speedup_den += task_dur * frac
             if problems:
                 self.problems |= problems
                 self.problem_duration += dur
@@ -93,27 +85,63 @@ class QualAppResult:
                     self.complex_types.add(marker[:-4])
         return out
 
-    def _supported_fraction(self, plan: PlanNode) -> float:
-        total = 0
+    def _plan_scores(self, plan: PlanNode) -> Tuple[float, float]:
+        """(supported fraction, estimated speedup), driven by the LIVE
+        engine registries (tools/supported_ops.py).  An operator counts
+        as supported when (a) its exec translates and (b) every function
+        token in its simple string is a registered expression.  The
+        speedup estimate is Amdahl over the plan's operators: each
+        supported op's unit of work shrinks by its per-op factor (the
+        reference's operatorsScore.csv weighting in PluginTypeChecker),
+        so a plan of cheap pass-through nodes no longer scores like an
+        accelerated join/aggregate pipeline."""
+        factors = supported_exec_factors()
+        n = 0
         good = 0
+        new_time = 0.0
         for node in plan.walk():
-            total += 1
             base = node.node_name.split("(")[0].strip()
-            if any(base.startswith(s) or s in base
-                   for s in SUPPORTED_EXECS):
-                good += 1
-            elif "scan" in base.lower():
-                fmt = _scan_format(node)
-                if fmt in SUPPORTED_READ_FORMATS:
+            if base in TRANSPARENT_EXECS or \
+                    any(base.startswith(t) for t in TRANSPARENT_EXECS):
+                continue
+            n += 1
+            if "scan" in base.lower():
+                if _scan_format(node) in SUPPORTED_READ_FORMATS:
                     good += 1
-        return good / total if total else 0.0
+                    new_time += 1 / 2.0
+                else:
+                    new_time += 1.0
+                continue
+            factor = next((f for prefix, f in factors.items()
+                           if base.startswith(prefix)), None)
+            bad = unsupported_expr_tokens(node.simple_string) \
+                if factor is not None else []
+            self.unsupported_exprs |= set(bad)
+            if factor is None or bad:
+                new_time += 1.0    # runs where it ran before
+                continue
+            good += 1
+            new_time += 1.0 / factor
+        if n == 0:
+            return 0.0, 1.0
+        return good / n, n / max(new_time, 1e-9)
 
     # ------------------------------------------------------------------
     @property
+    def estimated_speedup(self) -> float:
+        """Task-duration-weighted Amdahl estimate over the app's plans."""
+        if self._speedup_den <= 0:
+            return 1.0
+        return self._speedup_num / self._speedup_den
+
+    @property
     def score(self) -> float:
-        """The reference's qualification score: supported SQL task time,
-        discounted when reads are unsupported (QualAppInfo score calc)."""
-        score = float(self.supported_task_duration)
+        """The reference's qualification score: supported SQL task time
+        scaled by the registry-derived speedup estimate, discounted when
+        reads are unsupported (QualAppInfo score calc +
+        operatorsScore weighting)."""
+        score = float(self.supported_task_duration) * \
+            self.estimated_speedup
         if self.unsupported_read_formats:
             score *= 0.8
         if "UDF" in self.problems:

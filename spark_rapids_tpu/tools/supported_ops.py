@@ -1,0 +1,220 @@
+"""Registry-derived qualification data.
+
+The reference's qualification tool loads GENERATED per-operator data
+(supportedExecs.csv / supportedExprs.csv / operatorsScore.csv, consumed
+by tools/.../qualification/PluginTypeChecker.scala) so its scoring can
+never drift from what the plugin accepts.  Here the same data is read
+LIVE from the engine registries (plan/overrides.py EXEC_SIGS +
+EXPR_RULES — the tables the plan-rewrite engine itself consults), plus a
+per-exec speedup-factor table calibrated against bench.py's suite
+ratios (the operatorsScore analog)."""
+
+from __future__ import annotations
+
+import re
+from functools import lru_cache
+from typing import Dict, FrozenSet, List, Tuple
+
+# Spark physical-plan nodeName prefix -> (engine exec class, speedup
+# factor).  A row only counts as supported when its engine class is
+# actually registered in EXEC_SIGS, so deleting an exec from the engine
+# automatically downgrades qualification scores.
+_EXEC_MAP: List[Tuple[str, str, float]] = [
+    ("HashAggregate", "CpuHashAggregateExec", 3.0),
+    ("ObjectHashAggregate", "CpuHashAggregateExec", 3.0),
+    ("SortAggregate", "CpuHashAggregateExec", 3.0),
+    ("SortMergeJoin", "CpuJoinExec", 3.0),
+    ("ShuffledHashJoin", "CpuJoinExec", 3.0),
+    ("BroadcastHashJoin", "BroadcastHashJoinExec", 3.0),
+    ("BroadcastNestedLoopJoin", "BroadcastNestedLoopJoinExec", 2.0),
+    ("CartesianProduct", "NestedLoopJoinExec", 2.0),
+    ("TakeOrderedAndProject", "SortExec", 2.5),
+    ("Sort", "SortExec", 2.5),
+    ("Window", "WindowExec", 3.0),
+    ("Project", "ProjectExec", 2.0),
+    ("Filter", "FilterExec", 2.0),
+    ("Expand", "ExpandExec", 2.0),
+    ("Generate", "GenerateExec", 2.0),
+    ("Union", "UnionExec", 1.5),
+    ("Range", "RangeExec", 1.5),
+    ("Sample", "SampleExec", 1.5),
+    ("GlobalLimit", "GlobalLimitExec", 1.0),
+    ("LocalLimit", "LocalLimitExec", 1.0),
+    ("CollectLimit", "LocalLimitExec", 1.0),
+    ("Coalesce", "CoalesceBatchesExec", 1.0),
+    ("BroadcastExchange", "BroadcastExchangeExec", 2.0),
+    ("ShuffleExchange", "ShuffleExchangeExec", 2.5),
+    ("Exchange", "ShuffleExchangeExec", 2.5),
+]
+
+# wrapper/bookkeeping nodes: no engine exec needed; they neither count
+# toward nor block a stage
+TRANSPARENT_EXECS = frozenset({
+    "WholeStageCodegen", "InputAdapter", "ColumnarToRow", "RowToColumnar",
+    "AdaptiveSparkPlan", "ReusedExchange", "ReusedSubquery", "Subquery",
+    "SubqueryBroadcast", "AQEShuffleRead", "CustomShuffleReader",
+    "LocalTableScan", "SerializeFromObject", "DeserializeToObject",
+})
+
+# engine expression class -> the Spark SQL names it prints in plan
+# simple-strings (where the lowercased class name differs)
+_EXPR_ALIASES: Dict[str, Tuple[str, ...]] = {
+    "Average": ("avg", "mean"),
+    "StringReplace": ("replace",),
+    "StringRepeat": ("repeat",),
+    "Trim": ("trim",),
+    "TrimLeft": ("ltrim",),
+    "TrimRight": ("rtrim",),
+    "StringLPad": ("lpad",),
+    "StringRPad": ("rpad",),
+    "StringLocate": ("locate", "position"),
+    "SubstringIndex": ("substring_index",),
+    "RegExpExtract": ("regexp_extract",),
+    "RegExpReplace": ("regexp_replace",),
+    "RLike": ("rlike",),
+    "StringSplit": ("split",),
+    "ConcatWs": ("concat_ws",),
+    "GetJsonObject": ("get_json_object",),
+    "DayOfMonth": ("dayofmonth", "day"),
+    "DayOfWeek": ("dayofweek",),
+    "DayOfYear": ("dayofyear",),
+    "WeekDay": ("weekday",),
+    "TruncDate": ("trunc",),
+    "DateAdd": ("date_add",),
+    "DateSub": ("date_sub",),
+    "AddMonths": ("add_months",),
+    "LastDay": ("last_day",),
+    "DateDiff": ("datediff",),
+    "FromUnixTime": ("from_unixtime",),
+    "ToUnixTimestamp": ("to_unix_timestamp",),
+    "UnixTimestamp": ("unix_timestamp",),
+    "DateFormatClass": ("date_format",),
+    "TimeAdd": ("time_add",),
+    "TimeWindow": ("window",),
+    "Murmur3Hash": ("hash",),
+    "HiveHash": ("hive_hash",),
+    "MonotonicallyIncreasingID": ("monotonically_increasing_id",),
+    "SparkPartitionID": ("spark_partition_id",),
+    "InputFileName": ("input_file_name",),
+    "InputFileBlockStart": ("input_file_block_start",),
+    "InputFileBlockLength": ("input_file_block_length",),
+    "RowNumber": ("row_number",),
+    "DenseRank": ("dense_rank",),
+    "PercentRank": ("percent_rank",),
+    "CumeDist": ("cume_dist",),
+    "NTile": ("ntile",),
+    "WindowSpec": ("windowspecdefinition",),
+    "CollectList": ("collect_list",),
+    "CollectSet": ("collect_set",),
+    "StddevPop": ("stddev_pop",),
+    "StddevSamp": ("stddev_samp", "stddev", "std"),
+    "VariancePop": ("var_pop",),
+    "VarianceSamp": ("var_samp", "variance"),
+    "ApproximatePercentile": ("approx_percentile",
+                              "percentile_approx"),
+    "PivotFirst": ("pivotfirst",),
+    "NormalizeNaNAndZero": ("normalizenanandzero", "knownfloatingpointnormalized"),
+    "CreateNamedStruct": ("named_struct", "struct"),
+    "CreateArray": ("array",),
+    "CreateMap": ("map",),
+    "GetStructField": ("getstructfield",),
+    "GetArrayItem": ("getarrayitem",),
+    "ElementAt": ("element_at",),
+    "GetMapValue": ("getmapvalue",),
+    "MapKeys": ("map_keys",),
+    "MapValues": ("map_values",),
+    "MapEntries": ("map_entries",),
+    "TransformKeys": ("transform_keys",),
+    "TransformValues": ("transform_values",),
+    "ArrayTransform": ("transform",),
+    "ArrayFilter": ("filter",),
+    "ArrayExists": ("exists",),
+    "ArrayForAll": ("forall",),
+    "ArrayContains": ("array_contains",),
+    "ArrayMax": ("array_max",),
+    "ArrayMin": ("array_min",),
+    "SortArray": ("sort_array",),
+    "PosExplode": ("posexplode",),
+    "IntegralDivide": ("div",),
+    "UnaryMinus": ("negative",),
+    "UnaryPositive": ("positive",),
+    "Remainder": ("mod",),
+    "BitwiseNot": ("not",),
+    "ShiftLeft": ("shiftleft",),
+    "ShiftRight": ("shiftright",),
+    "ShiftRightUnsigned": ("shiftrightunsigned",),
+    "Logarithm": ("log",),
+    "ToDegrees": ("degrees",),
+    "ToRadians": ("radians",),
+    "Bound": ("boundreference",),
+    "EqualTo": ("equalto",),
+    "EqualNullSafe": ("equalnullsafe",),
+    "NullIf": ("nullif",),
+    "Nvl": ("nvl", "ifnull"),
+    "NaNvl": ("nanvl",),
+    "AtLeastNNonNulls": ("atleastnnonnulls",),
+    "Length": ("length", "char_length", "character_length"),
+    "BitLength": ("bit_length",),
+    "InitCap": ("initcap",),
+    "Like": ("like",),
+    "ScalarSubquery": ("scalar-subquery", "scalarsubquery"),
+}
+
+# tokens Spark prints structurally that never decide supportability
+NEUTRAL_TOKENS = frozenset({
+    "keys", "functions", "output", "aggregate", "arraybuffer", "list",
+    "some", "none", "cast", "ansi_cast", "promote_precision",
+    "check_overflow", "checkoverflow", "specifiedwindowframe",
+    "windowexpression", "sortorder", "exprid", "decimal", "dynamicpruning",
+    "unscaled", "unscaledvalue", "makedecimal", "staticinvoke",
+    "knownnotnull", "aggregateexpression", "alias", "attributereference",
+})
+
+
+@lru_cache(maxsize=1)
+def supported_exec_factors() -> Dict[str, float]:
+    """Spark nodeName prefix -> speedup factor, for execs whose engine
+    class is registered right now."""
+    from ..plan.overrides import EXEC_SIGS
+    registered = {c.__name__ for c in EXEC_SIGS}
+    return {spark: factor for spark, engine, factor in _EXEC_MAP
+            if engine in registered}
+
+
+@lru_cache(maxsize=1)
+def supported_expr_tokens() -> FrozenSet[str]:
+    """Lowercased Spark function tokens the expression registry covers."""
+    from ..plan.overrides import EXPR_RULES
+    toks = set()
+    for cls in EXPR_RULES:
+        name = cls.__name__
+        toks.add(name.lower())
+        # CamelCase -> snake_case (DenseRank -> dense_rank)
+        toks.add(re.sub(r"(?<!^)(?=[A-Z])", "_", name).lower())
+        toks.update(_EXPR_ALIASES.get(name, ()))
+    return frozenset(toks)
+
+
+_TOKEN_RE = re.compile(r"([A-Za-z_][A-Za-z0-9_]*)\s*\(")
+
+
+def unsupported_expr_tokens(simple_string: str) -> List[str]:
+    """Function-shaped tokens in a plan node's simple string that neither
+    the expression registry nor the structural-token list covers — the
+    node would fall back (the reference parses expressions out of plan
+    strings the same way, PluginTypeChecker.getNotSupportedExprs)."""
+    known = supported_expr_tokens()
+    execs = {s.lower() for s in supported_exec_factors()}
+    execs |= {s.lower() for s in TRANSPARENT_EXECS}
+    out = []
+    for tok in _TOKEN_RE.findall(simple_string):
+        t = tok.lower()
+        if t.startswith("partial_") or t.startswith("merge_") or \
+                t.startswith("finalmerge_"):
+            t = t.split("_", 1)[1]
+        if t.startswith("gpu") or t.startswith("tpu"):
+            t = t[3:]
+        if t in known or t in NEUTRAL_TOKENS or t in execs:
+            continue
+        out.append(tok)
+    return out

@@ -182,3 +182,86 @@ def test_compare_apps(tmp_path):
     rows = compare_apps([a, b])
     assert [r["appId"] for r in rows] == ["a1", "b1"]
     assert all(r["taskDuration"] == 1800 for r in rows)
+
+
+def _mk_rich_log(path, app_id, plan, app_name="RichApp"):
+    """Synthetic log with a caller-supplied SQL plan tree."""
+    events = [
+        {"Event": "SparkListenerLogStart", "Spark Version": "3.3.0"},
+        {"Event": "SparkListenerApplicationStart", "App Name": app_name,
+         "App ID": app_id, "Timestamp": 1000},
+        {"Event":
+         "org.apache.spark.sql.execution.ui."
+         "SparkListenerSQLExecutionStart",
+         "executionId": 0, "description": "q", "time": 1500,
+         "sparkPlanInfo": plan},
+        {"Event": "SparkListenerJobStart", "Job ID": 0,
+         "Submission Time": 1600,
+         "Stage Infos": [{"Stage ID": 0, "Stage Attempt ID": 0,
+                          "Stage Name": "s0", "Number of Tasks": 1}],
+         "Properties": {"spark.sql.execution.id": "0"}},
+        {"Event": "SparkListenerTaskEnd", "Stage ID": 0,
+         "Task Info": {"Task ID": 0, "Attempt": 0, "Launch Time": 1800,
+                       "Finish Time": 2800, "Failed": False,
+                       "Executor ID": "1"},
+         "Task Metrics": {"Executor Run Time": 1000,
+                          "Executor CPU Time": 900_000_000}},
+        {"Event":
+         "org.apache.spark.sql.execution.ui.SparkListenerSQLExecutionEnd",
+         "executionId": 0, "time": 3100},
+        {"Event": "SparkListenerApplicationEnd", "Timestamp": 4000},
+    ]
+    with open(path, "w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+    return path
+
+
+def _node(name, simple, *children):
+    return {"nodeName": name, "simpleString": simple,
+            "children": list(children), "metrics": []}
+
+
+def test_qualification_registry_scoring_golden(tmp_path):
+    """Scores come from the LIVE registries (tools/supported_ops.py):
+    heavyweight accelerable operators outrank pass-through plans, and an
+    unregistered expression inside a supported exec downgrades exactly
+    that node (ref PluginTypeChecker + operatorsScore weighting)."""
+    scan = _node("Scan parquet", "FileScan parquet [k,v]")
+    heavy = _mk_rich_log(
+        str(tmp_path / "heavy"), "app-heavy",
+        _node("SortMergeJoin", "SortMergeJoin [k], [k2], Inner",
+              _node("HashAggregate",
+                    "HashAggregate(keys=[k], functions=[sum(v), avg(v)])",
+                    scan),
+              _node("Sort", "Sort [k2 ASC NULLS FIRST]", scan)))
+    passthrough = _mk_rich_log(
+        str(tmp_path / "passthrough"), "app-passthrough",
+        _node("LocalLimit", "LocalLimit 10",
+              _node("Coalesce", "Coalesce 1", scan)))
+    bad_expr = _mk_rich_log(
+        str(tmp_path / "badexpr"), "app-badexpr",
+        _node("SortMergeJoin", "SortMergeJoin [k], [k2], Inner",
+              _node("HashAggregate",
+                    "HashAggregate(keys=[k], "
+                    "functions=[some_exotic_udaf(v)])",
+                    scan),
+              _node("Sort", "Sort [k2 ASC NULLS FIRST]", scan)))
+    outdir = str(tmp_path / "out")
+    results = qualify([heavy, passthrough, bad_expr], outdir)
+    by_id = {r.app.app_id: r for r in results}
+    # identical task time everywhere: ranking is pure op discrimination
+    assert by_id["app-heavy"].score > by_id["app-badexpr"].score
+    assert by_id["app-heavy"].score > by_id["app-passthrough"].score
+    assert "some_exotic_udaf" in by_id["app-badexpr"].unsupported_exprs
+    assert by_id["app-heavy"].unsupported_exprs == set()
+
+    golden = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "goldens", "qualification_scores.csv")
+    got_rows = [[r.app.app_id, f"{r.score:.2f}"] for r in results]
+    if not os.path.exists(golden):  # first run materializes the golden
+        with open(golden, "w", newline="") as f:
+            csv.writer(f).writerows(got_rows)
+    with open(golden) as f:
+        want_rows = [row for row in csv.reader(f) if row]
+    assert got_rows == want_rows, (got_rows, want_rows)
