@@ -218,14 +218,83 @@ def measure_cache_cold(n_rows: int) -> float:
         shutil.rmtree(cache_dir, ignore_errors=True)
 
 
+def time_pyspark(fact, dim, pq_path, out_root, repeats: int = 3):
+    """The same 7 queries on local-mode Spark-CPU — the reference's true
+    comparison target (FAQ.md's 3-7x bar).  Returns per-query medians,
+    or None when pyspark is not importable (the hermetic engine
+    environment ships none; CI environments with pyspark report it)."""
+    try:
+        from pyspark.sql import SparkSession, functions as SF
+        from pyspark.sql.window import Window as SW
+    except ImportError:
+        return None
+    spark = (SparkSession.builder.master("local[*]")
+             .config("spark.sql.shuffle.partitions", "4")
+             .config("spark.ui.enabled", "false")
+             .appName("bench-baseline").getOrCreate())
+    fdf = spark.createDataFrame(fact.to_pandas())
+    ddf = spark.createDataFrame(dim.to_pandas())
+    fdf.cache().count()
+    ddf.cache().count()
+
+    def q1():
+        return (fdf.filter(SF.col("v") > -(10**6) // 2).groupBy("k")
+                .agg(SF.sum("v"), SF.avg("f"), SF.count("*")).collect())
+
+    def q2():
+        return (fdf.join(ddf, on="k").groupBy("k")
+                .agg(SF.sum("w")).collect())
+
+    def q3():
+        return fdf.orderBy("k", "v").collect()
+
+    def q4():
+        w = SW.partitionBy("k").orderBy("v")
+        return fdf.select("k", "v", SF.row_number().over(w),
+                          SF.sum("v").over(w)).collect()
+
+    def q5():
+        return (spark.read.parquet(pq_path).filter(SF.col("f") < 0.5)
+                .groupBy("k").agg(SF.sum("v"), SF.count("*")).collect())
+
+    def q6():
+        return (fdf.repartition(4, "k").join(ddf.repartition(2, "k"),
+                                             on="k")
+                .groupBy("k").agg(SF.sum("w")).collect())
+
+    def q7():
+        out = os.path.join(out_root, f"spark_out_{time.time_ns()}")
+        fdf.filter(SF.col("v") > 0).write.mode("overwrite").parquet(out)
+        shutil.rmtree(out, ignore_errors=True)
+
+    names = ["agg", "join", "sort", "window", "parquet", "shuffle_join",
+             "write"]
+    out = {}
+    for name, q in zip(names, (q1, q2, q3, q4, q5, q6, q7)):
+        q()
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            q()
+            times.append(time.perf_counter() - t0)
+        out[name] = sorted(times)[len(times) // 2]
+    spark.stop()
+    return out
+
+
 def main():
-    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    pos = [a for a in sys.argv[1:] if not a.startswith("--")]
+    n_rows = int(pos[0]) if pos else 1_000_000
+    with_pyspark = "--baseline=pyspark" in sys.argv[1:]
     fact, dim = make_tables(n_rows)
     root = tempfile.mkdtemp(prefix="spark_rapids_tpu_bench_")
+    spark_cpu = None
     try:
         pq_path = write_parquet_input(fact, root)
         tpu, tpu_compile = time_engine(True, fact, dim, pq_path, root)
         cpu, _ = time_engine(False, fact, dim, pq_path, root)
+        if with_pyspark:
+            spark_cpu = time_pyspark(fact, dim, pq_path, root)
     finally:
         shutil.rmtree(root, ignore_errors=True)
     tpu_total = sum(tpu.values())
@@ -242,14 +311,23 @@ def main():
                      "mb_per_s": round(bps / 1e6, 1),
                      "hbm_pct": round(100.0 * bps / _HBM_BYTES_PER_S, 4)}
     cold_s = measure_cache_cold(n_rows)
-    print(json.dumps({
+    out = {
         "metric": "sql_suite_rows_per_sec",
         "value": round(value, 1),
         "unit": "rows/s",
         "vs_baseline": round(cpu_total / tpu_total, 3),
         "cache_cold_compile_s": round(cold_s, 2),
         "detail": detail,
-    }))
+    }
+    if with_pyspark:
+        if spark_cpu is None:
+            out["vs_spark_cpu"] = None   # pyspark not importable here
+        else:
+            out["vs_spark_cpu"] = round(
+                sum(spark_cpu.values()) / tpu_total, 3)
+            for k in detail:
+                detail[k]["spark_cpu_s"] = round(spark_cpu[k], 3)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
