@@ -147,9 +147,9 @@ object SpecBuilder {
     case StartsWith(l, r)   => nary("startswith", Seq(l, r))
     case EndsWith(l, r)     => nary("endswith", Seq(l, r))
     case Concat(cs)         => nary("concat", cs)
-    case t: StringTrim      => nary("trim", Seq(t.srcStr))
-    case t: StringTrimLeft  => nary("ltrim", Seq(t.srcStr))
-    case t: StringTrimRight => nary("rtrim", Seq(t.srcStr))
+    case t: StringTrim if t.trimStr.isEmpty      => nary("trim", Seq(t.srcStr))
+    case t: StringTrimLeft if t.trimStr.isEmpty  => nary("ltrim", Seq(t.srcStr))
+    case t: StringTrimRight if t.trimStr.isEmpty => nary("rtrim", Seq(t.srcStr))
     // --- datetime tier ----------------------------------------------------
     case Year(c)       => nary("year", Seq(c))
     case Month(c)      => nary("month", Seq(c))

@@ -137,3 +137,28 @@ def test_fetch_speculation_validates_and_falls_back():
     assert got.column("k").to_pylist() == want.column("k").to_pylist()
     assert got.column("s").to_pylist() == want.column("s").to_pylist()
     assert fetch_mod._LAST_PLAN[pkey][1] == 0  # repeat count reset
+
+
+def test_fetch_extra_scalars_ride_along():
+    """Deferred speculation guards ride the sizes transfer: values come
+    back exactly, the batch is unchanged, and the speculative one-sync
+    plan still validates on repeats."""
+    rng = np.random.default_rng(44)
+    tb = pa.table({
+        "a": pa.array(rng.integers(0, 1000, 500).astype(np.int64)),
+        "b": pa.array(rng.random(500)),
+    })
+    rb = tb.combine_chunks().to_batches()[0]
+    for _ in range(3):   # repeats arm + use the speculative plan
+        b = batch_to_device(rb)
+        out, extras = fetch_batch(
+            b, extra_scalars=[jnp.bool_(True), jnp.bool_(False),
+                              jnp.int64(12345)])
+        assert list(extras) == [1, 0, 12345]
+        back = pa.Table.from_batches(
+            [batch_to_arrow(DeviceBatch(out.columns, out.num_rows,
+                                        tb.schema.names))])
+        assert back.equals(tb)
+    # host-side batches answer extras without device work
+    host_out, host_extras = fetch_batch(out, extra_scalars=[jnp.bool_(True)])
+    assert list(host_extras) == [1]
