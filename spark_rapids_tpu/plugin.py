@@ -26,10 +26,16 @@ log = logging.getLogger("spark_rapids_tpu.plugin")
 
 
 def _host_cpu_fingerprint() -> str:
-    """Identify the host CPU feature set for the compilation-cache key.
+    """Identify the host machine instance for the compilation-cache key.
 
-    Prefers the kernel's cpuinfo flags (the exact feature list XLA:CPU
-    targets); falls back to the machine arch + CPU model name."""
+    CPU feature flags alone are NOT enough: two VM instances can report
+    identical cpuinfo flags while their pCPUs differ in ways XLA:CPU's
+    AOT executables bake in — loading a stale instance's entry then
+    SIGILLs/SEGVs inside the cache read (observed: a suite run crashing
+    in get_executable_and_time on an entry a previous instance wrote).
+    Scoping by machine-id/boot-id keeps the cache warm for the whole
+    life of an instance (what repeated queries and CI runs need) while
+    making cross-instance AOT reuse — the only unsafe case — a miss."""
     import hashlib
     import platform
 
@@ -44,8 +50,17 @@ def _host_cpu_fingerprint() -> str:
                     flags = line.split(":", 1)[1].strip()
     except OSError:
         flags = platform.processor()
+    instance = ""
+    for p in ("/etc/machine-id", "/proc/sys/kernel/random/boot_id"):
+        try:
+            with open(p) as f:
+                instance = f.read().strip()
+            if instance:
+                break
+        except OSError:
+            continue
     return platform.machine() + "|" + \
-        hashlib.sha1(flags.encode()).hexdigest()[:12]
+        hashlib.sha1(f"{flags}|{instance}".encode()).hexdigest()[:12]
 
 
 class PluginInitError(RuntimeError):
