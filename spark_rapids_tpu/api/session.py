@@ -208,7 +208,17 @@ class TpuSession:
                 result = final_plan.execute_collect(ctx)
             except SpeculativeSizingMiss:
                 # a capacity guess undershot (guard came back false):
-                # nothing was surfaced — re-execute with exact sizing
+                # nothing was surfaced — but any cache materialization
+                # this run streamed is built on truncated batches and
+                # must be discarded before the exact re-execution
+                from ..io.cached_batch import CacheWriteExec
+
+                def _reset_cache(node):
+                    if isinstance(node, CacheWriteExec):
+                        node.entry.materialized = False
+                        node.entry.partitions = []
+                        node.entry.schema = None
+                final_plan.foreach(_reset_cache)
                 self.release_plan_shuffles(final_plan)
                 final_plan = self.prepare_plan(lp)
                 ctx = ExecContext(self.conf)

@@ -141,6 +141,23 @@ class Join(LogicalPlan):
         rn, rt = self.children[1].schema()
         if self.how in ("left_semi", "left_anti"):
             return ln, lt
+        if self.using:
+            # USING semantics (mirrors plan_join's output projection):
+            # coalesced key columns first, then each side's remainder
+            names, types = [], []
+            for k in self.using:
+                names.append(k)
+                types.append(rt[rn.index(k)] if self.how == "right"
+                             else lt[ln.index(k)])
+            for n, t_ in zip(ln, lt):
+                if n not in self.using:
+                    names.append(n)
+                    types.append(t_)
+            for n, t_ in zip(rn, rt):
+                if n not in self.using:
+                    names.append(n)
+                    types.append(t_)
+            return names, types
         return ln + rn, lt + rt
 
 

@@ -125,3 +125,24 @@ def test_compile_lean_sort_matches_carry():
         outs.append(s.create_dataframe(tb, num_partitions=2)
                     .sort(col("k"), col("v").desc(), col("s")).collect())
     assert outs[0].equals(outs[1])
+
+
+def test_speculation_miss_does_not_poison_df_cache():
+    """A cache() materialization streamed during a mispredicted run must
+    be discarded before re-execution — a truncated blob surviving into
+    CachedScanExec would silently corrupt every later query."""
+    n, dup = 4000, 64
+    probe = pa.table({
+        "k": pa.array((np.arange(n, dtype=np.int64) % 50)),
+        "v": pa.array(np.arange(n, dtype=np.int64))})
+    build = pa.table({
+        "k": pa.array(np.repeat(np.arange(50, dtype=np.int64), dup)),
+        "w": pa.array(np.arange(50 * dup, dtype=np.int64))})
+    s = _session(True)
+    df = (s.create_dataframe(probe)
+          .join(s.create_dataframe(build), on="k").cache())
+    first = df.collect()           # miss -> re-execute -> cache rebuilt
+    assert first.num_rows == n * dup
+    second = df.collect()          # served from the cache
+    assert second.num_rows == n * dup
+    assert _sorted(first).equals(_sorted(second))
