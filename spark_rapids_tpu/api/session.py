@@ -74,24 +74,36 @@ class TpuSession:
         # compile cache dir the plugin just configured
         self._init_sort_mode(conf)
 
+    _auto_sort_mode_decided = False
+
     def _init_sort_mode(self, conf: RapidsConf) -> None:
         """Pick the sort kernel structure (ops/carry.py module doc):
         'auto' = compile-lean exactly while the persistent XLA compile
-        cache is cold, throughput carry-sorts once it is warm."""
+        cache is cold, throughput carry-sorts once it is warm.  The
+        auto probe decides ONCE per process — this process's own cache
+        writes must not flip kernel structure between sessions."""
         import os
         from ..ops.carry import set_compile_lean
         mode = conf.get(cfg.SORT_COMPILE_LEAN)
         if mode in ("on", "off"):
             set_compile_lean(mode == "on")
+            TpuSession._auto_sort_mode_decided = True
+            return
+        if TpuSession._auto_sort_mode_decided:
             return
         try:
             import jax
             d = jax.config.jax_compilation_cache_dir
-            cold = not d or not os.path.isdir(d) or \
-                not any(os.scandir(d))
+            if not d:
+                # no persistent cache configured yet (plugin runs only
+                # for device sessions) — leave the decision to a later
+                # session that actually compiles device kernels
+                return
+            cold = not os.path.isdir(d) or not any(os.scandir(d))
         except Exception:
             cold = False
         set_compile_lean(cold)
+        TpuSession._auto_sort_mode_decided = True
 
     # -- conf ---------------------------------------------------------------
     @property
