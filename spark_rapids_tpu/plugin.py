@@ -233,6 +233,14 @@ class TpuExecutorPlugin:
         import os
         if not self.conf.get(cfg.COMPILATION_CACHE_ENABLED):
             return
+        if os.environ.get("SPARK_RAPIDS_TPU_DISABLE_COMPILE_CACHE"):
+            # escape hatch for environments running many engine
+            # processes against one cache dir concurrently: XLA:CPU AOT
+            # loads from a dir under concurrent write have been observed
+            # to segfault inside the cache read (tests/conftest.py sets
+            # this — the hermetic suite relies on the in-process jit
+            # table, and must never crash on a cache race)
+            return
         cache_dir = os.path.expanduser(
             self.conf.get(cfg.COMPILATION_CACHE_DIR))
         try:

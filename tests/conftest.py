@@ -6,6 +6,11 @@ import os
 
 os.environ["PALLAS_AXON_POOL_IPS"] = ""   # disable the axon TPU tunnel
 os.environ["JAX_PLATFORMS"] = "cpu"
+# the hermetic suite must never crash on a persistent-cache race: CPU
+# AOT loads from a dir that another engine process is writing have been
+# observed to segfault inside jax's cache read.  The in-process jit
+# table carries the suite's warmth; device (axon) runs keep persistence.
+os.environ["SPARK_RAPIDS_TPU_DISABLE_COMPILE_CACHE"] = "1"
 # silence the cpu_aot_loader machine-feature ERROR spam: XLA bakes
 # +prefer-no-scatter/-gather pseudo-features into its own AOT cache
 # entries, so even same-host loads log a scary (but benign) mismatch
