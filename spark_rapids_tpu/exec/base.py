@@ -48,6 +48,18 @@ Batch = DeviceBatch  # alias: same structure on both engines
 
 _JIT_CACHE: Dict[tuple, object] = {}
 
+# Live-executable budget.  Every compiled XLA:CPU executable keeps LLVM
+# JIT code segments mapped (3 mappings per module; the thunk runtime
+# emits MANY modules per program), and a long-lived process that compiles
+# unboundedly walks into the kernel's vm.max_map_count — after which any
+# native allocation segfaults.  The table is an LRU: evicting a jitted
+# fn drops the executable and unmaps its code; a re-entry re-traces and
+# (persistent cache permitting) reloads instead of recompiling.  The
+# default keeps far more kernels live than any single query uses (a big
+# fused program carries ~40 kernel modules ≈ 120 mappings, so ~192 live
+# programs stay well inside the default 65530-map budget).
+_JIT_CACHE_MAX = 192
+
 
 def process_jit(key: tuple, make_fn):
     """Return the process-cached jitted function for `key`, building it
@@ -64,6 +76,12 @@ def process_jit(key: tuple, make_fn):
     f = _JIT_CACHE.get(key)
     if f is None:
         f = jax.jit(make_fn())
+        while len(_JIT_CACHE) >= _JIT_CACHE_MAX:
+            _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
+        _JIT_CACHE[key] = f
+    else:
+        # move-to-end: LRU order rides dict insertion order
+        _JIT_CACHE.pop(key)
         _JIT_CACHE[key] = f
     return f
 

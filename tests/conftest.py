@@ -6,14 +6,22 @@ import os
 
 os.environ["PALLAS_AXON_POOL_IPS"] = ""   # disable the axon TPU tunnel
 os.environ["JAX_PLATFORMS"] = "cpu"
-# The persistent cache stays ON for the suite: a full fresh-compile run
-# JITs ~600 programs in one process and XLA:CPU has segfaulted compiling
-# late programs in such runs (LLVM JIT aging), while warm-cache solo
-# runs have been stable across every round.  The cache is scoped to the
-# machine instance (plugin._host_cpu_fingerprint), so stale-instance AOT
-# loads — the other observed crash — cannot occur.  Set
-# SPARK_RAPIDS_TPU_DISABLE_COMPILE_CACHE=1 only when running several
-# engine processes concurrently against one cache dir.
+# A full suite process drives the kernel's vm.max_map_count (65530)
+# into the ground: glibc malloc serves every large XLA:CPU buffer with
+# its own anonymous mmap, and ~600 jitted programs' worth of arrays put
+# the process at ~36k maps by mid-suite and over the limit around the
+# window tests — at which point ANY native allocation (a compile, a
+# cache serialize, a cache read) segfaults.  mallopt(M_MMAP_MAX, 0)
+# routes large allocations through the heap instead; map count stays
+# flat and the crashes disappear.  (Root-caused from three distinct
+# fatal stacks that all struck at the same process age.)
+import ctypes
+
+try:
+    _libc = ctypes.CDLL("libc.so.6", use_errno=True)
+    _libc.mallopt(-4, 0)        # M_MMAP_MAX = 0
+except Exception:               # non-glibc platforms: keep defaults
+    pass
 # silence the cpu_aot_loader machine-feature ERROR spam: XLA bakes
 # +prefer-no-scatter/-gather pseudo-features into its own AOT cache
 # entries, so even same-host loads log a scary (but benign) mismatch
@@ -29,6 +37,13 @@ if "xla_cpu_parallel_codegen_split_count" not in xla_flags:
     # LLVM codegen has crashed nondeterministically deep into such runs
     # (segfault inside backend_compile_and_load) — serialize it
     xla_flags += " --xla_cpu_parallel_codegen_split_count=1"
+if "xla_cpu_use_thunk_runtime" not in xla_flags:
+    # the thunk runtime JITs one LLVM module PER KERNEL (~16k modules x
+    # 3 mappings for this suite), blowing through the kernel's
+    # vm.max_map_count (65530) mid-run — at which point any native
+    # allocation segfaults.  The legacy runtime emits one module per
+    # executable: map count stays ~2k for the same suite.
+    xla_flags += " --xla_cpu_use_thunk_runtime=false"
 os.environ["XLA_FLAGS"] = xla_flags.strip()
 
 # the axon sitecustomize imports jax at interpreter start, so env vars are
@@ -44,3 +59,20 @@ import pytest  # noqa: E402
 def tpu_session():
     from spark_rapids_tpu.api.session import TpuSession
     return TpuSession.builder().get_or_create()
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_jit_code_residency():
+    """Flush compiled-code caches between test modules.
+
+    Beyond the engine's own LRU (exec/base.py process_jit), jax keeps
+    GLOBAL caches for eager ops and dropped jits; across ~40 modules the
+    accumulated LLVM JIT segments walk the process into the kernel's
+    vm.max_map_count, after which any native allocation segfaults.
+    In-module kernel reuse (what the tests exercise) is unaffected."""
+    yield
+    import jax
+
+    from spark_rapids_tpu.exec.base import clear_jit_cache
+    clear_jit_cache()
+    jax.clear_caches()
