@@ -187,10 +187,13 @@ def _plan_uncached(lp: L.LogicalPlan, conf) -> eb.Exec:
     if isinstance(lp, L.Union):
         return UnionExec([plan(c, conf) for c in lp.children])
     if isinstance(lp, L.Distinct):
+        # plan as GROUP BY all columns so the multi-partition path gets
+        # the same co-locating hash exchange an aggregate gets (per-
+        # partition-only dedup would leak cross-partition duplicates)
         names, dtypes = lp.schema()
         grouping = [AttributeReference(n) for n in names]
-        return CpuHashAggregateExec(grouping, [],
-                                    plan(lp.children[0], conf))
+        return _plan_uncached(L.Aggregate(grouping, [], lp.children[0]),
+                              conf)
     if isinstance(lp, L.Window):
         from ..exec.window import WindowExec
         child = plan(lp.children[0], conf)
