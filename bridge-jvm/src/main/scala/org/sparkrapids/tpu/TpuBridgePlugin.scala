@@ -130,6 +130,14 @@ object SpecBuilder {
         case _                     => return None
       }
       nary(op, Seq(b.left, b.right))
+    case In(v, list) if list.forall(_.isInstanceOf[Literal]) =>
+      for {
+        vs <- expr(v)
+        items <- {
+          val xs = list.map(expr)
+          if (xs.exists(_.isEmpty)) None else Some(xs.flatten)
+        }
+      } yield s"""{"op": "in", "children": [$vs], "values": [${items.mkString(", ")}]}"""
     case Not(EqualTo(l, r)) => nary("ne", Seq(l, r))
     case Not(c)             => nary("not", Seq(c))
     case IsNull(c)          => nary("isnull", Seq(c))

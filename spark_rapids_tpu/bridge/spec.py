@@ -91,6 +91,10 @@ def expr_from_spec(spec: Dict):
         return table[op](*kids)
     if op == "cast":
         return Cast(kids[0], _parse_type(spec["type"]))
+    if op == "in":
+        # children[0] is the value; the literal list rides "values"
+        items = [expr_from_spec(v) for v in spec.get("values", [])]
+        return pr.In(kids[0], items)
     if op == "ne":
         return pr.Not(pr.EqualTo(*kids))
     if op == "not":

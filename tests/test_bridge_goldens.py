@@ -120,3 +120,18 @@ def test_string_datetime_cast_golden():
     assert got.column("y").to_pylist() == [2021, 2023, 2025]
     assert got.column("vi").to_pylist() == [1, 3, 5]
     assert got.schema.field("vi").type == pa.int32()
+
+
+def test_in_predicate_spec():
+    """IN over a literal list round-trips through the spec language."""
+    spec = {
+        "input": {"schema": [["k", "bigint"]]},
+        "inputs": [],
+        "ops": [{"op": "filter", "condition": {
+            "op": "in", "children": [{"col": "k"}],
+            "values": [{"lit": 2, "type": "bigint"},
+                       {"lit": 5, "type": "bigint"}]}}],
+    }
+    tb = pa.table({"k": pa.array(np.arange(10, dtype=np.int64))})
+    got = _run(spec, tb)
+    assert sorted(got.column("k").to_pylist()) == [2, 5]
