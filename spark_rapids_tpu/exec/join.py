@@ -307,10 +307,6 @@ class HashJoinExec(Exec):
         whose output rides the (probe, build) gather maps only."""
         if self.how not in ("inner", "left"):
             return False
-        if self._bound_condition is not None and self.how != "inner":
-            # conditional left runs the expand+repair kernel, which the
-            # speculative fused program does not carry
-            return False
         def flat(c):
             return c.offsets is None and c.data_hi is None and \
                 not c.children
@@ -324,12 +320,22 @@ class HashJoinExec(Exec):
         order, lo, counts, sizes, _ = self._count(jnp, build, probe)
         zeros_p = [0] * len(probe.columns)
         zeros_b = [0] * len(build.columns)
-        out = self._expand(jnp, build, probe, order, lo, counts, out_cap,
-                           zeros_p, zeros_b)
-        if self._bound_condition is not None and self.how == "inner":
-            pctx = EvalContext(jnp, out)
-            out = apply_filter(jnp, out, self._bound_condition.eval(pctx),
-                               self.output_names)
+        if self._bound_condition is not None and self.how == "left":
+            # the conditional-left expand+repair kernel fuses in too;
+            # its output never exceeds the sizing bound (eff counts
+            # already include the null-extension rows, and the repair
+            # only shrinks)
+            out = self._expand_left_cond(jnp, build, probe, order, lo,
+                                         counts, out_cap, zeros_p,
+                                         zeros_b)
+        else:
+            out = self._expand(jnp, build, probe, order, lo, counts,
+                               out_cap, zeros_p, zeros_b)
+            if self._bound_condition is not None and self.how == "inner":
+                pctx = EvalContext(jnp, out)
+                out = apply_filter(jnp, out,
+                                   self._bound_condition.eval(pctx),
+                                   self.output_names)
         return out, sizes[0] <= np.int64(out_cap)
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
