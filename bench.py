@@ -207,7 +207,7 @@ def measure_cache_cold(n_rows: int) -> float:
     try:
         r = subprocess.run(
             [sys.executable, "-c", _COLD_SCRIPT, str(n_rows), cache_dir],
-            capture_output=True, text=True, timeout=900)
+            capture_output=True, text=True, timeout=300)
         for line in r.stdout.splitlines():
             if line.startswith("COLD_SECONDS="):
                 return float(line.split("=")[1])
