@@ -284,6 +284,12 @@ object SpecBuilder {
       case _: RowNumber => Some(("row_number", None, None))
       case _: Rank      => Some(("rank", None, None))
       case _: DenseRank => Some(("dense_rank", None, None))
+      case _: PercentRank => Some(("percent_rank", None, None))
+      case _: CumeDist    => Some(("cume_dist", None, None))
+      case NTile(Literal(n: Int, _)) =>
+        // the Python side reads "offset" for lead/lag and "n" for
+        // ntile; reuse the offset slot, renamed at emit time
+        Some(("ntile", None, Some(n)))
       case l: Lead => (l.offset, l.default) match {
         case (Literal(o: Int, _), Literal(null, _)) =>
           Some(("lead", Some(l.input), Some(o)))
@@ -321,7 +327,9 @@ object SpecBuilder {
         case Some(c) => expr(c).getOrElse(return None)
         case None    => "null"
       }
-      val off = offset.map(o => s""", "offset": $o""").getOrElse("")
+      val off = offset.map(o =>
+        if (fname == "ntile") s""", "n": $o"""
+        else s""", "offset": $o""").getOrElse("")
       val fjson =
         s"""{"fn": ${json(fname)}, "expr": $childJs, "name": ${json(name)}$off}"""
       val key = (spec.partitionSpec.map(_.sql), spec.orderSpec.map(_.sql))

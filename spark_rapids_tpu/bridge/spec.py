@@ -122,7 +122,8 @@ def _agg_from_spec(a: Dict):
     return AggregateExpression(agg, a.get("name") or fn)
 
 
-_WINDOW_FNS = ("row_number", "rank", "dense_rank", "sum", "count", "avg",
+_WINDOW_FNS = ("row_number", "rank", "dense_rank", "percent_rank",
+               "cume_dist", "ntile", "sum", "count", "avg",
                "min", "max", "lead", "lag")
 
 
@@ -150,6 +151,15 @@ def _window_from_spec(op: Dict) -> List:
             func = Rank()
         elif fn == "dense_rank":
             func = DenseRank()
+        elif fn == "percent_rank":
+            from ..expr.window import PercentRank
+            func = PercentRank()
+        elif fn == "cume_dist":
+            from ..expr.window import CumeDist
+            func = CumeDist()
+        elif fn == "ntile":
+            from ..expr.window import NTile
+            func = NTile(int(f.get("n", 1)))
         elif fn == "lead":
             func = Lead(child, int(f.get("offset", 1)))
         elif fn == "lag":

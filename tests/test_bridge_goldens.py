@@ -135,3 +135,32 @@ def test_in_predicate_spec():
     tb = pa.table({"k": pa.array(np.arange(10, dtype=np.int64))})
     got = _run(spec, tb)
     assert sorted(got.column("k").to_pylist()) == [2, 5]
+
+
+def test_window_ranking_tier_spec():
+    """percent_rank / cume_dist / ntile ride the window spec op."""
+    spec = {
+        "input": {"schema": [["k", "bigint"], ["v", "bigint"]]},
+        "inputs": [],
+        "ops": [{"op": "window",
+                 "partitionBy": [{"col": "k"}],
+                 "orderBy": [{"expr": {"col": "v"}, "ascending": True,
+                              "nullsFirst": True}],
+                 "funcs": [
+                     {"fn": "percent_rank", "expr": None, "name": "pr"},
+                     {"fn": "cume_dist", "expr": None, "name": "cd"},
+                     {"fn": "ntile", "expr": None, "n": 4, "name": "nt"}]}],
+    }
+    rng = np.random.default_rng(12)
+    tb = pa.table({"k": pa.array(rng.integers(0, 4, 80).astype(np.int64)),
+                   "v": pa.array(rng.permutation(80).astype(np.int64))})
+    got = _run(spec, tb).sort_by([("k", "ascending"), ("v", "ascending")])
+    df = tb.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    want_pr = df.groupby("k")["v"].rank(method="min").sub(1) / \
+        (df.groupby("k")["v"].transform("count") - 1)
+    assert np.allclose(got.column("pr").to_numpy(), want_pr.to_numpy())
+    want_cd = df.groupby("k")["v"].rank(method="max") / \
+        df.groupby("k")["v"].transform("count")
+    assert np.allclose(got.column("cd").to_numpy(), want_cd.to_numpy())
+    nt = got.column("nt").to_numpy()
+    assert nt.min() == 1 and nt.max() == 4
