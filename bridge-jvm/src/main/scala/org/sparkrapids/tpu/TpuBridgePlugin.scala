@@ -178,6 +178,8 @@ object SpecBuilder {
       case a: Average => Some(("avg", Some(a.child)))
       case m: Min     => Some(("min", Some(m.child)))
       case m: Max     => Some(("max", Some(m.child)))
+      case f: First if !f.ignoreNulls => Some(("first", Some(f.child)))
+      case l: Last if !l.ignoreNulls  => Some(("last", Some(l.child)))
       case Count(Seq(Literal(1, _))) => Some(("count", None))
       case Count(Seq(c))             => Some(("count", Some(c)))
       case _                         => None
