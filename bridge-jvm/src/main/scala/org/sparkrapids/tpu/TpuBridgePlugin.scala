@@ -265,9 +265,9 @@ object SpecBuilder {
     Some(s""""condition": $cond""")
   }
 
-  /** Default-frame check: the spec language carries no frame clause, so
-   *  only Spark's default frames translate (ranking functions force
-   *  ROWS UNBOUNDED..CURRENT; ordered aggregates default to RANGE
+  /** Default-frame check: Spark's defaults match the engine's, so these
+   *  emit no frame clause (ranking functions force ROWS
+   *  UNBOUNDED..CURRENT; ordered aggregates default to RANGE
    *  UNBOUNDED..CURRENT; unordered to the whole partition). */
   private def defaultFrame(frame: Expression, hasOrder: Boolean): Boolean =
     frame match {
@@ -280,6 +280,30 @@ object SpecBuilder {
       case UnspecifiedFrame => true
       case _ => false
     }
+
+  /** Non-default frames with literal integer bounds emit an explicit
+   *  frame clause ("" = engine default; None = untranslatable). */
+  private def frameJson(frame: Expression, hasOrder: Boolean): Option[String] = {
+    if (defaultFrame(frame, hasOrder)) return Some("")
+    def bound(e: Expression): Option[String] = e match {
+      case UnboundedPreceding => Some("\"unboundedPreceding\"")
+      case UnboundedFollowing => Some("\"unboundedFollowing\"")
+      case CurrentRow         => Some("\"currentRow\"")
+      case Literal(n: Int, _)  => Some(n.toString)
+      case Literal(n: Long, _) => Some(n.toString)
+      case _ => None
+    }
+    frame match {
+      case SpecifiedWindowFrame(ft, lo, hi) =>
+        val t = ft match {
+          case RowFrame   => "rows"
+          case RangeFrame => "range"
+        }
+        for (l <- bound(lo); h <- bound(hi)) yield
+          s""", "frame": {"type": ${json(t)}, "start": $l, "end": $h}"""
+      case _ => None
+    }
+  }
 
   private def windowFn(e: Expression): Option[(String, Option[Expression], Option[Int])] =
     e match {
@@ -311,18 +335,18 @@ object SpecBuilder {
   /** Window translation: one spec window op per distinct
    *  (partitionBy, orderBy) group, in output order. */
   private def windowOps(w: WindowExec): Option[List[String]] = {
-    case class Grp(part: Seq[Expression], order: Seq[SortOrder])
+    case class Grp(part: Seq[Expression], order: Seq[SortOrder],
+                   frame: String)
     val grouped = scala.collection.mutable.LinkedHashMap
-      .empty[(Seq[String], Seq[String]), (Grp, ArrayBuffer[String])]
+      .empty[(Seq[String], Seq[String], String), (Grp, ArrayBuffer[String])]
     for (ne <- w.windowExpression) {
       val (name, we) = ne match {
         case Alias(we: WindowExpression, n) => (n, we)
         case _ => return None
       }
       val spec = we.windowSpec
-      if (!defaultFrame(spec.frameSpecification, spec.orderSpec.nonEmpty)) {
-        return None
-      }
+      val fj = frameJson(spec.frameSpecification,
+                         spec.orderSpec.nonEmpty).getOrElse(return None)
       val fn = windowFn(we.windowFunction).getOrElse(return None)
       val (fname, child, offset) = fn
       val childJs = child match {
@@ -334,9 +358,10 @@ object SpecBuilder {
         else s""", "offset": $o""").getOrElse("")
       val fjson =
         s"""{"fn": ${json(fname)}, "expr": $childJs, "name": ${json(name)}$off}"""
-      val key = (spec.partitionSpec.map(_.sql), spec.orderSpec.map(_.sql))
+      val key = (spec.partitionSpec.map(_.sql), spec.orderSpec.map(_.sql),
+                 fj)
       grouped.getOrElseUpdate(
-        key, (Grp(spec.partitionSpec, spec.orderSpec), ArrayBuffer()))
+        key, (Grp(spec.partitionSpec, spec.orderSpec, fj), ArrayBuffer()))
         ._2 += fjson
     }
     val ops = grouped.values.map { case (g, fns) =>
@@ -352,7 +377,7 @@ object SpecBuilder {
       if (orders.exists(_.isEmpty)) return None
       s"""{"op": "window", "partitionBy": [${parts.flatten.mkString(", ")}], """ +
         s""""orderBy": [${orders.flatten.mkString(", ")}], """ +
-        s""""funcs": [${fns.mkString(", ")}]}"""
+        s""""funcs": [${fns.mkString(", ")}]${g.frame}}"""
     }
     Some(ops.toList)
   }

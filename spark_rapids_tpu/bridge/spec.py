@@ -127,6 +127,28 @@ _WINDOW_FNS = ("row_number", "rank", "dense_rank", "percent_rank",
                "min", "max", "lead", "lag")
 
 
+def _frame_from_spec(f: Dict):
+    """{"type": "rows"|"range", "start": N|"unboundedPreceding"|
+    "currentRow", "end": ...} -> the engine's (kind, lo, hi) triple."""
+    from ..expr.window import (CURRENT_ROW, UNBOUNDED_FOLLOWING,
+                               UNBOUNDED_PRECEDING)
+
+    def bound(v):
+        if v == "unboundedPreceding":
+            return UNBOUNDED_PRECEDING
+        if v == "unboundedFollowing":
+            return UNBOUNDED_FOLLOWING
+        if v == "currentRow":
+            return CURRENT_ROW
+        return int(v)
+
+    kind = f.get("type", "rows")
+    if kind not in ("rows", "range"):
+        raise ValueError(f"unsupported bridge window frame {kind!r}")
+    return (kind, bound(f.get("start", "unboundedPreceding")),
+            bound(f.get("end", "currentRow")))
+
+
 def _window_from_spec(op: Dict) -> List:
     """Window op spec -> WindowExpression list."""
     from ..expr.aggregates import Average, Count, Max, Min, Sum
@@ -137,7 +159,8 @@ def _window_from_spec(op: Dict) -> List:
         order_by=[(expr_from_spec(o["expr"]),
                    bool(o.get("ascending", True)),
                    bool(o.get("nullsFirst", o.get("ascending", True))))
-                  for o in op.get("orderBy", [])])
+                  for o in op.get("orderBy", [])],
+        frame=_frame_from_spec(op["frame"]) if op.get("frame") else None)
     out = []
     for f in op["funcs"]:
         fn = f["fn"]

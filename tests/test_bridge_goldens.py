@@ -164,3 +164,28 @@ def test_window_ranking_tier_spec():
     assert np.allclose(got.column("cd").to_numpy(), want_cd.to_numpy())
     nt = got.column("nt").to_numpy()
     assert nt.min() == 1 and nt.max() == 4
+
+
+def test_window_explicit_rows_frame_spec():
+    """An explicit ROWS frame clause rides the window op (moving sum
+    over the 3-row trailing window)."""
+    spec = {
+        "input": {"schema": [["k", "bigint"], ["v", "bigint"]]},
+        "inputs": [],
+        "ops": [{"op": "window",
+                 "partitionBy": [{"col": "k"}],
+                 "orderBy": [{"expr": {"col": "v"}, "ascending": True,
+                              "nullsFirst": True}],
+                 "funcs": [{"fn": "sum", "expr": {"col": "v"},
+                            "name": "ms"}],
+                 "frame": {"type": "rows", "start": -2,
+                           "end": "currentRow"}}],
+    }
+    rng = np.random.default_rng(13)
+    tb = pa.table({"k": pa.array(rng.integers(0, 3, 60).astype(np.int64)),
+                   "v": pa.array(rng.permutation(60).astype(np.int64))})
+    got = _run(spec, tb).sort_by([("k", "ascending"), ("v", "ascending")])
+    df = tb.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    want = df.groupby("k")["v"].rolling(3, min_periods=1).sum() \
+        .reset_index(drop=True)
+    assert got.column("ms").to_pylist() == [int(x) for x in want]
