@@ -20,7 +20,13 @@ of scan/filter/project/aggregate/join/window subtrees).  Shape:
         "orderBy": [{"expr": <expr>, "ascending": true,
                      "nullsFirst": true}],
         "funcs": [{"fn": "row_number", "name": "rn"},
-                  {"fn": "sum", "expr": <expr>, "name": "rs"}]},
+                  {"fn": "ntile", "n": 4, "name": "q"},
+                  {"fn": "lag", "expr": <expr>, "offset": 1, "name": "p"},
+                  {"fn": "sum", "expr": <expr>, "name": "rs"}],
+        "frame": {"type": "rows", "start": -2, "end": "currentRow"}},
+                                       # frame optional; bounds are ints
+                                       # or "unboundedPreceding" /
+                                       # "unboundedFollowing"/"currentRow"
        {"op": "sort", "orders": [{"expr": <expr>, "ascending": true,
                                   "nullsFirst": true}]},
        {"op": "limit", "n": 10}
@@ -30,7 +36,15 @@ The main stream is input 0; `join` ops reference later streams by index.
 Expressions are JSON trees:
 
     {"col": "v"} | {"lit": 5, "type": "bigint"} |
-    {"op": "gt", "children": [<expr>, <expr>]}
+    {"op": "gt", "children": [<expr>, <expr>]} |
+    {"op": "cast", "type": "double", "children": [<expr>]} |
+    {"op": "in", "children": [<expr>], "values": [<lit>...]}
+
+Operator tiers: comparisons/boolean (eq/ne/lt/le/gt/ge/and/or/not,
+isnull/isnotnull/isnan), arithmetic (add/sub/mul/div/mod/abs), strings
+(upper/lower/length/substr/concat/trim/ltrim/rtrim/contains/startswith/
+endswith), datetime (year/month/dayofmonth/hour/minute/second/datediff/
+date_add/date_sub), conditionals (if/coalesce), cast, in.
 
 Types use Spark SQL DDL names (the same strings the DataFrame API's
 schema parser accepts), so the Scala side can emit
