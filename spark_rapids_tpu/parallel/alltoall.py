@@ -39,9 +39,21 @@ from ..ops.scan import cumsum_fast
 
 
 def exchange_supported(dtypes) -> Optional[str]:
-    """Return a reason string if the ICI path cannot carry these columns."""
+    """Return a reason string if the ICI path cannot carry these columns.
+    Structs of fixed-width/string fields ride the exchange (row-aligned
+    children move independently); arrays/maps still stage via host."""
+    def ok(dt) -> bool:
+        if isinstance(dt, (t.ArrayType, t.MapType)):
+            return False
+        if isinstance(dt, t.StructType):
+            return all(ok(f.data_type) and
+                       not isinstance(f.data_type,
+                                      (t.StringType, t.BinaryType))
+                       for f in dt.fields)
+        return True
+
     for dt in dtypes:
-        if isinstance(dt, (t.ArrayType, t.MapType, t.StructType)):
+        if not ok(dt):
             return f"nested type {dt.name} falls back to host shuffle"
     return None
 
@@ -149,8 +161,7 @@ def exchange_by_pid(batch: DeviceBatch, pids, n_parts: int, axis_name: str,
     out_total = jnp.sum(valid_flat.astype(jnp.int32))
     out_live = jnp.arange(flat_rows, dtype=jnp.int32) < out_total
 
-    out_cols: List[DeviceColumn] = []
-    for col in batch.columns:
+    def move(col: DeviceColumn) -> DeviceColumn:
         validity = col.validity if col.validity is not None else \
             jnp.ones((cap,), bool)
         v_send = validity[src_row] & send_valid
@@ -162,12 +173,17 @@ def exchange_by_pid(batch: DeviceBatch, pids, n_parts: int, axis_name: str,
             recv_len = a2a(len_send)
             out_chars, out_offs = _string_receive(
                 recv_chars, recv_len, ord2, n_parts, slot)
-            out_cols.append(DeviceColumn(col.dtype, data=out_chars,
-                                         validity=recv_v, offsets=out_offs))
-            continue
-        if isinstance(col.dtype, (t.ArrayType, t.MapType, t.StructType)):
+            return DeviceColumn(col.dtype, data=out_chars,
+                                validity=recv_v, offsets=out_offs)
+        if isinstance(col.dtype, t.StructType):
+            # struct children are row-aligned: each field rides the same
+            # permutation independently
+            return DeviceColumn(col.dtype, validity=recv_v,
+                                children=tuple(move(ch)
+                                               for ch in col.children))
+        if isinstance(col.dtype, (t.ArrayType, t.MapType)):
             raise NotImplementedError(
-                "nested types ride the host shuffle fallback")
+                "array/map types ride the host shuffle fallback")
         data_send = col.data[src_row]
         out_data = a2a(data_send).reshape(flat_rows)[ord2]
         out_data = jnp.where(out_live, out_data,
@@ -176,9 +192,10 @@ def exchange_by_pid(batch: DeviceBatch, pids, n_parts: int, axis_name: str,
         if col.data_hi is not None:
             hi = a2a(col.data_hi[src_row]).reshape(flat_rows)[ord2]
             new_col.data_hi = jnp.where(out_live, hi, jnp.zeros_like(hi))
-        out_cols.append(new_col)
+        return new_col
 
-    return DeviceBatch(out_cols, out_total, batch.names)
+    return DeviceBatch([move(c) for c in batch.columns], out_total,
+                       batch.names)
 
 
 def allgather_batch(batch: DeviceBatch, axis_name: str,
