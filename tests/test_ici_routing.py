@@ -361,3 +361,33 @@ def test_ici_left_join_with_condition():
     want = q(cpu).collect()
     order = [(n, "ascending") for n in got.schema.names]
     assert got.sort_by(order).equals(want.sort_by(order))
+
+
+def test_ici_struct_keyed_time_window_aggregate():
+    """Struct grouping keys (time-window buckets) ride the ICI path now
+    that the exchange carries struct-of-flat columns (round-5 widening)."""
+    import datetime
+    rng = np.random.default_rng(29)
+    n = 2000
+    base = datetime.datetime(2024, 1, 1)
+    ts = [base + datetime.timedelta(seconds=int(x))
+          for x in rng.integers(0, 3600, n)]
+    tb = pa.table({"t": pa.array(ts, type=pa.timestamp("us")),
+                   "v": pa.array(rng.integers(0, 50, n).astype(np.int64))})
+
+    def q(session):
+        return (session.create_dataframe(tb, num_partitions=4)
+                .group_by(F.window(col("t"), "10 minutes"))
+                .agg(F.sum(col("v")).alias("sv")).collect())
+
+    s = _session()
+    got = q(s)
+    assert "IciAggregateExec" in _names(s), _names(s)
+    c = (TpuSession.builder()
+         .config("spark.rapids.sql.enabled", False).get_or_create())
+    want = q(c)
+    gs = sorted(zip(map(str, got.column(0).to_pylist()),
+                    got.column("sv").to_pylist()))
+    ws = sorted(zip(map(str, want.column(0).to_pylist()),
+                    want.column("sv").to_pylist()))
+    assert gs == ws
