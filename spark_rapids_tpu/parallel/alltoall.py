@@ -213,8 +213,7 @@ def allgather_batch(batch: DeviceBatch, axis_name: str,
     total = jnp.sum(valid_flat.astype(jnp.int32))
     out_live = jnp.arange(flat_rows, dtype=jnp.int32) < total
 
-    out_cols: List[DeviceColumn] = []
-    for col in batch.columns:
+    def gather_col(col: DeviceColumn) -> DeviceColumn:
         validity = col.validity if col.validity is not None else \
             jnp.ones((cap,), bool)
         recv_v = ag(validity & live)[ord2] & out_live
@@ -226,17 +225,22 @@ def allgather_batch(batch: DeviceBatch, axis_name: str,
             # source char starts inside each gathered shard = its own offsets
             out_chars, out_offs = _string_receive(
                 recv_chars, recv_len, ord2, n_parts, cap)
-            out_cols.append(DeviceColumn(col.dtype, data=out_chars,
-                                         validity=recv_v, offsets=out_offs))
-            continue
-        if isinstance(col.dtype, (t.ArrayType, t.MapType, t.StructType)):
+            return DeviceColumn(col.dtype, data=out_chars,
+                                validity=recv_v, offsets=out_offs)
+        if isinstance(col.dtype, t.StructType):
+            return DeviceColumn(col.dtype, validity=recv_v,
+                                children=tuple(gather_col(ch)
+                                               for ch in col.children))
+        if isinstance(col.dtype, (t.ArrayType, t.MapType)):
             raise NotImplementedError(
-                "nested types ride the host broadcast fallback")
+                "array/map types ride the host broadcast fallback")
         out_data = ag(col.data)[ord2]
         out_data = jnp.where(out_live, out_data, jnp.zeros_like(out_data))
         new_col = DeviceColumn(col.dtype, data=out_data, validity=recv_v)
         if col.data_hi is not None:
             hi = ag(col.data_hi)[ord2]
             new_col.data_hi = jnp.where(out_live, hi, jnp.zeros_like(hi))
-        out_cols.append(new_col)
-    return DeviceBatch(out_cols, total, batch.names)
+        return new_col
+
+    return DeviceBatch([gather_col(c) for c in batch.columns], total,
+                       batch.names)
