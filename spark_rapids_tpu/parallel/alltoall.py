@@ -40,11 +40,18 @@ from ..ops.scan import cumsum_fast
 
 def exchange_supported(dtypes) -> Optional[str]:
     """Return a reason string if the ICI path cannot carry these columns.
-    Structs of fixed-width/string fields ride the exchange (row-aligned
-    children move independently); arrays/maps still stage via host."""
+    Structs of fixed-width fields and arrays/maps of fixed-width
+    elements ride the exchange; deeper nesting (string/span elements,
+    struct elements) stages via host."""
+    def fixed(dt) -> bool:
+        return not isinstance(dt, (t.StringType, t.BinaryType,
+                                   t.ArrayType, t.MapType, t.StructType))
+
     def ok(dt) -> bool:
-        if isinstance(dt, (t.ArrayType, t.MapType)):
-            return False
+        if isinstance(dt, t.ArrayType):
+            return fixed(dt.element_type)
+        if isinstance(dt, t.MapType):
+            return fixed(dt.key_type) and fixed(dt.value_type)
         if isinstance(dt, t.StructType):
             return all(ok(f.data_type) and
                        not isinstance(f.data_type,
@@ -58,6 +65,41 @@ def exchange_supported(dtypes) -> Optional[str]:
     return None
 
 
+def _flat_child_lanes(col: DeviceColumn):
+    """(lanes, rebuild) for an array/map column of FLAT children: the
+    child-aligned 1-D lanes sharing the column's offsets, and a function
+    rebuilding the column from exchanged lanes.  (None, None) when a
+    child is itself a span/struct (host fallback)."""
+    def flat_lanes(c: DeviceColumn):
+        if c.offsets is not None or c.children:
+            return None
+        out = [c.data]
+        out.append(c.validity if c.validity is not None else
+                   jnp.ones((int(c.data.shape[0]),), bool))
+        if c.data_hi is not None:
+            out.append(c.data_hi)
+        return out
+
+    per_child = [flat_lanes(ch) for ch in col.children]
+    if any(x is None for x in per_child):
+        return None, None
+    lanes = [lane for ls in per_child for lane in ls]
+
+    def rebuild(out_lanes, out_offs, validity):
+        it = iter(out_lanes)
+        children = []
+        for ch, ls in zip(col.children, per_child):
+            data = next(it)
+            valid = next(it)
+            new = DeviceColumn(ch.dtype, data=data, validity=valid)
+            if ch.data_hi is not None:
+                new.data_hi = next(it)
+            children.append(new)
+        return DeviceColumn(col.dtype, validity=validity,
+                            offsets=out_offs, children=tuple(children))
+    return lanes, rebuild
+
+
 def _counts_starts(pid_key, n_parts: int):
     """Per-destination row counts and exclusive starts after a stable sort."""
     one_hot = pid_key[None, :] == jnp.arange(n_parts, dtype=pid_key.dtype)[:, None]
@@ -66,61 +108,80 @@ def _counts_starts(pid_key, n_parts: int):
     return counts, starts
 
 
+def _span_send(offs, lanes, src_row, send_valid, n_parts: int, slot: int):
+    """Pack a span column's child lanes into fixed-shape send tensors.
+
+    `lanes` are 1-D child-aligned arrays (chars for strings; element
+    data/validity lanes for arrays and maps — every lane shares `offs`).
+    Returns (list of packed [P, child_slot] tensors, len_send [P, slot])."""
+    child_slot = int(lanes[0].shape[0])
+    lengths = offs[1:] - offs[:-1]
+    row_len = jnp.where(send_valid, lengths[src_row], 0).astype(jnp.int32)
+    # per-peer exclusive child starts [P, slot+1]
+    child_start = jnp.concatenate(
+        [jnp.zeros((n_parts, 1), jnp.int32), cumsum_fast(jnp, row_len, axis=1)],
+        axis=1)
+    total_children = child_start[:, -1]
+    c = jnp.arange(child_slot, dtype=jnp.int32)
+
+    def per_peer_src(cs, srow, tot):
+        j = jnp.clip(jnp.searchsorted(cs, c, side="right") - 1, 0, slot - 1)
+        within = c - cs[j]
+        src_c = offs[srow[j]] + within
+        valid_c = c < tot
+        return jnp.clip(src_c, 0, child_slot - 1), valid_c
+
+    src_c, valid_c = jax.vmap(per_peer_src)(child_start, src_row,
+                                            total_children)
+    packed = [jnp.where(valid_c, lane[src_c],
+                        jnp.zeros((), lane.dtype))
+              for lane in lanes]
+    return packed, row_len
+
+
 def _string_send(col: DeviceColumn, src_row, send_valid, n_parts: int,
                  slot: int):
     """Pack a string column into fixed-shape send tensors.
 
     Returns (chars_send [P, char_slot], len_send [P, slot])."""
-    offs = col.offsets
-    chars = col.data
-    char_slot = int(chars.shape[0])
-    lengths = offs[1:] - offs[:-1]
-    row_len = jnp.where(send_valid, lengths[src_row], 0).astype(jnp.int32)
-    # per-peer exclusive char starts [P, slot+1]
-    char_start = jnp.concatenate(
-        [jnp.zeros((n_parts, 1), jnp.int32), cumsum_fast(jnp, row_len, axis=1)],
-        axis=1)
-    total_chars = char_start[:, -1]
-    c = jnp.arange(char_slot, dtype=jnp.int32)
-
-    def per_peer(cs, srow, tot):
-        j = jnp.clip(jnp.searchsorted(cs, c, side="right") - 1, 0, slot - 1)
-        within = c - cs[j]
-        src_c = offs[srow[j]] + within
-        valid_c = c < tot
-        return jnp.where(valid_c,
-                         chars[jnp.clip(src_c, 0, char_slot - 1)],
-                         jnp.uint8(0))
-
-    chars_send = jax.vmap(per_peer)(char_start, src_row, total_chars)
-    return chars_send, row_len
+    packed, row_len = _span_send(col.offsets, [col.data], src_row,
+                                 send_valid, n_parts, slot)
+    return packed[0], row_len
 
 
-def _string_receive(recv_chars, recv_len, ord2, n_parts: int, slot: int):
-    """Re-assemble a received string column into (offsets, chars)."""
-    char_slot = int(recv_chars.shape[1])
+def _span_receive_layout(recv_len, ord2, n_parts: int, slot: int,
+                         child_slot: int):
+    """Shared re-assembly coordinates for received span lanes: returns
+    (out_offs, peer_index, src_child_index, live_child_mask) so every
+    child lane of the column gathers through one layout computation."""
     flat_rows = n_parts * slot
     len_flat = recv_len.reshape(flat_rows)
     out_len = len_flat[ord2]
     out_offs = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), cumsum_fast(jnp, out_len)]).astype(jnp.int32)
-    # per-source-peer exclusive char starts in the receive buffer
+    # per-source-peer exclusive child starts in the receive buffer
     recv_start = jnp.concatenate(
         [jnp.zeros((n_parts, 1), jnp.int32), cumsum_fast(jnp, recv_len, axis=1)],
         axis=1)
-    out_char_cap = n_parts * char_slot
-    c = jnp.arange(out_char_cap, dtype=jnp.int32)
+    out_child_cap = n_parts * child_slot
+    c = jnp.arange(out_child_cap, dtype=jnp.int32)
     r = jnp.clip(jnp.searchsorted(out_offs, c, side="right") - 1,
                  0, flat_rows - 1)
     flat_src = ord2[r]
     p = flat_src // slot
     j = flat_src - p * slot
-    src_c = recv_start[p, j] + (c - out_offs[r])
-    total_chars = out_offs[-1]
-    out_chars = jnp.where(
-        c < total_chars,
-        recv_chars[p, jnp.clip(src_c, 0, char_slot - 1)],
-        jnp.uint8(0))
+    src_c = jnp.clip(recv_start[p, j] + (c - out_offs[r]), 0,
+                     child_slot - 1)
+    live = c < out_offs[-1]
+    return out_offs, p, src_c, live
+
+
+def _string_receive(recv_chars, recv_len, ord2, n_parts: int, slot: int):
+    """Re-assemble a received string column into (offsets, chars)."""
+    char_slot = int(recv_chars.shape[1])
+    out_offs, p, src_c, live = _span_receive_layout(
+        recv_len, ord2, n_parts, slot, char_slot)
+    out_chars = jnp.where(live, recv_chars[p, src_c], jnp.uint8(0))
     return out_chars, out_offs
 
 
@@ -182,8 +243,24 @@ def exchange_by_pid(batch: DeviceBatch, pids, n_parts: int, axis_name: str,
                                 children=tuple(move(ch)
                                                for ch in col.children))
         if isinstance(col.dtype, (t.ArrayType, t.MapType)):
-            raise NotImplementedError(
-                "array/map types ride the host shuffle fallback")
+            # array/map of flat elements: every child lane shares the
+            # offsets, so they ride one span layout (the string path
+            # generalized — elements instead of bytes)
+            lanes, rebuild = _flat_child_lanes(col)
+            if lanes is None:
+                raise NotImplementedError(
+                    "nested span elements ride the host shuffle fallback")
+            child_slot = int(lanes[0].shape[0])
+            packed, row_len = _span_send(col.offsets, lanes, src_row,
+                                         send_valid, n_parts, slot)
+            recv_lanes = [a2a(x) for x in packed]
+            recv_len = a2a(row_len)
+            out_offs, p, src_c, live_c = _span_receive_layout(
+                recv_len, ord2, n_parts, slot, child_slot)
+            out_lanes = [jnp.where(live_c, rl[p, src_c],
+                                   jnp.zeros((), rl.dtype))
+                         for rl in recv_lanes]
+            return rebuild(out_lanes, out_offs, recv_v)
         data_send = col.data[src_row]
         out_data = a2a(data_send).reshape(flat_rows)[ord2]
         out_data = jnp.where(out_live, out_data,

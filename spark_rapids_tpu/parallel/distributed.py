@@ -68,26 +68,62 @@ def stack_shards(tables: Sequence[pa.Table], capacity: Optional[int] = None):
 
 
 def _equalize_char_caps(batches: List[DeviceBatch]) -> List[DeviceBatch]:
+    """Pad every shard's span child lanes (string chars, array/map
+    element lanes) to the max across shards so stacking is legal."""
     from ..columnar.device import DeviceColumn
     if not batches:
         return batches
+
+    def pad_lane(x, cap):
+        cur = int(x.shape[0])
+        if cur >= cap:
+            return x
+        return jnp.concatenate([x, jnp.zeros((cap - cur,), x.dtype)])
+
+    def equalize(cols: List[DeviceColumn]) -> List[DeviceColumn]:
+        dt = cols[0].dtype
+        if isinstance(dt, (t.StringType, t.BinaryType)):
+            cap = max(int(c.data.shape[0]) for c in cols)
+            return [DeviceColumn(c.dtype, data=pad_lane(c.data, cap),
+                                 validity=c.validity, offsets=c.offsets)
+                    for c in cols]
+        if isinstance(dt, (t.ArrayType, t.MapType)):
+            child_cols = [equalize([c.children[i] for c in cols])
+                          for i in range(len(cols[0].children))]
+            caps = [max(int(lane.shape[0])
+                        for lane in (ch.data for ch in group))
+                    for group in child_cols]
+            padded = []
+            for group, cap in zip(child_cols, caps):
+                padded.append([
+                    DeviceColumn(ch.dtype, data=pad_lane(ch.data, cap),
+                                 validity=None if ch.validity is None
+                                 else pad_lane(ch.validity, cap),
+                                 offsets=ch.offsets,
+                                 data_hi=None if ch.data_hi is None
+                                 else pad_lane(ch.data_hi, cap),
+                                 children=ch.children)
+                    for ch in group])
+            return [DeviceColumn(c.dtype, validity=c.validity,
+                                 offsets=c.offsets,
+                                 children=tuple(padded[i][bi]
+                                                for i in range(len(padded))))
+                    for bi, c in enumerate(cols)]
+        if isinstance(dt, t.StructType):
+            child_cols = [equalize([c.children[i] for c in cols])
+                          for i in range(len(cols[0].children))]
+            return [DeviceColumn(c.dtype, validity=c.validity,
+                                 children=tuple(child_cols[i][bi]
+                                                for i in range(len(child_cols))))
+                    for bi, c in enumerate(cols)]
+        return list(cols)
+
     ncol = batches[0].num_cols
-    out = [list(b.columns) for b in batches]
-    for ci in range(ncol):
-        cols = [b.columns[ci] for b in batches]
-        if not isinstance(cols[0].dtype, (t.StringType, t.BinaryType)):
-            continue
-        char_cap = max(int(c.data.shape[0]) for c in cols)
-        for bi, c in enumerate(cols):
-            cur = int(c.data.shape[0])
-            if cur < char_cap:
-                data = jnp.concatenate(
-                    [c.data, jnp.zeros((char_cap - cur,), jnp.uint8)])
-                out[bi][ci] = DeviceColumn(c.dtype, data=data,
-                                           validity=c.validity,
-                                           offsets=c.offsets)
-    return [DeviceBatch(cols, b.num_rows, b.names)
-            for cols, b in zip(out, batches)]
+    per_col = [equalize([b.columns[ci] for b in batches])
+               for ci in range(ncol)]
+    return [DeviceBatch([per_col[ci][bi] for ci in range(ncol)],
+                        b.num_rows, b.names)
+            for bi, b in enumerate(batches)]
 
 
 def unstack_shards(stacked: DeviceBatch) -> List[DeviceBatch]:

@@ -391,3 +391,30 @@ def test_ici_struct_keyed_time_window_aggregate():
     ws = sorted(zip(map(str, want.column(0).to_pylist()),
                     want.column("sv").to_pylist()))
     assert gs == ws
+
+
+def test_ici_collect_list_rides_array_exchange():
+    """collect_list's array-typed partial buffers now ride the ICI
+    all_to_all (round-5 span widening) instead of the host fallback."""
+    rng = np.random.default_rng(31)
+    tb = pa.table({
+        "k": pa.array(rng.integers(0, 20, 600).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 100, 600).astype(np.int64)),
+    })
+
+    def q(session):
+        return (session.create_dataframe(tb, num_partitions=4)
+                .group_by(col("k"))
+                .agg(F.collect_list(col("v")).alias("vs")).collect())
+
+    s = _session()
+    got = q(s)
+    assert "IciAggregateExec" in _names(s), _names(s)
+    c = (TpuSession.builder()
+         .config("spark.rapids.sql.enabled", False).get_or_create())
+    want = q(c)
+    gs = {k: sorted(v) for k, v in zip(got.column("k").to_pylist(),
+                                       got.column("vs").to_pylist())}
+    ws = {k: sorted(v) for k, v in zip(want.column("k").to_pylist(),
+                                       want.column("vs").to_pylist())}
+    assert gs == ws
