@@ -100,6 +100,10 @@ def _stackable_schema(dtypes) -> bool:
     through host Arrow, matching exchange_supported's fallback)."""
     from .. import types as t
 
+    def fixed(dt):
+        return not isinstance(dt, (t.StringType, t.BinaryType,
+                                   t.ArrayType, t.MapType, t.StructType))
+
     def flat(dt):
         if isinstance(dt, (t.StringType, t.BinaryType, t.ArrayType,
                            t.MapType)):
@@ -107,9 +111,16 @@ def _stackable_schema(dtypes) -> bool:
         if isinstance(dt, t.StructType):
             return all(flat(f.data_type) for f in dt.fields)
         return True
-    return all(
-        flat(dt) or isinstance(dt, (t.StringType, t.BinaryType))
-        for dt in dtypes)
+
+    def spannable(dt):
+        if isinstance(dt, (t.StringType, t.BinaryType)):
+            return True
+        if isinstance(dt, t.ArrayType):
+            return fixed(dt.element_type)
+        if isinstance(dt, t.MapType):
+            return fixed(dt.key_type) and fixed(dt.value_type)
+        return False
+    return all(flat(dt) or spannable(dt) for dt in dtypes)
 
 
 def _gather_source_stacked(source: Exec, ctx, names, dtypes, n_dev: int):
@@ -156,8 +167,11 @@ def _gather_source_stacked(source: Exec, ctx, names, dtypes, n_dev: int):
     need_rows = max(1024, -(-total // n_dev))
     per = 1 << math.ceil(math.log2(need_rows))
     in_cap = merged.capacity
-    char_caps = tuple(int(c.data.shape[0]) if c.offsets is not None else 0
-                      for c in merged.columns)
+    char_caps = tuple(
+        int((c.data if c.data is not None
+             else c.children[0].data).shape[0])
+        if c.offsets is not None else 0
+        for c in merged.columns)
 
     def make():
         def reshard(b: DeviceBatch):
@@ -179,20 +193,37 @@ def _gather_source_stacked(source: Exec, ctx, names, dtypes, n_dev: int):
                                             offs[-1], offs.dtype)])
                     else:
                         offs = offs[:need + 1]
-                    # pad chars so a shard's dynamic slice never clamps
-                    data_p = jnp.concatenate(
-                        [c.data, jnp.zeros((ccap,), c.data.dtype)])
-                    sh_off, sh_chars = [], []
+                    # every child-aligned lane (chars for strings,
+                    # element lanes for arrays/maps) slices per shard at
+                    # the source's child capacity; padding ensures the
+                    # dynamic slice never clamps
+                    if c.children:
+                        from .alltoall import _flat_child_lanes
+                        lanes, rebuild = _flat_child_lanes(c)
+                    else:
+                        lanes, rebuild = [c.data], None
+                    padded = [jnp.concatenate(
+                        [ln, jnp.zeros((ccap,), ln.dtype)])
+                        for ln in lanes]
+                    sh_off = []
+                    sh_lanes = [[] for _ in lanes]
                     for i in range(n_dev):
                         o = offs[i * per:i * per + per + 1]
                         sh_off.append(o - o[0])
-                        sh_chars.append(lax.dynamic_slice(
-                            data_p, (o[0],), (ccap,)))
+                        for li, ln in enumerate(padded):
+                            sh_lanes[li].append(lax.dynamic_slice(
+                                ln, (o[0],), (ccap,)))
                     validity = None if c.validity is None else \
                         pad_to(c.validity, need).reshape(n_dev, per)
-                    cols.append(DeviceColumn(
-                        c.dtype, data=jnp.stack(sh_chars),
-                        validity=validity, offsets=jnp.stack(sh_off)))
+                    stacked_lanes = [jnp.stack(g) for g in sh_lanes]
+                    if rebuild is None:
+                        cols.append(DeviceColumn(
+                            c.dtype, data=stacked_lanes[0],
+                            validity=validity,
+                            offsets=jnp.stack(sh_off)))
+                    else:
+                        cols.append(rebuild(stacked_lanes,
+                                            jnp.stack(sh_off), validity))
                 else:
                     cols.append(jax.tree_util.tree_map(
                         lambda x: pad_to(x, need).reshape(n_dev, per), c))

@@ -418,3 +418,33 @@ def test_ici_collect_list_rides_array_exchange():
     ws = {k: sorted(v) for k, v in zip(want.column("k").to_pylist(),
                                        want.column("vs").to_pylist())}
     assert gs == ws
+
+
+def test_ici_array_repartition_device_resident(monkeypatch):
+    """A bare repartition of an array column rides the device-resident
+    reshard + all_to_all (no host Arrow staging)."""
+    from spark_rapids_tpu.parallel import ici_exec
+
+    def boom(*a, **k):
+        raise AssertionError("host Arrow staging used")
+
+    monkeypatch.setattr(ici_exec, "_gather_source_table", boom)
+
+    rng = np.random.default_rng(37)
+    n = 1024
+    arrs = [None if i % 17 == 0 else
+            [int(x) for x in range(i % 4)] for i in range(n)]
+    tb = pa.table({
+        "k": pa.array(rng.integers(0, 64, n).astype(np.int64)),
+        "a": pa.array(arrs, type=pa.list_(pa.int64())),
+    })
+    s = _session()
+    got = (s.create_dataframe(tb, num_partitions=4)
+           .repartition(8, col("k")).collect())
+    assert "IciExchangeExec" in _names(s), _names(s)
+    key = lambda r: (r[0], repr(r[1]))  # noqa: E731
+    got_rows = sorted(zip(got.column("k").to_pylist(),
+                          got.column("a").to_pylist()), key=key)
+    want_rows = sorted(zip(tb.column("k").to_pylist(),
+                           tb.column("a").to_pylist()), key=key)
+    assert got_rows == want_rows
