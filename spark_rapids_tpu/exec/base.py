@@ -57,8 +57,13 @@ _JIT_CACHE: Dict[tuple, object] = {}
 # (persistent cache permitting) reloads instead of recompiling.  The
 # default keeps far more kernels live than any single query uses (a big
 # fused program carries ~40 kernel modules ≈ 120 mappings, so ~192 live
-# programs stay well inside the default 65530-map budget).
-_JIT_CACHE_MAX = 192
+# programs stay well inside the default 65530-map budget).  Override
+# with SPARK_RAPIDS_TPU_JIT_CACHE_MAX for hosts with a raised
+# vm.max_map_count or unusually many distinct query shapes per process.
+import os as _os
+
+_JIT_CACHE_MAX = int(_os.environ.get("SPARK_RAPIDS_TPU_JIT_CACHE_MAX",
+                                     "192"))
 
 
 def process_jit(key: tuple, make_fn):
