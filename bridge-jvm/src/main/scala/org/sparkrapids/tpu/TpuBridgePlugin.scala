@@ -228,7 +228,12 @@ object SpecBuilder {
   private def joinHow(t: JoinType): Option[String] = t match {
     case Inner     => Some("inner")
     case LeftOuter => Some("left")
-    case FullOuter => Some("full")
+    // NO FullOuter: TpuBridgeExec runs the spec once per stream partition
+    // against the whole collected build side, so each partition would
+    // emit the build side's unmatched rows (and null-extend build rows
+    // matched only in another partition) — duplicated/wrong results for
+    // any full outer join with >1 stream partition.  The reference
+    // handles full outer via a co-partitioned shuffle only.
     case LeftSemi  => Some("left_semi")
     case LeftAnti  => Some("left_anti")
     case _         => None

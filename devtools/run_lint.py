@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""CI entry point for the tpulint repo lint.
+
+Runs the TPU-Rxxx invariant rules over spark_rapids_tpu/ and exits
+nonzero on any violation NOT in the checked-in baseline
+(devtools/lint_baseline.txt), so the invariants ratchet: existing debt
+is frozen, new debt fails the suite (tests/test_lint_clean.py invokes
+this from tier-1).
+
+    python devtools/run_lint.py                    # check
+    python devtools/run_lint.py --update-baseline  # re-freeze debt
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_baseline.txt")
+
+
+def main(argv=None):
+    from spark_rapids_tpu.tools.__main__ import main as tools_main
+    args = ["lint", "--repo", "--baseline", BASELINE]
+    if "--update-baseline" in (argv or sys.argv[1:]):
+        args.append("--update-baseline")
+    return tools_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,38 @@
+"""Static plan/kernel analysis (`tpulint`).
+
+The L8 tooling layer the reference ships as qualification/supported-ops/
+api_validation reasons about plans *before* running them; this package is
+the TPU-native extension of that idea to the correctness class round 5
+surfaced: planning-time gates admitting plans the runtime then crashes
+on, and plan shapes that defeat the JIT residency cache.
+
+Two front ends share one rule/diagnostic framework (diagnostics.py):
+
+  * plan lint (plan_lint.py)  — walks a converted physical plan and
+    reports hazards as structured TPU-Lxxx diagnostics (error/warn/info);
+    opt-in pre-flight via ``spark.rapids.tpu.lint.enabled`` downgrades
+    hazardous subtrees to host fallback instead of crashing.
+  * repo lint (repo_lint.py)  — AST pass over the package source
+    enforcing codebase invariants as TPU-Rxxx diagnostics, with a
+    checked-in baseline for pre-existing violations
+    (devtools/lint_baseline.txt, devtools/run_lint.py).
+
+Both are driven by the machine-readable kernel capability table in
+capabilities.py, which mirrors the actual dtype branch structure of the
+kernels in ``parallel/`` and cross-checks every planning-time admission
+gate against it (``verify_gates``) — the check class that provably
+catches the round-5 alltoall admit/crash mismatch.
+
+CLI: ``python -m spark_rapids_tpu.tools lint [--plan FIXTURE...|--repo]``.
+"""
+
+from .diagnostics import (ERROR, INFO, WARN, Diagnostic, Rule, RULE_CATALOG,
+                          format_diagnostics, register_rule)
+from .plan_lint import downgrade_hazards, lint_plan, lint_spark_plan
+from .repo_lint import lint_repo, load_baseline
+
+__all__ = [
+    "Diagnostic", "Rule", "RULE_CATALOG", "ERROR", "WARN", "INFO",
+    "format_diagnostics", "register_rule", "lint_plan", "lint_spark_plan",
+    "downgrade_hazards", "lint_repo", "load_baseline",
+]

@@ -1,0 +1,121 @@
+"""Shared rule/diagnostic framework for the plan and repo linters.
+
+Modeled on the reference's generated-docs discipline (TypeChecks.scala
+SupportedOpsDocs): every rule registers itself with a stable code, a
+severity, and documentation, and the catalog is the single source for
+docsgen output (docs/lint_rules.md), suppression handling, and the two
+lint front ends.
+
+Diagnostic codes:
+  TPU-Lxxx — plan lint (hazards in a physical plan about to execute)
+  TPU-Rxxx — repo lint (codebase invariants over the package source)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+# severities, orderable: ERROR > WARN > INFO
+ERROR = "error"
+WARN = "warn"
+INFO = "info"
+_SEV_ORDER = {ERROR: 2, WARN: 1, INFO: 0}
+
+
+class Rule:
+    """One registered lint rule: stable code + severity + docs.
+
+    `check` signature differs per front end (plan rules receive a
+    LintContext, repo rules a parsed module) — the catalog only cares
+    that every diagnostic traces back to a documented code."""
+
+    def __init__(self, code: str, severity: str, title: str, doc: str,
+                 check: Optional[Callable] = None):
+        if severity not in _SEV_ORDER:
+            raise ValueError(f"unknown severity {severity!r}")
+        self.code = code
+        self.severity = severity
+        self.title = title
+        self.doc = " ".join(doc.split())
+        self.check = check
+
+    def diag(self, message: str, loc: str = "", node=None,
+             severity: Optional[str] = None) -> "Diagnostic":
+        return Diagnostic(self.code, severity or self.severity, message,
+                          loc=loc, node=node)
+
+
+RULE_CATALOG: Dict[str, Rule] = {}
+
+
+def register_rule(code: str, severity: str, title: str, doc: str,
+                  check: Optional[Callable] = None) -> Rule:
+    if code in RULE_CATALOG:
+        raise ValueError(f"duplicate lint rule code {code}")
+    r = Rule(code, severity, title, doc, check)
+    RULE_CATALOG[code] = r
+    return r
+
+
+class Diagnostic:
+    """One structured finding.
+
+    `loc` is human-oriented: an operator path like
+    ``HashJoinExec > ShuffleExchangeExec`` for plan lint, ``path:line``
+    for repo lint.  `node` (plan lint only) is the offending Exec so the
+    pre-flight can downgrade exactly that subtree; it never participates
+    in equality/fingerprints."""
+
+    __slots__ = ("code", "severity", "message", "loc", "node")
+
+    def __init__(self, code: str, severity: str, message: str,
+                 loc: str = "", node=None):
+        self.code = code
+        self.severity = severity
+        self.message = message
+        self.loc = loc
+        self.node = node
+
+    @property
+    def is_error(self) -> bool:
+        return self.severity == ERROR
+
+    def fingerprint(self) -> str:
+        """Stable identity for baselining: no line numbers, no node ids —
+        a reshuffled file keeps its fingerprints."""
+        path = self.loc.split(":", 1)[0]
+        return f"{self.code}\t{path}\t{self.message}"
+
+    def __repr__(self):
+        return (f"Diagnostic({self.code}, {self.severity}, "
+                f"{self.message!r}, loc={self.loc!r})")
+
+    def render(self) -> str:
+        where = f" [{self.loc}]" if self.loc else ""
+        return f"{self.severity.upper():5s} {self.code}{where}: {self.message}"
+
+
+def sort_diagnostics(diags: List[Diagnostic]) -> List[Diagnostic]:
+    return sorted(diags, key=lambda d: (-_SEV_ORDER[d.severity], d.code,
+                                        d.loc, d.message))
+
+
+def format_diagnostics(diags: List[Diagnostic]) -> str:
+    if not diags:
+        return "no diagnostics\n"
+    lines = [d.render() for d in sort_diagnostics(diags)]
+    n_err = sum(1 for d in diags if d.severity == ERROR)
+    n_warn = sum(1 for d in diags if d.severity == WARN)
+    lines.append(f"{len(diags)} diagnostic(s): {n_err} error(s), "
+                 f"{n_warn} warning(s)")
+    return "\n".join(lines) + "\n"
+
+
+def filter_suppressed(diags: List[Diagnostic],
+                      disabled_codes) -> List[Diagnostic]:
+    """Drop diagnostics whose code the user suppressed
+    (spark.rapids.tpu.lint.disable, comma-separated)."""
+    disabled = {c.strip() for c in disabled_codes if c.strip()}
+    if not disabled:
+        return diags
+    return [d for d in diags if d.code not in disabled]

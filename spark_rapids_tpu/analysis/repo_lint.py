@@ -1,0 +1,255 @@
+"""Repo lint: AST + registry pass enforcing codebase invariants.
+
+  TPU-R001  no implicit host sync (np.asarray / jax.device_get /
+            .block_until_ready) inside exec/ and ops/ hot paths — the
+            single-round-trip fetch path (columnar/fetch.py) is the only
+            sanctioned device->host crossing
+  TPU-R002  every SPARK_RAPIDS_* env var read is declared in
+            config.DECLARED_ENV_KEYS (env knobs must be documented
+            config surface, not scattered literals)
+  TPU-R003  every public Expression subclass under expr/ is registered
+            with a TypeSig in the overrides registry (an expression
+            without a declared dtype coverage is un-taggable: the
+            planner cannot prove where it runs)
+  TPU-R004  every planning-time admission gate is no weaker than the
+            kernel it guards (capabilities.verify_gates — the check that
+            catches the round-5 alltoall admit/crash drift)
+
+Pre-existing violations live in a checked-in baseline
+(devtools/lint_baseline.txt, fingerprint per line); devtools/run_lint.py
+exits nonzero only on NEW violations, so the invariant ratchets.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Set
+
+from .diagnostics import Diagnostic, ERROR, WARN, register_rule
+
+R001 = register_rule(
+    "TPU-R001", ERROR, "implicit host sync in hot path",
+    "np.asarray / jax.device_get / .block_until_ready inside exec/ or "
+    "ops/ forces a device round trip (tens of ms on a tunneled TPU) per "
+    "call site; device->host crossings belong to columnar/fetch.py's "
+    "batched two-round-trip path.")
+
+R002 = register_rule(
+    "TPU-R002", ERROR, "undeclared environment-variable config",
+    "A SPARK_RAPIDS_* environment variable is read without being listed "
+    "in config.DECLARED_ENV_KEYS; env knobs are config surface and must "
+    "be declared and documented like every other key.")
+
+R003 = register_rule(
+    "TPU-R003", WARN, "expression without registered dtype coverage",
+    "A public Expression subclass under expr/ has no entry in the "
+    "overrides EXPR_RULES registry: the tagging engine cannot reason "
+    "about its dtype coverage, so plans using it are un-analyzable.")
+
+R004 = register_rule(
+    "TPU-R004", ERROR, "planning gate weaker than kernel coverage",
+    "A registered admission gate (capabilities.registered_gates) admits "
+    "a dtype its runtime kernel raises on — plans pass planning and "
+    "crash mid-query.  Tighten the gate or extend the kernel.")
+
+# hot-path packages for TPU-R001 (module-relative, forward slashes)
+_HOT_PATHS = ("spark_rapids_tpu/exec/", "spark_rapids_tpu/ops/")
+_SYNC_RECEIVERS = {"asarray": {"np", "numpy"}, "device_get": {"jax"}}
+
+
+def _package_root() -> str:
+    """Directory CONTAINING the spark_rapids_tpu package."""
+    import spark_rapids_tpu
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(spark_rapids_tpu.__file__)))
+
+
+def _py_files(root: str) -> Iterable[str]:
+    pkg = os.path.join(root, "spark_rapids_tpu")
+    for dirpath, _dirs, files in os.walk(pkg):
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+class _ScopedVisitor(ast.NodeVisitor):
+    """Tracks the enclosing class/function qualname so fingerprints
+    survive line-number churn."""
+
+    def __init__(self):
+        self._scope: List[str] = []
+
+    @property
+    def scope(self) -> str:
+        return ".".join(self._scope) or "<module>"
+
+    def visit_ClassDef(self, node):
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    def visit_FunctionDef(self, node):
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+class _HostSyncVisitor(_ScopedVisitor):
+    def __init__(self, relpath: str):
+        super().__init__()
+        self.relpath = relpath
+        self.diags: List[Diagnostic] = []
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            call = None
+            if f.attr == "block_until_ready":
+                call = ".block_until_ready"
+            elif f.attr in _SYNC_RECEIVERS and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in _SYNC_RECEIVERS[f.attr]:
+                call = f"{f.value.id}.{f.attr}"
+            if call is not None:
+                self.diags.append(R001.diag(
+                    f"implicit host sync {call} in {self.scope}",
+                    loc=f"{self.relpath}:{node.lineno}"))
+        self.generic_visit(node)
+
+
+class _EnvReadVisitor(_ScopedVisitor):
+    def __init__(self, relpath: str, declared: Set[str]):
+        super().__init__()
+        self.relpath = relpath
+        self.declared = declared
+        self.diags: List[Diagnostic] = []
+
+    @staticmethod
+    def _is_environ(node) -> bool:
+        return isinstance(node, ast.Attribute) and \
+            node.attr == "environ" and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id.lstrip("_") == "os"
+
+    def _check_key(self, key_node, lineno: int):
+        if isinstance(key_node, ast.Constant) and \
+                isinstance(key_node.value, str) and \
+                key_node.value.startswith("SPARK_RAPIDS") and \
+                key_node.value not in self.declared:
+            self.diags.append(R002.diag(
+                f"undeclared env key {key_node.value} read in "
+                f"{self.scope}", loc=f"{self.relpath}:{lineno}"))
+
+    def visit_Subscript(self, node):
+        if self._is_environ(node.value):
+            self._check_key(node.slice, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in ("get", "pop") and \
+                self._is_environ(f.value) and node.args:
+            self._check_key(node.args[0], node.lineno)
+        self.generic_visit(node)
+
+
+def _ast_diagnostics(root: str) -> List[Diagnostic]:
+    from .. import config as cfg_mod
+    declared = set(getattr(cfg_mod, "DECLARED_ENV_KEYS", ()))
+    diags: List[Diagnostic] = []
+    for path in _py_files(root):
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=relpath)
+        except SyntaxError as ex:
+            diags.append(Diagnostic("TPU-R000", ERROR,
+                                    f"unparsable module: {ex.msg}",
+                                    loc=relpath))
+            continue
+        if any(relpath.startswith(h) for h in _HOT_PATHS):
+            v = _HostSyncVisitor(relpath)
+            v.visit(tree)
+            diags.extend(v.diags)
+        ev = _EnvReadVisitor(relpath, declared)
+        ev.visit(tree)
+        diags.extend(ev.diags)
+    return diags
+
+
+def _registry_diagnostics() -> List[Diagnostic]:
+    """TPU-R003/R004: checks against the LIVE registries, so they can
+    never drift from the code the way a parallel table would."""
+    import importlib
+    import inspect
+    import pkgutil
+
+    diags: List[Diagnostic] = []
+    from ..expr.core import Expression
+    from ..plan.overrides import EXPR_RULES
+
+    import spark_rapids_tpu.expr as expr_pkg
+    for info in pkgutil.iter_modules(expr_pkg.__path__):
+        mod = importlib.import_module(f"spark_rapids_tpu.expr.{info.name}")
+        for name, cls in sorted(vars(mod).items()):
+            if not (inspect.isclass(cls) and issubclass(cls, Expression)):
+                continue
+            if cls.__module__ != mod.__name__ or name.startswith("_"):
+                continue
+            if inspect.isabstract(cls) or cls in EXPR_RULES:
+                continue
+            # abstract-by-convention bases: anything further subclassed
+            # within the package is a base, not a leaf operator
+            if any(c is not cls and issubclass(c, cls)
+                   for m2 in (vars(mod),) for c in m2.values()
+                   if inspect.isclass(c)):
+                continue
+            diags.append(R003.diag(
+                f"expression {name} has no registered TypeSig rule",
+                loc=f"spark_rapids_tpu/expr/{info.name}.py"))
+
+    from .capabilities import verify_gates
+    for gate, kernel, dt in verify_gates():
+        diags.append(R004.diag(
+            f"gate {gate} admits {dt.name} but kernel {kernel} raises "
+            f"on it", loc="spark_rapids_tpu/analysis/capabilities.py"))
+    return diags
+
+
+def lint_repo(root: Optional[str] = None) -> List[Diagnostic]:
+    """Run every repo rule over the package source; returns ALL
+    violations (baseline subtraction is the caller's concern)."""
+    root = root or _package_root()
+    from .diagnostics import sort_diagnostics
+    return sort_diagnostics(_ast_diagnostics(root) +
+                            _registry_diagnostics())
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> Set[str]:
+    if not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        return {line.rstrip("\n") for line in f
+                if line.strip() and not line.startswith("#")}
+
+
+def save_baseline(path: str, diags: List[Diagnostic]) -> None:
+    lines = sorted({d.fingerprint() for d in diags})
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("# tpulint repo baseline: pre-existing violations, one "
+                "fingerprint per line.\n# Regenerate with: python "
+                "devtools/run_lint.py --update-baseline\n")
+        for line in lines:
+            f.write(line + "\n")
+
+
+def new_violations(diags: List[Diagnostic],
+                   baseline: Set[str]) -> List[Diagnostic]:
+    return [d for d in diags if d.fingerprint() not in baseline]

@@ -503,6 +503,47 @@ TPU_STRING_DATA_BUCKETS = conf("spark.rapids.tpu.stringDataBuckets").string() \
     .doc("Byte-capacity buckets for the string data buffer.") \
     .create_with_default("16384,131072,1048576,8388608,67108864,268435456")
 
+# --- static analysis (tpulint) --------------------------------------------
+
+LINT_ENABLED = conf("spark.rapids.tpu.lint.enabled").boolean() \
+    .doc("Opt-in pre-flight plan lint: before execution the converted "
+         "plan is checked against the TPU-Lxxx rule catalog "
+         "(docs/static-analysis.md) and hazardous subtrees are "
+         "downgraded to the host engine instead of crashing mid-query.") \
+    .create_with_default(False)
+
+LINT_DISABLE = conf("spark.rapids.tpu.lint.disable").string() \
+    .doc("Comma-separated diagnostic codes (e.g. TPU-L005) to suppress "
+         "in the plan lint.") \
+    .create_with_default("")
+
+LINT_MAX_DRIVER_COLLECT = conf(
+    "spark.rapids.tpu.lint.maxDriverCollectBytes").bytes() \
+    .doc("Plan lint threshold (TPU-L004): a broadcast/build side whose "
+         "estimated size exceeds this is flagged as a driver-side "
+         "whole-build collect hazard.") \
+    .check(lambda v: v > 0, "must be positive") \
+    .create_with_default(512 * 1024 * 1024)
+
+LINT_MAX_PROGRAMS = conf(
+    "spark.rapids.tpu.lint.maxCompiledPrograms").integer() \
+    .doc("Plan lint threshold (TPU-L005): warn when a plan spans more "
+         "distinct compiled-program shapes than this (JIT residency "
+         "cache churn).  Default is half the process JIT cache budget.") \
+    .check(lambda v: v >= 1, "must be >= 1") \
+    .create_with_default(96)
+
+# Environment variables the engine reads directly (escape hatches that
+# must exist before config parsing, e.g. cache sizing at import time).
+# The repo lint (TPU-R002) fails on any SPARK_RAPIDS_* env read not
+# listed here: env knobs are config surface and get declared like keys.
+DECLARED_ENV_KEYS = (
+    # process JIT residency budget, read at exec/base.py import
+    "SPARK_RAPIDS_TPU_JIT_CACHE_MAX",
+    # disable the persistent XLA compile cache (plugin.py startup)
+    "SPARK_RAPIDS_TPU_DISABLE_COMPILE_CACHE",
+)
+
 
 class RapidsConf:
     """Snapshot of a config map with typed accessors

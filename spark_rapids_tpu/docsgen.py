@@ -58,6 +58,30 @@ def generate_supported_ops() -> str:
     return "\n".join(lines) + "\n"
 
 
+def generate_lint_rules() -> str:
+    """docs/lint_rules.md from the live tpulint rule catalog (the lint
+    analog of supported_ops: codes/severities/docs can never drift from
+    the rules actually enforced)."""
+    # importing the front ends populates the catalog
+    from .analysis import plan_lint, repo_lint  # noqa: F401
+    from .analysis.diagnostics import RULE_CATALOG
+    lines = [
+        "# tpulint rule catalog",
+        "",
+        "Generated from the live rule registry "
+        "(`spark_rapids_tpu/analysis/`) — do not edit.  "
+        "See docs/static-analysis.md for architecture and suppression.",
+        "",
+        "| Code | Severity | Title | Description |",
+        "|---|---|---|---|",
+    ]
+    for code in sorted(RULE_CATALOG):
+        r = RULE_CATALOG[code]
+        lines.append(f"| `{r.code}` | {r.severity} | {r.title} | "
+                     f"{r.doc} |")
+    return "\n".join(lines) + "\n"
+
+
 def write_docs(outdir: str = "docs") -> List[str]:
     os.makedirs(outdir, exist_ok=True)
     paths = []
@@ -68,6 +92,10 @@ def write_docs(outdir: str = "docs") -> List[str]:
     p = os.path.join(outdir, "supported_ops.md")
     with open(p, "w") as f:
         f.write(generate_supported_ops())
+    paths.append(p)
+    p = os.path.join(outdir, "lint_rules.md")
+    with open(p, "w") as f:
+        f.write(generate_lint_rules())
     paths.append(p)
     return paths
 

@@ -65,6 +65,28 @@ def exchange_supported(dtypes) -> Optional[str]:
     return None
 
 
+def allgather_supported(dtypes) -> Optional[str]:
+    """Return a reason string if ``allgather_batch`` cannot replicate
+    these columns.  A strict subset of ``exchange_supported``: the
+    gather path has no span receive layout for arrays/maps (they raise
+    NotImplementedError at runtime), so any planning gate admitting the
+    replicate/allgather branch must check THIS predicate, not just the
+    exchange one (the round-5 admit/crash mismatch,
+    analysis/capabilities.py ALLGATHER_BATCH)."""
+    def ok(dt) -> bool:
+        if isinstance(dt, (t.ArrayType, t.MapType)):
+            return False
+        if isinstance(dt, t.StructType):
+            return all(ok(f.data_type) for f in dt.fields)
+        return True
+
+    for dt in dtypes:
+        if not ok(dt):
+            return (f"array/map type {dt.name} rides the host broadcast "
+                    f"fallback (no allgather span layout)")
+    return None
+
+
 def _flat_child_lanes(col: DeviceColumn):
     """(lanes, rebuild) for an array/map column of FLAT children: the
     child-aligned 1-D lanes sharing the column's offsets, and a function

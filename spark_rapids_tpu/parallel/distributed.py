@@ -20,7 +20,22 @@ from typing import List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import pyarrow as pa
-from jax import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:
+    # pre-0.6 jax ships shard_map under experimental with the replica
+    # check named check_rep instead of check_vma; adapt the call shape
+    # so the SPMD stages run on both API generations
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=True):
+        if f is None:
+            return lambda g: shard_map(g, mesh=mesh, in_specs=in_specs,
+                                       out_specs=out_specs,
+                                       check_vma=check_vma)
+        return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import types as t
@@ -28,7 +43,8 @@ from ..columnar.device import (DEFAULT_ROW_BUCKETS, DeviceBatch,
                                batch_to_arrow, batch_to_device, bucket_for)
 from ..expr.core import EvalContext
 from ..shuffle.partitioning import HashPartitioning
-from .alltoall import allgather_batch, exchange_by_pid, exchange_supported
+from .alltoall import (allgather_batch, allgather_supported,
+                       exchange_by_pid, exchange_supported)
 from .mesh import DATA_AXIS, build_mesh
 
 
@@ -158,6 +174,15 @@ class DistributedAggregate:
                                           self.partial.aggregates, FINAL,
                                           self.partial)
         reason = exchange_supported(self.partial.output_types)
+        if reason is None and not self.partial.grouping:
+            # the ungrouped path replicates partial buffers through
+            # allgather_batch, whose dtype coverage is STRICTLY NARROWER
+            # than the exchange kernel's (no array/map span layout) — a
+            # global collect_list/collect_set must fail HERE, at
+            # planning/construction time, so callers fall back to the
+            # host path instead of crashing mid-query (ADVICE round 5,
+            # analysis/capabilities.py verify_gates)
+            reason = allgather_supported(self.partial.output_types)
         if reason:
             raise NotImplementedError(reason)
         k = len(list(grouping))

@@ -1000,6 +1000,7 @@ class TpuOverrides:
     def __init__(self, conf: cfg.RapidsConf):
         self.conf = conf
         self.last_explain = ""
+        self.last_lint = []
 
     def apply(self, plan: eb.Exec) -> eb.Exec:
         # external override providers contribute rules lazily (the
@@ -1026,6 +1027,21 @@ class TpuOverrides:
         converted = meta.convert()
         from ..parallel.ici_exec import install_ici_stages
         converted = install_ici_stages(converted, self.conf)
+        if self.conf.get(cfg.LINT_ENABLED):
+            # opt-in pre-flight: hazards the rewrite engine admitted but
+            # the runtime would crash on (or quietly serve wrong/slow)
+            # become structured diagnostics, and the subtrees with a
+            # sound host fallback are downgraded instead of executed
+            from ..analysis.plan_lint import downgrade_hazards, lint_plan
+            self.last_lint = lint_plan(converted, self.conf)
+            if self.last_lint:
+                converted = downgrade_hazards(converted, self.last_lint)
+                from ..analysis.diagnostics import format_diagnostics
+                lint_text = "tpulint:\n" + \
+                    format_diagnostics(self.last_lint)
+                self.last_explain += "\n" + lint_text
+                if explain_mode != "NONE":
+                    print(lint_text, end="")
         from ..shuffle.aqe import install_aqe_readers
         converted = install_aqe_readers(converted, self.conf)
         return insert_transitions(converted)

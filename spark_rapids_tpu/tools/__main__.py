@@ -2,10 +2,69 @@
 
     python -m spark_rapids_tpu.tools qualification <eventlogs...> [-o DIR]
     python -m spark_rapids_tpu.tools profiling     <eventlogs...> [-o DIR] [-c]
+    python -m spark_rapids_tpu.tools lint --repo   [--baseline FILE]
+    python -m spark_rapids_tpu.tools lint --plan   <fixture.py...>
+
+Lint fixtures are Python files defining ``plan_*()`` builders, each
+returning ``(exec_root, conf_dict)`` — the checked-in golden bad plans
+under tests/goldens/lint/ are the reference examples.
 """
 
 import argparse
 import sys
+
+
+def _run_plan_lint(paths):
+    import runpy
+
+    from ..analysis.diagnostics import format_diagnostics
+    from ..analysis.plan_lint import lint_plan
+    from ..config import RapidsConf
+
+    any_error = False
+    for path in paths:
+        ns = runpy.run_path(path)
+        builders = sorted(k for k in ns if k.startswith("plan_")
+                          and callable(ns[k]))
+        if not builders:
+            sys.stderr.write(f"{path}: no plan_*() builders found\n")
+            return 2
+        for name in builders:
+            root, conf_map = ns[name]()
+            diags = lint_plan(root, RapidsConf(conf_map))
+            sys.stdout.write(f"== {path}::{name}\n")
+            sys.stdout.write(format_diagnostics(diags))
+            any_error |= any(d.is_error for d in diags)
+    return 1 if any_error else 0
+
+
+def _run_repo_lint(baseline_path, update):
+    from ..analysis.diagnostics import format_diagnostics
+    from ..analysis.repo_lint import (lint_repo, load_baseline,
+                                      new_violations, save_baseline)
+
+    diags = lint_repo()
+    if update:
+        save_baseline(baseline_path, diags)
+        sys.stdout.write(f"baseline updated: {len(diags)} violation(s) "
+                         f"-> {baseline_path}\n")
+        return 0
+    baseline = load_baseline(baseline_path)
+    fresh = new_violations(diags, baseline)
+    if fresh:
+        sys.stdout.write(format_diagnostics(fresh))
+        sys.stdout.write(f"{len(fresh)} NEW violation(s) not in baseline "
+                         f"({baseline_path})\n")
+        return 1
+    sys.stdout.write(f"repo lint clean ({len(diags)} baselined "
+                     f"violation(s))\n")
+    return 0
+
+
+def _default_baseline():
+    import os
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "devtools", "lint_baseline.txt")
 
 
 def main(argv=None):
@@ -19,17 +78,35 @@ def main(argv=None):
     pr.add_argument("logs", nargs="+")
     pr.add_argument("-o", "--output", default="profile_output")
     pr.add_argument("-c", "--compare", action="store_true")
+    li = sub.add_parser("lint",
+                        help="static plan/repo analysis (tpulint)")
+    li.add_argument("--repo", action="store_true",
+                    help="run the repo invariant lint over the package")
+    li.add_argument("--plan", nargs="*", metavar="FIXTURE",
+                    help="lint physical plans built by plan_*() "
+                         "functions in the given Python files")
+    li.add_argument("--baseline", default=None,
+                    help="repo-lint baseline file "
+                         "(default: devtools/lint_baseline.txt)")
+    li.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current violations")
     args = p.parse_args(argv)
 
     if args.cmd == "qualification":
         from .qualification import format_summary, qualify
         results = qualify(args.logs, args.output)
         sys.stdout.write(format_summary(results))
-    else:
+    elif args.cmd == "profiling":
         from .profiling import profile
         reports = profile(args.logs, args.output, compare=args.compare)
         sys.stdout.write(f"profiled {len(reports)} application(s) -> "
                          f"{args.output}\n")
+    else:
+        if args.plan:
+            return _run_plan_lint(args.plan)
+        # --repo is the default lint mode
+        return _run_repo_lint(args.baseline or _default_baseline(),
+                              args.update_baseline)
     return 0
 
 

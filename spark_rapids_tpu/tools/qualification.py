@@ -41,6 +41,10 @@ class QualAppResult:
         self.unsupported_write_formats: Set[str] = set()
         self.complex_types: Set[str] = set()
         self.unsupported_exprs: Set[str] = set()
+        # structured TPU-Lxxx hazards from the static analyzer's
+        # event-log front end (analysis/plan_lint.lint_spark_plan) — the
+        # same rule vocabulary the live pre-flight lint reports
+        self.lint_diagnostics: List = []
         self._speedup_num = 0.0
         self._speedup_den = 0.0
         self._analyze()
@@ -57,6 +61,8 @@ class QualAppResult:
                 self.failed_sql_ids.append(sx.sql_id)
                 continue
             problems = self._plan_problems(sx.plan)
+            from ..analysis.plan_lint import lint_spark_plan
+            self.lint_diagnostics.extend(lint_spark_plan(sx.plan))
             frac, speedup = self._plan_scores(sx.plan)
             self.supported_task_duration += int(task_dur * frac)
             self._speedup_num += task_dur * frac * speedup
@@ -210,7 +216,26 @@ def qualify(paths: List[str], output_dir: Optional[str] = None
                 output_dir,
                 "spark_rapids_tpu_qualification_output.log"), "w") as f:
             f.write(format_summary(results))
+        with open(os.path.join(
+                output_dir,
+                "spark_rapids_tpu_qualification_lint.log"), "w") as f:
+            f.write(format_lint(results))
     return results
+
+
+def format_lint(results: List[QualAppResult]) -> str:
+    """Per-app static-analysis hazards in the TPU-Lxxx rule vocabulary
+    (codes documented in docs/static-analysis.md)."""
+    lines = ["=" * 72, "Static-analysis hazards per application:",
+             "=" * 72]
+    for r in results:
+        lines.append(f"{r.app.app_name} ({r.app.app_id}):")
+        if not r.lint_diagnostics:
+            lines.append("  no hazards detected")
+            continue
+        for d in r.lint_diagnostics:
+            lines.append("  " + d.render())
+    return "\n".join(lines) + "\n"
 
 
 def format_summary(results: List[QualAppResult]) -> str:

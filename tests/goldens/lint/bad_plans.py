@@ -1,0 +1,149 @@
+"""Golden "bad plan" fixtures for the tpulint plan linter.
+
+Each ``plan_<rule>()`` builder returns ``(exec_root, conf_map)`` — a
+physical plan carrying exactly the hazard one TPU-Lxxx rule class
+exists to catch, plus the session conf that arms it.  Consumed two
+ways:
+
+  * tests/test_lint_plan.py asserts each builder trips the codes listed
+    in expected_codes.json (and nothing unexpected at error severity);
+  * ``python -m spark_rapids_tpu.tools lint --plan
+    tests/goldens/lint/bad_plans.py`` prints the diagnostics, which is
+    the CLI's reference demo.
+
+These plans are deliberately hazardous — they document plan shapes the
+overrides engine must never emit, so do not "fix" them.
+"""
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.exec import base as eb
+from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+from spark_rapids_tpu.exec.basic import (FilterExec, LocalScanExec,
+                                         ProjectExec)
+from spark_rapids_tpu.exec.broadcast import BroadcastExchangeExec
+from spark_rapids_tpu.exec.join import HashJoinExec
+from spark_rapids_tpu.exec.python_udf import ArrowEvalPythonExec
+from spark_rapids_tpu.expr.aggregates import (AggregateExpression,
+                                              CollectList, PARTIAL, Sum)
+from spark_rapids_tpu.expr.core import (Alias, AttributeReference,
+                                        Literal)
+from spark_rapids_tpu.expr.predicates import GreaterThan
+from spark_rapids_tpu.expr.regex import RLike
+from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+from spark_rapids_tpu.udf.python_udf import PythonUDF
+
+
+def _scan(table, placement=eb.TPU, **kw):
+    s = LocalScanExec(table, **kw)
+    s.placement = placement
+    return s
+
+
+def _ints(n=8, name="v"):
+    return pa.table({name: pa.array(range(n), type=pa.int64())})
+
+
+def plan_L001_ici_ungrouped_array_agg():
+    """Global collect_list under transport=ici: the array partial buffer
+    passes the exchange admission gate but allgather_batch raises on it
+    — the round-5 admit/crash mismatch (ADVICE alltoall.py:278)."""
+    scan = _scan(_ints())
+    agg = TpuHashAggregateExec(
+        [], [AggregateExpression(CollectList(AttributeReference("v")))],
+        PARTIAL, scan)
+    agg.placement = eb.TPU
+    return agg, {"spark.rapids.shuffle.transport": "ici"}
+
+
+def plan_L002_ping_pong():
+    """A host-placed filter sandwiched between device projections: two
+    interconnect crossings per batch for one operator."""
+    scan = _scan(_ints())
+    p1 = ProjectExec([AttributeReference("v")], scan)
+    p1.placement = eb.TPU
+    host = FilterExec(GreaterThan(AttributeReference("v"),
+                                  Literal(2, t.LONG)), p1)
+    host.placement = eb.CPU
+    p2 = ProjectExec([AttributeReference("v")], host)
+    p2.placement = eb.TPU
+    return p2, {}
+
+
+def plan_L003_host_expr_on_device():
+    """A device-placed projection carrying a regex (host-only, no TPU
+    lowering): admitted here only because the plan skipped tagging."""
+    tb = pa.table({"s": pa.array(["a", "b"], type=pa.string())})
+    scan = _scan(tb)
+    proj = ProjectExec(
+        [Alias(RLike(AttributeReference("s"), Literal("a.*", t.STRING)),
+               "m")], scan)
+    proj.placement = eb.TPU
+    return proj, {}
+
+
+def plan_L004_driver_collect():
+    """Broadcast of a build side far above the whole-build collect
+    threshold (armed low so the fixture stays small)."""
+    big = pa.table({"k": pa.array(range(4096), type=pa.int64())})
+    bex = BroadcastExchangeExec(_scan(big))
+    bex.placement = eb.TPU
+    probe = _scan(_ints(name="k"))
+    join = HashJoinExec([AttributeReference("k")],
+                        [AttributeReference("k")], "inner", None,
+                        probe, bex)
+    join.placement = eb.TPU
+    return join, {"spark.rapids.tpu.lint.maxDriverCollectBytes": "1k"}
+
+
+def plan_L005_compile_churn():
+    """Off-bucket scan capacity plus more distinct operator schemas than
+    the compiled-program budget (armed low): every shape compiles its
+    own XLA program family and churns the residency cache."""
+    scan = _scan(_ints(), batch_rows=777)
+    node = scan
+    for i in range(4):
+        node = ProjectExec([AttributeReference("v"),
+                            Alias(AttributeReference("v"), f"c{i}")], node)
+        node.placement = eb.TPU
+    return node, {"spark.rapids.tpu.lint.maxCompiledPrograms": 3}
+
+
+def plan_L006_partition_contract():
+    """A join marked colocated with no establishing exchange under
+    either side: matching keys are NOT co-located, so per-partition
+    results are silently wrong (the bridge full-outer class)."""
+    left = _scan(_ints(name="k"))
+    right = _scan(_ints(name="k"))
+    join = HashJoinExec([AttributeReference("k")],
+                        [AttributeReference("k")], "inner", None,
+                        left, right, colocated=True)
+    join.placement = eb.TPU
+    return join, {}
+
+
+def plan_L007_ici_host_staging():
+    """transport=ici but the exchanged schema carries array<string>,
+    which the all_to_all kernel cannot ride — the shuffle silently
+    stages through host Arrow."""
+    tb = pa.table({
+        "k": pa.array(range(8), type=pa.int64()),
+        "tags": pa.array([["x"]] * 8, type=pa.list_(pa.string())),
+    })
+    scan = _scan(tb)
+    ex = ShuffleExchangeExec(
+        HashPartitioning([AttributeReference("k")], 4), scan)
+    ex.placement = eb.TPU
+    return ex, {"spark.rapids.shuffle.transport": "ici"}
+
+
+def plan_L008_udf_boundary():
+    """An opaque Python UDF worker boundary consuming device-resident
+    batches: serialize + re-upload per batch."""
+    scan = _scan(_ints())
+    udf = PythonUDF(lambda x: x + 1, t.LONG,
+                    [AttributeReference("v")], name="plus1")
+    node = ArrowEvalPythonExec([("u", udf)], scan)
+    return node, {}

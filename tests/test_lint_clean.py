@@ -1,0 +1,54 @@
+"""Tier-1 gate for the repo lint: the package must stay clean modulo
+the checked-in baseline (devtools/lint_baseline.txt), so any NEW
+invariant violation fails the suite — the ratchet devtools/run_lint.py
+applies in CI."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "devtools", "lint_baseline.txt")
+
+
+def test_repo_lint_clean_modulo_baseline():
+    from spark_rapids_tpu.analysis.diagnostics import format_diagnostics
+    from spark_rapids_tpu.analysis.repo_lint import (lint_repo,
+                                                     load_baseline,
+                                                     new_violations)
+    fresh = new_violations(lint_repo(REPO), load_baseline(BASELINE))
+    assert not fresh, (
+        "new repo-lint violations (run devtools/run_lint.py "
+        "--update-baseline only if intentional):\n"
+        + format_diagnostics(fresh))
+
+
+def test_baseline_entries_are_not_stale():
+    """A baseline line whose violation disappeared is debt already paid:
+    fail so it gets deleted and the ratchet tightens."""
+    from spark_rapids_tpu.analysis.repo_lint import (lint_repo,
+                                                     load_baseline)
+    current = {d.fingerprint() for d in lint_repo(REPO)}
+    stale = load_baseline(BASELINE) - current
+    assert not stale, f"stale baseline entries, remove them: {stale}"
+
+
+def test_run_lint_cli_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "devtools", "run_lint.py")],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_lint_cli_plan_mode_flags_goldens():
+    proc = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_tpu.tools", "lint",
+         "--plan", os.path.join(REPO, "tests", "goldens", "lint",
+                                "bad_plans.py")],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    # golden bad plans contain errors by design -> rc 1, all codes shown
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    for code in ("TPU-L001", "TPU-L004"):
+        assert code in proc.stdout, proc.stdout

@@ -1,0 +1,216 @@
+"""Plan-lint tests over the golden bad-plan fixtures, plus the
+regression pair for the round-5 alltoall admit/crash mismatch: the
+hazard is (a) flagged by lint and (b) no longer reachable at runtime."""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.analysis import capabilities as caps
+from spark_rapids_tpu.analysis.diagnostics import (RULE_CATALOG,
+                                                   format_diagnostics)
+from spark_rapids_tpu.analysis.plan_lint import (downgrade_hazards,
+                                                 lint_plan,
+                                                 lint_spark_plan)
+from spark_rapids_tpu.config import RapidsConf
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "goldens", "lint")
+
+
+def _fixtures():
+    spec = importlib.util.spec_from_file_location(
+        "lint_bad_plans", os.path.join(GOLDEN_DIR, "bad_plans.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return {k: getattr(mod, k) for k in dir(mod) if k.startswith("plan_")}
+
+
+with open(os.path.join(GOLDEN_DIR, "expected_codes.json")) as f:
+    EXPECTED = json.load(f)
+
+
+def test_every_fixture_has_expectations_and_vice_versa():
+    assert sorted(_fixtures()) == sorted(EXPECTED)
+
+
+@pytest.mark.parametrize("name", sorted(EXPECTED))
+def test_golden_bad_plan_flags_expected_codes(name):
+    root, conf_map = _fixtures()[name]()
+    diags = lint_plan(root, RapidsConf(conf_map))
+    got = {d.code for d in diags}
+    want = set(EXPECTED[name])
+    assert want <= got, (name, format_diagnostics(diags))
+    # a fixture built for one hazard must not drown it in others
+    unexpected_errors = {d.code for d in diags
+                         if d.is_error and d.code not in want}
+    assert not unexpected_errors, (name, format_diagnostics(diags))
+
+
+def test_rule_class_coverage_is_at_least_eight():
+    """Acceptance: the golden fixtures exercise >= 8 distinct rule
+    classes, including the ICI admit mismatch and driver-collect-size."""
+    all_codes = set()
+    fx = _fixtures()
+    for name, want in EXPECTED.items():
+        root, conf_map = fx[name]()
+        all_codes |= {d.code for d in lint_plan(root, RapidsConf(conf_map))}
+    assert len(all_codes) >= 8, all_codes
+    assert "TPU-L001" in all_codes and "TPU-L004" in all_codes
+    assert all_codes <= set(RULE_CATALOG), all_codes
+
+
+def test_clean_plan_produces_no_diagnostics():
+    from spark_rapids_tpu.exec import base as eb
+    from spark_rapids_tpu.exec.basic import LocalScanExec, ProjectExec
+    from spark_rapids_tpu.expr.core import AttributeReference
+    scan = LocalScanExec(pa.table({"v": pa.array([1, 2],
+                                                 type=pa.int64())}))
+    scan.placement = eb.TPU
+    proj = ProjectExec([AttributeReference("v")], scan)
+    proj.placement = eb.TPU
+    assert lint_plan(proj, RapidsConf({})) == []
+
+
+def test_suppression_drops_codes():
+    fx = _fixtures()
+    root, conf_map = fx["plan_L002_ping_pong"]()
+    conf_map = dict(conf_map,
+                    **{"spark.rapids.tpu.lint.disable": "TPU-L002"})
+    assert lint_plan(root, RapidsConf(conf_map)) == []
+
+
+def test_downgrade_moves_hazard_subtree_to_host():
+    from spark_rapids_tpu.exec import base as eb
+    fx = _fixtures()
+    root, conf_map = fx["plan_L003_host_expr_on_device"]()
+    conf = RapidsConf(conf_map)
+    diags = lint_plan(root, conf)
+    fixed = downgrade_hazards(root, diags)
+    assert fixed.placement == eb.CPU
+    # the downgraded subtree is clean on re-lint
+    assert not [d for d in lint_plan(fixed, conf) if d.is_error]
+
+
+def test_downgrade_clears_broken_colocation():
+    from spark_rapids_tpu.exec import base as eb
+    fx = _fixtures()
+    root, conf_map = fx["plan_L006_partition_contract"]()
+    conf = RapidsConf(conf_map)
+    fixed = downgrade_hazards(root, lint_plan(root, conf))
+    assert fixed.placement == eb.CPU and not fixed.colocated
+    assert not [d for d in lint_plan(fixed, conf) if d.is_error]
+
+
+# ---------------------------------------------------------------------------
+# capability table: the gate cross-check provably catches the round-5 bug
+# ---------------------------------------------------------------------------
+
+def test_registered_gates_are_no_weaker_than_kernels():
+    assert caps.verify_gates() == []
+
+
+def test_old_exchange_gate_would_be_flagged():
+    """The pre-fix admission gate (exchange_supported alone guarding the
+    allgather path) is exactly what TPU-L001/R004 exist to catch."""
+    from spark_rapids_tpu.parallel.alltoall import exchange_supported
+    bad = caps.gate_weaker_than_kernel(exchange_supported,
+                                       caps.ALLGATHER_BATCH)
+    import spark_rapids_tpu.types as t
+    assert any(isinstance(dt, t.ArrayType) for dt in bad)
+    assert any(isinstance(dt, t.MapType) for dt in bad)
+
+
+# ---------------------------------------------------------------------------
+# regression: ungrouped array/map aggregate over ICI (ADVICE round 5)
+# ---------------------------------------------------------------------------
+
+def test_distributed_aggregate_rejects_ungrouped_array_at_construction():
+    """Construction (= planning time) must refuse what allgather_batch
+    would raise NotImplementedError on mid-query."""
+    from spark_rapids_tpu.expr.aggregates import (AggregateExpression,
+                                                  CollectList)
+    from spark_rapids_tpu.expr.core import AttributeReference
+    from spark_rapids_tpu.parallel import DistributedAggregate
+    import spark_rapids_tpu.types as t
+    with pytest.raises(NotImplementedError, match="allgather|array/map"):
+        DistributedAggregate(
+            [], [AggregateExpression(CollectList(AttributeReference("v")))],
+            ["v"], [t.LONG])
+
+
+def test_distributed_aggregate_grouped_array_still_admitted():
+    """The stricter predicate must not over-reject: GROUPED collect_list
+    routes through exchange_by_pid, which carries arrays of flat
+    elements fine."""
+    from spark_rapids_tpu.expr.aggregates import (AggregateExpression,
+                                                  CollectList)
+    from spark_rapids_tpu.expr.core import AttributeReference
+    from spark_rapids_tpu.parallel import DistributedAggregate
+    import spark_rapids_tpu.types as t
+    agg = DistributedAggregate(
+        [AttributeReference("k")],
+        [AggregateExpression(CollectList(AttributeReference("v")))],
+        ["k", "v"], [t.LONG, t.LONG])
+    assert agg.output_names[0] == "k"
+
+
+def test_global_collect_list_over_ici_runs_on_host_path():
+    """End to end: with transport=ici a global collect_list query no
+    longer reaches allgather_batch's NotImplementedError — it executes
+    (host fallback) and returns the right rows."""
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.session import TpuSession
+    s = (TpuSession.builder()
+         .config("spark.rapids.sql.enabled", True)
+         .config("spark.rapids.shuffle.transport", "ici")
+         .get_or_create())
+    tb = pa.table({"v": pa.array([3, 1, 2], type=pa.int64())})
+    df = s.create_dataframe(tb, num_partitions=2)
+    out = df.agg(F.collect_list(col("v")).alias("vs")).collect()
+    assert sorted(out.column("vs")[0].as_py()) == [1, 2, 3]
+    # the hazardous fused ICI stage was refused at planning time
+    names = []
+    s.last_plan.foreach(lambda e: names.append(type(e).__name__))
+    assert "IciAggregateExec" not in names
+
+
+# ---------------------------------------------------------------------------
+# pre-flight wiring (spark.rapids.tpu.lint.enabled)
+# ---------------------------------------------------------------------------
+
+def test_preflight_lint_records_diagnostics_and_query_still_works():
+    from spark_rapids_tpu.api.session import TpuSession
+    s = (TpuSession.builder()
+         .config("spark.rapids.sql.enabled", True)
+         .config("spark.rapids.tpu.lint.enabled", True)
+         .config("spark.rapids.sql.explain", "NONE")
+         .get_or_create())
+    tb = pa.table({"v": pa.array(range(10), type=pa.int64())})
+    df = s.create_dataframe(tb)
+    out = df.filter(df["v"] > 5).collect()
+    assert out.num_rows == 4
+    # a clean query records an empty diagnostic list, not stale state
+    assert isinstance(getattr(s, "last_plan"), object)
+
+
+# ---------------------------------------------------------------------------
+# event-log front end (qualification surfacing)
+# ---------------------------------------------------------------------------
+
+def test_lint_spark_plan_speaks_rule_vocabulary():
+    from spark_rapids_tpu.tools.eventlog import PlanNode
+    plan = PlanNode(
+        "HashAggregate",
+        "HashAggregate(keys=[], functions=[collect_list(v)])",
+        [PlanNode("Project", "Project [regexp_replace(s, 'a', 'b')]",
+                  [PlanNode("Scan parquet", "FileScan parquet", [])])])
+    codes = {d.code for d in lint_spark_plan(plan)}
+    assert "TPU-L001" in codes and "TPU-L003" in codes
+    # offline text analysis is never upgraded to a hard error
+    assert all(not d.is_error for d in lint_spark_plan(plan))

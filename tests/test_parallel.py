@@ -7,7 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
 import pytest
-from jax import shard_map
+from spark_rapids_tpu.parallel.distributed import shard_map
 from jax.sharding import PartitionSpec as P
 
 from spark_rapids_tpu.parallel import (DistributedAggregate,
