@@ -1,32 +1,100 @@
 #!/usr/bin/env python
-"""CI entry point for the tpulint repo lint.
+"""CI entry point for the tpulint repo lint and the flow-sensitive
+plan-lint gate.
 
-Runs the TPU-Rxxx invariant rules over spark_rapids_tpu/ and exits
-nonzero on any violation NOT in the checked-in baseline
+Default mode runs the TPU-Rxxx invariant rules over spark_rapids_tpu/
+and exits nonzero on any violation NOT in the checked-in baseline
 (devtools/lint_baseline.txt), so the invariants ratchet: existing debt
 is frozen, new debt fails the suite (tests/test_lint_clean.py invokes
 this from tier-1).
 
-    python devtools/run_lint.py                    # check
+--interp runs the plan lint in flow-sensitive mode (abstract
+interpreter, analysis/interp.py) over the golden corpus and exits
+nonzero when the analyzer regresses in either direction:
+
+  * any ERROR diagnostic on tests/goldens/lint/good_plans.py
+    (false reject), or
+  * a missing expected code on tests/goldens/lint/bad_plans.py
+    (false admit — expected_codes.json is the contract), or
+  * any differential-oracle mismatch between predicted and executed
+    schema/residency/partitioning on the good corpus.
+
+    python devtools/run_lint.py                    # repo check
     python devtools/run_lint.py --update-baseline  # re-freeze debt
+    python devtools/run_lint.py --interp           # plan typechecker gate
 """
 
+import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "lint_baseline.txt")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "devtools", "lint_baseline.txt")
+GOLDEN = os.path.join(REPO, "tests", "goldens", "lint")
+
+
+def _builders(path):
+    import runpy
+    ns = runpy.run_path(path)
+    return {k: ns[k] for k in ns if k.startswith("plan_")
+            and callable(ns[k])}
+
+
+def run_interp_gate() -> int:
+    from spark_rapids_tpu.analysis.oracle import verify_plan
+    from spark_rapids_tpu.analysis.plan_lint import lint_plan
+    from spark_rapids_tpu.config import RapidsConf
+
+    failures = 0
+
+    good = _builders(os.path.join(GOLDEN, "good_plans.py"))
+    for name in sorted(good):
+        root, conf_map = good[name]()
+        conf = RapidsConf(conf_map)
+        errors = [d for d in lint_plan(root, conf, infer=True)
+                  if d.is_error]
+        for d in errors:
+            failures += 1
+            print(f"FALSE REJECT {name}: {d.render()}")
+        mismatches = verify_plan(root, conf)
+        for m in mismatches:
+            failures += 1
+            print(f"ORACLE DRIFT {name}: {m}")
+
+    with open(os.path.join(GOLDEN, "expected_codes.json")) as f:
+        expected = json.load(f)
+    bad = _builders(os.path.join(GOLDEN, "bad_plans.py"))
+    for name in sorted(expected):
+        root, conf_map = bad[name]()
+        got = {d.code for d in lint_plan(root, RapidsConf(conf_map),
+                                         infer=True)}
+        for code in set(expected[name]) - got:
+            failures += 1
+            print(f"FALSE ADMIT {name}: expected {code}, got "
+                  f"{sorted(got)}")
+
+    n = len(good) + len(expected)
+    if failures:
+        print(f"plan typechecker gate: {failures} failure(s) over {n} "
+              f"golden plans")
+        return 1
+    print(f"plan typechecker gate clean ({len(good)} good plans "
+          f"oracle-verified, {len(expected)} hazards flagged)")
+    return 0
 
 
 def main(argv=None):
+    args = argv if argv is not None else sys.argv[1:]
+    if "--interp" in args:
+        return run_interp_gate()
     from spark_rapids_tpu.tools.__main__ import main as tools_main
-    args = ["lint", "--repo", "--baseline", BASELINE]
-    if "--update-baseline" in (argv or sys.argv[1:]):
-        args.append("--update-baseline")
-    return tools_main(args)
+    cli = ["lint", "--repo", "--baseline", BASELINE]
+    if "--update-baseline" in args:
+        cli.append("--update-baseline")
+    return tools_main(cli)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,311 @@
+"""Abstract domain for the flow-sensitive plan typechecker.
+
+The plan lint's original rules (TPU-L001..L008) pattern-match one node
+and its parent; anything that *flows* through the plan — where a
+column's bytes actually live, which partitioning contract survives a
+rewrite, which columns an exchange ships that nobody reads — is
+invisible to them.  ``analysis/interp.py`` closes that gap with an
+abstract interpreter that walks the converted ``Exec`` tree bottom-up
+propagating one :class:`AbstractState` per subtree.  This module is the
+domain itself:
+
+  * **schema** — output column names, dtypes and (best-effort)
+    nullability, computed *structurally* from child states + the node's
+    own expressions, never by trusting the node's declared
+    ``output_names``/``output_types`` (the declared schema is what
+    downstream operators bound against at construction, so declared vs
+    inferred drift IS the TPU-L009 hazard);
+  * **residency** — whether the subtree's batches are device (jnp) or
+    host (numpy) resident;
+  * **distribution** — the partitioning contract (single / hash-
+    clustered on keys / replicated / unknown), the lattice the
+    TPU-L006/L011 contract checks evaluate in;
+  * **ordering** — the within-partition sort contract;
+  * **size bounds** — row estimates from the SAME model the cost-based
+    optimizer uses (``plan/cost.py``'s ``estimate_rows``), widened to
+    byte estimates for the L010/L012 transfer accounting.
+
+Every element is deliberately conservative: an unknown exec degrades to
+"declared schema, unknown distribution, placement residency" rather
+than guessing, so the interpreter can never reject a plan on facts it
+does not actually have.  The differential oracle
+(``analysis/oracle.py``) keeps the optimistic parts honest: predicted
+schema/residency/partitioning are asserted against real numpy-backend
+execution over the golden corpus, the same discipline
+``capabilities.verify_gates()`` established for dtype gates.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from .. import types as t
+
+# residency values (match the observable fact: are the batch leaves
+# jax Arrays or numpy arrays — columnar/fetch.py's batch_is_device)
+DEVICE = "device"
+HOST = "host"
+
+
+# ---------------------------------------------------------------------------
+# per-row byte widths (size-bound estimates)
+# ---------------------------------------------------------------------------
+
+_VAR_WIDTH_DEFAULT = 24  # assumed avg payload bytes for strings/binary
+
+
+def dtype_width(dt: t.DataType) -> float:
+    """Estimated bytes per row for one column of `dt` — flat widths are
+    exact, variable-length types use the same avg-payload heuristic
+    class the reference's size estimators use."""
+    if isinstance(dt, (t.StringType, t.BinaryType)):
+        return 4 + _VAR_WIDTH_DEFAULT          # offsets + payload
+    if isinstance(dt, t.ArrayType):
+        return 4 + 4 * dtype_width(dt.element_type)
+    if isinstance(dt, t.MapType):
+        return 4 + 4 * (dtype_width(dt.key_type) +
+                        dtype_width(dt.value_type))
+    if isinstance(dt, t.StructType):
+        return 1 + sum(dtype_width(f.data_type) for f in dt.fields)
+    if isinstance(dt, t.DecimalType):
+        return 8 if dt.is64 else 16
+    if isinstance(dt, (t.BooleanType, t.ByteType)):
+        return 1
+    if isinstance(dt, t.ShortType):
+        return 2
+    if isinstance(dt, (t.IntegerType, t.FloatType, t.DateType)):
+        return 4
+    if isinstance(dt, t.NullType):
+        return 1
+    return 8  # long/double/timestamp and anything else
+
+
+def schema_width(dtypes: Sequence[t.DataType]) -> float:
+    return sum(dtype_width(dt) for dt in dtypes)
+
+
+# ---------------------------------------------------------------------------
+# distribution lattice
+# ---------------------------------------------------------------------------
+
+class Dist:
+    """Base partitioning fact.  ``UNKNOWN`` is the lattice top: no
+    guarantee about which partition a row lives in."""
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+    def __eq__(self, other):
+        return type(self) is type(other) and vars(self) == vars(other)
+
+    def __hash__(self):
+        return hash((type(self).__name__, tuple(sorted(vars(self).items()))))
+
+
+class UnknownDist(Dist):
+    def describe(self):
+        return "unknown"
+
+
+class SingleDist(Dist):
+    """Exactly one partition — trivially co-locates everything."""
+
+    def describe(self):
+        return "single"
+
+
+class ReplicatedDist(Dist):
+    """Every consumer partition sees the WHOLE input (broadcast / the
+    AQE replicate-read of a skew-split join's build side)."""
+
+    def describe(self):
+        return "replicated"
+
+
+class HashDist(Dist):
+    """Rows hash-routed on `keys`: equal key tuples are co-located in
+    one of `num_partitions` partitions (None = count unknown, e.g.
+    after AQE coalescing, which preserves clustering)."""
+
+    def __init__(self, keys: Sequence[str],
+                 num_partitions: Optional[int]):
+        self.keys = tuple(keys)
+        self.num_partitions = num_partitions
+
+    def describe(self):
+        n = "?" if self.num_partitions is None else self.num_partitions
+        return f"hash({', '.join(self.keys)}) x {n}"
+
+
+UNKNOWN = UnknownDist()
+SINGLE = SingleDist()
+REPLICATED = ReplicatedDist()
+
+
+def clusters_on(dist: Dist, keys: Sequence[str]) -> bool:
+    """True when `dist` guarantees rows with equal values of `keys` are
+    co-located in one partition.  Hash distribution on a non-empty
+    SUBSET of the keys suffices (equal full tuples => equal subset =>
+    same partition), mirroring Spark's ClusteredDistribution check."""
+    if isinstance(dist, SingleDist):
+        return True
+    if isinstance(dist, HashDist):
+        return bool(dist.keys) and set(dist.keys) <= set(keys)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# interface requirements (what Exec.input_contracts() returns)
+# ---------------------------------------------------------------------------
+
+class Contract:
+    """One declared input requirement.  ``check(states)`` receives the
+    children's inferred AbstractStates and returns violation strings
+    (empty = satisfied)."""
+
+    def check(self, states: Sequence["AbstractState"]) -> List[str]:
+        raise NotImplementedError
+
+
+class ClusteredContract(Contract):
+    """Child `child_index` must arrive hash-clustered on `keys` (or
+    single-partition / replicated) — the FINAL-aggregate contract."""
+
+    def __init__(self, keys: Sequence[str], child_index: int = 0,
+                 what: str = "operator"):
+        self.keys = tuple(keys)
+        self.child_index = child_index
+        self.what = what
+
+    def check(self, states):
+        st = states[self.child_index]
+        if st.dist is None:
+            return []
+        if clusters_on(st.dist, self.keys) or \
+                isinstance(st.dist, ReplicatedDist):
+            return []
+        return [f"{self.what} requires input clustered on "
+                f"[{', '.join(self.keys)}] but the inferred distribution "
+                f"is {st.dist.describe()}"]
+
+
+class CoClusteredContract(Contract):
+    """A colocated hash join's two-sided requirement: both sides
+    clustered compatibly on their respective keys with the SAME
+    partition count, OR the build side replicated (then the probe may be
+    distributed any way), OR everything in one partition."""
+
+    def __init__(self, left_keys: Sequence[str],
+                 right_keys: Sequence[str]):
+        self.left_keys = tuple(left_keys)
+        self.right_keys = tuple(right_keys)
+
+    def check(self, states):
+        l, r = states[0], states[1]
+        if l.dist is None or r.dist is None:
+            return []
+        if isinstance(r.dist, ReplicatedDist):
+            return []
+        if isinstance(l.dist, SingleDist) and isinstance(r.dist, SingleDist):
+            return []
+        if isinstance(l.dist, HashDist) and isinstance(r.dist, HashDist) \
+                and clusters_on(l.dist, self.left_keys) \
+                and clusters_on(r.dist, self.right_keys):
+            # the two routings must agree positionally: same key position
+            # prefix and same partition count (None = unknown, trust it
+            # only when both sides went through the same rewrite)
+            lpos = [self.left_keys.index(k) for k in l.dist.keys]
+            rpos = [self.right_keys.index(k) for k in r.dist.keys]
+            if lpos == rpos and l.dist.num_partitions == \
+                    r.dist.num_partitions:
+                return []
+            return ["colocated join sides are clustered on incompatible "
+                    f"routings ({l.dist.describe()} vs "
+                    f"{r.dist.describe()}): matching keys can land in "
+                    "different partitions"]
+        return ["colocated join requires both sides clustered on the "
+                f"join keys (or a replicated build side); inferred "
+                f"{l.dist.describe()} / {r.dist.describe()}"]
+
+
+# ---------------------------------------------------------------------------
+# the per-subtree abstract state
+# ---------------------------------------------------------------------------
+
+class AbstractState:
+    """Everything the interpreter knows about one subtree's output."""
+
+    __slots__ = ("names", "dtypes", "nullable", "residency", "dist",
+                 "ordering", "rows", "num_partitions", "saw_exchange")
+
+    def __init__(self, names: Sequence[str],
+                 dtypes: Sequence[t.DataType],
+                 nullable: Optional[Sequence[bool]] = None,
+                 residency: str = HOST,
+                 dist: Optional[Dist] = None,
+                 ordering: Sequence[Tuple[str, bool]] = (),
+                 rows: Optional[float] = None,
+                 num_partitions: Optional[int] = None,
+                 saw_exchange: bool = False):
+        self.names = list(names)
+        self.dtypes = list(dtypes)
+        self.nullable = list(nullable) if nullable is not None \
+            else [True] * len(self.names)
+        self.residency = residency
+        self.dist = dist if dist is not None else UNKNOWN
+        self.ordering = tuple(ordering)
+        self.rows = rows
+        self.num_partitions = num_partitions
+        # whether ANY exchange exists in the subtree — the L006-vs-L011
+        # discriminator (contract never established vs established then
+        # broken by a rewrite)
+        self.saw_exchange = saw_exchange
+
+    # -- derived ------------------------------------------------------------
+    def bytes_estimate(self) -> Optional[float]:
+        if self.rows is None:
+            return None
+        return self.rows * schema_width(self.dtypes)
+
+    def replace(self, **kw) -> "AbstractState":
+        out = AbstractState(self.names, self.dtypes, self.nullable,
+                            self.residency, self.dist, self.ordering,
+                            self.rows, self.num_partitions,
+                            self.saw_exchange)
+        for k, v in kw.items():
+            setattr(out, k, v)
+        return out
+
+    def describe(self) -> str:
+        cols = ", ".join(f"{n}:{dt.name}"
+                         for n, dt in zip(self.names, self.dtypes))
+        rows = "?" if self.rows is None else f"~{int(self.rows)}"
+        np_ = "?" if self.num_partitions is None else self.num_partitions
+        ordr = ("" if not self.ordering else
+                " sorted[" + ", ".join(
+                    f"{n} {'ASC' if asc else 'DESC'}"
+                    for n, asc in self.ordering) + "]")
+        return (f"[{cols}] {self.residency} dist={self.dist.describe()} "
+                f"parts={np_} rows={rows}{ordr}")
+
+
+def key_names(bound_keys, child_names: Sequence[str]) -> Optional[List[str]]:
+    """Map bound key expressions to child column names; None when a key
+    is not a plain column reference (then no clustering fact can be
+    named)."""
+    from ..expr.core import AttributeReference, BoundReference
+    out: List[str] = []
+    for k in bound_keys:
+        if isinstance(k, BoundReference):
+            if 0 <= k.ordinal < len(child_names):
+                out.append(child_names[k.ordinal])
+            else:
+                return None
+        elif isinstance(k, AttributeReference):
+            if k.name in child_names:
+                out.append(k.name)
+            else:
+                return None
+        else:
+            return None
+    return out

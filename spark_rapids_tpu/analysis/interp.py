@@ -1,0 +1,779 @@
+"""Flow-sensitive abstract interpreter over the Exec IR.
+
+Walks a converted physical plan bottom-up propagating one
+``absdomain.AbstractState`` per subtree (schema, residency,
+partitioning/ordering contract, size bounds — see ``absdomain.py``) and
+verifies every producer/consumer interface along the way.  Mismatches
+become typed diagnostics in the existing TPU-Lxxx framework:
+
+  TPU-L009  schema mismatch at an exec boundary: an operator's *bound*
+            expressions (ordinals + dtypes frozen at construction)
+            disagree with the schema its child actually produces —
+            the stale-bind class that ``with_new_children`` rewrites
+            and AQE surgery can introduce.
+  TPU-L010  dead columns shipped across an exchange: a column the
+            exchange moves that no operator above ever reads, with the
+            estimated wasted ICI/shuffle bytes.
+  TPU-L011  partitioning contract violated after a rewrite: a consumer
+            declaring a co-location requirement sits above a subtree
+            whose exchanges establish an INCOMPATIBLE routing (keys /
+            partition count changed between establishment and use).
+            The never-established flavor keeps its original TPU-L006
+            code — now decided on the inferred distribution rather
+            than "is my direct child an exchange".
+  TPU-L012  residency ping-pong: a root-to-leaf path whose batches
+            cross the host<->device boundary two or more times, with
+            the estimated bytes moved per pass.
+
+Interface requirements are DECLARED by the operators themselves
+(``Exec.input_contracts()`` — colocated joins return a
+``CoClusteredContract``, FINAL-mode grouped aggregates a
+``ClusteredContract``) and enforced here; the differential oracle
+(``analysis/oracle.py``) checks the interpreter's predictions against
+real numpy-backend execution so the analyzer can never drift from the
+engine (the ``capabilities.verify_gates()`` discipline applied to the
+analyzer itself).
+
+The interpreter is total: a node it cannot model precisely degrades to
+its declared schema with unknown distribution — conservative facts can
+suppress a finding but never invent one.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from .. import config as cfg
+from .. import types as t
+from ..exec import base as eb
+from .absdomain import (DEVICE, HOST, REPLICATED, SINGLE, UNKNOWN,
+                        AbstractState, Dist, HashDist, UnknownDist,
+                        schema_width)
+from .diagnostics import ERROR, WARN, Diagnostic, register_rule
+
+L009 = register_rule(
+    "TPU-L009", ERROR, "schema mismatch at an exec boundary",
+    "An operator's bound expressions reference input ordinals or dtypes "
+    "that disagree with the schema its child actually produces — a "
+    "with_new_children/AQE rewrite swapped the subtree after binding.  "
+    "Executing would read the wrong column or mis-type a kernel; the "
+    "operator must be re-bound against its new input.")
+
+L010 = register_rule(
+    "TPU-L010", WARN, "dead columns shipped across an exchange",
+    "An exchange moves columns no operator above it ever reads.  Every "
+    "byte of a dead column still rides the wire (ICI all_to_all lanes "
+    "or host Arrow staging); project them away below the exchange.  "
+    "The message carries the estimated wasted bytes from the same row "
+    "model the cost-based optimizer uses.")
+
+L011 = register_rule(
+    "TPU-L011", ERROR, "partitioning contract violated after rewrite",
+    "An operator declaring a co-location requirement "
+    "(Exec.input_contracts) consumes a subtree whose exchanges "
+    "establish an INCOMPATIBLE routing — keys or partition counts "
+    "changed between the exchange that established the contract and "
+    "the operator reusing it (the AQE/rewrite-reuse class).  Rows for "
+    "one key would be merged per-partition in different partitions: "
+    "silently wrong results.")
+
+L012 = register_rule(
+    "TPU-L012", WARN, "residency ping-pong along a plan path",
+    "A root-to-leaf path crosses the host<->device boundary two or "
+    "more times: each crossing pays the interconnect's fixed latency "
+    "per batch plus the batch's bytes.  The message totals the "
+    "estimated bytes moved along the path; hoist the host island out "
+    "of the device pipeline or fall the whole path back.")
+
+
+# ---------------------------------------------------------------------------
+# result container
+# ---------------------------------------------------------------------------
+
+class InterpResult:
+    """States keyed by id(node), liveness (columns read above a node),
+    and the boundary diagnostics discovered during the walk."""
+
+    def __init__(self):
+        self.states: Dict[int, AbstractState] = {}
+        self.live: Dict[int, Set[str]] = {}
+        self.diags: List[Diagnostic] = []
+
+    def state(self, node: eb.Exec) -> Optional[AbstractState]:
+        return self.states.get(id(node))
+
+    def live_names(self, node: eb.Exec) -> Optional[Set[str]]:
+        return self.live.get(id(node))
+
+    def residency(self, node: eb.Exec) -> str:
+        st = self.states.get(id(node))
+        if st is not None:
+            return st.residency
+        return DEVICE if node.placement == eb.TPU else HOST
+
+
+# ---------------------------------------------------------------------------
+# transfer helpers
+# ---------------------------------------------------------------------------
+
+def _placement_residency(node: eb.Exec) -> str:
+    return DEVICE if node.placement == eb.TPU else HOST
+
+
+def _rows_of(node: eb.Exec, child_states: Sequence[AbstractState]) -> float:
+    from ..plan.cost import DEFAULT_ROW_COUNT, estimate_rows
+    child_rows = [s.rows if s.rows is not None else float(DEFAULT_ROW_COUNT)
+                  for s in child_states]
+    try:
+        return estimate_rows(node, child_rows)
+    except Exception:
+        return child_rows[0] if child_rows else float(DEFAULT_ROW_COUNT)
+
+
+def _passthrough_map(exprs, child_names: Sequence[str]) -> Dict[str, str]:
+    """child column name -> output name for expressions that forward a
+    column unchanged (AttributeReference or Alias of one); the map that
+    decides which distribution/ordering facts survive a projection."""
+    from ..expr.core import Alias, AttributeReference, BoundReference, \
+        output_name
+    out: Dict[str, str] = {}
+    for e in exprs:
+        target = e.children[0] if isinstance(e, Alias) and e.children else e
+        src = None
+        if isinstance(target, AttributeReference):
+            src = target.name
+        elif isinstance(target, BoundReference):
+            if 0 <= target.ordinal < len(child_names):
+                src = child_names[target.ordinal]
+        if src is not None and src not in out:
+            out[src] = output_name(e)
+    return out
+
+
+def _remap_dist(dist: Dist, mapping: Dict[str, str]) -> Dist:
+    if isinstance(dist, HashDist):
+        if all(k in mapping for k in dist.keys):
+            return HashDist([mapping[k] for k in dist.keys],
+                            dist.num_partitions)
+        return UnknownDist()
+    return dist
+
+
+def _remap_ordering(ordering, mapping: Dict[str, str]):
+    out = []
+    for name, asc in ordering:
+        if name not in mapping:
+            break  # ordering is a prefix contract
+        out.append((mapping[name], asc))
+    return tuple(out)
+
+
+def _child_passthrough(node: eb.Exec, st: AbstractState,
+                       **overrides) -> AbstractState:
+    out = st.replace(residency=_placement_residency(node))
+    for k, v in overrides.items():
+        setattr(out, k, v)
+    return out
+
+
+def _fallback_state(node: eb.Exec,
+                    child_states: Sequence[AbstractState]) -> AbstractState:
+    """Declared schema, no optimistic facts — the degradation for execs
+    the interpreter does not model."""
+    try:
+        names = list(node.output_names)
+        dtypes = list(node.output_types)
+    except Exception:
+        names, dtypes = [], []
+    return AbstractState(
+        names, dtypes,
+        residency=_placement_residency(node),
+        dist=UNKNOWN,
+        rows=_rows_of(node, child_states),
+        num_partitions=(child_states[0].num_partitions
+                        if child_states else None),
+        saw_exchange=any(s.saw_exchange for s in child_states))
+
+
+# ---------------------------------------------------------------------------
+# per-exec transfer functions
+# ---------------------------------------------------------------------------
+
+def _dist_of_partitioning(part, child_names: Sequence[str]) -> Dist:
+    from ..shuffle.partitioning import (HashPartitioning,
+                                        SinglePartitioning)
+    from ..expr.core import AttributeReference
+    if isinstance(part, SinglePartitioning):
+        return SINGLE
+    if isinstance(part, HashPartitioning):
+        keys = []
+        for k in part.keys:
+            if isinstance(k, AttributeReference) and k.name in child_names:
+                keys.append(k.name)
+            else:
+                return UnknownDist()
+        return HashDist(keys, part.num_partitions)
+    return UnknownDist()
+
+
+def _transfer(node: eb.Exec, child_states: List[AbstractState],
+              conf: cfg.RapidsConf) -> AbstractState:
+    from ..exec.basic import (CoalesceBatchesExec, FilterExec,
+                              GlobalLimitExec, LocalLimitExec,
+                              LocalScanExec, ProjectExec, RangeExec,
+                              SampleExec, UnionExec)
+    from ..exec.gatherpart import GatherPartitionsExec
+    from ..exec.sort import SortExec
+    from ..expr.core import AttributeReference, bind_expression, output_name
+
+    saw = any(s.saw_exchange for s in child_states)
+    rows = _rows_of(node, child_states)
+
+    if isinstance(node, LocalScanExec):
+        nullable = [f.nullable for f in node.table.schema]
+        return AbstractState(
+            node.output_names, node.output_types, nullable,
+            residency=_placement_residency(node),
+            dist=SINGLE if node.num_partitions == 1 else UNKNOWN,
+            rows=float(node.table.num_rows),
+            num_partitions=node.num_partitions)
+
+    if isinstance(node, RangeExec):
+        return AbstractState(
+            node.output_names, node.output_types, [False],
+            residency=_placement_residency(node),
+            dist=SINGLE if node.num_partitions == 1 else UNKNOWN,
+            rows=rows, num_partitions=node.num_partitions)
+
+    if isinstance(node, ProjectExec):
+        st = child_states[0]
+        names = [output_name(e) for e in node.exprs]
+        dtypes = []
+        nullable = []
+        for e in node.exprs:
+            b = bind_expression(e, st.names, st.dtypes)
+            dtypes.append(b.data_type())
+            nullable.append(bool(getattr(b, "nullable", True)))
+        mapping = _passthrough_map(node.exprs, st.names)
+        return AbstractState(
+            names, dtypes, nullable,
+            residency=_placement_residency(node),
+            dist=_remap_dist(st.dist, mapping),
+            ordering=_remap_ordering(st.ordering, mapping),
+            rows=rows, num_partitions=st.num_partitions,
+            saw_exchange=saw)
+
+    if isinstance(node, (FilterExec, SampleExec, LocalLimitExec,
+                         GlobalLimitExec, CoalesceBatchesExec)):
+        return _child_passthrough(node, child_states[0], rows=rows,
+                                  saw_exchange=saw)
+
+    if isinstance(node, SortExec):
+        st = child_states[0]
+        ordering = []
+        for e, asc, _nf in node.orders:
+            if isinstance(e, AttributeReference) and e.name in st.names:
+                ordering.append((e.name, bool(asc)))
+            else:
+                break  # a computed sort key ends the nameable prefix
+        return _child_passthrough(node, st, ordering=tuple(ordering),
+                                  rows=rows, saw_exchange=saw)
+
+    if isinstance(node, GatherPartitionsExec):
+        st = child_states[0]
+        keep_order = st.ordering if (st.num_partitions or 0) == 1 else ()
+        return st.replace(dist=SINGLE, num_partitions=1,
+                          ordering=keep_order, saw_exchange=saw)
+
+    if isinstance(node, UnionExec):
+        st = child_states[0]
+        parts = None
+        if all(s.num_partitions is not None for s in child_states):
+            parts = sum(s.num_partitions for s in child_states)
+        return AbstractState(
+            st.names, st.dtypes,
+            [any(s.nullable[i] if i < len(s.nullable) else True
+                 for s in child_states)
+             for i in range(len(st.names))],
+            residency=st.residency, dist=UNKNOWN, rows=rows,
+            num_partitions=parts, saw_exchange=saw)
+
+    # -- transitions ---------------------------------------------------------
+    if isinstance(node, eb.HostToDeviceExec):
+        return child_states[0].replace(residency=DEVICE)
+    if isinstance(node, eb.DeviceToHostExec):
+        return child_states[0].replace(residency=HOST)
+
+    # -- exchanges -----------------------------------------------------------
+    from ..shuffle.exchange import ShuffleExchangeExec
+    if isinstance(node, ShuffleExchangeExec):
+        st = child_states[0]
+        return st.replace(
+            residency=_placement_residency(node),
+            dist=_dist_of_partitioning(node.partitioning, st.names),
+            ordering=(),
+            num_partitions=node.partitioning.num_partitions,
+            rows=rows, saw_exchange=True)
+
+    from ..exec.broadcast import BroadcastExchangeExec
+    if isinstance(node, BroadcastExchangeExec):
+        st = child_states[0]
+        return st.replace(residency=_placement_residency(node),
+                          dist=REPLICATED, ordering=(), num_partitions=1,
+                          rows=rows, saw_exchange=True)
+
+    from ..shuffle.aqe import AQEShuffleReadExec, _SkewAwareRead
+    if isinstance(node, AQEShuffleReadExec):
+        st = child_states[0]
+        if isinstance(node, _SkewAwareRead):
+            # skew split scatters one reduce partition's blocks across
+            # several output partitions: clustering is GONE
+            dist: Dist = UNKNOWN
+        elif node.replicate_for is not None:
+            dist = REPLICATED
+        elif isinstance(st.dist, HashDist):
+            # partition coalescing preserves clustering, count unknown
+            dist = HashDist(st.dist.keys, None)
+        else:
+            dist = st.dist
+        return st.replace(dist=dist, num_partitions=None, ordering=(),
+                          saw_exchange=True)
+
+    # -- joins ---------------------------------------------------------------
+    from ..exec.join import HashJoinExec, NestedLoopJoinExec
+    if isinstance(node, HashJoinExec):
+        l, r = child_states
+        if node.how in ("left_semi", "left_anti"):
+            names, dtypes = list(l.names), list(l.dtypes)
+            nullable = list(l.nullable)
+        else:
+            names = list(l.names) + list(r.names)
+            dtypes = list(l.dtypes) + list(r.dtypes)
+            r_null = [True] * len(r.names) if node.how in ("left", "full") \
+                else list(r.nullable)
+            l_null = [True] * len(l.names) if node.how in ("right", "full") \
+                else list(l.nullable)
+            nullable = l_null + r_null
+        dist = l.dist if node.how in ("inner", "left", "left_semi",
+                                      "left_anti") else UNKNOWN
+        if isinstance(dist, HashDist) and \
+                not set(dist.keys) <= set(names):
+            dist = UNKNOWN
+        return AbstractState(
+            names, dtypes, nullable,
+            residency=_placement_residency(node), dist=dist, rows=rows,
+            num_partitions=l.num_partitions, saw_exchange=saw)
+
+    if isinstance(node, NestedLoopJoinExec):
+        l, r = child_states
+        return AbstractState(
+            list(l.names) + list(r.names),
+            list(l.dtypes) + list(r.dtypes),
+            residency=_placement_residency(node), dist=UNKNOWN,
+            rows=rows, num_partitions=l.num_partitions,
+            saw_exchange=saw)
+
+    # -- aggregates ----------------------------------------------------------
+    from ..exec.aggregate import TpuHashAggregateExec
+    from ..expr.aggregates import Count, FINAL, PARTIAL
+    if isinstance(node, TpuHashAggregateExec):
+        st = child_states[0]
+        k = len(node.grouping)
+        if node.mode == FINAL:
+            gnames = list(st.names[:k])
+            gtypes = list(st.dtypes[:k])
+        else:
+            gnames = [output_name(g) for g in node.grouping]
+            gtypes = [bind_expression(g, st.names, st.dtypes).data_type()
+                      for g in node.grouping]
+        if node.mode == PARTIAL:
+            names = gnames + node._buffer_names
+            dtypes = gtypes + node._buffer_types
+            nullable = [True] * len(names)
+        else:
+            names = gnames + [ae.name for ae in node.aggregates]
+            dtypes = gtypes + [ae.data_type() for ae in node.aggregates]
+            nullable = [True] * k + [
+                not isinstance(ae.func, Count) for ae in node.aggregates]
+        # grouped rows keep the child's clustering when the keys survive
+        if node.mode == FINAL:
+            mapping = {n: n for n in gnames}
+        else:
+            mapping = _passthrough_map(node.grouping, st.names)
+        dist = _remap_dist(st.dist, mapping) if k else \
+            (SINGLE if (st.num_partitions or 0) == 1 else st.dist)
+        return AbstractState(
+            names, dtypes, nullable,
+            residency=_placement_residency(node), dist=dist, rows=rows,
+            num_partitions=st.num_partitions, saw_exchange=saw)
+
+    # -- ICI fused stages ----------------------------------------------------
+    from ..parallel.ici_exec import IciExchangeExec
+    if isinstance(node, IciExchangeExec):
+        st = child_states[0]
+        return st.replace(
+            residency=DEVICE,
+            dist=_dist_of_partitioning(node.exchange.partitioning,
+                                       st.names),
+            ordering=(),
+            num_partitions=node.exchange.partitioning.num_partitions,
+            saw_exchange=True)
+
+    # anything else (python exchanges, window, expand, generate, cached
+    # scans, fused ICI stages, ...): declared schema, no optimistic facts
+    return _fallback_state(node, child_states)
+
+
+# ---------------------------------------------------------------------------
+# boundary checks
+# ---------------------------------------------------------------------------
+
+def _bound_expr_sites(node: eb.Exec) -> List[Tuple[object, int]]:
+    """(bound expression, child index) pairs whose BoundReferences were
+    frozen against the child's schema at construction time."""
+    from ..exec.basic import FilterExec, ProjectExec
+    from ..exec.sort import SortExec
+    from ..exec.join import HashJoinExec
+    from ..exec.aggregate import TpuHashAggregateExec
+    from ..expr.aggregates import COMPLETE, PARTIAL
+    out: List[Tuple[object, int]] = []
+    if isinstance(node, ProjectExec):
+        out += [(b, 0) for b in node._bound]
+    elif isinstance(node, FilterExec):
+        out.append((node._bound, 0))
+    elif isinstance(node, SortExec):
+        out += [(e, 0) for e, _asc, _nf in node._bound]
+    elif isinstance(node, HashJoinExec):
+        out += [(k, 0) for k in node.left_keys]
+        out += [(k, 1) for k in node.right_keys]
+    elif isinstance(node, TpuHashAggregateExec):
+        if node.mode in (PARTIAL, COMPLETE):
+            out += [(g, 0) for g in node._bound_grouping]
+            out += [(u, 0) for u in node._update_inputs]
+    return out
+
+
+def _check_bound_refs(node: eb.Exec, child_states: List[AbstractState],
+                      path: str) -> List[Diagnostic]:
+    from ..expr.core import BoundReference
+    diags: List[Diagnostic] = []
+    seen: Set[Tuple[int, int]] = set()
+    for bexpr, ci in _bound_expr_sites(node):
+        if ci >= len(child_states):
+            continue
+        st = child_states[ci]
+        try:
+            refs = bexpr.collect(
+                lambda e: isinstance(e, BoundReference))
+        except Exception:
+            continue
+        for br in refs:
+            key = (ci, br.ordinal)
+            if key in seen:
+                continue
+            if br.ordinal >= len(st.names) or br.ordinal < 0:
+                seen.add(key)
+                diags.append(L009.diag(
+                    f"{node.name} is bound to input ordinal "
+                    f"{br.ordinal} ({br.name}) but its child produces "
+                    f"only {len(st.names)} column(s) — the subtree was "
+                    f"swapped after binding; re-bind the operator",
+                    loc=path, node=node))
+            elif repr(br.dtype) != repr(st.dtypes[br.ordinal]):
+                seen.add(key)
+                diags.append(L009.diag(
+                    f"{node.name} is bound to ordinal {br.ordinal} as "
+                    f"{br.dtype.name} but the child now produces "
+                    f"{st.dtypes[br.ordinal].name} "
+                    f"({st.names[br.ordinal]}) — stale bind after a "
+                    f"rewrite", loc=path, node=node))
+    # union arms must agree column-for-column
+    from ..exec.basic import UnionExec
+    if isinstance(node, UnionExec) and len(child_states) > 1:
+        first = child_states[0]
+        for i, st in enumerate(child_states[1:], start=1):
+            if len(st.dtypes) != len(first.dtypes) or any(
+                    repr(a) != repr(b)
+                    for a, b in zip(first.dtypes, st.dtypes)):
+                diags.append(L009.diag(
+                    f"union arm {i} produces "
+                    f"[{', '.join(dt.name for dt in st.dtypes)}] but arm "
+                    f"0 produces "
+                    f"[{', '.join(dt.name for dt in first.dtypes)}]",
+                    loc=path, node=node))
+    return diags
+
+
+def _check_contracts(node: eb.Exec, child_states: List[AbstractState],
+                     path: str) -> List[Diagnostic]:
+    try:
+        contract = node.input_contracts()
+    except Exception:
+        return []
+    if contract is None:
+        return []
+    try:
+        violations = contract.check(child_states)
+    except Exception:
+        return []
+    diags = []
+    for v in violations:
+        established = any(s.saw_exchange for s in child_states)
+        rule = L011 if established else None
+        if rule is None:
+            # never established: the original TPU-L006 class, now decided
+            # on the inferred distribution instead of node shape
+            from .plan_lint import L006
+            rule = L006
+        diags.append(rule.diag(v, loc=path, node=node))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# plan-level passes: liveness (L010) and residency paths (L012)
+# ---------------------------------------------------------------------------
+
+def _bound_read_names(bexprs, st: AbstractState) -> Set[str]:
+    from ..expr.core import AttributeReference, BoundReference
+    out: Set[str] = set()
+    for b in bexprs:
+        try:
+            refs = b.collect(lambda e: isinstance(
+                e, (BoundReference, AttributeReference)))
+        except Exception:
+            return set(st.names)
+        for r in refs:
+            if isinstance(r, BoundReference):
+                if 0 <= r.ordinal < len(st.names):
+                    out.add(st.names[r.ordinal])
+            elif r.name in st.names:
+                out.add(r.name)
+    return out
+
+
+def _child_reads(node: eb.Exec, live_out: Set[str],
+                 child_states: List[AbstractState]) -> List[Set[str]]:
+    """Columns each child must produce for `node` to serve `live_out`.
+    Conservative default: everything."""
+    from ..exec.basic import (CoalesceBatchesExec, FilterExec,
+                              GlobalLimitExec, LocalLimitExec, ProjectExec,
+                              SampleExec, UnionExec)
+    from ..exec.gatherpart import GatherPartitionsExec
+    from ..exec.sort import SortExec
+    from ..exec.join import HashJoinExec
+    from ..exec.aggregate import TpuHashAggregateExec
+    from ..expr.aggregates import COMPLETE, FINAL, PARTIAL
+    from ..shuffle.exchange import ShuffleExchangeExec
+
+    if not node.children:
+        return []
+    st0 = child_states[0]
+
+    if isinstance(node, ProjectExec):
+        from ..expr.core import output_name
+        wanted = [b for e, b in zip(node.exprs, node._bound)
+                  if output_name(e) in live_out]
+        return [_bound_read_names(wanted, st0)]
+    if isinstance(node, FilterExec):
+        return [(live_out & set(st0.names)) |
+                _bound_read_names([node._bound], st0)]
+    if isinstance(node, SortExec):
+        return [(live_out & set(st0.names)) |
+                _bound_read_names([e for e, _a, _n in node._bound], st0)]
+    if isinstance(node, (SampleExec, LocalLimitExec, GlobalLimitExec,
+                         CoalesceBatchesExec, GatherPartitionsExec,
+                         eb.HostToDeviceExec, eb.DeviceToHostExec)):
+        return [live_out & set(st0.names)]
+    if isinstance(node, UnionExec):
+        return [live_out & set(s.names) for s in child_states]
+    if isinstance(node, ShuffleExchangeExec):
+        keys = set()
+        bound = getattr(node.partitioning, "_bound", None)
+        if bound is not None:
+            keys = _bound_read_names([bound], st0)
+        else:
+            orders = getattr(node.partitioning, "_bound_orders", None)
+            if orders:
+                keys = _bound_read_names([e for e, _a, _n in orders], st0)
+        return [(live_out & set(st0.names)) | keys]
+    if isinstance(node, HashJoinExec):
+        l, r = child_states
+        lread = (live_out & set(l.names)) | _bound_read_names(
+            node.left_keys, l)
+        rread = _bound_read_names(node.right_keys, r)
+        if node.how not in ("left_semi", "left_anti"):
+            rread |= live_out & set(r.names)
+        if node.condition is not None:
+            lread, rread = set(l.names), set(r.names)
+        return [lread, rread]
+    if isinstance(node, TpuHashAggregateExec):
+        if node.mode == FINAL:
+            return [set(st0.names)]  # every buffer column merges
+        reads = _bound_read_names(
+            list(node._bound_grouping) + list(node._update_inputs), st0)
+        return [reads]
+    return [set(s.names) for s in child_states]
+
+
+def _liveness_pass(root: eb.Exec, result: InterpResult) -> None:
+    root_state = result.state(root)
+    if root_state is None:
+        return
+
+    def down(node: eb.Exec, live_out: Set[str]):
+        result.live[id(node)] = set(live_out)
+        child_states = [result.state(c) or
+                        AbstractState(c.output_names, c.output_types)
+                        for c in node.children]
+        try:
+            reads = _child_reads(node, live_out, child_states)
+        except Exception:
+            reads = [set(s.names) for s in child_states]
+        for c, r in zip(node.children, reads):
+            down(c, r)
+
+    down(root, set(root_state.names))
+
+
+def _is_exchange_node(node: eb.Exec) -> bool:
+    from ..shuffle.exchange import ShuffleExchangeExec
+    from ..exec.broadcast import BroadcastExchangeExec
+    from ..parallel.ici_exec import IciExchangeExec
+    return isinstance(node, (ShuffleExchangeExec, BroadcastExchangeExec,
+                             IciExchangeExec))
+
+
+def _check_dead_columns(root: eb.Exec, result: InterpResult,
+                        conf: cfg.RapidsConf) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+
+    def walk(node: eb.Exec, path: str):
+        here = f"{path} > {node.name}" if path else node.name
+        st = result.state(node)
+        live = result.live_names(node)
+        if st is not None and live is not None and \
+                _is_exchange_node(node):
+            # partitioning keys are read by the router itself
+            child_states = [result.state(c) for c in node.children]
+            if all(s is not None for s in child_states):
+                keys: Set[str] = set()
+                reads = _child_reads(node, live, child_states)
+                if reads:
+                    keys = reads[0]
+                dead = [(n, dt) for n, dt in zip(st.names, st.dtypes)
+                        if n not in live and n not in keys]
+                if dead:
+                    rows = st.rows or 0.0
+                    wasted = int(rows * schema_width([dt for _n, dt
+                                                      in dead]))
+                    wire = "ICI" if conf.get(cfg.SHUFFLE_TRANSPORT) == \
+                        "ici" else "shuffle"
+                    cols = ", ".join(n for n, _dt in dead)
+                    diags.append(L010.diag(
+                        f"{node.name} ships column(s) [{cols}] that "
+                        f"nothing above the exchange reads "
+                        f"(~{max(wasted >> 10, 1)} KiB wasted {wire} "
+                        f"bytes); project them away below the exchange",
+                        loc=here, node=node))
+        for c in node.children:
+            walk(c, here)
+
+    walk(root, "")
+    return diags
+
+
+def _check_residency_paths(root: eb.Exec,
+                           result: InterpResult) -> List[Diagnostic]:
+    """Host islands strictly inside a device region along a root-to-leaf
+    path: data already resident on device comes down and goes straight
+    back up.  (A device region inside a host pipeline is the NORMAL
+    accelerated shape — upload, compute, fetch — and is never flagged.)
+    Each island costs two crossings; bytes total the states moved over
+    both edges."""
+    diags: List[Diagnostic] = []
+    seen: Set[Tuple[str, int]] = set()
+
+    def down(node: eb.Exec, path: str, runs: List[Tuple[str, float, str]]):
+        here = f"{path} > {node.name}" if path else node.name
+        res = result.residency(node)
+        if not runs or runs[-1][0] != res:
+            st = result.state(node)
+            b = (st.bytes_estimate() or 0.0) if st is not None else 0.0
+            runs = runs + [(res, b, here)]
+        if not node.children:
+            islands = [i for i in range(1, len(runs) - 1)
+                       if runs[i][0] == HOST and
+                       runs[i - 1][0] == DEVICE and
+                       runs[i + 1][0] == DEVICE]
+            if islands:
+                crossings = 2 * len(islands)
+                bytes_ = sum(runs[i][1] + runs[i + 1][1]
+                             for i in islands)
+                loc = runs[islands[0]][2]
+                key = (loc, crossings)
+                if key not in seen:
+                    seen.add(key)
+                    diags.append(L012.diag(
+                        f"{len(islands)} host island(s) inside a device "
+                        f"pipeline: the path crosses host<->device "
+                        f"{crossings} extra times "
+                        f"(~{max(int(bytes_) >> 10, 1)} KiB moved per "
+                        f"pass); hoist the host work out of the device "
+                        f"pipeline or fall the whole path back",
+                        loc=loc, node=None))
+        for c in node.children:
+            down(c, here, runs)
+
+    down(root, "", [])
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# front end
+# ---------------------------------------------------------------------------
+
+def infer_plan(root: eb.Exec, conf: cfg.RapidsConf) -> InterpResult:
+    """Run the abstract interpreter over a converted plan: fills in one
+    AbstractState per node, the liveness map, and every boundary
+    diagnostic (L009/L010/L011/L012 + flow-decided L006).  Pure — never
+    mutates the plan, never executes it."""
+    result = InterpResult()
+
+    def up(node: eb.Exec, path: str) -> AbstractState:
+        here = f"{path} > {node.name}" if path else node.name
+        child_states = [up(c, here) for c in node.children]
+        result.diags.extend(_check_bound_refs(node, child_states, here))
+        result.diags.extend(_check_contracts(node, child_states, here))
+        try:
+            st = _transfer(node, child_states, conf)
+        except Exception:
+            st = _fallback_state(node, child_states)
+        result.states[id(node)] = st
+        return st
+
+    up(root, "")
+    _liveness_pass(root, result)
+    result.diags.extend(_check_dead_columns(root, result, conf))
+    result.diags.extend(_check_residency_paths(root, result))
+    return result
+
+
+def format_states(root: eb.Exec, result: InterpResult) -> str:
+    """Inferred-state tree for `tools lint --plan --infer` output."""
+    lines: List[str] = []
+
+    def walk(node: eb.Exec, level: int):
+        st = result.state(node)
+        desc = st.describe() if st is not None else "(no state)"
+        live = result.live_names(node)
+        dead = ""
+        if live is not None and st is not None:
+            unread = [n for n in st.names if n not in live]
+            if unread and node.children:
+                dead = f" unread=[{', '.join(unread)}]"
+        lines.append(f"{'  ' * level}{node.name}: {desc}{dead}")
+        for c in node.children:
+            walk(c, level + 1)
+
+    walk(root, 0)
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,196 @@
+"""Differential oracle: the abstract interpreter checked against the
+engine it models.
+
+``capabilities.verify_gates()`` established the discipline for dtype
+gates: a planning-time predicate is only trusted because a drift check
+probes it against the kernel it guards.  This module applies the same
+discipline to the plan typechecker itself — for every subtree of a
+plan, execute it on the numpy/JAX-cpu backend and assert the
+interpreter's predictions hold on the real batches:
+
+  * **schema** — every yielded batch carries exactly the predicted
+    column names and dtypes;
+  * **residency** — predicted DEVICE subtrees yield jax-backed batches,
+    predicted HOST subtrees numpy-backed ones;
+  * **partition count** — a predicted count matches the node's actual
+    partitioning;
+  * **hash clustering** — a predicted ``HashDist(keys, n)`` is verified
+    extensionally: the distinct key tuples observed in different
+    partitions are pairwise disjoint;
+  * **ordering** — a predicted within-partition sort contract is
+    verified on the materialized rows.
+
+``verify_plan`` runs over the golden good-plan corpus in
+tests/test_interp_oracle.py and devtools/run_lint.py --interp: any
+mismatch means the analyzer drifted from the engine and fails tier-1
+(zero false rejects); the bad-plan fixtures keep the other direction
+honest (zero false admits).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .. import config as cfg
+from ..exec import base as eb
+from .absdomain import (DEVICE, HOST, AbstractState, HashDist,
+                        ReplicatedDist, SingleDist)
+
+
+class Observation:
+    """What one subtree's real execution showed."""
+
+    __slots__ = ("names", "dtypes", "device", "partitions",
+                 "partition_tables", "rows")
+
+    def __init__(self, names, dtypes, device, partitions,
+                 partition_tables, rows):
+        self.names = names                      # per-batch column names
+        self.dtypes = dtypes                    # per-batch column dtypes
+        self.device = device                    # bool | None (no batches)
+        self.partitions = partitions
+        self.partition_tables = partition_tables  # pid -> list[RecordBatch]
+        self.rows = rows
+
+
+def _observe(node: eb.Exec, ctx: eb.ExecContext) -> Observation:
+    from ..columnar.fetch import batch_is_device
+    names: Optional[Tuple[str, ...]] = None
+    dtypes: Optional[Tuple] = None
+    device: Optional[bool] = None
+    tables: Dict[int, list] = {}
+    rows = 0
+    nparts = node.num_partitions
+    for pid in range(nparts):
+        tables[pid] = []
+        for b in node.execute_partition(pid, ctx):
+            bn = tuple(b.names)
+            bt = tuple(c.dtype for c in b.columns)
+            if names is None:
+                names, dtypes = bn, bt
+            elif bn != names or tuple(map(repr, bt)) != \
+                    tuple(map(repr, dtypes)):
+                raise AssertionError(
+                    f"{node.name} yields inconsistent batch schemas: "
+                    f"{bn} vs {names}")
+            device = bool(batch_is_device(b)) if device is None \
+                else (device or batch_is_device(b))
+            rb = eb.to_host_batch(b, b.names)
+            rows += rb.num_rows
+            if rb.num_rows:
+                tables[pid].append(rb)
+    return Observation(names, dtypes, device, nparts, tables, rows)
+
+
+def _key_tuples(batches, names: Sequence[str],
+                keys: Sequence[str]) -> Set[tuple]:
+    out: Set[tuple] = set()
+    idx = [list(names).index(k) for k in keys]
+    for rb in batches:
+        cols = [rb.column(i).to_pylist() for i in idx]
+        out.update(zip(*cols) if cols else ())
+    return out
+
+
+def _check_ordering(batches, names: Sequence[str],
+                    ordering) -> Optional[str]:
+    """Verify the first ordering key is monotone over the partition's
+    rows in yield order (nulls skipped — null placement is a separate
+    contract the domain does not model)."""
+    if not ordering:
+        return None
+    key, asc = ordering[0]
+    if key not in names:
+        return f"predicted ordering key {key!r} missing from output"
+    i = list(names).index(key)
+    vals = [v for rb in batches for v in rb.column(i).to_pylist()
+            if v is not None]
+    ok = all(a <= b for a, b in zip(vals, vals[1:])) if asc else \
+        all(a >= b for a, b in zip(vals, vals[1:]))
+    if not ok:
+        return (f"predicted {'ascending' if asc else 'descending'} "
+                f"ordering on {key!r} does not hold at runtime")
+    return None
+
+
+def _compare(st: AbstractState, obs: Observation) -> List[str]:
+    out: List[str] = []
+    if obs.names is not None:
+        if tuple(st.names) != obs.names:
+            out.append(f"predicted columns {list(st.names)} but execution "
+                       f"produced {list(obs.names)}")
+        elif [repr(dt) for dt in st.dtypes] != \
+                [repr(dt) for dt in obs.dtypes]:
+            pred = [dt.name for dt in st.dtypes]
+            got = [dt.name for dt in obs.dtypes]
+            out.append(f"predicted dtypes {pred} but execution produced "
+                       f"{got}")
+    if obs.device is not None:
+        pred_dev = st.residency == DEVICE
+        if pred_dev != obs.device:
+            out.append(f"predicted {st.residency} residency but batches "
+                       f"are {'device' if obs.device else 'host'}-backed")
+    if st.num_partitions is not None and \
+            st.num_partitions != obs.partitions:
+        out.append(f"predicted {st.num_partitions} partition(s) but the "
+                   f"operator runs {obs.partitions}")
+    if isinstance(st.dist, SingleDist) and obs.partitions != 1:
+        out.append(f"predicted single-partition distribution but the "
+                   f"operator runs {obs.partitions} partitions")
+    if isinstance(st.dist, HashDist) and obs.names is not None and \
+            all(k in obs.names for k in st.dist.keys):
+        if st.dist.num_partitions is not None and \
+                st.dist.num_partitions != obs.partitions:
+            out.append(f"predicted hash routing over "
+                       f"{st.dist.num_partitions} partitions but the "
+                       f"operator runs {obs.partitions}")
+        seen: Dict[tuple, int] = {}
+        for pid, batches in obs.partition_tables.items():
+            for kt in _key_tuples(batches, obs.names, st.dist.keys):
+                prev = seen.setdefault(kt, pid)
+                if prev != pid:
+                    out.append(
+                        f"predicted clustering on "
+                        f"[{', '.join(st.dist.keys)}] is violated: key "
+                        f"{kt} appears in partitions {prev} and {pid}")
+                    break
+    if obs.names is not None:
+        for pid, batches in obs.partition_tables.items():
+            err = _check_ordering(batches, obs.names, st.ordering)
+            if err:
+                out.append(f"partition {pid}: {err}")
+                break
+    return out
+
+
+def verify_plan(root: eb.Exec, conf: cfg.RapidsConf,
+                skip: Sequence[type] = ()) -> List[str]:
+    """Execute every subtree of `root` on the numpy backend and return
+    every way the interpreter's predictions disagree with reality
+    (empty list = the analyzer matches the engine on this plan)."""
+    from .interp import infer_plan
+    result = infer_plan(root, conf)
+    ctx = eb.ExecContext(conf)
+    # speculative sizing defers correctness guards to the collect
+    # boundary; the oracle reads interior nodes directly, so run exact
+    ctx.task_context["no_speculation"] = True
+    mismatches: List[str] = []
+
+    def walk(node: eb.Exec, path: str):
+        here = f"{path} > {node.name}" if path else node.name
+        for c in node.children:
+            walk(c, here)
+        if skip and isinstance(node, tuple(skip)):
+            return
+        st = result.state(node)
+        if st is None:
+            return
+        try:
+            obs = _observe(node, ctx)
+        except AssertionError as ex:
+            mismatches.append(f"{here}: {ex}")
+            return
+        mismatches.extend(f"{here}: {m}" for m in _compare(st, obs))
+
+    walk(root, "")
+    return mismatches

@@ -17,6 +17,12 @@ The rule classes target what round 5 showed actually breaks queries:
             Arrow because of its column types
   TPU-L008  opaque Python-UDF boundary inside a device pipeline
 
+The flow-sensitive rules TPU-L009..L012 (schema mismatch at a boundary,
+dead exchange columns, contract violation after rewrite, residency
+ping-pong totals) live in ``analysis/interp.py`` — the abstract
+interpreter whose per-subtree states also upgrade L002/L006/L007 here
+from syntactic to flow-sensitive (see docs/static-analysis.md).
+
 ``lint_plan`` is pure analysis; ``downgrade_hazards`` applies the safe
 repairs (host fallback by placement flip — the CPU engine runs the
 identical xp-parameterized kernels) for the rules where that is sound,
@@ -101,13 +107,40 @@ L008 = register_rule(
     "upload.")
 
 # rules whose host-fallback repair is sound (placement flip runs the
-# identical xp-parameterized kernels on the host engine)
-DOWNGRADE_CODES = {"TPU-L001", "TPU-L003", "TPU-L006"}
+# identical xp-parameterized kernels on the host engine).  TPU-L011
+# (contract broken by a rewrite) repairs exactly like L006: the flip
+# clears the co-location assumption and the host path re-merges whole.
+# TPU-L009 is NOT here — a stale bind is wrong on either engine.
+DOWNGRADE_CODES = {"TPU-L001", "TPU-L003", "TPU-L006", "TPU-L011"}
 
 
 # ---------------------------------------------------------------------------
 # walk helpers
 # ---------------------------------------------------------------------------
+
+class LintContext:
+    """What every rule check sees: the session conf plus (when the
+    abstract interpreter ran) the per-node inferred states and liveness,
+    so rules can be flow-sensitive with a syntactic fallback."""
+
+    def __init__(self, conf: cfg.RapidsConf, interp=None):
+        self.conf = conf
+        self.interp = interp  # analysis.interp.InterpResult or None
+
+    def get(self, entry):
+        return self.conf.get(entry)
+
+    def residency(self, node: eb.Exec) -> str:
+        from .absdomain import DEVICE, HOST
+        if self.interp is not None:
+            return self.interp.residency(node)
+        return DEVICE if node.placement == eb.TPU else HOST
+
+    def live_names(self, node: eb.Exec):
+        if self.interp is None:
+            return None
+        return self.interp.live_names(node)
+
 
 def _walk(node: eb.Exec, parent: Optional[eb.Exec] = None, path: str = ""
           ) -> Iterator[Tuple[eb.Exec, Optional[eb.Exec], str]]:
@@ -140,8 +173,8 @@ def _is_exchange(node: eb.Exec) -> bool:
 # per-node rule checks
 # ---------------------------------------------------------------------------
 
-def _check_ici_admit_mismatch(conf, node, parent, path):
-    if conf.get(cfg.SHUFFLE_TRANSPORT) != "ici":
+def _check_ici_admit_mismatch(ctx, node, parent, path):
+    if ctx.get(cfg.SHUFFLE_TRANSPORT) != "ici":
         return
     if not hasattr(node, "aggregates") or getattr(node, "grouping", None):
         return
@@ -162,20 +195,23 @@ def _check_ici_admit_mismatch(conf, node, parent, path):
                 loc=path, node=node)
 
 
-def _check_ping_pong(conf, node, parent, path):
-    if node.placement != eb.CPU or parent is None:
+def _check_ping_pong(ctx, node, parent, path):
+    # flow-sensitive: decided on the INFERRED residency (which knows
+    # forwarding operators and transitions), not the raw placement flag
+    from .absdomain import DEVICE, HOST
+    if ctx.residency(node) != HOST or parent is None:
         return
     if getattr(node, "deliberate_cpu", False):
         return  # python exchange: TPU-L008's finding, not a planning slip
-    if parent.placement == eb.TPU and \
-            any(c.placement == eb.TPU for c in node.children):
+    if ctx.residency(parent) == DEVICE and \
+            any(ctx.residency(c) == DEVICE for c in node.children):
         yield L002.diag(
-            f"{node.name} runs on host between device-placed "
-            f"{parent.name} and a device-placed child: two interconnect "
-            f"crossings per batch", loc=path, node=node)
+            f"{node.name} runs on host between device-resident "
+            f"{parent.name} and a device-resident child: two "
+            f"interconnect crossings per batch", loc=path, node=node)
 
 
-def _check_host_expr_on_device(conf, node, parent, path):
+def _check_host_expr_on_device(ctx, node, parent, path):
     if node.placement != eb.TPU:
         return
     exprs = _node_expressions(node)
@@ -187,7 +223,7 @@ def _check_host_expr_on_device(conf, node, parent, path):
     dtypes = child.output_types if child is not None else []
     for e in exprs:
         try:
-            meta = ExprMeta(e, conf, names, dtypes)
+            meta = ExprMeta(e, ctx.conf, names, dtypes)
             meta.tag()
         except Exception:
             continue  # unbindable here != hazard; tagging owns that call
@@ -207,10 +243,10 @@ def _node_expressions(node: eb.Exec):
     return []
 
 
-def _check_driver_collect(conf, node, parent, path):
+def _check_driver_collect(ctx, node, parent, path):
     from ..exec.broadcast import BroadcastExchangeExec
     from ..exec.join import HashJoinExec
-    cap = conf.get(cfg.LINT_MAX_DRIVER_COLLECT)
+    cap = ctx.get(cfg.LINT_MAX_DRIVER_COLLECT)
     build = None
     if isinstance(node, BroadcastExchangeExec):
         build = node.children[0]
@@ -232,8 +268,8 @@ def _check_driver_collect(conf, node, parent, path):
             f"it", loc=path, node=node)
 
 
-def _check_ici_host_staging(conf, node, parent, path):
-    if conf.get(cfg.SHUFFLE_TRANSPORT) != "ici":
+def _check_ici_host_staging(ctx, node, parent, path):
+    if ctx.get(cfg.SHUFFLE_TRANSPORT) != "ici":
         return
     from ..shuffle.exchange import ShuffleExchangeExec
     if not isinstance(node, ShuffleExchangeExec):
@@ -241,12 +277,24 @@ def _check_ici_host_staging(conf, node, parent, path):
     from ..parallel.alltoall import exchange_supported
     reason = exchange_supported(node.output_types)
     if reason:
+        # flow-sensitive refinement: if only columns nothing above reads
+        # block the transport, the real fix is dropping them (TPU-L010)
+        hint = ""
+        live = ctx.live_names(node)
+        if live is not None:
+            live_types = [dt for n, dt in zip(node.output_names,
+                                              node.output_types)
+                          if n in live]
+            if exchange_supported(live_types) is None:
+                hint = (" — only columns nothing above the exchange "
+                        "reads block the transport; dropping them "
+                        "(see TPU-L010) restores ICI")
         yield L007.diag(
-            f"exchange falls off the ICI transport: {reason}",
+            f"exchange falls off the ICI transport: {reason}{hint}",
             loc=path, node=node)
 
 
-def _check_udf_boundary(conf, node, parent, path):
+def _check_udf_boundary(ctx, node, parent, path):
     from ..exec.python_udf import ArrowEvalPythonExec
     opaque = getattr(node, "deliberate_cpu", False) or \
         isinstance(node, ArrowEvalPythonExec)
@@ -259,7 +307,13 @@ def _check_udf_boundary(conf, node, parent, path):
             loc=path, node=node)
 
 
-def _check_partition_contract(conf, node, parent, path):
+def _check_partition_contract(ctx, node, parent, path):
+    # flow-sensitive mode subsumes this: interp evaluates the operator's
+    # declared input_contracts() against the INFERRED distribution (so a
+    # filter/project between exchange and consumer no longer hides the
+    # contract, and a wrong-keyed exchange no longer satisfies it)
+    if ctx.interp is not None:
+        return
     from ..exec.aggregate import TpuHashAggregateExec
     from ..exec.join import HashJoinExec
     from ..expr.aggregates import FINAL
@@ -329,14 +383,37 @@ def _check_compile_churn(conf, root) -> Iterator[Diagnostic]:
 # front end
 # ---------------------------------------------------------------------------
 
-def lint_plan(root: eb.Exec, conf: cfg.RapidsConf) -> List[Diagnostic]:
+def lint_plan(root: eb.Exec, conf: cfg.RapidsConf,
+              infer: Optional[bool] = None) -> List[Diagnostic]:
     """Analyze a converted physical plan; returns sorted diagnostics
-    (most severe first).  Pure — never mutates the plan."""
+    (most severe first).  Pure — never mutates (or executes) the plan.
+
+    `infer` controls the flow-sensitive mode: the abstract interpreter
+    (analysis/interp.py) runs first, its per-node states upgrade
+    L002/L006/L007 from syntactic to flow-sensitive and add the
+    boundary rules L009-L012.  Default comes from
+    spark.rapids.tpu.lint.infer (on); a failed interpretation degrades
+    to the syntactic rules rather than killing planning."""
+    if infer is None:
+        infer = conf.get(cfg.LINT_INFER)
     diags: List[Diagnostic] = []
+    interp_result = None
+    if infer:
+        try:
+            from .interp import infer_plan
+            interp_result = infer_plan(root, conf)
+            diags.extend(interp_result.diags)
+        except Exception as ex:  # degrade to syntactic, never kill planning
+            interp_result = None
+            diags.append(Diagnostic(
+                "TPU-L000", INFO,
+                f"abstract interpreter failed ({ex}); syntactic rules "
+                f"only", loc=root.name))
+    ctx = LintContext(conf, interp_result)
     for node, parent, path in _walk(root):
         for check in _NODE_CHECKS:
             try:
-                diags.extend(check(conf, node, parent, path) or ())
+                diags.extend(check(ctx, node, parent, path) or ())
             except Exception as ex:  # a broken rule must not kill planning
                 diags.append(Diagnostic(
                     "TPU-L000", INFO,

@@ -47,6 +47,37 @@ def _is_device(x) -> bool:
     return isinstance(x, jax.Array)
 
 
+def fetch_ints(scalars: Sequence) -> List[int]:
+    """Resolve a mixed list of host/device integer scalars to python ints
+    in at most ONE device transfer.
+
+    This is the sanctioned crossing for host-driven control flow that
+    needs a handful of device scalars (span byte counts, slice bounds):
+    callers stack every scalar they need and pay a single tunnel round
+    trip instead of one per value (TPU-R001's whole point)."""
+    dev_idx: List[int] = []
+    dev_vals: List = []
+    out: List[Optional[int]] = []
+    for s in scalars:
+        if _is_device(s):
+            out.append(None)
+            dev_idx.append(len(out) - 1)
+            dev_vals.append(jnp.asarray(s).astype(jnp.int64))
+        else:
+            out.append(int(s))
+    if dev_vals:
+        fetched = np.asarray(jnp.stack(dev_vals))  # one transfer
+        for i, v in zip(dev_idx, fetched):
+            out[i] = int(v)
+    return out  # type: ignore[return-value]
+
+
+def fetch_array(x) -> np.ndarray:
+    """Sanctioned single-transfer host materialization of one device
+    array (e.g. the join count phase's stacked sizes vector)."""
+    return np.asarray(x)
+
+
 def batch_is_device(batch: DeviceBatch) -> bool:
     return any(_is_device(l) for l in jax.tree_util.tree_leaves(batch))
 

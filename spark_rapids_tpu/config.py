@@ -512,6 +512,15 @@ LINT_ENABLED = conf("spark.rapids.tpu.lint.enabled").boolean() \
          "downgraded to the host engine instead of crashing mid-query.") \
     .create_with_default(False)
 
+LINT_INFER = conf("spark.rapids.tpu.lint.infer").boolean() \
+    .doc("Run the plan lint in flow-sensitive mode: the abstract "
+         "interpreter (analysis/interp.py) propagates schema/residency/"
+         "partitioning/size states through the plan, upgrading "
+         "TPU-L002/L006/L007 from syntactic to flow-sensitive and "
+         "adding the boundary rules TPU-L009..L012.  A failed "
+         "interpretation degrades to the syntactic rules.") \
+    .create_with_default(True)
+
 LINT_DISABLE = conf("spark.rapids.tpu.lint.disable").string() \
     .doc("Comma-separated diagnostic codes (e.g. TPU-L005) to suppress "
          "in the plan lint.") \

@@ -62,8 +62,9 @@ def generate_lint_rules() -> str:
     """docs/lint_rules.md from the live tpulint rule catalog (the lint
     analog of supported_ops: codes/severities/docs can never drift from
     the rules actually enforced)."""
-    # importing the front ends populates the catalog
-    from .analysis import plan_lint, repo_lint  # noqa: F401
+    # importing the front ends populates the catalog (interp carries the
+    # flow-sensitive rules TPU-L009..L012)
+    from .analysis import interp, plan_lint, repo_lint  # noqa: F401
     from .analysis.diagnostics import RULE_CATALOG
     lines = [
         "# tpulint rule catalog",

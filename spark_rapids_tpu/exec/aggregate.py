@@ -446,6 +446,16 @@ class TpuHashAggregateExec(Exec):
         for ae in self.aggregates:
             self._merge_ops += ae.func.merge_ops()
 
+    def input_contracts(self):
+        if self.mode != FINAL or not self.grouping:
+            return None
+        from ..analysis.absdomain import ClusteredContract
+        # FINAL input layout: grouping columns first — partial buffers
+        # for one group must all arrive in this task's partition
+        keys = self.children[0].output_names[:len(self.grouping)]
+        return ClusteredContract(keys,
+                                 what="FINAL-mode grouped aggregate")
+
     @property
     def output_names(self):
         if self.mode == PARTIAL:

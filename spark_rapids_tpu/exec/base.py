@@ -457,6 +457,20 @@ class Exec:
             return self.children[0].num_partitions
         return 1
 
+    # -- interface requirements ----------------------------------------------
+    def input_contracts(self):
+        """Declared producer/consumer interface requirement for the
+        flow-sensitive plan typechecker (analysis/interp.py): either
+        None (no requirement beyond a bindable schema — the default) or
+        an analysis.absdomain.Contract whose check() receives the
+        children's inferred abstract states and returns violation
+        strings.  Operators that assume a partitioning contract
+        (colocated joins, FINAL-mode aggregates) override this; the
+        interpreter enforces every declaration and the differential
+        oracle (analysis/oracle.py) keeps the declarations honest
+        against real execution."""
+        return None
+
     # -- statistics ----------------------------------------------------------
     def estimated_size_bytes(self) -> Optional[int]:
         """Rough output-size estimate for planning (broadcast decisions, CBO

@@ -28,6 +28,14 @@ def _concat_flat(xp, arrays, cap, fill_dtype):
     return xp.concatenate([joined, pad])
 
 
+def _span_counts(xp, cols, counts) -> list:
+    """Per-column live child/byte counts (offsets[n]) as host ints, all
+    resolved in ONE device transfer (columnar/fetch.py's sanctioned
+    crossing) instead of one implicit sync per column."""
+    from ..columnar.fetch import fetch_ints
+    return fetch_ints([c.offsets[n] for c, n in zip(cols, counts)])
+
+
 def concat_columns(xp, cols: Sequence[DeviceColumn], counts, cap: int,
                    dtype: t.DataType) -> DeviceColumn:
     """Concatenate column segments where cols[i] contributes its first
@@ -44,9 +52,8 @@ def concat_columns(xp, cols: Sequence[DeviceColumn], counts, cap: int,
         offs_parts = []
         chars_parts = []
         base = 0
-        for c, n in zip(cols, counts):
+        for c, n, nb in zip(cols, counts, _span_counts(xp, cols, counts)):
             o = c.offsets
-            nb = int(o[n]) if xp is np else int(np.asarray(o)[n])
             offs_parts.append((o[:n] if xp is np else o[:n]) + np.int32(base))
             chars_parts.append(c.data[:nb])
             base += nb
@@ -70,9 +77,8 @@ def concat_columns(xp, cols: Sequence[DeviceColumn], counts, cap: int,
         offs_parts = []
         base = 0
         child_counts = []
-        for c, n in zip(cols, counts):
+        for c, n, nb in zip(cols, counts, _span_counts(xp, cols, counts)):
             o = c.offsets
-            nb = int(np.asarray(o)[n])
             offs_parts.append(o[:n] + np.int32(base))
             child_counts.append(nb)
             base += nb

@@ -113,6 +113,17 @@ class HashJoinExec(Exec):
             bind_expression(condition, self.output_names, self.output_types)
             if condition is not None else None)
 
+    def input_contracts(self):
+        if not self.colocated:
+            return None
+        from ..analysis.absdomain import CoClusteredContract, key_names
+        l, r = self.children
+        lk = key_names(self.left_keys, l.output_names)
+        rk = key_names(self.right_keys, r.output_names)
+        if lk is None or rk is None:
+            return None  # computed keys: no nameable clustering fact
+        return CoClusteredContract(lk, rk)
+
     @property
     def output_names(self):
         l, r = self.children
@@ -406,7 +417,8 @@ class HashJoinExec(Exec):
                 if self.how == "right":
                     # planned flipped; only unmatched emission remains here
                     pass
-                sizes = np.asarray(sizes)          # one round trip
+                from ..columnar.fetch import fetch_array
+                sizes = fetch_array(sizes)         # one round trip
                 ntotal = int(sizes[0])
                 if ntotal >= (1 << 31):
                     # expand_pairs builds pair offsets in int32; a wrap
@@ -702,8 +714,11 @@ def _left_conditional_impl(join_exec: "CpuJoinExec", lt, rt, lkn, rkn,
     real = pc.is_valid(joined.column("__bmark__"))
     passing = pc.and_(mask, real)
     pass_rows = joined.filter(passing)
-    passed = np.unique(np.asarray(pass_rows.column("__pid__")))
-    all_pids = np.asarray(lt2.column("__pid__"))
+    # pure host data (pyarrow chunked arrays), no device crossing here
+    passed = np.unique(pass_rows.column("__pid__").combine_chunks()
+                       .to_numpy(zero_copy_only=False))
+    all_pids = lt2.column("__pid__").combine_chunks() \
+        .to_numpy(zero_copy_only=False)
     missing = lt2.take(np.flatnonzero(~np.isin(all_pids, passed)))
     out = pass_rows.select(lnames + rnames)
     if missing.num_rows:

@@ -41,21 +41,30 @@ def slice_batch(xp, batch: DeviceBatch, names, types, start: int,
                 length: int) -> DeviceBatch:
     """Host-driven row slice [start, start+length) re-bucketed to the
     smallest covering capacity (variable-length columns re-pack)."""
+    from ..columnar.fetch import fetch_ints
     cap = bucket_for(max(length, 1), DEFAULT_ROW_BUCKETS)
     idx = xp.arange(cap, dtype=xp.int32) + np.int32(start)
     valid = xp.arange(cap, dtype=xp.int32) < length
+    # span columns need their [start, start+length) child extents to pick
+    # output buckets: gather every lo/hi scalar in ONE batched fetch
+    # (fetch_ints) rather than pulling each column's whole offsets lane
+    span_cols = [c for c, dt in zip(batch.columns, types)
+                 if isinstance(dt, (t.StringType, t.BinaryType,
+                                    t.ArrayType, t.MapType))]
+    wanted = []
+    for c in span_cols:
+        last = int(c.offsets.shape[0]) - 1
+        wanted.append(c.offsets[min(start, last)])
+        wanted.append(c.offsets[min(start + length, last)])
+    bounds = iter(fetch_ints(wanted))
     char_caps = []
     for c, dt in zip(batch.columns, types):
         if isinstance(dt, (t.StringType, t.BinaryType)):
-            o = np.asarray(c.offsets)
-            lo = int(o[min(start, len(o) - 1)])
-            hi = int(o[min(start + length, len(o) - 1)])
+            lo, hi = next(bounds), next(bounds)
             char_caps.append(bucket_for(max(hi - lo, 1),
                                         DEFAULT_CHAR_BUCKETS))
         elif isinstance(dt, (t.ArrayType, t.MapType)):
-            o = np.asarray(c.offsets)
-            lo = int(o[min(start, len(o) - 1)])
-            hi = int(o[min(start + length, len(o) - 1)])
+            lo, hi = next(bounds), next(bounds)
             char_caps.append(bucket_for(max(hi - lo, 1),
                                         DEFAULT_ROW_BUCKETS))
         else:

@@ -51,6 +51,38 @@ _CARDINALITY = {
 }
 
 
+def estimate_rows(node: eb.Exec, child_rows: List[float]) -> float:
+    """Output-row estimate for one operator given its children's
+    estimates — the single row model shared by the cost-based optimizer
+    and the flow-sensitive plan typechecker (analysis/interp.py), so
+    admission decisions and CBO placement reason from the same numbers."""
+    name = type(node).__name__
+    from ..exec.basic import GlobalLimitExec, LocalLimitExec, LocalScanExec, RangeExec
+    if isinstance(node, LocalScanExec):
+        return float(node.table.num_rows)
+    if isinstance(node, RangeExec):
+        return max(1.0, abs(node.end - node.start) / abs(node.step))
+    from ..io.scan import FileScanExec
+    if isinstance(node, FileScanExec):
+        try:
+            import os
+            size = sum(os.path.getsize(p) for p in node.paths)
+            return max(size / 100.0, 1.0)  # ~100 compressed bytes/row
+        except OSError:
+            return float(DEFAULT_ROW_COUNT)
+    if isinstance(node, (LocalLimitExec, GlobalLimitExec)):
+        n = float(node.limit)
+        return min(n, child_rows[0]) if child_rows else n
+    if not child_rows:
+        return float(DEFAULT_ROW_COUNT)
+    if name in ("UnionExec",):
+        return sum(child_rows)
+    if name in ("HashJoinExec", "CpuJoinExec", "BroadcastHashJoinExec",
+                "NestedLoopJoinExec", "BroadcastNestedLoopJoinExec"):
+        return max(child_rows)
+    return child_rows[0] * _CARDINALITY.get(name, 1.0)
+
+
 class CostBasedOptimizer:
     def __init__(self, conf: cfg.RapidsConf):
         self.conf = conf
@@ -62,31 +94,7 @@ class CostBasedOptimizer:
         return float(raw) if raw is not None else default
 
     def _rows(self, node: eb.Exec, child_rows: List[float]) -> float:
-        name = type(node).__name__
-        from ..exec.basic import GlobalLimitExec, LocalLimitExec, LocalScanExec, RangeExec
-        if isinstance(node, LocalScanExec):
-            return float(node.table.num_rows)
-        if isinstance(node, RangeExec):
-            return max(1.0, abs(node.end - node.start) / abs(node.step))
-        from ..io.scan import FileScanExec
-        if isinstance(node, FileScanExec):
-            try:
-                import os
-                size = sum(os.path.getsize(p) for p in node.paths)
-                return max(size / 100.0, 1.0)  # ~100 compressed bytes/row
-            except OSError:
-                return float(DEFAULT_ROW_COUNT)
-        if isinstance(node, (LocalLimitExec, GlobalLimitExec)):
-            n = float(node.limit)
-            return min(n, child_rows[0]) if child_rows else n
-        if not child_rows:
-            return float(DEFAULT_ROW_COUNT)
-        if name in ("UnionExec",):
-            return sum(child_rows)
-        if name in ("HashJoinExec", "CpuJoinExec", "BroadcastHashJoinExec",
-                    "NestedLoopJoinExec", "BroadcastNestedLoopJoinExec"):
-            return max(child_rows)
-        return child_rows[0] * _CARDINALITY.get(name, 1.0)
+        return estimate_rows(node, child_rows)
 
     # -- the DP -------------------------------------------------------------
     def optimize(self, meta) -> int:

@@ -1031,7 +1031,12 @@ class TpuOverrides:
             # opt-in pre-flight: hazards the rewrite engine admitted but
             # the runtime would crash on (or quietly serve wrong/slow)
             # become structured diagnostics, and the subtrees with a
-            # sound host fallback are downgraded instead of executed
+            # sound host fallback are downgraded instead of executed.
+            # The lint runs flow-sensitively (spark.rapids.tpu.lint.infer,
+            # on by default): the abstract interpreter's per-subtree
+            # states decide the contract rules, so the downgrade set
+            # includes violations only dataflow can see (TPU-L011 —
+            # a contract broken BETWEEN its exchange and its consumer).
             from ..analysis.plan_lint import downgrade_hazards, lint_plan
             self.last_lint = lint_plan(converted, self.conf)
             if self.last_lint:

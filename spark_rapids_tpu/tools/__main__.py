@@ -3,7 +3,7 @@
     python -m spark_rapids_tpu.tools qualification <eventlogs...> [-o DIR]
     python -m spark_rapids_tpu.tools profiling     <eventlogs...> [-o DIR] [-c]
     python -m spark_rapids_tpu.tools lint --repo   [--baseline FILE]
-    python -m spark_rapids_tpu.tools lint --plan   <fixture.py...>
+    python -m spark_rapids_tpu.tools lint --plan   <fixture.py...> [--infer]
 
 Lint fixtures are Python files defining ``plan_*()`` builders, each
 returning ``(exec_root, conf_dict)`` — the checked-in golden bad plans
@@ -14,7 +14,7 @@ import argparse
 import sys
 
 
-def _run_plan_lint(paths):
+def _run_plan_lint(paths, infer=False):
     import runpy
 
     from ..analysis.diagnostics import format_diagnostics
@@ -31,8 +31,15 @@ def _run_plan_lint(paths):
             return 2
         for name in builders:
             root, conf_map = ns[name]()
-            diags = lint_plan(root, RapidsConf(conf_map))
+            conf = RapidsConf(conf_map)
+            diags = lint_plan(root, conf)
             sys.stdout.write(f"== {path}::{name}\n")
+            if infer:
+                # print the abstract interpreter's per-subtree states
+                # (schema / residency / distribution / rows / liveness)
+                from ..analysis.interp import format_states, infer_plan
+                sys.stdout.write(format_states(root, infer_plan(root,
+                                                                conf)))
             sys.stdout.write(format_diagnostics(diags))
             any_error |= any(d.is_error for d in diags)
     return 1 if any_error else 0
@@ -85,6 +92,11 @@ def main(argv=None):
     li.add_argument("--plan", nargs="*", metavar="FIXTURE",
                     help="lint physical plans built by plan_*() "
                          "functions in the given Python files")
+    li.add_argument("--infer", action="store_true",
+                    help="with --plan: print the abstract "
+                         "interpreter's inferred per-subtree states "
+                         "(schema/residency/partitioning/rows) before "
+                         "the diagnostics")
     li.add_argument("--baseline", default=None,
                     help="repo-lint baseline file "
                          "(default: devtools/lint_baseline.txt)")
@@ -103,7 +115,7 @@ def main(argv=None):
                          f"{args.output}\n")
     else:
         if args.plan:
-            return _run_plan_lint(args.plan)
+            return _run_plan_lint(args.plan, infer=args.infer)
         # --repo is the default lint mode
         return _run_repo_lint(args.baseline or _default_baseline(),
                               args.update_baseline)

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dis
 import math
+import sys
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .. import types as t
@@ -181,6 +182,24 @@ def _call_method(obj: Expression, name: str, args: List[Expression]) -> Expressi
     raise UdfCompileError(f"unsupported string method {name!r}")
 
 
+# py3.10 has per-operator binary opcodes; 3.11+ folds them into BINARY_OP
+# with an argrepr symbol — map the legacy names onto the same symbols so
+# one _binary() serves every interpreter version.
+_LEGACY_BINARY = {
+    "BINARY_ADD": "+", "INPLACE_ADD": "+",
+    "BINARY_SUBTRACT": "-", "INPLACE_SUBTRACT": "-",
+    "BINARY_MULTIPLY": "*", "INPLACE_MULTIPLY": "*",
+    "BINARY_TRUE_DIVIDE": "/", "INPLACE_TRUE_DIVIDE": "/",
+    "BINARY_FLOOR_DIVIDE": "//", "INPLACE_FLOOR_DIVIDE": "//",
+    "BINARY_MODULO": "%", "INPLACE_MODULO": "%",
+    "BINARY_POWER": "**", "INPLACE_POWER": "**",
+}
+
+# 3.11+ LOAD_GLOBAL carries a "push NULL first" flag in the low arg bit;
+# on 3.10 the arg is just a name index and must not be misread as a flag
+_LOAD_GLOBAL_PUSHES_NULL = sys.version_info >= (3, 11)
+
+
 # -- stack markers -----------------------------------------------------------
 
 class _Null:
@@ -272,9 +291,15 @@ class _Interp:
                     val = getattr(builtins, name)
                 else:
                     val = self.globals[name]
-                if ins.arg & 1:  # 3.12: NULL is pushed below the callable
+                if _LOAD_GLOBAL_PUSHES_NULL and ins.arg & 1:
+                    # 3.11+: NULL is pushed below the callable
                     stack.append(_Null())
-                stack.append(_Global(val))
+                if val is None or isinstance(val, (bool, int, float, str)):
+                    # plain global constant: fold to a literal so
+                    # `lambda x: x + SOME_CONST` compiles like a closure
+                    stack.append(_const(val))
+                else:
+                    stack.append(_Global(val))
                 idx += 1
             elif op == "PUSH_NULL":
                 stack.append(_Null())
@@ -325,6 +350,42 @@ class _Interp:
                 else:
                     raise UdfCompileError(f"calling {deeper!r}/{upper!r}")
                 idx += 1
+            elif op == "CALL_FUNCTION":
+                # py3.10 plain call: [callable, args...] with no NULL
+                argc = ins.arg
+                args = stack[len(stack) - argc:]
+                del stack[len(stack) - argc:]
+                target = stack.pop()
+                if not all(isinstance(a, Expression) for a in args):
+                    raise UdfCompileError("non-expression call args")
+                if not isinstance(target, _Global):
+                    raise UdfCompileError(f"calling {target!r}")
+                stack.append(_call_function(target.value, args))
+                idx += 1
+            elif op == "CALL_METHOD":
+                # py3.10 method call: [pair..., args...] where pair is what
+                # LOAD_METHOD pushed — (NULL, fn) or (_Method, self)
+                argc = ins.arg
+                args = stack[len(stack) - argc:]
+                del stack[len(stack) - argc:]
+                upper = stack.pop()
+                deeper = stack.pop()
+                if not all(isinstance(a, Expression) for a in args):
+                    raise UdfCompileError("non-expression call args")
+                if isinstance(deeper, _Method):
+                    stack.append(_call_method(deeper.obj, deeper.name, args))
+                elif isinstance(deeper, _Null) and isinstance(upper, _Global):
+                    stack.append(_call_function(upper.value, args))
+                else:
+                    raise UdfCompileError(f"calling {deeper!r}/{upper!r}")
+                idx += 1
+            elif op in _LEGACY_BINARY:
+                rhs, lhs = stack.pop(), stack.pop()
+                if not (isinstance(lhs, Expression)
+                        and isinstance(rhs, Expression)):
+                    raise UdfCompileError("binary op on non-expressions")
+                stack.append(_binary(_LEGACY_BINARY[op], lhs, rhs))
+                idx += 1
             elif op == "BINARY_OP":
                 rhs, lhs = stack.pop(), stack.pop()
                 if not (isinstance(lhs, Expression)
@@ -371,16 +432,39 @@ class _Interp:
             elif op == "COPY":
                 stack.append(stack[-ins.arg])
                 idx += 1
+            elif op == "DUP_TOP":
+                stack.append(stack[-1])
+                idx += 1
             elif op == "SWAP":
                 stack[-1], stack[-ins.arg] = stack[-ins.arg], stack[-1]
+                idx += 1
+            elif op == "ROT_TWO":
+                stack[-1], stack[-2] = stack[-2], stack[-1]
                 idx += 1
             elif op == "POP_TOP":
                 stack.pop()
                 idx += 1
-            elif op in ("JUMP_FORWARD",):
-                idx = self.by_offset[ins.argval]
+            elif op in ("JUMP_FORWARD", "JUMP_ABSOLUTE"):
+                target = self.by_offset[ins.argval]
+                if target <= idx:  # 3.10 spells loop back-edges this way
+                    raise UdfCompileError("loops are not compilable")
+                idx = target
             elif op == "JUMP_BACKWARD":
                 raise UdfCompileError("loops are not compilable")
+            elif op in ("JUMP_IF_FALSE_OR_POP", "JUMP_IF_TRUE_OR_POP"):
+                # short-circuit and/or: on jump the operand VALUE stays on
+                # the stack; on fallthrough it is popped.  Fork both arms
+                # and select with If on the operand's truthiness.
+                operand = stack.pop()
+                if not isinstance(operand, Expression):
+                    raise UdfCompileError("branching on non-expression")
+                pred = _as_predicate(operand)
+                fall_e = self.run(idx + 1, stack, local_vars)
+                jump_e = self.run(self.by_offset[ins.argval],
+                                  stack + [operand], local_vars)
+                if op == "JUMP_IF_FALSE_OR_POP":
+                    return cond.If(pred, fall_e, jump_e)
+                return cond.If(pred, jump_e, fall_e)
             elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE",
                         "POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE"):
                 pred = stack.pop()

@@ -113,10 +113,13 @@ def plan_L005_compile_churn():
 
 def plan_L006_partition_contract():
     """A join marked colocated with no establishing exchange under
-    either side: matching keys are NOT co-located, so per-partition
-    results are silently wrong (the bridge full-outer class)."""
-    left = _scan(_ints(name="k"))
-    right = _scan(_ints(name="k"))
+    either side of its MULTI-partition inputs: matching keys are NOT
+    co-located, so per-partition results are silently wrong (the bridge
+    full-outer class).  The scans are 2-partition on purpose — the
+    flow-sensitive checker correctly admits the single-partition
+    variant (everything co-located trivially)."""
+    left = _scan(_ints(name="k"), num_partitions=2)
+    right = _scan(_ints(name="k"), num_partitions=2)
     join = HashJoinExec([AttributeReference("k")],
                         [AttributeReference("k")], "inner", None,
                         left, right, colocated=True)
@@ -147,3 +150,89 @@ def plan_L008_udf_boundary():
                     [AttributeReference("v")], name="plus1")
     node = ArrowEvalPythonExec([("u", udf)], scan)
     return node, {}
+
+
+# ---------------------------------------------------------------------------
+# flow-sensitive fixtures (TPU-L009..L012, analysis/interp.py)
+# ---------------------------------------------------------------------------
+
+def plan_L009_stale_bind_after_rewrite():
+    """A projection bound against one schema whose child a rewrite then
+    swapped for a different one (the with_new_children/AQE surgery
+    class): the stale BoundReference reads ordinal 0 as long where the
+    new child produces a double named differently.  Only the
+    flow-sensitive checker sees it — node-local rules have no notion of
+    'the schema the child actually produces'."""
+    old_child = _scan(_ints(), placement=eb.CPU)
+    proj = ProjectExec([AttributeReference("v")], old_child)
+    proj.placement = eb.CPU
+    new_child = _scan(pa.table({"w": pa.array([1.5, 2.5],
+                                              type=pa.float64())}),
+                      placement=eb.CPU)
+    return proj.with_new_children([new_child]), {}
+
+
+def plan_L010_dead_exchange_columns():
+    """An exchange ships a wide payload column that nothing above the
+    exchange ever reads — every byte still rides the wire.  Requires
+    liveness THROUGH the plan: the column is dead because of a
+    projection two levels up."""
+    tb = pa.table({
+        "k": pa.array(range(64), type=pa.int64()),
+        "v": pa.array(range(64), type=pa.int64()),
+        "payload": pa.array(["x" * 64] * 64, type=pa.string()),
+    })
+    scan = _scan(tb, num_partitions=2)
+    ex = ShuffleExchangeExec(
+        HashPartitioning([AttributeReference("k")], 4), scan)
+    ex.placement = eb.TPU
+    proj = ProjectExec([AttributeReference("k"),
+                        AttributeReference("v")], ex)
+    proj.placement = eb.TPU
+    return proj, {}
+
+
+def plan_L011_contract_broken_by_rewrite():
+    """A colocated join whose establishing exchanges a rewrite re-keyed:
+    both sides ARE exchanges (so the syntactic L006 shape check
+    passes), but they hash-route on column `a`, not the join key `k` —
+    matching keys land in different partitions.  Only the inferred
+    distribution catches it."""
+    lt = pa.table({"k": pa.array(range(8), type=pa.int64()),
+                   "a": pa.array(range(8), type=pa.int64())})
+    rt = pa.table({"k": pa.array(range(8), type=pa.int64()),
+                   "a": pa.array(range(8), type=pa.int64())})
+    lex = ShuffleExchangeExec(
+        HashPartitioning([AttributeReference("a")], 4),
+        _scan(lt, num_partitions=2))
+    lex.placement = eb.TPU
+    rex = ShuffleExchangeExec(
+        HashPartitioning([AttributeReference("a")], 4),
+        _scan(rt, num_partitions=2))
+    rex.placement = eb.TPU
+    join = HashJoinExec([AttributeReference("k")],
+                        [AttributeReference("k")], "inner", None,
+                        lex, rex, colocated=True)
+    join.placement = eb.TPU
+    return join, {}
+
+
+def plan_L012_residency_ping_pong():
+    """Two separate host islands inside one device pipeline: batches
+    already resident on device cross down to host and back up TWICE
+    along the same path.  The path-level rule totals the transfer
+    bytes; the node-local L002 only ever sees one sandwich at a time."""
+    scan = _scan(_ints(n=4096))
+    d1 = ProjectExec([AttributeReference("v")], scan)
+    d1.placement = eb.TPU
+    h1 = FilterExec(GreaterThan(AttributeReference("v"),
+                                Literal(1, t.LONG)), d1)
+    h1.placement = eb.CPU
+    d2 = ProjectExec([AttributeReference("v")], h1)
+    d2.placement = eb.TPU
+    h2 = FilterExec(GreaterThan(AttributeReference("v"),
+                                Literal(2, t.LONG)), d2)
+    h2.placement = eb.CPU
+    d3 = ProjectExec([AttributeReference("v")], h2)
+    d3.placement = eb.TPU
+    return d3, {}

@@ -1,0 +1,181 @@
+"""Golden GOOD-plan corpus for the flow-sensitive plan typechecker.
+
+Each ``plan_*()`` builder returns ``(exec_root, conf_map)`` — a clean,
+runnable physical plan of the shapes the overrides engine actually
+emits.  Consumed three ways:
+
+  * tests/test_interp_oracle.py runs the differential oracle over every
+    builder: the abstract interpreter's predicted schema / residency /
+    partitioning / ordering must match real numpy-backend execution on
+    EVERY subtree (the analyzer is statically checked against the
+    engine, the verify_gates() discipline);
+  * the same test asserts the flow-sensitive lint raises no errors here
+    (zero false rejects), the complement of bad_plans.py's zero false
+    admits;
+  * ``devtools/run_lint.py --interp`` gates both in CI.
+
+Keep the plans executable and hazard-free: a builder that trips a rule
+belongs in bad_plans.py instead.
+"""
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.exec import base as eb
+from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+from spark_rapids_tpu.exec.basic import (CoalesceBatchesExec, FilterExec,
+                                         LocalLimitExec, LocalScanExec,
+                                         ProjectExec, SampleExec,
+                                         UnionExec)
+from spark_rapids_tpu.exec.broadcast import BroadcastExchangeExec
+from spark_rapids_tpu.exec.gatherpart import GatherPartitionsExec
+from spark_rapids_tpu.exec.join import HashJoinExec
+from spark_rapids_tpu.exec.sort import SortExec
+from spark_rapids_tpu.expr.aggregates import (AggregateExpression, FINAL,
+                                              PARTIAL, Sum)
+from spark_rapids_tpu.expr.arithmetic import Add
+from spark_rapids_tpu.expr.core import (Alias, AttributeReference,
+                                        Literal)
+from spark_rapids_tpu.expr.predicates import GreaterThan
+from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+
+
+def _scan(table, placement=eb.TPU, **kw):
+    s = LocalScanExec(table, **kw)
+    s.placement = placement
+    return s
+
+
+def _kv(n=32, k_mod=5, names=("k", "v")):
+    return pa.table({
+        names[0]: pa.array([i % k_mod for i in range(n)],
+                           type=pa.int64()),
+        names[1]: pa.array(range(n), type=pa.int64()),
+    })
+
+
+def plan_project_filter_device():
+    """scan -> filter -> project, all device-resident."""
+    scan = _scan(_kv())
+    f = FilterExec(GreaterThan(AttributeReference("v"),
+                               Literal(3, t.LONG)), scan)
+    f.placement = eb.TPU
+    p = ProjectExec([AttributeReference("k"),
+                     Alias(Add(AttributeReference("v"),
+                               Literal(1, t.LONG)), "v1")], f)
+    p.placement = eb.TPU
+    return p, {}
+
+
+def plan_host_pipeline():
+    """The same pipeline entirely on the host engine (numpy batches)."""
+    scan = _scan(_kv(), placement=eb.CPU)
+    f = FilterExec(GreaterThan(AttributeReference("v"),
+                               Literal(3, t.LONG)), scan)
+    f.placement = eb.CPU
+    p = ProjectExec([AttributeReference("v")], f)
+    p.placement = eb.CPU
+    return p, {}
+
+
+def plan_accelerated_island():
+    """Host scan -> device compute -> host root: the NORMAL accelerated
+    shape (one device region inside a host pipeline) that the residency
+    rules must never flag."""
+    scan = _scan(_kv(), placement=eb.CPU)
+    up = eb.HostToDeviceExec(scan)
+    p = ProjectExec([AttributeReference("k"),
+                     AttributeReference("v")], up)
+    p.placement = eb.TPU
+    down = eb.DeviceToHostExec(p)
+    return down, {}
+
+
+def plan_partial_final_aggregate():
+    """The canonical grouped-aggregate pipeline: partial below a hash
+    exchange on the group key, FINAL above it (the contract the
+    ClusteredContract declaration encodes)."""
+    scan = _scan(_kv(n=64), num_partitions=2)
+    grouping = [AttributeReference("k")]
+    aggs = [AggregateExpression(Sum(AttributeReference("v")))]
+    partial = TpuHashAggregateExec(grouping, aggs, PARTIAL, scan)
+    ex = ShuffleExchangeExec(
+        HashPartitioning([AttributeReference(partial.output_names[0])], 4),
+        partial)
+    ex.placement = eb.TPU
+    final = TpuHashAggregateExec(grouping, partial.aggregates, FINAL, ex)
+    return final, {}
+
+
+def plan_colocated_join_with_exchanges():
+    """Shuffled hash join: both sides exchanged on the join keys with
+    the same partition count — the contract the CoClusteredContract
+    declaration encodes."""
+    lt = _kv(n=32, names=("k", "v"))
+    rt = _kv(n=24, names=("k2", "w"))
+    lex = ShuffleExchangeExec(
+        HashPartitioning([AttributeReference("k")], 4),
+        _scan(lt, num_partitions=2))
+    lex.placement = eb.TPU
+    rex = ShuffleExchangeExec(
+        HashPartitioning([AttributeReference("k2")], 4),
+        _scan(rt, num_partitions=2))
+    rex.placement = eb.TPU
+    join = HashJoinExec([AttributeReference("k")],
+                        [AttributeReference("k2")], "inner", None,
+                        lex, rex, colocated=True)
+    join.placement = eb.TPU
+    return join, {}
+
+
+def plan_broadcast_join():
+    """Broadcast hash join: replicated build side satisfies the
+    co-location requirement for any probe distribution."""
+    probe = _scan(_kv(n=32), num_partitions=2)
+    bex = BroadcastExchangeExec(_scan(_kv(n=8, names=("k2", "w"))))
+    bex.placement = eb.TPU
+    join = HashJoinExec([AttributeReference("k")],
+                        [AttributeReference("k2")], "inner", None,
+                        probe, bex)
+    join.placement = eb.TPU
+    return join, {}
+
+
+def plan_global_sort():
+    """Gather to one partition then sort: the single-chip global-sort
+    shape; the predicted ordering contract is oracle-verified."""
+    scan = _scan(_kv(n=48), num_partitions=3)
+    g = GatherPartitionsExec(scan)
+    g.placement = eb.TPU
+    c = CoalesceBatchesExec(g)
+    c.placement = eb.TPU
+    s = SortExec([(AttributeReference("v"), False, True)], c,
+                 is_global=True)
+    s.placement = eb.TPU
+    return s, {}
+
+
+def plan_union_limit_sample():
+    """Union of two scans, sampled and limited — forwarding operators
+    whose states pass through."""
+    u = UnionExec([_scan(_kv(n=16)), _scan(_kv(n=16))])
+    u.placement = eb.TPU
+    sm = SampleExec(0.5, 42, u)
+    sm.placement = eb.TPU
+    lim = LocalLimitExec(5, sm)
+    lim.placement = eb.TPU
+    return lim, {}
+
+
+def plan_exchange_fully_read():
+    """An exchange whose every column IS read above (no dead columns):
+    the L010 non-example."""
+    scan = _scan(_kv(n=32), num_partitions=2)
+    ex = ShuffleExchangeExec(
+        HashPartitioning([AttributeReference("k")], 4), scan)
+    ex.placement = eb.TPU
+    p = ProjectExec([Alias(Add(AttributeReference("k"),
+                               AttributeReference("v")), "s")], ex)
+    p.placement = eb.TPU
+    return p, {}

@@ -1,0 +1,238 @@
+"""Differential oracle for the flow-sensitive plan typechecker: the
+abstract interpreter's predictions (schema, residency, partitioning,
+ordering) are checked against REAL numpy-backend execution on every
+subtree of the golden corpus — the analyzer is itself statically checked
+against the engine, the discipline capabilities.verify_gates()
+established for dtype gates.
+
+  * good_plans.py: zero false rejects (no error diagnostics) AND every
+    prediction matches execution;
+  * bad_plans.py: zero false admits (each fixture's expected codes fire
+    in flow-sensitive mode);
+  * plus drift-detection sanity: a deliberately wrong prediction IS
+    caught, so a green oracle is evidence, not vacuity.
+"""
+
+import importlib.util
+import json
+import os
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.analysis import absdomain
+from spark_rapids_tpu.analysis.interp import format_states, infer_plan
+from spark_rapids_tpu.analysis.oracle import _compare, _observe, verify_plan
+from spark_rapids_tpu.analysis.plan_lint import lint_plan
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.exec import base as eb
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "goldens", "lint")
+
+
+def _load(fname):
+    spec = importlib.util.spec_from_file_location(
+        fname.replace(".py", ""), os.path.join(GOLDEN_DIR, fname))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return {k: getattr(mod, k) for k in dir(mod) if k.startswith("plan_")}
+
+
+GOOD = sorted(_load("good_plans.py"))
+with open(os.path.join(GOLDEN_DIR, "expected_codes.json")) as f:
+    BAD_EXPECTED = json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# predictions match execution on every subtree (zero drift)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", GOOD)
+def test_oracle_predictions_match_execution(name):
+    root, conf_map = _load("good_plans.py")[name]()
+    conf = RapidsConf(conf_map)
+    mismatches = verify_plan(root, conf)
+    assert not mismatches, "\n".join(
+        [format_states(root, infer_plan(root, conf))] + mismatches)
+
+
+@pytest.mark.parametrize("name", GOOD)
+def test_good_corpus_has_zero_false_rejects(name):
+    root, conf_map = _load("good_plans.py")[name]()
+    diags = lint_plan(root, RapidsConf(conf_map), infer=True)
+    errors = [d for d in diags if d.is_error]
+    assert not errors, [d.render() for d in errors]
+
+
+@pytest.mark.parametrize("name", sorted(BAD_EXPECTED))
+def test_bad_corpus_has_zero_false_admits(name):
+    """Flow-sensitive mode must still flag every golden hazard."""
+    root, conf_map = _load("bad_plans.py")[name]()
+    got = {d.code for d in lint_plan(root, RapidsConf(conf_map),
+                                     infer=True)}
+    assert set(BAD_EXPECTED[name]) <= got, (name, got)
+
+
+# ---------------------------------------------------------------------------
+# the oracle is not vacuous: wrong predictions ARE caught
+# ---------------------------------------------------------------------------
+
+def _observe_root(root, conf):
+    ctx = eb.ExecContext(conf)
+    ctx.task_context["no_speculation"] = True
+    return _observe(root, ctx)
+
+
+def test_oracle_catches_wrong_schema_prediction():
+    root, conf_map = _load("good_plans.py")["plan_project_filter_device"]()
+    conf = RapidsConf(conf_map)
+    st = infer_plan(root, conf).state(root)
+    obs = _observe_root(root, conf)
+    assert not _compare(st, obs)
+    wrong = st.replace(dtypes=[t.DOUBLE] * len(st.dtypes))
+    assert any("dtypes" in m for m in _compare(wrong, obs))
+    renamed = st.replace(names=["x" for _ in st.names])
+    assert any("columns" in m for m in _compare(renamed, obs))
+
+
+def test_oracle_catches_wrong_residency_prediction():
+    root, conf_map = _load("good_plans.py")["plan_host_pipeline"]()
+    conf = RapidsConf(conf_map)
+    st = infer_plan(root, conf).state(root)
+    obs = _observe_root(root, conf)
+    assert st.residency == absdomain.HOST and not _compare(st, obs)
+    wrong = st.replace(residency=absdomain.DEVICE)
+    assert any("residency" in m for m in _compare(wrong, obs))
+
+
+def test_oracle_catches_wrong_clustering_prediction():
+    """Claiming hash clustering on a column the exchange does NOT route
+    by must be refuted by the observed partition contents."""
+    from spark_rapids_tpu.exec.basic import LocalScanExec
+    from spark_rapids_tpu.expr.core import AttributeReference
+    from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+    tb = pa.table({
+        "k": pa.array([i % 5 for i in range(40)], type=pa.int64()),
+        # v repeats 0/1: its values straddle every k-routed partition
+        "v": pa.array([i % 2 for i in range(40)], type=pa.int64()),
+    })
+    scan = LocalScanExec(tb, num_partitions=2)
+    scan.placement = eb.TPU
+    ex = ShuffleExchangeExec(
+        HashPartitioning([AttributeReference("k")], 4), scan)
+    ex.placement = eb.TPU
+    conf = RapidsConf({})
+    st = infer_plan(ex, conf).state(ex)
+    obs = _observe_root(ex, conf)
+    assert not _compare(st, obs)  # the true claim (clustered on k) holds
+    wrong = st.replace(dist=absdomain.HashDist(["v"], 4))
+    assert any("clustering" in m for m in _compare(wrong, obs))
+
+
+def test_oracle_catches_wrong_ordering_prediction():
+    root, conf_map = _load("good_plans.py")["plan_global_sort"]()
+    conf = RapidsConf(conf_map)
+    st = infer_plan(root, conf).state(root)
+    obs = _observe_root(root, conf)
+    assert st.ordering and not _compare(st, obs)
+    flipped = st.replace(ordering=((st.ordering[0][0],
+                                    not st.ordering[0][1]),))
+    assert any("ordering" in m for m in _compare(flipped, obs))
+
+
+# ---------------------------------------------------------------------------
+# interface-requirement declarations (verify_gates()-style drift checks)
+# ---------------------------------------------------------------------------
+
+def test_contract_declarations_exist_where_runtime_assumes_colocation():
+    """The operators whose kernels ASSUME a partitioning contract must
+    declare it via Exec.input_contracts — the declaration is what the
+    interpreter enforces and the oracle keeps honest."""
+    good = _load("good_plans.py")
+    join, _ = good["plan_colocated_join_with_exchanges"]()
+    assert isinstance(join.input_contracts(),
+                      absdomain.CoClusteredContract)
+    final, _ = good["plan_partial_final_aggregate"]()
+    assert isinstance(final.input_contracts(),
+                      absdomain.ClusteredContract)
+    # non-colocated joins and PARTIAL aggregates assume nothing
+    bj, _ = good["plan_broadcast_join"]()
+    assert bj.input_contracts() is None
+    assert final.children[0].children[0].input_contracts() is None
+
+
+def test_declared_contracts_accept_what_execution_coLocates():
+    """Satisfied declarations on the good corpus, violated ones on the
+    bad corpus — the two directions of the admission drift check."""
+    good = _load("good_plans.py")
+    for name in ("plan_colocated_join_with_exchanges",
+                 "plan_partial_final_aggregate"):
+        root, conf_map = good[name]()
+        res = infer_plan(root, RapidsConf(conf_map))
+        assert not [d for d in res.diags
+                    if d.code in ("TPU-L006", "TPU-L011")], name
+    bad = _load("bad_plans.py")
+    root, conf_map = bad["plan_L011_contract_broken_by_rewrite"]()
+    res = infer_plan(root, RapidsConf(conf_map))
+    assert [d for d in res.diags if d.code == "TPU-L011"]
+
+
+def test_downgrade_repairs_flow_contract_violation():
+    """TPU-L011 is downgradeable: the host flip clears the co-location
+    assumption and re-lints clean."""
+    from spark_rapids_tpu.analysis.plan_lint import downgrade_hazards
+    bad = _load("bad_plans.py")
+    root, conf_map = bad["plan_L011_contract_broken_by_rewrite"]()
+    conf = RapidsConf(conf_map)
+    fixed = downgrade_hazards(root, lint_plan(root, conf))
+    assert fixed.placement == eb.CPU and not fixed.colocated
+    assert not [d for d in lint_plan(fixed, conf) if d.is_error]
+
+
+# ---------------------------------------------------------------------------
+# property-style: inferred schema == executed schema through the session
+# ---------------------------------------------------------------------------
+
+def _session():
+    from spark_rapids_tpu.api.session import TpuSession
+    return (TpuSession.builder()
+            .config("spark.rapids.sql.enabled", True)
+            .config("spark.rapids.sql.explain", "NONE")
+            .get_or_create())
+
+
+def test_inferred_schema_equals_executed_schema_via_session():
+    """For real converted plans (the overrides engine's own output!),
+    the interpreter's root schema equals the schema of the collected
+    arrow table, column for column."""
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    s = _session()
+    tb = pa.table({
+        "k": pa.array([i % 3 for i in range(30)], type=pa.int64()),
+        "v": pa.array(range(30), type=pa.int64()),
+        "x": pa.array([float(i) / 2 for i in range(30)],
+                      type=pa.float64()),
+    })
+    queries = [
+        lambda df: df.filter(df["v"] > 4).select(col("k"), col("x")),
+        lambda df: df.group_by(col("k")).agg(
+            F.sum(col("v")).alias("sv")),
+        lambda df: df.select((col("v") + col("k")).alias("s")),
+    ]
+    for q in queries:
+        df = s.create_dataframe(tb, num_partitions=2)
+        out = q(df).collect()
+        plan = s.last_plan
+        st = infer_plan(plan, s.conf).state(plan)
+        assert st is not None
+        assert list(st.names) == out.schema.names
+        from spark_rapids_tpu.columnar.interop import to_arrow_schema
+        predicted = to_arrow_schema(st.names, st.dtypes)
+        assert [f.type for f in predicted] == \
+            [f.type for f in out.schema], (predicted, out.schema)
+        # and every subtree of the converted plan matches execution
+        assert verify_plan(plan, s.conf) == []

@@ -41,6 +41,20 @@ def test_run_lint_cli_exits_zero():
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
+def test_run_lint_interp_gate_exits_zero():
+    """Tier-1 gate for the flow-sensitive plan typechecker: zero false
+    rejects + differential-oracle agreement on the good corpus, zero
+    false admits on the bad corpus.  Any interpreter regression fails
+    fast here."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "devtools", "run_lint.py"),
+         "--interp"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "gate clean" in proc.stdout, proc.stdout
+
+
 def test_lint_cli_plan_mode_flags_goldens():
     proc = subprocess.run(
         [sys.executable, "-m", "spark_rapids_tpu.tools", "lint",

@@ -79,8 +79,11 @@ def test_clean_plan_produces_no_diagnostics():
 def test_suppression_drops_codes():
     fx = _fixtures()
     root, conf_map = fx["plan_L002_ping_pong"]()
+    # the host-island fixture trips both the node rule (L002) and the
+    # flow-sensitive path rule (L012); suppressing both silences it
     conf_map = dict(conf_map,
-                    **{"spark.rapids.tpu.lint.disable": "TPU-L002"})
+                    **{"spark.rapids.tpu.lint.disable":
+                       "TPU-L002,TPU-L012"})
     assert lint_plan(root, RapidsConf(conf_map)) == []
 
 
