@@ -52,22 +52,44 @@ object TpuBridgeColumnarRule extends org.apache.spark.sql.execution.ColumnarRule
 
 /**
  * Replace the largest supported plan prefix with a TpuBridgeExec.  The
- * match walks top-down: at each node, collect the chain of spec-capable
- * operators (project/filter/aggregate/sort/limit/window/join) whose
- * expressions all translate; the first untranslatable node becomes the
- * bridge exec's child and executes on the CPU as usual.
+ * match collects the chain of spec-capable operators (project/filter/
+ * aggregate/sort/limit/window/join) whose expressions all translate;
+ * the first untranslatable node becomes the bridge exec's child and
+ * executes on the CPU as usual.
+ *
+ * Placement is restricted to positions where no ancestor can depend on
+ * the replaced subtree's outputPartitioning/outputOrdering: the PLAN
+ * ROOT, or DIRECTLY BELOW AN EXCHANGE.  This rule runs as a columnar
+ * rule, i.e. AFTER EnsureRequirements has satisfied every operator's
+ * required distribution and ordering — a TpuBridgeExec reports unknown
+ * partitioning and no ordering, and SpecBuilder elides the exchanges
+ * and sorts under a bridged SMJ/SHJ, so bridging mid-plan would feed
+ * parents silently unpartitioned, unsorted input (no re-planning pass
+ * runs afterwards to notice).  Below an exchange both properties are
+ * re-established/destroyed anyway, so the replacement is invisible.
  */
 object TpuBridgeRule extends Rule[SparkPlan] {
   override def apply(plan: SparkPlan): SparkPlan = {
     if (!plan.conf.getConfString("spark.tpu.bridge.enabled", "false").toBoolean) {
       return plan
     }
-    plan.transformDown {
-      case p if SpecBuilder.supportedChain(p) =>
-        val (ops, child, extraInputs) = SpecBuilder.build(p)
-        TpuBridgeExec(p.output, ops, child, extraInputs)
-    }
+    rewrite(plan, atSafeBoundary = true)
   }
+
+  private[tpu] def rewrite(p: SparkPlan, atSafeBoundary: Boolean): SparkPlan =
+    p match {
+      case p if atSafeBoundary && SpecBuilder.supportedChain(p) =>
+        val (ops, child, extraInputs) = SpecBuilder.build(p)
+        // keep rewriting below the bridged stage's input (an exchange
+        // there re-enables the boundary for its own subtree)
+        TpuBridgeExec(p.output, ops,
+          rewrite(child, atSafeBoundary = false), extraInputs)
+      case e: org.apache.spark.sql.execution.exchange.Exchange =>
+        e.withNewChildren(e.children.map(rewrite(_, atSafeBoundary = true)))
+      case other =>
+        other.withNewChildren(
+          other.children.map(rewrite(_, atSafeBoundary = false)))
+    }
 }
 
 /** Catalyst -> JSON spec translation (mirrors bridge/spec.py). */
@@ -406,12 +428,33 @@ object SpecBuilder {
     case other => other
   }
 
+  /**
+   * Driver-collect robustness gate: a shuffled/sort-merge join's build
+   * side is `executeCollect()`-ed whole to the driver by TpuBridgeExec
+   * — but Spark chose a NON-broadcast join precisely because that side
+   * exceeded the broadcast threshold, so an unbounded collect can OOM
+   * the driver.  Translate only when the build side's optimizer size
+   * estimate is known AND under the cap (unknown = conservatively
+   * reject; broadcast joins already passed Spark's own threshold and
+   * skip this gate).
+   */
+  private def buildSideFits(build: SparkPlan): Boolean = {
+    val cap = try {
+      org.apache.spark.sql.internal.SQLConf.get.getConfString(
+        "spark.tpu.bridge.maxBuildSideBytes", "268435456").toLong
+    } catch { case _: Exception => 268435456L }
+    try {
+      build.logicalLink.exists(_.stats.sizeInBytes <= cap)
+    } catch { case _: Exception => false }
+  }
+
   private def translateJoin(
       joinType: JoinType, leftKeys: Seq[Expression],
       rightKeys: Seq[Expression], condition: Option[Expression],
       left: SparkPlan, right: SparkPlan,
       extra: ArrayBuffer[SparkPlan],
-      walk: SparkPlan => Option[(List[String], SparkPlan)])
+      walk: SparkPlan => Option[(List[String], SparkPlan)],
+      gateBuildSize: Boolean)
       : Option[(List[String], SparkPlan)] = {
     val how = joinHow(joinType).getOrElse(return None)
     // residual conditions only on inner joins (engine post-filters)
@@ -420,7 +463,29 @@ object SpecBuilder {
       case "left_semi" | "left_anti" => Nil
       case _ => right.output.map(_.name)
     })
-    if (outNames.distinct.length != outNames.length) return None
+    // Duplicated output names: names are the engine's only addressing.
+    // The one recoverable case is an INNER equi join whose duplicates
+    // are exactly the identically-named join keys (the common
+    // `df.join(dim, on="k")` shape — Spark's USING join keeps BOTH key
+    // attributes at the join node): emit the engine's coalescing "on"
+    // form and restore the duplicated key columns with a projection.
+    // Sound for inner joins only — both sides' key values are equal on
+    // every surviving row; an outer join's null-extended side would be
+    // resurrected from the wrong side's values.
+    val dups = outNames.diff(outNames.distinct).toSet
+    val keyPairs = leftKeys.zip(rightKeys).flatMap {
+      case (l: AttributeReference, r: AttributeReference) => Some((l, r))
+      case _ => None
+    }
+    val restoreDupKeys = dups.nonEmpty
+    if (restoreDupKeys) {
+      val allSameNamed = keyPairs.length == leftKeys.length &&
+        keyPairs.forall { case (l, r) => l.name == r.name }
+      if (how != "inner" || !allSameNamed || condition.isDefined ||
+          !dups.subsetOf(keyPairs.map(_._1.name).toSet)) {
+        return None
+      }
+    }
     val keys = joinKeys(leftKeys, rightKeys, left, right)
       .getOrElse(return None)
     val onStyle = keys.startsWith("\"on\"")
@@ -438,11 +503,22 @@ object SpecBuilder {
       case None => keys
     }
     val buildPlan = stripExchange(right)
+    if (gateBuildSize && !buildSideFits(buildPlan)) return None
     extra += buildPlan
     val idx = extra.size
     walk(stripExchange(left)).map { case (ops, leaf) =>
-      (s"""{"op": "join", "right": $idx, "how": ${json(how)}, $keyField}""" :: ops,
-        leaf)
+      val joinOp =
+        s"""{"op": "join", "right": $idx, "how": ${json(how)}, $keyField}"""
+      val opsOut = if (restoreDupKeys) {
+        // the engine's "on" join outputs [keys, left rest, right rest];
+        // restore Spark's schema (left.output ++ right.output, key
+        // names duplicated) by projecting the coalesced key twice
+        val exprs = outNames.map(n =>
+          s"""{"expr": {"col": ${json(n)}}, "name": ${json(n)}}""")
+        s"""{"op": "project", "exprs": [${exprs.mkString(", ")}]}""" ::
+          joinOp :: ops
+      } else joinOp :: ops
+      (opsOut, leaf)
     }
   }
 
@@ -506,18 +582,19 @@ object SpecBuilder {
         }
       case j: BroadcastHashJoinExec
           if j.buildSide == org.apache.spark.sql.catalyst.optimizer.BuildRight =>
+        // Spark's own broadcast threshold already bounded this build side
         translateJoin(j.joinType, j.leftKeys, j.rightKeys, j.condition,
-          j.left, j.right, extra, walk)
+          j.left, j.right, extra, walk, gateBuildSize = false)
       case j: ShuffledHashJoinExec
           if j.buildSide == org.apache.spark.sql.catalyst.optimizer.BuildRight =>
         translateJoin(j.joinType, j.leftKeys, j.rightKeys, j.condition,
-          j.left, j.right, extra, walk)
+          j.left, j.right, extra, walk, gateBuildSize = true)
       case j: SortMergeJoinExec =>
         // the engine replaces sort-merge with hash joins (like the
         // reference's replaceSortMergeJoin); input sort order is not
         // required by the sidecar stage
         translateJoin(j.joinType, j.leftKeys, j.rightKeys, j.condition,
-          j.left, j.right, extra, walk)
+          j.left, j.right, extra, walk, gateBuildSize = true)
       case leaf => Some((Nil, leaf))
     }
 

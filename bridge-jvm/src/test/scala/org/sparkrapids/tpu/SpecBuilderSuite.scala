@@ -94,6 +94,101 @@ class SpecBuilderSuite extends AnyFunSuite {
     }
   }
 
+  test("same-name inner equi join restores duplicated key columns") {
+    val prev = spark.conf.get("spark.sql.autoBroadcastJoinThreshold")
+    spark.conf.set("spark.sql.autoBroadcastJoinThreshold", "-1")
+    try {
+      val fact = Seq((1L, 10L), (2L, 20L)).toDF("k", "x")
+      val dim = Seq((1L, 100L), (2L, 200L)).toDF("k", "w")
+      val df = fact.join(dim, fact("k") === dim("k"), "inner")
+      check("shuffled_join_same_keys", df)
+    } finally {
+      spark.conf.set("spark.sql.autoBroadcastJoinThreshold", prev)
+    }
+  }
+
+  test("same-name OUTER equi join stays untranslatable") {
+    // restoring the duplicated key from the coalesced "on" column is
+    // only exact when both sides' values agree on every row — an outer
+    // join's null-extended side would be resurrected from the wrong
+    // side's values, so the fallback must hold
+    val prev = spark.conf.get("spark.sql.autoBroadcastJoinThreshold")
+    spark.conf.set("spark.sql.autoBroadcastJoinThreshold", "-1")
+    try {
+      val fact = Seq((1L, 10L), (2L, 20L)).toDF("k", "x")
+      val dim = Seq((1L, 100L), (3L, 300L)).toDF("k", "w")
+      val df = fact.join(dim, fact("k") === dim("k"), "left")
+      val plan = df.queryExecution.executedPlan
+      val join = plan.collectFirst {
+        case j: org.apache.spark.sql.execution.joins.SortMergeJoinExec => j
+        case j: org.apache.spark.sql.execution.joins.ShuffledHashJoinExec => j
+      }
+      assert(join.isDefined, s"no shuffled join in:\n$plan")
+      assert(!SpecBuilder.supportedChain(join.get))
+    } finally {
+      spark.conf.set("spark.sql.autoBroadcastJoinThreshold", prev)
+    }
+  }
+
+  test("shuffled join build side above the size cap is rejected") {
+    // Spark chose a non-broadcast join because the build side exceeded
+    // the broadcast threshold; TpuBridgeExec executeCollect()s it to
+    // the driver, so translation is gated on the optimizer's size
+    // estimate against spark.tpu.bridge.maxBuildSideBytes
+    val prevBc = spark.conf.get("spark.sql.autoBroadcastJoinThreshold")
+    spark.conf.set("spark.sql.autoBroadcastJoinThreshold", "-1")
+    try {
+      val fact = Seq((1L, 10L), (2L, 20L)).toDF("id", "x")
+      val dim = Seq((1L, 100L), (2L, 200L)).toDF("user_id", "w")
+      val df = fact.join(dim, $"id" === $"user_id", "inner")
+      val join = df.queryExecution.executedPlan.collectFirst {
+        case j: org.apache.spark.sql.execution.joins.SortMergeJoinExec => j
+        case j: org.apache.spark.sql.execution.joins.ShuffledHashJoinExec => j
+      }.get
+      spark.conf.set("spark.tpu.bridge.maxBuildSideBytes", "1")
+      try {
+        assert(!SpecBuilder.supportedChain(join))
+      } finally {
+        spark.conf.unset("spark.tpu.bridge.maxBuildSideBytes")
+      }
+      assert(SpecBuilder.supportedChain(join)) // default cap admits it
+    } finally {
+      spark.conf.set("spark.sql.autoBroadcastJoinThreshold", prevBc)
+    }
+  }
+
+  test("bridge applies only at the root or directly below an exchange") {
+    import org.apache.spark.sql.tpubridge.TpuBridgeExec
+    spark.conf.set("spark.tpu.bridge.enabled", "true")
+    try {
+      // whole plan supported -> replaced at the root
+      val root = Seq((1L, 2L), (3L, -4L)).toDF("k", "v")
+        .filter($"v" > 0).select($"k", ($"v" * 2).as("v2"))
+      assert(TpuBridgeRule(root.queryExecution.executedPlan)
+        .isInstanceOf[TpuBridgeExec])
+      // an untranslatable parent with NO exchange in between: the
+      // supported chain below it must NOT bridge — TpuBridgeExec
+      // reports unknown partitioning/ordering and EnsureRequirements
+      // has already run, so a mid-plan replacement feeds ancestors
+      // unpartitioned, unsorted input
+      val mid = Seq((1L, 2L), (3L, -4L)).toDF("k", "v")
+        .filter($"v" > 0)
+        .select($"k", monotonically_increasing_id().as("id"))
+      val midPlan = TpuBridgeRule(mid.queryExecution.executedPlan)
+      assert(midPlan.collectFirst { case b: TpuBridgeExec => b }.isEmpty,
+        s"bridged mid-plan:\n$midPlan")
+      // ...but directly below an exchange the replacement is invisible
+      // (partitioning is re-established, ordering destroyed anyway)
+      val below = Seq((1L, 2L), (3L, -4L)).toDF("k", "v")
+        .filter($"v" > 0).repartition($"k")
+      val belowPlan = TpuBridgeRule(below.queryExecution.executedPlan)
+      assert(belowPlan.collectFirst { case b: TpuBridgeExec => b }.isDefined,
+        s"no bridge below the exchange:\n$belowPlan")
+    } finally {
+      spark.conf.set("spark.tpu.bridge.enabled", "false")
+    }
+  }
+
   test("string / datetime / cast tier") {
     val df = Seq(("ax", java.sql.Date.valueOf("2024-03-01"), 7L))
       .toDF("s", "d", "v")

@@ -19,9 +19,17 @@ nonzero when the analyzer regresses in either direction:
   * any differential-oracle mismatch between predicted and executed
     schema/residency/partitioning on the good corpus.
 
+--memsan runs the tmsan gate: the lifetime/peak pass over the golden
+corpus plus a full shadow-ledger replay — every good plan executes with
+the runtime sanitizer installed and must (a) keep its measured peak
+device bytes at or under the static TPU-L014 bound, (b) leave a clean
+ledger (no leaks, no lifecycle violations); the memory bad-plan
+fixtures (L013/L014/L015) must each trip their code.
+
     python devtools/run_lint.py                    # repo check
     python devtools/run_lint.py --update-baseline  # re-freeze debt
     python devtools/run_lint.py --interp           # plan typechecker gate
+    python devtools/run_lint.py --memsan           # lifetime + ledger gate
 """
 
 import json
@@ -86,10 +94,90 @@ def run_interp_gate() -> int:
     return 0
 
 
+def _release_plan(root):
+    """Mirror TpuSession.release_plan_shuffles for bare exec trees: drop
+    shuffle blocks and device exchange memos so the post-query ledger
+    check sees what a real session would."""
+    ids = []
+    root.foreach(lambda e: ids.append(e._shuffle_id)
+                 if getattr(e, "_shuffle_id", None) is not None else None)
+    if ids:
+        from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+        mgr = TpuShuffleManager.get()
+        for sid in ids:
+            mgr.unregister(sid)
+    root.foreach(lambda e: e.release_shuffle()
+                 if hasattr(e, "release_shuffle") else None)
+
+
+def run_memsan_gate() -> int:
+    from spark_rapids_tpu.analysis.lifetime import analyze_memory
+    from spark_rapids_tpu.analysis.plan_lint import lint_plan
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.exec import base as eb
+    from spark_rapids_tpu.memory import memsan
+    from spark_rapids_tpu.memory.spill import SpillCatalog
+
+    failures = 0
+    good = _builders(os.path.join(GOLDEN, "good_plans.py"))
+    for name in sorted(good):
+        root, conf_map = good[name]()
+        conf = RapidsConf(conf_map)
+        bound = analyze_memory(root, conf).bound(root)
+        with SpillCatalog._lock:
+            SpillCatalog._instance = SpillCatalog()
+        with memsan.installed() as ledger:
+            ctx = eb.ExecContext(conf)
+            ctx.task_context["no_speculation"] = True
+            try:
+                root.execute_collect(ctx)
+                _release_plan(root)
+            except memsan.LifecycleViolation as ex:
+                failures += 1
+                print(f"LEDGER VIOLATION {name}: {ex}")
+                continue
+            if bound is not None and ledger.peak_device_bytes > bound:
+                failures += 1
+                print(f"BOUND VIOLATION {name}: measured "
+                      f"{ledger.peak_device_bytes} device bytes > "
+                      f"static bound {int(bound)}")
+            try:
+                ledger.assert_clean()
+            except memsan.LifecycleViolation as ex:
+                failures += 1
+                print(f"DIRTY LEDGER {name}: {ex}")
+
+    # the memory hazard fixtures must each trip their diagnostic
+    bad = _builders(os.path.join(GOLDEN, "bad_plans.py"))
+    mem_fixtures = {
+        "plan_L013_shared_boundary_use_after_close": "TPU-L013",
+        "plan_L014_peak_over_hbm_budget": "TPU-L014",
+        "plan_L015_boundary_never_closes": "TPU-L015",
+    }
+    for name, code in sorted(mem_fixtures.items()):
+        root, conf_map = bad[name]()
+        got = {d.code for d in lint_plan(root, RapidsConf(conf_map),
+                                         infer=True)}
+        if code not in got:
+            failures += 1
+            print(f"FALSE ADMIT {name}: expected {code}, got "
+                  f"{sorted(got)}")
+
+    if failures:
+        print(f"memsan gate: {failures} failure(s)")
+        return 1
+    print(f"memsan gate clean ({len(good)} good plans ledger-replayed "
+          f"within their static bounds, {len(mem_fixtures)} memory "
+          f"hazards flagged)")
+    return 0
+
+
 def main(argv=None):
     args = argv if argv is not None else sys.argv[1:]
     if "--interp" in args:
         return run_interp_gate()
+    if "--memsan" in args:
+        return run_memsan_gate()
     from spark_rapids_tpu.tools.__main__ import main as tools_main
     cli = ["lint", "--repo", "--baseline", BASELINE]
     if "--update-baseline" in args:

@@ -1,0 +1,457 @@
+"""tmsan static side: buffer-lifetime + peak-HBM analysis over the Exec IR.
+
+The reference plugin's single biggest operational failure class is
+accelerator OOM and leaked/mis-tiered device buffers; RMM plus the
+Arm.scala RAII discipline manage it at runtime.  Our ``memory/spill.py``
+(SpillCatalog budgets, SpillableBatch lifecycle) and ``native/arena.py``
+reproduce that role with zero *static* coverage — the typechecker built
+in PRs 1-2 reasons about schema, residency and partitioning but is blind
+to allocation lifetime and peak HBM.  This module closes that gap with
+two artifacts sharing ONE source of truth:
+
+  * the **ownership lattice** — per-buffer lifecycle states
+    (allocated -> registered-spillable -> pinned -> spilled -> closed)
+    and the legal-transition relation ``LIFECYCLE``.  The static pass
+    checks declared operator protocols against it, and the runtime
+    shadow ledger (``memory/memsan.py``) asserts the SAME relation on
+    every real alloc/register/pin/spill/unspill/close event, so the
+    machine can never drift from the engine (the
+    ``capabilities.verify_gates()`` discipline applied to memory);
+
+  * the **peak-device-bytes bound** — a bottom-up pass deriving, for
+    every subtree, a conservative bound on simultaneously-live device
+    bytes from the SAME row model the cost-based optimizer and
+    L010/L012 already use (``plan/cost.estimate_rows`` via the
+    interpreter's AbstractStates), widened by the engine's real batch
+    padding (capacity buckets, validity lanes, span-buffer minimums).
+
+Operators DECLARE their memory behavior via ``Exec.memory_effects()``
+(the ``input_contracts()`` pattern): how many device bytes they hold
+while streaming, what they retain after (pinned scans, exchange memos),
+and whether they hand out catalog-registered *handles* whose close is
+deferred to a declared consumer count.  Three rules evaluate the
+declarations:
+
+  TPU-L013  use-after-close / use-while-spilled hazard along some
+            execution path: a handle-producing subtree is consumed by
+            MORE parents than its declared consumer count — the extra
+            consumer reads handles the last declared consumer already
+            closed (the stale-rewrite sharing class, L009's sibling).
+  TPU-L014  subtree peak-device-bytes bound exceeds the configured HBM
+            budget (spark.rapids.tpu.memsan.hbmBudgetBytes): the OOM is
+            predicted at plan time.  Repairable — the pre-flight either
+            forces the operator's out-of-core path
+            (``try_outofcore_repair``, exec/outofcore.py) or downgrades
+            the subtree to host like L006/L011.
+  TPU-L015  batch acquired but not closed/unregistered on every path: a
+            handle producer declares MORE consumers than the plan has
+            parents for it (close never fires), or declares it never
+            closes at all — a plan-level leak.
+
+``verify against the ledger``: devtools/run_lint.py --memsan replays the
+golden corpus with the shadow ledger installed and asserts measured peak
+device bytes <= the static bound and a clean ledger after every query;
+tests/test_memsan.py adds the anti-vacuity injections.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from .. import config as cfg
+from .absdomain import AbstractState, schema_width
+from .diagnostics import ERROR, Diagnostic, register_rule
+
+# ---------------------------------------------------------------------------
+# the ownership lattice (shared with memory/memsan.py's runtime ledger)
+# ---------------------------------------------------------------------------
+
+# states
+UNBORN = "unborn"
+ALLOCATED = "allocated"
+REGISTERED = "registered"          # catalog-registered, spillable
+PINNED = "pinned"                  # pin-cache resident, evictable
+SPILLED = "spilled"                # demoted to host/disk tier
+CLOSED = "closed"
+
+# events
+ALLOC = "alloc"
+REGISTER = "register"
+PIN = "pin"
+SPILL = "spill"
+UNSPILL = "unspill"
+MATERIALIZE = "materialize"        # get_batch: read access to the payload
+CLOSE = "close"
+EVICT = "evict"                    # pin-cache eviction under pressure
+
+# (state, event) -> next state.  A pair absent here is a lifecycle
+# violation: the runtime ledger raises on it, the static pass reports
+# the rule that predicts it (MATERIALIZE after CLOSE = TPU-L013's
+# runtime shape; a terminal state that never reaches CLOSE/EVICT =
+# TPU-L015's).
+LIFECYCLE: Dict[tuple, str] = {
+    (UNBORN, ALLOC): ALLOCATED,
+    (ALLOCATED, REGISTER): REGISTERED,
+    (ALLOCATED, PIN): PINNED,
+    (ALLOCATED, MATERIALIZE): ALLOCATED,
+    (ALLOCATED, CLOSE): CLOSED,
+    (REGISTERED, MATERIALIZE): REGISTERED,
+    (REGISTERED, SPILL): SPILLED,
+    (REGISTERED, PIN): PINNED,
+    (REGISTERED, CLOSE): CLOSED,
+    (PINNED, MATERIALIZE): PINNED,
+    (PINNED, EVICT): CLOSED,
+    (PINNED, CLOSE): CLOSED,
+    (SPILLED, SPILL): SPILLED,         # host tier -> disk tier
+    (SPILLED, MATERIALIZE): SPILLED,   # read via deserialize is legal
+    (SPILLED, UNSPILL): REGISTERED,
+    (SPILLED, CLOSE): CLOSED,
+}
+
+# states whose payload occupies device memory (the ledger's accounting
+# and the static bound agree on this set)
+DEVICE_RESIDENT = frozenset({ALLOCATED, REGISTERED, PINNED})
+
+
+def lifecycle_next(state: str, event: str) -> Optional[str]:
+    """Next state, or None when (state, event) is a violation."""
+    return LIFECYCLE.get((state, event))
+
+
+# ---------------------------------------------------------------------------
+# rule registrations
+# ---------------------------------------------------------------------------
+
+L013 = register_rule(
+    "TPU-L013", ERROR, "use-after-close along an execution path",
+    "A subtree that hands out catalog-registered batch handles is "
+    "consumed by more parents than its declared consumer count "
+    "(Exec.memory_effects): the last declared consumer closes the "
+    "handles, so every later consumer materializes closed buffers — "
+    "the shared-subtree flavor of the stale-rewrite class "
+    "(with_new_children/reuse surgery duplicated a consumer without "
+    "updating the producer's count).  The runtime shadow ledger "
+    "(spark.rapids.tpu.memsan.enabled) catches the same violation as "
+    "it happens; this rule predicts it at plan time.")
+
+L014 = register_rule(
+    "TPU-L014", ERROR, "subtree peak device bytes exceed the HBM budget",
+    "The conservative peak-device-bytes bound for this subtree — "
+    "derived from the same row model the cost-based optimizer uses, "
+    "widened by real batch padding — exceeds "
+    "spark.rapids.tpu.memsan.hbmBudgetBytes: the query would OOM "
+    "mid-flight.  The pre-flight repairs it by forcing the operator's "
+    "out-of-core path (a bounded spill budget) where one exists, or "
+    "downgrading the subtree to the host engine.")
+
+L015 = register_rule(
+    "TPU-L015", ERROR, "batch acquired but never closed on some path",
+    "A handle-producing operator declares a consumer count the plan "
+    "never reaches (or declares it never closes at all): its "
+    "registered device buffers survive the query — a plan-level leak "
+    "the SpillCatalog leak tracker would only report after the damage. "
+    "Re-derive the producer's consumer count from the plan, or route "
+    "ownership to a consumer that closes.")
+
+
+# ---------------------------------------------------------------------------
+# byte model: the engine's REAL batch footprint for an abstract state
+# ---------------------------------------------------------------------------
+
+def padded_partition_bytes(st: AbstractState) -> float:
+    """Device bytes of ONE partition's batch as the engine actually
+    allocates it: rows padded to the capacity bucket, one validity lane
+    per column, span buffers at least one char/row bucket.  This is what
+    keeps the static bound >= the shadow ledger's measured bytes (which
+    count padded leaf nbytes, not logical rows)."""
+    from .. import types as t
+    from ..columnar.device import DEFAULT_CHAR_BUCKETS, DEFAULT_ROW_BUCKETS, \
+        bucket_for
+    rows = st.rows if st.rows is not None else 0.0
+    parts = st.num_partitions or 1
+    rows_pp = max(rows / max(parts, 1), 1.0)
+    cap = float(bucket_for(int(rows_pp), DEFAULT_ROW_BUCKETS))
+    # +1 byte/row/column for the validity lane schema_width omits
+    width = schema_width(st.dtypes) + len(st.dtypes)
+    span_floor = sum(
+        float(DEFAULT_CHAR_BUCKETS[0])
+        for dt in st.dtypes
+        if isinstance(dt, (t.StringType, t.BinaryType, t.ArrayType,
+                           t.MapType)))
+    return cap * width + span_floor
+
+
+def total_bytes(st: AbstractState) -> float:
+    parts = st.num_partitions or 1
+    return padded_partition_bytes(st) * max(parts, 1)
+
+
+def hbm_budget(conf: cfg.RapidsConf) -> int:
+    """The TPU-L014 budget: explicit memsan budget, else the spill
+    catalog's device budget, else the catalog's default."""
+    b = conf.get(cfg.MEMSAN_HBM_BUDGET)
+    if b is not None:
+        return b
+    b = conf.get(cfg.SPILL_DEVICE_BUDGET)
+    if b is not None:
+        return b
+    return 8 << 30
+
+
+def spill_budget(conf: cfg.RapidsConf) -> int:
+    """The catalog threshold that bounds REGISTERED (spillable) device
+    bytes — maybe_spill demotes past it, so spill-managed holds are
+    capped here even when the raw input is not."""
+    b = conf.get(cfg.SPILL_DEVICE_BUDGET)
+    if b is not None:
+        return b
+    return 8 << 30
+
+
+# ---------------------------------------------------------------------------
+# operator declarations
+# ---------------------------------------------------------------------------
+
+class MemoryEffects:
+    """Declared device-memory behavior of one operator (per partition
+    where not stated otherwise).
+
+    hold              device bytes the operator keeps live while it
+                      streams, INCLUDING its in-flight output (None =
+                      the default: one padded output batch);
+    retained          bytes that stay device-resident AFTER the subtree
+                      finished streaming (pinned scan caches, exchange
+                      memos) — charged to every ancestor's peak;
+    handles           True when the operator hands catalog-registered
+                      SpillableBatch handles to a deferred close
+                      protocol (SpillBoundaryExec);
+    handle_consumers  how many full consumptions the producer waits for
+                      before closing its handles;
+    closes_handles    False = the operator declares it NEVER closes
+                      (unconditional leak unless something else owns);
+    note              human-readable model note for format_memory.
+    """
+
+    __slots__ = ("hold", "retained", "handles", "handle_consumers",
+                 "closes_handles", "note")
+
+    def __init__(self, hold: Optional[float] = None, retained: float = 0.0,
+                 handles: bool = False, handle_consumers: int = 1,
+                 closes_handles: bool = True, note: str = ""):
+        self.hold = hold
+        self.retained = retained
+        self.handles = handles
+        self.handle_consumers = handle_consumers
+        self.closes_handles = closes_handles
+        self.note = note
+
+
+# ---------------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------------
+
+class MemState:
+    """Memory facts for one subtree."""
+
+    __slots__ = ("hold", "retained", "live", "note")
+
+    def __init__(self, hold: float, retained: float, live: float,
+                 note: str = ""):
+        self.hold = hold          # node's own working set
+        self.retained = retained  # node's own post-stream residue
+        self.live = live          # subtree peak bound (inclusive)
+        self.note = note
+
+
+class MemResult:
+    def __init__(self, budget: int):
+        self.budget = budget
+        self.states: Dict[int, MemState] = {}
+        self.diags: List[Diagnostic] = []
+
+    def state(self, node) -> Optional[MemState]:
+        return self.states.get(id(node))
+
+    def bound(self, node) -> Optional[float]:
+        st = self.states.get(id(node))
+        return st.live if st is not None else None
+
+
+def _parent_counts(root) -> Dict[int, int]:
+    """How many times each node OBJECT is consumed in the plan (a reused
+    subtree appears under several parents; the root is consumed once by
+    the collect)."""
+    counts: Dict[int, int] = {id(root): 1}
+    seen: set = set()
+
+    def walk(node):
+        for c in node.children:
+            counts[id(c)] = counts.get(id(c), 0) + 1
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for c in node.children:
+            walk(c)
+
+    walk(root)
+    return counts
+
+
+def analyze_memory(root, conf: cfg.RapidsConf,
+                   interp=None) -> MemResult:
+    """Run the lifetime/peak pass over a converted plan.  Pure — never
+    mutates or executes the plan.  `interp` is an InterpResult from
+    analysis.interp.infer_plan (computed here when absent): the byte
+    model rides its AbstractStates, i.e. the same cost model everywhere.
+    """
+    from ..exec import base as eb
+    if interp is None:
+        from .interp import infer_plan
+        interp = infer_plan(root, conf)
+    budget = hbm_budget(conf)
+    result = MemResult(budget)
+    parents = _parent_counts(root)
+    handle_checked: set = set()  # a shared node is analyzed once per path
+
+    def state_of(node) -> AbstractState:
+        st = interp.state(node)
+        if st is not None:
+            return st
+        try:
+            return AbstractState(node.output_names, node.output_types,
+                                 num_partitions=node.num_partitions)
+        except Exception:
+            return AbstractState([], [])
+
+    def up(node, path: str) -> MemState:
+        here = f"{path} > {node.name}" if path else node.name
+        child_mem = [up(c, here) for c in node.children]
+        child_abs = [state_of(c) for c in node.children]
+        try:
+            eff = node.memory_effects(child_abs, conf)
+        except Exception:
+            eff = None
+        if eff is None:
+            eff = MemoryEffects()
+        hold = eff.hold if eff.hold is not None \
+            else padded_partition_bytes(state_of(node))
+        live = hold + eff.retained + sum(m.live for m in child_mem)
+        mem = MemState(hold, eff.retained, live, eff.note)
+        result.states[id(node)] = mem
+
+        # handle-protocol rules: the close is deferred to a declared
+        # consumer count; the plan's actual parent count must MATCH it
+        if eff.handles and id(node) not in handle_checked:
+            handle_checked.add(id(node))
+            n_parents = parents.get(id(node), 1)
+            if not eff.closes_handles:
+                result.diags.append(L015.diag(
+                    f"{node.name} registers batch handles it declares "
+                    f"it never closes and no consumer takes ownership: "
+                    f"~{_kib(hold)} KiB of device buffers survive the "
+                    f"query", loc=here, node=node))
+            elif n_parents > eff.handle_consumers:
+                result.diags.append(L013.diag(
+                    f"{node.name} closes its handles after "
+                    f"{eff.handle_consumers} consumption(s) but the "
+                    f"plan consumes it {n_parents} times: consumer(s) "
+                    f"{eff.handle_consumers + 1}..{n_parents} would "
+                    f"materialize closed buffers — re-derive the "
+                    f"consumer count after the rewrite that shared "
+                    f"this subtree", loc=here, node=node))
+            elif n_parents < eff.handle_consumers:
+                result.diags.append(L015.diag(
+                    f"{node.name} waits for {eff.handle_consumers} "
+                    f"consumption(s) before closing but the plan only "
+                    f"consumes it {n_parents} time(s): the close never "
+                    f"fires and ~{_kib(hold)} KiB of registered device "
+                    f"buffers leak", loc=here, node=node))
+        return mem
+
+    root_mem = up(root, "")
+
+    # TPU-L014 at the deepest over-budget frontier: the node(s) whose own
+    # contribution pushes the subtree over, not every ancestor above them
+    if root_mem.live > budget:
+        def frontier(node, path: str):
+            here = f"{path} > {node.name}" if path else node.name
+            mem = result.states[id(node)]
+            if mem.live <= budget:
+                return
+            over_children = [c for c in node.children
+                             if result.states[id(c)].live > budget]
+            if over_children:
+                for c in over_children:
+                    frontier(c, here)
+                return
+            result.diags.append(L014.diag(
+                f"{node.name} subtree peaks at ~{_kib(mem.live)} KiB "
+                f"device bytes (own working set ~{_kib(mem.hold)} KiB) "
+                f"against a {_kib(budget)} KiB HBM budget: predicted "
+                f"mid-query OOM — force the out-of-core path or "
+                f"downgrade the subtree", loc=here, node=node))
+
+        frontier(root, "")
+    return result
+
+
+def _kib(b: float) -> int:
+    return max(int(b) >> 10, 1)
+
+
+# ---------------------------------------------------------------------------
+# repair (the pre-flight's L014 path)
+# ---------------------------------------------------------------------------
+
+def try_outofcore_repair(root, node, conf: cfg.RapidsConf) -> bool:
+    """Force `node`'s out-of-core path with a budget sized so the
+    repaired bound fits: operators with a spill-managed fallback (sort,
+    aggregate merge) get ``oc_budget`` set — their execute path then
+    bounds registered device bytes at it (exec/outofcore.py enforces) —
+    and the model's 3x working-set factor lands the subtree under the
+    HBM budget.  Returns False when the node has no such path (the
+    caller downgrades to host instead)."""
+    from ..exec.aggregate import TpuHashAggregateExec
+    from ..exec.sort import SortExec
+    if not isinstance(node, (SortExec, TpuHashAggregateExec)):
+        return False
+    res = analyze_memory(root, conf)
+    mem = res.state(node)
+    if mem is None:
+        return False
+    budget = res.budget
+    below = mem.live - mem.hold - mem.retained  # children's live total
+    slack = budget - below - mem.retained
+    if slack <= 4096:
+        return False  # even a minimal out-of-core chunk cannot fit
+    # the out-of-core working set is ~3x the enforced budget (registered
+    # runs at the budget + one raw merge group + its merged copy)
+    node.oc_budget = int(slack // 4)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# CLI rendering (tools lint --plan --memsan)
+# ---------------------------------------------------------------------------
+
+def format_memory(root, result: MemResult) -> str:
+    lines: List[str] = [
+        f"memsan: HBM budget {_kib(result.budget)} KiB"]
+
+    def walk(node, level: int):
+        mem = result.state(node)
+        if mem is None:
+            desc = "(no state)"
+        else:
+            ret = f" retained=~{_kib(mem.retained)}KiB" if mem.retained \
+                else ""
+            note = f" [{mem.note}]" if mem.note else ""
+            flag = " OVER-BUDGET" if mem.live > result.budget else ""
+            desc = (f"hold=~{_kib(mem.hold)}KiB{ret} "
+                    f"peak<=~{_kib(mem.live)}KiB{flag}{note}")
+        lines.append(f"{'  ' * level}{node.name}: {desc}")
+        for c in node.children:
+            walk(c, level + 1)
+
+    walk(root, 0)
+    return "\n".join(lines) + "\n"

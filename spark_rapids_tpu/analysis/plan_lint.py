@@ -110,8 +110,16 @@ L008 = register_rule(
 # identical xp-parameterized kernels on the host engine).  TPU-L011
 # (contract broken by a rewrite) repairs exactly like L006: the flip
 # clears the co-location assumption and the host path re-merges whole.
+# TPU-L014 (peak over the HBM budget) first tries the cheaper repair —
+# forcing the operator's out-of-core path (lifetime.try_outofcore_repair)
+# — and host-flips only when no such path exists; the flip is sound
+# because host RAM backs the working set instead of HBM.
 # TPU-L009 is NOT here — a stale bind is wrong on either engine.
-DOWNGRADE_CODES = {"TPU-L001", "TPU-L003", "TPU-L006", "TPU-L011"}
+# TPU-L013/L015 are NOT here — a broken handle protocol (use-after-close
+# / leak) is broken on either engine; only re-deriving the consumer
+# count fixes it.
+DOWNGRADE_CODES = {"TPU-L001", "TPU-L003", "TPU-L006", "TPU-L011",
+                   "TPU-L014"}
 
 
 # ---------------------------------------------------------------------------
@@ -409,6 +417,18 @@ def lint_plan(root: eb.Exec, conf: cfg.RapidsConf,
                 "TPU-L000", INFO,
                 f"abstract interpreter failed ({ex}); syntactic rules "
                 f"only", loc=root.name))
+        if interp_result is not None:
+            # tmsan lifetime/peak pass (TPU-L013..L015) rides the same
+            # inferred states; a failure degrades like the interpreter
+            try:
+                from .lifetime import analyze_memory
+                diags.extend(
+                    analyze_memory(root, conf, interp_result).diags)
+            except Exception as ex:
+                diags.append(Diagnostic(
+                    "TPU-L000", INFO,
+                    f"lifetime pass failed ({ex}); memory rules "
+                    f"skipped", loc=root.name))
     ctx = LintContext(conf, interp_result)
     for node, parent, path in _walk(root):
         for check in _NODE_CHECKS:
@@ -423,16 +443,32 @@ def lint_plan(root: eb.Exec, conf: cfg.RapidsConf,
     return sort_diagnostics(filter_suppressed(diags, disabled.split(",")))
 
 
-def downgrade_hazards(root: eb.Exec, diags: List[Diagnostic]) -> eb.Exec:
+def downgrade_hazards(root: eb.Exec, diags: List[Diagnostic],
+                      conf: Optional[cfg.RapidsConf] = None) -> eb.Exec:
     """Apply the sound repairs: flagged subtrees (DOWNGRADE_CODES with
     error severity) fall back to the host engine — placement flips to
     CPU (the xp-parameterized kernels run identically on numpy), fused
     ICI stages restore their host-path originals, and broken co-location
     assumptions are cleared.  insert_transitions then brackets the
-    boundary as usual."""
+    boundary as usual.
+
+    TPU-L014 (peak over the HBM budget) gets the cheaper repair first:
+    operators with a spill-managed fallback are forced out-of-core
+    (oc_budget) and stay on device; only nodes without such a path
+    host-flip."""
+    repaired: set = set()
+    if conf is not None:
+        from .lifetime import try_outofcore_repair
+        for d in diags:
+            if d.code == "TPU-L014" and d.node is not None:
+                try:
+                    if try_outofcore_repair(root, d.node, conf):
+                        repaired.add(id(d.node))
+                except Exception:
+                    pass  # fall through to the host flip
     flagged = {id(d.node) for d in diags
                if d.node is not None and d.is_error and
-               d.code in DOWNGRADE_CODES}
+               d.code in DOWNGRADE_CODES and id(d.node) not in repaired}
     if not flagged:
         return root
 

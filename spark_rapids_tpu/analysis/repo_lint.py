@@ -14,10 +14,17 @@
   TPU-R004  every planning-time admission gate is no weaker than the
             kernel it guards (capabilities.verify_gates — the check that
             catches the round-5 alltoall admit/crash drift)
+  TPU-R005  device allocations in exec/ and ops/ route through the
+            catalog/arena APIs (SpillCatalog.register, batch_to_device,
+            the shared staging arena) — an unrouted buffer is invisible
+            to spill pressure, leak_report and the tmsan ledger
 
 Pre-existing violations live in a checked-in baseline
 (devtools/lint_baseline.txt, fingerprint per line); devtools/run_lint.py
 exits nonzero only on NEW violations, so the invariant ratchets.
+Deliberate single-site exceptions are annotated in place with
+``# tpulint: allow[TPU-Rxxx] <reason>`` instead of baselined — the
+annotation travels with the code it sanctions.
 """
 
 from __future__ import annotations
@@ -53,9 +60,48 @@ R004 = register_rule(
     "a dtype its runtime kernel raises on — plans pass planning and "
     "crash mid-query.  Tighten the gate or extend the kernel.")
 
-# hot-path packages for TPU-R001 (module-relative, forward slashes)
+R005 = register_rule(
+    "TPU-R005", ERROR, "device allocation outside the catalog/arena APIs",
+    "Code in exec/ or ops/ constructs a SpillableBatch directly, calls "
+    "jax.device_put, or builds a private HostArena: device buffers must "
+    "enter through SpillCatalog.register/register_pinned (budgeted, "
+    "spillable, visible to the tmsan shadow ledger), uploads through "
+    "columnar.device.batch_to_device / HostToDeviceExec, and staging "
+    "through the plugin's shared arena — an unrouted allocation is "
+    "invisible to every memory-safety layer (spill pressure, "
+    "leak_report, the TPU-L014 peak bound).")
+
+# hot-path packages for TPU-R001/R005 (module-relative, forward slashes)
 _HOT_PATHS = ("spark_rapids_tpu/exec/", "spark_rapids_tpu/ops/")
 _SYNC_RECEIVERS = {"asarray": {"np", "numpy"}, "device_get": {"jax"}}
+
+# `# tpulint: allow[TPU-Rxxx] <reason>` on the flagged line or the line
+# above sanctions one deliberate violation (the annotated-sink analog of
+# the baseline, for sites that are the POINT of the rule's exception —
+# e.g. maybe_sync IS the sanctioned device-timing sync)
+import re as _re
+
+_ALLOW_RE = _re.compile(r"tpulint:\s*allow\[([A-Z0-9-]+)\]")
+
+
+def _allowed_lines(source: str) -> dict:
+    """rule code -> set of line numbers (1-based) the annotation covers:
+    its own line, any immediately following comment lines, and the first
+    code line after them (so a multi-line reason can sit above the
+    call)."""
+    out: dict = {}
+    lines = source.splitlines()
+    for i, line in enumerate(lines, start=1):
+        for code in _ALLOW_RE.findall(line):
+            covered = out.setdefault(code, set())
+            covered.add(i)
+            j = i + 1
+            while j <= len(lines) and \
+                    lines[j - 1].lstrip().startswith("#"):
+                covered.add(j)
+                j += 1
+            covered.add(j)
+    return out
 
 
 def _package_root() -> str:
@@ -120,6 +166,37 @@ class _HostSyncVisitor(_ScopedVisitor):
         self.generic_visit(node)
 
 
+class _DeviceAllocVisitor(_ScopedVisitor):
+    """TPU-R005: direct device-buffer acquisition in exec//ops/ that
+    bypasses the catalog/arena routing."""
+
+    def __init__(self, relpath: str):
+        super().__init__()
+        self.relpath = relpath
+        self.diags: List[Diagnostic] = []
+
+    def visit_Call(self, node):
+        f = node.func
+        call = None
+        if isinstance(f, ast.Name) and f.id in ("SpillableBatch",
+                                                "HostArena"):
+            call = f"{f.id}(...)"
+        elif isinstance(f, ast.Attribute):
+            if f.attr in ("SpillableBatch", "HostArena"):
+                call = f"{f.attr}(...)"
+            elif f.attr == "device_put" and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in ("jax", "jnp"):
+                call = f"{f.value.id}.device_put"
+        if call is not None:
+            self.diags.append(R005.diag(
+                f"unrouted device allocation {call} in {self.scope}; "
+                f"route through SpillCatalog.register / "
+                f"batch_to_device / the shared arena",
+                loc=f"{self.relpath}:{node.lineno}"))
+        self.generic_visit(node)
+
+
 class _EnvReadVisitor(_ScopedVisitor):
     def __init__(self, relpath: str, declared: Set[str]):
         super().__init__()
@@ -164,19 +241,30 @@ def _ast_diagnostics(root: str) -> List[Diagnostic]:
         relpath = os.path.relpath(path, root).replace(os.sep, "/")
         try:
             with open(path, encoding="utf-8") as f:
-                tree = ast.parse(f.read(), filename=relpath)
+                source = f.read()
+            tree = ast.parse(source, filename=relpath)
         except SyntaxError as ex:
             diags.append(Diagnostic("TPU-R000", ERROR,
                                     f"unparsable module: {ex.msg}",
                                     loc=relpath))
             continue
+        file_diags: List[Diagnostic] = []
         if any(relpath.startswith(h) for h in _HOT_PATHS):
             v = _HostSyncVisitor(relpath)
             v.visit(tree)
-            diags.extend(v.diags)
+            file_diags.extend(v.diags)
+            dv = _DeviceAllocVisitor(relpath)
+            dv.visit(tree)
+            file_diags.extend(dv.diags)
         ev = _EnvReadVisitor(relpath, declared)
         ev.visit(tree)
-        diags.extend(ev.diags)
+        file_diags.extend(ev.diags)
+        allowed = _allowed_lines(source) if file_diags else {}
+        for d in file_diags:
+            lineno = int(d.loc.rsplit(":", 1)[-1]) if ":" in d.loc else -1
+            if lineno in allowed.get(d.code, ()):
+                continue  # annotated sanctioned sink
+            diags.append(d)
     return diags
 
 

@@ -212,6 +212,13 @@ class TpuSession:
         from ..memory.spill import SpillCatalog
         debug = self.conf.get(cfg.MEMORY_DEBUG)
         cat = SpillCatalog.get()
+        # tmsan runtime sanitizer: record + assert the buffer lifecycle
+        # state machine on every catalog/arena event while the query
+        # runs, then require a clean ledger (no leaks) afterwards
+        memsan_on = self.conf.get(cfg.MEMSAN_ENABLED)
+        if memsan_on:
+            from ..memory import memsan
+            ledger = memsan.install()
         if debug:
             cat.debug = True
             before = {b_id for b_id, *_ in cat.leak_report()}
@@ -242,8 +249,18 @@ class TpuSession:
             self.release_plan_shuffles(final_plan)
             if debug:
                 cat.debug = False
+            if memsan_on:
+                memsan.uninstall()
             raise
         self.release_plan_shuffles(final_plan)
+        if memsan_on:
+            try:
+                # everything the query registered must have reached
+                # CLOSED (pinned scan caches are sanctioned residents);
+                # leaks surface with owning-exec provenance
+                ledger.assert_clean()
+            finally:
+                memsan.uninstall()
         if debug:
             leaks = [l for l in cat.leak_report() if l[0] not in before]
             cat.debug = False

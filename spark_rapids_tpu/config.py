@@ -542,6 +542,27 @@ LINT_MAX_PROGRAMS = conf(
     .check(lambda v: v >= 1, "must be >= 1") \
     .create_with_default(96)
 
+# --- memory sanitizer (tmsan) ---------------------------------------------
+
+MEMSAN_ENABLED = conf("spark.rapids.tpu.memsan.enabled").boolean() \
+    .doc("Opt-in runtime shadow ledger over the spill catalog and "
+         "staging arena: every alloc/register/pin/spill/unspill/close "
+         "is recorded with owning-exec attribution and asserted "
+         "against the buffer-lifecycle state machine "
+         "(analysis/lifetime.py); after each query the session fails "
+         "on a dirty ledger (leaked or mis-tiered buffers).  The "
+         "runtime oracle for the static TPU-L013..L015 rules.  "
+         "Diagnostics only — adds per-event bookkeeping.") \
+    .create_with_default(False)
+
+MEMSAN_HBM_BUDGET = conf("spark.rapids.tpu.memsan.hbmBudgetBytes").bytes() \
+    .doc("Device-memory budget the static peak bound (TPU-L014) and "
+         "the shadow ledger's peak check are evaluated against.  "
+         "Default: the spill catalog's device budget "
+         "(spark.rapids.memory.tpu.spillBudgetBytes or the HBM arena "
+         "size).") \
+    .create_optional()
+
 # Environment variables the engine reads directly (escape hatches that
 # must exist before config parsing, e.g. cache sizing at import time).
 # The repo lint (TPU-R002) fails on any SPARK_RAPIDS_* env read not

@@ -63,8 +63,9 @@ def generate_lint_rules() -> str:
     analog of supported_ops: codes/severities/docs can never drift from
     the rules actually enforced)."""
     # importing the front ends populates the catalog (interp carries the
-    # flow-sensitive rules TPU-L009..L012)
-    from .analysis import interp, plan_lint, repo_lint  # noqa: F401
+    # flow-sensitive rules TPU-L009..L012, lifetime the tmsan memory
+    # rules TPU-L013..L015)
+    from .analysis import interp, lifetime, plan_lint, repo_lint  # noqa: F401
     from .analysis.diagnostics import RULE_CATALOG
     lines = [
         "# tpulint rule catalog",

@@ -586,6 +586,22 @@ class TpuHashAggregateExec(Exec):
         out = gather_batch(xp, batch, order, live[order], batch.num_rows)
         return DeviceBatch(out.columns, batch.num_rows, batch.names)
 
+    def memory_effects(self, child_states, conf):
+        """Accumulates registered partial batches then concat + merge:
+        ~3x one partition's padded input bytes in-core, or 3x the
+        enforced budget out-of-core (bounded by oc_budget when the
+        TPU-L014 pre-flight repair forced it)."""
+        from ..analysis.lifetime import (MemoryEffects,
+                                         padded_partition_bytes,
+                                         spill_budget)
+        if not child_states:
+            return None
+        pp = padded_partition_bytes(child_states[0])
+        budget = float(min(spill_budget(conf),
+                           self.oc_budget or (1 << 62)))
+        hold = 3.0 * (pp if pp <= budget else budget)
+        return MemoryEffects(hold=hold, note="aggregate: spill-managed")
+
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         xp = self.xp
         on_tpu = self.placement == TPU
@@ -634,6 +650,10 @@ class TpuHashAggregateExec(Exec):
             # accumulated partials are spillable (ref aggregate.scala's
             # spillable batch accumulation before merge)
             partials.append(spill.register(out, SpillPriority.INPUT))
+            if self.oc_budget is not None:
+                from .outofcore import enforce_device_budget
+                enforce_device_budget(
+                    spill, min(spill.device_budget, self.oc_budget))
         if not partials:
             if self.grouping:
                 return
@@ -651,7 +671,9 @@ class TpuHashAggregateExec(Exec):
                 self._jit_update(eb) if on_tpu
                 else self._update_batch(np, eb), SpillPriority.INPUT)]
         total = sum(p.device_bytes for p in partials)
-        if total <= SpillCatalog.get().device_budget:
+        budget = min(SpillCatalog.get().device_budget,
+                     self.oc_budget or (1 << 62))
+        if total <= budget:
             # in-core: one concat + merge
             with MetricTimer(self.metrics[OP_TIME]):
                 mats = [p.get_batch(xp) for p in partials]
@@ -684,10 +706,21 @@ class TpuHashAggregateExec(Exec):
         sortkeys_fn = self._jit_sortkeys if on_tpu else \
             (lambda b: self._sort_by_keys(np, b))
         chunk_rows = max(int(p.num_rows) for p in partials)
+        if self.oc_budget is not None:
+            # snap down to a capacity bucket (off-bucket chunks pad UP)
+            from ..columnar.device import DEFAULT_ROW_BUCKETS
+            rows_total = sum(int(p.num_rows) for p in partials)
+            bpr = max(total / max(rows_total, 1), 1.0)
+            target = int(budget / (2 * bpr))
+            floor = DEFAULT_ROW_BUCKETS[0]
+            for b in DEFAULT_ROW_BUCKETS:
+                if b <= target:
+                    floor = b
+            chunk_rows = min(chunk_rows, floor)
         with MetricTimer(self.metrics[OP_TIME]):
             for m in merge_partials_bounded(
                     xp, partials, merge_fn, sortkeys_fn, schema_names,
-                    schema_types, spill, spill.device_budget, chunk_rows):
+                    schema_types, spill, budget, chunk_rows):
                 if self.mode == PARTIAL:
                     out = m
                 else:

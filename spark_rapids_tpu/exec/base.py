@@ -124,10 +124,11 @@ def semantic_sig(v) -> object:
         return ("set",) + tuple(sorted(map(semantic_sig, v),
                                        key=repr))
     if isinstance(v, (np.ndarray, jnp.ndarray)):
-        a = np.asarray(v)
-        if a.nbytes <= (1 << 20):
-            return ("arr", a.dtype.str, a.shape, a.tobytes())
-        return ("bigarr", a.dtype.str, a.shape, id(v))
+        if getattr(v, "nbytes", 0) > (1 << 20):
+            return ("bigarr", np.dtype(v.dtype).str, v.shape, id(v))
+        from ..columnar.fetch import fetch_array
+        a = fetch_array(v)  # sanctioned single-transfer materialization
+        return ("arr", a.dtype.str, a.shape, a.tobytes())
     if callable(v) and not hasattr(v, "children"):
         # user functions (UDFs): key by BYTECODE + captured VALUES
         # (closure cells, referenced globals, bound self), so a
@@ -172,10 +173,11 @@ def _value_sig(x):
     if isinstance(x, _pytypes.CodeType):
         return _code_sig(x)
     if isinstance(x, (np.ndarray, jnp.ndarray)):
-        a = np.asarray(x)
-        if a.nbytes <= (1 << 16):
-            return ("arr", a.dtype.str, a.shape, a.tobytes())
-        return _UNSIGNABLE
+        if getattr(x, "nbytes", 0) > (1 << 16):
+            return _UNSIGNABLE
+        from ..columnar.fetch import fetch_array
+        a = fetch_array(x)  # sanctioned single-transfer materialization
+        return ("arr", a.dtype.str, a.shape, a.tobytes())
     if isinstance(x, (tuple, list)):
         parts = tuple(_value_sig(i) for i in x)
         return _UNSIGNABLE if any(p is _UNSIGNABLE for p in parts) \
@@ -269,11 +271,11 @@ class Metric:
     @property
     def value(self):
         if self._pending:
-            # resolve all deferred device scalars in ONE transfer (a
-            # per-scalar fetch would pay one tunnel round trip each)
-            stacked = jnp.stack([jnp.asarray(p, dtype=jnp.int64)
-                                 for p in self._pending])
-            self._value += int(np.asarray(stacked).sum())
+            # resolve all deferred device scalars through the sanctioned
+            # batched crossing (ONE transfer; a per-scalar fetch would
+            # pay one tunnel round trip each)
+            from ..columnar.fetch import fetch_ints
+            self._value += sum(fetch_ints(self._pending))
             self._pending.clear()
         return self._value
 
@@ -322,8 +324,12 @@ def maybe_sync(out) -> None:
     if _device_timing_enabled:
         leaves = [l for l in jax.tree_util.tree_leaves(out)
                   if isinstance(l, jax.Array)]
+        # tpulint: allow[TPU-R001] this function IS the sanctioned sync:
+        # device-timing diagnostics exist to pay the barrier on purpose
         jax.block_until_ready(leaves)
         if leaves:
+            # tpulint: allow[TPU-R001] deliberate one-element fetch — the
+            # only reliable execution barrier on tunneled platforms
             np.asarray(leaves[-1].ravel()[-1:])
 
 
@@ -408,11 +414,12 @@ class ExecContext:
         g = self.drain_spec_guards()
         if not g:
             return
-        vals = np.asarray(jnp.stack([jnp.asarray(x) for x in g]))
-        if not vals.all():
+        from ..columnar.fetch import fetch_ints
+        vals = fetch_ints(g)  # one stacked transfer (columnar/fetch)
+        failed = sum(1 for v in vals if not v)
+        if failed:
             raise SpeculativeSizingMiss(
-                f"{int((~vals.astype(bool)).sum())} speculation guard(s) "
-                "failed")
+                f"{failed} speculation guard(s) failed")
 
     @property
     def capacity_buckets(self):
@@ -432,6 +439,13 @@ class Exec:
     """Base physical operator."""
 
     placement = CPU
+
+    # Forced out-of-core budget (device bytes).  None = the operator's
+    # normal in-core/out-of-core decision against the spill catalog's
+    # budget; set by the TPU-L014 pre-flight repair
+    # (analysis/lifetime.try_outofcore_repair) to bound the working set
+    # of operators with a spill-managed fallback (sort, aggregate).
+    oc_budget: Optional[int] = None
 
     def __init__(self, children: Sequence["Exec"]):
         self.children: List[Exec] = list(children)
@@ -469,6 +483,20 @@ class Exec:
         interpreter enforces every declaration and the differential
         oracle (analysis/oracle.py) keeps the declarations honest
         against real execution."""
+        return None
+
+    def memory_effects(self, child_states, conf):
+        """Declared device-memory behavior for the lifetime/peak pass
+        (analysis/lifetime.py): either None (pure streaming — the
+        working set is one output batch, nothing retained, no deferred
+        handle protocol) or an analysis.lifetime.MemoryEffects.
+        `child_states` are the children's inferred AbstractStates, so
+        declarations can size themselves from the same cost model the
+        CBO uses.  Operators that materialize (sort, aggregate, join
+        builds), retain (pinned scans, exchange memos) or hand out
+        catalog-registered handles (SpillBoundaryExec) override this;
+        the runtime shadow ledger (memory/memsan.py) keeps the
+        declarations honest against real execution."""
         return None
 
     # -- statistics ----------------------------------------------------------

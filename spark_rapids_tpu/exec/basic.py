@@ -66,6 +66,26 @@ class LocalScanExec(Exec):
     def estimated_size_bytes(self):
         return self.table.nbytes
 
+    def memory_effects(self, child_states, conf):
+        """A device-placed scan with a pin cache keeps every uploaded
+        batch HBM-resident across collects — sanctioned retention
+        (evicted first under pressure), but real peak bytes."""
+        from .. import config as cfg
+        from ..analysis.lifetime import (MemoryEffects,
+                                         padded_partition_bytes,
+                                         total_bytes)
+        from .base import TPU as _TPU
+        if self.pin_cache is None or self.placement != _TPU or \
+                not conf.get(cfg.SCAN_PIN_DEVICE):
+            return None
+        from ..analysis.absdomain import AbstractState
+        st = AbstractState(self._names, self._types,
+                           rows=float(self.table.num_rows),
+                           num_partitions=self._num_partitions)
+        return MemoryEffects(hold=padded_partition_bytes(st),
+                             retained=total_bytes(st),
+                             note="pinned scan cache")
+
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         from .. import config as cfg
         key = (pid, self._num_partitions, self.batch_rows,
@@ -457,6 +477,17 @@ class CoalesceBatchesExec(Exec):
         super().__init__([child])
         self.target_rows = target_rows
         self.require_single_batch = require_single_batch
+
+    def memory_effects(self, child_states, conf):
+        """Accumulates raw pending batches up to the target before each
+        concat: the pending set plus its concatenated copy coexist."""
+        from ..analysis.lifetime import (MemoryEffects,
+                                         padded_partition_bytes)
+        if not child_states:
+            return None
+        return MemoryEffects(
+            hold=2.0 * padded_partition_bytes(child_states[0]),
+            note="raw pending concat")
 
     @property
     def output_names(self):

@@ -60,6 +60,17 @@ class BroadcastExchangeExec(Exec):
     def describe(self):
         return "BroadcastExchange"
 
+    def memory_effects(self, child_states, conf):
+        """Collects + concatenates the whole child once and keeps the
+        cached batch device-resident for every consumer until the exec
+        instance dies — raw (not spill-managed) retention."""
+        from ..analysis.lifetime import MemoryEffects, total_bytes
+        if not child_states:
+            return None
+        whole = total_bytes(child_states[0])
+        return MemoryEffects(hold=whole, retained=whole,
+                             note="cached broadcast batch")
+
     def _materialize(self, ctx: ExecContext) -> Batch:
         with self._lock:
             if self._cached is not None:

@@ -124,6 +124,24 @@ class HashJoinExec(Exec):
             return None  # computed keys: no nameable clustering fact
         return CoClusteredContract(lk, rk)
 
+    def memory_effects(self, child_states, conf):
+        """The build side is concatenated into ONE raw device batch per
+        probe partition (whole right side unless colocated) — not
+        spill-managed, so the full build bytes count against peak; plus
+        the probe's in-flight batch and the expanded output."""
+        from ..analysis.lifetime import (MemoryEffects,
+                                         padded_partition_bytes,
+                                         total_bytes)
+        if len(child_states) < 2:
+            return None
+        build = padded_partition_bytes(child_states[1]) if self.colocated \
+            else total_bytes(child_states[1])
+        # 2x build (collected batches + concat) + probe batch + output
+        return MemoryEffects(
+            hold=2.0 * build + 2.0 * padded_partition_bytes(
+                child_states[0]) + build,
+            note="raw build-side concat")
+
     @property
     def output_names(self):
         l, r = self.children
@@ -495,6 +513,20 @@ class NestedLoopJoinExec(Exec):
     @property
     def num_partitions(self):
         return self.children[0].num_partitions
+
+    def memory_effects(self, child_states, conf):
+        """Collects the whole right side raw per probe partition, and
+        the cross-product output amplifies: both sides' bytes plus the
+        expanded batch count against peak."""
+        from ..analysis.lifetime import (MemoryEffects,
+                                         padded_partition_bytes,
+                                         total_bytes)
+        if len(child_states) < 2:
+            return None
+        return MemoryEffects(
+            hold=3.0 * total_bytes(child_states[1]) +
+            2.0 * padded_partition_bytes(child_states[0]),
+            note="raw build-side concat")
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         xp = self.xp

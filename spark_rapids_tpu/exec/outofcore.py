@@ -25,6 +25,7 @@ jitted ones.
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, Iterator, List, Sequence
 
 import numpy as np
@@ -34,6 +35,7 @@ from ..columnar.device import (DEFAULT_CHAR_BUCKETS, DEFAULT_ROW_BUCKETS,
                                DeviceBatch, bucket_for)
 from ..memory.spill import SpillableBatch, SpillCatalog, SpillPriority
 from ..ops.gather import gather_batch
+from .base import Exec
 from .concat import concat_batches
 
 
@@ -93,6 +95,20 @@ def _run_bytes(run: Run) -> int:
     return sum(c.device_bytes for c in run)
 
 
+def enforce_device_budget(spill: SpillCatalog, budget: int) -> None:
+    """Keep REGISTERED device bytes at or under `budget` — the stronger
+    form of maybe_spill the out-of-core paths use: maybe_spill only
+    reacts to the catalog-wide threshold, while a forced out-of-core
+    budget (Exec.oc_budget, the TPU-L014 repair) must bound the working
+    set even when the catalog as a whole is far from pressure."""
+    over = spill.device_bytes_registered() - min(budget,
+                                                 spill.device_budget)
+    if over > 0:
+        spill.synchronous_spill(over)
+    else:
+        spill.maybe_spill()
+
+
 def external_merge_sort(xp, inputs: Sequence[SpillableBatch],
                         sort_fn: Callable[[DeviceBatch], DeviceBatch],
                         names, types, spill: SpillCatalog, budget: int,
@@ -107,7 +123,7 @@ def external_merge_sort(xp, inputs: Sequence[SpillableBatch],
         run = [spill.register(c, SpillPriority.INPUT)
                for c in rechunk(xp, sb, names, types, chunk_rows)]
         runs.append(run)
-        spill.maybe_spill()
+        enforce_device_budget(spill, budget)
     while len(runs) > 1:
         # greedy budget-bounded fan-in (always >= 2: correctness over a
         # transient overshoot when two single runs already exceed budget)
@@ -129,7 +145,7 @@ def external_merge_sort(xp, inputs: Sequence[SpillableBatch],
         new_run = [spill.register(c, SpillPriority.INPUT)
                    for c in rechunk(xp, sb, names, types, chunk_rows)]
         runs.append(new_run)
-        spill.maybe_spill()
+        enforce_device_budget(spill, budget)
     for c in runs[0]:
         out = c.get_batch(xp)
         c.close()
@@ -180,7 +196,7 @@ def merge_partials_bounded(xp, partials: List[SpillableBatch],
                 continue
             nxt.append(_merge_compact(group))
             progress = True
-            spill.maybe_spill()
+            enforce_device_budget(spill, budget)
         partials = nxt
         if not progress:
             break
@@ -209,3 +225,79 @@ def merge_partials_bounded(xp, partials: List[SpillableBatch],
                             min(n, 1))
     if carry is not None and int(carry.num_rows) > 0:
         yield carry
+
+
+class SpillBoundaryExec(Exec):
+    """Out-of-core boundary: registers the child's batches in the
+    SpillCatalog so everything staged below a materializing consumer is
+    spill-managed (demotable under pressure instead of raw HBM), and
+    memoizes the registered handles per (query, partition) so a REUSED
+    subtree executes its child exactly once (the IciExchangeExec memo
+    discipline for ordinary pipelines).
+
+    Ownership protocol: the handles close after `consumers` full
+    consumptions.  That number is part of the PLAN — a rewrite that
+    shares or un-shares this node must re-derive it, which is exactly
+    what the static lifetime pass checks: more parents than declared
+    consumers is a use-after-close along the extra path (TPU-L013),
+    fewer means the close never fires (TPU-L015).  The runtime shadow
+    ledger (spark.rapids.tpu.memsan.enabled) catches either one as it
+    happens."""
+
+    def __init__(self, child: Exec, consumers: int = 1,
+                 close_on_exhaust: bool = True):
+        super().__init__([child])
+        self.placement = child.placement
+        self.consumers = consumers
+        # False = this node declares it never closes (only sound when a
+        # downstream owner takes over — no such owner exists today, so
+        # the lifetime pass flags it as a plan-level leak)
+        self.close_on_exhaust = close_on_exhaust
+        self._memo: dict = {}
+        self._lock = threading.Lock()
+
+    @property
+    def output_names(self):
+        return self.children[0].output_names
+
+    @property
+    def output_types(self):
+        return self.children[0].output_types
+
+    def describe(self):
+        return f"SpillBoundary consumers={self.consumers}"
+
+    def memory_effects(self, child_states, conf):
+        from ..analysis.lifetime import (MemoryEffects,
+                                         padded_partition_bytes,
+                                         spill_budget)
+        pp = padded_partition_bytes(child_states[0]) if child_states \
+            else 0.0
+        return MemoryEffects(
+            hold=min(pp, float(spill_budget(conf))) + pp,
+            handles=True, handle_consumers=self.consumers,
+            closes_handles=self.close_on_exhaust,
+            note="spill-managed staging")
+
+    def execute_partition(self, pid, ctx) -> Iterator[DeviceBatch]:
+        xp = self.xp
+        spill = SpillCatalog.get()
+        key = (ctx.uid, pid)
+        with self._lock:
+            entry = self._memo.get(key)
+        if entry is None:
+            handles = [spill.register(b, SpillPriority.INPUT)
+                       for b in
+                       self.children[0].execute_partition(pid, ctx)]
+            entry = {"handles": handles, "reads": 0}
+            with self._lock:
+                self._memo[key] = entry
+        # a consumer past the declared count materializes CLOSED handles
+        # here — the runtime shape of TPU-L013 (get_batch raises; under
+        # the shadow ledger, as a LifecycleViolation with provenance)
+        for h in entry["handles"]:
+            yield h.get_batch(xp)
+        entry["reads"] += 1
+        if self.close_on_exhaust and entry["reads"] >= self.consumers:
+            for h in entry["handles"]:
+                h.close()

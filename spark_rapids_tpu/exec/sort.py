@@ -84,18 +84,42 @@ class SortExec(Exec):
         return process_jit(self._jit_key,
                            lambda: lambda b: self._sort_batch(jnp, b))
 
+    def memory_effects(self, child_states, conf):
+        """Materializes its whole input as registered spillables, then
+        concat + sorted copy: ~3x one partition's padded bytes in-core,
+        or 3x the enforced budget out-of-core (the working set the
+        TPU-L014 repair bounds by setting oc_budget)."""
+        from ..analysis.lifetime import (MemoryEffects,
+                                         padded_partition_bytes,
+                                         spill_budget)
+        if not child_states:
+            return None
+        pp = padded_partition_bytes(child_states[0])
+        budget = float(min(spill_budget(conf),
+                           self.oc_budget or (1 << 62)))
+        hold = 3.0 * (pp if pp <= budget else budget)
+        return MemoryEffects(hold=hold, note="sort: spill-managed")
+
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         xp = self.xp
         from ..memory.spill import SpillCatalog, SpillPriority
+        from .outofcore import enforce_device_budget
         spill = SpillCatalog.get()
-        pending = [spill.register(b, SpillPriority.INPUT)
-                   for b in self.children[0].execute_partition(pid, ctx)]
+        # a forced out-of-core budget (the TPU-L014 pre-flight repair)
+        # lowers the in-core threshold below the catalog's and bounds
+        # registered device bytes while the input streams in
+        budget = min(spill.device_budget, self.oc_budget or (1 << 62))
+        pending = []
+        for b in self.children[0].execute_partition(pid, ctx):
+            pending.append(spill.register(b, SpillPriority.INPUT))
+            if self.oc_budget is not None:
+                enforce_device_budget(spill, budget)
         if not pending:
             return
         sort_fn = self._jitted if self.placement == TPU \
             else lambda b: self._sort_batch(np, b)
         total = sum(p.device_bytes for p in pending)
-        if total <= spill.device_budget:
+        if total <= budget:
             # in-core: concat everything and sort once
             with MetricTimer(self.metrics[OP_TIME]):
                 batches = [p.get_batch(xp) for p in pending]
@@ -113,10 +137,24 @@ class SortExec(Exec):
         # out-of-core external merge sort (ref GpuSortExec.scala:231)
         from .outofcore import external_merge_sort
         chunk_rows = max(int(p.num_rows) for p in pending)
+        if self.oc_budget is not None:
+            # keep each run chunk at ~half the enforced budget so a
+            # two-run merge group stays within it; snap DOWN to a
+            # capacity bucket — an off-bucket chunk pads UP to the next
+            # bucket and would inflate real memory instead
+            from ..columnar.device import DEFAULT_ROW_BUCKETS
+            rows_total = sum(int(p.num_rows) for p in pending)
+            bpr = max(total / max(rows_total, 1), 1.0)
+            target = int(budget / (2 * bpr))
+            floor = DEFAULT_ROW_BUCKETS[0]
+            for b in DEFAULT_ROW_BUCKETS:
+                if b <= target:
+                    floor = b
+            chunk_rows = min(chunk_rows, floor)
         with MetricTimer(self.metrics[OP_TIME]):
             for out in external_merge_sort(
                     xp, pending, sort_fn, self.output_names,
-                    self.output_types, spill, spill.device_budget,
+                    self.output_types, spill, budget,
                     chunk_rows):
                 self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
                 self.metrics[NUM_OUTPUT_BATCHES] += 1

@@ -495,6 +495,8 @@ class WindowExec(Exec):
                         iota = xp.arange(cap, dtype=xp.int32)
                         if xp is np:
                             inv = np.zeros((cap,), np.int32)
+                            # tpulint: allow[TPU-R001] host-engine branch:
+                            # lay.order is numpy here, no device crossing
                             inv[np.asarray(lay.order)] = iota
                         else:
                             inv = xp.zeros((cap,), xp.int32).at[

@@ -1,0 +1,228 @@
+"""tmsan runtime side: a shadow ledger over the spill catalog and the
+host staging arena.
+
+The static lifetime pass (analysis/lifetime.py) predicts buffer-protocol
+violations and peak HBM from declared operator effects; this module is
+the differential oracle that keeps those declarations honest — the role
+analysis/oracle.py plays for the plan typechecker, applied to memory.
+
+Opt-in via ``spark.rapids.tpu.memsan.enabled``: ``memory/spill.py`` and
+``native/arena.py`` emit one event per lifecycle transition
+(alloc/register/pin/spill/unspill/materialize/close/evict) into the
+installed ledger, which
+
+  * asserts every transition against the SAME ``LIFECYCLE`` relation the
+    static pass evaluates (a use-after-close or double-spill raises
+    ``LifecycleViolation`` at the exact call site, with the owning
+    exec);
+  * attributes every buffer to the Exec whose execute_partition frame
+    acquired it (stack walk, only paid while the ledger is installed);
+  * tracks live/peak device bytes so a query's measured peak can be
+    checked against the static TPU-L014 bound
+    (``devtools/run_lint.py --memsan`` replays the golden corpus doing
+    exactly that);
+  * extends ``SpillCatalog.leak_report()`` with exec provenance and
+    gives the session a post-query ``assert_clean()`` — the
+    Arm.scala-style leak check with the analyzer's vocabulary.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..analysis.lifetime import (ALLOC, ALLOCATED, CLOSE, CLOSED,
+                                 DEVICE_RESIDENT, EVICT, MATERIALIZE, PIN,
+                                 REGISTER, SPILL, UNBORN, UNSPILL,
+                                 lifecycle_next)
+
+
+class LifecycleViolation(RuntimeError):
+    """A real buffer event broke the ownership state machine."""
+
+
+class LedgerEntry:
+    __slots__ = ("handle_id", "kind", "state", "device_bytes", "owner",
+                 "history")
+
+    def __init__(self, handle_id: str, kind: str, owner: str):
+        self.handle_id = handle_id
+        self.kind = kind              # "spillable" | "pinned" | "arena"
+        self.state = UNBORN
+        self.device_bytes = 0         # currently device-resident bytes
+        self.owner = owner
+        self.history: List[str] = []
+
+
+def _owning_exec() -> str:
+    """Attribute the current call to the nearest enclosing Exec frame
+    (its execute path acquired the buffer); falls back to the first
+    in-package caller outside memory/."""
+    import sys
+    from ..exec.base import Exec
+    f = sys._getframe(2)
+    fallback = ""
+    while f is not None:
+        self_ = f.f_locals.get("self")
+        if isinstance(self_, Exec):
+            return type(self_).__name__
+        fn = f.f_code.co_filename
+        if not fallback and "spark_rapids_tpu" in fn and \
+                "/memory/" not in fn.replace("\\", "/"):
+            fallback = f"{fn.rsplit('spark_rapids_tpu', 1)[-1].lstrip('/')}" \
+                       f":{f.f_lineno}"
+        f = f.f_back
+    return fallback or "(unknown)"
+
+
+class ShadowLedger:
+    """Event sink + lifecycle asserter + peak accountant."""
+
+    def __init__(self, strict: bool = True):
+        self.strict = strict
+        self._lock = threading.RLock()
+        self.entries: Dict[str, LedgerEntry] = {}
+        self.device_live = 0
+        self.peak_device_bytes = 0
+        self.violations: List[str] = []
+        self.arena_high_water = 0
+
+    # -- event core ---------------------------------------------------------
+    def record(self, handle_id: str, event: str, device_delta: int = 0,
+               kind: str = "spillable") -> None:
+        with self._lock:
+            entry = self.entries.get(handle_id)
+            if entry is None:
+                if event != ALLOC:
+                    # a buffer born before the ledger was installed:
+                    # nothing provable about its lifecycle — ignore
+                    return
+                entry = LedgerEntry(handle_id, kind, _owning_exec())
+                self.entries[handle_id] = entry
+            nxt = lifecycle_next(entry.state, event)
+            entry.history.append(event)
+            if nxt is None:
+                msg = (f"buffer {handle_id[:8]} (owner {entry.owner}): "
+                       f"illegal {event} in state {entry.state} "
+                       f"[history: {' -> '.join(entry.history)}]")
+                self.violations.append(msg)
+                if self.strict:
+                    raise LifecycleViolation(msg)
+                return
+            entry.state = nxt
+            if device_delta:
+                entry.device_bytes += device_delta
+                self.device_live += device_delta
+                if self.device_live > self.peak_device_bytes:
+                    self.peak_device_bytes = self.device_live
+
+    # -- spill.py hook surface ----------------------------------------------
+    def on_alloc(self, handle_id: str, nbytes: int,
+                 kind: str = "spillable") -> None:
+        self.record(handle_id, ALLOC, device_delta=nbytes, kind=kind)
+
+    def on_register(self, handle_id: str) -> None:
+        self.record(handle_id, REGISTER)
+
+    def on_pin(self, handle_id: str, nbytes: int) -> None:
+        # pin-cache entries are born and pinned in one step
+        self.record(handle_id, ALLOC, device_delta=nbytes, kind="pinned")
+        self.record(handle_id, PIN)
+
+    def on_spill(self, handle_id: str, freed_device: int) -> None:
+        self.record(handle_id, SPILL, device_delta=-freed_device)
+
+    def on_unspill(self, handle_id: str, nbytes: int) -> None:
+        self.record(handle_id, UNSPILL, device_delta=nbytes)
+
+    def on_materialize(self, handle_id: str) -> None:
+        self.record(handle_id, MATERIALIZE)
+
+    def on_close(self, handle_id: str) -> None:
+        with self._lock:
+            entry = self.entries.get(handle_id)
+            freed = entry.device_bytes if entry is not None and \
+                entry.state in DEVICE_RESIDENT else 0
+        self.record(handle_id, CLOSE, device_delta=-freed)
+
+    def on_evict(self, handle_id: str) -> None:
+        with self._lock:
+            entry = self.entries.get(handle_id)
+            freed = entry.device_bytes if entry is not None else 0
+        self.record(handle_id, EVICT, device_delta=-freed)
+
+    # -- arena hook surface --------------------------------------------------
+    def on_arena_alloc(self, arena_id: str, size: int,
+                       closed: bool) -> None:
+        with self._lock:
+            if closed:
+                msg = f"arena {arena_id[:8]}: alloc after close"
+                self.violations.append(msg)
+                if self.strict:
+                    raise LifecycleViolation(msg)
+            self.arena_high_water = max(self.arena_high_water, size)
+
+    # -- reports -------------------------------------------------------------
+    def owner_of(self, handle_id: str) -> Optional[str]:
+        entry = self.entries.get(handle_id)
+        return entry.owner if entry is not None else None
+
+    def live_entries(self, ignore_pinned: bool = True) -> List[LedgerEntry]:
+        with self._lock:
+            return [e for e in self.entries.values()
+                    if e.state not in (CLOSED, UNBORN)
+                    and not (ignore_pinned and e.kind == "pinned")]
+
+    def assert_clean(self, ignore_pinned: bool = True) -> None:
+        """Post-query check: every tracked buffer reached CLOSED (pinned
+        cache entries are sanctioned residents — evictable under
+        pressure — and excluded by default) and no violation was
+        swallowed in non-strict mode."""
+        leaks = self.live_entries(ignore_pinned)
+        problems = list(self.violations)
+        for e in leaks:
+            problems.append(
+                f"leaked buffer {e.handle_id[:8]}: owner {e.owner}, "
+                f"state {e.state}, ~{max(e.device_bytes >> 10, 1)} KiB "
+                f"device [history: {' -> '.join(e.history)}] (TPU-L015)")
+        if problems:
+            raise LifecycleViolation(
+                f"shadow ledger dirty after query "
+                f"({len(problems)} problem(s)):\n" + "\n".join(problems))
+
+
+# ---------------------------------------------------------------------------
+# installation (what spill.py/arena.py consult)
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[ShadowLedger] = None
+
+
+def install(strict: bool = True) -> ShadowLedger:
+    global _ACTIVE
+    _ACTIVE = ShadowLedger(strict=strict)
+    return _ACTIVE
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active_ledger() -> Optional[ShadowLedger]:
+    return _ACTIVE
+
+
+class installed:
+    """Context manager: ``with memsan.installed() as ledger: ...``"""
+
+    def __init__(self, strict: bool = True):
+        self.strict = strict
+        self.ledger: Optional[ShadowLedger] = None
+
+    def __enter__(self) -> ShadowLedger:
+        self.ledger = install(strict=self.strict)
+        return self.ledger
+
+    def __exit__(self, *exc):
+        uninstall()

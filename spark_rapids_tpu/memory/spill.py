@@ -32,6 +32,14 @@ import numpy as np
 from ..columnar.device import DeviceBatch
 
 
+def _ledger():
+    """The installed tmsan shadow ledger, or None (the common case —
+    the sanitizer is opt-in via spark.rapids.tpu.memsan.enabled and
+    every hook below is a no-op without it)."""
+    from . import memsan
+    return memsan.active_ledger()
+
+
 class StorageTier(Enum):
     DEVICE = 0
     HOST = 1
@@ -75,6 +83,9 @@ class SpillableBatch:
         # num_rows may be a traced device scalar; resolving it here would
         # force a sync per registered batch — defer to first read
         self._num_rows = batch.num_rows
+        led = _ledger()
+        if led is not None:
+            led.on_alloc(self.id, self.device_bytes)
 
     @property
     def num_rows(self) -> int:
@@ -91,6 +102,9 @@ class SpillableBatch:
         self._host_bytes = serialize_batch(self._batch)
         self._batch = None
         self.tier = StorageTier.HOST
+        led = _ledger()
+        if led is not None:
+            led.on_spill(self.id, self.device_bytes)
         return self.device_bytes
 
     def spill_to_disk(self):
@@ -105,10 +119,20 @@ class SpillableBatch:
         self._disk_path = path
         self._host_bytes = None
         self.tier = StorageTier.DISK
+        led = _ledger()
+        if led is not None:
+            led.on_spill(self.id, 0)  # host tier -> disk: no HBM delta
         return freed
 
     def get_batch(self, xp) -> DeviceBatch:
         """Materialize (unspilling if needed)."""
+        led = _ledger()
+        if led is not None:
+            led.on_materialize(self.id)
+        if self.closed:
+            raise RuntimeError(
+                f"SpillableBatch {self.id[:8]} materialized after close "
+                f"(use-after-close — the hazard TPU-L013 predicts)")
         if self.tier == StorageTier.DEVICE:
             b = self._batch
             if xp is not np:
@@ -131,6 +155,8 @@ class SpillableBatch:
                     pass
                 self._disk_path = None
             self.tier = StorageTier.DEVICE
+            if led is not None:
+                led.on_unspill(self.id, self.device_bytes)
             self.catalog.note_unspill(self)
         return batch
 
@@ -138,6 +164,11 @@ class SpillableBatch:
         return len(self._host_bytes) if self._host_bytes else 0
 
     def close(self):
+        if self.closed:
+            return  # idempotent, like file.close()
+        led = _ledger()
+        if led is not None:
+            led.on_close(self.id)
         self.closed = True
         self.catalog.unregister(self)
         self._batch = None
@@ -153,6 +184,12 @@ class SpillableBatch:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def _pin_handle_id(owner, key, oid: Optional[int] = None) -> str:
+    """Stable ledger handle id for one pin-cache entry (pin and evict
+    must name the same buffer)."""
+    return f"pin-{oid if oid is not None else id(owner)}-{hash(key):x}"
 
 
 class SpillCatalog:
@@ -218,6 +255,9 @@ class SpillCatalog:
     def register(self, batch: DeviceBatch,
                  priority: int = SpillPriority.ACTIVE) -> SpillableBatch:
         sb = SpillableBatch(batch, self, priority)
+        led = _ledger()
+        if led is not None:
+            led.on_register(sb.id)
         with self._reg_lock:
             self._buffers[sb.id] = sb
             if self.debug:
@@ -233,19 +273,31 @@ class SpillCatalog:
             self._created_at.pop(sb.id, None)
 
     def leak_report(self) -> List[tuple]:
-        """(id, tier, bytes, creation_stack) for every still-open
-        buffer — the debug-mode leak check (Arm.scala analog)."""
+        """(id, tier, bytes, provenance) for every still-open buffer —
+        the debug-mode leak check (Arm.scala analog).  Provenance is the
+        creation stack under spark.rapids.memory.tpu.debug; with the
+        tmsan shadow ledger installed it is prefixed with the OWNING
+        EXEC the ledger attributed the allocation to."""
+        led = _ledger()
         with self._reg_lock:
-            return [(b.id, b.tier.name, b.device_bytes,
-                     self._created_at.get(b.id, "(enable debug for "
-                     "stacks)"))
-                    for b in self._buffers.values()]
+            out = []
+            for b in self._buffers.values():
+                prov = self._created_at.get(
+                    b.id, "(enable debug for stacks)")
+                owner = led.owner_of(b.id) if led is not None else None
+                if owner:
+                    prov = f"owner={owner}\n{prov}"
+                out.append((b.id, b.tier.name, b.device_bytes, prov))
+            return out
 
     # -- pinned scan batches -------------------------------------------------
     def register_pinned(self, owner: Dict, key, batch_list) -> None:
         """Account a pin-cache entry (owner[key] = batches) against the
         device budget and make it evictable."""
         nbytes = sum(batch_device_bytes(b) for b in batch_list)
+        led = _ledger()
+        if led is not None:
+            led.on_pin(_pin_handle_id(owner, key), nbytes)
         with self._reg_lock:
             self._pinned[(id(owner), key)] = nbytes
             self._pin_owners[(id(owner), key)] = owner
@@ -257,6 +309,7 @@ class SpillCatalog:
 
     def _evict_pinned(self, target_free: int) -> int:
         freed = 0
+        led = _ledger()
         with self._reg_lock:
             for (oid, key), nbytes in list(self._pinned.items()):
                 if freed >= target_free:
@@ -264,6 +317,8 @@ class SpillCatalog:
                 owner = self._pin_owners.get((oid, key))
                 if owner is not None:
                     owner.pop(key, None)
+                if led is not None:
+                    led.on_evict(_pin_handle_id(owner, key, oid))
                 self._pinned.pop((oid, key), None)
                 self._pin_owners.pop((oid, key), None)
                 freed += nbytes

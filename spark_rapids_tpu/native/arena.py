@@ -15,9 +15,17 @@ from typing import Optional
 from . import get_lib
 
 
+def _ledger():
+    """The installed tmsan shadow ledger (no-op when disabled)."""
+    from ..memory import memsan
+    return memsan.active_ledger()
+
+
 class HostArena:
     def __init__(self, capacity: int = 64 << 20):
         self.capacity = capacity
+        self._closed = False
+        self._arena_id = f"arena-{id(self):x}"
         self._lock = threading.Lock()
         self._lib = get_lib()
         if self._lib is not None:
@@ -33,6 +41,13 @@ class HostArena:
 
     def alloc(self, size: int, align: int = 64) -> Optional[memoryview]:
         """A writable view of `size` bytes, or None when exhausted."""
+        led = _ledger()
+        if led is not None:
+            # alloc-after-close is the arena's use-after-free shape; the
+            # ledger also tracks the staging high-water mark
+            led.on_arena_alloc(
+                self._arena_id,
+                size if self._closed else self.used + size, self._closed)
         with self._lock:
             if self._arena is not None:
                 off = self._lib.tpu_arena_alloc(self._arena, size, align)
@@ -76,6 +91,7 @@ class HostArena:
         return self._n
 
     def close(self):
+        self._closed = True
         if self._arena is not None:
             self._lib.tpu_arena_destroy(self._arena)
             self._arena = None

@@ -418,6 +418,21 @@ class IciExchangeExec(Exec):
     def describe(self):
         return f"IciExchange({self.num_partitions} chips, all_to_all)"
 
+    def memory_effects(self, child_states, conf):
+        """Memoizes the whole shuffled dataset device-resident (raw, not
+        spill-managed) until release_shuffle at query end — plus the
+        all_to_all's send/recv staging while it runs."""
+        from ..analysis.lifetime import (MemoryEffects,
+                                         padded_partition_bytes)
+        if not child_states:
+            return None
+        st = child_states[0]
+        shards = max(self.num_partitions, 1)
+        whole = padded_partition_bytes(
+            st.replace(num_partitions=shards)) * shards
+        return MemoryEffects(hold=2.0 * whole, retained=whole,
+                             note="device shuffle memo")
+
     def _shards(self, ctx):
         key = ctx.uid
         with self._memo_lock:

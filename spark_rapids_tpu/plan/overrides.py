@@ -1040,7 +1040,8 @@ class TpuOverrides:
             from ..analysis.plan_lint import downgrade_hazards, lint_plan
             self.last_lint = lint_plan(converted, self.conf)
             if self.last_lint:
-                converted = downgrade_hazards(converted, self.last_lint)
+                converted = downgrade_hazards(converted, self.last_lint,
+                                              self.conf)
                 from ..analysis.diagnostics import format_diagnostics
                 lint_text = "tpulint:\n" + \
                     format_diagnostics(self.last_lint)

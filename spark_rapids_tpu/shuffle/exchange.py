@@ -51,6 +51,26 @@ class ShuffleExchangeExec(Exec):
     def describe(self):
         return f"ShuffleExchange {self.partitioning.describe()}"
 
+    def memory_effects(self, child_states, conf):
+        """The accelerated shuffle caches every map-output block in the
+        catalog (SHUFFLE priority, spill-managed) until the session
+        releases the shuffle at query end: the whole exchanged dataset
+        is retained, but bounded by the spill budget.  Blocks pad
+        per (map, reduce) pair — maps x reduces capacity buckets, not
+        one — so the model sizes a padded BLOCK and multiplies."""
+        from ..analysis.lifetime import (MemoryEffects,
+                                         padded_partition_bytes,
+                                         spill_budget)
+        if not child_states:
+            return None
+        st = child_states[0]
+        blocks = (st.num_partitions or 1) * max(self.num_partitions, 1)
+        whole = min(
+            padded_partition_bytes(st.replace(num_partitions=blocks))
+            * blocks, float(spill_budget(conf)))
+        return MemoryEffects(hold=whole, retained=whole,
+                             note="spill-managed shuffle blocks")
+
     def _map_batch(self, xp, batch: Batch, row_offset: int):
         ctx = EvalContext(xp, batch)
         pids = self.partitioning.partition_ids(xp, ctx, batch, row_offset)

@@ -3,7 +3,7 @@
     python -m spark_rapids_tpu.tools qualification <eventlogs...> [-o DIR]
     python -m spark_rapids_tpu.tools profiling     <eventlogs...> [-o DIR] [-c]
     python -m spark_rapids_tpu.tools lint --repo   [--baseline FILE]
-    python -m spark_rapids_tpu.tools lint --plan   <fixture.py...> [--infer]
+    python -m spark_rapids_tpu.tools lint --plan   <fixture.py...> [--infer] [--memsan]
 
 Lint fixtures are Python files defining ``plan_*()`` builders, each
 returning ``(exec_root, conf_dict)`` — the checked-in golden bad plans
@@ -14,7 +14,7 @@ import argparse
 import sys
 
 
-def _run_plan_lint(paths, infer=False):
+def _run_plan_lint(paths, infer=False, memsan=False):
     import runpy
 
     from ..analysis.diagnostics import format_diagnostics
@@ -40,6 +40,12 @@ def _run_plan_lint(paths, infer=False):
                 from ..analysis.interp import format_states, infer_plan
                 sys.stdout.write(format_states(root, infer_plan(root,
                                                                 conf)))
+            if memsan:
+                # print the lifetime pass's per-subtree peak-byte bounds
+                from ..analysis.lifetime import (analyze_memory,
+                                                 format_memory)
+                sys.stdout.write(format_memory(
+                    root, analyze_memory(root, conf)))
             sys.stdout.write(format_diagnostics(diags))
             any_error |= any(d.is_error for d in diags)
     return 1 if any_error else 0
@@ -97,6 +103,11 @@ def main(argv=None):
                          "interpreter's inferred per-subtree states "
                          "(schema/residency/partitioning/rows) before "
                          "the diagnostics")
+    li.add_argument("--memsan", action="store_true",
+                    help="with --plan: print the lifetime pass's "
+                         "per-subtree peak-device-byte bounds "
+                         "(hold/retained/peak vs the HBM budget) "
+                         "before the diagnostics")
     li.add_argument("--baseline", default=None,
                     help="repo-lint baseline file "
                          "(default: devtools/lint_baseline.txt)")
@@ -115,7 +126,8 @@ def main(argv=None):
                          f"{args.output}\n")
     else:
         if args.plan:
-            return _run_plan_lint(args.plan, infer=args.infer)
+            return _run_plan_lint(args.plan, infer=args.infer,
+                                  memsan=args.memsan)
         # --repo is the default lint mode
         return _run_repo_lint(args.baseline or _default_baseline(),
                               args.update_baseline)

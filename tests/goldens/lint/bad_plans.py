@@ -217,6 +217,57 @@ def plan_L011_contract_broken_by_rewrite():
     return join, {}
 
 
+def plan_L013_shared_boundary_use_after_close():
+    """A SpillBoundary whose registered handles close after ONE
+    consumption, shared by TWO union arms (the with_new_children/reuse
+    surgery duplicated a consumer without re-deriving the producer's
+    count): the second arm materializes closed buffers.  Executing this
+    raises use-after-close at runtime — and under
+    spark.rapids.tpu.memsan.enabled the shadow ledger pinpoints it with
+    owning-exec provenance; the static lifetime pass predicts it from
+    the parent count alone."""
+    from spark_rapids_tpu.exec.outofcore import SpillBoundaryExec
+    from spark_rapids_tpu.exec.basic import UnionExec
+    scan = _scan(_ints(n=16))
+    sb = SpillBoundaryExec(scan, consumers=1)
+    p1 = ProjectExec([AttributeReference("v")], sb)
+    p1.placement = eb.TPU
+    p2 = ProjectExec([AttributeReference("v")], sb)
+    p2.placement = eb.TPU
+    u = UnionExec([p1, p2])
+    u.placement = eb.TPU
+    return u, {}
+
+
+def plan_L014_peak_over_hbm_budget():
+    """An in-core sort whose ~3x working set (registered input + concat
+    + sorted copy) blows a deliberately small HBM budget: the OOM is
+    predictable from the same size model the CBO uses.  The pre-flight
+    repair forces the sort out-of-core (oc_budget) instead of
+    downgrading — see test_memsan.py."""
+    big = pa.table({"v": pa.array(range(1 << 15), type=pa.int64())})
+    scan = _scan(big, num_partitions=4)
+    s = __import__("spark_rapids_tpu.exec.sort",
+                   fromlist=["SortExec"]).SortExec(
+        [(AttributeReference("v"), True, True)], scan, is_global=False)
+    s.placement = eb.TPU
+    return s, {"spark.rapids.tpu.memsan.hbmBudgetBytes": "256k"}
+
+
+def plan_L015_boundary_never_closes():
+    """A SpillBoundary declaring TWO consumers in a plan with only one
+    parent (the rewrite that UN-shared the subtree forgot the count):
+    the close never fires and the registered device buffers survive the
+    query — the plan-level leak class the SpillCatalog leak tracker
+    would only report after the damage."""
+    from spark_rapids_tpu.exec.outofcore import SpillBoundaryExec
+    scan = _scan(_ints(n=16))
+    sb = SpillBoundaryExec(scan, consumers=2)
+    p = ProjectExec([AttributeReference("v")], sb)
+    p.placement = eb.TPU
+    return p, {}
+
+
 def plan_L012_residency_ping_pong():
     """Two separate host islands inside one device pipeline: batches
     already resident on device cross down to host and back up TWICE

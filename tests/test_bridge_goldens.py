@@ -104,6 +104,31 @@ def test_shuffled_join_diff_keys_golden():
     assert got.column("w").to_pylist() == want_w
 
 
+def test_shuffled_join_same_keys_golden():
+    """The common same-name equi join (`df.join(dim, on="k")`): the
+    bridge emits the engine's coalescing "on" join plus a projection
+    that restores Spark's duplicated key columns — exact for inner
+    joins because both sides' key values agree on every surviving
+    row."""
+    spec = _load("shuffled_join_same_keys")
+    fact = pa.table({
+        "k": pa.array(np.arange(100, dtype=np.int64) % 20),
+        "x": pa.array(np.arange(100, dtype=np.int64))})
+    dim = pa.table({
+        "k": pa.array(np.arange(15, dtype=np.int64)),
+        "w": pa.array((np.arange(15, dtype=np.int64) * 10))})
+    got = _run(spec, fact, (dim,)).sort_by(
+        [("x", "ascending")])
+    # Spark's join-node schema: left.output ++ right.output, key twice
+    assert got.schema.names == ["k", "x", "k", "w"]
+    keep = [i for i in range(100) if i % 20 < 15]
+    assert got.column("x").to_pylist() == keep
+    assert got.column(0).to_pylist() == [i % 20 for i in keep]
+    # the restored right-side key equals the left key on every row
+    assert got.column(2).to_pylist() == got.column(0).to_pylist()
+    assert got.column("w").to_pylist() == [(i % 20) * 10 for i in keep]
+
+
 def test_string_datetime_cast_golden():
     import datetime
     spec = _load("string_datetime_cast")

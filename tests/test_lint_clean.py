@@ -55,6 +55,28 @@ def test_run_lint_interp_gate_exits_zero():
     assert "gate clean" in proc.stdout, proc.stdout
 
 
+def test_run_lint_memsan_gate_exits_zero():
+    """Tier-1 gate for tmsan: every golden good plan replays under the
+    shadow ledger with measured peak device bytes <= the static
+    TPU-L014 bound and a clean ledger afterwards; the memory hazard
+    fixtures (L013/L014/L015) each produce their diagnostic."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "devtools", "run_lint.py"),
+         "--memsan"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "memsan gate clean" in proc.stdout, proc.stdout
+
+
+def test_baseline_is_empty_and_stays_empty():
+    """PR-3 burned the last baselined TPU-R001 debt down to zero: the
+    ratchet now enforces a spotless repo (deliberate exceptions are
+    `tpulint: allow[...]` annotations in place, not baseline lines)."""
+    from spark_rapids_tpu.analysis.repo_lint import load_baseline
+    assert load_baseline(BASELINE) == set()
+
+
 def test_lint_cli_plan_mode_flags_goldens():
     proc = subprocess.run(
         [sys.executable, "-m", "spark_rapids_tpu.tools", "lint",

@@ -1,0 +1,338 @@
+"""tmsan: the buffer-lifetime/peak-HBM analyzer differentially validated
+against the runtime shadow ledger.
+
+Three layers, mirroring the typechecker's oracle discipline
+(test_interp_oracle.py):
+
+  * differential — every golden good plan executes with the shadow
+    ledger installed: measured peak device bytes <= the static TPU-L014
+    bound, ledger clean (no leaks, no lifecycle violations) afterwards;
+  * anti-vacuity — an injected leak, an injected use-after-close and an
+    over-budget plan each produce their diagnostic (L015, L013, L014),
+    statically AND at runtime, so a green gate is evidence;
+  * repair — the TPU-L014 pre-flight forces the sort out-of-core
+    (oc_budget) instead of downgrading, the repaired plan re-lints
+    clean, still computes the right answer, and its measured peak
+    respects the new bound.
+"""
+
+import importlib.util
+import os
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.analysis import lifetime
+from spark_rapids_tpu.analysis.lifetime import (ALLOCATED, CLOSE, CLOSED,
+                                                MATERIALIZE, REGISTER,
+                                                REGISTERED, SPILL, UNBORN,
+                                                analyze_memory,
+                                                format_memory,
+                                                lifecycle_next)
+from spark_rapids_tpu.analysis.plan_lint import (downgrade_hazards,
+                                                 lint_plan)
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.exec import base as eb
+from spark_rapids_tpu.memory import memsan
+from spark_rapids_tpu.memory.spill import SpillCatalog
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "goldens", "lint")
+
+
+def _load(fname):
+    spec = importlib.util.spec_from_file_location(
+        fname.replace(".py", ""), os.path.join(GOLDEN_DIR, fname))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return {k: getattr(mod, k) for k in dir(mod) if k.startswith("plan_")}
+
+
+GOOD = sorted(_load("good_plans.py"))
+
+
+@pytest.fixture()
+def fresh_catalog():
+    with SpillCatalog._lock:
+        old = SpillCatalog._instance
+        SpillCatalog._instance = SpillCatalog()
+    yield SpillCatalog._instance
+    with SpillCatalog._lock:
+        SpillCatalog._instance = old
+
+
+def _release_plan(root):
+    ids = []
+    root.foreach(lambda e: ids.append(e._shuffle_id)
+                 if getattr(e, "_shuffle_id", None) is not None else None)
+    if ids:
+        from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+        mgr = TpuShuffleManager.get()
+        for sid in ids:
+            mgr.unregister(sid)
+    root.foreach(lambda e: e.release_shuffle()
+                 if hasattr(e, "release_shuffle") else None)
+
+
+# ---------------------------------------------------------------------------
+# the lifecycle state machine itself
+# ---------------------------------------------------------------------------
+
+def test_lifecycle_machine_legal_paths():
+    assert lifecycle_next(UNBORN, "alloc") == ALLOCATED
+    assert lifecycle_next(ALLOCATED, REGISTER) == REGISTERED
+    assert lifecycle_next(REGISTERED, SPILL) == "spilled"
+    assert lifecycle_next("spilled", "unspill") == REGISTERED
+    assert lifecycle_next(REGISTERED, CLOSE) == CLOSED
+
+
+def test_lifecycle_machine_rejects_hazards():
+    # use-after-close and register-after-close are not transitions
+    assert lifecycle_next(CLOSED, MATERIALIZE) is None
+    assert lifecycle_next(CLOSED, REGISTER) is None
+    # an unregistered buffer cannot spill (nothing manages it)
+    assert lifecycle_next(ALLOCATED, SPILL) is None
+
+
+# ---------------------------------------------------------------------------
+# differential: measured peak <= static bound, clean ledger, good corpus
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", GOOD)
+def test_measured_peak_within_static_bound(name, fresh_catalog):
+    root, conf_map = _load("good_plans.py")[name]()
+    conf = RapidsConf(conf_map)
+    res = analyze_memory(root, conf)
+    bound = res.bound(root)
+    assert bound is not None and not res.diags, format_memory(root, res)
+    with memsan.installed() as ledger:
+        ctx = eb.ExecContext(conf)
+        ctx.task_context["no_speculation"] = True
+        root.execute_collect(ctx)
+        _release_plan(root)
+        assert ledger.peak_device_bytes <= bound, (
+            f"{name}: measured {ledger.peak_device_bytes} > bound "
+            f"{int(bound)}\n" + format_memory(root, res))
+        ledger.assert_clean()
+
+
+# ---------------------------------------------------------------------------
+# anti-vacuity: injections ARE caught (runtime + static)
+# ---------------------------------------------------------------------------
+
+def _one_batch(xp=None):
+    import numpy as np
+    from spark_rapids_tpu.columnar.device import batch_to_device
+    rb = pa.RecordBatch.from_pydict(
+        {"v": pa.array(range(64), type=pa.int64())})
+    return batch_to_device(rb, xp=xp or np)
+
+
+def test_injected_leak_is_caught(fresh_catalog):
+    with memsan.installed() as ledger:
+        sb = fresh_catalog.register(_one_batch())
+        with pytest.raises(memsan.LifecycleViolation) as ei:
+            ledger.assert_clean()
+        assert "leaked buffer" in str(ei.value)
+        assert "TPU-L015" in str(ei.value)
+        sb.close()
+        ledger.assert_clean()  # closing resolves the leak
+
+
+def test_injected_use_after_close_is_caught(fresh_catalog):
+    import numpy as np
+    with memsan.installed():
+        sb = fresh_catalog.register(_one_batch())
+        sb.close()
+        with pytest.raises(memsan.LifecycleViolation) as ei:
+            sb.get_batch(np)
+        assert "illegal materialize in state closed" in str(ei.value)
+
+
+def test_use_after_close_guarded_even_without_ledger(fresh_catalog):
+    """The engine itself now refuses (previously it returned None
+    silently); the ledger adds provenance on top."""
+    import numpy as np
+    sb = fresh_catalog.register(_one_batch())
+    sb.close()
+    with pytest.raises(RuntimeError, match="use-after-close"):
+        sb.get_batch(np)
+
+
+def test_injected_double_spill_accounting(fresh_catalog):
+    """Spill and unspill keep the ledger's device accounting exact."""
+    with memsan.installed() as ledger:
+        sb = fresh_catalog.register(_one_batch())
+        live0 = ledger.device_live
+        assert live0 >= sb.device_bytes
+        sb.spill_to_host()
+        assert ledger.device_live == live0 - sb.device_bytes
+        sb.spill_to_disk()  # host->disk: no device delta
+        assert ledger.device_live == live0 - sb.device_bytes
+        sb.close()
+        ledger.assert_clean()
+
+
+def test_ledger_attributes_owner_exec(fresh_catalog):
+    """Buffers registered inside an Exec's execute path carry the exec's
+    name in the ledger and in leak_report()."""
+    from spark_rapids_tpu.exec.outofcore import SpillBoundaryExec
+    from spark_rapids_tpu.exec.basic import LocalScanExec
+    scan = LocalScanExec(pa.table(
+        {"v": pa.array(range(32), type=pa.int64())}))
+    sb = SpillBoundaryExec(scan, consumers=2)  # never fully consumed
+    with memsan.installed() as ledger:
+        ctx = eb.ExecContext(RapidsConf({}))
+        list(sb.execute_partition(0, ctx))
+        leaks = ledger.live_entries()
+        assert leaks and all(e.owner == "SpillBoundaryExec"
+                             for e in leaks)
+        assert any("owner=SpillBoundaryExec" in prov
+                   for _i, _t, _b, prov in fresh_catalog.leak_report())
+        with pytest.raises(memsan.LifecycleViolation,
+                           match="SpillBoundaryExec"):
+            ledger.assert_clean()
+
+
+def test_runtime_use_after_close_on_shared_boundary(fresh_catalog):
+    """Executing the L013 fixture really does materialize closed
+    handles: the static prediction and the runtime agree."""
+    root, conf_map = _load("bad_plans.py")[
+        "plan_L013_shared_boundary_use_after_close"]()
+    with memsan.installed():
+        ctx = eb.ExecContext(RapidsConf(conf_map))
+        with pytest.raises(memsan.LifecycleViolation):
+            root.execute_collect(ctx)
+
+
+def test_arena_alloc_after_close_is_caught():
+    from spark_rapids_tpu.native.arena import HostArena
+    arena = HostArena(1 << 16)
+    with memsan.installed() as ledger:
+        arena.alloc(128)
+        assert ledger.arena_high_water >= 128
+        arena.close()
+        with pytest.raises(memsan.LifecycleViolation,
+                           match="alloc after close"):
+            arena.alloc(64)
+
+
+# ---------------------------------------------------------------------------
+# static rules over the bad fixtures (the plan-level injections)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name,code", [
+    ("plan_L013_shared_boundary_use_after_close", "TPU-L013"),
+    ("plan_L014_peak_over_hbm_budget", "TPU-L014"),
+    ("plan_L015_boundary_never_closes", "TPU-L015"),
+])
+def test_memory_fixture_flags_its_code(name, code):
+    root, conf_map = _load("bad_plans.py")[name]()
+    diags = lint_plan(root, RapidsConf(conf_map), infer=True)
+    assert code in {d.code for d in diags}, [d.render() for d in diags]
+
+
+def test_l014_vanishes_when_budget_fits():
+    """The same plan under a roomy budget is admitted — the rule is
+    driven by the bound, not the shape."""
+    root, conf_map = _load("bad_plans.py")[
+        "plan_L014_peak_over_hbm_budget"]()
+    conf = RapidsConf(dict(
+        conf_map, **{"spark.rapids.tpu.memsan.hbmBudgetBytes": "1g"}))
+    assert not [d for d in lint_plan(root, conf, infer=True)
+                if d.code == "TPU-L014"]
+
+
+# ---------------------------------------------------------------------------
+# the TPU-L014 repair: forced out-of-core, correct results, bounded peak
+# ---------------------------------------------------------------------------
+
+def test_l014_repair_forces_out_of_core_and_stays_correct(fresh_catalog):
+    root, conf_map = _load("bad_plans.py")[
+        "plan_L014_peak_over_hbm_budget"]()
+    conf = RapidsConf(conf_map)
+    diags = lint_plan(root, conf, infer=True)
+    assert any(d.code == "TPU-L014" for d in diags)
+    fixed = downgrade_hazards(root, diags, conf)
+    # repaired in place: still on device, out-of-core forced
+    assert fixed.placement == eb.TPU and fixed.oc_budget is not None
+    assert not [d for d in lint_plan(fixed, conf, infer=True)
+                if d.is_error]
+    bound = analyze_memory(fixed, conf).bound(fixed)
+    with memsan.installed() as ledger:
+        ctx = eb.ExecContext(conf)
+        ctx.task_context["no_speculation"] = True
+        out = fixed.execute_collect(ctx)
+        assert ledger.peak_device_bytes <= bound
+        ledger.assert_clean()
+    col = out.column("v").to_pylist()
+    assert len(col) == 1 << 15  # nothing lost to the forced spilling
+    # per-partition (non-global) sort: each partition is ordered
+    assert sorted(col) == list(range(1 << 15))
+
+
+def test_repair_sizes_budget_under_hbm_limit():
+    root, conf_map = _load("bad_plans.py")[
+        "plan_L014_peak_over_hbm_budget"]()
+    conf = RapidsConf(conf_map)
+    assert lifetime.try_outofcore_repair(root, root, conf)
+    assert root.oc_budget is not None
+    res = analyze_memory(root, conf)
+    assert res.bound(root) <= res.budget and not res.diags
+
+
+# ---------------------------------------------------------------------------
+# session wiring: spark.rapids.tpu.memsan.enabled
+# ---------------------------------------------------------------------------
+
+def test_session_memsan_clean_query(fresh_catalog):
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.api.column import col
+    s = (TpuSession.builder()
+         .config("spark.rapids.sql.explain", "NONE")
+         .config("spark.rapids.tpu.memsan.enabled", True)
+         .get_or_create())
+    tb = pa.table({"k": pa.array([i % 3 for i in range(30)],
+                                 type=pa.int64()),
+                   "v": pa.array(range(30), type=pa.int64())})
+    df = s.create_dataframe(tb, num_partitions=2)
+    out = df.sort(col("v"), ascending=False).collect()
+    assert out.column("v").to_pylist()[0] == 29
+    assert memsan.active_ledger() is None  # uninstalled after the query
+
+
+# ---------------------------------------------------------------------------
+# TPU-R005 anti-vacuity: the AST rule sees an unrouted allocation
+# ---------------------------------------------------------------------------
+
+def test_r005_flags_unrouted_device_allocation(tmp_path):
+    import ast
+    from spark_rapids_tpu.analysis.repo_lint import _DeviceAllocVisitor
+    src = (
+        "def bad(batch, catalog):\n"
+        "    sb = SpillableBatch(batch, catalog)\n"
+        "    up = jax.device_put(batch)\n"
+        "    arena = HostArena(1 << 20)\n"
+        "    ok = catalog.register(batch)\n")
+    v = _DeviceAllocVisitor("spark_rapids_tpu/exec/fake.py")
+    v.visit(ast.parse(src))
+    msgs = [d.message for d in v.diags]
+    assert len(msgs) == 3, msgs
+    assert any("SpillableBatch" in m for m in msgs)
+    assert any("device_put" in m for m in msgs)
+    assert any("HostArena" in m for m in msgs)
+    assert all(d.code == "TPU-R005" for d in v.diags)
+
+
+def test_allow_annotation_sanctions_single_site(tmp_path):
+    """`# tpulint: allow[...]` suppresses exactly the annotated line."""
+    from spark_rapids_tpu.analysis.repo_lint import _allowed_lines
+    src = ("x = 1\n"
+           "# tpulint: allow[TPU-R001] reason\n"
+           "# continued reason\n"
+           "np.asarray(y)\n"
+           "np.asarray(z)\n")
+    allowed = _allowed_lines(src)
+    assert 4 in allowed["TPU-R001"]      # the annotated call
+    assert 5 not in allowed["TPU-R001"]  # the next one still flags
