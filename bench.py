@@ -124,12 +124,14 @@ def queries(session, fact, dim, pq_path, out_root):
 
 
 def time_engine(enabled: bool, fact, dim, pq_path, out_root,
-                repeats: int = 3):
+                repeats: int = 3, trace: bool = False):
     from spark_rapids_tpu.api.session import TpuSession
     extra = {}
     if enabled and os.environ.get("BENCH_TRANSPORT"):
         extra["spark.rapids.shuffle.transport"] = \
             os.environ["BENCH_TRANSPORT"]
+    if trace:
+        extra["spark.rapids.tpu.trace.enabled"] = True
     b = TpuSession.builder().config("spark.rapids.sql.enabled", enabled)
     for k, v in extra.items():
         b = b.config(k, v)
@@ -304,10 +306,24 @@ def _device_reachable(timeout_s: float = 180.0) -> bool:
     return bool(ok)
 
 
+def measure_trace_overhead(fact, dim, pq_path, out_root) -> float:
+    """Flight-recorder overhead guard: the suite with tracing on vs off
+    (same session config otherwise).  Returns overhead as a percentage
+    of the untraced total — the observability acceptance bar is <5% on
+    these golden queries (tracing is per-partition spans + deferred
+    scalars, never a hot-path sync, so the budget holds with room)."""
+    plain, _ = time_engine(True, fact, dim, pq_path, out_root)
+    traced, _ = time_engine(True, fact, dim, pq_path, out_root,
+                            trace=True)
+    base = sum(plain.values())
+    return 100.0 * (sum(traced.values()) - base) / base
+
+
 def main():
     pos = [a for a in sys.argv[1:] if not a.startswith("--")]
     n_rows = int(pos[0]) if pos else 1_000_000
     with_pyspark = "--baseline=pyspark" in sys.argv[1:]
+    with_trace_guard = "--trace-overhead" in sys.argv[1:]
     if not _device_reachable():
         print(json.dumps({
             "metric": "sql_suite_rows_per_sec", "value": 0.0,
@@ -319,12 +335,16 @@ def main():
     fact, dim = make_tables(n_rows)
     root = tempfile.mkdtemp(prefix="spark_rapids_tpu_bench_")
     spark_cpu = None
+    trace_overhead = None
     try:
         pq_path = write_parquet_input(fact, root)
         tpu, tpu_compile = time_engine(True, fact, dim, pq_path, root)
         cpu, _ = time_engine(False, fact, dim, pq_path, root)
         if with_pyspark:
             spark_cpu = time_pyspark(fact, dim, pq_path, root)
+        if with_trace_guard:
+            trace_overhead = measure_trace_overhead(fact, dim, pq_path,
+                                                    root)
     finally:
         shutil.rmtree(root, ignore_errors=True)
     tpu_total = sum(tpu.values())
@@ -357,7 +377,13 @@ def main():
                 sum(spark_cpu.values()) / tpu_total, 3)
             for k in detail:
                 detail[k]["spark_cpu_s"] = round(spark_cpu[k], 3)
+    if trace_overhead is not None:
+        out["trace_overhead_pct"] = round(trace_overhead, 2)
     print(json.dumps(out))
+    if trace_overhead is not None and trace_overhead > 5.0:
+        print(f"TRACE OVERHEAD GUARD FAILED: {trace_overhead:.2f}% > 5%",
+              file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -26,10 +26,17 @@ device bytes at or under the static TPU-L014 bound, (b) leave a clean
 ledger (no leaks, no lifecycle violations); the memory bad-plan
 fixtures (L013/L014/L015) must each trip their code.
 
+--obs runs the flight-recorder gate: one golden query executes with
+tracing + the self-emitted event log enabled and the gate fails on
+unclosed spans, unflushed event logs, event-log lines the parser
+rejects, or a round-trip mismatch (parsed operator aggregates !=
+live last_query_metrics).
+
     python devtools/run_lint.py                    # repo check
     python devtools/run_lint.py --update-baseline  # re-freeze debt
     python devtools/run_lint.py --interp           # plan typechecker gate
     python devtools/run_lint.py --memsan           # lifetime + ledger gate
+    python devtools/run_lint.py --obs              # flight-recorder gate
 """
 
 import json
@@ -172,12 +179,106 @@ def run_memsan_gate() -> int:
     return 0
 
 
+def run_obs_gate() -> int:
+    """Flight-recorder gate: replay one golden query with tracing and
+    the self-emitted event log on; fail on unclosed spans, an unflushed
+    or unparsable log, or live-vs-parsed aggregate drift."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.session import TpuSession, last_query_metrics
+    from spark_rapids_tpu.tools.eventlog import parse_event_log
+    from spark_rapids_tpu.tools.profiling import (accuracy_report,
+                                                  operator_metrics)
+
+    failures = 0
+    tmp = tempfile.mkdtemp(prefix="obs_gate_")
+    try:
+        s = (TpuSession.builder()
+             .config("spark.rapids.sql.enabled", True)
+             .config("spark.rapids.tpu.eventLog.dir", tmp)
+             .config("spark.rapids.tpu.trace.enabled", True)
+             .get_or_create())
+        tb = pa.table({
+            "k": pa.array((np.arange(500) % 11).astype(np.int64)),
+            "v": pa.array(np.arange(500, dtype=np.int64))})
+        out = (s.create_dataframe(tb, num_partitions=2)
+               .filter(col("v") > 5).group_by(col("k"))
+               .agg(F.sum(col("v")).alias("sv"),
+                    F.count("*").alias("c"))
+               .collect())
+        assert out.num_rows == 11
+        trace = s.last_query_trace()
+        if trace is None or not trace.sealed:
+            failures += 1
+            print("OBS: query left no sealed trace")
+        elif trace.open_span_count():
+            failures += 1
+            print(f"OBS: {trace.open_span_count()} unclosed span(s)")
+        logs = [f for f in os.listdir(tmp) if f.startswith("events_")]
+        if not logs:
+            failures += 1
+            print("OBS: no event log flushed")
+            return 1
+        path = os.path.join(tmp, logs[0])
+        rejected = 0
+        with open(path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    json.loads(line)
+                except json.JSONDecodeError:
+                    rejected += 1
+        if rejected:
+            failures += 1
+            print(f"OBS: {rejected} event-log line(s) the parser "
+                  f"rejects")
+        app = parse_event_log(path)
+        if 0 not in app.sql_executions or \
+                app.sql_executions[0].end_time is None:
+            failures += 1
+            print("OBS: SQL execution missing or never ended in the "
+                  "parsed log")
+        parsed = operator_metrics(app, 0, "DEBUG")
+        live = [tuple(r) for r in last_query_metrics(s, "DEBUG")]
+        if parsed != live:
+            failures += 1
+            print(f"OBS: round-trip drift — parsed {len(parsed)} "
+                  f"operator metric(s), live {len(live)}")
+            for a, b in zip(parsed, live):
+                if a != b:
+                    print(f"  parsed {a} != live {b}")
+        if not accuracy_report(app):
+            failures += 1
+            print("OBS: no predicted-vs-actual rows in the emitted "
+                  "plan")
+        if not app.spans:
+            failures += 1
+            print("OBS: no flight-recorder span records in the log")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    if failures:
+        print(f"obs gate: {failures} failure(s)")
+        return 1
+    print("obs gate clean (1 golden query traced, logged, re-parsed "
+          "and matched against live metrics)")
+    return 0
+
+
 def main(argv=None):
     args = argv if argv is not None else sys.argv[1:]
     if "--interp" in args:
         return run_interp_gate()
     if "--memsan" in args:
         return run_memsan_gate()
+    if "--obs" in args:
+        return run_obs_gate()
     from spark_rapids_tpu.tools.__main__ import main as tools_main
     cli = ["lint", "--repo", "--baseline", BASELINE]
     if "--update-baseline" in args:

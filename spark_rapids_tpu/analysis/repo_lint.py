@@ -18,6 +18,10 @@
             catalog/arena APIs (SpillCatalog.register, batch_to_device,
             the shared staging arena) — an unrouted buffer is invisible
             to spill pressure, leak_report and the tmsan ledger
+  TPU-R006  raw time.perf_counter*/TraceAnnotation in exec/, ops/,
+            shuffle/, parallel/ must route through MetricTimer or the
+            obs/ flight recorder (one timing path for metrics, traces
+            and the self-emitted event log)
 
 Pre-existing violations live in a checked-in baseline
 (devtools/lint_baseline.txt, fingerprint per line); devtools/run_lint.py
@@ -60,6 +64,15 @@ R004 = register_rule(
     "a dtype its runtime kernel raises on — plans pass planning and "
     "crash mid-query.  Tighten the gate or extend the kernel.")
 
+R006 = register_rule(
+    "TPU-R006", ERROR, "raw timing primitive outside MetricTimer/tracer",
+    "time.perf_counter/perf_counter_ns or jax.profiler.TraceAnnotation "
+    "used directly in exec/, ops/, shuffle/ or parallel/: operator "
+    "timing must route through MetricTimer (which owns the sanctioned "
+    "clock reads and the NVTX-analog annotation) or the obs/ flight "
+    "recorder, so the engine has ONE timing path that metrics, traces "
+    "and the self-emitted event log all agree on.")
+
 R005 = register_rule(
     "TPU-R005", ERROR, "device allocation outside the catalog/arena APIs",
     "Code in exec/ or ops/ constructs a SpillableBatch directly, calls "
@@ -74,6 +87,10 @@ R005 = register_rule(
 # hot-path packages for TPU-R001/R005 (module-relative, forward slashes)
 _HOT_PATHS = ("spark_rapids_tpu/exec/", "spark_rapids_tpu/ops/")
 _SYNC_RECEIVERS = {"asarray": {"np", "numpy"}, "device_get": {"jax"}}
+# one-timing-path packages for TPU-R006 (everywhere operator work runs)
+_TIMING_PATHS = ("spark_rapids_tpu/exec/", "spark_rapids_tpu/ops/",
+                 "spark_rapids_tpu/shuffle/", "spark_rapids_tpu/parallel/")
+_TIMING_CALLS = {"perf_counter", "perf_counter_ns"}
 
 # `# tpulint: allow[TPU-Rxxx] <reason>` on the flagged line or the line
 # above sanctions one deliberate violation (the annotated-sink analog of
@@ -197,6 +214,36 @@ class _DeviceAllocVisitor(_ScopedVisitor):
         self.generic_visit(node)
 
 
+class _TimingVisitor(_ScopedVisitor):
+    """TPU-R006: raw clock reads / profiler annotations in the operator
+    packages that bypass the single timing path (MetricTimer + the
+    obs/ tracer)."""
+
+    def __init__(self, relpath: str):
+        super().__init__()
+        self.relpath = relpath
+        self.diags: List[Diagnostic] = []
+
+    def visit_Call(self, node):
+        f = node.func
+        call = None
+        if isinstance(f, ast.Attribute) and f.attr in _TIMING_CALLS and \
+                isinstance(f.value, ast.Name) and \
+                f.value.id.lstrip("_") == "time":
+            call = f"time.{f.attr}"
+        elif isinstance(f, ast.Name) and f.id == "TraceAnnotation":
+            call = "TraceAnnotation(...)"
+        elif isinstance(f, ast.Attribute) and \
+                f.attr == "TraceAnnotation":
+            call = "TraceAnnotation(...)"
+        if call is not None:
+            self.diags.append(R006.diag(
+                f"raw timing primitive {call} in {self.scope}; route "
+                f"through MetricTimer or the obs/ tracer",
+                loc=f"{self.relpath}:{node.lineno}"))
+        self.generic_visit(node)
+
+
 class _EnvReadVisitor(_ScopedVisitor):
     def __init__(self, relpath: str, declared: Set[str]):
         super().__init__()
@@ -256,6 +303,10 @@ def _ast_diagnostics(root: str) -> List[Diagnostic]:
             dv = _DeviceAllocVisitor(relpath)
             dv.visit(tree)
             file_diags.extend(dv.diags)
+        if any(relpath.startswith(h) for h in _TIMING_PATHS):
+            tv = _TimingVisitor(relpath)
+            tv.visit(tree)
+            file_diags.extend(tv.diags)
         ev = _EnvReadVisitor(relpath, declared)
         ev.visit(tree)
         file_diags.extend(ev.diags)

@@ -34,6 +34,11 @@ class TpuSession:
         self._conf_map = dict(conf or {})
         self.last_plan = None
         self.last_explain = ""
+        # flight recorder (obs/): per-query trace + self-emitted event log
+        self._last_trace = None
+        self._obs_plan = None
+        self._obs_writer = None
+        self._sql_counter = 0
         self._init_runtime()
         TpuSession._active = self
 
@@ -161,6 +166,7 @@ class TpuSession:
         never run device work (ref explain stays driver-side)."""
         from ..expr.subquery import (has_scalar_subquery,
                                      resolve_scalar_subqueries)
+        from ..obs.tracer import trace_span
         from ..shims import set_active_shim
         # queries are evaluated sequentially per process; installing the
         # dialect per execution keeps interleaved sessions with different
@@ -170,13 +176,17 @@ class TpuSession:
         if has_scalar_subquery(lp):
             # subqueries run first, driver-side, and substitute as typed
             # literals (ref GpuScalarSubquery / ExecSubqueryExpression)
-            lp = resolve_scalar_subqueries(lp, self,
-                                           execute=run_subqueries)
-        physical = plan_physical(lp, self.conf)
-        from ..plan.planner import force_perfile_if_input_file
-        force_perfile_if_input_file(physical)
-        overrides = TpuOverrides(self.conf)
-        final_plan = overrides.apply(physical)
+            with trace_span("phase:subqueries", kind="phase"):
+                lp = resolve_scalar_subqueries(lp, self,
+                                               execute=run_subqueries)
+        with trace_span("phase:planning", kind="phase"):
+            physical = plan_physical(lp, self.conf)
+            from ..plan.planner import force_perfile_if_input_file
+            force_perfile_if_input_file(physical)
+        with trace_span("phase:overrides", kind="phase") as sp:
+            overrides = TpuOverrides(self.conf)
+            final_plan = overrides.apply(physical)
+            sp.set(lint_diags=len(getattr(overrides, "last_lint", [])))
         self.last_plan = final_plan
         self.last_explain = overrides.last_explain
         return final_plan
@@ -201,11 +211,44 @@ class TpuSession:
             if hasattr(e, "release_shuffle") else None)
 
     def execute(self, lp: L.LogicalPlan) -> pa.Table:
+        from ..obs import tracer as obs
+        conf = self.conf
+        eventlog_dir = conf.get(cfg.EVENT_LOG_DIR)
+        tracing = conf.get(cfg.TRACE_ENABLED) or eventlog_dir is not None
+        if not tracing:
+            return self._execute_query(lp, None, None)
+        # flight recorder: one QueryTrace per execute(); the installed
+        # tracer is what every instrumented layer (operator spans,
+        # spill/shuffle/ICI/bridge events) records into
+        tracer = obs.QueryTrace(max_spans=conf.get(cfg.TRACE_MAX_SPANS))
+        obs.install(tracer)
+        self._last_trace = tracer
+        self._obs_plan = None
+        try:
+            return self._execute_query(lp, tracer, eventlog_dir)
+        except BaseException as ex:
+            # failed queries flush too: spans close with the exception
+            # recorded, the event log gets a JobFailed group
+            self._flush_query_obs(tracer, ex, eventlog_dir)
+            raise
+        finally:
+            obs.uninstall()
+
+    def _execute_query(self, lp: L.LogicalPlan, tracer,
+                       eventlog_dir) -> pa.Table:
+        from ..obs.tracer import trace_span
         from ..plan.host_assist import try_host_assisted_collect
-        assisted = try_host_assisted_collect(self, lp)
+        with trace_span("phase:host_assist", kind="phase"):
+            assisted = try_host_assisted_collect(self, lp)
         if assisted is not None:
+            if tracer is not None:
+                tracer.finalize()
+                tracer._flush_done = True  # no plan ran: nothing to log
             return assisted
-        final_plan = self.prepare_plan(lp)
+        with trace_span("phase:plan", kind="phase"):
+            final_plan = self.prepare_plan(lp)
+        self._obs_plan = final_plan
+        self._install_predictions(tracer, final_plan)
         from ..plugin import ExecutionPlanCaptureCallback
         ExecutionPlanCaptureCallback.on_plan(final_plan)
         ctx = ExecContext(self.conf)
@@ -224,7 +267,8 @@ class TpuSession:
             before = {b_id for b_id, *_ in cat.leak_report()}
         try:
             try:
-                result = final_plan.execute_collect(ctx)
+                with trace_span("phase:execute", kind="phase"):
+                    result = final_plan.execute_collect(ctx)
             except SpeculativeSizingMiss:
                 # a capacity guess undershot (guard came back false):
                 # nothing was surfaced — but any cache materialization
@@ -238,11 +282,20 @@ class TpuSession:
                         node.entry.partitions = []
                         node.entry.schema = None
                 final_plan.foreach(_reset_cache)
+                if tracer is not None:
+                    # abandoned generators never see the exception:
+                    # close their spans now so the re-execution starts
+                    # from a consistent trace
+                    tracer.interrupt("speculation-miss")
                 self.release_plan_shuffles(final_plan)
-                final_plan = self.prepare_plan(lp)
+                with trace_span("phase:plan-retry", kind="phase"):
+                    final_plan = self.prepare_plan(lp)
+                self._obs_plan = final_plan
+                self._install_predictions(tracer, final_plan)
                 ctx = ExecContext(self.conf)
                 ctx.task_context["no_speculation"] = True
-                result = final_plan.execute_collect(ctx)
+                with trace_span("phase:execute-retry", kind="phase"):
+                    result = final_plan.execute_collect(ctx)
         except BaseException:
             # an aborted query routinely strands buffers; the original
             # error must surface, not a misleading leak report
@@ -250,6 +303,9 @@ class TpuSession:
             if debug:
                 cat.debug = False
             if memsan_on:
+                if tracer is not None:
+                    tracer.measured_peak_device_bytes = \
+                        ledger.peak_device_bytes
                 memsan.uninstall()
             raise
         self.release_plan_shuffles(final_plan)
@@ -260,6 +316,9 @@ class TpuSession:
                 # leaks surface with owning-exec provenance
                 ledger.assert_clean()
             finally:
+                if tracer is not None:
+                    tracer.measured_peak_device_bytes = \
+                        ledger.peak_device_bytes
                 memsan.uninstall()
         if debug:
             leaks = [l for l in cat.leak_report() if l[0] not in before]
@@ -271,7 +330,92 @@ class TpuSession:
                 raise RuntimeError(
                     f"query leaked {len(leaks)} spillable "
                     f"buffer(s) (memory.tpu.debug):\n{detail}")
+        if tracer is not None:
+            self._flush_query_obs(tracer, None, eventlog_dir)
         return result
+
+    # -- flight recorder ----------------------------------------------------
+    def last_query_trace(self):
+        """The obs.QueryTrace of the last traced query (None when both
+        spark.rapids.tpu.trace.enabled and eventLog.dir were unset)."""
+        return self._last_trace
+
+    def _install_predictions(self, tracer, final_plan) -> None:
+        """Attach the CBO/interp row+byte model and tmsan's static
+        peak-HBM bound to the trace, keyed by plan node — actuals are
+        recorded at span close and the pair feeds `tools profile
+        --accuracy` (the feedback signal for CBO tuning)."""
+        if tracer is None:
+            return
+        try:
+            from ..analysis.interp import infer_plan
+            from ..analysis.lifetime import analyze_memory, total_bytes
+            interp = infer_plan(final_plan, self.conf)
+            mem = analyze_memory(final_plan, self.conf, interp)
+
+            def visit(n):
+                st = interp.state(n)
+                if st is None:
+                    return
+                bound = mem.bound(n)
+                tracer.predictions[id(n)] = {
+                    "node": type(n).__name__,
+                    "rows": None if st.rows is None else int(st.rows),
+                    "bytes": int(total_bytes(st)),
+                    "peakHbmBound": None if bound is None
+                    else int(bound),
+                }
+            final_plan.foreach(visit)
+            bound = mem.bound(final_plan)
+            tracer.static_peak_bound = bound
+        except Exception:
+            # the model is advisory: an analyzer crash must degrade the
+            # accuracy report, never the query
+            pass
+
+    def _flush_query_obs(self, tracer, error, eventlog_dir) -> None:
+        """Seal the trace and append the query to the event log — the
+        single exit point for success, speculation-retry and failure
+        paths alike (idempotent: re-entry on a writer error is a no-op).
+        """
+        if tracer is None or getattr(tracer, "_flush_done", False):
+            return
+        tracer._flush_done = True
+        final_plan = self._obs_plan
+        if final_plan is not None:
+            try:
+                from ..exec.base import drain_plan_metrics
+                drain_plan_metrics(final_plan)  # ONE device crossing
+            except Exception:
+                pass  # a dead device must not mask the query's error
+        tracer.finalize(error=error)
+        if eventlog_dir is None or final_plan is None:
+            return
+        sql_id = self._sql_counter
+        self._sql_counter += 1
+        try:
+            writer = self._event_log_writer(eventlog_dir)
+            writer.write_query(
+                sql_id, final_plan, tracer,
+                error=repr(error) if error is not None else None,
+                description=f"{type(final_plan).__name__} "
+                            f"(query {sql_id})")
+        except Exception:
+            if error is None:
+                raise  # an unwritable event log must surface somewhere
+            # ...but never by masking the query's own failure
+
+    def _event_log_writer(self, directory: str):
+        w = self._obs_writer
+        if w is None or w.directory != directory:
+            import uuid
+            from ..obs.eventlog_writer import EventLogWriter
+            w = EventLogWriter(
+                directory, app_id=f"tpu-{uuid.uuid4().hex[:12]}",
+                spark_version=getattr(self.shim, "version", ""),
+                conf_map=self._conf_map)
+            self._obs_writer = w
+        return w
 
     def explain(self, lp: L.LogicalPlan) -> str:
         final_plan = self.prepare_plan(lp, run_subqueries=False)

@@ -42,26 +42,36 @@ class BridgeClient:
 
     def execute_stage(self, spec: dict, table: pa.Table,
                       extra_tables=()) -> pa.Table:
-        blob = json.dumps(spec).encode()
-        if extra_tables:
-            parts = [MAGIC, b"M", struct.pack("<I", len(blob)), blob,
-                     struct.pack("<I", 1 + len(extra_tables))]
-            for tb in (table, *extra_tables):
-                ipc = self._ipc(tb)
-                parts += [struct.pack("<Q", len(ipc)), ipc]
-            self._sock.sendall(b"".join(parts))
-        else:
-            ipc = self._ipc(table)
-            self._sock.sendall(
-                MAGIC + b"E" + struct.pack("<I", len(blob)) + blob +
-                struct.pack("<Q", len(ipc)) + ipc)
-        tag = _read_exact(self._sock, 1)
-        if tag == b"E":
-            (n,) = struct.unpack("<I", _read_exact(self._sock, 4))
-            raise BridgeError(_read_exact(self._sock, n).decode())
-        (n,) = struct.unpack("<Q", _read_exact(self._sock, 8))
-        with pa.ipc.open_stream(io.BytesIO(_read_exact(self._sock, n))) as r:
-            return r.read_all()
+        from ..obs.tracer import trace_span
+        with trace_span("bridge.execute_stage",
+                        op=str(spec.get("op", ""))) as obs_sp:
+            blob = json.dumps(spec).encode()
+            sent = 0
+            if extra_tables:
+                parts = [MAGIC, b"M", struct.pack("<I", len(blob)), blob,
+                         struct.pack("<I", 1 + len(extra_tables))]
+                for tb in (table, *extra_tables):
+                    ipc = self._ipc(tb)
+                    parts += [struct.pack("<Q", len(ipc)), ipc]
+                    sent += len(ipc)
+                self._sock.sendall(b"".join(parts))
+            else:
+                ipc = self._ipc(table)
+                sent = len(ipc)
+                self._sock.sendall(
+                    MAGIC + b"E" + struct.pack("<I", len(blob)) + blob +
+                    struct.pack("<Q", len(ipc)) + ipc)
+            tag = _read_exact(self._sock, 1)
+            if tag == b"E":
+                (n,) = struct.unpack("<I", _read_exact(self._sock, 4))
+                raise BridgeError(_read_exact(self._sock, n).decode())
+            (n,) = struct.unpack("<Q", _read_exact(self._sock, 8))
+            with pa.ipc.open_stream(
+                    io.BytesIO(_read_exact(self._sock, n))) as r:
+                out = r.read_all()
+            obs_sp.set(request_bytes=sent, response_bytes=n,
+                       rows=out.num_rows)
+            return out
 
     def shutdown_sidecar(self):
         try:

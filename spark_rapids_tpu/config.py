@@ -563,6 +563,35 @@ MEMSAN_HBM_BUDGET = conf("spark.rapids.tpu.memsan.hbmBudgetBytes").bytes() \
          "size).") \
     .create_optional()
 
+# --- observability (flight recorder) --------------------------------------
+
+TRACE_ENABLED = conf("spark.rapids.tpu.trace.enabled").boolean() \
+    .doc("Record a per-query span tree (session phases, per-operator "
+         "per-partition execute spans, spill/shuffle/ICI/bridge events) "
+         "in the in-process flight recorder.  Low overhead by design: "
+         "the hot path never syncs — deferred device scalars resolve in "
+         "one crossing at query end.  Read back via "
+         "session.last_query_trace() (Chrome-trace/text exporters) and "
+         "the `tools trace` CLI.  Implied by eventLog.dir.") \
+    .create_with_default(False)
+
+TRACE_MAX_SPANS = conf("spark.rapids.tpu.trace.maxSpans").integer() \
+    .doc("Bound on recorded spans per query; past it new spans are "
+         "dropped and counted (a runaway query degrades the trace, "
+         "never the engine).") \
+    .check(lambda v: v >= 64, "must be >= 64") \
+    .create_with_default(65536)
+
+EVENT_LOG_DIR = conf("spark.rapids.tpu.eventLog.dir").string() \
+    .doc("When set, the session appends each query to a JSON-lines "
+         "event log (events_<appId>) in the SparkListener schema "
+         "tools/eventlog.py parses — `tools profile` / `tools qualify` "
+         "then work on this engine's own runs.  The emitted plan embeds "
+         "per-operator metric values and predicted-vs-actual rows/bytes "
+         "(`tools profile --accuracy`).  Failed queries flush too, as "
+         "JobFailed.  Enables tracing for the logged queries.") \
+    .create_optional()
+
 # Environment variables the engine reads directly (escape hatches that
 # must exist before config parsing, e.g. cache sizing at import time).
 # The repo lint (TPU-R002) fails on any SPARK_RAPIDS_* env read not

@@ -34,6 +34,7 @@ from .. import types as t
 from ..columnar.device import (DEFAULT_CHAR_BUCKETS, DEFAULT_ROW_BUCKETS,
                                DeviceBatch, bucket_for)
 from ..memory.spill import SpillableBatch, SpillCatalog, SpillPriority
+from ..obs.tracer import trace_event, trace_span
 from ..ops.gather import gather_batch
 from .base import Exec
 from .concat import concat_batches
@@ -117,11 +118,13 @@ def external_merge_sort(xp, inputs: Sequence[SpillableBatch],
     device bytes (ref GpuOutOfCoreSortIterator, GpuSortExec.scala:231)."""
     runs: List[Run] = []
     for p in inputs:
-        b = p.get_batch(xp)
-        p.close()
-        sb = sort_fn(b)
-        run = [spill.register(c, SpillPriority.INPUT)
-               for c in rechunk(xp, sb, names, types, chunk_rows)]
+        with trace_span("oc.sort_run") as obs_sp:
+            b = p.get_batch(xp)
+            p.close()
+            sb = sort_fn(b)
+            run = [spill.register(c, SpillPriority.INPUT)
+                   for c in rechunk(xp, sb, names, types, chunk_rows)]
+            obs_sp.set(chunks=len(run), bytes=_run_bytes(run))
         runs.append(run)
         enforce_device_budget(spill, budget)
     while len(runs) > 1:
@@ -133,17 +136,21 @@ def external_merge_sort(xp, inputs: Sequence[SpillableBatch],
                         total + _run_bytes(runs[0]) <= budget):
             total += _run_bytes(runs[0])
             group.append(runs.pop(0))
-        chunks = [c.get_batch(xp) for r in group for c in r]
-        for r in group:
-            for c in r:
-                c.close()
-        merged = concat_batches(xp, chunks, names, types) \
-            if len(chunks) > 1 else chunks[0]
-        del chunks
-        sb = sort_fn(merged)
-        del merged
-        new_run = [spill.register(c, SpillPriority.INPUT)
-                   for c in rechunk(xp, sb, names, types, chunk_rows)]
+        with trace_span("oc.merge", fan_in=len(group),
+                        bytes=total) as obs_sp:
+            chunks = [c.get_batch(xp) for r in group for c in r]
+            for r in group:
+                for c in r:
+                    c.close()
+            merged = concat_batches(xp, chunks, names, types) \
+                if len(chunks) > 1 else chunks[0]
+            del chunks
+            sb = sort_fn(merged)
+            del merged
+            new_run = [spill.register(c, SpillPriority.INPUT)
+                       for c in rechunk(xp, sb, names, types,
+                                        chunk_rows)]
+            obs_sp.set(chunks=len(new_run))
         runs.append(new_run)
         enforce_device_budget(spill, budget)
     for c in runs[0]:
@@ -165,18 +172,20 @@ def merge_partials_bounded(xp, partials: List[SpillableBatch],
     groups in sorted key order, live rows first (the segment-reduce
     kernel's contract)."""
     def _merge_compact(group: List[SpillableBatch]) -> SpillableBatch:
-        mats = [p.get_batch(xp) for p in group]
-        for p in group:
-            p.close()
-        merged_in = concat_batches(xp, mats, names, types) \
-            if len(mats) > 1 else mats[0]
-        del mats
-        out = merge_fn(merged_in)
-        # re-bucket to the surviving group count so batches genuinely
-        # shrink (the merge kernel keeps its input capacity)
-        compacted = slice_batch(xp, out, names, types, 0,
-                                int(out.num_rows))
-        return spill.register(compacted, SpillPriority.INPUT)
+        with trace_span("oc.merge_partials", fan_in=len(group),
+                        bytes=sum(p.device_bytes for p in group)):
+            mats = [p.get_batch(xp) for p in group]
+            for p in group:
+                p.close()
+            merged_in = concat_batches(xp, mats, names, types) \
+                if len(mats) > 1 else mats[0]
+            del mats
+            out = merge_fn(merged_in)
+            # re-bucket to the surviving group count so batches genuinely
+            # shrink (the merge kernel keeps its input capacity)
+            compacted = slice_batch(xp, out, names, types, 0,
+                                    int(out.num_rows))
+            return spill.register(compacted, SpillPriority.INPUT)
 
     while len(partials) > 1:
         nxt: List[SpillableBatch] = []
@@ -290,6 +299,9 @@ class SpillBoundaryExec(Exec):
                        for b in
                        self.children[0].execute_partition(pid, ctx)]
             entry = {"handles": handles, "reads": 0}
+            trace_event("oc.boundary_stage", pid=pid,
+                        handles=len(handles),
+                        bytes=sum(h.device_bytes for h in handles))
             with self._lock:
                 self._memo[key] = entry
         # a consumer past the declared count materializes CLOSED handles

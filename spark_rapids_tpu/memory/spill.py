@@ -40,6 +40,15 @@ def _ledger():
     return memsan.active_ledger()
 
 
+def _trace_event(name: str, **attrs) -> None:
+    """Flight-recorder hook: tier moves are exactly what a post-mortem
+    wants on the timeline (no-op without an installed tracer)."""
+    from ..obs import tracer
+    tr = tracer.active_tracer()
+    if tr is not None:
+        tr.event(name, **attrs)
+
+
 class StorageTier(Enum):
     DEVICE = 0
     HOST = 1
@@ -105,6 +114,8 @@ class SpillableBatch:
         led = _ledger()
         if led is not None:
             led.on_spill(self.id, self.device_bytes)
+        _trace_event("spill.host", bytes=self.device_bytes,
+                     buffer=self.id[:8])
         return self.device_bytes
 
     def spill_to_disk(self):
@@ -122,6 +133,7 @@ class SpillableBatch:
         led = _ledger()
         if led is not None:
             led.on_spill(self.id, 0)  # host tier -> disk: no HBM delta
+        _trace_event("spill.disk", bytes=freed, buffer=self.id[:8])
         return freed
 
     def get_batch(self, xp) -> DeviceBatch:
@@ -157,6 +169,8 @@ class SpillableBatch:
             self.tier = StorageTier.DEVICE
             if led is not None:
                 led.on_unspill(self.id, self.device_bytes)
+            _trace_event("spill.unspill", bytes=self.device_bytes,
+                         buffer=self.id[:8])
             self.catalog.note_unspill(self)
         return batch
 
@@ -323,6 +337,7 @@ class SpillCatalog:
                 self._pin_owners.pop((oid, key), None)
                 freed += nbytes
                 self.pinned_evicted_bytes += nbytes
+                _trace_event("spill.evict_pinned", bytes=nbytes)
         return freed
 
     def note_unspill(self, sb: SpillableBatch):

@@ -21,6 +21,14 @@ def _ledger():
     return memsan.active_ledger()
 
 
+def _trace_event(name: str, **attrs) -> None:
+    """Flight-recorder hook (no-op without an installed tracer)."""
+    from ..obs import tracer
+    tr = tracer.active_tracer()
+    if tr is not None:
+        tr.event(name, **attrs)
+
+
 class HostArena:
     def __init__(self, capacity: int = 64 << 20):
         self.capacity = capacity
@@ -52,6 +60,8 @@ class HostArena:
             if self._arena is not None:
                 off = self._lib.tpu_arena_alloc(self._arena, size, align)
                 if off < 0:
+                    _trace_event("arena.exhausted", wanted=size,
+                                 capacity=self.capacity)
                     return None
                 base = self._lib.tpu_arena_base(self._arena)
                 return memoryview(
@@ -59,6 +69,8 @@ class HostArena:
                         ctypes.addressof(base.contents) + off)).cast("B")
             off = (self._used + align - 1) & ~(align - 1)
             if off + size > self.capacity:
+                _trace_event("arena.exhausted", wanted=size,
+                             capacity=self.capacity)
                 return None
             self._used = off + size
             self._high = max(self._high, self._used)
@@ -91,6 +103,9 @@ class HostArena:
         return self._n
 
     def close(self):
+        if not self._closed:
+            _trace_event("arena.close", high_water=self.high_water,
+                         allocs=self.n_allocs)
         self._closed = True
         if self._arena is not None:
             self._lib.tpu_arena_destroy(self._arena)

@@ -1,0 +1,9 @@
+"""Observability subsystem (the flight recorder): span tracer, self-
+emitted SparkListener event logs, Chrome-trace/text exporters, and the
+predicted-vs-actual accuracy loop.  See docs/observability.md."""
+
+from .tracer import (QueryTrace, active_tracer, install, trace_event,
+                     trace_span, uninstall)
+
+__all__ = ["QueryTrace", "active_tracer", "install", "uninstall",
+           "trace_event", "trace_span"]

@@ -1,0 +1,239 @@
+"""Self-emitted event log: JSON-lines in the SparkListener schema that
+``tools/eventlog.py`` already parses, so ``tools profile`` /
+``tools qualify`` work on this engine's OWN runs, not just foreign Spark
+history logs (closing the producer/consumer loop the reference gets for
+free from Spark's EventLoggingListener).
+
+One file per session under ``spark.rapids.tpu.eventLog.dir``
+(``events_<appId>``); every query appends one SQLExecutionStart /
+JobStart / StageSubmitted / TaskEnd* / StageCompleted / JobEnd /
+SQLExecutionEnd group plus the span records as
+``...rapids.tpu.TpuSpanEvent`` lines (unknown to foreign parsers, which
+skip unrecognized Event kinds — ours replays them for
+``tools trace``).  Failed queries flush too, as JobFailed.
+
+The emitted SparkPlanInfo embeds each operator's drained metric values
+and its ``tpuPrediction`` (CBO rows/bytes + tmsan peak bound) /
+``tpuActual`` (measured rows/bytes) — what ``tools profile --accuracy``
+ranks."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def _json_default(o):
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, (np.bool_,)):
+        return bool(o)
+    return str(o)
+
+
+def plan_info(node, tracer=None) -> Dict[str, Any]:
+    """Serialize an Exec tree as SparkPlanInfo, embedding drained metric
+    values (name/level/value) and the tracer's prediction/actual maps."""
+    metrics = [{"name": m.name, "metricType": "sum", "level": m.level,
+                "value": m.value}
+               for m in node.metrics.values()]
+    d: Dict[str, Any] = {
+        "nodeName": type(node).__name__,
+        "simpleString": node.describe(),
+        "children": [plan_info(c, tracer) for c in node.children],
+        "metrics": metrics,
+    }
+    if tracer is not None:
+        pred = tracer.predictions.get(id(node))
+        if pred is not None:
+            d["tpuPrediction"] = pred
+        act = tracer.actuals.get(id(node))
+        if act is not None:
+            d["tpuActual"] = act
+    return d
+
+
+class EventLogWriter:
+    """Appends one session's queries to a single rolling-style log file."""
+
+    def __init__(self, directory: str, app_id: str,
+                 app_name: str = "spark_rapids_tpu",
+                 spark_version: str = "", conf_map: Optional[Dict] = None):
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        self.app_id = app_id
+        self.app_name = app_name
+        self.spark_version = spark_version
+        self.conf_map = dict(conf_map or {})
+        self.path = os.path.join(directory, f"events_{app_id}")
+        self._lock = threading.Lock()
+        self._started = False
+        self.queries_flushed = 0
+
+    # ------------------------------------------------------------------
+    def _header(self, now_ms: int) -> List[Dict]:
+        return [
+            {"Event": "SparkListenerLogStart",
+             "Spark Version": self.spark_version},
+            {"Event": "SparkListenerApplicationStart",
+             "App Name": self.app_name, "App ID": self.app_id,
+             "Timestamp": now_ms},
+            {"Event": "SparkListenerEnvironmentUpdate",
+             "Spark Properties": {str(k): str(v) for k, v in
+                                  self.conf_map.items()}},
+            {"Event": "SparkListenerExecutorAdded", "Executor ID": "0",
+             "Timestamp": now_ms,
+             "Executor Info": {"Host": "localhost",
+                               "Total Cores": os.cpu_count() or 1}},
+        ]
+
+    def write_query(self, sql_id: int, final_plan, tracer,
+                    error: Optional[str] = None,
+                    description: str = "") -> str:
+        """Append one finalized query (tracer must be sealed).  Returns
+        the log path."""
+        spans = tracer.span_dicts()
+        start_ms = tracer.wall_start_ms
+        end_rel_ns = max((s["startNs"] + s["durNs"] for s in spans),
+                        default=0)
+        end_ms = start_ms + max(end_rel_ns // 1_000_000, 1)
+        failed = error is not None
+        stage_name = type(final_plan).__name__
+        events: List[Dict] = []
+        with self._lock:
+            if not self._started:
+                events += self._header(start_ms)
+                self._started = True
+            events.append({
+                "Event": "org.apache.spark.sql.execution.ui."
+                         "SparkListenerSQLExecutionStart",
+                "executionId": sql_id,
+                "description": description or f"query {sql_id}",
+                "time": start_ms,
+                "sparkPlanInfo": plan_info(final_plan, tracer),
+            })
+            events.append({
+                "Event": "SparkListenerJobStart", "Job ID": sql_id,
+                "Submission Time": start_ms,
+                "Stage Infos": [{"Stage ID": sql_id,
+                                 "Stage Attempt ID": 0,
+                                 "Stage Name": stage_name,
+                                 "Number of Tasks":
+                                     final_plan.num_partitions}],
+                "Properties": {"spark.sql.execution.id": str(sql_id)},
+            })
+            events.append({
+                "Event": "SparkListenerStageSubmitted",
+                "Stage Info": {"Stage ID": sql_id, "Stage Attempt ID": 0,
+                               "Stage Name": stage_name,
+                               "Number of Tasks":
+                                   final_plan.num_partitions,
+                               "Submission Time": start_ms},
+            })
+            events += self._task_events(sql_id, final_plan, spans,
+                                        start_ms, failed)
+            events.append({
+                "Event": "SparkListenerStageCompleted",
+                "Stage Info": {"Stage ID": sql_id, "Stage Attempt ID": 0,
+                               "Stage Name": stage_name,
+                               "Number of Tasks":
+                                   final_plan.num_partitions,
+                               "Submission Time": start_ms,
+                               "Completion Time": end_ms,
+                               "Failure Reason": error},
+            })
+            events.append({
+                "Event": "SparkListenerJobEnd", "Job ID": sql_id,
+                "Completion Time": end_ms,
+                "Job Result": {"Result": "JobFailed" if failed
+                               else "JobSucceeded"},
+            })
+            end_ev = {
+                "Event": "org.apache.spark.sql.execution.ui."
+                         "SparkListenerSQLExecutionEnd",
+                "executionId": sql_id, "time": end_ms,
+            }
+            if tracer.measured_peak_device_bytes is not None:
+                end_ev["tpuPeakDeviceBytes"] = \
+                    tracer.measured_peak_device_bytes
+            if tracer.static_peak_bound is not None:
+                end_ev["tpuStaticPeakBound"] = \
+                    int(tracer.static_peak_bound)
+            events.append(end_ev)
+            for s in spans:
+                events.append({
+                    "Event": "org.apache.spark.sql.rapids.tpu."
+                             "TpuSpanEvent",
+                    "executionId": sql_id, **s})
+            events.append({"Event": "SparkListenerApplicationEnd",
+                           "Timestamp": end_ms})
+            with open(self.path, "a", encoding="utf-8") as f:
+                for ev in events:
+                    f.write(json.dumps(ev, default=_json_default) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            self.queries_flushed += 1
+        return self.path
+
+    # ------------------------------------------------------------------
+    def _task_events(self, sql_id: int, final_plan, spans: List[Dict],
+                     start_ms: int, failed: bool) -> List[Dict]:
+        """One TaskEnd per root-operator partition span (the engine's
+        'task' = one partition holding the TPU semaphore); spill totals
+        from the trace's spill events land on task 0."""
+        root_spans = [s for s in spans if s.get("kind") == "operator"
+                      and (s.get("attrs") or {}).get("op") ==
+                      type(final_plan).__name__]
+        mem_spilled = sum((s.get("attrs") or {}).get("bytes", 0)
+                          for s in spans if s["name"] == "spill.host")
+        disk_spilled = sum((s.get("attrs") or {}).get("bytes", 0)
+                           for s in spans if s["name"] == "spill.disk")
+        sh_write = sum((s.get("attrs") or {}).get("bytes", 0)
+                       for s in spans
+                       if s["name"] == "shuffle.map_write")
+        if not root_spans:
+            # degenerate fallback: one synthetic task spanning the query
+            dur = max((s["startNs"] + s["durNs"] for s in spans),
+                      default=1_000_000)
+            root_spans = [{"pid": 0, "startNs": 0, "durNs": dur,
+                           "rows": 0, "bytes": 0, "status": "ok"}]
+        out = []
+        for i, s in enumerate(sorted(root_spans,
+                                     key=lambda x: x.get("pid", 0))):
+            launch = start_ms + s["startNs"] // 1_000_000
+            finish = launch + max(s["durNs"] // 1_000_000, 1)
+            run_ms = max(s["durNs"] // 1_000_000, 1)
+            out.append({
+                "Event": "SparkListenerTaskEnd", "Stage ID": sql_id,
+                "Task Info": {"Task ID": sql_id * 1000 + i,
+                              "Attempt": 0, "Executor ID": "0",
+                              "Launch Time": launch,
+                              "Finish Time": finish,
+                              "Failed": failed and
+                              s.get("status") == "error"},
+                "Task Metrics": {
+                    "Executor Run Time": run_ms,
+                    "Executor CPU Time": run_ms * 1_000_000,
+                    "JVM GC Time": 0,
+                    "Result Size": s.get("bytes", 0),
+                    "Input Metrics": {"Bytes Read": 0},
+                    "Output Metrics": {"Bytes Written":
+                                       s.get("bytes", 0)},
+                    "Shuffle Read Metrics": {"Remote Bytes Read": 0,
+                                             "Local Bytes Read": 0},
+                    "Shuffle Write Metrics": {
+                        "Shuffle Bytes Written":
+                            sh_write if i == 0 else 0},
+                    "Memory Bytes Spilled":
+                        mem_spilled if i == 0 else 0,
+                    "Disk Bytes Spilled":
+                        disk_spilled if i == 0 else 0,
+                },
+            })
+        return out

@@ -1,0 +1,117 @@
+"""Trace renderers: Chrome-trace/Perfetto JSON, a text timeline, and
+the predicted-vs-actual accuracy math.
+
+All renderers operate on the neutral span-dict schema
+(``QueryTrace.span_dicts()``), which is also exactly what the
+self-emitted event log's span lines carry — so the live trace and a
+replayed log render identically (``tools trace --export chrome``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+def spans_to_chrome(span_dicts: List[Dict[str, Any]],
+                    process_name: str = "spark_rapids_tpu") -> Dict:
+    """Chrome trace-event JSON (chrome://tracing / Perfetto): complete
+    "X" events for intervals, instant "i" events, ts/dur in
+    microseconds relative to query start."""
+    events: List[Dict[str, Any]] = [
+        {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+         "args": {"name": process_name}},
+    ]
+    for s in span_dicts:
+        args = dict(s.get("attrs") or {})
+        args["status"] = s.get("status", "")
+        for k in ("rows", "bytes", "batches", "error", "pid"):
+            if s.get(k) not in (None, "", 0):
+                args[k] = s[k]
+        base = {"name": s["name"], "cat": s.get("kind", "span"),
+                "pid": 0, "tid": s.get("tid", 0),
+                "ts": s["startNs"] / 1000.0, "args": args}
+        if s.get("kind") == "event" or not s.get("durNs"):
+            events.append({**base, "ph": "i", "s": "t"})
+        else:
+            events.append({**base, "ph": "X",
+                           "dur": max(s["durNs"] / 1000.0, 0.001)})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def spans_to_text(span_dicts: List[Dict[str, Any]]) -> str:
+    """Indented text timeline (span tree in start order)."""
+    by_parent: Dict[Optional[int], List[Dict]] = {}
+    for s in span_dicts:
+        by_parent.setdefault(s.get("parentId"), []).append(s)
+    for kids in by_parent.values():
+        kids.sort(key=lambda s: s["startNs"])
+    ids = {s["spanId"] for s in span_dicts}
+    roots = [s for s in span_dicts
+             if s.get("parentId") is None or s["parentId"] not in ids]
+    lines: List[str] = []
+
+    def emit(s: Dict, depth: int) -> None:
+        dur_ms = s.get("durNs", 0) / 1e6
+        extra = ""
+        if s.get("kind") == "operator":
+            extra = (f" rows={s.get('rows', 0)}"
+                     f" batches={s.get('batches', 0)}")
+        if s.get("status") not in ("ok", "", None):
+            extra += f" [{s['status']}]"
+        if s.get("error"):
+            extra += f" !{s['error']}"
+        mark = "·" if s.get("kind") == "event" else "—"
+        lines.append(f"{'  ' * depth}{mark} {s['name']} "
+                     f"{dur_ms:.3f}ms{extra}")
+        for c in by_parent.get(s["spanId"], []):
+            emit(c, depth + 1)
+
+    for r in sorted(roots, key=lambda s: s["startNs"]):
+        emit(r, 0)
+    return "\n".join(lines) + "\n"
+
+
+def _err(pred, actual) -> float:
+    """Relative prediction error: |pred - actual| / max(actual, 1).
+    None predictions read as 'no model' and rank last (error -1)."""
+    if pred is None:
+        return -1.0
+    return abs(float(pred) - float(actual)) / max(float(actual), 1.0)
+
+
+def accuracy_row(node: str, pred: Dict[str, Any],
+                 act: Dict[str, Any]) -> Dict[str, Any]:
+    """One predicted-vs-actual report row — shared by the live trace
+    (QueryTrace.accuracy_rows) and the event-log replay
+    (tools/profiling.accuracy_report), so both rank identically."""
+    p_rows, a_rows = pred.get("rows"), act.get("rows", 0)
+    p_bytes, a_bytes = pred.get("bytes"), act.get("bytes", 0)
+    return {
+        "node": node,
+        "predictedRows": None if p_rows is None else int(p_rows),
+        "actualRows": int(a_rows),
+        "rowsErr": round(_err(p_rows, a_rows), 4),
+        "predictedBytes": None if p_bytes is None else int(p_bytes),
+        "actualBytes": int(a_bytes),
+        "bytesErr": round(_err(p_bytes, a_bytes), 4),
+        "peakHbmBound": pred.get("peakHbmBound"),
+    }
+
+
+def format_accuracy(rows: List[Dict[str, Any]],
+                    measured_peak: Optional[int] = None,
+                    static_bound: Optional[float] = None) -> str:
+    lines = ["### Predicted vs Actual (worst first) ###",
+             f"{'operator':28s} {'predRows':>12s} {'actRows':>12s} "
+             f"{'rowsErr':>8s} {'predBytes':>14s} {'actBytes':>14s} "
+             f"{'bytesErr':>8s}"]
+    for r in rows:
+        lines.append(
+            f"{str(r['node'])[:28]:28s} "
+            f"{str(r['predictedRows']):>12s} {r['actualRows']:>12d} "
+            f"{r['rowsErr']:>8.2f} {str(r['predictedBytes']):>14s} "
+            f"{r['actualBytes']:>14d} {r['bytesErr']:>8.2f}")
+    if static_bound is not None or measured_peak is not None:
+        lines.append(f"peak HBM: static bound="
+                     f"{int(static_bound) if static_bound else None} "
+                     f"measured={measured_peak}")
+    return "\n".join(lines) + "\n"

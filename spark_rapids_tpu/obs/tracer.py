@@ -1,0 +1,430 @@
+"""Flight-recorder span tracer: the producer half of the diagnostic
+story the reference plugin gets from GpuExec metrics + NVTX ranges +
+Spark's event log.
+
+One ``QueryTrace`` records a per-query span tree — session phases
+(subqueries/planning/overrides/execute), per-operator per-partition
+execute spans, out-of-core chunk spans, and instrumented events from the
+memory/shuffle/parallel/bridge layers — under the same
+deferred-device-scalar discipline as ``exec.base.Metric``: the hot path
+never syncs or fetches; device row counts are stashed and resolved at
+``finalize()`` through ONE ``columnar/fetch.fetch_ints`` crossing.
+Timestamps come from the monotonic ``time.perf_counter_ns`` clock with a
+wall-clock anchor captured once at trace start.
+
+The buffer is bounded (``spark.rapids.tpu.trace.maxSpans``): past the
+cap new spans are dropped and counted, never reallocated — a runaway
+query degrades the trace, not the engine (Dapper-style always-on,
+low-overhead discipline).
+
+Instrumented modules reach the recorder through the installed-tracer
+pattern the tmsan shadow ledger uses (``memory/memsan.py``): with no
+query tracing, ``active_tracer()`` is None and every hook is a cheap
+no-op.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# span kinds
+QUERY = "query"
+PHASE = "phase"
+OPERATOR = "operator"
+SPAN = "span"
+EVENT = "event"
+
+_HOST_NUMS = (int, float, bool, np.integer, np.floating, np.bool_)
+
+
+class Span:
+    """One recorded interval (or instant event, t1 == t0)."""
+
+    __slots__ = ("span_id", "parent_id", "name", "kind", "t0_ns", "t1_ns",
+                 "tid", "status", "error", "attrs", "node_id", "pid",
+                 "rows", "bytes", "batches")
+
+    def __init__(self, span_id: int, parent_id: Optional[int], name: str,
+                 kind: str, t0_ns: int, tid: int,
+                 node_id: Optional[int] = None,
+                 pid: Optional[int] = None,
+                 attrs: Optional[Dict[str, Any]] = None):
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.kind = kind
+        self.t0_ns = t0_ns
+        self.t1_ns: Optional[int] = None
+        self.tid = tid
+        self.status = "open"
+        self.error: Optional[str] = None
+        self.attrs = attrs or {}
+        self.node_id = node_id
+        self.pid = pid
+        self.rows = 0
+        self.bytes = 0
+        self.batches = 0
+
+    @property
+    def dur_ns(self) -> int:
+        return 0 if self.t1_ns is None else self.t1_ns - self.t0_ns
+
+
+class _SpanHandle:
+    """What ``QueryTrace.span()`` yields: lets the block attach attrs
+    after the fact without reaching into tracer internals."""
+
+    __slots__ = ("_trace", "_sid")
+
+    def __init__(self, trace: "QueryTrace", sid: Optional[int]):
+        self._trace = trace
+        self._sid = sid
+
+    def __bool__(self) -> bool:
+        return self._sid is not None
+
+    def set(self, **attrs) -> None:
+        if self._sid is not None:
+            self._trace.add_attrs(self._sid, **attrs)
+
+
+class QueryTrace:
+    """Thread-safe bounded span recorder for ONE query execution."""
+
+    def __init__(self, max_spans: int = 65536):
+        self.max_spans = max_spans
+        self.t0_ns = time.perf_counter_ns()
+        self.wall_start_ms = int(time.time() * 1000)
+        self.spans: List[Span] = []
+        self._by_id: Dict[int, Span] = {}
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._ids = itertools.count(1)
+        # deferred device scalars: (span, scalar) resolved at finalize
+        # through ONE fetch_ints crossing (the Metric discipline)
+        self._pending: List[tuple] = []
+        self.dropped = 0
+        self.sealed = False
+        self.error: Optional[str] = None
+        # predicted-vs-actual: id(exec node) -> dicts; predictions are
+        # installed by the session from the CBO/interp/tmsan models,
+        # actuals aggregate from operator spans at finalize
+        self.predictions: Dict[int, Dict[str, Any]] = {}
+        self.actuals: Dict[int, Dict[str, Any]] = {}
+        self.measured_peak_device_bytes: Optional[int] = None
+        self.static_peak_bound: Optional[float] = None
+        self.root_id = self.start("query", QUERY)
+
+    # -- parent stack (per thread) ------------------------------------------
+    def _stack(self) -> List[int]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = []
+            self._tls.stack = st
+        return st
+
+    def _push(self, sid: int) -> None:
+        self._stack().append(sid)
+
+    def _pop(self) -> None:
+        st = self._stack()
+        if st:
+            st.pop()
+
+    def _default_parent(self) -> Optional[int]:
+        # spans with no enclosing span (any thread) hang off the query
+        # root, so the tree always has one top
+        st = self._stack()
+        if st:
+            return st[-1]
+        return getattr(self, "root_id", None)
+
+    # -- core ---------------------------------------------------------------
+    def start(self, name: str, kind: str, node_id: Optional[int] = None,
+              pid: Optional[int] = None, parent: Optional[int] = None,
+              **attrs) -> Optional[int]:
+        with self._lock:
+            if self.sealed:
+                return None
+            if len(self.spans) >= self.max_spans:
+                self.dropped += 1
+                return None
+            sid = next(self._ids)
+            if parent is None:
+                parent = self._default_parent()
+            sp = Span(sid, parent if parent != sid else None, name, kind,
+                      time.perf_counter_ns(), threading.get_ident(),
+                      node_id=node_id, pid=pid, attrs=dict(attrs))
+            self.spans.append(sp)
+            self._by_id[sid] = sp
+            return sid
+
+    def end(self, sid: Optional[int], status: str = "ok",
+            error: Optional[str] = None) -> None:
+        if sid is None:
+            return
+        with self._lock:
+            sp = self._by_id.get(sid)
+            if sp is None or sp.t1_ns is not None:
+                return
+            sp.t1_ns = time.perf_counter_ns()
+            sp.status = status
+            sp.error = error
+
+    def event(self, name: str, **attrs) -> None:
+        sid = self.start(name, EVENT, **attrs)
+        if sid is not None:
+            sp = self._by_id[sid]
+            sp.t1_ns = sp.t0_ns
+            sp.status = "ok"
+
+    def add_attrs(self, sid: Optional[int], **attrs) -> None:
+        if sid is None:
+            return
+        with self._lock:
+            sp = self._by_id.get(sid)
+            if sp is not None:
+                sp.attrs.update(attrs)
+
+    @contextlib.contextmanager
+    def span(self, name: str, kind: str = SPAN, **attrs):
+        sid = self.start(name, kind, **attrs)
+        if sid is not None:
+            self._push(sid)
+        err: Optional[BaseException] = None
+        try:
+            yield _SpanHandle(self, sid)
+        except BaseException as ex:
+            err = ex
+            raise
+        finally:
+            if sid is not None:
+                self._pop()
+                self.end(sid, "error" if err is not None else "ok",
+                         repr(err) if err is not None else None)
+
+    # -- operator spans ------------------------------------------------------
+    def trace_operator(self, node, pid: int, inner):
+        """Wrap one execute_partition iterator in an operator span: the
+        span opens at first pull, accumulates output rows (deferred when
+        the count is a traced device scalar — never a sync here), device
+        bytes (array metadata only) and batches, and closes on
+        exhaustion, abandonment (early-exit limits) or error — the
+        exception is recorded on the span (post-mortem debugging)."""
+        it = iter(inner)
+
+        def gen():
+            sid = self.start(f"{type(node).__name__}.execute", OPERATOR,
+                             node_id=id(node), pid=pid,
+                             op=type(node).__name__)
+            try:
+                while True:
+                    if sid is not None:
+                        self._push(sid)
+                    try:
+                        b = next(it)
+                    except StopIteration:
+                        break
+                    finally:
+                        if sid is not None:
+                            self._pop()
+                    if sid is not None:
+                        self._note_batch(sid, b)
+                    yield b
+            except GeneratorExit:
+                self.end(sid, "abandoned")
+                raise
+            except BaseException as ex:
+                self.end(sid, "error", repr(ex))
+                raise
+            self.end(sid)
+
+        return gen()
+
+    def _note_batch(self, sid: int, batch) -> None:
+        with self._lock:
+            sp = self._by_id.get(sid)
+            if sp is None:
+                return
+            sp.batches += 1
+            n = getattr(batch, "num_rows", None)
+            if isinstance(n, _HOST_NUMS):
+                sp.rows += int(n)
+            elif n is not None:
+                self._pending.append((sp, n))
+            try:
+                from ..memory.spill import batch_device_bytes
+                sp.bytes += batch_device_bytes(batch)
+            except Exception:
+                pass
+
+    # -- failure / end of query ---------------------------------------------
+    def interrupt(self, reason: str) -> None:
+        """Close every still-open operator span with `reason` (the
+        speculation-miss path: abandoned generators never see the
+        exception, so their spans would otherwise dangle into the
+        re-execution)."""
+        self.event(reason)
+        with self._lock:
+            now = time.perf_counter_ns()
+            for sp in self.spans:
+                if sp.t1_ns is None and sp.kind == OPERATOR:
+                    sp.t1_ns = now
+                    sp.status = reason
+
+    def finalize(self, error: Optional[BaseException] = None) -> None:
+        """Seal the trace: close open spans (recording the exception on
+        them for failed queries), resolve ALL deferred device scalars in
+        one fetch crossing, and aggregate per-operator actuals."""
+        with self._lock:
+            if self.sealed:
+                return
+            self.sealed = True
+            self.error = repr(error) if error is not None else None
+            now = time.perf_counter_ns()
+            for sp in self.spans:
+                if sp.t1_ns is None:
+                    sp.t1_ns = now
+                    if error is not None:
+                        sp.status = "error"
+                        if sp.error is None:
+                            sp.error = repr(error)
+                    else:
+                        sp.status = "ok"
+            pending, self._pending = self._pending, []
+        if pending:
+            try:
+                from ..columnar.fetch import fetch_ints
+                vals = fetch_ints([v for _, v in pending])
+                for (sp, _), v in zip(pending, vals):
+                    sp.rows += int(v)
+            except Exception:
+                # failure paths may leave the device unusable; a trace
+                # with unresolved row counts still beats no trace
+                pass
+        for sp in self.spans:
+            if sp.kind != OPERATOR or sp.node_id is None:
+                continue
+            agg = self.actuals.setdefault(
+                sp.node_id, {"rows": 0, "bytes": 0, "batches": 0,
+                             "timeNs": 0, "node": sp.attrs.get("op", "")})
+            agg["rows"] += sp.rows
+            agg["bytes"] += sp.bytes
+            agg["batches"] += sp.batches
+            agg["timeNs"] += sp.dur_ns
+
+    # -- reports -------------------------------------------------------------
+    def open_span_count(self) -> int:
+        with self._lock:
+            return sum(1 for s in self.spans if s.t1_ns is None)
+
+    def span_dicts(self) -> List[Dict[str, Any]]:
+        """Schema shared with the self-emitted event log's span lines
+        and the export renderers (obs/export.py)."""
+        out = []
+        with self._lock:
+            for s in self.spans:
+                rel = s.t0_ns - self.t0_ns
+                d = {"spanId": s.span_id, "parentId": s.parent_id,
+                     "name": s.name, "kind": s.kind,
+                     "startNs": rel, "durNs": s.dur_ns,
+                     "wallMs": self.wall_start_ms + rel // 1_000_000,
+                     "tid": s.tid, "status": s.status,
+                     "attrs": dict(s.attrs)}
+                if s.error:
+                    d["error"] = s.error
+                if s.pid is not None:
+                    d["pid"] = s.pid
+                if s.kind == OPERATOR:
+                    d["rows"] = int(s.rows)
+                    d["bytes"] = int(s.bytes)
+                    d["batches"] = int(s.batches)
+                out.append(d)
+        return out
+
+    def operator_spans(self, node_id: Optional[int] = None) -> List[Span]:
+        with self._lock:
+            return [s for s in self.spans if s.kind == OPERATOR and
+                    (node_id is None or s.node_id == node_id)]
+
+    def accuracy_rows(self) -> List[Dict[str, Any]]:
+        """Per-operator predicted-vs-actual rows/bytes, ranked worst
+        first — the feedback signal for CBO tuning."""
+        from .export import accuracy_row
+        rows = []
+        for nid, pred in self.predictions.items():
+            act = self.actuals.get(nid)
+            if act is None:
+                continue
+            rows.append(accuracy_row(act.get("node") or pred.get("node"),
+                                     pred, act))
+        rows.sort(key=lambda r: -r["rowsErr"])
+        return rows
+
+    def to_chrome(self) -> Dict[str, Any]:
+        from .export import spans_to_chrome
+        return spans_to_chrome(self.span_dicts())
+
+    def to_text(self) -> str:
+        from .export import spans_to_text
+        return spans_to_text(self.span_dicts())
+
+
+# ---------------------------------------------------------------------------
+# installation (what the instrumented layers consult)
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[QueryTrace] = None
+
+
+def install(trace: QueryTrace) -> QueryTrace:
+    global _ACTIVE
+    _ACTIVE = trace
+    return trace
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active_tracer() -> Optional[QueryTrace]:
+    return _ACTIVE
+
+
+def trace_event(name: str, **attrs) -> None:
+    """Record an instant event on the active trace (no-op otherwise)."""
+    tr = _ACTIVE
+    if tr is not None:
+        tr.event(name, **attrs)
+
+
+@contextlib.contextmanager
+def trace_span(name: str, kind: str = SPAN, **attrs):
+    """Span context manager against the active trace; yields a handle
+    with ``.set(**attrs)`` (or an inert one when tracing is off)."""
+    tr = _ACTIVE
+    if tr is None:
+        yield _SpanHandle_NULL
+        return
+    with tr.span(name, kind=kind, **attrs) as h:
+        yield h
+
+
+class _NullHandle:
+    __slots__ = ()
+
+    def __bool__(self) -> bool:
+        return False
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+_SpanHandle_NULL = _NullHandle()

@@ -23,6 +23,7 @@ from .. import config as cfg
 from ..exec.base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU,
                          Batch, Exec, MetricTimer, to_host_batch)
 from ..columnar.interop import to_arrow_schema
+from ..obs.tracer import trace_event
 
 
 class IciAggregateExec(Exec):
@@ -67,10 +68,14 @@ class IciAggregateExec(Exec):
             source, ctx, source.output_names, source.output_types,
             self._dagg.n_dev)
         if stacked is not None:
+            trace_event("ici.stage", op="aggregate", path="stacked",
+                        chips=self._dagg.n_dev)
             with MetricTimer(self.metrics[OP_TIME]):
                 out = self._dagg._compiled(stacked)
             yield from _emit_stacked(self, out)
             return
+        trace_event("ici.stage", op="aggregate", path="host",
+                    chips=self._dagg.n_dev)
         tbl = _gather_source_table(source, ctx, source.output_names,
                                    source.output_types)
         shards = _shard_table(tbl, self._dagg.n_dev)
@@ -304,11 +309,15 @@ class IciSortExec(Exec):
             source, ctx, source.output_names, source.output_types,
             self._dsort.n_dev)
         if stacked is not None:
+            trace_event("ici.stage", op="sort", path="stacked",
+                        chips=self._dsort.n_dev)
             # shard i holds globally-ordered range i: emit in mesh order
             with MetricTimer(self.metrics[OP_TIME]):
                 out = self._dsort._compiled(stacked)
             yield from _emit_stacked(self, out)
             return
+        trace_event("ici.stage", op="sort", path="host",
+                    chips=self._dsort.n_dev)
         tbl = _gather_source_table(source, ctx, source.output_names,
                                    source.output_types)
         shards = _shard_table(tbl, self._dsort.n_dev)
@@ -359,10 +368,13 @@ class IciJoinExec(Exec):
                                     rsrc.output_types, n_dev) \
             if ls is not None else None
         if ls is not None and rs is not None:
+            trace_event("ici.stage", op="join", path="stacked",
+                        chips=n_dev)
             with MetricTimer(self.metrics[OP_TIME]):
                 out = self._djoin.run_stacked(ls, rs)
             yield from _emit_table(self, out)
             return
+        trace_event("ici.stage", op="join", path="host", chips=n_dev)
         lt = _gather_source_table(lsrc, ctx, lsrc.output_names,
                                   lsrc.output_types)
         rt = _gather_source_table(rsrc, ctx, rsrc.output_names,
@@ -443,6 +455,9 @@ class IciExchangeExec(Exec):
             stacked = _gather_source_stacked(
                 source, ctx, source.output_names, source.output_types,
                 self._dex.n_dev)
+            trace_event("ici.stage", op="exchange",
+                        path="stacked" if stacked is not None
+                        else "host", chips=self._dex.n_dev)
             with MetricTimer(self.metrics[OP_TIME]):
                 if stacked is not None:
                     out = self._dex.run_stacked(stacked)

@@ -92,55 +92,69 @@ class ShuffleExchangeExec(Exec):
         with self._write_lock:
             if self._shuffle_id is not None:
                 return
-            mgr = TpuShuffleManager.get()
-            shuffle_id = mgr.new_shuffle_id()
-            xp = self.xp
-            child = self.children[0]
-            # phase 1: dispatch every map batch's partition-sort (async);
-            # phase 2: ONE host sync brings back ALL count vectors (a
-            # per-batch sync costs a full tunnel round trip each)
-            staged: List[tuple] = []  # (map_id, sorted_batch, counts)
-            for map_id in range(child.num_partitions):
-                row_offset = 0
-                for b in child.execute_partition(map_id, ctx):
-                    with MetricTimer(self.metrics[OP_TIME]):
-                        if self.placement == TPU:
-                            sorted_b, counts = self._jit_map(
-                                b, np.int32(row_offset))
-                        else:
-                            sorted_b, counts = self._map_batch(
-                                np, b, row_offset)
-                    staged.append((map_id, sorted_b, counts))
-                    row_offset += int(b.num_rows)
-            if staged and self.placement == TPU:
-                all_counts = np.asarray(
-                    jnp.stack([c for _, _, c in staged]))   # one sync
-            else:
-                all_counts = np.stack([np.asarray(c)
-                                       for _, _, c in staged]) \
-                    if staged else np.zeros((0, self.num_partitions))
-            per_map: Dict[int, Dict[int, List[Batch]]] = {}
-            with MetricTimer(self.metrics[OP_TIME]):
-                for (map_id, sorted_b, _), counts_host in zip(staged,
-                                                              all_counts):
-                    slices = per_map.setdefault(map_id, {})
-                    start = 0
-                    for pid_out in range(self.num_partitions):
-                        n = int(counts_host[pid_out])
-                        if n == 0:
-                            continue
-                        piece = _slice_rows(xp, sorted_b, start, n)
-                        slices.setdefault(pid_out, []).append(piece)
-                        start += n
-            for map_id in range(child.num_partitions):
-                slices = per_map.get(map_id, {})
-                merged = {}
-                for pid_out, parts in slices.items():
-                    merged[pid_out] = parts[0] if len(parts) == 1 else \
-                        concat_batches(xp, parts, self.output_names,
-                                       self.output_types)
-                mgr.write_map_output(shuffle_id, map_id, merged)
-            self._shuffle_id = shuffle_id
+            from ..obs.tracer import trace_span
+            with trace_span("shuffle.map_write",
+                            partitions=self.num_partitions) as obs_sp:
+                self._write_all(ctx, obs_sp)
+
+    def _write_all(self, ctx: ExecContext, obs_sp):
+        """Map side under one flight-recorder span: obs_sp collects the
+        staged block count and device bytes for the timeline and the
+        event log's shuffle-write task metric."""
+        mgr = TpuShuffleManager.get()
+        shuffle_id = mgr.new_shuffle_id()
+        xp = self.xp
+        child = self.children[0]
+        # phase 1: dispatch every map batch's partition-sort (async);
+        # phase 2: ONE host sync brings back ALL count vectors (a
+        # per-batch sync costs a full tunnel round trip each)
+        staged: List[tuple] = []  # (map_id, sorted_batch, counts)
+        for map_id in range(child.num_partitions):
+            row_offset = 0
+            for b in child.execute_partition(map_id, ctx):
+                with MetricTimer(self.metrics[OP_TIME]):
+                    if self.placement == TPU:
+                        sorted_b, counts = self._jit_map(
+                            b, np.int32(row_offset))
+                    else:
+                        sorted_b, counts = self._map_batch(
+                            np, b, row_offset)
+                staged.append((map_id, sorted_b, counts))
+                row_offset += int(b.num_rows)
+        if staged and self.placement == TPU:
+            all_counts = np.asarray(
+                jnp.stack([c for _, _, c in staged]))   # one sync
+        else:
+            all_counts = np.stack([np.asarray(c)
+                                   for _, _, c in staged]) \
+                if staged else np.zeros((0, self.num_partitions))
+        per_map: Dict[int, Dict[int, List[Batch]]] = {}
+        with MetricTimer(self.metrics[OP_TIME]):
+            for (map_id, sorted_b, _), counts_host in zip(staged,
+                                                          all_counts):
+                slices = per_map.setdefault(map_id, {})
+                start = 0
+                for pid_out in range(self.num_partitions):
+                    n = int(counts_host[pid_out])
+                    if n == 0:
+                        continue
+                    piece = _slice_rows(xp, sorted_b, start, n)
+                    slices.setdefault(pid_out, []).append(piece)
+                    start += n
+        for map_id in range(child.num_partitions):
+            slices = per_map.get(map_id, {})
+            merged = {}
+            for pid_out, parts in slices.items():
+                merged[pid_out] = parts[0] if len(parts) == 1 else \
+                    concat_batches(xp, parts, self.output_names,
+                                   self.output_types)
+            mgr.write_map_output(shuffle_id, map_id, merged)
+        if obs_sp:
+            from ..memory.spill import batch_device_bytes
+            obs_sp.set(shuffle_id=shuffle_id, blocks=len(staged),
+                       bytes=sum(batch_device_bytes(b)
+                                 for _, b, _ in staged))
+        self._shuffle_id = shuffle_id
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         from ..memory.spill import SpillableBatch

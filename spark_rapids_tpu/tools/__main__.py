@@ -1,9 +1,15 @@
 """CLI for the offline tools (ref QualificationMain / ProfileMain):
 
     python -m spark_rapids_tpu.tools qualification <eventlogs...> [-o DIR]
-    python -m spark_rapids_tpu.tools profiling     <eventlogs...> [-o DIR] [-c]
+    python -m spark_rapids_tpu.tools profiling     <eventlogs...> [-o DIR] [-c] [--accuracy]
+    python -m spark_rapids_tpu.tools trace         <eventlog> [--export chrome|text] [-o FILE]
     python -m spark_rapids_tpu.tools lint --repo   [--baseline FILE]
     python -m spark_rapids_tpu.tools lint --plan   <fixture.py...> [--infer] [--memsan]
+
+`profiling --accuracy` and `trace` consume the engine's SELF-emitted
+event logs (spark.rapids.tpu.eventLog.dir): predicted-vs-actual
+rows/bytes per operator, and the flight-recorder span tree exported as
+Chrome-trace JSON (chrome://tracing / Perfetto) or a text timeline.
 
 Lint fixtures are Python files defining ``plan_*()`` builders, each
 returning ``(exec_root, conf_dict)`` — the checked-in golden bad plans
@@ -74,6 +80,35 @@ def _run_repo_lint(baseline_path, update):
     return 0
 
 
+def _run_trace_export(log, fmt, output, sql_id):
+    import json
+
+    from ..obs.export import spans_to_chrome, spans_to_text
+    from .eventlog import parse_event_log
+
+    app = parse_event_log(log)
+    spans = [s for s in app.spans
+             if sql_id is None or s.get("executionId") == sql_id]
+    if not spans:
+        sys.stderr.write(f"{log}: no flight-recorder spans "
+                         f"(self-emitted logs only; was "
+                         f"spark.rapids.tpu.eventLog.dir set?)\n")
+        return 2
+    if fmt == "text":
+        text = spans_to_text(spans)
+        if output:
+            with open(output, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text)
+        return 0
+    out_path = output or (log + ".trace.json")
+    with open(out_path, "w") as f:
+        json.dump(spans_to_chrome(spans), f)
+    sys.stdout.write(f"{len(spans)} span(s) -> {out_path}\n")
+    return 0
+
+
 def _default_baseline():
     import os
     return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
@@ -91,6 +126,21 @@ def main(argv=None):
     pr.add_argument("logs", nargs="+")
     pr.add_argument("-o", "--output", default="profile_output")
     pr.add_argument("-c", "--compare", action="store_true")
+    pr.add_argument("-a", "--accuracy", action="store_true",
+                    help="print the predicted-vs-actual report "
+                         "(self-emitted logs embed the CBO/tmsan "
+                         "model and measured rows/bytes per operator)")
+    tr = sub.add_parser("trace",
+                        help="export the flight-recorder span tree "
+                             "from a self-emitted event log")
+    tr.add_argument("log")
+    tr.add_argument("--export", choices=["chrome", "text"],
+                    default="chrome")
+    tr.add_argument("-o", "--output", default=None,
+                    help="output file (default: <log>.trace.json for "
+                         "chrome; stdout for text)")
+    tr.add_argument("--sql", type=int, default=None,
+                    help="only this SQL execution id")
     li = sub.add_parser("lint",
                         help="static plan/repo analysis (tpulint)")
     li.add_argument("--repo", action="store_true",
@@ -124,6 +174,14 @@ def main(argv=None):
         reports = profile(args.logs, args.output, compare=args.compare)
         sys.stdout.write(f"profiled {len(reports)} application(s) -> "
                          f"{args.output}\n")
+        if args.accuracy:
+            from .eventlog import find_event_logs, parse_event_log
+            from .profiling import format_accuracy
+            for log in find_event_logs(args.logs):
+                sys.stdout.write(format_accuracy(parse_event_log(log)))
+    elif args.cmd == "trace":
+        return _run_trace_export(args.log, args.export, args.output,
+                                 args.sql)
     else:
         if args.plan:
             return _run_plan_lint(args.plan, infer=args.infer,

@@ -19,23 +19,34 @@ from typing import Any, Dict, Iterator, List, Optional
 
 
 class PlanNode:
-    """One node of a SparkPlanInfo tree."""
+    """One node of a SparkPlanInfo tree.
 
-    __slots__ = ("node_name", "simple_string", "children", "metrics")
+    `prediction`/`actual` are the spark_rapids_tpu extensions the
+    engine's self-emitted logs carry (tpuPrediction/tpuActual: the
+    CBO's row/byte model + tmsan's peak-HBM bound vs what actually ran
+    — the `tools profile --accuracy` inputs); None on foreign logs."""
+
+    __slots__ = ("node_name", "simple_string", "children", "metrics",
+                 "prediction", "actual")
 
     def __init__(self, node_name: str, simple_string: str = "",
                  children: Optional[List["PlanNode"]] = None,
-                 metrics: Optional[List[dict]] = None):
+                 metrics: Optional[List[dict]] = None,
+                 prediction: Optional[dict] = None,
+                 actual: Optional[dict] = None):
         self.node_name = node_name
         self.simple_string = simple_string
         self.children = children or []
         self.metrics = metrics or []
+        self.prediction = prediction
+        self.actual = actual
 
     @classmethod
     def from_json(cls, d: dict) -> "PlanNode":
         return cls(d.get("nodeName", ""), d.get("simpleString", ""),
                    [cls.from_json(c) for c in d.get("children", [])],
-                   d.get("metrics", []))
+                   d.get("metrics", []),
+                   d.get("tpuPrediction"), d.get("tpuActual"))
 
     def walk(self) -> Iterator["PlanNode"]:
         yield self
@@ -45,7 +56,8 @@ class PlanNode:
 
 class SQLExecution:
     __slots__ = ("sql_id", "description", "plan", "start_time", "end_time",
-                 "failed", "job_ids")
+                 "failed", "job_ids", "peak_device_bytes",
+                 "static_peak_bound")
 
     def __init__(self, sql_id: int, description: str, plan: PlanNode,
                  start_time: int):
@@ -56,6 +68,10 @@ class SQLExecution:
         self.end_time: Optional[int] = None
         self.failed = False
         self.job_ids: List[int] = []
+        # spark_rapids_tpu extensions (memsan-measured peak vs the tmsan
+        # static bound); None on foreign logs
+        self.peak_device_bytes: Optional[int] = None
+        self.static_peak_bound: Optional[int] = None
 
     @property
     def duration(self) -> int:
@@ -114,6 +130,9 @@ class AppInfo:
         self.sql_executions: Dict[int, SQLExecution] = {}
         self.job_to_sql: Dict[int, int] = {}
         self.stage_to_job: Dict[int, int] = {}
+        # flight-recorder span records (TpuSpanEvent lines from the
+        # engine's self-emitted logs; empty for foreign Spark logs)
+        self.spans: List[dict] = []
 
     @property
     def app_duration(self) -> int:
@@ -264,6 +283,10 @@ def _apply_event(app: AppInfo, ev: dict) -> None:
         sx = app.sql_executions.get(sql_id)
         if sx is not None:
             sx.end_time = ev.get("time", 0)
+            sx.peak_device_bytes = ev.get("tpuPeakDeviceBytes")
+            sx.static_peak_bound = ev.get("tpuStaticPeakBound")
+    elif kind.endswith("TpuSpanEvent"):
+        app.spans.append(ev)
     elif kind.endswith("SQLAdaptiveExecutionUpdate"):
         sql_id = ev.get("executionId", 0)
         sx = app.sql_executions.get(sql_id)

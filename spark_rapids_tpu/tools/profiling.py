@@ -68,6 +68,67 @@ def sql_aggregates(app: AppInfo) -> List[Dict]:
 
 
 # ---------------------------------------------------------------------------
+# Operator metrics + predicted-vs-actual (spark_rapids_tpu self-emitted
+# logs; the engine embeds drained metric values and the CBO/tmsan model
+# into SparkPlanInfo — see obs/eventlog_writer.py)
+# ---------------------------------------------------------------------------
+
+_LEVEL_ORDER = {"ESSENTIAL": 0, "MODERATE": 1, "DEBUG": 2}
+
+
+def operator_metrics(app: AppInfo, sql_id: int,
+                     level: str = "MODERATE") -> List[tuple]:
+    """(operator, metric, value) rows for one SQL execution, in the
+    same pre-order walk and level filter as the live
+    ``exec.base.metrics_report`` — the round-trip contract: parsing a
+    self-emitted log reproduces ``last_query_metrics`` exactly."""
+    sx = app.sql_executions.get(sql_id)
+    if sx is None:
+        return []
+    cutoff = _LEVEL_ORDER.get(level, 1)
+    out: List[tuple] = []
+    for node in sx.plan.walk():
+        for m in node.metrics:
+            if "value" not in m:
+                continue  # foreign Spark logs carry accumulator ids
+            if _LEVEL_ORDER.get(m.get("level", "MODERATE"), 1) > cutoff:
+                continue
+            out.append((node.node_name, m.get("name", ""), m["value"]))
+    return out
+
+
+def accuracy_report(app: AppInfo) -> List[Dict]:
+    """Predicted-vs-actual rows/bytes per operator across all SQL
+    executions, ranked by row-prediction error (worst first) — the
+    feedback signal CBO-tuning consumes.  Adds the query-level
+    peak-HBM pair (tmsan static bound vs memsan-measured) when the log
+    carries it."""
+    from ..obs.export import accuracy_row
+    rows: List[Dict] = []
+    for sql_id, sx in sorted(app.sql_executions.items()):
+        for node in sx.plan.walk():
+            if node.prediction is None or node.actual is None:
+                continue
+            r = accuracy_row(node.node_name, node.prediction,
+                             node.actual)
+            r["sqlId"] = sql_id
+            rows.append(r)
+    rows.sort(key=lambda r: -r["rowsErr"])
+    return rows
+
+
+def format_accuracy(app: AppInfo) -> str:
+    from ..obs.export import format_accuracy as _fmt
+    rows = accuracy_report(app)
+    peaks = [(sx.static_peak_bound, sx.peak_device_bytes)
+             for sx in app.sql_executions.values()
+             if sx.static_peak_bound is not None or
+             sx.peak_device_bytes is not None]
+    bound, measured = peaks[-1] if peaks else (None, None)
+    return _fmt(rows, measured_peak=measured, static_bound=bound)
+
+
+# ---------------------------------------------------------------------------
 # Health check (ref HealthCheck.scala)
 # ---------------------------------------------------------------------------
 
@@ -173,12 +234,18 @@ def profile(paths: List[str], output_dir: Optional[str] = None,
             continue
     reports = []
     for app in apps:
-        reports.append({
+        rep = {
             "application": app_information(app),
             "stages": stage_aggregates(app),
             "sql": sql_aggregates(app),
             "health": health_check(app),
-        })
+            # self-emitted logs only: per-operator metric values and the
+            # predicted-vs-actual rows (empty for foreign Spark logs)
+            "operators": {sql_id: operator_metrics(app, sql_id, "DEBUG")
+                          for sql_id in sorted(app.sql_executions)},
+            "accuracy": accuracy_report(app),
+        }
+        reports.append(rep)
     if output_dir:
         os.makedirs(output_dir, exist_ok=True)
         for app, rep in zip(apps, reports):

@@ -69,6 +69,20 @@ def test_run_lint_memsan_gate_exits_zero():
     assert "memsan gate clean" in proc.stdout, proc.stdout
 
 
+def test_run_lint_obs_gate_exits_zero():
+    """Tier-1 gate for the flight recorder: one golden query replays
+    with tracing + the self-emitted event log on, and the gate fails on
+    unclosed spans, an unflushed/unparsable log, or live-vs-parsed
+    aggregate drift."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "devtools", "run_lint.py"),
+         "--obs"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "obs gate clean" in proc.stdout, proc.stdout
+
+
 def test_baseline_is_empty_and_stays_empty():
     """PR-3 burned the last baselined TPU-R001 debt down to zero: the
     ratchet now enforces a spotless repo (deliberate exceptions are

@@ -70,3 +70,111 @@ def test_trace_annotations_run():
     finally:
         from spark_rapids_tpu.exec.base import set_trace_annotations
         set_trace_annotations(False)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder (obs/): span tree, exporters, CLI subcommands
+# ---------------------------------------------------------------------------
+
+def _traced_session(**extra):
+    b = (TpuSession.builder()
+         .config("spark.rapids.sql.enabled", True)
+         .config("spark.rapids.tpu.trace.enabled", True))
+    for k, v in extra.items():
+        b = b.config(k, v)
+    return b.get_or_create()
+
+
+def test_last_query_trace_span_tree():
+    s = _traced_session()
+    df = s.create_dataframe(pa.table({"x": pa.array(range(64))}))
+    out = df.filter(col("x") > 9).collect()
+    assert out.num_rows == 54
+    tr = s.last_query_trace()
+    assert tr is not None and tr.sealed and tr.open_span_count() == 0
+    names = [sp.name for sp in tr.spans]
+    # session phases + per-operator execute spans
+    assert "phase:plan" in names and "phase:execute" in names
+    ops = [sp for sp in tr.spans if sp.kind == "operator"]
+    assert any(sp.attrs.get("op") == "DeviceToHostExec" for sp in ops)
+    # the root-operator span resolved its output rows (deferred scalars
+    # drained at finalize, never on the hot path)
+    root_ops = [sp for sp in ops
+                if sp.attrs.get("op") == "DeviceToHostExec"]
+    assert sum(sp.rows for sp in root_ops) == 54
+    # operator spans nest under the execute phase
+    by_id = {sp.span_id: sp for sp in tr.spans}
+    for sp in ops:
+        anc = sp
+        while anc.parent_id is not None:
+            anc = by_id[anc.parent_id]
+        assert anc.kind == "query"
+
+
+def test_chrome_export_schema_and_text_timeline():
+    s = _traced_session()
+    df = s.create_dataframe(pa.table({"x": pa.array(range(32))}))
+    df.filter(col("x") > 0).collect()
+    tr = s.last_query_trace()
+    ch = tr.to_chrome()
+    assert set(ch) == {"traceEvents", "displayTimeUnit"}
+    evs = ch["traceEvents"]
+    assert evs and all({"name", "ph", "pid", "tid"} <= set(e)
+                       for e in evs)
+    complete = [e for e in evs if e["ph"] == "X"]
+    assert complete and all("ts" in e and "dur" in e and e["dur"] > 0
+                            for e in complete)
+    assert any(e["name"] == "DeviceToHostExec.execute"
+               for e in complete)
+    txt = tr.to_text()
+    assert "phase:execute" in txt and "DeviceToHostExec" in txt
+
+
+def test_tools_cli_trace_and_accuracy(tmp_path, capsys):
+    import json
+
+    from spark_rapids_tpu.tools.__main__ import main as tools_main
+    s = _traced_session(**{"spark.rapids.tpu.eventLog.dir":
+                           str(tmp_path / "logs")})
+    df = s.create_dataframe(pa.table(
+        {"k": pa.array([i % 3 for i in range(90)]),
+         "v": pa.array(range(90))}))
+    df.group_by(col("k")).agg(F.sum(col("v")).alias("sv")).collect()
+    log_dir = tmp_path / "logs"
+    log = str(next(log_dir.glob("events_*")))
+
+    # profiling --accuracy prints the predicted-vs-actual table
+    rc = tools_main(["profiling", log, "-o", str(tmp_path / "out"),
+                     "--accuracy"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Predicted vs Actual" in out and "actRows" in out
+
+    # trace --export chrome writes Perfetto-loadable JSON
+    chrome_path = tmp_path / "q.trace.json"
+    rc = tools_main(["trace", log, "--export", "chrome", "-o",
+                     str(chrome_path)])
+    assert rc == 0
+    ch = json.loads(chrome_path.read_text())
+    assert ch["traceEvents"] and any(
+        e.get("ph") == "X" for e in ch["traceEvents"])
+
+    # trace --export text prints the timeline
+    rc = tools_main(["trace", log, "--export", "text"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "phase:execute" in out
+
+    # a foreign log (no span records) is a clean error, not a crash
+    foreign = tmp_path / "foreign_log"
+    foreign.write_text('{"Event": "SparkListenerLogStart", '
+                       '"Spark Version": "3.1.1"}\n')
+    assert tools_main(["trace", str(foreign)]) == 2
+
+
+def test_generated_docs_cover_observability():
+    text = cfg.generate_docs()
+    assert "spark.rapids.tpu.eventLog.dir" in text
+    assert "spark.rapids.tpu.trace.enabled" in text
+    from spark_rapids_tpu.docsgen import generate_lint_rules
+    assert "TPU-R006" in generate_lint_rules()
