@@ -124,7 +124,8 @@ def queries(session, fact, dim, pq_path, out_root):
 
 
 def time_engine(enabled: bool, fact, dim, pq_path, out_root,
-                repeats: int = 3, trace: bool = False):
+                repeats: int = 3, trace: bool = False,
+                eventlog_dir: str = None, metrics: bool = None):
     from spark_rapids_tpu.api.session import TpuSession
     extra = {}
     if enabled and os.environ.get("BENCH_TRANSPORT"):
@@ -132,6 +133,10 @@ def time_engine(enabled: bool, fact, dim, pq_path, out_root,
             os.environ["BENCH_TRANSPORT"]
     if trace:
         extra["spark.rapids.tpu.trace.enabled"] = True
+    if eventlog_dir:
+        extra["spark.rapids.tpu.eventLog.dir"] = eventlog_dir
+    if metrics is not None:
+        extra["spark.rapids.tpu.metrics.enabled"] = metrics
     b = TpuSession.builder().config("spark.rapids.sql.enabled", enabled)
     for k, v in extra.items():
         b = b.config(k, v)
@@ -284,11 +289,13 @@ def time_pyspark(fact, dim, pq_path, out_root, repeats: int = 3):
     return out
 
 
-def _device_reachable(timeout_s: float = 180.0) -> bool:
+def _device_reachable(timeout_s: float = 180.0):
     """One tiny round trip with a hard deadline: a dead accelerator
-    tunnel must produce an honest error line, not a hung benchmark."""
+    tunnel must produce an honest error line, not a hung benchmark.
+    Returns (ok, error_string)."""
     import threading
     ok = []
+    err = []
 
     def probe():
         try:
@@ -297,13 +304,17 @@ def _device_reachable(timeout_s: float = 180.0) -> bool:
             import numpy as _np
             _np.asarray(jnp.arange(4) + 1)
             ok.append(True)
-        except Exception:
-            pass
+        except Exception as ex:
+            err.append(repr(ex))
 
     t = threading.Thread(target=probe, daemon=True)
     t.start()
     t.join(timeout_s)
-    return bool(ok)
+    if ok:
+        return True, None
+    if err:
+        return False, f"device probe failed: {err[0]}"
+    return False, f"device probe timed out after {timeout_s:g}s"
 
 
 def measure_trace_overhead(fact, dim, pq_path, out_root) -> float:
@@ -319,32 +330,135 @@ def measure_trace_overhead(fact, dim, pq_path, out_root) -> float:
     return 100.0 * (sum(traced.values()) - base) / base
 
 
+def measure_metrics_overhead(fact, dim, pq_path, out_root) -> float:
+    """Continuous-metrics overhead guard: the suite with the registry
+    feeding vs fully disabled.  The acceptance bar is <2% — every hook
+    is one dict lookup + one locked integer add, nothing touches the
+    device, so the budget holds with a wide margin.
+
+    The 2% bar is tighter than single-run host jitter on small inputs,
+    so each arm runs twice and keeps its noise floor (the minimum):
+    systematic overhead survives a minimum, scheduler hiccups do not."""
+    def floor(metrics_on):
+        totals = []
+        for _ in range(2):
+            t, _c = time_engine(True, fact, dim, pq_path, out_root,
+                                metrics=metrics_on)
+            totals.append(sum(t.values()))
+        return min(totals)
+
+    base = floor(False)
+    return 100.0 * (floor(True) - base) / base
+
+
+def record_history(history_dir: str, eventlog_dir: str,
+                   check: bool, wall_threshold=None) -> int:
+    """Distill this run's event log into the append-only fingerprint
+    history (--record); with --check, diff against the previous run and
+    return 1 on deterministic drift (obs/history.py)."""
+    from spark_rapids_tpu.obs.history import (HistoryDir,
+                                              deterministic_drift,
+                                              diff_runs,
+                                              distill_event_log)
+    hist = HistoryDir(history_dir)
+    fps = []
+    for f in sorted(os.listdir(eventlog_dir)):
+        if f.startswith("events_"):
+            fps += distill_event_log(os.path.join(eventlog_dir, f))
+    path = hist.record(fps, label="bench suite")
+    print(f"bench: recorded {len(fps)} query fingerprint(s) -> {path}",
+          file=sys.stderr)
+    if not check:
+        return 0
+    runs = hist.runs()
+    if len(runs) < 2:
+        print("bench --check: first recorded run, nothing to diff",
+              file=sys.stderr)
+        return 0
+    drifts = diff_runs(hist.load(runs[-2]), hist.load(runs[-1]),
+                       wall_threshold_pct=wall_threshold)
+    for d in drifts:
+        print(f"bench --check: {d.render()}", file=sys.stderr)
+    if deterministic_drift(drifts):
+        print("BENCH REGRESSION CHECK FAILED: deterministic "
+              "fingerprint drift vs the previous recorded run",
+              file=sys.stderr)
+        return 1
+    print("bench --check: no deterministic drift vs previous run",
+          file=sys.stderr)
+    return 0
+
+
+def _arg_value(flag: str, default=None):
+    for a in sys.argv[1:]:
+        if a.startswith(flag + "="):
+            return a.split("=", 1)[1]
+    return default
+
+
+def _cpu_fallback_reexec(probe_error: str) -> None:
+    """The dead-bench guard (BENCH_r01..r05 shipped FIVE rounds of
+    `rows/s = 0.0 (accelerator unreachable)` without anything
+    noticing): when the device probe fails, re-exec the whole suite in
+    a fresh process pinned to JAX_PLATFORMS=cpu — jax may already be
+    wedged half-initialized in THIS process, so an in-process retry
+    cannot work — and emit a REAL suite number tagged
+    `"backend": "cpu_fallback"` with the probe error preserved.  The
+    trajectory keeps an honest measurement instead of a zero."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               BENCH_CPU_FALLBACK_ERROR=probe_error)
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         *sys.argv[1:], "--cpu-fallback"],
+        env=env, capture_output=True, text=True)
+    sys.stderr.write(r.stderr)
+    sys.stdout.write(r.stdout)
+    sys.exit(r.returncode)
+
+
 def main():
     pos = [a for a in sys.argv[1:] if not a.startswith("--")]
     n_rows = int(pos[0]) if pos else 1_000_000
     with_pyspark = "--baseline=pyspark" in sys.argv[1:]
     with_trace_guard = "--trace-overhead" in sys.argv[1:]
-    if not _device_reachable():
-        print(json.dumps({
-            "metric": "sql_suite_rows_per_sec", "value": 0.0,
-            "unit": "rows/s", "vs_baseline": 0.0,
-            "error": "accelerator unreachable (device probe timed out); "
-                     "see docs/performance.md for the last measured "
-                     "suite numbers"}))
-        return
+    with_metrics_guard = "--metrics-overhead" in sys.argv[1:]
+    with_record = "--record" in sys.argv[1:]
+    with_check = "--check" in sys.argv[1:]
+    is_cpu_fallback = "--cpu-fallback" in sys.argv[1:]
+    history_dir = _arg_value("--history", "tpu_bench_history")
+    wall_threshold = _arg_value("--wall-threshold")
+    wall_threshold = float(wall_threshold) if wall_threshold else None
+    if not is_cpu_fallback:
+        reachable, probe_error = _device_reachable()
+        if not reachable:
+            _cpu_fallback_reexec(probe_error)
     fact, dim = make_tables(n_rows)
     root = tempfile.mkdtemp(prefix="spark_rapids_tpu_bench_")
+    eventlog_dir = None
+    if with_record or with_check:
+        eventlog_dir = os.path.join(root, "eventlog")
+        os.makedirs(eventlog_dir, exist_ok=True)
     spark_cpu = None
     trace_overhead = None
+    metrics_overhead = None
+    regress_rc = 0
     try:
         pq_path = write_parquet_input(fact, root)
-        tpu, tpu_compile = time_engine(True, fact, dim, pq_path, root)
+        tpu, tpu_compile = time_engine(True, fact, dim, pq_path, root,
+                                       eventlog_dir=eventlog_dir)
         cpu, _ = time_engine(False, fact, dim, pq_path, root)
         if with_pyspark:
             spark_cpu = time_pyspark(fact, dim, pq_path, root)
         if with_trace_guard:
             trace_overhead = measure_trace_overhead(fact, dim, pq_path,
                                                     root)
+        if with_metrics_guard:
+            metrics_overhead = measure_metrics_overhead(
+                fact, dim, pq_path, root)
+        if with_record or with_check:
+            regress_rc = record_history(history_dir, eventlog_dir,
+                                        with_check, wall_threshold)
     finally:
         shutil.rmtree(root, ignore_errors=True)
     tpu_total = sum(tpu.values())
@@ -379,11 +493,25 @@ def main():
                 detail[k]["spark_cpu_s"] = round(spark_cpu[k], 3)
     if trace_overhead is not None:
         out["trace_overhead_pct"] = round(trace_overhead, 2)
+    if metrics_overhead is not None:
+        out["metrics_overhead_pct"] = round(metrics_overhead, 2)
+    if is_cpu_fallback:
+        # honest provenance: a real rows/s number, measured on the CPU
+        # backend because the accelerator probe failed — never a 0.0
+        out["backend"] = "cpu_fallback"
+        out["probe_error"] = os.environ.get(
+            "BENCH_CPU_FALLBACK_ERROR", "accelerator unreachable")
     print(json.dumps(out))
     if trace_overhead is not None and trace_overhead > 5.0:
         print(f"TRACE OVERHEAD GUARD FAILED: {trace_overhead:.2f}% > 5%",
               file=sys.stderr)
         sys.exit(1)
+    if metrics_overhead is not None and metrics_overhead > 2.0:
+        print(f"METRICS OVERHEAD GUARD FAILED: "
+              f"{metrics_overhead:.2f}% > 2%", file=sys.stderr)
+        sys.exit(1)
+    if regress_rc:
+        sys.exit(regress_rc)
 
 
 if __name__ == "__main__":

@@ -32,11 +32,27 @@ unclosed spans, unflushed event logs, event-log lines the parser
 rejects, or a round-trip mismatch (parsed operator aggregates !=
 live last_query_metrics).
 
+--regress runs the cross-run watchdog gate: the golden query corpus
+replays TWICE in fresh subprocesses (fresh process = fresh JIT/plan
+caches, so both replays see identical steady state), each run's
+self-emitted event log distills into fingerprints (obs/history.py),
+and the gate fails when the two replays show ANY deterministic drift —
+plus anti-vacuity: an injected fallback and an injected fetch-crossing
+bump must each be flagged by the differ.
+
+--metrics runs the continuous-metrics gate: one golden query (plus one
+in-process bridge round trip) must light up nonzero series from >= 6
+distinct subsystems (spill, arena, shuffle, fetch, session queries,
+bridge) in the Prometheus exposition, and the JSON health snapshot
+must carry the expected schema.
+
     python devtools/run_lint.py                    # repo check
     python devtools/run_lint.py --update-baseline  # re-freeze debt
     python devtools/run_lint.py --interp           # plan typechecker gate
     python devtools/run_lint.py --memsan           # lifetime + ledger gate
     python devtools/run_lint.py --obs              # flight-recorder gate
+    python devtools/run_lint.py --regress          # cross-run watchdog gate
+    python devtools/run_lint.py --metrics          # metrics/health gate
 """
 
 import json
@@ -271,6 +287,216 @@ def run_obs_gate() -> int:
     return 0
 
 
+# the golden regression corpus: three deterministic queries covering
+# shuffle (fuse off), join and global sort.  Runs in a FRESH subprocess
+# per replay so process-level caches (JIT, speculative fetch plans,
+# scan pins) start identical — the same steady state two real CI runs
+# see — making the deterministic fingerprint fields exactly comparable.
+_REGRESS_CORPUS = r"""
+import sys
+import numpy as np
+import pyarrow as pa
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col
+
+eventlog_dir = sys.argv[1]
+rng = np.random.default_rng(1234)
+fact = pa.table({
+    "k": pa.array((rng.integers(0, 97, 4000)).astype(np.int64)),
+    "v": pa.array(rng.integers(-1000, 1000, 4000).astype(np.int64)),
+})
+dim = pa.table({
+    "k": pa.array(np.arange(97, dtype=np.int64)),
+    "w": pa.array(np.arange(97, dtype=np.int64) * 3),
+})
+s = (TpuSession.builder()
+     .config("spark.rapids.sql.enabled", True)
+     .config("spark.rapids.tpu.singleChipFuse", "off")
+     .config("spark.rapids.tpu.eventLog.dir", eventlog_dir)
+     .get_or_create())
+fdf = s.create_dataframe(fact, num_partitions=2)
+ddf = s.create_dataframe(dim)
+out1 = (fdf.filter(col("v") > -500).group_by(col("k"))
+        .agg(F.sum(col("v")).alias("sv"), F.count("*").alias("c"))
+        .collect())
+assert out1.num_rows == 97, out1.num_rows
+out2 = (fdf.join(ddf, on="k", how="inner").group_by(col("k"))
+        .agg(F.sum(col("w")).alias("sw")).collect())
+assert out2.num_rows == 97, out2.num_rows
+out3 = fdf.sort(col("k"), col("v")).collect()
+assert out3.num_rows == 4000, out3.num_rows
+print("CORPUS_OK")
+"""
+
+
+def _replay_corpus(eventlog_dir: str) -> str:
+    """One fresh-process replay of the golden corpus; returns the
+    event-log path."""
+    import subprocess
+    r = subprocess.run(
+        [sys.executable, "-c", _REGRESS_CORPUS, eventlog_dir],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS=os.environ.get(
+            "JAX_PLATFORMS", "cpu")))
+    if r.returncode != 0 or "CORPUS_OK" not in r.stdout:
+        raise RuntimeError(f"corpus replay failed rc={r.returncode}:\n"
+                           f"{r.stdout}\n{r.stderr}")
+    logs = [f for f in os.listdir(eventlog_dir)
+            if f.startswith("events_")]
+    if len(logs) != 1:
+        raise RuntimeError(f"expected 1 event log, found {logs}")
+    return os.path.join(eventlog_dir, logs[0])
+
+
+def run_regress_gate() -> int:
+    import copy
+    import shutil
+    import tempfile
+
+    from spark_rapids_tpu.obs.history import (HistoryDir,
+                                              deterministic_drift,
+                                              diff_runs,
+                                              distill_event_log)
+
+    failures = 0
+    root = tempfile.mkdtemp(prefix="regress_gate_")
+    try:
+        hist = HistoryDir(os.path.join(root, "history"))
+        for i in (1, 2):
+            d = os.path.join(root, f"run{i}")
+            os.makedirs(d)
+            hist.record(distill_event_log(_replay_corpus(d)),
+                        label=f"gate replay {i}")
+        runs = hist.runs()
+        run1, run2 = hist.load(runs[-2]), hist.load(runs[-1])
+        drift = deterministic_drift(diff_runs(run1, run2))
+        for dr in drift:
+            failures += 1
+            print(f"REPLAY DRIFT: {dr.render()}")
+
+        # anti-vacuity: the differ must FLAG injected regressions —
+        # a watchdog that never barks is worse than none
+        tampered = copy.deepcopy(run2)
+        q0 = tampered["queries"][0]
+        q0["fallback_ops"] = sorted(q0["fallback_ops"] +
+                                    ["InjectedHostOnlyExec"])
+        q1 = tampered["queries"][min(1, len(tampered["queries"]) - 1)]
+        q1["fetch_crossings"] = q1.get("fetch_crossings", 0) + 5
+        kinds = {d.kind for d in
+                 deterministic_drift(diff_runs(run1, tampered))}
+        for want in ("new_fallback", "crossing_growth"):
+            if want not in kinds:
+                failures += 1
+                print(f"VACUOUS DIFFER: injected {want} not flagged "
+                      f"(got {sorted(kinds)})")
+        n = len(run2.get("queries", ()))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    if failures:
+        print(f"regress gate: {failures} failure(s)")
+        return 1
+    print(f"regress gate clean ({n} golden queries replayed twice with "
+          f"identical deterministic fingerprints; injected fallback + "
+          f"crossing bump both flagged)")
+    return 0
+
+
+# subsystem -> Prometheus family prefixes that must show a nonzero
+# series after the golden query + bridge round trip (ISSUE acceptance:
+# >= 6 distinct subsystems)
+_METRIC_SUBSYSTEMS = {
+    "spill": ("tpu_spill_",),
+    "arena": ("tpu_arena_",),
+    "shuffle": ("tpu_shuffle_",),
+    "fetch": ("tpu_fetch_",),
+    "session": ("tpu_queries_",),
+    "ici/bridge": ("tpu_bridge_", "tpu_ici_"),
+}
+
+
+def run_metrics_gate() -> int:
+    import threading
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.bridge import BridgeClient, SidecarServer
+    from spark_rapids_tpu.obs.health import (HealthMonitor,
+                                             render_prometheus)
+    from spark_rapids_tpu.obs.metrics import MetricsRegistry
+
+    failures = 0
+    reg = MetricsRegistry.reset_for_tests()
+    s = (TpuSession.builder()
+         .config("spark.rapids.sql.enabled", True)
+         .config("spark.rapids.tpu.singleChipFuse", "off")
+         .config("spark.rapids.memory.pinnedPool.size", "8m")
+         .config("spark.rapids.memory.tpu.spillBudgetBytes", 1)
+         .get_or_create())
+    tb = pa.table({
+        "k": pa.array((np.arange(2000) % 53).astype(np.int64)),
+        "v": pa.array(np.arange(2000, dtype=np.int64))})
+    out = (s.create_dataframe(tb, num_partitions=2)
+           .filter(col("v") > 5).group_by(col("k"))
+           .agg(F.sum(col("v")).alias("sv")).collect())
+    assert out.num_rows == 53, out.num_rows
+
+    # one bridge round trip against the in-process reference sidecar
+    server = SidecarServer(port=0)
+    t = threading.Thread(target=server.serve_forever,
+                         kwargs={"announce": False}, daemon=True)
+    t.start()
+    try:
+        client = BridgeClient(server.port)
+        res = client.execute_stage(
+            {"ops": [{"op": "filter",
+                      "condition": {"op": "gt",
+                                    "children": [
+                                        {"col": "v"},
+                                        {"lit": 100,
+                                         "type": "bigint"}]}}]},
+            pa.table({"k": pa.array([1, 2, 3], pa.int64()),
+                      "v": pa.array([50, 150, 250], pa.int64())}))
+        assert res.num_rows == 2, res.num_rows
+        client.close()
+    finally:
+        server.shutdown()
+
+    text = render_prometheus(reg)
+    lit = set()
+    for sub, prefixes in _METRIC_SUBSYSTEMS.items():
+        nonzero = [
+            line for line in text.splitlines()
+            if any(line.startswith(p) for p in prefixes)
+            and not line.startswith("#")
+            and float(line.rsplit(None, 1)[-1]) > 0]
+        if nonzero:
+            lit.add(sub)
+        else:
+            failures += 1
+            print(f"METRICS: subsystem {sub} exposed no nonzero "
+                  f"series (prefixes {prefixes})")
+    snap = HealthMonitor(reg).snapshot()
+    for key in ("status", "timestamp_ms", "components", "queries"):
+        if key not in snap:
+            failures += 1
+            print(f"HEALTH: snapshot missing key {key!r}")
+    if snap.get("status") not in ("ok", "degraded", "down"):
+        failures += 1
+        print(f"HEALTH: bad status {snap.get('status')!r}")
+    if failures:
+        print(f"metrics gate: {failures} failure(s)")
+        return 1
+    print(f"metrics gate clean ({len(lit)} subsystems exposed nonzero "
+          f"Prometheus series from one golden query + one bridge round "
+          f"trip; health snapshot schema ok)")
+    return 0
+
+
 def main(argv=None):
     args = argv if argv is not None else sys.argv[1:]
     if "--interp" in args:
@@ -279,6 +505,10 @@ def main(argv=None):
         return run_memsan_gate()
     if "--obs" in args:
         return run_obs_gate()
+    if "--regress" in args:
+        return run_regress_gate()
+    if "--metrics" in args:
+        return run_metrics_gate()
     from spark_rapids_tpu.tools.__main__ import main as tools_main
     cli = ["lint", "--repo", "--baseline", BASELINE]
     if "--update-baseline" in args:

@@ -73,6 +73,17 @@ R006 = register_rule(
     "recorder, so the engine has ONE timing path that metrics, traces "
     "and the self-emitted event log all agree on.")
 
+R007 = register_rule(
+    "TPU-R007", ERROR, "ad-hoc module-level metric tally",
+    "A module-level mutable counter (integer tally, Counter(), "
+    "defaultdict tally, or a dict/list/set whose name says it counts) "
+    "in exec/, ops/, shuffle/, parallel/ or memory/: process-wide "
+    "statistics must route through obs.metrics.MetricsRegistry so they "
+    "are thread-safe, cardinality-bounded, and visible to the "
+    "Prometheus/health exposition and the regression watchdog — an "
+    "ad-hoc global is invisible to all three.  Sanctioned sinks are "
+    "annotated `# tpulint: allow[TPU-R007]` in place.")
+
 R005 = register_rule(
     "TPU-R005", ERROR, "device allocation outside the catalog/arena APIs",
     "Code in exec/ or ops/ constructs a SpillableBatch directly, calls "
@@ -91,6 +102,8 @@ _SYNC_RECEIVERS = {"asarray": {"np", "numpy"}, "device_get": {"jax"}}
 _TIMING_PATHS = ("spark_rapids_tpu/exec/", "spark_rapids_tpu/ops/",
                  "spark_rapids_tpu/shuffle/", "spark_rapids_tpu/parallel/")
 _TIMING_CALLS = {"perf_counter", "perf_counter_ns"}
+# one-metrics-path packages for TPU-R007 (engine-statistics producers)
+_TALLY_PATHS = _TIMING_PATHS + ("spark_rapids_tpu/memory/",)
 
 # `# tpulint: allow[TPU-Rxxx] <reason>` on the flagged line or the line
 # above sanctions one deliberate violation (the annotated-sink analog of
@@ -244,6 +257,99 @@ class _TimingVisitor(_ScopedVisitor):
         self.generic_visit(node)
 
 
+_TALLY_NAME = _re.compile(
+    r"(^|_)(n|num|count(er)?s?|totals?|tall(y|ies)|hits?|miss(es)?|"
+    r"calls?|stats?)(_|$|\d)", _re.I)
+
+
+def _is_tally_name(name: str) -> bool:
+    return bool(_TALLY_NAME.search(name))
+
+
+def module_tally_diagnostics(source_or_tree, relpath: str):
+    """TPU-R007 over ONE module's top level (factored out so tests can
+    run it against synthetic sources).  Flags:
+
+      * a module-level Counter()/defaultdict(int|float) binding — these
+        containers exist to count, whatever the name says;
+      * a module-level int/float literal, empty dict/list/set literal
+        or dict()/list()/set() call bound to a counter-ish name
+        (``_FOO_COUNT``, ``TOTALS``, ``_hits`` ...);
+      * a module-level augmented assignment to a counter-ish name
+        (``_N_CALLS += 1``).
+
+    Lookup tables, caches and registries (names without a counting
+    word) stay legal: the rule targets tallies, not constants.
+    """
+    tree = source_or_tree if isinstance(source_or_tree, ast.Module) \
+        else ast.parse(source_or_tree, filename=relpath)
+    diags: List[Diagnostic] = []
+
+    def _is_counting_container(v) -> bool:
+        if not isinstance(v, ast.Call):
+            return False
+        f = v.func
+        name = f.id if isinstance(f, ast.Name) else \
+            f.attr if isinstance(f, ast.Attribute) else ""
+        if name == "Counter":
+            return True
+        if name == "defaultdict" and v.args and \
+                isinstance(v.args[0], ast.Name) and \
+                v.args[0].id in ("int", "float"):
+            return True
+        return False
+
+    def _is_mutable_zero(v) -> bool:
+        if isinstance(v, ast.Constant) and \
+                isinstance(v.value, (int, float)) and \
+                not isinstance(v.value, bool):
+            return True
+        if isinstance(v, (ast.Dict, ast.List, ast.Set)):
+            return not (getattr(v, "keys", None) or
+                        getattr(v, "elts", None))
+        if isinstance(v, ast.Call) and isinstance(v.func, ast.Name) and \
+                v.func.id in ("dict", "list", "set") and not v.args \
+                and not v.keywords:
+            return True
+        return False
+
+    for node in tree.body:
+        targets = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets = [t for t in node.targets
+                       if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and \
+                node.value is not None:
+            targets = [node.target]
+            value = node.value
+        elif isinstance(node, ast.AugAssign) and \
+                isinstance(node.target, ast.Name):
+            if _is_tally_name(node.target.id):
+                diags.append(R007.diag(
+                    f"module-level tally mutation "
+                    f"{node.target.id} {type(node.op).__name__}=; "
+                    f"route through obs.metrics.MetricsRegistry",
+                    loc=f"{relpath}:{node.lineno}"))
+            continue
+        if not targets or value is None:
+            continue
+        for t in targets:
+            if _is_counting_container(value):
+                diags.append(R007.diag(
+                    f"module-level counting container {t.id}; route "
+                    f"through obs.metrics.MetricsRegistry",
+                    loc=f"{relpath}:{node.lineno}"))
+            elif _is_tally_name(t.id) and _is_mutable_zero(value):
+                diags.append(R007.diag(
+                    f"module-level mutable tally {t.id}; route "
+                    f"through obs.metrics.MetricsRegistry",
+                    loc=f"{relpath}:{node.lineno}"))
+    return diags
+
+
 class _EnvReadVisitor(_ScopedVisitor):
     def __init__(self, relpath: str, declared: Set[str]):
         super().__init__()
@@ -307,6 +413,8 @@ def _ast_diagnostics(root: str) -> List[Diagnostic]:
             tv = _TimingVisitor(relpath)
             tv.visit(tree)
             file_diags.extend(tv.diags)
+        if any(relpath.startswith(h) for h in _TALLY_PATHS):
+            file_diags.extend(module_tally_diagnostics(tree, relpath))
         ev = _EnvReadVisitor(relpath, declared)
         ev.visit(tree)
         file_diags.extend(ev.diags)

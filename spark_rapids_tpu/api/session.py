@@ -44,6 +44,16 @@ class TpuSession:
 
     def _init_runtime(self):
         conf = self.conf
+        # continuous metrics: the registry collects by default (cheap);
+        # the HTTP exposition endpoint is opt-in via metrics.port
+        from ..obs import metrics as obs_metrics
+        obs_metrics.set_enabled(conf.get(cfg.METRICS_ENABLED))
+        port = conf.get(cfg.METRICS_PORT)
+        if port is not None and conf.get(cfg.METRICS_ENABLED):
+            from ..obs.health import ensure_server
+            self.metrics_server = ensure_server(port)
+        else:
+            self.metrics_server = None
         from ..memory.meta import set_default_codec
         set_default_codec(conf.get(cfg.SHUFFLE_COMPRESSION_CODEC))
         from ..shims import ShimLoader, set_active_shim
@@ -186,10 +196,28 @@ class TpuSession:
         with trace_span("phase:overrides", kind="phase") as sp:
             overrides = TpuOverrides(self.conf)
             final_plan = overrides.apply(physical)
-            sp.set(lint_diags=len(getattr(overrides, "last_lint", [])))
+            lint = getattr(overrides, "last_lint", [])
+            sp.set(lint_diags=len(lint),
+                   lint_rules=sorted({d.code for d in lint}))
         self.last_plan = final_plan
         self.last_explain = overrides.last_explain
+        self._count_fallbacks(final_plan)
         return final_plan
+
+    def _count_fallbacks(self, final_plan) -> None:
+        """Feed tpu_fallback_ops_total: operators the overrides engine
+        left on the host engine, by exec name (a growing fallback set
+        is the regression watchdog's loudest deterministic signal)."""
+        from ..exec.base import CPU
+        from ..obs import metrics as m
+        if not m.enabled():
+            return
+        fam = m.counter("tpu_fallback_ops_total",
+                        "plan operators left on the host engine",
+                        ("op",))
+        final_plan.foreach(
+            lambda e: fam.labels(op=type(e).__name__).inc()
+            if e.placement == CPU else None)
 
     def release_plan_shuffles(self, final_plan) -> None:
         """Release shuffle blocks a plan registered in the global spill
@@ -211,6 +239,25 @@ class TpuSession:
             if hasattr(e, "release_shuffle") else None)
 
     def execute(self, lp: L.LogicalPlan) -> pa.Table:
+        """Execute + collect, under the continuous query-lifecycle
+        metrics (active/completed/failed) every health probe reads."""
+        from ..obs import metrics as m
+        m.gauge("tpu_queries_active",
+                "queries currently executing").gauge_inc()
+        try:
+            result = self._execute(lp)
+        except BaseException:
+            m.counter("tpu_queries_failed_total",
+                      "queries that raised").inc()
+            raise
+        finally:
+            m.gauge("tpu_queries_active",
+                    "queries currently executing").dec()
+        m.counter("tpu_queries_completed_total",
+                  "queries that returned a result").inc()
+        return result
+
+    def _execute(self, lp: L.LogicalPlan) -> pa.Table:
         from ..obs import tracer as obs
         conf = self.conf
         eventlog_dir = conf.get(cfg.EVENT_LOG_DIR)
@@ -274,6 +321,9 @@ class TpuSession:
                 # nothing was surfaced — but any cache materialization
                 # this run streamed is built on truncated batches and
                 # must be discarded before the exact re-execution
+                from ..obs import metrics as m
+                m.counter("tpu_queries_retried_total",
+                          "speculation-miss exact re-executions").inc()
                 from ..io.cached_batch import CacheWriteExec
 
                 def _reset_cache(node):
@@ -314,7 +364,14 @@ class TpuSession:
                 # everything the query registered must have reached
                 # CLOSED (pinned scan caches are sanctioned residents);
                 # leaks surface with owning-exec provenance
-                ledger.assert_clean()
+                try:
+                    ledger.assert_clean()
+                except BaseException:
+                    from ..obs import metrics as m
+                    m.counter("tpu_memsan_dirty_ledgers_total",
+                              "queries whose shadow ledger was dirty "
+                              "(leak or lifecycle violation)").inc()
+                    raise
             finally:
                 if tracer is not None:
                     tracer.measured_peak_device_bytes = \
@@ -333,6 +390,22 @@ class TpuSession:
         if tracer is not None:
             self._flush_query_obs(tracer, None, eventlog_dir)
         return result
+
+    # -- continuous metrics -------------------------------------------------
+    _health_monitor = None
+
+    def metrics_snapshot(self) -> Dict:
+        """The JSON health document the /healthz endpoint serves —
+        status derived from arena exhaustion, memsan ledger state,
+        heartbeat misses and device-probe liveness — plus the full
+        Prometheus exposition text under ``prometheus`` (the same
+        surface without running an HTTP server)."""
+        from ..obs.health import HealthMonitor, render_prometheus
+        if TpuSession._health_monitor is None:
+            TpuSession._health_monitor = HealthMonitor()
+        snap = TpuSession._health_monitor.snapshot()
+        snap["prometheus"] = render_prometheus()
+        return snap
 
     # -- flight recorder ----------------------------------------------------
     def last_query_trace(self):

@@ -42,7 +42,11 @@ class BridgeClient:
 
     def execute_stage(self, spec: dict, table: pa.Table,
                       extra_tables=()) -> pa.Table:
+        import time
+
+        from ..obs import metrics as m
         from ..obs.tracer import trace_span
+        t0 = time.perf_counter()
         with trace_span("bridge.execute_stage",
                         op=str(spec.get("op", ""))) as obs_sp:
             blob = json.dumps(spec).encode()
@@ -71,6 +75,16 @@ class BridgeClient:
                 out = r.read_all()
             obs_sp.set(request_bytes=sent, response_bytes=n,
                        rows=out.num_rows)
+            m.counter("tpu_bridge_round_trips_total",
+                      "sidecar execute_stage round trips").inc()
+            m.counter("tpu_bridge_request_bytes_total",
+                      "Arrow IPC bytes sent to the sidecar").inc(sent)
+            m.counter("tpu_bridge_response_bytes_total",
+                      "Arrow IPC bytes received from the sidecar") \
+                .inc(n)
+            m.histogram("tpu_bridge_latency_seconds",
+                        "execute_stage round-trip latency") \
+                .observe(time.perf_counter() - t0)
             return out
 
     def shutdown_sidecar(self):

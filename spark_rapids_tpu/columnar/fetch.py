@@ -47,6 +47,22 @@ def _is_device(x) -> bool:
     return isinstance(x, jax.Array)
 
 
+def _note_crossing(transfers: int, nbytes: int) -> None:
+    """Account one device->host fetch: continuous counters plus a
+    flight-recorder event — the per-query crossing count is a
+    DETERMINISTIC regression-watchdog field (obs/history.py), so every
+    sanctioned crossing must announce itself here."""
+    from ..obs import metrics as m
+    from ..obs.tracer import trace_event
+    m.counter("tpu_fetch_crossings_total",
+              "device->host transfer round trips through the "
+              "sanctioned fetch path").inc(transfers)
+    m.counter("tpu_fetch_bytes_total",
+              "bytes moved device->host through the sanctioned fetch "
+              "path").inc(nbytes)
+    trace_event("fetch.crossing", transfers=transfers, bytes=nbytes)
+
+
 def fetch_ints(scalars: Sequence) -> List[int]:
     """Resolve a mixed list of host/device integer scalars to python ints
     in at most ONE device transfer.
@@ -67,6 +83,7 @@ def fetch_ints(scalars: Sequence) -> List[int]:
             out.append(int(s))
     if dev_vals:
         fetched = np.asarray(jnp.stack(dev_vals))  # one transfer
+        _note_crossing(1, fetched.nbytes)
         for i, v in zip(dev_idx, fetched):
             out[i] = int(v)
     return out  # type: ignore[return-value]
@@ -75,7 +92,10 @@ def fetch_ints(scalars: Sequence) -> List[int]:
 def fetch_array(x) -> np.ndarray:
     """Sanctioned single-transfer host materialization of one device
     array (e.g. the join count phase's stacked sizes vector)."""
-    return np.asarray(x)
+    out = np.asarray(x)
+    if _is_device(x):
+        _note_crossing(1, out.nbytes)
+    return out
 
 
 def batch_is_device(batch: DeviceBatch) -> bool:
@@ -517,8 +537,10 @@ def fetch_batch(batch: DeviceBatch,
         fetched = jax.device_get((sizes_dev,) + tuple(spec_out))  # 1 sync
         sizes = np.asarray(fetched[0])
         spec_bufs = fetched[1:]
+        _note_crossing(1, sum(int(b.nbytes) for b in fetched))
     else:
         sizes = np.asarray(sizes_fn(batch, extras_t))  # round trip 1
+        _note_crossing(1, sizes.nbytes)
     extra_vals = sizes[len(sizes) - n_extra:] if n_extra else None
     if n_extra:
         sizes = sizes[:len(sizes) - n_extra]
@@ -558,6 +580,7 @@ def fetch_batch(batch: DeviceBatch,
                               lambda: _make_shrink_pack_fn(out_cap, vc,
                                                            plan))
         bufs = jax.device_get(pack_fn(batch))    # round trip 2 (one sync)
+        _note_crossing(1, sum(int(b.nbytes) for b in bufs))
     this_plan = (out_cap, vc, plan)
     prev = _LAST_PLAN.get(pkey)
     if len(_LAST_PLAN) > 256 and pkey not in _LAST_PLAN:

@@ -592,6 +592,39 @@ EVENT_LOG_DIR = conf("spark.rapids.tpu.eventLog.dir").string() \
          "JobFailed.  Enables tracing for the logged queries.") \
     .create_optional()
 
+# --- continuous metrics / health / regression watchdog --------------------
+
+METRICS_ENABLED = conf("spark.rapids.tpu.metrics.enabled").boolean() \
+    .doc("Feed the process-wide metrics registry (obs/metrics.py): "
+         "counters/gauges/histograms from the spill catalog, staging "
+         "arena, shuffle, ICI, bridge, fetch path and session query "
+         "lifecycle.  Cheap by design (one locked integer add per "
+         "event, <2% on the benchmark suite — bench.py "
+         "--metrics-overhead guards it); read back via "
+         "session.metrics_snapshot(), the Prometheus endpoint "
+         "(metrics.port) or obs.health.render_prometheus().") \
+    .create_with_default(True)
+
+METRICS_PORT = conf("spark.rapids.tpu.metrics.port").integer() \
+    .doc("When set, serve GET /metrics (Prometheus text format) and "
+         "GET /healthz (JSON health snapshot derived from arena "
+         "exhaustion, memsan ledger, heartbeat misses and device-probe "
+         "liveness) on this localhost port via a stdlib HTTP daemon "
+         "thread.  0 binds an ephemeral port (tests).  Unset: no "
+         "endpoint (the default — exposition is opt-in, collection is "
+         "not).") \
+    .create_optional()
+
+REGRESS_HISTORY_DIR = conf("spark.rapids.tpu.regress.historyDir") \
+    .string() \
+    .doc("Append-only directory of per-run query fingerprints for the "
+         "cross-run regression watchdog (obs/history.py): `tools "
+         "regress --record` distills self-emitted event logs into it "
+         "and `tools regress --check` / `bench.py --check` diff the "
+         "two most recent runs, failing on deterministic drift (new "
+         "fallbacks, fetch-crossing growth, operator row drift).") \
+    .create_optional()
+
 # Environment variables the engine reads directly (escape hatches that
 # must exist before config parsing, e.g. cache sizing at import time).
 # The repo lint (TPU-R002) fails on any SPARK_RAPIDS_* env read not
@@ -601,6 +634,10 @@ DECLARED_ENV_KEYS = (
     "SPARK_RAPIDS_TPU_JIT_CACHE_MAX",
     # disable the persistent XLA compile cache (plugin.py startup)
     "SPARK_RAPIDS_TPU_DISABLE_COMPILE_CACHE",
+    # hard deadline (seconds) on TPU device discovery before the
+    # single-chip/skip fallback (parallel/mesh.py; the MULTICHIP rc=124
+    # hang guard) — read before any conf exists
+    "SPARK_RAPIDS_TPU_DEVICE_PROBE_TIMEOUT_S",
 )
 
 

@@ -64,7 +64,12 @@ def serialize_batch(batch: DeviceBatch,
         body = ncodec.zstd_compress(body)
     head = _HEADER.pack(MAGIC, VERSION, codec, int(batch.num_rows),
                         len(body))
-    return head + body
+    # spill/shuffle payloads stage through the shared pinned arena when
+    # one is configured (spark.rapids.memory.pinnedPool.size): one
+    # page-aligned native buffer instead of per-call heap churn, and
+    # the arena's utilization gauges see every serialized batch
+    from ..native.arena import stage_bytes
+    return stage_bytes(head + body)
 
 
 def deserialize_batch(data: bytes, xp=np) -> DeviceBatch:

@@ -49,6 +49,26 @@ def _trace_event(name: str, **attrs) -> None:
         tr.event(name, **attrs)
 
 
+def _metrics():
+    """Continuous-metrics families for the spill subsystem (obs/metrics
+    creation is idempotent; increments are no-ops when disabled)."""
+    from ..obs import metrics as m
+    return (
+        m.counter("tpu_spill_registered_batches_total",
+                  "spillable batches registered in the catalog"),
+        m.counter("tpu_spill_registered_bytes_total",
+                  "device bytes entering the spill catalog"),
+        m.counter("tpu_spill_bytes_total",
+                  "bytes demoted per destination tier", ("tier",)),
+        m.counter("tpu_spill_pinned_evictions_total",
+                  "pinned scan-cache entries evicted under pressure"),
+        m.gauge("tpu_spill_device_bytes",
+                "registered device-resident bytes (incl. pinned)"),
+        m.gauge("tpu_spill_host_bytes",
+                "serialized bytes held in the HOST tier"),
+    )
+
+
 class StorageTier(Enum):
     DEVICE = 0
     HOST = 1
@@ -116,6 +136,7 @@ class SpillableBatch:
             led.on_spill(self.id, self.device_bytes)
         _trace_event("spill.host", bytes=self.device_bytes,
                      buffer=self.id[:8])
+        _metrics()[2].labels(tier="host").inc(self.device_bytes)
         return self.device_bytes
 
     def spill_to_disk(self):
@@ -134,6 +155,7 @@ class SpillableBatch:
         if led is not None:
             led.on_spill(self.id, 0)  # host tier -> disk: no HBM delta
         _trace_event("spill.disk", bytes=freed, buffer=self.id[:8])
+        _metrics()[2].labels(tier="disk").inc(freed)
         return freed
 
     def get_batch(self, xp) -> DeviceBatch:
@@ -278,13 +300,26 @@ class SpillCatalog:
                 import traceback
                 self._created_at[sb.id] = "".join(
                     traceback.format_stack(limit=8)[:-1])
+        mm = _metrics()
+        mm[0].inc()
+        mm[1].inc(sb.device_bytes)
         self.maybe_spill()
+        self._update_gauges()
         return sb
 
     def unregister(self, sb: SpillableBatch):
         with self._reg_lock:
             self._buffers.pop(sb.id, None)
             self._created_at.pop(sb.id, None)
+        self._update_gauges()
+
+    def _update_gauges(self) -> None:
+        from ..obs import metrics as m
+        if not m.enabled():
+            return  # the O(buffers) sums below are not free
+        mm = _metrics()
+        mm[4].set(self.device_bytes_registered())
+        mm[5].set(self.host_bytes_registered())
 
     def leak_report(self) -> List[tuple]:
         """(id, tier, bytes, provenance) for every still-open buffer —
@@ -315,7 +350,9 @@ class SpillCatalog:
         with self._reg_lock:
             self._pinned[(id(owner), key)] = nbytes
             self._pin_owners[(id(owner), key)] = owner
+        _metrics()[1].inc(nbytes)
         self.maybe_spill()
+        self._update_gauges()
 
     def pinned_bytes(self) -> int:
         with self._reg_lock:
@@ -338,6 +375,7 @@ class SpillCatalog:
                 freed += nbytes
                 self.pinned_evicted_bytes += nbytes
                 _trace_event("spill.evict_pinned", bytes=nbytes)
+                _metrics()[3].inc()
         return freed
 
     def note_unspill(self, sb: SpillableBatch):
@@ -373,6 +411,7 @@ class SpillCatalog:
                 freed += b.spill_to_host()
                 self.spilled_to_host_bytes += b.host_size()
             self._enforce_host_budget()
+        self._update_gauges()
         return freed
 
     def _enforce_host_budget(self):

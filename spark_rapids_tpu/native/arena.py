@@ -29,6 +29,21 @@ def _trace_event(name: str, **attrs) -> None:
         tr.event(name, **attrs)
 
 
+def _metrics():
+    """(allocs_total, exhaustions_total, used_bytes, utilization)."""
+    from ..obs import metrics as m
+    return (
+        m.counter("tpu_arena_allocs_total",
+                  "staging-arena allocations served"),
+        m.counter("tpu_arena_exhaustions_total",
+                  "allocations refused because the arena was full"),
+        m.gauge("tpu_arena_used_bytes",
+                "bytes currently bump-allocated in the staging arena"),
+        m.gauge("tpu_arena_utilization_ratio",
+                "staging-arena used/capacity at the last allocation"),
+    )
+
+
 class HostArena:
     def __init__(self, capacity: int = 64 << 20):
         self.capacity = capacity
@@ -56,26 +71,35 @@ class HostArena:
             led.on_arena_alloc(
                 self._arena_id,
                 size if self._closed else self.used + size, self._closed)
+        mm = _metrics()
         with self._lock:
             if self._arena is not None:
                 off = self._lib.tpu_arena_alloc(self._arena, size, align)
                 if off < 0:
                     _trace_event("arena.exhausted", wanted=size,
                                  capacity=self.capacity)
+                    mm[1].inc()
                     return None
                 base = self._lib.tpu_arena_base(self._arena)
-                return memoryview(
+                out = memoryview(
                     (ctypes.c_uint8 * size).from_address(
                         ctypes.addressof(base.contents) + off)).cast("B")
-            off = (self._used + align - 1) & ~(align - 1)
-            if off + size > self.capacity:
-                _trace_event("arena.exhausted", wanted=size,
-                             capacity=self.capacity)
-                return None
-            self._used = off + size
-            self._high = max(self._high, self._used)
-            self._n += 1
-            return memoryview(self._buf)[off:off + size]
+            else:
+                off = (self._used + align - 1) & ~(align - 1)
+                if off + size > self.capacity:
+                    _trace_event("arena.exhausted", wanted=size,
+                                 capacity=self.capacity)
+                    mm[1].inc()
+                    return None
+                self._used = off + size
+                self._high = max(self._high, self._used)
+                self._n += 1
+                out = memoryview(self._buf)[off:off + size]
+            used = self.used
+        mm[0].inc()
+        mm[2].set(used)
+        mm[3].set(used / self.capacity if self.capacity else 0.0)
+        return out
 
     def reset(self):
         with self._lock:
@@ -83,6 +107,32 @@ class HostArena:
                 self._lib.tpu_arena_reset(self._arena)
             else:
                 self._used = 0
+        mm = _metrics()
+        mm[2].set(0)
+        mm[3].set(0.0)
+
+    def stage(self, data) -> bytes:
+        """Stage a bytes-like payload through the arena: alloc, copy,
+        hand back an immutable copy backed by the (page-aligned, native
+        when available) staging buffer.  A full arena resets first —
+        staged payloads are consumed immediately by the caller, so the
+        bump pointer can recycle; a payload larger than the whole arena
+        bypasses it (counted as an exhaustion by alloc())."""
+        size = len(data)
+        if self._closed:
+            return bytes(data)
+        if size == 0 or size > self.capacity:
+            if size > self.capacity:
+                _metrics()[1].inc()
+            return bytes(data)
+        mv = self.alloc(size)
+        if mv is None:
+            self.reset()
+            mv = self.alloc(size)
+            if mv is None:
+                return bytes(data)
+        mv[:] = data
+        return bytes(mv)
 
     @property
     def used(self) -> int:
@@ -116,3 +166,41 @@ class HostArena:
             self.close()
         except Exception:
             pass
+
+
+# ---------------------------------------------------------------------------
+# process-wide shared staging arena
+# (spark.rapids.memory.pinnedPool.size; the reference's pinned staging
+#  pool, GpuDeviceManager.scala:302 — serialize/spill payloads stage
+#  through ONE page-aligned native buffer instead of per-call mallocs)
+# ---------------------------------------------------------------------------
+
+_shared: "Optional[HostArena]" = None
+_shared_lock = threading.Lock()
+
+
+def configure_shared_arena(capacity: int) -> "Optional[HostArena]":
+    """(Re)create the shared staging arena; capacity <= 0 disables it.
+    Called by the executor plugin from the pinnedPool.size config."""
+    global _shared
+    with _shared_lock:
+        if _shared is not None:
+            _shared.close()
+            _shared = None
+        if capacity > 0:
+            _shared = HostArena(capacity)
+        return _shared
+
+
+def shared_arena() -> "Optional[HostArena]":
+    return _shared
+
+
+def stage_bytes(data) -> bytes:
+    """Stage a serialized payload through the shared arena when one is
+    configured (spill/shuffle serialization calls this); plain bytes
+    otherwise."""
+    a = _shared
+    if a is None:
+        return data if isinstance(data, bytes) else bytes(data)
+    return a.stage(data)

@@ -1,6 +1,9 @@
-"""Observability subsystem (the flight recorder): span tracer, self-
-emitted SparkListener event logs, Chrome-trace/text exporters, and the
-predicted-vs-actual accuracy loop.  See docs/observability.md."""
+"""Observability subsystem: the flight recorder (span tracer, self-
+emitted SparkListener event logs, Chrome-trace/text exporters, the
+predicted-vs-actual accuracy loop) plus the CONTINUOUS layer — the
+process-wide metrics registry (obs/metrics.py), the Prometheus/health
+exposition (obs/health.py) and the cross-run regression watchdog
+(obs/history.py).  See docs/observability.md."""
 
 from .tracer import (QueryTrace, active_tracer, install, trace_event,
                      trace_span, uninstall)

@@ -48,6 +48,9 @@ def plan_info(node, tracer=None) -> Dict[str, Any]:
         "simpleString": node.describe(),
         "children": [plan_info(c, tracer) for c in node.children],
         "metrics": metrics,
+        # host-vs-TPU placement rides the plan so the regression
+        # watchdog (obs/history.py) can fingerprint the fallback set
+        "tpuPlacement": getattr(node, "placement", ""),
     }
     if tracer is not None:
         pred = tracer.predictions.get(id(node))

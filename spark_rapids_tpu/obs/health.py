@@ -1,0 +1,274 @@
+"""Health & exposition: the machine-scrapable surface over the
+process-wide MetricsRegistry (obs/metrics.py).
+
+Three consumers, one source of truth:
+
+* ``render_prometheus()`` — the registry in Prometheus text exposition
+  format 0.0.4 (``# HELP`` / ``# TYPE`` + series lines; histograms as
+  cumulative ``_bucket{le=...}`` plus ``_sum``/``_count``).
+* ``HealthMonitor.snapshot()`` — a JSON health document whose status is
+  DERIVED from the same counters: arena-exhaustion rate, dirty memsan
+  ledgers, shuffle heartbeat misses and device-probe liveness each map
+  to a component status; the worst component wins.  Rates are deltas
+  since the previous snapshot, so a counter that stopped moving stops
+  hurting the status (an exhaustion storm an hour ago is history, not
+  an alert).
+* ``MetricsServer`` — an opt-in stdlib HTTP endpoint
+  (``spark.rapids.tpu.metrics.port``) serving ``GET /metrics``
+  (Prometheus) and ``GET /healthz`` (the JSON snapshot), the scrape
+  target a deployment points Prometheus/k8s probes at.  Daemon threads
+  only: the server must never keep the engine process alive.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import metrics as M
+
+OK = "ok"
+DEGRADED = "degraded"
+DOWN = "down"
+
+_SEVERITY = {OK: 0, DEGRADED: 1, DOWN: 2}
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float):
+        if v == int(v) and abs(v) < 1e15:
+            return str(int(v))
+        return repr(v)
+    return str(v)
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r"\"") \
+        .replace("\n", r"\n")
+
+
+def _label_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f'{k}="{_escape(v)}"'
+                          for k, v in labels.items()) + "}"
+
+
+def render_prometheus(reg: Optional[M.MetricsRegistry] = None) -> str:
+    """The whole registry in Prometheus text format."""
+    reg = reg or M.registry()
+    lines: List[str] = []
+    for fam in reg.families():
+        lines.append(f"# HELP {fam.name} {fam.doc}")
+        lines.append(f"# TYPE {fam.name} {fam.kind}")
+        for labels, ch in fam.series():
+            if fam.kind == M.HISTOGRAM:
+                for ub, cum in ch.cumulative():
+                    le = "+Inf" if ub == float("inf") else _fmt_value(ub)
+                    bl = dict(labels)
+                    bl["le"] = le
+                    lines.append(f"{fam.name}_bucket{_label_str(bl)} "
+                                 f"{cum}")
+                lines.append(f"{fam.name}_sum{_label_str(labels)} "
+                             f"{_fmt_value(ch.sum)}")
+                lines.append(f"{fam.name}_count{_label_str(labels)} "
+                             f"{ch.count}")
+            else:
+                lines.append(f"{fam.name}{_label_str(labels)} "
+                             f"{_fmt_value(ch.value)}")
+    lines.append(f"# HELP tpu_metrics_series_overflow_total label sets "
+                 f"evicted into _overflow series by the cardinality cap")
+    lines.append("# TYPE tpu_metrics_series_overflow_total counter")
+    lines.append(f"tpu_metrics_series_overflow_total "
+                 f"{reg.overflow_total()}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# health derivation
+# ---------------------------------------------------------------------------
+
+def _counter_value(reg: M.MetricsRegistry, name: str) -> int:
+    """Sum over every series of one family (0 when absent)."""
+    for fam in reg.families():
+        if fam.name == name:
+            total = 0
+            for _labels, ch in fam.series():
+                total += getattr(ch, "value", 0)
+            return total
+    return 0
+
+
+def _gauge_value(reg: M.MetricsRegistry, name: str) -> Optional[float]:
+    for fam in reg.families():
+        if fam.name == name:
+            for _labels, ch in fam.series():
+                return ch.value
+    return None
+
+
+class HealthMonitor:
+    """Derives a status document from counter DELTAS between snapshots.
+
+    Component map (ISSUE acceptance: arena-exhaustion rate, dirty memsan
+    ledger, heartbeat misses, device-probe liveness):
+
+      device     DOWN when ``tpu_device_probe_ok`` gauge reads 0 or a
+                 probe failure was counted since the last snapshot
+      arena      DEGRADED when ``tpu_arena_exhaustions_total`` moved
+      memory     DOWN when ``tpu_memsan_dirty_ledgers_total`` moved
+                 (a dirty ledger is a correctness signal, not load)
+      shuffle    DEGRADED when ``tpu_shuffle_heartbeat_missed_total``
+                 moved
+      queries    DEGRADED when ``tpu_queries_failed_total`` moved
+
+    Overall status = worst component.  A component with no series yet
+    reports OK — absence of a subsystem is not an alert.
+    """
+
+    _DELTA_RULES = (
+        # (component, counter family, status when the delta is > 0)
+        ("device", "tpu_device_probe_failures_total", DOWN),
+        ("arena", "tpu_arena_exhaustions_total", DEGRADED),
+        ("memory", "tpu_memsan_dirty_ledgers_total", DOWN),
+        ("shuffle", "tpu_shuffle_heartbeat_missed_total", DEGRADED),
+        ("queries", "tpu_queries_failed_total", DEGRADED),
+    )
+
+    def __init__(self, reg: Optional[M.MetricsRegistry] = None):
+        self._reg = reg
+        self._prev: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def snapshot(self) -> Dict:
+        reg = self._reg or M.registry()
+        components: Dict[str, Dict] = {}
+        status = OK
+        with self._lock:
+            for comp, fam_name, bad in self._DELTA_RULES:
+                cur = _counter_value(reg, fam_name)
+                delta = cur - self._prev.get(fam_name, 0)
+                self._prev[fam_name] = cur
+                comp_status = bad if delta > 0 else OK
+                entry = components.setdefault(
+                    comp, {"status": OK, "signals": {}})
+                entry["signals"][fam_name] = {"total": cur,
+                                              "delta": delta}
+                if _SEVERITY[comp_status] > _SEVERITY[entry["status"]]:
+                    entry["status"] = comp_status
+        probe_ok = _gauge_value(reg, "tpu_device_probe_ok")
+        dev = components.setdefault("device",
+                                    {"status": OK, "signals": {}})
+        dev["signals"]["tpu_device_probe_ok"] = probe_ok
+        if probe_ok is not None and probe_ok == 0:
+            dev["status"] = DOWN
+        for entry in components.values():
+            if _SEVERITY[entry["status"]] > _SEVERITY[status]:
+                status = entry["status"]
+        return {
+            "status": status,
+            "timestamp_ms": int(time.time() * 1000),
+            "components": components,
+            "queries": {
+                "active": _gauge_value(reg, "tpu_queries_active") or 0,
+                "completed":
+                    _counter_value(reg, "tpu_queries_completed_total"),
+                "failed":
+                    _counter_value(reg, "tpu_queries_failed_total"),
+                "retried":
+                    _counter_value(reg, "tpu_queries_retried_total"),
+            },
+            "series_overflow": reg.overflow_total(),
+        }
+
+
+# ---------------------------------------------------------------------------
+# opt-in stdlib HTTP endpoint
+# ---------------------------------------------------------------------------
+
+class MetricsServer:
+    """`GET /metrics` (Prometheus) + `GET /healthz` (JSON) on localhost.
+
+    Stdlib only (http.server); one daemon thread; ``port=0`` binds an
+    ephemeral port (tests).  Never raises into the engine: a scrape
+    error is the scraper's problem.
+    """
+
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 reg: Optional[M.MetricsRegistry] = None):
+        import http.server
+
+        monitor = HealthMonitor(reg)
+        registry = reg
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib contract)
+                if self.path.startswith("/metrics"):
+                    body = render_prometheus(registry).encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.startswith("/healthz"):
+                    body = json.dumps(monitor.snapshot()).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence per-scrape stderr spam
+                pass
+
+        import socketserver
+
+        class _Server(socketserver.ThreadingMixIn,
+                      http.server.HTTPServer):
+            daemon_threads = True
+
+        self._httpd = _Server((host, port), _Handler)
+        self.port = self._httpd.server_address[1]
+        self.monitor = monitor
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="tpu-metrics-endpoint")
+        self._thread.start()
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+
+
+_SERVER: Optional[MetricsServer] = None
+_SERVER_LOCK = threading.Lock()
+
+
+def ensure_server(port: int) -> MetricsServer:
+    """One endpoint per process: repeated sessions with the same port
+    reuse it; a different port replaces it."""
+    global _SERVER
+    with _SERVER_LOCK:
+        if _SERVER is not None and \
+                (_SERVER.port == port or port == 0):
+            return _SERVER
+        if _SERVER is not None:
+            _SERVER.close()
+        _SERVER = MetricsServer(port)
+        return _SERVER
+
+
+def shutdown_server() -> None:
+    global _SERVER
+    with _SERVER_LOCK:
+        if _SERVER is not None:
+            _SERVER.close()
+            _SERVER = None

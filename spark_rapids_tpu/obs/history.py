@@ -1,0 +1,261 @@
+"""Cross-run regression watchdog: per-query fingerprints distilled from
+the self-emitted event logs (obs/eventlog_writer.py), an append-only
+history directory, and a differ that flags drift between runs.
+
+The reference's qualification/profiling tools answer "how did THIS run
+go"; nothing in-repo answered "is run N quietly worse than run N−1" —
+which is exactly how five benchmark rounds of ``rows/s = 0.0`` shipped
+unnoticed.  This module closes that loop:
+
+* ``query_fingerprint`` distills ONE SQL execution into a small dict
+  with two strictly separated halves:
+
+  - **deterministic** fields — identical across replays of the same
+    query on the same data: plan shape, per-operator aggregate rows /
+    batches, the fallback set (operators left on the host engine),
+    device→host fetch-crossing count, and lint rule hits.  CI compares
+    ONLY these (``devtools/run_lint.py --regress``), so the gate can
+    demand exact equality without flaking.
+  - **timing** fields — wall ms, per-operator time, measured peak
+    device bytes.  ``tools regress`` compares them only when the caller
+    opts in with a threshold (``--wall-threshold``), never in CI.
+
+* ``HistoryDir`` appends one JSON document per recorded run
+  (``run_<seq>_<stamp>.json``); existing files are never rewritten —
+  the history is an audit log, not a cache.
+
+* ``diff_runs`` emits typed ``Drift`` records: ``new_fallback``,
+  ``crossing_growth``, ``operator_drift``, ``plan_change``,
+  ``lint_drift`` (deterministic) and ``wall_regression`` (timing,
+  threshold-gated).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Dict, List, Optional, Tuple
+
+FINGERPRINT_VERSION = 1
+
+#: fields the CI gate may compare (exact equality across replays)
+DETERMINISTIC_FIELDS = ("plan_shape", "operators", "fallback_ops",
+                        "fetch_crossings", "lint_rule_hits")
+#: advisory fields (never compared in CI)
+TIMING_FIELDS = ("wall_ms", "operator_time_ns", "peak_device_bytes")
+
+
+# ---------------------------------------------------------------------------
+# distillation
+# ---------------------------------------------------------------------------
+
+def _plan_shape(node) -> list:
+    return [node.node_name, [_plan_shape(c) for c in node.children]]
+
+
+def query_fingerprint(sql, spans: List[dict]) -> Dict:
+    """Fingerprint one parsed ``SQLExecution`` (tools/eventlog.py) plus
+    its flight-recorder span records."""
+    operators: Dict[str, Dict[str, int]] = {}
+    fallback: List[str] = []
+    time_ns = 0
+    for n in sql.plan.walk():
+        act = n.actual or {}
+        agg = operators.setdefault(
+            n.node_name, {"rows": 0, "bytes": 0, "batches": 0})
+        agg["rows"] += int(act.get("rows") or 0)
+        agg["bytes"] += int(act.get("bytes") or 0)
+        agg["batches"] += int(act.get("batches") or 0)
+        time_ns += int(act.get("timeNs") or 0)
+        if getattr(n, "placement", None) == "cpu":
+            fallback.append(n.node_name)
+    crossings = 0
+    lint_hits: List[str] = []
+    for s in spans:
+        if s.get("name") == "fetch.crossing":
+            crossings += int((s.get("attrs") or {}).get("transfers", 1))
+        if s.get("name") == "phase:overrides":
+            lint_hits += list((s.get("attrs") or {}).get("lint_rules",
+                                                         ()))
+    return {
+        "version": FINGERPRINT_VERSION,
+        "sql_id": sql.sql_id,
+        "description": sql.description,
+        "failed": bool(sql.failed),
+        # deterministic half
+        "plan_shape": _plan_shape(sql.plan),
+        "operators": operators,
+        "fallback_ops": sorted(fallback),
+        "fetch_crossings": crossings,
+        "lint_rule_hits": sorted(set(lint_hits)),
+        # timing half
+        "wall_ms": sql.duration,
+        "operator_time_ns": time_ns,
+        "peak_device_bytes": sql.peak_device_bytes,
+    }
+
+
+def distill_event_log(path: str) -> List[Dict]:
+    """Every query in one self-emitted event log, fingerprinted in
+    execution order."""
+    from ..tools.eventlog import parse_event_log
+    app = parse_event_log(path)
+    out = []
+    for sql_id in sorted(app.sql_executions):
+        spans = [s for s in app.spans
+                 if s.get("executionId") == sql_id]
+        out.append(query_fingerprint(app.sql_executions[sql_id], spans))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# append-only history
+# ---------------------------------------------------------------------------
+
+_RUN_RE = re.compile(r"^run_(\d{6})_.*\.json$")
+
+
+class HistoryDir:
+    """One directory of ``run_<seq>_<stamp>.json`` documents; strictly
+    append-only (record() refuses to overwrite)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def runs(self) -> List[str]:
+        """Absolute run-file paths, oldest first."""
+        names = sorted(n for n in os.listdir(self.path)
+                       if _RUN_RE.match(n))
+        return [os.path.join(self.path, n) for n in names]
+
+    def load(self, path: str) -> Dict:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+
+    def latest(self, n: int = 1) -> List[Dict]:
+        return [self.load(p) for p in self.runs()[-n:]]
+
+    def record(self, fingerprints: List[Dict],
+               label: str = "") -> str:
+        """Append one run document; returns its path."""
+        seq = len(self.runs())
+        stamp = time.strftime("%Y%m%dT%H%M%S")
+        name = f"run_{seq:06d}_{stamp}.json"
+        path = os.path.join(self.path, name)
+        if os.path.exists(path):  # same-second re-record: bump seq
+            name = f"run_{seq:06d}_{stamp}_{os.getpid()}.json"
+            path = os.path.join(self.path, name)
+        doc = {"version": FINGERPRINT_VERSION,
+               "recorded_at": stamp,
+               "label": label,
+               "queries": fingerprints}
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.rename(tmp, path)
+        return path
+
+
+# ---------------------------------------------------------------------------
+# the differ
+# ---------------------------------------------------------------------------
+
+class Drift:
+    """One detected regression signal between two runs."""
+
+    __slots__ = ("query", "kind", "detail", "deterministic")
+
+    def __init__(self, query: str, kind: str, detail: str,
+                 deterministic: bool):
+        self.query = query
+        self.kind = kind
+        self.detail = detail
+        self.deterministic = deterministic
+
+    def render(self) -> str:
+        tag = "DETERMINISTIC" if self.deterministic else "TIMING"
+        return f"[{tag}] {self.query}: {self.kind} — {self.detail}"
+
+    def __repr__(self):
+        return f"Drift({self.render()!r})"
+
+
+def _key(fp: Dict) -> Tuple[int, str]:
+    return (fp.get("sql_id", -1), fp.get("description", ""))
+
+
+def diff_fingerprints(old: Dict, new: Dict,
+                      wall_threshold_pct: Optional[float] = None
+                      ) -> List[Drift]:
+    """Drift records between two fingerprints of the SAME query."""
+    q = new.get("description") or f"query {new.get('sql_id')}"
+    out: List[Drift] = []
+    if old.get("plan_shape") != new.get("plan_shape"):
+        out.append(Drift(q, "plan_change",
+                         "physical plan shape changed between runs",
+                         True))
+    new_fb = set(new.get("fallback_ops", ())) - \
+        set(old.get("fallback_ops", ()))
+    if new_fb:
+        out.append(Drift(
+            q, "new_fallback",
+            f"operator(s) newly on the host engine: "
+            f"{sorted(new_fb)}", True))
+    oc, nc = old.get("fetch_crossings", 0), new.get("fetch_crossings", 0)
+    if nc > oc:
+        out.append(Drift(
+            q, "crossing_growth",
+            f"device->host fetch crossings grew {oc} -> {nc}", True))
+    oops, nops = old.get("operators", {}), new.get("operators", {})
+    for op in sorted(set(oops) | set(nops)):
+        a, b = oops.get(op), nops.get(op)
+        if a is None or b is None:
+            continue  # plan_change already covers added/removed nodes
+        for f in ("rows", "batches"):
+            if a.get(f) != b.get(f):
+                out.append(Drift(
+                    q, "operator_drift",
+                    f"{op}.{f}: {a.get(f)} -> {b.get(f)}", True))
+    new_lint = set(new.get("lint_rule_hits", ())) - \
+        set(old.get("lint_rule_hits", ()))
+    if new_lint:
+        out.append(Drift(q, "lint_drift",
+                         f"new lint rule hit(s): {sorted(new_lint)}",
+                         True))
+    if wall_threshold_pct is not None:
+        ow, nw = old.get("wall_ms") or 0, new.get("wall_ms") or 0
+        if ow > 0 and nw > ow * (1.0 + wall_threshold_pct / 100.0):
+            out.append(Drift(
+                q, "wall_regression",
+                f"wall {ow}ms -> {nw}ms "
+                f"(> {wall_threshold_pct:g}% threshold)", False))
+    return out
+
+
+def diff_runs(old_run: Dict, new_run: Dict,
+              wall_threshold_pct: Optional[float] = None) -> List[Drift]:
+    """Drift between two run documents, matching queries by
+    (sql_id, description); queries present in only one run are reported
+    as corpus drift."""
+    old_by = {_key(fp): fp for fp in old_run.get("queries", ())}
+    new_by = {_key(fp): fp for fp in new_run.get("queries", ())}
+    out: List[Drift] = []
+    for k in sorted(set(old_by) | set(new_by),
+                    key=lambda t: (t[0], t[1])):
+        if k not in new_by:
+            out.append(Drift(k[1] or f"query {k[0]}", "query_removed",
+                             "query present in old run only", True))
+        elif k not in old_by:
+            out.append(Drift(k[1] or f"query {k[0]}", "query_added",
+                             "query present in new run only", True))
+        else:
+            out += diff_fingerprints(old_by[k], new_by[k],
+                                     wall_threshold_pct)
+    return out
+
+
+def deterministic_drift(drifts: List[Drift]) -> List[Drift]:
+    return [d for d in drifts if d.deterministic]

@@ -308,6 +308,13 @@ class QueryTrace:
                 # failure paths may leave the device unusable; a trace
                 # with unresolved row counts still beats no trace
                 pass
+        from . import metrics as m
+        m.counter("tpu_trace_spans_total",
+                  "flight-recorder spans sealed").inc(len(self.spans))
+        if self.dropped:
+            m.counter("tpu_trace_dropped_spans_total",
+                      "spans dropped past trace.maxSpans") \
+                .inc(self.dropped)
         for sp in self.spans:
             if sp.kind != OPERATOR or sp.node_id is None:
                 continue

@@ -26,6 +26,17 @@ from ..columnar.interop import to_arrow_schema
 from ..obs.tracer import trace_event
 
 
+def _note_stage(op: str, path: str, chips: int) -> None:
+    """One ICI stage ran: flight-recorder event + the continuous
+    stacked-vs-host decision counter (a drift toward `host` is the
+    ICI reshard quietly degrading — the watchdog's signal)."""
+    trace_event("ici.stage", op=op, path=path, chips=chips)
+    from ..obs import metrics as m
+    m.counter("tpu_ici_stage_total",
+              "fused mesh stages by operator and data path",
+              ("op", "path")).labels(op=op, path=path).inc()
+
+
 class IciAggregateExec(Exec):
     """Fused distributed GROUP BY over the device mesh (replaces
     final ← exchange ← partial; one XLA program, rows ride ICI)."""
@@ -68,14 +79,12 @@ class IciAggregateExec(Exec):
             source, ctx, source.output_names, source.output_types,
             self._dagg.n_dev)
         if stacked is not None:
-            trace_event("ici.stage", op="aggregate", path="stacked",
-                        chips=self._dagg.n_dev)
+            _note_stage("aggregate", "stacked", self._dagg.n_dev)
             with MetricTimer(self.metrics[OP_TIME]):
                 out = self._dagg._compiled(stacked)
             yield from _emit_stacked(self, out)
             return
-        trace_event("ici.stage", op="aggregate", path="host",
-                    chips=self._dagg.n_dev)
+        _note_stage("aggregate", "host", self._dagg.n_dev)
         tbl = _gather_source_table(source, ctx, source.output_names,
                                    source.output_types)
         shards = _shard_table(tbl, self._dagg.n_dev)
@@ -309,15 +318,13 @@ class IciSortExec(Exec):
             source, ctx, source.output_names, source.output_types,
             self._dsort.n_dev)
         if stacked is not None:
-            trace_event("ici.stage", op="sort", path="stacked",
-                        chips=self._dsort.n_dev)
+            _note_stage("sort", "stacked", self._dsort.n_dev)
             # shard i holds globally-ordered range i: emit in mesh order
             with MetricTimer(self.metrics[OP_TIME]):
                 out = self._dsort._compiled(stacked)
             yield from _emit_stacked(self, out)
             return
-        trace_event("ici.stage", op="sort", path="host",
-                    chips=self._dsort.n_dev)
+        _note_stage("sort", "host", self._dsort.n_dev)
         tbl = _gather_source_table(source, ctx, source.output_names,
                                    source.output_types)
         shards = _shard_table(tbl, self._dsort.n_dev)
@@ -368,13 +375,12 @@ class IciJoinExec(Exec):
                                     rsrc.output_types, n_dev) \
             if ls is not None else None
         if ls is not None and rs is not None:
-            trace_event("ici.stage", op="join", path="stacked",
-                        chips=n_dev)
+            _note_stage("join", "stacked", n_dev)
             with MetricTimer(self.metrics[OP_TIME]):
                 out = self._djoin.run_stacked(ls, rs)
             yield from _emit_table(self, out)
             return
-        trace_event("ici.stage", op="join", path="host", chips=n_dev)
+        _note_stage("join", "host", n_dev)
         lt = _gather_source_table(lsrc, ctx, lsrc.output_names,
                                   lsrc.output_types)
         rt = _gather_source_table(rsrc, ctx, rsrc.output_names,
@@ -455,9 +461,9 @@ class IciExchangeExec(Exec):
             stacked = _gather_source_stacked(
                 source, ctx, source.output_names, source.output_types,
                 self._dex.n_dev)
-            trace_event("ici.stage", op="exchange",
-                        path="stacked" if stacked is not None
-                        else "host", chips=self._dex.n_dev)
+            _note_stage("exchange",
+                        "stacked" if stacked is not None else "host",
+                        self._dex.n_dev)
             with MetricTimer(self.metrics[OP_TIME]):
                 if stacked is not None:
                     out = self._dex.run_stacked(stacked)
@@ -502,8 +508,12 @@ def install_ici_stages(root: Exec, conf: cfg.RapidsConf) -> Exec:
     alike); this pass is the plan-level equivalent."""
     if conf.get(cfg.SHUFFLE_TRANSPORT) != "ici":
         return root
-    import jax
-    if len(jax.devices()) < 2:
+    # deadline-bounded discovery: a hung multichip topology exchange
+    # (the MULTICHIP rc=124 shape) degrades to the single-chip path —
+    # counted in tpu_device_probe_failures_total + a tracer event —
+    # instead of hanging the planner
+    from .mesh import device_count
+    if device_count(default=1) < 2:
         return root
     from ..exec.aggregate import TpuHashAggregateExec
     from ..exec.join import HashJoinExec

@@ -11,7 +11,9 @@ collectives onto ICI; multi-pod meshes extend the same axis over DCN.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import os
+import threading
+from typing import List, Optional, Sequence
 
 import jax
 import numpy as np
@@ -19,12 +21,91 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 DATA_AXIS = "data"
 
+# Hard deadline on first-touch device discovery.  jax.devices() on a
+# multichip slice blocks on PJRT topology exchange: one unreachable
+# chip/host and the call hangs FOREVER (the MULTICHIP rc=124 rounds —
+# the whole benchmark died inside discovery with nothing in-repo
+# noticing).  The deadline turns that hang into a counted, traced,
+# cleanly-degradable failure.
+DEFAULT_PROBE_TIMEOUT_S = 120.0
+
+
+class DeviceDiscoveryTimeout(RuntimeError):
+    """Device discovery exceeded its hard deadline (likely an
+    unreachable chip or a dead accelerator tunnel)."""
+
+
+def _probe_timeout_s() -> float:
+    raw = os.environ.get("SPARK_RAPIDS_TPU_DEVICE_PROBE_TIMEOUT_S")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return DEFAULT_PROBE_TIMEOUT_S
+
+
+def discover_devices(timeout_s: Optional[float] = None) -> List:
+    """``jax.devices()`` under a hard deadline.
+
+    On timeout the daemon probe thread is left behind (there is no safe
+    way to interrupt a hung PJRT client), ``tpu_device_probe_failures_
+    total`` increments, a tracer event is emitted, and
+    ``DeviceDiscoveryTimeout`` raises so callers take their single-chip
+    or skip fallback instead of hanging the process."""
+    from ..obs import metrics as m
+    from ..obs.tracer import trace_event
+    timeout_s = _probe_timeout_s() if timeout_s is None else timeout_s
+    result: List = []
+    error: List[BaseException] = []
+
+    def probe():
+        try:
+            result.extend(jax.devices())
+        except BaseException as ex:  # noqa: BLE001 — report, not mask
+            error.append(ex)
+
+    t = threading.Thread(target=probe, daemon=True,
+                         name="tpu-device-probe")
+    t.start()
+    t.join(timeout_s)
+    fail = m.counter("tpu_device_probe_failures_total",
+                     "device discovery timeouts / errors")
+    ok = m.gauge("tpu_device_probe_ok",
+                 "1 when the last device probe succeeded, else 0")
+    if t.is_alive():
+        fail.inc()
+        ok.set(0)
+        trace_event("mesh.probe_timeout", timeout_s=timeout_s)
+        raise DeviceDiscoveryTimeout(
+            f"device discovery exceeded {timeout_s:g}s (unreachable "
+            f"chip or dead tunnel); set "
+            f"SPARK_RAPIDS_TPU_DEVICE_PROBE_TIMEOUT_S to adjust")
+    if error:
+        fail.inc()
+        ok.set(0)
+        trace_event("mesh.probe_error", error=repr(error[0]))
+        raise error[0]
+    ok.set(1)
+    return result
+
+
+def device_count(timeout_s: Optional[float] = None,
+                 default: int = 1) -> int:
+    """Visible-device count with the discovery deadline applied; a
+    timed-out or failed probe degrades to ``default`` (single-chip) so
+    planning gates skip the multichip path instead of hanging."""
+    try:
+        return len(discover_devices(timeout_s))
+    except BaseException:
+        return default
+
 
 def build_mesh(n_devices: Optional[int] = None,
                axis_name: str = DATA_AXIS,
                devices: Optional[Sequence] = None) -> Mesh:
     """A 1-D data-parallel mesh over the first ``n_devices`` chips."""
-    devs = list(devices) if devices is not None else jax.devices()
+    devs = list(devices) if devices is not None else discover_devices()
     if n_devices is not None:
         if n_devices > len(devs):
             raise ValueError(

@@ -206,6 +206,10 @@ class TpuExecutorPlugin:
             self.semaphore = TpuSemaphore.initialize(
                 self.conf.get(cfg.CONCURRENT_TPU_TASKS))
             self.spill_catalog = SpillCatalog.init_from_conf(self.conf)
+            pinned = self.conf.get(cfg.PINNED_POOL_SIZE)
+            if pinned and pinned > 0:
+                from .native.arena import configure_shared_arena
+                configure_shared_arena(pinned)
             if self.conf.get(cfg.SHUFFLE_MANAGER_ENABLED) and \
                     self.conf.get(cfg.SHUFFLE_TRANSPORT) == "tcp":
                 from .shuffle.transport import ShuffleServer

@@ -149,11 +149,18 @@ class ShuffleExchangeExec(Exec):
                     concat_batches(xp, parts, self.output_names,
                                    self.output_types)
             mgr.write_map_output(shuffle_id, map_id, merged)
-        if obs_sp:
+        from ..obs import metrics as m
+        if obs_sp or m.enabled():
             from ..memory.spill import batch_device_bytes
-            obs_sp.set(shuffle_id=shuffle_id, blocks=len(staged),
-                       bytes=sum(batch_device_bytes(b)
-                                 for _, b, _ in staged))
+            total = sum(batch_device_bytes(b) for _, b, _ in staged)
+            if obs_sp:
+                obs_sp.set(shuffle_id=shuffle_id, blocks=len(staged),
+                           bytes=total)
+            m.counter("tpu_shuffle_write_bytes_total",
+                      "device bytes staged by shuffle map writes") \
+                .inc(total)
+            m.counter("tpu_shuffle_write_blocks_total",
+                      "map-output blocks written").inc(len(staged))
         self._shuffle_id = shuffle_id
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
@@ -165,11 +172,15 @@ class ShuffleExchangeExec(Exec):
         set_current_input_file("")
         mgr = TpuShuffleManager.get()
         xp = self.xp
+        from ..obs import metrics as m
+        read_batches = m.counter("tpu_shuffle_read_batches_total",
+                                 "reduce-side blocks read back")
         for b in mgr.read_partition(self._shuffle_id, pid):
             if isinstance(b, SpillableBatch):
                 b = b.get_batch(xp)
             self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
             self.metrics[NUM_OUTPUT_BATCHES] += 1
+            read_batches.inc()
             yield b
 
 

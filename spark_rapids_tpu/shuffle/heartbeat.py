@@ -13,6 +13,19 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 
+def _missed_counter():
+    from ..obs import metrics as m
+    return m.counter("tpu_shuffle_heartbeat_missed_total",
+                     "peers expired after missing their heartbeat "
+                     "window")
+
+
+def _peers_gauge():
+    from ..obs import metrics as m
+    return m.gauge("tpu_shuffle_peers_live",
+                   "shuffle-capable peers inside the heartbeat window")
+
+
 @dataclass
 class PeerInfo:
     executor_id: str
@@ -33,8 +46,10 @@ class HeartbeatManager:
                           ) -> List[PeerInfo]:
         with self._lock:
             self._peers[executor_id] = PeerInfo(executor_id, host, port)
-            return [p for p in self._peers.values()
-                    if p.executor_id != executor_id]
+            out = [p for p in self._peers.values()
+                   if p.executor_id != executor_id]
+            _peers_gauge().set(len(self._peers))
+            return out
 
     def executor_heartbeat(self, executor_id: str) -> List[PeerInfo]:
         with self._lock:
@@ -59,6 +74,9 @@ class HeartbeatManager:
                     if now - p.last_heartbeat > self.timeout_s]
             for k in dead:
                 del self._peers[k]
+            if dead:
+                _missed_counter().inc(len(dead))
+            _peers_gauge().set(len(self._peers))
             return dead
 
 

@@ -5,6 +5,15 @@
     python -m spark_rapids_tpu.tools trace         <eventlog> [--export chrome|text] [-o FILE]
     python -m spark_rapids_tpu.tools lint --repo   [--baseline FILE]
     python -m spark_rapids_tpu.tools lint --plan   <fixture.py...> [--infer] [--memsan]
+    python -m spark_rapids_tpu.tools regress --history DIR --record <eventlog...> [--label L]
+    python -m spark_rapids_tpu.tools regress --history DIR --check [--wall-threshold PCT]
+
+`regress` is the cross-run watchdog (obs/history.py): --record distills
+self-emitted event logs into per-query fingerprints appended to the
+history dir; --check diffs the two most recent runs and exits nonzero
+on DETERMINISTIC drift (new fallbacks, fetch-crossing growth, operator
+row drift, plan/lint changes).  Wall-clock comparison is opt-in via
+--wall-threshold and never fails CI.
 
 `profiling --accuracy` and `trace` consume the engine's SELF-emitted
 event logs (spark.rapids.tpu.eventLog.dir): predicted-vs-actual
@@ -109,6 +118,50 @@ def _run_trace_export(log, fmt, output, sql_id):
     return 0
 
 
+def _run_regress(history_dir, record_logs, check, wall_threshold,
+                 label=""):
+    from ..obs.history import (HistoryDir, deterministic_drift,
+                               diff_runs, distill_event_log)
+    from .eventlog import find_event_logs
+
+    hist = HistoryDir(history_dir)
+    if record_logs:
+        fps = []
+        for log in find_event_logs(record_logs):
+            fps += distill_event_log(log)
+        if not fps:
+            sys.stderr.write("regress --record: no queries found in "
+                             "the given event log(s)\n")
+            return 2
+        path = hist.record(fps, label=label)
+        sys.stdout.write(f"recorded {len(fps)} query fingerprint(s) "
+                         f"-> {path}\n")
+        if not check:
+            return 0
+    runs = hist.runs()
+    if len(runs) < 2:
+        sys.stderr.write(f"regress --check: need >= 2 recorded runs in "
+                         f"{history_dir}, have {len(runs)}\n")
+        return 2
+    old, new = hist.load(runs[-2]), hist.load(runs[-1])
+    drifts = diff_runs(old, new, wall_threshold_pct=wall_threshold)
+    for d in drifts:
+        sys.stdout.write(d.render() + "\n")
+    hard = deterministic_drift(drifts)
+    if hard:
+        sys.stdout.write(f"regress: {len(hard)} deterministic drift "
+                         f"signal(s) between {runs[-2].rsplit('/')[-1]} "
+                         f"and {runs[-1].rsplit('/')[-1]}\n")
+        return 1
+    timing = len(drifts) - len(hard)
+    sys.stdout.write(
+        f"regress clean: no deterministic drift across "
+        f"{len(new.get('queries', ()))} quer(ies)"
+        + (f" ({timing} timing-only signal(s) above)" if timing
+           else "") + "\n")
+    return 0
+
+
 def _default_baseline():
     import os
     return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
@@ -163,6 +216,26 @@ def main(argv=None):
                          "(default: devtools/lint_baseline.txt)")
     li.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline from current violations")
+    rg = sub.add_parser("regress",
+                        help="cross-run regression watchdog over "
+                             "self-emitted event-log fingerprints")
+    rg.add_argument("--history", required=True,
+                    help="append-only fingerprint history directory "
+                         "(spark.rapids.tpu.regress.historyDir)")
+    rg.add_argument("--record", nargs="*", metavar="EVENTLOG",
+                    default=None,
+                    help="distill these event logs into one run "
+                         "appended to the history")
+    rg.add_argument("--check", action="store_true",
+                    help="diff the two most recent runs; exit 1 on "
+                         "deterministic drift")
+    rg.add_argument("--wall-threshold", type=float, default=None,
+                    metavar="PCT",
+                    help="also report wall-clock regressions above "
+                         "this percentage (advisory: timing drift "
+                         "never fails the check)")
+    rg.add_argument("--label", default="",
+                    help="free-form label stored on the recorded run")
     args = p.parse_args(argv)
 
     if args.cmd == "qualification":
@@ -182,6 +255,11 @@ def main(argv=None):
     elif args.cmd == "trace":
         return _run_trace_export(args.log, args.export, args.output,
                                  args.sql)
+    elif args.cmd == "regress":
+        if args.record is None and not args.check:
+            p.error("regress needs --record and/or --check")
+        return _run_regress(args.history, args.record, args.check,
+                            args.wall_threshold, label=args.label)
     else:
         if args.plan:
             return _run_plan_lint(args.plan, infer=args.infer,

@@ -27,26 +27,31 @@ class PlanNode:
     — the `tools profile --accuracy` inputs); None on foreign logs."""
 
     __slots__ = ("node_name", "simple_string", "children", "metrics",
-                 "prediction", "actual")
+                 "prediction", "actual", "placement")
 
     def __init__(self, node_name: str, simple_string: str = "",
                  children: Optional[List["PlanNode"]] = None,
                  metrics: Optional[List[dict]] = None,
                  prediction: Optional[dict] = None,
-                 actual: Optional[dict] = None):
+                 actual: Optional[dict] = None,
+                 placement: str = ""):
         self.node_name = node_name
         self.simple_string = simple_string
         self.children = children or []
         self.metrics = metrics or []
         self.prediction = prediction
         self.actual = actual
+        # "tpu" / "cpu" on self-emitted logs (the regression watchdog's
+        # fallback-set field); "" on foreign Spark logs
+        self.placement = placement
 
     @classmethod
     def from_json(cls, d: dict) -> "PlanNode":
         return cls(d.get("nodeName", ""), d.get("simpleString", ""),
                    [cls.from_json(c) for c in d.get("children", [])],
                    d.get("metrics", []),
-                   d.get("tpuPrediction"), d.get("tpuActual"))
+                   d.get("tpuPrediction"), d.get("tpuActual"),
+                   d.get("tpuPlacement", ""))
 
     def walk(self) -> Iterator["PlanNode"]:
         yield self

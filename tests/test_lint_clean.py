@@ -83,6 +83,34 @@ def test_run_lint_obs_gate_exits_zero():
     assert "obs gate clean" in proc.stdout, proc.stdout
 
 
+def test_run_lint_regress_gate_exits_zero():
+    """Tier-1 gate for the cross-run watchdog: the golden corpus
+    replays twice in fresh subprocesses and the two runs' DETERMINISTIC
+    fingerprints must be identical; the differ must flag an injected
+    fallback and an injected crossing bump (anti-vacuity)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "devtools", "run_lint.py"),
+         "--regress"],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "regress gate clean" in proc.stdout, proc.stdout
+
+
+def test_run_lint_metrics_gate_exits_zero():
+    """Tier-1 gate for the continuous-metrics layer: one golden query
+    plus one bridge round trip must expose nonzero Prometheus series
+    from >= 6 distinct subsystems (spill, arena, shuffle, fetch,
+    session, bridge) and a schema-valid health snapshot."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "devtools", "run_lint.py"),
+         "--metrics"],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "metrics gate clean" in proc.stdout, proc.stdout
+
+
 def test_baseline_is_empty_and_stays_empty():
     """PR-3 burned the last baselined TPU-R001 debt down to zero: the
     ratchet now enforces a spotless repo (deliberate exceptions are

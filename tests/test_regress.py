@@ -1,0 +1,216 @@
+"""Cross-run regression watchdog (obs/history.py + `tools regress`).
+
+Anti-vacuity is the point: the differ must be SILENT on identical
+replays and LOUD on each injected regression kind (fallback, crossing
+bump) — a watchdog that never barks, or always barks, is dead weight."""
+
+import copy
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.obs.history import (DETERMINISTIC_FIELDS,
+                                          TIMING_FIELDS, HistoryDir,
+                                          deterministic_drift,
+                                          diff_fingerprints, diff_runs,
+                                          distill_event_log)
+
+
+def _fp(sql_id=0, **over):
+    fp = {
+        "version": 1,
+        "sql_id": sql_id,
+        "description": f"q{sql_id}",
+        "failed": False,
+        "plan_shape": ["AggExec", [["FilterExec", [["ScanExec", []]]]]],
+        "operators": {"AggExec": {"rows": 97, "bytes": 800,
+                                  "batches": 1},
+                      "FilterExec": {"rows": 1900, "bytes": 16000,
+                                     "batches": 2}},
+        "fallback_ops": ["DeviceToHostExec"],
+        "fetch_crossings": 3,
+        "lint_rule_hits": [],
+        "wall_ms": 120,
+        "operator_time_ns": 5_000_000,
+        "peak_device_bytes": 1 << 20,
+    }
+    fp.update(over)
+    return fp
+
+
+def _run(*fps):
+    return {"version": 1, "recorded_at": "x", "label": "",
+            "queries": list(fps)}
+
+
+# ---------------------------------------------------------------------------
+# differ semantics
+# ---------------------------------------------------------------------------
+
+def test_identical_replays_report_zero_drift():
+    assert diff_runs(_run(_fp()), _run(_fp())) == []
+
+
+def test_timing_only_changes_never_fail_ci():
+    new = _fp(wall_ms=9999, operator_time_ns=1,
+              peak_device_bytes=123)
+    # without a threshold: silence
+    assert diff_runs(_run(_fp()), _run(new)) == []
+    # with a threshold: reported, but NOT deterministic
+    drifts = diff_runs(_run(_fp()), _run(new), wall_threshold_pct=10)
+    assert [d.kind for d in drifts] == ["wall_regression"]
+    assert deterministic_drift(drifts) == []
+
+
+def test_injected_fallback_is_flagged():
+    new = _fp()
+    new["fallback_ops"] = sorted(new["fallback_ops"] +
+                                 ["InjectedHostOnlyExec"])
+    drifts = diff_runs(_run(_fp()), _run(new))
+    assert any(d.kind == "new_fallback" and d.deterministic
+               for d in drifts)
+    assert "InjectedHostOnlyExec" in drifts[0].detail
+    # a REMOVED fallback (improvement) is not drift
+    assert diff_runs(_run(new), _run(_fp())) == []
+
+
+def test_injected_crossing_bump_is_flagged():
+    new = _fp(fetch_crossings=5)
+    drifts = diff_runs(_run(_fp()), _run(new))
+    assert [d.kind for d in drifts] == ["crossing_growth"]
+    assert drifts[0].deterministic
+    # fewer crossings (improvement) is not drift
+    assert diff_runs(_run(new), _run(_fp())) == []
+
+
+def test_operator_row_drift_and_plan_change():
+    new = _fp()
+    new["operators"]["AggExec"] = {"rows": 96, "bytes": 800,
+                                   "batches": 1}
+    drifts = diff_runs(_run(_fp()), _run(new))
+    assert [d.kind for d in drifts] == ["operator_drift"]
+    new2 = _fp(plan_shape=["SortExec", []])
+    kinds = {d.kind for d in diff_runs(_run(_fp()), _run(new2))}
+    assert "plan_change" in kinds
+
+
+def test_lint_drift_and_corpus_change():
+    new = _fp(lint_rule_hits=["TPU-L004"])
+    assert [d.kind for d in diff_runs(_run(_fp()), _run(new))] == \
+        ["lint_drift"]
+    drifts = diff_runs(_run(_fp(0), _fp(1)), _run(_fp(0)))
+    assert [d.kind for d in drifts] == ["query_removed"]
+
+
+def test_deterministic_and_timing_fields_are_disjoint():
+    assert not set(DETERMINISTIC_FIELDS) & set(TIMING_FIELDS)
+    fp = _fp()
+    for f in DETERMINISTIC_FIELDS + TIMING_FIELDS:
+        assert f in fp, f
+
+
+# ---------------------------------------------------------------------------
+# append-only history
+# ---------------------------------------------------------------------------
+
+def test_history_dir_append_only_ordering(tmp_path):
+    hist = HistoryDir(str(tmp_path / "h"))
+    p1 = hist.record([_fp()], label="one")
+    p2 = hist.record([_fp(), _fp(1)], label="two")
+    assert hist.runs() == [p1, p2]
+    assert os.path.exists(p1) and os.path.exists(p2)
+    doc1, doc2 = hist.latest(2)
+    assert doc1["label"] == "one" and len(doc2["queries"]) == 2
+    # round trips through JSON exactly
+    assert doc2["queries"][0] == _fp()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: real query -> event log -> fingerprint -> differ
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def logged_run(tmp_path):
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.session import TpuSession
+    d = str(tmp_path / "evt")
+    s = (TpuSession.builder()
+         .config("spark.rapids.sql.enabled", True)
+         .config("spark.rapids.tpu.singleChipFuse", "off")
+         .config("spark.rapids.tpu.eventLog.dir", d)
+         .get_or_create())
+    tb = pa.table({
+        "k": pa.array((np.arange(600) % 13).astype(np.int64)),
+        "v": pa.array(np.arange(600, dtype=np.int64))})
+    out = (s.create_dataframe(tb, num_partitions=2)
+           .filter(col("v") > 9).group_by(col("k"))
+           .agg(F.sum(col("v")).alias("sv")).collect())
+    assert out.num_rows == 13
+    logs = [f for f in os.listdir(d) if f.startswith("events_")]
+    assert len(logs) == 1
+    return os.path.join(d, logs[0])
+
+
+def test_distilled_fingerprint_fields(logged_run):
+    fps = distill_event_log(logged_run)
+    assert len(fps) == 1
+    fp = fps[0]
+    assert not fp["failed"]
+    # crossings were recorded (the sanctioned fetch path announced
+    # itself) and the result fetch moved real rows
+    assert fp["fetch_crossings"] >= 1
+    ops = fp["operators"]
+    assert any(v["rows"] > 0 for v in ops.values())
+    assert fp["plan_shape"]
+    assert isinstance(fp["fallback_ops"], list)
+    assert fp["wall_ms"] >= 0
+    json.dumps(fp)  # JSON-clean
+
+
+def test_self_diff_of_real_run_is_silent(logged_run):
+    fps = distill_event_log(logged_run)
+    assert diff_runs(_run(*fps), _run(*copy.deepcopy(fps))) == []
+    # ... and the injections still trip on the REAL fingerprint
+    tampered = copy.deepcopy(fps)
+    tampered[0]["fallback_ops"] = \
+        sorted(tampered[0]["fallback_ops"] + ["InjectedExec"])
+    tampered[0]["fetch_crossings"] += 2
+    kinds = {d.kind for d in diff_runs(_run(*fps), _run(*tampered))}
+    assert {"new_fallback", "crossing_growth"} <= kinds
+
+
+# ---------------------------------------------------------------------------
+# tools regress CLI
+# ---------------------------------------------------------------------------
+
+def test_tools_regress_cli_record_and_check(tmp_path, logged_run,
+                                            capsys):
+    from spark_rapids_tpu.tools.__main__ import main as tools_main
+    hist = str(tmp_path / "hist")
+    assert tools_main(["regress", "--history", hist, "--record",
+                       logged_run]) == 0
+    assert tools_main(["regress", "--history", hist, "--record",
+                       logged_run, "--check"]) == 0
+    out = capsys.readouterr().out
+    assert "regress clean" in out
+    # tamper the newest run on disk -> --check must fail
+    h = HistoryDir(hist)
+    newest = h.runs()[-1]
+    doc = h.load(newest)
+    doc["queries"][0]["fetch_crossings"] += 7
+    with open(newest, "w") as f:
+        json.dump(doc, f)
+    assert tools_main(["regress", "--history", hist, "--check"]) == 1
+    assert "crossing_growth" in capsys.readouterr().out
+
+
+def test_tools_regress_cli_needs_two_runs(tmp_path, capsys):
+    from spark_rapids_tpu.tools.__main__ import main as tools_main
+    hist = str(tmp_path / "hist2")
+    HistoryDir(hist).record([_fp()])
+    assert tools_main(["regress", "--history", hist, "--check"]) == 2
+    assert "need >= 2" in capsys.readouterr().err
