@@ -225,6 +225,102 @@ def measure_cache_cold(n_rows: int) -> float:
         shutil.rmtree(cache_dir, ignore_errors=True)
 
 
+_SUITE_NAMES = ("agg", "join", "sort", "window", "parquet",
+                "shuffle_join", "write")
+
+
+def run_one_suite(name: str, n_rows: int, cache_dir: str,
+                  ledger_dir: str = "") -> None:
+    """Internal mode (--one-suite): run ONE suite query in THIS fresh
+    process against the given persistent compile cache dir, and print
+    the compile observatory's totals.  The --compile-report driver runs
+    this twice per suite — a cold subprocess (empty cache) then a warm
+    one (populated cache) — so cold/warm compile cost and the distinct-
+    program count are measured per suite instead of today's single
+    lumped first-run-minus-warm `compile_s` guess."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.obs.compileprof import CompileObservatory
+    fact, dim = make_tables(n_rows)
+    root = tempfile.mkdtemp(prefix="tpu_suite_")
+    try:
+        pq_path = write_parquet_input(fact, root)
+        b = (TpuSession.builder()
+             .config("spark.rapids.sql.enabled", True)
+             .config("spark.rapids.tpu.jit.persistentCacheDir",
+                     cache_dir)
+             # pin the sort kernel structure: 'auto' flips lean->
+             # throughput between the cold and warm process (by
+             # design), which would make cold/warm compile distinct
+             # program SETS instead of the same set re-measured
+             .config("spark.rapids.tpu.sort.compileLean", "off"))
+        if ledger_dir:
+            b = b.config("spark.rapids.tpu.compile.ledgerDir",
+                         ledger_dir)
+        s = b.get_or_create()
+        qs = dict(queries(s, fact, dim, pq_path, root))
+        t0 = time.perf_counter()
+        out = qs[name]()
+        wall = time.perf_counter() - t0
+        assert out.num_rows > 0
+        snap = CompileObservatory.get().snapshot()
+        from spark_rapids_tpu.obs import metrics as obs_metrics
+        reg = obs_metrics.registry()
+        disk_hits = reg.counter(
+            "tpu_jit_persistent_cache_hits_total").value()
+        disk_misses = reg.counter(
+            "tpu_jit_persistent_cache_misses_total").value()
+        print("SUITE_JSON=" + json.dumps({
+            "suite": name, "wall_s": round(wall, 3),
+            "compile_s": snap["compile_seconds_total"],
+            "trace_s": snap["trace_seconds_total"],
+            "build_total_s": round(snap["compile_seconds_total"] +
+                                   snap["trace_seconds_total"], 3),
+            "distinct_programs": snap["distinct_programs"],
+            "disk_hits": disk_hits, "disk_misses": disk_misses}))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _one_suite_subprocess(name: str, n_rows: int, cache_dir: str):
+    """One fresh-process suite run; returns the parsed SUITE_JSON."""
+    import subprocess
+    env = dict(os.environ)
+    env.pop("SPARK_RAPIDS_TPU_DISABLE_COMPILE_CACHE", None)
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), str(n_rows),
+         f"--one-suite={name}", f"--cache-dir={cache_dir}"],
+        capture_output=True, text=True, timeout=900, env=env)
+    for line in r.stdout.splitlines():
+        if line.startswith("SUITE_JSON="):
+            return json.loads(line[len("SUITE_JSON="):])
+    raise RuntimeError(f"suite {name} subprocess failed "
+                       f"rc={r.returncode}:\n{r.stdout}\n{r.stderr}")
+
+
+def measure_compile_report(n_rows: int) -> dict:
+    """Per-suite cold/warm compile attribution: each suite runs in a
+    cold subprocess (fresh persistent cache) then a warm one (same
+    cache dir).  compile_cold_s is the full trace+lower+compile wall a
+    new deployment pays; compile_warm_s is what survives a populated
+    disk cache (re-trace + cache reads) — the before/after ROADMAP
+    item 1's cache-key work will be judged on."""
+    report = {}
+    for name in _SUITE_NAMES:
+        cache_dir = tempfile.mkdtemp(prefix=f"tpu_ccache_{name}_")
+        try:
+            cold = _one_suite_subprocess(name, n_rows, cache_dir)
+            warm = _one_suite_subprocess(name, n_rows, cache_dir)
+            report[name] = {
+                "compile_cold_s": round(cold["build_total_s"], 2),
+                "compile_warm_s": round(warm["build_total_s"], 2),
+                "distinct_programs": cold["distinct_programs"],
+                "warm_disk_hits": warm["disk_hits"],
+            }
+        finally:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+    return report
+
+
 def time_pyspark(fact, dim, pq_path, out_root, repeats: int = 3):
     """The same 7 queries on local-mode Spark-CPU — the reference's true
     comparison target (FAQ.md's 3-7x bar).  Returns per-query medians,
@@ -420,9 +516,16 @@ def _cpu_fallback_reexec(probe_error: str) -> None:
 def main():
     pos = [a for a in sys.argv[1:] if not a.startswith("--")]
     n_rows = int(pos[0]) if pos else 1_000_000
+    one_suite = _arg_value("--one-suite")
+    if one_suite:
+        # internal mode used by --compile-report's cold/warm subprocesses
+        run_one_suite(one_suite, n_rows, _arg_value("--cache-dir", ""),
+                      _arg_value("--ledger-dir", ""))
+        return
     with_pyspark = "--baseline=pyspark" in sys.argv[1:]
     with_trace_guard = "--trace-overhead" in sys.argv[1:]
     with_metrics_guard = "--metrics-overhead" in sys.argv[1:]
+    with_compile_report = "--compile-report" in sys.argv[1:]
     with_record = "--record" in sys.argv[1:]
     with_check = "--check" in sys.argv[1:]
     is_cpu_fallback = "--cpu-fallback" in sys.argv[1:]
@@ -461,6 +564,9 @@ def main():
                                         with_check, wall_threshold)
     finally:
         shutil.rmtree(root, ignore_errors=True)
+    compile_report = None
+    if with_compile_report:
+        compile_report = measure_compile_report(n_rows)
     tpu_total = sum(tpu.values())
     cpu_total = sum(cpu.values())
     # rows processed: each query consumes the fact table once
@@ -474,6 +580,11 @@ def main():
                      "compile_s": round(tpu_compile[k], 1),
                      "mb_per_s": round(bps / 1e6, 1),
                      "hbm_pct": round(100.0 * bps / _HBM_BYTES_PER_S, 4)}
+        if compile_report is not None and k in compile_report:
+            # the observatory's measured cold/warm split replaces the
+            # lumped first-run-minus-warm guess
+            del detail[k]["compile_s"]
+            detail[k].update(compile_report[k])
     cold_s = measure_cache_cold(n_rows)
     out = {
         "metric": "sql_suite_rows_per_sec",

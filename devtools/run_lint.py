@@ -46,6 +46,14 @@ distinct subsystems (spill, arena, shuffle, fetch, session queries,
 bridge) in the Prometheus exposition, and the JSON health snapshot
 must carry the expected schema.
 
+--jit runs the compile-observatory gate: the golden corpus replays
+twice in ONE process and the second pass must build ZERO programs
+(shape-canonicalization honesty), the compile ledger / jit.build spans
+/ tpu_jit_misses_total must agree about the build count, every build
+must carry a classified cause with >= 95% of wall compile time
+attributed, and injected bucket/dtype perturbations must classify as
+shape_churn / dtype_churn (anti-vacuity).
+
     python devtools/run_lint.py                    # repo check
     python devtools/run_lint.py --update-baseline  # re-freeze debt
     python devtools/run_lint.py --interp           # plan typechecker gate
@@ -53,6 +61,7 @@ must carry the expected schema.
     python devtools/run_lint.py --obs              # flight-recorder gate
     python devtools/run_lint.py --regress          # cross-run watchdog gate
     python devtools/run_lint.py --metrics          # metrics/health gate
+    python devtools/run_lint.py --jit              # compile-observatory gate
 """
 
 import json
@@ -313,6 +322,10 @@ dim = pa.table({
 s = (TpuSession.builder()
      .config("spark.rapids.sql.enabled", True)
      .config("spark.rapids.tpu.singleChipFuse", "off")
+     # pin the sort kernel structure: 'auto' decides from the persistent
+     # compile cache's cold/warm state, and the two gate replays must
+     # compile the SAME program set (distinct_programs is deterministic)
+     .config("spark.rapids.tpu.sort.compileLean", "off")
      .config("spark.rapids.tpu.eventLog.dir", eventlog_dir)
      .get_or_create())
 fdf = s.create_dataframe(fact, num_partitions=2)
@@ -497,6 +510,161 @@ def run_metrics_gate() -> int:
     return 0
 
 
+def run_jit_gate() -> int:
+    """Compile-observatory gate: the golden corpus replays TWICE in one
+    process — the second pass must produce ZERO program builds (shape-
+    canonicalization honesty: identical queries must share programs),
+    the ledger, the jit.build spans and the tpu_jit_misses_total metric
+    must agree about the build count, every build must carry a
+    classified cause, `tools compile-report` must attribute >= 95% of
+    measured wall compile time, and (anti-vacuity) a key/shape
+    perturbing injection must produce a classified churn miss."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.exec import base as eb
+    from spark_rapids_tpu.obs.compileprof import (CAUSES,
+                                                  CompileObservatory)
+    from spark_rapids_tpu.obs.metrics import MetricsRegistry
+    from spark_rapids_tpu.tools.eventlog import parse_event_log
+
+    failures = 0
+    tmp = tempfile.mkdtemp(prefix="jit_gate_")
+    reg = MetricsRegistry.reset_for_tests()
+    obs = CompileObservatory.reset_for_tests()
+    eb.clear_jit_cache()
+    try:
+        evt = os.path.join(tmp, "evt")
+        os.makedirs(evt)
+        hist = os.path.join(tmp, "hist")
+        s = (TpuSession.builder()
+             .config("spark.rapids.sql.enabled", True)
+             .config("spark.rapids.tpu.singleChipFuse", "off")
+             .config("spark.rapids.tpu.sort.compileLean", "off")
+             .config("spark.rapids.tpu.eventLog.dir", evt)
+             .config("spark.rapids.tpu.compile.ledgerDir", hist)
+             .get_or_create())
+        rng = np.random.default_rng(1234)
+        fact = pa.table({
+            "k": pa.array((rng.integers(0, 97, 4000)).astype(np.int64)),
+            "v": pa.array(rng.integers(-1000, 1000, 4000)
+                          .astype(np.int64))})
+        dim = pa.table({
+            "k": pa.array(np.arange(97, dtype=np.int64)),
+            "w": pa.array(np.arange(97, dtype=np.int64) * 3)})
+        fdf = s.create_dataframe(fact, num_partitions=2)
+        ddf = s.create_dataframe(dim)
+
+        def corpus():
+            o1 = (fdf.filter(col("v") > -500).group_by(col("k"))
+                  .agg(F.sum(col("v")).alias("sv"),
+                       F.count("*").alias("c")).collect())
+            o2 = (fdf.join(ddf, on="k", how="inner").group_by(col("k"))
+                  .agg(F.sum(col("w")).alias("sw")).collect())
+            o3 = fdf.sort(col("k"), col("v")).collect()
+            assert (o1.num_rows, o2.num_rows, o3.num_rows) == \
+                (97, 97, 4000)
+
+        corpus()
+        snap1 = obs.snapshot()
+        if snap1["builds"] == 0:
+            failures += 1
+            print("JIT: vacuous gate — the corpus compiled nothing")
+        for cause in snap1["by_cause"]:
+            if cause not in CAUSES:
+                failures += 1
+                print(f"JIT: unrecognized miss cause {cause!r}")
+        corpus()
+        snap2 = obs.snapshot()
+        if snap2["builds"] != snap1["builds"]:
+            failures += 1
+            print(f"JIT: SECOND-PASS MISS — replaying the identical "
+                  f"corpus built {snap2['builds'] - snap1['builds']} "
+                  f"new program(s) (shape canonicalization is lying); "
+                  f"causes now {snap2['by_cause']}")
+
+        # three sinks, one truth: ledger / spans / metrics must agree
+        ledger_builds = 0
+        ledger_path = os.path.join(hist, "compile_ledger.jsonl")
+        if os.path.exists(ledger_path):
+            with open(ledger_path) as f:
+                ledger_builds = sum(
+                    1 for line in f if line.strip()
+                    and json.loads(line).get("event") == "build")
+        logs = [f for f in os.listdir(evt) if f.startswith("events_")]
+        span_builds = 0
+        if logs:
+            app = parse_event_log(os.path.join(evt, logs[0]))
+            span_builds = sum(1 for sp in app.spans
+                              if sp.get("name") == "jit.build")
+        fam = reg.counter("tpu_jit_misses_total",
+                          labelnames=("exec", "cause"))
+        metric_builds = sum(ch.value for _, ch in fam.series())
+        if not (snap2["builds"] == ledger_builds == span_builds ==
+                metric_builds):
+            failures += 1
+            print(f"JIT: build-count disagreement — observatory "
+                  f"{snap2['builds']}, ledger {ledger_builds}, "
+                  f"jit.build spans {span_builds}, "
+                  f"tpu_jit_misses_total {metric_builds}")
+
+        # anti-vacuity: a capacity-bucket perturbation (same program
+        # modulo buckets) must be classified, not silently re-counted
+        # as novel work
+        import jax.numpy as jnp
+        probe = eb.process_jit(("JitGateProbe", "sig"),
+                               lambda: (lambda x: x + 1))
+        probe(jnp.zeros(1024, jnp.int32))
+        churn0 = obs.snapshot()["by_cause"].get("shape_churn", 0)
+        probe(jnp.zeros(8192, jnp.int32))         # bucket perturbation
+        churn1 = obs.snapshot()["by_cause"].get("shape_churn", 0)
+        if churn1 != churn0 + 1:
+            failures += 1
+            print(f"JIT: bucket-perturbed probe not classified as "
+                  f"shape_churn (causes {obs.snapshot()['by_cause']})")
+        dt0 = obs.snapshot()["by_cause"].get("dtype_churn", 0)
+        probe(jnp.zeros(1024, jnp.float32))       # dtype perturbation
+        dt1 = obs.snapshot()["by_cause"].get("dtype_churn", 0)
+        if dt1 != dt0 + 1:
+            failures += 1
+            print(f"JIT: dtype-perturbed probe not classified as "
+                  f"dtype_churn (causes {obs.snapshot()['by_cause']})")
+
+        # the acceptance bar: the report must attribute the wall
+        # compile time it measured, with every miss carrying a cause
+        from spark_rapids_tpu.tools.compile_report import (
+            aggregate_ledger, load_ledger)
+        agg = aggregate_ledger(load_ledger(ledger_path))
+        if agg["attribution_pct"] < 95.0:
+            failures += 1
+            print(f"JIT: compile-report attributes only "
+                  f"{agg['attribution_pct']:.1f}% of wall compile "
+                  f"time (< 95%)")
+        if agg["causeless_builds"]:
+            failures += 1
+            print(f"JIT: {agg['causeless_builds']} build(s) carry no "
+                  f"miss cause")
+        n_builds = snap2["builds"]
+        total_s = agg["total_s"]
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+        eb.clear_jit_cache()
+    if failures:
+        print(f"jit gate: {failures} failure(s)")
+        return 1
+    print(f"jit gate clean ({n_builds} corpus program(s) built once, "
+          f"{total_s:.2f}s wall compile fully attributed; second pass "
+          f"zero-miss; ledger/span/metric counts agree; bucket and "
+          f"dtype perturbations classified)")
+    return 0
+
+
 def main(argv=None):
     args = argv if argv is not None else sys.argv[1:]
     if "--interp" in args:
@@ -509,6 +677,8 @@ def main(argv=None):
         return run_regress_gate()
     if "--metrics" in args:
         return run_metrics_gate()
+    if "--jit" in args:
+        return run_jit_gate()
     from spark_rapids_tpu.tools.__main__ import main as tools_main
     cli = ["lint", "--repo", "--baseline", BASELINE]
     if "--update-baseline" in args:

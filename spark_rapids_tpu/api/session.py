@@ -54,6 +54,21 @@ class TpuSession:
             self.metrics_server = ensure_server(port)
         else:
             self.metrics_server = None
+        # compile observatory: every XLA build at the process_jit seam
+        # gets split timing, a classified cause and (with a ledger dir)
+        # cross-session persistence (obs/compileprof.py)
+        from ..obs.compileprof import CompileObservatory
+        ledger_dir = conf.get(cfg.COMPILE_LEDGER_DIR) or \
+            conf.get(cfg.REGRESS_HISTORY_DIR)
+        ledger_path = None
+        if ledger_dir:
+            from ..obs.history import HistoryDir
+            ledger_path = HistoryDir(ledger_dir).compile_ledger_path()
+        CompileObservatory.get().configure(
+            enabled=conf.get(cfg.COMPILE_OBSERVATORY_ENABLED),
+            ledger_path=ledger_path,
+            buckets=conf.capacity_buckets + conf.string_data_buckets,
+            thrash_warn_ratio=conf.get(cfg.JIT_THRASH_WARN_RATIO))
         from ..memory.meta import set_default_codec
         set_default_codec(conf.get(cfg.SHUFFLE_COMPRESSION_CODEC))
         from ..shims import ShimLoader, set_active_shim

@@ -471,6 +471,44 @@ COMPILATION_CACHE_DIR = conf("spark.rapids.tpu.compilationCache.dir") \
     .doc("Directory for the persistent XLA compilation cache.") \
     .create_with_default("~/.cache/spark_rapids_tpu_xla")
 
+JIT_PERSISTENT_CACHE_DIR = conf("spark.rapids.tpu.jit.persistentCacheDir") \
+    .string() \
+    .doc("Explicit directory for JAX's built-in persistent compilation "
+         "cache (jax_compilation_cache_dir), wired at session init.  "
+         "Overrides spark.rapids.tpu.compilationCache.dir when set; the "
+         "platform/XLA-flags/host fingerprint subdirectory scoping "
+         "still applies.  Disk hits and misses are counted as "
+         "tpu_jit_persistent_cache_{hits,misses}_total.") \
+    .create_optional()
+
+JIT_THRASH_WARN_RATIO = conf("spark.rapids.tpu.jit.cacheThrashWarnRatio") \
+    .double() \
+    .doc("Warn when the process JIT cache thrashes: refault rate "
+         "(eviction_refault rebuilds / LRU evictions) above this ratio "
+         "logs a warning suggesting a larger "
+         "SPARK_RAPIDS_TPU_JIT_CACHE_MAX.") \
+    .check(lambda v: 0.0 < v <= 1.0, "must be in (0,1]") \
+    .create_with_default(0.5)
+
+COMPILE_OBSERVATORY_ENABLED = conf(
+    "spark.rapids.tpu.compile.observatory.enabled").boolean() \
+    .doc("Attribute, classify and persist every XLA program build at "
+         "the process_jit seam (obs/compileprof.py): split trace-vs-"
+         "compile timing, miss-cause classification (new_program / "
+         "shape_churn / dtype_churn / eviction_refault), the "
+         "tpu_jit_* metrics family, enriched jit.build spans and the "
+         "cross-session compile ledger `tools compile-report` reads.") \
+    .create_with_default(True)
+
+COMPILE_LEDGER_DIR = conf("spark.rapids.tpu.compile.ledgerDir") \
+    .string() \
+    .doc("Directory for the cross-session compile ledger "
+         "(compile_ledger.jsonl, appended by the compile observatory "
+         "and aggregated by `tools compile-report`).  Defaults to "
+         "spark.rapids.tpu.regress.historyDir when that is set; unset "
+         "both and builds are still traced/metered but not persisted.") \
+    .create_optional()
+
 PROFILE_TRACE_ANNOTATIONS = conf(
     "spark.rapids.sql.profile.traceAnnotations").boolean() \
     .doc("Wrap timed operator work in jax.profiler TraceAnnotation ranges "

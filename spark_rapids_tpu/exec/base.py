@@ -53,12 +53,6 @@ def _active_tracer():
     return _obs_mod.active_tracer()
 
 
-def _trace_event(name: str, **attrs) -> None:
-    tr = _active_tracer()
-    if tr is not None:
-        tr.event(name, **attrs)
-
-
 # ---------------------------------------------------------------------------
 # Process-level jit cache
 # ---------------------------------------------------------------------------
@@ -89,12 +83,27 @@ import os as _os
 _JIT_CACHE_MAX = int(_os.environ.get("SPARK_RAPIDS_TPU_JIT_CACHE_MAX",
                                      "192"))
 
+_compileprof_mod = None
+
+
+def _observatory():
+    """The compile observatory (obs/compileprof.py): every build,
+    hit and eviction at this seam is attributed, classified and
+    persisted there.  Lazy module load, cached like the tracer hook."""
+    global _compileprof_mod
+    if _compileprof_mod is None:
+        from ..obs import compileprof as _c
+        _compileprof_mod = _c
+    return _compileprof_mod.CompileObservatory.get()
+
 
 def process_jit(key: tuple, make_fn):
     """Return the process-cached jitted function for `key`, building it
     with make_fn() (a 0-arg factory returning the python callable) on
-    first use.  jax.jit itself then caches per input-shape signature, so
-    capacity buckets share one entry here.
+    first use.  Per input-shape compilation under one entry is handled
+    by the compile observatory's AOT proxy (or jax.jit's own cache when
+    the observatory is disabled), so capacity buckets share one entry
+    here.
 
     The active shim version joins the key: dialect-sensitive expressions
     (legacy stddev, lenient date cast) trace DIFFERENT computations per
@@ -104,23 +113,33 @@ def process_jit(key: tuple, make_fn):
     key = (active_shim().version,) + key
     f = _JIT_CACHE.get(key)
     if f is None:
-        # flight recorder: a cache miss here is the "compile" phase a
-        # query pays (tracing off -> no-op)
-        _trace_event("jit.build", sig=str(key[1])[:80],
-                     cache_size=len(_JIT_CACHE))
-        f = jax.jit(make_fn())
+        obs = _observatory()
+        f = obs.build(key, make_fn)
         while len(_JIT_CACHE) >= _JIT_CACHE_MAX:
-            _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
+            ekey = next(iter(_JIT_CACHE))
+            # never evict silently: count it, ledger it, and remember
+            # the evicted fingerprints so a rebuild classifies as
+            # eviction_refault (thrash becomes visible, not weather)
+            obs.note_eviction(ekey, _JIT_CACHE.pop(ekey))
         _JIT_CACHE[key] = f
+        obs.note_cache_size(len(_JIT_CACHE))
     else:
         # move-to-end: LRU order rides dict insertion order
         _JIT_CACHE.pop(key)
         _JIT_CACHE[key] = f
+        _observatory().note_hit(key)
     return f
 
 
 def clear_jit_cache() -> None:
     _JIT_CACHE.clear()
+    # a deliberate reset, not LRU pressure: programs become
+    # non-resident (honest refault classification) without counting
+    # evictions or arming the thrash warning
+    try:
+        _observatory().note_clear()
+    except Exception:
+        pass
 
 
 def jit_cache_size() -> int:

@@ -2,8 +2,10 @@
 emitted SparkListener event logs, Chrome-trace/text exporters, the
 predicted-vs-actual accuracy loop) plus the CONTINUOUS layer — the
 process-wide metrics registry (obs/metrics.py), the Prometheus/health
-exposition (obs/health.py) and the cross-run regression watchdog
-(obs/history.py).  See docs/observability.md."""
+exposition (obs/health.py), the cross-run regression watchdog
+(obs/history.py) and the compile observatory (obs/compileprof.py:
+split build timing, miss-cause classification and the cross-session
+compile ledger at the process_jit seam).  See docs/observability.md."""
 
 from .tracer import (QueryTrace, active_tracer, install, trace_event,
                      trace_span, uninstall)

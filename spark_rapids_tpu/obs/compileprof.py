@@ -1,0 +1,622 @@
+"""Compile observatory: attribute, classify and persist every XLA
+compilation the engine pays for.
+
+BENCH_r05_builder measured the join suite at 68.6 s of compile against
+0.372 s of device time — the engine is compile-bound, and until this
+module the only record of a compilation was an unlabeled ``jit.build``
+instant event with no duration, no cause and no cross-session memory.
+The observatory sits at the single ``process_jit`` seam
+(``exec/base.py``): every jit in exec/, parallel/, columnar/ and
+shuffle/ already routes through that table, so one wrapper sees every
+program the process ever builds.
+
+What one build produces:
+
+* **Split timing.**  The returned callable dispatches through an AOT
+  proxy: the first call per input-shape signature runs
+  ``jit(f).lower(*args)`` (trace + lower, timed) then
+  ``lowered.compile()`` (backend compile, timed — this is the step the
+  persistent disk cache can absorb) and caches the compiled executable
+  for every later call with that signature.  The split is what ROADMAP
+  item 1 needs: re-trace cost survives a disk cache, backend cost does
+  not.
+* **A program fingerprint.**  Exec kind parsed from the jit key, a
+  stable hash of the semantic key, a bucket-canonical key hash (every
+  int in the key or leading array dim that equals a configured
+  capacity/string bucket is masked), the input dtype signature and the
+  capacity signature, plus the lowered StableHLO size.
+* **A classified cause.**  Every build is diffed against the index of
+  previously seen programs (this process + the loaded ledger):
+
+  - ``eviction_refault`` — this exact program was built before and is
+    no longer resident (LRU eviction, cache clear, or a previous
+    session: process death is the ultimate eviction);
+  - ``shape_churn``     — the same program modulo capacity buckets was
+    already built (same exec + canonical key + dtypes, different
+    bucket) — the recompiles bucket canonicalization would erase;
+  - ``dtype_churn``     — the same exec + capacity signature was built
+    under a different dtype signature;
+  - ``new_program``     — genuinely novel work.
+
+* **Three sinks, one truth.**  Each build (a) stamps an enriched
+  ``jit.build`` span on the active flight-recorder trace, (b) feeds the
+  ``tpu_jit_{hits,misses,evictions,compile_seconds}_total`` metric
+  families plus the ``tpu_jit_cache_size`` gauge, and (c) appends one
+  JSONL record to the cross-session compile ledger
+  (``compile_ledger.jsonl`` in the obs/history.py HistoryDir).  The CI
+  gate (``devtools/run_lint.py --jit``) fails when the three disagree
+  about the build count.
+
+``tools compile-report`` aggregates the ledger into
+top-programs-by-compile-cost, churn offenders and the dedupe projection
+("N programs collapse to M under bucket canonicalization") — the
+evidence the persistent-cache key design needs.
+
+Overhead discipline: with the observatory disabled every ``process_jit``
+call costs one extra attribute read; enabled, a warm call pays one
+pytree flatten + dict lookup per batch (same cost class as the tracer's
+per-batch bookkeeping, never a device touch or a lock on the warm
+path).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+log = logging.getLogger("spark_rapids_tpu.obs.compileprof")
+
+LEDGER_FILENAME = "compile_ledger.jsonl"
+LEDGER_VERSION = 1
+
+# miss-cause taxonomy (closed: every build carries exactly one)
+CAUSE_NEW = "new_program"
+CAUSE_SHAPE = "shape_churn"
+CAUSE_DTYPE = "dtype_churn"
+CAUSE_REFAULT = "eviction_refault"
+CAUSES = (CAUSE_NEW, CAUSE_SHAPE, CAUSE_DTYPE, CAUSE_REFAULT)
+
+# default bucket set for canonicalization, matching the config defaults
+# (spark.rapids.tpu.batchCapacityBuckets / .stringDataBuckets); sessions
+# override via configure() so changed bucket configs stay honest
+_DEFAULT_BUCKETS = frozenset(
+    (1024, 8192, 65536, 262144, 1048576, 4194304,
+     16384, 131072, 8388608, 67108864, 268435456))
+
+_CAP_MASK = "<cap>"
+
+# jit families can out-card the default 64-series cap: exec kinds alone
+# approach it, and misses fan out by cause
+_JIT_MAX_SERIES = 256
+
+
+def _stable_hash(obj: Any) -> str:
+    """12-hex stable hash of a semantic key.  repr() is stable for the
+    atoms semantic_sig produces (strings, ints, bytes, type names); the
+    rare id()-keyed fallback entries hash per-process only — they can
+    fragment cross-session aggregation, never corrupt it."""
+    return hashlib.sha1(repr(obj).encode()).hexdigest()[:12]
+
+
+def _mask_buckets(v: Any, buckets) -> Any:
+    """The jit key with every capacity-bucket int replaced by a
+    sentinel: two keys that differ only in bucket choice canonicalize
+    to the same value (the dedupe axis of `tools compile-report`)."""
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, int):
+        return _CAP_MASK if v in buckets else v
+    if isinstance(v, tuple):
+        return tuple(_mask_buckets(x, buckets) for x in v)
+    if isinstance(v, list):
+        return [_mask_buckets(x, buckets) for x in v]
+    return v
+
+
+def _exec_kind(key: tuple) -> str:
+    """The operator kind from a process_jit key.  Keys arrive as
+    (shim_version, kind, ...); the kind is the first string past the
+    version for every call site in the tree."""
+    for part in key[1:]:
+        if isinstance(part, str):
+            return part
+    return str(key[1])[:40] if len(key) > 1 else "?"
+
+
+# ---------------------------------------------------------------------------
+# input-shape signatures
+# ---------------------------------------------------------------------------
+
+_PY_SCALARS = (int, float, bool, complex)
+
+
+def _leaf_sig(leaf) -> Optional[Tuple]:
+    """(dtype, shape, sharding) of one call-argument leaf, or None when
+    the leaf has no stable signature (tracers under an enclosing trace,
+    arbitrary objects) — the caller then falls back to plain jit
+    dispatch.  The sharding joins the signature because an AOT-compiled
+    executable bakes its input shardings in: a mesh-committed array
+    (ICI stage output) and a single-device one are DIFFERENT programs
+    (jit's own dispatch cache keys the same way)."""
+    import jax
+    if isinstance(leaf, jax.core.Tracer):
+        return None
+    dt = getattr(leaf, "dtype", None)
+    shape = getattr(leaf, "shape", None)
+    if dt is not None and shape is not None:
+        return (str(dt), tuple(int(s) for s in shape),
+                getattr(leaf, "sharding", None))
+    if isinstance(leaf, _PY_SCALARS):
+        # python scalars are weak-typed dynamic args under jit: the
+        # TYPE picks the program, the value rides at call time
+        return (type(leaf).__name__, (), None)
+    return None
+
+
+def _dispatch_key(args) -> Optional[tuple]:
+    """Hashable per-call signature (treedef + leaf dtype/shape), or
+    None when any leaf is unsignable."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    sigs = []
+    for leaf in leaves:
+        s = _leaf_sig(leaf)
+        if s is None:
+            return None
+        sigs.append(s)
+    return (treedef, tuple(sigs))
+
+
+def _shape_record(sig: tuple, buckets) -> Tuple[str, tuple, tuple, tuple]:
+    """(shape_hash, dtype_sig, cap_sig, canon_caps) from a dispatch
+    key.  cap_sig is the tuple of leaf shapes (the capacity buckets ride
+    the leading dims); canon_caps masks bucket-valued dims.  The
+    shardings join the shape hash (program identity) but not the
+    dtype/cap signatures the cause classifier compares — a resharded
+    rebuild reads as shape_churn, the nearest honest cause.  The
+    treedef joins the hash too: same leaves under a different pytree
+    structure (e.g. renamed batch columns) is a different program."""
+    treedef, leaf_sigs = sig
+    dtype_sig = tuple(s[0] for s in leaf_sigs)
+    cap_sig = tuple(s[1] for s in leaf_sigs)
+    shardings = tuple(repr(s[2]) for s in leaf_sigs)
+    canon = tuple(tuple(_CAP_MASK if d in buckets else d for d in shp)
+                  for shp in cap_sig)
+    return (_stable_hash((repr(treedef), dtype_sig, cap_sig,
+                          shardings)), dtype_sig, cap_sig, canon)
+
+
+# ---------------------------------------------------------------------------
+# the observatory
+# ---------------------------------------------------------------------------
+
+class CompileObservatory:
+    """Process-wide singleton recording every XLA program build."""
+
+    _instance: Optional["CompileObservatory"] = None
+    _ilock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.enabled = True
+        self.ledger_path: Optional[str] = None
+        self.thrash_warn_ratio = 0.5
+        self.buckets = frozenset(_DEFAULT_BUCKETS)
+        # program index: pid = (key_hash, shape_hash)
+        self._programs: Dict[Tuple[str, str], Dict] = {}
+        self._resident: set = set()        # pids live in this process
+        self._evicted: set = set()         # seen, no longer resident
+        self._evicted_live: set = set()    # evicted by THIS process's LRU
+        self._families: set = set()        # (exec, canon_key, dtype_hash)
+        self._cap_index: Dict[Tuple[str, str], set] = {}
+        # counters (read via snapshot(); the registry carries the
+        # exported copies)
+        self.builds = 0
+        self.hits = 0
+        self.evictions = 0
+        self.refaults = 0
+        self.compile_seconds_total = 0.0
+        self.trace_seconds_total = 0.0
+        self.by_cause: Dict[str, int] = {}
+        self._warn_next = 1
+
+    # -- lifecycle -----------------------------------------------------------
+    @classmethod
+    def get(cls) -> "CompileObservatory":
+        with cls._ilock:
+            if cls._instance is None:
+                cls._instance = CompileObservatory()
+            return cls._instance
+
+    @classmethod
+    def reset_for_tests(cls) -> "CompileObservatory":
+        """Fresh observatory (tests and CI gates need known-empty
+        indexes; production never calls this)."""
+        with cls._ilock:
+            cls._instance = CompileObservatory()
+            return cls._instance
+
+    def configure(self, enabled: Optional[bool] = None,
+                  ledger_path: Optional[str] = None,
+                  buckets=None,
+                  thrash_warn_ratio: Optional[float] = None) -> None:
+        """Session-init wiring.  Setting a ledger path loads the prior
+        sessions' program index, so cross-session rebuilds classify as
+        refaults instead of novel work."""
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if buckets is not None:
+                self.buckets = frozenset(int(b) for b in buckets)
+            if thrash_warn_ratio is not None:
+                self.thrash_warn_ratio = float(thrash_warn_ratio)
+            if ledger_path is not None and \
+                    ledger_path != self.ledger_path:
+                self.ledger_path = ledger_path
+                self._load_ledger(ledger_path)
+
+    def _load_ledger(self, path: str) -> None:
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if rec.get("event") != "build":
+                        continue
+                    pid = (rec.get("key", ""), rec.get("shape", ""))
+                    if pid in self._resident:
+                        continue
+                    self._programs.setdefault(pid, rec)
+                    self._evicted.add(pid)
+                    self._families.add((rec.get("exec", ""),
+                                        rec.get("canon_key", ""),
+                                        rec.get("dtype_hash", "")))
+                    self._cap_index.setdefault(
+                        (rec.get("exec", ""), rec.get("cap_hash", "")),
+                        set()).add(rec.get("dtype_hash", ""))
+        except OSError as ex:
+            log.warning("compile ledger unreadable: %s", ex)
+
+    # -- the process_jit seam ------------------------------------------------
+    def build(self, key: tuple, make_fn):
+        """Called on a process_jit table miss: returns the callable the
+        table stores.  Enabled -> an AOT proxy that times and records
+        every per-shape program build; disabled -> plain jax.jit plus
+        the legacy untimed jit.build event."""
+        import jax
+        jitted = jax.jit(make_fn())
+        if not self.enabled:
+            from .tracer import trace_event
+            trace_event("jit.build", sig=str(_exec_kind(key))[:80])
+            return jitted
+        return _ProfiledJit(self, key, jitted)
+
+    def note_hit(self, key: tuple) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self.hits += 1
+        from . import metrics as m
+        _fam_hits().labels(exec=_exec_kind(key)).inc()
+
+    def note_eviction(self, key: tuple, fn) -> None:
+        """One LRU eviction from the process jit table: counted,
+        ledgered, and the entry's programs marked non-resident so a
+        rebuild classifies as eviction_refault."""
+        if not self.enabled:
+            return
+        exec_kind = _exec_kind(key)
+        pids: List[Tuple[str, str]] = []
+        if isinstance(fn, _ProfiledJit):
+            pids = list(fn.built_pids())
+        with self._lock:
+            self.evictions += 1
+            for pid in pids:
+                self._resident.discard(pid)
+                self._evicted.add(pid)
+                self._evicted_live.add(pid)
+        _fam_evictions().labels(exec=exec_kind).inc()
+        self._append_ledger({
+            "event": "evict", "exec": exec_kind,
+            "key": _stable_hash(key),
+            "programs": [p[1] for p in pids]})
+
+    def note_clear(self) -> None:
+        """clear_jit_cache(): a deliberate reset, not LRU pressure —
+        resident programs become non-resident (rebuilds are honest
+        refaults) but no eviction is counted and no thrash warning can
+        arise from it."""
+        with self._lock:
+            self._evicted |= self._resident
+            self._resident = set()
+
+    def note_cache_size(self, n: int) -> None:
+        if not self.enabled:
+            return
+        _fam_cache_size().set(n)
+
+    # -- recording -----------------------------------------------------------
+    def classify(self, exec_kind: str, pid: Tuple[str, str],
+                 canon_key: str, dtype_hash: str,
+                 cap_hash: str) -> str:
+        """Cause of one build against the seen-program index; caller
+        holds the lock."""
+        if pid in self._evicted:
+            return CAUSE_REFAULT
+        if (exec_kind, canon_key, dtype_hash) in self._families:
+            return CAUSE_SHAPE
+        seen_dtypes = self._cap_index.get((exec_kind, cap_hash))
+        if seen_dtypes and dtype_hash not in seen_dtypes:
+            return CAUSE_DTYPE
+        return CAUSE_NEW
+
+    def record_build(self, exec_kind: str, key_hash: str,
+                     canon_key: str, sig: tuple,
+                     trace_s: Optional[float],
+                     compile_s: Optional[float], total_s: float,
+                     hlo_bytes: int, key_head: str) -> str:
+        """Register one program build; returns the classified cause."""
+        shape_hash, dtype_sig, cap_sig, canon_caps = \
+            _shape_record(sig, self.buckets)
+        dtype_hash = _stable_hash(dtype_sig)
+        cap_hash = _stable_hash(cap_sig)
+        pid = (key_hash, shape_hash)
+        with self._lock:
+            cause = self.classify(exec_kind, pid, canon_key,
+                                  dtype_hash, cap_hash)
+            was_live = pid in self._evicted_live
+            self.builds += 1
+            self.by_cause[cause] = self.by_cause.get(cause, 0) + 1
+            self.compile_seconds_total += compile_s or 0.0
+            self.trace_seconds_total += trace_s or 0.0
+            self._programs[pid] = {
+                "exec": exec_kind, "key": key_hash,
+                "canon_key": canon_key, "shape": shape_hash,
+                "cause": cause, "total_s": total_s}
+            self._resident.add(pid)
+            self._evicted.discard(pid)
+            self._evicted_live.discard(pid)
+            self._families.add((exec_kind, canon_key, dtype_hash))
+            self._cap_index.setdefault(
+                (exec_kind, cap_hash), set()).add(dtype_hash)
+            warn = None
+            if cause == CAUSE_REFAULT and was_live:
+                self.refaults += 1
+                rate = self.refaults / max(1, self.evictions)
+                if rate > self.thrash_warn_ratio and \
+                        self.refaults >= self._warn_next:
+                    self._warn_next = max(2, self.refaults * 2)
+                    warn = (self.refaults, self.evictions, rate)
+        if warn is not None:
+            log.warning(
+                "JIT cache thrash: %d of %d evicted programs were "
+                "rebuilt (refault rate %.0f%% > %.0f%% threshold) — "
+                "raise SPARK_RAPIDS_TPU_JIT_CACHE_MAX or reduce "
+                "distinct query shapes per process",
+                warn[0], warn[1], 100 * warn[2],
+                100 * self.thrash_warn_ratio)
+        _fam_misses().labels(exec=exec_kind, cause=cause).inc()
+        if total_s:
+            _fam_compile_seconds().labels(
+                exec=exec_kind, cause=cause).inc(total_s)
+        self._append_ledger({
+            "event": "build", "exec": exec_kind, "key": key_hash,
+            "canon_key": canon_key, "shape": shape_hash,
+            "dtype_hash": dtype_hash, "cap_hash": cap_hash,
+            "cause": cause,
+            "trace_s": None if trace_s is None else round(trace_s, 6),
+            "compile_s": None if compile_s is None
+            else round(compile_s, 6),
+            "total_s": round(total_s, 6), "hlo_bytes": hlo_bytes,
+            "dtypes": list(dtype_sig),
+            "caps": [list(s) for s in cap_sig],
+            "canon_caps": [list(s) for s in canon_caps],
+            "key_head": key_head})
+        from .tracer import trace_event
+        trace_event("jit.build", op=exec_kind, cause=cause,
+                    key=key_hash, shape=shape_hash,
+                    total_s=round(total_s, 6),
+                    trace_s=None if trace_s is None
+                    else round(trace_s, 6),
+                    compile_s=None if compile_s is None
+                    else round(compile_s, 6),
+                    hlo_bytes=hlo_bytes, sig=key_head)
+        return cause
+
+    def _append_ledger(self, rec: Dict) -> None:
+        path = self.ledger_path
+        if path is None:
+            return
+        rec = dict(rec, v=LEDGER_VERSION, ts=round(time.time(), 3),
+                   os_pid=os.getpid())
+        try:
+            with self._lock:
+                with open(path, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(rec, sort_keys=True) + "\n")
+        except OSError as ex:  # the ledger is telemetry, never fatal
+            log.warning("compile ledger append failed: %s", ex)
+
+    # -- read side -----------------------------------------------------------
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "builds": self.builds,
+                "hits": self.hits,
+                "evictions": self.evictions,
+                "refaults": self.refaults,
+                "compile_seconds_total":
+                    round(self.compile_seconds_total, 6),
+                "trace_seconds_total":
+                    round(self.trace_seconds_total, 6),
+                "by_cause": dict(self.by_cause),
+                "distinct_programs": len(self._programs),
+                "resident_programs": len(self._resident),
+            }
+
+
+# ---------------------------------------------------------------------------
+# the AOT proxy
+# ---------------------------------------------------------------------------
+
+class _ProfiledJit:
+    """Callable stored in the process jit table: dispatches per
+    input-shape signature to an AOT-compiled executable, timing the
+    lower/compile split on each first-per-shape call."""
+
+    __slots__ = ("_obs", "_key_hash", "_canon_key", "_exec",
+                 "_key_head", "_jitted", "_compiled", "_lock")
+
+    def __init__(self, obs: CompileObservatory, key: tuple, jitted):
+        self._obs = obs
+        self._exec = _exec_kind(key)
+        self._key_hash = _stable_hash(key)
+        self._canon_key = _stable_hash(_mask_buckets(key, obs.buckets))
+        self._key_head = str(key[1] if len(key) > 1 else key)[:80]
+        self._jitted = jitted
+        self._compiled: Dict[tuple, Any] = {}
+        self._lock = threading.Lock()
+
+    def built_pids(self) -> List[Tuple[str, str]]:
+        return [(self._key_hash,
+                 _shape_record(sk, self._obs.buckets)[0])
+                for sk in list(self._compiled)]
+
+    def __call__(self, *args):
+        sig = _dispatch_key(args)
+        if sig is None:
+            # unsignable leaves (e.g. called under an enclosing trace):
+            # plain jit dispatch, no profiling
+            return self._jitted(*args)
+        fn = self._compiled.get(sig)
+        if fn is not None:
+            return fn(*args)
+        return self._build_and_call(sig, args)
+
+    def _build_and_call(self, sig, args):
+        with self._lock:
+            fn = self._compiled.get(sig)
+            if fn is None:
+                fn = self._build(sig, args)
+                self._compiled[sig] = fn
+        return fn(*args)
+
+    def _build(self, sig, args):
+        t0 = time.perf_counter()
+        trace_s = compile_s = None
+        hlo_bytes = 0
+        try:
+            lowered = self._jitted.lower(*args)
+            t1 = time.perf_counter()
+            trace_s = t1 - t0
+            try:
+                hlo_bytes = len(lowered.as_text())
+            except Exception:
+                hlo_bytes = 0
+            fn = lowered.compile()
+            compile_s = time.perf_counter() - t1
+        except Exception:
+            # the AOT path is an observation vehicle: any lower/compile
+            # surprise falls back to plain jit dispatch (which recompiles
+            # internally and raises its own honest error if the program
+            # itself is broken)
+            fn = self._jitted
+        total_s = time.perf_counter() - t0
+        self._obs.record_build(self._exec, self._key_hash,
+                               self._canon_key, sig, trace_s,
+                               compile_s, total_s, hlo_bytes,
+                               self._key_head)
+        return fn
+
+
+# ---------------------------------------------------------------------------
+# metric families (created idempotently; cached to keep the seam cheap)
+# ---------------------------------------------------------------------------
+
+def _registry():
+    from . import metrics
+    return metrics.registry()
+
+
+def _fam_hits():
+    return _registry().counter(
+        "tpu_jit_hits_total", "process jit-table hits", ("exec",),
+        max_series=_JIT_MAX_SERIES)
+
+
+def _fam_misses():
+    return _registry().counter(
+        "tpu_jit_misses_total",
+        "program builds (jit-table or per-shape misses), by cause",
+        ("exec", "cause"), max_series=_JIT_MAX_SERIES)
+
+
+def _fam_evictions():
+    return _registry().counter(
+        "tpu_jit_evictions_total", "process jit-table LRU evictions",
+        ("exec",), max_series=_JIT_MAX_SERIES)
+
+
+def _fam_compile_seconds():
+    return _registry().counter(
+        "tpu_jit_compile_seconds_total",
+        "wall seconds spent building programs (trace+lower+compile)",
+        ("exec", "cause"), max_series=_JIT_MAX_SERIES)
+
+
+def _fam_cache_size():
+    return _registry().gauge(
+        "tpu_jit_cache_size", "live entries in the process jit table")
+
+
+# ---------------------------------------------------------------------------
+# persistent disk-cache metrics (satellite of ROADMAP item 1)
+# ---------------------------------------------------------------------------
+
+_DISK_EVENTS = {
+    "/jax/compilation_cache/cache_hits":
+        ("tpu_jit_persistent_cache_hits_total",
+         "persistent XLA compile-cache disk hits"),
+    "/jax/compilation_cache/cache_misses":
+        ("tpu_jit_persistent_cache_misses_total",
+         "persistent XLA compile-cache disk misses"),
+}
+
+_disk_listener_installed = False
+
+
+def install_persistent_cache_metrics() -> None:
+    """Count JAX's own persistent-compilation-cache disk hits/misses
+    into the registry (idempotent; wired at plugin init next to
+    jax_compilation_cache_dir).  This is the measurement that tells
+    ROADMAP item 1 whether the disk cache works."""
+    global _disk_listener_installed
+    if _disk_listener_installed:
+        return
+    try:
+        import jax.monitoring as mon
+    except Exception:
+        return
+
+    def _on_event(event, **kw):
+        fam = _DISK_EVENTS.get(event)
+        if fam is not None:
+            _registry().counter(fam[0], fam[1]).inc()
+
+    mon.register_event_listener(_on_event)
+    _disk_listener_installed = True
+
+
+def observatory() -> CompileObservatory:
+    return CompileObservatory.get()

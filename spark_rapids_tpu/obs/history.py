@@ -38,13 +38,19 @@ import re
 import time
 from typing import Dict, List, Optional, Tuple
 
-FINGERPRINT_VERSION = 1
+FINGERPRINT_VERSION = 2
 
-#: fields the CI gate may compare (exact equality across replays)
+#: fields the CI gate may compare (exact equality across replays).
+#: distinct_programs / miss_causes come from the compile observatory's
+#: enriched jit.build spans: in a fresh process the same query compiles
+#: the same programs for the same causes, so recompile-count growth and
+#: cause shifts are deterministic regressions, not noise.
 DETERMINISTIC_FIELDS = ("plan_shape", "operators", "fallback_ops",
-                        "fetch_crossings", "lint_rule_hits")
+                        "fetch_crossings", "lint_rule_hits",
+                        "distinct_programs", "miss_causes")
 #: advisory fields (never compared in CI)
-TIMING_FIELDS = ("wall_ms", "operator_time_ns", "peak_device_bytes")
+TIMING_FIELDS = ("wall_ms", "operator_time_ns", "peak_device_bytes",
+                 "compile_seconds")
 
 
 # ---------------------------------------------------------------------------
@@ -73,12 +79,21 @@ def query_fingerprint(sql, spans: List[dict]) -> Dict:
             fallback.append(n.node_name)
     crossings = 0
     lint_hits: List[str] = []
+    builds = 0
+    miss_causes: Dict[str, int] = {}
+    compile_s = 0.0
     for s in spans:
+        attrs = s.get("attrs") or {}
         if s.get("name") == "fetch.crossing":
-            crossings += int((s.get("attrs") or {}).get("transfers", 1))
+            crossings += int(attrs.get("transfers", 1))
         if s.get("name") == "phase:overrides":
-            lint_hits += list((s.get("attrs") or {}).get("lint_rules",
-                                                         ()))
+            lint_hits += list(attrs.get("lint_rules", ()))
+        if s.get("name") == "jit.build":
+            builds += 1
+            cause = attrs.get("cause")
+            if cause:
+                miss_causes[cause] = miss_causes.get(cause, 0) + 1
+            compile_s += float(attrs.get("total_s") or 0.0)
     return {
         "version": FINGERPRINT_VERSION,
         "sql_id": sql.sql_id,
@@ -90,10 +105,13 @@ def query_fingerprint(sql, spans: List[dict]) -> Dict:
         "fallback_ops": sorted(fallback),
         "fetch_crossings": crossings,
         "lint_rule_hits": sorted(set(lint_hits)),
+        "distinct_programs": builds,
+        "miss_causes": miss_causes,
         # timing half
         "wall_ms": sql.duration,
         "operator_time_ns": time_ns,
         "peak_device_bytes": sql.peak_device_bytes,
+        "compile_seconds": round(compile_s, 6),
     }
 
 
@@ -130,6 +148,15 @@ class HistoryDir:
         names = sorted(n for n in os.listdir(self.path)
                        if _RUN_RE.match(n))
         return [os.path.join(self.path, n) for n in names]
+
+    def compile_ledger_path(self) -> str:
+        """The cross-session compile ledger (JSONL, appended by the
+        compile observatory, aggregated by `tools compile-report`) —
+        it lives alongside the run fingerprints so one history dir
+        answers both 'did behavior drift' and 'what did compiles cost'.
+        """
+        from .compileprof import LEDGER_FILENAME
+        return os.path.join(self.path, LEDGER_FILENAME)
 
     def load(self, path: str) -> Dict:
         with open(path, encoding="utf-8") as f:
@@ -225,6 +252,37 @@ def diff_fingerprints(old: Dict, new: Dict,
         out.append(Drift(q, "lint_drift",
                          f"new lint rule hit(s): {sorted(new_lint)}",
                          True))
+    # compile-observatory fields (fingerprint v2): only compared when
+    # BOTH runs carry them, so a history spanning the upgrade never
+    # false-trips
+    if "distinct_programs" in old and "distinct_programs" in new:
+        op, np_ = old["distinct_programs"], new["distinct_programs"]
+        if np_ > op:
+            out.append(Drift(
+                q, "recompile_drift",
+                f"distinct compiled programs grew {op} -> {np_}", True))
+        oc_, nc_ = old.get("miss_causes") or {}, \
+            new.get("miss_causes") or {}
+        if np_ <= op:
+            # same-or-fewer total builds but some CAUSE count grew:
+            # the miss mix shifted (e.g. canonicalization stopped
+            # collapsing a shape and new_program became shape_churn)
+            grown = sorted(c for c in nc_
+                           if nc_[c] > oc_.get(c, 0))
+            if grown:
+                out.append(Drift(
+                    q, "cause_shift",
+                    f"miss-cause histogram shifted: {grown} grew "
+                    f"({oc_} -> {nc_})", True))
+    if wall_threshold_pct is not None and \
+            "compile_seconds" in old and "compile_seconds" in new:
+        ow, nw = old["compile_seconds"] or 0.0, \
+            new["compile_seconds"] or 0.0
+        if ow > 0.05 and nw > ow * (1.0 + wall_threshold_pct / 100.0):
+            out.append(Drift(
+                q, "compile_regression",
+                f"compile seconds {ow:.2f}s -> {nw:.2f}s "
+                f"(> {wall_threshold_pct:g}% threshold)", False))
     if wall_threshold_pct is not None:
         ow, nw = old.get("wall_ms") or 0, new.get("wall_ms") or 0
         if ow > 0 and nw > ow * (1.0 + wall_threshold_pct / 100.0):

@@ -245,8 +245,12 @@ class TpuExecutorPlugin:
             # this — the hermetic suite relies on the in-process jit
             # table, and must never crash on a cache race)
             return
+        # the explicit per-deployment key wins; the legacy key is the
+        # default location (ROADMAP item 1: the cheapest first bite of
+        # cross-session compile reuse is jax's own disk cache)
         cache_dir = os.path.expanduser(
-            self.conf.get(cfg.COMPILATION_CACHE_DIR))
+            self.conf.get(cfg.JIT_PERSISTENT_CACHE_DIR)
+            or self.conf.get(cfg.COMPILATION_CACHE_DIR))
         try:
             import hashlib
             import jax
@@ -265,6 +269,10 @@ class TpuExecutorPlugin:
             jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
             jax.config.update("jax_persistent_cache_min_compile_time_secs",
                               0.0)
+            # count disk hits/misses so the observatory can tell whether
+            # the persistent cache actually absorbs backend compiles
+            from .obs.compileprof import install_persistent_cache_metrics
+            install_persistent_cache_metrics()
         except Exception as ex:  # cache is an optimization, never fatal
             log.warning("compilation cache unavailable: %s", ex)
 

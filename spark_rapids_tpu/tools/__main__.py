@@ -7,6 +7,14 @@
     python -m spark_rapids_tpu.tools lint --plan   <fixture.py...> [--infer] [--memsan]
     python -m spark_rapids_tpu.tools regress --history DIR --record <eventlog...> [--label L]
     python -m spark_rapids_tpu.tools regress --history DIR --check [--wall-threshold PCT]
+    python -m spark_rapids_tpu.tools compile-report --ledger PATH [--top N] [--json]
+
+`compile-report` aggregates the compile observatory's cross-session
+ledger (obs/compileprof.py; `--ledger` takes the JSONL file or the
+history dir holding compile_ledger.jsonl) into top-programs-by-compile-
+cost, miss causes, churn offenders and the bucket-canonicalization
+dedupe projection — the evidence for the persistent-program-cache key
+design (ROADMAP item 1).
 
 `regress` is the cross-run watchdog (obs/history.py): --record distills
 self-emitted event logs into per-query fingerprints appended to the
@@ -236,6 +244,17 @@ def main(argv=None):
                          "never fails the check)")
     rg.add_argument("--label", default="",
                     help="free-form label stored on the recorded run")
+    cr = sub.add_parser("compile-report",
+                        help="aggregate the compile observatory "
+                             "ledger into the compile-cost report")
+    cr.add_argument("--ledger", required=True,
+                    help="compile_ledger.jsonl or the history dir "
+                         "containing it "
+                         "(spark.rapids.tpu.compile.ledgerDir)")
+    cr.add_argument("--top", type=int, default=10,
+                    help="rows per ranking section")
+    cr.add_argument("--json", action="store_true",
+                    help="emit the aggregate as JSON instead of text")
     args = p.parse_args(argv)
 
     if args.cmd == "qualification":
@@ -260,6 +279,10 @@ def main(argv=None):
             p.error("regress needs --record and/or --check")
         return _run_regress(args.history, args.record, args.check,
                             args.wall_threshold, label=args.label)
+    elif args.cmd == "compile-report":
+        from .compile_report import run_compile_report
+        return run_compile_report(args.ledger, top=args.top,
+                                  as_json=args.json)
     else:
         if args.plan:
             return _run_plan_lint(args.plan, infer=args.infer,

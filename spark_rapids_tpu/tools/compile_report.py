@@ -1,0 +1,213 @@
+"""`tools compile-report`: aggregate the compile observatory's
+cross-session ledger (obs/compileprof.py) into the evidence ROADMAP
+item 1 needs to design the persistent program cache:
+
+* **Totals + attribution coverage** — how much wall compile time the
+  ledger explains, split trace/lower vs backend-compile, and whether
+  every build carries a classified cause (the acceptance bar is >= 95%
+  attribution with zero cause-less builds).
+* **Top programs by compile cost** — where the seconds actually went,
+  by (exec kind, key, shapes).
+* **Churn offenders** — exec kinds ranked by compile seconds burned on
+  shape_churn / dtype_churn / eviction_refault misses: recompiles a
+  better cache key or bucket canonicalization would erase.
+* **Dedupe projection** — group programs by their bucket-canonical
+  identity (exec, canonical key hash, dtype signature): "N programs
+  collapse to M; projected warm-session savings = X s" — the direct
+  measurement of what keying the cache on (exec kind, dtype layout,
+  capacity bucket) buys.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+
+def load_ledger(path: str) -> List[Dict]:
+    """Parse one compile ledger (JSONL).  `path` may be the file or a
+    directory containing ``compile_ledger.jsonl``.  Unparsable lines
+    are skipped and counted (the ledger is append-under-crash telemetry,
+    a torn final line must not kill the report)."""
+    from ..obs.compileprof import LEDGER_FILENAME
+    if os.path.isdir(path):
+        path = os.path.join(path, LEDGER_FILENAME)
+    records: List[Dict] = []
+    rejected = 0
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                rejected += 1
+    if rejected:
+        records.append({"event": "_rejected", "count": rejected})
+    return records
+
+
+def aggregate_ledger(records: List[Dict]) -> Dict:
+    """One pass over ledger records -> the report's data model."""
+    builds = [r for r in records if r.get("event") == "build"]
+    evicts = [r for r in records if r.get("event") == "evict"]
+    rejected = sum(r.get("count", 0) for r in records
+                   if r.get("event") == "_rejected")
+
+    total_s = sum(r.get("total_s") or 0.0 for r in builds)
+    trace_s = sum(r.get("trace_s") or 0.0 for r in builds)
+    compile_s = sum(r.get("compile_s") or 0.0 for r in builds)
+    # attribution: a build is fully attributed when it carries an exec
+    # kind, a cause and a split (trace_s/compile_s); AOT-fallback builds
+    # carry total_s only
+    attributed_s = sum(r.get("total_s") or 0.0 for r in builds
+                       if r.get("exec") and r.get("cause"))
+    causeless = [r for r in builds if not r.get("cause")]
+    by_cause: Dict[str, Dict] = {}
+    for r in builds:
+        c = r.get("cause") or "?"
+        agg = by_cause.setdefault(c, {"count": 0, "total_s": 0.0})
+        agg["count"] += 1
+        agg["total_s"] += r.get("total_s") or 0.0
+
+    # distinct programs: last build wins (rebuilds refresh timing)
+    programs: Dict[tuple, Dict] = {}
+    prog_counts: Dict[tuple, int] = {}
+    prog_seconds: Dict[tuple, float] = {}
+    for r in builds:
+        pid = (r.get("key", ""), r.get("shape", ""))
+        programs[pid] = r
+        prog_counts[pid] = prog_counts.get(pid, 0) + 1
+        prog_seconds[pid] = prog_seconds.get(pid, 0.0) + \
+            (r.get("total_s") or 0.0)
+
+    top = sorted(programs.items(),
+                 key=lambda kv: -prog_seconds[kv[0]])
+
+    # churn: compile seconds burned on misses a better cache key erases
+    churn: Dict[str, Dict] = {}
+    for r in builds:
+        if r.get("cause") in ("shape_churn", "dtype_churn",
+                              "eviction_refault"):
+            agg = churn.setdefault(
+                r.get("exec", "?"),
+                {"count": 0, "total_s": 0.0, "causes": {}})
+            agg["count"] += 1
+            agg["total_s"] += r.get("total_s") or 0.0
+            c = r["cause"]
+            agg["causes"][c] = agg["causes"].get(c, 0) + 1
+
+    # dedupe projection: canonical identity = (exec, canon_key, dtypes)
+    families: Dict[tuple, List[tuple]] = {}
+    for pid, r in programs.items():
+        fam = (r.get("exec", ""), r.get("canon_key", ""),
+               tuple(r.get("dtypes") or ()))
+        families.setdefault(fam, []).append(pid)
+    saved_s = 0.0
+    for members in families.values():
+        if len(members) > 1:
+            secs = sorted((prog_seconds[p] for p in members),
+                          reverse=True)
+            saved_s += sum(secs[1:])
+
+    return {
+        "builds": len(builds),
+        "evictions": len(evicts),
+        "rejected_lines": rejected,
+        "total_s": total_s,
+        "trace_s": trace_s,
+        "compile_s": compile_s,
+        "attributed_s": attributed_s,
+        "attribution_pct": (100.0 * attributed_s / total_s)
+        if total_s else 100.0,
+        "causeless_builds": len(causeless),
+        "by_cause": by_cause,
+        "distinct_programs": len(programs),
+        "top_programs": [
+            {"exec": r.get("exec"), "key": pid[0], "shape": pid[1],
+             "cause": r.get("cause"),
+             "builds": prog_counts[pid],
+             "total_s": prog_seconds[pid],
+             "hlo_bytes": r.get("hlo_bytes", 0),
+             "caps": r.get("caps"), "dtypes": r.get("dtypes")}
+            for pid, r in top],
+        "churn_offenders": sorted(
+            ({"exec": k, **v} for k, v in churn.items()),
+            key=lambda d: -d["total_s"]),
+        "canonical_families": len(families),
+        "projected_savings_s": saved_s,
+    }
+
+
+def format_report(agg: Dict, top: int = 10) -> str:
+    out: List[str] = []
+    w = out.append
+    w("== compile observatory report ==")
+    w(f"builds: {agg['builds']}  distinct programs: "
+      f"{agg['distinct_programs']}  evictions: {agg['evictions']}")
+    w(f"wall compile time: {agg['total_s']:.2f}s "
+      f"(trace/lower {agg['trace_s']:.2f}s + backend compile "
+      f"{agg['compile_s']:.2f}s)")
+    w(f"attribution: {agg['attribution_pct']:.1f}% of wall compile "
+      f"time carries (exec, cause); {agg['causeless_builds']} "
+      f"cause-less build(s)")
+    if agg.get("rejected_lines"):
+        w(f"note: {agg['rejected_lines']} unparsable ledger line(s) "
+          f"skipped")
+    w("")
+    w("-- misses by cause --")
+    for c, v in sorted(agg["by_cause"].items(),
+                       key=lambda kv: -kv[1]["total_s"]):
+        w(f"  {c:18s} {v['count']:5d} build(s)  "
+          f"{v['total_s']:8.2f}s")
+    w("")
+    w(f"-- top {top} programs by compile cost --")
+    for p in agg["top_programs"][:top]:
+        caps = ",".join("x".join(map(str, s))
+                        for s in (p.get("caps") or [])[:4]) or "-"
+        w(f"  {p['total_s']:8.2f}s  {p['exec']:24s} "
+          f"cause={p['cause']:16s} builds={p['builds']} "
+          f"key={p['key']} caps=[{caps}]")
+    w("")
+    w("-- churn offenders (recompiles a better cache key erases) --")
+    if not agg["churn_offenders"]:
+        w("  none: every build was a genuinely new program")
+    for c in agg["churn_offenders"][:top]:
+        causes = " ".join(f"{k}={v}" for k, v in
+                          sorted(c["causes"].items()))
+        w(f"  {c['total_s']:8.2f}s  {c['exec']:24s} "
+          f"{c['count']} build(s)  {causes}")
+    w("")
+    n, m = agg["distinct_programs"], agg["canonical_families"]
+    w("-- dedupe projection (bucket canonicalization) --")
+    w(f"  {n} program(s) collapse to {m} under bucket "
+      f"canonicalization; projected warm-session savings = "
+      f"{agg['projected_savings_s']:.2f}s")
+    return "\n".join(out) + "\n"
+
+
+def run_compile_report(ledger: str, top: int = 10,
+                       as_json: bool = False,
+                       out=None) -> int:
+    import sys
+    out = out or sys.stdout
+    try:
+        records = load_ledger(ledger)
+    except OSError as ex:
+        sys.stderr.write(f"compile-report: {ex}\n")
+        return 2
+    agg = aggregate_ledger(records)
+    if not agg["builds"]:
+        sys.stderr.write(
+            "compile-report: ledger has no build records (was "
+            "spark.rapids.tpu.compile.ledgerDir or "
+            "spark.rapids.tpu.regress.historyDir set?)\n")
+        return 2
+    if as_json:
+        out.write(json.dumps(agg, indent=1, sort_keys=True,
+                             default=str) + "\n")
+    else:
+        out.write(format_report(agg, top=top))
+    return 0

@@ -111,6 +111,23 @@ def test_run_lint_metrics_gate_exits_zero():
     assert "metrics gate clean" in proc.stdout, proc.stdout
 
 
+def test_run_lint_jit_gate_exits_zero():
+    """Tier-1 gate for the compile observatory: the golden corpus
+    replays twice in one process with ZERO second-pass program builds
+    (shape-canonicalization honesty), the compile ledger / jit.build
+    spans / tpu_jit_misses_total agree on the build count, >= 95% of
+    wall compile time is attributed with every build carrying a cause,
+    and injected bucket/dtype perturbations classify as
+    shape_churn/dtype_churn."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "devtools", "run_lint.py"),
+         "--jit"],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "jit gate clean" in proc.stdout, proc.stdout
+
+
 def test_baseline_is_empty_and_stays_empty():
     """PR-3 burned the last baselined TPU-R001 debt down to zero: the
     ratchet now enforces a spotless repo (deliberate exceptions are

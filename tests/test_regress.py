@@ -33,9 +33,12 @@ def _fp(sql_id=0, **over):
         "fallback_ops": ["DeviceToHostExec"],
         "fetch_crossings": 3,
         "lint_rule_hits": [],
+        "distinct_programs": 3,
+        "miss_causes": {"new_program": 2, "shape_churn": 1},
         "wall_ms": 120,
         "operator_time_ns": 5_000_000,
         "peak_device_bytes": 1 << 20,
+        "compile_seconds": 4.2,
     }
     fp.update(over)
     return fp
@@ -84,6 +87,49 @@ def test_injected_crossing_bump_is_flagged():
     assert drifts[0].deterministic
     # fewer crossings (improvement) is not drift
     assert diff_runs(_run(new), _run(_fp())) == []
+
+
+def test_injected_extra_recompile_is_flagged():
+    """Anti-vacuity for the compile-observatory fields: one extra
+    program build between replays is a deterministic regression (the
+    exact failure mode shape canonicalization exists to prevent)."""
+    new = _fp(distinct_programs=4,
+              miss_causes={"new_program": 3, "shape_churn": 1})
+    drifts = diff_runs(_run(_fp()), _run(new))
+    assert any(d.kind == "recompile_drift" and d.deterministic
+               for d in drifts)
+    # FEWER programs (improvement) is not drift
+    assert diff_runs(_run(new), _run(_fp())) == []
+
+
+def test_injected_cause_shift_is_flagged():
+    """Same build count, different cause mix: canonicalization quietly
+    stopped collapsing a shape."""
+    new = _fp(miss_causes={"new_program": 1, "shape_churn": 2})
+    drifts = diff_runs(_run(_fp()), _run(new))
+    assert [d.kind for d in drifts] == ["cause_shift"]
+    assert drifts[0].deterministic
+    assert "shape_churn" in drifts[0].detail
+
+
+def test_compile_seconds_is_timing_class_only():
+    new = _fp(compile_seconds=42.0)
+    # no threshold: silence — compile time is in the timing class
+    assert diff_runs(_run(_fp()), _run(new)) == []
+    drifts = diff_runs(_run(_fp()), _run(new), wall_threshold_pct=50)
+    assert any(d.kind == "compile_regression" and not d.deterministic
+               for d in drifts)
+    assert deterministic_drift(drifts) == []
+
+
+def test_pre_observatory_fingerprints_never_false_trip():
+    """A history spanning the v1->v2 upgrade must not flag the absence
+    of compile fields as drift."""
+    old = _fp()
+    for f in ("distinct_programs", "miss_causes", "compile_seconds"):
+        del old[f]
+    assert diff_runs(_run(old), _run(_fp()),
+                     wall_threshold_pct=10) == []
 
 
 def test_operator_row_drift_and_plan_change():
