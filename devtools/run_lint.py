@@ -614,6 +614,50 @@ def run_jit_gate() -> int:
                   f"jit.build spans {span_builds}, "
                   f"tpu_jit_misses_total {metric_builds}")
 
+        # the dedupe projection is a CONTRACT, not a report: with
+        # bucket-canonical tracing landed, the corpus must realize no
+        # more distinct programs than the observatory projects under
+        # canonicalization — i.e. zero projected savings left on the
+        # table.  (Checked before the churn-injection probes below,
+        # which deliberately add shape/dtype churn.)
+        from spark_rapids_tpu.tools.compile_report import (
+            aggregate_ledger, load_ledger)
+        agg_c = aggregate_ledger(load_ledger(ledger_path))
+        if agg_c["distinct_programs"] > agg_c["canonical_families"]:
+            failures += 1
+            print(f"JIT: PROJECTION BROKEN — corpus realized "
+                  f"{agg_c['distinct_programs']} distinct program(s) "
+                  f"vs {agg_c['canonical_families']} canonical "
+                  f"familie(s): {agg_c['projected_savings_s']:.2f}s of "
+                  f"bucket-churn compile left on the table")
+
+        # recompile-drift watchdog: the gate's own event log distilled
+        # against the pre-change recording — distinct compiled programs
+        # per corpus query must not GROW past the baseline (fewer is
+        # progress; query_added drifts from the second pass are
+        # expected and ignored)
+        from spark_rapids_tpu.obs.history import (diff_runs,
+                                                  distill_event_log)
+        baseline_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "jit_corpus_baseline.json")
+        if logs and os.path.exists(baseline_path):
+            with open(baseline_path) as f:
+                baseline = json.load(f)
+            current = {"queries":
+                       distill_event_log(os.path.join(evt, logs[0]))}
+            recompiles = [d for d in diff_runs(baseline, current)
+                          if d.kind == "recompile_drift"]
+            for d in recompiles:
+                failures += 1
+                print(f"JIT: RECOMPILE DRIFT vs pre-change baseline — "
+                      f"{d.render()}")
+        else:
+            failures += 1
+            print(f"JIT: recompile-drift check could not run "
+                  f"(event log present: {bool(logs)}, baseline "
+                  f"present: {os.path.exists(baseline_path)})")
+
         # anti-vacuity: a capacity-bucket perturbation (same program
         # modulo buckets) must be classified, not silently re-counted
         # as novel work
@@ -638,8 +682,6 @@ def run_jit_gate() -> int:
 
         # the acceptance bar: the report must attribute the wall
         # compile time it measured, with every miss carrying a cause
-        from spark_rapids_tpu.tools.compile_report import (
-            aggregate_ledger, load_ledger)
         agg = aggregate_ledger(load_ledger(ledger_path))
         if agg["attribution_pct"] < 95.0:
             failures += 1
@@ -660,8 +702,9 @@ def run_jit_gate() -> int:
         return 1
     print(f"jit gate clean ({n_builds} corpus program(s) built once, "
           f"{total_s:.2f}s wall compile fully attributed; second pass "
-          f"zero-miss; ledger/span/metric counts agree; bucket and "
-          f"dtype perturbations classified)")
+          f"zero-miss; ledger/span/metric counts agree; dedupe "
+          f"projection realized; no recompile drift vs the pre-change "
+          f"baseline; bucket and dtype perturbations classified)")
     return 0
 
 

@@ -103,6 +103,18 @@ class TpuSession:
         # after plugin init: the cold-cache probe reads the persistent
         # compile cache dir the plugin just configured
         self._init_sort_mode(conf)
+        # warm-start tier: replay the costliest ledger recipes so first
+        # queries dispatch to ready programs.  Ordered after plugin and
+        # sort-mode init — the replay compiles through the persistent
+        # disk cache and must not flip the cold-cache probe's verdict.
+        self._prewarm_thread = None
+        if ledger_path and conf.get(cfg.JIT_PREWARM_ENABLED) and \
+                conf.get(cfg.COMPILE_OBSERVATORY_ENABLED):
+            from ..obs.prewarm import prewarm_session
+            self._prewarm_thread = prewarm_session(
+                ledger_path,
+                top_k=conf.get(cfg.JIT_PREWARM_TOP_K),
+                background=conf.get(cfg.JIT_PREWARM_BACKGROUND))
 
     _auto_sort_mode_decided = False
 

@@ -509,6 +509,32 @@ COMPILE_LEDGER_DIR = conf("spark.rapids.tpu.compile.ledgerDir") \
          "both and builds are still traced/metered but not persisted.") \
     .create_optional()
 
+JIT_PREWARM_ENABLED = conf("spark.rapids.tpu.jit.prewarm.enabled") \
+    .boolean() \
+    .doc("Replay the costliest program recipes from the compile ledger "
+         "at session init (the warm-start tier of the program cache): "
+         "each recipe recompiles through the persistent disk cache and "
+         "stages a dispatch-ready program, so repeated sessions run "
+         "their first queries with zero query-time builds.  Requires a "
+         "compile ledger dir; recipes live under its programs/ "
+         "subdirectory.  tpu_jit_prewarm_{hits,seconds}_total measure "
+         "the payoff.") \
+    .create_with_default(True)
+
+JIT_PREWARM_TOP_K = conf("spark.rapids.tpu.jit.prewarm.topK").integer() \
+    .doc("How many ledger programs (ranked by cumulative compile "
+         "seconds) to replay at session init.") \
+    .check(lambda v: v >= 0, "must be >= 0") \
+    .create_with_default(32)
+
+JIT_PREWARM_BACKGROUND = conf(
+    "spark.rapids.tpu.jit.prewarm.background").boolean() \
+    .doc("Run the session-init prewarm on a daemon thread instead of "
+         "blocking startup.  Queries racing the thread simply "
+         "cold-build; the default is synchronous so a freshly opened "
+         "session is deterministically warm.") \
+    .create_with_default(False)
+
 PROFILE_TRACE_ANNOTATIONS = conf(
     "spark.rapids.sql.profile.traceAnnotations").boolean() \
     .doc("Wrap timed operator work in jax.profiler TraceAnnotation ranges "

@@ -114,7 +114,12 @@ def process_jit(key: tuple, make_fn):
     f = _JIT_CACHE.get(key)
     if f is None:
         obs = _observatory()
-        f = obs.build(key, make_fn)
+        # warm-start tier first: a recipe replayed at session init (or
+        # by `tools prewarm`) may have a dispatch-ready proxy staged
+        # for this exact key — claim it instead of building
+        f = obs.take_prewarmed(key)
+        if f is None:
+            f = obs.build(key, make_fn)
         while len(_JIT_CACHE) >= _JIT_CACHE_MAX:
             ekey = next(iter(_JIT_CACHE))
             # never evict silently: count it, ledger it, and remember
@@ -188,6 +193,12 @@ def semantic_sig(v) -> object:
         if sig is not None:
             return sig
         return ("callable", getattr(v, "__qualname__", ""), id(v))
+    hook = getattr(v, "_semantic_sig_", None)
+    if hook is not None:
+        # nodes that key on less than their full field set (e.g.
+        # ParamLiteral excludes its VALUE — the hoisted constant rides
+        # in as a traced argument, so it must not fork the key space)
+        return hook()
     try:
         fields = vars(v)
     except TypeError:

@@ -150,9 +150,14 @@ class ProjectExec(Exec):
     def __init__(self, exprs: Sequence[Expression], child: Exec):
         super().__init__([child])
         self.exprs = list(exprs)
-        self._bound = [bind_expression(e, child.output_names,
-                                       child.output_types)
-                       for e in self.exprs]
+        bound = [bind_expression(e, child.output_names,
+                                 child.output_types)
+                 for e in self.exprs]
+        # hoist eligible constants to ParamLiteral slots: the jit key
+        # drops the values, two projections differing only in literals
+        # share one program (expr/params.py has the safety rules)
+        from ..expr.params import parameterize_exprs
+        self._bound, self._params = parameterize_exprs(bound)
 
     @property
     def output_names(self):
@@ -165,8 +170,10 @@ class ProjectExec(Exec):
     def describe(self):
         return f"Project [{', '.join(e.sql() for e in self.exprs)}]"
 
-    def _compute(self, xp, batch: Batch, row_base=0) -> Batch:
-        ctx = EvalContext(xp, batch, row_base=row_base)
+    def _compute(self, xp, batch: Batch, row_base=0, params=None) -> Batch:
+        ctx = EvalContext(xp, batch, row_base=row_base,
+                          params=params if params is not None
+                          else (self._params or None))
         cols = []
         for b in self._bound:
             v = b.eval(ctx)
@@ -187,11 +194,24 @@ class ProjectExec(Exec):
 
     @property
     def _jitted(self):
+        if self._params:
+            # params ride as traced scalar args: the value-free key is
+            # only valid because the closure receives them at call time
+            fn = process_jit(
+                self._jit_key,
+                lambda: lambda b, ps: self._compute(jnp, b, params=ps))
+            return lambda b: fn(b, self._params)
         return process_jit(self._jit_key,
                            lambda: lambda b: self._compute(jnp, b))
 
     @property
     def _jitted_rowpos(self):
+        if self._params:
+            fn = process_jit(
+                self._jit_key + ("rowpos",),
+                lambda: lambda b, base, ps: self._compute(jnp, b, base,
+                                                          params=ps))
+            return lambda b, base: fn(b, base, self._params)
         return process_jit(self._jit_key + ("rowpos",),
                            lambda: lambda b, base: self._compute(jnp, b,
                                                                  base))
@@ -243,8 +263,11 @@ class FilterExec(Exec):
     def __init__(self, condition: Expression, child: Exec):
         super().__init__([child])
         self.condition = condition
-        self._bound = bind_expression(condition, child.output_names,
-                                      child.output_types)
+        bound = bind_expression(condition, child.output_names,
+                                child.output_types)
+        from ..expr.params import parameterize_exprs
+        trees, self._params = parameterize_exprs([bound])
+        self._bound = trees[0]
 
     @property
     def output_names(self):
@@ -257,8 +280,10 @@ class FilterExec(Exec):
     def describe(self):
         return f"Filter [{self.condition.sql()}]"
 
-    def _compute(self, xp, batch: Batch, row_base=0) -> Batch:
-        ctx = EvalContext(xp, batch, row_base=row_base)
+    def _compute(self, xp, batch: Batch, row_base=0, params=None) -> Batch:
+        ctx = EvalContext(xp, batch, row_base=row_base,
+                          params=params if params is not None
+                          else (self._params or None))
         pred = self._bound.eval(ctx)
         from .filter_common import apply_filter
         return apply_filter(xp, batch, pred, self.output_names)
@@ -270,11 +295,22 @@ class FilterExec(Exec):
 
     @property
     def _jitted(self):
+        if self._params:
+            fn = process_jit(
+                self._jit_key,
+                lambda: lambda b, ps: self._compute(jnp, b, params=ps))
+            return lambda b: fn(b, self._params)
         return process_jit(self._jit_key,
                            lambda: lambda b: self._compute(jnp, b))
 
     @property
     def _jitted_rowpos(self):
+        if self._params:
+            fn = process_jit(
+                self._jit_key + ("rowpos",),
+                lambda: lambda b, base, ps: self._compute(jnp, b, base,
+                                                          params=ps))
+            return lambda b, base: fn(b, base, self._params)
         return process_jit(self._jit_key + ("rowpos",),
                            lambda: lambda b, base: self._compute(jnp, b,
                                                                  base))

@@ -74,13 +74,18 @@ class EvalContext:
     """
 
     __slots__ = ("xp", "batch", "ansi", "capacity", "lambda_bindings",
-                 "row_base")
+                 "row_base", "params")
 
-    def __init__(self, xp, batch, ansi: bool = False, row_base=0):
+    def __init__(self, xp, batch, ansi: bool = False, row_base=0,
+                 params=None):
         self.xp = xp
         self.batch = batch  # DeviceBatch (buffers in xp-land)
         self.ansi = ansi
         self.capacity = batch.capacity if batch is not None else 0
+        # hoisted-literal values for ParamLiteral slots (expr/params.py):
+        # traced scalars on the TPU path so constant changes never
+        # retrace; None -> evaluators fall back to the baked values
+        self.params = params
         # name -> ColumnValue for in-scope lambda variables (higher-order
         # function bodies evaluate in array-element space)
         self.lambda_bindings = {}

@@ -1,0 +1,144 @@
+"""Literal parameterization: hoist constant scalars out of traced
+closures so structurally identical queries share one compiled program.
+
+Ref: the reference plugin amortizes kernel setup across queries through
+its process-wide execution layer; here the analogous win is collapsing
+the jit key space.  A bound expression tree like ``v > 5`` bakes the
+``5`` into the traced computation, so ``v > 9999`` — the same program
+shape — compiles a second XLA program.  `parameterize_exprs` rewrites
+eligible ``Literal`` nodes into `ParamLiteral` slots whose values ride
+into the kernel as *traced scalar arguments*; the jit key then carries
+only (slot, dtype) and the two queries dispatch to one executable.
+
+Safety rules (wrong sharing is silently wrong results, so the pass is
+deliberately conservative):
+
+* only literals under whitelisted parents (plain comparisons and
+  +/-/* arithmetic) are hoisted — those evaluators are pure array math
+  with no host-side branching on the scalar's VALUE.  Divide/Pmod and
+  friends stay value-keyed (zero-divisor handling), as do string /
+  decimal / boolean literals (host-side key derivation, scale logic and
+  ``bool()`` coercion all concretize the value).
+* non-null values only: null literals flow through evaluator validity
+  short-circuits that branch on ``is_null``.
+* a parameterized tree may key a jit entry ONLY where the parameter
+  values are actually threaded as call arguments — `ParamLiteral`'s
+  evaluator falls back to the baked value when no params are bound, so
+  host-path (numpy) evaluation needs no threading, but a traced closure
+  built from a parameterized tree without passing params would bake the
+  first query's constants under a shared key.  The exec-side helpers in
+  exec/basic.py are the reference wiring.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from .. import types as t
+from .core import (EvalContext, Expression, LeafExpression, Literal,
+                   ScalarValue, evaluator)
+
+# parents whose evaluators treat both operands as opaque array operands
+# (promote + cast + xp op): safe to feed a traced scalar
+from .arithmetic import Add, Multiply, Subtract
+from .predicates import (EqualNullSafe, EqualTo, GreaterThan,
+                         GreaterThanOrEqual, LessThan, LessThanOrEqual)
+
+PARAM_PARENTS = (EqualTo, EqualNullSafe, LessThan, LessThanOrEqual,
+                 GreaterThan, GreaterThanOrEqual,
+                 Add, Subtract, Multiply)
+
+# value domains whose evaluators never concretize the scalar: fixed-
+# width numerics and the day/microsecond integer encodings
+_PARAM_DTYPES = (t.ByteType, t.ShortType, t.IntegerType, t.LongType,
+                 t.FloatType, t.DoubleType, t.DateType, t.TimestampType)
+
+
+class ParamLiteral(LeafExpression):
+    """A literal hoisted to runtime-parameter slot `slot`.
+
+    Keeps the original value so unparameterized evaluation (numpy host
+    path, plan printing) behaves exactly like the `Literal` it
+    replaced; the semantic signature deliberately EXCLUDES the value —
+    that is the whole point."""
+
+    def __init__(self, slot: int, dtype: t.DataType, value):
+        self.slot = slot
+        self.dtype = dtype
+        self.value = value
+
+    def data_type(self):
+        return self.dtype
+
+    @property
+    def nullable(self):
+        return False
+
+    def _semantic_sig_(self):
+        return ("ParamLiteral", self.slot, repr(self.dtype))
+
+    def sql(self):
+        return f"$param{self.slot}"
+
+
+@evaluator(ParamLiteral)
+def _eval_param_literal(e: ParamLiteral, ctx: EvalContext):
+    params = getattr(ctx, "params", None)
+    if params is not None:
+        return ScalarValue(params[e.slot], e.dtype)
+    return ScalarValue(e.value, e.dtype)
+
+
+def _eligible(lit: Expression) -> bool:
+    return (type(lit) is Literal and lit.value is not None
+            and isinstance(lit.dtype, _PARAM_DTYPES))
+
+
+def _np_param(lit: Literal):
+    """The slot's call-time value: an np scalar typed from the literal's
+    DataType so the jit dispatch signature is value-independent."""
+    return np.dtype(t.to_np_dtype(lit.dtype)).type(lit.value)
+
+
+def _rewrite(e: Expression, values: List) -> Expression:
+    new_children = []
+    changed = False
+    hoist = isinstance(e, PARAM_PARENTS)
+    for c in e.children:
+        if hoist and _eligible(c):
+            values.append(_np_param(c))
+            nc = ParamLiteral(len(values) - 1, c.dtype, c.value)
+        else:
+            nc = _rewrite(c, values)
+        changed |= nc is not c
+        new_children.append(nc)
+    return e.with_children(new_children) if changed else e
+
+
+def parameterize_exprs(bound: Sequence[Expression]
+                       ) -> Tuple[List[Expression], Tuple]:
+    """Rewrite eligible literals in already-BOUND expression trees.
+
+    Returns (trees, params): `trees` with `ParamLiteral` slots in slot
+    order across the whole sequence, and `params` the matching tuple of
+    np-typed scalar values to pass at call time.  `params` is empty
+    when nothing was eligible — callers then keep the original
+    value-baked jit wiring (and its value-carrying key)."""
+    values: List = []
+    out = [_rewrite(b, values) for b in bound]
+    if not values:
+        return list(bound), ()
+    return out, tuple(values)
+
+
+def param_values(trees: Sequence[Expression]) -> Tuple:
+    """Re-derive the call-time parameter tuple from rewritten trees
+    (slot order is the collection order of `parameterize_exprs`)."""
+    lits: List[ParamLiteral] = []
+    for b in trees:
+        lits += b.collect(lambda e: isinstance(e, ParamLiteral))
+    lits.sort(key=lambda p: p.slot)
+    return tuple(np.dtype(t.to_np_dtype(p.dtype)).type(p.value)
+                 for p in lits)

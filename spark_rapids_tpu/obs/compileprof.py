@@ -172,6 +172,42 @@ def _dispatch_key(args) -> Optional[tuple]:
     return (treedef, tuple(sigs))
 
 
+def _erase_sharding(sig: tuple) -> tuple:
+    """A dispatch key with leaf shardings dropped.  Prewarmed programs
+    are compiled from ShapeDtypeStruct skeletons (no sharding), while
+    concrete query calls carry committed-device shardings — the
+    warm-start lookup matches on shapes/dtypes and lets the executable
+    itself reject a true sharding mismatch (caught, falls back to a
+    cold build)."""
+    treedef, leaf_sigs = sig
+    return (treedef, tuple((d, s, None) for d, s, _ in leaf_sigs))
+
+
+def _aval_dispatch_key(args) -> Optional[tuple]:
+    """Like _dispatch_key, but tracer leaves sign by their abstract
+    value (shape/dtype, no sharding — an enclosing trace has none to
+    offer).  Lets the plain-jit fallback path dedupe and ledger its
+    builds under the SAME canonical key instead of silently forking
+    the key space."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    sigs = []
+    for leaf in leaves:
+        if isinstance(leaf, jax.core.Tracer):
+            av = getattr(leaf, "aval", None)
+            shape = getattr(av, "shape", None)
+            dt = getattr(av, "dtype", None)
+            if shape is None or dt is None:
+                return None
+            sigs.append((str(dt), tuple(int(d) for d in shape), None))
+            continue
+        s = _leaf_sig(leaf)
+        if s is None:
+            return None
+        sigs.append(s)
+    return (treedef, tuple(sigs))
+
+
 def _shape_record(sig: tuple, buckets) -> Tuple[str, tuple, tuple, tuple]:
     """(shape_hash, dtype_sig, cap_sig, canon_caps) from a dispatch
     key.  cap_sig is the tuple of leaf shapes (the capacity buckets ride
@@ -224,6 +260,12 @@ class CompileObservatory:
         self.trace_seconds_total = 0.0
         self.by_cause: Dict[str, int] = {}
         self._warn_next = 1
+        # warm-start tier: proxies readied from ledger recipes, waiting
+        # for their process_jit miss to claim them (key -> _ProfiledJit)
+        self._prewarm_staged: Dict[tuple, Any] = {}
+        self.prewarm_hits = 0
+        self.prewarm_seconds = 0.0
+        self.prewarm_stats: Optional[Dict] = None
 
     # -- lifecycle -----------------------------------------------------------
     @classmethod
@@ -296,20 +338,21 @@ class CompileObservatory:
         every per-shape program build; disabled -> plain jax.jit plus
         the legacy untimed jit.build event."""
         import jax
-        jitted = jax.jit(make_fn())
+        fn = make_fn()
+        jitted = jax.jit(fn)
         if not self.enabled:
             from .tracer import trace_event
             trace_event("jit.build", sig=str(_exec_kind(key))[:80])
             return jitted
-        return _ProfiledJit(self, key, jitted)
+        return _ProfiledJit(self, key, jitted, fn)
 
     def note_hit(self, key: tuple) -> None:
         if not self.enabled:
             return
         with self._lock:
             self.hits += 1
-        from . import metrics as m
         _fam_hits().labels(exec=_exec_kind(key)).inc()
+        self._update_shared_ratio()
 
     def note_eviction(self, key: tuple, fn) -> None:
         """One LRU eviction from the process jit table: counted,
@@ -346,6 +389,90 @@ class CompileObservatory:
         if not self.enabled:
             return
         _fam_cache_size().set(n)
+
+    # -- warm-start tier -----------------------------------------------------
+    def save_recipe_for(self, key: tuple, key_hash: str, fn,
+                        args: tuple) -> None:
+        """Persist a program recipe after a successful AOT build so the
+        next session (or `tools prewarm`) can replay it.  Best-effort:
+        no ledger dir, no raw fn, or a failed pickle all no-op."""
+        if not self.enabled or self.ledger_path is None or fn is None:
+            return
+        from . import prewarm as pw
+        pw.save_recipe(self.ledger_path, key_hash, key, fn, args)
+
+    def prewarm_entry(self, key: tuple, fn, abstract_list) -> int:
+        """Replay one recipe: compile its recorded abstract signatures
+        (flowing through JAX's persistent disk cache) and stage a
+        dispatch-ready proxy for the matching process_jit miss.
+        Returns the number of programs readied."""
+        import jax
+        if not self.enabled:
+            return 0
+        jitted = jax.jit(fn)
+        proxy = _ProfiledJit(self, key, jitted, fn)
+        n = 0
+        for abstract in abstract_list:
+            try:
+                sig = _dispatch_key(abstract)
+                if sig is None:
+                    continue
+                t0 = time.perf_counter()
+                compiled = jitted.lower(*abstract).compile()
+                dt = time.perf_counter() - t0
+            except Exception as ex:
+                log.debug("prewarm replay failed for %s: %s",
+                          proxy._key_hash, ex)
+                continue
+            proxy._prewarmed[_erase_sharding(sig)] = compiled
+            n += 1
+            with self._lock:
+                self.prewarm_seconds += dt
+            _fam_prewarm_seconds().inc(dt)
+            self._append_ledger({
+                "event": "prewarm", "exec": proxy._exec,
+                "key": proxy._key_hash,
+                "canon_key": proxy._canon_key,
+                "total_s": round(dt, 6)})
+        if n:
+            with self._lock:
+                self._prewarm_staged[key] = proxy
+        return n
+
+    def take_prewarmed(self, key: tuple):
+        """Claim the staged proxy for a process_jit key, if a recipe
+        replay readied one (called on the table's miss path)."""
+        with self._lock:
+            return self._prewarm_staged.pop(key, None)
+
+    def note_prewarm_hit(self, exec_kind: str,
+                         pid: Optional[Tuple[str, str]] = None) -> None:
+        """One query call served by a prewarmed executable — the build
+        the warm-start tier just avoided."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.prewarm_hits += 1
+            if pid is not None:
+                self._resident.add(pid)
+                self._evicted.discard(pid)
+                self._evicted_live.discard(pid)
+        _fam_prewarm_hits().labels(exec=exec_kind).inc()
+        self._update_shared_ratio()
+
+    def note_prewarm_session(self, stats: Dict) -> None:
+        with self._lock:
+            self.prewarm_stats = dict(stats)
+
+    def _update_shared_ratio(self) -> None:
+        """tpu_jit_shared_program_ratio = distinct resident programs
+        over total jit dispatches; 1.0 means every call built its own
+        program, ->0 means the bucket-canonical key space is doing its
+        job."""
+        with self._lock:
+            calls = self.hits + self.builds + self.prewarm_hits
+            n = len(self._resident)
+        _fam_shared_ratio().set(n / max(1, calls))
 
     # -- recording -----------------------------------------------------------
     def classify(self, exec_kind: str, pid: Tuple[str, str],
@@ -408,6 +535,7 @@ class CompileObservatory:
                 warn[0], warn[1], 100 * warn[2],
                 100 * self.thrash_warn_ratio)
         _fam_misses().labels(exec=exec_kind, cause=cause).inc()
+        self._update_shared_ratio()
         if total_s:
             _fam_compile_seconds().labels(
                 exec=exec_kind, cause=cause).inc(total_s)
@@ -463,6 +591,10 @@ class CompileObservatory:
                 "by_cause": dict(self.by_cause),
                 "distinct_programs": len(self._programs),
                 "resident_programs": len(self._resident),
+                "prewarm_hits": self.prewarm_hits,
+                "prewarm_seconds": round(self.prewarm_seconds, 6),
+                "prewarm": dict(self.prewarm_stats)
+                if self.prewarm_stats else None,
             }
 
 
@@ -475,17 +607,25 @@ class _ProfiledJit:
     input-shape signature to an AOT-compiled executable, timing the
     lower/compile split on each first-per-shape call."""
 
-    __slots__ = ("_obs", "_key_hash", "_canon_key", "_exec",
-                 "_key_head", "_jitted", "_compiled", "_lock")
+    __slots__ = ("_obs", "_key", "_key_hash", "_canon_key", "_exec",
+                 "_key_head", "_jitted", "_fn", "_compiled",
+                 "_prewarmed", "_traced_sigs", "_lock")
 
-    def __init__(self, obs: CompileObservatory, key: tuple, jitted):
+    def __init__(self, obs: CompileObservatory, key: tuple, jitted,
+                 fn=None):
         self._obs = obs
+        self._key = key
         self._exec = _exec_kind(key)
         self._key_hash = _stable_hash(key)
         self._canon_key = _stable_hash(_mask_buckets(key, obs.buckets))
         self._key_head = str(key[1] if len(key) > 1 else key)[:80]
         self._jitted = jitted
+        self._fn = fn  # the raw traced callable (prewarm recipes)
         self._compiled: Dict[tuple, Any] = {}
+        # warm-start tier: executables replayed from a prior session's
+        # recipes, keyed by sharding-erased signature
+        self._prewarmed: Dict[tuple, Any] = {}
+        self._traced_sigs: set = set()  # aval sigs seen under a trace
         self._lock = threading.Lock()
 
     def built_pids(self) -> List[Tuple[str, str]]:
@@ -497,12 +637,51 @@ class _ProfiledJit:
         sig = _dispatch_key(args)
         if sig is None:
             # unsignable leaves (e.g. called under an enclosing trace):
-            # plain jit dispatch, no profiling
-            return self._jitted(*args)
+            # plain jit dispatch, recorded under the same canonical key
+            return self._traced_call(args)
         fn = self._compiled.get(sig)
         if fn is not None:
             return fn(*args)
+        if self._prewarmed:
+            fn = self._prewarmed.get(_erase_sharding(sig))
+            if fn is not None:
+                try:
+                    out = fn(*args)
+                except Exception:
+                    # sharding/layout mismatch with the skeleton-compiled
+                    # executable: cold-build honestly instead
+                    return self._build_and_call(sig, args)
+                with self._lock:
+                    self._compiled.setdefault(sig, fn)
+                self._obs.note_prewarm_hit(
+                    self._exec,
+                    (self._key_hash,
+                     _shape_record(sig, self._obs.buckets)[0]))
+                return out
         return self._build_and_call(sig, args)
+
+    def _traced_call(self, args):
+        """Plain-jit dispatch for tracer-leaf calls — but the first call
+        per aval signature is still timed (the inline trace is real
+        compile work) and record_build'ed under this entry's canonical
+        key, so fallback builds dedupe and reach the ledger instead of
+        vanishing."""
+        sig = _aval_dispatch_key(args)
+        if sig is None:
+            return self._jitted(*args)
+        with self._lock:
+            known = sig in self._traced_sigs or sig in self._compiled
+            if not known:
+                self._traced_sigs.add(sig)
+        if known:
+            return self._jitted(*args)
+        t0 = time.perf_counter()
+        out = self._jitted(*args)
+        dt = time.perf_counter() - t0
+        self._obs.record_build(self._exec, self._key_hash,
+                               self._canon_key, sig, dt, None, dt, 0,
+                               self._key_head)
+        return out
 
     def _build_and_call(self, sig, args):
         with self._lock:
@@ -526,6 +705,8 @@ class _ProfiledJit:
                 hlo_bytes = 0
             fn = lowered.compile()
             compile_s = time.perf_counter() - t1
+            self._obs.save_recipe_for(self._key, self._key_hash,
+                                      self._fn, args)
         except Exception:
             # the AOT path is an observation vehicle: any lower/compile
             # surprise falls back to plain jit dispatch (which recompiles
@@ -578,6 +759,26 @@ def _fam_compile_seconds():
 def _fam_cache_size():
     return _registry().gauge(
         "tpu_jit_cache_size", "live entries in the process jit table")
+
+
+def _fam_prewarm_hits():
+    return _registry().counter(
+        "tpu_jit_prewarm_hits_total",
+        "query calls served by a warm-start-tier (prewarmed) program",
+        ("exec",), max_series=_JIT_MAX_SERIES)
+
+
+def _fam_prewarm_seconds():
+    return _registry().counter(
+        "tpu_jit_prewarm_seconds_total",
+        "wall seconds spent replaying program recipes at session init")
+
+
+def _fam_shared_ratio():
+    return _registry().gauge(
+        "tpu_jit_shared_program_ratio",
+        "distinct resident programs / jit dispatches "
+        "(1.0 = no sharing, ->0 = canonical keys collapsing the space)")
 
 
 # ---------------------------------------------------------------------------

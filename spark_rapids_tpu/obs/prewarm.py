@@ -1,0 +1,247 @@
+"""Warm-start tier of the two-tier program cache.
+
+The in-memory tier is the process jit table (exec/base.py) fronted by
+the observatory's AOT proxies.  This module adds the cross-session
+tier: every successful AOT build persists a **program recipe** next to
+the compile ledger — the full bucket-canonical jit key, the raw traced
+callable (cloudpickled with data-carrying captures stubbed out), and
+the abstract (shape/dtype) argument pytrees each built signature was
+compiled for.  A later session replays the top-K costliest recipes at
+init: `jax.jit(fn).lower(*abstract).compile()` flows through JAX's
+persistent compilation cache (disk hit, no backend compile) and the
+resulting executables are staged into the observatory so the first
+real query call dispatches straight to a ready program — zero
+query-time builds, `compile_warm_s ~= 0`.
+
+Everything here is best-effort telemetry-adjacent machinery: a recipe
+that fails to pickle, load or replay is skipped and counted, never
+fatal.  Stubbing is safe because traced kernels take their batches as
+call ARGUMENTS — closure-captured scan tables / device buffers / locks
+are never touched while tracing `_compute`-style bodies; if one ever
+is, the replay raises, the recipe is dropped, and the query path
+simply cold-builds as before.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+RECIPES_DIRNAME = "programs"
+RECIPE_VERSION = 1
+# backstop against closures that smuggle real data past the stubs
+MAX_RECIPE_BYTES = 8 << 20
+# abstract signatures retained per recipe (bucketed shapes converge
+# fast; an unbounded list would accrete one entry per join-size bucket)
+MAX_SIGS_PER_RECIPE = 8
+
+
+def _stub_none():
+    return None
+
+
+def _stub_types() -> tuple:
+    import _thread
+
+    import jax
+    import pyarrow as pa
+    types: List[type] = [pa.Table, pa.RecordBatch, pa.ChunkedArray,
+                         pa.Array, jax.Array,
+                         _thread.LockType, type(threading.RLock())]
+    return tuple(types)
+
+
+def _dumps_stubbed(obj) -> bytes:
+    """cloudpickle with data-carrying / unpicklable captures replaced by
+    None: recipes describe PROGRAMS (keys + traced code + abstract
+    shapes), they must never ship table payloads or device buffers."""
+    import cloudpickle
+    stubs = _stub_types()
+
+    class _StubPickler(cloudpickle.CloudPickler):
+        def reducer_override(self, o):
+            if isinstance(o, stubs):
+                return (_stub_none, ())
+            return super().reducer_override(o)
+
+    buf = io.BytesIO()
+    _StubPickler(buf).dump(obj)
+    return buf.getvalue()
+
+
+def _to_abstract(x):
+    """One call-argument leaf -> its shape/dtype skeleton.  Python
+    scalars pass through (weak-typed dynamic args: the type picks the
+    program, the value is irrelevant to lowering)."""
+    import jax
+    dt = getattr(x, "dtype", None)
+    shape = getattr(x, "shape", None)
+    if dt is not None and shape is not None:
+        return jax.ShapeDtypeStruct(tuple(int(s) for s in shape),
+                                    np.dtype(dt))
+    return x
+
+
+def recipes_dir(ledger_path: str) -> str:
+    return os.path.join(os.path.dirname(ledger_path), RECIPES_DIRNAME)
+
+
+def _abstract_repr(abstract) -> str:
+    """Stable text form of one abstract arg pytree (treedef + leaf
+    shape/dtype) — the recipe's dedupe key for persisted signatures."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(abstract)
+    return repr((str(treedef),
+                 [(getattr(x, "shape", None),
+                   str(getattr(x, "dtype", type(x).__name__)))
+                  for x in leaves]))
+
+
+# (recipe path, ) -> list of abstract arg pytrees already persisted
+# (rewrite-from-memory keeps the save path free of load-modify-write
+# cycles); keyed by the on-disk path, not the bare key_hash, so one
+# process writing to several ledger dirs never cross-suppresses saves
+_saved_sigs: Dict[str, List[Any]] = {}
+_save_lock = threading.Lock()
+
+
+def save_recipe(ledger_path: str, key_hash: str, key: tuple, fn,
+                args: tuple) -> bool:
+    """Persist/extend the recipe for one built program; returns True
+    when written.  Called from the observatory after a successful AOT
+    build — must never raise."""
+    import jax
+    try:
+        abstract = jax.tree_util.tree_map(_to_abstract, args)
+        sig_repr = _abstract_repr(abstract)
+        d = recipes_dir(ledger_path)
+        path = os.path.join(d, f"{key_hash}.pkl")
+        cache_key = os.path.abspath(path)
+        with _save_lock:
+            sigs = _saved_sigs.get(cache_key)
+            if sigs is None:
+                # first save to this path in THIS process: merge the
+                # signatures an earlier session already persisted so a
+                # rewrite never sheds them
+                sigs = _saved_sigs[cache_key] = []
+                if os.path.exists(path):
+                    try:
+                        import cloudpickle
+                        with open(path, "rb") as f:
+                            prior = cloudpickle.load(f)
+                        for a in (prior.get("abstract") or ()):
+                            sigs.append((_abstract_repr(a), a))
+                    except Exception:
+                        pass
+            if any(r == sig_repr for r, _ in sigs):
+                return False
+            if len(sigs) >= MAX_SIGS_PER_RECIPE:
+                return False
+            sigs.append((sig_repr, abstract))
+            payload = _dumps_stubbed({
+                "v": RECIPE_VERSION, "key": key,
+                "fn": fn, "abstract": [a for _, a in sigs]})
+        if len(payload) > MAX_RECIPE_BYTES:
+            log.debug("recipe %s over size backstop (%d bytes), "
+                      "not persisted", key_hash, len(payload))
+            return False
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+        return True
+    except Exception as ex:
+        log.debug("recipe save failed for %s: %s", key_hash, ex)
+        return False
+
+
+def rank_ledger_programs(ledger_path: str) -> List[Tuple[str, float]]:
+    """(key_hash, total compile seconds) from the ledger's build
+    events, costliest first — the prewarm priority order."""
+    import json
+    costs: Dict[str, float] = {}
+    try:
+        with open(ledger_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("event") != "build":
+                    continue
+                k = rec.get("key", "")
+                costs[k] = costs.get(k, 0.0) + (rec.get("total_s")
+                                                or 0.0)
+    except OSError:
+        return []
+    return sorted(costs.items(), key=lambda kv: -kv[1])
+
+
+def prewarm_from_ledger(ledger_path: str, top_k: int = 32,
+                        observatory=None) -> Dict[str, Any]:
+    """Replay the top-K costliest recipes: compile each recorded
+    abstract signature (hitting JAX's persistent disk cache when one is
+    configured) and stage dispatch-ready proxies in the observatory so
+    query-time calls build nothing.  Returns honest stats."""
+    from .compileprof import CompileObservatory
+    obs = observatory or CompileObservatory.get()
+    stats = {"recipes": 0, "programs": 0, "skipped": 0, "errors": 0,
+             "seconds": 0.0}
+    ranked = rank_ledger_programs(ledger_path)[:max(0, int(top_k))]
+    d = recipes_dir(ledger_path)
+    for key_hash, _cost in ranked:
+        path = os.path.join(d, f"{key_hash}.pkl")
+        if not os.path.exists(path):
+            stats["skipped"] += 1
+            continue
+        t0 = time.perf_counter()
+        try:
+            import cloudpickle
+            with open(path, "rb") as f:
+                doc = cloudpickle.load(f)
+            if doc.get("v") != RECIPE_VERSION:
+                stats["skipped"] += 1
+                continue
+            n = obs.prewarm_entry(doc["key"], doc["fn"],
+                                  doc.get("abstract") or ())
+        except Exception as ex:
+            stats["errors"] += 1
+            log.debug("recipe replay failed for %s: %s", key_hash, ex)
+            continue
+        dt = time.perf_counter() - t0
+        stats["recipes"] += 1
+        stats["programs"] += n
+        stats["seconds"] += dt
+    obs.note_prewarm_session(stats)
+    return stats
+
+
+def prewarm_session(ledger_path: str, top_k: int = 32,
+                    background: bool = False) -> Optional[threading.Thread]:
+    """Session-init entry: prewarm synchronously, or on a daemon thread
+    so startup is not blocked (queries racing the thread simply
+    cold-build — the staging tier is checked under the jit-table
+    seam's normal locking)."""
+    if not os.path.exists(ledger_path) or \
+            not os.path.isdir(recipes_dir(ledger_path)):
+        return None
+    if not background:
+        prewarm_from_ledger(ledger_path, top_k=top_k)
+        return None
+    t = threading.Thread(
+        target=lambda: prewarm_from_ledger(ledger_path, top_k=top_k),
+        name="tpu-jit-prewarm", daemon=True)
+    t.start()
+    return t

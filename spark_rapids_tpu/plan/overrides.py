@@ -71,6 +71,12 @@ def _tag_literal(meta: "ExprMeta"):
 
 
 expr_rule(Literal, T.all_types, "literal values", _tag_literal)
+
+from ..expr.params import ParamLiteral  # noqa: E402 (needs Literal)
+
+expr_rule(ParamLiteral, _num + T.DATE + T.TIMESTAMP,
+          "parameterized literal (hoisted out of the jit key so "
+          "literal-only query twins share compiled programs)")
 expr_rule(Alias, T.all_types.nested(), "named expression")
 expr_rule(AttributeReference,
           (_common + T.ARRAY + T.STRUCT + T.MAP + T.BINARY).nested(),

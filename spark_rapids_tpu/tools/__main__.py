@@ -8,6 +8,7 @@
     python -m spark_rapids_tpu.tools regress --history DIR --record <eventlog...> [--label L]
     python -m spark_rapids_tpu.tools regress --history DIR --check [--wall-threshold PCT]
     python -m spark_rapids_tpu.tools compile-report --ledger PATH [--top N] [--json]
+    python -m spark_rapids_tpu.tools prewarm        --ledger DIR [--top K] [--cache-dir DIR]
 
 `compile-report` aggregates the compile observatory's cross-session
 ledger (obs/compileprof.py; `--ledger` takes the JSONL file or the
@@ -170,6 +171,51 @@ def _run_regress(history_dir, record_logs, check, wall_threshold,
     return 0
 
 
+def _run_prewarm(ledger, top, cache_dir):
+    import os
+
+    path = ledger
+    if os.path.isdir(path):
+        from ..obs.history import HistoryDir
+        path = HistoryDir(path).compile_ledger_path()
+    if not os.path.exists(path):
+        sys.stderr.write(f"{ledger}: no compile ledger found\n")
+        return 2
+    if cache_dir:
+        # same platform/XLA-flags/host scoping as the plugin wires at
+        # session init, so the entries this replay writes are the ones
+        # a real session will read
+        import hashlib
+
+        import jax
+
+        from ..plugin import _host_cpu_fingerprint
+        fp = hashlib.sha1(
+            f"{jax.__version__}|{jax.default_backend()}|"
+            f"{os.environ.get('XLA_FLAGS', '')}|"
+            f"{_host_cpu_fingerprint()}".encode()).hexdigest()[:12]
+        d = os.path.join(os.path.expanduser(cache_dir), fp)
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+    from ..obs.compileprof import CompileObservatory
+    from ..obs.prewarm import prewarm_from_ledger
+    CompileObservatory.get().configure(enabled=True, ledger_path=path)
+    stats = prewarm_from_ledger(path, top_k=top)
+    sys.stdout.write(
+        f"prewarm: {stats['recipes']} recipe(s) replayed, "
+        f"{stats['programs']} program(s) compiled in "
+        f"{stats['seconds']:.2f}s ({stats['skipped']} without recipes, "
+        f"{stats['errors']} error(s))\n")
+    if stats["recipes"] == 0 and stats["errors"] == 0:
+        sys.stdout.write(
+            "no recipes found — run a session with "
+            "spark.rapids.tpu.compile.ledgerDir set to record some\n")
+    return 1 if stats["errors"] else 0
+
+
 def _default_baseline():
     import os
     return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
@@ -255,6 +301,21 @@ def main(argv=None):
                     help="rows per ranking section")
     cr.add_argument("--json", action="store_true",
                     help="emit the aggregate as JSON instead of text")
+    pw = sub.add_parser("prewarm",
+                        help="replay the top-K ledger program recipes "
+                             "to populate the persistent compile cache "
+                             "out-of-band")
+    pw.add_argument("--ledger", required=True,
+                    help="compile_ledger.jsonl or the history dir "
+                         "containing it (recipes live in its programs/ "
+                         "subdirectory)")
+    pw.add_argument("--top", type=int, default=32,
+                    help="how many programs to replay, ranked by "
+                         "cumulative compile seconds")
+    pw.add_argument("--cache-dir", default=None,
+                    help="persistent XLA compile cache to populate "
+                         "(spark.rapids.tpu.jit.persistentCacheDir); "
+                         "without it the replay only validates recipes")
     args = p.parse_args(argv)
 
     if args.cmd == "qualification":
@@ -283,6 +344,8 @@ def main(argv=None):
         from .compile_report import run_compile_report
         return run_compile_report(args.ledger, top=args.top,
                                   as_json=args.json)
+    elif args.cmd == "prewarm":
+        return _run_prewarm(args.ledger, args.top, args.cache_dir)
     else:
         if args.plan:
             return _run_plan_lint(args.plan, infer=args.infer,

@@ -1,0 +1,123 @@
+"""Cross-query program sharing under bucket-canonical tracing.
+
+The jit key space is meant to collapse to (exec kind, dtype layout,
+capacity bucket): two structurally distinct queries that differ only in
+literal constants and land in the same capacity buckets must run the
+second query on the FIRST query's programs — zero new compilations.
+ParamLiteral (expr/params.py) hoists eligible literals out of the
+traced closures into traced arguments, and the semantic jit key
+excludes their values, so this is exactly what the seam should deliver.
+
+The anti-vacuity twin proves the test has teeth: changing a column's
+DTYPE (not a literal) must fork the key space and compile new
+programs — if it didn't, the sharing assertion above would be
+vacuously green for the wrong reason (e.g. a disabled observatory).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu.exec.base as eb
+import spark_rapids_tpu.obs.metrics as obs_metrics
+from spark_rapids_tpu.api.column import col
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.obs.compileprof import CompileObservatory
+
+
+@pytest.fixture
+def obs():
+    obs_metrics.MetricsRegistry.reset_for_tests()
+    o = CompileObservatory.reset_for_tests()
+    eb.clear_jit_cache()
+    yield o
+    eb.clear_jit_cache()
+    CompileObservatory.reset_for_tests()
+    obs_metrics.MetricsRegistry.reset_for_tests()
+
+
+def _session() -> TpuSession:
+    return (TpuSession.builder()
+            .config("spark.rapids.sql.enabled", True)
+            .config("spark.rapids.tpu.singleChipFuse", "off")
+            .config("spark.rapids.tpu.sort.compileLean", "off")
+            .get_or_create())
+
+
+def _table(n=2000):
+    # v = 0..n-1: the filter survivor counts for `v > 5` (1994) and
+    # `v > 9` (1990) land in the SAME capacity bucket (2048), so even
+    # the survivor-repack transfer programs are shared — a different
+    # bucket would be an honest, wanted recompile, not sharing failure
+    return pa.table({
+        "k": pa.array((np.arange(n) % 7).astype(np.int64)),
+        "v": pa.array(np.arange(n, dtype=np.int64)),
+    })
+
+
+def _query(df, threshold: int, addend: int):
+    return (df.filter(col("v") > threshold)
+            .select(col("k"), (col("v") + addend).alias("x"))
+            .collect())
+
+
+def test_literal_twins_share_all_programs(obs):
+    s = _session()
+    df = s.create_dataframe(_table())
+
+    out1 = _query(df, 5, 7)
+    snap1 = obs.snapshot()
+    assert snap1["builds"] > 0  # the cold query really compiled
+
+    out2 = _query(df, 9, 11)
+    snap2 = obs.snapshot()
+
+    assert snap2["builds"] == snap1["builds"], (
+        f"literal-only twin compiled "
+        f"{snap2['builds'] - snap1['builds']} new program(s): "
+        f"{snap2['by_cause']}")
+    assert snap2["hits"] > snap1["hits"]
+
+    # sharing must not bend correctness: both results are exact
+    v = np.arange(2000, dtype=np.int64)
+    np.testing.assert_array_equal(
+        np.sort(out1.column("x").to_numpy()), np.sort(v[v > 5] + 7))
+    np.testing.assert_array_equal(
+        np.sort(out2.column("x").to_numpy()), np.sort(v[v > 9] + 11))
+
+
+def test_dtype_change_must_compile(obs):
+    s = _session()
+    df = s.create_dataframe(_table())
+    _query(df, 5, 7)
+    snap1 = obs.snapshot()
+
+    # same query shape over float64 — a dtype-layout change is a
+    # genuinely different program family and MUST compile
+    n = 2000
+    ftbl = pa.table({
+        "k": pa.array((np.arange(n) % 7).astype(np.int64)),
+        "v": pa.array(np.arange(n, dtype=np.float64)),
+    })
+    fdf = s.create_dataframe(ftbl)
+    out = (fdf.filter(col("v") > 5.0)
+           .select(col("k"), (col("v") + 7.0).alias("x"))
+           .collect())
+    snap2 = obs.snapshot()
+
+    assert snap2["builds"] > snap1["builds"], (
+        "dtype change compiled nothing — the sharing test is vacuous")
+    v = np.arange(n, dtype=np.float64)
+    np.testing.assert_allclose(
+        np.sort(out.column("x").to_numpy()), np.sort(v[v > 5.0] + 7.0))
+
+
+def test_shared_program_ratio_gauge(obs):
+    """tpu_jit_shared_program_ratio drops as calls reuse programs."""
+    s = _session()
+    df = s.create_dataframe(_table())
+    _query(df, 5, 7)
+    _query(df, 9, 11)
+    ratio = obs_metrics.registry().gauge(
+        "tpu_jit_shared_program_ratio").value()
+    assert 0.0 < ratio < 1.0
