@@ -13,6 +13,12 @@ high-cardinality group-by / join / sort, windows, parquet IO):
               multi-batch host-exchange path
   q7 write:   scan -> parquet write (columnar write path)
 
+Plus one out-of-loop measurement: `big_join`, a join whose build side
+deliberately exceeds the JVM bridge's retired 256 MB driver-collect cap
+(`spark.tpu.bridge.maxBuildSideBytes`), executed through the
+spill-backed shuffled path under the memsan ledger (--skip-big-join to
+omit; it costs one full build-side shuffle).
+
 Prints ONE JSON line: value = total rows processed per second through
 the TPU engine across the suite; vs_baseline = CPU-engine time / TPU
 time on the same host (the stand-in for Spark-CPU until a cluster
@@ -227,6 +233,92 @@ def measure_cache_cold(n_rows: int) -> float:
 
 _SUITE_NAMES = ("agg", "join", "sort", "window", "parquet",
                 "shuffle_join", "write")
+
+
+# the JVM bridge's retired driver-collect ceiling: shuffled/SMJ joins
+# whose build side exceeded this were REJECTED outright before the
+# spill-backed shuffle catalog existed.  big_join deliberately builds
+# past it so the retired cap has a measured after.
+_OLD_BUILD_CAP_BYTES = 256 * 1024 * 1024
+
+
+def measure_big_join(cap_bytes: int = _OLD_BUILD_CAP_BYTES) -> dict:
+    """One end-to-end join whose BUILD side exceeds the old 256 MB
+    bridge cap (`spark.tpu.bridge.maxBuildSideBytes`), executed through
+    the co-partitioned spill-backed shuffle path — the workload the
+    bridge used to reject.  Runs ONCE (~the cost of shuffling the full
+    build side through the catalog), outside the repeated suite loop.
+
+    A wide FK->PK dimension keeps the byte size past the cap without a
+    row-explosion: 33 int64 columns, unique keys, so the join output is
+    one row per probe row.  The LEFT join pins the oversized dimension
+    as the build side (an inner join would flip the smaller fact into
+    build position and broadcast it).  singleChipFuse is off so the
+    single-device host still plans the real ShuffledHashJoinExec over
+    co-clustered catalog partitions instead of fusing the exchanges
+    away.  The memsan shadow ledger rides the run: peak device bytes
+    are measured, and a dirty ledger (leaked shuffle blocks, lifecycle
+    violations) fails the measurement rather than reporting around it."""
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.memory import memsan
+
+    ncols = 32
+    row_bytes = 8 * (1 + ncols)
+    build_rows = cap_bytes // row_bytes + 1      # first size past the cap
+    cols = {"k": pa.array(np.arange(build_rows, dtype=np.int64))}
+    base = np.arange(build_rows, dtype=np.int64)
+    for i in range(ncols):
+        cols[f"w{i}"] = pa.array(base + i)
+    dim = pa.table(cols)
+    assert dim.nbytes > cap_bytes
+    rng = np.random.default_rng(42)
+    probe_rows = 1_000_000
+    fact = pa.table({
+        "k": pa.array(rng.integers(0, build_rows,
+                                   probe_rows).astype(np.int64)),
+        "v": pa.array(rng.integers(-1000, 1000,
+                                   probe_rows).astype(np.int64))})
+    s = (TpuSession.builder()
+         .config("spark.rapids.sql.enabled", True)
+         .config("spark.rapids.tpu.singleChipFuse", "off")
+         .get_or_create())
+    fdf = s.create_dataframe(fact, num_partitions=4)
+    ddf = s.create_dataframe(dim, num_partitions=4)
+    t0 = time.perf_counter()
+    with memsan.installed() as ledger:
+        out = (fdf.join(ddf, on="k", how="left")
+               .group_by(col("k"))
+               .agg(F.sum(col("w0")).alias("sw"))
+               .collect())
+    wall = time.perf_counter() - t0
+    expect_groups = len(np.unique(fact.column("k").to_numpy()))
+    assert out.num_rows == expect_groups, \
+        f"big_join lost rows: {out.num_rows} != {expect_groups}"
+    kinds = []
+    s.last_plan.foreach(lambda e: kinds.append(type(e).__name__))
+    shuffled = "ShuffledHashJoinExec" in kinds and \
+        "BroadcastHashJoinExec" not in kinds
+    assert shuffled, f"big_join did not take the shuffled path: {kinds}"
+    try:
+        ledger.assert_clean()
+        clean = True
+    except Exception:
+        clean = False
+    rows_in = probe_rows + build_rows
+    return {
+        "build_side_bytes": dim.nbytes,
+        "old_cap_bytes": cap_bytes,
+        "probe_rows": probe_rows,
+        "build_rows": build_rows,
+        "wall_s": round(wall, 2),
+        "rows_per_s": round(rows_in / wall, 1),
+        "output_rows": out.num_rows,
+        "peak_device_bytes": int(ledger.peak_device_bytes),
+        "shuffled_plan": shuffled,
+        "memsan_clean": clean,
+    }
 
 
 def run_one_suite(name: str, n_rows: int, cache_dir: str,
@@ -542,6 +634,7 @@ def main():
     with_compile_report = "--compile-report" in sys.argv[1:]
     with_record = "--record" in sys.argv[1:]
     with_check = "--check" in sys.argv[1:]
+    with_big_join = "--skip-big-join" not in sys.argv[1:]
     is_cpu_fallback = "--cpu-fallback" in sys.argv[1:]
     history_dir = _arg_value("--history", "tpu_bench_history")
     wall_threshold = _arg_value("--wall-threshold")
@@ -599,6 +692,11 @@ def main():
             # lumped first-run-minus-warm guess
             del detail[k]["compile_s"]
             detail[k].update(compile_report[k])
+    big_join = None
+    if with_big_join:
+        # once, not in the repeated suite loop: the measurement IS a
+        # full 256 MB+ build side through the spill-backed catalog
+        big_join = measure_big_join()
     cold_s = measure_cache_cold(n_rows)
     out = {
         "metric": "sql_suite_rows_per_sec",
@@ -608,6 +706,8 @@ def main():
         "cache_cold_compile_s": round(cold_s, 2),
         "detail": detail,
     }
+    if big_join is not None:
+        out["big_join"] = big_join
     if with_pyspark:
         if spark_cpu is None:
             out["vs_spark_cpu"] = None   # pyspark not importable here

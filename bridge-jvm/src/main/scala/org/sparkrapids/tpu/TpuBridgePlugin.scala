@@ -429,14 +429,16 @@ object SpecBuilder {
   }
 
   /**
-   * Driver-collect robustness gate: a shuffled/sort-merge join's build
-   * side is `executeCollect()`-ed whole to the driver by TpuBridgeExec
-   * — but Spark chose a NON-broadcast join precisely because that side
-   * exceeded the broadcast threshold, so an unbounded collect can OOM
-   * the driver.  Translate only when the build side's optimizer size
-   * estimate is known AND under the cap (unknown = conservatively
-   * reject; broadcast joins already passed Spark's own threshold and
-   * skip this gate).
+   * Broadcast-vs-shuffled CBO threshold (formerly the driver-collect
+   * scale ceiling): a shuffled/sort-merge join whose build side's
+   * optimizer estimate is under the cap may run as the engine's
+   * broadcast-style hash join; past the cap — or when the estimate is
+   * unknown — the join translates with `"strategy": "shuffled"`, which
+   * pins the engine to the co-partitioned spill-backed shuffle path
+   * (both sides hash-exchanged into the spillable shuffle catalog, one
+   * co-clustered shard joined at a time).  Nothing falls back anymore:
+   * the old behavior of rejecting the translation made
+   * maxBuildSideBytes a hard input-scale ceiling.
    */
   private def buildSideFits(build: SparkPlan): Boolean = {
     val cap = try {
@@ -503,12 +505,17 @@ object SpecBuilder {
       case None => keys
     }
     val buildPlan = stripExchange(right)
-    if (gateBuildSize && !buildSideFits(buildPlan)) return None
+    // oversized (or unknown-size) build sides no longer reject: they
+    // pin the engine's shuffled path, where the build side streams
+    // through the spill-backed shuffle catalog one shard at a time
+    val forceShuffled = gateBuildSize && !buildSideFits(buildPlan)
     extra += buildPlan
     val idx = extra.size
     walk(stripExchange(left)).map { case (ops, leaf) =>
+      val strategyField =
+        if (forceShuffled) """, "strategy": "shuffled"""" else ""
       val joinOp =
-        s"""{"op": "join", "right": $idx, "how": ${json(how)}, $keyField}"""
+        s"""{"op": "join", "right": $idx, "how": ${json(how)}$strategyField, $keyField}"""
       val opsOut = if (restoreDupKeys) {
         // the engine's "on" join outputs [keys, left rest, right rest];
         // restore Spark's schema (left.output ++ right.output, key

@@ -130,11 +130,13 @@ class SpecBuilderSuite extends AnyFunSuite {
     }
   }
 
-  test("shuffled join build side above the size cap is rejected") {
-    // Spark chose a non-broadcast join because the build side exceeded
-    // the broadcast threshold; TpuBridgeExec executeCollect()s it to
-    // the driver, so translation is gated on the optimizer's size
-    // estimate against spark.tpu.bridge.maxBuildSideBytes
+  test("shuffled join build side above the size cap pins the shuffled " +
+       "strategy") {
+    // maxBuildSideBytes used to be a hard translation ceiling (the
+    // build side was executeCollect()-ed whole to the driver); it is
+    // now only the broadcast-vs-shuffled CBO threshold: an over-cap
+    // (or unknown-size) build side still translates, with the join op
+    // pinned to the engine's spill-backed shuffled path
     val prevBc = spark.conf.get("spark.sql.autoBroadcastJoinThreshold")
     spark.conf.set("spark.sql.autoBroadcastJoinThreshold", "-1")
     try {
@@ -147,12 +149,32 @@ class SpecBuilderSuite extends AnyFunSuite {
       }.get
       spark.conf.set("spark.tpu.bridge.maxBuildSideBytes", "1")
       try {
-        assert(!SpecBuilder.supportedChain(join))
+        assert(SpecBuilder.supportedChain(join)) // no longer a ceiling
+        assert(SpecBuilder.build(join)._1
+          .contains(""""strategy": "shuffled""""))
       } finally {
         spark.conf.unset("spark.tpu.bridge.maxBuildSideBytes")
       }
-      assert(SpecBuilder.supportedChain(join)) // default cap admits it
+      assert(SpecBuilder.supportedChain(join))
+      // under the default cap the CBO may still pick broadcast-style
+      assert(!SpecBuilder.build(join)._1.contains(""""strategy""""))
     } finally {
+      spark.conf.set("spark.sql.autoBroadcastJoinThreshold", prevBc)
+    }
+  }
+
+  test("forced shuffled join matches its golden") {
+    val prevBc = spark.conf.get("spark.sql.autoBroadcastJoinThreshold")
+    spark.conf.set("spark.sql.autoBroadcastJoinThreshold", "-1")
+    spark.conf.set("spark.tpu.bridge.maxBuildSideBytes", "1")
+    try {
+      val fact = Seq((1L, 10L), (2L, 20L)).toDF("id", "x")
+      val dim = Seq((1L, 100L), (2L, 200L)).toDF("user_id", "w")
+      val df = fact.join(dim, $"id" === $"user_id", "inner")
+        .select($"x", $"w")
+      check("shuffled_join_forced", df)
+    } finally {
+      spark.conf.unset("spark.tpu.bridge.maxBuildSideBytes")
       spark.conf.set("spark.sql.autoBroadcastJoinThreshold", prevBc)
     }
   }

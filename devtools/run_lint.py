@@ -54,6 +54,16 @@ must carry a classified cause with >= 95% of wall compile time
 attributed, and injected bucket/dtype perturbations must classify as
 shape_churn / dtype_churn (anti-vacuity).
 
+--shuffle runs the distributed-shuffle gate: the checked-in forced-
+shuffled-join bridge golden replays through a real session under the
+memsan shadow ledger with the spill budget forced to 1 byte (every
+registered map-output block must demote and come back correct), and
+the gate fails on a wrong join result, a plan that fell back to
+broadcast, a dirty ledger, leaked catalog blocks after stage release,
+a silent slice-view write (zero saved bytes), or a transport leg whose
+fetched-block/byte counters disagree with what the server actually
+registered.
+
     python devtools/run_lint.py                    # repo check
     python devtools/run_lint.py --update-baseline  # re-freeze debt
     python devtools/run_lint.py --interp           # plan typechecker gate
@@ -62,6 +72,7 @@ shape_churn / dtype_churn (anti-vacuity).
     python devtools/run_lint.py --regress          # cross-run watchdog gate
     python devtools/run_lint.py --metrics          # metrics/health gate
     python devtools/run_lint.py --jit              # compile-observatory gate
+    python devtools/run_lint.py --shuffle          # distributed-shuffle gate
 """
 
 import json
@@ -708,6 +719,159 @@ def run_jit_gate() -> int:
     return 0
 
 
+def run_shuffle_gate() -> int:
+    """Distributed-shuffle gate: (a) the forced-shuffled-join bridge
+    golden replays through a real session under the memsan shadow
+    ledger with the spill budget pinned to 1 byte, so every registered
+    map-output block demotes off-device and must come back correct;
+    (b) a transport leg serves real catalog blocks over TCP and the
+    async fetcher's block/byte counters must agree with what the
+    server registered (and count zero errors)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.bridge.spec import plan_spec_to_logical
+    from spark_rapids_tpu.columnar.device import (batch_to_arrow,
+                                                  batch_to_device)
+    from spark_rapids_tpu.memory import memsan
+    from spark_rapids_tpu.memory.spill import SpillCatalog
+    from spark_rapids_tpu.obs import metrics as m
+    from spark_rapids_tpu.obs.metrics import MetricsRegistry
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+    from spark_rapids_tpu.shuffle.transport import (AsyncBlockFetcher,
+                                                    ShuffleClient,
+                                                    ShuffleServer)
+
+    failures = 0
+    MetricsRegistry.reset_for_tests()
+    with SpillCatalog._lock:
+        SpillCatalog._instance = SpillCatalog()
+    TpuShuffleManager.reset()
+
+    golden = os.path.join(REPO, "bridge-jvm", "src", "test",
+                          "resources", "goldens",
+                          "shuffled_join_forced.json")
+    with open(golden) as f:
+        spec = json.load(f)["spec"]
+    spec["numPartitions"] = 4
+
+    # skewed keys: every other row hits key 0, so each map batch's
+    # per-partition slice buckets sum PAST the whole-batch bucket and
+    # the slice-view write must bank nonzero saved bytes (anti-vacuity
+    # for tpu_shuffle_write_saved_bytes_total)
+    n = 4000
+    ids = np.where(np.arange(n) % 2 == 0, 0,
+                   np.arange(n) % 97).astype(np.int64)
+    fact = pa.table({"id": pa.array(ids),
+                     "x": pa.array(np.arange(n, dtype=np.int64))})
+    dim = pa.table({"user_id": pa.array(np.arange(97, dtype=np.int64)),
+                    "w": pa.array(np.arange(97, dtype=np.int64) * 10)})
+
+    s = (TpuSession.builder()
+         .config("spark.rapids.sql.enabled", True)
+         .config("spark.rapids.tpu.singleChipFuse", "off")
+         .config("spark.rapids.memory.tpu.spillBudgetBytes", 1)
+         .get_or_create())
+    with memsan.installed() as ledger:
+        got = s.execute(plan_spec_to_logical(spec, fact, (dim,)))
+        names = []
+        s.last_plan.foreach(lambda e: names.append(type(e).__name__))
+        if "ShuffledHashJoinExec" not in names or \
+                names.count("ShuffleExchangeExec") < 2:
+            failures += 1
+            print(f"SHUFFLE: golden plan lost its shuffled shape: "
+                  f"{names}")
+        if "BroadcastHashJoinExec" in names:
+            failures += 1
+            print("SHUFFLE: forced-shuffled golden fell back to "
+                  "broadcast")
+        want = np.sort(ids * 10)
+        if not np.array_equal(np.sort(got.column("w").to_numpy()),
+                              want) or got.num_rows != n:
+            failures += 1
+            print(f"SHUFFLE: wrong join result ({got.num_rows} rows)")
+        peak = ledger.peak_device_bytes
+        try:
+            ledger.assert_clean()
+        except memsan.LifecycleViolation as ex:
+            failures += 1
+            print(f"SHUFFLE: dirty ledger after stage release: {ex}")
+    if TpuShuffleManager.get().catalog.num_blocks() != 0:
+        failures += 1
+        print(f"SHUFFLE: {TpuShuffleManager.get().catalog.num_blocks()}"
+              f" catalog block(s) leaked past release_plan_shuffles")
+    leaks = SpillCatalog.get().leak_report()
+    if leaks:
+        failures += 1
+        print(f"SHUFFLE: {len(leaks)} spillable buffer(s) leaked")
+    spilled = sum(ch.value for _, ch in
+                  m.counter("tpu_spill_bytes_total",
+                            labelnames=("tier",)).series())
+    if spilled <= 0:
+        failures += 1
+        print("SHUFFLE: vacuous replay — a 1-byte spill budget spilled "
+              "nothing")
+    saved = m.counter("tpu_shuffle_write_saved_bytes_total").value()
+    if saved <= 0:
+        failures += 1
+        print("SHUFFLE: slice-view map write banked zero saved bytes "
+              "on a skewed corpus")
+    wrote = m.counter("tpu_shuffle_write_blocks_total").value()
+    read = m.counter("tpu_shuffle_read_batches_total").value()
+    if wrote <= 0 or read <= 0:
+        failures += 1
+        print(f"SHUFFLE: write/read counters never moved "
+              f"(wrote {wrote}, read {read})")
+
+    # transport leg: real catalog blocks over TCP, counters must agree
+    TpuShuffleManager.reset()
+    mgr = TpuShuffleManager.get()
+    n_maps, rows = 6, 128
+    for mid in range(n_maps):
+        rb = pa.record_batch({"a": pa.array(
+            [mid * 1000 + i for i in range(rows)], type=pa.int64())})
+        mgr.write_map_output(21, mid, {0: batch_to_device(rb, xp=np)})
+    server = ShuffleServer(mgr).start()
+    try:
+        cli = ShuffleClient("127.0.0.1", server.port)
+        first = [batch_to_arrow(b).column("a").to_pylist()[0]
+                 for b in AsyncBlockFetcher(cli, 21, 0, window=3)]
+        cli.close()
+    finally:
+        server.stop()
+        TpuShuffleManager.reset()
+    if first != [mid * 1000 for mid in range(n_maps)]:
+        failures += 1
+        print(f"SHUFFLE: transport leg fetched wrong blocks: {first}")
+    fetched = m.counter("tpu_shuffle_fetch_blocks_total").value()
+    if fetched != n_maps:
+        failures += 1
+        print(f"SHUFFLE: fetched-block counter disagrees "
+              f"({fetched} != {n_maps} served)")
+    if m.counter("tpu_shuffle_fetch_bytes_total").value() <= 0:
+        failures += 1
+        print("SHUFFLE: fetched-bytes counter never moved")
+    errs = m.counter("tpu_shuffle_fetch_errors_total",
+                     labelnames=("kind",))
+    n_errs = sum(ch.value for _, ch in errs.series())
+    if n_errs:
+        failures += 1
+        print(f"SHUFFLE: clean transport leg counted {n_errs} fetch "
+              f"error(s)")
+
+    MetricsRegistry.reset_for_tests()
+    if failures:
+        print(f"shuffle gate: {failures} failure(s)")
+        return 1
+    print(f"shuffle gate clean (forced-shuffled golden joined "
+          f"correctly under a 1-byte spill budget, peak {int(peak)} "
+          f"device bytes, {int(spilled)} bytes spilled, {int(saved)} "
+          f"slice-view bytes saved, ledger + catalog clean; transport "
+          f"leg fetched {int(fetched)} blocks with zero errors)")
+    return 0
+
+
 def main(argv=None):
     args = argv if argv is not None else sys.argv[1:]
     if "--interp" in args:
@@ -722,6 +886,8 @@ def main(argv=None):
         return run_metrics_gate()
     if "--jit" in args:
         return run_jit_gate()
+    if "--shuffle" in args:
+        return run_shuffle_gate()
     from spark_rapids_tpu.tools.__main__ import main as tools_main
     cli = ["lint", "--repo", "--baseline", BASELINE]
     if "--update-baseline" in args:

@@ -241,7 +241,8 @@ def plan_spec_to_logical(spec: Dict, table, extra_tables=()) -> L.LogicalPlan:
             cond = expr_from_spec(op["condition"]) \
                 if op.get("condition") is not None else None
             lp = L.Join(lp, right, how, cond,
-                        using=list(op.get("on") or []) or None)
+                        using=list(op.get("on") or []) or None,
+                        force_shuffled=op.get("strategy") == "shuffled")
         elif kind == "window":
             lp = L.Window(_window_from_spec(op), lp)
         elif kind == "sort":

@@ -381,6 +381,34 @@ SHUFFLE_HEARTBEAT_TIMEOUT_MS = conf("spark.rapids.shuffle.heartbeat.timeoutMs").
     .doc("Peer considered dead after missing heartbeats for this long.") \
     .create_with_default(30000)
 
+SHUFFLE_FETCH_MAX_IN_FLIGHT = conf(
+    "spark.rapids.tpu.shuffle.fetch.maxInFlight").integer() \
+    .doc("Bounded in-flight window of the async block fetcher: how many "
+         "fetched-but-unconsumed blocks may be buffered while the "
+         "consumer joins the previous block (fetch/compute overlap, "
+         "ref BufferReceiveState windows).  Bounds reduce-side host "
+         "memory at window x block size.") \
+    .create_with_default(4)
+
+SHUFFLE_FETCH_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.shuffle.fetch.timeoutMs").integer() \
+    .doc("Per-block timeout of the async fetcher.  Liveness normally "
+         "fails faster via heartbeat expiry "
+         "(spark.rapids.shuffle.heartbeat.timeoutMs); this is the "
+         "backstop for a live-but-stuck peer.") \
+    .create_with_default(30000)
+
+SHUFFLE_SLICE_VIEWS = conf(
+    "spark.rapids.tpu.shuffle.sliceViews").boolean() \
+    .doc("Map-output slicing strategy.  On: each map batch is sorted by "
+         "target partition once and registered as ONE spillable block; "
+         "per-reduce-partition blocks are row-range views sliced lazily "
+         "at first read — the write path stages each batch's bytes once "
+         "instead of once per reduce partition.  Off: eager per-"
+         "partition gather copies at write time (the pre-slice-view "
+         "behavior).") \
+    .create_with_default(True)
+
 # --- io -------------------------------------------------------------------
 
 PARQUET_ENABLED = conf("spark.rapids.sql.format.parquet.enabled").boolean() \

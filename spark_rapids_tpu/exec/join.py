@@ -367,12 +367,11 @@ class HashJoinExec(Exec):
                                    self.output_names)
         return out, sizes[0] <= np.int64(out_cap)
 
-    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
-        from .. import config as cfg
+    def _collect_build(self, pid, ctx) -> Batch:
+        """Materialize the build side as ONE device batch: this
+        partition's co-clustered shard when colocated, the whole right
+        side otherwise."""
         xp = self.xp
-        on_tpu = self.placement == TPU
-        speculate = (on_tpu and ctx.speculation_enabled and
-                     ctx.conf.get(cfg.JOIN_SPECULATIVE_SIZING))
         right = self.children[1]
         build_batches = []
         if self.colocated:
@@ -388,9 +387,17 @@ class HashJoinExec(Exec):
                 {n: pa.array([], type=f.type)
                  for n, f in zip(schema.names, schema)})
             build_batches = [batch_to_device(rb, xp=xp)]
-        build = concat_batches(xp, build_batches, right.output_names,
-                               right.output_types) \
+        return concat_batches(xp, build_batches, right.output_names,
+                              right.output_types) \
             if len(build_batches) > 1 else build_batches[0]
+
+    def execute_partition(self, pid, ctx) -> Iterator[Batch]:
+        from .. import config as cfg
+        xp = self.xp
+        on_tpu = self.placement == TPU
+        speculate = (on_tpu and ctx.speculation_enabled and
+                     ctx.conf.get(cfg.JOIN_SPECULATIVE_SIZING))
+        build = self._collect_build(pid, ctx)
         matched_acc = None
         for probe in self.children[0].execute_partition(pid, ctx):
             if speculate and self._spec_supported(build, probe):
@@ -487,6 +494,62 @@ class HashJoinExec(Exec):
             out = self._unmatched_build(xp, build, matched_acc)
             if int(out.num_rows):
                 yield out
+
+
+class ShuffledHashJoinExec(HashJoinExec):
+    """Co-partitioned hash join over spill-backed shuffle catalog
+    partitions (ref GpuShuffledHashJoinExec.scala).
+
+    Both children are hash-exchanged on the join keys (declared via
+    ``CoClusteredContract``), so partition ``pid`` joins ONLY its own
+    shard on each side — the build side is one catalog partition, not
+    the whole table, which is what lets joins scale past single-device
+    memory: the exchanged blocks are spill-managed (DEVICE->HOST->DISK),
+    and the build materialization retries under synchronous spill when
+    concatenating a shard would overflow HBM.  On a mesh, this node
+    rewrites further into IciJoinExec (in-shard all_to_all); this class
+    is the single-host / DCN realization."""
+
+    def __init__(self, left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression], how: str,
+                 condition: Optional[Expression],
+                 left: Exec, right: Exec, colocated: bool = True):
+        # co-partitioning is this node's reason to exist
+        super().__init__(left_keys, right_keys, how, condition, left,
+                         right, colocated=True)
+
+    def describe(self):
+        ks = ", ".join(f"{a.sql()}={b.sql()}"
+                       for a, b in zip(self.left_keys, self.right_keys))
+        return f"ShuffledHashJoin {self.how} on [{ks}]"
+
+    def memory_effects(self, child_states, conf):
+        """One co-clustered shard per side is live at a time; the rest
+        of both exchanged datasets is shuffle retention already modeled
+        (and spill-bounded) by the exchange children.  The shard's
+        concat + expand still holds raw device bytes, so the bound keeps
+        the parent's 2x-build + probe + output shape — over one
+        partition, not the whole build side."""
+        from ..analysis.lifetime import (MemoryEffects,
+                                         padded_partition_bytes)
+        if len(child_states) < 2:
+            return None
+        build = padded_partition_bytes(child_states[1])
+        probe = padded_partition_bytes(child_states[0])
+        return MemoryEffects(
+            hold=2.0 * build + 2.0 * probe + build,
+            note="co-partitioned spill-backed build shard")
+
+    def _collect_build(self, pid, ctx) -> Batch:
+        """Materialize this partition's build shard under OOM-retry:
+        running out of device memory synchronously spills lower-priority
+        registrations (shuffle blocks first) and tries again, instead of
+        failing the join."""
+        from ..memory.spill import SpillCatalog, with_retry_spill
+        return with_retry_spill(
+            lambda: super(ShuffledHashJoinExec, self)._collect_build(
+                pid, ctx),
+            SpillCatalog.get())
 
 
 class NestedLoopJoinExec(Exec):
@@ -857,13 +920,19 @@ def plan_join(lp, left: Exec, right: Exec, conf) -> Exec:
         raise NotImplementedError(
             f"non-equi {how} join is not supported yet")
 
-    # ---- equi joins: broadcast-hash vs shuffled-hash
+    # ---- equi joins: broadcast-hash vs shuffled-hash.  The bridge pins
+    # oversized-build joins to the shuffled path (force_shuffled): their
+    # build side exceeded the broadcast/collect threshold, so the only
+    # scalable plan is co-partitioning both sides through the
+    # spill-backed shuffle catalog.
+    force_shuffled = bool(getattr(lp, "force_shuffled", False))
     colocated = False
-    if multi and threshold >= 0 and rsz is not None and rsz <= threshold \
+    if multi and not force_shuffled and threshold >= 0 \
+            and rsz is not None and rsz <= threshold \
             and how in ("inner", "left", "left_semi", "left_anti", "cross"):
         from .broadcast import BroadcastExchangeExec
         right = BroadcastExchangeExec(right)
-    elif multi:
+    elif multi or force_shuffled:
         # shuffled hash join: co-partition both sides on the join keys
         from ..shuffle.exchange import ShuffleExchangeExec
         from ..shuffle.partitioning import HashPartitioning

@@ -195,6 +195,17 @@ def _as_string_column(ctx: EvalContext, v, dtype) -> ColumnValue:
     xp = ctx.xp
     if isinstance(v, ColumnValue):
         return v
+    if hasattr(v.value, "shape"):
+        # ParamLiteral string: traced uint8 chars — tile on device
+        # (length is static, it rides the jit key via the array shape)
+        arr = xp.asarray(v.value, dtype=xp.uint8)
+        ln = int(arr.shape[0])
+        cap = ctx.capacity
+        return ColumnValue(DeviceColumn(
+            dtype,
+            data=xp.tile(arr, cap) if ln else xp.zeros((1,), xp.uint8),
+            offsets=xp.arange(cap + 1, dtype=xp.int32) * xp.int32(ln),
+            validity=xp.ones((cap,), dtype=bool)))
     s = v.value if isinstance(v.value, bytes) else (
         v.value.encode() if isinstance(v.value, str) else None)
     cap = ctx.capacity

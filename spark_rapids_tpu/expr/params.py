@@ -13,12 +13,18 @@ only (slot, dtype) and the two queries dispatch to one executable.
 Safety rules (wrong sharing is silently wrong results, so the pass is
 deliberately conservative):
 
-* only literals under whitelisted parents (plain comparisons and
-  +/-/* arithmetic) are hoisted — those evaluators are pure array math
-  with no host-side branching on the scalar's VALUE.  Divide/Pmod and
-  friends stay value-keyed (zero-divisor handling), as do string /
-  decimal / boolean literals (host-side key derivation, scale logic and
-  ``bool()`` coercion all concretize the value).
+* only literals under whitelisted parents (plain comparisons, +/-/*
+  arithmetic, If/CaseWhen value arms and IN item lists) are hoisted —
+  those evaluators are pure array math with no host-side branching on
+  the scalar's VALUE.  Divide/Pmod and friends stay value-keyed
+  (zero-divisor handling), as do decimal / boolean literals (scale
+  logic and ``bool()`` coercion concretize the value).
+* string literals hoist as uint8 char arrays whose BYTE LENGTH stays
+  in the jit key (array shape is static under tracing anyway); the
+  string evaluators reachable from the whitelisted parents derive
+  hashes / order keys / broadcast columns on DEVICE from the traced
+  chars, so only same-length strings share a program — `'abc' = s`
+  and `'xyz' = s` dispatch to one executable.
 * non-null values only: null literals flow through evaluator validity
   short-circuits that branch on ``is_null``.
 * a parameterized tree may key a jit entry ONLY where the parameter
@@ -43,12 +49,17 @@ from .core import (EvalContext, Expression, LeafExpression, Literal,
 # parents whose evaluators treat both operands as opaque array operands
 # (promote + cast + xp op): safe to feed a traced scalar
 from .arithmetic import Add, Multiply, Subtract
+from .conditional import CaseWhen, If
 from .predicates import (EqualNullSafe, EqualTo, GreaterThan,
-                         GreaterThanOrEqual, LessThan, LessThanOrEqual)
+                         GreaterThanOrEqual, In, LessThan,
+                         LessThanOrEqual)
 
 PARAM_PARENTS = (EqualTo, EqualNullSafe, LessThan, LessThanOrEqual,
                  GreaterThan, GreaterThanOrEqual,
-                 Add, Subtract, Multiply)
+                 Add, Subtract, Multiply,
+                 # value arms blend via _value_parts / _string_select
+                 # (xp.full / device gather — no host branching)
+                 If, CaseWhen)
 
 # value domains whose evaluators never concretize the scalar: fixed-
 # width numerics and the day/microsecond integer encodings
@@ -77,6 +88,11 @@ class ParamLiteral(LeafExpression):
         return False
 
     def _semantic_sig_(self):
+        if isinstance(self.dtype, t.StringType):
+            # byte length stays in the key: the chars ride as a traced
+            # uint8 array whose (static) shape is the length anyway
+            return ("ParamLiteral", self.slot, repr(self.dtype),
+                    len(self.value))
         return ("ParamLiteral", self.slot, repr(self.dtype))
 
     def sql(self):
@@ -92,13 +108,22 @@ def _eval_param_literal(e: ParamLiteral, ctx: EvalContext):
 
 
 def _eligible(lit: Expression) -> bool:
-    return (type(lit) is Literal and lit.value is not None
-            and isinstance(lit.dtype, _PARAM_DTYPES))
+    if type(lit) is not Literal or lit.value is None:
+        return False
+    if isinstance(lit.dtype, _PARAM_DTYPES):
+        return True
+    # strings hoist as char arrays (empty strings stay baked: a
+    # zero-length traced operand buys nothing and the string kernels
+    # assume at least one char of backing data)
+    return isinstance(lit.dtype, t.StringType) and len(lit.value) > 0
 
 
-def _np_param(lit: Literal):
+def _np_param(lit):
     """The slot's call-time value: an np scalar typed from the literal's
-    DataType so the jit dispatch signature is value-independent."""
+    DataType (strings: the utf-8 chars as a uint8 array) so the jit
+    dispatch signature is value-independent."""
+    if isinstance(lit.dtype, t.StringType):
+        return np.frombuffer(lit.value, dtype=np.uint8)
     return np.dtype(t.to_np_dtype(lit.dtype)).type(lit.value)
 
 
@@ -114,7 +139,25 @@ def _rewrite(e: Expression, values: List) -> Expression:
             nc = _rewrite(c, values)
         changed |= nc is not c
         new_children.append(nc)
-    return e.with_children(new_children) if changed else e
+    node = e.with_children(new_children) if changed else e
+    if isinstance(e, In):
+        # item literals ride `items`, not `children` — _eval_in's per-
+        # item compare is the same promote+cast array math as the
+        # binary comparisons, so they hoist under the same rules
+        new_items, items_changed = [], False
+        for it in e.items:
+            if _eligible(it):
+                values.append(_np_param(it))
+                new_items.append(ParamLiteral(len(values) - 1,
+                                              it.dtype, it.value))
+                items_changed = True
+            else:
+                new_items.append(it)
+        if items_changed:
+            if node is e:
+                node = e.with_children(list(e.children))
+            node.items = tuple(new_items)
+    return node
 
 
 def parameterize_exprs(bound: Sequence[Expression]
@@ -137,8 +180,17 @@ def param_values(trees: Sequence[Expression]) -> Tuple:
     """Re-derive the call-time parameter tuple from rewritten trees
     (slot order is the collection order of `parameterize_exprs`)."""
     lits: List[ParamLiteral] = []
+
+    def visit(e: Expression):
+        if isinstance(e, ParamLiteral):
+            lits.append(e)
+        for c in e.children:
+            visit(c)
+        # In keeps its literal list OUTSIDE children
+        for it in getattr(e, "items", ()):
+            visit(it)
+
     for b in trees:
-        lits += b.collect(lambda e: isinstance(e, ParamLiteral))
+        visit(b)
     lits.sort(key=lambda p: p.slot)
-    return tuple(np.dtype(t.to_np_dtype(p.dtype)).type(p.value)
-                 for p in lits)
+    return tuple(_np_param(p) for p in lits)

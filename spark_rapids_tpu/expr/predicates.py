@@ -108,17 +108,32 @@ def _float_like(dt):
     return isinstance(dt, (t.FloatType, t.DoubleType))
 
 
+def _param_chars(xp, value):
+    """A hoisted string parameter's traced uint8 chars as a 1-string
+    column (offsets [0, len]); len is static (array shape)."""
+    arr = xp.asarray(value, dtype=xp.uint8)
+    offs = xp.asarray(np.array([0, int(arr.shape[0])], dtype=np.int32))
+    return offs, arr
+
+
 def _string_eq_data(ctx: EvalContext, lv: Value, rv: Value):
     xp = ctx.xp
     if isinstance(lv, ColumnValue) and isinstance(rv, ColumnValue):
         return sops.string_eq(xp, lv.col.offsets, lv.col.data,
                               rv.col.offsets, rv.col.data)
     col, scalar = (lv, rv) if isinstance(lv, ColumnValue) else (rv, lv)
+    c1, c2 = sops.string_hashes(xp, col.col.offsets, col.col.data)
+    lens = sops.lengths(xp, col.col.offsets)
+    if hasattr(scalar.value, "shape"):
+        # ParamLiteral string: chars are a traced array, so the hashes
+        # must come from the device kernel, not host-side key derivation
+        offs, arr = _param_chars(xp, scalar.value)
+        s1, s2 = sops.string_hashes(xp, offs, arr)
+        ln = np.int32(int(arr.shape[0]))
+        return (lens == ln) & (c1 == s1[0]) & (c2 == s2[0])
     sval = scalar.value if isinstance(scalar.value, bytes) else \
         (scalar.value or b"")
     _, h1, h2, ln = scalar_string_keys(sval)
-    c1, c2 = sops.string_hashes(xp, col.col.offsets, col.col.data)
-    lens = sops.lengths(xp, col.col.offsets)
     return (lens == ln) & (c1 == h1) & (c2 == h2)
 
 
@@ -130,6 +145,10 @@ def _string_order_lt(ctx: EvalContext, lv: Value, rv: Value, or_equal: bool):
         if isinstance(v, ColumnValue):
             cols = sops.order_keys(xp, v.col.offsets, v.col.data)
             return cols
+        if hasattr(v.value, "shape"):  # ParamLiteral string (traced)
+            offs, arr = _param_chars(xp, v.value)
+            cols = sops.order_keys(xp, offs, arr)
+            return [xp.broadcast_to(c, (ctx.capacity,)) for c in cols]
         words, _, _, ln = scalar_string_keys(
             v.value if isinstance(v.value, bytes) else b"")
         return [xp.full((ctx.capacity,), w, dtype=xp.uint64) for w in words] + \
@@ -410,12 +429,15 @@ def _eval_in(e: In, ctx: EvalContext):
     for item in e.items:
         if item.value is None:
             continue
+        # eval (not .value): a ParamLiteral item resolves to the traced
+        # call-time scalar when params are bound
+        iv = item.eval(ctx)
         if _is_string(dt):
-            eq = _string_eq_data(ctx, v, ScalarValue(item.value, t.STRING))
+            eq = _string_eq_data(ctx, v, iv)
         else:
             common = promote(dt, item.dtype)
             ld = cast_data(ctx, data_of(v, ctx), dt, common)
-            rd = cast_data(ctx, item.value, item.dtype, common)
+            rd = cast_data(ctx, iv.value, item.dtype, common)
             eq = ld == rd
         matched = matched | eq
     if val is None:

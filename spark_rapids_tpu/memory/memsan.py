@@ -99,6 +99,14 @@ class ShadowLedger:
                     return
                 entry = LedgerEntry(handle_id, kind, _owning_exec())
                 self.entries[handle_id] = entry
+            if event == ALLOC and entry.state == CLOSED and \
+                    entry.history and entry.history[-1] == EVICT:
+                # re-admission of an evicted pin-cache entry: the
+                # deterministic pin handle id reuses the slot, so this
+                # ALLOC starts a NEW lifecycle (eviction is the
+                # catalog's doing, not the owner's — unlike an explicit
+                # close, after which alloc stays illegal)
+                entry.state = UNBORN
             nxt = lifecycle_next(entry.state, event)
             entry.history.append(event)
             if nxt is None:

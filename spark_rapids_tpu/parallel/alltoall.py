@@ -208,21 +208,38 @@ def _string_receive(recv_chars, recv_len, ord2, n_parts: int, slot: int):
 
 
 def exchange_by_pid(batch: DeviceBatch, pids, n_parts: int, axis_name: str,
-                    slot: Optional[int] = None) -> DeviceBatch:
+                    slot: Optional[int] = None,
+                    on_overflow: str = "error"):
     """Redistribute rows so the device at mesh position ``p`` along
     ``axis_name`` receives every row with ``pids == p``.
 
     Must be called inside ``shard_map`` over a mesh with that axis (size
     ``n_parts``).  Returns a batch of capacity ``n_parts * slot``.
-    """
+
+    The send tensors are ``[n_parts, slot]`` — ``n_parts`` times the
+    per-peer budget — so ``slot`` is the exchange's memory knob.  With
+    the default ``on_overflow='error'``, ``slot < capacity`` is refused
+    up front: a skewed destination would silently drop rows.  With
+    ``on_overflow='guard'`` a sub-capacity slot is admitted and the
+    return becomes ``(batch, ok)`` where ``ok`` is this shard's
+    device-side bool that NO destination overflowed its budget — the
+    speculative-sizing pattern (exec/join.py's deferred guard): the
+    caller checks every shard's guard after the fetch and re-runs with
+    ``slot=capacity`` on a miss, paying hash-shard-balanced joins
+    ~``slot/capacity`` of the full exchange footprint."""
     cap = batch.capacity
-    if slot is not None and slot < cap:
+    guarded = on_overflow == "guard"
+    if on_overflow not in ("error", "guard"):
+        raise ValueError(f"on_overflow={on_overflow!r}: "
+                         f"expected 'error' or 'guard'")
+    if slot is not None and slot < cap and not guarded:
         # a per-peer budget below the local capacity can silently drop rows
         # when one destination receives more than `slot` of them; there is
         # no in-graph way to signal that, so refuse up front
         raise ValueError(
             f"slot={slot} < capacity={cap}: a skewed partition could "
-            f"overflow the per-peer budget; use slot >= capacity")
+            f"overflow the per-peer budget; use slot >= capacity "
+            f"(or on_overflow='guard')")
     slot = slot or cap
     live = batch.row_mask()
     pid_key = jnp.where(live, pids.astype(jnp.int32), n_parts)
@@ -293,8 +310,13 @@ def exchange_by_pid(batch: DeviceBatch, pids, n_parts: int, axis_name: str,
             new_col.data_hi = jnp.where(out_live, hi, jnp.zeros_like(hi))
         return new_col
 
-    return DeviceBatch([move(c) for c in batch.columns], out_total,
-                       batch.names)
+    out = DeviceBatch([move(c) for c in batch.columns], out_total,
+                      batch.names)
+    if guarded:
+        # no destination held more rows than its send budget (checked on
+        # the send side, where the drop would happen)
+        return out, jnp.all(counts <= jnp.int32(slot))
+    return out
 
 
 def allgather_batch(batch: DeviceBatch, axis_name: str,

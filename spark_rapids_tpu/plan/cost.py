@@ -77,8 +77,9 @@ def estimate_rows(node: eb.Exec, child_rows: List[float]) -> float:
         return float(DEFAULT_ROW_COUNT)
     if name in ("UnionExec",):
         return sum(child_rows)
-    if name in ("HashJoinExec", "CpuJoinExec", "BroadcastHashJoinExec",
-                "NestedLoopJoinExec", "BroadcastNestedLoopJoinExec"):
+    if name in ("HashJoinExec", "ShuffledHashJoinExec", "CpuJoinExec",
+                "BroadcastHashJoinExec", "NestedLoopJoinExec",
+                "BroadcastNestedLoopJoinExec"):
         return max(child_rows)
     return child_rows[0] * _CARDINALITY.get(name, 1.0)
 

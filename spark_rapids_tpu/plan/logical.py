@@ -130,11 +130,16 @@ class Aggregate(LogicalPlan):
 class Join(LogicalPlan):
     def __init__(self, left: LogicalPlan, right: LogicalPlan,
                  how: str, condition: Optional[Expression] = None,
-                 using: Optional[List[str]] = None):
+                 using: Optional[List[str]] = None,
+                 force_shuffled: bool = False):
         self.children = (left, right)
         self.how = how  # inner, left, right, full, left_semi, left_anti, cross
         self.condition = condition
         self.using = using
+        # planner pin from the bridge: a build side past the broadcast/
+        # collect threshold must take the spill-backed shuffled path,
+        # never broadcast (ref the retired maxBuildSideBytes gate)
+        self.force_shuffled = force_shuffled
 
     def schema(self):
         ln, lt = self.children[0].schema()

@@ -74,7 +74,7 @@ expr_rule(Literal, T.all_types, "literal values", _tag_literal)
 
 from ..expr.params import ParamLiteral  # noqa: E402 (needs Literal)
 
-expr_rule(ParamLiteral, _num + T.DATE + T.TIMESTAMP,
+expr_rule(ParamLiteral, _num + T.DATE + T.TIMESTAMP + T.STRING,
           "parameterized literal (hoisted out of the jit key so "
           "literal-only query twins share compiled programs)")
 expr_rule(Alias, T.all_types.nested(), "named expression")
@@ -644,13 +644,15 @@ EXEC_SIGS: Dict[Type[eb.Exec], TypeSig] = {
 
 from ..exec.broadcast import (BroadcastExchangeExec, BroadcastHashJoinExec,
                               BroadcastNestedLoopJoinExec)
-from ..exec.join import CpuJoinExec, HashJoinExec, NestedLoopJoinExec
+from ..exec.join import (CpuJoinExec, HashJoinExec, NestedLoopJoinExec,
+                         ShuffledHashJoinExec)
 from ..exec.sort import SortExec
 
 EXEC_SIGS[SortExec] = T.common_scalar.nested()
 EXEC_SIGS[CpuJoinExec] = _exec_common
 EXEC_SIGS[NestedLoopJoinExec] = _exec_common
 EXEC_SIGS[HashJoinExec] = _exec_common
+EXEC_SIGS[ShuffledHashJoinExec] = _exec_common
 EXEC_SIGS[BroadcastExchangeExec] = _exec_common
 EXEC_SIGS[BroadcastHashJoinExec] = _exec_common
 EXEC_SIGS[BroadcastNestedLoopJoinExec] = _exec_common
@@ -713,8 +715,14 @@ def _convert_join(e: "CpuJoinExec", conf) -> eb.Exec:
             g.placement = left.placement
             left = CoalesceBatchesExec(g)
             left.placement = g.placement
-    cls = BroadcastHashJoinExec \
-        if isinstance(right, BroadcastExchangeExec) else HashJoinExec
+    if isinstance(right, BroadcastExchangeExec):
+        cls = BroadcastHashJoinExec
+    elif colocated:
+        # both sides hash-exchanged on the keys: the co-partitioned
+        # spill-backed path (build = one catalog shard, not the table)
+        cls = ShuffledHashJoinExec
+    else:
+        cls = HashJoinExec
     j = cls(e.left_keys, e.right_keys, e.how, e.condition,
             left, right, colocated=colocated)
     j.placement = eb.TPU
@@ -973,6 +981,14 @@ def insert_transitions(root: eb.Exec) -> eb.Exec:
         return node
 
     root = fix(root)
+    # fix() clones every node, and the num_partitions probe below can
+    # EXECUTE the plan (an AQE reader materializes its map stage to size
+    # its specs) — so replicated build readers must be re-pointed at the
+    # cloned probe partner HERE, not only after insert_transitions
+    # returns, or the stale partner shuffles the probe side a second
+    # time and leaks every block it writes.
+    from ..shuffle.aqe import relink_replicated_readers
+    root = relink_replicated_readers(root)
     if root.placement == eb.TPU:
         # collect boundary: funnel every partition's device batches into
         # ONE device-side concat before crossing to host — each fetch
@@ -1054,6 +1070,10 @@ class TpuOverrides:
                 self.last_explain += "\n" + lint_text
                 if explain_mode != "NONE":
                     print(lint_text, end="")
-        from ..shuffle.aqe import install_aqe_readers
+        from ..shuffle.aqe import (install_aqe_readers,
+                                   relink_replicated_readers)
         converted = install_aqe_readers(converted, self.conf)
-        return insert_transitions(converted)
+        # transition insertion clones nodes, so this must run LAST or a
+        # replicated build reader keeps a stale pre-clone partner (which
+        # re-shuffles the probe side and leaks the blocks)
+        return relink_replicated_readers(insert_transitions(converted))

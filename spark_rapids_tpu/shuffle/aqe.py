@@ -146,8 +146,30 @@ class AQEShuffleReadExec(Exec):
 
     # -- spec computation ---------------------------------------------------
     def _materialize(self):
+        from ..exec.base import SpeculativeSizingMiss
         ctx = ExecContext(self.conf)
         self.exchange._ensure_written(ctx)
+        try:
+            ctx.verify_spec_guards()
+        except SpeculativeSizingMiss:
+            # The map stage ran under this PRIVATE context, so its
+            # guards never reach the session's speculation-retry: a
+            # speculative join feeding this exchange undershot and the
+            # catalog now holds TRUNCATED blocks.  Heal locally — drop
+            # the bad shuffle and rewrite it exactly, no speculation.
+            from ..obs import metrics as m
+            m.counter("tpu_shuffle_map_rewrites_total",
+                      "map stages rewritten after a speculation guard "
+                      "failed under the exchange's private context").inc()
+            with self.exchange._write_lock:
+                sid = self.exchange._shuffle_id
+                self.exchange._shuffle_id = None
+            if sid is not None:
+                TpuShuffleManager.get().unregister(sid)
+            ctx = ExecContext(self.conf)
+            ctx.task_context["no_speculation"] = True
+            self.exchange._ensure_written(ctx)
+            ctx.verify_spec_guards()
 
     def specs(self) -> List[PartitionSpec]:
         with self._lock:
@@ -176,8 +198,8 @@ class AQEShuffleReadExec(Exec):
 
     # -- read ---------------------------------------------------------------
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
-        from ..memory.spill import SpillableBatch
         from ..io.scan import set_current_input_file
+        from .manager import materialize_block
         spec = self.specs()[pid]
         self.exchange._ensure_written(ctx)
         # no "current file" past an exchange (ref InputFileBlockRule.scala)
@@ -185,6 +207,9 @@ class AQEShuffleReadExec(Exec):
         mgr = TpuShuffleManager.get()
         sid = self.exchange._shuffle_id
         xp = self.xp
+        from ..obs import metrics as m
+        read_batches = m.counter("tpu_shuffle_read_batches_total",
+                                 "reduce-side blocks read back")
         for rid in spec.reduce_ids:
             blocks = mgr.catalog.blocks_for_reduce(sid, rid)
             if spec.block_slice is not None:
@@ -192,10 +217,10 @@ class AQEShuffleReadExec(Exec):
                 blocks = blocks[lo:hi]
             for blk in blocks:
                 for b in mgr.catalog.get(blk):
-                    if isinstance(b, SpillableBatch):
-                        b = b.get_batch(xp)
+                    b = materialize_block(b, xp)
                     self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
                     self.metrics[NUM_OUTPUT_BATCHES] += 1
+                    read_batches.inc()
                     yield b
 
 
@@ -237,6 +262,37 @@ def install_aqe_readers(root: Exec, conf: cfg.RapidsConf) -> Exec:
         return node.with_new_children(new_kids) if changed else node
 
     return rewrite(root)
+
+
+def relink_replicated_readers(root: Exec) -> Exec:
+    """Repair ``replicate_for`` after plan surgery.  Passes downstream of
+    install_aqe_readers (transition insertion, any with_new_children
+    rewrite) clone nodes, so a build-side reader's ``replicate_for`` can
+    end up pointing at the PRE-clone probe reader — whose exchange is an
+    orphan that would shuffle the probe side a second time at execution
+    and leak every block it writes (nothing in the final plan owns its
+    shuffle id).  Re-point it at the probe reader actually in the tree."""
+    from ..exec.base import DeviceToHostExec, HostToDeviceExec
+    from ..exec.join import HashJoinExec
+
+    def unwrap(node: Exec) -> Exec:
+        while isinstance(node, (DeviceToHostExec, HostToDeviceExec)) \
+                and node.children:
+            node = node.children[0]
+        return node
+
+    def fix(node: Exec) -> None:
+        if isinstance(node, HashJoinExec) and len(node.children) == 2:
+            l, r = (unwrap(c) for c in node.children)
+            if isinstance(l, AQEShuffleReadExec) and \
+                    isinstance(r, AQEShuffleReadExec) and \
+                    r.replicate_for is not None and r.replicate_for is not l:
+                r.replicate_for = l
+        for c in node.children:
+            fix(c)
+
+    fix(root)
+    return root
 
 
 class _SkewAwareRead(AQEShuffleReadExec):

@@ -12,5 +12,35 @@ class TpuShuffleFetchFailedError(TpuShuffleError):
     map stage (lineage recompute model, same as the reference)."""
 
 
-class TpuShuffleTimeoutError(TpuShuffleFetchFailedError):
-    pass
+class TpuShuffleTimeoutError(TpuShuffleFetchFailedError, TimeoutError):
+    """A fetch exceeded its deadline while the peer still looked alive
+    (heartbeat expiry covers the dead-peer case).  Also a builtin
+    TimeoutError so pre-typed callers keep catching it."""
+
+
+class TpuShufflePeerDeadError(TpuShuffleFetchFailedError):
+    """The serving peer was declared dead by the heartbeat manager.
+
+    Raised instead of letting the socket time out: liveness is decided
+    by heartbeat expiry (shuffle/heartbeat.py), so the fetch fails fast
+    and carries the peer identity for the retry scheduler."""
+
+    def __init__(self, peer_id: str, detail: str = ""):
+        self.peer_id = peer_id
+        msg = f"shuffle peer {peer_id!r} declared dead by heartbeat"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+class TpuShuffleTruncatedFrameError(TpuShuffleFetchFailedError):
+    """The connection closed mid-frame: some bytes of a frame arrived
+    but not all of them.  Distinct from a clean close so callers can
+    tell a half-written transfer from an idle disconnect."""
+
+    def __init__(self, expected: int, got: int, what: str = "frame"):
+        self.expected = expected
+        self.got = got
+        super().__init__(
+            f"truncated shuffle {what}: expected {expected} bytes, "
+            f"got {got}")

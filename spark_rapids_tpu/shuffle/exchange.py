@@ -24,7 +24,7 @@ from ..exec.base import (NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS, OP_TIME, TPU,
                          Batch, Exec, ExecContext, MetricTimer, process_jit,
                          schema_sig, semantic_sig)
 from ..exec.concat import concat_batches
-from .manager import TpuShuffleManager
+from .manager import TpuShuffleManager, materialize_block, slice_rows
 from .partitioning import Partitioning, slice_batch_by_partition
 
 
@@ -128,30 +128,57 @@ class ShuffleExchangeExec(Exec):
             all_counts = np.stack([np.asarray(c)
                                    for _, _, c in staged]) \
                 if staged else np.zeros((0, self.num_partitions))
-        per_map: Dict[int, Dict[int, List[Batch]]] = {}
-        with MetricTimer(self.metrics[OP_TIME]):
-            for (map_id, sorted_b, _), counts_host in zip(staged,
-                                                          all_counts):
-                slices = per_map.setdefault(map_id, {})
-                start = 0
-                for pid_out in range(self.num_partitions):
-                    n = int(counts_host[pid_out])
-                    if n == 0:
-                        continue
-                    piece = _slice_rows(xp, sorted_b, start, n)
-                    slices.setdefault(pid_out, []).append(piece)
-                    start += n
-        for map_id in range(child.num_partitions):
-            slices = per_map.get(map_id, {})
-            merged = {}
-            for pid_out, parts in slices.items():
-                merged[pid_out] = parts[0] if len(parts) == 1 else \
-                    concat_batches(xp, parts, self.output_names,
-                                   self.output_types)
-            mgr.write_map_output(shuffle_id, map_id, merged)
+        from .. import config as cfg
+        from ..memory.spill import batch_device_bytes
+        slice_views = ctx.conf.get(cfg.SHUFFLE_SLICE_VIEWS)
+        saved_bytes = 0
+        if slice_views:
+            # one pass per batch: the sorted batch registers ONCE as a
+            # shared spillable block; each reduce partition gets a lazy
+            # (start, n) view instead of an eager padded gather copy
+            from ..columnar.device import DEFAULT_ROW_BUCKETS, bucket_for
+            with MetricTimer(self.metrics[OP_TIME]):
+                for (map_id, sorted_b, _), counts_host in zip(staged,
+                                                              all_counts):
+                    layout = []
+                    start = 0
+                    for pid_out in range(self.num_partitions):
+                        n = int(counts_host[pid_out])
+                        if n:
+                            layout.append((pid_out, start, n))
+                        start += n
+                    mgr.write_map_output_sorted(shuffle_id, map_id,
+                                                sorted_b, layout)
+                    whole = batch_device_bytes(sorted_b)
+                    bpr = whole / max(int(sorted_b.capacity), 1)
+                    eager = sum(
+                        bpr * bucket_for(max(n, 1), DEFAULT_ROW_BUCKETS)
+                        for _, _, n in layout)
+                    saved_bytes += max(0, int(eager - whole))
+        else:
+            per_map: Dict[int, Dict[int, List[Batch]]] = {}
+            with MetricTimer(self.metrics[OP_TIME]):
+                for (map_id, sorted_b, _), counts_host in zip(staged,
+                                                              all_counts):
+                    slices = per_map.setdefault(map_id, {})
+                    start = 0
+                    for pid_out in range(self.num_partitions):
+                        n = int(counts_host[pid_out])
+                        if n == 0:
+                            continue
+                        piece = _slice_rows(xp, sorted_b, start, n)
+                        slices.setdefault(pid_out, []).append(piece)
+                        start += n
+            for map_id in range(child.num_partitions):
+                slices = per_map.get(map_id, {})
+                merged = {}
+                for pid_out, parts in slices.items():
+                    merged[pid_out] = parts[0] if len(parts) == 1 else \
+                        concat_batches(xp, parts, self.output_names,
+                                       self.output_types)
+                mgr.write_map_output(shuffle_id, map_id, merged)
         from ..obs import metrics as m
         if obs_sp or m.enabled():
-            from ..memory.spill import batch_device_bytes
             total = sum(batch_device_bytes(b) for _, b, _ in staged)
             if obs_sp:
                 obs_sp.set(shuffle_id=shuffle_id, blocks=len(staged),
@@ -161,10 +188,15 @@ class ShuffleExchangeExec(Exec):
                 .inc(total)
             m.counter("tpu_shuffle_write_blocks_total",
                       "map-output blocks written").inc(len(staged))
+            if slice_views:
+                m.counter(
+                    "tpu_shuffle_write_saved_bytes_total",
+                    "device bytes NOT re-staged by the one-pass "
+                    "slice-view map write (vs eager per-partition "
+                    "gather copies)").inc(saved_bytes)
         self._shuffle_id = shuffle_id
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
-        from ..memory.spill import SpillableBatch
         from ..io.scan import set_current_input_file
         self._ensure_written(ctx)
         # past an exchange there is no "current file" (Spark's
@@ -176,22 +208,12 @@ class ShuffleExchangeExec(Exec):
         read_batches = m.counter("tpu_shuffle_read_batches_total",
                                  "reduce-side blocks read back")
         for b in mgr.read_partition(self._shuffle_id, pid):
-            if isinstance(b, SpillableBatch):
-                b = b.get_batch(xp)
+            b = materialize_block(b, xp)
             self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
             self.metrics[NUM_OUTPUT_BATCHES] += 1
             read_batches.inc()
             yield b
 
 
-def _slice_rows(xp, batch: DeviceBatch, start: int, n: int) -> DeviceBatch:
-    """Host-driven row-range slice of a (sorted) batch; keeps buffers on
-    device via gather."""
-    from ..columnar.device import DEFAULT_ROW_BUCKETS, bucket_for
-    from ..ops.gather import gather_batch
-    cap = bucket_for(max(n, 1), DEFAULT_ROW_BUCKETS)
-    idx = xp.arange(cap, dtype=np.int32) + np.int32(start)
-    idx = xp.clip(idx, 0, batch.capacity - 1)
-    valid = xp.arange(cap, dtype=np.int32) < n
-    out = gather_batch(xp, batch, idx, valid, n)
-    return DeviceBatch(out.columns, n, batch.names)
+# row-range slicing now lives next to the catalog's slice views
+_slice_rows = slice_rows

@@ -1,20 +1,34 @@
-"""Shuffle manager + buffer catalog.
+"""Shuffle manager + spill-backed buffer catalog.
 
 Ref: RapidsShuffleInternalManagerBase.scala:74-462 (caching writer keeps
 batches in device memory, no row serialization; reader serves local blocks
 from the catalog zero-copy) and ShuffleBufferCatalog.scala.
 
-The TPU realization keeps each map task's partition slices as live device
-(or host) batches registered in a catalog keyed by
-(shuffle_id, map_id, reduce_id).  Spill integration: each stored batch is
-wrapped SpillableShuffleBuffer so the memory framework can demote it
-DEVICE->HOST->DISK under pressure (memory/spill.py)."""
+The TPU realization keeps each map task's partition slices registered in a
+catalog keyed by (shuffle_id, map_id, reduce_id).  The catalog is the
+single registration choke point into memory/spill.py: every stored block
+is a spill-managed handle (SpillableBatch or a row-range view over one),
+so the memory framework can demote shuffle retention DEVICE->HOST->DISK
+under pressure and the memsan ledger sees every byte the shuffle holds.
+
+Two block representations coexist:
+
+* whole blocks — one SpillableBatch per (map, reduce) pair (the eager
+  path, and everything arriving via ``write_map_output``);
+* slice views — ``ShuffleBlockSlice``: the map batch is sorted by target
+  partition ONCE and registered as ONE spillable buffer; each reduce
+  partition's block is a (start, num_rows) view that gathers its rows
+  lazily at first read.  The write path stages each batch's bytes once
+  instead of once per reduce partition (see ``write_map_output_sorted``).
+"""
 
 from __future__ import annotations
 
 import itertools
 import threading
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
 
 from ..columnar.device import DeviceBatch
 
@@ -26,17 +40,122 @@ class ShuffleBlockId(tuple):
         return super().__new__(cls, (shuffle_id, map_id, reduce_id))
 
 
+def slice_rows(xp, batch: DeviceBatch, start: int, n: int) -> DeviceBatch:
+    """Host-driven row-range slice of a (sorted) batch; keeps buffers on
+    device via gather."""
+    from ..columnar.device import DEFAULT_ROW_BUCKETS, bucket_for
+    from ..ops.gather import gather_batch
+    cap = bucket_for(max(n, 1), DEFAULT_ROW_BUCKETS)
+    idx = xp.arange(cap, dtype=np.int32) + np.int32(start)
+    idx = xp.clip(idx, 0, batch.capacity - 1)
+    valid = xp.arange(cap, dtype=np.int32) < n
+    out = gather_batch(xp, batch, idx, valid, n)
+    return DeviceBatch(out.columns, n, batch.names)
+
+
+def materialize_block(b, xp):
+    """Resolve any catalog block (SpillableBatch, ShuffleBlockSlice, or a
+    raw batch) to a concrete batch on ``xp``."""
+    get = getattr(b, "get_batch", None)
+    return get(xp) if get is not None else b
+
+
+class _SharedMapOutput:
+    """One sorted map-output batch shared by every slice view cut from
+    it; the spill registration closes when the last view releases."""
+
+    __slots__ = ("sb", "_refs", "_lock")
+
+    def __init__(self, sb, refs: int):
+        self.sb = sb  # SpillableBatch
+        self._refs = refs
+        self._lock = threading.Lock()
+
+    def get_batch(self, xp):
+        return self.sb.get_batch(xp)
+
+    @property
+    def device_bytes(self):
+        return getattr(self.sb, "device_bytes", 0)
+
+    def release(self):
+        with self._lock:
+            self._refs -= 1
+            last = self._refs <= 0
+        if last:
+            self.sb.close()
+
+
+class ShuffleBlockSlice:
+    """Row-range view over one shared sorted map-output batch.
+
+    Duck-types the SpillableBatch surface the shuffle readers use
+    (``get_batch``/``num_rows``/``device_bytes``/``close``) so it can sit
+    in the catalog next to whole blocks."""
+
+    __slots__ = ("_shared", "start", "num_rows", "_total_rows")
+
+    def __init__(self, shared: _SharedMapOutput, start: int, num_rows: int,
+                 total_rows: int):
+        self._shared = shared
+        self.start = start
+        self.num_rows = num_rows
+        self._total_rows = max(total_rows, 1)
+
+    def get_batch(self, xp) -> DeviceBatch:
+        base = self._shared.get_batch(xp)
+        return slice_rows(xp, base, self.start, self.num_rows)
+
+    @property
+    def device_bytes(self) -> int:
+        # proportional share of the shared buffer: exact enough for AQE
+        # partition statistics, and it sums to the buffer's real bytes
+        return int(self._shared.device_bytes
+                   * (self.num_rows / self._total_rows))
+
+    def close(self):
+        self._shared.release()
+
+
 class ShuffleBufferCatalog:
-    """Registry of shuffle buffers (ref ShuffleBufferCatalog.scala)."""
+    """Registry of shuffle buffers (ref ShuffleBufferCatalog.scala).
+
+    Registration choke point: ``add`` spill-registers raw device batches,
+    so nothing reaches the catalog outside the memory framework's view."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._buffers: Dict[ShuffleBlockId, List] = {}
-        self._bytes = 0
 
     def add(self, block: ShuffleBlockId, batch) -> None:
+        from ..memory.spill import SpillCatalog, SpillPriority
+        if isinstance(batch, DeviceBatch):
+            batch = SpillCatalog.get().register(batch,
+                                                SpillPriority.SHUFFLE)
         with self._lock:
             self._buffers.setdefault(block, []).append(batch)
+
+    def add_sliced(self, shuffle_id: int, map_id: int,
+                   sorted_batch: DeviceBatch,
+                   layout: Iterable[Tuple[int, int, int]]) -> None:
+        """Register ONE sorted map batch and cut per-reduce views from
+        it.  ``layout`` is (reduce_id, start, num_rows) triples; the
+        shared spill registration lives until every view closes."""
+        from ..memory.spill import SpillCatalog, SpillPriority
+        layout = [t for t in layout if t[2] > 0]
+        if not layout:
+            return
+        sb = sorted_batch
+        if isinstance(sb, DeviceBatch):
+            sb = SpillCatalog.get().register(sb, SpillPriority.SHUFFLE)
+        total = int(getattr(sorted_batch, "num_rows", 0)) or \
+            sum(n for _, _, n in layout)
+        shared = _SharedMapOutput(sb, refs=len(layout))
+        with self._lock:
+            for reduce_id, start, n in layout:
+                self._buffers.setdefault(
+                    ShuffleBlockId(shuffle_id, map_id, reduce_id), []
+                ).append(ShuffleBlockSlice(shared, start, n, total))
 
     def get(self, block: ShuffleBlockId) -> List:
         with self._lock:
@@ -53,19 +172,27 @@ class ShuffleBufferCatalog:
         otherwise the process-global SpillCatalog grows without bound and
         its device-budget accounting spills live buffers forever."""
         with self._lock:
+            doomed = []
             for k in [b for b in self._buffers if b[0] == shuffle_id]:
-                for sb in self._buffers[k]:
-                    close = getattr(sb, "close", None)
-                    if close is not None:
-                        try:
-                            close()
-                        except Exception:
-                            pass
-                del self._buffers[k]
+                doomed.extend(self._buffers.pop(k))
+        for sb in doomed:
+            close = getattr(sb, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
 
     def num_blocks(self) -> int:
         with self._lock:
             return len(self._buffers)
+
+    def device_bytes(self) -> int:
+        """Bytes the catalog currently retains (spill tier included) —
+        the number tmsan's shuffle-retention bound models."""
+        with self._lock:
+            blocks = [b for bs in self._buffers.values() for b in bs]
+        return sum(int(getattr(b, "device_bytes", 0) or 0) for b in blocks)
 
 
 class TpuShuffleManager:
@@ -99,16 +226,23 @@ class TpuShuffleManager:
     def write_map_output(self, shuffle_id: int, map_id: int,
                          slices: Dict[int, DeviceBatch]) -> None:
         """Register one map task's partition slices (ref
-        RapidsCachingWriter.write).  Batches stay live in device memory but
-        are registered spillable, so memory pressure demotes them
-        HOST->DISK exactly like the reference's shuffle-buffer spill."""
-        from ..memory.spill import SpillCatalog, SpillPriority
-        spill = SpillCatalog.get()
+        RapidsCachingWriter.write).  Batches stay live in device memory
+        but the catalog registers them spillable, so memory pressure
+        demotes them HOST->DISK exactly like the reference's
+        shuffle-buffer spill."""
         for reduce_id, batch in slices.items():
-            sb = spill.register(batch, SpillPriority.SHUFFLE) \
-                if isinstance(batch, DeviceBatch) else batch
             self.catalog.add(ShuffleBlockId(shuffle_id, map_id, reduce_id),
-                             sb)
+                             batch)
+        self._written[(shuffle_id, map_id)] = True
+
+    def write_map_output_sorted(self, shuffle_id: int, map_id: int,
+                                sorted_batch: DeviceBatch,
+                                layout: Iterable[Tuple[int, int, int]]
+                                ) -> None:
+        """One-pass map write: the partition-sorted batch registers once,
+        reduce partitions become lazy row-range views (the slice-view
+        write path, spark.rapids.tpu.shuffle.sliceViews)."""
+        self.catalog.add_sliced(shuffle_id, map_id, sorted_batch, layout)
         self._written[(shuffle_id, map_id)] = True
 
     def map_done(self, shuffle_id: int, map_id: int) -> bool:

@@ -14,16 +14,20 @@ chunked sends mirroring the bounce-buffer flow control."""
 
 from __future__ import annotations
 
+import queue
 import socket
 import socketserver
 import struct
 import threading
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
 from ..memory.meta import TableMeta, deserialize_batch, serialize_batch
-from .manager import ShuffleBlockId, TpuShuffleManager
+from .errors import (TpuShuffleError, TpuShuffleFetchFailedError,
+                     TpuShufflePeerDeadError, TpuShuffleTimeoutError,
+                     TpuShuffleTruncatedFrameError)
+from .manager import ShuffleBlockId, TpuShuffleManager, materialize_block
 
 # message types (ref RapidsShuffleTransport.scala:96-119)
 MSG_METADATA_REQ = 1
@@ -49,6 +53,7 @@ class Transaction:
         self.request_id = request_id
         self.status = TransactionStatus.PENDING
         self.error: Optional[str] = None
+        self.exc: Optional[BaseException] = None
         self.result = None
         self._done = threading.Event()
 
@@ -57,17 +62,21 @@ class Transaction:
         self.status = TransactionStatus.SUCCESS
         self._done.set()
 
-    def fail(self, error: str):
+    def fail(self, error: str, exc: Optional[BaseException] = None):
+        """Record failure; ``exc`` preserves the typed shuffle error so
+        ``wait`` re-raises it instead of a generic fetch failure."""
         self.error = error
+        self.exc = exc
         self.status = TransactionStatus.ERROR
         self._done.set()
 
     def wait(self, timeout: Optional[float] = None):
         if not self._done.wait(timeout):
-            raise TimeoutError(
+            raise TpuShuffleTimeoutError(
                 f"shuffle transaction {self.request_id} timed out")
         if self.status == TransactionStatus.ERROR:
-            from .errors import TpuShuffleFetchFailedError
+            if self.exc is not None:
+                raise self.exc
             raise TpuShuffleFetchFailedError(self.error or "unknown")
         return self.result
 
@@ -82,6 +91,8 @@ class ShuffleServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                with outer._conns_lock:
+                    outer._conns.add(self.request)
                 try:
                     while True:
                         head = _recv_exact(self.request, _FRAME.size)
@@ -100,7 +111,12 @@ class ShuffleServer:
                                         b"bad message")
                 except (ConnectionError, OSError):
                     return
+                finally:
+                    with outer._conns_lock:
+                        outer._conns.discard(self.request)
 
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         self._server = socketserver.ThreadingTCPServer((host, port), Handler)
         self._server.daemon_threads = True
         self.port = self._server.server_address[1]
@@ -114,6 +130,19 @@ class ShuffleServer:
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+        # sever in-flight connections too: a stopped server must look
+        # DEAD to clients, not keep serving on old sockets forever
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def _handle_metadata(self, sock, req_id, body):
         shuffle_id, reduce_id = struct.unpack("<qq", body)
@@ -187,7 +216,14 @@ class ShuffleClient:
                 off += TableMeta._S.size
                 metas.append(((sid, mid, red, idx), meta))
             tx.complete(metas)
+        except TpuShuffleError as ex:
+            self._drop_conn()
+            tx.fail(str(ex), exc=ex)
+        except socket.timeout as ex:
+            self._drop_conn()
+            tx.fail(str(ex), exc=TpuShuffleTimeoutError(str(ex)))
         except OSError as ex:
+            self._drop_conn()
             tx.fail(str(ex))
         return tx
 
@@ -205,17 +241,161 @@ class ShuffleClient:
                     return tx
                 (total,) = struct.unpack("<q", body)
                 payload = _recv_exact(sock, total)
+                if payload is None or len(payload) < total:
+                    raise TpuShuffleTruncatedFrameError(
+                        total, len(payload or b""), what="block body")
             tx.complete(deserialize_batch(payload, xp=xp))
+        except TpuShuffleError as ex:
+            self._drop_conn()
+            tx.fail(str(ex), exc=ex)
+        except socket.timeout as ex:
+            self._drop_conn()
+            tx.fail(str(ex), exc=TpuShuffleTimeoutError(str(ex)))
         except OSError as ex:
+            self._drop_conn()
             tx.fail(str(ex))
         return tx
 
+    def _drop_conn(self):
+        """Connection state after any failure is unknowable (half-read
+        frames); reconnect on the next request."""
+        try:
+            self.close()
+        except OSError:
+            pass
+
+
+class AsyncBlockFetcher:
+    """Pipelined reduce-side fetch (ref RapidsShuffleClient's
+    BufferReceiveState windows + doFetch flow).
+
+    A background thread streams the partition's blocks from the peer
+    while the consumer joins the previous block; at most ``window``
+    fetched-but-unconsumed blocks buffer in between, so reduce-side host
+    memory is bounded at window x block size while transfer overlaps
+    per-partition join compute.
+
+    Liveness rides shuffle/heartbeat.py: when a ``heartbeat`` manager
+    and ``peer_id`` are wired in, a peer that heartbeat expiry declares
+    dead fails the iteration with TpuShufflePeerDeadError immediately —
+    before and between block fetches — instead of waiting out a socket
+    timeout."""
+
+    _DONE = object()
+
+    def __init__(self, client: "ShuffleClient", shuffle_id: int,
+                 reduce_id: int, xp=np, window: int = 4,
+                 timeout: float = 30.0, heartbeat=None,
+                 peer_id: Optional[str] = None):
+        self.client = client
+        self.shuffle_id = shuffle_id
+        self.reduce_id = reduce_id
+        self.xp = xp
+        self.window = max(int(window), 1)
+        self.timeout = timeout
+        self.heartbeat = heartbeat
+        self.peer_id = peer_id
+        self._stop = threading.Event()
+
+    # -- liveness -----------------------------------------------------------
+    def _check_peer(self):
+        if self.heartbeat is None or self.peer_id is None:
+            return
+        self.heartbeat.expire_dead()
+        live = {p.executor_id for p in self.heartbeat.live_peers()}
+        if self.peer_id not in live:
+            raise TpuShufflePeerDeadError(self.peer_id)
+
+    # -- pipeline -----------------------------------------------------------
+    def _producer(self, keys, q):
+        try:
+            for (sid, mid, rid, idx) in keys:
+                if self._stop.is_set():
+                    return
+                self._check_peer()
+                b = self.client.fetch_block(sid, mid, rid, idx,
+                                            xp=self.xp).wait(self.timeout)
+                if not self._put(q, b):
+                    return
+            self._put(q, self._DONE)
+        except BaseException as ex:  # noqa: BLE001 — relayed to consumer
+            self._put(q, ex)
+
+    def _put(self, q, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def blocks(self) -> Iterator:
+        """Yield the partition's blocks in block order, prefetching up
+        to the window ahead of the consumer."""
+        from ..obs import metrics as m
+        try:
+            self._check_peer()
+            metas = self.client.fetch_metadata(
+                self.shuffle_id, self.reduce_id).wait(self.timeout)
+        except TpuShuffleError as ex:
+            raise self._classify(ex, m)
+        keys = [k for k, _ in metas]
+        if not keys:
+            return
+        q: "queue.Queue" = queue.Queue(maxsize=self.window)
+        t = threading.Thread(target=self._producer, args=(keys, q),
+                             name="shuffle-fetcher", daemon=True)
+        t.start()
+        blocks_c = m.counter("tpu_shuffle_fetch_blocks_total",
+                             "blocks fetched by the async fetcher")
+        bytes_c = m.counter("tpu_shuffle_fetch_bytes_total",
+                            "device bytes fetched by the async fetcher")
+        try:
+            while True:
+                item = q.get()
+                if item is self._DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise self._classify(item, m)
+                blocks_c.inc()
+                if m.enabled():
+                    from ..memory.spill import batch_device_bytes
+                    bytes_c.inc(batch_device_bytes(item))
+                yield item
+        finally:
+            self._stop.set()
+
+    __iter__ = blocks
+
+    def _classify(self, ex: BaseException, m) -> BaseException:
+        """Fold transport failures into the typed error taxonomy and
+        count them: a socket error from a heartbeat-dead peer IS a dead
+        peer, whatever errno it surfaced as."""
+        if isinstance(ex, TpuShufflePeerDeadError):
+            kind = "peer_dead"
+        elif isinstance(ex, TpuShuffleTruncatedFrameError):
+            kind = "truncated"
+        elif isinstance(ex, TpuShuffleTimeoutError):
+            kind = "timeout"
+        else:
+            try:
+                self._check_peer()
+            except TpuShufflePeerDeadError as dead:
+                dead.__cause__ = ex
+                ex, kind = dead, "peer_dead"
+            else:
+                kind = "fetch_failed"
+                if not isinstance(ex, TpuShuffleError):
+                    ex = TpuShuffleFetchFailedError(str(ex))
+        m.counter("tpu_shuffle_fetch_errors_total",
+                  "async fetch failures by kind",
+                  labelnames=("kind",)).labels(kind=kind).inc()
+        return ex
+
 
 def _materialize(b):
-    from ..memory.spill import SpillableBatch
-    if isinstance(b, SpillableBatch):
-        return b.get_batch(np)
-    return b
+    return materialize_block(b, np)
 
 
 def _send_frame(sock, mtype: int, req_id: int, body: bytes):
@@ -226,8 +406,14 @@ def _recv_frame(sock) -> Tuple[int, int, bytes]:
     head = _recv_exact(sock, _FRAME.size)
     if head is None:
         raise ConnectionError("peer closed")
+    if len(head) < _FRAME.size:
+        raise TpuShuffleTruncatedFrameError(_FRAME.size, len(head),
+                                            what="frame header")
     mtype, req_id, blen = _FRAME.unpack(head)
     body = _recv_exact(sock, blen) if blen else b""
+    if blen and (body is None or len(body) < blen):
+        raise TpuShuffleTruncatedFrameError(blen, len(body or b""),
+                                            what="frame body")
     return mtype, req_id, body
 
 

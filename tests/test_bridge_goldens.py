@@ -129,6 +129,38 @@ def test_shuffled_join_same_keys_golden():
     assert got.column("w").to_pylist() == [(i % 20) * 10 for i in keep]
 
 
+def test_shuffled_join_forced_golden():
+    """The bridge's over-cap strategy pin: a join op carrying
+    `"strategy": "shuffled"` (emitted when the build side exceeds
+    spark.tpu.bridge.maxBuildSideBytes) must route through the
+    co-partitioned spill-backed shuffled path — exchanges on both
+    sides + ShuffledHashJoinExec, never a broadcast/collected build —
+    and still produce exact join results."""
+    spec = _load("shuffled_join_forced")
+    spec["numPartitions"] = 4
+    fact = pa.table({
+        "id": pa.array(np.arange(100, dtype=np.int64) % 20),
+        "x": pa.array(np.arange(100, dtype=np.int64))})
+    dim = pa.table({
+        "user_id": pa.array(np.arange(20, dtype=np.int64)),
+        "w": pa.array((np.arange(20, dtype=np.int64) * 10))})
+    s = TpuSession.builder() \
+        .config("spark.rapids.sql.enabled", True) \
+        .config("spark.rapids.tpu.singleChipFuse", "off") \
+        .get_or_create()
+    lp = plan_spec_to_logical(spec, fact, (dim,))
+    got = s.execute(lp).sort_by([("x", "ascending"), ("w", "ascending")])
+    names = []
+    s.last_plan.foreach(lambda e: names.append(type(e).__name__))
+    assert "ShuffledHashJoinExec" in names, names
+    assert names.count("ShuffleExchangeExec") >= 2, names
+    assert "BroadcastHashJoinExec" not in names, names
+    assert got.schema.names == ["x", "w"]
+    assert got.column("x").to_pylist() == list(range(100))
+    assert got.column("w").to_pylist() == [int(i % 20) * 10
+                                           for i in range(100)]
+
+
 def test_string_datetime_cast_golden():
     import datetime
     spec = _load("string_datetime_cast")

@@ -128,6 +128,22 @@ def test_run_lint_jit_gate_exits_zero():
     assert "jit gate clean" in proc.stdout, proc.stdout
 
 
+def test_run_lint_shuffle_gate_exits_zero():
+    """Tier-1 gate for the distributed shuffle: the forced-shuffled-join
+    bridge golden replays under the memsan shadow ledger with a 1-byte
+    spill budget (every map-output block demotes and must come back
+    correct), the catalog and ledger must be clean after stage release,
+    the slice-view write must bank nonzero saved bytes, and a TCP
+    transport leg's fetch counters must agree with the served blocks."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "devtools", "run_lint.py"),
+         "--shuffle"],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "shuffle gate clean" in proc.stdout, proc.stdout
+
+
 def test_baseline_is_empty_and_stays_empty():
     """PR-3 burned the last baselined TPU-R001 debt down to zero: the
     ratchet now enforces a spotless repo (deliberate exceptions are

@@ -96,6 +96,60 @@ def test_exchange_carries_nulls_and_strings():
     assert got_rows == want_rows
 
 
+def run_exchange_guarded(table, pid_of_row, slot):
+    """exchange_by_pid with a sub-capacity slot under on_overflow='guard';
+    returns (per-device tables, per-device ok bools)."""
+    mesh = mesh8()
+    stacked = stack_shards(shard_tables(table))
+
+    def step(shard):
+        b = jax.tree_util.tree_map(lambda x: x[0], shard)
+        pids = pid_of_row(b)
+        out, ok = exchange_by_pid(b, pids, N_DEV, "data", slot=slot,
+                                  on_overflow="guard")
+        return (jax.tree_util.tree_map(lambda x: x[None], out), ok[None])
+
+    fn = jax.jit(shard_map(step, mesh=mesh, in_specs=P("data"),
+                           out_specs=(P("data"), P("data")),
+                           check_vma=False))
+    out, oks = fn(stacked)
+    return ([batch_to_arrow(b) for b in unstack_shards(out)],
+            [bool(x) for x in np.asarray(oks)])
+
+
+def test_exchange_guard_mode_clean_when_budget_fits():
+    """A sub-capacity slot that every destination fits under must route
+    all rows AND report ok=True on every shard (the speculative-sizing
+    fast path: ~slot/capacity of the full exchange footprint)."""
+    n = 800  # 100 rows/shard; round-robin pids -> ~13 per destination
+    table = pa.table({
+        "k": pa.array((np.arange(n) % N_DEV).astype(np.int64)),
+        "v": pa.array(np.arange(n, dtype=np.int64)),
+    })
+    outs, oks = run_exchange_guarded(
+        table, lambda b: b.columns[0].data % N_DEV, slot=32)
+    assert all(oks), oks
+    total = 0
+    for d, rb in enumerate(outs):
+        assert (rb.column("k").to_numpy() % N_DEV == d).all()
+        total += rb.num_rows
+    assert total == n
+
+
+def test_exchange_guard_mode_flags_overflow():
+    """A skewed destination that exceeds the slot budget must flip the
+    sending shards' guard to False — the caller's signal to re-run at
+    slot=capacity — never silently drop rows without a flag."""
+    n = 800  # every row targets device 0: 100 sends/shard > slot=32
+    table = pa.table({
+        "k": pa.array(np.zeros(n, dtype=np.int64)),
+        "v": pa.array(np.arange(n, dtype=np.int64)),
+    })
+    outs, oks = run_exchange_guarded(
+        table, lambda b: b.columns[0].data % N_DEV, slot=32)
+    assert not any(oks), oks
+
+
 def test_allgather_broadcast():
     table = pa.table({"b": pa.array(np.arange(64, dtype=np.int64))})
     mesh = mesh8()

@@ -112,6 +112,83 @@ def test_dtype_change_must_compile(obs):
         np.sort(out.column("x").to_numpy()), np.sort(v[v > 5.0] + 7.0))
 
 
+def test_in_list_twins_share_programs(obs):
+    """IN-list items hoist like comparison literals: twins that differ
+    only in the listed values (same list LENGTH) share every program."""
+    s = _session()
+    df = s.create_dataframe(_table())
+    out1 = df.filter(col("v").isin(3, 700, 1500)).collect()
+    snap1 = obs.snapshot()
+    assert snap1["builds"] > 0
+    out2 = df.filter(col("v").isin(8, 901, 1999)).collect()
+    snap2 = obs.snapshot()
+    assert snap2["builds"] == snap1["builds"], snap2["by_cause"]
+    assert sorted(out1.column("v").to_pylist()) == [3, 700, 1500]
+    assert sorted(out2.column("v").to_pylist()) == [8, 901, 1999]
+
+
+def test_case_arm_twins_share_programs(obs):
+    """Numeric CASE value arms hoist: twins differing only in the arm
+    constants (and the compared literal) share every program."""
+    from spark_rapids_tpu.api.functions import when
+    s = _session()
+    df = s.create_dataframe(_table())
+
+    def q(cut, a, b):
+        return df.select(
+            when(col("v") > cut, a).otherwise(b).alias("c")).collect()
+
+    out1 = q(1000, 7, 3)
+    snap1 = obs.snapshot()
+    assert snap1["builds"] > 0
+    out2 = q(500, 90, 40)
+    snap2 = obs.snapshot()
+    assert snap2["builds"] == snap1["builds"], snap2["by_cause"]
+    v = np.arange(2000, dtype=np.int64)
+    np.testing.assert_array_equal(out1.column("c").to_numpy(),
+                                  np.where(v > 1000, 7, 3))
+    np.testing.assert_array_equal(out2.column("c").to_numpy(),
+                                  np.where(v > 500, 90, 40))
+
+
+def _stable():
+    n = 512
+    vals = ["red", "blu", "grn", "yel"]
+    return pa.table({
+        "s": pa.array([vals[i % 4] for i in range(n)]),
+        "v": pa.array(np.arange(n, dtype=np.int64)),
+    })
+
+
+def test_string_literal_twins_share_programs(obs):
+    """Same-BYTE-LENGTH string literal twins share programs: the chars
+    ride in as a traced uint8 array, equality hashes on device."""
+    s = _session()
+    df = s.create_dataframe(_stable())
+    out1 = df.filter(col("s") == "red").collect()
+    snap1 = obs.snapshot()
+    assert snap1["builds"] > 0
+    out2 = df.filter(col("s") == "grn").collect()
+    snap2 = obs.snapshot()
+    assert snap2["builds"] == snap1["builds"], snap2["by_cause"]
+    assert set(out1.column("s").to_pylist()) == {"red"}
+    assert set(out2.column("s").to_pylist()) == {"grn"}
+    assert out1.num_rows == out2.num_rows == 128
+
+
+def test_string_length_change_must_compile(obs):
+    """Anti-vacuity: a DIFFERENT byte length is a different traced
+    shape and must fork the key space (honest recompile)."""
+    s = _session()
+    df = s.create_dataframe(_stable())
+    df.filter(col("s") == "red").collect()
+    snap1 = obs.snapshot()
+    out = df.filter(col("s") == "reddish").collect()
+    snap2 = obs.snapshot()
+    assert snap2["builds"] > snap1["builds"]
+    assert out.num_rows == 0
+
+
 def test_shared_program_ratio_gauge(obs):
     """tpu_jit_shared_program_ratio drops as calls reuse programs."""
     s = _session()
