@@ -553,6 +553,248 @@ def measure_metrics_overhead(fact, dim, pq_path, out_root) -> float:
     return 100.0 * (floor(True) - base) / base
 
 
+# ---------------------------------------------------------------------------
+# --serve: sustained-QPS serving benchmark (pool + byte-weighted admission)
+# ---------------------------------------------------------------------------
+
+#: synthetic sql_id for the serve fingerprint (real event-log sql_ids are
+#: small per-app ordinals; this can never collide with one)
+_SERVE_SQL_ID = 100_000
+
+
+def serve_mix(session, fact, dim, pq_path):
+    """The four-query serving mix (agg/join/window/parquet), bound to one
+    pooled session.  Dataframes are pre-created so the measured cost is
+    query execution, not host-side table registration."""
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.expr.window import WindowBuilder
+
+    fdf = session.create_dataframe(fact)
+    ddf = session.create_dataframe(dim)
+
+    def agg():
+        return (fdf.filter(col("v") > -(10**6) // 2)
+                .group_by(col("k"))
+                .agg(F.sum(col("v")).alias("sv"),
+                     F.count("*").alias("c"))
+                .collect())
+
+    def join():
+        return (fdf.join(ddf, on="k", how="inner")
+                .group_by(col("k"))
+                .agg(F.sum(col("w")).alias("sw"))
+                .collect())
+
+    def window():
+        w = WindowBuilder().partition_by(col("k")).order_by(col("v"))
+        return (fdf.select(col("k"), col("v"),
+                           F.row_number().over(w).alias("rn"))
+                .collect())
+
+    def parquet():
+        return (session.read.parquet(pq_path)
+                .filter(col("f") < 0.5)
+                .group_by(col("k"))
+                .agg(F.sum(col("v")).alias("sv"))
+                .collect())
+
+    return {"agg": agg, "join": join, "window": window,
+            "parquet": parquet}
+
+
+def measure_serve(fact, dim, pq_path, concurrency: int = 8,
+                  queries_per_worker: int = 3,
+                  request_io_ms: float = 150.0) -> dict:
+    """Sustained-QPS serving measurement: the SAME request list through
+    a 1-session pool serially (one-at-a-time server), then a
+    `concurrency`-session pool with `concurrency` client threads, under
+    byte-weighted admission.  The concurrent arm must sustain strictly
+    higher aggregate QPS than the serial arm (``qps_speedup > 1``) —
+    the whole point of co-running — with zero dirty memsan ledgers and
+    zero admission accounting drift (every admitted ticket ends as a
+    completed or failed query).
+
+    ``request_io_ms`` models the per-request client transfer latency of
+    the offered load (request receive + response delivery), charged
+    identically to every request in BOTH arms: a one-at-a-time server
+    eats it sequentially, a multi-tenant server overlaps it with other
+    tenants' compute.  On a multi-core host the compute itself overlaps
+    too; on a single-core CI host this client I/O is the slack that
+    makes the co-running dividend measurable at all."""
+    import concurrent.futures as cf
+
+    from spark_rapids_tpu.api.pool import SessionPool
+    from spark_rapids_tpu.memory.admission import AdmissionController
+    from spark_rapids_tpu.obs import metrics as obs_metrics
+
+    conf = {
+        "spark.rapids.sql.enabled": "true",
+        "spark.rapids.tpu.memsan.enabled": "true",
+        "spark.rapids.tpu.serve.hbmAdmissionBudgetBytes": str(2 << 30),
+        "spark.rapids.tpu.serve.admissionTimeoutMs": "120000",
+    }
+    reg = obs_metrics.registry()
+
+    def counters():
+        out = {n: reg.counter(f"tpu_admission_{n}_total").value()
+               for n in ("admitted", "queued", "timeouts", "repaired")}
+        out["completed"] = reg.counter(
+            "tpu_queries_completed_total").value()
+        out["failed"] = reg.counter("tpu_queries_failed_total").value()
+        out["dirty_ledgers"] = reg.counter(
+            "tpu_memsan_dirty_ledgers_total").value()
+        qw = reg.histogram("tpu_admission_queue_wait_seconds").value()
+        # value() is 0 (not a tuple) before the first observation
+        cnt, total = qw if isinstance(qw, tuple) else (0, 0.0)
+        out["queue_wait_count"], out["queue_wait_sum_s"] = cnt, total
+        return out
+
+    mix_names = ("agg", "join", "window", "parquet")
+    worklist = [mix_names[i % len(mix_names)]
+                for i in range(concurrency * queries_per_worker)]
+    peak_seen = [0]
+    peak_lock = __import__("threading").Lock()
+
+    def run_list(pool, mixes, workers):
+        latencies = {}
+
+        def one(i_name):
+            i, name = i_name
+            io_s = request_io_ms / 1000.0
+            with pool.session() as s:
+                t0 = time.perf_counter()
+                time.sleep(io_s / 2)      # request receive
+                out = mixes[id(s)][name]()
+                time.sleep(io_s / 2)      # response delivery
+                lat = time.perf_counter() - t0
+            assert out.num_rows > 0
+            pk = s.last_peak_device_bytes or 0
+            with peak_lock:
+                peak_seen[0] = max(peak_seen[0], pk)
+            latencies[i] = (name, lat)
+
+        t0 = time.perf_counter()
+        if workers == 1:
+            for item in enumerate(worklist):
+                one(item)
+        else:
+            with cf.ThreadPoolExecutor(max_workers=workers) as ex:
+                list(ex.map(one, enumerate(worklist)))
+        wall = time.perf_counter() - t0
+        return wall, [latencies[i][1] for i in sorted(latencies)]
+
+    c0 = counters()
+    # serial arm: one session, one client
+    pool1 = SessionPool(1, conf)
+    mixes1 = {id(s): serve_mix(s, fact, dim, pq_path)
+              for s in pool1._sessions}
+    for name in mix_names:  # warm the shared jit cache once per shape
+        with pool1.session() as s:
+            mixes1[id(s)][name]()
+    serial_wall, serial_lat = run_list(pool1, mixes1, 1)
+    pool1.close()
+    # concurrent arm: N sessions, N client threads, same worklist
+    poolN = SessionPool(concurrency, conf)
+    mixesN = {id(s): serve_mix(s, fact, dim, pq_path)
+              for s in poolN._sessions}
+    conc_before = counters()
+    conc_wall, conc_lat = run_list(poolN, mixesN, concurrency)
+    poolN.drain(timeout=30)
+    c1 = counters()
+    ctrl = AdmissionController.get()
+
+    def pct(lats, p):
+        srt = sorted(lats)
+        return srt[min(int(p * (len(srt) - 1) + 0.5), len(srt) - 1)]
+
+    total = len(worklist)
+    delta = {k: c1[k] - c0[k] for k in c0}
+    qw_cnt = c1["queue_wait_count"] - conc_before["queue_wait_count"]
+    qw_sum = c1["queue_wait_sum_s"] - conc_before["queue_wait_sum_s"]
+    serial_qps = total / serial_wall
+    conc_qps = total / conc_wall
+    return {
+        "mix": list(mix_names),
+        "queries": total,
+        "concurrency": concurrency,
+        "request_io_ms": request_io_ms,
+        "serial_qps": round(serial_qps, 2),
+        "concurrent_qps": round(conc_qps, 2),
+        "qps_speedup": round(conc_qps / serial_qps, 3),
+        "p50_ms": round(pct(conc_lat, 0.50) * 1000, 1),
+        "p99_ms": round(pct(conc_lat, 0.99) * 1000, 1),
+        "serial_p50_ms": round(pct(serial_lat, 0.50) * 1000, 1),
+        "queue_wait_mean_ms": round(
+            1000 * qw_sum / qw_cnt, 2) if qw_cnt else 0.0,
+        "peak_device_bytes": int(peak_seen[0]),
+        "max_bytes_in_flight": int(ctrl.max_in_flight_seen)
+            if ctrl else 0,
+        "budget_bytes": int(ctrl.budget_bytes) if ctrl else 0,
+        "admission": {k: int(delta[k]) for k in
+                      ("admitted", "queued", "timeouts", "repaired")},
+        "completed": int(delta["completed"]),
+        "failed": int(delta["failed"]),
+        "dirty_ledgers": int(delta["dirty_ledgers"]),
+        "accounting_drift": int(
+            delta["admitted"] - delta["completed"] - delta["failed"]),
+    }
+
+
+def serve_fingerprint(serve: dict) -> dict:
+    """The serve run as ONE history fingerprint: counter totals are the
+    deterministic half (fixed mix + budget replays identically; queued
+    is scheduling noise and excluded), percentiles the timing half."""
+    from spark_rapids_tpu.obs.history import FINGERPRINT_VERSION
+    return {
+        "version": FINGERPRINT_VERSION,
+        "sql_id": _SERVE_SQL_ID,
+        "description": "serve_mix",
+        "failed": False,
+        "serve_counters": {
+            "admitted": serve["admission"]["admitted"],
+            "repaired": serve["admission"]["repaired"],
+            "timeouts": serve["admission"]["timeouts"],
+            "completed": serve["completed"],
+            "failed": serve["failed"],
+        },
+        "serve_p50_ms": serve["p50_ms"],
+        "serve_p99_ms": serve["p99_ms"],
+    }
+
+
+def record_serve_history(history_dir: str, serve: dict, check: bool,
+                         wall_threshold=None) -> int:
+    """--record/--check for the serving benchmark, through the same
+    append-only HistoryDir + differ as the suite fingerprints."""
+    from spark_rapids_tpu.obs.history import (HistoryDir,
+                                              deterministic_drift,
+                                              diff_runs)
+    hist = HistoryDir(history_dir)
+    path = hist.record([serve_fingerprint(serve)], label="bench serve")
+    print(f"bench --serve: recorded serve fingerprint -> {path}",
+          file=sys.stderr)
+    if not check:
+        return 0
+    runs = hist.runs()
+    if len(runs) < 2:
+        print("bench --serve --check: first recorded run, nothing to "
+              "diff", file=sys.stderr)
+        return 0
+    drifts = diff_runs(hist.load(runs[-2]), hist.load(runs[-1]),
+                       wall_threshold_pct=wall_threshold)
+    for d in drifts:
+        print(f"bench --serve --check: {d.render()}", file=sys.stderr)
+    if deterministic_drift(drifts):
+        print("SERVE REGRESSION CHECK FAILED: deterministic "
+              "fingerprint drift vs the previous recorded run",
+              file=sys.stderr)
+        return 1
+    print("bench --serve --check: no deterministic drift vs previous "
+          "run", file=sys.stderr)
+    return 0
+
+
 def record_history(history_dir: str, eventlog_dir: str,
                    check: bool, wall_threshold=None) -> int:
     """Distill this run's event log into the append-only fingerprint
@@ -628,6 +870,7 @@ def main():
         run_one_suite(one_suite, n_rows, _arg_value("--cache-dir", ""),
                       _arg_value("--ledger-dir", ""))
         return
+    with_serve = "--serve" in sys.argv[1:]
     with_pyspark = "--baseline=pyspark" in sys.argv[1:]
     with_trace_guard = "--trace-overhead" in sys.argv[1:]
     with_metrics_guard = "--metrics-overhead" in sys.argv[1:]
@@ -643,6 +886,58 @@ def main():
         reachable, probe_error = _device_reachable()
         if not reachable:
             _cpu_fallback_reexec(probe_error)
+    if with_serve:
+        # serving mode: sustained-QPS mix under the session pool + byte
+        # admission gate, instead of the single-tenant suite.  Smaller
+        # default row count: the measurement is throughput under
+        # concurrency, not per-query scan speed.
+        serve_rows = int(pos[0]) if pos else 200_000
+        concurrency = int(_arg_value("--concurrency", "8"))
+        request_io_ms = float(_arg_value("--request-io-ms", "150"))
+        fact, dim = make_tables(serve_rows)
+        root = tempfile.mkdtemp(prefix="spark_rapids_tpu_serve_")
+        try:
+            pq_path = write_parquet_input(fact, root)
+            serve = measure_serve(fact, dim, pq_path,
+                                  concurrency=concurrency,
+                                  request_io_ms=request_io_ms)
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+        out = {
+            "metric": "serve_sustained_qps",
+            "value": serve["concurrent_qps"],
+            "unit": "queries/s",
+            "vs_baseline": serve["qps_speedup"],
+            "serve": serve,
+        }
+        if is_cpu_fallback:
+            out["backend"] = "cpu_fallback"
+            out["probe_error"] = os.environ.get(
+                "BENCH_CPU_FALLBACK_ERROR", "accelerator unreachable")
+        print(json.dumps(out))
+        regress_rc = 0
+        if with_record or with_check:
+            serve_hist = _arg_value("--history",
+                                    "tpu_bench_serve_history")
+            regress_rc = record_serve_history(
+                serve_hist, serve, with_check, wall_threshold)
+        failed = False
+        if serve["qps_speedup"] <= 1.0:
+            print(f"SERVE QPS GUARD FAILED: concurrent "
+                  f"{serve['concurrent_qps']} qps <= serial "
+                  f"{serve['serial_qps']} qps", file=sys.stderr)
+            failed = True
+        if serve["dirty_ledgers"]:
+            print(f"SERVE MEMSAN GUARD FAILED: "
+                  f"{serve['dirty_ledgers']} dirty ledger(s)",
+                  file=sys.stderr)
+            failed = True
+        if serve["accounting_drift"]:
+            print(f"SERVE ADMISSION GUARD FAILED: accounting drift "
+                  f"{serve['accounting_drift']} (admitted != completed "
+                  f"+ failed)", file=sys.stderr)
+            failed = True
+        sys.exit(1 if failed or regress_rc else 0)
     fact, dim = make_tables(n_rows)
     root = tempfile.mkdtemp(prefix="spark_rapids_tpu_bench_")
     eventlog_dir = None

@@ -872,6 +872,153 @@ def run_shuffle_gate() -> int:
     return 0
 
 
+def run_serve_gate() -> int:
+    """Multi-tenant serving gate: a golden four-query mix replayed 16
+    times across 4 concurrent pooled sessions under byte-weighted
+    admission.  Every concurrent result must equal the serial ground
+    truth bit-for-bit; the memsan dirty-ledger counter must stay zero;
+    the admission books must balance (admitted = completed + failed,
+    zero timeouts, max bytes in flight nonzero and within budget); and
+    after the pool drains no shuffle block or spillable buffer may
+    survive (orphan check)."""
+    import concurrent.futures as cf
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.pool import SessionPool
+    from spark_rapids_tpu.expr.window import WindowBuilder
+    from spark_rapids_tpu.memory.admission import AdmissionController
+    from spark_rapids_tpu.memory.spill import SpillCatalog
+    from spark_rapids_tpu.obs import metrics as m
+    from spark_rapids_tpu.obs.metrics import MetricsRegistry
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+
+    failures = 0
+    MetricsRegistry.reset_for_tests()
+    with SpillCatalog._lock:
+        SpillCatalog._instance = SpillCatalog()
+    TpuShuffleManager.reset()
+    AdmissionController.reset_for_tests()
+
+    n = 4000
+    rng = np.random.default_rng(7)
+    fact = pa.table({
+        "k": pa.array(rng.integers(0, 97, n).astype(np.int64)),
+        "v": pa.array(rng.integers(-1000, 1000, n).astype(np.int64)),
+    })
+    dim = pa.table({
+        "k": pa.array(np.arange(97, dtype=np.int64)),
+        "w": pa.array(np.arange(97, dtype=np.int64) * 10),
+    })
+    budget = 256 << 20
+    pool = SessionPool(4, {
+        "spark.rapids.sql.enabled": "true",
+        "spark.rapids.tpu.memsan.enabled": "true",
+        "spark.rapids.tpu.singleChipFuse": "off",
+        "spark.rapids.tpu.serve.hbmAdmissionBudgetBytes": str(budget),
+        "spark.rapids.tpu.serve.admissionTimeoutMs": "60000",
+    })
+
+    def mk_mix(s):
+        fdf = s.create_dataframe(fact)
+        # multi-partition join keeps real shuffle blocks in play so the
+        # post-drain orphan check is not vacuous
+        fdf4 = s.create_dataframe(fact, num_partitions=4)
+        ddf2 = s.create_dataframe(dim, num_partitions=2)
+        w = WindowBuilder().partition_by(col("k")).order_by(col("v"))
+        return {
+            "agg": lambda: (fdf.group_by(col("k"))
+                            .agg(F.sum(col("v")).alias("sv"),
+                                 F.count("*").alias("c")).collect()),
+            "join": lambda: (fdf4.join(ddf2, on="k", how="inner")
+                             .group_by(col("k"))
+                             .agg(F.sum(col("w")).alias("sw"))
+                             .collect()),
+            "window": lambda: (fdf.select(
+                col("k"), col("v"),
+                F.row_number().over(w).alias("rn")).collect()),
+            "sort": lambda: fdf.sort(col("k"), col("v")).collect(),
+        }
+
+    mixes = {id(s): mk_mix(s) for s in pool._sessions}
+
+    def canon(tb):
+        cols = sorted(tb.column_names)
+        return sorted(zip(*(tb.column(c).to_pylist() for c in cols)))
+
+    expected = {}
+    with pool.session() as s:        # serial ground truth
+        for name, q in mixes[id(s)].items():
+            expected[name] = canon(q())
+
+    worklist = [name for name in sorted(expected) for _ in range(4)]
+
+    def one(name):
+        with pool.session() as s:
+            return name, canon(mixes[id(s)][name]())
+
+    with cf.ThreadPoolExecutor(max_workers=4) as ex:
+        results = list(ex.map(one, worklist))
+    pool.drain(timeout=60)
+    pool.close()
+
+    wrong = [name for name, got in results if got != expected[name]]
+    if wrong:
+        failures += 1
+        print(f"SERVE: {len(wrong)} concurrent result(s) diverged from "
+              f"the serial ground truth: {sorted(set(wrong))}")
+    dirty = m.counter("tpu_memsan_dirty_ledgers_total").value()
+    if dirty:
+        failures += 1
+        print(f"SERVE: {dirty} dirty memsan ledger(s) under concurrency")
+    admitted = m.counter("tpu_admission_admitted_total").value()
+    completed = m.counter("tpu_queries_completed_total").value()
+    failed = m.counter("tpu_queries_failed_total").value()
+    timeouts = m.counter("tpu_admission_timeouts_total").value()
+    if admitted != completed + failed:
+        failures += 1
+        print(f"SERVE: admission books don't balance: {admitted} "
+              f"admitted != {completed} completed + {failed} failed")
+    if failed or timeouts:
+        failures += 1
+        print(f"SERVE: clean mix counted {failed} failure(s), "
+              f"{timeouts} timeout(s)")
+    ctrl = AdmissionController.get()
+    peak_in_flight = ctrl.max_in_flight_seen if ctrl else -1
+    if ctrl is None or peak_in_flight <= 0:
+        failures += 1
+        print("SERVE: vacuous gate — no byte-weighted ticket was ever "
+              "in flight")
+    elif peak_in_flight > budget:
+        failures += 1
+        print(f"SERVE: bytes in flight exceeded the budget "
+              f"({peak_in_flight} > {budget})")
+    blocks = TpuShuffleManager.get().catalog.num_blocks()
+    if blocks:
+        failures += 1
+        print(f"SERVE: {blocks} orphaned shuffle block(s) after drain")
+    leaks = SpillCatalog.get().leak_report()
+    if leaks:
+        failures += 1
+        print(f"SERVE: {len(leaks)} spillable buffer(s) leaked")
+
+    MetricsRegistry.reset_for_tests()
+    AdmissionController.reset_for_tests()
+    if failures:
+        print(f"serve gate: {failures} failure(s)")
+        return 1
+    print(f"serve gate clean ({len(results)} concurrent queries across "
+          f"4 sessions matched the serial ground truth; {admitted} "
+          f"admitted = {completed} completed + {failed} failed, zero "
+          f"timeouts; peak {int(peak_in_flight)} ticket bytes in "
+          f"flight within the {budget} budget; ledgers, shuffle "
+          f"catalog and spill catalog all clean after drain)")
+    return 0
+
+
 def main(argv=None):
     args = argv if argv is not None else sys.argv[1:]
     if "--interp" in args:
@@ -888,6 +1035,8 @@ def main(argv=None):
         return run_jit_gate()
     if "--shuffle" in args:
         return run_shuffle_gate()
+    if "--serve" in args:
+        return run_serve_gate()
     from spark_rapids_tpu.tools.__main__ import main as tools_main
     cli = ["lint", "--repo", "--baseline", BASELINE]
     if "--update-baseline" in args:

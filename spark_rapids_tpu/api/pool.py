@@ -1,0 +1,136 @@
+"""SessionPool: N logical TpuSessions multiplexed over the ONE
+process-wide runtime for multi-tenant serving.
+
+The heavyweight state — device manager, spill catalog, shuffle manager,
+staging arena, MetricsRegistry, CompileObservatory, persistent compile
+cache — is process-wide by construction (each is a singleton the plugin
+bootstrap initializes idempotently), so pooling sessions costs the
+per-session bookkeeping only: last-plan/explain slots, the event-log
+writer (one app id per session, so concurrent queries never interleave
+in one log) and the per-query flight-recorder trace.
+
+Borrowing binds the session to the calling thread
+(``TpuSession.bind_to_thread``), so library code resolving
+``TpuSession.active()`` mid-query sees the borrower's session; pool
+sessions run with ``_obs_isolation`` on, which installs the tracer and
+the memsan shadow ledger THREAD-LOCALLY — a per-query clean check never
+flags a co-running query's live buffers as leaks, and spans never
+interleave across traces.
+
+Byte-weighted co-running is the admission controller's job
+(memory/admission.py, ``spark.rapids.tpu.serve.*``): the pool bounds
+how many queries are in flight, the controller bounds how many BYTES.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from .. import config as cfg
+from ..config import RapidsConf
+from .session import TpuSession
+
+
+class SessionPool:
+    """Fixed-size pool of TpuSessions sharing one process runtime."""
+
+    def __init__(self, size: Optional[int] = None,
+                 conf: Optional[Dict] = None):
+        conf_map = dict(conf or {})
+        rc = RapidsConf(conf_map)
+        self.size = int(size) if size is not None else \
+            rc.get(cfg.SERVE_POOL_SIZE)
+        if self.size < 1:
+            raise ValueError(f"pool size must be >= 1, got {self.size}")
+        self._cv = threading.Condition()
+        self._closed = False
+        self._sessions = []
+        for _ in range(self.size):
+            s = TpuSession(conf_map)
+            s._obs_isolation = True
+            self._sessions.append(s)
+        self._idle = deque(self._sessions)
+
+    # -- borrow / return ------------------------------------------------------
+    def _borrow(self, timeout: Optional[float]) -> TpuSession:
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        from ..obs import metrics as m
+        with self._cv:
+            while not self._idle:
+                if self._closed:
+                    raise RuntimeError("SessionPool is closed")
+                remaining = None if deadline is None else \
+                    deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"no idle session within {timeout:g}s "
+                        f"(pool size {self.size})")
+                self._cv.wait(remaining)
+            if self._closed:
+                raise RuntimeError("SessionPool is closed")
+            s = self._idle.popleft()
+            m.gauge("tpu_session_pool_in_use",
+                    "pool sessions currently borrowed") \
+                .set(self.size - len(self._idle))
+            return s
+
+    def _return(self, s: TpuSession) -> None:
+        from ..obs import metrics as m
+        with self._cv:
+            self._idle.append(s)
+            m.gauge("tpu_session_pool_in_use",
+                    "pool sessions currently borrowed") \
+                .set(self.size - len(self._idle))
+            self._cv.notify_all()
+
+    @contextlib.contextmanager
+    def session(self, timeout: Optional[float] = None):
+        """Borrow a session, bound to the calling thread for the
+        duration (``TpuSession.active()`` resolves to it)."""
+        s = self._borrow(timeout)
+        TpuSession.bind_to_thread(s)
+        try:
+            yield s
+        finally:
+            TpuSession.bind_to_thread(None)
+            self._return(s)
+
+    def run(self, fn, timeout: Optional[float] = None):
+        """``fn(session)`` on a borrowed session (the one-liner most
+        serving threads want)."""
+        with self.session(timeout) as s:
+            return fn(s)
+
+    # -- lifecycle ------------------------------------------------------------
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Block until every session is idle (all in-flight queries
+        done) — the quiesce point the serve gate checks orphaned
+        shuffles after."""
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        with self._cv:
+            while len(self._idle) < self.size:
+                remaining = None if deadline is None else \
+                    deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"pool did not drain within {timeout:g}s "
+                        f"({self.size - len(self._idle)} busy)")
+                self._cv.wait(remaining)
+
+    def close(self) -> None:
+        """Refuse further borrows; idle sessions stay usable directly
+        (the process-wide runtime they share outlives the pool)."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    @property
+    def idle(self) -> int:
+        with self._cv:
+            return len(self._idle)

@@ -29,6 +29,8 @@ from .dataframe import DataFrame
 class TpuSession:
     _active: Optional["TpuSession"] = None
     _lock = threading.Lock()
+    _create_lock = threading.Lock()
+    _tls = threading.local()
 
     def __init__(self, conf: Optional[Dict] = None):
         self._conf_map = dict(conf or {})
@@ -39,8 +41,13 @@ class TpuSession:
         self._obs_plan = None
         self._obs_writer = None
         self._sql_counter = 0
+        # pool sessions (api/pool.py) bind tracer + memsan ledger
+        # thread-locally so co-running queries never share either
+        self._obs_isolation = False
+        self.last_peak_device_bytes = None
         self._init_runtime()
-        TpuSession._active = self
+        with TpuSession._lock:
+            TpuSession._active = self
 
     def _init_runtime(self):
         conf = self.conf
@@ -162,9 +169,26 @@ class TpuSession:
 
     @classmethod
     def active(cls) -> "TpuSession":
+        """The session for THIS thread: the pool-bound one when the
+        calling thread borrowed from a SessionPool (api/pool.py), else
+        the process-wide last-created session, built on demand.
+        Thread-safe — concurrent first calls no longer race to build
+        two default sessions."""
+        bound = getattr(cls._tls, "session", None)
+        if bound is not None:
+            return bound
         if cls._active is None:
-            cls._active = TpuSession()
+            with cls._create_lock:
+                if cls._active is None:
+                    TpuSession()  # registers itself as _active
         return cls._active
+
+    @classmethod
+    def bind_to_thread(cls,
+                       session: Optional["TpuSession"]) -> None:
+        """Bind (or with None, unbind) the calling thread's active()
+        session — the SessionPool's borrow/return hook."""
+        cls._tls.session = session
 
     # -- data sources -------------------------------------------------------
     def create_dataframe(self, data, num_partitions: int = 1) -> DataFrame:
@@ -295,7 +319,10 @@ class TpuSession:
         # tracer is what every instrumented layer (operator spans,
         # spill/shuffle/ICI/bridge events) records into
         tracer = obs.QueryTrace(max_spans=conf.get(cfg.TRACE_MAX_SPANS))
-        obs.install(tracer)
+        if self._obs_isolation:
+            obs.install_local(tracer)
+        else:
+            obs.install(tracer)
         self._last_trace = tracer
         self._obs_plan = None
         try:
@@ -306,7 +333,10 @@ class TpuSession:
             self._flush_query_obs(tracer, ex, eventlog_dir)
             raise
         finally:
-            obs.uninstall()
+            if self._obs_isolation:
+                obs.uninstall_local()
+            else:
+                obs.uninstall()
 
     def _execute_query(self, lp: L.LogicalPlan, tracer,
                        eventlog_dir) -> pa.Table:
@@ -321,6 +351,21 @@ class TpuSession:
             return assisted
         with trace_span("phase:plan", kind="phase"):
             final_plan = self.prepare_plan(lp)
+        # byte-weighted admission (serve.hbmAdmissionBudgetBytes): the
+        # plan's tmsan static peak bound is its ticket — acquired once,
+        # held across the speculation retry (re-entrancy), released in
+        # the finally (release-on-failure)
+        ticket, controller = self._admit_plan(final_plan)
+        try:
+            return self._execute_admitted(lp, final_plan, tracer,
+                                          eventlog_dir, ticket)
+        finally:
+            if controller is not None:
+                controller.release(ticket)
+
+    def _execute_admitted(self, lp: L.LogicalPlan, final_plan, tracer,
+                          eventlog_dir, ticket) -> pa.Table:
+        from ..obs.tracer import trace_span
         self._obs_plan = final_plan
         self._install_predictions(tracer, final_plan)
         from ..plugin import ExecutionPlanCaptureCallback
@@ -331,11 +376,14 @@ class TpuSession:
         cat = SpillCatalog.get()
         # tmsan runtime sanitizer: record + assert the buffer lifecycle
         # state machine on every catalog/arena event while the query
-        # runs, then require a clean ledger (no leaks) afterwards
+        # runs, then require a clean ledger (no leaks) afterwards.
+        # Pool sessions install thread-locally: a per-query clean check
+        # must not flag co-running queries' live buffers as leaks.
+        from ..memory import memsan
         memsan_on = self.conf.get(cfg.MEMSAN_ENABLED)
         if memsan_on:
-            from ..memory import memsan
-            ledger = memsan.install()
+            ledger = memsan.install_local() if self._obs_isolation \
+                else memsan.install()
         if debug:
             cat.debug = True
             before = {b_id for b_id, *_ in cat.leak_report()}
@@ -367,6 +415,14 @@ class TpuSession:
                 self.release_plan_shuffles(final_plan)
                 with trace_span("phase:plan-retry", kind="phase"):
                     final_plan = self.prepare_plan(lp)
+                if ticket is not None and ticket.repaired:
+                    # the retry re-planned from scratch: re-shrink the
+                    # fresh plan so it still fits the admitted ticket
+                    from ..memory.admission import AdmissionController
+                    ctrl = AdmissionController.get()
+                    if ctrl is not None:
+                        self._repair_for_admission(final_plan,
+                                                   ctrl.budget_bytes)
                 self._obs_plan = final_plan
                 self._install_predictions(tracer, final_plan)
                 ctx = ExecContext(self.conf)
@@ -380,10 +436,11 @@ class TpuSession:
             if debug:
                 cat.debug = False
             if memsan_on:
+                self.last_peak_device_bytes = ledger.peak_device_bytes
                 if tracer is not None:
                     tracer.measured_peak_device_bytes = \
                         ledger.peak_device_bytes
-                memsan.uninstall()
+                self._memsan_uninstall(memsan)
             raise
         self.release_plan_shuffles(final_plan)
         if memsan_on:
@@ -400,10 +457,11 @@ class TpuSession:
                               "(leak or lifecycle violation)").inc()
                     raise
             finally:
+                self.last_peak_device_bytes = ledger.peak_device_bytes
                 if tracer is not None:
                     tracer.measured_peak_device_bytes = \
                         ledger.peak_device_bytes
-                memsan.uninstall()
+                self._memsan_uninstall(memsan)
         if debug:
             leaks = [l for l in cat.leak_report() if l[0] not in before]
             cat.debug = False
@@ -417,6 +475,81 @@ class TpuSession:
         if tracer is not None:
             self._flush_query_obs(tracer, None, eventlog_dir)
         return result
+
+    def _memsan_uninstall(self, memsan) -> None:
+        if self._obs_isolation:
+            memsan.uninstall_local()
+        else:
+            memsan.uninstall()
+
+    # -- byte-weighted admission (multi-tenant serving) ---------------------
+
+    def _admit_plan(self, final_plan):
+        """Admission for one prepared plan: its tmsan static peak-
+        device-bytes bound (TPU-L014) is the ticket.  A bound past the
+        whole budget first re-plans through the out-of-core repair so
+        the re-analyzed bound fits; then the ticket queues FIFO in the
+        controller.  Returns (ticket, controller), (None, None) when
+        admission is unconfigured — the single-tenant fast path."""
+        from ..memory.admission import AdmissionController
+        controller = AdmissionController.get()
+        if controller is None:
+            return None, None
+        conf = self.conf
+        bound = self._static_peak_bound(final_plan, conf)
+        repaired = False
+        if bound is not None and bound > controller.budget_bytes:
+            repaired = self._repair_for_admission(
+                final_plan, controller.budget_bytes)
+            if repaired:
+                bound = self._static_peak_bound(
+                    final_plan, conf,
+                    budget=controller.budget_bytes) or bound
+        ticket = controller.admit(
+            0 if bound is None else int(bound),
+            label=type(final_plan).__name__,
+            timeout_s=conf.get(cfg.SERVE_ADMISSION_TIMEOUT_MS) / 1000.0,
+            repaired=repaired)
+        return ticket, controller
+
+    def _static_peak_bound(self, final_plan, conf,
+                           budget=None) -> Optional[int]:
+        """The plan's conservative peak-HBM bound from the lifetime
+        pass; None when the analyzer cannot produce one (the query then
+        rides an unweighted 0-byte ticket — admission stays advisory,
+        never wrong-side-blocking)."""
+        try:
+            from ..analysis.lifetime import analyze_memory
+            c = conf if budget is None else \
+                conf.set(cfg.MEMSAN_HBM_BUDGET.key, int(budget))
+            b = analyze_memory(final_plan, c).bound(final_plan)
+            return None if b is None else int(b)
+        except Exception:
+            return None
+
+    def _repair_for_admission(self, final_plan, budget) -> bool:
+        """Re-plan an oversized ticket through the existing TPU-L014
+        repair: run the lifetime pass against the ADMISSION budget and
+        force oc_budget on each repairable frontier node (sort /
+        aggregate merge), so the query co-runs out-of-core instead of
+        hogging the whole budget."""
+        try:
+            from ..analysis.lifetime import (analyze_memory,
+                                             try_outofcore_repair)
+            conf2 = self.conf.set(cfg.MEMSAN_HBM_BUDGET.key,
+                                  int(budget))
+            res = analyze_memory(final_plan, conf2)
+            done = False
+            for d in res.diags:
+                if d.code == "TPU-L014" and d.node is not None:
+                    try:
+                        done = try_outofcore_repair(
+                            final_plan, d.node, conf2) or done
+                    except Exception:
+                        pass  # unrepairable node: queue at full size
+            return done
+        except Exception:
+            return False
 
     # -- continuous metrics -------------------------------------------------
     _health_monitor = None

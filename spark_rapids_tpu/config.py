@@ -717,6 +717,43 @@ REGRESS_HISTORY_DIR = conf("spark.rapids.tpu.regress.historyDir") \
          "fallbacks, fetch-crossing growth, operator row drift).") \
     .create_optional()
 
+# --- multi-tenant serving (admission control + session pool) --------------
+
+SERVE_ADMISSION_BUDGET = conf(
+    "spark.rapids.tpu.serve.hbmAdmissionBudgetBytes").bytes() \
+    .doc("Byte-weighted admission budget for concurrent serving: each "
+         "query presents its tmsan static peak-device-bytes bound "
+         "(TPU-L014, analysis/lifetime.py) as its ticket at plan time, "
+         "and tickets co-run only while their bounds sum to at most "
+         "this.  Oversized-but-repairable plans (sort / aggregate "
+         "merge) are re-planned through the out-of-core repair path "
+         "with a smaller oc_budget first; the rest queue FIFO until "
+         "serve.admissionTimeoutMs, then fail with the typed "
+         "AdmissionTimeout.  Unset disables admission control (the "
+         "single-tenant default: only the count-based "
+         "concurrentGpuTasks semaphore gates the device).") \
+    .check(lambda v: v >= 1, "must be >= 1") \
+    .create_optional()
+
+SERVE_ADMISSION_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.serve.admissionTimeoutMs").integer() \
+    .doc("How long a query may wait in the FIFO admission queue for "
+         "its byte ticket before failing with AdmissionTimeout — "
+         "typed backpressure a serving tier can retry or shed, never "
+         "a silent hang (and never an OOM from admitting anyway).") \
+    .check(lambda v: v >= 1, "must be >= 1") \
+    .create_with_default(30_000)
+
+SERVE_POOL_SIZE = conf("spark.rapids.tpu.serve.poolSize").integer() \
+    .doc("Logical sessions a SessionPool (api/pool.py) multiplexes "
+         "over the ONE process-wide runtime (device manager, spill "
+         "catalog, shuffle manager, metrics registry, compile "
+         "observatory).  Each borrowed session binds to the borrowing "
+         "thread with per-query tracer and memsan-ledger isolation; "
+         "size it to the offered concurrency, not the chip count.") \
+    .check(lambda v: v >= 1, "must be >= 1") \
+    .create_with_default(4)
+
 # Environment variables the engine reads directly (escape hatches that
 # must exist before config parsing, e.g. cache sizing at import time).
 # The repo lint (TPU-R002) fails on any SPARK_RAPIDS_* env read not

@@ -1,0 +1,197 @@
+"""Byte-weighted TPU admission control for multi-tenant serving.
+
+The count-based ``TpuSemaphore`` bounds HOW MANY tasks touch the device;
+it knows nothing about bytes, so two queries whose peaks sum past HBM
+can still co-run.  This controller closes that gap: at plan time each
+query presents its tmsan static peak-device-bytes bound (the TPU-L014
+machinery in analysis/lifetime.py) as an admission ticket, and tickets
+co-run only while their bounds sum to at most
+``spark.rapids.tpu.serve.hbmAdmissionBudgetBytes``.
+
+Contract (the serving invariants the stress tests assert):
+
+  * **Never OOM by construction** — admitted bounds never sum past the
+    budget, and the bound is conservative per query.
+  * **FIFO, never deadlock** — waiters queue in arrival order; a ticket
+    that cannot fit within ``serve.admissionTimeoutMs`` fails with the
+    typed ``AdmissionTimeout`` (backpressure the caller can act on),
+    never a silent hang.  A ticket larger than the whole budget waits
+    its timeout like any other — budget=1 byte must time out, not
+    vacuously pass.
+  * **Release on failure** — ``release()`` is idempotent and sits in
+    the session's ``finally``; a failed query can never strand bytes.
+
+Oversized-but-repairable plans are re-planned by the session through
+``try_outofcore_repair`` (smaller ``oc_budget``) before admission, so a
+giant sort/aggregate shrinks its ticket instead of hogging the budget.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+
+class AdmissionTimeout(RuntimeError):
+    """The admission ticket could not be granted within the timeout."""
+
+
+class AdmissionTicket:
+    """One admitted query's reservation against the byte budget."""
+
+    __slots__ = ("nbytes", "label", "repaired", "queue_wait_s",
+                 "released")
+
+    def __init__(self, nbytes: int, label: str, repaired: bool,
+                 queue_wait_s: float):
+        self.nbytes = nbytes
+        self.label = label
+        self.repaired = repaired
+        self.queue_wait_s = queue_wait_s
+        self.released = False
+
+
+def _metrics():
+    from ..obs import metrics as m
+    return m
+
+
+class AdmissionController:
+    """Process-wide FIFO byte-budget gate (None until configured: the
+    single-tenant path pays nothing)."""
+
+    _instance: Optional["AdmissionController"] = None
+    _ilock = threading.Lock()
+
+    def __init__(self, budget_bytes: int, timeout_s: float):
+        if budget_bytes < 1:
+            raise ValueError(f"admission budget must be >= 1 byte, "
+                             f"got {budget_bytes}")
+        self.budget_bytes = int(budget_bytes)
+        self.timeout_s = float(timeout_s)
+        self._cv = threading.Condition()
+        self._in_flight = 0
+        self._queue: deque = deque()  # waiter tokens, arrival order
+        self.max_in_flight_seen = 0
+
+    # -- process-wide configuration ------------------------------------------
+    @classmethod
+    def configure(cls, budget_bytes: Optional[int],
+                  timeout_s: float) -> Optional["AdmissionController"]:
+        """Install (budget set) or clear (budget None) the controller;
+        idempotent for unchanged values so pooled sessions sharing one
+        conf re-init without disturbing in-flight accounting."""
+        with cls._ilock:
+            if budget_bytes is None:
+                cls._instance = None
+                return None
+            inst = cls._instance
+            if inst is not None and \
+                    inst.budget_bytes == int(budget_bytes) and \
+                    inst.timeout_s == float(timeout_s):
+                return inst
+            cls._instance = AdmissionController(budget_bytes, timeout_s)
+            return cls._instance
+
+    @classmethod
+    def get(cls) -> Optional["AdmissionController"]:
+        with cls._ilock:
+            return cls._instance
+
+    @classmethod
+    def reset_for_tests(cls) -> None:
+        with cls._ilock:
+            cls._instance = None
+
+    # -- admission ------------------------------------------------------------
+    def _publish_gauges(self) -> None:
+        m = _metrics()
+        m.gauge("tpu_admission_queue_depth",
+                "queries waiting in the FIFO admission queue") \
+            .set(len(self._queue))
+        m.gauge("tpu_admission_bytes_in_flight",
+                "sum of admitted tickets' static peak-HBM bounds") \
+            .set(self._in_flight)
+
+    def admit(self, nbytes: int, label: str = "",
+              timeout_s: Optional[float] = None,
+              repaired: bool = False) -> AdmissionTicket:
+        """Block until ``nbytes`` fits in the budget (FIFO order) and
+        reserve it; raises ``AdmissionTimeout`` past the deadline."""
+        m = _metrics()
+        nbytes = max(int(nbytes), 0)
+        timeout = self.timeout_s if timeout_s is None else timeout_s
+        deadline = time.monotonic() + timeout
+        t0 = time.monotonic()
+        token = object()
+        queued = False
+        with self._cv:
+            self._queue.append(token)
+            try:
+                while self._queue[0] is not token or \
+                        self._in_flight + nbytes > self.budget_bytes:
+                    if not queued:
+                        queued = True
+                        m.counter(
+                            "tpu_admission_queued_total",
+                            "tickets that had to wait before "
+                            "admission").inc()
+                    self._publish_gauges()
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        m.counter(
+                            "tpu_admission_timeouts_total",
+                            "tickets that hit serve.admissionTimeoutMs "
+                            "without fitting in the budget").inc()
+                        raise AdmissionTimeout(
+                            f"admission ticket {label or '(query)'} "
+                            f"({nbytes} bytes) timed out after "
+                            f"{timeout:g}s: budget "
+                            f"{self.budget_bytes} bytes, "
+                            f"{self._in_flight} in flight, "
+                            f"{len(self._queue) - 1} ahead/behind in "
+                            f"queue")
+                    self._cv.wait(remaining)
+                self._in_flight += nbytes
+                if self._in_flight > self.max_in_flight_seen:
+                    self.max_in_flight_seen = self._in_flight
+            finally:
+                self._queue.remove(token)
+                self._publish_gauges()
+                # head departure (admitted OR timed out) can unblock
+                # the next waiter
+                self._cv.notify_all()
+        wait_s = time.monotonic() - t0
+        m.counter("tpu_admission_admitted_total",
+                  "tickets granted a byte reservation").inc()
+        if repaired:
+            m.counter("tpu_admission_repaired_total",
+                      "oversized tickets admitted after out-of-core "
+                      "re-planning shrank their bound").inc()
+        m.histogram("tpu_admission_queue_wait_seconds",
+                    "time from admit() to reservation").observe(wait_s)
+        return AdmissionTicket(nbytes, label, repaired, wait_s)
+
+    def release(self, ticket: AdmissionTicket) -> None:
+        """Return the ticket's bytes (idempotent: the session's finally
+        may race a failure path that already released)."""
+        with self._cv:
+            if ticket.released:
+                return
+            ticket.released = True
+            self._in_flight -= ticket.nbytes
+            self._publish_gauges()
+            self._cv.notify_all()
+
+    # -- introspection ---------------------------------------------------------
+    @property
+    def bytes_in_flight(self) -> int:
+        with self._cv:
+            return self._in_flight
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._queue)

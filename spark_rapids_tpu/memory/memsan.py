@@ -204,6 +204,7 @@ class ShadowLedger:
 # ---------------------------------------------------------------------------
 
 _ACTIVE: Optional[ShadowLedger] = None
+_TLS = threading.local()
 
 
 def install(strict: bool = True) -> ShadowLedger:
@@ -217,8 +218,23 @@ def uninstall() -> None:
     _ACTIVE = None
 
 
+def install_local(strict: bool = True) -> ShadowLedger:
+    """Thread-local install for concurrent serving (api/pool.py): each
+    pool query audits ITS OWN buffers — a per-query assert_clean must
+    not see co-running queries' live entries as leaks.  Single-session
+    flows keep the process-global slot, where helper threads (scan
+    prefetch, shuffle fetch) also report."""
+    _TLS.ledger = ShadowLedger(strict=strict)
+    return _TLS.ledger
+
+
+def uninstall_local() -> None:
+    _TLS.ledger = None
+
+
 def active_ledger() -> Optional[ShadowLedger]:
-    return _ACTIVE
+    led = getattr(_TLS, "ledger", None)
+    return led if led is not None else _ACTIVE
 
 
 class installed:

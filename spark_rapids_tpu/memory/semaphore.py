@@ -4,11 +4,19 @@ Ref: GpuSemaphore.scala:27-170 — bounds how many concurrent tasks may hold
 device memory at once (spark.rapids.sql.concurrentGpuTasks); a task
 acquires before its first device operation and releases at completion.
 Re-entrant per task, like the reference's per-task bookkeeping.
+
+The permit ledger is a mutex + condition variable rather than a raw
+``threading.Semaphore``: the re-entrancy check and the permit grab happen
+under ONE lock (two threads sharing a task id can no longer both miss the
+holders table and double-acquire, leaking a permit), and a stray
+release for a task that holds nothing is a no-op instead of inflating
+the permit count past ``max_concurrent``.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional
 
 
@@ -17,10 +25,13 @@ class TpuSemaphore:
     _lock = threading.Lock()
 
     def __init__(self, max_concurrent: int):
+        if max_concurrent < 1:
+            raise ValueError(f"max_concurrent must be >= 1, "
+                             f"got {max_concurrent}")
         self.max_concurrent = max_concurrent
-        self._sem = threading.Semaphore(max_concurrent)
-        self._holders: Dict[int, int] = {}
-        self._holders_lock = threading.Lock()
+        self._cv = threading.Condition()
+        self._permits = max_concurrent
+        self._holders: Dict[int, int] = {}  # task_id -> re-entry depth
 
     @classmethod
     def initialize(cls, max_concurrent: int) -> "TpuSemaphore":
@@ -34,35 +45,55 @@ class TpuSemaphore:
     def get(cls) -> "TpuSemaphore":
         with cls._lock:
             if cls._instance is None:
-                cls._instance = TpuSemaphore(1)
+                # before plugin init the configured width is still
+                # knowable — fabricating max_concurrent=1 here silently
+                # serialized every task on this path
+                import warnings
+
+                from .. import config as cfg
+                width = cfg.RapidsConf({}).get(cfg.CONCURRENT_TPU_TASKS)
+                warnings.warn(
+                    f"TpuSemaphore.get() before plugin initialization; "
+                    f"using the {cfg.CONCURRENT_TPU_TASKS.key} default "
+                    f"({width}) — TpuSemaphore.initialize() at plugin "
+                    f"startup is the supported path", RuntimeWarning,
+                    stacklevel=2)
+                cls._instance = TpuSemaphore(width)
             return cls._instance
 
     def acquire_if_necessary(self, task_id: int,
                              timeout: Optional[float] = None) -> bool:
         """Blocks until the task holds the semaphore (re-entrant)."""
-        with self._holders_lock:
-            if task_id in self._holders:
-                self._holders[task_id] += 1
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        with self._cv:
+            held = self._holders.get(task_id)
+            if held:
+                self._holders[task_id] = held + 1
                 return True
-        ok = self._sem.acquire(timeout=timeout) if timeout is not None \
-            else self._sem.acquire()
-        if ok:
-            with self._holders_lock:
-                self._holders[task_id] = 1
-        return ok
+            while self._permits <= 0:
+                remaining = None if deadline is None else \
+                    deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+            self._permits -= 1
+            self._holders[task_id] = 1
+            return True
 
     def release_if_necessary(self, task_id: int) -> None:
-        with self._holders_lock:
-            n = self._holders.get(task_id)
-            if n is None:
-                return
-            if n > 1:
-                self._holders[task_id] = n - 1
+        with self._cv:
+            depth = self._holders.get(task_id)
+            if depth is None:
+                return  # double-release: permits stay untouched
+            if depth > 1:
+                self._holders[task_id] = depth - 1
                 return
             del self._holders[task_id]
-        self._sem.release()
+            self._permits += 1
+            self._cv.notify()
 
     @property
     def holders(self) -> int:
-        with self._holders_lock:
+        with self._cv:
             return len(self._holders)

@@ -137,11 +137,18 @@ class HealthMonitor:
         ("memory", "tpu_memsan_dirty_ledgers_total", DOWN),
         ("shuffle", "tpu_shuffle_heartbeat_missed_total", DEGRADED),
         ("queries", "tpu_queries_failed_total", DEGRADED),
+        ("admission", "tpu_admission_timeouts_total", DEGRADED),
     )
+
+    # sustained admission backlog: queue depth at or above this for two
+    # consecutive snapshots means the byte budget is oversubscribed (one
+    # momentarily deep snapshot is ordinary burst absorption, not alert)
+    _QUEUE_DEEP = 3
 
     def __init__(self, reg: Optional[M.MetricsRegistry] = None):
         self._reg = reg
         self._prev: Dict[str, int] = {}
+        self._queue_deep_prev = False
         self._lock = threading.Lock()
 
     def snapshot(self) -> Dict:
@@ -160,6 +167,17 @@ class HealthMonitor:
                                               "delta": delta}
                 if _SEVERITY[comp_status] > _SEVERITY[entry["status"]]:
                     entry["status"] = comp_status
+            depth = _gauge_value(reg, "tpu_admission_queue_depth")
+            deep = depth is not None and depth >= self._QUEUE_DEEP
+            adm = components.setdefault("admission",
+                                        {"status": OK, "signals": {}})
+            adm["signals"]["tpu_admission_queue_depth"] = depth
+            adm["signals"]["tpu_admission_bytes_in_flight"] = \
+                _gauge_value(reg, "tpu_admission_bytes_in_flight")
+            if deep and self._queue_deep_prev and \
+                    _SEVERITY[DEGRADED] > _SEVERITY[adm["status"]]:
+                adm["status"] = DEGRADED
+            self._queue_deep_prev = deep
         probe_ok = _gauge_value(reg, "tpu_device_probe_ok")
         dev = components.setdefault("device",
                                     {"status": OK, "signals": {}})

@@ -290,6 +290,31 @@ def diff_fingerprints(old: Dict, new: Dict,
                 q, "wall_regression",
                 f"wall {ow}ms -> {nw}ms "
                 f"(> {wall_threshold_pct:g}% threshold)", False))
+    # serving fingerprints (bench.py --serve): the admission counter
+    # totals for a fixed mix+budget are deterministic (admitted,
+    # repaired, timeouts, completed, failed — queued is scheduling-
+    # dependent and deliberately excluded); latency percentiles are
+    # timing.  Both guarded on both runs carrying the fields, so a
+    # history spanning the serve upgrade never false-trips.
+    if "serve_counters" in old and "serve_counters" in new:
+        osc, nsc = old["serve_counters"] or {}, new["serve_counters"] or {}
+        changed = sorted(f for f in set(osc) & set(nsc)
+                         if osc[f] != nsc[f])
+        if changed:
+            out.append(Drift(
+                q, "serve_counter_drift",
+                "admission counters moved: " + ", ".join(
+                    f"{f} {osc[f]} -> {nsc[f]}" for f in changed),
+                True))
+    if wall_threshold_pct is not None:
+        for f in ("serve_p50_ms", "serve_p99_ms"):
+            if f in old and f in new:
+                ov, nv = old[f] or 0.0, new[f] or 0.0
+                if ov > 0 and nv > ov * (1.0 + wall_threshold_pct / 100.0):
+                    out.append(Drift(
+                        q, "serve_latency_regression",
+                        f"{f} {ov:.1f}ms -> {nv:.1f}ms "
+                        f"(> {wall_threshold_pct:g}% threshold)", False))
     return out
 
 

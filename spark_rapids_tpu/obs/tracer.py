@@ -388,6 +388,7 @@ class QueryTrace:
 # ---------------------------------------------------------------------------
 
 _ACTIVE: Optional[QueryTrace] = None
+_TLS = threading.local()
 
 
 def install(trace: QueryTrace) -> QueryTrace:
@@ -401,13 +402,28 @@ def uninstall() -> None:
     _ACTIVE = None
 
 
+def install_local(trace: QueryTrace) -> QueryTrace:
+    """Thread-local install for concurrent serving (api/pool.py): each
+    pool query's trace binds to ITS thread so co-running queries never
+    interleave spans.  Single-session flows keep the process-global
+    slot, where helper threads (scan prefetch, shuffle fetch) also
+    report."""
+    _TLS.active = trace
+    return trace
+
+
+def uninstall_local() -> None:
+    _TLS.active = None
+
+
 def active_tracer() -> Optional[QueryTrace]:
-    return _ACTIVE
+    tr = getattr(_TLS, "active", None)
+    return tr if tr is not None else _ACTIVE
 
 
 def trace_event(name: str, **attrs) -> None:
     """Record an instant event on the active trace (no-op otherwise)."""
-    tr = _ACTIVE
+    tr = active_tracer()
     if tr is not None:
         tr.event(name, **attrs)
 
@@ -416,7 +432,7 @@ def trace_event(name: str, **attrs) -> None:
 def trace_span(name: str, kind: str = SPAN, **attrs):
     """Span context manager against the active trace; yields a handle
     with ``.set(**attrs)`` (or an inert one when tracing is off)."""
-    tr = _ACTIVE
+    tr = active_tracer()
     if tr is None:
         yield _SpanHandle_NULL
         return

@@ -205,6 +205,15 @@ class TpuExecutorPlugin:
             self.device_manager = DeviceManager.initialize(self.conf)
             self.semaphore = TpuSemaphore.initialize(
                 self.conf.get(cfg.CONCURRENT_TPU_TASKS))
+            # byte-weighted admission (serve.hbmAdmissionBudgetBytes):
+            # configured alongside the count semaphore so both gates
+            # share one lifecycle; unset budget clears the controller
+            # (single-tenant sessions must not inherit a previous
+            # serving session's budget)
+            from .memory.admission import AdmissionController
+            AdmissionController.configure(
+                self.conf.get(cfg.SERVE_ADMISSION_BUDGET),
+                self.conf.get(cfg.SERVE_ADMISSION_TIMEOUT_MS) / 1000.0)
             self.spill_catalog = SpillCatalog.init_from_conf(self.conf)
             pinned = self.conf.get(cfg.PINNED_POOL_SIZE)
             if pinned and pinned > 0:

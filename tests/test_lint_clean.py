@@ -144,6 +144,23 @@ def test_run_lint_shuffle_gate_exits_zero():
     assert "shuffle gate clean" in proc.stdout, proc.stdout
 
 
+def test_run_lint_serve_gate_exits_zero():
+    """Tier-1 gate for multi-tenant serving: the golden four-query mix
+    replays 16 times across 4 concurrent pooled sessions under
+    byte-weighted admission — every result must equal the serial ground
+    truth, the admission books must balance (admitted = completed +
+    failed, zero timeouts, peak ticket bytes within budget), and no
+    dirty ledger, shuffle block, or spillable buffer may survive the
+    drain."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "devtools", "run_lint.py"),
+         "--serve"],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "serve gate clean" in proc.stdout, proc.stdout
+
+
 def test_baseline_is_empty_and_stays_empty():
     """PR-3 burned the last baselined TPU-R001 debt down to zero: the
     ratchet now enforces a spotless repo (deliberate exceptions are

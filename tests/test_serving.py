@@ -1,0 +1,398 @@
+"""Multi-tenant serving: byte-weighted admission control, the session
+pool, and the rewritten TpuSemaphore.
+
+Everything here runs in the shared tier-1 process, so each test restores
+the process-global singletons it touches (AdmissionController,
+TpuSemaphore._instance) — the fixtures below do that unconditionally.
+"""
+
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.memory.admission import (AdmissionController,
+                                               AdmissionTimeout)
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+
+
+@pytest.fixture
+def fresh_admission():
+    prev_sem = TpuSemaphore._instance
+    AdmissionController.reset_for_tests()
+    yield
+    AdmissionController.reset_for_tests()
+    TpuSemaphore._instance = prev_sem
+
+
+# ---------------------------------------------------------------------------
+# AdmissionController unit behavior
+# ---------------------------------------------------------------------------
+
+def test_admission_byte_bookkeeping(fresh_admission):
+    ctrl = AdmissionController.configure(1000, 5.0)
+    t1 = ctrl.admit(600)
+    t2 = ctrl.admit(300)
+    assert ctrl.bytes_in_flight == 900
+    assert ctrl.max_in_flight_seen == 900
+    ctrl.release(t1)
+    ctrl.release(t1)  # idempotent: a double release must not underflow
+    assert ctrl.bytes_in_flight == 300
+    ctrl.release(t2)
+    assert ctrl.bytes_in_flight == 0
+    assert ctrl.queue_depth == 0
+
+
+def test_admission_timeout_when_budget_full(fresh_admission):
+    ctrl = AdmissionController.configure(1000, 5.0)
+    t1 = ctrl.admit(900)
+    t0 = time.monotonic()
+    with pytest.raises(AdmissionTimeout):
+        ctrl.admit(200, timeout_s=0.2)
+    assert time.monotonic() - t0 >= 0.2
+    assert ctrl.queue_depth == 0  # the timed-out waiter left the queue
+    ctrl.release(t1)
+
+
+def test_oversized_ticket_queues_then_completes(fresh_admission):
+    """A ticket that does not fit RIGHT NOW (but fits the budget) must
+    wait its turn and then run — never error, never deadlock."""
+    ctrl = AdmissionController.configure(1000, 30.0)
+    t1 = ctrl.admit(900)
+    admitted_at = []
+
+    def waiter():
+        t2 = ctrl.admit(800, timeout_s=10)
+        admitted_at.append(ctrl.bytes_in_flight)
+        ctrl.release(t2)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.15)
+    assert ctrl.queue_depth == 1 and not admitted_at  # genuinely queued
+    ctrl.release(t1)
+    th.join(5)
+    assert not th.is_alive()
+    assert admitted_at == [800]
+    assert ctrl.max_in_flight_seen <= 1000
+
+
+def test_admission_is_fifo(fresh_admission):
+    """Strict arrival order: a small ticket that WOULD fit must not
+    overtake a queued larger one (head-of-line blocking is the
+    starvation guarantee, not a bug)."""
+    ctrl = AdmissionController.configure(1000, 30.0)
+    t1 = ctrl.admit(600)
+    order = []
+    ready = threading.Event()
+
+    def big():
+        t = ctrl.admit(500, timeout_s=10)  # 600+500 > 1000: waits
+        order.append("big")
+        time.sleep(0.05)
+        ctrl.release(t)
+
+    def small():
+        ready.wait(5)
+        t = ctrl.admit(10, timeout_s=10)   # fits, but behind big
+        order.append("small")
+        ctrl.release(t)
+
+    th_big = threading.Thread(target=big)
+    th_small = threading.Thread(target=small)
+    th_big.start()
+    time.sleep(0.1)   # big is queued first
+    th_small.start()
+    ready.set()
+    time.sleep(0.2)
+    assert order == []  # small did NOT jump the queue
+    ctrl.release(t1)
+    th_big.join(5)
+    th_small.join(5)
+    assert order == ["big", "small"]
+
+
+def test_configure_unset_budget_clears_controller(fresh_admission):
+    AdmissionController.configure(1000, 5.0)
+    assert AdmissionController.get() is not None
+    AdmissionController.configure(None, 5.0)
+    assert AdmissionController.get() is None
+
+
+def test_configure_same_values_keeps_in_flight_state(fresh_admission):
+    """Pooled sessions re-run plugin init with identical conf; the
+    controller must keep its in-flight accounting across that."""
+    ctrl = AdmissionController.configure(1000, 5.0)
+    t = ctrl.admit(400)
+    again = AdmissionController.configure(1000, 5.0)
+    assert again is ctrl
+    assert again.bytes_in_flight == 400
+    ctrl.release(t)
+
+
+# ---------------------------------------------------------------------------
+# session-path admission (the tmsan bound as the ticket)
+# ---------------------------------------------------------------------------
+
+def _agg_query(session, offset: int = 0, n: int = 400):
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    df = session.create_dataframe({
+        "k": (np.arange(n) % 7).astype(np.int64),
+        "v": np.arange(n, dtype=np.float64) + offset,
+    })
+    return (df.group_by(col("k"))
+            .agg(F.sum(col("v")).alias("sv"))
+            .collect())
+
+
+def test_budget_one_byte_times_out(fresh_admission):
+    """Anti-vacuity for the whole admission path: with a 1-byte budget
+    every real plan's static bound is oversized and unrepairable below
+    budget, so the query must surface a typed AdmissionTimeout — not an
+    OOM, not a hang, not a silent pass."""
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession({
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.tpu.serve.hbmAdmissionBudgetBytes": "1",
+        "spark.rapids.tpu.serve.admissionTimeoutMs": "300",
+    })
+    t0 = time.monotonic()
+    with pytest.raises(AdmissionTimeout):
+        _agg_query(s)
+    assert time.monotonic() - t0 < 30  # timed out, did not hang
+    ctrl = AdmissionController.get()
+    assert ctrl is not None and ctrl.bytes_in_flight == 0
+
+
+def test_admission_released_after_query(fresh_admission):
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession({
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.tpu.serve.hbmAdmissionBudgetBytes":
+            str(1 << 30),
+    })
+    out = _agg_query(s)
+    assert out.num_rows == 7
+    ctrl = AdmissionController.get()
+    assert ctrl is not None
+    assert ctrl.bytes_in_flight == 0 and ctrl.queue_depth == 0
+    assert ctrl.max_in_flight_seen > 0  # a real nonzero ticket flowed
+
+
+# ---------------------------------------------------------------------------
+# 8-thread mixed-query stress over the pool
+# ---------------------------------------------------------------------------
+
+def test_eight_thread_mixed_query_stress(fresh_admission):
+    """Eight client threads over a 4-session pool: per-thread exact
+    results, zero dirty ledgers, admitted bytes never past the budget,
+    and balanced admission books."""
+    import concurrent.futures as cf
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.pool import SessionPool
+    from spark_rapids_tpu.obs.metrics import registry
+
+    budget = 256 << 20
+    pool = SessionPool(4, {
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.tpu.memsan.enabled": True,
+        "spark.rapids.tpu.serve.hbmAdmissionBudgetBytes": str(budget),
+        "spark.rapids.tpu.serve.admissionTimeoutMs": "60000",
+    })
+    reg = registry()
+    names = ("tpu_admission_admitted_total", "tpu_queries_completed_total",
+             "tpu_queries_failed_total", "tpu_memsan_dirty_ledgers_total",
+             "tpu_admission_timeouts_total")
+    base = {nm: reg.counter(nm).value() for nm in names}
+    n = 1200
+    k = (np.arange(n) % 7).astype(np.int64)
+
+    def agg_worker(i):
+        v = np.arange(n, dtype=np.float64) + i * 1000
+
+        def work(s):
+            from collections import defaultdict
+            out = (s.create_dataframe({"k": k, "v": v})
+                   .group_by(col("k"))
+                   .agg(F.sum(col("v")).alias("sv")).collect())
+            want = defaultdict(float)
+            for kk, vv in zip(k, v):
+                want[int(kk)] += vv
+            got = dict(zip(out.column("k").to_pylist(),
+                           out.column("sv").to_pylist()))
+            assert got == pytest.approx(dict(want)), f"thread {i}"
+        pool.run(work)
+
+    def sort_worker(i):
+        v = np.random.default_rng(i).permutation(n).astype(np.int64)
+
+        def work(s):
+            from spark_rapids_tpu.api.column import col as c
+            out = (s.create_dataframe({"v": v})
+                   .sort(c("v")).collect())
+            assert out.column("v").to_pylist() == sorted(v.tolist()), \
+                f"thread {i}"
+        pool.run(work)
+
+    jobs = [(agg_worker if i % 2 == 0 else sort_worker, i)
+            for i in range(16)]
+    with cf.ThreadPoolExecutor(max_workers=8) as ex:
+        futs = [ex.submit(fn, i) for fn, i in jobs]
+        for f in futs:
+            f.result()  # re-raise any worker assertion
+    pool.drain(timeout=30)
+    pool.close()
+
+    delta = {nm: reg.counter(nm).value() - base[nm] for nm in names}
+    assert delta["tpu_memsan_dirty_ledgers_total"] == 0
+    assert delta["tpu_admission_timeouts_total"] == 0
+    assert delta["tpu_admission_admitted_total"] == 16
+    assert delta["tpu_admission_admitted_total"] == \
+        delta["tpu_queries_completed_total"] + \
+        delta["tpu_queries_failed_total"]
+    ctrl = AdmissionController.get()
+    assert ctrl is not None
+    assert 0 < ctrl.max_in_flight_seen <= budget
+    assert ctrl.bytes_in_flight == 0 and ctrl.queue_depth == 0
+
+
+def test_pool_binds_active_session_per_thread(fresh_admission):
+    from spark_rapids_tpu.api.pool import SessionPool
+    from spark_rapids_tpu.api.session import TpuSession
+
+    pool = SessionPool(2, {"spark.rapids.sql.enabled": True})
+    seen = []
+
+    def work(s):
+        assert TpuSession.active() is s
+        seen.append(s)
+    pool.run(work)
+    pool.run(work)
+    pool.close()
+    assert len(seen) == 2
+
+
+# ---------------------------------------------------------------------------
+# health: sustained admission backlog degrades
+# ---------------------------------------------------------------------------
+
+def test_health_degrades_on_sustained_deep_queue():
+    from spark_rapids_tpu.obs.health import DEGRADED, OK, HealthMonitor
+    from spark_rapids_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    mon = HealthMonitor(reg)
+    depth = reg.gauge("tpu_admission_queue_depth", "d")
+    depth.set(HealthMonitor._QUEUE_DEEP)
+    # one deep snapshot is burst absorption, not an alert
+    snap = mon.snapshot()
+    assert snap["components"]["admission"]["status"] == OK
+    # deep for a SECOND consecutive snapshot -> degraded
+    snap = mon.snapshot()
+    assert snap["components"]["admission"]["status"] == DEGRADED
+    assert snap["status"] == DEGRADED
+    depth.set(0)
+    assert mon.snapshot()["components"]["admission"]["status"] == OK
+
+
+def test_health_degrades_on_admission_timeouts():
+    from spark_rapids_tpu.obs.health import DEGRADED, HealthMonitor
+    from spark_rapids_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    mon = HealthMonitor(reg)
+    assert mon.snapshot()["status"] == "ok"
+    reg.counter("tpu_admission_timeouts_total", "d").inc()
+    snap = mon.snapshot()
+    assert snap["components"]["admission"]["status"] == DEGRADED
+
+
+# ---------------------------------------------------------------------------
+# history: serve fingerprint drift detection
+# ---------------------------------------------------------------------------
+
+def test_serve_fingerprint_drift_detection():
+    from spark_rapids_tpu.obs.history import (deterministic_drift,
+                                              diff_fingerprints)
+    base = {
+        "sql_id": 100_000, "description": "serve_mix",
+        "serve_counters": {"admitted": 52, "repaired": 0,
+                           "timeouts": 0, "completed": 52, "failed": 0},
+        "serve_p50_ms": 2000.0, "serve_p99_ms": 3000.0,
+    }
+    same = dict(base)
+    assert diff_fingerprints(base, same, wall_threshold_pct=50) == []
+    moved = dict(base, serve_counters=dict(base["serve_counters"],
+                                           timeouts=2, completed=50))
+    drifts = diff_fingerprints(base, moved)
+    kinds = [d.kind for d in drifts]
+    assert "serve_counter_drift" in kinds
+    assert deterministic_drift(drifts)
+    slower = dict(base, serve_p99_ms=9000.0)
+    drifts = diff_fingerprints(base, slower, wall_threshold_pct=50)
+    assert [d.kind for d in drifts] == ["serve_latency_regression"]
+    assert not deterministic_drift(drifts)  # timing, never gates CI
+    # a run recorded before the serve fields existed never false-trips
+    legacy = {"sql_id": 100_000, "description": "serve_mix"}
+    assert diff_fingerprints(legacy, base, wall_threshold_pct=50) == []
+
+
+# ---------------------------------------------------------------------------
+# TpuSemaphore seed fixes
+# ---------------------------------------------------------------------------
+
+def test_semaphore_get_before_init_warns_and_reads_config(
+        fresh_admission):
+    """The seed fabricated max_concurrent=1 silently — every task on
+    this path serialized.  get() must now warn and honor the configured
+    default width (spark.rapids.sql.concurrentGpuTasks = 2)."""
+    TpuSemaphore._instance = None
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        sem = TpuSemaphore.get()
+    assert any(issubclass(w.category, RuntimeWarning) for w in caught)
+    assert sem.max_concurrent == 2
+
+
+def test_semaphore_double_release_does_not_inflate_permits(
+        fresh_admission):
+    sem = TpuSemaphore(1)
+    assert sem.acquire_if_necessary(1)
+    sem.release_if_necessary(1)
+    sem.release_if_necessary(1)  # stray: must be a no-op
+    sem.release_if_necessary(99)  # never-held task: also a no-op
+    assert sem.acquire_if_necessary(2)
+    # if the strays inflated the permit count past max_concurrent=1,
+    # this third task would squeeze in alongside task 2
+    assert not sem.acquire_if_necessary(3, timeout=0.05)
+    sem.release_if_necessary(2)
+    assert sem.acquire_if_necessary(3, timeout=1.0)
+    sem.release_if_necessary(3)
+
+
+def test_semaphore_reentrant_across_threads_same_task(fresh_admission):
+    """Two threads sharing one task id must both hold without consuming
+    two permits (the seed's check-then-acquire race double-acquired)."""
+    sem = TpuSemaphore(1)
+    results = []
+
+    def worker():
+        results.append(sem.acquire_if_necessary(7, timeout=2.0))
+
+    threads = [threading.Thread(target=worker) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(5)
+    assert results == [True, True]
+    sem.release_if_necessary(7)  # depth 2 -> 1: still held
+    assert sem.holders == 1
+    sem.release_if_necessary(7)
+    assert sem.holders == 0
+    assert sem.acquire_if_necessary(8, timeout=1.0)
+    sem.release_if_necessary(8)
