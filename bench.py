@@ -322,14 +322,22 @@ def measure_big_join(cap_bytes: int = _OLD_BUILD_CAP_BYTES) -> dict:
 
 
 def run_one_suite(name: str, n_rows: int, cache_dir: str,
-                  ledger_dir: str = "") -> None:
+                  ledger_dir: str = "", accuracy_history: str = "",
+                  feedback: bool = False) -> None:
     """Internal mode (--one-suite): run ONE suite query in THIS fresh
     process against the given persistent compile cache dir, and print
     the compile observatory's totals.  The --compile-report driver runs
     this twice per suite — a cold subprocess (empty cache) then a warm
     one (populated cache) — so cold/warm compile cost and the distinct-
     program count are measured per suite instead of today's single
-    lumped first-run-minus-warm `compile_s` guess."""
+    lumped first-run-minus-warm `compile_s` guess.
+
+    With `accuracy_history` set (the --accuracy driver), the session
+    also runs traced against that regression HistoryDir, so the
+    estimator ledger records predicted-vs-actual for every operator —
+    and `feedback=True` (the warm arm) blends the prior cold arm's
+    recorded actuals back into the estimates first.  SUITE_JSON then
+    carries this process's mean relative row/byte estimate error."""
     from spark_rapids_tpu.api.session import TpuSession
     from spark_rapids_tpu.obs.compileprof import CompileObservatory
     fact, dim = make_tables(n_rows)
@@ -348,6 +356,12 @@ def run_one_suite(name: str, n_rows: int, cache_dir: str,
         if ledger_dir:
             b = b.config("spark.rapids.tpu.compile.ledgerDir",
                          ledger_dir)
+        if accuracy_history:
+            b = (b.config("spark.rapids.tpu.regress.historyDir",
+                          accuracy_history)
+                 .config("spark.rapids.tpu.trace.enabled", True)
+                 .config("spark.rapids.tpu.feedback.enabled",
+                         feedback))
         s = b.get_or_create()
         qs = dict(queries(s, fact, dim, pq_path, root))
         t0 = time.perf_counter()
@@ -361,7 +375,7 @@ def run_one_suite(name: str, n_rows: int, cache_dir: str,
             "tpu_jit_persistent_cache_hits_total").value()
         disk_misses = reg.counter(
             "tpu_jit_persistent_cache_misses_total").value()
-        print("SUITE_JSON=" + json.dumps({
+        payload = {
             "suite": name, "wall_s": round(wall, 3),
             "compile_s": snap["compile_seconds_total"],
             "trace_s": snap["trace_seconds_total"],
@@ -371,13 +385,24 @@ def run_one_suite(name: str, n_rows: int, cache_dir: str,
             "builds": snap["builds"],
             "prewarm_hits": snap["prewarm_hits"],
             "prewarm_s": snap["prewarm_seconds"],
-            "disk_hits": disk_hits, "disk_misses": disk_misses}))
+            "disk_hits": disk_hits, "disk_misses": disk_misses}
+        if accuracy_history:
+            from spark_rapids_tpu.obs.estimator import EstimatorLedger
+            est = EstimatorLedger.get().snapshot()
+            payload.update({
+                "est_observations": est["observations"],
+                "mean_rows_err": est["mean_rows_err"],
+                "mean_bytes_err": est["mean_bytes_err"],
+                "calibration_score": est["calibration_score"]})
+        print("SUITE_JSON=" + json.dumps(payload))
     finally:
         shutil.rmtree(root, ignore_errors=True)
 
 
 def _one_suite_subprocess(name: str, n_rows: int, cache_dir: str,
-                          ledger_dir: str = ""):
+                          ledger_dir: str = "",
+                          accuracy_history: str = "",
+                          feedback: bool = False):
     """One fresh-process suite run; returns the parsed SUITE_JSON."""
     import subprocess
     env = dict(os.environ)
@@ -386,6 +411,10 @@ def _one_suite_subprocess(name: str, n_rows: int, cache_dir: str,
            f"--one-suite={name}", f"--cache-dir={cache_dir}"]
     if ledger_dir:
         cmd.append(f"--ledger-dir={ledger_dir}")
+    if accuracy_history:
+        cmd.append(f"--accuracy-history={accuracy_history}")
+        if feedback:
+            cmd.append("--with-feedback")
     r = subprocess.run(
         cmd, capture_output=True, text=True, timeout=900, env=env)
     for line in r.stdout.splitlines():
@@ -424,6 +453,41 @@ def measure_compile_report(n_rows: int) -> dict:
         finally:
             shutil.rmtree(cache_dir, ignore_errors=True)
             shutil.rmtree(ledger_dir, ignore_errors=True)
+    return report
+
+
+def measure_accuracy(n_rows: int) -> dict:
+    """Per-suite estimator accuracy, cold model vs warm ledger: each
+    suite runs in a cold subprocess (fresh regression HistoryDir — the
+    static cost model alone) and then a warm one (same HistoryDir with
+    ``spark.rapids.tpu.feedback.enabled``, so the session loads the
+    cold arm's estimator ledger and blends its recorded actuals into
+    the estimates).  The per-arm mean relative row/byte estimate error
+    comes straight off each subprocess's EstimatorLedger snapshot —
+    the cold->warm delta is the measured value of closing the
+    predict->execute loop, per workload shape."""
+    report = {}
+    for name in _SUITE_NAMES:
+        hist_dir = tempfile.mkdtemp(prefix=f"tpu_acc_hist_{name}_")
+        cache_dir = tempfile.mkdtemp(prefix=f"tpu_acc_cache_{name}_")
+        try:
+            cold = _one_suite_subprocess(name, n_rows, cache_dir,
+                                         accuracy_history=hist_dir)
+            warm = _one_suite_subprocess(name, n_rows, cache_dir,
+                                         accuracy_history=hist_dir,
+                                         feedback=True)
+            report[name] = {
+                "rows_err_cold": cold["mean_rows_err"],
+                "rows_err_warm": warm["mean_rows_err"],
+                "bytes_err_cold": cold["mean_bytes_err"],
+                "bytes_err_warm": warm["mean_bytes_err"],
+                "est_observations": cold["est_observations"],
+                "calibration_cold": cold["calibration_score"],
+                "calibration_warm": warm["calibration_score"],
+            }
+        finally:
+            shutil.rmtree(hist_dir, ignore_errors=True)
+            shutil.rmtree(cache_dir, ignore_errors=True)
     return report
 
 
@@ -637,7 +701,9 @@ def measure_serve(fact, dim, pq_path, concurrency: int = 8,
     reg = obs_metrics.registry()
 
     def counters():
-        out = {n: reg.counter(f"tpu_admission_{n}_total").value()
+        # admission counters are tenant-labeled; total() sums the fleet
+        out = {n: reg.counter(f"tpu_admission_{n}_total",
+                              labelnames=("tenant",)).total()
                for n in ("admitted", "queued", "timeouts", "repaired")}
         out["completed"] = reg.counter(
             "tpu_queries_completed_total").value()
@@ -866,15 +932,19 @@ def main():
     n_rows = int(pos[0]) if pos else 1_000_000
     one_suite = _arg_value("--one-suite")
     if one_suite:
-        # internal mode used by --compile-report's cold/warm subprocesses
+        # internal mode used by the --compile-report and --accuracy
+        # drivers' cold/warm subprocesses
         run_one_suite(one_suite, n_rows, _arg_value("--cache-dir", ""),
-                      _arg_value("--ledger-dir", ""))
+                      _arg_value("--ledger-dir", ""),
+                      _arg_value("--accuracy-history", ""),
+                      "--with-feedback" in sys.argv[1:])
         return
     with_serve = "--serve" in sys.argv[1:]
     with_pyspark = "--baseline=pyspark" in sys.argv[1:]
     with_trace_guard = "--trace-overhead" in sys.argv[1:]
     with_metrics_guard = "--metrics-overhead" in sys.argv[1:]
     with_compile_report = "--compile-report" in sys.argv[1:]
+    with_accuracy = "--accuracy" in sys.argv[1:]
     with_record = "--record" in sys.argv[1:]
     with_check = "--check" in sys.argv[1:]
     with_big_join = "--skip-big-join" not in sys.argv[1:]
@@ -969,6 +1039,9 @@ def main():
     compile_report = None
     if with_compile_report:
         compile_report = measure_compile_report(n_rows)
+    accuracy_report = None
+    if with_accuracy:
+        accuracy_report = measure_accuracy(n_rows)
     tpu_total = sum(tpu.values())
     cpu_total = sum(cpu.values())
     # rows processed: each query consumes the fact table once
@@ -987,6 +1060,8 @@ def main():
             # lumped first-run-minus-warm guess
             del detail[k]["compile_s"]
             detail[k].update(compile_report[k])
+        if accuracy_report is not None and k in accuracy_report:
+            detail[k].update(accuracy_report[k])
     big_join = None
     if with_big_join:
         # once, not in the repeated suite loop: the measurement IS a

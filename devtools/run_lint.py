@@ -64,6 +64,18 @@ a silent slice-view write (zero saved bytes), or a transport leg whose
 fetched-block/byte counters disagree with what the server actually
 registered.
 
+--feedback runs the estimator-observatory gate: the golden corpus
+replays cold (fresh estimator ledger, static cost model) then warm
+(feedback-directed planning over the cold arm's ledger) in fresh
+subprocesses; the warm replay's mean relative row-estimate error must
+be STRICTLY below the cold one, and TWO warm replays over identical
+ledger snapshots must show zero deterministic fingerprint drift
+(feedback-directed planning must be reproducible, never thrash) —
+plus anti-vacuity: an injected 100x row misestimate at a shuffle
+boundary must trigger a recorded re-plan whose three sinks (replan
+span, tpu_replan_total, ledger event) agree, with the join result
+bit-exact against the CPU-engine ground truth.
+
     python devtools/run_lint.py                    # repo check
     python devtools/run_lint.py --update-baseline  # re-freeze debt
     python devtools/run_lint.py --interp           # plan typechecker gate
@@ -73,6 +85,7 @@ registered.
     python devtools/run_lint.py --metrics          # metrics/health gate
     python devtools/run_lint.py --jit              # compile-observatory gate
     python devtools/run_lint.py --shuffle          # distributed-shuffle gate
+    python devtools/run_lint.py --feedback         # estimator-observatory gate
 """
 
 import json
@@ -974,10 +987,13 @@ def run_serve_gate() -> int:
     if dirty:
         failures += 1
         print(f"SERVE: {dirty} dirty memsan ledger(s) under concurrency")
-    admitted = m.counter("tpu_admission_admitted_total").value()
+    # admission counters are tenant-labeled; total() sums the fleet
+    admitted = m.counter("tpu_admission_admitted_total",
+                         labelnames=("tenant",)).total()
     completed = m.counter("tpu_queries_completed_total").value()
     failed = m.counter("tpu_queries_failed_total").value()
-    timeouts = m.counter("tpu_admission_timeouts_total").value()
+    timeouts = m.counter("tpu_admission_timeouts_total",
+                         labelnames=("tenant",)).total()
     if admitted != completed + failed:
         failures += 1
         print(f"SERVE: admission books don't balance: {admitted} "
@@ -1019,6 +1035,261 @@ def run_serve_gate() -> int:
     return 0
 
 
+# the feedback gate's corpus: the regress corpus queries, run traced
+# against an estimator ledger dir.  "cold" records the static model's
+# errors; "warm" loads the cold arm's ledger and blends its recorded
+# actuals back into the estimates (spark.rapids.tpu.feedback.enabled).
+# Fresh subprocess per arm: the ledger singleton, jit caches and plan
+# caches all start identical, so cold vs warm isolates the feedback.
+_FEEDBACK_CORPUS = r"""
+import json
+import sys
+import numpy as np
+import pyarrow as pa
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import col
+from spark_rapids_tpu.obs.estimator import EstimatorLedger
+
+hist_dir, eventlog_dir, arm = sys.argv[1], sys.argv[2], sys.argv[3]
+rng = np.random.default_rng(1234)
+fact = pa.table({
+    "k": pa.array((rng.integers(0, 97, 4000)).astype(np.int64)),
+    "v": pa.array(rng.integers(-1000, 1000, 4000).astype(np.int64)),
+})
+dim = pa.table({
+    "k": pa.array(np.arange(97, dtype=np.int64)),
+    "w": pa.array(np.arange(97, dtype=np.int64) * 3),
+})
+s = (TpuSession.builder()
+     .config("spark.rapids.sql.enabled", True)
+     .config("spark.rapids.tpu.singleChipFuse", "off")
+     .config("spark.rapids.tpu.sort.compileLean", "off")
+     .config("spark.rapids.tpu.trace.enabled", True)
+     .config("spark.rapids.tpu.regress.historyDir", hist_dir)
+     .config("spark.rapids.tpu.feedback.enabled", arm == "warm")
+     .config("spark.rapids.tpu.eventLog.dir", eventlog_dir)
+     .get_or_create())
+fdf = s.create_dataframe(fact, num_partitions=2)
+ddf = s.create_dataframe(dim)
+out1 = (fdf.filter(col("v") > -500).group_by(col("k"))
+        .agg(F.sum(col("v")).alias("sv"), F.count("*").alias("c"))
+        .collect())
+assert out1.num_rows == 97, out1.num_rows
+out2 = (fdf.join(ddf, on="k", how="inner").group_by(col("k"))
+        .agg(F.sum(col("w")).alias("sw")).collect())
+assert out2.num_rows == 97, out2.num_rows
+out3 = fdf.sort(col("k"), col("v")).collect()
+assert out3.num_rows == 4000, out3.num_rows
+print("EST_JSON=" + json.dumps(EstimatorLedger.get().snapshot()))
+"""
+
+
+# anti-vacuity corpus: the static row model is sabotaged by 100x at
+# shuffle boundaries, so the measured map output disagrees with the
+# prediction by exactly the factor the re-planner keys on.  The gate
+# demands a recorded strategy_switch whose three sinks agree AND a
+# bit-exact join result against the CPU-engine ground truth.
+_MISESTIMATE_CORPUS = r"""
+import json
+import os
+import sys
+from spark_rapids_tpu.plan import cost
+
+_orig = cost._static_rows
+
+
+def _skewed(node, child_rows):
+    r = _orig(node, child_rows)
+    if type(node).__name__ == "ShuffleExchangeExec":
+        return r / 100.0  # injected 100x row misestimate
+    return r
+
+
+cost._static_rows = _skewed
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.obs import metrics as m
+from spark_rapids_tpu.obs.estimator import EstimatorLedger
+
+hist_dir = sys.argv[1]
+s = TpuSession({
+    "spark.rapids.sql.enabled": True,
+    "spark.rapids.tpu.regress.historyDir": hist_dir,
+    "spark.rapids.tpu.trace.enabled": True,
+    "spark.rapids.tpu.feedback.enabled": True,
+    "spark.rapids.tpu.singleChipFuse": "off",
+    "spark.rapids.sql.autoBroadcastJoinThreshold": 0,
+    "spark.rapids.tpu.serve.hbmAdmissionBudgetBytes": 1 << 30,
+})
+n = 2000
+left = s.create_dataframe(
+    {"k": [i % 50 for i in range(n)], "v": list(range(n))},
+    num_partitions=4)
+right = s.create_dataframe(
+    {"k": list(range(50)), "w": [i * 10 for i in range(50)]},
+    num_partitions=4)
+out = left.join(right, on="k").collect()
+
+spans = [sp for sp in s.last_query_trace().spans
+         if sp.name == "replan"]
+fam = m.registry().counter("tpu_replan_total",
+                           labelnames=("decision", "cause"))
+metric_n = int(sum(ch.value for _, ch in fam.series()))
+ledger_n = 0
+with open(os.path.join(hist_dir, "estimator_ledger.jsonl")) as f:
+    for line in f:
+        if line.strip() and \
+                json.loads(line).get("event") == "replan":
+            ledger_n += 1
+switches = [sp for sp in spans
+            if sp.attrs.get("decision") == "strategy_switch"
+            and sp.attrs.get("cause") == "row_misestimate"]
+
+# exact results: the re-plan must never change the answer
+cost._static_rows = _orig
+s2 = TpuSession({"spark.rapids.sql.enabled": False})
+truth = left.join(right, on="k").collect()
+
+
+def canon(t):
+    t = t.select(sorted(t.column_names))
+    return t.combine_chunks().sort_by(
+        [(c, "ascending") for c in t.column_names])
+
+
+print("REPLAN_JSON=" + json.dumps({
+    "rows": out.num_rows,
+    "spans": len(spans), "metric": metric_n, "ledger": ledger_n,
+    "strategy_switches": len(switches),
+    "snapshot_replans": EstimatorLedger.get().snapshot()["replans"],
+    "exact": bool(canon(out).equals(canon(truth)))}))
+"""
+
+
+def _feedback_subprocess(script, args, marker):
+    """One fresh-process feedback-gate leg; returns the marker JSON or
+    None (with the transcript printed) on failure."""
+    import subprocess
+    r = subprocess.run(
+        [sys.executable, "-c", script, *args],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS=os.environ.get(
+            "JAX_PLATFORMS", "cpu")))
+    payload = None
+    for line in r.stdout.splitlines():
+        if line.startswith(marker + "="):
+            payload = json.loads(line[len(marker) + 1:])
+    if r.returncode != 0 or payload is None:
+        print(f"FEEDBACK: subprocess failed rc={r.returncode}:\n"
+              f"{r.stdout}\n{r.stderr}")
+        return None
+    return payload
+
+
+def run_feedback_gate() -> int:
+    """Estimator-observatory gate: cold-then-warm golden replay (warm
+    must be strictly more accurate; two warm replays over identical
+    ledger snapshots must show zero deterministic drift) plus the
+    injected-misestimate re-plan anti-vacuity leg."""
+    import shutil
+    import tempfile
+
+    from spark_rapids_tpu.obs.history import (deterministic_drift,
+                                              diff_runs,
+                                              distill_event_log)
+
+    failures = 0
+    root = tempfile.mkdtemp(prefix="feedback_gate_")
+    try:
+        cold_hist = os.path.join(root, "hist_cold")
+        os.makedirs(cold_hist)
+        est, fps = {}, {}
+        # cold first (records the ledger), then two warm replays over
+        # IDENTICAL copies of the cold ledger — each warm arm appends
+        # its own observations, so sharing one dir would hand warm2 a
+        # different (grown) ledger and make the drift diff meaningless
+        arms = [("cold", cold_hist), ("warm", None), ("warm2", None)]
+        for i, (arm, hist) in enumerate(arms):
+            if hist is None:
+                hist = os.path.join(root, f"hist_{arm}")
+                shutil.copytree(cold_hist, hist)
+                arms[i] = (arm, hist)
+            evt = os.path.join(root, f"evt_{arm}")
+            os.makedirs(evt)
+            payload = _feedback_subprocess(
+                _FEEDBACK_CORPUS,
+                [hist, evt, "cold" if arm == "cold" else "warm"],
+                "EST_JSON")
+            if payload is None:
+                return 1
+            est[arm] = payload
+            logs = [f for f in os.listdir(evt)
+                    if f.startswith("events_")]
+            fps[arm] = {"queries": distill_event_log(
+                os.path.join(evt, logs[0]))} if logs else None
+
+        if est["cold"]["observations"] == 0:
+            failures += 1
+            print("FEEDBACK: vacuous gate — the cold replay recorded "
+                  "no observations")
+        if not est["warm"]["feedback_enabled"]:
+            failures += 1
+            print("FEEDBACK: warm arm ran without feedback enabled")
+        cold_err = est["cold"]["mean_rows_err"]
+        warm_err = est["warm"]["mean_rows_err"]
+        if not warm_err < cold_err:
+            failures += 1
+            print(f"FEEDBACK: warm ledger did not sharpen the model "
+                  f"(warm mean rel row error {warm_err} !< cold "
+                  f"{cold_err})")
+        if fps["warm"] is None or fps["warm2"] is None:
+            failures += 1
+            print("FEEDBACK: corpus replay left no event log to diff")
+        else:
+            for dr in deterministic_drift(
+                    diff_runs(fps["warm"], fps["warm2"])):
+                failures += 1
+                print(f"FEEDBACK DRIFT warm replay 1 -> 2: "
+                      f"{dr.render()}")
+
+        # anti-vacuity: the injected 100x misestimate MUST re-plan,
+        # the three sinks must agree, and the answer must not change
+        mhist = os.path.join(root, "mis_hist")
+        os.makedirs(mhist)
+        rep = _feedback_subprocess(
+            _MISESTIMATE_CORPUS, [mhist], "REPLAN_JSON")
+        if rep is None:
+            return 1
+        if rep["strategy_switches"] < 1:
+            failures += 1
+            print(f"FEEDBACK: injected 100x misestimate did not "
+                  f"trigger a strategy_switch re-plan ({rep})")
+        if rep["spans"] < 1 or not (
+                rep["spans"] == rep["metric"] == rep["ledger"]
+                == rep["snapshot_replans"]):
+            failures += 1
+            print(f"FEEDBACK: re-plan sinks disagree — spans "
+                  f"{rep['spans']}, tpu_replan_total {rep['metric']}, "
+                  f"ledger events {rep['ledger']}, snapshot "
+                  f"{rep['snapshot_replans']}")
+        if not rep["exact"]:
+            failures += 1
+            print("FEEDBACK: re-planned join diverged from the "
+                  "CPU-engine ground truth")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    if failures:
+        print(f"feedback gate: {failures} failure(s)")
+        return 1
+    print(f"feedback gate clean (warm replay mean row error "
+          f"{warm_err} < cold {cold_err} over "
+          f"{est['cold']['observations']} observations, zero "
+          f"deterministic drift across warm replays; injected 100x "
+          f"misestimate re-planned {rep['spans']} decision(s) with "
+          f"span/metric/ledger agreeing and exact results)")
+    return 0
+
+
 def main(argv=None):
     args = argv if argv is not None else sys.argv[1:]
     if "--interp" in args:
@@ -1037,6 +1308,8 @@ def main(argv=None):
         return run_shuffle_gate()
     if "--serve" in args:
         return run_serve_gate()
+    if "--feedback" in args:
+        return run_feedback_gate()
     from spark_rapids_tpu.tools.__main__ import main as tools_main
     cli = ["lint", "--repo", "--baseline", BASELINE]
     if "--update-baseline" in args:

@@ -732,11 +732,18 @@ def _check_residency_paths(root: eb.Exec,
 # front end
 # ---------------------------------------------------------------------------
 
-def infer_plan(root: eb.Exec, conf: cfg.RapidsConf) -> InterpResult:
+def infer_plan(root: eb.Exec, conf: cfg.RapidsConf,
+               row_overrides: Optional[Dict[int, float]] = None
+               ) -> InterpResult:
     """Run the abstract interpreter over a converted plan: fills in one
     AbstractState per node, the liveness map, and every boundary
     diagnostic (L009/L010/L011/L012 + flow-decided L006).  Pure — never
-    mutates the plan, never executes it."""
+    mutates the plan, never executes it.
+
+    ``row_overrides`` (id(node) -> rows) substitutes MEASURED row counts
+    for the model's estimates at specific nodes — the exchange-boundary
+    re-planner pins a materialized shuffle's real output here and the
+    override propagates upward through every downstream transfer."""
     result = InterpResult()
 
     def up(node: eb.Exec, path: str) -> AbstractState:
@@ -748,6 +755,8 @@ def infer_plan(root: eb.Exec, conf: cfg.RapidsConf) -> InterpResult:
             st = _transfer(node, child_states, conf)
         except Exception:
             st = _fallback_state(node, child_states)
+        if row_overrides and id(node) in row_overrides:
+            st.rows = row_overrides[id(node)]
         result.states[id(node)] = st
         return st
 

@@ -49,9 +49,13 @@ class SessionPool:
         self._cv = threading.Condition()
         self._closed = False
         self._sessions = []
-        for _ in range(self.size):
+        for i in range(self.size):
             s = TpuSession(conf_map)
             s._obs_isolation = True
+            # the tenant label its admission tickets book under — the
+            # pool-session id by default (ISSUE: per-tenant accounting
+            # on tpu_admission_* counters and queue gauges)
+            s._tenant = f"pool-{i}"
             self._sessions.append(s)
         self._idle = deque(self._sessions)
 

@@ -76,6 +76,22 @@ class TpuSession:
             ledger_path=ledger_path,
             buckets=conf.capacity_buckets + conf.string_data_buckets,
             thrash_warn_ratio=conf.get(cfg.JIT_THRASH_WARN_RATIO))
+        # estimator observatory: predicted-vs-actual per operator
+        # signature, persisted next to the compile ledger; recording is
+        # always on, feedback.enabled additionally blends it back into
+        # planning and arms the exchange-boundary re-planner
+        from ..obs.estimator import EstimatorLedger
+        est_path = None
+        if ledger_dir:
+            from ..obs.history import HistoryDir
+            est_path = HistoryDir(ledger_dir).estimator_ledger_path()
+        EstimatorLedger.get().configure(
+            ledger_path=est_path,
+            feedback_enabled=conf.get(cfg.FEEDBACK_ENABLED),
+            blend_floor=conf.get(cfg.FEEDBACK_BLEND_FLOOR),
+            blend_cap=conf.get(cfg.FEEDBACK_BLEND_CAP),
+            min_observations=conf.get(cfg.FEEDBACK_MIN_OBSERVATIONS),
+            replan_factor=conf.get(cfg.FEEDBACK_REPLAN_FACTOR))
         from ..memory.meta import set_default_codec
         set_default_codec(conf.get(cfg.SHUFFLE_COMPRESSION_CODEC))
         from ..shims import ShimLoader, set_active_shim
@@ -371,6 +387,20 @@ class TpuSession:
         from ..plugin import ExecutionPlanCaptureCallback
         ExecutionPlanCaptureCallback.on_plan(final_plan)
         ctx = ExecContext(self.conf)
+        # exchange-boundary re-planner: armed for the whole execution
+        # (feedback.enabled gates inside); it needs the live ticket to
+        # re-price and the exec context to pin strategy switches on
+        from ..analysis import replan as replan_mod
+        from ..memory.admission import AdmissionController
+        rctx = replan_mod.ReplanContext(
+            plan_root=final_plan, conf=self.conf, ticket=ticket,
+            controller=AdmissionController.get()
+            if ticket is not None else None,
+            tracer=tracer, exec_ctx=ctx)
+        replan_mod.install(rctx)
+        # boundaries whose map stage ran during planning replay now —
+        # still before the first reduce partition launches
+        replan_mod.scan_materialized(rctx)
         from ..memory.spill import SpillCatalog
         debug = self.conf.get(cfg.MEMORY_DEBUG)
         cat = SpillCatalog.get()
@@ -427,6 +457,11 @@ class TpuSession:
                 self._install_predictions(tracer, final_plan)
                 ctx = ExecContext(self.conf)
                 ctx.task_context["no_speculation"] = True
+                # the retry re-planned: point the re-planner at the
+                # fresh plan/context (its ticket carries over)
+                rctx.plan_root = final_plan
+                rctx.exec_ctx = ctx
+                replan_mod.scan_materialized(rctx)
                 with trace_span("phase:execute-retry", kind="phase"):
                     result = final_plan.execute_collect(ctx)
         except BaseException:
@@ -442,6 +477,8 @@ class TpuSession:
                         ledger.peak_device_bytes
                 self._memsan_uninstall(memsan)
             raise
+        finally:
+            replan_mod.uninstall()
         self.release_plan_shuffles(final_plan)
         if memsan_on:
             try:
@@ -509,7 +546,10 @@ class TpuSession:
             0 if bound is None else int(bound),
             label=type(final_plan).__name__,
             timeout_s=conf.get(cfg.SERVE_ADMISSION_TIMEOUT_MS) / 1000.0,
-            repaired=repaired)
+            repaired=repaired,
+            # pool sessions carry their slot id (api/pool.py); a
+            # standalone session books under the default tenant
+            tenant=getattr(self, "_tenant", ""))
         return ticket, controller
 
     def _static_peak_bound(self, final_plan, conf,
@@ -583,6 +623,7 @@ class TpuSession:
         try:
             from ..analysis.interp import infer_plan
             from ..analysis.lifetime import analyze_memory, total_bytes
+            from ..obs.estimator import signature_of
             interp = infer_plan(final_plan, self.conf)
             mem = analyze_memory(final_plan, self.conf, interp)
 
@@ -593,6 +634,7 @@ class TpuSession:
                 bound = mem.bound(n)
                 tracer.predictions[id(n)] = {
                     "node": type(n).__name__,
+                    "sig": signature_of(n),
                     "rows": None if st.rows is None else int(st.rows),
                     "bytes": int(total_bytes(st)),
                     "peakHbmBound": None if bound is None
@@ -622,6 +664,17 @@ class TpuSession:
             except Exception:
                 pass  # a dead device must not mask the query's error
         tracer.finalize(error=error)
+        try:
+            # distill predicted-vs-actual into the estimator ledger —
+            # the signal the feedback blend and `bench --accuracy` read
+            from ..obs.estimator import EstimatorLedger
+            EstimatorLedger.get().record_query(
+                tracer.predictions, tracer.actuals,
+                static_bound=getattr(tracer, "static_peak_bound", None),
+                measured_peak=getattr(
+                    tracer, "measured_peak_device_bytes", None))
+        except Exception:
+            pass  # grading is advisory; never mask the query's outcome
         if eventlog_dir is None or final_plan is None:
             return
         sql_id = self._sql_counter

@@ -754,6 +754,61 @@ SERVE_POOL_SIZE = conf("spark.rapids.tpu.serve.poolSize").integer() \
     .check(lambda v: v >= 1, "must be >= 1") \
     .create_with_default(4)
 
+# --- feedback-directed planning (estimator observatory) -------------------
+
+FEEDBACK_ENABLED = conf("spark.rapids.tpu.feedback.enabled").boolean() \
+    .doc("Close the predict->execute loop: blend the estimator "
+         "ledger's recorded per-(exec kind, input signature) actuals "
+         "into plan/cost.estimate_rows, and re-plan the reduce side of "
+         "a shuffle at the exchange boundary from the catalog's "
+         "measured partition_stats (switch join strategy, force the "
+         "out-of-core repair, re-price the admission ticket) before it "
+         "launches.  Observation RECORDING is always on (the "
+         "EstimatorLedger grades the CBO regardless); this key gates "
+         "whether the recorded signal feeds back into planning.  Off "
+         "by default: feedback makes plans depend on execution "
+         "history.") \
+    .create_with_default(False)
+
+FEEDBACK_BLEND_FLOOR = conf("spark.rapids.tpu.feedback.blendFloor") \
+    .double() \
+    .doc("Minimum confidence weight given to a recorded actual when a "
+         "matching (exec kind, input signature) exists in the "
+         "estimator ledger: estimate = w*recorded + (1-w)*static with "
+         "w clamped to [blendFloor, blendCap] by observation count "
+         "(w grows as n/(n+1)).") \
+    .check(lambda v: 0.0 <= v <= 1.0, "must be in [0, 1]") \
+    .create_with_default(0.25)
+
+FEEDBACK_BLEND_CAP = conf("spark.rapids.tpu.feedback.blendCap") \
+    .double() \
+    .doc("Maximum confidence weight a recorded actual can earn: even a "
+         "heavily observed signature keeps (1-blendCap) of the static "
+         "model, so a workload shift can still pull the estimate back "
+         "before the ledger re-learns it.") \
+    .check(lambda v: 0.0 <= v <= 1.0, "must be in [0, 1]") \
+    .create_with_default(0.9)
+
+FEEDBACK_MIN_OBSERVATIONS = conf(
+    "spark.rapids.tpu.feedback.minObservations").integer() \
+    .doc("Observations a (exec kind, input signature) needs in the "
+         "estimator ledger before its recorded mean is blended into "
+         "estimate_rows.  1 means a single prior run of the same "
+         "query shape already sharpens the next plan.") \
+    .check(lambda v: v >= 1, "must be >= 1") \
+    .create_with_default(1)
+
+FEEDBACK_REPLAN_FACTOR = conf(
+    "spark.rapids.tpu.feedback.replan.misestimateFactor").double() \
+    .doc("How far the measured map-stage output may diverge from the "
+         "planner's prediction (ratio, either direction) before the "
+         "exchange-boundary re-plan switches the reduce-side join off "
+         "speculative sizing (analysis/replan.py).  Ticket re-pricing "
+         "and out-of-core repair decisions fire on any material bound "
+         "change regardless of this factor.") \
+    .check(lambda v: v > 1.0, "must be > 1") \
+    .create_with_default(4.0)
+
 # Environment variables the engine reads directly (escape hatches that
 # must exist before config parsing, e.g. cache sizing at import time).
 # The repo lint (TPU-R002) fails on any SPARK_RAPIDS_* env read not

@@ -24,6 +24,15 @@ Contract (the serving invariants the stress tests assert):
 Oversized-but-repairable plans are re-planned by the session through
 ``try_outofcore_repair`` (smaller ``oc_budget``) before admission, so a
 giant sort/aggregate shrinks its ticket instead of hogging the budget.
+After the map side of a shuffle materializes, the exchange-boundary
+re-planner (analysis/replan.py) may ``reprice()`` a live ticket to the
+measured bound — truthful accounting that backpressures FUTURE admits
+without ever stalling the already-running query.
+
+Every ``tpu_admission_*`` counter and queue gauge carries a ``tenant``
+label (the pool-session id by default) so per-tenant consumption is
+visible; cardinality is bounded by the registry's per-family series cap,
+past which tenants collapse into the ``_overflow`` series.
 """
 
 from __future__ import annotations
@@ -31,7 +40,11 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Optional
+from typing import Dict, Optional
+
+DEFAULT_TENANT = "default"
+
+_TENANT_LABELS = ("tenant",)
 
 
 class AdmissionTimeout(RuntimeError):
@@ -41,13 +54,14 @@ class AdmissionTimeout(RuntimeError):
 class AdmissionTicket:
     """One admitted query's reservation against the byte budget."""
 
-    __slots__ = ("nbytes", "label", "repaired", "queue_wait_s",
+    __slots__ = ("nbytes", "label", "tenant", "repaired", "queue_wait_s",
                  "released")
 
-    def __init__(self, nbytes: int, label: str, repaired: bool,
-                 queue_wait_s: float):
+    def __init__(self, nbytes: int, label: str, tenant: str,
+                 repaired: bool, queue_wait_s: float):
         self.nbytes = nbytes
         self.label = label
+        self.tenant = tenant
         self.repaired = repaired
         self.queue_wait_s = queue_wait_s
         self.released = False
@@ -75,6 +89,11 @@ class AdmissionController:
         self._in_flight = 0
         self._queue: deque = deque()  # waiter tokens, arrival order
         self.max_in_flight_seen = 0
+        # per-tenant views of the two aggregates above (pruned at zero
+        # so a burst of one-shot tenants cannot grow these unboundedly;
+        # the metric families bound their own cardinality separately)
+        self._queued_by_tenant: Dict[str, int] = {}
+        self._inflight_by_tenant: Dict[str, int] = {}
 
     # -- process-wide configuration ------------------------------------------
     @classmethod
@@ -106,22 +125,42 @@ class AdmissionController:
             cls._instance = None
 
     # -- admission ------------------------------------------------------------
+    def _counter(self, name: str, doc: str, tenant: str):
+        return _metrics().counter(name, doc,
+                                  labelnames=_TENANT_LABELS) \
+            .labels(tenant=tenant)
+
     def _publish_gauges(self) -> None:
         m = _metrics()
-        m.gauge("tpu_admission_queue_depth",
-                "queries waiting in the FIFO admission queue") \
-            .set(len(self._queue))
-        m.gauge("tpu_admission_bytes_in_flight",
-                "sum of admitted tickets' static peak-HBM bounds") \
-            .set(self._in_flight)
+        qd = m.gauge("tpu_admission_queue_depth",
+                     "queries waiting in the FIFO admission queue",
+                     labelnames=_TENANT_LABELS)
+        bif = m.gauge("tpu_admission_bytes_in_flight",
+                      "sum of admitted tickets' static peak-HBM bounds",
+                      labelnames=_TENANT_LABELS)
+        # drained tenants publish a final 0 and leave the dict; their
+        # metric series stay behind at 0, which is what a scrape wants
+        for t in list(self._queued_by_tenant):
+            qd.labels(tenant=t).set(self._queued_by_tenant[t])
+            if not self._queued_by_tenant[t]:
+                del self._queued_by_tenant[t]
+        for t in list(self._inflight_by_tenant):
+            bif.labels(tenant=t).set(self._inflight_by_tenant[t])
+            if not self._inflight_by_tenant[t]:
+                del self._inflight_by_tenant[t]
+
+    def _tenant_add(self, book: Dict[str, int], tenant: str,
+                    delta: int) -> None:
+        book[tenant] = book.get(tenant, 0) + delta
 
     def admit(self, nbytes: int, label: str = "",
               timeout_s: Optional[float] = None,
-              repaired: bool = False) -> AdmissionTicket:
+              repaired: bool = False,
+              tenant: str = DEFAULT_TENANT) -> AdmissionTicket:
         """Block until ``nbytes`` fits in the budget (FIFO order) and
         reserve it; raises ``AdmissionTimeout`` past the deadline."""
-        m = _metrics()
         nbytes = max(int(nbytes), 0)
+        tenant = tenant or DEFAULT_TENANT
         timeout = self.timeout_s if timeout_s is None else timeout_s
         deadline = time.monotonic() + timeout
         t0 = time.monotonic()
@@ -129,22 +168,24 @@ class AdmissionController:
         queued = False
         with self._cv:
             self._queue.append(token)
+            self._tenant_add(self._queued_by_tenant, tenant, 1)
             try:
                 while self._queue[0] is not token or \
                         self._in_flight + nbytes > self.budget_bytes:
                     if not queued:
                         queued = True
-                        m.counter(
+                        self._counter(
                             "tpu_admission_queued_total",
                             "tickets that had to wait before "
-                            "admission").inc()
+                            "admission", tenant).inc()
                     self._publish_gauges()
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
-                        m.counter(
+                        self._counter(
                             "tpu_admission_timeouts_total",
                             "tickets that hit serve.admissionTimeoutMs "
-                            "without fitting in the budget").inc()
+                            "without fitting in the budget",
+                            tenant).inc()
                         raise AdmissionTimeout(
                             f"admission ticket {label or '(query)'} "
                             f"({nbytes} bytes) timed out after "
@@ -155,24 +196,63 @@ class AdmissionController:
                             f"queue")
                     self._cv.wait(remaining)
                 self._in_flight += nbytes
+                self._tenant_add(self._inflight_by_tenant, tenant,
+                                 nbytes)
                 if self._in_flight > self.max_in_flight_seen:
                     self.max_in_flight_seen = self._in_flight
             finally:
                 self._queue.remove(token)
+                self._tenant_add(self._queued_by_tenant, tenant, -1)
                 self._publish_gauges()
                 # head departure (admitted OR timed out) can unblock
                 # the next waiter
                 self._cv.notify_all()
         wait_s = time.monotonic() - t0
-        m.counter("tpu_admission_admitted_total",
-                  "tickets granted a byte reservation").inc()
+        self._counter("tpu_admission_admitted_total",
+                      "tickets granted a byte reservation",
+                      tenant).inc()
         if repaired:
-            m.counter("tpu_admission_repaired_total",
-                      "oversized tickets admitted after out-of-core "
-                      "re-planning shrank their bound").inc()
-        m.histogram("tpu_admission_queue_wait_seconds",
-                    "time from admit() to reservation").observe(wait_s)
-        return AdmissionTicket(nbytes, label, repaired, wait_s)
+            self._counter("tpu_admission_repaired_total",
+                          "oversized tickets admitted after out-of-core "
+                          "re-planning shrank their bound",
+                          tenant).inc()
+        _metrics().histogram(
+            "tpu_admission_queue_wait_seconds",
+            "time from admit() to reservation").observe(wait_s)
+        return AdmissionTicket(nbytes, label, tenant, repaired, wait_s)
+
+    def reprice(self, ticket: AdmissionTicket, new_nbytes: int) -> int:
+        """Adjust a LIVE ticket's reservation to ``new_nbytes`` — the
+        exchange-boundary re-planner calls this once the map stage's
+        measured partition sizes sharpen (or inflate) the static bound.
+        Never blocks: the query already holds the device, so when the
+        new bound overshoots the budget the honest move is truthful
+        accounting (future admits queue behind it), not a mid-flight
+        stall.  Mutating ``ticket.nbytes`` in place keeps the
+        release-once invariant intact — ``release()`` subtracts
+        whatever the ticket says it holds.  Returns the signed byte
+        delta applied (0 for a released ticket or an unchanged bound).
+        """
+        new = max(int(new_nbytes), 0)
+        with self._cv:
+            if ticket.released:
+                return 0
+            delta = new - ticket.nbytes
+            if delta == 0:
+                return 0
+            self._in_flight += delta
+            ticket.nbytes = new
+            self._tenant_add(self._inflight_by_tenant, ticket.tenant,
+                             delta)
+            if self._in_flight > self.max_in_flight_seen:
+                self.max_in_flight_seen = self._in_flight
+            self._publish_gauges()
+            # a shrink can unblock the next waiter
+            self._cv.notify_all()
+        self._counter("tpu_admission_repriced_total",
+                      "live tickets re-priced by the exchange-boundary "
+                      "re-planner", ticket.tenant).inc()
+        return delta
 
     def release(self, ticket: AdmissionTicket) -> None:
         """Return the ticket's bytes (idempotent: the session's finally
@@ -182,6 +262,8 @@ class AdmissionController:
                 return
             ticket.released = True
             self._in_flight -= ticket.nbytes
+            self._tenant_add(self._inflight_by_tenant, ticket.tenant,
+                             -ticket.nbytes)
             self._publish_gauges()
             self._cv.notify_all()
 

@@ -1,0 +1,427 @@
+"""Estimator observatory: grade the planner's row/byte/peak-HBM
+predictions against execution, remember the grades, and feed them back.
+
+The flight recorder already attaches predicted rows/bytes/peak-HBM to
+every operator span (``api/session._install_predictions``) and ``tools
+profile --accuracy`` ranks the misses — but nothing CONSUMED the
+signal: the CBO, the L010/L012/L014 byte estimates and the admission
+tickets all trusted a static model the recorder could prove wrong.
+This module closes the loop:
+
+* **The ledger.**  Every closed operator span distills its
+  predicted-vs-actual (rows, bytes; plus the query-level measured peak
+  device bytes vs the tmsan static bound) into running statistics
+  keyed by (exec kind, input-shape/dtype signature), persisted as
+  append-only JSONL (``estimator_ledger.jsonl``) in the regression
+  HistoryDir — the same cross-session discipline as the compile
+  ledger, and the same tolerant line-by-line load.
+* **The metrics.**  ``tpu_estimator_observations_total{exec}`` and
+  ``tpu_estimator_abs_error_total{exec}`` (cumulative relative error,
+  so error-per-observation is a PromQL division away) plus the
+  ``tpu_estimator_calibration_score`` gauge (1/(1+mean abs relative
+  row error): 1.0 = clairvoyant, ->0 = guessing).
+* **The feedback.**  With ``spark.rapids.tpu.feedback.enabled``,
+  ``plan/cost.estimate_rows`` blends a matching signature's recorded
+  mean into the static estimate with a confidence weight grown by
+  observation count and clamped to [blendFloor, blendCap] — sharpening
+  the one bound the CBO, the lint byte estimates and the admission
+  tickets all ride.  Recording never depends on the flag; only the
+  feedback does.
+
+Exchange-boundary re-planning (``analysis/replan.py``) sinks its
+decisions here too (``event: "replan"``), so one file answers both
+"how wrong were we" and "what did we do about it".
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+log = logging.getLogger("spark_rapids_tpu.obs.estimator")
+
+ESTIMATOR_LEDGER_FILENAME = "estimator_ledger.jsonl"
+ESTIMATOR_LEDGER_VERSION = 1
+
+# estimator families fan out by exec kind like the jit families do
+_EST_MAX_SERIES = 256
+
+
+def _stable_hash(obj: Any) -> str:
+    """12-hex stable hash (repr is stable for the strings/ints/tuples
+    signature_of produces) — matches the compile ledger's key hashing
+    so the two ledgers aggregate the same way cross-session."""
+    return hashlib.sha1(repr(obj).encode()).hexdigest()[:12]
+
+
+def _static_partitions(c) -> Optional[int]:
+    """A child's partition count WITHOUT triggering lazy computation:
+    an AQE reader's ``num_partitions`` property MATERIALIZES the
+    shuffle to count post-coalesce specs — a signature probe must never
+    run device work, and the signature must not depend on whether the
+    map stage happens to have run yet.  Use the underlying exchange's
+    static count for those nodes; everything else answers statically."""
+    if hasattr(c, "exchange") and hasattr(c, "_specs"):
+        return getattr(c.exchange, "num_partitions", None)
+    return getattr(c, "num_partitions", None)
+
+
+def signature_of(node) -> str:
+    """The (exec kind, input-shape/dtype) signature one operator's
+    statistics accumulate under: the node's semantic description plus
+    its dtype layout and, RECURSIVELY, each child's signature and
+    partition count.  The recursion is what keeps two structurally
+    different queries from pooling their statistics: a join's output
+    coalesce and a sort's output coalesce can share dtypes and
+    partition counts at one level deep, and blending the sort's 4000
+    actual rows into the join's 97 would poison both estimates.
+    Stable across processes (describe() renders bound expressions as
+    SQL, not object ids), so a prior session's observations match this
+    one's plans."""
+    return _sig(node, {})
+
+
+def _sig(node, memo: Dict[int, str]) -> str:
+    nid = id(node)
+    got = memo.get(nid)
+    if got is not None:
+        return got
+    try:
+        kind = type(node).__name__
+        try:
+            desc = node.describe()
+        except Exception:
+            desc = kind
+        self_sig = tuple(dt.name for dt in node.output_types)
+        children = tuple(getattr(node, "children", ()) or ())
+        if not children:
+            # AQE readers hang below their exchange without listing it
+            # as a child; the map-side subtree is what distinguishes
+            # two reads that share a dtype layout
+            exch = getattr(node, "exchange", None)
+            if exch is not None:
+                children = (exch,)
+        child_sig = tuple((_sig(c, memo), _static_partitions(c))
+                          for c in children)
+        out = _stable_hash((kind, desc, self_sig, child_sig))
+    except Exception:
+        out = _stable_hash(type(node).__name__)
+    memo[nid] = out
+    return out
+
+
+def _rel_err(pred, actual) -> Optional[float]:
+    """Relative prediction error |pred-actual|/max(actual,1); None
+    prediction means 'no model' and produces no observation (same
+    convention as obs/export._err, minus its -1 rank sentinel)."""
+    if pred is None:
+        return None
+    return abs(float(pred) - float(actual)) / max(float(actual), 1.0)
+
+
+class _SigStats:
+    """Running statistics for one (exec kind, signature)."""
+
+    __slots__ = ("n", "rows_sum", "bytes_sum", "rows_err_sum",
+                 "bytes_err_sum")
+
+    def __init__(self):
+        self.n = 0
+        self.rows_sum = 0.0
+        self.bytes_sum = 0.0
+        self.rows_err_sum = 0.0
+        self.bytes_err_sum = 0.0
+
+    def add(self, act_rows, act_bytes, rows_err, bytes_err) -> None:
+        self.n += 1
+        self.rows_sum += float(act_rows)
+        self.bytes_sum += float(act_bytes)
+        if rows_err is not None:
+            self.rows_err_sum += rows_err
+        if bytes_err is not None:
+            self.bytes_err_sum += bytes_err
+
+    @property
+    def mean_rows(self) -> float:
+        return self.rows_sum / max(self.n, 1)
+
+    @property
+    def mean_bytes(self) -> float:
+        return self.bytes_sum / max(self.n, 1)
+
+
+class EstimatorLedger:
+    """Process-wide singleton of predicted-vs-actual statistics."""
+
+    _instance: Optional["EstimatorLedger"] = None
+    _ilock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.enabled = True
+        self.ledger_path: Optional[str] = None
+        # feedback knobs (spark.rapids.tpu.feedback.*, pushed in by
+        # session init so estimate_rows keeps its conf-free signature)
+        self.feedback_enabled = False
+        self.blend_floor = 0.25
+        self.blend_cap = 0.9
+        self.min_observations = 1
+        self.replan_factor = 4.0
+        self._stats: Dict[Tuple[str, str], _SigStats] = {}
+        self.observations = 0
+        self.rows_err_total = 0.0
+        self.bytes_err_total = 0.0
+        self.replans = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    @classmethod
+    def get(cls) -> "EstimatorLedger":
+        with cls._ilock:
+            if cls._instance is None:
+                cls._instance = EstimatorLedger()
+            return cls._instance
+
+    @classmethod
+    def reset_for_tests(cls) -> "EstimatorLedger":
+        """Fresh ledger (tests and CI gates need known-empty stats;
+        production never calls this)."""
+        with cls._ilock:
+            cls._instance = EstimatorLedger()
+            return cls._instance
+
+    def configure(self, enabled: Optional[bool] = None,
+                  ledger_path: Optional[str] = None,
+                  feedback_enabled: Optional[bool] = None,
+                  blend_floor: Optional[float] = None,
+                  blend_cap: Optional[float] = None,
+                  min_observations: Optional[int] = None,
+                  replan_factor: Optional[float] = None) -> None:
+        """Session-init wiring.  Setting a ledger path loads the prior
+        sessions' observations, so the very next plan already blends a
+        warm model (the cold->warm axis `bench.py --accuracy` and the
+        `--feedback` gate measure)."""
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if feedback_enabled is not None:
+                self.feedback_enabled = bool(feedback_enabled)
+            if blend_floor is not None:
+                self.blend_floor = float(blend_floor)
+            if blend_cap is not None:
+                self.blend_cap = float(blend_cap)
+            if min_observations is not None:
+                self.min_observations = int(min_observations)
+            if replan_factor is not None:
+                self.replan_factor = float(replan_factor)
+            if ledger_path is not None and \
+                    ledger_path != self.ledger_path:
+                self.ledger_path = ledger_path
+                self._load_ledger(ledger_path)
+
+    def _load_ledger(self, path: str) -> None:
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if rec.get("event") != "observe":
+                        continue
+                    key = (rec.get("exec", ""), rec.get("sig", ""))
+                    st = self._stats.setdefault(key, _SigStats())
+                    st.add(rec.get("act_rows", 0) or 0,
+                           rec.get("act_bytes", 0) or 0,
+                           rec.get("rows_err"), rec.get("bytes_err"))
+        except OSError as ex:
+            log.warning("estimator ledger unreadable: %s", ex)
+
+    def _append_ledger(self, rec: Dict) -> None:
+        path = self.ledger_path
+        if path is None:
+            return
+        rec = dict(rec, v=ESTIMATOR_LEDGER_VERSION,
+                   ts=round(time.time(), 3), os_pid=os.getpid())
+        try:
+            with self._lock:
+                with open(path, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(rec, sort_keys=True) + "\n")
+        except OSError as ex:  # the ledger is telemetry, never fatal
+            log.warning("estimator ledger append failed: %s", ex)
+
+    # -- recording -----------------------------------------------------------
+    def observe(self, exec_kind: str, sig: str,
+                pred_rows, act_rows, pred_bytes, act_bytes) -> None:
+        """One closed operator span's predicted-vs-actual."""
+        if not self.enabled:
+            return
+        rows_err = _rel_err(pred_rows, act_rows)
+        bytes_err = _rel_err(pred_bytes, act_bytes)
+        with self._lock:
+            st = self._stats.setdefault((exec_kind, sig), _SigStats())
+            st.add(act_rows, act_bytes, rows_err, bytes_err)
+            self.observations += 1
+            if rows_err is not None:
+                self.rows_err_total += rows_err
+            if bytes_err is not None:
+                self.bytes_err_total += bytes_err
+            calib = 1.0 / (1.0 + self.rows_err_total
+                           / max(self.observations, 1))
+        _fam_observations().labels(exec=exec_kind).inc()
+        if rows_err is not None:
+            _fam_abs_error().labels(exec=exec_kind).inc(rows_err)
+        _fam_calibration().set(round(calib, 6))
+        self._append_ledger({
+            "event": "observe", "exec": exec_kind, "sig": sig,
+            "pred_rows": None if pred_rows is None else int(pred_rows),
+            "act_rows": int(act_rows),
+            "pred_bytes": None if pred_bytes is None
+            else int(pred_bytes),
+            "act_bytes": int(act_bytes),
+            "rows_err": None if rows_err is None
+            else round(rows_err, 6),
+            "bytes_err": None if bytes_err is None
+            else round(bytes_err, 6)})
+
+    def observe_peak(self, static_bound, measured_peak) -> None:
+        """Query-level measured peak device bytes vs the tmsan static
+        bound — the calibration of the number admission tickets ride."""
+        if not self.enabled or measured_peak is None:
+            return
+        err = _rel_err(static_bound, measured_peak)
+        _fam_observations().labels(exec="__peak_hbm__").inc()
+        if err is not None:
+            _fam_abs_error().labels(exec="__peak_hbm__").inc(err)
+        self._append_ledger({
+            "event": "observe_peak",
+            "static_bound": None if static_bound is None
+            else int(static_bound),
+            "measured_peak": int(measured_peak),
+            "err": None if err is None else round(err, 6)})
+
+    def record_query(self, predictions: Dict, actuals: Dict,
+                     static_bound=None, measured_peak=None) -> int:
+        """Distill one finished query: join the planner's per-node
+        predictions against the trace's per-node operator actuals (both
+        keyed by id(node)) and record every pair that carries an input
+        signature.  Returns the number of observations taken."""
+        if not self.enabled:
+            return 0
+        n = 0
+        for nid, pred in (predictions or {}).items():
+            act = (actuals or {}).get(nid)
+            sig = pred.get("sig")
+            if act is None or sig is None:
+                continue
+            self.observe(pred.get("node", "?"), sig,
+                         pred.get("rows"), act.get("rows", 0),
+                         pred.get("bytes"), act.get("bytes", 0))
+            n += 1
+        if measured_peak is not None:
+            self.observe_peak(static_bound, measured_peak)
+        return n
+
+    def record_replan(self, decision: str, cause: str, **extra) -> None:
+        """One exchange-boundary re-plan decision: the ledger sink of
+        the triple (span + tpu_replan_total + ledger) the --feedback
+        gate cross-checks."""
+        with self._lock:
+            self.replans += 1
+        _fam_replans().labels(decision=decision, cause=cause).inc()
+        rec = {"event": "replan", "decision": decision, "cause": cause}
+        for k, v in extra.items():
+            rec[k] = v
+        self._append_ledger(rec)
+
+    # -- feedback ------------------------------------------------------------
+    def blend_rows(self, node, static_rows: float) -> Optional[float]:
+        """Confidence-weight-blend the recorded mean actual row count
+        for this node's signature into the static estimate, or None
+        when feedback is off / the signature is unseen / too thin.
+        w = clamp(n/(n+1), [blendFloor, blendCap]); the static model
+        always keeps (1-w) so a stale ledger can be pulled back."""
+        if not (self.enabled and self.feedback_enabled):
+            return None
+        key = (type(node).__name__, signature_of(node))
+        with self._lock:
+            st = self._stats.get(key)
+            if st is None or st.n < self.min_observations:
+                return None
+            mean, n = st.mean_rows, st.n
+        w = min(self.blend_cap,
+                max(self.blend_floor, n / (n + 1.0)))
+        return w * mean + (1.0 - w) * float(static_rows)
+
+    def lookup(self, exec_kind: str, sig: str) -> Optional[_SigStats]:
+        with self._lock:
+            return self._stats.get((exec_kind, sig))
+
+    # -- read side -----------------------------------------------------------
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "observations": self.observations,
+                "signatures": len(self._stats),
+                "rows_err_total": round(self.rows_err_total, 6),
+                "mean_rows_err": round(
+                    self.rows_err_total / max(self.observations, 1), 6),
+                "mean_bytes_err": round(
+                    self.bytes_err_total / max(self.observations, 1), 6),
+                "calibration_score": round(
+                    1.0 / (1.0 + self.rows_err_total
+                           / max(self.observations, 1)), 6),
+                "replans": self.replans,
+                "feedback_enabled": self.feedback_enabled,
+            }
+
+
+# ---------------------------------------------------------------------------
+# metric families (created idempotently)
+# ---------------------------------------------------------------------------
+
+def _registry():
+    from . import metrics
+    return metrics.registry()
+
+
+def _fam_observations():
+    return _registry().counter(
+        "tpu_estimator_observations_total",
+        "predicted-vs-actual observations distilled into the "
+        "estimator ledger", ("exec",), max_series=_EST_MAX_SERIES)
+
+
+def _fam_abs_error():
+    return _registry().counter(
+        "tpu_estimator_abs_error_total",
+        "cumulative relative row-estimate error "
+        "(|pred-actual|/max(actual,1)); divide by observations for "
+        "the mean", ("exec",), max_series=_EST_MAX_SERIES)
+
+
+def _fam_calibration():
+    return _registry().gauge(
+        "tpu_estimator_calibration_score",
+        "1/(1+mean abs relative row error): 1.0 = clairvoyant "
+        "planner, ->0 = guessing")
+
+
+def _fam_replans():
+    return _registry().counter(
+        "tpu_replan_total",
+        "exchange-boundary re-plan decisions from measured map-stage "
+        "partition stats", ("decision", "cause"))
+
+
+def ledger() -> EstimatorLedger:
+    return EstimatorLedger.get()

@@ -104,10 +104,15 @@ def _counter_value(reg: M.MetricsRegistry, name: str) -> int:
 
 
 def _gauge_value(reg: M.MetricsRegistry, name: str) -> Optional[float]:
+    """Aggregate read: sums every series so per-tenant gauges (the
+    admission family) report their fleet-wide value; a label-less gauge
+    has one series and sums to itself."""
     for fam in reg.families():
         if fam.name == name:
+            total = None
             for _labels, ch in fam.series():
-                return ch.value
+                total = (total or 0) + ch.value
+            return total
     return None
 
 

@@ -50,7 +50,7 @@ DETERMINISTIC_FIELDS = ("plan_shape", "operators", "fallback_ops",
                         "distinct_programs", "miss_causes")
 #: advisory fields (never compared in CI)
 TIMING_FIELDS = ("wall_ms", "operator_time_ns", "peak_device_bytes",
-                 "compile_seconds")
+                 "compile_seconds", "estimate_rows_err")
 
 
 # ---------------------------------------------------------------------------
@@ -67,6 +67,7 @@ def query_fingerprint(sql, spans: List[dict]) -> Dict:
     operators: Dict[str, Dict[str, int]] = {}
     fallback: List[str] = []
     time_ns = 0
+    est_errs: List[float] = []
     for n in sql.plan.walk():
         act = n.actual or {}
         agg = operators.setdefault(
@@ -77,6 +78,12 @@ def query_fingerprint(sql, spans: List[dict]) -> Dict:
         time_ns += int(act.get("timeNs") or 0)
         if getattr(n, "placement", None) == "cpu":
             fallback.append(n.node_name)
+        pred = getattr(n, "prediction", None)
+        if pred is not None and n.actual is not None and \
+                pred.get("rows") is not None:
+            from .export import _err
+            est_errs.append(_err(pred.get("rows"),
+                                 n.actual.get("rows", 0)))
     crossings = 0
     lint_hits: List[str] = []
     builds = 0
@@ -112,6 +119,12 @@ def query_fingerprint(sql, spans: List[dict]) -> Dict:
         "operator_time_ns": time_ns,
         "peak_device_bytes": sql.peak_device_bytes,
         "compile_seconds": round(compile_s, 6),
+        # advisory estimator-accuracy field (fingerprint v2+): mean
+        # relative row-estimate error over the operators that carried a
+        # prediction; None when the log predates the estimator
+        # observatory, so pre-feedback histories never false-trip
+        "estimate_rows_err": round(sum(est_errs) / len(est_errs), 6)
+        if est_errs else None,
     }
 
 
@@ -157,6 +170,15 @@ class HistoryDir:
         """
         from .compileprof import LEDGER_FILENAME
         return os.path.join(self.path, LEDGER_FILENAME)
+
+    def estimator_ledger_path(self) -> str:
+        """The cross-session estimator ledger (JSONL, appended by
+        obs/estimator.py): per-(exec kind, input signature)
+        predicted-vs-actual observations and exchange-boundary re-plan
+        decisions, loaded back at session init to warm the feedback
+        model."""
+        from .estimator import ESTIMATOR_LEDGER_FILENAME
+        return os.path.join(self.path, ESTIMATOR_LEDGER_FILENAME)
 
     def load(self, path: str) -> Dict:
         with open(path, encoding="utf-8") as f:
@@ -289,6 +311,20 @@ def diff_fingerprints(old: Dict, new: Dict,
             out.append(Drift(
                 q, "wall_regression",
                 f"wall {ow}ms -> {nw}ms "
+                f"(> {wall_threshold_pct:g}% threshold)", False))
+    # estimator-accuracy field (advisory, threshold-gated like wall):
+    # only compared when BOTH runs carry it, so pre-feedback histories
+    # never trip — and never deterministic, because accuracy depends on
+    # what the warm ledger had seen
+    if wall_threshold_pct is not None and \
+            old.get("estimate_rows_err") is not None and \
+            new.get("estimate_rows_err") is not None:
+        oe, ne = old["estimate_rows_err"], new["estimate_rows_err"]
+        if ne > oe + 0.05 and \
+                ne > oe * (1.0 + wall_threshold_pct / 100.0):
+            out.append(Drift(
+                q, "estimate_accuracy_regression",
+                f"mean row-estimate error {oe:.4f} -> {ne:.4f} "
                 f"(> {wall_threshold_pct:g}% threshold)", False))
     # serving fingerprints (bench.py --serve): the admission counter
     # totals for a fixed mix+budget are deterministic (admitted,

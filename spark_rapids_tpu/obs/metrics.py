@@ -247,6 +247,19 @@ class MetricFamily:
             return (ch.count, ch.sum)
         return ch.value
 
+    def total(self):
+        """Sum over every series (including the overflow series) — the
+        label-blind read a caller uses when it cares about the family's
+        aggregate, not a particular label set (counters/gauges only)."""
+        with self._lock:
+            children = list(self._children.values())
+        out = 0
+        for ch in children:
+            if isinstance(ch, _HistChild):
+                raise ValueError(f"{self.name}: total() on a histogram")
+            out += ch.value
+        return out
+
 
 class MetricsRegistry:
     """Process-wide singleton; families are created idempotently so any

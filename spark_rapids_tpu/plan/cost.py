@@ -55,7 +55,26 @@ def estimate_rows(node: eb.Exec, child_rows: List[float]) -> float:
     """Output-row estimate for one operator given its children's
     estimates — the single row model shared by the cost-based optimizer
     and the flow-sensitive plan typechecker (analysis/interp.py), so
-    admission decisions and CBO placement reason from the same numbers."""
+    admission decisions and CBO placement reason from the same numbers.
+
+    With ``spark.rapids.tpu.feedback.enabled`` the estimator ledger
+    (obs/estimator.py) confidence-weight-blends the recorded mean
+    actual for this node's (exec kind, input signature) into the
+    static estimate — every consumer of this function (CBO, the
+    L010/L012 byte estimates, the L014 bound, admission tickets)
+    sharpens from the same feedback."""
+    static = _static_rows(node, child_rows)
+    try:
+        from ..obs.estimator import EstimatorLedger
+        blended = EstimatorLedger.get().blend_rows(node, static)
+    except Exception:
+        blended = None
+    return static if blended is None else max(blended, 0.0)
+
+
+def _static_rows(node: eb.Exec, child_rows: List[float]) -> float:
+    """The pure static row model (no feedback) — what a cold planner
+    uses, and what the blend anchors its (1-w) share to."""
     name = type(node).__name__
     from ..exec.basic import GlobalLimitExec, LocalLimitExec, LocalScanExec, RangeExec
     if isinstance(node, LocalScanExec):

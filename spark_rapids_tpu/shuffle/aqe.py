@@ -184,6 +184,11 @@ class AQEShuffleReadExec(Exec):
             sid = self.exchange._shuffle_id
             n = self.exchange.num_partitions
             sizes = partition_stats(sid, n)
+            # exchange boundary: the map output is measured and the
+            # reduce side has not launched — the one moment a
+            # misestimate can still be acted on (analysis/replan.py)
+            from ..analysis.replan import on_map_stage_materialized
+            on_map_stage_materialized(self, sid, sizes)
             target = self.conf.get(cfg.ADVISORY_PARTITION_SIZE)
             self._specs = coalesce_specs(sizes, target)
             return self._specs
@@ -307,6 +312,8 @@ class _SkewAwareRead(AQEShuffleReadExec):
             n = self.exchange.num_partitions
             mgr = TpuShuffleManager.get()
             sizes = partition_stats(sid, n)
+            from ..analysis.replan import on_map_stage_materialized
+            on_map_stage_materialized(self, sid, sizes)
             n_blocks = [len(mgr.catalog.blocks_for_reduce(sid, rid))
                         for rid in range(n)]
             target = self.conf.get(cfg.ADVISORY_PARTITION_SIZE)

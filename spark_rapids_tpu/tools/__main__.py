@@ -8,6 +8,7 @@
     python -m spark_rapids_tpu.tools regress --history DIR --record <eventlog...> [--label L]
     python -m spark_rapids_tpu.tools regress --history DIR --check [--wall-threshold PCT]
     python -m spark_rapids_tpu.tools compile-report --ledger PATH [--top N] [--json]
+    python -m spark_rapids_tpu.tools estimator-report --ledger PATH [--top N] [--json]
     python -m spark_rapids_tpu.tools prewarm        --ledger DIR [--top K] [--cache-dir DIR]
 
 `compile-report` aggregates the compile observatory's cross-session
@@ -16,6 +17,14 @@ history dir holding compile_ledger.jsonl) into top-programs-by-compile-
 cost, miss causes, churn offenders and the bucket-canonicalization
 dedupe projection — the evidence for the persistent-program-cache key
 design (ROADMAP item 1).
+
+`estimator-report` is its planner-side twin: it aggregates the
+estimator observatory's ledger (obs/estimator.py; `--ledger` takes the
+JSONL file or the history dir holding estimator_ledger.jsonl) into the
+planner calibration score, the exec kinds with the worst row-estimate
+error (where feedback blending buys the most), the peak-HBM
+bound-vs-measured error, and the exchange-boundary re-plan decisions
+by (decision, cause).
 
 `regress` is the cross-run watchdog (obs/history.py): --record distills
 self-emitted event logs into per-query fingerprints appended to the
@@ -301,6 +310,18 @@ def main(argv=None):
                     help="rows per ranking section")
     cr.add_argument("--json", action="store_true",
                     help="emit the aggregate as JSON instead of text")
+    er = sub.add_parser("estimator-report",
+                        help="aggregate the estimator observatory "
+                             "ledger into the planner calibration "
+                             "report")
+    er.add_argument("--ledger", required=True,
+                    help="estimator_ledger.jsonl or the history dir "
+                         "containing it "
+                         "(spark.rapids.tpu.regress.historyDir)")
+    er.add_argument("--top", type=int, default=10,
+                    help="rows per ranking section")
+    er.add_argument("--json", action="store_true",
+                    help="emit the aggregate as JSON instead of text")
     pw = sub.add_parser("prewarm",
                         help="replay the top-K ledger program recipes "
                              "to populate the persistent compile cache "
@@ -344,6 +365,10 @@ def main(argv=None):
         from .compile_report import run_compile_report
         return run_compile_report(args.ledger, top=args.top,
                                   as_json=args.json)
+    elif args.cmd == "estimator-report":
+        from .estimator_report import run_estimator_report
+        return run_estimator_report(args.ledger, top=args.top,
+                                    as_json=args.json)
     elif args.cmd == "prewarm":
         return _run_prewarm(args.ledger, args.top, args.cache_dir)
     else:

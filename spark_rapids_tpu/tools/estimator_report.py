@@ -1,0 +1,151 @@
+"""`tools estimator-report`: aggregate the estimator observatory's
+cross-session ledger (obs/estimator.py) into the planner report the
+feedback loop is tuned against:
+
+* **Calibration** — observations, mean relative row/byte error and the
+  calibration score (1/(1+mean row error)), plus the peak-HBM
+  static-bound-vs-measured error admission tickets ride.
+* **Worst offenders** — exec kinds ranked by cumulative row-estimate
+  error: where the static model is most wrong and where feedback
+  blending buys the most.
+* **Re-plan decisions** — the `replan` events by (decision, cause):
+  how often a misestimate was caught at an exchange boundary and what
+  was done about it (strategy_switch / oc_repair / ticket_reprice).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+
+def load_estimator_ledger(path: str) -> List[Dict]:
+    """Parse one estimator ledger (JSONL).  `path` may be the file or
+    a directory containing ``estimator_ledger.jsonl``.  Unparsable
+    lines are skipped and counted — append-under-crash telemetry, a
+    torn final line must not kill the report."""
+    from ..obs.estimator import ESTIMATOR_LEDGER_FILENAME
+    if os.path.isdir(path):
+        path = os.path.join(path, ESTIMATOR_LEDGER_FILENAME)
+    records: List[Dict] = []
+    rejected = 0
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                rejected += 1
+    if rejected:
+        records.append({"event": "_rejected", "count": rejected})
+    return records
+
+
+def aggregate_estimator_ledger(records: List[Dict]) -> Dict:
+    """One pass over ledger records -> the report's data model."""
+    observes = [r for r in records if r.get("event") == "observe"]
+    peaks = [r for r in records if r.get("event") == "observe_peak"]
+    replans = [r for r in records if r.get("event") == "replan"]
+    rejected = sum(r.get("count", 0) for r in records
+                   if r.get("event") == "_rejected")
+
+    rows_err_total = sum(r["rows_err"] for r in observes
+                         if r.get("rows_err") is not None)
+    bytes_err_total = sum(r["bytes_err"] for r in observes
+                          if r.get("bytes_err") is not None)
+    n = len(observes)
+    mean_rows_err = rows_err_total / max(n, 1)
+
+    by_exec: Dict[str, Dict] = {}
+    sigs: set = set()
+    for r in observes:
+        sigs.add((r.get("exec", "?"), r.get("sig", "")))
+        agg = by_exec.setdefault(
+            r.get("exec", "?"),
+            {"count": 0, "rows_err": 0.0, "bytes_err": 0.0})
+        agg["count"] += 1
+        agg["rows_err"] += r.get("rows_err") or 0.0
+        agg["bytes_err"] += r.get("bytes_err") or 0.0
+
+    peak_errs = [r["err"] for r in peaks if r.get("err") is not None]
+    by_decision: Dict[str, int] = {}
+    for r in replans:
+        key = f"{r.get('decision', '?')}/{r.get('cause', '?')}"
+        by_decision[key] = by_decision.get(key, 0) + 1
+
+    return {
+        "observations": n,
+        "signatures": len(sigs),
+        "rejected_lines": rejected,
+        "mean_rows_err": round(mean_rows_err, 6),
+        "mean_bytes_err": round(bytes_err_total / max(n, 1), 6),
+        "calibration_score": round(1.0 / (1.0 + mean_rows_err), 6),
+        "peak_observations": len(peaks),
+        "mean_peak_err": round(sum(peak_errs)
+                               / max(len(peak_errs), 1), 6),
+        "worst_execs": sorted(
+            ({"exec": k, **v,
+              "mean_rows_err": round(v["rows_err"]
+                                     / max(v["count"], 1), 6)}
+             for k, v in by_exec.items()),
+            key=lambda d: -d["rows_err"]),
+        "replans": len(replans),
+        "replans_by_decision": by_decision,
+    }
+
+
+def format_estimator_report(agg: Dict, top: int = 10) -> str:
+    out: List[str] = []
+    w = out.append
+    w("== estimator observatory report ==")
+    w(f"observations: {agg['observations']}  distinct signatures: "
+      f"{agg['signatures']}")
+    w(f"mean relative error: rows {agg['mean_rows_err']:.4f}  "
+      f"bytes {agg['mean_bytes_err']:.4f}  calibration score "
+      f"{agg['calibration_score']:.4f} (1.0 = clairvoyant)")
+    if agg["peak_observations"]:
+        w(f"peak-HBM bound: {agg['peak_observations']} "
+          f"observation(s), mean |static-measured| error "
+          f"{agg['mean_peak_err']:.4f}")
+    if agg.get("rejected_lines"):
+        w(f"note: {agg['rejected_lines']} unparsable ledger line(s) "
+          f"skipped")
+    w("")
+    w(f"-- top {top} exec kinds by cumulative row-estimate error --")
+    for e in agg["worst_execs"][:top]:
+        w(f"  {e['rows_err']:10.4f}  {e['exec']:28s} "
+          f"{e['count']:5d} obs  mean {e['mean_rows_err']:.4f}")
+    w("")
+    w("-- exchange-boundary re-plans --")
+    if not agg["replans"]:
+        w("  none recorded (feedback off, or every estimate held)")
+    for key, count in sorted(agg["replans_by_decision"].items(),
+                             key=lambda kv: -kv[1]):
+        w(f"  {count:5d}  {key}")
+    return "\n".join(out) + "\n"
+
+
+def run_estimator_report(ledger: str, top: int = 10,
+                         as_json: bool = False, out=None) -> int:
+    import sys
+    out = out or sys.stdout
+    try:
+        records = load_estimator_ledger(ledger)
+    except OSError as ex:
+        sys.stderr.write(f"estimator-report: {ex}\n")
+        return 2
+    agg = aggregate_estimator_ledger(records)
+    if not agg["observations"]:
+        sys.stderr.write(
+            "estimator-report: ledger has no observe records (was "
+            "spark.rapids.tpu.regress.historyDir set?)\n")
+        return 2
+    if as_json:
+        out.write(json.dumps(agg, indent=1, sort_keys=True,
+                             default=str) + "\n")
+    else:
+        out.write(format_estimator_report(agg, top=top))
+    return 0

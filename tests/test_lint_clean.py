@@ -161,6 +161,24 @@ def test_run_lint_serve_gate_exits_zero():
     assert "serve gate clean" in proc.stdout, proc.stdout
 
 
+def test_run_lint_feedback_gate_exits_zero():
+    """Tier-1 gate for the estimator observatory: the golden corpus
+    replays cold (recording) then warm (feedback-blended) and the warm
+    replay's mean relative row error must be STRICTLY below cold; two
+    warm replays over identical ledger snapshots must show zero
+    deterministic drift; an injected 100x row misestimate must provably
+    re-plan at the exchange boundary with the replan span, the
+    tpu_replan_total metric, and the estimator ledger all agreeing —
+    and bit-exact results throughout."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "devtools", "run_lint.py"),
+         "--feedback"],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "feedback gate clean" in proc.stdout, proc.stdout
+
+
 def test_baseline_is_empty_and_stays_empty():
     """PR-3 burned the last baselined TPU-R001 debt down to zero: the
     ratchet now enforces a spotless repo (deliberate exceptions are

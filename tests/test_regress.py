@@ -39,6 +39,7 @@ def _fp(sql_id=0, **over):
         "operator_time_ns": 5_000_000,
         "peak_device_bytes": 1 << 20,
         "compile_seconds": 4.2,
+        "estimate_rows_err": 0.12,
     }
     fp.update(over)
     return fp

@@ -208,7 +208,15 @@ def test_eight_thread_mixed_query_stress(fresh_admission):
     names = ("tpu_admission_admitted_total", "tpu_queries_completed_total",
              "tpu_queries_failed_total", "tpu_memsan_dirty_ledgers_total",
              "tpu_admission_timeouts_total")
-    base = {nm: reg.counter(nm).value() for nm in names}
+
+    def cval(nm):
+        # admission counters are tenant-labeled; total() is the
+        # label-blind fleet-wide read
+        if nm.startswith("tpu_admission_"):
+            return reg.counter(nm, labelnames=("tenant",)).total()
+        return reg.counter(nm).value()
+
+    base = {nm: cval(nm) for nm in names}
     n = 1200
     k = (np.arange(n) % 7).astype(np.int64)
 
@@ -248,7 +256,7 @@ def test_eight_thread_mixed_query_stress(fresh_admission):
     pool.drain(timeout=30)
     pool.close()
 
-    delta = {nm: reg.counter(nm).value() - base[nm] for nm in names}
+    delta = {nm: cval(nm) - base[nm] for nm in names}
     assert delta["tpu_memsan_dirty_ledgers_total"] == 0
     assert delta["tpu_admission_timeouts_total"] == 0
     assert delta["tpu_admission_admitted_total"] == 16
@@ -259,6 +267,11 @@ def test_eight_thread_mixed_query_stress(fresh_admission):
     assert ctrl is not None
     assert 0 < ctrl.max_in_flight_seen <= budget
     assert ctrl.bytes_in_flight == 0 and ctrl.queue_depth == 0
+    # pooled sessions book admission under their pool-session tenant
+    fam = reg.counter("tpu_admission_admitted_total",
+                      labelnames=("tenant",))
+    assert any(lbl["tenant"].startswith("pool-")
+               for lbl, _ in fam.series())
 
 
 def test_pool_binds_active_session_per_thread(fresh_admission):
@@ -373,6 +386,192 @@ def test_semaphore_double_release_does_not_inflate_permits(
     sem.release_if_necessary(2)
     assert sem.acquire_if_necessary(3, timeout=1.0)
     sem.release_if_necessary(3)
+
+
+# ---------------------------------------------------------------------------
+# per-tenant admission accounting (PR 11)
+# ---------------------------------------------------------------------------
+
+def test_admission_counters_carry_tenant_label(fresh_admission):
+    from spark_rapids_tpu.obs.metrics import registry
+
+    ctrl = AdmissionController.configure(1000, 5.0)
+    reg = registry()
+    fam = reg.counter("tpu_admission_admitted_total",
+                      labelnames=("tenant",))
+    base_a = fam.value(tenant="tenant-a")
+    base_b = fam.value(tenant="tenant-b")
+    ta = ctrl.admit(300, tenant="tenant-a")
+    tb = ctrl.admit(200, tenant="tenant-b")
+    assert fam.value(tenant="tenant-a") - base_a == 1
+    assert fam.value(tenant="tenant-b") - base_b == 1
+    bif = reg.gauge("tpu_admission_bytes_in_flight",
+                    labelnames=("tenant",))
+    assert bif.value(tenant="tenant-a") == 300
+    assert bif.value(tenant="tenant-b") == 200
+    ctrl.release(ta)
+    ctrl.release(tb)
+    # drained tenants publish a final 0 (the series stays, at 0)
+    assert bif.value(tenant="tenant-a") == 0
+    assert bif.value(tenant="tenant-b") == 0
+    assert ctrl.bytes_in_flight == 0
+
+
+def test_admission_default_tenant_when_unset(fresh_admission):
+    from spark_rapids_tpu.obs.metrics import registry
+
+    ctrl = AdmissionController.configure(1000, 5.0)
+    fam = registry().counter("tpu_admission_admitted_total",
+                             labelnames=("tenant",))
+    base = fam.value(tenant="default")
+    t = ctrl.admit(10)          # no tenant given
+    t2 = ctrl.admit(10, tenant="")  # empty string normalizes too
+    assert fam.value(tenant="default") - base == 2
+    ctrl.release(t)
+    ctrl.release(t2)
+
+
+def test_tenant_label_cardinality_cap(fresh_admission):
+    """A runaway tenant id must collapse into the registry's single
+    overflow series, never grow the family without bound."""
+    from spark_rapids_tpu.obs.metrics import (DEFAULT_MAX_SERIES,
+                                              OVERFLOW_LABEL,
+                                              MetricsRegistry)
+
+    MetricsRegistry.reset_for_tests()
+    ctrl = AdmissionController.configure(10**9, 5.0)
+    n_tenants = DEFAULT_MAX_SERIES + 16
+    for i in range(n_tenants):
+        t = ctrl.admit(1, tenant=f"hostile-{i}")
+        ctrl.release(t)
+    from spark_rapids_tpu.obs.metrics import registry
+    fam = registry().counter("tpu_admission_admitted_total",
+                             labelnames=("tenant",))
+    assert fam.overflowed > 0
+    series = fam.series()
+    assert len(series) <= DEFAULT_MAX_SERIES + 1  # cap + overflow
+    overflow = [c for lbl, c in series
+                if lbl["tenant"] == OVERFLOW_LABEL]
+    assert overflow and overflow[0].value >= 16
+    assert fam.total() == n_tenants  # nothing dropped, only collapsed
+    MetricsRegistry.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# ticket lifetime across an exchange-boundary re-plan (PR 11)
+# ---------------------------------------------------------------------------
+
+def test_reprice_mutates_ticket_and_releases_once(fresh_admission):
+    """reprice() must keep the release-once invariant: the books
+    balance to zero after exactly one release, no matter how many
+    times the re-planner re-priced the live ticket."""
+    ctrl = AdmissionController.configure(1000, 5.0)
+    t = ctrl.admit(400, tenant="t0")
+    assert ctrl.reprice(t, 700) == 300
+    assert t.nbytes == 700 and ctrl.bytes_in_flight == 700
+    assert ctrl.reprice(t, 700) == 0   # no-op at the same price
+    assert ctrl.reprice(t, 250) == -450  # shrink is truthful too
+    assert ctrl.bytes_in_flight == 250
+    ctrl.release(t)
+    ctrl.release(t)  # double release stays idempotent after reprice
+    assert ctrl.bytes_in_flight == 0
+    assert ctrl.reprice(t, 900) == 0  # released ticket: dead, no books
+    assert ctrl.bytes_in_flight == 0
+
+
+def test_reprice_above_budget_never_blocks(fresh_admission):
+    """A mid-flight bound that overshoots the budget books honestly
+    (future admits queue) instead of stalling the running query."""
+    ctrl = AdmissionController.configure(1000, 5.0)
+    t = ctrl.admit(600)
+    assert ctrl.reprice(t, 1500) == 900
+    assert ctrl.bytes_in_flight == 1500  # truthful, over budget
+    with pytest.raises(AdmissionTimeout):
+        ctrl.admit(10, timeout_s=0.2)  # correctly held back
+    ctrl.release(t)
+    assert ctrl.bytes_in_flight == 0
+
+
+def test_reprice_shrink_unblocks_queued_waiter(fresh_admission):
+    ctrl = AdmissionController.configure(1000, 30.0)
+    t1 = ctrl.admit(900)
+    admitted = []
+
+    def waiter():
+        t2 = ctrl.admit(500, timeout_s=10)
+        admitted.append(ctrl.bytes_in_flight)
+        ctrl.release(t2)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.15)
+    assert ctrl.queue_depth == 1 and not admitted
+    ctrl.reprice(t1, 300)  # the re-planner sharpened the bound
+    th.join(5)
+    assert not th.is_alive()
+    assert admitted == [800]  # 300 + 500
+    ctrl.release(t1)
+    assert ctrl.bytes_in_flight == 0
+
+
+def test_replan_reprices_and_releases_once_end_to_end(
+        fresh_admission, tmp_path, monkeypatch):
+    """Satellite: an exchange-boundary strategy switch must re-price
+    the live admission ticket and the books must balance to zero after
+    the query — exactly like the SpeculativeSizingMiss retry path."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.obs.estimator import EstimatorLedger
+    from spark_rapids_tpu.obs.metrics import registry
+    from spark_rapids_tpu.plan import cost
+
+    EstimatorLedger.reset_for_tests()
+    orig = cost._static_rows
+
+    def skewed(node, child_rows):
+        r = orig(node, child_rows)
+        if type(node).__name__ == "ShuffleExchangeExec":
+            return r / 100.0  # injected 100x row misestimate
+        return r
+
+    monkeypatch.setattr(cost, "_static_rows", skewed)
+    reg = registry()
+    repriced = reg.counter("tpu_admission_repriced_total",
+                           labelnames=("tenant",))
+    replans = reg.counter("tpu_replan_total",
+                          labelnames=("decision", "cause"))
+    base_rp = repriced.total()
+    base_sw = replans.value(decision="strategy_switch",
+                            cause="row_misestimate")
+    s = TpuSession({
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.tpu.regress.historyDir": str(tmp_path),
+        # predictions (the misestimate baseline) are flight-recorder
+        # state, so the re-planner needs tracing on
+        "spark.rapids.tpu.trace.enabled": True,
+        "spark.rapids.tpu.feedback.enabled": True,
+        "spark.rapids.tpu.singleChipFuse": "off",
+        "spark.rapids.sql.autoBroadcastJoinThreshold": "0",
+        "spark.rapids.tpu.serve.hbmAdmissionBudgetBytes":
+            str(1 << 30),
+    })
+    n = 2000
+    left = s.create_dataframe(
+        {"k": [i % 50 for i in range(n)], "v": list(range(n))},
+        num_partitions=4)
+    right = s.create_dataframe(
+        {"k": list(range(50)), "w": [i * 10 for i in range(50)]},
+        num_partitions=4)
+    out = left.join(right, on="k").collect()
+    assert out.num_rows == n
+    # the misestimate provably re-planned and re-priced ...
+    assert replans.value(decision="strategy_switch",
+                         cause="row_misestimate") - base_sw >= 1
+    assert repriced.total() - base_rp >= 1
+    # ... and the repriced ticket still released exactly once
+    ctrl = AdmissionController.get()
+    assert ctrl is not None
+    assert ctrl.bytes_in_flight == 0 and ctrl.queue_depth == 0
+    EstimatorLedger.reset_for_tests()
 
 
 def test_semaphore_reentrant_across_threads_same_task(fresh_admission):
