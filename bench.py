@@ -899,6 +899,208 @@ def record_history(history_dir: str, eventlog_dir: str,
     return 0
 
 
+# ---------------------------------------------------------------------------
+# --dist: multi-process shuffle benchmark (remote block fetch over loopback)
+# ---------------------------------------------------------------------------
+
+_DIST_CODECS = ("none", "lz4", "zstd")
+#: fetch window per mode: serial drains one block at a time; pipelined
+#: keeps the fetcher's producer thread decompressing ahead of the join
+_DIST_MODES = (("pipelined", 4), ("serial", 1))
+
+
+def _dist_reference(rows: int, parts: int, seed: int):
+    """In-process reference: same tables, same murmur3 routing, same
+    per-partition pyarrow join the distributed run performs — the
+    bit-exactness oracle."""
+    import pyarrow as pa
+    from spark_rapids_tpu.shuffle.serve_map import (build_side_tables,
+                                                    partition_record_batch)
+    fact, dim = build_side_tables(rows, seed)
+    fparts = partition_record_batch(fact, "k", parts)
+    dparts = partition_record_batch(dim, "k", parts)
+    out = []
+    for pid in range(parts):
+        f, d = fparts.get(pid), dparts.get(pid)
+        if f is None or d is None:
+            continue
+        out.append(pa.table(f).join(pa.table(d), "k"))
+    return pa.concat_tables(out).sort_by(
+        [("k", "ascending"), ("v", "ascending")])
+
+
+def _dist_fetch_join(parts: int, window: int):
+    """Reduce side of the distributed join: stream both shuffles'
+    blocks for every partition through the locality read path (all
+    remote here — the child owns every block) and join per partition."""
+    import pyarrow as pa
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.columnar.device import batch_to_arrow
+    from spark_rapids_tpu.shuffle.locality import read_reduce_blocks
+    from spark_rapids_tpu.shuffle.manager import materialize_block
+    from spark_rapids_tpu.shuffle.serve_map import DIM_SID, FACT_SID
+    conf = cfg.RapidsConf(
+        {cfg.SHUFFLE_FETCH_MAX_IN_FLIGHT.key: str(window)})
+    out = []
+    for pid in range(parts):
+        sides = []
+        for sid in (FACT_SID, DIM_SID):
+            rbs = [batch_to_arrow(materialize_block(b, np))
+                   for b in read_reduce_blocks(sid, pid, conf=conf,
+                                               xp=np)]
+            sides.append(pa.Table.from_batches(rbs) if rbs else None)
+        f, d = sides
+        if f is None or d is None:
+            continue
+        out.append(f.join(d, "k"))
+    return pa.concat_tables(out).sort_by(
+        [("k", "ascending"), ("v", "ascending")])
+
+
+def _dist_run(rows: int, parts: int, codec: str, window: int,
+              seed: int) -> dict:
+    """One (codec, window) distributed run: child process owns the map
+    outputs and serves them; this process plays the reduce side."""
+    import subprocess
+    from spark_rapids_tpu.obs import metrics as m
+    from spark_rapids_tpu.shuffle.locality import reset_pool
+    from spark_rapids_tpu.shuffle.registry import (BlockEndpoint,
+                                                   BlockLocationRegistry)
+    from spark_rapids_tpu.shuffle.serve_map import DIM_SID, FACT_SID
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SPARK_RAPIDS_TPU_DISABLE_COMPILE_CACHE="1")
+    child = subprocess.Popen(
+        [sys.executable, "-m", "spark_rapids_tpu.shuffle.serve_map",
+         "--rows", str(rows), "--parts", str(parts),
+         "--codec", codec, "--seed", str(seed)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    try:
+        line = child.stdout.readline()
+        if not line.startswith("PORT "):
+            raise RuntimeError(f"bad serve_map handshake: {line!r}")
+        port = int(line.split()[1])
+        reg = BlockLocationRegistry.get()
+        reg.set_local("bench-reduce", "127.0.0.1", 0)
+        ep = BlockEndpoint("bench-map-0", "127.0.0.1", port)
+        reg.register(FACT_SID, [ep])
+        reg.register(DIM_SID, [ep])
+        local_c = m.counter("tpu_shuffle_local_blocks_total")
+        local_before = local_c.value()
+        t0 = time.perf_counter()
+        joined = _dist_fetch_join(parts, window)
+        wall = time.perf_counter() - t0
+        local_after = local_c.value()
+        child.stdin.write("done\n")
+        child.stdin.flush()
+        stats_line = child.stdout.readline()
+        if not stats_line.startswith("STATS "):
+            raise RuntimeError(f"bad serve_map stats: {stats_line!r}")
+        stats = json.loads(stats_line[len("STATS "):])
+        rc = child.wait(timeout=30)
+        if rc != 0:
+            raise RuntimeError(f"serve_map exited {rc}")
+    finally:
+        child.stdin.close()
+        child.stdout.close()
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+        reset_pool()
+        BlockLocationRegistry.get().forget_shuffle(FACT_SID)
+        BlockLocationRegistry.get().forget_shuffle(DIM_SID)
+    raw = stats.get("raw_bytes") or 0
+    comp = stats.get("compressed_bytes") or 0
+    return {
+        "codec": codec,
+        "window": window,
+        "rows_joined": joined.num_rows,
+        "wall_s": round(wall, 4),
+        "fetch_mb_s": round(raw / max(wall, 1e-9) / 1e6, 2),
+        "raw_bytes": raw,
+        "compressed_bytes": comp,
+        "compression_ratio": round(comp / raw, 4) if raw else None,
+        "server_metadata_requests": stats.get(
+            "server_metadata_requests"),
+        "server_transfer_requests": stats.get(
+            "server_transfer_requests"),
+        "child_leaked_blocks": stats.get("leaked_blocks"),
+        "child_leaks": stats.get("leaks"),
+        "parent_local_blocks": local_after - local_before,
+        "_table": joined,
+    }
+
+
+def measure_dist(rows: int, parts: int, seed: int) -> dict:
+    """Full --dist sweep: none/lz4/zstd x pipelined/serial, each run
+    bit-exact against the in-process reference, zero leaked blocks on
+    both sides, lz4 visibly compressing (ratio < 0.9)."""
+    from spark_rapids_tpu.memory.spill import SpillCatalog
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+    reference = _dist_reference(rows, parts, seed)
+    runs = []
+    failures = []
+    for codec in _DIST_CODECS:
+        for mode, window in _DIST_MODES:
+            r = _dist_run(rows, parts, codec, window, seed)
+            r["mode"] = mode
+            tbl = r.pop("_table")
+            r["bit_exact"] = tbl.equals(reference)
+            if not r["bit_exact"]:
+                failures.append(
+                    f"{codec}/{mode}: result not bit-exact vs "
+                    f"in-process reference ({tbl.num_rows} vs "
+                    f"{reference.num_rows} rows)")
+            if r["child_leaked_blocks"]:
+                failures.append(
+                    f"{codec}/{mode}: child leaked "
+                    f"{r['child_leaked_blocks']} catalog block(s)")
+            if r["child_leaks"]:
+                failures.append(
+                    f"{codec}/{mode}: child spill ledger reported "
+                    f"{r['child_leaks']} leak(s)")
+            if r["parent_local_blocks"]:
+                failures.append(
+                    f"{codec}/{mode}: {r['parent_local_blocks']} "
+                    f"block(s) took the local path — every block is "
+                    f"remote in this topology")
+            if codec != "none" and r["compression_ratio"] is not None \
+                    and r["compression_ratio"] >= 0.9:
+                failures.append(
+                    f"{codec}/{mode}: compression ratio "
+                    f"{r['compression_ratio']} >= 0.9 — codec not "
+                    f"actually compressing the shuffle payload")
+            runs.append(r)
+            print("SUITE_JSON=" + json.dumps(
+                {"suite": f"dist_{codec}_{mode}",
+                 **{k: v for k, v in r.items()}}))
+    parent_leaks = len(SpillCatalog.get().leak_report())
+    if parent_leaks:
+        failures.append(f"reduce side spill ledger reported "
+                        f"{parent_leaks} leak(s)")
+    leftover = TpuShuffleManager.get().catalog.num_blocks()
+    if leftover:
+        failures.append(f"reduce side catalog still holds {leftover} "
+                        f"block(s) after all runs drained")
+    def _wall(codec, mode):
+        for r in runs:
+            if r["codec"] == codec and r["mode"] == mode:
+                return r["wall_s"]
+        return None
+    summary = {
+        "metric": "dist_shuffle_fetch",
+        "rows": rows,
+        "parts": parts,
+        "runs": runs,
+        "pipelined_vs_serial_lz4": round(
+            _wall("lz4", "serial") / max(_wall("lz4", "pipelined"),
+                                         1e-9), 3),
+        "failures": failures,
+    }
+    return summary
+
+
 def _arg_value(flag: str, default=None):
     for a in sys.argv[1:]:
         if a.startswith(flag + "="):
@@ -939,6 +1141,18 @@ def main():
                       _arg_value("--accuracy-history", ""),
                       "--with-feedback" in sys.argv[1:])
         return
+    if "--dist" in sys.argv[1:]:
+        # multi-process shuffle mode: map side in a child OS process,
+        # reduce side here, blocks over loopback TCP.  Pure host-side
+        # (numpy + pyarrow) — no accelerator probe needed.
+        dist_rows = int(pos[0]) if pos else 20_000
+        dist_parts = int(_arg_value("--parts", "4"))
+        dist_seed = int(_arg_value("--seed", "7"))
+        summary = measure_dist(dist_rows, dist_parts, dist_seed)
+        print(json.dumps(summary))
+        for msg in summary["failures"]:
+            print(f"DIST GUARD FAILED: {msg}", file=sys.stderr)
+        sys.exit(1 if summary["failures"] else 0)
     with_serve = "--serve" in sys.argv[1:]
     with_pyspark = "--baseline=pyspark" in sys.argv[1:]
     with_trace_guard = "--trace-overhead" in sys.argv[1:]

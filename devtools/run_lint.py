@@ -873,6 +873,12 @@ def run_shuffle_gate() -> int:
         print(f"SHUFFLE: clean transport leg counted {n_errs} fetch "
               f"error(s)")
 
+    # wire leg: injected remote failures must surface TYPED, with
+    # tpu_shuffle_fetch_errors_total{kind} agreeing, and the locality
+    # split must prove local blocks never cross the wire
+    wire_failures = _shuffle_wire_leg()
+    failures += wire_failures
+
     MetricsRegistry.reset_for_tests()
     if failures:
         print(f"shuffle gate: {failures} failure(s)")
@@ -881,8 +887,356 @@ def run_shuffle_gate() -> int:
           f"correctly under a 1-byte spill budget, peak {int(peak)} "
           f"device bytes, {int(spilled)} bytes spilled, {int(saved)} "
           f"slice-view bytes saved, ledger + catalog clean; transport "
-          f"leg fetched {int(fetched)} blocks with zero errors)")
+          f"leg fetched {int(fetched)} blocks with zero errors; wire "
+          f"leg: every injected remote failure surfaced typed, "
+          f"replica retry completed exactly once, local blocks stayed "
+          f"zero-copy, cross-process golden bit-exact)")
     return 0
+
+
+def _shuffle_wire_leg() -> int:
+    """Injected-failure wire scenarios.  Each rogue server speaks just
+    enough protocol to inject ONE specific fault; the client must fail
+    with the matching typed error AND count it under the matching
+    ``tpu_shuffle_fetch_errors_total{kind}`` — a mismatch between what
+    raised and what was counted is itself a failure."""
+    import socket
+    import struct
+    import subprocess
+    import threading
+    import time
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.columnar.device import (batch_to_arrow,
+                                                  batch_to_device)
+    from spark_rapids_tpu.memory.meta import (CODEC_LZ4, MAGIC, VERSION,
+                                              _HEADER, TableMeta)
+    from spark_rapids_tpu.obs import metrics as m
+    from spark_rapids_tpu.shuffle import locality
+    from spark_rapids_tpu.shuffle.errors import (
+        TpuShuffleCorruptBlockError, TpuShuffleFetchFailedError,
+        TpuShufflePeerDeadError, TpuShuffleStaleFrameError,
+        TpuShuffleTruncatedFrameError)
+    from spark_rapids_tpu.shuffle.heartbeat import HeartbeatManager
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+    from spark_rapids_tpu.shuffle.registry import (BlockEndpoint,
+                                                   BlockLocationRegistry)
+    from spark_rapids_tpu.shuffle.transport import (
+        _FRAME, _recv_exact, MSG_BUFFER, MSG_METADATA_RESP,
+        AsyncBlockFetcher, ShuffleClient, ShuffleServer,
+        _server_requests_counter)
+
+    failures = 0
+    errs = m.counter("tpu_shuffle_fetch_errors_total",
+                     "async fetch failures by kind",
+                     labelnames=("kind",))
+
+    def rogue(script):
+        """One-connection server running ``script(conn)`` then closing:
+        the injected-failure side of each scenario."""
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(1)
+        port = lsock.getsockname()[1]
+
+        def run():
+            conn, _ = lsock.accept()
+            try:
+                script(conn)
+            except OSError:
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                lsock.close()
+
+        threading.Thread(target=run, daemon=True).start()
+        return port
+
+    def read_req(conn):
+        head = _recv_exact(conn, _FRAME.size)
+        mtype, rid, blen = _FRAME.unpack(head)
+        if blen:
+            _recv_exact(conn, blen)
+        return mtype, rid
+
+    def expect(name, port, exc_type, kind, window=2):
+        """Drive one fetch against the rogue at ``port``; it must raise
+        ``exc_type`` and bump errs{kind} by exactly one."""
+        nonlocal failures
+        before = errs.value(kind=kind)
+        cli = ShuffleClient("127.0.0.1", port, timeout=10.0)
+        try:
+            list(AsyncBlockFetcher(cli, 31, 0, window=window,
+                                   timeout=10.0))
+        except exc_type:
+            pass
+        except Exception as ex:  # noqa: BLE001 — report the wrong type
+            failures += 1
+            print(f"SHUFFLE-WIRE: {name} raised "
+                  f"{type(ex).__name__} ({ex}), expected "
+                  f"{exc_type.__name__}")
+            cli.close()
+            return
+        else:
+            failures += 1
+            print(f"SHUFFLE-WIRE: {name} did not raise")
+            cli.close()
+            return
+        cli.close()
+        got = errs.value(kind=kind) - before
+        if got != 1:
+            failures += 1
+            print(f"SHUFFLE-WIRE: {name} counted {got} "
+                  f"errors_total{{kind={kind}}}, expected 1")
+
+    # (1) stale frame: a response correlating to a DIFFERENT request id
+    def stale_script(conn):
+        _, rid = read_req(conn)
+        conn.sendall(_FRAME.pack(MSG_METADATA_RESP, rid + 977, 0))
+
+    expect("stale frame", rogue(stale_script),
+           TpuShuffleStaleFrameError, "stale")
+
+    # (2) truncated frame: header promises 100 body bytes, sends 10
+    def trunc_script(conn):
+        _, rid = read_req(conn)
+        conn.sendall(_FRAME.pack(MSG_METADATA_RESP, rid, 100)
+                     + b"x" * 10)
+
+    expect("truncated frame", rogue(trunc_script),
+           TpuShuffleTruncatedFrameError, "truncated")
+
+    # (3) corrupt compressed body: valid TPUB header claiming lz4, then
+    # garbage where the codec frame should be
+    def corrupt_script(conn):
+        _, rid = read_req(conn)
+        meta = (struct.pack("<i", 1)
+                + struct.pack("<qqqq", 31, 0, 0, 0)
+                + TableMeta.of_stats(10, 160, 0).pack())
+        conn.sendall(_FRAME.pack(MSG_METADATA_RESP, rid, len(meta))
+                     + meta)
+        _, rid = read_req(conn)
+        payload = _HEADER.pack(MAGIC, VERSION, CODEC_LZ4, 10, 20) \
+            + b"\xff" * 20
+        conn.sendall(_FRAME.pack(MSG_BUFFER, rid, 8)
+                     + struct.pack("<q", len(payload)) + payload)
+
+    expect("corrupt codec body", rogue(corrupt_script),
+           TpuShuffleCorruptBlockError, "corrupt")
+
+    # (4) mid-fetch server death: a REAL server stopped after the
+    # consumer takes its first block — the rest of the stream must fail
+    # typed, not hang
+    TpuShuffleManager.reset()
+    mgr = TpuShuffleManager.get()
+    for mid in range(6):
+        rb = pa.record_batch({"a": pa.array(
+            [mid * 100 + i for i in range(64)], type=pa.int64())})
+        mgr.write_map_output(41, mid, {0: batch_to_device(rb, xp=np)})
+    server = ShuffleServer(mgr).start()
+    before = errs.value(kind="fetch_failed")
+    cli = ShuffleClient("127.0.0.1", server.port, timeout=10.0)
+    died_typed = False
+    try:
+        for i, _b in enumerate(AsyncBlockFetcher(cli, 41, 0, window=1,
+                                                 timeout=10.0)):
+            if i == 0:
+                server.stop()
+    except TpuShuffleFetchFailedError:
+        died_typed = True
+    except Exception as ex:  # noqa: BLE001
+        failures += 1
+        print(f"SHUFFLE-WIRE: mid-fetch death raised "
+              f"{type(ex).__name__}, expected a typed fetch failure")
+    cli.close()
+    if not died_typed and not failures:
+        failures += 1
+        print("SHUFFLE-WIRE: mid-fetch server death did not fail the "
+              "stream")
+    if died_typed and errs.value(kind="fetch_failed") - before != 1:
+        failures += 1
+        print("SHUFFLE-WIRE: mid-fetch death not counted under "
+              "kind=fetch_failed")
+
+    # (5) heartbeat-dead peer: every replica expired -> typed peer-dead
+    # without ever dialing
+    hb = HeartbeatManager(timeout_s=0.01)
+    hb.register_executor("wire-dead", "127.0.0.1", 1)
+    time.sleep(0.05)
+    BlockLocationRegistry.reset()
+    reg = BlockLocationRegistry.get()
+    reg.set_local("gate-reduce", "127.0.0.1", 0)
+    reg.attach_heartbeat(hb)
+    group = [BlockEndpoint("wire-dead", "127.0.0.1", 1)]
+    before = errs.value(kind="peer_dead")
+    try:
+        list(locality._fetch_group(group, 42, 0, reg, np, 2, 5.0, 1, m))
+        failures += 1
+        print("SHUFFLE-WIRE: all-dead replica group did not raise")
+    except TpuShufflePeerDeadError:
+        if errs.value(kind="peer_dead") - before != 1:
+            failures += 1
+            print("SHUFFLE-WIRE: dead peer group not counted under "
+                  "kind=peer_dead")
+
+    # (6) replica retry, exactly once: first replica's port refuses the
+    # dial, the live replica must serve ALL blocks with ONE retry and
+    # zero duplicates
+    TpuShuffleManager.reset()
+    mgr = TpuShuffleManager.get()
+    for mid in range(6):
+        rb = pa.record_batch({"a": pa.array(
+            [mid * 100 + i for i in range(64)], type=pa.int64())})
+        mgr.write_map_output(43, mid, {0: batch_to_device(rb, xp=np)})
+    server = ShuffleServer(mgr).start()
+    dead_sock = socket.socket()
+    dead_sock.bind(("127.0.0.1", 0))
+    dead_port = dead_sock.getsockname()[1]
+    dead_sock.close()  # nothing listens here anymore
+    hb2 = HeartbeatManager(timeout_s=60.0)
+    hb2.register_executor("replica-a", "127.0.0.1", dead_port)
+    hb2.register_executor("replica-b", "127.0.0.1", server.port)
+    reg.attach_heartbeat(hb2)
+    group = [BlockEndpoint("replica-a", "127.0.0.1", dead_port),
+             BlockEndpoint("replica-b", "127.0.0.1", server.port)]
+    retries = m.counter("tpu_shuffle_fetch_retries_total")
+    r_before = retries.value()
+    locality.reset_pool()
+    try:
+        got = [batch_to_arrow(b).column("a").to_pylist()[0]
+               for b in locality._fetch_group(group, 43, 0, reg, np,
+                                              2, 5.0, 2, m)]
+        if got != [mid * 100 for mid in range(6)]:
+            failures += 1
+            print(f"SHUFFLE-WIRE: replica retry delivered {got} "
+                  f"(duplicates or gaps)")
+        if retries.value() - r_before != 1:
+            failures += 1
+            print(f"SHUFFLE-WIRE: replica retry counted "
+                  f"{retries.value() - r_before} retries, expected 1")
+    except Exception as ex:  # noqa: BLE001
+        failures += 1
+        print(f"SHUFFLE-WIRE: replica retry failed: "
+              f"{type(ex).__name__}: {ex}")
+    finally:
+        server.stop()
+        locality.reset_pool()
+
+    # (7) local zero-copy proof: a shuffle whose owner group is THIS
+    # process must serve from the catalog — local counter moves, the
+    # block-server transfer counter must NOT
+    TpuShuffleManager.reset()
+    mgr = TpuShuffleManager.get()
+    rb = pa.record_batch({"a": pa.array(range(64), type=pa.int64())})
+    mgr.write_map_output(44, 0, {0: batch_to_device(rb, xp=np)})
+    server = ShuffleServer(mgr).start()
+    BlockLocationRegistry.reset()
+    reg = BlockLocationRegistry.get()
+    reg.set_local("gate-local", "127.0.0.1", server.port)
+    reg.register(44, [BlockEndpoint("gate-local", "127.0.0.1",
+                                    server.port)])
+    local_c = m.counter("tpu_shuffle_local_blocks_total")
+    srv_c = _server_requests_counter()
+    l_before = local_c.value()
+    t_before = srv_c.value(kind="transfer")
+    n_local = sum(1 for _ in locality.read_reduce_blocks(44, 0))
+    server.stop()
+    if n_local != 1 or local_c.value() - l_before != 1:
+        failures += 1
+        print(f"SHUFFLE-WIRE: local group read {n_local} block(s), "
+              f"local counter moved "
+              f"{local_c.value() - l_before} — zero-copy path broken")
+    if srv_c.value(kind="transfer") - t_before != 0:
+        failures += 1
+        print("SHUFFLE-WIRE: local blocks crossed the wire (server "
+              "transfer counter moved)")
+
+    # (8) forced-remote golden over loopback: a child OS process owns
+    # the map outputs; the joined result here must be bit-exact vs the
+    # in-process reference, with zero local reads and zero leaks
+    from spark_rapids_tpu.shuffle.serve_map import (
+        DIM_SID, FACT_SID, build_side_tables, partition_record_batch)
+    TpuShuffleManager.reset()
+    BlockLocationRegistry.reset()
+    reg = BlockLocationRegistry.get()
+    reg.set_local("gate-reduce", "127.0.0.1", 0)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SPARK_RAPIDS_TPU_DISABLE_COMPILE_CACHE="1")
+    rows, parts, seed = 4000, 2, 3
+    child = subprocess.Popen(
+        [sys.executable, "-m", "spark_rapids_tpu.shuffle.serve_map",
+         "--rows", str(rows), "--parts", str(parts),
+         "--codec", "lz4", "--seed", str(seed)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, env=env, cwd=REPO)
+    try:
+        line = child.stdout.readline()
+        port = int(line.split()[1])
+        ep = BlockEndpoint("gate-map", "127.0.0.1", port)
+        reg.register(FACT_SID, [ep])
+        reg.register(DIM_SID, [ep])
+        l_before = local_c.value()
+        out = []
+        for pid in range(parts):
+            sides = []
+            for sid in (FACT_SID, DIM_SID):
+                rbs = [batch_to_arrow(b) for b in
+                       locality.read_reduce_blocks(sid, pid)]
+                sides.append(pa.Table.from_batches(rbs)
+                             if rbs else None)
+            if sides[0] is not None and sides[1] is not None:
+                out.append(sides[0].join(sides[1], "k"))
+        got_tbl = pa.concat_tables(out).sort_by(
+            [("k", "ascending"), ("v", "ascending")])
+        fact, dim = build_side_tables(rows, seed)
+        ref = []
+        fparts = partition_record_batch(fact, "k", parts)
+        dparts = partition_record_batch(dim, "k", parts)
+        for pid in range(parts):
+            f, d = fparts.get(pid), dparts.get(pid)
+            if f is not None and d is not None:
+                ref.append(pa.table(f).join(pa.table(d), "k"))
+        ref_tbl = pa.concat_tables(ref).sort_by(
+            [("k", "ascending"), ("v", "ascending")])
+        if not got_tbl.equals(ref_tbl):
+            failures += 1
+            print(f"SHUFFLE-WIRE: cross-process golden NOT bit-exact "
+                  f"({got_tbl.num_rows} vs {ref_tbl.num_rows} rows)")
+        if local_c.value() - l_before != 0:
+            failures += 1
+            print("SHUFFLE-WIRE: cross-process run took the local "
+                  "path for remote-owned blocks")
+        child.stdin.write("done\n")
+        child.stdin.flush()
+        stats_line = child.stdout.readline()
+        stats = json.loads(stats_line[len("STATS "):])
+        if stats["leaked_blocks"] or stats["leaks"]:
+            failures += 1
+            print(f"SHUFFLE-WIRE: map-side process leaked "
+                  f"{stats['leaked_blocks']} block(s), "
+                  f"{stats['leaks']} spill ledger leak(s)")
+        ratio = (stats["compressed_bytes"] / stats["raw_bytes"]
+                 if stats["raw_bytes"] else 1.0)
+        if ratio >= 0.9:
+            failures += 1
+            print(f"SHUFFLE-WIRE: lz4 shuffle ratio {ratio:.3f} >= "
+                  f"0.9 — compression not visible on the wire")
+        child.wait(timeout=30)
+    finally:
+        child.stdin.close()
+        child.stdout.close()
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+        locality.reset_pool()
+        BlockLocationRegistry.reset()
+        TpuShuffleManager.reset()
+    return failures
 
 
 def run_serve_gate() -> int:

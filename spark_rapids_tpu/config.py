@@ -409,6 +409,40 @@ SHUFFLE_SLICE_VIEWS = conf(
          "behavior).") \
     .create_with_default(True)
 
+SHUFFLE_SERVER_ENABLED = conf(
+    "spark.rapids.tpu.shuffle.server.enabled").boolean() \
+    .doc("Start the shuffle block-server endpoint at executor plugin "
+         "init, next to the health HTTP server, so peers can fetch this "
+         "process's catalog blocks over TCP.  Implied by "
+         "spark.rapids.shuffle.transport=tcp; set explicitly to serve "
+         "blocks while keeping another transport for writes.") \
+    .create_with_default(False)
+
+SHUFFLE_SERVER_PORT = conf(
+    "spark.rapids.tpu.shuffle.server.port").integer() \
+    .doc("TCP port of the shuffle block server (0 = ephemeral; the "
+         "bound port is what heartbeat registration advertises to "
+         "peers).") \
+    .create_with_default(0)
+
+SHUFFLE_LOCALITY_ENABLED = conf(
+    "spark.rapids.tpu.shuffle.locality.enabled").boolean() \
+    .doc("Consult the BlockLocationRegistry on reduce-side reads: "
+         "blocks owned by this process stay zero-copy catalog reads "
+         "(never crossing the wire), blocks registered to remote "
+         "endpoints stream through the async fetcher.  Off: reads "
+         "serve only the local catalog (the pre-registry behavior).") \
+    .create_with_default(True)
+
+SHUFFLE_FETCH_MAX_RETRIES = conf(
+    "spark.rapids.tpu.shuffle.fetch.maxRetries").integer() \
+    .doc("Additional fetch attempts after the first failure of a "
+         "remote reduce-side read, each against the next live replica "
+         "of the owning endpoint group (heartbeat liveness picks the "
+         "candidates).  Exhausting the budget fails the stage with a "
+         "typed error carrying provenance — never a silent hang.") \
+    .create_with_default(2)
+
 # --- io -------------------------------------------------------------------
 
 PARQUET_ENABLED = conf("spark.rapids.sql.format.parquet.enabled").boolean() \

@@ -30,8 +30,16 @@ CODEC_LZ4 = 1
 CODEC_ZSTD = 2
 
 CODEC_BY_NAME = {"none": CODEC_NONE, "lz4": CODEC_LZ4, "zstd": CODEC_ZSTD}
+CODEC_NAMES = {v: k for k, v in CODEC_BY_NAME.items()}
 
 _default_codec = CODEC_NONE
+
+
+class TpuCorruptPayloadError(ValueError):
+    """A serialized batch failed to decode: bad magic/version, a body
+    shorter than its declared length, or codec-level corruption.  Typed
+    (never a bare assert) so shuffle transport and disk-spill reads can
+    surface data corruption distinctly from programming errors."""
 
 
 def set_default_codec(name: str) -> None:
@@ -49,6 +57,17 @@ def default_codec() -> int:
 def serialize_batch(batch: DeviceBatch,
                     codec: Optional[int] = None) -> bytes:
     """Device/host batch -> self-describing bytes."""
+    return serialize_batch_with_sizes(batch, codec)[0]
+
+
+def serialize_batch_with_sizes(batch: DeviceBatch,
+                               codec: Optional[int] = None
+                               ) -> Tuple[bytes, int, int]:
+    """serialize_batch plus the (raw, encoded) body sizes, so callers
+    (shuffle server, spill tiers) can account compression per payload
+    without re-measuring.  Every serialized byte is metered into
+    tpu_shuffle_{raw,compressed}_bytes_total{codec} here — the single
+    choke point both shuffle transport and spill stage through."""
     if codec is None:
         codec = _default_codec
     rb = batch_to_arrow(batch)
@@ -56,6 +75,7 @@ def serialize_batch(batch: DeviceBatch,
     with pa.ipc.new_stream(sink, rb.schema) as w:
         w.write_batch(rb)
     body = sink.getvalue()
+    raw_len = len(body)
     if codec == CODEC_LZ4:
         from ..native import codec as ncodec
         body = ncodec.lz4_compress(body)
@@ -64,28 +84,57 @@ def serialize_batch(batch: DeviceBatch,
         body = ncodec.zstd_compress(body)
     head = _HEADER.pack(MAGIC, VERSION, codec, int(batch.num_rows),
                         len(body))
+    from ..obs import metrics as m
+    if m.enabled():
+        name = CODEC_NAMES.get(codec, str(codec))
+        m.counter("tpu_shuffle_raw_bytes_total",
+                  "uncompressed payload bytes staged for shuffle/spill",
+                  ("codec",)).labels(codec=name).inc(raw_len)
+        m.counter("tpu_shuffle_compressed_bytes_total",
+                  "encoded payload bytes after the codec (equals raw "
+                  "for codec=none)",
+                  ("codec",)).labels(codec=name).inc(len(body))
     # spill/shuffle payloads stage through the shared pinned arena when
     # one is configured (spark.rapids.memory.pinnedPool.size): one
     # page-aligned native buffer instead of per-call heap churn, and
     # the arena's utilization gauges see every serialized batch
     from ..native.arena import stage_bytes
-    return stage_bytes(head + body)
+    return stage_bytes(head + body), raw_len, len(body)
 
 
 def deserialize_batch(data: bytes, xp=np) -> DeviceBatch:
+    from ..native.codec import CodecCorruptionError
+    if len(data) < _HEADER.size:
+        raise TpuCorruptPayloadError(
+            f"payload too short for header: {len(data)} bytes < "
+            f"{_HEADER.size}")
     magic, version, codec, n_rows, body_len = _HEADER.unpack_from(data, 0)
-    assert magic == MAGIC and version == VERSION, "bad batch header"
+    if magic != MAGIC or version != VERSION:
+        raise TpuCorruptPayloadError(
+            f"bad batch header: magic={magic!r} version={version}")
     body = data[_HEADER.size:_HEADER.size + body_len]
-    if codec == CODEC_LZ4:
-        from ..native import codec as ncodec
-        body = ncodec.lz4_decompress(body)
-    elif codec == CODEC_ZSTD:
-        from ..native import codec as ncodec
-        body = ncodec.zstd_decompress(body)
-    with pa.ipc.open_stream(io.BytesIO(body)) as r:
-        rbs = list(r)
+    if len(body) < body_len:
+        raise TpuCorruptPayloadError(
+            f"truncated payload body: header declares {body_len} bytes, "
+            f"{len(body)} present")
+    try:
+        if codec == CODEC_LZ4:
+            from ..native import codec as ncodec
+            body = ncodec.lz4_decompress(body)
+        elif codec == CODEC_ZSTD:
+            from ..native import codec as ncodec
+            body = ncodec.zstd_decompress(body)
+        elif codec != CODEC_NONE:
+            raise TpuCorruptPayloadError(
+                f"unknown codec id {codec} in batch header")
+        with pa.ipc.open_stream(io.BytesIO(body)) as r:
+            rbs = list(r)
+    except CodecCorruptionError as ex:
+        raise TpuCorruptPayloadError(f"codec frame corrupt: {ex}") from ex
+    except pa.ArrowInvalid as ex:
+        raise TpuCorruptPayloadError(f"arrow body corrupt: {ex}") from ex
     if not rbs:
-        raise ValueError("empty batch stream")
+        raise TpuCorruptPayloadError("empty batch stream")
     return batch_to_device(rbs[0], xp=xp)
 
 
@@ -113,8 +162,21 @@ class TableMeta:
 
     @classmethod
     def of(cls, batch: DeviceBatch, payload: bytes) -> "TableMeta":
-        import zlib
-        names = ",".join(batch.names).encode()
-        types = ",".join(d.name for d in batch.dtypes).encode()
-        fp = zlib.crc32(names + b"|" + types)
-        return cls(int(batch.num_rows), len(payload), fp)
+        return cls(int(batch.num_rows), len(payload),
+                   schema_fingerprint(batch.names, batch.dtypes))
+
+    @classmethod
+    def of_stats(cls, num_rows: int, num_bytes: int,
+                 fingerprint: int) -> "TableMeta":
+        """Meta from catalog-tracked stats — the O(1) path the block
+        server uses instead of materializing and serializing payloads
+        (num_bytes is the catalog's retained-size hint, not an exact
+        serialized length)."""
+        return cls(int(num_rows), int(num_bytes), fingerprint)
+
+
+def schema_fingerprint(names, dtypes) -> int:
+    import zlib
+    n = ",".join(names).encode()
+    t = ",".join(d.name for d in dtypes).encode()
+    return zlib.crc32(n + b"|" + t)

@@ -66,6 +66,13 @@ def _metrics():
                 "registered device-resident bytes (incl. pinned)"),
         m.gauge("tpu_spill_host_bytes",
                 "serialized bytes held in the HOST tier"),
+        m.counter("tpu_spill_raw_bytes_total",
+                  "uncompressed serialized-body bytes entering each "
+                  "tier (pre-codec)", ("tier",)),
+        m.counter("tpu_spill_serialized_bytes_total",
+                  "post-codec bytes actually stored per tier — vs the "
+                  "raw counter this is the codec's effect on host "
+                  "retention and disk I/O", ("tier",)),
     )
 
 
@@ -127,8 +134,10 @@ class SpillableBatch:
     def spill_to_host(self):
         if self.tier != StorageTier.DEVICE:
             return 0
-        from .meta import serialize_batch
-        self._host_bytes = serialize_batch(self._batch)
+        from .meta import serialize_batch_with_sizes
+        self._host_bytes, raw_len, enc_len = \
+            serialize_batch_with_sizes(self._batch)
+        self._raw_body_len = raw_len
         self._batch = None
         self.tier = StorageTier.HOST
         led = _ledger()
@@ -136,7 +145,10 @@ class SpillableBatch:
             led.on_spill(self.id, self.device_bytes)
         _trace_event("spill.host", bytes=self.device_bytes,
                      buffer=self.id[:8])
-        _metrics()[2].labels(tier="host").inc(self.device_bytes)
+        mm = _metrics()
+        mm[2].labels(tier="host").inc(self.device_bytes)
+        mm[6].labels(tier="host").inc(raw_len)
+        mm[7].labels(tier="host").inc(enc_len)
         return self.device_bytes
 
     def spill_to_disk(self):
@@ -155,7 +167,11 @@ class SpillableBatch:
         if led is not None:
             led.on_spill(self.id, 0)  # host tier -> disk: no HBM delta
         _trace_event("spill.disk", bytes=freed, buffer=self.id[:8])
-        _metrics()[2].labels(tier="disk").inc(freed)
+        mm = _metrics()
+        mm[2].labels(tier="disk").inc(freed)
+        mm[6].labels(tier="disk").inc(
+            getattr(self, "_raw_body_len", freed))
+        mm[7].labels(tier="disk").inc(freed)
         return freed
 
     def get_batch(self, xp) -> DeviceBatch:

@@ -28,6 +28,25 @@ _B_ZLIB = 2
 _B_ZSTD = 3
 
 
+class CodecCorruptionError(RuntimeError):
+    """A compressed frame failed to decode: short/garbled header, an
+    unknown backend id, or a backend reporting a size/CRC mismatch.
+    Typed (instead of a bare RuntimeError) so transport and spill can
+    surface corruption distinctly from infrastructure failures."""
+
+
+def _unpack_frame(data: bytes):
+    if len(data) < _FRAME.size:
+        raise CodecCorruptionError(
+            f"codec frame too short: {len(data)} bytes < "
+            f"{_FRAME.size}-byte header")
+    n, backend = _FRAME.unpack_from(data, 0)
+    if n < 0:
+        raise CodecCorruptionError(
+            f"codec frame declares negative size {n}")
+    return n, backend, data[_FRAME.size:]
+
+
 # --- lz4 -------------------------------------------------------------------
 
 def lz4_compress(data: bytes) -> bytes:
@@ -45,10 +64,12 @@ def lz4_compress(data: bytes) -> bytes:
 
 
 def lz4_decompress(data: bytes) -> bytes:
-    n, backend = _FRAME.unpack_from(data, 0)
-    body = data[_FRAME.size:]
+    n, backend, body = _unpack_frame(data)
     if backend == _B_ZLIB:
-        return zlib.decompress(body)
+        return _zlib_decompress(body, n)
+    if backend != _B_NATIVE_LZ4:
+        raise CodecCorruptionError(
+            f"lz4 frame carries unknown backend id {backend}")
     lib = get_lib()
     if lib is None:
         raise RuntimeError(
@@ -60,8 +81,20 @@ def lz4_decompress(data: bytes) -> bytes:
     src = (ctypes.c_uint8 * max(len(body), 1)).from_buffer_copy(body or b"\0")
     m = lib.tpu_lz4_decompress(src, len(body), dst, n)
     if m != n:
-        raise RuntimeError(f"lz4 decompress: expected {n} bytes, got {m}")
+        raise CodecCorruptionError(
+            f"lz4 decompress: expected {n} bytes, got {m}")
     return bytes(dst[:n])
+
+
+def _zlib_decompress(body: bytes, n: int) -> bytes:
+    try:
+        out = zlib.decompress(body)
+    except zlib.error as ex:
+        raise CodecCorruptionError(f"zlib decompress failed: {ex}") from ex
+    if len(out) != n:
+        raise CodecCorruptionError(
+            f"zlib decompress: expected {n} bytes, got {len(out)}")
+    return out
 
 
 # --- zstd ------------------------------------------------------------------
@@ -109,10 +142,12 @@ def zstd_compress(data: bytes, level: int = 1) -> bytes:
 
 
 def zstd_decompress(data: bytes) -> bytes:
-    n, backend = _FRAME.unpack_from(data, 0)
-    body = data[_FRAME.size:]
+    n, backend, body = _unpack_frame(data)
     if backend == _B_ZLIB:
-        return zlib.decompress(body)
+        return _zlib_decompress(body, n)
+    if backend != _B_ZSTD:
+        raise CodecCorruptionError(
+            f"zstd frame carries unknown backend id {backend}")
     lib = _zstd()
     if lib is None:
         raise RuntimeError("payload was zstd-compressed but libzstd "
@@ -120,7 +155,8 @@ def zstd_decompress(data: bytes) -> bytes:
     dst = ctypes.create_string_buffer(max(n, 1))
     m = lib.ZSTD_decompress(dst, n, body, len(body))
     if lib.ZSTD_isError(m) or m != n:
-        raise RuntimeError(f"zstd decompress: expected {n} bytes, got {m}")
+        raise CodecCorruptionError(
+            f"zstd decompress: expected {n} bytes, got {m}")
     return dst.raw[:n]
 
 

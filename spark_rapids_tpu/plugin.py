@@ -219,15 +219,30 @@ class TpuExecutorPlugin:
             if pinned and pinned > 0:
                 from .native.arena import configure_shared_arena
                 configure_shared_arena(pinned)
-            if self.conf.get(cfg.SHUFFLE_MANAGER_ENABLED) and \
-                    self.conf.get(cfg.SHUFFLE_TRANSPORT) == "tcp":
+            # block-server endpoint: starts next to the health HTTP
+            # server when transport=tcp OR shuffle.server.enabled —
+            # peers fetch this process's catalog blocks from it
+            srv_on = self.conf.get(cfg.SHUFFLE_MANAGER_ENABLED) and (
+                self.conf.get(cfg.SHUFFLE_TRANSPORT) == "tcp"
+                or self.conf.get(cfg.SHUFFLE_SERVER_ENABLED))
+            if srv_on:
                 from .shuffle.transport import ShuffleServer
-                self.shuffle_server = ShuffleServer().start()
+                self.shuffle_server = ShuffleServer(
+                    port=self.conf.get(cfg.SHUFFLE_SERVER_PORT)).start()
+            # the location registry learns this process's identity so
+            # reduce-side reads can split local (zero-copy catalog)
+            # from remote (fetched) blocks
+            from .shuffle.registry import BlockLocationRegistry
+            reg = BlockLocationRegistry.get()
+            reg.set_local(self.executor_id, "127.0.0.1",
+                          getattr(self.shuffle_server, "port", 0) or 0)
             if self.driver is not None:
                 self.driver.receive({
                     "kind": "register", "executor_id": self.executor_id,
                     "host": "localhost",
                     "port": getattr(self.shuffle_server, "port", 0)})
+                if self.driver.heartbeat_manager is not None:
+                    reg.attach_heartbeat(self.driver.heartbeat_manager)
             log.info("TPU executor plugin initialized (executor %s)",
                      self.executor_id)
         except Exception as ex:

@@ -213,20 +213,28 @@ class AQEShuffleReadExec(Exec):
         sid = self.exchange._shuffle_id
         xp = self.xp
         from ..obs import metrics as m
+        from .locality import read_reduce_blocks
         read_batches = m.counter("tpu_shuffle_read_batches_total",
                                  "reduce-side blocks read back")
         for rid in spec.reduce_ids:
-            blocks = mgr.catalog.blocks_for_reduce(sid, rid)
             if spec.block_slice is not None:
+                # skew-split chunks index the LOCAL catalog's block list
+                # (skew detection never fires for remote owner groups —
+                # see _SkewAwareRead.specs), so the slice path stays a
+                # direct catalog read
                 lo, hi = spec.block_slice
-                blocks = blocks[lo:hi]
-            for blk in blocks:
-                for b in mgr.catalog.get(blk):
-                    b = materialize_block(b, xp)
-                    self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
-                    self.metrics[NUM_OUTPUT_BATCHES] += 1
-                    read_batches.inc()
-                    yield b
+                blocks = mgr.catalog.blocks_for_reduce(sid, rid)[lo:hi]
+                src = (b for blk in blocks for b in mgr.catalog.get(blk))
+            else:
+                # locality-aware: local blocks zero-copy, remote owner
+                # groups streamed through the async fetcher
+                src = read_reduce_blocks(sid, rid, conf=self.conf, xp=xp)
+            for b in src:
+                b = materialize_block(b, xp)
+                self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
+                self.metrics[NUM_OUTPUT_BATCHES] += 1
+                read_batches.inc()
+                yield b
 
 
 def install_aqe_readers(root: Exec, conf: cfg.RapidsConf) -> Exec:
@@ -317,7 +325,13 @@ class _SkewAwareRead(AQEShuffleReadExec):
             n_blocks = [len(mgr.catalog.blocks_for_reduce(sid, rid))
                         for rid in range(n)]
             target = self.conf.get(cfg.ADVISORY_PARTITION_SIZE)
-            split = skew_split_specs(
+            # skew chunks slice the local catalog's block list; sizes
+            # and n_blocks are local-only stats, so with remote owner
+            # groups a split would drop (or double-read) remote blocks —
+            # fall back to plain coalescing there
+            from .registry import BlockLocationRegistry
+            remote = BlockLocationRegistry.get().remote_groups(sid)
+            split = None if remote else skew_split_specs(
                 sizes, n_blocks,
                 self.conf.get(cfg.SKEW_JOIN_FACTOR),
                 self.conf.get(cfg.SKEW_JOIN_THRESHOLD), target)

@@ -44,3 +44,39 @@ class TpuShuffleTruncatedFrameError(TpuShuffleFetchFailedError):
         super().__init__(
             f"truncated shuffle {what}: expected {expected} bytes, "
             f"got {got}")
+
+
+class TpuShuffleStaleFrameError(TpuShuffleFetchFailedError):
+    """A response frame carried a request id other than the in-flight
+    request's — a stale answer from a prior timed-out request on the
+    same connection.  Accepting it would hand the caller the WRONG
+    partition's bytes, so correlation mismatches fail typed and drop
+    the connection."""
+
+    def __init__(self, expected: int, got: int):
+        self.expected = expected
+        self.got = got
+        super().__init__(
+            f"stale shuffle frame: expected request id {expected}, "
+            f"got {got}")
+
+
+class TpuShuffleBlockMissingError(TpuShuffleFetchFailedError):
+    """The peer's catalog has no such block: the map output was never
+    registered there, or the shuffle was already released.  Retryable
+    against a replica; carries the block key for provenance."""
+
+    def __init__(self, detail: str = ""):
+        super().__init__(f"shuffle block missing on peer: {detail}"
+                         if detail else "shuffle block missing on peer")
+
+
+class TpuShuffleCorruptBlockError(TpuShuffleFetchFailedError):
+    """A fetched payload failed header validation or codec
+    decompression: the bytes arrived complete but do not decode.
+    Distinct from truncation (the connection stayed healthy) so the
+    retry policy can prefer a replica over the same corrupt source."""
+
+    def __init__(self, detail: str = ""):
+        super().__init__(f"corrupt shuffle block: {detail}"
+                         if detail else "corrupt shuffle block")

@@ -202,12 +202,15 @@ class ShuffleExchangeExec(Exec):
         # past an exchange there is no "current file" (Spark's
         # input_file_name() returns "" there; ref InputFileBlockRule.scala)
         set_current_input_file("")
-        mgr = TpuShuffleManager.get()
         xp = self.xp
         from ..obs import metrics as m
+        from .locality import read_reduce_blocks
         read_batches = m.counter("tpu_shuffle_read_batches_total",
                                  "reduce-side blocks read back")
-        for b in mgr.read_partition(self._shuffle_id, pid):
+        # locality-aware read: catalog blocks zero-copy, remote owner
+        # groups streamed through the async fetcher (registry-driven)
+        for b in read_reduce_blocks(self._shuffle_id, pid,
+                                    conf=ctx.conf, xp=xp):
             b = materialize_block(b, xp)
             self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
             self.metrics[NUM_OUTPUT_BATCHES] += 1

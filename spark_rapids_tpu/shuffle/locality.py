@@ -1,0 +1,171 @@
+"""Locality-aware reduce-side reads.
+
+Ref: RapidsCachingReader.scala — the reference's reader splits a reduce
+task's blocks into catalog-local ones (served zero-copy from the caching
+writer's device buffers) and remote ones (fetched through the UCX
+transport), then hands the iterator to the join.
+
+This module is the single read path ``exchange.py`` and the AQE readers
+call.  It consults the ``BlockLocationRegistry``:
+
+* blocks in the in-process catalog are yielded as-is (lazy spill
+  handles — zero-copy until the consumer materializes), counted in
+  ``tpu_shuffle_local_blocks_total`` — the proof they never crossed the
+  wire;
+* each *remote* owner group streams through ``AsyncBlockFetcher`` so
+  decompression (producer thread) overlaps the consumer's join compute,
+  with a bounded retry over the group's live replicas: an attempt that
+  dies mid-stream resumes from the next replica at the first block not
+  yet delivered (block order is the catalog's deterministic sort), so
+  every block is delivered exactly once or the stage fails typed with
+  provenance — never a hang, never a duplicate."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from .errors import TpuShufflePeerDeadError
+from .manager import TpuShuffleManager
+from .registry import BlockEndpoint, BlockLocationRegistry
+
+# one connection per peer endpoint, shared across reduce partitions
+# (ref RapidsShuffleTransport caching client connections per peer)
+_pool: Dict[Tuple[str, int], "object"] = {}
+_pool_lock = threading.Lock()
+
+
+def client_for(host: str, port: int, timeout: float = 30.0):
+    from .transport import ShuffleClient
+    key = (host, int(port))
+    with _pool_lock:
+        c = _pool.get(key)
+        if c is None:
+            c = ShuffleClient(host, int(port), timeout=timeout)
+            _pool[key] = c
+        return c
+
+
+def reset_pool() -> None:
+    with _pool_lock:
+        clients = list(_pool.values())
+        _pool.clear()
+    for c in clients:
+        try:
+            c.close()
+        except OSError:
+            pass
+
+
+def _read_conf(conf):
+    from .. import config as cfg
+    if conf is None:
+        dflt = cfg.RapidsConf({})
+        conf = dflt
+    return (conf.get(cfg.SHUFFLE_LOCALITY_ENABLED),
+            conf.get(cfg.SHUFFLE_FETCH_MAX_IN_FLIGHT),
+            conf.get(cfg.SHUFFLE_FETCH_TIMEOUT_MS) / 1000.0,
+            conf.get(cfg.SHUFFLE_FETCH_MAX_RETRIES))
+
+
+def read_reduce_blocks(shuffle_id: int, reduce_id: int, conf=None,
+                       xp=np) -> Iterator:
+    """Yield every block of one reduce partition: local catalog entries
+    first (lazy — the caller materializes), then each remote owner
+    group's batches streamed from a live replica."""
+    from ..obs import metrics as m
+    mgr = TpuShuffleManager.get()
+    reg = BlockLocationRegistry.get()
+    local_c = m.counter(
+        "tpu_shuffle_local_blocks_total",
+        "reduce-side blocks served zero-copy from the in-process "
+        "catalog — the locality split's proof they never crossed "
+        "the wire")
+    for block in mgr.catalog.blocks_for_reduce(shuffle_id, reduce_id):
+        for b in mgr.catalog.get(block):
+            local_c.inc()
+            yield b
+    locality_on, window, timeout, max_retries = _read_conf(conf)
+    if not locality_on:
+        return
+    remote = reg.remote_groups(shuffle_id)
+    if not remote:
+        return
+    for group in remote:
+        yield from _fetch_group(group, shuffle_id, reduce_id, reg, xp,
+                                window, timeout, max_retries, m)
+
+
+def _fetch_group(group, shuffle_id: int, reduce_id: int, reg, xp,
+                 window: int, timeout: float, max_retries: int, m
+                 ) -> Iterator:
+    """Stream one owner group's blocks, retrying across live replicas.
+
+    ``delivered`` counts blocks already handed to the consumer; a retry
+    resumes the replica's deterministic block order past that point, so
+    the group completes exactly once."""
+    from ..obs.tracer import trace_event
+    from .transport import AsyncBlockFetcher
+    retries_c = m.counter(
+        "tpu_shuffle_fetch_retries_total",
+        "remote fetch attempts re-driven against another live replica "
+        "after a typed failure")
+    delivered = 0
+    attempts = 0
+    tried = []
+    last_exc: Optional[BaseException] = None
+    while attempts <= max_retries:
+        live = reg.live_endpoints(group)
+        # rotate so a retry prefers a replica not just tried
+        if tried and len(live) > 1:
+            live = [e for e in live if e.executor_id != tried[-1]] + \
+                [e for e in live if e.executor_id == tried[-1]]
+        if not live:
+            break
+        ep = live[0]
+        attempts += 1
+        if attempts > 1:
+            retries_c.inc()
+        tried.append(ep.executor_id)
+        client = client_for(ep.host, ep.port, timeout)
+        fetcher = AsyncBlockFetcher(
+            client, shuffle_id, reduce_id, xp=xp, window=window,
+            timeout=timeout, heartbeat=reg.heartbeat,
+            peer_id=ep.executor_id)
+        already = delivered  # handed over by previous attempts
+        skipped = 0
+        fetched_here = 0
+        try:
+            for b in fetcher.blocks():
+                if skipped < already:
+                    skipped += 1
+                    continue
+                delivered += 1
+                fetched_here += 1
+                yield b
+            if fetched_here or delivered or attempts:
+                trace_event("shuffle.remote_fetch",
+                            shuffle_id=shuffle_id, reduce_id=reduce_id,
+                            peer=ep.executor_id, blocks=delivered,
+                            attempts=attempts)
+            return
+        except TpuShufflePeerDeadError as ex:
+            last_exc = ex
+        except Exception as ex:  # typed + counted by the fetcher
+            last_exc = ex
+    detail = (f"shuffle {shuffle_id} reduce {reduce_id}: owner group "
+              f"{[e.executor_id for e in group]} exhausted after "
+              f"{attempts} attempt(s) (tried {tried}, "
+              f"{delivered} block(s) delivered)")
+    if last_exc is not None:
+        last_exc.fetch_provenance = detail
+        raise last_exc
+    # no replica was even attemptable: every endpoint heartbeat-dead.
+    # Count it here — the fetcher's classifier never saw this failure
+    m.counter("tpu_shuffle_fetch_errors_total",
+              "async fetch failures by kind",
+              labelnames=("kind",)).labels(kind="peer_dead").inc()
+    raise TpuShufflePeerDeadError(
+        ",".join(e.executor_id for e in group), detail=detail)

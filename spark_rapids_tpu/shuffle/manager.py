@@ -126,9 +126,30 @@ class ShuffleBufferCatalog:
     def __init__(self):
         self._lock = threading.Lock()
         self._buffers: Dict[ShuffleBlockId, List] = {}
+        # per-shuffle schema fingerprint, recorded once at first add: all
+        # blocks of one shuffle share the child plan's schema, so the
+        # block server can answer metadata requests from these stats
+        # without materializing (let alone serializing) any payload
+        self._schema_fp: Dict[int, int] = {}
+
+    def _note_schema(self, shuffle_id: int, batch) -> None:
+        if shuffle_id in self._schema_fp:
+            return
+        names = getattr(batch, "names", None)
+        if names is None:
+            return
+        from ..memory.meta import schema_fingerprint
+        self._schema_fp[shuffle_id] = schema_fingerprint(
+            names, batch.dtypes)
+
+    def schema_fp(self, shuffle_id: int) -> int:
+        with self._lock:
+            return self._schema_fp.get(shuffle_id, 0)
 
     def add(self, block: ShuffleBlockId, batch) -> None:
         from ..memory.spill import SpillCatalog, SpillPriority
+        with self._lock:
+            self._note_schema(block[0], batch)
         if isinstance(batch, DeviceBatch):
             batch = SpillCatalog.get().register(batch,
                                                 SpillPriority.SHUFFLE)
@@ -145,6 +166,8 @@ class ShuffleBufferCatalog:
         layout = [t for t in layout if t[2] > 0]
         if not layout:
             return
+        with self._lock:
+            self._note_schema(shuffle_id, sorted_batch)
         sb = sorted_batch
         if isinstance(sb, DeviceBatch):
             sb = SpillCatalog.get().register(sb, SpillPriority.SHUFFLE)
@@ -175,6 +198,7 @@ class ShuffleBufferCatalog:
             doomed = []
             for k in [b for b in self._buffers if b[0] == shuffle_id]:
                 doomed.extend(self._buffers.pop(k))
+            self._schema_fp.pop(shuffle_id, None)
         for sb in doomed:
             close = getattr(sb, "close", None)
             if close is not None:
@@ -206,6 +230,11 @@ class TpuShuffleManager:
         self.catalog = ShuffleBufferCatalog()
         self._ids = itertools.count()
         self._written: Dict[Tuple[int, int], bool] = {}
+        # per-shuffle (raw, encoded) payload byte totals, fed by every
+        # transfer/spill serialization of this shuffle's blocks — the
+        # per-shuffle compression ratio for spans and SUITE_JSON
+        self._comp: Dict[int, List[int]] = {}
+        self._comp_lock = threading.Lock()
 
     @classmethod
     def get(cls) -> "TpuShuffleManager":
@@ -257,5 +286,36 @@ class TpuShuffleManager:
             for b in self.catalog.get(block):
                 yield b
 
+    # -- compression accounting ---------------------------------------------
+    def note_payload_sizes(self, shuffle_id: int, raw: int,
+                           encoded: int) -> None:
+        with self._comp_lock:
+            tot = self._comp.setdefault(shuffle_id, [0, 0])
+            tot[0] += int(raw)
+            tot[1] += int(encoded)
+
+    def compression_stats(self, shuffle_id: int) -> Optional[Dict]:
+        with self._comp_lock:
+            tot = self._comp.get(shuffle_id)
+            if tot is None or tot[0] <= 0:
+                return None
+            raw, enc = tot
+        return {"raw_bytes": raw, "compressed_bytes": enc,
+                "ratio": enc / raw}
+
     def unregister(self, shuffle_id: int):
+        # sink the shuffle's lifetime compression ratio into the flight
+        # recorder before the books close (metrics keep the codec-level
+        # totals; this is the per-shuffle view)
+        stats = self.compression_stats(shuffle_id)
+        if stats is not None:
+            from ..obs.tracer import trace_event
+            trace_event("shuffle.compression", shuffle_id=shuffle_id,
+                        raw_bytes=stats["raw_bytes"],
+                        compressed_bytes=stats["compressed_bytes"],
+                        ratio=stats["ratio"])
         self.catalog.remove_shuffle(shuffle_id)
+        with self._comp_lock:
+            self._comp.pop(shuffle_id, None)
+        from .registry import BlockLocationRegistry
+        BlockLocationRegistry.get().forget_shuffle(shuffle_id)

@@ -1,0 +1,139 @@
+"""Block location registry: which endpoint owns which shuffle blocks.
+
+Ref: RapidsShuffleHeartbeatManager's peer registry + the shuffle
+manager's block-to-executor mapping — the reference resolves a reduce
+task's block locations through Spark's MapOutputTracker and then fetches
+over UCX from the owning executor.
+
+Here map stages register their blocks' owning endpoint (executor id,
+host, block-server port) per shuffle; reduce-side reads consult the
+registry to split a partition's blocks into
+
+* local  — owned by THIS process: served zero-copy from the in-process
+  ``ShuffleBufferCatalog``, never crossing the wire;
+* remote — owned by a peer: streamed through ``AsyncBlockFetcher`` from
+  a live replica of the owning group.
+
+Endpoints register in *groups*: one ``register`` call names the replica
+set that can all serve the same block set (one entry in the common
+case).  Liveness rides the attached ``HeartbeatManager`` — the registry
+never invents its own failure detector."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+
+@dataclass(frozen=True)
+class BlockEndpoint:
+    """One block-server endpoint (executor identity + dial address)."""
+
+    executor_id: str
+    host: str
+    port: int
+
+
+class BlockLocationRegistry:
+    """Process-wide map: shuffle_id -> ordered owner groups.
+
+    Each owner group is a replica set (endpoints able to serve the SAME
+    blocks); distinct groups own DISJOINT block sets, so a reduce read
+    takes every group exactly once and retries only inside a group."""
+
+    _instance: Optional["BlockLocationRegistry"] = None
+    _class_lock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._owners: Dict[int, List[List[BlockEndpoint]]] = {}
+        self._local: Optional[BlockEndpoint] = None
+        self._heartbeat = None
+
+    @classmethod
+    def get(cls) -> "BlockLocationRegistry":
+        with cls._class_lock:
+            if cls._instance is None:
+                cls._instance = BlockLocationRegistry()
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._class_lock:
+            cls._instance = None
+
+    # -- wiring -------------------------------------------------------------
+    def set_local(self, executor_id: str, host: str = "127.0.0.1",
+                  port: int = 0) -> None:
+        """Identify THIS process's endpoint so reads can tell their own
+        registrations from remote ones."""
+        with self._lock:
+            self._local = BlockEndpoint(executor_id, host, port)
+
+    @property
+    def local(self) -> Optional[BlockEndpoint]:
+        with self._lock:
+            return self._local
+
+    def attach_heartbeat(self, heartbeat) -> None:
+        """Wire the HeartbeatManager whose expiry decides liveness."""
+        with self._lock:
+            self._heartbeat = heartbeat
+
+    @property
+    def heartbeat(self):
+        with self._lock:
+            return self._heartbeat
+
+    # -- registration -------------------------------------------------------
+    def register(self, shuffle_id: int,
+                 endpoints: Sequence[BlockEndpoint]) -> None:
+        """Record one owner group (a replica set) for ``shuffle_id``.
+        Map stages call this once per owning executor; re-registering an
+        identical group is a no-op so idempotent map-stage retries don't
+        duplicate fetches."""
+        group = list(endpoints)
+        if not group:
+            return
+        with self._lock:
+            groups = self._owners.setdefault(int(shuffle_id), [])
+            if group not in groups:
+                groups.append(group)
+
+    def forget_shuffle(self, shuffle_id: int) -> None:
+        with self._lock:
+            self._owners.pop(int(shuffle_id), None)
+
+    # -- lookup -------------------------------------------------------------
+    def owner_groups(self, shuffle_id: int) -> List[List[BlockEndpoint]]:
+        with self._lock:
+            return [list(g) for g in self._owners.get(int(shuffle_id), [])]
+
+    def is_local_group(self, group: Sequence[BlockEndpoint]) -> bool:
+        """A group containing this process's endpoint is served from the
+        in-process catalog — those blocks must never cross the wire."""
+        with self._lock:
+            local = self._local
+        if local is None:
+            return False
+        return any(e.executor_id == local.executor_id for e in group)
+
+    def remote_groups(self, shuffle_id: int) -> List[List[BlockEndpoint]]:
+        return [g for g in self.owner_groups(shuffle_id)
+                if not self.is_local_group(g)]
+
+    def live_endpoints(self, group: Sequence[BlockEndpoint]
+                       ) -> List[BlockEndpoint]:
+        """Replicas of ``group`` the heartbeat still considers alive
+        (all of them when no heartbeat is attached)."""
+        hb = self.heartbeat
+        if hb is None:
+            return list(group)
+        hb.expire_dead()
+        live = {p.executor_id for p in hb.live_peers()}
+        return [e for e in group if e.executor_id in live]
+
+    def num_shuffles(self) -> int:
+        with self._lock:
+            return len(self._owners)

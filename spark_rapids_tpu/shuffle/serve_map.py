@@ -1,0 +1,150 @@
+"""Runnable map-side block server: the OTHER process of a distributed
+shuffle.
+
+``python -m spark_rapids_tpu.shuffle.serve_map --rows N --parts P
+--codec lz4 --seed 7`` builds deterministic fact/dim tables, hash-
+partitions them with the engine's Spark-compatible murmur3 routing,
+registers every partition slice in this process's ShuffleBufferCatalog,
+and serves them from a ShuffleServer on an ephemeral port.
+
+Used by ``bench.py --dist`` and the cross-process shuffle test: the
+parent process plays the reduce side — it registers this process as the
+remote owner of both shuffles and fetches/joins over loopback.
+
+stdout protocol (one line each, flushed):
+
+    PORT <port>          after the server is up
+    STATS <json>         after the parent signals done (any stdin line
+                         or EOF): codec byte counters, served request
+                         counts, and the leak report
+
+The same table-building helpers are imported by the parent for its
+in-process reference run, so bit-exactness compares identical inputs."""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+FACT_SID = 1
+DIM_SID = 2
+_KEYS = 1000  # key cardinality: every dim key appears in the fact side
+
+
+def build_side_tables(rows: int, seed: int) -> Tuple[pa.RecordBatch,
+                                                     pa.RecordBatch]:
+    """Deterministic fact(k, v) + dim(k, d) record batches.  Sequential
+    v/d lanes keep the payload compressible (the bench measures codec
+    ratios on them); the key lane cycles so joins fan out evenly."""
+    rng = np.random.RandomState(seed)
+    k = (np.arange(rows, dtype=np.int64) * 2654435761 % _KEYS)
+    v = np.arange(rows, dtype=np.int64) + int(rng.randint(0, 1000))
+    fact = pa.record_batch({"k": pa.array(k), "v": pa.array(v)})
+    dk = np.arange(_KEYS, dtype=np.int64)
+    dd = dk * 3 + 1
+    dim = pa.record_batch({"k": pa.array(dk), "d": pa.array(dd)})
+    return fact, dim
+
+
+def partition_record_batch(rb: pa.RecordBatch, key: str, n_parts: int
+                           ) -> Dict[int, pa.RecordBatch]:
+    """Split rows by the engine's hash routing (pmod(murmur3(key), n)) —
+    the same partitioner the exchange uses, so both processes of the
+    distributed join route rows identically."""
+    from ..columnar.device import batch_to_device
+    from ..expr.core import AttributeReference, EvalContext
+    from ..shuffle.partitioning import HashPartitioning
+    from .. import types as t
+    part = HashPartitioning([AttributeReference(key)], n_parts).bind(
+        rb.schema.names, [t.LONG] * len(rb.schema.names))
+    b = batch_to_device(rb, xp=np)
+    pids = np.asarray(part.partition_ids(np, EvalContext(np, b), b))
+    pids = pids[:rb.num_rows]
+    out = {}
+    tbl = pa.table(rb)
+    for pid in range(n_parts):
+        idx = np.nonzero(pids == pid)[0]
+        if len(idx):
+            out[pid] = tbl.take(pa.array(idx)).combine_chunks().to_batches()[0]
+    return out
+
+
+def register_map_outputs(mgr, shuffle_id: int, rb: pa.RecordBatch,
+                         key: str, n_parts: int, n_maps: int = 2) -> None:
+    """Split the table into ``n_maps`` map tasks and register each map's
+    partition slices — several blocks per reduce partition, like a real
+    multi-batch map stage."""
+    from ..columnar.device import batch_to_device
+    rows = rb.num_rows
+    per = max(1, (rows + n_maps - 1) // n_maps)
+    for mid in range(n_maps):
+        piece = rb.slice(mid * per, per)
+        if piece.num_rows == 0:
+            continue
+        parts = partition_record_batch(piece, key, n_parts)
+        mgr.write_map_output(shuffle_id, mid, {
+            pid: batch_to_device(p, xp=np) for pid, p in parts.items()})
+
+
+def _arg(flag: str, default: str) -> str:
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a == flag and i + 1 < len(argv):
+            return argv[i + 1]
+        if a.startswith(flag + "="):
+            return a.split("=", 1)[1]
+    return default
+
+
+def main() -> int:
+    rows = int(_arg("--rows", "20000"))
+    parts = int(_arg("--parts", "4"))
+    codec = _arg("--codec", "none")
+    seed = int(_arg("--seed", "7"))
+    from ..memory.meta import set_default_codec
+    from ..memory.spill import SpillCatalog
+    from ..obs import metrics as m
+    from .manager import TpuShuffleManager
+    from .transport import ShuffleServer
+    set_default_codec(codec)
+    mgr = TpuShuffleManager.get()
+    fact, dim = build_side_tables(rows, seed)
+    register_map_outputs(mgr, FACT_SID, fact, "k", parts)
+    register_map_outputs(mgr, DIM_SID, dim, "k", parts)
+    server = ShuffleServer(mgr).start()
+    print(f"PORT {server.port}", flush=True)
+    sys.stdin.readline()  # parent signals done (or closes the pipe)
+    fact_comp = mgr.compression_stats(FACT_SID)
+    dim_comp = mgr.compression_stats(DIM_SID)
+    mgr.unregister(FACT_SID)
+    mgr.unregister(DIM_SID)
+    leaked = mgr.catalog.num_blocks()
+    leaks = SpillCatalog.get().leak_report()
+    raw_c = m.counter("tpu_shuffle_raw_bytes_total",
+                      labelnames=("codec",))
+    comp_c = m.counter("tpu_shuffle_compressed_bytes_total",
+                       labelnames=("codec",))
+    from .transport import _server_requests_counter
+    req_c = _server_requests_counter()
+    stats = {
+        "codec": codec,
+        "raw_bytes": raw_c.value(codec=codec),
+        "compressed_bytes": comp_c.value(codec=codec),
+        "server_metadata_requests": req_c.value(kind="metadata"),
+        "server_transfer_requests": req_c.value(kind="transfer"),
+        "leaked_blocks": leaked,
+        "leaks": len(leaks),
+        "fact_compression": fact_comp,
+        "dim_compression": dim_comp,
+    }
+    server.stop()
+    print("STATS " + json.dumps(stats), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

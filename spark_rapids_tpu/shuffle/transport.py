@@ -23,10 +23,12 @@ from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from ..memory.meta import TableMeta, deserialize_batch, serialize_batch
-from .errors import (TpuShuffleError, TpuShuffleFetchFailedError,
-                     TpuShufflePeerDeadError, TpuShuffleTimeoutError,
-                     TpuShuffleTruncatedFrameError)
+from ..memory.meta import (TableMeta, TpuCorruptPayloadError,
+                           deserialize_batch, serialize_batch_with_sizes)
+from .errors import (TpuShuffleBlockMissingError, TpuShuffleCorruptBlockError,
+                     TpuShuffleError, TpuShuffleFetchFailedError,
+                     TpuShufflePeerDeadError, TpuShuffleStaleFrameError,
+                     TpuShuffleTimeoutError, TpuShuffleTruncatedFrameError)
 from .manager import ShuffleBlockId, TpuShuffleManager, materialize_block
 
 # message types (ref RapidsShuffleTransport.scala:96-119)
@@ -36,8 +38,25 @@ MSG_TRANSFER_REQ = 3
 MSG_BUFFER = 4
 MSG_ERROR = 5
 
-_FRAME = struct.Struct("<BIq")  # type, request_id, body_len
+# request_id is a full u64: the client draws ids from range(1, 1<<62),
+# so a narrower wire field would alias distinct requests once the
+# counter passes its width (the 32-bit field wrapped after 4B requests
+# and broke response correlation)
+_FRAME = struct.Struct("<BQq")  # type, request_id, body_len
 CHUNK = 1 << 20  # windowed send size (bounce-buffer analog)
+
+# MSG_ERROR bodies are "code:detail"; codes map to the typed taxonomy
+# client-side so a peer's failure reason survives the wire
+ERR_BLOCK_MISSING = "block_missing"
+ERR_BAD_MESSAGE = "bad_message"
+
+
+def _server_requests_counter():
+    from ..obs import metrics as m
+    return m.counter("tpu_shuffle_server_requests_total",
+                     "block-server requests served, by kind — metadata "
+                     "answers come from catalog stats (O(1)), transfer "
+                     "answers stream payload bytes", ("kind",))
 
 
 class TransactionStatus:
@@ -108,7 +127,8 @@ class ShuffleServer:
                                                    body)
                         else:
                             _send_frame(self.request, MSG_ERROR, req_id,
-                                        b"bad message")
+                                        f"{ERR_BAD_MESSAGE}:unknown "
+                                        f"type {mtype}".encode())
                 except (ConnectionError, OSError):
                     return
                 finally:
@@ -145,27 +165,43 @@ class ShuffleServer:
                 pass
 
     def _handle_metadata(self, sock, req_id, body):
+        """Answer from catalog-tracked stats — O(blocks), NOT
+        O(partition bytes).  Serializing (and compressing) every batch
+        just to report row counts made a metadata request cost as much
+        as the transfer itself; the catalog records num_rows /
+        device_bytes / a per-shuffle schema fingerprint at registration,
+        so nothing materializes here."""
+        _server_requests_counter().labels(kind="metadata").inc()
         shuffle_id, reduce_id = struct.unpack("<qq", body)
-        blocks = self.manager.catalog.blocks_for_reduce(shuffle_id,
-                                                        reduce_id)
+        cat = self.manager.catalog
+        fp = cat.schema_fp(shuffle_id)
+        blocks = cat.blocks_for_reduce(shuffle_id, reduce_id)
         metas = []
         for blk in blocks:
-            for i, b in enumerate(self.manager.catalog.get(blk)):
-                b = _materialize(b)
-                payload = serialize_batch(b)
-                metas.append((blk, i, TableMeta.of(b, payload)))
+            for i, b in enumerate(cat.get(blk)):
+                nr = getattr(b, "num_rows", 0)
+                if not isinstance(nr, int):
+                    nr = int(np.asarray(nr))
+                nbytes = int(getattr(b, "device_bytes", 0) or 0)
+                metas.append((blk, i, TableMeta.of_stats(nr, nbytes, fp)))
         out = struct.pack("<i", len(metas))
         for (sid, mid, rid), i, meta in metas:
             out += struct.pack("<qqqq", sid, mid, rid, i) + meta.pack()
         _send_frame(sock, MSG_METADATA_RESP, req_id, out)
 
     def _handle_transfer(self, sock, req_id, body):
+        _server_requests_counter().labels(kind="transfer").inc()
         sid, mid, rid, idx = struct.unpack("<qqqq", body)
         batches = self.manager.catalog.get(ShuffleBlockId(sid, mid, rid))
         if idx >= len(batches):
-            _send_frame(sock, MSG_ERROR, req_id, b"no such block")
+            _send_frame(sock, MSG_ERROR, req_id,
+                        f"{ERR_BLOCK_MISSING}:({sid},{mid},{rid})[{idx}] "
+                        f"not in catalog".encode())
             return
-        payload = serialize_batch(_materialize(batches[idx]))
+        payload, raw_len, enc_len = serialize_batch_with_sizes(
+            _materialize(batches[idx]))
+        # per-shuffle compressed/raw totals: the span + SUITE_JSON ratio
+        self.manager.note_payload_sizes(sid, raw_len, enc_len)
         # windowed chunked send (bounce-buffer flow, BufferSendState analog)
         total = len(payload)
         _send_frame(sock, MSG_BUFFER, req_id,
@@ -203,7 +239,9 @@ class ShuffleClient:
                 _send_frame(sock, MSG_METADATA_REQ, tx.request_id,
                             struct.pack("<qq", shuffle_id, reduce_id))
                 mtype, rid, body = _recv_frame(sock)
+                _check_correlation(tx, rid)
             if mtype == MSG_ERROR:
+                _raise_peer_error(body)
                 tx.fail(body.decode())
                 return tx
             (n,) = struct.unpack_from("<i", body, 0)
@@ -236,7 +274,9 @@ class ShuffleClient:
                 _send_frame(sock, MSG_TRANSFER_REQ, tx.request_id,
                             struct.pack("<qqqq", sid, mid, rid, idx))
                 mtype, req, body = _recv_frame(sock)
+                _check_correlation(tx, req)
                 if mtype == MSG_ERROR:
+                    _raise_peer_error(body)
                     tx.fail(body.decode())
                     return tx
                 (total,) = struct.unpack("<q", body)
@@ -244,7 +284,12 @@ class ShuffleClient:
                 if payload is None or len(payload) < total:
                     raise TpuShuffleTruncatedFrameError(
                         total, len(payload or b""), what="block body")
-            tx.complete(deserialize_batch(payload, xp=xp))
+            try:
+                batch = deserialize_batch(payload, xp=xp)
+            except TpuCorruptPayloadError as ex:
+                raise TpuShuffleCorruptBlockError(
+                    f"({sid},{mid},{rid})[{idx}]: {ex}") from ex
+            tx.complete(batch)
         except TpuShuffleError as ex:
             self._drop_conn()
             tx.fail(str(ex), exc=ex)
@@ -376,6 +421,12 @@ class AsyncBlockFetcher:
             kind = "peer_dead"
         elif isinstance(ex, TpuShuffleTruncatedFrameError):
             kind = "truncated"
+        elif isinstance(ex, TpuShuffleStaleFrameError):
+            kind = "stale"
+        elif isinstance(ex, TpuShuffleCorruptBlockError):
+            kind = "corrupt"
+        elif isinstance(ex, TpuShuffleBlockMissingError):
+            kind = "block_missing"
         elif isinstance(ex, TpuShuffleTimeoutError):
             kind = "timeout"
         else:
@@ -398,8 +449,25 @@ def _materialize(b):
     return materialize_block(b, np)
 
 
+def _check_correlation(tx: Transaction, rid: int) -> None:
+    """A response must answer THIS request: a mismatched id is a stale
+    frame from a prior timed-out request still in the pipe — accepting
+    it would return the wrong partition's bytes.  Fails typed; the
+    caller drops the connection (its framing is now unknowable)."""
+    if rid != tx.request_id:
+        raise TpuShuffleStaleFrameError(tx.request_id, rid)
+
+
+def _raise_peer_error(body: bytes) -> None:
+    """Map a MSG_ERROR 'code:detail' body onto the typed taxonomy."""
+    text = body.decode(errors="replace")
+    code, _, detail = text.partition(":")
+    if code == ERR_BLOCK_MISSING:
+        raise TpuShuffleBlockMissingError(detail)
+
+
 def _send_frame(sock, mtype: int, req_id: int, body: bytes):
-    sock.sendall(_FRAME.pack(mtype, req_id & 0xFFFFFFFF, len(body)) + body)
+    sock.sendall(_FRAME.pack(mtype, req_id, len(body)) + body)
 
 
 def _recv_frame(sock) -> Tuple[int, int, bytes]:

@@ -1,0 +1,438 @@
+"""Remote-shuffle tests: wire-protocol correlation (64-bit request ids,
+stale-frame rejection), the O(blocks) metadata fast path, the
+locality-aware read split (local zero-copy vs remote fetch), bounded
+replica retry, and the cross-process end-to-end golden against
+``serve_map``."""
+
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _serve_blocks(n_maps=4, rows=64, shuffle_id=11, reduce_id=2):
+    from spark_rapids_tpu.columnar.device import batch_to_device
+    from spark_rapids_tpu.shuffle.transport import ShuffleServer
+    TpuShuffleManager.reset()
+    mgr = TpuShuffleManager.get()
+    for mid in range(n_maps):
+        rb = pa.record_batch({"a": pa.array(
+            [mid * 1000 + i for i in range(rows)], type=pa.int64())})
+        mgr.write_map_output(shuffle_id, mid,
+                             {reduce_id: batch_to_device(rb, xp=np)})
+    return mgr, ShuffleServer(mgr).start()
+
+
+def _rogue_server(script):
+    """One-connection server driving ``script(conn)`` — the injected
+    wire-fault side of a scenario."""
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+
+    def run():
+        conn, _ = lsock.accept()
+        try:
+            script(conn)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            lsock.close()
+
+    threading.Thread(target=run, daemon=True).start()
+    return port
+
+
+# -- wire protocol ----------------------------------------------------------
+
+
+def test_request_ids_survive_past_32_bits():
+    """Regression: the frame header carried req ids in a 32-bit field
+    while the client draws from range(1, 1<<62) — ids past 4B aliased
+    and correlated responses to the wrong request.  The field is u64
+    now; a request id above 2^32 must round-trip verbatim."""
+    from spark_rapids_tpu.columnar.device import batch_to_arrow
+    from spark_rapids_tpu.shuffle.transport import ShuffleClient
+    mgr, server = _serve_blocks(n_maps=1)
+    try:
+        cli = ShuffleClient("127.0.0.1", server.port)
+        big = (1 << 40) + 17
+        cli._req_ids = iter(range(big, 1 << 62))
+        metas = cli.fetch_metadata(11, 2).wait(10.0)
+        assert len(metas) == 1
+        (sid, mid, rid, idx), meta = metas[0]
+        assert meta.num_rows == 64
+        b = cli.fetch_block(sid, mid, rid, idx).wait(10.0)
+        assert batch_to_arrow(b).column("a").to_pylist()[0] == 0
+        cli.close()
+    finally:
+        server.stop()
+        TpuShuffleManager.reset()
+
+
+def test_frame_header_is_64_bit():
+    from spark_rapids_tpu.shuffle.transport import _FRAME
+    mtype, rid, blen = _FRAME.unpack(_FRAME.pack(2, (1 << 40) + 17, 5))
+    assert rid == (1 << 40) + 17
+
+
+def test_stale_frame_rejected_typed():
+    """A response whose request id does not match the in-flight request
+    is a stale frame from a timed-out predecessor — accepting it would
+    hand back the wrong partition's bytes.  Must fail typed, and the
+    fetcher must count kind=stale."""
+    import spark_rapids_tpu.obs.metrics as m
+    from spark_rapids_tpu.shuffle.errors import TpuShuffleStaleFrameError
+    from spark_rapids_tpu.shuffle.transport import (_FRAME, _recv_exact,
+                                                    MSG_METADATA_RESP,
+                                                    AsyncBlockFetcher,
+                                                    ShuffleClient)
+
+    def liar(conn):
+        head = _recv_exact(conn, _FRAME.size)
+        _, rid, blen = _FRAME.unpack(head)
+        if blen:
+            _recv_exact(conn, blen)
+        conn.sendall(_FRAME.pack(MSG_METADATA_RESP, rid + 1234, 0))
+
+    m.MetricsRegistry.reset_for_tests()
+    try:
+        cli = ShuffleClient("127.0.0.1", _rogue_server(liar),
+                            timeout=10.0)
+        with pytest.raises(TpuShuffleStaleFrameError) as ei:
+            list(AsyncBlockFetcher(cli, 11, 2, window=2, timeout=10.0))
+        assert ei.value.got == ei.value.expected + 1234
+        cli.close()
+        errs = m.counter("tpu_shuffle_fetch_errors_total",
+                         labelnames=("kind",))
+        assert errs.value(kind="stale") == 1
+    finally:
+        m.MetricsRegistry.reset_for_tests()
+
+
+def test_block_missing_surfaces_typed_from_peer():
+    """A transfer request for a block the peer's catalog does not hold
+    must come back as the typed missing-block error, not a generic
+    failure string."""
+    from spark_rapids_tpu.shuffle.errors import TpuShuffleBlockMissingError
+    from spark_rapids_tpu.shuffle.transport import ShuffleClient
+    mgr, server = _serve_blocks(n_maps=1)
+    try:
+        cli = ShuffleClient("127.0.0.1", server.port)
+        with pytest.raises(TpuShuffleBlockMissingError):
+            cli.fetch_block(11, 0, 2, 99).wait(10.0)
+        cli.close()
+    finally:
+        server.stop()
+        TpuShuffleManager.reset()
+
+
+# -- metadata fast path -----------------------------------------------------
+
+
+def test_metadata_answers_without_serializing_payloads(monkeypatch):
+    """The block server's metadata path must derive row counts from
+    catalog stats — O(blocks) — never by materializing and serializing
+    partitions.  Poisoning the serializer proves no payload is touched,
+    and the per-kind server counters must split metadata from
+    transfer."""
+    import spark_rapids_tpu.obs.metrics as m
+    from spark_rapids_tpu.shuffle import transport
+    from spark_rapids_tpu.shuffle.transport import (ShuffleClient,
+                                                    _server_requests_counter)
+
+    def boom(*a, **k):
+        raise AssertionError("metadata request serialized a payload")
+
+    m.MetricsRegistry.reset_for_tests()
+    mgr, server = _serve_blocks(n_maps=3, rows=50)
+    monkeypatch.setattr(transport, "serialize_batch_with_sizes", boom)
+    try:
+        cli = ShuffleClient("127.0.0.1", server.port)
+        metas = cli.fetch_metadata(11, 2).wait(10.0)
+        assert [meta.num_rows for _, meta in metas] == [50, 50, 50]
+        assert all(meta.num_bytes > 0 for _, meta in metas)
+        # all blocks share one schema -> one fingerprint, and it matches
+        # what the catalog recorded at registration
+        fps = {meta.schema_fingerprint for _, meta in metas}
+        assert fps == {mgr.catalog.schema_fp(11)} and fps != {0}
+        cli.close()
+        req_c = _server_requests_counter()
+        assert req_c.value(kind="metadata") == 1
+        assert req_c.value(kind="transfer") == 0
+    finally:
+        server.stop()
+        TpuShuffleManager.reset()
+        m.MetricsRegistry.reset_for_tests()
+
+
+# -- locality split ---------------------------------------------------------
+
+
+def _fresh_registry(local_id="test-local", port=0):
+    from spark_rapids_tpu.shuffle.registry import BlockLocationRegistry
+    BlockLocationRegistry.reset()
+    reg = BlockLocationRegistry.get()
+    reg.set_local(local_id, "127.0.0.1", port)
+    return reg
+
+
+def test_local_blocks_never_cross_the_wire():
+    """A shuffle whose owner group is this process reads straight from
+    the catalog: the local-blocks counter moves, the server's transfer
+    counter must not."""
+    import spark_rapids_tpu.obs.metrics as m
+    from spark_rapids_tpu.shuffle import locality
+    from spark_rapids_tpu.shuffle.registry import BlockEndpoint
+    from spark_rapids_tpu.shuffle.transport import _server_requests_counter
+    m.MetricsRegistry.reset_for_tests()
+    mgr, server = _serve_blocks(n_maps=3)
+    reg = _fresh_registry(port=server.port)
+    reg.register(11, [BlockEndpoint("test-local", "127.0.0.1",
+                                    server.port)])
+    try:
+        blocks = list(locality.read_reduce_blocks(11, 2))
+        assert len(blocks) == 3
+        assert m.counter("tpu_shuffle_local_blocks_total").value() == 3
+        assert _server_requests_counter().value(kind="transfer") == 0
+        assert m.counter("tpu_shuffle_fetch_blocks_total").value() == 0
+    finally:
+        server.stop()
+        TpuShuffleManager.reset()
+        from spark_rapids_tpu.shuffle.registry import BlockLocationRegistry
+        BlockLocationRegistry.reset()
+        m.MetricsRegistry.reset_for_tests()
+
+
+def test_locality_disabled_skips_remote_groups():
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.shuffle import locality
+    from spark_rapids_tpu.shuffle.registry import (BlockEndpoint,
+                                                   BlockLocationRegistry)
+    TpuShuffleManager.reset()
+    reg = _fresh_registry()
+    reg.register(77, [BlockEndpoint("far-away", "127.0.0.1", 1)])
+    conf = cfg.RapidsConf(
+        {cfg.SHUFFLE_LOCALITY_ENABLED.key: "false"})
+    try:
+        assert list(locality.read_reduce_blocks(77, 0, conf=conf)) == []
+    finally:
+        BlockLocationRegistry.reset()
+        TpuShuffleManager.reset()
+
+
+def test_replica_retry_completes_exactly_once():
+    """First replica refuses the dial; the fetch must fail over to the
+    live replica, deliver every block exactly once, and count exactly
+    one retry."""
+    import spark_rapids_tpu.obs.metrics as m
+    from spark_rapids_tpu.columnar.device import batch_to_arrow
+    from spark_rapids_tpu.shuffle import locality
+    from spark_rapids_tpu.shuffle.registry import (BlockEndpoint,
+                                                   BlockLocationRegistry)
+    m.MetricsRegistry.reset_for_tests()
+    mgr, server = _serve_blocks(n_maps=4)
+    reg = _fresh_registry()
+    dead_sock = socket.socket()
+    dead_sock.bind(("127.0.0.1", 0))
+    dead_port = dead_sock.getsockname()[1]
+    dead_sock.close()
+    group = [BlockEndpoint("replica-dead", "127.0.0.1", dead_port),
+             BlockEndpoint("replica-live", "127.0.0.1", server.port)]
+    locality.reset_pool()
+    try:
+        got = [batch_to_arrow(b).column("a").to_pylist()[0]
+               for b in locality._fetch_group(group, 11, 2, reg, np,
+                                              2, 5.0, 2, m)]
+        assert got == [0, 1000, 2000, 3000]
+        assert m.counter("tpu_shuffle_fetch_retries_total").value() == 1
+    finally:
+        server.stop()
+        locality.reset_pool()
+        TpuShuffleManager.reset()
+        BlockLocationRegistry.reset()
+        m.MetricsRegistry.reset_for_tests()
+
+
+def test_exhausted_group_fails_with_provenance():
+    """When every replica fails, the error must carry fetch provenance
+    (group, attempts, blocks delivered) — never hang, never raise
+    untyped."""
+    import spark_rapids_tpu.obs.metrics as m
+    from spark_rapids_tpu.shuffle import locality
+    from spark_rapids_tpu.shuffle.errors import TpuShuffleError
+    from spark_rapids_tpu.shuffle.registry import (BlockEndpoint,
+                                                   BlockLocationRegistry)
+    m.MetricsRegistry.reset_for_tests()
+    TpuShuffleManager.reset()
+    reg = _fresh_registry()
+    dead_sock = socket.socket()
+    dead_sock.bind(("127.0.0.1", 0))
+    dead_port = dead_sock.getsockname()[1]
+    dead_sock.close()
+    group = [BlockEndpoint("gone", "127.0.0.1", dead_port)]
+    locality.reset_pool()
+    try:
+        with pytest.raises(TpuShuffleError) as ei:
+            list(locality._fetch_group(group, 11, 2, reg, np,
+                                       2, 2.0, 1, m))
+        prov = getattr(ei.value, "fetch_provenance", "")
+        assert "gone" in prov and "attempt" in prov
+    finally:
+        locality.reset_pool()
+        BlockLocationRegistry.reset()
+        m.MetricsRegistry.reset_for_tests()
+
+
+# -- cross-process end to end ----------------------------------------------
+
+
+def test_cross_process_fetch_join_bit_exact():
+    """Full remote path: a child OS process owns both sides' map
+    outputs (lz4-compressed) and serves them over loopback; this
+    process fetches through the locality reader and joins.  The result
+    must be bit-exact vs the in-process reference, with zero local-path
+    reads, zero leaked blocks on the serving side, and the compression
+    ratio visible in the child's shuffle byte counters."""
+    import spark_rapids_tpu.obs.metrics as m
+    from spark_rapids_tpu.columnar.device import batch_to_arrow
+    from spark_rapids_tpu.shuffle import locality
+    from spark_rapids_tpu.shuffle.registry import (BlockEndpoint,
+                                                   BlockLocationRegistry)
+    from spark_rapids_tpu.shuffle.serve_map import (
+        DIM_SID, FACT_SID, build_side_tables, partition_record_batch)
+    rows, parts, seed = 6000, 3, 11
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SPARK_RAPIDS_TPU_DISABLE_COMPILE_CACHE="1")
+    child = subprocess.Popen(
+        [sys.executable, "-m", "spark_rapids_tpu.shuffle.serve_map",
+         "--rows", str(rows), "--parts", str(parts),
+         "--codec", "lz4", "--seed", str(seed)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, env=env, cwd=REPO)
+    m.MetricsRegistry.reset_for_tests()
+    TpuShuffleManager.reset()
+    reg = _fresh_registry("reduce-side")
+    try:
+        line = child.stdout.readline()
+        assert line.startswith("PORT "), line
+        port = int(line.split()[1])
+        ep = BlockEndpoint("map-side", "127.0.0.1", port)
+        reg.register(FACT_SID, [ep])
+        reg.register(DIM_SID, [ep])
+        out = []
+        for pid in range(parts):
+            sides = []
+            for sid in (FACT_SID, DIM_SID):
+                rbs = [batch_to_arrow(b) for b in
+                       locality.read_reduce_blocks(sid, pid)]
+                sides.append(pa.Table.from_batches(rbs) if rbs else None)
+            if sides[0] is not None and sides[1] is not None:
+                out.append(sides[0].join(sides[1], "k"))
+        got = pa.concat_tables(out).sort_by(
+            [("k", "ascending"), ("v", "ascending")])
+        fact, dim = build_side_tables(rows, seed)
+        fparts = partition_record_batch(fact, "k", parts)
+        dparts = partition_record_batch(dim, "k", parts)
+        ref = [pa.table(fparts[p]).join(pa.table(dparts[p]), "k")
+               for p in range(parts) if p in fparts and p in dparts]
+        ref_t = pa.concat_tables(ref).sort_by(
+            [("k", "ascending"), ("v", "ascending")])
+        assert got.equals(ref_t)
+        assert got.num_rows == rows
+        # every block was remote: zero local reads, zero fetch errors
+        assert m.counter("tpu_shuffle_local_blocks_total").value() == 0
+        errs = m.counter("tpu_shuffle_fetch_errors_total",
+                         labelnames=("kind",))
+        assert sum(ch.value for _, ch in errs.series()) == 0
+        child.stdin.write("done\n")
+        child.stdin.flush()
+        stats = json.loads(
+            child.stdout.readline()[len("STATS "):])
+        assert stats["leaked_blocks"] == 0
+        assert stats["leaks"] == 0
+        assert stats["raw_bytes"] > 0
+        assert stats["compressed_bytes"] / stats["raw_bytes"] < 0.9
+        assert stats["server_transfer_requests"] > 0
+        assert child.wait(timeout=30) == 0
+    finally:
+        child.stdin.close()
+        child.stdout.close()
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+        locality.reset_pool()
+        BlockLocationRegistry.reset()
+        TpuShuffleManager.reset()
+        m.MetricsRegistry.reset_for_tests()
+
+
+# -- compression accounting -------------------------------------------------
+
+
+def test_manager_tracks_per_shuffle_compression_ratio():
+    from spark_rapids_tpu.columnar.device import batch_to_device
+    TpuShuffleManager.reset()
+    mgr = TpuShuffleManager.get()
+    rb = pa.record_batch({"a": pa.array(np.arange(256, dtype=np.int64))})
+    mgr.write_map_output(55, 0, {0: batch_to_device(rb, xp=np)})
+    try:
+        assert mgr.compression_stats(55) is None  # nothing served yet
+        mgr.note_payload_sizes(55, 1000, 400)
+        mgr.note_payload_sizes(55, 1000, 600)
+        st = mgr.compression_stats(55)
+        assert st == {"raw_bytes": 2000, "compressed_bytes": 1000,
+                      "ratio": 0.5}
+        mgr.unregister(55)
+        assert mgr.compression_stats(55) is None  # dropped with shuffle
+    finally:
+        TpuShuffleManager.reset()
+
+
+def test_spill_tiers_record_raw_vs_serialized_bytes(tmp_path):
+    """spill_to_disk must account compressed-vs-raw per tier so the
+    codec's effect on the spill path is observable, not inferred."""
+    import spark_rapids_tpu.obs.metrics as m
+    from spark_rapids_tpu.columnar.device import batch_to_device
+    from spark_rapids_tpu.memory import meta
+    from spark_rapids_tpu.memory.spill import SpillCatalog
+    m.MetricsRegistry.reset_for_tests()
+    meta.set_default_codec("lz4")
+    try:
+        cat = SpillCatalog(device_budget=1 << 30, host_budget=1 << 30,
+                           spill_dir=str(tmp_path))
+        rb = pa.record_batch(
+            {"a": pa.array(np.arange(4096, dtype=np.int64))})
+        sb = cat.register(batch_to_device(rb, xp=np))
+        sb.spill_to_host()
+        sb.spill_to_disk()
+        raw_c = m.counter("tpu_spill_raw_bytes_total",
+                          labelnames=("tier",))
+        ser_c = m.counter("tpu_spill_serialized_bytes_total",
+                          labelnames=("tier",))
+        for tier in ("host", "disk"):
+            assert raw_c.value(tier=tier) > 0
+            assert ser_c.value(tier=tier) > 0
+            # lz4 on sequential int64 lanes: serialized < raw
+            assert ser_c.value(tier=tier) < raw_c.value(tier=tier)
+        sb.close()
+    finally:
+        meta.set_default_codec("none")
+        m.MetricsRegistry.reset_for_tests()
