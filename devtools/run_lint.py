@@ -64,6 +64,16 @@ a silent slice-view write (zero saved bytes), or a transport leg whose
 fetched-block/byte counters disagree with what the server actually
 registered.
 
+--csan runs the concurrency-sanitizer gate: the tpucsan repo pass
+(TPU-R008 lock-order cycles, TPU-R009 unguarded multi-root shared
+writes, TPU-R010 condvar misuse) must be clean modulo the baseline,
+the ABBA/shared-write/condvar fixtures must each trip their rule
+(anti-vacuity), the static lock-order artifact must be non-trivial
+with every declared thread root matched, and the serve golden mix
+replays under the runtime lock witness (obs/lockwitness.py) — the
+gate fails on any acquisition edge the static graph cannot explain
+(unmodeled edge) or any observed lock-order cycle.
+
 --feedback runs the estimator-observatory gate: the golden corpus
 replays cold (fresh estimator ledger, static cost model) then warm
 (feedback-directed planning over the cold arm's ledger) in fresh
@@ -85,6 +95,7 @@ bit-exact against the CPU-engine ground truth.
     python devtools/run_lint.py --metrics          # metrics/health gate
     python devtools/run_lint.py --jit              # compile-observatory gate
     python devtools/run_lint.py --shuffle          # distributed-shuffle gate
+    python devtools/run_lint.py --csan             # concurrency-sanitizer gate
     python devtools/run_lint.py --feedback         # estimator-observatory gate
 """
 
@@ -1389,6 +1400,250 @@ def run_serve_gate() -> int:
     return 0
 
 
+# anti-vacuity fixtures for the csan gate: each must trip exactly its
+# rule.  Self-contained modules the analyzer resolves without the repo.
+_CSAN_ABBA_SRC = '''
+import threading
+
+class Pair:
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+
+    def forward(self):
+        with self._la:
+            self.inner_b()
+
+    def backward(self):
+        with self._lb:
+            self.inner_a()
+
+    def inner_a(self):
+        with self._la:
+            pass
+
+    def inner_b(self):
+        with self._lb:
+            pass
+'''
+
+_CSAN_R009_SRC = '''
+import threading
+
+class Stats:
+    _instance = None
+    _ilock = threading.Lock()
+
+    def __init__(self):
+        self.tally = 0
+
+    @classmethod
+    def get(cls):
+        with cls._ilock:
+            if cls._instance is None:
+                cls._instance = Stats()
+            return cls._instance
+
+    def bump(self):
+        self.tally += 1
+
+def root_a():
+    Stats.get().bump()
+
+def root_b():
+    Stats.get().bump()
+'''
+
+_CSAN_R010_SRC = '''
+import threading
+
+_cv = threading.Condition()
+_items = []
+
+def bad_wait():
+    with _cv:
+        if not _items:
+            _cv.wait()
+        return _items.pop()
+'''
+
+
+def run_csan_gate() -> int:
+    """tpucsan gate, four legs: (1) the repo pass is clean against the
+    baseline; (2) the ABBA / shared-write / condvar fixtures each trip
+    their rule (anti-vacuity); (3) the static lock-order artifact is
+    non-trivial (the serving locks and their metrics edges exist); (4)
+    the serve golden mix replays under the runtime lock witness and
+    execution must observe zero acquisition edges the static graph
+    cannot explain and zero lock-order cycles, with the contention
+    metrics registered."""
+    import concurrent.futures as cf
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.analysis import concurrency as cc
+    from spark_rapids_tpu.analysis.repo_lint import load_baseline
+
+    failures = 0
+
+    # -- leg 1: repo pass clean modulo baseline -----------------------------
+    diags = cc.repo_diagnostics()
+    baseline = load_baseline(BASELINE)
+    fresh = [d for d in diags if d.fingerprint() not in baseline]
+    for d in fresh:
+        failures += 1
+        print(f"CSAN: new finding: {d.render()}")
+
+    # -- leg 2: anti-vacuity fixtures ---------------------------------------
+    fixtures = (("TPU-R008", {"spark_rapids_tpu/pairmod.py":
+                              _CSAN_ABBA_SRC}, None),
+                ("TPU-R009", {"spark_rapids_tpu/statsmod.py":
+                              _CSAN_R009_SRC},
+                 ["statsmod.root_a", "statsmod.root_b"]),
+                ("TPU-R010", {"spark_rapids_tpu/cvmod.py":
+                              _CSAN_R010_SRC}, None))
+    for code, sources, roots in fixtures:
+        got = {d.code for d in
+               cc.analyze_sources(sources, roots=roots).diagnostics}
+        if code not in got:
+            failures += 1
+            print(f"CSAN: {code} fixture did not trip (got "
+                  f"{sorted(got) or 'nothing'}) — the rule is vacuous")
+
+    # -- leg 3: the artifact the witness consumes is non-trivial ------------
+    art = cc.lock_order_artifact()
+    if len(art["locks"]) < 20 or len(art["edges"]) < 10:
+        failures += 1
+        print(f"CSAN: implausibly small lock graph "
+              f"({len(art['locks'])} locks, {len(art['edges'])} edges) "
+              f"— extraction regressed")
+    if len(art["roots"]) < len(cc.THREAD_ROOTS):
+        failures += 1
+        print(f"CSAN: only {len(art['roots'])} of "
+              f"{len(cc.THREAD_ROOTS)} declared thread roots matched "
+              f"a function — the root table is stale")
+    if art["cycles"]:
+        failures += 1
+        print(f"CSAN: static lock-order cycle(s): {art['cycles']}")
+
+    # -- leg 4: serve corpus under the runtime lock witness -----------------
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.pool import SessionPool
+    from spark_rapids_tpu.expr.window import WindowBuilder
+    from spark_rapids_tpu.memory.admission import AdmissionController
+    from spark_rapids_tpu.memory.spill import SpillCatalog
+    from spark_rapids_tpu.obs import lockwitness
+    from spark_rapids_tpu.obs.metrics import MetricsRegistry
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+
+    MetricsRegistry.reset_for_tests()
+    with SpillCatalog._lock:
+        SpillCatalog._instance = SpillCatalog()
+    TpuShuffleManager.reset()
+    AdmissionController.reset_for_tests()
+    lockwitness.reset_for_tests()
+
+    n = 4000
+    rng = np.random.default_rng(7)
+    fact = pa.table({
+        "k": pa.array(rng.integers(0, 97, n).astype(np.int64)),
+        "v": pa.array(rng.integers(-1000, 1000, n).astype(np.int64)),
+    })
+    dim = pa.table({
+        "k": pa.array(np.arange(97, dtype=np.int64)),
+        "w": pa.array(np.arange(97, dtype=np.int64) * 10),
+    })
+    try:
+        witness = lockwitness.install(art)
+        # the singletons whose instance locks the serve path takes must
+        # exist before refresh() so they get wrapped
+        TpuShuffleManager.get()
+        SpillCatalog.get()
+        pool = SessionPool(4, {
+            "spark.rapids.sql.enabled": "true",
+            "spark.rapids.tpu.csan.enabled": "true",
+            "spark.rapids.tpu.singleChipFuse": "off",
+            "spark.rapids.tpu.serve.hbmAdmissionBudgetBytes":
+                str(256 << 20),
+            "spark.rapids.tpu.serve.admissionTimeoutMs": "60000",
+        })
+        witness.refresh()
+
+        def mk_mix(s):
+            fdf = s.create_dataframe(fact)
+            fdf4 = s.create_dataframe(fact, num_partitions=4)
+            ddf2 = s.create_dataframe(dim, num_partitions=2)
+            w = WindowBuilder().partition_by(col("k")).order_by(col("v"))
+            return {
+                "agg": lambda: (fdf.group_by(col("k"))
+                                .agg(F.sum(col("v")).alias("sv"),
+                                     F.count("*").alias("c"))
+                                .collect()),
+                "join": lambda: (fdf4.join(ddf2, on="k", how="inner")
+                                 .group_by(col("k"))
+                                 .agg(F.sum(col("w")).alias("sw"))
+                                 .collect()),
+                "window": lambda: (fdf.select(
+                    col("k"), col("v"),
+                    F.row_number().over(w).alias("rn")).collect()),
+                "sort": lambda: fdf.sort(col("k"), col("v")).collect(),
+            }
+
+        mixes = {id(s): mk_mix(s) for s in pool._sessions}
+        worklist = [name for name in sorted(mixes[id(
+            pool._sessions[0])]) for _ in range(4)]
+
+        def one(name):
+            with pool.session() as s:
+                return mixes[id(s)][name]()
+
+        with cf.ThreadPoolExecutor(max_workers=4) as ex:
+            list(ex.map(one, worklist))
+        pool.drain(timeout=60)
+        pool.close()
+
+        rep = witness.report()
+        if rep["n_wrapped"] < 8:
+            failures += 1
+            print(f"CSAN: witness wrapped only {rep['n_wrapped']} "
+                  f"lock(s) — registration regressed")
+        if not rep["edges"]:
+            failures += 1
+            print("CSAN: vacuous witness run — no nested acquisition "
+                  "was ever observed")
+        for a, b in rep["unmodeled"]:
+            failures += 1
+            print(f"CSAN: UNMODELED runtime edge {a} -> {b}: the "
+                  f"static graph cannot explain this nesting")
+        for cyc in rep["cycles"]:
+            failures += 1
+            print(f"CSAN: runtime lock-order cycle observed: {cyc}")
+        fams = {f.name for f in MetricsRegistry.get().families()}
+        for fam in ("tpu_lock_contention_total", "tpu_lock_wait_seconds"):
+            if fam not in fams:
+                failures += 1
+                print(f"CSAN: contention metric family {fam} missing")
+    finally:
+        lockwitness.reset_for_tests()
+        MetricsRegistry.reset_for_tests()
+        AdmissionController.reset_for_tests()
+        TpuShuffleManager.reset()
+
+    if failures:
+        print(f"csan gate: {failures} failure(s)")
+        return 1
+    print(f"csan gate clean (repo pass clean modulo baseline; R008/"
+          f"R009/R010 fixtures all trip; static graph: "
+          f"{len(art['locks'])} locks, {len(art['edges'])} edges, "
+          f"{len(art['roots'])} thread roots, no cycles; witness "
+          f"replay: {rep['n_wrapped']} locks wrapped, "
+          f"{len(rep['edges'])} observed edges all modeled, zero "
+          f"runtime cycles)")
+    return 0
+
+
 # the feedback gate's corpus: the regress corpus queries, run traced
 # against an estimator ledger dir.  "cold" records the static model's
 # errors; "warm" loads the cold arm's ledger and blends its recorded
@@ -1662,6 +1917,8 @@ def main(argv=None):
         return run_shuffle_gate()
     if "--serve" in args:
         return run_serve_gate()
+    if "--csan" in args:
+        return run_csan_gate()
     if "--feedback" in args:
         return run_feedback_gate()
     from spark_rapids_tpu.tools.__main__ import main as tools_main

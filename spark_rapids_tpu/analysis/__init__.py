@@ -16,6 +16,11 @@ Two front ends share one rule/diagnostic framework (diagnostics.py):
     enforcing codebase invariants as TPU-Rxxx diagnostics, with a
     checked-in baseline for pre-existing violations
     (devtools/lint_baseline.txt, devtools/run_lint.py).
+  * tpucsan (concurrency.py)  — inter-procedural lock-order and
+    shared-state concurrency sanitizer (TPU-R008/R009/R010); its
+    static edge relation is the artifact the runtime lock witness
+    (obs/lockwitness.py, spark.rapids.tpu.csan.enabled) validates
+    against actual per-thread acquisition chains.
 
 Both are driven by the machine-readable kernel capability table in
 capabilities.py, which mirrors the actual dtype branch structure of the
@@ -30,9 +35,13 @@ from .diagnostics import (ERROR, INFO, WARN, Diagnostic, Rule, RULE_CATALOG,
                           format_diagnostics, register_rule)
 from .plan_lint import downgrade_hazards, lint_plan, lint_spark_plan
 from .repo_lint import lint_repo, load_baseline
+from .concurrency import (THREAD_ROOTS, analyze_repo, analyze_sources,
+                          lock_order_artifact)
 
 __all__ = [
     "Diagnostic", "Rule", "RULE_CATALOG", "ERROR", "WARN", "INFO",
     "format_diagnostics", "register_rule", "lint_plan", "lint_spark_plan",
     "downgrade_hazards", "lint_repo", "load_baseline",
+    "THREAD_ROOTS", "analyze_repo", "analyze_sources",
+    "lock_order_artifact",
 ]

@@ -1,0 +1,1265 @@
+"""tpucsan: lock-order & shared-state concurrency sanitizer.
+
+The serving-era engine (PRs 8-12) holds 40+ ``threading.Lock`` /
+``RLock`` / ``Condition`` instances across shuffle/, api/, memory/,
+obs/ and native/, and nothing reasoned about how they compose — PR 9
+already fixed a check-then-acquire race in the seed's TpuSemaphore by
+hand.  This pass is the static half of the thread analogue of tmsan:
+
+  TPU-R008  lock-order cycle: two code paths acquire the same pair of
+            locks in opposite orders (potential ABBA deadlock).
+  TPU-R009  shared mutable state (module global, class attribute, or
+            instance attribute of a process-shared class) written from
+            >= 2 declared thread roots with NO lock common to every
+            write site.
+  TPU-R010  condition-variable / raw-lock misuse: ``wait()`` outside a
+            predicate re-check loop, ``notify()`` without the condition
+            held, or an explicit ``acquire()`` with no ``finally``-path
+            ``release()`` in the same function.
+
+Pipeline (pure AST, no imports of the analyzed code):
+
+  1. **Lock extraction** — every ``threading.Lock/RLock/Condition()``
+     bound to a module global, a class attribute, or ``self.x`` gets a
+     canonical name (``memory.admission.AdmissionController._cv``,
+     ``obs.prewarm._save_lock``).  ``Event`` and ``Semaphore`` are NOT
+     locks here (Event.wait is not a condvar wait; the UDF worker pool
+     semaphore has its own cross-function pairing discipline).
+  2. **Call graph** — conservative, type-aware-lite resolution:
+     ``self.m()`` / ``cls.m()``, ``ClassName.m()``, constructor calls,
+     singleton accessors (``return cls._instance`` classmethods),
+     locals/attributes typed by construction, module-alias calls, and
+     chained calls through inferred return types.  Receivers that stay
+     unresolved fall back to name-based CHA (capped and blocklisted)
+     for *reachability only* — never for lock-order edges, so an
+     ambiguous name can hide a finding but cannot fabricate one.
+  3. **Lock-order edges** — ``outer -> inner`` whenever ``inner`` is
+     acquired (directly or via the typed may-acquire closure of a
+     callee) while ``outer`` is held.  Per-instance locks of one class
+     collapse onto one static node, so self-edges are dropped rather
+     than reported as self-deadlock.
+  4. **Thread roots** — the declared entry points concurrency actually
+     starts from (``THREAD_ROOTS``): pool borrow, main query thread,
+     transport fetch worker, block-server handler, heartbeat loop,
+     prewarm background, metrics HTTP server.  Root reachability feeds
+     TPU-R009; an always-held-locks fixpoint (intersection over call
+     sites) supplies the inter-procedural guard set.
+
+The edge relation is ONE shared artifact (``lock_order_artifact``):
+the opt-in runtime witness (obs/lockwitness.py,
+``spark.rapids.tpu.csan.enabled``) wraps the registered lock objects,
+records actual per-thread acquisition chains, and fails when execution
+observes an edge this pass missed or completes a cycle it flagged —
+the static analysis is validated against execution, not just asserted.
+
+Suppression: ``# tpulint: allow[TPU-R008]`` on a cycle lock's
+declaration line, ``allow[TPU-R009]`` on any write site of the state,
+``allow[TPU-R010]`` on the flagged wait/notify/acquire line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from .diagnostics import Diagnostic, ERROR, WARN, register_rule
+
+R008 = register_rule(
+    "TPU-R008", ERROR, "lock-order cycle (potential ABBA deadlock)",
+    "Two code paths acquire the same locks in opposite orders: if the "
+    "paths ever interleave on different threads each can end up holding "
+    "the lock the other is waiting for, and both block forever.  "
+    "Establish one global acquisition order (or drop one nesting by "
+    "copying state out under the first lock).  The runtime lock witness "
+    "(spark.rapids.tpu.csan.enabled) fails hard if execution completes "
+    "a flagged cycle.")
+
+R009 = register_rule(
+    "TPU-R009", ERROR, "shared state written from multiple thread roots "
+    "without a common lock",
+    "A module global, class attribute, or instance attribute of a "
+    "process-shared class (singleton or lock-owning) is written from "
+    ">= 2 declared thread entry points with no single lock held at "
+    "every write: concurrent writers can interleave and lose updates "
+    "(the GIL does not make += or dict writes atomic across the read-"
+    "modify-write).  Guard every write site with one lock, or sanction "
+    "a deliberately racy latch with tpulint: allow[TPU-R009].")
+
+R010 = register_rule(
+    "TPU-R010", ERROR, "condition-variable / raw-lock misuse",
+    "wait() outside a while predicate loop misses spurious wakeups and "
+    "stolen wakeups (the woken thread must re-check); notify() without "
+    "the condition held raises at runtime or races the waiter's "
+    "predicate read; an explicit acquire() whose release() is not on a "
+    "finally path leaks the lock on any exception between them.  Use "
+    "`with lock:` / `while not pred: cv.wait()` or pair acquire() with "
+    "a try/finally release().")
+
+# package-relative prefix every analyzed file carries
+_PKG = "spark_rapids_tpu/"
+
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+
+# mutating container methods that count as writes for TPU-R009
+_MUTATORS = {"append", "appendleft", "add", "update", "pop", "popleft",
+             "popitem", "remove", "discard", "clear", "extend",
+             "extendleft", "insert", "setdefault", "sort", "reverse"}
+
+# CHA fallback (unresolved receivers, reachability only): skip names
+# this generic — they would wire the whole repo together
+_CHA_BLOCKLIST = {"get", "set", "inc", "dec", "close", "reset", "value",
+                  "observe", "labels", "total", "series", "items",
+                  "keys", "values", "start", "stop", "run", "read",
+                  "write", "send", "recv", "join", "put", "copy",
+                  "next", "flush", "release", "acquire", "wait",
+                  "notify", "notify_all", "register", "unregister"}
+_CHA_CAP = 6
+
+# Declared thread entry points: (label, relpath suffix, scope suffix).
+# These are where concurrency actually starts in this engine — the
+# docs/static-analysis.md thread-root table is generated from this.
+THREAD_ROOTS: Tuple[Tuple[str, str, str], ...] = (
+    ("serving-client", "api/pool.py", "SessionPool.run"),
+    ("main-query", "api/session.py", "TpuSession.execute"),
+    ("shuffle-fetcher", "shuffle/transport.py",
+     "AsyncBlockFetcher._producer"),
+    ("block-server", "shuffle/transport.py", "Handler.handle"),
+    ("heartbeat-loop", "shuffle/heartbeat.py", "HeartbeatEndpoint._run"),
+    ("jit-prewarm", "obs/prewarm.py", "prewarm_from_ledger"),
+    ("metrics-http", "obs/health.py", "do_GET"),
+)
+
+
+def _relmod(relpath: str) -> str:
+    p = relpath
+    if p.startswith(_PKG):
+        p = p[len(_PKG):]
+    if p.endswith(".py"):
+        p = p[:-3]
+    if p.endswith("/__init__"):
+        p = p[:-len("/__init__")]
+    return p.replace("/", ".")
+
+
+class LockDef:
+    __slots__ = ("name", "kind", "relpath", "lineno", "owner", "attr",
+                 "class_level")
+
+    def __init__(self, name, kind, relpath, lineno, owner, attr,
+                 class_level):
+        self.name = name          # canonical: relmod[.Class].attr
+        self.kind = kind          # lock | rlock | condition
+        self.relpath = relpath
+        self.lineno = lineno
+        self.owner = owner        # class key or None for module-level
+        self.attr = attr
+        self.class_level = class_level
+
+
+class ClassInfo:
+    __slots__ = ("key", "name", "relpath", "relmod", "methods",
+                 "attr_types", "is_singleton", "lock_attrs")
+
+    def __init__(self, key, name, relpath, relmod):
+        self.key = key
+        self.name = name
+        self.relpath = relpath
+        self.relmod = relmod
+        self.methods: Dict[str, str] = {}      # method name -> fid
+        self.attr_types: Dict[str, Set[Tuple[str, str]]] = {}
+        self.is_singleton = False              # has an _instance attr
+        self.lock_attrs: Dict[str, LockDef] = {}
+
+
+class FuncInfo:
+    __slots__ = ("fid", "relpath", "relmod", "scope", "node", "cls",
+                 "is_init", "ret_types", "acquired", "direct_edges",
+                 "callsites", "writes", "cv_events", "finally_released",
+                 "local_funcs")
+
+    def __init__(self, fid, relpath, relmod, scope, node, cls):
+        self.fid = fid
+        self.relpath = relpath
+        self.relmod = relmod
+        self.scope = scope        # dotted scope within the module
+        self.node = node
+        self.cls = cls            # enclosing ClassInfo key or None
+        self.is_init = scope.split(".")[-1] in ("__init__", "__new__")
+        self.ret_types: Set[Tuple[str, str]] = set()
+        self.acquired: Set[str] = set()
+        self.direct_edges: Set[Tuple[str, str]] = set()
+        # (callee fids frozenset, via_cha, held frozenset, lineno)
+        self.callsites: List[Tuple[FrozenSet[str], bool,
+                                   FrozenSet[str], int]] = []
+        # (state name, lineno, lexically held frozenset)
+        self.writes: List[Tuple[str, int, FrozenSet[str]]] = []
+        # (kind, lock name, lineno, held frozenset, loop_depth)
+        self.cv_events: List[Tuple[str, str, int, FrozenSet[str], int]] = []
+        self.finally_released: Set[str] = set()
+        self.local_funcs: Dict[str, str] = {}  # nested def name -> fid
+
+
+class Analysis:
+    """The shared artifact: locks, edges, cycles, diagnostics."""
+
+    def __init__(self):
+        self.locks: Dict[str, LockDef] = {}
+        self.edges: Set[Tuple[str, str]] = set()
+        self.cycles: List[List[str]] = []
+        self.diagnostics: List[Diagnostic] = []
+        # diagnostic -> candidate (relpath, lineno) allow-annotation
+        # sites (a cross-file finding can be sanctioned at any of them)
+        self.allow_sites: Dict[int, List[Tuple[str, int]]] = {}
+        self.roots: Dict[str, str] = {}        # root fid -> label
+        self.reachable: Dict[str, Set[str]] = {}
+
+    def artifact(self) -> Dict:
+        """JSON-able lock-order relation the runtime witness consumes."""
+        return {
+            "locks": {n: d.kind for n, d in sorted(self.locks.items())},
+            "edges": sorted(list(e) for e in self.edges),
+            "cycles": [list(c) for c in self.cycles],
+            "roots": dict(sorted(self.roots.items())),
+        }
+
+
+# ---------------------------------------------------------------------------
+# pass 1: module structure (classes, methods, locks, imports, globals)
+# ---------------------------------------------------------------------------
+
+class _Module:
+    __slots__ = ("relpath", "relmod", "tree", "source", "imports",
+                 "globals", "funcs")
+
+    def __init__(self, relpath, relmod, tree, source):
+        self.relpath = relpath
+        self.relmod = relmod
+        self.tree = tree
+        self.source = source
+        # alias -> ("mod", relmod) | ("class", ckey) | ("func", fid)
+        self.imports: Dict[str, Tuple[str, str]] = {}
+        self.globals: Set[str] = set()
+        self.funcs: List[str] = []
+
+
+def _lock_ctor_kind(node) -> Optional[str]:
+    """'lock'/'rlock'/'condition' when ``node`` is a direct
+    threading.X() / X() construction (NOT e.g. type(threading.RLock()))."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == "threading":
+        return _LOCK_CTORS.get(f.attr)
+    if isinstance(f, ast.Name):
+        return _LOCK_CTORS.get(f.id)
+    return None
+
+
+class _Collector(ast.NodeVisitor):
+    """Pass-1 walk of one module: classes, functions, locks, imports."""
+
+    def __init__(self, an: "_Analyzer", mod: _Module):
+        self.an = an
+        self.mod = mod
+        self.scope: List[str] = []
+        self.cls_stack: List[Optional[ClassInfo]] = []
+        self.func_stack: List[FuncInfo] = []
+
+    # -- imports (module-wide, function-local included) ----------------------
+    def visit_Import(self, node):
+        for a in node.names:
+            name = a.name
+            alias = a.asname or name.split(".")[0]
+            tgt = self.an.mod_by_dotted(name)
+            if tgt is not None:
+                self.mod.imports[alias] = ("mod", tgt)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        base = self._resolve_from(node)
+        if base is None:
+            return
+        for a in node.names:
+            alias = a.asname or a.name
+            sub = self.an.mod_by_dotted(f"{base}.{a.name}", relative=True)
+            if sub is not None:
+                self.mod.imports[alias] = ("mod", sub)
+            else:
+                self.mod.imports[alias] = ("sym", f"{base}:{a.name}")
+
+    def _resolve_from(self, node) -> Optional[str]:
+        if node.level == 0:
+            return self.an.mod_by_dotted(node.module or "",
+                                         want_pkg=True)
+        parts = self.mod.relmod.split(".")
+        # a module's package is its parents; __init__ already normalized
+        parts = parts[:len(parts) - node.level] if node.level <= \
+            len(parts) else []
+        if node.module:
+            parts += node.module.split(".")
+        return ".".join(parts) if parts else None
+
+    # -- classes / functions -------------------------------------------------
+    def visit_ClassDef(self, node):
+        key = f"{self.mod.relmod}." + ".".join(self.scope + [node.name])
+        ci = ClassInfo(key, node.name, self.mod.relpath, self.mod.relmod)
+        self.an.classes[key] = ci
+        self.an.classes_by_name.setdefault(node.name, []).append(key)
+        if not self.scope:
+            self.mod.imports.setdefault(node.name, ("class", key))
+        # class-level attrs: locks, _instance singleton marker
+        for stmt in node.body:
+            tgts, val = _assign_parts(stmt)
+            for t in tgts:
+                if not isinstance(t, ast.Name):
+                    continue
+                if t.id == "_instance":
+                    ci.is_singleton = True
+                kind = _lock_ctor_kind(val)
+                if kind:
+                    ld = LockDef(f"{key}.{t.id}", kind, self.mod.relpath,
+                                 stmt.lineno, key, t.id, True)
+                    self.an.locks[ld.name] = ld
+                    ci.lock_attrs[t.id] = ld
+        self.scope.append(node.name)
+        self.cls_stack.append(ci)
+        self.generic_visit(node)
+        self.cls_stack.pop()
+        self.scope.pop()
+
+    def visit_FunctionDef(self, node):
+        scope = ".".join(self.scope + [node.name])
+        fid = f"{self.mod.relpath}::{scope}"
+        cls = self.cls_stack[-1] if self.cls_stack else None
+        fi = FuncInfo(fid, self.mod.relpath, self.mod.relmod, scope,
+                      node, cls.key if cls else None)
+        self.an.funcs[fid] = fi
+        self.mod.funcs.append(fid)
+        if cls is not None and len(self.scope) >= 1 and \
+                self.scope[-1] == cls.name:
+            cls.methods[node.name] = fid
+        elif self.func_stack:
+            self.func_stack[-1].local_funcs[node.name] = fid
+        elif not self.scope:
+            self.mod.imports.setdefault(node.name, ("lfunc", fid))
+        # instance locks / attr types from `self.x = ...` in any method
+        if cls is not None:
+            for sub in ast.walk(node):
+                tgts, val = _assign_parts(sub)
+                for t in tgts:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    kind = _lock_ctor_kind(val)
+                    if kind and t.attr not in cls.lock_attrs:
+                        ld = LockDef(f"{cls.key}.{t.attr}", kind,
+                                     self.mod.relpath, sub.lineno,
+                                     cls.key, t.attr, False)
+                        self.an.locks[ld.name] = ld
+                        cls.lock_attrs[t.attr] = ld
+        self.scope.append(node.name)
+        self.func_stack.append(fi)
+        self.generic_visit(node)
+        self.func_stack.pop()
+        self.scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node):
+        if not self.scope:  # module top level
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.mod.globals.add(t.id)
+                    kind = _lock_ctor_kind(node.value)
+                    if kind:
+                        ld = LockDef(f"{self.mod.relmod}.{t.id}", kind,
+                                     self.mod.relpath, node.lineno,
+                                     None, t.id, False)
+                        self.an.locks[ld.name] = ld
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if not self.scope and isinstance(node.target, ast.Name):
+            self.mod.globals.add(node.target.id)
+        self.generic_visit(node)
+
+
+def _assign_parts(stmt):
+    if isinstance(stmt, ast.Assign):
+        return stmt.targets, stmt.value
+    if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        return [stmt.target], stmt.value
+    return (), None
+
+
+# ---------------------------------------------------------------------------
+# pass 2: per-function scan with a lexical held-locks stack
+# ---------------------------------------------------------------------------
+
+class _FuncScan:
+    """One function's linear walk: lock acquisitions (with/acquire),
+    nested-call sites with the held set, shared-state writes, condvar
+    events.  Statement order is preserved so explicit acquire/release
+    pairs track the held set across siblings."""
+
+    def __init__(self, an: "_Analyzer", fi: FuncInfo, mod: _Module):
+        self.an = an
+        self.fi = fi
+        self.mod = mod
+        self.held: List[str] = []
+        self.loop_depth = 0
+        self.declared_globals: Set[str] = set()
+        # locals -> type tokens, seeded per scan round
+        self.local_types: Dict[str, Set[Tuple[str, str]]] = {}
+
+    def run(self):
+        fi = self.fi
+        fi.acquired.clear()
+        fi.direct_edges.clear()
+        fi.callsites = []
+        fi.writes = []
+        fi.cv_events = []
+        fi.finally_released.clear()
+        fi.ret_types = set()
+        node = fi.node
+        # prepass: local var types from simple assignments
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub is not node:
+                continue
+            tgts, val = _assign_parts(sub)
+            for t in tgts:
+                if isinstance(t, ast.Name) and val is not None:
+                    ts = self.an.expr_types(val, self)
+                    if ts:
+                        self.local_types.setdefault(t.id, set()) \
+                            .update(ts)
+            if isinstance(sub, ast.Global):
+                self.declared_globals.update(sub.names)
+        for stmt in node.body:
+            self._stmt(stmt)
+
+    # -- statement dispatch --------------------------------------------------
+    def _stmt(self, node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # separate scopes, scanned on their own
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            for item in node.items:
+                lk = self.resolve_lock(item.context_expr)
+                self._expr(item.context_expr)
+                if lk is not None:
+                    self._acquire(lk.name, node.lineno)
+                    pushed += 1
+            for s in node.body:
+                self._stmt(s)
+            for _ in range(pushed):
+                self.held.pop()
+            return
+        if isinstance(node, ast.While):
+            self._expr(node.test)
+            self.loop_depth += 1
+            for s in node.body:
+                self._stmt(s)
+            self.loop_depth -= 1
+            for s in node.orelse:
+                self._stmt(s)
+            return
+        if isinstance(node, ast.For):
+            self._expr(node.iter)
+            self.loop_depth += 1
+            for s in node.body:
+                self._stmt(s)
+            self.loop_depth -= 1
+            for s in node.orelse:
+                self._stmt(s)
+            return
+        if isinstance(node, ast.Try):
+            for lk in self.an.locks.values():
+                pass
+            for s in node.finalbody:
+                for sub in ast.walk(s):
+                    if isinstance(sub, ast.Call) and \
+                            isinstance(sub.func, ast.Attribute) and \
+                            sub.func.attr == "release":
+                        lk = self.resolve_lock(sub.func.value)
+                        if lk is not None:
+                            self.fi.finally_released.add(lk.name)
+            for s in (node.body + [h for hh in node.handlers
+                                   for h in hh.body] + node.orelse +
+                      node.finalbody):
+                self._stmt(s)
+            return
+        if isinstance(node, (ast.If,)):
+            self._expr(node.test)
+            for s in node.body + node.orelse:
+                self._stmt(s)
+            return
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._record_write(node)
+            for child in ast.iter_child_nodes(node):
+                self._expr(child)
+            return
+        if isinstance(node, ast.Expr):
+            self._expr(node.value)
+            return
+        if isinstance(node, ast.Return):
+            if node.value is not None:
+                self.fi.ret_types.update(
+                    self.an.expr_types(node.value, self))
+                self._expr(node.value)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    st = self._state_of(t.value)
+                    if st:
+                        self.fi.writes.append(
+                            (st, node.lineno, frozenset(self.held)))
+            return
+        # everything else: visit child statements/expressions generically
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self._stmt(child)
+            else:
+                self._expr(child)
+
+    # -- expressions: calls, lock-method events ------------------------------
+    def _expr(self, node):
+        if node is None or isinstance(node, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef,
+                                             ast.ClassDef, ast.Lambda)):
+            return
+        if isinstance(node, ast.Call):
+            self._call(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self._stmt(child)
+            else:
+                self._expr(child)
+
+    def _call(self, node: ast.Call):
+        f = node.func
+        handled = False
+        if isinstance(f, ast.Attribute):
+            lk = self.resolve_lock(f.value)
+            if lk is not None and f.attr in (
+                    "acquire", "release", "wait", "wait_for", "notify",
+                    "notify_all", "locked"):
+                handled = True
+                held = frozenset(self.held)
+                if f.attr == "acquire":
+                    self.fi.cv_events.append(
+                        ("acquire", lk.name, node.lineno, held,
+                         self.loop_depth))
+                    self._acquire(lk.name, node.lineno)
+                elif f.attr == "release":
+                    if lk.name in self.held:
+                        self.held.remove(lk.name)
+                elif f.attr == "wait":
+                    self.fi.cv_events.append(
+                        ("wait", lk.name, node.lineno, held,
+                         self.loop_depth))
+                elif f.attr in ("notify", "notify_all"):
+                    self.fi.cv_events.append(
+                        ("notify", lk.name, node.lineno, held,
+                         self.loop_depth))
+            # mutator method on shared state -> write
+            if f.attr in _MUTATORS:
+                st = self._state_of(f.value)
+                if st:
+                    self.fi.writes.append(
+                        (st, node.lineno, frozenset(self.held)))
+        if not handled:
+            tgts, via_cha = self.an.resolve_call(node, self)
+            if tgts:
+                self.fi.callsites.append(
+                    (frozenset(tgts), via_cha, frozenset(self.held),
+                     node.lineno))
+        for a in node.args:
+            self._expr(a)
+        for kw in node.keywords:
+            self._expr(kw.value)
+        if isinstance(f, ast.Attribute):
+            self._expr(f.value)
+        elif not isinstance(f, ast.Name):
+            self._expr(f)
+
+    def _acquire(self, name: str, lineno: int):
+        for outer in self.held:
+            if outer != name:
+                self.fi.direct_edges.add((outer, name))
+        self.fi.acquired.add(name)
+        self.held.append(name)
+
+    # -- writes --------------------------------------------------------------
+    def _record_write(self, node):
+        if isinstance(node, ast.Assign):
+            targets, _ = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:  # AugAssign
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Tuple):
+                tt = list(t.elts)
+            else:
+                tt = [t]
+            for tgt in tt:
+                st = None
+                if isinstance(tgt, ast.Subscript):
+                    st = self._state_of(tgt.value)
+                else:
+                    st = self._state_of(tgt, store=True)
+                if st:
+                    self.fi.writes.append(
+                        (st, node.lineno, frozenset(self.held)))
+
+    def _state_of(self, expr, store: bool = False) -> Optional[str]:
+        """Canonical shared-state name for a write target/receiver, or
+        None when the receiver is local (untracked)."""
+        fi = self.fi
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            base = expr.value.id
+            if base in ("self", "cls") and fi.cls is not None:
+                if fi.is_init:
+                    return None  # construction is single-threaded
+                ci = self.an.classes[fi.cls]
+                if base == "cls":
+                    return f"{fi.cls}.{expr.attr}"  # class attr: shared
+                if self.an.is_shared_class(ci):
+                    if expr.attr in ci.lock_attrs:
+                        return None  # rebinding a lock is its own sin
+                    return f"{fi.cls}.{expr.attr}"
+                return None
+            tgt = self.mod.imports.get(base)
+            if tgt and tgt[0] == "class":
+                return f"{tgt[1]}.{expr.attr}"
+            return None
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            if name in self.declared_globals or \
+                    (not store and name in self.mod.globals):
+                if (self.mod.relmod, name) in self.an.module_locks:
+                    return None
+                if store and name not in self.declared_globals:
+                    return None
+                return f"{self.mod.relmod}.{name}"
+            return None
+        return None
+
+    # -- lock resolution -----------------------------------------------------
+    def resolve_lock(self, expr) -> Optional[LockDef]:
+        an = self.an
+        if isinstance(expr, ast.Name):
+            ld = an.module_locks.get((self.mod.relmod, expr.id))
+            return ld
+        if isinstance(expr, ast.Attribute):
+            for tok in an.expr_types(expr.value, self):
+                if tok[0] == "class":
+                    ci = an.classes.get(tok[1])
+                    if ci and expr.attr in ci.lock_attrs:
+                        return ci.lock_attrs[expr.attr]
+                elif tok[0] == "mod":
+                    ld = an.module_locks.get((tok[1], expr.attr))
+                    if ld:
+                        return ld
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the analyzer
+# ---------------------------------------------------------------------------
+
+class _Analyzer:
+    def __init__(self, sources: Dict[str, str],
+                 roots: Optional[Iterable[str]] = None):
+        self.sources = sources
+        self.mods: Dict[str, _Module] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.classes_by_name: Dict[str, List[str]] = {}
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.locks: Dict[str, LockDef] = {}
+        self.module_locks: Dict[Tuple[str, str], LockDef] = {}
+        self.methods_by_name: Dict[str, List[str]] = {}
+        self.explicit_roots = list(roots) if roots is not None else None
+        self._relmods: Set[str] = set()
+
+    # -- module name resolution ----------------------------------------------
+    def mod_by_dotted(self, dotted: str, relative: bool = False,
+                      want_pkg: bool = False) -> Optional[str]:
+        d = dotted
+        if d.startswith("spark_rapids_tpu."):
+            d = d[len("spark_rapids_tpu."):]
+        elif d == "spark_rapids_tpu":
+            d = ""
+        if d in self._relmods:
+            return d
+        if want_pkg and (d == "" or any(
+                r.startswith(d + ".") for r in self._relmods)):
+            return d
+        return None
+
+    def is_shared_class(self, ci: ClassInfo) -> bool:
+        """Instance state of these classes is treated as cross-thread
+        shared: singletons, and classes that declare a lock (owning a
+        lock is declaring yourself concurrent)."""
+        return ci.is_singleton or bool(ci.lock_attrs)
+
+    # -- type inference ------------------------------------------------------
+    def expr_types(self, expr, scan: Optional[_FuncScan],
+                   depth: int = 0) -> Set[Tuple[str, str]]:
+        if depth > 6 or expr is None:
+            return set()
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            if scan is not None:
+                if name == "self" and scan.fi.cls:
+                    return {("class", scan.fi.cls)}
+                if name == "cls" and scan.fi.cls:
+                    return {("class", scan.fi.cls)}
+                lt = scan.local_types.get(name)
+                if lt:
+                    return set(lt)
+                tgt = scan.mod.imports.get(name)
+                if tgt:
+                    if tgt[0] in ("mod", "class"):
+                        return {tgt}
+                    if tgt[0] == "sym":
+                        m, sym = tgt[1].split(":")
+                        ck = f"{m}.{sym}"
+                        if ck in self.classes:
+                            return {("class", ck)}
+            return set()
+        if isinstance(expr, ast.Attribute):
+            # self._attr with a known construction type
+            for tok in self.expr_types(expr.value, scan, depth + 1):
+                if tok[0] == "class":
+                    ci = self.classes.get(tok[1])
+                    if ci:
+                        at = ci.attr_types.get(expr.attr)
+                        if at:
+                            return set(at)
+                        if expr.attr == "_instance" and ci.is_singleton:
+                            return {("class", ci.key)}
+                elif tok[0] == "mod" and scan is not None:
+                    sub = self.mod_by_dotted(f"{tok[1]}.{expr.attr}")
+                    if sub is not None:
+                        return {("mod", sub)}
+                    ck = f"{tok[1]}.{expr.attr}"
+                    if ck in self.classes:
+                        return {("class", ck)}
+            return set()
+        if isinstance(expr, ast.Call):
+            tgts, _cha = self.resolve_call(expr, scan, typed_only=True)
+            out: Set[Tuple[str, str]] = set()
+            for t in tgts:
+                if t.startswith("ctor:"):
+                    out.add(("class", t[5:]))
+                else:
+                    fi = self.funcs.get(t)
+                    if fi:
+                        out.update(fi.ret_types)
+            return out
+        return set()
+
+    # -- call resolution -----------------------------------------------------
+    def resolve_call(self, node: ast.Call, scan: Optional[_FuncScan],
+                     typed_only: bool = False
+                     ) -> Tuple[Set[str], bool]:
+        """Resolve a call to target fids.  Constructor targets carry a
+        ``ctor:<classkey>`` pseudo-id alongside their __init__ fid.
+        Returns (targets, resolved_via_cha)."""
+        f = node.func
+        out: Set[str] = set()
+        if isinstance(f, ast.Name):
+            name = f.id
+            if scan is not None:
+                # nested function in an enclosing scope
+                lf = scan.fi.local_funcs.get(name)
+                if lf:
+                    return {lf}, False
+                tgt = scan.mod.imports.get(name)
+                if tgt:
+                    if tgt[0] == "lfunc":
+                        return {tgt[1]}, False
+                    if tgt[0] == "class":
+                        return self._ctor_targets(tgt[1]), False
+                    if tgt[0] == "sym":
+                        m, sym = tgt[1].split(":")
+                        ck = f"{m}.{sym}"
+                        if ck in self.classes:
+                            return self._ctor_targets(ck), False
+                        mod = self.mods.get_by_relmod(m) if hasattr(
+                            self.mods, "get_by_relmod") else None
+                        fid = self._module_func(m, sym)
+                        if fid:
+                            return {fid}, False
+            return set(), False
+        if isinstance(f, ast.Attribute):
+            attr = f.attr
+            recv_types = self.expr_types(f.value, scan)
+            for tok in recv_types:
+                if tok[0] == "class":
+                    ci = self.classes.get(tok[1])
+                    if ci:
+                        fid = ci.methods.get(attr)
+                        if fid:
+                            out.add(fid)
+                elif tok[0] == "mod":
+                    fid = self._module_func(tok[1], attr)
+                    if fid:
+                        out.add(fid)
+            if out:
+                return out, False
+            if typed_only:
+                return set(), False
+            # CHA fallback for reachability: every class defining this
+            # method name, capped and blocklisted
+            if attr in _CHA_BLOCKLIST or attr.startswith("__"):
+                return set(), False
+            cands = self.methods_by_name.get(attr, ())
+            if 0 < len(cands) <= _CHA_CAP:
+                return set(cands), True
+        return out, False
+
+    def _ctor_targets(self, ckey: str) -> Set[str]:
+        out = {f"ctor:{ckey}"}
+        ci = self.classes.get(ckey)
+        if ci:
+            init = ci.methods.get("__init__")
+            if init:
+                out.add(init)
+        return out
+
+    def _module_func(self, relmod: str, name: str) -> Optional[str]:
+        mod = self._mods_by_relmod.get(relmod)
+        if mod is None:
+            return None
+        tgt = mod.imports.get(name)
+        if tgt and tgt[0] == "lfunc":
+            return tgt[1]
+        if tgt and tgt[0] == "class":
+            return None
+        return None
+
+    # -- driver --------------------------------------------------------------
+    def run(self) -> Analysis:
+        # parse
+        for relpath in sorted(self.sources):
+            relmod = _relmod(relpath)
+            self._relmods.add(relmod)
+        self._mods_by_relmod: Dict[str, _Module] = {}
+        trees = {}
+        for relpath in sorted(self.sources):
+            try:
+                tree = ast.parse(self.sources[relpath],
+                                 filename=relpath)
+            except SyntaxError:
+                continue
+            trees[relpath] = tree
+        for relpath, tree in trees.items():
+            mod = _Module(relpath, _relmod(relpath), tree,
+                          self.sources[relpath])
+            self.mods[relpath] = mod
+            self._mods_by_relmod[mod.relmod] = mod
+        # pass 1
+        for mod in self.mods.values():
+            _Collector(self, mod).visit(mod.tree)
+        for ld in self.locks.values():
+            if ld.owner is None:
+                self.module_locks[(_relmod(ld.relpath), ld.attr)] = ld
+        for ci in self.classes.values():
+            for mname, fid in ci.methods.items():
+                self.methods_by_name.setdefault(mname, []).append(fid)
+        # attr types (needs the class table, so after pass 1)
+        self._collect_attr_types()
+        # pass 2, three rounds so chained return types stabilize
+        for _ in range(3):
+            for fid in sorted(self.funcs):
+                fi = self.funcs[fid]
+                mod = self.mods.get(fi.relpath)
+                if mod is not None:
+                    _FuncScan(self, fi, mod).run()
+        return self._finish()
+
+    def _collect_attr_types(self):
+        for fid in sorted(self.funcs):
+            fi = self.funcs[fid]
+            if fi.cls is None:
+                continue
+            ci = self.classes[fi.cls]
+            mod = self.mods.get(fi.relpath)
+            if mod is None:
+                continue
+            scan = _FuncScan(self, fi, mod)
+            for sub in ast.walk(fi.node):
+                tgts, val = _assign_parts(sub)
+                for t in tgts:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id in ("self", "cls") and \
+                            isinstance(val, ast.Call):
+                        ts = self.expr_types(val, scan)
+                        cts = {x for x in ts}
+                        if cts:
+                            ci.attr_types.setdefault(t.attr, set()) \
+                                .update(cts)
+
+    # -- fixpoints and rule emission -----------------------------------------
+    def _finish(self) -> Analysis:
+        res = Analysis()
+        res.locks = dict(self.locks)
+
+        # typed call graph (lock-edge propagation) and full graph
+        typed: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+        full: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+        for fid, fi in self.funcs.items():
+            tl, fl = [], []
+            for tgts, via_cha, held, _ln in fi.callsites:
+                for t in tgts:
+                    if t.startswith("ctor:"):
+                        continue
+                    fl.append((t, held))
+                    if not via_cha:
+                        tl.append((t, held))
+            typed[fid] = tl
+            full[fid] = fl
+
+        # may-acquire closure over the typed graph
+        ma: Dict[str, Set[str]] = {fid: set(fi.acquired)
+                                   for fid, fi in self.funcs.items()}
+        changed = True
+        while changed:
+            changed = False
+            for fid in self.funcs:
+                cur = ma[fid]
+                before = len(cur)
+                for callee, _held in typed[fid]:
+                    if callee in ma:
+                        cur |= ma[callee]
+                if len(cur) != before:
+                    changed = True
+
+        # lock-order edges: direct nesting + held-at-callsite x callee
+        # closure (self-edges dropped: per-instance locks of one class
+        # collapse onto one static node)
+        edges: Set[Tuple[str, str]] = set()
+        for fid, fi in self.funcs.items():
+            edges |= fi.direct_edges
+            for callee, held in typed[fid]:
+                for inner in ma.get(callee, ()):
+                    for outer in held:
+                        if outer != inner:
+                            edges.add((outer, inner))
+        res.edges = edges
+
+        # roots
+        roots: Dict[str, str] = {}
+        if self.explicit_roots is not None:
+            for r in self.explicit_roots:
+                for fid in self.funcs:
+                    relpath, scope = fid.split("::", 1)
+                    dotted = f"{_relmod(relpath)}.{scope}"
+                    if dotted == r or dotted.endswith("." + r) or \
+                            scope == r:
+                        roots[fid] = r
+        else:
+            for label, path_sfx, scope_sfx in THREAD_ROOTS:
+                for fid, fi in self.funcs.items():
+                    if fi.relpath.endswith(path_sfx) and (
+                            fi.scope == scope_sfx or
+                            fi.scope.endswith("." + scope_sfx)):
+                        roots[fid] = label
+        res.roots = roots
+
+        # reachability per root over the full graph
+        succ: Dict[str, Set[str]] = {
+            fid: {c for c, _h in full[fid]} for fid in self.funcs}
+        reach: Dict[str, Set[str]] = {}
+        for root in roots:
+            seen = {root}
+            stack = [root]
+            while stack:
+                cur = stack.pop()
+                for nxt in succ.get(cur, ()):
+                    if nxt not in seen and nxt in self.funcs:
+                        seen.add(nxt)
+                        stack.append(nxt)
+            reach[root] = seen
+        res.reachable = reach
+
+        # always-held fixpoint H(f) over the full graph, from roots
+        TOP = None
+        H: Dict[str, Optional[FrozenSet[str]]] = {
+            fid: TOP for fid in self.funcs}
+        work = []
+        for root in roots:
+            H[root] = frozenset()
+            work.append(root)
+        while work:
+            cur = work.pop()
+            base = H[cur] or frozenset()
+            for callee, held in full.get(cur, ()):
+                if callee not in H:
+                    continue
+                new = base | held
+                old = H[callee]
+                merged = new if old is TOP else (old & new)
+                if merged != old:
+                    H[callee] = merged
+                    work.append(callee)
+
+        self._rule_r008(res)
+        self._rule_r009(res, reach, roots, H)
+        self._rule_r010(res, H)
+        res.diagnostics.sort(key=lambda d: (d.code, d.loc, d.message))
+        return res
+
+    # -- TPU-R008 ------------------------------------------------------------
+    def _rule_r008(self, res: Analysis):
+        graph: Dict[str, Set[str]] = {}
+        for a, b in res.edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        sccs = _tarjan(graph)
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            cyc = _shortest_cycle(graph, sorted(scc))
+            res.cycles.append(cyc)
+            path = " -> ".join(cyc + [cyc[0]])
+            d = R008.diag(
+                f"lock-order cycle {path} (potential ABBA deadlock)",
+                loc=self._lock_loc(cyc[0]))
+            res.diagnostics.append(d)
+            res.allow_sites[id(d)] = [
+                (self.locks[n].relpath, self.locks[n].lineno)
+                for n in cyc if n in self.locks]
+
+    def _lock_loc(self, name: str) -> str:
+        ld = self.locks.get(name)
+        return f"{ld.relpath}:{ld.lineno}" if ld else ""
+
+    # -- TPU-R009 ------------------------------------------------------------
+    def _rule_r009(self, res, reach, roots, H):
+        # state -> [(fid, lineno, guard set)]
+        by_state: Dict[str, List[Tuple[str, int, FrozenSet[str]]]] = {}
+        for fid, fi in self.funcs.items():
+            for st, lineno, held in fi.writes:
+                by_state.setdefault(st, []).append((fid, lineno, held))
+        for st in sorted(by_state):
+            writes = by_state[st]
+            st_roots = sorted({roots[r] for r in roots
+                               if any(w[0] in reach[r] for w in writes)})
+            if len(st_roots) < 2:
+                continue
+            guards: Optional[FrozenSet[str]] = None
+            sites: List[Tuple[str, int]] = []
+            for fid, lineno, held in writes:
+                if not any(fid in reach[r] for r in roots):
+                    continue
+                h = H.get(fid)
+                g = held | (h if h is not None else frozenset())
+                guards = g if guards is None else (guards & g)
+                fi = self.funcs[fid]
+                sites.append((fi.relpath, lineno))
+            if guards is None or guards:
+                continue
+            sites.sort()
+            d = R009.diag(
+                f"shared state {st} written from {len(st_roots)} thread "
+                f"roots ({', '.join(st_roots)}) with no common guarding "
+                f"lock", loc=f"{sites[0][0]}:{sites[0][1]}")
+            res.diagnostics.append(d)
+            res.allow_sites[id(d)] = sites
+
+    # -- TPU-R010 ------------------------------------------------------------
+    def _rule_r010(self, res, H):
+        for fid in sorted(self.funcs):
+            fi = self.funcs[fid]
+            loc_scope = fi.scope
+            for kind, lock, lineno, held, loop_depth in fi.cv_events:
+                ld = self.locks.get(lock)
+                if kind == "wait":
+                    if ld is not None and ld.kind != "condition":
+                        continue  # Lock.wait does not exist; ignore
+                    if loop_depth == 0:
+                        d = R010.diag(
+                            f"{lock}.wait() outside a while predicate "
+                            f"loop in {loc_scope}: spurious/stolen "
+                            f"wakeups skip the re-check",
+                            loc=f"{fi.relpath}:{lineno}")
+                        res.diagnostics.append(d)
+                        res.allow_sites[id(d)] = [(fi.relpath, lineno)]
+                elif kind == "notify":
+                    h = H.get(fid)
+                    if lock in held or (h is not None and lock in h):
+                        continue
+                    d = R010.diag(
+                        f"{lock}.notify() without the condition held "
+                        f"in {loc_scope}: raises RuntimeError or races "
+                        f"the waiter's predicate read",
+                        loc=f"{fi.relpath}:{lineno}")
+                    res.diagnostics.append(d)
+                    res.allow_sites[id(d)] = [(fi.relpath, lineno)]
+                elif kind == "acquire":
+                    if lock in fi.finally_released:
+                        continue
+                    d = R010.diag(
+                        f"explicit {lock}.acquire() in {loc_scope} "
+                        f"with no finally-path release() in the same "
+                        f"function: any exception in between leaks the "
+                        f"lock", loc=f"{fi.relpath}:{lineno}")
+                    res.diagnostics.append(d)
+                    res.allow_sites[id(d)] = [(fi.relpath, lineno)]
+
+
+def _tarjan(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Iterative Tarjan SCC."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    for start in sorted(graph):
+        if start in index:
+            continue
+        work = [(start, iter(sorted(graph.get(start, ()))))]
+        index[start] = low[start] = counter[0]
+        counter[0] += 1
+        stack.append(start)
+        on_stack.add(start)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(graph.get(nxt, ())))))
+                    advanced = True
+                    break
+                elif nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                out.append(scc)
+    return out
+
+
+def _shortest_cycle(graph: Dict[str, Set[str]],
+                    scc: List[str]) -> List[str]:
+    """A representative cycle inside one SCC (BFS from its min node)."""
+    start = scc[0]
+    members = set(scc)
+    prev: Dict[str, Optional[str]] = {start: None}
+    from collections import deque
+    q = deque([start])
+    while q:
+        cur = q.popleft()
+        for nxt in sorted(graph.get(cur, ())):
+            if nxt == start:
+                path = [cur]
+                while prev[path[-1]] is not None:
+                    path.append(prev[path[-1]])
+                return list(reversed(path))
+            if nxt in members and nxt not in prev:
+                prev[nxt] = cur
+                q.append(nxt)
+    return scc
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def analyze_sources(sources: Dict[str, str],
+                    roots: Optional[Iterable[str]] = None) -> Analysis:
+    """Run the full pass over in-memory sources (fixtures, tests).
+    ``roots`` are dotted thread-entry names (``mod.Class.method``);
+    None selects the declared ``THREAD_ROOTS`` table."""
+    return _Analyzer(sources, roots=roots).run()
+
+
+def _package_sources(root: Optional[str] = None) -> Dict[str, str]:
+    from .repo_lint import _package_root, _py_files
+    root = root or _package_root()
+    out = {}
+    for path in _py_files(root):
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            out[relpath] = f.read()
+    return out
+
+
+_REPO_CACHE: Dict[str, Analysis] = {}
+
+
+def analyze_repo(root: Optional[str] = None,
+                 refresh: bool = False) -> Analysis:
+    """The repo-wide analysis (memoized per process: the witness and
+    the lint front end share one run)."""
+    from .repo_lint import _package_root
+    key = os.path.abspath(root or _package_root())
+    if refresh or key not in _REPO_CACHE:
+        _REPO_CACHE[key] = _Analyzer(_package_sources(root)).run()
+    return _REPO_CACHE[key]
+
+
+def repo_diagnostics(root: Optional[str] = None) -> List[Diagnostic]:
+    """TPU-R008/R009/R010 over the package, with ``tpulint: allow``
+    annotations honored at any of a finding's candidate sites."""
+    res = analyze_repo(root)
+    return filter_allowed(res, _package_sources(root))
+
+
+def filter_allowed(res: Analysis,
+                   sources: Dict[str, str]) -> List[Diagnostic]:
+    from .repo_lint import _allowed_lines
+    allowed: Dict[str, Dict[str, Set[int]]] = {}
+    for relpath, source in sources.items():
+        al = _allowed_lines(source)
+        if al:
+            allowed[relpath] = al
+    out = []
+    for d in res.diagnostics:
+        sites = res.allow_sites.get(id(d)) or []
+        if not sites and ":" in d.loc:
+            p, _, ln = d.loc.rpartition(":")
+            sites = [(p, int(ln))]
+        sanctioned = any(
+            ln in allowed.get(p, {}).get(d.code, ())
+            for p, ln in sites)
+        if not sanctioned:
+            out.append(d)
+    return out
+
+
+def lock_order_artifact(root: Optional[str] = None) -> Dict:
+    """The shared static artifact the runtime lock witness validates
+    against: {'locks': {name: kind}, 'edges': [[a, b], ...],
+    'cycles': [...], 'roots': {...}}."""
+    return analyze_repo(root).artifact()

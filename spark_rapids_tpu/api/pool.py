@@ -47,6 +47,11 @@ class SessionPool:
         if self.size < 1:
             raise ValueError(f"pool size must be >= 1, got {self.size}")
         self._cv = threading.Condition()
+        # csan lock witness: deferred no-op unless the witness is
+        # installed (spark.rapids.tpu.csan.enabled)
+        from ..obs import lockwitness
+        lockwitness.maybe_register("api.pool.SessionPool._cv", self,
+                                   "_cv")
         self._closed = False
         self._sessions = []
         for i in range(self.size):

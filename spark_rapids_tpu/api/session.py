@@ -327,6 +327,12 @@ class TpuSession:
     def _execute(self, lp: L.LogicalPlan) -> pa.Table:
         from ..obs import tracer as obs
         conf = self.conf
+        if conf.get(cfg.CSAN_ENABLED):
+            # lock witness: wrap registered locks before any of them is
+            # taken on this query's path; refresh() also picks up locks
+            # whose owners were constructed since the last query
+            from ..obs import lockwitness
+            lockwitness.ensure_installed()
         eventlog_dir = conf.get(cfg.EVENT_LOG_DIR)
         tracing = conf.get(cfg.TRACE_ENABLED) or eventlog_dir is not None
         if not tracing:

@@ -668,6 +668,21 @@ LINT_MAX_PROGRAMS = conf(
     .check(lambda v: v >= 1, "must be >= 1") \
     .create_with_default(96)
 
+# --- concurrency sanitizer (tpucsan) --------------------------------------
+
+CSAN_ENABLED = conf("spark.rapids.tpu.csan.enabled").boolean() \
+    .doc("Opt-in runtime lock witness (obs/lockwitness.py): the "
+         "engine's registered locks are wrapped so every per-thread "
+         "acquisition chain is recorded and checked against the static "
+         "lock-order relation from the tpucsan pass "
+         "(analysis/concurrency.py, TPU-R008..R010).  The witness "
+         "report fails on an acquisition edge the static graph cannot "
+         "explain (unmodeled edge) or on an observed lock-order cycle, "
+         "and exports tpu_lock_contention_total / tpu_lock_wait_seconds "
+         "for the witnessed locks.  Diagnostics only — adds per-acquire "
+         "bookkeeping.") \
+    .create_with_default(False)
+
 # --- memory sanitizer (tmsan) ---------------------------------------------
 
 MEMSAN_ENABLED = conf("spark.rapids.tpu.memsan.enabled").boolean() \

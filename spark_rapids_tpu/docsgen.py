@@ -64,8 +64,10 @@ def generate_lint_rules() -> str:
     the rules actually enforced)."""
     # importing the front ends populates the catalog (interp carries the
     # flow-sensitive rules TPU-L009..L012, lifetime the tmsan memory
-    # rules TPU-L013..L015)
-    from .analysis import interp, lifetime, plan_lint, repo_lint  # noqa: F401
+    # rules TPU-L013..L015, concurrency the tpucsan rules
+    # TPU-R008..R010)
+    from .analysis import (concurrency, interp, lifetime,  # noqa: F401
+                           plan_lint, repo_lint)
     from .analysis.diagnostics import RULE_CATALOG
     lines = [
         "# tpulint rule catalog",

@@ -112,6 +112,12 @@ class AdmissionController:
                     inst.timeout_s == float(timeout_s):
                 return inst
             cls._instance = AdmissionController(budget_bytes, timeout_s)
+            # csan lock witness: each configure() builds a fresh _cv;
+            # deferred registration is lock-safe (we hold _ilock here)
+            from ..obs import lockwitness
+            lockwitness.maybe_register(
+                "memory.admission.AdmissionController._cv",
+                cls._instance, "_cv")
             return cls._instance
 
     @classmethod

@@ -18,6 +18,7 @@ from __future__ import annotations
 import ctypes
 import ctypes.util
 import struct
+import threading
 import zlib
 
 from . import get_lib
@@ -101,31 +102,42 @@ def _zlib_decompress(body: bytes, n: int) -> bytes:
 
 _zstd_lib = None
 _zstd_checked = False
+_zstd_init_lock = threading.Lock()
 
 
 def _zstd():
+    # Double-checked init: codec callers run on every thread root
+    # (query threads, block-server handlers, the async fetcher).  The
+    # unguarded fast-path READ is safe under the GIL; both WRITES stay
+    # inside the lock, and _zstd_checked flips only after _zstd_lib is
+    # fully configured, so no thread can observe checked=True with a
+    # half-bound library and silently take the zlib fallback.
     global _zstd_lib, _zstd_checked
     if _zstd_checked:
         return _zstd_lib
-    _zstd_checked = True
-    name = ctypes.util.find_library("zstd") or "libzstd.so.1"
-    try:
-        lib = ctypes.CDLL(name)
-    except OSError:
-        return None
-    lib.ZSTD_compressBound.restype = ctypes.c_size_t
-    lib.ZSTD_compressBound.argtypes = [ctypes.c_size_t]
-    lib.ZSTD_compress.restype = ctypes.c_size_t
-    lib.ZSTD_compress.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
-                                  ctypes.c_void_p, ctypes.c_size_t,
-                                  ctypes.c_int]
-    lib.ZSTD_decompress.restype = ctypes.c_size_t
-    lib.ZSTD_decompress.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
-                                    ctypes.c_void_p, ctypes.c_size_t]
-    lib.ZSTD_isError.restype = ctypes.c_uint
-    lib.ZSTD_isError.argtypes = [ctypes.c_size_t]
-    _zstd_lib = lib
-    return _zstd_lib
+    with _zstd_init_lock:
+        if _zstd_checked:
+            return _zstd_lib
+        name = ctypes.util.find_library("zstd") or "libzstd.so.1"
+        try:
+            lib = ctypes.CDLL(name)
+        except OSError:
+            _zstd_checked = True
+            return None
+        lib.ZSTD_compressBound.restype = ctypes.c_size_t
+        lib.ZSTD_compressBound.argtypes = [ctypes.c_size_t]
+        lib.ZSTD_compress.restype = ctypes.c_size_t
+        lib.ZSTD_compress.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                      ctypes.c_void_p, ctypes.c_size_t,
+                                      ctypes.c_int]
+        lib.ZSTD_decompress.restype = ctypes.c_size_t
+        lib.ZSTD_decompress.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                        ctypes.c_void_p, ctypes.c_size_t]
+        lib.ZSTD_isError.restype = ctypes.c_uint
+        lib.ZSTD_isError.argtypes = [ctypes.c_size_t]
+        _zstd_lib = lib
+        _zstd_checked = True
+        return _zstd_lib
 
 
 def zstd_compress(data: bytes, level: int = 1) -> bytes:

@@ -1,0 +1,350 @@
+"""Runtime lock witness — the execution half of tpucsan.
+
+``analysis/concurrency.py`` computes a static lock-order relation; this
+module validates it against what threads actually do.  When
+``spark.rapids.tpu.csan.enabled`` is on, the witness replaces the
+engine's registered lock objects with thin proxies that
+
+  * keep a per-thread stack of held witness locks,
+  * record every nesting edge ``outer -> inner`` actually executed,
+  * count blocked acquisitions into ``tpu_lock_contention_total{lock}``
+    and time them into ``tpu_lock_wait_seconds{lock}`` (cardinality is
+    bounded by the witness registry itself — one series per registered
+    lock — on top of the metric family's own ``max_series`` cap),
+
+and ``report()`` then fails the run if execution observed an
+acquisition edge the static graph cannot explain (an *unmodeled* edge:
+the pass has a hole) or if the observed edges close a lock-order cycle
+(the ABBA interleaving TPU-R008 warns about actually happened).  Static
+analysis validated by execution, execution checked against static
+analysis — same contract as tmsan's plan-vs-ledger split.
+
+Design constraints that shape the code:
+
+  * ``maybe_register`` is called from inside constructors that may be
+    holding locks — it only appends to a pending list under the
+    witness's own raw mutex and never touches the metrics registry, so
+    instrumentation cannot introduce lock edges of its own.  The actual
+    wrapping (and metric-series resolution) happens in ``refresh()``,
+    called from lock-free context at query start.
+  * metric children are resolved ONCE at wrap time; the hot acquire
+    path touches only the per-series child locks, never the registry
+    locks — otherwise witnessing `MetricsRegistry._lock` would recurse
+    into itself.
+  * an unmodeled edge is judged against the TRANSITIVE CLOSURE of the
+    static edges: the runtime stack sees ``A held while C acquired``
+    even when the static pass modeled it as ``A -> B`` and ``B -> C``
+    through a callee.
+  * ``Condition.wait()`` releases and reacquires its inner lock without
+    passing through the proxy; the held stack deliberately keeps the
+    condition "held" across the wait — the thread is blocked, it cannot
+    acquire anything else, so no spurious edges appear.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+_WAIT_BUCKETS = (0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+                 1.0, 5.0)
+
+
+class _LockProxy:
+    """Wraps a Lock/RLock: same surface, plus witness bookkeeping."""
+
+    def __init__(self, inner, name: str, witness: "LockWitness",
+                 contended, wait_hist):
+        self._inner = inner
+        self._name = name
+        self._witness = witness
+        self._contended = contended   # pre-resolved counter child
+        self._wait_hist = wait_hist   # pre-resolved histogram child
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if not blocking or timeout != -1:
+            if timeout != -1:
+                got = self._inner.acquire(blocking, timeout)
+            else:
+                got = self._inner.acquire(blocking)
+        else:
+            got = self._inner.acquire(False)
+            if not got:
+                self._contended.inc()
+                t0 = time.perf_counter()
+                got = self._inner.acquire()
+                self._wait_hist.observe(time.perf_counter() - t0)
+        if got:
+            self._witness.on_acquired(self._name)
+        return got
+
+    def release(self):
+        self._witness.on_released(self._name)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+
+class _CondProxy(_LockProxy):
+    """Condition proxy: wait/notify delegate to the wrapped condvar
+    (which owns the real lock, so ``wait`` still re-acquires it)."""
+
+    def wait(self, timeout: Optional[float] = None):
+        return self._inner.wait(timeout)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        return self._inner.wait_for(predicate, timeout)
+
+    def notify(self, n: int = 1):
+        return self._inner.notify(n)
+
+    def notify_all(self):
+        return self._inner.notify_all()
+
+
+def _closure(edges: Set[Tuple[str, str]]) -> Set[Tuple[str, str]]:
+    succ: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        succ.setdefault(a, set()).add(b)
+    out: Set[Tuple[str, str]] = set()
+    for start in succ:
+        seen: Set[str] = set()
+        stack = list(succ[start])
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(succ.get(cur, ()))
+        out.update((start, s) for s in seen)
+    return out
+
+
+def _find_cycles(edges: Set[Tuple[str, str]]) -> List[List[str]]:
+    """SCCs of size >= 2 (or self-loops) in the observed edge graph."""
+    from ..analysis.concurrency import _tarjan
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    cycles = [sorted(scc) for scc in _tarjan(graph) if len(scc) >= 2]
+    cycles += [[a] for a, b in edges if a == b]
+    return sorted(cycles)
+
+
+class LockWitness:
+    def __init__(self, artifact: Dict):
+        self._mu = threading.Lock()        # raw: guards witness state
+        self._tls = threading.local()
+        static_edges = {tuple(e) for e in artifact.get("edges", ())}
+        self.static_locks: Set[str] = set(artifact.get("locks", {}))
+        self.static_cycles = [list(c) for c in artifact.get("cycles", ())]
+        self._static_closure = _closure(static_edges) | static_edges
+        self.observed: Set[Tuple[str, str]] = set()
+        self.unmodeled: Set[Tuple[str, str]] = set()
+        self.acquire_count: Dict[str, int] = {}
+        # (owner, attr, original) for uninstall
+        self._wrapped: List[Tuple[object, str, object]] = []
+        self._pending: List[Tuple[str, object, str]] = []
+        self._fams = None
+
+    # -- registration --------------------------------------------------------
+    def enqueue(self, name: str, owner: object, attr: str) -> None:
+        with self._mu:
+            self._pending.append((name, owner, attr))
+
+    def _metric_children(self, name: str):
+        from . import metrics as m
+        if self._fams is None:
+            self._fams = (
+                m.counter("tpu_lock_contention_total",
+                          "Blocked acquisitions of witness-registered "
+                          "locks (csan lock witness).",
+                          labelnames=("lock",)),
+                m.histogram("tpu_lock_wait_seconds",
+                            "Blocking-acquire wait time on witness-"
+                            "registered locks (csan lock witness).",
+                            labelnames=("lock",),
+                            buckets=_WAIT_BUCKETS),
+            )
+        cont, wait = self._fams
+        return cont.labels(lock=name), wait.labels(lock=name)
+
+    def wrap(self, name: str, owner: object, attr: str) -> None:
+        """Swap ``owner.attr`` for a proxy.  Call from lock-free
+        context only (metric-series resolution takes registry locks)."""
+        cur = getattr(owner, attr, None)
+        if cur is None or isinstance(cur, _LockProxy):
+            return
+        cont, wait = self._metric_children(name)
+        if isinstance(cur, threading.Condition):
+            proxy = _CondProxy(cur, name, self, cont, wait)
+        elif hasattr(cur, "acquire") and hasattr(cur, "release"):
+            proxy = _LockProxy(cur, name, self, cont, wait)
+        else:
+            return
+        setattr(owner, attr, proxy)
+        self._wrapped.append((owner, attr, cur))
+
+    def refresh(self) -> None:
+        """Drain deferred registrations and wrap the engine's known
+        long-lived lock owners that exist right now."""
+        with self._mu:
+            pending, self._pending = self._pending, []
+        for name, owner, attr in pending:
+            self.wrap(name, owner, attr)
+        self._wrap_singletons()
+
+    def _wrap_singletons(self) -> None:
+        # Default witnessed set: the locks the serving path actually
+        # interleaves.  Classes are wrapped unconditionally; instance
+        # locks only when the singleton already exists (wrapping must
+        # not CREATE singletons as a side effect).
+        from . import metrics as m_mod
+        reg = m_mod.MetricsRegistry
+        self.wrap("obs.metrics.MetricsRegistry._ilock", reg, "_ilock")
+        if reg._instance is not None:
+            self.wrap("obs.metrics.MetricsRegistry._lock",
+                      reg._instance, "_lock")
+        from ..memory.admission import AdmissionController as AC
+        self.wrap("memory.admission.AdmissionController._ilock",
+                  AC, "_ilock")
+        if AC._instance is not None:
+            self.wrap("memory.admission.AdmissionController._cv",
+                      AC._instance, "_cv")
+        from ..memory.semaphore import TpuSemaphore
+        self.wrap("memory.semaphore.TpuSemaphore._lock",
+                  TpuSemaphore, "_lock")
+        if getattr(TpuSemaphore, "_instance", None) is not None:
+            self.wrap("memory.semaphore.TpuSemaphore._cv",
+                      TpuSemaphore._instance, "_cv")
+        from ..memory.spill import SpillCatalog
+        self.wrap("memory.spill.SpillCatalog._lock", SpillCatalog,
+                  "_lock")
+        if SpillCatalog._instance is not None:
+            self.wrap("memory.spill.SpillCatalog._reg_lock",
+                      SpillCatalog._instance, "_reg_lock")
+        from ..shuffle.manager import TpuShuffleManager
+        self.wrap("shuffle.manager.TpuShuffleManager._lock",
+                  TpuShuffleManager, "_lock")
+        inst = TpuShuffleManager._instance
+        if inst is not None:
+            self.wrap("shuffle.manager.TpuShuffleManager._comp_lock",
+                      inst, "_comp_lock")
+            self.wrap("shuffle.manager.ShuffleBufferCatalog._lock",
+                      inst.catalog, "_lock")
+
+    # -- the hot path --------------------------------------------------------
+    def _stack(self) -> List[str]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = []
+            self._tls.stack = st
+        return st
+
+    def on_acquired(self, name: str) -> None:
+        st = self._stack()
+        new_edges = [(h, name) for h in st if h != name]
+        st.append(name)
+        with self._mu:
+            self.acquire_count[name] = \
+                self.acquire_count.get(name, 0) + 1
+            for e in new_edges:
+                self.observed.add(e)
+                if e not in self._static_closure:
+                    self.unmodeled.add(e)
+
+    def on_released(self, name: str) -> None:
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] == name:
+                del st[i]
+                break
+
+    # -- verdict -------------------------------------------------------------
+    def report(self) -> Dict:
+        with self._mu:
+            observed = sorted(self.observed)
+            unmodeled = sorted(self.unmodeled)
+            counts = dict(self.acquire_count)
+        cycles = _find_cycles(set(observed))
+        return {
+            "locks_wrapped": sorted(
+                {w[0].__class__.__name__ + "." + w[1]
+                 for w in self._wrapped}),
+            "n_wrapped": len(self._wrapped),
+            "acquires": counts,
+            "edges": observed,
+            "unmodeled": unmodeled,
+            "cycles": cycles,
+            "ok": not unmodeled and not cycles,
+        }
+
+    def uninstall(self) -> None:
+        for owner, attr, original in reversed(self._wrapped):
+            cur = getattr(owner, attr, None)
+            if isinstance(cur, _LockProxy):
+                setattr(owner, attr, original)
+        self._wrapped.clear()
+
+
+# ---------------------------------------------------------------------------
+# module-level lifecycle (mirrors tracer/memsan install semantics)
+# ---------------------------------------------------------------------------
+
+_WITNESS: Optional[LockWitness] = None
+
+
+def install(artifact: Optional[Dict] = None) -> LockWitness:
+    """Install (or return) the process witness.  ``artifact`` defaults
+    to the repo's own static lock-order relation."""
+    global _WITNESS
+    if _WITNESS is None:
+        if artifact is None:
+            from ..analysis.concurrency import lock_order_artifact
+            artifact = lock_order_artifact()
+        _WITNESS = LockWitness(artifact)
+    _WITNESS.refresh()
+    return _WITNESS
+
+
+def ensure_installed() -> LockWitness:
+    return install()
+
+
+def get_witness() -> Optional[LockWitness]:
+    return _WITNESS
+
+
+def maybe_register(name: str, owner: object, attr: str) -> None:
+    """Deferred lock registration — safe to call while holding locks
+    (constructors do); a no-op unless the witness is installed."""
+    w = _WITNESS
+    if w is not None:
+        w.enqueue(name, owner, attr)
+
+
+def report() -> Optional[Dict]:
+    w = _WITNESS
+    return w.report() if w is not None else None
+
+
+def uninstall() -> None:
+    global _WITNESS
+    if _WITNESS is not None:
+        _WITNESS.uninstall()
+        _WITNESS = None
+
+
+def reset_for_tests() -> None:
+    uninstall()

@@ -230,6 +230,7 @@ class TpuShuffleManager:
         self.catalog = ShuffleBufferCatalog()
         self._ids = itertools.count()
         self._written: Dict[Tuple[int, int], bool] = {}
+        self._written_lock = threading.Lock()
         # per-shuffle (raw, encoded) payload byte totals, fed by every
         # transfer/spill serialization of this shuffle's blocks — the
         # per-shuffle compression ratio for spans and SUITE_JSON
@@ -262,7 +263,8 @@ class TpuShuffleManager:
         for reduce_id, batch in slices.items():
             self.catalog.add(ShuffleBlockId(shuffle_id, map_id, reduce_id),
                              batch)
-        self._written[(shuffle_id, map_id)] = True
+        with self._written_lock:
+            self._written[(shuffle_id, map_id)] = True
 
     def write_map_output_sorted(self, shuffle_id: int, map_id: int,
                                 sorted_batch: DeviceBatch,
@@ -272,10 +274,15 @@ class TpuShuffleManager:
         reduce partitions become lazy row-range views (the slice-view
         write path, spark.rapids.tpu.shuffle.sliceViews)."""
         self.catalog.add_sliced(shuffle_id, map_id, sorted_batch, layout)
-        self._written[(shuffle_id, map_id)] = True
+        with self._written_lock:
+            self._written[(shuffle_id, map_id)] = True
 
     def map_done(self, shuffle_id: int, map_id: int) -> bool:
-        return self._written.get((shuffle_id, map_id), False)
+        # map-completion flags are read by remote reduce readers while
+        # other map tasks are still publishing: the dict mutates under
+        # a reader's feet without this lock (tpucsan audit, PR 13)
+        with self._written_lock:
+            return self._written.get((shuffle_id, map_id), False)
 
     # -- read side ----------------------------------------------------------
     def read_partition(self, shuffle_id: int, reduce_id: int

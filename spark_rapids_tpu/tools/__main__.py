@@ -84,6 +84,27 @@ def _run_plan_lint(paths, infer=False, memsan=False):
     return 1 if any_error else 0
 
 
+def _run_lock_graph(output):
+    """Dump the tpucsan static lock-order artifact (the relation the
+    runtime lock witness validates against) as JSON."""
+    import json
+
+    from ..analysis.concurrency import lock_order_artifact
+
+    art = lock_order_artifact()
+    text = json.dumps(art, indent=2, sort_keys=True) + "\n"
+    if output:
+        with open(output, "w", encoding="utf-8") as f:
+            f.write(text)
+        sys.stdout.write(
+            f"lock graph: {len(art['locks'])} lock(s), "
+            f"{len(art['edges'])} edge(s), {len(art['cycles'])} "
+            f"cycle(s) -> {output}\n")
+    else:
+        sys.stdout.write(text)
+    return 1 if art["cycles"] else 0
+
+
 def _run_repo_lint(baseline_path, update):
     from ..analysis.diagnostics import format_diagnostics
     from ..analysis.repo_lint import (lint_repo, load_baseline,
@@ -279,6 +300,14 @@ def main(argv=None):
                          "(default: devtools/lint_baseline.txt)")
     li.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline from current violations")
+    li.add_argument("--lock-graph", action="store_true",
+                    help="dump the tpucsan static lock-order artifact "
+                         "(locks, acquisition edges, cycles, thread "
+                         "roots) as JSON; exits 1 if the graph has a "
+                         "cycle")
+    li.add_argument("-o", "--output", default=None,
+                    help="with --lock-graph: write the JSON here "
+                         "instead of stdout")
     rg = sub.add_parser("regress",
                         help="cross-run regression watchdog over "
                              "self-emitted event-log fingerprints")
@@ -372,6 +401,8 @@ def main(argv=None):
     elif args.cmd == "prewarm":
         return _run_prewarm(args.ledger, args.top, args.cache_dir)
     else:
+        if args.lock_graph:
+            return _run_lock_graph(args.output)
         if args.plan:
             return _run_plan_lint(args.plan, infer=args.infer,
                                   memsan=args.memsan)

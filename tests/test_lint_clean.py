@@ -161,6 +161,21 @@ def test_run_lint_serve_gate_exits_zero():
     assert "serve gate clean" in proc.stdout, proc.stdout
 
 
+def test_run_lint_csan_gate_exits_zero():
+    """Tier-1 gate for tpucsan: the concurrency repo pass (TPU-R008/
+    R009/R010) must be clean modulo the baseline, the ABBA/shared-write/
+    condvar fixtures must each trip (anti-vacuity), and the serve golden
+    mix must replay under the runtime lock witness with zero unmodeled
+    acquisition edges and zero observed lock-order cycles."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "devtools", "run_lint.py"),
+         "--csan"],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "csan gate clean" in proc.stdout, proc.stdout
+
+
 def test_run_lint_feedback_gate_exits_zero():
     """Tier-1 gate for the estimator observatory: the golden corpus
     replays cold (recording) then warm (feedback-blended) and the warm

@@ -27,6 +27,45 @@ def fresh_admission():
     TpuSemaphore._instance = prev_sem
 
 
+def run_with_watchdog(fn, timeout_s=120.0):
+    """Deadlock canary for the concurrency stress tests: run ``fn`` on
+    a daemon thread and, if it has not finished after ``timeout_s``,
+    dump EVERY live thread's stack and fail — a wedged lock interleaving
+    must produce a readable diagnosis, not hang CI until the job
+    timeout."""
+    import sys
+    import traceback
+
+    outcome = {}
+
+    def body():
+        try:
+            fn()
+            outcome["ok"] = True
+        except BaseException as ex:  # re-raised on the test thread
+            outcome["exc"] = ex
+
+    th = threading.Thread(target=body, name="watchdog-body", daemon=True)
+    th.start()
+    th.join(timeout_s)
+    if th.is_alive():
+        frames = sys._current_frames()
+        dump = []
+        for t in threading.enumerate():
+            fr = frames.get(t.ident)
+            if fr is None:
+                continue
+            dump.append(f"--- thread {t.name!r} "
+                        f"(daemon={t.daemon}, alive={t.is_alive()}) ---")
+            dump.extend(line.rstrip()
+                        for line in traceback.format_stack(fr))
+        pytest.fail(
+            f"watchdog expired after {timeout_s}s — probable deadlock; "
+            f"all thread stacks:\n" + "\n".join(dump), pytrace=False)
+    if "exc" in outcome:
+        raise outcome["exc"]
+
+
 # ---------------------------------------------------------------------------
 # AdmissionController unit behavior
 # ---------------------------------------------------------------------------
@@ -247,14 +286,19 @@ def test_eight_thread_mixed_query_stress(fresh_admission):
                 f"thread {i}"
         pool.run(work)
 
-    jobs = [(agg_worker if i % 2 == 0 else sort_worker, i)
-            for i in range(16)]
-    with cf.ThreadPoolExecutor(max_workers=8) as ex:
-        futs = [ex.submit(fn, i) for fn, i in jobs]
-        for f in futs:
-            f.result()  # re-raise any worker assertion
-    pool.drain(timeout=30)
-    pool.close()
+    def stress():
+        jobs = [(agg_worker if i % 2 == 0 else sort_worker, i)
+                for i in range(16)]
+        with cf.ThreadPoolExecutor(max_workers=8) as ex:
+            futs = [ex.submit(fn, i) for fn, i in jobs]
+            for f in futs:
+                f.result()  # re-raise any worker assertion
+        pool.drain(timeout=30)
+        pool.close()
+
+    # watchdog: a wedged admission/pool/metrics interleaving dumps
+    # all thread stacks instead of hanging the suite
+    run_with_watchdog(stress, timeout_s=300.0)
 
     delta = {nm: cval(nm) - base[nm] for nm in names}
     assert delta["tpu_memsan_dirty_ledgers_total"] == 0
@@ -272,6 +316,36 @@ def test_eight_thread_mixed_query_stress(fresh_admission):
                       labelnames=("tenant",))
     assert any(lbl["tenant"].startswith("pool-")
                for lbl, _ in fam.series())
+
+
+def test_pool_drain_under_watchdog(fresh_admission):
+    """drain() blocks until every borrowed session is returned, then
+    returns promptly — run under the deadlock canary so a broken
+    borrow/return/notify interleaving diagnoses itself."""
+    from spark_rapids_tpu.api.pool import SessionPool
+
+    pool = SessionPool(2, {"spark.rapids.sql.enabled": True})
+    release = threading.Event()
+    borrowed = threading.Event()
+
+    def hold():
+        with pool.session():
+            borrowed.set()
+            assert release.wait(30)
+
+    def scenario():
+        th = threading.Thread(target=hold, daemon=True)
+        th.start()
+        assert borrowed.wait(30)
+        # a borrow is outstanding: drain must NOT complete yet
+        with pytest.raises(TimeoutError):
+            pool.drain(timeout=0.2)
+        release.set()
+        th.join(30)
+        pool.drain(timeout=30)  # raises TimeoutError if it wedges
+        pool.close()
+
+    run_with_watchdog(scenario, timeout_s=120.0)
 
 
 def test_pool_binds_active_session_per_thread(fresh_admission):
