@@ -958,11 +958,17 @@ def _dist_fetch_join(parts: int, window: int):
 
 
 def _dist_run(rows: int, parts: int, codec: str, window: int,
-              seed: int) -> dict:
+              seed: int, traced: bool = False) -> dict:
     """One (codec, window) distributed run: child process owns the map
-    outputs and serves them; this process plays the reduce side."""
+    outputs and serves them; this process plays the reduce side.
+
+    ``traced=True`` installs a live tracer around the fetch/join, so
+    the run pays the full fleet-observatory path (fetch spans, the v2
+    context on the wire, the post-fetch /spans pulls + merge) and the
+    result carries ``_trace`` for the merged-trace report."""
     import subprocess
     from spark_rapids_tpu.obs import metrics as m
+    from spark_rapids_tpu.obs import tracer as tr
     from spark_rapids_tpu.shuffle.locality import reset_pool
     from spark_rapids_tpu.shuffle.registry import (BlockEndpoint,
                                                    BlockLocationRegistry)
@@ -972,10 +978,12 @@ def _dist_run(rows: int, parts: int, codec: str, window: int,
     child = subprocess.Popen(
         [sys.executable, "-m", "spark_rapids_tpu.shuffle.serve_map",
          "--rows", str(rows), "--parts", str(parts),
-         "--codec", codec, "--seed", str(seed)],
+         "--codec", codec, "--seed", str(seed),
+         "--executor-id", "bench-map-0"],
         stdin=subprocess.PIPE, stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL, text=True, env=env,
         cwd=os.path.dirname(os.path.abspath(__file__)))
+    trace = None
     try:
         line = child.stdout.readline()
         if not line.startswith("PORT "):
@@ -988,9 +996,14 @@ def _dist_run(rows: int, parts: int, codec: str, window: int,
         reg.register(DIM_SID, [ep])
         local_c = m.counter("tpu_shuffle_local_blocks_total")
         local_before = local_c.value()
+        if traced:
+            trace = tr.install(tr.QueryTrace())
         t0 = time.perf_counter()
         joined = _dist_fetch_join(parts, window)
         wall = time.perf_counter() - t0
+        if trace is not None:
+            trace.finalize()
+            tr.uninstall()
         local_after = local_c.value()
         child.stdin.write("done\n")
         child.stdin.flush()
@@ -1002,6 +1015,8 @@ def _dist_run(rows: int, parts: int, codec: str, window: int,
         if rc != 0:
             raise RuntimeError(f"serve_map exited {rc}")
     finally:
+        if trace is not None and tr.active_tracer() is trace:
+            tr.uninstall()
         child.stdin.close()
         child.stdout.close()
         if child.poll() is None:
@@ -1012,7 +1027,7 @@ def _dist_run(rows: int, parts: int, codec: str, window: int,
         BlockLocationRegistry.get().forget_shuffle(DIM_SID)
     raw = stats.get("raw_bytes") or 0
     comp = stats.get("compressed_bytes") or 0
-    return {
+    out = {
         "codec": codec,
         "window": window,
         "rows_joined": joined.num_rows,
@@ -1027,15 +1042,99 @@ def _dist_run(rows: int, parts: int, codec: str, window: int,
             "server_transfer_requests"),
         "child_leaked_blocks": stats.get("leaked_blocks"),
         "child_leaks": stats.get("leaks"),
+        "child_unpulled_spans": stats.get("unpulled_spans"),
         "parent_local_blocks": local_after - local_before,
         "_table": joined,
     }
+    if trace is not None:
+        out["_trace"] = trace
+    return out
 
 
-def measure_dist(rows: int, parts: int, seed: int) -> dict:
+def _dist_trace_report(trace, trace_out: str) -> tuple:
+    """Verify the merged trace's fleet shape and write it as ONE
+    Chrome/Perfetto JSON: every remote fetch span must carry the
+    producer's serve spans (metadata + transfer roots, with serialize
+    and compress step children under the transfers), skew-corrected
+    into the consumer's clock, with zero lost spans.  Returns
+    (report, failures)."""
+    from spark_rapids_tpu.obs.export import fleet_summary
+    failures = []
+    spans = trace.span_dicts()
+    by_parent = {}
+    for s in spans:
+        by_parent.setdefault(s.get("parentId"), []).append(s)
+    fetch = [s for s in spans if s["name"] == "shuffle.fetch"]
+    if not fetch:
+        failures.append("traced dist run recorded no fetch spans")
+    for f in fetch:
+        roots = [k for k in by_parent.get(f["spanId"], [])
+                 if k.get("proc")]
+        names = {r["name"] for r in roots}
+        if not {"shuffle.serve.metadata",
+                "shuffle.serve.transfer"} <= names:
+            failures.append(
+                f"fetch span {f['spanId']} lacks producer serve "
+                f"children (got {sorted(names)})")
+            continue
+        steps = {c["name"]
+                 for r in roots if r["name"] == "shuffle.serve.transfer"
+                 for c in by_parent.get(r["spanId"], [])}
+        if not {"serve.serialize", "serve.compress"} <= steps:
+            failures.append(
+                f"fetch span {f['spanId']} transfer lacks serialize/"
+                f"compress children (got {sorted(steps)})")
+        f0, f1 = f["startNs"], f["startNs"] + f["durNs"]
+        for r in roots:
+            if not (f0 <= r["startNs"]
+                    and r["startNs"] + r["durNs"] <= f1):
+                failures.append(
+                    f"remote span {r['name']} outside its fetch "
+                    f"parent — clock skew not corrected")
+    if trace.remote_spans_merged == 0:
+        failures.append("traced dist run merged zero remote spans")
+    if trace.remote_spans_lost:
+        failures.append(f"clean dist run lost "
+                        f"{trace.remote_spans_lost} remote span(s)")
+    with open(trace_out, "w") as f:
+        json.dump(trace.to_chrome(), f)
+    report = {
+        "trace_file": trace_out,
+        "fetch_spans": len(fetch),
+        "remote_spans_merged": trace.remote_spans_merged,
+        "remote_spans_lost": trace.remote_spans_lost,
+        "fleet": fleet_summary(spans),
+    }
+    return report, failures
+
+
+def measure_dist_trace_overhead(rows: int, parts: int,
+                                seed: int) -> float:
+    """Distributed flight-recorder overhead: the lz4/pipelined dist
+    run with the full fleet path on (fetch spans, wire contexts,
+    /spans pulls + merge) vs untraced.  Same <5% bar as the local
+    guard; each arm keeps its two-run noise floor."""
+    def floor(traced):
+        walls = []
+        for _ in range(2):
+            r = _dist_run(rows, parts, "lz4", 4, seed, traced=traced)
+            r.pop("_table", None)
+            r.pop("_trace", None)
+            walls.append(r["wall_s"])
+        return min(walls)
+
+    base = floor(False)
+    return 100.0 * (floor(True) - base) / base
+
+
+def measure_dist(rows: int, parts: int, seed: int,
+                 trace_out: str = "tpu_dist_trace.json") -> dict:
     """Full --dist sweep: none/lz4/zstd x pipelined/serial, each run
     bit-exact against the in-process reference, zero leaked blocks on
-    both sides, lz4 visibly compressing (ratio < 0.9)."""
+    both sides, lz4 visibly compressing (ratio < 0.9).  A final traced
+    lz4/pipelined run (outside the timing sweep) must merge the
+    producer's serve spans under every fetch span with zero lost
+    spans, and its clock-aligned Chrome trace lands in trace_out."""
     from spark_rapids_tpu.memory.spill import SpillCatalog
     from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
     reference = _dist_reference(rows, parts, seed)
@@ -1075,6 +1174,20 @@ def measure_dist(rows: int, parts: int, seed: int) -> dict:
             print("SUITE_JSON=" + json.dumps(
                 {"suite": f"dist_{codec}_{mode}",
                  **{k: v for k, v in r.items()}}))
+    traced = _dist_run(rows, parts, "lz4", 4, seed, traced=True)
+    traced_tbl = traced.pop("_table")
+    if not traced_tbl.equals(reference):
+        failures.append("traced lz4/pipelined run not bit-exact vs "
+                        "in-process reference")
+    trace_report, trace_failures = _dist_trace_report(
+        traced.pop("_trace"), trace_out)
+    failures.extend(trace_failures)
+    if traced.get("child_unpulled_spans"):
+        failures.append(
+            f"traced run left {traced['child_unpulled_spans']} span "
+            f"record(s) unpulled in the child's RemoteSpanStore")
+    print("SUITE_JSON=" + json.dumps(
+        {"suite": "dist_trace_merged", **trace_report}))
     parent_leaks = len(SpillCatalog.get().leak_report())
     if parent_leaks:
         failures.append(f"reduce side spill ledger reported "
@@ -1096,6 +1209,7 @@ def measure_dist(rows: int, parts: int, seed: int) -> dict:
         "pipelined_vs_serial_lz4": round(
             _wall("lz4", "serial") / max(_wall("lz4", "pipelined"),
                                          1e-9), 3),
+        "merged_trace": trace_report,
         "failures": failures,
     }
     return summary
@@ -1148,7 +1262,17 @@ def main():
         dist_rows = int(pos[0]) if pos else 20_000
         dist_parts = int(_arg_value("--parts", "4"))
         dist_seed = int(_arg_value("--seed", "7"))
-        summary = measure_dist(dist_rows, dist_parts, dist_seed)
+        trace_out = _arg_value("--trace-out", "tpu_dist_trace.json")
+        summary = measure_dist(dist_rows, dist_parts, dist_seed,
+                               trace_out=trace_out)
+        if "--trace-overhead" in sys.argv[1:]:
+            pct = measure_dist_trace_overhead(dist_rows, dist_parts,
+                                              dist_seed)
+            summary["dist_trace_overhead_pct"] = round(pct, 2)
+            if pct > 5.0:
+                summary["failures"].append(
+                    f"distributed tracing overhead {pct:.2f}% > 5% of "
+                    f"untraced fetch wall time")
         print(json.dumps(summary))
         for msg in summary["failures"]:
             print(f"DIST GUARD FAILED: {msg}", file=sys.stderr)

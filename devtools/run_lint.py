@@ -86,6 +86,19 @@ boundary must trigger a recorded re-plan whose three sinks (replan
 span, tpu_replan_total, ledger event) agree, with the join result
 bit-exact against the CPU-engine ground truth.
 
+--fleet runs the fleet-observatory gate: TWO serve_map child processes
+serve the join sides over loopback while this process fetches with a
+live tracer and a FleetAggregator over both peers' /metrics — the
+golden cross-process join must be bit-exact, the merged trace must
+contain each producer's serve spans nested under the consumer's fetch
+spans (skew-corrected, zero lost spans, producer buffers fully
+drained), the aggregator must expose rollup series for both peers with
+an ok verdict, and an injected peer death (child killed mid-fleet)
+must flip the verdict to degraded AND surface the orphan-span counter
+with the dead peer's fetch span closed typed — anti-vacuity both ways:
+the clean half must actually merge spans, the degraded half must
+actually degrade.
+
     python devtools/run_lint.py                    # repo check
     python devtools/run_lint.py --update-baseline  # re-freeze debt
     python devtools/run_lint.py --interp           # plan typechecker gate
@@ -97,6 +110,7 @@ bit-exact against the CPU-engine ground truth.
     python devtools/run_lint.py --shuffle          # distributed-shuffle gate
     python devtools/run_lint.py --csan             # concurrency-sanitizer gate
     python devtools/run_lint.py --feedback         # estimator-observatory gate
+    python devtools/run_lint.py --fleet            # fleet-observatory gate
 """
 
 import json
@@ -935,9 +949,9 @@ def _shuffle_wire_leg() -> int:
     from spark_rapids_tpu.shuffle.registry import (BlockEndpoint,
                                                    BlockLocationRegistry)
     from spark_rapids_tpu.shuffle.transport import (
-        _FRAME, _recv_exact, MSG_BUFFER, MSG_METADATA_RESP,
-        AsyncBlockFetcher, ShuffleClient, ShuffleServer,
-        _server_requests_counter)
+        _FRAME, _recv_exact, MSG_BUFFER, MSG_ERROR, MSG_HELLO,
+        MSG_METADATA_RESP, AsyncBlockFetcher, ShuffleClient,
+        ShuffleServer, _server_requests_counter)
 
     failures = 0
     errs = m.counter("tpu_shuffle_fetch_errors_total",
@@ -969,11 +983,19 @@ def _shuffle_wire_leg() -> int:
         return port
 
     def read_req(conn):
-        head = _recv_exact(conn, _FRAME.size)
-        mtype, rid, blen = _FRAME.unpack(head)
-        if blen:
-            _recv_exact(conn, blen)
-        return mtype, rid
+        while True:
+            head = _recv_exact(conn, _FRAME.size)
+            mtype, rid, blen = _FRAME.unpack(head)
+            if blen:
+                _recv_exact(conn, blen)
+            if mtype == MSG_HELLO:
+                # pre-fleet peer: the correlated bad_message refusal
+                # pins the client to v1 framing, so the scripted
+                # request arrives next in the shape read above
+                eb = b"bad_message:unknown message type"
+                conn.sendall(_FRAME.pack(MSG_ERROR, rid, len(eb)) + eb)
+                continue
+            return mtype, rid
 
     def expect(name, port, exc_type, kind, window=2):
         """Drive one fetch against the rogue at ``port``; it must raise
@@ -1899,6 +1921,247 @@ def run_feedback_gate() -> int:
     return 0
 
 
+def run_fleet_gate() -> int:
+    """Fleet-observatory gate: two real peer processes, one merged
+    trace, one aggregator — then a peer dies and everything that must
+    notice does.  See the module docstring for the full contract."""
+    import subprocess
+
+    import pyarrow as pa
+
+    import spark_rapids_tpu.obs.metrics as m
+    from spark_rapids_tpu.columnar.device import batch_to_arrow
+    from spark_rapids_tpu.obs import tracer as tr
+    from spark_rapids_tpu.obs.fleet import (ClockSync, FleetAggregator,
+                                            RemoteSpanStore,
+                                            install_aggregator)
+    from spark_rapids_tpu.obs.health import HealthMonitor
+    from spark_rapids_tpu.shuffle import locality
+    from spark_rapids_tpu.shuffle.heartbeat import HeartbeatManager
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+    from spark_rapids_tpu.shuffle.registry import (BlockEndpoint,
+                                                   BlockLocationRegistry)
+    from spark_rapids_tpu.shuffle.serve_map import (
+        DIM_SID, FACT_SID, build_side_tables, partition_record_batch)
+
+    failures = 0
+    rows, parts, seed = 6000, 3, 23
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SPARK_RAPIDS_TPU_DISABLE_COMPILE_CACHE="1")
+
+    def spawn(name):
+        return subprocess.Popen(
+            [sys.executable, "-m",
+             "spark_rapids_tpu.shuffle.serve_map",
+             "--rows", str(rows), "--parts", str(parts),
+             "--codec", "lz4", "--seed", str(seed),
+             "--executor-id", name],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, env=env, cwd=REPO)
+
+    def reset_all():
+        tr.uninstall()
+        install_aggregator(None)
+        locality.reset_pool()
+        BlockLocationRegistry.reset()
+        TpuShuffleManager.reset()
+        RemoteSpanStore.reset()
+        ClockSync.reset()
+        m.MetricsRegistry.reset_for_tests()
+
+    reset_all()
+    # one peer owns the fact side, the other the dim side: every fetch
+    # of the golden join exercises BOTH peers' serve paths
+    children = {"peer-a": spawn("peer-a"), "peer-b": spawn("peer-b")}
+    stats_a = None
+    try:
+        ports = {}
+        for name, child in children.items():
+            fields = child.stdout.readline().split()
+            if len(fields) < 4 or fields[0] != "PORT" \
+                    or fields[2] != "OBS":
+                print(f"FLEET: {name} announced no PORT/OBS line")
+                return 1
+            ports[name] = (int(fields[1]), int(fields[3]))
+        reg = BlockLocationRegistry.get()
+        reg.set_local("driver", "127.0.0.1", 0)
+        hb = HeartbeatManager(timeout_s=30.0)
+        for name, (port, obs_port) in ports.items():
+            reg.register(FACT_SID if name == "peer-a" else DIM_SID,
+                         [BlockEndpoint(name, "127.0.0.1", port)])
+            hb.register_executor(name, "127.0.0.1", port,
+                                 obs_port=obs_port)
+        reg.attach_heartbeat(hb)
+        agg = install_aggregator(FleetAggregator(hb, max_peers=4,
+                                                 timeout_s=5.0))
+
+        # -- clean half: golden cross-process join under a live tracer
+        trace = tr.install(tr.QueryTrace())
+        out = []
+        for pid in range(parts):
+            sides = []
+            for sid in (FACT_SID, DIM_SID):
+                rbs = [batch_to_arrow(b) for b in
+                       locality.read_reduce_blocks(sid, pid)]
+                sides.append(pa.Table.from_batches(rbs) if rbs else None)
+            if sides[0] is not None and sides[1] is not None:
+                out.append(sides[0].join(sides[1], "k"))
+        got = pa.concat_tables(out).sort_by(
+            [("k", "ascending"), ("v", "ascending")])
+        trace.finalize()
+        tr.uninstall()
+        fact, dim = build_side_tables(rows, seed)
+        fparts = partition_record_batch(fact, "k", parts)
+        dparts = partition_record_batch(dim, "k", parts)
+        ref = pa.concat_tables(
+            [pa.table(fparts[p]).join(pa.table(dparts[p]), "k")
+             for p in range(parts) if p in fparts and p in dparts]
+        ).sort_by([("k", "ascending"), ("v", "ascending")])
+        if not got.equals(ref):
+            failures += 1
+            print("FLEET: cross-process join diverged from the "
+                  "in-process reference")
+
+        spans = trace.span_dicts()
+        by_parent = {}
+        for s in spans:
+            by_parent.setdefault(s.get("parentId"), []).append(s)
+        fetch = [s for s in spans if s["name"] == "shuffle.fetch"]
+        bad_fetch = 0
+        for f in fetch:
+            kids = by_parent.get(f["spanId"], [])
+            roots = [k for k in kids
+                     if k.get("proc") == f["attrs"].get("peer")]
+            names = {k["name"] for k in roots}
+            f0, f1 = f["startNs"], f["startNs"] + f["durNs"]
+            ok = {"shuffle.serve.metadata",
+                  "shuffle.serve.transfer"} <= names
+            for r in roots:
+                ok = ok and f0 <= r["startNs"] \
+                    and r["startNs"] + r["durNs"] <= f1
+            if not ok:
+                bad_fetch += 1
+        if bad_fetch:
+            failures += 1
+            print(f"FLEET: {bad_fetch}/{len(fetch)} fetch span(s) "
+                  f"missing nested producer serve spans (or spans "
+                  f"outside the parent interval)")
+        procs = {s.get("proc") for s in spans if s.get("proc")}
+        # anti-vacuity, clean direction: the merge must have HAPPENED,
+        # for both peers, with zero losses
+        if trace.remote_spans_merged == 0 or procs != set(children):
+            failures += 1
+            print(f"FLEET: vacuous merge — {trace.remote_spans_merged} "
+                  f"remote span(s) from peers {sorted(procs)}")
+        lost_clean = m.counter(
+            "tpu_trace_remote_spans_lost_total").value()
+        if trace.remote_spans_lost or lost_clean:
+            failures += 1
+            print(f"FLEET: clean run lost {trace.remote_spans_lost} "
+                  f"remote span(s) (counter {lost_clean})")
+
+        peers = agg.scrape()
+        scraped = [p for p, e in peers.items() if e.get("scraped")]
+        if sorted(scraped) != sorted(children):
+            failures += 1
+            print(f"FLEET: aggregator scraped {sorted(scraped)}, "
+                  f"wanted both of {sorted(children)}")
+        rollup = m.gauge("tpu_fleet_rollup",
+                         labelnames=("peer", "name"))
+        for name in children:
+            served = rollup.value(
+                peer=name, name="tpu_shuffle_server_requests_total")
+            if not served:
+                failures += 1
+                print(f"FLEET: no rollup series shows {name} serving "
+                      f"requests")
+        verdict_clean = agg.verdict(scrape_first=False)["status"]
+        if verdict_clean != "ok":
+            failures += 1
+            print(f"FLEET: clean fleet verdict is {verdict_clean}")
+
+        # -- degraded half: kill peer-b mid-fleet, fetch into the hole
+        children["peer-b"].kill()
+        children["peer-b"].wait()
+        trace2 = tr.install(tr.QueryTrace())
+        try:
+            list(locality.read_reduce_blocks(DIM_SID, 0))
+            failures += 1
+            print("FLEET: fetch against the killed peer succeeded")
+        except Exception:
+            pass
+        trace2.finalize()
+        tr.uninstall()
+        lost_spans = [s for s in trace2.span_dicts()
+                      if s["name"] == "shuffle.fetch"
+                      and s["attrs"].get("spans_lost")]
+        lost_total = m.counter(
+            "tpu_trace_remote_spans_lost_total").value()
+        # anti-vacuity, degraded direction: the orphan path must fire
+        if not lost_spans or lost_total <= lost_clean:
+            failures += 1
+            print(f"FLEET: peer death surfaced no orphan spans "
+                  f"({len(lost_spans)} annotated, counter "
+                  f"{lost_total})")
+        if any(s["status"] != "error" for s in lost_spans):
+            failures += 1
+            print("FLEET: a spans_lost fetch span is not closed typed")
+        # the children never run a heartbeat loop; a dead process is
+        # silence, which expiry models as a stale last-heartbeat stamp
+        hb._peers["peer-b"].last_heartbeat -= hb.timeout_s + 1
+        verdict = agg.verdict()
+        if verdict["status"] != "degraded" or not any(
+                "peer-b" in r for r in verdict["reasons"]):
+            failures += 1
+            print(f"FLEET: dead peer left verdict {verdict['status']} "
+                  f"(reasons {verdict['reasons']})")
+        snap = HealthMonitor().snapshot()
+        if snap["status"] != "degraded" or \
+                snap["components"].get("fleet", {}).get("status") \
+                != "degraded":
+            failures += 1
+            print(f"FLEET: /healthz does not carry the degraded fleet "
+                  f"verdict (status {snap['status']})")
+
+        # peer-a shuts down clean: its span buffer must be fully
+        # drained (every serve span came home in the merged trace)
+        children["peer-a"].stdin.write("done\n")
+        children["peer-a"].stdin.flush()
+        stats_line = children["peer-a"].stdout.readline()
+        stats_a = json.loads(stats_line[len("STATS "):]) \
+            if stats_line.startswith("STATS ") else None
+        if stats_a is None or stats_a.get("unpulled_spans") != 0:
+            failures += 1
+            print(f"FLEET: peer-a left serve spans unpulled "
+                  f"({stats_a and stats_a.get('unpulled_spans')})")
+        if stats_a is not None and stats_a.get("leaked_blocks"):
+            failures += 1
+            print(f"FLEET: peer-a leaked "
+                  f"{stats_a['leaked_blocks']} block(s)")
+    finally:
+        for child in children.values():
+            try:
+                child.stdin.close()
+                child.stdout.close()
+            except OSError:
+                pass
+            if child.poll() is None:
+                child.kill()
+                child.wait()
+        reset_all()
+    if failures:
+        print(f"fleet gate: {failures} failure(s)")
+        return 1
+    print(f"fleet gate clean (cross-process join bit-exact over "
+          f"{parts} partitions x 2 peers; merged trace carries "
+          f"{len(fetch)} fetch spans with producer serve spans nested "
+          f"and zero lost; rollup + ok verdict for both peers; killed "
+          f"peer degraded the fleet verdict and /healthz and counted "
+          f"{int(lost_total)} orphaned span record(s); peer-a drained "
+          f"clean)")
+    return 0
+
+
 def main(argv=None):
     args = argv if argv is not None else sys.argv[1:]
     if "--interp" in args:
@@ -1921,6 +2184,8 @@ def main(argv=None):
         return run_csan_gate()
     if "--feedback" in args:
         return run_feedback_gate()
+    if "--fleet" in args:
+        return run_fleet_gate()
     from spark_rapids_tpu.tools.__main__ import main as tools_main
     cli = ["lint", "--repo", "--baseline", BASELINE]
     if "--update-baseline" in args:

@@ -61,6 +61,12 @@ class TpuSession:
             self.metrics_server = ensure_server(port)
         else:
             self.metrics_server = None
+        # fleet observatory bounds: size the producer-side serve-span
+        # buffer the /spans endpoint drains
+        from ..obs.fleet import RemoteSpanStore
+        RemoteSpanStore.get().configure(
+            conf.get(cfg.FLEET_SPANS_MAX_TRACES),
+            conf.get(cfg.FLEET_SPANS_MAX_PER_TRACE))
         # compile observatory: every XLA build at the process_jit seam
         # gets split timing, a classified cause and (with a ledger dir)
         # cross-session persistence (obs/compileprof.py)

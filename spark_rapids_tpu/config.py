@@ -756,6 +756,65 @@ METRICS_PORT = conf("spark.rapids.tpu.metrics.port").integer() \
          "not).") \
     .create_optional()
 
+# --- fleet observatory (cross-process tracing + peer aggregation) ----------
+
+FLEET_PROPAGATION_ENABLED = conf(
+    "spark.rapids.tpu.fleet.propagation.enabled").boolean() \
+    .doc("Thread the active query's (trace_id, span_id, tenant) context "
+         "through the shuffle wire protocol (the v2 frame-header "
+         "extension) so block servers record their serve/serialize/"
+         "compress spans under the requesting fetch span, and pull "
+         "those spans back over the producer's /spans endpoint after "
+         "each remote fetch.  Pre-v2 peers degrade silently to "
+         "uncorrelated v1 traffic; a failed pull closes the fetch span "
+         "with a spans_lost annotation (counted in "
+         "tpu_trace_remote_spans_lost_total), never a hang.") \
+    .create_with_default(True)
+
+FLEET_SPANS_MAX_TRACES = conf(
+    "spark.rapids.tpu.fleet.spans.maxTraces").integer() \
+    .doc("Bound on distinct trace buckets the producer-side "
+         "RemoteSpanStore holds awaiting /spans pulls; past it the "
+         "oldest trace is evicted (an abandoned consumer must not pin "
+         "producer memory).") \
+    .check(lambda v: v >= 1, "must be >= 1") \
+    .create_with_default(64)
+
+FLEET_SPANS_MAX_PER_TRACE = conf(
+    "spark.rapids.tpu.fleet.spans.maxPerTrace").integer() \
+    .doc("Bound on buffered serve spans per trace in the producer-side "
+         "RemoteSpanStore; past it new spans are dropped and counted "
+         "in tpu_trace_remote_spans_dropped_total.") \
+    .check(lambda v: v >= 1, "must be >= 1") \
+    .create_with_default(512)
+
+FLEET_AGGREGATOR_ENABLED = conf(
+    "spark.rapids.tpu.fleet.aggregator.enabled").boolean() \
+    .doc("On the driver, walk the heartbeat peer registry and scrape "
+         "each live peer's /metrics + /healthz into cluster-rollup "
+         "series (tpu_fleet_rollup{peer,name}, tpu_fleet_peer_up) and "
+         "a fleet health verdict (any dead, unreachable or unhealthy "
+         "peer degrades /healthz).  Requires executors to advertise an "
+         "obs port at registration.") \
+    .create_with_default(True)
+
+FLEET_SCRAPE_MAX_PEERS = conf(
+    "spark.rapids.tpu.fleet.scrape.maxPeers").integer() \
+    .doc("Cardinality cap on the aggregator's peer label: at most this "
+         "many peers are scraped per round; excess live peers are "
+         "counted in tpu_fleet_peers_skipped_total instead of labeled "
+         "(the registry's own series cap backstops it).") \
+    .check(lambda v: v >= 1, "must be >= 1") \
+    .create_with_default(16)
+
+FLEET_SCRAPE_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.fleet.scrape.timeoutMs").integer() \
+    .doc("Per-peer HTTP timeout for aggregator scrapes and post-fetch "
+         "/spans pulls.  A pull that exceeds it counts the fetch's "
+         "producer spans as lost rather than stalling the read path.") \
+    .check(lambda v: v >= 1, "must be >= 1") \
+    .create_with_default(2000)
+
 REGRESS_HISTORY_DIR = conf("spark.rapids.tpu.regress.historyDir") \
     .string() \
     .doc("Append-only directory of per-run query fingerprints for the "

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import io
 import struct
+import time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -61,27 +62,38 @@ def serialize_batch(batch: DeviceBatch,
 
 
 def serialize_batch_with_sizes(batch: DeviceBatch,
-                               codec: Optional[int] = None
+                               codec: Optional[int] = None,
+                               timings: Optional[dict] = None
                                ) -> Tuple[bytes, int, int]:
     """serialize_batch plus the (raw, encoded) body sizes, so callers
     (shuffle server, spill tiers) can account compression per payload
     without re-measuring.  Every serialized byte is metered into
     tpu_shuffle_{raw,compressed}_bytes_total{codec} here — the single
-    choke point both shuffle transport and spill stage through."""
+    choke point both shuffle transport and spill stage through.
+
+    ``timings`` (when given) receives ``serialize_ns``/``compress_ns``
+    so the shuffle server can attribute its serve histogram to the
+    arrow-encode vs codec halves without a second clock around this
+    call."""
     if codec is None:
         codec = _default_codec
+    t0 = time.perf_counter_ns()
     rb = batch_to_arrow(batch)
     sink = io.BytesIO()
     with pa.ipc.new_stream(sink, rb.schema) as w:
         w.write_batch(rb)
     body = sink.getvalue()
     raw_len = len(body)
+    t1 = time.perf_counter_ns()
     if codec == CODEC_LZ4:
         from ..native import codec as ncodec
         body = ncodec.lz4_compress(body)
     elif codec == CODEC_ZSTD:
         from ..native import codec as ncodec
         body = ncodec.zstd_compress(body)
+    if timings is not None:
+        timings["serialize_ns"] = t1 - t0
+        timings["compress_ns"] = time.perf_counter_ns() - t1
     head = _HEADER.pack(MAGIC, VERSION, codec, int(batch.num_rows),
                         len(body))
     from ..obs import metrics as m

@@ -15,19 +15,37 @@ def spans_to_chrome(span_dicts: List[Dict[str, Any]],
                     process_name: str = "spark_rapids_tpu") -> Dict:
     """Chrome trace-event JSON (chrome://tracing / Perfetto): complete
     "X" events for intervals, instant "i" events, ts/dur in
-    microseconds relative to query start."""
+    microseconds relative to query start.
+
+    Spans carrying a ``proc`` (merged remote spans — obs/fleet.py) get
+    their own Chrome PROCESS lane per producer, so the one merged
+    timeline shows the consumer and each peer side by side.  Chrome
+    "pid" here is a lane id, NOT the span-dict "pid" field (that one is
+    the partition id and stays in args)."""
     events: List[Dict[str, Any]] = [
         {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
          "args": {"name": process_name}},
     ]
+    lanes: Dict[str, int] = {}
     for s in span_dicts:
         args = dict(s.get("attrs") or {})
         args["status"] = s.get("status", "")
         for k in ("rows", "bytes", "batches", "error", "pid"):
             if s.get(k) not in (None, "", 0):
                 args[k] = s[k]
+        proc = s.get("proc")
+        lane = 0
+        if proc:
+            lane = lanes.get(proc)
+            if lane is None:
+                lane = len(lanes) + 1
+                lanes[proc] = lane
+                events.append({"name": "process_name", "ph": "M",
+                               "pid": lane, "tid": 0,
+                               "args": {"name": str(proc)}})
+            args["proc"] = proc
         base = {"name": s["name"], "cat": s.get("kind", "span"),
-                "pid": 0, "tid": s.get("tid", 0),
+                "pid": lane, "tid": s.get("tid", 0),
                 "ts": s["startNs"] / 1000.0, "args": args}
         if s.get("kind") == "event" or not s.get("durNs"):
             events.append({**base, "ph": "i", "s": "t"})
@@ -67,6 +85,67 @@ def spans_to_text(span_dicts: List[Dict[str, Any]]) -> str:
 
     for r in sorted(roots, key=lambda s: s["startNs"]):
         emit(r, 0)
+    return "\n".join(lines) + "\n"
+
+
+def fleet_summary(span_dicts: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Per-peer wire vs serve vs compute decomposition of one merged
+    trace (the ``tools fleet`` report).
+
+    For every ``shuffle.fetch`` span: the peer's SERVE time is the
+    merged remote serve roots under it (spans carrying ``proc``), and
+    WIRE time is the remainder of the fetch — what the network and the
+    fetch pipeline cost beyond the producer's own work.  COMPUTE is the
+    query total minus all fetch time (local execution)."""
+    by_parent: Dict[Optional[int], List[Dict]] = {}
+    for s in span_dicts:
+        by_parent.setdefault(s.get("parentId"), []).append(s)
+    peers: Dict[str, Dict[str, Any]] = {}
+    fetch_total = 0
+    for s in span_dicts:
+        if s.get("name") != "shuffle.fetch":
+            continue
+        attrs = s.get("attrs") or {}
+        peer = str(attrs.get("peer", "?"))
+        e = peers.setdefault(peer, {
+            "fetches": 0, "fetchNs": 0, "serveNs": 0,
+            "remoteSpans": 0, "spansLost": 0})
+        e["fetches"] += 1
+        e["fetchNs"] += int(s.get("durNs") or 0)
+        fetch_total += int(s.get("durNs") or 0)
+        if attrs.get("spans_lost"):
+            e["spansLost"] += 1
+        for c in by_parent.get(s.get("spanId"), []):
+            if c.get("proc"):
+                e["serveNs"] += int(c.get("durNs") or 0)
+                e["remoteSpans"] += 1 + len(
+                    by_parent.get(c.get("spanId"), []))
+    for e in peers.values():
+        e["wireNs"] = max(e["fetchNs"] - e["serveNs"], 0)
+    query = next((s for s in span_dicts if s.get("kind") == "query"),
+                 None)
+    total = int(query.get("durNs") or 0) if query else fetch_total
+    return {"peers": peers, "queryNs": total,
+            "computeNs": max(total - fetch_total, 0)}
+
+
+def format_fleet_summary(summary: Dict[str, Any]) -> str:
+    """Text rendering of ``fleet_summary`` for the CLI."""
+    lines = ["### Fleet: per-peer wire vs serve time ###",
+             f"{'peer':20s} {'fetches':>8s} {'fetch ms':>10s} "
+             f"{'serve ms':>10s} {'wire ms':>10s} {'spans':>6s} "
+             f"{'lost':>5s}"]
+    for peer, e in sorted(summary.get("peers", {}).items()):
+        lines.append(
+            f"{peer[:20]:20s} {e['fetches']:>8d} "
+            f"{e['fetchNs'] / 1e6:>10.3f} {e['serveNs'] / 1e6:>10.3f} "
+            f"{e['wireNs'] / 1e6:>10.3f} {e['remoteSpans']:>6d} "
+            f"{e['spansLost']:>5d}")
+    if not summary.get("peers"):
+        lines.append("(no remote fetch spans in this trace)")
+    lines.append(f"query total {summary.get('queryNs', 0) / 1e6:.3f}ms, "
+                 f"local compute "
+                 f"{summary.get('computeNs', 0) / 1e6:.3f}ms")
     return "\n".join(lines) + "\n"
 
 

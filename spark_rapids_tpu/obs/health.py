@@ -189,6 +189,24 @@ class HealthMonitor:
         dev["signals"]["tpu_device_probe_ok"] = probe_ok
         if probe_ok is not None and probe_ok == 0:
             dev["status"] = DOWN
+        # fleet verdict (driver only — where an aggregator is
+        # installed): any dead peer degrades the whole endpoint, so a
+        # cluster probe pointed at the driver sees executor loss
+        from .fleet import installed_aggregator
+        agg = installed_aggregator()
+        if agg is not None:
+            try:
+                verdict = agg.verdict(scrape_first=False)
+            except Exception:
+                verdict = None
+            if verdict is not None:
+                fc = components.setdefault(
+                    "fleet", {"status": OK, "signals": {}})
+                fc["signals"]["peers"] = verdict.get("peers")
+                fc["signals"]["reasons"] = verdict.get("reasons")
+                if verdict.get("status") != OK and \
+                        _SEVERITY[DEGRADED] > _SEVERITY[fc["status"]]:
+                    fc["status"] = DEGRADED
         for entry in components.values():
             if _SEVERITY[entry["status"]] > _SEVERITY[status]:
                 status = entry["status"]
@@ -231,10 +249,27 @@ class MetricsServer:
         class _Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib contract)
                 if self.path.startswith("/metrics"):
+                    from .fleet import fleet_refresh
+                    fleet_refresh()
                     body = render_prometheus(registry).encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif self.path.startswith("/healthz"):
+                    from .fleet import fleet_refresh
+                    fleet_refresh()
                     body = json.dumps(monitor.snapshot()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/spans"):
+                    # the fleet pull endpoint: a consumer that carried a
+                    # trace context to this process collects the serve
+                    # spans recorded under it.  drain=1 (the default)
+                    # pops — a retried fetch group never double-merges.
+                    from urllib.parse import parse_qs, urlparse
+                    from .fleet import RemoteSpanStore
+                    q = parse_qs(urlparse(self.path).query)
+                    trace_id = (q.get("trace_id") or [None])[0]
+                    drain = (q.get("drain") or ["1"])[0] != "0"
+                    body = RemoteSpanStore.get().to_json(
+                        trace_id, drain=drain).encode()
                     ctype = "application/json"
                 else:
                     self.send_response(404)

@@ -48,7 +48,7 @@ class Span:
 
     __slots__ = ("span_id", "parent_id", "name", "kind", "t0_ns", "t1_ns",
                  "tid", "status", "error", "attrs", "node_id", "pid",
-                 "rows", "bytes", "batches")
+                 "rows", "bytes", "batches", "proc")
 
     def __init__(self, span_id: int, parent_id: Optional[int], name: str,
                  kind: str, t0_ns: int, tid: int,
@@ -70,6 +70,10 @@ class Span:
         self.rows = 0
         self.bytes = 0
         self.batches = 0
+        # producing process for merged remote spans (executor id or
+        # "server:<port>"); None = this process.  NOT `pid` — that slot
+        # is the PARTITION id.
+        self.proc: Optional[str] = None
 
     @property
     def dur_ns(self) -> int:
@@ -119,6 +123,13 @@ class QueryTrace:
         self.actuals: Dict[int, Dict[str, Any]] = {}
         self.measured_peak_device_bytes: Optional[int] = None
         self.static_peak_bound: Optional[float] = None
+        # fleet identity: travels inside the shuffle wire's v2 trace
+        # context so producer-side serve spans can be pulled back and
+        # grafted under this trace's fetch spans
+        from .fleet import new_trace_id
+        self.trace_id = new_trace_id()
+        self.remote_spans_merged = 0
+        self.remote_spans_lost = 0
         self.root_id = self.start("query", QUERY)
 
     # -- parent stack (per thread) ------------------------------------------
@@ -264,6 +275,86 @@ class QueryTrace:
             except Exception:
                 pass
 
+    # -- fleet merge ---------------------------------------------------------
+    def add_remote_spans(self, parent_sid: Optional[int],
+                         remote_spans: List[Dict[str, Any]],
+                         offset_ns: int = 0, proc: str = "") -> int:
+        """Graft producer-side span dicts (the /spans pull schema:
+        spanId/parentId/remoteParent/name/t0Ns/t1Ns/status/proc/attrs,
+        timestamps in the PRODUCER's perf_counter_ns domain) under the
+        local fetch span ``parent_sid``.
+
+        Remote clocks convert by ``t_local = t_peer - offset_ns`` (the
+        hello handshake's NTP estimate), then clamp into the parent
+        interval: the offset carries up to rtt/2 of error, and a child
+        that leaks outside its parent would break every downstream
+        renderer's nesting invariant — a clamped edge is the honest
+        rendering of "within this fetch, at clock precision".
+
+        Returns the number merged (counted into
+        tpu_trace_remote_spans_merged_total)."""
+        if not remote_spans:
+            return 0
+        merged = 0
+        with self._lock:
+            if self.sealed:
+                return 0
+            parent = self._by_id.get(parent_sid) if parent_sid else None
+            if parent is None:
+                return 0
+            p0 = parent.t0_ns
+            p1 = parent.t1_ns
+            id_map: Dict[Any, int] = {}
+            grafted: List[tuple] = []
+            for rs in remote_spans:
+                if len(self.spans) + len(grafted) >= self.max_spans:
+                    self.dropped += 1
+                    continue
+                try:
+                    rt0 = int(rs["t0Ns"]) - offset_ns
+                    rt1 = int(rs["t1Ns"]) - offset_ns
+                except (KeyError, TypeError, ValueError):
+                    continue
+                sid = next(self._ids)
+                id_map[rs.get("spanId")] = sid
+                grafted.append((sid, rs, rt0, rt1))
+            for sid, rs, rt0, rt1 in grafted:
+                if rt1 < rt0:
+                    rt1 = rt0
+                rt0 = max(rt0, p0)
+                if p1 is not None:
+                    rt0 = min(rt0, p1)
+                    rt1 = min(rt1, p1)
+                rt1 = max(rt1, rt0)
+                if rs.get("remoteParent"):
+                    rparent = parent_sid
+                else:
+                    rparent = id_map.get(rs.get("parentId"), parent_sid)
+                sp = Span(sid, rparent, str(rs.get("name", "remote")),
+                          SPAN, rt0, threading.get_ident(),
+                          attrs=dict(rs.get("attrs") or {}))
+                sp.t1_ns = rt1
+                sp.status = str(rs.get("status", "ok"))
+                if rs.get("error"):
+                    sp.error = str(rs["error"])
+                sp.proc = str(rs.get("proc") or proc or "remote")
+                self.spans.append(sp)
+                self._by_id[sid] = sp
+                merged += 1
+            self.remote_spans_merged += merged
+        if merged:
+            from .fleet import remote_merged_counter
+            remote_merged_counter().inc(merged)
+        return merged
+
+    def note_remote_spans_lost(self, n: int = 1) -> None:
+        """Producer spans that should have merged but never arrived
+        (peer died mid-fetch / /spans pull failed); counted into
+        tpu_trace_remote_spans_lost_total by the caller's orphan
+        hygiene path."""
+        with self._lock:
+            self.remote_spans_lost += int(n)
+
     # -- failure / end of query ---------------------------------------------
     def interrupt(self, reason: str) -> None:
         """Close every still-open operator span with `reason` (the
@@ -348,6 +439,8 @@ class QueryTrace:
                     d["error"] = s.error
                 if s.pid is not None:
                     d["pid"] = s.pid
+                if s.proc is not None:
+                    d["proc"] = s.proc
                 if s.kind == OPERATOR:
                     d["rows"] = int(s.rows)
                     d["bytes"] = int(s.bytes)

@@ -128,12 +128,23 @@ class TpuDriverPlugin:
         self.conf_map = fixup_configs(conf_map or {})
         self.conf = cfg.RapidsConf(self.conf_map)
         self.heartbeat_manager = None
+        self.fleet_aggregator = None
 
     def init(self) -> dict:
         from .shuffle.heartbeat import HeartbeatManager
         if self.conf.get(cfg.SHUFFLE_MANAGER_ENABLED):
             timeout = self.conf.get(cfg.SHUFFLE_HEARTBEAT_TIMEOUT_MS) / 1000
             self.heartbeat_manager = HeartbeatManager(timeout_s=timeout)
+            if self.conf.get(cfg.FLEET_AGGREGATOR_ENABLED):
+                # the driver is where cluster-rollup series and the
+                # fleet verdict live: the aggregator walks THIS
+                # registry's peers at every /metrics//healthz read
+                from .obs.fleet import FleetAggregator, install_aggregator
+                self.fleet_aggregator = install_aggregator(FleetAggregator(
+                    self.heartbeat_manager,
+                    max_peers=self.conf.get(cfg.FLEET_SCRAPE_MAX_PEERS),
+                    timeout_s=self.conf.get(
+                        cfg.FLEET_SCRAPE_TIMEOUT_MS) / 1000.0))
         log.info("TPU driver plugin initialized")
         return self.conf_map  # the fixed-up configs Spark distributes
 
@@ -146,7 +157,8 @@ class TpuDriverPlugin:
         if kind == "register":
             peers = self.heartbeat_manager.register_executor(
                 message["executor_id"], message.get("host", ""),
-                message.get("port", 0))
+                message.get("port", 0),
+                obs_port=message.get("obs_port", 0))
             return {"ok": True, "peers": [p.__dict__ for p in peers]}
         if kind == "heartbeat":
             peers = self.heartbeat_manager.executor_heartbeat(
@@ -155,6 +167,10 @@ class TpuDriverPlugin:
         return {"ok": False, "error": f"unknown message {kind!r}"}
 
     def shutdown(self):
+        if self.fleet_aggregator is not None:
+            from .obs.fleet import install_aggregator
+            install_aggregator(None)
+            self.fleet_aggregator = None
         self.heartbeat_manager = None
 
 
@@ -236,11 +252,24 @@ class TpuExecutorPlugin:
             reg = BlockLocationRegistry.get()
             reg.set_local(self.executor_id, "127.0.0.1",
                           getattr(self.shuffle_server, "port", 0) or 0)
+            # fleet endpoint: when metrics.port is configured this
+            # executor serves /metrics//healthz//spans and advertises
+            # the bound port at registration so the driver's aggregator
+            # can scrape it and consumers can pull serve spans
+            obs_port = 0
+            mport = self.conf.get(cfg.METRICS_PORT)
+            if mport is not None:
+                from .obs.health import ensure_server
+                obs_port = ensure_server(mport).port
+            if self.shuffle_server is not None:
+                self.shuffle_server.executor_id = self.executor_id
+                self.shuffle_server.obs_port = obs_port
             if self.driver is not None:
                 self.driver.receive({
                     "kind": "register", "executor_id": self.executor_id,
                     "host": "localhost",
-                    "port": getattr(self.shuffle_server, "port", 0)})
+                    "port": getattr(self.shuffle_server, "port", 0),
+                    "obs_port": obs_port})
                 if self.driver.heartbeat_manager is not None:
                     reg.attach_heartbeat(self.driver.heartbeat_manager)
             log.info("TPU executor plugin initialized (executor %s)",

@@ -71,6 +71,21 @@ class TpuShuffleBlockMissingError(TpuShuffleFetchFailedError):
                          if detail else "shuffle block missing on peer")
 
 
+class TpuShuffleVersionError(TpuShuffleFetchFailedError):
+    """A frame announced a wire version this build does not speak.
+    Versioning fails TYPED on both sides: a server answers an unknown
+    request version with a structured MSG_ERROR (never a guess at the
+    body layout), and a client treats an unknown response version as
+    this error and drops the connection — correlation state is
+    unknowable past an unparsed frame."""
+
+    def __init__(self, got: int, supported: str = "1-2"):
+        self.got = got
+        super().__init__(
+            f"unsupported shuffle wire version {got} "
+            f"(this build speaks {supported})")
+
+
 class TpuShuffleCorruptBlockError(TpuShuffleFetchFailedError):
     """A fetched payload failed header validation or codec
     decompression: the bytes arrived complete but do not decode.

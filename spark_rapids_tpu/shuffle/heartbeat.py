@@ -32,6 +32,9 @@ class PeerInfo:
     host: str
     port: int
     last_heartbeat: float = field(default_factory=time.monotonic)
+    # the peer's /metrics//spans endpoint (0 = none advertised); the
+    # FleetAggregator scrapes through this, not the shuffle port
+    obs_port: int = 0
 
 
 class HeartbeatManager:
@@ -42,10 +45,11 @@ class HeartbeatManager:
         self._lock = threading.Lock()
         self.timeout_s = timeout_s
 
-    def register_executor(self, executor_id: str, host: str, port: int
-                          ) -> List[PeerInfo]:
+    def register_executor(self, executor_id: str, host: str, port: int,
+                          obs_port: int = 0) -> List[PeerInfo]:
         with self._lock:
-            self._peers[executor_id] = PeerInfo(executor_id, host, port)
+            self._peers[executor_id] = PeerInfo(executor_id, host, port,
+                                                obs_port=int(obs_port))
             out = [p for p in self._peers.values()
                    if p.executor_id != executor_id]
             _peers_gauge().set(len(self._peers))
@@ -86,13 +90,15 @@ class HeartbeatEndpoint:
 
     def __init__(self, manager: HeartbeatManager, executor_id: str,
                  host: str, port: int, interval_s: float = 5.0,
-                 on_peers: Optional[Callable[[List[PeerInfo]], None]] = None):
+                 on_peers: Optional[Callable[[List[PeerInfo]], None]] = None,
+                 obs_port: int = 0):
         self.manager = manager
         self.executor_id = executor_id
         self.interval_s = interval_s
         self.on_peers = on_peers
         self._stop = threading.Event()
-        peers = manager.register_executor(executor_id, host, port)
+        peers = manager.register_executor(executor_id, host, port,
+                                          obs_port=obs_port)
         if on_peers:
             on_peers(peers)
         self._thread = threading.Thread(target=self._run, daemon=True)

@@ -67,7 +67,9 @@ def _read_conf(conf):
     return (conf.get(cfg.SHUFFLE_LOCALITY_ENABLED),
             conf.get(cfg.SHUFFLE_FETCH_MAX_IN_FLIGHT),
             conf.get(cfg.SHUFFLE_FETCH_TIMEOUT_MS) / 1000.0,
-            conf.get(cfg.SHUFFLE_FETCH_MAX_RETRIES))
+            conf.get(cfg.SHUFFLE_FETCH_MAX_RETRIES),
+            conf.get(cfg.FLEET_PROPAGATION_ENABLED),
+            conf.get(cfg.FLEET_SCRAPE_TIMEOUT_MS) / 1000.0)
 
 
 def read_reduce_blocks(shuffle_id: int, reduce_id: int, conf=None,
@@ -87,7 +89,8 @@ def read_reduce_blocks(shuffle_id: int, reduce_id: int, conf=None,
         for b in mgr.catalog.get(block):
             local_c.inc()
             yield b
-    locality_on, window, timeout, max_retries = _read_conf(conf)
+    (locality_on, window, timeout, max_retries, prop_on,
+     pull_timeout) = _read_conf(conf)
     if not locality_on:
         return
     remote = reg.remote_groups(shuffle_id)
@@ -95,23 +98,35 @@ def read_reduce_blocks(shuffle_id: int, reduce_id: int, conf=None,
         return
     for group in remote:
         yield from _fetch_group(group, shuffle_id, reduce_id, reg, xp,
-                                window, timeout, max_retries, m)
+                                window, timeout, max_retries, m,
+                                prop_on, pull_timeout)
 
 
 def _fetch_group(group, shuffle_id: int, reduce_id: int, reg, xp,
-                 window: int, timeout: float, max_retries: int, m
+                 window: int, timeout: float, max_retries: int, m,
+                 prop_on: bool = True, pull_timeout: float = 2.0
                  ) -> Iterator:
     """Stream one owner group's blocks, retrying across live replicas.
 
     ``delivered`` counts blocks already handed to the consumer; a retry
     resumes the replica's deterministic block order past that point, so
-    the group completes exactly once."""
-    from ..obs.tracer import trace_event
+    the group completes exactly once.
+
+    Fleet propagation: each attempt opens a ``shuffle.fetch`` span and
+    threads its (trace_id, span_id, tenant) down the wire; when the
+    attempt finishes the producer's serve spans are pulled back over
+    its /spans endpoint and grafted under the fetch span, skew-
+    corrected.  Orphan hygiene: a peer that negotiated v2 but whose
+    spans cannot be recovered (died mid-fetch, pull failed) closes the
+    fetch span with ``spans_lost`` and counts
+    tpu_trace_remote_spans_lost_total — never an unclosed span."""
+    from ..obs.tracer import SPAN, active_tracer, trace_event
     from .transport import AsyncBlockFetcher
     retries_c = m.counter(
         "tpu_shuffle_fetch_retries_total",
         "remote fetch attempts re-driven against another live replica "
         "after a typed failure")
+    tracer = active_tracer() if prop_on else None
     delivered = 0
     attempts = 0
     tried = []
@@ -130,10 +145,21 @@ def _fetch_group(group, shuffle_id: int, reduce_id: int, reg, xp,
             retries_c.inc()
         tried.append(ep.executor_id)
         client = client_for(ep.host, ep.port, timeout)
+        ctx = None
+        sid = None
+        if tracer is not None:
+            from ..obs.fleet import TraceContext, current_tenant
+            sid = tracer.start("shuffle.fetch", SPAN,
+                               shuffle_id=shuffle_id,
+                               reduce_id=reduce_id,
+                               peer=ep.executor_id, attempt=attempts)
+            if sid is not None:
+                ctx = TraceContext(tracer.trace_id, sid,
+                                   current_tenant())
         fetcher = AsyncBlockFetcher(
             client, shuffle_id, reduce_id, xp=xp, window=window,
             timeout=timeout, heartbeat=reg.heartbeat,
-            peer_id=ep.executor_id)
+            peer_id=ep.executor_id, ctx=ctx)
         already = delivered  # handed over by previous attempts
         skipped = 0
         fetched_here = 0
@@ -145,6 +171,11 @@ def _fetch_group(group, shuffle_id: int, reduce_id: int, reg, xp,
                 delivered += 1
                 fetched_here += 1
                 yield b
+            if tracer is not None and sid is not None:
+                tracer.add_attrs(sid, blocks=fetched_here)
+                tracer.end(sid, "ok")
+                _merge_serve_spans(tracer, sid, client, ep, ctx,
+                                   pull_timeout)
             if fetched_here or delivered or attempts:
                 trace_event("shuffle.remote_fetch",
                             shuffle_id=shuffle_id, reduce_id=reduce_id,
@@ -155,6 +186,13 @@ def _fetch_group(group, shuffle_id: int, reduce_id: int, reg, xp,
             last_exc = ex
         except Exception as ex:  # typed + counted by the fetcher
             last_exc = ex
+        if tracer is not None and sid is not None:
+            # the attempt failed: the span closes typed NOW, and any
+            # serve spans the peer may hold for it are declared lost —
+            # a dead peer's /spans will never answer, and a live one's
+            # partial record would mis-parent under a failed attempt
+            _note_spans_lost(tracer, sid, client, ctx,
+                             repr(last_exc))
     detail = (f"shuffle {shuffle_id} reduce {reduce_id}: owner group "
               f"{[e.executor_id for e in group]} exhausted after "
               f"{attempts} attempt(s) (tried {tried}, "
@@ -169,3 +207,46 @@ def _fetch_group(group, shuffle_id: int, reduce_id: int, reg, xp,
               labelnames=("kind",)).labels(kind="peer_dead").inc()
     raise TpuShufflePeerDeadError(
         ",".join(e.executor_id for e in group), detail=detail)
+
+
+def _ctx_was_sendable(client, ctx) -> bool:
+    """Did this attempt actually put a context on the wire?  Only then
+    can the producer hold spans for it (pre-v2 peers never saw one)."""
+    return ctx is not None and (client.last_peer_version or 0) >= 2
+
+
+def _merge_serve_spans(tracer, sid, client, ep, ctx,
+                       pull_timeout: float) -> None:
+    """Post-attempt: drain the producer's serve spans for this trace
+    and graft them under the (already closed) fetch span.  Every
+    failure downgrades to spans_lost accounting — the read path has
+    the data; observability loss must never fail it."""
+    if not _ctx_was_sendable(client, ctx):
+        return
+    if not client.peer_obs_port:
+        return
+    from ..obs.fleet import pull_remote_spans
+    try:
+        spans = pull_remote_spans(ep.host, client.peer_obs_port,
+                                  tracer.trace_id,
+                                  timeout_s=pull_timeout)
+        tracer.add_remote_spans(
+            sid, spans, offset_ns=client.clock_offset_ns or 0,
+            proc=client.peer_executor_id or ep.executor_id)
+    except Exception as ex:
+        _note_spans_lost(tracer, sid, client, ctx,
+                         f"spans pull failed: {ex!r}", force=True)
+
+
+def _note_spans_lost(tracer, sid, client, ctx, error: str,
+                     force: bool = False) -> None:
+    """Orphan hygiene: close the fetch span typed with a spans_lost
+    annotation and count the loss.  No-op when no context ever crossed
+    the wire (nothing remote exists to lose)."""
+    tracer.end(sid, "error", error)  # no-op if already closed
+    if not force and not _ctx_was_sendable(client, ctx):
+        return
+    tracer.add_attrs(sid, spans_lost=True)
+    tracer.note_remote_spans_lost()
+    from ..obs.fleet import remote_lost_counter
+    remote_lost_counter().inc()

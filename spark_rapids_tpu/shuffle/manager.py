@@ -236,6 +236,10 @@ class TpuShuffleManager:
         # per-shuffle compression ratio for spans and SUITE_JSON
         self._comp: Dict[int, List[int]] = {}
         self._comp_lock = threading.Lock()
+        # per-shuffle serve-side seconds by step (decode/catalog_read/
+        # serialize/compress/send), fed by the block server — the
+        # per-peer serve breakdown serve_map ships in its STATS line
+        self._serve: Dict[int, Dict[str, float]] = {}
 
     @classmethod
     def get(cls) -> "TpuShuffleManager":
@@ -301,6 +305,24 @@ class TpuShuffleManager:
             tot[0] += int(raw)
             tot[1] += int(encoded)
 
+    def note_serve_time(self, shuffle_id: int, step: str,
+                        seconds: float) -> None:
+        with self._comp_lock:
+            steps = self._serve.setdefault(shuffle_id, {})
+            steps[step] = steps.get(step, 0.0) + float(seconds)
+
+    def serve_stats(self, shuffle_id: Optional[int] = None) -> Dict:
+        """Serve-side seconds by step — one shuffle's, or all shuffles
+        folded together (what serve_map reports at exit)."""
+        with self._comp_lock:
+            if shuffle_id is not None:
+                return dict(self._serve.get(shuffle_id, {}))
+            out: Dict[str, float] = {}
+            for steps in self._serve.values():
+                for step, secs in steps.items():
+                    out[step] = out.get(step, 0.0) + secs
+            return out
+
     def compression_stats(self, shuffle_id: int) -> Optional[Dict]:
         with self._comp_lock:
             tot = self._comp.get(shuffle_id)
@@ -324,5 +346,6 @@ class TpuShuffleManager:
         self.catalog.remove_shuffle(shuffle_id)
         with self._comp_lock:
             self._comp.pop(shuffle_id, None)
+            self._serve.pop(shuffle_id, None)
         from .registry import BlockLocationRegistry
         BlockLocationRegistry.get().forget_shuffle(shuffle_id)

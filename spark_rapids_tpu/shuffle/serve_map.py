@@ -105,9 +105,11 @@ def main() -> int:
     parts = int(_arg("--parts", "4"))
     codec = _arg("--codec", "none")
     seed = int(_arg("--seed", "7"))
+    executor_id = _arg("--executor-id", "serve-map-0")
     from ..memory.meta import set_default_codec
     from ..memory.spill import SpillCatalog
     from ..obs import metrics as m
+    from ..obs.health import MetricsServer
     from .manager import TpuShuffleManager
     from .transport import ShuffleServer
     set_default_codec(codec)
@@ -115,11 +117,19 @@ def main() -> int:
     fact, dim = build_side_tables(rows, seed)
     register_map_outputs(mgr, FACT_SID, fact, "k", parts)
     register_map_outputs(mgr, DIM_SID, dim, "k", parts)
-    server = ShuffleServer(mgr).start()
-    print(f"PORT {server.port}", flush=True)
+    # the fleet endpoint: /metrics + /healthz + /spans on an ephemeral
+    # port, advertised so the parent's aggregator scrapes this process
+    # and its tracer pulls our serve spans back
+    obs = MetricsServer(0)
+    server = ShuffleServer(mgr, executor_id=executor_id,
+                           obs_port=obs.port).start()
+    # "PORT <port> OBS <obs_port>": the parent splits on whitespace and
+    # reads field [1], so pre-fleet parents still parse this line
+    print(f"PORT {server.port} OBS {obs.port}", flush=True)
     sys.stdin.readline()  # parent signals done (or closes the pipe)
     fact_comp = mgr.compression_stats(FACT_SID)
     dim_comp = mgr.compression_stats(DIM_SID)
+    serve_steps = mgr.serve_stats()
     mgr.unregister(FACT_SID)
     mgr.unregister(DIM_SID)
     leaked = mgr.catalog.num_blocks()
@@ -128,6 +138,7 @@ def main() -> int:
                       labelnames=("codec",))
     comp_c = m.counter("tpu_shuffle_compressed_bytes_total",
                        labelnames=("codec",))
+    from ..obs.fleet import RemoteSpanStore
     from .transport import _server_requests_counter
     req_c = _server_requests_counter()
     stats = {
@@ -140,8 +151,11 @@ def main() -> int:
         "leaks": len(leaks),
         "fact_compression": fact_comp,
         "dim_compression": dim_comp,
+        "serve_seconds_by_step": serve_steps,
+        "unpulled_spans": RemoteSpanStore.get().span_count(),
     }
     server.stop()
+    obs.close()
     print("STATS " + json.dumps(stats), flush=True)
     return 0
 

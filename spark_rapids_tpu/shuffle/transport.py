@@ -19,6 +19,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -28,7 +29,8 @@ from ..memory.meta import (TableMeta, TpuCorruptPayloadError,
 from .errors import (TpuShuffleBlockMissingError, TpuShuffleCorruptBlockError,
                      TpuShuffleError, TpuShuffleFetchFailedError,
                      TpuShufflePeerDeadError, TpuShuffleStaleFrameError,
-                     TpuShuffleTimeoutError, TpuShuffleTruncatedFrameError)
+                     TpuShuffleTimeoutError, TpuShuffleTruncatedFrameError,
+                     TpuShuffleVersionError)
 from .manager import ShuffleBlockId, TpuShuffleManager, materialize_block
 
 # message types (ref RapidsShuffleTransport.scala:96-119)
@@ -37,6 +39,11 @@ MSG_METADATA_RESP = 2
 MSG_TRANSFER_REQ = 3
 MSG_BUFFER = 4
 MSG_ERROR = 5
+# v2 additions: version/clock handshake.  HELLO rides v1 framing on
+# purpose — a pre-v2 server parses it fine (then answers bad_message
+# with CORRECT correlation), so negotiation never corrupts the stream.
+MSG_HELLO = 6
+MSG_HELLO_RESP = 7
 
 # request_id is a full u64: the client draws ids from range(1, 1<<62),
 # so a narrower wire field would alias distinct requests once the
@@ -45,10 +52,31 @@ MSG_ERROR = 5
 _FRAME = struct.Struct("<BQq")  # type, request_id, body_len
 CHUNK = 1 << 20  # windowed send size (bounce-buffer analog)
 
+# --- v2 framing: the trace-context header extension ------------------------
+# A v2 frame leads with a magic byte that can never be a v1 message
+# type, then: version, message type, request id, body length, context
+# length; the packed TraceContext blob precedes the body.  The
+# (magic, version, mtype, request_id) prefix is FROZEN across all
+# future versions so an unknown-version frame can still be refused with
+# correct correlation.  Only REQUESTS use v2 framing (the context flows
+# consumer -> producer); responses stay v1 so an old client against a
+# new server sees pure v1 traffic.
+WIRE_V2_MAGIC = 0xE2
+WIRE_VERSION = 2
+_FRAME2 = struct.Struct("<BBBQqH")  # magic, ver, type, req_id, blen, ctxlen
+
 # MSG_ERROR bodies are "code:detail"; codes map to the typed taxonomy
 # client-side so a peer's failure reason survives the wire
 ERR_BLOCK_MISSING = "block_missing"
 ERR_BAD_MESSAGE = "bad_message"
+ERR_BAD_VERSION = "bad_version"
+
+# hello bodies: request is the client's send timestamp; the response
+# echoes it and adds the server's receive/send timestamps (NTP-style
+# four-timestamp clock estimate), wire version, /spans-capable obs
+# port, and the serving executor's identity
+_HELLO_REQ = struct.Struct("<q")
+_HELLO_RESP = struct.Struct("<BqqqiH")
 
 
 def _server_requests_counter():
@@ -57,6 +85,21 @@ def _server_requests_counter():
                      "block-server requests served, by kind — metadata "
                      "answers come from catalog stats (O(1)), transfer "
                      "answers stream payload bytes", ("kind",))
+
+
+#: serve-side latency ladder: loopback serves sit in the 10us-10ms
+#: decades, far below the fetch-path default buckets
+_SERVE_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3,
+                  2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2, 0.1, 0.5)
+
+
+def _serve_hist():
+    from ..obs import metrics as m
+    return m.histogram("tpu_shuffle_serve_seconds",
+                       "block-server time per request step (request "
+                       "decode, catalog read, arrow serialize, codec "
+                       "compress, socket send)", ("step",),
+                       buckets=_SERVE_BUCKETS)
 
 
 class TransactionStatus:
@@ -101,11 +144,20 @@ class Transaction:
 
 
 class ShuffleServer:
-    """Serves catalog blocks over TCP (ref RapidsShuffleServer.scala)."""
+    """Serves catalog blocks over TCP (ref RapidsShuffleServer.scala).
+
+    Speaks both wire versions: v1 frames exactly as before (old peers
+    keep working), v2 frames whose header extension carries the
+    requesting query's TraceContext — those requests additionally
+    record serve spans into the RemoteSpanStore for the consumer's
+    ``/spans`` pull, parented under the consumer's fetch span."""
 
     def __init__(self, manager: Optional[TpuShuffleManager] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 executor_id: str = "", obs_port: int = 0):
         self.manager = manager or TpuShuffleManager.get()
+        self.executor_id = executor_id
+        self.obs_port = obs_port
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -114,21 +166,8 @@ class ShuffleServer:
                     outer._conns.add(self.request)
                 try:
                     while True:
-                        head = _recv_exact(self.request, _FRAME.size)
-                        if head is None:
+                        if not outer._serve_one(self.request):
                             return
-                        mtype, req_id, blen = _FRAME.unpack(head)
-                        body = _recv_exact(self.request, blen) if blen else b""
-                        if mtype == MSG_METADATA_REQ:
-                            outer._handle_metadata(self.request, req_id,
-                                                   body)
-                        elif mtype == MSG_TRANSFER_REQ:
-                            outer._handle_transfer(self.request, req_id,
-                                                   body)
-                        else:
-                            _send_frame(self.request, MSG_ERROR, req_id,
-                                        f"{ERR_BAD_MESSAGE}:unknown "
-                                        f"type {mtype}".encode())
                 except (ConnectionError, OSError):
                     return
                 finally:
@@ -164,7 +203,92 @@ class ShuffleServer:
             except OSError:
                 pass
 
-    def _handle_metadata(self, sock, req_id, body):
+    # -- frame pump ----------------------------------------------------------
+    def _serve_one(self, sock) -> bool:
+        """Read and answer ONE frame; False on clean disconnect.  The
+        first byte discriminates v1 (a message type, all < 0xE2) from
+        v2 (the magic byte)."""
+        first = _recv_exact(sock, 1)
+        if first is None:
+            return False
+        ctx = None
+        if first[0] == WIRE_V2_MAGIC:
+            rest = _recv_exact(sock, _FRAME2.size - 1)
+            if rest is None or len(rest) < _FRAME2.size - 1:
+                return False
+            _magic, version, mtype, req_id, blen, clen = \
+                _FRAME2.unpack(first + rest)
+            ctx_blob = _recv_exact(sock, clen) if clen else b""
+            body = _recv_exact(sock, blen) if blen else b""
+            if version != WIRE_VERSION:
+                # typed refusal with CORRECT correlation: the frozen
+                # v2 prefix guarantees req_id parsed right even for a
+                # future version whose tail layout we cannot read
+                _send_frame(sock, MSG_ERROR, req_id,
+                            f"{ERR_BAD_VERSION}:{version}".encode())
+                return True
+            if ctx_blob:
+                from ..obs.fleet import TraceContext
+                try:
+                    ctx = TraceContext.unpack(ctx_blob)
+                except (struct.error, ValueError):
+                    ctx = None  # a bad context degrades tracing only
+        else:
+            rest = _recv_exact(sock, _FRAME.size - 1)
+            if rest is None or len(rest) < _FRAME.size - 1:
+                return False
+            mtype, req_id, blen = _FRAME.unpack(first + rest)
+            body = _recv_exact(sock, blen) if blen else b""
+        if mtype == MSG_METADATA_REQ:
+            self._handle_metadata(sock, req_id, body, ctx=ctx)
+        elif mtype == MSG_TRANSFER_REQ:
+            self._handle_transfer(sock, req_id, body, ctx=ctx)
+        elif mtype == MSG_HELLO:
+            self._handle_hello(sock, req_id, body)
+        else:
+            _send_frame(sock, MSG_ERROR, req_id,
+                        f"{ERR_BAD_MESSAGE}:unknown "
+                        f"type {mtype}".encode())
+        return True
+
+    def _handle_hello(self, sock, req_id, body):
+        """Version + clock handshake: echo the client's send timestamp
+        with our receive/send timestamps (perf_counter_ns — arbitrary
+        epoch per process, which is exactly why the client needs the
+        four-timestamp offset estimate), plus wire version, the /spans
+        obs port, and this executor's identity."""
+        # tpulint: allow[TPU-R006] clock-sync protocol timestamps —
+        # the raw reads ARE the payload, not engine timing
+        t1 = time.perf_counter_ns()
+        (t0,) = _HELLO_REQ.unpack_from(body, 0)
+        eb = (self.executor_id or "").encode()
+        # tpulint: allow[TPU-R006] clock-sync protocol timestamp
+        t2 = time.perf_counter_ns()
+        _send_frame(sock, MSG_HELLO_RESP, req_id,
+                    _HELLO_RESP.pack(WIRE_VERSION, t0, t1, t2,
+                                     int(self.obs_port or 0), len(eb))
+                    + eb)
+
+    def _recorder(self, ctx, name: str, **attrs):
+        if ctx is None:
+            return None
+        from ..obs.fleet import ServeSpanRecorder
+        return ServeSpanRecorder(
+            ctx, name,
+            proc=self.executor_id or f"server:{self.port}", **attrs)
+
+    def _step(self, rec, shuffle_id: int, step: str, t0_ns: int,
+              t1_ns: int) -> None:
+        """One timed serve step: the per-kind breakdown histogram and
+        the shuffle's serve-time ledger always see it; a span child is
+        recorded only when the request carried a TraceContext."""
+        secs = max(t1_ns - t0_ns, 0) / 1e9
+        _serve_hist().labels(step=step).observe(secs)
+        self.manager.note_serve_time(shuffle_id, step, secs)
+        if rec is not None:
+            rec.step(f"serve.{step}", t0_ns, t1_ns)
+
+    def _handle_metadata(self, sock, req_id, body, ctx=None):
         """Answer from catalog-tracked stats — O(blocks), NOT
         O(partition bytes).  Serializing (and compressing) every batch
         just to report row counts made a metadata request cost as much
@@ -172,7 +296,14 @@ class ShuffleServer:
         device_bytes / a per-shuffle schema fingerprint at registration,
         so nothing materializes here."""
         _server_requests_counter().labels(kind="metadata").inc()
+        rec = self._recorder(ctx, "shuffle.serve.metadata")
+        # tpulint: allow[TPU-R006] serve-span step boundaries: the
+        # producer has no installed tracer — ServeSpanRecorder builds
+        # the remote spans the consumer's tracer will graft
+        t_in = time.perf_counter_ns()
         shuffle_id, reduce_id = struct.unpack("<qq", body)
+        # tpulint: allow[TPU-R006] serve-span step boundary
+        t_dec = time.perf_counter_ns()
         cat = self.manager.catalog
         fp = cat.schema_fp(shuffle_id)
         blocks = cat.blocks_for_reduce(shuffle_id, reduce_id)
@@ -187,19 +318,53 @@ class ShuffleServer:
         out = struct.pack("<i", len(metas))
         for (sid, mid, rid), i, meta in metas:
             out += struct.pack("<qqqq", sid, mid, rid, i) + meta.pack()
+        # tpulint: allow[TPU-R006] serve-span step boundary
+        t_cat = time.perf_counter_ns()
         _send_frame(sock, MSG_METADATA_RESP, req_id, out)
+        # tpulint: allow[TPU-R006] serve-span step boundary
+        t_sent = time.perf_counter_ns()
+        self._step(rec, shuffle_id, "decode", t_in, t_dec)
+        self._step(rec, shuffle_id, "catalog_read", t_dec, t_cat)
+        self._step(rec, shuffle_id, "send", t_cat, t_sent)
+        if rec is not None:
+            rec.set_attrs(shuffle_id=shuffle_id, reduce_id=reduce_id,
+                          blocks=len(metas))
+            rec.close()
 
-    def _handle_transfer(self, sock, req_id, body):
+    def _handle_transfer(self, sock, req_id, body, ctx=None):
         _server_requests_counter().labels(kind="transfer").inc()
+        rec = self._recorder(ctx, "shuffle.serve.transfer")
+        # tpulint: allow[TPU-R006] serve-span step boundaries (see
+        # _handle_metadata): producer-side spans for the fleet merge
+        t_in = time.perf_counter_ns()
         sid, mid, rid, idx = struct.unpack("<qqqq", body)
+        # tpulint: allow[TPU-R006] serve-span step boundary
+        t_dec = time.perf_counter_ns()
+        self._step(rec, sid, "decode", t_in, t_dec)
         batches = self.manager.catalog.get(ShuffleBlockId(sid, mid, rid))
         if idx >= len(batches):
             _send_frame(sock, MSG_ERROR, req_id,
                         f"{ERR_BLOCK_MISSING}:({sid},{mid},{rid})[{idx}] "
                         f"not in catalog".encode())
+            if rec is not None:
+                rec.close("error", f"block_missing ({sid},{mid},{rid})"
+                                   f"[{idx}]")
             return
+        mat = _materialize(batches[idx])
+        # tpulint: allow[TPU-R006] serve-span step boundary
+        t_cat = time.perf_counter_ns()
+        self._step(rec, sid, "catalog_read", t_dec, t_cat)
+        timings: Dict[str, int] = {}
         payload, raw_len, enc_len = serialize_batch_with_sizes(
-            _materialize(batches[idx]))
+            mat, timings=timings)
+        # tpulint: allow[TPU-R006] serve-span step boundary
+        t_ser = time.perf_counter_ns()
+        # split the serializer's wall between arrow IPC and the codec
+        # using its own internal timings (compress is 0ns for codec=none
+        # — the span is still recorded so the breakdown shape is stable)
+        comp_ns = min(timings.get("compress_ns", 0), t_ser - t_cat)
+        self._step(rec, sid, "serialize", t_cat, t_ser - comp_ns)
+        self._step(rec, sid, "compress", t_ser - comp_ns, t_ser)
         # per-shuffle compressed/raw totals: the span + SUITE_JSON ratio
         self.manager.note_payload_sizes(sid, raw_len, enc_len)
         # windowed chunked send (bounce-buffer flow, BufferSendState analog)
@@ -208,10 +373,27 @@ class ShuffleServer:
                     struct.pack("<q", total))
         for off in range(0, total, CHUNK):
             sock.sendall(payload[off:off + CHUNK])
+        # tpulint: allow[TPU-R006] serve-span step boundary
+        t_sent = time.perf_counter_ns()
+        self._step(rec, sid, "send", t_ser, t_sent)
+        if rec is not None:
+            rec.set_attrs(shuffle_id=sid, map_id=mid, reduce_id=rid,
+                          index=idx, raw_bytes=raw_len,
+                          encoded_bytes=enc_len)
+            rec.close()
 
 
 class ShuffleClient:
-    """Fetches remote blocks (ref RapidsShuffleClient + doFetch flow)."""
+    """Fetches remote blocks (ref RapidsShuffleClient + doFetch flow).
+
+    On the first request over a connection the client performs the
+    MSG_HELLO version/clock handshake.  A pre-v2 peer answers it with a
+    correlated ``bad_message`` error — the client then pins the peer to
+    v1 and never emits a v2 frame at it, so mixed-version clusters
+    degrade to uncorrelated-but-correct v1 traffic instead of framing
+    corruption.  A v2 peer's reply carries the NTP-style timestamps
+    (fed to ``obs.fleet.ClockSync``), its /spans obs port, and its
+    executor identity."""
 
     def __init__(self, host: str, port: int, timeout: float = 30.0):
         self.addr = (host, port)
@@ -219,6 +401,16 @@ class ShuffleClient:
         self._sock: Optional[socket.socket] = None
         self._req_ids = iter(range(1, 1 << 62))
         self._lock = threading.Lock()
+        # hello-negotiated peer facts (None version = not negotiated yet)
+        self.peer_version: Optional[int] = None
+        self.peer_obs_port = 0
+        self.peer_executor_id = ""
+        self.clock_offset_ns: Optional[int] = None
+        self.clock_rtt_ns: Optional[int] = None
+        # sticky across _drop_conn: whether any connection to this peer
+        # ever negotiated v2 — the orphan-hygiene path needs to know a
+        # context COULD have been sent even after the connection died
+        self.last_peer_version: Optional[int] = None
 
     def _conn(self) -> socket.socket:
         if self._sock is None:
@@ -231,13 +423,69 @@ class ShuffleClient:
             self._sock.close()
             self._sock = None
 
-    def fetch_metadata(self, shuffle_id: int, reduce_id: int) -> Transaction:
+    # -- hello / version negotiation ----------------------------------------
+    def _ensure_hello(self, sock) -> None:
+        """Negotiate once per connection (caller holds the lock)."""
+        if self.peer_version is not None:
+            return
+        req_id = next(self._req_ids)
+        # tpulint: allow[TPU-R006] clock-sync protocol timestamps —
+        # t0/t3 are the NTP-style handshake's local bracket
+        t0 = time.perf_counter_ns()
+        _send_frame(sock, MSG_HELLO, req_id, _HELLO_REQ.pack(t0))
+        mtype, rid, body = _recv_frame(sock)
+        # tpulint: allow[TPU-R006] clock-sync protocol timestamp
+        t3 = time.perf_counter_ns()
+        if rid != req_id:
+            raise TpuShuffleStaleFrameError(req_id, rid)
+        if mtype == MSG_ERROR:
+            text = body.decode(errors="replace")
+            if text.startswith(ERR_BAD_MESSAGE):
+                # pre-v2 peer: HELLO is an unknown type to it, but the
+                # v1-framed refusal correlated correctly — pin to v1
+                self.peer_version = self.last_peer_version = 1
+                return
+            raise TpuShuffleFetchFailedError(f"hello failed: {text}")
+        if mtype != MSG_HELLO_RESP:
+            raise TpuShuffleFetchFailedError(
+                f"hello answered with message type {mtype}")
+        version, t0_echo, t1, t2, obs_port, elen = \
+            _HELLO_RESP.unpack_from(body, 0)
+        self.peer_executor_id = body[
+            _HELLO_RESP.size:_HELLO_RESP.size + elen].decode(
+            errors="replace")
+        self.peer_version = min(int(version), WIRE_VERSION)
+        self.last_peer_version = self.peer_version
+        self.peer_obs_port = int(obs_port)
+        from ..obs.fleet import ClockSync
+        self.clock_offset_ns, self.clock_rtt_ns = \
+            ClockSync.estimate(t0_echo, t1, t2, t3)
+        if self.peer_executor_id:
+            ClockSync.get().observe(self.peer_executor_id,
+                                    t0_echo, t1, t2, t3)
+
+    def _send_request(self, sock, mtype: int, req_id: int, body: bytes,
+                      ctx) -> None:
+        """v2 frame with the packed TraceContext when the peer speaks
+        v2 and a context is in hand; plain v1 frame otherwise."""
+        if ctx is not None and (self.peer_version or 1) >= 2:
+            blob = ctx.pack()
+            sock.sendall(_FRAME2.pack(WIRE_V2_MAGIC, WIRE_VERSION,
+                                      mtype, req_id, len(body),
+                                      len(blob)) + blob + body)
+        else:
+            _send_frame(sock, mtype, req_id, body)
+
+    def fetch_metadata(self, shuffle_id: int, reduce_id: int,
+                       ctx=None) -> Transaction:
         tx = Transaction(next(self._req_ids))
         try:
             with self._lock:
                 sock = self._conn()
-                _send_frame(sock, MSG_METADATA_REQ, tx.request_id,
-                            struct.pack("<qq", shuffle_id, reduce_id))
+                self._ensure_hello(sock)
+                self._send_request(sock, MSG_METADATA_REQ, tx.request_id,
+                                   struct.pack("<qq", shuffle_id,
+                                               reduce_id), ctx)
                 mtype, rid, body = _recv_frame(sock)
                 _check_correlation(tx, rid)
             if mtype == MSG_ERROR:
@@ -265,14 +513,16 @@ class ShuffleClient:
             tx.fail(str(ex))
         return tx
 
-    def fetch_block(self, sid: int, mid: int, rid: int, idx: int, xp=np
-                    ) -> Transaction:
+    def fetch_block(self, sid: int, mid: int, rid: int, idx: int, xp=np,
+                    ctx=None) -> Transaction:
         tx = Transaction(next(self._req_ids))
         try:
             with self._lock:
                 sock = self._conn()
-                _send_frame(sock, MSG_TRANSFER_REQ, tx.request_id,
-                            struct.pack("<qqqq", sid, mid, rid, idx))
+                self._ensure_hello(sock)
+                self._send_request(sock, MSG_TRANSFER_REQ, tx.request_id,
+                                   struct.pack("<qqqq", sid, mid, rid, idx),
+                                   ctx)
                 mtype, req, body = _recv_frame(sock)
                 _check_correlation(tx, req)
                 if mtype == MSG_ERROR:
@@ -303,11 +553,14 @@ class ShuffleClient:
 
     def _drop_conn(self):
         """Connection state after any failure is unknowable (half-read
-        frames); reconnect on the next request."""
+        frames); reconnect on the next request.  The hello handshake is
+        per-connection, so peer facts reset too — the replacement peer
+        behind the same address may speak a different version."""
         try:
             self.close()
         except OSError:
             pass
+        self.peer_version = None
 
 
 class AsyncBlockFetcher:
@@ -331,7 +584,7 @@ class AsyncBlockFetcher:
     def __init__(self, client: "ShuffleClient", shuffle_id: int,
                  reduce_id: int, xp=np, window: int = 4,
                  timeout: float = 30.0, heartbeat=None,
-                 peer_id: Optional[str] = None):
+                 peer_id: Optional[str] = None, ctx=None):
         self.client = client
         self.shuffle_id = shuffle_id
         self.reduce_id = reduce_id
@@ -340,6 +593,7 @@ class AsyncBlockFetcher:
         self.timeout = timeout
         self.heartbeat = heartbeat
         self.peer_id = peer_id
+        self.ctx = ctx
         self._stop = threading.Event()
 
     # -- liveness -----------------------------------------------------------
@@ -359,7 +613,8 @@ class AsyncBlockFetcher:
                     return
                 self._check_peer()
                 b = self.client.fetch_block(sid, mid, rid, idx,
-                                            xp=self.xp).wait(self.timeout)
+                                            xp=self.xp,
+                                            ctx=self.ctx).wait(self.timeout)
                 if not self._put(q, b):
                     return
             self._put(q, self._DONE)
@@ -382,7 +637,8 @@ class AsyncBlockFetcher:
         try:
             self._check_peer()
             metas = self.client.fetch_metadata(
-                self.shuffle_id, self.reduce_id).wait(self.timeout)
+                self.shuffle_id, self.reduce_id,
+                ctx=self.ctx).wait(self.timeout)
         except TpuShuffleError as ex:
             raise self._classify(ex, m)
         keys = [k for k, _ in metas]
@@ -464,6 +720,9 @@ def _raise_peer_error(body: bytes) -> None:
     code, _, detail = text.partition(":")
     if code == ERR_BLOCK_MISSING:
         raise TpuShuffleBlockMissingError(detail)
+    if code == ERR_BAD_VERSION:
+        raise TpuShuffleVersionError(
+            int(detail) if detail.isdigit() else -1)
 
 
 def _send_frame(sock, mtype: int, req_id: int, body: bytes):
@@ -477,6 +736,25 @@ def _recv_frame(sock) -> Tuple[int, int, bytes]:
     if len(head) < _FRAME.size:
         raise TpuShuffleTruncatedFrameError(_FRAME.size, len(head),
                                             what="frame header")
+    if head[0] == WIRE_V2_MAGIC:
+        # v2-framed response: _FRAME2 is 4 bytes longer than _FRAME.
+        # The (magic, version, mtype, req_id) prefix is frozen, so an
+        # unknown version still fails typed instead of corrupting
+        # correlation on the bytes after it.
+        rest = _recv_exact(sock, _FRAME2.size - _FRAME.size)
+        if rest is None or len(rest) < _FRAME2.size - _FRAME.size:
+            raise TpuShuffleTruncatedFrameError(
+                _FRAME2.size, _FRAME.size + len(rest or b""),
+                what="frame header")
+        _, ver, mtype, req_id, blen, clen = _FRAME2.unpack(head + rest)
+        if ver != WIRE_VERSION:
+            raise TpuShuffleVersionError(ver)
+        want = clen + blen
+        blob = _recv_exact(sock, want) if want else b""
+        if want and (blob is None or len(blob) < want):
+            raise TpuShuffleTruncatedFrameError(want, len(blob or b""),
+                                                what="frame body")
+        return mtype, req_id, blob[clen:]
     mtype, req_id, blen = _FRAME.unpack(head)
     body = _recv_exact(sock, blen) if blen else b""
     if blen and (body is None or len(body) < blen):

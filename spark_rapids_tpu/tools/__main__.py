@@ -2,7 +2,8 @@
 
     python -m spark_rapids_tpu.tools qualification <eventlogs...> [-o DIR]
     python -m spark_rapids_tpu.tools profiling     <eventlogs...> [-o DIR] [-c] [--accuracy]
-    python -m spark_rapids_tpu.tools trace         <eventlog> [--export chrome|text] [-o FILE]
+    python -m spark_rapids_tpu.tools trace         <eventlog> [--export chrome|text] [-o FILE] [--merged]
+    python -m spark_rapids_tpu.tools fleet         <eventlog|trace.json> [--json]
     python -m spark_rapids_tpu.tools lint --repo   [--baseline FILE]
     python -m spark_rapids_tpu.tools lint --plan   <fixture.py...> [--infer] [--memsan]
     python -m spark_rapids_tpu.tools regress --history DIR --record <eventlog...> [--label L]
@@ -128,7 +129,7 @@ def _run_repo_lint(baseline_path, update):
     return 0
 
 
-def _run_trace_export(log, fmt, output, sql_id):
+def _run_trace_export(log, fmt, output, sql_id, merged=False):
     import json
 
     from ..obs.export import spans_to_chrome, spans_to_text
@@ -137,6 +138,11 @@ def _run_trace_export(log, fmt, output, sql_id):
     app = parse_event_log(log)
     spans = [s for s in app.spans
              if sql_id is None or s.get("executionId") == sql_id]
+    if not merged:
+        # default view: THIS process's spans only; --merged includes
+        # the remote serve spans grafted in by the fleet observatory
+        # (they carry "proc" — the producing executor's identity)
+        spans = [s for s in spans if not s.get("proc")]
     if not spans:
         sys.stderr.write(f"{log}: no flight-recorder spans "
                          f"(self-emitted logs only; was "
@@ -154,6 +160,37 @@ def _run_trace_export(log, fmt, output, sql_id):
     with open(out_path, "w") as f:
         json.dump(spans_to_chrome(spans), f)
     sys.stdout.write(f"{len(spans)} span(s) -> {out_path}\n")
+    return 0
+
+
+def _run_fleet_summary(log, sql_id, as_json=False):
+    import json
+
+    from ..obs.export import fleet_summary, format_fleet_summary
+
+    spans = None
+    if log.endswith(".json"):
+        # a raw span dump (bench.py --dist writes one): either a bare
+        # span-dict list or {"spans": [...]}
+        try:
+            with open(log) as f:
+                doc = json.load(f)
+            spans = doc if isinstance(doc, list) else doc.get("spans")
+        except (OSError, ValueError):
+            spans = None
+    if spans is None:
+        from .eventlog import parse_event_log
+        app = parse_event_log(log)
+        spans = [s for s in app.spans
+                 if sql_id is None or s.get("executionId") == sql_id]
+    if not spans:
+        sys.stderr.write(f"{log}: no flight-recorder spans\n")
+        return 2
+    summary = fleet_summary(spans)
+    if as_json:
+        sys.stdout.write(json.dumps(summary, indent=2) + "\n")
+    else:
+        sys.stdout.write(format_fleet_summary(summary))
     return 0
 
 
@@ -278,6 +315,20 @@ def main(argv=None):
                          "chrome; stdout for text)")
     tr.add_argument("--sql", type=int, default=None,
                     help="only this SQL execution id")
+    tr.add_argument("--merged", action="store_true",
+                    help="include the remote serve spans the fleet "
+                         "observatory merged into the trace (one "
+                         "clock-aligned multi-process timeline; each "
+                         "producer gets its own Chrome process lane)")
+    fl = sub.add_parser("fleet",
+                        help="per-peer wire vs serve vs compute "
+                             "summary of a merged trace")
+    fl.add_argument("log", help="self-emitted event log (or a raw "
+                                ".trace.json span dump)")
+    fl.add_argument("--sql", type=int, default=None,
+                    help="only this SQL execution id")
+    fl.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of text")
     li = sub.add_parser("lint",
                         help="static plan/repo analysis (tpulint)")
     li.add_argument("--repo", action="store_true",
@@ -384,7 +435,10 @@ def main(argv=None):
                 sys.stdout.write(format_accuracy(parse_event_log(log)))
     elif args.cmd == "trace":
         return _run_trace_export(args.log, args.export, args.output,
-                                 args.sql)
+                                 args.sql, merged=args.merged)
+    elif args.cmd == "fleet":
+        return _run_fleet_summary(args.log, args.sql,
+                                  as_json=args.json)
     elif args.cmd == "regress":
         if args.record is None and not args.check:
             p.error("regress needs --record and/or --check")

@@ -194,6 +194,23 @@ def test_run_lint_feedback_gate_exits_zero():
     assert "feedback gate clean" in proc.stdout, proc.stdout
 
 
+def test_run_lint_fleet_gate_exits_zero():
+    """Tier-1 gate for the fleet observatory: a golden join fetched
+    from TWO serve_map child processes must produce one merged trace
+    with every producer's serve spans nested under the consumer's
+    fetch spans and zero lost spans; the aggregator must expose rollup
+    series and an ok verdict for both peers; killing a peer mid-fleet
+    must degrade the verdict AND surface the orphan-span counter —
+    anti-vacuity in both directions."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "devtools", "run_lint.py"),
+         "--fleet"],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "fleet gate clean" in proc.stdout, proc.stdout
+
+
 def test_baseline_is_empty_and_stays_empty():
     """PR-3 burned the last baselined TPU-R001 debt down to zero: the
     ratchet now enforces a spotless repo (deliberate exceptions are

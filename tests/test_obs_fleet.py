@@ -1,0 +1,701 @@
+"""Fleet observatory tests: the v2 wire-context extension (mixed-
+version decode in BOTH directions, typed unknown-version refusal), the
+clock-offset handshake, the producer-side RemoteSpanStore + /spans pull,
+orphan-span hygiene (a peer dying mid-fetch must never leave an
+unclosed span), the driver-side FleetAggregator rollup/verdict, and the
+cross-process end-to-end merged trace against ``serve_map``."""
+
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu.obs.metrics as m
+from spark_rapids_tpu.obs import tracer as tr
+from spark_rapids_tpu.obs.fleet import (ClockSync, FleetAggregator,
+                                        RemoteSpanStore, TraceContext,
+                                        install_aggregator,
+                                        parse_prometheus_totals,
+                                        pull_remote_spans)
+from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _serve_blocks(n_maps=4, rows=64, shuffle_id=11, reduce_id=2,
+                  executor_id="", obs_port=0, private_mgr=False):
+    """``private_mgr=True`` gives the server its own catalog (not the
+    process singleton), so an in-process reader sees the blocks as
+    REMOTE-only — the single-process stand-in for a real peer."""
+    from spark_rapids_tpu.columnar.device import batch_to_device
+    from spark_rapids_tpu.shuffle.transport import ShuffleServer
+    TpuShuffleManager.reset()
+    mgr = TpuShuffleManager() if private_mgr else TpuShuffleManager.get()
+    for mid in range(n_maps):
+        rb = pa.record_batch({"a": pa.array(
+            [mid * 1000 + i for i in range(rows)], type=pa.int64())})
+        mgr.write_map_output(shuffle_id, mid,
+                             {reduce_id: batch_to_device(rb, xp=np)})
+    return mgr, ShuffleServer(mgr, executor_id=executor_id,
+                              obs_port=obs_port).start()
+
+
+def _rogue_server(script):
+    """One-connection server driving ``script(conn)`` — the injected
+    wire-fault side of a scenario."""
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+
+    def run():
+        conn, _ = lsock.accept()
+        try:
+            script(conn)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            lsock.close()
+
+    threading.Thread(target=run, daemon=True).start()
+    return port
+
+
+def _fresh_registry(local_id="test-local", port=0):
+    from spark_rapids_tpu.shuffle.registry import BlockLocationRegistry
+    BlockLocationRegistry.reset()
+    reg = BlockLocationRegistry.get()
+    reg.set_local(local_id, "127.0.0.1", port)
+    return reg
+
+
+def _reset_all():
+    from spark_rapids_tpu.shuffle import locality
+    from spark_rapids_tpu.shuffle.registry import BlockLocationRegistry
+    tr.uninstall()
+    install_aggregator(None)
+    locality.reset_pool()
+    BlockLocationRegistry.reset()
+    TpuShuffleManager.reset()
+    RemoteSpanStore.reset()
+    ClockSync.reset()
+    m.MetricsRegistry.reset_for_tests()
+
+
+# -- context + clock primitives ---------------------------------------------
+
+
+def test_trace_context_roundtrip_and_tenant_bound():
+    from spark_rapids_tpu.obs.fleet import new_trace_id
+    tid = new_trace_id()
+    ctx = TraceContext(tid, (1 << 61) + 7, tenant="team-a")
+    back = TraceContext.unpack(ctx.pack())
+    assert back.trace_id == tid
+    assert back.span_id == (1 << 61) + 7
+    assert back.tenant == "team-a"
+    # the context must stay header-sized: a hostile tenant string is
+    # truncated at pack time, never an oversized blob on the wire
+    huge = TraceContext(tid, 1, tenant="x" * 500)
+    assert len(huge.pack()) <= 25 + 64
+    assert TraceContext.unpack(huge.pack()).tenant == "x" * 64
+
+
+def test_clock_sync_estimate_and_min_rtt_retention():
+    # t0/t3 client clock, t1/t2 server clock: server runs 100ns ahead,
+    # 10ns each way on the wire, 5ns server turnaround
+    offset, rtt = ClockSync.estimate(0, 110, 115, 25)
+    assert offset == 100
+    assert rtt == 20
+    ClockSync.reset()
+    cs = ClockSync.get()
+    cs.observe("p", 0, 110, 115, 25)
+    # a later, noisier sample (bigger rtt) must NOT replace the tighter
+    # estimate: offset error is bounded by rtt/2
+    cs.observe("p", 0, 500, 505, 1000)
+    assert cs.offset_ns("p") == 100
+    assert cs.rtt_ns("p") == 20
+    cs.observe("p", 0, 105, 106, 12)  # tighter: replaces
+    assert cs.rtt_ns("p") == 11
+    ClockSync.reset()
+
+
+def test_remote_span_store_bounds_and_drain():
+    _reset_all()
+    try:
+        store = RemoteSpanStore.get()
+        store.configure(2, 3)
+        for i in range(5):
+            store.add("t1", {"spanId": i, "t0Ns": i, "t1Ns": i + 1})
+        assert len(store.peek_all()["t1"]) == 3  # per-trace cap
+        assert store.dropped == 2
+        assert m.counter(
+            "tpu_trace_remote_spans_dropped_total").value() == 2
+        store.add("t2", {"spanId": 10, "t0Ns": 0, "t1Ns": 1})
+        store.add("t3", {"spanId": 11, "t0Ns": 0, "t1Ns": 1})
+        # trace cap: oldest bucket ("t1") evicted, loss is visible
+        assert "t1" not in store.peek_all()
+        assert store.evicted_traces == 1
+        assert [s["spanId"] for s in store.drain("t2")] == [10]
+        assert store.drain("t2") == []  # pull semantics: gone
+        assert store.span_count() == 1
+    finally:
+        _reset_all()
+
+
+def test_parse_prometheus_totals_folds_histograms():
+    text = "\n".join([
+        "# HELP tpu_x_total help",
+        "# TYPE tpu_x_total counter",
+        'tpu_x_total{k="a"} 2',
+        'tpu_x_total{k="b"} 3',
+        'tpu_h_seconds_bucket{le="0.1"} 7',
+        "tpu_h_seconds_sum 1.5",
+        "tpu_h_seconds_count 9",
+        "tpu_g 4",
+    ])
+    totals = parse_prometheus_totals(text)
+    assert totals["tpu_x_total"] == 5.0
+    assert totals["tpu_h_seconds"] == 9.0  # _count stands for the family
+    assert "tpu_h_seconds_bucket" not in totals
+    assert "tpu_h_seconds_sum" not in totals
+    assert totals["tpu_g"] == 4.0
+
+
+# -- wire version negotiation ------------------------------------------------
+
+
+def test_hello_negotiates_v2_clock_and_identity():
+    from spark_rapids_tpu.shuffle.transport import ShuffleClient
+    _reset_all()
+    mgr, server = _serve_blocks(executor_id="peer-A", obs_port=9123)
+    try:
+        cli = ShuffleClient("127.0.0.1", server.port)
+        assert cli.peer_version is None
+        metas = cli.fetch_metadata(11, 2).wait(10.0)
+        assert len(metas) == 4
+        assert cli.peer_version == 2
+        assert cli.last_peer_version == 2
+        assert cli.peer_executor_id == "peer-A"
+        assert cli.peer_obs_port == 9123
+        # same process, same perf_counter_ns: offset is tiny, rtt real
+        assert cli.clock_offset_ns is not None
+        assert cli.clock_rtt_ns > 0
+        assert abs(cli.clock_offset_ns) < 1_000_000_000
+        assert ClockSync.get().offset_ns("peer-A") is not None
+        cli.close()
+    finally:
+        server.stop()
+        _reset_all()
+
+
+def test_new_client_pins_old_peer_to_v1():
+    """Direction 1 of mixed-version decode: a pre-v2 server answers
+    MSG_HELLO with a correlated bad_message error.  The client must pin
+    the peer to v1 and never emit a v2 frame at it — every byte the old
+    server sees must parse with the v1 struct."""
+    from spark_rapids_tpu.shuffle.transport import (
+        _FRAME, _recv_exact, MSG_ERROR, MSG_HELLO, MSG_METADATA_REQ,
+        MSG_METADATA_RESP, ShuffleClient)
+    seen_types = []
+
+    def old_server(conn):
+        for _ in range(2):
+            head = _recv_exact(conn, _FRAME.size)
+            mtype, rid, blen = _FRAME.unpack(head)
+            seen_types.append(mtype)
+            if blen:
+                _recv_exact(conn, blen)
+            if mtype == MSG_HELLO:
+                body = f"bad_message:unknown type {mtype}".encode()
+                conn.sendall(_FRAME.pack(MSG_ERROR, rid, len(body))
+                             + body)
+            else:
+                conn.sendall(_FRAME.pack(MSG_METADATA_RESP, rid, 4)
+                             + struct.pack("<i", 0))
+
+    cli = ShuffleClient("127.0.0.1", _rogue_server(old_server),
+                        timeout=10.0)
+    ctx = TraceContext("ab" * 16, 42, "t")
+    # a context in hand and STILL a v1 frame: the peer can't decode v2
+    assert cli.fetch_metadata(11, 2, ctx=ctx).wait(10.0) == []
+    assert cli.peer_version == 1
+    assert cli.last_peer_version == 1
+    assert seen_types == [MSG_HELLO, MSG_METADATA_REQ]
+    cli.close()
+
+
+def test_old_client_v1_frames_against_new_server():
+    """Direction 2: an old client speaks raw v1 frames with no hello at
+    a new server — responses must come back pure v1 with correct
+    correlation (the upgrade never strands the old fleet half)."""
+    from spark_rapids_tpu.shuffle.transport import (
+        _FRAME, MSG_METADATA_REQ, MSG_METADATA_RESP)
+    _reset_all()
+    mgr, server = _serve_blocks(n_maps=2)
+    try:
+        s = socket.create_connection(("127.0.0.1", server.port),
+                                     timeout=10.0)
+        body = struct.pack("<qq", 11, 2)
+        s.sendall(_FRAME.pack(MSG_METADATA_REQ, 77, len(body)) + body)
+        head = s.recv(_FRAME.size, socket.MSG_WAITALL)
+        mtype, rid, blen = _FRAME.unpack(head)
+        assert mtype == MSG_METADATA_RESP
+        assert rid == 77
+        resp = s.recv(blen, socket.MSG_WAITALL)
+        (n,) = struct.unpack_from("<i", resp, 0)
+        assert n == 2
+        s.close()
+    finally:
+        server.stop()
+        _reset_all()
+
+
+def test_unknown_version_request_refused_typed():
+    """A v2 frame from the FUTURE (version 3): the frozen prefix lets
+    the server correlate it, so the refusal is a typed bad_version
+    error on the right request id — not framing corruption."""
+    from spark_rapids_tpu.shuffle.errors import TpuShuffleVersionError
+    from spark_rapids_tpu.shuffle.transport import (
+        _FRAME, _FRAME2, _raise_peer_error, MSG_ERROR, MSG_METADATA_REQ,
+        WIRE_V2_MAGIC)
+    _reset_all()
+    mgr, server = _serve_blocks(n_maps=1)
+    try:
+        s = socket.create_connection(("127.0.0.1", server.port),
+                                     timeout=10.0)
+        body = struct.pack("<qq", 11, 2)
+        s.sendall(_FRAME2.pack(WIRE_V2_MAGIC, 3, MSG_METADATA_REQ, 99,
+                               len(body), 0) + body)
+        head = s.recv(_FRAME.size, socket.MSG_WAITALL)
+        mtype, rid, blen = _FRAME.unpack(head)
+        err = s.recv(blen, socket.MSG_WAITALL)
+        assert mtype == MSG_ERROR
+        assert rid == 99
+        assert err == b"bad_version:3"
+        s.close()
+        with pytest.raises(TpuShuffleVersionError) as ei:
+            _raise_peer_error(err)
+        assert ei.value.got == 3
+    finally:
+        server.stop()
+        _reset_all()
+
+
+def test_unknown_version_response_refused_typed():
+    """The client side of the same invariant: a peer answering with a
+    v2 frame of an unknown version fails typed, never a misparse."""
+    from spark_rapids_tpu.shuffle.errors import TpuShuffleVersionError
+    from spark_rapids_tpu.shuffle.transport import (
+        _FRAME, _FRAME2, _HELLO_RESP, _recv_exact, MSG_HELLO_RESP,
+        MSG_METADATA_RESP, WIRE_V2_MAGIC, ShuffleClient)
+
+    def future_server(conn):
+        head = _recv_exact(conn, _FRAME.size)
+        _, rid, blen = _FRAME.unpack(head)
+        _recv_exact(conn, blen)
+        body = _HELLO_RESP.pack(2, 0, 1, 2, 0, 0)
+        conn.sendall(_FRAME.pack(MSG_HELLO_RESP, rid, len(body)) + body)
+        head2 = _recv_exact(conn, _FRAME.size)  # the v1 metadata req
+        _, rid2, blen2 = _FRAME.unpack(head2)
+        _recv_exact(conn, blen2)
+        conn.sendall(_FRAME2.pack(WIRE_V2_MAGIC, 3, MSG_METADATA_RESP,
+                                  rid2, 0, 0))
+
+    cli = ShuffleClient("127.0.0.1", _rogue_server(future_server),
+                        timeout=10.0)
+    with pytest.raises(TpuShuffleVersionError):
+        cli.fetch_metadata(11, 2).wait(10.0)
+    cli.close()
+
+
+# -- producer serve spans + /spans pull -------------------------------------
+
+
+def test_serve_spans_recorded_parented_and_drained_over_http():
+    from spark_rapids_tpu.obs.health import MetricsServer
+    from spark_rapids_tpu.shuffle.transport import ShuffleClient
+    _reset_all()
+    obs = MetricsServer(0)
+    mgr, server = _serve_blocks(executor_id="peer-A",
+                                obs_port=obs.port)
+    try:
+        cli = ShuffleClient("127.0.0.1", server.port)
+        ctx = TraceContext("cd" * 16, 31, tenant="team-b")
+        metas = cli.fetch_metadata(11, 2, ctx=ctx).wait(10.0)
+        (sid, mid, rid, idx), _ = metas[0]
+        cli.fetch_block(sid, mid, rid, idx, ctx=ctx).wait(10.0)
+        spans = pull_remote_spans("127.0.0.1", obs.port, ctx.trace_id)
+        roots = {s["name"]: s for s in spans if s.get("remoteParent")}
+        assert set(roots) == {"shuffle.serve.metadata",
+                              "shuffle.serve.transfer"}
+        for root in roots.values():
+            assert root["parentId"] == 31  # the consumer's fetch span
+            assert root["proc"] == "peer-A"
+            assert root["attrs"]["tenant"] == "team-b"
+            assert root["t1Ns"] >= root["t0Ns"]
+        steps = {s["name"] for s in spans if not s.get("remoteParent")}
+        assert {"serve.decode", "serve.catalog_read", "serve.send",
+                "serve.serialize", "serve.compress"} <= steps
+        # every step child is parented under one of the two roots and
+        # timed inside this process's clock domain
+        root_ids = {r["spanId"] for r in roots.values()}
+        for s in spans:
+            if not s.get("remoteParent"):
+                assert s["parentId"] in root_ids
+        # drain semantics: the pull above emptied the bucket
+        assert pull_remote_spans("127.0.0.1", obs.port,
+                                 ctx.trace_id) == []
+        # the serve-side breakdown histogram moved for every step
+        hist = m.histogram("tpu_shuffle_serve_seconds",
+                           labelnames=("step",))
+        for step in ("decode", "catalog_read", "serialize", "compress",
+                     "send"):
+            assert hist.labels(step=step).count > 0, step
+        cli.close()
+    finally:
+        server.stop()
+        obs.close()
+        _reset_all()
+
+
+def test_requests_without_context_record_no_spans():
+    """Anti-vacuity for the store: plain v1-ish traffic (no context)
+    must not deposit spans — only the histogram moves."""
+    from spark_rapids_tpu.shuffle.transport import ShuffleClient
+    _reset_all()
+    mgr, server = _serve_blocks(executor_id="peer-A")
+    try:
+        cli = ShuffleClient("127.0.0.1", server.port)
+        metas = cli.fetch_metadata(11, 2).wait(10.0)
+        (sid, mid, rid, idx), _ = metas[0]
+        cli.fetch_block(sid, mid, rid, idx).wait(10.0)
+        assert RemoteSpanStore.get().span_count() == 0
+        assert m.histogram("tpu_shuffle_serve_seconds",
+                           labelnames=("step",)) \
+            .labels(step="send").count > 0
+        cli.close()
+    finally:
+        server.stop()
+        _reset_all()
+
+
+# -- consumer-side merge + orphan hygiene -----------------------------------
+
+
+def _fleet_read_setup(executor_id="peer-A"):
+    """In-process producer (server + obs endpoint) registered as the
+    remote owner of shuffle 11, with a live tracer installed."""
+    from spark_rapids_tpu.obs.health import MetricsServer
+    from spark_rapids_tpu.shuffle.registry import BlockEndpoint
+    obs = MetricsServer(0)
+    mgr, server = _serve_blocks(executor_id=executor_id,
+                                obs_port=obs.port, private_mgr=True)
+    reg = _fresh_registry("reduce-side")
+    reg.register(11, [BlockEndpoint(executor_id, "127.0.0.1",
+                                    server.port)])
+    trace = tr.install(tr.QueryTrace())
+    return obs, server, trace
+
+
+def test_fetch_group_merges_serve_spans_under_fetch_span():
+    from spark_rapids_tpu.shuffle import locality
+    obs, server, trace = _fleet_read_setup()
+    try:
+        blocks = list(locality.read_reduce_blocks(11, 2))
+        assert len(blocks) == 4
+        trace.finalize()
+        spans = trace.span_dicts()
+        fetch = [s for s in spans if s["name"] == "shuffle.fetch"]
+        assert len(fetch) == 1
+        assert fetch[0]["status"] == "ok"
+        assert fetch[0]["attrs"]["peer"] == "peer-A"
+        assert fetch[0]["attrs"]["blocks"] == 4
+        by_parent = {}
+        for s in spans:
+            by_parent.setdefault(s.get("parentId"), []).append(s)
+        serve_roots = [s for s in by_parent.get(fetch[0]["spanId"], [])
+                       if s.get("proc") == "peer-A"]
+        names = {s["name"] for s in serve_roots}
+        assert "shuffle.serve.metadata" in names
+        assert "shuffle.serve.transfer" in names
+        f0 = fetch[0]["startNs"]
+        f1 = f0 + fetch[0]["durNs"]
+        for root in serve_roots:
+            # skew-corrected and clamped: inside the parent interval
+            assert f0 <= root["startNs"]
+            assert root["startNs"] + root["durNs"] <= f1
+            for step in by_parent.get(root["spanId"], []):
+                assert root["startNs"] <= step["startNs"]
+                assert (step["startNs"] + step["durNs"]
+                        <= root["startNs"] + root["durNs"])
+        # 1 metadata root (+3 steps) and 4 transfer roots (+5 steps
+        # each): everything the producer recorded came home
+        assert trace.remote_spans_merged == \
+            sum(1 for s in spans if s.get("proc"))
+        assert trace.remote_spans_merged == 28
+        assert trace.remote_spans_lost == 0
+        assert m.counter(
+            "tpu_trace_remote_spans_merged_total").value() > 0
+        assert m.counter(
+            "tpu_trace_remote_spans_lost_total").value() == 0
+        # pull drained the producer's bucket: nothing left to leak
+        assert RemoteSpanStore.get().span_count() == 0
+    finally:
+        server.stop()
+        obs.close()
+        _reset_all()
+
+
+def test_spans_pull_failure_closes_fetch_span_with_spans_lost():
+    """Orphan hygiene: the read path delivered the data but /spans did
+    not answer — the fetch span must stay CLOSED, annotated spans_lost,
+    and the loss counted.  Observability loss never fails the read."""
+    from spark_rapids_tpu.obs import fleet
+    from spark_rapids_tpu.shuffle import locality
+    obs, server, trace = _fleet_read_setup()
+    real_pull = fleet.pull_remote_spans
+
+    def broken_pull(*a, **k):
+        raise OSError("obs endpoint gone")
+
+    fleet.pull_remote_spans = broken_pull
+    try:
+        blocks = list(locality.read_reduce_blocks(11, 2))
+        assert len(blocks) == 4  # the data still arrived
+        trace.finalize()
+        spans = trace.span_dicts()
+        fetch = [s for s in spans if s["name"] == "shuffle.fetch"]
+        assert len(fetch) == 1
+        assert fetch[0]["status"] == "ok"  # closed before the pull
+        assert fetch[0]["attrs"]["spans_lost"] is True
+        assert trace.remote_spans_lost == 1
+        assert trace.remote_spans_merged == 0
+        assert m.counter(
+            "tpu_trace_remote_spans_lost_total").value() == 1
+        assert trace.open_span_count() == 0
+    finally:
+        fleet.pull_remote_spans = real_pull
+        server.stop()
+        obs.close()
+        _reset_all()
+
+
+def test_dead_peer_closes_fetch_spans_typed_without_false_loss():
+    """A peer that never answered (connect refused) closes every fetch
+    attempt's span typed — and because no context ever crossed the
+    wire, NO spans_lost is counted (nothing remote exists to lose)."""
+    from spark_rapids_tpu.shuffle import locality
+    from spark_rapids_tpu.shuffle.registry import BlockEndpoint
+    _reset_all()
+    reg = _fresh_registry("reduce-side")
+    reg.register(11, [BlockEndpoint("gone", "127.0.0.1", 1)])
+    trace = tr.install(tr.QueryTrace())
+    try:
+        with pytest.raises(Exception):
+            list(locality.read_reduce_blocks(11, 2))
+        # hygiene: every fetch span closed; only the query root is open
+        assert trace.open_span_count() == 1
+        trace.finalize()
+        fetch = [s for s in trace.span_dicts()
+                 if s["name"] == "shuffle.fetch"]
+        assert fetch  # one per attempt
+        for f in fetch:
+            assert f["status"] == "error"
+            assert "spans_lost" not in f["attrs"]
+        assert trace.remote_spans_lost == 0
+        assert m.counter(
+            "tpu_trace_remote_spans_lost_total").value() == 0
+    finally:
+        _reset_all()
+
+
+# -- driver-side aggregation -------------------------------------------------
+
+
+def test_fleet_aggregator_rollup_and_dead_peer_verdict():
+    from spark_rapids_tpu.obs.health import MetricsServer
+    from spark_rapids_tpu.shuffle.heartbeat import HeartbeatManager
+    _reset_all()
+    m.counter("tpu_queries_completed_total").inc(3)
+    obs = MetricsServer(0)  # both peers expose THIS process's registry
+    hb = HeartbeatManager(timeout_s=30.0)
+    hb.register_executor("exec-1", "127.0.0.1", 7001, obs_port=obs.port)
+    hb.register_executor("exec-2", "127.0.0.1", 7002, obs_port=obs.port)
+    agg = FleetAggregator(hb, max_peers=4, timeout_s=5.0)
+    try:
+        peers = agg.scrape()
+        assert set(peers) == {"exec-1", "exec-2"}
+        for e in peers.values():
+            assert e["scraped"] is True
+            assert e["health"] == "ok"
+        up = m.gauge("tpu_fleet_peer_up", labelnames=("peer",))
+        assert up.value(peer="exec-1") == 1
+        assert up.value(peer="exec-2") == 1
+        rollup = m.gauge("tpu_fleet_rollup",
+                         labelnames=("peer", "name"))
+        for pid in ("exec-1", "exec-2"):
+            assert rollup.value(
+                peer=pid, name="tpu_queries_completed_total") == 3
+        assert m.gauge("tpu_fleet_peers_live").value() == 2
+        assert agg.verdict()["status"] == "ok"
+        # exec-2 stops heartbeating: the fleet degrades and says why
+        hb._peers["exec-2"].last_heartbeat -= 10_000
+        verdict = agg.verdict()
+        assert verdict["status"] == "degraded"
+        assert any("exec-2" in r and "dead" in r
+                   for r in verdict["reasons"])
+        assert up.value(peer="exec-2") == 0
+        assert up.value(peer="exec-1") == 1
+        assert m.gauge("tpu_fleet_peers_live").value() == 1
+        # the dead peer is remembered until explicitly forgotten
+        agg.forget_peer("exec-2")
+        assert agg.verdict()["status"] == "ok"
+    finally:
+        obs.close()
+        _reset_all()
+
+
+def test_scrape_cap_bounds_peer_cardinality():
+    from spark_rapids_tpu.shuffle.heartbeat import HeartbeatManager
+    _reset_all()
+    hb = HeartbeatManager(timeout_s=30.0)
+    for i in range(5):
+        hb.register_executor(f"e{i}", "127.0.0.1", 7000 + i, obs_port=0)
+    agg = FleetAggregator(hb, max_peers=2, timeout_s=1.0)
+    try:
+        peers = agg.scrape()
+        assert len(peers) == 2
+        assert m.counter("tpu_fleet_peers_skipped_total").value() == 3
+    finally:
+        _reset_all()
+
+
+def test_healthz_carries_fleet_verdict():
+    from spark_rapids_tpu.obs.health import HealthMonitor
+    from spark_rapids_tpu.shuffle.heartbeat import HeartbeatManager
+    _reset_all()
+    hb = HeartbeatManager(timeout_s=30.0)
+    hb.register_executor("exec-1", "127.0.0.1", 7001, obs_port=0)
+    agg = install_aggregator(FleetAggregator(hb, timeout_s=1.0))
+    try:
+        agg.scrape()
+        snap = HealthMonitor().snapshot()
+        assert snap["components"]["fleet"]["status"] == "ok"
+        hb._peers["exec-1"].last_heartbeat -= 10_000
+        agg.scrape()
+        snap = HealthMonitor().snapshot()
+        assert snap["status"] == "degraded"
+        fleet_comp = snap["components"]["fleet"]
+        assert fleet_comp["status"] == "degraded"
+        assert any("exec-1" in r for r in fleet_comp["signals"]["reasons"])
+    finally:
+        _reset_all()
+
+
+# -- cross-process end-to-end ------------------------------------------------
+
+
+def test_cross_process_merged_trace_e2e():
+    """The fleet observatory's acceptance shape in one test: a child
+    process serves both join sides; this process fetches with a live
+    tracer.  The merged trace must show the child's serve spans (its
+    clock domain, skew-corrected) nested under our fetch spans, with
+    zero lost spans and the child's span buffer fully drained."""
+    from spark_rapids_tpu.obs.export import fleet_summary
+    from spark_rapids_tpu.shuffle import locality
+    from spark_rapids_tpu.shuffle.registry import BlockEndpoint
+    from spark_rapids_tpu.shuffle.serve_map import DIM_SID, FACT_SID
+    rows, parts = 4000, 2
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SPARK_RAPIDS_TPU_DISABLE_COMPILE_CACHE="1")
+    child = subprocess.Popen(
+        [sys.executable, "-m", "spark_rapids_tpu.shuffle.serve_map",
+         "--rows", str(rows), "--parts", str(parts),
+         "--codec", "lz4", "--seed", "13",
+         "--executor-id", "map-side"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, env=env, cwd=REPO)
+    _reset_all()
+    reg = _fresh_registry("reduce-side")
+    trace = tr.install(tr.QueryTrace())
+    try:
+        line = child.stdout.readline()
+        fields = line.split()
+        assert fields[0] == "PORT" and fields[2] == "OBS", line
+        port, obs_port = int(fields[1]), int(fields[3])
+        assert obs_port > 0
+        ep = BlockEndpoint("map-side", "127.0.0.1", port)
+        reg.register(FACT_SID, [ep])
+        reg.register(DIM_SID, [ep])
+        n_blocks = 0
+        for shuffle_sid in (FACT_SID, DIM_SID):
+            for pid in range(parts):
+                n_blocks += len(list(
+                    locality.read_reduce_blocks(shuffle_sid, pid)))
+        assert n_blocks > 0
+        trace.finalize()
+        spans = trace.span_dicts()
+        by_parent = {}
+        for s in spans:
+            by_parent.setdefault(s.get("parentId"), []).append(s)
+        fetch = [s for s in spans if s["name"] == "shuffle.fetch"]
+        assert len(fetch) == parts * 2  # one per (shuffle, partition)
+        for f in fetch:
+            assert f["status"] == "ok"
+            kids = by_parent.get(f["spanId"], [])
+            serve_roots = [k for k in kids if k.get("proc")]
+            names = {k["name"] for k in serve_roots}
+            assert "shuffle.serve.metadata" in names, f
+            assert "shuffle.serve.transfer" in names, f
+            f0, f1 = f["startNs"], f["startNs"] + f["durNs"]
+            for root in serve_roots:
+                assert root["proc"] == "map-side"
+                # the child's perf_counter_ns epoch is unrelated to
+                # ours: only the offset correction can land these
+                # inside the parent — monotone within each parent
+                assert f0 <= root["startNs"]
+                assert root["startNs"] + root["durNs"] <= f1
+                for step in by_parent.get(root["spanId"], []):
+                    assert root["startNs"] <= step["startNs"]
+                    assert (step["startNs"] + step["durNs"]
+                            <= root["startNs"] + root["durNs"])
+        assert trace.remote_spans_merged > 0
+        assert trace.remote_spans_lost == 0
+        assert m.counter(
+            "tpu_trace_remote_spans_lost_total").value() == 0
+        # the tools-facing rollups agree with the raw spans
+        summary = fleet_summary(spans)
+        peer = summary["peers"]["map-side"]
+        assert peer["fetches"] == parts * 2
+        assert peer["serveNs"] > 0
+        assert peer["spansLost"] == 0
+        chrome = trace.to_chrome()
+        lanes = {e["args"]["name"] for e in chrome["traceEvents"]
+                 if e.get("ph") == "M"}
+        assert "map-side" in lanes  # its own Perfetto process lane
+        child.stdin.write("done\n")
+        child.stdin.flush()
+        stats = json.loads(child.stdout.readline()[len("STATS "):])
+        assert stats["unpulled_spans"] == 0  # every span came home
+        assert stats["serve_seconds_by_step"]["serialize"] > 0
+        assert stats["serve_seconds_by_step"]["send"] > 0
+        assert child.wait(timeout=30) == 0
+    finally:
+        child.stdin.close()
+        child.stdout.close()
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+        _reset_all()
