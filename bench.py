@@ -131,7 +131,8 @@ def queries(session, fact, dim, pq_path, out_root):
 
 def time_engine(enabled: bool, fact, dim, pq_path, out_root,
                 repeats: int = 3, trace: bool = False,
-                eventlog_dir: str = None, metrics: bool = None):
+                eventlog_dir: str = None, metrics: bool = None,
+                hbm: bool = None):
     from spark_rapids_tpu.api.session import TpuSession
     extra = {}
     if enabled and os.environ.get("BENCH_TRANSPORT"):
@@ -143,6 +144,8 @@ def time_engine(enabled: bool, fact, dim, pq_path, out_root,
         extra["spark.rapids.tpu.eventLog.dir"] = eventlog_dir
     if metrics is not None:
         extra["spark.rapids.tpu.metrics.enabled"] = metrics
+    if hbm is not None:
+        extra["spark.rapids.tpu.hbm.timeline.enabled"] = hbm
     b = TpuSession.builder().config("spark.rapids.sql.enabled", enabled)
     for k, v in extra.items():
         b = b.config(k, v)
@@ -617,6 +620,62 @@ def measure_metrics_overhead(fact, dim, pq_path, out_root) -> float:
     return 100.0 * (floor(True) - base) / base
 
 
+def measure_hbm_overhead(fact, dim, pq_path, out_root,
+                         trace_out: str = None) -> float:
+    """HBM-observatory overhead guard: the suite with the memory
+    timeline feeding vs fully disabled.  The acceptance bar is <5% —
+    every lifecycle hook is a dict update + bounded ring append under
+    one lock, published to gauges outside it, so the budget holds.
+
+    Like the metrics guard, each arm runs twice and keeps its noise
+    floor (the minimum): systematic overhead survives a minimum,
+    scheduler hiccups do not.
+
+    When ``trace_out`` is set, one extra traced+timeline run exports
+    its Chrome trace there so the HBM counter tracks ("ph": "C",
+    ``HBM <tenant>``) land next to the operator spans for eyeballing
+    in Perfetto."""
+    def floor(hbm_on):
+        totals = []
+        for _ in range(2):
+            t, _c = time_engine(True, fact, dim, pq_path, out_root,
+                                hbm=hbm_on)
+            totals.append(sum(t.values()))
+        return min(totals)
+
+    base = floor(False)
+    pct = 100.0 * (floor(True) - base) / base
+    if trace_out:
+        _hbm_trace_export(fact, dim, pq_path, out_root, trace_out)
+    return pct
+
+
+def _hbm_trace_export(fact, dim, pq_path, out_root,
+                      trace_out: str) -> None:
+    """One traced run of the suite's agg query with the timeline on,
+    Chrome trace (operator spans + HBM counter tracks) to a file."""
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.session import TpuSession
+    s = (TpuSession.builder()
+         .config("spark.rapids.sql.enabled", True)
+         .config("spark.rapids.tpu.trace.enabled", True)
+         .config("spark.rapids.tpu.hbm.timeline.enabled", True)
+         .get_or_create())
+    out = (s.create_dataframe(fact)
+           .group_by(col("k"))
+           .agg(F.sum(col("v")).alias("sv"))
+           .collect())
+    assert out.num_rows > 0
+    tr = s.last_query_trace()
+    if tr is None:
+        return
+    with open(trace_out, "w") as f:
+        json.dump(tr.to_chrome(), f)
+    print(f"bench --hbm-overhead: Chrome trace with HBM counter "
+          f"tracks -> {trace_out}", file=sys.stderr)
+
+
 # ---------------------------------------------------------------------------
 # --serve: sustained-QPS serving benchmark (pool + byte-weighted admission)
 # ---------------------------------------------------------------------------
@@ -769,6 +828,19 @@ def measure_serve(fact, dim, pq_path, concurrency: int = 8,
     poolN.drain(timeout=30)
     c1 = counters()
     ctrl = AdmissionController.get()
+    # HBM observatory rollup: per-tenant peak device occupancy and how
+    # much of that peak was demotable (spillable-now) — the co-running
+    # headroom story per pool tenant (obs/memprof.py)
+    from spark_rapids_tpu.obs.memprof import MemoryTimeline
+    hbm_rep = MemoryTimeline.get().report()
+    hbm_tenants = {}
+    for tenant, row in sorted(hbm_rep.get("tenants", {}).items()):
+        pk = int(row.get("peak_bytes", 0))
+        dm = int(row.get("peak_demotable_bytes", 0))
+        hbm_tenants[tenant] = {
+            "peak_device_bytes": pk,
+            "demotable_share": round(dm / pk, 4) if pk else 0.0,
+        }
 
     def pct(lats, p):
         srt = sorted(lats)
@@ -804,6 +876,14 @@ def measure_serve(fact, dim, pq_path, concurrency: int = 8,
         "dirty_ledgers": int(delta["dirty_ledgers"]),
         "accounting_drift": int(
             delta["admitted"] - delta["completed"] - delta["failed"]),
+        "hbm": {
+            "enabled": bool(hbm_rep.get("enabled")),
+            "total_peak_bytes": int(hbm_rep.get("peak_bytes", 0)),
+            "demotable_bytes": int(hbm_rep.get("demotable_bytes", 0)),
+            "unattributed_events": int(
+                hbm_rep.get("unattributed_events", 0)),
+            "tenants": hbm_tenants,
+        },
     }
 
 
@@ -826,6 +906,9 @@ def serve_fingerprint(serve: dict) -> dict:
         },
         "serve_p50_ms": serve["p50_ms"],
         "serve_p99_ms": serve["p99_ms"],
+        # advisory (never diffed — byte peaks are data-layout noise):
+        # per-tenant HBM peaks + demotable share from the observatory
+        "serve_hbm": serve.get("hbm", {}),
     }
 
 
@@ -1281,6 +1364,8 @@ def main():
     with_pyspark = "--baseline=pyspark" in sys.argv[1:]
     with_trace_guard = "--trace-overhead" in sys.argv[1:]
     with_metrics_guard = "--metrics-overhead" in sys.argv[1:]
+    with_hbm_guard = "--hbm-overhead" in sys.argv[1:]
+    hbm_trace_out = _arg_value("--trace-out")
     with_compile_report = "--compile-report" in sys.argv[1:]
     with_accuracy = "--accuracy" in sys.argv[1:]
     with_record = "--record" in sys.argv[1:]
@@ -1355,6 +1440,7 @@ def main():
     spark_cpu = None
     trace_overhead = None
     metrics_overhead = None
+    hbm_overhead = None
     regress_rc = 0
     try:
         pq_path = write_parquet_input(fact, root)
@@ -1369,6 +1455,9 @@ def main():
         if with_metrics_guard:
             metrics_overhead = measure_metrics_overhead(
                 fact, dim, pq_path, root)
+        if with_hbm_guard:
+            hbm_overhead = measure_hbm_overhead(
+                fact, dim, pq_path, root, trace_out=hbm_trace_out)
         if with_record or with_check:
             regress_rc = record_history(history_dir, eventlog_dir,
                                         with_check, wall_threshold)
@@ -1428,6 +1517,8 @@ def main():
         out["trace_overhead_pct"] = round(trace_overhead, 2)
     if metrics_overhead is not None:
         out["metrics_overhead_pct"] = round(metrics_overhead, 2)
+    if hbm_overhead is not None:
+        out["hbm_overhead_pct"] = round(hbm_overhead, 2)
     if is_cpu_fallback:
         # honest provenance: a real rows/s number, measured on the CPU
         # backend because the accelerator probe failed — never a 0.0
@@ -1442,6 +1533,10 @@ def main():
     if metrics_overhead is not None and metrics_overhead > 2.0:
         print(f"METRICS OVERHEAD GUARD FAILED: "
               f"{metrics_overhead:.2f}% > 2%", file=sys.stderr)
+        sys.exit(1)
+    if hbm_overhead is not None and hbm_overhead > 5.0:
+        print(f"HBM OVERHEAD GUARD FAILED: {hbm_overhead:.2f}% > 5%",
+              file=sys.stderr)
         sys.exit(1)
     if regress_rc:
         sys.exit(regress_rc)

@@ -99,6 +99,16 @@ with the dead peer's fetch span closed typed — anti-vacuity both ways:
 the clean half must actually merge spans, the degraded half must
 actually degrade.
 
+--hbm runs the HBM-observatory gate: a golden replay where the tenant
+memory timeline, the memsan shadow ledger and the spill catalog must
+agree byte-for-byte on peak device occupancy, then a 4-session pool
+stress where every lifecycle event must book under its pool tenant
+(zero unattributed) with the tpu_hbm_tenant_bytes gauge family summing
+to the timeline's live total — anti-vacuity both ways: an allocation
+injected from a context-free thread must trip the unattributed
+counter, and an injected operator failure must leave exactly one
+parseable post-mortem bundle naming the failing operator and tenant.
+
     python devtools/run_lint.py                    # repo check
     python devtools/run_lint.py --update-baseline  # re-freeze debt
     python devtools/run_lint.py --interp           # plan typechecker gate
@@ -111,6 +121,7 @@ actually degrade.
     python devtools/run_lint.py --csan             # concurrency-sanitizer gate
     python devtools/run_lint.py --feedback         # estimator-observatory gate
     python devtools/run_lint.py --fleet            # fleet-observatory gate
+    python devtools/run_lint.py --hbm              # HBM-observatory gate
 """
 
 import json
@@ -1422,6 +1433,231 @@ def run_serve_gate() -> int:
     return 0
 
 
+def run_hbm_gate() -> int:
+    """HBM-observatory gate (obs/memprof.py): (1) golden replay where
+    three independent sinks must agree — the tenant timeline's
+    spill-backed peak, the memsan shadow ledger's measured peak and the
+    spill catalog's registered device bytes, all equal and nonzero, and
+    the tpu_hbm_tenant_bytes gauge family must sum to the timeline's
+    live total; (2) a 4-session pool stress where pool tenants book
+    their own occupancy and ZERO events go unattributed; (3)
+    anti-vacuity — an allocation injected from a context-free thread
+    MUST count as unattributed, and an injected operator failure MUST
+    leave exactly one well-formed post-mortem bundle naming the failing
+    operator and the owning tenant."""
+    import concurrent.futures as cf
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.pool import SessionPool
+    from spark_rapids_tpu.columnar.device import batch_to_device
+    from spark_rapids_tpu.expr.window import WindowBuilder
+    from spark_rapids_tpu.memory.admission import AdmissionController
+    from spark_rapids_tpu.memory.spill import SpillCatalog
+    from spark_rapids_tpu.obs import metrics as m
+    from spark_rapids_tpu.obs import postmortem as pm
+    from spark_rapids_tpu.obs.memprof import MemoryTimeline
+    from spark_rapids_tpu.obs.metrics import MetricsRegistry
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+
+    failures = 0
+    MetricsRegistry.reset_for_tests()
+    with SpillCatalog._lock:
+        SpillCatalog._instance = SpillCatalog()
+    TpuShuffleManager.reset()
+    AdmissionController.reset_for_tests()
+    MemoryTimeline.reset_for_tests()
+
+    n = 4000
+    rng = np.random.default_rng(7)
+    fact = pa.table({
+        "k": pa.array(rng.integers(0, 97, n).astype(np.int64)),
+        "v": pa.array(rng.integers(-1000, 1000, n).astype(np.int64)),
+    })
+    dim = pa.table({
+        "k": pa.array(np.arange(97, dtype=np.int64)),
+        "w": pa.array(np.arange(97, dtype=np.int64) * 10),
+    })
+    pmdir = tempfile.mkdtemp(prefix="tpu_hbm_gate_pm_")
+    pool = SessionPool(4, {
+        "spark.rapids.sql.enabled": "true",
+        "spark.rapids.tpu.memsan.enabled": "true",
+        "spark.rapids.tpu.trace.enabled": "true",
+        "spark.rapids.tpu.singleChipFuse": "off",
+        "spark.rapids.tpu.hbm.postmortem.dir": pmdir,
+    })
+
+    def mk_mix(s):
+        fdf = s.create_dataframe(fact)
+        fdf4 = s.create_dataframe(fact, num_partitions=4)
+        ddf2 = s.create_dataframe(dim, num_partitions=2)
+        w = WindowBuilder().partition_by(col("k")).order_by(col("v"))
+        return {
+            "agg": lambda: (fdf.group_by(col("k"))
+                            .agg(F.sum(col("v")).alias("sv"),
+                                 F.count("*").alias("c")).collect()),
+            "join": lambda: (fdf4.join(ddf2, on="k", how="inner")
+                             .group_by(col("k"))
+                             .agg(F.sum(col("w")).alias("sw"))
+                             .collect()),
+            "window": lambda: (fdf.select(
+                col("k"), col("v"),
+                F.row_number().over(w).alias("rn")).collect()),
+            "sort": lambda: fdf.sort(col("k"), col("v")).collect(),
+        }
+
+    mixes = {id(s): mk_mix(s) for s in pool._sessions}
+    tl = MemoryTimeline.get()
+
+    # (1) golden replay: one fresh query, three sinks must agree
+    with pool.session() as s:
+        out = mixes[id(s)]["agg"]()
+        assert out.num_rows > 0
+        memsan_peak = int(s.last_peak_device_bytes or 0)
+    timeline_peak = int(tl.report().get("peak_spill_backed_bytes", 0))
+    catalog_live = int(SpillCatalog.get().device_bytes_registered())
+    if not (timeline_peak > 0
+            and timeline_peak == memsan_peak == catalog_live):
+        failures += 1
+        print(f"HBM: three sinks disagree after the golden replay: "
+              f"timeline {timeline_peak}, memsan {memsan_peak}, "
+              f"spill catalog {catalog_live}")
+
+    # (2) pool stress: every event attributed, gauges reconcile
+    worklist = [name for name in sorted(mixes[id(pool._sessions[0])])
+                for _ in range(4)]
+
+    def one(name):
+        with pool.session() as s:
+            out = mixes[id(s)][name]()
+            assert out.num_rows > 0
+
+    with cf.ThreadPoolExecutor(max_workers=4) as ex:
+        list(ex.map(one, worklist))
+    pool.drain(timeout=60)
+    rep = tl.report()
+    booked = sorted(t for t in rep.get("tenants", {})
+                    if t.startswith("pool-"))
+    if len(booked) < 2:
+        failures += 1
+        print(f"HBM: pool stress booked occupancy for {booked} only — "
+              f"per-tenant attribution is vacuous")
+    if rep.get("unattributed_events", 0):
+        failures += 1
+        print(f"HBM: {rep['unattributed_events']} event(s) went "
+              f"unattributed under the pool stress")
+    gauge_total = int(m.gauge("tpu_hbm_tenant_bytes",
+                              labelnames=("tenant", "class")).total())
+    live_total = int(tl.live_bytes())
+    if gauge_total != live_total:
+        failures += 1
+        print(f"HBM: tpu_hbm_tenant_bytes gauges sum to {gauge_total} "
+              f"but the timeline holds {live_total} live bytes")
+
+    # (3a) anti-vacuity: a context-free allocation MUST go unattributed
+    before = int(tl.report().get("unattributed_events", 0))
+    rogue_rb = pa.record_batch(
+        {"x": pa.array(np.arange(256, dtype=np.int64))})
+    holder = {}
+
+    def rogue():
+        holder["sb"] = SpillCatalog.get().register(
+            batch_to_device(rogue_rb, xp=np))
+
+    t = threading.Thread(target=rogue)
+    t.start()
+    t.join()
+    after = int(tl.report().get("unattributed_events", 0))
+    if after <= before:
+        failures += 1
+        print("HBM: injected context-free allocation did NOT count as "
+              "unattributed — the attribution check is vacuous")
+    if holder.get("sb") is not None:
+        holder["sb"].close()
+
+    # (3b) anti-vacuity: injected operator failure -> exactly one
+    # well-formed post-mortem bundle
+    from spark_rapids_tpu.exec import basic as exec_basic
+    from spark_rapids_tpu.exec.base import _wrap_execute_partition
+    real_execute = exec_basic.FilterExec.execute_partition
+
+    def boom(self, pid, ctx):
+        # generator, so the raise happens at first pull — inside the
+        # operator span the flight recorder opens for FilterExec
+        raise RuntimeError("hbm gate injected operator failure")
+        yield
+
+    exec_basic.FilterExec.execute_partition = \
+        _wrap_execute_partition(boom)
+    raised = False
+    try:
+        with pool.session() as s:
+            try:
+                (s.create_dataframe(fact)
+                 .filter(col("v") > 0)
+                 .group_by(col("k"))
+                 .agg(F.sum(col("v")).alias("sv"))
+                 .collect())
+            except Exception:
+                raised = True
+    finally:
+        exec_basic.FilterExec.execute_partition = real_execute
+    if not raised:
+        failures += 1
+        print("HBM: injected operator failure did not raise")
+    bundles = pm.list_bundles(pmdir)
+    if len(bundles) != 1:
+        failures += 1
+        print(f"HBM: expected exactly one post-mortem bundle, found "
+              f"{len(bundles)} in {pmdir}")
+    else:
+        try:
+            doc = pm.load_bundle(bundles[0])
+            op = (doc.get("failing_operator") or {}).get("operator", "")
+            rendered = pm.render_postmortem(doc)
+            bad = []
+            if doc.get("kind") != "query_failure":
+                bad.append(f"kind={doc.get('kind')!r}")
+            if not str(doc.get("tenant", "")).startswith("pool-"):
+                bad.append(f"tenant={doc.get('tenant')!r}")
+            if "FilterExec" not in op:
+                bad.append(f"failing_operator={op!r}")
+            if "report" not in (doc.get("hbm") or {}):
+                bad.append("missing hbm report")
+            if "FilterExec" not in rendered:
+                bad.append("render omits the failing operator")
+            if bad:
+                failures += 1
+                print("HBM: post-mortem bundle malformed: "
+                      + ", ".join(bad))
+        except Exception as ex:
+            failures += 1
+            print(f"HBM: post-mortem bundle unparseable: {ex!r}")
+
+    pool.close()
+    shutil.rmtree(pmdir, ignore_errors=True)
+    MetricsRegistry.reset_for_tests()
+    AdmissionController.reset_for_tests()
+    MemoryTimeline.reset_for_tests()
+    if failures:
+        print(f"hbm gate: {failures} failure(s)")
+        return 1
+    print(f"hbm gate clean (three sinks agreed at {timeline_peak} "
+          f"bytes; {len(worklist)} pooled queries booked "
+          f"{len(booked)} tenants with zero unattributed events and "
+          f"gauges reconciling at {live_total} live bytes; injected "
+          f"rogue allocation tripped the attribution check; injected "
+          f"operator failure left exactly one parseable post-mortem "
+          f"bundle naming FilterExec)")
+    return 0
+
+
 # anti-vacuity fixtures for the csan gate: each must trip exactly its
 # rule.  Self-contained modules the analyzer resolves without the repo.
 _CSAN_ABBA_SRC = '''
@@ -2186,6 +2422,8 @@ def main(argv=None):
         return run_feedback_gate()
     if "--fleet" in args:
         return run_fleet_gate()
+    if "--hbm" in args:
+        return run_hbm_gate()
     from spark_rapids_tpu.tools.__main__ import main as tools_main
     cli = ["lint", "--repo", "--baseline", BASELINE]
     if "--update-baseline" in args:

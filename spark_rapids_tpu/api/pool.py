@@ -115,6 +115,27 @@ class SessionPool:
         with self.session(timeout) as s:
             return fn(s)
 
+    # -- observability --------------------------------------------------------
+    def hbm_report(self) -> Dict:
+        """Pool-level HBM occupancy rollup: the process-wide observatory
+        report (the timeline is a singleton — every pool session's
+        queries book into it under their ``pool-<i>`` tenant) plus a
+        whale line: which tenant holds the most resident bytes right
+        now, and each tenant's share of the pool total."""
+        from ..obs.memprof import MemoryTimeline
+        rep = MemoryTimeline.get().report()
+        total = rep.get("total_bytes") or 0
+        whale, whale_bytes = None, 0
+        for tenant, row in rep.get("tenants", {}).items():
+            resident = row.get("resident_bytes", 0)
+            row["share"] = round(resident / total, 4) if total else 0.0
+            if resident > whale_bytes:
+                whale, whale_bytes = tenant, resident
+        rep["pool_size"] = self.size
+        rep["whale_tenant"] = whale
+        rep["whale_bytes"] = whale_bytes
+        return rep
+
     # -- lifecycle ------------------------------------------------------------
     def drain(self, timeout: Optional[float] = None) -> None:
         """Block until every session is idle (all in-flight queries

@@ -129,6 +129,15 @@ class TpuSession:
             self.device_manager = None
             self.semaphore = None
             self.spill_catalog = None
+        # HBM observatory: the process-wide occupancy timeline every
+        # spill/arena/broadcast/admission hook feeds (obs/memprof.py).
+        # Configured after plugin init so the device budget is known.
+        from ..obs.memprof import MemoryTimeline
+        MemoryTimeline.configure(
+            enabled=conf.get(cfg.HBM_TIMELINE_ENABLED),
+            max_samples=conf.get(cfg.HBM_TIMELINE_MAX_SAMPLES),
+            budget_bytes=self.spill_catalog.device_budget
+            if self.spill_catalog is not None else 0)
         # after plugin init: the cold-cache probe reads the persistent
         # compile cache dir the plugin just configured
         self._init_sort_mode(conf)
@@ -331,6 +340,7 @@ class TpuSession:
         return result
 
     def _execute(self, lp: L.LogicalPlan) -> pa.Table:
+        from ..obs import memprof
         from ..obs import tracer as obs
         conf = self.conf
         if conf.get(cfg.CSAN_ENABLED):
@@ -341,30 +351,45 @@ class TpuSession:
             lockwitness.ensure_installed()
         eventlog_dir = conf.get(cfg.EVENT_LOG_DIR)
         tracing = conf.get(cfg.TRACE_ENABLED) or eventlog_dir is not None
-        if not tracing:
-            return self._execute_query(lp, None, None)
-        # flight recorder: one QueryTrace per execute(); the installed
-        # tracer is what every instrumented layer (operator spans,
-        # spill/shuffle/ICI/bridge events) records into
-        tracer = obs.QueryTrace(max_spans=conf.get(cfg.TRACE_MAX_SPANS))
-        if self._obs_isolation:
-            obs.install_local(tracer)
-        else:
-            obs.install(tracer)
-        self._last_trace = tracer
-        self._obs_plan = None
+        # HBM observatory attribution scope: every spill/arena event on
+        # this thread books under (tenant, query) until the query ends
+        memprof.push_context(getattr(self, "_tenant", "") or "default",
+                             f"q{self._sql_counter}")
         try:
-            return self._execute_query(lp, tracer, eventlog_dir)
-        except BaseException as ex:
-            # failed queries flush too: spans close with the exception
-            # recorded, the event log gets a JobFailed group
-            self._flush_query_obs(tracer, ex, eventlog_dir)
-            raise
-        finally:
+            if not tracing:
+                try:
+                    return self._execute_query(lp, None, None)
+                except BaseException as ex:
+                    self._maybe_postmortem(ex, None)
+                    raise
+            # flight recorder: one QueryTrace per execute(); the
+            # installed tracer is what every instrumented layer
+            # (operator spans, spill/shuffle/ICI/bridge events) records
+            tracer = obs.QueryTrace(
+                max_spans=conf.get(cfg.TRACE_MAX_SPANS))
             if self._obs_isolation:
-                obs.uninstall_local()
+                obs.install_local(tracer)
             else:
-                obs.uninstall()
+                obs.install(tracer)
+            self._last_trace = tracer
+            self._obs_plan = None
+            try:
+                return self._execute_query(lp, tracer, eventlog_dir)
+            except BaseException as ex:
+                # failed queries flush too: spans close with the
+                # exception recorded, the event log gets a JobFailed
+                # group; the black box dumps AFTER the flush so the
+                # bundle sees the sealed trace
+                self._flush_query_obs(tracer, ex, eventlog_dir)
+                self._maybe_postmortem(ex, tracer)
+                raise
+            finally:
+                if self._obs_isolation:
+                    obs.uninstall_local()
+                else:
+                    obs.uninstall()
+        finally:
+            memprof.pop_context()
 
     def _execute_query(self, lp: L.LogicalPlan, tracer,
                        eventlog_dir) -> pa.Table:
@@ -619,6 +644,15 @@ class TpuSession:
         snap["prometheus"] = render_prometheus()
         return snap
 
+    def hbm_report(self) -> Dict:
+        """The HBM observatory's occupancy-attribution answer: each
+        tenant's resident device bytes split into pinned vs demotable
+        (spillable-now) vs closed-pending, plus staging-arena fill and
+        admission reservations (obs/memprof.py).  Returns a
+        disabled-shaped report when hbm.timeline.enabled is off."""
+        from ..obs.memprof import MemoryTimeline
+        return MemoryTimeline.get().report()
+
     # -- flight recorder ----------------------------------------------------
     def last_query_trace(self):
         """The obs.QueryTrace of the last traced query (None when both
@@ -702,6 +736,39 @@ class TpuSession:
             if error is None:
                 raise  # an unwritable event log must surface somewhere
             # ...but never by masking the query's own failure
+
+    def _maybe_postmortem(self, error, tracer) -> None:
+        """Failure black box: dump a bounded post-mortem bundle for a
+        failed query (operator error, dirty memsan ledger, admission
+        timeout — they all unwind through here).  Strictly best-effort:
+        a black-box crash must never mask the query's own error."""
+        try:
+            conf = self.conf
+            if not conf.get(cfg.HBM_POSTMORTEM_ENABLED):
+                return
+            out_dir = conf.get(cfg.HBM_POSTMORTEM_DIR) or \
+                conf.get(cfg.REGRESS_HISTORY_DIR)
+            if not out_dir:
+                return
+            from ..obs.postmortem import dump_postmortem
+            path = dump_postmortem(
+                out_dir, error, session=self, tracer=tracer,
+                plan=self._obs_plan,
+                tenant=getattr(self, "_tenant", "") or "default",
+                max_bundles=conf.get(cfg.HBM_POSTMORTEM_MAX_BUNDLES))
+            if path and tracer is not None:
+                # point the self-emitted event log at the bundle: the
+                # writer records the sealed trace's spans, so a late
+                # instant span is visible in the JobFailed group
+                eventlog_dir = conf.get(cfg.EVENT_LOG_DIR)
+                if eventlog_dir:
+                    try:
+                        writer = self._event_log_writer(eventlog_dir)
+                        writer.write_postmortem_pointer(path)
+                    except Exception:
+                        pass
+        except Exception:
+            pass
 
     def _event_log_writer(self, directory: str):
         w = self._obs_writer

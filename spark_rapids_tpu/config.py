@@ -917,6 +917,56 @@ FEEDBACK_REPLAN_FACTOR = conf(
     .check(lambda v: v > 1.0, "must be > 1") \
     .create_with_default(4.0)
 
+# --- HBM observatory (obs/memprof.py) -------------------------------------
+
+HBM_TIMELINE_ENABLED = conf(
+    "spark.rapids.tpu.hbm.timeline.enabled").boolean() \
+    .doc("Maintain the tenant-attributed device-memory occupancy "
+         "timeline (obs/memprof.py): every spill-catalog, staging-"
+         "arena, broadcast-retention and admission-ticket event books "
+         "a per-(tenant, buffer class) byte delta, exported as "
+         "Perfetto counter tracks in the Chrome trace and as the "
+         "tpu_hbm_* metric families.  session.hbm_report() and the "
+         "admission controller's hbm_holders() read it.  Cheap: one "
+         "dict update per lifecycle event, bounded sample ring.") \
+    .create_with_default(True)
+
+HBM_TIMELINE_MAX_SAMPLES = conf(
+    "spark.rapids.tpu.hbm.timeline.maxSamples").integer() \
+    .doc("Bound on the occupancy timeline's in-memory sample ring; "
+         "past it the oldest samples drop (the live per-tenant books "
+         "stay exact — only the replayable history window is bounded). "
+         "The post-mortem bundle and trace counter tracks read this "
+         "window.") \
+    .check(lambda v: v >= 64, "must be >= 64") \
+    .create_with_default(4096)
+
+HBM_POSTMORTEM_ENABLED = conf(
+    "spark.rapids.tpu.hbm.postmortem.enabled").boolean() \
+    .doc("Failure black box: on query failure, dirty memsan ledger or "
+         "admission timeout, dump a bounded post-mortem bundle (trace, "
+         "metrics snapshot, memory-timeline window, plan, interp/tmsan "
+         "states, estimator grades, effective config) under "
+         "<postmortem.dir>/postmortems/, rendered by `tools "
+         "postmortem`.  Needs hbm.postmortem.dir or "
+         "regress.historyDir to be set.") \
+    .create_with_default(True)
+
+HBM_POSTMORTEM_DIR = conf(
+    "spark.rapids.tpu.hbm.postmortem.dir").string() \
+    .doc("Directory whose postmortems/ subdir receives failure "
+         "bundles.  Unset: falls back to regress.historyDir, and when "
+         "neither is set the black box is inert.") \
+    .create_optional()
+
+HBM_POSTMORTEM_MAX_BUNDLES = conf(
+    "spark.rapids.tpu.hbm.postmortem.maxBundles").integer() \
+    .doc("Retention cap on the postmortems/ directory: past it the "
+         "oldest bundles are deleted after each dump, so a crash-"
+         "looping workload cannot fill the disk with black boxes.") \
+    .check(lambda v: v >= 1, "must be >= 1") \
+    .create_with_default(16)
+
 # Environment variables the engine reads directly (escape hatches that
 # must exist before config parsing, e.g. cache sizing at import time).
 # The repo lint (TPU-R002) fails on any SPARK_RAPIDS_* env read not

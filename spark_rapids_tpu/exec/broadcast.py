@@ -94,11 +94,34 @@ class BroadcastExchangeExec(Exec):
                     if len(batches) > 1 else batches[0]
                 maybe_sync(out)
             from ..memory.spill import batch_device_bytes
-            self.metrics[BROADCAST_BYTES] += batch_device_bytes(out)
+            nbytes = batch_device_bytes(out)
+            self.metrics[BROADCAST_BYTES] += nbytes
             self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
             self.metrics[NUM_OUTPUT_BATCHES] += 1
             self._cached = out
+            self._cached_bytes = nbytes
+            from ..obs import memprof
+            tl = memprof.active_timeline()
+            if tl is not None:
+                # raw (not spill-managed) retention: the HBM observatory
+                # books it as closed-pending — resident until release
+                tl.on_broadcast(f"bcast-{id(self):x}", nbytes)
             return out
+
+    def release_shuffle(self):
+        """Drop the cached broadcast batch (plan-release hook — rides
+        ``session.release_plan_shuffles`` like IciExchangeExec).  Each
+        collect re-plans, so releasing the cache is unobservable and
+        hands the HBM back at plan teardown instead of exec GC time."""
+        with self._lock:
+            if self._cached is None:
+                return
+            self._cached = None
+            self._cached_bytes = 0
+        from ..obs import memprof
+        tl = memprof.active_timeline()
+        if tl is not None:
+            tl.on_broadcast_release(f"bcast-{id(self):x}")
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         yield self._materialize(ctx)

@@ -72,6 +72,14 @@ def _metrics():
     return m
 
 
+def _timeline():
+    """The HBM observatory's occupancy timeline (None when disabled).
+    Ticket grant/reprice/release feed the per-tenant *reserved* series —
+    the other half of the "who holds what" answer next to residency."""
+    from ..obs import memprof
+    return memprof.active_timeline()
+
+
 class AdmissionController:
     """Process-wide FIFO byte-budget gate (None until configured: the
     single-tenant path pays nothing)."""
@@ -225,6 +233,9 @@ class AdmissionController:
         _metrics().histogram(
             "tpu_admission_queue_wait_seconds",
             "time from admit() to reservation").observe(wait_s)
+        tl = _timeline()
+        if tl is not None:
+            tl.note_ticket(tenant, nbytes)
         return AdmissionTicket(nbytes, label, tenant, repaired, wait_s)
 
     def reprice(self, ticket: AdmissionTicket, new_nbytes: int) -> int:
@@ -258,6 +269,9 @@ class AdmissionController:
         self._counter("tpu_admission_repriced_total",
                       "live tickets re-priced by the exchange-boundary "
                       "re-planner", ticket.tenant).inc()
+        tl = _timeline()
+        if tl is not None:
+            tl.note_ticket(ticket.tenant, delta)
         return delta
 
     def release(self, ticket: AdmissionTicket) -> None:
@@ -272,8 +286,21 @@ class AdmissionController:
                              -ticket.nbytes)
             self._publish_gauges()
             self._cv.notify_all()
+        tl = _timeline()
+        if tl is not None:
+            tl.note_ticket(ticket.tenant, -ticket.nbytes)
 
     # -- introspection ---------------------------------------------------------
+    def hbm_holders(self) -> dict:
+        """The HBM observatory's occupancy split — "who holds what",
+        the signal queue/reprice policy (and item 5's preemption) acts
+        on.  Each tenant row carries resident bytes split into pinned /
+        demotable (spillable-now) / closed-pending, plus the admission
+        reservation tracked from this controller's own ticket stream.
+        Returns a disabled-shaped report when the timeline is off."""
+        from ..obs.memprof import MemoryTimeline
+        return MemoryTimeline.get().report()
+
     @property
     def bytes_in_flight(self) -> int:
         with self._cv:

@@ -40,6 +40,14 @@ def _ledger():
     return memsan.active_ledger()
 
 
+def _timeline():
+    """The HBM observatory's occupancy timeline, or None when disabled
+    (spark.rapids.tpu.hbm.timeline.enabled) — same no-op discipline as
+    the shadow-ledger hooks."""
+    from ..obs import memprof
+    return memprof.active_timeline()
+
+
 def _trace_event(name: str, **attrs) -> None:
     """Flight-recorder hook: tier moves are exactly what a post-mortem
     wants on the timeline (no-op without an installed tracer)."""
@@ -122,6 +130,13 @@ class SpillableBatch:
         led = _ledger()
         if led is not None:
             led.on_alloc(self.id, self.device_bytes)
+        tl = _timeline()
+        if tl is not None:
+            from ..obs import memprof
+            bclass = memprof.SHUFFLE_BLOCK \
+                if priority == SpillPriority.SHUFFLE \
+                else memprof.WORKING_SET
+            tl.on_alloc(self.id, self.device_bytes, bclass)
 
     @property
     def num_rows(self) -> int:
@@ -143,6 +158,9 @@ class SpillableBatch:
         led = _ledger()
         if led is not None:
             led.on_spill(self.id, self.device_bytes)
+        tl = _timeline()
+        if tl is not None:
+            tl.on_spill(self.id, self.device_bytes)
         _trace_event("spill.host", bytes=self.device_bytes,
                      buffer=self.id[:8])
         mm = _metrics()
@@ -207,6 +225,9 @@ class SpillableBatch:
             self.tier = StorageTier.DEVICE
             if led is not None:
                 led.on_unspill(self.id, self.device_bytes)
+            tl = _timeline()
+            if tl is not None:
+                tl.on_unspill(self.id, self.device_bytes)
             _trace_event("spill.unspill", bytes=self.device_bytes,
                          buffer=self.id[:8])
             self.catalog.note_unspill(self)
@@ -221,6 +242,9 @@ class SpillableBatch:
         led = _ledger()
         if led is not None:
             led.on_close(self.id)
+        tl = _timeline()
+        if tl is not None:
+            tl.on_close(self.id)
         self.closed = True
         self.catalog.unregister(self)
         self._batch = None
@@ -363,6 +387,9 @@ class SpillCatalog:
         led = _ledger()
         if led is not None:
             led.on_pin(_pin_handle_id(owner, key), nbytes)
+        tl = _timeline()
+        if tl is not None:
+            tl.on_pin(_pin_handle_id(owner, key), nbytes)
         with self._reg_lock:
             self._pinned[(id(owner), key)] = nbytes
             self._pin_owners[(id(owner), key)] = owner
@@ -377,6 +404,7 @@ class SpillCatalog:
     def _evict_pinned(self, target_free: int) -> int:
         freed = 0
         led = _ledger()
+        tl = _timeline()
         with self._reg_lock:
             for (oid, key), nbytes in list(self._pinned.items()):
                 if freed >= target_free:
@@ -386,6 +414,8 @@ class SpillCatalog:
                     owner.pop(key, None)
                 if led is not None:
                     led.on_evict(_pin_handle_id(owner, key, oid))
+                if tl is not None:
+                    tl.on_evict(_pin_handle_id(owner, key, oid))
                 self._pinned.pop((oid, key), None)
                 self._pin_owners.pop((oid, key), None)
                 freed += nbytes

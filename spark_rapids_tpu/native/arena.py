@@ -21,6 +21,25 @@ def _ledger():
     return memsan.active_ledger()
 
 
+def _timeline():
+    """The HBM observatory's occupancy timeline (None when disabled)."""
+    from ..obs import memprof
+    return memprof.active_timeline()
+
+
+def _tenant_ctx():
+    """(tenant, query) charged for the current arena operation — the
+    thread's memprof attribution scope, or the unattributed sentinel.
+    Arena exhaustion events historically recorded only the requesting
+    operator; the tenant label is what lets the black box name the
+    culprit rather than just the victim."""
+    from ..obs import memprof
+    ctx = memprof.current_context()
+    if ctx is None:
+        return memprof.UNATTRIBUTED_TENANT, ""
+    return ctx
+
+
 def _trace_event(name: str, **attrs) -> None:
     """Flight-recorder hook (no-op without an installed tracer)."""
     from ..obs import tracer
@@ -36,7 +55,8 @@ def _metrics():
         m.counter("tpu_arena_allocs_total",
                   "staging-arena allocations served"),
         m.counter("tpu_arena_exhaustions_total",
-                  "allocations refused because the arena was full"),
+                  "allocations refused because the arena was full",
+                  ("tenant",)),
         m.gauge("tpu_arena_used_bytes",
                 "bytes currently bump-allocated in the staging arena"),
         m.gauge("tpu_arena_utilization_ratio",
@@ -72,13 +92,15 @@ class HostArena:
                 self._arena_id,
                 size if self._closed else self.used + size, self._closed)
         mm = _metrics()
+        tenant, query = _tenant_ctx()
         with self._lock:
             if self._arena is not None:
                 off = self._lib.tpu_arena_alloc(self._arena, size, align)
                 if off < 0:
                     _trace_event("arena.exhausted", wanted=size,
-                                 capacity=self.capacity)
-                    mm[1].inc()
+                                 capacity=self.capacity, tenant=tenant,
+                                 query=query)
+                    mm[1].labels(tenant=tenant).inc()
                     return None
                 base = self._lib.tpu_arena_base(self._arena)
                 out = memoryview(
@@ -88,8 +110,9 @@ class HostArena:
                 off = (self._used + align - 1) & ~(align - 1)
                 if off + size > self.capacity:
                     _trace_event("arena.exhausted", wanted=size,
-                                 capacity=self.capacity)
-                    mm[1].inc()
+                                 capacity=self.capacity, tenant=tenant,
+                                 query=query)
+                    mm[1].labels(tenant=tenant).inc()
                     return None
                 self._used = off + size
                 self._high = max(self._high, self._used)
@@ -99,6 +122,9 @@ class HostArena:
         mm[0].inc()
         mm[2].set(used)
         mm[3].set(used / self.capacity if self.capacity else 0.0)
+        tl = _timeline()
+        if tl is not None:
+            tl.on_arena_alloc(self._arena_id, used, self.capacity)
         return out
 
     def reset(self):
@@ -110,6 +136,9 @@ class HostArena:
         mm = _metrics()
         mm[2].set(0)
         mm[3].set(0.0)
+        tl = _timeline()
+        if tl is not None:
+            tl.on_arena_reset(self._arena_id)
 
     def stage(self, data) -> bytes:
         """Stage a bytes-like payload through the arena: alloc, copy,
@@ -123,7 +152,7 @@ class HostArena:
             return bytes(data)
         if size == 0 or size > self.capacity:
             if size > self.capacity:
-                _metrics()[1].inc()
+                _metrics()[1].labels(tenant=_tenant_ctx()[0]).inc()
             return bytes(data)
         mv = self.alloc(size)
         if mv is None:
@@ -156,6 +185,9 @@ class HostArena:
         if not self._closed:
             _trace_event("arena.close", high_water=self.high_water,
                          allocs=self.n_allocs)
+            tl = _timeline()
+            if tl is not None:
+                tl.on_arena_reset(self._arena_id)
         self._closed = True
         if self._arena is not None:
             self._lib.tpu_arena_destroy(self._arena)

@@ -184,6 +184,20 @@ class EventLogWriter:
             self.queries_flushed += 1
         return self.path
 
+    def write_postmortem_pointer(self, bundle_path: str) -> None:
+        """Append one pointer line naming the failure black box's
+        post-mortem bundle — the log's reader (and a human tailing it)
+        can jump straight from the JobFailed group to the artifact.
+        Unknown Event kinds are skipped by foreign parsers."""
+        with self._lock:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(json.dumps({
+                    "Event": "org.apache.spark.sql.rapids.tpu."
+                             "TpuPostmortemEvent",
+                    "bundlePath": bundle_path,
+                }, default=_json_default) + "\n")
+                f.flush()
+
     # ------------------------------------------------------------------
     def _task_events(self, sql_id: int, final_plan, spans: List[Dict],
                      start_ms: int, failed: bool) -> List[Dict]:

@@ -21,7 +21,15 @@ def spans_to_chrome(span_dicts: List[Dict[str, Any]],
     their own Chrome PROCESS lane per producer, so the one merged
     timeline shows the consumer and each peer side by side.  Chrome
     "pid" here is a lane id, NOT the span-dict "pid" field (that one is
-    the partition id and stays in args)."""
+    the partition id and stays in args).
+
+    ``hbm.sample`` / ``hbm.admitted`` instants (the HBM observatory's
+    occupancy stream, obs/memprof.py) render as Perfetto COUNTER tracks
+    ("C" events) instead of instants: one ``HBM <tenant>`` track per
+    tenant with a per-buffer-class series, plus an ``HBM admitted
+    <tenant>`` track for ticket reservations.  Merged remote samples
+    keep their producer's lane, so a fleet trace shows each peer's HBM
+    curve under its own span lane."""
     events: List[Dict[str, Any]] = [
         {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
          "args": {"name": process_name}},
@@ -44,6 +52,20 @@ def spans_to_chrome(span_dicts: List[Dict[str, Any]],
                                "pid": lane, "tid": 0,
                                "args": {"name": str(proc)}})
             args["proc"] = proc
+        if s["name"] in ("hbm.sample", "hbm.admitted"):
+            attrs = s.get("attrs") or {}
+            tenant = attrs.get("tenant", "?")
+            if s["name"] == "hbm.admitted":
+                track = f"HBM admitted {tenant}"
+                series = {"admitted": attrs.get("bytes", 0)}
+            else:
+                track = f"HBM {tenant}"
+                series = {attrs.get("cls", "bytes"):
+                          attrs.get("bytes", 0)}
+            events.append({"name": track, "ph": "C", "pid": lane,
+                           "tid": 0, "ts": s["startNs"] / 1000.0,
+                           "args": series})
+            continue
         base = {"name": s["name"], "cat": s.get("kind", "span"),
                 "pid": lane, "tid": s.get("tid", 0),
                 "ts": s["startNs"] / 1000.0, "args": args}

@@ -150,10 +150,20 @@ class HealthMonitor:
     # momentarily deep snapshot is ordinary burst absorption, not alert)
     _QUEUE_DEEP = 3
 
+    # sustained HBM tightness (the observatory's degrade rule): live
+    # device bytes at or above _HBM_HIGH_FRACTION of the budget while
+    # the demotable share of them sits below _HBM_LOW_DEMOTABLE, for
+    # two consecutive snapshots — the device is nearly full AND
+    # spilling can't meaningfully relieve it (pinned/broadcast-heavy),
+    # which is exactly when the next big admit stalls or OOMs
+    _HBM_HIGH_FRACTION = 0.9
+    _HBM_LOW_DEMOTABLE = 0.25
+
     def __init__(self, reg: Optional[M.MetricsRegistry] = None):
         self._reg = reg
         self._prev: Dict[str, int] = {}
         self._queue_deep_prev = False
+        self._hbm_tight_prev = False
         self._lock = threading.Lock()
 
     def snapshot(self) -> Dict:
@@ -183,6 +193,24 @@ class HealthMonitor:
                     _SEVERITY[DEGRADED] > _SEVERITY[adm["status"]]:
                 adm["status"] = DEGRADED
             self._queue_deep_prev = deep
+            # HBM observatory: sustained high watermark with a low
+            # demotable share (see class attrs above)
+            total = _gauge_value(reg, "tpu_hbm_total_bytes")
+            demotable = _gauge_value(reg, "tpu_hbm_demotable_bytes")
+            budget = _gauge_value(reg, "tpu_hbm_budget_bytes")
+            hbm = components.setdefault("hbm",
+                                        {"status": OK, "signals": {}})
+            hbm["signals"]["tpu_hbm_total_bytes"] = total
+            hbm["signals"]["tpu_hbm_demotable_bytes"] = demotable
+            hbm["signals"]["tpu_hbm_budget_bytes"] = budget
+            tight = bool(
+                budget and total is not None and
+                total >= self._HBM_HIGH_FRACTION * budget and
+                (demotable or 0) < self._HBM_LOW_DEMOTABLE * total)
+            if tight and self._hbm_tight_prev and \
+                    _SEVERITY[DEGRADED] > _SEVERITY[hbm["status"]]:
+                hbm["status"] = DEGRADED
+            self._hbm_tight_prev = tight
         probe_ok = _gauge_value(reg, "tpu_device_probe_ok")
         dev = components.setdefault("device",
                                     {"status": OK, "signals": {}})

@@ -180,6 +180,16 @@ class HistoryDir:
         from .estimator import ESTIMATOR_LEDGER_FILENAME
         return os.path.join(self.path, ESTIMATOR_LEDGER_FILENAME)
 
+    def postmortems_dir(self) -> str:
+        """The failure black box's bundle directory (obs/postmortem.py
+        dumps one JSON bundle per failed query here, retention-capped
+        by hbm.postmortem.maxBundles; `tools postmortem` renders them).
+        Created on first access so a crashing query never also fails
+        on a missing directory."""
+        d = os.path.join(self.path, "postmortems")
+        os.makedirs(d, exist_ok=True)
+        return d
+
     def load(self, path: str) -> Dict:
         with open(path, encoding="utf-8") as f:
             return json.load(f)

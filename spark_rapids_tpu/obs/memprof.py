@@ -1,0 +1,486 @@
+"""HBM observatory: a tenant-attributed device-memory timeline.
+
+The engine already *emits* every lifecycle transition that moves bytes
+on or off the device — ``memory/spill.py`` (alloc / register / pin /
+spill / unspill / materialize / close / evict), ``native/arena.py``
+(staging-arena fills and resets) and ``memory/admission.py`` (ticket
+grant / reprice / release) — but until now those streams only fed
+end-state gauges and the memsan shadow ledger's peak.  Nobody could
+answer "who held HBM at time t, and how much of it was demotable?".
+
+``MemoryTimeline`` is a bounded, thread-safe subscriber to those
+streams.  It maintains per-``(tenant, buffer class)`` occupancy series
+where the buffer class is one of:
+
+====================  ===================================================
+``shuffle_block``     spill-registered shuffle partitions
+                      (``SpillPriority.SHUFFLE``) — demotable
+``working_set``       spill-registered operator working sets
+                      (``ACTIVE`` / ``INPUT`` priorities) — demotable
+``pinned_scan``       pinned scan/cache buffers (``register_pinned``) —
+                      resident until evicted, *not* demotable
+``broadcast``         raw (not spill-managed) broadcast-side retention —
+                      closed-pending: freed only at plan release
+``arena_staging``     host-side transfer-staging arena fill — reported
+                      separately, excluded from the HBM split
+====================  ===================================================
+
+Tenant / query attribution comes from a thread-local context stack
+pushed by ``session._execute`` (see :func:`push_context`).  Events that
+arrive with no context are charged to the ``_unattributed`` tenant and
+counted — the ``--hbm`` lint gate trips on any such allocation.
+
+Samples (one per event, bounded ring) carry a ``perf_counter_ns``
+timestamp on the same clock as ``QueryTrace.t0_ns`` so the exported
+Chrome trace can stitch the occupancy curve under the span lanes as
+Perfetto counter tracks (see ``obs/export.py``).  The timeline also
+publishes ``tpu_hbm_*`` metrics and answers :meth:`report` — the
+pinned / demotable / closed-pending split the admission controller's
+queue and reprice decisions consume via ``hbm_holders()``.
+
+Everything is disabled-cheap: when the observatory is off,
+:func:`active_timeline` returns ``None`` and every hook site is a
+single attribute load + ``is None`` test.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from . import metrics
+
+# Buffer-class taxonomy (keep in sync with docs/observability.md).
+SHUFFLE_BLOCK = "shuffle_block"
+WORKING_SET = "working_set"
+PINNED_SCAN = "pinned_scan"
+BROADCAST = "broadcast"
+ARENA_STAGING = "arena_staging"
+
+BUFFER_CLASSES = (SHUFFLE_BLOCK, WORKING_SET, PINNED_SCAN, BROADCAST,
+                  ARENA_STAGING)
+
+# Device-resident classes, split the way admission wants to see them.
+DEMOTABLE_CLASSES = (SHUFFLE_BLOCK, WORKING_SET)
+PINNED_CLASSES = (PINNED_SCAN,)
+CLOSED_PENDING_CLASSES = (BROADCAST,)
+# Classes counted against the device (HBM) budget.  arena_staging is
+# host-side transfer memory and is reported separately.
+DEVICE_CLASSES = DEMOTABLE_CLASSES + PINNED_CLASSES + CLOSED_PENDING_CLASSES
+# Classes the memsan shadow ledger also sees (it never observes raw
+# broadcast retention) — the three-sinks-agree comparison uses this.
+SPILL_BACKED_CLASSES = DEMOTABLE_CLASSES + PINNED_CLASSES
+
+UNATTRIBUTED_TENANT = "_unattributed"
+
+DEFAULT_MAX_SAMPLES = 4096
+
+
+# ---------------------------------------------------------------------------
+# tenant / query context (thread-local stack)
+
+_CTX = threading.local()
+
+
+def push_context(tenant: str, query: str = "") -> None:
+    """Enter a (tenant, query) attribution scope on this thread."""
+    stack = getattr(_CTX, "stack", None)
+    if stack is None:
+        stack = _CTX.stack = []
+    stack.append((tenant or "default", query))
+
+
+def pop_context() -> None:
+    stack = getattr(_CTX, "stack", None)
+    if stack:
+        stack.pop()
+
+
+def current_context() -> Optional[Tuple[str, str]]:
+    """The innermost (tenant, query) scope on this thread, or None."""
+    stack = getattr(_CTX, "stack", None)
+    if stack:
+        return stack[-1]
+    return None
+
+
+def _owning_operator() -> str:
+    # Reuse memsan's frame walk: the nearest ``execute_partition`` /
+    # ``_materialize`` caller names the operator responsible.
+    try:
+        from ..memory.memsan import _owning_exec
+        return _owning_exec() or ""
+    except Exception:
+        return ""
+
+
+class MemoryTimeline:
+    """Process-wide occupancy timeline (singleton via :meth:`get`)."""
+
+    _instance: Optional["MemoryTimeline"] = None
+    _ilock = threading.Lock()
+
+    def __init__(self, max_samples: int = DEFAULT_MAX_SAMPLES,
+                 budget_bytes: int = 0) -> None:
+        self._lock = threading.RLock()
+        self.enabled = False
+        self.max_samples = max_samples
+        self.budget_bytes = budget_bytes
+        with self._lock:
+            self._reset_books()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @classmethod
+    def get(cls) -> "MemoryTimeline":
+        with cls._ilock:
+            if cls._instance is None:
+                cls._instance = MemoryTimeline()
+            return cls._instance
+
+    @classmethod
+    def configure(cls, enabled: bool = True,
+                  max_samples: int = DEFAULT_MAX_SAMPLES,
+                  budget_bytes: int = 0) -> "MemoryTimeline":
+        tl = cls.get()
+        with tl._lock:
+            tl.enabled = enabled
+            tl.max_samples = max(int(max_samples), 64)
+            if budget_bytes:
+                tl.budget_bytes = int(budget_bytes)
+            tl._samples = deque(tl._samples, maxlen=tl.max_samples)
+        if enabled:
+            tl._publish_budget()
+        return tl
+
+    @classmethod
+    def reset_for_tests(cls) -> None:
+        with cls._ilock:
+            cls._instance = None
+
+    def _reset_books(self) -> None:
+        # (tenant, class) -> live bytes
+        self._series: Dict[Tuple[str, str], int] = {}
+        # handle id -> [tenant, bclass, bytes-on-device, query, operator]
+        self._handles: Dict[str, list] = {}
+        # arena id -> {tenant: bytes}; arena id -> last observed `used`
+        self._arena_books: Dict[str, Dict[str, int]] = {}
+        self._arena_last: Dict[str, int] = {}
+        # tenant -> admission-reserved bytes (tickets; not residency)
+        self._admitted: Dict[str, int] = {}
+        self._samples: deque = deque(maxlen=self.max_samples)
+        self.total_live = 0           # device classes only
+        self.peak_total = 0           # watermark incl. broadcast
+        self.peak_spill = 0           # spill-backed only (== memsan view)
+        self._tenant_live: Dict[str, int] = {}
+        self._tenant_peak: Dict[str, int] = {}
+        self._tenant_peak_demotable: Dict[str, int] = {}
+        self.unattributed_total = 0
+        self.samples_dropped = 0
+
+    def clear(self) -> None:
+        """Drop all books and samples (tests / gate replays)."""
+        with self._lock:
+            self._reset_books()
+
+    # -- core accounting ----------------------------------------------------
+
+    def _context(self) -> Tuple[str, str]:
+        ctx = current_context()
+        if ctx is None:
+            with self._lock:
+                self.unattributed_total += 1
+            return UNATTRIBUTED_TENANT, ""
+        return ctx
+
+    def _apply(self, tenant: str, bclass: str, delta: int,
+               query: str = "", operator: str = "") -> None:
+        """Apply a byte delta under the lock, then emit outside it."""
+        if delta == 0:
+            return
+        with self._lock:
+            key = (tenant, bclass)
+            self._series[key] = self._series.get(key, 0) + delta
+            if self._series[key] <= 0:
+                del self._series[key]
+            if bclass in DEVICE_CLASSES:
+                self.total_live += delta
+                if self.total_live > self.peak_total:
+                    self.peak_total = self.total_live
+                live = self._tenant_live.get(tenant, 0) + delta
+                if live > 0:
+                    self._tenant_live[tenant] = live
+                else:
+                    self._tenant_live.pop(tenant, None)
+                    live = 0
+                if live > self._tenant_peak.get(tenant, 0):
+                    self._tenant_peak[tenant] = live
+                if bclass in SPILL_BACKED_CLASSES:
+                    spill_live = sum(
+                        v for (t, c), v in self._series.items()
+                        if c in SPILL_BACKED_CLASSES)
+                    if spill_live > self.peak_spill:
+                        self.peak_spill = spill_live
+                demo = sum(self._series.get((tenant, c), 0)
+                           for c in DEMOTABLE_CLASSES)
+                if demo > self._tenant_peak_demotable.get(tenant, 0):
+                    self._tenant_peak_demotable[tenant] = demo
+            if len(self._samples) == self._samples.maxlen:
+                self.samples_dropped += 1
+            self._samples.append({
+                "t_ns": time.perf_counter_ns(),
+                "tenant": tenant, "class": bclass, "delta": delta,
+                "live": self._series.get((tenant, bclass), 0),
+                "total": self.total_live,
+                "query": query, "operator": operator,
+            })
+            live_now = self._series.get((tenant, bclass), 0)
+        self._publish(tenant, bclass, live_now)
+        self._emit_sample(tenant, bclass, live_now, query, operator)
+
+    # -- event hooks (spill catalog) ---------------------------------------
+
+    def on_alloc(self, handle_id: str, nbytes: int, bclass: str) -> None:
+        tenant, query = self._context()
+        op = _owning_operator()
+        with self._lock:
+            self._handles[handle_id] = [tenant, bclass, nbytes, query, op]
+        self._apply(tenant, bclass, nbytes, query, op)
+
+    # register is the same observation as alloc for already-built batches
+    on_register = on_alloc
+
+    def on_pin(self, handle_id: str, nbytes: int) -> None:
+        self.on_alloc(handle_id, nbytes, PINNED_SCAN)
+
+    def on_spill(self, handle_id: str, device_bytes_freed: int) -> None:
+        with self._lock:
+            rec = self._handles.get(handle_id)
+            if rec is None or device_bytes_freed <= 0:
+                return
+            tenant, bclass = rec[0], rec[1]
+            freed = min(device_bytes_freed, rec[2])
+            rec[2] -= freed
+            query, op = rec[3], rec[4]
+        self._apply(tenant, bclass, -freed, query, op)
+
+    def on_unspill(self, handle_id: str, nbytes: int) -> None:
+        with self._lock:
+            rec = self._handles.get(handle_id)
+            if rec is None:
+                return
+            tenant, bclass = rec[0], rec[1]
+            rec[2] += nbytes
+            query, op = rec[3], rec[4]
+        self._apply(tenant, bclass, nbytes, query, op)
+
+    # a device-resident get() is a no-op for occupancy; materialize after
+    # a spill comes back through on_unspill.
+    def on_close(self, handle_id: str) -> None:
+        with self._lock:
+            rec = self._handles.pop(handle_id, None)
+            if rec is None:
+                return
+            tenant, bclass, nbytes, query, op = rec
+        if nbytes > 0:
+            self._apply(tenant, bclass, -nbytes, query, op)
+
+    # eviction of a pinned buffer frees its device bytes like a close
+    on_evict = on_close
+
+    # -- event hooks (broadcast raw retention) ------------------------------
+
+    def on_broadcast(self, handle_id: str, nbytes: int) -> None:
+        self.on_alloc(handle_id, nbytes, BROADCAST)
+
+    on_broadcast_release = on_close
+
+    # -- event hooks (staging arena) ----------------------------------------
+
+    def on_arena_alloc(self, arena_id: str, used_now: int,
+                       capacity: int) -> None:
+        """Called after an arena alloc with the arena's new fill level.
+
+        Deltas are computed as used-after differences so alignment
+        padding reconciles exactly against ``tpu_arena_used_bytes``.
+        """
+        tenant, query = self._context()
+        with self._lock:
+            last = self._arena_last.get(arena_id, 0)
+            delta = used_now - last
+            self._arena_last[arena_id] = used_now
+            if delta == 0:
+                return
+            book = self._arena_books.setdefault(arena_id, {})
+            book[tenant] = book.get(tenant, 0) + delta
+        if capacity > used_now:
+            if metrics.enabled():
+                metrics.histogram(
+                    "tpu_hbm_arena_free_chunk_bytes",
+                    "Free contiguous arena bytes observed at each "
+                    "staging alloc (fragmentation proxy)",
+                    buckets=metrics.DEFAULT_BYTES_BUCKETS,
+                ).observe(capacity - used_now)
+        self._apply(tenant, ARENA_STAGING, delta, query)
+
+    def on_arena_reset(self, arena_id: str) -> None:
+        """Arena reset/close: return every tenant's staging bytes."""
+        with self._lock:
+            book = self._arena_books.pop(arena_id, {})
+            self._arena_last.pop(arena_id, None)
+        for tenant, nbytes in book.items():
+            if nbytes:
+                self._apply(tenant, ARENA_STAGING, -nbytes)
+
+    # -- event hooks (admission tickets) ------------------------------------
+
+    def note_ticket(self, tenant: str, delta: int) -> None:
+        """Track admission reservations (grant/reprice/release)."""
+        tenant = tenant or "default"
+        with self._lock:
+            cur = self._admitted.get(tenant, 0) + delta
+            if cur > 0:
+                self._admitted[tenant] = cur
+            else:
+                self._admitted.pop(tenant, None)
+                cur = 0
+        from . import tracer
+        tr = tracer.active_tracer()
+        if tr is not None:
+            tr.event("hbm.admitted", tenant=tenant, bytes=cur)
+
+    # -- export -------------------------------------------------------------
+
+    def _publish(self, tenant: str, bclass: str, live: int) -> None:
+        if not metrics.enabled():
+            return
+        metrics.gauge("tpu_hbm_tenant_bytes",
+                      "Live device/staging bytes per tenant and buffer "
+                      "class", ("tenant", "class")).labels(
+                          tenant=tenant, **{"class": bclass}).set(live)
+        with self._lock:
+            total = self.total_live
+            demotable = sum(v for (t, c), v in self._series.items()
+                            if c in DEMOTABLE_CLASSES)
+            peak = self.peak_total
+        metrics.gauge("tpu_hbm_total_bytes",
+                      "Live device bytes across all tenants").set(total)
+        metrics.gauge("tpu_hbm_demotable_bytes",
+                      "Device bytes spillable right now (shuffle + "
+                      "working set)").set(demotable)
+        metrics.gauge("tpu_hbm_watermark_bytes",
+                      "High-water mark of live device bytes").set(peak)
+
+    def _publish_budget(self) -> None:
+        if self.budget_bytes and metrics.enabled():
+            metrics.gauge("tpu_hbm_budget_bytes",
+                          "Configured device memory budget").set(
+                              self.budget_bytes)
+
+    def _emit_sample(self, tenant: str, bclass: str, live: int,
+                     query: str, operator: str) -> None:
+        from . import tracer
+        tr = tracer.active_tracer()
+        if tr is None:
+            return
+        attrs = {"tenant": tenant, "cls": bclass, "bytes": live}
+        if query:
+            attrs["query"] = query
+        if operator:
+            attrs["operator"] = operator
+        tr.event("hbm.sample", **attrs)
+
+    # -- queries ------------------------------------------------------------
+
+    def live_bytes(self, bclass: Optional[str] = None,
+                   tenant: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(
+                v for (t, c), v in self._series.items()
+                if (bclass is None or c == bclass)
+                and (tenant is None or t == tenant))
+
+    def spill_backed_bytes(self) -> int:
+        """Live bytes in the classes the spill catalog also gauges."""
+        with self._lock:
+            return sum(v for (t, c), v in self._series.items()
+                       if c in SPILL_BACKED_CLASSES)
+
+    def arena_bytes(self) -> int:
+        with self._lock:
+            return sum(v for (t, c), v in self._series.items()
+                       if c == ARENA_STAGING)
+
+    def report(self) -> dict:
+        """The pinned / demotable / closed-pending occupancy split.
+
+        This is the "who holds what" answer the admission controller's
+        queue and reprice decisions consume (``hbm_holders()``), and the
+        payload behind ``session.hbm_report()``.
+        """
+        with self._lock:
+            tenants: Dict[str, dict] = {}
+            for (tenant, bclass), live in sorted(self._series.items()):
+                row = tenants.setdefault(tenant, {
+                    "classes": {}, "pinned_bytes": 0,
+                    "demotable_bytes": 0, "closed_pending_bytes": 0,
+                    "arena_staging_bytes": 0, "resident_bytes": 0,
+                    "admitted_bytes": 0, "peak_bytes": 0,
+                })
+                row["classes"][bclass] = live
+                if bclass in PINNED_CLASSES:
+                    row["pinned_bytes"] += live
+                elif bclass in DEMOTABLE_CLASSES:
+                    row["demotable_bytes"] += live
+                elif bclass in CLOSED_PENDING_CLASSES:
+                    row["closed_pending_bytes"] += live
+                elif bclass == ARENA_STAGING:
+                    row["arena_staging_bytes"] += live
+                if bclass in DEVICE_CLASSES:
+                    row["resident_bytes"] += live
+            for tenant, nbytes in self._admitted.items():
+                row = tenants.setdefault(tenant, {
+                    "classes": {}, "pinned_bytes": 0,
+                    "demotable_bytes": 0, "closed_pending_bytes": 0,
+                    "arena_staging_bytes": 0, "resident_bytes": 0,
+                    "admitted_bytes": 0, "peak_bytes": 0,
+                })
+                row["admitted_bytes"] = nbytes
+            for tenant, row in tenants.items():
+                row["peak_bytes"] = self._tenant_peak.get(tenant, 0)
+                row["peak_demotable_bytes"] = \
+                    self._tenant_peak_demotable.get(tenant, 0)
+            return {
+                "enabled": self.enabled,
+                "total_bytes": self.total_live,
+                "peak_bytes": self.peak_total,
+                "peak_spill_backed_bytes": self.peak_spill,
+                "demotable_bytes": sum(
+                    r["demotable_bytes"] for r in tenants.values()),
+                "budget_bytes": self.budget_bytes,
+                "unattributed_events": self.unattributed_total,
+                "tenants": tenants,
+            }
+
+    def window(self, last: int = 256) -> List[dict]:
+        """The most recent ``last`` samples (post-mortem window)."""
+        with self._lock:
+            samples = list(self._samples)
+        return samples[-last:]
+
+    def sample_count(self) -> int:
+        with self._lock:
+            return len(self._samples)
+
+
+def active_timeline() -> Optional[MemoryTimeline]:
+    """The process timeline iff the observatory is enabled, else None.
+
+    Hook sites call this on every event — it must stay allocation-free
+    and cheap on the disabled path.
+    """
+    tl = MemoryTimeline._instance
+    if tl is not None and tl.enabled:
+        return tl
+    return None

@@ -1,0 +1,334 @@
+"""Failure black box: bounded post-mortem bundles for failed queries.
+
+Until this module existed every failed query evaporated its evidence —
+the trace died with the session object, the metrics kept moving, and
+the memory timeline's "who held HBM at failure time" answer was gone by
+the time anyone asked.  ``dump_postmortem`` freezes all of it into ONE
+JSON bundle under ``<historyDir>/postmortems/`` the moment the failure
+unwinds through ``session._execute``:
+
+  * the sealed trace (span dicts + measured/static peaks + drop count),
+  * a full metrics snapshot (Prometheus exposition text),
+  * the HBM observatory's occupancy report and recent sample window,
+  * the failing plan's tree, the interp/tmsan analysis states,
+  * the estimator's predicted-vs-actual grades,
+  * and the session's effective config.
+
+Bundles are retention-capped (``hbm.postmortem.maxBundles``) so a
+crash-looping workload cannot fill the disk, and every step here is
+best-effort — a black-box crash must never mask the query's own error.
+``tools postmortem`` renders a bundle back into a human report.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+BUNDLE_VERSION = 1
+BUNDLE_PREFIX = "pm_"
+# hard byte bound on one serialized bundle: a post-mortem is a summary,
+# not an archive — past it the sample window is halved until it fits
+MAX_BUNDLE_BYTES = 4 << 20
+
+_seq_lock = None
+_seq = 0
+
+
+def _next_seq() -> int:
+    global _seq_lock, _seq
+    import threading
+    if _seq_lock is None:
+        _seq_lock = threading.Lock()
+    with _seq_lock:
+        _seq += 1
+        return _seq
+
+
+def _classify(error) -> str:
+    """Bundle kind from the failure's exception type."""
+    from ..memory.admission import AdmissionTimeout
+    from ..memory.memsan import LifecycleViolation
+    if isinstance(error, AdmissionTimeout):
+        return "admission_timeout"
+    if isinstance(error, LifecycleViolation):
+        return "dirty_ledger"
+    name = type(error).__name__ if error is not None else ""
+    if "Leak" in name or "leak" in str(error or "").lower()[:200]:
+        return "dirty_ledger"
+    return "query_failure"
+
+
+def _failing_operator(span_dicts: List[Dict]) -> Optional[Dict]:
+    """The INNERMOST operator span that closed with an error — the
+    culprit the acceptance criteria want named.  When a query dies, the
+    seal marks every still-open span on the stack errored, outermost
+    first by start time, so the deepest (latest-started) errored span
+    is the operator that actually threw; its ancestors are the
+    unwind."""
+    errored = [s for s in span_dicts
+               if s.get("kind") == "operator"
+               and s.get("status") == "error"]
+    if not errored:
+        return None
+    s = max(errored, key=lambda s: s.get("startNs", 0))
+    return {"name": s.get("name"),
+            "operator": (s.get("attrs") or {}).get("op", s.get("name")),
+            "error": s.get("error"),
+            "startNs": s.get("startNs")}
+
+
+def _json_safe(obj):
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        return repr(obj)
+
+
+def dump_postmortem(out_dir: str, error, session=None, tracer=None,
+                    plan=None, tenant: str = "default",
+                    max_bundles: int = 16) -> Optional[str]:
+    """Write one bundle; returns its path (None when the dump itself
+    failed — callers treat the black box as strictly advisory)."""
+    try:
+        from .history import HistoryDir
+        pm_dir = HistoryDir(out_dir).postmortems_dir()
+        bundle = build_bundle(error, session=session, tracer=tracer,
+                              plan=plan, tenant=tenant)
+        stamp = time.strftime("%Y%m%d_%H%M%S")
+        path = os.path.join(
+            pm_dir, f"{BUNDLE_PREFIX}{stamp}_{_next_seq():04d}.json")
+        body = json.dumps(bundle, default=repr)
+        while len(body) > MAX_BUNDLE_BYTES and \
+                len(bundle.get("hbm", {}).get("window", [])) > 8:
+            w = bundle["hbm"]["window"]
+            bundle["hbm"]["window"] = w[len(w) // 2:]
+            bundle["hbm"]["window_truncated"] = True
+            body = json.dumps(bundle, default=repr)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(body)
+        _enforce_retention(pm_dir, max_bundles)
+        return path
+    except Exception:
+        return None
+
+
+def build_bundle(error, session=None, tracer=None, plan=None,
+                 tenant: str = "default") -> Dict[str, Any]:
+    """Assemble the bundle dict.  Every section is individually
+    best-effort: a dead subsystem contributes an error note, never an
+    exception."""
+    bundle: Dict[str, Any] = {
+        "version": BUNDLE_VERSION,
+        "kind": _classify(error),
+        "wall_time_ms": int(time.time() * 1000),
+        "tenant": tenant,
+        "error": {"type": type(error).__name__ if error is not None
+                  else None,
+                  "message": str(error) if error is not None else None},
+    }
+    try:
+        # the attribution scope is still on this thread — the failure
+        # unwinds through session._execute inside push_context/pop
+        from .memprof import current_context
+        ctx = current_context()
+        if ctx is not None and ctx[1]:
+            bundle["query"] = ctx[1]
+    except Exception:
+        pass
+    # trace: sealed span dicts + the peak the memsan ledger measured
+    try:
+        if tracer is not None:
+            spans = tracer.span_dicts()
+            bundle["trace"] = {
+                "spans": spans,
+                "dropped": getattr(tracer, "dropped", 0),
+                "measured_peak_device_bytes":
+                    getattr(tracer, "measured_peak_device_bytes", None),
+                "static_peak_bound":
+                    _json_safe(getattr(tracer, "static_peak_bound",
+                                       None)),
+            }
+            bundle["failing_operator"] = _failing_operator(spans)
+    except Exception as ex:
+        bundle["trace"] = {"error": repr(ex)}
+    # HBM observatory: occupancy split at failure time + recent window
+    try:
+        from .memprof import MemoryTimeline
+        tl = MemoryTimeline.get()
+        bundle["hbm"] = {"report": tl.report(), "window": tl.window()}
+    except Exception as ex:
+        bundle["hbm"] = {"error": repr(ex)}
+    # metrics: the full exposition text (grep-able, schema-stable)
+    try:
+        from .health import render_prometheus
+        bundle["metrics"] = render_prometheus()
+    except Exception as ex:
+        bundle["metrics"] = f"# unavailable: {ex!r}"
+    # plan + analysis states
+    try:
+        if plan is not None:
+            bundle["plan"] = plan.tree_string()
+    except Exception as ex:
+        bundle["plan"] = f"(unavailable: {ex!r})"
+    try:
+        if plan is not None and session is not None:
+            from ..analysis.interp import infer_plan
+            from ..analysis.lifetime import analyze_memory, total_bytes
+            interp = infer_plan(plan, session.conf)
+            mem = analyze_memory(plan, session.conf, interp)
+            states = []
+
+            def visit(n):
+                st = interp.state(n)
+                if st is None:
+                    return
+                b = mem.bound(n)
+                states.append({
+                    "node": type(n).__name__,
+                    "rows": None if st.rows is None else int(st.rows),
+                    "bytes": int(total_bytes(st)),
+                    "peak_hbm_bound": None if b is None else int(b),
+                })
+            plan.foreach(visit)
+            bundle["analysis"] = {
+                "states": states,
+                "diags": [f"{d.code}: {d.message}"
+                          for d in getattr(mem, "diags", [])],
+            }
+    except Exception as ex:
+        bundle["analysis"] = {"error": repr(ex)}
+    # estimator grades: predicted-vs-actual for the failed run
+    try:
+        if tracer is not None:
+            bundle["estimator"] = tracer.accuracy_rows()
+    except Exception as ex:
+        bundle["estimator"] = [{"error": repr(ex)}]
+    # effective config (the session's raw map — what the operator set,
+    # not every default; defaults are recoverable from docs/configs.md)
+    try:
+        if session is not None:
+            bundle["config"] = {str(k): str(v) for k, v in
+                                session._conf_map.items()}
+    except Exception as ex:
+        bundle["config"] = {"error": repr(ex)}
+    return bundle
+
+
+def _enforce_retention(pm_dir: str, max_bundles: int) -> None:
+    try:
+        bundles = sorted(
+            f for f in os.listdir(pm_dir)
+            if f.startswith(BUNDLE_PREFIX) and f.endswith(".json"))
+        for stale in bundles[:-max_bundles] if max_bundles > 0 else []:
+            try:
+                os.unlink(os.path.join(pm_dir, stale))
+            except OSError:
+                pass
+    except OSError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# rendering (`tools postmortem`)
+
+def list_bundles(out_dir: str) -> List[str]:
+    """Bundle paths under out_dir, oldest first.  Accepts either the
+    history dir (looks in its postmortems/ subdir) or the postmortems
+    dir itself."""
+    cand = os.path.join(out_dir, "postmortems")
+    d = cand if os.path.isdir(cand) else out_dir
+    if not os.path.isdir(d):
+        return []
+    return [os.path.join(d, f) for f in sorted(os.listdir(d))
+            if f.startswith(BUNDLE_PREFIX) and f.endswith(".json")]
+
+
+def load_bundle(path: str) -> Dict[str, Any]:
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "?"
+    n = int(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return f"{n}B"
+
+
+def render_postmortem(bundle: Dict[str, Any]) -> str:
+    """Human report: what failed, who held HBM when it did."""
+    lines = ["### Post-mortem bundle ###"]
+    err = bundle.get("error") or {}
+    lines.append(f"kind:    {bundle.get('kind', '?')}")
+    lines.append(f"tenant:  {bundle.get('tenant', '?')}"
+                 + (f"  query: {bundle['query']}"
+                    if bundle.get("query") else ""))
+    lines.append(f"error:   {err.get('type')}: {err.get('message')}")
+    op = bundle.get("failing_operator")
+    if op:
+        lines.append(f"failing operator: {op.get('operator')}"
+                     f" ({op.get('error')})")
+    else:
+        lines.append("failing operator: (no errored operator span — "
+                     "failure before/outside execution)")
+    hbm = bundle.get("hbm") or {}
+    rep = hbm.get("report") or {}
+    lines.append("")
+    lines.append(f"HBM at failure: total {_fmt_bytes(rep.get('total_bytes'))}"
+                 f" / budget {_fmt_bytes(rep.get('budget_bytes'))}"
+                 f", peak {_fmt_bytes(rep.get('peak_bytes'))}"
+                 f", demotable {_fmt_bytes(rep.get('demotable_bytes'))}")
+    tenants = rep.get("tenants") or {}
+    if tenants:
+        lines.append(f"{'tenant':16s} {'resident':>12s} {'pinned':>12s} "
+                     f"{'demotable':>12s} {'closed-pend':>12s} "
+                     f"{'arena':>12s} {'admitted':>12s}")
+        for t, row in sorted(tenants.items()):
+            lines.append(
+                f"{t[:16]:16s} {_fmt_bytes(row.get('resident_bytes')):>12s} "
+                f"{_fmt_bytes(row.get('pinned_bytes')):>12s} "
+                f"{_fmt_bytes(row.get('demotable_bytes')):>12s} "
+                f"{_fmt_bytes(row.get('closed_pending_bytes')):>12s} "
+                f"{_fmt_bytes(row.get('arena_staging_bytes')):>12s} "
+                f"{_fmt_bytes(row.get('admitted_bytes')):>12s}")
+    window = hbm.get("window") or []
+    if window:
+        lines.append(f"timeline window: {len(window)} sample(s)"
+                     + (" (truncated)" if hbm.get("window_truncated")
+                        else ""))
+        for s in window[-8:]:
+            lines.append(
+                f"  t={s.get('t_ns', 0) / 1e6:.3f}ms {s.get('tenant')}/"
+                f"{s.get('class')} {s.get('delta'):+d} -> live "
+                f"{_fmt_bytes(s.get('live'))} total "
+                f"{_fmt_bytes(s.get('total'))}"
+                + (f" op={s['operator']}" if s.get("operator") else ""))
+    tr = bundle.get("trace") or {}
+    if "spans" in tr:
+        lines.append("")
+        lines.append(
+            f"trace: {len(tr['spans'])} span(s), "
+            f"{tr.get('dropped', 0)} dropped, measured peak "
+            f"{_fmt_bytes(tr.get('measured_peak_device_bytes'))}, "
+            f"static bound {_fmt_bytes(tr.get('static_peak_bound'))}")
+    if bundle.get("plan"):
+        lines.append("")
+        lines.append("plan:")
+        lines += ["  " + l for l in
+                  str(bundle["plan"]).splitlines()[:40]]
+    diags = (bundle.get("analysis") or {}).get("diags") or []
+    if diags:
+        lines.append("analysis diags: " + "; ".join(diags[:10]))
+    if bundle.get("config"):
+        lines.append("")
+        lines.append("config (explicitly set):")
+        for k, v in sorted(bundle["config"].items()):
+            lines.append(f"  {k}={v}")
+    return "\n".join(lines) + "\n"

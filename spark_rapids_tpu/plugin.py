@@ -231,6 +231,15 @@ class TpuExecutorPlugin:
                 self.conf.get(cfg.SERVE_ADMISSION_BUDGET),
                 self.conf.get(cfg.SERVE_ADMISSION_TIMEOUT_MS) / 1000.0)
             self.spill_catalog = SpillCatalog.init_from_conf(self.conf)
+            # HBM observatory: (re)configure the occupancy timeline
+            # with the freshly-sized device budget, so its watermark
+            # fraction and tpu_hbm_budget_bytes gauge are truthful even
+            # when the plugin is bootstrapped outside a TpuSession
+            from .obs.memprof import MemoryTimeline
+            MemoryTimeline.configure(
+                enabled=self.conf.get(cfg.HBM_TIMELINE_ENABLED),
+                max_samples=self.conf.get(cfg.HBM_TIMELINE_MAX_SAMPLES),
+                budget_bytes=self.spill_catalog.device_budget)
             pinned = self.conf.get(cfg.PINNED_POOL_SIZE)
             if pinned and pinned > 0:
                 from .native.arena import configure_shared_arena

@@ -11,6 +11,14 @@
     python -m spark_rapids_tpu.tools compile-report --ledger PATH [--top N] [--json]
     python -m spark_rapids_tpu.tools estimator-report --ledger PATH [--top N] [--json]
     python -m spark_rapids_tpu.tools prewarm        --ledger DIR [--top K] [--cache-dir DIR]
+    python -m spark_rapids_tpu.tools postmortem     <bundle.json|dir> [--json] [--last N]
+
+`postmortem` renders the failure black box's bundles
+(obs/postmortem.py; dumped to <historyDir>/postmortems/ on query
+failure, dirty memsan ledger or admission timeout): the failing
+operator, its tenant/query, the per-tenant HBM occupancy split at
+failure time and the memory-timeline window leading up to it.  Given a
+directory it renders the newest bundle (or the newest --last N).
 
 `compile-report` aggregates the compile observatory's cross-session
 ledger (obs/compileprof.py; `--ledger` takes the JSONL file or the
@@ -283,6 +291,40 @@ def _run_prewarm(ledger, top, cache_dir):
     return 1 if stats["errors"] else 0
 
 
+def _run_postmortem(target, as_json=False, last=1):
+    import json
+    import os
+
+    from ..obs.postmortem import (list_bundles, load_bundle,
+                                  render_postmortem)
+
+    if os.path.isdir(target):
+        paths = list_bundles(target)[-max(last, 1):]
+        if not paths:
+            sys.stderr.write(f"{target}: no post-mortem bundles "
+                             f"(pm_*.json) found — was "
+                             f"spark.rapids.tpu.hbm.postmortem.dir (or "
+                             f"regress.historyDir) set when the query "
+                             f"failed?\n")
+            return 2
+    else:
+        paths = [target]
+    rc = 0
+    for path in paths:
+        try:
+            bundle = load_bundle(path)
+        except (OSError, ValueError) as ex:
+            sys.stderr.write(f"{path}: unreadable bundle: {ex}\n")
+            rc = 2
+            continue
+        if as_json:
+            sys.stdout.write(json.dumps(bundle, indent=2) + "\n")
+        else:
+            sys.stdout.write(f"== {path}\n")
+            sys.stdout.write(render_postmortem(bundle))
+    return rc
+
+
 def _default_baseline():
     import os
     return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
@@ -417,6 +459,20 @@ def main(argv=None):
                     help="persistent XLA compile cache to populate "
                          "(spark.rapids.tpu.jit.persistentCacheDir); "
                          "without it the replay only validates recipes")
+    pm = sub.add_parser("postmortem",
+                        help="render a failure black-box bundle "
+                             "(failing operator, tenant, HBM occupancy "
+                             "at failure time)")
+    pm.add_argument("target",
+                    help="a pm_*.json bundle, or a directory (history "
+                         "dir or its postmortems/ subdir) — renders "
+                         "the newest bundle(s)")
+    pm.add_argument("--json", action="store_true",
+                    help="emit the raw bundle JSON instead of the "
+                         "report")
+    pm.add_argument("--last", type=int, default=1,
+                    help="with a directory: render the newest N "
+                         "bundles (default 1)")
     args = p.parse_args(argv)
 
     if args.cmd == "qualification":
@@ -454,6 +510,9 @@ def main(argv=None):
                                     as_json=args.json)
     elif args.cmd == "prewarm":
         return _run_prewarm(args.ledger, args.top, args.cache_dir)
+    elif args.cmd == "postmortem":
+        return _run_postmortem(args.target, as_json=args.json,
+                               last=args.last)
     else:
         if args.lock_graph:
             return _run_lock_graph(args.output)

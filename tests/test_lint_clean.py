@@ -211,6 +211,25 @@ def test_run_lint_fleet_gate_exits_zero():
     assert "fleet gate clean" in proc.stdout, proc.stdout
 
 
+def test_run_lint_hbm_gate_exits_zero():
+    """Tier-1 gate for the HBM observatory: the tenant memory timeline,
+    the memsan shadow ledger and the spill catalog must agree
+    byte-for-byte on a golden replay's peak device occupancy; a
+    4-session pool stress must book every lifecycle event under its
+    pool tenant with the tpu_hbm_tenant_bytes gauges summing to the
+    timeline's live total; an injected context-free allocation must
+    trip the unattributed counter and an injected operator failure must
+    leave exactly one parseable post-mortem bundle naming the failing
+    operator (anti-vacuity both ways)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "devtools", "run_lint.py"),
+         "--hbm"],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "hbm gate clean" in proc.stdout, proc.stdout
+
+
 def test_baseline_is_empty_and_stays_empty():
     """PR-3 burned the last baselined TPU-R001 debt down to zero: the
     ratchet now enforces a spotless repo (deliberate exceptions are
