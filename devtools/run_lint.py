@@ -109,6 +109,21 @@ injected from a context-free thread must trip the unattributed
 counter, and an injected operator failure must leave exactly one
 parseable post-mortem bundle naming the failing operator and tenant.
 
+--faults runs the tpufsan fault-injection campaign: the exception-flow
+pass (analysis/raiseflow.py) must be finding-free (TPU-R011 broad
+swallow, TPU-R012 leaking release obligation, TPU-R013 untyped seam
+escape, TPU-R014 deadline-free socket), its raise-graph artifact must
+enumerate >= 40 statically-reachable (seam, typed-error) pairs with
+zero untyped leaks, and every pair is then injected for real — through
+the session, the serving pool, the async fetcher and the block server
+— asserting the exact typed error reaches the caller, the admission /
+shuffle / spill books balance with all spans closed, and exactly one
+parseable post-mortem bundle records each failure; background roots
+(heartbeat loop, metrics endpoint) must survive an injected fault
+while counting it, degrading health and black-boxing it — plus
+anti-vacuity: planted orphans must trip the books check and an
+untyped injection must fail the propagation verdict.
+
     python devtools/run_lint.py                    # repo check
     python devtools/run_lint.py --update-baseline  # re-freeze debt
     python devtools/run_lint.py --interp           # plan typechecker gate
@@ -122,6 +137,7 @@ parseable post-mortem bundle naming the failing operator and tenant.
     python devtools/run_lint.py --feedback         # estimator-observatory gate
     python devtools/run_lint.py --fleet            # fleet-observatory gate
     python devtools/run_lint.py --hbm              # HBM-observatory gate
+    python devtools/run_lint.py --faults           # tpufsan fault campaign
 """
 
 import json
@@ -2398,6 +2414,495 @@ def run_fleet_gate() -> int:
     return 0
 
 
+def run_faults_gate() -> int:
+    """tpufsan fault-injection campaign: the raise-graph artifact
+    enumerates every statically-reachable (seam, typed-error) pair
+    (>= 40) and the gate injects each one, asserting (a) the exact
+    typed error propagates to the seam's caller, (b) the admission /
+    shuffle / spill books balance afterward with all spans closed, and
+    (c) exactly one parseable post-mortem bundle records the failure.
+    Background thread roots (heartbeat loop, metrics endpoint) get
+    their own legs: an injected fault must increment
+    tpu_background_errors_total{root}, degrade health and black-box a
+    background_failure bundle while the thread SURVIVES.  Anti-vacuity:
+    the books check must flag planted orphans, and an untyped injected
+    error must fail the propagation verdict."""
+    import shutil
+    import tempfile
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.analysis import raiseflow
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.pool import (PoolClosedError, PoolTimeout,
+                                           SessionPool)
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.exec import basic as exec_basic
+    from spark_rapids_tpu.exec.base import _wrap_execute_partition
+    from spark_rapids_tpu.memory.admission import AdmissionController
+    from spark_rapids_tpu.memory.spill import SpillCatalog
+    from spark_rapids_tpu.obs import bgerrors, health
+    from spark_rapids_tpu.obs import metrics as m
+    from spark_rapids_tpu.obs import postmortem as pm
+    from spark_rapids_tpu.obs.metrics import MetricsRegistry
+    from spark_rapids_tpu.shuffle import transport as tr
+    from spark_rapids_tpu.shuffle.errors import TpuShuffleError
+    from spark_rapids_tpu.shuffle.heartbeat import (HeartbeatEndpoint,
+                                                    HeartbeatManager)
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+
+    failures = 0
+    injected = 0
+
+    # -- leg 1: the static campaign plan itself -----------------------------
+    for d in raiseflow.repo_diagnostics():
+        failures += 1
+        print(f"FAULTS: raiseflow finding (fix it, don't baseline it): "
+              f"{d.render()}")
+    art = raiseflow.raise_graph_artifact()
+    plan = art["injections"]
+    if len(plan) < 40:
+        failures += 1
+        print(f"FAULTS: injection plan shrank to {len(plan)} pairs "
+              f"(< 40) — seam reachability regressed")
+    leaks = sum(len(s["untyped"]) for s in art["seams"].values())
+    if leaks:
+        failures += 1
+        print(f"FAULTS: {leaks} untyped operational leak(s) at public "
+              f"seams in the artifact")
+    by_seam = {}
+    for inj in plan:
+        by_seam.setdefault(inj["seam"], []).append(inj["error"])
+
+    # -- fresh world --------------------------------------------------------
+    MetricsRegistry.reset_for_tests()
+    with SpillCatalog._lock:
+        SpillCatalog._instance = SpillCatalog()
+    TpuShuffleManager.reset()
+    AdmissionController.reset_for_tests()
+    bgerrors.reset()
+    pmdir = tempfile.mkdtemp(prefix="tpu_faults_pm_")
+
+    def books(session=None):
+        probs = []
+        blocks = TpuShuffleManager.get().catalog.num_blocks()
+        if blocks:
+            probs.append(f"{blocks} orphaned shuffle block(s)")
+        sleaks = SpillCatalog.get().leak_report()
+        if sleaks:
+            probs.append(f"{len(sleaks)} spill leak(s)")
+        ac = AdmissionController.get()
+        if ac is not None:
+            if ac.bytes_in_flight():
+                probs.append(f"{ac.bytes_in_flight()} admission "
+                             f"byte(s) still in flight")
+            if ac.queue_depth():
+                probs.append(f"admission queue depth "
+                             f"{ac.queue_depth()}")
+        if session is not None:
+            trace = session.last_query_trace()
+            if trace is not None and trace.open_span_count():
+                probs.append(f"{trace.open_span_count()} unclosed "
+                             f"span(s)")
+        return probs
+
+    def expect_bundle(before, name):
+        new = [b for b in pm.list_bundles(pmdir) if b not in before]
+        if len(new) != 1:
+            return [f"expected exactly 1 new bundle, found {len(new)}"]
+        try:
+            doc = pm.load_bundle(new[0])
+        except Exception as ex:
+            return [f"bundle unparseable: {ex!r}"]
+        probs = []
+        if (doc.get("error") or {}).get("type") != name:
+            probs.append(f"bundle names "
+                         f"{(doc.get('error') or {}).get('type')!r}, "
+                         f"injected {name}")
+        if not doc.get("kind"):
+            probs.append("bundle has no kind")
+        return probs
+
+    # -- leg 2: session seams (main-query, serving-client) ------------------
+    tb = pa.table({
+        "k": pa.array((np.arange(400) % 7).astype(np.int64)),
+        "v": pa.array(np.arange(400, dtype=np.int64))})
+    conf = {
+        "spark.rapids.sql.enabled": "true",
+        "spark.rapids.tpu.memsan.enabled": "true",
+        "spark.rapids.tpu.trace.enabled": "true",
+        "spark.rapids.tpu.hbm.postmortem.dir": pmdir,
+        "spark.rapids.tpu.hbm.postmortem.maxBundles": "500",
+    }
+    sess = TpuSession(conf)
+    pool = SessionPool(2, conf)
+    real_execute = exec_basic.FilterExec.execute_partition
+
+    def inject_session(seam, name, runner, raise_obj=None,
+                       expect_name=None):
+        """Arm FilterExec with the constructed error, run one golden
+        query through the seam, verify type + books + bundle."""
+        err = raise_obj if raise_obj is not None \
+            else raiseflow.construct_error(name)
+        expect_name = expect_name or name
+
+        def boom(self, pid, ctx):
+            raise err
+            yield  # generator: the raise happens inside the op span
+
+        exec_basic.FilterExec.execute_partition = \
+            _wrap_execute_partition(boom)
+        before = set(pm.list_bundles(pmdir))
+        caught = None
+        used_session = []
+        try:
+            try:
+                runner(used_session)
+            except BaseException as ex:
+                caught = ex
+        finally:
+            exec_basic.FilterExec.execute_partition = real_execute
+        probs = []
+        if caught is None:
+            probs.append("injected fault never surfaced")
+        elif type(caught).__name__ != expect_name:
+            probs.append(f"typed propagation broken: injected "
+                         f"{expect_name}, caller saw "
+                         f"{type(caught).__name__}: {caught}")
+        probs += books(used_session[0] if used_session else None)
+        probs += expect_bundle(before, expect_name)
+        return probs
+
+    def run_main(used):
+        used.append(sess)
+        sess.create_dataframe(tb, num_partitions=2) \
+            .filter(col("v") > 5).collect()
+
+    def run_pool(used):
+        def q(s):
+            used.append(s)
+            return (s.create_dataframe(tb, num_partitions=2)
+                    .filter(col("v") > 5).collect())
+        pool.run(q, timeout=60)
+
+    for seam, runner in (("main-query", run_main),
+                         ("serving-client", run_pool)):
+        for name in by_seam.get(seam, []):
+            injected += 1
+            for p in inject_session(seam, name, runner):
+                failures += 1
+                print(f"FAULTS [{seam}/{name}]: {p}")
+
+    # -- leg 3: pool seams driven for real ----------------------------------
+    def harness_bundle(seam, err):
+        """Non-session seams have no session to black-box for them; the
+        serving harness records the typed failure itself."""
+        pm.dump_postmortem(pmdir, err, tenant=f"faults:{seam}",
+                           max_bundles=500)
+
+    def drive_pool_seam(seam, name, driver):
+        before = set(pm.list_bundles(pmdir))
+        caught = None
+        try:
+            driver()
+        except BaseException as ex:
+            caught = ex
+        probs = []
+        if caught is None:
+            probs.append("real-path drive raised nothing")
+        elif type(caught).__name__ != name:
+            probs.append(f"expected {name}, got "
+                         f"{type(caught).__name__}: {caught}")
+        else:
+            harness_bundle(seam, caught)
+            probs += expect_bundle(before, name)
+        probs += books()
+        return probs
+
+    def drive_borrow_closed():
+        p2 = SessionPool(1, {"spark.rapids.sql.enabled": "true"})
+        p2.close()
+        with p2.session():
+            pass
+
+    def drive_borrow_timeout():
+        p2 = SessionPool(1, {"spark.rapids.sql.enabled": "true"})
+        try:
+            with p2.session():
+                with p2.session(timeout=0.05):
+                    pass
+        finally:
+            p2.close()
+
+    def drive_drain_timeout():
+        p2 = SessionPool(1, {"spark.rapids.sql.enabled": "true"})
+        try:
+            ctx = p2.session()
+            ctx.__enter__()  # held busy past the drain deadline
+            try:
+                p2.drain(timeout=0.05)
+            finally:
+                ctx.__exit__(None, None, None)
+        finally:
+            p2.close()
+
+    for seam, name, driver in (
+            ("pool-borrow", "PoolClosedError", drive_borrow_closed),
+            ("pool-borrow", "PoolTimeout", drive_borrow_timeout),
+            ("pool-drain", "PoolTimeout", drive_drain_timeout)):
+        injected += 1
+        for p in drive_pool_seam(seam, name, driver):
+            failures += 1
+            print(f"FAULTS [{seam}/{name}]: {p}")
+
+    # -- leg 4: shuffle-fetcher seam ----------------------------------------
+    class _Tx:
+        def __init__(self, result=None, exc=None):
+            self.result, self.exc = result, exc
+
+        def wait(self, timeout=None):
+            if self.exc is not None:
+                raise self.exc
+            return self.result
+
+    class _StubClient:
+        def __init__(self, err):
+            self.err = err
+
+        def fetch_metadata(self, sid, rid, ctx=None):
+            return _Tx(result=[((sid, 0, rid, 0), None)])
+
+        def fetch_block(self, sid, mid, rid, idx, xp=None, ctx=None):
+            return _Tx(exc=self.err)
+
+    for name in by_seam.get("shuffle-fetcher", []):
+        injected += 1
+        err = raiseflow.construct_error(name)
+        before = set(pm.list_bundles(pmdir))
+        fetcher = tr.AsyncBlockFetcher(_StubClient(err), 7, 0,
+                                       timeout=5.0)
+        caught = None
+        try:
+            list(fetcher.blocks())
+        except BaseException as ex:
+            caught = ex
+        probs = []
+        if caught is None:
+            probs.append("fetcher swallowed the injected fault")
+        elif type(caught).__name__ != name:
+            probs.append(f"fetch classification mangled the type: "
+                         f"injected {name}, got "
+                         f"{type(caught).__name__}: {caught}")
+        else:
+            harness_bundle("shuffle-fetcher", caught)
+            probs += expect_bundle(before, name)
+        probs += books()
+        for p in probs:
+            failures += 1
+            print(f"FAULTS [shuffle-fetcher/{name}]: {p}")
+    errs_counted = sum(
+        ch.value for _, ch in
+        m.counter("tpu_shuffle_fetch_errors_total",
+                  labelnames=("kind",)).series())
+    if errs_counted < len(by_seam.get("shuffle-fetcher", [])):
+        failures += 1
+        print(f"FAULTS: fetch-error counter saw {errs_counted} of "
+              f"{len(by_seam.get('shuffle-fetcher', []))} injections")
+
+    # -- leg 5: block-server seam (typed relay over the wire) ---------------
+    for name in by_seam.get("block-server", []):
+        injected += 1
+        err = raiseflow.construct_error(name)
+        mgr = TpuShuffleManager.get()
+        server = tr.ShuffleServer(mgr).start()
+        before = set(pm.list_bundles(pmdir))
+        real_get = mgr.catalog.get
+        mgr.catalog.get = lambda *a, **k: (_ for _ in ()).throw(err)
+        caught = None
+        try:
+            client = tr.ShuffleClient("127.0.0.1", server.port,
+                                      timeout=5.0)
+            try:
+                client.fetch_block(1, 0, 0, 0).wait(5.0)
+            except BaseException as ex:
+                caught = ex
+            probs = []
+            if caught is None:
+                probs.append("server swallowed the injected fault")
+            elif not isinstance(caught, TpuShuffleError):
+                probs.append(f"wire relay lost the typed taxonomy: "
+                             f"got {type(caught).__name__}: {caught}")
+            elif name not in str(caught):
+                probs.append(f"relayed error does not name the "
+                             f"server-side {name}: {caught}")
+            else:
+                harness_bundle("block-server", caught)
+                probs += expect_bundle(before, type(caught).__name__)
+            # liveness: the server must still answer after the fault
+            mgr.catalog.get = real_get
+            metas = client.fetch_metadata(99, 0).wait(5.0)
+            if metas is None:
+                probs.append("server dead after relaying the fault")
+        finally:
+            mgr.catalog.get = real_get
+            server.stop()
+        probs += books()
+        for p in probs:
+            failures += 1
+            print(f"FAULTS [block-server/{name}]: {p}")
+
+    # -- leg 6: background thread roots -------------------------------------
+    bgerrors.reset()
+    bgerrors.set_postmortem_dir(pmdir)
+
+    def bg_counter(root):
+        fam = m.counter("tpu_background_errors_total",
+                        labelnames=("root",))
+        return sum(ch.value for lbl, ch in fam.series()
+                   if lbl.get("root") == root)
+
+    # heartbeat loop: one poisoned beat, then the loop must keep beating
+    before = set(pm.list_bundles(pmdir))
+    hb_mgr = HeartbeatManager(timeout_s=30.0)
+    calls = {"n": 0}
+    real_beat = hb_mgr.executor_heartbeat
+
+    def flaky_beat(eid):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("faults gate injected heartbeat failure")
+        return real_beat(eid)
+
+    hb_mgr.executor_heartbeat = flaky_beat
+    ep = HeartbeatEndpoint(hb_mgr, "e1", "127.0.0.1", 1,
+                           interval_s=0.02).start()
+    deadline = _time.monotonic() + 5.0
+    while calls["n"] < 3 and _time.monotonic() < deadline:
+        _time.sleep(0.02)
+    ep.stop()
+    probs = []
+    if calls["n"] < 3:
+        probs.append(f"heartbeat loop died after the injected fault "
+                     f"(beats: {calls['n']})")
+    if bg_counter("heartbeat-loop") < 1:
+        probs.append("tpu_background_errors_total{root=heartbeat-loop} "
+                     "never incremented")
+    rec = bgerrors.last_error("heartbeat-loop")
+    if not rec or rec["type"] != "RuntimeError":
+        probs.append(f"last-error record wrong: {rec}")
+    new = [b for b in pm.list_bundles(pmdir) if b not in before]
+    kinds = []
+    for b in new:
+        try:
+            kinds.append(pm.load_bundle(b).get("kind"))
+        except Exception:
+            kinds.append("<unparseable>")
+    if kinds != ["background_failure"]:
+        probs.append(f"expected one background_failure bundle, "
+                     f"got {kinds}")
+    injected += 1
+    for p in probs:
+        failures += 1
+        print(f"FAULTS [heartbeat-loop]: {p}")
+
+    # metrics endpoint: a failing scrape must 500 + count + degrade,
+    # and the endpoint must keep serving afterward
+    before = set(pm.list_bundles(pmdir))
+    srv = health.MetricsServer(0)
+    real_render = health.render_prometheus
+
+    def bad_render(*a, **k):
+        raise RuntimeError("faults gate injected scrape failure")
+
+    probs = []
+    try:
+        health.render_prometheus = bad_render
+        code = None
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5)
+        except urllib.error.HTTPError as ex:
+            code = ex.code
+        if code != 500:
+            probs.append(f"poisoned scrape answered {code}, not 500")
+        health.render_prometheus = real_render
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics",
+                timeout=5) as resp:
+            if resp.status != 200:
+                probs.append(f"endpoint dead after the fault: "
+                             f"{resp.status}")
+        if bg_counter("metrics-http") < 1:
+            probs.append("tpu_background_errors_total"
+                         "{root=metrics-http} never incremented")
+        snap = srv.monitor.snapshot()
+        comp = (snap.get("components") or {}).get("background")
+        status = comp.get("status") if isinstance(comp, dict) else comp
+        if status not in ("degraded", "DEGRADED"):
+            probs.append(f"health did not degrade on a background "
+                         f"fault: {status!r}")
+        new = [b for b in pm.list_bundles(pmdir) if b not in before]
+        if len(new) != 1:
+            probs.append(f"expected one metrics-http bundle, "
+                         f"found {len(new)}")
+    finally:
+        health.render_prometheus = real_render
+        srv.close()
+    injected += 1
+    for p in probs:
+        failures += 1
+        print(f"FAULTS [metrics-http]: {p}")
+
+    # -- leg 7: anti-vacuity ------------------------------------------------
+    # (a) the books check must flag planted orphans
+    from spark_rapids_tpu.columnar.device import batch_to_device
+    from spark_rapids_tpu.shuffle.manager import ShuffleBlockId
+    rb = pa.record_batch({"x": pa.array(np.arange(64, dtype=np.int64))})
+    planted_sb = SpillCatalog.get().register(batch_to_device(rb, xp=np))
+    TpuShuffleManager.get().catalog.add(
+        ShuffleBlockId(9999, 0, 0), batch_to_device(rb, xp=np))
+    planted = books()
+    TpuShuffleManager.get().catalog.remove_shuffle(9999)
+    planted_sb.close()
+    if len(planted) < 2:
+        failures += 1
+        print(f"FAULTS: books check is vacuous — planted an orphan "
+              f"block AND a spill leak, it reported {planted}")
+    if books():
+        failures += 1
+        print(f"FAULTS: books dirty after anti-vacuity cleanup: "
+              f"{books()}")
+    # (b) an untyped injected error must fail the propagation verdict
+    untyped = inject_session(
+        "main-query", "TpuShuffleTimeoutError", run_main,
+        raise_obj=RuntimeError("untyped leak the verdict must catch"),
+        expect_name="TpuShuffleTimeoutError")
+    if not any("typed propagation broken" in p for p in untyped):
+        failures += 1
+        print("FAULTS: propagation verdict is vacuous — an untyped "
+              "RuntimeError injection produced no typed-propagation "
+              "complaint")
+
+    pool.close()
+    shutil.rmtree(pmdir, ignore_errors=True)
+    bgerrors.reset()
+    MetricsRegistry.reset_for_tests()
+    AdmissionController.reset_for_tests()
+    if failures:
+        print(f"faults gate: {failures} failure(s) over {injected} "
+              f"injection(s)")
+        return 1
+    print(f"faults gate clean ({injected} fault injections across "
+          f"{len(by_seam)} seams + 2 background roots: 100% typed "
+          f"propagation, books balanced, one parseable post-mortem "
+          f"bundle per failure)")
+    return 0
+
+
 def main(argv=None):
     args = argv if argv is not None else sys.argv[1:]
     if "--interp" in args:
@@ -2424,6 +2929,8 @@ def main(argv=None):
         return run_fleet_gate()
     if "--hbm" in args:
         return run_hbm_gate()
+    if "--faults" in args:
+        return run_faults_gate()
     from spark_rapids_tpu.tools.__main__ import main as tools_main
     cli = ["lint", "--repo", "--baseline", BASELINE]
     if "--update-baseline" in args:

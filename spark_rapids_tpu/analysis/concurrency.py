@@ -213,6 +213,7 @@ class Analysis:
         self.allow_sites: Dict[int, List[Tuple[str, int]]] = {}
         self.roots: Dict[str, str] = {}        # root fid -> label
         self.reachable: Dict[str, Set[str]] = {}
+        self.funcs: Dict[str, "FuncInfo"] = {}  # fid -> resolved info
 
     def artifact(self) -> Dict:
         """JSON-able lock-order relation the runtime witness consumes."""
@@ -994,6 +995,10 @@ class _Analyzer:
                         stack.append(nxt)
             reach[root] = seen
         res.reachable = reach
+        # retain the resolved function table: the exception-flow pass
+        # (raiseflow.py) propagates raise sets over this same call
+        # graph instead of re-resolving targets
+        res.funcs = self.funcs
 
         # always-held fixpoint H(f) over the full graph, from roots
         TOP = None

@@ -753,7 +753,14 @@ def infer_plan(root: eb.Exec, conf: cfg.RapidsConf,
         result.diags.extend(_check_contracts(node, child_states, here))
         try:
             st = _transfer(node, child_states, conf)
-        except Exception:
+        except Exception as ex:
+            # deliberate degradation (the fallback state keeps the
+            # interpreter total) — but record the swallowed error on
+            # the flight recorder so a misbehaving transfer function
+            # is diagnosable, not silent (tpufsan TPU-R011)
+            from ..obs.tracer import trace_event
+            trace_event("interp.transfer_fallback", node=node.name,
+                        error=repr(ex))
             st = _fallback_state(node, child_states)
         if row_overrides and id(node) in row_overrides:
             st.rows = row_overrides[id(node)]

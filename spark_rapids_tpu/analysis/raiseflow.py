@@ -1,0 +1,1159 @@
+"""tpufsan — inter-procedural exception-flow & resource-release lint.
+
+Ref: the reference plugin's resilience rests on disciplined error
+propagation across the JNI/shuffle boundary (typed fetch failures that
+the stage-retry scheduler dispatches on, RMM retry/OOM unwinding that
+releases every reservation it holds).  This pass proves the same
+discipline statically for our port, the third instance of the
+static-pass + runtime-witness pattern tpucsan (locks) and tmsan
+(device memory) established:
+
+  * per function, the set of TYPED errors it can raise — seeded from
+    explicit ``raise`` sites, propagated over the tpucsan-resolved call
+    graph (typed edges only; the CHA fallback is reachability-grade,
+    not propagation-grade), narrowed by ``except`` clauses;
+  * four repo rules over that raise graph:
+
+      TPU-R011  overbroad/bare ``except`` that swallows a typed engine
+                error without re-raising it or routing it through a
+                sanctioned sink (postmortem / black-box recording, the
+                background-error router, a relay that hands the caught
+                exception onward);
+      TPU-R012  a resource acquired on a path where a raising
+                successor can skip its release — the release
+                obligation is declared per acquire API
+                (``_OBLIGATIONS``); ``with``, ``try/finally`` and
+                ownership-transfer idioms are recognized;
+      TPU-R013  an untyped operational exception (RuntimeError,
+                TimeoutError, OSError family) escaping a public seam
+                whose callers dispatch on the typed taxonomy — scoped
+                to raises originating inside the seam's own subsystem
+                so a deep utility ValueError is not the seam's debt;
+      TPU-R014  a socket created or accepted on a thread-root-reachable
+                path with no explicit deadline (a hung peer must never
+                pin a daemon thread forever).
+
+The computed raise graph doubles as the *test plan*: ``tools lint
+--raise-graph`` dumps per-seam raise sets plus the injection plan, and
+``devtools/run_lint.py --faults`` replays the golden corpus once per
+statically-reachable (seam, typed-error) pair with that fault
+monkeypatch-injected, asserting typed propagation, balanced books and
+a postmortem bundle — the same artifact hand-off the lock witness uses
+against the tpucsan lock-order artifact.
+
+Suppression: ``# tpulint: allow[TPU-R01x] reason`` on the flagged line,
+same as every repo rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from .diagnostics import Diagnostic, register_rule
+
+R011 = register_rule(
+    "TPU-R011", "error", "broad except swallows a typed engine error",
+    "An overbroad or typed `except` consumes an engine error from the "
+    "typed taxonomy without re-raising it or routing it through a "
+    "sanctioned sink (postmortem/black-box recording, the background-"
+    "error router, or a relay that passes the exception onward). "
+    "Callers dispatching on the taxonomy never see the failure.")
+R012 = register_rule(
+    "TPU-R012", "error", "raising path can skip a resource release",
+    "A resource with a declared release obligation (admission ticket, "
+    "tracer span, spill registration, pooled session, socket) is "
+    "acquired where a raising successor can unwind past the release. "
+    "Use `with`, a `try/finally`, or transfer ownership explicitly.")
+R013 = register_rule(
+    "TPU-R013", "error", "untyped exception escapes a public seam",
+    "A public seam whose callers dispatch on the typed error taxonomy "
+    "can leak an untyped operational exception (RuntimeError, "
+    "TimeoutError, OSError family) raised inside the seam's own "
+    "subsystem. Type the failure so retry/backpressure policy can act "
+    "on it.")
+R014 = register_rule(
+    "TPU-R014", "error", "socket on a thread root has no deadline",
+    "A socket created, connected or accepted on a path reachable from "
+    "a daemon-thread root carries no explicit timeout: a hung peer "
+    "pins the thread forever. Pass timeout= at creation or call "
+    "settimeout() before blocking I/O.")
+
+
+# ---------------------------------------------------------------------------
+# taxonomy: builtin exception hierarchy + package-defined classes
+# ---------------------------------------------------------------------------
+
+# the slice of the builtin hierarchy the repo actually raises/catches
+_BUILTIN_EXC_PARENTS: Dict[str, Tuple[str, ...]] = {
+    "BaseException": (),
+    "Exception": ("BaseException",),
+    "GeneratorExit": ("BaseException",),
+    "KeyboardInterrupt": ("BaseException",),
+    "SystemExit": ("BaseException",),
+    "StopIteration": ("Exception",),
+    "StopAsyncIteration": ("Exception",),
+    "ArithmeticError": ("Exception",),
+    "ZeroDivisionError": ("ArithmeticError",),
+    "OverflowError": ("ArithmeticError",),
+    "AssertionError": ("Exception",),
+    "AttributeError": ("Exception",),
+    "ImportError": ("Exception",),
+    "ModuleNotFoundError": ("ImportError",),
+    "LookupError": ("Exception",),
+    "KeyError": ("LookupError",),
+    "IndexError": ("LookupError",),
+    "MemoryError": ("Exception",),
+    "NameError": ("Exception",),
+    "NotImplementedError": ("RuntimeError",),
+    "RecursionError": ("RuntimeError",),
+    "RuntimeError": ("Exception",),
+    "OSError": ("Exception",),
+    "IOError": ("OSError",),
+    "FileNotFoundError": ("OSError",),
+    "FileExistsError": ("OSError",),
+    "PermissionError": ("OSError",),
+    "ConnectionError": ("OSError",),
+    "ConnectionResetError": ("ConnectionError",),
+    "ConnectionRefusedError": ("ConnectionError",),
+    "ConnectionAbortedError": ("ConnectionError",),
+    "BrokenPipeError": ("ConnectionError",),
+    "TimeoutError": ("OSError",),
+    "InterruptedError": ("OSError",),
+    "TypeError": ("Exception",),
+    "ValueError": ("Exception",),
+    "UnicodeDecodeError": ("ValueError",),
+    "UnicodeEncodeError": ("ValueError",),
+    # dotted builtins the transport/codec layers touch
+    "socket.timeout": ("TimeoutError",),
+    "socket.error": ("OSError",),
+    "struct.error": ("Exception",),
+    "json.JSONDecodeError": ("ValueError",),
+    "queue.Empty": ("Exception",),
+    "queue.Full": ("Exception",),
+    "pickle.PicklingError": ("Exception",),
+}
+
+# Exception-typed catches do NOT consume these
+_NOT_UNDER_EXCEPTION = {"GeneratorExit", "KeyboardInterrupt",
+                        "SystemExit", "BaseException"}
+
+# R013: the untyped *operational* failures callers would have to
+# dispatch on blind.  Programming errors (ValueError/TypeError/KeyError
+# ...) stay out: they indicate caller bugs, not runtime conditions a
+# retry/backpressure policy acts on.
+_UNTYPED_OPERATIONAL = {
+    "RuntimeError", "TimeoutError", "OSError", "IOError",
+    "ConnectionError", "ConnectionResetError", "BrokenPipeError",
+    "socket.timeout", "socket.error", "Exception", "BaseException",
+}
+
+# dynamic raise whose class the pass cannot resolve (``raise f(x)``)
+_DYNAMIC = "<dynamic>"
+
+_PKG_PREFIX = "spark_rapids_tpu/"
+
+
+def _path_under(relpath: str, prefix: str) -> bool:
+    """Does ``relpath`` live under ``prefix``?  Tolerates relpaths that
+    carry the package directory (spark_rapids_tpu/api/...) against
+    package-relative prefixes (api/)."""
+    if relpath.startswith(_PKG_PREFIX):
+        relpath = relpath[len(_PKG_PREFIX):]
+    if prefix.startswith(_PKG_PREFIX):
+        prefix = prefix[len(_PKG_PREFIX):]
+    return relpath == prefix or relpath.startswith(prefix)
+
+
+def _package_exceptions(sources: Dict[str, str]) -> Dict[str, Dict]:
+    """{class name: {"bases": (...), "relpath": ..., "lineno": ...}}
+    for every exception class defined in the package (transitively
+    rooted in a builtin exception)."""
+    classes: Dict[str, Dict] = {}
+    for relpath, src in sources.items():
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bases = []
+            for b in node.bases:
+                if isinstance(b, ast.Name):
+                    bases.append(b.id)
+                elif isinstance(b, ast.Attribute):
+                    bases.append(b.attr)
+            classes.setdefault(node.name, {
+                "bases": tuple(bases), "relpath": relpath,
+                "lineno": node.lineno})
+    # fixpoint: a class is an exception iff some base is
+    exc: Dict[str, Dict] = {}
+    changed = True
+    while changed:
+        changed = False
+        for name, info in classes.items():
+            if name in exc:
+                continue
+            if any(b in _BUILTIN_EXC_PARENTS or b in exc
+                   for b in info["bases"]):
+                exc[name] = info
+                changed = True
+    return exc
+
+
+class Taxonomy:
+    """Subclass lattice over builtin + package exception names."""
+
+    def __init__(self, package_exc: Dict[str, Dict]):
+        self.package_exc = package_exc
+        self._parents: Dict[str, Tuple[str, ...]] = dict(
+            _BUILTIN_EXC_PARENTS)
+        for name, info in package_exc.items():
+            self._parents[name] = tuple(
+                b for b in info["bases"]
+                if b in _BUILTIN_EXC_PARENTS or b in package_exc)
+
+    def is_typed(self, name: str) -> bool:
+        return name in self.package_exc
+
+    def ancestors(self, name: str) -> Set[str]:
+        out: Set[str] = set()
+        work = [name]
+        while work:
+            cur = work.pop()
+            for p in self._parents.get(cur, ()):
+                if p not in out:
+                    out.add(p)
+                    work.append(p)
+        return out
+
+    def catches(self, caught: str, raised: str) -> bool:
+        """Would ``except caught`` consume ``raise raised``?"""
+        if raised == _DYNAMIC:
+            return caught in ("*", "BaseException", "Exception")
+        if caught == "*" or caught == "BaseException":
+            return True
+        if caught == "Exception":
+            return raised not in _NOT_UNDER_EXCEPTION
+        return raised == caught or caught in self.ancestors(raised)
+
+    def is_broad(self, types: Tuple[str, ...]) -> bool:
+        return any(t in ("*", "Exception", "BaseException")
+                   for t in types)
+
+
+# ---------------------------------------------------------------------------
+# seams and obligations
+# ---------------------------------------------------------------------------
+
+# (label, relpath suffix, scope suffix, subsystem prefixes whose
+#  untyped raises are the seam's R013 debt)
+SEAMS: Tuple[Tuple[str, str, str, Tuple[str, ...]], ...] = (
+    ("main-query", "api/session.py", "TpuSession.execute", ("api/",)),
+    ("serving-client", "api/pool.py", "SessionPool.run", ("api/",)),
+    ("pool-borrow", "api/pool.py", "SessionPool._borrow", ("api/",)),
+    ("pool-drain", "api/pool.py", "SessionPool.drain", ("api/",)),
+    ("shuffle-fetcher", "shuffle/transport.py",
+     "AsyncBlockFetcher.blocks", ("shuffle/",)),
+    ("block-server", "shuffle/transport.py", "ShuffleServer._serve_one",
+     ("shuffle/",)),
+    ("heartbeat-loop", "shuffle/heartbeat.py", "HeartbeatEndpoint._run",
+     ("shuffle/",)),
+    ("metrics-http", "obs/health.py", "do_GET", ("obs/health.py",)),
+)
+
+# a seam whose workload is a caller-supplied callable executes another
+# seam's body at runtime even though the call is statically invisible:
+# SessionPool.run(fn) invokes fn(session) which drives
+# TpuSession.execute in every real caller — its injection plan
+# inherits the delegate's
+_SEAM_DELEGATES: Dict[str, Tuple[str, ...]] = {
+    "serving-client": ("main-query",),
+}
+
+# release obligations: acquire fid suffix -> (label, release method
+# names).  The release call must be guaranteed (with / finally) or
+# ownership must leave the function (returned, yielded, stored on
+# self/module state, or handed to another call).
+_OBLIGATIONS: Tuple[Tuple[str, str, Tuple[str, ...]], ...] = (
+    ("admission ticket",
+     "memory/admission.py::AdmissionController.admit", ("release",)),
+    ("tracer span",
+     "obs/tracer.py::QueryTrace.start", ("end", "finalize")),
+    ("spill registration",
+     "memory/spill.py::SpillCatalog.register",
+     ("unregister", "close")),
+    ("pooled session",
+     "api/pool.py::SessionPool._borrow", ("_return", "close")),
+    # NOT an obligation: TpuShuffleManager.write_map_output — map
+    # outputs are stage-scoped by design (release_plan_shuffles runs
+    # in the session's except-BaseException arm); the books checks in
+    # the --shuffle/--serve/--faults gates witness that at runtime.
+)
+
+# handler callees that count as sanctioned sinks for TPU-R011: failure
+# black-box recording and the background-error router ARE the typed
+# route for paths with no caller to re-raise into
+_SANCTIONED_SINKS = {
+    "dump_postmortem", "_maybe_postmortem", "build_bundle",
+    "note_background_error", "record_failure",
+    # plan tagging: a caught typed error becomes a recorded
+    # cannot-place-on-TPU reason the plan report surfaces
+    "will_not_work",
+    # flight-recorder breadcrumb: deliberate degradation that records
+    # itself to the black box is routed, not swallowed
+    "trace_event",
+}
+
+# handler callees that never count as relaying the caught exception
+# (formatting/logging keeps the swallow a swallow)
+_LOGGING_CALLEES = {
+    "debug", "info", "warning", "warn", "error", "exception", "log",
+    "print", "repr", "str", "format",
+}
+
+
+# ---------------------------------------------------------------------------
+# per-function exception-flow scan
+# ---------------------------------------------------------------------------
+
+class _Handler:
+    __slots__ = ("types", "lineno", "name", "has_raise", "relays",
+                 "routes_sink", "deferred_names", "reraises_bare")
+
+    def __init__(self, types: Tuple[str, ...], lineno: int,
+                 name: Optional[str]):
+        self.types = types
+        self.lineno = lineno
+        self.name = name          # `except X as name`
+        self.has_raise = False    # any raise statement in the body
+        self.reraises_bare = False
+        self.relays = False       # caught var passed onward as an arg
+        self.routes_sink = False  # calls a sanctioned sink
+        self.deferred_names: Set[str] = set()  # v = ex; ... raise v
+
+
+class _TryCtx:
+    __slots__ = ("handlers", "lineno", "body_elems")
+
+    def __init__(self, handlers: List[_Handler], lineno: int):
+        self.handlers = handlers
+        self.lineno = lineno
+        # elements lexically inside the guarded body (indices)
+        self.body_elems: List[int] = []
+
+    def first_match(self, tax: Taxonomy, exc: str) -> Optional[_Handler]:
+        for h in self.handlers:
+            if any(tax.catches(t, exc) for t in h.types):
+                return h
+        return None
+
+
+class _Elem:
+    """One raising element: an explicit raise or a resolved callsite."""
+    __slots__ = ("kind", "data", "lineno", "guards", "handler")
+
+    def __init__(self, kind: str, data, lineno: int,
+                 guards: Tuple[_TryCtx, ...],
+                 handler: Optional[Tuple[_TryCtx, _Handler]] = None):
+        self.kind = kind      # "raise" | "call" | "reraise"
+        self.data = data      # exc name | tuple of callee fids | None
+        self.lineno = lineno
+        self.guards = guards  # innermost last
+        self.handler = handler  # set for elements inside an except body
+
+
+class _Acquire:
+    __slots__ = ("label", "release_names", "lineno", "var",
+                 "protected", "in_with")
+
+    def __init__(self, label: str, release_names: Tuple[str, ...],
+                 lineno: int, var: Optional[str]):
+        self.label = label
+        self.release_names = release_names
+        self.lineno = lineno
+        self.var = var
+        self.protected = False
+        self.in_with = False
+
+
+class _FuncFlow(ast.NodeVisitor):
+    """Single-function walk: raising elements with their lexical
+    handler guards, release-obligation acquires, socket-deadline
+    evidence."""
+
+    def __init__(self, fi, call_targets: Dict[int, FrozenSet[str]],
+                 obligations):
+        self.fi = fi
+        self.call_targets = call_targets
+        self.obligations = obligations
+        self.elems: List[_Elem] = []
+        self.tries: List[_TryCtx] = []      # all Try nodes seen
+        self.guard_stack: List[_TryCtx] = []
+        self.handler_stack: List[Tuple[_TryCtx, _Handler]] = []
+        self.acquires: List[_Acquire] = []
+        self.release_lines: Dict[str, List[int]] = {}  # name -> linenos
+        self.finally_release_names: Set[str] = set()
+        # releases performed inside an except handler (cleanup-and-
+        # reraise protects an obligation just like a finally does)
+        self.handler_release_names: Set[str] = set()
+        self.transfer_names: Set[str] = set()   # returned/stored/passed
+        self.with_call_lines: Set[int] = set()
+        self.settimeout_targets: Set[str] = set()
+        # (kind, lineno, bound var, created-with-deadline)
+        self.socket_calls: List[Tuple[str, int, str, bool]] = []
+        self.self_socket_passed: List[int] = []  # self.request handed on
+        self.self_socket_timeout = False
+        self.in_finally = 0
+        self.is_contextmanager = any(
+            (isinstance(d, ast.Name) and d.id == "contextmanager") or
+            (isinstance(d, ast.Attribute) and d.attr == "contextmanager")
+            for d in getattr(fi.node, "decorator_list", ()))
+        # local socket variables: var -> created-with-deadline?
+        self.local_sockets: Dict[str, bool] = {}
+
+    # -- helpers -------------------------------------------------------------
+    def _guards(self) -> Tuple[_TryCtx, ...]:
+        return tuple(self.guard_stack)
+
+    def _add_elem(self, kind, data, lineno) -> None:
+        e = _Elem(kind, data, lineno, self._guards(),
+                  self.handler_stack[-1] if self.handler_stack else None)
+        idx = len(self.elems)
+        self.elems.append(e)
+        for t in self.guard_stack:
+            t.body_elems.append(idx)
+
+    @staticmethod
+    def _exc_name(node) -> Optional[str]:
+        """Resolve a raise/except expression to a taxonomy name."""
+        if isinstance(node, ast.Call):
+            node = node.func
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            # socket.timeout / struct.error keep their dotted spelling
+            if isinstance(node.value, ast.Name) and \
+                    f"{node.value.id}.{node.attr}" in _BUILTIN_EXC_PARENTS:
+                return f"{node.value.id}.{node.attr}"
+            return node.attr
+        return None
+
+    # -- structure -----------------------------------------------------------
+    def visit_Try(self, node: ast.Try) -> None:
+        handlers: List[_Handler] = []
+        for h in node.handlers:
+            if h.type is None:
+                types: Tuple[str, ...] = ("*",)
+            elif isinstance(h.type, ast.Tuple):
+                types = tuple(self._exc_name(e) or "*"
+                              for e in h.type.elts)
+            else:
+                types = (self._exc_name(h.type) or "*",)
+            handlers.append(_Handler(types, h.lineno, h.name))
+        ctx = _TryCtx(handlers, node.lineno)
+        self.tries.append(ctx)
+        self.guard_stack.append(ctx)
+        for st in node.body:
+            self.visit(st)
+        self.guard_stack.pop()
+        # handler bodies run under the OUTER guards only
+        for h, hrec in zip(node.handlers, handlers):
+            self.handler_stack.append((ctx, hrec))
+            for st in h.body:
+                self.visit(st)
+            self.handler_stack.pop()
+            self._digest_handler(h, hrec)
+        for st in node.orelse:
+            self.visit(st)
+        self.in_finally += 1
+        for st in node.finalbody:
+            self.visit(st)
+        self.in_finally -= 1
+
+    def _digest_handler(self, h: ast.ExceptHandler,
+                        hrec: _Handler) -> None:
+        """Classify what the handler does with what it caught."""
+        for sub in ast.walk(h):
+            if isinstance(sub, ast.Raise):
+                hrec.has_raise = True
+                if sub.exc is None:
+                    hrec.reraises_bare = True
+            elif isinstance(sub, ast.Assign) and hrec.name:
+                if isinstance(sub.value, ast.Name) and \
+                        sub.value.id == hrec.name:
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            hrec.deferred_names.add(t.id)
+            elif isinstance(sub, ast.Call):
+                callee = None
+                if isinstance(sub.func, ast.Attribute):
+                    callee = sub.func.attr
+                elif isinstance(sub.func, ast.Name):
+                    callee = sub.func.id
+                if callee in _SANCTIONED_SINKS:
+                    hrec.routes_sink = True
+                if hrec.name and callee not in _LOGGING_CALLEES:
+                    for a in list(sub.args) + \
+                            [k.value for k in sub.keywords]:
+                        if isinstance(a, ast.Name) and \
+                                a.id == hrec.name:
+                            hrec.relays = True
+
+    def visit_Raise(self, node: ast.Raise) -> None:
+        if node.exc is None:
+            if self.handler_stack:
+                self._add_elem("reraise", None, node.lineno)
+            return
+        name = self._exc_name(node.exc)
+        known = name is not None and (
+            name in _BUILTIN_EXC_PARENTS or name[0:1].isupper())
+        self._add_elem("raise", name if known else _DYNAMIC,
+                       node.lineno)
+        # a `raise v` where v was a deferred handler assignment keeps
+        # the deferred types alive — record the raised name
+        if isinstance(node.exc, ast.Name):
+            self.transfer_names.add(node.exc.id)
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        for item in node.items:
+            if isinstance(item.context_expr, ast.Call):
+                self.with_call_lines.add(item.context_expr.lineno)
+        self.generic_visit(node)
+
+    def visit_Return(self, node: ast.Return) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name):
+                self.transfer_names.add(sub.id)
+        self.generic_visit(node)
+
+    def visit_Yield(self, node: ast.Yield) -> None:
+        if node.value is not None:
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name):
+                    self.transfer_names.add(sub.id)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # self.x = v / container[k] = v: ownership leaves the frame
+        stored_names = set()
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Name):
+                stored_names.add(sub.id)
+        for t in node.targets:
+            if isinstance(t, (ast.Attribute, ast.Subscript)):
+                self.transfer_names |= stored_names
+        # acquire bound to a local: v = controller.admit(...)
+        if isinstance(node.value, ast.Call):
+            self._note_acquire(node.value, node.targets)
+            self._note_socket_create(node.value, node.targets)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        callee_attr = None
+        if isinstance(node.func, ast.Attribute):
+            callee_attr = node.func.attr
+        elif isinstance(node.func, ast.Name):
+            callee_attr = node.func.id
+        # raise-contribution element for resolved targets
+        tgts = self.call_targets.get(node.lineno)
+        if tgts:
+            self._add_elem("call", tgts, node.lineno)
+        # releases + transfers
+        if callee_attr:
+            self.release_lines.setdefault(callee_attr, []).append(
+                node.lineno)
+            if self.in_finally:
+                self.finally_release_names.add(callee_attr)
+            if self.handler_stack:
+                self.handler_release_names.add(callee_attr)
+            if callee_attr == "settimeout":
+                if isinstance(node.func.value, ast.Name):
+                    self.settimeout_targets.add(node.func.value.id)
+                elif isinstance(node.func.value, ast.Attribute) and \
+                        node.func.value.attr in ("request",
+                                                 "connection"):
+                    self.self_socket_timeout = True
+        for a in list(node.args) + [k.value for k in node.keywords]:
+            if isinstance(a, ast.Name):
+                self.transfer_names.add(a.id)
+            elif isinstance(a, ast.Attribute) and \
+                    isinstance(a.value, ast.Name) and \
+                    a.value.id == "self" and \
+                    a.attr in ("request", "connection"):
+                self.self_socket_passed.append(node.lineno)
+        # bare-expression acquire (result dropped) still obliges
+        self._note_acquire(node, ())
+        self._note_socket_create(node, ())
+        self.generic_visit(node)
+
+    # -- obligations ---------------------------------------------------------
+    def _note_acquire(self, call: ast.Call, targets) -> None:
+        tgts = self.call_targets.get(call.lineno) or frozenset()
+        if any(a.lineno == call.lineno for a in self.acquires):
+            return  # visit_Assign already noted this call
+        for label, suffix, releases in self.obligations:
+            if not any(t.endswith(suffix) for t in tgts):
+                continue
+            var = None
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    var = t.id
+                elif isinstance(t, ast.Tuple):
+                    for e in t.elts:
+                        if isinstance(e, ast.Name):
+                            var = e.id
+                            break
+            acq = _Acquire(label, releases, call.lineno, var)
+            acq.in_with = call.lineno in self.with_call_lines
+            self.acquires.append(acq)
+            return
+
+    def _note_socket_create(self, call: ast.Call, targets) -> None:
+        """socket.create_connection()/socket.socket() sites for R014."""
+        f = call.func
+        name = None
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "socket":
+            name = f.attr
+        elif isinstance(f, ast.Name) and \
+                f.id in ("create_connection",):
+            name = f.id
+        if name not in ("create_connection", "socket"):
+            return
+        if any(c[1] == call.lineno for c in self.socket_calls):
+            return  # visit_Assign already noted this call
+        has_deadline = False
+        if name == "create_connection":
+            for k in call.keywords:
+                if k.arg == "timeout" and not (
+                        isinstance(k.value, ast.Constant) and
+                        k.value.value is None):
+                    has_deadline = True
+            if len(call.args) >= 2:
+                has_deadline = True
+        var = None
+        for t in targets:
+            if isinstance(t, ast.Name):
+                var = t.id
+        if var:
+            self.local_sockets[var] = has_deadline
+        self.socket_calls.append((name, call.lineno, var or "",
+                                  has_deadline))
+
+
+# ---------------------------------------------------------------------------
+# the analysis driver
+# ---------------------------------------------------------------------------
+
+class FlowAnalysis:
+    """Raise sets, seams, diagnostics — plus the JSON-able artifact the
+    fault-injection gate consumes."""
+
+    def __init__(self):
+        self.taxonomy: Optional[Taxonomy] = None
+        self.raises: Dict[str, Set[str]] = {}       # fid -> escape set
+        self.potential: Dict[str, Set[str]] = {}    # fid -> pre-narrow
+        self.seams: Dict[str, str] = {}             # label -> fid
+        self.seam_surfaces: Dict[str, Tuple[str, ...]] = {}
+        self.origin: Dict[str, Set[str]] = {}       # exc -> relpaths
+        # typed errors raisable anywhere REACHABLE from each seam over
+        # the full (typed + CHA) call graph — the injection plan: the
+        # gate must prove the seam propagates each one when it arises
+        self.reach_typed: Dict[str, List[str]] = {}
+        # exc name -> {(relpath, lineno)} explicit raise sites — the
+        # monkeypatch points the fault gate derives injections from
+        self.raise_sites: Dict[str, Set[Tuple[str, int]]] = {}
+        self.diagnostics: List[Diagnostic] = []
+        self.allow_sites: Dict[int, List[Tuple[str, int]]] = {}
+
+    def seam_raises(self, label: str,
+                    typed_only: bool = True) -> List[str]:
+        fid = self.seams.get(label)
+        if fid is None:
+            return []
+        out = self.raises.get(fid, set()) | \
+            self.potential.get(fid, set())
+        tax = self.taxonomy
+        if typed_only:
+            out = {e for e in out if tax is not None and
+                   tax.is_typed(e)}
+        return sorted(out - {_DYNAMIC})
+
+    def artifact(self) -> Dict:
+        """{'seams': {...}, 'taxonomy': {...}, 'injections': [...]}."""
+        tax = self.taxonomy
+        seams = {}
+        injections = []
+        for label in sorted(self.seams):
+            fid = self.seams[label]
+            escaped = sorted(self.raises.get(fid, set()) - {_DYNAMIC})
+            typed = sorted(set(self.seam_raises(label)) |
+                           set(self.reach_typed.get(label, [])))
+            surface = self.seam_surfaces.get(label, ())
+            leaks = []
+            for e in escaped:
+                # the R013 contract exactly: an *operational* untyped
+                # exception whose origin is under the seam's own
+                # surface (programming errors like ValueError /
+                # TypeError and deep third-layer escapes stay in
+                # "escapes" — visible, but not a leak verdict)
+                if tax is None or tax.is_typed(e):
+                    continue
+                if e not in _UNTYPED_OPERATIONAL:
+                    continue
+                if any(_path_under(o, p)
+                       for o in self.origin.get(e, set())
+                       for p in surface):
+                    leaks.append(e)
+            seams[label] = {
+                "fid": fid,
+                "typed": typed,
+                "untyped": leaks,
+                "escapes": [e for e in escaped
+                            if tax is not None and not tax.is_typed(e)],
+            }
+            for e in typed:
+                injections.append({"seam": label, "error": e})
+        taxonomy = {}
+        if tax is not None:
+            for name, info in sorted(tax.package_exc.items()):
+                taxonomy[name] = {
+                    "bases": list(info["bases"]),
+                    "module": info["relpath"],
+                    "raise_sites": sorted(
+                        f"{p}:{ln}"
+                        for p, ln in self.raise_sites.get(name, ())),
+                }
+        return {"seams": seams, "taxonomy": taxonomy,
+                "injections": injections}
+
+
+class _FlowAnalyzer:
+    def __init__(self, sources: Dict[str, str], csan_analysis,
+                 seams=SEAMS, obligations=None):
+        self.sources = sources
+        self.csan = csan_analysis
+        self.seam_table = seams
+        self.obligations = []
+        for label, suffix, releases in (obligations or _OBLIGATIONS):
+            self.obligations.append((label, suffix, releases))
+        self.res = FlowAnalysis()
+
+    def run(self) -> FlowAnalysis:
+        res = self.res
+        tax = Taxonomy(_package_exceptions(self.sources))
+        res.taxonomy = tax
+        funcs = self.csan.funcs
+
+        # per-function scans
+        flows: Dict[str, _FuncFlow] = {}
+        for fid, fi in funcs.items():
+            call_targets: Dict[int, FrozenSet[str]] = {}
+            for tgts, via_cha, _held, ln in fi.callsites:
+                if via_cha:
+                    continue  # CHA edges are reachability-grade only
+                real = frozenset(t for t in tgts
+                                 if not t.startswith("ctor:"))
+                if real:
+                    call_targets[ln] = call_targets.get(
+                        ln, frozenset()) | real
+            fl = _FuncFlow(fi, call_targets, self.obligations)
+            try:
+                fl.visit(fi.node)
+            except RecursionError:
+                pass
+            flows[fid] = fl
+
+        # seam resolution
+        for label, path_sfx, scope_sfx, surface in self.seam_table:
+            for fid, fi in funcs.items():
+                if fi.relpath.endswith(path_sfx) and (
+                        fi.scope == scope_sfx or
+                        fi.scope.endswith("." + scope_sfx)):
+                    res.seams[label] = fid
+                    res.seam_surfaces[label] = surface
+
+        # raise-set fixpoint over the typed call graph
+        raises: Dict[str, Set[str]] = {fid: set() for fid in funcs}
+        origin: Dict[str, Set[str]] = {}
+
+        def elem_contrib(fid: str, e: _Elem) -> Set[str]:
+            if e.kind == "raise":
+                if e.data != _DYNAMIC:
+                    origin.setdefault(e.data, set()).add(
+                        funcs[fid].relpath)
+                return {e.data}
+            if e.kind == "call":
+                out: Set[str] = set()
+                for t in e.data:
+                    out |= raises.get(t, set())
+                return out
+            if e.kind == "reraise" and e.handler is not None:
+                ctx, h = e.handler
+                body_pot = set()
+                fl = flows[fid]
+                for idx in ctx.body_elems:
+                    body_pot |= elem_contrib(fid, fl.elems[idx])
+                return {exc for exc in body_pot
+                        if ctx.first_match(tax, exc) is h}
+            return set()
+
+        def escape_set(fid: str) -> Set[str]:
+            fl = flows[fid]
+            out: Set[str] = set()
+            for e in fl.elems:
+                contrib = elem_contrib(fid, e)
+                for ctx in reversed(e.guards):
+                    if not contrib:
+                        break
+                    survived = set()
+                    for exc in contrib:
+                        h = ctx.first_match(tax, exc)
+                        if h is None or h.reraises_bare or \
+                                (h.deferred_names and
+                                 h.deferred_names & fl.transfer_names):
+                            survived.add(exc)
+                    contrib = survived
+                out |= contrib
+            return out
+
+        for _round in range(24):
+            changed = False
+            for fid in funcs:
+                new = escape_set(fid)
+                if new != raises[fid]:
+                    raises[fid] = new
+                    changed = True
+            if not changed:
+                break
+        res.raises = raises
+        res.origin = origin
+
+        # pre-narrowing potential sets (what a seam's body can see
+        # before its own handlers narrow it) — drives the injection
+        # plan for seams that sanction everything (background loops)
+        for fid in funcs:
+            fl = flows[fid]
+            pot: Set[str] = set()
+            for e in fl.elems:
+                pot |= elem_contrib(fid, e)
+            res.potential[fid] = pot - {_DYNAMIC}
+
+        # explicit raise sites (the monkeypatch points)
+        for fid, fl in flows.items():
+            for e in fl.elems:
+                if e.kind == "raise" and e.data != _DYNAMIC:
+                    res.raise_sites.setdefault(e.data, set()).add(
+                        (funcs[fid].relpath, e.lineno))
+
+        # injection plan: typed errors raisable anywhere reachable
+        # from each seam over the FULL call graph (typed + CHA edges —
+        # reachability-grade is exactly right here: the plan asks
+        # "can this error arise under this seam at runtime?")
+        # subclass-override closure: a resolved call to C.m can land in
+        # any override D.m at runtime (the tpucsan typed edge stops at
+        # the declared class — fine for lock order, too narrow for
+        # "which errors can arise under this seam")
+        children: Dict[str, Set[str]] = {}
+        for relpath, src in self.sources.items():
+            try:
+                tree = ast.parse(src)
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef):
+                    for b in node.bases:
+                        bn = b.id if isinstance(b, ast.Name) else (
+                            b.attr if isinstance(b, ast.Attribute)
+                            else None)
+                        if bn:
+                            children.setdefault(bn, set()).add(
+                                node.name)
+        subs: Dict[str, Set[str]] = {}
+
+        def _descendants(cls: str) -> Set[str]:
+            if cls not in subs:
+                subs[cls] = set()
+                for c in children.get(cls, ()):
+                    subs[cls].add(c)
+                    subs[cls] |= _descendants(c)
+            return subs[cls]
+
+        method_index: Dict[Tuple[str, str], Set[str]] = {}
+        for fid, fi in funcs.items():
+            parts = fi.scope.split(".")
+            if len(parts) >= 2:
+                method_index.setdefault(
+                    (parts[-2], parts[-1]), set()).add(fid)
+
+        def _overrides(tgt: str) -> Set[str]:
+            scope = tgt.split("::", 1)[-1].split(".")
+            if len(scope) < 2:
+                return set()
+            cls, m = scope[-2], scope[-1]
+            out: Set[str] = set()
+            for d in _descendants(cls):
+                out |= method_index.get((d, m), set())
+            return out
+
+        full_edges: Dict[str, Set[str]] = {}
+        for fid, fi in funcs.items():
+            out = full_edges.setdefault(fid, set())
+            for tgts, _via_cha, _held, _ln in fi.callsites:
+                for t in tgts:
+                    if t in funcs:
+                        out.add(t)
+                        out |= _overrides(t)
+        raw_typed: Dict[str, Set[str]] = {}
+        for fid, fl in flows.items():
+            raw_typed[fid] = {
+                e.data for e in fl.elems
+                if e.kind == "raise" and e.data != _DYNAMIC and
+                tax.is_typed(e.data)}
+        for label, seam_fid in res.seams.items():
+            seen = {seam_fid}
+            work = [seam_fid]
+            while work:
+                cur = work.pop()
+                for nxt in full_edges.get(cur, ()):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        work.append(nxt)
+            reach: Set[str] = set()
+            for fid in seen:
+                reach |= raw_typed.get(fid, set())
+            res.reach_typed[label] = sorted(reach)
+        for label, delegates in _SEAM_DELEGATES.items():
+            if label not in res.seams:
+                continue
+            merged = set(res.reach_typed.get(label, []))
+            for dl in delegates:
+                merged |= set(res.reach_typed.get(dl, []))
+            res.reach_typed[label] = sorted(merged)
+
+        self._rule_r011(flows, tax, elem_contrib)
+        self._rule_r012(flows, raises)
+        self._rule_r013(tax)
+        self._rule_r014(flows)
+        return res
+
+    # -- rules ---------------------------------------------------------------
+    def _rule_r011(self, flows, tax, elem_contrib) -> None:
+        res = self.res
+        for fid, fl in flows.items():
+            fi = self.csan.funcs[fid]
+            for ctx in fl.tries:
+                body_pot: Set[str] = set()
+                for idx in ctx.body_elems:
+                    body_pot |= elem_contrib(fid, fl.elems[idx])
+                for h in ctx.handlers:
+                    # only OVERBROAD catches: `except TpuShuffleX`
+                    # is the taxonomy being dispatched on — the whole
+                    # point of typing the errors
+                    if not tax.is_broad(h.types):
+                        continue
+                    matched = {exc for exc in body_pot
+                               if ctx.first_match(tax, exc) is h}
+                    typed_matched = sorted(
+                        e for e in matched if tax.is_typed(e))
+                    if not typed_matched:
+                        continue
+                    if h.has_raise or h.relays or h.routes_sink or \
+                            (h.deferred_names and
+                             h.deferred_names & fl.transfer_names):
+                        continue
+                    shown = ", ".join(typed_matched[:4])
+                    if len(typed_matched) > 4:
+                        shown += ", ..."
+                    d = R011.diag(
+                        f"{fi.scope}: bare/broad except swallows "
+                        f"typed engine error(s) {shown} without "
+                        f"re-raise, relay or a sanctioned sink",
+                        loc=f"{fi.relpath}:{h.lineno}")
+                    res.diagnostics.append(d)
+                    res.allow_sites[id(d)] = [(fi.relpath, h.lineno)]
+
+    def _rule_r012(self, flows, raises) -> None:
+        res = self.res
+        for fid, fl in flows.items():
+            fi = self.csan.funcs[fid]
+            if fl.is_contextmanager or fi.is_init:
+                continue
+            for acq in fl.acquires:
+                if acq.in_with:
+                    continue
+                if any(r in fl.finally_release_names or
+                       r in fl.handler_release_names
+                       for r in acq.release_names):
+                    continue
+                if acq.var and acq.var in fl.transfer_names:
+                    continue  # ownership left this frame
+                # a raising successor between acquire and the release
+                release_after = [
+                    ln for r in acq.release_names
+                    for ln in fl.release_lines.get(r, [])
+                    if ln > acq.lineno]
+                horizon = min(release_after) if release_after \
+                    else float("inf")
+                risky = False
+                for e in fl.elems:
+                    if not (acq.lineno < e.lineno <= horizon):
+                        continue
+                    if e.kind == "raise":
+                        risky = True
+                        break
+                    if e.kind == "call" and any(
+                            raises.get(t) for t in e.data):
+                        risky = True
+                        break
+                if not risky:
+                    continue
+                d = R012.diag(
+                    f"{fi.scope}: {acq.label} acquired here can leak "
+                    f"— a raising successor unwinds before "
+                    f"{'/'.join(acq.release_names)}() and no finally/"
+                    f"with/ownership-transfer protects it",
+                    loc=f"{fi.relpath}:{acq.lineno}")
+                res.diagnostics.append(d)
+                res.allow_sites[id(d)] = [(fi.relpath, acq.lineno)]
+
+    def _rule_r013(self, tax) -> None:
+        res = self.res
+        for label, fid in res.seams.items():
+            fi = self.csan.funcs[fid]
+            surface = res.seam_surfaces.get(label, ())
+            for exc in sorted(res.raises.get(fid, set())):
+                if exc == _DYNAMIC or tax.is_typed(exc):
+                    continue
+                if exc not in _UNTYPED_OPERATIONAL:
+                    continue
+                origins = res.origin.get(exc, set())
+                in_scope = [o for o in origins
+                            if any(_path_under(o, p)
+                                   for p in surface)]
+                if not in_scope:
+                    continue
+                d = R013.diag(
+                    f"seam {label} ({fi.scope}) leaks untyped {exc} "
+                    f"raised in {sorted(in_scope)[0]} — callers "
+                    f"dispatch on the typed taxonomy",
+                    loc=f"{fi.relpath}:{fi.node.lineno}")
+                res.diagnostics.append(d)
+                res.allow_sites[id(d)] = [
+                    (fi.relpath, fi.node.lineno)]
+
+    def _rule_r014(self, flows) -> None:
+        res = self.res
+        reachable: Set[str] = set()
+        for root, seen in self.csan.reachable.items():
+            reachable |= seen
+        reachable |= set(self.csan.roots)
+        for fid, fl in flows.items():
+            if fid not in reachable:
+                continue
+            fi = self.csan.funcs[fid]
+            for name, lineno, var, created_deadline in fl.socket_calls:
+                deadline = created_deadline or (
+                    fl.local_sockets.get(var, False) if var else False)
+                if name == "create_connection" and not deadline and \
+                        var not in fl.settimeout_targets:
+                    d = R014.diag(
+                        f"{fi.scope}: socket.create_connection on a "
+                        f"thread-root path without an explicit "
+                        f"timeout", loc=f"{fi.relpath}:{lineno}")
+                    res.diagnostics.append(d)
+                    res.allow_sites[id(d)] = [(fi.relpath, lineno)]
+                elif name == "socket" and var and \
+                        var not in fl.settimeout_targets and \
+                        not deadline:
+                    d = R014.diag(
+                        f"{fi.scope}: socket() created on a thread-"
+                        f"root path never gets settimeout()",
+                        loc=f"{fi.relpath}:{lineno}")
+                    res.diagnostics.append(d)
+                    res.allow_sites[id(d)] = [(fi.relpath, lineno)]
+            if fl.self_socket_passed and not fl.self_socket_timeout:
+                lineno = min(fl.self_socket_passed)
+                d = R014.diag(
+                    f"{fi.scope}: accepted connection "
+                    f"(self.request/self.connection) used without "
+                    f"settimeout() — a hung peer pins this handler "
+                    f"thread forever", loc=f"{fi.relpath}:{lineno}")
+                res.diagnostics.append(d)
+                res.allow_sites[id(d)] = [(fi.relpath, lineno)]
+
+
+# ---------------------------------------------------------------------------
+# public API (mirrors concurrency.py)
+# ---------------------------------------------------------------------------
+
+def analyze_sources(sources: Dict[str, str],
+                    roots: Optional[Iterable[str]] = None,
+                    seams=SEAMS) -> FlowAnalysis:
+    """Full pass over in-memory sources (fixtures, tests)."""
+    from . import concurrency
+    csan = concurrency.analyze_sources(sources, roots=roots)
+    return _FlowAnalyzer(sources, csan, seams=seams).run()
+
+
+_REPO_CACHE: Dict[str, FlowAnalysis] = {}
+
+
+def analyze_repo(root: Optional[str] = None,
+                 refresh: bool = False) -> FlowAnalysis:
+    from . import concurrency
+    from .repo_lint import _package_root
+    key = os.path.abspath(root or _package_root())
+    if refresh or key not in _REPO_CACHE:
+        sources = concurrency._package_sources(root)
+        csan = concurrency.analyze_repo(root, refresh=refresh)
+        _REPO_CACHE[key] = _FlowAnalyzer(sources, csan).run()
+    return _REPO_CACHE[key]
+
+
+def repo_diagnostics(root: Optional[str] = None) -> List[Diagnostic]:
+    """TPU-R011..R014 over the package, allow-annotations honored."""
+    from . import concurrency
+    res = analyze_repo(root)
+    return concurrency.filter_allowed(res, concurrency._package_sources(root))
+
+
+def raise_graph_artifact(root: Optional[str] = None) -> Dict:
+    """The JSON artifact `tools lint --raise-graph` dumps and the
+    --faults gate consumes: per-seam raise sets + the injection plan."""
+    return analyze_repo(root).artifact()
+
+
+# sample constructors for typed errors with non-trivial signatures —
+# the fault-injection gate instantiates every typed error in the plan
+_SAMPLE_ARGS: Dict[str, tuple] = {
+    "TpuShufflePeerDeadError": ("peer-1", "tpufsan injected"),
+    "TpuShuffleTruncatedFrameError": (128, 7),
+    "TpuShuffleStaleFrameError": (1, 2),
+    "TpuShuffleVersionError": (9,),
+}
+
+
+def construct_error(name: str,
+                    root: Optional[str] = None) -> BaseException:
+    """Instantiate the typed error ``name`` for fault injection."""
+    import importlib
+    res = analyze_repo(root)
+    info = res.taxonomy.package_exc.get(name) if res.taxonomy else None
+    if info is None:
+        raise KeyError(f"unknown typed error {name!r}")
+    relpath = info["relpath"]
+    if relpath.startswith(_PKG_PREFIX):
+        relpath = relpath[len(_PKG_PREFIX):]
+    relmod = relpath[:-3].replace("/", ".")
+    mod = importlib.import_module(f"spark_rapids_tpu.{relmod}")
+    cls = getattr(mod, name)
+    args = _SAMPLE_ARGS.get(name, (f"tpufsan injected {name}",))
+    try:
+        return cls(*args)
+    except TypeError:
+        return cls()

@@ -471,10 +471,11 @@ def lint_repo(root: Optional[str] = None) -> List[Diagnostic]:
     violations (baseline subtraction is the caller's concern)."""
     root = root or _package_root()
     from .diagnostics import sort_diagnostics
-    from . import concurrency
+    from . import concurrency, raiseflow
     return sort_diagnostics(_ast_diagnostics(root) +
                             _registry_diagnostics() +
-                            concurrency.repo_diagnostics(root))
+                            concurrency.repo_diagnostics(root) +
+                            raiseflow.repo_diagnostics(root))
 
 
 # ---------------------------------------------------------------------------

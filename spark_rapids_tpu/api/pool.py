@@ -35,6 +35,17 @@ from ..config import RapidsConf
 from .session import TpuSession
 
 
+class PoolClosedError(RuntimeError):
+    """Borrow refused because the pool is closed — typed (tpufsan
+    TPU-R013) so serving callers can tell shutdown from capacity;
+    subclasses RuntimeError so pre-taxonomy callers keep working."""
+
+
+class PoolTimeout(TimeoutError):
+    """No idle session (borrow) or still-busy sessions (drain) within
+    the deadline; subclasses TimeoutError for pre-taxonomy callers."""
+
+
 class SessionPool:
     """Fixed-size pool of TpuSessions sharing one process runtime."""
 
@@ -72,16 +83,16 @@ class SessionPool:
         with self._cv:
             while not self._idle:
                 if self._closed:
-                    raise RuntimeError("SessionPool is closed")
+                    raise PoolClosedError("SessionPool is closed")
                 remaining = None if deadline is None else \
                     deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
-                    raise TimeoutError(
+                    raise PoolTimeout(
                         f"no idle session within {timeout:g}s "
                         f"(pool size {self.size})")
                 self._cv.wait(remaining)
             if self._closed:
-                raise RuntimeError("SessionPool is closed")
+                raise PoolClosedError("SessionPool is closed")
             s = self._idle.popleft()
             m.gauge("tpu_session_pool_in_use",
                     "pool sessions currently borrowed") \
@@ -148,7 +159,7 @@ class SessionPool:
                 remaining = None if deadline is None else \
                     deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
-                    raise TimeoutError(
+                    raise PoolTimeout(
                         f"pool did not drain within {timeout:g}s "
                         f"({self.size - len(self._idle)} busy)")
                 self._cv.wait(remaining)

@@ -61,6 +61,16 @@ class TpuSession:
             self.metrics_server = ensure_server(port)
         else:
             self.metrics_server = None
+        # background-thread failures (heartbeat loop, metrics endpoint)
+        # bundle into the same black box as query failures when one is
+        # configured — the router is process-global because those
+        # threads outlive any single session
+        from ..obs import bgerrors
+        if conf.get(cfg.HBM_POSTMORTEM_ENABLED):
+            bg_dir = conf.get(cfg.HBM_POSTMORTEM_DIR) or \
+                conf.get(cfg.REGRESS_HISTORY_DIR)
+            if bg_dir:
+                bgerrors.set_postmortem_dir(bg_dir)
         # fleet observatory bounds: size the producer-side serve-span
         # buffer the /spans endpoint drains
         from ..obs.fleet import RemoteSpanStore
@@ -543,7 +553,8 @@ class TpuSession:
                 detail = "\n---\n".join(
                     f"{i} tier={t_} bytes={b}\n{st}"
                     for i, t_, b, st in leaks)
-                raise RuntimeError(
+                from ..memory.memsan import LifecycleViolation
+                raise LifecycleViolation(
                     f"query leaked {len(leaks)} spillable "
                     f"buffer(s) (memory.tpu.debug):\n{detail}")
         if tracer is not None:

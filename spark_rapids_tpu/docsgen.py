@@ -65,9 +65,9 @@ def generate_lint_rules() -> str:
     # importing the front ends populates the catalog (interp carries the
     # flow-sensitive rules TPU-L009..L012, lifetime the tmsan memory
     # rules TPU-L013..L015, concurrency the tpucsan rules
-    # TPU-R008..R010)
+    # TPU-R008..R010, raiseflow the tpufsan rules TPU-R011..R014)
     from .analysis import (concurrency, interp, lifetime,  # noqa: F401
-                           plan_lint, repo_lint)
+                           plan_lint, raiseflow, repo_lint)
     from .analysis.diagnostics import RULE_CATALOG
     lines = [
         "# tpulint rule catalog",
@@ -86,6 +86,59 @@ def generate_lint_rules() -> str:
     return "\n".join(lines) + "\n"
 
 
+def generate_error_taxonomy() -> str:
+    """docs/error_taxonomy.md from the tpufsan raise-graph: every typed
+    engine error with its base classes, defining module and raise
+    sites, plus the per-seam escape contract the fault-injection gate
+    (`devtools/run_lint.py --faults`) exercises.  Generated from the
+    live analysis, so the table can never drift from the code."""
+    from .analysis.raiseflow import raise_graph_artifact
+    art = raise_graph_artifact()
+    lines = [
+        "# Typed error taxonomy",
+        "",
+        "Generated from the tpufsan exception-flow analysis "
+        "(`spark_rapids_tpu/analysis/raiseflow.py`) — do not edit.  "
+        "Dump the full artifact with `tools lint --raise-graph`; "
+        "`devtools/run_lint.py --faults` injects every (seam, error) "
+        "pair below.",
+        "",
+        "## Typed errors",
+        "",
+        "| Error | Bases | Module | Raise sites |",
+        "|---|---|---|---|",
+    ]
+    for name in sorted(art["taxonomy"]):
+        info = art["taxonomy"][name]
+        sites = ", ".join(f"`{s}`" for s in info["raise_sites"]) \
+            or "(constructed by callers)"
+        lines.append(
+            f"| `{name}` | {', '.join(info['bases'])} | "
+            f"`{info['module']}` | {sites} |")
+    lines += [
+        "",
+        "## Public seams",
+        "",
+        "Per seam: the typed errors that can escape to its caller "
+        "(the injection campaign's reach set) and any untyped "
+        "operational leaks (must be empty — TPU-R013).",
+        "",
+        "| Seam | Function | Typed errors | Untyped leaks |",
+        "|---|---|---|---|",
+    ]
+    for label in sorted(art["seams"]):
+        s = art["seams"][label]
+        typed = ", ".join(f"`{e}`" for e in s["typed"]) or "—"
+        leaks = ", ".join(f"`{e}`" for e in s["untyped"]) or "—"
+        lines.append(f"| {label} | `{s['fid']}` | {typed} | {leaks} |")
+    lines += [
+        "",
+        f"Planned injections: {len(art['injections'])} "
+        f"(seam × typed-error pairs).",
+    ]
+    return "\n".join(lines) + "\n"
+
+
 def write_docs(outdir: str = "docs") -> List[str]:
     os.makedirs(outdir, exist_ok=True)
     paths = []
@@ -100,6 +153,10 @@ def write_docs(outdir: str = "docs") -> List[str]:
     p = os.path.join(outdir, "lint_rules.md")
     with open(p, "w") as f:
         f.write(generate_lint_rules())
+    paths.append(p)
+    p = os.path.join(outdir, "error_taxonomy.md")
+    with open(p, "w") as f:
+        f.write(generate_error_taxonomy())
     paths.append(p)
     return paths
 

@@ -613,122 +613,131 @@ class TpuHashAggregateExec(Exec):
         schema_types = kt + self._buffer_types
         from ..memory.spill import SpillCatalog, SpillPriority
         spill = SpillCatalog.get()
-        it = iter(self.children[0].execute_partition(pid, ctx))
-        first = next(it, None)
-        second = next(it, None) if first is not None else None
-        if first is not None and second is None and \
-                self.mode in (PARTIAL, COMPLETE):
-            # single input batch: _group_reduce leaves unique keys, so
-            # the cross-batch merge would be a no-op re-sort.  PARTIAL
-            # emits the update output directly; COMPLETE fuses
-            # update+evaluate into one compiled program.
-            with MetricTimer(self.metrics[OP_TIME]):
-                if not on_tpu:
-                    out = self._update_batch(np, first)
-                    if self.mode == COMPLETE:
-                        out = self._evaluate_batch(np, out)
-                elif self.mode == COMPLETE:
-                    out = self._jit_complete(first)
-                else:
-                    out = self._jit_update(first)
-                maybe_sync(out)
-            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
-            self.metrics[NUM_OUTPUT_BATCHES] += 1
-            yield out
-            return
-        import itertools
-        stream = (b for b in itertools.chain(
-            [x for x in (first, second) if x is not None], it))
-        for b in stream:
-            with MetricTimer(self.metrics[OP_TIME]):
-                if self.mode in (PARTIAL, COMPLETE):
-                    out = self._jit_update(b) if on_tpu else \
-                        self._update_batch(np, b)
-                else:
-                    out = b  # FINAL: merge happens below
-                maybe_sync(out)
-            # accumulated partials are spillable (ref aggregate.scala's
-            # spillable batch accumulation before merge)
-            partials.append(spill.register(out, SpillPriority.INPUT))
-            if self.oc_budget is not None:
-                from .outofcore import enforce_device_budget
-                enforce_device_budget(
-                    spill, min(spill.device_budget, self.oc_budget))
-        if not partials:
-            if self.grouping:
-                return
-            # global aggregate over empty input still yields one row
-            from ..columnar.interop import to_arrow_schema
-            empty = to_arrow_schema(
-                self.children[0].output_names,
-                self.children[0].output_types).empty_table()
-            rb = (empty.to_batches() or
-                  [pa.RecordBatch.from_pydict(
-                      {n: pa.array([], type=f.type)
-                       for n, f in zip(empty.schema.names, empty.schema)})])
-            eb = batch_to_device(rb[0], xp=xp)
-            partials = [spill.register(
-                self._jit_update(eb) if on_tpu
-                else self._update_batch(np, eb), SpillPriority.INPUT)]
-        total = sum(p.device_bytes for p in partials)
-        budget = min(SpillCatalog.get().device_budget,
-                     self.oc_budget or (1 << 62))
-        if total <= budget:
-            # in-core: one concat + merge
-            with MetricTimer(self.metrics[OP_TIME]):
-                mats = [p.get_batch(xp) for p in partials]
-                if len(mats) == 1:
-                    merged_in = mats[0]
-                else:
-                    merged_in = concat_batches(xp, mats, schema_names,
-                                               schema_types)
-                for p in partials:
-                    p.close()
-                if self.mode == PARTIAL:
-                    out = self._jit_merge(merged_in) if on_tpu else \
-                        self._merge_batch(np, merged_in)
-                else:
-                    out = self._jit_merge_eval(merged_in) if on_tpu else \
-                        self._evaluate_batch(np,
-                                             self._merge_batch(np,
-                                                               merged_in))
-                maybe_sync(out)
-            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
-            self.metrics[NUM_OUTPUT_BATCHES] += 1
-            yield out
-            return
-        # out-of-core: budget-bounded iterative merge with sort-based
-        # fallback (ref aggregate.scala:309-314)
-        from .outofcore import merge_partials_bounded
-        spill = SpillCatalog.get()
-        merge_fn = self._jit_merge if on_tpu else \
-            (lambda b: self._merge_batch(np, b))
-        sortkeys_fn = self._jit_sortkeys if on_tpu else \
-            (lambda b: self._sort_by_keys(np, b))
-        chunk_rows = max(int(p.num_rows) for p in partials)
-        if self.oc_budget is not None:
-            # snap down to a capacity bucket (off-bucket chunks pad UP)
-            from ..columnar.device import DEFAULT_ROW_BUCKETS
-            rows_total = sum(int(p.num_rows) for p in partials)
-            bpr = max(total / max(rows_total, 1), 1.0)
-            target = int(budget / (2 * bpr))
-            floor = DEFAULT_ROW_BUCKETS[0]
-            for b in DEFAULT_ROW_BUCKETS:
-                if b <= target:
-                    floor = b
-            chunk_rows = min(chunk_rows, floor)
-        with MetricTimer(self.metrics[OP_TIME]):
-            for m in merge_partials_bounded(
-                    xp, partials, merge_fn, sortkeys_fn, schema_names,
-                    schema_types, spill, budget, chunk_rows):
-                if self.mode == PARTIAL:
-                    out = m
-                else:
-                    out = self._jit_eval(m) if on_tpu else \
-                        self._evaluate_batch(np, m)
+        try:
+            it = iter(self.children[0].execute_partition(pid, ctx))
+            first = next(it, None)
+            second = next(it, None) if first is not None else None
+            if first is not None and second is None and \
+                    self.mode in (PARTIAL, COMPLETE):
+                # single input batch: _group_reduce leaves unique keys, so
+                # the cross-batch merge would be a no-op re-sort.  PARTIAL
+                # emits the update output directly; COMPLETE fuses
+                # update+evaluate into one compiled program.
+                with MetricTimer(self.metrics[OP_TIME]):
+                    if not on_tpu:
+                        out = self._update_batch(np, first)
+                        if self.mode == COMPLETE:
+                            out = self._evaluate_batch(np, out)
+                    elif self.mode == COMPLETE:
+                        out = self._jit_complete(first)
+                    else:
+                        out = self._jit_update(first)
+                    maybe_sync(out)
                 self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
                 self.metrics[NUM_OUTPUT_BATCHES] += 1
                 yield out
+                return
+            import itertools
+            stream = (b for b in itertools.chain(
+                [x for x in (first, second) if x is not None], it))
+            for b in stream:
+                with MetricTimer(self.metrics[OP_TIME]):
+                    if self.mode in (PARTIAL, COMPLETE):
+                        out = self._jit_update(b) if on_tpu else \
+                            self._update_batch(np, b)
+                    else:
+                        out = b  # FINAL: merge happens below
+                    maybe_sync(out)
+                # accumulated partials are spillable (ref aggregate.scala's
+                # spillable batch accumulation before merge)
+                partials.append(spill.register(out, SpillPriority.INPUT))
+                if self.oc_budget is not None:
+                    from .outofcore import enforce_device_budget
+                    enforce_device_budget(
+                        spill, min(spill.device_budget, self.oc_budget))
+            if not partials:
+                if self.grouping:
+                    return
+                # global aggregate over empty input still yields one row
+                from ..columnar.interop import to_arrow_schema
+                empty = to_arrow_schema(
+                    self.children[0].output_names,
+                    self.children[0].output_types).empty_table()
+                rb = (empty.to_batches() or
+                      [pa.RecordBatch.from_pydict(
+                          {n: pa.array([], type=f.type)
+                           for n, f in zip(empty.schema.names, empty.schema)})])
+                eb = batch_to_device(rb[0], xp=xp)
+                partials = [spill.register(
+                    self._jit_update(eb) if on_tpu
+                    else self._update_batch(np, eb), SpillPriority.INPUT)]
+            total = sum(p.device_bytes for p in partials)
+            budget = min(SpillCatalog.get().device_budget,
+                         self.oc_budget or (1 << 62))
+            if total <= budget:
+                # in-core: one concat + merge
+                with MetricTimer(self.metrics[OP_TIME]):
+                    mats = [p.get_batch(xp) for p in partials]
+                    if len(mats) == 1:
+                        merged_in = mats[0]
+                    else:
+                        merged_in = concat_batches(xp, mats, schema_names,
+                                                   schema_types)
+                    for p in partials:
+                        p.close()
+                    if self.mode == PARTIAL:
+                        out = self._jit_merge(merged_in) if on_tpu else \
+                            self._merge_batch(np, merged_in)
+                    else:
+                        out = self._jit_merge_eval(merged_in) if on_tpu else \
+                            self._evaluate_batch(np,
+                                                 self._merge_batch(np,
+                                                                   merged_in))
+                    maybe_sync(out)
+                self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+                self.metrics[NUM_OUTPUT_BATCHES] += 1
+                yield out
+                return
+            # out-of-core: budget-bounded iterative merge with sort-based
+            # fallback (ref aggregate.scala:309-314)
+            from .outofcore import merge_partials_bounded
+            spill = SpillCatalog.get()
+            merge_fn = self._jit_merge if on_tpu else \
+                (lambda b: self._merge_batch(np, b))
+            sortkeys_fn = self._jit_sortkeys if on_tpu else \
+                (lambda b: self._sort_by_keys(np, b))
+            chunk_rows = max(int(p.num_rows) for p in partials)
+            if self.oc_budget is not None:
+                # snap down to a capacity bucket (off-bucket chunks pad UP)
+                from ..columnar.device import DEFAULT_ROW_BUCKETS
+                rows_total = sum(int(p.num_rows) for p in partials)
+                bpr = max(total / max(rows_total, 1), 1.0)
+                target = int(budget / (2 * bpr))
+                floor = DEFAULT_ROW_BUCKETS[0]
+                for b in DEFAULT_ROW_BUCKETS:
+                    if b <= target:
+                        floor = b
+                chunk_rows = min(chunk_rows, floor)
+            with MetricTimer(self.metrics[OP_TIME]):
+                for m in merge_partials_bounded(
+                        xp, partials, merge_fn, sortkeys_fn, schema_names,
+                        schema_types, spill, budget, chunk_rows):
+                    if self.mode == PARTIAL:
+                        out = m
+                    else:
+                        out = self._jit_eval(m) if on_tpu else \
+                            self._evaluate_batch(np, m)
+                    self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+                    self.metrics[NUM_OUTPUT_BATCHES] += 1
+                    yield out
+        finally:
+            # a raising producer (or an abandoned consumer) must
+            # not strand registered spillables: close everything
+            # this partition accumulated — idempotent, so batches
+            # the merge already consumed are no-ops (tpufsan
+            # TPU-R012)
+            for p in partials:
+                p.close()
 
 
 # ---------------------------------------------------------------------------

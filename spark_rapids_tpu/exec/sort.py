@@ -110,52 +110,61 @@ class SortExec(Exec):
         # registered device bytes while the input streams in
         budget = min(spill.device_budget, self.oc_budget or (1 << 62))
         pending = []
-        for b in self.children[0].execute_partition(pid, ctx):
-            pending.append(spill.register(b, SpillPriority.INPUT))
-            if self.oc_budget is not None:
-                enforce_device_budget(spill, budget)
-        if not pending:
-            return
-        sort_fn = self._jitted if self.placement == TPU \
-            else lambda b: self._sort_batch(np, b)
-        total = sum(p.device_bytes for p in pending)
-        if total <= budget:
-            # in-core: concat everything and sort once
-            with MetricTimer(self.metrics[OP_TIME]):
-                batches = [p.get_batch(xp) for p in pending]
-                merged = concat_batches(xp, batches, self.output_names,
-                                        self.output_types) \
-                    if len(batches) > 1 else batches[0]
-                for p in pending:
-                    p.close()
-                out = sort_fn(merged)
-                maybe_sync(out)
-            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
-            self.metrics[NUM_OUTPUT_BATCHES] += 1
-            yield out
-            return
-        # out-of-core external merge sort (ref GpuSortExec.scala:231)
-        from .outofcore import external_merge_sort
-        chunk_rows = max(int(p.num_rows) for p in pending)
-        if self.oc_budget is not None:
-            # keep each run chunk at ~half the enforced budget so a
-            # two-run merge group stays within it; snap DOWN to a
-            # capacity bucket — an off-bucket chunk pads UP to the next
-            # bucket and would inflate real memory instead
-            from ..columnar.device import DEFAULT_ROW_BUCKETS
-            rows_total = sum(int(p.num_rows) for p in pending)
-            bpr = max(total / max(rows_total, 1), 1.0)
-            target = int(budget / (2 * bpr))
-            floor = DEFAULT_ROW_BUCKETS[0]
-            for b in DEFAULT_ROW_BUCKETS:
-                if b <= target:
-                    floor = b
-            chunk_rows = min(chunk_rows, floor)
-        with MetricTimer(self.metrics[OP_TIME]):
-            for out in external_merge_sort(
-                    xp, pending, sort_fn, self.output_names,
-                    self.output_types, spill, budget,
-                    chunk_rows):
+        try:
+            for b in self.children[0].execute_partition(pid, ctx):
+                pending.append(spill.register(b, SpillPriority.INPUT))
+                if self.oc_budget is not None:
+                    enforce_device_budget(spill, budget)
+            if not pending:
+                return
+            sort_fn = self._jitted if self.placement == TPU \
+                else lambda b: self._sort_batch(np, b)
+            total = sum(p.device_bytes for p in pending)
+            if total <= budget:
+                # in-core: concat everything and sort once
+                with MetricTimer(self.metrics[OP_TIME]):
+                    batches = [p.get_batch(xp) for p in pending]
+                    merged = concat_batches(xp, batches, self.output_names,
+                                            self.output_types) \
+                        if len(batches) > 1 else batches[0]
+                    for p in pending:
+                        p.close()
+                    out = sort_fn(merged)
+                    maybe_sync(out)
                 self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
                 self.metrics[NUM_OUTPUT_BATCHES] += 1
                 yield out
+                return
+            # out-of-core external merge sort (ref GpuSortExec.scala:231)
+            from .outofcore import external_merge_sort
+            chunk_rows = max(int(p.num_rows) for p in pending)
+            if self.oc_budget is not None:
+                # keep each run chunk at ~half the enforced budget so a
+                # two-run merge group stays within it; snap DOWN to a
+                # capacity bucket — an off-bucket chunk pads UP to the next
+                # bucket and would inflate real memory instead
+                from ..columnar.device import DEFAULT_ROW_BUCKETS
+                rows_total = sum(int(p.num_rows) for p in pending)
+                bpr = max(total / max(rows_total, 1), 1.0)
+                target = int(budget / (2 * bpr))
+                floor = DEFAULT_ROW_BUCKETS[0]
+                for b in DEFAULT_ROW_BUCKETS:
+                    if b <= target:
+                        floor = b
+                chunk_rows = min(chunk_rows, floor)
+            with MetricTimer(self.metrics[OP_TIME]):
+                for out in external_merge_sort(
+                        xp, pending, sort_fn, self.output_names,
+                        self.output_types, spill, budget,
+                        chunk_rows):
+                    self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+                    self.metrics[NUM_OUTPUT_BATCHES] += 1
+                    yield out
+        finally:
+            # a raising producer (or an abandoned consumer) must
+            # not strand registered spillables: close everything
+            # this partition accumulated — idempotent, so batches
+            # the merge already consumed are no-ops (tpufsan
+            # TPU-R012)
+            for p in pending:
+                p.close()

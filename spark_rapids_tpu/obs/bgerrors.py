@@ -1,0 +1,91 @@
+"""Typed routing for background-thread failures.
+
+Long-lived daemon threads — the shuffle heartbeat loop, the metrics
+HTTP server — used to swallow unexpected exceptions silently: the
+thread either died without a trace or logged-and-continued, and the
+only symptom was a peer quietly going stale.  tpufsan (TPU-R011)
+formalizes why that is unacceptable; this module is the sanctioned
+sink those threads route through instead.
+
+``note_background_error(root, error)`` does three things, each
+best-effort and none able to raise back into the calling thread:
+
+1. increments ``tpu_background_errors_total{root=...}`` so the
+   failure is visible on the metrics surface and drives the health
+   monitor's delta rule (``background`` component degrades);
+2. records the last error per root (type, message, monotonic count)
+   for health snapshots and tests;
+3. writes a postmortem bundle of kind ``background_failure`` when a
+   black-box directory is configured — background failures get the
+   same forensic treatment as query failures.
+
+The bundle directory is process-global (`set_postmortem_dir`) because
+background threads outlive any one session; ``TpuSession`` points it
+at its own history dir when postmortems are enabled.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+_lock = threading.Lock()
+_last_errors: Dict[str, Dict[str, Any]] = {}
+_postmortem_dir: Optional[str] = None
+
+
+def set_postmortem_dir(path: Optional[str]) -> None:
+    """Point background-failure bundles at a history directory (None
+    disables bundling; counting and last-error recording continue)."""
+    global _postmortem_dir
+    with _lock:
+        _postmortem_dir = path
+
+
+def last_error(root: str) -> Optional[Dict[str, Any]]:
+    """The most recent recorded failure for ``root`` (or None):
+    ``{"type", "message", "count"}``."""
+    with _lock:
+        rec = _last_errors.get(root)
+        return dict(rec) if rec else None
+
+
+def reset() -> None:
+    """Test hook: forget recorded errors and the bundle directory."""
+    global _postmortem_dir
+    with _lock:
+        _last_errors.clear()
+        _postmortem_dir = None
+
+
+def note_background_error(root: str, error: BaseException) -> None:
+    """Route a background-thread failure through the typed path:
+    counter + last-error record + (best-effort) postmortem bundle.
+
+    Never raises — a broken observability stack must not take the
+    heartbeat loop down with it."""
+    try:
+        from . import metrics as m
+        m.counter("tpu_background_errors_total",
+                  "unexpected exceptions in background threads, "
+                  "by thread root",
+                  labelnames=("root",)).labels(root=root).inc()
+    except Exception:
+        pass
+    try:
+        with _lock:
+            rec = _last_errors.setdefault(
+                root, {"type": "", "message": "", "count": 0})
+            rec["type"] = type(error).__name__
+            rec["message"] = str(error)
+            rec["count"] += 1
+            out_dir = _postmortem_dir
+    except Exception:
+        out_dir = None
+    if out_dir:
+        try:
+            from .postmortem import dump_background_postmortem
+            dump_background_postmortem(out_dir, error,
+                                       tenant=f"background:{root}")
+        except Exception:
+            pass

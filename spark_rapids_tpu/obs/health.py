@@ -143,6 +143,7 @@ class HealthMonitor:
         ("shuffle", "tpu_shuffle_heartbeat_missed_total", DEGRADED),
         ("queries", "tpu_queries_failed_total", DEGRADED),
         ("admission", "tpu_admission_timeouts_total", DEGRADED),
+        ("background", "tpu_background_errors_total", DEGRADED),
     )
 
     # sustained admission backlog: queue depth at or above this for two
@@ -276,6 +277,21 @@ class MetricsServer:
 
         class _Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib contract)
+                try:
+                    self._serve()
+                except Exception as ex:
+                    # a scrape must never kill the endpoint thread
+                    # silently: count it, degrade health, black-box it,
+                    # and tell the scraper (tpufsan TPU-R011)
+                    from .bgerrors import note_background_error
+                    note_background_error("metrics-http", ex)
+                    try:
+                        self.send_response(500)
+                        self.end_headers()
+                    except Exception:
+                        pass  # client already gone; nothing to tell
+
+            def _serve(self):
                 if self.path.startswith("/metrics"):
                     from .fleet import fleet_refresh
                     fleet_refresh()

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -32,15 +33,15 @@ BUNDLE_PREFIX = "pm_"
 # not an archive — past it the sample window is halved until it fits
 MAX_BUNDLE_BYTES = 4 << 20
 
-_seq_lock = None
+# eager, not lazily created on first use: bundles are now written from
+# background threads too, and a lazy `if _seq_lock is None: Lock()`
+# init is itself a race (two first-callers can mint different locks)
+_seq_lock = threading.Lock()
 _seq = 0
 
 
 def _next_seq() -> int:
-    global _seq_lock, _seq
-    import threading
-    if _seq_lock is None:
-        _seq_lock = threading.Lock()
+    global _seq
     with _seq_lock:
         _seq += 1
         return _seq
@@ -89,14 +90,45 @@ def _json_safe(obj):
 
 def dump_postmortem(out_dir: str, error, session=None, tracer=None,
                     plan=None, tenant: str = "default",
-                    max_bundles: int = 16) -> Optional[str]:
+                    max_bundles: int = 16,
+                    kind: Optional[str] = None) -> Optional[str]:
     """Write one bundle; returns its path (None when the dump itself
-    failed — callers treat the black box as strictly advisory)."""
+    failed — callers treat the black box as strictly advisory).
+    ``kind`` overrides the exception-type classification — the
+    background-error router labels its bundles ``background_failure``
+    regardless of the escaping type."""
+    try:
+        bundle = build_bundle(error, session=session, tracer=tracer,
+                              plan=plan, tenant=tenant, kind=kind)
+        return _write_bundle(out_dir, bundle, max_bundles)
+    except Exception:
+        return None
+
+
+def dump_background_postmortem(out_dir: str, error, tenant: str,
+                               max_bundles: int = 16) -> Optional[str]:
+    """Black-box a background-thread failure (heartbeat loop, metrics
+    endpoint).  Deliberately a LEAN bundle — header, metrics exposition
+    and the HBM window — NOT ``build_bundle``: a background thread has
+    no session, plan or tracer to freeze, and keeping this path off the
+    planner/analysis machinery keeps the tpucsan reach of those thread
+    roots (and therefore their shared-write surface) small and honest."""
+    try:
+        bundle = _bundle_header(error, tenant, "background_failure")
+        _add_hbm_section(bundle)
+        _add_metrics_section(bundle)
+        return _write_bundle(out_dir, bundle, max_bundles)
+    except Exception:
+        return None
+
+
+def _write_bundle(out_dir: str, bundle: Dict[str, Any],
+                  max_bundles: int) -> Optional[str]:
+    """Serialize one assembled bundle under ``<out_dir>/postmortems/``
+    with the size clamp and retention cap applied."""
     try:
         from .history import HistoryDir
         pm_dir = HistoryDir(out_dir).postmortems_dir()
-        bundle = build_bundle(error, session=session, tracer=tracer,
-                              plan=plan, tenant=tenant)
         stamp = time.strftime("%Y%m%d_%H%M%S")
         path = os.path.join(
             pm_dir, f"{BUNDLE_PREFIX}{stamp}_{_next_seq():04d}.json")
@@ -115,20 +147,45 @@ def dump_postmortem(out_dir: str, error, session=None, tracer=None,
         return None
 
 
-def build_bundle(error, session=None, tracer=None, plan=None,
-                 tenant: str = "default") -> Dict[str, Any]:
-    """Assemble the bundle dict.  Every section is individually
-    best-effort: a dead subsystem contributes an error note, never an
-    exception."""
-    bundle: Dict[str, Any] = {
+def _bundle_header(error, tenant: str,
+                   kind: Optional[str]) -> Dict[str, Any]:
+    return {
         "version": BUNDLE_VERSION,
-        "kind": _classify(error),
+        "kind": kind or _classify(error),
         "wall_time_ms": int(time.time() * 1000),
         "tenant": tenant,
         "error": {"type": type(error).__name__ if error is not None
                   else None,
                   "message": str(error) if error is not None else None},
     }
+
+
+def _add_hbm_section(bundle: Dict[str, Any]) -> None:
+    # HBM observatory: occupancy split at failure time + recent window
+    try:
+        from .memprof import MemoryTimeline
+        tl = MemoryTimeline.get()
+        bundle["hbm"] = {"report": tl.report(), "window": tl.window()}
+    except Exception as ex:
+        bundle["hbm"] = {"error": repr(ex)}
+
+
+def _add_metrics_section(bundle: Dict[str, Any]) -> None:
+    # metrics: the full exposition text (grep-able, schema-stable)
+    try:
+        from .health import render_prometheus
+        bundle["metrics"] = render_prometheus()
+    except Exception as ex:
+        bundle["metrics"] = f"# unavailable: {ex!r}"
+
+
+def build_bundle(error, session=None, tracer=None, plan=None,
+                 tenant: str = "default",
+                 kind: Optional[str] = None) -> Dict[str, Any]:
+    """Assemble the bundle dict.  Every section is individually
+    best-effort: a dead subsystem contributes an error note, never an
+    exception."""
+    bundle = _bundle_header(error, tenant, kind)
     try:
         # the attribution scope is still on this thread — the failure
         # unwinds through session._execute inside push_context/pop
@@ -154,19 +211,8 @@ def build_bundle(error, session=None, tracer=None, plan=None,
             bundle["failing_operator"] = _failing_operator(spans)
     except Exception as ex:
         bundle["trace"] = {"error": repr(ex)}
-    # HBM observatory: occupancy split at failure time + recent window
-    try:
-        from .memprof import MemoryTimeline
-        tl = MemoryTimeline.get()
-        bundle["hbm"] = {"report": tl.report(), "window": tl.window()}
-    except Exception as ex:
-        bundle["hbm"] = {"error": repr(ex)}
-    # metrics: the full exposition text (grep-able, schema-stable)
-    try:
-        from .health import render_prometheus
-        bundle["metrics"] = render_prometheus()
-    except Exception as ex:
-        bundle["metrics"] = f"# unavailable: {ex!r}"
+    _add_hbm_section(bundle)
+    _add_metrics_section(bundle)
     # plan + analysis states
     try:
         if plan is not None:

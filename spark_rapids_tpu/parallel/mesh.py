@@ -97,7 +97,12 @@ def device_count(timeout_s: Optional[float] = None,
     planning gates skip the multichip path instead of hanging."""
     try:
         return len(discover_devices(timeout_s))
-    except BaseException:
+    except Exception as ex:
+        # deliberate degradation to single-chip — breadcrumb the
+        # swallowed probe error so a dead tunnel is diagnosable from
+        # the trace (tpufsan TPU-R011)
+        from ..obs.tracer import trace_event
+        trace_event("mesh.degrade_single_chip", error=repr(ex))
         return default
 
 

@@ -109,9 +109,18 @@ class HeartbeatEndpoint:
 
     def _run(self):
         while not self._stop.wait(self.interval_s):
-            peers = self.manager.executor_heartbeat(self.executor_id)
-            if self.on_peers:
-                self.on_peers(peers)
+            try:
+                peers = self.manager.executor_heartbeat(self.executor_id)
+                if self.on_peers:
+                    self.on_peers(peers)
+            except Exception as ex:
+                # a bad beat must not kill the loop (a dead loop means
+                # this executor silently expires from every peer list),
+                # but it must not vanish either: route through the
+                # typed background-error path — counter + health
+                # degradation + black-box bundle (tpufsan TPU-R011)
+                from ..obs.bgerrors import note_background_error
+                note_background_error("heartbeat-loop", ex)
 
     def stop(self):
         self._stop.set()

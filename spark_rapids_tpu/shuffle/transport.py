@@ -70,6 +70,7 @@ _FRAME2 = struct.Struct("<BBBQqH")  # magic, ver, type, req_id, blen, ctxlen
 ERR_BLOCK_MISSING = "block_missing"
 ERR_BAD_MESSAGE = "bad_message"
 ERR_BAD_VERSION = "bad_version"
+ERR_INTERNAL = "internal"
 
 # hello bodies: request is the client's send timestamp; the response
 # echoes it and adds the server's receive/send timestamps (NTP-style
@@ -143,6 +144,12 @@ class Transaction:
         return self.result
 
 
+# server-side per-connection deadline: generous (reused connections
+# idle legitimately between fetch waves) but bounded — liveness, not
+# latency
+SERVER_IDLE_TIMEOUT_S = 120.0
+
+
 class ShuffleServer:
     """Serves catalog blocks over TCP (ref RapidsShuffleServer.scala).
 
@@ -162,6 +169,11 @@ class ShuffleServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                # a hung/silent peer must never pin this handler thread
+                # forever (tpufsan TPU-R014); an idle-timeout close
+                # surfaces client-side as a typed fetch failure and the
+                # locality retry loop reconnects
+                self.request.settimeout(SERVER_IDLE_TIMEOUT_S)
                 with outer._conns_lock:
                     outer._conns.add(self.request)
                 try:
@@ -239,16 +251,29 @@ class ShuffleServer:
                 return False
             mtype, req_id, blen = _FRAME.unpack(first + rest)
             body = _recv_exact(sock, blen) if blen else b""
-        if mtype == MSG_METADATA_REQ:
-            self._handle_metadata(sock, req_id, body, ctx=ctx)
-        elif mtype == MSG_TRANSFER_REQ:
-            self._handle_transfer(sock, req_id, body, ctx=ctx)
-        elif mtype == MSG_HELLO:
-            self._handle_hello(sock, req_id, body)
-        else:
+        try:
+            if mtype == MSG_METADATA_REQ:
+                self._handle_metadata(sock, req_id, body, ctx=ctx)
+            elif mtype == MSG_TRANSFER_REQ:
+                self._handle_transfer(sock, req_id, body, ctx=ctx)
+            elif mtype == MSG_HELLO:
+                self._handle_hello(sock, req_id, body)
+            else:
+                _send_frame(sock, MSG_ERROR, req_id,
+                            f"{ERR_BAD_MESSAGE}:unknown "
+                            f"type {mtype}".encode())
+        except (ConnectionError, OSError):
+            raise  # the socket itself is gone — nothing to relay on
+        except Exception as ex:
+            # an engine failure while serving ONE request (corrupt
+            # catalog entry, dirty ledger, serializer bug) must reach
+            # the requesting peer as a typed refusal it can dispatch
+            # on, not as a dropped connection it can only classify as
+            # "fetch failed, maybe dead" (tpufsan typed-propagation
+            # contract: the fault campaign injects here)
             _send_frame(sock, MSG_ERROR, req_id,
-                        f"{ERR_BAD_MESSAGE}:unknown "
-                        f"type {mtype}".encode())
+                        f"{ERR_INTERNAL}:{type(ex).__name__}: "
+                        f"{ex}".encode())
         return True
 
     def _handle_hello(self, sock, req_id, body):
@@ -723,6 +748,9 @@ def _raise_peer_error(body: bytes) -> None:
     if code == ERR_BAD_VERSION:
         raise TpuShuffleVersionError(
             int(detail) if detail.isdigit() else -1)
+    # ERR_INTERNAL / unknown future codes: still a typed fetch failure
+    # carrying the peer's own diagnosis — never fall through silently
+    raise TpuShuffleFetchFailedError(text)
 
 
 def _send_frame(sock, mtype: int, req_id: int, body: bytes):

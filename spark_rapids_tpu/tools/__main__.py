@@ -114,6 +114,29 @@ def _run_lock_graph(output):
     return 1 if art["cycles"] else 0
 
 
+def _run_raise_graph(output):
+    """Dump the tpufsan exception-flow artifact (what the fault-
+    injection gate enumerates) as JSON."""
+    import json
+
+    from ..analysis.raiseflow import raise_graph_artifact
+
+    art = raise_graph_artifact()
+    text = json.dumps(art, indent=2, sort_keys=True) + "\n"
+    leaks = sum(len(s["untyped"]) for s in art["seams"].values())
+    if output:
+        with open(output, "w", encoding="utf-8") as f:
+            f.write(text)
+        sys.stdout.write(
+            f"raise graph: {len(art['seams'])} seam(s), "
+            f"{len(art['taxonomy'])} typed error(s), "
+            f"{len(art['injections'])} planned injection(s), "
+            f"{leaks} untyped leak(s) -> {output}\n")
+    else:
+        sys.stdout.write(text)
+    return 1 if leaks else 0
+
+
 def _run_repo_lint(baseline_path, update):
     from ..analysis.diagnostics import format_diagnostics
     from ..analysis.repo_lint import (lint_repo, load_baseline,
@@ -398,9 +421,16 @@ def main(argv=None):
                          "(locks, acquisition edges, cycles, thread "
                          "roots) as JSON; exits 1 if the graph has a "
                          "cycle")
+    li.add_argument("--raise-graph", action="store_true",
+                    help="dump the tpufsan exception-flow artifact "
+                         "(per-seam typed/untyped escape sets, the "
+                         "typed-error taxonomy with raise sites, and "
+                         "the fault-injection plan) as JSON; exits 1 "
+                         "when any seam leaks an untyped operational "
+                         "exception")
     li.add_argument("-o", "--output", default=None,
-                    help="with --lock-graph: write the JSON here "
-                         "instead of stdout")
+                    help="with --lock-graph/--raise-graph: write the "
+                         "JSON here instead of stdout")
     rg = sub.add_parser("regress",
                         help="cross-run regression watchdog over "
                              "self-emitted event-log fingerprints")
@@ -516,6 +546,8 @@ def main(argv=None):
     else:
         if args.lock_graph:
             return _run_lock_graph(args.output)
+        if args.raise_graph:
+            return _run_raise_graph(args.output)
         if args.plan:
             return _run_plan_lint(args.plan, infer=args.infer,
                                   memsan=args.memsan)

@@ -230,6 +230,29 @@ def test_run_lint_hbm_gate_exits_zero():
     assert "hbm gate clean" in proc.stdout, proc.stdout
 
 
+def test_run_lint_faults_gate_exits_zero():
+    """Tier-1 gate for tpufsan: the exception-flow repo pass (TPU-R011/
+    R012/R013/R014) must be clean, the raise-graph must plan >= 40
+    statically-reachable (seam, typed-error) injection pairs with zero
+    untyped operational leaks, and the fault-injection campaign must
+    then execute every pair for real — each injected error propagating
+    with its exact type, the books balancing afterwards (no orphaned
+    shuffle blocks, spill leaks, stranded admission bytes or open
+    spans) and exactly one parseable post-mortem bundle per failure;
+    the background thread roots (heartbeat, metrics HTTP) must survive
+    their faults and surface them via tpu_background_errors_total plus
+    a degraded health verdict (anti-vacuity: planted orphans must trip
+    the books check, an untyped injection must trip the propagation
+    check)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "devtools", "run_lint.py"),
+         "--faults"],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "faults gate clean" in proc.stdout, proc.stdout
+
+
 def test_baseline_is_empty_and_stays_empty():
     """PR-3 burned the last baselined TPU-R001 debt down to zero: the
     ratchet now enforces a spotless repo (deliberate exceptions are

@@ -1,0 +1,461 @@
+"""tpufsan unit tests: exception-flow rules TPU-R011..R014 against
+bad/clean twin fixtures (anti-vacuity in both directions), raise-set
+propagation over the real repo's call chains, the fault-injection plan
+the --faults gate executes, and the background-error routing seam.
+
+The end-to-end campaign lives in ``devtools/run_lint.py --faults``
+(wired into tier-1 by tests/test_lint_clean.py); these units pin the
+analysis semantics the campaign relies on."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.analysis import raiseflow
+
+
+def _codes(res):
+    return sorted({d.code for d in res.diagnostics})
+
+
+# ---------------------------------------------------------------------------
+# TPU-R011: broad except swallowing a typed engine error
+# ---------------------------------------------------------------------------
+
+_R011_COMMON = '''
+class EngineError(Exception):
+    pass
+
+def work():
+    raise EngineError("x")
+'''
+
+
+def test_r011_broad_swallow_fires():
+    src = _R011_COMMON + '''
+def seam():
+    try:
+        work()
+    except Exception:
+        pass
+'''
+    res = raiseflow.analyze_sources(
+        {"spark_rapids_tpu/m11.py": src}, seams=())
+    assert _codes(res) == ["TPU-R011"]
+    (d,) = res.diagnostics
+    assert "EngineError" in d.message
+
+
+def test_r011_reraise_twin_is_clean():
+    src = _R011_COMMON + '''
+def seam():
+    try:
+        work()
+    except Exception:
+        raise
+'''
+    res = raiseflow.analyze_sources(
+        {"spark_rapids_tpu/m11.py": src}, seams=())
+    assert _codes(res) == []
+
+
+def test_r011_narrow_handler_is_clean():
+    # catching the typed error BY TYPE is a deliberate decision, not a
+    # swallow — only bare/broad handlers are in scope
+    src = _R011_COMMON + '''
+def seam():
+    try:
+        work()
+    except EngineError:
+        pass
+'''
+    res = raiseflow.analyze_sources(
+        {"spark_rapids_tpu/m11.py": src}, seams=())
+    assert _codes(res) == []
+
+
+# ---------------------------------------------------------------------------
+# TPU-R012: raising successor can skip a declared release obligation
+# ---------------------------------------------------------------------------
+
+# the fixture lives at the real admission relpath so its admit() fid
+# matches the declared obligation suffix
+_R012_COMMON = '''
+class AdmissionController:
+    def admit(self, n):
+        return object()
+    def release(self):
+        pass
+
+def might_raise():
+    raise ValueError("x")
+'''
+
+
+def test_r012_leaking_acquire_fires():
+    src = _R012_COMMON + '''
+def risky():
+    ctrl = AdmissionController()
+    ctrl.admit(8)
+    might_raise()
+    ctrl.release()
+'''
+    res = raiseflow.analyze_sources(
+        {"spark_rapids_tpu/memory/admission.py": src}, seams=())
+    assert _codes(res) == ["TPU-R012"]
+    (d,) = res.diagnostics
+    assert "admission ticket" in d.message
+
+
+def test_r012_finally_twin_is_clean():
+    src = _R012_COMMON + '''
+def careful():
+    ctrl = AdmissionController()
+    ctrl.admit(8)
+    try:
+        might_raise()
+    finally:
+        ctrl.release()
+'''
+    res = raiseflow.analyze_sources(
+        {"spark_rapids_tpu/memory/admission.py": src}, seams=())
+    assert _codes(res) == []
+
+
+def test_r012_ownership_transfer_is_clean():
+    # handing the ticket to another frame (stored on self / passed as
+    # an argument) transfers the obligation out of this function
+    src = _R012_COMMON + '''
+def handoff(sink):
+    ctrl = AdmissionController()
+    t = ctrl.admit(8)
+    might_raise()
+    sink.finish(t)
+'''
+    res = raiseflow.analyze_sources(
+        {"spark_rapids_tpu/memory/admission.py": src}, seams=())
+    assert _codes(res) == []
+
+
+# ---------------------------------------------------------------------------
+# TPU-R013: untyped operational exception escaping a public seam
+# ---------------------------------------------------------------------------
+
+_R013_SEAM = (("svc", "svc.py", "serve", ("svc.py",)),)
+
+
+def test_r013_untyped_leak_fires():
+    src = '''
+def helper():
+    raise RuntimeError("boom")
+
+def serve():
+    helper()
+'''
+    res = raiseflow.analyze_sources(
+        {"spark_rapids_tpu/svc.py": src}, seams=_R013_SEAM)
+    assert _codes(res) == ["TPU-R013"]
+    (d,) = res.diagnostics
+    assert "RuntimeError" in d.message
+
+
+def test_r013_typed_twin_is_clean():
+    src = '''
+class SvcError(Exception):
+    pass
+
+def helper():
+    raise SvcError("boom")
+
+def serve():
+    helper()
+'''
+    res = raiseflow.analyze_sources(
+        {"spark_rapids_tpu/svc.py": src}, seams=_R013_SEAM)
+    assert _codes(res) == []
+
+
+# ---------------------------------------------------------------------------
+# TPU-R014: socket on a thread-root path without a deadline
+# ---------------------------------------------------------------------------
+
+def test_r014_socket_without_deadline_fires():
+    src = '''
+import socket
+
+def _run():
+    s = socket.create_connection(("peer", 9))
+    return s.recv(4)
+'''
+    res = raiseflow.analyze_sources(
+        {"spark_rapids_tpu/sockmod.py": src},
+        roots=["sockmod._run"], seams=())
+    assert _codes(res) == ["TPU-R014"]
+
+
+def test_r014_timeout_twin_is_clean():
+    src = '''
+import socket
+
+def _run():
+    s = socket.create_connection(("peer", 9), timeout=5.0)
+    return s.recv(4)
+'''
+    res = raiseflow.analyze_sources(
+        {"spark_rapids_tpu/sockmod.py": src},
+        roots=["sockmod._run"], seams=())
+    assert _codes(res) == []
+
+
+# ---------------------------------------------------------------------------
+# raise-set propagation over the real repo
+# ---------------------------------------------------------------------------
+
+def test_repo_is_fsan_clean():
+    assert raiseflow.repo_diagnostics() == []
+
+
+def test_main_query_seam_raise_set():
+    res = raiseflow.analyze_repo()
+    typed = res.raises[res.seams["main-query"]]
+    # errors raised many frames below TpuSession.execute must have
+    # propagated up through the interprocedural fixpoint
+    for name in ("AdmissionTimeout", "EvalError", "LifecycleViolation"):
+        assert name in typed, f"{name} did not propagate to main-query"
+
+
+def test_pool_seams_include_pool_lifecycle_errors():
+    art = raiseflow.raise_graph_artifact()
+    assert "PoolClosedError" in art["seams"]["pool-borrow"]["typed"]
+    assert "PoolTimeout" in art["seams"]["pool-drain"]["typed"]
+    # serving-client delegates to main-query AND adds the pool's own
+    # lifecycle errors on top
+    serving = set(art["seams"]["serving-client"]["typed"])
+    main = set(art["seams"]["main-query"]["typed"])
+    assert main <= serving
+    assert {"PoolClosedError", "PoolTimeout"} <= serving - main
+
+
+def test_fetcher_seam_carries_wire_errors():
+    art = raiseflow.raise_graph_artifact()
+    fetcher = set(art["seams"]["shuffle-fetcher"]["typed"])
+    assert {"TpuShuffleBlockMissingError", "TpuShufflePeerDeadError",
+            "TpuShuffleTruncatedFrameError"} <= fetcher
+
+
+def test_injection_plan_floor_and_no_leaks():
+    art = raiseflow.raise_graph_artifact()
+    assert len(art["injections"]) >= 40
+    leaks = {label: s["untyped"]
+             for label, s in art["seams"].items() if s["untyped"]}
+    assert not leaks, f"untyped operational leaks at seams: {leaks}"
+
+
+def test_every_planned_error_is_constructible():
+    art = raiseflow.raise_graph_artifact()
+    for inj in art["injections"]:
+        err = raiseflow.construct_error(inj["error"])
+        assert isinstance(err, Exception)
+        assert type(err).__name__ == inj["error"]
+
+
+# ---------------------------------------------------------------------------
+# background-error routing (heartbeat / metrics-http thread roots)
+# ---------------------------------------------------------------------------
+
+def test_note_background_error_counts_records_and_bundles(tmp_path):
+    from spark_rapids_tpu.obs import bgerrors
+    from spark_rapids_tpu.obs import metrics as m
+    from spark_rapids_tpu.obs import postmortem as pm
+    from spark_rapids_tpu.obs.metrics import MetricsRegistry
+    MetricsRegistry.reset_for_tests()
+    bgerrors.reset()
+    try:
+        bgerrors.set_postmortem_dir(str(tmp_path))
+        bgerrors.note_background_error(
+            "heartbeat-loop", RuntimeError("beat failed"))
+        bgerrors.note_background_error(
+            "heartbeat-loop", RuntimeError("beat failed again"))
+        rec = bgerrors.last_error("heartbeat-loop")
+        assert rec["type"] == "RuntimeError"
+        assert rec["count"] == 2
+        fam = m.counter("tpu_background_errors_total",
+                        labelnames=("root",))
+        assert fam.value(root="heartbeat-loop") == 2
+        bundles = pm.list_bundles(str(tmp_path))
+        assert len(bundles) == 2
+        doc = pm.load_bundle(bundles[0])
+        assert doc["kind"] == "background_failure"
+        assert doc["error"]["type"] == "RuntimeError"
+    finally:
+        bgerrors.reset()
+        MetricsRegistry.reset_for_tests()
+
+
+def test_background_errors_degrade_health():
+    from spark_rapids_tpu.obs import bgerrors
+    from spark_rapids_tpu.obs.health import HealthMonitor
+    from spark_rapids_tpu.obs.metrics import MetricsRegistry
+    MetricsRegistry.reset_for_tests()
+    bgerrors.reset()
+    try:
+        mon = HealthMonitor()
+        mon.snapshot()  # baseline for the delta rules
+        bgerrors.note_background_error(
+            "metrics-http", RuntimeError("render blew up"))
+        snap = mon.snapshot()
+        assert snap["components"]["background"]["status"] == "degraded"
+    finally:
+        bgerrors.reset()
+        MetricsRegistry.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# fault-injection mechanics: the properties the --faults gate asserts
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def _fresh_world(tmp_path):
+    from spark_rapids_tpu.memory.admission import AdmissionController
+    from spark_rapids_tpu.memory.spill import SpillCatalog
+    from spark_rapids_tpu.obs.metrics import MetricsRegistry
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+    MetricsRegistry.reset_for_tests()
+    with SpillCatalog._lock:
+        SpillCatalog._instance = SpillCatalog()
+    TpuShuffleManager.reset()
+    AdmissionController.reset_for_tests()
+    yield tmp_path
+    TpuShuffleManager.reset()
+    AdmissionController.reset_for_tests()
+    MetricsRegistry.reset_for_tests()
+
+
+def _golden_table():
+    return pa.table({
+        "k": pa.array((np.arange(60) % 7).astype(np.int64)),
+        "v": pa.array(np.arange(60, dtype=np.int64))})
+
+
+def test_injected_typed_fault_propagates_and_books_balance(_fresh_world):
+    """One real injection end to end: arm FilterExec with a typed
+    engine error, run a golden query, and assert exactly what the gate
+    asserts per (seam, error) pair — typed propagation, balanced books
+    and one parseable post-mortem bundle."""
+    from spark_rapids_tpu.api.functions import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.exec import basic as exec_basic
+    from spark_rapids_tpu.exec.base import _wrap_execute_partition
+    from spark_rapids_tpu.obs import postmortem as pm
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+
+    pmdir = str(_fresh_world)
+    sess = TpuSession({
+        "spark.rapids.sql.enabled": "true",
+        "spark.rapids.tpu.trace.enabled": "true",
+        "spark.rapids.tpu.hbm.postmortem.dir": pmdir,
+    })
+    err = raiseflow.construct_error("TpuShufflePeerDeadError")
+
+    def boom(self, pid, ctx):
+        raise err
+        yield
+
+    real = exec_basic.FilterExec.execute_partition
+    exec_basic.FilterExec.execute_partition = \
+        _wrap_execute_partition(boom)
+    try:
+        with pytest.raises(Exception) as ei:
+            (sess.create_dataframe(_golden_table(), num_partitions=2)
+             .filter(col("v") > 5).collect())
+    finally:
+        exec_basic.FilterExec.execute_partition = real
+    assert type(ei.value).__name__ == "TpuShufflePeerDeadError"
+    # books balance: no orphaned blocks, no open spans
+    assert TpuShuffleManager.get().catalog.num_blocks() == 0
+    trace = sess.last_query_trace()
+    assert trace is not None and trace.open_span_count() == 0
+    # exactly one parseable bundle naming the injected error
+    bundles = pm.list_bundles(pmdir)
+    assert len(bundles) == 1
+    doc = pm.load_bundle(bundles[0])
+    assert doc["error"]["type"] == "TpuShufflePeerDeadError"
+
+
+def test_leaking_fault_is_detected(_fresh_world):
+    """Anti-vacuity for the campaign's books check: a fault that fires
+    AFTER the exchange wrote its map outputs, combined with a broken
+    release path, must leave orphaned shuffle blocks — exactly the
+    signal that fails the --faults gate.  With the release path intact
+    the same fault leaves the catalog clean."""
+    from spark_rapids_tpu.api.functions import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.exec import basic as exec_basic
+    from spark_rapids_tpu.exec.base import _wrap_execute_partition
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+
+    sess = TpuSession({"spark.rapids.sql.enabled": "true"})
+    err = raiseflow.construct_error("TpuShuffleFetchFailedError")
+
+    def boom(self, pid, ctx):
+        # drain the child first so the exchange below has materialized
+        # its map outputs before the fault unwinds the query
+        for _ in self.children[0].execute_partition(pid, ctx):
+            pass
+        raise err
+        yield
+
+    def run_query():
+        return (sess.create_dataframe(_golden_table(), num_partitions=3)
+                .repartition(5, col("k"))
+                .filter(col("v") > 5).collect())
+
+    real = exec_basic.FilterExec.execute_partition
+    exec_basic.FilterExec.execute_partition = \
+        _wrap_execute_partition(boom)
+    real_release = TpuSession.release_plan_shuffles
+    try:
+        # broken release path: the fault strands every map-output block
+        TpuSession.release_plan_shuffles = lambda self, plan: None
+        with pytest.raises(Exception):
+            run_query()
+        leaked = TpuShuffleManager.get().catalog.num_blocks()
+        assert leaked > 0, \
+            "books check is vacuous: broken release leaked nothing"
+        # intact release path: the same fault leaves balanced books
+        TpuSession.release_plan_shuffles = real_release
+        TpuShuffleManager.reset()
+        with pytest.raises(Exception):
+            run_query()
+        assert TpuShuffleManager.get().catalog.num_blocks() == 0
+    finally:
+        TpuSession.release_plan_shuffles = real_release
+        exec_basic.FilterExec.execute_partition = real
+
+
+def test_untyped_injection_breaks_typed_propagation_check(_fresh_world):
+    """The campaign's propagation check is not vacuous: injecting a
+    RAW RuntimeError surfaces as RuntimeError at the seam, which is
+    precisely the mismatch the gate reports as broken propagation."""
+    from spark_rapids_tpu.api.functions import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.exec import basic as exec_basic
+    from spark_rapids_tpu.exec.base import _wrap_execute_partition
+
+    sess = TpuSession({"spark.rapids.sql.enabled": "true"})
+
+    def boom(self, pid, ctx):
+        raise RuntimeError("untyped operational failure")
+        yield
+
+    real = exec_basic.FilterExec.execute_partition
+    exec_basic.FilterExec.execute_partition = \
+        _wrap_execute_partition(boom)
+    try:
+        with pytest.raises(Exception) as ei:
+            (sess.create_dataframe(_golden_table(), num_partitions=2)
+             .filter(col("v") > 5).collect())
+    finally:
+        exec_basic.FilterExec.execute_partition = real
+    assert type(ei.value).__name__ != "TpuShuffleTimeoutError"
+    assert type(ei.value).__name__ == "RuntimeError"
