@@ -1041,23 +1041,30 @@ def _dist_fetch_join(parts: int, window: int):
 
 
 def _dist_run(rows: int, parts: int, codec: str, window: int,
-              seed: int, traced: bool = False) -> dict:
+              seed: int, traced: bool = False,
+              digest: bool = True) -> dict:
     """One (codec, window) distributed run: child process owns the map
     outputs and serves them; this process plays the reduce side.
 
     ``traced=True`` installs a live tracer around the fetch/join, so
     the run pays the full fleet-observatory path (fetch spans, the v2
     context on the wire, the post-fetch /spans pulls + merge) and the
-    result carries ``_trace`` for the merged-trace report."""
+    result carries ``_trace`` for the merged-trace report.
+
+    ``digest=False`` turns content addressing off on BOTH sides (the
+    child skips write-time block digests, this side skips fetch
+    verification) — the baseline arm of the tpudsan overhead guard."""
     import subprocess
     from spark_rapids_tpu.obs import metrics as m
     from spark_rapids_tpu.obs import tracer as tr
+    from spark_rapids_tpu.shuffle.digest import set_digest_enabled
     from spark_rapids_tpu.shuffle.locality import reset_pool
     from spark_rapids_tpu.shuffle.registry import (BlockEndpoint,
                                                    BlockLocationRegistry)
     from spark_rapids_tpu.shuffle.serve_map import DIM_SID, FACT_SID
     env = dict(os.environ, JAX_PLATFORMS="cpu",
-               SPARK_RAPIDS_TPU_DISABLE_COMPILE_CACHE="1")
+               SPARK_RAPIDS_TPU_DISABLE_COMPILE_CACHE="1",
+               SPARK_RAPIDS_TPU_DSAN_DIGEST="1" if digest else "0")
     child = subprocess.Popen(
         [sys.executable, "-m", "spark_rapids_tpu.shuffle.serve_map",
          "--rows", str(rows), "--parts", str(parts),
@@ -1079,8 +1086,13 @@ def _dist_run(rows: int, parts: int, codec: str, window: int,
         reg.register(DIM_SID, [ep])
         local_c = m.counter("tpu_shuffle_local_blocks_total")
         local_before = local_c.value()
+        verified_c = m.counter("tpu_shuffle_digest_verified_total")
+        mismatch_c = m.counter("tpu_shuffle_digest_mismatch_total")
+        verified_before = verified_c.value()
+        mismatch_before = mismatch_c.value()
         if traced:
             trace = tr.install(tr.QueryTrace())
+        set_digest_enabled(digest)
         t0 = time.perf_counter()
         joined = _dist_fetch_join(parts, window)
         wall = time.perf_counter() - t0
@@ -1098,6 +1110,7 @@ def _dist_run(rows: int, parts: int, codec: str, window: int,
         if rc != 0:
             raise RuntimeError(f"serve_map exited {rc}")
     finally:
+        set_digest_enabled(True)
         if trace is not None and tr.active_tracer() is trace:
             tr.uninstall()
         child.stdin.close()
@@ -1127,6 +1140,9 @@ def _dist_run(rows: int, parts: int, codec: str, window: int,
         "child_leaks": stats.get("leaks"),
         "child_unpulled_spans": stats.get("unpulled_spans"),
         "parent_local_blocks": local_after - local_before,
+        "digest": digest,
+        "digest_verified_blocks": verified_c.value() - verified_before,
+        "digest_mismatches": mismatch_c.value() - mismatch_before,
         "_table": joined,
     }
     if trace is not None:
@@ -1208,6 +1224,44 @@ def measure_dist_trace_overhead(rows: int, parts: int,
 
     base = floor(False)
     return 100.0 * (floor(True) - base) / base
+
+
+def measure_dist_digest_overhead(rows: int, parts: int,
+                                 seed: int) -> dict:
+    """tpudsan content-addressing overhead: the lz4/pipelined dist run
+    with write-time block digests + fetch-side verification on vs
+    fully off (both processes).  The digest arm must actually verify
+    blocks (anti-vacuity) with zero mismatches; each arm keeps its
+    two-run noise floor.  Budget: < 2% of untraced fetch wall time."""
+    failures = []
+
+    def floor(digest):
+        walls, verified, mismatches = [], 0, 0
+        for _ in range(2):
+            r = _dist_run(rows, parts, "lz4", 4, seed, digest=digest)
+            r.pop("_table", None)
+            walls.append(r["wall_s"])
+            verified += r["digest_verified_blocks"]
+            mismatches += r["digest_mismatches"]
+        return min(walls), verified, mismatches
+
+    base, base_verified, _ = floor(False)
+    on, on_verified, on_mismatches = floor(True)
+    if base_verified:
+        failures.append(
+            f"digest-off arm verified {base_verified} block(s) — the "
+            f"off switch does not reach the fetch path")
+    if not on_verified:
+        failures.append(
+            "digest-on arm verified ZERO blocks — the overhead "
+            "measurement is vacuous (digests never reached the wire)")
+    if on_mismatches:
+        failures.append(
+            f"digest-on arm recorded {on_mismatches} content "
+            f"mismatch(es) on a clean loopback run")
+    pct = 100.0 * (on - base) / base
+    return {"pct": round(pct, 2), "verified_blocks": on_verified,
+            "failures": failures}
 
 
 def measure_dist(rows: int, parts: int, seed: int,
@@ -1348,6 +1402,15 @@ def main():
         trace_out = _arg_value("--trace-out", "tpu_dist_trace.json")
         summary = measure_dist(dist_rows, dist_parts, dist_seed,
                                trace_out=trace_out)
+        dg = measure_dist_digest_overhead(dist_rows, dist_parts,
+                                          dist_seed)
+        summary["dist_digest_overhead_pct"] = dg["pct"]
+        summary["dist_digest_verified_blocks"] = dg["verified_blocks"]
+        summary["failures"].extend(dg["failures"])
+        if dg["pct"] > 2.0:
+            summary["failures"].append(
+                f"content-addressing overhead {dg['pct']:.2f}% > 2% "
+                f"of digest-off fetch wall time")
         if "--trace-overhead" in sys.argv[1:]:
             pct = measure_dist_trace_overhead(dist_rows, dist_parts,
                                               dist_seed)

@@ -137,7 +137,21 @@ untyped injection must fail the propagation verdict.
     python devtools/run_lint.py --feedback         # estimator-observatory gate
     python devtools/run_lint.py --fleet            # fleet-observatory gate
     python devtools/run_lint.py --hbm              # HBM-observatory gate
+--dsan runs the tpudsan determinism gate: the replay-safety repo pass
+(TPU-R015 volatile reads, TPU-R016 arrival-order float folds, TPU-L017
+fingerprint hygiene) must be finding-free with zero frozen baseline
+debt, the planted rule fixtures must each trip (anti-vacuity), and the
+permuted-replay oracle replays every golden-corpus exchange's map
+write under permuted batch arrival AND a changed input split — every
+subtree claiming order_stable or better must reproduce its
+content-addressed block digests (ShuffleBufferCatalog write-time
+digests, cross-checked against recomputes), while two planted
+nondeterminism injections (an arrival-order float sum, a
+PYTHONHASHSEED-dependent set-iteration router) must produce
+DIFFERENT digests, proving the oracle is not vacuous.
+
     python devtools/run_lint.py --faults           # tpufsan fault campaign
+    python devtools/run_lint.py --dsan             # tpudsan determinism gate
 """
 
 import json
@@ -2903,6 +2917,397 @@ def run_faults_gate() -> int:
     return 0
 
 
+# --- tpudsan: determinism & replay-safety gate ------------------------------
+
+# planted R015 hazards: a wall-clock read and a set-literal iteration on
+# a result-affecting path in exec/ — both must trip or the rule is vacuous
+_DSAN_R015_SRC = '''\
+import time
+
+
+def route_rows(batches, nparts):
+    out = {}
+    stamp = time.time()
+    for key in {"alpha", "beta", "gamma"}:
+        out[key] = stamp
+    return out
+'''
+
+# planted R016 hazard: a float accumulator folded across an
+# arrival-ordered source with no tolerance and no canonicalization
+_DSAN_R016_SRC = '''\
+def fold(batches):
+    running_sum = 0.0
+    for b in batches:
+        running_sum += b.column_sum("v")
+    return running_sum
+'''
+
+# the set-iteration injection, run for REAL under two PYTHONHASHSEEDs:
+# partition routing follows set(KEYS) iteration order, so the printed
+# block digests must differ between seeds (dynamic anti-vacuity) AND the
+# same source must trip TPU-R015 statically (for key in set(...)).
+_DSAN_HASHSEED_SRC = r"""
+import json
+
+import pyarrow as pa
+
+from spark_rapids_tpu.shuffle.digest import block_digest
+
+KEYS = ["key-%03d" % i for i in range(32)]
+assign = {}
+pos = 0
+for key in set(KEYS):
+    assign.setdefault(pos % 4, []).append(key)
+    pos += 1
+digests = {}
+for pid in sorted(assign):
+    ks = assign[pid]
+    rb = pa.RecordBatch.from_pydict({
+        "k": pa.array(ks, type=pa.string()),
+        "v": pa.array([KEYS.index(k) for k in ks], type=pa.int64()),
+    })
+    digests[str(pid)] = block_digest(rb)
+print(json.dumps(digests))
+"""
+
+
+def run_dsan_gate() -> int:
+    """tpudsan gate, four legs: (1) the determinism repo pass
+    (TPU-R015/R016 + the L017 fingerprint-hygiene registry check) is
+    finding-free with nothing frozen in the baseline; (2) static
+    anti-vacuity — the planted R015/R016 sources, an L017 volatile /
+    overlapping fingerprint schema and a stable_merge=off float partial
+    aggregate must each trip their rule; (3) the permuted-replay oracle
+    — every golden-corpus exchange site replays its map write under
+    permuted batch arrival and again under a changed input split, and
+    every subtree that CLAIMS order_stable or better must reproduce its
+    content digests (bit_exact claims: per-(map,reduce) block-digest
+    multisets; order_stable claims: per-(map,reduce) row-multiset
+    digests; changed split: per-reduce row folds, skipped for
+    partition-scoped partials), with every recorded write-time digest
+    cross-checked against a recompute; (4) dynamic anti-vacuity — the
+    planted arrival-order float sum and the PYTHONHASHSEED-dependent
+    set-iteration router must each produce DIFFERENT digests when
+    replayed, proving the oracle can see real nondeterminism."""
+    import subprocess
+    from collections import Counter
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.analysis import determinism as dsan
+    from spark_rapids_tpu.analysis.plan_lint import lint_plan
+    from spark_rapids_tpu.analysis.repo_lint import load_baseline
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.exec import base as eb
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.exec.basic import LocalScanExec
+    from spark_rapids_tpu.expr.aggregates import (AggregateExpression,
+                                                  PARTIAL, Sum)
+    from spark_rapids_tpu.expr.core import AttributeReference
+    from spark_rapids_tpu.memory.spill import SpillCatalog
+    from spark_rapids_tpu.shuffle.digest import (block_digest,
+                                                 fold_multiset,
+                                                 row_multiset_digest)
+    from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.shuffle.manager import (TpuShuffleManager,
+                                                  materialize_block)
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+
+    failures = 0
+
+    # -- leg 1: repo pass finding-free, nothing frozen ----------------------
+    for d in dsan.repo_diagnostics():
+        failures += 1
+        print(f"DSAN: repo finding (baseline is burned empty): "
+              f"{d.render()}")
+    frozen = [fp for fp in load_baseline(BASELINE)
+              if fp.split("\t", 1)[0] in ("TPU-R015", "TPU-R016",
+                                          "TPU-L017")]
+    if frozen:
+        failures += 1
+        print(f"DSAN: {len(frozen)} determinism fingerprint(s) frozen "
+              f"in the baseline — these rules must stay at zero debt")
+
+    # -- leg 2: static anti-vacuity -----------------------------------------
+    got = {d.code for d in dsan.module_diagnostics(
+        _DSAN_R015_SRC, "spark_rapids_tpu/exec/injected.py")}
+    n_r015 = sum(d.code == "TPU-R015" for d in dsan.module_diagnostics(
+        _DSAN_R015_SRC, "spark_rapids_tpu/exec/injected.py"))
+    if n_r015 < 2:
+        failures += 1
+        print(f"DSAN: R015 fixture tripped {n_r015}/2 plants (wall "
+              f"clock + set iteration) — the rule is vacuous "
+              f"(got {sorted(got)})")
+    got = {d.code for d in dsan.module_diagnostics(
+        _DSAN_R016_SRC, "spark_rapids_tpu/exec/injected.py")}
+    if "TPU-R016" not in got:
+        failures += 1
+        print(f"DSAN: R016 fixture did not trip (got "
+              f"{sorted(got) or 'nothing'}) — the rule is vacuous")
+    hyg = dsan.fingerprint_hygiene_diagnostics(
+        deterministic=["plan_hash", "submit_time_ms"],
+        timing=["submit_time_ms"])
+    if sum(d.code == "TPU-L017" for d in hyg) < 1:
+        failures += 1
+        print("DSAN: L017 did not flag an overlapping volatile "
+              "fingerprint field — the hygiene check is vacuous")
+    hyg = dsan.fingerprint_hygiene_diagnostics(
+        deterministic=["plan_hash", "wall_start"], timing=[])
+    if sum(d.code == "TPU-L017" for d in hyg) < 1:
+        failures += 1
+        print("DSAN: L017 did not flag a time-derived deterministic "
+              "fingerprint field — the hygiene check is vacuous")
+
+    def _inject_plan(stable):
+        """scan(batch_rows=1) -> PARTIAL float Sum -> hash exchange.
+        With stable_merge off the partial's float buffers fold in batch
+        arrival order — the canonical L016 hazard; the data is chosen so
+        a reversed arrival changes the sum ((1e16 - 1e16) + 1 = 1 but
+        (1 - 1e16) + 1e16 = 0 in float64)."""
+        tbl = pa.table({
+            "k": pa.array([0, 0, 0], type=pa.int64()),
+            "v": pa.array([1e16, -1e16, 1.0], type=pa.float64()),
+        })
+        scan = LocalScanExec(tbl, num_partitions=1, batch_rows=1)
+        scan.placement = eb.CPU
+        partial = TpuHashAggregateExec(
+            [AttributeReference("k")],
+            [AggregateExpression(Sum(AttributeReference("v")))],
+            PARTIAL, scan)
+        partial.placement = eb.CPU
+        partial.stable_merge = stable
+        ex = ShuffleExchangeExec(
+            HashPartitioning([AttributeReference("k")], 2), partial)
+        ex.placement = eb.CPU
+        return ex, scan
+
+    bad_ex, _ = _inject_plan(stable=False)
+    got = {d.code for d in lint_plan(bad_ex, RapidsConf({}))}
+    if "TPU-L016" not in got:
+        failures += 1
+        print(f"DSAN: the stable_merge=off float partial did not trip "
+              f"TPU-L016 (got {sorted(got)}) — the rule is vacuous")
+    clean_ex, _ = _inject_plan(stable=True)
+    got = {d.code for d in lint_plan(clean_ex, RapidsConf({}))}
+    if "TPU-L016" in got:
+        failures += 1
+        print("DSAN: the canonical-merge twin tripped TPU-L016 — "
+              "false positive on the clean shape")
+
+    # -- leg 3: the permuted-replay oracle over golden exchange sites -------
+    def _walk(node):
+        yield node
+        for c in node.children:
+            yield from _walk(c)
+
+    def _prep_scans(root, batch_rows, extra_parts=0):
+        """Deterministic chunking for the oracle: fixed batch_rows so
+        legs differ ONLY in what the leg varies; pin caches off so
+        every leg rereads the table."""
+        for n in _walk(root):
+            if isinstance(n, LocalScanExec):
+                n.batch_rows = batch_rows
+                n.pin_cache = None
+                n._num_partitions += extra_parts
+
+    class _Permuted(eb.Exec):
+        """Adversarial scheduler: replays the child's batches in
+        reversed arrival order.  Exactly the perturbation an
+        order_stable claim promises immunity to, so the wrapper itself
+        declares nothing."""
+
+        def __init__(self, inner):
+            super().__init__([inner])
+            self.placement = inner.placement
+
+        @property
+        def output_names(self):
+            return self.children[0].output_names
+
+        @property
+        def output_types(self):
+            return self.children[0].output_types
+
+        def execute_partition(self, pid, ctx):
+            return iter(list(
+                self.children[0].execute_partition(pid, ctx))[::-1])
+
+    def _permute_scans(root):
+        for n in list(_walk(root)):
+            if isinstance(n, _Permuted):
+                continue
+            for i, c in enumerate(n.children):
+                if isinstance(c, LocalScanExec):
+                    n.children[i] = _Permuted(c)
+
+    def _run_exchange(ex, conf_map):
+        """Drive ONE exchange's map write and harvest its content
+        addressing: recorded write-time digests per (map, reduce), a
+        row-multiset digest per (map, reduce), the per-reduce row fold,
+        and any recorded-vs-recomputed digest mismatches."""
+        conf = RapidsConf(dict(conf_map))
+        ctx = eb.ExecContext(conf)
+        ctx.task_context["no_speculation"] = True
+        ex._ensure_written(ctx)
+        sid = ex._shuffle_id
+        mgr = TpuShuffleManager.get()
+        blockdg = {}   # (mid, rid) -> Counter of recorded block digests
+        for ((_, mid, rid), _idx), dg in \
+                mgr.catalog.digests_for_shuffle(sid).items():
+            blockdg.setdefault((mid, rid), Counter())[dg] += 1
+        rowdg = {}     # (mid, rid) -> u64 row-multiset fold
+        reduce_fold = {}  # rid -> u64 row fold across all maps
+        bad_records = []
+        for rid in range(ex.num_partitions):
+            for blk in mgr.catalog.blocks_for_reduce(sid, rid):
+                for i, sb in enumerate(mgr.catalog.get(blk)):
+                    rb = materialize_block(sb, np)
+                    recorded = mgr.catalog.digest(blk, i)
+                    recomputed = block_digest(rb)
+                    if recorded != recomputed:
+                        bad_records.append((tuple(blk), i, recorded,
+                                            recomputed))
+                    rd = row_multiset_digest(rb)
+                    key = (blk[1], rid)
+                    rowdg[key] = (rowdg.get(key, 0) + rd) \
+                        & 0xFFFFFFFFFFFFFFFF
+                    reduce_fold[rid] = (reduce_fold.get(rid, 0) + rd) \
+                        & 0xFFFFFFFFFFFFFFFF
+        mgr.unregister(sid)
+        return blockdg, rowdg, reduce_fold, bad_records
+
+    from spark_rapids_tpu.analysis.determinism import (BIT_EXACT,
+                                                       ORDER_STABLE,
+                                                       RANK)
+
+    with SpillCatalog._lock:
+        SpillCatalog._instance = SpillCatalog()
+    TpuShuffleManager.reset()
+
+    good = _builders(os.path.join(GOLDEN, "good_plans.py"))
+    oracle_sites = 0
+    split_skips = 0
+    for name in ("plan_partial_final_aggregate",
+                 "plan_colocated_join_with_exchanges",
+                 "plan_exchange_fully_read"):
+        roots = {}
+        for leg in ("A", "B", "C"):
+            root, conf_map = good[name]()
+            _prep_scans(root, batch_rows=5,
+                        extra_parts=1 if leg == "C" else 0)
+            if leg == "C":
+                _prep_scans(root, batch_rows=7)
+            if leg == "B":
+                _permute_scans(root)
+            roots[leg] = (root, conf_map)
+        res = dsan.classify_plan(roots["A"][0],
+                                 RapidsConf(dict(roots["A"][1])))
+        exchanges = {leg: [n for n in _walk(roots[leg][0])
+                           if isinstance(n, ShuffleExchangeExec)]
+                     for leg in roots}
+        for i, exa in enumerate(exchanges["A"]):
+            oracle_sites += 1
+            child = exa.children[0]
+            claim = res.effective(child)
+            scoped = res.is_partition_scoped(child)
+            if RANK[claim] < RANK[ORDER_STABLE]:
+                failures += 1
+                print(f"DSAN: {name} exchange[{i}] subtree claims "
+                      f"{claim} ({res.reason(child)}) — golden plans "
+                      f"must replay order_stable or better")
+                continue
+            A = _run_exchange(exa, roots["A"][1])
+            B = _run_exchange(exchanges["B"][i], roots["B"][1])
+            C = _run_exchange(exchanges["C"][i], roots["C"][1])
+            for leg, r in (("A", A), ("B", B), ("C", C)):
+                for blk, idx, rec, comp in r[3]:
+                    failures += 1
+                    print(f"DSAN: {name} exchange[{i}] leg {leg}: "
+                          f"recorded digest {rec:#018x} != recomputed "
+                          f"{comp:#018x} for block {blk}[{idx}] — "
+                          f"write-time recording drifted")
+            if claim == BIT_EXACT and A[0] != B[0]:
+                failures += 1
+                print(f"DSAN: {name} exchange[{i}]: subtree claims "
+                      f"bit_exact but permuted arrival changed the "
+                      f"per-(map,reduce) block-digest multisets")
+            if A[1] != B[1]:
+                failures += 1
+                print(f"DSAN: {name} exchange[{i}]: subtree claims "
+                      f"{claim} but permuted arrival changed the "
+                      f"per-(map,reduce) row-multiset digests — "
+                      f"recomputed blocks would not match the lost "
+                      f"ones")
+            if scoped:
+                split_skips += 1
+                print(f"DSAN: note: {name} exchange[{i}] changed-split "
+                      f"leg skipped — the subtree is partition-scoped "
+                      f"(partial buffers regroup with the input "
+                      f"split); arrival-permutation still enforced")
+            elif A[2] != C[2]:
+                failures += 1
+                print(f"DSAN: {name} exchange[{i}]: a changed input "
+                      f"split altered the per-reduce row multisets — "
+                      f"hash routing must be content-determined")
+
+    # -- leg 4a: dynamic anti-vacuity — arrival-order float sum -------------
+    ex_fwd, _ = _inject_plan(stable=False)
+    ex_rev, scan_rev = _inject_plan(stable=False)
+    agg_rev = ex_rev.children[0]
+    agg_rev.children[0] = _Permuted(scan_rev)
+    F = _run_exchange(ex_fwd, {})
+    R = _run_exchange(ex_rev, {})
+    if F[1] == R[1]:
+        failures += 1
+        print("DSAN: the stable_merge=off float sum digested "
+              "IDENTICALLY under reversed arrival — the dynamic "
+              "oracle cannot see arrival-order nondeterminism "
+              "(vacuous)")
+
+    # -- leg 4b: dynamic anti-vacuity — PYTHONHASHSEED set routing ----------
+    got = {d.code for d in dsan.module_diagnostics(
+        _DSAN_HASHSEED_SRC, "spark_rapids_tpu/shuffle/injected.py",
+        rules=("TPU-R015",))}
+    if "TPU-R015" not in got:
+        failures += 1
+        print("DSAN: the set-iteration router source did not trip "
+              "TPU-R015 statically")
+    runs = []
+    for seed in ("1", "2"):
+        env = dict(os.environ, PYTHONHASHSEED=seed,
+                   JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        p = subprocess.run([sys.executable, "-c", _DSAN_HASHSEED_SRC],
+                           capture_output=True, text=True, env=env,
+                           cwd=REPO, timeout=300)
+        if p.returncode != 0:
+            failures += 1
+            print(f"DSAN: hashseed probe (seed {seed}) failed: "
+                  f"{p.stderr.strip()[-400:]}")
+            runs.append(None)
+        else:
+            runs.append(json.loads(p.stdout.strip().splitlines()[-1]))
+    if None not in runs and runs[0] == runs[1]:
+        failures += 1
+        print("DSAN: set-iteration routing digested IDENTICALLY under "
+              "two PYTHONHASHSEEDs — the digest oracle cannot see "
+              "hash-order nondeterminism (vacuous)")
+
+    if failures:
+        print(f"dsan gate: {failures} failure(s)")
+        return 1
+    print(f"dsan gate clean (repo determinism pass finding-free with "
+          f"zero frozen debt; R015/R016/L017/L016 fixtures all trip "
+          f"with the canonical-merge twin clean; {oracle_sites} golden "
+          f"exchange sites digest-identical under permuted arrival "
+          f"and changed split ({split_skips} partition-scoped "
+          f"split-leg skip(s)); both planted nondeterminism "
+          f"injections visible to the dynamic oracle)")
+    return 0
+
+
 def main(argv=None):
     args = argv if argv is not None else sys.argv[1:]
     if "--interp" in args:
@@ -2931,6 +3336,8 @@ def main(argv=None):
         return run_hbm_gate()
     if "--faults" in args:
         return run_faults_gate()
+    if "--dsan" in args:
+        return run_dsan_gate()
     from spark_rapids_tpu.tools.__main__ import main as tools_main
     cli = ["lint", "--repo", "--baseline", BASELINE]
     if "--update-baseline" in args:

@@ -1,0 +1,680 @@
+"""tpudsan: determinism & replay-safety pass.
+
+ROADMAP's lineage-based recovery ("recompute lost map partitions") and
+fingerprint-keyed fragment caching both assume a recomputed plan
+fragment reproduces its output bit-for-bit.  The reference gets that by
+convention; this pass gets it by proof, the same way tmsan proved memory
+bounds and tpufsan proved typed exception flow: operators DECLARE a
+replay class via ``Exec.determinism()``, the pass composes the
+declarations bottom-up alongside the interp's schema/residency states,
+and the permuted-replay oracle (``devtools/run_lint.py --dsan``) keeps
+the declarations honest by replaying golden map stages under permuted
+batch arrival order and a changed input split, asserting
+content-digest-identical shuffle blocks wherever ``order_stable`` or
+better is claimed.
+
+The replay-class lattice (strongest first):
+
+  bit_exact        recompute reproduces the output bytes exactly,
+                   whatever the batch arrival order or input split
+  order_stable     the output MULTISET per partition is invariant under
+                   batch arrival order and input-split changes; row
+                   order within a partition may differ (hash-table
+                   emission order, probe order)
+  order_dependent  output VALUES depend on arrival order — e.g. a float
+                   accumulation whose grouping follows batch arrival
+  nondeterministic RNG, wall clock, or iteration-order effects: two
+                   runs may disagree on content
+
+Rules:
+
+  TPU-L016  a subtree feeding an exchange or cacheable fragment is
+            weaker than order_stable without a stabilizing barrier;
+            repairable when the weakness is a canonicalizable merge
+            (``try_stabilize_repair`` forces the aggregate's keyed
+            canonical merge, the same pre-flight shape as the L014
+            out-of-core repair)
+  TPU-L017  a plan-fragment fingerprint field in obs/history.py
+            incorporates a volatile input (wall-clock, session-local
+            state), so a fingerprint-keyed cache hit could serve stale
+            or unreproducible data
+  TPU-R015  wall-clock / unseeded RNG / set-iteration order / id()-keyed
+            ordering on a result-affecting path in exec/, ops/, expr/
+            or shuffle/ without a sanctioned helper
+  TPU-R016  a float reduction folded in batch-arrival order (no declared
+            tolerance, no canonical keyed merge): partials regrouped by
+            a different split or arrival order change the result
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .diagnostics import Diagnostic, ERROR, register_rule
+
+# ---------------------------------------------------------------------------
+# rule registrations
+# ---------------------------------------------------------------------------
+
+L016 = register_rule(
+    "TPU-L016", ERROR, "replay-unstable subtree feeds an exchange",
+    "The subtree below a shuffle/ICI exchange or cache write composes to "
+    "a replay class weaker than order_stable: a recomputed map task "
+    "(lineage recovery) or a fingerprint-keyed cache hit would not "
+    "reproduce the blocks it replaces.  Repairable when the weakness is "
+    "a canonicalizable merge — the pre-flight forces the aggregate's "
+    "keyed canonical merge (stable_merge), the same downgrade machinery "
+    "as TPU-L011/L014.")
+
+L017 = register_rule(
+    "TPU-L017", ERROR, "volatile input in a plan-fragment fingerprint",
+    "A field of the query/fragment fingerprint (obs/history.py "
+    "DETERMINISTIC_FIELDS) incorporates a volatile input — wall-clock, "
+    "timing, session-local state — so two identical plans fingerprint "
+    "differently (cache misses) or two different executions collide "
+    "(stale cache hits).  Deterministic and timing field sets must be "
+    "disjoint and deterministic field names must not be time-derived.")
+
+R015 = register_rule(
+    "TPU-R015", ERROR, "volatile source on a result-affecting path",
+    "Wall-clock reads (time.time/time_ns, datetime.now/utcnow), "
+    "unseeded RNG (random.*, np.random.* without an explicit seed), "
+    "iteration over a set (PYTHONHASHSEED-dependent order across "
+    "processes), or id()-keyed sorting inside exec/, ops/, expr/ or "
+    "shuffle/: any of these on a result path makes a recomputed "
+    "partition differ from the lost one.  Seeded generators "
+    "(np.random.RandomState(seed), random.Random(seed)) and "
+    "sorted(set(...)) are sanctioned; deliberate sites are annotated "
+    "`# tpulint: allow[TPU-R015]` in place.")
+
+R016 = register_rule(
+    "TPU-R016", ERROR, "arrival-order float accumulation",
+    "A float value is folded (`+=`) across batches in arrival order "
+    "inside exec/: float addition is not associative, so a different "
+    "batch arrival order or input split changes the result.  Declare a "
+    "tolerance, canonicalize with a keyed merge "
+    "(TpuHashAggregateExec.stable_merge), or tree-reduce in a "
+    "content-determined order.  Deliberate sites are annotated "
+    "`# tpulint: allow[TPU-R016]` in place.")
+
+# ---------------------------------------------------------------------------
+# the replay-class lattice
+# ---------------------------------------------------------------------------
+
+BIT_EXACT = "bit_exact"
+ORDER_STABLE = "order_stable"
+ORDER_DEPENDENT = "order_dependent"
+NONDETERMINISTIC = "nondeterministic"
+
+RANK = {BIT_EXACT: 3, ORDER_STABLE: 2, ORDER_DEPENDENT: 1,
+        NONDETERMINISTIC: 0}
+CLASSES = (BIT_EXACT, ORDER_STABLE, ORDER_DEPENDENT, NONDETERMINISTIC)
+
+
+def meet(a: str, b: str) -> str:
+    """Weaker of two replay classes (lattice meet)."""
+    return a if RANK[a] <= RANK[b] else b
+
+
+class Determinism:
+    """One operator's declared replay behavior.
+
+    `cls` is the operator's own contribution assuming its inputs arrive
+    bit-identically; composition with the children happens in
+    ``classify_plan``.  `order_sensitive_selection` marks operators
+    whose output CONTENT depends on input row order (limits, offset-
+    keyed sampling) — sound only above an order-establishing sort, else
+    the effective class degrades to order_dependent.
+    `establishes_order` marks operators whose output row order is a
+    function of content (sorts), which is what makes a selection above
+    them stable and satisfies the TPU-L016 barrier requirement.
+    `partition_scoped` marks operators whose output values depend on
+    the partition grouping itself (PARTIAL-mode aggregates): the
+    permuted-replay oracle skips the changed-split leg for such
+    subtrees (arrival-permutation identity is still asserted).
+    `canonicalizable` marks a weakness ``try_stabilize_repair`` can fix
+    by forcing the operator's canonical keyed merge."""
+
+    __slots__ = ("cls", "reason", "order_sensitive_selection",
+                 "establishes_order", "partition_scoped",
+                 "canonicalizable")
+
+    def __init__(self, cls: str, reason: str = "",
+                 order_sensitive_selection: bool = False,
+                 establishes_order: bool = False,
+                 partition_scoped: bool = False,
+                 canonicalizable: bool = False):
+        if cls not in RANK:
+            raise ValueError(f"unknown replay class {cls!r}")
+        self.cls = cls
+        self.reason = reason
+        self.order_sensitive_selection = order_sensitive_selection
+        self.establishes_order = establishes_order
+        self.partition_scoped = partition_scoped
+        self.canonicalizable = canonicalizable
+
+    def __repr__(self):
+        return f"Determinism({self.cls!r}, {self.reason!r})"
+
+
+_DEFAULT = Determinism(BIT_EXACT, "pure streaming operator (default)")
+
+
+def node_determinism(node) -> Determinism:
+    """An operator's declaration, defaulted: None means pure streaming
+    (row-wise function of input, no order/time/RNG sensitivity)."""
+    d = node.determinism()
+    return d if d is not None else _DEFAULT
+
+
+# ---------------------------------------------------------------------------
+# bottom-up composition over a physical plan
+# ---------------------------------------------------------------------------
+
+class DeterminismResult:
+    """Per-node effective replay classes for one plan, plus the TPU-L016
+    diagnostics.  `classes[id(node)]` is the class of the SUBTREE rooted
+    at node (own declaration met with every child's effective class)."""
+
+    def __init__(self):
+        self.classes: Dict[int, str] = {}
+        self.reasons: Dict[int, str] = {}
+        self.partition_scoped: Dict[int, bool] = {}
+        self.repairs: List[str] = []
+        self.diags: List[Diagnostic] = []
+
+    def effective(self, node) -> str:
+        return self.classes.get(id(node), NONDETERMINISTIC)
+
+    def reason(self, node) -> str:
+        return self.reasons.get(id(node), "")
+
+    def is_partition_scoped(self, node) -> bool:
+        return self.partition_scoped.get(id(node), False)
+
+
+def _is_fragment_boundary(node) -> bool:
+    """Nodes whose child subtree must replay order_stable or better:
+    exchange writes (lineage recovery recomputes the map side) and
+    cache writes (fingerprint-keyed reuse serves the stored blocks)."""
+    from ..io.cached_batch import CacheWriteExec
+    from ..parallel.ici_exec import IciExchangeExec
+    from ..shuffle.exchange import ShuffleExchangeExec
+    return isinstance(node, (ShuffleExchangeExec, IciExchangeExec,
+                             CacheWriteExec))
+
+
+def _classify(node, res: DeterminismResult) -> str:
+    child_eff = [_classify(c, res) for c in node.children]
+    d = node_determinism(node)
+    own, reason = d.cls, d.reason
+    if d.order_sensitive_selection and node.children and \
+            not all(node_determinism(c).establishes_order
+                    for c in node.children):
+        if RANK[own] > RANK[ORDER_DEPENDENT]:
+            own = ORDER_DEPENDENT
+            reason = (f"{node.name}: order-sensitive selection with no "
+                      f"order-establishing sort below — which rows are "
+                      f"selected follows batch arrival")
+    eff = own
+    weakest = f"{node.name}: {reason}" if reason else node.name
+    for c, ce in zip(node.children, child_eff):
+        if RANK[ce] < RANK[eff]:
+            eff, weakest = ce, res.reasons[id(c)]
+    scoped = d.partition_scoped or \
+        any(res.partition_scoped[id(c)] for c in node.children)
+    res.classes[id(node)] = eff
+    res.reasons[id(node)] = weakest if RANK[eff] < RANK[BIT_EXACT] \
+        else f"{node.name}: {reason}" if reason else ""
+    res.partition_scoped[id(node)] = scoped
+    return eff
+
+
+def classify_plan(root, conf=None) -> DeterminismResult:
+    """Compose declared replay classes bottom-up and emit TPU-L016 for
+    every fragment boundary whose input subtree is weaker than
+    order_stable.  Pure — never mutates the plan (the repair lives in
+    ``try_stabilize_repair``, applied by the pre-flight)."""
+    res = DeterminismResult()
+    _classify(root, res)
+    _emit_l016(root, res, path="")
+    return res
+
+
+def _emit_l016(node, res: DeterminismResult, path: str) -> None:
+    here = f"{path} > {node.name}" if path else node.name
+    if _is_fragment_boundary(node) and node.children:
+        child = node.children[0]
+        eff = res.effective(child)
+        if RANK[eff] < RANK[ORDER_STABLE]:
+            fix = ", ".join(_canonical_sites(child))
+            hint = (f" — repairable: force the canonical keyed merge on "
+                    f"[{fix}]" if fix else
+                    " — no stabilizing barrier available; recomputed "
+                    "blocks may not match the lost ones")
+            res.diags.append(L016.diag(
+                f"subtree feeding {node.name} composes to {eff} "
+                f"({res.reason(child)}); lineage recovery and "
+                f"fingerprint-keyed caching need order_stable or "
+                f"better{hint}", loc=here, node=node))
+    for c in node.children:
+        _emit_l016(c, res, here)
+
+
+def _canonical_sites(node) -> List[str]:
+    out = []
+    if node_determinism(node).canonicalizable:
+        out.append(node.name)
+    for c in node.children:
+        out.extend(_canonical_sites(c))
+    return out
+
+
+def try_stabilize_repair(root, node, conf) -> bool:
+    """TPU-L016 repair: force the canonical keyed merge on every
+    canonicalizable operator under the flagged boundary `node`
+    (aggregate ``stable_merge`` — sorts partial buffers by group key +
+    value words before folding, making the accumulation order a
+    function of content, not arrival).  Returns True when the subtree
+    re-classifies to order_stable or better; the caller treats that
+    like the L014 out-of-core repair (no host flip needed)."""
+    flipped = []
+
+    def force(n):
+        if node_determinism(n).canonicalizable and \
+                getattr(n, "stable_merge", True) is False:
+            n.stable_merge = True
+            n.__dict__.pop("_jit_key", None)  # invalidate cached_property
+            flipped.append(n)
+        for c in n.children:
+            force(c)
+
+    force(node)
+    if not flipped:
+        return False
+    res = DeterminismResult()
+    child = node.children[0] if node.children else node
+    eff = _classify(child, res)
+    if RANK[eff] >= RANK[ORDER_STABLE]:
+        return True
+    for n in flipped:  # repair did not reach order_stable: undo
+        n.stable_merge = False
+        n.__dict__.pop("_jit_key", None)
+    return False
+
+
+def format_classes(root, conf=None) -> str:
+    """Human-oriented per-subtree replay classes (the --determinism
+    plan-mode printer, sibling of interp.format_states)."""
+    res = classify_plan(root, conf)
+    lines: List[str] = []
+
+    def walk(node, depth):
+        eff = res.effective(node)
+        own = node_determinism(node)
+        extra = ""
+        if RANK[eff] < RANK[BIT_EXACT] and res.reason(node):
+            extra = f"  <- {res.reason(node)}"
+        if res.is_partition_scoped(node):
+            extra += "  [partition-scoped]"
+        lines.append(f"{'  ' * depth}{node.name}: {eff}"
+                     f" (declares {own.cls}){extra}")
+        for c in node.children:
+            walk(c, depth + 1)
+
+    walk(root, 0)
+    for d in res.diags:
+        lines.append(d.render())
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# TPU-L017: fingerprint hygiene (obs/history.py)
+# ---------------------------------------------------------------------------
+
+_VOLATILE_FIELD = re.compile(
+    r"(wall|time|_ms($|_)|_ns($|_)|seconds|session|pid|stamp|random|"
+    r"uptime)", re.I)
+
+
+def fingerprint_hygiene_diagnostics(
+        deterministic: Optional[Iterable[str]] = None,
+        timing: Optional[Iterable[str]] = None) -> List[Diagnostic]:
+    """TPU-L017 over the live fingerprint schema: the deterministic
+    field set (what fragment caching keys on) must be disjoint from the
+    timing set and free of volatile names.  Parameters are injectable
+    so the gate can prove the check is not vacuous."""
+    if deterministic is None or timing is None:
+        from ..obs import history
+        deterministic = history.DETERMINISTIC_FIELDS
+        timing = history.TIMING_FIELDS
+    loc = "spark_rapids_tpu/obs/history.py"
+    diags: List[Diagnostic] = []
+    overlap = sorted(set(deterministic) & set(timing))
+    for f in overlap:
+        diags.append(L017.diag(
+            f"fingerprint field {f} is listed both deterministic and "
+            f"timing: a cache keyed on it would miss on identical "
+            f"plans and collide across executions", loc=loc))
+    for f in deterministic:
+        if f in overlap:
+            continue
+        if _VOLATILE_FIELD.search(f):
+            diags.append(L017.diag(
+                f"deterministic fingerprint field {f} looks "
+                f"time-derived; a fingerprint-keyed cache hit could "
+                f"serve stale data", loc=loc))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# TPU-R015/R016: the repo AST pass
+# ---------------------------------------------------------------------------
+
+_R015_PATHS = ("spark_rapids_tpu/exec/", "spark_rapids_tpu/ops/",
+               "spark_rapids_tpu/expr/", "spark_rapids_tpu/shuffle/")
+_R016_PATHS = ("spark_rapids_tpu/exec/",)
+
+# np.random constructors that take an explicit seed are the sanctioned
+# route (serve_map's RandomState(seed) synthetic-data generator)
+_SEEDED_NP_RANDOM = {"RandomState", "default_rng", "SeedSequence",
+                     "Generator"}
+_WALL_CLOCK = {"time", "time_ns"}
+
+
+def _func_chain(f) -> List[str]:
+    """Dotted name parts of a call target, outermost first."""
+    parts: List[str] = []
+    while isinstance(f, ast.Attribute):
+        parts.append(f.attr)
+        f = f.value
+    if isinstance(f, ast.Name):
+        parts.append(f.id)
+    return list(reversed(parts))
+
+
+class _VolatileSourceVisitor:
+    """TPU-R015 over one module (scope tracking via repo_lint's
+    _ScopedVisitor, shared with every other repo rule)."""
+
+    def __init__(self, relpath: str):
+        from .repo_lint import _ScopedVisitor
+        outer = self
+
+        class V(_ScopedVisitor):
+            def visit_Call(self, node):
+                outer._call(node, self.scope)
+                self.generic_visit(node)
+
+            def visit_For(self, node):
+                outer._iter(node.iter, self.scope)
+                self.generic_visit(node)
+
+            def visit_comprehension(self, node):
+                outer._iter(node.iter, self.scope)
+                self.generic_visit(node)
+
+        self.relpath = relpath
+        self.diags: List[Diagnostic] = []
+        self._v = V()
+
+    def visit(self, tree):
+        self._v.visit(tree)
+
+    def _diag(self, msg: str, scope: str, lineno: int):
+        self.diags.append(R015.diag(
+            f"{msg} in {scope}", loc=f"{self.relpath}:{lineno}"))
+
+    def _call(self, node, scope: str):
+        chain = _func_chain(node.func)
+        if not chain:
+            return
+        head, tail = chain[0].lstrip("_"), chain[-1]
+        if head == "time" and len(chain) == 2 and tail in _WALL_CLOCK:
+            self._diag(f"wall-clock read time.{tail}() on a result "
+                       f"path", scope, node.lineno)
+        elif tail in ("now", "utcnow") and "datetime" in chain:
+            self._diag(f"wall-clock read {'.'.join(chain)}() on a "
+                       f"result path", scope, node.lineno)
+        elif head == "random" and len(chain) == 2 and \
+                tail not in ("Random", "SystemRandom"):
+            self._diag(f"unseeded RNG random.{tail}()", scope,
+                       node.lineno)
+        elif len(chain) >= 3 and chain[-2] == "random" and \
+                chain[0].lstrip("_") in ("np", "numpy") and \
+                tail not in _SEEDED_NP_RANDOM:
+            self._diag(f"unseeded RNG {'.'.join(chain)}()", scope,
+                       node.lineno)
+        elif tail in ("sorted", "sort") and any(
+                kw.arg == "key" and isinstance(kw.value, ast.Name) and
+                kw.value.id == "id" for kw in node.keywords):
+            self._diag("id()-keyed sort: addresses differ across "
+                       "processes and replays", scope, node.lineno)
+
+    def _iter(self, it, scope: str):
+        if isinstance(it, ast.Set):
+            self._diag("iteration over a set literal "
+                       "(PYTHONHASHSEED-dependent order)", scope,
+                       it.lineno)
+        elif isinstance(it, ast.Call):
+            chain = _func_chain(it.func)
+            if chain and chain[-1] in ("set", "frozenset") and \
+                    len(chain) == 1:
+                self._diag(f"iteration over {chain[-1]}() "
+                           f"(PYTHONHASHSEED-dependent order); wrap in "
+                           f"sorted()", scope, it.lineno)
+
+
+_ARRIVAL_NAME = re.compile(
+    r"(^|_)(batch(es)?|block(s)?|partial(s)?|chunk(s)?|mats?|streams?)$",
+    re.I)
+_ARRIVAL_CALLS = {"execute_partition", "blocks", "read_reduce_blocks",
+                  "blocks_for_reduce"}
+# integer bookkeeping folded across batches is fine — only value-level
+# float folds regroup under a different split
+_BOOKKEEPING = re.compile(
+    r"(rows|bytes|offset|idx|index|pos|base|seen|done|len)", re.I)
+
+
+def _is_arrival_iter(it) -> Optional[str]:
+    if isinstance(it, ast.Name) and _ARRIVAL_NAME.search(it.id):
+        return it.id
+    if isinstance(it, ast.Call):
+        chain = _func_chain(it.func)
+        if chain and chain[-1] in _ARRIVAL_CALLS:
+            return f"{chain[-1]}()"
+    return None
+
+
+class _ArrivalFoldVisitor:
+    """TPU-R016 over one module: `acc += f(batch)` inside a for-loop
+    over an arrival-ordered source, where acc is not integer
+    bookkeeping — the float-fold order then equals arrival order."""
+
+    def __init__(self, relpath: str):
+        from .repo_lint import _ScopedVisitor, _is_tally_name
+        outer = self
+        self._is_tally = _is_tally_name
+
+        class V(_ScopedVisitor):
+            def visit_For(self, node):
+                outer._for(node, self.scope)
+                self.generic_visit(node)
+
+        self.relpath = relpath
+        self.diags: List[Diagnostic] = []
+        self._v = V()
+
+    def visit(self, tree):
+        self._v.visit(tree)
+
+    def _for(self, node, scope: str):
+        src = _is_arrival_iter(node.iter)
+        if src is None:
+            return
+        loop_names = {n.id for n in ast.walk(node.target)
+                      if isinstance(n, ast.Name)}
+        for stmt in ast.walk(node):
+            if not (isinstance(stmt, ast.AugAssign) and
+                    isinstance(stmt.op, ast.Add)):
+                continue
+            tgt = stmt.target
+            name = tgt.id if isinstance(tgt, ast.Name) else \
+                tgt.attr if isinstance(tgt, ast.Attribute) else None
+            if name is None or self._is_tally(name) or \
+                    _BOOKKEEPING.search(name):
+                continue
+            refs = {n.id for n in ast.walk(stmt.value)
+                    if isinstance(n, ast.Name)}
+            if not (refs & loop_names):
+                continue
+            if isinstance(stmt.value, ast.Call):
+                chain = _func_chain(stmt.value.func)
+                if chain and chain[-1] in ("int", "len", "list",
+                                           "tuple"):
+                    continue
+            self.diags.append(R016.diag(
+                f"{name} += folded across {src} in arrival order in "
+                f"{scope}: float accumulation order follows batch "
+                f"arrival — canonicalize (keyed merge / tree reduce) "
+                f"or declare a tolerance",
+                loc=f"{self.relpath}:{stmt.lineno}"))
+
+
+def repo_diagnostics(root: Optional[str] = None) -> List[Diagnostic]:
+    """TPU-R015/R016 over the package source plus the TPU-L017
+    fingerprint-hygiene registry check; appended to lint_repo like the
+    tpucsan and tpufsan passes."""
+    from .repo_lint import _allowed_lines, _package_root, _py_files
+    root = root or _package_root()
+    diags: List[Diagnostic] = []
+    for path in _py_files(root):
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        r015 = any(relpath.startswith(p) for p in _R015_PATHS)
+        r016 = any(relpath.startswith(p) for p in _R016_PATHS)
+        if not (r015 or r016):
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=relpath)
+        except SyntaxError:
+            continue  # TPU-R000 already reported by the core pass
+        file_diags: List[Diagnostic] = []
+        if r015:
+            v = _VolatileSourceVisitor(relpath)
+            v.visit(tree)
+            file_diags.extend(v.diags)
+        if r016:
+            fv = _ArrivalFoldVisitor(relpath)
+            fv.visit(tree)
+            file_diags.extend(fv.diags)
+        allowed = _allowed_lines(source) if file_diags else {}
+        for d in file_diags:
+            lineno = int(d.loc.rsplit(":", 1)[-1]) if ":" in d.loc else -1
+            if lineno in allowed.get(d.code, ()):
+                continue
+            diags.append(d)
+    diags.extend(fingerprint_hygiene_diagnostics())
+    return diags
+
+
+def module_diagnostics(source: str, relpath: str,
+                       rules: Tuple[str, ...] = ("TPU-R015", "TPU-R016")
+                       ) -> List[Diagnostic]:
+    """Run the R015/R016 visitors against one synthetic source (test
+    fixtures, the --dsan anti-vacuity injections)."""
+    tree = ast.parse(source, filename=relpath)
+    diags: List[Diagnostic] = []
+    if "TPU-R015" in rules:
+        v = _VolatileSourceVisitor(relpath)
+        v.visit(tree)
+        diags.extend(v.diags)
+    if "TPU-R016" in rules:
+        fv = _ArrivalFoldVisitor(relpath)
+        fv.visit(tree)
+        diags.extend(fv.diags)
+    allowed = _allowed_lines_of(source)
+    out = []
+    for d in diags:
+        lineno = int(d.loc.rsplit(":", 1)[-1]) if ":" in d.loc else -1
+        if lineno in allowed.get(d.code, ()):
+            continue
+        out.append(d)
+    return out
+
+
+def _allowed_lines_of(source: str) -> dict:
+    from .repo_lint import _allowed_lines
+    return _allowed_lines(source)
+
+
+# ---------------------------------------------------------------------------
+# repo-level artifact (tools lint --determinism)
+# ---------------------------------------------------------------------------
+
+def determinism_artifact() -> dict:
+    """Declared replay classes for every registered operator class plus
+    the fingerprint-hygiene status — the tpudsan analog of the raise
+    graph / lock graph artifacts.  Class-level: operators whose
+    declaration depends on instance state (aggregates) report
+    'dynamic'."""
+    import importlib
+    import inspect
+
+    from ..exec.base import Exec
+    decls: Dict[str, str] = {}
+    mods = ("spark_rapids_tpu.exec.base", "spark_rapids_tpu.exec.basic",
+            "spark_rapids_tpu.exec.aggregate", "spark_rapids_tpu.exec.sort",
+            "spark_rapids_tpu.exec.join", "spark_rapids_tpu.exec.window",
+            "spark_rapids_tpu.exec.broadcast", "spark_rapids_tpu.exec.concat",
+            "spark_rapids_tpu.exec.expand", "spark_rapids_tpu.exec.gatherpart",
+            "spark_rapids_tpu.exec.outofcore",
+            "spark_rapids_tpu.exec.pandas_udf",
+            "spark_rapids_tpu.exec.python_udf",
+            "spark_rapids_tpu.shuffle.exchange", "spark_rapids_tpu.shuffle.aqe",
+            "spark_rapids_tpu.parallel.ici_exec",
+            "spark_rapids_tpu.io.cached_batch", "spark_rapids_tpu.io.scan")
+    for m in mods:
+        mod = importlib.import_module(m)
+        for name, cls in sorted(vars(mod).items()):
+            if not (inspect.isclass(cls) and issubclass(cls, Exec) and
+                    cls.__module__ == m) or name.startswith("_"):
+                continue
+            own = cls.determinism is not Exec.determinism
+            if not own:
+                decls[name] = f"{BIT_EXACT} (inherited default)"
+                continue
+            try:
+                d = cls.determinism(_ClassProbe(cls))
+                decls[name] = d.cls if d is not None else BIT_EXACT
+            except Exception:
+                decls[name] = "dynamic (instance-dependent)"
+    hygiene = fingerprint_hygiene_diagnostics()
+    return {
+        "lattice": list(CLASSES),
+        "declarations": decls,
+        "fingerprint_hygiene": [d.render() for d in hygiene],
+        "rules": {c: {"severity": r.severity, "title": r.title}
+                  for c, r in (("TPU-L016", L016), ("TPU-L017", L017),
+                               ("TPU-R015", R015), ("TPU-R016", R016))},
+    }
+
+
+class _ClassProbe:
+    """Minimal instance stand-in so class-level declarations that only
+    read class attributes can be probed without constructing the
+    operator; anything touching instance state raises and reports
+    'dynamic'."""
+
+    def __init__(self, cls):
+        self._cls = cls
+
+    def __getattr__(self, name):
+        v = getattr(self._cls, name, None)
+        if v is None or callable(v):
+            raise AttributeError(name)
+        return v

@@ -439,6 +439,17 @@ def lint_plan(root: eb.Exec, conf: cfg.RapidsConf,
                     "TPU-L000", INFO,
                     f"lint rule {check.__name__} failed: {ex}", loc=path))
     diags.extend(_check_compile_churn(conf, root))
+    if conf.get(cfg.DSAN_ENABLED):
+        # tpudsan replay-class composition (TPU-L016) rides the same
+        # pre-flight; a failed pass degrades like the interpreter
+        try:
+            from .determinism import classify_plan
+            diags.extend(classify_plan(root, conf).diags)
+        except Exception as ex:
+            diags.append(Diagnostic(
+                "TPU-L000", INFO,
+                f"determinism pass failed ({ex}); replay rules "
+                f"skipped", loc=root.name))
     disabled = conf.raw("spark.rapids.tpu.lint.disable", "") or ""
     return sort_diagnostics(filter_suppressed(diags, disabled.split(",")))
 
@@ -466,6 +477,18 @@ def downgrade_hazards(root: eb.Exec, diags: List[Diagnostic],
                         repaired.add(id(d.node))
                 except Exception:
                     pass  # fall through to the host flip
+        # TPU-L016 has its own in-place repair (force the aggregate's
+        # canonical keyed merge under the flagged boundary); a host
+        # flip would NOT help — order dependence is engine-independent
+        # — so L016 never joins the flip set below
+        from .determinism import try_stabilize_repair
+        for d in diags:
+            if d.code == "TPU-L016" and d.node is not None:
+                try:
+                    if try_stabilize_repair(root, d.node, conf):
+                        repaired.add(id(d.node))
+                except Exception:
+                    pass  # unrepairable: diagnostic stands
     flagged = {id(d.node) for d in diags
                if d.node is not None and d.is_error and
                d.code in DOWNGRADE_CODES and id(d.node) not in repaired}

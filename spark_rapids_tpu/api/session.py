@@ -26,6 +26,20 @@ from ..plan.planner import plan as plan_physical
 from .dataframe import DataFrame
 
 
+def _replay_class(plan, conf) -> str:
+    """The final plan's effective replay class (tpudsan lattice root),
+    stamped on the phase:overrides span so run fingerprints and the
+    failure black box can see recompute guarantees weaken across runs.
+    Best-effort: classification must never fail a query."""
+    try:
+        if not conf.get(cfg.DSAN_ENABLED):
+            return "unclassified"
+        from ..analysis.determinism import classify_plan
+        return classify_plan(plan, conf).effective(plan)
+    except Exception:
+        return "unclassified"
+
+
 class TpuSession:
     _active: Optional["TpuSession"] = None
     _lock = threading.Lock()
@@ -290,7 +304,8 @@ class TpuSession:
             final_plan = overrides.apply(physical)
             lint = getattr(overrides, "last_lint", [])
             sp.set(lint_diags=len(lint),
-                   lint_rules=sorted({d.code for d in lint}))
+                   lint_rules=sorted({d.code for d in lint}),
+                   replay_class=_replay_class(final_plan, self.conf))
         self.last_plan = final_plan
         self.last_explain = overrides.last_explain
         self._count_fallbacks(final_plan)

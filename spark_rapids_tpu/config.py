@@ -704,6 +704,34 @@ MEMSAN_HBM_BUDGET = conf("spark.rapids.tpu.memsan.hbmBudgetBytes").bytes() \
          "size).") \
     .create_optional()
 
+# --- determinism sanitizer (tpudsan) --------------------------------------
+
+DSAN_ENABLED = conf("spark.rapids.tpu.dsan.enabled").boolean() \
+    .doc("Run the determinism / replay-safety pass "
+         "(analysis/determinism.py) as part of the plan lint: every "
+         "operator's declared replay class (bit_exact > order_stable > "
+         "order_dependent > nondeterministic) is composed bottom-up and "
+         "a subtree feeding an exchange or cacheable fragment whose "
+         "class is weaker than order_stable raises TPU-L016 "
+         "(repairable by forcing the aggregate's canonical keyed "
+         "merge).  The permuted-replay oracle "
+         "(devtools/run_lint.py --dsan) keeps the declarations "
+         "honest.") \
+    .create_with_default(True)
+
+DSAN_DIGEST_ENABLED = conf("spark.rapids.tpu.dsan.digest.enabled") \
+    .boolean() \
+    .doc("Record a content digest (blake2b-64 over the Arrow-canonical "
+         "live rows) for every shuffle block at map-write time, carry "
+         "it in the block metadata wire frame, and verify it on every "
+         "remote read — a mismatch fails typed "
+         "(TpuShuffleDigestError) and counts "
+         "tpu_shuffle_digest_mismatch_total.  This is the "
+         "recovered-block correctness check lineage-based recompute "
+         "relies on (a replayed map task must reproduce the block it "
+         "replaces bit-for-bit).") \
+    .create_with_default(True)
+
 # --- observability (flight recorder) --------------------------------------
 
 TRACE_ENABLED = conf("spark.rapids.tpu.trace.enabled").boolean() \
@@ -980,6 +1008,10 @@ DECLARED_ENV_KEYS = (
     # single-chip/skip fallback (parallel/mesh.py; the MULTICHIP rc=124
     # hang guard) — read before any conf exists
     "SPARK_RAPIDS_TPU_DEVICE_PROBE_TIMEOUT_S",
+    # seed for shuffle/digest.py's process-wide digest switch: lets
+    # session-less subprocesses (serve_map, the --dist bench child)
+    # honor spark.rapids.tpu.dsan.digest.enabled without a conf object
+    "SPARK_RAPIDS_TPU_DSAN_DIGEST",
 )
 
 

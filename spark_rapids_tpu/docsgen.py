@@ -65,9 +65,11 @@ def generate_lint_rules() -> str:
     # importing the front ends populates the catalog (interp carries the
     # flow-sensitive rules TPU-L009..L012, lifetime the tmsan memory
     # rules TPU-L013..L015, concurrency the tpucsan rules
-    # TPU-R008..R010, raiseflow the tpufsan rules TPU-R011..R014)
-    from .analysis import (concurrency, interp, lifetime,  # noqa: F401
-                           plan_lint, raiseflow, repo_lint)
+    # TPU-R008..R010, raiseflow the tpufsan rules TPU-R011..R014,
+    # determinism the tpudsan rules TPU-L016/L017 + TPU-R015/R016)
+    from .analysis import (concurrency, determinism,  # noqa: F401
+                           interp, lifetime, plan_lint, raiseflow,
+                           repo_lint)
     from .analysis.diagnostics import RULE_CATALOG
     lines = [
         "# tpulint rule catalog",

@@ -404,6 +404,16 @@ class TpuHashAggregateExec(Exec):
 
     placement = TPU
 
+    # Canonical keyed merge (tpudsan): before folding accumulated
+    # partials, _merge_batch orders rows by grouping-key AND buffer
+    # value words, so the float accumulation order is a function of
+    # content, not of batch arrival — the property that lets a
+    # recomputed map task reproduce its shuffle blocks bit-for-bit
+    # (TPU-R016/L016).  The TPU-L016 pre-flight repair
+    # (analysis/determinism.try_stabilize_repair) forces this back on
+    # when a plan turns it off.
+    stable_merge: bool = True
+
     def __init__(self, grouping: Sequence[Expression],
                  aggregates: Sequence[AggregateExpression],
                  mode: str, child: Exec):
@@ -445,6 +455,30 @@ class TpuHashAggregateExec(Exec):
         self._merge_ops = []
         for ae in self.aggregates:
             self._merge_ops += ae.func.merge_ops()
+
+    def determinism(self):
+        from ..analysis.determinism import (Determinism, ORDER_DEPENDENT,
+                                            ORDER_STABLE)
+        scoped = self.mode == PARTIAL  # partial buffers regroup with
+        #                                the input split
+        if any(isinstance(ae.func, CollectList)
+               for ae in self.aggregates):
+            return Determinism(
+                ORDER_DEPENDENT, "collect_list/collect_set element "
+                "order follows batch arrival",
+                partition_scoped=scoped)
+        floaty = any(isinstance(bt, t.FractionalType)
+                     for bt in self._buffer_types)
+        if floaty and not self.stable_merge:
+            return Determinism(
+                ORDER_DEPENDENT, "float partial buffers fold in batch "
+                "arrival order (stable_merge off): a different arrival "
+                "order changes the sums", partition_scoped=scoped,
+                canonicalizable=True)
+        return Determinism(
+            ORDER_STABLE, "group emission order follows arrival; the "
+            "canonical keyed merge makes buffer folds "
+            "content-determined", partition_scoped=scoped)
 
     def input_contracts(self):
         if self.mode != FINAL or not self.grouping:
@@ -501,6 +535,8 @@ class TpuHashAggregateExec(Exec):
 
     def _merge_batch(self, xp, batch: Batch) -> Batch:
         k = len(self.grouping)
+        if self.stable_merge:
+            batch = self._canonicalize_merge_input(xp, batch)
         live = xp.arange(batch.capacity, dtype=np.int32) < batch.num_rows
         key_cols = list(batch.columns[:k])
         val_cols = list(batch.columns[k:])
@@ -508,6 +544,29 @@ class TpuHashAggregateExec(Exec):
                                   batch.capacity, live,
                                   global_agg=not self.grouping)
         return DeviceBatch(ok + ov, n, self._group_names + self._buffer_names)
+
+    def _canonicalize_merge_input(self, xp, batch: Batch) -> Batch:
+        """Order the concatenated partials by key + buffer value words
+        so the within-group fold order is content-determined (the
+        stable_merge canonical keyed merge).  Nested buffer columns
+        (collect_list arrays) contribute no words — their element
+        order is declared order_dependent anyway."""
+        cap = batch.capacity
+        live = xp.arange(cap, dtype=np.int32) < batch.num_rows
+        words: List = [(~live).astype(xp.uint64)]
+        for kc in batch.columns[:len(self.grouping)]:
+            words += seg.key_words_for_column(xp, kc, live,
+                                              for_grouping=True)
+        for vc in batch.columns[len(self.grouping):]:
+            try:
+                words += seg.key_words_for_column(xp, vc, live,
+                                                  for_grouping=True)
+            except Exception:
+                continue  # nested buffer: no sortable words
+        order = seg.lexsort(xp, words, cap)
+        from ..ops.gather import gather_batch
+        out = gather_batch(xp, batch, order, live[order], batch.num_rows)
+        return DeviceBatch(out.columns, batch.num_rows, batch.names)
 
     def _evaluate_batch(self, xp, batch: Batch) -> Batch:
         """buffers -> final results (Final/Complete modes)."""
@@ -525,7 +584,7 @@ class TpuHashAggregateExec(Exec):
 
     @functools.cached_property
     def _jit_key(self):
-        return ("TpuHashAggregateExec", self.mode,
+        return ("TpuHashAggregateExec", self.mode, self.stable_merge,
                 schema_sig(self.children[0]),
                 tuple(self._group_names), tuple(self._buffer_names),
                 tuple(self.output_names),
@@ -781,6 +840,22 @@ class CpuHashAggregateExec(Exec):
     def describe(self):
         return (f"CpuHashAggregate(keys=[{', '.join(self._group_names)}], "
                 f"fns=[{', '.join(a.name for a in self.aggregates)}])")
+
+    def determinism(self):
+        from ..analysis.determinism import (Determinism, ORDER_DEPENDENT,
+                                            ORDER_STABLE)
+        floaty = any(isinstance(bt, t.FractionalType)
+                     for bt in (b for ae in self.aggregates
+                                for b in ae.func.buffer_types()))
+        if floaty or any(isinstance(ae.func, CollectList)
+                         for ae in self.aggregates):
+            return Determinism(
+                ORDER_DEPENDENT, "pyarrow group_by folds the table in "
+                "batch-arrival row order (no canonical merge on the "
+                "host fallback)")
+        return Determinism(
+            ORDER_STABLE, "integer/decimal folds are exact; group "
+            "emission order follows arrival")
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         from ..expr.core import EvalContext as EC

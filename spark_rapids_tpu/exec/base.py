@@ -592,6 +592,21 @@ class Exec:
         declarations honest against real execution."""
         return None
 
+    def determinism(self):
+        """Declared replay class for the determinism pass
+        (analysis/determinism.py): either None (pure streaming — the
+        output is a row-wise function of the input, indifferent to
+        batch arrival order, wall clock and RNG: bit_exact) or an
+        analysis.determinism.Determinism on the lattice
+        bit_exact > order_stable > order_dependent > nondeterministic.
+        Operators whose output row order or values follow batch
+        arrival (hash aggregates, joins, unions), that select by input
+        position (limits, offset-keyed sampling), or that run opaque
+        user code (UDF boundaries) override this; the permuted-replay
+        oracle (devtools/run_lint.py --dsan) keeps the declarations
+        honest against real recomputation."""
+        return None
+
     # -- statistics ----------------------------------------------------------
     def estimated_size_bytes(self) -> Optional[int]:
         """Rough output-size estimate for planning (broadcast decisions, CBO

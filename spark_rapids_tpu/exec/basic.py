@@ -405,6 +405,13 @@ class UnionExec(Exec):
     def num_partitions(self):
         return sum(c.num_partitions for c in self.children)
 
+    def determinism(self):
+        from ..analysis.determinism import Determinism, ORDER_STABLE
+        return Determinism(
+            ORDER_STABLE, "union interleaves child partitions: output "
+            "row order follows child emission, content multiset is "
+            "invariant")
+
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         for c in self.children:
             if pid < c.num_partitions:
@@ -427,6 +434,12 @@ class LocalLimitExec(Exec):
     @property
     def output_types(self):
         return self.children[0].output_types
+
+    def determinism(self):
+        from ..analysis.determinism import BIT_EXACT, Determinism
+        return Determinism(
+            BIT_EXACT, "limit selects the first rows by input "
+            "position", order_sensitive_selection=True)
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         remaining = self.limit
@@ -478,6 +491,13 @@ class SampleExec(Exec):
     def describe(self):
         return f"Sample fraction={self.fraction} seed={self.seed}"
 
+    def determinism(self):
+        from ..analysis.determinism import BIT_EXACT, Determinism
+        return Determinism(
+            BIT_EXACT, "seeded hash of (seed, partition, global row "
+            "index): the keep decision follows the running row offset, "
+            "i.e. input arrival order", order_sensitive_selection=True)
+
     def _keep_mask(self, xp, cap: int, row_offset: int, pid: int):
         idx = (xp.arange(cap, dtype=np.uint32) + np.uint32(row_offset))
         h = idx ^ np.uint32(self.seed * 0x9E3779B9 + pid * 0x85EBCA6B
@@ -524,6 +544,12 @@ class CoalesceBatchesExec(Exec):
         return MemoryEffects(
             hold=2.0 * padded_partition_bytes(child_states[0]),
             note="raw pending concat")
+
+    def determinism(self):
+        from ..analysis.determinism import Determinism, ORDER_STABLE
+        return Determinism(
+            ORDER_STABLE, "re-batches in arrival order: batch "
+            "boundaries follow arrival, row multiset is invariant")
 
     @property
     def output_names(self):

@@ -60,6 +60,13 @@ class BroadcastExchangeExec(Exec):
     def describe(self):
         return "BroadcastExchange"
 
+    def determinism(self):
+        from ..analysis.determinism import Determinism, ORDER_STABLE
+        return Determinism(
+            ORDER_STABLE, "whole-side collect concatenates child "
+            "partitions in emission order; content multiset is "
+            "invariant")
+
     def memory_effects(self, child_states, conf):
         """Collects + concatenates the whole child once and keeps the
         cached batch device-resident for every consumer until the exec

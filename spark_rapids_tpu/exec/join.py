@@ -113,6 +113,12 @@ class HashJoinExec(Exec):
             bind_expression(condition, self.output_names, self.output_types)
             if condition is not None else None)
 
+    def determinism(self):
+        from ..analysis.determinism import Determinism, ORDER_STABLE
+        return Determinism(
+            ORDER_STABLE, "probe-order emission: output row order "
+            "follows probe-side arrival, matched multiset is invariant")
+
     def input_contracts(self):
         if not self.colocated:
             return None
@@ -576,6 +582,12 @@ class NestedLoopJoinExec(Exec):
     @property
     def num_partitions(self):
         return self.children[0].num_partitions
+
+    def determinism(self):
+        from ..analysis.determinism import Determinism, ORDER_STABLE
+        return Determinism(
+            ORDER_STABLE, "cross-product emission order follows both "
+            "sides' arrival; matched multiset is invariant")
 
     def memory_effects(self, child_states, conf):
         """Collects the whole right side raw per probe partition, and

@@ -60,6 +60,17 @@ def _emit_table(self_node: Exec, tbl: pa.Table,
         yield b
 
 
+def _opaque_udf_determinism(what: str):
+    """Pandas-UDF boundaries run arbitrary user code: nothing provable
+    about clock/RNG/iteration-order use, so the replay class bottoms
+    out (the recompute may legitimately differ)."""
+    from ..analysis.determinism import Determinism, NONDETERMINISTIC
+    return Determinism(
+        NONDETERMINISTIC,
+        f"{what}: opaque user code (clock/RNG/iteration order "
+        f"unprovable); a recomputed partition may differ")
+
+
 class MapInPandasExec(Exec):
     """df.mapInPandas(fn, schema): fn(iterator[pd.DataFrame]) ->
     iterator[pd.DataFrame] (ref GpuMapInPandasExec)."""
@@ -84,6 +95,9 @@ class MapInPandasExec(Exec):
 
     def describe(self):
         return f"MapInPandas({getattr(self.fn, '__name__', 'fn')})"
+
+    def determinism(self):
+        return _opaque_udf_determinism("mapInPandas user function")
 
     def execute_partition(self, pid, ctx: ExecContext) -> Iterator[Batch]:
         from ..udf import worker as w
@@ -155,6 +169,9 @@ class FlatMapGroupsInPandasExec(Exec):
         return (f"FlatMapGroupsInPandas(keys=[{', '.join(self.key_names)}],"
                 f" {getattr(self.fn, '__name__', 'fn')})")
 
+    def determinism(self):
+        return _opaque_udf_determinism("grouped-map user function")
+
     def execute_partition(self, pid, ctx: ExecContext) -> Iterator[Batch]:
         from ..udf import worker as w
         limit = ctx.conf.arrow_max_records_per_batch
@@ -211,6 +228,9 @@ class AggregateInPandasExec(Exec):
     def describe(self):
         return (f"AggregateInPandas(keys=[{', '.join(self.key_names)}], "
                 f"fns=[{', '.join(n for n, *_ in self.udfs)}])")
+
+    def determinism(self):
+        return _opaque_udf_determinism("grouped-aggregate user function")
 
     def execute_partition(self, pid, ctx: ExecContext) -> Iterator[Batch]:
         from ..udf import worker as w
@@ -279,6 +299,9 @@ class FlatMapCoGroupsInPandasExec(Exec):
     def describe(self):
         return (f"FlatMapCoGroupsInPandas(keys="
                 f"[{', '.join(self.left_keys)}])")
+
+    def determinism(self):
+        return _opaque_udf_determinism("cogrouped-map user function")
 
     def execute_partition(self, pid, ctx: ExecContext) -> Iterator[Batch]:
         from ..udf import worker as w

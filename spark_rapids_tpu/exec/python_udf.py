@@ -57,6 +57,12 @@ class ArrowEvalPythonExec(Exec):
     def describe(self):
         return f"ArrowEvalPython [{', '.join(self.udf_names)}]"
 
+    def determinism(self):
+        from ..analysis.determinism import Determinism, NONDETERMINISTIC
+        return Determinism(
+            NONDETERMINISTIC, "opaque Python UDF (clock/RNG/iteration "
+            "order unprovable); a recomputed partition may differ")
+
     def _split(self, b: Batch, limit: int) -> Iterator[Batch]:
         n = int(b.num_rows)
         if n <= limit:

@@ -55,6 +55,13 @@ class SortExec(Exec):
                        for e, a, _ in self._bound)
         return f"Sort [{os}] global={self.is_global}"
 
+    def determinism(self):
+        from ..analysis.determinism import Determinism, ORDER_STABLE
+        return Determinism(
+            ORDER_STABLE, "stable sort: key order is a function of "
+            "content, tie order follows arrival",
+            establishes_order=True)
+
     def _sort_batch(self, xp, batch: Batch) -> Batch:
         ctx = EvalContext(xp, batch)
         live = ctx.row_mask()

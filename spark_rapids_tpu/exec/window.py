@@ -106,6 +106,13 @@ class WindowExec(Exec):
     def describe(self):
         return f"Window [{', '.join(w.name for w in self.window_exprs)}]"
 
+    def determinism(self):
+        from ..analysis.determinism import Determinism, ORDER_STABLE
+        return Determinism(
+            ORDER_STABLE, "frames evaluate over the per-spec sorted "
+            "space (content-determined); rank/row_number over tied "
+            "order keys follow arrival within the tie")
+
     # ------------------------------------------------------------------
     class _Layout:
         """Sorted-space layout shared by every window expr on one spec:

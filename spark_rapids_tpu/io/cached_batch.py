@@ -112,6 +112,12 @@ class CacheWriteExec(Exec):
     def describe(self):
         return "CacheWrite(parquet)"
 
+    def determinism(self):
+        from ..analysis.determinism import Determinism, ORDER_STABLE
+        return Determinism(
+            ORDER_STABLE, "stores batches in child emission order; the "
+            "cached partition's row multiset is invariant")
+
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         from ..exec.base import to_host_batch
         with self._lock:

@@ -152,39 +152,48 @@ def deserialize_batch(data: bytes, xp=np) -> DeviceBatch:
 
 class TableMeta:
     """Lightweight descriptor advertised before transfer (ref
-    MetaUtils.buildTableMeta): row count + serialized size + schema id."""
+    MetaUtils.buildTableMeta): row count + serialized size + schema id +
+    the block's u64 content digest (0 when digests are disabled or the
+    writer recorded none — verification is skipped, never guessed)."""
 
-    __slots__ = ("num_rows", "num_bytes", "schema_fingerprint")
+    __slots__ = ("num_rows", "num_bytes", "schema_fingerprint",
+                 "content_digest")
 
     def __init__(self, num_rows: int, num_bytes: int,
-                 schema_fingerprint: int):
+                 schema_fingerprint: int, content_digest: int = 0):
         self.num_rows = num_rows
         self.num_bytes = num_bytes
         self.schema_fingerprint = schema_fingerprint
+        self.content_digest = content_digest
 
-    _S = struct.Struct("<qqQ")
+    _S = struct.Struct("<qqQQ")
 
     def pack(self) -> bytes:
         return self._S.pack(self.num_rows, self.num_bytes,
-                            self.schema_fingerprint)
+                            self.schema_fingerprint,
+                            self.content_digest)
 
     @classmethod
     def unpack(cls, data: bytes) -> "TableMeta":
         return cls(*cls._S.unpack_from(data, 0))
 
     @classmethod
-    def of(cls, batch: DeviceBatch, payload: bytes) -> "TableMeta":
+    def of(cls, batch: DeviceBatch, payload: bytes,
+           content_digest: int = 0) -> "TableMeta":
         return cls(int(batch.num_rows), len(payload),
-                   schema_fingerprint(batch.names, batch.dtypes))
+                   schema_fingerprint(batch.names, batch.dtypes),
+                   content_digest)
 
     @classmethod
     def of_stats(cls, num_rows: int, num_bytes: int,
-                 fingerprint: int) -> "TableMeta":
+                 fingerprint: int, content_digest: int = 0) -> "TableMeta":
         """Meta from catalog-tracked stats — the O(1) path the block
         server uses instead of materializing and serializing payloads
         (num_bytes is the catalog's retained-size hint, not an exact
-        serialized length)."""
-        return cls(int(num_rows), int(num_bytes), fingerprint)
+        serialized length; content_digest is the digest the catalog
+        cached at map-write time, never computed here)."""
+        return cls(int(num_rows), int(num_bytes), fingerprint,
+                   content_digest)
 
 
 def schema_fingerprint(names, dtypes) -> int:

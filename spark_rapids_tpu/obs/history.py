@@ -26,8 +26,8 @@ unnoticed.  This module closes that loop:
 
 * ``diff_runs`` emits typed ``Drift`` records: ``new_fallback``,
   ``crossing_growth``, ``operator_drift``, ``plan_change``,
-  ``lint_drift`` (deterministic) and ``wall_regression`` (timing,
-  threshold-gated).
+  ``lint_drift``, ``replay_class_drift`` (deterministic) and
+  ``wall_regression`` (timing, threshold-gated).
 """
 
 from __future__ import annotations
@@ -47,7 +47,8 @@ FINGERPRINT_VERSION = 2
 #: cause shifts are deterministic regressions, not noise.
 DETERMINISTIC_FIELDS = ("plan_shape", "operators", "fallback_ops",
                         "fetch_crossings", "lint_rule_hits",
-                        "distinct_programs", "miss_causes")
+                        "distinct_programs", "miss_causes",
+                        "replay_class")
 #: advisory fields (never compared in CI)
 TIMING_FIELDS = ("wall_ms", "operator_time_ns", "peak_device_bytes",
                  "compile_seconds", "estimate_rows_err")
@@ -89,12 +90,14 @@ def query_fingerprint(sql, spans: List[dict]) -> Dict:
     builds = 0
     miss_causes: Dict[str, int] = {}
     compile_s = 0.0
+    replay_class = None
     for s in spans:
         attrs = s.get("attrs") or {}
         if s.get("name") == "fetch.crossing":
             crossings += int(attrs.get("transfers", 1))
         if s.get("name") == "phase:overrides":
             lint_hits += list(attrs.get("lint_rules", ()))
+            replay_class = attrs.get("replay_class") or replay_class
         if s.get("name") == "jit.build":
             builds += 1
             cause = attrs.get("cause")
@@ -114,6 +117,10 @@ def query_fingerprint(sql, spans: List[dict]) -> Dict:
         "lint_rule_hits": sorted(set(lint_hits)),
         "distinct_programs": builds,
         "miss_causes": miss_causes,
+        # tpudsan replay class of the final plan (phase:overrides span);
+        # None when the log predates the sanitizer, so mixed histories
+        # never false-trip
+        "replay_class": replay_class,
         # timing half
         "wall_ms": sql.duration,
         "operator_time_ns": time_ns,
@@ -284,6 +291,18 @@ def diff_fingerprints(old: Dict, new: Dict,
         out.append(Drift(q, "lint_drift",
                          f"new lint rule hit(s): {sorted(new_lint)}",
                          True))
+    # tpudsan replay class (fingerprint v2+): the same query on the
+    # same data classifies identically, so ANY shift is deterministic
+    # drift — a weakening means recomputed shuffle blocks may no
+    # longer be digest-identical to lost ones.  Compared only when
+    # BOTH runs carry the field (histories spanning the sanitizer
+    # upgrade never false-trip).
+    orc, nrc = old.get("replay_class"), new.get("replay_class")
+    if orc and nrc and orc != nrc:
+        out.append(Drift(
+            q, "replay_class_drift",
+            f"plan replay class changed {orc} -> {nrc} — the "
+            f"recompute/replay guarantee shifted between runs", True))
     # compile-observatory fields (fingerprint v2): only compared when
     # BOTH runs carry them, so a history spanning the upgrade never
     # false-trips

@@ -246,6 +246,21 @@ def build_bundle(error, session=None, tracer=None, plan=None,
             }
     except Exception as ex:
         bundle["analysis"] = {"error": repr(ex)}
+    # tpudsan replay class of the failed plan: tells the operator
+    # whether a recompute of the lost work is even guaranteed to
+    # reproduce the failing state (order_dependent subtrees may not)
+    try:
+        if plan is not None and session is not None:
+            from ..analysis.determinism import classify_plan
+            res = classify_plan(plan, session.conf)
+            bundle["replay"] = {
+                "class": res.effective(plan),
+                "reason": res.reason(plan),
+                "weak_subtrees": [d.message for d in res.diags
+                                  if d.code == "TPU-L016"],
+            }
+    except Exception as ex:
+        bundle["replay"] = {"error": repr(ex)}
     # estimator grades: predicted-vs-actual for the failed run
     try:
         if tracer is not None:
@@ -324,6 +339,14 @@ def render_postmortem(bundle: Dict[str, Any]) -> str:
     else:
         lines.append("failing operator: (no errored operator span — "
                      "failure before/outside execution)")
+    rep = bundle.get("replay")
+    if rep and not rep.get("error"):
+        line = f"replay class:   {rep.get('class')}"
+        if rep.get("reason"):
+            line += f" ({rep['reason']})"
+        lines.append(line)
+        for w in rep.get("weak_subtrees") or ():
+            lines.append(f"  weak subtree: {w}")
     hbm = bundle.get("hbm") or {}
     rep = hbm.get("report") or {}
     lines.append("")

@@ -73,6 +73,11 @@ class IciAggregateExec(Exec):
         n = self.mesh.shape[self._dagg.axis]
         return f"IciAggregate({n} chips, all_to_all)"
 
+    def determinism(self):
+        # the fused stage realizes the host aggregate's semantics on
+        # the mesh: same replay class as the operator it replaces
+        return self.final_agg.determinism()
+
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         source = self.children[0]
         stacked = _gather_source_stacked(
@@ -312,6 +317,9 @@ class IciSortExec(Exec):
         n = self.mesh.shape[self._dsort.axis]
         return f"IciSort({n} chips, sample+all_to_all)"
 
+    def determinism(self):
+        return self.sort_exec.determinism()
+
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         source = self.children[0]
         stacked = _gather_source_stacked(
@@ -363,6 +371,9 @@ class IciJoinExec(Exec):
     def describe(self):
         n = self.mesh.shape[self._djoin.axis]
         return f"IciJoin({self.join_exec.how}, {n} chips, all_to_all)"
+
+    def determinism(self):
+        return self.join_exec.determinism()
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:
         lsrc, rsrc = self.children
@@ -435,6 +446,12 @@ class IciExchangeExec(Exec):
 
     def describe(self):
         return f"IciExchange({self.num_partitions} chips, all_to_all)"
+
+    def determinism(self):
+        from ..analysis.determinism import Determinism, ORDER_STABLE
+        return Determinism(
+            ORDER_STABLE, "all_to_all routing is content-determined; "
+            "per-chip row multiset is invariant under arrival order")
 
     def memory_effects(self, child_states, conf):
         """Memoizes the whole shuffled dataset device-resident (raw, not

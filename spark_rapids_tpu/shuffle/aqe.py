@@ -144,6 +144,14 @@ class AQEShuffleReadExec(Exec):
         n = len(self._specs) if self._specs is not None else "?"
         return f"{active_shim().aqe_shuffle_read_name()}({n} specs)"
 
+    def determinism(self):
+        from ..analysis.determinism import Determinism, ORDER_STABLE
+        return Determinism(
+            ORDER_STABLE, "coalesced/split reduce reads concatenate "
+            "blocks in registry order; the combined row multiset per "
+            "output partition is stats-determined, not arrival-"
+            "determined")
+
     # -- spec computation ---------------------------------------------------
     def _materialize(self):
         from ..exec.base import SpeculativeSizingMiss

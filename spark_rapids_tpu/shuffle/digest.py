@@ -1,0 +1,127 @@
+"""Content-addressed shuffle blocks (tpudsan's dynamic oracle substrate).
+
+Every map-output block gets a 64-bit content digest recorded in the
+``ShuffleBufferCatalog`` at write time and advertised in ``TableMeta``
+(``content_digest``).  Reduce-side fetches re-digest the deserialized
+payload and compare — a mismatch means the bytes decoded fine but are
+not the bytes the map task registered (stale replica, bit rot past the
+codec's own framing, or a nondeterministic recompute), and fails typed
+as ``TpuShuffleDigestError`` so the replica-retry loop prefers another
+owner.
+
+The digest is *content*-addressed, not byte-addressed: it hashes the
+Arrow-canonical form of the live rows.
+
+* capacity padding never contributes (``batch_to_arrow`` trims to
+  ``num_rows``);
+* value slots under a null mask are canonicalized to the Arrow
+  builder's zero-fill — two batches with equal live values and equal
+  null positions digest identically even when the masked garbage
+  differs (it does differ between independent recomputes);
+* sliced arrays (non-zero offsets, unaligned validity bitmaps) are
+  rebased before hashing, so a slice-view block and its gathered
+  materialization agree.
+
+That canonical form is exactly what the permuted-replay oracle
+(devtools/run_lint.py --dsan) compares across recomputes: a subtree
+that declares ``order_stable`` or better must reproduce every block
+digest under permuted batch arrival, and every per-reduce multiset
+digest under a changed partition count."""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import struct
+from typing import Iterable
+
+import pyarrow as pa
+
+_DIGEST_BYTES = 8  # u64 — rides TableMeta's fixed little-endian struct
+
+# process-wide switch, set from spark.rapids.tpu.dsan.digest.enabled at
+# the shuffle write path (ref set_default_codec's session-init pattern);
+# the catalog and the fetch verifier both consult it.  The env seed
+# lets session-less subprocesses (serve_map, the --dist bench's map
+# child) flip it without a conf object.
+_enabled = os.environ.get("SPARK_RAPIDS_TPU_DSAN_DIGEST", "1") != "0"
+
+
+def set_digest_enabled(on: bool) -> None:
+    global _enabled
+    _enabled = bool(on)
+
+
+def digest_enabled() -> bool:
+    return _enabled
+
+
+def _canonical_batch(rb: pa.RecordBatch) -> pa.RecordBatch:
+    """Rebuild any column whose raw buffers are not canonical.
+
+    Null-bearing columns carry arbitrary bytes under the mask and
+    rebuild through the Arrow builder (zero-filled null slots).  Sliced
+    columns carry offsets OR oversized parent buffers — a zero-offset
+    head slice keeps the parent's full data buffer and IPC serializes
+    it whole, so offset alone is NOT a sufficient test; any column
+    whose referenced buffers exceed its logical bytes compacts through
+    a C++ take (exact-length buffers, rebased to offset 0)."""
+    cols = []
+    dirty = False
+    for col in rb.columns:
+        if col.null_count:
+            col = pa.array(col.to_pylist(), type=col.type)
+            dirty = True
+        elif col.offset or col.get_total_buffer_size() != col.nbytes:
+            col = col.take(pa.array(range(len(col)), type=pa.int64()))
+            dirty = True
+        cols.append(col)
+    if not dirty:
+        return rb
+    return pa.RecordBatch.from_arrays(cols, names=list(rb.schema.names))
+
+
+def block_digest(batch) -> int:
+    """u64 content digest of a batch's live rows (blake2b-8 over the
+    canonical Arrow IPC bytes).  Accepts a DeviceBatch (materialized
+    through the same ``batch_to_arrow`` path serialization uses) or a
+    ``pa.RecordBatch`` directly."""
+    if not isinstance(batch, pa.RecordBatch):
+        from ..columnar.device import batch_to_arrow
+        batch = batch_to_arrow(batch)
+    rb = _canonical_batch(batch)
+    h = hashlib.blake2b(digest_size=_DIGEST_BYTES)
+    h.update(struct.pack("<q", rb.num_rows))
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, rb.schema) as w:
+        w.write_batch(rb)
+    h.update(sink.getvalue())
+    return int.from_bytes(h.digest(), "little")
+
+
+def fold_multiset(digests: Iterable[int]) -> int:
+    """Order-insensitive fold of block digests: u64 sum of a re-hash of
+    each element.  The permuted-replay oracle's changed-partition-count
+    leg compares this per reduce partition — the block *set* reshapes
+    when the input split changes, but the row multiset feeding each
+    reduce partition must not (hash routing is content-determined)."""
+    acc = 0
+    for d in digests:
+        h = hashlib.blake2b(struct.pack("<Q", d & 0xFFFFFFFFFFFFFFFF),
+                            digest_size=_DIGEST_BYTES)
+        acc = (acc + int.from_bytes(h.digest(), "little")) \
+            & 0xFFFFFFFFFFFFFFFF
+    return acc
+
+
+def row_multiset_digest(batch) -> int:
+    """Order-insensitive digest of a batch's row multiset: fold of
+    per-row digests.  Used by the oracle's changed-split leg where even
+    intra-block row order may legitimately differ between runs."""
+    if not isinstance(batch, pa.RecordBatch):
+        from ..columnar.device import batch_to_arrow
+        batch = batch_to_arrow(batch)
+    rb = _canonical_batch(batch)
+    return fold_multiset(
+        block_digest(rb.slice(i, 1)) for i in range(rb.num_rows))

@@ -86,6 +86,27 @@ class TpuShuffleVersionError(TpuShuffleFetchFailedError):
             f"(this build speaks {supported})")
 
 
+class TpuShuffleDigestError(TpuShuffleFetchFailedError):
+    """A fetched block decoded cleanly but its content digest does not
+    match the digest the map writer registered (TableMeta.
+    content_digest): the payload is internally consistent yet is NOT
+    the registered block — a stale replica, bit rot below the codec's
+    framing, or a nondeterministic recompute served in place of the
+    original.  Carries the block key and both digests so the retry
+    scheduler (and tpudsan's oracle) can attribute the divergence."""
+
+    def __init__(self, block, index: int, expected: int, got: int):
+        self.block = tuple(block)
+        self.index = index
+        self.expected = expected
+        self.got = got
+        sid, mid, rid = self.block
+        super().__init__(
+            f"shuffle block content digest mismatch: "
+            f"({sid},{mid},{rid})[{index}] expected "
+            f"{expected:#018x}, got {got:#018x}")
+
+
 class TpuShuffleCorruptBlockError(TpuShuffleFetchFailedError):
     """A fetched payload failed header validation or codec
     decompression: the bytes arrived complete but do not decode.

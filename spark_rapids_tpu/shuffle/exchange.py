@@ -51,6 +51,13 @@ class ShuffleExchangeExec(Exec):
     def describe(self):
         return f"ShuffleExchange {self.partitioning.describe()}"
 
+    def determinism(self):
+        from ..analysis.determinism import Determinism, ORDER_STABLE
+        return Determinism(
+            ORDER_STABLE, "hash routing is content-determined; block "
+            "arrival order on the reduce side follows scheduling, the "
+            "per-partition row multiset is invariant")
+
     def memory_effects(self, child_states, conf):
         """The accelerated shuffle caches every map-output block in the
         catalog (SHUFFLE priority, spill-managed) until the session
@@ -105,6 +112,12 @@ class ShuffleExchangeExec(Exec):
         shuffle_id = mgr.new_shuffle_id()
         xp = self.xp
         child = self.children[0]
+        # content addressing rides the session conf: the catalog digests
+        # every block this write registers (tpudsan's replay oracle and
+        # the fetch-side verification both key off these)
+        from .. import config as cfg_dsan
+        from .digest import set_digest_enabled
+        set_digest_enabled(ctx.conf.get(cfg_dsan.DSAN_DIGEST_ENABLED))
         # phase 1: dispatch every map batch's partition-sort (async);
         # phase 2: ONE host sync brings back ALL count vectors (a
         # per-batch sync costs a full tunnel round trip each)
@@ -194,6 +207,14 @@ class ShuffleExchangeExec(Exec):
                     "device bytes NOT re-staged by the one-pass "
                     "slice-view map write (vs eager per-partition "
                     "gather copies)").inc(saved_bytes)
+        from .digest import digest_enabled
+        if digest_enabled():
+            # publish write-time digests next to the endpoint record:
+            # content addressing must survive this writer's death, so
+            # the registry (not just the serving catalog) carries them
+            from .registry import BlockLocationRegistry
+            BlockLocationRegistry.get().note_block_digests(
+                shuffle_id, mgr.catalog.digests_for_shuffle(shuffle_id))
         self._shuffle_id = shuffle_id
 
     def execute_partition(self, pid, ctx) -> Iterator[Batch]:

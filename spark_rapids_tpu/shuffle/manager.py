@@ -131,6 +131,11 @@ class ShuffleBufferCatalog:
         # block server can answer metadata requests from these stats
         # without materializing (let alone serializing) any payload
         self._schema_fp: Dict[int, int] = {}
+        # per-block content digests keyed ((shuffle,map,reduce), index),
+        # computed at map-write time (spark.rapids.tpu.dsan.digest.
+        # enabled) — the metadata handler only LOOKS THEM UP, so its
+        # O(blocks) no-materialize contract holds
+        self._digests: Dict[Tuple[ShuffleBlockId, int], int] = {}
 
     def _note_schema(self, shuffle_id: int, batch) -> None:
         if shuffle_id in self._schema_fp:
@@ -148,13 +153,20 @@ class ShuffleBufferCatalog:
 
     def add(self, block: ShuffleBlockId, batch) -> None:
         from ..memory.spill import SpillCatalog, SpillPriority
+        from .digest import block_digest, digest_enabled
         with self._lock:
             self._note_schema(block[0], batch)
+        dg = 0
+        if digest_enabled():
+            dg = block_digest(materialize_block(batch, np))
         if isinstance(batch, DeviceBatch):
             batch = SpillCatalog.get().register(batch,
                                                 SpillPriority.SHUFFLE)
         with self._lock:
-            self._buffers.setdefault(block, []).append(batch)
+            bufs = self._buffers.setdefault(block, [])
+            if dg:
+                self._digests[(block, len(bufs))] = dg
+            bufs.append(batch)
 
     def add_sliced(self, shuffle_id: int, map_id: int,
                    sorted_batch: DeviceBatch,
@@ -163,11 +175,22 @@ class ShuffleBufferCatalog:
         it.  ``layout`` is (reduce_id, start, num_rows) triples; the
         shared spill registration lives until every view closes."""
         from ..memory.spill import SpillCatalog, SpillPriority
+        from .digest import block_digest, digest_enabled
         layout = [t for t in layout if t[2] > 0]
         if not layout:
             return
         with self._lock:
             self._note_schema(shuffle_id, sorted_batch)
+        slice_digests = {}
+        if digest_enabled():
+            # ONE host conversion of the sorted batch; per-reduce digests
+            # come from arrow row-range slices of it (block_digest
+            # rebases sliced buffers, so these agree with the digest of
+            # the gathered materialization the block server serves)
+            from ..columnar.device import batch_to_arrow
+            rb = batch_to_arrow(materialize_block(sorted_batch, np))
+            for reduce_id, start, n in layout:
+                slice_digests[reduce_id] = block_digest(rb.slice(start, n))
         sb = sorted_batch
         if isinstance(sb, DeviceBatch):
             sb = SpillCatalog.get().register(sb, SpillPriority.SHUFFLE)
@@ -176,13 +199,31 @@ class ShuffleBufferCatalog:
         shared = _SharedMapOutput(sb, refs=len(layout))
         with self._lock:
             for reduce_id, start, n in layout:
-                self._buffers.setdefault(
-                    ShuffleBlockId(shuffle_id, map_id, reduce_id), []
-                ).append(ShuffleBlockSlice(shared, start, n, total))
+                blk = ShuffleBlockId(shuffle_id, map_id, reduce_id)
+                bufs = self._buffers.setdefault(blk, [])
+                dg = slice_digests.get(reduce_id, 0)
+                if dg:
+                    self._digests[(blk, len(bufs))] = dg
+                bufs.append(ShuffleBlockSlice(shared, start, n, total))
 
     def get(self, block: ShuffleBlockId) -> List:
         with self._lock:
             return list(self._buffers.get(block, []))
+
+    def digest(self, block: ShuffleBlockId, index: int = 0) -> int:
+        """The content digest recorded for one block at map-write time
+        (0 when digests were disabled then) — a pure lookup, so the
+        metadata handler can carry it without materializing anything."""
+        with self._lock:
+            return self._digests.get((block, index), 0)
+
+    def digests_for_shuffle(self, shuffle_id: int
+                            ) -> Dict[Tuple[ShuffleBlockId, int], int]:
+        """All recorded digests of one shuffle — what the map stage
+        publishes to the BlockLocationRegistry alongside its endpoint."""
+        with self._lock:
+            return {k: v for k, v in self._digests.items()
+                    if k[0][0] == shuffle_id}
 
     def blocks_for_reduce(self, shuffle_id: int, reduce_id: int
                           ) -> List[ShuffleBlockId]:
@@ -199,6 +240,8 @@ class ShuffleBufferCatalog:
             for k in [b for b in self._buffers if b[0] == shuffle_id]:
                 doomed.extend(self._buffers.pop(k))
             self._schema_fp.pop(shuffle_id, None)
+            for k in [k for k in self._digests if k[0][0] == shuffle_id]:
+                self._digests.pop(k)
         for sb in doomed:
             close = getattr(sb, "close", None)
             if close is not None:

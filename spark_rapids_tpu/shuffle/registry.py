@@ -50,6 +50,13 @@ class BlockLocationRegistry:
         self._owners: Dict[int, List[List[BlockEndpoint]]] = {}
         self._local: Optional[BlockEndpoint] = None
         self._heartbeat = None
+        # content digests published by map stages alongside their
+        # endpoints: shuffle_id -> {((sid,mid,rid), index): u64}.  The
+        # reduce side can cross-check a replica's advertised digest
+        # against the writer's published one (content addressing
+        # survives the writer's death; a replica can't vouch for
+        # itself)
+        self._digests: Dict[int, Dict] = {}
 
     @classmethod
     def get(cls) -> "BlockLocationRegistry":
@@ -101,9 +108,23 @@ class BlockLocationRegistry:
             if group not in groups:
                 groups.append(group)
 
+    def note_block_digests(self, shuffle_id: int, digests: Dict) -> None:
+        """Publish map-write content digests for ``shuffle_id`` (keys
+        are ((shuffle,map,reduce), index) like the catalog's).  Merges:
+        each map stage publishes only its own blocks."""
+        if not digests:
+            return
+        with self._lock:
+            self._digests.setdefault(int(shuffle_id), {}).update(digests)
+
+    def block_digests(self, shuffle_id: int) -> Dict:
+        with self._lock:
+            return dict(self._digests.get(int(shuffle_id), {}))
+
     def forget_shuffle(self, shuffle_id: int) -> None:
         with self._lock:
             self._owners.pop(int(shuffle_id), None)
+            self._digests.pop(int(shuffle_id), None)
 
     # -- lookup -------------------------------------------------------------
     def owner_groups(self, shuffle_id: int) -> List[List[BlockEndpoint]]:

@@ -27,10 +27,10 @@ import numpy as np
 from ..memory.meta import (TableMeta, TpuCorruptPayloadError,
                            deserialize_batch, serialize_batch_with_sizes)
 from .errors import (TpuShuffleBlockMissingError, TpuShuffleCorruptBlockError,
-                     TpuShuffleError, TpuShuffleFetchFailedError,
-                     TpuShufflePeerDeadError, TpuShuffleStaleFrameError,
-                     TpuShuffleTimeoutError, TpuShuffleTruncatedFrameError,
-                     TpuShuffleVersionError)
+                     TpuShuffleDigestError, TpuShuffleError,
+                     TpuShuffleFetchFailedError, TpuShufflePeerDeadError,
+                     TpuShuffleStaleFrameError, TpuShuffleTimeoutError,
+                     TpuShuffleTruncatedFrameError, TpuShuffleVersionError)
 from .manager import ShuffleBlockId, TpuShuffleManager, materialize_block
 
 # message types (ref RapidsShuffleTransport.scala:96-119)
@@ -339,7 +339,10 @@ class ShuffleServer:
                 if not isinstance(nr, int):
                     nr = int(np.asarray(nr))
                 nbytes = int(getattr(b, "device_bytes", 0) or 0)
-                metas.append((blk, i, TableMeta.of_stats(nr, nbytes, fp)))
+                # content digest: a cached write-time value — a pure
+                # dict lookup, so the no-materialize contract holds
+                metas.append((blk, i, TableMeta.of_stats(
+                    nr, nbytes, fp, cat.digest(blk, i))))
         out = struct.pack("<i", len(metas))
         for (sid, mid, rid), i, meta in metas:
             out += struct.pack("<qqqq", sid, mid, rid, i) + meta.pack()
@@ -631,15 +634,38 @@ class AsyncBlockFetcher:
             raise TpuShufflePeerDeadError(self.peer_id)
 
     # -- pipeline -----------------------------------------------------------
-    def _producer(self, keys, q):
+    def _verify_digest(self, key, expected: int, batch) -> None:
+        """Read-side content check: re-digest the deserialized batch
+        against the write-time digest the metadata response carried.
+        Skipped (never guessed) when the writer recorded none or
+        digests are disabled locally."""
+        from .digest import block_digest, digest_enabled
+        if not expected or not digest_enabled():
+            return
+        got = block_digest(batch)
+        from ..obs import metrics as m
+        if got != expected:
+            m.counter("tpu_shuffle_digest_mismatch_total",
+                      "fetched blocks whose content digest did not "
+                      "match the map writer's registered digest").inc()
+            sid, mid, rid, idx = key
+            raise TpuShuffleDigestError((sid, mid, rid), idx,
+                                        expected, got)
+        m.counter("tpu_shuffle_digest_verified_total",
+                  "fetched blocks whose content digest matched the "
+                  "map writer's registered digest").inc()
+
+    def _producer(self, metas, q):
         try:
-            for (sid, mid, rid, idx) in keys:
+            for (sid, mid, rid, idx), meta in metas:
                 if self._stop.is_set():
                     return
                 self._check_peer()
                 b = self.client.fetch_block(sid, mid, rid, idx,
                                             xp=self.xp,
                                             ctx=self.ctx).wait(self.timeout)
+                self._verify_digest((sid, mid, rid, idx),
+                                    getattr(meta, "content_digest", 0), b)
                 if not self._put(q, b):
                     return
             self._put(q, self._DONE)
@@ -666,11 +692,10 @@ class AsyncBlockFetcher:
                 ctx=self.ctx).wait(self.timeout)
         except TpuShuffleError as ex:
             raise self._classify(ex, m)
-        keys = [k for k, _ in metas]
-        if not keys:
+        if not metas:
             return
         q: "queue.Queue" = queue.Queue(maxsize=self.window)
-        t = threading.Thread(target=self._producer, args=(keys, q),
+        t = threading.Thread(target=self._producer, args=(metas, q),
                              name="shuffle-fetcher", daemon=True)
         t.start()
         blocks_c = m.counter("tpu_shuffle_fetch_blocks_total",
@@ -706,6 +731,8 @@ class AsyncBlockFetcher:
             kind = "stale"
         elif isinstance(ex, TpuShuffleCorruptBlockError):
             kind = "corrupt"
+        elif isinstance(ex, TpuShuffleDigestError):
+            kind = "digest"
         elif isinstance(ex, TpuShuffleBlockMissingError):
             kind = "block_missing"
         elif isinstance(ex, TpuShuffleTimeoutError):

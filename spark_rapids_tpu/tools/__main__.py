@@ -5,7 +5,8 @@
     python -m spark_rapids_tpu.tools trace         <eventlog> [--export chrome|text] [-o FILE] [--merged]
     python -m spark_rapids_tpu.tools fleet         <eventlog|trace.json> [--json]
     python -m spark_rapids_tpu.tools lint --repo   [--baseline FILE]
-    python -m spark_rapids_tpu.tools lint --plan   <fixture.py...> [--infer] [--memsan]
+    python -m spark_rapids_tpu.tools lint --plan   <fixture.py...> [--infer] [--memsan] [--determinism]
+    python -m spark_rapids_tpu.tools lint --determinism [-o FILE]
     python -m spark_rapids_tpu.tools regress --history DIR --record <eventlog...> [--label L]
     python -m spark_rapids_tpu.tools regress --history DIR --check [--wall-threshold PCT]
     python -m spark_rapids_tpu.tools compile-report --ledger PATH [--top N] [--json]
@@ -56,7 +57,8 @@ import argparse
 import sys
 
 
-def _run_plan_lint(paths, infer=False, memsan=False):
+def _run_plan_lint(paths, infer=False, memsan=False,
+                   determinism=False):
     import runpy
 
     from ..analysis.diagnostics import format_diagnostics
@@ -88,6 +90,28 @@ def _run_plan_lint(paths, infer=False, memsan=False):
                                                  format_memory)
                 sys.stdout.write(format_memory(
                     root, analyze_memory(root, conf)))
+            if determinism:
+                # print per-subtree replay classes, then show what the
+                # L016 in-place repair (canonical keyed merge) achieves
+                from ..analysis.determinism import (classify_plan,
+                                                    format_classes,
+                                                    try_stabilize_repair)
+                sys.stdout.write(format_classes(root, conf))
+                res = classify_plan(root, conf)
+                for d in res.diags:
+                    if d.code != "TPU-L016" or d.node is None:
+                        continue
+                    if try_stabilize_repair(root, d.node, conf):
+                        after = classify_plan(root, conf)
+                        sys.stdout.write(
+                            f"TPU-L016 repair applied at "
+                            f"{d.node.name}: subtree now "
+                            f"{after.effective(d.node.children[0])} "
+                            f"(canonical keyed merge forced)\n")
+                    else:
+                        sys.stdout.write(
+                            f"TPU-L016 at {d.node.name}: no "
+                            f"stabilizing repair available\n")
             sys.stdout.write(format_diagnostics(diags))
             any_error |= any(d.is_error for d in diags)
     return 1 if any_error else 0
@@ -135,6 +159,31 @@ def _run_raise_graph(output):
     else:
         sys.stdout.write(text)
     return 1 if leaks else 0
+
+
+def _run_determinism_artifact(output):
+    """Dump the tpudsan replay-class artifact (declared determinism of
+    every registered operator + fingerprint hygiene) as JSON — the
+    sibling of --lock-graph / --raise-graph."""
+    import json
+
+    from ..analysis.determinism import determinism_artifact
+
+    art = determinism_artifact()
+    text = json.dumps(art, indent=2, sort_keys=True) + "\n"
+    hygiene = art["fingerprint_hygiene"]
+    if output:
+        with open(output, "w", encoding="utf-8") as f:
+            f.write(text)
+        sys.stdout.write(
+            f"determinism artifact: {len(art['declarations'])} "
+            f"operator declaration(s) over the "
+            f"{len(art['lattice'])}-class lattice, "
+            f"{len(hygiene)} fingerprint-hygiene finding(s) "
+            f"-> {output}\n")
+    else:
+        sys.stdout.write(text)
+    return 1 if hygiene else 0
 
 
 def _run_repo_lint(baseline_path, update):
@@ -411,6 +460,12 @@ def main(argv=None):
                          "per-subtree peak-device-byte bounds "
                          "(hold/retained/peak vs the HBM budget) "
                          "before the diagnostics")
+    li.add_argument("--determinism", action="store_true",
+                    help="dump the tpudsan replay-class artifact "
+                         "(declared determinism per operator + "
+                         "fingerprint hygiene) as JSON; with --plan, "
+                         "print per-subtree replay classes and the "
+                         "TPU-L016 repair outcome instead")
     li.add_argument("--baseline", default=None,
                     help="repo-lint baseline file "
                          "(default: devtools/lint_baseline.txt)")
@@ -548,9 +603,12 @@ def main(argv=None):
             return _run_lock_graph(args.output)
         if args.raise_graph:
             return _run_raise_graph(args.output)
+        if args.determinism and not args.plan:
+            return _run_determinism_artifact(args.output)
         if args.plan:
             return _run_plan_lint(args.plan, infer=args.infer,
-                                  memsan=args.memsan)
+                                  memsan=args.memsan,
+                                  determinism=args.determinism)
         # --repo is the default lint mode
         return _run_repo_lint(args.baseline or _default_baseline(),
                               args.update_baseline)

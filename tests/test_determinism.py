@@ -1,0 +1,475 @@
+"""tpudsan tests: the TPU-R015/R016 repo rules and their clean twins,
+TPU-L016 on the plan (stable_merge off/on) with the stabilizing
+repair, TPU-L017 fingerprint hygiene via the injectable schema, the
+permuted-replay oracle round trip over a real exchange write, the
+replica-retry read failing typed with provenance when a block's
+content digest is corrupted, and the digest metadata surviving the v2
+wire frame in both directions."""
+
+import socket
+from collections import Counter
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.analysis import determinism as dsan
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.exec import base as eb
+from spark_rapids_tpu.shuffle.manager import (TpuShuffleManager,
+                                              materialize_block)
+
+_REL = "spark_rapids_tpu/exec/injected.py"
+
+
+def _codes(diags):
+    return sorted({d.code for d in diags})
+
+
+# -- TPU-R015: volatile sources on result paths -----------------------------
+
+_R015_BAD = '''\
+import time
+
+
+def route_rows(batches, nparts):
+    out = {}
+    stamp = time.time()
+    for key in {"alpha", "beta", "gamma"}:
+        out[key] = stamp
+    return out
+'''
+
+# twin differs only in the sanctioned forms: a seeded RNG and a
+# deterministic iteration order
+_R015_CLEAN = '''\
+import random
+
+
+def route_rows(batches, nparts):
+    out = {}
+    rng = random.Random(1234)
+    for key in sorted(["alpha", "beta", "gamma"]):
+        out[key] = rng.random()
+    return out
+'''
+
+
+def test_r015_flags_wall_clock_and_set_iteration():
+    diags = dsan.module_diagnostics(_R015_BAD, _REL)
+    assert _codes(diags) == ["TPU-R015"]
+    msgs = " | ".join(d.message for d in diags)
+    assert "wall-clock" in msgs and "set literal" in msgs
+    assert len(diags) >= 2
+
+
+def test_r015_clean_twin_is_silent():
+    assert dsan.module_diagnostics(_R015_CLEAN, _REL) == []
+
+
+# -- TPU-R016: arrival-order float folds ------------------------------------
+
+_R016_BAD = '''\
+def fold(batches):
+    running_sum = 0.0
+    for b in batches:
+        running_sum += b.column_sum("v")
+    return running_sum
+'''
+
+# twin canonicalizes the fold order before accumulating — the repair
+# the rule message prescribes
+_R016_CLEAN = '''\
+def fold(batches):
+    running_sum = 0.0
+    for b in sorted(batches, key=lambda b: b.block_key):
+        running_sum += b.column_sum("v")
+    return running_sum
+'''
+
+
+def test_r016_flags_arrival_order_float_fold():
+    diags = dsan.module_diagnostics(_R016_BAD, _REL)
+    assert _codes(diags) == ["TPU-R016"]
+    assert "arrival order" in diags[0].message
+
+
+def test_r016_canonicalized_twin_is_silent():
+    assert dsan.module_diagnostics(_R016_CLEAN, _REL) == []
+
+
+# -- TPU-L016: weak subtree feeding an exchange -----------------------------
+
+
+def _float_partial_plan(stable: bool):
+    """scan(batch_rows=1) -> PARTIAL float Sum -> hash exchange; the
+    values make arrival order observable in float64 ((1e16 - 1e16) + 1
+    vs (1 - 1e16) + 1e16)."""
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.exec.basic import LocalScanExec
+    from spark_rapids_tpu.expr.aggregates import (AggregateExpression,
+                                                  PARTIAL, Sum)
+    from spark_rapids_tpu.expr.core import AttributeReference
+    from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+    tbl = pa.table({
+        "k": pa.array([0, 0, 0], type=pa.int64()),
+        "v": pa.array([1e16, -1e16, 1.0], type=pa.float64()),
+    })
+    scan = LocalScanExec(tbl, num_partitions=1, batch_rows=1)
+    scan.placement = eb.CPU
+    partial = TpuHashAggregateExec(
+        [AttributeReference("k")],
+        [AggregateExpression(Sum(AttributeReference("v")))],
+        PARTIAL, scan)
+    partial.placement = eb.CPU
+    partial.stable_merge = stable
+    ex = ShuffleExchangeExec(
+        HashPartitioning([AttributeReference("k")], 2), partial)
+    ex.placement = eb.CPU
+    return ex
+
+
+def test_l016_flags_unstable_float_partial_under_exchange():
+    from spark_rapids_tpu.analysis.plan_lint import lint_plan
+    diags = lint_plan(_float_partial_plan(stable=False), RapidsConf({}))
+    l016 = [d for d in diags if d.code == "TPU-L016"]
+    assert l016, f"expected TPU-L016, got {_codes(diags)}"
+    assert "order_stable" in l016[0].message
+
+
+def test_l016_clean_with_canonical_merge():
+    from spark_rapids_tpu.analysis.plan_lint import lint_plan
+    diags = lint_plan(_float_partial_plan(stable=True), RapidsConf({}))
+    assert "TPU-L016" not in _codes(diags)
+
+
+def test_l016_stabilize_repair_upgrades_the_subtree():
+    """The repair forces the canonical keyed merge on the flagged
+    boundary's canonicalizable operators; the re-classified subtree
+    must reach order_stable and a re-lint must come back clean."""
+    from spark_rapids_tpu.analysis.plan_lint import lint_plan
+    ex = _float_partial_plan(stable=False)
+    conf = RapidsConf({})
+    l016 = [d for d in lint_plan(ex, conf) if d.code == "TPU-L016"]
+    node = getattr(l016[0], "node", None)
+    assert node is not None
+    assert dsan.try_stabilize_repair(ex, node, conf)
+    assert ex.children[0].stable_merge is True
+    res = dsan.classify_plan(ex, conf)
+    assert dsan.RANK[res.effective(ex.children[0])] >= \
+        dsan.RANK[dsan.ORDER_STABLE]
+    assert "TPU-L016" not in _codes(lint_plan(ex, conf))
+
+
+# -- TPU-L017: fingerprint hygiene ------------------------------------------
+
+
+def test_l017_overlapping_and_volatile_schemas_flagged():
+    overlap = dsan.fingerprint_hygiene_diagnostics(
+        deterministic=["plan_hash", "submit_time_ms"],
+        timing=["submit_time_ms"])
+    assert _codes(overlap) == ["TPU-L017"]
+    volatile = dsan.fingerprint_hygiene_diagnostics(
+        deterministic=["plan_hash", "wall_start"], timing=[])
+    assert _codes(volatile) == ["TPU-L017"]
+
+
+def test_l017_clean_schema_and_live_registry_silent():
+    assert dsan.fingerprint_hygiene_diagnostics(
+        deterministic=["plan_hash"], timing=["submit_time_ms"]) == []
+    # the live obs/history schema must itself be hygienic
+    assert dsan.fingerprint_hygiene_diagnostics() == []
+
+
+# -- permuted-replay oracle round trip --------------------------------------
+
+
+class _Permuted(eb.Exec):
+    """Adversarial scheduler: replays the child's batches in reversed
+    arrival order."""
+
+    def __init__(self, inner):
+        super().__init__([inner])
+        self.placement = inner.placement
+
+    @property
+    def output_names(self):
+        return self.children[0].output_names
+
+    @property
+    def output_types(self):
+        return self.children[0].output_types
+
+    def execute_partition(self, pid, ctx):
+        return iter(list(
+            self.children[0].execute_partition(pid, ctx))[::-1])
+
+
+def _scan_exchange(permute: bool):
+    from spark_rapids_tpu.exec.basic import LocalScanExec
+    from spark_rapids_tpu.expr.core import AttributeReference
+    from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+    n = 64
+    tbl = pa.table({
+        "k": pa.array([i % 8 for i in range(n)], type=pa.int64()),
+        "v": pa.array([i * 11 for i in range(n)], type=pa.int64()),
+    })
+    scan = LocalScanExec(tbl, num_partitions=2, batch_rows=5)
+    scan.placement = eb.CPU
+    scan.pin_cache = None
+    child = _Permuted(scan) if permute else scan
+    ex = ShuffleExchangeExec(
+        HashPartitioning([AttributeReference("k")], 4), child)
+    ex.placement = eb.CPU
+    return ex
+
+
+def _write_and_harvest(ex):
+    """Drive the exchange's map write; return the per-(map, reduce)
+    Counter of recorded block digests plus any recorded-vs-recomputed
+    disagreements, then unregister the shuffle."""
+    from spark_rapids_tpu.shuffle.digest import block_digest
+    ctx = eb.ExecContext(RapidsConf({}))
+    ctx.task_context["no_speculation"] = True
+    ex._ensure_written(ctx)
+    sid = ex._shuffle_id
+    mgr = TpuShuffleManager.get()
+    blockdg = {}
+    for ((_, mid, rid), _idx), dg in \
+            mgr.catalog.digests_for_shuffle(sid).items():
+        blockdg.setdefault((mid, rid), Counter())[dg] += 1
+    bad = []
+    for rid in range(ex.num_partitions):
+        for blk in mgr.catalog.blocks_for_reduce(sid, rid):
+            for i, sb in enumerate(mgr.catalog.get(blk)):
+                recorded = mgr.catalog.digest(blk, i)
+                recomputed = block_digest(materialize_block(sb, np))
+                if recorded != recomputed:
+                    bad.append((tuple(blk), i, recorded, recomputed))
+    mgr.unregister(sid)
+    return blockdg, bad
+
+
+def test_permuted_replay_reproduces_block_digests():
+    """The oracle round trip: an exchange over a bit-exact scan must
+    write digest-identical block multisets under permuted batch
+    arrival, and every write-time digest must agree with a recompute
+    from the stored buffers (the content-addressing invariant)."""
+    fwd = _scan_exchange(permute=False)
+    res = dsan.classify_plan(fwd, RapidsConf({}))
+    assert dsan.RANK[res.effective(fwd.children[0])] >= \
+        dsan.RANK[dsan.ORDER_STABLE]
+    TpuShuffleManager.reset()
+    try:
+        a, bad_a = _write_and_harvest(fwd)
+        b, bad_b = _write_and_harvest(_scan_exchange(permute=True))
+        assert bad_a == [] and bad_b == []
+        assert a and a == b
+    finally:
+        TpuShuffleManager.reset()
+
+
+def test_oracle_sees_planted_arrival_order_nondeterminism():
+    """Anti-vacuity: the stable_merge=off float partial must produce
+    DIFFERENT digests under reversed arrival — if it did not, the
+    oracle could never catch a real order_dependent subtree."""
+    TpuShuffleManager.reset()
+    try:
+        fwd = _float_partial_plan(stable=False)
+        rev = _float_partial_plan(stable=False)
+        rev.children[0].children[0] = _Permuted(
+            rev.children[0].children[0])
+        a, _ = _write_and_harvest(fwd)
+        b, _ = _write_and_harvest(rev)
+        assert a != b
+    finally:
+        TpuShuffleManager.reset()
+
+
+# -- corrupted block fails typed with provenance ----------------------------
+
+
+def _serve_blocks(n_maps=4, rows=64, shuffle_id=11, reduce_id=2):
+    from spark_rapids_tpu.columnar.device import batch_to_device
+    from spark_rapids_tpu.shuffle.transport import ShuffleServer
+    TpuShuffleManager.reset()
+    mgr = TpuShuffleManager.get()
+    for mid in range(n_maps):
+        rb = pa.record_batch({"a": pa.array(
+            [mid * 1000 + i for i in range(rows)], type=pa.int64())})
+        mgr.write_map_output(shuffle_id, mid,
+                             {reduce_id: batch_to_device(rb, xp=np)})
+    return mgr, ShuffleServer(mgr).start()
+
+
+def test_corrupted_block_digest_fails_replica_retry_typed():
+    """A fetched block whose content does not match the write-time
+    digest must fail the replica-retry read as the typed digest error
+    carrying fetch provenance (which replica, how many attempts), move
+    the mismatch counter, and leave expected != got on the error."""
+    import spark_rapids_tpu.obs.metrics as m
+    from spark_rapids_tpu.shuffle import locality
+    from spark_rapids_tpu.shuffle.errors import TpuShuffleDigestError
+    from spark_rapids_tpu.shuffle.registry import (BlockEndpoint,
+                                                   BlockLocationRegistry)
+    m.MetricsRegistry.reset_for_tests()
+    mgr, server = _serve_blocks(n_maps=2)
+    # corrupt one registered digest: the advertised metadata now
+    # promises content the payload cannot hash to
+    key = sorted(mgr.catalog._digests)[0]
+    mgr.catalog._digests[key] ^= 0x1
+    BlockLocationRegistry.reset()
+    reg = BlockLocationRegistry.get()
+    reg.set_local("test-local", "127.0.0.1", 0)
+    group = [BlockEndpoint("replica-a", "127.0.0.1", server.port)]
+    locality.reset_pool()
+    try:
+        with pytest.raises(TpuShuffleDigestError) as ei:
+            list(locality._fetch_group(group, 11, 2, reg, np,
+                                       2, 5.0, 2, m))
+        assert ei.value.expected != ei.value.got
+        prov = getattr(ei.value, "fetch_provenance", "")
+        assert "replica-a" in prov and "attempt" in prov
+        assert m.counter("tpu_shuffle_digest_mismatch_total").value() \
+            >= 1
+    finally:
+        server.stop()
+        locality.reset_pool()
+        TpuShuffleManager.reset()
+        BlockLocationRegistry.reset()
+        m.MetricsRegistry.reset_for_tests()
+
+
+# -- replay-class drift across runs -----------------------------------------
+
+
+def test_replay_class_drift_is_deterministic():
+    from spark_rapids_tpu.obs.history import diff_fingerprints
+    base = {"sql_id": 0, "description": "q0",
+            "replay_class": "order_stable"}
+    weakened = dict(base, replay_class="order_dependent")
+    drifts = diff_fingerprints(base, weakened)
+    kinds = {d.kind for d in drifts}
+    assert "replay_class_drift" in kinds
+    d = next(d for d in drifts if d.kind == "replay_class_drift")
+    assert d.deterministic
+    assert "order_stable" in d.detail and "order_dependent" in d.detail
+
+
+def test_replay_class_drift_needs_both_runs_to_carry_it():
+    """A history spanning the tpudsan upgrade (old runs have no
+    replay_class) must never false-trip."""
+    from spark_rapids_tpu.obs.history import diff_fingerprints
+    old = {"sql_id": 0, "description": "q0"}
+    new = {"sql_id": 0, "description": "q0",
+           "replay_class": "order_stable"}
+    assert not any(d.kind == "replay_class_drift"
+                   for d in diff_fingerprints(old, new))
+    assert not any(d.kind == "replay_class_drift"
+                   for d in diff_fingerprints(new, old))
+
+
+def test_fingerprint_harvests_replay_class_from_overrides_span():
+    from spark_rapids_tpu.obs.history import (DETERMINISTIC_FIELDS,
+                                              query_fingerprint)
+
+    class _Plan:
+        node_name = "ScanExec"
+        children = ()
+        actual = {}
+
+        def walk(self):
+            return [self]
+
+    class _Sql:
+        sql_id = 0
+        description = "q0"
+        failed = False
+        plan = _Plan()
+        duration = 1
+        peak_device_bytes = 0
+
+    assert "replay_class" in DETERMINISTIC_FIELDS
+    fp = query_fingerprint(_Sql(), [
+        {"name": "phase:overrides",
+         "attrs": {"lint_rules": [], "replay_class": "bit_exact"}}])
+    assert fp["replay_class"] == "bit_exact"
+    # logs predating the sanitizer leave the field None
+    assert query_fingerprint(_Sql(), [])["replay_class"] is None
+
+
+# -- failure black box records the replay class -----------------------------
+
+
+def test_postmortem_bundle_carries_replay_class():
+    """The failure black box must record the failed plan's replay
+    class — whether a recompute is even guaranteed to reproduce the
+    failing state — and the renderer must surface it."""
+    from spark_rapids_tpu.obs.postmortem import (build_bundle,
+                                                 render_postmortem)
+
+    class _Session:
+        conf = RapidsConf({})
+        _conf_map = {}
+
+    bundle = build_bundle(RuntimeError("boom"), session=_Session(),
+                          plan=_float_partial_plan(stable=False))
+    rep = bundle["replay"]
+    assert rep["class"] == "order_dependent"
+    assert rep["reason"]
+    assert rep["weak_subtrees"]
+    text = render_postmortem(bundle)
+    assert "replay class:   order_dependent" in text
+    # a stabilized twin classifies order_stable in the same bundle path
+    clean = build_bundle(RuntimeError("boom"), session=_Session(),
+                         plan=_float_partial_plan(stable=True))
+    assert clean["replay"]["class"] == "order_stable"
+    assert clean["replay"]["weak_subtrees"] == []
+
+
+# -- digest metadata on the v2 wire frame -----------------------------------
+
+
+def test_table_meta_digest_packs_and_unpacks():
+    from spark_rapids_tpu.memory.meta import TableMeta
+    big = (1 << 63) + 12345
+    tm = TableMeta(10, 4096, 7, big)
+    assert TableMeta._S.size == len(tm.pack())
+    back = TableMeta.unpack(tm.pack())
+    assert (back.num_rows, back.num_bytes, back.schema_fingerprint,
+            back.content_digest) == (10, 4096, 7, big)
+
+
+def test_digest_survives_wire_frame_both_directions():
+    """Server -> client: fetch_metadata must carry every block's
+    write-time digest verbatim.  Client -> payload: the transferred
+    block must verify against that digest (verified counter moves,
+    mismatch counter does not)."""
+    import spark_rapids_tpu.obs.metrics as m
+    from spark_rapids_tpu.shuffle.transport import (AsyncBlockFetcher,
+                                                    ShuffleClient)
+    m.MetricsRegistry.reset_for_tests()
+    mgr, server = _serve_blocks(n_maps=3, rows=50)
+    try:
+        cli = ShuffleClient("127.0.0.1", server.port)
+        metas = cli.fetch_metadata(11, 2).wait(10.0)
+        assert len(metas) == 3
+        for (sid, mid, rid, idx), meta in metas:
+            assert meta.content_digest != 0
+            assert meta.content_digest == \
+                mgr.catalog.digest((sid, mid, rid), idx)
+        # the verifying read path re-digests every transferred payload
+        got = list(AsyncBlockFetcher(cli, 11, 2, window=2,
+                                     timeout=10.0))
+        assert len(got) == 3
+        cli.close()
+        assert m.counter(
+            "tpu_shuffle_digest_verified_total").value() == 3
+        assert m.counter(
+            "tpu_shuffle_digest_mismatch_total").value() == 0
+    finally:
+        server.stop()
+        TpuShuffleManager.reset()
+        m.MetricsRegistry.reset_for_tests()

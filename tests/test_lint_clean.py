@@ -253,6 +253,26 @@ def test_run_lint_faults_gate_exits_zero():
     assert "faults gate clean" in proc.stdout, proc.stdout
 
 
+def test_run_lint_dsan_gate_exits_zero():
+    """Tier-1 gate for tpudsan: the determinism repo pass (TPU-R015/
+    R016 + the L017 fingerprint-hygiene check) must be finding-free
+    with zero frozen baseline debt, the planted rule fixtures must
+    each trip, every golden-corpus exchange site must reproduce its
+    content-addressed block digests under permuted batch arrival and a
+    changed input split (write-time digests cross-checked against
+    recomputes), and the two planted nondeterminism injections (an
+    arrival-order float sum, a PYTHONHASHSEED-dependent set-iteration
+    router) must each produce DIFFERENT digests — the oracle provably
+    sees real nondeterminism."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "devtools", "run_lint.py"),
+         "--dsan"],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "dsan gate clean" in proc.stdout, proc.stdout
+
+
 def test_baseline_is_empty_and_stays_empty():
     """PR-3 burned the last baselined TPU-R001 debt down to zero: the
     ratchet now enforces a spotless repo (deliberate exceptions are

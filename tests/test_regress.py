@@ -35,6 +35,7 @@ def _fp(sql_id=0, **over):
         "lint_rule_hits": [],
         "distinct_programs": 3,
         "miss_causes": {"new_program": 2, "shape_churn": 1},
+        "replay_class": "order_stable",
         "wall_ms": 120,
         "operator_time_ns": 5_000_000,
         "peak_device_bytes": 1 << 20,
