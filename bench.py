@@ -389,6 +389,16 @@ def run_one_suite(name: str, n_rows: int, cache_dir: str,
             "prewarm_hits": snap["prewarm_hits"],
             "prewarm_s": snap["prewarm_seconds"],
             "disk_hits": disk_hits, "disk_misses": disk_misses}
+        # tpuxsan padding-waste books (obs/tracer.py): counters only
+        # fill when tracing ran, so a no-trace suite honestly reports 0
+        pad_fam = reg.counter("tpu_pad_waste_bytes_total",
+                              labelnames=("exec",))
+        tot_fam = reg.counter("tpu_operator_bytes_total",
+                              labelnames=("exec",))
+        pad = sum(ch.value for _, ch in pad_fam.series())
+        tot = sum(ch.value for _, ch in tot_fam.series())
+        payload["pad_waste_bytes"] = int(pad)
+        payload["pad_waste_ratio"] = round(pad / tot, 4) if tot else 0.0
         if accuracy_history:
             from spark_rapids_tpu.obs.estimator import EstimatorLedger
             est = EstimatorLedger.get().snapshot()

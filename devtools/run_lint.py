@@ -150,8 +150,24 @@ nondeterminism injections (an arrival-order float sum, a
 PYTHONHASHSEED-dependent set-iteration router) must produce
 DIFFERENT digests, proving the oracle is not vacuous.
 
+--hlo runs the tpuxsan program-efficiency gate: the golden corpus
+replays with StableHLO + cost_analysis() persistence on, every
+persisted program artifact must resolve (deduped, size-capped), the
+analytic cost model (analysis/hlocost.py) must agree with XLA's own
+bytes-accessed within the declared tolerance on >= 90% of compiled
+programs (a drifting model fails the gate — anti-vacuity for the
+costing itself), the runtime padding-waste books must reconcile three
+ways (span padWasteBytes vs recomputation from live rows/capacity vs
+the tpu_pad_waste_bytes_total counter), the TPU-L018/L019/L020/R017
+fixtures must each trip with their clean twins passing, an injected
+pathological bucket (a 1M-capacity launch carrying 10 live rows) must
+produce both the L018 finding and the expected counter delta, and
+`tools kernel-report` must rank the grouped-aggregate and hash-join
+programs among the top fusion targets with nonzero projected savings.
+
     python devtools/run_lint.py --faults           # tpufsan fault campaign
     python devtools/run_lint.py --dsan             # tpudsan determinism gate
+    python devtools/run_lint.py --hlo              # tpuxsan efficiency gate
 """
 
 import json
@@ -3308,6 +3324,338 @@ def run_dsan_gate() -> int:
     return 0
 
 
+def run_hlo_gate() -> int:
+    """tpuxsan gate: the golden corpus replays with StableHLO +
+    cost_analysis() persistence on; every persisted program artifact
+    must resolve (deduped), the analytic cost model must agree with
+    XLA's bytes-accessed on >= 90% of compiled programs, the padding
+    books must reconcile three ways (span padWasteBytes vs live-row
+    recomputation vs the counter), the L018/L019/L020/R017 fixtures
+    must trip with clean twins passing, an injected pathological
+    bucket (1M capacity over 10 live rows) must produce both the L018
+    finding and the counter delta, and `tools kernel-report` must rank
+    the grouped-aggregate and hash-join programs with nonzero
+    projected savings."""
+    import io
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu import types as t
+    from spark_rapids_tpu.analysis import hloaudit, hlocost
+    from spark_rapids_tpu.analysis.plan_lint import (downgrade_hazards,
+                                                     lint_plan)
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.columnar.device import (DeviceBatch,
+                                                  DeviceColumn)
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.exec import base as eb
+    from spark_rapids_tpu.memory.spill import batch_device_bytes
+    from spark_rapids_tpu.obs.compileprof import (HLO_SUBDIR, HLO_SUFFIX,
+                                                  CompileObservatory)
+    from spark_rapids_tpu.obs.metrics import MetricsRegistry
+    from spark_rapids_tpu.obs.tracer import QueryTrace
+    from spark_rapids_tpu.tools.compile_report import load_ledger
+    from spark_rapids_tpu.tools.eventlog import parse_event_log
+    from spark_rapids_tpu.tools.kernel_report import (
+        aggregate_kernel_report, load_estimator_ledger,
+        run_kernel_report)
+
+    failures = 0
+    tmp = tempfile.mkdtemp(prefix="hlo_gate_")
+    reg = MetricsRegistry.reset_for_tests()
+    CompileObservatory.reset_for_tests()
+    eb.clear_jit_cache()
+    try:
+        evt = os.path.join(tmp, "evt")
+        os.makedirs(evt)
+        hist = os.path.join(tmp, "hist")
+        s = (TpuSession.builder()
+             .config("spark.rapids.sql.enabled", True)
+             .config("spark.rapids.tpu.singleChipFuse", "off")
+             .config("spark.rapids.tpu.sort.compileLean", "off")
+             .config("spark.rapids.tpu.eventLog.dir", evt)
+             .config("spark.rapids.tpu.compile.ledgerDir", hist)
+             .get_or_create())
+        rng = np.random.default_rng(20818)
+        fact = pa.table({
+            "k": pa.array((rng.integers(0, 97, 4000)).astype(np.int64)),
+            "v": pa.array(rng.integers(-1000, 1000, 4000)
+                          .astype(np.int64))})
+        dim = pa.table({
+            "k": pa.array(np.arange(97, dtype=np.int64)),
+            "w": pa.array(np.arange(97, dtype=np.int64) * 3)})
+        fdf = s.create_dataframe(fact, num_partitions=2)
+        ddf = s.create_dataframe(dim)
+
+        o1 = (fdf.filter(col("v") > -500).group_by(col("k"))
+              .agg(F.sum(col("v")).alias("sv"),
+                   F.count("*").alias("c")).collect())
+        o2 = (fdf.join(ddf, on="k", how="inner").group_by(col("k"))
+              .agg(F.sum(col("w")).alias("sw")).collect())
+        o3 = fdf.sort(col("k"), col("v")).collect()
+        o4 = (fdf.filter(col("v") > 0)
+              .select(col("k"), (col("v") + col("v")).alias("v2"))
+              .collect())
+        if (o1.num_rows, o2.num_rows, o3.num_rows) != (97, 97, 4000) \
+                or o4.num_rows == 0:
+            failures += 1
+            print("HLO: corpus produced wrong row counts")
+
+        # [persist] every build's hlo_hash must resolve to exactly one
+        # deduped artifact on disk; a corpus that persists nothing is
+        # vacuous
+        ledger_path = os.path.join(hist, "compile_ledger.jsonl")
+        records = load_ledger(ledger_path)
+        builds = [r for r in records if r.get("event") == "build"]
+        hashes = {r["hlo_hash"] for r in builds if r.get("hlo_hash")}
+        unhashed = [r for r in builds if not r.get("hlo_hash")]
+        if not builds or not hashes:
+            failures += 1
+            print(f"HLO: vacuous — {len(builds)} build(s), "
+                  f"{len(hashes)} persisted program(s)")
+        if unhashed:
+            failures += 1
+            print(f"HLO: {len(unhashed)} build(s) carry no hlo_hash "
+                  f"({sorted({r.get('exec') for r in unhashed})})")
+        hlo_dir = os.path.join(hist, HLO_SUBDIR)
+        on_disk = set()
+        if os.path.isdir(hlo_dir):
+            on_disk = {f[:-len(HLO_SUFFIX)] for f in os.listdir(hlo_dir)
+                       if f.endswith(HLO_SUFFIX)}
+        if on_disk != hashes:
+            failures += 1
+            print(f"HLO: artifact store out of step with the ledger — "
+                  f"{len(hashes)} hash(es) vs {len(on_disk)} file(s); "
+                  f"missing {sorted(hashes - on_disk)[:4]}, orphaned "
+                  f"{sorted(on_disk - hashes)[:4]}")
+
+        # [cost model] the analytic model must track XLA's own books —
+        # drift means the report's gap column is fiction
+        cm = hlocost.validate_model(builds, tolerance=8.0)
+        if cm["checked"] == 0:
+            failures += 1
+            print("HLO: cost-model check vacuous — no build carried "
+                  "cost_analysis() bytes")
+        elif cm["agreement_pct"] < 90.0:
+            failures += 1
+            print(f"HLO: cost model agrees on only "
+                  f"{cm['agreement_pct']:.0f}% of {cm['checked']} "
+                  f"program(s) (< 90%); worst {cm['worst']}")
+
+        # [pad books] three-way reconciliation: each span's persisted
+        # padWasteBytes must equal the live-row recomputation, and the
+        # counter must equal the span sum (checked BEFORE the synthetic
+        # injection below adds counter-only traffic)
+        logs = [f for f in os.listdir(evt) if f.startswith("events_")]
+        op_spans = []
+        if logs:
+            app = parse_event_log(os.path.join(evt, logs[0]))
+            op_spans = [sp for sp in app.spans
+                        if "padWasteBytes" in sp]
+        if not op_spans:
+            failures += 1
+            print("HLO: pad reconciliation vacuous — no operator span "
+                  "carries padWasteBytes")
+        span_total = 0
+        for sp in op_spans:
+            cap = int(sp.get("capRows") or 0)
+            byt = int(sp.get("bytes") or 0)
+            want = 0
+            if cap > 0 and byt > 0:
+                live = min(max(int(sp.get("rows") or 0), 0), cap)
+                want = int(byt * (cap - live) / cap)
+            got = int(sp["padWasteBytes"])
+            if got != want:
+                failures += 1
+                print(f"HLO: span {sp.get('name')} books {got} pad "
+                      f"bytes; rows/capacity recompute to {want}")
+            span_total += got
+        pad_fam = reg.counter("tpu_pad_waste_bytes_total",
+                              labelnames=("exec",))
+        metric_total = int(sum(ch.value for _, ch in pad_fam.series()))
+        if metric_total != span_total:
+            failures += 1
+            print(f"HLO: tpu_pad_waste_bytes_total {metric_total} != "
+                  f"event-log span sum {span_total}")
+
+        # [kernel report] the headline artifact must rank the Pallas
+        # candidates with nonzero projected savings, and the CLI must
+        # render it
+        agg = aggregate_kernel_report(records,
+                                      load_estimator_ledger(hist))
+        sav = {t_["target"]: t_["projected_savings_s"]
+               for t_ in agg["targets"]}
+        for want_target in ("fused grouped aggregate (sort+segment-"
+                            "reduce)", "fused hash build/probe"):
+            if sav.get(want_target, 0.0) <= 0.0:
+                failures += 1
+                print(f"HLO: kernel report projects no savings for "
+                      f"{want_target!r} (targets {sav})")
+        buf = io.StringIO()
+        rc = run_kernel_report(ledger_path, hist, out=buf)
+        if rc != 0 or "kernel gap report" not in buf.getvalue():
+            failures += 1
+            print(f"HLO: kernel-report CLI failed (rc {rc})")
+
+        # [fixtures] bad twins trip, clean twins pass
+        bad = _builders(os.path.join(GOLDEN, "bad_plans.py"))
+        root18, cmap18 = bad["plan_L018_pad_waste"]()
+        d18 = lint_plan(root18, RapidsConf(cmap18), infer=True)
+        if "TPU-L018" not in {d.code for d in d18}:
+            failures += 1
+            print("HLO: the pathological-bucket plan did not trip "
+                  "TPU-L018")
+        root18c, _ = bad["plan_L018_pad_waste"]()
+        clean = {d.code for d in lint_plan(root18c, RapidsConf({}),
+                                           infer=True)}
+        if {"TPU-L018", "TPU-L020"} & clean:
+            failures += 1
+            print(f"HLO: clean twin (default buckets) tripped "
+                  f"{sorted(clean)}")
+        root20, cmap20 = bad["plan_L020_fusion_break"]()
+        if "TPU-L020" not in {d.code for d in lint_plan(
+                root20, RapidsConf(cmap20), infer=True)}:
+            failures += 1
+            print("HLO: the project->filter pipeline did not trip "
+                  "TPU-L020")
+        root20x, _ = bad["plan_L020_fusion_break"]()
+        off = {d.code for d in lint_plan(
+            root20x, RapidsConf({"spark.rapids.tpu.xsan.enabled":
+                                 False}), infer=True)}
+        if {"TPU-L018", "TPU-L020"} & off:
+            failures += 1
+            print(f"HLO: xsan.enabled=false still emitted "
+                  f"{sorted(off)}")
+
+        # L018 repair: with a genuinely smaller bucket on the menu the
+        # pre-flight must arm the speculative re-bucket and keep the
+        # filter on device; with none it must refuse
+        ns = __import__("runpy").run_path(
+            os.path.join(GOLDEN, "bad_plans.py"))
+        from spark_rapids_tpu.exec.basic import FilterExec
+        from spark_rapids_tpu.expr.core import (AttributeReference,
+                                                Literal)
+        from spark_rapids_tpu.expr.predicates import GreaterThan
+        scan = ns["_scan"](ns["_ints"](n=1200))
+        flt = FilterExec(GreaterThan(AttributeReference("v"),
+                                     Literal(600, t.LONG)), scan)
+        flt.placement = eb.TPU
+        rconf = RapidsConf({"spark.rapids.tpu.batchCapacityBuckets":
+                            "1024,1048576"})
+        rd = lint_plan(flt, rconf, infer=True)
+        downgrade_hazards(flt, rd, rconf)
+        if flt.rebucket_cap != 1024 or flt.placement != eb.TPU:
+            failures += 1
+            print(f"HLO: L018 repair did not arm (rebucket_cap="
+                  f"{flt.rebucket_cap}, placement={flt.placement})")
+        if getattr(root18, "rebucket_cap", None) is not None:
+            failures += 1
+            print("HLO: L018 repair armed with no smaller bucket "
+                  "available (a no-op shrink)")
+
+        # L019: a planted host callback inside a persisted program
+        # trips; the pure twin is clean
+        hdir = os.path.join(tmp, "hlo_fixtures")
+        os.makedirs(hdir)
+        bad_hlo = ('func.func @main(%arg0: tensor<4xi64>) {\n'
+                   '  %0 = "stablehlo.custom_call"(%arg0) '
+                   '{call_target_name = "xla_python_cpu_callback"} : '
+                   '(tensor<4xi64>) -> tensor<4xi64>\n  return\n}\n')
+        ok_hlo = ('func.func @main(%arg0: tensor<4xi64>) {\n'
+                  '  %0 = stablehlo.add %arg0, %arg0 : tensor<4xi64>\n'
+                  '  return\n}\n')
+        for h, text in (("deadbeef00000001", bad_hlo),
+                        ("deadbeef00000002", ok_hlo)):
+            with open(os.path.join(hdir, h + HLO_SUFFIX), "w") as f:
+                f.write(text)
+        recs = [{"event": "build", "exec": "ProbeExec",
+                 "hlo_hash": "deadbeef00000001"},
+                {"event": "build", "exec": "CleanExec",
+                 "hlo_hash": "deadbeef00000002"}]
+        l19 = hloaudit.audit_ledger(recs, hdir, 16 << 20)
+        codes19 = [d.code for d in l19]
+        if codes19 != ["TPU-L019"]:
+            failures += 1
+            print(f"HLO: planted host callback produced {codes19} "
+                  f"(expected exactly one TPU-L019, clean twin silent)")
+
+        # R017: a raw jnp call in exec/ trips; the xp-parameterized and
+        # allow-annotated twins are clean
+        r_bad = "import jax.numpy as jnp\n\ndef widen(c):\n" \
+                "    return jnp.cumsum(c)\n"
+        r_ok = "def widen(c, xp):\n    return xp.cumsum(c)\n"
+        r_allow = ("import jax.numpy as jnp\n\ndef widen(c):\n"
+                   "    return jnp.cumsum(c)  "
+                   "# tpulint: allow[TPU-R017] gate fixture\n")
+        if [d.code for d in hloaudit.module_diagnostics(
+                r_bad, "exec/fake.py")] != ["TPU-R017"]:
+            failures += 1
+            print("HLO: raw jnp call in exec/ did not trip TPU-R017")
+        for src, rel, why in ((r_ok, "exec/fake.py", "xp twin"),
+                              (r_allow, "exec/fake.py", "allow twin"),
+                              (r_bad, "obs/fake.py", "non-kernel path")):
+            got = [d.code for d in hloaudit.module_diagnostics(src, rel)]
+            if got:
+                failures += 1
+                print(f"HLO: R017 {why} flagged {got}")
+        # burned-in baseline: the live tree owes zero R017 findings
+        live = [d for d in hloaudit.repo_diagnostics(
+            os.path.join(REPO, "spark_rapids_tpu"))
+            if d.code == "TPU-R017"]
+        if live:
+            failures += 1
+            print(f"HLO: {len(live)} unregistered raw jnp/lax site(s) "
+                  f"in the live tree: {[d.loc for d in live[:4]]}")
+
+        # [injection] a 1M-capacity launch carrying 10 live rows must
+        # move the counter by exactly bytes*(cap-live)/cap
+        cap = 1 << 20
+        import jax.numpy as jnp
+        pathological = DeviceBatch(
+            [DeviceColumn(t.LONG, data=jnp.zeros(cap, jnp.int64))],
+            10, ["v"])
+        expect = int(batch_device_bytes(pathological)
+                     * (cap - 10) / cap)
+        before = int(sum(ch.value for _, ch in pad_fam.series()))
+        qt = QueryTrace()
+
+        class InjectedBucketExec:
+            pass
+
+        for _ in qt.trace_operator(InjectedBucketExec(), 0,
+                                   iter([pathological])):
+            pass
+        qt.finalize()
+        after = int(sum(ch.value for _, ch in pad_fam.series()))
+        if after - before != expect or expect <= 0:
+            failures += 1
+            print(f"HLO: pathological bucket moved the counter by "
+                  f"{after - before} (expected {expect})")
+
+        n_prog = len(hashes)
+        pct = cm["agreement_pct"] or 0.0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+        eb.clear_jit_cache()
+    if failures:
+        print(f"hlo gate: {failures} failure(s)")
+        return 1
+    print(f"hlo gate clean ({n_prog} persisted program(s) resolve "
+          f"deduped; cost model agrees on {pct:.0f}% of programs; pad "
+          f"books reconcile span/recompute/counter; kernel report "
+          f"ranks the grouped-aggregate and hash-join fusions with "
+          f"nonzero savings; L018/L019/L020/R017 fixtures trip with "
+          f"clean twins silent; repair arms only when a smaller "
+          f"bucket exists; injected 1M-capacity launch booked the "
+          f"exact padding delta)")
+    return 0
+
+
 def main(argv=None):
     args = argv if argv is not None else sys.argv[1:]
     if "--interp" in args:
@@ -3338,6 +3686,8 @@ def main(argv=None):
         return run_faults_gate()
     if "--dsan" in args:
         return run_dsan_gate()
+    if "--hlo" in args:
+        return run_hlo_gate()
     from spark_rapids_tpu.tools.__main__ import main as tools_main
     cli = ["lint", "--repo", "--baseline", BASELINE]
     if "--update-baseline" in args:

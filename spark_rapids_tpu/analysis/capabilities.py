@@ -169,3 +169,41 @@ def verify_gates() -> List[Tuple[str, str, t.DataType]]:
         for dt in gate_weaker_than_kernel(gate, kernel):
             out.append((name, kernel.name, dt))
     return out
+
+
+# ---------------------------------------------------------------------------
+# device-kernel table (TPU-R017)
+# ---------------------------------------------------------------------------
+# The xp-parameterization convention keeps exec// ops/ backend-agnostic:
+# kernels take `xp` and run identically on numpy for the host path.  The
+# few entry points that NEED a jax-only primitive (today: lax.sort's
+# multi-operand stable sort, which numpy has no analogue for — the host
+# path branches around it) register here so the tpuxsan repo rule
+# (TPU-R017, analysis/hloaudit.py) can tell a sanctioned kernel from an
+# accidental bypass.  Keys are package-relative paths; values map the
+# entry-point function name to the one-line reason it is device-only.
+# Nested helpers inside a registered entry point are covered by it.
+
+DEVICE_KERNELS: Dict[str, Dict[str, str]] = {
+    "ops/carry.py": {
+        "sort_rows": "multi-operand stable carry sort (lax.sort); host "
+                     "path uses np.argsort + gather instead",
+        "_sort_rows_lean": "compile-lean variant of sort_rows sharing "
+                           "one lax.sort across key widths",
+    },
+    "ops/join_kernels.py": {
+        "count_matches": "sort-based hash-match counting rides "
+                         "lax.sort's multi-operand form",
+    },
+    "ops/segmented.py": {
+        "lexsort": "multi-word lexicographic sort is lax.sort's "
+                   "is_stable multi-operand mode",
+    },
+}
+
+
+def device_kernel_functions(relpath: str) -> frozenset:
+    """Sanctioned jnp/lax-calling entry points for one module, by
+    package-relative path.  Empty for modules with no registration —
+    every raw call there is a TPU-R017 finding."""
+    return frozenset(DEVICE_KERNELS.get(relpath, ()))

@@ -1,0 +1,275 @@
+"""tpuxsan cost model: the analytic roofline for compiled programs.
+
+The reference's L4 speed comes from hand-written kernels; ours composes
+generic XLA ops.  Before anyone writes a Pallas kernel we need to say
+*where* the generic composition loses — and a ranked answer needs three
+numbers per program:
+
+* **analytic bytes** — what the program *should* move: a roofline built
+  from the ledger's capacity-bucket signatures and dtype widths times a
+  per-exec-kind pass count (how many capacity-sized sweeps the operator
+  family's composition makes).  This is deliberately an
+  order-of-magnitude model: it mirrors XLA's cost_analysis() convention
+  (every op books operands + results) closely enough to cross-validate
+  within ``spark.rapids.tpu.xsan.costTolerance``, and a model that
+  drifts past the tolerance on the golden corpus FAILS the --hlo gate —
+  a lying cost model is worse than none (the tmsan anti-vacuity
+  discipline, applied to costing).
+* **speed-of-light bytes** — what the operator's *semantics* require:
+  one read plus one write of the LIVE data.  The ratio XLA-bytes /
+  speed-of-light is the kernel gap a hand-written (Pallas) kernel could
+  close.
+* **padding waste** — the fraction of every launch that is bucket
+  padding (live rows vs capacity), booked at runtime as
+  ``tpu_pad_waste_bytes_total{exec}`` (obs/tracer.py) and estimated
+  statically here for the TPU-L018 plan rule.
+
+All three are pure functions of ledger records / interp states — no
+device, no JAX import — so the audit runs in CI on a cold checkout.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# dtype widths (ledger dtype strings are numpy/jax names)
+# ---------------------------------------------------------------------------
+
+_BITS = re.compile(r"(\d+)$")
+
+
+def dtype_width(name: str) -> int:
+    """Bytes per element of one ledger dtype string ('int64' -> 8,
+    'float32' -> 4, 'bool' -> 1).  Unknown names cost 4 (the honest
+    middle: flat lanes are int32/float32-dominated)."""
+    if name in ("bool", "bool_", "int8", "uint8"):
+        return 1
+    m = _BITS.search(name or "")
+    if m:
+        return max(1, int(m.group(1)) // 8)
+    return 4
+
+
+def record_base_bytes(rec: Dict) -> int:
+    """One capacity-sized sweep over a build record's input arrays:
+    sum(prod(shape) * width(dtype)) over the dispatch-key leaves."""
+    caps = rec.get("caps") or []
+    dtypes = rec.get("dtypes") or []
+    total = 0
+    for i, shape in enumerate(caps):
+        n = 1
+        for d in shape:
+            if isinstance(d, int):
+                n *= max(d, 1)
+        w = dtype_width(dtypes[i] if i < len(dtypes) else "")
+        total += n * w
+    return total
+
+
+# ---------------------------------------------------------------------------
+# the per-exec-kind pass model
+# ---------------------------------------------------------------------------
+# How many capacity-sized sweeps each operator family's generic-XLA
+# composition makes, in cost_analysis() convention (a fused op books its
+# operands AND its result, so even a pure elementwise map costs ~2-3x
+# the data; a lax.sort books every operand on both sides plus the
+# internal permutation traffic).  Calibrated against CPU-backend
+# cost_analysis over the golden corpus (devtools/run_lint.py --hlo);
+# the gate re-validates the calibration on every run.
+
+KIND_PASSES: Dict[str, float] = {
+    # elementwise map + compaction sort on the keep flag
+    "FilterExec": 5.0,
+    # elementwise expression evaluation, read + write
+    "ProjectExec": 3.0,
+    # multi-operand carry sort + segment reduce + group compaction
+    "TpuHashAggregateExec": 8.0,
+    # hash both sides, lexicographic sort, gather both payloads
+    "HashJoinExec": 8.0,
+    # key-word extraction + multi-operand stable sort + row gather
+    "SortExec": 6.0,
+    # partition sort + segmented scans over every frame function
+    "WindowExec": 8.0,
+}
+DEFAULT_PASSES = 3.0
+
+# The scan-composed families are NOT linear in the bucket: their
+# programs chain associative scans and multi-operand sorts whose XLA
+# lowering expands to log2(n) full-width stages each (cost_analysis
+# books ~5-12x base PER log2(n) on the golden corpus, vs the flat
+# 1.5-10x of the elementwise families above).  For these kinds the
+# pass count is `coeff * log2(max bucket dim)`; the flat KIND_PASSES
+# entry remains the memory-bound-family marker (TPU-L020) and the
+# small-n floor.
+LOG_PASS_KINDS: Dict[str, float] = {
+    "TpuHashAggregateExec": 8.0,
+    "HashJoinExec": 8.0,
+    "WindowExec": 8.0,
+}
+
+
+def record_max_dim(rec: Dict) -> int:
+    """Largest static dimension across a build record's dispatch-key
+    leaves — the bucket the scan depth scales with."""
+    n = 1
+    for shape in rec.get("caps") or []:
+        for d in shape:
+            if isinstance(d, int) and d > n:
+                n = d
+    return n
+
+
+def analytic_bytes(rec: Dict) -> int:
+    """The roofline model's bytes-accessed for one ledger build record:
+    base input bytes times the exec family's pass count (log-linear in
+    the bucket for the scan-composed families)."""
+    kind = rec.get("exec", "")
+    passes = KIND_PASSES.get(kind, DEFAULT_PASSES)
+    if kind in LOG_PASS_KINDS:
+        depth = math.log2(max(record_max_dim(rec), 2))
+        passes = max(passes, LOG_PASS_KINDS[kind] * depth)
+    return int(record_base_bytes(rec) * passes)
+
+
+def xla_bytes(rec: Dict) -> Optional[float]:
+    """XLA's own bytes-accessed for a build record, or None when the
+    backend did not report the key (absent is absent, never zero)."""
+    cost = rec.get("cost")
+    if not isinstance(cost, dict):
+        return None
+    v = cost.get("bytes accessed")
+    return None if v is None else float(v)
+
+
+def cost_agreement(rec: Dict, tolerance: float
+                   ) -> Optional[Tuple[bool, float]]:
+    """Cross-validate the analytic model against cost_analysis() for
+    one record.  Returns (within_tolerance, ratio analytic/xla), or
+    None when XLA reported no bytes (the record joins neither side of
+    the >= 90% agreement bar)."""
+    xb = xla_bytes(rec)
+    if xb is None or xb <= 0:
+        return None
+    ratio = analytic_bytes(rec) / xb
+    return (1.0 / tolerance) <= ratio <= tolerance, ratio
+
+
+def validate_model(records: Iterable[Dict], tolerance: float) -> Dict:
+    """The --hlo gate's model check over a ledger: every build record
+    with an XLA bytes-accessed figure votes; the model passes when
+    >= 90% of votes agree within the declared tolerance."""
+    checked = agreed = 0
+    worst: Optional[Tuple[float, Dict]] = None
+    for rec in records:
+        if rec.get("event") != "build":
+            continue
+        res = cost_agreement(rec, tolerance)
+        if res is None:
+            continue
+        ok, ratio = res
+        checked += 1
+        agreed += 1 if ok else 0
+        off = max(ratio, 1.0 / ratio) if ratio > 0 else float("inf")
+        if worst is None or off > worst[0]:
+            worst = (off, {"exec": rec.get("exec"),
+                           "key": rec.get("key"),
+                           "ratio": round(ratio, 3)})
+    return {
+        "checked": checked,
+        "agreed": agreed,
+        "agreement_pct": (100.0 * agreed / checked) if checked else None,
+        "tolerance": tolerance,
+        "worst": worst[1] if worst else None,
+    }
+
+
+# ---------------------------------------------------------------------------
+# speed of light + the kernel gap
+# ---------------------------------------------------------------------------
+
+def speed_of_light_bytes(live_bytes: float) -> float:
+    """What the semantics require: read the live data once, write the
+    live result once.  The floor every kernel gap is measured against."""
+    return 2.0 * max(float(live_bytes), 1.0)
+
+
+def kernel_gap(xla_bytes_accessed: float, live_bytes: float) -> float:
+    """How many times more memory traffic the compiled program makes
+    than a speed-of-light kernel over the live data (>= 1.0)."""
+    return max(float(xla_bytes_accessed) /
+               speed_of_light_bytes(live_bytes), 1.0)
+
+
+def projected_savings_s(measured_s: float, gap: float,
+                        pad_ratio: float) -> float:
+    """Seconds a hand-written kernel over live (unpadded) data could
+    save: the measured time minus its speed-of-light share, where the
+    gap already folds in the padded traffic and `pad_ratio` credits the
+    launch-grain waste a dynamic-shape kernel also erases."""
+    gap = max(float(gap), 1.0)
+    base = measured_s * (1.0 - 1.0 / gap)
+    # padding the gap model didn't see (host-measured launches)
+    extra = measured_s * (1.0 / gap) * min(max(pad_ratio, 0.0), 0.99)
+    return base + extra
+
+
+# ---------------------------------------------------------------------------
+# static padding-waste model (the TPU-L018 input)
+# ---------------------------------------------------------------------------
+
+def pad_waste_for(rows: float, capacity: int, row_width: float
+                  ) -> Tuple[float, int]:
+    """(waste ratio, wasted bytes) for `rows` live rows launched at
+    `capacity` with `row_width` bytes per row."""
+    capacity = max(int(capacity), 1)
+    live = min(max(float(rows), 0.0), float(capacity))
+    ratio = 1.0 - live / capacity
+    return ratio, int((capacity - live) * max(row_width, 1))
+
+
+# Operators whose output batches KEEP the input batch's capacity: the
+# filter compacts survivors to the front and shrinks num_rows only,
+# and the projection rewrites columns in place.  Everything else
+# (aggregate, join, sort, exchange) emits freshly-bucketed batches.
+CAPACITY_PRESERVING = frozenset({"FilterExec", "ProjectExec"})
+
+
+def plan_pad_waste(root, conf, infer_result) -> List[Dict]:
+    """Static per-node padding-waste table for one plan: the interp's
+    row estimates vs the capacity each node's batches actually launch
+    at.  Capacity propagates bottom-up — a filter's output keeps its
+    input bucket (compaction shrinks num_rows, never capacity), which
+    is exactly the waste the TPU-L018 re-bucket repair erases.  Pure
+    planning-time arithmetic — the runtime books the measured twin via
+    obs/tracer.py."""
+    from ..columnar.device import bucket_for
+    from .absdomain import schema_width
+    buckets = conf.capacity_buckets
+    out: List[Dict] = []
+
+    def walk(node, path) -> Optional[int]:
+        """Returns the node's output-batch capacity estimate."""
+        here = f"{path} > {node.name}" if path else node.name
+        child_caps = [walk(c, here) for c in node.children]
+        st = infer_result.states.get(id(node)) if infer_result else None
+        rows = getattr(st, "rows", None) if st is not None else None
+        if rows is None or rows <= 0:
+            return None
+        if (type(node).__name__ in CAPACITY_PRESERVING and child_caps
+                and child_caps[0]):
+            cap = max(child_caps[0], bucket_for(int(rows), buckets))
+        else:
+            cap = bucket_for(int(rows), buckets)
+        width = schema_width(node.output_types)
+        ratio, waste = pad_waste_for(rows, cap, width)
+        out.append({"node": node, "path": here,
+                    "rows": float(rows), "capacity": cap,
+                    "row_width": width, "waste_ratio": ratio,
+                    "waste_bytes": waste})
+        return cap
+
+    walk(root, "")
+    return out

@@ -450,6 +450,17 @@ def lint_plan(root: eb.Exec, conf: cfg.RapidsConf,
                 "TPU-L000", INFO,
                 f"determinism pass failed ({ex}); replay rules "
                 f"skipped", loc=root.name))
+    if conf.get(cfg.XSAN_ENABLED) and interp_result is not None:
+        # tpuxsan program-efficiency rules (TPU-L018/L020) ride the
+        # same interp states; a failed pass degrades like the others
+        try:
+            from .hloaudit import audit_plan
+            diags.extend(audit_plan(root, conf, interp_result))
+        except Exception as ex:
+            diags.append(Diagnostic(
+                "TPU-L000", INFO,
+                f"tpuxsan pass failed ({ex}); efficiency rules "
+                f"skipped", loc=root.name))
     disabled = conf.raw("spark.rapids.tpu.lint.disable", "") or ""
     return sort_diagnostics(filter_suppressed(diags, disabled.split(",")))
 
@@ -486,6 +497,18 @@ def downgrade_hazards(root: eb.Exec, diags: List[Diagnostic],
             if d.code == "TPU-L016" and d.node is not None:
                 try:
                     if try_stabilize_repair(root, d.node, conf):
+                        repaired.add(id(d.node))
+                except Exception:
+                    pass  # unrepairable: diagnostic stands
+        # TPU-L018's repair re-buckets the nearest filter speculatively
+        # (hloaudit.try_rebucket_repair); a host flip would trade
+        # padding for losing the device entirely, so like L016 it never
+        # joins the flip set below
+        from .hloaudit import try_rebucket_repair
+        for d in diags:
+            if d.code == "TPU-L018" and d.node is not None:
+                try:
+                    if try_rebucket_repair(root, d.node, conf):
                         repaired.add(id(d.node))
                 except Exception:
                     pass  # unrepairable: diagnostic stands

@@ -471,12 +471,13 @@ def lint_repo(root: Optional[str] = None) -> List[Diagnostic]:
     violations (baseline subtraction is the caller's concern)."""
     root = root or _package_root()
     from .diagnostics import sort_diagnostics
-    from . import concurrency, determinism, raiseflow
+    from . import concurrency, determinism, hloaudit, raiseflow
     return sort_diagnostics(_ast_diagnostics(root) +
                             _registry_diagnostics() +
                             concurrency.repo_diagnostics(root) +
                             raiseflow.repo_diagnostics(root) +
-                            determinism.repo_diagnostics(root))
+                            determinism.repo_diagnostics(root) +
+                            hloaudit.repo_diagnostics(root))
 
 
 # ---------------------------------------------------------------------------

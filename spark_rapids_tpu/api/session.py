@@ -12,6 +12,7 @@ way the reference's integration tests do.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Dict, List, Optional
 
@@ -101,11 +102,16 @@ class TpuSession:
         if ledger_dir:
             from ..obs.history import HistoryDir
             ledger_path = HistoryDir(ledger_dir).compile_ledger_path()
+        hlo_dir = conf.get(cfg.XSAN_HLO_DIR)
+        if not hlo_dir and ledger_dir:
+            from ..obs.compileprof import HLO_SUBDIR
+            hlo_dir = os.path.join(ledger_dir, HLO_SUBDIR)
         CompileObservatory.get().configure(
             enabled=conf.get(cfg.COMPILE_OBSERVATORY_ENABLED),
             ledger_path=ledger_path,
             buckets=conf.capacity_buckets + conf.string_data_buckets,
-            thrash_warn_ratio=conf.get(cfg.JIT_THRASH_WARN_RATIO))
+            thrash_warn_ratio=conf.get(cfg.JIT_THRASH_WARN_RATIO),
+            hlo_dir=hlo_dir or None)
         # estimator observatory: predicted-vs-actual per operator
         # signature, persisted next to the compile ledger; recording is
         # always on, feedback.enabled additionally blends it back into

@@ -44,6 +44,19 @@ def bucket_for(n: int, buckets: Sequence[int]) -> int:
     return 1 << math.ceil(math.log2(n))
 
 
+def bucket_floor(target: int, buckets: Sequence[int]) -> int:
+    """Largest bucket <= target; below the smallest, the smallest bucket.
+    The dual of ``bucket_for``: sizing DOWN to a capacity that fits a
+    budget (sort's spill chunk sizing, the TPU-L018 re-bucket repair)
+    instead of UP to one that fits the data."""
+    target = int(target)
+    floor = buckets[0]
+    for b in buckets:
+        if b <= target:
+            floor = b
+    return floor
+
+
 class DeviceColumn:
     """One column of device data.  A pytree; static aux is the SQL dtype."""
 
@@ -146,6 +159,49 @@ class DeviceBatch:
 
 jax.tree_util.register_pytree_node(
     DeviceBatch, DeviceBatch.tree_flatten, DeviceBatch.tree_unflatten)
+
+
+# ---------------------------------------------------------------------------
+# capacity shrink (the TPU-L018 speculative re-bucket)
+# ---------------------------------------------------------------------------
+
+def shrink_column(col: DeviceColumn, cap: int) -> DeviceColumn:
+    """Slice the leading `cap` rows of a column's row-dimension arrays
+    (static shapes: `cap` is a Python int known at trace time).  Only
+    sound when the live rows sit at the front (a compacted filter
+    output) and their count is <= cap — the caller guards that with the
+    speculation machinery.  Char data and span children keep their own
+    capacities (they are byte/element-bucketed, not row-bucketed)."""
+    dtype = col.dtype
+    validity = None if col.validity is None else col.validity[:cap]
+    if isinstance(dtype, (t.StringType, t.BinaryType)):
+        return DeviceColumn(dtype, data=col.data, validity=validity,
+                            offsets=col.offsets[:cap + 1])
+    if isinstance(dtype, (t.ArrayType, t.MapType)):
+        return DeviceColumn(dtype, validity=validity,
+                            offsets=col.offsets[:cap + 1],
+                            children=col.children)
+    if isinstance(dtype, t.StructType):
+        return DeviceColumn(dtype, validity=validity,
+                            children=tuple(shrink_column(c, cap)
+                                           for c in col.children))
+    return DeviceColumn(
+        dtype,
+        data=None if col.data is None else col.data[:cap],
+        validity=validity,
+        data_hi=None if col.data_hi is None else col.data_hi[:cap])
+
+
+def shrink_batch(batch: DeviceBatch, cap: int) -> DeviceBatch:
+    """Re-bucket a batch DOWN to row capacity `cap` by slicing every
+    column's leading rows.  num_rows rides along unchanged (still the
+    traced live count); correctness requires num_rows <= cap, which the
+    caller asserts via a speculation guard (exec/base.py
+    SpeculativeSizingMiss re-executes on a missed guess)."""
+    if cap >= batch.capacity:
+        return batch
+    return DeviceBatch([shrink_column(c, cap) for c in batch.columns],
+                       batch.num_rows, batch.names)
 
 
 # ---------------------------------------------------------------------------

@@ -732,6 +732,65 @@ DSAN_DIGEST_ENABLED = conf("spark.rapids.tpu.dsan.digest.enabled") \
          "replaces bit-for-bit).") \
     .create_with_default(True)
 
+# --- program-efficiency sanitizer (tpuxsan) -------------------------------
+
+XSAN_ENABLED = conf("spark.rapids.tpu.xsan.enabled").boolean() \
+    .doc("Run the compiled-program efficiency pass (analysis/hloaudit.py) "
+         "as part of the plan lint: per-subtree padding-waste accounting "
+         "against the capacity buckets (TPU-L018, repairable by "
+         "speculative re-bucketing through the pre-flight downgrade "
+         "machinery) and the fusion-break roofline check (TPU-L020).  "
+         "The StableHLO ledger audit (TPU-L019 host transfers, analytic "
+         "cost-model cross-validation) rides the compile observatory's "
+         "persisted programs (devtools/run_lint.py --hlo).") \
+    .create_with_default(True)
+
+XSAN_PAD_WASTE_MAX = conf("spark.rapids.tpu.xsan.padWasteMax").double() \
+    .doc("TPU-L018 threshold: flag a subtree whose padding-waste ratio "
+         "(1 - live rows / capacity bucket) exceeds this AND whose "
+         "wasted bytes exceed xsan.padWasteMinBytes.  Capacity buckets "
+         "are ~8x apart, so ratios up to ~0.87 are the normal cost of "
+         "shape-stable compilation; above this the launch is mostly "
+         "padding.") \
+    .check(lambda v: 0.0 < v <= 1.0, "must be in (0, 1]") \
+    .create_with_default(0.95)
+
+XSAN_PAD_WASTE_MIN_BYTES = conf(
+    "spark.rapids.tpu.xsan.padWasteMinBytes").bytes() \
+    .doc("TPU-L018 floor: subtrees wasting fewer padded bytes than this "
+         "per launch are never flagged, whatever their ratio — tiny "
+         "batches on the smallest bucket are not worth re-bucketing.") \
+    .create_with_default(1024 * 1024)
+
+XSAN_HLO_DIR = conf("spark.rapids.tpu.xsan.hloDir").string() \
+    .doc("Directory the compile observatory persists lowered StableHLO "
+         "text into (blake2-keyed, per-program dedupe, 2 MB cap).  "
+         "Default: an hlo/ subdir of the compile ledger dir "
+         "(spark.rapids.tpu.compile.ledgerDir / regress.historyDir); "
+         "no ledger dir means no persistence.") \
+    .create_optional()
+
+XSAN_COST_TOLERANCE = conf("spark.rapids.tpu.xsan.costTolerance") \
+    .double() \
+    .doc("Cross-validation tolerance between the analytic cost model "
+         "(analysis/hlocost.py roofline) and XLA's own cost_analysis() "
+         "bytes-accessed: the ratio analytic/XLA must land in "
+         "[1/tol, tol].  The model is an order-of-magnitude roofline "
+         "(it catches unit errors, missing operands and capacity/live "
+         "confusion, not instruction scheduling); drift past the "
+         "tolerance on the golden corpus fails the --hlo gate itself "
+         "(anti-vacuity: a lying model is a gate failure).") \
+    .check(lambda v: v >= 1.0, "must be >= 1.0") \
+    .create_with_default(8.0)
+
+XSAN_BROADCAST_BYTES_MAX = conf(
+    "spark.rapids.tpu.xsan.broadcastBytesMax").bytes() \
+    .doc("StableHLO audit bound: a materialized broadcast_in_dim "
+         "intermediate larger than this inside one compiled program is "
+         "reported as a fusion hazard (the broadcast should stay fused "
+         "into its consumer, not hit HBM).") \
+    .create_with_default(16 * 1024 * 1024)
+
 # --- observability (flight recorder) --------------------------------------
 
 TRACE_ENABLED = conf("spark.rapids.tpu.trace.enabled").boolean() \

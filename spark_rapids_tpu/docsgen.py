@@ -66,10 +66,11 @@ def generate_lint_rules() -> str:
     # flow-sensitive rules TPU-L009..L012, lifetime the tmsan memory
     # rules TPU-L013..L015, concurrency the tpucsan rules
     # TPU-R008..R010, raiseflow the tpufsan rules TPU-R011..R014,
-    # determinism the tpudsan rules TPU-L016/L017 + TPU-R015/R016)
+    # determinism the tpudsan rules TPU-L016/L017 + TPU-R015/R016,
+    # hloaudit the tpuxsan rules TPU-L018..L020 + TPU-R017)
     from .analysis import (concurrency, determinism,  # noqa: F401
-                           interp, lifetime, plan_lint, raiseflow,
-                           repo_lint)
+                           hloaudit, interp, lifetime, plan_lint,
+                           raiseflow, repo_lint)
     from .analysis.diagnostics import RULE_CATALOG
     lines = [
         "# tpulint rule catalog",

@@ -332,8 +332,9 @@ def _collect_update(xp, vc: DeviceColumn, seg_ids, contrib, cap: int,
     else:
         from jax import lax
         iota = xp.arange(cap, dtype=xp.int32)
-        order3 = lax.sort(((~keep).astype(xp.int32), iota), num_keys=1,
-                          is_stable=True)[1]
+        order3 = lax.sort(  # tpulint: allow[TPU-R017] group-compaction sort inline in the aggregate update/merge; host branch above uses np.argsort
+            ((~keep).astype(xp.int32), iota), num_keys=1,
+            is_stable=True)[1]
     child = gather_column(xp, perm, order3, keep[order3])
     cnt, _ = seg.segment_reduce(xp, "sum", keep.astype(np.int32), sids,
                                 cap, keep, sorted_ids=True)
@@ -382,8 +383,9 @@ def _collect_merge(xp, vc: DeviceColumn, order, seg_ids, contrib, cap: int,
     else:
         from jax import lax
         iota = xp.arange(child_cap, dtype=xp.int32)
-        order3 = lax.sort(((~keep).astype(xp.int32), iota), num_keys=1,
-                          is_stable=True)[1]
+        order3 = lax.sort(  # tpulint: allow[TPU-R017] group-compaction sort inline in the aggregate update/merge; host branch above uses np.argsort
+            ((~keep).astype(xp.int32), iota), num_keys=1,
+            is_stable=True)[1]
     final_child = gather_column(xp, child_s, order3, keep[order3])
     cseg_s = cseg[order2]
     cnt, _ = seg.segment_reduce(xp, "sum", keep.astype(np.int64), cseg_s,
@@ -768,15 +770,13 @@ class TpuHashAggregateExec(Exec):
             chunk_rows = max(int(p.num_rows) for p in partials)
             if self.oc_budget is not None:
                 # snap down to a capacity bucket (off-bucket chunks pad UP)
-                from ..columnar.device import DEFAULT_ROW_BUCKETS
+                from ..columnar.device import (DEFAULT_ROW_BUCKETS,
+                                               bucket_floor)
                 rows_total = sum(int(p.num_rows) for p in partials)
                 bpr = max(total / max(rows_total, 1), 1.0)
                 target = int(budget / (2 * bpr))
-                floor = DEFAULT_ROW_BUCKETS[0]
-                for b in DEFAULT_ROW_BUCKETS:
-                    if b <= target:
-                        floor = b
-                chunk_rows = min(chunk_rows, floor)
+                chunk_rows = min(chunk_rows,
+                                 bucket_floor(target, DEFAULT_ROW_BUCKETS))
             with MetricTimer(self.metrics[OP_TIME]):
                 for m in merge_partials_bounded(
                         xp, partials, merge_fn, sortkeys_fn, schema_names,

@@ -268,6 +268,10 @@ class FilterExec(Exec):
         from ..expr.params import parameterize_exprs
         trees, self._params = parameterize_exprs([bound])
         self._bound = trees[0]
+        # armed by the TPU-L018 pre-flight repair
+        # (analysis/hloaudit.try_rebucket_repair): shrink compacted
+        # output to this bucket under a deferred speculation guard
+        self.rebucket_cap: Optional[int] = None
 
     @property
     def output_names(self):
@@ -331,6 +335,17 @@ class FilterExec(Exec):
                 else:
                     out = self._jitted(b) if self.placement == TPU \
                         else self._compute(np, b)
+                cap = self.rebucket_cap
+                if (cap is not None and self.placement == TPU and
+                        ctx.speculation_enabled and cap < out.capacity):
+                    # speculative re-bucket (TPU-L018 repair): survivors
+                    # are compacted to the front, so slicing to the
+                    # right-sized bucket is exact whenever the guard
+                    # holds; a missed guess re-executes the query with
+                    # speculation disabled before results surface
+                    from ..columnar.device import shrink_batch
+                    ctx.add_spec_guard(out.num_rows <= cap)
+                    out = shrink_batch(out, cap)
                 maybe_sync(out)
             if self._needs_rowpos:
                 offset += int(b.num_rows)

@@ -150,15 +150,13 @@ class SortExec(Exec):
                 # two-run merge group stays within it; snap DOWN to a
                 # capacity bucket — an off-bucket chunk pads UP to the next
                 # bucket and would inflate real memory instead
-                from ..columnar.device import DEFAULT_ROW_BUCKETS
+                from ..columnar.device import (DEFAULT_ROW_BUCKETS,
+                                               bucket_floor)
                 rows_total = sum(int(p.num_rows) for p in pending)
                 bpr = max(total / max(rows_total, 1), 1.0)
                 target = int(budget / (2 * bpr))
-                floor = DEFAULT_ROW_BUCKETS[0]
-                for b in DEFAULT_ROW_BUCKETS:
-                    if b <= target:
-                        floor = b
-                chunk_rows = min(chunk_rows, floor)
+                chunk_rows = min(chunk_rows,
+                                 bucket_floor(target, DEFAULT_ROW_BUCKETS))
             with MetricTimer(self.metrics[OP_TIME]):
                 for out in external_merge_sort(
                         xp, pending, sort_fn, self.output_names,

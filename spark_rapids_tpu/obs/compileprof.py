@@ -74,6 +74,42 @@ log = logging.getLogger("spark_rapids_tpu.obs.compileprof")
 LEDGER_FILENAME = "compile_ledger.jsonl"
 LEDGER_VERSION = 1
 
+# lowered-StableHLO persistence (the tpuxsan audit's raw material):
+# blake2-keyed text files, deduped per program, size-capped so a
+# pathological giant program cannot bloat the ledger dir
+HLO_SUBDIR = "hlo"
+HLO_SUFFIX = ".stablehlo.mlir"
+HLO_MAX_BYTES = 2 * 1024 * 1024
+
+# the canonical cost_analysis keys the audit consumes.  XLA backends
+# report DIFFERENT subsets (CPU omits transcendentals and sometimes
+# flops): only keys the backend actually returned are recorded — an
+# absent key is absent, never zero.
+COST_KEYS = ("flops", "bytes accessed", "transcendentals")
+
+
+def hlo_key(text: str) -> str:
+    """Content key of one lowered program's StableHLO text."""
+    return hashlib.blake2b(text.encode("utf-8", "replace"),
+                           digest_size=8).hexdigest()
+
+
+def cost_summary(compiled) -> Optional[Dict[str, float]]:
+    """The executable's own cost_analysis(), distilled to the canonical
+    keys it actually reported.  Returns None when the backend offers no
+    analysis at all — callers must treat that as 'unknown', not free."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out = {k: float(ca[k]) for k in COST_KEYS
+           if k in ca and ca[k] is not None}
+    return out or None
+
 # miss-cause taxonomy (closed: every build carries exactly one)
 CAUSE_NEW = "new_program"
 CAUSE_SHAPE = "shape_churn"
@@ -241,6 +277,7 @@ class CompileObservatory:
         self._lock = threading.RLock()
         self.enabled = True
         self.ledger_path: Optional[str] = None
+        self.hlo_dir: Optional[str] = None
         self.thrash_warn_ratio = 0.5
         self.buckets = frozenset(_DEFAULT_BUCKETS)
         # program index: pid = (key_hash, shape_hash)
@@ -286,10 +323,13 @@ class CompileObservatory:
     def configure(self, enabled: Optional[bool] = None,
                   ledger_path: Optional[str] = None,
                   buckets=None,
-                  thrash_warn_ratio: Optional[float] = None) -> None:
+                  thrash_warn_ratio: Optional[float] = None,
+                  hlo_dir: Optional[str] = None) -> None:
         """Session-init wiring.  Setting a ledger path loads the prior
         sessions' program index, so cross-session rebuilds classify as
-        refaults instead of novel work."""
+        refaults instead of novel work.  `hlo_dir` turns on lowered-
+        StableHLO persistence (tpuxsan's raw material); the session
+        defaults it to an hlo/ subdir next to the ledger."""
         with self._lock:
             if enabled is not None:
                 self.enabled = bool(enabled)
@@ -297,10 +337,36 @@ class CompileObservatory:
                 self.buckets = frozenset(int(b) for b in buckets)
             if thrash_warn_ratio is not None:
                 self.thrash_warn_ratio = float(thrash_warn_ratio)
+            if hlo_dir is not None:
+                self.hlo_dir = hlo_dir or None
             if ledger_path is not None and \
                     ledger_path != self.ledger_path:
                 self.ledger_path = ledger_path
                 self._load_ledger(ledger_path)
+
+    def save_hlo(self, text: str) -> Tuple[str, bool]:
+        """Persist one program's StableHLO text under its content key.
+        Returns (key, persisted).  Dedupe is by filename: a program
+        already on disk (this session or a prior one) is not rewritten.
+        Oversized programs (> HLO_MAX_BYTES) record their key and size
+        in the ledger but are not persisted."""
+        key = hlo_key(text)
+        d = self.hlo_dir
+        if d is None or len(text) > HLO_MAX_BYTES:
+            return key, False
+        path = os.path.join(d, key + HLO_SUFFIX)
+        if os.path.exists(path):
+            return key, True
+        try:
+            os.makedirs(d, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(text)
+            os.replace(tmp, path)
+        except OSError as ex:  # persistence is telemetry, never fatal
+            log.warning("HLO persist failed: %s", ex)
+            return key, False
+        return key, True
 
     def _load_ledger(self, path: str) -> None:
         if not os.path.exists(path):
@@ -493,7 +559,9 @@ class CompileObservatory:
                      canon_key: str, sig: tuple,
                      trace_s: Optional[float],
                      compile_s: Optional[float], total_s: float,
-                     hlo_bytes: int, key_head: str) -> str:
+                     hlo_bytes: int, key_head: str,
+                     hlo_hash: Optional[str] = None,
+                     cost: Optional[Dict[str, float]] = None) -> str:
         """Register one program build; returns the classified cause."""
         shape_hash, dtype_sig, cap_sig, canon_caps = \
             _shape_record(sig, self.buckets)
@@ -548,6 +616,10 @@ class CompileObservatory:
             "compile_s": None if compile_s is None
             else round(compile_s, 6),
             "total_s": round(total_s, 6), "hlo_bytes": hlo_bytes,
+            # tpuxsan: content key of the persisted StableHLO (None =
+            # not captured) and the backend's own cost_analysis keys —
+            # ONLY those the backend reported (absent != zero)
+            "hlo_hash": hlo_hash, "cost": cost,
             "dtypes": list(dtype_sig),
             "caps": [list(s) for s in cap_sig],
             "canon_caps": [list(s) for s in canon_caps],
@@ -695,16 +767,20 @@ class _ProfiledJit:
         t0 = time.perf_counter()
         trace_s = compile_s = None
         hlo_bytes = 0
+        hlo_hash = cost = None
         try:
             lowered = self._jitted.lower(*args)
             t1 = time.perf_counter()
             trace_s = t1 - t0
             try:
-                hlo_bytes = len(lowered.as_text())
+                text = lowered.as_text()
+                hlo_bytes = len(text)
+                hlo_hash, _ = self._obs.save_hlo(text)
             except Exception:
                 hlo_bytes = 0
             fn = lowered.compile()
             compile_s = time.perf_counter() - t1
+            cost = cost_summary(fn)
             self._obs.save_recipe_for(self._key, self._key_hash,
                                       self._fn, args)
         except Exception:
@@ -717,7 +793,8 @@ class _ProfiledJit:
         self._obs.record_build(self._exec, self._key_hash,
                                self._canon_key, sig, trace_s,
                                compile_s, total_s, hlo_bytes,
-                               self._key_head)
+                               self._key_head, hlo_hash=hlo_hash,
+                               cost=cost)
         return fn
 
 

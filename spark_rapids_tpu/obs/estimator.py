@@ -261,8 +261,12 @@ class EstimatorLedger:
 
     # -- recording -----------------------------------------------------------
     def observe(self, exec_kind: str, sig: str,
-                pred_rows, act_rows, pred_bytes, act_bytes) -> None:
-        """One closed operator span's predicted-vs-actual."""
+                pred_rows, act_rows, pred_bytes, act_bytes,
+                time_ns=None, pad_waste_bytes=None) -> None:
+        """One closed operator span's predicted-vs-actual.  `time_ns`
+        (measured span time) and `pad_waste_bytes` (capacity-padding
+        bytes) feed the tpuxsan kernel-gap report; None = the trace did
+        not carry them (old producers), never zero."""
         if not self.enabled:
             return
         rows_err = _rel_err(pred_rows, act_rows)
@@ -291,7 +295,10 @@ class EstimatorLedger:
             "rows_err": None if rows_err is None
             else round(rows_err, 6),
             "bytes_err": None if bytes_err is None
-            else round(bytes_err, 6)})
+            else round(bytes_err, 6),
+            "time_ns": None if time_ns is None else int(time_ns),
+            "pad_waste_bytes": None if pad_waste_bytes is None
+            else int(pad_waste_bytes)})
 
     def observe_peak(self, static_bound, measured_peak) -> None:
         """Query-level measured peak device bytes vs the tmsan static
@@ -325,7 +332,9 @@ class EstimatorLedger:
                 continue
             self.observe(pred.get("node", "?"), sig,
                          pred.get("rows"), act.get("rows", 0),
-                         pred.get("bytes"), act.get("bytes", 0))
+                         pred.get("bytes"), act.get("bytes", 0),
+                         time_ns=act.get("timeNs"),
+                         pad_waste_bytes=act.get("padWasteBytes"))
             n += 1
         if measured_peak is not None:
             self.observe_peak(static_bound, measured_peak)

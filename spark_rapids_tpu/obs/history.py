@@ -51,7 +51,8 @@ DETERMINISTIC_FIELDS = ("plan_shape", "operators", "fallback_ops",
                         "replay_class")
 #: advisory fields (never compared in CI)
 TIMING_FIELDS = ("wall_ms", "operator_time_ns", "peak_device_bytes",
-                 "compile_seconds", "estimate_rows_err")
+                 "compile_seconds", "estimate_rows_err",
+                 "pad_waste_ratio")
 
 
 # ---------------------------------------------------------------------------
@@ -69,6 +70,8 @@ def query_fingerprint(sql, spans: List[dict]) -> Dict:
     fallback: List[str] = []
     time_ns = 0
     est_errs: List[float] = []
+    pad_bytes = None  # None until some actual carries the key
+    total_bytes = 0
     for n in sql.plan.walk():
         act = n.actual or {}
         agg = operators.setdefault(
@@ -77,6 +80,10 @@ def query_fingerprint(sql, spans: List[dict]) -> Dict:
         agg["bytes"] += int(act.get("bytes") or 0)
         agg["batches"] += int(act.get("batches") or 0)
         time_ns += int(act.get("timeNs") or 0)
+        total_bytes += int(act.get("bytes") or 0)
+        if "padWasteBytes" in act:
+            pad_bytes = (pad_bytes or 0) + \
+                int(act.get("padWasteBytes") or 0)
         if getattr(n, "placement", None) == "cpu":
             fallback.append(n.node_name)
         pred = getattr(n, "prediction", None)
@@ -132,6 +139,12 @@ def query_fingerprint(sql, spans: List[dict]) -> Dict:
         # observatory, so pre-feedback histories never false-trip
         "estimate_rows_err": round(sum(est_errs) / len(est_errs), 6)
         if est_errs else None,
+        # advisory tpuxsan padding-waste share (timing class: batch
+        # split and speculative re-bucketing legitimately move it);
+        # None when the log predates pad accounting, so mixed
+        # histories never false-trip
+        "pad_waste_ratio": round(pad_bytes / total_bytes, 6)
+        if pad_bytes is not None and total_bytes else None,
     }
 
 

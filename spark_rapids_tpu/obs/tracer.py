@@ -48,7 +48,7 @@ class Span:
 
     __slots__ = ("span_id", "parent_id", "name", "kind", "t0_ns", "t1_ns",
                  "tid", "status", "error", "attrs", "node_id", "pid",
-                 "rows", "bytes", "batches", "proc")
+                 "rows", "bytes", "batches", "cap_rows", "proc")
 
     def __init__(self, span_id: int, parent_id: Optional[int], name: str,
                  kind: str, t0_ns: int, tid: int,
@@ -70,6 +70,10 @@ class Span:
         self.rows = 0
         self.bytes = 0
         self.batches = 0
+        # summed static batch capacities (tpuxsan padding-waste books:
+        # device bytes are capacity-sized, so waste = bytes * (1 -
+        # rows/cap_rows) once deferred row counts resolve)
+        self.cap_rows = 0
         # producing process for merged remote spans (executor id or
         # "server:<port>"); None = this process.  NOT `pid` — that slot
         # is the PARTITION id.
@@ -78,6 +82,15 @@ class Span:
     @property
     def dur_ns(self) -> int:
         return 0 if self.t1_ns is None else self.t1_ns - self.t0_ns
+
+    def pad_waste_bytes(self) -> int:
+        """Device bytes this span's output batches spent on capacity
+        padding: bytes are capacity-sized, rows are live.  Only valid
+        after deferred row counts resolve (finalize)."""
+        if self.cap_rows <= 0 or self.bytes <= 0:
+            return 0
+        live = min(max(int(self.rows), 0), self.cap_rows)
+        return int(self.bytes * (self.cap_rows - live) / self.cap_rows)
 
 
 class _SpanHandle:
@@ -274,6 +287,12 @@ class QueryTrace:
                 sp.bytes += batch_device_bytes(batch)
             except Exception:
                 pass
+            try:
+                cap = getattr(batch, "capacity", None)
+                if cap:
+                    sp.cap_rows += int(cap)
+            except Exception:
+                pass
 
     # -- fleet merge ---------------------------------------------------------
     def add_remote_spans(self, parent_sid: Optional[int],
@@ -406,16 +425,37 @@ class QueryTrace:
             m.counter("tpu_trace_dropped_spans_total",
                       "spans dropped past trace.maxSpans") \
                 .inc(self.dropped)
+        pad_fam = m.counter("tpu_pad_waste_bytes_total",
+                            "device bytes occupied by capacity-bucket "
+                            "padding (live rows vs bucket capacity, "
+                            "per launch; tpuxsan TPU-L018 books)",
+                            ("exec",))
+        bytes_fam = m.counter("tpu_operator_bytes_total",
+                              "device bytes flowing through operator "
+                              "spans (the pad-waste ratio denominator)",
+                              ("exec",))
         for sp in self.spans:
             if sp.kind != OPERATOR or sp.node_id is None:
                 continue
             agg = self.actuals.setdefault(
                 sp.node_id, {"rows": 0, "bytes": 0, "batches": 0,
-                             "timeNs": 0, "node": sp.attrs.get("op", "")})
+                             "timeNs": 0, "padWasteBytes": 0,
+                             "node": sp.attrs.get("op", "")})
             agg["rows"] += sp.rows
             agg["bytes"] += sp.bytes
             agg["batches"] += sp.batches
             agg["timeNs"] += sp.dur_ns
+            waste = sp.pad_waste_bytes()
+            agg["padWasteBytes"] += waste
+            try:
+                if sp.bytes:
+                    bytes_fam.labels(
+                        exec=sp.attrs.get("op", "?")).inc(sp.bytes)
+                if waste:
+                    pad_fam.labels(
+                        exec=sp.attrs.get("op", "?")).inc(waste)
+            except Exception:
+                pass
 
     # -- reports -------------------------------------------------------------
     def open_span_count(self) -> int:
@@ -445,6 +485,8 @@ class QueryTrace:
                     d["rows"] = int(s.rows)
                     d["bytes"] = int(s.bytes)
                     d["batches"] = int(s.batches)
+                    d["capRows"] = int(s.cap_rows)
+                    d["padWasteBytes"] = s.pad_waste_bytes()
                 out.append(d)
         return out
 

@@ -11,6 +11,7 @@
     python -m spark_rapids_tpu.tools regress --history DIR --check [--wall-threshold PCT]
     python -m spark_rapids_tpu.tools compile-report --ledger PATH [--top N] [--json]
     python -m spark_rapids_tpu.tools estimator-report --ledger PATH [--top N] [--json]
+    python -m spark_rapids_tpu.tools kernel-report  --compile-ledger PATH --estimator-ledger PATH [--top N] [--json]
     python -m spark_rapids_tpu.tools prewarm        --ledger DIR [--top K] [--cache-dir DIR]
     python -m spark_rapids_tpu.tools postmortem     <bundle.json|dir> [--json] [--last N]
 
@@ -35,6 +36,14 @@ planner calibration score, the exec kinds with the worst row-estimate
 error (where feedback blending buys the most), the peak-HBM
 bound-vs-measured error, and the exchange-boundary re-plan decisions
 by (decision, cause).
+
+`kernel-report` is the tpuxsan headline artifact: it joins the compile
+ledger's per-program cost_analysis() figures against the estimator
+ledger's measured span seconds and padding-waste bytes, computes each
+exec kind's speed-of-light gap (analysis/hlocost.py), and ranks the
+kinds and the named fusion pipelines (hash build/probe,
+filter->project, grouped aggregate) by projected kernel savings — the
+evidence that decides which Pallas kernel to write first.
 
 `regress` is the cross-run watchdog (obs/history.py): --record distills
 self-emitted event logs into per-query fingerprints appended to the
@@ -517,6 +526,23 @@ def main(argv=None):
                     help="rows per ranking section")
     cr.add_argument("--json", action="store_true",
                     help="emit the aggregate as JSON instead of text")
+    kr = sub.add_parser("kernel-report",
+                        help="rank compiled programs by kernel gap x "
+                             "measured seconds x padding waste (the "
+                             "Pallas target list)")
+    kr.add_argument("--compile-ledger", required=True,
+                    help="compile_ledger.jsonl or the dir containing "
+                         "it (spark.rapids.tpu.compile.ledgerDir)")
+    kr.add_argument("--estimator-ledger", required=True,
+                    help="estimator_ledger.jsonl or the dir containing "
+                         "it (spark.rapids.tpu.regress.historyDir)")
+    kr.add_argument("--top", type=int, default=10,
+                    help="rows per ranking section")
+    kr.add_argument("--tolerance", type=float, default=8.0,
+                    help="cost-model agreement ratio "
+                         "(spark.rapids.tpu.xsan.costTolerance)")
+    kr.add_argument("--json", action="store_true",
+                    help="emit the aggregate as JSON instead of text")
     er = sub.add_parser("estimator-report",
                         help="aggregate the estimator observatory "
                              "ledger into the planner calibration "
@@ -589,6 +615,12 @@ def main(argv=None):
         from .compile_report import run_compile_report
         return run_compile_report(args.ledger, top=args.top,
                                   as_json=args.json)
+    elif args.cmd == "kernel-report":
+        from .kernel_report import run_kernel_report
+        return run_kernel_report(args.compile_ledger,
+                                 args.estimator_ledger, top=args.top,
+                                 as_json=args.json,
+                                 tolerance=args.tolerance)
     elif args.cmd == "estimator-report":
         from .estimator_report import run_estimator_report
         return run_estimator_report(args.ledger, top=args.top,

@@ -1,0 +1,212 @@
+"""`tools kernel-report`: the ranked kernel-gap report.
+
+ROADMAP's Pallas question is "which hand-written kernel pays for
+itself first?".  This report answers it by joining the two ledgers the
+engine already writes:
+
+* the **compile ledger** (obs/compileprof.py) carries, per compiled
+  program, XLA's own ``cost_analysis()`` bytes-accessed and the
+  capacity-bucket signature — what the program *moves*;
+* the **estimator ledger** (obs/estimator.py) carries, per operator
+  span, measured seconds (``time_ns``) and the padding-waste bytes the
+  tracer booked — what the program *costs* and how much of its traffic
+  is bucket padding.
+
+Per exec kind the report computes the speed-of-light gap (XLA bytes
+over 2x the live bytes, analysis/hlocost.py), the measured pad-waste
+ratio, and the projected seconds a fused dynamic-shape kernel saves —
+then ranks kinds and the named fusion pipelines (hash build/probe,
+filter->project) by that product.  The --hlo gate replays the golden
+corpus and asserts the report ranks the grouped-aggregate and
+hash-join programs on top with nonzero projected savings.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from ..analysis import hlocost
+
+
+def load_estimator_ledger(path: str) -> List[Dict]:
+    """Parse one estimator ledger (JSONL); `path` may be the file or a
+    directory containing ``estimator_ledger.jsonl``.  Torn lines are
+    skipped — both ledgers are append-under-crash telemetry."""
+    from ..obs.estimator import ESTIMATOR_LEDGER_FILENAME
+    if os.path.isdir(path):
+        path = os.path.join(path, ESTIMATOR_LEDGER_FILENAME)
+    records: List[Dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return records
+
+
+# The planner's join variants (broadcast, shuffled) all execute the
+# HashJoinExec kernel programs — the compile ledger books them under
+# the base kind, so the measured side folds onto it too or the report
+# would never join the two ledgers for a broadcast join.
+KIND_ALIASES = {
+    "BroadcastHashJoinExec": "HashJoinExec",
+    "ShuffledHashJoinExec": "HashJoinExec",
+}
+
+
+def _kind(k: str) -> str:
+    return KIND_ALIASES.get(k, k)
+
+
+# the fused pipelines a hand-written kernel would collapse; each names
+# the exec kinds whose measured time the fusion attacks together
+FUSION_PIPELINES = (
+    ("fused hash build/probe", ("HashJoinExec",)),
+    ("fused filter->project", ("FilterExec", "ProjectExec")),
+    ("fused grouped aggregate (sort+segment-reduce)",
+     ("TpuHashAggregateExec",)),
+)
+
+
+def aggregate_kernel_report(compile_records: List[Dict],
+                            observe_records: List[Dict],
+                            tolerance: float = 8.0) -> Dict:
+    """Join the two ledgers by exec kind -> the report's data model."""
+    builds = [r for r in compile_records if r.get("event") == "build"]
+
+    # measured side: seconds / bytes / padding per exec kind
+    measured: Dict[str, Dict] = {}
+    for r in observe_records:
+        if r.get("event") != "observe":
+            continue
+        k = _kind(r.get("exec", "?"))
+        m = measured.setdefault(k, {"seconds": 0.0, "spans": 0,
+                                    "act_bytes": 0, "pad_bytes": 0})
+        m["spans"] += 1
+        if r.get("time_ns") is not None:
+            m["seconds"] += r["time_ns"] / 1e9
+        m["act_bytes"] += r.get("act_bytes") or 0
+        # None = the span predates pad accounting; absent is absent
+        if r.get("pad_waste_bytes") is not None:
+            m["pad_bytes"] += r["pad_waste_bytes"]
+
+    # compiled side: per-program XLA bytes vs one launch's bucket bytes
+    compiled: Dict[str, Dict] = {}
+    seen_progs: set = set()
+    for r in builds:
+        k = _kind(r.get("exec", "?"))
+        c = compiled.setdefault(k, {"programs": 0, "builds": 0,
+                                    "gap_sum": 0.0, "gap_n": 0})
+        c["builds"] += 1
+        pid = (k, r.get("hlo_hash") or r.get("key", ""))
+        if pid in seen_progs:
+            continue
+        seen_progs.add(pid)
+        c["programs"] += 1
+        xb = hlocost.xla_bytes(r)
+        base = hlocost.record_base_bytes(r)
+        if xb is not None and base > 0:
+            pad = measured.get(k, {})
+            total = pad.get("act_bytes", 0)
+            ratio = (pad.get("pad_bytes", 0) / total) if total else 0.0
+            live = base * max(1.0 - ratio, 1e-6)
+            c["gap_sum"] += hlocost.kernel_gap(xb, live)
+            c["gap_n"] += 1
+
+    rows: List[Dict] = []
+    for k in sorted(set(measured) | set(compiled)):
+        m = measured.get(k, {"seconds": 0.0, "spans": 0,
+                             "act_bytes": 0, "pad_bytes": 0})
+        c = compiled.get(k, {"programs": 0, "builds": 0,
+                             "gap_sum": 0.0, "gap_n": 0})
+        pad_ratio = (m["pad_bytes"] / m["act_bytes"]) \
+            if m["act_bytes"] else 0.0
+        gap = (c["gap_sum"] / c["gap_n"]) if c["gap_n"] else None
+        savings = hlocost.projected_savings_s(
+            m["seconds"], gap if gap is not None else 1.0, pad_ratio)
+        rows.append({
+            "exec": k, "measured_s": m["seconds"], "spans": m["spans"],
+            "programs": c["programs"], "builds": c["builds"],
+            "act_bytes": m["act_bytes"],
+            "pad_waste_bytes": m["pad_bytes"],
+            "pad_ratio": pad_ratio, "gap": gap,
+            "projected_savings_s": savings,
+        })
+    rows.sort(key=lambda r: -r["projected_savings_s"])
+
+    by_kind = {r["exec"]: r for r in rows}
+    targets: List[Dict] = []
+    for name, kinds in FUSION_PIPELINES:
+        members = [by_kind[k] for k in kinds if k in by_kind]
+        if not members:
+            continue
+        # the fusion erases the handoff on top of each member's own
+        # gap, so its floor is the sum of the member savings
+        targets.append({
+            "target": name, "kinds": list(kinds),
+            "measured_s": sum(m["measured_s"] for m in members),
+            "projected_savings_s": sum(m["projected_savings_s"]
+                                       for m in members),
+        })
+    targets.sort(key=lambda t: -t["projected_savings_s"])
+
+    return {
+        "kinds": rows,
+        "targets": targets,
+        "cost_model": hlocost.validate_model(builds, tolerance),
+    }
+
+
+def format_kernel_report(agg: Dict, top: int = 10) -> str:
+    out: List[str] = []
+    w = out.append
+    w("== kernel gap report (tpuxsan) ==")
+    cm = agg["cost_model"]
+    pct = cm["agreement_pct"]
+    w(f"cost model: {cm['agreed']}/{cm['checked']} programs within "
+      f"{cm['tolerance']:.0f}x of XLA cost_analysis"
+      + (f" ({pct:.0f}%)" if pct is not None else " (no cost data)"))
+    w("")
+    w(f"-- top {top} exec kinds by projected kernel savings --")
+    for r in agg["kinds"][:top]:
+        gap = f"{r['gap']:.1f}x" if r["gap"] is not None else "   ?"
+        w(f"  {r['projected_savings_s']:8.3f}s  {r['exec']:24s} "
+          f"measured={r['measured_s']:7.3f}s gap={gap:>6s} "
+          f"pad={100 * r['pad_ratio']:4.1f}% "
+          f"programs={r['programs']} spans={r['spans']}")
+    w("")
+    w("-- ranked fusion targets (the Pallas list) --")
+    if not agg["targets"]:
+        w("  none: no compiled programs observed")
+    for t in agg["targets"][:top]:
+        w(f"  {t['projected_savings_s']:8.3f}s  {t['target']:44s} "
+          f"over {'+'.join(t['kinds'])}")
+    return "\n".join(out) + "\n"
+
+
+def run_kernel_report(compile_ledger: str, estimator_ledger: str,
+                      top: int = 10, as_json: bool = False,
+                      tolerance: float = 8.0, out=None) -> int:
+    import sys
+    out = out or sys.stdout
+    from .compile_report import load_ledger
+    try:
+        compile_records = load_ledger(compile_ledger)
+        observe_records = load_estimator_ledger(estimator_ledger)
+    except OSError as ex:
+        sys.stderr.write(f"kernel-report: {ex}\n")
+        return 2
+    agg = aggregate_kernel_report(compile_records, observe_records,
+                                  tolerance=tolerance)
+    if as_json:
+        out.write(json.dumps(agg, indent=2, sort_keys=True,
+                             default=str) + "\n")
+    else:
+        out.write(format_kernel_report(agg, top=top))
+    return 0

@@ -287,3 +287,30 @@ def plan_L012_residency_ping_pong():
     d3 = ProjectExec([AttributeReference("v")], h2)
     d3.placement = eb.TPU
     return d3, {}
+
+
+def plan_L018_pad_waste():
+    """Ten live rows forced into a single 1M-row capacity bucket: the
+    interp's row estimate is a sliver of the bucket every launch pads
+    to, so ~100% of the memory traffic is padding (tpuxsan TPU-L018).
+    The pre-flight repair re-buckets the filter speculatively when a
+    smaller bucket exists; here there is none, so the finding stands."""
+    scan = _scan(_ints(n=10))
+    flt = FilterExec(GreaterThan(AttributeReference("v"),
+                                 Literal(2, t.LONG)), scan)
+    flt.placement = eb.TPU
+    return flt, {"spark.rapids.tpu.batchCapacityBuckets": "1048576"}
+
+
+def plan_L020_fusion_break():
+    """A memory-bound projection feeding a memory-bound filter over a
+    ~1.5 MiB intermediate: two separate compiled programs write and
+    re-read the handoff a fused kernel would never materialize
+    (tpuxsan TPU-L020, advisory — the kernel-gap report's target)."""
+    scan = _scan(_ints(n=200000))
+    proj = ProjectExec([AttributeReference("v")], scan)
+    proj.placement = eb.TPU
+    flt = FilterExec(GreaterThan(AttributeReference("v"),
+                                 Literal(2, t.LONG)), proj)
+    flt.placement = eb.TPU
+    return flt, {}

@@ -1,0 +1,496 @@
+"""tpuxsan tests: the analytic cost model against synthetic ledger
+records (linear vs log-linear pass families, absent cost keys staying
+absent), StableHLO hazard parsing on checked-in snippets, the
+TPU-L018/L019/L020/R017 rules with their clean twins, capacity
+propagation through compacting operators, the speculative re-bucket
+repair's arm/refuse cases, the shrink/bucket device helpers at their
+edges, StableHLO persistence (dedupe + size cap), and the kernel-gap
+report's two-ledger join, ranking and CLI render."""
+
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.analysis import hloaudit, hlocost
+from spark_rapids_tpu.analysis.interp import infer_plan
+from spark_rapids_tpu.analysis.plan_lint import (downgrade_hazards,
+                                                 lint_plan)
+from spark_rapids_tpu.columnar.device import (DeviceBatch, DeviceColumn,
+                                              bucket_floor, bucket_for,
+                                              shrink_batch,
+                                              shrink_column)
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.exec import base as eb
+from spark_rapids_tpu.exec.basic import (FilterExec, LocalScanExec,
+                                         ProjectExec)
+from spark_rapids_tpu.expr.core import (Alias, AttributeReference,
+                                        Literal)
+from spark_rapids_tpu.expr.predicates import GreaterThan
+from spark_rapids_tpu.obs.compileprof import (HLO_MAX_BYTES, HLO_SUFFIX,
+                                              CompileObservatory,
+                                              cost_summary, hlo_key)
+from spark_rapids_tpu.tools.kernel_report import (aggregate_kernel_report,
+                                                  format_kernel_report,
+                                                  load_estimator_ledger,
+                                                  run_kernel_report)
+
+
+def _codes(diags):
+    return sorted(d.code for d in diags)
+
+
+def _scan(table, placement=eb.TPU, **kw):
+    s = LocalScanExec(table, **kw)
+    s.placement = placement
+    return s
+
+
+def _ints(n=8, name="v"):
+    return pa.table({name: pa.array(range(n), type=pa.int64())})
+
+
+# -- the analytic cost model ------------------------------------------------
+
+def test_record_base_bytes_sums_dispatch_leaves():
+    rec = {"caps": [[1024], [1024], []], "dtypes":
+           ["int64", "bool", "int32"]}
+    # the scalar leaf still books its width: 1024*8 + 1024*1 + 4
+    assert hlocost.record_base_bytes(rec) == 1024 * 8 + 1024 + 4
+    assert hlocost.record_base_bytes({}) == 0
+
+
+def test_analytic_bytes_linear_family():
+    rec = {"exec": "ProjectExec", "caps": [[1024]], "dtypes": ["int64"]}
+    assert hlocost.analytic_bytes(rec) == int(1024 * 8 * 3.0)
+
+
+def test_analytic_bytes_log_family_scales_with_bucket():
+    small = {"exec": "TpuHashAggregateExec", "caps": [[1024]],
+             "dtypes": ["int64"]}
+    big = {"exec": "TpuHashAggregateExec", "caps": [[8192]],
+           "dtypes": ["int64"]}
+    # scan-composed programs pay log2(n) full-width stages
+    assert hlocost.analytic_bytes(small) == int(1024 * 8 * 8.0 * 10)
+    assert hlocost.analytic_bytes(big) == int(8192 * 8 * 8.0 * 13)
+    assert hlocost.record_max_dim(big) == 8192
+
+
+def test_cost_agreement_absent_is_absent_never_zero():
+    rec = {"exec": "ProjectExec", "caps": [[64]], "dtypes": ["int64"]}
+    assert hlocost.xla_bytes(rec) is None
+    assert hlocost.cost_agreement(rec, 8.0) is None
+    rec["cost"] = {"flops": 12.0}          # backend omitted bytes
+    assert hlocost.xla_bytes(rec) is None
+    rec["cost"] = {"bytes accessed": float(64 * 8 * 3)}
+    ok, ratio = hlocost.cost_agreement(rec, 8.0)
+    assert ok and ratio == pytest.approx(1.0)
+
+
+def test_validate_model_agreement_and_vacuity():
+    good = {"event": "build", "exec": "ProjectExec", "caps": [[64]],
+            "dtypes": ["int64"],
+            "cost": {"bytes accessed": float(64 * 8 * 3)}}
+    drift = {"event": "build", "exec": "ProjectExec", "caps": [[64]],
+             "dtypes": ["int64"],
+             "cost": {"bytes accessed": float(64 * 8 * 3 * 100)}}
+    out = hlocost.validate_model([good, drift], tolerance=8.0)
+    assert (out["checked"], out["agreed"]) == (2, 1)
+    assert out["agreement_pct"] == pytest.approx(50.0)
+    assert out["worst"]["ratio"] == pytest.approx(0.01)
+    # no cost data at all: the pct is None, never a fake 100
+    vac = hlocost.validate_model(
+        [{"event": "build", "exec": "ProjectExec"}], 8.0)
+    assert vac["checked"] == 0 and vac["agreement_pct"] is None
+
+
+def test_pad_waste_for_math():
+    ratio, waste = hlocost.pad_waste_for(10, 1024, 8.0)
+    assert ratio == pytest.approx((1024 - 10) / 1024)
+    assert waste == int((1024 - 10) * 8.0)
+    assert hlocost.pad_waste_for(1024, 1024, 8.0) == (0.0, 0)
+
+
+# -- StableHLO hazard parsing ----------------------------------------------
+
+_HOST_HLO = '''\
+func.func @main(%arg0: tensor<4xi64>) -> tensor<4xi64> {
+  %0 = "stablehlo.custom_call"(%arg0) {call_target_name = \
+"xla_python_cpu_callback"} : (tensor<4xi64>) -> tensor<4xi64>
+  return %0 : tensor<4xi64>
+}
+'''
+
+_SEND_HLO = '''\
+func.func @main(%arg0: tensor<4xi64>) -> tensor<4xi64> {
+  %0 = "stablehlo.send"(%arg0) : (tensor<4xi64>) -> !stablehlo.token
+  return %arg0 : tensor<4xi64>
+}
+'''
+
+_BCAST_HLO = '''\
+func.func @main(%arg0: tensor<1xf32>) -> tensor<8388608xf32> {
+  %0 = "stablehlo.broadcast_in_dim"(%arg0) : (tensor<1xf32>) \
+-> tensor<8388608xf32>
+  return %0 : tensor<8388608xf32>
+}
+'''
+
+_CLEAN_HLO = '''\
+func.func @main(%arg0: tensor<4xi64>) -> tensor<4xi64> {
+  %0 = stablehlo.add %arg0, %arg0 : tensor<4xi64>
+  return %0 : tensor<4xi64>
+}
+'''
+
+
+def test_parse_hlo_hazards_host_callback_and_send():
+    hz = hloaudit.parse_hlo_hazards(_HOST_HLO, 16 << 20)
+    assert len(hz["host_transfers"]) == 1
+    assert "callback" in hz["host_transfers"][0][1]
+    hz2 = hloaudit.parse_hlo_hazards(_SEND_HLO, 16 << 20)
+    assert len(hz2["host_transfers"]) == 1
+
+
+def test_parse_hlo_hazards_broadcast_bytes():
+    hz = hloaudit.parse_hlo_hazards(_BCAST_HLO, 16 << 20)
+    # the broadcast RESULT (last tensor on the line) is 32 MiB of f32
+    assert hz["big_broadcasts"] == [(2, 8388608 * 4)]
+    # raising the budget past the result size silences it
+    assert not hloaudit.parse_hlo_hazards(
+        _BCAST_HLO, 64 << 20)["big_broadcasts"]
+
+
+def test_parse_hlo_hazards_clean_program():
+    hz = hloaudit.parse_hlo_hazards(_CLEAN_HLO, 16 << 20)
+    assert not hz["host_transfers"] and not hz["big_broadcasts"]
+
+
+def test_audit_ledger_twins_and_dedupe(tmp_path):
+    bad_h, ok_h = hlo_key(_HOST_HLO), hlo_key(_CLEAN_HLO)
+    for h, text in ((bad_h, _HOST_HLO), (ok_h, _CLEAN_HLO)):
+        (tmp_path / (h + HLO_SUFFIX)).write_text(text)
+    recs = [
+        {"event": "build", "exec": "ProbeExec", "hlo_hash": bad_h},
+        # same program again: the audit reads it ONCE
+        {"event": "build", "exec": "ProbeExec", "hlo_hash": bad_h},
+        {"event": "build", "exec": "CleanExec", "hlo_hash": ok_h},
+        # hash with no artifact (oversized or pruned): skipped, no crash
+        {"event": "build", "exec": "GoneExec", "hlo_hash": "0" * 16},
+    ]
+    diags = hloaudit.audit_ledger(recs, str(tmp_path), 16 << 20)
+    assert _codes(diags) == ["TPU-L019"]
+    assert "ProbeExec" in diags[0].loc
+
+
+def test_audit_ledger_no_dir_is_noop():
+    recs = [{"event": "build", "exec": "X", "hlo_hash": "ab"}]
+    assert hloaudit.audit_ledger(recs, None, 16 << 20) == []
+
+
+# -- TPU-R017: raw jnp/lax bypassing the kernel table ----------------------
+
+_R017_BAD = "import jax.numpy as jnp\n\n\ndef widen(c):\n" \
+            "    return jnp.cumsum(c)\n"
+_R017_XP = "def widen(c, xp):\n    return xp.cumsum(c)\n"
+_R017_ALLOW = ("import jax.numpy as jnp\n\n\ndef widen(c):\n"
+               "    return jnp.cumsum(c)  "
+               "# tpulint: allow[TPU-R017] test fixture\n")
+_R017_KERNEL = ("import jax.numpy as jnp\n\n\ndef count_matches(a):\n"
+                "    return jnp.cumsum(a)\n")
+
+
+def test_r017_raw_call_in_exec_trips():
+    diags = hloaudit.module_diagnostics(_R017_BAD, "exec/fake.py")
+    assert _codes(diags) == ["TPU-R017"]
+    assert "jnp.cumsum" in diags[0].message
+
+
+def test_r017_clean_twins():
+    assert not hloaudit.module_diagnostics(_R017_XP, "exec/fake.py")
+    assert not hloaudit.module_diagnostics(_R017_ALLOW, "exec/fake.py")
+    # outside exec//ops/ the rule does not apply
+    assert not hloaudit.module_diagnostics(_R017_BAD, "obs/fake.py")
+
+
+def test_r017_registered_kernel_entry_point_passes():
+    # count_matches is in the DEVICE_KERNELS capability table for
+    # ops/join_kernels.py: the registered surface may call lax/jnp
+    assert not hloaudit.module_diagnostics(_R017_KERNEL,
+                                           "ops/join_kernels.py")
+    # the same source elsewhere in ops/ is unregistered -> trips
+    assert _codes(hloaudit.module_diagnostics(
+        _R017_KERNEL, "ops/fake.py")) == ["TPU-R017"]
+
+
+def test_r017_live_tree_owes_nothing():
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "spark_rapids_tpu")
+    live = [d for d in hloaudit.repo_diagnostics(pkg)
+            if d.code == "TPU-R017"]
+    assert live == [], [d.render() for d in live]
+
+
+# -- capacity propagation + TPU-L018 ---------------------------------------
+
+def _filter_plan(n, buckets):
+    scan = _scan(_ints(n=n))
+    flt = FilterExec(GreaterThan(AttributeReference("v"),
+                                 Literal(n // 2, t.LONG)), scan)
+    flt.placement = eb.TPU
+    conf = RapidsConf({"spark.rapids.tpu.batchCapacityBuckets": buckets}
+                      if buckets else {})
+    return flt, conf
+
+
+def test_plan_pad_waste_filter_inherits_child_capacity():
+    flt, conf = _filter_plan(2000, "1024,1048576")
+    waste = hlocost.plan_pad_waste(flt, conf, infer_plan(flt, conf))
+    by_node = {id(w["node"]): w for w in waste}
+    # the scan's 2000 rows land in the 1M bucket; the filter's ~1000
+    # survivors COMPACT but keep the input capacity — re-bucketing is
+    # the repair's job, not the model's assumption
+    assert by_node[id(flt)]["capacity"] == 1048576
+    assert by_node[id(flt.children[0])]["capacity"] == 1048576
+    assert by_node[id(flt)]["waste_ratio"] > 0.99
+
+
+def test_audit_plan_l018_trips_and_default_buckets_stay_clean():
+    flt, conf = _filter_plan(10, "1048576")
+    diags = hloaudit.audit_plan(flt, conf, infer_plan(flt, conf))
+    assert "TPU-L018" in _codes(diags)
+    # default buckets are <= 8x apart and the waste is under the MiB
+    # floor: no finding
+    flt2, conf2 = _filter_plan(10, None)
+    assert not hloaudit.audit_plan(flt2, conf2,
+                                   infer_plan(flt2, conf2))
+
+
+def test_lint_plan_xsan_disabled_is_silent():
+    flt, _ = _filter_plan(10, "1048576")
+    conf = RapidsConf({"spark.rapids.tpu.batchCapacityBuckets":
+                       "1048576",
+                       "spark.rapids.tpu.xsan.enabled": False})
+    codes = {d.code for d in lint_plan(flt, conf, infer=True)}
+    assert not codes & {"TPU-L018", "TPU-L020"}
+
+
+# -- TPU-L020: fusion breaks -----------------------------------------------
+
+def _project_filter_plan(n):
+    scan = _scan(_ints(n=n))
+    flt = FilterExec(GreaterThan(AttributeReference("v"),
+                                 Literal(0, t.LONG)), scan)
+    flt.placement = eb.TPU
+    proj = ProjectExec([Alias(AttributeReference("v"), "v2")], flt)
+    proj.placement = eb.TPU
+    return proj
+
+
+def test_fusion_break_trips_on_large_intermediate():
+    proj = _project_filter_plan(400000)
+    conf = RapidsConf({})
+    diags = hloaudit.audit_plan(proj, conf, infer_plan(proj, conf))
+    l20 = [d for d in diags if d.code == "TPU-L020"]
+    assert l20 and "FilterExec -> ProjectExec" in l20[0].message
+
+
+def test_fusion_break_small_intermediate_clean():
+    proj = _project_filter_plan(100)
+    conf = RapidsConf({})
+    assert not [d for d in hloaudit.audit_plan(
+        proj, conf, infer_plan(proj, conf)) if d.code == "TPU-L020"]
+
+
+# -- the speculative re-bucket repair --------------------------------------
+
+def test_rebucket_repair_arms_when_smaller_bucket_exists():
+    flt, conf = _filter_plan(1200, "1024,1048576")
+    diags = lint_plan(flt, conf, infer=True)
+    assert "TPU-L018" in {d.code for d in diags}
+    downgrade_hazards(flt, diags, conf)
+    # repaired speculatively: still on device, shrink target armed
+    assert flt.rebucket_cap == 1024
+    assert flt.placement == eb.TPU
+
+
+def test_rebucket_repair_refuses_noop_shrink():
+    flt, conf = _filter_plan(10, "1048576")
+    assert hloaudit.try_rebucket_repair(flt, flt, conf) is False
+    assert flt.rebucket_cap is None
+
+
+# -- device shrink/bucket helpers at the edges -----------------------------
+
+def test_bucket_for_and_floor_edges():
+    bks = (1024, 8192)
+    assert bucket_for(1024, bks) == 1024          # exact boundary
+    assert bucket_for(1025, bks) == 8192
+    assert bucket_for(10000, bks) == 16384        # over-max: pow2 up
+    assert bucket_floor(8191, bks) == 1024
+    assert bucket_floor(8192, bks) == 8192
+    assert bucket_floor(10, bks) == 1024          # below the smallest
+
+
+def test_default_bucket_tables_edges():
+    from spark_rapids_tpu.columnar.device import (DEFAULT_CHAR_BUCKETS,
+                                                  DEFAULT_ROW_BUCKETS)
+    top = DEFAULT_ROW_BUCKETS[-1]
+    assert bucket_for(top, DEFAULT_ROW_BUCKETS) == top
+    assert bucket_for(top + 1, DEFAULT_ROW_BUCKETS) == top * 2
+    assert bucket_for(0, DEFAULT_ROW_BUCKETS) == DEFAULT_ROW_BUCKETS[0]
+    ctop = DEFAULT_CHAR_BUCKETS[-1]
+    assert bucket_for(ctop, DEFAULT_CHAR_BUCKETS) == ctop
+    assert bucket_floor(ctop - 1, DEFAULT_CHAR_BUCKETS) == \
+        DEFAULT_CHAR_BUCKETS[-2]
+    # each table stays sorted and <= 8x apart: the static L018 bound
+    # (defaults never pad past padWasteMax) rests on this
+    for bks in (DEFAULT_ROW_BUCKETS, DEFAULT_CHAR_BUCKETS):
+        assert list(bks) == sorted(bks)
+        assert all(b2 / b1 <= 8 for b1, b2 in zip(bks, bks[1:]))
+
+
+def test_shrink_column_long_and_string():
+    col = DeviceColumn(t.LONG, data=np.zeros(1024, np.int64),
+                       validity=np.ones(1024, bool))
+    out = shrink_column(col, 16)
+    assert out.capacity == 16 and out.validity.shape == (16,)
+    scol = DeviceColumn(t.STRING, data=np.zeros(64, np.uint8),
+                        offsets=np.zeros(1025, np.int32))
+    sout = shrink_column(scol, 16)
+    # rows re-bucket; char data keeps its own byte bucket
+    assert sout.capacity == 16 and sout.data.shape == (64,)
+
+
+def test_shrink_batch_noop_and_rows_ride_along():
+    col = DeviceColumn(t.LONG, data=np.zeros(1024, np.int64))
+    b = DeviceBatch([col], 10, ["x"])
+    assert shrink_batch(b, 2048) is b             # growing is a no-op
+    small = shrink_batch(b, 16)
+    assert small.capacity == 16 and small.num_rows == 10
+
+
+# -- StableHLO persistence --------------------------------------------------
+
+def test_save_hlo_dedupes_and_caps(tmp_path):
+    obs = CompileObservatory.reset_for_tests()
+    try:
+        obs.configure(hlo_dir=str(tmp_path))
+        k1, ok1 = obs.save_hlo(_CLEAN_HLO)
+        k2, ok2 = obs.save_hlo(_CLEAN_HLO)
+        assert ok1 and ok2 and k1 == k2 == hlo_key(_CLEAN_HLO)
+        files = [f for f in os.listdir(tmp_path)
+                 if f.endswith(HLO_SUFFIX)]
+        assert files == [k1 + HLO_SUFFIX]
+        big = "x" * (HLO_MAX_BYTES + 1)
+        kb, okb = obs.save_hlo(big)
+        assert not okb                     # key recorded, text not
+        assert not os.path.exists(
+            os.path.join(tmp_path, kb + HLO_SUFFIX))
+    finally:
+        CompileObservatory.reset_for_tests()
+
+
+def test_cost_summary_records_only_reported_keys():
+    class Fake:
+        def __init__(self, ca):
+            self._ca = ca
+
+        def cost_analysis(self):
+            if isinstance(self._ca, Exception):
+                raise self._ca
+            return self._ca
+
+    got = cost_summary(Fake([{"bytes accessed": 5.0, "flops": 1.0,
+                              "utilization": 9.0}]))
+    assert got == {"bytes accessed": 5.0, "flops": 1.0}
+    assert cost_summary(Fake([])) is None
+    assert cost_summary(Fake(RuntimeError("no analysis"))) is None
+
+
+# -- the kernel-gap report --------------------------------------------------
+
+def _synthetic_ledgers():
+    base = 8192 * 8
+    compile_records = [
+        {"event": "build", "exec": "HashJoinExec", "hlo_hash": "h1",
+         "caps": [[8192]], "dtypes": ["int64"],
+         "cost": {"bytes accessed": float(base * 8 * 13)}},
+        # the same program rebuilt (eviction refault): ONE program
+        {"event": "build", "exec": "HashJoinExec", "hlo_hash": "h1",
+         "caps": [[8192]], "dtypes": ["int64"],
+         "cost": {"bytes accessed": float(base * 8 * 13)}},
+        {"event": "build", "exec": "ProjectExec", "hlo_hash": "h2",
+         "caps": [[8192]], "dtypes": ["int64"],
+         "cost": {"bytes accessed": float(base * 3)}},
+    ]
+    observe_records = [
+        # the broadcast variant folds onto the HashJoinExec kernel kind
+        {"event": "observe", "exec": "BroadcastHashJoinExec",
+         "time_ns": 2_000_000_000, "act_bytes": base,
+         "pad_waste_bytes": base // 2},
+        {"event": "observe", "exec": "ProjectExec",
+         "time_ns": 500_000_000, "act_bytes": base,
+         "pad_waste_bytes": None},      # predates pad accounting
+    ]
+    return compile_records, observe_records
+
+
+def test_aggregate_kernel_report_joins_and_ranks():
+    compile_records, observe_records = _synthetic_ledgers()
+    agg = aggregate_kernel_report(compile_records, observe_records)
+    by_kind = {r["exec"]: r for r in agg["kinds"]}
+    join = by_kind["HashJoinExec"]
+    assert "BroadcastHashJoinExec" not in by_kind
+    assert join["programs"] == 1 and join["builds"] == 2
+    assert join["measured_s"] == pytest.approx(2.0)
+    assert join["gap"] is not None and join["gap"] > 1.0
+    assert join["projected_savings_s"] > 0.0
+    # None pad bytes stayed absent: the Project row books zero
+    assert by_kind["ProjectExec"]["pad_waste_bytes"] == 0
+    targets = {t_["target"]: t_ for t_ in agg["targets"]}
+    assert targets["fused hash build/probe"][
+        "projected_savings_s"] > 0.0
+    assert agg["cost_model"]["agreement_pct"] == pytest.approx(100.0)
+
+
+def test_format_kernel_report_renders():
+    agg = aggregate_kernel_report(*_synthetic_ledgers())
+    text = format_kernel_report(agg)
+    assert "kernel gap report" in text
+    assert "HashJoinExec" in text
+    assert "fused hash build/probe" in text
+
+
+def test_run_kernel_report_cli(tmp_path, capsys):
+    compile_records, observe_records = _synthetic_ledgers()
+    cl = tmp_path / "compile_ledger.jsonl"
+    el = tmp_path / "estimator_ledger.jsonl"
+    cl.write_text("\n".join(json.dumps(r) for r in compile_records))
+    el.write_text("\n".join(json.dumps(r) for r in observe_records)
+                  + '\n{"torn": ')
+    import io
+    buf = io.StringIO()
+    assert run_kernel_report(str(cl), str(tmp_path), out=buf) == 0
+    assert "kernel gap report" in buf.getvalue()
+    jbuf = io.StringIO()
+    assert run_kernel_report(str(cl), str(el), as_json=True,
+                             out=jbuf) == 0
+    assert json.loads(jbuf.getvalue())["targets"]
+
+
+def test_run_kernel_report_missing_ledger_errors(tmp_path):
+    import io
+    assert run_kernel_report(str(tmp_path / "nope.jsonl"),
+                             str(tmp_path / "nope2.jsonl"),
+                             out=io.StringIO()) == 2
+
+
+def test_load_estimator_ledger_skips_torn_lines(tmp_path):
+    el = tmp_path / "estimator_ledger.jsonl"
+    el.write_text('{"event": "observe", "exec": "X"}\n{"torn": \n')
+    recs = load_estimator_ledger(str(tmp_path))
+    assert len(recs) == 1 and recs[0]["exec"] == "X"

@@ -273,6 +273,29 @@ def test_run_lint_dsan_gate_exits_zero():
     assert "dsan gate clean" in proc.stdout, proc.stdout
 
 
+def test_run_lint_hlo_gate_exits_zero():
+    """Tier-1 gate for tpuxsan: the golden corpus replays with
+    StableHLO + cost_analysis() persistence on — every build's
+    hlo_hash must resolve to exactly one deduped artifact, the
+    analytic cost model must agree with XLA's bytes-accessed on
+    >= 90% of compiled programs, the padding books must reconcile
+    three ways (span padWasteBytes vs live-row recomputation vs the
+    tpu_pad_waste_bytes_total counter), the L018/L019/L020/R017
+    fixtures must trip with their clean twins silent, the L018
+    repair must arm only when a genuinely smaller bucket exists, an
+    injected pathological bucket (1M capacity over 10 live rows)
+    must book the exact padding delta, and `tools kernel-report`
+    must rank the grouped-aggregate and hash-join fusions with
+    nonzero projected savings."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "devtools", "run_lint.py"),
+         "--hlo"],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "hlo gate clean" in proc.stdout, proc.stdout
+
+
 def test_baseline_is_empty_and_stays_empty():
     """PR-3 burned the last baselined TPU-R001 debt down to zero: the
     ratchet now enforces a spotless repo (deliberate exceptions are

@@ -41,6 +41,7 @@ def _fp(sql_id=0, **over):
         "peak_device_bytes": 1 << 20,
         "compile_seconds": 4.2,
         "estimate_rows_err": 0.12,
+        "pad_waste_ratio": 0.31,
     }
     fp.update(over)
     return fp
