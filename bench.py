@@ -766,6 +766,13 @@ def measure_serve(fact, dim, pq_path, concurrency: int = 8,
         "spark.rapids.tpu.memsan.enabled": "true",
         "spark.rapids.tpu.serve.hbmAdmissionBudgetBytes": str(2 << 30),
         "spark.rapids.tpu.serve.admissionTimeoutMs": "120000",
+        # latency observatory: tracing feeds critical-path extraction,
+        # the SLO target classifies each request GOOD/BAD (generous:
+        # the interesting output is the per-tenant segment mix, not a
+        # burn alert on a loaded CI host)
+        "spark.rapids.tpu.trace.enabled": "true",
+        "spark.rapids.tpu.slo.targetMs": str(
+            int(request_io_ms * 10) or 1000),
     }
     reg = obs_metrics.registry()
 
@@ -829,7 +836,12 @@ def measure_serve(fact, dim, pq_path, concurrency: int = 8,
             mixes1[id(s)][name]()
     serial_wall, serial_lat = run_list(pool1, mixes1, 1)
     pool1.close()
-    # concurrent arm: N sessions, N client threads, same worklist
+    # concurrent arm: N sessions, N client threads, same worklist.
+    # Reset the latency observatory between arms so the per-tenant
+    # report describes the concurrent arm only (pool1's session is
+    # also tenant pool-0); the new pool's sessions reconfigure it
+    from spark_rapids_tpu.obs.slo import LatencyObservatory
+    LatencyObservatory.reset_for_tests()
     poolN = SessionPool(concurrency, conf)
     mixesN = {id(s): serve_mix(s, fact, dim, pq_path)
               for s in poolN._sessions}
@@ -855,6 +867,29 @@ def measure_serve(fact, dim, pq_path, concurrency: int = 8,
     def pct(lats, p):
         srt = sorted(lats)
         return srt[min(int(p * (len(srt) - 1) + 0.5), len(srt) - 1)]
+
+    # latency observatory rollup for the concurrent arm: per-tenant
+    # p50/p99 with the dominant tail segment — the attribution columns
+    # the QoS work (ROADMAP item 4) diffs before/after
+    slo_rep = LatencyObservatory.get().slo_report()
+    slo_tenants = {}
+    for tenant, row in sorted(slo_rep.get("tenants", {}).items()):
+        slo_tenants[tenant] = {
+            "p50_ms": row["p50_ms"],
+            "p99_ms": row["p99_ms"],
+            "burn_rate": row["burn_rate"],
+            "dominant_segment": row["dominant_tail_segment"],
+        }
+    slo_overhead_pct = slo_rep.get("overhead", {}).get("pct", 0.0)
+    if slo_tenants:
+        print("bench --serve per-tenant latency attribution:",
+              file=sys.stderr)
+        print(f"  {'tenant':<10} {'p50_ms':>9} {'p99_ms':>9} "
+              f"{'burn':>6}  dominant_segment", file=sys.stderr)
+        for tenant, row in slo_tenants.items():
+            print(f"  {tenant:<10} {row['p50_ms']:>9.1f} "
+                  f"{row['p99_ms']:>9.1f} {row['burn_rate']:>6.2f}  "
+                  f"{row['dominant_segment'] or '-'}", file=sys.stderr)
 
     total = len(worklist)
     delta = {k: c1[k] - c0[k] for k in c0}
@@ -894,6 +929,12 @@ def measure_serve(fact, dim, pq_path, concurrency: int = 8,
                 hbm_rep.get("unattributed_events", 0)),
             "tenants": hbm_tenants,
         },
+        "slo": {
+            "target_ms": slo_rep.get("target_ms"),
+            "objective": slo_rep.get("objective"),
+            "overhead_pct": slo_overhead_pct,
+            "tenants": slo_tenants,
+        },
     }
 
 
@@ -919,6 +960,17 @@ def serve_fingerprint(serve: dict) -> dict:
         # advisory (never diffed — byte peaks are data-layout noise):
         # per-tenant HBM peaks + demotable share from the observatory
         "serve_hbm": serve.get("hbm", {}),
+        # advisory timing-class per-tenant SLO fields: burn rate is
+        # load-dependent; the dominant tail segment feeds the
+        # tail_mix_shift differ (timing-gated, never deterministic)
+        "slo_burn_rate": {
+            t: row["burn_rate"]
+            for t, row in serve.get("slo", {}).get("tenants",
+                                                   {}).items()},
+        "tail_dominant_segment": {
+            t: row["dominant_segment"]
+            for t, row in serve.get("slo", {}).get("tenants",
+                                                   {}).items()},
     }
 
 
@@ -1492,6 +1544,12 @@ def main():
             print(f"SERVE QPS GUARD FAILED: concurrent "
                   f"{serve['concurrent_qps']} qps <= serial "
                   f"{serve['serial_qps']} qps", file=sys.stderr)
+            failed = True
+        if serve.get("slo", {}).get("overhead_pct", 0.0) >= 5.0:
+            print(f"SERVE OBSERVATORY OVERHEAD GUARD FAILED: "
+                  f"critical-path extraction cost "
+                  f"{serve['slo']['overhead_pct']:.2f}% of query wall "
+                  f"(>= 5%)", file=sys.stderr)
             failed = True
         if serve["dirty_ledgers"]:
             print(f"SERVE MEMSAN GUARD FAILED: "

@@ -3656,6 +3656,331 @@ def run_hlo_gate() -> int:
     return 0
 
 
+def run_slo_gate() -> int:
+    """Latency-observatory gate (obs/critpath.py + obs/slo.py), two
+    phases through one 4-session pool:
+
+    * **Golden mix** — the serve gate's four queries replayed
+      concurrently with tracing on: every completed query's
+      critical-path segments must sum to its wall time within the
+      tolerance gate, the three sinks must agree (root-span annotation,
+      tpu_latency_segment_seconds_total counters, latency ledger), and
+      the burn-rate health rule must NOT trip (anti-vacuity one way).
+    * **Injected whale** — tenant pool-0's FilterExec is armed with a
+      sleep and its admission ticket inflated so victims (pool-1..3)
+      queue behind it deterministically: the sustained-burn health rule
+      must flip DEGRADED naming the victims, tail-report must attribute
+      each victim's p99 >= 50% to queue_wait while its p50 mix stays
+      compute-dominated, and the whale itself must stay
+      compute-attributed (anti-vacuity the other way).  Plus the
+      observatory's own overhead must stay under 5% of query wall —
+      the same accounting `bench.py --serve` reports.
+    """
+    import concurrent.futures as cf
+    import shutil
+    import tempfile
+    import time as _time
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.pool import SessionPool
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.exec.base import _wrap_execute_partition
+    from spark_rapids_tpu.exec.basic import FilterExec
+    from spark_rapids_tpu.memory.admission import AdmissionController
+    from spark_rapids_tpu.memory.spill import SpillCatalog
+    from spark_rapids_tpu.obs import metrics as m
+    from spark_rapids_tpu.obs.critpath import SEGMENT_FAMILY
+    from spark_rapids_tpu.obs.health import DEGRADED, OK, HealthMonitor
+    from spark_rapids_tpu.obs.metrics import MetricsRegistry
+    from spark_rapids_tpu.obs.slo import LatencyObservatory
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+
+    failures = 0
+    MetricsRegistry.reset_for_tests()
+    with SpillCatalog._lock:
+        SpillCatalog._instance = SpillCatalog()
+    TpuShuffleManager.reset()
+    AdmissionController.reset_for_tests()
+    LatencyObservatory.reset_for_tests()
+
+    n = 4000
+    rng = np.random.default_rng(7)
+    fact = pa.table({
+        "k": pa.array(rng.integers(0, 97, n).astype(np.int64)),
+        "v": pa.array(rng.integers(-1000, 1000, n).astype(np.int64)),
+    })
+    dim = pa.table({
+        "k": pa.array(np.arange(97, dtype=np.int64)),
+        "w": pa.array(np.arange(97, dtype=np.int64) * 10),
+    })
+    budget = 256 << 20
+    hist = tempfile.mkdtemp(prefix="slo_gate_hist_")
+    pool = SessionPool(4, {
+        "spark.rapids.sql.enabled": "true",
+        "spark.rapids.tpu.memsan.enabled": "true",
+        "spark.rapids.tpu.singleChipFuse": "off",
+        "spark.rapids.tpu.trace.enabled": "true",
+        "spark.rapids.tpu.serve.hbmAdmissionBudgetBytes": str(budget),
+        "spark.rapids.tpu.serve.admissionTimeoutMs": "60000",
+        "spark.rapids.tpu.regress.historyDir": hist,
+        # generous golden-phase target: the golden mix must never burn
+        # on a loaded CI host (the whale phase reconfigures to 400ms)
+        "spark.rapids.tpu.slo.targetMs": "600000",
+        "spark.rapids.tpu.slo.objective": "0.9",
+    })
+    monitor = HealthMonitor()
+
+    from spark_rapids_tpu.expr.window import WindowBuilder
+
+    def mk_mix(s):
+        fdf = s.create_dataframe(fact)
+        fdf4 = s.create_dataframe(fact, num_partitions=4)
+        ddf2 = s.create_dataframe(dim, num_partitions=2)
+        w = WindowBuilder().partition_by(col("k")).order_by(col("v"))
+        return {
+            "agg": lambda: (fdf.group_by(col("k"))
+                            .agg(F.sum(col("v")).alias("sv"),
+                                 F.count("*").alias("c")).collect()),
+            "join": lambda: (fdf4.join(ddf2, on="k", how="inner")
+                             .group_by(col("k"))
+                             .agg(F.sum(col("w")).alias("sw"))
+                             .collect()),
+            "window": lambda: (fdf.select(
+                col("k"), col("v"),
+                F.row_number().over(w).alias("rn")).collect()),
+            "sort": lambda: fdf.sort(col("k"), col("v")).collect(),
+            # whale-phase query: single partition so the armed filter
+            # sleeps exactly once per run
+            "filter_agg": lambda: (fdf.filter(col("v") > -10_000)
+                                   .group_by(col("k"))
+                                   .agg(F.sum(col("v")).alias("sv"))
+                                   .collect()),
+        }
+
+    mixes = {id(s): mk_mix(s) for s in pool._sessions}
+    worklist = [name for name in ("agg", "join", "window", "sort")
+                for _ in range(4)]
+
+    def one(name):
+        with pool.session() as s:
+            mixes[id(s)][name]()
+
+    with cf.ThreadPoolExecutor(max_workers=4) as ex:
+        list(ex.map(one, worklist))
+    pool.drain(timeout=60)
+
+    def load_ledger():
+        import json
+        path = os.path.join(hist, "latency_ledger.jsonl")
+        if not os.path.exists(path):
+            return []
+        with open(path, encoding="utf-8") as f:
+            return [json.loads(x) for x in f if x.strip()]
+
+    # -- golden-phase checks -------------------------------------------------
+    records = load_ledger()
+    completed = m.counter("tpu_queries_completed_total").value()
+    if not records or len(records) != completed:
+        failures += 1
+        print(f"SLO: ledger sink disagrees with the query counter "
+              f"({len(records)} records != {completed} completed)")
+    bad_recon = [r for r in records if not r.get("reconciled")]
+    for r in records:
+        covered = sum(r["segments"].values())
+        if abs(covered - r["wall_s"]) > max(0.05 * r["wall_s"], 0.002):
+            bad_recon.append(r)
+    if bad_recon:
+        failures += 1
+        print(f"SLO: {len(bad_recon)} record(s) failed segment-vs-wall "
+              f"reconciliation (first: {bad_recon[0]})")
+    ledger_seg_s = sum(sum(r["segments"].values()) for r in records)
+    fam = [f for f in MetricsRegistry.get().families()
+           if f.name == SEGMENT_FAMILY]
+    counter_seg_s = fam[0].total() if fam else 0.0
+    if not fam or abs(counter_seg_s - ledger_seg_s) > \
+            max(0.01 * ledger_seg_s, 1e-3):
+        failures += 1
+        print(f"SLO: counter sink disagrees with span math "
+              f"({counter_seg_s:.4f}s counted vs {ledger_seg_s:.4f}s "
+              f"in the ledger)")
+    annotated = [sp for s in pool._sessions
+                 if s.last_query_trace() is not None
+                 for sp in s.last_query_trace().span_dicts()
+                 if sp["kind"] == "query" and
+                 sp["attrs"].get("critical_path")]
+    if not annotated:
+        failures += 1
+        print("SLO: no root span carries the critical_path annotation")
+    for _ in range(2):
+        snap = monitor.snapshot()
+    if snap["components"]["slo"]["status"] != OK:
+        failures += 1
+        print(f"SLO: burn rule tripped on the clean golden mix "
+              f"(vacuity): {snap['components']['slo']}")
+
+    # -- whale phase ---------------------------------------------------------
+    def run_as(s, fn):
+        TpuSession.bind_to_thread(s)
+        try:
+            return fn()
+        finally:
+            TpuSession.bind_to_thread(None)
+
+    # warm the filter_agg jit before arming anything so the whale's
+    # tail is sleep, not first-compile
+    for s in pool._sessions:
+        run_as(s, mixes[id(s)]["filter_agg"])
+
+    # the whale phase writes its own ledger: the CLI report below must
+    # describe the incident, not the golden phase's first-compile tails
+    hist_whale = tempfile.mkdtemp(prefix="slo_gate_whale_")
+    LatencyObservatory.reset_for_tests()
+    LatencyObservatory.get().configure(
+        target_ms=400, objective=0.9,
+        ledger_path=os.path.join(hist_whale, "latency_ledger.jsonl"))
+
+    whale_sleep, victim_sleep = 0.6, 0.05
+    raw_ep = FilterExec.execute_partition.__wrapped__
+    orig_ep = FilterExec.execute_partition
+    orig_bound = TpuSession._static_peak_bound
+
+    def sleepy_ep(self, pid, ctx):
+        s = TpuSession.active()
+        tenant = getattr(s, "_tenant", "") if s is not None else ""
+        slp = whale_sleep if tenant == "pool-0" else victim_sleep
+        for b in raw_ep(self, pid, ctx):
+            if slp:
+                _time.sleep(slp)  # inside the operator span: compute
+                slp = 0.0
+            yield b
+
+    def fixed_bound(self, final_plan, conf, budget=None):
+        # whale + any victim oversubscribes the 256M budget, two
+        # victims co-run: victims queue IFF the whale is in flight
+        return (200 << 20) if getattr(self, "_tenant", "") == "pool-0" \
+            else (100 << 20)
+
+    FilterExec.execute_partition = _wrap_execute_partition(sleepy_ep)
+    TpuSession._static_peak_bound = fixed_bound
+    try:
+        whale, victims = pool._sessions[0], pool._sessions[1:]
+        # uncontended victim baselines: GOOD and compute-dominated
+        for _ in range(6):
+            for s in victims:
+                run_as(s, mixes[id(s)]["filter_agg"])
+        for _ in range(2):
+            snap = monitor.snapshot()
+        if snap["components"]["slo"]["status"] != OK:
+            failures += 1
+            print(f"SLO: burn rule tripped on uncontended victims "
+                  f"(vacuity): {snap['components']['slo']}")
+        # whale rounds: pool-0 admits first and holds 200M through its
+        # armed 0.6s filter; victims arrive 0.15s later and queue
+        for _ in range(4):
+            with cf.ThreadPoolExecutor(max_workers=4) as ex:
+                futs = [ex.submit(run_as, whale,
+                                  mixes[id(whale)]["filter_agg"])]
+                _time.sleep(0.15)
+                futs += [ex.submit(run_as, s,
+                                   mixes[id(s)]["filter_agg"])
+                         for s in victims]
+                for f in futs:
+                    f.result()
+    finally:
+        FilterExec.execute_partition = orig_ep
+        TpuSession._static_peak_bound = orig_bound
+
+    # -- whale-phase checks --------------------------------------------------
+    rep = LatencyObservatory.get().slo_report()
+    tail = LatencyObservatory.get().tail_report()
+    victim_names = [f"pool-{i}" for i in (1, 2, 3)]
+    for name in victim_names:
+        row = rep["tenants"].get(name, {})
+        if row.get("burn_rate", 0.0) <= 1.0:
+            failures += 1
+            print(f"SLO: victim {name} burn rate "
+                  f"{row.get('burn_rate')} did not exceed 1 under the "
+                  f"whale")
+        agg = tail["tenants"].get(name, {})
+        if agg.get("dominant_tail_segment") != "queue_wait" or \
+                agg.get("p99_mix", {}).get("queue_wait", 0.0) < 0.5:
+            failures += 1
+            print(f"SLO: victim {name} p99 not attributed >= 50% to "
+                  f"queue_wait: {agg.get('p99_mix')}")
+        if agg.get("p50_mix", {}).get("queue_wait", 0.0) >= 0.5:
+            failures += 1
+            print(f"SLO: victim {name} p50 mix is queue-dominated — "
+                  f"the baseline should be compute-bound: "
+                  f"{agg.get('p50_mix')}")
+    whale_dom = tail["tenants"].get("pool-0", {}).get(
+        "dominant_tail_segment") or ""
+    if not whale_dom.startswith("compute:"):
+        failures += 1
+        print(f"SLO: the whale's own tail should be compute-bound, "
+              f"got {whale_dom!r}")
+    for _ in range(2):
+        snap = monitor.snapshot()
+    slo_comp = snap["components"]["slo"]
+    burning = slo_comp.get("signals", {}).get("burning_tenants", [])
+    if slo_comp["status"] != DEGRADED or \
+            not set(victim_names) <= set(burning):
+        failures += 1
+        print(f"SLO: sustained burn did not degrade /healthz naming "
+              f"the victims: {slo_comp}")
+    # admission.wait span: queue time must be a real span under the
+    # root, carrying its ticket bytes and queue depth at enqueue
+    waits = [sp for s in pool._sessions
+             if s.last_query_trace() is not None
+             for sp in s.last_query_trace().span_dicts()
+             if sp["name"] == "admission.wait"]
+    if not waits or not any("queue_depth_at_enqueue" in sp["attrs"]
+                            for sp in waits):
+        failures += 1
+        print("SLO: no admission.wait span with queue depth recorded")
+    overhead = LatencyObservatory.get().overhead()
+    if overhead["pct"] >= 5.0:
+        failures += 1
+        print(f"SLO: critical-path extraction overhead "
+              f"{overhead['pct']:.2f}% of query wall (>= 5%)")
+    # tail-report CLI over the same ledger: the culprit line must name
+    # queue_wait for a victim tenant
+    import contextlib
+    import io
+    from spark_rapids_tpu.tools.tail_report import run_tail_report
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = run_tail_report(hist_whale)
+    cli_out = buf.getvalue()
+    if rc != 0 or not any(f"tenant {v}'s p99 is" in cli_out and
+                          "queue_wait" in cli_out
+                          for v in victim_names):
+        failures += 1
+        print(f"SLO: tools tail-report did not name queue_wait as a "
+              f"victim's dominant tail segment:\n{cli_out}")
+
+    pool.drain(timeout=60)
+    pool.close()
+    shutil.rmtree(hist, ignore_errors=True)
+    shutil.rmtree(hist_whale, ignore_errors=True)
+    MetricsRegistry.reset_for_tests()
+    AdmissionController.reset_for_tests()
+    LatencyObservatory.reset_for_tests()
+    if failures:
+        print(f"slo gate: {failures} failure(s)")
+        return 1
+    print(f"slo gate clean ({len(records)} golden queries reconciled "
+          f"segments to wall with span/counter/ledger sinks agreeing; "
+          f"injected whale flipped the burn-rate health rule naming "
+          f"{burning}; victims' p99 >= 50% queue_wait with "
+          f"compute-dominated p50; extraction overhead "
+          f"{overhead['pct']:.2f}% < 5%)")
+    return 0
+
+
 def main(argv=None):
     args = argv if argv is not None else sys.argv[1:]
     if "--interp" in args:
@@ -3688,6 +4013,8 @@ def main(argv=None):
         return run_dsan_gate()
     if "--hlo" in args:
         return run_hlo_gate()
+    if "--slo" in args:
+        return run_slo_gate()
     from spark_rapids_tpu.tools.__main__ import main as tools_main
     cli = ["lint", "--repo", "--baseline", BASELINE]
     if "--update-baseline" in args:

@@ -147,6 +147,23 @@ class SessionPool:
         rep["whale_bytes"] = whale_bytes
         return rep
 
+    def slo_report(self) -> Dict:
+        """Pool-level SLO rollup from the latency observatory (a
+        singleton — every pool session's traced queries record into it
+        under their ``pool-<i>`` tenant): per-tenant good/total counts,
+        windowed burn rate, p50/p99 and the dominant tail segment,
+        plus a worst-burn line mirroring hbm_report's whale line."""
+        from ..obs.slo import LatencyObservatory
+        rep = LatencyObservatory.get().slo_report()
+        worst, worst_burn = None, 0.0
+        for tenant, row in rep.get("tenants", {}).items():
+            if row.get("burn_rate", 0.0) > worst_burn:
+                worst, worst_burn = tenant, row["burn_rate"]
+        rep["pool_size"] = self.size
+        rep["worst_burn_tenant"] = worst
+        rep["worst_burn_rate"] = worst_burn
+        return rep
+
     # -- lifecycle ------------------------------------------------------------
     def drain(self, timeout: Optional[float] = None) -> None:
         """Block until every session is idle (all in-flight queries

@@ -128,6 +128,19 @@ class TpuSession:
             blend_cap=conf.get(cfg.FEEDBACK_BLEND_CAP),
             min_observations=conf.get(cfg.FEEDBACK_MIN_OBSERVATIONS),
             replan_factor=conf.get(cfg.FEEDBACK_REPLAN_FACTOR))
+        # latency observatory: per-tenant SLO windows + tail reservoir
+        # fed by critical-path extraction on every traced query; the
+        # per-query ledger lands in the regress HistoryDir
+        from ..obs.slo import LatencyObservatory
+        slo_ledger = None
+        hist_dir = conf.get(cfg.REGRESS_HISTORY_DIR)
+        if hist_dir:
+            from ..obs.history import HistoryDir
+            slo_ledger = HistoryDir(hist_dir).latency_ledger_path()
+        LatencyObservatory.get().configure(
+            target_ms=conf.get(cfg.SLO_TARGET_MS),
+            objective=conf.get(cfg.SLO_OBJECTIVE),
+            ledger_path=slo_ledger)
         from ..memory.meta import set_default_codec
         set_default_codec(conf.get(cfg.SHUFFLE_COMPRESSION_CODEC))
         from ..shims import ShimLoader, set_active_shim
@@ -753,6 +766,19 @@ class TpuSession:
                     tracer, "measured_peak_device_bytes", None))
         except Exception:
             pass  # grading is advisory; never mask the query's outcome
+        try:
+            # critical-path extraction + SLO accounting: annotates the
+            # root span (so the event-log write below carries it into
+            # Perfetto), bumps the per-segment counters and feeds the
+            # latency observatory's burn window / tail reservoir
+            from ..obs.critpath import record_query_latency
+            record_query_latency(
+                tracer, tenant=getattr(self, "_tenant", "") or "default",
+                error=error,
+                label=type(final_plan).__name__ if final_plan is not None
+                else "")
+        except Exception:
+            pass  # attribution is advisory; never mask the query's outcome
         if eventlog_dir is None or final_plan is None:
             return
         sql_id = self._sql_counter

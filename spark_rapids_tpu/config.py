@@ -949,6 +949,27 @@ SERVE_POOL_SIZE = conf("spark.rapids.tpu.serve.poolSize").integer() \
     .check(lambda v: v >= 1, "must be >= 1") \
     .create_with_default(4)
 
+SLO_TARGET_MS = conf("spark.rapids.tpu.slo.targetMs").integer() \
+    .doc("Per-request latency objective for the latency observatory "
+         "(obs/slo.py): a traced query counts GOOD when it completes "
+         "within this many milliseconds; failed queries are always "
+         "BAD.  Feeds the per-tenant tpu_slo_{good,total,burn_rate} "
+         "gauges, the sustained-burn /healthz rule and "
+         "SessionPool.slo_report().  Unset disables SLO accounting — "
+         "critical-path extraction (obs/critpath.py) still runs for "
+         "every traced query.") \
+    .check(lambda v: v >= 1, "must be >= 1") \
+    .create_optional()
+
+SLO_OBJECTIVE = conf("spark.rapids.tpu.slo.objective").double() \
+    .doc("Fraction of requests that must meet slo.targetMs.  The "
+         "windowed burn rate is (bad share) / (1 - objective): burn "
+         "1.0 spends error budget exactly as fast as the objective "
+         "allows, and sustained burn > 1 across two health snapshots "
+         "degrades /healthz naming the burning tenant.") \
+    .check(lambda v: 0.0 < v < 1.0, "must be in (0, 1)") \
+    .create_with_default(0.99)
+
 # --- feedback-directed planning (estimator observatory) -------------------
 
 FEEDBACK_ENABLED = conf("spark.rapids.tpu.feedback.enabled").boolean() \

@@ -180,47 +180,57 @@ class AdmissionController:
         t0 = time.monotonic()
         token = object()
         queued = False
-        with self._cv:
-            self._queue.append(token)
-            self._tenant_add(self._queued_by_tenant, tenant, 1)
-            try:
-                while self._queue[0] is not token or \
-                        self._in_flight + nbytes > self.budget_bytes:
-                    if not queued:
-                        queued = True
-                        self._counter(
-                            "tpu_admission_queued_total",
-                            "tickets that had to wait before "
-                            "admission", tenant).inc()
+        # queue time becomes a real span under the query root (admit()
+        # runs between phase:plan and phase:execute, so the thread's
+        # span stack is empty and the span parents to the root): the
+        # Perfetto timeline shows the wait and critical-path extraction
+        # books it as queue_wait instead of inferring it
+        from ..obs.tracer import trace_span
+        with trace_span("admission.wait", bytes=nbytes,
+                        tenant=tenant) as span:
+            with self._cv:
+                self._queue.append(token)
+                span.set(queue_depth_at_enqueue=len(self._queue) - 1)
+                self._tenant_add(self._queued_by_tenant, tenant, 1)
+                try:
+                    while self._queue[0] is not token or \
+                            self._in_flight + nbytes > self.budget_bytes:
+                        if not queued:
+                            queued = True
+                            self._counter(
+                                "tpu_admission_queued_total",
+                                "tickets that had to wait before "
+                                "admission", tenant).inc()
+                        self._publish_gauges()
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            self._counter(
+                                "tpu_admission_timeouts_total",
+                                "tickets that hit "
+                                "serve.admissionTimeoutMs without "
+                                "fitting in the budget",
+                                tenant).inc()
+                            raise AdmissionTimeout(
+                                f"admission ticket {label or '(query)'} "
+                                f"({nbytes} bytes) timed out after "
+                                f"{timeout:g}s: budget "
+                                f"{self.budget_bytes} bytes, "
+                                f"{self._in_flight} in flight, "
+                                f"{len(self._queue) - 1} ahead/behind "
+                                f"in queue")
+                        self._cv.wait(remaining)
+                    self._in_flight += nbytes
+                    self._tenant_add(self._inflight_by_tenant, tenant,
+                                     nbytes)
+                    if self._in_flight > self.max_in_flight_seen:
+                        self.max_in_flight_seen = self._in_flight
+                finally:
+                    self._queue.remove(token)
+                    self._tenant_add(self._queued_by_tenant, tenant, -1)
                     self._publish_gauges()
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        self._counter(
-                            "tpu_admission_timeouts_total",
-                            "tickets that hit serve.admissionTimeoutMs "
-                            "without fitting in the budget",
-                            tenant).inc()
-                        raise AdmissionTimeout(
-                            f"admission ticket {label or '(query)'} "
-                            f"({nbytes} bytes) timed out after "
-                            f"{timeout:g}s: budget "
-                            f"{self.budget_bytes} bytes, "
-                            f"{self._in_flight} in flight, "
-                            f"{len(self._queue) - 1} ahead/behind in "
-                            f"queue")
-                    self._cv.wait(remaining)
-                self._in_flight += nbytes
-                self._tenant_add(self._inflight_by_tenant, tenant,
-                                 nbytes)
-                if self._in_flight > self.max_in_flight_seen:
-                    self.max_in_flight_seen = self._in_flight
-            finally:
-                self._queue.remove(token)
-                self._tenant_add(self._queued_by_tenant, tenant, -1)
-                self._publish_gauges()
-                # head departure (admitted OR timed out) can unblock
-                # the next waiter
-                self._cv.notify_all()
+                    # head departure (admitted OR timed out) can
+                    # unblock the next waiter
+                    self._cv.notify_all()
         wait_s = time.monotonic() - t0
         self._counter("tpu_admission_admitted_total",
                       "tickets granted a byte reservation",

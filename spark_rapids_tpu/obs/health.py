@@ -130,6 +130,9 @@ class HealthMonitor:
       shuffle    DEGRADED when ``tpu_shuffle_heartbeat_missed_total``
                  moved
       queries    DEGRADED when ``tpu_queries_failed_total`` moved
+      slo        DEGRADED when the SAME tenant's SLO burn rate stays
+                 above 1 for two consecutive snapshots (the burning
+                 tenants are named in ``burning_tenants``)
 
     Overall status = worst component.  A component with no series yet
     reports OK — absence of a subsystem is not an alert.
@@ -165,6 +168,7 @@ class HealthMonitor:
         self._prev: Dict[str, int] = {}
         self._queue_deep_prev = False
         self._hbm_tight_prev = False
+        self._slo_burning_prev: set = set()
         self._lock = threading.Lock()
 
     def snapshot(self) -> Dict:
@@ -212,6 +216,29 @@ class HealthMonitor:
                     _SEVERITY[DEGRADED] > _SEVERITY[hbm["status"]]:
                 hbm["status"] = DEGRADED
             self._hbm_tight_prev = tight
+            # latency observatory: sustained per-tenant SLO burn.  The
+            # gauge sum across tenants is meaningless here (one tenant
+            # at burn 4 must not hide behind three at 0), so this rule
+            # reads each tenant's series and degrades only when the
+            # SAME tenant burns > 1 in two consecutive snapshots,
+            # naming it — the page the operator gets says WHO
+            burn_by_tenant: Dict[str, float] = {}
+            for fam in reg.families():
+                if fam.name == "tpu_slo_burn_rate":
+                    for labels, ch in fam.series():
+                        burn_by_tenant[labels.get("tenant", "?")] = \
+                            ch.value
+            slo = components.setdefault("slo",
+                                        {"status": OK, "signals": {}})
+            slo["signals"]["tpu_slo_burn_rate"] = burn_by_tenant
+            burning = {t for t, v in burn_by_tenant.items()
+                       if v is not None and v > 1.0}
+            sustained = sorted(burning & self._slo_burning_prev)
+            if sustained:
+                slo["signals"]["burning_tenants"] = sustained
+                if _SEVERITY[DEGRADED] > _SEVERITY[slo["status"]]:
+                    slo["status"] = DEGRADED
+            self._slo_burning_prev = burning
         probe_ok = _gauge_value(reg, "tpu_device_probe_ok")
         dev = components.setdefault("device",
                                     {"status": OK, "signals": {}})

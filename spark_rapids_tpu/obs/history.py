@@ -52,7 +52,8 @@ DETERMINISTIC_FIELDS = ("plan_shape", "operators", "fallback_ops",
 #: advisory fields (never compared in CI)
 TIMING_FIELDS = ("wall_ms", "operator_time_ns", "peak_device_bytes",
                  "compile_seconds", "estimate_rows_err",
-                 "pad_waste_ratio")
+                 "pad_waste_ratio", "slo_burn_rate",
+                 "tail_dominant_segment")
 
 
 # ---------------------------------------------------------------------------
@@ -199,6 +200,15 @@ class HistoryDir:
         model."""
         from .estimator import ESTIMATOR_LEDGER_FILENAME
         return os.path.join(self.path, ESTIMATOR_LEDGER_FILENAME)
+
+    def latency_ledger_path(self) -> str:
+        """The per-query latency ledger (JSONL, appended by the
+        latency observatory, obs/slo.py): one line per traced query
+        with its wall time, GOOD/BAD verdict and full critical-path
+        segment breakdown — the third critical-path sink, read back by
+        `tools tail-report`."""
+        from .slo import LATENCY_LEDGER_FILENAME
+        return os.path.join(self.path, LATENCY_LEDGER_FILENAME)
 
     def postmortems_dir(self) -> str:
         """The failure black box's bundle directory (obs/postmortem.py
@@ -393,6 +403,22 @@ def diff_fingerprints(old: Dict, new: Dict,
                         q, "serve_latency_regression",
                         f"{f} {ov:.1f}ms -> {nv:.1f}ms "
                         f"(> {wall_threshold_pct:g}% threshold)", False))
+        # tail-mix shift: a tenant whose dominant p99 segment changed
+        # between runs (compute -> queue_wait is the classic whale
+        # signature).  Timing-class discipline as above: only reported
+        # when percentile checks were asked for, only when BOTH runs
+        # carry the field, and never deterministic — the tail of a
+        # concurrent mix is scheduling-dependent by nature.
+        otd = old.get("tail_dominant_segment")
+        ntd = new.get("tail_dominant_segment")
+        if isinstance(otd, dict) and isinstance(ntd, dict):
+            for tenant in sorted(set(otd) & set(ntd)):
+                if otd[tenant] and ntd[tenant] and \
+                        otd[tenant] != ntd[tenant]:
+                    out.append(Drift(
+                        q, "tail_mix_shift",
+                        f"tenant {tenant} dominant tail segment "
+                        f"{otd[tenant]} -> {ntd[tenant]}", False))
     return out
 
 

@@ -1,0 +1,320 @@
+"""Per-tenant SLO tracking and tail-latency attribution.
+
+obs/critpath.py answers "where did *this* query's time go"; this
+module answers the two serving questions built on top of it:
+
+* **Is each tenant meeting its latency objective?**
+  ``spark.rapids.tpu.slo.targetMs`` defines GOOD (wall <= target and
+  not failed); ``spark.rapids.tpu.slo.objective`` is the fraction of
+  requests that must be GOOD.  A count-based sliding window per tenant
+  feeds a burn rate — ``(bad share in window) / (1 - objective)`` — so
+  burn 1.0 means "spending error budget exactly as fast as allowed"
+  and sustained burn > 1 degrades /healthz, naming the tenant
+  (obs/health.py).  Published as ``tpu_slo_{good,total,burn_rate}``
+  gauges labeled by tenant.
+
+* **What makes the tail slow?**  A bounded reservoir keeps the
+  slowest-N requests per tenant with their full segment breakdowns,
+  alongside a recent ring for p50 context.  ``aggregate_tail``
+  contrasts the p50 vs p99 segment mix and names the dominant tail
+  segment — the evidence shape ROADMAP item 4 (weighted-fair
+  admission) will gate on: "tenant pool-3's p99 is 71% queue-wait
+  under tenant pool-0's whale".
+
+Every recorded query is also appended to ``latency_ledger.jsonl`` in
+the regress HistoryDir (obs/history.py) — the third critical-path
+sink, read back by ``tools tail-report`` for cross-process and
+post-hoc analysis.  Singleton discipline follows the compile/HBM
+observatories: ``LatencyObservatory.get()`` everywhere,
+``reset_for_tests()`` in gates.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+#: burn-rate window: last N requests per tenant.
+BURN_WINDOW = 64
+#: recent ring per tenant — p50/p99 mixes are computed over this.
+RECENT_RING = 256
+#: slowest-N reservoir per tenant: guarantees extreme-tail retention
+#: even after the ring has rotated past a whale incident.
+TAIL_RESERVOIR = 8
+
+#: JSONL ledger filename inside the regress HistoryDir (obs/history.py)
+LATENCY_LEDGER_FILENAME = "latency_ledger.jsonl"
+
+GOOD_FAMILY = "tpu_slo_good"
+TOTAL_FAMILY = "tpu_slo_total"
+BURN_FAMILY = "tpu_slo_burn_rate"
+
+
+def _pct(xs: Sequence[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    idx = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+    return xs[idx]
+
+
+def _mix(records: Sequence[dict]) -> Dict[str, float]:
+    """Normalized segment shares across a set of per-query records."""
+    totals: Dict[str, float] = {}
+    for r in records:
+        for seg, sec in (r.get("segments") or {}).items():
+            totals[seg] = totals.get(seg, 0.0) + float(sec)
+    denom = sum(totals.values())
+    if denom <= 0:
+        return {}
+    return {k: v / denom for k, v in sorted(totals.items())}
+
+
+def aggregate_tail(records: Sequence[dict]) -> Optional[dict]:
+    """Contrast the p50 vs p99 segment mix for one tenant's records
+    (each ``{"wall_s": float, "segments": {seg: sec}}``).  Shared by
+    the live observatory and ``tools tail-report`` so both agree on
+    what "dominant tail segment" means."""
+    records = [r for r in records if r.get("wall_s") is not None]
+    if not records:
+        return None
+    walls = [float(r["wall_s"]) for r in records]
+    p50_s, p99_s = _pct(walls, 0.50), _pct(walls, 0.99)
+    body = [r for r in records if float(r["wall_s"]) <= p50_s] or records
+    tail = [r for r in records if float(r["wall_s"]) >= p99_s]
+    if not tail:
+        tail = [max(records, key=lambda r: float(r["wall_s"]))]
+    p50_mix, p99_mix = _mix(body), _mix(tail)
+    dominant = max(p99_mix, key=p99_mix.get) if p99_mix else None
+    return {
+        "count": len(records),
+        "p50_ms": round(p50_s * 1000.0, 3),
+        "p99_ms": round(p99_s * 1000.0, 3),
+        "p50_mix": {k: round(v, 4) for k, v in p50_mix.items()},
+        "p99_mix": {k: round(v, 4) for k, v in p99_mix.items()},
+        "dominant_tail_segment": dominant,
+        "dominant_tail_share": round(p99_mix.get(dominant, 0.0), 4)
+        if dominant else 0.0,
+    }
+
+
+class _TenantState:
+    __slots__ = ("good", "total", "window", "ring", "reservoir", "wall_s")
+
+    def __init__(self):
+        self.good = 0
+        self.total = 0
+        self.window = deque(maxlen=BURN_WINDOW)   # recent GOOD/BAD bits
+        self.ring = deque(maxlen=RECENT_RING)     # recent records
+        self.reservoir = []                       # slowest-N records
+        self.wall_s = 0.0
+
+    def burn_rate(self, objective: float) -> float:
+        if not self.window:
+            return 0.0
+        bad = sum(1 for g in self.window if not g)
+        return (bad / len(self.window)) / max(1e-9, 1.0 - objective)
+
+    def tail_records(self) -> List[dict]:
+        # ring plus reservoir, deduplicated by sequence stamp: the
+        # reservoir re-surfaces whales the ring has already rotated out.
+        seen = set()
+        out = []
+        for r in list(self.ring) + [r for _, _, r in self.reservoir]:
+            if r["seq"] not in seen:
+                seen.add(r["seq"])
+                out.append(r)
+        return out
+
+
+class LatencyObservatory:
+    """Process-wide singleton; per-tenant SLO windows + tail records."""
+
+    _instance: Optional["LatencyObservatory"] = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "LatencyObservatory":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset_for_tests(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._io = threading.Lock()
+        self._target_ms: Optional[int] = None
+        self._objective: float = 0.99
+        self._ledger_path: Optional[str] = None
+        self._tenants: Dict[str, _TenantState] = {}
+        self._seq = 0
+        self._extract_s = 0.0
+        self._query_wall_s = 0.0
+
+    # -- configuration ------------------------------------------------------
+    def configure(self, target_ms: Optional[int] = None,
+                  objective: Optional[float] = None,
+                  ledger_path: Optional[str] = None) -> "LatencyObservatory":
+        """Idempotent: pool sessions all configure with the same conf;
+        None leaves the existing value in place so a late session does
+        not wipe a configured target."""
+        with self._mu:
+            if target_ms is not None:
+                self._target_ms = int(target_ms)
+            if objective is not None:
+                self._objective = float(objective)
+            if ledger_path is not None:
+                self._ledger_path = str(ledger_path)
+        return self
+
+    @property
+    def target_ms(self) -> Optional[int]:
+        return self._target_ms
+
+    @property
+    def objective(self) -> float:
+        return self._objective
+
+    # -- record side --------------------------------------------------------
+    def record(self, tenant: str, wall_s: float, segments: Dict[str, float],
+               failed: bool = False, label: str = "",
+               reconciled: bool = True, extract_s: float = 0.0) -> None:
+        from .metrics import MetricsRegistry
+        tenant = tenant or "default"
+        wall_ms = wall_s * 1000.0
+        with self._mu:
+            st = self._tenants.setdefault(tenant, _TenantState())
+            self._seq += 1
+            good = (not failed) and (self._target_ms is None
+                                     or wall_ms <= self._target_ms)
+            st.total += 1
+            if good:
+                st.good += 1
+            st.window.append(good)
+            rec = {"seq": self._seq, "wall_s": wall_s,
+                   "segments": dict(segments), "failed": failed,
+                   "label": label}
+            st.ring.append(rec)
+            st.reservoir.append((wall_s, self._seq, rec))
+            st.reservoir.sort(key=lambda t: (-t[0], t[1]))
+            del st.reservoir[TAIL_RESERVOIR:]
+            st.wall_s += wall_s
+            self._extract_s += extract_s
+            self._query_wall_s += wall_s
+            burn = st.burn_rate(self._objective)
+            good_n, total_n = st.good, st.total
+            ledger_path = self._ledger_path
+            objective = self._objective
+            target_ms = self._target_ms
+        reg = MetricsRegistry.get()
+        doc = "Per-tenant SLO tracking (obs/slo.py)."
+        reg.gauge(GOOD_FAMILY, doc, ("tenant",)).labels(
+            tenant=tenant).set(good_n)
+        reg.gauge(TOTAL_FAMILY, doc, ("tenant",)).labels(
+            tenant=tenant).set(total_n)
+        reg.gauge(BURN_FAMILY, doc, ("tenant",)).labels(
+            tenant=tenant).set(round(burn, 4))
+        if ledger_path:
+            line = json.dumps({
+                "ts": round(time.time(), 3), "tenant": tenant,
+                "label": label, "wall_s": round(wall_s, 6),
+                "failed": failed, "good": good, "reconciled": reconciled,
+                "target_ms": target_ms, "objective": objective,
+                "segments": {k: round(v, 6) for k, v in segments.items()},
+            }, sort_keys=True)
+            try:
+                with self._io:
+                    with open(ledger_path, "a", encoding="utf-8") as fh:
+                        fh.write(line + "\n")
+            except OSError:
+                pass  # advisory sink: a read-only HistoryDir must not fail queries
+
+    # -- read side -----------------------------------------------------------
+    def overhead(self) -> dict:
+        with self._mu:
+            pct = (100.0 * self._extract_s / self._query_wall_s
+                   if self._query_wall_s > 0 else 0.0)
+            return {"extract_s": round(self._extract_s, 6),
+                    "query_wall_s": round(self._query_wall_s, 6),
+                    "pct": round(pct, 4)}
+
+    def slo_report(self) -> dict:
+        with self._mu:
+            tenants = {}
+            for name in sorted(self._tenants):
+                st = self._tenants[name]
+                walls = [r["wall_s"] * 1000.0 for r in st.ring]
+                agg = aggregate_tail(st.tail_records())
+                tenants[name] = {
+                    "good": st.good, "total": st.total,
+                    "window": len(st.window),
+                    "burn_rate": round(st.burn_rate(self._objective), 4),
+                    "p50_ms": round(_pct(walls, 0.50), 3),
+                    "p99_ms": round(_pct(walls, 0.99), 3),
+                    "dominant_tail_segment":
+                        agg["dominant_tail_segment"] if agg else None,
+                }
+            return {"enabled": self._target_ms is not None,
+                    "target_ms": self._target_ms,
+                    "objective": self._objective,
+                    "burn_window": BURN_WINDOW,
+                    "overhead": {
+                        "extract_s": round(self._extract_s, 6),
+                        "query_wall_s": round(self._query_wall_s, 6),
+                        "pct": round(100.0 * self._extract_s
+                                     / self._query_wall_s, 4)
+                        if self._query_wall_s > 0 else 0.0},
+                    "tenants": tenants}
+
+    def tail_report(self) -> dict:
+        with self._mu:
+            tenants = {}
+            for name in sorted(self._tenants):
+                st = self._tenants[name]
+                agg = aggregate_tail(st.tail_records())
+                if agg is None:
+                    continue
+                agg["slowest"] = [
+                    {"wall_ms": round(w * 1000.0, 3), "label": r["label"],
+                     "failed": r["failed"]}
+                    for w, _, r in st.reservoir]
+                tenants[name] = agg
+            return {"target_ms": self._target_ms,
+                    "objective": self._objective, "tenants": tenants}
+
+
+def format_tail_report(report: dict) -> str:
+    """Human rendering shared by ``tools tail-report`` and the gate."""
+    lines = []
+    tenants = report.get("tenants") or {}
+    if not tenants:
+        return "tail-report: no recorded queries"
+    for name, agg in tenants.items():
+        p50d = max(agg["p50_mix"], key=agg["p50_mix"].get) \
+            if agg.get("p50_mix") else None
+        dom = agg.get("dominant_tail_segment")
+        share = agg.get("dominant_tail_share", 0.0)
+        lines.append(
+            f"tenant {name}: n={agg['count']} p50={agg['p50_ms']:.1f}ms"
+            f" ({p50d or '-'}) | p99={agg['p99_ms']:.1f}ms —"
+            f" tenant {name}'s p99 is {share:.0%} {dom or '-'}")
+        for s in agg.get("slowest", ())[:3]:
+            lines.append(f"    slowest: {s['wall_ms']:.1f}ms"
+                         f" {s['label'] or '(unlabeled)'}"
+                         f"{' FAILED' if s.get('failed') else ''}")
+    # name the heaviest tenant by total recorded wall — the usual whale
+    by_wall = sorted(
+        ((sum(s["wall_ms"] for s in agg.get("slowest", ())), name)
+         for name, agg in tenants.items()), reverse=True)
+    if by_wall and by_wall[0][0] > 0:
+        lines.append(f"heaviest tail (sum of slowest-N wall): "
+                     f"tenant {by_wall[0][1]}")
+    return "\n".join(lines)

@@ -10,6 +10,7 @@
     python -m spark_rapids_tpu.tools regress --history DIR --record <eventlog...> [--label L]
     python -m spark_rapids_tpu.tools regress --history DIR --check [--wall-threshold PCT]
     python -m spark_rapids_tpu.tools compile-report --ledger PATH [--top N] [--json]
+    python -m spark_rapids_tpu.tools tail-report    --ledger PATH [--top N] [--json]
     python -m spark_rapids_tpu.tools estimator-report --ledger PATH [--top N] [--json]
     python -m spark_rapids_tpu.tools kernel-report  --compile-ledger PATH --estimator-ledger PATH [--top N] [--json]
     python -m spark_rapids_tpu.tools prewarm        --ledger DIR [--top K] [--cache-dir DIR]
@@ -28,6 +29,12 @@ history dir holding compile_ledger.jsonl) into top-programs-by-compile-
 cost, miss causes, churn offenders and the bucket-canonicalization
 dedupe projection — the evidence for the persistent-program-cache key
 design (ROADMAP item 1).
+
+`tail-report` aggregates the latency observatory's per-query ledger
+(obs/slo.py; `--ledger` takes latency_ledger.jsonl or the history dir
+holding it) into per-tenant p50-vs-p99 segment mixes and names each
+tenant's dominant tail segment — the whale-victim evidence ROADMAP
+item 4's weighted-fair admission will be judged against.
 
 `estimator-report` is its planner-side twin: it aggregates the
 estimator observatory's ledger (obs/estimator.py; `--ledger` takes the
@@ -526,6 +533,19 @@ def main(argv=None):
                     help="rows per ranking section")
     cr.add_argument("--json", action="store_true",
                     help="emit the aggregate as JSON instead of text")
+    tr = sub.add_parser("tail-report",
+                        help="contrast per-tenant p50 vs p99 segment "
+                             "mixes from the latency observatory "
+                             "ledger and name each tenant's dominant "
+                             "tail segment")
+    tr.add_argument("--ledger", required=True,
+                    help="latency_ledger.jsonl or the history dir "
+                         "containing it "
+                         "(spark.rapids.tpu.regress.historyDir)")
+    tr.add_argument("--top", type=int, default=3,
+                    help="slowest queries listed per tenant")
+    tr.add_argument("--json", action="store_true",
+                    help="emit the aggregate as JSON instead of text")
     kr = sub.add_parser("kernel-report",
                         help="rank compiled programs by kernel gap x "
                              "measured seconds x padding waste (the "
@@ -615,6 +635,10 @@ def main(argv=None):
         from .compile_report import run_compile_report
         return run_compile_report(args.ledger, top=args.top,
                                   as_json=args.json)
+    elif args.cmd == "tail-report":
+        from .tail_report import run_tail_report
+        return run_tail_report(args.ledger, top=args.top,
+                               as_json=args.json)
     elif args.cmd == "kernel-report":
         from .kernel_report import run_kernel_report
         return run_kernel_report(args.compile_ledger,

@@ -1,0 +1,89 @@
+"""`tools tail-report`: aggregate the latency observatory's per-query
+ledger (obs/slo.py; ``latency_ledger.jsonl`` in the regress
+HistoryDir) into per-tenant tail-latency attribution:
+
+* **p50 vs p99 segment mix** — what a typical request spends its time
+  on versus what the slowest requests spend it on.  A healthy tenant's
+  two mixes look alike; a whale victim's p99 mix is dominated by
+  ``queue_wait`` while its p50 stays compute-dominated.
+* **Dominant tail segment** — the single segment that explains the
+  most p99 wall time per tenant, the one-line answer ("tenant pool-3's
+  p99 is 71% queue-wait") ROADMAP item 4's weighted-fair admission
+  will be judged against.
+* **Slowest-N receipts** — the reservoir rows behind the percentages,
+  so a surprising mix can be chased to concrete queries.
+
+The aggregation itself lives in obs/slo.py (``aggregate_tail``) so the
+offline report and the live ``SessionPool.slo_report()`` can never
+disagree about what "dominant" means.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+
+def load_ledger(path: str) -> List[Dict]:
+    """Parse one latency ledger (JSONL).  ``path`` may be the file or
+    a directory containing ``latency_ledger.jsonl``.  Unparsable lines
+    are skipped — the ledger is append-under-crash telemetry and a
+    torn final line must not kill the report."""
+    from ..obs.slo import LATENCY_LEDGER_FILENAME
+    if os.path.isdir(path):
+        path = os.path.join(path, LATENCY_LEDGER_FILENAME)
+    records: List[Dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and "wall_s" in rec:
+                records.append(rec)
+    return records
+
+
+def aggregate_records(records: List[Dict], top: int = 3) -> Dict:
+    """Group ledger records by tenant and run the shared tail
+    aggregation over each group."""
+    from ..obs.slo import aggregate_tail
+    by_tenant: Dict[str, List[Dict]] = {}
+    for r in records:
+        by_tenant.setdefault(r.get("tenant") or "default", []).append(r)
+    tenants: Dict[str, Dict] = {}
+    for name in sorted(by_tenant):
+        recs = by_tenant[name]
+        agg = aggregate_tail(recs)
+        if agg is None:
+            continue
+        slowest = sorted(recs, key=lambda r: -float(r["wall_s"]))[:top]
+        agg["slowest"] = [
+            {"wall_ms": round(float(r["wall_s"]) * 1000.0, 3),
+             "label": r.get("label") or "",
+             "failed": bool(r.get("failed"))}
+            for r in slowest]
+        tenants[name] = agg
+    return {"queries": len(records), "tenants": tenants}
+
+
+def run_tail_report(ledger: str, top: int = 3,
+                    as_json: bool = False) -> int:
+    try:
+        records = load_ledger(ledger)
+    except OSError as ex:
+        print(f"tail-report: cannot read ledger: {ex}")
+        return 1
+    report = aggregate_records(records, top=top)
+    if as_json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0
+    from ..obs.slo import format_tail_report
+    print(f"latency ledger: {report['queries']} queries, "
+          f"{len(report['tenants'])} tenants")
+    print(format_tail_report(report))
+    return 0

@@ -1,0 +1,317 @@
+"""Critical-path extraction edge cases (obs/critpath.py) plus the
+latency observatory's windows (obs/slo.py).
+
+The extractor runs on the neutral ``span_dicts()`` schema, so most
+tests here hand-build span trees with exact nanosecond intervals and
+assert the partition property directly: segments must sum to the root
+wall time (the sweep is an exact partition by construction — any
+residual is an algorithm bug, which is precisely what the tolerance
+gate exists to catch)."""
+
+import json
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.obs.critpath import (
+    RECONCILE_TOLERANCE, SEG_COMPILE, SEG_FETCH_SERVE, SEG_FETCH_WIRE,
+    SEG_OC_SPILL, SEG_OTHER, SEG_PLANNING, SEG_PREWARM, SEG_QUEUE_WAIT,
+    SEG_SHUFFLE_WRITE, extract_critical_path, segment_of)
+from spark_rapids_tpu.obs.slo import (LatencyObservatory, aggregate_tail,
+                                      format_tail_report)
+
+MS = 1_000_000  # ns
+
+
+def mk(sid, parent, name, kind, t0_ms, dur_ms, status="ok", proc=None,
+       **attrs):
+    d = {"spanId": sid, "parentId": parent, "name": name, "kind": kind,
+         "startNs": int(t0_ms * MS), "durNs": int(dur_ms * MS),
+         "status": status, "tid": 1, "attrs": attrs}
+    if proc:
+        d["proc"] = proc
+    return d
+
+
+def total(res):
+    return sum(res["segments"].values())
+
+
+@pytest.fixture
+def fresh_observatory():
+    LatencyObservatory.reset_for_tests()
+    yield
+    LatencyObservatory.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# partition property
+# ---------------------------------------------------------------------------
+
+def test_taxonomy_partition_sums_to_wall():
+    spans = [
+        mk(1, None, "query", "query", 0, 100),
+        mk(2, 1, "admission.wait", "span", 0, 10, bytes=1 << 20),
+        mk(3, 1, "phase:plan", "phase", 10, 10),
+        mk(4, 1, "phase:execute", "phase", 20, 75),
+        mk(5, 4, "FilterExec.execute", "operator", 25, 35,
+           op="FilterExec"),
+    ]
+    res = extract_critical_path(spans)
+    segs = res["segments"]
+    assert segs[SEG_QUEUE_WAIT] == pytest.approx(0.010)
+    assert segs[SEG_PLANNING] == pytest.approx(0.010)
+    assert segs["compute:FilterExec"] == pytest.approx(0.035)
+    # root self-time (95..100) + execute self-time (20..25, 60..95)
+    assert segs[SEG_OTHER] == pytest.approx(0.045)
+    assert total(res) == pytest.approx(res["wall_s"], abs=1e-12)
+    assert res["reconciled"]
+
+
+def test_concurrent_partitions_do_not_double_book():
+    # two per-partition execute spans overlap 10..90: naive duration
+    # summing books 170ms into a 100ms window; the sweep assigns each
+    # elementary slice to the covering span that ends last
+    spans = [
+        mk(1, None, "query", "query", 0, 100),
+        mk(2, 1, "phase:execute", "phase", 0, 100),
+        mk(3, 2, "AggExec.execute", "operator", 0, 90, op="AggExec"),
+        mk(4, 2, "AggExec.execute", "operator", 10, 90, op="AggExec"),
+    ]
+    res = extract_critical_path(spans)
+    assert res["segments"]["compute:AggExec"] == pytest.approx(0.100)
+    assert total(res) == pytest.approx(0.100, abs=1e-12)
+    assert res["reconciled"]
+
+
+def test_failed_query_error_span_mid_tree_reconciles():
+    # finalize() closes open spans on failure, so an error span still
+    # carries a closed interval — the partition must not care
+    spans = [
+        mk(1, None, "query", "query", 0, 50, status="error"),
+        mk(2, 1, "phase:execute", "phase", 10, 40, status="error"),
+        mk(3, 2, "SortExec.execute", "operator", 10, 25, status="error",
+           op="SortExec"),
+    ]
+    res = extract_critical_path(spans)
+    assert res["segments"]["compute:SortExec"] == pytest.approx(0.025)
+    assert total(res) == pytest.approx(res["wall_s"], abs=1e-12)
+    assert res["reconciled"]
+
+
+def test_zero_length_spans_and_events_are_ignored():
+    spans = [
+        mk(1, None, "query", "query", 0, 10),
+        mk(2, 1, "phase:execute", "phase", 0, 0),      # zero-length
+        mk(3, 1, "shuffle.remote_fetch", "event", 5, 0),
+        mk(4, 1, "fetch.crossing", "event", 6, 0),
+    ]
+    res = extract_critical_path(spans)
+    assert res["segments"] == {SEG_OTHER: pytest.approx(0.010)}
+    assert res["reconciled"]
+
+
+def test_remote_fetch_wire_vs_producer_serve_split():
+    # grafted producer spans carry `proc`: their time is the
+    # producer's serve, the fetch span's remaining self-time is wire
+    spans = [
+        mk(1, None, "query", "query", 0, 100),
+        mk(2, 1, "phase:execute", "phase", 0, 100),
+        mk(3, 2, "shuffle.fetch", "span", 10, 80, shuffle_id=1),
+        mk(4, 3, "ShuffleWriteExec.execute", "operator", 30, 40,
+           proc="executor-2", op="ShuffleWriteExec"),
+    ]
+    res = extract_critical_path(spans)
+    assert res["segments"][SEG_FETCH_WIRE] == pytest.approx(0.040)
+    assert res["segments"][SEG_FETCH_SERVE] == pytest.approx(0.040)
+    assert total(res) == pytest.approx(0.100, abs=1e-12)
+    assert res["reconciled"]
+
+
+def test_jit_build_event_synthesizes_compile_interval():
+    # jit.build is an instant event carrying total_s: the extractor
+    # reconstructs [t - total_s, t] as a compile child so operator
+    # self-time is not silently inflated by XLA builds
+    spans = [
+        mk(1, None, "query", "query", 0, 100),
+        mk(2, 1, "ProjectExec.execute", "operator", 0, 100,
+           op="ProjectExec"),
+        mk(3, 2, "jit.build", "event", 50, 0, total_s=0.030,
+           cause="new_program"),
+    ]
+    res = extract_critical_path(spans)
+    assert res["segments"][SEG_COMPILE] == pytest.approx(0.030)
+    assert res["segments"]["compute:ProjectExec"] == pytest.approx(0.070)
+    assert res["reconciled"]
+
+
+def test_prewarm_cause_classifies_separately():
+    spans = [
+        mk(1, None, "query", "query", 0, 50),
+        mk(2, 1, "jit.build", "event", 40, 0, total_s=0.020,
+           cause="prewarm"),
+    ]
+    res = extract_critical_path(spans)
+    assert res["segments"][SEG_PREWARM] == pytest.approx(0.020)
+    assert total(res) == pytest.approx(0.050, abs=1e-12)
+
+
+def test_compile_interval_clips_to_parent():
+    # a build longer than its parent's elapsed time must not book
+    # negative self-time: the synthetic interval clips at the parent
+    spans = [
+        mk(1, None, "query", "query", 0, 20),
+        mk(2, 1, "jit.build", "event", 10, 0, total_s=0.050),
+    ]
+    res = extract_critical_path(spans)
+    assert res["segments"][SEG_COMPILE] == pytest.approx(0.010)
+    assert total(res) == pytest.approx(0.020, abs=1e-12)
+    assert res["reconciled"]
+
+
+def test_empty_and_rootless_traces_are_benign():
+    assert extract_critical_path([])["segments"] == {}
+    res = extract_critical_path(
+        [mk(1, None, "phase:plan", "phase", 0, 10)])
+    assert res["segments"] == {} and res["reconciled"]
+
+
+def test_segment_of_taxonomy():
+    assert segment_of(mk(1, None, "oc.sort_run", "span", 0, 1)) == \
+        SEG_OC_SPILL
+    assert segment_of(mk(1, None, "shuffle.map_write", "span", 0, 1)) \
+        == SEG_SHUFFLE_WRITE
+    assert segment_of(mk(1, None, "replan", "replan", 0, 1)) == \
+        SEG_PLANNING
+    assert segment_of(mk(1, None, "bridge.execute_stage", "span", 0, 1)
+                      ) == SEG_OTHER
+    # proc wins over every name-based rule
+    assert segment_of(mk(1, None, "phase:plan", "phase", 0, 1,
+                         proc="exec-1")) == SEG_FETCH_SERVE
+
+
+# ---------------------------------------------------------------------------
+# observatory windows + tail aggregation
+# ---------------------------------------------------------------------------
+
+def test_burn_rate_window_and_reservoir(fresh_observatory):
+    obs = LatencyObservatory.get().configure(target_ms=100,
+                                             objective=0.9)
+    for _ in range(18):
+        obs.record("pool-1", 0.010, {"compute:FilterExec": 0.010})
+    for _ in range(2):
+        obs.record("pool-1", 0.500, {SEG_QUEUE_WAIT: 0.450,
+                                     "compute:FilterExec": 0.050})
+    rep = obs.slo_report()
+    t = rep["tenants"]["pool-1"]
+    assert t["total"] == 20 and t["good"] == 18
+    # bad share 2/20 = 10%, error budget 10% -> burn exactly 1.0
+    assert t["burn_rate"] == pytest.approx(1.0)
+    assert t["dominant_tail_segment"] == SEG_QUEUE_WAIT
+    tail = obs.tail_report()["tenants"]["pool-1"]
+    assert tail["slowest"][0]["wall_ms"] == pytest.approx(500.0)
+    assert tail["p99_mix"][SEG_QUEUE_WAIT] == pytest.approx(0.9)
+    assert "queue_wait" in format_tail_report(obs.tail_report())
+
+
+def test_failed_queries_are_always_bad(fresh_observatory):
+    obs = LatencyObservatory.get().configure(target_ms=10_000,
+                                             objective=0.5)
+    obs.record("pool-0", 0.001, {SEG_OTHER: 0.001}, failed=True)
+    rep = obs.slo_report()["tenants"]["pool-0"]
+    assert rep["good"] == 0 and rep["total"] == 1
+    assert rep["burn_rate"] == pytest.approx(2.0)
+
+
+def test_ledger_sink_appends_jsonl(fresh_observatory, tmp_path):
+    path = tmp_path / "latency_ledger.jsonl"
+    obs = LatencyObservatory.get().configure(target_ms=100,
+                                             ledger_path=str(path))
+    obs.record("pool-2", 0.042, {SEG_OTHER: 0.042}, label="AggExec")
+    obs.record("pool-2", 0.300, {SEG_QUEUE_WAIT: 0.300})
+    lines = [json.loads(x) for x in
+             path.read_text().strip().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["tenant"] == "pool-2" and lines[0]["good"]
+    assert not lines[1]["good"]
+    from spark_rapids_tpu.tools.tail_report import (aggregate_records,
+                                                    load_ledger)
+    agg = aggregate_records(load_ledger(str(tmp_path)))
+    assert agg["tenants"]["pool-2"]["dominant_tail_segment"] == \
+        SEG_QUEUE_WAIT
+
+
+def test_aggregate_tail_empty():
+    assert aggregate_tail([]) is None
+
+
+# ---------------------------------------------------------------------------
+# end to end: a real traced query flows through all three sinks
+# ---------------------------------------------------------------------------
+
+def test_traced_query_triple_sinks(fresh_observatory, tmp_path):
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.obs.metrics import MetricsRegistry
+
+    s = TpuSession.builder() \
+        .config("spark.rapids.sql.enabled", True) \
+        .config("spark.rapids.tpu.trace.enabled", True) \
+        .config("spark.rapids.tpu.slo.targetMs", 60_000) \
+        .config("spark.rapids.tpu.regress.historyDir", str(tmp_path)) \
+        .get_or_create()
+    df = s.create_dataframe(pa.table({"x": pa.array(range(256))}))
+    df.group_by(col("x")).agg(F.count("*").alias("c")).collect()
+
+    tracer = s.last_query_trace()
+    assert tracer is not None
+    root = [sp for sp in tracer.span_dicts() if sp["kind"] == "query"][0]
+    cp = root["attrs"].get("critical_path")
+    assert cp and root["attrs"]["critical_path_reconciled"]
+    assert sum(cp.values()) == pytest.approx(
+        root["durNs"] / 1e9, rel=RECONCILE_TOLERANCE, abs=1e-3)
+
+    fam = [f for f in MetricsRegistry.get().families()
+           if f.name == "tpu_latency_segment_seconds_total"]
+    assert fam and fam[0].total() > 0
+
+    obs = LatencyObservatory.get()
+    rep = obs.slo_report()
+    assert rep["enabled"] and rep["tenants"]["default"]["total"] >= 1
+    ledger = tmp_path / "latency_ledger.jsonl"
+    assert ledger.exists()
+    rec = json.loads(ledger.read_text().strip().splitlines()[-1])
+    assert rec["reconciled"] and rec["segments"]
+
+
+# -- tail-mix shift across runs ---------------------------------------------
+
+
+def test_tail_mix_shift_is_timing_class_and_threshold_gated():
+    from spark_rapids_tpu.obs.history import diff_fingerprints
+    base = {"sql_id": 0, "description": "q0",
+            "tail_dominant_segment": {"pool-1": "compute:FilterExec"}}
+    shifted = dict(base,
+                   tail_dominant_segment={"pool-1": "queue_wait"})
+    # no percentile checks asked for: silence
+    assert not any(d.kind == "tail_mix_shift"
+                   for d in diff_fingerprints(base, shifted))
+    drifts = diff_fingerprints(base, shifted, wall_threshold_pct=10)
+    d = next(d for d in drifts if d.kind == "tail_mix_shift")
+    assert not d.deterministic
+    assert "pool-1" in d.detail
+    assert "compute:FilterExec" in d.detail and "queue_wait" in d.detail
+
+
+def test_tail_mix_shift_needs_both_runs_to_carry_it():
+    """A history spanning the latency-observatory upgrade (old runs
+    have no tail_dominant_segment) must never false-trip."""
+    from spark_rapids_tpu.obs.history import diff_fingerprints
+    old = {"sql_id": 0, "description": "q0"}
+    new = {"sql_id": 0, "description": "q0",
+           "tail_dominant_segment": {"pool-1": "queue_wait"}}
+    for a, b in ((old, new), (new, old)):
+        assert not any(d.kind == "tail_mix_shift"
+                       for d in diff_fingerprints(
+                           a, b, wall_threshold_pct=10))

@@ -161,6 +161,24 @@ def test_run_lint_serve_gate_exits_zero():
     assert "serve gate clean" in proc.stdout, proc.stdout
 
 
+def test_run_lint_slo_gate_exits_zero():
+    """Tier-1 gate for the latency observatory: every golden-mix
+    query's critical-path segments must sum to wall within tolerance
+    with the span/counter/ledger sinks agreeing; an injected whale
+    (sleep-armed FilterExec + inflated admission ticket on pool-0)
+    must flip the sustained-burn health rule naming the victim tenants
+    and appear as tail-report's queue_wait culprit while victim p50
+    stays compute-dominated (anti-vacuity both ways); and the
+    extraction overhead must stay under 5% of query wall."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "devtools", "run_lint.py"),
+         "--slo"],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "slo gate clean" in proc.stdout, proc.stdout
+
+
 def test_run_lint_csan_gate_exits_zero():
     """Tier-1 gate for tpucsan: the concurrency repo pass (TPU-R008/
     R009/R010) must be clean modulo the baseline, the ABBA/shared-write/

@@ -42,6 +42,8 @@ def _fp(sql_id=0, **over):
         "compile_seconds": 4.2,
         "estimate_rows_err": 0.12,
         "pad_waste_ratio": 0.31,
+        "slo_burn_rate": 0.2,
+        "tail_dominant_segment": {"default": "compute:AggExec"},
     }
     fp.update(over)
     return fp
