@@ -695,10 +695,129 @@ def _hbm_trace_export(fact, dim, pq_path, out_root,
 _SERVE_SQL_ID = 100_000
 
 
-def serve_mix(session, fact, dim, pq_path):
+def measure_serve_deadlines(fact, dim, pq_path, concurrency: int = 8,
+                            deadline_ms: int = 1,
+                            queries_per_worker: int = 3) -> dict:
+    """``--deadline-ms`` leg: the serving mix with every third request
+    carrying a per-request deadline tight enough to always trip.  Those
+    requests must fail as TYPED TpuQueryDeadlineExceeded — counted
+    under ``tpu_cancellations_total{cause="deadline"}`` — while every
+    surviving request returns a bit-exact result vs a no-deadline
+    reference, with zero dirty memsan ledgers and balanced admission
+    books: a deadline storm is a correctness no-op for its
+    neighbours."""
+    import concurrent.futures as cf
+
+    from spark_rapids_tpu.api.pool import SessionPool
+    from spark_rapids_tpu.memory.admission import AdmissionController
+    from spark_rapids_tpu.obs import metrics as obs_metrics
+    from spark_rapids_tpu.obs.progress import TpuQueryDeadlineExceeded
+
+    conf = {
+        "spark.rapids.sql.enabled": "true",
+        "spark.rapids.tpu.memsan.enabled": "true",
+        "spark.rapids.tpu.serve.hbmAdmissionBudgetBytes": str(2 << 30),
+        "spark.rapids.tpu.serve.admissionTimeoutMs": "120000",
+    }
+    reg = obs_metrics.registry()
+
+    def deadline_cancels():
+        fam = reg.counter("tpu_cancellations_total",
+                          labelnames=("cause",))
+        return sum(ch.value for lbl, ch in fam.series()
+                   if lbl.get("cause") == "deadline")
+
+    def dirty_ledgers():
+        return reg.counter("tpu_memsan_dirty_ledgers_total").value()
+
+    pool = SessionPool(concurrency, conf)
+    plans = {id(s): serve_mix(s, fact, dim, pq_path, as_plans=True)
+             for s in pool._sessions}
+    mix_names = ("agg", "join", "window", "parquet")
+    # warm the jit cache and pin the bit-exact reference answer per mix
+    # entry (deterministic inputs: every session agrees)
+    refs = {}
+    for name in mix_names:
+        with pool.session() as s:
+            refs[name] = plans[id(s)][name]().collect()
+
+    worklist = [(i, mix_names[i % len(mix_names)], i % 3 == 0)
+                for i in range(concurrency * queries_per_worker)]
+    tight_n = sum(1 for _, _, tight in worklist if tight)
+    cancels0, dirty0 = deadline_cancels(), dirty_ledgers()
+    outcomes = {}
+
+    def one(item):
+        i, name, tight = item
+        with pool.session() as s:
+            df = plans[id(s)][name]()
+            if tight:
+                try:
+                    s.execute(df._lp, deadline_ms=deadline_ms)
+                    outcomes[i] = ("no-trip", name)
+                except TpuQueryDeadlineExceeded:
+                    outcomes[i] = ("deadline", name)
+                except Exception as ex:  # wrong TYPE is the failure
+                    outcomes[i] = ("wrong-error",
+                                   f"{name}: {type(ex).__name__}")
+            else:
+                out = df.collect()
+                outcomes[i] = ("ok", name) if out.equals(refs[name]) \
+                    else ("mismatch", name)
+
+    with cf.ThreadPoolExecutor(max_workers=concurrency) as ex:
+        list(ex.map(one, worklist))
+    pool.drain(timeout=60)
+    pool.close()
+
+    typed = sum(1 for k, _ in outcomes.values() if k == "deadline")
+    survivors_ok = sum(1 for k, _ in outcomes.values() if k == "ok")
+    counted = deadline_cancels() - cancels0
+    dirty = dirty_ledgers() - dirty0
+    ctrl = AdmissionController.get()
+    failures = []
+    if typed != tight_n:
+        bad = sorted(v for v in outcomes.values()
+                     if v[0] in ("no-trip", "wrong-error"))
+        failures.append(
+            f"{typed}/{tight_n} tight-deadline requests raised typed "
+            f"TpuQueryDeadlineExceeded (offenders: {bad[:4]})")
+    if counted != typed:
+        failures.append(
+            f'tpu_cancellations_total{{cause="deadline"}} grew by '
+            f"{counted}, expected {typed}")
+    if survivors_ok != len(worklist) - tight_n:
+        failures.append(
+            f"{len(worklist) - tight_n - survivors_ok} surviving "
+            f"request(s) were not bit-exact vs the no-deadline "
+            f"reference")
+    if dirty:
+        failures.append(f"{dirty} dirty memsan ledger(s) after the "
+                        f"deadline storm")
+    if ctrl is not None and (ctrl.bytes_in_flight or ctrl.queue_depth):
+        failures.append(
+            f"admission books unbalanced after drain: "
+            f"{ctrl.bytes_in_flight}B in flight, "
+            f"queue depth {ctrl.queue_depth}")
+    return {
+        "deadline_ms": int(deadline_ms),
+        "requests": len(worklist),
+        "tight_requests": tight_n,
+        "deadline_failures_typed": typed,
+        "deadline_cancellations_counted": int(counted),
+        "survivors_bit_exact": survivors_ok,
+        "dirty_ledgers": int(dirty),
+        "failures": failures,
+    }
+
+
+def serve_mix(session, fact, dim, pq_path, as_plans: bool = False):
     """The four-query serving mix (agg/join/window/parquet), bound to one
     pooled session.  Dataframes are pre-created so the measured cost is
-    query execution, not host-side table registration."""
+    query execution, not host-side table registration.  ``as_plans``
+    returns the un-collected dataframe builders instead of collect
+    closures — the ``--deadline-ms`` leg needs the logical plan so it
+    can execute with a per-request deadline."""
     from spark_rapids_tpu.api import functions as F
     from spark_rapids_tpu.api.column import col
     from spark_rapids_tpu.expr.window import WindowBuilder
@@ -710,30 +829,30 @@ def serve_mix(session, fact, dim, pq_path):
         return (fdf.filter(col("v") > -(10**6) // 2)
                 .group_by(col("k"))
                 .agg(F.sum(col("v")).alias("sv"),
-                     F.count("*").alias("c"))
-                .collect())
+                     F.count("*").alias("c")))
 
     def join():
         return (fdf.join(ddf, on="k", how="inner")
                 .group_by(col("k"))
-                .agg(F.sum(col("w")).alias("sw"))
-                .collect())
+                .agg(F.sum(col("w")).alias("sw")))
 
     def window():
         w = WindowBuilder().partition_by(col("k")).order_by(col("v"))
-        return (fdf.select(col("k"), col("v"),
-                           F.row_number().over(w).alias("rn"))
-                .collect())
+        return fdf.select(col("k"), col("v"),
+                          F.row_number().over(w).alias("rn"))
 
     def parquet():
         return (session.read.parquet(pq_path)
                 .filter(col("f") < 0.5)
                 .group_by(col("k"))
-                .agg(F.sum(col("v")).alias("sv"))
-                .collect())
+                .agg(F.sum(col("v")).alias("sv")))
 
-    return {"agg": agg, "join": join, "window": window,
-            "parquet": parquet}
+    builders = {"agg": agg, "join": join, "window": window,
+                "parquet": parquet}
+    if as_plans:
+        return builders
+    return {name: (lambda b=b: b().collect())
+            for name, b in builders.items()}
 
 
 def measure_serve(fact, dim, pq_path, concurrency: int = 8,
@@ -1512,6 +1631,7 @@ def main():
         serve_rows = int(pos[0]) if pos else 200_000
         concurrency = int(_arg_value("--concurrency", "8"))
         request_io_ms = float(_arg_value("--request-io-ms", "150"))
+        deadline_ms = _arg_value("--deadline-ms")
         fact, dim = make_tables(serve_rows)
         root = tempfile.mkdtemp(prefix="spark_rapids_tpu_serve_")
         try:
@@ -1519,6 +1639,10 @@ def main():
             serve = measure_serve(fact, dim, pq_path,
                                   concurrency=concurrency,
                                   request_io_ms=request_io_ms)
+            if deadline_ms is not None:
+                serve["cancellations"] = measure_serve_deadlines(
+                    fact, dim, pq_path, concurrency=concurrency,
+                    deadline_ms=int(deadline_ms))
         finally:
             shutil.rmtree(root, ignore_errors=True)
         out = {
@@ -1560,6 +1684,10 @@ def main():
             print(f"SERVE ADMISSION GUARD FAILED: accounting drift "
                   f"{serve['accounting_drift']} (admitted != completed "
                   f"+ failed)", file=sys.stderr)
+            failed = True
+        for msg in serve.get("cancellations", {}).get("failures", []):
+            print(f"SERVE DEADLINE GUARD FAILED: {msg}",
+                  file=sys.stderr)
             failed = True
         sys.exit(1 if failed or regress_rc else 0)
     fact, dim = make_tables(n_rows)

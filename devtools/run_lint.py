@@ -2447,7 +2447,7 @@ def run_fleet_gate() -> int:
 def run_faults_gate() -> int:
     """tpufsan fault-injection campaign: the raise-graph artifact
     enumerates every statically-reachable (seam, typed-error) pair
-    (>= 40) and the gate injects each one, asserting (a) the exact
+    (>= 50) and the gate injects each one, asserting (a) the exact
     typed error propagates to the seam's caller, (b) the admission /
     shuffle / spill books balance afterward with all spans closed, and
     (c) exactly one parseable post-mortem bundle records the failure.
@@ -2495,10 +2495,10 @@ def run_faults_gate() -> int:
               f"{d.render()}")
     art = raiseflow.raise_graph_artifact()
     plan = art["injections"]
-    if len(plan) < 40:
+    if len(plan) < 50:
         failures += 1
         print(f"FAULTS: injection plan shrank to {len(plan)} pairs "
-              f"(< 40) — seam reachability regressed")
+              f"(< 50) — seam reachability regressed")
     leaks = sum(len(s["untyped"]) for s in art["seams"].values())
     if leaks:
         failures += 1
@@ -2738,10 +2738,16 @@ def run_faults_gate() -> int:
         ch.value for _, ch in
         m.counter("tpu_shuffle_fetch_errors_total",
                   labelnames=("kind",)).series())
-    if errs_counted < len(by_seam.get("shuffle-fetcher", [])):
+    # cancellation is control flow, not a fetch failure: the fetcher
+    # passes TpuQueryCancelled/TpuQueryDeadlineExceeded through without
+    # booking a fetch-error kind (they count in tpu_cancellations_total)
+    fetch_faults = [n for n in by_seam.get("shuffle-fetcher", [])
+                    if n not in ("TpuQueryCancelled",
+                                 "TpuQueryDeadlineExceeded")]
+    if errs_counted < len(fetch_faults):
         failures += 1
         print(f"FAULTS: fetch-error counter saw {errs_counted} of "
-              f"{len(by_seam.get('shuffle-fetcher', []))} injections")
+              f"{len(fetch_faults)} injections")
 
     # -- leg 5: block-server seam (typed relay over the wire) ---------------
     for name in by_seam.get("block-server", []):
@@ -3981,6 +3987,684 @@ def run_slo_gate() -> int:
     return 0
 
 
+def run_progress_gate() -> int:
+    """Progress-observatory gate (obs/progress.py), one 4-session pool:
+
+    * **Golden mix** — the serve mix replayed concurrently with tracing
+      on: every finished query's live-view record must show ratio 1.0
+      with partitions_done reconciling exactly to the trace's operator
+      span count, a probed query must show monotone mid-flight ratios
+      that actually move, the watchdog must stay quiet (anti-vacuity),
+      and tracker hook overhead must stay < 5% of query wall with the
+      on/off check proving the hooks are really the thing measured.
+    * **Injected stall** — an armed FilterExec sleeps past
+      ``watchdog.stallSeconds``: the scan must flag the query naming
+      the deepest open operator, degrade /healthz, black-box exactly
+      one stall record, then auto-cancel with cause=watchdog.
+    * **Cancel legs** — cancels injected during compute (session
+      API), queue-wait (pool API, ticket removed from the admission
+      FIFO while the whale still holds budget), and remote-fetch
+      (fetcher poll loop), plus a blown ``deadline_ms``: each must
+      propagate the exact typed error, balance the books (no orphaned
+      shuffle blocks, no stranded admission bytes, no open spans, no
+      spill leaks) and produce exactly one classified bundle.
+    """
+    import concurrent.futures as cf
+    import shutil
+    import tempfile
+    import threading
+    import time as _time
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+    from spark_rapids_tpu.api.pool import SessionPool
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.exec.base import _wrap_execute_partition
+    from spark_rapids_tpu.exec.basic import FilterExec
+    from spark_rapids_tpu.memory.admission import AdmissionController
+    from spark_rapids_tpu.memory.spill import SpillCatalog
+    from spark_rapids_tpu.obs import bgerrors
+    from spark_rapids_tpu.obs import metrics as m
+    from spark_rapids_tpu.obs import postmortem as pm
+    from spark_rapids_tpu.obs import progress as prog
+    from spark_rapids_tpu.obs.health import DEGRADED, OK, HealthMonitor
+    from spark_rapids_tpu.obs.metrics import MetricsRegistry
+    from spark_rapids_tpu.obs.progress import (ProgressTracker,
+                                               TpuQueryCancelled,
+                                               TpuQueryDeadlineExceeded)
+    from spark_rapids_tpu.obs.slo import LatencyObservatory
+    from spark_rapids_tpu.shuffle import transport as tr
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+    from spark_rapids_tpu.tools.top import format_top
+
+    failures = 0
+    MetricsRegistry.reset_for_tests()
+    with SpillCatalog._lock:
+        SpillCatalog._instance = SpillCatalog()
+    TpuShuffleManager.reset()
+    AdmissionController.reset_for_tests()
+    LatencyObservatory.reset_for_tests()
+    ProgressTracker.reset_for_tests()
+    bgerrors.reset()
+
+    pmdir = tempfile.mkdtemp(prefix="progress_gate_pm_")
+
+    n = 4000
+    rng = np.random.default_rng(11)
+    fact = pa.table({
+        "k": pa.array(rng.integers(0, 97, n).astype(np.int64)),
+        "v": pa.array(rng.integers(-1000, 1000, n).astype(np.int64)),
+    })
+    dim = pa.table({
+        "k": pa.array(np.arange(97, dtype=np.int64)),
+        "w": pa.array(np.arange(97, dtype=np.int64) * 10),
+    })
+    budget = 256 << 20
+    pool = SessionPool(4, {
+        "spark.rapids.sql.enabled": "true",
+        "spark.rapids.tpu.memsan.enabled": "true",
+        "spark.rapids.tpu.singleChipFuse": "off",
+        "spark.rapids.tpu.trace.enabled": "true",
+        "spark.rapids.tpu.serve.hbmAdmissionBudgetBytes": str(budget),
+        "spark.rapids.tpu.serve.admissionTimeoutMs": "60000",
+        "spark.rapids.tpu.hbm.postmortem.dir": pmdir,
+        "spark.rapids.tpu.hbm.postmortem.maxBundles": "500",
+    })
+    monitor = HealthMonitor()
+
+    def mk_mix(s):
+        fdf = s.create_dataframe(fact)
+        fdf4 = s.create_dataframe(fact, num_partitions=4)
+        ddf2 = s.create_dataframe(dim, num_partitions=2)
+        return {
+            "agg": lambda: (fdf4.group_by(col("k"))
+                            .agg(F.sum(col("v")).alias("sv"),
+                                 F.count("*").alias("c")).collect()),
+            "join": lambda: (fdf4.join(ddf2, on="k", how="inner")
+                             .group_by(col("k"))
+                             .agg(F.sum(col("w")).alias("sw"))
+                             .collect()),
+            "sort": lambda: fdf.sort(col("k"), col("v")).collect(),
+            # armed-leg query: every cancel/stall leg drives this shape
+            # so the armed FilterExec sits mid-plan with 4 partitions
+            "filter4": lambda: (fdf4.filter(col("v") > -10_000)
+                                .group_by(col("k"))
+                                .agg(F.sum(col("v")).alias("sv"))
+                                .collect()),
+            # exchange-free: with a warmed plan the group-by exchange's
+            # map stage (and an armed FilterExec inside it) can run
+            # during PLANNING, before admission — the queue-cancel
+            # whale must park post-admission, so it parks here
+            "filter_only": lambda: (fdf4.filter(col("v") > -10_000)
+                                    .collect()),
+        }
+
+    mixes = {id(s): mk_mix(s) for s in pool._sessions}
+    worklist = [name for name in ("agg", "join", "sort", "filter4")
+                for _ in range(4)]
+
+    def one(name):
+        with pool.session() as s:
+            mixes[id(s)][name]()
+
+    def run_as(s, fn):
+        TpuSession.bind_to_thread(s)
+        try:
+            return fn()
+        finally:
+            TpuSession.bind_to_thread(None)
+
+    def books(session=None):
+        probs = []
+        blocks = TpuShuffleManager.get().catalog.num_blocks()
+        if blocks:
+            probs.append(f"{blocks} orphaned shuffle block(s)")
+        sleaks = SpillCatalog.get().leak_report()
+        if sleaks:
+            probs.append(f"{len(sleaks)} spill leak(s)")
+        ac = AdmissionController.get()
+        if ac is not None:
+            if ac.bytes_in_flight:
+                probs.append(f"{ac.bytes_in_flight} admission "
+                             f"byte(s) still in flight")
+            if ac.queue_depth:
+                probs.append(f"admission queue depth "
+                             f"{ac.queue_depth}")
+        if session is not None:
+            trace = session.last_query_trace()
+            if trace is not None and trace.open_span_count():
+                probs.append(f"{trace.open_span_count()} unclosed "
+                             f"span(s)")
+        return probs
+
+    def expect_bundle(before, err_name, kind, extra_kinds=()):
+        docs = []
+        for b in pm.list_bundles(pmdir):
+            if b in before:
+                continue
+            try:
+                docs.append(pm.load_bundle(b))
+            except Exception as ex:
+                return [f"bundle unparseable: {ex!r}"]
+        main = [d for d in docs if d.get("kind") == kind]
+        rest = sorted(d.get("kind") or "?" for d in docs
+                      if d.get("kind") != kind)
+        probs = []
+        if len(main) != 1:
+            return [f"expected exactly 1 {kind} bundle, found "
+                    f"{len(main)} (all new kinds: "
+                    f"{[d.get('kind') for d in docs]})"]
+        if rest != sorted(extra_kinds):
+            probs.append(f"unexpected extra bundle kind(s): {rest} "
+                         f"(expected {sorted(extra_kinds)})")
+        doc = main[0]
+        if (doc.get("error") or {}).get("type") != err_name:
+            probs.append(f"bundle names "
+                         f"{(doc.get('error') or {}).get('type')!r}, "
+                         f"expected {err_name}")
+        if "cancellation" not in doc:
+            probs.append("bundle lost the cancellation section")
+        rendered = pm.render_postmortem(doc)
+        if "cancel:" not in rendered or "observed at" not in rendered:
+            probs.append("rendered post-mortem does not show the "
+                         "cancel cause/checkpoint")
+        return probs
+
+    def cancel_count(cause):
+        fam = m.counter("tpu_cancellations_total",
+                        labelnames=("cause",))
+        return sum(ch.value for lbl, ch in fam.series()
+                   if lbl.get("cause") == cause)
+
+    # -- golden mix ----------------------------------------------------------
+    with cf.ThreadPoolExecutor(max_workers=4) as ex:
+        list(ex.map(one, worklist))
+    pool.drain(timeout=60)
+
+    view = ProgressTracker.get().live_view()
+    if view["inflight"]:
+        failures += 1
+        print(f"PROGRESS: {len(view['inflight'])} quer(ies) still "
+              f"in flight after drain")
+    if view["stalled"]:
+        failures += 1
+        print(f"PROGRESS: watchdog flagged the healthy golden mix "
+              f"(vacuity): {view['stalled']}")
+    recent = view["recent"]
+    done = [r for r in recent if r["error"] is None]
+    if len(done) < len(worklist):
+        failures += 1
+        print(f"PROGRESS: finished ring holds {len(done)} clean "
+              f"records, ran {len(worklist)}")
+    for r in done:
+        if r["progress_ratio"] != 1.0 or r["rows"] <= 0 or \
+                r["partitions_done"] <= 0:
+            failures += 1
+            print(f"PROGRESS: finished record not fully accounted: "
+                  f"{r['tenant']}/{r['query']} "
+                  f"ratio={r['progress_ratio']} rows={r['rows']} "
+                  f"partitions={r['partitions_done']}")
+    if not any(r.get("predicted_rows") for r in done):
+        failures += 1
+        print("PROGRESS: no finished record carries estimator-ledger "
+              "row predictions")
+    # live-view partition accounting must reconcile exactly to the
+    # trace: one closed operator span per observed execute_partition
+    for s in pool._sessions:
+        trace = s.last_query_trace()
+        mine = [r for r in recent if r["tenant"] == s._tenant]
+        if trace is None or not mine:
+            failures += 1
+            print(f"PROGRESS: {s._tenant} left no trace/record to "
+                  f"reconcile")
+            continue
+        spans = [sp for sp in trace.span_dicts()
+                 if sp["kind"] == "operator"]
+        if mine[-1]["partitions_done"] != len(spans):
+            failures += 1
+            print(f"PROGRESS: {s._tenant} live view counted "
+                  f"{mine[-1]['partitions_done']} partition(s), the "
+                  f"trace closed {len(spans)} operator span(s)")
+    snap = monitor.snapshot()
+    if snap["components"].get("progress", {}).get("status") != OK:
+        failures += 1
+        print(f"PROGRESS: /healthz progress component not OK on the "
+              f"golden mix: {snap['components'].get('progress')}")
+    top_out = format_top(view)
+    if "in flight" not in top_out or "recent:" not in top_out:
+        failures += 1
+        print(f"PROGRESS: tools top render missing sections:\n"
+              f"{top_out}")
+    inflight_fam = [f for f in MetricsRegistry.get().families()
+                    if f.name == "tpu_queries_inflight"]
+    if not inflight_fam or inflight_fam[0].total() != 0:
+        failures += 1
+        print(f"PROGRESS: tpu_queries_inflight gauges did not return "
+              f"to zero: "
+              f"{inflight_fam[0].total() if inflight_fam else 'absent'}")
+    if not any(f.name == "tpu_query_progress_ratio"
+               for f in MetricsRegistry.get().families()):
+        failures += 1
+        print("PROGRESS: tpu_query_progress_ratio family never "
+              "published")
+
+    # -- probed monotone mid-flight ratios -----------------------------------
+    raw_ep = FilterExec.execute_partition.__wrapped__
+    orig_ep = FilterExec.execute_partition
+    probe = []
+
+    def probing_ep(self, pid, ctx):
+        h = prog.current_handle()
+        for b in raw_ep(self, pid, ctx):
+            if h is not None:
+                probe.append(h.progress_ratio())
+            yield b
+
+    FilterExec.execute_partition = _wrap_execute_partition(probing_ep)
+    try:
+        s0 = pool._sessions[0]
+        run_as(s0, mixes[id(s0)]["filter4"])
+    finally:
+        FilterExec.execute_partition = orig_ep
+    if len(probe) < 4:
+        failures += 1
+        print(f"PROGRESS: probe saw only {len(probe)} mid-flight "
+              f"ratio sample(s)")
+    if probe != sorted(probe):
+        failures += 1
+        print(f"PROGRESS: mid-flight ratios not monotone: {probe}")
+    if probe and (min(probe) == max(probe) or max(probe) > 1.0):
+        failures += 1
+        print(f"PROGRESS: mid-flight ratios never moved (or "
+              f"overshot 1.0): {probe}")
+
+    # -- hook overhead < 5% of query wall ------------------------------------
+    view = ProgressTracker.get().live_view(scan=False)
+    wall_s = sum(r["elapsed_s"] for r in view["recent"])
+    oh = ProgressTracker.get().overhead()
+    pct = 100.0 * oh["hook_s"] / wall_s if wall_s else 100.0
+    if oh["hook_s"] <= 0.0:
+        failures += 1
+        print("PROGRESS: hook overhead booked zero seconds over the "
+              "golden mix (vacuity — the hooks are not measuring)")
+    if pct >= 5.0:
+        failures += 1
+        print(f"PROGRESS: tracker hook overhead {pct:.2f}% of query "
+              f"wall (>= 5%)")
+
+    # on/off anti-vacuity: disabled tracking registers nothing, books
+    # no overhead, and the query's result bytes do not change
+    s0 = pool._sessions[0]
+    ref = run_as(s0, mixes[id(s0)]["agg"])
+    ring_before = len(ProgressTracker.get().live_view(
+        scan=False)["recent"])
+    oh_before = ProgressTracker.get().overhead()["hook_s"]
+    ProgressTracker.get().configure(enabled=False)
+    try:
+        off = run_as(s0, mixes[id(s0)]["agg"])
+    finally:
+        ProgressTracker.get().configure(enabled=True)
+    ring_after = len(ProgressTracker.get().live_view(
+        scan=False)["recent"])
+    oh_after = ProgressTracker.get().overhead()["hook_s"]
+    if ring_after != ring_before or oh_after != oh_before:
+        failures += 1
+        print(f"PROGRESS: disabled tracker still observed the query "
+              f"(ring {ring_before}->{ring_after}, hook_s "
+              f"{oh_before}->{oh_after})")
+    if not ref.equals(off):
+        failures += 1
+        print("PROGRESS: tracking on/off changed query results")
+
+    # -- injected stall: watchdog flags, names, black-boxes, auto-cancels ----
+    ProgressTracker.get().configure(stall_seconds=0.35,
+                                    auto_cancel_seconds=0.9)
+    started = threading.Event()
+
+    def stuck_ep(self, pid, ctx):
+        for b in raw_ep(self, pid, ctx):
+            if not started.is_set():
+                started.set()
+                _time.sleep(1.4)  # one dead-silent stall, no touch()
+            yield b
+
+    FilterExec.execute_partition = _wrap_execute_partition(stuck_ep)
+    before = set(pm.list_bundles(pmdir))
+    caught = {}
+
+    def victim_stall():
+        s1 = pool._sessions[1]
+        try:
+            run_as(s1, mixes[id(s1)]["filter4"])
+        except BaseException as ex:  # noqa: BLE001 — verified below
+            caught["stall"] = ex
+
+    th = threading.Thread(target=victim_stall)
+    th.start()
+    stall_rec = None
+    auto_cancelled = False
+    try:
+        if not started.wait(30):
+            failures += 1
+            print("PROGRESS: armed stall never reached the operator")
+        deadline = _time.monotonic() + 15
+        while not auto_cancelled and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+            for rec in ProgressTracker.get().watchdog_scan():
+                if rec["tenant"] == "pool-1":
+                    stall_rec = stall_rec or rec
+                    auto_cancelled = auto_cancelled or \
+                        rec.get("auto_cancelled", False)
+        if stall_rec is not None and not auto_cancelled:
+            # the stall was seen but never aged past auto-cancel
+            pass
+        snap = monitor.snapshot()
+    finally:
+        th.join(30)
+        FilterExec.execute_partition = orig_ep
+        ProgressTracker.get().configure(stall_seconds=30.0)
+        ProgressTracker.get().auto_cancel_seconds = None
+    op = (stall_rec or {}).get("deepest_open_operator")
+    if stall_rec is None or not op or not str(op).endswith("Exec"):
+        failures += 1
+        print(f"PROGRESS: watchdog did not flag the stall naming the "
+              f"deepest open operator: {stall_rec}")
+    if snap["components"].get("progress", {}).get("status") != DEGRADED:
+        failures += 1
+        print(f"PROGRESS: /healthz did not degrade on the stalled "
+              f"query: {snap['components'].get('progress')}")
+    if m.counter("tpu_query_stalls_total").value() != 1:
+        failures += 1
+        print(f"PROGRESS: tpu_query_stalls_total counted "
+              f"{m.counter('tpu_query_stalls_total').value()} "
+              f"(expected exactly 1 — scans must dedup)")
+    bb = bgerrors.last_error("watchdog")
+    if not bb or "no progress" not in str(bb.get("message", "")):
+        failures += 1
+        print(f"PROGRESS: stall never reached the failure black box: "
+              f"{bb}")
+    err = caught.get("stall")
+    if not isinstance(err, TpuQueryCancelled) or \
+            getattr(err, "cause", None) != "watchdog":
+        failures += 1
+        print(f"PROGRESS: watchdog auto-cancel did not propagate "
+              f"typed with cause=watchdog: {err!r}")
+    for p in books(pool._sessions[1]):
+        failures += 1
+        print(f"PROGRESS [stall]: {p}")
+    for p in expect_bundle(before, "TpuQueryCancelled", "cancelled",
+                           extra_kinds=("background_failure",)):
+        failures += 1
+        print(f"PROGRESS [stall]: {p}")
+    if cancel_count("watchdog") != 1:
+        failures += 1
+        print(f"PROGRESS: cancellations{{cause=watchdog}} = "
+              f"{cancel_count('watchdog')}, expected 1")
+
+    # -- cancel mid-compute (session API) ------------------------------------
+    started2 = threading.Event()
+    release2 = threading.Event()
+
+    def slow_ep(self, pid, ctx):
+        for b in raw_ep(self, pid, ctx):
+            started2.set()
+            release2.wait(10.0)  # held until the cancel has landed
+            yield b
+
+    FilterExec.execute_partition = _wrap_execute_partition(slow_ep)
+    before = set(pm.list_bundles(pmdir))
+    s2 = pool._sessions[2]
+
+    def victim_compute():
+        try:
+            run_as(s2, mixes[id(s2)]["filter4"])
+        except BaseException as ex:  # noqa: BLE001 — verified below
+            caught["compute"] = ex
+
+    th = threading.Thread(target=victim_compute)
+    th.start()
+    try:
+        if not started2.wait(30):
+            failures += 1
+            print("PROGRESS: compute-cancel query never reached the "
+                  "armed operator")
+        if not s2.cancel("q0"):
+            failures += 1
+            print("PROGRESS: session.cancel found no in-flight query")
+        release2.set()
+    finally:
+        th.join(30)
+        FilterExec.execute_partition = orig_ep
+    err = caught.get("compute")
+    if not isinstance(err, TpuQueryCancelled) or \
+            getattr(err, "cause", None) != "client" or \
+            getattr(err, "checkpoint", None) not in ("compute",
+                                                     "partition"):
+        failures += 1
+        print(f"PROGRESS: mid-compute cancel did not propagate typed "
+              f"at a compute checkpoint: {err!r}")
+    for p in books(s2):
+        failures += 1
+        print(f"PROGRESS [compute-cancel]: {p}")
+    for p in expect_bundle(before, "TpuQueryCancelled", "cancelled"):
+        failures += 1
+        print(f"PROGRESS [compute-cancel]: {p}")
+
+    # -- cancel while queued for admission (pool API) ------------------------
+    orig_bound = TpuSession._static_peak_bound
+
+    def fixed_bound(self, final_plan, conf, budget=None):
+        # whale 200M + victim 100M oversubscribes 256M: the victim
+        # queues IFF the whale is in flight
+        return (200 << 20) if getattr(self, "_tenant", "") == "pool-0" \
+            else (100 << 20)
+
+    h_started = threading.Event()
+    hold = threading.Event()
+
+    def holding_ep(self, pid, ctx):
+        s = TpuSession.active()
+        if getattr(s, "_tenant", "") == "pool-0" and \
+                not h_started.is_set():
+            h_started.set()
+            hold.wait(20.0)  # holds 200M of admitted budget
+        for b in raw_ep(self, pid, ctx):
+            yield b
+
+    FilterExec.execute_partition = _wrap_execute_partition(holding_ep)
+    TpuSession._static_peak_bound = fixed_bound
+    before = set(pm.list_bundles(pmdir))
+    whale, victim = pool._sessions[0], pool._sessions[3]
+    whale_res = {}
+
+    def run_whale():
+        try:
+            whale_res["table"] = run_as(
+                whale, mixes[id(whale)]["filter_only"])
+        except BaseException as ex:  # noqa: BLE001 — verified below
+            whale_res["err"] = ex
+
+    def victim_queue():
+        try:
+            run_as(victim, mixes[id(victim)]["filter4"])
+        except BaseException as ex:  # noqa: BLE001 — verified below
+            caught["queue"] = ex
+
+    th_w = threading.Thread(target=run_whale)
+    th_v = threading.Thread(target=victim_queue)
+    th_w.start()
+    try:
+        if not h_started.wait(30):
+            failures += 1
+            print("PROGRESS: whale never started holding admission")
+        th_v.start()
+        ac = AdmissionController.get()
+        deadline = _time.monotonic() + 15
+        while ac.queue_depth < 1 and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        if ac.queue_depth < 1:
+            failures += 1
+            print("PROGRESS: victim never queued behind the whale")
+        if not pool.cancel("pool-3", "q0"):
+            failures += 1
+            print("PROGRESS: pool.cancel found no in-flight query")
+        # the cancelled ticket must leave the FIFO while the whale
+        # still holds the budget — cancel-while-queued, not timeout
+        deadline = _time.monotonic() + 5
+        while ac.queue_depth and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        if ac.queue_depth:
+            failures += 1
+            print(f"PROGRESS: cancelled ticket still queued "
+                  f"(depth {ac.queue_depth})")
+    finally:
+        hold.set()
+        th_v.join(30)
+        th_w.join(30)
+        FilterExec.execute_partition = orig_ep
+        TpuSession._static_peak_bound = orig_bound
+    err = caught.get("queue")
+    if not isinstance(err, TpuQueryCancelled) or \
+            getattr(err, "checkpoint", None) != "queue-wait":
+        failures += 1
+        print(f"PROGRESS: queued cancel did not propagate typed at "
+              f"the queue-wait checkpoint: {err!r}")
+    if "table" not in whale_res:
+        failures += 1
+        print(f"PROGRESS: the whale did not survive the victim's "
+              f"cancel: {whale_res.get('err')!r}")
+    for p in books(victim):
+        failures += 1
+        print(f"PROGRESS [queue-cancel]: {p}")
+    for p in expect_bundle(before, "TpuQueryCancelled", "cancelled"):
+        failures += 1
+        print(f"PROGRESS [queue-cancel]: {p}")
+
+    # -- blown deadline_ms ---------------------------------------------------
+    before = set(pm.list_bundles(pmdir))
+    s1 = pool._sessions[1]
+
+    def run_deadline():
+        lp = (s1.create_dataframe(fact, num_partitions=4)
+              .group_by(col("k")).agg(F.sum(col("v")).alias("sv"))
+              ._lp)
+        return s1.execute(lp, deadline_ms=1)
+
+    err = None
+    try:
+        run_as(s1, run_deadline)
+    except BaseException as ex:  # noqa: BLE001 — verified below
+        err = ex
+    if not isinstance(err, TpuQueryDeadlineExceeded):
+        failures += 1
+        print(f"PROGRESS: deadline_ms=1 did not raise "
+              f"TpuQueryDeadlineExceeded: {err!r}")
+    for p in books(s1):
+        failures += 1
+        print(f"PROGRESS [deadline]: {p}")
+    for p in expect_bundle(before, "TpuQueryDeadlineExceeded",
+                           "deadline_exceeded"):
+        failures += 1
+        print(f"PROGRESS [deadline]: {p}")
+    if cancel_count("deadline") != 1:
+        failures += 1
+        print(f"PROGRESS: cancellations{{cause=deadline}} = "
+              f"{cancel_count('deadline')}, expected 1")
+
+    # -- cancel during remote fetch ------------------------------------------
+    unblock = threading.Event()
+
+    class _MetaTx:
+        def __init__(self, metas):
+            self.metas = metas
+
+        def wait(self, timeout=None):
+            return self.metas
+
+    class _SlowTx:
+        def wait(self, timeout=None):
+            unblock.wait(min(timeout or 3.0, 3.0))
+            return None
+
+    class _SlowClient:
+        def fetch_metadata(self, sid, rid, ctx=None):
+            return _MetaTx([((sid, 0, rid, 0), None)])
+
+        def fetch_block(self, sid, mid, rid, idx, xp=None, ctx=None):
+            return _SlowTx()
+
+    before = set(pm.list_bundles(pmdir))
+    handle = ProgressTracker.get().begin_query("qfetch", tenant="gate")
+    prog.bind_to_thread(handle)
+    timer = threading.Timer(
+        0.4, lambda: ProgressTracker.get().cancel("qfetch",
+                                                  tenant="gate"))
+    timer.start()
+    err = None
+    try:
+        fetcher = tr.AsyncBlockFetcher(_SlowClient(), 9, 0,
+                                       timeout=5.0)
+        list(fetcher.blocks())
+    except BaseException as ex:  # noqa: BLE001 — verified below
+        err = ex
+    finally:
+        timer.cancel()
+        unblock.set()
+        ProgressTracker.get().end_query(handle, err)
+        prog.bind_to_thread(None)
+    if not isinstance(err, TpuQueryCancelled) or \
+            getattr(err, "checkpoint", None) != "remote-fetch":
+        failures += 1
+        print(f"PROGRESS: mid-fetch cancel did not propagate typed at "
+              f"the remote-fetch checkpoint: {err!r}")
+    else:
+        # no session owns the fetcher: the serving harness black-boxes
+        pm.dump_postmortem(pmdir, err, tenant="gate", max_bundles=500)
+        for p in expect_bundle(before, "TpuQueryCancelled",
+                               "cancelled"):
+            failures += 1
+            print(f"PROGRESS [fetch-cancel]: {p}")
+    for p in books():
+        failures += 1
+        print(f"PROGRESS [fetch-cancel]: {p}")
+    if cancel_count("client") != 3:
+        failures += 1
+        print(f"PROGRESS: cancellations{{cause=client}} = "
+              f"{cancel_count('client')}, expected 3 (compute, "
+              f"queue-wait, remote-fetch)")
+
+    # -- wind-down -----------------------------------------------------------
+    inflight_fam = [f for f in MetricsRegistry.get().families()
+                    if f.name == "tpu_queries_inflight"]
+    if not inflight_fam or inflight_fam[0].total() != 0:
+        failures += 1
+        print(f"PROGRESS: inflight gauges dirty after the cancel "
+              f"legs: "
+              f"{inflight_fam[0].total() if inflight_fam else 'absent'}")
+    pool.drain(timeout=60)
+    pool.close()
+    shutil.rmtree(pmdir, ignore_errors=True)
+    bgerrors.reset()
+    MetricsRegistry.reset_for_tests()
+    AdmissionController.reset_for_tests()
+    LatencyObservatory.reset_for_tests()
+    ProgressTracker.reset_for_tests()
+    if failures:
+        print(f"progress gate: {failures} failure(s)")
+        return 1
+    print(f"progress gate clean ({len(done)} golden queries at ratio "
+          f"1.0 reconciling partitions to operator spans; probed "
+          f"ratios monotone {probe[0]:.2f}->{probe[-1]:.2f}; injected "
+          f"stall flagged {op} then auto-cancelled; compute/"
+          f"queue-wait/remote-fetch/deadline cancels all typed with "
+          f"balanced books and one bundle each; hook overhead "
+          f"{pct:.3f}% < 5%)")
+    return 0
+
+
 def main(argv=None):
     args = argv if argv is not None else sys.argv[1:]
     if "--interp" in args:
@@ -4015,6 +4699,8 @@ def main(argv=None):
         return run_hlo_gate()
     if "--slo" in args:
         return run_slo_gate()
+    if "--progress" in args:
+        return run_progress_gate()
     from spark_rapids_tpu.tools.__main__ import main as tools_main
     cli = ["lint", "--repo", "--baseline", BASELINE]
     if "--update-baseline" in args:

@@ -126,6 +126,14 @@ class SessionPool:
         with self.session(timeout) as s:
             return fn(s)
 
+    def cancel(self, tenant: str, query_id: str) -> bool:
+        """Request cooperative cancellation of an in-flight query by
+        (tenant, query_id) — the pair every live-view row carries
+        (``GET /queries`` / ``tools top``).  Returns True if a live
+        query matched."""
+        from ..obs.progress import ProgressTracker
+        return ProgressTracker.get().cancel(query_id, tenant=tenant)
+
     # -- observability --------------------------------------------------------
     def hbm_report(self) -> Dict:
         """Pool-level HBM occupancy rollup: the process-wide observatory

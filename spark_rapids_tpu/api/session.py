@@ -141,6 +141,15 @@ class TpuSession:
             target_ms=conf.get(cfg.SLO_TARGET_MS),
             objective=conf.get(cfg.SLO_OBJECTIVE),
             ledger_path=slo_ledger)
+        # progress observatory: the live in-flight view + cooperative
+        # cancel tokens + stuck-query watchdog thresholds
+        from ..obs.progress import ProgressTracker
+        ProgressTracker.get().configure(
+            enabled=conf.get(cfg.PROGRESS_ENABLED),
+            max_queries=conf.get(cfg.PROGRESS_MAX_QUERIES),
+            stall_seconds=conf.get(cfg.WATCHDOG_STALL_SECONDS),
+            auto_cancel_seconds=conf.get(
+                cfg.WATCHDOG_AUTO_CANCEL_SECONDS))
         from ..memory.meta import set_default_codec
         set_default_codec(conf.get(cfg.SHUFFLE_COMPRESSION_CODEC))
         from ..shims import ShimLoader, set_active_shim
@@ -364,14 +373,22 @@ class TpuSession:
             lambda e: e.release_shuffle()
             if hasattr(e, "release_shuffle") else None)
 
-    def execute(self, lp: L.LogicalPlan) -> pa.Table:
+    def execute(self, lp: L.LogicalPlan,
+                deadline_ms: Optional[int] = None) -> pa.Table:
         """Execute + collect, under the continuous query-lifecycle
-        metrics (active/completed/failed) every health probe reads."""
+        metrics (active/completed/failed) every health probe reads.
+
+        ``deadline_ms`` bounds the query's wall time: past it the next
+        cooperative checkpoint (partition boundary, admission queue
+        wait, shuffle fetch loop) raises the typed
+        TpuQueryDeadlineExceeded, unwinding through the same release
+        obligations as any other failure.  Unset, the session-level
+        ``spark.rapids.tpu.progress.deadlineMs`` default applies."""
         from ..obs import metrics as m
         m.gauge("tpu_queries_active",
                 "queries currently executing").gauge_inc()
         try:
-            result = self._execute(lp)
+            result = self._execute(lp, deadline_ms=deadline_ms)
         except BaseException:
             m.counter("tpu_queries_failed_total",
                       "queries that raised").inc()
@@ -383,8 +400,19 @@ class TpuSession:
                   "queries that returned a result").inc()
         return result
 
-    def _execute(self, lp: L.LogicalPlan) -> pa.Table:
+    def cancel(self, query_id: str) -> bool:
+        """Request cooperative cancellation of an in-flight query on
+        this session.  Returns True if a live query matched; the query
+        itself raises TpuQueryCancelled at its next checkpoint
+        (partition boundary, admission wait, or shuffle fetch loop)."""
+        from ..obs.progress import ProgressTracker
+        return ProgressTracker.get().cancel(
+            query_id, tenant=getattr(self, "_tenant", "") or "default")
+
+    def _execute(self, lp: L.LogicalPlan,
+                 deadline_ms: Optional[int] = None) -> pa.Table:
         from ..obs import memprof
+        from ..obs import progress as prog
         from ..obs import tracer as obs
         conf = self.conf
         if conf.get(cfg.CSAN_ENABLED):
@@ -399,11 +427,24 @@ class TpuSession:
         # this thread books under (tenant, query) until the query ends
         memprof.push_context(getattr(self, "_tenant", "") or "default",
                              f"q{self._sql_counter}")
+        # progress observatory: register the live-view record + cancel
+        # token, bound thread-local so the cooperative checkpoints in
+        # exec/admission/shuffle find it without signature plumbing
+        if deadline_ms is None:
+            deadline_ms = conf.get(cfg.PROGRESS_DEADLINE_MS)
+        handle = prog.ProgressTracker.get().begin_query(
+            f"q{self._sql_counter}",
+            tenant=getattr(self, "_tenant", "") or "default",
+            deadline_ms=deadline_ms)
+        prog.bind_to_thread(handle)
         try:
             if not tracing:
                 try:
-                    return self._execute_query(lp, None, None)
+                    result = self._execute_query(lp, None, None)
+                    prog.ProgressTracker.get().end_query(handle)
+                    return result
                 except BaseException as ex:
+                    prog.ProgressTracker.get().end_query(handle, ex)
                     self._maybe_postmortem(ex, None)
                     raise
             # flight recorder: one QueryTrace per execute(); the
@@ -418,12 +459,15 @@ class TpuSession:
             self._last_trace = tracer
             self._obs_plan = None
             try:
-                return self._execute_query(lp, tracer, eventlog_dir)
+                result = self._execute_query(lp, tracer, eventlog_dir)
+                prog.ProgressTracker.get().end_query(handle)
+                return result
             except BaseException as ex:
                 # failed queries flush too: spans close with the
                 # exception recorded, the event log gets a JobFailed
                 # group; the black box dumps AFTER the flush so the
                 # bundle sees the sealed trace
+                prog.ProgressTracker.get().end_query(handle, ex)
                 self._flush_query_obs(tracer, ex, eventlog_dir)
                 self._maybe_postmortem(ex, tracer)
                 raise
@@ -433,6 +477,7 @@ class TpuSession:
                 else:
                     obs.uninstall()
         finally:
+            prog.bind_to_thread(None)
             memprof.pop_context()
 
     def _execute_query(self, lp: L.LogicalPlan, tracer,
@@ -452,7 +497,14 @@ class TpuSession:
         # plan's tmsan static peak bound is its ticket — acquired once,
         # held across the speculation retry (re-entrancy), released in
         # the finally (release-on-failure)
-        ticket, controller = self._admit_plan(final_plan)
+        try:
+            ticket, controller = self._admit_plan(final_plan)
+        except BaseException:
+            # a cancel / deadline / AdmissionTimeout raised while
+            # queued must not strand the shuffle blocks that exchange
+            # map stages already materialized during planning
+            self.release_plan_shuffles(final_plan)
+            raise
         try:
             return self._execute_admitted(lp, final_plan, tracer,
                                           eventlog_dir, ticket)
@@ -734,6 +786,12 @@ class TpuSession:
             final_plan.foreach(visit)
             bound = mem.bound(final_plan)
             tracer.static_peak_bound = bound
+            # the progress observatory blends the same per-node row
+            # model into its ETA — feed it the ledger we just built
+            from ..obs import progress as prog
+            handle = prog.current_handle()
+            if handle is not None:
+                handle.set_predictions(tracer.predictions)
         except Exception:
             # the model is advisory: an analyzer crash must degrade the
             # accuracy report, never the query

@@ -1075,6 +1075,64 @@ HBM_POSTMORTEM_MAX_BUNDLES = conf(
     .check(lambda v: v >= 1, "must be >= 1") \
     .create_with_default(16)
 
+# --- progress observatory (obs/progress.py) -------------------------------
+
+PROGRESS_ENABLED = conf("spark.rapids.tpu.progress.enabled").boolean() \
+    .doc("Maintain the live in-flight query view (obs/progress.py): "
+         "phase, per-operator partitions done/total, rows-so-far vs "
+         "the estimator's predicted rows, a confidence-blended ETA, "
+         "and the cooperative cancel/deadline token the partition-"
+         "boundary, admission-wait and shuffle-fetch checkpoints "
+         "consult.  Served by GET /queries and `tools top`.  Cheap: "
+         "per-batch dict updates, no device crossings.  Off, "
+         "session.cancel() and deadline_ms have nothing to act on and "
+         "report/raise accordingly.") \
+    .create_with_default(True)
+
+PROGRESS_MAX_QUERIES = conf(
+    "spark.rapids.tpu.progress.maxQueries").integer() \
+    .doc("Bound on the live view's in-flight registry: past it the "
+         "oldest entry is evicted (a registration leaked by a crashed "
+         "query must not grow the view forever).  Size to the offered "
+         "concurrency; the finished ring is bounded separately.") \
+    .check(lambda v: v >= 1, "must be >= 1") \
+    .create_with_default(64)
+
+PROGRESS_DEADLINE_MS = conf(
+    "spark.rapids.tpu.progress.deadlineMs").integer() \
+    .doc("Default per-query deadline: queries that run past it raise "
+         "the typed TpuQueryDeadlineExceeded at the next cooperative "
+         "checkpoint (partition boundary, admission queue wait, "
+         "shuffle fetch loop).  An explicit "
+         "TpuSession.execute(deadline_ms=...) overrides it per call.  "
+         "Unset: no deadline unless the caller passes one.  Deadline "
+         "failures count BAD against the tenant's SLO burn window; "
+         "client cancels do not.") \
+    .check(lambda v: v >= 1, "must be >= 1") \
+    .create_optional()
+
+WATCHDOG_STALL_SECONDS = conf(
+    "spark.rapids.tpu.watchdog.stallSeconds").double() \
+    .doc("Stuck-query watchdog threshold: an in-flight query with no "
+         "progress event (no phase change, operator open/close or "
+         "batch) for this long is flagged stalled — /healthz degrades "
+         "naming the query and its deepest open operator span, and "
+         "one stall record lands in the failure black box.  The scan "
+         "is poll-driven (health snapshots, GET /queries); 0 disables "
+         "it.") \
+    .check(lambda v: v >= 0.0, "must be >= 0") \
+    .create_with_default(30.0)
+
+WATCHDOG_AUTO_CANCEL_SECONDS = conf(
+    "spark.rapids.tpu.watchdog.autoCancelSeconds").double() \
+    .doc("Hard stall deadline: a query stalled this long is cancelled "
+         "by the watchdog (cause=watchdog in tpu_cancellations_total) "
+         "at the next scan, unwinding through the same typed "
+         "cooperative-cancel path a client cancel uses.  Unset: the "
+         "watchdog only flags, never cancels.") \
+    .check(lambda v: v > 0.0, "must be > 0") \
+    .create_optional()
+
 # Environment variables the engine reads directly (escape hatches that
 # must exist before config parsing, e.g. cache sizing at import time).
 # The repo lint (TPU-R002) fails on any SPARK_RAPIDS_* env read not

@@ -500,16 +500,26 @@ OP_TIME = "opTime"
 
 def _wrap_execute_partition(fn):
     """Route every operator's execute_partition through the flight
-    recorder: with a tracer installed the produced iterator is wrapped
-    in a per-(operator, partition) span recording batches/rows/bytes
-    and the exception on failure; without one the original generator is
-    returned untouched (one global read per partition call)."""
+    recorder and the progress observatory: with a tracer installed the
+    produced iterator is wrapped in a per-(operator, partition) span
+    recording batches/rows/bytes and the exception on failure; with a
+    progress handle bound to the thread the iterator also feeds the
+    live view (partitions done, rows so far) and observes the
+    cooperative cancel flag per batch.  The progress wrapper sits
+    INSIDE the tracer wrapper so a cancel raised between batches
+    propagates through trace_operator's error arm and closes the span
+    immediately.  Without either, the original generator is returned
+    untouched (two global reads per partition call)."""
     import functools
 
     @functools.wraps(fn)
     def wrapper(self, pid, ctx):
+        from ..obs import progress as prog
         tr = _active_tracer()
         inner = fn(self, pid, ctx)
+        handle = prog.current_handle()
+        if handle is not None:
+            inner = handle.observe_operator(self, pid, inner)
         if tr is None:
             return inner
         return tr.trace_operator(self, pid, inner)
@@ -628,9 +638,28 @@ class Exec:
         Each partition is a 'task': it holds the TPU semaphore while it
         runs (ref GpuSemaphore acquire/release around task device work)."""
         from ..memory.semaphore import TpuSemaphore
+        from ..obs import progress as prog
+        from ..obs.progress import (TpuQueryCancelled,
+                                    TpuQueryDeadlineExceeded)
         sem = TpuSemaphore.get()
         out: List[pa.RecordBatch] = []
         for pid in range(self.num_partitions):
+            # cooperative cancel checkpoint at the partition boundary:
+            # nothing device-side is in flight here, so unwinding now
+            # leaves only the release obligations the finally arms
+            # below already discharge
+            tok = prog.current_token()
+            if tok is not None:
+                if tok.cancelled:
+                    raise TpuQueryCancelled(
+                        tok.describe("partition", self.name),
+                        query_id=tok.query_id, operator=self.name,
+                        checkpoint="partition", cause=tok.cause)
+                if tok.deadline_exceeded:
+                    raise TpuQueryDeadlineExceeded(
+                        tok.describe("partition", self.name),
+                        query_id=tok.query_id, operator=self.name,
+                        checkpoint="partition")
             sem.acquire_if_necessary(pid)
             try:
                 for b in self.execute_partition(pid, ctx):

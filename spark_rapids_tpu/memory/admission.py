@@ -180,6 +180,15 @@ class AdmissionController:
         t0 = time.monotonic()
         token = object()
         queued = False
+        # cooperative cancel checkpoint: a queued query's cancel token
+        # registers this controller's cv as a waker, so cancel() /
+        # deadline expiry wakes the waiter immediately; the shared
+        # finally below removes the token from the FIFO and notifies
+        # the survivors — cancel-while-queued cannot strand the queue
+        from ..obs import progress as prog
+        from ..obs.progress import (TpuQueryCancelled,
+                                    TpuQueryDeadlineExceeded)
+        ctok = prog.current_token()
         # queue time becomes a real span under the query root (admit()
         # runs between phase:plan and phase:execute, so the thread's
         # span stack is empty and the span parents to the root): the
@@ -188,49 +197,75 @@ class AdmissionController:
         from ..obs.tracer import trace_span
         with trace_span("admission.wait", bytes=nbytes,
                         tenant=tenant) as span:
-            with self._cv:
-                self._queue.append(token)
-                span.set(queue_depth_at_enqueue=len(self._queue) - 1)
-                self._tenant_add(self._queued_by_tenant, tenant, 1)
-                try:
-                    while self._queue[0] is not token or \
-                            self._in_flight + nbytes > self.budget_bytes:
-                        if not queued:
-                            queued = True
-                            self._counter(
-                                "tpu_admission_queued_total",
-                                "tickets that had to wait before "
-                                "admission", tenant).inc()
+            if ctok is not None:
+                ctok.add_waker(self._cv)
+            try:
+                with self._cv:
+                    self._queue.append(token)
+                    span.set(queue_depth_at_enqueue=len(self._queue) - 1)
+                    self._tenant_add(self._queued_by_tenant, tenant, 1)
+                    try:
+                        while self._queue[0] is not token or \
+                                self._in_flight + nbytes > \
+                                self.budget_bytes:
+                            if not queued:
+                                queued = True
+                                self._counter(
+                                    "tpu_admission_queued_total",
+                                    "tickets that had to wait before "
+                                    "admission", tenant).inc()
+                            self._publish_gauges()
+                            if ctok is not None:
+                                if ctok.cancelled:
+                                    raise TpuQueryCancelled(
+                                        ctok.describe("queue-wait"),
+                                        query_id=ctok.query_id,
+                                        checkpoint="queue-wait",
+                                        cause=ctok.cause)
+                                if ctok.deadline_exceeded:
+                                    raise TpuQueryDeadlineExceeded(
+                                        ctok.describe("queue-wait"),
+                                        query_id=ctok.query_id,
+                                        checkpoint="queue-wait")
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0:
+                                self._counter(
+                                    "tpu_admission_timeouts_total",
+                                    "tickets that hit "
+                                    "serve.admissionTimeoutMs without "
+                                    "fitting in the budget",
+                                    tenant).inc()
+                                raise AdmissionTimeout(
+                                    f"admission ticket "
+                                    f"{label or '(query)'} "
+                                    f"({nbytes} bytes) timed out after "
+                                    f"{timeout:g}s: budget "
+                                    f"{self.budget_bytes} bytes, "
+                                    f"{self._in_flight} in flight, "
+                                    f"{len(self._queue) - 1} "
+                                    f"ahead/behind in queue")
+                            if ctok is not None:
+                                dl = ctok.deadline_remaining_s()
+                                if dl is not None:
+                                    remaining = min(remaining,
+                                                    max(dl, 0.0) + 0.01)
+                            self._cv.wait(remaining)
+                        self._in_flight += nbytes
+                        self._tenant_add(self._inflight_by_tenant,
+                                         tenant, nbytes)
+                        if self._in_flight > self.max_in_flight_seen:
+                            self.max_in_flight_seen = self._in_flight
+                    finally:
+                        self._queue.remove(token)
+                        self._tenant_add(self._queued_by_tenant, tenant,
+                                         -1)
                         self._publish_gauges()
-                        remaining = deadline - time.monotonic()
-                        if remaining <= 0:
-                            self._counter(
-                                "tpu_admission_timeouts_total",
-                                "tickets that hit "
-                                "serve.admissionTimeoutMs without "
-                                "fitting in the budget",
-                                tenant).inc()
-                            raise AdmissionTimeout(
-                                f"admission ticket {label or '(query)'} "
-                                f"({nbytes} bytes) timed out after "
-                                f"{timeout:g}s: budget "
-                                f"{self.budget_bytes} bytes, "
-                                f"{self._in_flight} in flight, "
-                                f"{len(self._queue) - 1} ahead/behind "
-                                f"in queue")
-                        self._cv.wait(remaining)
-                    self._in_flight += nbytes
-                    self._tenant_add(self._inflight_by_tenant, tenant,
-                                     nbytes)
-                    if self._in_flight > self.max_in_flight_seen:
-                        self.max_in_flight_seen = self._in_flight
-                finally:
-                    self._queue.remove(token)
-                    self._tenant_add(self._queued_by_tenant, tenant, -1)
-                    self._publish_gauges()
-                    # head departure (admitted OR timed out) can
-                    # unblock the next waiter
-                    self._cv.notify_all()
+                        # head departure (admitted, timed out OR
+                        # cancelled) can unblock the next waiter
+                        self._cv.notify_all()
+            finally:
+                if ctok is not None:
+                    ctok.remove_waker(self._cv)
         wait_s = time.monotonic() - t0
         self._counter("tpu_admission_admitted_total",
                       "tickets granted a byte reservation",

@@ -272,9 +272,15 @@ def record_query_latency(tracer, tenant: str, error: Optional[BaseException]
         "Seconds spent extracting critical paths — the observatory's own "
         "overhead, guarded < 5% of query wall by the --slo gate.").inc(
             extract_s)
-    # sink 3: the SLO observatory (burn window, tail reservoir, ledger)
+    # sink 3: the SLO observatory (burn window, tail reservoir, ledger).
+    # Cancel/deadline accounting: a client cancel is excluded from the
+    # burn window (the engine didn't miss), a blown deadline counts BAD
+    from .progress import TpuQueryCancelled, TpuQueryDeadlineExceeded
     LatencyObservatory.get().record(
         tenant=tenant, wall_s=res["wall_s"], segments=res["segments"],
         failed=error is not None, label=label,
-        reconciled=res["reconciled"], extract_s=extract_s)
+        reconciled=res["reconciled"], extract_s=extract_s,
+        cancelled=(isinstance(error, TpuQueryCancelled)
+                   and getattr(error, "cause", "client") == "client"),
+        deadline=isinstance(error, TpuQueryDeadlineExceeded))
     return res

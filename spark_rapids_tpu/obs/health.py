@@ -239,6 +239,22 @@ class HealthMonitor:
                 if _SEVERITY[DEGRADED] > _SEVERITY[slo["status"]]:
                     slo["status"] = DEGRADED
             self._slo_burning_prev = burning
+        # progress observatory: a watchdog scan per snapshot — stalled
+        # queries degrade the endpoint and are NAMED (query, tenant,
+        # phase, deepest open operator), so the page says which query
+        # is stuck where, not just "something is slow"
+        try:
+            from .progress import ProgressTracker
+            stalls = ProgressTracker.get().watchdog_scan()
+        except Exception:
+            stalls = []
+        prg = components.setdefault("progress",
+                                    {"status": OK, "signals": {}})
+        prg["signals"]["stalled_queries"] = stalls
+        prg["signals"]["tpu_query_stalls_total"] = \
+            _counter_value(reg, "tpu_query_stalls_total")
+        if stalls and _SEVERITY[DEGRADED] > _SEVERITY[prg["status"]]:
+            prg["status"] = DEGRADED
         probe_ok = _gauge_value(reg, "tpu_device_probe_ok")
         dev = components.setdefault("device",
                                     {"status": OK, "signals": {}})
@@ -328,6 +344,14 @@ class MetricsServer:
                     from .fleet import fleet_refresh
                     fleet_refresh()
                     body = json.dumps(monitor.snapshot()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/queries"):
+                    # the progress observatory's live view; the scrape
+                    # doubles as a watchdog scan, so a stalled query is
+                    # flagged the moment anyone looks
+                    from .progress import ProgressTracker
+                    body = json.dumps(
+                        ProgressTracker.get().live_view()).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/spans"):
                     # the fleet pull endpoint: a consumer that carried a

@@ -51,10 +51,15 @@ def _classify(error) -> str:
     """Bundle kind from the failure's exception type."""
     from ..memory.admission import AdmissionTimeout
     from ..memory.memsan import LifecycleViolation
+    from .progress import TpuQueryCancelled, TpuQueryDeadlineExceeded
     if isinstance(error, AdmissionTimeout):
         return "admission_timeout"
     if isinstance(error, LifecycleViolation):
         return "dirty_ledger"
+    if isinstance(error, TpuQueryDeadlineExceeded):
+        return "deadline_exceeded"
+    if isinstance(error, TpuQueryCancelled):
+        return "cancelled"
     name = type(error).__name__ if error is not None else ""
     if "Leak" in name or "leak" in str(error or "").lower()[:200]:
         return "dirty_ledger"
@@ -186,6 +191,21 @@ def build_bundle(error, session=None, tracer=None, plan=None,
     best-effort: a dead subsystem contributes an error note, never an
     exception."""
     bundle = _bundle_header(error, tenant, kind)
+    # cancellation context: who set the flag and which checkpoint /
+    # operator observed it (the typed errors carry all three)
+    try:
+        from .progress import (TpuQueryCancelled,
+                               TpuQueryDeadlineExceeded)
+        if isinstance(error, (TpuQueryCancelled,
+                              TpuQueryDeadlineExceeded)):
+            bundle["cancellation"] = {
+                "cause": getattr(error, "cause", None),
+                "checkpoint": getattr(error, "checkpoint", None),
+                "operator": getattr(error, "operator", None),
+                "query_id": getattr(error, "query_id", None),
+            }
+    except Exception:
+        pass
     try:
         # the attribution scope is still on this thread — the failure
         # unwinds through session._execute inside push_context/pop
@@ -332,6 +352,13 @@ def render_postmortem(bundle: Dict[str, Any]) -> str:
                  + (f"  query: {bundle['query']}"
                     if bundle.get("query") else ""))
     lines.append(f"error:   {err.get('type')}: {err.get('message')}")
+    canc = bundle.get("cancellation")
+    if canc:
+        where = canc.get("checkpoint") or "?"
+        if canc.get("operator"):
+            where += f" in {canc['operator']}"
+        lines.append(f"cancel:  cause={canc.get('cause') or 'deadline'}"
+                     f", observed at {where}")
     op = bundle.get("failing_operator")
     if op:
         lines.append(f"failing operator: {op.get('operator')}"

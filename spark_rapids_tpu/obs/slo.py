@@ -186,7 +186,14 @@ class LatencyObservatory:
     # -- record side --------------------------------------------------------
     def record(self, tenant: str, wall_s: float, segments: Dict[str, float],
                failed: bool = False, label: str = "",
-               reconciled: bool = True, extract_s: float = 0.0) -> None:
+               reconciled: bool = True, extract_s: float = 0.0,
+               cancelled: bool = False, deadline: bool = False) -> None:
+        """``cancelled`` (a CLIENT cancel) excludes the request from the
+        burn window entirely — the engine didn't miss, the caller
+        changed its mind, and counting it either way would let a cancel
+        storm mask (or fake) real burn.  ``deadline`` (the query blew
+        its deadline_ms) counts BAD regardless of wall-vs-target: a
+        deadline miss IS the latency failure the SLO exists to catch."""
         from .metrics import MetricsRegistry
         tenant = tenant or "default"
         wall_ms = wall_s * 1000.0
@@ -195,10 +202,14 @@ class LatencyObservatory:
             self._seq += 1
             good = (not failed) and (self._target_ms is None
                                      or wall_ms <= self._target_ms)
+            if deadline:
+                good = False
+            client_cancel = cancelled and not deadline
             st.total += 1
             if good:
                 st.good += 1
-            st.window.append(good)
+            if not client_cancel:
+                st.window.append(good)
             rec = {"seq": self._seq, "wall_s": wall_s,
                    "segments": dict(segments), "failed": failed,
                    "label": label}

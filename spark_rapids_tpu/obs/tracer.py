@@ -187,7 +187,14 @@ class QueryTrace:
                       node_id=node_id, pid=pid, attrs=dict(attrs))
             self.spans.append(sp)
             self._by_id[sid] = sp
-            return sid
+        # progress observatory phase feed — outside the span lock (the
+        # hook takes the tracker's own lock; never nest the two).
+        # Phase spans and the admission wait are the only names that
+        # move a query's live-view phase, so filter here on the hot path
+        if kind == PHASE or name == "admission.wait":
+            from . import progress as _progress
+            _progress.note_span_open(name, kind)
+        return sid
 
     def end(self, sid: Optional[int], status: str = "ok",
             error: Optional[str] = None) -> None:

@@ -702,9 +702,35 @@ class AsyncBlockFetcher:
                              "blocks fetched by the async fetcher")
         bytes_c = m.counter("tpu_shuffle_fetch_bytes_total",
                             "device bytes fetched by the async fetcher")
+        # cooperative cancel checkpoint: with a query cancel token bound
+        # to this thread the blocking q.get() becomes a short poll so a
+        # cancel/deadline observed mid-fetch unwinds within ~250ms; the
+        # shared finally stops the producer, which drops its in-flight
+        # block — no orphaned shuffle state
+        from ..obs import progress as prog
+        from ..obs.progress import (TpuQueryCancelled,
+                                    TpuQueryDeadlineExceeded)
+        ctok = prog.current_token()
         try:
             while True:
-                item = q.get()
+                if ctok is not None:
+                    if ctok.cancelled:
+                        raise TpuQueryCancelled(
+                            ctok.describe("remote-fetch"),
+                            query_id=ctok.query_id,
+                            checkpoint="remote-fetch",
+                            cause=ctok.cause)
+                    if ctok.deadline_exceeded:
+                        raise TpuQueryDeadlineExceeded(
+                            ctok.describe("remote-fetch"),
+                            query_id=ctok.query_id,
+                            checkpoint="remote-fetch")
+                    try:
+                        item = q.get(timeout=0.25)
+                    except queue.Empty:
+                        continue
+                else:
+                    item = q.get()
                 if item is self._DONE:
                     return
                 if isinstance(item, BaseException):
@@ -723,6 +749,14 @@ class AsyncBlockFetcher:
         """Fold transport failures into the typed error taxonomy and
         count them: a socket error from a heartbeat-dead peer IS a dead
         peer, whatever errno it surfaced as."""
+        from ..obs.progress import (TpuQueryCancelled,
+                                    TpuQueryDeadlineExceeded)
+        if isinstance(ex, (TpuQueryCancelled, TpuQueryDeadlineExceeded)):
+            # cancellation is control flow, not a fetch failure: it
+            # unwinds with its type/cause/checkpoint intact and is
+            # counted once in tpu_cancellations_total, never in the
+            # fetch-error counters
+            return ex
         if isinstance(ex, TpuShufflePeerDeadError):
             kind = "peer_dead"
         elif isinstance(ex, TpuShuffleTruncatedFrameError):

@@ -15,6 +15,13 @@
     python -m spark_rapids_tpu.tools kernel-report  --compile-ledger PATH --estimator-ledger PATH [--top N] [--json]
     python -m spark_rapids_tpu.tools prewarm        --ledger DIR [--top K] [--cache-dir DIR]
     python -m spark_rapids_tpu.tools postmortem     <bundle.json|dir> [--json] [--last N]
+    python -m spark_rapids_tpu.tools top            [--url HOST:PORT] [--watch] [--json]
+
+`top` renders the progress observatory's live view (obs/progress.py;
+served as `GET /queries` on the health endpoint): one row per
+in-flight query with phase, blended progress ratio, ETA, rows vs the
+planner's predicted rows, the deepest open operator span, and
+stall/cancel flags from the stuck-query watchdog.
 
 `postmortem` renders the failure black box's bundles
 (obs/postmortem.py; dumped to <historyDir>/postmortems/ on query
@@ -590,6 +597,22 @@ def main(argv=None):
                     help="persistent XLA compile cache to populate "
                          "(spark.rapids.tpu.jit.persistentCacheDir); "
                          "without it the replay only validates recipes")
+    tp = sub.add_parser("top",
+                        help="live in-flight query view (phase, "
+                             "progress, ETA, deepest open operator, "
+                             "watchdog flags) from a running engine's "
+                             "GET /queries endpoint")
+    tp.add_argument("--url", default="127.0.0.1:9090",
+                    help="health endpoint host:port or full URL "
+                         "(spark.rapids.tpu.metrics.port)")
+    tp.add_argument("--watch", action="store_true",
+                    help="refresh in place every --interval seconds "
+                         "until Ctrl-C (default: one snapshot)")
+    tp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period with --watch (seconds)")
+    tp.add_argument("--json", action="store_true",
+                    help="emit the raw /queries JSON instead of the "
+                         "table")
     pm = sub.add_parser("postmortem",
                         help="render a failure black-box bundle "
                              "(failing operator, tenant, HBM occupancy "
@@ -651,6 +674,10 @@ def main(argv=None):
                                     as_json=args.json)
     elif args.cmd == "prewarm":
         return _run_prewarm(args.ledger, args.top, args.cache_dir)
+    elif args.cmd == "top":
+        from .top import run_top
+        return run_top(args.url, interval=args.interval,
+                       watch=args.watch, as_json=args.json)
     elif args.cmd == "postmortem":
         return _run_postmortem(args.target, as_json=args.json,
                                last=args.last)

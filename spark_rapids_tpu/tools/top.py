@@ -1,0 +1,122 @@
+"""`tools top` — the live in-flight query view (obs/progress.py).
+
+Reads `GET /queries` from a running engine's health endpoint
+(`spark.rapids.tpu.metrics.port`) and renders a `top`-style table:
+one row per in-flight query with phase, blended progress ratio, ETA,
+rows-vs-predicted, the deepest open operator, and any watchdog flags;
+a short tail of recently finished queries for context.  `--watch`
+refreshes in place; the default is one snapshot (scriptable, and what
+the gate exercises).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Dict, List, Optional
+from urllib.request import urlopen
+
+
+def fetch_view(url: str, timeout: float = 5.0) -> Dict:
+    """One `GET /queries` document from a running engine."""
+    if not url.startswith("http"):
+        url = f"http://{url}"
+    if not url.rstrip("/").endswith("/queries"):
+        url = url.rstrip("/") + "/queries"
+    with urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _bar(ratio: float, width: int = 12) -> str:
+    filled = int(round(max(0.0, min(ratio, 1.0)) * width))
+    return "#" * filled + "." * (width - filled)
+
+
+def _fmt_eta(eta: Optional[float]) -> str:
+    if eta is None:
+        return "-"
+    if eta >= 60:
+        return f"{eta / 60:.1f}m"
+    return f"{eta:.1f}s"
+
+
+def format_top(view: Dict) -> str:
+    """Render one live-view document as the `top` table."""
+    lines: List[str] = []
+    inflight = view.get("inflight") or []
+    stalled = {(s.get("tenant"), s.get("query"))
+               for s in view.get("stalled") or []}
+    wd = view.get("watchdog") or {}
+    lines.append(
+        f"queries: {len(inflight)} in flight, "
+        f"{len(stalled)} stalled "
+        f"(watchdog stall={wd.get('stall_seconds')}s"
+        + (f", auto-cancel={wd.get('auto_cancel_seconds')}s"
+           if wd.get("auto_cancel_seconds") else "") + ")")
+    if inflight:
+        lines.append(
+            f"{'TENANT':12s} {'QUERY':8s} {'PHASE':10s} "
+            f"{'PROGRESS':14s} {'RATIO':>6s} {'ETA':>7s} "
+            f"{'ROWS':>10s} {'PRED':>10s} {'ELAPSED':>8s}  OPERATOR")
+        for q in inflight:
+            flags = ""
+            if (q.get("tenant"), q.get("query")) in stalled or \
+                    q.get("stalled"):
+                flags += " STALLED"
+            if q.get("cancelled"):
+                flags += f" CANCELLING({q.get('cancel_cause')})"
+            ratio = q.get("progress_ratio") or 0.0
+            lines.append(
+                f"{str(q.get('tenant'))[:12]:12s} "
+                f"{str(q.get('query'))[:8]:8s} "
+                f"{str(q.get('phase'))[:10]:10s} "
+                f"[{_bar(ratio)}] {ratio:6.1%} "
+                f"{_fmt_eta(q.get('eta_s')):>7s} "
+                f"{q.get('rows') or 0:>10d} "
+                f"{q.get('predicted_rows') or 0:>10d} "
+                f"{q.get('elapsed_s', 0.0):>7.1f}s  "
+                f"{q.get('deepest_open_operator') or '-'}{flags}")
+    else:
+        lines.append("(no queries in flight)")
+    recent = view.get("recent") or []
+    if recent:
+        lines.append("")
+        lines.append("recent:")
+        for q in recent[-5:]:
+            outcome = q.get("error") or "ok"
+            if q.get("cancelled"):
+                outcome += f" (cancelled: {q.get('cancel_cause')})"
+            lines.append(
+                f"  {q.get('tenant')}/{q.get('query')} "
+                f"{q.get('elapsed_s', 0.0):.2f}s "
+                f"rows={q.get('rows') or 0} {outcome}")
+    return "\n".join(lines) + "\n"
+
+
+def run_top(url: str, interval: float = 2.0, watch: bool = False,
+            as_json: bool = False) -> int:
+    """CLI driver: one snapshot by default, refresh loop with
+    ``--watch`` (Ctrl-C exits 0)."""
+    try:
+        while True:
+            try:
+                view = fetch_view(url)
+            except OSError as ex:
+                sys.stderr.write(
+                    f"tools top: cannot reach {url}: {ex}\n"
+                    f"(is the engine running with "
+                    f"spark.rapids.tpu.metrics.port set?)\n")
+                return 2
+            if as_json:
+                sys.stdout.write(json.dumps(view, indent=2) + "\n")
+            else:
+                if watch:
+                    sys.stdout.write("\x1b[2J\x1b[H")  # clear screen
+                sys.stdout.write(format_top(view))
+                sys.stdout.flush()
+            if not watch:
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0

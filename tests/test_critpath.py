@@ -223,6 +223,46 @@ def test_failed_queries_are_always_bad(fresh_observatory):
     assert rep["burn_rate"] == pytest.approx(2.0)
 
 
+def test_client_cancel_excluded_from_burn_window(fresh_observatory):
+    """A client cancel is the caller changing its mind, not the engine
+    missing: it must stay OUT of the burn window entirely — counting it
+    either way would let a cancel storm fake (or mask) real burn."""
+    obs = LatencyObservatory.get().configure(target_ms=100,
+                                             objective=0.9)
+    for _ in range(9):
+        obs.record("pool-1", 0.010, {"compute:FilterExec": 0.010})
+    obs.record("pool-1", 0.500, {SEG_QUEUE_WAIT: 0.500})  # one real miss
+    base = obs.slo_report()["tenants"]["pool-1"]
+    assert base["window"] == 10
+    assert base["burn_rate"] == pytest.approx(1.0)
+    # a burst of client cancels — slow AND fast — moves nothing
+    obs.record("pool-1", 5.0, {SEG_QUEUE_WAIT: 5.0},
+               failed=True, cancelled=True)
+    obs.record("pool-1", 0.001, {SEG_OTHER: 0.001},
+               failed=True, cancelled=True)
+    rep = obs.slo_report()["tenants"]["pool-1"]
+    assert rep["total"] == 12       # still counted as traffic
+    assert rep["window"] == 10      # ... but absent from the window
+    assert rep["burn_rate"] == pytest.approx(1.0)
+
+
+def test_deadline_miss_counts_bad_in_burn_window(fresh_observatory):
+    """A blown deadline IS the latency failure the SLO exists to catch:
+    it counts BAD in the window even when the measured wall is under
+    target, and even though the request also carries the cancelled flag
+    (deadline wins over the client-cancel exclusion)."""
+    obs = LatencyObservatory.get().configure(target_ms=100,
+                                             objective=0.9)
+    for _ in range(9):
+        obs.record("pool-1", 0.010, {"compute:FilterExec": 0.010})
+    obs.record("pool-1", 0.005, {SEG_OTHER: 0.005},
+               cancelled=True, deadline=True)  # wall < target, still bad
+    rep = obs.slo_report()["tenants"]["pool-1"]
+    assert rep["total"] == 10 and rep["good"] == 9
+    assert rep["window"] == 10
+    assert rep["burn_rate"] == pytest.approx(1.0)
+
+
 def test_ledger_sink_appends_jsonl(fresh_observatory, tmp_path):
     path = tmp_path / "latency_ledger.jsonl"
     obs = LatencyObservatory.get().configure(target_ms=100,

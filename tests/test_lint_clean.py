@@ -248,9 +248,31 @@ def test_run_lint_hbm_gate_exits_zero():
     assert "hbm gate clean" in proc.stdout, proc.stdout
 
 
+def test_run_lint_progress_gate_exits_zero():
+    """Tier-1 gate for the progress observatory: the golden serve mix
+    must finish at ratio 1.0 with live-view partition counts
+    reconciling exactly to closed operator spans, a probed query must
+    show monotone mid-flight ratios that actually move, an injected
+    stall must trip the watchdog naming the deepest open operator
+    (degraded /healthz, black-boxed, then auto-cancelled with
+    cause=watchdog), cancels injected during compute / queue-wait /
+    remote-fetch plus a blown deadline_ms must each propagate their
+    exact typed error with balanced books and exactly one classified
+    bundle, and tracker hook overhead must stay under 5% of query
+    wall with the on/off anti-vacuity check proving the hooks are the
+    thing measured."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "devtools", "run_lint.py"),
+         "--progress"],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "progress gate clean" in proc.stdout, proc.stdout
+
+
 def test_run_lint_faults_gate_exits_zero():
     """Tier-1 gate for tpufsan: the exception-flow repo pass (TPU-R011/
-    R012/R013/R014) must be clean, the raise-graph must plan >= 40
+    R012/R013/R014) must be clean, the raise-graph must plan >= 50
     statically-reachable (seam, typed-error) injection pairs with zero
     untyped operational leaks, and the fault-injection campaign must
     then execute every pair for real — each injected error propagating

@@ -172,6 +172,171 @@ def test_configure_same_values_keeps_in_flight_state(fresh_admission):
 
 
 # ---------------------------------------------------------------------------
+# cooperative cancellation at the admission queue (obs/progress.py)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def fresh_progress():
+    from spark_rapids_tpu.obs import progress as prog
+    prog.ProgressTracker.reset_for_tests()
+    prog.bind_to_thread(None)
+    yield prog.ProgressTracker.get()
+    prog.bind_to_thread(None)
+    prog.ProgressTracker.reset_for_tests()
+
+
+def _wait_for(pred, timeout_s=5.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    pytest.fail(f"{what} not reached within {timeout_s}s")
+
+
+def _admit_bound(ctrl, qid, nbytes, outcome, order):
+    """Waiter thread body: register ``qid`` with the ProgressTracker and
+    bind its cancel token to this thread — the queue-wait checkpoint in
+    ``admit()`` finds the token via ``prog.current_token()``, exactly as
+    the session path does."""
+    from spark_rapids_tpu.obs import progress as prog
+    tracker = prog.ProgressTracker.get()
+    h = tracker.begin_query(qid, tenant="cancel-edge")
+    prog.bind_to_thread(h)
+    try:
+        t = ctrl.admit(nbytes, label=qid, timeout_s=10)
+        order.append(qid)
+        outcome[qid] = ("admitted", t)
+    except BaseException as ex:
+        outcome[qid] = ("raised", ex)
+    finally:
+        prog.bind_to_thread(None)
+        err = outcome[qid][1] if outcome[qid][0] == "raised" else None
+        tracker.end_query(h, error=err)
+
+
+def test_cancel_queue_head_wakes_next_waiter(fresh_admission, fresh_progress):
+    """Cancelling the ticket at the HEAD of the admission queue must
+    unwind it as a typed queue-wait cancel AND wake the waiter behind
+    it — which then admits without anything else releasing."""
+    from spark_rapids_tpu.obs.progress import (ProgressTracker,
+                                               TpuQueryCancelled)
+
+    def body():
+        ctrl = AdmissionController.configure(1000, 30.0)
+        t1 = ctrl.admit(900)
+        outcome, order = {}, []
+        th_a = threading.Thread(
+            target=_admit_bound, args=(ctrl, "qa", 500, outcome, order))
+        th_a.start()
+        _wait_for(lambda: ctrl.queue_depth == 1, what="qa queued")
+        th_b = threading.Thread(
+            target=_admit_bound, args=(ctrl, "qb", 50, outcome, order))
+        th_b.start()
+        _wait_for(lambda: ctrl.queue_depth == 2, what="qb queued behind qa")
+        assert not order  # qb (which fits) did NOT overtake the head
+
+        assert ProgressTracker.get().cancel("qa", tenant="cancel-edge")
+        th_a.join(5)
+        assert not th_a.is_alive()
+        kind, err = outcome["qa"]
+        assert kind == "raised" and isinstance(err, TpuQueryCancelled)
+        assert err.checkpoint == "queue-wait" and err.cause == "client"
+
+        # the cancel itself promoted qb to head and woke it: 900+50 fits
+        th_b.join(5)
+        assert not th_b.is_alive()
+        assert outcome["qb"][0] == "admitted" and order == ["qb"]
+
+        ctrl.release(outcome["qb"][1])
+        ctrl.release(t1)
+        assert ctrl.bytes_in_flight == 0 and ctrl.queue_depth == 0
+
+    run_with_watchdog(body, 60.0)
+
+
+def test_cancel_mid_queue_preserves_survivor_fifo(fresh_admission,
+                                                  fresh_progress):
+    """Cancelling a MIDDLE ticket removes only that ticket; the
+    survivors keep their original arrival order (head-of-line FIFO, no
+    re-sort, no overtake by the now-smaller tail)."""
+    from spark_rapids_tpu.obs.progress import (ProgressTracker,
+                                               TpuQueryCancelled)
+
+    def body():
+        ctrl = AdmissionController.configure(1000, 30.0)
+        t1 = ctrl.admit(900)
+        outcome, order = {}, []
+        threads = {}
+        for i, (qid, nb) in enumerate(
+                (("qa", 600), ("qb", 500), ("qc", 600))):
+            th = threading.Thread(
+                target=_admit_bound, args=(ctrl, qid, nb, outcome, order))
+            th.start()
+            threads[qid] = th
+            _wait_for(lambda d=i + 1: ctrl.queue_depth == d,
+                      what=f"{qid} queued")
+
+        assert ProgressTracker.get().cancel("qb", tenant="cancel-edge")
+        threads["qb"].join(5)
+        assert not threads["qb"].is_alive()
+        kind, err = outcome["qb"]
+        assert kind == "raised" and isinstance(err, TpuQueryCancelled)
+        assert err.checkpoint == "queue-wait"
+        assert ctrl.queue_depth == 2  # the survivors are still queued
+        assert not order              # ... and still blocked behind t1
+
+        ctrl.release(t1)
+        # qa (the original head) admits; qc (600 more) must keep waiting
+        _wait_for(lambda: "qa" in outcome, what="qa admitted")
+        assert outcome["qa"][0] == "admitted"
+        assert ctrl.queue_depth == 1 and "qc" not in outcome
+
+        ctrl.release(outcome["qa"][1])
+        threads["qc"].join(5)
+        assert not threads["qc"].is_alive()
+        assert outcome["qc"][0] == "admitted"
+        assert order == ["qa", "qc"]  # survivor FIFO preserved end-to-end
+
+        ctrl.release(outcome["qc"][1])
+        assert ctrl.bytes_in_flight == 0 and ctrl.queue_depth == 0
+
+    run_with_watchdog(body, 60.0)
+
+
+def test_cancel_after_admit_releases_ticket_exactly_once(fresh_admission,
+                                                         fresh_progress):
+    """A cancel that lands in the window between admission and the first
+    partition raises at the next checkpoint; the unwind releases the
+    ticket exactly once (and a second release is a no-op, not an
+    underflow)."""
+    from spark_rapids_tpu.obs import progress as prog
+    from spark_rapids_tpu.obs.progress import (ProgressTracker,
+                                               TpuQueryCancelled)
+    ctrl = AdmissionController.configure(1000, 5.0)
+    tracker = ProgressTracker.get()
+    h = tracker.begin_query("qz", tenant="cancel-edge")
+    prog.bind_to_thread(h)
+    try:
+        ticket = ctrl.admit(500, label="qz")
+        assert ctrl.bytes_in_flight == 500
+        assert tracker.cancel("qz", tenant="cancel-edge")
+        with pytest.raises(TpuQueryCancelled) as ei:
+            h.token.check(checkpoint="partition", operator="LocalScanExec")
+        assert ei.value.checkpoint == "partition"
+        assert ei.value.cause == "client"
+        ctrl.release(ticket)              # the unwind path's release
+        assert ctrl.bytes_in_flight == 0
+        ctrl.release(ticket)              # double release must be a no-op
+        assert ctrl.bytes_in_flight == 0
+        assert ctrl.queue_depth == 0
+        assert ctrl.max_in_flight_seen == 500
+    finally:
+        prog.bind_to_thread(None)
+        tracker.end_query(h, error=None)
+
+
+# ---------------------------------------------------------------------------
 # session-path admission (the tmsan bound as the ticket)
 # ---------------------------------------------------------------------------
 
